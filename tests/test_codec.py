@@ -1,0 +1,171 @@
+"""Codec invariants: roundtrip and memcmp-order preservation.
+
+Mirrors the reference's util/codec/codec_test.go table-driven style.
+"""
+
+import random
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu.codec import (
+    encode_key, encode_value, decode_all,
+    encode_bytes, decode_bytes,
+    encode_varint, decode_varint, encode_uvarint, decode_uvarint,
+)
+from tidb_tpu.types import Datum, Kind, NULL, compare_datum, datum_from_py
+from tidb_tpu.types.time_types import Duration, Time, parse_time, parse_duration
+
+
+INTS = [0, 1, -1, 2, -2, 127, -128, 255, 1 << 31, -(1 << 31), (1 << 63) - 1, -(1 << 63),
+        12345678901234, -98765432109876]
+FLOATS = [0.0, -0.0, 1.5, -1.5, 3.1415926, -2.718, 1e300, -1e300, 1e-300, -1e-300,
+          float("inf"), float("-inf")]
+BYTES = [b"", b"a", b"ab", b"abcdefg", b"abcdefgh", b"abcdefghi",
+         b"abcdefgh\x00", b"\x00", b"\xff" * 17, bytes(range(256))]
+DECIMALS = ["0", "1", "-1", "1.5", "-1.5", "0.001", "-0.001", "123456789.987654321",
+            "-123456789.987654321", "1E10", "-1E10", "0.5", "0.55", "-0.5", "-0.55",
+            "99999999999999999999.9999", "1.50", "150", "15000000"]
+
+
+def _roundtrip(datums, comparable):
+    enc = encode_key(datums) if comparable else encode_value(datums)
+    back = decode_all(enc)
+    assert len(back) == len(datums)
+    for a, b in zip(datums, back):
+        if a.kind == Kind.NULL:
+            assert b.kind == Kind.NULL
+        elif a.kind == Kind.STRING:
+            assert b.get_bytes() == a.get_bytes()
+        else:
+            assert compare_datum(a, b) == 0, (a, b)
+
+
+@pytest.mark.parametrize("comparable", [True, False])
+def test_roundtrip_all_kinds(comparable):
+    datums = (
+        [Datum.i64(v) for v in INTS]
+        + [Datum.u64(v) for v in [0, 1, (1 << 64) - 1, 1 << 63]]
+        + [Datum.f64(v) for v in FLOATS]
+        + [Datum.bytes_(v) for v in BYTES]
+        + [Datum.dec(Decimal(s)) for s in DECIMALS]
+        + [NULL,
+           Datum(Kind.DURATION, parse_duration("11:30:45.999999")),
+           Datum(Kind.TIME, parse_time("2026-07-29 11:30:45.123456")),
+           Datum(Kind.TIME, parse_time("1998-09-02"))]
+    )
+    _roundtrip(datums, comparable)
+
+
+def _assert_order_preserved(datums):
+    """encode_key order must equal compare_datum order."""
+    encoded = [(encode_key([d]), d) for d in datums]
+    for i, (ea, da) in enumerate(encoded):
+        for eb, db in encoded:
+            want = compare_datum(da, db)
+            got = -1 if ea < eb else (0 if ea == eb else 1)
+            assert got == want, (da, db, ea.hex(), eb.hex())
+
+
+def test_int_order():
+    _assert_order_preserved([Datum.i64(v) for v in INTS])
+
+
+def test_mixed_int_uint_order():
+    # uint and int share memcmp space only within their own flags; check each
+    _assert_order_preserved([Datum.u64(v) for v in [0, 1, 255, 1 << 40, (1 << 64) - 1]])
+
+
+def test_float_order():
+    vals = [v for v in FLOATS]
+    _assert_order_preserved([Datum.f64(v) for v in vals])
+
+
+def test_bytes_order():
+    _assert_order_preserved([Datum.bytes_(v) for v in BYTES])
+
+
+def test_decimal_order():
+    _assert_order_preserved([Datum.dec(Decimal(s)) for s in DECIMALS])
+
+
+def test_time_order():
+    ts = ["1000-01-01", "1998-09-02", "1998-09-02 00:00:01", "2026-07-29 23:59:59.999999",
+          "9999-12-31 23:59:59"]
+    _assert_order_preserved([Datum(Kind.TIME, parse_time(t)) for t in ts])
+
+
+def test_duration_order():
+    ds = ["-838:59:59", "-00:00:01", "00:00:00", "00:00:01", "838:59:59"]
+    _assert_order_preserved([Datum(Kind.DURATION, parse_duration(d)) for d in ds])
+
+
+def test_null_sorts_first():
+    enc_null = encode_key([NULL])
+    for d in [Datum.i64(-(1 << 63)), Datum.bytes_(b""), Datum.f64(float("-inf")),
+              Datum.dec(Decimal("-1E100"))]:
+        assert enc_null < encode_key([d])
+
+
+def test_compound_key_order():
+    rows = [
+        [Datum.i64(1), Datum.bytes_(b"a")],
+        [Datum.i64(1), Datum.bytes_(b"ab")],
+        [Datum.i64(2), Datum.bytes_(b"")],
+        [Datum.i64(2), NULL],
+    ]
+    keys = [encode_key(r) for r in rows]
+    assert keys[0] < keys[1] < keys[2]
+    assert keys[3] < keys[2]  # NULL sorts before ""
+
+
+def test_bytes_group_boundary_fuzz():
+    rng = random.Random(42)
+    pool = []
+    for _ in range(200):
+        n = rng.choice([0, 1, 7, 8, 9, 15, 16, 17, rng.randrange(0, 40)])
+        pool.append(bytes(rng.randrange(256) for _ in range(n)))
+    encs = sorted((encode_key([Datum.bytes_(p)]), p) for p in pool)
+    raws = [p for _, p in encs]
+    assert raws == sorted(pool)
+    for p in pool:
+        buf = bytearray()
+        encode_bytes(buf, p)
+        back, used = decode_bytes(memoryview(bytes(buf)), 0)
+        assert back == p and used == len(buf)
+
+
+def test_varint_roundtrip():
+    for v in INTS:
+        buf = bytearray()
+        encode_varint(buf, v)
+        got, pos = decode_varint(memoryview(bytes(buf)), 0)
+        assert got == v and pos == len(buf)
+    for v in [0, 1, 300, (1 << 64) - 1]:
+        buf = bytearray()
+        encode_uvarint(buf, v)
+        got, pos = decode_uvarint(memoryview(bytes(buf)), 0)
+        assert got == v and pos == len(buf)
+
+
+def test_decimal_canonical_trailing_zeros():
+    a = encode_key([Datum.dec(Decimal("1.5"))])
+    b = encode_key([Datum.dec(Decimal("1.50"))])
+    assert a == b
+
+
+def test_decimal_beyond_context_precision():
+    # regression: Decimal.normalize()/scaleb() round to the 28-digit context
+    # precision; the codec must stay exact for arbitrarily long mantissas
+    vals = [Decimal("9" * 60), Decimal("-" + "9" * 60), Decimal("1E-1000"),
+            Decimal("1." + "123456789" * 5)]
+    for v in vals:
+        enc = encode_key([Datum.dec(v)])
+        assert decode_all(enc)[0].val == v
+
+
+def test_decode_malformed_raises_valueerror():
+    for raw in [b"\x03\x00\x00", b"\x06\x02", b"\x09\x02\x09", b"\xf0",
+                b"\x01abc", b"\x02\x08abc", b"\x08\x01"]:
+        with pytest.raises(ValueError):
+            decode_all(raw)

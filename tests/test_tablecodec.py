@@ -1,0 +1,82 @@
+"""Key/value layout tests (mirrors tablecodec tests)."""
+
+from decimal import Decimal
+
+from tidb_tpu import tablecodec as tc
+from tidb_tpu.types import Datum, NULL, compare_datum
+
+
+def test_row_key_roundtrip():
+    for tid, h in [(1, 1), (5, -7), (1 << 40, (1 << 63) - 1), (3, -(1 << 63))]:
+        key = tc.encode_row_key(tid, h)
+        assert tc.decode_row_key(key) == (tid, h)
+        assert tc.decode_table_id(key) == tid
+
+
+def test_row_key_order_matches_handle_order():
+    tid = 42
+    handles = [-(1 << 63), -100, -1, 0, 1, 99, (1 << 63) - 1]
+    keys = [tc.encode_row_key(tid, h) for h in handles]
+    assert keys == sorted(keys)
+
+
+def test_record_prefix_contains_all_handles():
+    tid = 7
+    start, end = tc.encode_record_range(tid)
+    for h in [-(1 << 63), 0, (1 << 63) - 1]:
+        k = tc.encode_row_key(tid, h)
+        assert start <= k < end
+    other = tc.encode_row_key(8, 0)
+    assert not (start <= other < end)
+
+
+def test_tables_dont_interleave():
+    # all keys of table 7 sort strictly before all keys of table 8
+    last_t7 = tc.encode_row_key(7, (1 << 63) - 1)
+    first_t8 = tc.encode_index_key(8, 1, [NULL], None)
+    assert last_t7 < tc.table_prefix(8) <= first_t8
+
+
+def test_row_value_roundtrip():
+    cols = [1, 3, 7]
+    vals = [Datum.i64(5), Datum.string("hello"), Datum.dec(Decimal("1.25"))]
+    enc = tc.encode_row(cols, vals)
+    back = tc.decode_row(enc)
+    assert set(back) == {1, 3, 7}
+    for cid, d in zip(cols, vals):
+        assert compare_datum(back[cid], d) == 0
+
+
+def test_empty_row_value():
+    enc = tc.encode_row([], [])
+    assert len(enc) == 1
+    assert tc.decode_row(enc) == {}
+
+
+def test_index_key_roundtrip():
+    vals = [Datum.i64(9), Datum.string("xy")]
+    key = tc.encode_index_key(11, 2, vals, handle=77)
+    got, suffix = tc.cut_index_key(key, 2)
+    assert compare_datum(got[0], vals[0]) == 0
+    assert got[1].get_bytes() == b"xy"
+    assert tc.decode_handle_from_index_suffix(suffix) == 77
+
+
+def test_index_key_order():
+    rows = [[Datum.i64(1), Datum.string("a")],
+            [Datum.i64(1), Datum.string("b")],
+            [Datum.i64(2), Datum.string("a")]]
+    keys = [tc.encode_index_key(1, 1, r, handle=i) for i, r in enumerate(rows)]
+    assert keys == sorted(keys)
+
+
+def test_handle_range_keys():
+    tid = 3
+    start, end = tc.handle_range_keys(tid, 10, 20)
+    assert start <= tc.encode_row_key(tid, 10) < end
+    assert start <= tc.encode_row_key(tid, 20) < end
+    assert not (start <= tc.encode_row_key(tid, 21) < end)
+    assert not (start <= tc.encode_row_key(tid, 9) < end)
+    # unbounded high end
+    start, end = tc.handle_range_keys(tid, 0, (1 << 63) - 1)
+    assert start <= tc.encode_row_key(tid, (1 << 63) - 1) < end

@@ -1,0 +1,110 @@
+"""Datum comparison/conversion tests (mirrors util/types tests)."""
+
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu import errors, mysqldef as my
+from tidb_tpu.types import (
+    Datum, Kind, NULL, compare_datum, convert_datum, datum_from_py,
+    FieldType, parse_time, parse_duration,
+)
+from tidb_tpu.types.field_type import new_field_type, agg_field_type
+
+
+def cmp(a, b):
+    return compare_datum(datum_from_py(a), datum_from_py(b))
+
+
+def test_cross_type_numeric_compare():
+    assert cmp(1, 1.0) == 0
+    assert cmp(1, Decimal("1.00")) == 0
+    assert cmp(2, 1.5) == 1
+    assert cmp(-1, 0.5) == -1
+    assert cmp(Decimal("1.1"), 1.1) == 0
+    assert cmp((1 << 63) - 1, float((1 << 63) - 1)) in (-1, 0)  # float rounding tolerated
+    assert cmp("12", 12) == 0
+    assert cmp("12.5", 12.5) == 0
+    assert cmp("abc", 0) == 0  # non-numeric string coerces to 0
+
+
+def test_string_compare_binary():
+    assert cmp("a", "b") == -1
+    assert cmp(b"ab", "ab") == 0
+    assert cmp("abc", "ab") == 1
+
+
+def test_null_ordering():
+    assert compare_datum(NULL, NULL) == 0
+    assert compare_datum(NULL, Datum.i64(-(1 << 63))) == -1
+    assert compare_datum(Datum.string(""), NULL) == 1
+
+
+def test_time_duration_compare():
+    t1 = datum_from_py(parse_time("1998-09-02"))
+    t2 = datum_from_py(parse_time("1998-09-03"))
+    assert compare_datum(t1, t2) == -1
+    d1 = datum_from_py(parse_duration("01:00:00"))
+    d2 = datum_from_py(parse_duration("-01:00:00"))
+    assert compare_datum(d1, d2) == 1
+
+
+def test_convert_int_bounds():
+    ft = new_field_type(my.TypeTiny)
+    assert convert_datum(Datum.i64(127), ft).get_int() == 127
+    with pytest.raises(errors.OverflowError_):
+        convert_datum(Datum.i64(128), ft)
+    ft.flag |= my.UnsignedFlag
+    assert convert_datum(Datum.i64(255), ft).get_int() == 255
+    with pytest.raises(errors.OverflowError_):
+        convert_datum(Datum.i64(-1), ft)
+
+
+def test_convert_rounding():
+    ft = new_field_type(my.TypeLong)
+    assert convert_datum(Datum.f64(1.5), ft).get_int() == 2
+    assert convert_datum(Datum.f64(-1.5), ft).get_int() == -2
+    assert convert_datum(Datum.f64(2.4), ft).get_int() == 2
+    assert convert_datum(Datum.string("3.6"), ft).get_int() == 4
+
+
+def test_convert_decimal_quantize():
+    ft = new_field_type(my.TypeNewDecimal)
+    ft.flen, ft.decimal = 10, 2
+    d = convert_datum(Datum.string("1.005"), ft)
+    assert d.val == Decimal("1.01")
+    d = convert_datum(Datum.f64(2.5), ft)
+    assert d.val == Decimal("2.50")
+
+
+def test_convert_string_flen():
+    ft = new_field_type(my.TypeVarchar)
+    ft.flen = 3
+    assert convert_datum(Datum.string("abc"), ft).get_string() == "abc"
+    with pytest.raises(errors.OverflowError_):
+        convert_datum(Datum.string("abcd"), ft)
+
+
+def test_convert_time():
+    ft = new_field_type(my.TypeDate)
+    d = convert_datum(Datum.string("1998-09-02 11:22:33"), ft)
+    assert str(d.val) == "1998-09-02"
+    ft2 = new_field_type(my.TypeDatetime)
+    d2 = convert_datum(Datum.string("19980902112233"), ft2)
+    assert str(d2.val) == "1998-09-02 11:22:33"
+
+
+def test_time_packed_roundtrip():
+    t = parse_time("2026-07-29 11:30:45.123456")
+    from tidb_tpu.types.time_types import Time
+    assert Time.from_packed_int(t.to_packed_int()).dt == t.dt
+
+
+def test_agg_field_types():
+    dec = new_field_type(my.TypeNewDecimal)
+    dec.decimal = 2
+    assert agg_field_type("count", dec).tp == my.TypeLonglong
+    assert agg_field_type("sum", dec).tp == my.TypeNewDecimal
+    assert agg_field_type("sum", new_field_type(my.TypeDouble)).tp == my.TypeDouble
+    assert agg_field_type("avg", dec).decimal == 6
+    assert agg_field_type("max", dec).tp == my.TypeNewDecimal

@@ -1,0 +1,27 @@
+"""tidb_tpu — a TPU-native distributed SQL engine.
+
+Capability target: the 2016-era TiDB beta at /root/reference (MySQL-compatible
+frontend, cost-based planner with coprocessor pushdown, MVCC transactions over a
+KV core, online schema change).  The coprocessor execution tier is rebuilt for
+TPUs: eligible scan/filter/projection/aggregation subtrees are routed to a
+JAX columnar engine (``tidb_tpu.ops``) instead of a row-at-a-time interpreter,
+with per-region partial aggregates combined via collectives over a device mesh
+(``tidb_tpu.parallel``).
+
+Layer map (mirrors SURVEY.md §1; reference files cited per-module):
+
+  session.py      — Parse/Compile/runStmt, txn lifecycle   (ref: tidb.go, session.go)
+  parser/ sqlast/ — SQL frontend                            (ref: parser/, ast/)
+  plan/           — logical/physical planner + pushdown     (ref: plan/)
+  executor/       — volcano operators + distsql executors   (ref: executor/)
+  distsql/        — coprocessor request/result framework    (ref: distsql/)
+  copr/           — coprocessor protocol + CPU xeval        (ref: distsql/xeval,
+                                                             store/localstore/local_region.go)
+  ops/            — TPU columnar coprocessor (JAX/Pallas)   (new: the north star)
+  parallel/       — device mesh, sharded scan, psum combine (new)
+  kv/ localstore/ — txn KV abstraction + MVCC store         (ref: kv/, store/localstore/)
+  model/ meta/ table/ tablecodec/ — schema & row codec      (ref: model/, meta/, table/)
+  types/ codec/   — Datum values, order-preserving codec    (ref: util/types, util/codec)
+"""
+
+__version__ = "0.1.0"

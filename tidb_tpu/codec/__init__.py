@@ -1,0 +1,41 @@
+"""Order-preserving binary codec — the storage wire format.
+
+Reference: util/codec/ (number.go, bytes.go, float.go, decimal.go, codec.go).
+Invariant: for datums a, b of comparable kinds,
+    compare_datum(a, b) == cmp(encode_key([a]), encode_key([b]))
+(memcmp order). Key encoding is order-preserving; value encoding uses the
+compact variants (smaller, not order-preserving).
+"""
+
+from tidb_tpu.codec.codec import (  # noqa: F401
+    encode_key,
+    encode_value,
+    decode_one,
+    decode_all,
+    encode_datum,
+    NIL_FLAG,
+    BYTES_FLAG,
+    COMPACT_BYTES_FLAG,
+    INT_FLAG,
+    UINT_FLAG,
+    FLOAT_FLAG,
+    DECIMAL_FLAG,
+    DURATION_FLAG,
+    TIME_FLAG,
+    MAX_FLAG,
+)
+from tidb_tpu.codec.number import (  # noqa: F401
+    encode_int_to_cmp_uint,
+    decode_cmp_uint_to_int,
+    encode_u64,
+    decode_u64,
+    encode_varint,
+    decode_varint,
+    encode_uvarint,
+    decode_uvarint,
+)
+from tidb_tpu.codec.bytes_codec import (  # noqa: F401
+    encode_bytes,
+    decode_bytes,
+    encode_bytes_desc,
+)

@@ -1,0 +1,102 @@
+"""Error taxonomy.
+
+Reference: terror/terror.go (error class/code registry with MySQL code mapping)
+and kv/error.go (retryable-error detection driving session.Retry).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import mysqldef as my
+
+
+class TiDBError(Exception):
+    """Base engine error carrying a MySQL error code for the wire protocol."""
+
+    code: int = my.ErrUnknown
+
+    def __init__(self, msg: str = "", code: int | None = None):
+        super().__init__(msg)
+        if code is not None:
+            self.code = code
+
+
+class ParseError(TiDBError):
+    code = my.ErrParse
+
+
+class PlanError(TiDBError):
+    pass
+
+
+class ExecError(TiDBError):
+    pass
+
+
+class UnknownFieldError(TiDBError):
+    code = my.ErrBadField
+
+
+class NoSuchTableError(TiDBError):
+    code = my.ErrNoSuchTable
+
+
+class TableExistsError(TiDBError):
+    code = my.ErrTableExists
+
+
+class BadDBError(TiDBError):
+    code = my.ErrBadDB
+
+
+class DBExistsError(TiDBError):
+    code = my.ErrDBCreateExists
+
+
+class DupEntryError(TiDBError):
+    code = my.ErrDupEntry
+
+
+class TypeError_(TiDBError):
+    code = my.ErrTruncated
+
+
+class OverflowError_(TiDBError):
+    code = my.ErrDataTooLong
+
+
+class DivByZeroError(TiDBError):
+    code = my.ErrDivisionByZero
+
+
+# ---- KV-layer errors (kv/error.go) ----
+
+class KVError(TiDBError):
+    pass
+
+
+class KeyNotExistsError(KVError):
+    """kv.ErrNotExist"""
+
+
+class KeyExistsError(KVError, DupEntryError):
+    """kv.ErrKeyExists — unique constraint violation surfaced as 1062."""
+    code = my.ErrDupEntry
+
+
+class RetryableError(KVError):
+    """kv.ErrRetryable / write-conflict class: session may replay the txn.
+
+    Reference: kv/error.go IsRetryableError + session.Retry (session.go:274).
+    """
+
+
+class WriteConflictError(RetryableError):
+    pass
+
+
+class LockedError(RetryableError):
+    """localstore ErrLockConflict (store/localstore/kv.go tryLock)."""
+
+
+def is_retryable(err: BaseException) -> bool:
+    return isinstance(err, RetryableError)

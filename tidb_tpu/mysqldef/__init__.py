@@ -1,0 +1,122 @@
+"""MySQL protocol/semantic constants (subset).
+
+Reference: mysql/type.go, mysql/const.go, mysql/errcode.go in /root/reference.
+Only the constants the engine actually consults are defined; the wire server
+(tidb_tpu.server) will extend this as protocol support widens.
+"""
+
+# ---- column type codes (mysql/type.go) ----
+TypeDecimal = 0x00
+TypeTiny = 0x01
+TypeShort = 0x02
+TypeLong = 0x03
+TypeFloat = 0x04
+TypeDouble = 0x05
+TypeNull = 0x06
+TypeTimestamp = 0x07
+TypeLonglong = 0x08
+TypeInt24 = 0x09
+TypeDate = 0x0A
+TypeDuration = 0x0B
+TypeDatetime = 0x0C
+TypeYear = 0x0D
+TypeNewDate = 0x0E
+TypeVarchar = 0x0F
+TypeBit = 0x10
+TypeNewDecimal = 0xF6
+TypeEnum = 0xF7
+TypeSet = 0xF8
+TypeTinyBlob = 0xF9
+TypeMediumBlob = 0xFA
+TypeLongBlob = 0xFB
+TypeBlob = 0xFC
+TypeVarString = 0xFD
+TypeString = 0xFE
+TypeGeometry = 0xFF
+
+STRING_TYPES = frozenset(
+    (TypeVarchar, TypeVarString, TypeString, TypeBlob, TypeTinyBlob,
+     TypeMediumBlob, TypeLongBlob)
+)
+INTEGER_TYPES = frozenset((TypeTiny, TypeShort, TypeInt24, TypeLong, TypeLonglong, TypeYear))
+FLOAT_TYPES = frozenset((TypeFloat, TypeDouble))
+TIME_TYPES = frozenset((TypeDate, TypeNewDate, TypeDatetime, TypeTimestamp))
+
+# ---- column flags (mysql/const.go) ----
+NotNullFlag = 1
+PriKeyFlag = 2
+UniqueKeyFlag = 4
+MultipleKeyFlag = 8
+BlobFlag = 16
+UnsignedFlag = 32
+ZerofillFlag = 64
+BinaryFlag = 128
+AutoIncrementFlag = 512
+OnUpdateNowFlag = 8192
+
+
+def has_unsigned_flag(flag: int) -> bool:
+    return bool(flag & UnsignedFlag)
+
+
+def has_not_null_flag(flag: int) -> bool:
+    return bool(flag & NotNullFlag)
+
+
+def has_auto_increment_flag(flag: int) -> bool:
+    return bool(flag & AutoIncrementFlag)
+
+
+def has_pri_key_flag(flag: int) -> bool:
+    return bool(flag & PriKeyFlag)
+
+
+# ---- default lengths (mysql/type.go GetDefaultFieldLength equivalents) ----
+def default_field_length(tp: int) -> int:
+    return {
+        TypeTiny: 4, TypeShort: 6, TypeInt24: 9, TypeLong: 11, TypeLonglong: 21,
+        TypeFloat: 12, TypeDouble: 22, TypeNewDecimal: 11, TypeDuration: 10,
+        TypeDate: 10, TypeDatetime: 19, TypeTimestamp: 19, TypeYear: 4,
+    }.get(tp, -1)
+
+
+# ---- integer bounds ----
+MaxInt64 = (1 << 63) - 1
+MinInt64 = -(1 << 63)
+MaxUint64 = (1 << 64) - 1
+
+SIGNED_BOUNDS = {
+    TypeTiny: (-128, 127),
+    TypeShort: (-32768, 32767),
+    TypeInt24: (-8388608, 8388607),
+    TypeLong: (-2147483648, 2147483647),
+    TypeLonglong: (MinInt64, MaxInt64),
+    TypeYear: (1901, 2155),
+}
+UNSIGNED_BOUNDS = {
+    TypeTiny: 255,
+    TypeShort: 65535,
+    TypeInt24: 16777215,
+    TypeLong: 4294967295,
+    TypeLonglong: MaxUint64,
+    TypeYear: 2155,
+}
+
+# ---- error codes (subset of mysql/errcode.go) ----
+ErrDupEntry = 1062
+ErrBadDB = 1049
+ErrNoSuchTable = 1146
+ErrTableExists = 1050
+ErrBadField = 1054
+ErrParse = 1064
+ErrUnknown = 1105
+ErrDivisionByZero = 1365
+ErrDataTooLong = 1406
+ErrTruncated = 1265
+ErrNonUniq = 1052
+ErrWrongValueCount = 1136
+ErrCantDropFieldOrKey = 1091
+ErrDupKeyName = 1061
+ErrDBCreateExists = 1007
+ErrDBDropExists = 1008
+ErrAccessDenied = 1045

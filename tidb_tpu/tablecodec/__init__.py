@@ -1,0 +1,153 @@
+"""Table key/value layout over the KV store.
+
+Reference: tablecodec/tablecodec.go —
+  rowkey    = 't' + enc_int(tableID) + '_r' + enc_int(handle)        (:39-43,:54)
+  index key = 't' + enc_int(tableID) + '_i' + enc_int(indexID)
+              + encoded column datums [+ enc_int(handle) if non-unique] (:340)
+  row value = interleaved [colID datum, value datum] pairs, compact   (:113,:198)
+
+enc_int is the order-preserving comparable int encoding, so handle order ==
+key order and regions can split on handle boundaries.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.codec import codec as cdc
+from tidb_tpu.codec import number as num
+from tidb_tpu.types.datum import Datum, Kind
+
+TABLE_PREFIX = b"t"
+ROW_PREFIX_SEP = b"_r"
+INDEX_PREFIX_SEP = b"_i"
+META_PREFIX = b"m"
+
+RECORD_ROW_KEY_LEN = 1 + 9 + 2 + 9  # t + enc_int(tid) + _r + enc_int(handle)
+
+
+def _enc_int(v: int) -> bytes:
+    buf = bytearray([cdc.INT_FLAG])
+    num.encode_u64(buf, num.encode_int_to_cmp_uint(v))
+    return bytes(buf)
+
+
+def _dec_int(data: bytes, pos: int) -> tuple[int, int]:
+    if data[pos] != cdc.INT_FLAG:
+        raise ValueError("invalid int flag in key")
+    u, pos2 = num.decode_u64(memoryview(data), pos + 1)
+    return num.decode_cmp_uint_to_int(u), pos2
+
+
+def table_record_prefix(table_id: int) -> bytes:
+    return TABLE_PREFIX + _enc_int(table_id) + ROW_PREFIX_SEP
+
+
+def table_index_prefix(table_id: int) -> bytes:
+    return TABLE_PREFIX + _enc_int(table_id) + INDEX_PREFIX_SEP
+
+
+def table_prefix(table_id: int) -> bytes:
+    return TABLE_PREFIX + _enc_int(table_id)
+
+
+def encode_row_key(table_id: int, handle: int) -> bytes:
+    return table_record_prefix(table_id) + _enc_int(handle)
+
+
+def decode_row_key(key: bytes) -> tuple[int, int]:
+    """key → (table_id, handle)."""
+    if not key.startswith(TABLE_PREFIX):
+        raise ValueError(f"not a record key: {key!r}")
+    tid, pos = _dec_int(key, 1)
+    if key[pos : pos + 2] != ROW_PREFIX_SEP:
+        raise ValueError(f"not a record key: {key!r}")
+    handle, _ = _dec_int(key, pos + 2)
+    return tid, handle
+
+
+def decode_table_id(key: bytes) -> int:
+    if not key.startswith(TABLE_PREFIX):
+        raise ValueError(f"not a table key: {key!r}")
+    tid, _ = _dec_int(key, 1)
+    return tid
+
+
+def encode_index_seek_key(table_id: int, index_id: int, encoded_values: bytes = b"") -> bytes:
+    return table_index_prefix(table_id) + _enc_int(index_id) + encoded_values
+
+
+def encode_index_key(table_id: int, index_id: int, values, handle: int | None) -> bytes:
+    """Non-unique indexes append the handle to disambiguate duplicates."""
+    buf = bytearray(encode_index_seek_key(table_id, index_id))
+    for d in values:
+        cdc.encode_datum(buf, d, comparable=True)
+    if handle is not None:
+        buf += _enc_int(handle)
+    return bytes(buf)
+
+
+def cut_index_key(key: bytes, n_values: int) -> tuple[list[Datum], bytes]:
+    """Split an index key into its column datums and the remaining suffix
+    (handle for non-unique indexes). Reference: tablecodec.CutIndexKey:357."""
+    prefix_len = 1 + 9 + 2 + 9  # t + tid + _i + idxID
+    mv = memoryview(key)
+    pos = prefix_len
+    vals = []
+    for _ in range(n_values):
+        d, pos = cdc.decode_one(mv, pos)
+        vals.append(d)
+    return vals, key[pos:]
+
+
+def decode_handle_from_index_suffix(suffix: bytes) -> int:
+    h, _ = _dec_int(suffix, 0)
+    return h
+
+
+# ---- row values ----
+
+def encode_row(col_ids, datums) -> bytes:
+    """Row value = [colID, value, colID, value, ...] compact-encoded.
+    Reference: tablecodec.EncodeRow:113. Empty rows encode as a single 0
+    byte so the KV layer never stores an empty value."""
+    if len(col_ids) != len(datums):
+        raise errors.ExecError("encode_row: column/value count mismatch")
+    if not col_ids:
+        return bytes([cdc.NIL_FLAG])
+    buf = bytearray()
+    for cid, d in zip(col_ids, datums):
+        cdc.encode_datum(buf, Datum.i64(cid), comparable=False)
+        cdc.encode_datum(buf, d, comparable=False)
+    return bytes(buf)
+
+
+def decode_row(value: bytes) -> dict[int, Datum]:
+    """Row value → {colID: datum}. Reference: tablecodec.DecodeRow:198."""
+    out: dict[int, Datum] = {}
+    if not value or value == bytes([cdc.NIL_FLAG]):
+        return out
+    mv = memoryview(value)
+    pos = 0
+    while pos < len(mv):
+        cid_d, pos = cdc.decode_one(mv, pos)
+        if pos >= len(mv):
+            raise ValueError("truncated row value")
+        val_d, pos = cdc.decode_one(mv, pos)
+        out[cid_d.get_int()] = val_d
+    return out
+
+
+def encode_record_range(table_id: int) -> tuple[bytes, bytes]:
+    """[start, end) covering all records of a table."""
+    prefix = table_record_prefix(table_id)
+    return prefix, prefix + b"\xff" * 9
+
+
+def handle_range_keys(table_id: int, low: int, high_inclusive: int) -> tuple[bytes, bytes]:
+    """[start, end) for a handle range [low, high]."""
+    start = encode_row_key(table_id, low)
+    if high_inclusive >= (1 << 63) - 1:
+        end = table_record_prefix(table_id) + b"\xff" * 9
+    else:
+        end = encode_row_key(table_id, high_inclusive + 1)
+    return start, end

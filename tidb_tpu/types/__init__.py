@@ -1,0 +1,21 @@
+"""SQL value types: Datum tagged union, FieldType, conversion/comparison rules.
+
+Reference: util/types/datum.go (Datum), util/types/field_type.go,
+util/types/convert.go, util/types/compare.go, mydecimal/time/duration files.
+Decimal uses Python's decimal.Decimal (exact); Time/Duration are thin wrappers
+over datetime with fsp. The TPU columnar tier (tidb_tpu.ops) maps these to
+fixed-point int64 / float64 / dictionary-coded planes — see ops/columnar.py.
+"""
+
+from tidb_tpu.types.datum import (  # noqa: F401
+    Datum,
+    Kind,
+    NULL,
+    MIN_NOT_NULL,
+    MAX_VALUE,
+    compare_datum,
+    datum_from_py,
+)
+from tidb_tpu.types.field_type import FieldType, agg_field_type  # noqa: F401
+from tidb_tpu.types.time_types import Duration, Time, parse_time, parse_duration  # noqa: F401
+from tidb_tpu.types.convert import convert_datum, cast_to_number, coerce_arith, unflatten_datum  # noqa: F401

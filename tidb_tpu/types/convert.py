@@ -1,0 +1,201 @@
+"""Value conversion to column types and arithmetic coercion.
+
+Reference: util/types/convert.go (Convert/ConvertTo), util/types/etc.go
+overflow handling, evaluator/arith rules (ComputeArithmetic operand coercion).
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal, ROUND_HALF_UP, ROUND_HALF_EVEN
+
+from tidb_tpu import errors, mysqldef as my
+from tidb_tpu.types.datum import Datum, Kind, NULL
+from tidb_tpu.types.field_type import FieldType
+from tidb_tpu.types.time_types import Duration, Time, parse_duration, parse_time
+
+
+def convert_datum(d: Datum, ft: FieldType) -> Datum:
+    """Convert a datum to a column's FieldType for storage (CAST semantics).
+
+    Raises OverflowError_/TypeError_ on out-of-range or malformed input
+    (strict mode; the reference's non-strict truncation warnings are a later
+    session-variable feature).
+    """
+    if d.kind == Kind.NULL:
+        return NULL
+    tp = ft.tp
+    if tp in my.INTEGER_TYPES:
+        return _to_int(d, ft)
+    if tp in my.FLOAT_TYPES:
+        v = _to_float(d)
+        return Datum.f64(v)
+    if tp in (my.TypeNewDecimal, my.TypeDecimal):
+        dec = _to_decimal(d)
+        if ft.decimal is not None and ft.decimal >= 0:
+            q = Decimal(1).scaleb(-ft.decimal)
+            dec = dec.quantize(q, rounding=ROUND_HALF_UP)
+        return Datum.dec(dec)
+    if tp in my.STRING_TYPES:
+        s = _to_string(d)
+        if ft.flen >= 0 and len(s) > ft.flen:
+            if tp in (my.TypeVarchar, my.TypeString):
+                raise errors.OverflowError_(
+                    f"data too long for column (len {len(s)} > {ft.flen})")
+        if my.BlobFlag & ft.flag or tp in (my.TypeBlob, my.TypeTinyBlob,
+                                           my.TypeMediumBlob, my.TypeLongBlob):
+            return Datum.bytes_(s.encode() if isinstance(s, str) else s)
+        return Datum.string(s)
+    if tp in my.TIME_TYPES:
+        return Datum(Kind.TIME, _to_time(d, tp, ft.decimal if ft.decimal >= 0 else 0))
+    if tp == my.TypeDuration:
+        return Datum(Kind.DURATION, _to_duration(d, ft.decimal if ft.decimal >= 0 else 0))
+    if tp == my.TypeBit:
+        return _to_int(d, ft)
+    if tp == my.TypeNull:
+        return NULL
+    raise errors.TypeError_(f"unsupported conversion target type 0x{tp:02x}")
+
+
+def _round_half_away(x: float) -> int:
+    import math
+    return int(math.floor(x + 0.5)) if x >= 0 else -int(math.floor(-x + 0.5))
+
+
+def _to_int(d: Datum, ft: FieldType) -> Datum:
+    k = d.kind
+    if k in (Kind.INT64, Kind.UINT64):
+        v = d.val
+    elif k == Kind.FLOAT64:
+        v = _round_half_away(d.val)
+    elif k == Kind.DECIMAL:
+        v = int(d.val.quantize(Decimal(1), rounding=ROUND_HALF_UP))
+    elif k in (Kind.STRING, Kind.BYTES):
+        n = d.as_number()
+        v = _round_half_away(n) if isinstance(n, float) else int(n)
+    elif k == Kind.TIME:
+        v = int(round(d.val.to_number()))
+    elif k == Kind.DURATION:
+        v = int(round(d.val.to_number()))
+    else:
+        raise errors.TypeError_(f"cannot convert {k!r} to integer")
+    if ft.is_unsigned():
+        ub = my.UNSIGNED_BOUNDS.get(ft.tp, my.MaxUint64)
+        if v < 0 or v > ub:
+            raise errors.OverflowError_(f"unsigned {ft.compact_str()} out of range: {v}")
+        return Datum.u64(v) if ft.tp == my.TypeLonglong else Datum.i64(v)
+    lb, ub = my.SIGNED_BOUNDS.get(ft.tp, (my.MinInt64, my.MaxInt64))
+    if v < lb or v > ub:
+        raise errors.OverflowError_(f"{ft.compact_str()} out of range: {v}")
+    return Datum.i64(v)
+
+
+def _to_float(d: Datum) -> float:
+    n = d.as_number()
+    return float(n)
+
+
+def _to_decimal(d: Datum) -> Decimal:
+    k = d.kind
+    if k == Kind.DECIMAL:
+        return d.val
+    if k in (Kind.INT64, Kind.UINT64):
+        return Decimal(d.val)
+    if k == Kind.FLOAT64:
+        return Decimal(repr(d.val))
+    if k in (Kind.STRING, Kind.BYTES):
+        n = d.as_number()
+        return Decimal(repr(n)) if isinstance(n, float) else Decimal(n)
+    n = d.as_number()
+    return Decimal(str(n))
+
+
+def _to_string(d: Datum) -> str:
+    k = d.kind
+    if k == Kind.STRING:
+        return d.val
+    if k == Kind.BYTES:
+        return d.val.decode("utf-8", "replace")
+    if k in (Kind.INT64, Kind.UINT64):
+        return str(d.val)
+    if k == Kind.FLOAT64:
+        return repr(d.val)
+    if k == Kind.DECIMAL:
+        return format(d.val, "f")
+    if k in (Kind.TIME, Kind.DURATION):
+        return str(d.val)
+    raise errors.TypeError_(f"cannot convert {k!r} to string")
+
+
+def _to_time(d: Datum, tp: int, fsp: int) -> Time:
+    k = d.kind
+    if k == Kind.TIME:
+        t = d.val
+        if tp == my.TypeDate:
+            return Time(t.dt.replace(hour=0, minute=0, second=0, microsecond=0), tp, fsp)
+        return Time(t.dt, tp, fsp)
+    if k in (Kind.STRING, Kind.BYTES):
+        return parse_time(d.get_string(), tp, fsp)
+    if k in (Kind.INT64, Kind.UINT64):
+        return parse_time(str(d.val), tp, fsp)
+    raise errors.TypeError_(f"cannot convert {k!r} to time")
+
+
+def _to_duration(d: Datum, fsp: int) -> Duration:
+    k = d.kind
+    if k == Kind.DURATION:
+        return d.val
+    if k in (Kind.STRING, Kind.BYTES):
+        return parse_duration(d.get_string(), fsp)
+    if k in (Kind.INT64, Kind.UINT64):
+        v = d.val
+        h, rem = divmod(abs(v), 10000)
+        m, s = divmod(rem, 100)
+        nanos = (h * 3600 + m * 60 + s) * 1_000_000_000
+        return Duration(-nanos if v < 0 else nanos, fsp)
+    raise errors.TypeError_(f"cannot convert {k!r} to duration")
+
+
+def unflatten_datum(d: Datum, ft: FieldType) -> Datum:
+    """Restore column-type metadata lost by the flag-only codec decode.
+
+    Reference: tablecodec.DecodeColumnValue / types.Unflatten — the storage
+    codec keeps only the value class (TIME decodes with default tp, strings
+    decode as BYTES); the column's FieldType restores DATE-vs-DATETIME, fsp,
+    and str-vs-bytes before values reach executors.
+    """
+    k = d.kind
+    if k == Kind.NULL:
+        return d
+    if k == Kind.TIME:
+        t: Time = d.val
+        tp = ft.tp if ft.is_time() else t.tp
+        fsp = ft.decimal if ft.decimal >= 0 else 0
+        return Datum(Kind.TIME, Time(t.dt, tp, fsp))
+    if k == Kind.DURATION:
+        fsp = ft.decimal if ft.decimal >= 0 else 0
+        return Datum(Kind.DURATION, Duration(d.val.nanos, fsp))
+    if k == Kind.BYTES and ft.is_string() and ft.tp not in (
+            my.TypeBlob, my.TypeTinyBlob, my.TypeMediumBlob, my.TypeLongBlob):
+        if not (ft.flag & my.BinaryFlag):
+            return Datum(Kind.STRING, d.val.decode("utf-8", "replace"))
+    if k == Kind.INT64 and ft.is_unsigned() and ft.tp == my.TypeLonglong and d.val >= 0:
+        return Datum(Kind.UINT64, d.val)
+    return d
+
+
+def cast_to_number(d: Datum):
+    """Numeric context coercion returning int | float | Decimal (NULL→None)."""
+    if d.kind == Kind.NULL:
+        return None
+    return d.as_number()
+
+
+def coerce_arith(a, b):
+    """Coerce two Python numbers for arithmetic per MySQL rules:
+    float dominates, then Decimal, then int."""
+    if isinstance(a, float) or isinstance(b, float):
+        return float(a), float(b)
+    if isinstance(a, Decimal) or isinstance(b, Decimal):
+        return (a if isinstance(a, Decimal) else Decimal(a),
+                b if isinstance(b, Decimal) else Decimal(b))
+    return a, b

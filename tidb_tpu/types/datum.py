@@ -1,0 +1,250 @@
+"""Datum: the tagged-union SQL value.
+
+Reference: util/types/datum.go:53 (Datum struct with Kind* constants) and
+util/types/compare.go (cross-type comparison). Unlike the Go original, which
+packs small values into x/b fields, this is a two-slot Python object; the hot
+path (the coprocessor) does not use Datums at all — it runs columnar (see
+tidb_tpu.ops), so Datum stays simple and correct rather than micro-optimized.
+"""
+
+from __future__ import annotations
+
+import enum
+from decimal import Decimal
+from typing import Any
+
+from tidb_tpu import errors
+
+
+class Kind(enum.IntEnum):
+    NULL = 0
+    INT64 = 1
+    UINT64 = 2
+    FLOAT64 = 3
+    STRING = 4
+    BYTES = 5
+    DECIMAL = 6
+    DURATION = 7
+    TIME = 8
+    INTERFACE = 9        # row tuples in some executors (rare)
+    MIN_NOT_NULL = 100   # range boundary sentinels (util/types/datum.go KindMinNotNull)
+    MAX_VALUE = 101
+
+
+class Datum:
+    __slots__ = ("kind", "val")
+
+    def __init__(self, kind: Kind, val: Any = None):
+        self.kind = kind
+        self.val = val
+
+    # ---- constructors ----
+    @staticmethod
+    def null() -> "Datum":
+        return NULL
+
+    @staticmethod
+    def i64(v: int) -> "Datum":
+        v = int(v)
+        if not (-(1 << 63) <= v < (1 << 63)):
+            raise errors.OverflowError_(f"int64 out of range: {v}")
+        return Datum(Kind.INT64, v)
+
+    @staticmethod
+    def u64(v: int) -> "Datum":
+        v = int(v)
+        if not (0 <= v < (1 << 64)):
+            raise errors.OverflowError_(f"uint64 out of range: {v}")
+        return Datum(Kind.UINT64, v)
+
+    @staticmethod
+    def f64(v: float) -> "Datum":
+        return Datum(Kind.FLOAT64, float(v))
+
+    @staticmethod
+    def string(v: str) -> "Datum":
+        return Datum(Kind.STRING, v)
+
+    @staticmethod
+    def bytes_(v: bytes) -> "Datum":
+        return Datum(Kind.BYTES, v)
+
+    @staticmethod
+    def dec(v) -> "Datum":
+        if not isinstance(v, Decimal):
+            v = Decimal(str(v))
+        return Datum(Kind.DECIMAL, v)
+
+    # ---- predicates ----
+    def is_null(self) -> bool:
+        return self.kind == Kind.NULL
+
+    # ---- accessors (raise on kind mismatch like GetInt64 would panic) ----
+    def get_int(self) -> int:
+        if self.kind in (Kind.INT64, Kind.UINT64):
+            return self.val
+        raise errors.TypeError_(f"datum kind {self.kind!r} is not an int")
+
+    def get_float(self) -> float:
+        if self.kind == Kind.FLOAT64:
+            return self.val
+        raise errors.TypeError_(f"datum kind {self.kind!r} is not a float")
+
+    def get_string(self) -> str:
+        if self.kind == Kind.STRING:
+            return self.val
+        if self.kind == Kind.BYTES:
+            return self.val.decode("utf-8", "replace")
+        raise errors.TypeError_(f"datum kind {self.kind!r} is not a string")
+
+    def get_bytes(self) -> bytes:
+        if self.kind == Kind.BYTES:
+            return self.val
+        if self.kind == Kind.STRING:
+            return self.val.encode("utf-8")
+        raise errors.TypeError_(f"datum kind {self.kind!r} is not bytes")
+
+    # ---- numeric view used by comparison/arith coercion ----
+    def as_number(self):
+        """Return a Python number preserving exactness where possible."""
+        k = self.kind
+        if k in (Kind.INT64, Kind.UINT64):
+            return self.val
+        if k == Kind.FLOAT64:
+            return self.val
+        if k == Kind.DECIMAL:
+            return self.val
+        if k == Kind.STRING:
+            return _str_to_number(self.val)
+        if k == Kind.BYTES:
+            return _str_to_number(self.val.decode("utf-8", "replace"))
+        if k == Kind.DURATION:
+            return self.val.to_number()
+        if k == Kind.TIME:
+            return self.val.to_number()
+        raise errors.TypeError_(f"cannot coerce {k!r} to number")
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        if self.kind == Kind.NULL:
+            return "Datum(NULL)"
+        return f"Datum({self.kind.name}, {self.val!r})"
+
+    def __eq__(self, other):
+        """Structural equality within a kind-class (numeric / string / time).
+
+        NB: deliberately narrower than compare_datum's MySQL coercion (which
+        would make "12" == 12 and break the hash/eq contract). SQL equality
+        goes through compare_datum; this is for sets/dicts in tests and plans.
+        """
+        if not isinstance(other, Datum):
+            return NotImplemented
+        a, b = self.kind, other.kind
+        if a == Kind.NULL or b == Kind.NULL:
+            return a == b
+        if a in _NUM_KINDS and b in _NUM_KINDS:
+            return _cmp_num(self.val, other.val) == 0
+        if a in _STR_KINDS and b in _STR_KINDS:
+            return self.get_bytes() == other.get_bytes()
+        return a == b and self.val == other.val
+
+    def __hash__(self):
+        # Python's numeric hash is cross-type consistent (hash(1) == hash(1.0)
+        # == hash(Decimal(1))), so numeric kinds hash by value directly.
+        if self.kind in _NUM_KINDS:
+            return hash(self.val)
+        if self.kind in _STR_KINDS:
+            return hash(self.get_bytes())
+        return hash((int(self.kind), self.val))
+
+
+_STR_KINDS = (Kind.STRING, Kind.BYTES)
+
+NULL = Datum(Kind.NULL)
+MIN_NOT_NULL = Datum(Kind.MIN_NOT_NULL)
+MAX_VALUE = Datum(Kind.MAX_VALUE)
+
+
+def datum_from_py(v: Any) -> Datum:
+    """Lift a Python value into a Datum (test/datagen convenience)."""
+    if v is None:
+        return NULL
+    if isinstance(v, Datum):
+        return v
+    if isinstance(v, bool):
+        return Datum.i64(int(v))
+    if isinstance(v, int):
+        if v > (1 << 63) - 1:
+            return Datum.u64(v)
+        return Datum.i64(v)
+    if isinstance(v, float):
+        return Datum.f64(v)
+    if isinstance(v, Decimal):
+        return Datum.dec(v)
+    if isinstance(v, str):
+        return Datum.string(v)
+    if isinstance(v, (bytes, bytearray)):
+        return Datum.bytes_(bytes(v))
+    from tidb_tpu.types.time_types import Duration, Time
+    if isinstance(v, (Duration, Time)):
+        return Datum(Kind.DURATION if isinstance(v, Duration) else Kind.TIME, v)
+    raise errors.TypeError_(f"cannot make datum from {type(v)!r}")
+
+
+_NUM_PREFIX_RE = __import__("re").compile(
+    r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?")
+
+
+def _str_to_number(s: str):
+    """MySQL-ish lenient string→number: longest numeric prefix, else 0."""
+    m = _NUM_PREFIX_RE.match(s.strip())
+    if not m:
+        return 0
+    text = m.group(0)
+    if "." in text or m.group(2):
+        return float(text)
+    return int(text)
+
+
+_NUM_KINDS = (Kind.INT64, Kind.UINT64, Kind.FLOAT64, Kind.DECIMAL)
+
+
+def compare_datum(a: Datum, b: Datum) -> int:
+    """Three-way compare with MySQL cross-type coercion.
+
+    Reference: util/types/datum.go CompareDatum / compare.go. NULL sorts before
+    everything; MIN_NOT_NULL/MAX_VALUE are range-boundary sentinels.
+    """
+    ak, bk = a.kind, b.kind
+    if ak == Kind.NULL:
+        return 0 if bk == Kind.NULL else -1
+    if bk == Kind.NULL:
+        return 1
+    if ak == Kind.MIN_NOT_NULL:
+        return 0 if bk == Kind.MIN_NOT_NULL else -1
+    if bk == Kind.MIN_NOT_NULL:
+        return 1
+    if ak == Kind.MAX_VALUE:
+        return 0 if bk == Kind.MAX_VALUE else 1
+    if bk == Kind.MAX_VALUE:
+        return -1
+
+    # same-class fast paths
+    if ak in (Kind.STRING, Kind.BYTES) and bk in (Kind.STRING, Kind.BYTES):
+        # binary collation over utf-8 bytes (the 2016 reference is binary-collation only)
+        x, y = a.get_bytes(), b.get_bytes()
+        return -1 if x < y else (0 if x == y else 1)
+    if ak == Kind.TIME and bk == Kind.TIME:
+        return a.val.compare(b.val)
+    if ak == Kind.DURATION and bk == Kind.DURATION:
+        return (a.val.nanos > b.val.nanos) - (a.val.nanos < b.val.nanos)
+
+    x, y = a.as_number(), b.as_number()
+    return _cmp_num(x, y)
+
+
+def _cmp_num(x, y) -> int:
+    # int/Decimal compare exactly; float comparisons go through float
+    if isinstance(x, float) or isinstance(y, float):
+        xf, yf = float(x), float(y)
+        return (xf > yf) - (xf < yf)
+    return (x > y) - (x < y)

@@ -1,0 +1,144 @@
+"""FieldType: column type metadata.
+
+Reference: util/types/field_type.go (FieldType struct) and
+evaluator type-merge rules used by plan/typeinferer.go.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import mysqldef as my
+
+
+UNSPECIFIED_LENGTH = -1
+
+
+class FieldType:
+    __slots__ = ("tp", "flag", "flen", "decimal", "charset", "collate", "elems")
+
+    def __init__(self, tp: int = my.TypeNull, flag: int = 0,
+                 flen: int = UNSPECIFIED_LENGTH, decimal: int = UNSPECIFIED_LENGTH,
+                 charset: str = "utf8", collate: str = "utf8_bin", elems=None):
+        self.tp = tp
+        self.flag = flag
+        self.flen = flen
+        self.decimal = decimal
+        self.charset = charset
+        self.collate = collate
+        self.elems = elems or []  # enum/set literals
+
+    # ---- predicates ----
+    def is_unsigned(self) -> bool:
+        return my.has_unsigned_flag(self.flag)
+
+    def is_string(self) -> bool:
+        return self.tp in my.STRING_TYPES
+
+    def is_integer(self) -> bool:
+        return self.tp in my.INTEGER_TYPES
+
+    def is_float(self) -> bool:
+        return self.tp in my.FLOAT_TYPES
+
+    def is_decimal(self) -> bool:
+        return self.tp in (my.TypeNewDecimal, my.TypeDecimal)
+
+    def is_time(self) -> bool:
+        return self.tp in my.TIME_TYPES
+
+    def is_numeric(self) -> bool:
+        return self.is_integer() or self.is_float() or self.is_decimal()
+
+    def clone(self) -> "FieldType":
+        ft = FieldType(self.tp, self.flag, self.flen, self.decimal,
+                       self.charset, self.collate, list(self.elems))
+        return ft
+
+    def __repr__(self):  # pragma: no cover
+        return f"FieldType(tp=0x{self.tp:02x}, flag={self.flag}, flen={self.flen}, dec={self.decimal})"
+
+    def __eq__(self, other):
+        return (isinstance(other, FieldType) and self.tp == other.tp
+                and self.flag == other.flag and self.flen == other.flen
+                and self.decimal == other.decimal)
+
+    def compact_str(self) -> str:
+        names = {
+            my.TypeTiny: "tinyint", my.TypeShort: "smallint", my.TypeInt24: "mediumint",
+            my.TypeLong: "int", my.TypeLonglong: "bigint", my.TypeFloat: "float",
+            my.TypeDouble: "double", my.TypeNewDecimal: "decimal", my.TypeVarchar: "varchar",
+            my.TypeString: "char", my.TypeBlob: "text", my.TypeDate: "date",
+            my.TypeDatetime: "datetime", my.TypeTimestamp: "timestamp",
+            my.TypeDuration: "time", my.TypeYear: "year", my.TypeBit: "bit",
+            my.TypeNull: "null", my.TypeEnum: "enum", my.TypeSet: "set",
+        }
+        s = names.get(self.tp, f"type({self.tp})")
+        if self.flen >= 0 and self.tp in (my.TypeVarchar, my.TypeString, my.TypeNewDecimal):
+            if self.decimal >= 0 and self.tp == my.TypeNewDecimal:
+                s += f"({self.flen},{self.decimal})"
+            else:
+                s += f"({self.flen})"
+        if self.is_unsigned():
+            s += " unsigned"
+        return s
+
+
+def new_field_type(tp: int) -> FieldType:
+    ft = FieldType(tp)
+    ft.flen = my.default_field_length(tp)
+    return ft
+
+
+# merge order for binary-operation result types (simplified
+# util/types/field_type.go MergeFieldType / evaluator numeric rules)
+_MERGE_ORDER = [
+    my.TypeDouble, my.TypeFloat, my.TypeNewDecimal, my.TypeLonglong, my.TypeLong,
+    my.TypeInt24, my.TypeShort, my.TypeTiny,
+]
+
+
+def merge_numeric(a: FieldType, b: FieldType) -> FieldType:
+    """Result type of an arithmetic op over a and b."""
+    if a.tp == my.TypeNull:
+        return b.clone()
+    if b.tp == my.TypeNull:
+        return a.clone()
+    for tp in _MERGE_ORDER:
+        if a.tp == tp or b.tp == tp:
+            ft = new_field_type(tp)
+            if tp == my.TypeNewDecimal:
+                ft.decimal = max(a.decimal if a.decimal >= 0 else 0,
+                                 b.decimal if b.decimal >= 0 else 0)
+            return ft
+    # non-numeric operands (strings/dates) act as double in arithmetic
+    return new_field_type(my.TypeDouble)
+
+
+def agg_field_type(name: str, arg: FieldType) -> FieldType:
+    """Result FieldType of an aggregate function.
+
+    Reference: the AggFields synthesis in plan/physical_plans.go:265-283 —
+    count→bigint, sum→decimal (exactness!), avg→decimal/double, min/max→arg.
+    """
+    name = name.lower()
+    if name == "count":
+        ft = new_field_type(my.TypeLonglong)
+        ft.flag |= my.NotNullFlag
+        return ft
+    if name == "sum":
+        if arg.is_float():
+            return new_field_type(my.TypeDouble)
+        ft = new_field_type(my.TypeNewDecimal)
+        ft.decimal = arg.decimal if arg.decimal >= 0 else 0
+        return ft
+    if name == "avg":
+        if arg.is_float():
+            return new_field_type(my.TypeDouble)
+        ft = new_field_type(my.TypeNewDecimal)
+        base = arg.decimal if arg.decimal >= 0 else 0
+        ft.decimal = min(base + 4, 30)
+        return ft
+    if name in ("min", "max", "first", "firstrow"):
+        return arg.clone()
+    if name == "group_concat":
+        return new_field_type(my.TypeVarString)
+    return new_field_type(my.TypeDouble)
