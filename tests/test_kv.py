@@ -1,0 +1,191 @@
+"""KV layer tests: membuffer/unionstore semantics, MVCC visibility,
+optimistic commit conflicts, region split. Mirrors kv/ and store/localstore
+test suites in the reference."""
+
+import threading
+
+import pytest
+
+from tidb_tpu import errors
+from tidb_tpu.kv import MemBuffer, UnionStore, run_in_new_txn
+from tidb_tpu.kv.union_store import OPT_PRESUME_KEY_NOT_EXISTS
+from tidb_tpu.localstore import LocalStore
+
+
+def test_membuffer_basic():
+    mb = MemBuffer()
+    mb.set(b"a", b"1")
+    mb.set(b"c", b"3")
+    mb.set(b"b", b"2")
+    assert mb.get(b"a") == b"1"
+    with pytest.raises(errors.KeyNotExistsError):
+        mb.get(b"x")
+    assert [k for k, _ in mb.iterate()] == [b"a", b"b", b"c"]
+    assert [k for k, _ in mb.iterate(b"b")] == [b"b", b"c"]
+    assert [k for k, _ in mb.iterate(b"a\x00", b"c")] == [b"b"]
+    mb.delete(b"b")
+    with pytest.raises(errors.KeyNotExistsError):
+        mb.get(b"b")
+    assert [k for k, _ in mb.iterate()] == [b"a", b"c"]
+    assert [k for k, _ in mb.iterate_reverse()] == [b"c", b"a"]
+
+
+def test_txn_read_own_writes():
+    store = LocalStore()
+    txn = store.begin()
+    txn.set(b"k1", b"v1")
+    assert txn.get(b"k1") == b"v1"
+    txn.delete(b"k1")
+    with pytest.raises(errors.KeyNotExistsError):
+        txn.get(b"k1")
+    txn.set(b"k1", b"v2")
+    txn.commit()
+    assert store.get_snapshot().get(b"k1") == b"v2"
+
+
+def test_snapshot_isolation():
+    store = LocalStore()
+    t1 = store.begin()
+    t1.set(b"k", b"v1")
+    t1.commit()
+
+    snap_before = store.get_snapshot()
+    t2 = store.begin()
+    t3 = store.begin()
+    t2.set(b"k", b"v2")
+    t2.commit()
+    # t3 started before t2 committed: must still see v1
+    assert t3.get(b"k") == b"v1"
+    assert snap_before.get(b"k") == b"v1"
+    assert store.get_snapshot().get(b"k") == b"v2"
+
+
+def test_write_conflict_is_retryable():
+    store = LocalStore()
+    t1 = store.begin()
+    t2 = store.begin()
+    t1.set(b"k", b"t1")
+    t2.set(b"k", b"t2")
+    t1.commit()
+    with pytest.raises(errors.WriteConflictError):
+        t2.commit()
+
+
+def test_rollback_discards():
+    store = LocalStore()
+    t = store.begin()
+    t.set(b"k", b"v")
+    t.rollback()
+    with pytest.raises(errors.KeyNotExistsError):
+        store.get_snapshot().get(b"k")
+    with pytest.raises(errors.KVError):
+        t.set(b"k", b"again")
+
+
+def test_union_iteration_overlay():
+    store = LocalStore()
+    t = store.begin()
+    for k in (b"a", b"b", b"c"):
+        t.set(k, b"snap")
+    t.commit()
+    t2 = store.begin()
+    t2.set(b"b", b"dirty")      # overwrite
+    t2.delete(b"c")             # tombstone
+    t2.set(b"d", b"new")        # insert
+    got = list(t2.iterate(b"a", b"z"))
+    assert got == [(b"a", b"snap"), (b"b", b"dirty"), (b"d", b"new")]
+    rev = [k for k, _ in t2.iterate_reverse(b"a", b"z")]
+    assert rev == [b"d", b"b", b"a"]
+
+
+def test_presume_key_not_exists():
+    store = LocalStore()
+    t = store.begin()
+    t.set(b"dup", b"v")
+    t.commit()
+
+    t2 = store.begin()
+    t2.set_option(OPT_PRESUME_KEY_NOT_EXISTS)
+    with pytest.raises(errors.KeyNotExistsError):
+        t2.get(b"dup")  # presumed absent, recorded as lazy condition
+    t2.set(b"dup", b"v2")
+    with pytest.raises(errors.KeyExistsError):
+        t2.commit()
+
+
+def test_mvcc_compact():
+    store = LocalStore()
+    for i in range(5):
+        t = store.begin()
+        t.set(b"k", f"v{i}".encode())
+        t.commit()
+    t = store.begin()
+    t.delete(b"gone")  # no-op delete of absent key writes tombstone
+    t.set(b"gone", b"x")
+    t.commit()
+    t = store.begin()
+    t.delete(b"gone")
+    t.commit()
+    snap_ver = store.current_version()
+    removed = store.compact(safe_point_ts=snap_ver)
+    assert removed >= 4
+    assert store.get_snapshot().get(b"k") == b"v4"
+    with pytest.raises(errors.KeyNotExistsError):
+        store.get_snapshot().get(b"gone")
+
+
+def test_run_in_new_txn_retries():
+    store = LocalStore()
+    t = store.begin()
+    t.set(b"ctr", b"0")
+    t.commit()
+    attempts = []
+
+    def bump(txn):
+        attempts.append(1)
+        v = int(txn.get(b"ctr"))
+        if len(attempts) == 1:
+            # sneak in a conflicting commit mid-txn
+            other = store.begin()
+            other.set(b"ctr", str(v + 100).encode())
+            other.commit()
+        txn.set(b"ctr", str(v + 1).encode())
+
+    run_in_new_txn(store, True, bump)
+    assert store.get_snapshot().get(b"ctr") == b"101"
+    assert len(attempts) == 2
+
+
+def test_concurrent_increments():
+    store = LocalStore()
+    t = store.begin()
+    t.set(b"n", b"0")
+    t.commit()
+
+    def worker():
+        def bump(txn):
+            txn.set(b"n", str(int(txn.get(b"n")) + 1).encode())
+        run_in_new_txn(store, True, bump)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert store.get_snapshot().get(b"n") == b"8"
+
+
+def test_region_split_and_range():
+    store = LocalStore()
+    rm = store.regions
+    assert len(rm.all_regions()) == 1
+    rm.split_keys([b"g", b"p"])
+    regions = rm.all_regions()
+    assert [(r.start, r.end) for r in regions] == [(b"", b"g"), (b"g", b"p"), (b"p", None)]
+    tasks = rm.regions_for_range(b"c", b"x")
+    assert len(tasks) == 3
+    assert tasks[0][1:] == (b"c", b"g")
+    assert tasks[1][1:] == (b"g", b"p")
+    assert tasks[2][1:] == (b"p", b"x")
+    tasks = rm.regions_for_range(b"h", b"i")
+    assert len(tasks) == 1 and tasks[0][0].start == b"g"

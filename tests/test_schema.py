@@ -1,0 +1,259 @@
+"""Schema layer tests: meta/structure roundtrips, DDL state machine,
+table read/write paths. Mirrors meta/, ddl/ suites in the reference."""
+
+import pytest
+
+from tidb_tpu import errors, mysqldef as my
+from tidb_tpu.ddl import ColumnSpec, IndexSpec
+from tidb_tpu.domain import Domain
+from tidb_tpu.localstore import LocalStore
+from tidb_tpu.meta import Meta
+from tidb_tpu.model import DBInfo, SchemaState
+from tidb_tpu.structure import TxStructure
+from tidb_tpu.types import Datum, datum_from_py
+from tidb_tpu.types.datum import NULL
+from tidb_tpu.types.field_type import new_field_type
+
+
+def _ft(tp, flag=0, flen=-1, dec=-1):
+    ft = new_field_type(tp)
+    ft.flag |= flag
+    if flen >= 0:
+        ft.flen = flen
+    if dec >= 0:
+        ft.decimal = dec
+    return ft
+
+
+@pytest.fixture
+def store():
+    return LocalStore()
+
+
+@pytest.fixture
+def domain(store):
+    return Domain(store)
+
+
+def test_structure_string_hash_list(store):
+    txn = store.begin()
+    t = TxStructure(txn, txn)
+    t.set(b"s", b"v")
+    assert t.get(b"s") == b"v"
+    assert t.inc(b"ctr", 5) == 5
+    assert t.inc(b"ctr") == 6
+
+    t.hset(b"h", b"f1", b"a")
+    t.hset(b"h", b"f2", b"b")
+    assert t.hget(b"h", b"f1") == b"a"
+    assert dict(t.hgetall(b"h")) == {b"f1": b"a", b"f2": b"b"}
+    t.hdel(b"h", b"f1")
+    assert t.hget(b"h", b"f1") is None
+
+    t.rpush(b"l", b"x")
+    t.rpush(b"l", b"y")
+    assert t.llen(b"l") == 2
+    assert t.lindex(b"l", 0) == b"x"
+    t.lset(b"l", 0, b"x2")
+    assert t.lpop(b"l") == b"x2"
+    assert t.lpop(b"l") == b"y"
+    assert t.lpop(b"l") is None
+    txn.commit()
+
+
+def test_meta_ids_and_dbs(store):
+    txn = store.begin()
+    m = Meta(txn)
+    assert m.gen_global_id() == 1
+    assert m.gen_global_ids(3) == [2, 3, 4]
+    m.create_database(DBInfo(id=10, name="test"))
+    assert m.get_database(10).name == "test"
+    with pytest.raises(errors.DBExistsError):
+        m.create_database(DBInfo(id=10, name="test"))
+    assert [d.name for d in m.list_databases()] == ["test"]
+    txn.commit()
+
+
+def _create_test_table(domain, name="t", with_index=False):
+    domain.ddl.create_schema("test")
+    cols = [
+        ColumnSpec("id", _ft(my.TypeLonglong)),
+        ColumnSpec("v", _ft(my.TypeVarchar, flen=64)),
+        ColumnSpec("n", _ft(my.TypeLong), default_value=7, has_default=True),
+    ]
+    idxs = [IndexSpec("primary", ["id"], primary=True)]
+    if with_index:
+        idxs.append(IndexSpec("idx_v", ["v"]))
+    domain.ddl.create_table("test", name, cols, idxs)
+    return domain.info_schema().table_by_name("test", name)
+
+
+def test_ddl_create_schema_table(domain):
+    tbl = _create_test_table(domain)
+    assert tbl.info.pk_is_handle
+    assert [c.name for c in tbl.info.columns] == ["id", "v", "n"]
+    assert domain.info_schema().version >= 2
+    with pytest.raises(errors.TableExistsError):
+        domain.ddl.create_table("test", "t", [ColumnSpec("x", _ft(my.TypeLong))], [])
+    with pytest.raises(errors.DBExistsError):
+        domain.ddl.create_schema("test")
+
+
+def test_table_crud(domain, store):
+    tbl = _create_test_table(domain, with_index=True)
+    txn = store.begin()
+    row = [Datum.i64(1), datum_from_py("hello"), Datum.i64(42)]
+    h = tbl.add_record(txn, row)
+    assert h == 1  # pk-is-handle
+    txn.commit()
+
+    snap = store.get_snapshot()
+    got = tbl.row_with_cols(snap, 1)
+    assert got[0].get_int() == 1
+    assert got[1].get_string() == "hello"
+    assert got[2].get_int() == 42
+
+    # duplicate pk
+    txn = store.begin()
+    with pytest.raises(errors.KeyExistsError):
+        tbl.add_record(txn, row)
+        txn.commit()
+    txn.rollback()
+
+    # update moves index entry
+    txn = store.begin()
+    new_row = [Datum.i64(1), datum_from_py("world"), Datum.i64(43)]
+    tbl.update_record(txn, 1, got, new_row)
+    txn.commit()
+    snap = store.get_snapshot()
+    idx = tbl.indices[0]
+    entries = list(idx.iterate(snap))
+    assert entries[0][0][0].get_bytes() == b"world"
+    assert entries[0][1] == 1
+
+    # delete
+    txn = store.begin()
+    tbl.remove_record(txn, 1, new_row)
+    txn.commit()
+    snap = store.get_snapshot()
+    assert list(tbl.iter_records(snap)) == []
+    assert list(idx.iterate(snap)) == []
+
+
+def test_auto_increment_handles(domain, store):
+    domain.ddl.create_schema("test")
+    domain.ddl.create_table("test", "t", [ColumnSpec("v", _ft(my.TypeLong))], [])
+    tbl = domain.info_schema().table_by_name("test", "t")
+    txn = store.begin()
+    h1 = tbl.add_record(txn, [Datum.i64(10)])
+    h2 = tbl.add_record(txn, [Datum.i64(20)])
+    txn.commit()
+    assert h2 == h1 + 1
+    rows = list(tbl.iter_records(store.get_snapshot()))
+    assert [r[0] for r in rows] == [h1, h2]
+
+
+def test_add_index_with_backfill(domain, store):
+    tbl = _create_test_table(domain)
+    txn = store.begin()
+    for i in range(700):  # multiple reorg batches (REORG_BATCH_SIZE=256)
+        tbl.add_record(txn, [Datum.i64(i), datum_from_py(f"v{i % 10}"), Datum.i64(i)])
+    txn.commit()
+
+    domain.ddl.create_index("test", "t", "idx_v", ["v"])
+    tbl2 = domain.info_schema().table_by_name("test", "t")
+    idx = next(i for i in tbl2.indices if i.info.name == "idx_v")
+    assert idx.info.state == SchemaState.PUBLIC
+    entries = list(idx.iterate(store.get_snapshot()))
+    assert len(entries) == 700
+    # index order: v0, v0, ..., v1 ...
+    vals = [e[0][0].get_bytes() for e in entries]
+    assert vals == sorted(vals)
+
+    # unique index over duplicate data must fail and cancel the job
+    with pytest.raises(errors.TiDBError):
+        domain.ddl.create_index("test", "t", "uniq_v", ["v"], unique=True)
+
+
+def test_drop_index(domain, store):
+    tbl = _create_test_table(domain, with_index=True)
+    txn = store.begin()
+    tbl.add_record(txn, [Datum.i64(1), datum_from_py("a"), Datum.i64(0)])
+    txn.commit()
+    domain.ddl.drop_index("test", "t", "idx_v")
+    tbl2 = domain.info_schema().table_by_name("test", "t")
+    assert tbl2.info.find_index("idx_v") is None
+    # index data gone
+    from tidb_tpu import tablecodec as tc
+    prefix = tc.table_index_prefix(tbl.id)
+    assert list(store.get_snapshot().iterate(prefix, prefix + b"\xff" * 12)) == []
+
+
+def test_add_drop_column(domain, store):
+    tbl = _create_test_table(domain)
+    txn = store.begin()
+    tbl.add_record(txn, [Datum.i64(1), datum_from_py("a"), Datum.i64(5)])
+    txn.commit()
+
+    domain.ddl.add_column("test", "t", ColumnSpec(
+        "extra", _ft(my.TypeLong), default_value=99, has_default=True))
+    tbl2 = domain.info_schema().table_by_name("test", "t")
+    assert [c.name for c in tbl2.info.columns] == ["id", "v", "n", "extra"]
+    # old row: extra reads as original default 99
+    row = tbl2.row_with_cols(store.get_snapshot(), 1)
+    assert row[3].get_int() == 99
+    # new row stores the column
+    txn = store.begin()
+    tbl2.add_record(txn, [Datum.i64(2), datum_from_py("b"), Datum.i64(6), Datum.i64(100)])
+    txn.commit()
+    row2 = tbl2.row_with_cols(store.get_snapshot(), 2)
+    assert row2[3].get_int() == 100
+
+    domain.ddl.drop_column("test", "t", "extra")
+    tbl3 = domain.info_schema().table_by_name("test", "t")
+    assert [c.name for c in tbl3.info.columns] == ["id", "v", "n"]
+    assert len(tbl3.row_with_cols(store.get_snapshot(), 2)) == 3
+
+
+def test_drop_table_and_truncate(domain, store):
+    tbl = _create_test_table(domain)
+    txn = store.begin()
+    tbl.add_record(txn, [Datum.i64(1), datum_from_py("a"), Datum.i64(0)])
+    txn.commit()
+
+    old_id = tbl.id
+    domain.ddl.truncate_table("test", "t")
+    tbl2 = domain.info_schema().table_by_name("test", "t")
+    assert tbl2.id != old_id
+    assert list(tbl2.iter_records(store.get_snapshot())) == []
+
+    domain.ddl.drop_table("test", "t")
+    assert not domain.info_schema().table_exists("test", "t")
+    with pytest.raises(errors.NoSuchTableError):
+        domain.info_schema().table_by_name("test", "t")
+
+
+def test_drop_schema(domain, store):
+    _create_test_table(domain)
+    domain.ddl.drop_schema("test")
+    assert not domain.info_schema().schema_exists("test")
+    with pytest.raises(errors.BadDBError):
+        domain.ddl.drop_schema("test")
+
+
+def test_unsigned_bigint_pk_not_handle(domain, store):
+    domain.ddl.create_schema("test")
+    domain.ddl.create_table("test", "u", [
+        ColumnSpec("id", _ft(my.TypeLonglong, flag=my.UnsignedFlag)),
+        ColumnSpec("v", _ft(my.TypeLong)),
+    ], [IndexSpec("primary", ["id"], primary=True)])
+    tbl = domain.info_schema().table_by_name("test", "u")
+    # unsigned pk must NOT become the row handle (would wrap at 2^63)
+    assert not tbl.info.pk_is_handle
+    txn = store.begin()
+    big = (1 << 63) + 5
+    tbl.add_record(txn, [Datum.u64(big), Datum.i64(1)])
+    txn.commit()
+    rows = list(tbl.iter_records(store.get_snapshot()))
+    assert len(rows) == 1
+    assert rows[0][1][0].get_int() == big

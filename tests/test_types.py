@@ -108,3 +108,17 @@ def test_agg_field_types():
     assert agg_field_type("sum", new_field_type(my.TypeDouble)).tp == my.TypeDouble
     assert agg_field_type("avg", dec).decimal == 6
     assert agg_field_type("max", dec).tp == my.TypeNewDecimal
+
+
+def test_duration_two_part_is_hours_minutes():
+    # regression: 'HH:MM' must parse as hours:minutes (MySQL), not MM:SS
+    d = parse_duration("11:30", fsp=0)
+    assert str(d) == "11:30:00"
+    assert parse_duration("-2:05").to_number() == -20500
+
+
+def test_wide_decimal_quantize_no_crash():
+    from tidb_tpu.types.convert import quantize_decimal
+    from decimal import Decimal
+    v = Decimal("12345678901234567890123456789.1")
+    assert quantize_decimal(v, 2) == Decimal("12345678901234567890123456789.10")

@@ -1,0 +1,18 @@
+"""Online schema change (F1-style job queue).
+
+Reference: ddl/ (ddl.go DDL interface, ddl_worker.go queue/owner protocol,
+column.go, index.go backfill, table.go, schema.go, bg_worker.go).
+
+Statements enqueue model.DDLJob records in the meta queue inside their own
+txn; the worker pops jobs and steps schema objects through
+DELETE_ONLY → WRITE_ONLY → WRITE_REORG → PUBLIC (add) or the reverse (drop),
+bumping the schema version each step. ADD INDEX reorg backfills index
+entries in batched transactions with a progress checkpoint on the job
+(ddl/index.go addTableIndex / backfillTableIndex).
+
+Single-process deployment runs the worker inline after enqueue; the
+multi-server owner-lease protocol drives the same state machine.
+"""
+
+from tidb_tpu.ddl.ddl import DDL, ColumnSpec, IndexSpec  # noqa: F401
+from tidb_tpu.ddl.callback import Callback  # noqa: F401

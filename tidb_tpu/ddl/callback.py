@@ -1,0 +1,15 @@
+"""DDL test hooks.
+
+Reference: ddl/callback.go — tests interpose between schema states to assert
+mid-DDL invariants (column_change_test.go, index_change_test.go).
+"""
+
+from __future__ import annotations
+
+
+class Callback:
+    def on_changed(self, err: Exception | None) -> None:
+        """After every schema-version bump (one state transition)."""
+
+    def on_job_updated(self, job) -> None:
+        """After a job's state is persisted."""

@@ -1,0 +1,539 @@
+"""DDL statement implementation + job worker.
+
+Reference: ddl/ddl.go (DDL interface :92, buildTableInfo), ddl/ddl_worker.go
+(addDDLJob :152, handleDDLJobQueue :234, runDDLJob :328), ddl/index.go
+(onCreateIndex :113, addTableIndex backfill :378), ddl/column.go,
+ddl/table.go, ddl/schema.go, ddl/bg_worker.go.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+from tidb_tpu import errors, mysqldef as my, tablecodec as tc
+from tidb_tpu.ddl.callback import Callback
+from tidb_tpu.kv import run_in_new_txn
+from tidb_tpu.meta import Meta
+from tidb_tpu.model import (
+    ActionType, ColumnInfo, DBInfo, DDLJob, IndexColumn, IndexInfo, JobState,
+    SchemaState, TableInfo,
+)
+from tidb_tpu.table import Table
+from tidb_tpu.types.field_type import FieldType
+
+REORG_BATCH_SIZE = 256
+
+
+@dataclass
+class ColumnSpec:
+    name: str
+    field_type: FieldType
+    default_value: Any = None
+    has_default: bool = False
+    comment: str = ""
+
+
+@dataclass
+class IndexSpec:
+    name: str
+    columns: list[str] = dc_field(default_factory=list)
+    unique: bool = False
+    primary: bool = False
+
+
+class DDL:
+    """Owns the job queue; single-process mode runs jobs inline after
+    enqueue (the reference's every-server worker loop, collapsed)."""
+
+    def __init__(self, store, handle, callback: Callback | None = None):
+        self.store = store
+        self.handle = handle  # infoschema.Handle
+        self.callback = callback or Callback()
+        self._lock = threading.Lock()
+
+    # ================= public API (ddl/ddl.go DDL interface) =================
+
+    def create_schema(self, name: str) -> None:
+        schema = self.handle.get()
+        if schema.schema_exists(name):
+            raise errors.DBExistsError(f"Can't create database '{name}'; database exists")
+        job = self._new_job(ActionType.CREATE_SCHEMA, 0, 0, [name])
+        self._run_job(job)
+
+    def drop_schema(self, name: str) -> None:
+        schema = self.handle.get()
+        db = schema.schema_by_name(name)
+        if db is None:
+            raise errors.BadDBError(f"Can't drop database '{name}'; database doesn't exist")
+        job = self._new_job(ActionType.DROP_SCHEMA, db.id, 0, [])
+        self._run_job(job)
+
+    def create_table(self, db_name: str, table_name: str, cols: list[ColumnSpec],
+                     indexes: list[IndexSpec]) -> None:
+        schema = self.handle.get()
+        db = schema.schema_by_name(db_name)
+        if db is None:
+            raise errors.BadDBError(f"Unknown database '{db_name}'")
+        if schema.table_exists(db_name, table_name):
+            raise errors.TableExistsError(f"Table '{table_name}' already exists")
+        tbl_json = self._build_table_info(table_name, cols, indexes).to_json()
+        job = self._new_job(ActionType.CREATE_TABLE, db.id, 0, [tbl_json])
+        self._run_job(job)
+
+    def drop_table(self, db_name: str, table_name: str) -> None:
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        job = self._new_job(ActionType.DROP_TABLE, db.id, tbl.id, [])
+        self._run_job(job)
+
+    def truncate_table(self, db_name: str, table_name: str) -> None:
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        job = self._new_job(ActionType.TRUNCATE_TABLE, db.id, tbl.id, [])
+        self._run_job(job)
+
+    def create_index(self, db_name: str, table_name: str, index_name: str,
+                     col_names: list[str], unique: bool = False) -> None:
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        if tbl.info.find_index(index_name) is not None:
+            raise errors.TiDBError(f"Duplicate key name '{index_name}'",
+                                   code=my.ErrDupKeyName)
+        for cn in col_names:
+            if tbl.info.find_column(cn) is None:
+                raise errors.UnknownFieldError(f"Key column '{cn}' doesn't exist")
+        job = self._new_job(ActionType.ADD_INDEX, db.id, tbl.id,
+                            [index_name, col_names, unique])
+        self._run_job(job)
+
+    def drop_index(self, db_name: str, table_name: str, index_name: str) -> None:
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        if tbl.info.find_index(index_name) is None:
+            raise errors.TiDBError(f"Can't DROP '{index_name}'; check that it exists",
+                                   code=my.ErrCantDropFieldOrKey)
+        job = self._new_job(ActionType.DROP_INDEX, db.id, tbl.id, [index_name])
+        self._run_job(job)
+
+    def add_column(self, db_name: str, table_name: str, spec: ColumnSpec) -> None:
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        if tbl.info.find_column(spec.name) is not None:
+            raise errors.TiDBError(f"Duplicate column name '{spec.name}'", code=1060)
+        col_json = ColumnInfo(0, spec.name, 0, spec.field_type, spec.default_value,
+                              spec.has_default,
+                              spec.default_value if spec.has_default else None,
+                              spec.comment).to_json()
+        job = self._new_job(ActionType.ADD_COLUMN, db.id, tbl.id, [col_json])
+        self._run_job(job)
+
+    def drop_column(self, db_name: str, table_name: str, col_name: str) -> None:
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        col = tbl.info.find_column(col_name)
+        if col is None:
+            raise errors.TiDBError(f"Can't DROP '{col_name}'; check that it exists",
+                                   code=my.ErrCantDropFieldOrKey)
+        if any(col_name.lower() == ic.name.lower()
+               for idx in tbl.info.indices for ic in idx.columns):
+            raise errors.TiDBError(
+                f"Can't DROP '{col_name}'; it is referenced by an index",
+                code=my.ErrCantDropFieldOrKey)
+        if tbl.info.pk_handle_column() is col:
+            raise errors.TiDBError("Can't DROP the primary key handle column",
+                                   code=my.ErrCantDropFieldOrKey)
+        job = self._new_job(ActionType.DROP_COLUMN, db.id, tbl.id, [col_name])
+        self._run_job(job)
+
+    # ================= table-info construction =================
+
+    def _build_table_info(self, name: str, cols: list[ColumnSpec],
+                          indexes: list[IndexSpec]) -> TableInfo:
+        """Reference: ddl/ddl.go buildTableInfo + buildColumnsAndConstraints."""
+        seen = set()
+        columns = []
+        for i, spec in enumerate(cols):
+            if spec.name.lower() in seen:
+                raise errors.TiDBError(f"Duplicate column name '{spec.name}'", code=1060)
+            seen.add(spec.name.lower())
+            columns.append(ColumnInfo(
+                id=i + 1, name=spec.name, offset=i, field_type=spec.field_type,
+                default_value=spec.default_value, has_default=spec.has_default,
+                comment=spec.comment, state=SchemaState.PUBLIC))
+        info = TableInfo(id=0, name=name, columns=columns)
+
+        offsets = {c.lower_name: c.offset for c in columns}
+        idx_id = 1
+        for spec in indexes:
+            icols = []
+            for cn in spec.columns:
+                if cn.lower() not in offsets:
+                    raise errors.UnknownFieldError(f"Key column '{cn}' doesn't exist")
+                icols.append(IndexColumn(cn, offsets[cn.lower()]))
+            if spec.primary:
+                # single int pk → handle column (pk_is_handle fast path)
+                if len(icols) == 1:
+                    col = columns[icols[0].offset]
+                    # signed only: handles are int64; a BIGINT UNSIGNED pk
+                    # >= 2^63 would wrap and mis-sort as a handle
+                    if col.field_type.is_integer() and not col.field_type.is_unsigned():
+                        col.field_type.flag |= my.PriKeyFlag | my.NotNullFlag
+                        info.pk_is_handle = True
+                        continue
+                for ic in icols:
+                    columns[ic.offset].field_type.flag |= my.NotNullFlag
+            columns_flag = my.UniqueKeyFlag if spec.unique else my.MultipleKeyFlag
+            columns[icols[0].offset].field_type.flag |= columns_flag
+            info.indices.append(IndexInfo(
+                id=idx_id, name=spec.name or f"idx_{idx_id}", columns=icols,
+                unique=spec.unique or spec.primary, primary=spec.primary,
+                state=SchemaState.PUBLIC))
+            idx_id += 1
+        return info
+
+    # ================= job machinery =================
+
+    def _new_job(self, tp: ActionType, schema_id: int, table_id: int,
+                 args: list) -> DDLJob:
+        def alloc(txn):
+            return Meta(txn).gen_global_id()
+
+        job_id = run_in_new_txn(self.store, True, alloc)
+        return DDLJob(id=job_id, tp=tp, schema_id=schema_id, table_id=table_id,
+                      args=args)
+
+    def _run_job(self, job: DDLJob) -> None:
+        """Enqueue then drive the queue until this job finishes.
+        Reference: ddl_worker.go addDDLJob + handleDDLJobQueue."""
+        with self._lock:
+            def enqueue(txn):
+                Meta(txn).enqueue_ddl_job(job)
+            run_in_new_txn(self.store, True, enqueue)
+            finished = self._handle_job_queue(wait_for=job.id)
+        if finished is not None and finished.error:
+            raise errors.TiDBError(finished.error,
+                                   code=finished.error_code or None)
+
+    def _handle_job_queue(self, wait_for: int | None = None) -> DDLJob | None:
+        """Drive the queue; returns the finished job matching wait_for."""
+        while True:
+            done_job: DDLJob | None = None
+
+            def step(txn):
+                nonlocal done_job
+                m = Meta(txn)
+                cur = m.get_ddl_job(0)
+                if cur is None:
+                    return False
+                changed = self._run_one_state(txn, m, cur)
+                if cur.is_finished():
+                    m.dequeue_ddl_job()
+                    m.add_history_ddl_job(cur)
+                    done_job = cur
+                else:
+                    m.update_ddl_job(cur, 0)
+                if changed:
+                    m.bump_schema_version()
+                return True
+
+            progressed = run_in_new_txn(self.store, True, step)
+            if not progressed:
+                return None
+            # every version bump is visible to other servers here
+            self.handle.load()
+            self.callback.on_changed(None)
+            if done_job is not None:
+                self.callback.on_job_updated(done_job)
+                if wait_for is not None and done_job.id == wait_for:
+                    return done_job
+
+    def _run_one_state(self, txn, m: Meta, job: DDLJob) -> bool:
+        """One state transition of one job; returns True if schema changed.
+        Reference: ddl_worker.go runDDLJob."""
+        try:
+            handler = {
+                ActionType.CREATE_SCHEMA: self._on_create_schema,
+                ActionType.DROP_SCHEMA: self._on_drop_schema,
+                ActionType.CREATE_TABLE: self._on_create_table,
+                ActionType.DROP_TABLE: self._on_drop_table,
+                ActionType.TRUNCATE_TABLE: self._on_truncate_table,
+                ActionType.ADD_INDEX: self._on_add_index,
+                ActionType.DROP_INDEX: self._on_drop_index,
+                ActionType.ADD_COLUMN: self._on_add_column,
+                ActionType.DROP_COLUMN: self._on_drop_column,
+            }[job.tp]
+        except KeyError:
+            job.state = JobState.CANCELLED
+            job.error = f"invalid ddl job type {job.tp}"
+            return False
+        try:
+            return handler(txn, m, job)
+        except errors.TiDBError as e:
+            job.state = JobState.CANCELLED
+            job.error = str(e)
+            job.error_code = e.code
+            # roll back half-built schema objects so no orphaned
+            # non-public column/index survives a cancelled job
+            # (reference: ddl_worker.go job rollback on error)
+            changed = False
+            if job.tp == ActionType.ADD_INDEX:
+                changed = self._rollback_add_index(txn, m, job)
+            elif job.tp == ActionType.ADD_COLUMN:
+                changed = self._rollback_add_column(txn, m, job)
+            return changed
+
+    def _rollback_add_index(self, txn, m: Meta, job: DDLJob) -> bool:
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            return False
+        index_name = job.args[0]
+        idx = info.find_index(index_name)
+        if idx is None or idx.state == SchemaState.PUBLIC:
+            return False
+        prefix = tc.encode_index_seek_key(info.id, idx.id)
+        for k, _v in list(txn.iterate(prefix, prefix + b"\xff" * 9)):
+            txn.delete(k)
+        info.indices = [i for i in info.indices if i.id != idx.id]
+        m.update_table(job.schema_id, info)
+        return True
+
+    def _rollback_add_column(self, txn, m: Meta, job: DDLJob) -> bool:
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            return False
+        col_name = ColumnInfo.from_json(job.args[0]).name
+        col = info.find_column(col_name)
+        if col is None or col.state == SchemaState.PUBLIC:
+            return False
+        info.columns.remove(col)
+        m.update_table(job.schema_id, info)
+        return True
+
+    # ---- schema ops ----
+
+    def _on_create_schema(self, txn, m: Meta, job: DDLJob) -> bool:
+        name = job.args[0]
+        for db in m.list_databases():
+            if db.name.lower() == name.lower():
+                raise errors.DBExistsError(f"database {name} exists")
+        db_id = m.gen_global_id()
+        m.create_database(DBInfo(id=db_id, name=name))
+        job.schema_id = db_id
+        job.state = JobState.DONE
+        return True
+
+    def _on_drop_schema(self, txn, m: Meta, job: DDLJob) -> bool:
+        # delete table data inline (reference defers to the bg queue)
+        for tbl in m.list_tables(job.schema_id):
+            self._delete_table_data(txn, tbl.id)
+        m.drop_database(job.schema_id)
+        job.state = JobState.DONE
+        return True
+
+    # ---- table ops ----
+
+    def _on_create_table(self, txn, m: Meta, job: DDLJob) -> bool:
+        info = TableInfo.from_json(job.args[0])
+        for t in m.list_tables(job.schema_id):
+            if t.name.lower() == info.name.lower():
+                raise errors.TableExistsError(f"table {info.name} exists")
+        info.id = m.gen_global_id()
+        info.state = SchemaState.PUBLIC
+        m.create_table(job.schema_id, info)
+        job.table_id = info.id
+        job.state = JobState.DONE
+        return True
+
+    def _on_drop_table(self, txn, m: Meta, job: DDLJob) -> bool:
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        if info.state == SchemaState.PUBLIC:
+            info.state = SchemaState.WRITE_ONLY
+        elif info.state == SchemaState.WRITE_ONLY:
+            info.state = SchemaState.DELETE_ONLY
+        else:
+            self._delete_table_data(txn, info.id)
+            m.drop_table(job.schema_id, info.id)
+            job.state = JobState.DONE
+            return True
+        m.update_table(job.schema_id, info)
+        return True
+
+    def _on_truncate_table(self, txn, m: Meta, job: DDLJob) -> bool:
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        self._delete_table_data(txn, info.id)
+        m.drop_table(job.schema_id, info.id)
+        info.id = m.gen_global_id()
+        m.create_table(job.schema_id, info)
+        job.state = JobState.DONE
+        return True
+
+    def _delete_table_data(self, txn, table_id: int) -> None:
+        start = tc.table_prefix(table_id)
+        end = start + b"\xff" * 12
+        for k, _v in list(txn.iterate(start, end)):
+            txn.delete(k)
+
+    # ---- index ops (the online state machine) ----
+
+    def _on_add_index(self, txn, m: Meta, job: DDLJob) -> bool:
+        index_name, col_names, unique = job.args
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        idx = info.find_index(index_name)
+        if idx is None:
+            cols = []
+            for cn in col_names:
+                c = info.find_column(cn)
+                if c is None:
+                    raise errors.UnknownFieldError(f"column {cn} doesn't exist")
+                cols.append(IndexColumn(c.name, c.offset))
+            idx = IndexInfo(id=max((i.id for i in info.indices), default=0) + 1,
+                            name=index_name, columns=cols, unique=unique,
+                            state=SchemaState.NONE)
+            info.indices.append(idx)
+
+        if idx.state == SchemaState.NONE:
+            idx.state = SchemaState.DELETE_ONLY
+        elif idx.state == SchemaState.DELETE_ONLY:
+            idx.state = SchemaState.WRITE_ONLY
+        elif idx.state == SchemaState.WRITE_ONLY:
+            idx.state = SchemaState.WRITE_REORG
+            job.reorg_handle = None
+        elif idx.state == SchemaState.WRITE_REORG:
+            done = self._backfill_index(txn, info, idx, job)
+            if not done:
+                m.update_table(job.schema_id, info)
+                return False  # more batches; stay in WRITE_REORG
+            idx.state = SchemaState.PUBLIC
+            job.state = JobState.DONE
+        m.update_table(job.schema_id, info)
+        return True
+
+    def _backfill_index(self, txn, info: TableInfo, idx: IndexInfo,
+                        job: DDLJob) -> bool:
+        """One batch of index backfill inside the job txn; checkpoint in
+        job.reorg_handle. Reference: ddl/index.go backfillTableIndex:489."""
+        tbl = Table(info)
+        index = next(i for i in tbl.indices if i.info.id == idx.id)
+        start_handle = job.reorg_handle
+        if start_handle is None:
+            start, end = tc.encode_record_range(info.id)
+        else:
+            start, _ = tc.handle_range_keys(info.id, start_handle + 1, (1 << 63) - 1)
+            _, end = tc.encode_record_range(info.id)
+        count = 0
+        last_handle = None
+        for k, v in txn.iterate(start, end):
+            if count >= REORG_BATCH_SIZE:
+                job.reorg_handle = last_handle
+                return False
+            _tid, handle = tc.decode_row_key(k)
+            data = tc.decode_row(v)
+            values = []
+            from tidb_tpu.types.datum import NULL
+            from tidb_tpu.types import unflatten_datum
+            pk_col = info.pk_handle_column()
+            for ic in idx.columns:
+                col = info.columns[ic.offset]
+                if pk_col is not None and col.id == pk_col.id:
+                    from tidb_tpu.types import Datum
+                    values.append(Datum.i64(handle))
+                else:
+                    values.append(unflatten_datum(data[col.id], col.field_type)
+                                  if col.id in data else NULL)
+            index.create(txn, values, handle, backfill=True)
+            last_handle = handle
+            count += 1
+        return True
+
+    def _on_drop_index(self, txn, m: Meta, job: DDLJob) -> bool:
+        index_name = job.args[0]
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        idx = info.find_index(index_name)
+        if idx is None:
+            raise errors.TiDBError(f"index {index_name} doesn't exist",
+                                   code=my.ErrCantDropFieldOrKey)
+        if idx.state == SchemaState.PUBLIC:
+            idx.state = SchemaState.WRITE_ONLY
+        elif idx.state == SchemaState.WRITE_ONLY:
+            idx.state = SchemaState.DELETE_ONLY
+        else:
+            # delete index data, then remove from schema
+            prefix = tc.encode_index_seek_key(info.id, idx.id)
+            for k, _v in list(txn.iterate(prefix, prefix + b"\xff" * 9)):
+                txn.delete(k)
+            info.indices = [i for i in info.indices if i.id != idx.id]
+            m.update_table(job.schema_id, info)
+            job.state = JobState.DONE
+            return True
+        m.update_table(job.schema_id, info)
+        return True
+
+    # ---- column ops ----
+
+    def _on_add_column(self, txn, m: Meta, job: DDLJob) -> bool:
+        col = ColumnInfo.from_json(job.args[0])
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        existing = info.find_column(col.name)
+        if existing is None:
+            col.id = max((c.id for c in info.columns), default=0) + 1
+            col.offset = len(info.columns)
+            col.state = SchemaState.NONE
+            info.columns.append(col)
+            existing = col
+        if existing.state == SchemaState.NONE:
+            existing.state = SchemaState.DELETE_ONLY
+        elif existing.state == SchemaState.DELETE_ONLY:
+            existing.state = SchemaState.WRITE_ONLY
+        elif existing.state == SchemaState.WRITE_ONLY:
+            # no reorg needed: original_default covers old rows
+            existing.state = SchemaState.PUBLIC
+            job.state = JobState.DONE
+        m.update_table(job.schema_id, info)
+        return True
+
+    def _on_drop_column(self, txn, m: Meta, job: DDLJob) -> bool:
+        col_name = job.args[0]
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        col = info.find_column(col_name)
+        if col is None:
+            raise errors.TiDBError(f"column {col_name} doesn't exist",
+                                   code=my.ErrCantDropFieldOrKey)
+        if col.state == SchemaState.PUBLIC:
+            col.state = SchemaState.WRITE_ONLY
+        elif col.state == SchemaState.WRITE_ONLY:
+            col.state = SchemaState.DELETE_ONLY
+        else:
+            info.columns.remove(col)
+            for i, c in enumerate(sorted(info.columns, key=lambda c: c.offset)):
+                c.offset = i
+            info.columns.sort(key=lambda c: c.offset)
+            # fix index column offsets by name
+            by_name = {c.lower_name: c.offset for c in info.columns}
+            for idx in info.indices:
+                for ic in idx.columns:
+                    ic.offset = by_name[ic.name.lower()]
+            m.update_table(job.schema_id, info)
+            job.state = JobState.DONE
+            return True
+        m.update_table(job.schema_id, info)
+        return True

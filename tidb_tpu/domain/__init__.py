@@ -1,0 +1,47 @@
+"""Domain: per-storage schema cache, DDL owner, bootstrap glue.
+
+Reference: domain/domain.go — owns the infoschema.Handle, reload loop,
+schema-validity tracking, and the DDL worker. Single-process mode reloads
+synchronously after every DDL version bump; the lease-based refresher and
+validity kill-switch activate in multi-server deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tidb_tpu.ddl import DDL, Callback
+from tidb_tpu.infoschema import Handle, InfoSchema
+
+_domains: dict[str, "Domain"] = {}
+_domains_lock = threading.Lock()
+
+
+class Domain:
+    def __init__(self, store, ddl_callback: Callback | None = None):
+        self.store = store
+        self.handle = Handle(store)
+        self.handle.load()
+        self.ddl = DDL(store, self.handle, callback=ddl_callback)
+
+    def info_schema(self) -> InfoSchema:
+        return self.handle.get()
+
+    def reload(self) -> InfoSchema:
+        return self.handle.load()
+
+
+def get_domain(store, **kwargs) -> Domain:
+    """One Domain per storage instance (tidb.go:48-75 domain map)."""
+    key = store.uuid()
+    with _domains_lock:
+        d = _domains.get(key)
+        if d is None:
+            d = Domain(store, **kwargs)
+            _domains[key] = d
+        return d
+
+
+def clear_domains() -> None:
+    with _domains_lock:
+        _domains.clear()

@@ -1,0 +1,91 @@
+"""Immutable schema snapshot with by-name/by-ID maps.
+
+Reference: infoschema/infoschema.go (InfoSchema + Handle), builder.go.
+Each DDL-induced version produces a fresh immutable InfoSchema; sessions pin
+one for a statement's lifetime. INFORMATION_SCHEMA virtual tables attach in
+the executor layer (executor/show.py) rather than as memory tables for now.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tidb_tpu import errors
+from tidb_tpu.meta import Meta
+from tidb_tpu.model import DBInfo, TableInfo
+from tidb_tpu.table import Table
+
+
+class InfoSchema:
+    def __init__(self, version: int, dbs: list[DBInfo],
+                 tables_by_db: dict[int, list[TableInfo]], store=None):
+        self.version = version
+        self._db_by_name: dict[str, DBInfo] = {d.name.lower(): d for d in dbs}
+        self._db_by_id: dict[int, DBInfo] = {d.id: d for d in dbs}
+        self._tbl_by_name: dict[tuple[str, str], Table] = {}
+        self._tbl_by_id: dict[int, Table] = {}
+        for db_id, tbls in tables_by_db.items():
+            db = self._db_by_id[db_id]
+            for ti in tbls:
+                t = Table(ti, store=store, db_id=db_id)
+                self._tbl_by_name[(db.name.lower(), ti.name.lower())] = t
+                self._tbl_by_id[ti.id] = t
+
+    # ---- lookups ----
+    def schema_by_name(self, name: str) -> DBInfo | None:
+        return self._db_by_name.get(name.lower())
+
+    def schema_exists(self, name: str) -> bool:
+        return name.lower() in self._db_by_name
+
+    def table_by_name(self, db: str, table: str) -> Table:
+        t = self._tbl_by_name.get((db.lower(), table.lower()))
+        if t is None:
+            if not self.schema_exists(db):
+                raise errors.BadDBError(f"Unknown database '{db}'")
+            raise errors.NoSuchTableError(f"Table '{db}.{table}' doesn't exist")
+        return t
+
+    def table_exists(self, db: str, table: str) -> bool:
+        return (db.lower(), table.lower()) in self._tbl_by_name
+
+    def table_by_id(self, tid: int) -> Table | None:
+        return self._tbl_by_id.get(tid)
+
+    def all_schema_names(self) -> list[str]:
+        return [d.name for d in self._db_by_name.values()]
+
+    def schema_tables(self, db: str) -> list[Table]:
+        dbl = db.lower()
+        return [t for (d, _n), t in self._tbl_by_name.items() if d == dbl]
+
+
+class Handle:
+    """Atomically-swapped current InfoSchema. Reference: infoschema.Handle."""
+
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._schema: InfoSchema | None = None
+
+    def get(self) -> InfoSchema:
+        s = self._schema
+        if s is None:
+            raise errors.TiDBError("schema not loaded yet")
+        return s
+
+    def load(self) -> InfoSchema:
+        """Full load from meta at the current KV version.
+        Reference: domain.loadInfoSchema (domain/domain.go:50)."""
+        txn = self.store.begin()
+        try:
+            m = Meta(txn)
+            version = m.schema_version()
+            dbs = m.list_databases()
+            tables = {db.id: m.list_tables(db.id) for db in dbs}
+        finally:
+            txn.rollback()
+        schema = InfoSchema(version, dbs, tables, store=self.store)
+        with self._lock:
+            self._schema = schema
+        return schema

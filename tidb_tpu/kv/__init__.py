@@ -1,0 +1,29 @@
+"""Transactional KV abstraction.
+
+Reference: kv/kv.go (Retriever/Mutator/Transaction/Snapshot/Storage/Client),
+kv/union_store.go, kv/memdb_buffer.go, kv/txn.go.
+"""
+
+from tidb_tpu.kv.kv import (  # noqa: F401
+    Retriever,
+    Mutator,
+    Transaction,
+    Snapshot,
+    Storage,
+    Client,
+    Request,
+    Response,
+    KeyRange,
+    Driver,
+    register_driver,
+    open_store,
+    REQ_TYPE_SELECT,
+    REQ_TYPE_INDEX,
+    REQ_SUB_TYPE_BASIC,
+    REQ_SUB_TYPE_DESC,
+    REQ_SUB_TYPE_GROUP_BY,
+    REQ_SUB_TYPE_TOPN,
+)
+from tidb_tpu.kv.membuffer import MemBuffer  # noqa: F401
+from tidb_tpu.kv.union_store import UnionStore  # noqa: F401
+from tidb_tpu.kv.txn_util import run_in_new_txn, backoff  # noqa: F401

@@ -1,0 +1,182 @@
+"""Core KV interfaces.
+
+Reference: kv/kv.go:37-181. The Client/Request/Response trio is the
+coprocessor boundary (kv/kv.go:94-137): the executor marshals a SelectRequest
+into Request.data, the storage backend fans it out per region, and Response
+streams one region's partial result per next() call. This is exactly where
+the TPU execution tier plugs in (ops.TpuClient) without the executor knowing.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+from tidb_tpu import errors
+
+# request types (kv/kv.go:103-111)
+REQ_TYPE_SELECT = 101
+REQ_TYPE_INDEX = 102
+
+REQ_SUB_TYPE_BASIC = 0
+REQ_SUB_TYPE_DESC = 10000
+REQ_SUB_TYPE_GROUP_BY = 10001
+REQ_SUB_TYPE_TOPN = 10002
+REQ_SUB_TYPE_SIGNATURE = 10003  # expression capability probes carry the op name
+
+
+@dataclass(frozen=True)
+class KeyRange:
+    """[start, end) over encoded keys. Reference: kv/key.go KeyRange."""
+    start: bytes
+    end: bytes
+
+    def is_point(self) -> bool:
+        return len(self.end) == len(self.start) + 1 and self.end[:-1] == self.start \
+            and self.end[-1] == 0
+
+
+class Retriever(abc.ABC):
+    @abc.abstractmethod
+    def get(self, key: bytes) -> bytes:
+        """Raise KeyNotExistsError if absent."""
+
+    @abc.abstractmethod
+    def iterate(self, start: bytes, end: bytes | None = None) -> Iterator[tuple[bytes, bytes]]:
+        """Ascending (key, value) pairs in [start, end)."""
+
+    def get_or_none(self, key: bytes) -> bytes | None:
+        try:
+            return self.get(key)
+        except errors.KeyNotExistsError:
+            return None
+
+
+class Mutator(abc.ABC):
+    @abc.abstractmethod
+    def set(self, key: bytes, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, key: bytes) -> None: ...
+
+
+class Snapshot(Retriever):
+    def batch_get(self, keys) -> dict[bytes, bytes]:
+        out = {}
+        for k in keys:
+            v = self.get_or_none(k)
+            if v is not None:
+                out[k] = v
+        return out
+
+
+class Transaction(Retriever, Mutator, abc.ABC):
+    """Reference: kv/kv.go:140-153 — snapshot-isolated, buffered writes."""
+
+    @abc.abstractmethod
+    def commit(self) -> None: ...
+
+    @abc.abstractmethod
+    def rollback(self) -> None: ...
+
+    @abc.abstractmethod
+    def start_ts(self) -> int: ...
+
+    def valid(self) -> bool:
+        return True
+
+    def lock_keys(self, *keys: bytes) -> None:
+        """SELECT FOR UPDATE support; optimistic backends may no-op."""
+
+    # options (kv/kv.go SetOption): PresumeKeyNotExists etc.
+    def set_option(self, opt: str, val: Any = True) -> None:
+        pass
+
+    def del_option(self, opt: str) -> None:
+        pass
+
+
+@dataclass
+class Request:
+    """Coprocessor request. Reference: kv/kv.go:113-127."""
+    tp: int
+    data: Any                      # SelectRequest (copr.select) — in-proc object
+    key_ranges: list[KeyRange] = field(default_factory=list)
+    keep_order: bool = False
+    desc: bool = False
+    concurrency: int = 1
+
+
+class Response(abc.ABC):
+    """Reference: kv/kv.go:129-137 — one region's result bytes per next()."""
+
+    @abc.abstractmethod
+    def next(self) -> Any | None:
+        """Next partial result (SelectResponse) or None when exhausted."""
+
+
+class Client(abc.ABC):
+    """Reference: kv/kv.go:94-100."""
+
+    @abc.abstractmethod
+    def send(self, req: Request) -> Response: ...
+
+    @abc.abstractmethod
+    def support_request_type(self, req_type: int, sub_type: Any) -> bool:
+        """Capability probe gating pushdown planning (plan/expr_to_pb.go:92)."""
+
+
+class Storage(abc.ABC):
+    """Reference: kv/kv.go:155-170."""
+
+    @abc.abstractmethod
+    def begin(self) -> Transaction: ...
+
+    @abc.abstractmethod
+    def get_snapshot(self, version: int | None = None) -> Snapshot: ...
+
+    @abc.abstractmethod
+    def get_client(self) -> Client: ...
+
+    @abc.abstractmethod
+    def current_version(self) -> int: ...
+
+    def uuid(self) -> str:
+        return f"store-{id(self):x}"
+
+    def close(self) -> None:
+        pass
+
+
+class Driver(abc.ABC):
+    """Reference: kv/kv.go:147 kv.Driver + tidb.go:172-187 URL registry."""
+
+    @abc.abstractmethod
+    def open(self, path: str) -> Storage: ...
+
+
+_drivers: dict[str, Driver] = {}
+_stores: dict[str, Storage] = {}
+
+
+def register_driver(scheme: str, driver: Driver) -> None:
+    if scheme in _drivers:
+        raise errors.KVError(f"driver {scheme!r} already registered")
+    _drivers[scheme] = driver
+
+
+def open_store(url: str) -> Storage:
+    """'scheme://path' → cached Storage (tidb.go NewStore/domain-per-store)."""
+    if "://" not in url:
+        raise errors.KVError(f"malformed store url {url!r}")
+    scheme, path = url.split("://", 1)
+    if scheme not in _drivers:
+        raise errors.KVError(f"unknown store scheme {scheme!r}")
+    key = f"{scheme}://{path}"
+    if path and key in _stores:
+        return _stores[key]
+    store = _drivers[scheme].open(path)
+    if path:
+        _stores[key] = store
+    return store

@@ -1,0 +1,84 @@
+"""In-memory sorted write buffer.
+
+Reference: kv/memdb_buffer.go (goleveldb memdb-backed). Python version: a
+dict plus a lazily-resorted key list — writes are O(1), the sorted view is
+rebuilt only when iteration follows a write. Deletions are tombstones
+(empty value) so UnionStore can shadow snapshot keys, matching the
+reference's convention (kv/union_store.go len(v)==0 ⇒ ErrNotExist).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterator
+
+from tidb_tpu import errors
+from tidb_tpu.kv.kv import Mutator, Retriever
+
+TOMBSTONE = b""
+
+
+class MemBuffer(Retriever, Mutator):
+    __slots__ = ("_data", "_sorted", "_dirty")
+
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._sorted: list[bytes] = []
+        self._dirty = False
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def get(self, key: bytes) -> bytes:
+        try:
+            v = self._data[key]
+        except KeyError:
+            raise errors.KeyNotExistsError(f"key not exist: {key!r}") from None
+        if v == TOMBSTONE:
+            raise errors.KeyNotExistsError(f"key deleted: {key!r}")
+        return v
+
+    def get_raw(self, key: bytes) -> bytes | None:
+        """Tombstone-visible get (None = never written, b'' = deleted)."""
+        return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if key not in self._data:
+            self._dirty = True
+        self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self.set(key, TOMBSTONE)
+
+    def _view(self) -> list[bytes]:
+        if self._dirty:
+            self._sorted = sorted(self._data)
+            self._dirty = False
+        return self._sorted
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None,
+                include_tombstones: bool = False) -> Iterator[tuple[bytes, bytes]]:
+        view = self._view()
+        i = bisect.bisect_left(view, start)
+        while i < len(view):
+            k = view[i]
+            if end is not None and k >= end:
+                return
+            v = self._data[k]
+            if include_tombstones or v != TOMBSTONE:
+                yield k, v
+            i += 1
+
+    def iterate_reverse(self, start: bytes = b"", end: bytes | None = None,
+                        include_tombstones: bool = False) -> Iterator[tuple[bytes, bytes]]:
+        """Descending over [start, end) — mirrors localstore reverse seek."""
+        view = self._view()
+        i = (bisect.bisect_left(view, end) if end is not None else len(view)) - 1
+        while i >= 0:
+            k = view[i]
+            if k < start:
+                return
+            v = self._data[k]
+            if include_tombstones or v != TOMBSTONE:
+                yield k, v
+            i -= 1

@@ -1,0 +1,55 @@
+"""Transaction retry helpers.
+
+Reference: kv/txn.go (RunInNewTxn, BackOff with exponential jitter).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Callable, TypeVar
+
+from tidb_tpu import errors
+
+log = logging.getLogger(__name__)
+
+MAX_RETRY_CNT = 10
+RETRY_BACKOFF_BASE_MS = 1
+RETRY_BACKOFF_CAP_MS = 100
+
+T = TypeVar("T")
+
+
+def backoff(attempts: int) -> float:
+    """Sleep with capped exponential backoff + jitter; returns slept seconds."""
+    upper = min(RETRY_BACKOFF_CAP_MS, RETRY_BACKOFF_BASE_MS * (1 << min(attempts, 20)))
+    ms = random.uniform(0, upper)
+    time.sleep(ms / 1000.0)
+    return ms / 1000.0
+
+
+def run_in_new_txn(store, retryable: bool, fn: Callable[[object], T]) -> T:
+    """Run fn(txn) in a fresh transaction, retrying on write conflict.
+
+    Reference: kv/txn.go RunInNewTxn — used by DDL/meta operations that must
+    win eventually.
+    """
+    last_err: BaseException | None = None
+    for attempt in range(MAX_RETRY_CNT):
+        txn = store.begin()
+        try:
+            result = fn(txn)
+            txn.commit()
+            return result
+        except BaseException as e:
+            try:
+                txn.rollback()
+            except errors.TiDBError:
+                pass
+            if not (retryable and errors.is_retryable(e)):
+                raise
+            last_err = e
+            log.debug("run_in_new_txn retry %d: %s", attempt, e)
+            backoff(attempt)
+    raise last_err  # type: ignore[misc]

@@ -1,0 +1,113 @@
+"""UnionStore: private write buffer overlaid on a snapshot.
+
+Reference: kv/union_store.go:24-203 (unionStore, lazyMemBuffer,
+PresumeKeyNotExists condition pairs) and kv/union_iter.go (merged
+dirty+snapshot iteration).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Iterator
+
+from tidb_tpu import errors
+from tidb_tpu.kv.kv import Mutator, Retriever, Snapshot
+from tidb_tpu.kv.membuffer import MemBuffer, TOMBSTONE
+
+OPT_PRESUME_KEY_NOT_EXISTS = "presume_key_not_exists"
+
+
+class UnionStore(Retriever, Mutator):
+    def __init__(self, snapshot: Snapshot):
+        self.snapshot = snapshot
+        self.buffer = MemBuffer()
+        # key → expected-error marker for lazily-checked existence assumptions
+        # (kv/union_store.go markLazyConditionPair). INSERT uses this to skip
+        # a read per unique key and batch-check at commit.
+        self._lazy_conditions: dict[bytes, errors.TiDBError | None] = {}
+        self._presume_not_exists = False
+
+    # ---- options ----
+    def set_option(self, opt: str, val=True) -> None:
+        if opt == OPT_PRESUME_KEY_NOT_EXISTS:
+            self._presume_not_exists = bool(val)
+
+    def del_option(self, opt: str) -> None:
+        if opt == OPT_PRESUME_KEY_NOT_EXISTS:
+            self._presume_not_exists = False
+
+    # ---- retriever/mutator ----
+    def get(self, key: bytes) -> bytes:
+        v = self.buffer.get_raw(key)
+        if v is not None:
+            if v == TOMBSTONE:
+                raise errors.KeyNotExistsError(f"key deleted: {key!r}")
+            return v
+        if self._presume_not_exists:
+            # assume absent; record the assumption for commit-time verification
+            self._lazy_conditions[key] = errors.KeyExistsError(
+                f"key already exists: {key!r}")
+            raise errors.KeyNotExistsError(f"key presumed not exist: {key!r}")
+        return self.snapshot.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self.buffer.set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        self.buffer.delete(key)
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None) -> Iterator[tuple[bytes, bytes]]:
+        """Merged ascending iteration; buffer shadows snapshot (union_iter.go)."""
+        return _merge(self.buffer.iterate(start, end, include_tombstones=True),
+                      self.snapshot.iterate(start, end))
+
+    def iterate_reverse(self, start: bytes = b"", end: bytes | None = None):
+        snap_rev = getattr(self.snapshot, "iterate_reverse", None)
+        snap_it = snap_rev(start, end) if snap_rev else iter(())
+        return _merge(self.buffer.iterate_reverse(start, end, include_tombstones=True),
+                      snap_it, reverse=True)
+
+    # ---- commit-time checks ----
+    def check_lazy_conditions(self) -> None:
+        """Verify PresumeKeyNotExists assumptions against the snapshot
+        (kv/union_store.go CheckLazyConditionPairs)."""
+        if not self._lazy_conditions:
+            return
+        found = self.snapshot.batch_get(list(self._lazy_conditions))
+        for key, err in self._lazy_conditions.items():
+            if key in found and err is not None:
+                raise err
+        self._lazy_conditions.clear()
+
+    def walk_buffer(self) -> Iterator[tuple[bytes, bytes]]:
+        """All buffered mutations including tombstones (for commit)."""
+        return self.buffer.iterate(include_tombstones=True)
+
+
+def _merge(dirty_it, snap_it, reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:
+    """Two-way ordered merge where the dirty side wins on equal keys and
+    tombstones suppress snapshot entries."""
+    sentinel = object()
+
+    def nxt(it):
+        return next(it, sentinel)
+
+    d, s = nxt(dirty_it), nxt(snap_it)
+    while d is not sentinel or s is not sentinel:
+        if s is sentinel:
+            take_dirty = True
+        elif d is sentinel:
+            take_dirty = False
+        else:
+            if d[0] == s[0]:
+                s = nxt(snap_it)  # shadowed
+                continue
+            take_dirty = (d[0] < s[0]) != reverse
+        if take_dirty:
+            k, v = d
+            d = nxt(dirty_it)
+            if v != TOMBSTONE:
+                yield k, v
+        else:
+            yield s
+            s = nxt(snap_it)

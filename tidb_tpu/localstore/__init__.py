@@ -1,0 +1,9 @@
+"""Embedded MVCC storage engine + in-process coprocessor host.
+
+Reference: store/localstore/ (kv.go dbStore, mvcc.go, snapshot.go,
+compactor.go, local_client.go, local_region.go, local_pd.go).
+"""
+
+from tidb_tpu.localstore.store import LocalStore, LocalDriver  # noqa: F401
+from tidb_tpu.localstore.mvcc import MVCCStore  # noqa: F401
+from tidb_tpu.localstore.regions import RegionInfo, RegionManager  # noqa: F401

@@ -1,0 +1,111 @@
+"""In-memory MVCC key-value core.
+
+Reference: store/localstore/mvcc.go (version-suffixed cells, tombstones) and
+snapshot.go (mvccSeek to first visible version). Representation differs from
+the reference's flat version-suffixed keyspace: per-key descending version
+lists under a sorted key index — simpler and faster for range scans in
+Python, with identical visibility semantics (newest version ≤ read_ts wins;
+tombstone ⇒ invisible).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Iterator
+
+
+class MVCCStore:
+    def __init__(self):
+        # key → [(version, value|None)], version descending; None = tombstone
+        self._cells: dict[bytes, list[tuple[int, bytes | None]]] = {}
+        self._keys: list[bytes] = []
+        self._lock = threading.RLock()
+
+    # ---- writes (called under the store's commit lock) ----
+    # Version lists are COPY-ON-WRITE: readers iterate whichever immutable
+    # list they fetched from the dict without locking (dict reads are atomic
+    # under the GIL); writers install a fresh list. This keeps the scan hot
+    # path lock-free while write()/compact() stay race-free.
+    def write(self, key: bytes, version: int, value: bytes | None) -> None:
+        with self._lock:
+            versions = self._cells.get(key)
+            if versions is None:
+                self._cells[key] = [(version, value)]
+                bisect.insort(self._keys, key)
+                return
+            if version > versions[0][0]:
+                self._cells[key] = [(version, value)] + versions
+            else:
+                # out-of-order insert (rare; e.g. replay in tests)
+                i = 0
+                while i < len(versions) and versions[i][0] > version:
+                    i += 1
+                if i < len(versions) and versions[i][0] == version:
+                    self._cells[key] = versions[:i] + [(version, value)] + versions[i + 1:]
+                else:
+                    self._cells[key] = versions[:i] + [(version, value)] + versions[i:]
+
+    # ---- reads ----
+    def get(self, key: bytes, read_ts: int) -> bytes | None:
+        """Newest visible value at read_ts, or None (absent or tombstone)."""
+        versions = self._cells.get(key)
+        if not versions:
+            return None
+        for ver, val in versions:
+            if ver <= read_ts:
+                return val
+        return None
+
+    def scan(self, start: bytes, end: bytes | None, read_ts: int,
+             reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:
+        """Visible (key, value) pairs in [start, end), ascending (or desc)."""
+        with self._lock:
+            lo = bisect.bisect_left(self._keys, start)
+            hi = bisect.bisect_left(self._keys, end) if end is not None else len(self._keys)
+            keys = self._keys[lo:hi]
+        if reverse:
+            keys = reversed(keys)
+        for k in keys:
+            v = self.get(k, read_ts)
+            if v is not None:
+                yield k, v
+
+    def latest_commit_version(self, key: bytes) -> int:
+        """Newest write version of key (0 if never written) — the conflict
+        check source for optimistic commit (store/localstore/kv.go tryLock)."""
+        versions = self._cells.get(key)
+        return versions[0][0] if versions else 0
+
+    # ---- GC (store/localstore/compactor.go) ----
+    def compact(self, safe_point_ts: int) -> int:
+        """Drop versions older than the newest one ≤ safe_point_ts; drop keys
+        whose only surviving version is a tombstone older than the safepoint.
+        Returns number of cells removed."""
+        removed = 0
+        with self._lock:
+            dead_keys = []
+            for key, versions in self._cells.items():
+                keep_idx = None
+                for i, (ver, _val) in enumerate(versions):
+                    if ver <= safe_point_ts:
+                        keep_idx = i
+                        break
+                if keep_idx is None:
+                    continue
+                removed += len(versions) - keep_idx - 1
+                trimmed = versions[: keep_idx + 1]  # COW for lock-free readers
+                self._cells[key] = trimmed
+                if len(trimmed) == 1 and trimmed[0][1] is None \
+                        and trimmed[0][0] <= safe_point_ts:
+                    dead_keys.append(key)
+            for key in dead_keys:
+                del self._cells[key]
+                i = bisect.bisect_left(self._keys, key)
+                if i < len(self._keys) and self._keys[i] == key:
+                    del self._keys[i]
+                removed += 1
+        return removed
+
+    def __len__(self) -> int:
+        return len(self._cells)

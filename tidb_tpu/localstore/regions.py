@@ -1,0 +1,90 @@
+"""Region layout for the single-node store.
+
+Reference: store/localstore/local_pd.go (static region split) and
+local_region.go buildLocalRegionServers. Regions are [start, end) key
+ranges; the coprocessor client intersects request ranges with regions to
+build per-region tasks — the unit of parallel fan-out, and on the TPU path
+the unit of batch sharding across chips.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RegionInfo:
+    region_id: int
+    start: bytes            # b"" = -inf
+    end: bytes | None       # None = +inf
+    write_count: int = 0    # split heuristic / columnar-cache invalidation hint
+    version: int = 0        # bumped on every write batch touching the region
+
+    def contains(self, key: bytes) -> bool:
+        return key >= self.start and (self.end is None or key < self.end)
+
+    def intersect(self, start: bytes, end: bytes | None) -> tuple[bytes, bytes | None] | None:
+        lo = max(self.start, start)
+        if self.end is None:
+            hi = end
+        elif end is None:
+            hi = self.end
+        else:
+            hi = min(self.end, end)
+        if hi is not None and lo >= hi:
+            return None
+        return lo, hi
+
+
+class RegionManager:
+    """Sorted, splittable region table (single node, no raft)."""
+
+    def __init__(self):
+        self._id_gen = itertools.count(1)
+        self._lock = threading.RLock()
+        self._regions: list[RegionInfo] = [RegionInfo(next(self._id_gen), b"", None)]
+
+    def all_regions(self) -> list[RegionInfo]:
+        with self._lock:
+            return list(self._regions)
+
+    def split(self, split_key: bytes) -> None:
+        """Split the region containing split_key at that key."""
+        with self._lock:
+            i = self._locate(split_key)
+            r = self._regions[i]
+            if r.start == split_key:
+                return  # already a boundary
+            left = RegionInfo(r.region_id, r.start, split_key, r.write_count, r.version)
+            right = RegionInfo(next(self._id_gen), split_key, r.end, 0, r.version)
+            self._regions[i : i + 1] = [left, right]
+
+    def split_keys(self, keys: list[bytes]) -> None:
+        for k in keys:
+            self.split(k)
+
+    def regions_for_range(self, start: bytes, end: bytes | None) -> list[tuple[RegionInfo, bytes, bytes | None]]:
+        """All (region, clipped_start, clipped_end) overlapping [start, end)."""
+        out = []
+        with self._lock:
+            for r in self._regions:
+                clipped = r.intersect(start, end)
+                if clipped is not None:
+                    out.append((r, clipped[0], clipped[1]))
+        return out
+
+    def note_write(self, n: int) -> None:
+        # coarse: bump all regions' version; finer per-key attribution comes
+        # with the columnar-cache milestone where it gates cache reuse
+        with self._lock:
+            for r in self._regions:
+                r.write_count += n
+                r.version += 1
+
+    def _locate(self, key: bytes) -> int:
+        starts = [r.start for r in self._regions]
+        i = bisect.bisect_right(starts, key) - 1
+        return max(i, 0)

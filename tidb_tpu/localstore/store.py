@@ -1,0 +1,190 @@
+"""LocalStore: kv.Storage over the MVCC core with optimistic commit.
+
+Reference: store/localstore/kv.go (dbStore, tryLock/doCommit),
+local_version_provider.go (TSO), snapshot.go (dbSnapshot).
+Commit protocol: single-process optimistic — under the commit mutex, every
+written key's latest commit version is checked against the txn's start_ts;
+any newer write aborts with a retryable conflict (the lock-table segment map
+of the reference collapses to this check because commit is serialized).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator
+
+from tidb_tpu import errors
+from tidb_tpu.kv.kv import (
+    Client, Driver, KeyRange, Request, Response, Snapshot, Storage, Transaction,
+)
+from tidb_tpu.kv.union_store import UnionStore
+from tidb_tpu.kv.membuffer import TOMBSTONE
+from tidb_tpu.localstore.mvcc import MVCCStore
+from tidb_tpu.localstore.regions import RegionManager
+
+
+class VersionProvider:
+    """Monotonic TSO shaped like TiKV's: physical-ms << 18 | logical.
+    Reference: store/localstore/local_version_provider.go."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = 0
+
+    def current_version(self) -> int:
+        with self._lock:
+            ts = int(time.time() * 1000) << 18
+            if ts <= self._last:
+                ts = self._last + 1
+            self._last = ts
+            return ts
+
+
+class LocalSnapshot(Snapshot):
+    def __init__(self, mvcc: MVCCStore, version: int):
+        self._mvcc = mvcc
+        self.version = version
+
+    def get(self, key: bytes) -> bytes:
+        v = self._mvcc.get(key, self.version)
+        if v is None:
+            raise errors.KeyNotExistsError(f"key not exist: {key!r}")
+        return v
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None) -> Iterator[tuple[bytes, bytes]]:
+        return self._mvcc.scan(start, end, self.version)
+
+    def iterate_reverse(self, start: bytes = b"", end: bytes | None = None):
+        return self._mvcc.scan(start, end, self.version, reverse=True)
+
+
+class LocalTxn(Transaction):
+    def __init__(self, store: "LocalStore", start_ts: int):
+        self._store = store
+        self._start_ts = start_ts
+        self._us = UnionStore(LocalSnapshot(store.mvcc, start_ts))
+        self._valid = True
+        self._dirty = False
+
+    def start_ts(self) -> int:
+        return self._start_ts
+
+    def valid(self) -> bool:
+        return self._valid
+
+    def is_readonly(self) -> bool:
+        return not self._dirty
+
+    # ---- retriever/mutator ----
+    def get(self, key: bytes) -> bytes:
+        self._check_valid()
+        return self._us.get(key)
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        self._check_valid()
+        return self._us.iterate(start, end)
+
+    def iterate_reverse(self, start: bytes = b"", end: bytes | None = None):
+        self._check_valid()
+        return self._us.iterate_reverse(start, end)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._check_valid()
+        if not value:
+            raise errors.KVError("cannot set empty value")
+        self._dirty = True
+        self._us.set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        self._check_valid()
+        self._dirty = True
+        self._us.delete(key)
+
+    def set_option(self, opt: str, val=True) -> None:
+        self._us.set_option(opt, val)
+
+    def del_option(self, opt: str) -> None:
+        self._us.del_option(opt)
+
+    # ---- lifecycle ----
+    def commit(self) -> None:
+        self._check_valid()
+        self._valid = False
+        if not self._dirty:
+            return
+        self._us.check_lazy_conditions()
+        self._store.commit_txn(self._start_ts, list(self._us.walk_buffer()))
+
+    def rollback(self) -> None:
+        # idempotent: error paths rollback unconditionally, including after
+        # a failed commit that already invalidated the txn
+        self._valid = False
+
+    def _check_valid(self):
+        if not self._valid:
+            raise errors.KVError("transaction already committed or rolled back")
+
+
+class LocalStore(Storage):
+    def __init__(self, path: str = ""):
+        self.path = path
+        self.mvcc = MVCCStore()
+        self.oracle = VersionProvider()
+        self.regions = RegionManager()
+        self._commit_lock = threading.Lock()
+        self._client: Client | None = None
+        self._closed = False
+
+    # ---- Storage ----
+    def begin(self) -> Transaction:
+        return LocalTxn(self, self.oracle.current_version())
+
+    def get_snapshot(self, version: int | None = None) -> Snapshot:
+        return LocalSnapshot(self.mvcc, version if version is not None
+                             else self.oracle.current_version())
+
+    def get_client(self) -> Client:
+        if self._client is None:
+            # default CPU coprocessor client; swapped by engine config
+            from tidb_tpu.localstore.local_client import LocalClient
+            self._client = LocalClient(self)
+        return self._client
+
+    def set_client(self, client: Client) -> None:
+        """Install an alternative coprocessor client (e.g. ops.TpuClient)."""
+        self._client = client
+
+    def current_version(self) -> int:
+        return self.oracle.current_version()
+
+    def uuid(self) -> str:
+        return f"local-{self.path or id(self):}"
+
+    # ---- commit (store/localstore/kv.go:111-165) ----
+    def commit_txn(self, txn_start_ts: int, mutations: list[tuple[bytes, bytes]]) -> None:
+        with self._commit_lock:
+            for key, _val in mutations:
+                if self.mvcc.latest_commit_version(key) > txn_start_ts:
+                    raise errors.WriteConflictError(
+                        f"write conflict on {key!r} (start_ts={txn_start_ts})")
+            commit_ts = self.oracle.current_version()
+            for key, val in mutations:
+                self.mvcc.write(key, commit_ts, None if val == TOMBSTONE else val)
+            self.regions.note_write(len(mutations))
+
+    # ---- GC ----
+    def compact(self, safe_point_ts: int | None = None,
+                max_age_ms: int = 20 * 60 * 1000) -> int:
+        """MVCC GC at a safepoint (default now − max_age_ms).
+        Reference: store/localstore/compactor.go policy {SafePoint: 20min}."""
+        if safe_point_ts is None:
+            safe_point_ts = (int(time.time() * 1000) - max_age_ms) << 18
+        return self.mvcc.compact(safe_point_ts)
+
+
+class LocalDriver(Driver):
+    """URL scheme driver. Reference: tidb.go:254-258 store registration."""
+
+    def open(self, path: str) -> Storage:
+        return LocalStore(path)

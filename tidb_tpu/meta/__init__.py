@@ -1,0 +1,147 @@
+"""Meta-KV layout: schema metadata, ID allocation, DDL queues.
+
+Reference: meta/meta.go:83+ (Meta over TxStructure under the 'm' prefix):
+schema version key, DB/table info hashes, global/auto-increment ID counters,
+DDL job fifo queues, job history, owner keys.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.model import DBInfo, DDLJob, TableInfo
+from tidb_tpu.structure import TxStructure
+
+KEY_SCHEMA_VERSION = b"SchemaVersionKey"
+KEY_NEXT_GLOBAL_ID = b"NextGlobalID"
+KEY_DBS = b"DBs"                    # hash: DB:{id} → DBInfo
+KEY_DDL_JOB_QUEUE = b"DDLJobList"
+KEY_BG_JOB_QUEUE = b"DDLBgJobList"  # background (drop-table data deletion)
+KEY_DDL_JOB_HISTORY = b"DDLJobHistory"  # hash: job_id → DDLJob
+KEY_DDL_OWNER = b"DDLOwner"
+KEY_BG_OWNER = b"BgOwner"
+
+
+def _db_key(db_id: int) -> bytes:
+    return b"DB:%d" % db_id
+
+
+def _table_key(table_id: int) -> bytes:
+    return b"Table:%d" % table_id
+
+
+def _autoid_key(table_id: int) -> bytes:
+    return b"TID:%d" % table_id
+
+
+class Meta:
+    """Typed accessors over one transaction's view of the meta keyspace."""
+
+    def __init__(self, txn):
+        self.t = TxStructure(txn, txn, prefix=b"m")
+
+    # ---- IDs ----
+    def gen_global_id(self) -> int:
+        return self.t.inc(KEY_NEXT_GLOBAL_ID)
+
+    def gen_global_ids(self, n: int) -> list[int]:
+        end = self.t.inc(KEY_NEXT_GLOBAL_ID, n)
+        return list(range(end - n + 1, end + 1))
+
+    def gen_auto_table_id(self, db_id: int, table_id: int, step: int = 1) -> int:
+        if self.t.hget(_db_key(db_id), _table_key(table_id)) is None:
+            raise errors.NoSuchTableError(f"table {table_id} not in db {db_id}")
+        return self.t.inc(_autoid_key(table_id), step)
+
+    # ---- schema version ----
+    def schema_version(self) -> int:
+        v = self.t.get(KEY_SCHEMA_VERSION)
+        return int(v) if v else 0
+
+    def bump_schema_version(self) -> int:
+        return self.t.inc(KEY_SCHEMA_VERSION)
+
+    # ---- databases ----
+    def create_database(self, db: DBInfo) -> None:
+        if self.t.hget(KEY_DBS, _db_key(db.id)) is not None:
+            raise errors.DBExistsError(f"db {db.id} exists")
+        self.t.hset(KEY_DBS, _db_key(db.id), db.serialize())
+
+    def update_database(self, db: DBInfo) -> None:
+        if self.t.hget(KEY_DBS, _db_key(db.id)) is None:
+            raise errors.BadDBError(f"db {db.id} doesn't exist")
+        self.t.hset(KEY_DBS, _db_key(db.id), db.serialize())
+
+    def drop_database(self, db_id: int) -> None:
+        for field in self.t.hkeys(_db_key(db_id)):
+            if field.startswith(b"Table:"):
+                self.t.clear(_autoid_key(int(field[6:])))
+            self.t.hdel(_db_key(db_id), field)
+        self.t.hdel(KEY_DBS, _db_key(db_id))
+
+    def get_database(self, db_id: int) -> DBInfo | None:
+        raw = self.t.hget(KEY_DBS, _db_key(db_id))
+        return DBInfo.deserialize(raw) if raw else None
+
+    def list_databases(self) -> list[DBInfo]:
+        return [DBInfo.deserialize(v) for _f, v in self.t.hgetall(KEY_DBS)]
+
+    # ---- tables ----
+    def create_table(self, db_id: int, tbl: TableInfo) -> None:
+        if self.t.hget(KEY_DBS, _db_key(db_id)) is None:
+            raise errors.BadDBError(f"db {db_id} doesn't exist")
+        if self.t.hget(_db_key(db_id), _table_key(tbl.id)) is not None:
+            raise errors.TableExistsError(f"table {tbl.id} exists")
+        self.t.hset(_db_key(db_id), _table_key(tbl.id), tbl.serialize())
+
+    def update_table(self, db_id: int, tbl: TableInfo) -> None:
+        if self.t.hget(_db_key(db_id), _table_key(tbl.id)) is None:
+            raise errors.NoSuchTableError(f"table {tbl.id} doesn't exist")
+        self.t.hset(_db_key(db_id), _table_key(tbl.id), tbl.serialize())
+
+    def drop_table(self, db_id: int, table_id: int) -> None:
+        self.t.hdel(_db_key(db_id), _table_key(table_id))
+        self.t.clear(_autoid_key(table_id))
+
+    def get_table(self, db_id: int, table_id: int) -> TableInfo | None:
+        raw = self.t.hget(_db_key(db_id), _table_key(table_id))
+        return TableInfo.deserialize(raw) if raw else None
+
+    def list_tables(self, db_id: int) -> list[TableInfo]:
+        out = []
+        for field, v in self.t.hgetall(_db_key(db_id)):
+            if field.startswith(b"Table:"):
+                out.append(TableInfo.deserialize(v))
+        return out
+
+    # ---- DDL job queues (meta/meta.go:442+) ----
+    def enqueue_ddl_job(self, job: DDLJob, bg: bool = False) -> None:
+        self.t.rpush(KEY_BG_JOB_QUEUE if bg else KEY_DDL_JOB_QUEUE, job.serialize())
+
+    def get_ddl_job(self, index: int = 0, bg: bool = False) -> DDLJob | None:
+        raw = self.t.lindex(KEY_BG_JOB_QUEUE if bg else KEY_DDL_JOB_QUEUE, index)
+        return DDLJob.deserialize(raw) if raw else None
+
+    def update_ddl_job(self, job: DDLJob, index: int = 0, bg: bool = False) -> None:
+        self.t.lset(KEY_BG_JOB_QUEUE if bg else KEY_DDL_JOB_QUEUE, index,
+                    job.serialize())
+
+    def dequeue_ddl_job(self, bg: bool = False) -> DDLJob | None:
+        raw = self.t.lpop(KEY_BG_JOB_QUEUE if bg else KEY_DDL_JOB_QUEUE)
+        return DDLJob.deserialize(raw) if raw else None
+
+    def ddl_job_queue_len(self, bg: bool = False) -> int:
+        return self.t.llen(KEY_BG_JOB_QUEUE if bg else KEY_DDL_JOB_QUEUE)
+
+    def add_history_ddl_job(self, job: DDLJob) -> None:
+        self.t.hset(KEY_DDL_JOB_HISTORY, b"%d" % job.id, job.serialize())
+
+    def history_ddl_job(self, job_id: int) -> DDLJob | None:
+        raw = self.t.hget(KEY_DDL_JOB_HISTORY, b"%d" % job_id)
+        return DDLJob.deserialize(raw) if raw else None
+
+    # ---- owner election keys (ddl/ddl_worker.go checkOwner) ----
+    def get_owner(self, bg: bool = False) -> bytes | None:
+        return self.t.get(KEY_BG_OWNER if bg else KEY_DDL_OWNER)
+
+    def set_owner(self, owner_json: bytes, bg: bool = False) -> None:
+        self.t.set(KEY_BG_OWNER if bg else KEY_DDL_OWNER, owner_json)

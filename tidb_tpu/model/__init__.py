@@ -1,0 +1,15 @@
+"""Serializable schema metadata.
+
+Reference: model/model.go (DBInfo/TableInfo/ColumnInfo/IndexInfo),
+model/ddl.go (Job, schema states for online DDL).
+"""
+
+from tidb_tpu.model.model import (  # noqa: F401
+    SchemaState,
+    ColumnInfo,
+    IndexColumn,
+    IndexInfo,
+    TableInfo,
+    DBInfo,
+)
+from tidb_tpu.model.ddl_job import DDLJob, JobState, ActionType  # noqa: F401

@@ -1,0 +1,74 @@
+"""DDL job records for the asynchronous schema-change queue.
+
+Reference: model/ddl.go (Job, JobState) and ddl/ddl_worker.go queue protocol.
+Jobs are enqueued by any server and processed by the elected owner, stepping
+schema objects through SchemaState transitions.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class ActionType(enum.IntEnum):
+    NONE = 0
+    CREATE_SCHEMA = 1
+    DROP_SCHEMA = 2
+    CREATE_TABLE = 3
+    DROP_TABLE = 4
+    ADD_COLUMN = 5
+    DROP_COLUMN = 6
+    ADD_INDEX = 7
+    DROP_INDEX = 8
+    TRUNCATE_TABLE = 9
+
+
+class JobState(enum.IntEnum):
+    NONE = 0
+    RUNNING = 1
+    ROLLBACK = 2
+    DONE = 3
+    CANCELLED = 4
+    SYNCED = 5
+
+
+@dataclass
+class DDLJob:
+    id: int
+    tp: ActionType
+    schema_id: int
+    table_id: int = 0
+    state: JobState = JobState.NONE
+    error: str = ""
+    error_code: int = 0
+    # action-specific payload (column def json, index def json, names…)
+    args: list[Any] = field(default_factory=list)
+    # reorg progress checkpoint (ddl/reorg.go reorgInfo.UpdateHandle)
+    reorg_handle: int | None = None
+    schema_state: int = 0
+    snapshot_ver: int = 0
+
+    def serialize(self) -> bytes:
+        return json.dumps({
+            "id": self.id, "tp": int(self.tp), "schema_id": self.schema_id,
+            "table_id": self.table_id, "state": int(self.state),
+            "error": self.error, "error_code": self.error_code, "args": self.args,
+            "reorg_handle": self.reorg_handle,
+            "schema_state": self.schema_state,
+            "snapshot_ver": self.snapshot_ver,
+        }, separators=(",", ":")).encode()
+
+    @staticmethod
+    def deserialize(b: bytes) -> "DDLJob":
+        d = json.loads(b)
+        return DDLJob(d["id"], ActionType(d["tp"]), d["schema_id"], d["table_id"],
+                      JobState(d["state"]), d.get("error", ""),
+                      d.get("error_code", 0), d.get("args", []),
+                      d.get("reorg_handle"), d.get("schema_state", 0),
+                      d.get("snapshot_ver", 0))
+
+    def is_finished(self) -> bool:
+        return self.state in (JobState.DONE, JobState.CANCELLED, JobState.SYNCED)

@@ -1,0 +1,195 @@
+"""Schema structs with JSON serialization for meta storage.
+
+Reference: model/model.go. Schema states implement F1-style online schema
+change (None → DeleteOnly → WriteOnly → WriteReorganization → Public); every
+reader/writer consults column/index state so concurrent servers at adjacent
+schema versions stay consistent.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.types.field_type import FieldType
+
+
+class SchemaState(enum.IntEnum):
+    NONE = 0
+    DELETE_ONLY = 1
+    WRITE_ONLY = 2
+    WRITE_REORG = 3
+    PUBLIC = 4
+
+
+def _ft_to_json(ft: FieldType) -> dict:
+    return {"tp": ft.tp, "flag": ft.flag, "flen": ft.flen, "decimal": ft.decimal,
+            "charset": ft.charset, "collate": ft.collate, "elems": ft.elems}
+
+
+def _ft_from_json(d: dict) -> FieldType:
+    return FieldType(d["tp"], d["flag"], d["flen"], d["decimal"],
+                     d.get("charset", "utf8"), d.get("collate", "utf8_bin"),
+                     d.get("elems"))
+
+
+@dataclass
+class ColumnInfo:
+    id: int
+    name: str
+    offset: int
+    field_type: FieldType
+    default_value: Any = None      # string form; None = no default
+    has_default: bool = False
+    # value returned for rows written before this column existed
+    # (reference: column.go original default; avoids ADD COLUMN backfill)
+    original_default: Any = None
+    comment: str = ""
+    state: SchemaState = SchemaState.PUBLIC
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "name": self.name, "offset": self.offset,
+                "type": _ft_to_json(self.field_type),
+                "default": self.default_value, "has_default": self.has_default,
+                "orig_default": self.original_default,
+                "comment": self.comment, "state": int(self.state)}
+
+    @staticmethod
+    def from_json(d: dict) -> "ColumnInfo":
+        return ColumnInfo(d["id"], d["name"], d["offset"], _ft_from_json(d["type"]),
+                          d.get("default"), d.get("has_default", False),
+                          d.get("orig_default"),
+                          d.get("comment", ""), SchemaState(d.get("state", 4)))
+
+    @property
+    def lower_name(self) -> str:
+        return self.name.lower()
+
+
+@dataclass
+class IndexColumn:
+    name: str
+    offset: int
+    length: int = -1  # prefix length; -1 = whole column
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "offset": self.offset, "length": self.length}
+
+    @staticmethod
+    def from_json(d: dict) -> "IndexColumn":
+        return IndexColumn(d["name"], d["offset"], d.get("length", -1))
+
+
+@dataclass
+class IndexInfo:
+    id: int
+    name: str
+    columns: list[IndexColumn]
+    unique: bool = False
+    primary: bool = False
+    state: SchemaState = SchemaState.PUBLIC
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "name": self.name,
+                "columns": [c.to_json() for c in self.columns],
+                "unique": self.unique, "primary": self.primary,
+                "state": int(self.state)}
+
+    @staticmethod
+    def from_json(d: dict) -> "IndexInfo":
+        return IndexInfo(d["id"], d["name"],
+                         [IndexColumn.from_json(c) for c in d["columns"]],
+                         d.get("unique", False), d.get("primary", False),
+                         SchemaState(d.get("state", 4)))
+
+
+@dataclass
+class TableInfo:
+    id: int
+    name: str
+    columns: list[ColumnInfo] = field(default_factory=list)
+    indices: list[IndexInfo] = field(default_factory=list)
+    pk_is_handle: bool = False     # single int PK stored as the row handle
+    auto_increment_offset: int = 0
+    charset: str = "utf8"
+    collate: str = "utf8_bin"
+    comment: str = ""
+    state: SchemaState = SchemaState.PUBLIC
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "name": self.name,
+                "columns": [c.to_json() for c in self.columns],
+                "indices": [i.to_json() for i in self.indices],
+                "pk_is_handle": self.pk_is_handle,
+                "charset": self.charset, "collate": self.collate,
+                "comment": self.comment, "state": int(self.state)}
+
+    @staticmethod
+    def from_json(d: dict) -> "TableInfo":
+        return TableInfo(d["id"], d["name"],
+                         [ColumnInfo.from_json(c) for c in d["columns"]],
+                         [IndexInfo.from_json(i) for i in d.get("indices", [])],
+                         d.get("pk_is_handle", False), 0,
+                         d.get("charset", "utf8"), d.get("collate", "utf8_bin"),
+                         d.get("comment", ""), SchemaState(d.get("state", 4)))
+
+    def serialize(self) -> bytes:
+        return json.dumps(self.to_json(), separators=(",", ":")).encode()
+
+    @staticmethod
+    def deserialize(b: bytes) -> "TableInfo":
+        return TableInfo.from_json(json.loads(b))
+
+    # ---- helpers ----
+    def find_column(self, name: str) -> ColumnInfo | None:
+        lname = name.lower()
+        for c in self.columns:
+            if c.lower_name == lname:
+                return c
+        return None
+
+    def pk_handle_column(self) -> ColumnInfo | None:
+        if not self.pk_is_handle:
+            return None
+        for c in self.columns:
+            if my.has_pri_key_flag(c.field_type.flag):
+                return c
+        return None
+
+    def public_columns(self) -> list[ColumnInfo]:
+        return [c for c in self.columns if c.state == SchemaState.PUBLIC]
+
+    def writable_columns(self) -> list[ColumnInfo]:
+        return [c for c in self.columns
+                if c.state in (SchemaState.WRITE_ONLY, SchemaState.WRITE_REORG,
+                               SchemaState.PUBLIC)]
+
+    def find_index(self, name: str) -> IndexInfo | None:
+        lname = name.lower()
+        for idx in self.indices:
+            if idx.name.lower() == lname:
+                return idx
+        return None
+
+
+@dataclass
+class DBInfo:
+    id: int
+    name: str
+    charset: str = "utf8"
+    collate: str = "utf8_bin"
+    state: SchemaState = SchemaState.PUBLIC
+
+    def serialize(self) -> bytes:
+        return json.dumps({"id": self.id, "name": self.name, "charset": self.charset,
+                           "collate": self.collate, "state": int(self.state)},
+                          separators=(",", ":")).encode()
+
+    @staticmethod
+    def deserialize(b: bytes) -> "DBInfo":
+        d = json.loads(b)
+        return DBInfo(d["id"], d["name"], d.get("charset", "utf8"),
+                      d.get("collate", "utf8_bin"), SchemaState(d.get("state", 4)))

@@ -1,0 +1,135 @@
+"""Redis-like string/hash/list structures encoded onto KV pairs.
+
+Reference: structure/ (structure.go TxStructure, hash.go, list.go). The meta
+layer stores schema metadata, ID counters, and DDL job queues through these
+primitives so everything rides ordinary transactions.
+
+Key layout (mirrors structure/structure.go encoding):
+  string: prefix + enc_bytes(key) + enc_uint(TYPE_STRING)
+  hash:   prefix + enc_bytes(key) + enc_uint(TYPE_HASH) + enc_bytes(field)
+  list:   prefix + enc_bytes(key) + enc_uint(TYPE_LIST) + enc_uint(index)
+Hash/list metadata (counts, bounds) live at the bare type key.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator
+
+from tidb_tpu import errors
+from tidb_tpu.codec import bytes_codec as bc
+from tidb_tpu.codec import number as num
+from tidb_tpu.utils import prefix_next
+
+TYPE_STRING = 1
+TYPE_HASH = 2
+TYPE_LIST = 3
+
+_LIST_META_INIT = {"left": 0, "right": 0}  # elements live at [left, right)
+
+
+class TxStructure:
+    def __init__(self, retriever, mutator, prefix: bytes = b"m"):
+        self._r = retriever
+        self._w = mutator
+        self.prefix = prefix
+
+    # ---- key encoding ----
+    def _type_key(self, key: bytes, tp: int) -> bytes:
+        buf = bytearray(self.prefix)
+        bc.encode_bytes(buf, key)
+        num.encode_u64(buf, tp)
+        return bytes(buf)
+
+    def _hash_data_key(self, key: bytes, field: bytes) -> bytes:
+        buf = bytearray(self._type_key(key, TYPE_HASH))
+        bc.encode_bytes(buf, field)
+        return bytes(buf)
+
+    def _list_item_key(self, key: bytes, index: int) -> bytes:
+        buf = bytearray(self._type_key(key, TYPE_LIST))
+        num.encode_u64(buf, num.encode_int_to_cmp_uint(index))
+        return bytes(buf)
+
+    # ---- strings ----
+    def set(self, key: bytes, value: bytes) -> None:
+        self._w.set(self._type_key(key, TYPE_STRING), value)
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._r.get_or_none(self._type_key(key, TYPE_STRING))
+
+    def inc(self, key: bytes, step: int = 1) -> int:
+        k = self._type_key(key, TYPE_STRING)
+        cur = self._r.get_or_none(k)
+        val = (int(cur) if cur else 0) + step
+        if step != 0:  # step=0 is a pure read: don't turn it into a write
+            self._w.set(k, str(val).encode())
+        return val
+
+    def clear(self, key: bytes) -> None:
+        self._w.delete(self._type_key(key, TYPE_STRING))
+
+    # ---- hashes ----
+    def hset(self, key: bytes, field: bytes, value: bytes) -> None:
+        self._w.set(self._hash_data_key(key, field), value)
+
+    def hget(self, key: bytes, field: bytes) -> bytes | None:
+        return self._r.get_or_none(self._hash_data_key(key, field))
+
+    def hdel(self, key: bytes, field: bytes) -> None:
+        self._w.delete(self._hash_data_key(key, field))
+
+    def hgetall(self, key: bytes) -> Iterator[tuple[bytes, bytes]]:
+        prefix = self._type_key(key, TYPE_HASH)
+        end = prefix_next(prefix)
+        for k, v in self._r.iterate(prefix, end):
+            field, _ = bc.decode_bytes(memoryview(k), len(prefix))
+            yield field, v
+
+    def hkeys(self, key: bytes) -> list[bytes]:
+        return [f for f, _ in self.hgetall(key)]
+
+    # ---- lists (DDL job queues: ddl/ddl_worker.go fifo) ----
+    def _list_meta(self, key: bytes) -> dict:
+        raw = self._r.get_or_none(self._type_key(key, TYPE_LIST))
+        return json.loads(raw) if raw else dict(_LIST_META_INIT)
+
+    def _save_list_meta(self, key: bytes, meta: dict) -> None:
+        mk = self._type_key(key, TYPE_LIST)
+        if meta["left"] == meta["right"]:
+            self._w.delete(mk)
+        else:
+            self._w.set(mk, json.dumps(meta).encode())
+
+    def rpush(self, key: bytes, value: bytes) -> None:
+        meta = self._list_meta(key)
+        self._w.set(self._list_item_key(key, meta["right"]), value)
+        meta["right"] += 1
+        self._save_list_meta(key, meta)
+
+    def lpop(self, key: bytes) -> bytes | None:
+        meta = self._list_meta(key)
+        if meta["left"] == meta["right"]:
+            return None
+        k = self._list_item_key(key, meta["left"])
+        v = self._r.get_or_none(k)
+        self._w.delete(k)
+        meta["left"] += 1
+        self._save_list_meta(key, meta)
+        return v
+
+    def lindex(self, key: bytes, index: int) -> bytes | None:
+        meta = self._list_meta(key)
+        if not (0 <= index < meta["right"] - meta["left"]):
+            return None
+        return self._r.get_or_none(self._list_item_key(key, meta["left"] + index))
+
+    def lset(self, key: bytes, index: int, value: bytes) -> None:
+        meta = self._list_meta(key)
+        if not (0 <= index < meta["right"] - meta["left"]):
+            raise errors.KVError("list index out of range")
+        self._w.set(self._list_item_key(key, meta["left"] + index), value)
+
+    def llen(self, key: bytes) -> int:
+        meta = self._list_meta(key)
+        return meta["right"] - meta["left"]

@@ -1,0 +1,9 @@
+"""Row-level table abstraction over KV.
+
+Reference: table/table.go:62 (Table interface), table/tables/tables.go,
+table/tables/index.go (kvIndex), table/column.go, meta/autoid.
+"""
+
+from tidb_tpu.table.tables import Table, Index  # noqa: F401
+from tidb_tpu.table.column import get_default_value, cast_value  # noqa: F401
+from tidb_tpu.table.autoid import Allocator  # noqa: F401

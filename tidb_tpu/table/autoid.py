@@ -1,0 +1,58 @@
+"""Batched auto-increment ID allocator.
+
+Reference: meta/autoid/autoid.go — allocators grab a range of IDs from meta
+in one txn (step batching) and hand them out from memory, refetching when
+exhausted. Rebase() lifts the cursor when explicit values exceed it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tidb_tpu.kv import run_in_new_txn
+from tidb_tpu.meta import Meta
+
+DEFAULT_STEP = 1000
+
+
+class Allocator:
+    def __init__(self, store, db_id: int, table_id: int, step: int = DEFAULT_STEP):
+        self.store = store
+        self.db_id = db_id
+        self.table_id = table_id
+        self.step = step
+        self._lock = threading.Lock()
+        self._base = 0
+        self._end = 0
+
+    def alloc(self) -> int:
+        with self._lock:
+            if self._base >= self._end:
+                self._refill(self.step)
+            self._base += 1
+            return self._base
+
+    def rebase(self, new_base: int) -> None:
+        """Ensure future allocations exceed new_base (explicit INSERT values)."""
+        with self._lock:
+            if new_base < self._base:
+                return
+            if new_base < self._end:
+                self._base = new_base
+                return
+
+            def bump(txn):
+                m = Meta(txn)
+                cur = m.gen_auto_table_id(self.db_id, self.table_id, 0)
+                if new_base > cur:
+                    m.gen_auto_table_id(self.db_id, self.table_id, new_base - cur)
+
+            run_in_new_txn(self.store, True, bump)
+            self._base = self._end = new_base
+
+    def _refill(self, step: int) -> None:
+        def grab(txn):
+            return Meta(txn).gen_auto_table_id(self.db_id, self.table_id, step)
+
+        end = run_in_new_txn(self.store, True, grab)
+        self._base, self._end = end - step, end

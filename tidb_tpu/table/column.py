@@ -1,0 +1,44 @@
+"""Column helpers: defaults and value casting.
+
+Reference: table/column.go (GetColDefaultValue, CastValue, CheckNotNull).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors, mysqldef as my
+from tidb_tpu.model import ColumnInfo
+from tidb_tpu.types import Datum, convert_datum, datum_from_py
+from tidb_tpu.types.datum import Kind, NULL
+
+
+def cast_value(d: Datum, col: ColumnInfo) -> Datum:
+    """Cast a datum to the column type (INSERT/UPDATE path)."""
+    return convert_datum(d, col.field_type)
+
+
+def get_default_value(col: ColumnInfo) -> Datum:
+    """Default for a column omitted from an INSERT."""
+    if col.has_default:
+        if col.default_value is None:
+            return NULL
+        dv = col.default_value
+        if isinstance(dv, str) and dv.upper() == "CURRENT_TIMESTAMP" \
+                and col.field_type.tp in (my.TypeTimestamp, my.TypeDatetime):
+            import datetime
+            from tidb_tpu.types.time_types import Time
+            return Datum(Kind.TIME, Time(datetime.datetime.now().replace(microsecond=0),
+                                         col.field_type.tp))
+        return convert_datum(datum_from_py(dv), col.field_type)
+    if my.has_auto_increment_flag(col.field_type.flag):
+        return NULL  # filled by the allocator
+    if my.has_not_null_flag(col.field_type.flag):
+        raise errors.ExecError(
+            f"Field '{col.name}' doesn't have a default value",
+            code=1364)
+    return NULL
+
+
+def check_not_null(col: ColumnInfo, d: Datum) -> None:
+    if d.kind == Kind.NULL and my.has_not_null_flag(col.field_type.flag) \
+            and not my.has_auto_increment_flag(col.field_type.flag):
+        raise errors.ExecError(f"Column '{col.name}' cannot be null", code=1048)

@@ -1,0 +1,221 @@
+"""Table and Index implementations over KV.
+
+Reference: table/tables/tables.go (AddRecord/RowWithCols/UpdateRecord/
+RemoveRecord/IterRecords) and table/tables/index.go (kvIndex create/delete/
+seek). Rows store every writable column except a pk-is-handle column (the
+handle lives in the key); NULL columns are stored explicitly so schema-change
+backfills can distinguish "missing" from NULL.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tidb_tpu import errors, tablecodec as tc
+from tidb_tpu.kv.union_store import OPT_PRESUME_KEY_NOT_EXISTS
+from tidb_tpu.model import IndexInfo, SchemaState, TableInfo
+from tidb_tpu.table.autoid import Allocator
+from tidb_tpu.table.column import cast_value, check_not_null
+from tidb_tpu.types import Datum, unflatten_datum
+from tidb_tpu.types.datum import Kind, NULL
+
+
+class Index:
+    """Secondary index over KV. Unique index value = handle; non-unique key
+    embeds the handle (tablecodec layout)."""
+
+    def __init__(self, table: "Table", info: IndexInfo):
+        self.table = table
+        self.info = info
+
+    def _values_for_row(self, row: list[Datum]) -> list[Datum]:
+        return [row[c.offset] for c in self.info.columns]
+
+    def _has_null(self, values: list[Datum]) -> bool:
+        return any(v.kind == Kind.NULL for v in values)
+
+    def create(self, txn, values: list[Datum], handle: int,
+               backfill: bool = False) -> None:
+        if self.info.unique and not self._has_null(values):
+            key = tc.encode_index_key(self.table.id, self.info.id, values, None)
+            existing = txn.get_or_none(key)
+            if existing is not None:
+                if backfill and int(existing) == handle:
+                    return  # reorg re-scan or row indexed by a concurrent writer
+                raise errors.KeyExistsError(
+                    f"Duplicate entry for key '{self.info.name}'")
+            txn.set(key, b"%d" % handle)
+        else:
+            # NULLs never collide in unique indexes (SQL semantics)
+            key = tc.encode_index_key(self.table.id, self.info.id, values, handle)
+            txn.set(key, b"0")
+
+    def delete(self, txn, values: list[Datum], handle: int) -> None:
+        if self.info.unique and not self._has_null(values):
+            key = tc.encode_index_key(self.table.id, self.info.id, values, None)
+        else:
+            key = tc.encode_index_key(self.table.id, self.info.id, values, handle)
+        txn.delete(key)
+
+    def iterate(self, retriever, start_values=None) -> Iterator[tuple[list[Datum], int]]:
+        """Yield (column datums, handle) in index order."""
+        prefix = tc.encode_index_seek_key(self.table.id, self.info.id)
+        start = prefix if start_values is None else \
+            tc.encode_index_key(self.table.id, self.info.id, start_values, None)
+        end = prefix + b"\xff" * 9
+        n = len(self.info.columns)
+        for k, v in retriever.iterate(start, end):
+            vals, suffix = tc.cut_index_key(k, n)
+            if suffix:
+                handle = tc.decode_handle_from_index_suffix(suffix)
+            else:
+                handle = int(v)
+            yield vals, handle
+
+
+class Table:
+    """Reference: table/tables/tables.go memory+kv table implementation."""
+
+    def __init__(self, info: TableInfo, store=None, db_id: int = 0):
+        self.info = info
+        self.id = info.id
+        self.store = store
+        self.db_id = db_id
+        self._alloc = Allocator(store, db_id, info.id) if store is not None else None
+        self.indices = [Index(self, ii) for ii in info.indices]
+
+    # ---- handles / auto id ----
+    def alloc_handle(self) -> int:
+        if self._alloc is None:
+            raise errors.ExecError("table has no allocator (no store bound)")
+        return self._alloc.alloc()
+
+    def rebase_auto_id(self, v: int) -> None:
+        if self._alloc is not None:
+            self._alloc.rebase(v)
+
+    # ---- writes ----
+    def add_record(self, txn, row: list[Datum], handle: int | None = None,
+                   skip_unique_check: bool = False) -> int:
+        """Insert a full row (already cast to column types, in column offset
+        order including non-public columns as NULL). Returns the handle."""
+        info = self.info
+        pk_col = info.pk_handle_column()
+        if handle is None:
+            if pk_col is not None:
+                handle = row[pk_col.offset].get_int()
+            else:
+                handle = self.alloc_handle()
+        elif self._alloc is not None and pk_col is None:
+            self._alloc.rebase(handle)
+        if pk_col is not None:
+            self.rebase_auto_id(handle)
+
+        # row key with duplicate detection (PresumeKeyNotExists lazy check:
+        # executor_write.go + union_store.go markLazyConditionPair)
+        key = tc.encode_row_key(self.id, handle)
+        if not skip_unique_check:
+            txn.set_option(OPT_PRESUME_KEY_NOT_EXISTS)
+            try:
+                txn.get(key)
+                raise errors.KeyExistsError(f"Duplicate entry '{handle}' for key 'PRIMARY'")
+            except errors.KeyNotExistsError:
+                pass
+            finally:
+                txn.del_option(OPT_PRESUME_KEY_NOT_EXISTS)
+
+        # index entries (only indexes in a writable state: online DDL)
+        for idx in self.indices:
+            if idx.info.state == SchemaState.NONE or idx.info.state == SchemaState.DELETE_ONLY:
+                continue
+            idx.create(txn, idx._values_for_row(row), handle)
+
+        col_ids, values = [], []
+        for col in info.writable_columns():
+            if pk_col is not None and col.id == pk_col.id:
+                continue  # handle lives in the key
+            col_ids.append(col.id)
+            values.append(row[col.offset])
+        txn.set(key, tc.encode_row(col_ids, values))
+        return handle
+
+    def remove_record(self, txn, handle: int, row: list[Datum]) -> None:
+        txn.delete(tc.encode_row_key(self.id, handle))
+        for idx in self.indices:
+            if idx.info.state == SchemaState.NONE:
+                continue
+            idx.delete(txn, idx._values_for_row(row), handle)
+
+    def update_record(self, txn, handle: int, old_row: list[Datum],
+                      new_row: list[Datum], touched: list[bool] | None = None) -> None:
+        info = self.info
+        for idx in self.indices:
+            if idx.info.state in (SchemaState.NONE,):
+                continue
+            old_vals = idx._values_for_row(old_row)
+            new_vals = idx._values_for_row(new_row)
+            if any(a != b for a, b in zip(old_vals, new_vals)):
+                idx.delete(txn, old_vals, handle)
+                if idx.info.state != SchemaState.DELETE_ONLY:
+                    idx.create(txn, new_vals, handle)
+        pk_col = info.pk_handle_column()
+        col_ids, values = [], []
+        for col in info.writable_columns():
+            if pk_col is not None and col.id == pk_col.id:
+                continue
+            col_ids.append(col.id)
+            values.append(new_row[col.offset])
+        txn.set(tc.encode_row_key(self.id, handle), tc.encode_row(col_ids, values))
+
+    # ---- reads ----
+    def row_with_cols(self, retriever, handle: int, cols=None) -> list[Datum]:
+        """Decode one row; cols defaults to public columns. Values are
+        unflattened to column FieldTypes (DATE vs DATETIME etc.)."""
+        info = self.info
+        cols = cols if cols is not None else info.public_columns()
+        raw = retriever.get(tc.encode_row_key(self.id, handle))
+        data = tc.decode_row(raw)
+        pk_col = info.pk_handle_column()
+        out = []
+        for col in cols:
+            if pk_col is not None and col.id == pk_col.id:
+                out.append(Datum.u64(handle) if col.field_type.is_unsigned()
+                           else Datum.i64(handle))
+            elif col.id in data:
+                out.append(unflatten_datum(data[col.id], col.field_type))
+            else:
+                out.append(_missing_col_value(col))
+        return out
+
+    def iter_records(self, retriever, start_handle: int | None = None,
+                     cols=None) -> Iterator[tuple[int, list[Datum]]]:
+        info = self.info
+        cols = cols if cols is not None else info.public_columns()
+        pk_col = info.pk_handle_column()
+        if start_handle is None:
+            start, end = tc.encode_record_range(self.id)
+        else:
+            start, end = tc.handle_range_keys(self.id, start_handle, (1 << 63) - 1)
+        for k, v in retriever.iterate(start, end):
+            _tid, handle = tc.decode_row_key(k)
+            data = tc.decode_row(v)
+            row = []
+            for col in cols:
+                if pk_col is not None and col.id == pk_col.id:
+                    row.append(Datum.u64(handle) if col.field_type.is_unsigned()
+                               else Datum.i64(handle))
+                elif col.id in data:
+                    row.append(unflatten_datum(data[col.id], col.field_type))
+                else:
+                    row.append(_missing_col_value(col))
+            yield handle, row
+
+def _missing_col_value(col) -> Datum:
+    """Value for a row written before col existed: the column's original
+    default (captured at ADD COLUMN time), else NULL. Reference:
+    table/tables.go RowWithCols missing-column branch + column original
+    default — this is what makes ADD COLUMN O(1) instead of a backfill."""
+    if col.original_default is not None:
+        from tidb_tpu.types import convert_datum, datum_from_py
+        return convert_datum(datum_from_py(col.original_default), col.field_type)
+    return NULL

@@ -6,12 +6,22 @@ overflow handling, evaluator/arith rules (ComputeArithmetic operand coercion).
 
 from __future__ import annotations
 
-from decimal import Decimal, ROUND_HALF_UP, ROUND_HALF_EVEN
+from decimal import Decimal, ROUND_HALF_UP, localcontext
 
 from tidb_tpu import errors, mysqldef as my
 from tidb_tpu.types.datum import Datum, Kind, NULL
 from tidb_tpu.types.field_type import FieldType
 from tidb_tpu.types.time_types import Duration, Time, parse_duration, parse_time
+
+
+def quantize_decimal(dec: Decimal, frac: int, rounding=ROUND_HALF_UP) -> Decimal:
+    """Quantize to `frac` fractional digits with enough context precision
+    that wide values never raise InvalidOperation (default context is only
+    28 significant digits)."""
+    q = Decimal(1).scaleb(-frac)
+    with localcontext() as ctx:
+        ctx.prec = max(dec.adjusted() + 1 + frac + 2, 28)
+        return dec.quantize(q, rounding=rounding)
 
 
 def convert_datum(d: Datum, ft: FieldType) -> Datum:
@@ -32,8 +42,7 @@ def convert_datum(d: Datum, ft: FieldType) -> Datum:
     if tp in (my.TypeNewDecimal, my.TypeDecimal):
         dec = _to_decimal(d)
         if ft.decimal is not None and ft.decimal >= 0:
-            q = Decimal(1).scaleb(-ft.decimal)
-            dec = dec.quantize(q, rounding=ROUND_HALF_UP)
+            dec = quantize_decimal(dec, ft.decimal)
         return Datum.dec(dec)
     if tp in my.STRING_TYPES:
         s = _to_string(d)
@@ -180,6 +189,11 @@ def unflatten_datum(d: Datum, ft: FieldType) -> Datum:
             return Datum(Kind.STRING, d.val.decode("utf-8", "replace"))
     if k == Kind.INT64 and ft.is_unsigned() and ft.tp == my.TypeLonglong and d.val >= 0:
         return Datum(Kind.UINT64, d.val)
+    if k == Kind.DECIMAL and ft.is_decimal() and ft.decimal >= 0:
+        # restore display scale (codec canonicalizes trailing zeros)
+        quantized = quantize_decimal(d.val, ft.decimal)
+        if d.val == quantized:
+            return Datum(Kind.DECIMAL, quantized)
     return d
 
 

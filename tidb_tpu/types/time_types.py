@@ -120,7 +120,9 @@ _TIME_RE = re.compile(
     r"^\s*(\d{4})[-/](\d{1,2})[-/](\d{1,2})"
     r"(?:[T ](\d{1,2}):(\d{1,2})(?::(\d{1,2})(?:\.(\d{1,9}))?)?)?\s*$"
 )
-_DUR_RE = re.compile(r"^\s*(-)?(?:(\d+):)?(\d{1,2}):(\d{1,2})(?:\.(\d{1,9}))?\s*$")
+# 'HH:MM[:SS]' — MySQL reads a two-part duration as hours:minutes, not
+# minutes:seconds, so the hour group is mandatory and seconds optional
+_DUR_RE = re.compile(r"^\s*(-)?(\d+):(\d{1,2})(?::(\d{1,2}))?(?:\.(\d{1,9}))?\s*$")
 
 
 def parse_time(s: str, tp: int = my.TypeDatetime, fsp: int = 6) -> Time:
@@ -158,8 +160,7 @@ def parse_duration(s: str, fsp: int = 6) -> Duration:
     if not m:
         raise errors.TypeError_(f"invalid duration literal {s!r}")
     neg, hh, mm, ss, frac = m.groups()
-    h = int(hh or 0)
-    nanos = ((h * 3600 + int(mm) * 60 + int(ss)) * 1_000_000_000)
+    nanos = ((int(hh) * 3600 + int(mm) * 60 + int(ss or 0)) * 1_000_000_000)
     if frac:
         nanos += int((frac + "0" * 9)[:9])
     if neg:

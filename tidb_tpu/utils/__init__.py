@@ -1,0 +1,16 @@
+"""Small shared utilities (reference: util/ grab-bag, only what's needed)."""
+
+from __future__ import annotations
+
+
+def prefix_next(prefix: bytes) -> bytes:
+    """Smallest key strictly greater than every key with this prefix.
+    Reference: kv/key.go Key.PrefixNext — increment with carry; if all bytes
+    are 0xFF there is no upper bound (caller treats b'' suffix as +inf)."""
+    b = bytearray(prefix)
+    for i in range(len(b) - 1, -1, -1):
+        if b[i] != 0xFF:
+            b[i] += 1
+            del b[i + 1:]
+            return bytes(b)
+    return bytes(prefix) + b"\xff"  # degenerate: unbounded tail sentinel
