@@ -1,0 +1,241 @@
+"""Parser tests (mirrors parser/parser_test.go table-driven style)."""
+
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu import errors, mysqldef as my
+from tidb_tpu import sqlast as ast
+from tidb_tpu.parser import parse, parse_one
+from tidb_tpu.sqlast import Op
+from tidb_tpu.types.datum import Kind
+
+
+def test_select_basic():
+    s = parse_one("SELECT 1")
+    assert isinstance(s, ast.SelectStmt)
+    assert s.fields[0].expr.value.get_int() == 1
+    assert s.from_ is None
+
+
+def test_select_full_shape():
+    s = parse_one(
+        "select a, b as bb, t.c, count(*) cnt from db1.t where a > 1 and b <= 2 "
+        "group by a, b having cnt > 0 order by a desc, b limit 5, 10")
+    assert isinstance(s, ast.SelectStmt)
+    assert len(s.fields) == 4
+    assert s.fields[1].as_name == "bb"
+    assert s.fields[3].as_name == "cnt"
+    assert isinstance(s.fields[3].expr, ast.AggregateFunc)
+    src = s.from_.left
+    assert isinstance(src, ast.TableSource)
+    assert src.source.db == "db1" and src.source.name == "t"
+    assert isinstance(s.where, ast.BinaryOp) and s.where.op == Op.AndAnd
+    assert len(s.group_by) == 2
+    assert s.having is not None
+    assert s.order_by[0].desc and not s.order_by[1].desc
+    assert s.limit.offset == 5 and s.limit.count == 10
+
+
+def test_select_star_and_qualified_star():
+    s = parse_one("SELECT *, t.* FROM t")
+    assert s.fields[0].wild_table == ""
+    assert s.fields[1].wild_table == "t"
+
+
+def test_operator_precedence():
+    s = parse_one("SELECT 1 + 2 * 3")
+    e = s.fields[0].expr
+    assert e.op == Op.Plus
+    assert e.right.op == Op.Mul
+    s = parse_one("SELECT NOT a = b OR c AND d")
+    e = s.fields[0].expr
+    assert e.op == Op.OrOr  # OR binds loosest
+    s = parse_one("SELECT a = b AND c = d")
+    assert s.fields[0].expr.op == Op.AndAnd
+    s = parse_one("SELECT -2 + 3")
+    assert s.fields[0].expr.op == Op.Plus
+    s = parse_one("SELECT a BETWEEN 1 AND 2 AND b")
+    assert s.fields[0].expr.op == Op.AndAnd
+    assert isinstance(s.fields[0].expr.left, ast.Between)
+
+
+def test_expression_forms():
+    s = parse_one(
+        "SELECT a IS NULL, b IS NOT NULL, c LIKE 'x%', d NOT IN (1,2), "
+        "e BETWEEN 1 AND 10, CASE WHEN a THEN 1 ELSE 2 END, f <=> NULL, "
+        "CAST(a AS SIGNED), g DIV 2, h MOD 3")
+    f = s.fields
+    assert isinstance(f[0].expr, ast.IsNull) and not f[0].expr.not_
+    assert isinstance(f[1].expr, ast.IsNull) and f[1].expr.not_
+    assert isinstance(f[2].expr, ast.PatternLike)
+    assert isinstance(f[3].expr, ast.InExpr) and f[3].expr.not_
+    assert isinstance(f[4].expr, ast.Between)
+    assert isinstance(f[5].expr, ast.CaseExpr)
+    assert f[6].expr.op == Op.NullEQ
+    assert isinstance(f[7].expr, ast.CastExpr)
+    assert f[8].expr.op == Op.IntDiv
+    assert f[9].expr.op == Op.Mod
+
+
+def test_literals():
+    s = parse_one("SELECT 42, 3.14, 1e3, 'str', \"dq\", NULL, TRUE, FALSE, x'4142'")
+    vals = [f.expr.value for f in s.fields]
+    assert vals[0].get_int() == 42
+    assert vals[1].kind == Kind.DECIMAL and vals[1].val == Decimal("3.14")
+    assert vals[2].kind == Kind.FLOAT64 and vals[2].val == 1000.0
+    assert vals[3].get_string() == "str"
+    assert vals[4].get_string() == "dq"
+    assert vals[5].kind == Kind.NULL
+    assert vals[6].get_int() == 1
+    assert vals[7].get_int() == 0
+    assert vals[8].get_bytes() == b"AB"
+
+
+def test_string_escapes():
+    s = parse_one(r"SELECT 'a\'b', 'c''d', 'e\nf'")
+    vals = [f.expr.value.get_string() for f in s.fields]
+    assert vals == ["a'b", "c'd", "e\nf"]
+
+
+def test_joins():
+    s = parse_one("SELECT * FROM t1 JOIN t2 ON t1.a = t2.a LEFT JOIN t3 ON t2.b = t3.b")
+    j = s.from_
+    assert j.tp == "left" and j.on is not None
+    assert j.left.tp == "inner"
+    s = parse_one("SELECT * FROM t1, t2")
+    assert s.from_.tp == "cross"
+
+
+def test_insert_forms():
+    s = parse_one("INSERT INTO t (a, b) VALUES (1, 'x'), (2, 'y')")
+    assert isinstance(s, ast.InsertStmt)
+    assert s.columns == ["a", "b"]
+    assert len(s.values) == 2
+    s = parse_one("INSERT INTO t VALUES (1, DEFAULT)")
+    assert isinstance(s.values[0][1], ast.DefaultExpr)
+    s = parse_one("INSERT INTO t SET a = 1, b = 'x'")
+    assert len(s.setlist) == 2
+    s = parse_one("REPLACE INTO t VALUES (1)")
+    assert s.is_replace
+    s = parse_one("INSERT INTO t (a) SELECT a FROM s")
+    assert s.select is not None
+    s = parse_one("INSERT INTO t VALUES (1) ON DUPLICATE KEY UPDATE a = 2")
+    assert len(s.on_duplicate) == 1
+
+
+def test_update_delete():
+    s = parse_one("UPDATE t SET a = a + 1 WHERE b = 2 ORDER BY c LIMIT 3")
+    assert isinstance(s, ast.UpdateStmt)
+    assert len(s.assignments) == 1 and s.limit.count == 3
+    s = parse_one("DELETE FROM t WHERE a < 5")
+    assert isinstance(s, ast.DeleteStmt)
+
+
+def test_create_table():
+    s = parse_one("""
+        CREATE TABLE IF NOT EXISTS lineitem (
+            l_orderkey BIGINT NOT NULL,
+            l_quantity DECIMAL(15,2),
+            l_shipdate DATE,
+            l_comment VARCHAR(44) DEFAULT 'none' COMMENT 'c',
+            l_flag CHAR(1),
+            id INT PRIMARY KEY AUTO_INCREMENT,
+            PRIMARY KEY (l_orderkey),
+            UNIQUE uk (l_quantity),
+            INDEX idx_ship (l_shipdate)
+        )""")
+    assert isinstance(s, ast.CreateTableStmt)
+    assert s.if_not_exists
+    assert len(s.cols) == 6
+    assert s.cols[0].tp.tp == my.TypeLonglong
+    assert s.cols[1].tp.flen == 15 and s.cols[1].tp.decimal == 2
+    assert s.cols[2].tp.tp == my.TypeDate
+    opts = {o.tp for o in s.cols[5].options}
+    assert ast.ColumnOptionType.PRIMARY_KEY in opts
+    assert ast.ColumnOptionType.AUTO_INCREMENT in opts
+    assert [c.tp for c in s.constraints] == [
+        ast.ConstraintType.PRIMARY_KEY, ast.ConstraintType.UNIQUE,
+        ast.ConstraintType.INDEX]
+
+
+def test_create_drop_database_index():
+    s = parse_one("CREATE DATABASE IF NOT EXISTS db1")
+    assert s.name == "db1" and s.if_not_exists
+    s = parse_one("DROP DATABASE db1")
+    assert isinstance(s, ast.DropDatabaseStmt)
+    s = parse_one("CREATE UNIQUE INDEX idx ON t (a, b)")
+    assert s.unique and s.columns == ["a", "b"]
+    s = parse_one("DROP INDEX idx ON t")
+    assert isinstance(s, ast.DropIndexStmt)
+    s = parse_one("DROP TABLE IF EXISTS t1, t2")
+    assert len(s.tables) == 2 and s.if_exists
+
+
+def test_alter_table():
+    s = parse_one("ALTER TABLE t ADD COLUMN c INT DEFAULT 5, DROP COLUMN d, "
+                  "ADD INDEX idx (a), DROP INDEX idx2")
+    tps = [sp.tp for sp in s.specs]
+    assert tps == [ast.AlterTableType.ADD_COLUMN, ast.AlterTableType.DROP_COLUMN,
+                   ast.AlterTableType.ADD_CONSTRAINT, ast.AlterTableType.DROP_INDEX]
+
+
+def test_txn_and_misc():
+    assert isinstance(parse_one("BEGIN"), ast.BeginStmt)
+    assert isinstance(parse_one("START TRANSACTION"), ast.BeginStmt)
+    assert isinstance(parse_one("COMMIT"), ast.CommitStmt)
+    assert isinstance(parse_one("ROLLBACK"), ast.RollbackStmt)
+    assert parse_one("USE mydb").db == "mydb"
+    s = parse_one("SET @@autocommit = 1, @uservar = 'x', GLOBAL max_connections = 10")
+    assert s.variables[0].is_system and not s.variables[0].is_global
+    assert not s.variables[1].is_system
+    assert s.variables[2].is_global
+    s = parse_one("SHOW TABLES FROM db1")
+    assert s.tp == ast.ShowType.TABLES and s.db == "db1"
+    s = parse_one("EXPLAIN SELECT 1")
+    assert isinstance(s, ast.ExplainStmt)
+    s = parse_one("ADMIN CHECK TABLE t")
+    assert s.tp == ast.AdminType.CHECK_TABLE
+    s = parse_one("TRUNCATE TABLE t")
+    assert isinstance(s, ast.TruncateTableStmt)
+
+
+def test_multi_statement():
+    stmts = parse("SELECT 1; SELECT 2;")
+    assert len(stmts) == 2
+
+
+def test_comments_ignored():
+    s = parse_one("SELECT /* comment */ 1 -- trailing\n + 2")
+    assert s.fields[0].expr.op == Op.Plus
+
+
+def test_tpch_q6_shape():
+    s = parse_one("""
+        SELECT sum(l_extendedprice * l_discount) AS revenue FROM lineitem
+        WHERE l_shipdate >= '1994-01-01' AND l_shipdate < '1995-01-01'
+          AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24""")
+    assert isinstance(s.fields[0].expr, ast.AggregateFunc)
+    assert s.fields[0].as_name == "revenue"
+
+
+def test_tpch_q1_shape():
+    s = parse_one("""
+        SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice) AS sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+               avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price,
+               avg(l_discount) AS avg_disc, count(*) AS count_order
+        FROM lineitem WHERE l_shipdate <= '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus""")
+    assert len(s.fields) == 10
+    assert len(s.group_by) == 2
+
+
+def test_parse_errors():
+    for bad in ["SELECT", "SELECT FROM t", "INSERT t VALUES", "CREATE TABLE t",
+                "SELECT * FROM t WHERE", "FOO BAR", "SELECT 'unterminated"]:
+        with pytest.raises(errors.ParseError):
+            parse_one(bad)

@@ -1,0 +1,7 @@
+"""SQL frontend: lexer + parser.
+
+Reference: parser/ (lexer.go + parser.y goyacc grammar). Hand-written
+recursive-descent/Pratt implementation; see parser/parser.py.
+"""
+
+from tidb_tpu.parser.parser import Parser, parse, parse_one  # noqa: F401

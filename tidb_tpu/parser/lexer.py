@@ -1,0 +1,232 @@
+"""SQL lexer.
+
+Reference: parser/lexer.go (hand-written scanner feeding the goyacc grammar).
+Produces a token stream: keywords (case-insensitive), identifiers (bare or
+`quoted`), string literals with '' and \\ escapes, numeric literals
+(int / decimal / float split like the reference: a '.' or exponent makes it
+non-int; decimal stays exact), operators, and ? param markers. Comments:
+--, #, /* */.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from decimal import Decimal
+
+from tidb_tpu import errors
+
+
+# token types
+EOF = "eof"
+IDENT = "ident"
+STRING = "string"
+INT = "int"
+DECIMAL = "decimal"
+FLOAT = "float"
+PARAM = "param"
+OP = "op"          # punctuation/operators; value is the literal text
+KEYWORD = "kw"     # upper-cased keyword
+HEX = "hex"
+USER_VAR = "uservar"
+SYS_VAR = "sysvar"
+
+KEYWORDS = frozenset("""
+SELECT FROM WHERE GROUP BY HAVING ORDER LIMIT OFFSET AS DISTINCT ALL
+AND OR NOT XOR IS NULL TRUE FALSE BETWEEN IN LIKE ESCAPE EXISTS
+INSERT INTO VALUES VALUE REPLACE SET UPDATE DELETE IGNORE DUPLICATE KEY
+CREATE TABLE DATABASE SCHEMA INDEX UNIQUE PRIMARY DROP ALTER ADD COLUMN
+TRUNCATE IF EXISTS CONSTRAINT DEFAULT AUTO_INCREMENT COMMENT ON
+BEGIN START TRANSACTION COMMIT ROLLBACK USE SHOW DATABASES SCHEMAS TABLES
+COLUMNS FIELDS VARIABLES WARNINGS FULL DESCRIBE DESC ASC EXPLAIN ADMIN CHECK
+JOIN INNER LEFT RIGHT OUTER CROSS USING UNION CASE WHEN THEN ELSE END CAST
+CONVERT DIV MOD INTERVAL GLOBAL SESSION FOR SHARE LOCK MODE
+TINYINT SMALLINT MEDIUMINT INT INTEGER BIGINT FLOAT DOUBLE REAL DECIMAL
+NUMERIC CHAR VARCHAR BINARY VARBINARY TEXT TINYTEXT MEDIUMTEXT LONGTEXT
+BLOB TINYBLOB MEDIUMBLOB LONGBLOB DATE TIME DATETIME TIMESTAMP YEAR BIT
+UNSIGNED SIGNED ZEROFILL ENUM CHARACTER COLLATE CHARSET ENGINE ANALYZE
+PREPARE EXECUTE DEALLOCATE GRANT REVOKE IDENTIFIED TO PRIVILEGES WITH
+""".split())
+
+_MULTI_OPS = ("<=>", "<<", ">>", "<=", ">=", "!=", "<>", "||", "&&", ":=")
+_SINGLE_OPS = set("+-*/%(),.;=<>!&|^~@?")
+
+
+@dataclass
+class Token:
+    tp: str
+    val: object
+    pos: int
+
+    def is_kw(self, *kws: str) -> bool:
+        return self.tp == KEYWORD and self.val in kws
+
+    def __repr__(self):  # pragma: no cover
+        return f"Token({self.tp}, {self.val!r})"
+
+
+def tokenize(sql: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        # comments
+        if c == "#" or (c == "-" and sql[i : i + 3] in ("-- ", "--\t", "--\n", "--\r")) \
+                or (c == "-" and sql[i : i + 2] == "--" and i + 2 >= n):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if c == "/" and sql[i : i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise errors.ParseError("unterminated comment")
+            i = j + 2
+            continue
+        # strings
+        if c in "'\"":
+            start = i
+            val, i = _scan_string(sql, i, c)
+            toks.append(Token(STRING, val, start))
+            continue
+        # quoted identifier
+        if c == "`":
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == "`":
+                    if sql[j : j + 2] == "``":
+                        buf.append("`")
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            if j >= n:
+                raise errors.ParseError("unterminated quoted identifier")
+            toks.append(Token(IDENT, "".join(buf), i))
+            i = j + 1
+            continue
+        # numbers (incl. 0x hex integer literals)
+        if c == "0" and sql[i : i + 2] in ("0x", "0X") and i + 2 < n \
+                and sql[i + 2] in "0123456789abcdefABCDEF":
+            j = i + 2
+            while j < n and sql[j] in "0123456789abcdefABCDEF":
+                j += 1
+            toks.append(Token(INT, int(sql[i + 2 : j], 16), i))
+            i = j
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            tok, i = _scan_number(sql, i)
+            toks.append(tok)
+            continue
+        # hex literal 0x / x''
+        if c in "xX" and sql[i : i + 2] in ("x'", "X'"):
+            j = sql.find("'", i + 2)
+            if j < 0:
+                raise errors.ParseError("unterminated hex literal")
+            try:
+                val = bytes.fromhex(sql[i + 2 : j])
+            except ValueError as e:
+                raise errors.ParseError(f"invalid hex literal at {i}: {e}") from e
+            toks.append(Token(HEX, val, i))
+            i = j + 1
+            continue
+        # identifiers/keywords
+        if c.isalpha() or c == "_":
+            j = i + 1
+            while j < n and (sql[j].isalnum() or sql[j] == "_" or sql[j] == "$"):
+                j += 1
+            word = sql[i:j]
+            up = word.upper()
+            if up in KEYWORDS:
+                toks.append(Token(KEYWORD, up, i))
+            else:
+                toks.append(Token(IDENT, word, i))
+            i = j
+            continue
+        # variables
+        if c == "@":
+            if sql[i : i + 2] == "@@":
+                j = i + 2
+                while j < n and (sql[j].isalnum() or sql[j] in "._"):
+                    j += 1
+                toks.append(Token(SYS_VAR, sql[i + 2 : j], i))
+                i = j
+                continue
+            j = i + 1
+            while j < n and (sql[j].isalnum() or sql[j] in "._"):
+                j += 1
+            toks.append(Token(USER_VAR, sql[i + 1 : j], i))
+            i = j
+            continue
+        # operators
+        for m in _MULTI_OPS:
+            if sql.startswith(m, i):
+                toks.append(Token(OP, m, i))
+                i += len(m)
+                break
+        else:
+            if c == "?":
+                toks.append(Token(PARAM, "?", i))
+                i += 1
+            elif c in _SINGLE_OPS:
+                toks.append(Token(OP, c, i))
+                i += 1
+            else:
+                raise errors.ParseError(f"unexpected character {c!r} at {i}")
+    toks.append(Token(EOF, None, n))
+    return toks
+
+
+def _scan_string(sql: str, i: int, quote: str) -> tuple[str, int]:
+    n = len(sql)
+    j = i + 1
+    buf: list[str] = []
+    while j < n:
+        c = sql[j]
+        if c == "\\" and j + 1 < n:
+            nxt = sql[j + 1]
+            buf.append({"n": "\n", "t": "\t", "r": "\r", "0": "\x00",
+                        "b": "\b", "Z": "\x1a"}.get(nxt, nxt))
+            j += 2
+            continue
+        if c == quote:
+            if sql[j : j + 2] == quote * 2:  # doubled quote escape
+                buf.append(quote)
+                j += 2
+                continue
+            return "".join(buf), j + 1
+        buf.append(c)
+        j += 1
+    raise errors.ParseError("unterminated string literal")
+
+
+def _scan_number(sql: str, i: int) -> tuple[Token, int]:
+    n = len(sql)
+    j = i
+    is_float = is_dec = False
+    while j < n and sql[j].isdigit():
+        j += 1
+    if j < n and sql[j] == ".":
+        # not range syntax `1..2` (unused) — treat as decimal point
+        is_dec = True
+        j += 1
+        while j < n and sql[j].isdigit():
+            j += 1
+    if j < n and sql[j] in "eE":
+        k = j + 1
+        if k < n and sql[k] in "+-":
+            k += 1
+        if k < n and sql[k].isdigit():
+            is_float = True
+            j = k
+            while j < n and sql[j].isdigit():
+                j += 1
+    text = sql[i:j]
+    if is_float:
+        return Token(FLOAT, float(text), i), j
+    if is_dec:
+        return Token(DECIMAL, Decimal(text), i), j
+    return Token(INT, int(text), i), j

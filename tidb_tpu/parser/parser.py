@@ -1,0 +1,1016 @@
+"""Recursive-descent / Pratt SQL parser.
+
+Reference: parser/parser.y (goyacc LALR grammar, 5.3k lines) + parser/yy_parser.go.
+This is a hand-written equivalent covering the engine's dialect: DDL
+(CREATE/DROP/ALTER/TRUNCATE), DML (SELECT with joins/group/order/limit,
+INSERT/REPLACE, UPDATE, DELETE), txn control, SET/USE/SHOW/EXPLAIN/ADMIN.
+Operator precedence follows MySQL. Unsupported constructs raise ParseError
+with the offending token position.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+from tidb_tpu import errors, mysqldef as my
+from tidb_tpu import sqlast as ast
+from tidb_tpu.parser import lexer as lx
+from tidb_tpu.sqlast import Op
+from tidb_tpu.types import Datum, datum_from_py
+from tidb_tpu.types.datum import NULL
+from tidb_tpu.types.field_type import FieldType, new_field_type
+
+AGG_FUNCS = frozenset(("count", "sum", "avg", "min", "max", "group_concat",
+                       "first_row"))
+
+
+def _split_sysvar_scope(name: str) -> tuple[bool, str]:
+    """'global.x' → (True, 'x'); 'session.x' → (False, 'x'); else (False, name)."""
+    low = name.lower()
+    if low.startswith("global."):
+        return True, name[7:]
+    if low.startswith("session."):
+        return False, name[8:]
+    return False, name
+
+
+class Parser:
+    """parser.New().Parse() equivalent; instances are reusable."""
+
+    def parse(self, sql: str) -> list[ast.StmtNode]:
+        self.sql = sql
+        self.toks = lx.tokenize(sql)
+        self.pos = 0
+        stmts: list[ast.StmtNode] = []
+        while not self._at(lx.EOF):
+            if self._try_op(";"):
+                continue
+            start = self.pos
+            stmt = self._parse_statement()
+            stmt.text = self._text_between(start)
+            stmts.append(stmt)
+            if not self._at(lx.EOF) and not self._try_op(";"):
+                self._fail("expected ';' between statements")
+        return stmts
+
+    def parse_one(self, sql: str) -> ast.StmtNode:
+        stmts = self.parse(sql)
+        if len(stmts) != 1:
+            raise errors.ParseError(f"expected a single statement, got {len(stmts)}")
+        return stmts[0]
+
+    # ---- token helpers ----
+    def _cur(self) -> lx.Token:
+        return self.toks[self.pos]
+
+    def _next(self) -> lx.Token:
+        t = self.toks[self.pos]
+        self.pos += 1
+        return t
+
+    def _at(self, tp: str) -> bool:
+        return self._cur().tp == tp
+
+    def _at_kw(self, *kws: str) -> bool:
+        return self._cur().is_kw(*kws)
+
+    def _try_kw(self, *kws: str) -> bool:
+        if self._at_kw(*kws):
+            self.pos += 1
+            return True
+        return False
+
+    def _expect_kw(self, *kws: str) -> str:
+        if not self._at_kw(*kws):
+            self._fail(f"expected {'/'.join(kws)}")
+        return self._next().val  # type: ignore[return-value]
+
+    def _at_op(self, *ops: str) -> bool:
+        t = self._cur()
+        return t.tp == lx.OP and t.val in ops
+
+    def _try_op(self, *ops: str) -> bool:
+        if self._at_op(*ops):
+            self.pos += 1
+            return True
+        return False
+
+    def _expect_op(self, op: str) -> None:
+        if not self._try_op(op):
+            self._fail(f"expected {op!r}")
+
+    def _ident(self, what: str = "identifier") -> str:
+        t = self._cur()
+        if t.tp == lx.IDENT:
+            self.pos += 1
+            return t.val  # type: ignore[return-value]
+        # most keywords double as identifiers in practice (non-reserved)
+        if t.tp == lx.KEYWORD and t.val not in ("SELECT", "FROM", "WHERE"):
+            self.pos += 1
+            return t.val.lower()  # type: ignore[union-attr]
+        self._fail(f"expected {what}")
+
+    def _fail(self, msg: str):
+        t = self._cur()
+        raise errors.ParseError(
+            f"{msg} near {t.val!r} (token {self.pos}, byte {t.pos})")
+
+    def _text_between(self, start_tok: int) -> str:
+        start = self.toks[start_tok].pos
+        end = self.toks[self.pos].pos if self.pos < len(self.toks) else len(self.sql)
+        return self.sql[start:end].strip()
+
+    # ---- statement dispatch ----
+    def _parse_statement(self) -> ast.StmtNode:
+        t = self._cur()
+        if t.tp != lx.KEYWORD:
+            self._fail("expected statement keyword")
+        kw = t.val
+        handlers = {
+            "SELECT": self._parse_select,
+            "INSERT": self._parse_insert,
+            "REPLACE": self._parse_insert,
+            "UPDATE": self._parse_update,
+            "DELETE": self._parse_delete,
+            "CREATE": self._parse_create,
+            "DROP": self._parse_drop,
+            "ALTER": self._parse_alter,
+            "TRUNCATE": self._parse_truncate,
+            "BEGIN": self._parse_begin,
+            "START": self._parse_begin,
+            "COMMIT": lambda: (self._next(), ast.CommitStmt())[1],
+            "ROLLBACK": lambda: (self._next(), ast.RollbackStmt())[1],
+            "USE": self._parse_use,
+            "SET": self._parse_set,
+            "SHOW": self._parse_show,
+            "EXPLAIN": self._parse_explain,
+            "DESCRIBE": self._parse_explain,
+            "DESC": self._parse_explain,
+            "ADMIN": self._parse_admin,
+        }
+        h = handlers.get(kw)  # type: ignore[arg-type]
+        if h is None:
+            self._fail(f"unsupported statement {kw}")
+        return h()
+
+    # ================= SELECT =================
+
+    def _parse_select(self) -> ast.SelectStmt:
+        self._expect_kw("SELECT")
+        stmt = ast.SelectStmt()
+        if self._try_kw("DISTINCT"):
+            stmt.distinct = True
+        else:
+            self._try_kw("ALL")
+        stmt.fields = self._parse_select_fields()
+        if self._try_kw("FROM"):
+            stmt.from_ = self._parse_table_refs()
+        if self._try_kw("WHERE"):
+            stmt.where = self._parse_expr()
+        if self._try_kw("GROUP"):
+            self._expect_kw("BY")
+            stmt.group_by = self._parse_by_items()
+        if self._try_kw("HAVING"):
+            stmt.having = self._parse_expr()
+        if self._try_kw("ORDER"):
+            self._expect_kw("BY")
+            stmt.order_by = self._parse_by_items()
+        stmt.limit = self._parse_limit()
+        if self._try_kw("FOR"):
+            self._expect_kw("UPDATE")
+            stmt.for_update = True
+        elif self._try_kw("LOCK"):
+            self._expect_kw("IN")
+            self._expect_kw("SHARE")
+            self._expect_kw("MODE")
+            stmt.lock_in_share_mode = True
+        return stmt
+
+    def _parse_select_fields(self) -> list[ast.SelectField]:
+        fields = []
+        while True:
+            if self._at_op("*"):
+                self.pos += 1
+                fields.append(ast.SelectField(wild_table=""))
+            else:
+                # qualified wildcard t.*
+                save = self.pos
+                if self._cur().tp == lx.IDENT and \
+                        self.toks[self.pos + 1].tp == lx.OP and \
+                        self.toks[self.pos + 1].val == "." and \
+                        self.toks[self.pos + 2].tp == lx.OP and \
+                        self.toks[self.pos + 2].val == "*":
+                    tname = self._ident()
+                    self.pos += 2
+                    fields.append(ast.SelectField(wild_table=tname))
+                else:
+                    self.pos = save
+                    expr = self._parse_expr()
+                    as_name = ""
+                    if self._try_kw("AS"):
+                        as_name = self._ident_or_string()
+                    elif self._cur().tp == lx.IDENT:
+                        as_name = self._ident()
+                    fields.append(ast.SelectField(expr=expr, as_name=as_name))
+            if not self._try_op(","):
+                return fields
+
+    def _ident_or_string(self) -> str:
+        if self._at(lx.STRING):
+            return self._next().val  # type: ignore[return-value]
+        return self._ident()
+
+    def _parse_table_refs(self) -> ast.Join:
+        left = self._parse_table_factor()
+        node = ast.Join(left=left)
+        while True:
+            if self._try_op(","):
+                right = self._parse_table_factor()
+                node = ast.Join(left=node, right=right, tp="cross")
+                continue
+            tp = None
+            if self._try_kw("JOIN") or (self._try_kw("INNER") and self._expect_kw("JOIN")):
+                tp = "inner"
+            elif self._at_kw("LEFT", "RIGHT"):
+                side = self._next().val
+                self._try_kw("OUTER")
+                self._expect_kw("JOIN")
+                tp = side.lower()  # type: ignore[union-attr]
+            elif self._try_kw("CROSS"):
+                self._expect_kw("JOIN")
+                tp = "cross"
+            if tp is None:
+                return node
+            right = self._parse_table_factor()
+            on = None
+            if self._try_kw("ON"):
+                on = self._parse_expr()
+            node = ast.Join(left=node, right=right, tp=tp, on=on)
+
+    def _parse_table_factor(self) -> ast.Node:
+        if self._try_op("("):
+            inner = self._parse_table_refs()
+            self._expect_op(")")
+            return inner
+        name = self._ident("table name")
+        db = ""
+        if self._try_op("."):
+            db, name = name, self._ident("table name")
+        tn = ast.TableName(name=name, db=db)
+        as_name = ""
+        if self._try_kw("AS"):
+            as_name = self._ident()
+        elif self._cur().tp == lx.IDENT:
+            as_name = self._ident()
+        return ast.TableSource(source=tn, as_name=as_name)
+
+    def _parse_by_items(self) -> list[ast.ByItem]:
+        items = []
+        while True:
+            expr = self._parse_expr()
+            desc = False
+            if self._try_kw("DESC"):
+                desc = True
+            else:
+                self._try_kw("ASC")
+            items.append(ast.ByItem(expr=expr, desc=desc))
+            if not self._try_op(","):
+                return items
+
+    def _parse_limit(self) -> ast.Limit | None:
+        if not self._try_kw("LIMIT"):
+            return None
+        first = self._int_literal()
+        if self._try_op(","):
+            return ast.Limit(count=self._int_literal(), offset=first)
+        if self._try_kw("OFFSET"):
+            return ast.Limit(count=first, offset=self._int_literal())
+        return ast.Limit(count=first)
+
+    def _int_literal(self) -> int:
+        t = self._cur()
+        if t.tp != lx.INT:
+            self._fail("expected integer literal")
+        self.pos += 1
+        return t.val  # type: ignore[return-value]
+
+    # ================= INSERT / UPDATE / DELETE =================
+
+    def _parse_insert(self) -> ast.InsertStmt:
+        stmt = ast.InsertStmt()
+        if self._try_kw("REPLACE"):
+            stmt.is_replace = True
+        else:
+            self._expect_kw("INSERT")
+        if self._try_kw("IGNORE"):
+            stmt.ignore = True
+        self._try_kw("INTO")
+        stmt.table = self._parse_table_name()
+        if self._try_kw("SET"):
+            stmt.setlist = self._parse_assignments()
+            self._parse_on_duplicate(stmt)
+            return stmt
+        if self._at_op("("):
+            # could be a column list or a parenthesized SELECT
+            save = self.pos
+            self.pos += 1
+            if self._at_kw("SELECT"):
+                stmt.select = self._parse_select()
+                self._expect_op(")")
+                self._parse_on_duplicate(stmt)
+                return stmt
+            else:
+                cols = []
+                while True:
+                    cols.append(self._ident("column name"))
+                    if not self._try_op(","):
+                        break
+                self._expect_op(")")
+                stmt.columns = cols
+        if self._at_kw("SELECT"):
+            stmt.select = self._parse_select()
+        else:
+            self._expect_kw("VALUES", "VALUE")
+            while True:
+                self._expect_op("(")
+                row: list[ast.ExprNode] = []
+                if not self._at_op(")"):
+                    while True:
+                        if self._try_kw("DEFAULT"):
+                            row.append(ast.DefaultExpr())
+                        else:
+                            row.append(self._parse_expr())
+                        if not self._try_op(","):
+                            break
+                self._expect_op(")")
+                stmt.values.append(row)
+                if not self._try_op(","):
+                    break
+        self._parse_on_duplicate(stmt)
+        return stmt
+
+    def _parse_on_duplicate(self, stmt: ast.InsertStmt) -> None:
+        if self._try_kw("ON"):
+            self._expect_kw("DUPLICATE")
+            self._expect_kw("KEY")
+            self._expect_kw("UPDATE")
+            stmt.on_duplicate = self._parse_assignments()
+
+    def _parse_column_name(self) -> ast.ColumnName:
+        name = self._ident("column name")
+        table = db = ""
+        if self._try_op("."):
+            table, name = name, self._ident("column name")
+            if self._try_op("."):
+                db, table, name = table, name, self._ident("column name")
+        return ast.ColumnName(name=name, table=table, db=db)
+
+    def _parse_assignments(self) -> list[ast.Assignment]:
+        out = []
+        while True:
+            col = self._parse_column_name()
+            self._expect_op("=")
+            expr = self._parse_expr()
+            out.append(ast.Assignment(column=col, expr=expr))
+            if not self._try_op(","):
+                return out
+
+    def _parse_update(self) -> ast.UpdateStmt:
+        self._expect_kw("UPDATE")
+        stmt = ast.UpdateStmt()
+        stmt.table = self._parse_table_name()
+        self._expect_kw("SET")
+        stmt.assignments = self._parse_assignments()
+        if self._try_kw("WHERE"):
+            stmt.where = self._parse_expr()
+        if self._try_kw("ORDER"):
+            self._expect_kw("BY")
+            stmt.order_by = self._parse_by_items()
+        stmt.limit = self._parse_limit()
+        return stmt
+
+    def _parse_delete(self) -> ast.DeleteStmt:
+        self._expect_kw("DELETE")
+        self._expect_kw("FROM")
+        stmt = ast.DeleteStmt()
+        stmt.table = self._parse_table_name()
+        if self._try_kw("WHERE"):
+            stmt.where = self._parse_expr()
+        if self._try_kw("ORDER"):
+            self._expect_kw("BY")
+            stmt.order_by = self._parse_by_items()
+        stmt.limit = self._parse_limit()
+        return stmt
+
+    def _parse_table_name(self) -> ast.TableName:
+        name = self._ident("table name")
+        db = ""
+        if self._try_op("."):
+            db, name = name, self._ident("table name")
+        return ast.TableName(name=name, db=db)
+
+    # ================= DDL =================
+
+    def _parse_create(self) -> ast.StmtNode:
+        self._expect_kw("CREATE")
+        if self._try_kw("DATABASE", "SCHEMA"):
+            ine = self._parse_if_not_exists()
+            return ast.CreateDatabaseStmt(name=self._ident(), if_not_exists=ine)
+        if self._at_kw("UNIQUE", "INDEX"):
+            unique = self._try_kw("UNIQUE")
+            self._expect_kw("INDEX")
+            iname = self._ident("index name")
+            self._expect_kw("ON")
+            table = self._parse_table_name()
+            self._expect_op("(")
+            cols = []
+            while True:
+                cols.append(self._ident("column name"))
+                if not self._try_op(","):
+                    break
+            self._expect_op(")")
+            return ast.CreateIndexStmt(index_name=iname, table=table,
+                                       columns=cols, unique=unique)
+        self._expect_kw("TABLE")
+        ine = self._parse_if_not_exists()
+        table = self._parse_table_name()
+        stmt = ast.CreateTableStmt(table=table, if_not_exists=ine)
+        self._expect_op("(")
+        while True:
+            if self._at_kw("PRIMARY", "UNIQUE", "INDEX", "KEY", "CONSTRAINT"):
+                stmt.constraints.append(self._parse_constraint())
+            else:
+                stmt.cols.append(self._parse_column_def())
+            if not self._try_op(","):
+                break
+        self._expect_op(")")
+        # table options (ENGINE=, CHARSET=, COMMENT=...) — parse & ignore
+        while self._cur().tp in (lx.KEYWORD, lx.IDENT) and not self._at(lx.EOF) \
+                and not self._at_op(";"):
+            self._next()
+            if self._try_op("="):
+                self._next()
+        return stmt
+
+    def _parse_if_not_exists(self) -> bool:
+        if self._try_kw("IF"):
+            self._expect_kw("NOT")
+            self._expect_kw("EXISTS")
+            return True
+        return False
+
+    def _parse_constraint(self) -> ast.Constraint:
+        if self._try_kw("CONSTRAINT"):
+            if self._cur().tp == lx.IDENT:
+                self._ident()  # constraint symbol (ignored)
+        if self._try_kw("PRIMARY"):
+            self._expect_kw("KEY")
+            tp = ast.ConstraintType.PRIMARY_KEY
+            name = "primary"
+        elif self._try_kw("UNIQUE"):
+            self._try_kw("KEY", "INDEX")
+            tp = ast.ConstraintType.UNIQUE
+            name = self._ident("index name") if self._cur().tp == lx.IDENT else ""
+        else:
+            self._expect_kw("INDEX", "KEY")
+            tp = ast.ConstraintType.INDEX
+            name = self._ident("index name") if self._cur().tp == lx.IDENT else ""
+        self._expect_op("(")
+        keys = []
+        while True:
+            keys.append(self._ident("column name"))
+            if self._try_op("("):  # prefix length — parsed, ignored for now
+                self._int_literal()
+                self._expect_op(")")
+            if not self._try_op(","):
+                break
+        self._expect_op(")")
+        return ast.Constraint(tp=tp, name=name, keys=keys)
+
+    def _parse_column_def(self) -> ast.ColumnDef:
+        name = self._ident("column name")
+        ftype = self._parse_field_type()
+        col = ast.ColumnDef(name=name, tp=ftype)
+        while True:
+            if self._try_kw("NOT"):
+                self._expect_kw("NULL")
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.NOT_NULL))
+            elif self._try_kw("NULL"):
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.NULL))
+            elif self._try_kw("DEFAULT"):
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.DEFAULT,
+                                                    expr=self._parse_expr()))
+            elif self._try_kw("AUTO_INCREMENT"):
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.AUTO_INCREMENT))
+            elif self._try_kw("PRIMARY"):
+                self._expect_kw("KEY")
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.PRIMARY_KEY))
+            elif self._try_kw("UNIQUE"):
+                self._try_kw("KEY")
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.UNIQUE_KEY))
+            elif self._try_kw("COMMENT"):
+                t = self._next()
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.COMMENT,
+                                                    comment=str(t.val)))
+            elif self._try_kw("ON"):
+                self._expect_kw("UPDATE")
+                self._next()  # CURRENT_TIMESTAMP etc.
+                col.options.append(ast.ColumnOption(ast.ColumnOptionType.ON_UPDATE))
+            elif self._try_kw("CHARACTER"):
+                self._expect_kw("SET")
+                self._ident()
+            elif self._try_kw("COLLATE"):
+                self._ident()
+            else:
+                return col
+
+    _TYPE_MAP = {
+        "TINYINT": my.TypeTiny, "SMALLINT": my.TypeShort, "MEDIUMINT": my.TypeInt24,
+        "INT": my.TypeLong, "INTEGER": my.TypeLong, "BIGINT": my.TypeLonglong,
+        "FLOAT": my.TypeFloat, "DOUBLE": my.TypeDouble, "REAL": my.TypeDouble,
+        "DECIMAL": my.TypeNewDecimal, "NUMERIC": my.TypeNewDecimal,
+        "CHAR": my.TypeString, "VARCHAR": my.TypeVarchar,
+        "BINARY": my.TypeString, "VARBINARY": my.TypeVarchar,
+        "TEXT": my.TypeBlob, "TINYTEXT": my.TypeTinyBlob,
+        "MEDIUMTEXT": my.TypeMediumBlob, "LONGTEXT": my.TypeLongBlob,
+        "BLOB": my.TypeBlob, "TINYBLOB": my.TypeTinyBlob,
+        "MEDIUMBLOB": my.TypeMediumBlob, "LONGBLOB": my.TypeLongBlob,
+        "DATE": my.TypeDate, "TIME": my.TypeDuration, "DATETIME": my.TypeDatetime,
+        "TIMESTAMP": my.TypeTimestamp, "YEAR": my.TypeYear, "BIT": my.TypeBit,
+        "ENUM": my.TypeEnum, "SET": my.TypeSet,
+    }
+
+    def _parse_field_type(self) -> FieldType:
+        t = self._cur()
+        if t.tp != lx.KEYWORD or t.val not in self._TYPE_MAP:
+            self._fail("expected column type")
+        self.pos += 1
+        tp = self._TYPE_MAP[t.val]  # type: ignore[index]
+        ft = new_field_type(tp)
+        if t.val in ("BINARY", "VARBINARY"):
+            ft.flag |= my.BinaryFlag
+        if self._try_op("("):
+            if tp in (my.TypeEnum, my.TypeSet):
+                elems = []
+                while True:
+                    elems.append(self._next().val)
+                    if not self._try_op(","):
+                        break
+                ft.elems = elems
+            else:
+                ft.flen = self._int_literal()
+                if self._try_op(","):
+                    ft.decimal = self._int_literal()
+                elif tp == my.TypeNewDecimal:
+                    ft.decimal = 0
+            self._expect_op(")")
+        elif tp == my.TypeNewDecimal:
+            ft.flen, ft.decimal = 10, 0
+        while True:
+            if self._try_kw("UNSIGNED"):
+                ft.flag |= my.UnsignedFlag
+            elif self._try_kw("SIGNED"):
+                pass
+            elif self._try_kw("ZEROFILL"):
+                ft.flag |= my.ZerofillFlag | my.UnsignedFlag
+            elif self._try_kw("BINARY"):
+                ft.flag |= my.BinaryFlag
+            else:
+                return ft
+
+    def _parse_drop(self) -> ast.StmtNode:
+        self._expect_kw("DROP")
+        if self._try_kw("DATABASE", "SCHEMA"):
+            ie = self._parse_if_exists()
+            return ast.DropDatabaseStmt(name=self._ident(), if_exists=ie)
+        if self._try_kw("INDEX"):
+            iname = self._ident("index name")
+            self._expect_kw("ON")
+            return ast.DropIndexStmt(index_name=iname, table=self._parse_table_name())
+        self._expect_kw("TABLE")
+        ie = self._parse_if_exists()
+        tables = [self._parse_table_name()]
+        while self._try_op(","):
+            tables.append(self._parse_table_name())
+        return ast.DropTableStmt(tables=tables, if_exists=ie)
+
+    def _parse_if_exists(self) -> bool:
+        if self._try_kw("IF"):
+            self._expect_kw("EXISTS")
+            return True
+        return False
+
+    def _parse_alter(self) -> ast.AlterTableStmt:
+        self._expect_kw("ALTER")
+        self._expect_kw("TABLE")
+        stmt = ast.AlterTableStmt(table=self._parse_table_name())
+        while True:
+            if self._try_kw("ADD"):
+                if self._try_kw("COLUMN"):
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.ADD_COLUMN,
+                        column=self._parse_column_def()))
+                elif self._at_kw("PRIMARY", "UNIQUE", "INDEX", "KEY", "CONSTRAINT"):
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.ADD_CONSTRAINT,
+                        constraint=self._parse_constraint()))
+                else:
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.ADD_COLUMN,
+                        column=self._parse_column_def()))
+            elif self._try_kw("DROP"):
+                if self._try_kw("COLUMN"):
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.DROP_COLUMN, name=self._ident()))
+                elif self._try_kw("INDEX", "KEY"):
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.DROP_INDEX, name=self._ident()))
+                elif self._try_kw("PRIMARY"):
+                    self._expect_kw("KEY")
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.DROP_PRIMARY_KEY))
+                else:
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.DROP_COLUMN, name=self._ident()))
+            else:
+                self._fail("expected ADD or DROP in ALTER TABLE")
+            if not self._try_op(","):
+                return stmt
+
+    def _parse_truncate(self) -> ast.TruncateTableStmt:
+        self._expect_kw("TRUNCATE")
+        self._try_kw("TABLE")
+        return ast.TruncateTableStmt(table=self._parse_table_name())
+
+    # ================= misc =================
+
+    def _parse_begin(self) -> ast.BeginStmt:
+        if self._try_kw("START"):
+            self._expect_kw("TRANSACTION")
+        else:
+            self._expect_kw("BEGIN")
+        return ast.BeginStmt()
+
+    def _parse_use(self) -> ast.UseStmt:
+        self._expect_kw("USE")
+        return ast.UseStmt(db=self._ident("database name"))
+
+    def _parse_set(self) -> ast.SetStmt:
+        self._expect_kw("SET")
+        stmt = ast.SetStmt()
+        while True:
+            is_global, is_system = False, False
+            if self._try_kw("GLOBAL"):
+                is_global, is_system = True, True
+            elif self._try_kw("SESSION"):
+                is_system = True
+            t = self._cur()
+            if t.tp == lx.SYS_VAR:
+                self.pos += 1
+                is_system = True
+                scoped_global, name = _split_sysvar_scope(t.val)
+                is_global = is_global or scoped_global
+            elif t.tp == lx.USER_VAR:
+                self.pos += 1
+                name, is_system = t.val, False  # type: ignore[assignment]
+            else:
+                name = self._ident("variable name")
+                is_system = True
+            if not self._try_op("="):
+                self._expect_op(":=")
+            value = self._parse_expr()
+            stmt.variables.append(ast.VariableAssignment(
+                name=name, value=value, is_global=is_global, is_system=is_system))
+            if not self._try_op(","):
+                return stmt
+
+    def _parse_show(self) -> ast.ShowStmt:
+        self._expect_kw("SHOW")
+        full = self._try_kw("FULL")
+        if self._try_kw("DATABASES", "SCHEMAS"):
+            return ast.ShowStmt(tp=ast.ShowType.DATABASES, full=full)
+        if self._try_kw("TABLES"):
+            db = ""
+            if self._try_kw("FROM", "IN"):
+                db = self._ident()
+            return ast.ShowStmt(tp=ast.ShowType.TABLES, db=db, full=full)
+        if self._try_kw("COLUMNS", "FIELDS"):
+            self._expect_kw("FROM", "IN")
+            table = self._parse_table_name()
+            return ast.ShowStmt(tp=ast.ShowType.COLUMNS, table=table, full=full)
+        if self._try_kw("VARIABLES"):
+            pattern = ""
+            if self._try_kw("LIKE"):
+                pattern = str(self._next().val)
+            return ast.ShowStmt(tp=ast.ShowType.VARIABLES, pattern=pattern)
+        if self._try_kw("WARNINGS"):
+            return ast.ShowStmt(tp=ast.ShowType.WARNINGS)
+        if self._try_kw("CREATE"):
+            self._expect_kw("TABLE")
+            return ast.ShowStmt(tp=ast.ShowType.CREATE_TABLE,
+                                table=self._parse_table_name())
+        if self._try_kw("INDEX"):
+            self._expect_kw("FROM", "IN")
+            return ast.ShowStmt(tp=ast.ShowType.INDEXES,
+                                table=self._parse_table_name())
+        self._fail("unsupported SHOW")
+
+    def _parse_explain(self) -> ast.StmtNode:
+        self._next()  # EXPLAIN/DESCRIBE/DESC
+        if self._cur().tp == lx.KEYWORD and self._at_kw("SELECT", "INSERT", "UPDATE",
+                                                        "DELETE"):
+            return ast.ExplainStmt(stmt=self._parse_statement())
+        # DESCRIBE table → SHOW COLUMNS
+        return ast.ShowStmt(tp=ast.ShowType.COLUMNS, table=self._parse_table_name())
+
+    def _parse_admin(self) -> ast.AdminStmt:
+        self._expect_kw("ADMIN")
+        if self._try_kw("SHOW"):
+            self._ident("ddl")  # ADMIN SHOW DDL
+            return ast.AdminStmt(tp=ast.AdminType.SHOW_DDL)
+        self._expect_kw("CHECK")
+        self._expect_kw("TABLE")
+        tables = [self._parse_table_name()]
+        while self._try_op(","):
+            tables.append(self._parse_table_name())
+        return ast.AdminStmt(tp=ast.AdminType.CHECK_TABLE, tables=tables)
+
+    # ================= expressions (Pratt) =================
+    # binding powers, low → high (MySQL precedence)
+    _BP_OR = 10
+    _BP_XOR = 15
+    _BP_AND = 20
+    _BP_NOT = 25
+    _BP_CMP = 30       # = != < <= > >= <=> IS LIKE IN BETWEEN
+    _BP_BITOR = 40
+    _BP_BITAND = 45
+    _BP_SHIFT = 50
+    _BP_ADD = 55
+    _BP_MUL = 60
+    _BP_BITXOR = 65
+    _BP_UNARY = 70
+
+    def _parse_expr(self, rbp: int = 0) -> ast.ExprNode:
+        left = self._parse_prefix()
+        while True:
+            bp, parse_infix = self._infix(rbp)
+            if parse_infix is None:
+                return left
+            left = parse_infix(left)
+
+    def _infix(self, rbp: int):
+        t = self._cur()
+        if t.tp == lx.KEYWORD:
+            kw = t.val
+            if kw == "OR" and rbp < self._BP_OR:
+                return self._BP_OR, self._binary(Op.OrOr, self._BP_OR)
+            if kw == "XOR" and rbp < self._BP_XOR:
+                return self._BP_XOR, self._binary(Op.Xor, self._BP_XOR)
+            if kw == "AND" and rbp < self._BP_AND:
+                return self._BP_AND, self._binary(Op.AndAnd, self._BP_AND)
+            if kw in ("IS", "LIKE", "IN", "BETWEEN", "NOT") and rbp < self._BP_CMP:
+                return self._BP_CMP, self._cmp_keyword
+            if kw == "DIV" and rbp < self._BP_MUL:
+                return self._BP_MUL, self._binary(Op.IntDiv, self._BP_MUL)
+            if kw == "MOD" and rbp < self._BP_MUL:
+                return self._BP_MUL, self._binary(Op.Mod, self._BP_MUL)
+            return 0, None
+        if t.tp != lx.OP:
+            return 0, None
+        op = t.val
+        table = {
+            "||": (self._BP_OR, Op.OrOr), "&&": (self._BP_AND, Op.AndAnd),
+            "=": (self._BP_CMP, Op.EQ), "!=": (self._BP_CMP, Op.NE),
+            "<>": (self._BP_CMP, Op.NE), "<": (self._BP_CMP, Op.LT),
+            "<=": (self._BP_CMP, Op.LE), ">": (self._BP_CMP, Op.GT),
+            ">=": (self._BP_CMP, Op.GE), "<=>": (self._BP_CMP, Op.NullEQ),
+            "|": (self._BP_BITOR, Op.BitOr), "&": (self._BP_BITAND, Op.BitAnd),
+            "<<": (self._BP_SHIFT, Op.LeftShift), ">>": (self._BP_SHIFT, Op.RightShift),
+            "+": (self._BP_ADD, Op.Plus), "-": (self._BP_ADD, Op.Minus),
+            "*": (self._BP_MUL, Op.Mul), "/": (self._BP_MUL, Op.Div),
+            "%": (self._BP_MUL, Op.Mod), "^": (self._BP_BITXOR, Op.BitXor),
+        }
+        ent = table.get(op)  # type: ignore[arg-type]
+        if ent is None or rbp >= ent[0]:
+            return 0, None
+        return ent[0], self._binary(ent[1], ent[0])
+
+    def _binary(self, op: Op, bp: int):
+        def go(left: ast.ExprNode) -> ast.ExprNode:
+            self.pos += 1
+            right = self._parse_expr(bp)
+            return ast.BinaryOp(op=op, left=left, right=right)
+        return go
+
+    def _cmp_keyword(self, left: ast.ExprNode) -> ast.ExprNode:
+        if self._try_kw("IS"):
+            not_ = self._try_kw("NOT")
+            if self._try_kw("NULL"):
+                return ast.IsNull(expr=left, not_=not_)
+            if self._try_kw("TRUE"):
+                cmp = ast.BinaryOp(op=Op.EQ, left=left,
+                                   right=ast.Literal(Datum.i64(1)))
+                return ast.UnaryOp(op=Op.UnaryNot, operand=cmp) if not_ else cmp
+            if self._try_kw("FALSE"):
+                cmp = ast.BinaryOp(op=Op.EQ, left=left,
+                                   right=ast.Literal(Datum.i64(0)))
+                return ast.UnaryOp(op=Op.UnaryNot, operand=cmp) if not_ else cmp
+            self._fail("expected NULL/TRUE/FALSE after IS")
+        not_ = self._try_kw("NOT")
+        if self._try_kw("LIKE"):
+            pat = self._parse_expr(self._BP_CMP)
+            esc = "\\"
+            if self._try_kw("ESCAPE"):
+                esc = str(self._next().val)
+            return ast.PatternLike(expr=left, pattern=pat, not_=not_, escape=esc)
+        if self._try_kw("IN"):
+            self._expect_op("(")
+            items = []
+            while True:
+                items.append(self._parse_expr())
+                if not self._try_op(","):
+                    break
+            self._expect_op(")")
+            return ast.InExpr(expr=left, items=items, not_=not_)
+        if self._try_kw("BETWEEN"):
+            low = self._parse_expr(self._BP_CMP)
+            self._expect_kw("AND")
+            high = self._parse_expr(self._BP_CMP)
+            return ast.Between(expr=left, low=low, high=high, not_=not_)
+        self._fail("expected LIKE/IN/BETWEEN")
+
+    def _parse_prefix(self) -> ast.ExprNode:
+        t = self._cur()
+        # literals
+        if t.tp in (lx.INT, lx.FLOAT, lx.STRING):
+            self.pos += 1
+            return ast.Literal(datum_from_py(t.val))
+        if t.tp == lx.DECIMAL:
+            self.pos += 1
+            return ast.Literal(Datum.dec(t.val))
+        if t.tp == lx.HEX:
+            self.pos += 1
+            return ast.Literal(Datum.bytes_(t.val))
+        if t.tp == lx.PARAM:
+            self.pos += 1
+            return ast.ParamMarker()
+        if t.tp == lx.SYS_VAR:
+            self.pos += 1
+            is_global, name = _split_sysvar_scope(t.val)
+            return ast.VariableExpr(name=name, is_global=is_global, is_system=True)
+        if t.tp == lx.USER_VAR:
+            self.pos += 1
+            return ast.VariableExpr(name=t.val, is_system=False)
+        if t.tp == lx.KEYWORD:
+            if self._try_kw("NULL"):
+                return ast.Literal(NULL)
+            if self._try_kw("TRUE"):
+                return ast.Literal(Datum.i64(1))
+            if self._try_kw("FALSE"):
+                return ast.Literal(Datum.i64(0))
+            if self._try_kw("NOT"):
+                return ast.UnaryOp(op=Op.UnaryNot,
+                                   operand=self._parse_expr(self._BP_NOT))
+            if self._try_kw("CASE"):
+                return self._parse_case()
+            if self._try_kw("EXISTS"):
+                self._fail("subqueries are not supported yet")
+            if self._try_kw("CAST"):
+                self._expect_op("(")
+                expr = self._parse_expr()
+                self._expect_kw("AS")
+                ftype = self._parse_cast_type()
+                self._expect_op(")")
+                return ast.CastExpr(expr=expr, cast_type=ftype)
+            if self._try_kw("CONVERT"):
+                self._expect_op("(")
+                expr = self._parse_expr()
+                self._expect_op(",")
+                ftype = self._parse_cast_type()
+                self._expect_op(")")
+                return ast.CastExpr(expr=expr, cast_type=ftype)
+            if self._try_kw("DEFAULT"):
+                return ast.DefaultExpr()
+            if self._try_kw("INTERVAL"):
+                self._fail("INTERVAL expressions not supported yet")
+            # keyword usable as function name: LEFT(...), RIGHT(...)
+            if self.toks[self.pos + 1].tp == lx.OP and self.toks[self.pos + 1].val == "(":
+                name = self._next().val.lower()  # type: ignore[union-attr]
+                return self._parse_func_call(name)
+            self._fail(f"unexpected keyword {t.val} in expression")
+        if t.tp == lx.OP:
+            if self._try_op("("):
+                expr = self._parse_expr()
+                if self._try_op(","):
+                    row = ast.RowExpr(values=[expr])
+                    while True:
+                        row.values.append(self._parse_expr())
+                        if not self._try_op(","):
+                            break
+                    self._expect_op(")")
+                    return row
+                self._expect_op(")")
+                return expr
+            if self._try_op("-"):
+                return ast.UnaryOp(op=Op.UnaryMinus,
+                                   operand=self._parse_expr(self._BP_UNARY))
+            if self._try_op("+"):
+                return ast.UnaryOp(op=Op.UnaryPlus,
+                                   operand=self._parse_expr(self._BP_UNARY))
+            if self._try_op("!"):
+                return ast.UnaryOp(op=Op.UnaryNot,
+                                   operand=self._parse_expr(self._BP_UNARY))
+            if self._try_op("~"):
+                return ast.UnaryOp(op=Op.BitNeg,
+                                   operand=self._parse_expr(self._BP_UNARY))
+            self._fail("unexpected operator in expression")
+        if t.tp == lx.IDENT:
+            name = self._ident()
+            if self._at_op("("):
+                return self._parse_func_call(name.lower())
+            # qualified column
+            if self._try_op("."):
+                second = self._ident()
+                if self._try_op("."):
+                    third = self._ident()
+                    return ast.ColumnName(name=third, table=second, db=name)
+                return ast.ColumnName(name=second, table=name)
+            return ast.ColumnName(name=name)
+        self._fail("unexpected token in expression")
+
+    def _parse_cast_type(self) -> FieldType:
+        t = self._cur()
+        mapping = {"SIGNED": (my.TypeLonglong, 0),
+                   "UNSIGNED": (my.TypeLonglong, my.UnsignedFlag),
+                   "CHAR": (my.TypeVarString, 0),
+                   "BINARY": (my.TypeVarString, my.BinaryFlag),
+                   "DATE": (my.TypeDate, 0), "DATETIME": (my.TypeDatetime, 0),
+                   "TIME": (my.TypeDuration, 0),
+                   "DECIMAL": (my.TypeNewDecimal, 0)}
+        if t.tp == lx.KEYWORD and t.val in mapping:
+            self.pos += 1
+            tp, flag = mapping[t.val]  # type: ignore[index]
+            ft = new_field_type(tp)
+            ft.flag |= flag
+            if self._try_op("("):
+                ft.flen = self._int_literal()
+                if self._try_op(","):
+                    ft.decimal = self._int_literal()
+                self._expect_op(")")
+            if t.val == "UNSIGNED":
+                self._try_kw("INTEGER")
+            if t.val == "SIGNED":
+                self._try_kw("INTEGER")
+            return ft
+        self._fail("unsupported CAST target type")
+
+    def _parse_case(self) -> ast.CaseExpr:
+        case = ast.CaseExpr()
+        if not self._at_kw("WHEN"):
+            case.value = self._parse_expr()
+        while self._try_kw("WHEN"):
+            when = self._parse_expr()
+            self._expect_kw("THEN")
+            result = self._parse_expr()
+            case.when_clauses.append(ast.WhenClause(when=when, result=result))
+        if self._try_kw("ELSE"):
+            case.else_clause = self._parse_expr()
+        self._expect_kw("END")
+        if not case.when_clauses:
+            self._fail("CASE requires at least one WHEN clause")
+        return case
+
+    def _parse_func_call(self, name: str) -> ast.ExprNode:
+        self._expect_op("(")
+        if name in AGG_FUNCS:
+            distinct = self._try_kw("DISTINCT")
+            args: list[ast.ExprNode] = []
+            if self._at_op("*"):
+                if name != "count":
+                    self._fail("'*' argument only valid in COUNT")
+                self.pos += 1
+                args = [ast.Literal(Datum.i64(1))]
+            elif not self._at_op(")"):
+                while True:
+                    args.append(self._parse_expr())
+                    if not self._try_op(","):
+                        break
+            self._expect_op(")")
+            return ast.AggregateFunc(name=name, args=args, distinct=distinct)
+        args = []
+        if not self._at_op(")"):
+            while True:
+                args.append(self._parse_expr())
+                if not self._try_op(","):
+                    break
+        self._expect_op(")")
+        return ast.FuncCall(name=name, args=args)
+
+
+def parse(sql: str) -> list[ast.StmtNode]:
+    """Module-level convenience (tidb.Parse equivalent, tidb.go:102)."""
+    return Parser().parse(sql)
+
+
+def parse_one(sql: str) -> ast.StmtNode:
+    return Parser().parse_one(sql)

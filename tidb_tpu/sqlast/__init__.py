@@ -1,0 +1,29 @@
+"""AST node hierarchy.
+
+Reference: ast/ (ast.go Node/Visitor/ExprNode/StmtNode, expressions.go,
+dml.go, ddl.go, functions.go, misc.go). Python version uses dataclass nodes
+with an accept(visitor) protocol; visitors mutate in place and return the
+(possibly replaced) node, mirroring the reference's mutating visitor.
+"""
+
+from tidb_tpu.sqlast.base import Node, ExprNode, StmtNode, Visitor  # noqa: F401
+from tidb_tpu.sqlast.opcode import Op  # noqa: F401
+from tidb_tpu.sqlast.expressions import (  # noqa: F401
+    Literal, ColumnName, BinaryOp, UnaryOp, FuncCall, AggregateFunc,
+    Between, InExpr, PatternLike, IsNull, CaseExpr, WhenClause,
+    ParamMarker, RowExpr, DefaultExpr, VariableExpr, CastExpr,
+)
+from tidb_tpu.sqlast.dml import (  # noqa: F401
+    SelectStmt, SelectField, TableSource, Join, TableName, ByItem, Limit,
+    InsertStmt, UpdateStmt, DeleteStmt, Assignment,
+)
+from tidb_tpu.sqlast.ddl import (  # noqa: F401
+    CreateDatabaseStmt, DropDatabaseStmt, CreateTableStmt, DropTableStmt,
+    ColumnDef, ColumnOption, ColumnOptionType, Constraint, ConstraintType,
+    CreateIndexStmt, DropIndexStmt, AlterTableStmt, AlterTableSpec,
+    AlterTableType, TruncateTableStmt,
+)
+from tidb_tpu.sqlast.misc import (  # noqa: F401
+    BeginStmt, CommitStmt, RollbackStmt, UseStmt, SetStmt, VariableAssignment,
+    ShowStmt, ShowType, ExplainStmt, AdminStmt, AdminType,
+)

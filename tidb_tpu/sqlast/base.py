@@ -1,0 +1,68 @@
+"""Node/Visitor base protocol.
+
+Reference: ast/ast.go:26 (Node.Accept), :181 (Visitor.Enter/Leave).
+accept() walks children depth-first; Visitor.enter can skip children,
+Visitor.leave can replace the node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+class Visitor:
+    def enter(self, node: "Node") -> tuple["Node", bool]:
+        """Return (node, skip_children)."""
+        return node, False
+
+    def leave(self, node: "Node") -> tuple["Node", bool]:
+        """Return (possibly replaced node, ok). ok=False aborts the walk."""
+        return node, True
+
+
+class Node:
+    """Base AST node. Subclasses are dataclasses; children are discovered
+    from fields holding Node / list[Node]."""
+
+    def accept(self, v: Visitor) -> tuple["Node", bool]:
+        node, skip = v.enter(self)
+        if node is not self:
+            return node.accept(v)
+        if not skip:
+            for f in dataclasses.fields(self):  # type: ignore[arg-type]
+                val = getattr(self, f.name)
+                if isinstance(val, Node):
+                    new, ok = val.accept(v)
+                    if not ok:
+                        return self, False
+                    setattr(self, f.name, new)
+                elif isinstance(val, list):
+                    for i, item in enumerate(val):
+                        if isinstance(item, Node):
+                            new, ok = item.accept(v)
+                            if not ok:
+                                return self, False
+                            val[i] = new
+        return v.leave(self)
+
+    def children(self) -> list["Node"]:
+        out: list[Node] = []
+        for f in dataclasses.fields(self):  # type: ignore[arg-type]
+            val = getattr(self, f.name)
+            if isinstance(val, Node):
+                out.append(val)
+            elif isinstance(val, list):
+                out.extend(x for x in val if isinstance(x, Node))
+        return out
+
+
+class ExprNode(Node):
+    """Expression node; `ftype` is filled by type inference.
+    Reference: ast/ast.go:57 ExprNode (GetType/SetType)."""
+    ftype: Any = None
+
+
+class StmtNode(Node):
+    """Statement node. Reference: ast/ast.go:88."""
+    text: str = ""

@@ -1,0 +1,124 @@
+"""DDL statement AST nodes.
+
+Reference: ast/ddl.go (CreateTableStmt, ColumnDef, ColumnOption, Constraint,
+AlterTableStmt/AlterTableSpec, CreateIndexStmt…).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any
+
+from tidb_tpu.sqlast.base import ExprNode, Node, StmtNode
+from tidb_tpu.sqlast.dml import TableName
+
+
+class ColumnOptionType(enum.IntEnum):
+    NOT_NULL = 1
+    NULL = 2
+    DEFAULT = 3
+    AUTO_INCREMENT = 4
+    PRIMARY_KEY = 5
+    UNIQUE_KEY = 6
+    COMMENT = 7
+    ON_UPDATE = 8
+
+
+@dataclass
+class ColumnOption(Node):
+    tp: ColumnOptionType
+    expr: ExprNode | None = None
+    comment: str = ""
+
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    tp: Any = None  # FieldType
+    options: list[ColumnOption] = field(default_factory=list)
+
+
+class ConstraintType(enum.IntEnum):
+    PRIMARY_KEY = 1
+    KEY = 2
+    INDEX = 3
+    UNIQUE = 4
+    UNIQUE_KEY = 5
+    UNIQUE_INDEX = 6
+    FOREIGN_KEY = 7
+
+
+@dataclass
+class Constraint(Node):
+    tp: ConstraintType
+    name: str = ""
+    keys: list[str] = field(default_factory=list)
+
+
+@dataclass
+class CreateDatabaseStmt(StmtNode):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabaseStmt(StmtNode):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateTableStmt(StmtNode):
+    table: TableName
+    cols: list[ColumnDef] = field(default_factory=list)
+    constraints: list[Constraint] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt(StmtNode):
+    tables: list[TableName] = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTableStmt(StmtNode):
+    table: TableName = None  # type: ignore[assignment]
+
+
+@dataclass
+class CreateIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableName = None  # type: ignore[assignment]
+    columns: list[str] = field(default_factory=list)
+    unique: bool = False
+
+
+@dataclass
+class DropIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableName = None  # type: ignore[assignment]
+    if_exists: bool = False
+
+
+class AlterTableType(enum.IntEnum):
+    ADD_COLUMN = 1
+    DROP_COLUMN = 2
+    ADD_CONSTRAINT = 3  # add index/key
+    DROP_INDEX = 4
+    DROP_PRIMARY_KEY = 5
+
+
+@dataclass
+class AlterTableSpec(Node):
+    tp: AlterTableType
+    column: ColumnDef | None = None
+    constraint: Constraint | None = None
+    name: str = ""
+
+
+@dataclass
+class AlterTableStmt(StmtNode):
+    table: TableName = None  # type: ignore[assignment]
+    specs: list[AlterTableSpec] = field(default_factory=list)

@@ -1,0 +1,103 @@
+"""DML statement AST nodes.
+
+Reference: ast/dml.go (SelectStmt, Join, TableSource, InsertStmt,
+UpdateStmt, DeleteStmt, Limit, ByItem…).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tidb_tpu.sqlast.base import ExprNode, Node, StmtNode
+
+
+@dataclass
+class TableName(Node):
+    name: str
+    db: str = ""
+
+
+@dataclass
+class TableSource(Node):
+    """Table reference with optional alias; source may later be a subquery."""
+    source: Node
+    as_name: str = ""
+
+
+@dataclass
+class Join(Node):
+    """Join tree; right None = single table. tp: 'cross'|'inner'|'left'|'right'."""
+    left: Node
+    right: Node | None = None
+    tp: str = "cross"
+    on: ExprNode | None = None
+
+
+@dataclass
+class SelectField(Node):
+    """One item of the select list; wildcard if wild_table is not None
+    (empty string = bare '*')."""
+    expr: ExprNode | None = None
+    as_name: str = ""
+    wild_table: str | None = None
+
+
+@dataclass
+class ByItem(Node):
+    expr: ExprNode
+    desc: bool = False
+
+
+@dataclass
+class Limit(Node):
+    count: int
+    offset: int = 0
+
+
+@dataclass
+class SelectStmt(StmtNode):
+    fields: list[SelectField] = field(default_factory=list)
+    from_: Join | None = None
+    where: ExprNode | None = None
+    group_by: list[ByItem] = field(default_factory=list)
+    having: ExprNode | None = None
+    order_by: list[ByItem] = field(default_factory=list)
+    limit: Limit | None = None
+    distinct: bool = False
+    for_update: bool = False
+    lock_in_share_mode: bool = False
+
+
+@dataclass
+class Assignment(Node):
+    column: Node  # ColumnName
+    expr: ExprNode
+
+
+@dataclass
+class InsertStmt(StmtNode):
+    table: TableName = None  # type: ignore[assignment]
+    columns: list[str] = field(default_factory=list)
+    values: list[list[ExprNode]] = field(default_factory=list)
+    setlist: list[Assignment] = field(default_factory=list)
+    select: SelectStmt | None = None
+    is_replace: bool = False
+    ignore: bool = False
+    on_duplicate: list[Assignment] = field(default_factory=list)
+
+
+@dataclass
+class UpdateStmt(StmtNode):
+    table: TableName = None  # type: ignore[assignment]
+    assignments: list[Assignment] = field(default_factory=list)
+    where: ExprNode | None = None
+    order_by: list[ByItem] = field(default_factory=list)
+    limit: Limit | None = None
+
+
+@dataclass
+class DeleteStmt(StmtNode):
+    table: TableName = None  # type: ignore[assignment]
+    where: ExprNode | None = None
+    order_by: list[ByItem] = field(default_factory=list)
+    limit: Limit | None = None

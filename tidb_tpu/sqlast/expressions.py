@@ -1,0 +1,160 @@
+"""Expression AST nodes.
+
+Reference: ast/expressions.go (ValueExpr, ColumnNameExpr, BinaryOperationExpr,
+PatternInExpr, PatternLikeExpr, BetweenExpr, CaseExpr, IsNullExpr, RowExpr…)
+and ast/functions.go (FuncCallExpr, AggregateFuncExpr).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from tidb_tpu.sqlast.base import ExprNode
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+
+
+@dataclass
+class Literal(ExprNode):
+    """Constant value (ast.ValueExpr)."""
+    value: Datum
+    ftype: Any = None
+
+
+@dataclass
+class ColumnName(ExprNode):
+    """Possibly-qualified column reference; resolver fills offset/ftype.
+    Reference: ast.ColumnName + ColumnNameExpr + ResultField binding."""
+    name: str
+    table: str = ""
+    db: str = ""
+    # resolution results (plan/resolver.go equivalent):
+    offset: int = -1          # offset in the input row schema
+    col_id: int = 0           # column id in the table (for pushdown)
+    ftype: Any = None
+
+    def qualified(self) -> str:
+        parts = [p for p in (self.db, self.table, self.name) if p]
+        return ".".join(parts)
+
+
+@dataclass
+class BinaryOp(ExprNode):
+    op: Op
+    left: ExprNode
+    right: ExprNode
+    ftype: Any = None
+
+
+@dataclass
+class UnaryOp(ExprNode):
+    op: Op
+    operand: ExprNode
+    ftype: Any = None
+
+
+@dataclass
+class FuncCall(ExprNode):
+    """Scalar builtin call (ast.FuncCallExpr)."""
+    name: str
+    args: list[ExprNode] = field(default_factory=list)
+    ftype: Any = None
+
+
+@dataclass
+class AggregateFunc(ExprNode):
+    """Aggregate call (ast.AggregateFuncExpr): count/sum/avg/min/max/
+    first_row/group_concat, optionally DISTINCT."""
+    name: str
+    args: list[ExprNode] = field(default_factory=list)
+    distinct: bool = False
+    ftype: Any = None
+
+
+@dataclass
+class Between(ExprNode):
+    expr: ExprNode
+    low: ExprNode
+    high: ExprNode
+    not_: bool = False
+    ftype: Any = None
+
+
+@dataclass
+class InExpr(ExprNode):
+    """expr [NOT] IN (list) (ast.PatternInExpr; subquery form later)."""
+    expr: ExprNode
+    items: list[ExprNode] = field(default_factory=list)
+    not_: bool = False
+    ftype: Any = None
+
+
+@dataclass
+class PatternLike(ExprNode):
+    expr: ExprNode
+    pattern: ExprNode
+    not_: bool = False
+    escape: str = "\\"
+    ftype: Any = None
+
+
+@dataclass
+class IsNull(ExprNode):
+    expr: ExprNode
+    not_: bool = False
+    ftype: Any = None
+
+
+@dataclass
+class WhenClause(ExprNode):
+    when: ExprNode
+    result: ExprNode
+    ftype: Any = None
+
+
+@dataclass
+class CaseExpr(ExprNode):
+    """CASE [value] WHEN ... THEN ... [ELSE ...] END."""
+    value: ExprNode | None = None
+    when_clauses: list[WhenClause] = field(default_factory=list)
+    else_clause: ExprNode | None = None
+    ftype: Any = None
+
+
+@dataclass
+class ParamMarker(ExprNode):
+    """? placeholder in prepared statements."""
+    order: int = 0
+    value: Datum | None = None
+    ftype: Any = None
+
+
+@dataclass
+class RowExpr(ExprNode):
+    values: list[ExprNode] = field(default_factory=list)
+    ftype: Any = None
+
+
+@dataclass
+class DefaultExpr(ExprNode):
+    """DEFAULT / DEFAULT(col) in INSERT/UPDATE values."""
+    name: str = ""
+    ftype: Any = None
+
+
+@dataclass
+class VariableExpr(ExprNode):
+    """@@sysvar / @uservar reference."""
+    name: str
+    is_global: bool = False
+    is_system: bool = True
+    ftype: Any = None
+
+
+@dataclass
+class CastExpr(ExprNode):
+    """CAST(expr AS type) / CONVERT."""
+    expr: ExprNode
+    cast_type: Any = None  # FieldType
+    ftype: Any = None

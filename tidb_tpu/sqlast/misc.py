@@ -1,0 +1,81 @@
+"""Transaction-control / session / admin statement AST nodes.
+
+Reference: ast/misc.go (BeginStmt, CommitStmt, SetStmt, UseStmt, ShowStmt…)
+and ast/stats.go.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from tidb_tpu.sqlast.base import ExprNode, Node, StmtNode
+from tidb_tpu.sqlast.dml import TableName
+
+
+@dataclass
+class BeginStmt(StmtNode):
+    pass
+
+
+@dataclass
+class CommitStmt(StmtNode):
+    pass
+
+
+@dataclass
+class RollbackStmt(StmtNode):
+    pass
+
+
+@dataclass
+class UseStmt(StmtNode):
+    db: str = ""
+
+
+@dataclass
+class VariableAssignment(Node):
+    name: str
+    value: ExprNode | None = None
+    is_global: bool = False
+    is_system: bool = True
+
+
+@dataclass
+class SetStmt(StmtNode):
+    variables: list[VariableAssignment] = field(default_factory=list)
+
+
+class ShowType(enum.IntEnum):
+    DATABASES = 1
+    TABLES = 2
+    COLUMNS = 3
+    CREATE_TABLE = 4
+    VARIABLES = 5
+    INDEXES = 6
+    WARNINGS = 7
+
+
+@dataclass
+class ShowStmt(StmtNode):
+    tp: ShowType = ShowType.DATABASES
+    table: TableName | None = None
+    db: str = ""
+    full: bool = False
+    pattern: str = ""
+
+
+@dataclass
+class ExplainStmt(StmtNode):
+    stmt: StmtNode = None  # type: ignore[assignment]
+
+
+class AdminType(enum.IntEnum):
+    SHOW_DDL = 1
+    CHECK_TABLE = 2
+
+
+@dataclass
+class AdminStmt(StmtNode):
+    tp: AdminType = AdminType.SHOW_DDL
+    tables: list[TableName] = field(default_factory=list)
