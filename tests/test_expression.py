@@ -1,0 +1,247 @@
+"""Expression system tests.
+
+Modeled on the reference's evaluator tests (evaluator/evaluator_test.go,
+builtin_*_test.go) and expression/aggregation tests — table-driven over the
+scalar compute core, builtins, and aggregate partial/final merging.
+"""
+
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu import errors
+from tidb_tpu.expression import (
+    AggFunctionMode, AggregationFunction, Column, Constant, ScalarFunction,
+    new_op, ops as xops,
+)
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum, datum_from_py
+from tidb_tpu.types.datum import NULL
+
+
+def d(v):
+    return datum_from_py(v)
+
+
+def const(v):
+    return Constant(d(v))
+
+
+def fn(name, *args):
+    return ScalarFunction(name, [const(a) if not hasattr(a, "eval") else a
+                                 for a in args])
+
+
+def ev(e):
+    return e.eval([])
+
+
+class TestScalarOps:
+    @pytest.mark.parametrize("op,a,b,want", [
+        (Op.Plus, 1, 2, 3),
+        (Op.Plus, 1.5, 2, 3.5),
+        (Op.Minus, 5, 7, -2),
+        (Op.Mul, 3, 4, 12),
+        (Op.Div, 3, 2, Decimal("1.5")),
+        (Op.Div, 3.0, 2, 1.5),
+        (Op.Div, 1, 0, None),
+        (Op.IntDiv, 7, 2, 3),
+        (Op.IntDiv, -7, 2, -3),     # truncation toward zero
+        (Op.Mod, 7, 3, 1),
+        (Op.Mod, -7, 3, -1),        # sign of dividend
+        (Op.Mod, 7, 0, None),
+    ])
+    def test_arith(self, op, a, b, want):
+        got = xops.compute_arith(op, d(a), d(b))
+        if want is None:
+            assert got.is_null()
+        else:
+            assert got.val == want
+
+    @pytest.mark.parametrize("op,a,b,want", [
+        (Op.EQ, 1, 1, 1), (Op.EQ, 1, 2, 0),
+        (Op.EQ, "12", 12, 1),       # string-number coercion
+        (Op.NE, 1, 2, 1),
+        (Op.LT, 1, 2, 1), (Op.LE, 2, 2, 1),
+        (Op.GT, 3, 2, 1), (Op.GE, 2, 3, 0),
+        (Op.EQ, "abc", "ABC", 0),   # binary collation
+    ])
+    def test_compare(self, op, a, b, want):
+        assert xops.compute_compare(op, d(a), d(b)).val == want
+
+    def test_compare_null(self):
+        assert xops.compute_compare(Op.EQ, NULL, d(1)).is_null()
+        assert xops.compute_compare(Op.NullEQ, NULL, NULL).val == 1
+        assert xops.compute_compare(Op.NullEQ, NULL, d(1)).val == 0
+
+    def test_three_valued_logic(self):
+        T, F, N = d(1), d(0), NULL
+        assert xops.compute_logic(Op.AndAnd, F, N).val == 0
+        assert xops.compute_logic(Op.AndAnd, T, N).is_null()
+        assert xops.compute_logic(Op.OrOr, T, N).val == 1
+        assert xops.compute_logic(Op.OrOr, F, N).is_null()
+        assert xops.compute_logic(Op.Xor, T, N).is_null()
+
+    def test_bit_ops(self):
+        assert xops.compute_bit(Op.BitAnd, d(6), d(3)).val == 2
+        assert xops.compute_bit(Op.BitOr, d(6), d(3)).val == 7
+        assert xops.compute_bit(Op.BitXor, d(6), d(3)).val == 5
+        assert xops.compute_bit(Op.LeftShift, d(1), d(3)).val == 8
+        assert xops.compute_bit(Op.RightShift, d(8), d(3)).val == 1
+        # MySQL bit ops are uint64: -1 & anything
+        assert xops.compute_bit(Op.BitAnd, d(-1), d(7)).val == 7
+
+    def test_unary(self):
+        assert xops.compute_unary(Op.UnaryMinus, d(5)).val == -5
+        assert xops.compute_unary(Op.UnaryNot, d(0)).val == 1
+        assert xops.compute_unary(Op.UnaryNot, d(3)).val == 0
+        assert xops.compute_unary(Op.UnaryNot, NULL).is_null()
+        assert xops.compute_unary(Op.BitNeg, d(0)).val == (1 << 64) - 1
+
+    def test_overflow(self):
+        with pytest.raises(errors.OverflowError_):
+            xops.compute_arith(Op.Plus, d((1 << 63) - 1), d(1))
+
+    def test_like(self):
+        assert xops.compute_like(d("abc"), d("a%")).val == 1
+        assert xops.compute_like(d("abc"), d("_bc")).val == 1
+        assert xops.compute_like(d("abc"), d("b%")).val == 0
+        assert xops.compute_like(d("ABC"), d("abc")).val == 1  # ci
+        assert xops.compute_like(d("a%c"), d(r"a\%c")).val == 1
+        assert xops.compute_like(NULL, d("x")).is_null()
+        assert xops.compute_like(d("abc"), d("b%"), negated=True).val == 1
+
+    def test_in(self):
+        assert xops.compute_in(d(2), [d(1), d(2)]).val == 1
+        assert xops.compute_in(d(3), [d(1), d(2)]).val == 0
+        assert xops.compute_in(d(3), [d(1), NULL]).is_null()
+        assert xops.compute_in(d(1), [d(1), NULL]).val == 1
+        assert xops.compute_in(NULL, [d(1)]).is_null()
+        assert xops.compute_in(d(3), [d(1), d(2)], negated=True).val == 1
+
+
+class TestScalarFunction:
+    def test_op_expr_and_shortcircuit(self):
+        e = new_op(Op.Plus, const(1), const(2))
+        assert ev(e).val == 3
+        # OR short-circuits: right side would raise (unknown column offset)
+        bad = Column(col_name="x")
+        e = new_op(Op.OrOr, const(1), bad)
+        assert ev(e).val == 1
+
+    def test_control_funcs(self):
+        assert ev(fn("if", 1, "a", "b")).val == "a"
+        assert ev(fn("if", 0, "a", "b")).val == "b"
+        assert ev(fn("ifnull", NULL_D(), 5)).val == 5
+        assert ev(fn("nullif", 1, 1)).is_null()
+        assert ev(fn("coalesce", NULL_D(), NULL_D(), 7)).val == 7
+        assert ev(fn("isnull", NULL_D())).val == 1
+
+    def test_string_funcs(self):
+        assert ev(fn("concat", "a", 1, "b")).val == "a1b"
+        assert ev(fn("concat", "a", NULL_D())).is_null()
+        assert ev(fn("lower", "AbC")).val == "abc"
+        assert ev(fn("substring", "hello", 2)).val == "ello"
+        assert ev(fn("substring", "hello", 2, 2)).val == "el"
+        assert ev(fn("substring", "hello", -3, 2)).val == "ll"
+        assert ev(fn("left", "hello", 2)).val == "he"
+        assert ev(fn("replace", "aaa", "a", "b")).val == "bbb"
+        assert ev(fn("locate", "ll", "hello")).val == 3
+        assert ev(fn("length", "héllo")).val == 6   # bytes
+        assert ev(fn("char_length", "héllo")).val == 5
+        assert ev(fn("lpad", "5", 3, "0")).val == "005"
+
+    def test_math_funcs(self):
+        assert ev(fn("abs", -3)).val == 3
+        assert ev(fn("floor", 1.7)).val == 1
+        assert ev(fn("ceil", 1.2)).val == 2
+        assert ev(fn("round", 2.5)).val == 3.0      # half away from zero
+        assert ev(fn("round", 1.234, 2)).val == 1.23
+        assert ev(fn("pow", 2, 10)).val == 1024.0
+        assert ev(fn("sign", -9)).val == -1
+        assert ev(fn("greatest", 1, 9, 3)).val == 9
+        assert ev(fn("least", 4, 2, 8)).val == 2
+
+    def test_case(self):
+        # searched case: when,then,when,then,else
+        e = fn("case", 0, "a", 1, "b", "c")
+        assert ev(e).val == "b"
+        e = fn("case", 0, "a", 0, "b", "c")
+        assert ev(e).val == "c"
+
+    def test_column_eval(self):
+        c = Column(col_name="x", index=1)
+        assert c.eval([d(10), d(20)]).val == 20
+
+
+def NULL_D():
+    return Constant(NULL)
+
+
+class TestAggregation:
+    def _run(self, agg, rows):
+        ctx = agg.create_context()
+        for r in rows:
+            agg.update(ctx, r)
+        return agg.get_result(ctx)
+
+    def test_count_sum_avg(self):
+        col = Column(index=0)
+        rows = [[d(1)], [d(2)], [NULL], [d(3)]]
+        assert self._run(AggregationFunction("count", [col]), rows).val == 3
+        s = self._run(AggregationFunction("sum", [col]), rows)
+        assert s.val == Decimal(6)  # int sum → decimal exactness
+        a = self._run(AggregationFunction("avg", [col]), rows)
+        assert a.val == Decimal(2)
+
+    def test_min_max_first(self):
+        col = Column(index=0)
+        rows = [[d(5)], [d(1)], [NULL], [d(9)]]
+        assert self._run(AggregationFunction("max", [col]), rows).val == 9
+        assert self._run(AggregationFunction("min", [col]), rows).val == 1
+        assert self._run(AggregationFunction("first_row", [col]), rows).val == 5
+
+    def test_distinct(self):
+        col = Column(index=0)
+        rows = [[d(1)], [d(1)], [d(2)], [NULL]]
+        assert self._run(AggregationFunction("count", [col], distinct=True),
+                         rows).val == 2
+        assert self._run(AggregationFunction("sum", [col], distinct=True),
+                         rows).val == Decimal(3)
+
+    def test_group_concat(self):
+        col = Column(index=0)
+        rows = [[d("a")], [d("b")], [NULL]]
+        assert self._run(AggregationFunction("group_concat", [col]),
+                         rows).val == "a,b"
+
+    def test_empty_group_results(self):
+        col = Column(index=0)
+        assert self._run(AggregationFunction("count", [col]), []).val == 0
+        assert self._run(AggregationFunction("sum", [col]), []).is_null()
+        assert self._run(AggregationFunction("avg", [col]), []).is_null()
+        assert self._run(AggregationFunction("max", [col]), []).is_null()
+
+    def test_partial_final_roundtrip(self):
+        """Partial rows from two 'regions' merge to the complete answer —
+        the invariant the TPU psum combine relies on."""
+        col = Column(index=0)
+        region_rows = [[[d(1)], [d(2)]], [[d(3)], [NULL], [d(4)]]]
+        for name, want in [("count", 4), ("sum", Decimal(10)),
+                           ("avg", Decimal("2.5")), ("max", 4), ("min", 1)]:
+            partial = AggregationFunction(name, [col])
+            partial_rows = []
+            for rows in region_rows:
+                ctx = partial.create_context()
+                for r in rows:
+                    partial.update(ctx, r)
+                partial_rows.append(partial.get_partial_result(ctx))
+            width = len(partial_rows[0])
+            final_args = [Column(index=i) for i in range(width)]
+            final = AggregationFunction(name, final_args,
+                                        mode=AggFunctionMode.FINAL)
+            fctx = final.create_context()
+            for pr in partial_rows:
+                final.update(fctx, pr)
+            got = final.get_result(fctx)
+            assert got.val == want, name

@@ -1,0 +1,24 @@
+"""SQL-side expression system.
+
+Reference: expression/ (Expression/Column/Constant/ScalarFunction/Schema/
+AggregationFunction) + evaluator/ (builtin function library). The scalar
+compute core (ops.py) is shared with the coprocessor's xeval so both sides
+of the pushdown boundary agree exactly.
+"""
+
+from tidb_tpu.expression.expression import (
+    Expression, Column, Constant, ScalarFunction, Schema,
+    new_op, compose_cnf, split_cnf, TRUE_EXPR, FALSE_EXPR, NULL_EXPR,
+)
+from tidb_tpu.expression.aggregation import (
+    AggregationFunction, AggFunctionMode, AggEvaluateContext,
+)
+from tidb_tpu.expression import ops, builtin
+
+__all__ = [
+    "Expression", "Column", "Constant", "ScalarFunction", "Schema",
+    "new_op", "compose_cnf", "split_cnf",
+    "TRUE_EXPR", "FALSE_EXPR", "NULL_EXPR",
+    "AggregationFunction", "AggFunctionMode", "AggEvaluateContext",
+    "ops", "builtin",
+]

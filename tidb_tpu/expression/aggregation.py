@@ -1,0 +1,269 @@
+"""Aggregate functions with partial/final modes.
+
+Reference: expression/aggregation.go:33 (AggregationFunction interface),
+AggFunctionMode (:111), per-func implementations (sum/count/avg/first/max/
+min/concat/distinct) and the partial-row protocol the coprocessor speaks:
+a pushed-down aggregate emits `[cnt?, val?]` pairs per group
+(plan/physical_plans.go:171-178 needCount/needValue;
+store/localstore/local_region.go:357-391), and the upper FinalMode
+aggregate merges them (executor/executor.go:989-1080).
+"""
+
+from __future__ import annotations
+
+import enum
+from decimal import Decimal
+
+from tidb_tpu import errors
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, Kind, compare_datum
+from tidb_tpu.types.field_type import FieldType, agg_field_type
+
+from tidb_tpu.expression import ops as xops
+from tidb_tpu.expression.expression import Expression
+
+
+class AggFunctionMode(enum.IntEnum):
+    COMPLETE = 0   # raw rows in, final value out
+    FINAL = 1      # partial rows in ([cnt?, val?] columns), final value out
+
+
+def _sum_exact(acc: Datum, v: Datum) -> Datum:
+    """Accumulate preserving exactness: ints sum as Decimal so SUM never
+    silently wraps or loses precision (local_aggregate.go:149-161)."""
+    if v.is_null():
+        return acc
+    n = v.as_number()
+    if not isinstance(n, float):
+        n = Decimal(n) if not isinstance(n, Decimal) else n
+    if acc.is_null():
+        return Datum.f64(n) if isinstance(n, float) else Datum.dec(n)
+    cur = acc.as_number()
+    if isinstance(cur, float) or isinstance(n, float):
+        return Datum.f64(float(cur) + float(n))
+    if not isinstance(cur, Decimal):
+        cur = Decimal(cur)
+    return Datum.dec(cur + n)
+
+
+class AggEvaluateContext:
+    __slots__ = ("count", "value", "buffer", "distinct_set", "evaluated")
+
+    def __init__(self):
+        self.count = 0
+        self.value = NULL
+        self.buffer: list | None = None     # group_concat parts
+        self.distinct_set: set | None = None
+        self.evaluated = False
+
+
+class AggregationFunction:
+    """One aggregate call site. Stateless w.r.t. groups — per-group state
+    lives in AggEvaluateContext objects owned by the executor."""
+
+    def __init__(self, name: str, args: list[Expression],
+                 distinct: bool = False,
+                 mode: AggFunctionMode = AggFunctionMode.COMPLETE,
+                 separator: str = ","):
+        name = name.lower()
+        if name not in AGG_IMPLS:
+            raise errors.PlanError(f"unknown aggregate function {name!r}")
+        self.name = name
+        self.args = args
+        self.distinct = distinct
+        self.mode = mode
+        self.separator = separator
+
+    # --- pushdown metadata (plan/physical_plans.go:171-178) ---
+    def need_count(self) -> bool:
+        return self.name in ("count", "avg")
+
+    def need_value(self) -> bool:
+        return self.name in ("sum", "avg", "first_row", "max", "min",
+                             "group_concat")
+
+    def ret_type(self) -> FieldType:
+        arg_ft = self.args[0].ret_type if self.args else FieldType()
+        return agg_field_type(self.name, arg_ft)
+
+    def clone(self) -> "AggregationFunction":
+        return AggregationFunction(self.name, [a.clone() for a in self.args],
+                                   self.distinct, self.mode, self.separator)
+
+    def create_context(self) -> AggEvaluateContext:
+        ctx = AggEvaluateContext()
+        if self.distinct:
+            ctx.distinct_set = set()
+        if self.name == "group_concat":
+            ctx.buffer = []
+        return ctx
+
+    # --- update ---
+    def update(self, ctx: AggEvaluateContext, row: list[Datum]) -> None:
+        if self.mode == AggFunctionMode.FINAL:
+            self._update_final(ctx, row)
+        else:
+            AGG_IMPLS[self.name](self, ctx, [a.eval(row) for a in self.args])
+
+    def _update_final(self, ctx: AggEvaluateContext, row: list[Datum]) -> None:
+        """Merge one partial row. Arg expressions are Columns pointing at the
+        partial layout: count first if need_count, then value if need_value."""
+        i = 0
+        cnt = 0
+        if self.need_count():
+            d = self.args[i].eval(row)
+            cnt = 0 if d.is_null() else int(d.as_number())
+            i += 1
+        if self.name == "count":
+            ctx.count += cnt
+            return
+        val = self.args[i].eval(row)
+        if self.name in ("sum", "avg"):
+            ctx.value = _sum_exact(ctx.value, val)
+            ctx.count += cnt if self.need_count() else 0
+            return
+        if self.name in ("max", "min"):
+            _minmax_update(ctx, val, self.name == "max")
+            return
+        if self.name == "first_row":
+            if not ctx.evaluated:
+                ctx.value = val
+                ctx.evaluated = True
+            return
+        if self.name == "group_concat":
+            if not val.is_null():
+                ctx.buffer.append(val.get_string())
+            return
+        raise errors.ExecError(f"final merge unsupported for {self.name}")
+
+    # --- result ---
+    def get_result(self, ctx: AggEvaluateContext) -> Datum:
+        n = self.name
+        if n == "count":
+            return Datum.i64(ctx.count)
+        if n == "sum":
+            return ctx.value
+        if n == "avg":
+            if ctx.count == 0:
+                return NULL
+            s = ctx.value.as_number()
+            if isinstance(s, float):
+                return Datum.f64(s / ctx.count)
+            return Datum.dec((Decimal(s) if not isinstance(s, Decimal) else s)
+                             / Decimal(ctx.count))
+        if n in ("max", "min", "first_row"):
+            return ctx.value
+        if n == "group_concat":
+            if not ctx.buffer:
+                return NULL
+            return Datum.string(self.separator.join(ctx.buffer))
+        raise errors.ExecError(f"unknown aggregate {n}")
+
+    def get_partial_result(self, ctx: AggEvaluateContext) -> list[Datum]:
+        """Emit the [cnt?, val?] partial row slice this func contributes."""
+        out = []
+        if self.need_count():
+            out.append(Datum.i64(ctx.count))
+        if self.need_value():
+            if self.name == "group_concat":
+                out.append(self.get_result(ctx))
+            else:
+                out.append(ctx.value)
+        if not out:  # plain count carries its count as the single column
+            out.append(Datum.i64(ctx.count))
+        return out
+
+    def __repr__(self):
+        d = "distinct " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(repr, self.args))})"
+
+
+def _seen(ctx: AggEvaluateContext, vals: list[Datum]) -> bool:
+    """Distinct tracking; returns True if this tuple was already counted."""
+    if ctx.distinct_set is None:
+        return False
+    key = tuple(_hashable(v) for v in vals)
+    if key in ctx.distinct_set:
+        return True
+    ctx.distinct_set.add(key)
+    return False
+
+
+def _hashable(d: Datum):
+    if d.is_null():
+        return None
+    n = d.kind
+    if n in (Kind.STRING, Kind.BYTES):
+        return d.get_bytes()
+    if n in (Kind.INT64, Kind.UINT64, Kind.FLOAT64, Kind.DECIMAL):
+        v = d.as_number()
+        # cross-kind numeric identity: hash(1)==hash(1.0)==hash(Decimal(1))
+        return v
+    return (int(n), str(d.val))
+
+
+def _minmax_update(ctx: AggEvaluateContext, v: Datum, is_max: bool) -> None:
+    if v.is_null():
+        return
+    if ctx.value.is_null():
+        ctx.value = v
+        return
+    c = compare_datum(v, ctx.value)
+    if (c > 0) == is_max and c != 0:
+        ctx.value = v
+
+
+# ---- complete-mode updaters ----
+
+def _agg_count(fn, ctx, vals):
+    if any(v.is_null() for v in vals):
+        return
+    if _seen(ctx, vals):
+        return
+    ctx.count += 1
+
+
+def _agg_sum(fn, ctx, vals):
+    v = vals[0]
+    if v.is_null() or _seen(ctx, vals):
+        return
+    ctx.value = _sum_exact(ctx.value, v)
+    ctx.count += 1
+
+
+def _agg_avg(fn, ctx, vals):
+    _agg_sum(fn, ctx, vals)
+
+
+def _agg_max(fn, ctx, vals):
+    _minmax_update(ctx, vals[0], True)
+
+
+def _agg_min(fn, ctx, vals):
+    _minmax_update(ctx, vals[0], False)
+
+
+def _agg_first_row(fn, ctx, vals):
+    if not ctx.evaluated:
+        ctx.value = vals[0] if vals else NULL
+        ctx.evaluated = True
+
+
+def _agg_group_concat(fn, ctx, vals):
+    if any(v.is_null() for v in vals):
+        return
+    if _seen(ctx, vals):
+        return
+    ctx.buffer.append("".join(xops._datum_to_str(v) for v in vals))
+
+
+AGG_IMPLS = {
+    "count": _agg_count,
+    "sum": _agg_sum,
+    "avg": _agg_avg,
+    "max": _agg_max,
+    "min": _agg_min,
+    "first_row": _agg_first_row,
+    "group_concat": _agg_group_concat,
+}

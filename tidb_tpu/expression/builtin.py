@@ -1,0 +1,746 @@
+"""Builtin scalar function library.
+
+Reference: evaluator/builtin.go:43 (Funcs map) and the per-family files
+builtin_math.go / builtin_string.go / builtin_time.go / builtin_control.go /
+builtin_info.go. Functions take already-built arg Expressions plus the row,
+so control functions (IF/IFNULL/CASE/COALESCE) can evaluate lazily.
+"""
+
+from __future__ import annotations
+
+import math
+import time as _time
+from decimal import Decimal, ROUND_HALF_UP
+
+from tidb_tpu import errors
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, Kind, compare_datum
+
+from tidb_tpu.expression import ops as xops
+
+# name -> (min_args, max_args, impl(args, row)); max_args=-1 means variadic
+FUNCS: dict[str, tuple[int, int, object]] = {}
+
+
+def register(name: str, lo: int, hi: int):
+    def deco(fn):
+        FUNCS[name] = (lo, hi, fn)
+        return fn
+    return deco
+
+
+def call(name: str, args: list, row) -> Datum:
+    ent = FUNCS.get(name.lower())
+    if ent is None:
+        raise errors.ExecError(f"unknown function {name!r}")
+    lo, hi, fn = ent
+    if len(args) < lo or (hi != -1 and len(args) > hi):
+        raise errors.ExecError(
+            f"wrong argument count to {name}(): got {len(args)}")
+    return fn(args, row)
+
+
+def exists(name: str) -> bool:
+    return name.lower() in FUNCS
+
+
+def _vals(args, row):
+    return [a.eval(row) for a in args]
+
+
+def _str_or_none(d: Datum):
+    return None if d.is_null() else d.get_string()
+
+
+# ---- control (evaluator/builtin_control.go) ----
+
+@register("if", 3, 3)
+def _if(args, row):
+    t = xops.datum_truth(args[0].eval(row))
+    return args[1].eval(row) if t else args[2].eval(row)
+
+
+@register("ifnull", 2, 2)
+def _ifnull(args, row):
+    v = args[0].eval(row)
+    return args[1].eval(row) if v.is_null() else v
+
+
+@register("nullif", 2, 2)
+def _nullif(args, row):
+    a = args[0].eval(row)
+    if a.is_null():
+        return NULL
+    b = args[1].eval(row)
+    if not b.is_null() and compare_datum(a, b) == 0:
+        return NULL
+    return a
+
+
+@register("coalesce", 1, -1)
+def _coalesce(args, row):
+    for a in args:
+        v = a.eval(row)
+        if not v.is_null():
+            return v
+    return NULL
+
+
+@register("isnull", 1, 1)
+def _isnull(args, row):
+    return xops.bool_datum(args[0].eval(row).is_null())
+
+
+@register("case", 1, -1)
+def _case(args, row):
+    """Flattened CASE: [value?] (when, then)... [else]. The planner lowers
+    CaseExpr to this layout; compare-value CASE prepends the value."""
+    i = 0
+    n = len(args)
+    has_value = n % 2 == 0  # pairs + optional else is odd; +value flips parity
+    value = args[0].eval(row) if has_value else None
+    if has_value:
+        i = 1
+    while i + 1 < n:
+        cond = args[i].eval(row)
+        if value is not None:
+            matched = (not cond.is_null()) and (not value.is_null()) \
+                and compare_datum(value, cond) == 0
+        else:
+            matched = xops.datum_truth(cond) is True
+        if matched:
+            return args[i + 1].eval(row)
+        i += 2
+    if i < n:  # else arm
+        return args[i].eval(row)
+    return NULL
+
+
+# ---- comparison-adjacent ----
+
+@register("greatest", 2, -1)
+def _greatest(args, row):
+    best = None
+    for d in _vals(args, row):
+        if d.is_null():
+            return NULL
+        if best is None or compare_datum(d, best) > 0:
+            best = d
+    return best
+
+
+@register("least", 2, -1)
+def _least(args, row):
+    best = None
+    for d in _vals(args, row):
+        if d.is_null():
+            return NULL
+        if best is None or compare_datum(d, best) < 0:
+            best = d
+    return best
+
+
+# ---- math (evaluator/builtin_math.go) ----
+
+def _num1(args, row):
+    d = args[0].eval(row)
+    return None if d.is_null() else d.as_number()
+
+
+@register("abs", 1, 1)
+def _abs(args, row):
+    n = _num1(args, row)
+    if n is None:
+        return NULL
+    r = abs(n)
+    if isinstance(r, float):
+        return Datum.f64(r)
+    if isinstance(r, Decimal):
+        return Datum.dec(r)
+    return Datum.i64(r)
+
+
+@register("ceil", 1, 1)
+@register("ceiling", 1, 1)
+def _ceil(args, row):
+    n = _num1(args, row)
+    return NULL if n is None else Datum.i64(math.ceil(n))
+
+
+@register("floor", 1, 1)
+def _floor(args, row):
+    n = _num1(args, row)
+    return NULL if n is None else Datum.i64(math.floor(n))
+
+
+@register("round", 1, 2)
+def _round(args, row):
+    d = args[0].eval(row)
+    if d.is_null():
+        return NULL
+    places = 0
+    if len(args) > 1:
+        p = args[1].eval(row)
+        if p.is_null():
+            return NULL
+        places = int(p.as_number())
+    n = d.as_number()
+    if isinstance(n, float):
+        # MySQL rounds half away from zero, not banker's
+        q = Decimal(str(n)).quantize(Decimal(1).scaleb(-places),
+                                     rounding=ROUND_HALF_UP)
+        return Datum.f64(float(q))
+    q = Decimal(n).quantize(Decimal(1).scaleb(-places), rounding=ROUND_HALF_UP)
+    if d.kind in (Kind.INT64, Kind.UINT64) and places >= 0:
+        return Datum.i64(int(q))
+    return Datum.dec(q)
+
+
+@register("truncate", 2, 2)
+def _truncate(args, row):
+    d, p = args[0].eval(row), args[1].eval(row)
+    if d.is_null() or p.is_null():
+        return NULL
+    places = int(p.as_number())
+    n = d.as_number()
+    q = Decimal(str(n)).quantize(Decimal(1).scaleb(-max(places, -30)),
+                                 rounding="ROUND_DOWN") if places >= 0 else \
+        (Decimal(str(n)) // Decimal(10) ** -places) * Decimal(10) ** -places
+    if isinstance(n, float):
+        return Datum.f64(float(q))
+    if isinstance(n, Decimal):
+        return Datum.dec(q)
+    return Datum.i64(int(q))
+
+
+@register("pow", 2, 2)
+@register("power", 2, 2)
+def _pow(args, row):
+    a, b = _vals(args, row)
+    if a.is_null() or b.is_null():
+        return NULL
+    return Datum.f64(float(a.as_number()) ** float(b.as_number()))
+
+
+@register("sqrt", 1, 1)
+def _sqrt(args, row):
+    n = _num1(args, row)
+    if n is None:
+        return NULL
+    f = float(n)
+    return NULL if f < 0 else Datum.f64(math.sqrt(f))
+
+
+@register("sign", 1, 1)
+def _sign(args, row):
+    n = _num1(args, row)
+    if n is None:
+        return NULL
+    return Datum.i64((n > 0) - (n < 0))
+
+
+@register("mod", 2, 2)
+def _mod(args, row):
+    from tidb_tpu.sqlast.opcode import Op
+    a, b = _vals(args, row)
+    return xops.compute_arith(Op.Mod, a, b)
+
+
+@register("ln", 1, 1)
+def _ln(args, row):
+    n = _num1(args, row)
+    if n is None or float(n) <= 0:
+        return NULL
+    return Datum.f64(math.log(float(n)))
+
+
+@register("log", 1, 2)
+def _log(args, row):
+    vals = _vals(args, row)
+    if any(v.is_null() for v in vals):
+        return NULL
+    if len(vals) == 1:
+        x = float(vals[0].as_number())
+        return NULL if x <= 0 else Datum.f64(math.log(x))
+    base, x = float(vals[0].as_number()), float(vals[1].as_number())
+    if base <= 0 or base == 1 or x <= 0:
+        return NULL
+    return Datum.f64(math.log(x, base))
+
+
+@register("log2", 1, 1)
+def _log2(args, row):
+    n = _num1(args, row)
+    if n is None or float(n) <= 0:
+        return NULL
+    return Datum.f64(math.log2(float(n)))
+
+
+@register("log10", 1, 1)
+def _log10(args, row):
+    n = _num1(args, row)
+    if n is None or float(n) <= 0:
+        return NULL
+    return Datum.f64(math.log10(float(n)))
+
+
+@register("exp", 1, 1)
+def _exp(args, row):
+    n = _num1(args, row)
+    return NULL if n is None else Datum.f64(math.exp(float(n)))
+
+
+@register("pi", 0, 0)
+def _pi(args, row):
+    return Datum.f64(math.pi)
+
+
+_rand_state = [0x5DEECE66D]
+
+
+@register("rand", 0, 1)
+def _rand(args, row):
+    if args:
+        seed = args[0].eval(row)
+        if not seed.is_null():
+            _rand_state[0] = int(seed.as_number()) & ((1 << 48) - 1)
+    _rand_state[0] = (_rand_state[0] * 25214903917 + 11) & ((1 << 48) - 1)
+    return Datum.f64(_rand_state[0] / float(1 << 48))
+
+
+@register("crc32", 1, 1)
+def _crc32(args, row):
+    import zlib
+    d = args[0].eval(row)
+    if d.is_null():
+        return NULL
+    return Datum.u64(zlib.crc32(xops._datum_to_str(d).encode()) & 0xFFFFFFFF)
+
+
+# ---- strings (evaluator/builtin_string.go) ----
+
+@register("length", 1, 1)
+def _length(args, row):
+    d = args[0].eval(row)
+    return NULL if d.is_null() else Datum.i64(len(d.get_bytes()) if d.kind in (Kind.STRING, Kind.BYTES) else len(xops._datum_to_str(d)))
+
+
+@register("char_length", 1, 1)
+@register("character_length", 1, 1)
+def _char_length(args, row):
+    d = args[0].eval(row)
+    return NULL if d.is_null() else Datum.i64(len(xops._datum_to_str(d)))
+
+
+@register("concat", 1, -1)
+def _concat(args, row):
+    out = []
+    for d in _vals(args, row):
+        if d.is_null():
+            return NULL
+        out.append(xops._datum_to_str(d))
+    return Datum.string("".join(out))
+
+
+@register("concat_ws", 2, -1)
+def _concat_ws(args, row):
+    sep = args[0].eval(row)
+    if sep.is_null():
+        return NULL
+    parts = [xops._datum_to_str(d) for d in _vals(args[1:], row)
+             if not d.is_null()]
+    return Datum.string(sep.get_string().join(parts))
+
+
+@register("lower", 1, 1)
+@register("lcase", 1, 1)
+def _lower(args, row):
+    s = _str_or_none(args[0].eval(row))
+    return NULL if s is None else Datum.string(s.lower())
+
+
+@register("upper", 1, 1)
+@register("ucase", 1, 1)
+def _upper(args, row):
+    s = _str_or_none(args[0].eval(row))
+    return NULL if s is None else Datum.string(s.upper())
+
+
+@register("substring", 2, 3)
+@register("substr", 2, 3)
+def _substring(args, row):
+    vals = _vals(args, row)
+    if any(v.is_null() for v in vals):
+        return NULL
+    s = xops._datum_to_str(vals[0])
+    pos = int(vals[1].as_number())
+    if pos == 0:
+        return Datum.string("")
+    start = pos - 1 if pos > 0 else len(s) + pos
+    if start < 0:
+        return Datum.string("")
+    if len(vals) == 3:
+        ln = int(vals[2].as_number())
+        if ln <= 0:
+            return Datum.string("")
+        return Datum.string(s[start:start + ln])
+    return Datum.string(s[start:])
+
+
+@register("left", 2, 2)
+def _left(args, row):
+    s, n = _vals(args, row)
+    if s.is_null() or n.is_null():
+        return NULL
+    k = int(n.as_number())
+    return Datum.string(xops._datum_to_str(s)[:max(k, 0)])
+
+
+@register("right", 2, 2)
+def _right(args, row):
+    s, n = _vals(args, row)
+    if s.is_null() or n.is_null():
+        return NULL
+    k = int(n.as_number())
+    txt = xops._datum_to_str(s)
+    return Datum.string(txt[-k:] if k > 0 else "")
+
+
+@register("trim", 1, 3)
+def _trim(args, row):
+    # trim(s) | trim(s, remstr, direction:{0 both,1 leading,2 trailing})
+    vals = _vals(args, row)
+    if vals[0].is_null():
+        return NULL
+    s = xops._datum_to_str(vals[0])
+    rem = " "
+    direction = 0
+    if len(vals) >= 2 and not vals[1].is_null():
+        rem = xops._datum_to_str(vals[1])
+    if len(vals) == 3:
+        direction = int(vals[2].as_number())
+    if rem:
+        if direction in (0, 1):
+            while s.startswith(rem):
+                s = s[len(rem):]
+        if direction in (0, 2):
+            while s.endswith(rem):
+                s = s[:-len(rem)]
+    return Datum.string(s)
+
+
+@register("ltrim", 1, 1)
+def _ltrim(args, row):
+    s = _str_or_none(args[0].eval(row))
+    return NULL if s is None else Datum.string(s.lstrip(" "))
+
+
+@register("rtrim", 1, 1)
+def _rtrim(args, row):
+    s = _str_or_none(args[0].eval(row))
+    return NULL if s is None else Datum.string(s.rstrip(" "))
+
+
+@register("replace", 3, 3)
+def _replace(args, row):
+    vals = _vals(args, row)
+    if any(v.is_null() for v in vals):
+        return NULL
+    s, frm, to = (xops._datum_to_str(v) for v in vals)
+    return Datum.string(s.replace(frm, to) if frm else s)
+
+
+@register("repeat", 2, 2)
+def _repeat(args, row):
+    s, n = _vals(args, row)
+    if s.is_null() or n.is_null():
+        return NULL
+    k = int(n.as_number())
+    return Datum.string(xops._datum_to_str(s) * max(k, 0))
+
+
+@register("reverse", 1, 1)
+def _reverse(args, row):
+    s = _str_or_none(args[0].eval(row))
+    return NULL if s is None else Datum.string(s[::-1])
+
+
+@register("space", 1, 1)
+def _space(args, row):
+    n = args[0].eval(row)
+    return NULL if n.is_null() else Datum.string(" " * max(int(n.as_number()), 0))
+
+
+@register("locate", 2, 3)
+def _locate(args, row):
+    vals = _vals(args, row)
+    if vals[0].is_null() or vals[1].is_null():
+        return NULL
+    sub, s = xops._datum_to_str(vals[0]), xops._datum_to_str(vals[1])
+    start = 0
+    if len(vals) == 3:
+        if vals[2].is_null():
+            return NULL
+        start = max(int(vals[2].as_number()) - 1, 0)
+    return Datum.i64(s.lower().find(sub.lower(), start) + 1)
+
+
+@register("instr", 2, 2)
+def _instr(args, row):
+    s, sub = _vals(args, row)
+    if s.is_null() or sub.is_null():
+        return NULL
+    return Datum.i64(xops._datum_to_str(s).lower().find(
+        xops._datum_to_str(sub).lower()) + 1)
+
+
+@register("ascii", 1, 1)
+def _ascii(args, row):
+    s = _str_or_none(args[0].eval(row))
+    if s is None:
+        return NULL
+    return Datum.i64(s.encode()[0] if s else 0)
+
+
+@register("hex", 1, 1)
+def _hex(args, row):
+    d = args[0].eval(row)
+    if d.is_null():
+        return NULL
+    if d.kind in (Kind.STRING, Kind.BYTES):
+        return Datum.string(d.get_bytes().hex().upper())
+    return Datum.string(format(int(d.as_number()) & ((1 << 64) - 1), "X"))
+
+
+@register("unhex", 1, 1)
+def _unhex(args, row):
+    s = _str_or_none(args[0].eval(row))
+    if s is None:
+        return NULL
+    try:
+        return Datum.bytes_(bytes.fromhex(s))
+    except ValueError:
+        return NULL
+
+
+@register("lpad", 3, 3)
+def _lpad(args, row):
+    vals = _vals(args, row)
+    if any(v.is_null() for v in vals):
+        return NULL
+    s, n, pad = xops._datum_to_str(vals[0]), int(vals[1].as_number()), \
+        xops._datum_to_str(vals[2])
+    if n < 0 or (len(s) < n and not pad):
+        return NULL
+    if len(s) >= n:
+        return Datum.string(s[:n])
+    fill = (pad * n)[:n - len(s)]
+    return Datum.string(fill + s)
+
+
+@register("rpad", 3, 3)
+def _rpad(args, row):
+    vals = _vals(args, row)
+    if any(v.is_null() for v in vals):
+        return NULL
+    s, n, pad = xops._datum_to_str(vals[0]), int(vals[1].as_number()), \
+        xops._datum_to_str(vals[2])
+    if n < 0 or (len(s) < n and not pad):
+        return NULL
+    if len(s) >= n:
+        return Datum.string(s[:n])
+    fill = (pad * n)[:n - len(s)]
+    return Datum.string(s + fill)
+
+
+@register("strcmp", 2, 2)
+def _strcmp(args, row):
+    a, b = _vals(args, row)
+    if a.is_null() or b.is_null():
+        return NULL
+    x, y = xops._datum_to_str(a), xops._datum_to_str(b)
+    return Datum.i64((x > y) - (x < y))
+
+
+@register("field", 2, -1)
+def _field(args, row):
+    vals = _vals(args, row)
+    if vals[0].is_null():
+        return Datum.i64(0)
+    for i, v in enumerate(vals[1:], 1):
+        if not v.is_null() and compare_datum(vals[0], v) == 0:
+            return Datum.i64(i)
+    return Datum.i64(0)
+
+
+@register("bin", 1, 1)
+def _bin(args, row):
+    d = args[0].eval(row)
+    if d.is_null():
+        return NULL
+    return Datum.string(format(int(d.as_number()) & ((1 << 64) - 1), "b"))
+
+
+@register("char", 1, -1)
+def _char(args, row):
+    out = bytearray()
+    for d in _vals(args, row):
+        if d.is_null():
+            continue
+        v = int(d.as_number()) & 0xFFFFFFFF
+        chunk = bytearray()
+        while v:
+            chunk.insert(0, v & 0xFF)
+            v >>= 8
+        out.extend(chunk or b"\x00")
+    return Datum.string(out.decode("utf-8", "replace"))
+
+
+# ---- time (evaluator/builtin_time.go; subset over types.time_types) ----
+
+def _now_time():
+    import datetime as _dt
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.types.time_types import Time
+    return Time(_dt.datetime.now().replace(microsecond=0), my.TypeDatetime, 0)
+
+
+@register("now", 0, 1)
+@register("current_timestamp", 0, 1)
+@register("sysdate", 0, 1)
+def _now(args, row):
+    return Datum(Kind.TIME, _now_time())
+
+
+@register("curdate", 0, 0)
+@register("current_date", 0, 0)
+def _curdate(args, row):
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.types.time_types import Time
+    t = _now_time()
+    return Datum(Kind.TIME, Time(t.dt.replace(hour=0, minute=0, second=0),
+                                 my.TypeDate, 0))
+
+
+@register("unix_timestamp", 0, 1)
+def _unix_ts(args, row):
+    if not args:
+        return Datum.i64(int(_time.time()))
+    d = args[0].eval(row)
+    if d.is_null():
+        return NULL
+    if d.kind == Kind.TIME:
+        return Datum.i64(int(d.val.dt.timestamp()))
+    return Datum.i64(0)
+
+
+def _as_time(d: Datum):
+    from tidb_tpu.types.time_types import parse_time
+    if d.kind == Kind.TIME:
+        return d.val
+    if d.kind in (Kind.STRING, Kind.BYTES):
+        try:
+            return parse_time(d.get_string())
+        except errors.TiDBError:
+            return None
+    return None
+
+
+def _time_part(args, row, attr):
+    t = _as_time(args[0].eval(row))
+    return NULL if t is None else Datum.i64(getattr(t.dt, attr))
+
+
+@register("year", 1, 1)
+def _year(args, row):
+    return _time_part(args, row, "year")
+
+
+@register("month", 1, 1)
+def _month(args, row):
+    return _time_part(args, row, "month")
+
+
+@register("day", 1, 1)
+@register("dayofmonth", 1, 1)
+def _day(args, row):
+    return _time_part(args, row, "day")
+
+
+@register("hour", 1, 1)
+def _hour(args, row):
+    return _time_part(args, row, "hour")
+
+
+@register("minute", 1, 1)
+def _minute(args, row):
+    return _time_part(args, row, "minute")
+
+
+@register("second", 1, 1)
+def _second(args, row):
+    return _time_part(args, row, "second")
+
+
+@register("date", 1, 1)
+def _date(args, row):
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.types.time_types import Time
+    t = _as_time(args[0].eval(row))
+    if t is None:
+        return NULL
+    return Datum(Kind.TIME, Time(t.dt.replace(hour=0, minute=0, second=0,
+                                              microsecond=0), my.TypeDate, 0))
+
+
+@register("weekday", 1, 1)
+def _weekday(args, row):
+    t = _as_time(args[0].eval(row))
+    return NULL if t is None else Datum.i64(t.dt.weekday())
+
+
+@register("dayofweek", 1, 1)
+def _dayofweek(args, row):
+    t = _as_time(args[0].eval(row))
+    return NULL if t is None else Datum.i64((t.dt.weekday() + 1) % 7 + 1)
+
+
+@register("dayofyear", 1, 1)
+def _dayofyear(args, row):
+    t = _as_time(args[0].eval(row))
+    return NULL if t is None else Datum.i64(t.dt.timetuple().tm_yday)
+
+
+# ---- info (evaluator/builtin_info.go; ctx-bound ones are rebound by session) ----
+
+@register("version", 0, 0)
+def _version(args, row):
+    from tidb_tpu import mysqldef as my
+    return Datum.string(my.SERVER_VERSION)
+
+
+@register("database", 0, 0)
+@register("schema", 0, 0)
+def _database(args, row):
+    return NULL  # session layer substitutes a bound closure
+
+
+@register("current_user", 0, 0)
+@register("user", 0, 0)
+def _user(args, row):
+    return NULL  # session layer substitutes
+
+
+@register("connection_id", 0, 0)
+def _connection_id(args, row):
+    return Datum.u64(0)
+
+
+@register("found_rows", 0, 0)
+def _found_rows(args, row):
+    return Datum.u64(0)
+
+
+@register("last_insert_id", 0, 1)
+def _last_insert_id(args, row):
+    return Datum.u64(0)

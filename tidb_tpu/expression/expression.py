@@ -1,0 +1,276 @@
+"""Evaluable expression objects used by plans and executors.
+
+Reference: expression/expression.go:30 (Expression interface with Eval(row)),
+expression/column.go (Column, offset-resolved), expression/constant.go,
+expression/scalar_function.go:62 (dispatch into evaluator.Funcs),
+expression/schema.go.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from tidb_tpu import errors
+from tidb_tpu import mysqldef as my
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL
+from tidb_tpu.types.field_type import FieldType, new_field_type
+
+from tidb_tpu.expression import ops as xops
+
+
+class Expression(abc.ABC):
+    ret_type: FieldType
+
+    @abc.abstractmethod
+    def eval(self, row: list[Datum]) -> Datum: ...
+
+    @abc.abstractmethod
+    def clone(self) -> "Expression": ...
+
+    def equal(self, other: "Expression") -> bool:
+        return self is other
+
+    # structural helpers (plan/expression traversal)
+    def columns(self) -> list["Column"]:
+        out: list[Column] = []
+        _collect_columns(self, out)
+        return out
+
+
+def _collect_columns(e: Expression, out: list["Column"]) -> None:
+    if isinstance(e, Column):
+        out.append(e)
+    elif isinstance(e, ScalarFunction):
+        for a in e.args:
+            _collect_columns(a, out)
+
+
+class Column(Expression):
+    """A resolved column reference.
+
+    `index` is the offset into the executor row (set by ResolveIndices);
+    `col_id` is the table column id (for pushdown / tablecodec);
+    `from_id`/`position` identify the producing plan node + output slot.
+    """
+
+    def __init__(self, col_name: str = "", tbl_name: str = "", db_name: str = "",
+                 ret_type: FieldType | None = None, index: int = -1,
+                 col_id: int = 0, from_id: str = "", position: int = 0,
+                 is_agg: bool = False):
+        self.col_name = col_name
+        self.tbl_name = tbl_name
+        self.db_name = db_name
+        self.ret_type = ret_type or new_field_type(my.TypeNull)
+        self.index = index
+        self.col_id = col_id
+        self.from_id = from_id
+        self.position = position
+        self.is_agg = is_agg  # aggregate output column (not a real table col)
+
+    def eval(self, row: list[Datum]) -> Datum:
+        if self.index < 0:
+            raise errors.PlanError(f"column {self} not resolved to an offset")
+        return row[self.index]
+
+    def clone(self) -> "Column":
+        return Column(self.col_name, self.tbl_name, self.db_name,
+                      self.ret_type, self.index, self.col_id,
+                      self.from_id, self.position, self.is_agg)
+
+    def equal(self, other: Expression) -> bool:
+        return (isinstance(other, Column) and other.from_id == self.from_id
+                and other.position == self.position)
+
+    def __repr__(self):
+        parts = [p for p in (self.db_name, self.tbl_name, self.col_name) if p]
+        return ".".join(parts) or f"col#{self.position}"
+
+
+class Constant(Expression):
+    def __init__(self, value: Datum, ret_type: FieldType | None = None):
+        self.value = value
+        self.ret_type = ret_type or _infer_const_type(value)
+
+    def eval(self, row=None) -> Datum:
+        return self.value
+
+    def clone(self) -> "Constant":
+        return Constant(self.value, self.ret_type)
+
+    def equal(self, other: Expression) -> bool:
+        from tidb_tpu.types.datum import compare_datum
+        if not isinstance(other, Constant):
+            return False
+        if self.value.is_null() or other.value.is_null():
+            return self.value.is_null() and other.value.is_null()
+        try:
+            return compare_datum(self.value, other.value) == 0
+        except errors.TiDBError:
+            return False
+
+    def __repr__(self):
+        return repr(self.value.val) if not self.value.is_null() else "NULL"
+
+
+def _infer_const_type(d: Datum) -> FieldType:
+    from tidb_tpu.types.datum import Kind
+    m = {Kind.NULL: my.TypeNull, Kind.INT64: my.TypeLonglong,
+         Kind.UINT64: my.TypeLonglong, Kind.FLOAT64: my.TypeDouble,
+         Kind.STRING: my.TypeVarString, Kind.BYTES: my.TypeBlob,
+         Kind.DECIMAL: my.TypeNewDecimal, Kind.DURATION: my.TypeDuration,
+         Kind.TIME: my.TypeDatetime}
+    ft = new_field_type(m.get(d.kind, my.TypeNull))
+    if d.kind == Kind.UINT64:
+        ft.flag |= my.UnsignedFlag
+    return ft
+
+
+class ScalarFunction(Expression):
+    """Named function over child expressions.
+
+    Operator expressions are ScalarFunctions with reserved names carrying an
+    `op` (so expr→copr conversion is uniform); builtins dispatch by name into
+    expression.builtin.FUNCS, mirroring evaluator.Funcs (evaluator/builtin.go:43).
+    """
+
+    def __init__(self, func_name: str, args: list[Expression],
+                 ret_type: FieldType | None = None, op: Op | None = None):
+        self.func_name = func_name
+        self.args = args
+        self.op = op
+        self.ret_type = ret_type or new_field_type(my.TypeNull)
+
+    def eval(self, row: list[Datum]) -> Datum:
+        from tidb_tpu.expression import builtin
+        op = self.op
+        if op is not None:
+            if len(self.args) == 1:
+                return xops.compute_unary(op, self.args[0].eval(row))
+            a = self.args[0].eval(row)
+            # short-circuit AND/OR without evaluating the right side on
+            # a determined left (matches evaluator lazy logic eval)
+            if op == Op.AndAnd and xops.datum_truth(a) is False:
+                return xops.FALSE
+            if op == Op.OrOr and xops.datum_truth(a) is True:
+                return xops.TRUE
+            b = self.args[1].eval(row)
+            return xops.compute_binary(op, a, b)
+        return builtin.call(self.func_name, self.args, row)
+
+    def clone(self) -> "ScalarFunction":
+        return ScalarFunction(self.func_name, [a.clone() for a in self.args],
+                              self.ret_type, self.op)
+
+    def equal(self, other: Expression) -> bool:
+        return (isinstance(other, ScalarFunction)
+                and other.func_name == self.func_name and other.op == self.op
+                and len(other.args) == len(self.args)
+                and all(a.equal(b) for a, b in zip(self.args, other.args)))
+
+    def __repr__(self):
+        if self.op is not None and len(self.args) == 2:
+            return f"({self.args[0]!r} {self.op.sql()} {self.args[1]!r})"
+        if self.op is not None and len(self.args) == 1:
+            return f"({self.op.sql()} {self.args[0]!r})"
+        return f"{self.func_name}({', '.join(map(repr, self.args))})"
+
+
+def new_op(op: Op, *args: Expression, ret_type: FieldType | None = None) -> ScalarFunction:
+    rt = ret_type
+    if rt is None:
+        if op in (Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE, Op.NullEQ,
+                  Op.AndAnd, Op.OrOr, Op.Xor, Op.Not, Op.UnaryNot):
+            rt = new_field_type(my.TypeLonglong)
+        elif op in (Op.Plus, Op.Minus, Op.Mul, Op.Div, Op.IntDiv, Op.Mod):
+            from tidb_tpu.types.field_type import merge_numeric
+            if len(args) == 2:
+                rt = merge_numeric(args[0].ret_type, args[1].ret_type)
+                if op == Op.Div and rt.tp not in (my.TypeDouble, my.TypeFloat):
+                    rt = new_field_type(my.TypeNewDecimal)
+            else:
+                rt = args[0].ret_type.clone()
+        elif op in (Op.UnaryMinus, Op.UnaryPlus):
+            rt = args[0].ret_type.clone()
+        else:
+            rt = new_field_type(my.TypeLonglong)
+            rt.flag |= my.UnsignedFlag
+    return ScalarFunction(f"op_{op.name.lower()}", list(args), rt, op=op)
+
+
+TRUE_EXPR = Constant(Datum.i64(1))
+FALSE_EXPR = Constant(Datum.i64(0))
+NULL_EXPR = Constant(NULL)
+
+
+def compose_cnf(conditions: list[Expression]) -> Expression | None:
+    """AND a condition list into one expression (pushdown wire format)."""
+    if not conditions:
+        return None
+    out = conditions[0]
+    for c in conditions[1:]:
+        out = new_op(Op.AndAnd, out, c)
+    return out
+
+
+def split_cnf(expr: Expression | None) -> list[Expression]:
+    """Flatten nested ANDs (plan/util SplitCNFItems equivalent)."""
+    if expr is None:
+        return []
+    if isinstance(expr, ScalarFunction) and expr.op == Op.AndAnd:
+        return split_cnf(expr.args[0]) + split_cnf(expr.args[1])
+    return [expr]
+
+
+class Schema:
+    """Output column list of a plan node. Reference: expression/schema.go."""
+
+    def __init__(self, columns: list[Column] | None = None):
+        self.columns: list[Column] = columns or []
+
+    def __len__(self):
+        return len(self.columns)
+
+    def __iter__(self):
+        return iter(self.columns)
+
+    def __getitem__(self, i) -> Column:
+        return self.columns[i]
+
+    def append(self, col: Column) -> None:
+        self.columns.append(col)
+
+    def clone(self) -> "Schema":
+        return Schema([c.clone() for c in self.columns])
+
+    def column_index(self, col: Column) -> int:
+        for i, c in enumerate(self.columns):
+            if c.equal(col):
+                return i
+        return -1
+
+    def find_column(self, db: str, tbl: str, name: str) -> Column | None:
+        """Name-based lookup with ambiguity detection (resolver rules)."""
+        name = name.lower()
+        found: Column | None = None
+        for c in self.columns:
+            if c.col_name.lower() != name:
+                continue
+            if tbl and c.tbl_name.lower() != tbl.lower():
+                continue
+            if db and c.db_name.lower() != db.lower():
+                continue
+            if found is not None:
+                raise errors.PlanError(f"column '{name}' is ambiguous")
+            found = c
+        return found
+
+    def retrieve_positions(self) -> None:
+        """Renumber position/index to the current layout."""
+        for i, c in enumerate(self.columns):
+            c.position = i
+
+    def set_from(self, from_id: str) -> None:
+        for c in self.columns:
+            c.from_id = from_id

@@ -1,0 +1,287 @@
+"""Scalar compute core: binary/unary ops, LIKE, IN over Datums.
+
+Reference: evaluator/binop.go, evaluator/unaryop.go, distsql/xeval's
+eval_compare_ops.go / eval_arithmetic_ops.go / eval_logic_ops.go /
+eval_bit_ops.go. This one module is shared by the SQL-side evaluator
+(expression.ScalarFunction) and the CPU coprocessor (copr.xeval) so both
+sides of the pushdown boundary agree exactly on semantics — the parity
+oracle for the TPU kernels depends on that.
+
+NULL rules (three-valued logic):
+  - comparisons with a NULL operand yield NULL (except <=> which treats
+    NULL = NULL as true);
+  - AND: false dominates NULL; OR: true dominates NULL; XOR/NOT propagate;
+  - arithmetic and bit ops propagate NULL.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal, ROUND_HALF_UP
+import re
+
+from tidb_tpu import errors
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, Kind, compare_datum
+
+_I64_MIN = -(1 << 63)
+_I64_MAX = (1 << 63) - 1
+_U64_MAX = (1 << 64) - 1
+
+TRUE = Datum.i64(1)
+FALSE = Datum.i64(0)
+
+
+def bool_datum(b: bool) -> Datum:
+    return TRUE if b else FALSE
+
+
+def datum_truth(d: Datum) -> bool | None:
+    """SQL truthiness: NULL→None, else number != 0."""
+    if d.is_null():
+        return None
+    n = d.as_number()
+    return n != 0
+
+
+def _check_int_range(v: int, unsigned: bool = False) -> int:
+    if unsigned:
+        if 0 <= v <= _U64_MAX:
+            return v
+    elif _I64_MIN <= v <= _I64_MAX:
+        return v
+    raise errors.OverflowError_(f"BIGINT value is out of range: {v}")
+
+
+def compute_arith(op: Op, a: Datum, b: Datum) -> Datum:
+    """Reference: evaluator ComputeArithmetic (used by local_aggregate.go:233)."""
+    if a.is_null() or b.is_null():
+        return NULL
+    x, y = a.as_number(), b.as_number()
+    if op == Op.Plus:
+        return _num_result(_coerced(x, y, lambda p, q: p + q), a, b)
+    if op == Op.Minus:
+        return _num_result(_coerced(x, y, lambda p, q: p - q), a, b)
+    if op == Op.Mul:
+        return _num_result(_coerced(x, y, lambda p, q: p * q), a, b)
+    if op == Op.Div:
+        # MySQL `/`: exact operands → decimal, any float → float; x/0 → NULL
+        if isinstance(x, float) or isinstance(y, float):
+            if float(y) == 0.0:
+                return NULL
+            return Datum.f64(float(x) / float(y))
+        if y == 0:
+            return NULL
+        return Datum.dec(Decimal(x) / Decimal(y))
+    if op == Op.IntDiv:
+        if isinstance(x, float) or isinstance(y, float) or \
+                isinstance(x, Decimal) or isinstance(y, Decimal):
+            if float(y) == 0.0:
+                return NULL
+            q = Decimal(str(x)) / Decimal(str(y))
+            return Datum.i64(_check_int_range(int(q.to_integral_value(rounding="ROUND_DOWN"))))
+        if y == 0:
+            return NULL
+        # Go integer division truncates toward zero
+        q = abs(x) // abs(y)
+        if (x < 0) != (y < 0):
+            q = -q
+        return Datum.i64(_check_int_range(q))
+    if op == Op.Mod:
+        if float(y) == 0.0:
+            return NULL
+        if isinstance(x, float) or isinstance(y, float):
+            import math
+            return Datum.f64(math.fmod(float(x), float(y)))
+        if isinstance(x, Decimal) or isinstance(y, Decimal):
+            dx, dy = Decimal(str(x)), Decimal(str(y))
+            return Datum.dec(dx - dy * (dx / dy).to_integral_value(rounding="ROUND_DOWN"))
+        # MySQL % keeps the sign of the dividend (Go semantics)
+        r = abs(x) % abs(y)
+        return Datum.i64(-r if x < 0 else r)
+    raise errors.TypeError_(f"unknown arithmetic op {op!r}")
+
+
+def _coerced(x, y, fn):
+    if isinstance(x, float) or isinstance(y, float):
+        return fn(float(x), float(y))
+    if isinstance(x, Decimal) or isinstance(y, Decimal):
+        return fn(Decimal(str(x)) if not isinstance(x, Decimal) else x,
+                  Decimal(str(y)) if not isinstance(y, Decimal) else y)
+    return fn(x, y)
+
+
+def _num_result(v, a: Datum, b: Datum) -> Datum:
+    if isinstance(v, float):
+        return Datum.f64(v)
+    if isinstance(v, Decimal):
+        return Datum.dec(v)
+    unsigned = a.kind == Kind.UINT64 and b.kind == Kind.UINT64
+    return Datum.u64(_check_int_range(v, True)) if unsigned \
+        else Datum.i64(_check_int_range(v))
+
+
+def compute_compare(op: Op, a: Datum, b: Datum) -> Datum:
+    if op == Op.NullEQ:
+        if a.is_null() and b.is_null():
+            return TRUE
+        if a.is_null() or b.is_null():
+            return FALSE
+        return bool_datum(compare_datum(a, b) == 0)
+    if a.is_null() or b.is_null():
+        return NULL
+    c = compare_datum(a, b)
+    if op == Op.EQ:
+        return bool_datum(c == 0)
+    if op == Op.NE:
+        return bool_datum(c != 0)
+    if op == Op.LT:
+        return bool_datum(c < 0)
+    if op == Op.LE:
+        return bool_datum(c <= 0)
+    if op == Op.GT:
+        return bool_datum(c > 0)
+    if op == Op.GE:
+        return bool_datum(c >= 0)
+    raise errors.TypeError_(f"unknown comparison op {op!r}")
+
+
+def compute_logic(op: Op, a: Datum, b: Datum) -> Datum:
+    ta, tb = datum_truth(a), datum_truth(b)
+    if op == Op.AndAnd:
+        if ta is False or tb is False:
+            return FALSE
+        if ta is None or tb is None:
+            return NULL
+        return TRUE
+    if op == Op.OrOr:
+        if ta is True or tb is True:
+            return TRUE
+        if ta is None or tb is None:
+            return NULL
+        return FALSE
+    if op == Op.Xor:
+        if ta is None or tb is None:
+            return NULL
+        return bool_datum(ta != tb)
+    raise errors.TypeError_(f"unknown logic op {op!r}")
+
+
+def _to_uint64(d: Datum) -> int:
+    n = d.as_number()
+    if isinstance(n, (float, Decimal)):
+        n = int(Decimal(str(n)).to_integral_value(rounding=ROUND_HALF_UP))
+    return n & _U64_MAX
+
+
+def compute_bit(op: Op, a: Datum, b: Datum) -> Datum:
+    """MySQL bit ops operate on uint64."""
+    if a.is_null() or b.is_null():
+        return NULL
+    x, y = _to_uint64(a), _to_uint64(b)
+    if op == Op.BitAnd:
+        return Datum.u64(x & y)
+    if op == Op.BitOr:
+        return Datum.u64(x | y)
+    if op == Op.BitXor:
+        return Datum.u64(x ^ y)
+    if op == Op.LeftShift:
+        return Datum.u64((x << y) & _U64_MAX if y < 64 else 0)
+    if op == Op.RightShift:
+        return Datum.u64(x >> y if y < 64 else 0)
+    raise errors.TypeError_(f"unknown bit op {op!r}")
+
+
+def compute_binary(op: Op, a: Datum, b: Datum) -> Datum:
+    if op in (Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE, Op.NullEQ):
+        return compute_compare(op, a, b)
+    if op in (Op.Plus, Op.Minus, Op.Mul, Op.Div, Op.IntDiv, Op.Mod):
+        return compute_arith(op, a, b)
+    if op in (Op.AndAnd, Op.OrOr, Op.Xor):
+        return compute_logic(op, a, b)
+    return compute_bit(op, a, b)
+
+
+def compute_unary(op: Op, a: Datum) -> Datum:
+    if a.is_null():
+        return NULL
+    if op in (Op.UnaryNot, Op.Not):
+        t = datum_truth(a)
+        return NULL if t is None else bool_datum(not t)
+    if op == Op.UnaryMinus:
+        n = a.as_number()
+        if isinstance(n, float):
+            return Datum.f64(-n)
+        if isinstance(n, Decimal):
+            return Datum.dec(-n)
+        return Datum.i64(_check_int_range(-n))
+    if op == Op.UnaryPlus:
+        return a
+    if op == Op.BitNeg:
+        return Datum.u64(~_to_uint64(a) & _U64_MAX)
+    raise errors.TypeError_(f"unknown unary op {op!r}")
+
+
+# ---- LIKE ----
+
+_like_cache: dict[tuple[str, str], re.Pattern] = {}
+
+
+def _like_regex(pattern: str, escape: str) -> re.Pattern:
+    key = (pattern, escape)
+    pat = _like_cache.get(key)
+    if pat is None:
+        out, i = [], 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if escape and ch == escape and i + 1 < len(pattern):
+                out.append(re.escape(pattern[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(ch))
+            i += 1
+        # MySQL LIKE on the default collation is case-insensitive
+        pat = re.compile("^" + "".join(out) + "$", re.IGNORECASE | re.DOTALL)
+        _like_cache[key] = pat
+    return pat
+
+
+def compute_like(target: Datum, pattern: Datum, escape: str = "\\",
+                 negated: bool = False) -> Datum:
+    if target.is_null() or pattern.is_null():
+        return NULL
+    s = target.get_string() if target.kind in (Kind.STRING, Kind.BYTES) \
+        else _datum_to_str(target)
+    p = pattern.get_string()
+    matched = _like_regex(p, escape).match(s) is not None
+    return bool_datum(matched != negated)
+
+
+def _datum_to_str(d: Datum) -> str:
+    if d.kind in (Kind.STRING, Kind.BYTES):
+        return d.get_string()
+    if d.kind == Kind.FLOAT64:
+        v = d.val
+        return str(int(v)) if v == int(v) else repr(v)
+    return str(d.val)
+
+
+def compute_in(v: Datum, items: list[Datum], negated: bool = False) -> Datum:
+    """IN list semantics: match → true; no match and any NULL → NULL."""
+    if v.is_null():
+        return NULL
+    has_null = False
+    for it in items:
+        if it.is_null():
+            has_null = True
+            continue
+        if compare_datum(v, it) == 0:
+            return bool_datum(not negated)
+    if has_null:
+        return NULL
+    return bool_datum(negated)

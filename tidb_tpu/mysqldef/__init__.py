@@ -120,3 +120,7 @@ ErrDupKeyName = 1061
 ErrDBCreateExists = 1007
 ErrDBDropExists = 1008
 ErrAccessDenied = 1045
+
+# server version string reported by version() and the wire handshake
+# (reference: mysql/const.go ServerVersion)
+SERVER_VERSION = "5.7.1-TiDB-TPU-1.0"
