@@ -1,0 +1,29 @@
+"""Coprocessor protocol + execution engines.
+
+This package is the pushdown boundary of the framework — the equivalent of
+the reference's tipb protocol (_vendor .../tipb/go-tipb/select.pb.go) plus
+the engines that execute pushed-down requests:
+
+  proto.py          SelectRequest/SelectResponse/Expr — the wire IR
+  xeval.py          interpreted Expr evaluation over rows (distsql/xeval)
+  region_handler.py CPU engine: scan+filter+topn+partial agg per key range
+                    (store/localstore/local_region.go Handle)
+
+The TPU engine (tidb_tpu.ops) consumes the same proto IR but compiles Expr
+trees to vectorized JAX/Pallas kernels over columnar batches instead of
+interpreting them per row — the CPU engine here is the parity oracle.
+"""
+
+from tidb_tpu.copr.proto import (
+    Expr, ExprType, SelectRequest, SelectResponse, Chunk, ByItem,
+    PBColumnInfo, PBTableInfo, PBIndexInfo,
+    columns_to_proto, index_to_proto, field_type_from_pb_column,
+    expr_value, expr_column, expr_op, expr_agg,
+)
+
+__all__ = [
+    "Expr", "ExprType", "SelectRequest", "SelectResponse", "Chunk", "ByItem",
+    "PBColumnInfo", "PBTableInfo", "PBIndexInfo",
+    "columns_to_proto", "index_to_proto", "field_type_from_pb_column",
+    "expr_value", "expr_column", "expr_op", "expr_agg",
+]

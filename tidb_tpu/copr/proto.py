@@ -1,0 +1,262 @@
+"""The pushdown wire IR: SelectRequest / SelectResponse / Expr.
+
+Reference: tipb's select.proto generated Go
+(_vendor/src/github.com/pingcap/tipb/go-tipb/select.pb.go:75 SelectRequest,
+:254 SelectResponse, expression.pb.go Expr/ExprType) and the proto helpers in
+distsql/distsql.go:362-460 (ColumnsToProto, IndexToProto,
+FieldTypeFromPBColumn).
+
+Values crossing this boundary are codec-encoded bytes (the storage wire
+format), so the engines on the far side — CPU interpreter or TPU kernels —
+never see planner objects; this is a real process-boundary-shaped contract
+even though round 1 runs it in-proc.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.codec import codec
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+from tidb_tpu.types.field_type import FieldType
+
+
+class ExprType(enum.IntEnum):
+    """Mirrors tipb.ExprType's shape: value leaves, column ref, operators by
+    Op code, named control/string funcs, aggregates."""
+    # leaves
+    NULL = 0
+    VALUE = 1         # any literal; datum in Expr.val
+    COLUMN_REF = 2    # column id in Expr.val (int datum)
+    # composite
+    OPERATOR = 10     # Expr.op holds the opcode; 1-2 children
+    LIKE = 20         # children: [target, pattern]; val: escape str
+    NOT_LIKE = 21
+    IN = 22           # children: [target, item...]
+    NOT_IN = 23
+    IS_NULL = 24
+    IS_NOT_NULL = 25
+    IF = 30
+    IFNULL = 31
+    NULLIF = 32
+    COALESCE = 33
+    CASE = 34         # flattened case args (expression.builtin._case layout)
+    SCALAR_FUNC = 40  # generic builtin; name in Expr.val
+    # aggregates (tipb ExprType 3001-3008 family)
+    AGG_COUNT = 3001
+    AGG_SUM = 3002
+    AGG_AVG = 3003
+    AGG_MIN = 3004
+    AGG_MAX = 3005
+    AGG_FIRST = 3006
+    AGG_GROUP_CONCAT = 3007
+    AGG_DISTINCT = 3010  # wraps another agg; distinct marker
+
+
+AGG_TYPES = frozenset((ExprType.AGG_COUNT, ExprType.AGG_SUM, ExprType.AGG_AVG,
+                       ExprType.AGG_MIN, ExprType.AGG_MAX, ExprType.AGG_FIRST,
+                       ExprType.AGG_GROUP_CONCAT))
+
+AGG_NAME = {
+    ExprType.AGG_COUNT: "count", ExprType.AGG_SUM: "sum",
+    ExprType.AGG_AVG: "avg", ExprType.AGG_MIN: "min",
+    ExprType.AGG_MAX: "max", ExprType.AGG_FIRST: "first_row",
+    ExprType.AGG_GROUP_CONCAT: "group_concat",
+}
+AGG_TYPE_BY_NAME = {v: k for k, v in AGG_NAME.items()}
+
+
+@dataclass
+class Expr:
+    tp: ExprType
+    val: Datum | int | str | None = None
+    op: Op | None = None
+    children: list["Expr"] = field(default_factory=list)
+    distinct: bool = False  # aggregates only
+
+    def __repr__(self):
+        if self.tp == ExprType.VALUE:
+            return repr(self.val)
+        if self.tp == ExprType.COLUMN_REF:
+            return f"col#{self.val}"
+        if self.tp == ExprType.OPERATOR:
+            if len(self.children) == 2:
+                return f"({self.children[0]!r} {self.op.sql()} {self.children[1]!r})"
+            return f"({self.op.sql()} {self.children[0]!r})"
+        name = AGG_NAME.get(self.tp) or (self.val if self.tp == ExprType.SCALAR_FUNC
+                                         else self.tp.name.lower())
+        d = "distinct " if self.distinct else ""
+        return f"{name}({d}{', '.join(map(repr, self.children))})"
+
+
+def expr_value(d: Datum) -> Expr:
+    return Expr(ExprType.VALUE, val=d)
+
+
+def expr_column(col_id: int) -> Expr:
+    return Expr(ExprType.COLUMN_REF, val=col_id)
+
+
+def expr_op(op: Op, *children: Expr) -> Expr:
+    return Expr(ExprType.OPERATOR, op=op, children=list(children))
+
+
+def expr_agg(name: str, children: list[Expr], distinct: bool = False) -> Expr:
+    return Expr(AGG_TYPE_BY_NAME[name], children=children, distinct=distinct)
+
+
+@dataclass
+class PBColumnInfo:
+    """tipb.ColumnInfo — column metadata the coprocessor needs to decode and
+    type rows (distsql.ColumnToProto, distsql/distsql.go:404-421)."""
+    column_id: int
+    tp: int
+    flag: int = 0
+    flen: int = -1
+    decimal: int = -1
+    pk_handle: bool = False    # this column IS the integer handle
+    elems: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PBTableInfo:
+    table_id: int
+    columns: list[PBColumnInfo]
+
+
+@dataclass
+class PBIndexInfo:
+    table_id: int
+    index_id: int
+    columns: list[PBColumnInfo]  # indexed columns, in index order
+    unique: bool = False
+
+
+@dataclass
+class ByItem:
+    expr: Expr
+    desc: bool = False
+
+
+@dataclass
+class SelectRequest:
+    """tipb.SelectRequest (select.pb.go:75). Exactly one of table_info /
+    index_info is set; that chooses row-key vs index-key interpretation of
+    the attached KeyRanges (kv.Request carries those)."""
+    start_ts: int
+    table_info: PBTableInfo | None = None
+    index_info: PBIndexInfo | None = None
+    where: Expr | None = None
+    group_by: list[ByItem] = field(default_factory=list)
+    having: Expr | None = None
+    order_by: list[ByItem] = field(default_factory=list)
+    limit: int | None = None
+    aggregates: list[Expr] = field(default_factory=list)
+    desc: bool = False                    # scan direction
+    time_zone_offset: int = 0
+    flags: int = 0
+
+    def is_agg(self) -> bool:
+        return bool(self.aggregates) or bool(self.group_by)
+
+
+@dataclass
+class RowMeta:
+    handle: int
+    length: int
+
+
+@dataclass
+class Chunk:
+    """tipb.Chunk: rows packed as codec-encoded bytes + per-row meta.
+    The coprocessor emits ~64 rows per chunk (local_region.go getChunk)."""
+    rows_data: bytes = b""
+    rows_meta: list[RowMeta] = field(default_factory=list)
+
+
+@dataclass
+class SelectResponse:
+    chunks: list[Chunk] = field(default_factory=list)
+    error: str | None = None
+    # columnar fast path (TPU engine): decoded result columns, bypassing
+    # row-chunk encode/decode when both ends are in-proc. None → use chunks.
+    columnar: object | None = None
+
+    def row_count(self) -> int:
+        return sum(len(c.rows_meta) for c in self.chunks)
+
+
+class ChunkWriter:
+    """Packs datum rows into Chunks of `rows_per_chunk` rows."""
+
+    def __init__(self, rows_per_chunk: int = 64):
+        self.chunks: list[Chunk] = []
+        self._cur_data = bytearray()
+        self._cur_meta: list[RowMeta] = []
+        self.rows_per_chunk = rows_per_chunk
+
+    def append_row(self, handle: int, datums: list[Datum]) -> None:
+        data = codec.encode_value(datums)
+        self._cur_data.extend(data)
+        self._cur_meta.append(RowMeta(handle, len(data)))
+        if len(self._cur_meta) >= self.rows_per_chunk:
+            self._flush()
+
+    def append_encoded(self, handle: int, data: bytes) -> None:
+        self._cur_data.extend(data)
+        self._cur_meta.append(RowMeta(handle, len(data)))
+        if len(self._cur_meta) >= self.rows_per_chunk:
+            self._flush()
+
+    def _flush(self) -> None:
+        if self._cur_meta:
+            self.chunks.append(Chunk(bytes(self._cur_data), self._cur_meta))
+            self._cur_data = bytearray()
+            self._cur_meta = []
+
+    def finish(self) -> list[Chunk]:
+        self._flush()
+        return self.chunks
+
+
+def iter_response_rows(resp: SelectResponse):
+    """Yield (handle, datums) decoded from chunks — partialResult.Next's
+    chunk-wise decode (distsql/distsql.go:192,253)."""
+    for chunk in resp.chunks:
+        pos = 0
+        mv = memoryview(chunk.rows_data)
+        for meta in chunk.rows_meta:
+            row_bytes = bytes(mv[pos:pos + meta.length])
+            pos += meta.length
+            yield meta.handle, codec.decode_all(row_bytes)
+
+
+# ---- proto helpers (distsql/distsql.go:362-460) ----
+
+def column_to_proto(col, pk_is_handle: bool = False) -> PBColumnInfo:
+    """model.ColumnInfo → PBColumnInfo."""
+    ft = col.field_type
+    return PBColumnInfo(
+        column_id=col.id, tp=ft.tp, flag=ft.flag, flen=ft.flen,
+        decimal=ft.decimal, elems=list(ft.elems),
+        pk_handle=pk_is_handle and my.has_pri_key_flag(ft.flag))
+
+
+def columns_to_proto(columns, pk_is_handle: bool = False) -> list[PBColumnInfo]:
+    return [column_to_proto(c, pk_is_handle) for c in columns]
+
+
+def index_to_proto(tbl_info, idx_info) -> PBIndexInfo:
+    cols_by_name = {c.name.lower(): c for c in tbl_info.columns}
+    pb_cols = [column_to_proto(cols_by_name[ic.name.lower()])
+               for ic in idx_info.columns]
+    return PBIndexInfo(table_id=tbl_info.id, index_id=idx_info.id,
+                       columns=pb_cols, unique=idx_info.unique)
+
+
+def field_type_from_pb_column(col: PBColumnInfo) -> FieldType:
+    return FieldType(tp=col.tp, flag=col.flag, flen=col.flen,
+                     decimal=col.decimal, elems=list(col.elems))

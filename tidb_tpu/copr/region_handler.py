@@ -1,0 +1,251 @@
+"""CPU coprocessor engine: executes a SelectRequest over key ranges.
+
+Reference: store/localstore/local_region.go:189 (localRegion.Handle) and
+local_aggregate.go (partial aggregation). Pipeline per request:
+
+    range scan → row decode → xeval where-filter → (topn | aggregate | emit)
+
+Aggregate output rows are `[groupKey, cnt?, val?...]` partials
+(local_region.go:357-391) — the upper FinalMode aggregate merges them. The
+same handler serves table requests (row keys) and index requests (index
+keys). The TPU engine (tidb_tpu.ops) implements this same contract over
+columnar batches; this module is its parity oracle.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+from tidb_tpu import errors, mysqldef as my, tablecodec
+from tidb_tpu.codec import codec
+from tidb_tpu.copr.proto import (
+    AGG_NAME, ByItem, ChunkWriter, Expr, PBColumnInfo, SelectRequest,
+    SelectResponse,
+)
+from tidb_tpu.copr.xeval import Evaluator, _BoundChild
+from tidb_tpu.expression.aggregation import AggregationFunction
+from tidb_tpu.expression import ops as xops
+from tidb_tpu.kv.kv import KeyRange
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import compare_datum
+
+
+def handle_request(snapshot, req: SelectRequest,
+                   ranges: list[KeyRange]) -> SelectResponse:
+    """Entry point — one region's share of a coprocessor request."""
+    ctx = _SelectContext(snapshot, req)
+    try:
+        if req.table_info is not None:
+            for rg in ranges:
+                ctx.scan_table_range(rg)
+        elif req.index_info is not None:
+            for rg in ranges:
+                ctx.scan_index_range(rg)
+        else:
+            raise errors.ExecError("SelectRequest has neither table nor index info")
+        return ctx.finish()
+    except errors.TiDBError as e:
+        return SelectResponse(error=str(e))
+
+
+class _SelectContext:
+    """Reference: selectContext (local_region.go:165)."""
+
+    def __init__(self, snapshot, req: SelectRequest):
+        self.snap = snapshot
+        self.req = req
+        self.ev = Evaluator()
+        self.writer = ChunkWriter()
+        self.count = 0
+        self.limit = req.limit
+
+        cols = (req.table_info.columns if req.table_info
+                else req.index_info.columns)
+        self.columns: list[PBColumnInfo] = cols
+        self.pk_col: PBColumnInfo | None = next(
+            (c for c in cols if c.pk_handle), None)
+
+        self.aggs: list[AggregationFunction] = []
+        self.agg_ctxs: dict[bytes, list] = {}
+        self.group_keys: list[bytes] = []  # insertion-ordered
+        if req.is_agg():
+            for e in req.aggregates:
+                name = AGG_NAME[e.tp]
+                args = [_BoundChild(self.ev, c) for c in e.children]
+                self.aggs.append(AggregationFunction(name, args,
+                                                     distinct=e.distinct))
+
+        # TopN state: heap of (inverted sort key, seq, row) keeping the best
+        # `limit` rows (topnHeap, local_region.go:97)
+        self.topn = bool(req.order_by) and req.limit is not None \
+            and not req.is_agg()
+        self._heap: list = []
+        self._seq = 0
+
+    # ---- scans ----
+
+    def scan_table_range(self, rg: KeyRange) -> None:
+        it = (self.snap.iterate_reverse(rg.start, rg.end) if self.req.desc
+              else self.snap.iterate(rg.start, rg.end))
+        for key, value in it:
+            if self._done():
+                return
+            try:
+                _, handle = tablecodec.decode_row_key(key)
+            except errors.TiDBError:
+                continue
+            row = tablecodec.decode_row(value)
+            self._fill_handle(row, handle)
+            self._process_row(handle, row)
+
+    def scan_index_range(self, rg: KeyRange) -> None:
+        n_vals = len(self.columns)
+        has_pk = self.pk_col is not None
+        n_idx_vals = n_vals - 1 if has_pk else n_vals
+        it = (self.snap.iterate_reverse(rg.start, rg.end) if self.req.desc
+              else self.snap.iterate(rg.start, rg.end))
+        for key, value in it:
+            if self._done():
+                return
+            values, suffix = tablecodec.cut_index_key(key, n_idx_vals)
+            if suffix:
+                handle = tablecodec.decode_handle_from_index_suffix(suffix)
+            else:
+                # unique index: handle lives in the value (table.Index.create)
+                handle = int(value)
+            row = {c.column_id: v
+                   for c, v in zip(self.columns, values)}
+            if has_pk:
+                self._fill_handle(row, handle)
+            self._process_row(handle, row)
+
+    def _fill_handle(self, row: dict[int, Datum], handle: int) -> None:
+        if self.pk_col is not None:
+            d = Datum.u64(handle) if my.has_unsigned_flag(self.pk_col.flag) \
+                else Datum.i64(handle)
+            row[self.pk_col.column_id] = d
+
+    def _done(self) -> bool:
+        return (self.limit is not None and not self.topn
+                and not self.req.is_agg() and self.count >= self.limit)
+
+    # ---- per-row pipeline ----
+
+    def _process_row(self, handle: int, row: dict[int, Datum]) -> None:
+        self.ev.row = row
+        if self.req.where is not None:
+            if xops.datum_truth(self.ev.eval(self.req.where)) is not True:
+                return
+        if self.req.is_agg():
+            self._aggregate_row(row)
+            return
+        if self.topn:
+            self._topn_row(handle, row)
+            return
+        self.count += 1
+        self.writer.append_row(handle, self._output_row(row))
+
+    def _output_row(self, row: dict[int, Datum]) -> list[Datum]:
+        from tidb_tpu.types.datum import NULL
+        return [row.get(c.column_id, NULL) for c in self.columns]
+
+    # ---- aggregation (local_aggregate.go) ----
+
+    def _group_key(self) -> bytes:
+        if not self.req.group_by:
+            return b""
+        vals = [self.ev.eval(item.expr) for item in self.req.group_by]
+        return codec.encode_value(vals)
+
+    def _aggregate_row(self, row: dict[int, Datum]) -> None:
+        gk = self._group_key()
+        ctxs = self.agg_ctxs.get(gk)
+        if ctxs is None:
+            ctxs = [a.create_context() for a in self.aggs]
+            self.agg_ctxs[gk] = ctxs
+            self.group_keys.append(gk)
+        for agg, ctx in zip(self.aggs, ctxs):
+            # args are bound to self.ev which already points at `row`
+            agg.update(ctx, None)
+
+    # ---- topn ----
+
+    def _sort_key(self, row: dict[int, Datum]) -> list:
+        return [self.ev.eval(item.expr) for item in self.req.order_by]
+
+    def _topn_row(self, handle: int, row: dict[int, Datum]) -> None:
+        key = self._sort_key(row)
+        entry = _TopNEntry(key, [d.desc for d in self.req.order_by])
+        item = (entry, self._seq, handle, self._output_row(row))
+        self._seq += 1
+        if len(self._heap) < self.limit:
+            heapq.heappush(self._heap, _Inverted(item))
+        elif self._heap and _Inverted(item) > self._heap[0]:
+            heapq.heapreplace(self._heap, _Inverted(item))
+
+    # ---- output ----
+
+    def finish(self) -> SelectResponse:
+        if self.req.is_agg():
+            for gk in self.group_keys:
+                ctxs = self.agg_ctxs[gk]
+                out = [Datum.bytes_(gk)]
+                for agg, ctx in zip(self.aggs, ctxs):
+                    out.extend(agg.get_partial_result(ctx))
+                self.writer.append_row(0, out)
+        elif self.topn:
+            items = sorted((inv.item for inv in self._heap),
+                           key=lambda it: it[0])
+            for entry, _, handle, out in items:
+                self.writer.append_row(handle, out)
+        return SelectResponse(chunks=self.writer.finish())
+
+
+class _TopNEntry:
+    """Sort key with per-column desc flags; orders ascending in 'better
+    first' terms so the heap keeps the top-N."""
+
+    __slots__ = ("vals", "descs")
+
+    def __init__(self, vals: list[Datum], descs: list[bool]):
+        self.vals = vals
+        self.descs = descs
+
+    def compare(self, other: "_TopNEntry") -> int:
+        for a, b, desc in zip(self.vals, other.vals, self.descs):
+            c = compare_datum(a, b)
+            if c != 0:
+                return -c if desc else c
+        return 0
+
+    def __lt__(self, other):
+        return self.compare(other) < 0
+
+    def __gt__(self, other):
+        return self.compare(other) > 0
+
+    def __eq__(self, other):
+        return self.compare(other) == 0
+
+
+class _Inverted:
+    """Max-heap adapter over heapq's min-heap: 'worst kept row at top'."""
+
+    __slots__ = ("item",)
+
+    def __init__(self, item):
+        self.item = item
+
+    def _key(self):
+        return self.item[0], self.item[1]
+
+    def __lt__(self, other):          # self is "less" when it sorts LATER
+        a, sa = self._key()
+        b, sb = other._key()
+        c = a.compare(b)
+        if c != 0:
+            return c > 0
+        return sa > sb
+
+    def __gt__(self, other):
+        return other.__lt__(self)

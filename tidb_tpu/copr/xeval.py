@@ -1,0 +1,125 @@
+"""Interpreted evaluation of pushdown Expr trees over a row.
+
+Reference: distsql/xeval/eval.go:38 (Evaluator with row map[int64]Datum),
+Eval (:49) and the per-family files eval_compare_ops.go etc. Delegates all
+scalar semantics to expression.ops — the single compute core shared with the
+SQL-side evaluator — so pushdown cannot change results.
+
+This is the CPU reference engine the TPU kernels are differentially tested
+against ("result parity vs CPU xeval").
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.copr.proto import Expr, ExprType
+from tidb_tpu.expression import ops as xops
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL
+
+
+class Evaluator:
+    """Evaluates Expr trees; `row` maps column-id → Datum."""
+
+    __slots__ = ("row",)
+
+    def __init__(self):
+        self.row: dict[int, Datum] = {}
+
+    def eval(self, e: Expr) -> Datum:
+        tp = e.tp
+        if tp == ExprType.VALUE:
+            return e.val
+        if tp == ExprType.NULL:
+            return NULL
+        if tp == ExprType.COLUMN_REF:
+            try:
+                return self.row[e.val]
+            except KeyError:
+                raise errors.ExecError(f"column {e.val} not found in row")
+        if tp == ExprType.OPERATOR:
+            if len(e.children) == 1:
+                return xops.compute_unary(e.op, self.eval(e.children[0]))
+            from tidb_tpu.sqlast.opcode import Op
+            a = self.eval(e.children[0])
+            if e.op == Op.AndAnd and xops.datum_truth(a) is False:
+                return xops.FALSE
+            if e.op == Op.OrOr and xops.datum_truth(a) is True:
+                return xops.TRUE
+            return xops.compute_binary(e.op, a, self.eval(e.children[1]))
+        if tp in (ExprType.LIKE, ExprType.NOT_LIKE):
+            target = self.eval(e.children[0])
+            pattern = self.eval(e.children[1])
+            escape = e.val if isinstance(e.val, str) else "\\"
+            return xops.compute_like(target, pattern, escape,
+                                     negated=(tp == ExprType.NOT_LIKE))
+        if tp in (ExprType.IN, ExprType.NOT_IN):
+            v = self.eval(e.children[0])
+            items = [self.eval(c) for c in e.children[1:]]
+            return xops.compute_in(v, items, negated=(tp == ExprType.NOT_IN))
+        if tp == ExprType.IS_NULL:
+            return xops.bool_datum(self.eval(e.children[0]).is_null())
+        if tp == ExprType.IS_NOT_NULL:
+            return xops.bool_datum(not self.eval(e.children[0]).is_null())
+        if tp in _CONTROL:
+            return self._eval_named(_CONTROL[tp], e)
+        if tp == ExprType.CASE:
+            return self._eval_named("case", e)
+        if tp == ExprType.SCALAR_FUNC:
+            return self._eval_named(e.val, e)
+        raise errors.ExecError(f"xeval: unsupported expr type {tp!r}")
+
+    def _eval_named(self, name: str, e: Expr) -> Datum:
+        from tidb_tpu.expression import builtin
+        args = [_BoundChild(self, c) for c in e.children]
+        return builtin.call(name, args, None)
+
+
+_CONTROL = {
+    ExprType.IF: "if",
+    ExprType.IFNULL: "ifnull",
+    ExprType.NULLIF: "nullif",
+    ExprType.COALESCE: "coalesce",
+}
+
+
+class _BoundChild:
+    """Adapter presenting an Expr as an expression.Expression so builtin
+    control funcs can lazily evaluate arguments."""
+
+    __slots__ = ("ev", "expr")
+
+    def __init__(self, ev: Evaluator, expr: Expr):
+        self.ev = ev
+        self.expr = expr
+
+    def eval(self, row=None) -> Datum:
+        return self.ev.eval(self.expr)
+
+
+# capability probe — which expr shapes this engine supports
+# (store/localstore/local_client.go:39-90 SupportRequestType/supportExpr)
+def supported_expr(e: Expr) -> bool:
+    tp = e.tp
+    if tp in (ExprType.VALUE, ExprType.NULL, ExprType.COLUMN_REF):
+        return True
+    if tp == ExprType.OPERATOR:
+        return all(supported_expr(c) for c in e.children)
+    if tp in (ExprType.LIKE, ExprType.NOT_LIKE, ExprType.IN, ExprType.NOT_IN,
+              ExprType.IS_NULL, ExprType.IS_NOT_NULL, ExprType.IF,
+              ExprType.IFNULL, ExprType.NULLIF, ExprType.COALESCE,
+              ExprType.CASE):
+        return all(supported_expr(c) for c in e.children)
+    if tp == ExprType.SCALAR_FUNC:
+        from tidb_tpu.expression import builtin
+        return builtin.exists(e.val) and all(supported_expr(c)
+                                             for c in e.children)
+    from tidb_tpu.copr.proto import AGG_TYPES
+    if tp in AGG_TYPES:
+        # distinct aggregates are never pushed down: per-region distinct
+        # sets can't be merged by the FinalMode sum
+        # (plan/physical_plan_builder.go:797-809 has the same rule)
+        if e.distinct:
+            return False
+        return all(supported_expr(c) for c in e.children)
+    return False

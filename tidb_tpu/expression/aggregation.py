@@ -170,8 +170,6 @@ class AggregationFunction:
                 out.append(self.get_result(ctx))
             else:
                 out.append(ctx.value)
-        if not out:  # plain count carries its count as the single column
-            out.append(Datum.i64(ctx.count))
         return out
 
     def __repr__(self):

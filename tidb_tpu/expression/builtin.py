@@ -91,13 +91,17 @@ def _isnull(args, row):
     return xops.bool_datum(args[0].eval(row).is_null())
 
 
-@register("case", 1, -1)
+@register("case", 3, -1)
 def _case(args, row):
-    """Flattened CASE: [value?] (when, then)... [else]. The planner lowers
-    CaseExpr to this layout; compare-value CASE prepends the value."""
+    """Flattened CASE: [value?] (when, then)... else.
+
+    The ELSE arm is MANDATORY in this layout — the planner's lowering always
+    appends one (NULL when the SQL had no ELSE). That makes arity
+    unambiguous: searched CASE is 2k+1 args (odd), compare-value CASE is
+    value + 2k pairs + else = 2k+2 (even)."""
     i = 0
     n = len(args)
-    has_value = n % 2 == 0  # pairs + optional else is odd; +value flips parity
+    has_value = n % 2 == 0
     value = args[0].eval(row) if has_value else None
     if has_value:
         i = 1
@@ -629,9 +633,10 @@ def _unix_ts(args, row):
     d = args[0].eval(row)
     if d.is_null():
         return NULL
-    if d.kind == Kind.TIME:
-        return Datum.i64(int(d.val.dt.timestamp()))
-    return Datum.i64(0)
+    t = _as_time(d)
+    if t is None:
+        return Datum.i64(0)  # MySQL returns 0 for unparseable input
+    return Datum.i64(int(t.dt.timestamp()))
 
 
 def _as_time(d: Datum):

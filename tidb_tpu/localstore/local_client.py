@@ -1,0 +1,125 @@
+"""In-process coprocessor client for LocalStore.
+
+Reference: store/localstore/local_client.go — dbClient.Send builds per-region
+tasks by intersecting request ranges with region info (buildRegionTasks
+:169), executes them on a worker pool (:222-237), and streams one region's
+SelectResponse per Response.next(). SupportRequestType/supportExpr (:39-90)
+is the capability whitelist gating pushdown planning.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from tidb_tpu.copr.proto import Expr, SelectRequest
+from tidb_tpu.copr.region_handler import handle_request
+from tidb_tpu.copr.xeval import supported_expr
+from tidb_tpu.kv import kv
+from tidb_tpu.localstore.regions import RegionInfo
+
+
+class RegionTask:
+    __slots__ = ("region", "ranges")
+
+    def __init__(self, region: RegionInfo, ranges: list[kv.KeyRange]):
+        self.region = region
+        self.ranges = ranges
+
+
+def build_region_tasks(store, req: kv.Request) -> list[RegionTask]:
+    """Intersect request ranges with regions (local_client.go:169).
+    Tasks come back in region order; each holds its clipped ranges."""
+    by_region: dict[int, RegionTask] = {}
+    order: list[int] = []
+    for rg in req.key_ranges:
+        for region, lo, hi in store.regions.regions_for_range(rg.start, rg.end):
+            task = by_region.get(region.region_id)
+            if task is None:
+                task = RegionTask(region, [])
+                by_region[region.region_id] = task
+                order.append(region.region_id)
+            # hi=None means the unbounded last region; snapshot iteration
+            # accepts None as +inf so it propagates unchanged
+            task.ranges.append(kv.KeyRange(lo, hi))
+    # KeepOrder contract: tasks sorted by key, not by region id (split order)
+    tasks = [by_region[rid] for rid in order]
+    tasks.sort(key=lambda t: t.ranges[0].start)
+    if req.desc:
+        # desc scans deliver highest keys first: reverse task order and each
+        # task's range list (each range still scans reverse internally)
+        tasks.reverse()
+        for t in tasks:
+            t.ranges.reverse()
+    return tasks
+
+
+class LocalResponse(kv.Response):
+    """Streams one region's SelectResponse per next(), pipelined: workers
+    push into a bounded queue while the consumer drains (the reference's
+    fetch-goroutine + chan pattern, distsql/distsql.go:81-113)."""
+
+    def __init__(self, n_tasks: int):
+        # unbounded: a bounded queue would deadlock the serial send() path
+        # (producer and consumer are the same thread) and let an abandoned
+        # response pin shared-pool workers
+        self._q: queue.Queue = queue.Queue()
+        self._results: dict[int, object] = {}
+        self._next_idx = 0
+        self._n = n_tasks
+        self._delivered = 0
+
+    def _put(self, idx: int, resp) -> None:
+        self._q.put((idx, resp))
+
+    def next(self):
+        # deliver in task order (KeepOrder contract; also deterministic)
+        while self._delivered < self._n:
+            if self._next_idx in self._results:
+                resp = self._results.pop(self._next_idx)
+                self._next_idx += 1
+                self._delivered += 1
+                return resp
+            idx, resp = self._q.get()
+            self._results[idx] = resp
+        return None
+
+
+class LocalClient(kv.Client):
+    def __init__(self, store):
+        self.store = store
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="copr")
+
+    def send(self, req: kv.Request) -> kv.Response:
+        sel: SelectRequest = req.data
+        req.desc = req.desc or sel.desc  # either layer may set direction
+        tasks = build_region_tasks(self.store, req)
+        resp = LocalResponse(len(tasks))
+        snapshot = self.store.get_snapshot(sel.start_ts)
+
+        def run(idx: int, task: RegionTask) -> None:
+            try:
+                r = handle_request(snapshot, sel, task.ranges)
+            except Exception as e:  # defensive: never hang the consumer
+                from tidb_tpu.copr.proto import SelectResponse
+                r = SelectResponse(error=str(e))
+            resp._put(idx, r)
+
+        n_workers = max(1, min(req.concurrency, len(tasks)))
+        if n_workers <= 1 or len(tasks) <= 1:
+            for i, t in enumerate(tasks):
+                run(i, t)
+        else:
+            for i, t in enumerate(tasks):
+                self._pool.submit(run, i, t)
+        return resp
+
+    def support_request_type(self, req_type: int, sub_type) -> bool:
+        if req_type not in (kv.REQ_TYPE_SELECT, kv.REQ_TYPE_INDEX):
+            return False
+        if isinstance(sub_type, Expr):
+            return supported_expr(sub_type)
+        return sub_type in (kv.REQ_SUB_TYPE_BASIC, kv.REQ_SUB_TYPE_DESC,
+                            kv.REQ_SUB_TYPE_GROUP_BY, kv.REQ_SUB_TYPE_TOPN)
