@@ -1,0 +1,234 @@
+"""Planner tests: plan shapes, pushdown decisions, pruning, ranges.
+
+Mirrors plan/logical_plan_test.go and plan/physical_plan_test.go (golden
+plan-string checks) at smaller scale.
+"""
+
+import pytest
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.ddl.ddl import ColumnSpec, IndexSpec
+from tidb_tpu.domain import Domain, clear_domains
+from tidb_tpu.localstore import LocalStore
+from tidb_tpu.parser.parser import Parser
+from tidb_tpu.plan import optimize, tree_string
+from tidb_tpu.plan.plans import (
+    PhysicalHashAgg, PhysicalHashJoin, PhysicalIndexScan, PhysicalLimit,
+    PhysicalProjection, PhysicalSelection, PhysicalTableScan, PhysicalTopN,
+)
+from tidb_tpu.plan.refiner import TableRange
+from tidb_tpu.types.field_type import FieldType
+
+
+def _ft(tp, flag=0, flen=-1, dec=-1):
+    return FieldType(tp, flag, flen, dec)
+
+
+class Ctx:
+    def __init__(self, dom, db="test"):
+        self.dom = dom
+        self.current_db = db
+        self.params = []
+
+    def info_schema(self):
+        return self.dom.info_schema()
+
+    def get_sysvar(self, name, is_global):
+        return None
+
+
+@pytest.fixture
+def env():
+    clear_domains()
+    store = LocalStore()
+    dom = Domain(store)
+    dom.ddl.create_schema("test")
+    dom.ddl.create_table("test", "t", [
+        ColumnSpec("id", _ft(my.TypeLonglong)),
+        ColumnSpec("a", _ft(my.TypeLong)),
+        ColumnSpec("b", _ft(my.TypeVarchar, flen=64)),
+        ColumnSpec("c", _ft(my.TypeDouble)),
+    ], [IndexSpec("primary", ["id"], primary=True),
+        IndexSpec("idx_b", ["b"])])
+    dom.ddl.create_table("test", "s", [
+        ColumnSpec("id", _ft(my.TypeLonglong)),
+        ColumnSpec("t_id", _ft(my.TypeLonglong)),
+        ColumnSpec("v", _ft(my.TypeVarchar, flen=64)),
+    ], [IndexSpec("primary", ["id"], primary=True)])
+    ctx = Ctx(dom)
+    client = store.get_client()
+    return ctx, client
+
+
+def plan_for(ctx, client, sql):
+    stmt = Parser().parse_one(sql)
+    return optimize(stmt, ctx, client)
+
+
+def find_node(p, tp):
+    if isinstance(p, tp):
+        return p
+    for c in p.children:
+        r = find_node(c, tp)
+        if r is not None:
+            return r
+    return None
+
+
+class TestPushdown:
+    def test_filter_fully_pushed(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select a from t where a > 5")
+        scan = find_node(p, PhysicalTableScan)
+        assert scan is not None
+        assert scan.pushed_where is not None
+        assert not scan.conditions
+        # no SQL-side selection remains
+        assert find_node(p, PhysicalSelection) is None
+
+    def test_agg_pushdown_rewrites_final(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client,
+                     "select b, sum(c), count(*) from t group by b")
+        scan = find_node(p, PhysicalTableScan)
+        agg = find_node(p, PhysicalHashAgg)
+        assert scan.aggregated_push_down
+        assert len(scan.aggregates) >= 2
+        assert scan.group_by_pb
+        assert agg.has_pushed_child
+        # final agg funcs run in FINAL mode over the partial layout
+        from tidb_tpu.expression.aggregation import AggFunctionMode
+        assert all(f.mode == AggFunctionMode.FINAL for f in agg.agg_funcs)
+
+    def test_distinct_agg_not_pushed(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select count(distinct a) from t")
+        scan = find_node(p, PhysicalTableScan)
+        assert not scan.aggregated_push_down
+        agg = find_node(p, PhysicalHashAgg)
+        assert agg is not None and not agg.has_pushed_child
+
+    def test_topn_pushdown(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select a from t order by a desc limit 10")
+        scan = find_node(p, PhysicalTableScan)
+        topn = find_node(p, PhysicalTopN)
+        assert topn is not None
+        assert scan.topn_pb
+        assert scan.limit == 10
+
+    def test_limit_pushdown(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select a from t limit 3,7")
+        scan = find_node(p, PhysicalTableScan)
+        lim = find_node(p, PhysicalLimit)
+        assert lim is not None and lim.offset == 3 and lim.count == 7
+        assert scan.limit == 10  # offset+count pushed
+
+    def test_agg_blocked_by_residual_filter(self, env):
+        ctx, client = env
+        # CAST has no pushdown conversion → residual filter → agg stays up
+        p = plan_for(ctx, client,
+                     "select sum(a) from t where cast(a as char(10)) = '5'")
+        scan = find_node(p, PhysicalTableScan)
+        assert scan.conditions  # residual SQL-side filter
+        assert not scan.aggregated_push_down
+
+
+class TestAccessPaths:
+    def test_pk_range(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client,
+                     "select a from t where id > 10 and id <= 20")
+        scan = find_node(p, PhysicalTableScan)
+        assert scan.ranges == [TableRange(11, 20)]
+        assert scan.pushed_where is None  # fully consumed by the range
+
+    def test_pk_point(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select a from t where id = 7")
+        scan = find_node(p, PhysicalTableScan)
+        assert scan.ranges == [TableRange(7, 7)]
+
+    def test_pk_in_list(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select a from t where id in (3, 1, 5)")
+        scan = find_node(p, PhysicalTableScan)
+        assert scan.ranges == [TableRange(1, 1), TableRange(3, 3),
+                               TableRange(5, 5)]
+
+    def test_index_selected_for_eq(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select id from t where b = 'x'")
+        iscan = find_node(p, PhysicalIndexScan)
+        assert iscan is not None
+        assert iscan.index.name == "idx_b"
+        assert not iscan.double_read  # id (handle) + b covered by index
+        assert len(iscan.ranges) == 1
+
+    def test_index_double_read(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select c from t where b = 'x'")
+        iscan = find_node(p, PhysicalIndexScan)
+        assert iscan is not None and iscan.double_read
+
+
+class TestPruning:
+    def test_scan_columns_pruned(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select a from t where c > 1.5")
+        scan = find_node(p, PhysicalTableScan)
+        names = {c.col_name for c in scan.schema}
+        assert names == {"a", "c"}  # b and id dropped
+
+    def test_agg_prune_keeps_needed(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select sum(c) from t group by b")
+        scan = find_node(p, PhysicalTableScan)
+        names = {c.col_name for c in scan.schema}
+        assert names == {"b", "c"}
+
+
+class TestJoins:
+    def test_inner_join_eq_extracted(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client,
+                     "select t.a, s.v from t join s on t.id = s.t_id "
+                     "where s.v = 'x'")
+        hj = find_node(p, PhysicalHashJoin)
+        assert hj is not None
+        assert len(hj.eq_conditions) == 1
+        # s.v='x' pushed into the s-side scan
+        scans = []
+
+        def collect(n):
+            if isinstance(n, PhysicalTableScan):
+                scans.append(n)
+            for c in n.children:
+                collect(c)
+        collect(p)
+        assert len(scans) == 2
+        assert any(s.pushed_where is not None for s in scans)
+
+    def test_left_join_where_stays(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client,
+                     "select t.a from t left join s on t.id = s.t_id "
+                     "where s.v = 'x'")
+        # right-side WHERE filter must stay above the join
+        sel = find_node(p, PhysicalSelection)
+        hj = find_node(p, PhysicalHashJoin)
+        assert hj is not None and sel is not None
+
+
+class TestMisc:
+    def test_select_no_from(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select 1 + 1")
+        assert find_node(p, PhysicalProjection) is not None
+
+    def test_tree_string_smoke(self, env):
+        ctx, client = env
+        p = plan_for(ctx, client, "select b, count(*) from t group by b")
+        s = tree_string(p)
+        assert "tscan" in s and "phashagg" in s

@@ -749,3 +749,39 @@ def _found_rows(args, row):
 @register("last_insert_id", 0, 1)
 def _last_insert_id(args, row):
     return Datum.u64(0)
+
+
+# ---- predicate-shaped builtins used by the planner's lowering ----
+# (IN/LIKE become ScalarFunctions so the executor path and the expr→pb
+# conversion both dispatch by name)
+
+@register("in", 2, -1)
+def _in(args, row):
+    v = args[0].eval(row)
+    return xops.compute_in(v, [a.eval(row) for a in args[1:]])
+
+
+@register("not_in", 2, -1)
+def _not_in(args, row):
+    v = args[0].eval(row)
+    return xops.compute_in(v, [a.eval(row) for a in args[1:]], negated=True)
+
+
+@register("like", 3, 3)
+def _like(args, row):
+    esc = args[2].eval(row)
+    return xops.compute_like(args[0].eval(row), args[1].eval(row),
+                             esc.get_string() if not esc.is_null() else "\\")
+
+
+@register("not_like", 3, 3)
+def _not_like(args, row):
+    esc = args[2].eval(row)
+    return xops.compute_like(args[0].eval(row), args[1].eval(row),
+                             esc.get_string() if not esc.is_null() else "\\",
+                             negated=True)
+
+
+@register("is_not_null", 1, 1)
+def _is_not_null(args, row):
+    return xops.bool_datum(not args[0].eval(row).is_null())

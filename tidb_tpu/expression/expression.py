@@ -45,6 +45,8 @@ def _collect_columns(e: Expression, out: list["Column"]) -> None:
     elif isinstance(e, ScalarFunction):
         for a in e.args:
             _collect_columns(a, out)
+    elif isinstance(e, Cast):
+        _collect_columns(e.arg, out)
 
 
 class Column(Expression):
@@ -199,6 +201,31 @@ def new_op(op: Op, *args: Expression, ret_type: FieldType | None = None) -> Scal
     return ScalarFunction(f"op_{op.name.lower()}", list(args), rt, op=op)
 
 
+class Cast(Expression):
+    """CAST(expr AS type); evaluates via types.convert.convert_datum."""
+
+    def __init__(self, arg: Expression, to_type: FieldType):
+        self.arg = arg
+        self.ret_type = to_type
+
+    def eval(self, row: list[Datum]) -> Datum:
+        from tidb_tpu.types.convert import convert_datum
+        return convert_datum(self.arg.eval(row), self.ret_type)
+
+    def clone(self) -> "Cast":
+        return Cast(self.arg.clone(), self.ret_type)
+
+    def equal(self, other: Expression) -> bool:
+        return (isinstance(other, Cast) and other.ret_type == self.ret_type
+                and other.arg.equal(self.arg))
+
+    def columns(self) -> list[Column]:
+        return self.arg.columns()
+
+    def __repr__(self):
+        return f"cast({self.arg!r} as {self.ret_type.compact_str()})"
+
+
 TRUE_EXPR = Constant(Datum.i64(1))
 FALSE_EXPR = Constant(Datum.i64(0))
 NULL_EXPR = Constant(NULL)
@@ -267,9 +294,12 @@ class Schema:
         return found
 
     def retrieve_positions(self) -> None:
-        """Renumber position/index to the current layout."""
+        """Renumber to the current layout. Invariant: a schema column's
+        `index` (offset for evaluation against this node's output rows)
+        always equals its `position`."""
         for i, c in enumerate(self.columns):
             c.position = i
+            c.index = i
 
     def set_from(self, from_id: str) -> None:
         for c in self.columns:

@@ -1,0 +1,9 @@
+"""Planner: logical build → rule rewrites → physical plan with pushdown.
+
+Reference: plan/ (see SURVEY.md §2.2). Entry point: optimize().
+"""
+
+from tidb_tpu.plan.optimizer import optimize, optimize_plan
+from tidb_tpu.plan.plans import tree_string
+
+__all__ = ["optimize", "optimize_plan", "tree_string"]

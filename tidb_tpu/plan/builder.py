@@ -1,0 +1,691 @@
+"""AST → logical plan, with name resolution and expression rewriting.
+
+Reference: plan/planbuilder.go (planBuilder.build), plan/logical_plan_builder.go
+(buildSelect/buildJoin/buildAggregation/buildProjection/buildSort…),
+plan/expression_rewriter.go, plan/resolver.go. Name resolution happens during
+the rewrite against child plan schemas rather than as a separate AST pass —
+the schemas carry resolved offsets, so a second ResolveIndices pass isn't
+needed (schema invariant: column.index == position in the owning schema).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu import mysqldef as my
+from tidb_tpu import sqlast as ast
+from tidb_tpu.expression import (
+    AggregationFunction, Column, Constant, Expression, ScalarFunction, Schema,
+    new_op, split_cnf,
+)
+from tidb_tpu.expression.expression import Cast
+from tidb_tpu.plan import plans
+from tidb_tpu.plan.plans import (
+    Aggregation, DataSource, Delete, Distinct, ExplainPlan, Insert, Join,
+    Limit, Plan, Projection, Selection, ShowPlan, SimplePlan, Sort, SortItem,
+    TableDual, Union, Update,
+)
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, Kind
+from tidb_tpu.types.field_type import new_field_type
+
+
+class PlanBuilder:
+    """One statement → one logical plan."""
+
+    def __init__(self, ctx):
+        """ctx duck-type: .info_schema() → InfoSchema, .current_db: str,
+        .get_sysvar(name, is_global) → str|None, .params: list[Datum]."""
+        self.ctx = ctx
+        self.is_ = ctx.info_schema()
+
+    # ---- dispatch ----
+
+    def build(self, node: ast.StmtNode) -> Plan:
+        if isinstance(node, ast.SelectStmt):
+            return self.build_select(node)
+        if isinstance(node, ast.InsertStmt):
+            return self.build_insert(node)
+        if isinstance(node, ast.UpdateStmt):
+            return self.build_update(node)
+        if isinstance(node, ast.DeleteStmt):
+            return self.build_delete(node)
+        if isinstance(node, ast.ShowStmt):
+            return ShowPlan(node)
+        if isinstance(node, ast.ExplainStmt):
+            return ExplainPlan(self.build(node.stmt))
+        if isinstance(node, ast.UnionStmt):
+            return self.build_union(node)
+        # everything else executes directly (DDL/SET/USE/txn control/admin…)
+        return SimplePlan(node)
+
+    # ---- FROM clause ----
+
+    def resolve_table(self, tn: ast.TableName):
+        db = tn.db or self.ctx.current_db
+        if not db:
+            raise errors.BadDBError("no database selected")
+        tbl = self.is_.table_by_name(db, tn.name)
+        return db, tbl
+
+    def build_datasource(self, tn: ast.TableName, alias: str = "") -> DataSource:
+        db, tbl = self.resolve_table(tn)
+        info = tbl.info
+        ds = DataSource(db, tbl, info, alias)
+        schema = Schema()
+        for i, col in enumerate(info.public_columns()):
+            schema.append(Column(
+                col_name=col.name, tbl_name=ds.alias, db_name=db,
+                ret_type=col.field_type, index=i, col_id=col.id))
+        ds.set_schema(schema)
+        return ds
+
+    def build_table_ref(self, node) -> Plan:
+        if isinstance(node, ast.TableSource):
+            src = node.source
+            if isinstance(src, ast.TableName):
+                return self.build_datasource(src, node.as_name)
+            if isinstance(src, (ast.SelectStmt, ast.UnionStmt)):
+                sub = self.build(src)
+                if not node.as_name:
+                    raise errors.PlanError(
+                        "every derived table must have its own alias")
+                # re-expose the subquery schema under the alias
+                proxy = Projection([c.clone() for c in sub.schema])
+                proxy.add_child(sub)
+                schema = sub.schema.clone()
+                for c in schema.columns:
+                    c.tbl_name = node.as_name
+                    c.db_name = ""
+                proxy.set_schema(schema)
+                return proxy
+            raise errors.PlanError(f"unsupported table source {type(src)}")
+        if isinstance(node, ast.Join):
+            return self.build_join(node)
+        if isinstance(node, ast.TableName):
+            return self.build_datasource(node)
+        raise errors.PlanError(f"unsupported FROM node {type(node)}")
+
+    def build_join(self, jn: ast.Join) -> Plan:
+        left = self.build_table_ref(jn.left)
+        if jn.right is None:
+            return left
+        right = self.build_table_ref(jn.right)
+
+        swapped = jn.tp == "right"
+        if swapped:
+            left, right = right, left
+        tp = {"cross": Join.INNER, "inner": Join.INNER,
+              "left": Join.LEFT_OUTER, "right": Join.LEFT_OUTER}[jn.tp]
+        join = Join(tp)
+        join.add_child(left)
+        join.add_child(right)
+        join._left_width = len(left.schema)
+        merged = Schema([c.clone() for c in left.schema]
+                        + [c.clone() for c in right.schema])
+        join.set_schema(merged)
+        if jn.on is not None:
+            cond = self.rewrite(jn.on, join.schema)
+            join.other_conditions.extend(split_cnf(cond))
+        if swapped:
+            # restore [original-left, original-right] column order
+            proj_exprs = ([c.clone() for c in join.schema[len(left.schema):]]
+                          + [c.clone() for c in join.schema[:len(left.schema)]])
+            proj = Projection(proj_exprs)
+            proj.add_child(join)
+            schema = Schema([c.clone() for c in right.schema]
+                            + [c.clone() for c in left.schema])
+            proj.set_schema(schema)
+            return proj
+        return join
+
+    # ---- SELECT ----
+
+    def build_select(self, sel: ast.SelectStmt) -> Plan:
+        if sel.from_ is not None:
+            p = self.build_table_ref(sel.from_)
+        else:
+            p = TableDual(1)
+            p.set_schema(Schema())
+
+        if sel.where is not None:
+            p = self._add_selection(p, sel.where)
+
+        fields = self._expand_wildcards(sel.fields, p.schema)
+
+        agg_nodes = []
+        for f in fields:
+            _collect_aggs(f.expr, agg_nodes)
+        if sel.having is not None:
+            _collect_aggs(sel.having, agg_nodes)
+        for item in sel.order_by:
+            _collect_aggs(item.expr, agg_nodes)
+
+        mapper: dict[int, Column] = {}
+        if agg_nodes or sel.group_by:
+            p = self._build_aggregation(p, fields, sel, agg_nodes, mapper)
+
+        # final projection
+        alias_exprs: dict[str, Expression] = {}
+        proj_exprs: list[Expression] = []
+        proj_schema = Schema()
+        for i, f in enumerate(fields):
+            e = self.rewrite(f.expr, p.schema, mapper)
+            proj_exprs.append(e)
+            name = f.as_name or _field_name(f.expr)
+            out = Column(col_name=name, ret_type=e.ret_type, position=i)
+            if isinstance(e, Column) and not f.as_name:
+                out.tbl_name = e.tbl_name
+                out.db_name = e.db_name
+                out.col_id = e.col_id
+            proj_schema.append(out)
+            if f.as_name:
+                alias_exprs[f.as_name.lower()] = e
+
+        if sel.having is not None:
+            # HAVING runs below the projection; aliases resolve to their exprs
+            cond = self.rewrite(sel.having, p.schema, mapper, alias_exprs)
+            hsel = Selection(split_cnf(cond))
+            hsel.add_child(p)
+            hsel.schema = p.schema
+            p = hsel
+
+        proj = Projection(proj_exprs)
+        proj.add_child(p)
+        proj.set_schema(proj_schema)
+        p = proj
+        visible = len(proj_exprs)
+
+        if sel.distinct:
+            d = Distinct()
+            d.add_child(p)
+            d.schema = p.schema
+            p = d
+
+        if sel.order_by:
+            p = self._build_sort(p, sel.order_by, mapper, alias_exprs, visible)
+
+        if sel.limit is not None:
+            lim = Limit(sel.limit.offset, sel.limit.count)
+            lim.add_child(p)
+            lim.schema = p.schema
+            p = lim
+
+        if len(p.schema) > visible:
+            # trim hidden sort columns
+            trim = Projection([c.clone() for c in p.schema[:visible]])
+            trim.add_child(p)
+            trim.set_schema(Schema([c.clone() for c in p.schema[:visible]]))
+            p = trim
+        return p
+
+    def build_union(self, u) -> Plan:
+        children = [self.build_select(s) for s in u.selects]
+        first = children[0]
+        for c in children[1:]:
+            if len(c.schema) != len(first.schema):
+                raise errors.PlanError(
+                    "The used SELECT statements have a different number of columns")
+        un = Union()
+        for c in children:
+            un.add_child(c)
+        schema = first.schema.clone()
+        for col in schema.columns:
+            col.tbl_name = ""
+            col.db_name = ""
+        un.set_schema(schema)
+        p: Plan = un
+        if u.distinct:
+            d = Distinct()
+            d.add_child(p)
+            d.schema = p.schema
+            p = d
+        if u.order_by:
+            p = self._build_sort(p, u.order_by, {}, {}, len(p.schema))
+        if u.limit is not None:
+            lim = Limit(u.limit.offset, u.limit.count)
+            lim.add_child(p)
+            lim.schema = p.schema
+            p = lim
+        return p
+
+    def _add_selection(self, p: Plan, where: ast.ExprNode) -> Plan:
+        cond = self.rewrite(where, p.schema)
+        sel = Selection(split_cnf(cond))
+        sel.add_child(p)
+        sel.schema = p.schema  # pass-through: shares the child scope
+        return sel
+
+    def _expand_wildcards(self, fields, schema: Schema):
+        out = []
+        for f in fields:
+            if f.wild_table is None:
+                out.append(f)
+                continue
+            matched = False
+            for c in schema:
+                if f.wild_table and c.tbl_name.lower() != f.wild_table.lower():
+                    continue
+                matched = True
+                out.append(ast.SelectField(
+                    expr=ast.ColumnName(name=c.col_name, table=c.tbl_name,
+                                        db=c.db_name)))
+            if f.wild_table and not matched:
+                raise errors.UnknownFieldError(
+                    f"unknown table {f.wild_table!r} in wildcard")
+        if not out:
+            raise errors.PlanError("empty select list")
+        return out
+
+    def _build_aggregation(self, p: Plan, fields, sel, agg_nodes,
+                           mapper: dict[int, Column]) -> Plan:
+        """Aggregation over p. Output schema: one column per aggregate +
+        one first_row per bare column referenced above the aggregation
+        (logical_plan_builder.go buildAggregation)."""
+        agg_funcs: list[AggregationFunction] = []
+        agg_schema = Schema()
+
+        def add_func(fn: AggregationFunction, name: str,
+                     src: Column | None = None) -> Column:
+            agg_funcs.append(fn)
+            col = Column(col_name=name, ret_type=fn.ret_type(),
+                         position=len(agg_schema), is_agg=True)
+            if src is not None:
+                col.tbl_name = src.tbl_name
+                col.db_name = src.db_name
+                col.col_id = src.col_id
+            agg_schema.append(col)
+            return col
+
+        for node in agg_nodes:
+            args = [self.rewrite(a, p.schema) for a in node.args]
+            if not args and node.name.lower() == "count":
+                args = [Constant(Datum.i64(1))]  # COUNT(*)
+            fn = AggregationFunction(node.name.lower(), args,
+                                     distinct=node.distinct)
+            mapper[id(node)] = add_func(fn, _agg_name(node))
+
+        # bare columns referenced outside aggregates → first_row
+        bare: list[ast.ColumnName] = []
+        for f in fields:
+            _collect_bare_columns(f.expr, bare)
+        if sel.having is not None:
+            _collect_bare_columns(sel.having, bare)
+        for item in sel.order_by:
+            _collect_bare_columns(item.expr, bare)
+        for item in sel.group_by:
+            _collect_bare_columns(item.expr, bare)
+        seen: set[tuple] = set()
+        first_row_cols: dict[tuple, Column] = {}
+        for cn in bare:
+            try:
+                src = self._find_column(cn, p.schema)
+            except errors.TiDBError:
+                continue  # may be an alias; resolved later
+            key = (src.from_id, src.position)
+            if key in seen:
+                continue
+            seen.add(key)
+            fn = AggregationFunction("first_row", [src.clone()])
+            first_row_cols[key] = add_func(fn, src.col_name, src)
+
+        agg = Aggregation(agg_funcs, [])
+        agg.add_child(p)
+        agg.set_schema(agg_schema)
+        # positions changed in set_schema; refresh the mapper targets' clones
+        # (mapper columns are the same objects appended to agg_schema)
+
+        # group-by items: aliases and positions resolve against the fields
+        group_exprs: list[Expression] = []
+        for item in sel.group_by:
+            e = self._resolve_by_item(item.expr, fields, p.schema, {})
+            group_exprs.append(e)
+        agg.group_by = group_exprs
+        return agg
+
+    def _resolve_by_item(self, expr, fields, schema: Schema, mapper) -> Expression:
+        """GROUP BY / ORDER BY item: positional ints and select aliases
+        resolve against the select list (MySQL semantics)."""
+        if isinstance(expr, ast.Literal) and expr.value.kind in (Kind.INT64,
+                                                                 Kind.UINT64):
+            pos = expr.value.get_int()
+            if not (1 <= pos <= len(fields)):
+                raise errors.PlanError(f"Unknown column '{pos}' in clause")
+            return self.rewrite(fields[pos - 1].expr, schema, mapper)
+        if isinstance(expr, ast.ColumnName) and not expr.table:
+            for f in fields:
+                if f.as_name and f.as_name.lower() == expr.name.lower():
+                    return self.rewrite(f.expr, schema, mapper)
+        return self.rewrite(expr, schema, mapper)
+
+    def _build_sort(self, p: Plan, order_by, mapper, alias_exprs,
+                    visible: int) -> Plan:
+        """Sort above the projection; exprs not already in the projection's
+        output are appended as hidden columns (trimmed by build_select)."""
+        proj = None
+        if isinstance(p, Projection):
+            proj = p
+        elif isinstance(p, Distinct) and isinstance(p.child, Projection):
+            proj = p.child
+
+        items: list[SortItem] = []
+        for item in order_by:
+            e_ast = item.expr
+            col: Column | None = None
+            if isinstance(e_ast, ast.Literal) and e_ast.value.kind in (
+                    Kind.INT64, Kind.UINT64):
+                pos = e_ast.value.get_int()
+                if not (1 <= pos <= visible):
+                    raise errors.PlanError(
+                        f"Unknown column '{pos}' in 'order clause'")
+                col = p.schema[pos - 1]
+            elif isinstance(e_ast, ast.ColumnName):
+                try:
+                    col = self._find_column(e_ast, p.schema)
+                except errors.UnknownFieldError:
+                    col = None
+            if col is None:
+                if proj is None:
+                    raise errors.PlanError(
+                        "ORDER BY expression must appear in the select list "
+                        "for DISTINCT/UNION queries")
+                if isinstance(p, Distinct):
+                    raise errors.PlanError(
+                        "ORDER BY expression must appear in the select list "
+                        "when DISTINCT is used")
+                e = self.rewrite(e_ast, proj.child.schema, mapper, alias_exprs)
+                proj.exprs.append(e)
+                hidden = Column(col_name=f"_sort_{len(proj.schema)}",
+                                ret_type=e.ret_type)
+                proj.schema.append(hidden)
+                proj.set_schema(proj.schema)  # renumber positions/indexes
+                col = hidden
+            items.append(SortItem(col.clone(), item.desc))
+
+        srt = Sort(items)
+        srt.add_child(p)
+        srt.schema = p.schema
+        return srt
+
+    # ---- INSERT / UPDATE / DELETE ----
+
+    def build_insert(self, ins: ast.InsertStmt) -> Insert:
+        db, tbl = self.resolve_table(ins.table)
+        ds_schema = Schema()
+        for i, col in enumerate(tbl.info.public_columns()):
+            ds_schema.append(Column(col_name=col.name, tbl_name=tbl.info.name,
+                                    ret_type=col.field_type, index=i,
+                                    col_id=col.id))
+        lists = []
+        for row in ins.values:
+            lists.append([self.rewrite(e, Schema()) if not isinstance(e, ast.DefaultExpr)
+                          else e for e in row])
+        set_list = [(a.column, self.rewrite(a.expr, Schema()))
+                    for a in ins.setlist]
+        on_dup = [(a.column, a.expr) for a in ins.on_duplicate]
+        select_plan = self.build(ins.select) if ins.select is not None else None
+        plan = Insert(tbl, ins.columns or None, lists, set_list,
+                      ins.is_replace, on_dup, select_plan)
+        if select_plan is not None:
+            plan.add_child(select_plan)
+        plan.ignore = ins.ignore
+        return plan
+
+    def build_update(self, upd: ast.UpdateStmt) -> Update:
+        ds = self.build_datasource(upd.table)
+        p: Plan = ds
+        if upd.where is not None:
+            p = self._add_selection(p, upd.where)
+        if upd.order_by:
+            srt = Sort([SortItem(self.rewrite(i.expr, p.schema), i.desc)
+                        for i in upd.order_by])
+            srt.add_child(p)
+            srt.schema = p.schema
+            p = srt
+        if upd.limit is not None:
+            lim = Limit(upd.limit.offset, upd.limit.count)
+            lim.add_child(p)
+            lim.schema = p.schema
+            p = lim
+        ordered = []
+        for a in upd.assignments:
+            col = self._find_column(a.column, ds.schema)
+            ordered.append((col, self.rewrite(a.expr, ds.schema)))
+        u = Update(ordered)
+        u.add_child(p)
+        u.table = ds.table
+        u.set_schema(Schema())
+        return u
+
+    def build_delete(self, dele: ast.DeleteStmt) -> Delete:
+        ds = self.build_datasource(dele.table)
+        p: Plan = ds
+        if dele.where is not None:
+            p = self._add_selection(p, dele.where)
+        if dele.order_by:
+            srt = Sort([SortItem(self.rewrite(i.expr, p.schema), i.desc)
+                        for i in dele.order_by])
+            srt.add_child(p)
+            srt.schema = p.schema
+            p = srt
+        if dele.limit is not None:
+            lim = Limit(dele.limit.offset, dele.limit.count)
+            lim.add_child(p)
+            lim.schema = p.schema
+            p = lim
+        d = Delete([dele.table], False)
+        d.add_child(p)
+        d.table = ds.table
+        d.set_schema(Schema())
+        return d
+
+    # ---- expression rewriting (plan/expression_rewriter.go) ----
+
+    def _find_column(self, cn, schema: Schema) -> Column:
+        name = cn.name if isinstance(cn, ast.ColumnName) else cn
+        tblname = getattr(cn, "table", "")
+        dbname = getattr(cn, "db", "")
+        col = schema.find_column(dbname, tblname, name)
+        if col is None:
+            raise errors.UnknownFieldError(
+                f"Unknown column '{name}' in 'field list'")
+        return col
+
+    def rewrite(self, node: ast.ExprNode, schema: Schema,
+                mapper: dict[int, Column] | None = None,
+                alias_exprs: dict[str, Expression] | None = None) -> Expression:
+        m = mapper or {}
+        aliases = alias_exprs or {}
+
+        def rw(n) -> Expression:
+            if isinstance(n, ast.Literal):
+                return Constant(n.value)
+            if isinstance(n, ast.ColumnName):
+                if id(n) in m:
+                    return m[id(n)].clone()
+                try:
+                    return self._find_column(n, schema).clone()
+                except errors.UnknownFieldError:
+                    if not n.table and n.name.lower() in aliases:
+                        return aliases[n.name.lower()].clone()
+                    raise
+            if isinstance(n, ast.AggregateFunc):
+                col = m.get(id(n))
+                if col is None:
+                    raise errors.PlanError(
+                        f"misplaced aggregate function {n.name}()")
+                return col.clone()
+            if isinstance(n, ast.BinaryOp):
+                return new_op(n.op, rw(n.left), rw(n.right))
+            if isinstance(n, ast.UnaryOp):
+                return new_op(n.op, rw(n.operand))
+            if isinstance(n, ast.FuncCall):
+                from tidb_tpu.expression import builtin
+                if not builtin.exists(n.name):
+                    raise errors.ExecError(f"unknown function {n.name!r}")
+                args = [rw(a) for a in n.args]
+                return ScalarFunction(n.name.lower(), args,
+                                      _func_ret_type(n.name, args))
+            if isinstance(n, ast.Between):
+                e = rw(n.expr)
+                lo, hi = rw(n.low), rw(n.high)
+                ge = new_op(Op.GE, e, lo)
+                le = new_op(Op.LE, e.clone(), hi)
+                both = new_op(Op.AndAnd, ge, le)
+                return new_op(Op.UnaryNot, both) if n.not_ else both
+            if isinstance(n, ast.InExpr):
+                args = [rw(n.expr)] + [rw(i) for i in n.items]
+                name = "not_in" if n.not_ else "in"
+                return ScalarFunction(name, args,
+                                      new_field_type(my.TypeLonglong))
+            if isinstance(n, ast.PatternLike):
+                args = [rw(n.expr), rw(n.pattern),
+                        Constant(Datum.string(n.escape))]
+                name = "not_like" if n.not_ else "like"
+                return ScalarFunction(name, args,
+                                      new_field_type(my.TypeLonglong))
+            if isinstance(n, ast.IsNull):
+                name = "is_not_null" if n.not_ else "isnull"
+                return ScalarFunction(name, [rw(n.expr)],
+                                      new_field_type(my.TypeLonglong))
+            if isinstance(n, ast.CaseExpr):
+                args: list[Expression] = []
+                if n.value is not None:
+                    args.append(rw(n.value))
+                for wc in n.when_clauses:
+                    args.append(rw(wc.when))
+                    args.append(rw(wc.result))
+                # mandatory else arm (builtin._case arity contract)
+                args.append(rw(n.else_clause) if n.else_clause is not None
+                            else Constant(NULL))
+                rt = args[-1].ret_type if n.else_clause is not None \
+                    else (args[2].ret_type if n.value is not None
+                          else args[1].ret_type)
+                return ScalarFunction("case", args, rt)
+            if isinstance(n, ast.CastExpr):
+                return Cast(rw(n.expr), n.cast_type)
+            if isinstance(n, ast.ParamMarker):
+                if n.value is not None:
+                    return Constant(n.value)
+                params = getattr(self.ctx, "params", None) or []
+                if n.order < len(params):
+                    return Constant(params[n.order])
+                raise errors.PlanError("missing prepared statement parameter")
+            if isinstance(n, ast.VariableExpr):
+                return self._rewrite_variable(n)
+            if isinstance(n, ast.RowExpr):
+                raise errors.PlanError("row expressions not yet supported")
+            if isinstance(n, ast.DefaultExpr):
+                raise errors.PlanError("DEFAULT only valid in INSERT/UPDATE values")
+            raise errors.PlanError(f"cannot rewrite {type(n).__name__}")
+
+        return rw(node)
+
+    def _rewrite_variable(self, n: ast.VariableExpr) -> Expression:
+        if n.is_system:
+            val = self.ctx.get_sysvar(n.name, n.is_global)
+            if val is None:
+                return Constant(NULL)
+            return Constant(Datum.string(str(val)))
+        getter = getattr(self.ctx, "get_uservar", None)
+        val = getter(n.name) if getter else None
+        return Constant(val if isinstance(val, Datum) else
+                        (Datum.string(str(val)) if val is not None else NULL))
+
+
+# ---- helpers ----
+
+def _collect_aggs(node, out: list) -> None:
+    if node is None:
+        return
+    if isinstance(node, ast.AggregateFunc):
+        out.append(node)
+        return  # no nested aggregates
+    for child in _ast_children(node):
+        _collect_aggs(child, out)
+
+
+def _collect_bare_columns(node, out: list, in_agg: bool = False) -> None:
+    if node is None:
+        return
+    if isinstance(node, ast.ColumnName):
+        if not in_agg:
+            out.append(node)
+        return
+    if isinstance(node, ast.AggregateFunc):
+        return  # columns inside aggregate args resolve below the agg
+    for child in _ast_children(node):
+        _collect_bare_columns(child, out, in_agg)
+
+
+def _ast_children(node):
+    if isinstance(node, ast.BinaryOp):
+        return [node.left, node.right]
+    if isinstance(node, ast.UnaryOp):
+        return [node.operand]
+    if isinstance(node, (ast.FuncCall, ast.AggregateFunc)):
+        return list(node.args)
+    if isinstance(node, ast.Between):
+        return [node.expr, node.low, node.high]
+    if isinstance(node, ast.InExpr):
+        return [node.expr] + list(node.items)
+    if isinstance(node, ast.PatternLike):
+        return [node.expr, node.pattern]
+    if isinstance(node, ast.IsNull):
+        return [node.expr]
+    if isinstance(node, ast.CaseExpr):
+        out = []
+        if node.value is not None:
+            out.append(node.value)
+        for wc in node.when_clauses:
+            out.extend([wc.when, wc.result])
+        if node.else_clause is not None:
+            out.append(node.else_clause)
+        return out
+    if isinstance(node, ast.CastExpr):
+        return [node.expr]
+    if isinstance(node, ast.RowExpr):
+        return list(node.values)
+    return []
+
+
+def _field_name(expr) -> str:
+    if isinstance(expr, ast.ColumnName):
+        return expr.name
+    if isinstance(expr, ast.AggregateFunc):
+        return _agg_name(expr)
+    if isinstance(expr, ast.FuncCall):
+        return f"{expr.name}(...)"
+    text = getattr(expr, "text", "") or ""
+    return text or type(expr).__name__.lower()
+
+
+def _agg_name(node: "ast.AggregateFunc") -> str:
+    inner = "*" if not node.args else ", ".join(
+        a.name if isinstance(a, ast.ColumnName) else "..." for a in node.args)
+    d = "distinct " if node.distinct else ""
+    return f"{node.name.lower()}({d}{inner})"
+
+
+def _func_ret_type(name, args):
+    """Coarse builtin result typing — numeric funcs → double/bigint,
+    string funcs → varchar (plan/typeinferer.go equivalent)."""
+    name = name.lower()
+    if name in ("length", "char_length", "character_length", "ascii", "sign",
+                "floor", "ceil", "ceiling", "instr", "locate", "strcmp",
+                "field", "crc32", "connection_id", "found_rows",
+                "last_insert_id", "year", "month", "day", "dayofmonth",
+                "hour", "minute", "second", "weekday", "dayofweek",
+                "dayofyear", "unix_timestamp", "isnull", "is_not_null"):
+        return new_field_type(my.TypeLonglong)
+    if name in ("abs", "round", "truncate", "greatest", "least", "if",
+                "ifnull", "coalesce", "nullif", "case", "mod"):
+        return args[0].ret_type.clone() if args else new_field_type(my.TypeDouble)
+    if name in ("sqrt", "pow", "power", "exp", "ln", "log", "log2", "log10",
+                "pi", "rand"):
+        return new_field_type(my.TypeDouble)
+    if name in ("now", "current_timestamp", "sysdate", "curdate",
+                "current_date", "date"):
+        return new_field_type(my.TypeDatetime)
+    ft = new_field_type(my.TypeVarString)
+    return ft

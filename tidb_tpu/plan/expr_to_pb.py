@@ -1,0 +1,122 @@
+"""SQL-side Expression → pushdown Expr conversion with capability gating.
+
+Reference: plan/expr_to_pb.go — exprToPB (:47), datumToPBExpr (:59),
+columnToPBExpr (:98), scalarFuncToPBExpr (:118), aggFuncToPBExpr (:329),
+groupByItemToPB (:313), sortByItemToPB (:321), and the split-or-keep
+contract of expressionsToPB (:27-45): a condition that fails to convert
+stays on the SQL side, it never blocks the rest.
+
+Every conversion consults client.support_request_type with the candidate
+Expr as the probe (kv/kv.go:98 SupportRequestType), so a TPU client that
+lacks a kernel for an op automatically keeps that op on the SQL side —
+the exact fallback mechanism the copr=tpu routing relies on.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu.copr import proto
+from tidb_tpu.expression import (
+    AggregationFunction, Column, Constant, Expression, ScalarFunction,
+)
+from tidb_tpu.plan.plans import SortItem
+
+
+def expressions_to_pb(client, conditions: list[Expression], req_type: int):
+    """Split conditions into (single ANDed pb expr or None, remained).
+    Reference: plan/expr_to_pb.go:27-45 ExpressionsToPB."""
+    pb_exprs = []
+    remained = []
+    for cond in conditions:
+        pb = expr_to_pb(client, cond, req_type)
+        if pb is None:
+            remained.append(cond)
+        else:
+            pb_exprs.append(pb)
+    if not pb_exprs:
+        return None, remained
+    out = pb_exprs[0]
+    from tidb_tpu.sqlast.opcode import Op
+    for e in pb_exprs[1:]:
+        out = proto.expr_op(Op.AndAnd, out, e)
+    return out, remained
+
+
+def expr_to_pb(client, expr: Expression, req_type: int) -> proto.Expr | None:
+    pb = _convert(expr)
+    if pb is None:
+        return None
+    if not client.support_request_type(req_type, pb):
+        return None
+    return pb
+
+
+def _convert(expr: Expression) -> proto.Expr | None:
+    if isinstance(expr, Constant):
+        return proto.expr_value(expr.value)
+    if isinstance(expr, Column):
+        if expr.is_agg or expr.col_id <= 0:
+            return None  # not a storage column → can't cross the boundary
+        return proto.expr_column(expr.col_id)
+    if isinstance(expr, ScalarFunction):
+        children = []
+        for a in expr.args:
+            pb = _convert(a)
+            if pb is None:
+                return None
+            children.append(pb)
+        if expr.op is not None:
+            return proto.Expr(proto.ExprType.OPERATOR, op=expr.op,
+                              children=children)
+        name = expr.func_name
+        named = {
+            "in": proto.ExprType.IN, "not_in": proto.ExprType.NOT_IN,
+            "isnull": proto.ExprType.IS_NULL,
+            "is_not_null": proto.ExprType.IS_NOT_NULL,
+            "if": proto.ExprType.IF, "ifnull": proto.ExprType.IFNULL,
+            "nullif": proto.ExprType.NULLIF,
+            "coalesce": proto.ExprType.COALESCE,
+            "case": proto.ExprType.CASE,
+        }
+        if name in ("like", "not_like"):
+            # escape char travels in val; children [target, pattern]
+            esc = expr.args[2]
+            if not isinstance(esc, Constant):
+                return None
+            tp = proto.ExprType.LIKE if name == "like" \
+                else proto.ExprType.NOT_LIKE
+            return proto.Expr(tp, val=esc.value.get_string(),
+                              children=children[:2])
+        if name in named:
+            return proto.Expr(named[name], children=children)
+        # generic builtin by name (engine probes support)
+        return proto.Expr(proto.ExprType.SCALAR_FUNC, val=name,
+                          children=children)
+    return None  # Cast and anything else stays SQL-side for now
+
+
+def agg_func_to_pb(client, agg: AggregationFunction, req_type: int) -> proto.Expr | None:
+    """Reference: plan/expr_to_pb.go:329 aggFuncToPBExpr. Distinct aggs are
+    rejected by the engine capability probe."""
+    if agg.name not in proto.AGG_TYPE_BY_NAME:
+        return None
+    children = []
+    for a in agg.args:
+        pb = _convert(a)
+        if pb is None:
+            return None
+        children.append(pb)
+    e = proto.Expr(proto.AGG_TYPE_BY_NAME[agg.name], children=children,
+                   distinct=agg.distinct)
+    if not client.support_request_type(req_type, e):
+        return None
+    return e
+
+
+def group_by_item_to_pb(client, expr: Expression, req_type: int) -> proto.ByItem | None:
+    pb = expr_to_pb(client, expr, req_type)
+    return None if pb is None else proto.ByItem(pb)
+
+
+def sort_item_to_pb(client, item: SortItem, req_type: int) -> proto.ByItem | None:
+    pb = expr_to_pb(client, item.expr, req_type)
+    return None if pb is None else proto.ByItem(pb, item.desc)

@@ -1,0 +1,48 @@
+"""Optimizer entry: AST statement → executable physical plan.
+
+Reference: plan/optimizer.go:31 Optimize / :52 doOptimize —
+build logical → PredicatePushDown → PruneColumns → ResolveIndices →
+physical conversion with pushdown attachment. (Cost-based access-path
+choice uses the refiner heuristics until the statistics module lands.)
+"""
+
+from __future__ import annotations
+
+from tidb_tpu.plan.builder import PlanBuilder
+from tidb_tpu.plan.physical import PhysicalContext, to_physical
+from tidb_tpu.plan.plans import (
+    Delete, ExplainPlan, Insert, Plan, Selection, ShowPlan, SimplePlan,
+    Update,
+)
+from tidb_tpu.plan.rules import (
+    predicate_push_down, prune_columns, resolve_indices,
+)
+
+
+def optimize(stmt_node, ctx, client, dirty_table_ids=None) -> Plan:
+    builder = PlanBuilder(ctx)
+    p = builder.build(stmt_node)
+    return optimize_plan(p, ctx, client, dirty_table_ids)
+
+
+def optimize_plan(p: Plan, ctx, client, dirty_table_ids=None) -> Plan:
+    if isinstance(p, (SimplePlan, ShowPlan)):
+        return p
+    if isinstance(p, ExplainPlan):
+        p.target = optimize_plan(p.target, ctx, client, dirty_table_ids)
+        return p
+
+    remained, p = predicate_push_down(p)
+    if remained:
+        sel = Selection(remained)
+        sel.add_child(p)
+        sel.schema = p.schema
+        p = sel
+    if isinstance(p, (Insert, Update, Delete)):
+        for c in p.children:
+            prune_columns(c, None)
+    else:
+        prune_columns(p, None)
+    resolve_indices(p)
+    phys_ctx = PhysicalContext(client, set(dirty_table_ids or ()))
+    return to_physical(p, phys_ctx)

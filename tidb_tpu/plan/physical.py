@@ -1,0 +1,358 @@
+"""Logical → physical plan with coprocessor pushdown attachment.
+
+Reference: plan/physical_plan_builder.go (convert2TableScan :129,
+convert2IndexScan :206, convert2PhysicalPlanFinalHash :748) and
+plan/physical_plans.go (addAggregation :225, addTopN :199, addLimit :192).
+
+What crosses the pushdown boundary is decided here: filters, aggregates,
+group-bys, top-n and limits convert to copr IR and attach to the scan node
+when (a) every piece converts and (b) the kv client's capability probe
+accepts it — otherwise the piece stays as a SQL-side operator above the
+scan. This is the copr=cpu / copr=tpu routing point.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.copr import proto
+from tidb_tpu.expression import AggregationFunction, Column, Schema
+from tidb_tpu.expression.aggregation import AggFunctionMode
+from tidb_tpu.kv import kv
+from tidb_tpu.plan import refiner
+from tidb_tpu.plan.expr_to_pb import (
+    agg_func_to_pb, expressions_to_pb, group_by_item_to_pb, sort_item_to_pb,
+)
+from tidb_tpu.plan.plans import (
+    Aggregation, DataSource, Delete, Distinct, ExplainPlan, Insert, Join,
+    Limit, Plan, PhysicalDistinct, PhysicalHashAgg, PhysicalHashJoin,
+    PhysicalHashSemiJoin, PhysicalIndexScan, PhysicalLimit, PhysicalProjection,
+    PhysicalSelection, PhysicalSort, PhysicalTableDual, PhysicalTableScan,
+    PhysicalTopN, PhysicalUnion, PhysicalUnionScan, Projection, Selection,
+    Sort, SortItem, TableDual, Union, Update,
+)
+from tidb_tpu.types.field_type import FieldType, new_field_type
+
+
+class PhysicalContext:
+    def __init__(self, client, dirty_table_ids: set[int] | None = None):
+        self.client = client
+        self.dirty = dirty_table_ids or set()
+
+
+def to_physical(p: Plan, ctx: PhysicalContext) -> Plan:
+    if isinstance(p, DataSource):
+        return _convert_datasource(p, ctx)
+    if isinstance(p, Selection):
+        child = to_physical(p.child, ctx)
+        sel = PhysicalSelection(p.conditions)
+        sel.add_child(child)
+        sel.schema = child.schema
+        return sel
+    if isinstance(p, Projection):
+        child = to_physical(p.child, ctx)
+        proj = PhysicalProjection(p.exprs)
+        proj.add_child(child)
+        proj.schema = p.schema
+        return proj
+    if isinstance(p, Aggregation):
+        return _convert_aggregation(p, ctx)
+    if isinstance(p, Limit):
+        if isinstance(p.child, Sort):
+            return _convert_topn(p, p.child, ctx)
+        child = to_physical(p.child, ctx)
+        _push_limit(child, p.offset + p.count)
+        lim = PhysicalLimit(p.offset, p.count)
+        lim.add_child(child)
+        lim.schema = child.schema
+        return lim
+    if isinstance(p, Sort):
+        child = to_physical(p.child, ctx)
+        srt = PhysicalSort(p.by_items)
+        srt.add_child(child)
+        srt.schema = child.schema
+        return srt
+    if isinstance(p, Join):
+        left = to_physical(p.children[0], ctx)
+        right = to_physical(p.children[1], ctx)
+        # build the hash table on the right side (reference joins build the
+        # smaller side; without stats the inner/right is the heuristic)
+        hj = PhysicalHashJoin(p, small_side=1)
+        hj.add_child(left)
+        hj.add_child(right)
+        hj.schema = p.schema
+        hj._left_width = p._left_width
+        return hj
+    if isinstance(p, Distinct):
+        child = to_physical(p.child, ctx)
+        d = PhysicalDistinct()
+        d.add_child(child)
+        d.schema = child.schema
+        return d
+    if isinstance(p, Union):
+        u = PhysicalUnion()
+        for c in p.children:
+            u.add_child(to_physical(c, ctx))
+        u.schema = p.schema
+        return u
+    if isinstance(p, TableDual):
+        d = PhysicalTableDual(p.row_count)
+        d.schema = p.schema
+        return d
+    if isinstance(p, (Insert, Update, Delete)):
+        p.children = [to_physical(c, ctx) for c in p.children]
+        return p
+    if isinstance(p, ExplainPlan):
+        p.target = to_physical(p.target, ctx)
+        return p
+    # ShowPlan / SimplePlan / Prepare / Execute pass through
+    return p
+
+
+# ---------------------------------------------------------------------------
+# scans
+# ---------------------------------------------------------------------------
+
+def _handle_column(ds: DataSource) -> Column | None:
+    pk = ds.table_info.pk_handle_column()
+    if pk is None:
+        return None
+    for c in ds.schema:
+        if c.col_id == pk.id:
+            return c
+    return None
+
+
+def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
+    conditions = ds.push_conditions
+    handle_col = _handle_column(ds)
+    if handle_col is not None:
+        access, rest = refiner.detach_table_scan_conditions(
+            conditions, handle_col)
+    else:
+        access, rest = [], list(conditions)
+    table_ranges = refiner.build_table_range(access, handle_col) \
+        if access else list(refiner.FULL_TABLE_RANGE)
+
+    # index access path: only competes when the PK gave no bound
+    # (convert2IndexScan; the cost model with stats arrives later)
+    if not access:
+        idx_plan = _try_index_scan(ds, rest, ctx)
+        if idx_plan is not None:
+            return _maybe_union_scan(idx_plan, ds, conditions, ctx)
+
+    scan = PhysicalTableScan()
+    _fill_source(scan, ds)
+    scan.ranges = table_ranges
+    if ds.table_info.id in ctx.dirty:
+        scan.conditions = rest
+        return _maybe_union_scan(scan, ds, conditions, ctx)
+    pushed, remained = expressions_to_pb(ctx.client, rest, kv.REQ_TYPE_SELECT)
+    scan.pushed_where = pushed
+    scan.conditions = remained
+    return scan
+
+
+def _fill_source(scan, ds: DataSource) -> None:
+    scan.db_name = ds.db_name
+    scan.table = ds.table
+    scan.table_info = ds.table_info
+    scan.alias = ds.alias
+    scan.schema = ds.schema
+
+
+def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext):
+    """Pick the most selective index by eq-prefix length.
+    Reference: convert2IndexScan (plan/physical_plan_builder.go:206)."""
+    from tidb_tpu.model.model import SchemaState
+    best = None
+    best_score = 0
+    for idx in ds.table_info.indices:
+        if idx.state != SchemaState.PUBLIC:
+            continue
+        idx_cols = []
+        ok = True
+        for ic in idx.columns:
+            col_info = ds.table_info.find_column(ic.name)
+            sc = next((c for c in ds.schema if c.col_id == col_info.id), None)
+            if sc is None:
+                ok = False
+                break
+            idx_cols.append(sc)
+        if not ok or not idx_cols:
+            continue
+        eq_vals, range_conds, next_col, remained = \
+            refiner.detach_index_scan_conditions(conditions, idx_cols)
+        score = len(eq_vals) * 2 + (1 if range_conds else 0)
+        if score > best_score:
+            best_score = score
+            best = (idx, idx_cols, eq_vals, range_conds, remained)
+    if best is None:
+        return None
+    idx, idx_cols, eq_vals, range_conds, remained = best
+    scan = PhysicalIndexScan()
+    _fill_source(scan, ds)
+    scan.index = idx
+    scan.ranges = refiner.build_index_range(eq_vals, range_conds)
+    scan.conditions = remained
+    idx_col_ids = {c.col_id for c in idx_cols}
+    handle = _handle_column(ds)
+    covered = all(c.col_id in idx_col_ids
+                  or (handle is not None and c.col_id == handle.col_id)
+                  for c in ds.schema)
+    scan.double_read = not covered
+    scan.out_of_order = False
+    return scan
+
+
+def _maybe_union_scan(scan, ds: DataSource, conditions, ctx: PhysicalContext):
+    """Wrap with UnionScan when the txn holds dirty writes on this table so
+    reads-own-writes holds above pushdown scans
+    (plan/physical_plans.go:180 tryToAddUnionScan)."""
+    if ds.table_info.id not in ctx.dirty:
+        return scan
+    us = PhysicalUnionScan(list(conditions))
+    us.table_info = ds.table_info
+    us.add_child(scan)
+    us.schema = scan.schema
+    return us
+
+
+# ---------------------------------------------------------------------------
+# aggregation pushdown (convert2PhysicalPlanFinalHash)
+# ---------------------------------------------------------------------------
+
+def _pushable_scan(p: Plan):
+    """The scan an Aggregation may push into: a bare table scan with nothing
+    SQL-side between (residual filters break pushdown soundness)."""
+    if isinstance(p, PhysicalTableScan) and not p.conditions \
+            and not p.aggregates and p.limit is None and not p.topn_pb:
+        return p
+    return None
+
+
+def _convert_aggregation(agg: Aggregation, ctx: PhysicalContext) -> Plan:
+    child = to_physical(agg.child, ctx)
+    scan = _pushable_scan(child)
+    if scan is not None:
+        pushed = _try_push_aggregation(agg, scan, ctx)
+        if pushed is not None:
+            return pushed
+    ph = PhysicalHashAgg(agg.agg_funcs, agg.group_by)
+    ph.add_child(child)
+    ph.schema = agg.schema
+    return ph
+
+
+def _try_push_aggregation(agg: Aggregation, scan: PhysicalTableScan,
+                          ctx: PhysicalContext) -> Plan | None:
+    pb_aggs = []
+    for f in agg.agg_funcs:
+        pb = agg_func_to_pb(ctx.client, f, kv.REQ_TYPE_SELECT)
+        if pb is None:
+            return None
+        pb_aggs.append(pb)
+    pb_groups = []
+    for g in agg.group_by:
+        item = group_by_item_to_pb(ctx.client, g, kv.REQ_TYPE_SELECT)
+        if item is None:
+            return None
+        pb_groups.append(item)
+    if not ctx.client.support_request_type(kv.REQ_TYPE_SELECT,
+                                           kv.REQ_SUB_TYPE_GROUP_BY):
+        return None
+
+    scan.aggregates = pb_aggs
+    scan.group_by_pb = pb_groups
+    scan.aggregated_push_down = True
+
+    # partial row layout: [groupKey, f0 parts…, f1 parts…]
+    # (plan/physical_plans.go:265-283 AggFields synthesis)
+    agg_fields: list[FieldType] = [new_field_type(my.TypeBlob)]
+    final_funcs: list[AggregationFunction] = []
+    offset = 1
+    for f in agg.agg_funcs:
+        args: list[Column] = []
+        if f.need_count():
+            ft = new_field_type(my.TypeLonglong)
+            args.append(Column(col_name="cnt", ret_type=ft, index=offset))
+            agg_fields.append(ft)
+            offset += 1
+        if f.need_value():
+            ft = f.ret_type()
+            args.append(Column(col_name="val", ret_type=ft, index=offset))
+            agg_fields.append(ft)
+            offset += 1
+        if not f.need_count() and not f.need_value():  # plain count
+            ft = new_field_type(my.TypeLonglong)
+            args.append(Column(col_name="cnt", ret_type=ft, index=offset))
+            agg_fields.append(ft)
+            offset += 1
+        final_funcs.append(AggregationFunction(
+            f.name, args, mode=AggFunctionMode.FINAL, separator=f.separator))
+
+    scan.agg_fields = agg_fields
+    final = PhysicalHashAgg(final_funcs, [])
+    final.has_pushed_child = True
+    final.add_child(scan)
+    final.schema = agg.schema
+    return final
+
+
+# ---------------------------------------------------------------------------
+# top-n / limit pushdown
+# ---------------------------------------------------------------------------
+
+def _scan_below_projection(p: Plan):
+    """scan or projection→scan pattern for topn/limit pushdown."""
+    if isinstance(p, (PhysicalTableScan, PhysicalIndexScan)):
+        return p, None
+    if isinstance(p, PhysicalProjection) and len(p.children) == 1 \
+            and isinstance(p.child, PhysicalTableScan):
+        return p.child, p
+    return None, None
+
+
+def _convert_topn(lim: Limit, sort: Sort, ctx: PhysicalContext) -> Plan:
+    child = to_physical(sort.child, ctx)
+    topn = PhysicalTopN(sort.by_items, lim.offset, lim.count)
+    topn.add_child(child)
+    topn.schema = child.schema
+    _push_topn(topn, child, ctx)
+    return topn
+
+
+def _push_topn(topn: PhysicalTopN, child: Plan, ctx: PhysicalContext) -> None:
+    """Attach ORDER BY + LIMIT to the scan when sort keys map onto scan
+    columns (addTopN, plan/physical_plans.go:199). The SQL-side TopN stays:
+    per-region top-ks still need a final merge."""
+    scan, proj = _scan_below_projection(child)
+    if scan is None or scan.aggregated_push_down or scan.conditions \
+            or not isinstance(scan, PhysicalTableScan):
+        return
+    if not ctx.client.support_request_type(kv.REQ_TYPE_SELECT,
+                                           kv.REQ_SUB_TYPE_TOPN):
+        return
+    items_pb = []
+    for item in topn.by_items:
+        expr = item.expr
+        if proj is not None:
+            if not isinstance(expr, Column):
+                return
+            slot = proj.schema.column_index(expr)
+            if slot < 0:
+                return
+            expr = proj.exprs[slot]
+        pb = sort_item_to_pb(ctx.client, SortItem(expr, item.desc),
+                             kv.REQ_TYPE_SELECT)
+        if pb is None:
+            return
+        items_pb.append(pb)
+    scan.topn_pb = items_pb
+    scan.limit = topn.offset + topn.count
+
+
+def _push_limit(child: Plan, n: int) -> None:
+    scan, _ = _scan_below_projection(child)
+    if scan is not None and not scan.aggregated_push_down \
+            and not scan.conditions and not scan.topn_pb:
+        scan.limit = n if scan.limit is None else min(scan.limit, n)

@@ -1,0 +1,447 @@
+"""Plan node hierarchy: logical and physical operators.
+
+Reference: plan/plan.go:73,138,162 (Plan/LogicalPlan/PhysicalPlan),
+plan/logical_plans.go, plan/physical_plans.go. Each node carries an
+expression.Schema describing its output columns; children are ordered.
+
+The physical table/index sources implement the pushdown surface the
+reference calls physicalDistSQLPlan (plan/physical_plans.go:63):
+add_aggregation / add_topn / add_limit — what crosses the coprocessor
+boundary lives ON the scan node, exactly like the reference attaches
+tipb fields to physicalTableSource.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from tidb_tpu.expression import (
+    AggregationFunction, Column, Expression, Schema,
+)
+
+_id_gen = itertools.count(1)
+
+
+def alloc_id(prefix: str) -> str:
+    return f"{prefix}_{next(_id_gen)}"
+
+
+class Plan:
+    """Base plan node."""
+
+    def __init__(self, tp: str):
+        self.id = alloc_id(tp)
+        self.tp = tp
+        self.schema = Schema()
+        self.children: list[Plan] = []
+        self.correlated = False
+
+    def set_schema(self, schema: Schema) -> None:
+        self.schema = schema
+        schema.set_from(self.id)
+        schema.retrieve_positions()
+
+    def add_child(self, child: "Plan") -> None:
+        self.children.append(child)
+
+    @property
+    def child(self) -> "Plan":
+        return self.children[0]
+
+    def __repr__(self):
+        return self.id
+
+
+# ---------------------------------------------------------------------------
+# logical operators (plan/logical_plans.go)
+# ---------------------------------------------------------------------------
+
+class DataSource(Plan):
+    """A table in FROM. Holds the schema objects needed for access-path
+    planning (plan/logical_plans.go DataSource)."""
+
+    def __init__(self, db_name: str, table, table_info, alias: str = ""):
+        super().__init__("ds")
+        self.db_name = db_name
+        self.table = table              # table.tables.Table
+        self.table_info = table_info    # model.TableInfo
+        self.alias = alias or table_info.name
+        self.push_conditions: list[Expression] = []  # filled by predicate pushdown
+
+
+class Selection(Plan):
+    def __init__(self, conditions: list[Expression]):
+        super().__init__("sel")
+        self.conditions = conditions
+
+
+class Projection(Plan):
+    def __init__(self, exprs: list[Expression]):
+        super().__init__("proj")
+        self.exprs = exprs
+
+
+class Aggregation(Plan):
+    def __init__(self, agg_funcs: list[AggregationFunction],
+                 group_by: list[Expression]):
+        super().__init__("agg")
+        self.agg_funcs = agg_funcs
+        self.group_by = group_by
+
+
+class Sort(Plan):
+    def __init__(self, by_items: list["SortItem"]):
+        super().__init__("sort")
+        self.by_items = by_items
+        self.limit: int | None = None  # set when Limit sits directly above (TopN)
+        self.offset: int = 0
+
+
+class SortItem:
+    __slots__ = ("expr", "desc")
+
+    def __init__(self, expr: Expression, desc: bool = False):
+        self.expr = expr
+        self.desc = desc
+
+    def __repr__(self):
+        return f"{self.expr!r}{' desc' if self.desc else ''}"
+
+
+class Limit(Plan):
+    def __init__(self, offset: int, count: int):
+        super().__init__("limit")
+        self.offset = offset
+        self.count = count
+
+
+class Join(Plan):
+    INNER, LEFT_OUTER, RIGHT_OUTER, SEMI, LEFT_OUTER_SEMI = range(5)
+
+    def __init__(self, join_type: int):
+        super().__init__("join")
+        self.join_type = join_type
+        self.eq_conditions: list = []      # (left Column, right Column) pairs
+        self.left_conditions: list[Expression] = []
+        self.right_conditions: list[Expression] = []
+        self.other_conditions: list[Expression] = []
+        # anti semi-join flag (NOT EXISTS / NOT IN lowering)
+        self.anti = False
+
+
+class Union(Plan):
+    def __init__(self):
+        super().__init__("union")
+
+
+class Distinct(Plan):
+    def __init__(self):
+        super().__init__("dist")
+
+
+class TableDual(Plan):
+    """Zero/one-row source (SELECT without FROM). row_count 0 or 1."""
+
+    def __init__(self, row_count: int = 1):
+        super().__init__("dual")
+        self.row_count = row_count
+
+
+class MaxOneRow(Plan):
+    def __init__(self):
+        super().__init__("maxonerow")
+
+
+class Exists(Plan):
+    def __init__(self):
+        super().__init__("exists")
+
+
+class Apply(Plan):
+    """Correlated subquery execution: re-evaluates the inner plan per outer
+    row (plan/logical_plans.go Apply)."""
+
+    def __init__(self, inner_plan: Plan, outer_schema_cols: list[Column]):
+        super().__init__("apply")
+        self.inner_plan = inner_plan
+        self.outer_schema_cols = outer_schema_cols
+
+
+# ---- statement plans (write path + misc) ----
+
+class Insert(Plan):
+    def __init__(self, table, columns, lists, set_list, is_replace: bool,
+                 on_duplicate, select_plan: Plan | None):
+        super().__init__("insert")
+        self.table = table
+        self.columns = columns          # column names or None
+        self.lists = lists              # list of rows of Expression
+        self.set_list = set_list        # SET form assignments
+        self.is_replace = is_replace
+        self.on_duplicate = on_duplicate
+        self.select_plan = select_plan
+        self.priority = 0
+        self.ignore = False
+
+
+class Update(Plan):
+    def __init__(self, ordered_list):
+        super().__init__("update")
+        self.ordered_list = ordered_list  # list[(Column, Expression)]
+
+
+class Delete(Plan):
+    def __init__(self, tables, is_multi_table: bool):
+        super().__init__("delete")
+        self.tables = tables
+        self.is_multi_table = is_multi_table
+
+
+class ShowPlan(Plan):
+    def __init__(self, show_stmt):
+        super().__init__("show")
+        self.stmt = show_stmt
+
+
+class SimplePlan(Plan):
+    """Statements executed directly without optimization: DDL, SET, USE,
+    BEGIN/COMMIT/ROLLBACK, CREATE/DROP DATABASE, admin…
+    (plan/planbuilder.go buildSimple)."""
+
+    def __init__(self, stmt):
+        super().__init__("simple")
+        self.stmt = stmt
+
+
+class ExplainPlan(Plan):
+    def __init__(self, target: Plan):
+        super().__init__("explain")
+        self.target = target
+
+
+class Prepare(Plan):
+    def __init__(self, name: str, sql_text: str):
+        super().__init__("prepare")
+        self.name = name
+        self.sql_text = sql_text
+
+
+class Execute(Plan):
+    def __init__(self, name: str, using: list[Expression]):
+        super().__init__("execute")
+        self.name = name
+        self.using = using
+
+
+class Deallocate(Plan):
+    def __init__(self, name: str):
+        super().__init__("deallocate")
+        self.name = name
+
+
+# ---------------------------------------------------------------------------
+# physical operators (plan/physical_plans.go)
+# ---------------------------------------------------------------------------
+
+class PhysicalPlan(Plan):
+    pass
+
+
+class _PhysicalSource(PhysicalPlan):
+    """Shared pushdown surface of table/index scans — the reference's
+    physicalDistSQLPlan (plan/physical_plans.go:63,225)."""
+
+    def __init__(self, tp: str):
+        super().__init__(tp)
+        self.db_name = ""
+        self.table = None
+        self.table_info = None
+        self.alias = ""
+        # pushdown payload
+        self.conditions: list[Expression] = []       # SQL-side residual filter
+        self.pushed_where = None                     # copr.Expr
+        self.aggregates: list = []                   # copr.Expr agg list
+        self.group_by_pb: list = []                  # copr.ByItem
+        self.agg_funcs_final: list[AggregationFunction] = []
+        self.agg_fields: Schema | None = None        # schema after pushed agg
+        self.topn_pb: list = []                      # copr.ByItem
+        self.limit: int | None = None
+        self.desc = False
+        self.keep_order = False
+        self.out_of_order = True
+        self.aggregated_push_down = False
+
+    def storage_schema(self) -> Schema:
+        """Columns as fetched from storage (pre-agg layout)."""
+        return self.schema
+
+
+class PhysicalTableScan(_PhysicalSource):
+    def __init__(self):
+        super().__init__("tscan")
+        self.ranges: list = []      # refiner.TableRange list
+
+
+class PhysicalIndexScan(_PhysicalSource):
+    def __init__(self):
+        super().__init__("iscan")
+        self.index = None           # model.IndexInfo
+        self.ranges: list = []      # refiner.IndexRange list
+        self.double_read = False    # needs second lookup by handle
+        self.out_of_order = True
+
+
+class PhysicalSelection(PhysicalPlan):
+    def __init__(self, conditions: list[Expression]):
+        super().__init__("psel")
+        self.conditions = conditions
+
+
+class PhysicalProjection(PhysicalPlan):
+    def __init__(self, exprs: list[Expression]):
+        super().__init__("pproj")
+        self.exprs = exprs
+
+
+class PhysicalHashAgg(PhysicalPlan):
+    """mode: COMPLETE (raw rows) or FINAL (over pushed partials)."""
+
+    def __init__(self, agg_funcs, group_by):
+        super().__init__("phashagg")
+        self.agg_funcs = agg_funcs
+        self.group_by = group_by
+        self.has_pushed_child = False  # child emits [groupKey, partials...]
+
+
+class PhysicalStreamAgg(PhysicalPlan):
+    def __init__(self, agg_funcs, group_by):
+        super().__init__("pstreamagg")
+        self.agg_funcs = agg_funcs
+        self.group_by = group_by
+
+
+class PhysicalSort(PhysicalPlan):
+    def __init__(self, by_items: list[SortItem]):
+        super().__init__("psort")
+        self.by_items = by_items
+
+
+class PhysicalTopN(PhysicalPlan):
+    def __init__(self, by_items: list[SortItem], offset: int, count: int):
+        super().__init__("ptopn")
+        self.by_items = by_items
+        self.offset = offset
+        self.count = count
+
+
+class PhysicalLimit(PhysicalPlan):
+    def __init__(self, offset: int, count: int):
+        super().__init__("plimit")
+        self.offset = offset
+        self.count = count
+
+
+class PhysicalHashJoin(PhysicalPlan):
+    def __init__(self, join: Join, small_side: int):
+        super().__init__("phashjoin")
+        self.join_type = join.join_type
+        self.eq_conditions = join.eq_conditions
+        self.left_conditions = join.left_conditions
+        self.right_conditions = join.right_conditions
+        self.other_conditions = join.other_conditions
+        self.anti = join.anti
+        self.small_side = small_side  # 0 = build left, 1 = build right
+        self.concurrency = 5          # plan/physical_plan_builder.go:42
+
+
+class PhysicalHashSemiJoin(PhysicalPlan):
+    def __init__(self, join: Join, with_aux: bool):
+        super().__init__("psemijoin")
+        self.eq_conditions = join.eq_conditions
+        self.left_conditions = join.left_conditions
+        self.right_conditions = join.right_conditions
+        self.other_conditions = join.other_conditions
+        self.anti = join.anti
+        self.with_aux = with_aux      # LEFT OUTER SEMI: emit match flag col
+
+
+class PhysicalUnion(PhysicalPlan):
+    def __init__(self):
+        super().__init__("punion")
+
+
+class PhysicalDistinct(PhysicalPlan):
+    def __init__(self):
+        super().__init__("pdist")
+
+
+class PhysicalTableDual(PhysicalPlan):
+    def __init__(self, row_count: int = 1):
+        super().__init__("pdual")
+        self.row_count = row_count
+
+
+class PhysicalExists(PhysicalPlan):
+    def __init__(self):
+        super().__init__("pexists")
+
+
+class PhysicalMaxOneRow(PhysicalPlan):
+    def __init__(self):
+        super().__init__("pmaxonerow")
+
+
+class PhysicalApply(PhysicalPlan):
+    def __init__(self, inner_plan, outer_schema_cols):
+        super().__init__("papply")
+        self.inner_plan = inner_plan
+        self.outer_schema_cols = outer_schema_cols
+
+
+class PhysicalUnionScan(PhysicalPlan):
+    """Merges txn-dirty writes over a pushdown scan (executor/union_scan.go);
+    attached when the txn has uncommitted writes to the scanned table."""
+
+    def __init__(self, conditions: list[Expression]):
+        super().__init__("punionscan")
+        self.conditions = conditions
+        self.table_info = None
+
+
+def tree_string(p: Plan, indent: str = "") -> str:
+    """EXPLAIN-style plan rendering (plan/stringer.go)."""
+    label = p.tp
+    detail = ""
+    if isinstance(p, PhysicalTableScan):
+        detail = f" table:{p.alias}"
+        if p.pushed_where is not None:
+            detail += f" pushed_where:{p.pushed_where!r}"
+        if p.aggregates:
+            detail += f" pushed_aggs:{p.aggregates!r}"
+        if p.conditions:
+            detail += f" residual:{p.conditions!r}"
+        if p.limit is not None:
+            detail += f" limit:{p.limit}"
+        if p.topn_pb:
+            detail += " topn"
+    elif isinstance(p, PhysicalIndexScan):
+        detail = f" table:{p.alias} index:{p.index.name}" \
+            + (" double_read" if p.double_read else "")
+    elif isinstance(p, (PhysicalSelection, Selection)):
+        detail = f" {p.conditions!r}"
+    elif isinstance(p, (PhysicalProjection, Projection)):
+        detail = f" {p.exprs!r}"
+    elif isinstance(p, (PhysicalHashAgg, Aggregation, PhysicalStreamAgg)):
+        detail = f" funcs:{p.agg_funcs!r} group_by:{p.group_by!r}"
+    elif isinstance(p, (PhysicalSort, Sort)):
+        detail = f" {p.by_items!r}"
+    elif isinstance(p, PhysicalTopN):
+        detail = f" {p.by_items!r} limit:{p.offset},{p.count}"
+    elif isinstance(p, (PhysicalLimit, Limit)):
+        detail = f" {p.offset},{p.count}"
+    elif isinstance(p, PhysicalHashJoin):
+        detail = f" eq:{p.eq_conditions!r}"
+    lines = [f"{indent}{label}{detail}"]
+    for c in p.children:
+        lines.append(tree_string(c, indent + "  "))
+    return "\n".join(lines)

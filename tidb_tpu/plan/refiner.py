@@ -1,0 +1,207 @@
+"""Access-path range calculation: conditions → table/index ranges.
+
+Reference: plan/refiner.go (buildTableRange, buildIndexRange,
+detachTableScanConditions, detachIndexScanConditions) and plan/range.go
+(rangeBuilder over the points abstraction). Simplified to the condition
+shapes the executor pushes: comparisons / IN / BETWEEN-lowered ANDs on the
+integer PK handle (table scans) or an index column prefix (index scans).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tidb_tpu.expression import Column, Constant, Expression, ScalarFunction
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import Kind, compare_datum
+
+I64_MIN = -(1 << 63)
+I64_MAX = (1 << 63) - 1
+
+
+@dataclass
+class TableRange:
+    """Inclusive handle range [low, high] (plan/range.go TableRange)."""
+    low: int
+    high: int
+
+
+FULL_TABLE_RANGE = [TableRange(I64_MIN, I64_MAX)]
+
+
+@dataclass
+class IndexRange:
+    """Datum-tuple range over index columns (plan/range.go IndexRange)."""
+    low: list[Datum]
+    high: list[Datum]
+    low_exclude: bool = False
+    high_exclude: bool = False
+
+
+def _const_int(e: Expression) -> int | None:
+    if isinstance(e, Constant) and not e.value.is_null():
+        v = e.value
+        if v.kind in (Kind.INT64, Kind.UINT64):
+            return v.get_int()
+        if v.kind == Kind.FLOAT64 and float(v.val).is_integer():
+            return int(v.val)
+    return None
+
+
+def _col_cmp_const(cond: Expression, col: Column):
+    """Match `col OP const` / `const OP col` → (op, int) or None."""
+    if not isinstance(cond, ScalarFunction) or cond.op is None:
+        return None
+    op = cond.op
+    if op not in (Op.EQ, Op.LT, Op.LE, Op.GT, Op.GE) or len(cond.args) != 2:
+        return None
+    a, b = cond.args
+    if isinstance(a, Column) and a.equal(col):
+        v = _const_int(b)
+        return None if v is None else (op, v)
+    if isinstance(b, Column) and b.equal(col):
+        v = _const_int(a)
+        if v is None:
+            return None
+        flipped = {Op.LT: Op.GT, Op.LE: Op.GE, Op.GT: Op.LT, Op.GE: Op.LE,
+                   Op.EQ: Op.EQ}
+        return flipped[op], v
+    return None
+
+
+def detach_table_scan_conditions(conditions: list[Expression], handle_col: Column):
+    """Split into (access conditions on the handle, residual filter).
+    Reference: plan/refiner.go detachTableScanConditions."""
+    access, rest = [], []
+    for cond in conditions:
+        if _col_cmp_const(cond, handle_col) is not None:
+            access.append(cond)
+        elif (isinstance(cond, ScalarFunction) and cond.func_name == "in"
+                and isinstance(cond.args[0], Column)
+                and cond.args[0].equal(handle_col)
+                and all(_const_int(a) is not None for a in cond.args[1:])):
+            access.append(cond)
+        else:
+            rest.append(cond)
+    return access, rest
+
+
+def build_table_range(access: list[Expression], handle_col: Column) -> list[TableRange]:
+    """Intersect handle constraints into sorted disjoint ranges.
+    Reference: plan/refiner.go BuildTableRange."""
+    if not access:
+        return list(FULL_TABLE_RANGE)
+    ranges = [TableRange(I64_MIN, I64_MAX)]
+    for cond in access:
+        if isinstance(cond, ScalarFunction) and cond.func_name == "in":
+            points = sorted({_const_int(a) for a in cond.args[1:]})
+            ranges = _intersect_ranges(ranges,
+                                       [TableRange(p, p) for p in points])
+            continue
+        op, v = _col_cmp_const(cond, handle_col)
+        if op == Op.EQ:
+            new = [TableRange(v, v)]
+        elif op == Op.LT:
+            new = [TableRange(I64_MIN, v - 1)] if v > I64_MIN else []
+        elif op == Op.LE:
+            new = [TableRange(I64_MIN, v)]
+        elif op == Op.GT:
+            new = [TableRange(v + 1, I64_MAX)] if v < I64_MAX else []
+        else:  # GE
+            new = [TableRange(v, I64_MAX)]
+        ranges = _intersect_ranges(ranges, new)
+    return ranges
+
+
+def _intersect_ranges(a: list[TableRange], b: list[TableRange]) -> list[TableRange]:
+    out = []
+    for ra in a:
+        for rb in b:
+            lo, hi = max(ra.low, rb.low), min(ra.high, rb.high)
+            if lo <= hi:
+                out.append(TableRange(lo, hi))
+    out.sort(key=lambda r: r.low)
+    return out
+
+
+# ---- index ranges ----
+
+def _col_cmp_any_const(cond: Expression, col: Column):
+    """Like _col_cmp_const but for any constant datum type."""
+    if not isinstance(cond, ScalarFunction) or cond.op is None:
+        return None
+    op = cond.op
+    if op not in (Op.EQ, Op.LT, Op.LE, Op.GT, Op.GE) or len(cond.args) != 2:
+        return None
+    a, b = cond.args
+    if isinstance(a, Column) and a.equal(col) and isinstance(b, Constant) \
+            and not b.value.is_null():
+        return op, b.value
+    if isinstance(b, Column) and b.equal(col) and isinstance(a, Constant) \
+            and not a.value.is_null():
+        flipped = {Op.LT: Op.GT, Op.LE: Op.GE, Op.GT: Op.LT, Op.GE: Op.LE,
+                   Op.EQ: Op.EQ}
+        return flipped[op], a.value
+    return None
+
+
+def detach_index_scan_conditions(conditions: list[Expression],
+                                 index_cols: list[Column]):
+    """Greedy prefix match: eq conditions on leading index columns, then at
+    most one range condition set on the next column.
+    Reference: plan/refiner.go detachIndexScanConditions.
+    Returns (eq_values, range_conds_on_next_col, next_col, residual)."""
+    remaining = list(conditions)
+    eq_values: list[Datum] = []
+    for col in index_cols:
+        hit = None
+        for cond in remaining:
+            m = _col_cmp_any_const(cond, col)
+            if m is not None and m[0] == Op.EQ:
+                hit = (cond, m[1])
+                break
+        if hit is None:
+            break
+        eq_values.append(hit[1])
+        remaining.remove(hit[0])
+    range_conds = []
+    next_col = None
+    if len(eq_values) < len(index_cols):
+        next_col = index_cols[len(eq_values)]
+        for cond in list(remaining):
+            m = _col_cmp_any_const(cond, next_col)
+            if m is not None:
+                range_conds.append(m)
+                remaining.remove(cond)
+    return eq_values, range_conds, next_col, remaining
+
+
+def build_index_range(eq_values: list[Datum], range_conds) -> list[IndexRange]:
+    """Reference: plan/refiner.go buildIndexRange."""
+    from tidb_tpu.types.datum import MAX_VALUE, MIN_NOT_NULL, NULL as NULL_D
+    low: list[Datum] = list(eq_values)
+    high: list[Datum] = list(eq_values)
+    if not range_conds:
+        if not eq_values:
+            return [IndexRange([NULL_D], [MAX_VALUE])]
+        return [IndexRange(low, high)]
+    lo_d, lo_excl = MIN_NOT_NULL, False
+    hi_d, hi_excl = MAX_VALUE, False
+    for op, v in range_conds:
+        if op == Op.EQ:
+            if (compare_datum(lo_d, v) > 0 or compare_datum(hi_d, v) < 0):
+                return []
+            lo_d, hi_d, lo_excl, hi_excl = v, v, False, False
+        elif op in (Op.GT, Op.GE):
+            c = compare_datum(v, lo_d)
+            if c > 0 or (c == 0 and op == Op.GT and not lo_excl):
+                lo_d, lo_excl = v, op == Op.GT
+        else:  # LT / LE
+            c = compare_datum(v, hi_d)
+            if c < 0 or (c == 0 and op == Op.LT and not hi_excl):
+                hi_d, hi_excl = v, op == Op.LT
+    c = compare_datum(lo_d, hi_d)
+    if c > 0 or (c == 0 and (lo_excl or hi_excl)):
+        return []
+    return [IndexRange(low + [lo_d], high + [hi_d], lo_excl, hi_excl)]

@@ -1,0 +1,454 @@
+"""Logical rewrite rules: predicate pushdown, column pruning, index binding.
+
+Reference: plan/predicate_push_down.go, plan/column_pruning.go,
+plan/resolve_indices.go (folded into doOptimize, plan/optimizer.go:52).
+
+Scope model: a plan node's output scope is its schema; pass-through nodes
+(Selection/Sort/Limit/Distinct) share the child's schema object, so
+conditions resolved against them are already in the producing node's scope
+and pushdown needs no rebasing. Branding nodes (DataSource/Projection/
+Aggregation/Join/Union) introduce fresh (from_id, position) identities;
+`position` is a stable identity assigned at build time, `index` is the
+physical slot recomputed here after pruning.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu.expression import Column, Constant, Expression, ScalarFunction
+from tidb_tpu.expression.expression import Cast
+from tidb_tpu.plan.plans import (
+    Aggregation, DataSource, Delete, Distinct, ExplainPlan, Insert, Join,
+    Limit, Plan, Projection, Selection, Sort, TableDual, Union, Update,
+)
+from tidb_tpu.sqlast.opcode import Op
+
+
+# ---------------------------------------------------------------------------
+# expression utilities
+# ---------------------------------------------------------------------------
+
+def column_substitute(expr: Expression, schema, new_exprs) -> Expression:
+    """Replace references to schema's columns with the parallel new_exprs
+    (pushing predicates through a Projection)."""
+    if isinstance(expr, Column):
+        i = schema.column_index(expr)
+        return new_exprs[i].clone() if i >= 0 else expr.clone()
+    if isinstance(expr, ScalarFunction):
+        return ScalarFunction(expr.func_name,
+                              [column_substitute(a, schema, new_exprs)
+                               for a in expr.args],
+                              expr.ret_type, expr.op)
+    if isinstance(expr, Cast):
+        return Cast(column_substitute(expr.arg, schema, new_exprs),
+                    expr.ret_type)
+    return expr.clone()
+
+
+_NONDETERMINISTIC = frozenset(("rand", "now", "current_timestamp", "sysdate",
+                               "curdate", "current_date", "uuid",
+                               "connection_id", "last_insert_id"))
+
+
+def is_deterministic(expr: Expression) -> bool:
+    if isinstance(expr, ScalarFunction):
+        if expr.op is None and expr.func_name in _NONDETERMINISTIC:
+            return False
+        return all(is_deterministic(a) for a in expr.args)
+    if isinstance(expr, Cast):
+        return is_deterministic(expr.arg)
+    return True
+
+
+def _extract_eq_cond(cond: Expression, left_width: int):
+    """col_left = col_right across the join boundary → (lcol, rcol)."""
+    if not (isinstance(cond, ScalarFunction) and cond.op == Op.EQ
+            and len(cond.args) == 2):
+        return None
+    a, b = cond.args
+    if not (isinstance(a, Column) and isinstance(b, Column)):
+        return None
+    a_left = a.position < left_width
+    b_left = b.position < left_width
+    if a_left == b_left:
+        return None
+    return (a, b) if a_left else (b, a)
+
+
+def _cond_side(cond: Expression, left_width: int) -> str:
+    """'left' | 'right' | 'both' | 'none' by referenced column positions."""
+    cols = cond.columns()
+    if not cols:
+        return "none"
+    sides = {("left" if c.position < left_width else "right") for c in cols}
+    return sides.pop() if len(sides) == 1 else "both"
+
+
+def _rebase_to_child(cond: Expression, join: Join, side: str) -> Expression:
+    """Map a join-scope condition to the child scope (positions offset for
+    the right side)."""
+    left_width = join._left_width
+    child = join.children[0] if side == "left" else join.children[1]
+
+    def rb(e: Expression) -> Expression:
+        if isinstance(e, Column):
+            pos = e.position if side == "left" else e.position - left_width
+            return child.schema[_pos_slot(child.schema, pos)].clone()
+        if isinstance(e, ScalarFunction):
+            return ScalarFunction(e.func_name, [rb(a) for a in e.args],
+                                  e.ret_type, e.op)
+        if isinstance(e, Cast):
+            return Cast(rb(e.arg), e.ret_type)
+        return e.clone()
+
+    return rb(cond)
+
+
+def _pos_slot(schema, position: int) -> int:
+    for i, c in enumerate(schema.columns):
+        if c.position == position:
+            return i
+    raise KeyError(position)
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown
+# ---------------------------------------------------------------------------
+
+def predicate_push_down(p: Plan, predicates: list[Expression] | None = None):
+    """Returns (remained_conditions, new_plan). Predicates are in p's output
+    scope. Reference: plan/predicate_push_down.go."""
+    preds = predicates or []
+
+    if isinstance(p, DataSource):
+        p.push_conditions.extend(preds)
+        return [], p
+
+    if isinstance(p, Selection):
+        merged = list(p.conditions) + preds  # same scope (shared schema)
+        rem, child = predicate_push_down(p.child, merged)
+        if not rem:
+            return [], child
+        p.children = [child]
+        p.conditions = rem
+        p.schema = child.schema
+        return [], p
+
+    if isinstance(p, Projection):
+        pushable, kept = [], []
+        can_push_through = all(is_deterministic(e) for e in p.exprs)
+        for cond in preds:
+            if can_push_through:
+                pushable.append(column_substitute(cond, p.schema, p.exprs))
+            else:
+                kept.append(cond)
+        rem, child = predicate_push_down(p.child, pushable)
+        p.children = [_maybe_wrap_selection(child, rem)]
+        return kept, p
+
+    if isinstance(p, Join):
+        return _ppd_join(p, preds)
+
+    if isinstance(p, (Sort, Distinct)):
+        rem, child = predicate_push_down(p.child, preds)
+        p.children = [_maybe_wrap_selection(child, rem)]
+        p.schema = p.children[0].schema
+        return [], p
+
+    if isinstance(p, Limit):
+        # filters may not cross a LIMIT
+        rem, child = predicate_push_down(p.child, [])
+        p.children = [_maybe_wrap_selection(child, rem)]
+        p.schema = p.children[0].schema
+        return preds, p
+
+    if isinstance(p, Aggregation):
+        # conditions on agg outputs stay above (HAVING); group-key-only
+        # pushdown is a later optimization
+        rem, child = predicate_push_down(p.child, [])
+        p.children = [_maybe_wrap_selection(child, rem)]
+        return preds, p
+
+    if isinstance(p, Union):
+        for i, c in enumerate(p.children):
+            child_preds = []
+            for cond in preds:
+                # union scope position i ↔ child scope position i
+                child_preds.append(_rebase_union_cond(cond, c))
+            rem, nc = predicate_push_down(c, child_preds)
+            p.children[i] = _maybe_wrap_selection(nc, rem)
+        return [], p
+
+    if isinstance(p, (Insert, Update, Delete, ExplainPlan)):
+        new_children = []
+        for c in p.children:
+            rem, nc = predicate_push_down(c, [])
+            new_children.append(_maybe_wrap_selection(nc, rem))
+        p.children = new_children
+        return preds, p
+
+    # leaf-ish nodes (TableDual, Show, Simple…)
+    return preds, p
+
+
+def _rebase_union_cond(cond: Expression, child: Plan) -> Expression:
+    def rb(e):
+        if isinstance(e, Column):
+            return child.schema[_pos_slot(child.schema, e.position)].clone()
+        if isinstance(e, ScalarFunction):
+            return ScalarFunction(e.func_name, [rb(a) for a in e.args],
+                                  e.ret_type, e.op)
+        if isinstance(e, Cast):
+            return Cast(rb(e.arg), e.ret_type)
+        return e.clone()
+    return rb(cond)
+
+
+def _maybe_wrap_selection(p: Plan, conditions: list[Expression]) -> Plan:
+    if not conditions:
+        return p
+    sel = Selection(conditions)
+    sel.add_child(p)
+    sel.schema = p.schema
+    return sel
+
+
+def _ppd_join(join: Join, preds: list[Expression]):
+    lw = join._left_width
+    left_push: list[Expression] = []
+    right_push: list[Expression] = []
+    remained: list[Expression] = []
+
+    # ON conditions first (already in join scope)
+    on_conds = join.other_conditions
+    join.other_conditions = []
+    for cond in on_conds:
+        side = _cond_side(cond, lw)
+        eq = _extract_eq_cond(cond, lw)
+        if join.join_type == Join.INNER:
+            # inner ON ≡ WHERE
+            preds = preds + [cond]
+        else:  # LEFT_OUTER: ON filters the match, not the left rows
+            if eq is not None:
+                join.eq_conditions.append(eq)
+            elif side == "right":
+                right_push.append(_rebase_to_child(cond, join, "right"))
+            elif side == "left":
+                join.left_conditions.append(cond)
+            else:
+                join.other_conditions.append(cond)
+
+    for cond in preds:
+        side = _cond_side(cond, lw)
+        eq = _extract_eq_cond(cond, lw)
+        if join.join_type == Join.INNER:
+            if eq is not None:
+                join.eq_conditions.append(eq)
+            elif side == "left":
+                left_push.append(_rebase_to_child(cond, join, "left"))
+            elif side == "right":
+                right_push.append(_rebase_to_child(cond, join, "right"))
+            elif side == "none":
+                left_push.append(cond)  # constant condition
+            else:
+                join.other_conditions.append(cond)
+        else:  # LEFT_OUTER WHERE: only left-side filters push down
+            if side == "left":
+                left_push.append(_rebase_to_child(cond, join, "left"))
+            else:
+                remained.append(cond)
+
+    lrem, lchild = predicate_push_down(join.children[0], left_push)
+    rrem, rchild = predicate_push_down(join.children[1], right_push)
+    join.children = [_maybe_wrap_selection(lchild, lrem),
+                     _maybe_wrap_selection(rchild, rrem)]
+    return remained, join
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+# ---------------------------------------------------------------------------
+
+def prune_columns(p: Plan, required: set[int] | None = None) -> None:
+    """Drop unused output columns. `required` holds needed schema positions
+    of p (None = all). Reference: plan/column_pruning.go."""
+    if required is None:
+        required = {c.position for c in p.schema}
+
+    if isinstance(p, DataSource):
+        needed = set(required)
+        for cond in p.push_conditions:
+            needed.update(c.position for c in cond.columns())
+        p.schema.columns = [c for c in p.schema.columns
+                            if c.position in needed]
+        _relayout(p.schema)
+        return
+
+    if isinstance(p, (Selection, Sort, Distinct, Limit)):
+        child_req = set(required)
+        if isinstance(p, Selection):
+            for cond in p.conditions:
+                child_req.update(c.position for c in cond.columns())
+        if isinstance(p, Sort):
+            for item in p.by_items:
+                child_req.update(c.position for c in item.expr.columns())
+        if isinstance(p, Distinct):
+            child_req = {c.position for c in p.schema}  # dedup needs all
+        prune_columns(p.child, child_req)
+        p.schema = p.child.schema
+        return
+
+    if isinstance(p, Projection):
+        kept_exprs, kept_cols = [], []
+        for e, c in zip(p.exprs, p.schema.columns):
+            if c.position in required:
+                kept_exprs.append(e)
+                kept_cols.append(c)
+        if not kept_cols:  # keep at least one column (e.g. count input)
+            kept_exprs, kept_cols = p.exprs[:1], p.schema.columns[:1]
+        p.exprs = kept_exprs
+        p.schema.columns = kept_cols
+        _relayout(p.schema)
+        child_req = set()
+        for e in p.exprs:
+            child_req.update(c.position for c in e.columns())
+        prune_columns(p.child, child_req or None)
+        return
+
+    if isinstance(p, Aggregation):
+        kept_funcs, kept_cols = [], []
+        for f, c in zip(p.agg_funcs, p.schema.columns):
+            if c.position in required:
+                kept_funcs.append(f)
+                kept_cols.append(c)
+        if not kept_cols:
+            kept_funcs, kept_cols = p.agg_funcs[:1], p.schema.columns[:1]
+        p.agg_funcs = kept_funcs
+        p.schema.columns = kept_cols
+        _relayout(p.schema)
+        child_req = set()
+        for f in p.agg_funcs:
+            for a in f.args:
+                child_req.update(c.position for c in a.columns())
+        for g in p.group_by:
+            child_req.update(c.position for c in g.columns())
+        if not child_req and p.child.schema.columns:
+            # e.g. COUNT(1): keep one arbitrary child column
+            child_req = {p.child.schema.columns[0].position}
+        prune_columns(p.child, child_req)
+        return
+
+    if isinstance(p, Join):
+        lw = p._left_width
+        needed = set(required)
+        for lcol, rcol in p.eq_conditions:
+            needed.add(lcol.position)
+            needed.add(rcol.position)
+        for cond in (p.left_conditions + p.right_conditions
+                     + p.other_conditions):
+            needed.update(c.position for c in cond.columns())
+        left_req = {pos for pos in needed if pos < lw}
+        right_req = {pos - lw for pos in needed if pos >= lw}
+        prune_columns(p.children[0], left_req or {next(
+            (c.position for c in p.children[0].schema), 0)})
+        prune_columns(p.children[1], right_req or {next(
+            (c.position for c in p.children[1].schema), 0)})
+        p.schema.columns = [c for c in p.schema.columns if c.position in needed]
+        _relayout(p.schema)
+        return
+
+    if isinstance(p, Union):
+        for c in p.children:
+            prune_columns(c, set(required))
+        p.schema.columns = [c for c in p.schema.columns
+                            if c.position in required]
+        _relayout(p.schema)
+        return
+
+    # default: require everything from children
+    for c in p.children:
+        prune_columns(c, None)
+
+
+def _relayout(schema) -> None:
+    for i, c in enumerate(schema.columns):
+        c.index = i
+
+
+# ---------------------------------------------------------------------------
+# index resolution (rebind expression columns to physical slots)
+# ---------------------------------------------------------------------------
+
+def resolve_indices(p: Plan) -> None:
+    for c in p.children:
+        resolve_indices(c)
+
+    if isinstance(p, Join):
+        lw_slots = len(p.children[0].schema.columns)
+        lookup: dict[tuple, int] = {}
+        for c in p.children[0].schema.columns:
+            lookup[(c.from_id, c.position)] = c.index
+        for c in p.children[1].schema.columns:
+            lookup[(c.from_id, c.position)] = c.index + lw_slots
+        for lcol, rcol in p.eq_conditions:
+            _bind(lcol, lookup)
+            _bind(rcol, lookup)
+        for cond in p.left_conditions + p.right_conditions + p.other_conditions:
+            _bind_expr(cond, lookup)
+        # join output schema slots map through the lookup as well: the
+        # output row is [left_row, right_row]
+        join_lookup = {}
+        for c in p.schema.columns:
+            src_pos = c.position
+            lw = p._left_width
+            if src_pos < lw:
+                src = next(cc for cc in p.children[0].schema.columns
+                           if cc.position == src_pos)
+                c.index = src.index
+            else:
+                src = next(cc for cc in p.children[1].schema.columns
+                           if cc.position == src_pos - lw)
+                c.index = src.index + lw_slots
+            join_lookup[(c.from_id, c.position)] = c.index
+        return
+
+    if not p.children:
+        return
+    child = p.children[0]
+    lookup = {(c.from_id, c.position): c.index for c in child.schema.columns}
+
+    if isinstance(p, Selection):
+        for cond in p.conditions:
+            _bind_expr(cond, lookup)
+    elif isinstance(p, Projection):
+        for e in p.exprs:
+            _bind_expr(e, lookup)
+    elif isinstance(p, Aggregation):
+        for f in p.agg_funcs:
+            for a in f.args:
+                _bind_expr(a, lookup)
+        for g in p.group_by:
+            _bind_expr(g, lookup)
+    elif isinstance(p, Sort):
+        for item in p.by_items:
+            _bind_expr(item.expr, lookup)
+    elif isinstance(p, Update):
+        for _, e in p.ordered_list:
+            _bind_expr(e, lookup)
+        for col, _ in p.ordered_list:
+            _bind(col, lookup)
+
+
+def _bind(col: Column, lookup: dict) -> None:
+    key = (col.from_id, col.position)
+    if key in lookup:
+        col.index = lookup[key]
+
+
+def _bind_expr(e: Expression, lookup: dict) -> None:
+    if isinstance(e, Column):
+        _bind(e, lookup)
+    elif isinstance(e, ScalarFunction):
+        for a in e.args:
+            _bind_expr(a, lookup)
+    elif isinstance(e, Cast):
+        _bind_expr(e.arg, lookup)

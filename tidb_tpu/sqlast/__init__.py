@@ -15,6 +15,7 @@ from tidb_tpu.sqlast.expressions import (  # noqa: F401
 )
 from tidb_tpu.sqlast.dml import (  # noqa: F401
     SelectStmt, SelectField, TableSource, Join, TableName, ByItem, Limit,
+    UnionStmt,
     InsertStmt, UpdateStmt, DeleteStmt, Assignment,
 )
 from tidb_tpu.sqlast.ddl import (  # noqa: F401

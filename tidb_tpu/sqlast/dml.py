@@ -101,3 +101,12 @@ class DeleteStmt(StmtNode):
     where: ExprNode | None = None
     order_by: list[ByItem] = field(default_factory=list)
     limit: Limit | None = None
+
+
+@dataclass
+class UnionStmt(StmtNode):
+    """SELECT ... UNION [ALL] SELECT ... (ast/dml.go UnionStmt)."""
+    selects: list[SelectStmt] = field(default_factory=list)
+    distinct: bool = True  # UNION implies DISTINCT unless ALL
+    order_by: list[ByItem] = field(default_factory=list)
+    limit: Limit | None = None
