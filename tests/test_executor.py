@@ -1,0 +1,218 @@
+"""Executor integration tests: SQL text → plan → executor tree → rows,
+without the session layer (that arrives next; these pin the engine).
+
+Mirrors executor/executor_test.go shapes at smaller scale.
+"""
+
+import pytest
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.ddl.ddl import ColumnSpec, IndexSpec
+from tidb_tpu.domain import Domain, clear_domains
+from tidb_tpu.executor import ExecContext, ExecutorBuilder
+from tidb_tpu.localstore import LocalStore
+from tidb_tpu.parser.parser import Parser
+from tidb_tpu.plan import optimize
+from tidb_tpu.types.field_type import FieldType
+
+
+def _ft(tp, flag=0, flen=-1, dec=-1):
+    return FieldType(tp, flag, flen, dec)
+
+
+@pytest.fixture
+def ctx():
+    clear_domains()
+    store = LocalStore()
+    dom = Domain(store)
+    dom.ddl.create_schema("test")
+    dom.ddl.create_table("test", "t", [
+        ColumnSpec("id", _ft(my.TypeLonglong)),
+        ColumnSpec("a", _ft(my.TypeLong)),
+        ColumnSpec("b", _ft(my.TypeVarchar, flen=64)),
+        ColumnSpec("c", _ft(my.TypeDouble)),
+    ], [IndexSpec("primary", ["id"], primary=True),
+        IndexSpec("idx_b", ["b"])])
+    dom.ddl.create_table("test", "s", [
+        ColumnSpec("id", _ft(my.TypeLonglong)),
+        ColumnSpec("t_id", _ft(my.TypeLonglong)),
+        ColumnSpec("v", _ft(my.TypeVarchar, flen=64)),
+    ], [IndexSpec("primary", ["id"], primary=True)])
+    return ExecContext(store, dom, "test")
+
+
+def run(ctx, sql, commit=True):
+    stmt = Parser().parse_one(sql)
+    plan = optimize(stmt, ctx, ctx.client, ctx.dirty_tables)
+    exec_ = ExecutorBuilder(ctx).build(plan)
+    rows = []
+    while True:
+        r = exec_.next()
+        if r is None:
+            break
+        rows.append([d.val for d in r])
+    exec_.close()
+    if commit:
+        ctx.commit()
+    return rows
+
+
+def seed(ctx):
+    run(ctx, "insert into t values (1, 10, 'x', 1.5), (2, 20, 'y', 2.5), "
+             "(3, 30, 'x', 3.5), (4, 40, 'z', 4.5), (5, 50, 'y', null)")
+
+
+class TestReadPath:
+    def test_insert_and_scan(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select * from t")
+        assert len(rows) == 5
+        assert rows[0] == [1, 10, "x", 1.5]
+
+    def test_where_pushed(self, ctx):
+        seed(ctx)
+        assert run(ctx, "select id from t where a > 25") == [[3], [4], [5]]
+
+    def test_pk_range(self, ctx):
+        seed(ctx)
+        assert run(ctx, "select id from t where id between 2 and 4") == \
+            [[2], [3], [4]]
+
+    def test_projection_exprs(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select a * 2 + 1, upper(b) from t where id = 1")
+        assert rows == [[21, "X"]]
+
+    def test_agg_pushdown_end_to_end(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select count(*), sum(a), min(c), max(c) from t")
+        [[cnt, s, mn, mx]] = rows
+        assert cnt == 5 and int(s) == 150 and mn == 1.5 and mx == 4.5
+
+    def test_group_by(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select b, count(*), sum(a) from t "
+                        "group by b order by b")
+        assert rows == [["x", 2, 40], ["y", 2, 70], ["z", 1, 40]]
+
+    def test_group_by_multi_region(self, ctx):
+        seed(ctx)
+        from tidb_tpu import tablecodec as tc
+        tbl = ctx.info_schema().table_by_name("test", "t")
+        ctx.store.regions.split_keys([tc.encode_row_key(tbl.info.id, 3)])
+        rows = run(ctx, "select b, count(*) from t group by b order by b")
+        assert rows == [["x", 2], ["y", 2], ["z", 1]]
+
+    def test_having(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select b, count(*) as cnt from t group by b "
+                        "having cnt > 1 order by b")
+        assert rows == [["x", 2], ["y", 2]]
+
+    def test_order_limit(self, ctx):
+        seed(ctx)
+        assert run(ctx, "select id from t order by a desc limit 2") == \
+            [[5], [4]]
+        assert run(ctx, "select id from t order by c limit 1") == [[5]]
+
+    def test_distinct(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select distinct b from t order by b")
+        assert rows == [["x"], ["y"], ["z"]]
+
+    def test_index_single_read(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select id from t where b = 'y'")
+        assert sorted(rows) == [[2], [5]]
+
+    def test_index_double_read(self, ctx):
+        seed(ctx)
+        rows = run(ctx, "select a, c from t where b = 'x'")
+        assert sorted(rows) == [[10, 1.5], [30, 3.5]]
+
+    def test_select_no_from(self, ctx):
+        assert run(ctx, "select 1 + 1, 'hi'") == [[2, "hi"]]
+
+    def test_count_empty_table(self, ctx):
+        assert run(ctx, "select count(*) from t") == [[0]]
+
+    def test_avg_null_handling(self, ctx):
+        seed(ctx)
+        [[avg_c]] = run(ctx, "select avg(c) from t")
+        assert float(avg_c) == pytest.approx(3.0)  # null row excluded
+
+
+class TestJoins:
+    def seed_join(self, ctx):
+        seed(ctx)
+        run(ctx, "insert into s values (1, 1, 'one'), (2, 1, 'uno'), "
+                 "(3, 3, 'three'), (4, 99, 'orphan')")
+
+    def test_inner_join(self, ctx):
+        self.seed_join(ctx)
+        rows = run(ctx, "select t.id, s.v from t join s on t.id = s.t_id "
+                        "order by t.id, s.v")
+        assert rows == [[1, "one"], [1, "uno"], [3, "three"]]
+
+    def test_left_join(self, ctx):
+        self.seed_join(ctx)
+        rows = run(ctx, "select t.id, s.v from t left join s on t.id = s.t_id "
+                        "where t.id <= 2 order by t.id, s.v")
+        assert rows == [[1, "one"], [1, "uno"], [2, None]]
+
+    def test_cross_join(self, ctx):
+        self.seed_join(ctx)
+        [[n]] = run(ctx, "select count(*) from t, s")
+        assert n == 20
+
+
+class TestWritePath:
+    def test_update(self, ctx):
+        seed(ctx)
+        run(ctx, "update t set a = a + 100 where b = 'x'")
+        assert run(ctx, "select id, a from t where a > 100 order by id") == \
+            [[1, 110], [3, 130]]
+
+    def test_delete(self, ctx):
+        seed(ctx)
+        run(ctx, "delete from t where b = 'y'")
+        assert run(ctx, "select id from t") == [[1], [3], [4]]
+
+    def test_update_with_limit(self, ctx):
+        seed(ctx)
+        run(ctx, "update t set a = 0 order by id desc limit 2")
+        assert run(ctx, "select id from t where a = 0 order by id") == \
+            [[4], [5]]
+
+    def test_insert_defaults(self, ctx):
+        run(ctx, "insert into t (id, a) values (9, 7)")
+        rows = run(ctx, "select id, a, b from t")
+        assert rows == [[9, 7, None]]
+
+    def test_insert_missing_not_null_errors(self, ctx):
+        from tidb_tpu import errors
+        with pytest.raises(errors.ExecError):
+            run(ctx, "insert into t (a) values (7)")
+        ctx.rollback()
+
+    def test_duplicate_pk_error(self, ctx):
+        seed(ctx)
+        from tidb_tpu import errors
+        with pytest.raises(errors.DupEntryError):
+            run(ctx, "insert into t values (1, 0, 'dup', 0)")
+        ctx.rollback()
+
+    def test_read_own_writes_union_scan(self, ctx):
+        seed(ctx)
+        # same-txn read after write: UnionScan merges the txn buffer
+        run(ctx, "insert into t values (6, 60, 'w', 6.5)", commit=False)
+        rows = run(ctx, "select id from t where a >= 50", commit=False)
+        assert rows == [[5], [6]]
+        run(ctx, "update t set a = 99 where id = 1", commit=False)
+        rows = run(ctx, "select id from t where a = 99", commit=False)
+        assert rows == [[1]]
+        run(ctx, "delete from t where id = 2", commit=False)
+        rows = run(ctx, "select id from t", commit=False)
+        assert rows == [[1], [3], [4], [5], [6]]
+        ctx.commit()
+        assert run(ctx, "select count(*) from t") == [[5]]

@@ -1,0 +1,73 @@
+"""distsql: the executor-side coprocessor request/result framework.
+
+Reference: distsql/distsql.go — Select() (:277) wraps kv.Client.Send into a
+SelectResult (:43): an iterator over per-region partial results, each
+decoding codec-encoded chunk rows back into typed Datums
+(partialResult.Next :192, getChunk :253, FieldTypeFromPBColumn :362).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.copr.proto import SelectRequest, SelectResponse, iter_response_rows
+from tidb_tpu.kv import kv
+from tidb_tpu.types import Datum
+from tidb_tpu.types.convert import unflatten_datum
+from tidb_tpu.types.field_type import FieldType
+
+
+class SelectResult:
+    """Iterates (handle, typed row) across all regions of one request."""
+
+    def __init__(self, resp: kv.Response, field_types: list[FieldType]):
+        self._resp = resp
+        self._types = field_types
+        self._rows = iter(())
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            for handle, datums in self._rows:
+                return handle, self._decode(datums)
+            if self._done:
+                raise StopIteration
+            part = self._resp.next()
+            if part is None:
+                self._done = True
+                raise StopIteration
+            if part.error:
+                raise errors.ExecError(f"coprocessor error: {part.error}")
+            self._rows = iter_response_rows(part)
+
+    def _decode(self, datums: list[Datum]) -> list[Datum]:
+        if len(datums) != len(self._types):
+            raise errors.ExecError(
+                f"coprocessor row has {len(datums)} columns, "
+                f"schema wants {len(self._types)}")
+        return [unflatten_datum(d, ft) for d, ft in zip(datums, self._types)]
+
+    def partials(self):
+        """Yield one region's SelectResponse per call (for partial-aware
+        consumers like the final aggregator)."""
+        while True:
+            part = self._resp.next()
+            if part is None:
+                return
+            if part.error:
+                raise errors.ExecError(f"coprocessor error: {part.error}")
+            yield part
+
+
+def select(client: kv.Client, req: SelectRequest,
+           key_ranges: list[kv.KeyRange], field_types: list[FieldType],
+           concurrency: int = 10, keep_order: bool = False,
+           req_type: int = kv.REQ_TYPE_SELECT) -> SelectResult:
+    """Reference: distsql.Select (distsql/distsql.go:277)."""
+    kreq = kv.Request(tp=req_type, data=req, key_ranges=key_ranges,
+                      keep_order=keep_order, desc=req.desc,
+                      concurrency=concurrency)
+    resp = client.send(kreq)
+    return SelectResult(resp, field_types)

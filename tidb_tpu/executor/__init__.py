@@ -1,0 +1,10 @@
+"""Execution engine: volcano operators over the coprocessor pushdown.
+
+Reference: executor/ (see SURVEY.md §2.3).
+"""
+
+from tidb_tpu.executor.builder import ExecutorBuilder
+from tidb_tpu.executor.context import ExecContext
+from tidb_tpu.executor.executors import Executor
+
+__all__ = ["ExecutorBuilder", "ExecContext", "Executor"]

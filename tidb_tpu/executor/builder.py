@@ -1,0 +1,72 @@
+"""Physical plan → executor tree.
+
+Reference: executor/builder.go:47 (executorBuilder.build) — pattern-matches
+PhysicalPlan nodes into Executor iterators; picks distsql scans vs local
+paths by client capability (here: scans are always distsql — the localstore
+client is in-proc).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.executor import executors as ex
+from tidb_tpu.executor.distsql_exec import (
+    UnionScanExec, XSelectIndexExec, XSelectTableExec,
+)
+from tidb_tpu.executor.write import DeleteExec, InsertExec, UpdateExec
+from tidb_tpu.plan import plans as pl
+
+
+class ExecutorBuilder:
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def build(self, p: pl.Plan) -> ex.Executor:
+        if isinstance(p, pl.PhysicalTableScan):
+            scan = XSelectTableExec(p, self.ctx)
+            if p.conditions:
+                return ex.SelectionExec(scan, p.conditions)
+            return scan
+        if isinstance(p, pl.PhysicalIndexScan):
+            scan = XSelectIndexExec(p, self.ctx)
+            if p.conditions:
+                return ex.SelectionExec(scan, p.conditions)
+            return scan
+        if isinstance(p, pl.PhysicalUnionScan):
+            child = self.build(p.child)
+            return UnionScanExec(child, p, self.ctx)
+        if isinstance(p, pl.PhysicalSelection):
+            return ex.SelectionExec(self.build(p.child), p.conditions)
+        if isinstance(p, pl.PhysicalProjection):
+            return ex.ProjectionExec(self.build(p.child), p.exprs, p.schema)
+        if isinstance(p, pl.PhysicalHashAgg):
+            return ex.HashAggExec(self.build(p.child), p.agg_funcs,
+                                  p.group_by, p.schema, p.has_pushed_child)
+        if isinstance(p, pl.PhysicalSort):
+            return ex.SortExec(self.build(p.child), p.by_items)
+        if isinstance(p, pl.PhysicalTopN):
+            return ex.TopNExec(self.build(p.child), p.by_items, p.offset,
+                               p.count)
+        if isinstance(p, pl.PhysicalLimit):
+            return ex.LimitExec(self.build(p.child), p.offset, p.count)
+        if isinstance(p, pl.PhysicalDistinct):
+            return ex.DistinctExec(self.build(p.child))
+        if isinstance(p, pl.PhysicalHashJoin):
+            left = self.build(p.children[0])
+            right = self.build(p.children[1])
+            if p.eq_conditions:
+                return ex.HashJoinExec(left, right, p, p.schema)
+            return ex.HashJoinCartesianFix(left, right, p, p.schema)
+        if isinstance(p, pl.PhysicalUnion):
+            return ex.UnionExec([self.build(c) for c in p.children], p.schema)
+        if isinstance(p, pl.PhysicalTableDual):
+            return ex.TableDualExec(p.schema, p.row_count)
+        if isinstance(p, pl.Insert):
+            sel = self.build(p.select_plan) if p.select_plan is not None \
+                else None
+            return InsertExec(p, self.ctx, sel)
+        if isinstance(p, pl.Update):
+            return UpdateExec(p, self.ctx, self.build(p.child))
+        if isinstance(p, pl.Delete):
+            return DeleteExec(p, self.ctx, self.build(p.child))
+        raise errors.ExecError(f"no executor for plan node {p.tp!r}")

@@ -1,0 +1,76 @@
+"""Execution context: what executors need from the session.
+
+Reference: context.Context + sessionctx (the reference threads a context
+interface through builder/executors; session.Session implements it).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+
+
+class ExecContext:
+    """Standalone context for tests and embedded use; session.Session
+    provides a richer subclass-compatible object."""
+
+    def __init__(self, store, domain, current_db: str = ""):
+        self.store = store
+        self.domain = domain
+        self.current_db = current_db
+        self.client = store.get_client()
+        self.params: list = []
+        self._txn = None
+        self.affected_rows = 0
+        self.last_insert_id = 0
+        self.dirty_tables: set[int] = set()
+        self.vars: dict[str, str] = {}
+
+    # ---- schema ----
+    def info_schema(self):
+        return self.domain.info_schema()
+
+    # ---- txn lifecycle ----
+    def txn(self):
+        if self._txn is None or not self._txn.valid():
+            self._txn = self.store.begin()
+            self.dirty_tables = set()
+        return self._txn
+
+    def has_txn(self) -> bool:
+        return self._txn is not None and self._txn.valid()
+
+    def start_ts(self) -> int:
+        return self.txn().start_ts()
+
+    def commit(self):
+        if self._txn is not None:
+            self._txn.commit()
+            self._txn = None
+            self.dirty_tables = set()
+
+    def rollback(self):
+        if self._txn is not None:
+            self._txn.rollback()
+            self._txn = None
+            self.dirty_tables = set()
+
+    def mark_dirty(self, table_id: int) -> None:
+        self.dirty_tables.add(table_id)
+
+    # ---- statement results ----
+    def set_affected_rows(self, n: int) -> None:
+        self.affected_rows = n
+
+    # ---- sysvars ----
+    def get_sysvar(self, name: str, is_global: bool = False):
+        return self.vars.get(name.lower())
+
+    def set_sysvar(self, name: str, value, is_global: bool = False) -> None:
+        self.vars[name.lower()] = value
+
+    def distsql_concurrency(self) -> int:
+        v = self.vars.get("tidb_distsql_scan_concurrency")
+        return int(v) if v else 10
+
+    def plan_ctx(self):
+        return self

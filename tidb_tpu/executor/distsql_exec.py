@@ -1,0 +1,316 @@
+"""Pushdown scan executors: XSelectTableExec / XSelectIndexExec.
+
+Reference: executor/executor_distsql.go — XSelectTableExec (:733, doRequest
+:778, tableRangesToKVRanges :112), XSelectIndexExec (:326) with single-read
+(:396) and double-read (:457) modes: handle fetch → batched table lookups
+(1024 doubling to 20480, :53-56, :592).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors, mysqldef as my, tablecodec as tc
+from tidb_tpu.codec import codec
+from tidb_tpu.copr.proto import (
+    PBColumnInfo, PBIndexInfo, PBTableInfo, SelectRequest,
+)
+from tidb_tpu.distsql import select
+from tidb_tpu.executor.executors import Executor
+from tidb_tpu.kv import kv
+from tidb_tpu.plan.plans import PhysicalIndexScan, PhysicalTableScan
+from tidb_tpu.plan.refiner import I64_MAX, I64_MIN, IndexRange, TableRange
+from tidb_tpu.types import Datum
+from tidb_tpu.types.convert import unflatten_datum
+from tidb_tpu.types.datum import NULL
+
+BASE_LOOKUP_TASK_SIZE = 1024
+MAX_LOOKUP_TASK_SIZE = 20480
+
+
+def prefix_next(key: bytes) -> bytes:
+    """Smallest key greater than every key having `key` as prefix
+    (kv.Key.PrefixNext)."""
+    b = bytearray(key)
+    for i in reversed(range(len(b))):
+        if b[i] != 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+    return bytes(key) + b"\x00"
+
+
+def table_ranges_to_kv_ranges(table_id: int,
+                              ranges: list[TableRange]) -> list[kv.KeyRange]:
+    """Reference: executor_distsql.go:112."""
+    out = []
+    for r in ranges:
+        start = tc.encode_row_key(table_id, r.low)
+        end = prefix_next(tc.encode_row_key(table_id, r.high))
+        out.append(kv.KeyRange(start, end))
+    return out
+
+
+def index_ranges_to_kv_ranges(table_id: int, index_id: int,
+                              ranges: list[IndexRange]) -> list[kv.KeyRange]:
+    out = []
+    seek = tc.encode_index_seek_key(table_id, index_id)
+    for r in ranges:
+        low = seek + codec.encode_key(r.low)
+        if r.low_exclude:
+            low = prefix_next(low)
+        high = seek + codec.encode_key(r.high)
+        if not r.high_exclude:
+            high = prefix_next(high)
+        out.append(kv.KeyRange(low, high))
+    return out
+
+
+def handles_to_kv_ranges(table_id: int, handles: list[int]) -> list[kv.KeyRange]:
+    """Sorted handles → coalesced row-key ranges
+    (executor_distsql.go:130 tableHandlesToKVRanges)."""
+    out = []
+    i = 0
+    n = len(handles)
+    while i < n:
+        j = i
+        while j + 1 < n and handles[j + 1] == handles[j] + 1:
+            j += 1
+        start = tc.encode_row_key(table_id, handles[i])
+        end = prefix_next(tc.encode_row_key(table_id, handles[j]))
+        out.append(kv.KeyRange(start, end))
+        i = j + 1
+    return out
+
+
+def _pb_col(col, pk_handle: bool) -> PBColumnInfo:
+    ft = col.ret_type
+    return PBColumnInfo(column_id=col.col_id, tp=ft.tp, flag=ft.flag,
+                        flen=ft.flen, decimal=ft.decimal,
+                        pk_handle=pk_handle, elems=list(ft.elems))
+
+
+def _scan_pb_columns(scan) -> list[PBColumnInfo]:
+    info = scan.table_info
+    pk = info.pk_handle_column()
+    return [_pb_col(c, pk is not None and c.col_id == pk.id)
+            for c in scan.schema]
+
+
+class XSelectTableExec(Executor):
+    """Reference: executor/executor_distsql.go:733."""
+
+    def __init__(self, scan: PhysicalTableScan, ctx):
+        self.scan_plan = scan
+        self.schema = scan.schema
+        self.ctx = ctx
+        self._result = None
+
+    def _do_request(self):
+        scan = self.scan_plan
+        req = SelectRequest(
+            start_ts=self.ctx.start_ts(),
+            table_info=PBTableInfo(scan.table_info.id, _scan_pb_columns(scan)),
+            where=scan.pushed_where,
+            aggregates=list(scan.aggregates),
+            group_by=list(scan.group_by_pb),
+            order_by=list(scan.topn_pb),
+            limit=scan.limit,
+            desc=scan.desc,
+        )
+        if scan.aggregated_push_down:
+            types = scan.agg_fields
+        else:
+            types = [c.ret_type for c in scan.schema]
+        ranges = table_ranges_to_kv_ranges(scan.table_info.id, scan.ranges)
+        self._result = iter(select(
+            self.ctx.client, req, ranges, types,
+            concurrency=self.ctx.distsql_concurrency(),
+            keep_order=scan.keep_order))
+
+    def next(self):
+        if self._result is None:
+            self._do_request()
+        try:
+            handle, row = next(self._result)
+        except StopIteration:
+            return None
+        self.last_handle = handle
+        return row
+
+
+class XSelectIndexExec(Executor):
+    """Reference: executor/executor_distsql.go:326 — single-read for covering
+    scans, double-read (handles → batched row lookups) otherwise."""
+
+    def __init__(self, scan: PhysicalIndexScan, ctx):
+        self.scan_plan = scan
+        self.schema = scan.schema
+        self.ctx = ctx
+        self._rows = None
+        self._pos = 0
+
+    # -- request plumbing --
+
+    def _index_pb(self):
+        scan = self.scan_plan
+        info = scan.table_info
+        pb_cols = []
+        for ic in scan.index.columns:
+            col_info = info.find_column(ic.name)
+            ft = col_info.field_type
+            pb_cols.append(PBColumnInfo(
+                column_id=col_info.id, tp=ft.tp, flag=ft.flag, flen=ft.flen,
+                decimal=ft.decimal))
+        pk = info.pk_handle_column()
+        pk_in_schema = pk is not None and any(
+            c.col_id == pk.id for c in scan.schema)
+        if pk_in_schema:
+            ft = pk.field_type
+            pb_cols.append(PBColumnInfo(
+                column_id=pk.id, tp=ft.tp, flag=ft.flag, flen=ft.flen,
+                decimal=ft.decimal, pk_handle=True))
+        return PBIndexInfo(table_id=info.id, index_id=scan.index.id,
+                           columns=pb_cols, unique=scan.index.unique), pb_cols
+
+    def _index_request(self):
+        scan = self.scan_plan
+        pb_index, pb_cols = self._index_pb()
+        req = SelectRequest(start_ts=self.ctx.start_ts(), index_info=pb_index,
+                            desc=scan.desc)
+        from tidb_tpu.copr.proto import field_type_from_pb_column
+        field_types = [field_type_from_pb_column(c) for c in pb_cols]
+        ranges = index_ranges_to_kv_ranges(scan.table_info.id, scan.index.id,
+                                           scan.ranges)
+        return select(self.ctx.client, req, ranges, field_types,
+                      concurrency=self.ctx.distsql_concurrency(),
+                      keep_order=True, req_type=kv.REQ_TYPE_INDEX), pb_cols
+
+    def _materialize(self):
+        scan = self.scan_plan
+        result, pb_cols = self._index_request()
+        if not scan.double_read:
+            # single read: remap pb column order → schema order
+            col_pos = {c.column_id: i for i, c in enumerate(pb_cols)}
+            rows = []
+            for handle, vals in result:
+                row = [vals[col_pos[c.col_id]] for c in scan.schema]
+                rows.append((handle, row))
+            self._rows = rows
+            return
+        # double read: collect handles in index order, then batched lookups
+        handles = [handle for handle, _ in result]
+        rows_by_handle: dict[int, list] = {}
+        batch = BASE_LOOKUP_TASK_SIZE
+        i = 0
+        while i < len(handles):
+            chunk = handles[i:i + batch]
+            i += batch
+            batch = min(batch * 2, MAX_LOOKUP_TASK_SIZE)
+            for handle, row in self._lookup_rows(chunk):
+                rows_by_handle[handle] = row
+        self._rows = [(h, rows_by_handle[h]) for h in handles
+                      if h in rows_by_handle]
+
+    def _lookup_rows(self, handles: list[int]):
+        """Second request: fetch full rows by handle ranges
+        (doTableRequest, executor_distsql.go:701)."""
+        scan = self.scan_plan
+        req = SelectRequest(
+            start_ts=self.ctx.start_ts(),
+            table_info=PBTableInfo(scan.table_info.id, _scan_pb_columns(scan)))
+        ranges = handles_to_kv_ranges(scan.table_info.id, sorted(handles))
+        types = [c.ret_type for c in scan.schema]
+        return select(self.ctx.client, req, ranges, types,
+                      concurrency=self.ctx.distsql_concurrency())
+
+    def next(self):
+        if self._rows is None:
+            self._materialize()
+        if self._pos >= len(self._rows):
+            return None
+        handle, row = self._rows[self._pos]
+        self._pos += 1
+        self.last_handle = handle
+        return row
+
+
+class UnionScanExec(Executor):
+    """Merge txn-dirty rows over a snapshot scan so reads-own-writes holds
+    (executor/union_scan.go:29,97). The child scan reads at the txn's
+    start_ts; this overlays the txn's uncommitted buffer."""
+
+    def __init__(self, child: Executor, plan, ctx):
+        self.children = [child]
+        self.plan = plan
+        self.schema = child.schema
+        self.ctx = ctx
+        self._merged: list | None = None
+        self._pos = 0
+
+    def _scan_plan(self):
+        child = self.children[0]
+        scan = getattr(child, "scan_plan", None)
+        if scan is None:  # residual-filter SelectionExec wraps the scan
+            scan = child.children[0].scan_plan
+        return scan
+
+    def _dirty_rows(self) -> dict[int, list | None]:
+        """handle → row (None = deleted) from the txn buffer."""
+        from tidb_tpu.expression import ops as xops
+        scan = self._scan_plan()
+        info = scan.table_info
+        txn = self.ctx.txn()
+        out: dict[int, list | None] = {}
+        for r in scan.ranges:
+            start = tc.encode_row_key(info.id, r.low)
+            end = prefix_next(tc.encode_row_key(info.id, r.high))
+            for key, val in txn.dirty_iterate(start, end):
+                try:
+                    _, handle = tc.decode_row_key(key)
+                except errors.TiDBError:
+                    continue
+                if val == b"":  # tombstone
+                    out[handle] = None
+                    continue
+                data = tc.decode_row(val)
+                pk = info.pk_handle_column()
+                row = []
+                for c in scan.schema:
+                    if pk is not None and c.col_id == pk.id:
+                        row.append(Datum.i64(handle))
+                    else:
+                        d = data.get(c.col_id, NULL)
+                        row.append(unflatten_datum(d, c.ret_type))
+                ok = True
+                for cond in self.plan.conditions:
+                    if xops.datum_truth(cond.eval(row)) is not True:
+                        ok = False
+                        break
+                out[handle] = row if ok else None
+        return out
+
+    def _materialize(self):
+        child = self.children[0]
+        dirty = self._dirty_rows()
+        merged: list[tuple[int, list]] = []
+        while True:
+            row = child.next()
+            if row is None:
+                break
+            h = child.last_handle
+            if h in dirty:
+                continue  # replaced or deleted by the txn
+            merged.append((h, row))
+        for h, row in dirty.items():
+            if row is not None:
+                merged.append((h, row))
+        merged.sort(key=lambda p: p[0], reverse=self._scan_plan().desc)
+        self._merged = merged
+
+    def next(self):
+        if self._merged is None:
+            self._materialize()
+        if self._pos >= len(self._merged):
+            return None
+        handle, row = self._merged[self._pos]
+        self._pos += 1
+        self.last_handle = handle
+        return row

@@ -1,0 +1,449 @@
+"""Volcano execution operators (row-at-a-time iterators).
+
+Reference: executor/executor.go — Executor interface (:109, Next/Schema/
+Close), Selection (:1282), Projection (:1196), HashAgg (:958), Sort/TopN
+(:1457), Limit (:282), Distinct (:337), HashJoin (:442), Union, TableDual.
+
+Rows are list[Datum]. Executors that can sit on a write-plan path also
+propagate `last_handle` (the row's storage handle) so UPDATE/DELETE know
+which record each row came from.
+"""
+
+from __future__ import annotations
+
+import functools
+import heapq
+
+from tidb_tpu import errors
+from tidb_tpu.codec import codec
+from tidb_tpu.expression import AggregationFunction, Expression, Schema
+from tidb_tpu.expression import ops as xops
+from tidb_tpu.plan.plans import SortItem
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, compare_datum
+
+
+class Executor:
+    schema: Schema
+    last_handle: int | None = None
+
+    def next(self) -> list[Datum] | None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        for child in getattr(self, "children", ()):
+            child.close()
+
+    def drain(self) -> list[list[Datum]]:
+        out = []
+        while True:
+            row = self.next()
+            if row is None:
+                return out
+            out.append(row)
+
+
+class SelectionExec(Executor):
+    def __init__(self, child: Executor, conditions: list[Expression]):
+        self.children = [child]
+        self.conditions = conditions
+        self.schema = child.schema
+
+    def next(self):
+        child = self.children[0]
+        while True:
+            row = child.next()
+            if row is None:
+                return None
+            ok = True
+            for cond in self.conditions:
+                if xops.datum_truth(cond.eval(row)) is not True:
+                    ok = False
+                    break
+            if ok:
+                self.last_handle = child.last_handle
+                return row
+
+
+class ProjectionExec(Executor):
+    def __init__(self, child: Executor, exprs: list[Expression], schema: Schema):
+        self.children = [child]
+        self.exprs = exprs
+        self.schema = schema
+
+    def next(self):
+        row = self.children[0].next()
+        if row is None:
+            return None
+        self.last_handle = self.children[0].last_handle
+        return [e.eval(row) for e in self.exprs]
+
+
+class LimitExec(Executor):
+    def __init__(self, child: Executor, offset: int, count: int):
+        self.children = [child]
+        self.schema = child.schema
+        self.offset = offset
+        self.count = count
+        self._skipped = 0
+        self._emitted = 0
+
+    def next(self):
+        child = self.children[0]
+        while self._skipped < self.offset:
+            if child.next() is None:
+                return None
+            self._skipped += 1
+        if self._emitted >= self.count:
+            return None
+        row = child.next()
+        if row is None:
+            return None
+        self._emitted += 1
+        self.last_handle = child.last_handle
+        return row
+
+
+def _cmp_rows(items: list[SortItem]):
+    def cmp(a, b):
+        for item, ka, kb in zip(items, a[0], b[0]):
+            c = compare_datum(ka, kb)
+            if c != 0:
+                return -c if item.desc else c
+        return 0
+    return functools.cmp_to_key(cmp)
+
+
+class SortExec(Executor):
+    def __init__(self, child: Executor, by_items: list[SortItem]):
+        self.children = [child]
+        self.schema = child.schema
+        self.by_items = by_items
+        self._sorted: list | None = None
+        self._pos = 0
+
+    def _materialize(self):
+        child = self.children[0]
+        rows = []
+        while True:
+            row = child.next()
+            if row is None:
+                break
+            keys = [item.expr.eval(row) for item in self.by_items]
+            rows.append((keys, row, child.last_handle))
+        rows.sort(key=_cmp_rows(self.by_items))
+        self._sorted = rows
+
+    def next(self):
+        if self._sorted is None:
+            self._materialize()
+        if self._pos >= len(self._sorted):
+            return None
+        _, row, handle = self._sorted[self._pos]
+        self._pos += 1
+        self.last_handle = handle
+        return row
+
+
+class TopNExec(Executor):
+    """Bounded sort: keeps offset+count best rows (executor TopN path)."""
+
+    def __init__(self, child: Executor, by_items: list[SortItem],
+                 offset: int, count: int):
+        self.children = [child]
+        self.schema = child.schema
+        self.by_items = by_items
+        self.offset = offset
+        self.count = count
+        self._rows: list | None = None
+        self._pos = 0
+
+    def _materialize(self):
+        child = self.children[0]
+        limit = self.offset + self.count
+        key_of = _cmp_rows(self.by_items)
+        buf = []
+        while True:
+            row = child.next()
+            if row is None:
+                break
+            keys = [item.expr.eval(row) for item in self.by_items]
+            buf.append((keys, row, child.last_handle))
+            if len(buf) > 2 * limit + 64:
+                buf.sort(key=key_of)
+                del buf[limit:]
+        buf.sort(key=key_of)
+        self._rows = buf[self.offset:limit]
+
+    def next(self):
+        if self._rows is None:
+            self._materialize()
+        if self._pos >= len(self._rows):
+            return None
+        _, row, handle = self._rows[self._pos]
+        self._pos += 1
+        self.last_handle = handle
+        return row
+
+
+class DistinctExec(Executor):
+    def __init__(self, child: Executor):
+        self.children = [child]
+        self.schema = child.schema
+        self._seen: set[bytes] = set()
+
+    def next(self):
+        child = self.children[0]
+        while True:
+            row = child.next()
+            if row is None:
+                return None
+            key = codec.encode_value(row)
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            self.last_handle = child.last_handle
+            return row
+
+
+class HashAggExec(Executor):
+    """Hash aggregation; COMPLETE over raw rows or FINAL over coprocessor
+    partial rows [groupKey, partials...] (executor/executor.go:958,
+    :989-1080 FinalMode merge)."""
+
+    def __init__(self, child: Executor, agg_funcs: list[AggregationFunction],
+                 group_by: list[Expression], schema: Schema,
+                 pushed_child: bool):
+        self.children = [child]
+        self.agg_funcs = agg_funcs
+        self.group_by = group_by
+        self.schema = schema
+        self.pushed_child = pushed_child
+        self._groups: dict[bytes, list] | None = None
+        self._order: list[bytes] = []
+        self._pos = 0
+
+    def _group_key(self, row) -> bytes:
+        if self.pushed_child:
+            return row[0].get_bytes()
+        if not self.group_by:
+            return b""
+        return codec.encode_value([g.eval(row) for g in self.group_by])
+
+    def _materialize(self):
+        child = self.children[0]
+        groups: dict[bytes, list] = {}
+        order = []
+        while True:
+            row = child.next()
+            if row is None:
+                break
+            gk = self._group_key(row)
+            ctxs = groups.get(gk)
+            if ctxs is None:
+                ctxs = [f.create_context() for f in self.agg_funcs]
+                groups[gk] = ctxs
+                order.append(gk)
+            for f, ctx in zip(self.agg_funcs, ctxs):
+                f.update(ctx, row)
+        if not groups and not self.group_by and not self.pushed_child:
+            # aggregates over an empty input still yield one row
+            groups[b""] = [f.create_context() for f in self.agg_funcs]
+            order.append(b"")
+        if not groups and self.pushed_child and not self._has_pushed_group_by():
+            groups[b""] = [f.create_context() for f in self.agg_funcs]
+            order.append(b"")
+        self._groups = groups
+        self._order = order
+
+    def _has_pushed_group_by(self) -> bool:
+        child = self.children[0]
+        scan = getattr(child, "scan_plan", None)
+        return bool(scan is not None and scan.group_by_pb)
+
+    def next(self):
+        if self._groups is None:
+            self._materialize()
+        if self._pos >= len(self._order):
+            return None
+        gk = self._order[self._pos]
+        self._pos += 1
+        ctxs = self._groups[gk]
+        return [f.get_result(ctx) for f, ctx in zip(self.agg_funcs, ctxs)]
+
+
+class HashJoinExec(Executor):
+    """Build the right side into a hash table, probe with the left
+    (executor/executor.go:442; worker concurrency is a later milestone —
+    the TPU path gets the parallelism instead)."""
+
+    def __init__(self, child_left: Executor, child_right: Executor,
+                 plan, schema: Schema):
+        self.children = [child_left, child_right]
+        self.plan = plan
+        self.schema = schema
+        self._built: dict[bytes, list] | None = None
+        self._pending: list = []
+        self._right_width = 0
+
+    def _build(self):
+        right = self.children[1]
+        table: dict[bytes, list] = {}
+        r_keys = [rcol for _, rcol in self.plan.eq_conditions]
+        self._right_width = len(right.schema)
+        while True:
+            row = right.next()
+            if row is None:
+                break
+            if self.plan.right_conditions and not _conds_ok(
+                    self.plan.right_conditions, row):
+                continue
+            key_vals = [k.eval(row) for k in r_keys]
+            if any(v.is_null() for v in key_vals):
+                continue  # NULL never joins
+            table.setdefault(codec.encode_value(key_vals), []).append(row)
+        self._built = table
+
+    def next(self):
+        from tidb_tpu.plan.plans import Join
+        if self._built is None:
+            self._build()
+        while True:
+            if self._pending:
+                return self._pending.pop(0)
+            left_row = self.children[0].next()
+            if left_row is None:
+                return None
+            l_keys = [lcol for lcol, _ in self.plan.eq_conditions]
+            key_vals = [k.eval(left_row) for k in l_keys]
+            matches = []
+            if not any(v.is_null() for v in key_vals):
+                matches = self._built.get(codec.encode_value(key_vals), [])
+            out = []
+            left_ok = not self.plan.left_conditions or _conds_ok(
+                self.plan.left_conditions, left_row)
+            if left_ok:
+                for rrow in matches:
+                    joined = left_row + rrow
+                    if self.plan.other_conditions and not _conds_ok(
+                            self.plan.other_conditions, joined):
+                        continue
+                    out.append(joined)
+            if out:
+                if self.plan.join_type == Join.LEFT_OUTER:
+                    self._pending = out
+                    continue
+                self._pending = out[1:]
+                return out[0]
+            if self.plan.join_type == Join.LEFT_OUTER:
+                return left_row + [NULL] * self._right_width
+            # inner: no match → skip row
+
+
+def _conds_ok(conditions, row) -> bool:
+    return all(xops.datum_truth(c.eval(row)) is True for c in conditions)
+
+
+class HashJoinCartesianFix(Executor):
+    """Cartesian product when a join has no eq conditions (cross join)."""
+
+    def __init__(self, child_left: Executor, child_right: Executor,
+                 plan, schema: Schema):
+        self.children = [child_left, child_right]
+        self.plan = plan
+        self.schema = schema
+        self._right_rows: list | None = None
+        self._left_row = None
+        self._ri = 0
+        self._matched = False
+
+    def next(self):
+        from tidb_tpu.plan.plans import Join
+        if self._right_rows is None:
+            self._right_rows = self.children[1].drain()
+            if self.plan.right_conditions:
+                self._right_rows = [r for r in self._right_rows
+                                    if _conds_ok(self.plan.right_conditions, r)]
+        while True:
+            if self._left_row is None:
+                self._left_row = self.children[0].next()
+                if self._left_row is None:
+                    return None
+                self._ri = 0
+                self._matched = False
+            while self._ri < len(self._right_rows):
+                rrow = self._right_rows[self._ri]
+                self._ri += 1
+                left_ok = not self.plan.left_conditions or _conds_ok(
+                    self.plan.left_conditions, self._left_row)
+                if not left_ok:
+                    break
+                joined = self._left_row + rrow
+                if self.plan.other_conditions and not _conds_ok(
+                        self.plan.other_conditions, joined):
+                    continue
+                self._matched = True
+                return joined
+            left_row = self._left_row
+            self._left_row = None
+            if self.plan.join_type == Join.LEFT_OUTER and not self._matched:
+                return left_row + [NULL] * len(self.children[1].schema)
+
+
+class UnionExec(Executor):
+    def __init__(self, children: list[Executor], schema: Schema):
+        self.children = children
+        self.schema = schema
+        self._i = 0
+
+    def next(self):
+        while self._i < len(self.children):
+            row = self.children[self._i].next()
+            if row is not None:
+                return row
+            self._i += 1
+        return None
+
+
+class TableDualExec(Executor):
+    def __init__(self, schema: Schema, row_count: int = 1):
+        self.schema = schema
+        self.row_count = row_count
+        self._emitted = 0
+
+    def next(self):
+        if self._emitted >= self.row_count:
+            return None
+        self._emitted += 1
+        return []
+
+
+class ExistsExec(Executor):
+    def __init__(self, child: Executor, schema: Schema):
+        self.children = [child]
+        self.schema = schema
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        return [Datum.i64(1 if self.children[0].next() is not None else 0)]
+
+
+class MaxOneRowExec(Executor):
+    def __init__(self, child: Executor):
+        self.children = [child]
+        self.schema = child.schema
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        row = self.children[0].next()
+        if row is None:
+            return [NULL] * len(self.schema)
+        if self.children[0].next() is not None:
+            raise errors.ExecError("subquery returns more than 1 row")
+        return row

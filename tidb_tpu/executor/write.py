@@ -1,0 +1,251 @@
+"""Write executors: INSERT / REPLACE / UPDATE / DELETE.
+
+Reference: executor/executor_write.go — InsertExec/InsertValues (:551),
+UpdateExec (:143 updateRecord), DeleteExec (:41). Row construction: listed
+columns get their exprs, missing columns get defaults / auto-increment,
+everything is cast to the column type before table.add_record.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors, mysqldef as my, sqlast as ast
+from tidb_tpu.executor.executors import Executor
+from tidb_tpu.expression import Expression
+from tidb_tpu.table.column import cast_value, check_not_null, get_default_value
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL
+
+
+class InsertExec(Executor):
+    def __init__(self, plan, ctx, select_exec: Executor | None):
+        self.plan = plan
+        self.ctx = ctx
+        self.select_exec = select_exec
+        self.schema = plan.schema
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        plan = self.plan
+        tbl = plan.table
+        info = tbl.info
+        txn = self.ctx.txn()
+        cols = self._target_columns()
+        affected = 0
+
+        rows = []
+        if plan.select_plan is not None:
+            while True:
+                r = self.select_exec.next()
+                if r is None:
+                    break
+                rows.append(r)
+            if len(cols) == 0:
+                cols = info.public_columns()
+        elif plan.set_list:
+            cols = []
+            vals = []
+            for col_node, e in plan.set_list:
+                name = col_node.name if hasattr(col_node, "name") else col_node
+                ci = info.find_column(name)
+                if ci is None:
+                    raise errors.UnknownFieldError(
+                        f"Unknown column '{name}' in 'field list'")
+                cols.append(ci)
+                vals.append(e)
+            rows = [vals]
+        else:
+            rows = plan.lists
+
+        for value_row in rows:
+            if plan.select_plan is None and len(value_row) != len(cols):
+                raise errors.ExecError(
+                    "Column count doesn't match value count")
+            full = self._build_row(cols, value_row, txn)
+            try:
+                tbl.add_record(txn, full)
+                affected += 1
+            except errors.DupEntryError:
+                if plan.on_duplicate:
+                    self._on_duplicate(txn, tbl, full)
+                    affected += 2
+                elif plan.is_replace:
+                    self._replace(txn, tbl, full)
+                    affected += 2
+                elif plan.ignore:
+                    continue
+                else:
+                    raise
+        self.ctx.mark_dirty(info.id)
+        self.ctx.set_affected_rows(affected)
+        return None
+
+    def _target_columns(self):
+        info = self.plan.table.info
+        if not self.plan.columns:
+            return info.public_columns()
+        cols = []
+        for name in self.plan.columns:
+            ci = info.find_column(name)
+            if ci is None:
+                raise errors.UnknownFieldError(
+                    f"Unknown column '{name}' in 'field list'")
+            cols.append(ci)
+        return cols
+
+    def _build_row(self, cols, value_row, txn) -> list[Datum]:
+        info = self.plan.table.info
+        by_offset: dict[int, Datum] = {}
+        for ci, v in zip(cols, value_row):
+            if isinstance(v, ast.DefaultExpr):
+                d = get_default_value(ci)
+            elif isinstance(v, Expression):
+                d = v.eval([])
+            else:
+                d = v  # already a Datum (insert-from-select)
+            by_offset[ci.offset] = d
+        full: list[Datum] = []
+        for ci in info.columns:
+            d = by_offset.get(ci.offset)
+            if d is None:
+                if my.has_auto_increment_flag(ci.field_type.flag):
+                    d = Datum.i64(self.plan.table.alloc_handle())
+                else:
+                    d = get_default_value(ci)
+            elif d.is_null() and my.has_auto_increment_flag(ci.field_type.flag):
+                d = Datum.i64(self.plan.table.alloc_handle())
+            d = cast_value(d, ci)
+            check_not_null(ci, d)
+            full.append(d)
+        return full
+
+    def _existing_handle(self, full) -> int:
+        info = self.plan.table.info
+        pk = info.pk_handle_column()
+        if pk is None:
+            raise errors.ExecError(
+                "duplicate-key update without integer primary key "
+                "is not supported yet")
+        return full[pk.offset].get_int()
+
+    def _on_duplicate(self, txn, tbl, full):
+        handle = self._existing_handle(full)
+        old = tbl.row_with_cols(txn, handle)
+        new = list(old)
+        # ON DUPLICATE KEY UPDATE assignments; VALUES(col) not yet lowered
+        builder_schema_row = old
+        for col_node, expr_ast in self.plan.on_duplicate:
+            name = col_node.name if hasattr(col_node, "name") else col_node
+            ci = tbl.info.find_column(name)
+            if ci is None:
+                raise errors.UnknownFieldError(f"Unknown column '{name}'")
+            from tidb_tpu.plan.builder import PlanBuilder
+            e = PlanBuilder(self.ctx.plan_ctx()).rewrite(
+                expr_ast, _row_schema(tbl, builder_schema_row))
+            new[ci.offset] = cast_value(e.eval(old), ci)
+        tbl.update_record(txn, handle, old, new)
+
+    def _replace(self, txn, tbl, full):
+        handle = self._existing_handle(full)
+        old = tbl.row_with_cols(txn, handle)
+        tbl.remove_record(txn, handle, old)
+        tbl.add_record(txn, full)
+
+
+def _row_schema(tbl, row):
+    from tidb_tpu.expression import Column, Schema
+    s = Schema()
+    for i, ci in enumerate(tbl.info.columns):
+        s.append(Column(col_name=ci.name, tbl_name=tbl.info.name,
+                        ret_type=ci.field_type, index=i, position=i,
+                        col_id=ci.id))
+    return s
+
+
+class UpdateExec(Executor):
+    def __init__(self, plan, ctx, child: Executor):
+        self.plan = plan
+        self.ctx = ctx
+        self.children = [child]
+        self.schema = plan.schema
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        tbl = self.plan.table
+        info = tbl.info
+        txn = self.ctx.txn()
+        child = self.children[0]
+        affected = 0
+        updates = []
+        while True:
+            row = child.next()
+            if row is None:
+                break
+            handle = child.last_handle
+            if handle is None:
+                raise errors.ExecError("UPDATE source lost row handles")
+            updates.append((handle, list(row)))
+        for handle, row in updates:
+            new_row = list(row)
+            changed = False
+            for col, expr in self.plan.ordered_list:
+                ci = info.find_column(col.col_name)
+                d = cast_value(expr.eval(row), ci)
+                check_not_null(ci, d)
+                if _datum_changed(new_row[ci.offset], d):
+                    new_row[ci.offset] = d
+                    changed = True
+            if changed:
+                tbl.update_record(txn, handle, row, new_row)
+                affected += 1
+        self.ctx.mark_dirty(info.id)
+        self.ctx.set_affected_rows(affected)
+        return None
+
+
+def _datum_changed(old: Datum, new: Datum) -> bool:
+    from tidb_tpu.types.datum import compare_datum
+    if old.is_null() or new.is_null():
+        return old.is_null() != new.is_null()
+    try:
+        return compare_datum(old, new) != 0
+    except errors.TiDBError:
+        return True
+
+
+class DeleteExec(Executor):
+    def __init__(self, plan, ctx, child: Executor):
+        self.plan = plan
+        self.ctx = ctx
+        self.children = [child]
+        self.schema = plan.schema
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        tbl = self.plan.table
+        txn = self.ctx.txn()
+        child = self.children[0]
+        affected = 0
+        victims = []
+        while True:
+            row = child.next()
+            if row is None:
+                break
+            handle = child.last_handle
+            if handle is None:
+                raise errors.ExecError("DELETE source lost row handles")
+            victims.append((handle, list(row)))
+        for handle, row in victims:
+            tbl.remove_record(txn, handle, row)
+            affected += 1
+        self.ctx.mark_dirty(tbl.info.id)
+        self.ctx.set_affected_rows(affected)
+        return None

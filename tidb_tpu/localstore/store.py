@@ -89,6 +89,15 @@ class LocalTxn(Transaction):
         self._check_valid()
         return self._us.iterate_reverse(start, end)
 
+    def dirty_iterate(self, start: bytes = b"", end: bytes | None = None):
+        """This txn's own uncommitted writes in [start, end); deletions
+        appear with value b'' (tombstone). Used by UnionScan."""
+        self._check_valid()
+        return self._us.buffer.iterate(start, end, include_tombstones=True)
+
+    def is_dirty(self) -> bool:
+        return self._dirty
+
     def set(self, key: bytes, value: bytes) -> None:
         self._check_valid()
         if not value:

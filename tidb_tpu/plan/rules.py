@@ -384,15 +384,29 @@ def resolve_indices(p: Plan) -> None:
 
     if isinstance(p, Join):
         lw_slots = len(p.children[0].schema.columns)
-        lookup: dict[tuple, int] = {}
+        # two key spaces resolve to each side: the child's own identities and
+        # the join-scope identities (join.id, merged position)
+        left_lookup: dict[tuple, int] = {}
         for c in p.children[0].schema.columns:
-            lookup[(c.from_id, c.position)] = c.index
+            left_lookup[(c.from_id, c.position)] = c.index
+            left_lookup[(p.id, c.position)] = c.index
+        right_local: dict[tuple, int] = {}
         for c in p.children[1].schema.columns:
-            lookup[(c.from_id, c.position)] = c.index + lw_slots
+            right_local[(c.from_id, c.position)] = c.index
+            right_local[(p.id, c.position + p._left_width)] = c.index
+        lookup = dict(left_lookup)
+        for k, v in right_local.items():
+            lookup[k] = v + lw_slots
+        # eq keys and one-side conditions evaluate against a single side's
+        # row; other_conditions see the concatenated row
         for lcol, rcol in p.eq_conditions:
-            _bind(lcol, lookup)
-            _bind(rcol, lookup)
-        for cond in p.left_conditions + p.right_conditions + p.other_conditions:
+            _bind(lcol, left_lookup)
+            _bind(rcol, right_local)
+        for cond in p.left_conditions:
+            _bind_expr(cond, left_lookup)
+        for cond in p.right_conditions:
+            _bind_expr(cond, right_local)
+        for cond in p.other_conditions:
             _bind_expr(cond, lookup)
         # join output schema slots map through the lookup as well: the
         # output row is [left_row, right_row]

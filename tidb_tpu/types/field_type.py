@@ -137,7 +137,7 @@ def agg_field_type(name: str, arg: FieldType) -> FieldType:
         base = arg.decimal if arg.decimal >= 0 else 0
         ft.decimal = min(base + 4, 30)
         return ft
-    if name in ("min", "max", "first", "firstrow"):
+    if name in ("min", "max", "first", "firstrow", "first_row"):
         return arg.clone()
     if name == "group_concat":
         return new_field_type(my.TypeVarString)
