@@ -1,0 +1,70 @@
+"""TestKit: the SQL test harness.
+
+Reference: util/testkit/testkit.go:29 — MustExec / MustQuery with
+Result.Check assertions against an in-memory store.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from tidb_tpu.domain import clear_domains
+from tidb_tpu.session import Session, new_store
+
+_store_id = itertools.count(1)
+
+
+class Result:
+    def __init__(self, result_sets):
+        self.result_sets = result_sets
+
+    @property
+    def rows(self):
+        if not self.result_sets:
+            return []
+        return self.result_sets[-1].values()
+
+    def check(self, expected: list[list]) -> None:
+        got = self.rows
+        norm_got = [[_norm(v) for v in row] for row in got]
+        norm_exp = [[_norm(v) for v in row] for row in expected]
+        assert norm_got == norm_exp, f"\n got: {norm_got}\nwant: {norm_exp}"
+
+    def sort(self) -> "Result":
+        for rs in self.result_sets:
+            rs.rows.sort(key=lambda r: [repr(d.val) for d in r])
+        return self
+
+
+def _norm(v):
+    from decimal import Decimal
+    if isinstance(v, Decimal):
+        return float(v) if v != v.to_integral_value() else int(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if isinstance(v, float) and v.is_integer():
+        return v  # keep floats distinct from ints in expectations
+    return v
+
+
+class TestKit:
+    __test__ = False  # not a pytest class
+
+    def __init__(self, store=None):
+        clear_domains()
+        self.store = store or new_store(f"memory://tk{next(_store_id)}")
+        self.session = Session(self.store)
+
+    def exec(self, sql: str):
+        return Result(self.session.execute(sql))
+
+    must_exec = exec
+
+    def query(self, sql: str) -> Result:
+        return Result(self.session.execute(sql))
+
+    def new_session(self) -> "TestKit":
+        tk = TestKit.__new__(TestKit)
+        tk.store = self.store
+        tk.session = Session(self.store)
+        return tk

@@ -119,6 +119,9 @@ class PBColumnInfo:
     decimal: int = -1
     pk_handle: bool = False    # this column IS the integer handle
     elems: list[str] = field(default_factory=list)
+    # value for rows written before this column existed (tipb
+    # ColumnInfo.DefaultVal; model.ColumnInfo original_default)
+    default_val: Datum | None = None
 
 
 @dataclass
@@ -239,10 +242,12 @@ def iter_response_rows(resp: SelectResponse):
 def column_to_proto(col, pk_is_handle: bool = False) -> PBColumnInfo:
     """model.ColumnInfo → PBColumnInfo."""
     ft = col.field_type
+    default = col.original_default_datum()
     return PBColumnInfo(
         column_id=col.id, tp=ft.tp, flag=ft.flag, flen=ft.flen,
         decimal=ft.decimal, elems=list(ft.elems),
-        pk_handle=pk_is_handle and my.has_pri_key_flag(ft.flag))
+        pk_handle=pk_is_handle and my.has_pri_key_flag(ft.flag),
+        default_val=default)
 
 
 def columns_to_proto(columns, pk_is_handle: bool = False) -> list[PBColumnInfo]:

@@ -64,6 +64,14 @@ class _SelectContext:
         self.columns: list[PBColumnInfo] = cols
         self.pk_col: PBColumnInfo | None = next(
             (c for c in cols if c.pk_handle), None)
+        # fill values for columns absent from a stored row (written before
+        # an ADD COLUMN): the column's original default, else NULL — so
+        # pushed filters see the same value _output_row would emit
+        from tidb_tpu.types.datum import NULL as _NULL
+        self.fill_cols: list[tuple[int, Datum]] = [
+            (c.column_id, c.default_val if c.default_val is not None
+             else _NULL)
+            for c in cols if not c.pk_handle]
 
         self.aggs: list[AggregationFunction] = []
         self.agg_ctxs: dict[bytes, list] = {}
@@ -96,6 +104,9 @@ class _SelectContext:
                 continue
             row = tablecodec.decode_row(value)
             self._fill_handle(row, handle)
+            for cid, dv in self.fill_cols:
+                if cid not in row:
+                    row[cid] = dv
             self._process_row(handle, row)
 
     def scan_index_range(self, rg: KeyRange) -> None:

@@ -80,17 +80,21 @@ def handles_to_kv_ranges(table_id: int, handles: list[int]) -> list[kv.KeyRange]
     return out
 
 
-def _pb_col(col, pk_handle: bool) -> PBColumnInfo:
+def _pb_col(col, pk_handle: bool, model_col=None) -> PBColumnInfo:
     ft = col.ret_type
+    default = model_col.original_default_datum() if model_col is not None \
+        else None
     return PBColumnInfo(column_id=col.col_id, tp=ft.tp, flag=ft.flag,
                         flen=ft.flen, decimal=ft.decimal,
-                        pk_handle=pk_handle, elems=list(ft.elems))
+                        pk_handle=pk_handle, elems=list(ft.elems),
+                        default_val=default)
 
 
 def _scan_pb_columns(scan) -> list[PBColumnInfo]:
     info = scan.table_info
     pk = info.pk_handle_column()
-    return [_pb_col(c, pk is not None and c.col_id == pk.id)
+    by_id = {c.id: c for c in info.columns}
+    return [_pb_col(c, pk is not None and c.col_id == pk.id, by_id.get(c.col_id))
             for c in scan.schema]
 
 

@@ -1,0 +1,370 @@
+"""Simple-statement execution: DDL, SET, USE, SHOW, EXPLAIN, ADMIN, txn
+control — statements that bypass the optimizer.
+
+Reference: executor/executor_simple.go, executor/executor_ddl.go,
+executor/show.go, executor/executor_set.go, executor/explain.go.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors, mysqldef as my, sqlast as ast
+from tidb_tpu.ddl.ddl import ColumnSpec, IndexSpec
+from tidb_tpu.plan import tree_string
+from tidb_tpu.types import Datum, datum_from_py
+from tidb_tpu.types.datum import NULL
+from tidb_tpu.types.field_type import FieldType, new_field_type
+
+
+class ResultSet:
+    """Materialized query result (ast.RecordSet equivalent)."""
+
+    def __init__(self, fields: list[tuple[str, FieldType]],
+                 rows: list[list[Datum]]):
+        self.fields = fields
+        self.rows = rows
+
+    def field_names(self) -> list[str]:
+        return [f[0] for f in self.fields]
+
+    def values(self) -> list[list]:
+        return [[d.val for d in row] for row in self.rows]
+
+
+def _str_rs(names: list[str], rows: list[list]) -> ResultSet:
+    fields = [(n, new_field_type(my.TypeVarString)) for n in names]
+    drows = [[datum_from_py(v) if v is not None else NULL for v in row]
+             for row in rows]
+    return ResultSet(fields, drows)
+
+
+def execute_simple(session, stmt) -> ResultSet | None:
+    """Dispatch a non-optimized statement. Returns a ResultSet for SHOW-like
+    statements, None for effect-only ones."""
+    if isinstance(stmt, ast.UseStmt):
+        return _use(session, stmt)
+    if isinstance(stmt, ast.SetStmt):
+        return _set(session, stmt)
+    if isinstance(stmt, ast.BeginStmt):
+        session.begin_txn()
+        return None
+    if isinstance(stmt, ast.CommitStmt):
+        session.commit_txn()
+        return None
+    if isinstance(stmt, ast.RollbackStmt):
+        session.rollback_txn()
+        return None
+    if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
+                         ast.CreateTableStmt, ast.DropTableStmt,
+                         ast.TruncateTableStmt, ast.CreateIndexStmt,
+                         ast.DropIndexStmt, ast.AlterTableStmt)):
+        return _ddl(session, stmt)
+    if isinstance(stmt, ast.ShowStmt):
+        return _show(session, stmt)
+    if isinstance(stmt, ast.AdminStmt):
+        return _admin(session, stmt)
+    raise errors.ExecError(f"unsupported statement {type(stmt).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# USE / SET
+# ---------------------------------------------------------------------------
+
+def _use(session, stmt: ast.UseStmt):
+    if not session.info_schema().schema_exists(stmt.db):
+        raise errors.BadDBError(f"Unknown database '{stmt.db}'")
+    session.vars.current_db = stmt.db
+    return None
+
+
+def _set(session, stmt: ast.SetStmt):
+    from tidb_tpu.plan.builder import PlanBuilder
+    from tidb_tpu.expression import Schema
+    builder = PlanBuilder(session.plan_ctx())
+    for va in stmt.variables:
+        value = NULL
+        if va.value is not None:
+            value = builder.rewrite(va.value, Schema()).eval([])
+        if not va.is_system:
+            session.vars.users[va.name.lower()] = value
+            continue
+        sval = "" if value.is_null() else _datum_str(value)
+        if va.is_global:
+            session.global_vars.set(va.name, sval)
+            session.persist_global_var(va.name, sval)
+        else:
+            session.vars.set_system(va.name, sval)
+    return None
+
+
+def _datum_str(d: Datum) -> str:
+    from tidb_tpu.expression.ops import _datum_to_str
+    return _datum_to_str(d)
+
+
+# ---------------------------------------------------------------------------
+# DDL (executor/executor_ddl.go)
+# ---------------------------------------------------------------------------
+
+def _column_specs(cols: list[ast.ColumnDef], constraints: list[ast.Constraint]):
+    specs: list[ColumnSpec] = []
+    indices: list[IndexSpec] = []
+    for col in cols:
+        ft = col.tp.clone()
+        default = None
+        has_default = False
+        comment = ""
+        for opt in col.options:
+            t = opt.tp
+            if t == ast.ColumnOptionType.NOT_NULL:
+                ft.flag |= my.NotNullFlag
+            elif t == ast.ColumnOptionType.AUTO_INCREMENT:
+                ft.flag |= my.AutoIncrementFlag
+            elif t == ast.ColumnOptionType.DEFAULT:
+                if isinstance(opt.expr, ast.Literal):
+                    default = None if opt.expr.value.is_null() \
+                        else opt.expr.value.val
+                elif isinstance(opt.expr, ast.FuncCall):
+                    default = opt.expr.name.upper()
+                has_default = True
+            elif t == ast.ColumnOptionType.PRIMARY_KEY:
+                indices.append(IndexSpec("primary", [col.name], unique=True,
+                                         primary=True))
+            elif t == ast.ColumnOptionType.UNIQUE_KEY:
+                indices.append(IndexSpec(f"{col.name}", [col.name],
+                                         unique=True))
+            elif t == ast.ColumnOptionType.COMMENT:
+                comment = opt.comment
+        if isinstance(default, bool):
+            default = int(default)
+        specs.append(ColumnSpec(col.name, ft, default, has_default, comment))
+    for cons in constraints:
+        t = cons.tp
+        if t == ast.ConstraintType.PRIMARY_KEY:
+            indices.append(IndexSpec("primary", list(cons.keys), unique=True,
+                                     primary=True))
+        elif t in (ast.ConstraintType.UNIQUE, ast.ConstraintType.UNIQUE_KEY,
+                   ast.ConstraintType.UNIQUE_INDEX):
+            indices.append(IndexSpec(cons.name or cons.keys[0],
+                                     list(cons.keys), unique=True))
+        elif t in (ast.ConstraintType.KEY, ast.ConstraintType.INDEX):
+            indices.append(IndexSpec(cons.name or cons.keys[0],
+                                     list(cons.keys)))
+        elif t == ast.ConstraintType.FOREIGN_KEY:
+            pass  # parsed and ignored (reference ddl/foreign_key.go is a stub)
+    return specs, indices
+
+
+def _ddl(session, stmt):
+    # DDL implies commit of the current txn (tidb.go runStmt DDL rule)
+    session.commit_txn()
+    ddl = session.domain.ddl
+    db = session.vars.current_db
+
+    def dbname(tn) -> str:
+        name = tn.db or db
+        if not name:
+            raise errors.BadDBError("No database selected")
+        return name
+
+    if isinstance(stmt, ast.CreateDatabaseStmt):
+        try:
+            ddl.create_schema(stmt.name)
+        except errors.DBExistsError:
+            if not stmt.if_not_exists:
+                raise
+    elif isinstance(stmt, ast.DropDatabaseStmt):
+        try:
+            ddl.drop_schema(stmt.name)
+        except errors.BadDBError:
+            if not stmt.if_exists:
+                raise
+        if session.vars.current_db.lower() == stmt.name.lower():
+            session.vars.current_db = ""
+    elif isinstance(stmt, ast.CreateTableStmt):
+        specs, indices = _column_specs(stmt.cols, stmt.constraints)
+        try:
+            ddl.create_table(dbname(stmt.table), stmt.table.name, specs,
+                             indices)
+        except errors.TableExistsError:
+            if not stmt.if_not_exists:
+                raise
+    elif isinstance(stmt, ast.DropTableStmt):
+        for tn in stmt.tables:
+            try:
+                ddl.drop_table(dbname(tn), tn.name)
+            except errors.NoSuchTableError:
+                if not stmt.if_exists:
+                    raise
+    elif isinstance(stmt, ast.TruncateTableStmt):
+        ddl.truncate_table(dbname(stmt.table), stmt.table.name)
+    elif isinstance(stmt, ast.CreateIndexStmt):
+        ddl.create_index(dbname(stmt.table), stmt.table.name,
+                         stmt.index_name, stmt.columns, stmt.unique)
+    elif isinstance(stmt, ast.DropIndexStmt):
+        try:
+            ddl.drop_index(dbname(stmt.table), stmt.table.name,
+                           stmt.index_name)
+        except errors.TiDBError:
+            if not stmt.if_exists:
+                raise
+    elif isinstance(stmt, ast.AlterTableStmt):
+        for spec in stmt.specs:
+            _alter(session, ddl, dbname(stmt.table), stmt.table.name, spec)
+    return None
+
+
+def _alter(session, ddl, db: str, table: str, spec: ast.AlterTableSpec):
+    if spec.tp == ast.AlterTableType.ADD_COLUMN:
+        specs, _ = _column_specs([spec.column], [])
+        ddl.add_column(db, table, specs[0])
+    elif spec.tp == ast.AlterTableType.DROP_COLUMN:
+        ddl.drop_column(db, table, spec.name)
+    elif spec.tp == ast.AlterTableType.ADD_CONSTRAINT:
+        cons = spec.constraint
+        unique = cons.tp in (ast.ConstraintType.UNIQUE,
+                             ast.ConstraintType.UNIQUE_KEY,
+                             ast.ConstraintType.UNIQUE_INDEX)
+        ddl.create_index(db, table, cons.name or cons.keys[0],
+                         list(cons.keys), unique)
+    elif spec.tp == ast.AlterTableType.DROP_INDEX:
+        ddl.drop_index(db, table, spec.name)
+    else:
+        raise errors.ExecError(f"unsupported ALTER TABLE spec {spec.tp!r}")
+
+
+# ---------------------------------------------------------------------------
+# SHOW (executor/show.go)
+# ---------------------------------------------------------------------------
+
+def _like_filter(rows, pattern: str, col: int = 0):
+    if not pattern:
+        return rows
+    from tidb_tpu.expression.ops import compute_like
+    out = []
+    for row in rows:
+        m = compute_like(datum_from_py(row[col]), Datum.string(pattern))
+        if not m.is_null() and m.val == 1:
+            out.append(row)
+    return out
+
+
+def _show(session, stmt: ast.ShowStmt) -> ResultSet:
+    is_ = session.info_schema()
+    tp = stmt.tp
+    if tp == ast.ShowType.DATABASES:
+        names = sorted(is_.all_schema_names(), key=str.lower)
+        return _str_rs(["Database"], _like_filter([[n] for n in names],
+                                                  stmt.pattern))
+    if tp == ast.ShowType.TABLES:
+        db = stmt.db or session.vars.current_db
+        if not db:
+            raise errors.BadDBError("No database selected")
+        if not is_.schema_exists(db):
+            raise errors.BadDBError(f"Unknown database '{db}'")
+        names = sorted(t.info.name for t in is_.schema_tables(db))
+        return _str_rs([f"Tables_in_{db}"],
+                       _like_filter([[n] for n in names], stmt.pattern))
+    if tp == ast.ShowType.COLUMNS:
+        db = (stmt.table.db if stmt.table else "") or stmt.db \
+            or session.vars.current_db
+        tbl = is_.table_by_name(db, stmt.table.name)
+        rows = []
+        for c in tbl.info.public_columns():
+            ft = c.field_type
+            null = "NO" if my.has_not_null_flag(ft.flag) else "YES"
+            key = "PRI" if my.has_pri_key_flag(ft.flag) else (
+                "UNI" if ft.flag & my.UniqueKeyFlag else (
+                    "MUL" if ft.flag & my.MultipleKeyFlag else ""))
+            extra = "auto_increment" \
+                if my.has_auto_increment_flag(ft.flag) else ""
+            rows.append([c.name, ft.compact_str(), null, key,
+                         c.default_value, extra])
+        return _str_rs(["Field", "Type", "Null", "Key", "Default", "Extra"],
+                       rows)
+    if tp == ast.ShowType.CREATE_TABLE:
+        db = (stmt.table.db or session.vars.current_db)
+        tbl = is_.table_by_name(db, stmt.table.name)
+        return _str_rs(["Table", "Create Table"],
+                       [[tbl.info.name, _create_table_sql(tbl.info)]])
+    if tp == ast.ShowType.VARIABLES:
+        rows = []
+        seen = set()
+        source = session.global_vars.values if stmt.full else {
+            **session.global_vars.values, **session.vars.systems}
+        for name in sorted(source):
+            if name in seen:
+                continue
+            seen.add(name)
+            val = session.vars.get_system(name, session.global_vars) \
+                if not stmt.full else session.global_vars.get(name)
+            rows.append([name, val])
+        return _str_rs(["Variable_name", "Value"],
+                       _like_filter(rows, stmt.pattern))
+    if tp == ast.ShowType.INDEXES:
+        db = (stmt.table.db or session.vars.current_db)
+        tbl = is_.table_by_name(db, stmt.table.name)
+        rows = []
+        for idx in tbl.info.indices:
+            for seq, ic in enumerate(idx.columns, 1):
+                rows.append([tbl.info.name, 0 if idx.unique else 1,
+                             idx.name, seq, ic.name])
+        return _str_rs(["Table", "Non_unique", "Key_name", "Seq_in_index",
+                        "Column_name"], rows)
+    if tp == ast.ShowType.WARNINGS:
+        return _str_rs(["Level", "Code", "Message"], [])
+    raise errors.ExecError(f"unsupported SHOW type {tp!r}")
+
+
+def _create_table_sql(info) -> str:
+    parts = []
+    for c in info.public_columns():
+        ft = c.field_type
+        s = f"  `{c.name}` {ft.compact_str()}"
+        if my.has_not_null_flag(ft.flag):
+            s += " NOT NULL"
+        if my.has_auto_increment_flag(ft.flag):
+            s += " AUTO_INCREMENT"
+        if c.has_default and c.default_value is not None:
+            s += f" DEFAULT '{c.default_value}'"
+        parts.append(s)
+    for idx in info.indices:
+        cols = ", ".join(f"`{ic.name}`" for ic in idx.columns)
+        if idx.primary:
+            parts.append(f"  PRIMARY KEY ({cols})")
+        elif idx.unique:
+            parts.append(f"  UNIQUE KEY `{idx.name}` ({cols})")
+        else:
+            parts.append(f"  KEY `{idx.name}` ({cols})")
+    body = ",\n".join(parts)
+    return f"CREATE TABLE `{info.name}` (\n{body}\n) ENGINE=TiDB-TPU"
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN / ADMIN
+# ---------------------------------------------------------------------------
+
+def explain_result(plan) -> ResultSet:
+    lines = tree_string(plan).split("\n")
+    return _str_rs(["Plan"], [[line] for line in lines])
+
+
+def _admin(session, stmt: ast.AdminStmt) -> ResultSet:
+    if stmt.tp == ast.AdminType.SHOW_DDL:
+        from tidb_tpu.meta import Meta
+        txn = session.store.begin()
+        try:
+            m = Meta(txn)
+            ver = m.schema_version()
+            qlen = m.ddl_job_queue_len()
+        finally:
+            txn.rollback()
+        return _str_rs(["Schema_Version", "DDL_Job_Queue_Len"], [[str(ver),
+                                                                 str(qlen)]])
+    if stmt.tp == ast.AdminType.CHECK_TABLE:
+        from tidb_tpu.inspectkv import check_table
+        db = session.vars.current_db
+        for tn in stmt.tables:
+            tbl = session.info_schema().table_by_name(tn.db or db, tn.name)
+            check_table(session.store.get_snapshot(), tbl)
+        return None
+    raise errors.ExecError(f"unsupported ADMIN statement {stmt.tp!r}")

@@ -1,0 +1,55 @@
+"""Admin/debug scans over raw KV: index↔row consistency checks.
+
+Reference: inspectkv/inspectkv.go — CompareIndexData (:166),
+checkRecordAndIndex (:213); backs ADMIN CHECK TABLE
+(executor/executor.go:196).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.types.datum import compare_datum
+
+
+class InconsistencyError(errors.TiDBError):
+    pass
+
+
+def check_table(snapshot, tbl) -> None:
+    """Verify every index entry matches its row and every row is indexed."""
+    for idx in tbl.indices:
+        check_index(snapshot, tbl, idx)
+
+
+def check_index(snapshot, tbl, idx) -> None:
+    # index → rows
+    offsets = [c.offset for c in idx.info.columns]
+    for vals, handle in idx.iterate(snapshot):
+        try:
+            row = tbl.row_with_cols(snapshot, handle)
+        except errors.KeyNotExistsError:
+            raise InconsistencyError(
+                f"index {idx.info.name} entry {vals!r} points at missing "
+                f"handle {handle}")
+        for v, off in zip(vals, offsets):
+            rv = row[off]
+            if v.is_null() and rv.is_null():
+                continue
+            if v.is_null() != rv.is_null() or compare_datum(v, rv) != 0:
+                raise InconsistencyError(
+                    f"index {idx.info.name} handle {handle}: index value "
+                    f"{v!r} != row value {rv!r}")
+    # rows → index
+    index_handles = {h for _, h in idx.iterate(snapshot)}
+    for row, handle in _iter_rows(snapshot, tbl):
+        vals = [row[off] for off in offsets]
+        if idx.info.unique and any(v.is_null() for v in vals):
+            continue  # NULLs may legitimately be absent from a unique index
+        if handle not in index_handles:
+            raise InconsistencyError(
+                f"row {handle} missing from index {idx.info.name}")
+
+
+def _iter_rows(snapshot, tbl):
+    for handle, row in tbl.iter_records(snapshot):
+        yield row, handle

@@ -68,6 +68,17 @@ class ColumnInfo:
     def lower_name(self) -> str:
         return self.name.lower()
 
+    def original_default_datum(self):
+        """Typed Datum for rows written before this column existed
+        (column.go original default); NULL when the column had no default.
+        Single source for the table-read path and the copr protocol."""
+        from tidb_tpu.types.convert import convert_datum
+        from tidb_tpu.types.datum import NULL, datum_from_py
+        if self.original_default is None:
+            return NULL
+        return convert_datum(datum_from_py(self.original_default),
+                             self.field_type)
+
 
 @dataclass
 class IndexColumn:

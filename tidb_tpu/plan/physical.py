@@ -133,12 +133,13 @@ def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
     table_ranges = refiner.build_table_range(access, handle_col) \
         if access else list(refiner.FULL_TABLE_RANGE)
 
-    # index access path: only competes when the PK gave no bound
+    # index access path: only competes when the PK gave no bound; dirty
+    # tables always table-scan (UnionScan merges by handle ranges)
     # (convert2IndexScan; the cost model with stats arrives later)
-    if not access:
+    if not access and ds.table_info.id not in ctx.dirty:
         idx_plan = _try_index_scan(ds, rest, ctx)
         if idx_plan is not None:
-            return _maybe_union_scan(idx_plan, ds, conditions, ctx)
+            return idx_plan
 
     scan = PhysicalTableScan()
     _fill_source(scan, ds)

@@ -1,0 +1,337 @@
+"""Session: the engine's front door — parse → compile → run per statement,
+txn lifecycle, optimistic retry, bootstrap.
+
+Reference: tidb.go (Parse :102, Compile :114, runStmt :123), session.go
+(Execute :429, GetTxn :566, finishTxn :182, Retry :274), bootstrap.go
+(:121 system tables + root user).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from tidb_tpu import errors, sqlast as ast
+from tidb_tpu.executor.builder import ExecutorBuilder
+from tidb_tpu.executor.simple import ResultSet, execute_simple, explain_result
+from tidb_tpu.kv.kv import open_store, register_driver
+from tidb_tpu.domain import get_domain
+from tidb_tpu.parser.parser import Parser
+from tidb_tpu.plan import optimize_plan
+from tidb_tpu.plan.builder import PlanBuilder
+from tidb_tpu.plan.plans import (
+    Delete, ExplainPlan, Insert, ShowPlan, SimplePlan, Update,
+)
+from tidb_tpu.sessionctx import GlobalVars, SessionVars
+from tidb_tpu.types import Datum
+
+_conn_id_gen = itertools.count(1)
+_global_vars_by_store: dict[str, GlobalVars] = {}
+_bootstrap_lock = threading.Lock()
+
+
+def new_store(url: str):
+    """'local://path' or 'memory://' → Storage (tidb.go NewStore)."""
+    _ensure_drivers()
+    return open_store(url)
+
+
+def _ensure_drivers():
+    from tidb_tpu.localstore.store import LocalDriver
+    from tidb_tpu.kv import kv as kvmod
+    for scheme in ("local", "memory", "goleveldb", "boltdb"):
+        if scheme not in kvmod._drivers:
+            register_driver(scheme, LocalDriver())
+
+
+class Session:
+    """One connection's state. Reference: session.go session struct."""
+
+    def __init__(self, store):
+        self.store = store
+        self.domain = get_domain(store)
+        self.client = store.get_client()
+        self.vars = SessionVars()
+        self.vars.connection_id = next(_conn_id_gen)
+        self.global_vars = _global_vars_by_store.setdefault(
+            store.uuid(), GlobalVars())
+        self.parser = Parser()
+        self._txn = None
+        self.history: list[str] = []   # stmt texts for optimistic retry
+        self.params: list[Datum] = []
+        self.dirty_tables: set[int] = set()
+        bootstrap(self)
+
+    # ------------------------------------------------------------------
+    # context surface used by planner/executors (ExecContext duck-type)
+    # ------------------------------------------------------------------
+
+    @property
+    def current_db(self) -> str:
+        return self.vars.current_db
+
+    def info_schema(self):
+        return self.domain.info_schema()
+
+    def txn(self):
+        if self._txn is None or not self._txn.valid():
+            self._txn = self.store.begin()
+            self.dirty_tables = set()
+        return self._txn
+
+    def start_ts(self) -> int:
+        if self.vars.snapshot_ts is not None:
+            return self.vars.snapshot_ts
+        return self.txn().start_ts()
+
+    def mark_dirty(self, table_id: int) -> None:
+        self.dirty_tables.add(table_id)
+
+    def set_affected_rows(self, n: int) -> None:
+        self.vars.affected_rows = n
+
+    def get_sysvar(self, name: str, is_global: bool = False):
+        if is_global:
+            return self.global_vars.get(name)
+        return self.vars.get_system(name, self.global_vars)
+
+    def get_uservar(self, name: str):
+        return self.vars.users.get(name.lower())
+
+    def distsql_concurrency(self) -> int:
+        return self.vars.distsql_concurrency()
+
+    def plan_ctx(self):
+        return self
+
+    # ------------------------------------------------------------------
+    # txn control
+    # ------------------------------------------------------------------
+
+    def begin_txn(self) -> None:
+        self.commit_txn()
+        self.txn()  # eager begin so START TRANSACTION pins a snapshot
+        self.vars.in_txn = True
+        self.history = []
+
+    def commit_txn(self) -> None:
+        """Commit with optimistic retry (session.go finishTxn :182)."""
+        if self._txn is None:
+            self.vars.in_txn = False
+            return
+        try:
+            self._txn.commit()
+        except errors.RetryableError:
+            self._txn = None
+            self._retry()
+        finally:
+            self._txn = None
+            self.vars.in_txn = False
+            self.dirty_tables = set()
+            self.history = []
+
+    def rollback_txn(self) -> None:
+        if self._txn is not None:
+            self._txn.rollback()
+        self._txn = None
+        self.vars.in_txn = False
+        self.dirty_tables = set()
+        self.history = []
+
+    def _retry(self) -> None:
+        """Replay statement history on a fresh snapshot (session.Retry
+        :274). History holds the txn's mutating statement texts."""
+        stmts = list(self.history)
+        last_err = None
+        self._in_retry = True
+        try:
+            for _ in range(self.vars.retry_limit):
+                try:
+                    for sql in stmts:
+                        self._execute_one(self.parser.parse_one(sql), sql,
+                                          record_history=False)
+                    if self._txn is not None:
+                        self._txn.commit()
+                        self._txn = None
+                    return
+                except errors.RetryableError as e:
+                    last_err = e
+                    if self._txn is not None:
+                        self._txn.rollback()
+                        self._txn = None
+                    continue
+        finally:
+            self._in_retry = False
+        raise last_err
+
+    # ------------------------------------------------------------------
+    # execute
+    # ------------------------------------------------------------------
+
+    def execute(self, sql: str) -> list[ResultSet]:
+        """Reference: session.Execute (session.go:429)."""
+        stmts = self.parser.parse(sql)
+        results: list[ResultSet] = []
+        for stmt in stmts:
+            rs = self._execute_one(stmt, stmt.text or sql)
+            if rs is not None:
+                results.append(rs)
+        return results
+
+    def _execute_one(self, stmt, sql_text: str,
+                     record_history: bool = True) -> ResultSet | None:
+        self.vars.affected_rows = 0
+        if _is_simple(stmt):
+            return execute_simple(self, stmt)
+
+        plan = optimize_plan(PlanBuilder(self).build(stmt), self, self.client,
+                             self.dirty_tables)
+        if isinstance(plan, ShowPlan):
+            return execute_simple(self, plan.stmt)
+        if isinstance(plan, SimplePlan):
+            return execute_simple(self, plan.stmt)
+        if isinstance(plan, ExplainPlan):
+            return explain_result(plan.target)
+
+        is_write = isinstance(plan, (Insert, Update, Delete))
+        executor = ExecutorBuilder(self).build(plan)
+        try:
+            if is_write:
+                while executor.next() is not None:
+                    pass
+                rs = None
+                if record_history:
+                    self.history.append(sql_text)
+            else:
+                rows = []
+                while True:
+                    row = executor.next()
+                    if row is None:
+                        break
+                    rows.append(row)
+                fields = [(c.col_name, c.ret_type) for c in plan.schema]
+                rs = ResultSet(fields, rows)
+        except Exception:
+            if not self.vars.in_txn:
+                self.rollback_txn()
+            raise
+        finally:
+            executor.close()
+
+        # autocommit: commit unless inside an explicit txn or a retry replay
+        if is_write and not self.vars.in_txn \
+                and not getattr(self, "_in_retry", False):
+            if self.vars.autocommit:
+                self.commit_txn()
+        return rs
+
+    def persist_global_var(self, name: str, value: str) -> None:
+        """Write-through to mysql.global_variables (session.go globalVars)."""
+        try:
+            self.execute(
+                "replace into mysql.global_variables values "
+                f"('{name.lower()}', '{value}')")
+        except errors.TiDBError:
+            pass  # pre-bootstrap
+
+    def close(self) -> None:
+        self.rollback_txn()
+
+
+def _is_simple(stmt) -> bool:
+    return isinstance(stmt, (
+        ast.UseStmt, ast.SetStmt, ast.BeginStmt, ast.CommitStmt,
+        ast.RollbackStmt, ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
+        ast.CreateTableStmt, ast.DropTableStmt, ast.TruncateTableStmt,
+        ast.CreateIndexStmt, ast.DropIndexStmt, ast.AlterTableStmt,
+        ast.AdminStmt))
+
+
+# ---------------------------------------------------------------------------
+# bootstrap (bootstrap.go:121,288,309)
+# ---------------------------------------------------------------------------
+
+_BOOTSTRAPPED_STORES: set[str] = set()
+
+CREATE_USER_TABLE = """
+create table if not exists mysql.user (
+    Host char(64), User char(16), Password char(41),
+    Select_priv char(1) default 'N', Insert_priv char(1) default 'N',
+    Update_priv char(1) default 'N', Delete_priv char(1) default 'N',
+    Create_priv char(1) default 'N', Drop_priv char(1) default 'N',
+    Grant_priv char(1) default 'N', Alter_priv char(1) default 'N',
+    Index_priv char(1) default 'N', Execute_priv char(1) default 'N',
+    Show_db_priv char(1) default 'N', Super_priv char(1) default 'N',
+    Create_user_priv char(1) default 'N', Trigger_priv char(1) default 'N'
+)"""
+
+CREATE_DB_TABLE = """
+create table if not exists mysql.db (
+    Host char(60), DB char(64), User char(16),
+    Select_priv char(1) default 'N', Insert_priv char(1) default 'N',
+    Update_priv char(1) default 'N', Delete_priv char(1) default 'N',
+    Create_priv char(1) default 'N', Drop_priv char(1) default 'N',
+    Grant_priv char(1) default 'N', Index_priv char(1) default 'N',
+    Alter_priv char(1) default 'N', Execute_priv char(1) default 'N'
+)"""
+
+CREATE_TABLES_PRIV_TABLE = """
+create table if not exists mysql.tables_priv (
+    Host char(60), DB char(64), User char(16), Table_name char(64),
+    Grantor char(77), Table_priv char(128), Column_priv char(128)
+)"""
+
+CREATE_COLUMNS_PRIV_TABLE = """
+create table if not exists mysql.columns_priv (
+    Host char(60), DB char(64), User char(16), Table_name char(64),
+    Column_name char(64), Column_priv char(128)
+)"""
+
+CREATE_GLOBAL_VARIABLES_TABLE = """
+create table if not exists mysql.global_variables (
+    variable_name char(64) not null,
+    variable_value char(255),
+    primary key (variable_name)
+)"""
+
+CREATE_TIDB_TABLE = """
+create table if not exists mysql.tidb (
+    variable_name char(64) not null,
+    variable_value char(255),
+    comment char(255),
+    primary key (variable_name)
+)"""
+
+
+def bootstrap(session: Session) -> None:
+    """Create mysql.* system tables and the default root user on first use
+    of a store (bootstrap.go doDDLWorks/doDMLWorks)."""
+    uuid = session.store.uuid()
+    if uuid in _BOOTSTRAPPED_STORES:
+        return
+    with _bootstrap_lock:
+        if uuid in _BOOTSTRAPPED_STORES:
+            return
+        _BOOTSTRAPPED_STORES.add(uuid)
+        if session.info_schema().schema_exists("mysql"):
+            return  # persisted store already bootstrapped
+        session.execute("create database if not exists mysql")
+        for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,
+                    CREATE_TABLES_PRIV_TABLE, CREATE_COLUMNS_PRIV_TABLE,
+                    CREATE_GLOBAL_VARIABLES_TABLE, CREATE_TIDB_TABLE):
+            session.execute(ddl)
+        session.execute(
+            "insert into mysql.user (Host, User, Password, Select_priv, "
+            "Insert_priv, Update_priv, Delete_priv, Create_priv, Drop_priv, "
+            "Grant_priv, Alter_priv, Index_priv, Execute_priv, Show_db_priv, "
+            "Super_priv, Create_user_priv, Trigger_priv) values "
+            "('%', 'root', '', 'Y','Y','Y','Y','Y','Y','Y','Y','Y','Y','Y',"
+            "'Y','Y','Y')")
+        from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+        values = ", ".join(f"('{k}', '{v}')"
+                           for k, v in sorted(SYSVAR_DEFAULTS.items()))
+        session.execute(
+            f"insert into mysql.global_variables values {values}")
+        session.execute(
+            "insert into mysql.tidb values ('bootstrapped', 'True', "
+            "'Bootstrap flag. Do not delete.')")

@@ -1,0 +1,100 @@
+"""Session variables: per-connection state + the sysvar table.
+
+Reference: sessionctx/variable/ (SessionVars, sysvar.go's 626-line table,
+varsutil). A working subset of the MySQL sysvar table plus the engine's own
+tunables (tidb_distsql_scan_concurrency, sessionctx/variable/sysvar.go:591).
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.types import Datum
+
+# name → default (all values kept as strings, MySQL-style)
+SYSVAR_DEFAULTS: dict[str, str] = {
+    "autocommit": "1",
+    "auto_increment_increment": "1",
+    "auto_increment_offset": "1",
+    "character_set_client": "utf8",
+    "character_set_connection": "utf8",
+    "character_set_results": "utf8",
+    "character_set_server": "utf8",
+    "collation_connection": "utf8_general_ci",
+    "collation_database": "utf8_bin",
+    "collation_server": "utf8_bin",
+    "default_storage_engine": "InnoDB",
+    "interactive_timeout": "28800",
+    "lower_case_table_names": "2",
+    "max_allowed_packet": "67108864",
+    "max_connections": "151",
+    "net_buffer_length": "16384",
+    "net_write_timeout": "60",
+    "sql_mode": "",
+    "sql_select_limit": "18446744073709551615",
+    "time_zone": "SYSTEM",
+    "tx_isolation": "REPEATABLE-READ",
+    "version_comment": "TiDB-TPU Server",
+    "wait_timeout": "28800",
+    # engine tunables (reference sessionctx/variable/sysvar.go:591-600)
+    "tidb_distsql_scan_concurrency": "10",
+    "tidb_snapshot": "",
+    "tidb_skip_constraint_check": "0",
+    # TPU coprocessor routing: cpu | tpu (this build's copr=tpu switch)
+    "tidb_copr_backend": "cpu",
+    "tidb_copr_batch_rows": "1048576",
+}
+
+
+class SessionVars:
+    """Reference: sessionctx/variable.SessionVars."""
+
+    def __init__(self):
+        self.systems: dict[str, str] = {}       # session-scope overrides
+        self.users: dict[str, Datum] = {}       # @user_vars
+        self.current_db = ""
+        self.autocommit = True
+        self.in_txn = False                     # explicit BEGIN active
+        self.connection_id = 0
+        self.user = ""
+        self.last_insert_id = 0
+        self.affected_rows = 0
+        self.found_rows = 0
+        self.status_flags = 0
+        self.prepared: dict = {}                # name/id → prepared stmt
+        self.prepared_id_gen = 0
+        self.snapshot_ts: int | None = None     # tidb_snapshot time travel
+        self.retry_limit = 10
+
+    def get_system(self, name: str, globals_: "GlobalVars") -> str | None:
+        name = name.lower()
+        if name in self.systems:
+            return self.systems[name]
+        return globals_.get(name)
+
+    def set_system(self, name: str, value: str) -> None:
+        name = name.lower()
+        self.systems[name] = value
+        if name == "autocommit":
+            self.autocommit = value.lower() in ("1", "on", "true")
+
+    def distsql_concurrency(self) -> int:
+        v = self.systems.get("tidb_distsql_scan_concurrency")
+        return int(v) if v else int(
+            SYSVAR_DEFAULTS["tidb_distsql_scan_concurrency"])
+
+
+class GlobalVars:
+    """Global sysvar cache; persisted to mysql.global_variables once the
+    bootstrap tables exist (session.go globalSysVar cache equivalent)."""
+
+    def __init__(self):
+        self.values = dict(SYSVAR_DEFAULTS)
+
+    def get(self, name: str) -> str | None:
+        return self.values.get(name.lower())
+
+    def set(self, name: str, value: str) -> None:
+        name = name.lower()
+        if name not in self.values:
+            raise errors.ExecError(f"Unknown system variable '{name}'")
+        self.values[name] = value
