@@ -1,0 +1,129 @@
+"""Headline benchmark: TPC-H Q6 rows/sec/chip, TPU coprocessor vs the CPU
+xeval baseline (BASELINE.md configs 1-2).
+
+Builds a lineitem-shaped table in the in-memory MVCC store, runs Q6 through
+the FULL engine stack (SQL → plan → pushdown → coprocessor) on both
+engines, and prints ONE JSON line:
+
+    {"metric": "tpch_q6_rows_per_sec_tpu", "value": ..., "unit": "rows/s",
+     "vs_baseline": <tpu_rows_per_sec / cpu_rows_per_sec>}
+
+Environment:
+    BENCH_ROWS   lineitem row count (default 300000)
+    BENCH_RUNS   timed repetitions per engine (default 3)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+
+Q6 = ("select sum(l_extendedprice * l_discount) from lineitem "
+      "where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01' "
+      "and l_discount >= 0.05 and l_discount <= 0.07 "
+      "and l_quantity < 24")
+
+
+def build_store(n_rows: int):
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum, datum_from_py
+    from tidb_tpu.types.time_types import parse_time
+
+    store = new_store(f"memory://bench{n_rows}")
+    s = Session(store)
+    s.execute("create database tpch")
+    s.execute("use tpch")
+    s.execute(
+        "create table lineitem ("
+        " l_id bigint primary key,"
+        " l_quantity double, l_extendedprice double, l_discount double,"
+        " l_tax double, l_returnflag varchar(1), l_linestatus varchar(1),"
+        " l_shipdate date)")
+    tbl = s.info_schema().table_by_name("tpch", "lineitem")
+
+    rng = random.Random(42)
+    flags = ["A", "N", "R"]
+    statuses = ["F", "O"]
+    base = parse_time("1992-01-01")
+    import datetime as dt
+    t0 = time.time()
+    batch = 20000
+    i = 1
+    while i <= n_rows:
+        txn = store.begin()
+        for _ in range(min(batch, n_rows - i + 1)):
+            ship = base.dt + dt.timedelta(days=rng.randint(0, 2500))
+            from tidb_tpu.types.time_types import Time
+            row = [
+                Datum.i64(i),
+                Datum.f64(float(rng.randint(1, 50))),
+                Datum.f64(round(rng.uniform(900.0, 105000.0), 2)),
+                Datum.f64(round(rng.uniform(0.0, 0.1), 2)),
+                Datum.f64(round(rng.uniform(0.0, 0.08), 2)),
+                Datum.string(rng.choice(flags)),
+                Datum.string(rng.choice(statuses)),
+                datum_from_py(Time(ship, tbl.info.columns[7].field_type.tp)),
+            ]
+            tbl.add_record(txn, row, skip_unique_check=True)
+            i += 1
+        txn.commit()
+    load_s = time.time() - t0
+    return store, s, load_s
+
+
+def timed_runs(session, sql: str, runs: int):
+    session.execute(sql)  # warm (compile + cache)
+    results = []
+    t0 = time.time()
+    for _ in range(runs):
+        results.append(session.execute(sql)[0].values())
+    return (time.time() - t0) / runs, results
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", "300000"))
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+
+    from tidb_tpu.ops import TpuClient
+    from tidb_tpu.session import Session
+
+    store, session, load_s = build_store(n_rows)
+    print(f"# loaded {n_rows} rows in {load_s:.1f}s", file=sys.stderr)
+
+    # CPU xeval baseline (store/localstore/local_region.go equivalent)
+    cpu_s, cpu_results = timed_runs(session, Q6, runs)
+    cpu_rps = n_rows / cpu_s
+
+    # TPU coprocessor
+    store.set_client(TpuClient(store))
+    tpu_session = Session(store)
+    tpu_session.execute("use tpch")
+    tpu_s, tpu_results = timed_runs(tpu_session, Q6, runs)
+    tpu_rps = n_rows / tpu_s
+
+    client = store.get_client()
+    assert client.stats["tpu_requests"] > 0, "TPU engine was never used"
+
+    # result parity (float path: relative tolerance)
+    cpu_v = float(cpu_results[0][0][0])
+    tpu_v = float(tpu_results[0][0][0])
+    assert abs(cpu_v - tpu_v) <= 1e-6 * max(abs(cpu_v), 1.0), \
+        f"parity failure: cpu={cpu_v} tpu={tpu_v}"
+
+    print(f"# cpu: {cpu_s:.3f}s/run ({cpu_rps:,.0f} rows/s)  "
+          f"tpu: {tpu_s:.4f}s/run ({tpu_rps:,.0f} rows/s)  "
+          f"speedup {tpu_rps / cpu_rps:.1f}x", file=sys.stderr)
+    print(json.dumps({
+        "metric": "tpch_q6_rows_per_sec_tpu",
+        "value": round(tpu_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(tpu_rps / cpu_rps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -130,3 +130,28 @@ class TestMultiStatement:
         tk.exec("create table t (id bigint primary key); "
                 "insert into t values (1); insert into t values (2)")
         tk.query("select count(*) from t").check([[2]])
+
+
+class TestReviewRegressions:
+    def test_autocommit_reads_release_snapshot(self, tk):
+        """A read-only autocommit statement must not pin its snapshot:
+        later reads see other sessions' commits."""
+        tk.exec("create table t (id bigint primary key, v int)")
+        tk.exec("insert into t values (1, 10)")
+        tk.query("select v from t").check([[10]])
+        tk2 = tk.new_session()
+        tk2.exec("use test")
+        tk2.exec("update t set v = 20 where id = 1")
+        tk.query("select v from t").check([[20]])
+
+    def test_set_global_persists_to_table(self, tk):
+        tk.exec("set @@global.version_comment = \"it's mine\"")
+        tk.query("select variable_value from mysql.global_variables "
+                 "where variable_name = 'version_comment'").check(
+            [["it's mine"]])
+        assert tk.session.global_vars.get("version_comment") == "it's mine"
+
+    def test_global_concurrency_respected(self, tk):
+        tk.exec("set @@global.tidb_distsql_scan_concurrency = 4")
+        tk2 = tk.new_session()
+        assert tk2.session.distsql_concurrency() == 4

@@ -42,9 +42,7 @@ def _norm(v):
         return float(v) if v != v.to_integral_value() else int(v)
     if isinstance(v, bytes):
         return v.decode("utf-8", "replace")
-    if isinstance(v, float) and v.is_integer():
-        return v  # keep floats distinct from ints in expectations
-    return v
+    return v  # floats stay floats: expectations distinguish 1 from 1.0
 
 
 class TestKit:

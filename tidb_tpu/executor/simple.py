@@ -288,13 +288,9 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
                        [[tbl.info.name, _create_table_sql(tbl.info)]])
     if tp == ast.ShowType.VARIABLES:
         rows = []
-        seen = set()
         source = session.global_vars.values if stmt.full else {
             **session.global_vars.values, **session.vars.systems}
         for name in sorted(source):
-            if name in seen:
-                continue
-            seen.add(name)
             val = session.vars.get_system(name, session.global_vars) \
                 if not stmt.full else session.global_vars.get(name)
             rows.append([name, val])

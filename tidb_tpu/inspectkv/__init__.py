@@ -22,9 +22,11 @@ def check_table(snapshot, tbl) -> None:
 
 
 def check_index(snapshot, tbl, idx) -> None:
-    # index → rows
+    # index → rows (collect handles in the same pass for the reverse check)
     offsets = [c.offset for c in idx.info.columns]
+    index_handles: set[int] = set()
     for vals, handle in idx.iterate(snapshot):
+        index_handles.add(handle)
         try:
             row = tbl.row_with_cols(snapshot, handle)
         except errors.KeyNotExistsError:
@@ -40,7 +42,6 @@ def check_index(snapshot, tbl, idx) -> None:
                     f"index {idx.info.name} handle {handle}: index value "
                     f"{v!r} != row value {rv!r}")
     # rows → index
-    index_handles = {h for _, h in idx.iterate(snapshot)}
     for row, handle in _iter_rows(snapshot, tbl):
         vals = [row[off] for off in offsets]
         if idx.info.unique and any(v.is_null() for v in vals):

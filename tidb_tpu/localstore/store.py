@@ -144,6 +144,7 @@ class LocalStore(Storage):
         self._commit_lock = threading.Lock()
         self._client: Client | None = None
         self._closed = False
+        self._commit_ts_log: list[int] = []
 
     # ---- Storage ----
     def begin(self) -> Transaction:
@@ -181,6 +182,13 @@ class LocalStore(Storage):
             for key, val in mutations:
                 self.mvcc.write(key, commit_ts, None if val == TOMBSTONE else val)
             self.regions.note_write(len(mutations))
+            self._commit_ts_log.append(commit_ts)
+
+    def data_version_at(self, start_ts: int) -> int:
+        """Number of commits visible at start_ts — the cache key the TPU
+        columnar cache uses: equal versions ⇒ identical visible data."""
+        import bisect
+        return bisect.bisect_right(self._commit_ts_log, start_ts)
 
     # ---- GC ----
     def compact(self, safe_point_ts: int | None = None,

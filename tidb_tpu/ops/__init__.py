@@ -1,0 +1,19 @@
+"""TPU coprocessor execution tier.
+
+The TPU-native replacement for the per-row CPU engine
+(copr.region_handler): columnar batches (columnar.py), Expr → XLA lowering
+(exprc.py), fused filter/agg kernels (kernels.py), and the kv.Client
+implementation that routes requests to them (client.py).
+
+int64 planes (handles, codes, counts) require JAX x64 — enabled here
+before any array is created.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from tidb_tpu.ops.client import TpuClient  # noqa: E402
+from tidb_tpu.ops.columnar import ColumnBatch, pack_ranges  # noqa: E402
+
+__all__ = ["TpuClient", "ColumnBatch", "pack_ranges"]

@@ -1,0 +1,364 @@
+"""TpuClient: the TPU coprocessor behind the kv.Client boundary.
+
+Install with `store.set_client(TpuClient(store))` (or SET
+tidb_copr_backend='tpu' through a session) — the planner, executors and
+wire format are untouched; only the engine behind kv.Client.send changes.
+This mirrors how the reference swaps coprocessor backends behind
+kv.Client (kv/kv.go:94, SURVEY §7 capability negotiation).
+
+Execution model per request:
+  1. columnar batch for (table, columns, ranges, data version) — packed
+     once, cached in host memory; pushed to device per kernel call
+     (device-resident caching is the next milestone)
+  2. Expr trees lower to fused filter+aggregate XLA kernels (ops.exprc /
+     ops.kernels); one jitted callable per request signature, cached
+  3. results come back as the SAME partial-row protocol the CPU engine
+     emits, so the SQL-side FinalMode aggregation is engine-agnostic
+
+Anything that fails to lower raises Unsupported and the request silently
+falls back to the CPU engine (LocalClient) — result parity by construction,
+performance by routing.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.codec import codec
+from tidb_tpu.copr.proto import (
+    AGG_NAME, ChunkWriter, Expr, ExprType, SelectRequest, SelectResponse,
+)
+from tidb_tpu.kv import kv
+from tidb_tpu.localstore.local_client import LocalClient
+from tidb_tpu.ops import columnar as col
+from tidb_tpu.ops import kernels
+from tidb_tpu.ops.exprc import Unsupported, compile_expr, supported_for_tpu
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, Kind
+
+
+def _n_outputs(spec) -> int:
+    """Kernel outputs per aggregate (mirrors kernels._scalar_agg)."""
+    return 1 if spec.name == "count" else 2
+
+
+class _SingleResponse(kv.Response):
+    def __init__(self, resp: SelectResponse):
+        self._resp = resp
+
+    def next(self):
+        r, self._resp = self._resp, None
+        return r
+
+
+class TpuClient(kv.Client):
+    def __init__(self, store, mesh=None):
+        self.store = store
+        self.cpu = LocalClient(store)
+        self.mesh = mesh            # parallel.CoprMesh for multi-chip
+        self._batch_cache: dict = {}
+        self._fn_cache: dict = {}
+        self.stats = {"tpu_requests": 0, "cpu_fallbacks": 0,
+                      "batch_packs": 0, "batch_hits": 0}
+
+    # ------------------------------------------------------------------
+    # capability probe: optimistic structural check; send() falls back on
+    # lowering failure, so parity never depends on the probe being exact
+    # ------------------------------------------------------------------
+
+    def support_request_type(self, req_type: int, sub_type) -> bool:
+        if req_type == kv.REQ_TYPE_INDEX:
+            return self.cpu.support_request_type(req_type, sub_type)
+        if req_type != kv.REQ_TYPE_SELECT:
+            return False
+        if isinstance(sub_type, Expr):
+            from tidb_tpu.copr.proto import AGG_TYPES
+            if sub_type.tp in AGG_TYPES:
+                name = AGG_NAME[sub_type.tp]
+                if sub_type.distinct:
+                    # global (request-wide) aggregation makes distinct exact
+                    return name == "count"
+                return name in ("count", "sum", "avg", "min", "max",
+                                "first_row")
+            return self.cpu.support_request_type(req_type, sub_type)
+        return sub_type in (kv.REQ_SUB_TYPE_BASIC, kv.REQ_SUB_TYPE_DESC,
+                            kv.REQ_SUB_TYPE_GROUP_BY, kv.REQ_SUB_TYPE_TOPN)
+
+    # ------------------------------------------------------------------
+
+    def send(self, req: kv.Request) -> kv.Response:
+        sel: SelectRequest = req.data
+        if req.tp != kv.REQ_TYPE_SELECT or sel.table_info is None:
+            self.stats["cpu_fallbacks"] += 1
+            return self.cpu.send(req)
+        try:
+            resp = self._send_tpu(req, sel)
+            self.stats["tpu_requests"] += 1
+            return _SingleResponse(resp)
+        except Unsupported:
+            self.stats["cpu_fallbacks"] += 1
+            return self.cpu.send(req)
+
+    # ------------------------------------------------------------------
+
+    _uid_gen = __import__("itertools").count(1)
+
+    def _get_batch(self, sel: SelectRequest, ranges) -> col.ColumnBatch:
+        cols = sel.table_info.columns
+        version = self.store.data_version_at(sel.start_ts)
+        key = (sel.table_info.table_id,
+               tuple(c.column_id for c in cols),
+               tuple((r.start, r.end) for r in ranges),
+               version)
+        batch = self._batch_cache.get(key)
+        if batch is None:
+            snapshot = self.store.get_snapshot(sel.start_ts)
+            defaults = {c.column_id: c.default_val for c in cols
+                        if c.default_val is not None}
+            batch = col.pack_ranges(snapshot, sel.table_info.table_id, cols,
+                                    ranges, defaults)
+            batch._uid = next(self._uid_gen)
+            self._batch_cache[key] = batch
+            self.stats["batch_packs"] += 1
+            if len(self._batch_cache) > 64:
+                self._batch_cache.pop(next(iter(self._batch_cache)))
+        else:
+            self.stats["batch_hits"] += 1
+        return batch
+
+    def _send_tpu(self, req: kv.Request, sel: SelectRequest) -> SelectResponse:
+        if sel.having is not None:
+            raise Unsupported("having not lowered")
+        batch = self._get_batch(sel, req.key_ranges)
+        # per-request decode tables for datum reconstruction
+        self._col_pb = {c.column_id: c for c in sel.table_info.columns}
+        self._dict_for = {cid: cd.dictionary
+                          for cid, cd in batch.columns.items()
+                          if cd.kind == col.K_STR}
+        where = compile_expr(sel.where, batch) if sel.where is not None \
+            else None
+
+        if sel.is_agg():
+            return self._run_aggregate(sel, batch, where)
+        if sel.order_by:
+            return self._run_topn(sel, batch, where)
+        return self._run_filter(sel, batch, where, req)
+
+    # ------------------------------------------------------------------
+    # aggregation
+    # ------------------------------------------------------------------
+
+    def _kernel(self, sel, batch, kind: str, build):
+        """Compiled-kernel cache: one traced+jitted callable per (batch,
+        request-shape) signature — repeat queries skip tracing entirely."""
+        key = (kind, batch._uid, repr(sel.where), repr(sel.aggregates),
+               repr(sel.group_by), repr(sel.order_by), sel.limit, sel.desc)
+        ent = self._fn_cache.get(key)
+        if ent is None:
+            import jax
+            fn = build()
+            wrapper = kernels.pack_outputs(fn)
+            ent = (fn, wrapper, jax.jit(wrapper))
+            self._fn_cache[key] = ent
+            if len(self._fn_cache) > 256:
+                self._fn_cache.pop(next(iter(self._fn_cache)))
+        return ent
+
+    def _run_aggregate(self, sel, batch, where) -> SelectResponse:
+        specs = kernels.lower_aggregates(sel, batch)
+        planes = kernels.batch_planes(batch)
+        live = np.zeros(batch.capacity, dtype=bool)
+        live[: batch.n_rows] = True
+
+        if sel.group_by:
+            gcids, gsizes = kernels.lower_group_by(sel, batch)
+            fn, wrapper, jitted = self._kernel(
+                sel, batch, "grouped",
+                lambda: kernels.build_grouped_agg_fn(where, specs, gcids,
+                                                     gsizes))
+            if self.mesh is not None:
+                outs = [np.asarray(o)
+                        for o in self.mesh.run_grouped(fn, planes, live)]
+            else:
+                i_arr, f_arr = jitted(planes, live)
+                outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
+                                              np.asarray(f_arr))
+            return self._emit_grouped(sel, batch, specs, gcids, gsizes,
+                                      fn.radices, outs)
+        fn, wrapper, jitted = self._kernel(
+            sel, batch, "scalar",
+            lambda: kernels.build_scalar_agg_fn(where, specs, batch.n_rows))
+        if self.mesh is not None:
+            outs = [np.asarray(o)
+                    for o in self.mesh.run_scalar(fn, planes, live)]
+        else:
+            i_arr, f_arr = jitted(planes, live)
+            outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
+                                          np.asarray(f_arr))
+        return self._emit_scalar(sel, batch, specs, outs)
+
+    def _emit_scalar(self, sel, batch, specs, outs) -> SelectResponse:
+        writer = ChunkWriter()
+        row: list[Datum] = [Datum.bytes_(b"")]
+        i = 0
+        for spec, e in zip(specs, sel.aggregates):
+            row.extend(self._partial_datums(spec, e, outs, i, None))
+            i += _n_outputs(spec)
+        writer.append_row(0, row)
+        return SelectResponse(chunks=writer.finish())
+
+    def _emit_grouped(self, sel, batch, specs, gcids, gsizes, radices,
+                      outs) -> SelectResponse:
+        writer = ChunkWriter()
+        row_count = outs[0]
+        n_segments = row_count.shape[0]
+        live_gids = [g for g in range(n_segments - 1) if row_count[g] > 0]
+        dicts = [batch.columns[cid].dictionary for cid in gcids]
+        for gid in live_gids:
+            # decode mixed-radix gid → per-column codes
+            codes = []
+            rem = gid
+            for radix in reversed(radices):
+                codes.append(rem % radix)
+                rem //= radix
+            codes.reverse()
+            gvals = []
+            for code, size, d in zip(codes, gsizes, dicts):
+                gvals.append(NULL if code >= size
+                             else Datum.bytes_(d[code]))
+            gk = codec.encode_value(gvals)
+            row: list[Datum] = [Datum.bytes_(gk)]
+            i = 1  # outs[0] is row_count
+            for spec, e in zip(specs, sel.aggregates):
+                row.extend(self._partial_datums(spec, e, outs, i, gid))
+                i += _n_outputs(spec)
+            writer.append_row(0, row)
+        return SelectResponse(chunks=writer.finish())
+
+    def _partial_datums(self, spec, agg_expr, outs, i, gid) -> list[Datum]:
+        """Partial-row slice for one aggregate, layout-compatible with
+        AggregationFunction.get_partial_result."""
+        def at(j):
+            v = outs[j]
+            return v if gid is None else v[gid]
+
+        name = spec.name
+        if name == "count":
+            return [Datum.i64(int(at(i)))]
+        n = int(at(i))
+        v = at(i + 1)
+        if name in ("sum", "avg"):
+            if n == 0:
+                val = NULL
+            elif isinstance(v, np.floating) or \
+                    (hasattr(v, "dtype") and v.dtype.kind == "f"):
+                val = Datum.f64(float(v))
+            else:
+                val = Datum.dec(Decimal(int(v)))
+            return [Datum.i64(n), val] if name == "avg" else [val]
+        if name in ("min", "max", "first_row"):
+            if n == 0:
+                return [NULL]
+            return [self._phys_to_datum(agg_expr, v)]
+        raise Unsupported(name)
+
+    def _phys_to_datum(self, agg_expr, v) -> Datum:
+        """Physical kernel value → Datum, reversing columnar.datum_to_phys
+        using the aggregate argument's column type."""
+        arg = agg_expr.children[0] if agg_expr.children else None
+        tp = None
+        if arg is not None and arg.tp == ExprType.COLUMN_REF:
+            pb = self._col_pb.get(arg.val)
+            tp = pb.tp if pb is not None else None
+        if hasattr(v, "dtype") and v.dtype.kind == "f":
+            return Datum.f64(float(v))
+        iv = int(v)
+        if tp in my.TIME_TYPES:
+            return Datum(Kind.TIME, _number_to_time(iv, tp))
+        if tp == my.TypeDuration:
+            from tidb_tpu.types.time_types import Duration
+            return Datum(Kind.DURATION, Duration(iv))
+        if tp in my.STRING_TYPES:
+            # min/max over dict codes: decode via the arg column dictionary
+            d = self._dict_for.get(arg.val)
+            return Datum.bytes_(d[iv]) if d is not None and 0 <= iv < len(d) \
+                else NULL
+        return Datum.i64(iv)
+
+    # ------------------------------------------------------------------
+    # filter / topn
+    # ------------------------------------------------------------------
+
+    def _run_filter(self, sel, batch, where, req) -> SelectResponse:
+        _, wrapper, jitted = self._kernel(sel, batch, "filter",
+                                          lambda: kernels.build_filter_fn(where))
+        planes = kernels.batch_planes(batch)
+        live = np.zeros(batch.capacity, dtype=bool)
+        live[: batch.n_rows] = True
+        i_arr, f_arr = jitted(planes, live)
+        (mask_out,) = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
+                                             np.asarray(f_arr))
+        mask = mask_out.astype(bool)
+        idx = np.nonzero(mask)[0]
+        if sel.desc:
+            idx = idx[::-1]
+        if sel.limit is not None:
+            idx = idx[: sel.limit]
+        return self._emit_rows(sel, batch, idx)
+
+    def _run_topn(self, sel, batch, where) -> SelectResponse:
+        import jax
+        if len(sel.order_by) != 1 or sel.limit is None:
+            raise Unsupported("topn lowering needs 1 key + limit")
+        key = compile_expr(sel.order_by[0].expr, batch)
+        k = min(sel.limit, batch.capacity)
+        _, wrapper, jitted = self._kernel(
+            sel, batch, "topn",
+            lambda: kernels.build_topn_fn(where, key, sel.order_by[0].desc, k))
+        planes = kernels.batch_planes(batch)
+        live = np.zeros(batch.capacity, dtype=bool)
+        live[: batch.n_rows] = True
+        i_arr, f_arr = jitted(planes, live)
+        idx_out, n_live = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
+                                                 np.asarray(f_arr))
+        idx = np.asarray(idx_out)[: int(n_live)]
+        return self._emit_rows(sel, batch, idx)
+
+    def _emit_rows(self, sel, batch, idx) -> SelectResponse:
+        writer = ChunkWriter()
+        cols = sel.table_info.columns
+        planes = {cid: cd for cid, cd in batch.columns.items()}
+        for i in idx:
+            row = []
+            for c in cols:
+                cd = planes[c.column_id]
+                if not cd.valid[i]:
+                    row.append(NULL)
+                elif cd.kind == col.K_STR:
+                    row.append(Datum.bytes_(cd.dictionary[int(cd.values[i])]))
+                elif cd.kind == col.K_F64:
+                    row.append(Datum.f64(float(cd.values[i])))
+                else:
+                    v = int(cd.values[i])
+                    if c.tp in my.TIME_TYPES:
+                        row.append(Datum(Kind.TIME, _number_to_time(v, c.tp)))
+                    elif c.tp == my.TypeDuration:
+                        from tidb_tpu.types.time_types import Duration
+                        row.append(Datum(Kind.DURATION, Duration(v)))
+                    else:
+                        row.append(Datum.i64(v))
+            writer.append_row(int(batch.handles[i]), row)
+        return SelectResponse(chunks=writer.finish())
+
+    # populated per-request by _send_tpu for datum reconstruction
+    _col_pb: dict = {}
+    _dict_for: dict = {}
+
+
+def _number_to_time(v: int, tp: int):
+    """Inverse of the time plane encoding (Time.from_packed_int)."""
+    from tidb_tpu.types.time_types import Time
+    return Time.from_packed_int(v, tp)

@@ -1,0 +1,194 @@
+"""Columnar batches: the TPU coprocessor's in-memory data format.
+
+The CPU engine (copr.region_handler) interprets rows one at a time; the TPU
+engine packs each region-range scan into column arrays once — values plane +
+validity plane per column, strings dictionary-encoded, temporals as ordered
+int64 — and evaluates requests as vectorized kernels over the planes.
+
+Pack shapes are padded to power-of-two buckets so XLA compiles one kernel
+per bucket instead of one per row-count (SURVEY §7 "pad-to-bucket").
+
+Design notes (TPU-first):
+- values: int64 / float64 planes map directly onto VPU lanes; no row decode
+  on device, ever.
+- strings: batch-local ORDERED dictionary (sorted unique bytes), so =, <,
+  IN, and prefix-LIKE lower to integer compares on codes (binary collation
+  order is preserved by construction).
+- NULLs: separate bool validity plane per column; three-valued logic stays
+  vectorized (see ops.exprc).
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+
+import numpy as np
+
+from tidb_tpu import errors, tablecodec as tc
+from tidb_tpu.copr.proto import PBColumnInfo
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import Kind, NULL
+from tidb_tpu import mysqldef as my
+
+I64_MIN = -(1 << 63)
+
+# column physical kinds
+K_I64 = "i64"     # ints, times (to_number), durations (nanos), bools
+K_F64 = "f64"
+K_STR = "str"     # dictionary codes (int32) + ordered dictionary
+
+
+@dataclass
+class ColumnData:
+    kind: str
+    values: np.ndarray            # i64/f64 plane, or int64 codes for K_STR
+    valid: np.ndarray             # bool plane
+    dictionary: list[bytes] | None = None  # K_STR: sorted code → bytes
+    tp: int = 0                   # MySQL type byte (time/duration decode)
+
+    def code_of(self, b: bytes) -> int:
+        """Exact-match dictionary code, or -1."""
+        i = bisect.bisect_left(self.dictionary, b)
+        if i < len(self.dictionary) and self.dictionary[i] == b:
+            return i
+        return -1
+
+    def lower_bound(self, b: bytes) -> int:
+        """#codes strictly below b (for <, >=, prefix ranges)."""
+        return bisect.bisect_left(self.dictionary, b)
+
+    def upper_bound(self, b: bytes) -> int:
+        return bisect.bisect_right(self.dictionary, b)
+
+
+@dataclass
+class ColumnBatch:
+    n_rows: int                   # live rows
+    capacity: int                 # padded length of every plane
+    handles: np.ndarray           # int64; padding rows hold I64_MIN
+    columns: dict[int, ColumnData]  # column_id → planes
+
+    def row_mask(self) -> np.ndarray:
+        m = np.zeros(self.capacity, dtype=bool)
+        m[: self.n_rows] = True
+        return m
+
+
+def bucket_capacity(n: int, minimum: int = 1024) -> int:
+    c = minimum
+    while c < n:
+        c <<= 1
+    return c
+
+
+def column_phys_kind(col: PBColumnInfo) -> str:
+    tp = col.tp
+    if tp in my.INTEGER_TYPES or tp == my.TypeBit:
+        return K_I64
+    if tp in my.FLOAT_TYPES:
+        return K_F64
+    if tp in my.TIME_TYPES or tp == my.TypeDuration:
+        return K_I64
+    if tp in my.STRING_TYPES:
+        return K_STR
+    # decimals and exotics stay on the CPU engine (capability probe rejects)
+    raise errors.TypeError_(f"no columnar mapping for type 0x{tp:02x}")
+
+
+def datum_to_phys(d: Datum, kind: str):
+    """Datum → (physical value, is_valid). Temporal ordering uses
+    Time.to_number()/Duration nanos — monotonic, so compares carry over."""
+    if d.is_null():
+        return 0, False
+    k = d.kind
+    if kind == K_I64:
+        if k in (Kind.INT64, Kind.UINT64):
+            return int(d.val), True
+        if k == Kind.TIME:
+            # packed int is order-preserving and uniform across DATE /
+            # DATETIME (Time.to_packed_int) — to_number is not
+            return int(d.val.to_packed_int()), True
+        if k == Kind.DURATION:
+            return int(d.val.nanos), True
+        if k == Kind.FLOAT64:
+            return int(d.val), True
+        if k == Kind.DECIMAL:
+            return int(d.val), True
+    elif kind == K_F64:
+        return float(d.as_number()), True
+    elif kind == K_STR:
+        return d.get_bytes(), True
+    raise errors.TypeError_(f"cannot pack {d!r} as {kind}")
+
+
+def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
+                ranges, fill_defaults: dict[int, Datum] | None = None
+                ) -> ColumnBatch:
+    """Scan+decode [start,end) row ranges into a ColumnBatch.
+
+    This is the host-side decode the C++ packer will replace; the output
+    layout is the contract, not the loop.
+    """
+    col_kinds = {c.column_id: column_phys_kind(c) for c in columns}
+    pk_col = next((c for c in columns if c.pk_handle), None)
+    defaults = fill_defaults or {}
+
+    handles: list[int] = []
+    raw: dict[int, list] = {c.column_id: [] for c in columns}
+    valid: dict[int, list] = {c.column_id: [] for c in columns}
+
+    for rg in ranges:
+        for key, value in snapshot.iterate(rg.start, rg.end):
+            try:
+                _, handle = tc.decode_row_key(key)
+            except errors.TiDBError:
+                continue
+            row = tc.decode_row(value)
+            handles.append(handle)
+            for c in columns:
+                cid = c.column_id
+                if pk_col is not None and cid == pk_col.column_id:
+                    raw[cid].append(handle)
+                    valid[cid].append(True)
+                    continue
+                d = row.get(cid)
+                if d is None:
+                    d = defaults.get(cid, NULL)
+                v, ok = datum_to_phys(d, col_kinds[cid])
+                raw[cid].append(v)
+                valid[cid].append(ok)
+
+    n = len(handles)
+    cap = bucket_capacity(n)
+    h = np.full(cap, I64_MIN, dtype=np.int64)
+    h[:n] = handles
+    cols: dict[int, ColumnData] = {}
+    for c in columns:
+        cid = c.column_id
+        kind = col_kinds[cid]
+        va = np.zeros(cap, dtype=bool)
+        va[:n] = valid[cid]
+        if kind == K_STR:
+            cols[cid] = _pack_str_column(raw[cid], va, cap, n)
+            cols[cid].tp = c.tp
+        else:
+            dtype = np.int64 if kind == K_I64 else np.float64
+            vals = np.zeros(cap, dtype=dtype)
+            if n:
+                vals[:n] = [x if ok else 0
+                            for x, ok in zip(raw[cid], valid[cid])]
+            cols[cid] = ColumnData(kind, vals, va, tp=c.tp)
+    return ColumnBatch(n, cap, h, cols)
+
+
+def _pack_str_column(raw: list, va: np.ndarray, cap: int, n: int) -> ColumnData:
+    uniq = sorted({v for v, ok in zip(raw, va[:n]) if ok})
+    code_of = {b: i for i, b in enumerate(uniq)}
+    # int64 codes so min/max sentinels and mixed-radix group ids never
+    # overflow mid-kernel
+    codes = np.full(cap, -1, dtype=np.int64)
+    if n:
+        codes[:n] = [code_of[v] if ok else -1
+                     for v, ok in zip(raw, va[:n])]
+    return ColumnData(K_STR, codes, va, uniq)

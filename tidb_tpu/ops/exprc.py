@@ -1,0 +1,506 @@
+"""Compile pushdown Expr trees to vectorized JAX computations.
+
+Where the CPU engine interprets a tipb-style Expr per row
+(copr/xeval.py), this module lowers the same tree ONCE into array ops over
+a ColumnBatch's (values, valid) planes — the compiled form is traced under
+jit, fuses with the aggregation kernels, and runs on the MXU/VPU.
+
+Value model: every sub-expression evaluates to (values, valid) — the
+validity plane implements SQL three-valued logic without branches:
+    AND: false dominates NULL;  OR: true dominates NULL;
+    comparisons/arithmetic propagate NULL via valid = va & vb.
+
+String semantics ride the ordered dictionary (ops.columnar): =, <, IN and
+prefix-LIKE become integer compares against host-precomputed codes; general
+LIKE evaluates the pattern over the (small) dictionary on host and becomes
+a boolean gather. Unsupported shapes raise Unsupported — the TpuClient's
+capability probe turns that into "keep it on the SQL side / CPU engine".
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tidb_tpu import errors
+from tidb_tpu.copr.proto import Expr, ExprType
+from tidb_tpu.expression import ops as xops
+from tidb_tpu.ops import columnar as col
+from tidb_tpu.sqlast.opcode import Op
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import Kind
+
+
+class Unsupported(errors.TiDBError):
+    """Expr shape the TPU engine can't lower; request stays on CPU/SQL."""
+
+
+class CompiledExpr:
+    """A lowered expression: call with {col_id: (values, valid)} device
+    planes → (values, valid) arrays. `batch` supplies dictionaries and
+    column kinds at lowering time (host-side constant folding)."""
+
+    def __init__(self, fn, kind: str):
+        self.fn = fn
+        self.kind = kind  # result physical kind: i64 / f64 / bool
+
+    def __call__(self, planes):
+        return self.fn(planes)
+
+
+def compile_expr(e: Expr, batch: col.ColumnBatch) -> CompiledExpr:
+    tp = e.tp
+
+    if tp == ExprType.VALUE:
+        return _const(e.val)
+    if tp == ExprType.NULL:
+        return CompiledExpr(lambda planes: (jnp.int64(0), jnp.bool_(False)),
+                            col.K_I64)
+    if tp == ExprType.COLUMN_REF:
+        cid = e.val
+        cd = batch.columns.get(cid)
+        if cd is None:
+            raise Unsupported(f"column {cid} not packed")
+        kind = cd.kind
+        return CompiledExpr(lambda planes: planes[cid],
+                            col.K_I64 if kind == col.K_STR else kind)
+    if tp == ExprType.OPERATOR:
+        return _compile_operator(e, batch)
+    if tp in (ExprType.IN, ExprType.NOT_IN):
+        return _compile_in(e, batch, negated=(tp == ExprType.NOT_IN))
+    if tp in (ExprType.LIKE, ExprType.NOT_LIKE):
+        return _compile_like(e, batch, negated=(tp == ExprType.NOT_LIKE))
+    if tp == ExprType.IS_NULL:
+        c = compile_expr(e.children[0], batch)
+
+        def is_null(planes, c=c):
+            _, va = c(planes)
+            return jnp.logical_not(va), jnp.bool_(True)
+        return CompiledExpr(_bcast2(is_null), "bool")
+    if tp == ExprType.IS_NOT_NULL:
+        c = compile_expr(e.children[0], batch)
+
+        def is_not_null(planes, c=c):
+            _, va = c(planes)
+            return va, jnp.bool_(True)
+        return CompiledExpr(_bcast2(is_not_null), "bool")
+    if tp == ExprType.IF:
+        return _compile_if(e, batch)
+    if tp == ExprType.IFNULL:
+        a = compile_expr(e.children[0], batch)
+        b = compile_expr(e.children[1], batch)
+        kind = _merge_kind(a.kind, b.kind)
+
+        def ifnull(planes, a=a, b=b):
+            av, aa = a(planes)
+            bv, bb = b(planes)
+            av, bv = _promote(av, bv, kind)
+            return jnp.where(aa, av, bv), jnp.where(aa, aa, bb)
+        return CompiledExpr(ifnull, kind)
+    raise Unsupported(f"expr type {tp!r} has no TPU lowering")
+
+
+# ---------------------------------------------------------------------------
+# leaves / helpers
+# ---------------------------------------------------------------------------
+
+def _const(d: Datum) -> CompiledExpr:
+    if d.is_null():
+        return CompiledExpr(lambda planes: (jnp.int64(0), jnp.bool_(False)),
+                            col.K_I64)
+    k = d.kind
+    if k in (Kind.INT64, Kind.UINT64):
+        v = int(d.val)
+        return CompiledExpr(lambda planes: (jnp.int64(v), jnp.bool_(True)),
+                            col.K_I64)
+    if k == Kind.FLOAT64:
+        v = float(d.val)
+        return CompiledExpr(lambda planes: (jnp.float64(v), jnp.bool_(True)),
+                            col.K_F64)
+    if k == Kind.DECIMAL:
+        v = float(d.val)
+        return CompiledExpr(lambda planes: (jnp.float64(v), jnp.bool_(True)),
+                            col.K_F64)
+    if k == Kind.TIME:
+        v = int(d.val.to_packed_int())  # plane encoding (columnar)
+        return CompiledExpr(lambda planes: (jnp.int64(v), jnp.bool_(True)),
+                            col.K_I64)
+    if k in (Kind.STRING, Kind.BYTES):
+        # only meaningful against a dict column; handled by comparison
+        # lowering (needs the dictionary) — flag with a marker kind
+        b = d.get_bytes()
+        ce = CompiledExpr(None, "strconst")
+        ce.str_value = b
+        return ce
+    raise Unsupported(f"constant kind {k!r}")
+
+
+def _merge_kind(a: str, b: str) -> str:
+    if "f64" in (a, b):
+        return col.K_F64
+    return col.K_I64
+
+
+def _promote(av, bv, kind: str):
+    if kind == col.K_F64:
+        return av.astype(jnp.float64) if av.dtype != jnp.float64 else av, \
+            bv.astype(jnp.float64) if bv.dtype != jnp.float64 else bv
+    return av, bv
+
+
+def _bcast2(fn):
+    return fn
+
+
+def _str_column_of(e: Expr, batch: col.ColumnBatch) -> col.ColumnData | None:
+    if e.tp == ExprType.COLUMN_REF:
+        cd = batch.columns.get(e.val)
+        if cd is not None and cd.kind == col.K_STR:
+            return cd
+    return None
+
+
+# ---------------------------------------------------------------------------
+# operators
+# ---------------------------------------------------------------------------
+
+_CMP_OPS = {Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE}
+_ARITH_OPS = {Op.Plus, Op.Minus, Op.Mul, Op.Div}
+_LOGIC_OPS = {Op.AndAnd, Op.OrOr, Op.Xor}
+
+
+def _compile_operator(e: Expr, batch: col.ColumnBatch) -> CompiledExpr:
+    op = e.op
+    if len(e.children) == 1:
+        c = compile_expr(e.children[0], batch)
+        if op in (Op.UnaryNot, Op.Not):
+            def unot(planes, c=c):
+                v, va = c(planes)
+                return jnp.logical_not(_truthy(v)), va
+            return CompiledExpr(unot, "bool")
+        if op == Op.UnaryMinus:
+            def uneg(planes, c=c):
+                v, va = c(planes)
+                return -v, va
+            return CompiledExpr(uneg, c.kind)
+        if op == Op.UnaryPlus:
+            return c
+        raise Unsupported(f"unary op {op!r}")
+
+    if op in _CMP_OPS:
+        return _compile_compare(e, batch)
+    if op in _LOGIC_OPS:
+        return _compile_logic(e, batch)
+    if op in _ARITH_OPS or op in (Op.IntDiv, Op.Mod):
+        return _compile_arith(e, batch)
+    raise Unsupported(f"binary op {op!r}")
+
+
+def _truthy(v):
+    if v.dtype == jnp.bool_:
+        return v
+    return v != 0
+
+
+def _compile_compare(e: Expr, batch) -> CompiledExpr:
+    from tidb_tpu import mysqldef as my
+    op = e.op
+    left, right = e.children
+    # string constant vs TEMPORAL column: coerce the constant to the
+    # column's plane encoding (MySQL date-string coercion; the Q6 shape
+    # `l_shipdate <= '1998-09-02'`)
+    children = [left, right]
+    for i, (a, b) in enumerate(((left, right), (right, left))):
+        if a.tp == ExprType.COLUMN_REF and b.tp == ExprType.VALUE \
+                and not b.val.is_null() \
+                and b.val.kind in (Kind.STRING, Kind.BYTES):
+            cd = batch.columns.get(a.val)
+            if cd is not None and cd.kind == col.K_I64 \
+                    and cd.tp in my.TIME_TYPES:
+                from tidb_tpu.types.time_types import parse_time
+                try:
+                    t = parse_time(b.val.get_string())
+                except Exception:
+                    raise Unsupported("unparseable date constant")
+                children[1 - i] = Expr(ExprType.VALUE, val=Datum.i64(
+                    t.to_packed_int()))
+    left, right = children
+    e = Expr(e.tp, op=op, children=[left, right])
+    # string column vs string constant → code-space compare
+    for a, b, flip in ((left, right, False), (right, left, True)):
+        cd = _str_column_of(a, batch)
+        if cd is not None and b.tp == ExprType.VALUE \
+                and not b.val.is_null() \
+                and b.val.kind in (Kind.STRING, Kind.BYTES):
+            return _compile_str_cmp(a, cd, b.val.get_bytes(),
+                                    _flip_op(op) if flip else op, batch)
+    ca = compile_expr(left, batch)
+    cb = compile_expr(right, batch)
+    if "strconst" in (ca.kind, cb.kind):
+        raise Unsupported("string comparison without a dict column")
+    str_a = _str_column_of(left, batch)
+    str_b = _str_column_of(right, batch)
+    if (str_a is None) != (str_b is None):
+        raise Unsupported("mixed string/non-string comparison")
+    if str_a is not None and str_b is not None:
+        raise Unsupported("column-column string compare needs shared dict")
+    kind = _merge_kind(ca.kind, cb.kind)
+
+    def cmp(planes, ca=ca, cb=cb, op=op, kind=kind):
+        av, aa = ca(planes)
+        bv, bb = cb(planes)
+        av, bv = _promote(av, bv, kind)
+        return _cmp_arrays(op, av, bv), aa & bb
+    return CompiledExpr(cmp, "bool")
+
+
+def _flip_op(op: Op) -> Op:
+    return {Op.LT: Op.GT, Op.LE: Op.GE, Op.GT: Op.LT, Op.GE: Op.LE,
+            Op.EQ: Op.EQ, Op.NE: Op.NE}[op]
+
+
+def _cmp_arrays(op: Op, a, b):
+    if op == Op.EQ:
+        return a == b
+    if op == Op.NE:
+        return a != b
+    if op == Op.LT:
+        return a < b
+    if op == Op.LE:
+        return a <= b
+    if op == Op.GT:
+        return a > b
+    return a >= b
+
+
+def _compile_str_cmp(col_expr: Expr, cd: col.ColumnData, const: bytes,
+                     op: Op, batch) -> CompiledExpr:
+    cid = col_expr.val
+    if op == Op.EQ:
+        code = cd.code_of(const)
+
+        def eq(planes, cid=cid, code=code):
+            codes, va = planes[cid]
+            return codes == code if code >= 0 \
+                else jnp.zeros_like(va), va
+        return CompiledExpr(eq, "bool")
+    if op == Op.NE:
+        code = cd.code_of(const)
+
+        def ne(planes, cid=cid, code=code):
+            codes, va = planes[cid]
+            return codes != code if code >= 0 \
+                else jnp.ones_like(va), va
+        return CompiledExpr(ne, "bool")
+    # ordered compares via dictionary bounds (codes are sorted by bytes)
+    lb = cd.lower_bound(const)   # #entries < const
+    ub = cd.upper_bound(const)   # #entries <= const
+
+    def ordcmp(planes, cid=cid, op=op, lb=lb, ub=ub):
+        codes, va = planes[cid]
+        if op == Op.LT:
+            return codes < lb, va
+        if op == Op.LE:
+            return codes < ub, va
+        if op == Op.GT:
+            return codes >= ub, va
+        return codes >= lb, va   # GE
+    return CompiledExpr(ordcmp, "bool")
+
+
+def _compile_logic(e: Expr, batch) -> CompiledExpr:
+    op = e.op
+    ca = compile_expr(e.children[0], batch)
+    cb = compile_expr(e.children[1], batch)
+
+    def logic(planes, ca=ca, cb=cb, op=op):
+        av, aa = ca(planes)
+        bv, bb = cb(planes)
+        at, bt = _truthy(av), _truthy(bv)
+        if op == Op.AndAnd:
+            val = at & bt
+            valid = (aa & bb) | (aa & ~at) | (bb & ~bt)
+        elif op == Op.OrOr:
+            val = at | bt
+            valid = (aa & bb) | (aa & at) | (bb & bt)
+        else:  # Xor
+            val = at ^ bt
+            valid = aa & bb
+        return val, valid
+    return CompiledExpr(logic, "bool")
+
+
+def _compile_arith(e: Expr, batch) -> CompiledExpr:
+    op = e.op
+    ca = compile_expr(e.children[0], batch)
+    cb = compile_expr(e.children[1], batch)
+    if "strconst" in (ca.kind, cb.kind):
+        raise Unsupported("arithmetic on string constant")
+    kind = col.K_F64 if (op == Op.Div or col.K_F64 in (ca.kind, cb.kind)) \
+        else col.K_I64
+
+    def arith(planes, ca=ca, cb=cb, op=op, kind=kind):
+        av, aa = ca(planes)
+        bv, bb = cb(planes)
+        av, bv = _promote(av, bv, kind)
+        valid = aa & bb
+        if op == Op.Plus:
+            return av + bv, valid
+        if op == Op.Minus:
+            return av - bv, valid
+        if op == Op.Mul:
+            return av * bv, valid
+        if op == Op.Div:
+            zero = bv == 0
+            safe = jnp.where(zero, jnp.ones_like(bv), bv)
+            return av / safe, valid & ~zero
+        if op == Op.IntDiv:
+            zero = bv == 0
+            safe = jnp.where(zero, jnp.ones_like(bv), bv)
+            q = jnp.trunc(av / safe) if kind == col.K_F64 \
+                else jnp.sign(av) * jnp.sign(safe) * (jnp.abs(av) // jnp.abs(safe))
+            return q.astype(jnp.int64), valid & ~zero
+        # Mod: sign of dividend (Go/MySQL)
+        zero = bv == 0
+        safe = jnp.where(zero, jnp.ones_like(bv), bv)
+        r = jnp.sign(av) * (jnp.abs(av) % jnp.abs(safe))
+        return r, valid & ~zero
+    return CompiledExpr(arith, kind)
+
+
+def _compile_in(e: Expr, batch, negated: bool) -> CompiledExpr:
+    target = e.children[0]
+    items = e.children[1:]
+    cd = _str_column_of(target, batch)
+    if cd is not None:
+        codes = []
+        has_null = False
+        for it in items:
+            if it.tp != ExprType.VALUE:
+                raise Unsupported("non-constant IN item")
+            if it.val.is_null():
+                has_null = True
+                continue
+            codes.append(cd.code_of(it.val.get_bytes()))
+        cid = target.val
+        code_arr = jnp.asarray([c for c in codes], dtype=jnp.int32) \
+            if codes else jnp.asarray([-2], dtype=jnp.int32)
+
+        def str_in(planes, cid=cid, code_arr=code_arr, has_null=has_null,
+                   negated=negated):
+            cvals, va = planes[cid]
+            hit = jnp.any(cvals[:, None] == code_arr[None, :], axis=1)
+            val = ~hit if negated else hit
+            # no match + NULL in list → NULL
+            valid = va & (hit | jnp.bool_(not has_null))
+            return val, valid
+        return CompiledExpr(str_in, "bool")
+
+    ct = compile_expr(target, batch)
+    consts = []
+    has_null = False
+    kind = ct.kind
+    for it in items:
+        if it.tp != ExprType.VALUE:
+            raise Unsupported("non-constant IN item")
+        if it.val.is_null():
+            has_null = True
+            continue
+        v = it.val.as_number()
+        if isinstance(v, float):
+            kind = col.K_F64
+        consts.append(v)
+    arr = jnp.asarray(consts, dtype=jnp.float64 if kind == col.K_F64
+                      else jnp.int64) if consts \
+        else jnp.asarray([], dtype=jnp.int64)
+
+    def num_in(planes, ct=ct, arr=arr, has_null=has_null, negated=negated,
+               kind=kind):
+        v, va = ct(planes)
+        if kind == col.K_F64 and v.dtype != jnp.float64:
+            v = v.astype(jnp.float64)
+        if arr.size:
+            hit = jnp.any(v[:, None] == arr[None, :], axis=1)
+        else:
+            hit = jnp.zeros_like(va)
+        val = ~hit if negated else hit
+        valid = va & (hit | jnp.bool_(not has_null))
+        return val, valid
+    return CompiledExpr(num_in, "bool")
+
+
+def _compile_like(e: Expr, batch, negated: bool) -> CompiledExpr:
+    target, pattern = e.children[0], e.children[1]
+    cd = _str_column_of(target, batch)
+    if cd is None or pattern.tp != ExprType.VALUE:
+        raise Unsupported("LIKE needs dict column + constant pattern")
+    escape = e.val if isinstance(e.val, str) else "\\"
+    pat = pattern.val
+    # evaluate the pattern over the dictionary on host → boolean LUT
+    import numpy as np
+    from tidb_tpu.types.datum import Datum as D
+    lut_host = np.zeros(max(len(cd.dictionary), 1), dtype=bool)
+    for i, b in enumerate(cd.dictionary):
+        m = xops.compute_like(D.bytes_(b), pat, escape)
+        lut_host[i] = (not m.is_null()) and m.val == 1
+    lut = jnp.asarray(lut_host)
+    cid = target.val
+
+    def like(planes, cid=cid, lut=lut, negated=negated):
+        codes, va = planes[cid]
+        safe = jnp.clip(codes, 0, lut.shape[0] - 1)
+        hit = lut[safe]
+        return (~hit if negated else hit), va
+    return CompiledExpr(like, "bool")
+
+
+def _compile_if(e: Expr, batch) -> CompiledExpr:
+    cc = compile_expr(e.children[0], batch)
+    ca = compile_expr(e.children[1], batch)
+    cb = compile_expr(e.children[2], batch)
+    kind = _merge_kind(ca.kind, cb.kind)
+
+    def if_(planes, cc=cc, ca=ca, cb=cb, kind=kind):
+        cv, cva = cc(planes)
+        cond = _truthy(cv) & cva
+        av, aa = ca(planes)
+        bv, bb = cb(planes)
+        av, bv = _promote(av, bv, kind)
+        return jnp.where(cond, av, bv), jnp.where(cond, aa, bb)
+    return CompiledExpr(if_, kind)
+
+
+def supported_for_tpu(e: Expr, columns_by_id: dict[int, str]) -> bool:
+    """Static capability probe (no batch needed): can this Expr lower?
+    columns_by_id maps column_id → physical kind. Used by TpuClient's
+    support_request_type — mirrors xeval.supported_expr on the CPU side."""
+    tp = e.tp
+    if tp in (ExprType.VALUE, ExprType.NULL):
+        if tp == ExprType.VALUE and e.val is not None \
+                and not isinstance(e.val, Datum):
+            return False
+        if tp == ExprType.VALUE and e.val is not None \
+                and e.val.kind == Kind.DECIMAL:
+            return True
+        return True
+    if tp == ExprType.COLUMN_REF:
+        return e.val in columns_by_id
+    if tp == ExprType.OPERATOR:
+        if len(e.children) == 1:
+            ok_ops = (Op.UnaryNot, Op.Not, Op.UnaryMinus, Op.UnaryPlus)
+        else:
+            ok_ops = tuple(_CMP_OPS | _LOGIC_OPS | _ARITH_OPS
+                           | {Op.IntDiv, Op.Mod})
+        return e.op in ok_ops and all(
+            supported_for_tpu(c, columns_by_id) for c in e.children)
+    if tp in (ExprType.IN, ExprType.NOT_IN):
+        return (supported_for_tpu(e.children[0], columns_by_id)
+                and all(c.tp == ExprType.VALUE for c in e.children[1:]))
+    if tp in (ExprType.LIKE, ExprType.NOT_LIKE):
+        t = e.children[0]
+        return (t.tp == ExprType.COLUMN_REF
+                and columns_by_id.get(t.val) == col.K_STR
+                and e.children[1].tp == ExprType.VALUE)
+    if tp in (ExprType.IS_NULL, ExprType.IS_NOT_NULL, ExprType.IF,
+              ExprType.IFNULL):
+        return all(supported_for_tpu(c, columns_by_id) for c in e.children)
+    return False

@@ -1,0 +1,341 @@
+"""TPU aggregation/filter kernels over columnar batches.
+
+The device-side half of the coprocessor: one jitted function per request
+shape evaluates the pushed filter and all aggregates in a single fused XLA
+computation — the whole thing is a handful of masked reductions (VPU) and
+segment-sums (scatter-adds), so XLA fuses filter+agg into one pass over HBM.
+
+Group-by strategy (XLA-idiomatic, no hash tables): group columns are
+dictionary codes, the combined group id is a mixed-radix code over the
+dict sizes, and every aggregate is a `segment_sum`-family reduction with a
+STATIC segment count (padded to a bucket) — no dynamic shapes, no
+recompiles per batch (SURVEY §7 "sort+segment-reduce route").
+
+Multi-chip: the same kernels run under shard_map with rows sharded across
+the mesh; partial aggregates combine with lax.psum over ICI — see
+tidb_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.copr.proto import AGG_NAME, Expr, ExprType, SelectRequest
+from tidb_tpu.ops import columnar as col
+from tidb_tpu.ops.exprc import CompiledExpr, Unsupported, compile_expr
+
+F64_MAX = jnp.finfo(jnp.float64).max
+I64_MAX = (1 << 63) - 1
+I64_MIN = -(1 << 63)
+
+
+def pack_outputs(fn):
+    """Wrap a kernel so it returns (int64_stack, f64_stack) instead of a
+    tuple of per-aggregate results — ONE device→host transfer per dtype
+    per query instead of one per output. On tunneled platforms (axon) each
+    D2H costs a full round trip, so this dominates small-query latency.
+
+    The wrapper's .layout (populated at trace time) maps original output
+    index → ('i'|'f', row) in the stacked arrays."""
+    layout: list = []
+
+    def fn2(planes, live):
+        layout.clear()
+        outs = fn(planes, live)
+        ints, floats = [], []
+        i_off = f_off = 0
+        for o in outs:
+            o = jnp.atleast_1d(o)
+            flat = o.reshape(-1)
+            if o.dtype == jnp.float64:
+                layout.append(("f", f_off, flat.shape[0]))
+                floats.append(flat)
+                f_off += flat.shape[0]
+            else:
+                layout.append(("i", i_off, flat.shape[0]))
+                ints.append(flat.astype(jnp.int64))
+                i_off += flat.shape[0]
+        i_arr = jnp.concatenate(ints) if ints else jnp.zeros(0, jnp.int64)
+        f_arr = jnp.concatenate(floats) if floats else jnp.zeros(
+            0, jnp.float64)
+        return i_arr, f_arr
+
+    fn2.layout = layout
+    fn2.inner = fn
+    return fn2
+
+
+def unpack_outputs(wrapper, i_arr: np.ndarray, f_arr: np.ndarray) -> list:
+    """Host-side: packed arrays → list of per-output numpy values."""
+    out = []
+    for kind, off, n in wrapper.layout:
+        arr = (f_arr if kind == "f" else i_arr)[off:off + n]
+        out.append(arr[0] if n == 1 else arr)
+    return out
+
+
+def batch_planes(batch: col.ColumnBatch) -> dict:
+    """Host numpy → device arrays, one (values, valid) pair per column.
+    Memoized on the batch: planes stay device-resident across requests
+    (HBM residency is the point of the columnar cache)."""
+    planes = getattr(batch, "_device_planes", None)
+    if planes is None:
+        planes = {cid: (jnp.asarray(cd.values), jnp.asarray(cd.valid))
+                  for cid, cd in batch.columns.items()}
+        batch._device_planes = planes
+    return planes
+
+
+# ---------------------------------------------------------------------------
+# aggregate spec lowering
+# ---------------------------------------------------------------------------
+
+class AggSpec:
+    """One pushed aggregate lowered to its masked-reduction pieces."""
+
+    def __init__(self, name: str, arg: CompiledExpr | None, distinct: bool):
+        self.name = name
+        self.arg = arg
+        self.distinct = distinct
+
+
+def lower_aggregates(req: SelectRequest, batch: col.ColumnBatch) -> list[AggSpec]:
+    specs = []
+    for e in req.aggregates:
+        name = AGG_NAME[e.tp]
+        if name not in ("count", "sum", "avg", "min", "max", "first_row"):
+            raise Unsupported(f"aggregate {name} not lowered yet")
+        if e.distinct and name != "count":
+            raise Unsupported("distinct only lowered for count")
+        arg = compile_expr(e.children[0], batch) if e.children else None
+        specs.append(AggSpec(name, arg, e.distinct))
+    return specs
+
+
+def lower_group_by(req: SelectRequest, batch: col.ColumnBatch):
+    """Group-by items → (col_ids, dict sizes). Only dictionary-encoded
+    (string) columns group on-device; raw int group-bys fall back to CPU
+    until int dictionaries land."""
+    cids, sizes = [], []
+    for item in req.group_by:
+        e = item.expr
+        if e.tp != ExprType.COLUMN_REF:
+            raise Unsupported("non-column group-by")
+        cd = batch.columns.get(e.val)
+        if cd is None or cd.kind != col.K_STR:
+            raise Unsupported("group-by needs a dict-encoded column")
+        cids.append(e.val)
+        sizes.append(max(len(cd.dictionary), 1))
+    return cids, sizes
+
+
+# ---------------------------------------------------------------------------
+# single-shot (no group-by) aggregation kernel
+# ---------------------------------------------------------------------------
+
+def build_scalar_agg_fn(where: CompiledExpr | None, specs: list[AggSpec],
+                        row_limit: int):
+    """Returns fn(planes, live) → flat tuple of reduction results.
+    `live` is the row-liveness plane (padding exclusion)."""
+
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+        outs = []
+        for spec in specs:
+            outs.extend(_scalar_agg(spec, planes, mask))
+        return tuple(outs)
+
+    fn.combiners = _combiners(specs)
+    return fn
+
+
+def _combiners(specs: list[AggSpec], leading: list[str] | None = None):
+    """Cross-chip combine op per kernel output ('sum'|'min'|'max'|None).
+    None = not mesh-combinable (request stays single-chip / CPU).
+    This is the partial/final monoid split carried to ICI collectives:
+    count/sum → psum, min → pmin, max → pmax (SURVEY §2.10 row 2)."""
+    out = list(leading or [])
+    for spec in specs:
+        if spec.name == "count":
+            out.append(None if spec.distinct else "sum")
+        elif spec.name in ("sum", "avg"):
+            out.extend(["sum", "sum"])
+        elif spec.name == "min":
+            out.extend(["sum", "min"])
+        elif spec.name in ("max", "first_row"):
+            out.extend(["sum", "max"])
+        else:
+            out.append(None)
+    return out
+
+
+def _scalar_agg(spec: AggSpec, planes, mask):
+    name = spec.name
+    if spec.arg is None:  # count(*) style — planner lowers to count(1)
+        v, va = jnp.int64(1), jnp.bool_(True)
+    else:
+        v, va = spec.arg(planes)
+    contrib = mask & va
+    n = jnp.sum(contrib.astype(jnp.int64))
+    if name == "count":
+        if spec.distinct:
+            return (_distinct_count(v, contrib),)
+        return (n,)
+    if name == "sum":
+        vv = jnp.where(contrib, v, jnp.zeros_like(v))
+        return (n, jnp.sum(vv))
+    if name == "avg":
+        vv = jnp.where(contrib, v, jnp.zeros_like(v))
+        return (n, jnp.sum(vv))
+    if name in ("min", "max"):
+        if v.dtype == jnp.float64:
+            sentinel = F64_MAX if name == "min" else -F64_MAX
+        else:
+            sentinel = I64_MAX if name == "min" else I64_MIN + 1
+        vv = jnp.where(contrib, v, jnp.full_like(v, sentinel))
+        red = jnp.min(vv) if name == "min" else jnp.max(vv)
+        return (n, red)
+    if name == "first_row":
+        idx = jnp.argmax(contrib)  # first live index (argmax of bool)
+        return (n, v if jnp.ndim(v) == 0 else v[idx])
+    raise Unsupported(name)
+
+
+def _distinct_count(v, contrib):
+    """Exact distinct count: sort with invalids pushed to the end, count
+    boundaries. Static-shaped — no unique()."""
+    big = jnp.iinfo(jnp.int64).max if v.dtype != jnp.float64 \
+        else jnp.finfo(jnp.float64).max
+    key = jnp.where(contrib, v, jnp.full_like(v, big))
+    s = jnp.sort(key)
+    total = jnp.sum(contrib.astype(jnp.int64))
+    firsts = jnp.concatenate([jnp.ones(1, dtype=bool), s[1:] != s[:-1]])
+    live_sorted = jnp.arange(s.shape[0]) < total
+    return jnp.sum((firsts & live_sorted).astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# grouped aggregation kernel
+# ---------------------------------------------------------------------------
+
+def build_grouped_agg_fn(where: CompiledExpr | None, specs: list[AggSpec],
+                         group_cids: list[int], dict_sizes: list[int]):
+    """fn(planes, live) → (group_counts, per-spec arrays…), each sized
+    num_segments = prod(dict sizes) + 1; the LAST segment is the dead-row
+    sink (padding + filtered rows) and is dropped by the caller.
+
+    Group id = mixed-radix over the group columns' dict codes. NULL group
+    values use a reserved code slot per column (size+1 radix) so NULLs form
+    their own group, matching MySQL GROUP BY NULL semantics."""
+    radices = [s + 1 for s in dict_sizes]   # +1 slot for NULL per column
+    num_segments = 1
+    for r in radices:
+        num_segments *= r
+    num_segments += 1  # dead-row sink
+
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+        gid = None
+        for cid, radix, size in zip(group_cids, radices, dict_sizes):
+            codes, cva = planes[cid]
+            c = jnp.where(cva, codes, size).astype(jnp.int64)  # NULL → size
+            gid = c if gid is None else gid * radix + c
+        gid = jnp.where(mask, gid, num_segments - 1)  # dead rows → sink
+        row_count = jax.ops.segment_sum(mask.astype(jnp.int64), gid,
+                                        num_segments=num_segments)
+        outs = [row_count]
+        for spec in specs:
+            outs.extend(_grouped_agg(spec, planes, mask, gid, num_segments))
+        return tuple(outs)
+
+    fn.num_segments = num_segments
+    fn.radices = radices
+    fn.combiners = _combiners(specs, leading=["sum"])  # row_count first
+    return fn
+
+
+def _grouped_agg(spec: AggSpec, planes, mask, gid, num_segments):
+    name = spec.name
+    if spec.arg is None:
+        v, va = jnp.int64(1), jnp.bool_(True)
+    else:
+        v, va = spec.arg(planes)
+    contrib = mask & va
+    if jnp.ndim(v) == 0:
+        v = jnp.broadcast_to(v, mask.shape)
+        contrib = jnp.broadcast_to(contrib, mask.shape) & mask
+    n = jax.ops.segment_sum(contrib.astype(jnp.int64), gid,
+                            num_segments=num_segments)
+    if name == "count":
+        return (n,)
+    if name in ("sum", "avg"):
+        vv = jnp.where(contrib, v, jnp.zeros_like(v))
+        s = jax.ops.segment_sum(vv, gid, num_segments=num_segments)
+        return (n, s)
+    if name in ("min", "max"):
+        if v.dtype == jnp.float64:
+            sentinel = F64_MAX if name == "min" else -F64_MAX
+        else:
+            sentinel = I64_MAX if name == "min" else I64_MIN + 1
+        vv = jnp.where(contrib, v, jnp.full_like(v, sentinel))
+        if name == "min":
+            red = jax.ops.segment_min(vv, gid, num_segments=num_segments)
+        else:
+            red = jax.ops.segment_max(vv, gid, num_segments=num_segments)
+        return (n, red)
+    if name == "first_row":
+        # group columns' values are determined by the group id; others take
+        # the max contributing value (deterministic representative)
+        vv = jnp.where(contrib, v, jnp.full_like(v, I64_MIN + 1
+                                                 if v.dtype != jnp.float64
+                                                 else -F64_MAX))
+        red = jax.ops.segment_max(vv, gid, num_segments=num_segments)
+        return (n, red)
+    raise Unsupported(name)
+
+
+# ---------------------------------------------------------------------------
+# filter / topn kernels (non-aggregate requests)
+# ---------------------------------------------------------------------------
+
+def build_filter_fn(where: CompiledExpr | None):
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+        return (mask,)
+    return fn
+
+
+def build_topn_fn(where: CompiledExpr | None, key_expr: CompiledExpr,
+                  desc: bool, k: int):
+    """Top-k row indices by a single numeric sort key. NULL ordering:
+    ascending → NULLs first, descending → NULLs last (MySQL)."""
+
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+        v, va = key_expr(planes)
+        vf = v.astype(jnp.float64)
+        if desc:
+            score = jnp.where(va, vf, -jnp.inf)      # NULLs last
+        else:
+            score = jnp.where(va, -vf, jnp.inf)      # NULLs first
+        # dead rows must lose: push them below every live row
+        score = jnp.where(mask, score, -jnp.inf)
+        _, idx = jax.lax.top_k(score, k)
+        # how many of the top-k are live
+        n_live = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), k)
+        return idx, n_live
+    return fn

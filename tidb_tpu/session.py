@@ -55,6 +55,7 @@ class Session:
         self.vars.connection_id = next(_conn_id_gen)
         self.global_vars = _global_vars_by_store.setdefault(
             store.uuid(), GlobalVars())
+        self.vars._globals = self.global_vars
         self.parser = Parser()
         self._txn = None
         self.history: list[str] = []   # stmt texts for optimistic retry
@@ -218,21 +219,27 @@ class Session:
         finally:
             executor.close()
 
-        # autocommit: commit unless inside an explicit txn or a retry replay
-        if is_write and not self.vars.in_txn \
-                and not getattr(self, "_in_retry", False):
+        # autocommit: commit unless inside an explicit txn or a retry
+        # replay. Read statements commit too — their txn must be released
+        # or the session pins one snapshot (and its MVCC versions) forever.
+        if not self.vars.in_txn and not getattr(self, "_in_retry", False):
             if self.vars.autocommit:
                 self.commit_txn()
         return rs
 
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
-        try:
+        if self.store.uuid() not in _BOOTSTRAPPED_STORES:
+            return  # called from inside bootstrap itself
+        esc_n = name.lower().replace("'", "''")
+        esc_v = value.replace("'", "''")
+        self.execute(
+            "update mysql.global_variables set variable_value = "
+            f"'{esc_v}' where variable_name = '{esc_n}'")
+        if self.vars.affected_rows == 0:
             self.execute(
-                "replace into mysql.global_variables values "
-                f"('{name.lower()}', '{value}')")
-        except errors.TiDBError:
-            pass  # pre-bootstrap
+                f"insert into mysql.global_variables values ('{esc_n}', "
+                f"'{esc_v}')")
 
     def close(self) -> None:
         self.rollback_txn()
@@ -312,8 +319,8 @@ def bootstrap(session: Session) -> None:
     with _bootstrap_lock:
         if uuid in _BOOTSTRAPPED_STORES:
             return
-        _BOOTSTRAPPED_STORES.add(uuid)
         if session.info_schema().schema_exists("mysql"):
+            _BOOTSTRAPPED_STORES.add(uuid)
             return  # persisted store already bootstrapped
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,
@@ -335,3 +342,6 @@ def bootstrap(session: Session) -> None:
         session.execute(
             "insert into mysql.tidb values ('bootstrapped', 'True', "
             "'Bootstrap flag. Do not delete.')")
+        # only a fully-completed bootstrap marks the store (a failure above
+        # propagates and the next Session retries)
+        _BOOTSTRAPPED_STORES.add(uuid)

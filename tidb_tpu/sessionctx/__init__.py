@@ -50,6 +50,7 @@ class SessionVars:
 
     def __init__(self):
         self.systems: dict[str, str] = {}       # session-scope overrides
+        self._globals: "GlobalVars | None" = None  # bound by the session
         self.users: dict[str, Datum] = {}       # @user_vars
         self.current_db = ""
         self.autocommit = True
@@ -78,7 +79,9 @@ class SessionVars:
             self.autocommit = value.lower() in ("1", "on", "true")
 
     def distsql_concurrency(self) -> int:
-        v = self.systems.get("tidb_distsql_scan_concurrency")
+        v = self.systems.get("tidb_distsql_scan_concurrency") \
+            or (self._globals.get("tidb_distsql_scan_concurrency")
+                if self._globals is not None else None)
         return int(v) if v else int(
             SYSVAR_DEFAULTS["tidb_distsql_scan_concurrency"])
 

@@ -238,8 +238,29 @@ def compare_datum(a: Datum, b: Datum) -> int:
     if ak == Kind.DURATION and bk == Kind.DURATION:
         return (a.val.nanos > b.val.nanos) - (a.val.nanos < b.val.nanos)
 
+    # temporal vs string: coerce the string to the temporal type (MySQL
+    # comparison coercion; util/types/compare.go). Falling through to the
+    # numeric path would take the string's numeric PREFIX ('1998-09-02' →
+    # 1998) and silently mis-compare date filters.
+    if ak == Kind.TIME and bk in (Kind.STRING, Kind.BYTES):
+        t = _parse_time_or_none(b.get_string())
+        if t is not None:
+            return a.val.compare(t)
+    elif bk == Kind.TIME and ak in (Kind.STRING, Kind.BYTES):
+        t = _parse_time_or_none(a.get_string())
+        if t is not None:
+            return -b.val.compare(t)
+
     x, y = a.as_number(), b.as_number()
     return _cmp_num(x, y)
+
+
+def _parse_time_or_none(s: str):
+    from tidb_tpu.types.time_types import parse_time
+    try:
+        return parse_time(s)
+    except Exception:
+        return None
 
 
 def _cmp_num(x, y) -> int:
