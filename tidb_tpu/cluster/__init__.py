@@ -1,0 +1,20 @@
+"""Distributed KV: mock cluster + Percolator client (store/tikv equivalent).
+
+The full SQL engine runs unchanged over this storage via the same
+kv.Storage/kv.Client contracts as the single-node localstore; the
+differences live entirely below the KV boundary — region routing, 2PC,
+lock resolution, retry ladders. See SURVEY.md §2.7.
+"""
+
+from tidb_tpu.cluster.mvcc import KeyIsLockedError, LockInfo, MvccStore
+from tidb_tpu.cluster.rpc import (
+    NotLeaderError, RegionError, RpcHandler, StaleEpochError,
+)
+from tidb_tpu.cluster.store import ClusterDriver, DistStore
+from tidb_tpu.cluster.topology import Cluster
+
+__all__ = [
+    "Cluster", "ClusterDriver", "DistStore", "MvccStore",
+    "KeyIsLockedError", "LockInfo", "NotLeaderError", "RegionError",
+    "RpcHandler", "StaleEpochError",
+]

@@ -1,0 +1,233 @@
+"""Percolator-style MVCC store: the storage node's transactional core.
+
+Reference: store/tikv/mock-tikv/mvcc.go (the in-proc stand-in for real
+TiKV's storage layer). Three logical columns per key:
+  data:  committed versions [(commit_ts, start_ts, value|None)]
+  lock:  at most one uncommitted lock (primary, start_ts, ttl, kind, value)
+  write: folded into data here (commit records carry start_ts)
+
+Writes follow the Percolator protocol driven by the client's 2PC
+(cluster/twopc.py): prewrite takes locks + buffers values, commit moves the
+buffered value into the data column at commit_ts, rollback clears the lock.
+Reads at ts block on (raise) any lock with lock.start_ts <= ts, surfacing
+LockInfo so the client's resolver can decide commit-or-rollback.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tidb_tpu import errors
+
+
+@dataclass
+class LockInfo:
+    key: bytes
+    primary: bytes
+    start_ts: int
+    ttl_ms: int
+    kind: str               # 'put' | 'delete' | 'lock'
+    value: bytes | None
+    created_at: float = field(default_factory=time.monotonic)
+
+    def expired(self) -> bool:
+        return (time.monotonic() - self.created_at) * 1000.0 > self.ttl_ms
+
+
+class KeyIsLockedError(errors.RetryableError):
+    def __init__(self, lock: LockInfo):
+        super().__init__(f"key {lock.key!r} locked by txn {lock.start_ts}")
+        self.lock = lock
+
+
+class WriteConflict(errors.WriteConflictError):
+    pass
+
+
+class TxnAborted(errors.TiDBError):
+    """Commit attempted but the lock is gone and a rollback record exists."""
+
+
+@dataclass
+class _Versions:
+    # parallel sorted-by-commit_ts lists (ascending)
+    commit_ts: list[int] = field(default_factory=list)
+    start_ts: list[int] = field(default_factory=list)
+    values: list[bytes | None] = field(default_factory=list)  # None=delete
+
+
+class MvccStore:
+    """One per mock cluster (mock-tikv shares a single store too)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: dict[bytes, _Versions] = {}
+        self._locks: dict[bytes, LockInfo] = {}
+        # start_ts of explicitly rolled-back txns (rollback records)
+        self._rollbacks: set[int] = set()
+        self._sorted_keys: list[bytes] | None = []
+
+    # ---- reads ----
+
+    def get(self, key: bytes, read_ts: int) -> bytes | None:
+        with self._lock:
+            self._check_lock(key, read_ts)
+            return self._get_committed(key, read_ts)
+
+    def _check_lock(self, key: bytes, read_ts: int) -> None:
+        lock = self._locks.get(key)
+        if lock is not None and lock.start_ts <= read_ts \
+                and lock.kind != "lock":
+            raise KeyIsLockedError(lock)
+
+    def _get_committed(self, key: bytes, read_ts: int) -> bytes | None:
+        vs = self._data.get(key)
+        if vs is None:
+            return None
+        i = bisect.bisect_right(vs.commit_ts, read_ts) - 1
+        if i < 0:
+            return None
+        return vs.values[i]
+
+    def scan(self, start: bytes, end: bytes | None, read_ts: int,
+             limit: int | None = None, reverse: bool = False):
+        """Committed (key, value) pairs in [start, end) visible at read_ts;
+        raises KeyIsLockedError on a blocking lock."""
+        with self._lock:
+            keys = self._keys_in_range(start, end)
+            if reverse:
+                keys = list(reversed(keys))
+            out = []
+            for k in keys:
+                self._check_lock(k, read_ts)
+                v = self._get_committed(k, read_ts)
+                if v is not None:
+                    out.append((k, v))
+                    if limit is not None and len(out) >= limit:
+                        break
+            return out
+
+    def _keys_in_range(self, start: bytes, end: bytes | None) -> list[bytes]:
+        if self._sorted_keys is None:
+            self._sorted_keys = sorted(set(self._data) | set(self._locks))
+        keys = self._sorted_keys
+        lo = bisect.bisect_left(keys, start)
+        hi = bisect.bisect_left(keys, end) if end is not None else len(keys)
+        return keys[lo:hi]
+
+    # ---- percolator writes ----
+
+    def prewrite(self, mutations: list[tuple[str, bytes, bytes | None]],
+                 primary: bytes, start_ts: int, ttl_ms: int = 3000) -> None:
+        """mutations: (op, key, value). Reference: mock-tikv mvcc.Prewrite —
+        lock conflict → KeyIsLocked; newer committed write → WriteConflict."""
+        with self._lock:
+            # validate all first: prewrite is atomic per batch
+            for op, key, value in mutations:
+                lock = self._locks.get(key)
+                if lock is not None and lock.start_ts != start_ts:
+                    raise KeyIsLockedError(lock)
+                vs = self._data.get(key)
+                if vs and vs.commit_ts and vs.commit_ts[-1] >= start_ts:
+                    raise WriteConflict(
+                        f"write conflict on {key!r}: committed "
+                        f"{vs.commit_ts[-1]} >= start_ts {start_ts}")
+                if start_ts in self._rollbacks:
+                    raise TxnAborted(f"txn {start_ts} already rolled back")
+            for op, key, value in mutations:
+                self._locks[key] = LockInfo(key, primary, start_ts, ttl_ms,
+                                            op, value)
+            self._sorted_keys = None
+
+    def commit(self, keys: list[bytes], start_ts: int, commit_ts: int) -> None:
+        with self._lock:
+            for key in keys:
+                lock = self._locks.get(key)
+                if lock is None or lock.start_ts != start_ts:
+                    # already committed (idempotent retry) or rolled back
+                    if self._committed_at(key, start_ts) is not None:
+                        continue
+                    raise TxnAborted(
+                        f"commit of {key!r}@{start_ts}: lock missing")
+            for key in keys:
+                lock = self._locks.pop(key, None)
+                if lock is None or lock.start_ts != start_ts:
+                    continue
+                if lock.kind == "lock":
+                    continue  # SELECT FOR UPDATE lock: no data write
+                vs = self._data.setdefault(key, _Versions())
+                i = bisect.bisect_left(vs.commit_ts, commit_ts)
+                vs.commit_ts.insert(i, commit_ts)
+                vs.start_ts.insert(i, start_ts)
+                vs.values.insert(i, None if lock.kind == "delete"
+                                 else lock.value)
+            self._sorted_keys = None
+
+    def rollback(self, keys: list[bytes], start_ts: int) -> None:
+        with self._lock:
+            for key in keys:
+                lock = self._locks.get(key)
+                if lock is not None and lock.start_ts == start_ts:
+                    del self._locks[key]
+                elif self._committed_at(key, start_ts) is not None:
+                    raise TxnAborted(
+                        f"cannot roll back {key!r}@{start_ts}: committed")
+            self._rollbacks.add(start_ts)
+            self._sorted_keys = None
+
+    def _committed_at(self, key: bytes, start_ts: int) -> int | None:
+        vs = self._data.get(key)
+        if vs is None:
+            return None
+        for cts, sts in zip(vs.commit_ts, vs.start_ts):
+            if sts == start_ts:
+                return cts
+        return None
+
+    # ---- lock resolution support (cluster/lock_resolver.py) ----
+
+    def txn_status(self, primary: bytes, start_ts: int) -> tuple[str, int]:
+        """('committed', commit_ts) | ('rolled_back', 0) | ('locked', 0) —
+        checked on the PRIMARY key (the Percolator source of truth)."""
+        with self._lock:
+            cts = self._committed_at(primary, start_ts)
+            if cts is not None:
+                return "committed", cts
+            lock = self._locks.get(primary)
+            if lock is not None and lock.start_ts == start_ts:
+                return "locked", 0
+            return "rolled_back", 0
+
+    def scan_locks(self, max_ts: int, start: bytes = b"",
+                   end: bytes | None = None) -> list[LockInfo]:
+        with self._lock:
+            return [l for k, l in sorted(self._locks.items())
+                    if l.start_ts <= max_ts
+                    and k >= start and (end is None or k < end)]
+
+    # ---- GC ----
+
+    def gc(self, safe_point: int) -> int:
+        """Drop versions no snapshot at/after safe_point can see.
+        Reference: gc_worker.DoGC."""
+        removed = 0
+        with self._lock:
+            for key, vs in list(self._data.items()):
+                keep_from = bisect.bisect_right(vs.commit_ts, safe_point) - 1
+                if keep_from > 0:
+                    # versions before keep_from are shadowed at safe_point
+                    removed += keep_from
+                    vs.commit_ts = vs.commit_ts[keep_from:]
+                    vs.start_ts = vs.start_ts[keep_from:]
+                    vs.values = vs.values[keep_from:]
+                # tombstone visible at safepoint with no newer versions:
+                # the key is gone for every future reader
+                if len(vs.commit_ts) == 1 and vs.values[0] is None \
+                        and vs.commit_ts[0] <= safe_point:
+                    del self._data[key]
+                    removed += 1
+            self._sorted_keys = None
+        return removed

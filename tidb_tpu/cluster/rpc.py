@@ -1,0 +1,160 @@
+"""In-process RPC layer: request envelopes with region-epoch checking.
+
+Reference: store/tikv/mock-tikv/rpc.go — every KV/coprocessor request
+carries a region context (id, epoch, peer); the handler rejects stale
+clients with NotLeader / StaleEpoch / RegionMiss region errors exactly the
+way a real storage node does, which is what exercises the client's retry
+ladder (store/tikv/coprocessor.go:412-496).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tidb_tpu import errors
+from tidb_tpu.cluster.mvcc import KeyIsLockedError, MvccStore
+from tidb_tpu.cluster.topology import Cluster, Region
+
+
+class RegionError(errors.RetryableError):
+    pass
+
+
+class NotLeaderError(RegionError):
+    def __init__(self, region_id: int, leader_store_id: int = 0):
+        super().__init__(f"region {region_id}: not leader")
+        self.region_id = region_id
+        self.leader_store_id = leader_store_id
+
+
+class StaleEpochError(RegionError):
+    def __init__(self, region_id: int, current: Region | None):
+        super().__init__(f"region {region_id}: stale epoch")
+        self.current = current
+
+
+class RegionMissError(RegionError):
+    def __init__(self, region_id: int):
+        super().__init__(f"region {region_id}: not found")
+
+
+class ServerIsBusyError(RegionError):
+    pass
+
+
+@dataclass
+class RegionCtx:
+    region_id: int
+    epoch: tuple[int, int]
+    store_id: int           # the store the client thinks is leader
+
+
+class RpcHandler:
+    """One logical endpoint serving every store (in-proc mock); per-store
+    failure injection via `down_stores`."""
+
+    def __init__(self, cluster: Cluster, mvcc: MvccStore):
+        self.cluster = cluster
+        self.mvcc = mvcc
+        self.down_stores: set[int] = set()
+        self.busy_stores: set[int] = set()
+
+    # ---- region context validation ----
+
+    def _check(self, ctx: RegionCtx) -> Region:
+        if ctx.store_id in self.down_stores:
+            raise errors.KVError(f"store {ctx.store_id} unreachable")
+        if ctx.store_id in self.busy_stores:
+            raise ServerIsBusyError(f"store {ctx.store_id} busy")
+        region = self.cluster.region_by_id(ctx.region_id)
+        if region is None:
+            raise RegionMissError(ctx.region_id)
+        if region.leader_store_id != ctx.store_id or region.leader_peer_id == 0:
+            raise NotLeaderError(ctx.region_id, region.leader_store_id)
+        if region.epoch() != ctx.epoch:
+            raise StaleEpochError(ctx.region_id, region)
+        return region
+
+    def _clip(self, region: Region, start: bytes, end: bytes | None):
+        lo = max(start, region.start)
+        if region.end is None:
+            return lo, end
+        return lo, region.end if end is None else min(end, region.end)
+
+    # ---- KV commands (kvrpcpb equivalents) ----
+
+    def kv_get(self, ctx: RegionCtx, key: bytes, read_ts: int):
+        region = self._check(ctx)
+        if not region.contains(key):
+            raise StaleEpochError(ctx.region_id, region)
+        return self.mvcc.get(key, read_ts)
+
+    def kv_scan(self, ctx: RegionCtx, start: bytes, end: bytes | None,
+                read_ts: int, limit: int | None = None):
+        region = self._check(ctx)
+        lo, hi = self._clip(region, start, end)
+        return self.mvcc.scan(lo, hi, read_ts, limit)
+
+    def kv_prewrite(self, ctx: RegionCtx, mutations, primary: bytes,
+                    start_ts: int, ttl_ms: int):
+        self._check(ctx)
+        self.mvcc.prewrite(mutations, primary, start_ts, ttl_ms)
+
+    def kv_commit(self, ctx: RegionCtx, keys, start_ts: int, commit_ts: int):
+        self._check(ctx)
+        self.mvcc.commit(keys, start_ts, commit_ts)
+
+    def kv_rollback(self, ctx: RegionCtx, keys, start_ts: int):
+        self._check(ctx)
+        self.mvcc.rollback(keys, start_ts)
+
+    def kv_txn_status(self, primary: bytes, start_ts: int):
+        # status check goes wherever the primary lives; epoch-free
+        return self.mvcc.txn_status(primary, start_ts)
+
+    def kv_scan_locks(self, ctx: RegionCtx, max_ts: int):
+        region = self._check(ctx)
+        return self.mvcc.scan_locks(max_ts, region.start, region.end)
+
+    def kv_gc(self, ctx: RegionCtx, safe_point: int) -> int:
+        self._check(ctx)
+        return self.mvcc.gc(safe_point)
+
+    # ---- coprocessor (cop_handler.go) ----
+
+    def cop_request(self, ctx: RegionCtx, sel, ranges, read_ts: int):
+        from tidb_tpu.copr.region_handler import handle_request
+        from tidb_tpu.kv.kv import KeyRange
+        region = self._check(ctx)
+        clipped = []
+        for rg in ranges:
+            lo, hi = self._clip(region, rg.start, rg.end)
+            if hi is None or lo < hi:
+                clipped.append(KeyRange(lo, hi))
+        snapshot = _MvccSnapshotView(self.mvcc, read_ts)
+        return handle_request(snapshot, sel, clipped)
+
+
+class _MvccSnapshotView:
+    """kv.Snapshot-shaped view over the Percolator store at read_ts —
+    what the CPU coprocessor engine scans. Locks surface as
+    KeyIsLockedError for the client's resolve-and-retry."""
+
+    def __init__(self, mvcc: MvccStore, read_ts: int):
+        self.mvcc = mvcc
+        self.read_ts = read_ts
+
+    def get(self, key: bytes) -> bytes:
+        v = self.mvcc.get(key, self.read_ts)
+        if v is None:
+            raise errors.KeyNotExistsError(f"key not found: {key!r}")
+        return v
+
+    def get_or_none(self, key: bytes):
+        return self.mvcc.get(key, self.read_ts)
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        return iter(self.mvcc.scan(start, end, self.read_ts))
+
+    def iterate_reverse(self, start: bytes = b"", end: bytes | None = None):
+        return iter(self.mvcc.scan(start, end, self.read_ts, reverse=True))

@@ -1,0 +1,141 @@
+"""Mock cluster topology: stores, regions, leaders — manipulable mid-test.
+
+Reference: store/tikv/mock-tikv/cluster.go (:33 Cluster, :142-201
+Split/Merge/ChangeLeader/GiveUpLeader) — the machinery that lets tests
+force NotLeader / StaleEpoch / region-miss retries without real hardware.
+Also plays the PD role (region routing + id allocation), like
+mock-tikv/pd.go.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Peer:
+    peer_id: int
+    store_id: int
+
+
+@dataclass
+class Region:
+    region_id: int
+    start: bytes
+    end: bytes | None
+    peers: list[Peer]
+    leader_peer_id: int
+    conf_ver: int = 1
+    version: int = 1          # bumped on split/merge (epoch)
+
+    @property
+    def leader_store_id(self) -> int:
+        for p in self.peers:
+            if p.peer_id == self.leader_peer_id:
+                return p.store_id
+        return 0
+
+    def epoch(self) -> tuple[int, int]:
+        return (self.conf_ver, self.version)
+
+    def contains(self, key: bytes) -> bool:
+        return key >= self.start and (self.end is None or key < self.end)
+
+    def clone(self) -> "Region":
+        return Region(self.region_id, self.start, self.end,
+                      [Peer(p.peer_id, p.store_id) for p in self.peers],
+                      self.leader_peer_id, self.conf_ver, self.version)
+
+
+class Cluster:
+    def __init__(self, n_stores: int = 3, replicas: int = 3):
+        self._id = itertools.count(1)
+        self._lock = threading.RLock()
+        self.stores: dict[int, str] = {}
+        for _ in range(n_stores):
+            sid = next(self._id)
+            self.stores[sid] = f"store{sid}"
+        self.replicas = min(replicas, n_stores)
+        first = self._new_region(b"", None)
+        self.regions: list[Region] = [first]
+
+    def _new_region(self, start: bytes, end: bytes | None) -> Region:
+        rid = next(self._id)
+        store_ids = list(self.stores)
+        peers = [Peer(next(self._id), store_ids[i % len(store_ids)])
+                 for i in range(self.replicas)]
+        return Region(rid, start, end, peers, peers[0].peer_id)
+
+    # ---- routing (PD GetRegion) ----
+
+    def region_by_key(self, key: bytes) -> Region:
+        with self._lock:
+            i = self._locate(key)
+            return self.regions[i].clone()
+
+    def region_by_id(self, rid: int) -> Region | None:
+        with self._lock:
+            for r in self.regions:
+                if r.region_id == rid:
+                    return r.clone()
+            return None
+
+    def _locate(self, key: bytes) -> int:
+        starts = [r.start for r in self.regions]
+        return max(bisect.bisect_right(starts, key) - 1, 0)
+
+    # ---- test manipulation (cluster_manipulate.go) ----
+
+    def split(self, key: bytes) -> None:
+        with self._lock:
+            i = self._locate(key)
+            r = self.regions[i]
+            if r.start == key:
+                return
+            right = self._new_region(key, r.end)
+            r.end = key
+            r.version += 1
+            right.version = r.version
+            self.regions.insert(i + 1, right)
+
+    def split_keys(self, keys: list[bytes]) -> None:
+        for k in sorted(keys):
+            self.split(k)
+
+    def merge(self, rid_left: int, rid_right: int) -> None:
+        with self._lock:
+            li = next(i for i, r in enumerate(self.regions)
+                      if r.region_id == rid_left)
+            ri = next(i for i, r in enumerate(self.regions)
+                      if r.region_id == rid_right)
+            assert ri == li + 1, "can only merge adjacent regions"
+            left, right = self.regions[li], self.regions[ri]
+            left.end = right.end
+            left.version = max(left.version, right.version) + 1
+            del self.regions[ri]
+
+    def change_leader(self, region_id: int, store_id: int) -> None:
+        with self._lock:
+            for r in self.regions:
+                if r.region_id == region_id:
+                    for p in r.peers:
+                        if p.store_id == store_id:
+                            r.leader_peer_id = p.peer_id
+                            return
+                    # no peer on that store: add one (conf change)
+                    p = Peer(next(self._id), store_id)
+                    r.peers.append(p)
+                    r.conf_ver += 1
+                    r.leader_peer_id = p.peer_id
+                    return
+
+    def give_up_leader(self, region_id: int) -> None:
+        """No leader until changed — every request bounces NotLeader."""
+        with self._lock:
+            for r in self.regions:
+                if r.region_id == region_id:
+                    r.leader_peer_id = 0
+                    return

@@ -1,0 +1,132 @@
+"""Percolator two-phase commit.
+
+Reference: store/tikv/2pc.go — twoPhaseCommitter (:51): group mutations by
+region (:143), size-capped batches (:514, ≤512KiB), prewrite with the
+primary lock first (:248), TSO commit timestamp, commit the primary batch
+synchronously then the rest (:310, async in the reference), cleanup on
+failure; prewrite lock conflicts go through the lock resolver.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+from tidb_tpu.cluster.client import Backoffer
+from tidb_tpu.cluster.mvcc import KeyIsLockedError
+
+MAX_BATCH_BYTES = 512 * 1024  # appendBatchBySize (2pc.go:514)
+LOCK_TTL_MS = 3000
+
+
+class TwoPhaseCommitter:
+    def __init__(self, store, start_ts: int,
+                 mutations: dict[bytes, bytes | None]):
+        """mutations: key → value (None = delete)."""
+        self.store = store
+        self.start_ts = start_ts
+        self.mutations = mutations
+        self.keys = sorted(mutations)
+        self.primary = self.keys[0]
+        self.committed = False
+
+    # ---- batching ----
+
+    def _batches(self, keys: list[bytes]):
+        """Group by region, then cap batches by byte size."""
+        for region, group in self.store.cache.group_keys_by_region(keys):
+            batch: list[bytes] = []
+            size = 0
+            for k in group:
+                v = self.mutations.get(k)
+                ksize = len(k) + (len(v) if v else 0)
+                if batch and size + ksize > MAX_BATCH_BYTES:
+                    yield batch
+                    batch, size = [], 0
+                batch.append(k)
+                size += ksize
+            if batch:
+                yield batch
+
+    # ---- phases ----
+
+    def _prewrite_batch(self, keys: list[bytes], bo: Backoffer) -> None:
+        muts = []
+        for k in keys:
+            v = self.mutations[k]
+            muts.append(("delete", k, None) if v is None else ("put", k, v))
+        while True:
+            try:
+                self.store.sender.send(
+                    keys[0],
+                    lambda ctx, r: self.store.rpc.kv_prewrite(
+                        ctx, muts, self.primary, self.start_ts, LOCK_TTL_MS),
+                    bo)
+                return
+            except KeyIsLockedError as e:
+                cleared = self.store.resolver.resolve([e.lock], bo)
+                if not cleared:
+                    bo.backoff("txn_lock", e)
+
+    def _commit_batch(self, keys: list[bytes], commit_ts: int,
+                      bo: Backoffer) -> None:
+        self.store.sender.send(
+            keys[0],
+            lambda ctx, r: self.store.rpc.kv_commit(ctx, keys, self.start_ts,
+                                                    commit_ts),
+            bo)
+
+    def _cleanup(self) -> None:
+        bo = Backoffer()
+        for batch in self._batches(self.keys):
+            try:
+                self.store.sender.send(
+                    batch[0],
+                    lambda ctx, r: self.store.rpc.kv_rollback(
+                        ctx, batch, self.start_ts),
+                    bo)
+            except errors.TiDBError:
+                pass  # leftover locks resolve via TTL later
+
+    def execute(self) -> int:
+        """Returns commit_ts. Reference: execute (2pc.go:406)."""
+        bo = Backoffer()
+        # phase 1: prewrite — primary's batch first (it IS the txn record)
+        try:
+            primary_done = False
+            for batch in self._batches(self.keys):
+                if not primary_done and self.primary in batch:
+                    self._prewrite_batch(batch, bo)
+                    primary_done = True
+            for batch in self._batches(self.keys):
+                if self.primary not in batch:
+                    self._prewrite_batch(batch, bo)
+        except errors.TiDBError:
+            self._cleanup()
+            raise
+
+        commit_ts = self.store.oracle.current_version()
+
+        # phase 2: commit the primary first — once it lands the txn IS
+        # committed; secondary failures leave resolvable locks
+        try:
+            for batch in self._batches(self.keys):
+                if self.primary in batch:
+                    self._commit_batch([self.primary], commit_ts, bo)
+                    rest = [k for k in batch if k != self.primary]
+                    if rest:
+                        self._commit_batch(rest, commit_ts, bo)
+                    break
+        except errors.TiDBError:
+            if not self.committed:
+                self._cleanup()
+            raise
+        self.committed = True
+        for batch in self._batches(self.keys):
+            if self.primary in batch:
+                continue
+            try:
+                self._commit_batch(batch, commit_ts, bo)
+            except errors.TiDBError:
+                # committed state is decided by the primary; stragglers
+                # resolve via LockResolver on next read
+                break
+        return commit_ts

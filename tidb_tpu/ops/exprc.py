@@ -140,6 +140,34 @@ def _merge_kind(a: str, b: str) -> str:
     return col.K_I64
 
 
+def _reject_strconst(*compiled: CompiledExpr) -> None:
+    """A bare string constant only lowers inside a comparison against a
+    dict/temporal column; anywhere else the request must fall back."""
+    for c in compiled:
+        if c.kind == "strconst":
+            raise Unsupported("string constant outside dict comparison")
+
+
+def _coerce_temporal_const(column_expr: Expr, const_expr: Expr, batch) -> Expr:
+    """String constant vs TEMPORAL column → packed-int constant (MySQL
+    date-string coercion; shared by compare and IN lowering)."""
+    from tidb_tpu import mysqldef as my
+    if column_expr.tp == ExprType.COLUMN_REF \
+            and const_expr.tp == ExprType.VALUE \
+            and not const_expr.val.is_null() \
+            and const_expr.val.kind in (Kind.STRING, Kind.BYTES):
+        cd = batch.columns.get(column_expr.val)
+        if cd is not None and cd.kind == col.K_I64 \
+                and cd.tp in my.TIME_TYPES:
+            from tidb_tpu.types.time_types import parse_time
+            try:
+                t = parse_time(const_expr.val.get_string())
+            except Exception:
+                raise Unsupported("unparseable date constant")
+            return Expr(ExprType.VALUE, val=Datum.i64(t.to_packed_int()))
+    return const_expr
+
+
 def _promote(av, bv, kind: str):
     if kind == col.K_F64:
         return av.astype(jnp.float64) if av.dtype != jnp.float64 else av, \

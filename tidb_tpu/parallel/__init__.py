@@ -70,12 +70,19 @@ class CoprMesh:
             raise Unsupported(
                 f"batch capacity {live.shape[0]} not divisible by mesh "
                 f"size {self.n}")
-        local = self._combined(fn)
-        sharded = shard_map(
-            local, mesh=self.mesh,
-            in_specs=(P(AXIS), P(AXIS)),   # rows sharded across the axis
-            out_specs=P())                 # combined results replicated
-        return jax.jit(sharded)(planes, jnp.asarray(live))
+        ent = self._jit_cache.get(id(fn))
+        if ent is None or ent[0] is not fn:
+            local = self._combined(fn)
+            sharded = shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS)),  # rows sharded across the axis
+                out_specs=P())                # combined results replicated
+            # pin fn in the entry so its id can't be reused while cached
+            ent = (fn, jax.jit(sharded))
+            self._jit_cache[id(fn)] = ent
+            if len(self._jit_cache) > 256:
+                self._jit_cache.pop(next(iter(self._jit_cache)))
+        return ent[1](planes, jnp.asarray(live))
 
     # the client calls these; signatures match the single-chip jit path
     def run_scalar(self, fn, planes, live):

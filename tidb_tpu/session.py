@@ -42,6 +42,9 @@ def _ensure_drivers():
     for scheme in ("local", "memory", "goleveldb", "boltdb"):
         if scheme not in kvmod._drivers:
             register_driver(scheme, LocalDriver())
+    if "cluster" not in kvmod._drivers:
+        from tidb_tpu.cluster.store import ClusterDriver
+        register_driver("cluster", ClusterDriver())
 
 
 class Session:
@@ -237,9 +240,14 @@ class Session:
             "update mysql.global_variables set variable_value = "
             f"'{esc_v}' where variable_name = '{esc_n}'")
         if self.vars.affected_rows == 0:
-            self.execute(
-                f"insert into mysql.global_variables values ('{esc_n}', "
-                f"'{esc_v}')")
+            # affected counts CHANGED rows (MySQL), so 0 also means "row
+            # exists with this exact value" — insert only a missing row
+            try:
+                self.execute(
+                    f"insert into mysql.global_variables values ('{esc_n}', "
+                    f"'{esc_v}')")
+            except errors.DupEntryError:
+                pass
 
     def close(self) -> None:
         self.rollback_txn()
