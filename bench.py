@@ -1,12 +1,17 @@
-"""Headline benchmark: TPC-H Q6 rows/sec/chip, TPU coprocessor vs the CPU
-xeval baseline (BASELINE.md configs 1-2).
+"""Headline benchmark: TPC-H-shaped configs from BASELINE.md, TPU
+coprocessor vs the CPU xeval baseline, through the FULL engine stack
+(SQL → plan → pushdown → coprocessor).
 
-Builds a lineitem-shaped table in the in-memory MVCC store, runs Q6 through
-the FULL engine stack (SQL → plan → pushdown → coprocessor) on both
-engines, and prints ONE JSON line:
+Configs (BASELINE.md):
+  2. Q6  — scan + 3-predicate filter + single sum, no group-by
+  3. Q1  — scan + filter + 8 aggregates GROUP BY 2 cols
+  4. count(distinct l_orderkey) — distinct kernel
+  5. Q1 via the device mesh (region-sharded partial-agg combine)
 
-    {"metric": "tpch_q6_rows_per_sec_tpu", "value": ..., "unit": "rows/s",
-     "vs_baseline": <tpu_rows_per_sec / cpu_rows_per_sec>}
+Prints per-config lines to stderr and ONE JSON line to stdout:
+
+    {"metric": "tpch_geomean_rows_per_sec_tpu", "value": ...,
+     "unit": "rows/s", "vs_baseline": <geomean speedup over configs 2-4>}
 
 Environment:
     BENCH_ROWS   lineitem row count (default 300000)
@@ -16,6 +21,7 @@ Environment:
 from __future__ import annotations
 
 import json
+import math
 import os
 import random
 import sys
@@ -26,6 +32,17 @@ Q6 = ("select sum(l_extendedprice * l_discount) from lineitem "
       "where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01' "
       "and l_discount >= 0.05 and l_discount <= 0.07 "
       "and l_quantity < 24")
+
+Q1 = ("select l_returnflag, l_linestatus, "
+      "sum(l_quantity), sum(l_extendedprice), "
+      "sum(l_extendedprice * (1 - l_discount)), "
+      "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
+      "avg(l_quantity), avg(l_extendedprice), avg(l_discount), count(*) "
+      "from lineitem where l_shipdate <= '1998-09-02' "
+      "group by l_returnflag, l_linestatus "
+      "order by l_returnflag, l_linestatus")
+
+QDIST = "select count(distinct l_orderkey) from lineitem"
 
 
 def build_store(n_rows: int):
@@ -39,7 +56,7 @@ def build_store(n_rows: int):
     s.execute("use tpch")
     s.execute(
         "create table lineitem ("
-        " l_id bigint primary key,"
+        " l_id bigint primary key, l_orderkey bigint,"
         " l_quantity double, l_extendedprice double, l_discount double,"
         " l_tax double, l_returnflag varchar(1), l_linestatus varchar(1),"
         " l_shipdate date)")
@@ -60,13 +77,14 @@ def build_store(n_rows: int):
             from tidb_tpu.types.time_types import Time
             row = [
                 Datum.i64(i),
+                Datum.i64((i + 3) // 4),
                 Datum.f64(float(rng.randint(1, 50))),
                 Datum.f64(round(rng.uniform(900.0, 105000.0), 2)),
                 Datum.f64(round(rng.uniform(0.0, 0.1), 2)),
                 Datum.f64(round(rng.uniform(0.0, 0.08), 2)),
                 Datum.string(rng.choice(flags)),
                 Datum.string(rng.choice(statuses)),
-                datum_from_py(Time(ship, tbl.info.columns[7].field_type.tp)),
+                datum_from_py(Time(ship, tbl.info.columns[8].field_type.tp)),
             ]
             tbl.add_record(txn, row, skip_unique_check=True)
             i += 1
@@ -84,6 +102,25 @@ def timed_runs(session, sql: str, runs: int):
     return (time.time() - t0) / runs, results
 
 
+def check_parity(name: str, cpu_rows, tpu_rows):
+    assert len(cpu_rows) == len(tpu_rows), \
+        f"{name}: row count {len(cpu_rows)} vs {len(tpu_rows)}"
+    for cr, tr in zip(cpu_rows, tpu_rows):
+        assert len(cr) == len(tr), \
+            f"{name}: column count {len(cr)} vs {len(tr)}"
+        for cv, tv in zip(cr, tr):
+            if isinstance(cv, (int,)) and isinstance(tv, (int,)):
+                assert cv == tv, f"{name}: {cv} != {tv}"
+            elif cv is None or tv is None:
+                assert cv is None and tv is None, f"{name}: {cv} vs {tv}"
+            elif isinstance(cv, (bytes, str)):
+                assert cv == tv, f"{name}: {cv!r} != {tv!r}"
+            else:
+                a, b = float(cv), float(tv)
+                assert abs(a - b) <= 1e-6 * max(abs(a), abs(b), 1.0), \
+                    f"{name}: {a} != {b}"
+
+
 def main():
     n_rows = int(os.environ.get("BENCH_ROWS", "300000"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
@@ -92,36 +129,68 @@ def main():
     from tidb_tpu.session import Session
 
     store, session, load_s = build_store(n_rows)
-    print(f"# loaded {n_rows} rows in {load_s:.1f}s", file=sys.stderr)
+    print(f"# loaded {n_rows} rows in {load_s:.1f}s "
+          f"({n_rows / load_s:,.0f} rows/s)", file=sys.stderr)
+
+    configs = [("q6", Q6), ("q1", Q1), ("distinct", QDIST)]
 
     # CPU xeval baseline (store/localstore/local_region.go equivalent)
-    cpu_s, cpu_results = timed_runs(session, Q6, runs)
-    cpu_rps = n_rows / cpu_s
+    cpu = {}
+    for name, sql in configs:
+        cpu_s, cpu_results = timed_runs(session, sql, runs)
+        cpu[name] = (cpu_s, cpu_results)
 
     # TPU coprocessor
     store.set_client(TpuClient(store))
     tpu_session = Session(store)
     tpu_session.execute("use tpch")
-    tpu_s, tpu_results = timed_runs(tpu_session, Q6, runs)
-    tpu_rps = n_rows / tpu_s
+    tpu_client = store.get_client()
+    speedups = []
+    tpu_rps_all = []
+    for name, sql in configs:
+        before = (tpu_client.stats["tpu_requests"],
+                  tpu_client.stats["cpu_fallbacks"])
+        tpu_s, tpu_results = timed_runs(tpu_session, sql, runs)
+        assert tpu_client.stats["tpu_requests"] > before[0], \
+            f"{name}: never reached the TPU engine"
+        assert tpu_client.stats["cpu_fallbacks"] == before[1], \
+            f"{name}: fell back to the CPU engine"
+        cpu_s, cpu_results = cpu[name]
+        check_parity(name, cpu_results[0], tpu_results[0])
+        cpu_rps, tpu_rps = n_rows / cpu_s, n_rows / tpu_s
+        speedups.append(tpu_rps / cpu_rps)
+        tpu_rps_all.append(tpu_rps)
+        print(f"# {name}: cpu {cpu_s:.3f}s/run ({cpu_rps:,.0f} rows/s)  "
+              f"tpu {tpu_s:.4f}s/run ({tpu_rps:,.0f} rows/s)  "
+              f"speedup {tpu_rps / cpu_rps:.1f}x", file=sys.stderr)
 
     client = store.get_client()
     assert client.stats["tpu_requests"] > 0, "TPU engine was never used"
 
-    # result parity (float path: relative tolerance)
-    cpu_v = float(cpu_results[0][0][0])
-    tpu_v = float(tpu_results[0][0][0])
-    assert abs(cpu_v - tpu_v) <= 1e-6 * max(abs(cpu_v), 1.0), \
-        f"parity failure: cpu={cpu_v} tpu={tpu_v}"
+    # config 5: Q1 with the mesh client — partial aggregates combined over
+    # the device axis (psum/pmin/pmax); on single-chip hardware this runs
+    # with axis size 1, under the test env with 8 virtual devices
+    import jax
+    from tidb_tpu.parallel import CoprMesh
+    mesh_client = TpuClient(store, mesh=CoprMesh())
+    store.set_client(mesh_client)
+    mesh_session = Session(store)
+    mesh_session.execute("use tpch")
+    mesh_s, mesh_results = timed_runs(mesh_session, Q1, runs)
+    check_parity("q1_mesh", cpu["q1"][1][0], mesh_results[0])
+    assert mesh_client.stats["tpu_requests"] > 0, "mesh engine never used"
+    print(f"# q1_mesh ({len(jax.devices())} devices): {mesh_s:.4f}s/run "
+          f"({n_rows / mesh_s:,.0f} rows/s)", file=sys.stderr)
 
-    print(f"# cpu: {cpu_s:.3f}s/run ({cpu_rps:,.0f} rows/s)  "
-          f"tpu: {tpu_s:.4f}s/run ({tpu_rps:,.0f} rows/s)  "
-          f"speedup {tpu_rps / cpu_rps:.1f}x", file=sys.stderr)
+    geo_rps = math.exp(sum(math.log(x) for x in tpu_rps_all)
+                       / len(tpu_rps_all))
+    geo_speedup = math.exp(sum(math.log(x) for x in speedups)
+                           / len(speedups))
     print(json.dumps({
-        "metric": "tpch_q6_rows_per_sec_tpu",
-        "value": round(tpu_rps, 1),
+        "metric": "tpch_geomean_rows_per_sec_tpu",
+        "value": round(geo_rps, 1),
         "unit": "rows/s",
-        "vs_baseline": round(tpu_rps / cpu_rps, 2),
+        "vs_baseline": round(geo_speedup, 2),
     }))
 
 

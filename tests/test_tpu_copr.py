@@ -45,6 +45,18 @@ QUERIES = [
     "select b, count(*), sum(a), min(c), max(c) from t group by b order by b",
     "select b, avg(a) from t group by b order by b",
     "select b, count(*) from t where a > 15 group by b order by b",
+    # group by non-dict columns (ranked kernel)
+    "select a, count(*) from t group by a order by a",
+    "select a, sum(c), min(c), max(b) from t group by a order by a",
+    "select c, count(*) from t group by c order by c",
+    "select d, count(*), sum(a) from t group by d order by d",
+    "select a, b, count(*) from t group by a, b order by a, b",
+    "select id, count(*) from t group by id order by id",
+    "select a, count(*) from t where id > 100 group by a",
+    # first_row on a non-group column (exact first-in-scan-order)
+    "select b, a from t group by b order by b",
+    "select a, c from t group by a order by a",
+    "select b, d from t group by b order by b",
     # topn / limit
     "select id from t order by a desc limit 3",
     "select id from t order by c limit 2",
@@ -115,6 +127,26 @@ def test_tpu_engine_actually_used(stores):
     before = client.stats["batch_hits"]
     tpu.execute("select sum(a), min(a), max(a) from t")
     assert client.stats["batch_hits"] > before
+
+
+RANKED_QUERIES = [
+    "select a, count(*) from t group by a order by a",
+    "select a, b, count(*) from t group by a, b order by a, b",
+    "select a, b from t group by a order by a",
+    "select d, count(*), sum(a) from t group by d order by d",
+]
+
+
+@pytest.mark.parametrize("sql", RANKED_QUERIES)
+def test_ranked_group_by_stays_on_tpu(stores, sql):
+    """Int/float/time/mixed group-bys must run the ranked TPU kernel, not
+    silently fall back to the CPU engine (round-1 weak #6)."""
+    _, tpu = stores
+    client = tpu.store.get_client()
+    before = (client.stats["tpu_requests"], client.stats["cpu_fallbacks"])
+    tpu.execute(sql)
+    assert client.stats["tpu_requests"] > before[0], sql
+    assert client.stats["cpu_fallbacks"] == before[1], sql
 
 
 def test_fallback_on_unsupported(stores):

@@ -205,8 +205,10 @@ class _SelectContext:
                     out.extend(agg.get_partial_result(ctx))
                 self.writer.append_row(0, out)
         elif self.topn:
+            # ties break by scan order (seq) so output is deterministic and
+            # engine-independent (TPU top_k is stable by row index)
             items = sorted((inv.item for inv in self._heap),
-                           key=lambda it: it[0])
+                           key=lambda it: (it[0], it[1]))
             for entry, _, handle, out in items:
                 self.writer.append_row(handle, out)
         return SelectResponse(chunks=self.writer.finish())

@@ -61,6 +61,7 @@ class TpuClient(kv.Client):
         self.mesh = mesh            # parallel.CoprMesh for multi-chip
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
+        self._rank_cap_start: dict = {}
         self.stats = {"tpu_requests": 0, "cpu_fallbacks": 0,
                       "batch_packs": 0, "batch_hits": 0}
 
@@ -134,6 +135,7 @@ class TpuClient(kv.Client):
             raise Unsupported("having not lowered")
         batch = self._get_batch(sel, req.key_ranges)
         # per-request decode tables for datum reconstruction
+        self._cur_batch = batch
         self._col_pb = {c.column_id: c for c in sel.table_info.columns}
         self._dict_for = {cid: cd.dictionary
                           for cid, cd in batch.columns.items()
@@ -169,16 +171,24 @@ class TpuClient(kv.Client):
 
     def _run_aggregate(self, sel, batch, where) -> SelectResponse:
         specs = kernels.lower_aggregates(sel, batch)
-        planes = kernels.batch_planes(batch)
+        planes = kernels.batch_planes(
+            batch, with_pos=any(s.name == "first_row" for s in specs))
         live = np.zeros(batch.capacity, dtype=bool)
         live[: batch.n_rows] = True
 
         if sel.group_by:
-            gcids, gsizes = kernels.lower_group_by(sel, batch)
+            gspec = kernels.lower_group_by(sel, batch)
+            if gspec.kind == "rank":
+                if self.mesh is not None:
+                    # rank ids are batch-local; not psum-combinable
+                    raise Unsupported("ranked group-by is single-chip")
+                return self._run_ranked(sel, batch, where, specs, gspec,
+                                        planes, live)
             fn, wrapper, jitted = self._kernel(
                 sel, batch, "grouped",
-                lambda: kernels.build_grouped_agg_fn(where, specs, gcids,
-                                                     gsizes))
+                lambda: kernels.build_grouped_agg_fn(where, specs,
+                                                     gspec.cids,
+                                                     gspec.sizes))
             if self.mesh is not None:
                 outs = [np.asarray(o)
                         for o in self.mesh.run_grouped(fn, planes, live)]
@@ -186,8 +196,8 @@ class TpuClient(kv.Client):
                 i_arr, f_arr = jitted(planes, live)
                 outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
                                               np.asarray(f_arr))
-            return self._emit_grouped(sel, batch, specs, gcids, gsizes,
-                                      fn.radices, outs)
+            return self._emit_grouped(sel, batch, specs, gspec.cids,
+                                      gspec.sizes, fn.radices, outs)
         fn, wrapper, jitted = self._kernel(
             sel, batch, "scalar",
             lambda: kernels.build_scalar_agg_fn(where, specs, batch.n_rows))
@@ -238,6 +248,68 @@ class TpuClient(kv.Client):
             writer.append_row(0, row)
         return SelectResponse(chunks=writer.finish())
 
+    # escalation ladder of segment buckets for ranked group-by (last slot
+    # of each bucket is the dead-row sink); overflow → next bucket → CPU
+    _RANK_CAPS = (1025, 16385, 262145)
+
+    def _run_ranked(self, sel, batch, where, specs, gspec, planes,
+                    live) -> SelectResponse:
+        group_cols = list(zip(gspec.cids, gspec.col_kinds))
+        ngroups = -1
+        # remember which bucket a repeated query needed so re-runs skip the
+        # wasted under-sized kernel executions
+        ck = (batch._uid, repr(sel.where), repr(sel.aggregates),
+              repr(sel.group_by))
+        start = self._rank_cap_start.get(ck, self._RANK_CAPS[0])
+        for cap in self._RANK_CAPS:
+            if cap < start:
+                continue
+            _, wrapper, jitted = self._kernel(
+                sel, batch, f"rank{cap}",
+                lambda cap=cap: kernels.build_ranked_group_fn(
+                    where, specs, group_cols, cap))
+            i_arr, f_arr = jitted(planes, live)
+            outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
+                                          np.asarray(f_arr))
+            ngroups = int(outs[0])
+            if ngroups <= cap - 1:
+                self._rank_cap_start[ck] = cap
+                if len(self._rank_cap_start) > 256:
+                    self._rank_cap_start.pop(
+                        next(iter(self._rank_cap_start)))
+                return self._emit_ranked(sel, batch, specs, gspec, outs,
+                                         ngroups)
+        raise Unsupported(f"group cardinality {ngroups} exceeds rank buckets")
+
+    def _emit_ranked(self, sel, batch, specs, gspec, outs,
+                     ngroups: int) -> SelectResponse:
+        writer = ChunkWriter()
+        # outs layout: [ngroups, row_count, (rep, nonnull)×group col, aggs…]
+        base = 2 + 2 * len(gspec.cids)
+        for g in range(ngroups):
+            gvals = []
+            for j, cid in enumerate(gspec.cids):
+                nonnull = outs[2 + 2 * j + 1][g]
+                if not nonnull:
+                    gvals.append(NULL)
+                    continue
+                rep = outs[2 + 2 * j][g]
+                cd = batch.columns[cid]
+                if cd.kind == col.K_STR:
+                    gvals.append(Datum.bytes_(cd.dictionary[int(rep)]))
+                elif cd.kind == col.K_F64:
+                    gvals.append(Datum.f64(float(rep)))
+                else:
+                    gvals.append(self._i64_datum(cid, int(rep)))
+            gk = codec.encode_value(gvals)
+            row: list[Datum] = [Datum.bytes_(gk)]
+            i = base
+            for spec, e in zip(specs, sel.aggregates):
+                row.extend(self._partial_datums(spec, e, outs, i, g))
+                i += _n_outputs(spec)
+            writer.append_row(0, row)
+        return SelectResponse(chunks=writer.finish())
+
     def _partial_datums(self, spec, agg_expr, outs, i, gid) -> list[Datum]:
         """Partial-row slice for one aggregate, layout-compatible with
         AggregationFunction.get_partial_result."""
@@ -259,11 +331,41 @@ class TpuClient(kv.Client):
             else:
                 val = Datum.dec(Decimal(int(v)))
             return [Datum.i64(n), val] if name == "avg" else [val]
-        if name in ("min", "max", "first_row"):
+        if name == "first_row":
+            # v is the first contributing row's global position — gather
+            # the actual value host-side (exact CPU-engine semantics)
+            if n == 0:
+                return [NULL]
+            return [self._col_datum_at(self._cur_batch,
+                                       agg_expr.children[0].val, int(v))]
+        if name in ("min", "max"):
             if n == 0:
                 return [NULL]
             return [self._phys_to_datum(agg_expr, v)]
         raise Unsupported(name)
+
+    def _i64_datum(self, cid: int, iv: int) -> Datum:
+        """Int-plane value → Datum via the column's MySQL type."""
+        pb = self._col_pb.get(cid)
+        tp = pb.tp if pb is not None else None
+        if tp in my.TIME_TYPES:
+            return Datum(Kind.TIME, _number_to_time(iv, tp))
+        if tp == my.TypeDuration:
+            from tidb_tpu.types.time_types import Duration
+            return Datum(Kind.DURATION, Duration(iv))
+        if pb is not None and my.has_unsigned_flag(pb.flag):
+            return Datum.u64(iv)
+        return Datum.i64(iv)
+
+    def _col_datum_at(self, batch, cid: int, i: int) -> Datum:
+        cd = batch.columns[cid]
+        if not cd.valid[i]:
+            return NULL
+        if cd.kind == col.K_STR:
+            return Datum.bytes_(cd.dictionary[int(cd.values[i])])
+        if cd.kind == col.K_F64:
+            return Datum.f64(float(cd.values[i]))
+        return self._i64_datum(cid, int(cd.values[i]))
 
     def _phys_to_datum(self, agg_expr, v) -> Datum:
         """Physical kernel value → Datum, reversing columnar.datum_to_phys

@@ -30,6 +30,11 @@ F64_MAX = jnp.finfo(jnp.float64).max
 I64_MAX = (1 << 63) - 1
 I64_MIN = -(1 << 63)
 
+# pseudo column id carrying the global row position plane (arange over the
+# batch; sharded along with the data under shard_map, so positions stay
+# global across the mesh). Used by exact first_row lowering.
+POS_CID = -1
+
 
 def pack_outputs(fn):
     """Wrap a kernel so it returns (int64_stack, f64_stack) instead of a
@@ -76,15 +81,26 @@ def unpack_outputs(wrapper, i_arr: np.ndarray, f_arr: np.ndarray) -> list:
     return out
 
 
-def batch_planes(batch: col.ColumnBatch) -> dict:
+def batch_planes(batch: col.ColumnBatch, with_pos: bool = False) -> dict:
     """Host numpy → device arrays, one (values, valid) pair per column.
     Memoized on the batch: planes stay device-resident across requests
-    (HBM residency is the point of the columnar cache)."""
+    (HBM residency is the point of the columnar cache).
+
+    with_pos adds the POS_CID plane — global row positions for exact
+    first_row (sharded with the data, so positions remain global under
+    shard_map). Only requests with a first_row aggregate pay for it."""
     planes = getattr(batch, "_device_planes", None)
     if planes is None:
         planes = {cid: (jnp.asarray(cd.values), jnp.asarray(cd.valid))
                   for cid, cd in batch.columns.items()}
         batch._device_planes = planes
+    if with_pos:
+        pos = getattr(batch, "_device_pos", None)
+        if pos is None:
+            pos = (jnp.arange(batch.capacity, dtype=jnp.int64), None)
+            batch._device_pos = pos
+        planes = dict(planes)
+        planes[POS_CID] = pos
     return planes
 
 
@@ -107,28 +123,57 @@ def lower_aggregates(req: SelectRequest, batch: col.ColumnBatch) -> list[AggSpec
         name = AGG_NAME[e.tp]
         if name not in ("count", "sum", "avg", "min", "max", "first_row"):
             raise Unsupported(f"aggregate {name} not lowered yet")
-        if e.distinct and name != "count":
-            raise Unsupported("distinct only lowered for count")
+        if e.distinct and (name != "count" or req.group_by):
+            # distinct is exact only request-wide (no per-group dedup yet)
+            raise Unsupported("distinct only lowered for global count")
+        if name == "first_row":
+            # exact first-row semantics need a host-side gather by row
+            # position, which needs the argument to be a plain column
+            if not e.children or e.children[0].tp != ExprType.COLUMN_REF:
+                raise Unsupported("first_row lowering needs a column arg")
         arg = compile_expr(e.children[0], batch) if e.children else None
         specs.append(AggSpec(name, arg, e.distinct))
     return specs
 
 
-def lower_group_by(req: SelectRequest, batch: col.ColumnBatch):
-    """Group-by items → (col_ids, dict sizes). Only dictionary-encoded
-    (string) columns group on-device; raw int group-bys fall back to CPU
-    until int dictionaries land."""
-    cids, sizes = [], []
+class GroupSpec:
+    """Lowered group-by: either a mixed-radix code over dictionary columns
+    ('radix': globally consistent group ids, mesh-combinable) or a sort +
+    rank assignment over arbitrary columns ('rank': exact for any column
+    kind / cardinality, single-chip only — ids are batch-local ranks)."""
+
+    def __init__(self, kind: str, cids: list[int], sizes: list[int],
+                 col_kinds: list[str]):
+        self.kind = kind          # "radix" | "rank"
+        self.cids = cids
+        self.sizes = sizes        # radix only: dict sizes
+        self.col_kinds = col_kinds
+
+
+def lower_group_by(req: SelectRequest, batch: col.ColumnBatch) -> GroupSpec:
+    cids, kinds = [], []
     for item in req.group_by:
         e = item.expr
         if e.tp != ExprType.COLUMN_REF:
             raise Unsupported("non-column group-by")
         cd = batch.columns.get(e.val)
-        if cd is None or cd.kind != col.K_STR:
-            raise Unsupported("group-by needs a dict-encoded column")
+        if cd is None:
+            raise Unsupported("group-by column not packed")
         cids.append(e.val)
-        sizes.append(max(len(cd.dictionary), 1))
-    return cids, sizes
+        kinds.append(cd.kind)
+    if all(k == col.K_STR for k in kinds):
+        sizes = [max(len(batch.columns[c].dictionary), 1) for c in cids]
+        return GroupSpec("radix", cids, sizes, kinds)
+    return GroupSpec("rank", cids, [], kinds)
+
+
+def _orderable_i64(v):
+    """Monotone map of a value plane into int64 sort keys (floats via the
+    sign-flip bitcast trick; ints/codes are already ordered)."""
+    if v.dtype == jnp.float64:
+        i = jax.lax.bitcast_convert_type(v, jnp.int64)
+        return jnp.where(i >= 0, i, (~i) ^ jnp.int64(I64_MIN))
+    return v.astype(jnp.int64)
 
 
 # ---------------------------------------------------------------------------
@@ -165,9 +210,9 @@ def _combiners(specs: list[AggSpec], leading: list[str] | None = None):
             out.append(None if spec.distinct else "sum")
         elif spec.name in ("sum", "avg"):
             out.extend(["sum", "sum"])
-        elif spec.name == "min":
+        elif spec.name in ("min", "first_row"):
             out.extend(["sum", "min"])
-        elif spec.name in ("max", "first_row"):
+        elif spec.name == "max":
             out.extend(["sum", "max"])
         else:
             out.append(None)
@@ -201,8 +246,13 @@ def _scalar_agg(spec: AggSpec, planes, mask):
         red = jnp.min(vv) if name == "min" else jnp.max(vv)
         return (n, red)
     if name == "first_row":
-        idx = jnp.argmax(contrib)  # first live index (argmax of bool)
-        return (n, v if jnp.ndim(v) == 0 else v[idx])
+        # exact first-row semantics: smallest live row position — the first
+        # row counts even when its value is NULL (CPU oracle keeps it);
+        # the host gathers the value (mesh combine = pmin)
+        pos, _ = planes[POS_CID]
+        n_rows = jnp.sum(mask.astype(jnp.int64))
+        first = jnp.min(jnp.where(mask, pos, I64_MAX))
+        return (n_rows, first)
     raise Unsupported(name)
 
 
@@ -292,14 +342,97 @@ def _grouped_agg(spec: AggSpec, planes, mask, gid, num_segments):
             red = jax.ops.segment_max(vv, gid, num_segments=num_segments)
         return (n, red)
     if name == "first_row":
-        # group columns' values are determined by the group id; others take
-        # the max contributing value (deterministic representative)
-        vv = jnp.where(contrib, v, jnp.full_like(v, I64_MIN + 1
-                                                 if v.dtype != jnp.float64
-                                                 else -F64_MAX))
-        red = jax.ops.segment_max(vv, gid, num_segments=num_segments)
-        return (n, red)
+        # exact: smallest live row position per group — the first row
+        # counts even when its value is NULL (CPU oracle keeps it); the
+        # host gathers the value (mesh combine = pmin)
+        pos, _ = planes[POS_CID]
+        n_rows = jax.ops.segment_sum(mask.astype(jnp.int64), gid,
+                                     num_segments=num_segments)
+        first = jax.ops.segment_min(
+            jnp.where(mask, pos, I64_MAX), gid,
+            num_segments=num_segments)
+        return (n_rows, first)
     raise Unsupported(name)
+
+
+# ---------------------------------------------------------------------------
+# ranked (sort-based) grouped aggregation — arbitrary group columns
+# ---------------------------------------------------------------------------
+
+def build_ranked_group_fn(where: CompiledExpr | None, specs: list[AggSpec],
+                          group_cols: list[tuple[int, str]],
+                          num_segments: int):
+    """Group-by over arbitrary columns (int / float / time / dict-code mix)
+    via the XLA-idiomatic sort + segment-reduce route (SURVEY §7): rows are
+    lexsorted by the group key, group ids are boundary-cumsum ranks, and
+    every aggregate is a static-shaped segment reduction.
+
+    fn(planes, live) → (ngroups, row_count[S], rep_val/rep_nonnull per
+    group column, per-spec outputs…), S = num_segments; the LAST segment is
+    the dead-row sink. Ranks beyond S-1 clamp into the sink; the host
+    detects ngroups > S-1 and retries with a larger bucket (exact, no hash
+    collisions possible). Ids are batch-local ranks, so this kernel is
+    single-chip only — the client keeps rank requests off the mesh."""
+
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+
+        # lexsort: LAST key is primary → liveness first, then columns in
+        # declaration order (null flag before value, MySQL NULL-groups)
+        keys = []
+        for cid, _kind in group_cols:
+            v, va = planes[cid]
+            k = jnp.where(va, _orderable_i64(v), 0)
+            keys.append((k, (~va).astype(jnp.int64)))
+        sort_keys = []
+        for k, nullk in reversed(keys):
+            sort_keys.append(k)
+            sort_keys.append(nullk)
+        sort_keys.append((~mask).astype(jnp.int64))   # live rows first
+        order = jnp.lexsort(sort_keys)
+
+        live_s = mask[order]
+        cap = live_s.shape[0]
+        change = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        for k, nullk in keys:
+            ks, ns = k[order], nullk[order]
+            tail = (ks[1:] != ks[:-1]) | (ns[1:] != ns[:-1])
+            change = change | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), tail])
+        newgrp = change & live_s
+        ngroups = jnp.sum(newgrp.astype(jnp.int64))
+        gid_s = jnp.cumsum(newgrp.astype(jnp.int64)) - 1
+        gid_s = jnp.where(live_s,
+                          jnp.minimum(gid_s, num_segments - 1),
+                          num_segments - 1)
+        gid = jnp.zeros(cap, jnp.int64).at[order].set(gid_s)
+
+        row_count = jax.ops.segment_sum(mask.astype(jnp.int64), gid,
+                                        num_segments=num_segments)
+        outs = [ngroups, row_count]
+        # group-key representatives: constant within a group, so a masked
+        # segment_max recovers (value, non-null) exactly
+        for cid, kind in group_cols:
+            v, va = planes[cid]
+            contrib = mask & va
+            sent = -F64_MAX if v.dtype == jnp.float64 else I64_MIN + 1
+            rep = jax.ops.segment_max(
+                jnp.where(contrib, v, jnp.full_like(v, sent)), gid,
+                num_segments=num_segments)
+            nonnull = jax.ops.segment_max(contrib.astype(jnp.int64), gid,
+                                          num_segments=num_segments)
+            outs.extend([rep, nonnull])
+        for spec in specs:
+            outs.extend(_grouped_agg(spec, planes, mask, gid, num_segments))
+        return tuple(outs)
+
+    fn.num_segments = num_segments
+    # batch-local ranks cannot be psum-combined across chips
+    fn.combiners = [None]
+    return fn
 
 
 # ---------------------------------------------------------------------------

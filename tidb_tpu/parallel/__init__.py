@@ -24,6 +24,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tidb_tpu.ops import kernels as _kernels
 from tidb_tpu.ops.exprc import Unsupported
 
 AXIS = "copr"
@@ -72,17 +73,29 @@ class CoprMesh:
                 f"size {self.n}")
         ent = self._jit_cache.get(id(fn))
         if ent is None or ent[0] is not fn:
-            local = self._combined(fn)
-            sharded = shard_map(
-                local, mesh=self.mesh,
-                in_specs=(P(AXIS), P(AXIS)),  # rows sharded across the axis
-                out_specs=P())                # combined results replicated
+            if self.n == 1:
+                # axis of one: partials are already totals — no shard_map,
+                # no collectives (single-chip tunnels may only lower Sum
+                # all-reduce anyway); still validates mesh-combinability
+                self._combined(fn)
+                sharded = lambda planes, live: tuple(fn(planes, live))
+            else:
+                local = self._combined(fn)
+                sharded = shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=(P(AXIS), P(AXIS)),  # rows sharded on the axis
+                    out_specs=P())                # combined results replicated
+            # pack combined outputs into one transfer per dtype — on
+            # tunneled platforms every D2H is a full round trip
+            wrapper = _kernels.pack_outputs(sharded)
             # pin fn in the entry so its id can't be reused while cached
-            ent = (fn, jax.jit(sharded))
+            ent = (fn, wrapper, jax.jit(wrapper))
             self._jit_cache[id(fn)] = ent
             if len(self._jit_cache) > 256:
                 self._jit_cache.pop(next(iter(self._jit_cache)))
-        return ent[1](planes, jnp.asarray(live))
+        i_arr, f_arr = ent[2](planes, jnp.asarray(live))
+        return _kernels.unpack_outputs(ent[1], np.asarray(i_arr),
+                                       np.asarray(f_arr))
 
     # the client calls these; signatures match the single-chip jit path
     def run_scalar(self, fn, planes, live):
