@@ -257,3 +257,61 @@ def test_unsigned_bigint_pk_not_handle(domain, store):
     rows = list(tbl.iter_records(store.get_snapshot()))
     assert len(rows) == 1
     assert rows[0][1][0].get_int() == big
+
+
+def test_allocator_rebase_respects_meta_cursor():
+    """A second allocator rebasing below an already-advanced meta cursor
+    must not re-dispense ids from the first allocator's cached range."""
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.table.autoid import Allocator
+
+    store = new_store("memory://autoid_rebase")
+    s = Session(store)
+    s.execute("create database autoid_t")
+    s.execute("use autoid_t")
+    s.execute("create table t (x int)")
+    info = s.info_schema()
+    tbl = info.table_by_name("autoid_t", "t")
+    db_id = info.schema_by_name("autoid_t").id
+
+    a1 = Allocator(store, db_id, tbl.id)
+    assert a1.alloc() == 1          # meta cursor -> 1000; a1 holds 1..1000
+    a2 = Allocator(store, db_id, tbl.id)
+    a2.rebase(5)                    # explicit INSERT id below the cursor
+    assert a2.alloc() > 1000        # must not collide with a1's range
+
+
+def test_allocator_sequential_rebase_batches_meta_txns():
+    """Ascending explicit PKs (bulk load) hit meta once per step, not per
+    row (meta/autoid/autoid.go Rebase headroom)."""
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.table.autoid import Allocator
+
+    store = new_store("memory://autoid_seq")
+    s = Session(store)
+    s.execute("create database autoid_s")
+    s.execute("use autoid_s")
+    s.execute("create table t (x int)")
+    info = s.info_schema()
+    tbl = info.table_by_name("autoid_s", "t")
+    db_id = info.schema_by_name("autoid_s").id
+
+    a = Allocator(store, db_id, tbl.id)
+    calls = 0
+    orig = a._refill
+
+    import tidb_tpu.table.autoid as autoid_mod
+    real_run = autoid_mod.run_in_new_txn
+
+    def counting_run(store_, retryable, fn):
+        nonlocal calls
+        calls += 1
+        return real_run(store_, retryable, fn)
+
+    autoid_mod.run_in_new_txn = counting_run
+    try:
+        for v in range(1, 2001):
+            a.rebase(v)
+    finally:
+        autoid_mod.run_in_new_txn = real_run
+    assert calls <= 4, f"{calls} meta txns for 2000 sequential rebases"

@@ -22,6 +22,7 @@ from tidb_tpu.types.datum import Datum, Kind, NULL, MIN_NOT_NULL, MAX_VALUE
 from tidb_tpu.types.time_types import Duration, Time
 from tidb_tpu.codec import number as num
 from tidb_tpu.codec import bytes_codec as bc
+from tidb_tpu.native import codecx as _cx
 
 NIL_FLAG = 0x00
 BYTES_FLAG = 0x01
@@ -84,6 +85,13 @@ def encode_datum(buf: bytearray, d: Datum, comparable: bool) -> None:
 
 
 def encode_key(datums, buf: bytearray | None = None) -> bytes:
+    if buf is None and _cx is not None:
+        if not isinstance(datums, (list, tuple)):
+            datums = list(datums)  # keep the fallback's input intact
+        try:
+            return _cx.encode_datums(datums, True)
+        except _cx.Unsupported:
+            pass
     buf = bytearray() if buf is None else buf
     for d in datums:
         encode_datum(buf, d, comparable=True)
@@ -91,6 +99,13 @@ def encode_key(datums, buf: bytearray | None = None) -> bytes:
 
 
 def encode_value(datums, buf: bytearray | None = None) -> bytes:
+    if buf is None and _cx is not None:
+        if not isinstance(datums, (list, tuple)):
+            datums = list(datums)  # keep the fallback's input intact
+        try:
+            return _cx.encode_datums(datums, False)
+        except _cx.Unsupported:
+            pass
     buf = bytearray() if buf is None else buf
     for d in datums:
         encode_datum(buf, d, comparable=False)

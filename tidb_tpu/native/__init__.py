@@ -1,0 +1,57 @@
+"""Native (C) runtime components, built on demand.
+
+The C sources live next to this file; the extension is compiled once into
+this directory with the host toolchain (cc -O2 -shared) and imported from
+there. Every consumer must treat the import as optional — the pure-Python
+implementations remain the semantic definition and the fallback (the
+driver environment guarantees a toolchain, but portability is free).
+
+Components:
+    codecx — datum codec encode fast path (tidb_tpu/codec parity)
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build(name: str):
+    src = os.path.join(_DIR, f"{name}.c")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_DIR, f"{name}{suffix}")
+    try:
+        stale = (not os.path.exists(out)
+                 or os.path.getmtime(out) < os.path.getmtime(src))
+    except OSError:
+        stale = False  # source missing: use a prebuilt .so if present
+        if not os.path.exists(out):
+            return None
+    if stale:
+        cc = os.environ.get("CC", "cc")
+        include = sysconfig.get_paths()["include"]
+        cmd = [cc, "-O2", "-fPIC", "-shared", "-o", out, src,
+               f"-I{include}"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    spec = importlib.util.spec_from_file_location(
+        f"tidb_tpu.native.{name}", out)
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    sys.modules[f"tidb_tpu.native.{name}"] = mod
+    return mod
+
+
+codecx = None if os.environ.get("TIDB_TPU_NO_NATIVE") else _build("codecx")

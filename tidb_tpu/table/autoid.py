@@ -33,7 +33,11 @@ class Allocator:
             return self._base
 
     def rebase(self, new_base: int) -> None:
-        """Ensure future allocations exceed new_base (explicit INSERT values)."""
+        """Ensure future allocations exceed new_base (explicit INSERT values).
+
+        Reserves a full step of headroom beyond new_base so sequential
+        explicit values (bulk loads with ascending PKs) hit meta once per
+        step, not once per row (meta/autoid/autoid.go Rebase)."""
         with self._lock:
             if new_base < self._base:
                 return
@@ -44,11 +48,15 @@ class Allocator:
             def bump(txn):
                 m = Meta(txn)
                 cur = m.gen_auto_table_id(self.db_id, self.table_id, 0)
-                if new_base > cur:
-                    m.gen_auto_table_id(self.db_id, self.table_id, new_base - cur)
+                target = max(new_base, cur)
+                return m.gen_auto_table_id(self.db_id, self.table_id,
+                                           target + self.step - cur)
 
-            run_in_new_txn(self.store, True, bump)
-            self._base = self._end = new_base
+            self._end = run_in_new_txn(self.store, True, bump)
+            # base resumes at the meta cursor (end - step), NOT new_base:
+            # if another allocator already pushed meta past new_base, ids
+            # below the cursor may be outstanding elsewhere
+            self._base = self._end - self.step
 
     def _refill(self, step: int) -> None:
         def grab(txn):

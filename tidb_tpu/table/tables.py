@@ -83,6 +83,28 @@ class Table:
         self.db_id = db_id
         self._alloc = Allocator(store, db_id, info.id) if store is not None else None
         self.indices = [Index(self, ii) for ii in info.indices]
+        self._write_layout_cache = None
+
+    def _write_layout(self):
+        """Cached (col_ids, offsets) of non-pk writable columns plus the
+        encoded row-key prefix — recomputed when any column's schema state
+        changes (online DDL mutates states in place mid-job). The bulk
+        write path calls this per row; the token check is two tuples."""
+        info = self.info
+        token = tuple((c.id, c.state) for c in info.columns)
+        cached = self._write_layout_cache
+        if cached is not None and cached[0] == token:
+            return cached[1]
+        pk_col = info.pk_handle_column()
+        ids, offsets = [], []
+        for col in info.writable_columns():
+            if pk_col is not None and col.id == pk_col.id:
+                continue
+            ids.append(col.id)
+            offsets.append(col.offset)
+        layout = (pk_col, ids, offsets, tc.table_record_prefix(self.id))
+        self._write_layout_cache = (token, layout)
+        return layout
 
     # ---- handles / auto id ----
     def alloc_handle(self) -> int:
@@ -99,8 +121,7 @@ class Table:
                    skip_unique_check: bool = False) -> int:
         """Insert a full row (already cast to column types, in column offset
         order including non-public columns as NULL). Returns the handle."""
-        info = self.info
-        pk_col = info.pk_handle_column()
+        pk_col, col_ids, offsets, key_prefix = self._write_layout()
         if handle is None:
             if pk_col is not None:
                 handle = row[pk_col.offset].get_int()
@@ -113,7 +134,7 @@ class Table:
 
         # row key with duplicate detection (PresumeKeyNotExists lazy check:
         # executor_write.go + union_store.go markLazyConditionPair)
-        key = tc.encode_row_key(self.id, handle)
+        key = key_prefix + tc.enc_handle(handle)
         if not skip_unique_check:
             txn.set_option(OPT_PRESUME_KEY_NOT_EXISTS)
             try:
@@ -130,12 +151,8 @@ class Table:
                 continue
             idx.create(txn, idx._values_for_row(row), handle)
 
-        col_ids, values = [], []
-        for col in info.writable_columns():
-            if pk_col is not None and col.id == pk_col.id:
-                continue  # handle lives in the key
-            col_ids.append(col.id)
-            values.append(row[col.offset])
+        # pk handle lives in the key; everything else in the value
+        values = [row[off] for off in offsets]
         txn.set(key, tc.encode_row(col_ids, values))
         return handle
 
