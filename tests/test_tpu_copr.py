@@ -201,3 +201,27 @@ def test_mesh_parity(stores, mesh_store, sql):
     assert _norm(cpu_rows) == _norm(mesh_rows), sql
     client = mesh_store.store.get_client()
     assert client.stats["tpu_requests"] > 0
+
+
+def test_set_copr_backend_sysvar():
+    """SET tidb_copr_backend='tpu' must install/route to the TPU engine;
+    'cpu' restores the default engine (round-1 weak #3: the var was dead)."""
+    s = Session(new_store("memory://sysvar_route"))
+    s.execute("create database sv")
+    s.execute("use sv")
+    s.execute("create table t (id bigint primary key, a int)")
+    s.execute("insert into t values (1, 10), (2, 20)")
+    assert not isinstance(s.store.get_client(), TpuClient)
+
+    s.execute("set tidb_copr_backend = 'tpu'")
+    client = s.store.get_client()
+    assert isinstance(client, TpuClient)
+    assert s.execute("select sum(a) from t")[0].values() == [[30]]
+    assert client.stats["tpu_requests"] > 0
+
+    s.execute("set tidb_copr_backend = 'cpu'")
+    assert not isinstance(s.store.get_client(), TpuClient)
+    assert s.execute("select sum(a) from t")[0].values() == [[30]]
+
+    with pytest.raises(Exception):
+        s.execute("set tidb_copr_backend = 'gpu'")

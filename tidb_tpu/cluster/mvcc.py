@@ -69,6 +69,15 @@ class MvccStore:
         # start_ts of explicitly rolled-back txns (rollback records)
         self._rollbacks: set[int] = set()
         self._sorted_keys: list[bytes] | None = []
+        # ascending commit_ts of every commit batch (data_version_at)
+        self._commit_log: list[int] = []
+
+    def data_version_at(self, read_ts: int) -> int:
+        """Count of commit events visible at read_ts: equal versions imply
+        identical visible data — the columnar cache key (mirrors
+        localstore.LocalStore.data_version_at)."""
+        with self._lock:
+            return bisect.bisect_right(self._commit_log, read_ts)
 
     # ---- reads ----
 
@@ -152,6 +161,10 @@ class MvccStore:
                         continue
                     raise TxnAborted(
                         f"commit of {key!r}@{start_ts}: lock missing")
+            # visible-data version log: any commit advances the version
+            # seen by readers at ts >= commit_ts (columnar cache key)
+            i = bisect.bisect_left(self._commit_log, commit_ts)
+            self._commit_log.insert(i, commit_ts)
             for key in keys:
                 lock = self._locks.pop(key, None)
                 if lock is None or lock.start_ts != start_ts:

@@ -231,6 +231,17 @@ class DistStore(kv.Storage):
     def current_version(self) -> int:
         return self.oracle.current_version()
 
+    def data_version_at(self, start_ts: int) -> int:
+        """Visible-data version for snapshot reads at start_ts — the TPU
+        columnar cache key (splits/leader changes do NOT bump it: topology
+        moves no data)."""
+        return self.mvcc.data_version_at(start_ts)
+
+    def copr_cpu_client(self) -> kv.Client:
+        """CPU coprocessor engine for this storage — the TpuClient's
+        fallback path (region fan-out with the full retry ladder)."""
+        return DistCoprClient(self)
+
     def uuid(self) -> str:
         return f"cluster-{id(self.cluster):x}"
 

@@ -17,13 +17,18 @@ class ExecContext:
         self.store = store
         self.domain = domain
         self.current_db = current_db
-        self.client = store.get_client()
         self.params: list = []
         self._txn = None
         self.affected_rows = 0
         self.last_insert_id = 0
         self.dirty_tables: set[int] = set()
         self.vars: dict[str, str] = {}
+
+    @property
+    def client(self):
+        """Live view of the store's coprocessor client (engine swaps via
+        SET tidb_copr_backend take effect immediately)."""
+        return self.store.get_client()
 
     # ---- schema ----
     def info_schema(self):

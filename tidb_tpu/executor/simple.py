@@ -88,6 +88,8 @@ def _set(session, stmt: ast.SetStmt):
             session.vars.users[va.name.lower()] = value
             continue
         sval = "" if value.is_null() else _datum_str(value)
+        if va.name.lower() == "tidb_copr_backend":
+            session.apply_copr_backend(sval)  # validates before storing
         if va.is_global:
             session.global_vars.set(va.name, sval)
             session.persist_global_var(va.name, sval)

@@ -165,6 +165,11 @@ class LocalStore(Storage):
         """Install an alternative coprocessor client (e.g. ops.TpuClient)."""
         self._client = client
 
+    def copr_cpu_client(self) -> Client:
+        """CPU coprocessor engine (TpuClient fallback path)."""
+        from tidb_tpu.localstore.local_client import LocalClient
+        return LocalClient(self)
+
     def current_version(self) -> int:
         return self.oracle.current_version()
 

@@ -57,7 +57,13 @@ class _SingleResponse(kv.Response):
 class TpuClient(kv.Client):
     def __init__(self, store, mesh=None):
         self.store = store
-        self.cpu = LocalClient(store)
+        # CPU fallback engine: the store's own coprocessor client (cluster
+        # stores fan out per region with the retry ladder; localstore runs
+        # in-process) — the TPU tier itself is storage-agnostic because it
+        # packs batches through the store's SNAPSHOT, where region routing,
+        # leader changes and lock resolution already live
+        factory = getattr(store, "copr_cpu_client", None)
+        self.cpu = factory() if factory is not None else LocalClient(store)
         self.mesh = mesh            # parallel.CoprMesh for multi-chip
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
@@ -109,25 +115,38 @@ class TpuClient(kv.Client):
 
     def _get_batch(self, sel: SelectRequest, ranges) -> col.ColumnBatch:
         cols = sel.table_info.columns
+        base_key = (sel.table_info.table_id,
+                    tuple(c.column_id for c in cols),
+                    tuple((r.start, r.end) for r in ranges))
         version = self.store.data_version_at(sel.start_ts)
-        key = (sel.table_info.table_id,
-               tuple(c.column_id for c in cols),
-               tuple((r.start, r.end) for r in ranges),
-               version)
-        batch = self._batch_cache.get(key)
-        if batch is None:
-            snapshot = self.store.get_snapshot(sel.start_ts)
-            defaults = {c.column_id: c.default_val for c in cols
-                        if c.default_val is not None}
+        batch = self._batch_cache.get(base_key + (version,))
+        if batch is not None:
+            self.stats["batch_hits"] += 1
+            return batch
+        snapshot = self.store.get_snapshot(sel.start_ts)
+        defaults = {c.column_id: c.default_val for c in cols
+                    if c.default_val is not None}
+        # stabilization loop: on a cluster store, commits with a commit_ts
+        # below our start_ts can land DURING the pack (lock resolution),
+        # so the version is only a sound cache key if it is identical
+        # before and after packing; a churning version means other readers
+        # at the same key could see a different row set — don't cache
+        for _ in range(3):
             batch = col.pack_ranges(snapshot, sel.table_info.table_id, cols,
                                     ranges, defaults)
-            batch._uid = next(self._uid_gen)
-            self._batch_cache[key] = batch
-            self.stats["batch_packs"] += 1
-            if len(self._batch_cache) > 64:
-                self._batch_cache.pop(next(iter(self._batch_cache)))
+            after = self.store.data_version_at(sel.start_ts)
+            if after == version:
+                break
+            version = after
         else:
-            self.stats["batch_hits"] += 1
+            batch._uid = next(self._uid_gen)
+            self.stats["batch_packs"] += 1
+            return batch  # version still churning: serve uncached
+        batch._uid = next(self._uid_gen)
+        self._batch_cache[base_key + (version,)] = batch
+        self.stats["batch_packs"] += 1
+        if len(self._batch_cache) > 64:
+            self._batch_cache.pop(next(iter(self._batch_cache)))
         return batch
 
     def _send_tpu(self, req: kv.Request, sel: SelectRequest) -> SelectResponse:

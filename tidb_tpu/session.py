@@ -53,7 +53,6 @@ class Session:
     def __init__(self, store):
         self.store = store
         self.domain = get_domain(store)
-        self.client = store.get_client()
         self.vars = SessionVars()
         self.vars.connection_id = next(_conn_id_gen)
         self.global_vars = _global_vars_by_store.setdefault(
@@ -65,6 +64,12 @@ class Session:
         self.params: list[Datum] = []
         self.dirty_tables: set[int] = set()
         bootstrap(self)
+
+    @property
+    def client(self):
+        """Live view of the store's coprocessor client so SET
+        tidb_copr_backend (engine swap) affects this session immediately."""
+        return self.store.get_client()
 
     # ------------------------------------------------------------------
     # context surface used by planner/executors (ExecContext duck-type)
@@ -229,6 +234,24 @@ class Session:
             if self.vars.autocommit:
                 self.commit_txn()
         return rs
+
+    def apply_copr_backend(self, backend: str) -> None:
+        """SET tidb_copr_backend = 'cpu' | 'tpu' — swap the coprocessor
+        engine behind kv.Client. The client is a store-level seam (one
+        engine serves every session on the storage), mirroring how the
+        reference selects its coprocessor implementation per store."""
+        backend = backend.strip().lower()
+        if backend == "tpu":
+            from tidb_tpu.ops import TpuClient
+            if not isinstance(self.store.get_client(), TpuClient):
+                self.store.set_client(TpuClient(self.store))
+        elif backend == "cpu":
+            factory = getattr(self.store, "copr_cpu_client", None)
+            if factory is not None:
+                self.store.set_client(factory())
+        else:
+            raise errors.ExecError(
+                f"unknown tidb_copr_backend {backend!r} (cpu | tpu)")
 
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
