@@ -1,0 +1,214 @@
+"""Subqueries, derived tables, UNION — end-to-end SQL tests.
+
+Reference behaviors: parser/parser.y (SubSelect/UnionStmt productions),
+executor/executor.go (Apply/Exists/MaxOneRow/HashSemiJoin/Union),
+plan/expression_rewriter.go (scalar / EXISTS / IN subquery lowering).
+"""
+
+import pytest
+
+from testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.exec("create database test")
+    tk.exec("use test")
+    tk.exec("create table t (id int primary key, a int, b varchar(32))")
+    tk.exec("insert into t values (1, 10, 'x'), (2, 20, 'y'), (3, 30, 'z'), "
+            "(4, 40, 'x'), (5, null, 'w')")
+    tk.exec("create table s (id int primary key, ta int, c int)")
+    tk.exec("insert into s values (1, 10, 100), (2, 20, 200), (3, 20, 300), "
+            "(4, 99, 400)")
+    return tk
+
+
+# ---------------------------------------------------------------------------
+# UNION
+# ---------------------------------------------------------------------------
+
+class TestUnion:
+    def test_union_all(self, tk):
+        tk.exec("select 1 union all select 2 union all select 1") \
+            .check([[1], [2], [1]])
+
+    def test_union_distinct(self, tk):
+        tk.exec("select 1 union select 2 union select 1").sort() \
+            .check([[1], [2]])
+
+    def test_union_tables_order_limit(self, tk):
+        tk.exec("select a from t where a <= 20 union all select c from s "
+                "order by 1 limit 3").check([[10], [20], [100]])
+
+    def test_union_parenthesized(self, tk):
+        tk.exec("(select 1) union all (select 2)").check([[1], [2]])
+
+    def test_union_column_count_mismatch(self, tk):
+        with pytest.raises(Exception):
+            tk.exec("select 1, 2 union select 3")
+
+    def test_union_mixed_all_distinct(self, tk):
+        # DISTINCT dedups operands to its left only (MySQL semantics)
+        tk.exec("select 1 union select 2 union all select 2") \
+            .check([[1], [2], [2]])
+        tk.exec("select 1 union all select 1 union select 2").sort() \
+            .check([[1], [2]])
+
+    def test_parenthesized_select_trailing_limit(self, tk):
+        tk.exec("(select a from t where a is not null) order by 1 limit 2") \
+            .check([[10], [20]])
+
+    def test_union_in_derived_table(self, tk):
+        tk.exec("select count(*) from (select a from t union all "
+                "select c from s) u").check([[9]])
+
+
+# ---------------------------------------------------------------------------
+# derived tables
+# ---------------------------------------------------------------------------
+
+class TestDerivedTable:
+    def test_basic(self, tk):
+        tk.exec("select d.a from (select a from t where a > 20) d "
+                "order by d.a").check([[30], [40]])
+
+    def test_aggregate_inside(self, tk):
+        tk.exec("select cnt from (select b, count(*) cnt from t group by b) "
+                "g where g.cnt > 1").check([[2]])
+
+    def test_aggregate_over_derived(self, tk):
+        tk.exec("select sum(x) from (select a + 1 x from t where a is not "
+                "null) d").check([[104]])
+
+    def test_join_derived(self, tk):
+        tk.exec("select t.id, d.mx from t, (select max(c) mx from s) d "
+                "where t.id = 1").check([[1, 400]])
+
+    def test_requires_alias(self, tk):
+        with pytest.raises(Exception):
+            tk.exec("select * from (select a from t)")
+
+
+# ---------------------------------------------------------------------------
+# scalar subqueries
+# ---------------------------------------------------------------------------
+
+class TestScalarSubquery:
+    def test_uncorrelated_where(self, tk):
+        tk.exec("select id from t where a = (select max(c) / 10 from s)") \
+            .check([[4]])
+
+    def test_uncorrelated_select_list(self, tk):
+        tk.exec("select id, (select min(ta) from s) from t where id = 2") \
+            .check([[2, 10]])
+
+    def test_empty_yields_null(self, tk):
+        tk.exec("select (select c from s where ta = -1) from t "
+                "where id = 1").check([[None]])
+
+    def test_more_than_one_row_errors(self, tk):
+        with pytest.raises(Exception):
+            tk.exec("select id from t where a = (select ta from s)")
+
+    def test_correlated(self, tk):
+        # per-row max over matching s rows (TPC-H Q17 shape)
+        tk.exec("select id from t where a < (select max(c) from s "
+                "where s.ta = t.a) order by id").check([[1], [2]])
+
+    def test_correlated_select_list(self, tk):
+        tk.exec("select id, (select count(*) from s where s.ta = t.a) "
+                "from t order by id").check(
+            [[1, 1], [2, 2], [3, 0], [4, 0], [5, 0]])
+
+
+# ---------------------------------------------------------------------------
+# EXISTS
+# ---------------------------------------------------------------------------
+
+class TestExists:
+    def test_uncorrelated_true(self, tk):
+        tk.exec("select count(*) from t where exists (select 1 from s)") \
+            .check([[5]])
+
+    def test_uncorrelated_false(self, tk):
+        tk.exec("select count(*) from t where exists (select 1 from s "
+                "where ta < 0)").check([[0]])
+
+    def test_correlated(self, tk):
+        tk.exec("select id from t where exists (select 1 from s "
+                "where s.ta = t.a) order by id").check([[1], [2]])
+
+    def test_not_exists(self, tk):
+        tk.exec("select id from t where not exists (select 1 from s "
+                "where s.ta = t.a) order by id").check([[3], [4], [5]])
+
+
+# ---------------------------------------------------------------------------
+# IN subqueries
+# ---------------------------------------------------------------------------
+
+class TestInSubquery:
+    def test_uncorrelated(self, tk):
+        tk.exec("select id from t where a in (select ta from s) "
+                "order by id").check([[1], [2]])
+
+    def test_uncorrelated_not_in(self, tk):
+        tk.exec("select id from t where a not in (select ta from s) "
+                "order by id").check([[3], [4]])
+
+    def test_not_in_with_inner_null(self, tk):
+        tk.exec("insert into s values (5, null, 500)")
+        # inner set contains NULL → NOT IN is never TRUE
+        tk.exec("select count(*) from t where a not in (select ta from s)") \
+            .check([[0]])
+
+    def test_in_select_list_3vl(self, tk):
+        tk.exec("select id, a in (select ta from s) from t order by id") \
+            .check([[1, 1], [2, 1], [3, 0], [4, 0], [5, None]])
+
+    def test_correlated_in(self, tk):
+        tk.exec("select id from t where a in (select ta from s "
+                "where s.c <= 200) order by id").check([[1], [2]])
+        tk.exec("select id from t where id in (select id from s "
+                "where s.ta = t.a) order by id").check([[1], [2]])
+
+    def test_in_cross_type_numeric(self, tk):
+        # int probe vs decimal/float inner set must match numerically
+        tk.exec("select id from t where 1 in (select 1.0) order by id") \
+            .check([[1], [2], [3], [4], [5]])
+        tk.exec("select count(*) from t where a in (select ta + 0.0 from s)") \
+            .check([[2]])
+
+    def test_in_grouped_subquery(self, tk):
+        # TPC-H Q18 shape: IN over GROUP BY ... HAVING
+        tk.exec("select id from t where a in (select ta from s group by ta "
+                "having count(*) > 1) order by id").check([[2]])
+
+
+# ---------------------------------------------------------------------------
+# regression: mixed shapes
+# ---------------------------------------------------------------------------
+
+class TestMixedSubqueries:
+    def test_subquery_plus_filter_pushdown(self, tk):
+        tk.exec("select id from t where a > 10 and exists (select 1 from s "
+                "where s.ta = t.a) order by id").check([[2]])
+
+    def test_nested_subquery(self, tk):
+        tk.exec("select id from t where a in (select ta from s where c in "
+                "(select c from s where c >= 300)) order by id").check([[2]])
+
+    def test_union_of_subquery_filters(self, tk):
+        tk.exec("select id from t where a in (select ta from s) union all "
+                "select id from t where a = 30 order by 1") \
+            .check([[1], [2], [3]])
+
+    def test_update_with_subquery_where(self, tk):
+        tk.exec("update t set a = 99 where id in (select id from s "
+                "where c = 400)")
+        tk.exec("select a from t where id = 4").check([[99]])
+
+    def test_delete_with_subquery_where(self, tk):
+        tk.exec("delete from t where a in (select ta from s where c = 100)")
+        tk.exec("select count(*) from t").check([[4]])

@@ -59,6 +59,15 @@ class ExecutorBuilder:
             return ex.HashJoinCartesianFix(left, right, p, p.schema)
         if isinstance(p, pl.PhysicalUnion):
             return ex.UnionExec([self.build(c) for c in p.children], p.schema)
+        if isinstance(p, pl.PhysicalApply):
+            return ex.ApplyExec(self.build(p.child), p, self.ctx, p.schema)
+        if isinstance(p, pl.PhysicalHashSemiJoin):
+            return ex.HashSemiJoinExec(self.build(p.children[0]),
+                                       self.build(p.children[1]), p, p.schema)
+        if isinstance(p, pl.PhysicalExists):
+            return ex.ExistsExec(self.build(p.child), p.schema)
+        if isinstance(p, pl.PhysicalMaxOneRow):
+            return ex.MaxOneRowExec(self.build(p.child))
         if isinstance(p, pl.PhysicalTableDual):
             return ex.TableDualExec(p.schema, p.row_count)
         if isinstance(p, pl.Insert):

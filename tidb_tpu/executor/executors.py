@@ -418,6 +418,135 @@ class TableDualExec(Executor):
         return []
 
 
+def _in_key(d: Datum):
+    """Hash key for IN-subquery probing. Numeric kinds use the raw Python
+    value: int/float/Decimal hash equal when numerically equal, so
+    `1 IN (SELECT 1.0)` matches — mirroring compare_datum's coercion on
+    the correlated path. Everything else uses the order-preserving
+    encoding."""
+    from tidb_tpu.types.datum import Kind
+    if d.kind in (Kind.INT64, Kind.UINT64, Kind.FLOAT64, Kind.DECIMAL):
+        return d.val
+    return codec.encode_value([d])
+
+
+def _in_verdict(matched: bool, x_null: bool, any_rows: bool,
+                has_null: bool, anti: bool) -> Datum:
+    """SQL 3VL for `x IN (set)`: TRUE on a match; NULL when x is NULL and
+    the set is non-empty, or when there is no match but the set contains
+    NULL; FALSE otherwise. NOT IN negates with NULL preserved
+    (reference executor/executor.go HashSemiJoinExec null-aware probe)."""
+    if matched:
+        v: bool | None = True
+    elif x_null and any_rows:
+        v = None
+    elif has_null:
+        v = None
+    else:
+        v = False
+    if anti and v is not None:
+        v = not v
+    return NULL if v is None else Datum.i64(1 if v else 0)
+
+
+class ApplyExec(Executor):
+    """Re-evaluates the inner physical plan per outer row (executor
+    Apply, reference executor/executor.go). The current outer row is
+    published through the plan's shared cell so CorrelatedColumns inside
+    the inner tree read it; uncorrelated inners are drained once and
+    cached.
+
+    mode 'row': inner emits exactly one row (Exists/MaxOneRow on top) →
+    output outer_row + inner_row. mode 'semi': null-aware IN →
+    outer_row + [aux]."""
+
+    def __init__(self, outer: Executor, plan, ctx, schema: Schema):
+        self.children = [outer]
+        self.plan = plan
+        self.ctx = ctx
+        self.schema = schema
+        self._cache: list | None = None
+
+    def _inner_rows(self) -> list:
+        if not self.plan.correlated and self._cache is not None:
+            return self._cache
+        from tidb_tpu.executor.builder import ExecutorBuilder
+        inner = ExecutorBuilder(self.ctx).build(self.plan.inner_plan)
+        try:
+            rows = inner.drain()
+        finally:
+            inner.close()
+        if not self.plan.correlated:
+            self._cache = rows
+        return rows
+
+    def next(self):
+        outer = self.children[0]
+        row = outer.next()
+        if row is None:
+            return None
+        self.last_handle = outer.last_handle
+        self.plan.cell[0] = row
+        rows = self._inner_rows()
+        if self.plan.mode == "row":
+            return row + rows[0]
+        # semi: null-aware IN over single-column inner rows
+        x = self.plan.target_expr.eval(row)
+        matched = has_null = False
+        for r in rows:
+            y = r[0]
+            if y.is_null():
+                has_null = True
+            elif not x.is_null() and compare_datum(x, y) == 0:
+                matched = True
+                break
+        return row + [_in_verdict(matched, x.is_null(), bool(rows),
+                                  has_null, self.plan.anti)]
+
+
+class HashSemiJoinExec(Executor):
+    """Null-aware hash semi join for uncorrelated IN-subqueries; always
+    emits the aux match column (executor/executor.go HashSemiJoinExec with
+    auxMode)."""
+
+    def __init__(self, outer: Executor, inner: Executor, plan,
+                 schema: Schema):
+        self.children = [outer, inner]
+        self.plan = plan
+        self.schema = schema
+        self._keys: set | None = None
+        self._has_null = False
+        self._any_rows = False
+
+    def _build(self):
+        inner = self.children[1]
+        keys: set = set()
+        while True:
+            row = inner.next()
+            if row is None:
+                break
+            self._any_rows = True
+            y = self.plan.right_key.eval(row)
+            if y.is_null():
+                self._has_null = True
+            else:
+                keys.add(_in_key(y))
+        self._keys = keys
+
+    def next(self):
+        if self._keys is None:
+            self._build()
+        outer = self.children[0]
+        row = outer.next()
+        if row is None:
+            return None
+        self.last_handle = outer.last_handle
+        x = self.plan.left_key.eval(row)
+        matched = not x.is_null() and _in_key(x) in self._keys
+        return row + [_in_verdict(matched, x.is_null(), self._any_rows,
+                                  self._has_null, self.plan.anti)]
+
+
 class ExistsExec(Executor):
     def __init__(self, child: Executor, schema: Schema):
         self.children = [child]

@@ -90,6 +90,40 @@ class Column(Expression):
         return ".".join(parts) or f"col#{self.position}"
 
 
+class CorrelatedColumn(Expression):
+    """A reference to a column of an enclosing query, evaluated against the
+    current outer row stored in a shared cell (set per outer row by
+    ApplyExec). Deliberately NOT a Column subclass: the planner's rules and
+    the pushdown converter treat it as an opaque (constant-per-outer-row)
+    leaf, so correlated conditions never cross the coprocessor boundary.
+    Reference: expression/schema.go + plan/expression_rewriter.go
+    (correlated column handling)."""
+
+    def __init__(self, col: Column, cell: list):
+        self.col = col          # outer-scope identity (from_id/position)
+        self.cell = cell        # [outer_row] shared with the owning Apply
+        self.ret_type = col.ret_type
+        self.idx = -1           # outer-row slot, bound at Apply resolve time
+
+    def eval(self, row=None) -> Datum:
+        outer = self.cell[0]
+        if outer is None or self.idx < 0:
+            raise errors.PlanError(f"correlated column {self!r} unbound")
+        return outer[self.idx]
+
+    def clone(self) -> "CorrelatedColumn":
+        c = CorrelatedColumn(self.col, self.cell)
+        c.idx = self.idx
+        return c
+
+    def equal(self, other: Expression) -> bool:
+        return (isinstance(other, CorrelatedColumn)
+                and other.col.equal(self.col) and other.cell is self.cell)
+
+    def __repr__(self):
+        return f"corr({self.col!r})"
+
+
 class Constant(Expression):
     def __init__(self, value: Datum, ret_type: FieldType | None = None):
         self.value = value

@@ -123,11 +123,14 @@ class Parser:
     # ---- statement dispatch ----
     def _parse_statement(self) -> ast.StmtNode:
         t = self._cur()
+        if t.tp == lx.OP and t.val == "(":
+            # (SELECT ...) [UNION ...] as a top-level statement
+            return self._parse_select_or_union()
         if t.tp != lx.KEYWORD:
             self._fail("expected statement keyword")
         kw = t.val
         handlers = {
-            "SELECT": self._parse_select,
+            "SELECT": self._parse_select_or_union,
             "INSERT": self._parse_insert,
             "REPLACE": self._parse_insert,
             "UPDATE": self._parse_update,
@@ -154,6 +157,73 @@ class Parser:
         return h()
 
     # ================= SELECT =================
+
+    def _parse_select_or_union(self) -> ast.StmtNode:
+        """SELECT or (SELECT) [UNION [ALL] ...] with a trailing ORDER BY /
+        LIMIT belonging to the whole union (parser.y UnionStmt / SubSelect
+        productions, reference parser/parser.y)."""
+        term, paren = self._parse_union_term()
+        if not self._at_kw("UNION"):
+            if paren:
+                # (SELECT ...) [ORDER BY ...] [LIMIT ...] without UNION
+                if self._try_kw("ORDER"):
+                    self._expect_kw("BY")
+                    term.order_by = self._parse_by_items()
+                lim = self._parse_limit()
+                if lim is not None:
+                    term.limit = lim
+            return term
+        terms: list[tuple[ast.StmtNode, bool]] = [(term, paren)]
+        seps: list[bool] = []  # distinct flag per UNION separator
+        while self._try_kw("UNION"):
+            if self._try_kw("ALL"):
+                seps.append(False)
+            else:
+                self._try_kw("DISTINCT")
+                seps.append(True)
+            terms.append(self._parse_union_term())
+        order_by: list[ast.ByItem] = []
+        limit = None
+        for i, (t, was_paren) in enumerate(terms):
+            last = i == len(terms) - 1
+            if isinstance(t, ast.SelectStmt) and not was_paren \
+                    and (t.order_by or t.limit is not None):
+                if not last:
+                    self._fail("ORDER BY/LIMIT inside a UNION operand "
+                               "requires parentheses")
+                # trailing ORDER BY / LIMIT binds to the whole union
+                order_by, limit = t.order_by, t.limit
+                t.order_by, t.limit = [], None
+        stmts = [t for t, _ in terms]
+        # MySQL mixed ALL/DISTINCT: a DISTINCT separator dedups every
+        # operand to its left — nest so operands after the LAST DISTINCT
+        # keep duplicates
+        if any(seps):
+            k = max(i for i, d in enumerate(seps) if d)  # last distinct sep
+            inner = ast.UnionStmt(selects=stmts[:k + 2], distinct=True)
+            if k + 2 < len(stmts):
+                u = ast.UnionStmt(selects=[inner] + stmts[k + 2:],
+                                  distinct=False)
+            else:
+                u = inner
+        else:
+            u = ast.UnionStmt(selects=stmts, distinct=False)
+        if not order_by and self._try_kw("ORDER"):
+            self._expect_kw("BY")
+            order_by = self._parse_by_items()
+        if limit is None:
+            limit = self._parse_limit()
+        u.order_by = order_by
+        u.limit = limit
+        return u
+
+    def _parse_union_term(self) -> tuple[ast.StmtNode, bool]:
+        if self._at_op("("):
+            self.pos += 1
+            inner = self._parse_select_or_union()
+            self._expect_op(")")
+            return inner, True
+        return self._parse_select(), False
 
     def _parse_select(self) -> ast.SelectStmt:
         self._expect_kw("SELECT")
@@ -249,6 +319,16 @@ class Parser:
 
     def _parse_table_factor(self) -> ast.Node:
         if self._try_op("("):
+            if self._at_kw("SELECT"):
+                # derived table: (SELECT ...) [AS] alias
+                sub = self._parse_select_or_union()
+                self._expect_op(")")
+                as_name = ""
+                if self._try_kw("AS"):
+                    as_name = self._ident()
+                elif self._cur().tp == lx.IDENT:
+                    as_name = self._ident()
+                return ast.TableSource(source=sub, as_name=as_name)
             inner = self._parse_table_refs()
             self._expect_op(")")
             return inner
@@ -315,7 +395,7 @@ class Parser:
             save = self.pos
             self.pos += 1
             if self._at_kw("SELECT"):
-                stmt.select = self._parse_select()
+                stmt.select = self._parse_select_or_union()
                 self._expect_op(")")
                 self._parse_on_duplicate(stmt)
                 return stmt
@@ -328,7 +408,7 @@ class Parser:
                 self._expect_op(")")
                 stmt.columns = cols
         if self._at_kw("SELECT"):
-            stmt.select = self._parse_select()
+            stmt.select = self._parse_select_or_union()
         else:
             self._expect_kw("VALUES", "VALUE")
             while True:
@@ -825,6 +905,10 @@ class Parser:
             return ast.PatternLike(expr=left, pattern=pat, not_=not_, escape=esc)
         if self._try_kw("IN"):
             self._expect_op("(")
+            if self._at_kw("SELECT"):
+                sub = self._parse_select_or_union()
+                self._expect_op(")")
+                return ast.InExpr(expr=left, sel=sub, not_=not_)
             items = []
             while True:
                 items.append(self._parse_expr())
@@ -874,7 +958,10 @@ class Parser:
             if self._try_kw("CASE"):
                 return self._parse_case()
             if self._try_kw("EXISTS"):
-                self._fail("subqueries are not supported yet")
+                self._expect_op("(")
+                sub = self._parse_select_or_union()
+                self._expect_op(")")
+                return ast.ExistsSubquery(query=sub)
             if self._try_kw("CAST"):
                 self._expect_op("(")
                 expr = self._parse_expr()
@@ -900,6 +987,10 @@ class Parser:
             self._fail(f"unexpected keyword {t.val} in expression")
         if t.tp == lx.OP:
             if self._try_op("("):
+                if self._at_kw("SELECT"):
+                    sub = self._parse_select_or_union()
+                    self._expect_op(")")
+                    return ast.SubqueryExpr(query=sub)
                 expr = self._parse_expr()
                 if self._try_op(","):
                     row = ast.RowExpr(values=[expr])

@@ -14,15 +14,15 @@ from tidb_tpu import errors
 from tidb_tpu import mysqldef as my
 from tidb_tpu import sqlast as ast
 from tidb_tpu.expression import (
-    AggregationFunction, Column, Constant, Expression, ScalarFunction, Schema,
-    new_op, split_cnf,
+    AggregationFunction, Column, Constant, CorrelatedColumn, Expression,
+    ScalarFunction, Schema, new_op, split_cnf,
 )
 from tidb_tpu.expression.expression import Cast
 from tidb_tpu.plan import plans
 from tidb_tpu.plan.plans import (
-    Aggregation, DataSource, Delete, Distinct, ExplainPlan, Insert, Join,
-    Limit, Plan, Projection, Selection, ShowPlan, SimplePlan, Sort, SortItem,
-    TableDual, Union, Update,
+    Aggregation, Apply, DataSource, Delete, Distinct, Exists, ExplainPlan,
+    Insert, Join, Limit, MaxOneRow, Plan, Projection, Selection, SemiJoin,
+    ShowPlan, SimplePlan, Sort, SortItem, TableDual, Union, Update,
 )
 from tidb_tpu.sqlast.opcode import Op
 from tidb_tpu.types import Datum
@@ -38,6 +38,9 @@ class PlanBuilder:
         .get_sysvar(name, is_global) → str|None, .params: list[Datum]."""
         self.ctx = ctx
         self.is_ = ctx.info_schema()
+        # correlated-subquery scope stack: (outer schema, shared row cell)
+        self.outer_scopes: list[tuple[Schema, list]] = []
+        self._corr_marks: list[bool] = []
 
     # ---- dispatch ----
 
@@ -148,10 +151,14 @@ class PlanBuilder:
             p = TableDual(1)
             p.set_schema(Schema())
 
+        # wildcards expand against the FROM schema only — columns appended
+        # later by subquery Apply/SemiJoin wraps must not leak into `*`
+        from_schema = p.schema
+
         if sel.where is not None:
             p = self._add_selection(p, sel.where)
 
-        fields = self._expand_wildcards(sel.fields, p.schema)
+        fields = self._expand_wildcards(sel.fields, from_schema)
 
         agg_nodes = []
         for f in fields:
@@ -165,12 +172,14 @@ class PlanBuilder:
         if agg_nodes or sel.group_by:
             p = self._build_aggregation(p, fields, sel, agg_nodes, mapper)
 
-        # final projection
+        # final projection (subqueries in the select list / HAVING may wrap
+        # the plan in Apply/SemiJoin nodes through `holder`)
+        holder = [p]
         alias_exprs: dict[str, Expression] = {}
         proj_exprs: list[Expression] = []
         proj_schema = Schema()
         for i, f in enumerate(fields):
-            e = self.rewrite(f.expr, p.schema, mapper)
+            e = self.rewrite(f.expr, None, mapper, holder=holder)
             proj_exprs.append(e)
             name = f.as_name or _field_name(f.expr)
             out = Column(col_name=name, ret_type=e.ret_type, position=i)
@@ -184,11 +193,13 @@ class PlanBuilder:
 
         if sel.having is not None:
             # HAVING runs below the projection; aliases resolve to their exprs
-            cond = self.rewrite(sel.having, p.schema, mapper, alias_exprs)
+            cond = self.rewrite(sel.having, None, mapper, alias_exprs,
+                                holder=holder)
             hsel = Selection(split_cnf(cond))
-            hsel.add_child(p)
-            hsel.schema = p.schema
-            p = hsel
+            hsel.add_child(holder[0])
+            hsel.schema = holder[0].schema
+            holder[0] = hsel
+        p = holder[0]
 
         proj = Projection(proj_exprs)
         proj.add_child(p)
@@ -220,7 +231,7 @@ class PlanBuilder:
         return p
 
     def build_union(self, u) -> Plan:
-        children = [self.build_select(s) for s in u.selects]
+        children = [self.build(s) for s in u.selects]
         first = children[0]
         for c in children[1:]:
             if len(c.schema) != len(first.schema):
@@ -250,7 +261,9 @@ class PlanBuilder:
         return p
 
     def _add_selection(self, p: Plan, where: ast.ExprNode) -> Plan:
-        cond = self.rewrite(where, p.schema)
+        holder = [p]
+        cond = self.rewrite(where, None, holder=holder)
+        p = holder[0]
         sel = Selection(split_cnf(cond))
         sel.add_child(p)
         sel.schema = p.schema  # pass-through: shares the child scope
@@ -491,23 +504,145 @@ class PlanBuilder:
                 f"Unknown column '{name}' in 'field list'")
         return col
 
-    def rewrite(self, node: ast.ExprNode, schema: Schema,
+    # ---- subquery handling (plan/expression_rewriter.go handleScalar/
+    # handleExist/handleInSubquery) ----
+
+    def _find_outer_column(self, cn: ast.ColumnName) -> CorrelatedColumn | None:
+        """Resolve a name against enclosing query scopes (innermost first);
+        marks every scope between the reference and its definition as
+        correlated."""
+        for i in range(len(self.outer_scopes) - 1, -1, -1):
+            schema_o, cell = self.outer_scopes[i]
+            try:
+                col = schema_o.find_column(
+                    getattr(cn, "db", ""), getattr(cn, "table", ""), cn.name)
+            except errors.TiDBError:
+                col = None
+            if col is not None:
+                for j in range(i, len(self._corr_marks)):
+                    self._corr_marks[j] = True
+                return CorrelatedColumn(col.clone(), cell)
+        return None
+
+    def _build_subquery(self, qnode, outer_schema: Schema,
+                        cell: list) -> tuple[Plan, bool]:
+        """Build the inner plan with `outer_schema` visible for correlation.
+        Returns (plan, is_correlated)."""
+        self.outer_scopes.append((outer_schema, cell))
+        self._corr_marks.append(False)
+        try:
+            np = self.build(qnode)
+        finally:
+            self.outer_scopes.pop()
+            corr = self._corr_marks.pop()
+        return np, corr
+
+    def _wrap_apply(self, holder: list, inner: Plan, cell: list, mode: str,
+                    corr: bool, target_expr=None,
+                    anti: bool = False) -> Column:
+        """Wrap holder[0] in an Apply over `inner`; returns the appended
+        output column (the subquery's value)."""
+        p = holder[0]
+        ap = Apply(inner, cell, mode=mode, target_expr=target_expr, anti=anti)
+        ap.correlated = corr
+        ap.add_child(p)
+        ap._left_width = len(p.schema)
+        cols = [c.clone() for c in p.schema]
+        if mode == Apply.MODE_ROW:
+            appended = [c.clone() for c in inner.schema]
+        else:  # semi: synthesized aux column
+            appended = [_make_aux_col(ap.id)]
+        ap.schema = Schema(cols + appended)
+        holder[0] = ap
+        return appended[-1].clone()
+
+    def _handle_scalar_subquery(self, n: ast.SubqueryExpr,
+                                holder: list) -> Expression:
+        cell = [None]
+        np, corr = self._build_subquery(n.query, holder[0].schema, cell)
+        if len(np.schema) != 1:
+            raise errors.PlanError("Operand should contain 1 column(s)")
+        mor = MaxOneRow()
+        mor.add_child(np)
+        mor.schema = np.schema  # pass-through
+        return self._wrap_apply(holder, mor, cell, Apply.MODE_ROW, corr)
+
+    def _handle_exists_subquery(self, n: ast.ExistsSubquery,
+                                holder: list) -> Expression:
+        cell = [None]
+        np, corr = self._build_subquery(n.query, holder[0].schema, cell)
+        ex = Exists()
+        ex.add_child(np)
+        out = self._wrap_apply(holder, ex, cell, Apply.MODE_ROW, corr)
+        if n.not_:
+            return new_op(Op.UnaryNot, out)
+        return out
+
+    def _handle_in_subquery(self, n: ast.InExpr, holder: list,
+                            rw) -> Expression:
+        # resolve the left side against the current scope FIRST, so its
+        # identities belong to the pre-wrap schema (preserved by the wrap)
+        target = rw(n.expr)
+        cell = [None]
+        np, corr = self._build_subquery(n.sel, holder[0].schema, cell)
+        if len(np.schema) != 1:
+            raise errors.PlanError("Operand should contain 1 column(s)")
+        if corr:
+            return self._wrap_apply(holder, np, cell, Apply.MODE_SEMI, corr,
+                                    target_expr=target, anti=n.not_)
+        # uncorrelated: null-aware hash semi join
+        p = holder[0]
+        sj = SemiJoin(target, np.schema[0].clone(), anti=n.not_)
+        sj.add_child(p)
+        sj.add_child(np)
+        sj._left_width = len(p.schema)
+        aux = _make_aux_col(sj.id)
+        sj.schema = Schema([c.clone() for c in p.schema] + [aux])
+        holder[0] = sj
+        return aux.clone()
+
+    def rewrite(self, node: ast.ExprNode, schema: Schema | None,
                 mapper: dict[int, Column] | None = None,
-                alias_exprs: dict[str, Expression] | None = None) -> Expression:
+                alias_exprs: dict[str, Expression] | None = None,
+                holder: list | None = None) -> Expression:
+        """When `holder` is given ([plan]), subquery expressions may wrap
+        holder[0] in Apply/SemiJoin nodes and columns resolve against the
+        evolving holder[0].schema (plan/expression_rewriter.go er.p)."""
         m = mapper or {}
         aliases = alias_exprs or {}
+
+        def cur_schema() -> Schema:
+            return holder[0].schema if holder is not None else schema
 
         def rw(n) -> Expression:
             if isinstance(n, ast.Literal):
                 return Constant(n.value)
+            if isinstance(n, ast.SubqueryExpr):
+                if holder is None:
+                    raise errors.PlanError(
+                        "subquery is not supported in this context")
+                return self._handle_scalar_subquery(n, holder)
+            if isinstance(n, ast.ExistsSubquery):
+                if holder is None:
+                    raise errors.PlanError(
+                        "subquery is not supported in this context")
+                return self._handle_exists_subquery(n, holder)
+            if isinstance(n, ast.InExpr) and n.sel is not None:
+                if holder is None:
+                    raise errors.PlanError(
+                        "subquery is not supported in this context")
+                return self._handle_in_subquery(n, holder, rw)
             if isinstance(n, ast.ColumnName):
                 if id(n) in m:
                     return m[id(n)].clone()
                 try:
-                    return self._find_column(n, schema).clone()
+                    return self._find_column(n, cur_schema()).clone()
                 except errors.UnknownFieldError:
                     if not n.table and n.name.lower() in aliases:
                         return aliases[n.name.lower()].clone()
+                    corr = self._find_outer_column(n)
+                    if corr is not None:
+                        return corr
                     raise
             if isinstance(n, ast.AggregateFunc):
                 col = m.get(id(n))
@@ -594,6 +729,14 @@ class PlanBuilder:
 
 
 # ---- helpers ----
+
+def _make_aux_col(from_id: str) -> Column:
+    """The IN-subquery match column appended by Apply(semi)/SemiJoin."""
+    aux = Column(col_name="aux_col", ret_type=new_field_type(my.TypeLonglong))
+    aux.from_id = from_id
+    aux.position = 0
+    return aux
+
 
 def _collect_aggs(node, out: list) -> None:
     if node is None:

@@ -23,12 +23,13 @@ from tidb_tpu.plan.expr_to_pb import (
     agg_func_to_pb, expressions_to_pb, group_by_item_to_pb, sort_item_to_pb,
 )
 from tidb_tpu.plan.plans import (
-    Aggregation, DataSource, Delete, Distinct, ExplainPlan, Insert, Join,
-    Limit, Plan, PhysicalDistinct, PhysicalHashAgg, PhysicalHashJoin,
-    PhysicalHashSemiJoin, PhysicalIndexScan, PhysicalLimit, PhysicalProjection,
+    Aggregation, Apply, DataSource, Delete, Distinct, Exists, ExplainPlan,
+    Insert, Join, Limit, MaxOneRow, Plan, PhysicalApply, PhysicalDistinct,
+    PhysicalExists, PhysicalHashAgg, PhysicalHashJoin, PhysicalHashSemiJoin,
+    PhysicalIndexScan, PhysicalLimit, PhysicalMaxOneRow, PhysicalProjection,
     PhysicalSelection, PhysicalSort, PhysicalTableDual, PhysicalTableScan,
     PhysicalTopN, PhysicalUnion, PhysicalUnionScan, Projection, Selection,
-    Sort, SortItem, TableDual, Union, Update,
+    SemiJoin, Sort, SortItem, TableDual, Union, Update,
 )
 from tidb_tpu.types.field_type import FieldType, new_field_type
 
@@ -98,6 +99,33 @@ def to_physical(p: Plan, ctx: PhysicalContext) -> Plan:
         d = PhysicalTableDual(p.row_count)
         d.schema = p.schema
         return d
+    if isinstance(p, Apply):
+        outer = to_physical(p.children[0], ctx)
+        inner = to_physical(p.inner_plan, ctx)
+        pa = PhysicalApply(p, inner)
+        pa.add_child(outer)
+        pa.schema = p.schema
+        return pa
+    if isinstance(p, SemiJoin):
+        left = to_physical(p.children[0], ctx)
+        right = to_physical(p.children[1], ctx)
+        sj = PhysicalHashSemiJoin(p)
+        sj.add_child(left)
+        sj.add_child(right)
+        sj.schema = p.schema
+        return sj
+    if isinstance(p, Exists):
+        child = to_physical(p.child, ctx)
+        e = PhysicalExists()
+        e.add_child(child)
+        e.schema = p.schema
+        return e
+    if isinstance(p, MaxOneRow):
+        child = to_physical(p.child, ctx)
+        m = PhysicalMaxOneRow()
+        m.add_child(child)
+        m.schema = child.schema
+        return m
     if isinstance(p, (Insert, Update, Delete)):
         p.children = [to_physical(c, ctx) for c in p.children]
         return p

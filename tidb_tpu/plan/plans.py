@@ -148,23 +148,74 @@ class TableDual(Plan):
 
 
 class MaxOneRow(Plan):
+    """Guards a scalar subquery: passes through at most one row, yields an
+    all-NULL row when the child is empty (plan/logical_plans.go MaxOneRow).
+    Schema is shared with the child (pass-through)."""
+
     def __init__(self):
         super().__init__("maxonerow")
 
 
 class Exists(Plan):
+    """EXISTS(subquery) → a single int64 0/1 column
+    (plan/logical_plans.go Exists). Output column is branded by this node
+    (from_id = self.id, position 0) without rebranding the child."""
+
     def __init__(self):
         super().__init__("exists")
+        from tidb_tpu import mysqldef as my
+        from tidb_tpu.types.field_type import new_field_type
+        col = Column(col_name="exists_col",
+                     ret_type=new_field_type(my.TypeLonglong))
+        col.from_id = self.id
+        col.position = 0
+        self.schema = Schema([col])
 
 
 class Apply(Plan):
-    """Correlated subquery execution: re-evaluates the inner plan per outer
-    row (plan/logical_plans.go Apply)."""
+    """Subquery execution: re-evaluates the inner plan per outer row
+    (plan/logical_plans.go Apply; executor Apply). children = [outer];
+    inner_plan is a separate tree whose CorrelatedColumns read the current
+    outer row through `cell`.
 
-    def __init__(self, inner_plan: Plan, outer_schema_cols: list[Column]):
+    mode 'row': inner emits exactly one row (Exists/MaxOneRow wrapped);
+    output = outer_row + inner_row.
+    mode 'semi': null-aware IN-subquery; output = outer_row + [aux] where
+    aux is 1/0/NULL per SQL 3VL of `target_expr IN inner` (negated when
+    anti).
+
+    Schema: outer columns keep their identities (pass-through, so
+    conditions resolved before the wrap stay valid); appended columns carry
+    inner/branded identities — no (from_id, position) collisions since
+    from_ids are globally unique."""
+
+    MODE_ROW = "row"
+    MODE_SEMI = "semi"
+
+    def __init__(self, inner_plan: Plan, cell: list, mode: str = "row",
+                 target_expr=None, anti: bool = False):
         super().__init__("apply")
         self.inner_plan = inner_plan
-        self.outer_schema_cols = outer_schema_cols
+        self.cell = cell
+        self.mode = mode
+        self.target_expr = target_expr
+        self.anti = anti
+        self.correlated = True
+        self._left_width = 0
+
+
+class SemiJoin(Plan):
+    """Hash semi join for uncorrelated IN-subqueries, always emitting the
+    match-aux column (reference HashSemiJoinExec with auxMode). children =
+    [outer, inner]. Output = outer columns (identities preserved) + aux
+    (branded by this node)."""
+
+    def __init__(self, left_key, right_key, anti: bool = False):
+        super().__init__("semijoin")
+        self.left_key = left_key      # Expression over the outer row
+        self.right_key = right_key    # Column of the inner schema
+        self.anti = anti
+        self._left_width = 0
 
 
 # ---- statement plans (write path + misc) ----
@@ -355,14 +406,15 @@ class PhysicalHashJoin(PhysicalPlan):
 
 
 class PhysicalHashSemiJoin(PhysicalPlan):
-    def __init__(self, join: Join, with_aux: bool):
+    """Null-aware hash semi join with aux output column (executor
+    HashSemiJoinExec). children = [outer, inner]."""
+
+    def __init__(self, sj: SemiJoin):
         super().__init__("psemijoin")
-        self.eq_conditions = join.eq_conditions
-        self.left_conditions = join.left_conditions
-        self.right_conditions = join.right_conditions
-        self.other_conditions = join.other_conditions
-        self.anti = join.anti
-        self.with_aux = with_aux      # LEFT OUTER SEMI: emit match flag col
+        self.left_key = sj.left_key
+        self.right_key = sj.right_key
+        self.anti = sj.anti
+        self._left_width = sj._left_width
 
 
 class PhysicalUnion(PhysicalPlan):
@@ -392,10 +444,15 @@ class PhysicalMaxOneRow(PhysicalPlan):
 
 
 class PhysicalApply(PhysicalPlan):
-    def __init__(self, inner_plan, outer_schema_cols):
+    def __init__(self, ap: Apply, inner_phys: Plan):
         super().__init__("papply")
-        self.inner_plan = inner_plan
-        self.outer_schema_cols = outer_schema_cols
+        self.inner_plan = inner_phys
+        self.cell = ap.cell
+        self.mode = ap.mode
+        self.target_expr = ap.target_expr
+        self.anti = ap.anti
+        self.correlated = ap.correlated
+        self._left_width = ap._left_width
 
 
 class PhysicalUnionScan(PhysicalPlan):

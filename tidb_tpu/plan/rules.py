@@ -14,11 +14,14 @@ physical slot recomputed here after pruning.
 
 from __future__ import annotations
 
-from tidb_tpu.expression import Column, Constant, Expression, ScalarFunction
+from tidb_tpu.expression import (
+    Column, Constant, CorrelatedColumn, Expression, ScalarFunction,
+)
 from tidb_tpu.expression.expression import Cast
 from tidb_tpu.plan.plans import (
-    Aggregation, DataSource, Delete, Distinct, ExplainPlan, Insert, Join,
-    Limit, Plan, Projection, Selection, Sort, TableDual, Union, Update,
+    Aggregation, Apply, DataSource, Delete, Distinct, Exists, ExplainPlan,
+    Insert, Join, Limit, MaxOneRow, Plan, Projection, Selection, SemiJoin,
+    Sort, TableDual, Union, Update,
 )
 from tidb_tpu.sqlast.opcode import Op
 
@@ -147,6 +150,37 @@ def predicate_push_down(p: Plan, predicates: list[Expression] | None = None):
 
     if isinstance(p, Join):
         return _ppd_join(p, preds)
+
+    if isinstance(p, (Apply, SemiJoin)):
+        # conditions referencing only the outer side commute with both
+        # nodes (they preserve outer rows 1:1); the rest stay above.
+        # Identities are shared with the outer child — no rebasing needed.
+        outer = p.children[0]
+        outer_preds, rem = [], []
+        for cond in preds:
+            cols = cond.columns()
+            if cols and all(outer.schema.column_index(c) >= 0 for c in cols):
+                outer_preds.append(cond)
+            else:
+                rem.append(cond)
+        orem, ochild = predicate_push_down(outer, outer_preds)
+        ochild = _maybe_wrap_selection(ochild, orem)
+        if isinstance(p, Apply):
+            p.children = [ochild]
+            irem, ichild = predicate_push_down(p.inner_plan, [])
+            p.inner_plan = _maybe_wrap_selection(ichild, irem)
+            p._left_width = len(p.children[0].schema)
+        else:
+            irem, ichild = predicate_push_down(p.children[1], [])
+            p.children = [ochild, _maybe_wrap_selection(ichild, irem)]
+        return rem, p
+
+    if isinstance(p, (Exists, MaxOneRow)):
+        rem, child = predicate_push_down(p.child, [])
+        p.children = [_maybe_wrap_selection(child, rem)]
+        if isinstance(p, MaxOneRow):
+            p.schema = p.children[0].schema
+        return preds, p
 
     if isinstance(p, (Sort, Distinct)):
         rem, child = predicate_push_down(p.child, preds)
@@ -364,6 +398,23 @@ def prune_columns(p: Plan, required: set[int] | None = None) -> None:
         _relayout(p.schema)
         return
 
+    if isinstance(p, Apply):
+        # conservative: the outer row feeds correlated columns, keep it whole
+        prune_columns(p.children[0], None)
+        prune_columns(p.inner_plan, None)
+        return
+
+    if isinstance(p, SemiJoin):
+        prune_columns(p.children[0], None)
+        prune_columns(p.children[1], None)
+        return
+
+    if isinstance(p, (Exists, MaxOneRow)):
+        prune_columns(p.child, None)
+        if isinstance(p, MaxOneRow):
+            p.schema = p.child.schema
+        return
+
     # default: require everything from children
     for c in p.children:
         prune_columns(c, None)
@@ -378,9 +429,108 @@ def _relayout(schema) -> None:
 # index resolution (rebind expression columns to physical slots)
 # ---------------------------------------------------------------------------
 
+def iter_plan_exprs(p: Plan):
+    """Yield every expression held by nodes of the (logical) tree rooted at
+    p, including nested Apply inner plans — used to bind CorrelatedColumns
+    from an enclosing Apply."""
+    if isinstance(p, DataSource):
+        yield from p.push_conditions
+    elif isinstance(p, Selection):
+        yield from p.conditions
+    elif isinstance(p, Projection):
+        yield from p.exprs
+    elif isinstance(p, Aggregation):
+        for f in p.agg_funcs:
+            yield from f.args
+        yield from p.group_by
+    elif isinstance(p, Sort):
+        for it in p.by_items:
+            yield it.expr
+    elif isinstance(p, Join):
+        for lcol, rcol in p.eq_conditions:
+            yield lcol
+            yield rcol
+        yield from p.left_conditions
+        yield from p.right_conditions
+        yield from p.other_conditions
+    elif isinstance(p, SemiJoin):
+        yield p.left_key
+        yield p.right_key
+    elif isinstance(p, Apply):
+        if p.target_expr is not None:
+            yield p.target_expr
+    for c in p.children:
+        yield from iter_plan_exprs(c)
+    if isinstance(p, Apply):
+        yield from iter_plan_exprs(p.inner_plan)
+
+
+def _bind_corr(e: Expression, lookup: dict) -> None:
+    if isinstance(e, CorrelatedColumn):
+        key = (e.col.from_id, e.col.position)
+        if key in lookup:
+            e.idx = lookup[key]
+    elif isinstance(e, ScalarFunction):
+        for a in e.args:
+            _bind_corr(a, lookup)
+    elif isinstance(e, Cast):
+        _bind_corr(e.arg, lookup)
+
+
 def resolve_indices(p: Plan) -> None:
     for c in p.children:
         resolve_indices(c)
+
+    if isinstance(p, DataSource):
+        # push_conditions hold clones whose `index` predates pruning —
+        # rebind to the post-prune slot layout
+        lookup = {(c.from_id, c.position): c.index for c in p.schema.columns}
+        for cond in p.push_conditions:
+            _bind_expr(cond, lookup)
+        return
+
+    if isinstance(p, Apply):
+        resolve_indices(p.inner_plan)
+        outer_schema = p.children[0].schema
+        lookup = {(c.from_id, c.position): c.index
+                  for c in outer_schema.columns}
+        lw = len(outer_schema.columns)
+        p._left_width = lw
+        # correlated columns anywhere in the inner tree read outer-row slots
+        for e in iter_plan_exprs(p.inner_plan):
+            _bind_corr(e, lookup)
+        if p.target_expr is not None:
+            _bind_expr(p.target_expr, lookup)
+        # output row = outer_row + appended (inner result / aux)
+        nexti = lw
+        for c in p.schema.columns:
+            key = (c.from_id, c.position)
+            if key in lookup:
+                c.index = lookup[key]
+            else:
+                c.index = nexti
+                nexti += 1
+        return
+
+    if isinstance(p, SemiJoin):
+        left_schema = p.children[0].schema
+        left_lookup = {(c.from_id, c.position): c.index
+                       for c in left_schema.columns}
+        right_lookup = {(c.from_id, c.position): c.index
+                        for c in p.children[1].schema.columns}
+        lw = len(left_schema.columns)
+        p._left_width = lw
+        _bind_expr(p.left_key, left_lookup)
+        _bind_expr(p.right_key, right_lookup)
+        nexti = lw
+        for c in p.schema.columns:
+            key = (c.from_id, c.position)
+            if key in left_lookup:
+                c.index = left_lookup[key]
+            else:
+                c.index = nexti
+                nexti += 1
+        return
 
     if isinstance(p, Join):
         lw_slots = len(p.children[0].schema.columns)

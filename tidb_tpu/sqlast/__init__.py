@@ -12,6 +12,7 @@ from tidb_tpu.sqlast.expressions import (  # noqa: F401
     Literal, ColumnName, BinaryOp, UnaryOp, FuncCall, AggregateFunc,
     Between, InExpr, PatternLike, IsNull, CaseExpr, WhenClause,
     ParamMarker, RowExpr, DefaultExpr, VariableExpr, CastExpr,
+    SubqueryExpr, ExistsSubquery,
 )
 from tidb_tpu.sqlast.dml import (  # noqa: F401
     SelectStmt, SelectField, TableSource, Join, TableName, ByItem, Limit,

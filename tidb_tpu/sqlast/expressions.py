@@ -83,9 +83,26 @@ class Between(ExprNode):
 
 @dataclass
 class InExpr(ExprNode):
-    """expr [NOT] IN (list) (ast.PatternInExpr; subquery form later)."""
+    """expr [NOT] IN (list | subquery) (ast.PatternInExpr). When `sel` is
+    set the right side is a subquery (SelectStmt/UnionStmt)."""
     expr: ExprNode
     items: list[ExprNode] = field(default_factory=list)
+    not_: bool = False
+    sel: Any = None
+    ftype: Any = None
+
+
+@dataclass
+class SubqueryExpr(ExprNode):
+    """(SELECT ...) used as a scalar value (ast.SubqueryExpr)."""
+    query: Any = None  # SelectStmt | UnionStmt
+    ftype: Any = None
+
+
+@dataclass
+class ExistsSubquery(ExprNode):
+    """EXISTS (SELECT ...) (ast.ExistsSubqueryExpr)."""
+    query: Any = None
     not_: bool = False
     ftype: Any = None
 
