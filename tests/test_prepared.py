@@ -1,0 +1,133 @@
+"""PREPARE / EXECUTE / DEALLOCATE — text protocol prepared statements.
+
+Reference: executor/prepared.go (PrepareExec/ExecuteExec/DeallocateExec),
+session.go:478-563, parser.y PreparedStmt productions.
+"""
+
+import pytest
+
+from testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.exec("create database test")
+    tk.exec("use test")
+    tk.exec("create table t (id int primary key, a int, b varchar(32))")
+    tk.exec("insert into t values (1, 10, 'x'), (2, 20, 'y'), (3, 30, 'z')")
+    return tk
+
+
+class TestPrepare:
+    def test_basic_select(self, tk):
+        tk.exec("prepare s1 from 'select a from t where id = ?'")
+        tk.exec("set @v = 2")
+        tk.exec("execute s1 using @v").check([[20]])
+        tk.exec("set @v = 3")
+        tk.exec("execute s1 using @v").check([[30]])
+
+    def test_prepare_from_user_var(self, tk):
+        tk.exec("set @sql = 'select b from t where id = ?'")
+        tk.exec("prepare s2 from @sql")
+        tk.exec("set @p = 1")
+        tk.exec("execute s2 using @p").check([["x"]])
+
+    def test_no_params(self, tk):
+        tk.exec("prepare s from 'select count(*) from t'")
+        tk.exec("execute s").check([[3]])
+
+    def test_multiple_params(self, tk):
+        tk.exec("prepare s from 'select id from t where a > ? and b != ? "
+                "order by id'")
+        tk.exec("set @lo = 10, @skip = 'z'")
+        tk.exec("execute s using @lo, @skip").check([[2]])
+
+    def test_wrong_arg_count(self, tk):
+        tk.exec("prepare s from 'select * from t where id = ?'")
+        with pytest.raises(Exception, match="Incorrect arguments"):
+            tk.exec("execute s")
+
+    def test_unknown_handler(self, tk):
+        with pytest.raises(Exception, match="Unknown prepared statement"):
+            tk.exec("execute nope")
+
+    def test_deallocate(self, tk):
+        tk.exec("prepare s from 'select 1'")
+        tk.exec("deallocate prepare s")
+        with pytest.raises(Exception, match="Unknown prepared statement"):
+            tk.exec("execute s")
+        with pytest.raises(Exception, match="Unknown prepared statement"):
+            tk.exec("deallocate prepare s")
+
+    def test_prepare_write_stmt(self, tk):
+        tk.exec("prepare ins from 'insert into t values (?, ?, ?)'")
+        tk.exec("set @i = 4, @a = 40, @b = 'w'")
+        tk.exec("execute ins using @i, @a, @b")
+        tk.exec("select a from t where id = 4").check([[40]])
+        tk.exec("prepare upd from 'update t set a = ? where id = ?'")
+        tk.exec("set @na = 99, @i = 1")
+        tk.exec("execute upd using @na, @i")
+        tk.exec("select a from t where id = 1").check([[99]])
+
+    def test_prepared_show_and_explain(self, tk):
+        tk.exec("prepare s from 'show tables'")
+        tk.exec("execute s").check([["t"]])
+        tk.exec("prepare e from 'explain select count(*) from t'")
+        assert len(tk.exec("execute e").rows) >= 1
+
+    def test_re_prepare_replaces(self, tk):
+        tk.exec("prepare s from 'select 1'")
+        tk.exec("prepare s from 'select 2'")
+        tk.exec("execute s").check([[2]])
+
+    def test_nested_prepare_rejected(self, tk):
+        with pytest.raises(Exception, match="not supported"):
+            tk.exec("prepare s from 'prepare x from ''select 1'''")
+
+
+class TestPlanCache:
+    def test_plan_reused_across_executes(self, tk):
+        s = tk.session
+        tk.exec("prepare s from 'select a from t where id = ?'")
+        tk.exec("set @v = 1")
+        tk.exec("execute s using @v")
+        assert not s.vars.last_plan_from_cache
+        first = s.prepared["s"].plan
+        assert first is not None
+        tk.exec("set @v = 2")
+        tk.exec("execute s using @v").check([[20]])
+        assert s.vars.last_plan_from_cache
+        assert s.prepared["s"].plan is first
+
+    def test_cache_invalidated_by_ddl(self, tk):
+        s = tk.session
+        tk.exec("prepare s from 'select count(*) from t where id = ?'")
+        tk.exec("set @v = 1")
+        tk.exec("execute s using @v").check([[1]])
+        first = s.prepared["s"].plan
+        tk.exec("alter table t add column c int")
+        tk.exec("execute s using @v").check([[1]])
+        assert not s.vars.last_plan_from_cache
+        assert s.prepared["s"].plan is not first
+
+    def test_cache_bypassed_for_dirty_txn(self, tk):
+        s = tk.session
+        tk.exec("prepare s from 'select count(*) from t'")
+        tk.exec("execute s").check([[3]])
+        tk.exec("begin")
+        tk.exec("insert into t values (7, 70, 'q')")
+        # dirty writes must be visible (UnionScan) — the cached plan has no
+        # UnionScan, so the cache is bypassed
+        tk.exec("execute s").check([[4]])
+        assert not s.vars.last_plan_from_cache
+        tk.exec("rollback")
+        tk.exec("execute s").check([[3]])
+
+    def test_subquery_in_prepared(self, tk):
+        tk.exec("create table s2 (id int primary key, x int)")
+        tk.exec("insert into s2 values (1, 10), (2, 25)")
+        tk.exec("prepare q from 'select id from t where a in "
+                "(select x from s2) and a > ? order by id'")
+        tk.exec("set @m = 5")
+        tk.exec("execute q using @m").check([[1]])

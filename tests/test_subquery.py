@@ -173,6 +173,13 @@ class TestInSubquery:
         tk.exec("select id from t where id in (select id from s "
                 "where s.ta = t.a) order by id").check([[1], [2]])
 
+    def test_in_string_number_coercion(self, tk):
+        # string probe vs int inner set goes through full MySQL coercion
+        tk.exec("select '10' in (select a from t)").check([[1]])
+        tk.exec("select '11' in (select id from t)").check([[0]])
+        # no match + NULL present in the inner set → NULL, not FALSE
+        tk.exec("select '11' in (select a from t)").check([[None]])
+
     def test_in_cross_type_numeric(self, tk):
         # int probe vs decimal/float inner set must match numerically
         tk.exec("select id from t where 1 in (select 1.0) order by id") \

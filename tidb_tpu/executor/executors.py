@@ -418,15 +418,33 @@ class TableDualExec(Executor):
         return []
 
 
+from tidb_tpu.types.datum import Kind as _Kind
+
+_NUMERIC_KINDS = frozenset(
+    (_Kind.INT64, _Kind.UINT64, _Kind.FLOAT64, _Kind.DECIMAL))
+
+
+def _in_kind_class(d: Datum) -> str:
+    """Coercion class for IN-subquery hashing: values hash-compare safely
+    only within a class; cross-class probes (e.g. '1' vs 1) fall back to
+    compare_datum, which applies full MySQL coercion."""
+    if d.kind in _NUMERIC_KINDS:
+        return "n"
+    if d.kind in (_Kind.STRING, _Kind.BYTES):
+        return "s"
+    return str(d.kind)
+
+
 def _in_key(d: Datum):
-    """Hash key for IN-subquery probing. Numeric kinds use the raw Python
-    value: int/float/Decimal hash equal when numerically equal, so
-    `1 IN (SELECT 1.0)` matches — mirroring compare_datum's coercion on
-    the correlated path. Everything else uses the order-preserving
-    encoding."""
-    from tidb_tpu.types.datum import Kind
-    if d.kind in (Kind.INT64, Kind.UINT64, Kind.FLOAT64, Kind.DECIMAL):
+    """Hash key for IN-subquery probing. Numerics use the raw Python value
+    (int/float/Decimal hash equal when numerically equal, so
+    `1 IN (SELECT 1.0)` matches); strings/bytes normalize to bytes;
+    everything else uses the order-preserving encoding."""
+    if d.kind in _NUMERIC_KINDS:
         return d.val
+    if d.kind in (_Kind.STRING, _Kind.BYTES):
+        v = d.val
+        return v.encode("utf-8") if isinstance(v, str) else v
     return codec.encode_value([d])
 
 
@@ -515,6 +533,8 @@ class HashSemiJoinExec(Executor):
         self.plan = plan
         self.schema = schema
         self._keys: set | None = None
+        self._vals: list[Datum] = []      # distinct non-null inner values
+        self._classes: set[str] = set()   # coercion classes present
         self._has_null = False
         self._any_rows = False
 
@@ -529,8 +549,12 @@ class HashSemiJoinExec(Executor):
             y = self.plan.right_key.eval(row)
             if y.is_null():
                 self._has_null = True
-            else:
-                keys.add(_in_key(y))
+                continue
+            k = _in_key(y)
+            if k not in keys:
+                keys.add(k)
+                self._vals.append(y)
+                self._classes.add(_in_kind_class(y))
         self._keys = keys
 
     def next(self):
@@ -542,7 +566,19 @@ class HashSemiJoinExec(Executor):
             return None
         self.last_handle = outer.last_handle
         x = self.plan.left_key.eval(row)
-        matched = not x.is_null() and _in_key(x) in self._keys
+        matched = False
+        if not x.is_null():
+            matched = _in_key(x) in self._keys
+            if not matched and self._classes - {_in_kind_class(x)}:
+                # cross-class values present → full coercion compare
+                # (matches ApplyExec's compare_datum semantics)
+                for y in self._vals:
+                    try:
+                        if compare_datum(x, y) == 0:
+                            matched = True
+                            break
+                    except errors.TiDBError:
+                        continue
         return row + [_in_verdict(matched, x.is_null(), self._any_rows,
                                   self._has_null, self.plan.anti)]
 

@@ -7,7 +7,7 @@ of the pushdown boundary agree exactly.
 """
 
 from tidb_tpu.expression.expression import (
-    Expression, Column, Constant, CorrelatedColumn, ScalarFunction, Schema,
+    Expression, Column, Constant, CorrelatedColumn, ParamExpr, ScalarFunction, Schema,
     new_op, compose_cnf, split_cnf, TRUE_EXPR, FALSE_EXPR, NULL_EXPR,
 )
 from tidb_tpu.expression.aggregation import (
@@ -16,7 +16,7 @@ from tidb_tpu.expression.aggregation import (
 from tidb_tpu.expression import ops, builtin
 
 __all__ = [
-    "Expression", "Column", "Constant", "CorrelatedColumn", "ScalarFunction", "Schema",
+    "Expression", "Column", "Constant", "CorrelatedColumn", "ParamExpr", "ScalarFunction", "Schema",
     "new_op", "compose_cnf", "split_cnf",
     "TRUE_EXPR", "FALSE_EXPR", "NULL_EXPR",
     "AggregationFunction", "AggFunctionMode", "AggEvaluateContext",

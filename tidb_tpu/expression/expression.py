@@ -124,6 +124,32 @@ class CorrelatedColumn(Expression):
         return f"corr({self.col!r})"
 
 
+class ParamExpr(Expression):
+    """A prepared-statement parameter slot. Evaluates the session's
+    CURRENT parameter binding, so a cached plan is reusable across
+    EXECUTEs with different values (reference executor/prepared.go param
+    markers). Never crosses the coprocessor boundary (expr_to_pb returns
+    None for it) — parameterized filters stay SQL-side."""
+
+    def __init__(self, ctx, order: int, ret_type: FieldType | None = None):
+        self.ctx = ctx
+        self.order = order
+        self.ret_type = ret_type or new_field_type(my.TypeNull)
+
+    def eval(self, row=None) -> Datum:
+        params = getattr(self.ctx, "params", None) or []
+        if self.order >= len(params):
+            raise errors.ExecError(
+                f"missing prepared statement parameter {self.order}")
+        return params[self.order]
+
+    def clone(self) -> "ParamExpr":
+        return ParamExpr(self.ctx, self.order, self.ret_type)
+
+    def __repr__(self):
+        return f"?{self.order}"
+
+
 class Constant(Expression):
     def __init__(self, value: Datum, ret_type: FieldType | None = None):
         self.value = value

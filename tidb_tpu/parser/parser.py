@@ -41,6 +41,7 @@ class Parser:
         self.sql = sql
         self.toks = lx.tokenize(sql)
         self.pos = 0
+        self.param_markers: list[ast.ParamMarker] = []
         stmts: list[ast.StmtNode] = []
         while not self._at(lx.EOF):
             if self._try_op(";"):
@@ -150,6 +151,9 @@ class Parser:
             "DESCRIBE": self._parse_explain,
             "DESC": self._parse_explain,
             "ADMIN": self._parse_admin,
+            "PREPARE": self._parse_prepare,
+            "EXECUTE": self._parse_execute,
+            "DEALLOCATE": self._parse_deallocate,
         }
         h = handlers.get(kw)  # type: ignore[arg-type]
         if h is None:
@@ -803,6 +807,40 @@ class Parser:
         # DESCRIBE table → SHOW COLUMNS
         return ast.ShowStmt(tp=ast.ShowType.COLUMNS, table=self._parse_table_name())
 
+    def _parse_prepare(self) -> ast.PrepareStmt:
+        """PREPARE name FROM 'sql' | @var (reference parser.y PreparedStmt,
+        executor/prepared.go)."""
+        self._expect_kw("PREPARE")
+        name = self._ident("statement name")
+        self._expect_kw("FROM")
+        t = self._cur()
+        if t.tp == lx.STRING:
+            self.pos += 1
+            return ast.PrepareStmt(name=name, sql_text=t.val)
+        if t.tp == lx.USER_VAR:
+            self.pos += 1
+            return ast.PrepareStmt(name=name, from_var=t.val)
+        self._fail("expected string literal or @user_variable after FROM")
+
+    def _parse_execute(self) -> ast.ExecuteStmt:
+        self._expect_kw("EXECUTE")
+        stmt = ast.ExecuteStmt(name=self._ident("statement name"))
+        if self._try_kw("USING"):
+            while True:
+                t = self._cur()
+                if t.tp != lx.USER_VAR:
+                    self._fail("expected @user_variable in USING")
+                self.pos += 1
+                stmt.using.append(t.val)
+                if not self._try_op(","):
+                    break
+        return stmt
+
+    def _parse_deallocate(self) -> ast.DeallocateStmt:
+        self._expect_kw("DEALLOCATE")
+        self._try_kw("PREPARE")
+        return ast.DeallocateStmt(name=self._ident("statement name"))
+
     def _parse_admin(self) -> ast.AdminStmt:
         self._expect_kw("ADMIN")
         if self._try_kw("SHOW"):
@@ -937,7 +975,9 @@ class Parser:
             return ast.Literal(Datum.bytes_(t.val))
         if t.tp == lx.PARAM:
             self.pos += 1
-            return ast.ParamMarker()
+            pm = ast.ParamMarker(order=len(self.param_markers))
+            self.param_markers.append(pm)
+            return pm
         if t.tp == lx.SYS_VAR:
             self.pos += 1
             is_global, name = _split_sysvar_scope(t.val)

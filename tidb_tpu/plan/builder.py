@@ -59,6 +59,13 @@ class PlanBuilder:
             return ExplainPlan(self.build(node.stmt))
         if isinstance(node, ast.UnionStmt):
             return self.build_union(node)
+        if isinstance(node, ast.PrepareStmt):
+            return plans.Prepare(node.name, node.sql_text or "",
+                                 from_var=node.from_var)
+        if isinstance(node, ast.ExecuteStmt):
+            return plans.Execute(node.name, list(node.using))
+        if isinstance(node, ast.DeallocateStmt):
+            return plans.Deallocate(node.name)
         # everything else executes directly (DDL/SET/USE/txn control/admin…)
         return SimplePlan(node)
 
@@ -513,11 +520,10 @@ class PlanBuilder:
         correlated."""
         for i in range(len(self.outer_scopes) - 1, -1, -1):
             schema_o, cell = self.outer_scopes[i]
-            try:
-                col = schema_o.find_column(
-                    getattr(cn, "db", ""), getattr(cn, "table", ""), cn.name)
-            except errors.TiDBError:
-                col = None
+            # an ambiguity error in the nearest matching scope propagates —
+            # silently binding a farther scope would pick the wrong column
+            col = schema_o.find_column(
+                getattr(cn, "db", ""), getattr(cn, "table", ""), cn.name)
             if col is not None:
                 for j in range(i, len(self._corr_marks)):
                     self._corr_marks[j] = True
@@ -702,10 +708,12 @@ class PlanBuilder:
             if isinstance(n, ast.ParamMarker):
                 if n.value is not None:
                     return Constant(n.value)
+                from tidb_tpu.expression import ParamExpr
+                from tidb_tpu.expression.expression import _infer_const_type
                 params = getattr(self.ctx, "params", None) or []
-                if n.order < len(params):
-                    return Constant(params[n.order])
-                raise errors.PlanError("missing prepared statement parameter")
+                rt = _infer_const_type(params[n.order]) \
+                    if n.order < len(params) else None
+                return ParamExpr(self.ctx, n.order, rt)
             if isinstance(n, ast.VariableExpr):
                 return self._rewrite_variable(n)
             if isinstance(n, ast.RowExpr):

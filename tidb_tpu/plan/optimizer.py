@@ -11,8 +11,8 @@ from __future__ import annotations
 from tidb_tpu.plan.builder import PlanBuilder
 from tidb_tpu.plan.physical import PhysicalContext, to_physical
 from tidb_tpu.plan.plans import (
-    Delete, ExplainPlan, Insert, Plan, Selection, ShowPlan, SimplePlan,
-    Update,
+    Deallocate, Delete, Execute, ExplainPlan, Insert, Plan, Prepare,
+    Selection, ShowPlan, SimplePlan, Update,
 )
 from tidb_tpu.plan.rules import (
     predicate_push_down, prune_columns, resolve_indices,
@@ -26,7 +26,7 @@ def optimize(stmt_node, ctx, client, dirty_table_ids=None) -> Plan:
 
 
 def optimize_plan(p: Plan, ctx, client, dirty_table_ids=None) -> Plan:
-    if isinstance(p, (SimplePlan, ShowPlan)):
+    if isinstance(p, (SimplePlan, ShowPlan, Prepare, Execute, Deallocate)):
         return p
     if isinstance(p, ExplainPlan):
         p.target = optimize_plan(p.target, ctx, client, dirty_table_ids)

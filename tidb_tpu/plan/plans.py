@@ -271,17 +271,22 @@ class ExplainPlan(Plan):
 
 
 class Prepare(Plan):
-    def __init__(self, name: str, sql_text: str):
+    """PREPARE name FROM ... (reference executor/prepared.go PrepareExec)."""
+
+    def __init__(self, name: str, sql_text: str, from_var: str = ""):
         super().__init__("prepare")
         self.name = name
         self.sql_text = sql_text
+        self.from_var = from_var
 
 
 class Execute(Plan):
-    def __init__(self, name: str, using: list[Expression]):
+    """EXECUTE name USING @vars (executor/prepared.go ExecuteExec)."""
+
+    def __init__(self, name: str, using: list[str]):
         super().__init__("execute")
         self.name = name
-        self.using = using
+        self.using = using  # user variable names
 
 
 class Deallocate(Plan):

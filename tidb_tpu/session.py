@@ -20,7 +20,8 @@ from tidb_tpu.parser.parser import Parser
 from tidb_tpu.plan import optimize_plan
 from tidb_tpu.plan.builder import PlanBuilder
 from tidb_tpu.plan.plans import (
-    Delete, ExplainPlan, Insert, ShowPlan, SimplePlan, Update,
+    Deallocate, Delete, Execute, ExplainPlan, Insert, Prepare, ShowPlan,
+    SimplePlan, Update,
 )
 from tidb_tpu.sessionctx import GlobalVars, SessionVars
 from tidb_tpu.types import Datum
@@ -62,6 +63,7 @@ class Session:
         self._txn = None
         self.history: list[str] = []   # stmt texts for optimistic retry
         self.params: list[Datum] = []
+        self.prepared: dict[str, _PreparedStmt] = {}
         self.dirty_tables: set[int] = set()
         bootstrap(self)
 
@@ -195,13 +197,26 @@ class Session:
 
         plan = optimize_plan(PlanBuilder(self).build(stmt), self, self.client,
                              self.dirty_tables)
-        if isinstance(plan, ShowPlan):
-            return execute_simple(self, plan.stmt)
-        if isinstance(plan, SimplePlan):
+        return self._dispatch_plan(plan, sql_text, record_history)
+
+    def _dispatch_plan(self, plan, sql_text: str,
+                       record_history: bool) -> ResultSet | None:
+        """Route an optimized plan to its executor — shared by the direct
+        path and EXECUTE (so prepared SHOW/SET/EXPLAIN work too)."""
+        if isinstance(plan, (ShowPlan, SimplePlan)):
             return execute_simple(self, plan.stmt)
         if isinstance(plan, ExplainPlan):
             return explain_result(plan.target)
+        if isinstance(plan, Prepare):
+            return self._do_prepare(plan)
+        if isinstance(plan, Deallocate):
+            return self._do_deallocate(plan)
+        if isinstance(plan, Execute):
+            return self._do_execute(plan, sql_text, record_history)
+        return self._run_plan(plan, sql_text, record_history)
 
+    def _run_plan(self, plan, sql_text: str,
+                  record_history: bool = True) -> ResultSet | None:
         is_write = isinstance(plan, (Insert, Update, Delete))
         executor = ExecutorBuilder(self).build(plan)
         try:
@@ -234,6 +249,85 @@ class Session:
             if self.vars.autocommit:
                 self.commit_txn()
         return rs
+
+    # ------------------------------------------------------------------
+    # prepared statements (executor/prepared.go, session.go:478-563)
+    # ------------------------------------------------------------------
+
+    def _do_prepare(self, plan: Prepare) -> None:
+        text = plan.sql_text
+        if plan.from_var:
+            v = self.get_uservar(plan.from_var)
+            if v is None:
+                raise errors.ExecError(
+                    f"user variable @{plan.from_var} is not set")
+            text = v.get_string() if isinstance(v, Datum) else str(v)
+        p = Parser()
+        stmts = p.parse(text)
+        if len(stmts) != 1:
+            raise errors.ExecError(
+                "Can not prepare multiple statements")
+        inner = stmts[0]
+        if isinstance(inner, (ast.PrepareStmt, ast.ExecuteStmt,
+                              ast.DeallocateStmt)):
+            raise errors.ExecError(
+                "This command is not supported in the prepared statement "
+                "protocol yet")
+        self.prepared[plan.name.lower()] = _PreparedStmt(
+            inner, len(p.param_markers), text)
+        return None
+
+    def _do_deallocate(self, plan: Deallocate) -> None:
+        if self.prepared.pop(plan.name.lower(), None) is None:
+            raise errors.ExecError(
+                f"Unknown prepared statement handler ({plan.name}) "
+                "given to DEALLOCATE PREPARE")
+        return None
+
+    def _do_execute(self, plan: Execute, sql_text: str,
+                    record_history: bool) -> ResultSet | None:
+        ent = self.prepared.get(plan.name.lower())
+        if ent is None:
+            raise errors.ExecError(
+                f"Unknown prepared statement handler ({plan.name}) "
+                "given to EXECUTE")
+        values: list[Datum] = []
+        for vn in plan.using:
+            v = self.get_uservar(vn)
+            if isinstance(v, Datum):
+                values.append(v)
+            elif v is None:
+                from tidb_tpu.types.datum import NULL
+                values.append(NULL)
+            else:
+                values.append(Datum.string(str(v)))
+        if len(values) != ent.param_count:
+            raise errors.ExecError("Incorrect arguments to EXECUTE")
+        self.params = values
+        try:
+            # plan cache: reusable because ParamExpr reads live bindings;
+            # keyed by schema version + the coprocessor client OBJECT (a
+            # held reference — id() could be recycled after an engine
+            # swap), and bypassed while the txn holds dirty writes
+            # (UnionScan wiring is dirty-state-dependent)
+            key = (self.domain.info_schema().version, self.client)
+            phys = None
+            if ent.plan is not None and ent.plan_key is not None \
+                    and ent.plan_key[0] == key[0] \
+                    and ent.plan_key[1] is key[1] \
+                    and not self.dirty_tables:
+                phys = ent.plan
+                self.vars.last_plan_from_cache = True
+            else:
+                self.vars.last_plan_from_cache = False
+            if phys is None:
+                phys = optimize_plan(PlanBuilder(self).build(ent.stmt),
+                                     self, self.client, self.dirty_tables)
+                if not self.dirty_tables:
+                    ent.plan, ent.plan_key = phys, key
+            return self._dispatch_plan(phys, sql_text, record_history)
+        finally:
+            self.params = []
 
     def apply_copr_backend(self, backend: str) -> None:
         """SET tidb_copr_backend = 'cpu' | 'tpu' — swap the coprocessor
@@ -274,6 +368,20 @@ class Session:
 
     def close(self) -> None:
         self.rollback_txn()
+
+
+class _PreparedStmt:
+    """One PREPAREd statement: parsed AST + param count + cached physical
+    plan (executor/prepared.go Prepared)."""
+
+    __slots__ = ("stmt", "param_count", "text", "plan", "plan_key")
+
+    def __init__(self, stmt, param_count: int, text: str):
+        self.stmt = stmt
+        self.param_count = param_count
+        self.text = text
+        self.plan = None
+        self.plan_key = None
 
 
 def _is_simple(stmt) -> bool:

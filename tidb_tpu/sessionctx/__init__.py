@@ -65,6 +65,7 @@ class SessionVars:
         self.prepared_id_gen = 0
         self.snapshot_ts: int | None = None     # tidb_snapshot time travel
         self.retry_limit = 10
+        self.last_plan_from_cache = False       # prepared-stmt plan cache hit
 
     def get_system(self, name: str, globals_: "GlobalVars") -> str | None:
         name = name.lower()

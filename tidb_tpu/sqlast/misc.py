@@ -79,3 +79,24 @@ class AdminType(enum.IntEnum):
 class AdminStmt(StmtNode):
     tp: AdminType = AdminType.SHOW_DDL
     tables: list[TableName] = field(default_factory=list)
+
+
+@dataclass
+class PrepareStmt(StmtNode):
+    """PREPARE name FROM 'text' | @var (ast/misc.go PrepareStmt)."""
+    name: str = ""
+    sql_text: str = ""
+    from_var: str = ""   # user variable holding the text, if given
+
+
+@dataclass
+class ExecuteStmt(StmtNode):
+    """EXECUTE name [USING @a, @b, ...] (ast/misc.go ExecuteStmt)."""
+    name: str = ""
+    using: list[str] = field(default_factory=list)  # user variable names
+
+
+@dataclass
+class DeallocateStmt(StmtNode):
+    """DEALLOCATE | DROP PREPARE name (ast/misc.go DeallocateStmt)."""
+    name: str = ""
