@@ -1,0 +1,230 @@
+"""Column statistics: equi-depth histograms + row-count estimation.
+
+Reference: plan/statistics/statistics.go — Column (:44) with equi-depth
+buckets, EqualRowCount/LessRowCount/GreaterRowCount/BetweenRowCount
+(:76-143), NewTable (:314), PseudoTable (:372) with the pseudo estimation
+rates; built by ANALYZE TABLE (executor/executor_simple.go:253-310).
+
+Values are compared through their order-preserving codec encoding, so one
+histogram implementation serves every column kind the codec covers.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tidb_tpu.codec import codec
+from tidb_tpu.types import Datum
+
+# pseudo estimation rates (statistics.go:366-370)
+PSEUDO_ROW_COUNT = 10_000
+PSEUDO_EQUAL_RATE = 1000
+PSEUDO_LESS_RATE = 3
+PSEUDO_BETWEEN_RATE = 40
+
+DEFAULT_BUCKET_COUNT = 256
+
+
+def _enc(d: Datum) -> bytes:
+    return codec.encode_key([d])
+
+
+class Bucket:
+    """One equi-depth bucket: cumulative row count up to and including this
+    bucket, the (encoded) upper bound value, and how often that exact upper
+    value repeats (statistics.go bucket struct)."""
+
+    __slots__ = ("count", "upper", "repeats")
+
+    def __init__(self, count: int, upper: bytes, repeats: int):
+        self.count = count
+        self.upper = upper
+        self.repeats = repeats
+
+
+class ColumnStats:
+    """Histogram for one column (statistics.Column)."""
+
+    def __init__(self, col_id: int, ndv: int, null_count: int,
+                 buckets: list[Bucket]):
+        self.col_id = col_id
+        self.ndv = ndv
+        self.null_count = null_count
+        self.buckets = buckets
+
+    @property
+    def total(self) -> int:
+        return self.buckets[-1].count if self.buckets else 0
+
+    # ---- estimation (statistics.go:76-143) ----
+
+    def _bucket_index(self, key: bytes) -> int:
+        """First bucket whose upper >= key (binary search)."""
+        lo, hi = 0, len(self.buckets)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.buckets[mid].upper < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def equal_row_count(self, value: Datum) -> float:
+        if not self.buckets:
+            return 0.0
+        key = _enc(value)
+        i = self._bucket_index(key)
+        if i >= len(self.buckets):
+            return 0.0
+        if self.buckets[i].upper == key:
+            return float(self.buckets[i].repeats)
+        if self.ndv > 0:
+            return self.total / self.ndv
+        return 0.0
+
+    def less_row_count(self, value: Datum) -> float:
+        if not self.buckets:
+            return 0.0
+        key = _enc(value)
+        i = self._bucket_index(key)
+        if i >= len(self.buckets):
+            return float(self.total)
+        prev = self.buckets[i - 1].count if i > 0 else 0
+        in_bucket = self.buckets[i].count - prev
+        if self.buckets[i].upper == key:
+            # everything in the bucket except the repeats of the bound
+            return prev + max(0.0, in_bucket - self.buckets[i].repeats)
+        return prev + in_bucket / 2.0
+
+    def greater_row_count(self, value: Datum) -> float:
+        return max(0.0, self.total - self.less_row_count(value)
+                   - self.equal_row_count(value))
+
+    def between_row_count(self, low: Datum, high: Datum) -> float:
+        return max(0.0, self.less_row_count(high)
+                   - self.less_row_count(low))
+
+    # ---- serialization ----
+
+    def to_obj(self) -> dict:
+        return {"id": self.col_id, "ndv": self.ndv,
+                "nulls": self.null_count,
+                "buckets": [[b.count, b.upper.hex(), b.repeats]
+                            for b in self.buckets]}
+
+    @staticmethod
+    def from_obj(o: dict) -> "ColumnStats":
+        return ColumnStats(o["id"], o["ndv"], o.get("nulls", 0),
+                           [Bucket(c, bytes.fromhex(u), r)
+                            for c, u, r in o["buckets"]])
+
+
+def build_column_stats(col_id: int, values: list[Datum],
+                       bucket_count: int = DEFAULT_BUCKET_COUNT) -> ColumnStats:
+    """Equi-depth histogram from a full value sample
+    (statistics.go buildColumn)."""
+    null_count = sum(1 for v in values if v.is_null())
+    keys = sorted(_enc(v) for v in values if not v.is_null())
+    if not keys:
+        return ColumnStats(col_id, 0, null_count, [])
+    per_bucket = max(1, (len(keys) + bucket_count - 1) // bucket_count)
+    buckets: list[Bucket] = []
+    ndv = 0
+    prev_key = None
+    for k in keys:
+        if k != prev_key:
+            ndv += 1
+        if buckets and (buckets[-1].count - (buckets[-2].count if
+                        len(buckets) > 1 else 0)) < per_bucket:
+            b = buckets[-1]
+            b.count += 1
+            if k == b.upper:
+                b.repeats += 1
+            else:
+                b.upper = k
+                b.repeats = 1
+        elif buckets and k == buckets[-1].upper:
+            # a value never splits across buckets (equi-depth invariant)
+            buckets[-1].count += 1
+            buckets[-1].repeats += 1
+        else:
+            base = buckets[-1].count if buckets else 0
+            buckets.append(Bucket(base + 1, k, 1))
+        prev_key = k
+    return ColumnStats(col_id, ndv, null_count, buckets)
+
+
+class TableStats:
+    """Per-table statistics (statistics.Table)."""
+
+    def __init__(self, table_id: int, count: int,
+                 columns: dict[int, ColumnStats], pseudo: bool = False):
+        self.table_id = table_id
+        self.count = count
+        self.columns = columns
+        self.pseudo = pseudo
+
+    def col(self, col_id: int) -> ColumnStats | None:
+        return self.columns.get(col_id)
+
+    # ---- pseudo estimation (statistics.go:372 PseudoTable) ----
+
+    def equal_row_count(self, col_id: int, value: Datum) -> float:
+        c = self.col(col_id)
+        if self.pseudo or c is None or not c.buckets:
+            return self.count / PSEUDO_EQUAL_RATE
+        return c.equal_row_count(value) * self.count / max(c.total, 1)
+
+    def less_row_count(self, col_id: int, value: Datum) -> float:
+        c = self.col(col_id)
+        if self.pseudo or c is None or not c.buckets:
+            return self.count / PSEUDO_LESS_RATE
+        return c.less_row_count(value) * self.count / max(c.total, 1)
+
+    def greater_row_count(self, col_id: int, value: Datum) -> float:
+        c = self.col(col_id)
+        if self.pseudo or c is None or not c.buckets:
+            return self.count / PSEUDO_LESS_RATE
+        return c.greater_row_count(value) * self.count / max(c.total, 1)
+
+    def between_row_count(self, col_id: int, low: Datum,
+                          high: Datum) -> float:
+        c = self.col(col_id)
+        if self.pseudo or c is None or not c.buckets:
+            return self.count / PSEUDO_BETWEEN_RATE
+        return c.between_row_count(low, high) * self.count / max(c.total, 1)
+
+    # ---- serialization (statistics.proto equivalent) ----
+
+    def serialize(self) -> bytes:
+        return json.dumps({
+            "tid": self.table_id, "count": self.count,
+            "cols": [c.to_obj() for c in self.columns.values()],
+        }).encode()
+
+    @staticmethod
+    def deserialize(raw: bytes) -> "TableStats":
+        o = json.loads(raw.decode())
+        cols = {c["id"]: ColumnStats.from_obj(c) for c in o["cols"]}
+        return TableStats(o["tid"], o["count"], cols)
+
+
+def pseudo_table(table_id: int) -> TableStats:
+    return TableStats(table_id, PSEUDO_ROW_COUNT, {}, pseudo=True)
+
+
+def analyze_table(table, retriever) -> TableStats:
+    """Full-scan ANALYZE: one histogram per public column
+    (executor/executor_simple.go:253-310; full scan instead of reservoir
+    sampling — the TPU tier's columnar cache makes scans cheap)."""
+    info = table.info
+    cols = info.public_columns()
+    samples: dict[int, list[Datum]] = {c.id: [] for c in cols}
+    count = 0
+    for _handle, row in table.iter_records(retriever):
+        count += 1
+        for c, v in zip(cols, row):
+            samples[c.id].append(v)
+    columns = {cid: build_column_stats(cid, vals)
+               for cid, vals in samples.items()}
+    return TableStats(table.id, count, columns)
