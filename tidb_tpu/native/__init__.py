@@ -35,11 +35,19 @@ def _build(name: str):
     if stale:
         cc = os.environ.get("CC", "cc")
         include = sysconfig.get_paths()["include"]
-        cmd = [cc, "-O2", "-fPIC", "-shared", "-o", out, src,
+        # compile to a temp name and os.replace() so concurrent interpreters
+        # never dlopen a half-written .so
+        tmp = os.path.join(_DIR, f".{name}.{os.getpid()}{suffix}")
+        cmd = [cc, "-O2", "-fPIC", "-shared", "-o", tmp, src,
                f"-I{include}"]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)
         except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None
     spec = importlib.util.spec_from_file_location(
         f"tidb_tpu.native.{name}", out)

@@ -156,7 +156,15 @@ static int encode_one(Buf *b, PyObject *datum, int comparable) {
     }
     case K_U64: {
         unsigned long long v = PyLong_AsUnsignedLongLong(val);
-        if (v == (unsigned long long)-1 && PyErr_Occurred()) break;
+        if (v == (unsigned long long)-1 && PyErr_Occurred()) {
+            /* out-of-range raises OverflowError; downgrade to Unsupported so
+               callers fall back to the Python codec (which masks) */
+            if (PyErr_ExceptionMatches(PyExc_OverflowError)) {
+                PyErr_Clear();
+                PyErr_SetString(Unsupported, "u64 out of range");
+            }
+            break;
+        }
         if (comparable) {
             if (buf_putc(b, UINT_FLAG) == 0) rc = put_u64be(b, v);
         } else {
@@ -208,7 +216,13 @@ static int encode_one(Buf *b, PyObject *datum, int comparable) {
         if (!packed) break;
         unsigned long long v = PyLong_AsUnsignedLongLong(packed);
         Py_DECREF(packed);
-        if (v == (unsigned long long)-1 && PyErr_Occurred()) break;
+        if (v == (unsigned long long)-1 && PyErr_Occurred()) {
+            if (PyErr_ExceptionMatches(PyExc_OverflowError)) {
+                PyErr_Clear();
+                PyErr_SetString(Unsupported, "time packed value out of range");
+            }
+            break;
+        }
         if (buf_putc(b, TIME_FLAG) == 0) rc = put_u64be(b, v);
         break;
     }

@@ -168,11 +168,13 @@ def lower_group_by(req: SelectRequest, batch: col.ColumnBatch) -> GroupSpec:
 
 
 def _orderable_i64(v):
-    """Monotone map of a value plane into int64 sort keys (floats via the
-    sign-flip bitcast trick; ints/codes are already ordered)."""
+    """Monotone, equality-preserving sort key for a value plane. Floats
+    stay f64 — XLA sorts f64 natively on TPU, while a f64→i64
+    bitcast-convert is rejected by the TPU x64-emulation rewrite — with
+    -0.0 normalized so it ranks equal to +0.0 (SQL equality), matching
+    codec.encode_float_to_cmp_u64. Ints/codes map to int64."""
     if v.dtype == jnp.float64:
-        i = jax.lax.bitcast_convert_type(v, jnp.int64)
-        return jnp.where(i >= 0, i, (~i) ^ jnp.int64(I64_MIN))
+        return jnp.where(v == 0.0, 0.0, v)
     return v.astype(jnp.int64)
 
 

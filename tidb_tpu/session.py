@@ -335,6 +335,10 @@ class Session:
         engine serves every session on the storage), mirroring how the
         reference selects its coprocessor implementation per store."""
         backend = backend.strip().lower()
+        if not backend:
+            raise errors.ExecError(
+                "tidb_copr_backend cannot be NULL/empty; "
+                "use 'cpu' or 'tpu' (swaps the engine store-wide)")
         if backend == "tpu":
             from tidb_tpu.ops import TpuClient
             if not isinstance(self.store.get_client(), TpuClient):
