@@ -1,0 +1,202 @@
+"""Statistics + cost-based access-path tests.
+
+Mirrors plan/statistics/statistics_test.go (histogram estimation) and the
+physical-planner cost checks: the access path must flip between index and
+table scan as the data distribution (via ANALYZE) changes.
+"""
+
+from tidb_tpu import statistics
+from tidb_tpu.plan.plans import PhysicalIndexScan, PhysicalTableScan
+from tidb_tpu.types import Datum
+
+from tests.testkit import TestKit
+
+
+def _i(v):
+    return Datum.i64(v)
+
+
+class TestHistogram:
+    def test_build_and_estimate(self):
+        # 1000 rows: value i//10 → 100 distinct values, 10 repeats each
+        vals = [_i(i // 10) for i in range(1000)]
+        st = statistics.build_column_stats(1, vals, bucket_count=16)
+        assert st.ndv == 100
+        assert st.total == 1000
+        eq = st.equal_row_count(_i(42))
+        assert 5 <= eq <= 20  # true answer 10
+        less = st.less_row_count(_i(50))
+        assert 400 <= less <= 600  # true answer 500
+        bt = st.between_row_count(_i(20), _i(30))
+        assert 50 <= bt <= 200  # true answer 100
+
+    def test_nulls_and_empty(self):
+        from tidb_tpu.types.datum import NULL
+        st = statistics.build_column_stats(1, [NULL, NULL, _i(1)])
+        assert st.null_count == 2
+        assert st.total == 1
+        empty = statistics.build_column_stats(2, [])
+        assert empty.total == 0
+        assert empty.equal_row_count(_i(1)) == 0.0
+
+    def test_serialize_round_trip(self):
+        vals = [_i(i % 7) for i in range(100)]
+        tbl = statistics.TableStats(
+            5, 100, {1: statistics.build_column_stats(1, vals)})
+        back = statistics.TableStats.deserialize(tbl.serialize())
+        assert back.table_id == 5 and back.count == 100
+        assert back.col(1).ndv == 7
+        assert back.equal_row_count(1, _i(3)) == tbl.equal_row_count(1, _i(3))
+
+    def test_pseudo_rates(self):
+        st = statistics.pseudo_table(1)
+        assert st.count == statistics.PSEUDO_ROW_COUNT
+        assert st.equal_row_count(1, _i(5)) == \
+            st.count / statistics.PSEUDO_EQUAL_RATE
+
+
+class TestAnalyze:
+    def test_analyze_persists_and_estimates(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int primary key, b int, key idx_b (b))")
+        for i in range(50):
+            tk.exec(f"insert into t values ({i}, {i % 5})")
+        tk.exec("analyze table t")
+        info = tk.session.info_schema().table_by_name("d", "t")
+        st = tk.session.stats_for(info.id)
+        assert not st.pseudo
+        assert st.count == 50
+        b_id = info.info.find_column("b").id
+        assert 8 <= st.equal_row_count(b_id, _i(2)) <= 12  # true 10
+
+    def test_analyze_invalidates_prepared_plan_cache(self):
+        """A plan cached from pseudo stats must be re-planned after ANALYZE
+        (the cost-based access path may change)."""
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int primary key, b int, c int, "
+                "key idx_b (b))")
+        rows = ", ".join(
+            f"({i}, {7 if i < 195 else 1000 + i}, {i})" for i in range(200))
+        tk.exec(f"insert into t values {rows}")
+        tk.exec("prepare p from 'select count(1) from t where b = 7'")
+        tk.exec("execute p").check([[195]])
+        tk.exec("execute p").check([[195]])
+        assert tk.session.vars.last_plan_from_cache
+        tk.exec("analyze table t")
+        tk.exec("execute p").check([[195]])
+        assert not tk.session.vars.last_plan_from_cache
+
+    def test_drop_and_truncate_clear_stats(self):
+        from tidb_tpu.meta import Meta
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int primary key, b int)")
+        tk.exec("insert into t values (1, 1), (2, 2)")
+        tk.exec("analyze table t")
+        info = tk.session.info_schema().table_by_name("d", "t")
+        old_id = info.id
+        tk.exec("truncate table t")
+        tk.exec("drop table t")
+        txn = tk.store.begin()
+        try:
+            assert Meta(txn).get_table_stats(old_id) is None
+        finally:
+            txn.rollback()
+
+    def test_analyze_empty_table_keeps_pseudo_paths(self):
+        """Zero-count stats must not cost every path at 0 and pin table
+        scans after the table grows."""
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int primary key, b int, c int, "
+                "key idx_b (b))")
+        tk.exec("analyze table t")  # analyzed while empty
+        rows = ", ".join(f"({i}, {i}, {i})" for i in range(100))
+        tk.exec(f"insert into t values {rows}")
+        assert _scan_type(tk, "select c from t where b = 5") == "index"
+
+    def test_analyze_sees_own_txn_writes(self):
+        """ANALYZE implicitly commits (DDL rule) so the scan includes the
+        session's pending rows."""
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int primary key, b int)")
+        tk.exec("begin")
+        tk.exec("insert into t values (1, 1), (2, 2), (3, 3)")
+        tk.exec("analyze table t")
+        info = tk.session.info_schema().table_by_name("d", "t")
+        assert tk.session.stats_for(info.id).count == 3
+
+    def test_analyze_missing_table_errors(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        try:
+            tk.exec("analyze table nope")
+            assert False, "expected error"
+        except Exception:
+            pass
+
+
+def _scan_type(tk, sql):
+    from tidb_tpu.plan import optimize_plan
+    from tidb_tpu.plan.builder import PlanBuilder
+    s = tk.session
+    stmt = s.parser.parse_one(sql)
+    p = optimize_plan(PlanBuilder(s).build(stmt), s, s.client, set())
+
+    def find(n, tp):
+        if isinstance(n, tp):
+            return n
+        for c in n.children:
+            r = find(c, tp)
+            if r is not None:
+                return r
+        return None
+
+    if find(p, PhysicalIndexScan) is not None:
+        return "index"
+    assert find(p, PhysicalTableScan) is not None
+    return "table"
+
+
+class TestCostBasedAccessPath:
+    def test_path_flips_on_distribution(self):
+        """where b = <common value> should table-scan once stats reveal the
+        value matches most rows (double-read index would be slower); a rare
+        value keeps the index path."""
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int primary key, b int, c int, "
+                "key idx_b (b))")
+        # 200 rows: b=7 on 195 of them, b unique elsewhere
+        rows = ", ".join(
+            f"({i}, {7 if i < 195 else 1000 + i}, {i})" for i in range(200))
+        tk.exec(f"insert into t values {rows}")
+
+        # pseudo stats: eq on an index is assumed selective → index path
+        assert _scan_type(tk, "select c from t where b = 7") == "index"
+
+        tk.exec("analyze table t")
+        # common value: ~97% of the table → table scan wins
+        assert _scan_type(tk, "select c from t where b = 7") == "table"
+        # rare value: still the index
+        assert _scan_type(tk, "select c from t where b = 1199") == "index"
+        # results stay correct either way
+        tk.exec("select count(1) from t where b = 7").check([[195]])
+        tk.exec("select c from t where b = 1199").check([[199]])
+
+    def test_range_estimation_flip(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int primary key, b int, c int, "
+                "key idx_b (b))")
+        rows = ", ".join(f"({i}, {i}, {i})" for i in range(200))
+        tk.exec(f"insert into t values {rows}")
+        tk.exec("analyze table t")
+        # narrow range → index; huge range needing a double read → table
+        # scan; covering (index-only) stays index even for wide ranges
+        assert _scan_type(tk, "select c from t where b < 5") == "index"
+        assert _scan_type(tk, "select c from t where b < 190") == "table"
+        assert _scan_type(tk, "select b from t where b < 190") == "index"

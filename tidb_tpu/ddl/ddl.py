@@ -334,6 +334,7 @@ class DDL:
         # delete table data inline (reference defers to the bg queue)
         for tbl in m.list_tables(job.schema_id):
             self._delete_table_data(txn, tbl.id)
+            m.clear_table_stats(tbl.id)
         m.drop_database(job.schema_id)
         job.state = JobState.DONE
         return True
@@ -362,6 +363,7 @@ class DDL:
             info.state = SchemaState.DELETE_ONLY
         else:
             self._delete_table_data(txn, info.id)
+            m.clear_table_stats(info.id)
             m.drop_table(job.schema_id, info.id)
             job.state = JobState.DONE
             return True
@@ -373,6 +375,7 @@ class DDL:
         if info is None:
             raise errors.NoSuchTableError("table dropped concurrently")
         self._delete_table_data(txn, info.id)
+        m.clear_table_stats(info.id)
         m.drop_table(job.schema_id, info.id)
         info.id = m.gen_global_id()
         m.create_table(job.schema_id, info)

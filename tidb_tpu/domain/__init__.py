@@ -23,12 +23,53 @@ class Domain:
         self.handle = Handle(store)
         self.handle.load()
         self.ddl = DDL(store, self.handle, callback=ddl_callback)
+        self._stats: dict[int, object] = {}
+        self._stats_lock = threading.Lock()
+        self._stats_version = 0  # bumped on invalidation; keys plan caches
 
     def info_schema(self) -> InfoSchema:
         return self.handle.get()
 
     def reload(self) -> InfoSchema:
         return self.handle.load()
+
+    # ---- statistics cache (domain.go owns the statistics handle in the
+    # reference; loaded lazily from meta, pseudo when never analyzed) ----
+
+    def stats_for(self, table_id: int):
+        from tidb_tpu import statistics
+        from tidb_tpu.meta import Meta
+        with self._stats_lock:
+            st = self._stats.get(table_id)
+            gen = self._stats_version
+        if st is not None:
+            return st
+        txn = self.store.begin()
+        try:
+            raw = Meta(txn).get_table_stats(table_id)
+        finally:
+            txn.rollback()
+        st = (statistics.TableStats.deserialize(raw) if raw
+              else statistics.pseudo_table(table_id))
+        with self._stats_lock:
+            # a concurrent invalidate_stats between our load and here means
+            # the bytes we read may predate the ANALYZE that invalidated —
+            # serve them to this caller but don't pin them in the cache
+            if self._stats_version == gen:
+                self._stats[table_id] = st
+        return st
+
+    @property
+    def stats_version(self) -> int:
+        return self._stats_version
+
+    def invalidate_stats(self, table_id: int | None = None) -> None:
+        with self._stats_lock:
+            self._stats_version += 1
+            if table_id is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(table_id, None)
 
 
 def get_domain(store, **kwargs) -> Domain:

@@ -62,6 +62,8 @@ def execute_simple(session, stmt) -> ResultSet | None:
         return _show(session, stmt)
     if isinstance(stmt, ast.AdminStmt):
         return _admin(session, stmt)
+    if isinstance(stmt, ast.AnalyzeTableStmt):
+        return _analyze(session, stmt)
     raise errors.ExecError(f"unsupported statement {type(stmt).__name__}")
 
 
@@ -212,6 +214,10 @@ def _ddl(session, stmt):
     elif isinstance(stmt, ast.AlterTableStmt):
         for spec in stmt.specs:
             _alter(session, ddl, dbname(stmt.table), stmt.table.name, spec)
+    # drop cached TableStats for dropped/truncated/reshaped tables — table
+    # ids are never reused, so entries for dead ids would otherwise pin
+    # their histograms for the process lifetime
+    session.domain.invalidate_stats()
     return None
 
 
@@ -366,3 +372,29 @@ def _admin(session, stmt: ast.AdminStmt) -> ResultSet:
             check_table(session.store.get_snapshot(), tbl)
         return None
     raise errors.ExecError(f"unsupported ADMIN statement {stmt.tp!r}")
+
+
+def _analyze(session, stmt: ast.AnalyzeTableStmt) -> None:
+    """ANALYZE TABLE: full-scan histogram build persisted through meta
+    (executor/executor_simple.go:253-310 buildStatisticTable; the reference
+    reservoir-samples 10k rows — the columnar engine scans cheaply enough to
+    use every row)."""
+    from tidb_tpu import statistics
+    from tidb_tpu.kv.txn_util import run_in_new_txn
+    # implicit commit, like DDL: the histogram scan reads a fresh committed
+    # snapshot and must see this session's own pending writes
+    session.commit_txn()
+    db = session.vars.current_db
+    snap = session.store.get_snapshot()
+    for tn in stmt.tables:
+        tbl = session.info_schema().table_by_name(tn.db or db, tn.name)
+        stats = statistics.analyze_table(tbl, snap)
+        raw = stats.serialize()
+
+        def write(txn, table_id=tbl.id, raw=raw):
+            from tidb_tpu.meta import Meta
+            Meta(txn).set_table_stats(table_id, raw)
+
+        run_in_new_txn(session.store, True, write)
+        session.domain.invalidate_stats(tbl.id)
+    return None

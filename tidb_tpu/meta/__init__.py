@@ -33,6 +33,10 @@ def _autoid_key(table_id: int) -> bytes:
     return b"TID:%d" % table_id
 
 
+def _stats_key(table_id: int) -> bytes:
+    return b"Stats:%d" % table_id
+
+
 class Meta:
     """Typed accessors over one transaction's view of the meta keyspace."""
 
@@ -112,6 +116,18 @@ class Meta:
             if field.startswith(b"Table:"):
                 out.append(TableInfo.deserialize(v))
         return out
+
+    # ---- table statistics (plan/statistics persistence; the reference
+    # serializes statistics.proto into a column of a system table — here the
+    # meta keyspace is the natural home) ----
+    def set_table_stats(self, table_id: int, raw: bytes) -> None:
+        self.t.set(_stats_key(table_id), raw)
+
+    def get_table_stats(self, table_id: int) -> bytes | None:
+        return self.t.get(_stats_key(table_id))
+
+    def clear_table_stats(self, table_id: int) -> None:
+        self.t.clear(_stats_key(table_id))
 
     # ---- DDL job queues (meta/meta.go:442+) ----
     def enqueue_ddl_job(self, job: DDLJob, bg: bool = False) -> None:

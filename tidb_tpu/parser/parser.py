@@ -151,6 +151,7 @@ class Parser:
             "DESCRIBE": self._parse_explain,
             "DESC": self._parse_explain,
             "ADMIN": self._parse_admin,
+            "ANALYZE": self._parse_analyze,
             "PREPARE": self._parse_prepare,
             "EXECUTE": self._parse_execute,
             "DEALLOCATE": self._parse_deallocate,
@@ -852,6 +853,15 @@ class Parser:
         while self._try_op(","):
             tables.append(self._parse_table_name())
         return ast.AdminStmt(tp=ast.AdminType.CHECK_TABLE, tables=tables)
+
+    def _parse_analyze(self) -> ast.AnalyzeTableStmt:
+        """ANALYZE TABLE t1 [, t2] (parser.y AnalyzeTableStmt)."""
+        self._expect_kw("ANALYZE")
+        self._expect_kw("TABLE")
+        tables = [self._parse_table_name()]
+        while self._try_op(","):
+            tables.append(self._parse_table_name())
+        return ast.AnalyzeTableStmt(tables=tables)
 
     # ================= expressions (Pratt) =================
     # binding powers, low → high (MySQL precedence)

@@ -2,8 +2,8 @@
 
 Reference: plan/optimizer.go:31 Optimize / :52 doOptimize —
 build logical → PredicatePushDown → PruneColumns → ResolveIndices →
-physical conversion with pushdown attachment. (Cost-based access-path
-choice uses the refiner heuristics until the statistics module lands.)
+physical conversion with pushdown attachment and cost-based access-path
+choice backed by ANALYZE histograms (pseudo rates before ANALYZE).
 """
 
 from __future__ import annotations
@@ -44,5 +44,6 @@ def optimize_plan(p: Plan, ctx, client, dirty_table_ids=None) -> Plan:
     else:
         prune_columns(p, None)
     resolve_indices(p)
-    phys_ctx = PhysicalContext(client, set(dirty_table_ids or ()))
+    phys_ctx = PhysicalContext(client, set(dirty_table_ids or ()),
+                               stats_fn=getattr(ctx, "stats_for", None))
     return to_physical(p, phys_ctx)

@@ -34,10 +34,31 @@ from tidb_tpu.plan.plans import (
 from tidb_tpu.types.field_type import FieldType, new_field_type
 
 
+# Cost factors (plan/physical_plans.go:25-33 netWorkFactor/scanFactor et
+# al. — relative weights, not wall-clock units).
+NET_WORK_FACTOR = 1.5
+SCAN_FACTOR = 2.0
+LOOKUP_FACTOR = 3.0     # extra per-row cost of the double-read second round
+
+
 class PhysicalContext:
-    def __init__(self, client, dirty_table_ids: set[int] | None = None):
+    def __init__(self, client, dirty_table_ids: set[int] | None = None,
+                 stats_fn=None):
         self.client = client
         self.dirty = dirty_table_ids or set()
+        self._stats_fn = stats_fn
+
+    def stats(self, table_id: int):
+        from tidb_tpu import statistics
+        if self._stats_fn is not None:
+            st = self._stats_fn(table_id)
+            # zero-count stats (analyzed while empty) estimate every path
+            # at cost 0 and would pin full table scans after the table
+            # grows — fall back to pseudo rates like the reference does
+            # for missing/tiny statistics
+            if st is not None and st.count > 0:
+                return st
+        return statistics.pseudo_table(table_id)
 
 
 def to_physical(p: Plan, ctx: PhysicalContext) -> Plan:
@@ -161,12 +182,16 @@ def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
     table_ranges = refiner.build_table_range(access, handle_col) \
         if access else list(refiner.FULL_TABLE_RANGE)
 
-    # index access path: only competes when the PK gave no bound; dirty
-    # tables always table-scan (UnionScan merges by handle ranges)
-    # (convert2IndexScan; the cost model with stats arrives later)
+    # Cost-based access path (convert2TableScan :129 / convert2IndexScan
+    # :206, costs per calculateCost plan/physical_plans.go:70,84): the
+    # table-scan candidate is costed against every viable index, using
+    # ANALYZE histograms when present, pseudo rates otherwise. Dirty tables
+    # always table-scan (UnionScan merges by handle ranges).
     if not access and ds.table_info.id not in ctx.dirty:
-        idx_plan = _try_index_scan(ds, rest, ctx)
-        if idx_plan is not None:
+        stats = ctx.stats(ds.table_info.id)
+        table_cost = stats.count * SCAN_FACTOR + stats.count * NET_WORK_FACTOR
+        idx_plan, idx_cost = _try_index_scan(ds, rest, ctx, stats)
+        if idx_plan is not None and idx_cost < table_cost:
             return idx_plan
 
     scan = PhysicalTableScan()
@@ -189,12 +214,46 @@ def _fill_source(scan, ds: DataSource) -> None:
     scan.schema = ds.schema
 
 
-def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext):
-    """Pick the most selective index by eq-prefix length.
-    Reference: convert2IndexScan (plan/physical_plan_builder.go:206)."""
+def _estimate_index_rows(stats, idx_cols, eq_vals, range_conds,
+                         ranges) -> float:
+    """Rows matching an index eq-prefix + one range column, by multiplying
+    per-column selectivities from the histograms (getRowCountByIndexRanges,
+    plan/physical_plan_builder.go:67 via statistics row counts). `ranges`
+    is the already-built result of build_index_range(eq_vals, range_conds)
+    — its last bound pair is the range column's interval."""
+    if not ranges:
+        return 0.0
+    rows = float(max(stats.count, 1))
+    total = float(max(stats.count, 1))
+    for col, v in zip(idx_cols, eq_vals):
+        rows *= stats.equal_row_count(col.col_id, v) / total
+    if range_conds:
+        col = idx_cols[len(eq_vals)]
+        lo, hi = ranges[0].low[-1], ranges[0].high[-1]
+        from tidb_tpu.types.datum import MAX_VALUE, MIN_NOT_NULL
+        from tidb_tpu.types.datum import compare_datum
+        if lo is MIN_NOT_NULL and hi is MAX_VALUE:
+            sel = 1.0
+        elif lo is MIN_NOT_NULL:
+            sel = stats.less_row_count(col.col_id, hi) / total
+        elif hi is MAX_VALUE:
+            sel = stats.greater_row_count(col.col_id, lo) / total
+        elif compare_datum(lo, hi) == 0:
+            sel = stats.equal_row_count(col.col_id, lo) / total
+        else:
+            sel = stats.between_row_count(col.col_id, lo, hi) / total
+        rows *= min(1.0, max(sel, 0.0))
+    return rows
+
+
+def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext, stats):
+    """Pick the cheapest index by estimated row count; returns
+    (plan | None, cost). Reference: convert2IndexScan
+    (plan/physical_plan_builder.go:206)."""
     from tidb_tpu.model.model import SchemaState
+    handle = _handle_column(ds)
     best = None
-    best_score = 0
+    best_cost = float("inf")
     for idx in ds.table_info.indices:
         if idx.state != SchemaState.PUBLIC:
             continue
@@ -211,26 +270,32 @@ def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext):
             continue
         eq_vals, range_conds, next_col, remained = \
             refiner.detach_index_scan_conditions(conditions, idx_cols)
-        score = len(eq_vals) * 2 + (1 if range_conds else 0)
-        if score > best_score:
-            best_score = score
-            best = (idx, idx_cols, eq_vals, range_conds, remained)
+        if not eq_vals and not range_conds:
+            continue  # full index scan never beats the table scan here
+        ranges = refiner.build_index_range(eq_vals, range_conds)
+        rows = _estimate_index_rows(stats, idx_cols, eq_vals, range_conds,
+                                    ranges)
+        idx_col_ids = {c.col_id for c in idx_cols}
+        covered = all(c.col_id in idx_col_ids
+                      or (handle is not None and c.col_id == handle.col_id)
+                      for c in ds.schema)
+        cost = rows * SCAN_FACTOR + rows * NET_WORK_FACTOR
+        if not covered:
+            cost += rows * (NET_WORK_FACTOR + LOOKUP_FACTOR)
+        if cost < best_cost:
+            best_cost = cost
+            best = (idx, ranges, remained, not covered)
     if best is None:
-        return None
-    idx, idx_cols, eq_vals, range_conds, remained = best
+        return None, best_cost
+    idx, ranges, remained, double_read = best
     scan = PhysicalIndexScan()
     _fill_source(scan, ds)
     scan.index = idx
-    scan.ranges = refiner.build_index_range(eq_vals, range_conds)
+    scan.ranges = ranges
     scan.conditions = remained
-    idx_col_ids = {c.col_id for c in idx_cols}
-    handle = _handle_column(ds)
-    covered = all(c.col_id in idx_col_ids
-                  or (handle is not None and c.col_id == handle.col_id)
-                  for c in ds.schema)
-    scan.double_read = not covered
+    scan.double_read = double_read
     scan.out_of_order = False
-    return scan
+    return scan, best_cost
 
 
 def _maybe_union_scan(scan, ds: DataSource, conditions, ctx: PhysicalContext):

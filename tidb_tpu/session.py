@@ -84,6 +84,11 @@ class Session:
     def info_schema(self):
         return self.domain.info_schema()
 
+    def stats_for(self, table_id: int):
+        """Table statistics for the cost-based planner (pseudo until
+        ANALYZE TABLE has run; plan/logical_plan_builder.go:884)."""
+        return self.domain.stats_for(table_id)
+
     def txn(self):
         if self._txn is None or not self._txn.valid():
             self._txn = self.store.begin()
@@ -306,15 +311,17 @@ class Session:
         self.params = values
         try:
             # plan cache: reusable because ParamExpr reads live bindings;
-            # keyed by schema version + the coprocessor client OBJECT (a
-            # held reference — id() could be recycled after an engine
-            # swap), and bypassed while the txn holds dirty writes
-            # (UnionScan wiring is dirty-state-dependent)
-            key = (self.domain.info_schema().version, self.client)
+            # keyed by schema version + stats version (ANALYZE must evict
+            # plans whose access path was costed on older histograms) + the
+            # coprocessor client OBJECT (a held reference — id() could be
+            # recycled after an engine swap), and bypassed while the txn
+            # holds dirty writes (UnionScan wiring is dirty-state-dependent)
+            key = (self.domain.info_schema().version,
+                   self.domain.stats_version, self.client)
             phys = None
             if ent.plan is not None and ent.plan_key is not None \
-                    and ent.plan_key[0] == key[0] \
-                    and ent.plan_key[1] is key[1] \
+                    and ent.plan_key[:2] == key[:2] \
+                    and ent.plan_key[2] is key[2] \
                     and not self.dirty_tables:
                 phys = ent.plan
                 self.vars.last_plan_from_cache = True
@@ -394,7 +401,7 @@ def _is_simple(stmt) -> bool:
         ast.RollbackStmt, ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
         ast.CreateTableStmt, ast.DropTableStmt, ast.TruncateTableStmt,
         ast.CreateIndexStmt, ast.DropIndexStmt, ast.AlterTableStmt,
-        ast.AdminStmt))
+        ast.AdminStmt, ast.AnalyzeTableStmt))
 
 
 # ---------------------------------------------------------------------------

@@ -28,5 +28,5 @@ from tidb_tpu.sqlast.ddl import (  # noqa: F401
 from tidb_tpu.sqlast.misc import (  # noqa: F401
     BeginStmt, CommitStmt, RollbackStmt, UseStmt, SetStmt, VariableAssignment,
     ShowStmt, ShowType, ExplainStmt, AdminStmt, AdminType,
-    PrepareStmt, ExecuteStmt, DeallocateStmt,
+    AnalyzeTableStmt, PrepareStmt, ExecuteStmt, DeallocateStmt,
 )

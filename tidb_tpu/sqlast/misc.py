@@ -82,6 +82,13 @@ class AdminStmt(StmtNode):
 
 
 @dataclass
+class AnalyzeTableStmt(StmtNode):
+    """ANALYZE TABLE t1 [, t2] — builds column histograms
+    (ast/stats.go AnalyzeTableStmt; executor/executor_simple.go:253)."""
+    tables: list[TableName] = field(default_factory=list)
+
+
+@dataclass
 class PrepareStmt(StmtNode):
     """PREPARE name FROM 'text' | @var (ast/misc.go PrepareStmt)."""
     name: str = ""
