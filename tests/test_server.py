@@ -1,0 +1,209 @@
+"""MySQL wire protocol server tests.
+
+Conformance is checked with the in-repo client (tidb_tpu/server/client.py)
+— the analogue of the reference's go-sql-driver-based server tests
+(server/server_test.go).
+"""
+
+import socket
+import threading
+
+import pytest
+
+from tidb_tpu.server import Client, MySQLError, Server
+from tidb_tpu.server import protocol as p
+from tidb_tpu.server.packetio import PacketIO
+from tidb_tpu.session import Session, new_store
+from tests.testkit import _store_id  # reuse unique store naming
+
+
+@pytest.fixture
+def srv():
+    store = new_store(f"memory://srv{next(_store_id)}")
+    server = Server(store)
+    server.start()
+    yield server
+    server.close()
+
+
+def connect(server, **kw) -> Client:
+    return Client("127.0.0.1", server.port, **kw)
+
+
+class TestHandshake:
+    def test_root_empty_password(self, srv):
+        c = connect(srv)
+        assert c.server_version.startswith("5.7")
+        c.ping()
+        c.close()
+
+    def test_unknown_user_rejected(self, srv):
+        with pytest.raises(MySQLError) as ei:
+            connect(srv, user="nobody")
+        assert ei.value.code == 1045
+
+    def test_password_auth_round_trip(self, srv):
+        h = p.password_hash("s3cret")
+        s = Session(srv.store)
+        s.execute("insert into mysql.user (Host, User, Password) "
+                  f"values ('%', 'alice', '{h}')")
+        c = connect(srv, user="alice", password="s3cret")
+        c.ping()
+        c.close()
+        with pytest.raises(MySQLError):
+            connect(srv, user="alice", password="wrong")
+        with pytest.raises(MySQLError):
+            connect(srv, user="alice", password="")
+
+    def test_connect_with_db(self, srv):
+        c = connect(srv)
+        c.query("create database hsdb")
+        c.close()
+        c2 = connect(srv, db="hsdb")
+        c2.query("create table t (a int)")
+        c2.query("insert into t values (1)")
+        assert c2.query("select * from t")[0].rows == [["1"]]
+        c2.close()
+
+    def test_connect_with_bad_db(self, srv):
+        with pytest.raises(MySQLError):
+            connect(srv, db="no_such_db")
+
+
+class TestQuery:
+    def test_resultset_types_and_null(self, srv):
+        c = connect(srv)
+        c.query("create database d; use d")
+        c.query("create table t (a int primary key, b varchar(20), "
+                "c double, d decimal(10,2))")
+        r = c.query("insert into t values (1,'x',1.5,'3.75'), "
+                    "(2,null,null,null)")[0]
+        assert r.affected == 2
+        r = c.query("select * from t order by a")[0]
+        assert r.columns == ["a", "b", "c", "d"]
+        assert r.rows[0] == ["1", "x", "1.5", "3.75"]
+        assert r.rows[1] == ["2", None, None, None]
+        c.close()
+
+    def test_multi_statement_multi_resultset(self, srv):
+        c = connect(srv)
+        rs = c.query("select 1; select 'two'; select 3")
+        assert [x.rows for x in rs] == [[["1"]], [["two"]], [["3"]]]
+        c.close()
+
+    def test_multi_statement_per_statement_framing(self, srv):
+        """Effect statements get their own OK (with affected rows) even
+        mid-sequence — drivers attribute results positionally."""
+        c = connect(srv)
+        c.query("create database dm; use dm; create table t (a int)")
+        rs = c.query("insert into t values (1), (2); select 99; "
+                     "insert into t values (3)")
+        assert len(rs) == 3
+        assert rs[0].rows is None and rs[0].affected == 2
+        assert rs[1].rows == [["99"]]
+        assert rs[2].rows is None and rs[2].affected == 1
+        c.close()
+
+    def test_hostile_usernames_rejected_cleanly(self, srv):
+        for user in ("evil\\", "ro'ot", "a' or '1'='1"):
+            with pytest.raises(MySQLError) as ei:
+                connect(srv, user=user)
+            assert ei.value.code in (1045, 1105)
+
+    def test_error_keeps_connection_alive(self, srv):
+        c = connect(srv)
+        with pytest.raises(MySQLError) as ei:
+            c.query("select * from missing.t")
+        assert ei.value.code != 0
+        assert c.query("select 42")[0].rows == [["42"]]
+        c.close()
+
+    def test_init_db_command(self, srv):
+        c = connect(srv)
+        c.query("create database d2")
+        c.pkt.reset_sequence()
+        c.pkt.write_packet(bytes((p.COM_INIT_DB,)) + b"d2")
+        assert c.pkt.read_packet()[0] == 0x00
+        c.query("create table t (a int)")
+        assert c.query("select count(1) from t")[0].rows == [["0"]]
+        c.close()
+
+    def test_txn_rolls_back_on_disconnect(self, srv):
+        c = connect(srv)
+        c.query("create database d3; use d3; create table t (a int)")
+        c.query("begin")
+        c.query("insert into t values (1)")
+        c.close()
+        c2 = connect(srv, db="d3")
+        assert c2.query("select count(1) from t")[0].rows == [["0"]]
+        c2.close()
+
+    def test_prepared_statements_text_protocol(self, srv):
+        c = connect(srv)
+        c.query("create database d4; use d4; create table t (a int)")
+        c.query("insert into t values (1), (2), (3)")
+        c.query("prepare p from 'select a from t where a > ?'")
+        c.query("set @x = 1")
+        assert c.query("execute p using @x")[0].rows == [["2"], ["3"]]
+        c.close()
+
+
+class TestServerLimits:
+    def test_token_limit(self):
+        store = new_store(f"memory://srvlim{next(_store_id)}")
+        server = Server(store, token_limit=1)
+        server.start()
+        try:
+            c1 = connect(server)
+            # second connection is closed before handshake
+            with pytest.raises(Exception):
+                connect(server, timeout=2.0)
+            c1.close()
+        finally:
+            server.close()
+
+
+class TestPacketIO:
+    def test_large_packet_split_round_trip(self):
+        a, b = socket.socketpair()
+        pa, pb = PacketIO(a), PacketIO(b)
+        payload = bytes(range(256)) * 70000  # ~17.9MB > 0xffffff
+        got = {}
+        t = threading.Thread(target=lambda: got.setdefault(
+            "data", pb.read_packet()))
+        t.start()
+        pa.write_packet(payload)
+        t.join(timeout=30)
+        assert got["data"] == payload
+        a.close()
+        b.close()
+
+    def test_exact_boundary_payload(self):
+        a, b = socket.socketpair()
+        pa, pb = PacketIO(a), PacketIO(b)
+        payload = b"x" * 0xFFFFFF  # exact multiple → empty trailer packet
+        got = {}
+        t = threading.Thread(target=lambda: got.setdefault(
+            "data", pb.read_packet()))
+        t.start()
+        pa.write_packet(payload)
+        t.join(timeout=30)
+        assert got["data"] == payload
+        a.close()
+        b.close()
+
+
+class TestAuthPrimitives:
+    def test_scramble_round_trip(self):
+        salt = p.new_salt()
+        token = p.scramble_password("hunter2", salt)
+        assert p.check_auth(token, p.password_hash("hunter2"), salt)
+        assert not p.check_auth(token, p.password_hash("other"), salt)
+        assert not p.check_auth(b"", p.password_hash("hunter2"), salt)
+        assert p.check_auth(b"", "", salt)
+
+    def test_lenenc_int_round_trip(self):
+        for n in (0, 250, 251, 65535, 65536, 1 << 23, 1 << 24, 1 << 60):
+            enc = p.lenenc_int(n)
+            dec, pos = p.read_lenenc_int(enc, 0)
+            assert dec == n and pos == len(enc)

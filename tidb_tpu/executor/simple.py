@@ -387,13 +387,18 @@ def _analyze(session, stmt: ast.AnalyzeTableStmt) -> None:
     db = session.vars.current_db
     snap = session.store.get_snapshot()
     for tn in stmt.tables:
+        db_info = session.info_schema().schema_by_name(tn.db or db)
         tbl = session.info_schema().table_by_name(tn.db or db, tn.name)
         stats = statistics.analyze_table(tbl, snap)
         raw = stats.serialize()
 
-        def write(txn, table_id=tbl.id, raw=raw):
+        def write(txn, db_id=db_info.id, table_id=tbl.id, raw=raw):
             from tidb_tpu.meta import Meta
-            Meta(txn).set_table_stats(table_id, raw)
+            m = Meta(txn)
+            # a concurrent DROP TABLE may have cleared this id's stats —
+            # don't resurrect the key for a dead table (ids never reused)
+            if m.get_table(db_id, table_id) is not None:
+                m.set_table_stats(table_id, raw)
 
         run_in_new_txn(session.store, True, write)
         session.domain.invalidate_stats(tbl.id)

@@ -1,0 +1,12 @@
+"""MySQL wire protocol server (reference: server/ package).
+
+`Server` listens on TCP and speaks the MySQL 4.1+ protocol — handshake +
+mysql_native_password auth against mysql.user, COM_QUERY with textual
+resultsets (multi-statement / multi-resultset aware), COM_INIT_DB /
+COM_PING / COM_FIELD_LIST, 16MB packet splitting, and a connection-token
+limit. `Client` is the in-repo conformance client used by tests and the
+CLI shell.
+"""
+
+from tidb_tpu.server.client import Client, MySQLError, QueryResult  # noqa: F401
+from tidb_tpu.server.server import Server  # noqa: F401

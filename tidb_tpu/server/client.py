@@ -1,0 +1,159 @@
+"""Minimal MySQL client for conformance tests and the CLI.
+
+Implements the client half of the 4.1+ protocol against any MySQL-speaking
+server (handshake v10 + mysql_native_password, COM_QUERY text resultsets)
+— the stand-in for the reference's use of go-sql-driver in its test rigs.
+No external dependencies, so the wire server is tested end-to-end even in
+this hermetic environment.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from tidb_tpu.server import protocol as p
+from tidb_tpu.server.packetio import PacketIO
+
+
+class MySQLError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"({code}) {message}")
+        self.code = code
+        self.message = message
+
+
+class QueryResult:
+    def __init__(self, columns, rows, affected=0, insert_id=0, more=False):
+        self.columns = columns      # list[str]
+        self.rows = rows            # list[list[str|None]] or None for OK
+        self.affected = affected
+        self.insert_id = insert_id
+        self.more = more            # SERVER_MORE_RESULTS_EXISTS was set
+
+
+class Client:
+    def __init__(self, host: str, port: int, user: str = "root",
+                 password: str = "", db: str = "", timeout: float = 10.0):
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.pkt = PacketIO(sock)
+        self._handshake(user, password, db)
+
+    # ---- handshake ----
+
+    def _handshake(self, user: str, password: str, db: str) -> None:
+        greeting = self.pkt.read_packet()
+        if greeting[0] == 0xFF:
+            raise self._as_error(greeting)
+        pos = 1
+        end = greeting.index(b"\x00", pos)
+        self.server_version = greeting[pos:end].decode()
+        pos = end + 1
+        self.conn_id = struct.unpack_from("<I", greeting, pos)[0]
+        pos += 4
+        salt = greeting[pos:pos + 8]
+        pos += 9
+        caps = struct.unpack_from("<H", greeting, pos)[0]
+        pos += 2
+        if pos < len(greeting):
+            pos += 1 + 2  # charset + status
+            caps |= struct.unpack_from("<H", greeting, pos)[0] << 16
+            pos += 2
+            salt_len = greeting[pos]
+            pos += 1 + 10
+            if caps & p.CLIENT_SECURE_CONNECTION:
+                extra = max(13, salt_len - 8) - 1
+                salt += greeting[pos:pos + extra]
+
+        flags = (p.CLIENT_PROTOCOL_41 | p.CLIENT_LONG_PASSWORD
+                 | p.CLIENT_SECURE_CONNECTION | p.CLIENT_TRANSACTIONS
+                 | p.CLIENT_MULTI_STATEMENTS | p.CLIENT_MULTI_RESULTS
+                 | p.CLIENT_PLUGIN_AUTH)
+        if db:
+            flags |= p.CLIENT_CONNECT_WITH_DB
+        token = p.scramble_password(password, salt)
+        out = struct.pack("<IIB", flags, 1 << 24, p.CHARSET_UTF8)
+        out += b"\x00" * 23
+        out += user.encode() + b"\x00"
+        out += bytes((len(token),)) + token
+        if db:
+            out += db.encode() + b"\x00"
+        out += p.AUTH_PLUGIN + b"\x00"
+        self.pkt.write_packet(out)
+        resp = self.pkt.read_packet()
+        if resp[0] == 0xFF:
+            raise self._as_error(resp)
+
+    # ---- queries ----
+
+    def query(self, sql: str) -> list[QueryResult]:
+        """COM_QUERY; returns one QueryResult per resultset (rows=None for
+        effect-only statements)."""
+        self.pkt.reset_sequence()
+        self.pkt.write_packet(bytes((p.COM_QUERY,)) + sql.encode())
+        results = [self._read_result()]
+        while results[-1].more:
+            results.append(self._read_result())
+        return results
+
+    def _read_result(self) -> QueryResult:
+        first = self.pkt.read_packet()
+        if first[0] == 0xFF:
+            raise self._as_error(first)
+        if first[0] == 0x00:
+            affected, pos = p.read_lenenc_int(first, 1)
+            insert_id, pos = p.read_lenenc_int(first, pos)
+            status = struct.unpack_from("<H", first, pos)[0]
+            return QueryResult([], None, affected, insert_id,
+                               bool(status & p.SERVER_MORE_RESULTS_EXISTS))
+        ncols, _ = p.read_lenenc_int(first, 0)
+        columns = []
+        for _ in range(ncols):
+            cdef = self.pkt.read_packet()
+            pos = 0
+            for _f in range(4):  # catalog, db, table, org_table
+                _v, pos = p.read_lenenc_bytes(cdef, pos)
+            name, pos = p.read_lenenc_bytes(cdef, pos)
+            columns.append(name.decode())
+        eof = self.pkt.read_packet()
+        status = struct.unpack_from("<H", eof, 3)[0]
+        rows: list[list[str | None]] = []
+        while True:
+            data = self.pkt.read_packet()
+            if data[0] == 0xFF:
+                raise self._as_error(data)
+            if data[0] == 0xFE and len(data) < 9:
+                status = struct.unpack_from("<H", data, 3)[0]
+                break
+            row: list[str | None] = []
+            pos = 0
+            while pos < len(data):
+                v, pos = p.read_lenenc_bytes(data, pos)
+                row.append(None if v is None else v.decode())
+            rows.append(row)
+        return QueryResult(columns, rows, more=bool(
+            status & p.SERVER_MORE_RESULTS_EXISTS))
+
+    def ping(self) -> None:
+        self.pkt.reset_sequence()
+        self.pkt.write_packet(bytes((p.COM_PING,)))
+        resp = self.pkt.read_packet()
+        if resp[0] == 0xFF:
+            raise self._as_error(resp)
+
+    def close(self) -> None:
+        try:
+            self.pkt.reset_sequence()
+            self.pkt.write_packet(bytes((p.COM_QUIT,)))
+        except Exception:
+            pass
+        self.pkt.close()
+
+    @staticmethod
+    def _as_error(data: bytes) -> MySQLError:
+        code = struct.unpack_from("<H", data, 1)[0]
+        pos = 3
+        if pos < len(data) and data[pos:pos + 1] == b"#":
+            pos += 6
+        return MySQLError(code, data[pos:].decode(errors="replace"))

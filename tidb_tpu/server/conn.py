@@ -1,0 +1,203 @@
+"""One client connection: handshake, auth, command dispatch loop.
+
+Reference: server/conn.go — clientConn.Run (:312) reads command packets and
+dispatches (:350) to the session; handshake/auth (:90,:272); resultset
+writing (:640). Each connection owns one Session over the server's shared
+store, so SQL semantics (txns, sysvars, prepared statements) are exactly
+the library semantics.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from tidb_tpu import errors, mysqldef as my
+from tidb_tpu.server import protocol as p
+from tidb_tpu.server.packetio import PacketError, PacketIO
+from tidb_tpu.session import Session
+
+
+class ClientConnection:
+    def __init__(self, server, sock, conn_id: int):
+        self.server = server
+        self.pkt = PacketIO(sock)
+        self.conn_id = conn_id
+        self.salt = p.new_salt()
+        self.session: Session | None = None
+        self.user = ""
+        self.capability = 0
+        self.alive = True
+
+    # ------------------------------------------------------------------
+    # handshake (conn.go:90 writeInitialHandshake, :180 readHandshakeResponse)
+    # ------------------------------------------------------------------
+
+    def handshake(self) -> bool:
+        self.pkt.write_packet(p.handshake_v10(self.conn_id, self.salt))
+        data = self.pkt.read_packet()
+        pos = 0
+        self.capability = struct.unpack_from("<I", data, pos)[0]
+        pos += 4
+        pos += 4  # max packet size
+        pos += 1  # charset
+        pos += 23
+        end = data.index(b"\x00", pos)
+        self.user = data[pos:end].decode()
+        pos = end + 1
+        if self.capability & p.CLIENT_SECURE_CONNECTION:
+            alen = data[pos]
+            pos += 1
+            token = data[pos:pos + alen]
+            pos += alen
+        else:
+            end = data.index(b"\x00", pos)
+            token = data[pos:end]
+            pos = end + 1
+        db = ""
+        if self.capability & p.CLIENT_CONNECT_WITH_DB and pos < len(data):
+            end = data.find(b"\x00", pos)
+            end = len(data) if end < 0 else end
+            db = data[pos:end].decode()
+
+        if not self._check_user(self.user, token):
+            self.pkt.write_packet(p.err_packet(
+                my.ErrAccessDenied,
+                f"Access denied for user '{self.user}'", "28000"))
+            return False
+        self.session = Session(self.server.store)
+        self.session.vars.connection_id = self.conn_id
+        self.session.vars.user = self.user
+        if db:
+            try:
+                self.session.execute(f"use `{db.replace(chr(96), '``')}`")
+            except errors.TiDBError as e:
+                self.pkt.write_packet(self._err(e))
+                return False
+        self.pkt.write_packet(p.ok_packet(status=self._status()))
+        return True
+
+    def _check_user(self, user: str, token: bytes) -> bool:
+        stored = self.server.password_hash_for(user)
+        if stored is None:
+            return False
+        return p.check_auth(token, stored, self.salt)
+
+    # ------------------------------------------------------------------
+    # command loop (conn.go:312 Run)
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            if not self.handshake():
+                return
+            while self.alive and self.server.running:
+                self.pkt.reset_sequence()
+                try:
+                    data = self.pkt.read_packet()
+                except PacketError:
+                    return
+                if not data:
+                    return
+                self.dispatch(data[0], data[1:])
+        except (PacketError, OSError):
+            pass
+        except Exception as e:
+            # malformed handshake bytes / engine bug during auth: tell the
+            # client instead of dying with a thread traceback
+            try:
+                self.pkt.write_packet(p.err_packet(my.ErrUnknown, str(e)))
+            except Exception:
+                pass
+        finally:
+            self.close()
+
+    def dispatch(self, cmd: int, data: bytes) -> None:
+        try:
+            if cmd == p.COM_QUIT:
+                self.alive = False
+            elif cmd == p.COM_PING:
+                self.pkt.write_packet(p.ok_packet(status=self._status()))
+            elif cmd == p.COM_INIT_DB:
+                db = data.decode().replace("`", "``")
+                self.session.execute(f"use `{db}`")
+                self.pkt.write_packet(p.ok_packet(status=self._status()))
+            elif cmd == p.COM_QUERY:
+                self.handle_query(data.decode())
+            elif cmd == p.COM_FIELD_LIST:
+                self.handle_field_list(data)
+            else:
+                self.pkt.write_packet(p.err_packet(
+                    my.ErrUnknown, f"command {cmd} not supported"))
+        except errors.TiDBError as e:
+            self.pkt.write_packet(self._err(e))
+        except Exception as e:  # engine bug — keep the connection alive
+            self.pkt.write_packet(p.err_packet(my.ErrUnknown, str(e)))
+
+    def _status(self) -> int:
+        st = 0
+        if self.session is not None:
+            if self.session.vars.autocommit:
+                st |= p.SERVER_STATUS_AUTOCOMMIT
+            if self.session.vars.in_txn:
+                st |= p.SERVER_STATUS_IN_TRANS
+        return st
+
+    def _err(self, e: errors.TiDBError) -> bytes:
+        return p.err_packet(getattr(e, "code", my.ErrUnknown) or
+                            my.ErrUnknown, str(e))
+
+    # ------------------------------------------------------------------
+    # COM_QUERY (conn.go:571 handleQuery → :640 writeResultset)
+    # ------------------------------------------------------------------
+
+    def handle_query(self, sql: str) -> None:
+        """One OK or resultset per statement, chained with the
+        MORE_RESULTS flag (conn.go:571 handleQuery; multi-statement needs
+        per-statement framing so drivers attribute results correctly)."""
+        stmts = self.session.parser.parse(sql)
+        for i, stmt in enumerate(stmts):
+            rs = self.session.execute_stmt(stmt, stmt.text or sql)
+            more = i + 1 < len(stmts)
+            if rs is None:
+                st = self._status() | (p.SERVER_MORE_RESULTS_EXISTS
+                                       if more else 0)
+                self.pkt.write_packet(p.ok_packet(
+                    affected=self.session.vars.affected_rows,
+                    insert_id=self.session.vars.last_insert_id, status=st))
+            else:
+                self.write_resultset(rs, more)
+
+    def write_resultset(self, rs, more: bool) -> None:
+        status = self._status() | (p.SERVER_MORE_RESULTS_EXISTS if more
+                                   else 0)
+        self.pkt.write_packet(p.lenenc_int(len(rs.fields)))
+        for name, ft in rs.fields:
+            self.pkt.write_packet(p.column_def(
+                name, ft.tp, flag=ft.flag, flen=ft.flen, decimal=ft.decimal))
+        self.pkt.write_packet(p.eof_packet(status=status))
+        for row in rs.rows:
+            self.pkt.write_packet(p.text_row(
+                [p.datum_to_text(d) for d in row]))
+        self.pkt.write_packet(p.eof_packet(status=status))
+
+    def handle_field_list(self, data: bytes) -> None:
+        table = data.split(b"\x00", 1)[0].decode()
+        db = self.session.vars.current_db
+        tbl = self.session.info_schema().table_by_name(db, table)
+        for col in tbl.info.public_columns():
+            ft = col.field_type
+            self.pkt.write_packet(p.column_def(
+                col.name, ft.tp, flag=ft.flag, flen=ft.flen,
+                decimal=ft.decimal, db=db, table=table))
+        self.pkt.write_packet(p.eof_packet(status=self._status()))
+
+    def close(self) -> None:
+        self.alive = False
+        if self.session is not None:
+            try:
+                self.session.rollback_txn()
+            except Exception:
+                pass
+        self.pkt.close()
+        self.server.deregister(self)

@@ -1,0 +1,85 @@
+"""MySQL packet framing over a stream socket.
+
+Reference: server/packetio.go — every protocol unit is a sequence of
+packets `[3-byte little-endian length][1-byte sequence id][payload]`;
+payloads of 16MB-1 (0xffffff) or more are split, and a payload that is an
+exact multiple of 0xffffff is terminated by an empty packet so the reader
+knows it ended.
+"""
+
+from __future__ import annotations
+
+import socket
+
+MAX_PAYLOAD = 0xFFFFFF
+
+
+class PacketError(Exception):
+    pass
+
+
+class PacketIO:
+    """Reads/writes framed packets and tracks the sequence id, which resets
+    to 0 at each command boundary (server/packetio.go sequence checks)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.sequence = 0
+        self._rbuf = bytearray()
+
+    def reset_sequence(self) -> None:
+        self.sequence = 0
+
+    # ---- read ----
+
+    def _read_exact(self, n: int) -> bytes:
+        # bytearray append + front-slice: amortized linear, unlike bytes +=
+        # which recopies the whole accumulated buffer per recv
+        while len(self._rbuf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise PacketError("connection closed")
+            self._rbuf += chunk
+        out = bytes(self._rbuf[:n])
+        del self._rbuf[:n]
+        return out
+
+    def read_packet(self) -> bytes:
+        """One logical payload, reassembled across 16MB splits."""
+        parts: list[bytes] = []
+        while True:
+            header = self._read_exact(4)
+            length = header[0] | (header[1] << 8) | (header[2] << 16)
+            seq = header[3]
+            if seq != self.sequence:
+                raise PacketError(
+                    f"packet sequence mismatch: got {seq}, "
+                    f"want {self.sequence}")
+            self.sequence = (self.sequence + 1) & 0xFF
+            parts.append(self._read_exact(length))
+            if length < MAX_PAYLOAD:
+                return parts[0] if len(parts) == 1 else b"".join(parts)
+
+    # ---- write ----
+
+    def write_packet(self, payload: bytes) -> None:
+        """Split at 0xffffff; an exact-multiple payload gets a trailing
+        empty packet (packetio.go writePacket)."""
+        view = memoryview(payload)
+        while True:
+            chunk = view[:MAX_PAYLOAD]
+            n = len(chunk)
+            self.sock.sendall(bytes((n & 0xFF, (n >> 8) & 0xFF,
+                                     (n >> 16) & 0xFF, self.sequence)))
+            if n:
+                self.sock.sendall(chunk)
+            self.sequence = (self.sequence + 1) & 0xFF
+            view = view[n:]
+            if n < MAX_PAYLOAD:
+                return
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

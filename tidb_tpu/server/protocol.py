@@ -1,0 +1,230 @@
+"""MySQL client/server protocol encoding: capability flags, length-encoded
+values, handshake, OK/ERR/EOF, column definitions, textual resultset rows.
+
+Reference: server/conn.go (writeInitialHandshake :90, readHandshakeResponse
+:180, writeOK/writeError :430-470, writeResultset :640) and
+server/driver_tidb.go column-info conversion. Byte layouts follow the
+MySQL 4.1+ protocol; this file is the single place that knows them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+
+from tidb_tpu import mysqldef as my
+
+SERVER_VERSION = b"5.7.25-tidb-tpu"
+PROTOCOL_VERSION = 10
+
+# ---- capability flags (mysql/const.go Client*) ----
+CLIENT_LONG_PASSWORD = 1 << 0
+CLIENT_FOUND_ROWS = 1 << 1
+CLIENT_LONG_FLAG = 1 << 2
+CLIENT_CONNECT_WITH_DB = 1 << 3
+CLIENT_NO_SCHEMA = 1 << 4
+CLIENT_PROTOCOL_41 = 1 << 9
+CLIENT_TRANSACTIONS = 1 << 13
+CLIENT_SECURE_CONNECTION = 1 << 15
+CLIENT_MULTI_STATEMENTS = 1 << 16
+CLIENT_MULTI_RESULTS = 1 << 17
+CLIENT_PLUGIN_AUTH = 1 << 19
+CLIENT_DEPRECATE_EOF = 1 << 24
+
+SERVER_CAPABILITIES = (
+    CLIENT_LONG_PASSWORD | CLIENT_LONG_FLAG | CLIENT_CONNECT_WITH_DB
+    | CLIENT_PROTOCOL_41 | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION
+    | CLIENT_MULTI_STATEMENTS | CLIENT_MULTI_RESULTS | CLIENT_PLUGIN_AUTH
+)
+
+# ---- status flags ----
+SERVER_STATUS_IN_TRANS = 0x0001
+SERVER_STATUS_AUTOCOMMIT = 0x0002
+SERVER_MORE_RESULTS_EXISTS = 0x0008
+
+# ---- commands ----
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_CLOSE = 0x19
+
+CHARSET_UTF8 = 33
+CHARSET_BINARY = 63
+
+AUTH_PLUGIN = b"mysql_native_password"
+
+
+# ---------------------------------------------------------------------------
+# length-encoded primitives
+# ---------------------------------------------------------------------------
+
+def lenenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes((n,))
+    if n < (1 << 16):
+        return b"\xfc" + struct.pack("<H", n)
+    if n < (1 << 24):
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def read_lenenc_int(data: bytes, pos: int) -> tuple[int | None, int]:
+    first = data[pos]
+    if first < 251:
+        return first, pos + 1
+    if first == 0xFB:  # NULL in row data
+        return None, pos + 1
+    if first == 0xFC:
+        return struct.unpack_from("<H", data, pos + 1)[0], pos + 3
+    if first == 0xFD:
+        return int.from_bytes(data[pos + 1:pos + 4], "little"), pos + 4
+    return struct.unpack_from("<Q", data, pos + 1)[0], pos + 9
+
+
+def lenenc_bytes(b: bytes) -> bytes:
+    return lenenc_int(len(b)) + b
+
+
+def read_lenenc_bytes(data: bytes, pos: int) -> tuple[bytes | None, int]:
+    n, pos = read_lenenc_int(data, pos)
+    if n is None:
+        return None, pos
+    return data[pos:pos + n], pos + n
+
+
+# ---------------------------------------------------------------------------
+# auth (mysql_native_password)
+# ---------------------------------------------------------------------------
+
+def new_salt() -> bytes:
+    """20 random bytes, none of them 0 or '$' (conn.go RandomBuf rules)."""
+    out = bytearray()
+    while len(out) < 20:
+        b = os.urandom(1)[0]
+        if b != 0 and b != ord("$"):
+            out.append(b)
+    return bytes(out)
+
+
+def scramble_password(password: str, salt: bytes) -> bytes:
+    """Client-side token: SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw)))."""
+    if not password:
+        return b""
+    stage1 = hashlib.sha1(password.encode()).digest()
+    stage2 = hashlib.sha1(stage1).digest()
+    mix = hashlib.sha1(salt + stage2).digest()
+    return bytes(a ^ b for a, b in zip(stage1, mix))
+
+
+def password_hash(password: str) -> str:
+    """mysql.user storage form: '*' + HEX(SHA1(SHA1(pw))) (CalcPassword)."""
+    if not password:
+        return ""
+    stage2 = hashlib.sha1(hashlib.sha1(password.encode()).digest()).digest()
+    return "*" + stage2.hex().upper()
+
+
+def check_auth(token: bytes, stored_hash: str, salt: bytes) -> bool:
+    """Verify a scramble token against the stored double-SHA1 hash
+    (server/conn.go checkAuth → util.CheckScrambledPassword)."""
+    if not stored_hash:
+        return not token
+    if not token:
+        return False
+    try:
+        stage2 = bytes.fromhex(stored_hash.lstrip("*"))
+    except ValueError:
+        return False
+    mix = hashlib.sha1(salt + stage2).digest()
+    stage1 = bytes(a ^ b for a, b in zip(token, mix))
+    return hashlib.sha1(stage1).digest() == stage2
+
+
+# ---------------------------------------------------------------------------
+# server→client packets
+# ---------------------------------------------------------------------------
+
+def handshake_v10(conn_id: int, salt: bytes) -> bytes:
+    caps = SERVER_CAPABILITIES
+    out = bytes((PROTOCOL_VERSION,))
+    out += SERVER_VERSION + b"\x00"
+    out += struct.pack("<I", conn_id)
+    out += salt[:8] + b"\x00"
+    out += struct.pack("<H", caps & 0xFFFF)
+    out += bytes((CHARSET_UTF8,))
+    out += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+    out += struct.pack("<H", (caps >> 16) & 0xFFFF)
+    out += bytes((len(salt) + 1,))
+    out += b"\x00" * 10
+    out += salt[8:] + b"\x00"
+    out += AUTH_PLUGIN + b"\x00"
+    return out
+
+
+def ok_packet(affected: int = 0, insert_id: int = 0,
+              status: int = SERVER_STATUS_AUTOCOMMIT,
+              warnings: int = 0) -> bytes:
+    return (b"\x00" + lenenc_int(affected) + lenenc_int(insert_id)
+            + struct.pack("<HH", status, warnings))
+
+
+def eof_packet(status: int = SERVER_STATUS_AUTOCOMMIT,
+               warnings: int = 0) -> bytes:
+    return b"\xfe" + struct.pack("<HH", warnings, status)
+
+
+def err_packet(code: int, message: str, state: str = "HY000") -> bytes:
+    return (b"\xff" + struct.pack("<H", code) + b"#" + state.encode()[:5]
+            + message.encode())
+
+
+def column_def(name: str, tp: int, flag: int = 0, flen: int = -1,
+               decimal: int = -1, db: str = "", table: str = "") -> bytes:
+    """Column Definition 41 (server/column.go Dump equivalent)."""
+    charset = CHARSET_UTF8 if tp in my.STRING_TYPES else CHARSET_BINARY
+    if flen < 0:
+        flen = my.default_field_length(tp)
+        if flen < 0:
+            flen = 255
+    if decimal < 0:
+        decimal = 0x1F  # "not specified"
+    out = lenenc_bytes(b"def")
+    out += lenenc_bytes(db.encode())
+    out += lenenc_bytes(table.encode())
+    out += lenenc_bytes(table.encode())   # org_table
+    out += lenenc_bytes(name.encode())
+    out += lenenc_bytes(name.encode())    # org_name
+    out += bytes((0x0C,))                 # fixed-length fields length
+    out += struct.pack("<H", charset)
+    out += struct.pack("<I", flen & 0xFFFFFFFF)
+    out += bytes((tp,))
+    out += struct.pack("<H", flag & 0xFFFF)
+    out += bytes((decimal & 0xFF,))
+    out += b"\x00\x00"
+    return out
+
+
+def text_row(values: list[bytes | None]) -> bytes:
+    out = b""
+    for v in values:
+        out += b"\xfb" if v is None else lenenc_bytes(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# value → protocol text
+# ---------------------------------------------------------------------------
+
+def datum_to_text(d) -> bytes | None:
+    """Render one result Datum the way the MySQL textual protocol expects
+    (server/driver_tidb.go dumpTextValue)."""
+    if d.is_null():
+        return None
+    from tidb_tpu.expression.ops import _datum_to_str
+    s = _datum_to_str(d)
+    return s.encode() if isinstance(s, str) else bytes(s)

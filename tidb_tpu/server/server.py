@@ -1,0 +1,114 @@
+"""TCP server: listener, connection registry, token limit.
+
+Reference: server/server.go:65 (Server struct, Run loop :130, connection
+limit via tokenlimiter.go, status info :213). Threads stand in for
+goroutines: one accept loop plus one thread per connection, bounded by a
+semaphore token exactly like the reference's token limiter.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+
+from tidb_tpu.server.conn import ClientConnection
+from tidb_tpu.session import Session
+
+
+class Server:
+    def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
+                 token_limit: int = 100):
+        self.store = store
+        self.host = host
+        self.port = port
+        self.running = False
+        self._conn_ids = itertools.count(1)
+        self._conns: set[ClientConnection] = set()
+        self._conns_lock = threading.Lock()
+        self._tokens = threading.BoundedSemaphore(token_limit)
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        # one internal session for auth lookups (session.go ExecRestrictedSQL)
+        self._auth_session = Session(store)
+        self._auth_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Bind and serve in a background thread; self.port is the bound
+        port (useful with port=0 in tests)."""
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+        self.running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="tidb-accept", daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while self.running:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            if not self._tokens.acquire(blocking=False):
+                sock.close()  # over the connection limit (tokenlimiter.go)
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = ClientConnection(self, sock, next(self._conn_ids))
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=conn.run, daemon=True,
+                             name=f"tidb-conn-{conn.conn_id}").start()
+
+    def deregister(self, conn: ClientConnection) -> None:
+        with self._conns_lock:
+            if conn in self._conns:
+                self._conns.discard(conn)
+                self._tokens.release()
+
+    def close(self) -> None:
+        self.running = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.alive = False
+            c.pkt.close()
+
+    # ------------------------------------------------------------------
+    # auth + status
+    # ------------------------------------------------------------------
+
+    def password_hash_for(self, user: str) -> str | None:
+        """Stored mysql_native_password hash from mysql.user, or None when
+        the user doesn't exist (conn.go:272 auth path)."""
+        esc = user.replace("\\", "\\\\").replace("'", "\\'")
+        with self._auth_lock:
+            rs = self._auth_session.execute(
+                f"select Password, User from mysql.user where User = '{esc}'")
+        rows = rs[0].values() if rs else []
+        # belt-and-braces: the row must name exactly this user
+        rows = [r for r in rows
+                if (r[1].decode() if isinstance(r[1], bytes)
+                    else str(r[1])) == user]
+        if not rows:
+            return None
+        v = rows[0][0]
+        if v is None:
+            return ""
+        return v.decode() if isinstance(v, bytes) else str(v)
+
+    def status(self) -> dict:
+        with self._conns_lock:
+            n = len(self._conns)
+        return {"connections": n, "version": "tidb-tpu"}

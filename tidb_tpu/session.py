@@ -186,13 +186,20 @@ class Session:
 
     def execute(self, sql: str) -> list[ResultSet]:
         """Reference: session.Execute (session.go:429)."""
-        stmts = self.parser.parse(sql)
-        results: list[ResultSet] = []
-        for stmt in stmts:
-            rs = self._execute_one(stmt, stmt.text or sql)
-            if rs is not None:
-                results.append(rs)
-        return results
+        return [rs for rs in self.execute_each(sql) if rs is not None]
+
+    def execute_each(self, sql: str) -> list[ResultSet | None]:
+        """Like execute, but one entry per statement (None for effect-only
+        statements) — the wire server needs per-statement results to frame
+        one OK/resultset per statement of a multi-statement COM_QUERY."""
+        return [self.execute_stmt(stmt, stmt.text or sql)
+                for stmt in self.parser.parse(sql)]
+
+    def execute_stmt(self, stmt, sql_text: str) -> ResultSet | None:
+        """Execute one already-parsed statement; vars.affected_rows /
+        last_insert_id reflect it afterwards (the wire server reads them
+        to build the statement's OK packet)."""
+        return self._execute_one(stmt, sql_text)
 
     def _execute_one(self, stmt, sql_text: str,
                      record_history: bool = True) -> ResultSet | None:

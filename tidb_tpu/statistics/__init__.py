@@ -213,18 +213,31 @@ def pseudo_table(table_id: int) -> TableStats:
     return TableStats(table_id, PSEUDO_ROW_COUNT, {}, pseudo=True)
 
 
-def analyze_table(table, retriever) -> TableStats:
-    """Full-scan ANALYZE: one histogram per public column
-    (executor/executor_simple.go:253-310; full scan instead of reservoir
-    sampling — the TPU tier's columnar cache makes scans cheap)."""
+DEFAULT_SAMPLE_SIZE = 100_000
+
+
+def analyze_table(table, retriever,
+                  max_samples: int = DEFAULT_SAMPLE_SIZE) -> TableStats:
+    """ANALYZE: one histogram per public column, reservoir-sampled at
+    max_samples rows so memory stays bounded on huge tables
+    (executor/executor_simple.go:253-310; the reference reservoir is 10k —
+    a larger default trades a still-small footprint for better buckets)."""
+    import random
     info = table.info
     cols = info.public_columns()
-    samples: dict[int, list[Datum]] = {c.id: [] for c in cols}
+    rng = random.Random(table.id)  # deterministic per table for stable plans
+    sample_rows: list[list[Datum]] = []
     count = 0
     for _handle, row in table.iter_records(retriever):
+        if count < max_samples:
+            sample_rows.append(row)
+        else:
+            j = rng.randint(0, count)
+            if j < max_samples:
+                sample_rows[j] = row
         count += 1
-        for c, v in zip(cols, row):
-            samples[c.id].append(v)
-    columns = {cid: build_column_stats(cid, vals)
-               for cid, vals in samples.items()}
+    # histograms stay in sample units: every TableStats estimator already
+    # normalizes by the histogram total and rescales by self.count
+    columns = {c.id: build_column_stats(c.id, [r[i] for r in sample_rows])
+               for i, c in enumerate(cols)}
     return TableStats(table.id, count, columns)
