@@ -104,6 +104,15 @@ class TestQuery:
         assert rs[2].rows is None and rs[2].affected == 1
         c.close()
 
+    def test_empty_query_gets_err_packet(self, srv):
+        c = connect(srv)
+        for q in ("", ";", "-- just a comment"):
+            with pytest.raises(MySQLError) as ei:
+                c.query(q)
+            assert ei.value.code == 1065
+        assert c.query("select 1")[0].rows == [["1"]]
+        c.close()
+
     def test_hostile_usernames_rejected_cleanly(self, srv):
         for user in ("evil\\", "ro'ot", "a' or '1'='1"):
             with pytest.raises(MySQLError) as ei:

@@ -203,6 +203,28 @@ def test_mesh_parity(stores, mesh_store, sql):
     assert client.stats["tpu_requests"] > 0
 
 
+MESH_NUMERIC_GROUP_QUERIES = [
+    "select a, count(*), sum(c) from t group by a order by a",
+    "select d, count(*), sum(a) from t group by d order by d",
+    "select c, count(*) from t group by c order by c",
+    "select a, b, count(*), min(c) from t group by a, b order by a, b",
+]
+
+
+@pytest.mark.parametrize("sql", MESH_NUMERIC_GROUP_QUERIES)
+def test_mesh_numeric_group_keys(stores, mesh_store, sql):
+    """int/float/date group keys must be mesh-combinable (host-built global
+    dictionary codes → radix group ids → psum over ICI), NOT silent CPU
+    fallbacks (round-2 weak #1)."""
+    cpu, _ = stores
+    client = mesh_store.store.get_client()
+    before = client.stats["cpu_fallbacks"]
+    cpu_rows = cpu.execute(sql)[0].values()
+    mesh_rows = mesh_store.execute(sql)[0].values()
+    assert _norm(cpu_rows) == _norm(mesh_rows), sql
+    assert client.stats["cpu_fallbacks"] == before, sql
+
+
 def test_set_copr_backend_sysvar():
     """SET tidb_copr_backend='tpu' must install/route to the TPU engine;
     'cpu' restores the default engine (round-1 weak #3: the var was dead)."""

@@ -203,10 +203,11 @@ class TpuClient(kv.Client):
                     raise Unsupported("ranked group-by is single-chip")
                 return self._run_ranked(sel, batch, where, specs, gspec,
                                         planes, live)
+            planes = self._with_group_planes(batch, gspec, planes)
             fn, wrapper, jitted = self._kernel(
                 sel, batch, "grouped",
                 lambda: kernels.build_grouped_agg_fn(where, specs,
-                                                     gspec.cids,
+                                                     gspec.plane_keys,
                                                      gspec.sizes))
             if self.mesh is not None:
                 outs = [np.asarray(o)
@@ -215,8 +216,8 @@ class TpuClient(kv.Client):
                 i_arr, f_arr = jitted(planes, live)
                 outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
                                               np.asarray(f_arr))
-            return self._emit_grouped(sel, batch, specs, gspec.cids,
-                                      gspec.sizes, fn.radices, outs)
+            return self._emit_grouped(sel, batch, specs, gspec,
+                                      fn.radices, outs)
         fn, wrapper, jitted = self._kernel(
             sel, batch, "scalar",
             lambda: kernels.build_scalar_agg_fn(where, specs, batch.n_rows))
@@ -239,13 +240,41 @@ class TpuClient(kv.Client):
         writer.append_row(0, row)
         return SelectResponse(chunks=writer.finish())
 
-    def _emit_grouped(self, sel, batch, specs, gcids, gsizes, radices,
+    def _with_group_planes(self, batch, gspec, planes):
+        """Add host-built numeric group-code planes (device-cached on the
+        batch; the valid plane is the column's own)."""
+        extra = [k for k in gspec.plane_keys if kernels.is_group_code_key(k)]
+        if not extra:
+            return planes
+        import jax.numpy as jnp
+        dev = getattr(batch, "_device_gcodes", None)
+        if dev is None:
+            dev = batch._device_gcodes = {}
+        planes = dict(planes)
+        for key in extra:
+            cid = kernels.group_code_cid(key)
+            arr = dev.get(cid)
+            if arr is None:
+                codes, _uniq = batch.group_codes(cid)
+                arr = dev[cid] = jnp.asarray(codes)
+            planes[key] = (arr, planes[cid][1])
+        return planes
+
+    def _group_datum(self, cid: int, decoder, code: int) -> Datum:
+        kind, data = decoder
+        if kind == "str":
+            return Datum.bytes_(data[code])
+        v = data[code]
+        if isinstance(v, np.floating):
+            return Datum.f64(float(v))
+        return self._i64_datum(cid, int(v))
+
+    def _emit_grouped(self, sel, batch, specs, gspec, radices,
                       outs) -> SelectResponse:
         writer = ChunkWriter()
         row_count = outs[0]
         n_segments = row_count.shape[0]
         live_gids = [g for g in range(n_segments - 1) if row_count[g] > 0]
-        dicts = [batch.columns[cid].dictionary for cid in gcids]
         for gid in live_gids:
             # decode mixed-radix gid → per-column codes
             codes = []
@@ -255,9 +284,10 @@ class TpuClient(kv.Client):
                 rem //= radix
             codes.reverse()
             gvals = []
-            for code, size, d in zip(codes, gsizes, dicts):
+            for code, size, cid, dec in zip(codes, gspec.sizes, gspec.cids,
+                                            gspec.decoders):
                 gvals.append(NULL if code >= size
-                             else Datum.bytes_(d[code]))
+                             else self._group_datum(cid, dec, code))
             gk = codec.encode_value(gvals)
             row: list[Datum] = [Datum.bytes_(gk)]
             i = 1  # outs[0] is row_count

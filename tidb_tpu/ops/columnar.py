@@ -74,6 +74,38 @@ class ColumnBatch:
         m[: self.n_rows] = True
         return m
 
+    def group_codes(self, cid: int) -> tuple[np.ndarray, np.ndarray]:
+        """Host-built GLOBAL dictionary codes for a numeric/time group
+        column: (codes plane int64[capacity], sorted unique values).
+
+        Packing happens on the host before rows are sharded, so these codes
+        are identical on every chip — which is what makes radix group ids
+        psum-combinable across the mesh for ANY column kind, matching the
+        kind-agnostic group keys of the reference
+        (store/localstore/local_aggregate.go:28 getGroupKey). K_STR columns
+        don't need this: their values plane already is the code plane."""
+        cache = getattr(self, "_group_codes", None)
+        if cache is None:
+            cache = self._group_codes = {}
+        ent = cache.get(cid)
+        if ent is not None:
+            return ent
+        cd = self.columns[cid]
+        live = self.row_mask() & cd.valid
+        vals = cd.values
+        if cd.kind == K_F64:
+            # -0.0 groups with +0.0 (SQL equality)
+            vals = np.where(vals == 0.0, 0.0, vals)
+        uniq = np.unique(vals[live])
+        codes = np.searchsorted(uniq, vals).astype(np.int64)
+        if len(uniq):
+            np.minimum(codes, len(uniq) - 1, out=codes)  # pad rows in-range
+        else:
+            codes[:] = 0
+        ent = (codes, uniq)
+        cache[cid] = ent
+        return ent
+
 
 def bucket_capacity(n: int, minimum: int = 1024) -> int:
     c = minimum

@@ -136,18 +136,46 @@ def lower_aggregates(req: SelectRequest, batch: col.ColumnBatch) -> list[AggSpec
     return specs
 
 
+# radix group-by segment ceiling: beyond this the segment arrays get large
+# enough that the sort-based rank path (or CPU) wins
+RADIX_MAX_SEGMENTS = 1 << 20
+
+# planes-dict keys for host-built group-code planes. Plane keys must share
+# one orderable type (jax sorts pytree dict keys), so pseudo planes use
+# negative ints: POS_CID is -1, group codes for column c live at -1000 - c.
+GC_BASE = -1000
+
+
+def group_code_key(cid: int) -> int:
+    return GC_BASE - cid
+
+
+def is_group_code_key(key: int) -> bool:
+    return key <= GC_BASE
+
+
+def group_code_cid(key: int) -> int:
+    return GC_BASE - key
+
+
 class GroupSpec:
-    """Lowered group-by: either a mixed-radix code over dictionary columns
-    ('radix': globally consistent group ids, mesh-combinable) or a sort +
-    rank assignment over arbitrary columns ('rank': exact for any column
-    kind / cardinality, single-chip only — ids are batch-local ranks)."""
+    """Lowered group-by: either a mixed-radix code over GLOBAL dictionary
+    codes ('radix': group ids consistent across chips → mesh-combinable;
+    any column kind, K_STR codes come from the pack dictionary and numeric/
+    time codes from ColumnBatch.group_codes) or a sort + rank assignment
+    ('rank': any cardinality, single-chip only — ids are batch-local)."""
 
     def __init__(self, kind: str, cids: list[int], sizes: list[int],
-                 col_kinds: list[str]):
+                 col_kinds: list[str], plane_keys=None, decoders=None):
         self.kind = kind          # "radix" | "rank"
         self.cids = cids
         self.sizes = sizes        # radix only: dict sizes
         self.col_kinds = col_kinds
+        # radix only: planes-dict key per group column (the cid itself for
+        # K_STR, group_code_key(cid) for host-built numeric/time planes)
+        self.plane_keys = plane_keys or []
+        # radix only: per-column ("str", dictionary) | ("num", uniq array)
+        self.decoders = decoders or []
 
 
 def lower_group_by(req: SelectRequest, batch: col.ColumnBatch) -> GroupSpec:
@@ -161,9 +189,22 @@ def lower_group_by(req: SelectRequest, batch: col.ColumnBatch) -> GroupSpec:
             raise Unsupported("group-by column not packed")
         cids.append(e.val)
         kinds.append(cd.kind)
-    if all(k == col.K_STR for k in kinds):
-        sizes = [max(len(batch.columns[c].dictionary), 1) for c in cids]
-        return GroupSpec("radix", cids, sizes, kinds)
+    sizes, plane_keys, decoders = [], [], []
+    num_segments = 1
+    for cid, kind in zip(cids, kinds):
+        cd = batch.columns[cid]
+        if kind == col.K_STR:
+            sizes.append(max(len(cd.dictionary), 1))
+            plane_keys.append(cid)
+            decoders.append(("str", cd.dictionary))
+        else:
+            _codes, uniq = batch.group_codes(cid)
+            sizes.append(max(len(uniq), 1))
+            plane_keys.append(group_code_key(cid))
+            decoders.append(("num", uniq))
+        num_segments *= sizes[-1] + 1
+    if num_segments + 1 <= RADIX_MAX_SEGMENTS:
+        return GroupSpec("radix", cids, sizes, kinds, plane_keys, decoders)
     return GroupSpec("rank", cids, [], kinds)
 
 
@@ -276,14 +317,16 @@ def _distinct_count(v, contrib):
 # ---------------------------------------------------------------------------
 
 def build_grouped_agg_fn(where: CompiledExpr | None, specs: list[AggSpec],
-                         group_cids: list[int], dict_sizes: list[int]):
+                         group_keys: list, dict_sizes: list[int]):
     """fn(planes, live) → (group_counts, per-spec arrays…), each sized
     num_segments = prod(dict sizes) + 1; the LAST segment is the dead-row
     sink (padding + filtered rows) and is dropped by the caller.
 
-    Group id = mixed-radix over the group columns' dict codes. NULL group
-    values use a reserved code slot per column (size+1 radix) so NULLs form
-    their own group, matching MySQL GROUP BY NULL semantics."""
+    Group id = mixed-radix over the group columns' GLOBAL dict codes
+    (group_keys index into planes: a cid for K_STR, group_code_key(cid)
+    for host-built numeric codes). NULL group values use a reserved code slot
+    per column (size+1 radix) so NULLs form their own group, matching MySQL
+    GROUP BY NULL semantics."""
     radices = [s + 1 for s in dict_sizes]   # +1 slot for NULL per column
     num_segments = 1
     for r in radices:
@@ -296,8 +339,8 @@ def build_grouped_agg_fn(where: CompiledExpr | None, specs: list[AggSpec],
             wv, wva = where(planes)
             mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
         gid = None
-        for cid, radix, size in zip(group_cids, radices, dict_sizes):
-            codes, cva = planes[cid]
+        for key, radix, size in zip(group_keys, radices, dict_sizes):
+            codes, cva = planes[key]
             c = jnp.where(cva, codes, size).astype(jnp.int64)  # NULL → size
             gid = c if gid is None else gid * radix + c
         gid = jnp.where(mask, gid, num_segments - 1)  # dead rows → sink

@@ -38,7 +38,11 @@ class Client:
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.pkt = PacketIO(sock)
-        self._handshake(user, password, db)
+        try:
+            self._handshake(user, password, db)
+        except BaseException:
+            self.pkt.close()  # don't leak the fd on auth/db rejection
+            raise
 
     # ---- handshake ----
 

@@ -10,7 +10,6 @@ the library semantics.
 from __future__ import annotations
 
 import struct
-import threading
 
 from tidb_tpu import errors, mysqldef as my
 from tidb_tpu.server import protocol as p
@@ -156,6 +155,20 @@ class ClientConnection:
         MORE_RESULTS flag (conn.go:571 handleQuery; multi-statement needs
         per-statement framing so drivers attribute results correctly)."""
         stmts = self.session.parser.parse(sql)
+        if not stmts:
+            # MySQL: ER_EMPTY_QUERY — a packet must go back or the
+            # client hangs waiting for one
+            self.pkt.write_packet(p.err_packet(1065, "Query was empty",
+                                               "42000"))
+            return
+        if len(stmts) > 1 and not (self.capability
+                                   & p.CLIENT_MULTI_STATEMENTS):
+            # clients opt out of multi-statement as an injection
+            # mitigation; honor it like MySQL does
+            self.pkt.write_packet(p.err_packet(
+                my.ErrParse, "multi-statement disabled "
+                "(CLIENT_MULTI_STATEMENTS not set)", "42000"))
+            return
         for i, stmt in enumerate(stmts):
             rs = self.session.execute_stmt(stmt, stmt.text or sql)
             more = i + 1 < len(stmts)
