@@ -101,7 +101,68 @@ QUERIES = [
     # topn at scale
     "select id from t order by c desc limit 50",
     "select id from t order by a limit 25",
+    # multi-key topn (lexicographic; NULL ordering differs per direction)
+    "select id from t order by e desc, c limit 40",
+    "select id from t order by b, a desc, id limit 30",
+    # per-group distinct (sort-within-segment boundary counting)
+    "select e, count(distinct a) from t group by e order by e",
+    "select e, count(distinct b), sum(distinct a) from t "
+    "group by e order by e",
+    "select b, count(distinct e) from t group by b order by b",
+    # distinct over the whole request
+    "select sum(distinct e), avg(distinct e) from t",
 ]
+
+
+INDEX_QUERIES = [
+    # covering single-read, double-read, ranges, desc
+    "select a from t where a = 1500",
+    "select id, a from t where a > 2900 order by id",
+    "select count(*) from t where a between 100 and 200",
+    "select b from t where a = 777 order by id",
+]
+
+
+def test_index_with_pk_as_explicit_column():
+    """An index whose columns include the integer pk: PBIndexInfo carries
+    that column id twice (indexed datum + pk_handle) and the pack must not
+    double-append its plane (regression: broadcast ValueError)."""
+    store = new_store("memory://fuzz_pkidx")
+    store.set_client(TpuClient(store))
+    s = Session(store)
+    s.execute("create database d; use d")
+    s.execute("create table t (id bigint primary key, a int)")
+    rows = ", ".join(f"({i}, {i % 5})" for i in range(100))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create index idx_ai on t (a, id)")
+    client = store.get_client()
+    before = client.stats["tpu_requests"]
+    got = s.execute("select id, a from t where a = 3 order by id")[0].values()
+    assert got == [[i, 3] for i in range(3, 100, 5)]
+    assert client.stats["tpu_requests"] > before
+
+
+@pytest.fixture(scope="module")
+def indexed_sessions(sessions):
+    cpu, tpu = sessions
+    cpu.execute("create index idx_a on t (a)")
+    tpu.execute("create index idx_a on t (a)")
+    cpu.execute("create index idx_ai on t (a, id)")
+    tpu.execute("create index idx_ai on t (a, id)")
+    return cpu, tpu
+
+
+@pytest.mark.parametrize("sql", INDEX_QUERIES)
+def test_fuzz_index_parity(indexed_sessions, sql):
+    """REQ_TYPE_INDEX lowered to index-plane batches (round-2 missing #8):
+    same results as the CPU engine, served from the TPU tier."""
+    cpu, tpu = indexed_sessions
+    client = tpu.store.get_client()
+    before = client.stats["tpu_requests"]
+    cpu_rows = _norm(cpu.execute(sql)[0].values())
+    tpu_rows = _norm(tpu.execute(sql)[0].values())
+    assert cpu_rows == tpu_rows, sql
+    assert client.stats["tpu_requests"] > before, sql
 
 
 def _norm(rows):

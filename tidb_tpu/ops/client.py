@@ -77,17 +77,20 @@ class TpuClient(kv.Client):
     # ------------------------------------------------------------------
 
     def support_request_type(self, req_type: int, sub_type) -> bool:
-        if req_type == kv.REQ_TYPE_INDEX:
-            return self.cpu.support_request_type(req_type, sub_type)
-        if req_type != kv.REQ_TYPE_SELECT:
+        if req_type not in (kv.REQ_TYPE_SELECT, kv.REQ_TYPE_INDEX):
             return False
         if isinstance(sub_type, Expr):
             from tidb_tpu.copr.proto import AGG_TYPES
             if sub_type.tp in AGG_TYPES:
                 name = AGG_NAME[sub_type.tp]
                 if sub_type.distinct:
-                    # global (request-wide) aggregation makes distinct exact
-                    return name == "count"
+                    # the TPU batch is request-global, so distinct is exact
+                    # — EXCEPT across a mesh, where per-chip distinct
+                    # partials cannot be merged; keep those SQL-side
+                    # (min/max are distinct-insensitive)
+                    if self.mesh is not None:
+                        return name in ("min", "max")
+                    return name in ("count", "sum", "avg", "min", "max")
                 return name in ("count", "sum", "avg", "min", "max",
                                 "first_row")
             return self.cpu.support_request_type(req_type, sub_type)
@@ -98,7 +101,11 @@ class TpuClient(kv.Client):
 
     def send(self, req: kv.Request) -> kv.Response:
         sel: SelectRequest = req.data
-        if req.tp != kv.REQ_TYPE_SELECT or sel.table_info is None:
+        routable = ((req.tp == kv.REQ_TYPE_SELECT
+                     and sel.table_info is not None)
+                    or (req.tp == kv.REQ_TYPE_INDEX
+                        and sel.index_info is not None))
+        if not routable:
             self.stats["cpu_fallbacks"] += 1
             return self.cpu.send(req)
         try:
@@ -107,15 +114,28 @@ class TpuClient(kv.Client):
             return _SingleResponse(resp)
         except Unsupported:
             self.stats["cpu_fallbacks"] += 1
+            if any(e.distinct for e in sel.aggregates):
+                # per-region partials under-merge distinct aggregates; the
+                # CPU fallback must run the whole request as ONE region
+                # (the TPU probe admitted distinct on the promise of
+                # global execution)
+                return self._cpu_global(req, sel)
             return self.cpu.send(req)
+
+    def _cpu_global(self, req: kv.Request, sel) -> kv.Response:
+        from tidb_tpu.copr.region_handler import handle_request
+        snapshot = self.store.get_snapshot(sel.start_ts)
+        return _SingleResponse(handle_request(snapshot, sel, req.key_ranges))
 
     # ------------------------------------------------------------------
 
     _uid_gen = __import__("itertools").count(1)
 
     def _get_batch(self, sel: SelectRequest, ranges) -> col.ColumnBatch:
-        cols = sel.table_info.columns
-        base_key = (sel.table_info.table_id,
+        is_index = sel.table_info is None
+        src = sel.index_info if is_index else sel.table_info
+        cols = src.columns
+        base_key = (("idx", src.index_id) if is_index else src.table_id,
                     tuple(c.column_id for c in cols),
                     tuple((r.start, r.end) for r in ranges))
         version = self.store.data_version_at(sel.start_ts)
@@ -132,8 +152,9 @@ class TpuClient(kv.Client):
         # before and after packing; a churning version means other readers
         # at the same key could see a different row set — don't cache
         for _ in range(3):
-            batch = col.pack_ranges(snapshot, sel.table_info.table_id, cols,
-                                    ranges, defaults)
+            batch = (col.pack_index_ranges(snapshot, src, ranges) if is_index
+                     else col.pack_ranges(snapshot, src.table_id, cols,
+                                          ranges, defaults))
             after = self.store.data_version_at(sel.start_ts)
             if after == version:
                 break
@@ -155,7 +176,9 @@ class TpuClient(kv.Client):
         batch = self._get_batch(sel, req.key_ranges)
         # per-request decode tables for datum reconstruction
         self._cur_batch = batch
-        self._col_pb = {c.column_id: c for c in sel.table_info.columns}
+        src = sel.table_info if sel.table_info is not None else sel.index_info
+        self._cur_cols = src.columns
+        self._col_pb = {c.column_id: c for c in src.columns}
         self._dict_for = {cid: cd.dictionary
                           for cid, cd in batch.columns.items()
                           if cd.kind == col.K_STR}
@@ -461,14 +484,19 @@ class TpuClient(kv.Client):
         return self._emit_rows(sel, batch, idx)
 
     def _run_topn(self, sel, batch, where) -> SelectResponse:
-        import jax
-        if len(sel.order_by) != 1 or sel.limit is None:
-            raise Unsupported("topn lowering needs 1 key + limit")
-        key = compile_expr(sel.order_by[0].expr, batch)
+        if not sel.order_by or sel.limit is None:
+            raise Unsupported("topn lowering needs keys + limit")
         k = min(sel.limit, batch.capacity)
-        _, wrapper, jitted = self._kernel(
-            sel, batch, "topn",
-            lambda: kernels.build_topn_fn(where, key, sel.order_by[0].desc, k))
+        if len(sel.order_by) == 1:
+            key = compile_expr(sel.order_by[0].expr, batch)
+            build = lambda: kernels.build_topn_fn(  # noqa: E731
+                where, key, sel.order_by[0].desc, k)
+        else:
+            keys = [(compile_expr(item.expr, batch), item.desc)
+                    for item in sel.order_by]
+            build = lambda: kernels.build_topn_fn_multi(  # noqa: E731
+                where, keys, k)
+        _, wrapper, jitted = self._kernel(sel, batch, "topn", build)
         planes = kernels.batch_planes(batch)
         live = np.zeros(batch.capacity, dtype=bool)
         live[: batch.n_rows] = True
@@ -480,7 +508,7 @@ class TpuClient(kv.Client):
 
     def _emit_rows(self, sel, batch, idx) -> SelectResponse:
         writer = ChunkWriter()
-        cols = sel.table_info.columns
+        cols = self._cur_cols
         planes = {cid: cd for cid, cd in batch.columns.items()}
         for i in idx:
             row = []

@@ -214,6 +214,70 @@ def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
     return ColumnBatch(n, cap, h, cols)
 
 
+def pack_index_ranges(snapshot, index_info, ranges) -> ColumnBatch:
+    """Scan+decode index-key ranges into a ColumnBatch (REQ_TYPE_INDEX).
+
+    Index keys carry the indexed column datums inline
+    (tablecodec.cut_index_key); the handle comes from the key suffix, or
+    from the value for unique indexes. Columns with pk_handle take the
+    handle itself. Rows pack in key order, which IS index order — the
+    keep-order contract of index scans survives because emit walks row
+    positions. Reference: store/localstore/local_region.go:684
+    getRowsFromIndexReq."""
+    columns = index_info.columns
+    col_kinds = {c.column_id: column_phys_kind(c) for c in columns}
+    pk_col = next((c for c in columns if c.pk_handle), None)
+    n_idx_vals = len(columns) - 1 if pk_col is not None else len(columns)
+
+    handles: list[int] = []
+    raw: dict[int, list] = {c.column_id: [] for c in columns}
+    valid: dict[int, list] = {c.column_id: [] for c in columns}
+
+    for rg in ranges:
+        for key, value in snapshot.iterate(rg.start, rg.end):
+            try:
+                values, suffix = tc.cut_index_key(key, n_idx_vals)
+            except errors.TiDBError:
+                continue
+            if suffix:
+                handle = tc.decode_handle_from_index_suffix(suffix)
+            else:  # unique index: handle lives in the value
+                handle = int(value)
+            handles.append(handle)
+            for c, d in zip(columns, values):
+                if pk_col is not None and c.column_id == pk_col.column_id:
+                    continue  # handle (below) is authoritative — the pk
+                    # may ALSO be an explicit index column, and a double
+                    # append would corrupt the plane
+                v, ok = datum_to_phys(d, col_kinds[c.column_id])
+                raw[c.column_id].append(v)
+                valid[c.column_id].append(ok)
+            if pk_col is not None:
+                raw[pk_col.column_id].append(handle)
+                valid[pk_col.column_id].append(True)
+
+    n = len(handles)
+    cap = bucket_capacity(n)
+    h = np.full(cap, I64_MIN, dtype=np.int64)
+    h[:n] = handles
+    cols: dict[int, ColumnData] = {}
+    for cid, c in {c.column_id: c for c in columns}.items():
+        kind = col_kinds[cid]
+        va = np.zeros(cap, dtype=bool)
+        va[:n] = valid[cid]
+        if kind == K_STR:
+            cols[cid] = _pack_str_column(raw[cid], va, cap, n)
+            cols[cid].tp = c.tp
+        else:
+            dtype = np.int64 if kind == K_I64 else np.float64
+            vals = np.zeros(cap, dtype=dtype)
+            if n:
+                vals[:n] = [x if ok else 0
+                            for x, ok in zip(raw[cid], valid[cid])]
+            cols[cid] = ColumnData(kind, vals, va, tp=c.tp)
+    return ColumnBatch(n, cap, h, cols)
+
+
 def _pack_str_column(raw: list, va: np.ndarray, cap: int, n: int) -> ColumnData:
     uniq = sorted({v for v, ok in zip(raw, va[:n]) if ok})
     code_of = {b: i for i, b in enumerate(uniq)}

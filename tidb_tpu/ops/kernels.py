@@ -123,9 +123,8 @@ def lower_aggregates(req: SelectRequest, batch: col.ColumnBatch) -> list[AggSpec
         name = AGG_NAME[e.tp]
         if name not in ("count", "sum", "avg", "min", "max", "first_row"):
             raise Unsupported(f"aggregate {name} not lowered yet")
-        if e.distinct and (name != "count" or req.group_by):
-            # distinct is exact only request-wide (no per-group dedup yet)
-            raise Unsupported("distinct only lowered for global count")
+        if e.distinct and name == "first_row":
+            raise Unsupported("distinct first_row")
         if name == "first_row":
             # exact first-row semantics need a host-side gather by row
             # position, which needs the argument to be a plain column
@@ -250,9 +249,11 @@ def _combiners(specs: list[AggSpec], leading: list[str] | None = None):
     out = list(leading or [])
     for spec in specs:
         if spec.name == "count":
+            # distinct needs a GLOBAL dedup — per-chip distinct counts
+            # cannot be summed (the same value may appear on many chips)
             out.append(None if spec.distinct else "sum")
         elif spec.name in ("sum", "avg"):
-            out.extend(["sum", "sum"])
+            out.extend([None, None] if spec.distinct else ["sum", "sum"])
         elif spec.name in ("min", "first_row"):
             out.extend(["sum", "min"])
         elif spec.name == "max":
@@ -272,8 +273,10 @@ def _scalar_agg(spec: AggSpec, planes, mask):
     n = jnp.sum(contrib.astype(jnp.int64))
     if name == "count":
         if spec.distinct:
-            return (_distinct_count(v, contrib),)
+            return (_distinct_reduce(v, contrib)[0],)
         return (n,)
+    if name in ("sum", "avg") and spec.distinct:
+        return _distinct_reduce(v, contrib)
     if name == "sum":
         vv = jnp.where(contrib, v, jnp.zeros_like(v))
         return (n, jnp.sum(vv))
@@ -299,17 +302,34 @@ def _scalar_agg(spec: AggSpec, planes, mask):
     raise Unsupported(name)
 
 
-def _distinct_count(v, contrib):
-    """Exact distinct count: sort with invalids pushed to the end, count
-    boundaries. Static-shaped — no unique()."""
-    big = jnp.iinfo(jnp.int64).max if v.dtype != jnp.float64 \
-        else jnp.finfo(jnp.float64).max
-    key = jnp.where(contrib, v, jnp.full_like(v, big))
-    s = jnp.sort(key)
-    total = jnp.sum(contrib.astype(jnp.int64))
-    firsts = jnp.concatenate([jnp.ones(1, dtype=bool), s[1:] != s[:-1]])
-    live_sorted = jnp.arange(s.shape[0]) < total
-    return jnp.sum((firsts & live_sorted).astype(jnp.int64))
+def _distinct_reduce(v, contrib):
+    """Exact request-global (distinct count, distinct sum): the
+    num_segments=1 case of the grouped kernel — one shared implementation
+    so boundary/NULL handling can never diverge between paths."""
+    gid = jnp.zeros(contrib.shape, jnp.int64)
+    cnt, sm = _grouped_distinct(v, contrib, gid, 1)
+    return cnt[0], sm[0]
+
+
+def _grouped_distinct(v, contrib, gid, num_segments):
+    """Per-group exact (distinct count, distinct sum) via sort-within-
+    segment boundary counting: rows lexsorted by (group id, contributing
+    first, value); a contributing row opens a distinct run when the group
+    or the value changes (local_aggregate.go:199 per-func distinct sets —
+    here one sort amortizes every group)."""
+    if jnp.ndim(v) == 0:
+        v = jnp.broadcast_to(v, contrib.shape)
+    key = _orderable_i64(v)
+    order = jnp.lexsort([key, (~contrib).astype(jnp.int32), gid])
+    gs, ks, cs, vs = gid[order], key[order], contrib[order], v[order]
+    prev_g = jnp.concatenate([jnp.full(1, -1, gs.dtype), gs[:-1]])
+    prev_k = jnp.concatenate([ks[:1], ks[:-1]])
+    firsts = cs & ((gs != prev_g) | (ks != prev_k))
+    cnt = jax.ops.segment_sum(firsts.astype(jnp.int64), gs,
+                              num_segments=num_segments)
+    sm = jax.ops.segment_sum(jnp.where(firsts, vs, jnp.zeros_like(vs)), gs,
+                             num_segments=num_segments)
+    return cnt, sm
 
 
 # ---------------------------------------------------------------------------
@@ -370,7 +390,11 @@ def _grouped_agg(spec: AggSpec, planes, mask, gid, num_segments):
     n = jax.ops.segment_sum(contrib.astype(jnp.int64), gid,
                             num_segments=num_segments)
     if name == "count":
+        if spec.distinct:
+            return (_grouped_distinct(v, contrib, gid, num_segments)[0],)
         return (n,)
+    if name in ("sum", "avg") and spec.distinct:
+        return _grouped_distinct(v, contrib, gid, num_segments)
     if name in ("sum", "avg"):
         vv = jnp.where(contrib, v, jnp.zeros_like(v))
         s = jax.ops.segment_sum(vv, gid, num_segments=num_segments)
@@ -514,6 +538,39 @@ def build_topn_fn(where: CompiledExpr | None, key_expr: CompiledExpr,
         score = jnp.where(mask, score, -jnp.inf)
         _, idx = jax.lax.top_k(score, k)
         # how many of the top-k are live
+        n_live = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), k)
+        return idx, n_live
+    return fn
+
+
+def build_topn_fn_multi(where: CompiledExpr | None,
+                        keys: list[tuple[CompiledExpr, bool]], k: int):
+    """Top-k row indices over LEXICOGRAPHIC multi-key order (the CPU
+    engine's topnHeap with arbitrary by-items, local_region.go:97). One
+    full lexsort instead of a heap — XLA sorts beat data-dependent heap
+    control flow on TPU. Ties break by row position (stable sort), which
+    matches the heap's insertion-order tiebreak."""
+
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+        sort_keys = []   # built least-significant first for lexsort
+        for expr, desc in reversed(keys):
+            v, va = expr(planes)
+            vo = _orderable_i64(v)
+            if desc:
+                vo = -vo.astype(jnp.float64) if vo.dtype == jnp.float64 \
+                    else -vo
+            # NULL ordering: asc → first (null key 0 < 1), desc → last
+            nullk = va.astype(jnp.int32) if not desc \
+                else (~va).astype(jnp.int32)
+            sort_keys.append(jnp.where(va, vo, jnp.zeros_like(vo)))
+            sort_keys.append(nullk)
+        sort_keys.append((~mask).astype(jnp.int32))  # dead rows last
+        order = jnp.lexsort(sort_keys)
+        idx = order[:k]
         n_live = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), k)
         return idx, n_live
     return fn
