@@ -189,6 +189,15 @@ class TestWritePath:
         rows = run(ctx, "select id, a, b from t")
         assert rows == [[9, 7, None]]
 
+    def test_insert_select(self, ctx):
+        """INSERT ... SELECT: the select subplan must be physicalized
+        (regression: executor got the logical projection)."""
+        seed(ctx)
+        run(ctx, "insert into t (id, a, b) "
+                 "select id + 100, a * 2, b from t where a <= 20")
+        assert run(ctx, "select id, a from t where id > 100 order by id") \
+            == [[101, 20], [102, 40]]
+
     def test_insert_missing_not_null_errors(self, ctx):
         from tidb_tpu import errors
         with pytest.raises(errors.ExecError):

@@ -64,6 +64,12 @@ def execute_simple(session, stmt) -> ResultSet | None:
         return _admin(session, stmt)
     if isinstance(stmt, ast.AnalyzeTableStmt):
         return _analyze(session, stmt)
+    if isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
+        return _grant_revoke(session, stmt)
+    if isinstance(stmt, ast.CreateUserStmt):
+        return _create_user(session, stmt)
+    if isinstance(stmt, ast.DropUserStmt):
+        return _drop_user(session, stmt)
     raise errors.ExecError(f"unsupported statement {type(stmt).__name__}")
 
 
@@ -402,4 +408,143 @@ def _analyze(session, stmt: ast.AnalyzeTableStmt) -> None:
 
         run_in_new_txn(session.store, True, write)
         session.domain.invalidate_stats(tbl.id)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# GRANT / REVOKE / CREATE USER / DROP USER (executor/grant.go)
+# ---------------------------------------------------------------------------
+
+from tidb_tpu.utils import escape_string as _esc  # noqa: E402
+
+
+def _internal(session):
+    """Fresh unauthenticated session on the same store: grant-table edits
+    bypass the privilege check the CALLING statement already passed
+    (session.go ExecRestrictedSQL)."""
+    from tidb_tpu.session import Session
+    return Session(session.store)
+
+
+def _user_exists(internal, user: str) -> bool:
+    rs = internal.execute(
+        f"select count(1) from mysql.user where User = '{_esc(user)}'")
+    return rs[0].values()[0][0] > 0
+
+
+def _ensure_user(internal, spec, must_exist_ok: bool = True) -> None:
+    from tidb_tpu.server.protocol import password_hash
+    pw = password_hash(spec.password) if spec.password else ""
+    if _user_exists(internal, spec.user):
+        if spec.password is not None:
+            internal.execute(
+                f"update mysql.user set Password = '{pw}' "
+                f"where User = '{_esc(spec.user)}'")
+        return
+    internal.execute(
+        "insert into mysql.user (Host, User, Password) values "
+        f"('{_esc(spec.host)}', '{_esc(spec.user)}', '{pw}')")
+
+
+def _grant_revoke(session, stmt) -> None:
+    """Level routing per executor/grant.go: *.* → mysql.user columns,
+    db.* → mysql.db row, db.table → mysql.tables_priv row."""
+    from tidb_tpu import privilege as pv
+    session.commit_txn()  # implicit commit like DDL
+    internal = _internal(session)
+    granting = isinstance(stmt, ast.GrantStmt)
+    if stmt.table and not (stmt.db or session.vars.current_db):
+        # a bare table name with no db selected must NOT silently widen
+        # into a global grant (MySQL: ER_NO_DB_ERROR)
+        raise errors.BadDBError("No database selected")
+    db = (stmt.db or session.vars.current_db).lower() \
+        if (stmt.db or stmt.table) else ""
+    table = stmt.table.lower()
+
+    for spec in stmt.users:
+        if granting:
+            _ensure_user(internal, spec)
+        elif not _user_exists(internal, spec.user):
+            raise errors.ExecError(
+                f"user '{spec.user}' does not exist")
+        u = _esc(spec.user)
+        if not db:  # global: mysql.user columns
+            privs = pv.USER_PRIVS if stmt.privs == ["ALL"] else stmt.privs
+            sets = ", ".join(f"{p}_priv = '{'Y' if granting else 'N'}'"
+                             for p in privs)
+            internal.execute(
+                f"update mysql.user set {sets} where User = '{u}'")
+        elif not table:  # db level: mysql.db row
+            privs = pv.DB_PRIVS if stmt.privs == ["ALL"] else stmt.privs
+            n = internal.execute(
+                "select count(1) from mysql.db where User = "
+                f"'{u}' and DB = '{_esc(db)}'")[0].values()[0][0]
+            if n == 0 and granting:
+                internal.execute(
+                    "insert into mysql.db (Host, DB, User) values "
+                    f"('{_esc(spec.host)}', '{_esc(db)}', '{u}')")
+            if n > 0 or granting:
+                sets = ", ".join(f"{p}_priv = '{'Y' if granting else 'N'}'"
+                                 for p in privs)
+                internal.execute(
+                    f"update mysql.db set {sets} where User = '{u}' "
+                    f"and DB = '{_esc(db)}'")
+        else:  # table level: mysql.tables_priv Table_priv set
+            privs = pv.TABLE_PRIVS if stmt.privs == ["ALL"] else stmt.privs
+            rs = internal.execute(
+                "select Table_priv from mysql.tables_priv where User = "
+                f"'{u}' and DB = '{_esc(db)}' and Table_name = "
+                f"'{_esc(table)}'")[0].values()
+            have: set[str] = set()
+            exists = bool(rs)
+            if rs and rs[0][0]:
+                raw = rs[0][0]
+                raw = raw.decode() if isinstance(raw, bytes) else str(raw)
+                have = {p for p in raw.split(",") if p}
+            have = (have | set(privs)) if granting else (have - set(privs))
+            tp = ",".join(sorted(have))
+            if exists:
+                internal.execute(
+                    f"update mysql.tables_priv set Table_priv = '{tp}' "
+                    f"where User = '{u}' and DB = '{_esc(db)}' "
+                    f"and Table_name = '{_esc(table)}'")
+            elif granting:
+                internal.execute(
+                    "insert into mysql.tables_priv (Host, DB, User, "
+                    "Table_name, Table_priv) values "
+                    f"('{_esc(spec.host)}', '{_esc(db)}', '{u}', "
+                    f"'{_esc(table)}', '{tp}')")
+    pv.invalidate(session.store)
+    return None
+
+
+def _create_user(session, stmt: ast.CreateUserStmt) -> None:
+    from tidb_tpu import privilege as pv
+    session.commit_txn()
+    internal = _internal(session)
+    for spec in stmt.users:
+        if _user_exists(internal, spec.user):
+            if not stmt.if_not_exists:
+                raise errors.ExecError(f"user '{spec.user}' already exists")
+            continue
+        _ensure_user(internal, spec)
+    pv.invalidate(session.store)
+    return None
+
+
+def _drop_user(session, stmt: ast.DropUserStmt) -> None:
+    from tidb_tpu import privilege as pv
+    session.commit_txn()
+    internal = _internal(session)
+    for spec in stmt.users:
+        if not _user_exists(internal, spec.user):
+            if not stmt.if_exists:
+                raise errors.ExecError(f"user '{spec.user}' does not exist")
+            continue
+        u = _esc(spec.user)
+        internal.execute(f"delete from mysql.user where User = '{u}'")
+        internal.execute(f"delete from mysql.db where User = '{u}'")
+        internal.execute(
+            f"delete from mysql.tables_priv where User = '{u}'")
+    pv.invalidate(session.store)
     return None

@@ -152,6 +152,8 @@ class Parser:
             "DESC": self._parse_explain,
             "ADMIN": self._parse_admin,
             "ANALYZE": self._parse_analyze,
+            "GRANT": self._parse_grant,
+            "REVOKE": self._parse_revoke,
             "PREPARE": self._parse_prepare,
             "EXECUTE": self._parse_execute,
             "DEALLOCATE": self._parse_deallocate,
@@ -498,6 +500,11 @@ class Parser:
 
     def _parse_create(self) -> ast.StmtNode:
         self._expect_kw("CREATE")
+        if self._at(lx.IDENT) and self._cur().val.lower() == "user":
+            self._next()
+            ine = self._parse_if_not_exists()
+            return ast.CreateUserStmt(users=self._parse_user_specs(),
+                                      if_not_exists=ine)
         if self._try_kw("DATABASE", "SCHEMA"):
             ine = self._parse_if_not_exists()
             return ast.CreateDatabaseStmt(name=self._ident(), if_not_exists=ine)
@@ -665,6 +672,11 @@ class Parser:
 
     def _parse_drop(self) -> ast.StmtNode:
         self._expect_kw("DROP")
+        if self._at(lx.IDENT) and self._cur().val.lower() == "user":
+            self._next()
+            ie = self._parse_if_exists()
+            return ast.DropUserStmt(users=self._parse_user_specs(),
+                                    if_exists=ie)
         if self._try_kw("DATABASE", "SCHEMA"):
             ie = self._parse_if_exists()
             return ast.DropDatabaseStmt(name=self._ident(), if_exists=ie)
@@ -862,6 +874,88 @@ class Parser:
         while self._try_op(","):
             tables.append(self._parse_table_name())
         return ast.AnalyzeTableStmt(tables=tables)
+
+    # ================= GRANT / REVOKE (parser.y GrantStmt) =================
+
+    # GRANT keyword → mysql.user/db/tables_priv column stem
+    _PRIV_NAMES = {
+        "SELECT": "Select", "INSERT": "Insert", "UPDATE": "Update",
+        "DELETE": "Delete", "CREATE": "Create", "DROP": "Drop",
+        "GRANT": "Grant", "ALTER": "Alter", "INDEX": "Index",
+        "EXECUTE": "Execute",
+    }
+
+    def _parse_priv_list(self) -> list[str]:
+        if self._try_kw("ALL"):
+            self._try_kw("PRIVILEGES")
+            return ["ALL"]
+        privs = []
+        while True:
+            kw = self._expect_kw(*self._PRIV_NAMES.keys())
+            if kw == "GRANT":
+                self._ident("option")  # GRANT OPTION as a listed priv
+            privs.append(self._PRIV_NAMES[kw])
+            if not self._try_op(","):
+                return privs
+
+    def _parse_priv_level(self) -> tuple[str, str]:
+        """*.* | db.* | db.table | table → (db, table); '' = wildcard."""
+        if self._try_op("*"):
+            if self._try_op("."):
+                self._expect_op("*")
+            return "", ""
+        name = self._ident_or_string()
+        if self._try_op("."):
+            if self._try_op("*"):
+                return name, ""
+            return name, self._ident_or_string()
+        return "", name  # bare table name: current db
+
+    def _parse_user_specs(self) -> list[ast.UserSpec]:
+        users = []
+        while True:
+            user = self._ident_or_string()
+            host = "%"
+            # 'u'@'h': the lexer eats @ as an (empty or named) user-var
+            if self._at(lx.USER_VAR):
+                t = self._next()
+                host = t.val if t.val else self._ident_or_string()
+            spec = ast.UserSpec(user=user, host=host)
+            if self._try_kw("IDENTIFIED"):
+                self._expect_kw("BY")
+                spec.password = self._string_lit("password")
+            users.append(spec)
+            if not self._try_op(","):
+                return users
+
+    def _string_lit(self, what: str) -> str:
+        if self._at(lx.STRING):
+            return self._next().val  # type: ignore[return-value]
+        self._fail(f"expected {what} string")
+
+    def _parse_grant(self) -> ast.GrantStmt:
+        self._expect_kw("GRANT")
+        privs = self._parse_priv_list()
+        self._expect_kw("ON")
+        db, table = self._parse_priv_level()
+        self._expect_kw("TO")
+        users = self._parse_user_specs()
+        opt = False
+        if self._try_kw("WITH"):
+            self._expect_kw("GRANT")
+            self._ident("option")
+            opt = True
+        return ast.GrantStmt(privs=privs, db=db, table=table, users=users,
+                             grant_option=opt)
+
+    def _parse_revoke(self) -> ast.RevokeStmt:
+        self._expect_kw("REVOKE")
+        privs = self._parse_priv_list()
+        self._expect_kw("ON")
+        db, table = self._parse_priv_level()
+        self._expect_kw("FROM")
+        users = self._parse_user_specs()
+        return ast.RevokeStmt(privs=privs, db=db, table=table, users=users)
 
     # ================= expressions (Pratt) =================
     # binding powers, low → high (MySQL precedence)

@@ -149,6 +149,10 @@ def to_physical(p: Plan, ctx: PhysicalContext) -> Plan:
         return m
     if isinstance(p, (Insert, Update, Delete)):
         p.children = [to_physical(c, ctx) for c in p.children]
+        if isinstance(p, Insert) and p.select_plan is not None:
+            # the executor reads select_plan, which aliased children[0]
+            # before conversion — keep them the same plan
+            p.select_plan = p.children[0]
         return p
     if isinstance(p, ExplainPlan):
         p.target = to_physical(p.target, ctx)

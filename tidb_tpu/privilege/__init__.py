@@ -1,0 +1,213 @@
+"""Privilege system: cache of the mysql.{user,db,tables_priv} matrices and
+the per-statement check the session runs before executing.
+
+Reference: privilege/privilege.go:29 (Checker interface),
+privileges/privileges.go (userPrivileges cache over the grant tables),
+checked at execution sites. Here the check runs once per statement in
+Session._execute_one against the required (privilege, db, table) set
+derived from the AST — sessions without an authenticated user (library
+embedding, internal SQL) skip it, exactly like the reference's nil-checker
+contexts.
+
+Deliberate simplification vs MySQL: identities are keyed by USER only.
+Hosts are parsed and stored (wire compatibility) but never matched —
+'u'@'a' and 'u'@'b' are one identity. Single-tenant deployments behind the
+wire server don't need host-scoped grants; revisit if they ever do.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tidb_tpu import errors, mysqldef as my, sqlast as ast
+
+# privileges that exist at each scope (column stems; '<P>_priv' columns in
+# mysql.user / mysql.db, names inside tables_priv.Table_priv)
+USER_PRIVS = ("Select", "Insert", "Update", "Delete", "Create", "Drop",
+              "Grant", "Alter", "Index", "Execute")
+DB_PRIVS = ("Select", "Insert", "Update", "Delete", "Create", "Drop",
+            "Grant", "Index", "Alter", "Execute")
+TABLE_PRIVS = ("Select", "Insert", "Update", "Delete", "Create", "Drop",
+               "Grant", "Index", "Alter")
+
+
+class AccessDenied(errors.TiDBError):
+    code = my.ErrAccessDenied
+
+
+def _s(v) -> str:
+    if v is None:
+        return ""
+    return v.decode() if isinstance(v, bytes) else str(v)
+
+
+class Checker:
+    """Lazy cache of one user's grants, rebuilt when version changes."""
+
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._loaded_version = -1
+        self.version = 0    # bumped per-store by GRANT/REVOKE executors
+        self._global: dict[str, set[str]] = {}
+        self._db: dict[tuple[str, str], set[str]] = {}
+        self._table: dict[tuple[str, str, str], set[str]] = {}
+
+    def _load(self) -> None:
+        from tidb_tpu.session import Session
+        s = Session(self.store)  # internal: no user → no recursion
+        self._global.clear()
+        self._db.clear()
+        self._table.clear()
+        rs = s.execute("select * from mysql.user")[0]
+        names = rs.field_names()
+        for row in rs.values():
+            rec = dict(zip(names, row))
+            user = _s(rec.get("User"))
+            privs = {p for p in USER_PRIVS
+                     if _s(rec.get(f"{p}_priv")).upper() == "Y"}
+            self._global[user] = privs
+        rs = s.execute("select * from mysql.db")[0]
+        names = rs.field_names()
+        for row in rs.values():
+            rec = dict(zip(names, row))
+            key = (_s(rec.get("User")), _s(rec.get("DB")).lower())
+            privs = {p for p in DB_PRIVS
+                     if _s(rec.get(f"{p}_priv")).upper() == "Y"}
+            self._db[key] = privs
+        rs = s.execute("select * from mysql.tables_priv")[0]
+        names = rs.field_names()
+        for row in rs.values():
+            rec = dict(zip(names, row))
+            key = (_s(rec.get("User")), _s(rec.get("DB")).lower(),
+                   _s(rec.get("Table_name")).lower())
+            privs = {p.strip().capitalize()
+                     for p in _s(rec.get("Table_priv")).split(",") if p}
+            self._table[key] = privs
+
+    def check(self, user: str, db: str, table: str, priv: str) -> bool:
+        """Global OR db OR table scope grant (privileges.go Check)."""
+        with self._lock:
+            if self._loaded_version != self.version:
+                self._load()
+                self._loaded_version = self.version
+            g = self._global.get(user)
+            if g is None:
+                return False  # unknown user holds nothing
+            if priv in g:
+                return True
+            if db:
+                if priv in self._db.get((user, db.lower()), ()):
+                    return True
+                if table and priv in self._table.get(
+                        (user, db.lower(), table.lower()), ()):
+                    return True
+            return False
+
+
+_checkers: dict[str, Checker] = {}
+_checkers_lock = threading.Lock()
+
+
+def checker_for(store) -> Checker:
+    with _checkers_lock:
+        c = _checkers.get(store.uuid())
+        if c is None:
+            if len(_checkers) > 32:   # bound the per-store cache (tests
+                # churn many short-lived memory:// stores)
+                _checkers.pop(next(iter(_checkers)))
+            c = _checkers[store.uuid()] = Checker(store)
+        return c
+
+
+def invalidate(store) -> None:
+    """Per-store: a GRANT on one store must not force reloads on others."""
+    checker_for(store).version += 1
+
+
+# ---------------------------------------------------------------------------
+# statement → required privileges
+# ---------------------------------------------------------------------------
+
+def _walk_tables(node, out: list) -> None:
+    """Generic dataclass walk collecting every TableName (from-clauses,
+    derived tables, subqueries — anywhere one can appear)."""
+    if isinstance(node, ast.TableName):
+        out.append(node)
+        return
+    if isinstance(node, ast.Node):
+        for f in node.__dataclass_fields__:
+            _walk_tables(getattr(node, f), out)
+    elif isinstance(node, (list, tuple)):
+        for item in node:
+            _walk_tables(item, out)
+
+
+def required_privs(stmt, current_db: str) -> list[tuple[str, str, str]]:
+    """(priv, db, table) triples a user must hold to run stmt."""
+    out: list[tuple[str, str, str]] = []
+
+    def add(priv, tn: ast.TableName):
+        out.append((priv, (tn.db or current_db).lower(), tn.name.lower()))
+
+    def reads_except(targets, priv_for_target):
+        tabs: list[ast.TableName] = []
+        _walk_tables(stmt, tabs)
+        target_ids = {id(t) for t in targets}
+        for tn in tabs:
+            if id(tn) in target_ids:
+                add(priv_for_target, tn)
+            else:
+                add("Select", tn)
+
+    if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
+        tabs: list[ast.TableName] = []
+        _walk_tables(stmt, tabs)
+        for tn in tabs:
+            add("Select", tn)
+    elif isinstance(stmt, ast.InsertStmt):
+        reads_except([stmt.table], "Insert")
+    elif isinstance(stmt, ast.UpdateStmt):
+        reads_except([stmt.table], "Update")
+    elif isinstance(stmt, ast.DeleteStmt):
+        reads_except([stmt.table], "Delete")
+    elif isinstance(stmt, ast.CreateTableStmt):
+        add("Create", stmt.table)
+    elif isinstance(stmt, ast.DropTableStmt):
+        for tn in stmt.tables:
+            add("Drop", tn)
+    elif isinstance(stmt, ast.TruncateTableStmt):
+        add("Drop", stmt.table)
+    elif isinstance(stmt, (ast.CreateIndexStmt, ast.DropIndexStmt)):
+        add("Index", stmt.table)
+    elif isinstance(stmt, ast.AlterTableStmt):
+        add("Alter", stmt.table)
+    elif isinstance(stmt, ast.CreateDatabaseStmt):
+        out.append(("Create", stmt.name.lower(), ""))
+    elif isinstance(stmt, ast.DropDatabaseStmt):
+        out.append(("Drop", stmt.name.lower(), ""))
+    elif isinstance(stmt, ast.AnalyzeTableStmt):
+        for tn in stmt.tables:
+            add("Select", tn)
+    elif isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt,
+                           ast.CreateUserStmt, ast.DropUserStmt)):
+        out.append(("Grant", "", ""))
+    # SHOW / SET / USE / txn control / EXPLAIN target checked via its stmt
+    elif isinstance(stmt, ast.ExplainStmt) and stmt.stmt is not None:
+        return required_privs(stmt.stmt, current_db)
+    return out
+
+
+def check_stmt(session, stmt) -> None:
+    """Raise AccessDenied unless session's user holds every required
+    privilege. No-op for sessions without an authenticated user."""
+    user = session.vars.user
+    if not user:
+        return
+    checker = checker_for(session.store)
+    for priv, db, table in required_privs(stmt, session.vars.current_db):
+        if not checker.check(user, db, table, priv):
+            where = f"table '{db}.{table}'" if table else \
+                (f"database '{db}'" if db else "this operation")
+            raise AccessDenied(
+                f"{priv} command denied to user '{user}' for {where}")

@@ -92,7 +92,8 @@ class Server:
     def password_hash_for(self, user: str) -> str | None:
         """Stored mysql_native_password hash from mysql.user, or None when
         the user doesn't exist (conn.go:272 auth path)."""
-        esc = user.replace("\\", "\\\\").replace("'", "\\'")
+        from tidb_tpu.utils import escape_string
+        esc = escape_string(user)
         with self._auth_lock:
             rs = self._auth_session.execute(
                 f"select Password, User from mysql.user where User = '{esc}'")

@@ -204,6 +204,12 @@ class Session:
     def _execute_one(self, stmt, sql_text: str,
                      record_history: bool = True) -> ResultSet | None:
         self.vars.affected_rows = 0
+        if self.vars.user:
+            # authenticated sessions (wire connections) pass the privilege
+            # check; library/internal sessions have no user and skip it
+            # (privilege/privilege.go Checker bound per-session)
+            from tidb_tpu import privilege
+            privilege.check_stmt(self, stmt)
         if _is_simple(stmt):
             return execute_simple(self, stmt)
 
@@ -334,6 +340,11 @@ class Session:
                 self.vars.last_plan_from_cache = True
             else:
                 self.vars.last_plan_from_cache = False
+            if self.vars.user:
+                # EXECUTE runs the PREPAREd statement — check THAT, not
+                # the ExecuteStmt shell (else prepare is a privilege hole)
+                from tidb_tpu import privilege
+                privilege.check_stmt(self, ent.stmt)
             if phys is None:
                 phys = optimize_plan(PlanBuilder(self).build(ent.stmt),
                                      self, self.client, self.dirty_tables)
@@ -353,6 +364,15 @@ class Session:
             raise errors.ExecError(
                 "tidb_copr_backend cannot be NULL/empty; "
                 "use 'cpu' or 'tpu' (swaps the engine store-wide)")
+        if self.vars.user:
+            # the knob swaps the engine for EVERY session on this store —
+            # a store-global action needs the global Grant privilege
+            from tidb_tpu import privilege
+            if not privilege.checker_for(self.store).check(
+                    self.vars.user, "", "", "Grant"):
+                raise privilege.AccessDenied(
+                    f"user '{self.vars.user}' needs the global GRANT "
+                    "privilege to set tidb_copr_backend")
         if backend == "tpu":
             from tidb_tpu.ops import TpuClient
             if not isinstance(self.store.get_client(), TpuClient):
@@ -408,7 +428,8 @@ def _is_simple(stmt) -> bool:
         ast.RollbackStmt, ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
         ast.CreateTableStmt, ast.DropTableStmt, ast.TruncateTableStmt,
         ast.CreateIndexStmt, ast.DropIndexStmt, ast.AlterTableStmt,
-        ast.AdminStmt, ast.AnalyzeTableStmt))
+        ast.AdminStmt, ast.AnalyzeTableStmt, ast.GrantStmt, ast.RevokeStmt,
+        ast.CreateUserStmt, ast.DropUserStmt))
 
 
 # ---------------------------------------------------------------------------

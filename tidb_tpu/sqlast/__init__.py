@@ -29,4 +29,5 @@ from tidb_tpu.sqlast.misc import (  # noqa: F401
     BeginStmt, CommitStmt, RollbackStmt, UseStmt, SetStmt, VariableAssignment,
     ShowStmt, ShowType, ExplainStmt, AdminStmt, AdminType,
     AnalyzeTableStmt, PrepareStmt, ExecuteStmt, DeallocateStmt,
+    UserSpec, GrantStmt, RevokeStmt, CreateUserStmt, DropUserStmt,
 )

@@ -82,6 +82,45 @@ class AdminStmt(StmtNode):
 
 
 @dataclass
+class UserSpec(Node):
+    """'user'@'host' [IDENTIFIED BY 'password'] (ast/misc.go UserSpec)."""
+    user: str = ""
+    host: str = "%"
+    password: str | None = None
+
+
+@dataclass
+class GrantStmt(StmtNode):
+    """GRANT privs ON level TO users (ast/misc.go GrantStmt). Level:
+    db=''/table='' → *.* ; table='' → db.* ; else db.table."""
+    privs: list[str] = field(default_factory=list)  # names or "ALL"
+    db: str = ""
+    table: str = ""
+    users: list[UserSpec] = field(default_factory=list)
+    grant_option: bool = False
+
+
+@dataclass
+class RevokeStmt(StmtNode):
+    privs: list[str] = field(default_factory=list)
+    db: str = ""
+    table: str = ""
+    users: list[UserSpec] = field(default_factory=list)
+
+
+@dataclass
+class CreateUserStmt(StmtNode):
+    users: list[UserSpec] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserStmt(StmtNode):
+    users: list[UserSpec] = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
 class AnalyzeTableStmt(StmtNode):
     """ANALYZE TABLE t1 [, t2] — builds column histograms
     (ast/stats.go AnalyzeTableStmt; executor/executor_simple.go:253)."""

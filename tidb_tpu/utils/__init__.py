@@ -14,3 +14,10 @@ def prefix_next(prefix: bytes) -> bytes:
             del b[i + 1:]
             return bytes(b)
     return bytes(prefix) + b"\xff"  # degenerate: unbounded tail sentinel
+
+
+def escape_string(s: str) -> str:
+    """Escape a value for embedding in a single-quoted SQL literal — ONE
+    implementation shared by the auth lookup and the grant executors so
+    the two paths can never diverge."""
+    return s.replace("\\", "\\\\").replace("'", "\\'")
