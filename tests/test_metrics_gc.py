@@ -1,0 +1,100 @@
+"""Metrics registry + scheduled GC tests.
+
+Mirrors: metrics.go phase histograms / distsql/metrics.go (via SHOW
+STATUS), store/localstore/compactor.go (scheduled compaction), and
+store/tikv/gc_worker.go leader election (lease-guarded cluster GC).
+"""
+
+import pytest
+
+from tidb_tpu import metrics
+from tidb_tpu.gcworker import Compactor, GCWorker
+from tidb_tpu.session import Session, new_store
+from tests.testkit import TestKit, _store_id
+
+
+class TestMetrics:
+    def test_counter_histogram(self):
+        r = metrics.Registry()
+        r.counter("x").inc()
+        r.counter("x").inc(2)
+        h = r.histogram("lat")
+        h.observe(0.002)
+        h.observe(0.2)
+        snap = dict(r.snapshot())
+        assert snap["x"] == "3"
+        assert snap["lat_count"] == "2"
+        assert abs(float(snap["lat_sum"]) - 0.202) < 1e-9
+
+    def test_show_status_exposes_phases(self):
+        tk = TestKit()
+        tk.exec("create database d; use d; create table t (a int)")
+        tk.exec("insert into t values (1)")
+        tk.exec("select * from t")
+        snap = {r[0]: r[1] for r in tk.exec("show status").rows}
+        assert int(snap[b"session.compile_seconds_count".decode()]) > 0
+        assert int(snap["session.run_seconds_count"]) > 0
+        assert "session.statements.SelectStmt" in snap
+        like = tk.exec("show status like 'distsql%'").rows
+        assert all(r[0].startswith("distsql") for r in like)
+
+    def test_tpu_fallback_counters(self):
+        from tidb_tpu.ops import TpuClient
+        store = new_store(f"memory://mgc{next(_store_id)}")
+        store.set_client(TpuClient(store))
+        s = Session(store)
+        before = metrics.counter("copr.tpu.requests").value
+        s.execute("create database d; use d; create table t "
+                  "(a int primary key)")
+        s.execute("insert into t values (1), (2)")
+        s.execute("select sum(a) from t")
+        assert metrics.counter("copr.tpu.requests").value > before
+
+
+class TestScheduledGC:
+    def test_compactor_reclaims_old_versions(self):
+        store = new_store(f"memory://mgc{next(_store_id)}")
+        s = Session(store)
+        s.execute("create database d; use d; create table t "
+                  "(a int primary key, b int)")
+        s.execute("insert into t values (1, 0)")
+        for i in range(5):
+            s.execute(f"update t set b = {i + 1}")
+        c = Compactor(store, safe_age_ms=0)  # safepoint = now
+        removed = c.tick()
+        assert removed > 0
+        # data still correct at the current snapshot
+        assert s.execute("select b from t")[0].values() == [[5]]
+        # idle tick (no new writes) is a no-op
+        assert c.tick() == 0
+
+    def test_domain_starts_a_worker(self):
+        tk = TestKit()
+        dom = tk.session.domain
+        assert dom.gc_worker is not None
+        assert dom.gc_worker._thread.is_alive()
+
+    def test_cluster_gc_lease_single_leader(self):
+        store = new_store(f"cluster://3/mgc{next(_store_id)}")
+        s = Session(store)
+        s.execute("create database d; use d; create table t "
+                  "(a int primary key, b int)")
+        s.execute("insert into t values (1, 0)")
+        for i in range(4):
+            s.execute(f"update t set b = {i + 1}")
+        w1 = GCWorker(store, safe_age_ms=0)
+        w2 = GCWorker(store, safe_age_ms=0)
+        assert w1.tick() > 0          # takes the lease, collects
+        assert w2.tick() == 0         # lease held by w1 → skipped
+        assert w1.tick() >= 0         # holder renews fine
+        assert s.execute("select b from t")[0].values() == [[4]]
+
+    def test_lease_expiry_allows_takeover(self):
+        store = new_store(f"cluster://3/mgc{next(_store_id)}")
+        s = Session(store)
+        s.execute("create database d; use d; create table t (a int)")
+        s.execute("insert into t values (1)")
+        w1 = GCWorker(store, safe_age_ms=0, lease_ms=0)  # expires instantly
+        w2 = GCWorker(store, safe_age_ms=0)
+        w1.tick()
+        assert w2._try_lease()  # expired lease is free to take

@@ -132,6 +132,34 @@ class TestGrantLevels:
         with pytest.raises(AccessDenied):
             s.execute("set tidb_copr_backend = 'cpu'")
 
+    def test_bare_star_grant_is_current_db_not_global(self, env):
+        """GRANT ... ON * = current database (MySQL), NOT *.*."""
+        env.exec("create user 'bs1'")
+        env.exec("use app")
+        env.exec("grant select on * to 'bs1'")
+        s = as_user(env, "bs1")
+        s.execute("select * from t")  # app.* granted
+        with pytest.raises(AccessDenied):
+            s.execute("select * from other.s")  # NOT global
+        with pytest.raises(AccessDenied):
+            s.execute("select User from mysql.user")
+
+    def test_show_grants_for_other_user_needs_mysql_select(self, env):
+        env.exec("create user 'sg1'")
+        env.exec("grant select on app.* to 'sg1'")
+        s = as_user(env, "sg1")
+        s.execute("show grants")  # own grants: fine
+        with pytest.raises(AccessDenied):
+            s.execute("show grants for 'root'")
+        env.exec("grant select on mysql.* to 'sg1'")
+        assert s.execute("show grants for 'root'")[0].values()
+
+    def test_illegal_table_scope_priv_rejected(self, env):
+        env.exec("create user 'il1'")
+        with pytest.raises(errors.TiDBError):
+            env.exec("grant execute on app.t to 'il1'")
+        env.exec("grant all on app.t to 'il1'")  # ALL expands per scope
+
     def test_unknown_user_denied(self, env):
         s = as_user(env, "ghost")
         with pytest.raises(AccessDenied):

@@ -8,10 +8,16 @@ from __future__ import annotations
 
 import itertools
 
+import uuid
+
 from tidb_tpu.domain import clear_domains
 from tidb_tpu.session import Session, new_store
 
 _store_id = itertools.count(1)
+# stores are cached process-wide by URL (tidb.go NewStore); this module can
+# be imported both as `testkit` and `tests.testkit` (two counter copies),
+# so URLs carry a per-module-instance token to stay collision-free
+_token = uuid.uuid4().hex[:6]
 
 
 class Result:
@@ -50,7 +56,8 @@ class TestKit:
 
     def __init__(self, store=None):
         clear_domains()
-        self.store = store or new_store(f"memory://tk{next(_store_id)}")
+        self.store = store or new_store(
+            f"memory://tk{_token}_{next(_store_id)}")
         self.session = Session(self.store)
 
     def exec(self, sql: str):

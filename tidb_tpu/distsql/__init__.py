@@ -66,8 +66,19 @@ def select(client: kv.Client, req: SelectRequest,
            concurrency: int = 10, keep_order: bool = False,
            req_type: int = kv.REQ_TYPE_SELECT) -> SelectResult:
     """Reference: distsql.Select (distsql/distsql.go:277)."""
+    import time as _time
+    from tidb_tpu import metrics
     kreq = kv.Request(tp=req_type, data=req, key_ranges=key_ranges,
                       keep_order=keep_order, desc=req.desc,
                       concurrency=concurrency)
-    resp = client.send(kreq)
+    kind = "index" if req_type == kv.REQ_TYPE_INDEX else "select"
+    metrics.counter(f"distsql.queries.{kind}").inc()
+    t0 = _time.perf_counter()
+    try:
+        resp = client.send(kreq)
+    except Exception:
+        metrics.counter("distsql.errors").inc()
+        raise
+    metrics.histogram("distsql.send_seconds").observe(
+        _time.perf_counter() - t0)
     return SelectResult(resp, field_types)

@@ -26,6 +26,22 @@ class Domain:
         self._stats: dict[int, object] = {}
         self._stats_lock = threading.Lock()
         self._stats_version = 0  # bumped on invalidation; keys plan caches
+        # scheduled MVCC GC: the compactor serves embedded localstores,
+        # the lease-guarded worker serves shared cluster stores
+        # (compactor.go / gc_worker.go — see tidb_tpu.gcworker)
+        from tidb_tpu.gcworker import Compactor, GCWorker
+        if hasattr(store, "run_gc"):
+            self.gc_worker = GCWorker(store)
+        elif hasattr(store, "compact"):
+            self.gc_worker = Compactor(store)
+        else:
+            self.gc_worker = None
+        if self.gc_worker is not None:
+            self.gc_worker.start()
+
+    def close(self) -> None:
+        if self.gc_worker is not None:
+            self.gc_worker.stop()
 
     def info_schema(self) -> InfoSchema:
         return self.handle.get()
@@ -85,4 +101,6 @@ def get_domain(store, **kwargs) -> Domain:
 
 def clear_domains() -> None:
     with _domains_lock:
+        for d in _domains.values():
+            d.close()
         _domains.clear()

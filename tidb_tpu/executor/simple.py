@@ -265,6 +265,16 @@ def _like_filter(rows, pattern: str, col: int = 0):
 def _show(session, stmt: ast.ShowStmt) -> ResultSet:
     is_ = session.info_schema()
     tp = stmt.tp
+    if tp == ast.ShowType.STATUS:
+        from tidb_tpu import metrics
+        rows = [[n, v] for n, v in metrics.registry.snapshot()]
+        return _str_rs(["Variable_name", "Value"],
+                       _like_filter(rows, stmt.pattern))
+    if tp == ast.ShowType.GRANTS:
+        from tidb_tpu import privilege as pv
+        user = stmt.pattern or session.vars.user or "root"
+        return _str_rs([f"Grants for {user}"],
+                       [[g] for g in pv.show_grants(session.store, user)])
     if tp == ast.ShowType.DATABASES:
         names = sorted(is_.all_schema_names(), key=str.lower)
         return _str_rs(["Database"], _like_filter([[n] for n in names],
@@ -453,13 +463,29 @@ def _grant_revoke(session, stmt) -> None:
     session.commit_txn()  # implicit commit like DDL
     internal = _internal(session)
     granting = isinstance(stmt, ast.GrantStmt)
-    if stmt.table and not (stmt.db or session.vars.current_db):
-        # a bare table name with no db selected must NOT silently widen
+    if (stmt.table or stmt.db == "*") and \
+            not ((stmt.db and stmt.db != "*") or session.vars.current_db):
+        # bare table / bare * with no db selected must NOT silently widen
         # into a global grant (MySQL: ER_NO_DB_ERROR)
         raise errors.BadDBError("No database selected")
-    db = (stmt.db or session.vars.current_db).lower() \
-        if (stmt.db or stmt.table) else ""
+    if stmt.db == "*":  # ON * = current database scope
+        db = session.vars.current_db.lower()
+    else:
+        db = (stmt.db or session.vars.current_db).lower() \
+            if (stmt.db or stmt.table) else ""
     table = stmt.table.lower()
+    # scope validation (ER_ILLEGAL_GRANT_FOR_TABLE analog): a priv that
+    # doesn't exist at the target scope must error, not be stored
+    from tidb_tpu import privilege as _pv
+    scope = _pv.TABLE_PRIVS if table else (
+        _pv.DB_PRIVS if db else _pv.USER_PRIVS)
+    if stmt.privs != ["ALL"]:
+        bad = [p for p in stmt.privs if p not in scope]
+        if bad:
+            level = f"{db}.{table}" if table else (f"{db}.*" if db
+                                                   else "*.*")
+            raise errors.ExecError(
+                f"privilege(s) {', '.join(bad)} not grantable on {level}")
 
     for spec in stmt.users:
         if granting:

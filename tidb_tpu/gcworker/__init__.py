@@ -1,0 +1,131 @@
+"""Scheduled MVCC garbage collection.
+
+Reference: store/localstore/compactor.go (background compactor, policy
+{SafePoint: 20min, TriggerInterval: 1s}) and store/tikv/gc_worker.go:375
+(one leader-elected GC worker per cluster, 1min tick, safepoint = now −
+10min). Here both run as daemon tick threads owned by the Domain; the
+cluster worker takes a lease on a meta key so that when several Domains
+(servers) share one cluster store, exactly one runs GC per tick —
+the same single-leader discipline as saveValueToSysTable/leader checks in
+the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuidlib
+
+from tidb_tpu import metrics
+from tidb_tpu.structure import TxStructure
+
+GC_LEASE_KEY = b"GCLease"
+
+# safepoint ages (ms): localstore compactor 20min, cluster gc 10min
+LOCAL_SAFE_AGE_MS = 20 * 60 * 1000
+CLUSTER_SAFE_AGE_MS = 10 * 60 * 1000
+
+
+class _TickThread:
+    """Shared scaffolding: daemon thread calling tick() every interval,
+    stoppable, with a synchronous tick for tests."""
+
+    def __init__(self, name: str, interval_s: float):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # GC must never take the server down; next tick retries
+                metrics.counter("gc.tick_errors").inc()
+
+    def tick(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class Compactor(_TickThread):
+    """Periodic localstore MVCC compaction (compactor.go). Skips ticks
+    with no new writes since the last one — the reference triggers off
+    write notifications; the data-version probe is our equivalent."""
+
+    def __init__(self, store, interval_s: float = 1.0,
+                 safe_age_ms: int = LOCAL_SAFE_AGE_MS):
+        super().__init__("tidb-compactor", interval_s)
+        self.store = store
+        self.safe_age_ms = safe_age_ms
+        self._last_version = -1
+
+    def tick(self) -> int:
+        # commit count, NOT the clock TSO (which always advances):
+        # no new commits since the last tick → nothing to reclaim
+        cur = self.store.data_version_at(self.store.current_version())
+        if cur == self._last_version:
+            return 0
+        removed = self.store.compact(max_age_ms=self.safe_age_ms)
+        # only after a SUCCESSFUL compact — a raise must leave the version
+        # probe stale so the next tick retries
+        self._last_version = cur
+        metrics.counter("compactor.runs").inc()
+        if removed:
+            metrics.counter("compactor.versions_removed").inc(removed)
+        return removed
+
+
+class GCWorker(_TickThread):
+    """Cluster GC under a lease: the meta key GCLease holds
+    `uuid:expiry_ms`; a worker runs GC only while it owns (or can take
+    over) the lease (gc_worker.go checkLeader via system table)."""
+
+    def __init__(self, store, interval_s: float = 60.0,
+                 safe_age_ms: int = CLUSTER_SAFE_AGE_MS,
+                 lease_ms: int = 120_000):
+        super().__init__("tidb-gc-worker", interval_s)
+        self.store = store
+        self.safe_age_ms = safe_age_ms
+        self.lease_ms = lease_ms
+        self.uuid = uuidlib.uuid4().hex[:12]
+
+    def _try_lease(self) -> bool:
+        now = int(time.time() * 1000)
+        txn = self.store.begin()
+        try:
+            t = TxStructure(txn, txn, prefix=b"m")
+            raw = t.get(GC_LEASE_KEY)
+            if raw:
+                holder, _, expiry = raw.decode().partition(":")
+                if holder != self.uuid and int(expiry or 0) > now:
+                    txn.rollback()
+                    return False  # someone else holds a live lease
+            t.set(GC_LEASE_KEY,
+                  f"{self.uuid}:{now + self.lease_ms}".encode())
+            txn.commit()
+            return True
+        except Exception:
+            txn.rollback()
+            return False
+
+    def tick(self) -> int:
+        if not self._try_lease():
+            metrics.counter("gc.lease_lost").inc()
+            return 0
+        safe_point = self._safe_point()
+        removed = self.store.run_gc(safe_point)
+        metrics.counter("gc.runs").inc()
+        if removed:
+            metrics.counter("gc.versions_removed").inc(removed)
+        return removed
+
+    def _safe_point(self) -> int:
+        # oracle versions are (ms << 18 | logical): same scheme both stores
+        return (int(time.time() * 1000) - self.safe_age_ms) << 18

@@ -1,0 +1,114 @@
+"""Metrics: process-wide counters and histograms, surfaced via SHOW STATUS.
+
+Reference: the Prometheus instrumentation spread through metrics.go:20-45
+(session phase histograms), distsql/metrics.go (query histogram + error
+counters), executor/metrics.go, server/metrics.go. This registry keeps the
+same shape (counters + bucketed histograms, dot-separated names) without
+the Prometheus client dependency; SHOW STATUS is the pull endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Histogram:
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Counter | Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Counter(name)
+            return m  # type: ignore[return-value]
+
+    def histogram(self, name: str, buckets=_DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(name, buckets)
+            return m  # type: ignore[return-value]
+
+    def snapshot(self) -> list[tuple[str, str]]:
+        """Stable (name, value) rows for SHOW STATUS; histograms expand to
+        _count / _sum / _avg."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out: list[tuple[str, str]] = []
+        for name, m in items:
+            if isinstance(m, Counter):
+                out.append((name, str(m.value)))
+            else:
+                out.append((f"{name}_count", str(m.count)))
+                out.append((f"{name}_sum", f"{m.sum:.6f}"))
+                avg = m.sum / m.count if m.count else 0.0
+                out.append((f"{name}_avg", f"{avg:.6f}"))
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+# the process-wide default registry (metrics.go package-level collectors)
+registry = Registry()
+
+
+def counter(name: str) -> Counter:
+    return registry.counter(name)
+
+
+def histogram(name: str) -> Histogram:
+    return registry.histogram(name)

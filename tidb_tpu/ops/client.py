@@ -105,15 +105,19 @@ class TpuClient(kv.Client):
                      and sel.table_info is not None)
                     or (req.tp == kv.REQ_TYPE_INDEX
                         and sel.index_info is not None))
+        from tidb_tpu import metrics
         if not routable:
             self.stats["cpu_fallbacks"] += 1
+            metrics.counter("copr.tpu.cpu_fallbacks").inc()
             return self.cpu.send(req)
         try:
             resp = self._send_tpu(req, sel)
             self.stats["tpu_requests"] += 1
+            metrics.counter("copr.tpu.requests").inc()
             return _SingleResponse(resp)
         except Unsupported:
             self.stats["cpu_fallbacks"] += 1
+            metrics.counter("copr.tpu.cpu_fallbacks").inc()
             if any(e.distinct for e in sel.aggregates):
                 # per-region partials under-merge distinct aggregates; the
                 # CPU fallback must run the whole request as ONE region

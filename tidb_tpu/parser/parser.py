@@ -795,6 +795,9 @@ class Parser:
             self._expect_kw("FROM", "IN")
             table = self._parse_table_name()
             return ast.ShowStmt(tp=ast.ShowType.COLUMNS, table=table, full=full)
+        # GLOBAL/SESSION qualifier applies to VARIABLES and STATUS (the
+        # registry/sysvar table is process-wide either way)
+        self._try_kw("GLOBAL", "SESSION")
         if self._try_kw("VARIABLES"):
             pattern = ""
             if self._try_kw("LIKE"):
@@ -802,6 +805,22 @@ class Parser:
             return ast.ShowStmt(tp=ast.ShowType.VARIABLES, pattern=pattern)
         if self._try_kw("WARNINGS"):
             return ast.ShowStmt(tp=ast.ShowType.WARNINGS)
+        if self._at(lx.IDENT) and self._cur().val.lower() == "status":
+            self._next()
+            pattern = ""
+            if self._try_kw("LIKE"):
+                pattern = str(self._next().val)
+            return ast.ShowStmt(tp=ast.ShowType.STATUS, pattern=pattern)
+        if self._at(lx.IDENT) and self._cur().val.lower() == "grants":
+            self._next()
+            user = ""
+            if self._try_kw("FOR"):
+                user = self._ident_or_string()
+                if self._at(lx.USER_VAR):  # 'u'@'h' — host ignored
+                    t = self._next()
+                    if not t.val:
+                        self._ident_or_string()
+            return ast.ShowStmt(tp=ast.ShowType.GRANTS, pattern=user)
         if self._try_kw("CREATE"):
             self._expect_kw("TABLE")
             return ast.ShowStmt(tp=ast.ShowType.CREATE_TABLE,
@@ -899,11 +918,14 @@ class Parser:
                 return privs
 
     def _parse_priv_level(self) -> tuple[str, str]:
-        """*.* | db.* | db.table | table → (db, table); '' = wildcard."""
+        """*.* | * | db.* | db.table | table → (db, table); '' = global
+        wildcard, db='*' = MySQL's bare-star current-database scope (the
+        executor resolves it — it must NOT widen to global)."""
         if self._try_op("*"):
             if self._try_op("."):
                 self._expect_op("*")
-            return "", ""
+                return "", ""
+            return "*", ""
         name = self._ident_or_string()
         if self._try_op("."):
             if self._try_op("*"):

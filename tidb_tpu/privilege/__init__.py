@@ -125,6 +125,30 @@ def invalidate(store) -> None:
     checker_for(store).version += 1
 
 
+def show_grants(store, user: str) -> list[str]:
+    """GRANT statements reconstructing a user's privileges
+    (privilege.Checker.ShowGrants)."""
+    c = checker_for(store)
+    c.check(user, "", "", "Select")  # force a (re)load
+    out: list[str] = []
+    with c._lock:
+        g = c._global.get(user)
+        if g is not None:
+            privs = "ALL PRIVILEGES" if set(USER_PRIVS) <= g else \
+                ", ".join(sorted(p.upper() for p in g)) or "USAGE"
+            out.append(f"GRANT {privs} ON *.* TO '{user}'@'%'")
+        for (u, db), privs in sorted(c._db.items()):
+            if u == user and privs:
+                p = "ALL PRIVILEGES" if set(DB_PRIVS) <= privs else \
+                    ", ".join(sorted(x.upper() for x in privs))
+                out.append(f"GRANT {p} ON `{db}`.* TO '{user}'@'%'")
+        for (u, db, tbl), privs in sorted(c._table.items()):
+            if u == user and privs:
+                p = ", ".join(sorted(x.upper() for x in privs))
+                out.append(f"GRANT {p} ON `{db}`.`{tbl}` TO '{user}'@'%'")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # statement → required privileges
 # ---------------------------------------------------------------------------
@@ -205,7 +229,13 @@ def check_stmt(session, stmt) -> None:
     if not user:
         return
     checker = checker_for(session.store)
-    for priv, db, table in required_privs(stmt, session.vars.current_db):
+    reqs = required_privs(stmt, session.vars.current_db)
+    if isinstance(stmt, ast.ShowStmt) and stmt.tp == ast.ShowType.GRANTS \
+            and stmt.pattern and stmt.pattern != user:
+        # viewing ANOTHER account's grants requires read access to the
+        # grant tables (MySQL: SELECT on the mysql schema)
+        reqs = reqs + [("Select", "mysql", "")]
+    for priv, db, table in reqs:
         if not checker.check(user, db, table, priv):
             where = f"table '{db}.{table}'" if table else \
                 (f"database '{db}'" if db else "this operation")

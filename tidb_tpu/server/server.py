@@ -61,6 +61,8 @@ class Server:
                 continue
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = ClientConnection(self, sock, next(self._conn_ids))
+            from tidb_tpu import metrics
+            metrics.counter("server.connections_total").inc()
             with self._conns_lock:
                 self._conns.add(conn)
             threading.Thread(target=conn.run, daemon=True,

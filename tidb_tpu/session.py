@@ -192,8 +192,11 @@ class Session:
         """Like execute, but one entry per statement (None for effect-only
         statements) — the wire server needs per-statement results to frame
         one OK/resultset per statement of a multi-statement COM_QUERY."""
-        return [self.execute_stmt(stmt, stmt.text or sql)
-                for stmt in self.parser.parse(sql)]
+        import time as _time
+        t0 = _time.perf_counter()
+        stmts = self.parser.parse(sql)
+        _metric_handles().parse.observe(_time.perf_counter() - t0)
+        return [self.execute_stmt(stmt, stmt.text or sql) for stmt in stmts]
 
     def execute_stmt(self, stmt, sql_text: str) -> ResultSet | None:
         """Execute one already-parsed statement; vars.affected_rows /
@@ -203,7 +206,10 @@ class Session:
 
     def _execute_one(self, stmt, sql_text: str,
                      record_history: bool = True) -> ResultSet | None:
+        import time as _time
+        m = _metric_handles()
         self.vars.affected_rows = 0
+        m.stmt_counter(type(stmt)).inc()
         if self.vars.user:
             # authenticated sessions (wire connections) pass the privilege
             # check; library/internal sessions have no user and skip it
@@ -213,9 +219,16 @@ class Session:
         if _is_simple(stmt):
             return execute_simple(self, stmt)
 
+        # phase histograms mirror metrics.go:20-45 (compile/run durations)
+        t0 = _time.perf_counter()
         plan = optimize_plan(PlanBuilder(self).build(stmt), self, self.client,
                              self.dirty_tables)
-        return self._dispatch_plan(plan, sql_text, record_history)
+        m.compile.observe(_time.perf_counter() - t0)
+        t1 = _time.perf_counter()
+        try:
+            return self._dispatch_plan(plan, sql_text, record_history)
+        finally:
+            m.run.observe(_time.perf_counter() - t1)
 
     def _dispatch_plan(self, plan, sql_text: str,
                        record_history: bool) -> ResultSet | None:
@@ -420,6 +433,36 @@ class _PreparedStmt:
         self.text = text
         self.plan = None
         self.plan_key = None
+
+
+class _MetricHandles:
+    """Resolved-once metric objects for the per-statement hot path (the
+    registry lock + name lookup would otherwise run 3-4× per statement)."""
+
+    def __init__(self):
+        from tidb_tpu import metrics
+        self.parse = metrics.histogram("session.parse_seconds")
+        self.compile = metrics.histogram("session.compile_seconds")
+        self.run = metrics.histogram("session.run_seconds")
+        self._stmt: dict[type, object] = {}
+        self._metrics = metrics
+
+    def stmt_counter(self, tp: type):
+        c = self._stmt.get(tp)
+        if c is None:
+            c = self._stmt[tp] = self._metrics.counter(
+                f"session.statements.{tp.__name__}")
+        return c
+
+
+_metric_handles_obj: _MetricHandles | None = None
+
+
+def _metric_handles() -> _MetricHandles:
+    global _metric_handles_obj
+    if _metric_handles_obj is None:
+        _metric_handles_obj = _MetricHandles()
+    return _metric_handles_obj
 
 
 def _is_simple(stmt) -> bool:

@@ -54,6 +54,8 @@ class ShowType(enum.IntEnum):
     VARIABLES = 5
     INDEXES = 6
     WARNINGS = 7
+    STATUS = 8        # metrics registry (SHOW STATUS)
+    GRANTS = 9
 
 
 @dataclass
