@@ -8,14 +8,26 @@ Configs (BASELINE.md):
   4. count(distinct l_orderkey) — distinct kernel
   5. Q1 via the device mesh (region-sharded partial-agg combine)
 
-Prints per-config lines to stderr and ONE JSON line to stdout:
+Scale strategy (honest accounting at 10M+ rows): a BENCH_BASE_ROWS store
+is generated through the real write path, then replicated at the KV level
+(handle-shifted copies of the encoded rows) up to BENCH_ROWS. The CPU
+xeval baseline is timed on the base store (its per-row cost is linear, and
+3 runs at 10M would take tens of minutes); the TPU engine is timed on the
+full store. Parity is checked EXACTLY via the replication algebra:
+count/sum scale by the copy factor, avg/min/max are invariant, and
+count(distinct l_orderkey) is invariant (copies duplicate orderkeys).
+
+Prints per-config lines to stderr — rows/s/chip and achieved HBM read
+bandwidth (bytes of referenced planes / kernel wall time; the workload is
+memory-bound, so this is the MFU proxy) — and ONE JSON line to stdout:
 
     {"metric": "tpch_geomean_rows_per_sec_tpu", "value": ...,
-     "unit": "rows/s", "vs_baseline": <geomean speedup over configs 2-4>}
+     "unit": "rows/s", "vs_baseline": <geomean speedup>, ...extras}
 
 Environment:
-    BENCH_ROWS   lineitem row count (default 300000)
-    BENCH_RUNS   timed repetitions per engine (default 3)
+    BENCH_ROWS        total lineitem rows for the TPU engine (default 10M)
+    BENCH_BASE_ROWS   generated base rows / CPU-baseline rows (default 1M)
+    BENCH_RUNS        timed repetitions (default 3)
 """
 
 from __future__ import annotations
@@ -43,6 +55,10 @@ Q1 = ("select l_returnflag, l_linestatus, "
       "order by l_returnflag, l_linestatus")
 
 QDIST = "select count(distinct l_orderkey) from lineitem"
+
+# referenced lineitem columns per config (for the HBM-bytes figure):
+# value plane 8B + validity 1B per column per row
+REFERENCED_COLS = {"q6": 4, "q1": 7, "distinct": 1}
 
 
 def build_store(n_rows: int):
@@ -95,11 +111,116 @@ def build_store(n_rows: int):
             tbl.add_record(txn, row, skip_unique_check=True)
         txn.commit()
     load_s = time.time() - t0
-    return store, s, load_s
+    return store, s, tbl, load_s
+
+
+def replicate_store(base_store, base_session, tbl, n_base: int,
+                    factor: int):
+    """Clone the base store's lineitem rows (factor-1) more times with
+    shifted handles, straight through commit_txn — scale data without
+    paying per-datum encode again."""
+    from tidb_tpu import tablecodec as tc
+    from tidb_tpu.session import Session, new_store
+
+    big = new_store(f"memory://bench_big{n_base * factor}")
+    s = Session(big)
+    s.execute("create database tpch")
+    s.execute("use tpch")
+    # same DDL → same column ids (fresh store, deterministic id alloc)
+    s.execute(
+        "create table lineitem ("
+        " l_id bigint primary key, l_orderkey bigint,"
+        " l_quantity double, l_extendedprice double, l_discount double,"
+        " l_tax double, l_returnflag varchar(1), l_linestatus varchar(1),"
+        " l_shipdate date)")
+    big_tbl = s.info_schema().table_by_name("tpch", "lineitem")
+
+    snap = base_store.get_snapshot()
+    start_k, end_k = tc.encode_record_range(tbl.id)
+    pairs = [(k, v) for k, v in snap.iterate(start_k, end_k)]
+    t0 = time.time()
+    chunk = 250_000
+    for copy in range(factor):
+        shift = copy * n_base
+        muts = []
+        for k, v in pairs:
+            _, handle = tc.decode_row_key(k)
+            muts.append((tc.encode_row_key(big_tbl.id, handle + shift), v))
+            if len(muts) >= chunk:
+                big.commit_txn(big.current_version(), muts)
+                muts = []
+        if muts:
+            big.commit_txn(big.current_version(), muts)
+    rep_s = time.time() - t0
+    return big, s, rep_s
+
+
+def kernel_probe(session, client, sql: str, runs: int):
+    """Device-kernel timing in the process's CLEAN state: builds the pushed
+    request from the optimized plan, packs the batch, compiles, and times
+    dispatch+completion (block_until_ready) WITHOUT any device→host read —
+    the axon tunnel permanently degrades every dispatch after the first
+    D2H, so this is the only window where the hardware's own speed is
+    observable. The jitted kernel lands in the client's cache, so the
+    end-to-end phase reuses it (one compile total)."""
+    import jax
+    import numpy as np
+    from tidb_tpu.copr.proto import PBTableInfo, SelectRequest
+    from tidb_tpu.executor.distsql_exec import (
+        _scan_pb_columns, table_ranges_to_kv_ranges,
+    )
+    from tidb_tpu.ops import kernels
+    from tidb_tpu.plan import optimize_plan
+    from tidb_tpu.plan.builder import PlanBuilder
+    from tidb_tpu.plan.plans import PhysicalTableScan
+
+    stmt = session.parser.parse_one(sql)
+    plan = optimize_plan(PlanBuilder(session).build(stmt), session, client,
+                         set())
+    scan = plan
+    while scan is not None and not isinstance(scan, PhysicalTableScan):
+        scan = scan.children[0] if scan.children else None
+    assert scan is not None and scan.aggregated_push_down, sql
+    sel = SelectRequest(
+        start_ts=session.store.current_version(),
+        table_info=PBTableInfo(scan.table_info.id, _scan_pb_columns(scan)),
+        where=scan.pushed_where, aggregates=list(scan.aggregates),
+        group_by=list(scan.group_by_pb), order_by=[], limit=scan.limit,
+        desc=scan.desc)
+    ranges = table_ranges_to_kv_ranges(scan.table_info.id, scan.ranges)
+    batch = client._get_batch(sel, ranges)
+    specs = kernels.lower_aggregates(sel, batch)
+    planes = kernels.batch_planes(
+        batch, with_pos=any(s.name == "first_row" for s in specs))
+    live = np.zeros(batch.capacity, dtype=bool)
+    live[: batch.n_rows] = True
+    if sel.group_by:
+        gspec = kernels.lower_group_by(sel, batch)
+        assert gspec.kind == "radix", sql
+        planes = client._with_group_planes(batch, gspec, planes)
+        _fn, _w, jitted = client._kernel(
+            sel, batch, "grouped",
+            lambda: kernels.build_grouped_agg_fn(
+                kernels.compile_expr(sel.where, batch)
+                if sel.where is not None else None,
+                specs, gspec.plane_keys, gspec.sizes))
+    else:
+        _fn, _w, jitted = client._kernel(
+            sel, batch, "scalar",
+            lambda: kernels.build_scalar_agg_fn(
+                kernels.compile_expr(sel.where, batch)
+                if sel.where is not None else None, specs, batch.n_rows))
+    r = jitted(planes, live)
+    jax.block_until_ready(r)          # compile + first dispatch
+    t0 = time.time()
+    for _ in range(runs):
+        r = jitted(planes, live)
+    jax.block_until_ready(r)          # NO np.asarray — stays clean
+    return (time.time() - t0) / runs
 
 
 def timed_runs(session, sql: str, runs: int):
-    session.execute(sql)  # warm (compile + cache)
+    session.execute(sql)  # warm (compile + cache + pack)
     results = []
     t0 = time.time()
     for _ in range(runs):
@@ -107,82 +228,133 @@ def timed_runs(session, sql: str, runs: int):
     return (time.time() - t0) / runs, results
 
 
-def check_parity(name: str, cpu_rows, tpu_rows):
+def _close(a: float, b: float, tol=1e-6) -> bool:
+    return abs(a - b) <= tol * max(abs(a), abs(b), 1.0)
+
+
+def check_scaled_parity(name: str, cpu_rows, tpu_rows, factor: int):
+    """Exact parity under the replication algebra (see module docstring)."""
     assert len(cpu_rows) == len(tpu_rows), \
         f"{name}: row count {len(cpu_rows)} vs {len(tpu_rows)}"
     for cr, tr in zip(cpu_rows, tpu_rows):
-        assert len(cr) == len(tr), \
-            f"{name}: column count {len(cr)} vs {len(tr)}"
-        for cv, tv in zip(cr, tr):
-            if isinstance(cv, (int,)) and isinstance(tv, (int,)):
-                assert cv == tv, f"{name}: {cv} != {tv}"
-            elif cv is None or tv is None:
-                assert cv is None and tv is None, f"{name}: {cv} vs {tv}"
-            elif isinstance(cv, (bytes, str)):
-                assert cv == tv, f"{name}: {cv!r} != {tv!r}"
-            else:
-                a, b = float(cv), float(tv)
-                assert abs(a - b) <= 1e-6 * max(abs(a), abs(b), 1.0), \
-                    f"{name}: {a} != {b}"
+        assert len(cr) == len(tr), f"{name}: column count"
+        if name == "q6":
+            assert _close(float(cr[0]) * factor, float(tr[0])), \
+                f"{name}: {cr[0]}x{factor} != {tr[0]}"
+        elif name == "distinct":
+            assert int(cr[0]) == int(tr[0]), f"{name}: {cr[0]} != {tr[0]}"
+        elif name.startswith("q1"):
+            # [flag, status, 4×sum, 3×avg, count]
+            for j in (0, 1):
+                a = cr[j].decode() if isinstance(cr[j], bytes) else cr[j]
+                b = tr[j].decode() if isinstance(tr[j], bytes) else tr[j]
+                assert a == b, f"{name}: group {a} != {b}"
+            for j in (2, 3, 4, 5):
+                assert _close(float(cr[j]) * factor, float(tr[j])), \
+                    f"{name}: sum col {j}"
+            for j in (6, 7, 8):
+                assert _close(float(cr[j]), float(tr[j])), \
+                    f"{name}: avg col {j}"
+            assert int(cr[9]) * factor == int(tr[9]), f"{name}: count"
 
 
 def main():
-    n_rows = int(os.environ.get("BENCH_ROWS", "300000"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "10200000"))
+    n_base = int(os.environ.get("BENCH_BASE_ROWS", "300000"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
+    n_base = min(n_base, n_rows)
+    factor = max(1, n_rows // n_base)
+    n_rows = n_base * factor
 
     from tidb_tpu.ops import TpuClient
     from tidb_tpu.session import Session
 
-    store, session, load_s = build_store(n_rows)
-    print(f"# loaded {n_rows} rows in {load_s:.1f}s "
-          f"({n_rows / load_s:,.0f} rows/s)", file=sys.stderr)
+    base_store, base_session, tbl, load_s = build_store(n_base)
+    print(f"# loaded {n_base} rows in {load_s:.1f}s "
+          f"({n_base / load_s:,.0f} rows/s write path)", file=sys.stderr)
+
+    if factor > 1:
+        big_store, big_session, rep_s = replicate_store(
+            base_store, base_session, tbl, n_base, factor)
+        print(f"# replicated to {n_rows} rows in {rep_s:.1f}s",
+              file=sys.stderr)
+    else:
+        big_store, big_session = base_store, base_session
 
     configs = [("q6", Q6), ("q1", Q1), ("distinct", QDIST)]
 
-    # CPU xeval baseline (store/localstore/local_region.go equivalent)
+    # CPU xeval baseline on the base store (local_region.go equivalent)
     cpu = {}
     for name, sql in configs:
-        cpu_s, cpu_results = timed_runs(session, sql, runs)
+        cpu_s, cpu_results = timed_runs(base_session, sql,
+                                        max(1, runs if n_base <= 300_000
+                                            else 1))
         cpu[name] = (cpu_s, cpu_results)
+        print(f"# {name}: cpu xeval {cpu_s:.3f}s/run "
+              f"({n_base / cpu_s:,.0f} rows/s at {n_base} rows)",
+              file=sys.stderr)
 
-    # TPU coprocessor
-    store.set_client(TpuClient(store))
-    tpu_session = Session(store)
+    # TPU coprocessor on the full store
+    big_store.set_client(TpuClient(big_store))
+    tpu_session = Session(big_store)
     tpu_session.execute("use tpch")
-    tpu_client = store.get_client()
-    speedups = []
-    tpu_rps_all = []
+    tpu_client = big_store.get_client()
+
+    # phase 1 — CLEAN-state kernel probes (dispatch + block_until_ready,
+    # zero D2H): the hardware's own throughput/bandwidth, before the axon
+    # tunnel degrades dispatches. Also packs batches + compiles kernels
+    # that phase 2 reuses.
+    kernel_s: dict[str, float] = {}
+    for name, sql in configs:
+        try:
+            kernel_s[name] = kernel_probe(tpu_session, tpu_client, sql,
+                                          runs)
+            bw = n_rows * REFERENCED_COLS[name] * 9 / kernel_s[name] / 1e9
+            print(f"# {name}: device kernel {kernel_s[name] * 1000:.1f} "
+                  f"ms/run ({n_rows / kernel_s[name]:,.0f} rows/s/chip, "
+                  f"{bw:.1f} GB/s HBM achieved)", file=sys.stderr)
+        except Exception as e:  # probe is best-effort diagnostics
+            print(f"# {name}: kernel probe skipped ({e})", file=sys.stderr)
+
+    # phase 2 — end-to-end SQL (includes result decode; the first D2H
+    # triggers the tunnel's degraded-dispatch mode, which inflates
+    # per-query wall time by ~0.2-2s — reality of THIS deployment, so the
+    # headline number keeps it)
+    speedups, tpu_rps_all, bw_figures = [], [], {}
     for name, sql in configs:
         before = (tpu_client.stats["tpu_requests"],
                   tpu_client.stats["cpu_fallbacks"])
+        t_pack0 = time.time()
+        tpu_session.execute(sql)  # warm (batch + kernel reused from probe)
+        first_s = time.time() - t_pack0
         tpu_s, tpu_results = timed_runs(tpu_session, sql, runs)
         assert tpu_client.stats["tpu_requests"] > before[0], \
             f"{name}: never reached the TPU engine"
         assert tpu_client.stats["cpu_fallbacks"] == before[1], \
             f"{name}: fell back to the CPU engine"
         cpu_s, cpu_results = cpu[name]
-        check_parity(name, cpu_results[0], tpu_results[0])
-        cpu_rps, tpu_rps = n_rows / cpu_s, n_rows / tpu_s
+        check_scaled_parity(name, cpu_results[0], tpu_results[0], factor)
+        cpu_rps, tpu_rps = n_base / cpu_s, n_rows / tpu_s
         speedups.append(tpu_rps / cpu_rps)
         tpu_rps_all.append(tpu_rps)
-        print(f"# {name}: cpu {cpu_s:.3f}s/run ({cpu_rps:,.0f} rows/s)  "
-              f"tpu {tpu_s:.4f}s/run ({tpu_rps:,.0f} rows/s)  "
+        ks = kernel_s.get(name)
+        bw = (n_rows * REFERENCED_COLS[name] * 9 / ks / 1e9) if ks else 0.0
+        bw_figures[name] = round(bw, 2)
+        print(f"# {name}: tpu e2e {tpu_s:.4f}s/run ({tpu_rps:,.0f} rows/s"
+              f"/chip, first-run {first_s:.1f}s)  "
               f"speedup {tpu_rps / cpu_rps:.1f}x", file=sys.stderr)
-
-    client = store.get_client()
-    assert client.stats["tpu_requests"] > 0, "TPU engine was never used"
 
     # config 5: Q1 with the mesh client — partial aggregates combined over
     # the device axis (psum/pmin/pmax); on single-chip hardware this runs
     # with axis size 1, under the test env with 8 virtual devices
     import jax
     from tidb_tpu.parallel import CoprMesh
-    mesh_client = TpuClient(store, mesh=CoprMesh())
-    store.set_client(mesh_client)
-    mesh_session = Session(store)
+    mesh_client = TpuClient(big_store, mesh=CoprMesh())
+    big_store.set_client(mesh_client)
+    mesh_session = Session(big_store)
     mesh_session.execute("use tpch")
     mesh_s, mesh_results = timed_runs(mesh_session, Q1, runs)
-    check_parity("q1_mesh", cpu["q1"][1][0], mesh_results[0])
+    check_scaled_parity("q1_mesh", cpu["q1"][1][0], mesh_results[0], factor)
     assert mesh_client.stats["tpu_requests"] > 0, "mesh engine never used"
     print(f"# q1_mesh ({len(jax.devices())} devices): {mesh_s:.4f}s/run "
           f"({n_rows / mesh_s:,.0f} rows/s)", file=sys.stderr)
@@ -191,11 +363,17 @@ def main():
                        / len(tpu_rps_all))
     geo_speedup = math.exp(sum(math.log(x) for x in speedups)
                            / len(speedups))
+    kernel_rps = {name: round(n_rows / s, 1)
+                  for name, s in kernel_s.items()}
     print(json.dumps({
         "metric": "tpch_geomean_rows_per_sec_tpu",
         "value": round(geo_rps, 1),
         "unit": "rows/s",
         "vs_baseline": round(geo_speedup, 2),
+        "rows": n_rows,
+        "cpu_baseline_rows": n_base,
+        "hbm_gbps": bw_figures,
+        "kernel_rows_per_sec": kernel_rps,
     }))
 
 

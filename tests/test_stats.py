@@ -187,6 +187,69 @@ class TestCostBasedAccessPath:
         tk.exec("select count(1) from t where b = 7").check([[195]])
         tk.exec("select c from t where b = 1199").check([[199]])
 
+    def test_join_reorder_by_table_size(self):
+        """Inner-join chains order largest-first so every hash build side
+        (right child) is as small as stats allow (join_reorder.go)."""
+        from tidb_tpu.plan.plans import PhysicalHashJoin
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table big (id int primary key, k int)")
+        tk.exec("create table small (id int primary key, k int)")
+        rows = ", ".join(f"({i}, {i % 10})" for i in range(300))
+        tk.exec(f"insert into big values {rows}")
+        tk.exec("insert into small values (1, 1), (2, 2)")
+        tk.exec("analyze table big, small")
+
+        from tidb_tpu.plan import optimize_plan
+        from tidb_tpu.plan.builder import PlanBuilder
+        s = tk.session
+
+        def top_join(sql):
+            stmt = s.parser.parse_one(sql)
+            p = optimize_plan(PlanBuilder(s).build(stmt), s, s.client, set())
+            n = p
+            while n is not None and not isinstance(n, PhysicalHashJoin):
+                n = n.children[0] if n.children else None
+            return n
+
+        # syntax order small-first: reorder must put big on the LEFT
+        # (probe) and small on the RIGHT (build)
+        j = top_join("select * from small, big where small.k = big.k")
+        names = [c.tbl_name for c in j.children[1].schema[:1]]
+        assert names == ["small"], names
+        # results stay correct (column order = declaration order)
+        got = tk.exec("select small.id, big.id from small, big "
+                      "where small.k = big.k and big.id < 15 "
+                      "order by small.id, big.id").rows
+        assert got == [[1, 1], [1, 11], [2, 2], [2, 12]]
+        # three-way chain reorders and still answers correctly
+        tk.exec("create table mid (id int primary key, k int)")
+        tk.exec("insert into mid values " +
+                ", ".join(f"({i}, {i % 10})" for i in range(30)))
+        tk.exec("analyze table mid")
+        got = tk.exec(
+            "select small.id, mid.id, big.id from small, mid, big "
+            "where small.k = mid.k and mid.k = big.k and big.id < 12 "
+            "and mid.id < 12 order by small.id, mid.id, big.id").rows
+        assert got == [[1, 1, 1], [1, 1, 11], [1, 11, 1], [1, 11, 11],
+                       [2, 2, 2]]
+
+    def test_on_condition_scope_not_widened_by_flatten(self):
+        """An unqualified ON column that is unique at its own join level
+        must not become ambiguous against factors joined later
+        (regression: all ONs were resolved against the full chain)."""
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t1 (x int primary key, a int)")
+        tk.exec("create table t2 (y int primary key, b int)")
+        tk.exec("create table t3 (y int primary key, c int)")
+        tk.exec("insert into t1 values (1, 1), (2, 2)")
+        tk.exec("insert into t2 values (1, 10), (3, 30)")
+        tk.exec("insert into t3 values (1, 100), (2, 200)")
+        got = tk.exec("select t1.x, t2.b, t3.c from t1 join t2 on x = y "
+                      "join t3 on t1.x = t3.y order by t1.x").rows
+        assert got == [[1, 10, 100]]
+
     def test_range_estimation_flip(self):
         tk = TestKit()
         tk.exec("create database d; use d")

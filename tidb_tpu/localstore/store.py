@@ -145,6 +145,15 @@ class LocalStore(Storage):
         self._client: Client | None = None
         self._closed = False
         self._commit_ts_log: list[int] = []
+        # per-commit {key[:12] prefix → (min_key, max_key)} — the record
+        # prefix is 12 bytes (t + enc_int(tid) + _r), so the columnar cache
+        # can prove a batch of commits is append-only for its table.
+        # Bounded window: only the most recent commits are retained
+        # (cached batches are never older than a few versions in practice);
+        # requests preceding the window return None = "unknown"
+        self._commit_bounds_log: list[dict[bytes, tuple[bytes, bytes]]] = []
+        self._commit_bounds_base = 0           # version of log[0]
+        self._commit_bounds_cap = 4096
 
     # ---- Storage ----
     def begin(self) -> Transaction:
@@ -184,16 +193,38 @@ class LocalStore(Storage):
                     raise errors.WriteConflictError(
                         f"write conflict on {key!r} (start_ts={txn_start_ts})")
             commit_ts = self.oracle.current_version()
+            bounds: dict[bytes, tuple[bytes, bytes]] = {}
             for key, val in mutations:
                 self.mvcc.write(key, commit_ts, None if val == TOMBSTONE else val)
+                p = bytes(key[:12])
+                cur = bounds.get(p)
+                if cur is None:
+                    bounds[p] = (key, key)
+                else:
+                    bounds[p] = (min(cur[0], key), max(cur[1], key))
             self.regions.note_write(len(mutations))
             self._commit_ts_log.append(commit_ts)
+            self._commit_bounds_log.append(bounds)
+            overflow = len(self._commit_bounds_log) - self._commit_bounds_cap
+            if overflow > 0:
+                del self._commit_bounds_log[:overflow]
+                self._commit_bounds_base += overflow
 
     def data_version_at(self, start_ts: int) -> int:
         """Number of commits visible at start_ts — the cache key the TPU
         columnar cache uses: equal versions ⇒ identical visible data."""
         import bisect
         return bisect.bisect_right(self._commit_ts_log, start_ts)
+
+    def commit_bounds(self, from_version: int, to_version: int):
+        """Per-commit key-prefix bounds for commits (from, to], or None
+        when the window no longer covers from_version — callers must treat
+        None as 'not provably append-only'."""
+        lo = from_version - self._commit_bounds_base
+        hi = to_version - self._commit_bounds_base
+        if lo < 0:
+            return None
+        return self._commit_bounds_log[lo:hi]
 
     # ---- GC ----
     def compact(self, safe_point_ts: int | None = None,

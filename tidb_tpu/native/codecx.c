@@ -293,11 +293,347 @@ static PyObject *py_encode_datums(PyObject *self, PyObject *args) {
     return out;
 }
 
+/* ------------------------------------------------------------------ */
+/* pack_rows: batched row decode → columnar planes (the read-path hot   */
+/* loop; reverse of encode_row). Reference: the per-row decode in       */
+/* store/localstore/local_region.go:617 getRowData — here one C pass    */
+/* fills value/valid planes for the TPU columnar batch directly.        */
+/* ------------------------------------------------------------------ */
+
+typedef struct {
+    const uint8_t *p;
+    Py_ssize_t len, pos;
+} Rd;
+
+static inline int rd_u64be(Rd *r, uint64_t *out) {
+    if (r->pos + 8 > r->len) return -1;
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v = (v << 8) | r->p[r->pos + i];
+    r->pos += 8;
+    *out = v;
+    return 0;
+}
+
+static inline int rd_uvarint(Rd *r, uint64_t *out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (r->pos < r->len && shift < 70) {
+        uint8_t c = r->p[r->pos++];
+        v |= ((uint64_t)(c & 0x7F)) << shift;
+        if (!(c & 0x80)) { *out = v; return 0; }
+        shift += 7;
+    }
+    return -1;
+}
+
+static inline int rd_varint(Rd *r, int64_t *out) {
+    uint64_t u;
+    if (rd_uvarint(r, &u) < 0) return -1;
+    *out = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+    return 0;
+}
+
+/* decoded scalar: kind 0=null, 1=int-ish(i64), 2=float(f64), 3=bytes */
+typedef struct {
+    int kind;
+    int64_t i;
+    double f;
+    const uint8_t *bytes;   /* COMPACT only: borrowed pointer into value */
+    Py_ssize_t blen;
+    uint8_t *owned;         /* BYTES (memcomparable): decoded copy */
+} Dec;
+
+static int decode_value_datum(Rd *r, Dec *d) {
+    d->owned = NULL;
+    if (r->pos >= r->len) return -1;
+    uint8_t flag = r->p[r->pos++];
+    uint64_t u;
+    int64_t v;
+    switch (flag) {
+    case NIL_FLAG:
+        d->kind = 0;
+        return 0;
+    case VARINT_FLAG:
+        if (rd_varint(r, &v) < 0) return -1;
+        d->kind = 1; d->i = v;
+        return 0;
+    case UVARINT_FLAG:
+        if (rd_uvarint(r, &u) < 0) return -1;
+        d->kind = 1; d->i = (int64_t)u;
+        return 0;
+    case INT_FLAG:
+    case DURATION_FLAG:  /* cmp-int payload (nanos) */
+        if (rd_u64be(r, &u) < 0) return -1;
+        d->kind = 1; d->i = (int64_t)(u ^ SIGN_MASK);
+        return 0;
+    case UINT_FLAG:
+    case TIME_FLAG:      /* packed time uint */
+        if (rd_u64be(r, &u) < 0) return -1;
+        d->kind = 1; d->i = (int64_t)u;
+        return 0;
+    case FLOAT_FLAG: {
+        if (rd_u64be(r, &u) < 0) return -1;
+        if (u & SIGN_MASK) u &= ~SIGN_MASK; else u = ~u;
+        double f;
+        memcpy(&f, &u, 8);
+        d->kind = 2; d->f = f;
+        return 0;
+    }
+    case COMPACT_BYTES_FLAG: {
+        if (rd_varint(r, &v) < 0 || v < 0 || r->pos + v > r->len) return -1;
+        d->kind = 3;
+        d->bytes = r->p + r->pos;
+        d->blen = (Py_ssize_t)v;
+        r->pos += v;
+        return 0;
+    }
+    case BYTES_FLAG: {
+        /* memcomparable 9-byte groups: 8 data + marker(0xFF - pad) */
+        size_t cap = 0, n = 0;
+        uint8_t *out = NULL;
+        for (;;) {
+            if (r->pos + 9 > r->len) { PyMem_Free(out); return -1; }
+            const uint8_t *grp = r->p + r->pos;
+            r->pos += 9;
+            int pad = 0xFF - grp[8];
+            if (pad < 0 || pad > 8) { PyMem_Free(out); return -1; }
+            int take = 8 - pad;
+            if (n + 8 > cap) {
+                cap = cap ? cap * 2 : 32;
+                uint8_t *np2 = PyMem_Realloc(out, cap);
+                if (!np2) { PyMem_Free(out); return -1; }
+                out = np2;
+            }
+            memcpy(out + n, grp, (size_t)take);
+            n += (size_t)take;
+            if (pad > 0) break;
+        }
+        d->kind = 3;
+        d->owned = out;
+        d->bytes = out ? out : (const uint8_t *)"";
+        d->blen = (Py_ssize_t)n;
+        return 0;
+    }
+    default:
+        return -1;  /* DECIMAL etc.: caller falls back to Python */
+    }
+}
+
+static int skip_value_datum(Rd *r) {
+    Dec tmp;
+    if (decode_value_datum(r, &tmp) < 0) return -1;
+    PyMem_Free(tmp.owned);
+    return 0;
+}
+
+/* pack_rows(keys, values, col_ids, kinds, pk_idx)
+ *   keys/values: sequences of bytes (one KV pair per row)
+ *   kinds: bytes, one of 'i'/'f'/'s' per column
+ *   pk_idx: column index taking the handle, or -1
+ * → (n_rows, handles_le64, per-col value buffer | list, valid_u8, present_u8)
+ *   numeric value buffers are little-endian i64/f64 for np.frombuffer. */
+static PyObject *py_pack_rows(PyObject *self, PyObject *args) {
+    PyObject *keys_obj, *vals_obj, *cids_obj;
+    const char *kinds;
+    Py_ssize_t kinds_len;
+    int pk_idx;
+    if (!PyArg_ParseTuple(args, "OOOy#i", &keys_obj, &vals_obj, &cids_obj,
+                          &kinds, &kinds_len, &pk_idx))
+        return NULL;
+    PyObject *keys = PySequence_Fast(keys_obj, "keys not a sequence");
+    if (!keys) return NULL;
+    PyObject *vals = PySequence_Fast(vals_obj, "values not a sequence");
+    if (!vals) { Py_DECREF(keys); return NULL; }
+    PyObject *cids = PySequence_Fast(cids_obj, "col_ids not a sequence");
+    if (!cids) { Py_DECREF(keys); Py_DECREF(vals); return NULL; }
+
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(keys);
+    Py_ssize_t m = PySequence_Fast_GET_SIZE(cids);
+    if (PySequence_Fast_GET_SIZE(vals) != n || m != kinds_len || m > 256) {
+        PyErr_SetString(PyExc_ValueError, "pack_rows shape mismatch");
+        goto fail_seqs;
+    }
+    int64_t cid_arr[256];
+    for (Py_ssize_t j = 0; j < m; j++) {
+        long long c = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(cids, j));
+        if (c == -1 && PyErr_Occurred()) goto fail_seqs;
+        cid_arr[j] = c;
+    }
+
+    PyObject *handles = PyBytes_FromStringAndSize(NULL, n * 8);
+    PyObject **col_out = PyMem_Calloc((size_t)m, sizeof(PyObject *));
+    PyObject **valid_out = PyMem_Calloc((size_t)m, sizeof(PyObject *));
+    PyObject **present_out = PyMem_Calloc((size_t)m, sizeof(PyObject *));
+    if (!handles || !col_out || !valid_out || !present_out) goto fail_alloc;
+    for (Py_ssize_t j = 0; j < m; j++) {
+        if (kinds[j] == 's') col_out[j] = PyList_New(n);
+        else col_out[j] = PyBytes_FromStringAndSize(NULL, n * 8);
+        valid_out[j] = PyBytes_FromStringAndSize(NULL, n);
+        present_out[j] = PyBytes_FromStringAndSize(NULL, n);
+        if (!col_out[j] || !valid_out[j] || !present_out[j]) goto fail_alloc;
+        if (kinds[j] != 's')  /* invalid slots must read as 0, like the
+                                 Python path */
+            memset(PyBytes_AS_STRING(col_out[j]), 0, (size_t)(n * 8));
+        memset(PyBytes_AS_STRING(valid_out[j]), 0, (size_t)n);
+        memset(PyBytes_AS_STRING(present_out[j]), 0, (size_t)n);
+    }
+
+    int64_t *hbuf = (int64_t *)PyBytes_AS_STRING(handles);
+    Py_ssize_t out_i = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        const uint8_t *kp;
+        Py_ssize_t klen;
+        {
+            PyObject *ko = PySequence_Fast_GET_ITEM(keys, i);
+            if (PyBytes_AsStringAndSize(ko, (char **)&kp, &klen) < 0)
+                goto fail_alloc;
+        }
+        /* record key: 't' + INT(9) + "_r" + INT(9) */
+        if (klen != 21 || kp[0] != 't' || kp[10] != '_' || kp[11] != 'r'
+            || kp[12] != INT_FLAG)
+            continue;  /* not a row key: skip like the Python path */
+        uint64_t hu = 0;
+        for (int b8 = 0; b8 < 8; b8++) hu = (hu << 8) | kp[13 + b8];
+        int64_t handle = (int64_t)(hu ^ SIGN_MASK);
+        hbuf[out_i] = handle;
+
+        const uint8_t *vp;
+        Py_ssize_t vlen;
+        {
+            PyObject *vo = PySequence_Fast_GET_ITEM(vals, i);
+            if (PyBytes_AsStringAndSize(vo, (char **)&vp, &vlen) < 0)
+                goto fail_alloc;
+        }
+        Rd r = {vp, vlen, 0};
+        if (!(vlen == 1 && vp[0] == NIL_FLAG)) {  /* empty-row sentinel */
+            while (r.pos < r.len) {
+                int64_t cid;
+                if (r.p[r.pos] != VARINT_FLAG) {
+                    PyErr_SetString(Unsupported, "row col-id not varint");
+                    goto fail_alloc;
+                }
+                r.pos++;
+                if (rd_varint(&r, &cid) < 0) {
+                    PyErr_SetString(Unsupported, "truncated row value");
+                    goto fail_alloc;
+                }
+                Py_ssize_t j = -1;
+                for (Py_ssize_t jj = 0; jj < m; jj++)
+                    if (cid_arr[jj] == cid) { j = jj; break; }
+                if (j < 0) {
+                    if (skip_value_datum(&r) < 0) {
+                        PyErr_SetString(Unsupported, "undecodable datum");
+                        goto fail_alloc;
+                    }
+                    continue;
+                }
+                Dec d;
+                if (decode_value_datum(&r, &d) < 0) {
+                    PyErr_SetString(Unsupported, "undecodable datum");
+                    goto fail_alloc;
+                }
+                PyBytes_AS_STRING(present_out[j])[out_i] = 1;
+                char kind = kinds[j];
+                if (d.kind == 0) {
+                    /* NULL: valid stays 0 */
+                    if (kind == 's') {
+                        Py_INCREF(Py_None);
+                        PyList_SET_ITEM(col_out[j], out_i, Py_None);
+                    }
+                } else if (kind == 'i') {
+                    int64_t v = d.kind == 1 ? d.i
+                              : d.kind == 2 ? (int64_t)d.f : 0;
+                    if (d.kind == 3) {
+                        PyMem_Free(d.owned);
+                        PyErr_SetString(Unsupported, "bytes in int column");
+                        goto fail_alloc;
+                    }
+                    ((int64_t *)PyBytes_AS_STRING(col_out[j]))[out_i] = v;
+                    PyBytes_AS_STRING(valid_out[j])[out_i] = 1;
+                } else if (kind == 'f') {
+                    double v = d.kind == 2 ? d.f
+                             : d.kind == 1 ? (double)d.i : 0.0;
+                    if (d.kind == 3) {
+                        PyMem_Free(d.owned);
+                        PyErr_SetString(Unsupported, "bytes in float column");
+                        goto fail_alloc;
+                    }
+                    ((double *)PyBytes_AS_STRING(col_out[j]))[out_i] = v;
+                    PyBytes_AS_STRING(valid_out[j])[out_i] = 1;
+                } else {  /* 's' */
+                    if (d.kind != 3) {
+                        PyErr_SetString(Unsupported,
+                                        "non-bytes in string column");
+                        goto fail_alloc;
+                    }
+                    PyObject *bs = PyBytes_FromStringAndSize(
+                        (const char *)d.bytes, d.blen);
+                    PyMem_Free(d.owned);
+                    if (!bs) goto fail_alloc;
+                    PyList_SET_ITEM(col_out[j], out_i, bs);
+                    PyBytes_AS_STRING(valid_out[j])[out_i] = 1;
+                }
+            }
+        }
+        if (pk_idx >= 0) {
+            ((int64_t *)PyBytes_AS_STRING(col_out[pk_idx]))[out_i] = handle;
+            PyBytes_AS_STRING(valid_out[pk_idx])[out_i] = 1;
+            PyBytes_AS_STRING(present_out[pk_idx])[out_i] = 1;
+        }
+        out_i++;
+    }
+
+    /* unfilled string slots (absent column) must hold None, not NULL ptr */
+    for (Py_ssize_t j = 0; j < m; j++) {
+        if (kinds[j] != 's') continue;
+        for (Py_ssize_t i2 = 0; i2 < n; i2++) {
+            if (!PyList_GET_ITEM(col_out[j], i2)) {
+                Py_INCREF(Py_None);
+                PyList_SET_ITEM(col_out[j], i2, Py_None);
+            }
+        }
+    }
+
+    PyObject *cols_t = PyTuple_New(m);
+    PyObject *valid_t = PyTuple_New(m);
+    PyObject *present_t = PyTuple_New(m);
+    if (!cols_t || !valid_t || !present_t) {
+        Py_XDECREF(cols_t); Py_XDECREF(valid_t); Py_XDECREF(present_t);
+        goto fail_alloc;
+    }
+    for (Py_ssize_t j = 0; j < m; j++) {
+        PyTuple_SET_ITEM(cols_t, j, col_out[j]);
+        PyTuple_SET_ITEM(valid_t, j, valid_out[j]);
+        PyTuple_SET_ITEM(present_t, j, present_out[j]);
+        col_out[j] = valid_out[j] = present_out[j] = NULL;
+    }
+    PyMem_Free(col_out); PyMem_Free(valid_out); PyMem_Free(present_out);
+    Py_DECREF(keys); Py_DECREF(vals); Py_DECREF(cids);
+    PyObject *res = Py_BuildValue("nNNNN", out_i, handles, cols_t, valid_t,
+                                  present_t);
+    return res;
+
+fail_alloc:
+    Py_XDECREF(handles);
+    if (col_out) for (Py_ssize_t j = 0; j < m; j++) Py_XDECREF(col_out[j]);
+    if (valid_out) for (Py_ssize_t j = 0; j < m; j++) Py_XDECREF(valid_out[j]);
+    if (present_out) for (Py_ssize_t j = 0; j < m; j++) Py_XDECREF(present_out[j]);
+    PyMem_Free(col_out); PyMem_Free(valid_out); PyMem_Free(present_out);
+fail_seqs:
+    Py_DECREF(keys); Py_DECREF(vals); Py_DECREF(cids);
+    if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_ValueError, "pack_rows failed");
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"encode_row", py_encode_row, METH_VARARGS,
      "encode_row(col_ids, datums) -> bytes (compact row value layout)"},
     {"encode_datums", py_encode_datums, METH_VARARGS,
      "encode_datums(datums, comparable) -> bytes"},
+    {"pack_rows", py_pack_rows, METH_VARARGS,
+     "pack_rows(keys, values, col_ids, kinds, pk_idx) -> "
+     "(n, handles, cols, valids, presents)"},
     {NULL, NULL, 0, NULL},
 };
 

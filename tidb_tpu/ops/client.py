@@ -69,7 +69,8 @@ class TpuClient(kv.Client):
         self._fn_cache: dict = {}
         self._rank_cap_start: dict = {}
         self.stats = {"tpu_requests": 0, "cpu_fallbacks": 0,
-                      "batch_packs": 0, "batch_hits": 0}
+                      "batch_packs": 0, "batch_hits": 0,
+                      "batch_appends": 0}
 
     # ------------------------------------------------------------------
     # capability probe: optimistic structural check; send() falls back on
@@ -143,36 +144,81 @@ class TpuClient(kv.Client):
                     tuple(c.column_id for c in cols),
                     tuple((r.start, r.end) for r in ranges))
         version = self.store.data_version_at(sel.start_ts)
-        batch = self._batch_cache.get(base_key + (version,))
-        if batch is not None:
+        ent = self._batch_cache.get(base_key)
+        if ent is not None and ent[1] == version:
             self.stats["batch_hits"] += 1
-            return batch
+            return ent[0]
+        # a cached batch from a NEWER version must never serve an older
+        # snapshot (it may contain rows this reader cannot see) — usable
+        # as an append base only when strictly older than the reader
+        base_ent = ent if ent is not None and ent[1] < version else None
         snapshot = self.store.get_snapshot(sel.start_ts)
         defaults = {c.column_id: c.default_val for c in cols
                     if c.default_val is not None}
+
+        def build():
+            # incremental fast path: when every commit since the cached
+            # version that touches this table's record space lies strictly
+            # ABOVE the packed watermark (pure appends), only the delta is
+            # scanned — a write no longer costs a full repack (round-2
+            # weak #4)
+            if base_ent is not None and not is_index \
+                    and self._appends_only(src.table_id, base_ent):
+                self.stats["batch_appends"] += 1
+                return col.append_rows(base_ent[0], snapshot, src.table_id,
+                                       cols, ranges, defaults)
+            self.stats["batch_packs"] += 1
+            return (col.pack_index_ranges(snapshot, src, ranges)
+                    if is_index
+                    else col.pack_ranges(snapshot, src.table_id, cols,
+                                         ranges, defaults))
+
         # stabilization loop: on a cluster store, commits with a commit_ts
         # below our start_ts can land DURING the pack (lock resolution),
         # so the version is only a sound cache key if it is identical
         # before and after packing; a churning version means other readers
         # at the same key could see a different row set — don't cache
         for _ in range(3):
-            batch = (col.pack_index_ranges(snapshot, src, ranges) if is_index
-                     else col.pack_ranges(snapshot, src.table_id, cols,
-                                          ranges, defaults))
+            batch = build()
             after = self.store.data_version_at(sel.start_ts)
             if after == version:
                 break
             version = after
         else:
-            batch._uid = next(self._uid_gen)
-            self.stats["batch_packs"] += 1
+            if getattr(batch, "_uid", None) is None:
+                batch._uid = next(self._uid_gen)
             return batch  # version still churning: serve uncached
-        batch._uid = next(self._uid_gen)
-        self._batch_cache[base_key + (version,)] = batch
-        self.stats["batch_packs"] += 1
-        if len(self._batch_cache) > 64:
-            self._batch_cache.pop(next(iter(self._batch_cache)))
+        if getattr(batch, "_uid", None) is None:
+            batch._uid = next(self._uid_gen)
+        # monotonic cache: never let an older-snapshot build displace a
+        # newer cached batch
+        if ent is None or version >= ent[1]:
+            self._batch_cache[base_key] = (batch, version)
+            if len(self._batch_cache) > 64:
+                self._batch_cache.pop(next(iter(self._batch_cache)))
         return batch
+
+    def _appends_only(self, table_id: int, ent) -> bool:
+        """True when every commit in (cached version, now] either avoids
+        this table's record keyspace or only writes keys above the cached
+        batch's max handle."""
+        bounds_fn = getattr(self.store, "commit_bounds", None)
+        old_batch, old_version = ent
+        watermark = getattr(old_batch, "max_handle", None)
+        if bounds_fn is None or watermark is None:
+            return False
+        from tidb_tpu import tablecodec as tc
+        prefix = tc.table_record_prefix(table_id)
+        wm_key = tc.encode_row_key(table_id, watermark)
+        cur = self.store.data_version_at(self.store.current_version())
+        commits = bounds_fn(old_version, cur)
+        if commits is None:  # bounds window expired: can't prove anything
+            return False
+        for commit in commits:
+            b = commit.get(prefix)
+            if b is not None and b[0] <= wm_key:
+                return False
+        return True
 
     def _send_tpu(self, req: kv.Request, sel: SelectRequest) -> SelectResponse:
         if sel.having is not None:

@@ -154,17 +154,17 @@ def datum_to_phys(d: Datum, kind: str):
     raise errors.TypeError_(f"cannot pack {d!r} as {kind}")
 
 
-def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
-                ranges, fill_defaults: dict[int, Datum] | None = None
-                ) -> ColumnBatch:
-    """Scan+decode [start,end) row ranges into a ColumnBatch.
-
-    This is the host-side decode the C++ packer will replace; the output
-    layout is the contract, not the loop.
-    """
+def _scan_rows(snapshot, table_id: int, columns, ranges, defaults):
+    """Per-row scan + decode: (handles, raw values, valid flags) —
+    delegates to the native batch decoder when available, else the
+    Python loop (the layout contract is identical)."""
+    from tidb_tpu.ops import nativepack
+    native = nativepack.scan_rows(snapshot, table_id, columns, ranges,
+                                  defaults)
+    if native is not None:
+        return native
     col_kinds = {c.column_id: column_phys_kind(c) for c in columns}
     pk_col = next((c for c in columns if c.pk_handle), None)
-    defaults = fill_defaults or {}
 
     handles: list[int] = []
     raw: dict[int, list] = {c.column_id: [] for c in columns}
@@ -190,6 +190,22 @@ def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
                 v, ok = datum_to_phys(d, col_kinds[cid])
                 raw[cid].append(v)
                 valid[cid].append(ok)
+    return handles, raw, valid
+
+
+def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
+                ranges, fill_defaults: dict[int, Datum] | None = None
+                ) -> ColumnBatch:
+    """Scan+decode [start,end) row ranges into a ColumnBatch.
+
+    The hot per-row decode runs in C (native/codecx.c pack_rows) when the
+    extension is available; the output layout is the contract, not the
+    loop.
+    """
+    col_kinds = {c.column_id: column_phys_kind(c) for c in columns}
+    defaults = fill_defaults or {}
+    handles, raw, valid = _scan_rows(snapshot, table_id, columns, ranges,
+                                     defaults)
 
     n = len(handles)
     cap = bucket_capacity(n)
@@ -208,10 +224,85 @@ def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
             dtype = np.int64 if kind == K_I64 else np.float64
             vals = np.zeros(cap, dtype=dtype)
             if n:
-                vals[:n] = [x if ok else 0
-                            for x, ok in zip(raw[cid], valid[cid])]
+                src = raw[cid]
+                if isinstance(src, np.ndarray):
+                    vals[:n] = src[:n]
+                else:
+                    vals[:n] = [x if ok else 0
+                                for x, ok in zip(src, valid[cid])]
             cols[cid] = ColumnData(kind, vals, va, tp=c.tp)
-    return ColumnBatch(n, cap, h, cols)
+    batch = ColumnBatch(n, cap, h, cols)
+    batch.max_handle = int(max(handles)) if n else I64_MIN
+    return batch
+
+
+def append_rows(batch: ColumnBatch, snapshot, table_id: int,
+                columns: list[PBColumnInfo], ranges,
+                fill_defaults: dict[int, Datum] | None = None
+                ) -> ColumnBatch:
+    """Extend a cached batch with rows whose handle > batch.max_handle —
+    the append-only fast path of the columnar cache. A write workload of
+    pure inserts repacks only the delta instead of the whole table
+    (round-2 weak #4: full repack per data version lost HBM residency).
+
+    Returns `batch` itself when there is no delta (device planes stay
+    warm), else a NEW batch with planes copied + extended; string columns
+    merge dictionaries with old codes remapped."""
+    after = getattr(batch, "max_handle", I64_MIN)
+    lo = tc.encode_row_key(table_id, after + 1)
+    clipped = [type(rg)(max(rg.start, lo), rg.end) for rg in ranges
+               if rg.end > lo]
+    defaults = fill_defaults or {}
+    handles, raw, valid = _scan_rows(snapshot, table_id, columns, clipped,
+                                     defaults)
+    n_new = len(handles)
+    if n_new == 0:
+        return batch
+    col_kinds = {c.column_id: column_phys_kind(c) for c in columns}
+    n_old = batch.n_rows
+    n = n_old + n_new
+    cap = bucket_capacity(n)
+    h = np.full(cap, I64_MIN, dtype=np.int64)
+    h[:n_old] = batch.handles[:n_old]
+    h[n_old:n] = handles
+    cols: dict[int, ColumnData] = {}
+    for c in columns:
+        cid = c.column_id
+        kind = col_kinds[cid]
+        old = batch.columns[cid]
+        va = np.zeros(cap, dtype=bool)
+        va[:n_old] = old.valid[:n_old]
+        va[n_old:n] = valid[cid]
+        if kind == K_STR:
+            new_vals = [v if ok else None
+                        for v, ok in zip(raw[cid], valid[cid])]
+            merged = sorted(set(old.dictionary)
+                            | {v for v in new_vals if v is not None})
+            code_of = {b: i for i, b in enumerate(merged)}
+            codes = np.full(cap, -1, dtype=np.int64)
+            if old.dictionary:
+                remap = np.array([code_of[b] for b in old.dictionary],
+                                 dtype=np.int64)
+                oc = old.values[:n_old]
+                codes[:n_old] = np.where(old.valid[:n_old],
+                                         remap[np.clip(oc, 0, None)], -1)
+            codes[n_old:n] = [code_of[v] if v is not None else -1
+                              for v in new_vals]
+            cols[cid] = ColumnData(K_STR, codes, va, merged, tp=c.tp)
+        else:
+            dtype = np.int64 if kind == K_I64 else np.float64
+            vals = np.zeros(cap, dtype=dtype)
+            vals[:n_old] = old.values[:n_old]
+            src = raw[cid]
+            if isinstance(src, np.ndarray):
+                vals[n_old:n] = src[:n_new]
+            else:
+                vals[n_old:n] = [x if ok else 0
+                                 for x, ok in zip(src, valid[cid])]
+            cols[cid] = ColumnData(kind, vals, va, tp=c.tp)
+    out = ColumnBatch(n, cap, h, cols)
+    out.max_handle = max(after, int(max(handles)))
+    return out
 
 
 def pack_index_ranges(snapshot, index_info, ranges) -> ColumnBatch:

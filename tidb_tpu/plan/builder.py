@@ -117,6 +117,9 @@ class PlanBuilder:
         raise errors.PlanError(f"unsupported FROM node {type(node)}")
 
     def build_join(self, jn: ast.Join) -> Plan:
+        reordered = self._try_reorder_joins(jn)
+        if reordered is not None:
+            return reordered
         left = self.build_table_ref(jn.left)
         if jn.right is None:
             return left
@@ -148,6 +151,99 @@ class PlanBuilder:
             proj.set_schema(schema)
             return proj
         return join
+
+    # ---- join reorder (plan/join_reorder.go: greedy by estimated size) --
+
+    def _flatten_inner_chain(self, node, factors: list, ons: list) -> bool:
+        """Collect the factors of a pure inner/cross left-deep join chain;
+        False when any outer join interrupts it. Each ON is recorded with
+        the number of factors in scope at its join level, so name
+        resolution later sees exactly the tables MySQL scoping rules
+        allow (an unqualified column must not become ambiguous against
+        factors joined AFTER it)."""
+        if isinstance(node, ast.Join):
+            if node.right is None:
+                return self._flatten_inner_chain(node.left, factors, ons)
+            if node.tp not in ("cross", "inner"):
+                return False
+            if not self._flatten_inner_chain(node.left, factors, ons):
+                return False
+            factors.append(node.right)  # right side is always a factor
+            if node.on is not None:
+                ons.append((node.on, len(factors)))
+            return True
+        factors.append(node)
+        return True
+
+    def _estimate_factor_rows(self, p: Plan) -> float:
+        from tidb_tpu import statistics
+        if not isinstance(p, DataSource):
+            return float(statistics.PSEUDO_ROW_COUNT)
+        fn = getattr(self.ctx, "stats_for", None)
+        if fn is None:
+            return float(statistics.PSEUDO_ROW_COUNT)
+        st = fn(p.table_info.id)
+        return float(st.count) if st.count > 0 \
+            else float(statistics.PSEUDO_ROW_COUNT)
+
+    def _try_reorder_joins(self, jn: ast.Join) -> Plan | None:
+        """Reorder a pure inner/cross join chain LARGEST-first: the
+        physical hash join builds its table on the RIGHT child, so a
+        left-deep descending order keeps every build side as small as the
+        stats allow (join_reorder.go orders by estimated cardinality).
+        Returns None (normal path) when the chain is impure or stats give
+        no reason to move anything."""
+        factors: list = []
+        ons: list = []
+        if not self._flatten_inner_chain(jn, factors, ons) \
+                or len(factors) < 2:
+            return None
+        plans = [self.build_table_ref(f) for f in factors]
+        est = [self._estimate_factor_rows(p) for p in plans]
+        order = sorted(range(len(plans)), key=lambda i: (-est[i], i))
+        cur = plans[order[0]]
+        for idx in order[1:]:
+            right = plans[idx]
+            join = Join(Join.INNER)
+            join.add_child(cur)
+            join.add_child(right)
+            join._left_width = len(cur.schema)
+            join.set_schema(Schema([c.clone() for c in cur.schema]
+                                   + [c.clone() for c in right.schema]))
+            cur = join
+        # top-join slot range of each factor (consecutive, in `order`)
+        offsets = {}
+        off = 0
+        for idx in order:
+            offsets[idx] = off
+            off += len(plans[idx].schema)
+
+        def factor_cols(i: int):
+            return cur.schema.columns[offsets[i]:offsets[i]
+                                      + len(plans[i].schema)]
+
+        # each ON resolves against only the factors in scope at ITS join
+        # level (syntax order) — flattening must not make previously
+        # unambiguous unqualified columns ambiguous
+        for on, n_scope in ons:
+            scope_cols = []
+            for i in range(n_scope):
+                scope_cols.extend(factor_cols(i))
+            cond = self.rewrite(on, Schema(list(scope_cols)))
+            cur.other_conditions.extend(split_cnf(cond))
+        if order == list(range(len(plans))):
+            return cur
+        # restore the declaration column order for * expansion / output.
+        # Columns must be the TOP JOIN's identities (each factor occupies
+        # the consecutive slot range its position in `order` dictates) —
+        # factor-scope clones would resolve to the wrong side.
+        orig_cols = []
+        for i in range(len(plans)):  # syntax order
+            orig_cols.extend(factor_cols(i))
+        proj = Projection([c.clone() for c in orig_cols])
+        proj.add_child(cur)
+        proj.set_schema(Schema([c.clone() for c in orig_cols]))
+        return proj
 
     # ---- SELECT ----
 

@@ -9,7 +9,9 @@ choice backed by ANALYZE histograms (pseudo rates before ANALYZE).
 from __future__ import annotations
 
 from tidb_tpu.plan.builder import PlanBuilder
-from tidb_tpu.plan.physical import PhysicalContext, to_physical
+from tidb_tpu.plan.physical import (
+    PhysicalContext, eliminate_projections, to_physical,
+)
 from tidb_tpu.plan.plans import (
     Deallocate, Delete, Execute, ExplainPlan, Insert, Plan, Prepare,
     Selection, ShowPlan, SimplePlan, Update,
@@ -46,4 +48,4 @@ def optimize_plan(p: Plan, ctx, client, dirty_table_ids=None) -> Plan:
     resolve_indices(p)
     phys_ctx = PhysicalContext(client, set(dirty_table_ids or ()),
                                stats_fn=getattr(ctx, "stats_for", None))
-    return to_physical(p, phys_ctx)
+    return eliminate_projections(to_physical(p, phys_ctx))

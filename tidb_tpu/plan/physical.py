@@ -454,3 +454,43 @@ def _push_limit(child: Plan, n: int) -> None:
     if scan is not None and not scan.aggregated_push_down \
             and not scan.conditions and not scan.topn_pb:
         scan.limit = n if scan.limit is None else min(scan.limit, n)
+
+
+# ---------------------------------------------------------------------------
+# projection elimination (plan/eliminate_projection.go)
+# ---------------------------------------------------------------------------
+
+def _is_identity_projection(p: Plan) -> bool:
+    """A projection whose exprs map child slot i → output slot i for every
+    column is a no-op at runtime (indices already resolved); it only
+    renames. Such nodes arise from derived-table aliases, join-order
+    restoration, and wildcard re-exposure after pruning."""
+    if not isinstance(p, PhysicalProjection) or len(p.children) != 1:
+        return False
+    child_schema = p.child.schema
+    if len(p.exprs) != len(child_schema):
+        return False
+    return all(isinstance(e, Column) and e.index == i
+               for i, e in enumerate(p.exprs))
+
+
+def eliminate_projections(p: Plan) -> Plan:
+    """Splice identity projections out of the physical tree. The ROOT node
+    is never removed (its schema names the resultset) — only children are
+    replaced, so calling this on the root keeps it intact."""
+    if isinstance(p, ExplainPlan):
+        p.target = eliminate_projections(p.target)
+        return p
+    for i, c in enumerate(p.children):
+        c = eliminate_projections(c)
+        while _is_identity_projection(c):
+            c = c.child
+        p.children[i] = c
+    if isinstance(p, PhysicalApply):
+        inner = eliminate_projections(p.inner_plan)
+        while _is_identity_projection(inner):
+            inner = inner.child
+        p.inner_plan = inner
+    if isinstance(p, Insert) and p.select_plan is not None:
+        p.select_plan = p.children[0]
+    return p
