@@ -1,0 +1,221 @@
+"""LOAD DATA, CLI daemon, and workload harness tests.
+
+Mirrors: executor/executor_write.go LoadData + server/conn.go:507
+(LOCAL streaming), tidb-server/main.go flags, cmd/benchdb / cmd/benchkv.
+"""
+
+import os
+import tempfile
+
+import pytest
+
+from tidb_tpu import errors
+from tidb_tpu.server import Client, Server
+from tidb_tpu.session import Session, new_store
+from tests.testkit import TestKit, _store_id
+
+
+def _write(content: str) -> str:
+    fd, path = tempfile.mkstemp()
+    with os.fdopen(fd, "w") as f:
+        f.write(content)
+    return path
+
+
+class TestLoadData:
+    def test_tab_separated_with_nulls(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int, b varchar(20), c double)")
+        path = _write("1\thello\t1.5\n2\t\\N\t2.5\n")
+        try:
+            tk.exec(f"load data infile '{path}' into table t")
+            assert tk.session.vars.affected_rows == 2
+            tk.exec("select * from t order by a").check(
+                [[1, "hello", 1.5], [2, None, 2.5]])
+        finally:
+            os.unlink(path)
+
+    def test_csv_options_ignore_and_columns(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int, b varchar(8), c int)")
+        path = _write('skip me\n"1","x"\n"2","y"\n')
+        try:
+            tk.exec(f"load data infile '{path}' into table t "
+                    "fields terminated by ',' enclosed by '\"' "
+                    "lines terminated by '\\n' ignore 1 lines (a, b)")
+            tk.exec("select * from t order by a").check(
+                [[1, "x", None], [2, "y", None]])
+        finally:
+            os.unlink(path)
+
+    def test_missing_file_errors(self):
+        tk = TestKit()
+        tk.exec("create database d; use d; create table t (a int)")
+        with pytest.raises(errors.TiDBError):
+            tk.exec("load data infile '/no/such/file' into table t")
+
+    def test_local_infile_over_the_wire(self):
+        store = new_store(f"memory://ldw{next(_store_id)}")
+        srv = Server(store)
+        srv.start()
+        path = _write("5\tfive\n6\tsix\n")
+        try:
+            c = Client("127.0.0.1", srv.port, local_infile=True)
+            c.query("create database d; use d; "
+                    "create table t (a int, b varchar(8))")
+            r = c.query(f"load data local infile '{path}' into table t")
+            assert r[0].affected == 2
+            assert c.query("select * from t order by a")[0].rows == \
+                [["5", "five"], ["6", "six"]]
+            c.close()
+        finally:
+            os.unlink(path)
+            srv.close()
+
+    def test_local_infile_requires_capability(self):
+        """A client that didn't negotiate CLIENT_LOCAL_FILES gets
+        ER_NOT_ALLOWED_COMMAND, not a hanging 0xFB exchange."""
+        from tidb_tpu.server import MySQLError
+        store = new_store(f"memory://ldw{next(_store_id)}")
+        srv = Server(store)
+        srv.start()
+        try:
+            c = Client("127.0.0.1", srv.port)  # no local_infile opt-in
+            c.query("create database d; use d; create table t (a int)")
+            with pytest.raises(MySQLError) as ei:
+                c.query("load data local infile '/tmp/x' into table t")
+            assert ei.value.code == 1148
+            assert c.query("select 1")[0].rows == [["1"]]  # still in sync
+            c.close()
+        finally:
+            srv.close()
+
+    def test_local_infile_missing_file_raises_client_side(self):
+        from tidb_tpu.server import MySQLError
+        store = new_store(f"memory://ldw{next(_store_id)}")
+        srv = Server(store)
+        srv.start()
+        try:
+            c = Client("127.0.0.1", srv.port, local_infile=True)
+            c.query("create database d; use d; create table t (a int)")
+            with pytest.raises(MySQLError):
+                c.query("load data local infile '/no/such/f' into table t")
+            c.close()
+        finally:
+            srv.close()
+
+    def test_non_local_denied_for_authenticated_users(self):
+        from tidb_tpu.server import MySQLError
+        store = new_store(f"memory://ldw{next(_store_id)}")
+        srv = Server(store)
+        srv.start()
+        path = _write("1\n")
+        try:
+            c = Client("127.0.0.1", srv.port)
+            c.query("create database d; use d; create table t (a int)")
+            with pytest.raises(MySQLError):  # server file read blocked
+                c.query(f"load data infile '{path}' into table t")
+            c.close()
+        finally:
+            os.unlink(path)
+            srv.close()
+
+    def test_enclosed_field_with_embedded_terminator(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a varchar(16), b int)")
+        path = _write('"a,b",2\n"x",3\n')
+        try:
+            tk.exec(f"load data infile '{path}' into table t "
+                    "fields terminated by ',' enclosed by '\"'")
+            tk.exec("select * from t order by b").check(
+                [["a,b", 2], ["x", 3]])
+        finally:
+            os.unlink(path)
+
+    def test_escaped_backslash_then_n_stays_literal(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a varchar(16))")
+        path = _write("a\\\\nb\n")  # file holds: a \ \ n b
+        try:
+            tk.exec(f"load data infile '{path}' into table t")
+            got = tk.exec("select a from t").rows[0][0]
+            got = got if isinstance(got, str) else got.decode()
+            assert got == "a\\nb"  # literal backslash + n, NOT newline
+        finally:
+            os.unlink(path)
+
+    def test_load_error_rolls_back_partial_rows(self):
+        tk = TestKit()
+        tk.exec("create database d; use d")
+        tk.exec("create table t (a int not null)")
+        path = _write("1\n2\n\\N\n")  # third row violates NOT NULL
+        try:
+            with pytest.raises(errors.TiDBError):
+                tk.exec(f"load data infile '{path}' into table t")
+            tk.exec("insert into t values (9)")  # next autocommit stmt
+            tk.exec("select * from t").check([[9]])  # no partial rows
+        finally:
+            os.unlink(path)
+
+    def test_load_requires_insert_priv(self):
+        tk = TestKit()
+        tk.exec("create database d; use d; create table t (a int)")
+        tk.exec("create user 'ld1'")
+        tk.exec("grant select on d.* to 'ld1'")
+        path = _write("1\n")
+        try:
+            s = Session(tk.store)
+            s.vars.user = "ld1"
+            s.vars.current_db = "d"
+            from tidb_tpu.privilege import AccessDenied
+            with pytest.raises(AccessDenied):
+                s.execute(f"load data infile '{path}' into table t")
+        finally:
+            os.unlink(path)
+
+
+class TestCLI:
+    def test_daemon_serves_wire_protocol(self):
+        from tidb_tpu.cli import build_arg_parser, open_store
+        args = build_arg_parser().parse_args(
+            ["--store", "memory", "--path", f"cli{next(_store_id)}",
+             "--port", "0"])
+        store = open_store(args)
+        srv = Server(store, host=args.host, port=args.port,
+                     token_limit=args.token_limit)
+        srv.start()
+        try:
+            c = Client("127.0.0.1", srv.port)
+            c.query("select 1")
+            c.close()
+        finally:
+            srv.close()
+
+    def test_tpu_copr_flag_installs_engine(self):
+        from tidb_tpu.cli import build_arg_parser, open_store
+        from tidb_tpu.ops import TpuClient
+        args = build_arg_parser().parse_args(
+            ["--store", "memory", "--path", f"cli{next(_store_id)}",
+             "--copr", "tpu"])
+        store = open_store(args)
+        assert isinstance(store.get_client(), TpuClient)
+
+
+class TestHarnesses:
+    def test_benchdb_jobs(self, capsys):
+        from tidb_tpu.cmd.benchdb import main
+        assert main(["--store", f"memory://bd{next(_store_id)}",
+                     "--run", "create,insert:0_200,select:0_200:2,"
+                     "update-range:10_20:2,truncate,gc"]) == 0
+        out = capsys.readouterr().out
+        assert "insert:0_200" in out and "gc" in out
+
+    def test_benchkv_commits_all_keys(self, capsys):
+        from tidb_tpu.cmd.benchkv import main
+        assert main(["--store", f"memory://bk{next(_store_id)}",
+                     "-N", "2000", "-C", "4"]) == 0
+        assert "2000 keys committed, 0 failed" in capsys.readouterr().out

@@ -1,0 +1,129 @@
+"""tidb-tpu server daemon + interactive shell.
+
+Reference: tidb-server/main.go:44-62 — flags for store engine/path, ports,
+and runtime toggles; the process serves the MySQL wire protocol until
+interrupted. `--repl` additionally runs an interactive SQL shell on the
+same store (the reference ships no shell, but a CLI is the zero-dependency
+way to poke a running engine; mysql-client compatible via the server).
+
+Run:  python -m tidb_tpu.cli --store memory --port 4000
+      python -m tidb_tpu.cli --repl            (shell only, no listener)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tidb-tpu",
+        description="TPU-native MySQL-compatible SQL engine")
+    ap.add_argument("--store", default="memory",
+                    choices=["memory", "local", "cluster"],
+                    help="storage engine (tidb-server -store)")
+    ap.add_argument("--path", default="tidb",
+                    help="storage path / cluster spec (-path)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("-P", "--port", type=int, default=4000)
+    ap.add_argument("--token-limit", type=int, default=100,
+                    help="max concurrent connections (tokenlimiter.go)")
+    ap.add_argument("--copr", default="cpu", choices=["cpu", "tpu"],
+                    help="coprocessor engine backend")
+    ap.add_argument("--repl", action="store_true",
+                    help="interactive SQL shell instead of serving")
+    return ap
+
+
+def open_store(args):
+    from tidb_tpu.session import new_store
+    url = f"{args.store}://{args.path}"
+    store = new_store(url)
+    if args.copr == "tpu":
+        from tidb_tpu.ops import TpuClient
+        store.set_client(TpuClient(store))
+    return store
+
+
+def repl(store) -> int:
+    from tidb_tpu import errors
+    from tidb_tpu.session import Session
+    s = Session(store)
+    print("tidb-tpu shell; end statements with ';', exit with \\q")
+    buf = ""
+    while True:
+        try:
+            prompt = "tidb> " if not buf else "   -> "
+            line = input(prompt)
+        except EOFError:
+            print()
+            return 0
+        if line.strip() in ("\\q", "exit", "quit"):
+            return 0
+        buf += line + "\n"
+        if ";" not in line:
+            continue
+        sql, buf = buf, ""
+        t0 = time.time()
+        try:
+            results = s.execute(sql)
+        except errors.TiDBError as e:
+            print(f"ERROR {getattr(e, 'code', 0)}: {e}")
+            continue
+        for rs in results:
+            names = rs.field_names()
+            rows = [[_cell(v) for v in row] for row in rs.values()]
+            _print_table(names, rows)
+        n = (len(results[-1].rows) if results
+             else s.vars.affected_rows)
+        kind = "rows in set" if results else "rows affected"
+        print(f"{n} {kind} ({time.time() - t0:.2f} sec)\n")
+
+
+def _cell(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return str(v)
+
+
+def _print_table(names, rows) -> None:
+    widths = [len(n) for n in names]
+    for row in rows:
+        for i, v in enumerate(row):
+            widths[i] = max(widths[i], len(v))
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    print(sep)
+    print("|" + "|".join(f" {n:<{w}} " for n, w in zip(names, widths)) + "|")
+    print(sep)
+    for row in rows:
+        print("|" + "|".join(f" {v:<{w}} "
+                             for v, w in zip(row, widths)) + "|")
+    print(sep)
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    store = open_store(args)
+    if args.repl:
+        return repl(store)
+    from tidb_tpu.server import Server
+    srv = Server(store, host=args.host, port=args.port,
+                 token_limit=args.token_limit)
+    srv.start()
+    print(f"tidb-tpu listening on {args.host}:{srv.port} "
+          f"(store={args.store}://{args.path}, copr={args.copr})",
+          file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Workload drivers (reference: cmd/benchdb, cmd/benchkv)."""

@@ -1,0 +1,160 @@
+"""benchdb: SQL workload driver, wall-clock per job.
+
+Reference: cmd/benchdb/main.go:36-50 — a comma-separated job list
+(create, truncate, insert:lo_hi, update-random:lo_hi:n,
+update-range:lo_hi:n, select:lo_hi:n, query:<sql>:n, gc) runs in order
+against a store, printing the wall time of each. The reference drives a
+live PD/TiKV cluster; here the same jobs run against any engine URL
+(memory/local/cluster) or over the wire with --addr host:port.
+
+Run:  python -m tidb_tpu.cmd.benchdb --store memory --run \
+          create,insert:0_10000,select:0_10000:10,gc
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+
+DEFAULT_JOBS = ("create,truncate,insert:0_10000,update-random:0_10000:1000,"
+                "select:0_10000:10,update-range:5000_5100:100,"
+                "select:0_10000:10,gc,select:0_10000:10")
+
+
+class _WireRunner:
+    def __init__(self, addr: str):
+        from tidb_tpu.server import Client
+        host, _, port = addr.rpartition(":")
+        self.c = Client(host or "127.0.0.1", int(port))
+        self.c.query("create database if not exists bench")
+        self.c.query("use bench")
+
+    def run(self, sql: str):
+        return self.c.query(sql)
+
+
+class _LibRunner:
+    def __init__(self, url: str):
+        from tidb_tpu.session import Session, new_store
+        self.store = new_store(url)
+        self.s = Session(self.store)
+        self.s.execute("create database if not exists bench")
+        self.s.execute("use bench")
+
+    def run(self, sql: str):
+        return self.s.execute(sql)
+
+
+class BenchDB:
+    def __init__(self, runner, table: str, batch: int, blob: int):
+        self.r = runner
+        self.table = table
+        self.batch = batch
+        self.blob_val = "x" * blob
+        self.rng = random.Random(0)
+
+    # ---- jobs (cmd/benchdb main.go job dispatch) ----
+
+    def create(self):
+        self.r.run(f"create table if not exists {self.table} "
+                   "(id bigint primary key, name varchar(32), "
+                   "exp bigint, data blob)")
+
+    def truncate(self):
+        self.r.run(f"truncate table {self.table}")
+
+    def insert(self, lo: int, hi: int):
+        ids = list(range(lo, hi))
+        for i in range(0, len(ids), self.batch):
+            chunk = ids[i:i + self.batch]
+            vals = ", ".join(f"({j}, 'name{j}', {j * 10}, "
+                             f"'{self.blob_val}')" for j in chunk)
+            self.r.run(f"insert into {self.table} values {vals}")
+
+    def update_random(self, lo: int, hi: int, n: int):
+        for i in range(0, n, self.batch):
+            stmts = []
+            for _ in range(min(self.batch, n - i)):
+                rid = self.rng.randint(lo, hi - 1)
+                stmts.append(f"update {self.table} set exp = exp + 1 "
+                             f"where id = {rid}")
+            self.r.run("; ".join(stmts))
+
+    def update_range(self, lo: int, hi: int, n: int):
+        for _ in range(n):
+            self.r.run(f"update {self.table} set exp = exp + 1 "
+                       f"where id >= {lo} and id < {hi}")
+
+    def select(self, lo: int, hi: int, n: int):
+        for _ in range(n):
+            self.r.run(f"select id, name, exp from {self.table} "
+                       f"where id >= {lo} and id < {hi}")
+
+    def query(self, sql: str, n: int):
+        for _ in range(n):
+            self.r.run(sql)
+
+    def gc(self):
+        store = getattr(self.r, "store", None)
+        if store is None:
+            return  # wire mode: GC runs inside the server's workers
+        if hasattr(store, "run_gc"):
+            store.run_gc()
+        elif hasattr(store, "compact"):
+            store.compact(max_age_ms=0)
+
+    def run_job(self, spec: str):
+        name, _, rest = spec.partition(":")
+        t0 = time.time()
+        if name == "create":
+            self.create()
+        elif name == "truncate":
+            self.truncate()
+        elif name == "insert":
+            lo, hi = rest.split("_")
+            self.insert(int(lo), int(hi))
+        elif name == "update-random":
+            rng, n = rest.split(":")
+            lo, hi = rng.split("_")
+            self.update_random(int(lo), int(hi), int(n))
+        elif name == "update-range":
+            rng, n = rest.split(":")
+            lo, hi = rng.split("_")
+            self.update_range(int(lo), int(hi), int(n))
+        elif name == "select":
+            rng, n = rest.split(":")
+            lo, hi = rng.split("_")
+            self.select(int(lo), int(hi), int(n))
+        elif name == "query":
+            sql, _, n = rest.rpartition(":")
+            self.query(sql, int(n))
+        elif name == "gc":
+            self.gc()
+        else:
+            raise SystemExit(f"unknown job {name!r}")
+        print(f"{spec}: {time.time() - t0:.3f}s")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="benchdb")
+    ap.add_argument("--store", default="memory://benchdb",
+                    help="engine URL (memory:// | local:// | cluster://N/)")
+    ap.add_argument("--addr", default="",
+                    help="host:port of a running server (wire mode)")
+    ap.add_argument("--table", default="bench_db")
+    ap.add_argument("--batch", type=int, default=100)
+    ap.add_argument("--blob", type=int, default=32)
+    ap.add_argument("--run", default=DEFAULT_JOBS)
+    args = ap.parse_args(argv)
+    runner = _WireRunner(args.addr) if args.addr else _LibRunner(args.store)
+    bench = BenchDB(runner, args.table, args.batch, args.blob)
+    for job in args.run.split(","):
+        bench.run_job(job.strip())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

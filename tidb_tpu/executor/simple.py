@@ -70,6 +70,8 @@ def execute_simple(session, stmt) -> ResultSet | None:
         return _create_user(session, stmt)
     if isinstance(stmt, ast.DropUserStmt):
         return _drop_user(session, stmt)
+    if isinstance(stmt, ast.LoadDataStmt):
+        return _load_data(session, stmt)
     raise errors.ExecError(f"unsupported statement {type(stmt).__name__}")
 
 
@@ -418,6 +420,164 @@ def _analyze(session, stmt: ast.AnalyzeTableStmt) -> None:
 
         run_in_new_txn(session.store, True, write)
         session.domain.invalidate_stats(tbl.id)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# LOAD DATA (executor/executor_write.go LoadData; server/conn.go:507)
+# ---------------------------------------------------------------------------
+
+_ESCAPE_MAP = {"n": "\n", "t": "\t", "r": "\r", "0": "\0", "b": "\b",
+               "Z": "\x1a"}
+
+
+def _unescape(s: str, esc: str) -> str:
+    """Single left-to-right scan — chained str.replace would re-interpret
+    the output of an earlier replacement (e.g. '\\\\n' → newline)."""
+    out = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == esc and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append(_ESCAPE_MAP.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _split_fields(line: str, term: str, enc: str,
+                  esc: str) -> list[str | None]:
+    """Field scanner honoring enclosure and escapes: a terminator inside an
+    enclosed field is data, not a separator (MySQL LOAD DATA semantics)."""
+    fields: list[str | None] = []
+    i, n = 0, len(line)
+    while True:
+        raw = []
+        was_enclosed = False
+        if enc and line.startswith(enc, i):
+            was_enclosed = True
+            i += len(enc)
+            while i < n:
+                if esc and line[i] == esc and i + 1 < n:
+                    raw.append(_ESCAPE_MAP.get(line[i + 1], line[i + 1]))
+                    i += 2
+                    continue
+                if line.startswith(enc, i):
+                    i += len(enc)
+                    break
+                raw.append(line[i])
+                i += 1
+            # consume up to the next terminator
+            at = line.find(term, i) if term else -1
+            i = at if at >= 0 else n
+        else:
+            end = line.find(term, i) if term else -1
+            end = end if end >= 0 else n
+            raw.append(line[i:end])
+            i = end
+        text = "".join(raw)
+        if was_enclosed:
+            fields.append(text)
+        elif esc and text == esc + "N":
+            fields.append(None)  # \N = SQL NULL
+        else:
+            fields.append(_unescape(text, esc) if esc else text)
+        if i >= n:
+            return fields
+        i += len(term)
+
+
+def parse_load_lines(data: bytes, stmt) -> list[list[str | None]]:
+    """Split file content into field lists per the FIELDS/LINES clauses."""
+    text = data.decode("utf-8", "replace")
+    term = stmt.line_term or "\n"
+    lines = text.split(term)
+    if lines and lines[-1] == "":
+        lines.pop()  # trailing terminator
+    out: list[list[str | None]] = []
+    for i, line in enumerate(lines):
+        if i < stmt.ignore_lines:
+            continue
+        if stmt.line_starting:
+            at = line.find(stmt.line_starting)
+            if at < 0:
+                continue
+            line = line[at + len(stmt.line_starting):]
+        out.append(_split_fields(line, stmt.field_term or "\t",
+                                 stmt.field_enclosed, stmt.field_escaped))
+    return out
+
+
+def load_rows(session, stmt: ast.LoadDataStmt, data: bytes) -> int:
+    """Insert parsed lines through the table write path (batched txns)."""
+    from tidb_tpu.types import datum_from_py
+    from tidb_tpu.types.convert import convert_datum
+    from tidb_tpu.types.datum import NULL as NULL_D
+    db = stmt.table.db or session.vars.current_db
+    tbl = session.info_schema().table_by_name(db, stmt.table.name)
+    info = tbl.info
+    cols = info.public_columns()
+    if stmt.columns:
+        by_name = {c.name.lower(): c for c in cols}
+        targets = []
+        for cn in stmt.columns:
+            c = by_name.get(cn.lower())
+            if c is None:
+                raise errors.UnknownFieldError(f"unknown column {cn!r}")
+            targets.append(c)
+    else:
+        targets = cols
+    rows = parse_load_lines(data, stmt)
+    n = 0
+    from tidb_tpu.table.column import check_not_null
+    try:
+        txn = session.txn()
+        for raw in rows:
+            vals = {c.id: NULL_D for c in cols}
+            for c, f in zip(targets, raw):
+                if f is None:
+                    vals[c.id] = NULL_D
+                else:
+                    vals[c.id] = convert_datum(datum_from_py(f),
+                                               c.field_type)
+            row = []
+            for c in cols:
+                check_not_null(c, vals[c.id])
+                row.append(vals[c.id])
+            tbl.add_record(txn, row)
+            n += 1
+    except Exception:
+        # same statement-failure contract as _run_plan: partial writes
+        # must not linger in the session txn to be committed later
+        if not session.vars.in_txn:
+            session.rollback_txn()
+        raise
+    session.vars.affected_rows = n
+    if not session.vars.in_txn and session.vars.autocommit:
+        session.commit_txn()
+    return n
+
+
+def _load_data(session, stmt: ast.LoadDataStmt) -> None:
+    """Library-mode LOAD DATA reads the file directly; the wire server
+    intercepts LOCAL and streams the content from the client instead
+    (conn.go:507 handleLoadData)."""
+    if session.vars.user and not stmt.local:
+        # server-side file reads from a remote connection are a file-
+        # disclosure hole (MySQL gates them behind FILE +
+        # secure_file_priv; this engine has neither, so: LOCAL only)
+        raise errors.ExecError(
+            "LOAD DATA without LOCAL is disabled for authenticated "
+            "connections; use LOAD DATA LOCAL INFILE")
+    try:
+        with open(stmt.path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise errors.ExecError(f"can't read file {stmt.path!r}: {e}")
+    load_rows(session, stmt, data)
     return None
 
 

@@ -152,6 +152,7 @@ class Parser:
             "DESC": self._parse_explain,
             "ADMIN": self._parse_admin,
             "ANALYZE": self._parse_analyze,
+            "LOAD": self._parse_load_data,
             "GRANT": self._parse_grant,
             "REVOKE": self._parse_revoke,
             "PREPARE": self._parse_prepare,
@@ -893,6 +894,53 @@ class Parser:
         while self._try_op(","):
             tables.append(self._parse_table_name())
         return ast.AnalyzeTableStmt(tables=tables)
+
+    # ================= LOAD DATA (parser.y LoadDataStmt) =================
+
+    def _parse_load_data(self) -> ast.LoadDataStmt:
+        self._expect_kw("LOAD")
+        self._expect_kw("DATA")
+        stmt = ast.LoadDataStmt()
+        stmt.local = self._try_kw("LOCAL")
+        self._expect_kw("INFILE")
+        stmt.path = self._string_lit("file path")
+        self._expect_kw("INTO")
+        self._expect_kw("TABLE")
+        stmt.table = self._parse_table_name()
+        if self._try_kw("FIELDS", "COLUMNS"):
+            while True:
+                if self._try_kw("TERMINATED"):
+                    self._expect_kw("BY")
+                    stmt.field_term = self._string_lit("terminator")
+                elif self._try_kw("ENCLOSED"):
+                    self._expect_kw("BY")
+                    stmt.field_enclosed = self._string_lit("encloser")
+                elif self._try_kw("ESCAPED"):
+                    self._expect_kw("BY")
+                    stmt.field_escaped = self._string_lit("escape")
+                else:
+                    break
+        if self._try_kw("LINES"):
+            while True:
+                if self._try_kw("TERMINATED"):
+                    self._expect_kw("BY")
+                    stmt.line_term = self._string_lit("terminator")
+                elif self._try_kw("STARTING"):
+                    self._expect_kw("BY")
+                    stmt.line_starting = self._string_lit("prefix")
+                else:
+                    break
+        if self._try_kw("IGNORE"):
+            t = self._next()
+            stmt.ignore_lines = int(t.val)
+            self._expect_kw("LINES")
+        if self._try_op("("):
+            cols = [self._ident("column name")]
+            while self._try_op(","):
+                cols.append(self._ident("column name"))
+            self._expect_op(")")
+            stmt.columns = cols
+        return stmt
 
     # ================= GRANT / REVOKE (parser.y GrantStmt) =================
 

@@ -213,6 +213,8 @@ def required_privs(stmt, current_db: str) -> list[tuple[str, str, str]]:
     elif isinstance(stmt, ast.AnalyzeTableStmt):
         for tn in stmt.tables:
             add("Select", tn)
+    elif isinstance(stmt, ast.LoadDataStmt):
+        add("Insert", stmt.table)
     elif isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt,
                            ast.CreateUserStmt, ast.DropUserStmt)):
         out.append(("Grant", "", ""))

@@ -34,10 +34,14 @@ class QueryResult:
 
 class Client:
     def __init__(self, host: str, port: int, user: str = "root",
-                 password: str = "", db: str = "", timeout: float = 10.0):
+                 password: str = "", db: str = "", timeout: float = 10.0,
+                 local_infile: bool = False):
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.pkt = PacketIO(sock)
+        # opt-in, like MySQL's local_infile: a server must not be able to
+        # exfiltrate arbitrary client files via unsolicited 0xFB requests
+        self.local_infile = local_infile
         try:
             self._handshake(user, password, db)
         except BaseException:
@@ -74,6 +78,8 @@ class Client:
                  | p.CLIENT_SECURE_CONNECTION | p.CLIENT_TRANSACTIONS
                  | p.CLIENT_MULTI_STATEMENTS | p.CLIENT_MULTI_RESULTS
                  | p.CLIENT_PLUGIN_AUTH)
+        if self.local_infile:
+            flags |= p.CLIENT_LOCAL_FILES
         if db:
             flags |= p.CLIENT_CONNECT_WITH_DB
         token = p.scramble_password(password, salt)
@@ -105,6 +111,30 @@ class Client:
         first = self.pkt.read_packet()
         if first[0] == 0xFF:
             raise self._as_error(first)
+        if first[0] == 0xFB:
+            # LOCAL INFILE request: stream the named file, empty packet
+            # terminates, then the real response follows
+            path = first[1:].decode()
+            read_err: OSError | None = None
+            if self.local_infile:
+                try:
+                    with open(path, "rb") as f:
+                        while True:
+                            chunk = f.read(1 << 20)
+                            if not chunk:
+                                break
+                            self.pkt.write_packet(chunk)
+                except OSError as e:
+                    read_err = e
+            self.pkt.write_packet(b"")   # protocol requires the terminator
+            result = self._read_result()
+            if not self.local_infile:
+                raise MySQLError(
+                    2068, "LOAD DATA LOCAL INFILE is disabled on this "
+                    "client (pass local_infile=True)")
+            if read_err is not None:
+                raise MySQLError(2, f"can't read {path!r}: {read_err}")
+            return result
         if first[0] == 0x00:
             affected, pos = p.read_lenenc_int(first, 1)
             insert_id, pos = p.read_lenenc_int(first, pos)

@@ -169,9 +169,13 @@ class ClientConnection:
                 my.ErrParse, "multi-statement disabled "
                 "(CLIENT_MULTI_STATEMENTS not set)", "42000"))
             return
+        from tidb_tpu import sqlast as ast
         for i, stmt in enumerate(stmts):
-            rs = self.session.execute_stmt(stmt, stmt.text or sql)
             more = i + 1 < len(stmts)
+            if isinstance(stmt, ast.LoadDataStmt) and stmt.local:
+                self.handle_load_data_local(stmt, more)
+                continue
+            rs = self.session.execute_stmt(stmt, stmt.text or sql)
             if rs is None:
                 st = self._status() | (p.SERVER_MORE_RESULTS_EXISTS
                                        if more else 0)
@@ -180,6 +184,33 @@ class ClientConnection:
                     insert_id=self.session.vars.last_insert_id, status=st))
             else:
                 self.write_resultset(rs, more)
+
+    def handle_load_data_local(self, stmt, more: bool) -> None:
+        """LOAD DATA LOCAL INFILE: ask the client for the file content
+        (0xFB + filename), stream packets until the empty terminator, then
+        run the insert (conn.go:507 handleLoadData)."""
+        from tidb_tpu import privilege
+        from tidb_tpu.executor.simple import load_rows
+        if not (self.capability & p.CLIENT_LOCAL_FILES):
+            # a client that didn't negotiate LOCAL INFILE will never send
+            # file packets — emitting 0xFB would desync the connection
+            # (MySQL: ER_NOT_ALLOWED_COMMAND)
+            self.pkt.write_packet(p.err_packet(
+                1148, "The used command is not allowed with this "
+                "MySQL version", "42000"))
+            return
+        if self.session.vars.user:
+            privilege.check_stmt(self.session, stmt)
+        self.pkt.write_packet(b"\xfb" + stmt.path.encode())
+        chunks: list[bytes] = []
+        while True:
+            data = self.pkt.read_packet()
+            if not data:
+                break
+            chunks.append(data)
+        n = load_rows(self.session, stmt, b"".join(chunks))
+        st = self._status() | (p.SERVER_MORE_RESULTS_EXISTS if more else 0)
+        self.pkt.write_packet(p.ok_packet(affected=n, status=st))
 
     def write_resultset(self, rs, more: bool) -> None:
         status = self._status() | (p.SERVER_MORE_RESULTS_EXISTS if more

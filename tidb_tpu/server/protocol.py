@@ -24,6 +24,7 @@ CLIENT_FOUND_ROWS = 1 << 1
 CLIENT_LONG_FLAG = 1 << 2
 CLIENT_CONNECT_WITH_DB = 1 << 3
 CLIENT_NO_SCHEMA = 1 << 4
+CLIENT_LOCAL_FILES = 1 << 7
 CLIENT_PROTOCOL_41 = 1 << 9
 CLIENT_TRANSACTIONS = 1 << 13
 CLIENT_SECURE_CONNECTION = 1 << 15
@@ -36,6 +37,7 @@ SERVER_CAPABILITIES = (
     CLIENT_LONG_PASSWORD | CLIENT_LONG_FLAG | CLIENT_CONNECT_WITH_DB
     | CLIENT_PROTOCOL_41 | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION
     | CLIENT_MULTI_STATEMENTS | CLIENT_MULTI_RESULTS | CLIENT_PLUGIN_AUTH
+    | CLIENT_LOCAL_FILES
 )
 
 # ---- status flags ----

@@ -472,7 +472,7 @@ def _is_simple(stmt) -> bool:
         ast.CreateTableStmt, ast.DropTableStmt, ast.TruncateTableStmt,
         ast.CreateIndexStmt, ast.DropIndexStmt, ast.AlterTableStmt,
         ast.AdminStmt, ast.AnalyzeTableStmt, ast.GrantStmt, ast.RevokeStmt,
-        ast.CreateUserStmt, ast.DropUserStmt))
+        ast.CreateUserStmt, ast.DropUserStmt, ast.LoadDataStmt))
 
 
 # ---------------------------------------------------------------------------

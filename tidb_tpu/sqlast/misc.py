@@ -123,6 +123,22 @@ class DropUserStmt(StmtNode):
 
 
 @dataclass
+class LoadDataStmt(StmtNode):
+    """LOAD DATA [LOCAL] INFILE 'file' INTO TABLE t ... (ast/dml.go
+    LoadDataStmt). fields/lines options mirror FieldsClause/LinesClause."""
+    path: str = ""
+    local: bool = False
+    table: TableName = None  # type: ignore[assignment]
+    columns: list[str] = field(default_factory=list)
+    field_term: str = "\t"
+    field_enclosed: str = ""
+    field_escaped: str = "\\"
+    line_term: str = "\n"
+    line_starting: str = ""
+    ignore_lines: int = 0
+
+
+@dataclass
 class AnalyzeTableStmt(StmtNode):
     """ANALYZE TABLE t1 [, t2] — builds column histograms
     (ast/stats.go AnalyzeTableStmt; executor/executor_simple.go:253)."""
