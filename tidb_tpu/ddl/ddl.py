@@ -55,6 +55,15 @@ class DDL:
 
     # ================= public API (ddl/ddl.go DDL interface) =================
 
+    @staticmethod
+    def _check_not_virtual(db) -> None:
+        """Virtual schemas (performance_schema, reserved negative ids) have
+        no meta representation — DDL against them must error, not queue a
+        job that silently no-ops."""
+        if db is not None and db.id < 0:
+            raise errors.ExecError(
+                f"DDL is not allowed on system database '{db.name}'")
+
     def create_schema(self, name: str) -> None:
         schema = self.handle.get()
         if schema.schema_exists(name):
@@ -67,6 +76,7 @@ class DDL:
         db = schema.schema_by_name(name)
         if db is None:
             raise errors.BadDBError(f"Can't drop database '{name}'; database doesn't exist")
+        self._check_not_virtual(db)
         job = self._new_job(ActionType.DROP_SCHEMA, db.id, 0, [])
         self._run_job(job)
 
@@ -76,6 +86,7 @@ class DDL:
         db = schema.schema_by_name(db_name)
         if db is None:
             raise errors.BadDBError(f"Unknown database '{db_name}'")
+        self._check_not_virtual(db)
         if schema.table_exists(db_name, table_name):
             raise errors.TableExistsError(f"Table '{table_name}' already exists")
         tbl_json = self._build_table_info(table_name, cols, indexes).to_json()
@@ -86,6 +97,7 @@ class DDL:
         schema = self.handle.get()
         tbl = schema.table_by_name(db_name, table_name)
         db = schema.schema_by_name(db_name)
+        self._check_not_virtual(db)
         job = self._new_job(ActionType.DROP_TABLE, db.id, tbl.id, [])
         self._run_job(job)
 
@@ -93,6 +105,7 @@ class DDL:
         schema = self.handle.get()
         tbl = schema.table_by_name(db_name, table_name)
         db = schema.schema_by_name(db_name)
+        self._check_not_virtual(db)
         job = self._new_job(ActionType.TRUNCATE_TABLE, db.id, tbl.id, [])
         self._run_job(job)
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 from tidb_tpu import errors
 from tidb_tpu.executor import executors as ex
 from tidb_tpu.executor.distsql_exec import (
+    MemTableExec,
     UnionScanExec, XSelectIndexExec, XSelectTableExec,
 )
 from tidb_tpu.executor.write import DeleteExec, InsertExec, UpdateExec
@@ -23,7 +24,10 @@ class ExecutorBuilder:
 
     def build(self, p: pl.Plan) -> ex.Executor:
         if isinstance(p, pl.PhysicalTableScan):
-            scan = XSelectTableExec(p, self.ctx)
+            if getattr(p, "virtual", False):
+                scan = MemTableExec(p)
+            else:
+                scan = XSelectTableExec(p, self.ctx)
             if p.conditions:
                 return ex.SelectionExec(scan, p.conditions)
             return scan

@@ -98,6 +98,26 @@ def _scan_pb_columns(scan) -> list[PBColumnInfo]:
             for c in scan.schema]
 
 
+class MemTableExec(Executor):
+    """Scan over a virtual (in-memory) table — performance_schema rows
+    never live in KV (infoschema/tables.go virtual table pattern)."""
+
+    def __init__(self, scan: PhysicalTableScan):
+        self.scan_plan = scan
+        self.schema = scan.schema
+        self._iter = None
+
+    def next(self):
+        if self._iter is None:
+            info = self.scan_plan.table_info
+            slot = {c.id: i for i, c in enumerate(info.public_columns())}
+            picks = [slot[c.col_id] for c in self.schema]
+            self._iter = iter(
+                [ [row[i] for i in picks]
+                  for _h, row in self.scan_plan.table.iter_records(None) ])
+        return next(self._iter, None)
+
+
 class XSelectTableExec(Executor):
     """Reference: executor/executor_distsql.go:733."""
 

@@ -30,6 +30,20 @@ class InfoSchema:
                 t = Table(ti, store=store, db_id=db_id)
                 self._tbl_by_name[(db.name.lower(), ti.name.lower())] = t
                 self._tbl_by_id[ti.id] = t
+        if store is not None:
+            self._attach_perfschema(store)
+
+    def _attach_perfschema(self, store) -> None:
+        """Virtual performance_schema tables (perfschema/init.go:205);
+        reserved negative ids keep them off the KV/meta paths."""
+        from tidb_tpu import perfschema as ps
+        db = DBInfo(id=ps.DB_ID, name="performance_schema")
+        self._db_by_name[db.name] = db
+        self._db_by_id[db.id] = db
+        for ti in ps.table_infos():
+            vt = ps.VirtualTable(ti, store)
+            self._tbl_by_name[(db.name, ti.name.lower())] = vt
+            self._tbl_by_id[ti.id] = vt
 
     # ---- lookups ----
     def schema_by_name(self, name: str) -> DBInfo | None:

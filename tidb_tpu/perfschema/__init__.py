@@ -1,0 +1,196 @@
+"""performance_schema: bounded in-memory statement instrumentation,
+queryable through the normal SQL path as virtual tables.
+
+Reference: perfschema/init.go:205 (table definitions),
+perfschema/perfschema.go:32-50 (StartStatement/EndStatement hooks wired
+around each Execute at session.go:454-459). Here a per-store PerfSchema
+keeps a fixed-capacity ring of statement events; the infoschema snapshot
+attaches the virtual `performance_schema` database whose tables read from
+it, so `select * from performance_schema.events_statements_history` runs
+through the regular planner with SQL-side filtering (no KV, no pushdown).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.model import ColumnInfo, TableInfo
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL
+from tidb_tpu.types.field_type import FieldType
+
+# reserved negative ids: never collide with meta's allocator and never
+# reach the KV layer (the planner routes virtual scans to MemTableExec)
+DB_ID = -100
+T_STMT_CURRENT = -101
+T_STMT_HISTORY = -102
+T_INSTRUMENTS = -103
+
+HISTORY_CAP = 1024  # stmtEventsHistoryElemMax-style bound
+
+
+def _col(i: int, name: str, tp: int, flen: int = 64) -> ColumnInfo:
+    return ColumnInfo(id=i + 1, name=name, offset=i,
+                      field_type=FieldType(tp, 0, flen, -1))
+
+
+_STMT_COLS = [
+    ("THREAD_ID", my.TypeLonglong), ("EVENT_ID", my.TypeLonglong),
+    ("EVENT_NAME", my.TypeVarchar), ("SQL_TEXT", my.TypeBlob),
+    ("TIMER_START", my.TypeLonglong), ("TIMER_END", my.TypeLonglong),
+    ("TIMER_WAIT", my.TypeLonglong), ("ROWS_SENT", my.TypeLonglong),
+    ("ROWS_AFFECTED", my.TypeLonglong), ("ERRORS", my.TypeLonglong),
+    ("MESSAGE_TEXT", my.TypeVarchar),
+]
+
+
+def _stmt_table(tid: int, name: str) -> TableInfo:
+    return TableInfo(id=tid, name=name,
+                     columns=[_col(i, n, tp)
+                              for i, (n, tp) in enumerate(_STMT_COLS)])
+
+
+def table_infos() -> list[TableInfo]:
+    return [
+        _stmt_table(T_STMT_CURRENT, "events_statements_current"),
+        _stmt_table(T_STMT_HISTORY, "events_statements_history"),
+        TableInfo(id=T_INSTRUMENTS, name="setup_instruments", columns=[
+            _col(0, "NAME", my.TypeVarchar, 128),
+            _col(1, "ENABLED", my.TypeVarchar, 4),
+            _col(2, "TIMED", my.TypeVarchar, 4),
+        ]),
+    ]
+
+
+class StatementEvent:
+    __slots__ = ("thread_id", "event_id", "name", "sql_text", "t_start",
+                 "t_end", "rows_sent", "rows_affected", "errors", "message")
+
+    def __init__(self, thread_id: int, event_id: int, sql_text: str):
+        self.thread_id = thread_id
+        self.event_id = event_id
+        self.name = "statement/sql/execute"
+        self.sql_text = sql_text[:1024]
+        self.t_start = time.perf_counter_ns()
+        self.t_end = 0
+        self.rows_sent = 0
+        self.rows_affected = 0
+        self.errors = 0
+        self.message = ""
+
+    def row(self) -> list[Datum]:
+        wait = max(0, self.t_end - self.t_start) if self.t_end else 0
+        return [Datum.i64(self.thread_id), Datum.i64(self.event_id),
+                Datum.bytes_(self.name.encode()),
+                Datum.bytes_(self.sql_text.encode()),
+                Datum.i64(self.t_start), Datum.i64(self.t_end),
+                Datum.i64(wait), Datum.i64(self.rows_sent),
+                Datum.i64(self.rows_affected), Datum.i64(self.errors),
+                Datum.bytes_(self.message.encode()) if self.message
+                else NULL]
+
+
+CURRENT_CAP = 512  # bounded like the history ring: threads come and go
+
+
+class PerfSchema:
+    """Per-store statement event store (perfschema.statementStmts)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._event_ids = itertools.count(1)
+        self._history: deque[StatementEvent] = deque(maxlen=HISTORY_CAP)
+        # latest statement per thread (MySQL keeps completed statements in
+        # *_current until the thread's next one), LRU-bounded
+        self._current: "OrderedDict[int, StatementEvent]" = OrderedDict()
+        self.enabled = True
+
+    def start_statement(self, thread_id: int,
+                        sql_text: str) -> StatementEvent | None:
+        if not self.enabled:
+            return None
+        ev = StatementEvent(thread_id, next(self._event_ids), sql_text)
+        with self._lock:
+            self._current[thread_id] = ev
+            self._current.move_to_end(thread_id)
+            while len(self._current) > CURRENT_CAP:
+                self._current.popitem(last=False)
+        return ev
+
+    def end_statement(self, ev: StatementEvent | None, rows_sent: int = 0,
+                      rows_affected: int = 0, error: str = "") -> None:
+        if ev is None:
+            return
+        # mutate + publish under the lock: rows() may be rendering this
+        # very event through _current concurrently
+        with self._lock:
+            ev.t_end = time.perf_counter_ns()
+            ev.rows_sent = rows_sent
+            ev.rows_affected = rows_affected
+            if error:
+                ev.errors = 1
+                ev.message = error
+            self._history.append(ev)
+
+    # ---- virtual-table row providers ----
+
+    def rows(self, table_id: int) -> list[list[Datum]]:
+        if table_id == T_STMT_CURRENT:
+            with self._lock:  # render under the lock: no torn rows
+                return [e.row() for e in self._current.values()]
+        if table_id == T_STMT_HISTORY:
+            with self._lock:
+                return [e.row() for e in self._history]
+        if table_id == T_INSTRUMENTS:
+            on = b"YES" if self.enabled else b"NO"
+            return [[Datum.bytes_(b"statement/sql/execute"),
+                     Datum.bytes_(on), Datum.bytes_(b"YES")]]
+        return []
+
+
+_schemas: "OrderedDict[str, PerfSchema]" = OrderedDict()
+_schemas_lock = threading.Lock()
+
+
+def perf_for(store) -> PerfSchema:
+    with _schemas_lock:
+        ps = _schemas.get(store.uuid())
+        if ps is None:
+            ps = _schemas[store.uuid()] = PerfSchema()
+        # true LRU: evict the least-recently USED store, never a live one
+        _schemas.move_to_end(store.uuid())
+        while len(_schemas) > 128:
+            _schemas.popitem(last=False)
+        return ps
+
+
+class VirtualTable:
+    """Duck-types the table.Table read surface over in-memory rows; never
+    touches KV (infoschema/tables.go virtual table pattern)."""
+
+    virtual = True
+
+    def __init__(self, info: TableInfo, store):
+        self.info = info
+        self.id = info.id
+        self.store = store
+        self.indices = []
+
+    def iter_records(self, retriever, start_handle=None, cols=None):
+        rows = perf_for(self.store).rows(self.id)
+        for i, row in enumerate(rows):
+            yield i + 1, row
+
+    # write surface: clean read-only errors instead of AttributeError
+    def _read_only(self, *_a, **_k):
+        from tidb_tpu import errors
+        raise errors.ExecError(
+            f"table performance_schema.{self.info.name} is read-only")
+
+    add_record = _read_only
+    update_record = _read_only
+    remove_record = _read_only

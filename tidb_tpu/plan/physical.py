@@ -177,6 +177,14 @@ def _handle_column(ds: DataSource) -> Column | None:
 
 def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
     conditions = ds.push_conditions
+    if getattr(ds.table, "virtual", False):
+        # virtual (performance_schema) tables: in-memory rows, nothing
+        # crosses the coprocessor boundary — all filtering stays SQL-side
+        scan = PhysicalTableScan()
+        _fill_source(scan, ds)
+        scan.virtual = True
+        scan.conditions = list(conditions)
+        return scan
     handle_col = _handle_column(ds)
     if handle_col is not None:
         access, rest = refiner.detach_table_scan_conditions(
@@ -321,8 +329,10 @@ def _maybe_union_scan(scan, ds: DataSource, conditions, ctx: PhysicalContext):
 
 def _pushable_scan(p: Plan):
     """The scan an Aggregation may push into: a bare table scan with nothing
-    SQL-side between (residual filters break pushdown soundness)."""
+    SQL-side between (residual filters break pushdown soundness). Virtual
+    scans have no coprocessor behind them — nothing pushes."""
     if isinstance(p, PhysicalTableScan) and not p.conditions \
+            and not getattr(p, "virtual", False) \
             and not p.aggregates and p.limit is None and not p.topn_pb:
         return p
     return None
@@ -425,6 +435,7 @@ def _push_topn(topn: PhysicalTopN, child: Plan, ctx: PhysicalContext) -> None:
     per-region top-ks still need a final merge."""
     scan, proj = _scan_below_projection(child)
     if scan is None or scan.aggregated_push_down or scan.conditions \
+            or getattr(scan, "virtual", False) \
             or not isinstance(scan, PhysicalTableScan):
         return
     if not ctx.client.support_request_type(kv.REQ_TYPE_SELECT,
@@ -452,6 +463,7 @@ def _push_topn(topn: PhysicalTopN, child: Plan, ctx: PhysicalContext) -> None:
 def _push_limit(child: Plan, n: int) -> None:
     scan, _ = _scan_below_projection(child)
     if scan is not None and not scan.aggregated_push_down \
+            and not getattr(scan, "virtual", False) \
             and not scan.conditions and not scan.topn_pb:
         scan.limit = n if scan.limit is None else min(scan.limit, n)
 

@@ -206,6 +206,20 @@ class Session:
 
     def _execute_one(self, stmt, sql_text: str,
                      record_history: bool = True) -> ResultSet | None:
+        from tidb_tpu import perfschema
+        ps = perfschema.perf_for(self.store)
+        ev = ps.start_statement(self.vars.connection_id, sql_text)
+        try:
+            rs = self._execute_one_inner(stmt, sql_text, record_history)
+        except Exception as e:
+            ps.end_statement(ev, error=str(e))
+            raise
+        ps.end_statement(ev, rows_sent=len(rs.rows) if rs is not None else 0,
+                         rows_affected=self.vars.affected_rows)
+        return rs
+
+    def _execute_one_inner(self, stmt, sql_text: str,
+                           record_history: bool = True) -> ResultSet | None:
         import time as _time
         m = _metric_handles()
         self.vars.affected_rows = 0
