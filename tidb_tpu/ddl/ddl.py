@@ -8,7 +8,10 @@ ddl/table.go, ddl/schema.go, ddl/bg_worker.go.
 
 from __future__ import annotations
 
+import json
 import threading
+import time
+import uuid as uuidlib
 from dataclasses import dataclass, field as dc_field
 from typing import Any
 
@@ -24,6 +27,11 @@ from tidb_tpu.table import Table
 from tidb_tpu.types.field_type import FieldType
 
 REORG_BATCH_SIZE = 256
+
+# a silent owner is replaced after this long (ddl_worker.go maxOwnerTimeout)
+OWNER_TIMEOUT_MS = 4000
+# how long an enqueuing server waits for SOME owner to finish its job
+JOB_WAIT_TIMEOUT_S = 30.0
 
 
 @dataclass
@@ -44,14 +52,91 @@ class IndexSpec:
 
 
 class DDL:
-    """Owns the job queue; single-process mode runs jobs inline after
-    enqueue (the reference's every-server worker loop, collapsed)."""
+    """Owns the job queue. Every server may enqueue; only the OWNER — a
+    lease on the meta DDLOwner key, renewed per state step and taken over
+    after OWNER_TIMEOUT_MS of silence — processes (ddl_worker.go:97
+    checkOwner). The enqueuing server drives the queue inline when it can
+    own, else polls job history until the real owner finishes. A
+    background worker (start_worker) gives idle servers the reference's
+    onDDLWorker loop; drop-table data deletion rides the bg job queue
+    under its own owner key (bg_worker.go)."""
 
-    def __init__(self, store, handle, callback: Callback | None = None):
+    def __init__(self, store, handle, callback: Callback | None = None,
+                 schema_lease_s: float = 0.0):
         self.store = store
         self.handle = handle  # infoschema.Handle
         self.callback = callback or Callback()
+        self.uuid = uuidlib.uuid4().hex[:12]
+        # >0 emulates the reference's 2×lease waitSchemaChanged barrier
+        # (ddl_worker.go:397): other servers get 2 lease periods to load
+        # the bumped version before the next state transition
+        self.schema_lease_s = schema_lease_s
         self._lock = threading.Lock()
+        self._worker_stop: threading.Event | None = None
+
+    # ---- owner lease (ddl_worker.go:97) ----
+
+    def _take_owner(self, m: Meta, bg: bool = False) -> bool:
+        now = int(time.time() * 1000)
+        raw = m.get_owner(bg=bg)
+        if raw:
+            o = json.loads(raw)
+            if o["id"] != self.uuid and o["ts"] + OWNER_TIMEOUT_MS > now:
+                return False  # someone else holds a live lease
+            if o["id"] == self.uuid and \
+                    now - o["ts"] < OWNER_TIMEOUT_MS // 2:
+                return True  # fresh enough: skip the renewal write
+        m.set_owner(json.dumps({"id": self.uuid, "ts": now}).encode(),
+                    bg=bg)
+        return True
+
+    def _release_owner(self, bg: bool = False) -> None:
+        """Expire our own lease so the next server's DDL doesn't stall
+        waiting out OWNER_TIMEOUT_MS against an idle holder."""
+        def rel(txn):
+            m = Meta(txn)
+            raw = m.get_owner(bg=bg)
+            if raw and json.loads(raw)["id"] == self.uuid:
+                m.set_owner(json.dumps({"id": self.uuid, "ts": 0}).encode(),
+                            bg=bg)
+        try:
+            run_in_new_txn(self.store, True, rel)
+        except Exception:
+            pass  # worst case: the lease times out naturally
+
+    def _renew_owner(self) -> None:
+        def renew(txn):
+            self._take_owner(Meta(txn))
+        try:
+            run_in_new_txn(self.store, True, renew)
+        except Exception:
+            pass
+
+    # ---- background worker (ddl_worker.go onDDLWorker loop) ----
+
+    def start_worker(self, interval_s: float = 0.25) -> None:
+        if self._worker_stop is not None:
+            return
+        self._worker_stop = threading.Event()
+        stop = self._worker_stop  # capture: stop()+start() must not leave
+        # the old thread polling the NEW event (it would never exit)
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    with self._lock:
+                        self._handle_job_queue(None)
+                        self._handle_bg_queue()
+                except Exception:
+                    pass  # next tick retries; jobs survive in the queue
+
+        threading.Thread(target=loop, name="tidb-ddl-worker",
+                         daemon=True).start()
+
+    def stop_worker(self) -> None:
+        if self._worker_stop is not None:
+            self._worker_stop.set()
+            self._worker_stop = None
 
     # ================= public API (ddl/ddl.go DDL interface) =================
 
@@ -224,19 +309,49 @@ class DDL:
                       args=args)
 
     def _run_job(self, job: DDLJob) -> None:
-        """Enqueue then drive the queue until this job finishes.
+        """Enqueue then wait for the job to finish: drive the queue when
+        this server can own, else poll history while the owner works.
         Reference: ddl_worker.go addDDLJob + handleDDLJobQueue."""
         with self._lock:
             def enqueue(txn):
                 Meta(txn).enqueue_ddl_job(job)
             run_in_new_txn(self.store, True, enqueue)
-            finished = self._handle_job_queue(wait_for=job.id)
-        if finished is not None and finished.error:
+            deadline = time.time() + JOB_WAIT_TIMEOUT_S
+            finished = None
+            while finished is None:
+                finished = self._handle_job_queue(wait_for=job.id)
+                if finished is None:
+                    # queue empty (another server took it) or not owner
+                    finished = self._history_job(job.id)
+                    if finished is None:
+                        if time.time() > deadline:
+                            # the queue offers no mid-list removal, so the
+                            # job may STILL execute once the owner
+                            # recovers — the error must say so
+                            raise errors.TiDBError(
+                                f"DDL job {job.id} not processed within "
+                                f"{JOB_WAIT_TIMEOUT_S}s (owner stuck?); "
+                                "the job remains queued and may apply "
+                                "later")
+                        time.sleep(0.02)
+            self._handle_bg_queue()
+            self._release_owner()
+            self._release_owner(bg=True)
+        self.handle.load()  # converge this server even when not owner
+        if finished.error:
             raise errors.TiDBError(finished.error,
                                    code=finished.error_code or None)
 
+    def _history_job(self, job_id: int) -> DDLJob | None:
+        txn = self.store.begin()
+        try:
+            return Meta(txn).history_ddl_job(job_id)
+        finally:
+            txn.rollback()
+
     def _handle_job_queue(self, wait_for: int | None = None) -> DDLJob | None:
-        """Drive the queue; returns the finished job matching wait_for."""
+        """Drive the queue while owner; returns the finished job matching
+        wait_for, or None when the queue is empty / owned elsewhere."""
         while True:
             done_job: DDLJob | None = None
 
@@ -245,6 +360,8 @@ class DDL:
                 m = Meta(txn)
                 cur = m.get_ddl_job(0)
                 if cur is None:
+                    return False  # empty: don't even write a lease
+                if not self._take_owner(m):
                     return False
                 changed = self._run_one_state(txn, m, cur)
                 if cur.is_finished():
@@ -260,13 +377,46 @@ class DDL:
             progressed = run_in_new_txn(self.store, True, step)
             if not progressed:
                 return None
-            # every version bump is visible to other servers here
+            # every version bump is visible to other servers here; with a
+            # schema lease configured, give them 2 lease periods to load
+            # it before the next state (waitSchemaChanged, :397)
             self.handle.load()
+            if self.schema_lease_s > 0:
+                # renew the lease while sleeping — a 2×lease barrier longer
+                # than OWNER_TIMEOUT must not let another server steal the
+                # job mid-state
+                remaining = 2 * self.schema_lease_s
+                slice_s = OWNER_TIMEOUT_MS / 1000.0 / 4
+                while remaining > 0:
+                    time.sleep(min(slice_s, remaining))
+                    remaining -= slice_s
+                    if remaining > 0:
+                        self._renew_owner()
             self.callback.on_changed(None)
             if done_job is not None:
                 self.callback.on_job_updated(done_job)
                 if wait_for is not None and done_job.id == wait_for:
                     return done_job
+
+    # ---- background drop-data queue (ddl/bg_worker.go) ----
+
+    def _handle_bg_queue(self) -> None:
+        """Process queued drop-table data deletions under the bg owner
+        lease; every server's worker competes, exactly one wins each."""
+        while True:
+            def step(txn):
+                m = Meta(txn)
+                job = m.get_ddl_job(0, bg=True)
+                if job is None:
+                    return False  # empty: no lease write
+                if not self._take_owner(m, bg=True):
+                    return False
+                self._delete_table_data(txn, job.table_id)
+                m.dequeue_ddl_job(bg=True)
+                return True
+
+            if not run_in_new_txn(self.store, True, step):
+                return
 
     def _run_one_state(self, txn, m: Meta, job: DDLJob) -> bool:
         """One state transition of one job; returns True if schema changed.
@@ -343,10 +493,18 @@ class DDL:
         job.state = JobState.DONE
         return True
 
+    def _enqueue_bg_drop(self, m: Meta, schema_id: int,
+                         table_id: int) -> None:
+        """Defer data deletion to the bg queue (ddl/bg_worker.go): the
+        schema change commits fast, the keyspace drains asynchronously."""
+        m.enqueue_ddl_job(DDLJob(id=m.gen_global_id(),
+                                 tp=ActionType.DROP_TABLE,
+                                 schema_id=schema_id, table_id=table_id),
+                          bg=True)
+
     def _on_drop_schema(self, txn, m: Meta, job: DDLJob) -> bool:
-        # delete table data inline (reference defers to the bg queue)
         for tbl in m.list_tables(job.schema_id):
-            self._delete_table_data(txn, tbl.id)
+            self._enqueue_bg_drop(m, job.schema_id, tbl.id)
             m.clear_table_stats(tbl.id)
         m.drop_database(job.schema_id)
         job.state = JobState.DONE
@@ -375,7 +533,7 @@ class DDL:
         elif info.state == SchemaState.WRITE_ONLY:
             info.state = SchemaState.DELETE_ONLY
         else:
-            self._delete_table_data(txn, info.id)
+            self._enqueue_bg_drop(m, job.schema_id, info.id)
             m.clear_table_stats(info.id)
             m.drop_table(job.schema_id, info.id)
             job.state = JobState.DONE
@@ -387,7 +545,7 @@ class DDL:
         info = m.get_table(job.schema_id, job.table_id)
         if info is None:
             raise errors.NoSuchTableError("table dropped concurrently")
-        self._delete_table_data(txn, info.id)
+        self._enqueue_bg_drop(m, job.schema_id, info.id)
         m.clear_table_stats(info.id)
         m.drop_table(job.schema_id, info.id)
         info.id = m.gen_global_id()

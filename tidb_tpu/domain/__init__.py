@@ -39,9 +39,49 @@ class Domain:
         if self.gc_worker is not None:
             self.gc_worker.start()
 
+        self._reload_stop: threading.Event | None = None
+
     def close(self) -> None:
         if self.gc_worker is not None:
             self.gc_worker.stop()
+        self.ddl.stop_worker()
+        if self._reload_stop is not None:
+            self._reload_stop.set()
+            self._reload_stop = None
+
+    # ---- multi-server convergence (domain.go:371 loadSchemaInLoop) ----
+
+    def maybe_reload(self) -> bool:
+        """Reload iff another server bumped the schema version; returns
+        whether a reload happened."""
+        from tidb_tpu.meta import Meta
+        txn = self.store.begin()
+        try:
+            ver = Meta(txn).schema_version()
+        finally:
+            txn.rollback()
+        if ver != self.handle.get().version:
+            self.handle.load()
+            return True
+        return False
+
+    def start_reload_loop(self, interval_s: float = 0.25) -> None:
+        """Background refresher so THIS server converges on DDL performed
+        by others (reference reloads every lease/2)."""
+        if self._reload_stop is not None:
+            return
+        self._reload_stop = threading.Event()
+        stop = self._reload_stop
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.maybe_reload()
+                except Exception:
+                    pass
+
+        threading.Thread(target=loop, name="tidb-schema-reload",
+                         daemon=True).start()
 
     def info_schema(self) -> InfoSchema:
         return self.handle.get()

@@ -39,7 +39,15 @@ class Server:
 
     def start(self) -> None:
         """Bind and serve in a background thread; self.port is the bound
-        port (useful with port=0 in tests)."""
+        port (useful with port=0 in tests). A serving process is a
+        'tidb-server': it runs the multi-server convergence loops — the
+        schema refresher (domain.go loadSchemaInLoop) and the DDL/bg-queue
+        worker (ddl_worker.go onDDLWorker) — so several servers sharing
+        one store converge on each other's DDL."""
+        from tidb_tpu.domain import get_domain
+        dom = get_domain(self.store)
+        dom.start_reload_loop()
+        dom.ddl.start_worker()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
