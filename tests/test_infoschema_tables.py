@@ -1,0 +1,72 @@
+"""INFORMATION_SCHEMA virtual table tests (infoschema/tables.go)."""
+
+import pytest
+
+from tidb_tpu import errors
+from tests.testkit import TestKit
+
+
+@pytest.fixture
+def tk():
+    t = TestKit()
+    t.exec("create database d; use d")
+    t.exec("create table t (id int primary key, name varchar(32) not null, "
+           "v double, key idx_n (name))")
+    return t
+
+
+class TestInformationSchema:
+    def test_schemata(self, tk):
+        rows = tk.exec("select SCHEMA_NAME from "
+                       "information_schema.SCHEMATA order by "
+                       "SCHEMA_NAME").rows
+        names = [r[0] if isinstance(r[0], str) else r[0].decode()
+                 for r in rows]
+        assert "d" in names and "mysql" in names
+        assert "performance_schema" not in names  # virtual dbs excluded
+
+    def test_tables_and_filtering(self, tk):
+        tk.exec("select TABLE_NAME, TABLE_TYPE from "
+                "information_schema.TABLES "
+                "where TABLE_SCHEMA = 'd'").check([["t", "BASE TABLE"]])
+
+    def test_columns(self, tk):
+        rows = tk.exec(
+            "select COLUMN_NAME, ORDINAL_POSITION, IS_NULLABLE, DATA_TYPE,"
+            " COLUMN_KEY from information_schema.COLUMNS "
+            "where TABLE_NAME = 't' order by ORDINAL_POSITION").rows
+
+        def s(v):
+            return v if isinstance(v, str) else v.decode()
+        assert [[s(r[0]), r[1], s(r[2]), s(r[3]), s(r[4])] for r in rows] \
+            == [["id", 1, "NO", "int", "PRI"],
+                ["name", 2, "NO", "varchar", "MUL"],
+                ["v", 3, "YES", "double", ""]]
+
+    def test_statistics(self, tk):
+        tk.exec("select INDEX_NAME, SEQ_IN_INDEX, COLUMN_NAME from "
+                "information_schema.STATISTICS where TABLE_NAME = 't'"
+                ).check([["idx_n", 1, "name"]])
+
+    def test_snapshot_consistency_after_ddl(self, tk):
+        tk.exec("create table u (x int)")
+        n = tk.exec("select count(*) from information_schema.TABLES "
+                    "where TABLE_SCHEMA = 'd'").rows[0][0]
+        assert n == 2
+        tk.exec("drop table u")
+        n = tk.exec("select count(*) from information_schema.TABLES "
+                    "where TABLE_SCHEMA = 'd'").rows[0][0]
+        assert n == 1
+
+    def test_read_only_and_case_insensitive_db(self, tk):
+        with pytest.raises(errors.TiDBError):
+            tk.exec("insert into INFORMATION_SCHEMA.TABLES values ()")
+        assert tk.exec("select count(*) from "
+                       "INFORMATION_SCHEMA.SCHEMATA").rows[0][0] >= 2
+
+    def test_join_with_group_by(self, tk):
+        rows = tk.exec(
+            "select TABLE_NAME, count(*) from information_schema.COLUMNS "
+            "where TABLE_SCHEMA = 'd' group by TABLE_NAME").rows
+        assert [[r[0] if isinstance(r[0], str) else r[0].decode(), r[1]]
+                for r in rows] == [["t", 3]]

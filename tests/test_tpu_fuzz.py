@@ -30,7 +30,7 @@ def _build(store):
     s.execute("use fz")
     s.execute(
         "create table t (id bigint primary key, a int, b varchar(32), "
-        "c double, d date, e int, f int)")
+        "c double, d date, e int, f int, m decimal(12,2))")
     tbl = s.info_schema().table_by_name("fz", "t")
     date_tp = tbl.info.columns[4].field_type.tp
 
@@ -50,7 +50,10 @@ def _build(store):
             if rng.random() > 0.10 else NULL
         e = Datum.i64(rng.randint(0, 7))
         f = Datum.i64(rng.randint(-10**12, 10**12))
-        tbl.add_record(txn, [Datum.i64(i), a, b, c, d, e, f],
+        from decimal import Decimal as _D
+        m = Datum.dec(_D(rng.randint(-10**7, 10**7)) / 100) \
+            if rng.random() > 0.20 else NULL
+        tbl.add_record(txn, [Datum.i64(i), a, b, c, d, e, f, m],
                        skip_unique_check=True)
         if i % 2000 == 0:
             txn.commit()
@@ -111,6 +114,13 @@ QUERIES = [
     "select b, count(distinct e) from t group by b order by b",
     # distinct over the whole request
     "select sum(distinct e), avg(distinct e) from t",
+    # fixed-point decimal plane: EXACT aggregates / filters / group keys
+    "select sum(m), min(m), max(m), avg(m), count(m) from t",
+    "select e, sum(m), min(m) from t group by e order by e",
+    "select count(*) from t where m > 1234.56",
+    "select count(*) from t where m between -50000 and 50000",
+    "select count(distinct m) from t",
+    "select sum(m + m), sum(m * 2) from t where m < 0",
 ]
 
 
@@ -206,3 +216,33 @@ def test_fuzz_tpu_used(sessions):
     _, tpu = sessions
     client = tpu.store.get_client()
     assert client.stats["tpu_requests"] >= 15
+
+
+def test_decimal_stays_on_tpu(sessions):
+    """Fixed-point decimal requests must run the TPU kernels, not fall
+    back (round-2 weak #6: decimal semantics on TPU were float/absent)."""
+    _, tpu = sessions
+    client = tpu.store.get_client()
+    before = (client.stats["tpu_requests"], client.stats["cpu_fallbacks"])
+    tpu.execute("select e, sum(m), min(m), max(m) from t "
+                "group by e order by e")
+    tpu.execute("select count(*) from t where m > 0 and m < 90000")
+    assert client.stats["tpu_requests"] == before[0] + 2
+    assert client.stats["cpu_fallbacks"] == before[1]
+
+
+def test_too_fine_decimal_falls_back_cleanly():
+    """A decimal column beyond the fixed-point plane (scale > 6) must fall
+    back to the CPU engine — NOT error (regression: TypeError_ escaped
+    send())."""
+    store = new_store("memory://fuzz_decfine")
+    store.set_client(TpuClient(store))
+    s = Session(store)
+    s.execute("create database d; use d")
+    s.execute("create table t (a int primary key, p decimal(20,8))")
+    s.execute("insert into t values (1, '1.00000001'), (2, '2.5')")
+    client = store.get_client()
+    before = client.stats["cpu_fallbacks"]
+    got = s.execute("select sum(p) from t")[0].values()
+    assert float(got[0][0]) == 3.50000001
+    assert client.stats["cpu_fallbacks"] > before

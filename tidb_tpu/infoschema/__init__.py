@@ -31,11 +31,12 @@ class InfoSchema:
                 self._tbl_by_name[(db.name.lower(), ti.name.lower())] = t
                 self._tbl_by_id[ti.id] = t
         if store is not None:
-            self._attach_perfschema(store)
+            self._attach_virtual(store)
 
-    def _attach_perfschema(self, store) -> None:
-        """Virtual performance_schema tables (perfschema/init.go:205);
-        reserved negative ids keep them off the KV/meta paths."""
+    def _attach_virtual(self, store) -> None:
+        """Virtual databases (perfschema/init.go:205,
+        infoschema/tables.go); reserved negative ids keep them off the
+        KV/meta paths."""
         from tidb_tpu import perfschema as ps
         db = DBInfo(id=ps.DB_ID, name="performance_schema")
         self._db_by_name[db.name] = db
@@ -44,6 +45,14 @@ class InfoSchema:
             vt = ps.VirtualTable(ti, store)
             self._tbl_by_name[(db.name, ti.name.lower())] = vt
             self._tbl_by_id[ti.id] = vt
+        from tidb_tpu.infoschema import tables as it
+        idb = DBInfo(id=it.DB_ID, name="INFORMATION_SCHEMA")
+        self._db_by_name[idb.name.lower()] = idb
+        self._db_by_id[idb.id] = idb
+        for ti in it.table_infos():
+            ivt = it.InfoVirtualTable(ti, self)
+            self._tbl_by_name[(idb.name.lower(), ti.name.lower())] = ivt
+            self._tbl_by_id[ti.id] = ivt
 
     # ---- lookups ----
     def schema_by_name(self, name: str) -> DBInfo | None:

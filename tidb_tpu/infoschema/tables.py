@@ -1,0 +1,151 @@
+"""INFORMATION_SCHEMA virtual tables.
+
+Reference: infoschema/tables.go — SCHEMATA (dataForSchemata :323), TABLES
+(:338), COLUMNS (:371), STATISTICS (:428). Rows are synthesized from the
+CURRENT schema snapshot on every read, through the same virtual-table
+machinery performance_schema uses: reserved negative ids, MemTableExec,
+SQL-side filtering.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import mysqldef as my
+from tidb_tpu.model import ColumnInfo, TableInfo
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL
+from tidb_tpu.types.field_type import FieldType
+
+DB_ID = -200
+T_SCHEMATA = -201
+T_TABLES = -202
+T_COLUMNS = -203
+T_STATISTICS = -204
+
+
+def _col(i: int, name: str, tp: int = my.TypeVarchar,
+         flen: int = 64) -> ColumnInfo:
+    return ColumnInfo(id=i + 1, name=name, offset=i,
+                      field_type=FieldType(tp, 0, flen, -1))
+
+
+def _tbl(tid: int, name: str, cols: list[tuple]) -> TableInfo:
+    return TableInfo(id=tid, name=name,
+                     columns=[_col(i, *c) for i, c in enumerate(cols)])
+
+
+def table_infos() -> list[TableInfo]:
+    return [
+        _tbl(T_SCHEMATA, "SCHEMATA", [
+            ("CATALOG_NAME",), ("SCHEMA_NAME",),
+            ("DEFAULT_CHARACTER_SET_NAME",), ("DEFAULT_COLLATION_NAME",)]),
+        _tbl(T_TABLES, "TABLES", [
+            ("TABLE_CATALOG",), ("TABLE_SCHEMA",), ("TABLE_NAME",),
+            ("TABLE_TYPE",), ("ENGINE",),
+            ("TABLE_ROWS", my.TypeLonglong, 21),
+            ("AUTO_INCREMENT", my.TypeLonglong, 21), ("TABLE_COLLATION",),
+            ("TABLE_COMMENT", my.TypeVarchar, 256)]),
+        _tbl(T_COLUMNS, "COLUMNS", [
+            ("TABLE_CATALOG",), ("TABLE_SCHEMA",), ("TABLE_NAME",),
+            ("COLUMN_NAME",), ("ORDINAL_POSITION", my.TypeLonglong, 21),
+            ("COLUMN_DEFAULT",), ("IS_NULLABLE",), ("DATA_TYPE",),
+            ("COLUMN_TYPE",), ("COLUMN_KEY",), ("EXTRA",),
+            ("COLUMN_COMMENT", my.TypeVarchar, 256)]),
+        _tbl(T_STATISTICS, "STATISTICS", [
+            ("TABLE_CATALOG",), ("TABLE_SCHEMA",), ("TABLE_NAME",),
+            ("NON_UNIQUE",), ("INDEX_SCHEMA",), ("INDEX_NAME",),
+            ("SEQ_IN_INDEX", my.TypeLonglong, 21), ("COLUMN_NAME",),
+            ("COMMENT", my.TypeVarchar, 256)]),
+    ]
+
+
+def _s(v: str) -> Datum:
+    return Datum.bytes_(v.encode())
+
+
+def _real_schemas(snapshot):
+    """User + system databases, not the virtual ones (ids >= 0)."""
+    out = []
+    for name in sorted(snapshot.all_schema_names(), key=str.lower):
+        db = snapshot.schema_by_name(name)
+        if db is not None and db.id >= 0:
+            out.append(db)
+    return out
+
+
+def rows_for(snapshot, table_id: int) -> list[list[Datum]]:
+    """Synthesize one table's rows from an InfoSchema snapshot."""
+    if table_id == T_SCHEMATA:
+        return [[_s("def"), _s(db.name), _s(db.charset), _s(db.collate)]
+                for db in _real_schemas(snapshot)]
+    if table_id == T_TABLES:
+        out = []
+        for db in _real_schemas(snapshot):
+            for t in sorted(snapshot.schema_tables(db.name),
+                            key=lambda t: t.info.name.lower()):
+                out.append([_s("def"), _s(db.name), _s(t.info.name),
+                            _s("BASE TABLE"), _s("tidb-tpu"), NULL, NULL,
+                            _s(t.info.collate), _s(t.info.comment)])
+        return out
+    if table_id == T_COLUMNS:
+        out = []
+        for db in _real_schemas(snapshot):
+            for t in sorted(snapshot.schema_tables(db.name),
+                            key=lambda t: t.info.name.lower()):
+                for i, c in enumerate(t.info.public_columns()):
+                    ft = c.field_type
+                    nullable = "NO" if my.has_not_null_flag(ft.flag) \
+                        else "YES"
+                    key = "PRI" if my.has_pri_key_flag(ft.flag) else (
+                        "UNI" if ft.flag & my.UniqueKeyFlag else (
+                            "MUL" if ft.flag & my.MultipleKeyFlag else ""))
+                    extra = "auto_increment" \
+                        if my.has_auto_increment_flag(ft.flag) else ""
+                    default = NULL if c.default_value is None \
+                        else _s(str(c.default_value))
+                    out.append([
+                        _s("def"), _s(db.name), _s(t.info.name),
+                        _s(c.name), Datum.i64(i + 1), default,
+                        _s(nullable), _s(ft.type_name()),
+                        _s(ft.compact_str()), _s(key), _s(extra),
+                        _s(c.comment)])
+        return out
+    if table_id == T_STATISTICS:
+        out = []
+        for db in _real_schemas(snapshot):
+            for t in sorted(snapshot.schema_tables(db.name),
+                            key=lambda t: t.info.name.lower()):
+                for idx in t.info.indices:
+                    for seq, ic in enumerate(idx.columns):
+                        out.append([
+                            _s("def"), _s(db.name), _s(t.info.name),
+                            _s("0" if idx.unique else "1"), _s(db.name),
+                            _s(idx.name), Datum.i64(seq + 1), _s(ic.name),
+                            _s("")])
+        return out
+    return []
+
+
+class InfoVirtualTable:
+    """information_schema table bound to its owning snapshot — reads are
+    self-consistent with the statement's schema view."""
+
+    virtual = True
+
+    def __init__(self, info: TableInfo, snapshot_ref):
+        self.info = info
+        self.id = info.id
+        self._snapshot_ref = snapshot_ref  # the owning InfoSchema
+        self.indices = []
+
+    def iter_records(self, retriever, start_handle=None, cols=None):
+        for i, row in enumerate(rows_for(self._snapshot_ref, self.id)):
+            yield i + 1, row
+
+    def _read_only(self, *_a, **_k):
+        from tidb_tpu import errors
+        raise errors.ExecError(
+            f"table information_schema.{self.info.name} is read-only")
+
+    add_record = _read_only
+    update_record = _read_only
+    remove_record = _read_only

@@ -26,7 +26,7 @@ from decimal import Decimal
 
 import numpy as np
 
-from tidb_tpu import mysqldef as my
+from tidb_tpu import errors, mysqldef as my
 from tidb_tpu.codec import codec
 from tidb_tpu.copr.proto import (
     AGG_NAME, ChunkWriter, Expr, ExprType, SelectRequest, SelectResponse,
@@ -116,7 +116,10 @@ class TpuClient(kv.Client):
             self.stats["tpu_requests"] += 1
             metrics.counter("copr.tpu.requests").inc()
             return _SingleResponse(resp)
-        except Unsupported:
+        except (Unsupported, errors.TypeError_):
+            # TypeError_ = a column/value has no exact plane mapping
+            # (e.g. decimal finer than the fixed-point scale): same
+            # fallback contract as Unsupported — CPU answers
             self.stats["cpu_fallbacks"] += 1
             metrics.counter("copr.tpu.cpu_fallbacks").inc()
             if any(e.distinct for e in sel.aggregates):
@@ -334,7 +337,12 @@ class TpuClient(kv.Client):
         return planes
 
     def _group_datum(self, cid: int, decoder, code: int) -> Datum:
-        kind, data = decoder
+        kind = decoder[0]
+        if kind == "dec":
+            _k, data, scale = decoder
+            return Datum.dec(Decimal(int(data[code]))
+                             / (Decimal(10) ** scale))
+        _k, data = decoder
         if kind == "str":
             return Datum.bytes_(data[code])
         v = data[code]
@@ -421,6 +429,9 @@ class TpuClient(kv.Client):
                     gvals.append(Datum.bytes_(cd.dictionary[int(rep)]))
                 elif cd.kind == col.K_F64:
                     gvals.append(Datum.f64(float(rep)))
+                elif cd.kind == col.K_DEC:
+                    gvals.append(Datum.dec(
+                        Decimal(int(rep)) / (Decimal(10) ** cd.dec_scale)))
                 else:
                     gvals.append(self._i64_datum(cid, int(rep)))
             gk = codec.encode_value(gvals)
@@ -440,6 +451,8 @@ class TpuClient(kv.Client):
             return v if gid is None else v[gid]
 
         name = spec.name
+        dec_scale = spec.arg.scale if spec.arg is not None \
+            and spec.arg.kind == col.K_DEC else None
         if name == "count":
             return [Datum.i64(int(at(i)))]
         n = int(at(i))
@@ -447,6 +460,10 @@ class TpuClient(kv.Client):
         if name in ("sum", "avg"):
             if n == 0:
                 val = NULL
+            elif dec_scale is not None:
+                # fixed-point plane: scaled-int sum → exact Decimal
+                val = Datum.dec(Decimal(int(v))
+                                / (Decimal(10) ** dec_scale))
             elif isinstance(v, np.floating) or \
                     (hasattr(v, "dtype") and v.dtype.kind == "f"):
                 val = Datum.f64(float(v))
@@ -463,6 +480,9 @@ class TpuClient(kv.Client):
         if name in ("min", "max"):
             if n == 0:
                 return [NULL]
+            if dec_scale is not None:
+                return [Datum.dec(Decimal(int(v))
+                                  / (Decimal(10) ** dec_scale))]
             return [self._phys_to_datum(agg_expr, v)]
         raise Unsupported(name)
 
@@ -487,6 +507,9 @@ class TpuClient(kv.Client):
             return Datum.bytes_(cd.dictionary[int(cd.values[i])])
         if cd.kind == col.K_F64:
             return Datum.f64(float(cd.values[i]))
+        if cd.kind == col.K_DEC:
+            return Datum.dec(Decimal(int(cd.values[i]))
+                             / (Decimal(10) ** cd.dec_scale))
         return self._i64_datum(cid, int(cd.values[i]))
 
     def _phys_to_datum(self, agg_expr, v) -> Datum:
@@ -570,6 +593,10 @@ class TpuClient(kv.Client):
                     row.append(Datum.bytes_(cd.dictionary[int(cd.values[i])]))
                 elif cd.kind == col.K_F64:
                     row.append(Datum.f64(float(cd.values[i])))
+                elif cd.kind == col.K_DEC:
+                    row.append(Datum.dec(
+                        Decimal(int(cd.values[i]))
+                        / (Decimal(10) ** cd.dec_scale)))
                 else:
                     v = int(cd.values[i])
                     if c.tp in my.TIME_TYPES:

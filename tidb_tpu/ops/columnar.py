@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import bisect
 from dataclasses import dataclass
+from decimal import Decimal
 
 import numpy as np
 
@@ -37,6 +38,16 @@ I64_MIN = -(1 << 63)
 K_I64 = "i64"     # ints, times (to_number), durations (nanos), bools
 K_F64 = "f64"
 K_STR = "str"     # dictionary codes (int32) + ordered dictionary
+K_DEC = "dec"     # EXACT fixed-point: int64 scaled by 10^dec_scale
+                  # (SURVEY §7 "fixed-point int64 with guarded exactness")
+
+MAX_DEC_PLANE_SCALE = 6   # columns finer than this stay on the CPU engine
+
+_POW10 = [10 ** i for i in range(19)]
+
+
+def _dec_scale_of(c: PBColumnInfo, kind: str) -> int:
+    return c.decimal if kind == K_DEC and c.decimal and c.decimal > 0 else 0
 
 
 @dataclass
@@ -46,6 +57,9 @@ class ColumnData:
     valid: np.ndarray             # bool plane
     dictionary: list[bytes] | None = None  # K_STR: sorted code → bytes
     tp: int = 0                   # MySQL type byte (time/duration decode)
+    dec_scale: int = 0            # K_DEC: values = datum * 10^dec_scale
+    dec_max_abs: int = 0          # K_DEC: max |scaled value| in the batch
+                                  # (the overflow-guard bound for exprc)
 
     def code_of(self, b: bytes) -> int:
         """Exact-match dictionary code, or -1."""
@@ -124,16 +138,38 @@ def column_phys_kind(col: PBColumnInfo) -> str:
         return K_I64
     if tp in my.STRING_TYPES:
         return K_STR
-    # decimals and exotics stay on the CPU engine (capability probe rejects)
+    if tp in (my.TypeNewDecimal, my.TypeDecimal):
+        scale = col.decimal if col.decimal is not None else -1
+        prec = col.flen if col.flen is not None else -1
+        if 0 <= scale <= MAX_DEC_PLANE_SCALE and prec <= 18:
+            return K_DEC
+        raise errors.TypeError_(
+            f"decimal({prec},{scale}) exceeds the fixed-point plane")
+    # exotics stay on the CPU engine (send() falls back on TypeError_)
     raise errors.TypeError_(f"no columnar mapping for type 0x{tp:02x}")
 
 
-def datum_to_phys(d: Datum, kind: str):
+def datum_to_phys(d: Datum, kind: str, dec_scale: int = 0):
     """Datum → (physical value, is_valid). Temporal ordering uses
-    Time.to_number()/Duration nanos — monotonic, so compares carry over."""
+    Time.to_number()/Duration nanos — monotonic, so compares carry over.
+    K_DEC demands EXACT representation at the plane scale; a finer stored
+    value bails the pack to the CPU engine rather than round."""
     if d.is_null():
         return 0, False
     k = d.kind
+    if kind == K_DEC:
+        if k == Kind.DECIMAL:
+            v = d.val
+        elif k in (Kind.INT64, Kind.UINT64):
+            v = Decimal(int(d.val))
+        else:
+            raise errors.TypeError_(f"cannot pack {d!r} as fixed-point")
+        scaled = v * _POW10[dec_scale]
+        iv = int(scaled)
+        if scaled != iv or not (-(1 << 62) < iv < (1 << 62)):
+            raise errors.TypeError_(
+                f"decimal {v} not exact at scale {dec_scale}")
+        return iv, True
     if kind == K_I64:
         if k in (Kind.INT64, Kind.UINT64):
             return int(d.val), True
@@ -164,6 +200,8 @@ def _scan_rows(snapshot, table_id: int, columns, ranges, defaults):
     if native is not None:
         return native
     col_kinds = {c.column_id: column_phys_kind(c) for c in columns}
+    col_scales = {c.column_id: _dec_scale_of(c, col_kinds[c.column_id])
+                  for c in columns}
     pk_col = next((c for c in columns if c.pk_handle), None)
 
     handles: list[int] = []
@@ -187,7 +225,7 @@ def _scan_rows(snapshot, table_id: int, columns, ranges, defaults):
                 d = row.get(cid)
                 if d is None:
                     d = defaults.get(cid, NULL)
-                v, ok = datum_to_phys(d, col_kinds[cid])
+                v, ok = datum_to_phys(d, col_kinds[cid], col_scales[cid])
                 raw[cid].append(v)
                 valid[cid].append(ok)
     return handles, raw, valid
@@ -221,7 +259,7 @@ def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
             cols[cid] = _pack_str_column(raw[cid], va, cap, n)
             cols[cid].tp = c.tp
         else:
-            dtype = np.int64 if kind == K_I64 else np.float64
+            dtype = np.float64 if kind == K_F64 else np.int64
             vals = np.zeros(cap, dtype=dtype)
             if n:
                 src = raw[cid]
@@ -230,7 +268,11 @@ def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
                 else:
                     vals[:n] = [x if ok else 0
                                 for x, ok in zip(src, valid[cid])]
-            cols[cid] = ColumnData(kind, vals, va, tp=c.tp)
+            cols[cid] = ColumnData(
+                kind, vals, va, tp=c.tp,
+                dec_scale=_dec_scale_of(c, kind),
+                dec_max_abs=(int(np.abs(vals[:n]).max())
+                             if kind == K_DEC and n else 0))
     batch = ColumnBatch(n, cap, h, cols)
     batch.max_handle = int(max(handles)) if n else I64_MIN
     return batch
@@ -290,7 +332,7 @@ def append_rows(batch: ColumnBatch, snapshot, table_id: int,
                               for v in new_vals]
             cols[cid] = ColumnData(K_STR, codes, va, merged, tp=c.tp)
         else:
-            dtype = np.int64 if kind == K_I64 else np.float64
+            dtype = np.float64 if kind == K_F64 else np.int64
             vals = np.zeros(cap, dtype=dtype)
             vals[:n_old] = old.values[:n_old]
             src = raw[cid]
@@ -299,7 +341,11 @@ def append_rows(batch: ColumnBatch, snapshot, table_id: int,
             else:
                 vals[n_old:n] = [x if ok else 0
                                  for x, ok in zip(src, valid[cid])]
-            cols[cid] = ColumnData(kind, vals, va, tp=c.tp)
+            cols[cid] = ColumnData(
+                kind, vals, va, tp=c.tp,
+                dec_scale=_dec_scale_of(c, kind),
+                dec_max_abs=(int(np.abs(vals[:n]).max())
+                             if kind == K_DEC and n else 0))
     out = ColumnBatch(n, cap, h, cols)
     out.max_handle = max(after, int(max(handles)))
     return out
@@ -340,7 +386,9 @@ def pack_index_ranges(snapshot, index_info, ranges) -> ColumnBatch:
                     continue  # handle (below) is authoritative — the pk
                     # may ALSO be an explicit index column, and a double
                     # append would corrupt the plane
-                v, ok = datum_to_phys(d, col_kinds[c.column_id])
+                v, ok = datum_to_phys(
+                    d, col_kinds[c.column_id],
+                    _dec_scale_of(c, col_kinds[c.column_id]))
                 raw[c.column_id].append(v)
                 valid[c.column_id].append(ok)
             if pk_col is not None:
@@ -360,12 +408,16 @@ def pack_index_ranges(snapshot, index_info, ranges) -> ColumnBatch:
             cols[cid] = _pack_str_column(raw[cid], va, cap, n)
             cols[cid].tp = c.tp
         else:
-            dtype = np.int64 if kind == K_I64 else np.float64
+            dtype = np.float64 if kind == K_F64 else np.int64
             vals = np.zeros(cap, dtype=dtype)
             if n:
                 vals[:n] = [x if ok else 0
                             for x, ok in zip(raw[cid], valid[cid])]
-            cols[cid] = ColumnData(kind, vals, va, tp=c.tp)
+            cols[cid] = ColumnData(
+                kind, vals, va, tp=c.tp,
+                dec_scale=_dec_scale_of(c, kind),
+                dec_max_abs=(int(np.abs(vals[:n]).max())
+                             if kind == K_DEC and n else 0))
     return ColumnBatch(n, cap, h, cols)
 
 

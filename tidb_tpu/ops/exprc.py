@@ -34,17 +34,43 @@ class Unsupported(errors.TiDBError):
     """Expr shape the TPU engine can't lower; request stays on CPU/SQL."""
 
 
+# largest result scale a fixed-point product may reach before the scaled
+# int64 sum headroom (9.2e18 / 10^scale) gets too small (SURVEY §7:
+# "fixed-point int64 with guarded exactness"; int128 kernels would lift it)
+MAX_DEC_SCALE = 6
+
+
+# exact-arithmetic bound: intermediate scaled values must stay below this
+# or the request falls back to the CPU engine (int64 would silently wrap)
+DEC_ABS_LIMIT = 1 << 62
+
+
 class CompiledExpr:
     """A lowered expression: call with {col_id: (values, valid)} device
     planes → (values, valid) arrays. `batch` supplies dictionaries and
-    column kinds at lowering time (host-side constant folding)."""
+    column kinds at lowering time (host-side constant folding).
 
-    def __init__(self, fn, kind: str):
+    kind 'dec' is EXACT fixed-point: an int64 plane scaled by 10^scale,
+    with max_abs bounding |values| (from the batch's actual data) so every
+    derived expression can PROVE it cannot overflow — an unprovable shape
+    raises Unsupported and the CPU answers exactly instead. Mixing with a
+    float converts to f64 (MySQL's float context)."""
+
+    def __init__(self, fn, kind: str, scale: int = 0, max_abs: int = 0):
         self.fn = fn
-        self.kind = kind  # result physical kind: i64 / f64 / bool
+        self.kind = kind  # result physical kind: i64 / f64 / dec / bool
+        self.scale = scale
+        self.max_abs = max_abs
 
     def __call__(self, planes):
         return self.fn(planes)
+
+
+def _dec_guard(bound: int, what: str) -> int:
+    if bound >= DEC_ABS_LIMIT:
+        raise Unsupported(f"fixed-point {what} may exceed int64 "
+                          "(exact result stays on the CPU engine)")
+    return bound
 
 
 def compile_expr(e: Expr, batch: col.ColumnBatch) -> CompiledExpr:
@@ -62,7 +88,9 @@ def compile_expr(e: Expr, batch: col.ColumnBatch) -> CompiledExpr:
             raise Unsupported(f"column {cid} not packed")
         kind = cd.kind
         return CompiledExpr(lambda planes: planes[cid],
-                            col.K_I64 if kind == col.K_STR else kind)
+                            col.K_I64 if kind == col.K_STR else kind,
+                            scale=getattr(cd, "dec_scale", 0),
+                            max_abs=getattr(cd, "dec_max_abs", 0))
     if tp == ExprType.OPERATOR:
         return _compile_operator(e, batch)
     if tp in (ExprType.IN, ExprType.NOT_IN):
@@ -111,15 +139,24 @@ def _const(d: Datum) -> CompiledExpr:
     if k in (Kind.INT64, Kind.UINT64):
         v = int(d.val)
         return CompiledExpr(lambda planes: (jnp.int64(v), jnp.bool_(True)),
-                            col.K_I64)
+                            col.K_I64, max_abs=abs(v))
     if k == Kind.FLOAT64:
         v = float(d.val)
         return CompiledExpr(lambda planes: (jnp.float64(v), jnp.bool_(True)),
                             col.K_F64)
     if k == Kind.DECIMAL:
-        v = float(d.val)
-        return CompiledExpr(lambda planes: (jnp.float64(v), jnp.bool_(True)),
-                            col.K_F64)
+        # exact fixed-point at the constant's own scale
+        from decimal import Decimal
+        dv: Decimal = d.val
+        exp = -dv.as_tuple().exponent
+        scale = max(0, exp)
+        if scale > MAX_DEC_SCALE:
+            raise Unsupported(f"decimal constant scale {scale} too fine")
+        iv = int(dv * (10 ** scale))
+        _dec_guard(abs(iv), "constant")
+        return CompiledExpr(
+            lambda planes: (jnp.int64(iv), jnp.bool_(True)),
+            col.K_DEC, scale=scale, max_abs=abs(iv))
     if k == Kind.TIME:
         v = int(d.val.to_packed_int())  # plane encoding (columnar)
         return CompiledExpr(lambda planes: (jnp.int64(v), jnp.bool_(True)),
@@ -135,6 +172,10 @@ def _const(d: Datum) -> CompiledExpr:
 
 
 def _merge_kind(a: str, b: str) -> str:
+    if col.K_DEC in (a, b):
+        # IF/IFNULL branches would need scale unification — CPU keeps
+        # these exact instead
+        raise Unsupported("decimal in control function stays on CPU")
     if "f64" in (a, b):
         return col.K_F64
     return col.K_I64
@@ -175,6 +216,51 @@ def _promote(av, bv, kind: str):
     return av, bv
 
 
+def _to_f64(v, kind: str, scale: int):
+    f = v.astype(jnp.float64) if v.dtype != jnp.float64 else v
+    if kind == col.K_DEC and scale:
+        f = f / (10.0 ** scale)
+    return f
+
+
+def _align(ca: CompiledExpr, cb: CompiledExpr):
+    """Common representation for a binary numeric op: returns
+    (transform_a, transform_b, kind, scale). Fixed-point decimals stay
+    EXACT (rescale to the max scale as int64); a float operand drags both
+    sides into f64 (MySQL float context, matching xeval)."""
+    ka, kb = ca.kind, cb.kind
+    ident = lambda v: v  # noqa: E731
+    if col.K_F64 in (ka, kb):
+        return (lambda v: _to_f64(v, ka, ca.scale),
+                lambda v: _to_f64(v, kb, cb.scale), col.K_F64, 0)
+    if col.K_DEC in (ka, kb):
+        sa = ca.scale if ka == col.K_DEC else 0
+        sb = cb.scale if kb == col.K_DEC else 0
+        s = max(sa, sb)
+        # rescaling multiplies the plane — prove it can't wrap
+        _dec_guard(_max_abs_of(ca) * 10 ** (s - sa), "rescale")
+        _dec_guard(_max_abs_of(cb) * 10 ** (s - sb), "rescale")
+
+        def scaler(sc):
+            mul = 10 ** (s - sc)
+            if mul == 1:
+                return lambda v: v.astype(jnp.int64) \
+                    if v.dtype != jnp.int64 else v
+            return lambda v: v.astype(jnp.int64) * jnp.int64(mul)
+        return scaler(sa), scaler(sb), col.K_DEC, s
+    return ident, ident, col.K_I64, 0
+
+
+def _max_abs_of(c: CompiledExpr) -> int:
+    """Magnitude bound of an operand feeding fixed-point arithmetic.
+    i64 operands (plain int columns/consts) have no tracked bound — treat
+    conservatively as 2^31 (a wider int column mixing into decimal math
+    falls back via the guard)."""
+    if c.kind == col.K_DEC or c.max_abs:
+        return c.max_abs
+    return 1 << 31
+
+
 def _bcast2(fn):
     return fn
 
@@ -209,7 +295,8 @@ def _compile_operator(e: Expr, batch: col.ColumnBatch) -> CompiledExpr:
             def uneg(planes, c=c):
                 v, va = c(planes)
                 return -v, va
-            return CompiledExpr(uneg, c.kind)
+            return CompiledExpr(uneg, c.kind, scale=c.scale,
+                                max_abs=c.max_abs)
         if op == Op.UnaryPlus:
             return c
         raise Unsupported(f"unary op {op!r}")
@@ -271,13 +358,12 @@ def _compile_compare(e: Expr, batch) -> CompiledExpr:
         raise Unsupported("mixed string/non-string comparison")
     if str_a is not None and str_b is not None:
         raise Unsupported("column-column string compare needs shared dict")
-    kind = _merge_kind(ca.kind, cb.kind)
+    ta, tb, _kind, _scale = _align(ca, cb)
 
-    def cmp(planes, ca=ca, cb=cb, op=op, kind=kind):
+    def cmp(planes, ca=ca, cb=cb, op=op, ta=ta, tb=tb):
         av, aa = ca(planes)
         bv, bb = cb(planes)
-        av, bv = _promote(av, bv, kind)
-        return _cmp_arrays(op, av, bv), aa & bb
+        return _cmp_arrays(op, ta(av), tb(bv)), aa & bb
     return CompiledExpr(cmp, "bool")
 
 
@@ -363,13 +449,44 @@ def _compile_arith(e: Expr, batch) -> CompiledExpr:
     cb = compile_expr(e.children[1], batch)
     if "strconst" in (ca.kind, cb.kind):
         raise Unsupported("arithmetic on string constant")
+    dec_in = col.K_DEC in (ca.kind, cb.kind) \
+        and col.K_F64 not in (ca.kind, cb.kind)
+    if dec_in and op in (Op.Div, Op.IntDiv, Op.Mod):
+        raise Unsupported("decimal division stays exact on the CPU side")
+    if dec_in and op == Op.Mul:
+        # product scale adds; values multiply directly (exact)
+        scale = (ca.scale if ca.kind == col.K_DEC else 0) \
+            + (cb.scale if cb.kind == col.K_DEC else 0)
+        if scale > MAX_DEC_SCALE:
+            raise Unsupported(f"decimal product scale {scale} too fine")
+        bound = _dec_guard(_max_abs_of(ca) * _max_abs_of(cb), "product")
+
+        def dmul(planes, ca=ca, cb=cb):
+            av, aa = ca(planes)
+            bv, bb = cb(planes)
+            return av.astype(jnp.int64) * bv.astype(jnp.int64), aa & bb
+        return CompiledExpr(dmul, col.K_DEC, scale=scale, max_abs=bound)
+    if dec_in:
+        ta, tb, _k, scale = _align(ca, cb)
+        sa = ca.scale if ca.kind == col.K_DEC else 0
+        sb = cb.scale if cb.kind == col.K_DEC else 0
+        bound = _dec_guard(_max_abs_of(ca) * 10 ** (scale - sa)
+                           + _max_abs_of(cb) * 10 ** (scale - sb), "sum")
+
+        def daddsub(planes, ca=ca, cb=cb, op=op, ta=ta, tb=tb):
+            av, aa = ca(planes)
+            bv, bb = cb(planes)
+            av, bv = ta(av), tb(bv)
+            return (av + bv if op == Op.Plus else av - bv), aa & bb
+        return CompiledExpr(daddsub, col.K_DEC, scale=scale, max_abs=bound)
     kind = col.K_F64 if (op == Op.Div or col.K_F64 in (ca.kind, cb.kind)) \
         else col.K_I64
 
     def arith(planes, ca=ca, cb=cb, op=op, kind=kind):
         av, aa = ca(planes)
         bv, bb = cb(planes)
-        av, bv = _promote(av, bv, kind)
+        av = _to_f64(av, ca.kind, ca.scale) if kind == col.K_F64 else av
+        bv = _to_f64(bv, cb.kind, cb.scale) if kind == col.K_F64 else bv
         valid = aa & bb
         if op == Op.Plus:
             return av + bv, valid
@@ -424,7 +541,7 @@ def _compile_in(e: Expr, batch, negated: bool) -> CompiledExpr:
         return CompiledExpr(str_in, "bool")
 
     ct = compile_expr(target, batch)
-    consts = []
+    raw = []
     has_null = False
     kind = ct.kind
     for it in items:
@@ -436,16 +553,33 @@ def _compile_in(e: Expr, batch, negated: bool) -> CompiledExpr:
         v = it.val.as_number()
         if isinstance(v, float):
             kind = col.K_F64
-        consts.append(v)
+        raw.append(v)
+    consts = []
+    if kind == col.K_DEC:
+        from decimal import Decimal
+        for v in raw:
+            scaled = (Decimal(v) if not isinstance(v, Decimal) else v) \
+                * (10 ** ct.scale)
+            if scaled == int(scaled) and abs(int(scaled)) < DEC_ABS_LIMIT:
+                consts.append(int(scaled))
+            # inexact / beyond the plane bound: can never match — drop
+    elif kind == col.K_F64:
+        consts = [float(v) for v in raw]
+    else:
+        consts = [int(v) for v in raw]
     arr = jnp.asarray(consts, dtype=jnp.float64 if kind == col.K_F64
                       else jnp.int64) if consts \
         else jnp.asarray([], dtype=jnp.int64)
+    dec_div = (10.0 ** ct.scale) if (kind == col.K_F64
+                                     and ct.kind == col.K_DEC) else 1.0
 
     def num_in(planes, ct=ct, arr=arr, has_null=has_null, negated=negated,
-               kind=kind):
+               kind=kind, dec_div=dec_div):
         v, va = ct(planes)
         if kind == col.K_F64 and v.dtype != jnp.float64:
             v = v.astype(jnp.float64)
+        if dec_div != 1.0:
+            v = v / dec_div
         if arr.size:
             hit = jnp.any(v[:, None] == arr[None, :], axis=1)
         else:

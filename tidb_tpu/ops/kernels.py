@@ -125,6 +125,14 @@ def lower_aggregates(req: SelectRequest, batch: col.ColumnBatch) -> list[AggSpec
             raise Unsupported(f"aggregate {name} not lowered yet")
         if e.distinct and name == "first_row":
             raise Unsupported("distinct first_row")
+        if name in ("sum", "avg") and e.children:
+            probe = compile_expr(e.children[0], batch)
+            if probe.kind == col.K_DEC:
+                # scaled-int sums must provably fit int64: worst case is
+                # every row contributing the batch's max magnitude
+                from tidb_tpu.ops.exprc import _dec_guard
+                _dec_guard(probe.max_abs * max(batch.n_rows, 1),
+                           "aggregate sum")
         if name == "first_row":
             # exact first-row semantics need a host-side gather by row
             # position, which needs the argument to be a plain column
@@ -200,7 +208,10 @@ def lower_group_by(req: SelectRequest, batch: col.ColumnBatch) -> GroupSpec:
             _codes, uniq = batch.group_codes(cid)
             sizes.append(max(len(uniq), 1))
             plane_keys.append(group_code_key(cid))
-            decoders.append(("num", uniq))
+            if kind == col.K_DEC:
+                decoders.append(("dec", uniq, cd.dec_scale))
+            else:
+                decoders.append(("num", uniq))
         num_segments *= sizes[-1] + 1
     if num_segments + 1 <= RADIX_MAX_SEGMENTS:
         return GroupSpec("radix", cids, sizes, kinds, plane_keys, decoders)

@@ -21,7 +21,9 @@ def _kind_char(col) -> str | None:
         k = col_mod.column_phys_kind(col)
     except Exception:
         return None
-    return {"i64": "i", "f64": "f", "str": "s"}[k]
+    # "dec" falls back to the Python scan: the C decoder doesn't do the
+    # exact fixed-point scaling (Decimal wire values need Python anyway)
+    return {"i64": "i", "f64": "f", "str": "s"}.get(k)
 
 
 def scan_rows(snapshot, table_id: int, columns, ranges, defaults):

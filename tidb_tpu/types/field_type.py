@@ -81,6 +81,10 @@ class FieldType:
             s += " unsigned"
         return s
 
+    def type_name(self) -> str:
+        """Bare type word (information_schema DATA_TYPE column)."""
+        return self.compact_str().split("(")[0].split(" ")[0]
+
 
 def new_field_type(tp: int) -> FieldType:
     ft = FieldType(tp)
