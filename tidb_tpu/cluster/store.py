@@ -126,17 +126,27 @@ class DistCoprClient(kv.Client):
 
     def send(self, req: kv.Request) -> kv.Response:
         sel: SelectRequest = req.data
-        responses = []
         ranges = list(req.key_ranges)
-        if req.desc or sel.desc:
-            # per-range results still come back low→high per region; the
-            # desc ordering applies across tasks
-            for rg in reversed(ranges):
-                responses.extend(reversed(self._exec_range(rg, sel)))
-        else:
-            for rg in ranges:
-                responses.extend(self._exec_range(rg, sel))
-        return _ListResponse(responses)
+        desc = bool(req.desc or sel.desc)
+        # per-range results still come back low→high per region; the desc
+        # ordering applies across tasks
+        tasks = list(reversed(ranges)) if desc else ranges
+
+        def run(rg: kv.KeyRange):
+            out = self._exec_range(rg, sel)
+            return list(reversed(out)) if desc else out
+
+        concurrency = max(1, getattr(req, "concurrency", 1) or 1)
+        if len(tasks) <= 1 or concurrency <= 1:
+            responses = []
+            for rg in tasks:
+                responses.extend(run(rg))
+            return _ListResponse(responses)
+        # copIterator (store/tikv/coprocessor.go:305): worker threads fan
+        # out per task, results stream back IN TASK ORDER so keep_order
+        # scans stay sorted while later regions fetch in the background
+        return _PipelinedResponse(tasks, run,
+                                  min(concurrency, len(tasks)))
 
     def _exec_range(self, rg: kv.KeyRange, sel: SelectRequest):
         """Worklist execution of one key range: each step serves the prefix
@@ -199,6 +209,66 @@ class _ListResponse(kv.Response):
         r = self._responses[self._i]
         self._i += 1
         return r
+
+
+class _PipelinedResponse(kv.Response):
+    """Streaming fan-out: worker threads execute tasks concurrently, the
+    consumer receives completed task results in TASK ORDER (the reference's
+    ordered copIterator.Next with its buffered channel,
+    store/tikv/coprocessor.go:348). A worker error surfaces on next()."""
+
+    def __init__(self, tasks, run, concurrency: int):
+        self._results: dict[int, list] = {}
+        self._next_task = 0
+        self._n = len(tasks)
+        self._cv = threading.Condition()
+        self._err: BaseException | None = None
+        self._buf: list = []
+        self._cursor = 0
+
+        task_iter = iter(enumerate(tasks))
+        iter_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with iter_lock:
+                    nxt = next(task_iter, None)
+                if nxt is None:
+                    return
+                idx, rg = nxt
+                try:
+                    out = run(rg)
+                except BaseException as e:  # surfaced to the consumer
+                    with self._cv:
+                        if self._err is None:
+                            self._err = e
+                        self._cv.notify_all()
+                    return
+                with self._cv:
+                    self._results[idx] = out
+                    self._cv.notify_all()
+
+        for _ in range(concurrency):
+            threading.Thread(target=worker, daemon=True).start()
+
+    def next(self):
+        if self._cursor < len(self._buf):
+            r = self._buf[self._cursor]
+            self._cursor += 1
+            return r
+        with self._cv:
+            while True:
+                if self._err is not None:
+                    raise self._err
+                if self._next_task >= self._n:
+                    return None
+                if self._next_task in self._results:
+                    self._buf = self._results.pop(self._next_task)
+                    self._cursor = 0
+                    self._next_task += 1
+                    break
+                self._cv.wait()
+        return self.next()
 
 
 class DistStore(kv.Storage):

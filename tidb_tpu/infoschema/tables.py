@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from tidb_tpu import mysqldef as my
 from tidb_tpu.model import ColumnInfo, TableInfo
+from tidb_tpu.table.virtual import VirtualTableBase
 from tidb_tpu.types import Datum
 from tidb_tpu.types.datum import NULL
 from tidb_tpu.types.field_type import FieldType
@@ -125,27 +126,13 @@ def rows_for(snapshot, table_id: int) -> list[list[Datum]]:
     return []
 
 
-class InfoVirtualTable:
+class InfoVirtualTable(VirtualTableBase):
     """information_schema table bound to its owning snapshot — reads are
     self-consistent with the statement's schema view."""
 
-    virtual = True
-
     def __init__(self, info: TableInfo, snapshot_ref):
-        self.info = info
-        self.id = info.id
+        super().__init__(info, "information_schema")
         self._snapshot_ref = snapshot_ref  # the owning InfoSchema
-        self.indices = []
 
-    def iter_records(self, retriever, start_handle=None, cols=None):
-        for i, row in enumerate(rows_for(self._snapshot_ref, self.id)):
-            yield i + 1, row
-
-    def _read_only(self, *_a, **_k):
-        from tidb_tpu import errors
-        raise errors.ExecError(
-            f"table information_schema.{self.info.name} is read-only")
-
-    add_record = _read_only
-    update_record = _read_only
-    remove_record = _read_only
+    def rows(self):
+        return rows_for(self._snapshot_ref, self.id)

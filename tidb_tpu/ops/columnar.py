@@ -50,6 +50,14 @@ def _dec_scale_of(c: PBColumnInfo, kind: str) -> int:
     return c.decimal if kind == K_DEC and c.decimal and c.decimal > 0 else 0
 
 
+def _plane_max_abs(vals: np.ndarray, n: int, kind: str) -> int:
+    """Magnitude bound of a numeric plane (exact-arithmetic guards).
+    Python-int abs: np.abs(int64 min) would itself wrap."""
+    if kind not in (K_DEC, K_I64) or n == 0:
+        return 0
+    return max(abs(int(vals[:n].min())), abs(int(vals[:n].max())))
+
+
 @dataclass
 class ColumnData:
     kind: str
@@ -58,8 +66,9 @@ class ColumnData:
     dictionary: list[bytes] | None = None  # K_STR: sorted code → bytes
     tp: int = 0                   # MySQL type byte (time/duration decode)
     dec_scale: int = 0            # K_DEC: values = datum * 10^dec_scale
-    dec_max_abs: int = 0          # K_DEC: max |scaled value| in the batch
-                                  # (the overflow-guard bound for exprc)
+    max_abs: int = 0              # K_DEC/K_I64: max |value| in the batch —
+                                  # the overflow-guard bound for exprc's
+                                  # exact fixed-point arithmetic
 
     def code_of(self, b: bytes) -> int:
         """Exact-match dictionary code, or -1."""
@@ -271,8 +280,7 @@ def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
             cols[cid] = ColumnData(
                 kind, vals, va, tp=c.tp,
                 dec_scale=_dec_scale_of(c, kind),
-                dec_max_abs=(int(np.abs(vals[:n]).max())
-                             if kind == K_DEC and n else 0))
+                max_abs=_plane_max_abs(vals, n, kind))
     batch = ColumnBatch(n, cap, h, cols)
     batch.max_handle = int(max(handles)) if n else I64_MIN
     return batch
@@ -344,8 +352,7 @@ def append_rows(batch: ColumnBatch, snapshot, table_id: int,
             cols[cid] = ColumnData(
                 kind, vals, va, tp=c.tp,
                 dec_scale=_dec_scale_of(c, kind),
-                dec_max_abs=(int(np.abs(vals[:n]).max())
-                             if kind == K_DEC and n else 0))
+                max_abs=_plane_max_abs(vals, n, kind))
     out = ColumnBatch(n, cap, h, cols)
     out.max_handle = max(after, int(max(handles)))
     return out
@@ -416,8 +423,7 @@ def pack_index_ranges(snapshot, index_info, ranges) -> ColumnBatch:
             cols[cid] = ColumnData(
                 kind, vals, va, tp=c.tp,
                 dec_scale=_dec_scale_of(c, kind),
-                dec_max_abs=(int(np.abs(vals[:n]).max())
-                             if kind == K_DEC and n else 0))
+                max_abs=_plane_max_abs(vals, n, kind))
     return ColumnBatch(n, cap, h, cols)
 
 

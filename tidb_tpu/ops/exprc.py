@@ -56,11 +56,12 @@ class CompiledExpr:
     raises Unsupported and the CPU answers exactly instead. Mixing with a
     float converts to f64 (MySQL's float context)."""
 
-    def __init__(self, fn, kind: str, scale: int = 0, max_abs: int = 0):
+    def __init__(self, fn, kind: str, scale: int = 0,
+                 max_abs: int | None = None):
         self.fn = fn
         self.kind = kind  # result physical kind: i64 / f64 / dec / bool
         self.scale = scale
-        self.max_abs = max_abs
+        self.max_abs = max_abs  # None = no tracked bound (0 IS a bound)
 
     def __call__(self, planes):
         return self.fn(planes)
@@ -90,7 +91,7 @@ def compile_expr(e: Expr, batch: col.ColumnBatch) -> CompiledExpr:
         return CompiledExpr(lambda planes: planes[cid],
                             col.K_I64 if kind == col.K_STR else kind,
                             scale=getattr(cd, "dec_scale", 0),
-                            max_abs=getattr(cd, "dec_max_abs", 0))
+                            max_abs=getattr(cd, "max_abs", 0))
     if tp == ExprType.OPERATOR:
         return _compile_operator(e, batch)
     if tp in (ExprType.IN, ExprType.NOT_IN):
@@ -253,12 +254,16 @@ def _align(ca: CompiledExpr, cb: CompiledExpr):
 
 def _max_abs_of(c: CompiledExpr) -> int:
     """Magnitude bound of an operand feeding fixed-point arithmetic.
-    i64 operands (plain int columns/consts) have no tracked bound — treat
-    conservatively as 2^31 (a wider int column mixing into decimal math
-    falls back via the guard)."""
-    if c.kind == col.K_DEC or c.max_abs:
+    Columns and constants carry bounds from real data; a derived i64
+    expression without one CANNOT be proven safe — fall back rather than
+    risk a silent wrap."""
+    if c.max_abs is not None:
         return c.max_abs
-    return 1 << 31
+    if c.kind == col.K_DEC:
+        return 0  # dec without a bound only arises for empty planes
+    raise Unsupported(
+        "operand magnitude unknown in fixed-point arithmetic "
+        "(exact result stays on the CPU engine)")
 
 
 def _bcast2(fn):

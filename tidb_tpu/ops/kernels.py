@@ -125,20 +125,19 @@ def lower_aggregates(req: SelectRequest, batch: col.ColumnBatch) -> list[AggSpec
             raise Unsupported(f"aggregate {name} not lowered yet")
         if e.distinct and name == "first_row":
             raise Unsupported("distinct first_row")
-        if name in ("sum", "avg") and e.children:
-            probe = compile_expr(e.children[0], batch)
-            if probe.kind == col.K_DEC:
-                # scaled-int sums must provably fit int64: worst case is
-                # every row contributing the batch's max magnitude
-                from tidb_tpu.ops.exprc import _dec_guard
-                _dec_guard(probe.max_abs * max(batch.n_rows, 1),
-                           "aggregate sum")
         if name == "first_row":
             # exact first-row semantics need a host-side gather by row
             # position, which needs the argument to be a plain column
             if not e.children or e.children[0].tp != ExprType.COLUMN_REF:
                 raise Unsupported("first_row lowering needs a column arg")
         arg = compile_expr(e.children[0], batch) if e.children else None
+        if name in ("sum", "avg") and arg is not None \
+                and arg.kind == col.K_DEC:
+            # scaled-int sums must provably fit int64: worst case is
+            # every row contributing the batch's max magnitude
+            from tidb_tpu.ops.exprc import _dec_guard
+            _dec_guard((arg.max_abs or 0) * max(batch.n_rows, 1),
+                       "aggregate sum")
         specs.append(AggSpec(name, arg, e.distinct))
     return specs
 

@@ -19,6 +19,7 @@ from collections import OrderedDict, deque
 
 from tidb_tpu import mysqldef as my
 from tidb_tpu.model import ColumnInfo, TableInfo
+from tidb_tpu.table.virtual import VirtualTableBase
 from tidb_tpu.types import Datum
 from tidb_tpu.types.datum import NULL
 from tidb_tpu.types.field_type import FieldType
@@ -168,29 +169,12 @@ def perf_for(store) -> PerfSchema:
         return ps
 
 
-class VirtualTable:
-    """Duck-types the table.Table read surface over in-memory rows; never
-    touches KV (infoschema/tables.go virtual table pattern)."""
-
-    virtual = True
+class VirtualTable(VirtualTableBase):
+    """performance_schema table bound to its store's event registry."""
 
     def __init__(self, info: TableInfo, store):
-        self.info = info
-        self.id = info.id
+        super().__init__(info, "performance_schema")
         self.store = store
-        self.indices = []
 
-    def iter_records(self, retriever, start_handle=None, cols=None):
-        rows = perf_for(self.store).rows(self.id)
-        for i, row in enumerate(rows):
-            yield i + 1, row
-
-    # write surface: clean read-only errors instead of AttributeError
-    def _read_only(self, *_a, **_k):
-        from tidb_tpu import errors
-        raise errors.ExecError(
-            f"table performance_schema.{self.info.name} is read-only")
-
-    add_record = _read_only
-    update_record = _read_only
-    remove_record = _read_only
+    def rows(self):
+        return perf_for(self.store).rows(self.id)

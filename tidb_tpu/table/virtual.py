@@ -1,0 +1,37 @@
+"""Shared base for virtual (in-memory) tables.
+
+Reference: the virtual-table pattern of infoschema/tables.go and
+perfschema/ — rows synthesized on every read, no KV behind them, clean
+read-only errors on the write surface. The planner routes `virtual = True`
+tables to MemTableExec with all filtering SQL-side."""
+
+from __future__ import annotations
+
+
+class VirtualTableBase:
+    virtual = True
+
+    def __init__(self, info, db_name: str):
+        self.info = info
+        self.id = info.id
+        self.db_name = db_name
+        self.indices = []
+
+    # subclasses yield rows; retriever/cols are part of the Table read
+    # protocol but meaningless here
+    def rows(self):  # pragma: no cover - overridden
+        return []
+
+    def iter_records(self, retriever, start_handle=None, cols=None):
+        for i, row in enumerate(self.rows()):
+            yield i + 1, row
+
+    # write surface: one implementation of the read-only contract
+    def _read_only(self, *_a, **_k):
+        from tidb_tpu import errors
+        raise errors.ExecError(
+            f"table {self.db_name}.{self.info.name} is read-only")
+
+    add_record = _read_only
+    update_record = _read_only
+    remove_record = _read_only
