@@ -109,6 +109,87 @@ class TestPerfSchema:
         assert len(ps.rows(perfschema.T_STMT_CURRENT)) == \
             perfschema.CURRENT_CAP
 
+    def test_show_processlist_and_kill(self):
+        from tidb_tpu import errors
+        from tidb_tpu.session import Session
+        tk = TestKit()
+        other = Session(tk.store)
+        rows = tk.exec("show processlist").rows
+        ids = {int(r[0]) for r in rows}
+        assert tk.session.vars.connection_id in ids
+        assert other.vars.connection_id in ids
+        tk.exec(f"kill {other.vars.connection_id}")
+        import pytest as _pytest
+        with _pytest.raises(errors.TiDBError):
+            other.execute("select 1")
+        other.execute("select 1")  # one interruption, then normal service
+
+    def test_kill_connection_closes_wire_socket(self):
+        from tidb_tpu.server import Client, Server
+        from tidb_tpu.session import new_store
+        from tests.testkit import _store_id
+        store = new_store(f"memory://killconn{next(_store_id)}")
+        srv = Server(store)
+        srv.start()
+        try:
+            victim = Client("127.0.0.1", srv.port)
+            victim.query("select 1")
+            admin = Client("127.0.0.1", srv.port)
+            vid = next(int(r[0]) for r in admin.query(
+                "show processlist")[0].rows
+                if (r[7] or "") == "select 1")
+            admin.query(f"kill connection {vid}")
+            import pytest as _pytest
+            with _pytest.raises(Exception):
+                victim.query("select 1")  # socket closed
+            admin.query("select 1")       # admin unaffected
+            admin.close()
+        finally:
+            srv.close()
+
+    def test_internal_sessions_hidden_and_unkillable(self):
+        """The server's auth session must not appear in PROCESSLIST (and
+        so can't be killed to break logins)."""
+        from tidb_tpu.server import Client, Server
+        from tidb_tpu.session import new_store, sessions_for
+        from tests.testkit import _store_id
+        store = new_store(f"memory://killauth{next(_store_id)}")
+        srv = Server(store)
+        srv.start()
+        try:
+            c = Client("127.0.0.1", srv.port)
+            ids = {s.vars.connection_id for s in sessions_for(store)}
+            assert srv._auth_session.vars.connection_id not in ids
+            c.close()
+            c2 = Client("127.0.0.1", srv.port)  # auth still works
+            c2.query("select 1")
+            c2.close()
+        finally:
+            srv.close()
+
+    def test_processlist_hides_other_users_without_grant(self):
+        from tidb_tpu.session import Session
+        tk = TestKit()
+        tk.exec("create user 'pl1'")
+        restricted = Session(tk.store)
+        restricted.vars.user = "pl1"
+        rows = restricted.execute("show processlist")[0].values()
+        users = {(r[1].decode() if isinstance(r[1], bytes) else r[1])
+                 for r in rows}
+        assert users <= {"pl1"}
+
+    def test_kill_other_user_needs_grant(self):
+        from tidb_tpu.privilege import AccessDenied
+        from tidb_tpu.session import Session
+        import pytest as _pytest
+        tk = TestKit()
+        tk.exec("create user 'k1'")
+        victim = Session(tk.store)
+        attacker = Session(tk.store)
+        attacker.vars.user = "k1"
+        with _pytest.raises(AccessDenied):
+            attacker.execute(f"kill {victim.vars.connection_id}")
+
     def test_join_virtual_with_real_table(self):
         """Virtual tables flow through the regular planner: joins work."""
         tk = TestKit()

@@ -72,6 +72,8 @@ def execute_simple(session, stmt) -> ResultSet | None:
         return _drop_user(session, stmt)
     if isinstance(stmt, ast.LoadDataStmt):
         return _load_data(session, stmt)
+    if isinstance(stmt, ast.KillStmt):
+        return _kill(session, stmt)
     raise errors.ExecError(f"unsupported statement {type(stmt).__name__}")
 
 
@@ -272,6 +274,29 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
         rows = [[n, v] for n, v in metrics.registry.snapshot()]
         return _str_rs(["Variable_name", "Value"],
                        _like_filter(rows, stmt.pattern))
+    if tp == ast.ShowType.PROCESSLIST:
+        from tidb_tpu import perfschema, privilege as pv
+        from tidb_tpu.session import sessions_for
+        ps = perfschema.perf_for(session.store)
+        # MySQL gates other users' rows behind PROCESS; global Grant is
+        # this engine's administrative stand-in
+        me = session.vars.user
+        see_all = not me or pv.checker_for(session.store).check(
+            me, "", "", "Grant")
+        rows = []
+        for s in sorted(sessions_for(session.store),
+                        key=lambda s: s.vars.connection_id):
+            if not see_all and s.vars.user != me:
+                continue
+            cid = s.vars.connection_id
+            info = ps.current_sql(cid)
+            if info and not stmt.full:
+                info = info[:100]
+            rows.append([str(cid), s.vars.user or "", "localhost",
+                         s.vars.current_db or None, "Query", "0", "",
+                         info])
+        return _str_rs(["Id", "User", "Host", "db", "Command", "Time",
+                        "State", "Info"], rows)
     if tp == ast.ShowType.GRANTS:
         from tidb_tpu import privilege as pv
         user = stmt.pattern or session.vars.user or "root"
@@ -593,7 +618,7 @@ def _internal(session):
     bypass the privilege check the CALLING statement already passed
     (session.go ExecRestrictedSQL)."""
     from tidb_tpu.session import Session
-    return Session(session.store)
+    return Session(session.store, internal=True)
 
 
 def _user_exists(internal, user: str) -> bool:
@@ -614,6 +639,33 @@ def _ensure_user(internal, spec, must_exist_ok: bool = True) -> None:
     internal.execute(
         "insert into mysql.user (Host, User, Password) values "
         f"('{_esc(spec.host)}', '{_esc(spec.user)}', '{pw}')")
+
+
+def _kill(session, stmt: ast.KillStmt) -> None:
+    """KILL QUERY id: flag the target session; its next statement boundary
+    raises ER_QUERY_INTERRUPTED (coarse-grained — no mid-statement
+    preemption). KILL [CONNECTION] id additionally closes the target's
+    wire socket (server/conn.go kill path); a library session has no
+    socket, so CONNECTION degrades to the flag."""
+    from tidb_tpu import privilege as pv
+    from tidb_tpu.session import sessions_for
+    target = next((s for s in sessions_for(session.store)
+                   if s.vars.connection_id == stmt.conn_id), None)
+    if target is None:
+        raise errors.ExecError(f"Unknown thread id: {stmt.conn_id}",
+                               code=1094)
+    if session.vars.user and target.vars.user != session.vars.user \
+            and not pv.checker_for(session.store).check(
+                session.vars.user, "", "", "Grant"):
+        raise pv.AccessDenied(
+            "You are not owner of thread " + str(stmt.conn_id))
+    target.killed = True
+    if not stmt.query_only:
+        wc = getattr(target, "_wire_conn", None)
+        if wc is not None:
+            wc.alive = False
+            wc.pkt.close()
+    return None
 
 
 def _grant_revoke(session, stmt) -> None:

@@ -153,6 +153,7 @@ class Parser:
             "ADMIN": self._parse_admin,
             "ANALYZE": self._parse_analyze,
             "LOAD": self._parse_load_data,
+            "KILL": self._parse_kill,
             "GRANT": self._parse_grant,
             "REVOKE": self._parse_revoke,
             "PREPARE": self._parse_prepare,
@@ -812,6 +813,9 @@ class Parser:
             if self._try_kw("LIKE"):
                 pattern = str(self._next().val)
             return ast.ShowStmt(tp=ast.ShowType.STATUS, pattern=pattern)
+        if self._at(lx.IDENT) and self._cur().val.lower() == "processlist":
+            self._next()
+            return ast.ShowStmt(tp=ast.ShowType.PROCESSLIST, full=full)
         if self._at(lx.IDENT) and self._cur().val.lower() == "grants":
             self._next()
             user = ""
@@ -941,6 +945,15 @@ class Parser:
             self._expect_op(")")
             stmt.columns = cols
         return stmt
+
+    def _parse_kill(self) -> ast.KillStmt:
+        self._expect_kw("KILL")
+        query_only = False
+        if self._at(lx.IDENT) and self._cur().val.lower() in ("query",
+                                                             "connection"):
+            query_only = self._next().val.lower() == "query"
+        t = self._next()
+        return ast.KillStmt(conn_id=int(t.val), query_only=query_only)
 
     # ================= GRANT / REVOKE (parser.y GrantStmt) =================
 

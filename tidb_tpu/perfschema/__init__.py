@@ -137,6 +137,13 @@ class PerfSchema:
                 ev.message = error
             self._history.append(ev)
 
+    def current_sql(self, thread_id: int) -> str | None:
+        """Locked accessor for the thread's latest statement text (SHOW
+        PROCESSLIST Info column)."""
+        with self._lock:
+            ev = self._current.get(thread_id)
+            return ev.sql_text if ev is not None else None
+
     # ---- virtual-table row providers ----
 
     def rows(self, table_id: int) -> list[list[Datum]]:

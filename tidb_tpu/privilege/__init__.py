@@ -55,7 +55,7 @@ class Checker:
 
     def _load(self) -> None:
         from tidb_tpu.session import Session
-        s = Session(self.store)  # internal: no user → no recursion
+        s = Session(self.store, internal=True)  # no user → no recursion
         self._global.clear()
         self._db.clear()
         self._table.clear()

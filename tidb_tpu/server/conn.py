@@ -67,6 +67,7 @@ class ClientConnection:
         self.session = Session(self.server.store)
         self.session.vars.connection_id = self.conn_id
         self.session.vars.user = self.user
+        self.session._wire_conn = self  # KILL CONNECTION closes the socket
         if db:
             try:
                 self.session.execute(f"use `{db.replace(chr(96), '``')}`")

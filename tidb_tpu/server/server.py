@@ -30,7 +30,7 @@ class Server:
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         # one internal session for auth lookups (session.go ExecRestrictedSQL)
-        self._auth_session = Session(store)
+        self._auth_session = Session(store, internal=True)
         self._auth_lock = threading.Lock()
 
     # ------------------------------------------------------------------

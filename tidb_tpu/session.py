@@ -48,14 +48,44 @@ def _ensure_drivers():
         register_driver("cluster", ClusterDriver())
 
 
+_session_registry: dict[str, dict] = {}   # store uuid → {conn_id: weakref}
+_session_registry_lock = __import__("threading").Lock()
+
+
+def sessions_for(store) -> list["Session"]:
+    """Live sessions on a store (SHOW PROCESSLIST / KILL lookup)."""
+    with _session_registry_lock:
+        d = _session_registry.get(store.uuid(), {})
+        out = []
+        dead = []
+        for cid, ref in d.items():
+            s = ref()
+            if s is None:
+                dead.append(cid)
+            else:
+                out.append(s)
+        for cid in dead:
+            d.pop(cid, None)
+    return out
+
+
 class Session:
     """One connection's state. Reference: session.go session struct."""
 
-    def __init__(self, store):
+    def __init__(self, store, internal: bool = False):
         self.store = store
         self.domain = get_domain(store)
         self.vars = SessionVars()
         self.vars.connection_id = next(_conn_id_gen)
+        self.killed = False
+        # internal sessions (auth lookups, grant-table edits, stats loads)
+        # stay OUT of the processlist/KILL registry: killing the server's
+        # auth session would break every subsequent login
+        if not internal:
+            import weakref
+            with _session_registry_lock:
+                _session_registry.setdefault(store.uuid(), {})[
+                    self.vars.connection_id] = weakref.ref(self)
         self.global_vars = _global_vars_by_store.setdefault(
             store.uuid(), GlobalVars())
         self.vars._globals = self.global_vars
@@ -206,6 +236,12 @@ class Session:
 
     def _execute_one(self, stmt, sql_text: str,
                      record_history: bool = True) -> ResultSet | None:
+        if self.killed:
+            # KILL QUERY/CONNECTION semantics, coarse-grained: the flag
+            # interrupts the next statement boundary (ER_QUERY_INTERRUPTED)
+            self.killed = False
+            raise errors.ExecError("Query execution was interrupted",
+                                   code=1317)
         from tidb_tpu import perfschema
         ps = perfschema.perf_for(self.store)
         ev = ps.start_statement(self.vars.connection_id, sql_text)
@@ -486,7 +522,8 @@ def _is_simple(stmt) -> bool:
         ast.CreateTableStmt, ast.DropTableStmt, ast.TruncateTableStmt,
         ast.CreateIndexStmt, ast.DropIndexStmt, ast.AlterTableStmt,
         ast.AdminStmt, ast.AnalyzeTableStmt, ast.GrantStmt, ast.RevokeStmt,
-        ast.CreateUserStmt, ast.DropUserStmt, ast.LoadDataStmt))
+        ast.CreateUserStmt, ast.DropUserStmt, ast.LoadDataStmt,
+        ast.KillStmt))
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,7 @@ class ShowType(enum.IntEnum):
     WARNINGS = 7
     STATUS = 8        # metrics registry (SHOW STATUS)
     GRANTS = 9
+    PROCESSLIST = 10
 
 
 @dataclass
@@ -136,6 +137,13 @@ class LoadDataStmt(StmtNode):
     line_term: str = "\n"
     line_starting: str = ""
     ignore_lines: int = 0
+
+
+@dataclass
+class KillStmt(StmtNode):
+    """KILL [QUERY | CONNECTION] id (ast/misc.go KillStmt)."""
+    conn_id: int = 0
+    query_only: bool = False
 
 
 @dataclass
