@@ -147,6 +147,40 @@ class TestPerfSchema:
         finally:
             srv.close()
 
+    def test_kill_connection_tears_down_idle_victim(self):
+        """KILL CONNECTION must wake a peer blocked in recv (shutdown
+        before close) and free its session promptly (no conn↔session
+        reference cycle pinning the processlist row)."""
+        import time
+        from tidb_tpu.server import Client, Server
+        from tidb_tpu.session import new_store
+        from tests.testkit import _store_id
+        store = new_store(f"memory://killidle{next(_store_id)}")
+        srv = Server(store)
+        srv.start()
+        try:
+            victim = Client("127.0.0.1", srv.port)
+            victim.query("select 1")
+
+            def info(r):
+                v = r[7]
+                return v.decode() if isinstance(v, bytes) else (v or "")
+            admin = Client("127.0.0.1", srv.port)
+            vid = next(int(r[0]) for r in
+                       admin.query("show processlist")[0].rows
+                       if info(r) == "select 1")
+            admin.query(f"kill connection {vid}")
+            deadline = time.time() + 3.0
+            while time.time() < deadline:
+                rows = admin.query("show processlist")[0].rows
+                if all(int(r[0]) != vid for r in rows):
+                    break
+                time.sleep(0.05)
+            assert all(int(r[0]) != vid for r in rows)
+            admin.close()
+        finally:
+            srv.close()
+
     def test_internal_sessions_hidden_and_unkillable(self):
         """The server's auth session must not appear in PROCESSLIST (and
         so can't be killed to break logins)."""

@@ -17,7 +17,8 @@ from tidb_tpu.plan.plans import (
     Selection, ShowPlan, SimplePlan, Update,
 )
 from tidb_tpu.plan.rules import (
-    predicate_push_down, prune_columns, resolve_indices,
+    aggregation_push_down, predicate_push_down, prune_columns,
+    resolve_indices,
 )
 
 
@@ -40,6 +41,7 @@ def optimize_plan(p: Plan, ctx, client, dirty_table_ids=None) -> Plan:
         sel.add_child(p)
         sel.schema = p.schema
         p = sel
+    aggregation_push_down(p)
     if isinstance(p, (Insert, Update, Delete)):
         for c in p.children:
             prune_columns(c, None)

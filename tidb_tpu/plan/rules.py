@@ -299,6 +299,154 @@ def _ppd_join(join: Join, preds: list[Expression]):
 
 
 # ---------------------------------------------------------------------------
+# aggregation pushdown across joins (plan/aggregation_push_down.go)
+# ---------------------------------------------------------------------------
+
+_DECOMPOSABLE = frozenset(("sum", "count", "min", "max", "first_row"))
+
+
+def aggregation_push_down(p: Plan) -> None:
+    """Push partial aggregation below an INNER join: rows of the pushed
+    side group by (that side's group-by columns + its join-condition
+    columns), so every partial row joins with exactly the match set of its
+    members and the upper aggregation — flipped to FINAL mode — merges the
+    partials with identical semantics (aggregation_push_down.go
+    aggPushDown; decomposability per isDecomposable :37).
+
+    Slot discipline (the part the reference solves with schema surgery):
+    the lower Aggregation re-exposes the child's EXACT schema — each
+    agg-arg column's slot carries that function's partial, every other
+    slot carries first_row(col) — so the join's width/positions/conditions
+    and the upper plan need no rewriting at all."""
+    for c in p.children:
+        aggregation_push_down(c)
+    if isinstance(p, Apply):
+        aggregation_push_down(p.inner_plan)
+    if isinstance(p, Aggregation) and isinstance(p.child, Join) \
+            and p.child.join_type == Join.INNER:
+        _try_agg_push(p, p.child)
+
+
+def _try_agg_push(agg: Aggregation, join: Join) -> None:
+    from tidb_tpu.expression.aggregation import AggFunctionMode
+    lw = join._left_width
+
+    gby_positions = set()
+    for g in agg.group_by:
+        if not isinstance(g, Column):
+            return  # expression group keys: keep the aggregation above
+        gby_positions.add(g.position)
+
+    # classify funcs by side; every one must be decomposable with a bare
+    # single-column argument (the slot its partial hides in)
+    side_funcs: dict[int, list] = {0: [], 1: []}
+    arg_positions: set[int] = set()
+    for f in agg.agg_funcs:
+        if f.name not in _DECOMPOSABLE:
+            return
+        if f.distinct and f.name in ("sum", "count"):
+            return  # not decomposable (isDecomposable)
+        if len(f.args) != 1 or not isinstance(f.args[0], Column):
+            return  # count(*)/expressions: no slot to carry the partial
+        pos = f.args[0].position
+        if f.name == "first_row":
+            if pos not in gby_positions:
+                # a non-group first_row is "any row's value": pushing
+                # changes WHICH row wins — keep it deterministic
+                return
+            continue  # group-col first_row: mode-agnostic, claims no slot
+        if pos in arg_positions or pos in gby_positions:
+            return  # slot conflict: two consumers of one column
+        arg_positions.add(pos)
+        side_funcs[0 if pos < lw else 1].append(f)
+
+    # ONE side may be pre-aggregated. Collapsing a side changes how many
+    # join rows the OTHER side's rows appear in, so duplicate-SENSITIVE
+    # funcs (sum/count) are only sound on the pushed side; the other
+    # side may carry only duplicate-insensitive min/max (+ first_row of
+    # group columns, which are constant per group).
+    sc_sides = [s for s in (0, 1)
+                if any(f.name in ("sum", "count")
+                       for f in side_funcs[s])]
+    if len(sc_sides) > 1:
+        return
+    if sc_sides:
+        push_side = sc_sides[0]
+    elif side_funcs[0] or side_funcs[1]:
+        push_side = 0 if side_funcs[0] else 1
+    else:
+        return
+    funcs = side_funcs[push_side]  # first_row never lands here (it
+    # `continue`s out of classification above)
+    if _push_one_side(agg, join, push_side, funcs):
+        # the upper copies now merge partials (upper first_row over a
+        # group-constant slot is mode-agnostic and stays COMPLETE)
+        for f in funcs:
+            f.mode = AggFunctionMode.FINAL
+
+
+def _side_gby_cols(agg: Aggregation, join: Join, side: int) -> list:
+    """Child-scope group columns for the pushed side: the side's share of
+    the upper GROUP BY plus every column its join conditions read
+    (collectGbyCols — condition columns must become group keys so a
+    partial row's members share one match set)."""
+    lw = join._left_width
+    lo, hi = (0, lw) if side == 0 else (lw, 1 << 60)
+    out: dict[tuple, Column] = {}
+
+    def add_join_scope(c: Column):
+        if lo <= c.position < hi:
+            rb = _rebase_to_child(c, join, "left" if side == 0 else "right")
+            out[(rb.from_id, rb.position)] = rb
+
+    for g in agg.group_by:
+        add_join_scope(g)
+    for lcol, rcol in join.eq_conditions:
+        add_join_scope(lcol if side == 0 else rcol)
+    side_conds = join.left_conditions if side == 0 \
+        else join.right_conditions
+    for cond in side_conds:  # already child scope
+        for c in cond.columns():
+            out[(c.from_id, c.position)] = c
+    for cond in join.other_conditions:
+        for c in cond.columns():
+            add_join_scope(c)
+    return list(out.values())
+
+
+def _push_one_side(agg: Aggregation, join: Join, side: int, funcs) -> bool:
+    from tidb_tpu.expression import AggregationFunction, Schema
+    child = join.children[side]
+    side_name = "left" if side == 0 else "right"
+    gby_cols = _side_gby_cols(agg, join, side)
+    # a partial may not hide in a slot the join/group keys READ — e.g.
+    # sum(B.k) joined ON B.k would replace the key values with sums
+    gby_slots = {_pos_slot(child.schema, c.position) for c in gby_cols}
+    partial_by_slot: dict[int, AggregationFunction] = {}
+    for f in funcs:
+        rb_arg = _rebase_to_child(f.args[0], join, side_name)
+        pf = AggregationFunction(f.name, [rb_arg], distinct=f.distinct)
+        slot = _pos_slot(child.schema, rb_arg.position)
+        if slot in gby_slots:
+            return False
+        partial_by_slot[slot] = pf
+    lower = Aggregation([], [c.clone() for c in gby_cols])
+    lower.add_child(child)
+    # schema = CLONES of the child's columns (same identities, same order)
+    # so the join above is untouched; func i produces slot i
+    lower_funcs = []
+    for i, c in enumerate(child.schema.columns):
+        pf = partial_by_slot.get(i)
+        if pf is None:
+            pf = AggregationFunction("first_row", [c.clone()])
+        lower_funcs.append(pf)
+    lower.agg_funcs = lower_funcs
+    lower.schema = Schema([c.clone() for c in child.schema.columns])
+    join.children[side] = lower
+    return True
+
+
+# ---------------------------------------------------------------------------
 # column pruning
 # ---------------------------------------------------------------------------
 

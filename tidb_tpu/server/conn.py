@@ -244,5 +244,9 @@ class ClientConnection:
                 self.session.rollback_txn()
             except Exception:
                 pass
+            # break the conn↔session cycle so refcounting frees the
+            # session immediately (its processlist weakref dies with it)
+            self.session._wire_conn = None
+            self.session = None
         self.pkt.close()
         self.server.deregister(self)

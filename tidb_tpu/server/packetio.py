@@ -80,6 +80,13 @@ class PacketIO:
 
     def close(self) -> None:
         try:
+            # wake any thread blocked in recv() (KILL CONNECTION must
+            # tear down an IDLE peer too — close() alone doesn't send
+            # FIN while a read holds the descriptor)
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass

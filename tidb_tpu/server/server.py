@@ -23,7 +23,11 @@ class Server:
         self.host = host
         self.port = port
         self.running = False
-        self._conn_ids = itertools.count(1)
+        # wire connection ids come from the SESSION id space — a separate
+        # counter would collide with library/internal session ids in
+        # SHOW PROCESSLIST / KILL / perfschema thread ids
+        from tidb_tpu.session import _conn_id_gen
+        self._conn_ids = _conn_id_gen
         self._conns: set[ClientConnection] = set()
         self._conns_lock = threading.Lock()
         self._tokens = threading.BoundedSemaphore(token_limit)

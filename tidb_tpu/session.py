@@ -86,6 +86,10 @@ class Session:
             with _session_registry_lock:
                 _session_registry.setdefault(store.uuid(), {})[
                     self.vars.connection_id] = weakref.ref(self)
+                # bound across stores: short-lived (test) stores would
+                # otherwise pin their dicts forever
+                while len(_session_registry) > 64:
+                    _session_registry.pop(next(iter(_session_registry)))
         self.global_vars = _global_vars_by_store.setdefault(
             store.uuid(), GlobalVars())
         self.vars._globals = self.global_vars
