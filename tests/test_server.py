@@ -147,6 +147,44 @@ class TestQuery:
         assert c2.query("select count(1) from t")[0].rows == [["0"]]
         c2.close()
 
+    def test_driver_handshake_queries(self, srv):
+        """The statements real MySQL drivers issue right after connecting
+        must all succeed — and version()/@@version/handshake must agree."""
+        from tidb_tpu import mysqldef as my
+        c = connect(srv)
+        assert c.query("select @@version_comment")[0].rows
+        assert c.query("select @@version")[0].rows == \
+            [[my.SERVER_VERSION]]
+        assert c.query("select version()")[0].rows == \
+            [[my.SERVER_VERSION]]
+        assert c.server_version == my.SERVER_VERSION
+        for q in ("set names utf8", "set names 'utf8mb4'",
+                  "set character set utf8", "flush privileges",
+                  "flush tables"):
+            c.query(q)
+        with pytest.raises(MySQLError):
+            c.query("flush privleges")  # typo must not silently succeed
+        c.close()
+
+    def test_flush_privileges_reloads_grants(self, srv):
+        """Only a FLUSH may surface a grant-table row edited BEHIND the
+        executors (GRANT itself already invalidates)."""
+        c = connect(srv)
+        c.query("create database fp; use fp; create table t (a int)")
+        c.query("create user 'fp1' identified by 'x'")
+        u = Client("127.0.0.1", srv.port, user="fp1", password="x", db="fp")
+        with pytest.raises(MySQLError):
+            u.query("select count(*) from t")  # no grant yet
+        # edit the grant table directly: checker cache must NOT see it
+        c.query("insert into mysql.db (Host, DB, User, Select_priv) "
+                "values ('%', 'fp', 'fp1', 'Y')")
+        with pytest.raises(MySQLError):
+            u.query("select count(*) from t")
+        c.query("flush privileges")
+        assert u.query("select count(*) from t")[0].rows == [["0"]]
+        u.close()
+        c.close()
+
     def test_prepared_statements_text_protocol(self, srv):
         c = connect(srv)
         c.query("create database d4; use d4; create table t (a int)")

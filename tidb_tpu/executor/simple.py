@@ -74,6 +74,17 @@ def execute_simple(session, stmt) -> ResultSet | None:
         return _load_data(session, stmt)
     if isinstance(stmt, ast.KillStmt):
         return _kill(session, stmt)
+    if isinstance(stmt, ast.FlushStmt):
+        if stmt.what == "privileges":
+            from tidb_tpu import privilege as pv
+            pv.invalidate(session.store)
+        elif stmt.what not in ("tables", "status"):
+            # an unknown target must not silently "succeed" (a typo'd
+            # FLUSH PRIVLEGES would never reload the grants)
+            raise errors.ExecError(
+                f"unsupported FLUSH target {stmt.what!r}")
+        # tables/status: nothing to flush (no table cache; counters live)
+        return None
     raise errors.ExecError(f"unsupported statement {type(stmt).__name__}")
 
 

@@ -121,6 +121,7 @@ ErrDBCreateExists = 1007
 ErrDBDropExists = 1008
 ErrAccessDenied = 1045
 
-# server version string reported by version() and the wire handshake
+# THE server version string: version() builtin, @@version sysvar, and the
+# wire handshake must all agree — drivers version-gate features on it
 # (reference: mysql/const.go ServerVersion)
-SERVER_VERSION = "5.7.1-TiDB-TPU-1.0"
+SERVER_VERSION = "5.7.25-TiDB-TPU-1.0"

@@ -46,7 +46,7 @@ BLOB TINYBLOB MEDIUMBLOB LONGBLOB DATE TIME DATETIME TIMESTAMP YEAR BIT
 UNSIGNED SIGNED ZEROFILL ENUM CHARACTER COLLATE CHARSET ENGINE ANALYZE
 PREPARE EXECUTE DEALLOCATE GRANT REVOKE IDENTIFIED TO PRIVILEGES WITH
 LOAD DATA LOCAL INFILE FIELDS TERMINATED ENCLOSED ESCAPED LINES STARTING
-KILL
+KILL FLUSH
 """.split())
 
 _MULTI_OPS = ("<=>", "<<", ">>", "<=", ">=", "!=", "<>", "||", "&&", ":=")

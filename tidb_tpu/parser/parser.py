@@ -154,6 +154,7 @@ class Parser:
             "ANALYZE": self._parse_analyze,
             "LOAD": self._parse_load_data,
             "KILL": self._parse_kill,
+            "FLUSH": self._parse_flush,
             "GRANT": self._parse_grant,
             "REVOKE": self._parse_revoke,
             "PREPARE": self._parse_prepare,
@@ -756,6 +757,20 @@ class Parser:
 
     def _parse_set(self) -> ast.SetStmt:
         self._expect_kw("SET")
+        # SET NAMES x / SET CHARACTER SET x: connection charset selection —
+        # the engine is utf8-only, so these parse and no-op (parser.y
+        # SetNamesStmt); drivers send them right after the handshake
+        if self._at(lx.IDENT) and self._cur().val.lower() == "names":
+            self._next()
+            self._ident_or_string()
+            if self._try_kw("COLLATE"):
+                self._ident_or_string()
+            return ast.SetStmt()
+        if self._at_kw("CHARACTER"):
+            self._next()
+            self._expect_kw("SET")
+            self._ident_or_string()
+            return ast.SetStmt()
         stmt = ast.SetStmt()
         while True:
             is_global, is_system = False, False
@@ -945,6 +960,12 @@ class Parser:
             self._expect_op(")")
             stmt.columns = cols
         return stmt
+
+    def _parse_flush(self) -> ast.FlushStmt:
+        """FLUSH PRIVILEGES | TABLES | STATUS (parser.y FlushStmt)."""
+        self._expect_kw("FLUSH")
+        what = self._ident("flush target").lower()
+        return ast.FlushStmt(what=what)
 
     def _parse_kill(self) -> ast.KillStmt:
         self._expect_kw("KILL")

@@ -15,7 +15,7 @@ import struct
 
 from tidb_tpu import mysqldef as my
 
-SERVER_VERSION = b"5.7.25-tidb-tpu"
+SERVER_VERSION = my.SERVER_VERSION.encode()
 PROTOCOL_VERSION = 10
 
 # ---- capability flags (mysql/const.go Client*) ----

@@ -527,7 +527,7 @@ def _is_simple(stmt) -> bool:
         ast.CreateIndexStmt, ast.DropIndexStmt, ast.AlterTableStmt,
         ast.AdminStmt, ast.AnalyzeTableStmt, ast.GrantStmt, ast.RevokeStmt,
         ast.CreateUserStmt, ast.DropUserStmt, ast.LoadDataStmt,
-        ast.KillStmt))
+        ast.KillStmt, ast.FlushStmt))
 
 
 # ---------------------------------------------------------------------------

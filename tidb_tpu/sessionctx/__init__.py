@@ -7,7 +7,7 @@ tunables (tidb_distsql_scan_concurrency, sessionctx/variable/sysvar.go:591).
 
 from __future__ import annotations
 
-from tidb_tpu import errors
+from tidb_tpu import errors, mysqldef as my
 from tidb_tpu.types import Datum
 
 # name → default (all values kept as strings, MySQL-style)
@@ -34,6 +34,7 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "time_zone": "SYSTEM",
     "tx_isolation": "REPEATABLE-READ",
     "version_comment": "TiDB-TPU Server",
+    "version": my.SERVER_VERSION,
     "wait_timeout": "28800",
     # engine tunables (reference sessionctx/variable/sysvar.go:591-600)
     "tidb_distsql_scan_concurrency": "10",

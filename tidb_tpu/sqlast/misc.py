@@ -140,6 +140,12 @@ class LoadDataStmt(StmtNode):
 
 
 @dataclass
+class FlushStmt(StmtNode):
+    """FLUSH PRIVILEGES / TABLES / STATUS (ast/misc.go FlushTablesStmt)."""
+    what: str = "privileges"
+
+
+@dataclass
 class KillStmt(StmtNode):
     """KILL [QUERY | CONNECTION] id (ast/misc.go KillStmt)."""
     conn_id: int = 0
