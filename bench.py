@@ -8,25 +8,38 @@ Configs (BASELINE.md):
   4. count(distinct l_orderkey) — distinct kernel
   5. Q1 via the device mesh (region-sharded partial-agg combine)
 
+Measurement honesty (round 4): on the axon-tunneled chip, timings taken
+BEFORE the first device→host transfer are meaningless — block_until_ready
+returns optimistically (experiments/exp_axon_prims.py). A database's
+steady state is inherently post-D2H (every query reads its result), so
+this bench deliberately performs one tiny D2H right after JAX init
+("poisons" the tunnel into its synchronous mode) and then measures
+EVERYTHING in that world:
+
+  - device kernel   = dispatch + block_until_ready on resident planes
+                      (real compute + one ~33 ms tunnel round trip)
+  - e2e             = full SQL stack, result decode included
+  - hbm_peak_gbps   = bandwidth of a pure jnp.sum sweep over a resident
+                      f64 plane — the roofline the kernels are judged
+                      against; per-config fraction is reported
+
 Scale strategy (honest accounting at 10M+ rows): a BENCH_BASE_ROWS store
 is generated through the real write path, then replicated at the KV level
 (handle-shifted copies of the encoded rows) up to BENCH_ROWS. The CPU
-xeval baseline is timed on the base store (its per-row cost is linear, and
-3 runs at 10M would take tens of minutes); the TPU engine is timed on the
-full store. Parity is checked EXACTLY via the replication algebra:
-count/sum scale by the copy factor, avg/min/max are invariant, and
-count(distinct l_orderkey) is invariant (copies duplicate orderkeys).
+xeval baseline is timed on the base store (its per-row cost is linear; 1M
+base rows keep the extrapolation factor at 10×). Parity is checked EXACTLY
+via the replication algebra: count/sum scale by the copy factor,
+avg/min/max are invariant, and count(distinct l_orderkey) is invariant
+(copies duplicate orderkeys).
 
-Prints per-config lines to stderr — rows/s/chip and achieved HBM read
-bandwidth (bytes of referenced planes / kernel wall time; the workload is
-memory-bound, so this is the MFU proxy) — and ONE JSON line to stdout:
+Prints per-config lines to stderr and ONE JSON line to stdout:
 
     {"metric": "tpch_geomean_rows_per_sec_tpu", "value": ...,
      "unit": "rows/s", "vs_baseline": <geomean speedup>, ...extras}
 
 Environment:
-    BENCH_ROWS        total lineitem rows for the TPU engine (default 10M)
-    BENCH_BASE_ROWS   generated base rows / CPU-baseline rows (default 1M)
+    BENCH_ROWS        total lineitem rows for the TPU engine (default 10.2M)
+    BENCH_BASE_ROWS   generated base rows / CPU-baseline rows (default 1.02M)
     BENCH_RUNS        timed repetitions (default 3)
 """
 
@@ -155,16 +168,47 @@ def replicate_store(base_store, base_session, tbl, n_base: int,
     return big, s, rep_s
 
 
-def kernel_probe(session, client, sql: str, runs: int):
-    """Device-kernel timing in the process's CLEAN state: builds the pushed
-    request from the optimized plan, packs the batch, compiles, and times
-    dispatch+completion (block_until_ready) WITHOUT any device→host read —
-    the axon tunnel permanently degrades every dispatch after the first
-    D2H, so this is the only window where the hardware's own speed is
-    observable. The jitted kernel lands in the client's cache, so the
-    end-to-end phase reuses it (one compile total)."""
+def poison_tunnel():
+    """Force the axon tunnel into its post-D2H (synchronous) mode so every
+    subsequent timing is the steady-state truth. A no-op elsewhere."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
+    np.asarray(jnp.zeros(8))
+    jax.block_until_ready(jnp.zeros(8))
+
+
+def measure_hbm_peak(runs: int = 3) -> float:
+    """Achieved GB/s of the simplest possible HBM sweep (summing a
+    resident 1 GB f64 plane) in the post-D2H world — the per-chip roofline
+    the query kernels are judged against. The fixed sweep size amortizes
+    the ~130 ms dispatch+readback overhead that masquerades as bandwidth
+    on smaller working sets."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    elems = 128 << 20          # 1 GB — fixed ~130 ms dispatch overhead
+    #                            amortizes; larger sweeps cost H2D setup
+    plane = jnp.ones(elems, jnp.float64)
+    f = jax.jit(lambda v: jnp.sum(v))
+    jax.block_until_ready(f(plane))
+    t0 = time.time()
+    for _ in range(runs):
+        np.asarray(f(plane))   # result readback = certified completion
+    dt = (time.time() - t0) / runs
+    return elems * 8 / dt / 1e9
+
+
+def kernel_probe(session, client, sql: str, runs: int):
+    """Device-kernel timing: build the pushed request from the optimized
+    plan, pack the batch, compile, then time dispatch + block_until_ready
+    on device-resident planes. Runs AFTER poison_tunnel(), so the number
+    includes one ~33 ms tunnel round trip plus the kernel's real compute —
+    the same dispatch cost every end-to-end query pays. The jitted kernel
+    lands in the client's cache, so the e2e phase reuses it (one compile
+    total)."""
+    import jax
     from tidb_tpu.copr.proto import PBTableInfo, SelectRequest
     from tidb_tpu.executor.distsql_exec import (
         _scan_pb_columns, table_ranges_to_kv_ranges,
@@ -192,8 +236,7 @@ def kernel_probe(session, client, sql: str, runs: int):
     specs = kernels.lower_aggregates(sel, batch)
     planes = kernels.batch_planes(
         batch, with_pos=any(s.name == "first_row" for s in specs))
-    live = np.zeros(batch.capacity, dtype=bool)
-    live[: batch.n_rows] = True
+    live = kernels.device_live(batch)
     if sel.group_by:
         gspec = kernels.lower_group_by(sel, batch)
         assert gspec.kind == "radix", sql
@@ -210,12 +253,17 @@ def kernel_probe(session, client, sql: str, runs: int):
             lambda: kernels.build_scalar_agg_fn(
                 kernels.compile_expr(sel.where, batch)
                 if sel.where is not None else None, specs, batch.n_rows))
+    import numpy as np
     r = jitted(planes, live)
     jax.block_until_ready(r)          # compile + first dispatch
     t0 = time.time()
     for _ in range(runs):
-        r = jitted(planes, live)
-    jax.block_until_ready(r)          # NO np.asarray — stays clean
+        i_arr, f_arr = jitted(planes, live)
+        # read the (tiny, packed) outputs back: on this platform even
+        # post-D2H block_until_ready can return before some executables
+        # finish — the result D2H is the only certified completion point,
+        # and it is what every real query pays anyway
+        np.asarray(i_arr), np.asarray(f_arr)
     return (time.time() - t0) / runs
 
 
@@ -260,7 +308,7 @@ def check_scaled_parity(name: str, cpu_rows, tpu_rows, factor: int):
 
 def main():
     n_rows = int(os.environ.get("BENCH_ROWS", "10200000"))
-    n_base = int(os.environ.get("BENCH_BASE_ROWS", "300000"))
+    n_base = int(os.environ.get("BENCH_BASE_ROWS", "1020000"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
     n_base = min(n_base, n_rows)
     factor = max(1, n_rows // n_base)
@@ -300,10 +348,16 @@ def main():
     tpu_session.execute("use tpch")
     tpu_client = big_store.get_client()
 
-    # phase 1 — CLEAN-state kernel probes (dispatch + block_until_ready,
-    # zero D2H): the hardware's own throughput/bandwidth, before the axon
-    # tunnel degrades dispatches. Also packs batches + compiles kernels
-    # that phase 2 reuses.
+    # phase 0 — put the tunnel into its post-D2H mode NOW: every number
+    # from here on is measured in the same (real, synchronous) regime a
+    # serving database lives in. Pre-D2H timings on this platform are
+    # optimistic fiction (experiments/exp_axon_prims.py).
+    poison_tunnel()
+    hbm_peak = measure_hbm_peak()
+    print(f"# hbm peak (post-D2H copy-sweep): {hbm_peak:.2f} GB/s",
+          file=sys.stderr)
+
+    # phase 1 — device-kernel probes: dispatch+block on resident planes
     kernel_s: dict[str, float] = {}
     for name, sql in configs:
         try:
@@ -312,14 +366,12 @@ def main():
             bw = n_rows * REFERENCED_COLS[name] * 9 / kernel_s[name] / 1e9
             print(f"# {name}: device kernel {kernel_s[name] * 1000:.1f} "
                   f"ms/run ({n_rows / kernel_s[name]:,.0f} rows/s/chip, "
-                  f"{bw:.1f} GB/s HBM achieved)", file=sys.stderr)
+                  f"{bw:.1f} GB/s = {bw / hbm_peak * 100:.0f}% of peak)",
+                  file=sys.stderr)
         except Exception as e:  # probe is best-effort diagnostics
             print(f"# {name}: kernel probe skipped ({e})", file=sys.stderr)
 
-    # phase 2 — end-to-end SQL (includes result decode; the first D2H
-    # triggers the tunnel's degraded-dispatch mode, which inflates
-    # per-query wall time by ~0.2-2s — reality of THIS deployment, so the
-    # headline number keeps it)
+    # phase 2 — end-to-end SQL (parse → plan → dispatch → result decode)
     speedups, tpu_rps_all, bw_figures = [], [], {}
     for name, sql in configs:
         before = (tpu_client.stats["tpu_requests"],
@@ -372,7 +424,10 @@ def main():
         "vs_baseline": round(geo_speedup, 2),
         "rows": n_rows,
         "cpu_baseline_rows": n_base,
+        "hbm_peak_gbps": round(hbm_peak, 2),
         "hbm_gbps": bw_figures,
+        "hbm_fraction": {k: round(v / hbm_peak, 3)
+                         for k, v in bw_figures.items()},
         "kernel_rows_per_sec": kernel_rps,
     }))
 

@@ -1,0 +1,78 @@
+"""Which primitives stay fast post-D2H on the axon tunnel?
+
+Known: scatter (segment_sum) degrades to O(rows) per op; fused
+elementwise+reduce stays ~33ms + real compute. Test: sort, argsort,
+lexsort, cumsum, top_k, gather, and a scatter-free grouped-agg prototype
+(masked reductions over 13 segments, 20 outputs).
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+N = 500_000
+S = 13
+rng = np.random.default_rng(0)
+v = jnp.asarray(rng.random(N))
+iv = jnp.asarray(rng.integers(0, 1 << 40, N))
+gid = jnp.asarray(rng.integers(0, S, N))
+idx = jnp.asarray(rng.integers(0, N, N))
+jax.block_until_ready([v, iv, gid, idx])
+
+fns = {
+    "sort f64": jax.jit(lambda: jnp.sort(v)[0]),
+    "sort i64": jax.jit(lambda: jnp.sort(iv)[0]),
+    "argsort i64": jax.jit(lambda: jnp.argsort(iv)[0]),
+    "lexsort 3key": jax.jit(lambda: jnp.lexsort([iv, gid, gid])[0]),
+    "cumsum": jax.jit(lambda: jnp.cumsum(v)[-1]),
+    "top_k": jax.jit(lambda: jax.lax.top_k(v, 100)[0][0]),
+    "gather": jax.jit(lambda: jnp.sum(v[idx])),
+    "boundary-distinct": jax.jit(
+        lambda: jnp.sum((lambda s: jnp.concatenate(
+            [jnp.ones(1, bool), s[1:] != s[:-1]]))(jnp.sort(iv)))),
+}
+
+
+def grouped_masked(v, gid):
+    """Scatter-free grouped agg: 20 outputs x 13 segments via one-hot
+    masked reductions — [S, N] broadcast fused into reduces."""
+    oh = gid[None, :] == jnp.arange(S)[:, None]          # [S, N] bool
+    outs = []
+    for i in range(10):
+        vv = v + i
+        outs.append(jnp.sum(jnp.where(oh, vv[None, :], 0.0), axis=1))
+        outs.append(jnp.sum(oh & (vv[None, :] > 0.5), axis=1))
+    return jnp.concatenate(outs)
+
+
+def grouped_dot(v, gid):
+    """One-hot contraction variant: [S,N] f64 matmul-like einsum."""
+    oh = (gid[None, :] == jnp.arange(S)[:, None]).astype(jnp.float64)
+    vals = jnp.stack([v + i for i in range(10)])          # [10, N]
+    return jnp.einsum("sn,an->sa", oh, vals)
+
+fns["grouped-masked 20x13"] = jax.jit(lambda: grouped_masked(v, gid)[0])
+fns["grouped-dot 10x13"] = jax.jit(lambda: grouped_dot(v, gid)[0, 0])
+
+
+def t(fn, n=3):
+    r = fn()
+    jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(n):
+        r = fn()
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+
+
+_ = np.asarray(jnp.sum(v))
+print("--- D2H done; all timings post-D2H (the real steady-state world) ---")
+for name, fn in fns.items():
+    try:
+        print(f"running {name}...", flush=True)
+        print(f"{name:24s}: {t(fn)*1e3:8.1f} ms")
+    except Exception as e:
+        print(f"{name:24s}: FAIL {type(e).__name__} {str(e)[:80]}")

@@ -1,0 +1,44 @@
+"""Find the buffer-size threshold where post-D2H dispatches start
+re-staging arguments (BENCH_r03: q1 at 133MB/plane was byte-proportional;
+exp_axon_staging at 32MB/plane showed only a flat ~33ms RTT)."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+rng = np.random.default_rng(0)
+SIZES = [4_000_000, 8_000_000, 12_000_000, 16_700_000]  # 32/64/96/134 MB
+arrs = {n: jnp.asarray(rng.random(n)) for n in SIZES}
+jax.block_until_ready(list(arrs.values()))
+
+fns = {n: jax.jit(lambda v: jnp.sum(v)) for n in SIZES}
+
+
+def t(fn, *a, n=3):
+    r = fn(*a)
+    jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(n):
+        r = fn(*a)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+
+
+for n in SIZES:
+    print(f"pre-D2H  sum({n*8/1e6:.0f} MB): {t(fns[n], arrs[n])*1e3:8.1f} ms")
+
+_ = np.asarray(fns[SIZES[0]](arrs[SIZES[0]]))
+print("--- first D2H done ---")
+
+for n in SIZES:
+    print(f"post-D2H sum({n*8/1e6:.0f} MB): {t(fns[n], arrs[n])*1e3:8.1f} ms")
+
+# multi-plane at the big size: is cost per-buffer or total-bytes?
+big = SIZES[-1]
+p7 = {i: jnp.asarray(rng.random(big)) for i in range(7)}
+jax.block_until_ready(list(p7.values()))
+f7 = jax.jit(lambda pl: sum(jnp.sum(pl[i]) for i in range(7)))
+print(f"post-D2H 7-plane sum (7x{big*8/1e6:.0f} MB): {t(f7, p7)*1e3:8.1f} ms")

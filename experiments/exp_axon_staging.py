@@ -1,0 +1,79 @@
+"""Empirical probe of the axon tunnel's post-D2H dispatch degradation.
+
+Question: after the first device->host transfer, WHICH dispatches re-stage
+their argument buffers — all of them, or only executables compiled after
+the D2H?  (BENCH_r03 shows q6 e2e staying fast at 0.73s while q1/distinct,
+whose jitted fns are first compiled after q6's result read, run at exactly
+plane-bytes / tunnel-rate.)
+
+Run on the real chip: python experiments/exp_axon_staging.py
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+N = 4_000_000
+MB = N * 8 / 1e6
+rng = np.random.default_rng(0)
+planes = {i: jnp.asarray(rng.random(N)) for i in range(6)}
+live_np = np.ones(N, dtype=bool)
+live_dev = jnp.asarray(live_np)
+jax.block_until_ready(list(planes.values()))
+
+
+def mk(name, cols):
+    def f(pl, live):
+        s = jnp.float64(0)
+        for c in cols:
+            s = s + jnp.sum(jnp.where(live, pl[c], 0.0))
+        return s
+    f.__name__ = name
+    return jax.jit(f)
+
+
+def t(fn, *a, n=3):
+    r = fn(*a)
+    jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(n):
+        r = fn(*a)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+
+
+f = mk("f", [0, 1, 2])
+g = mk("g", [3, 4, 5])
+h = mk("h", [0, 1, 2, 3, 4, 5])
+h_exe = h.lower(planes, live_dev).compile()   # AOT pre-D2H, never dispatched
+
+print(f"plane bytes per fn: 3 cols = {3*MB:.0f} MB, 6 cols = {6*MB:.0f} MB")
+print(f"pre-D2H  f(3 cols, dev live): {t(f, planes, live_dev)*1e3:8.1f} ms")
+print(f"pre-D2H  g(3 cols, dev live): {t(g, planes, live_dev)*1e3:8.1f} ms")
+print(f"pre-D2H  f(3 cols, HOST live):{t(f, planes, live_np)*1e3:8.1f} ms")
+
+x = np.asarray(f(planes, live_dev))           # FIRST D2H
+print("--- first D2H done ---", float(x))
+
+print(f"post-D2H f (compiled+dispatched pre): {t(f, planes, live_dev)*1e3:8.1f} ms")
+print(f"post-D2H g (compiled+dispatched pre): {t(g, planes, live_dev)*1e3:8.1f} ms")
+k = mk("k", [0, 1, 2])
+print(f"post-D2H k (fresh jit, compiled post): {t(k, planes, live_dev)*1e3:8.1f} ms")
+print(f"post-D2H h (AOT pre, 1st dispatch post): {t(h_exe, planes, live_dev)*1e3:8.1f} ms")
+
+new0 = jnp.asarray(rng.random(N))
+planes2 = dict(planes)
+planes2[0] = new0
+print(f"post-D2H f with NEW dev arg:           {t(f, planes2, live_dev)*1e3:8.1f} ms")
+
+sm = jax.jit(lambda v: jnp.sum(v))
+small = jnp.asarray(rng.random(1000))
+print(f"post-D2H small fresh fn (8KB arg):     {t(sm, small)*1e3:8.1f} ms")
+
+# does a SECOND D2H make things worse / does k stay degraded?
+_ = np.asarray(g(planes, live_dev))
+print(f"post-2xD2H f:                          {t(f, planes, live_dev)*1e3:8.1f} ms")
+print(f"post-2xD2H k:                          {t(k, planes, live_dev)*1e3:8.1f} ms")

@@ -1,0 +1,75 @@
+"""Test: post-D2H, is per-dispatch cost ~ (number of XLA thunks) x RTT?
+
+Build executables with controlled numbers of unfusable ops (segment_sum
+scatters force separate thunks) and compare pre/post-D2H dispatch times.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+N = 2_000_000
+rng = np.random.default_rng(0)
+v = jnp.asarray(rng.random(N))
+gid = jnp.asarray(rng.integers(0, 13, N))
+jax.block_until_ready([v, gid])
+
+
+def mk_seg(k):
+    @jax.jit
+    def f(v, gid):
+        outs = []
+        for i in range(k):
+            outs.append(jax.ops.segment_sum(v + i, gid, num_segments=13))
+        return jnp.concatenate(outs)
+    return f
+
+
+def mk_chain(k):
+    @jax.jit
+    def f(v, gid):
+        x = v
+        for i in range(k):
+            x = x * 1.0000001 + 0.1   # fuses into one elementwise kernel
+        return jnp.sum(x)
+    return f
+
+
+def t(fn, *a, n=3):
+    r = fn(*a)
+    jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(n):
+        r = fn(*a)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+
+
+segs = {k: mk_seg(k) for k in (1, 4, 16)}
+chain = mk_chain(64)
+
+for k, f in segs.items():
+    print(f"pre-D2H  seg x{k:2d}: {t(f, v, gid)*1e3:8.1f} ms")
+print(f"pre-D2H  chain64: {t(chain, v, gid)*1e3:8.1f} ms")
+
+_ = np.asarray(jnp.sum(v))
+print("--- first D2H done ---")
+
+for k, f in segs.items():
+    print(f"post-D2H seg x{k:2d}: {t(f, v, gid)*1e3:8.1f} ms")
+print(f"post-D2H chain64: {t(chain, v, gid)*1e3:8.1f} ms")
+
+# fresh compiles post-D2H for the same shapes
+segs2 = {k: mk_seg(k) for k in (1, 16)}
+for k, f in segs2.items():
+    print(f"post-D2H seg x{k:2d} (fresh): {t(f, v, gid)*1e3:8.1f} ms")
+
+# does input size matter at fixed thunk count?
+v4 = jnp.asarray(rng.random(4 * N))
+gid4 = jnp.asarray(rng.integers(0, 13, 4 * N))
+jax.block_until_ready([v4, gid4])
+f16 = mk_seg(16)
+print(f"post-D2H seg x16 at 4x rows: {t(f16, v4, gid4)*1e3:8.1f} ms")

@@ -98,3 +98,43 @@ class TestScheduledGC:
         w2 = GCWorker(store, safe_age_ms=0)
         w1.tick()
         assert w2._try_lease()  # expired lease is free to take
+
+
+class TestGCSafepointClamp:
+    def test_active_snapshot_pins_versions(self):
+        store = new_store(f"memory://mgc{next(_store_id)}")
+        s = Session(store)
+        s.execute("create database d; use d; create table t "
+                  "(a int primary key, b int)")
+        s.execute("insert into t values (1, 0)")
+        snap_ts = store.current_version()
+        snap = store.get_snapshot(snap_ts)     # long-running reader
+        for i in range(5):
+            s.execute(f"update t set b = {i + 1}")
+        c = Compactor(store, safe_age_ms=0)
+        c.tick()
+        # the reader's version must have survived compaction
+        from tidb_tpu import tablecodec as tc
+        tbl = s.info_schema().table_by_name("d", "t")
+        start_k, end_k = tc.encode_record_range(tbl.id)
+        rows = list(snap.iterate(start_k, end_k))
+        assert len(rows) == 1
+        del snap, rows
+        # with the reader gone, the same tick reclaims them
+        s.execute("update t set b = 99")
+        assert c.tick() > 0
+
+    def test_cluster_gc_clamps_to_active_txn(self):
+        store = new_store(f"cluster://3/mgc{next(_store_id)}")
+        s = Session(store)
+        s.execute("create database d; use d; create table t "
+                  "(a int primary key, b int)")
+        s.execute("insert into t values (1, 0)")
+        reader = store.begin()                  # pins its start_ts
+        for i in range(3):
+            s.execute(f"update t set b = {i + 1}")
+        w = GCWorker(store, safe_age_ms=0)
+        w.tick()
+        assert store.oldest_active_ts() is not None
+        assert store.oldest_active_ts() <= reader.start_ts()
+        reader.rollback()

@@ -193,3 +193,17 @@ class TestIncrementalCache:
         got = norm(s.execute(q)[0].values())
         assert got == sorted(base + [["aa", 2]])
         assert cl.stats["batch_appends"] >= 1
+
+
+class TestPackRowsValidation:
+    def test_bad_pk_idx_rejected(self):
+        if nativepack._cx is None or not hasattr(nativepack._cx,
+                                                 "pack_rows"):
+            pytest.skip("native codec unavailable")
+        cx = nativepack._cx
+        with pytest.raises(ValueError):
+            cx.pack_rows([], [], [1], b"i", 5)      # pk_idx >= m
+        with pytest.raises(ValueError):
+            cx.pack_rows([], [], [1], b"s", 0)      # pk into string col
+        n, *_ = cx.pack_rows([], [], [1], b"i", 0)  # valid call still fine
+        assert n == 0

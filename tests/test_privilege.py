@@ -214,3 +214,40 @@ class TestWireAuth:
             root.close()
         finally:
             srv.close()
+
+
+class TestRevokeNoGrant:
+    def test_revoke_db_level_without_grant_errors(self, env):
+        env.exec("create user 'rng1'")
+        with pytest.raises(Exception) as ei:
+            env.exec("revoke select on app.* from 'rng1'")
+        assert "no such grant" in str(ei.value)
+
+    def test_revoke_table_level_without_grant_errors(self, env):
+        env.exec("create user 'rng2'")
+        with pytest.raises(Exception) as ei:
+            env.exec("revoke select on app.t from 'rng2'")
+        assert "no such grant" in str(ei.value)
+
+    def test_revoke_after_grant_still_works(self, env):
+        env.exec("create user 'rng3'")
+        env.exec("grant select on app.* to 'rng3'")
+        env.exec("revoke select on app.* from 'rng3'")  # no raise
+        s = as_user(env, "rng3")
+        with pytest.raises(AccessDenied):
+            s.execute("select * from t")
+
+
+class TestSchemaInspectionGate:
+    def test_show_create_table_denied_without_any_priv(self, env):
+        env.exec("create user 'si1'")
+        s = as_user(env, "si1")
+        with pytest.raises(AccessDenied):
+            s.execute("show create table app.t")
+
+    def test_show_columns_allowed_with_table_priv(self, env):
+        env.exec("create user 'si2'")
+        env.exec("grant select on app.t to 'si2'")
+        s = as_user(env, "si2")
+        s.execute("use app")
+        assert s.execute("show columns from t")[0].values()

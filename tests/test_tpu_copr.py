@@ -247,3 +247,37 @@ def test_set_copr_backend_sysvar():
 
     with pytest.raises(Exception):
         s.execute("set tidb_copr_backend = 'gpu'")
+
+
+class TestMeshHighNdvMinMax:
+    """Regression: with num_segments > ONEHOT_SEGMENTS_MAX the sorted
+    min/max route gathers at segment boundaries; a chip whose shard holds
+    NO rows of a group must contribute the sentinel there, not a
+    neighboring segment's value, or pmin/pmax combines go wrong."""
+
+    def test_grouped_minmax_across_shards(self):
+        from tidb_tpu.parallel import CoprMesh
+        cpu_store = new_store("memory://ndvmm_cpu")
+        mesh_store_ = new_store("memory://ndvmm_mesh")
+        mesh_store_.set_client(TpuClient(mesh_store_, mesh=CoprMesh()))
+        for st in (cpu_store, mesh_store_):
+            s = Session(st)
+            s.execute("create database d")
+            s.execute("use d")
+            s.execute("create table t (id bigint primary key, g int, "
+                      "v int)")
+            # 100 groups (> ONEHOT_SEGMENTS_MAX), CONTIGUOUS by handle so
+            # row-sharding leaves most groups absent from most shards
+            vals = ", ".join(
+                f"({i}, {i // 8}, {(i * 37) % 1000})" for i in range(800))
+            s.execute(f"insert into t values {vals}")
+            if st is cpu_store:
+                cpu_s = s
+            else:
+                mesh_s = s
+        sql = ("select g, min(v), max(v), count(*) from t "
+               "group by g order by g")
+        cpu_rows = cpu_s.execute(sql)[0].values()
+        mesh_rows = mesh_s.execute(sql)[0].values()
+        assert _norm(cpu_rows) == _norm(mesh_rows)
+        assert mesh_store_.get_client().stats["tpu_requests"] > 0

@@ -14,6 +14,7 @@ URL scheme.
 from __future__ import annotations
 
 import threading
+import weakref
 
 from tidb_tpu import errors
 from tidb_tpu.cluster.client import (
@@ -225,6 +226,11 @@ class _PipelinedResponse(kv.Response):
         self._err: BaseException | None = None
         self._buf: list = []
         self._cursor = 0
+        # backpressure: workers only start tasks inside a sliding window
+        # ahead of the consumer, so completed-but-unconsumed results stay
+        # proportional to concurrency instead of the whole region set (the
+        # reference's bounded channel, coprocessor.go:317)
+        self._window = max(2 * concurrency, 4)
 
         task_iter = iter(enumerate(tasks))
         iter_lock = threading.Lock()
@@ -236,6 +242,12 @@ class _PipelinedResponse(kv.Response):
                 if nxt is None:
                     return
                 idx, rg = nxt
+                with self._cv:
+                    while (idx >= self._next_task + self._window
+                           and self._err is None):
+                        self._cv.wait()
+                    if self._err is not None:
+                        return
                 try:
                     out = run(rg)
                 except BaseException as e:  # surfaced to the consumer
@@ -266,6 +278,7 @@ class _PipelinedResponse(kv.Response):
                     self._buf = self._results.pop(self._next_task)
                     self._cursor = 0
                     self._next_task += 1
+                    self._cv.notify_all()   # window advanced: wake workers
                     break
                 self._cv.wait()
         return self.next()
@@ -282,13 +295,26 @@ class DistStore(kv.Storage):
         self.oracle = VersionProvider()
         self._client: kv.Client | None = None
         self._commit_log_lock = threading.Lock()
+        # live readers, weakly held — see LocalStore._active_reads
+        self._active_reads = weakref.WeakSet()
 
     def begin(self) -> kv.Transaction:
-        return DistTxn(self, self.oracle.current_version())
+        txn = DistTxn(self, self.oracle.current_version())
+        self._active_reads.add(txn)
+        return txn
 
     def get_snapshot(self, version: int | None = None) -> kv.Snapshot:
-        return DistSnapshot(self, version if version is not None
+        snap = DistSnapshot(self, version if version is not None
                             else self.oracle.current_version())
+        self._active_reads.add(snap)
+        return snap
+
+    def oldest_active_ts(self) -> int | None:
+        ts = [getattr(o, "version", None) or getattr(o, "_start_ts", None)
+              for o in list(self._active_reads)
+              if getattr(o, "_valid", True)]   # finished txns don't pin
+        ts = [t for t in ts if t is not None]
+        return min(ts) if ts else None
 
     def get_client(self) -> kv.Client:
         if self._client is None:

@@ -728,6 +728,13 @@ def _grant_revoke(session, stmt) -> None:
             n = internal.execute(
                 "select count(1) from mysql.db where User = "
                 f"'{u}' and DB = '{_esc(db)}'")[0].values()[0][0]
+            if n == 0 and not granting:
+                # MySQL ER_NONEXISTING_GRANT: a REVOKE matching no stored
+                # grant row must say so, not silently no-op — a typo'd
+                # revocation in a security workflow would otherwise pass
+                raise errors.ExecError(
+                    f"There is no such grant defined for user '{spec.user}' "
+                    f"on host '{spec.host}'", code=1141)
             if n == 0 and granting:
                 internal.execute(
                     "insert into mysql.db (Host, DB, User) values "
@@ -750,6 +757,10 @@ def _grant_revoke(session, stmt) -> None:
                 raw = rs[0][0]
                 raw = raw.decode() if isinstance(raw, bytes) else str(raw)
                 have = {p for p in raw.split(",") if p}
+            if not granting and not exists:
+                raise errors.ExecError(
+                    f"There is no such grant defined for user '{spec.user}' "
+                    f"on host '{spec.host}' on table '{table}'", code=1147)
             have = (have | set(privs)) if granting else (have - set(privs))
             tp = ",".join(sorted(have))
             if exists:

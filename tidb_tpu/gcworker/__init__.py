@@ -21,6 +21,19 @@ from tidb_tpu.structure import TxStructure
 
 GC_LEASE_KEY = b"GCLease"
 
+
+def _clamp_to_active(store, safe_point: int) -> int:
+    """Never reclaim versions a live snapshot/txn may still read: the
+    effective safepoint is min(age-based point, oldest active start_ts - 1)
+    — the reference's early design lacks this and a statement running
+    longer than the safe age silently loses versions mid-scan; our own
+    benchmarks run in that duration range."""
+    oldest_fn = getattr(store, "oldest_active_ts", None)
+    oldest = oldest_fn() if oldest_fn is not None else None
+    if oldest is not None:
+        return min(safe_point, oldest - 1)
+    return safe_point
+
 # safepoint ages (ms): localstore compactor 20min, cluster gc 10min
 LOCAL_SAFE_AGE_MS = 20 * 60 * 1000
 CLUSTER_SAFE_AGE_MS = 10 * 60 * 1000
@@ -72,7 +85,9 @@ class Compactor(_TickThread):
         cur = self.store.data_version_at(self.store.current_version())
         if cur == self._last_version:
             return 0
-        removed = self.store.compact(max_age_ms=self.safe_age_ms)
+        safe = (int(time.time() * 1000) - self.safe_age_ms) << 18
+        removed = self.store.compact(
+            safe_point_ts=_clamp_to_active(self.store, safe))
         # only after a SUCCESSFUL compact — a raise must leave the version
         # probe stale so the next tick retries
         self._last_version = cur
@@ -119,7 +134,7 @@ class GCWorker(_TickThread):
         if not self._try_lease():
             metrics.counter("gc.lease_lost").inc()
             return 0
-        safe_point = self._safe_point()
+        safe_point = _clamp_to_active(self.store, self._safe_point())
         removed = self.store.run_gc(safe_point)
         metrics.counter("gc.runs").inc()
         if removed:

@@ -11,6 +11,7 @@ of the reference collapses to this check because commit is serialized).
 from __future__ import annotations
 
 import threading
+import weakref
 import time
 from typing import Iterator
 
@@ -154,14 +155,30 @@ class LocalStore(Storage):
         self._commit_bounds_log: list[dict[bytes, tuple[bytes, bytes]]] = []
         self._commit_bounds_base = 0           # version of log[0]
         self._commit_bounds_cap = 4096
+        # live readers (snapshots/txns), weakly held: GC clamps its
+        # safepoint to the oldest of these so a long scan can never have
+        # the versions it is reading reclaimed mid-flight
+        self._active_reads = weakref.WeakSet()
 
     # ---- Storage ----
     def begin(self) -> Transaction:
-        return LocalTxn(self, self.oracle.current_version())
+        txn = LocalTxn(self, self.oracle.current_version())
+        self._active_reads.add(txn)
+        return txn
 
     def get_snapshot(self, version: int | None = None) -> Snapshot:
-        return LocalSnapshot(self.mvcc, version if version is not None
+        snap = LocalSnapshot(self.mvcc, version if version is not None
                              else self.oracle.current_version())
+        self._active_reads.add(snap)
+        return snap
+
+    def oldest_active_ts(self) -> int | None:
+        """Smallest start_ts among live snapshots/txns, or None."""
+        ts = [getattr(o, "version", None) or getattr(o, "_start_ts", None)
+              for o in list(self._active_reads)
+              if getattr(o, "_valid", True)]   # finished txns don't pin
+        ts = [t for t in ts if t is not None]
+        return min(ts) if ts else None
 
     def get_client(self) -> Client:
         if self._client is None:

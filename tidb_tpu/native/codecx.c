@@ -453,6 +453,14 @@ static PyObject *py_pack_rows(PyObject *self, PyObject *args) {
         PyErr_SetString(PyExc_ValueError, "pack_rows shape mismatch");
         goto fail_seqs;
     }
+    /* the handle store below writes an int64 into col_out[pk_idx]: an
+     * out-of-range index or a non-numeric ('s') column would scribble over
+     * a PyList object header — reject at the boundary */
+    if (pk_idx >= 0 && (pk_idx >= m || kinds[pk_idx] == 's')) {
+        PyErr_SetString(PyExc_ValueError,
+                        "pack_rows: pk_idx out of range or not numeric");
+        goto fail_seqs;
+    }
     int64_t cid_arr[256];
     for (Py_ssize_t j = 0; j < m; j++) {
         long long c = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(cids, j));
@@ -541,11 +549,17 @@ static PyObject *py_pack_rows(PyObject *self, PyObject *args) {
                         PyList_SET_ITEM(col_out[j], out_i, Py_None);
                     }
                 } else if (kind == 'i') {
-                    int64_t v = d.kind == 1 ? d.i
-                              : d.kind == 2 ? (int64_t)d.f : 0;
+                    int64_t v = d.kind == 1 ? d.i : 0;
                     if (d.kind == 3) {
                         PyMem_Free(d.owned);
                         PyErr_SetString(Unsupported, "bytes in int column");
+                        goto fail_alloc;
+                    }
+                    if (d.kind == 2) {
+                        /* float datum in an int plane: the Python pack
+                         * path raises Unsupported (CPU fallback) rather
+                         * than silently truncating — keep parity */
+                        PyErr_SetString(Unsupported, "float in int column");
                         goto fail_alloc;
                     }
                     ((int64_t *)PyBytes_AS_STRING(col_out[j]))[out_i] = v;

@@ -268,8 +268,7 @@ class TpuClient(kv.Client):
         specs = kernels.lower_aggregates(sel, batch)
         planes = kernels.batch_planes(
             batch, with_pos=any(s.name == "first_row" for s in specs))
-        live = np.zeros(batch.capacity, dtype=bool)
-        live[: batch.n_rows] = True
+        live = kernels.device_live(batch)
 
         if sel.group_by:
             gspec = kernels.lower_group_by(sel, batch)
@@ -543,8 +542,7 @@ class TpuClient(kv.Client):
         _, wrapper, jitted = self._kernel(sel, batch, "filter",
                                           lambda: kernels.build_filter_fn(where))
         planes = kernels.batch_planes(batch)
-        live = np.zeros(batch.capacity, dtype=bool)
-        live[: batch.n_rows] = True
+        live = kernels.device_live(batch)
         i_arr, f_arr = jitted(planes, live)
         (mask_out,) = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
                                              np.asarray(f_arr))
@@ -571,8 +569,7 @@ class TpuClient(kv.Client):
                 where, keys, k)
         _, wrapper, jitted = self._kernel(sel, batch, "topn", build)
         planes = kernels.batch_planes(batch)
-        live = np.zeros(batch.capacity, dtype=bool)
-        live[: batch.n_rows] = True
+        live = kernels.device_live(batch)
         i_arr, f_arr = jitted(planes, live)
         idx_out, n_live = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
                                                  np.asarray(f_arr))

@@ -2,14 +2,21 @@
 
 The device-side half of the coprocessor: one jitted function per request
 shape evaluates the pushed filter and all aggregates in a single fused XLA
-computation — the whole thing is a handful of masked reductions (VPU) and
-segment-sums (scatter-adds), so XLA fuses filter+agg into one pass over HBM.
+computation — the whole thing is a handful of masked reductions (VPU),
+one-hot segment reductions, and sort+prefix-sum segment reductions, so XLA
+fuses filter+agg into few passes over HBM.
 
-Group-by strategy (XLA-idiomatic, no hash tables): group columns are
-dictionary codes, the combined group id is a mixed-radix code over the
-dict sizes, and every aggregate is a `segment_sum`-family reduction with a
-STATIC segment count (padded to a bucket) — no dynamic shapes, no
-recompiles per batch (SURVEY §7 "sort+segment-reduce route").
+Group-by strategy (XLA-idiomatic, no hash tables, NO SCATTER): group
+columns are dictionary codes, the combined group id is a mixed-radix code
+over the dict sizes, and every aggregate is a segment reduction with a
+STATIC segment count — computed either as a one-hot masked reduction
+(small segment counts: the [S, N] broadcast fuses into the reduce) or in
+sorted space (argsort by group id, cumsum, gather at segment boundaries).
+No `jax.ops.segment_*` anywhere: on tunneled TPU deployments (axon) every
+XLA scatter op degrades to O(row-bytes) host traffic per dispatch once any
+device→host read has happened in the process, which is the steady state of
+a database serving results. Sort/gather/reduce/cumsum do not degrade —
+measured in experiments/exp_axon_prims.py.
 
 Multi-chip: the same kernels run under shard_map with rows sharded across
 the mesh; partial aggregates combine with lax.psum over ICI — see
@@ -102,6 +109,16 @@ def batch_planes(batch: col.ColumnBatch, with_pos: bool = False) -> dict:
         planes = dict(planes)
         planes[POS_CID] = pos
     return planes
+
+
+def device_live(batch: col.ColumnBatch):
+    """Device-resident row-liveness plane, memoized on the batch. Passing
+    a host numpy mask instead costs an H2D of capacity bytes on EVERY
+    dispatch — tens of ms at 10M+ rows on tunneled deployments."""
+    arr = getattr(batch, "_device_live", None)
+    if arr is None:
+        arr = batch._device_live = jnp.asarray(batch.row_mask())
+    return arr
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +246,125 @@ def _orderable_i64(v):
 
 
 # ---------------------------------------------------------------------------
+# scatter-free segment reductions
+# ---------------------------------------------------------------------------
+
+# one-hot masked-reduction route below this; sorted route at/above. The
+# [S, N] one-hot broadcast never materializes (XLA fuses it into each
+# reduce), but per-output work is S×N element ops, so large S pays for a
+# sort instead.
+ONEHOT_SEGMENTS_MAX = 64
+
+
+class SegCtx:
+    """Segment-reduction context for one kernel invocation: shares the
+    one-hot plane (small S) or the argsort + boundary indices (large S)
+    across every aggregate in the request.
+
+    `presorted=True` means gid is already monotone non-decreasing (the
+    ranked path computes ids in sorted space), so the sorted route skips
+    its argsort and the permutation is the identity."""
+
+    def __init__(self, gid, num_segments: int, presorted: bool = False):
+        self.gid = gid
+        self.S = num_segments
+        self.presorted = presorted
+        self.use_onehot = (not presorted) and num_segments <= ONEHOT_SEGMENTS_MAX
+        self._oh = None
+        self._sorted = None
+
+    def onehot(self):
+        if self._oh is None:
+            self._oh = self.gid[None, :] == jnp.arange(self.S)[:, None]
+        return self._oh
+
+    def sorted_ctx(self):
+        """(order, gid_sorted, starts[S], ends[S])."""
+        if self._sorted is None:
+            if self.presorted:
+                order = None
+                gs = self.gid
+            else:
+                order = jnp.argsort(self.gid)
+                gs = self.gid[order]
+            r = jnp.arange(self.S)
+            starts = jnp.searchsorted(gs, r)
+            ends = jnp.searchsorted(gs, r, side="right")
+            self._sorted = (order, gs, starts, ends)
+        return self._sorted
+
+    def _permute(self, v):
+        order = self.sorted_ctx()[0]
+        return v if order is None else v[order]
+
+    def sum(self, v, contrib):
+        """Per-segment sum of v over contrib rows → [S] (v's dtype)."""
+        if jnp.ndim(v) == 0:
+            v = jnp.broadcast_to(v, contrib.shape)
+        vv = jnp.where(contrib, v, jnp.zeros_like(v))
+        if self.use_onehot:
+            oh = self.onehot()
+            return jnp.sum(jnp.where(oh, vv[None, :],
+                                     jnp.zeros((), vv.dtype)), axis=1)
+        _, _, starts, ends = self.sorted_ctx()
+        vs = self._permute(vv)
+        cs = jnp.concatenate([jnp.zeros(1, vs.dtype), jnp.cumsum(vs)])
+        return cs[ends] - cs[starts]
+
+    def count(self, contrib):
+        return self.sum(contrib.astype(jnp.int64), jnp.ones_like(contrib))
+
+    def _minmax(self, v, contrib, is_min: bool):
+        if jnp.ndim(v) == 0:
+            v = jnp.broadcast_to(v, contrib.shape)
+        if v.dtype == jnp.float64:
+            sentinel = F64_MAX if is_min else -F64_MAX
+        else:
+            sentinel = I64_MAX if is_min else I64_MIN + 1
+        vv = jnp.where(contrib, v, jnp.full_like(v, sentinel))
+        if self.use_onehot:
+            oh = self.onehot()
+            vm = jnp.where(oh, vv[None, :], jnp.full((), sentinel, vv.dtype))
+            return jnp.min(vm, axis=1) if is_min else jnp.max(vm, axis=1)
+        # sorted route: re-sort by (value-key, gid) — extremum sits at the
+        # segment's first (min) / last (max) row of that order. EMPTY
+        # segments must yield the sentinel, not a neighboring segment's
+        # gathered value: a chip whose shard has no rows for a group would
+        # otherwise poison the mesh pmin/pmax combine with a foreign value
+        key = _orderable_i64(vv)
+        order = jnp.lexsort([key, self.gid])
+        gs = self.gid[order]
+        r = jnp.arange(self.S)
+        starts = jnp.searchsorted(gs, r)
+        ends = jnp.searchsorted(gs, r, side="right")
+        vs = vv[order]
+        gathered = vs[jnp.clip(starts, 0, vs.shape[0] - 1)] if is_min \
+            else vs[jnp.clip(ends - 1, 0, vs.shape[0] - 1)]
+        return jnp.where(ends > starts, gathered,
+                         jnp.full((), sentinel, vs.dtype))
+
+    def min(self, v, contrib):
+        return self._minmax(v, contrib, True)
+
+    def max(self, v, contrib):
+        return self._minmax(v, contrib, False)
+
+
+def _sorted_boundary_sums(firsts, vals, gs, num_segments):
+    """Given rows ALREADY sorted by gs (monotone): per-segment count of
+    `firsts` and sum of vals over firsts, via prefix sums at segment
+    boundaries — the scatter-free tail of the distinct kernels."""
+    r = jnp.arange(num_segments)
+    starts = jnp.searchsorted(gs, r)
+    ends = jnp.searchsorted(gs, r, side="right")
+    fi = firsts.astype(jnp.int64)
+    cs_n = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(fi)])
+    vv = jnp.where(firsts, vals, jnp.zeros_like(vals))
+    cs_v = jnp.concatenate([jnp.zeros(1, vv.dtype), jnp.cumsum(vv)])
+    return cs_n[ends] - cs_n[starts], cs_v[ends] - cs_v[starts]
+
+
+# ---------------------------------------------------------------------------
 # single-shot (no group-by) aggregation kernel
 # ---------------------------------------------------------------------------
 
@@ -313,12 +449,34 @@ def _scalar_agg(spec: AggSpec, planes, mask):
 
 
 def _distinct_reduce(v, contrib):
-    """Exact request-global (distinct count, distinct sum): the
-    num_segments=1 case of the grouped kernel — one shared implementation
-    so boundary/NULL handling can never diverge between paths."""
-    gid = jnp.zeros(contrib.shape, jnp.int64)
-    cnt, sm = _grouped_distinct(v, contrib, gid, 1)
-    return cnt[0], sm[0]
+    """Exact request-global (distinct count, distinct sum) with ONE
+    single-key sort: non-contributing rows are folded into a +sentinel
+    run (instead of a second lexsort key), distinct runs are boundary
+    counts among non-sentinel keys, and a genuine sentinel-valued
+    contributing row is recovered exactly by a separate reduction. Sort
+    passes dominate this kernel, so one key instead of two ≈ 2× faster."""
+    if jnp.ndim(v) == 0:
+        v = jnp.broadcast_to(v, contrib.shape)
+    key = _orderable_i64(v)
+    sent = jnp.asarray(jnp.inf if key.dtype == jnp.float64 else I64_MAX,
+                       key.dtype)
+    ks = jnp.sort(jnp.where(contrib, key, sent))
+    # position 0 always opens a run (ks[0]-1 would be wrong for huge f64
+    # where x-1 == x)
+    boundary = jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]])
+    firsts = (ks != sent) & boundary
+    # a contributing row whose key EQUALS the sentinel merged into the
+    # sentinel run: count it (and its value) once, separately
+    has_sent = jnp.any(contrib & (key == sent))
+    cnt = jnp.sum(firsts.astype(jnp.int64)) + has_sent.astype(jnp.int64)
+    # distinct sum: sum of run-opening values. Values equal across a run,
+    # so sum keys at run starts; add the sentinel value if present.
+    vsum = jnp.sum(jnp.where(firsts, ks, jnp.zeros_like(ks)))
+    vsum = vsum + jnp.where(has_sent, sent, jnp.zeros_like(sent))
+    # ks is the ORDERABLE key, equal to v for i64/f64 planes except -0.0
+    # normalization — which only merges -0.0 with +0.0 (sum contribution 0
+    # either way), so summing keys is summing values
+    return cnt, vsum.astype(v.dtype)
 
 
 def _grouped_distinct(v, contrib, gid, num_segments):
@@ -326,7 +484,9 @@ def _grouped_distinct(v, contrib, gid, num_segments):
     segment boundary counting: rows lexsorted by (group id, contributing
     first, value); a contributing row opens a distinct run when the group
     or the value changes (local_aggregate.go:199 per-func distinct sets —
-    here one sort amortizes every group)."""
+    here one sort amortizes every group). After the lexsort the group ids
+    are monotone, so the per-segment totals are prefix-sum differences at
+    segment boundaries — no scatter."""
     if jnp.ndim(v) == 0:
         v = jnp.broadcast_to(v, contrib.shape)
     key = _orderable_i64(v)
@@ -335,11 +495,7 @@ def _grouped_distinct(v, contrib, gid, num_segments):
     prev_g = jnp.concatenate([jnp.full(1, -1, gs.dtype), gs[:-1]])
     prev_k = jnp.concatenate([ks[:1], ks[:-1]])
     firsts = cs & ((gs != prev_g) | (ks != prev_k))
-    cnt = jax.ops.segment_sum(firsts.astype(jnp.int64), gs,
-                              num_segments=num_segments)
-    sm = jax.ops.segment_sum(jnp.where(firsts, vs, jnp.zeros_like(vs)), gs,
-                             num_segments=num_segments)
-    return cnt, sm
+    return _sorted_boundary_sums(firsts, vs, gs, num_segments)
 
 
 # ---------------------------------------------------------------------------
@@ -374,11 +530,12 @@ def build_grouped_agg_fn(where: CompiledExpr | None, specs: list[AggSpec],
             c = jnp.where(cva, codes, size).astype(jnp.int64)  # NULL → size
             gid = c if gid is None else gid * radix + c
         gid = jnp.where(mask, gid, num_segments - 1)  # dead rows → sink
-        row_count = jax.ops.segment_sum(mask.astype(jnp.int64), gid,
-                                        num_segments=num_segments)
+        seg = SegCtx(gid, num_segments)
+        row_count = seg.count(mask)
         outs = [row_count]
         for spec in specs:
-            outs.extend(_grouped_agg(spec, planes, mask, gid, num_segments))
+            outs.extend(_grouped_agg(spec, planes, mask, gid, num_segments,
+                                     seg))
         return tuple(outs)
 
     fn.num_segments = num_segments
@@ -387,7 +544,11 @@ def build_grouped_agg_fn(where: CompiledExpr | None, specs: list[AggSpec],
     return fn
 
 
-def _grouped_agg(spec: AggSpec, planes, mask, gid, num_segments):
+def _grouped_agg(spec: AggSpec, planes, mask, gid, num_segments,
+                 seg: SegCtx, perm=None):
+    """One aggregate's per-segment outputs. `gid`/`seg` and (after `perm`,
+    when given) v/contrib all live in the same row order — the ranked path
+    passes its sort permutation so everything stays in sorted space."""
     name = spec.name
     if spec.arg is None:
         v, va = jnp.int64(1), jnp.bool_(True)
@@ -397,8 +558,9 @@ def _grouped_agg(spec: AggSpec, planes, mask, gid, num_segments):
     if jnp.ndim(v) == 0:
         v = jnp.broadcast_to(v, mask.shape)
         contrib = jnp.broadcast_to(contrib, mask.shape) & mask
-    n = jax.ops.segment_sum(contrib.astype(jnp.int64), gid,
-                            num_segments=num_segments)
+    if perm is not None:
+        v, contrib, mask = v[perm], contrib[perm], mask[perm]
+    n = seg.count(contrib)
     if name == "count":
         if spec.distinct:
             return (_grouped_distinct(v, contrib, gid, num_segments)[0],)
@@ -406,31 +568,19 @@ def _grouped_agg(spec: AggSpec, planes, mask, gid, num_segments):
     if name in ("sum", "avg") and spec.distinct:
         return _grouped_distinct(v, contrib, gid, num_segments)
     if name in ("sum", "avg"):
-        vv = jnp.where(contrib, v, jnp.zeros_like(v))
-        s = jax.ops.segment_sum(vv, gid, num_segments=num_segments)
-        return (n, s)
-    if name in ("min", "max"):
-        if v.dtype == jnp.float64:
-            sentinel = F64_MAX if name == "min" else -F64_MAX
-        else:
-            sentinel = I64_MAX if name == "min" else I64_MIN + 1
-        vv = jnp.where(contrib, v, jnp.full_like(v, sentinel))
-        if name == "min":
-            red = jax.ops.segment_min(vv, gid, num_segments=num_segments)
-        else:
-            red = jax.ops.segment_max(vv, gid, num_segments=num_segments)
-        return (n, red)
+        return (n, seg.sum(v, contrib))
+    if name == "min":
+        return (n, seg.min(v, contrib))
+    if name == "max":
+        return (n, seg.max(v, contrib))
     if name == "first_row":
         # exact: smallest live row position per group — the first row
         # counts even when its value is NULL (CPU oracle keeps it); the
         # host gathers the value (mesh combine = pmin)
         pos, _ = planes[POS_CID]
-        n_rows = jax.ops.segment_sum(mask.astype(jnp.int64), gid,
-                                     num_segments=num_segments)
-        first = jax.ops.segment_min(
-            jnp.where(mask, pos, I64_MAX), gid,
-            num_segments=num_segments)
-        return (n_rows, first)
+        if perm is not None:
+            pos = pos[perm]
+        return (seg.count(mask), seg.min(pos, mask))
     raise Unsupported(name)
 
 
@@ -451,7 +601,12 @@ def build_ranked_group_fn(where: CompiledExpr | None, specs: list[AggSpec],
     the dead-row sink. Ranks beyond S-1 clamp into the sink; the host
     detects ngroups > S-1 and retries with a larger bucket (exact, no hash
     collisions possible). Ids are batch-local ranks, so this kernel is
-    single-chip only — the client keeps rank requests off the mesh."""
+    single-chip only — the client keeps rank requests off the mesh.
+
+    Everything runs in SORTED space (group ids are monotone after the
+    lexsort), so per-segment totals are prefix-sum differences and group
+    representatives are gathers at segment starts — no scatter, and no
+    inverse permutation back to row order."""
 
     def fn(planes, live):
         mask = live
@@ -475,37 +630,34 @@ def build_ranked_group_fn(where: CompiledExpr | None, specs: list[AggSpec],
 
         live_s = mask[order]
         cap = live_s.shape[0]
-        change = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        change = None   # row 0 always opens a group (every term's head is 1)
         for k, nullk in keys:
             ks, ns = k[order], nullk[order]
             tail = (ks[1:] != ks[:-1]) | (ns[1:] != ns[:-1])
-            change = change | jnp.concatenate(
-                [jnp.ones(1, dtype=bool), tail])
+            term = jnp.concatenate([jnp.ones(1, dtype=bool), tail])
+            change = term if change is None else change | term
         newgrp = change & live_s
         ngroups = jnp.sum(newgrp.astype(jnp.int64))
         gid_s = jnp.cumsum(newgrp.astype(jnp.int64)) - 1
         gid_s = jnp.where(live_s,
                           jnp.minimum(gid_s, num_segments - 1),
                           num_segments - 1)
-        gid = jnp.zeros(cap, jnp.int64).at[order].set(gid_s)
 
-        row_count = jax.ops.segment_sum(mask.astype(jnp.int64), gid,
-                                        num_segments=num_segments)
+        seg = SegCtx(gid_s, num_segments, presorted=True)
+        _, _, starts, _ends = seg.sorted_ctx()
+        row_count = seg.count(live_s)
         outs = [ngroups, row_count]
-        # group-key representatives: constant within a group, so a masked
-        # segment_max recovers (value, non-null) exactly
+        # group-key representatives: every live row of a segment carries
+        # the same (value, null-flag) — gather them at the segment starts
+        start_i = jnp.clip(starts, 0, cap - 1)
         for cid, kind in group_cols:
             v, va = planes[cid]
-            contrib = mask & va
-            sent = -F64_MAX if v.dtype == jnp.float64 else I64_MIN + 1
-            rep = jax.ops.segment_max(
-                jnp.where(contrib, v, jnp.full_like(v, sent)), gid,
-                num_segments=num_segments)
-            nonnull = jax.ops.segment_max(contrib.astype(jnp.int64), gid,
-                                          num_segments=num_segments)
+            rep = v[order][start_i]
+            nonnull = (live_s & va[order])[start_i].astype(jnp.int64)
             outs.extend([rep, nonnull])
         for spec in specs:
-            outs.extend(_grouped_agg(spec, planes, mask, gid, num_segments))
+            outs.extend(_grouped_agg(spec, planes, mask, gid_s,
+                                     num_segments, seg, perm=order))
         return tuple(outs)
 
     fn.num_segments = num_segments

@@ -104,6 +104,24 @@ class Checker:
                     return True
             return False
 
+    def check_any(self, user: str, db: str, table: str) -> bool:
+        """Does the user hold ANY privilege on db.table at any scope?
+        MySQL's gate for schema inspection (COM_FIELD_LIST, SHOW COLUMNS,
+        SHOW CREATE TABLE): column metadata is visible iff some privilege
+        exists on the table (sql_show.cc check_table_access)."""
+        with self._lock:
+            if self._loaded_version != self.version:
+                self._load()
+                self._loaded_version = self.version
+            if self._global.get(user):
+                return True
+            if db and self._db.get((user, db.lower())):
+                return True
+            if db and table and self._table.get(
+                    (user, db.lower(), table.lower())):
+                return True
+            return False
+
 
 _checkers: dict[str, Checker] = {}
 _checkers_lock = threading.Lock()
@@ -232,6 +250,17 @@ def check_stmt(session, stmt) -> None:
         return
     checker = checker_for(session.store)
     reqs = required_privs(stmt, session.vars.current_db)
+    if isinstance(stmt, ast.ShowStmt) \
+            and stmt.tp in (ast.ShowType.COLUMNS, ast.ShowType.CREATE_TABLE) \
+            and getattr(stmt, "table", None):
+        tn = stmt.table
+        db = (getattr(tn, "db", None) or stmt.db
+              or session.vars.current_db or "").lower()
+        name = (tn.name if hasattr(tn, "name") else str(tn)).lower()
+        if not checker.check_any(user, db, name):
+            raise AccessDenied(
+                f"SHOW command denied to user '{user}' for table "
+                f"'{db}.{name}'")
     if isinstance(stmt, ast.ShowStmt) and stmt.tp == ast.ShowType.GRANTS \
             and stmt.pattern and stmt.pattern != user:
         # viewing ANOTHER account's grants requires read access to the

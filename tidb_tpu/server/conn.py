@@ -229,6 +229,16 @@ class ClientConnection:
     def handle_field_list(self, data: bytes) -> None:
         table = data.split(b"\x00", 1)[0].decode()
         db = self.session.vars.current_db
+        user = self.session.vars.user
+        if user:
+            # MySQL requires SOME privilege on the table before exposing
+            # its column definitions (same gate as SHOW COLUMNS)
+            from tidb_tpu import privilege as pv
+            if not pv.checker_for(self.session.store).check_any(
+                    user, db, table):
+                raise pv.AccessDenied(
+                    f"SHOW command denied to user '{user}' for table "
+                    f"'{db}.{table}'")
         tbl = self.session.info_schema().table_by_name(db, table)
         for col in tbl.info.public_columns():
             ft = col.field_type
