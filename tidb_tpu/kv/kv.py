@@ -115,6 +115,10 @@ class Response(abc.ABC):
     def next(self) -> Any | None:
         """Next partial result (SelectResponse) or None when exhausted."""
 
+    def close(self) -> None:
+        """Release fan-out resources; consumers that stop early (LIMIT)
+        MUST call this so pipelined workers are not parked forever."""
+
 
 class Client(abc.ABC):
     """Reference: kv/kv.go:94-100."""
@@ -180,3 +184,44 @@ def open_store(url: str) -> Storage:
     if path:
         _stores[key] = store
     return store
+
+
+def ms_to_version(ms: int) -> int:
+    """Wall-clock milliseconds → TSO version (physical-ms << 18 | logical);
+    the single owner of the version bit layout shared by both stores'
+    oracles (store/tikv/oracle scheme)."""
+    return ms << 18
+
+
+class ActiveReads:
+    """Thread-safe weak registry of live snapshots/transactions. GC
+    workers clamp their safepoint to oldest() so a long-running reader can
+    never have the versions it is reading reclaimed mid-scan."""
+
+    def __init__(self):
+        import threading
+        import weakref
+        self._set = weakref.WeakSet()
+        self._lock = threading.Lock()
+
+    def add(self, obj) -> None:
+        with self._lock:
+            self._set.add(obj)
+
+    def oldest(self) -> int | None:
+        """Smallest start version among live, unfinished readers."""
+        with self._lock:
+            objs = list(self._set)
+        ts = [getattr(o, "version", None) or getattr(o, "_start_ts", None)
+              for o in objs
+              if getattr(o, "_valid", True)]   # finished txns don't pin
+        ts = [t for t in ts if t is not None]
+        return min(ts) if ts else None
+
+
+def close_store(url: str) -> None:
+    """Close and evict a cached store (server shutdown / restart tests —
+    the next open_store on the same URL recovers from the engine)."""
+    store = _stores.pop(url, None)
+    if store is not None:
+        store.close()

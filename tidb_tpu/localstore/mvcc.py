@@ -107,5 +107,13 @@ class MVCCStore:
                 removed += 1
         return removed
 
+    def export_cells(self) -> dict:
+        """Consistent shallow copy of the cell map for engine snapshots:
+        version lists are copy-on-write (never mutated in place), so the
+        copy is immune to concurrent write()/compact() — which mutate the
+        DICT — without holding the lock during serialization."""
+        with self._lock:
+            return dict(self._cells)
+
     def __len__(self) -> int:
         return len(self._cells)

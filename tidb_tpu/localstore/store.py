@@ -11,13 +11,13 @@ of the reference collapses to this check because commit is serialized).
 from __future__ import annotations
 
 import threading
-import weakref
 import time
 from typing import Iterator
 
 from tidb_tpu import errors
 from tidb_tpu.kv.kv import (
-    Client, Driver, KeyRange, Request, Response, Snapshot, Storage, Transaction,
+    ActiveReads, Client, Driver, KeyRange, Request, Response, Snapshot,
+    Storage, Transaction,
 )
 from tidb_tpu.kv.union_store import UnionStore
 from tidb_tpu.kv.membuffer import TOMBSTONE
@@ -137,8 +137,10 @@ class LocalTxn(Transaction):
 
 
 class LocalStore(Storage):
-    def __init__(self, path: str = ""):
+    def __init__(self, path: str = "", engine=None):
+        from tidb_tpu.localstore.engine import MemEngine
         self.path = path
+        self.engine = engine if engine is not None else MemEngine()
         self.mvcc = MVCCStore()
         self.oracle = VersionProvider()
         self.regions = RegionManager()
@@ -155,10 +157,37 @@ class LocalStore(Storage):
         self._commit_bounds_log: list[dict[bytes, tuple[bytes, bytes]]] = []
         self._commit_bounds_base = 0           # version of log[0]
         self._commit_bounds_cap = 4096
-        # live readers (snapshots/txns), weakly held: GC clamps its
-        # safepoint to the oldest of these so a long scan can never have
-        # the versions it is reading reclaimed mid-flight
-        self._active_reads = weakref.WeakSet()
+        # live readers (snapshots/txns): GC clamps its safepoint to the
+        # oldest of these so a long scan can never have the versions it
+        # is reading reclaimed mid-flight
+        self._active_reads = ActiveReads()
+        self._recover()
+
+    def _recover(self) -> None:
+        """Load the engine's snapshot + WAL into the in-memory MVCC core
+        and re-arm the TSO above every recovered version (clock skew after
+        a restart must never mint a version at or below a durable one)."""
+        cells, commits = self.engine.recover()
+        max_ts = 0
+        snap_ts = 0
+        if cells:
+            for key, vers in cells.items():
+                for ver, val in vers:
+                    self.mvcc.write(key, ver, val)
+                if vers:
+                    max_ts = max(max_ts, vers[0][0])
+            snap_ts = max_ts
+        for commit_ts, muts in commits:
+            if commit_ts <= snap_ts:
+                # crash between snapshot rename and WAL reset: these
+                # commits are already inside the snapshot — replaying
+                # would double-count version/region bookkeeping
+                continue
+            self._apply_commit(commit_ts, muts)
+            max_ts = max(max_ts, commit_ts)
+        if max_ts:
+            with self.oracle._lock:
+                self.oracle._last = max(self.oracle._last, max_ts)
 
     # ---- Storage ----
     def begin(self) -> Transaction:
@@ -174,11 +203,7 @@ class LocalStore(Storage):
 
     def oldest_active_ts(self) -> int | None:
         """Smallest start_ts among live snapshots/txns, or None."""
-        ts = [getattr(o, "version", None) or getattr(o, "_start_ts", None)
-              for o in list(self._active_reads)
-              if getattr(o, "_valid", True)]   # finished txns don't pin
-        ts = [t for t in ts if t is not None]
-        return min(ts) if ts else None
+        return self._active_reads.oldest()
 
     def get_client(self) -> Client:
         if self._client is None:
@@ -202,6 +227,15 @@ class LocalStore(Storage):
     def uuid(self) -> str:
         return f"local-{self.path or id(self):}"
 
+    def close(self) -> None:
+        self._closed = True
+        self.engine.close()
+
+    def checkpoint(self) -> None:
+        """Force an engine snapshot now (ADMIN CHECKPOINT / shutdown)."""
+        with self._commit_lock:
+            self.engine.snapshot(self.mvcc.export_cells())
+
     # ---- commit (store/localstore/kv.go:111-165) ----
     def commit_txn(self, txn_start_ts: int, mutations: list[tuple[bytes, bytes]]) -> None:
         with self._commit_lock:
@@ -210,22 +244,35 @@ class LocalStore(Storage):
                     raise errors.WriteConflictError(
                         f"write conflict on {key!r} (start_ts={txn_start_ts})")
             commit_ts = self.oracle.current_version()
-            bounds: dict[bytes, tuple[bytes, bytes]] = {}
-            for key, val in mutations:
-                self.mvcc.write(key, commit_ts, None if val == TOMBSTONE else val)
-                p = bytes(key[:12])
-                cur = bounds.get(p)
-                if cur is None:
-                    bounds[p] = (key, key)
-                else:
-                    bounds[p] = (min(cur[0], key), max(cur[1], key))
-            self.regions.note_write(len(mutations))
-            self._commit_ts_log.append(commit_ts)
-            self._commit_bounds_log.append(bounds)
-            overflow = len(self._commit_bounds_log) - self._commit_bounds_cap
-            if overflow > 0:
-                del self._commit_bounds_log[:overflow]
-                self._commit_bounds_base += overflow
+            muts = [(key, None if val == TOMBSTONE else val)
+                    for key, val in mutations]
+            # write-ahead: durable (or raising) BEFORE the in-memory apply —
+            # an engine failure leaves memory untouched and the commit
+            # unacknowledged
+            self.engine.append_commit(commit_ts, muts)
+            self._apply_commit(commit_ts, muts)
+            self.engine.maybe_snapshot(self.mvcc.export_cells)
+
+    def _apply_commit(self, commit_ts: int,
+                      muts: list[tuple[bytes, bytes | None]]) -> None:
+        """Apply an (already durable) commit to the MVCC core + version
+        bookkeeping — shared by the live path and WAL recovery."""
+        bounds: dict[bytes, tuple[bytes, bytes]] = {}
+        for key, val in muts:
+            self.mvcc.write(key, commit_ts, val)
+            p = bytes(key[:12])
+            cur = bounds.get(p)
+            if cur is None:
+                bounds[p] = (key, key)
+            else:
+                bounds[p] = (min(cur[0], key), max(cur[1], key))
+        self.regions.note_write(len(muts))
+        self._commit_ts_log.append(commit_ts)
+        self._commit_bounds_log.append(bounds)
+        overflow = len(self._commit_bounds_log) - self._commit_bounds_cap
+        if overflow > 0:
+            del self._commit_bounds_log[:overflow]
+            self._commit_bounds_base += overflow
 
     def data_version_at(self, start_ts: int) -> int:
         """Number of commits visible at start_ts — the cache key the TPU
@@ -249,12 +296,23 @@ class LocalStore(Storage):
         """MVCC GC at a safepoint (default now − max_age_ms).
         Reference: store/localstore/compactor.go policy {SafePoint: 20min}."""
         if safe_point_ts is None:
-            safe_point_ts = (int(time.time() * 1000) - max_age_ms) << 18
+            from tidb_tpu.kv.kv import ms_to_version
+            safe_point_ts = ms_to_version(
+                int(time.time() * 1000) - max_age_ms)
         return self.mvcc.compact(safe_point_ts)
 
 
 class LocalDriver(Driver):
-    """URL scheme driver. Reference: tidb.go:254-258 store registration."""
+    """URL scheme driver. Reference: tidb.go:254-258 store registration.
+    scheme 'memory' (or an empty path) → pure-memory engine; 'local' /
+    'goleveldb' / 'boltdb' with a path → durable WAL engine at that
+    directory (the reference's disk engines, goleveldb.go/boltdb.go)."""
+
+    def __init__(self, scheme: str = "memory"):
+        self.scheme = scheme
 
     def open(self, path: str) -> Storage:
+        if path and self.scheme in ("local", "goleveldb", "boltdb"):
+            from tidb_tpu.localstore.engine import WalEngine
+            return LocalStore(path, engine=WalEngine(path))
         return LocalStore(path)

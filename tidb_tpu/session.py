@@ -42,7 +42,7 @@ def _ensure_drivers():
     from tidb_tpu.kv import kv as kvmod
     for scheme in ("local", "memory", "goleveldb", "boltdb"):
         if scheme not in kvmod._drivers:
-            register_driver(scheme, LocalDriver())
+            register_driver(scheme, LocalDriver(scheme))
     if "cluster" not in kvmod._drivers:
         from tidb_tpu.cluster.store import ClusterDriver
         register_driver("cluster", ClusterDriver())
