@@ -248,3 +248,40 @@ class TestSqlOverCluster:
         with pytest.raises(RuntimeError):
             while resp.next() is not None:
                 pass
+
+
+class TestPipelinedBackpressure:
+    def test_window_bounds_completed_results(self):
+        from tidb_tpu.cluster.store import _PipelinedResponse
+        import threading
+        import time as _t
+        ran = []
+        def run(task):
+            ran.append(task)
+            return [task]
+        resp = _PipelinedResponse(list(range(64)), run, concurrency=2)
+        assert resp.next() == 0
+        _t.sleep(0.2)
+        # workers must stay within the sliding window of the consumer,
+        # not race through all 64 tasks
+        assert len(ran) <= 2 * 2 + 2 + 1
+        while resp.next() is not None:
+            pass
+        assert sorted(ran) == list(range(64))
+
+    def test_close_releases_parked_workers(self):
+        from tidb_tpu.cluster.store import _PipelinedResponse
+        import time as _t
+        ran = []
+        def run(task):
+            ran.append(task)
+            return [task]
+        resp = _PipelinedResponse(list(range(64)), run, concurrency=2)
+        assert resp.next() == 0          # consume one, then abandon (LIMIT)
+        resp.close()
+        _t.sleep(0.3)
+        n_after_close = len(ran)
+        _t.sleep(0.3)
+        # workers exited: no further tasks execute after close settles
+        assert len(ran) == n_after_close
+        assert len(ran) < 64

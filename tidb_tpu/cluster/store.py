@@ -14,7 +14,6 @@ URL scheme.
 from __future__ import annotations
 
 import threading
-import weakref
 
 from tidb_tpu import errors
 from tidb_tpu.cluster.client import (
@@ -231,6 +230,7 @@ class _PipelinedResponse(kv.Response):
         # proportional to concurrency instead of the whole region set (the
         # reference's bounded channel, coprocessor.go:317)
         self._window = max(2 * concurrency, 4)
+        self._abandoned = False
 
         task_iter = iter(enumerate(tasks))
         iter_lock = threading.Lock()
@@ -244,9 +244,9 @@ class _PipelinedResponse(kv.Response):
                 idx, rg = nxt
                 with self._cv:
                     while (idx >= self._next_task + self._window
-                           and self._err is None):
+                           and self._err is None and not self._abandoned):
                         self._cv.wait()
-                    if self._err is not None:
+                    if self._err is not None or self._abandoned:
                         return
                 try:
                     out = run(rg)
@@ -262,6 +262,14 @@ class _PipelinedResponse(kv.Response):
 
         for _ in range(concurrency):
             threading.Thread(target=worker, daemon=True).start()
+
+    def close(self) -> None:
+        """Abandon the fan-out: wake any workers parked on the window so
+        they exit instead of waiting for a consumer that stopped early
+        (LIMIT). Idempotent."""
+        with self._cv:
+            self._abandoned = True
+            self._cv.notify_all()
 
     def next(self):
         if self._cursor < len(self._buf):
@@ -295,8 +303,8 @@ class DistStore(kv.Storage):
         self.oracle = VersionProvider()
         self._client: kv.Client | None = None
         self._commit_log_lock = threading.Lock()
-        # live readers, weakly held — see LocalStore._active_reads
-        self._active_reads = weakref.WeakSet()
+        # live readers — GC clamps to the oldest (see kv.ActiveReads)
+        self._active_reads = kv.ActiveReads()
 
     def begin(self) -> kv.Transaction:
         txn = DistTxn(self, self.oracle.current_version())
@@ -310,11 +318,7 @@ class DistStore(kv.Storage):
         return snap
 
     def oldest_active_ts(self) -> int | None:
-        ts = [getattr(o, "version", None) or getattr(o, "_start_ts", None)
-              for o in list(self._active_reads)
-              if getattr(o, "_valid", True)]   # finished txns don't pin
-        ts = [t for t in ts if t is not None]
-        return min(ts) if ts else None
+        return self._active_reads.oldest()
 
     def get_client(self) -> kv.Client:
         if self._client is None:

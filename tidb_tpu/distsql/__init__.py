@@ -28,6 +28,9 @@ class SelectResult:
     def __iter__(self):
         return self
 
+    def close(self) -> None:
+        self._resp.close()
+
     def __next__(self):
         while True:
             for handle, datums in self._rows:

@@ -159,6 +159,13 @@ class XSelectTableExec(Executor):
         self.last_handle = handle
         return row
 
+    def close(self) -> None:
+        # abandon pipelined region workers when the consumer stopped early
+        # (LIMIT above a scan) — they must not stay parked on the window
+        if self._result is not None:
+            self._result.close()
+        super().close()
+
 
 class XSelectIndexExec(Executor):
     """Reference: executor/executor_distsql.go:326 — single-read for covering
@@ -170,6 +177,7 @@ class XSelectIndexExec(Executor):
         self.ctx = ctx
         self._rows = None
         self._pos = 0
+        self._open_result = None   # in-flight SelectResult (error cleanup)
 
     # -- request plumbing --
 
@@ -210,6 +218,7 @@ class XSelectIndexExec(Executor):
     def _materialize(self):
         scan = self.scan_plan
         result, pb_cols = self._index_request()
+        self._open_result = result
         if not scan.double_read:
             # single read: remap pb column order → schema order
             col_pos = {c.column_id: i for i, c in enumerate(pb_cols)}
@@ -218,6 +227,8 @@ class XSelectIndexExec(Executor):
                 row = [vals[col_pos[c.col_id]] for c in scan.schema]
                 rows.append((handle, row))
             self._rows = rows
+            result.close()
+            self._open_result = None
             return
         # double read: collect handles in index order, then batched lookups
         handles = [handle for handle, _ in result]
@@ -232,6 +243,8 @@ class XSelectIndexExec(Executor):
                 rows_by_handle[handle] = row
         self._rows = [(h, rows_by_handle[h]) for h in handles
                       if h in rows_by_handle]
+        result.close()
+        self._open_result = None
 
     def _lookup_rows(self, handles: list[int]):
         """Second request: fetch full rows by handle ranges
@@ -254,6 +267,14 @@ class XSelectIndexExec(Executor):
         self._pos += 1
         self.last_handle = handle
         return row
+
+    def close(self) -> None:
+        # an error mid-materialize leaves the fan-out in flight; the
+        # session's executor.close() must release its parked workers
+        if self._open_result is not None:
+            self._open_result.close()
+            self._open_result = None
+        super().close()
 
 
 class UnionScanExec(Executor):

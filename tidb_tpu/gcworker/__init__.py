@@ -85,12 +85,16 @@ class Compactor(_TickThread):
         cur = self.store.data_version_at(self.store.current_version())
         if cur == self._last_version:
             return 0
-        safe = (int(time.time() * 1000) - self.safe_age_ms) << 18
-        removed = self.store.compact(
-            safe_point_ts=_clamp_to_active(self.store, safe))
+        from tidb_tpu.kv.kv import ms_to_version
+        safe = ms_to_version(int(time.time() * 1000) - self.safe_age_ms)
+        clamped = _clamp_to_active(self.store, safe)
+        removed = self.store.compact(safe_point_ts=clamped)
         # only after a SUCCESSFUL compact — a raise must leave the version
-        # probe stale so the next tick retries
-        self._last_version = cur
+        # probe stale so the next tick retries. A CLAMPED tick also stays
+        # unconsumed: once the pinning reader departs, the next tick must
+        # reclaim what it protected even on a write-idle store
+        if clamped >= safe:
+            self._last_version = cur
         metrics.counter("compactor.runs").inc()
         if removed:
             metrics.counter("compactor.versions_removed").inc(removed)
@@ -142,5 +146,5 @@ class GCWorker(_TickThread):
         return removed
 
     def _safe_point(self) -> int:
-        # oracle versions are (ms << 18 | logical): same scheme both stores
-        return (int(time.time() * 1000) - self.safe_age_ms) << 18
+        from tidb_tpu.kv.kv import ms_to_version
+        return ms_to_version(int(time.time() * 1000) - self.safe_age_ms)

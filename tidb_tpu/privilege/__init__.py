@@ -31,6 +31,11 @@ TABLE_PRIVS = ("Select", "Insert", "Update", "Delete", "Create", "Drop",
                "Grant", "Index", "Alter")
 
 
+# schema introspection on these is unconditionally allowed (MySQL
+# check_table_access always passes information_schema)
+VIRTUAL_SCHEMAS = ("information_schema", "performance_schema")
+
+
 class AccessDenied(errors.TiDBError):
     code = my.ErrAccessDenied
 
@@ -257,7 +262,8 @@ def check_stmt(session, stmt) -> None:
         db = (getattr(tn, "db", None) or stmt.db
               or session.vars.current_db or "").lower()
         name = (tn.name if hasattr(tn, "name") else str(tn)).lower()
-        if not checker.check_any(user, db, name):
+        if db not in VIRTUAL_SCHEMAS and not checker.check_any(user, db,
+                                                              name):
             raise AccessDenied(
                 f"SHOW command denied to user '{user}' for table "
                 f"'{db}.{name}'")

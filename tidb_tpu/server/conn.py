@@ -230,7 +230,8 @@ class ClientConnection:
         table = data.split(b"\x00", 1)[0].decode()
         db = self.session.vars.current_db
         user = self.session.vars.user
-        if user:
+        if user and db.lower() not in ("information_schema",
+                                       "performance_schema"):
             # MySQL requires SOME privilege on the table before exposing
             # its column definitions (same gate as SHOW COLUMNS)
             from tidb_tpu import privilege as pv
