@@ -1,0 +1,121 @@
+"""INSERT … ON DUPLICATE KEY UPDATE / INSERT IGNORE / REPLACE and
+handle-moving updates.
+
+Reference: executor/executor_write.go:554-608 (onDuplicateUpdate,
+batchGetInsertKeys eager conflict detection), parser/parser.y:2043.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tidb_tpu import errors
+from tests.testkit import TestKit
+
+
+@pytest.fixture
+def tk():
+    t = TestKit()
+    t.exec("create database test")
+    t.exec("use test")
+    t.exec("create table t (a int primary key, b int, unique key ub (b))")
+    t.exec("insert into t values (1, 10), (2, 20)")
+    return t
+
+
+class TestOnDuplicateKeyUpdate:
+    def test_pk_conflict_updates(self, tk):
+        tk.exec("insert into t values (1, 11) "
+                "on duplicate key update b = b + 100")
+        tk.query("select * from t order by a").check([[1, 110], [2, 20]])
+
+    def test_unique_index_conflict_targets_existing_row(self, tk):
+        # conflicts on ub (b=20) → row a=2 is the one updated
+        tk.exec("insert into t values (9, 20) "
+                "on duplicate key update b = b + 5")
+        tk.query("select * from t order by a").check([[1, 10], [2, 25]])
+
+    def test_values_function(self, tk):
+        tk.exec("insert into t values (1, 77) "
+                "on duplicate key update b = values(b) + 1")
+        tk.query("select b from t where a = 1").check([[78]])
+
+    def test_no_conflict_inserts_normally(self, tk):
+        tk.exec("insert into t values (3, 30) "
+                "on duplicate key update b = 999")
+        tk.query("select * from t order by a").check(
+            [[1, 10], [2, 20], [3, 30]])
+
+    def test_affected_rows_two_for_update(self, tk):
+        tk.exec("insert into t values (1, 12) "
+                "on duplicate key update b = 12")
+        assert tk.session.vars.affected_rows == 2
+
+    def test_updating_pk_moves_row(self, tk):
+        tk.exec("insert into t values (1, 0) "
+                "on duplicate key update a = a + 100")
+        tk.query("select * from t order by a").check([[2, 20], [101, 10]])
+        # index still points at the moved row
+        tk.query("select a from t where b = 10").check([[101]])
+
+
+class TestInsertIgnore:
+    def test_ignores_pk_and_unique_conflicts(self, tk):
+        tk.exec("insert ignore into t values (1, 99), (8, 20), (3, 30)")
+        tk.query("select * from t order by a").check(
+            [[1, 10], [2, 20], [3, 30]])
+
+    def test_affected_counts_only_inserted(self, tk):
+        tk.exec("insert ignore into t values (1, 99), (4, 40)")
+        assert tk.session.vars.affected_rows == 1
+
+
+class TestDupEntryErrors:
+    def test_pk_duplicate_is_1062_with_clean_message(self, tk):
+        with pytest.raises(errors.DupEntryError) as ei:
+            tk.exec("insert into t values (1, 5)")
+        assert getattr(ei.value, "code", None) == 1062
+        assert "Duplicate entry '1' for key 'PRIMARY'" in str(ei.value)
+
+    def test_update_pk_collision_is_1062(self, tk):
+        with pytest.raises(errors.DupEntryError):
+            tk.exec("update t set a = 2 where a = 1")
+
+
+class TestReplaceUniqueIndex:
+    def test_replace_via_unique_key(self, tk):
+        tk.exec("replace into t values (7, 20)")   # displaces row a=2
+        tk.query("select * from t order by a").check([[1, 10], [7, 20]])
+
+    def test_update_pk_move_keeps_indexes(self, tk):
+        tk.exec("update t set a = 50 where a = 2")
+        tk.query("select a from t where b = 20").check([[50]])
+        tk.exec("insert into t values (2, 99)")   # old handle is free again
+        tk.query("select count(1) from t").check([[3]])
+
+
+class TestMultiUniqueConflicts:
+    @pytest.fixture
+    def tk2(self):
+        t = TestKit()
+        t.exec("create database test")
+        t.exec("use test")
+        t.exec("create table m (id int primary key auto_increment, "
+               "a int, b int, unique key ua (a), unique key ub (b))")
+        t.exec("insert into m (a, b) values (1, 1), (2, 2)")
+        return t
+
+    def test_replace_deletes_every_conflicting_row(self, tk2):
+        # collides with row 1 on ua AND row 2 on ub: both must go
+        tk2.exec("replace into m (a, b) values (1, 2)")
+        tk2.query("select a, b from m").check([[1, 2]])
+
+    def test_ignore_leaves_no_dangling_index_entries(self, tk2):
+        # collides on ub only — the ua entry for a=3 must NOT be committed
+        tk2.exec("insert ignore into m (a, b) values (3, 2)")
+        tk2.query("select count(1) from m").check([[2]])
+        # an index scan on a=3 must find nothing (no phantom handle)
+        tk2.query("select a, b from m where a = 3").check([])
+        # and inserting a=3 with a fresh b must now succeed
+        tk2.exec("insert into m (a, b) values (3, 30)")
+        tk2.query("select a, b from m where a = 3").check([[3, 30]])

@@ -82,6 +82,13 @@ class KeyExistsError(KVError, DupEntryError):
     """kv.ErrKeyExists — unique constraint violation surfaced as 1062."""
     code = my.ErrDupEntry
 
+    def __init__(self, msg: str = "", existing_handle: int | None = None):
+        super().__init__(msg)
+        # the conflicting row's handle when the checker knows it (eager
+        # unique-index / row-key checks) — ON DUPLICATE KEY UPDATE and
+        # REPLACE locate the row to touch through this
+        self.existing_handle = existing_handle
+
 
 class RetryableError(KVError):
     """kv.ErrRetryable / write-conflict class: session may replay the txn.

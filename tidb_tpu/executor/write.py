@@ -59,20 +59,24 @@ class InsertExec(Executor):
         else:
             rows = plan.lists
 
+        # conflict-reactive forms must see duplicates EAGERLY (the default
+        # lazy presume-not-exists check only fires at commit, far too late
+        # to react inside the statement; executor_write.go:554)
+        eager = bool(plan.on_duplicate or plan.ignore or plan.is_replace)
         for value_row in rows:
             if plan.select_plan is None and len(value_row) != len(cols):
                 raise errors.ExecError(
                     "Column count doesn't match value count")
             full = self._build_row(cols, value_row, txn)
             try:
-                tbl.add_record(txn, full)
+                tbl.add_record(txn, full, eager_check=eager)
                 affected += 1
-            except errors.DupEntryError:
+            except errors.DupEntryError as e:
                 if plan.on_duplicate:
-                    self._on_duplicate(txn, tbl, full)
+                    self._on_duplicate(txn, tbl, full, e)
                     affected += 2
                 elif plan.is_replace:
-                    self._replace(txn, tbl, full)
+                    self._replace(txn, tbl, full, e)
                     affected += 2
                 elif plan.ignore:
                     continue
@@ -121,7 +125,14 @@ class InsertExec(Executor):
             full.append(d)
         return full
 
-    def _existing_handle(self, full) -> int:
+    def _existing_handle(self, full, err=None) -> int:
+        """Handle of the row the insert collided with: eager checks put
+        it on the error (unique secondary indexes collide on a DIFFERENT
+        handle than the new row's); PK collisions fall back to the new
+        row's own key."""
+        h = getattr(err, "existing_handle", None)
+        if h is not None:
+            return h
         info = self.plan.table.info
         pk = info.pk_handle_column()
         if pk is None:
@@ -130,28 +141,64 @@ class InsertExec(Executor):
                 "is not supported yet")
         return full[pk.offset].get_int()
 
-    def _on_duplicate(self, txn, tbl, full):
-        handle = self._existing_handle(full)
+    def _on_duplicate(self, txn, tbl, full, err=None):
+        handle = self._existing_handle(full, err)
         old = tbl.row_with_cols(txn, handle)
         new = list(old)
-        # ON DUPLICATE KEY UPDATE assignments; VALUES(col) not yet lowered
-        builder_schema_row = old
         for col_node, expr_ast in self.plan.on_duplicate:
             name = col_node.name if hasattr(col_node, "name") else col_node
             ci = tbl.info.find_column(name)
             if ci is None:
                 raise errors.UnknownFieldError(f"Unknown column '{name}'")
             from tidb_tpu.plan.builder import PlanBuilder
+            expr_ast = _subst_values_func(expr_ast, tbl, full)
             e = PlanBuilder(self.ctx.plan_ctx()).rewrite(
-                expr_ast, _row_schema(tbl, builder_schema_row))
+                expr_ast, _row_schema(tbl, old))
             new[ci.offset] = cast_value(e.eval(old), ci)
         tbl.update_record(txn, handle, old, new)
 
-    def _replace(self, txn, tbl, full):
-        handle = self._existing_handle(full)
-        old = tbl.row_with_cols(txn, handle)
-        tbl.remove_record(txn, handle, old)
-        tbl.add_record(txn, full)
+    def _replace(self, txn, tbl, full, err=None):
+        # MySQL REPLACE deletes EVERY row the new one conflicts with (the
+        # PK and each unique key can each name a different victim), then
+        # inserts — the reference's removeRow/addRecord cycle
+        while True:
+            handle = self._existing_handle(full, err)
+            old = tbl.row_with_cols(txn, handle)
+            tbl.remove_record(txn, handle, old)
+            try:
+                tbl.add_record(txn, full, eager_check=True)
+                return
+            except errors.DupEntryError as e2:
+                err = e2
+
+
+def _subst_values_func(node, tbl, full):
+    """Rewrite VALUES(col) inside ON DUPLICATE KEY UPDATE expressions to
+    the value the INSERT would have written (executor_write.go VALUES()
+    via the insert values map)."""
+    import dataclasses
+    if isinstance(node, ast.FuncCall) and node.name.lower() == "values" \
+            and len(node.args) == 1 and isinstance(node.args[0],
+                                                   ast.ColumnName):
+        ci = tbl.info.find_column(node.args[0].name)
+        if ci is None:
+            raise errors.UnknownFieldError(
+                f"Unknown column '{node.args[0].name}'")
+        return ast.Literal(value=full[ci.offset])
+    if isinstance(node, ast.Node):
+        changes = {}
+        for f in node.__dataclass_fields__:
+            v = getattr(node, f)
+            nv = _subst_values_func(v, tbl, full)
+            if nv is not v:
+                changes[f] = nv
+        if changes:
+            return dataclasses.replace(node, **changes)
+        return node
+    if isinstance(node, list):
+        out = [_subst_values_func(x, tbl, full) for x in node]
+        return out if any(a is not b for a, b in zip(out, node)) else node
+    return node
 
 
 def _row_schema(tbl, row):

@@ -46,7 +46,7 @@ class UnionStore(Retriever, Mutator):
         if self._presume_not_exists:
             # assume absent; record the assumption for commit-time verification
             self._lazy_conditions[key] = errors.KeyExistsError(
-                f"key already exists: {key!r}")
+                _dup_entry_message(key))
             raise errors.KeyNotExistsError(f"key presumed not exist: {key!r}")
         return self.snapshot.get(key)
 
@@ -111,3 +111,16 @@ def _merge(dirty_it, snap_it, reverse: bool = False) -> Iterator[tuple[bytes, by
         else:
             yield s
             s = nxt(snap_it)
+
+
+def _dup_entry_message(key: bytes) -> str:
+    """Human MySQL-1062 message for a duplicate key: decode the row key to
+    its handle (or an index key to its datums) instead of leaking raw
+    bytes over the wire (executor_write.go dup-entry formatting)."""
+    try:
+        from tidb_tpu import tablecodec as tc
+        _tid, handle = tc.decode_row_key(key)   # raises if not a row key
+        return f"Duplicate entry '{handle}' for key 'PRIMARY'"
+    except Exception:
+        pass
+    return f"Duplicate entry for key {key!r}"

@@ -169,6 +169,185 @@ class Client:
         return QueryResult(columns, rows, more=bool(
             status & p.SERVER_MORE_RESULTS_EXISTS))
 
+    # ---- binary prepared-statement protocol (client half) ----
+
+    def prepare(self, sql: str) -> tuple[int, int]:
+        """COM_STMT_PREPARE → (statement id, param count)."""
+        self.pkt.reset_sequence()
+        self.pkt.write_packet(bytes((p.COM_STMT_PREPARE,)) + sql.encode())
+        head = self.pkt.read_packet()
+        if head[0] == 0xFF:
+            raise self._as_error(head)
+        stmt_id = struct.unpack_from("<I", head, 1)[0]
+        n_cols, n_params = struct.unpack_from("<HH", head, 5)
+        for _ in range(n_params):
+            self.pkt.read_packet()           # param definitions
+        if n_params:
+            self.pkt.read_packet()           # EOF
+        for _ in range(n_cols):
+            self.pkt.read_packet()           # column definitions
+        if n_cols:
+            self.pkt.read_packet()           # EOF
+        return stmt_id, n_params
+
+    def execute(self, stmt_id: int, params: tuple = ()) -> QueryResult:
+        """COM_STMT_EXECUTE with Python params; binary resultset back."""
+        from decimal import Decimal as _Dec
+        import datetime as _dt
+        body = struct.pack("<IBI", stmt_id, 0, 1)
+        n = len(params)
+        if n:
+            bitmap = bytearray((n + 7) // 8)
+            types = b""
+            vals = b""
+            for i, v in enumerate(params):
+                if v is None:
+                    bitmap[i // 8] |= 1 << (i % 8)
+                    types += struct.pack("<H", 0x06)       # NULL
+                elif isinstance(v, bool):
+                    types += struct.pack("<H", 0x01)
+                    vals += struct.pack("<b", int(v))
+                elif isinstance(v, int):
+                    types += struct.pack("<H", 0x08)       # LONGLONG
+                    vals += struct.pack("<q", v)
+                elif isinstance(v, float):
+                    types += struct.pack("<H", 0x05)       # DOUBLE
+                    vals += struct.pack("<d", v)
+                elif isinstance(v, _Dec):
+                    types += struct.pack("<H", 0xF6)       # NEWDECIMAL
+                    vals += p.lenenc_bytes(str(v).encode())
+                elif isinstance(v, _dt.datetime):
+                    types += struct.pack("<H", 0x0C)       # DATETIME
+                    if v.microsecond:
+                        vals += bytes((11,)) + struct.pack(
+                            "<HBBBBBI", v.year, v.month, v.day, v.hour,
+                            v.minute, v.second, v.microsecond)
+                    else:
+                        vals += bytes((7,)) + struct.pack(
+                            "<HBBBBB", v.year, v.month, v.day, v.hour,
+                            v.minute, v.second)
+                elif isinstance(v, bytes):
+                    types += struct.pack("<H", 0xFC)       # BLOB
+                    vals += p.lenenc_bytes(v)
+                else:
+                    types += struct.pack("<H", 0xFD)       # VAR_STRING
+                    vals += p.lenenc_bytes(str(v).encode())
+            body += bytes(bitmap) + b"\x01" + types + vals
+        self.pkt.reset_sequence()
+        self.pkt.write_packet(bytes((p.COM_STMT_EXECUTE,)) + body)
+        return self._read_binary_result()
+
+    def close_stmt(self, stmt_id: int) -> None:
+        self.pkt.reset_sequence()
+        self.pkt.write_packet(bytes((p.COM_STMT_CLOSE,))
+                              + struct.pack("<I", stmt_id))
+        # no response, by protocol
+
+    def _read_binary_result(self) -> QueryResult:
+        first = self.pkt.read_packet()
+        if first[0] == 0xFF:
+            raise self._as_error(first)
+        if first[0] == 0x00:
+            affected, pos = p.read_lenenc_int(first, 1)
+            insert_id, pos = p.read_lenenc_int(first, pos)
+            status = struct.unpack_from("<H", first, pos)[0]
+            return QueryResult([], None, affected, insert_id,
+                               bool(status & p.SERVER_MORE_RESULTS_EXISTS))
+        ncols, _ = p.read_lenenc_int(first, 0)
+        columns, types = [], []
+        for _ in range(ncols):
+            cdef = self.pkt.read_packet()
+            pos = 0
+            for _f in range(4):
+                _v, pos = p.read_lenenc_bytes(cdef, pos)
+            name, pos = p.read_lenenc_bytes(cdef, pos)
+            _org, pos = p.read_lenenc_bytes(cdef, pos)
+            pos += 1 + 2 + 4
+            types.append((cdef[pos], struct.unpack_from("<H", cdef,
+                                                        pos + 1)[0]))
+            columns.append(name.decode())
+        self.pkt.read_packet()    # EOF after columns
+        rows = []
+        while True:
+            data = self.pkt.read_packet()
+            if data[0] == 0xFF:
+                raise self._as_error(data)
+            if data[0] == 0xFE and len(data) < 9:
+                break
+            rows.append(self._decode_binary_row(data, types))
+        return QueryResult(columns, rows)
+
+    def _decode_binary_row(self, data: bytes, types) -> list:
+        n = len(types)
+        bm_len = (n + 7 + 2) // 8
+        bitmap = data[1:1 + bm_len]
+        pos = 1 + bm_len
+        row = []
+        for i, (tp, flag) in enumerate(types):
+            bit = i + 2
+            if bitmap[bit // 8] & (1 << (bit % 8)):
+                row.append(None)
+                continue
+            unsigned = bool(flag & 0x20)   # UNSIGNED column flag
+            if tp == 0x01:
+                row.append(struct.unpack_from("<B" if unsigned else "<b",
+                                              data, pos)[0])
+                pos += 1
+            elif tp in (0x02, 0x0D):
+                row.append(struct.unpack_from("<H" if unsigned else "<h",
+                                              data, pos)[0])
+                pos += 2
+            elif tp in (0x03, 0x09):
+                row.append(struct.unpack_from("<I" if unsigned else "<i",
+                                              data, pos)[0])
+                pos += 4
+            elif tp == 0x08:
+                row.append(struct.unpack_from("<Q" if unsigned else "<q",
+                                              data, pos)[0])
+                pos += 8
+            elif tp == 0x04:
+                row.append(struct.unpack_from("<f", data, pos)[0])
+                pos += 4
+            elif tp == 0x05:
+                row.append(struct.unpack_from("<d", data, pos)[0])
+                pos += 8
+            elif tp in (0x07, 0x0A, 0x0C, 0x0E):
+                ln = data[pos]
+                pos += 1
+                import datetime as _dt
+                if ln == 0:
+                    row.append(_dt.datetime(1, 1, 1))
+                elif ln == 4:
+                    y, mo, d = struct.unpack_from("<HBB", data, pos)
+                    row.append(_dt.datetime(y, mo, d))
+                elif ln == 7:
+                    y, mo, d, h, mi, s = struct.unpack_from("<HBBBBB",
+                                                            data, pos)
+                    row.append(_dt.datetime(y, mo, d, h, mi, s))
+                else:
+                    y, mo, d, h, mi, s, us = struct.unpack_from(
+                        "<HBBBBBI", data, pos)
+                    row.append(_dt.datetime(y, mo, d, h, mi, s, us))
+                pos += ln
+            elif tp == 0x0B:
+                ln = data[pos]
+                pos += 1
+                if ln == 0:
+                    row.append(0)
+                elif ln >= 8:
+                    neg, days, h, mi, s = struct.unpack_from("<BIBBB",
+                                                             data, pos)
+                    us = struct.unpack_from("<I", data, pos + 8)[0] \
+                        if ln == 12 else 0
+                    nanos = (((days * 24 + h) * 3600 + mi * 60 + s)
+                             * 1_000_000_000 + us * 1000)
+                    row.append(-nanos if neg else nanos)
+                pos += ln
+            else:
+                v, pos = p.read_lenenc_bytes(data, pos)
+                row.append(None if v is None else v.decode())
+        return row
+
     def ping(self) -> None:
         self.pkt.reset_sequence()
         self.pkt.write_packet(bytes((p.COM_PING,)))

@@ -27,6 +27,9 @@ class ClientConnection:
         self.user = ""
         self.capability = 0
         self.alive = True
+        # per-statement bound param types (COM_STMT_EXECUTE may set
+        # new-params-bound=0 and reuse the previous execute's types)
+        self._stmt_types: dict[int, list[int]] = {}
 
     # ------------------------------------------------------------------
     # handshake (conn.go:90 writeInitialHandshake, :180 readHandshakeResponse)
@@ -126,6 +129,19 @@ class ClientConnection:
                 self.handle_query(data.decode())
             elif cmd == p.COM_FIELD_LIST:
                 self.handle_field_list(data)
+            elif cmd == p.COM_STMT_PREPARE:
+                self.handle_stmt_prepare(data)
+            elif cmd == p.COM_STMT_EXECUTE:
+                self.handle_stmt_execute(data)
+            elif cmd == p.COM_STMT_CLOSE:
+                # no response packet, by protocol (conn_stmt.go:226)
+                sid = struct.unpack_from("<I", data, 0)[0]
+                self.session.close_binary(sid)
+                self._stmt_types.pop(sid, None)
+            elif cmd == p.COM_STMT_RESET:
+                sid = struct.unpack_from("<I", data, 0)[0]
+                self._stmt_types.pop(sid, None)
+                self.pkt.write_packet(p.ok_packet(status=self._status()))
             else:
                 self.pkt.write_packet(p.err_packet(
                     my.ErrUnknown, f"command {cmd} not supported"))
@@ -230,8 +246,8 @@ class ClientConnection:
         table = data.split(b"\x00", 1)[0].decode()
         db = self.session.vars.current_db
         user = self.session.vars.user
-        if user and db.lower() not in ("information_schema",
-                                       "performance_schema"):
+        from tidb_tpu.privilege import VIRTUAL_SCHEMAS
+        if user and db.lower() not in VIRTUAL_SCHEMAS:
             # MySQL requires SOME privilege on the table before exposing
             # its column definitions (same gate as SHOW COLUMNS)
             from tidb_tpu import privilege as pv
@@ -247,6 +263,57 @@ class ClientConnection:
                 col.name, ft.tp, flag=ft.flag, flen=ft.flen,
                 decimal=ft.decimal, db=db, table=table))
         self.pkt.write_packet(p.eof_packet(status=self._status()))
+
+    # ------------------------------------------------------------------
+    # binary prepared-statement protocol (server/conn_stmt.go:47,104)
+    # ------------------------------------------------------------------
+
+    def handle_stmt_prepare(self, data: bytes) -> None:
+        sql = data.decode()
+        stmt_id, n_params = self.session.prepare_binary(sql)
+        # column count 0 at prepare time: result metadata always rides the
+        # execute response's resultset, which every driver reads anyway
+        self.pkt.write_packet(p.stmt_prepare_ok(stmt_id, 0, n_params))
+        if n_params:
+            for _ in range(n_params):
+                self.pkt.write_packet(p.column_def(
+                    "?", 0xFD, flag=0, flen=0))   # VAR_STRING params
+            self.pkt.write_packet(p.eof_packet(status=self._status()))
+
+    def handle_stmt_execute(self, data: bytes) -> None:
+        stmt_id, _flags, _iter = struct.unpack_from("<IBI", data, 0)
+        pos = 9
+        ent = self.session.binary_stmts.get(stmt_id)
+        if ent is None:
+            raise errors.ExecError(
+                f"Unknown prepared statement handler ({stmt_id}) "
+                "given to EXECUTE", code=1243)
+        values: list = []
+        if ent.param_count:
+            values, types = p.decode_binary_params(
+                data, pos, ent.param_count, self._stmt_types.get(stmt_id))
+            self._stmt_types[stmt_id] = types
+        rs = self.session.execute_binary(stmt_id, values)
+        if rs is None:
+            self.pkt.write_packet(p.ok_packet(
+                affected=self.session.vars.affected_rows,
+                insert_id=self.session.vars.last_insert_id,
+                status=self._status()))
+        else:
+            self.write_binary_resultset(rs)
+
+    def write_binary_resultset(self, rs) -> None:
+        status = self._status()
+        self.pkt.write_packet(p.lenenc_int(len(rs.fields)))
+        for name, ft in rs.fields:
+            self.pkt.write_packet(p.column_def(
+                name, ft.tp, flag=ft.flag, flen=ft.flen,
+                decimal=ft.decimal))
+        self.pkt.write_packet(p.eof_packet(status=status))
+        fts = [ft for _name, ft in rs.fields]
+        for row in rs.rows:
+            self.pkt.write_packet(p.binary_row(row, fts))
+        self.pkt.write_packet(p.eof_packet(status=status))
 
     def close(self) -> None:
         self.alive = False

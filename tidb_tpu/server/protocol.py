@@ -230,3 +230,214 @@ def datum_to_text(d) -> bytes | None:
     from tidb_tpu.expression.ops import _datum_to_str
     s = _datum_to_str(d)
     return s.encode() if isinstance(s, str) else bytes(s)
+
+
+# ---------------------------------------------------------------------------
+# binary (prepared-statement) protocol — server/conn_stmt.go
+# ---------------------------------------------------------------------------
+
+COM_STMT_SEND_LONG_DATA = 0x18
+COM_STMT_RESET = 0x1A
+
+UNSIGNED_TYPE_FLAG = 0x8000      # high byte of a param type
+
+
+def stmt_prepare_ok(stmt_id: int, n_cols: int, n_params: int,
+                    warnings: int = 0) -> bytes:
+    """COM_STMT_PREPARE_OK header (conn_stmt.go:67 writePrepare)."""
+    return (b"\x00" + struct.pack("<I", stmt_id)
+            + struct.pack("<HH", n_cols, n_params)
+            + b"\x00" + struct.pack("<H", warnings))
+
+
+def _pack_binary_time(dt, usec: int) -> bytes:
+    """DATE/DATETIME/TIMESTAMP binary value (length-prefixed)."""
+    if usec:
+        return bytes((11,)) + struct.pack(
+            "<HBBBBBI", dt.year, dt.month, dt.day, dt.hour, dt.minute,
+            dt.second, usec)
+    if dt.hour or dt.minute or dt.second:
+        return bytes((7,)) + struct.pack(
+            "<HBBBBB", dt.year, dt.month, dt.day, dt.hour, dt.minute,
+            dt.second)
+    if dt.year or dt.month or dt.day:
+        return bytes((4,)) + struct.pack("<HBB", dt.year, dt.month, dt.day)
+    return bytes((0,))
+
+
+def _pack_binary_duration(nanos: int) -> bytes:
+    neg = 1 if nanos < 0 else 0
+    nanos = abs(nanos)
+    usec, nanos = (nanos // 1000) % 1_000_000, nanos // 1_000_000_000
+    hours, rem = divmod(nanos, 3600)
+    mins, secs = divmod(rem, 60)
+    days, hours = divmod(hours, 24)
+    if usec:
+        return bytes((12,)) + struct.pack("<BIBBBI", neg, days, hours,
+                                          mins, secs, usec)
+    if days or hours or mins or secs:
+        return bytes((8,)) + struct.pack("<BIBBB", neg, days, hours, mins,
+                                         secs)
+    return bytes((0,))
+
+
+def binary_value(d, tp: int, flag: int = 0) -> bytes:
+    """One non-NULL result Datum in binary-row encoding, matching the
+    column type the server advertised (conn_stmt.go dumpBinaryValue)."""
+    if tp == my.TypeTiny:
+        return struct.pack("<b" if not my.has_unsigned_flag(flag) else "<B",
+                           int(d.val) & 0xFF if my.has_unsigned_flag(flag)
+                           else int(d.val))
+    if tp in (my.TypeShort, my.TypeYear):
+        return struct.pack("<H" if my.has_unsigned_flag(flag) else "<h",
+                           int(d.val))
+    if tp in (my.TypeInt24, my.TypeLong):
+        return struct.pack("<I" if my.has_unsigned_flag(flag) else "<i",
+                           int(d.val))
+    if tp == my.TypeLonglong:
+        v = int(d.val)
+        return struct.pack("<Q", v & (2 ** 64 - 1)) \
+            if my.has_unsigned_flag(flag) or v >= (1 << 63) \
+            else struct.pack("<q", v)
+    if tp == my.TypeFloat:
+        return struct.pack("<f", float(d.val))
+    if tp == my.TypeDouble:
+        return struct.pack("<d", float(d.val))
+    if tp in (my.TypeDate, my.TypeDatetime, my.TypeTimestamp,
+              my.TypeNewDate):
+        t = d.val               # types.time_types.Time
+        usec = getattr(t.dt, "microsecond", 0)
+        return _pack_binary_time(t.dt, usec)
+    if tp == my.TypeDuration:
+        return _pack_binary_duration(d.val.nanos)
+    # decimal / strings / blobs / enum / set / bit / json → lenenc string
+    v = datum_to_text(d)
+    return lenenc_bytes(v if v is not None else b"")
+
+
+def binary_row(datums: list, fields: list) -> bytes:
+    """Binary protocol resultset row: 0x00 header + NULL bitmap (offset 2)
+    + values (conn_stmt.go writeBinaryRow)."""
+    n = len(datums)
+    bitmap = bytearray((n + 7 + 2) // 8)
+    out = bytearray(b"\x00")
+    vals = b""
+    for i, (d, ft) in enumerate(zip(datums, fields)):
+        if d.is_null():
+            pos = i + 2
+            bitmap[pos // 8] |= 1 << (pos % 8)
+        else:
+            vals += binary_value(d, ft.tp, ft.flag)
+    out += bitmap + vals
+    return bytes(out)
+
+
+def decode_binary_params(data: bytes, pos: int, n_params: int,
+                         stored_types: list | None):
+    """COM_STMT_EXECUTE parameter block → (list[Datum], types).
+    `stored_types` carries the types of the previous execute when
+    new-params-bound-flag is 0 (conn_stmt.go parseStmtArgs)."""
+    from decimal import Decimal as _Dec
+
+    from tidb_tpu.types import Datum, datum_from_py
+    from tidb_tpu.types.datum import NULL
+    from tidb_tpu.types.time_types import Duration, Time
+
+    null_bitmap = data[pos:pos + (n_params + 7) // 8]
+    pos += (n_params + 7) // 8
+    new_bound = data[pos]
+    pos += 1
+    if new_bound:
+        types = [struct.unpack_from("<H", data, pos + 2 * i)[0]
+                 for i in range(n_params)]
+        pos += 2 * n_params
+    else:
+        if stored_types is None or len(stored_types) != n_params:
+            raise ValueError("no parameter types bound")
+        types = stored_types
+    out = []
+    for i in range(n_params):
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            out.append(NULL)
+            continue
+        tp = types[i] & 0xFF
+        unsigned = bool(types[i] & UNSIGNED_TYPE_FLAG)
+        if tp == my.TypeNull:
+            out.append(NULL)
+        elif tp == my.TypeTiny:
+            v = struct.unpack_from("<B" if unsigned else "<b", data, pos)[0]
+            pos += 1
+            out.append(Datum.u64(v) if unsigned else Datum.i64(v))
+        elif tp in (my.TypeShort, my.TypeYear):
+            v = struct.unpack_from("<H" if unsigned else "<h", data, pos)[0]
+            pos += 2
+            out.append(Datum.u64(v) if unsigned else Datum.i64(v))
+        elif tp in (my.TypeInt24, my.TypeLong):
+            v = struct.unpack_from("<I" if unsigned else "<i", data, pos)[0]
+            pos += 4
+            out.append(Datum.u64(v) if unsigned else Datum.i64(v))
+        elif tp == my.TypeLonglong:
+            v = struct.unpack_from("<Q" if unsigned else "<q", data, pos)[0]
+            pos += 8
+            out.append(Datum.u64(v) if unsigned else Datum.i64(v))
+        elif tp == my.TypeFloat:
+            v = struct.unpack_from("<f", data, pos)[0]
+            pos += 4
+            out.append(Datum.f64(float(v)))
+        elif tp == my.TypeDouble:
+            v = struct.unpack_from("<d", data, pos)[0]
+            pos += 8
+            out.append(Datum.f64(v))
+        elif tp in (my.TypeDecimal, my.TypeNewDecimal):
+            b, pos = read_lenenc_bytes(data, pos)
+            out.append(Datum.dec(_Dec(b.decode())))
+        elif tp in (my.TypeDate, my.TypeDatetime, my.TypeTimestamp):
+            ln = data[pos]
+            pos += 1
+            import datetime as _dt
+            if ln == 0:
+                dt = _dt.datetime(1, 1, 1)
+            elif ln == 4:
+                y, mo, dy = struct.unpack_from("<HBB", data, pos)
+                dt = _dt.datetime(y, mo, dy)
+            elif ln == 7:
+                y, mo, dy, h, mi, s = struct.unpack_from("<HBBBBB", data,
+                                                         pos)
+                dt = _dt.datetime(y, mo, dy, h, mi, s)
+            else:
+                y, mo, dy, h, mi, s, us = struct.unpack_from("<HBBBBBI",
+                                                             data, pos)
+                dt = _dt.datetime(y, mo, dy, h, mi, s, us)
+            pos += ln
+            out.append(datum_from_py(Time(
+                dt, my.TypeDate if tp == my.TypeDate else my.TypeDatetime)))
+        elif tp == my.TypeDuration:
+            ln = data[pos]
+            pos += 1
+            if ln == 0:
+                nanos = 0
+            elif ln == 8:
+                neg, days, h, mi, s = struct.unpack_from("<BIBBB", data,
+                                                         pos)
+                nanos = (((days * 24 + h) * 3600 + mi * 60 + s)
+                         * 1_000_000_000)
+                nanos = -nanos if neg else nanos
+            else:
+                neg, days, h, mi, s, us = struct.unpack_from("<BIBBBI",
+                                                             data, pos)
+                nanos = (((days * 24 + h) * 3600 + mi * 60 + s)
+                         * 1_000_000_000 + us * 1000)
+                nanos = -nanos if neg else nanos
+            pos += ln
+            out.append(datum_from_py(Duration(nanos)))
+        else:
+            # varchar / var_string / string / blobs / json / enum / set
+            b, pos = read_lenenc_bytes(data, pos)
+            if b is None:
+                out.append(NULL)
+            else:
+                try:
+                    out.append(Datum.string(b.decode()))
+                except UnicodeDecodeError:
+                    out.append(Datum.bytes_(b))
+    return out, types

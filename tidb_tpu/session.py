@@ -98,6 +98,10 @@ class Session:
         self.history: list[str] = []   # stmt texts for optimistic retry
         self.params: list[Datum] = []
         self.prepared: dict[str, _PreparedStmt] = {}
+        # binary-protocol statements: id → entry (server/conn_stmt.go keeps
+        # these per connection; one session per connection here)
+        self.binary_stmts: dict[int, _PreparedStmt] = {}
+        self._next_stmt_id = 0
         self.dirty_tables: set[int] = set()
         bootstrap(self)
 
@@ -362,6 +366,42 @@ class Session:
             inner, len(p.param_markers), text)
         return None
 
+    def prepare_binary(self, text: str) -> tuple[int, int]:
+        """COM_STMT_PREPARE: → (statement id, param count)
+        (server/conn_stmt.go:47 handleStmtPrepare)."""
+        p = Parser()
+        stmts = p.parse(text)
+        if len(stmts) != 1:
+            raise errors.ExecError("Can not prepare multiple statements")
+        inner = stmts[0]
+        if isinstance(inner, (ast.PrepareStmt, ast.ExecuteStmt,
+                              ast.DeallocateStmt)):
+            raise errors.ExecError(
+                "This command is not supported in the prepared statement "
+                "protocol yet")
+        self._next_stmt_id += 1
+        sid = self._next_stmt_id
+        self.binary_stmts[sid] = _PreparedStmt(inner, len(p.param_markers),
+                                               text)
+        return sid, len(p.param_markers)
+
+    def execute_binary(self, stmt_id: int, values: list):
+        """COM_STMT_EXECUTE with decoded params → ResultSet | None."""
+        ent = self.binary_stmts.get(stmt_id)
+        if ent is None:
+            raise errors.ExecError(
+                f"Unknown prepared statement handler ({stmt_id}) "
+                "given to EXECUTE", code=1243)
+        if self.killed:
+            self.killed = False
+            raise errors.ExecError("Query execution was interrupted",
+                                   code=1317)
+        # autocommit is handled inside _run_plan (run_prepared ends there)
+        return self.run_prepared(ent, values, ent.text)
+
+    def close_binary(self, stmt_id: int) -> None:
+        self.binary_stmts.pop(stmt_id, None)
+
     def _do_deallocate(self, plan: Deallocate) -> None:
         if self.prepared.pop(plan.name.lower(), None) is None:
             raise errors.ExecError(
@@ -386,6 +426,13 @@ class Session:
                 values.append(NULL)
             else:
                 values.append(Datum.string(str(v)))
+        return self.run_prepared(ent, values, sql_text, record_history)
+
+    def run_prepared(self, ent: "_PreparedStmt", values: list,
+                     sql_text: str, record_history: bool = False):
+        """Execute a prepared entry with bound param Datums — shared by
+        text EXECUTE and the binary COM_STMT_EXECUTE path
+        (server/conn_stmt.go:104 handleStmtExecute)."""
         if len(values) != ent.param_count:
             raise errors.ExecError("Incorrect arguments to EXECUTE")
         self.params = values

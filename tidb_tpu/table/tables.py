@@ -43,12 +43,25 @@ class Index:
                 if backfill and int(existing) == handle:
                     return  # reorg re-scan or row indexed by a concurrent writer
                 raise errors.KeyExistsError(
-                    f"Duplicate entry for key '{self.info.name}'")
+                    f"Duplicate entry for key '{self.info.name}'",
+                    existing_handle=int(existing))
             txn.set(key, b"%d" % handle)
         else:
             # NULLs never collide in unique indexes (SQL semantics)
             key = tc.encode_index_key(self.table.id, self.info.id, values, handle)
             txn.set(key, b"0")
+
+    def check_conflict(self, txn, values: list[Datum]) -> None:
+        """Raise KeyExistsError (with the existing row's handle) if these
+        values collide in a unique index — a pure read, no writes."""
+        if not self.info.unique or self._has_null(values):
+            return
+        key = tc.encode_index_key(self.table.id, self.info.id, values, None)
+        existing = txn.get_or_none(key)
+        if existing is not None:
+            raise errors.KeyExistsError(
+                f"Duplicate entry for key '{self.info.name}'",
+                existing_handle=int(existing))
 
     def delete(self, txn, values: list[Datum], handle: int) -> None:
         if self.info.unique and not self._has_null(values):
@@ -118,7 +131,8 @@ class Table:
 
     # ---- writes ----
     def add_record(self, txn, row: list[Datum], handle: int | None = None,
-                   skip_unique_check: bool = False) -> int:
+                   skip_unique_check: bool = False,
+                   eager_check: bool = False) -> int:
         """Insert a full row (already cast to column types, in column offset
         order including non-public columns as NULL). Returns the handle."""
         pk_col, col_ids, offsets, key_prefix = self._write_layout()
@@ -132,18 +146,38 @@ class Table:
         if pk_col is not None:
             self.rebase_auto_id(handle)
 
-        # row key with duplicate detection (PresumeKeyNotExists lazy check:
-        # executor_write.go + union_store.go markLazyConditionPair)
+        # row key with duplicate detection. Default: PresumeKeyNotExists
+        # lazy check (executor_write.go + union_store.go
+        # markLazyConditionPair) — resolved at commit. eager_check forces a
+        # real read NOW: INSERT IGNORE / ON DUPLICATE KEY UPDATE / REPLACE
+        # must observe the conflict inside the statement to react to it
+        # (executor_write.go:554 batchGetInsertKeys)
         key = key_prefix + tc.enc_handle(handle)
         if not skip_unique_check:
-            txn.set_option(OPT_PRESUME_KEY_NOT_EXISTS)
+            if not eager_check:
+                txn.set_option(OPT_PRESUME_KEY_NOT_EXISTS)
             try:
                 txn.get(key)
-                raise errors.KeyExistsError(f"Duplicate entry '{handle}' for key 'PRIMARY'")
+                raise errors.KeyExistsError(
+                    f"Duplicate entry '{handle}' for key 'PRIMARY'",
+                    existing_handle=handle)
             except errors.KeyNotExistsError:
                 pass
             finally:
-                txn.del_option(OPT_PRESUME_KEY_NOT_EXISTS)
+                if not eager_check:
+                    txn.del_option(OPT_PRESUME_KEY_NOT_EXISTS)
+        if eager_check and not skip_unique_check:
+            # callers that CATCH the duplicate error (IGNORE / ON
+            # DUPLICATE / REPLACE) need the conflict detected before ANY
+            # write lands in the txn buffer — otherwise the index entries
+            # written before the raising one would commit dangling
+            # (executor_write.go batchGetInsertKeys does the same
+            # check-all-first pass)
+            for idx in self.indices:
+                if idx.info.state in (SchemaState.NONE,
+                                      SchemaState.DELETE_ONLY):
+                    continue
+                idx.check_conflict(txn, idx._values_for_row(row))
 
         # index entries (only indexes in a writable state: online DDL)
         for idx in self.indices:
@@ -166,6 +200,17 @@ class Table:
     def update_record(self, txn, handle: int, old_row: list[Datum],
                       new_row: list[Datum], touched: list[bool] | None = None) -> None:
         info = self.info
+        pk = info.pk_handle_column()
+        if pk is not None:
+            new_handle = new_row[pk.offset].get_int()
+            if new_handle != handle:
+                # the handle IS the row key: a PK change moves the row
+                # (delete + insert, eagerly checked — the target handle
+                # may be taken), like the reference's updateRecord
+                # delete-then-add path for handle-changing updates
+                self.remove_record(txn, handle, old_row)
+                self.add_record(txn, new_row, eager_check=True)
+                return
         for idx in self.indices:
             if idx.info.state in (SchemaState.NONE,):
                 continue
