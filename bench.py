@@ -63,7 +63,8 @@ Q1 = ("select l_returnflag, l_linestatus, "
       "sum(l_extendedprice * (1 - l_discount)), "
       "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
       "avg(l_quantity), avg(l_extendedprice), avg(l_discount), count(*) "
-      "from lineitem where l_shipdate <= '1998-09-02' "
+      "from lineitem "
+      "where l_shipdate <= date '1998-12-01' - interval 90 day "
       "group by l_returnflag, l_linestatus "
       "order by l_returnflag, l_linestatus")
 

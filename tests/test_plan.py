@@ -232,3 +232,52 @@ class TestMisc:
         p = plan_for(ctx, client, "select b, count(*) from t group by b")
         s = tree_string(p)
         assert "tscan" in s and "phashagg" in s
+
+
+class TestStreamAgg:
+    """PhysicalStreamAgg fires when the child index scan's leading
+    columns cover the group keys (executor/executor.go:1085)."""
+
+    from tests.testkit import TestKit as _TK
+
+    @pytest.fixture
+    def tk(self):
+        t = self._TK()
+        t.exec("create database test")
+        t.exec("use test")
+        t.exec("create table s (a int primary key, b int, c int, "
+               "key ib (b, c))")
+        t.exec("insert into s values " +
+               ", ".join(f"({i}, {i % 4}, {i % 3})" for i in range(1, 60)))
+        return t
+
+    def _plan(self, t, sql):
+        return "\n".join(str(r[0]) for r in t.query("explain " + sql).rows)
+
+    def test_emitted_on_ordered_index_prefix(self, tk):
+        # CAST keeps the filter SQL-side → aggregation can't push down;
+        # the hinted index orders rows by (b, c) → stream aggregation
+        p = self._plan(tk, "select b, count(1) from s use index (ib) "
+                           "where cast(c as char) != '9' group by b")
+        assert "pstreamagg" in p
+
+    def test_results_match_hash_agg(self, tk):
+        sql_stream = ("select b, count(1), sum(a) from s use index (ib) "
+                      "where cast(c as char) != '9' group by b order by b")
+        sql_hash = ("select b, count(1), sum(a) from s "
+                    "where cast(c as char) != '9' group by b order by b")
+        assert "pstreamagg" in self._plan(tk, sql_stream)
+        assert tk.query(sql_stream).rows == tk.query(sql_hash).rows
+
+    def test_two_column_group_prefix(self, tk):
+        sql = ("select b, c, count(1) from s use index (ib) "
+               "where cast(a as char) != 'x' group by b, c order by b, c")
+        assert "pstreamagg" in self._plan(tk, sql)
+        r = tk.query(sql).rows
+        assert sum(row[2] for row in r) == 59
+
+    def test_not_emitted_when_group_not_prefix(self, tk):
+        # group by c alone is NOT a prefix of (b, c)
+        p = self._plan(tk, "select c, count(1) from s use index (ib) "
+                           "where cast(a as char) != '9' group by c")
+        assert "pstreamagg" not in p

@@ -34,6 +34,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="coprocessor engine backend")
     ap.add_argument("--repl", action="store_true",
                     help="interactive SQL shell instead of serving")
+    ap.add_argument("--lease", type=float, default=1.0,
+                    help="schema lease seconds (tidb-server -lease); "
+                         "enables the schema-validity kill-switch at "
+                         "2x lease, 0 disables")
+    ap.add_argument("--status-port", type=int, default=10080,
+                    help="HTTP status/metrics port (server.go:213); "
+                         "-1 disables")
     return ap
 
 
@@ -111,11 +118,23 @@ def main(argv=None) -> int:
     if args.repl:
         return repl(store)
     from tidb_tpu.server import Server
+    if args.lease > 0:
+        from tidb_tpu.domain import get_domain
+        dom = get_domain(store)
+        dom.ddl.schema_lease_s = args.lease
+        # reload every lease/2 (started here so Server.start()'s default
+        # loop call no-ops) and kill in-flight statements when no reload
+        # succeeds for 2x lease (domain.go:474)
+        dom.start_reload_loop(interval_s=args.lease / 2)
+        dom.schema_validity_lease_s = 2 * args.lease
     srv = Server(store, host=args.host, port=args.port,
-                 token_limit=args.token_limit)
+                 token_limit=args.token_limit,
+                 status_port=None if args.status_port < 0
+                 else args.status_port)
     srv.start()
     print(f"tidb-tpu listening on {args.host}:{srv.port} "
-          f"(store={args.store}://{args.path}, copr={args.copr})",
+          f"(store={args.store}://{args.path}, copr={args.copr}, "
+          f"status={srv.status_port})",
           file=sys.stderr)
     try:
         while True:

@@ -9,7 +9,9 @@ validity kill-switch activate in multi-server deployments.
 from __future__ import annotations
 
 import threading
+import time
 
+from tidb_tpu import errors
 from tidb_tpu.ddl import DDL, Callback
 from tidb_tpu.infoschema import Handle, InfoSchema
 
@@ -40,6 +42,14 @@ class Domain:
             self.gc_worker.start()
 
         self._reload_stop: threading.Event | None = None
+        # schema-validity kill-switch (domain.go:45,:474
+        # schemaValidityInfo): when the reload loop stalls longer than the
+        # lease, in-flight transactions must FAIL rather than run on a
+        # schema other servers may have moved past. 0 = disabled
+        # (single-server embedding; the reference enables it whenever a
+        # lease is configured).
+        self.schema_validity_lease_s: float = 0.0
+        self._last_reload_ok = time.monotonic()
 
     def close(self) -> None:
         if self.gc_worker is not None:
@@ -77,6 +87,7 @@ class Domain:
             while not stop.wait(interval_s):
                 try:
                     self.maybe_reload()
+                    self._last_reload_ok = time.monotonic()
                 except Exception:
                     pass
 
@@ -85,6 +96,27 @@ class Domain:
 
     def info_schema(self) -> InfoSchema:
         return self.handle.get()
+
+    def check_schema_valid(self) -> None:
+        """Raise when the cached schema is older than the validity lease
+        (reload loop stalled / partitioned): continuing could commit
+        against a schema version other servers already replaced
+        (domain.go:474 Check → ErrInfoSchemaExpired)."""
+        lease = self.schema_validity_lease_s
+        if lease <= 0:
+            return
+        if self._reload_stop is None:
+            # no reload loop running: a synchronous-DDL embedding is
+            # always current by construction
+            return
+        age = time.monotonic() - self._last_reload_ok
+        if age > lease:
+            raise errors.ExecError(
+                f"Information schema is out of date (no successful reload "
+                f"for {age:.1f}s > lease {lease:.1f}s)", code=8027)
+
+    def mark_reload_ok(self) -> None:
+        self._last_reload_ok = time.monotonic()
 
     def reload(self) -> InfoSchema:
         return self.handle.load()

@@ -43,6 +43,9 @@ class ExecutorBuilder:
             return ex.SelectionExec(self.build(p.child), p.conditions)
         if isinstance(p, pl.PhysicalProjection):
             return ex.ProjectionExec(self.build(p.child), p.exprs, p.schema)
+        if isinstance(p, pl.PhysicalStreamAgg):
+            return ex.StreamAggExec(self.build(p.child), p.agg_funcs,
+                                    p.group_by, p.schema)
         if isinstance(p, pl.PhysicalHashAgg):
             return ex.HashAggExec(self.build(p.child), p.agg_funcs,
                                   p.group_by, p.schema, p.has_pushed_child)

@@ -272,6 +272,67 @@ class HashAggExec(Executor):
         return [f.get_result(ctx) for f, ctx in zip(self.agg_funcs, ctxs)]
 
 
+class StreamAggExec(Executor):
+    """Streaming aggregation over input already ordered by the group-by
+    columns (executor/executor.go:1085 StreamAggExec): one group's
+    contexts live at a time; a key change emits the finished group. The
+    planner only emits this node when the child delivers rows grouped
+    consecutively (index scans whose leading columns are the group keys).
+    """
+
+    def __init__(self, child: Executor, agg_funcs: list[AggregationFunction],
+                 group_by: list[Expression], schema: Schema):
+        self.children = [child]
+        self.agg_funcs = agg_funcs
+        self.group_by = group_by
+        self.schema = schema
+        self._cur_key: bytes | None = None
+        self._ctxs = None
+        self._emitted_any = False
+        self._input_done = False
+
+    def _key(self, row) -> bytes:
+        if not self.group_by:
+            return b""
+        return codec.encode_value([g.eval(row) for g in self.group_by])
+
+    def _result_row(self):
+        return [f.get_result(ctx)
+                for f, ctx in zip(self.agg_funcs, self._ctxs)]
+
+    def next(self):
+        if self._input_done:
+            return None
+        child = self.children[0]
+        while True:
+            row = child.next()
+            if row is None:
+                self._input_done = True
+                if self._ctxs is not None:
+                    self._emitted_any = True
+                    return self._result_row()
+                if not self._emitted_any and not self.group_by:
+                    # aggregate over empty input still yields one row
+                    self._ctxs = [f.create_context()
+                                  for f in self.agg_funcs]
+                    self._emitted_any = True
+                    return self._result_row()
+                return None
+            k = self._key(row)
+            out = None
+            if self._ctxs is not None and k != self._cur_key:
+                out = self._result_row()
+                self._ctxs = None
+            if self._ctxs is None:
+                self._cur_key = k
+                self._ctxs = [f.create_context() for f in self.agg_funcs]
+            for f, ctx in zip(self.agg_funcs, self._ctxs):
+                f.update(ctx, row)
+            if out is not None:
+                self._emitted_any = True
+                return out
+
+
 class HashJoinExec(Executor):
     """Build the right side into a hash table, probe with the left
     (executor/executor.go:442; worker concurrency is a later milestone —

@@ -785,3 +785,129 @@ def _not_like(args, row):
 @register("is_not_null", 1, 1)
 def _is_not_null(args, row):
     return xops.bool_datum(not args[0].eval(row).is_null())
+
+
+# ---- interval arithmetic (evaluator/builtin_time.go DATE_ADD/DATE_SUB) ----
+
+_UNIT_SECONDS = {"microsecond": 1e-6, "second": 1, "minute": 60,
+                 "hour": 3600, "day": 86400, "week": 7 * 86400}
+
+
+def _interval_count(d: Datum) -> int | float:
+    """Interval magnitude: MySQL coerces strings/decimals numerically
+    (a non-numeric string coerces to 0, with a warning in MySQL)."""
+    if d.kind in (Kind.STRING, Kind.BYTES):
+        s = d.get_string().strip()
+        try:
+            return int(s)
+        except ValueError:
+            try:
+                return float(s)
+            except ValueError:
+                return 0
+    if d.kind == Kind.FLOAT64:
+        return float(d.val)
+    if d.kind == Kind.DECIMAL:
+        f = float(d.val)
+        return int(f) if f == int(f) else f
+    return int(d.get_int())
+
+
+def _date_arith(args, row, sign: int) -> Datum:
+    import datetime as _dt
+
+    from tidb_tpu import mysqldef as _my
+    from tidb_tpu.types.time_types import Time
+
+    t = _as_time(args[0].eval(row))
+    nd = args[1].eval(row)
+    if t is None or nd.is_null():
+        return NULL
+    unit = args[2].eval(row).get_string().lower()
+    n = _interval_count(nd) * sign
+    dt = t.dt
+    try:
+        if unit in ("year", "quarter", "month"):
+            months = int(n) * {"year": 12, "quarter": 3, "month": 1}[unit]
+            total = (dt.year * 12 + dt.month - 1) + months
+            y, m = divmod(total, 12)
+            import calendar
+            day = min(dt.day, calendar.monthrange(y, m + 1)[1])
+            dt = dt.replace(year=y, month=m + 1, day=day)
+        elif unit in _UNIT_SECONDS:
+            dt = dt + _dt.timedelta(seconds=n * _UNIT_SECONDS[unit])
+        else:
+            raise errors.ExecError(f"unsupported interval unit {unit!r}")
+    except (ValueError, OverflowError):
+        # out-of-range datetime (year < 1 / > 9999): MySQL yields NULL
+        # with a warning rather than an error
+        return NULL
+    # DATE stays DATE for whole-day units; any time-precision unit
+    # promotes to DATETIME (builtin_time.go dateArithmetic)
+    tp = t.tp
+    if tp == _my.TypeDate and unit not in ("year", "quarter", "month",
+                                           "week", "day"):
+        tp = _my.TypeDatetime
+    return Datum(Kind.TIME, Time(dt, tp, t.fsp))
+
+
+@register("date_add", 3, 3)
+def _date_add(args, row):
+    return _date_arith(args, row, 1)
+
+
+@register("date_sub", 3, 3)
+def _date_sub(args, row):
+    return _date_arith(args, row, -1)
+
+
+@register("extract", 2, 2)
+def _extract(args, row):
+    """EXTRACT(unit FROM t): unit arrives as the first (string) arg."""
+    unit = args[0].eval(row).get_string().lower()
+    t = _as_time(args[1].eval(row))
+    if t is None:
+        return NULL
+    d = t.dt
+    if unit == "microsecond":
+        return Datum.i64(d.microsecond)
+    if unit == "quarter":
+        return Datum.i64((d.month - 1) // 3 + 1)
+    if unit == "week":
+        return Datum.i64(int(d.strftime("%U")))   # mode 0: Sunday-based
+    if unit in ("year", "month", "day", "hour", "minute", "second"):
+        return Datum.i64(getattr(d, unit))
+    raise errors.ExecError(f"unsupported EXTRACT unit {unit!r}")
+
+
+@register("quarter", 1, 1)
+def _quarter(args, row):
+    t = _as_time(args[0].eval(row))
+    return NULL if t is None else Datum.i64((t.dt.month - 1) // 3 + 1)
+
+
+@register("week", 1, 2)
+def _week(args, row):
+    """WEEK(d[, mode]): mode 0/2 Sunday-based (%U), odd modes
+    Monday-based with the >=4-day rule (ISO week) — the two families
+    MySQL's 8 modes collapse into for week-of-year numbering."""
+    t = _as_time(args[0].eval(row))
+    if t is None:
+        return NULL
+    mode = 0
+    if len(args) > 1:
+        md = args[1].eval(row)
+        if not md.is_null():
+            mode = int(md.get_int())
+    if mode % 2:
+        return Datum.i64(t.dt.isocalendar()[1])
+    return Datum.i64(int(t.dt.strftime("%U")))
+
+
+@register("datediff", 2, 2)
+def _datediff(args, row):
+    a = _as_time(args[0].eval(row))
+    b = _as_time(args[1].eval(row))
+    if a is None or b is None:
+        return NULL
+    return Datum.i64((a.dt.date() - b.dt.date()).days)

@@ -110,5 +110,15 @@ def counter(name: str) -> Counter:
     return registry.counter(name)
 
 
+def render_text() -> str:
+    """Prometheus text exposition of the default registry (the status
+    HTTP port's /metrics; tidb-server/main.go:181 push-gateway analogue).
+    Metric names sanitize '.' → '_' per the Prometheus data model."""
+    lines = []
+    for name, value in registry.snapshot():
+        lines.append(f"{name.replace('.', '_')} {value}")
+    return "\n".join(lines) + "\n"
+
+
 def histogram(name: str) -> Histogram:
     return registry.histogram(name)

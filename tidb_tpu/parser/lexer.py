@@ -39,7 +39,7 @@ TRUNCATE IF EXISTS CONSTRAINT DEFAULT AUTO_INCREMENT COMMENT ON
 BEGIN START TRANSACTION COMMIT ROLLBACK USE SHOW DATABASES SCHEMAS TABLES
 COLUMNS FIELDS VARIABLES WARNINGS FULL DESCRIBE DESC ASC EXPLAIN ADMIN CHECK
 JOIN INNER LEFT RIGHT OUTER CROSS USING UNION CASE WHEN THEN ELSE END CAST
-CONVERT DIV MOD INTERVAL GLOBAL SESSION FOR SHARE LOCK MODE
+CONVERT DIV MOD INTERVAL GLOBAL SESSION FOR SHARE LOCK MODE FORCE
 TINYINT SMALLINT MEDIUMINT INT INTEGER BIGINT FLOAT DOUBLE REAL DECIMAL
 NUMERIC CHAR VARCHAR BINARY VARBINARY TEXT TINYTEXT MEDIUMTEXT LONGTEXT
 BLOB TINYBLOB MEDIUMBLOB LONGBLOB DATE TIME DATETIME TIMESTAMP YEAR BIT

@@ -17,7 +17,7 @@ from tidb_tpu import sqlast as ast
 from tidb_tpu.parser import lexer as lx
 from tidb_tpu.sqlast import Op
 from tidb_tpu.types import Datum, datum_from_py
-from tidb_tpu.types.datum import NULL
+from tidb_tpu.types.datum import NULL, Kind as DKind
 from tidb_tpu.types.field_type import FieldType, new_field_type
 
 AGG_FUNCS = frozenset(("count", "sum", "avg", "min", "max", "group_concat",
@@ -352,6 +352,22 @@ class Parser:
             as_name = self._ident()
         elif self._cur().tp == lx.IDENT:
             as_name = self._ident()
+        # index hints: USE/FORCE/IGNORE INDEX|KEY (i1[, i2...])
+        # (parser.y IndexHint :505-507); repeated hints accumulate
+        while self._at_kw("USE", "FORCE", "IGNORE"):
+            kind = self._next().val
+            self._expect_kw("INDEX", "KEY")
+            self._expect_op("(")
+            names = []
+            while True:
+                names.append(self._ident("index name").lower())
+                if not self._try_op(","):
+                    break
+            self._expect_op(")")
+            if kind == "IGNORE":
+                tn.ignore_index.extend(names)
+            else:
+                tn.use_index.extend(names)
         return ast.TableSource(source=tn, as_name=as_name)
 
     def _parse_by_items(self) -> list[ast.ByItem]:
@@ -1227,7 +1243,22 @@ class Parser:
             if self._try_kw("DEFAULT"):
                 return ast.DefaultExpr()
             if self._try_kw("INTERVAL"):
-                self._fail("INTERVAL expressions not supported yet")
+                val = self._parse_expr(self._BP_UNARY)
+                unit = self._interval_unit()
+                return ast.IntervalExpr(value=val, unit=unit)
+            if t.val in ("DATE", "TIME", "TIMESTAMP") \
+                    and self.toks[self.pos + 1].tp == lx.STRING:
+                # typed literal: DATE '1998-12-01' (parser.y DateLiteral)
+                kw = self._next().val
+                s = self._next().val
+                from tidb_tpu import mysqldef as _my
+                from tidb_tpu.types.time_types import (
+                    parse_duration, parse_time)
+                if kw == "TIME":
+                    return ast.Literal(
+                        Datum(DKind.DURATION, parse_duration(s)))
+                tp = _my.TypeDate if kw == "DATE" else _my.TypeTimestamp
+                return ast.Literal(Datum(DKind.TIME, parse_time(s, tp)))
             # keyword usable as function name: LEFT(...), RIGHT(...)
             if self.toks[self.pos + 1].tp == lx.OP and self.toks[self.pos + 1].val == "(":
                 name = self._next().val.lower()  # type: ignore[union-attr]
@@ -1319,8 +1350,27 @@ class Parser:
             self._fail("CASE requires at least one WHEN clause")
         return case
 
+    _INTERVAL_UNITS = ("MICROSECOND", "SECOND", "MINUTE", "HOUR", "DAY",
+                       "WEEK", "MONTH", "QUARTER", "YEAR")
+
+    def _interval_unit(self) -> str:
+        t = self._cur()
+        name = (t.val or "").upper() if isinstance(t.val, str) else ""
+        if name not in self._INTERVAL_UNITS:
+            self._fail(f"expected interval unit, got {t.val!r}")
+        self._next()
+        return name.lower()
+
     def _parse_func_call(self, name: str) -> ast.ExprNode:
         self._expect_op("(")
+        if name == "extract":
+            # EXTRACT(unit FROM expr)  (parser.y FunctionCallNonKeyword)
+            unit = self._interval_unit()
+            self._expect_kw("FROM")
+            e = self._parse_expr()
+            self._expect_op(")")
+            return ast.FuncCall(name="extract",
+                                args=[ast.Literal(Datum.string(unit)), e])
         if name in AGG_FUNCS:
             distinct = self._try_kw("DISTINCT")
             args: list[ast.ExprNode] = []

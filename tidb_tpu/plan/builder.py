@@ -82,6 +82,8 @@ class PlanBuilder:
         db, tbl = self.resolve_table(tn)
         info = tbl.info
         ds = DataSource(db, tbl, info, alias)
+        ds.use_index = list(getattr(tn, "use_index", ()) or ())
+        ds.ignore_index = list(getattr(tn, "ignore_index", ()) or ())
         schema = Schema()
         for i, col in enumerate(info.public_columns()):
             schema.append(Column(
@@ -753,16 +755,54 @@ class PlanBuilder:
                         f"misplaced aggregate function {n.name}()")
                 return col.clone()
             if isinstance(n, ast.BinaryOp):
+                # date +/- INTERVAL lowers to date_add/date_sub
+                # (parser.y DateArithOpt → ast.FuncDateArith)
+                li = isinstance(n.left, ast.IntervalExpr)
+                ri = isinstance(n.right, ast.IntervalExpr)
+                if li or ri:
+                    if n.op not in (Op.Plus, Op.Minus) or (li and ri) \
+                            or (li and n.op == Op.Minus):
+                        raise errors.PlanError(
+                            "INTERVAL is only valid as date +/- INTERVAL")
+                    iv = n.left if li else n.right
+                    date = n.right if li else n.left
+                    fname = "date_add" if n.op == Op.Plus else "date_sub"
+                    args = [rw(date), rw(iv.value),
+                            Constant(Datum.string(iv.unit))]
+                    return _fold(ScalarFunction(
+                        fname, args, _func_ret_type(fname, args)))
                 return new_op(n.op, rw(n.left), rw(n.right))
             if isinstance(n, ast.UnaryOp):
                 return new_op(n.op, rw(n.operand))
+            if isinstance(n, ast.IntervalExpr):
+                raise errors.PlanError(
+                    "INTERVAL is only valid as date +/- INTERVAL")
             if isinstance(n, ast.FuncCall):
                 from tidb_tpu.expression import builtin
-                if not builtin.exists(n.name):
+                name = n.name.lower()
+                nargs = list(n.args)
+                if name in ("date_add", "date_sub", "adddate", "subdate"):
+                    fname = "date_add" if name in ("date_add", "adddate") \
+                        else "date_sub"
+                    if len(nargs) == 2 and isinstance(nargs[1],
+                                                      ast.IntervalExpr):
+                        iv = nargs[1]
+                        args = [rw(nargs[0]), rw(iv.value),
+                                Constant(Datum.string(iv.unit))]
+                    elif len(nargs) == 2:
+                        # ADDDATE(d, n) plain form: n days
+                        args = [rw(nargs[0]), rw(nargs[1]),
+                                Constant(Datum.string("day"))]
+                    else:
+                        raise errors.ExecError(
+                            f"wrong argument count to {name}()")
+                    return _fold(ScalarFunction(
+                        fname, args, _func_ret_type(fname, args)))
+                if not builtin.exists(name):
                     raise errors.ExecError(f"unknown function {n.name!r}")
-                args = [rw(a) for a in n.args]
-                return ScalarFunction(n.name.lower(), args,
-                                      _func_ret_type(n.name, args))
+                args = [rw(a) for a in nargs]
+                return _fold(ScalarFunction(name, args,
+                                            _func_ret_type(name, args)))
             if isinstance(n, ast.Between):
                 e = rw(n.expr)
                 lo, hi = rw(n.low), rw(n.high)
@@ -914,6 +954,33 @@ def _agg_name(node: "ast.AggregateFunc") -> str:
     return f"{node.name.lower()}({d}{inner})"
 
 
+# functions whose value depends on more than their arguments — never
+# folded at plan time (evaluator/builtin_info.go + time "now" family)
+_NONDETERMINISTIC = frozenset((
+    "now", "current_timestamp", "sysdate", "curdate", "current_date",
+    "curtime", "current_time", "unix_timestamp", "rand", "uuid", "sleep",
+    "connection_id", "found_rows", "row_count", "last_insert_id",
+    "database", "schema", "user", "current_user", "session_user",
+    "system_user", "version",
+))
+
+
+def _fold(e):
+    """Evaluate a ScalarFunction of all-constant args at plan time.
+    Folding is what lets `date '1998-12-01' - interval 90 day` reach the
+    coprocessor (and its range refiner / TPU lowering) as a plain constant
+    comparison, the reference's expression.FoldConstant."""
+    if not isinstance(e, ScalarFunction) \
+            or e.func_name in _NONDETERMINISTIC:
+        return e
+    if any(not isinstance(a, Constant) for a in e.args):
+        return e
+    try:
+        return Constant(e.eval([]), e.ret_type)
+    except errors.TiDBError:
+        return e   # fold errors surface at execution, like the reference
+
+
 def _func_ret_type(name, args):
     """Coarse builtin result typing — numeric funcs → double/bigint,
     string funcs → varchar (plan/typeinferer.go equivalent)."""
@@ -923,7 +990,8 @@ def _func_ret_type(name, args):
                 "field", "crc32", "connection_id", "found_rows",
                 "last_insert_id", "year", "month", "day", "dayofmonth",
                 "hour", "minute", "second", "weekday", "dayofweek",
-                "dayofyear", "unix_timestamp", "isnull", "is_not_null"):
+                "dayofyear", "unix_timestamp", "isnull", "is_not_null",
+                "extract", "datediff", "quarter", "week"):
         return new_field_type(my.TypeLonglong)
     if name in ("abs", "round", "truncate", "greatest", "least", "if",
                 "ifnull", "coalesce", "nullif", "case", "mod"):
@@ -932,7 +1000,7 @@ def _func_ret_type(name, args):
                 "pi", "rand"):
         return new_field_type(my.TypeDouble)
     if name in ("now", "current_timestamp", "sysdate", "curdate",
-                "current_date", "date"):
+                "current_date", "date", "date_add", "date_sub"):
         return new_field_type(my.TypeDatetime)
     ft = new_field_type(my.TypeVarString)
     return ft

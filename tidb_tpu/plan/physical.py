@@ -13,7 +13,7 @@ scan. This is the copr=cpu / copr=tpu routing point.
 
 from __future__ import annotations
 
-from tidb_tpu import mysqldef as my
+from tidb_tpu import errors, mysqldef as my
 from tidb_tpu.copr import proto
 from tidb_tpu.expression import AggregationFunction, Column, Schema
 from tidb_tpu.expression.aggregation import AggFunctionMode
@@ -27,8 +27,9 @@ from tidb_tpu.plan.plans import (
     Insert, Join, Limit, MaxOneRow, Plan, PhysicalApply, PhysicalDistinct,
     PhysicalExists, PhysicalHashAgg, PhysicalHashJoin, PhysicalHashSemiJoin,
     PhysicalIndexScan, PhysicalLimit, PhysicalMaxOneRow, PhysicalProjection,
-    PhysicalSelection, PhysicalSort, PhysicalTableDual, PhysicalTableScan,
-    PhysicalTopN, PhysicalUnion, PhysicalUnionScan, Projection, Selection,
+    PhysicalSelection, PhysicalSort, PhysicalStreamAgg, PhysicalTableDual,
+    PhysicalTableScan, PhysicalTopN, PhysicalUnion, PhysicalUnionScan,
+    Projection, Selection,
     SemiJoin, Sort, SortItem, TableDual, Union, Update,
 )
 from tidb_tpu.types.field_type import FieldType, new_field_type
@@ -199,11 +200,34 @@ def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
     # table-scan candidate is costed against every viable index, using
     # ANALYZE histograms when present, pseudo rates otherwise. Dirty tables
     # always table-scan (UnionScan merges by handle ranges).
+    hints_use = [n.lower() for n in getattr(ds, "use_index", ())]
+    hints_ignore = {n.lower() for n in getattr(ds, "ignore_index", ())}
+    if hints_use or hints_ignore:
+        known = {i.name.lower() for i in ds.table_info.indices}
+        if ds.table_info.pk_handle_column() is not None:
+            known.add("primary")   # the clustered pk handle is an index
+        missing = [n for n in list(hints_use) + sorted(hints_ignore)
+                   if n not in known]
+        if missing:
+            raise errors.PlanError(
+                f"Key '{missing[0]}' doesn't exist in table "
+                f"'{ds.table_info.name}'", code=1176)
+        if "primary" in hints_use:
+            # USE INDEX (PRIMARY) = scan by the handle, i.e. the table
+            # scan itself; drop it from the secondary-index candidates
+            # (alone, it pins the table-scan path)
+            hints_use = [n for n in hints_use if n != "primary"]
+            if not hints_use:
+                hints_ignore = {i.name.lower()
+                                for i in ds.table_info.indices}
     if not access and ds.table_info.id not in ctx.dirty:
         stats = ctx.stats(ds.table_info.id)
         table_cost = stats.count * SCAN_FACTOR + stats.count * NET_WORK_FACTOR
-        idx_plan, idx_cost = _try_index_scan(ds, rest, ctx, stats)
-        if idx_plan is not None and idx_cost < table_cost:
+        idx_plan, idx_cost = _try_index_scan(ds, rest, ctx, stats,
+                                             hints_use, hints_ignore)
+        if idx_plan is not None and (hints_use or idx_cost < table_cost):
+            # a USE/FORCE INDEX hint overrides the cost model
+            # (plan/physical_plan_builder.go index-hint flow)
             return idx_plan
 
     scan = PhysicalTableScan()
@@ -258,16 +282,23 @@ def _estimate_index_rows(stats, idx_cols, eq_vals, range_conds,
     return rows
 
 
-def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext, stats):
+def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext,
+                    stats, hints_use=(), hints_ignore=frozenset()):
     """Pick the cheapest index by estimated row count; returns
-    (plan | None, cost). Reference: convert2IndexScan
-    (plan/physical_plan_builder.go:206)."""
+    (plan | None, cost). USE/FORCE hints restrict the candidate set (and
+    admit full-range index scans); IGNORE hints exclude. Reference:
+    convert2IndexScan (plan/physical_plan_builder.go:206) + the IndexHint
+    productions (parser.y:505-507)."""
     from tidb_tpu.model.model import SchemaState
     handle = _handle_column(ds)
     best = None
     best_cost = float("inf")
     for idx in ds.table_info.indices:
         if idx.state != SchemaState.PUBLIC:
+            continue
+        if hints_use and idx.name.lower() not in hints_use:
+            continue
+        if idx.name.lower() in hints_ignore:
             continue
         idx_cols = []
         ok = True
@@ -282,8 +313,10 @@ def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext, stats):
             continue
         eq_vals, range_conds, next_col, remained = \
             refiner.detach_index_scan_conditions(conditions, idx_cols)
-        if not eq_vals and not range_conds:
+        if not eq_vals and not range_conds and not hints_use:
             continue  # full index scan never beats the table scan here
+        # (hinted: MySQL honors USE INDEX even without usable conditions —
+        # build_index_range of nothing is the full index range)
         ranges = refiner.build_index_range(eq_vals, range_conds)
         rows = _estimate_index_rows(stats, idx_cols, eq_vals, range_conds,
                                     ranges)
@@ -345,10 +378,38 @@ def _convert_aggregation(agg: Aggregation, ctx: PhysicalContext) -> Plan:
         pushed = _try_push_aggregation(agg, scan, ctx)
         if pushed is not None:
             return pushed
+    if _stream_agg_applicable(agg, child):
+        # child delivers rows consecutively grouped (index order prefix
+        # covers the group keys): one live group instead of a hash table
+        # (executor/executor.go:1085 StreamAggExec)
+        ps = PhysicalStreamAgg(agg.agg_funcs, agg.group_by)
+        ps.add_child(child)
+        ps.schema = agg.schema
+        return ps
     ph = PhysicalHashAgg(agg.agg_funcs, agg.group_by)
     ph.add_child(child)
     ph.schema = agg.schema
     return ph
+
+
+def _stream_agg_applicable(agg: Aggregation, child: Plan) -> bool:
+    """True when every group-by expr is a column and together they form a
+    prefix (in order) of the child index scan's columns — index iteration
+    order then clusters each group consecutively."""
+    if not agg.group_by:
+        return False
+    # SQL-side filters preserve their child's row order
+    while isinstance(child, PhysicalSelection):
+        child = child.children[0]
+    if not isinstance(child, PhysicalIndexScan) or child.desc:
+        return False
+    idx_names = [ic.name.lower() for ic in child.index.columns]
+    group_cols = []
+    for g in agg.group_by:
+        if not isinstance(g, Column):
+            return False
+        group_cols.append(g.col_name.lower())
+    return idx_names[:len(group_cols)] == group_cols
 
 
 def _try_push_aggregation(agg: Aggregation, scan: PhysicalTableScan,

@@ -67,6 +67,8 @@ class DataSource(Plan):
         self.table_info = table_info    # model.TableInfo
         self.alias = alias or table_info.name
         self.push_conditions: list[Expression] = []  # filled by predicate pushdown
+        self.use_index: list[str] = []      # USE/FORCE INDEX hints
+        self.ignore_index: list[str] = []   # IGNORE INDEX hints
 
 
 class Selection(Plan):

@@ -9,8 +9,10 @@ semaphore token exactly like the reference's token limiter.
 from __future__ import annotations
 
 import itertools
+import json
 import socket
 import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tidb_tpu.server.conn import ClientConnection
 from tidb_tpu.session import Session
@@ -18,7 +20,7 @@ from tidb_tpu.session import Session
 
 class Server:
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
-                 token_limit: int = 100):
+                 token_limit: int = 100, status_port: int | None = None):
         self.store = store
         self.host = host
         self.port = port
@@ -36,6 +38,11 @@ class Server:
         # one internal session for auth lookups (session.go ExecRestrictedSQL)
         self._auth_session = Session(store, internal=True)
         self._auth_lock = threading.Lock()
+        # HTTP status service (server/server.go:213 startStatusHTTP):
+        # None (default) disables — an unauthenticated listener must be
+        # opted into (the CLI does, via --status-port); 0 = ephemeral port
+        self.status_port = status_port
+        self._status_httpd: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -61,6 +68,8 @@ class Server:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="tidb-accept", daemon=True)
         self._accept_thread.start()
+        if self.status_port is not None:
+            self._start_status_server()
 
     def _accept_loop(self) -> None:
         while self.running:
@@ -86,8 +95,48 @@ class Server:
                 self._conns.discard(conn)
                 self._tokens.release()
 
+    def _start_status_server(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr chatter per request
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj, sort_keys=True).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._json(server.status())
+                elif self.path == "/metrics":
+                    from tidb_tpu import metrics
+                    body = metrics.render_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._status_httpd = ThreadingHTTPServer(
+            (self.host, self.status_port), Handler)
+        self.status_port = self._status_httpd.server_address[1]
+        threading.Thread(target=self._status_httpd.serve_forever,
+                         name="tidb-status-http", daemon=True).start()
+
     def close(self) -> None:
         self.running = False
+        if self._status_httpd is not None:
+            self._status_httpd.shutdown()
+            self._status_httpd.server_close()
+            self._status_httpd = None
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -124,6 +173,19 @@ class Server:
         return v.decode() if isinstance(v, bytes) else str(v)
 
     def status(self) -> dict:
+        """server/server.go:213-262 status JSON: version, connections,
+        plus engine counters (TPU routing, slow queries, fallbacks)."""
+        from tidb_tpu import metrics, mysqldef as my
         with self._conns_lock:
             n = len(self._conns)
-        return {"connections": n, "version": "tidb-tpu"}
+        return {
+            "connections": n,
+            "version": my.SERVER_VERSION,
+            "git_hash": "tidb-tpu",
+            "copr": {
+                "tpu_requests": metrics.counter("copr.tpu.requests").value,
+                "cpu_fallbacks":
+                    metrics.counter("copr.tpu.cpu_fallbacks").value,
+            },
+            "slow_queries": metrics.counter("server.slow_queries").value,
+        }

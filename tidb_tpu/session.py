@@ -253,6 +253,8 @@ class Session:
         from tidb_tpu import perfschema
         ps = perfschema.perf_for(self.store)
         ev = ps.start_statement(self.vars.connection_id, sql_text)
+        import time as _time
+        t0 = _time.perf_counter()
         try:
             rs = self._execute_one_inner(stmt, sql_text, record_history)
         except Exception as e:
@@ -260,12 +262,37 @@ class Session:
             raise
         ps.end_statement(ev, rows_sent=len(rs.rows) if rs is not None else 0,
                          rows_affected=self.vars.affected_rows)
+        self._maybe_log_slow(sql_text, _time.perf_counter() - t0)
         return rs
+
+    def _maybe_log_slow(self, sql_text: str, elapsed_s: float) -> None:
+        """Slow-query log ([TIME_TABLE_SCAN]-style operator logs,
+        executor_distsql.go:849): statements over
+        tidb_slow_log_threshold ms go to the 'tidb_tpu.slowlog' logger."""
+        from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+        raw = self.vars.get_system("tidb_slow_log_threshold",
+                                   self.global_vars) \
+            or SYSVAR_DEFAULTS["tidb_slow_log_threshold"]
+        try:
+            thr_ms = float(raw)
+        except ValueError:
+            thr_ms = float(SYSVAR_DEFAULTS["tidb_slow_log_threshold"])
+        if thr_ms > 0 and elapsed_s * 1000 >= thr_ms:
+            import logging
+            logging.getLogger("tidb_tpu.slowlog").warning(
+                "[SLOW_QUERY] cost_time:%.3fs conn:%s sql:%s",
+                elapsed_s, self.vars.connection_id, sql_text[:2048])
+            from tidb_tpu import metrics
+            metrics.counter("server.slow_queries").inc()
 
     def _execute_one_inner(self, stmt, sql_text: str,
                            record_history: bool = True) -> ResultSet | None:
         import time as _time
         m = _metric_handles()
+        # schema-validity kill-switch (session.go:430
+        # checkSchemaValidOrRollback): fail fast when the reload loop
+        # stalled past the lease
+        self.domain.check_schema_valid()
         self.vars.affected_rows = 0
         m.stmt_counter(type(stmt)).inc()
         if self.vars.user:

@@ -42,6 +42,8 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_skip_constraint_check": "0",
     # TPU coprocessor routing: cpu | tpu (this build's copr=tpu switch)
     "tidb_copr_backend": "cpu",
+    "tidb_slow_log_threshold": "300",   # ms; statements slower than this
+    #                                     hit the tidb_tpu.slowlog logger
     "tidb_copr_batch_rows": "1048576",
 }
 

@@ -15,6 +15,10 @@ from tidb_tpu.sqlast.base import ExprNode, Node, StmtNode
 class TableName(Node):
     name: str
     db: str = ""
+    # USE/FORCE INDEX and IGNORE INDEX hints (parser.y IndexHint
+    # productions, :505-507); empty = no hint
+    use_index: list = field(default_factory=list)
+    ignore_index: list = field(default_factory=list)
 
 
 @dataclass

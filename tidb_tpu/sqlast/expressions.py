@@ -23,6 +23,14 @@ class Literal(ExprNode):
 
 
 @dataclass
+class IntervalExpr(ExprNode):
+    """INTERVAL <value> <unit> — only legal as a +/- operand or a
+    DATE_ADD/DATE_SUB argument (parser.y TimeUnit productions)."""
+    value: ExprNode = None  # type: ignore[assignment]
+    unit: str = "day"
+
+
+@dataclass
 class ColumnName(ExprNode):
     """Possibly-qualified column reference; resolver fills offset/ftype.
     Reference: ast.ColumnName + ColumnNameExpr + ResultField binding."""
