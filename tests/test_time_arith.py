@@ -140,3 +140,24 @@ class TestIndexHints:
         with pytest.raises(errors.TiDBError) as ei:
             ht.exec("select * from h use index (nope)")
         assert getattr(ei.value, "code", None) == 1176
+
+    def test_use_index_primary_alone_pins_table_scan(self, ht):
+        p = self._plan(ht, "select * from h use index (primary) "
+                           "where b = 3")
+        assert "index:" not in p
+
+    def test_use_index_primary_plus_secondary_keeps_cost_choice(self, ht):
+        # USE INDEX (PRIMARY, ic) admits BOTH the handle scan and ic as
+        # candidates — with no selective condition on c, the non-covering
+        # ic double-read costs more than the table scan, which must win
+        # (it is explicitly allowed by the hint)
+        p = self._plan(ht, "select * from h use index (primary, ic) "
+                           "where b = 3")
+        assert "index:" not in p
+        # but a selective range on c flips the choice to ic by cost
+        p = self._plan(ht, "select * from h use index (primary, ic) "
+                           "where c = 3 and b = 3")
+        assert "index:ic" in p
+        # i ≡ 3 (mod 35) over 1..119 → {3, 38, 73, 108}
+        ht.query("select count(1) from h use index (primary, ic) "
+                 "where c = 3 and b = 3").check([[4]])

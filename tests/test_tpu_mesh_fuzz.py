@@ -1,0 +1,169 @@
+"""Mesh-parity fuzz across the group-cardinality ladder (round-3 verdict
+item 5): the SAME grouped queries run on the CPU engine and on a TpuClient
+sharded over the 8-virtual-device mesh, crossing
+
+  - high-NDV single-key radix group-by (NDV ~5200 int, ~12k int64 — global
+    host-built dictionary codes, psum-combined),
+  - composite TUPLE codes: group-bys whose mixed-radix cross product
+    overflows RADIX_MAX_SEGMENTS (a×f ≈ 72M, a×b×e ≈ 3.5M) and so used to
+    be single-chip only — now compacted host-side to dense global ids
+    (ColumnBatch.tuple_codes) and psum-combined like any radix request,
+  - NULL groups, decimal group keys, first_row, and per-group distinct
+    inside tuple-coded segments.
+
+Reference: store/localstore/local_aggregate.go:28 getGroupKey is kind- and
+cardinality-agnostic; this suite proves the mesh path now is too.
+"""
+
+import random
+
+import pytest
+
+from tidb_tpu.ops import TpuClient
+from tidb_tpu.session import Session, new_store
+
+N_ROWS = 12_000
+
+
+def _build(store):
+    from decimal import Decimal as _D
+
+    from tidb_tpu.types import Datum
+    from tidb_tpu.types.datum import NULL
+
+    s = Session(store)
+    s.execute("create database mz")
+    s.execute("use mz")
+    s.execute(
+        "create table t (id bigint primary key, a int, b varchar(32), "
+        "c double, e int, f bigint, m decimal(12,2))")
+    tbl = s.info_schema().table_by_name("mz", "t")
+
+    rng = random.Random(97)
+    words = [f"w{i:03d}" for i in range(64)]
+    txn = store.begin()
+    for i in range(1, N_ROWS + 1):
+        a = Datum.i64(rng.randint(0, 5999)) if rng.random() > 0.05 else NULL
+        b = Datum.string(rng.choice(words)) if rng.random() > 0.15 else NULL
+        c = Datum.f64(round(rng.uniform(-1e6, 1e6), 4)) \
+            if rng.random() > 0.30 else NULL
+        e = Datum.i64(rng.randint(0, 8))
+        f = Datum.i64(rng.randint(-10**12, 10**12))
+        m = Datum.dec(_D(rng.randint(-10**7, 10**7)) / 100) \
+            if rng.random() > 0.20 else NULL
+        tbl.add_record(txn, [Datum.i64(i), a, b, c, e, f, m],
+                       skip_unique_check=True)
+        if i % 3000 == 0:
+            txn.commit()
+            txn = store.begin()
+    txn.commit()
+    return s
+
+
+@pytest.fixture(scope="module")
+def sessions():
+    from tidb_tpu.parallel import CoprMesh
+
+    cpu_store = new_store("memory://meshfz_cpu")
+    mesh_store = new_store("memory://meshfz_mesh")
+    mesh_store.set_client(TpuClient(mesh_store, mesh=CoprMesh()))
+    return _build(cpu_store), _build(mesh_store)
+
+
+QUERIES = [
+    # scalar sanity over the mesh combine
+    "select count(*), sum(c), min(a), max(f) from t",
+    # radix ladder: low NDV → ~5200 → ~12k, all psum-combined
+    "select e, count(*), sum(a), min(c), max(c), avg(c) from t "
+    "group by e order by e",
+    "select a, count(*), sum(c) from t group by a order by a",
+    "select f, count(*) from t group by f order by f",
+    # composite tuple codes: cross product 6001×~12k ≈ 72M >> ceiling,
+    # actual distinct tuples ~12k — dense global ids, mesh-combined
+    "select a, f, count(*), sum(c), min(c) from t group by a, f "
+    "order by a, f",
+    # tuple codes with NULL groups on two of three key columns
+    "select a, b, e, count(*), sum(c) from t group by a, b, e "
+    "order by a, b, e",
+    # decimal group key inside a tuple (fixed-point plane as code source)
+    "select a, m, count(*) from t group by a, m order by a, m",
+    # first_row (non-group select column) through the tuple path
+    "select a, f, b from t group by a, f order by a, f",
+    # per-group distinct inside tuple-coded segments
+    "select a, f, count(distinct e) from t group by a, f order by a, f",
+]
+
+
+def _norm(rows):
+    from decimal import Decimal
+    out = []
+    for row in rows:
+        nr = []
+        for v in row:
+            if isinstance(v, Decimal):
+                v = float(v)
+            if isinstance(v, bytes):
+                nr.append(v.decode())
+            elif isinstance(v, float):
+                nr.append(("f", v))
+            else:
+                nr.append(v)
+        out.append(nr)
+    return out
+
+
+def _close(a, b):
+    if isinstance(a, tuple) and a[0] == "f":
+        return isinstance(b, tuple) and \
+            abs(a[1] - b[1]) <= 1e-9 * max(abs(a[1]), abs(b[1]), 1.0)
+    return a == b
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_mesh_fuzz_parity(sessions, sql):
+    cpu, mesh = sessions
+    client = mesh.store.get_client()
+    before = (client.stats["tpu_requests"], client.stats["cpu_fallbacks"])
+    cpu_rows = _norm(cpu.execute(sql)[0].values())
+    mesh_rows = _norm(mesh.execute(sql)[0].values())
+    assert client.stats["tpu_requests"] > before[0], sql
+    assert client.stats["cpu_fallbacks"] == before[1], sql
+    assert len(cpu_rows) == len(mesh_rows), sql
+    for cr, tr in zip(cpu_rows, mesh_rows):
+        assert len(cr) == len(tr), sql
+        for a, b in zip(cr, tr):
+            assert _close(a, b), (sql, cr, tr)
+
+
+def test_high_ndv_queries_cross_the_ladder(sessions):
+    """The suite only proves what the verdict asked if the cardinalities
+    really cross the rank-bucket ladder: assert the group counts."""
+    cpu, _ = sessions
+    n_a = len(cpu.execute("select a, count(*) from t group by a")[0].values())
+    n_af = len(cpu.execute(
+        "select a, f, count(*) from t group by a, f")[0].values())
+    assert n_a >= 3000, n_a          # > first rank bucket (1025)
+    assert n_af >= 10_000, n_af      # > second bucket territory
+
+
+def test_tuple_lowering_used_on_mesh(sessions):
+    """group by a, f must actually take the composite-tuple route (not
+    radix, not CPU fallback): its cross product overflows the ceiling."""
+    from tidb_tpu.copr.proto import ByItem, SelectRequest, expr_column
+    from tidb_tpu.ops import kernels
+
+    _, mesh = sessions
+    client = mesh.store.get_client()
+    mesh.execute("select a, f, count(*) from t group by a, f")
+    batch = client._cur_batch
+    assert batch is not None
+    info = mesh.info_schema().table_by_name("mz", "t").info
+    cid = {c.name: c.id for c in info.columns}
+    req = SelectRequest(start_ts=0, group_by=[
+        ByItem(expr_column(cid["a"])), ByItem(expr_column(cid["f"]))])
+    gspec = kernels.lower_group_by(req, batch)
+    assert gspec.kind == "rank"
+    tspec = kernels.lower_tuple_group(gspec, batch)
+    assert tspec is not None and tspec.kind == "tuple"
+    assert tspec.n_groups >= 10_000
+    assert tspec.percol.shape == (tspec.n_groups, 2)

@@ -273,17 +273,32 @@ class TpuClient(kv.Client):
         if sel.group_by:
             gspec = kernels.lower_group_by(sel, batch)
             if gspec.kind == "rank":
-                if self.mesh is not None:
-                    # rank ids are batch-local; not psum-combinable
-                    raise Unsupported("ranked group-by is single-chip")
-                return self._run_ranked(sel, batch, where, specs, gspec,
-                                        planes, live)
+                if self.mesh is None:
+                    # single chip: device-side sort-rank, no host pass;
+                    # composite tuple codes only if the ladder overflows
+                    try:
+                        return self._run_ranked(sel, batch, where, specs,
+                                                gspec, planes, live)
+                    except Unsupported:
+                        tspec = kernels.lower_tuple_group(gspec, batch)
+                        if tspec is None:
+                            raise
+                        gspec = tspec
+                else:
+                    # mesh: rank ids are batch-local (not psum-combinable);
+                    # compact to global composite tuple codes instead
+                    tspec = kernels.lower_tuple_group(gspec, batch)
+                    if tspec is None:
+                        raise Unsupported(
+                            "group tuple cardinality exceeds segment "
+                            "ceiling")
+                    gspec = tspec
             planes = self._with_group_planes(batch, gspec, planes)
             fn, wrapper, jitted = self._kernel(
                 sel, batch, "grouped",
                 lambda: kernels.build_grouped_agg_fn(where, specs,
                                                      gspec.plane_keys,
-                                                     gspec.sizes))
+                                                     gspec.kernel_sizes))
             if self.mesh is not None:
                 outs = [np.asarray(o)
                         for o in self.mesh.run_grouped(fn, planes, live)]
@@ -316,9 +331,12 @@ class TpuClient(kv.Client):
         return SelectResponse(chunks=writer.finish())
 
     def _with_group_planes(self, batch, gspec, planes):
-        """Add host-built numeric group-code planes (device-cached on the
-        batch; the valid plane is the column's own)."""
-        extra = [k for k in gspec.plane_keys if kernels.is_group_code_key(k)]
+        """Add host-built group-code planes (device-cached on the batch):
+        per-column numeric codes (valid plane is the column's own) or the
+        composite tuple-code plane (NULLs already folded into the codes, so
+        its valid plane is all-true)."""
+        extra = [k for k in gspec.plane_keys
+                 if kernels.is_group_code_key(k) or kernels.is_tuple_key(k)]
         if not extra:
             return planes
         import jax.numpy as jnp
@@ -327,6 +345,15 @@ class TpuClient(kv.Client):
             dev = batch._device_gcodes = {}
         planes = dict(planes)
         for key in extra:
+            if kernels.is_tuple_key(key):
+                ent = dev.get(key)
+                if ent is None:
+                    codes, _percol = batch.tuple_codes(gspec.cids)
+                    ent = dev[key] = (
+                        jnp.asarray(codes),
+                        jnp.ones(batch.capacity, dtype=bool))
+                planes[key] = ent
+                continue
             cid = kernels.group_code_cid(key)
             arr = dev.get(cid)
             if arr is None:
@@ -356,13 +383,19 @@ class TpuClient(kv.Client):
         n_segments = row_count.shape[0]
         live_gids = [g for g in range(n_segments - 1) if row_count[g] > 0]
         for gid in live_gids:
-            # decode mixed-radix gid → per-column codes
-            codes = []
-            rem = gid
-            for radix in reversed(radices):
-                codes.append(rem % radix)
-                rem //= radix
-            codes.reverse()
+            if gspec.kind == "tuple":
+                # composite id indexes the host-built per-column code table
+                if gid >= gspec.n_groups:   # kernel's (unused) NULL slot
+                    continue
+                codes = [int(c) for c in gspec.percol[gid]]
+            else:
+                # decode mixed-radix gid → per-column codes
+                codes = []
+                rem = gid
+                for radix in reversed(radices):
+                    codes.append(rem % radix)
+                    rem //= radix
+                codes.reverse()
             gvals = []
             for code, size, cid, dec in zip(codes, gspec.sizes, gspec.cids,
                                             gspec.decoders):
@@ -390,6 +423,11 @@ class TpuClient(kv.Client):
         ck = (batch._uid, repr(sel.where), repr(sel.aggregates),
               repr(sel.group_by))
         start = self._rank_cap_start.get(ck, self._RANK_CAPS[0])
+        if start > self._RANK_CAPS[-1]:
+            # memoized overflow: repeats go straight to the tuple/CPU
+            # fallback without re-running the whole ladder
+            raise Unsupported("group cardinality exceeds rank buckets "
+                              "(memoized)")
         for cap in self._RANK_CAPS:
             if cap < start:
                 continue
@@ -408,6 +446,7 @@ class TpuClient(kv.Client):
                         next(iter(self._rank_cap_start)))
                 return self._emit_ranked(sel, batch, specs, gspec, outs,
                                          ngroups)
+        self._rank_cap_start[ck] = self._RANK_CAPS[-1] + 1
         raise Unsupported(f"group cardinality {ngroups} exceeds rank buckets")
 
     def _emit_ranked(self, sel, batch, specs, gspec, outs,

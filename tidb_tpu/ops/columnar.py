@@ -129,6 +129,76 @@ class ColumnBatch:
         cache[cid] = ent
         return ent
 
+    def tuple_codes(self, cids: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Host-built GLOBAL composite codes over a TUPLE of group columns:
+        (codes int64[capacity], percol int64[G, k]).
+
+        Each live row maps to the dense id (0..G-1, sorted order) of its
+        distinct (col_1, …, col_k) combination; percol[g, j] is column j's
+        per-column code for group g (== column size means NULL). Like
+        group_codes, the pass runs on the host BEFORE rows are sharded, so
+        ids are identical on every chip and composite group ids stay
+        psum-combinable across the mesh — this is what lets group-bys whose
+        mixed-radix cross product overflows the segment ceiling (but whose
+        ACTUAL distinct-tuple count fits) run mesh-wide, matching the
+        cardinality-agnostic group keys of the reference
+        (store/localstore/local_aggregate.go:28 getGroupKey)."""
+        cache = getattr(self, "_tuple_code_cache", None)
+        if cache is None:
+            cache = self._tuple_code_cache = {}
+        key = tuple(cids)
+        ent = cache.get(key)
+        if ent is not None:
+            return ent
+        live = self.row_mask()
+        percol_planes, radices = [], []
+        for cid in cids:
+            cd = self.columns[cid]
+            if cd.kind == K_STR:
+                size = len(cd.dictionary)
+                codes = cd.values.astype(np.int64)
+            else:
+                codes, uniq = self.group_codes(cid)
+                size = len(uniq)
+            # NULL → reserved per-column slot (same convention as the
+            # mixed-radix kernel's size+1 radices)
+            percol_planes.append(np.where(cd.valid, codes, size))
+            radices.append(size + 1)
+        prod = 1
+        for r in radices:
+            prod *= r
+        k = len(cids)
+        if prod < (1 << 62):
+            # pack the tuple into one int64 scalar (the same mixed-radix
+            # id the device kernel would compute), then compact
+            keys = np.zeros(self.capacity, dtype=np.int64)
+            for codes, r in zip(percol_planes, radices):
+                keys = keys * r + codes
+            uniq_keys = np.unique(keys[live])
+            out = np.searchsorted(uniq_keys, keys).astype(np.int64)
+            G = len(uniq_keys)
+            if G:
+                np.minimum(out, G - 1, out=out)  # pad rows in-range
+            else:
+                out[:] = 0
+            percol = np.empty((G, k), dtype=np.int64)
+            rem = uniq_keys.copy()
+            for j in range(k - 1, -1, -1):
+                percol[:, j] = rem % radices[j]
+                rem //= radices[j]
+        else:
+            # cross product beyond int64 — compact rows directly
+            stacked = np.stack(percol_planes, axis=1)
+            uniq_rows, inv = np.unique(stacked[live], axis=0,
+                                       return_inverse=True)
+            out = np.zeros(self.capacity, dtype=np.int64)
+            out[live] = inv
+            G = len(uniq_rows)
+            percol = uniq_rows.astype(np.int64).reshape(G, k)
+        ent = (out, percol)
+        cache[key] = ent
+        return ent
+
 
 def bucket_capacity(n: int, minimum: int = 1024) -> int:
     c = minimum

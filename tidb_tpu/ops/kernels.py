@@ -174,31 +174,64 @@ def group_code_key(cid: int) -> int:
 
 
 def is_group_code_key(key: int) -> bool:
-    return key <= GC_BASE
+    return TUPLE_BASE < key <= GC_BASE
 
 
 def group_code_cid(key: int) -> int:
     return GC_BASE - key
 
 
+# planes-dict keys for host-built composite TUPLE codes (tuple_codes):
+# one interned negative key per distinct group-column tuple, below every
+# per-column group-code key
+TUPLE_BASE = -1_000_000
+_tuple_keys: dict[tuple, int] = {}
+
+
+def tuple_code_key(cids) -> int:
+    t = tuple(cids)
+    key = _tuple_keys.get(t)
+    if key is None:
+        key = TUPLE_BASE - len(_tuple_keys)
+        _tuple_keys[t] = key
+    return key
+
+
+def is_tuple_key(key: int) -> bool:
+    return key <= TUPLE_BASE
+
+
 class GroupSpec:
-    """Lowered group-by: either a mixed-radix code over GLOBAL dictionary
-    codes ('radix': group ids consistent across chips → mesh-combinable;
-    any column kind, K_STR codes come from the pack dictionary and numeric/
-    time codes from ColumnBatch.group_codes) or a sort + rank assignment
-    ('rank': any cardinality, single-chip only — ids are batch-local)."""
+    """Lowered group-by, one of three id schemes:
+
+    - 'radix': mixed-radix code over GLOBAL per-column dictionary codes
+      (K_STR codes from the pack dictionary, numeric/time codes from
+      ColumnBatch.group_codes). Ids consistent across chips →
+      mesh-combinable.
+    - 'tuple': ONE host-built composite code over the whole group tuple
+      (ColumnBatch.tuple_codes) — the compaction of a radix space whose
+      cross product overflows RADIX_MAX_SEGMENTS. Ids global →
+      mesh-combinable; kernel_sizes is [n_groups], percol decodes ids back
+      to per-column codes.
+    - 'rank': device-side sort + rank assignment. Any cardinality with no
+      host pass, but ids are batch-local → single-chip only."""
 
     def __init__(self, kind: str, cids: list[int], sizes: list[int],
                  col_kinds: list[str], plane_keys=None, decoders=None):
-        self.kind = kind          # "radix" | "rank"
+        self.kind = kind          # "radix" | "tuple" | "rank"
         self.cids = cids
-        self.sizes = sizes        # radix only: dict sizes
+        self.sizes = sizes        # radix/tuple: per-column dict sizes
         self.col_kinds = col_kinds
-        # radix only: planes-dict key per group column (the cid itself for
-        # K_STR, group_code_key(cid) for host-built numeric/time planes)
+        # radix/tuple: planes-dict key per group plane (the cid itself for
+        # K_STR, group_code_key(cid) for host-built numeric/time planes,
+        # tuple_code_key(cids) — a single key — for composite codes)
         self.plane_keys = plane_keys or []
-        # radix only: per-column ("str", dictionary) | ("num", uniq array)
+        # radix/tuple: per-column ("str", dict) | ("num", uniq) | ("dec", …)
         self.decoders = decoders or []
+        # sizes handed to build_grouped_agg_fn ([n_groups] for tuple)
+        self.kernel_sizes = sizes
+        self.percol = None        # tuple: int64[G, k] per-column codes
+        self.n_groups = None      # tuple: G
 
 
 def lower_group_by(req: SelectRequest, batch: col.ColumnBatch) -> GroupSpec:
@@ -212,26 +245,62 @@ def lower_group_by(req: SelectRequest, batch: col.ColumnBatch) -> GroupSpec:
             raise Unsupported("group-by column not packed")
         cids.append(e.val)
         kinds.append(cd.kind)
-    sizes, plane_keys, decoders = [], [], []
+    # radix clamps sizes to >= 1 so its mixed-radix segment math stays
+    # nonzero; the kernel's NULL slot and the emit threshold both use the
+    # SAME clamped size, keeping decode consistent
+    sizes, decoders = _col_sizes_decoders(batch, cids, floor=1)
+    plane_keys = [cid if kind == col.K_STR else group_code_key(cid)
+                  for cid, kind in zip(cids, kinds)]
     num_segments = 1
-    for cid, kind in zip(cids, kinds):
-        cd = batch.columns[cid]
-        if kind == col.K_STR:
-            sizes.append(max(len(cd.dictionary), 1))
-            plane_keys.append(cid)
-            decoders.append(("str", cd.dictionary))
-        else:
-            _codes, uniq = batch.group_codes(cid)
-            sizes.append(max(len(uniq), 1))
-            plane_keys.append(group_code_key(cid))
-            if kind == col.K_DEC:
-                decoders.append(("dec", uniq, cd.dec_scale))
-            else:
-                decoders.append(("num", uniq))
-        num_segments *= sizes[-1] + 1
+    for s in sizes:
+        num_segments *= s + 1
     if num_segments + 1 <= RADIX_MAX_SEGMENTS:
         return GroupSpec("radix", cids, sizes, kinds, plane_keys, decoders)
     return GroupSpec("rank", cids, [], kinds)
+
+
+def _col_sizes_decoders(batch: col.ColumnBatch, cids: list[int],
+                        floor: int) -> tuple[list[int], list]:
+    """Per-group-column (sizes, decoders) shared by the radix and tuple
+    lowerings. `floor=1` for radix (see lower_group_by); `floor=0` for
+    tuple, whose percol codes use the UNCLAMPED size as the NULL code, so
+    the emit threshold must match it exactly."""
+    sizes, decoders = [], []
+    for cid in cids:
+        cd = batch.columns[cid]
+        if cd.kind == col.K_STR:
+            sizes.append(max(len(cd.dictionary), floor))
+            decoders.append(("str", cd.dictionary))
+        else:
+            _codes, uniq = batch.group_codes(cid)
+            sizes.append(max(len(uniq), floor))
+            if cd.kind == col.K_DEC:
+                decoders.append(("dec", uniq, cd.dec_scale))
+            else:
+                decoders.append(("num", uniq))
+    return sizes, decoders
+
+
+def lower_tuple_group(gspec: GroupSpec,
+                      batch: col.ColumnBatch) -> GroupSpec | None:
+    """Compact a rank-lowered group-by into composite TUPLE codes
+    (ColumnBatch.tuple_codes): one host pass builds dense global ids over
+    the actual distinct group tuples, so the grouped-radix kernel — and the
+    mesh psum combine — applies even when the per-column cross product
+    overflows RADIX_MAX_SEGMENTS. Returns None when even the distinct-tuple
+    count exceeds the segment ceiling (the result set itself would be that
+    large; the CPU engine takes those)."""
+    _codes, percol = batch.tuple_codes(gspec.cids)
+    n_groups = percol.shape[0]
+    if n_groups + 2 > RADIX_MAX_SEGMENTS:
+        return None
+    sizes, decoders = _col_sizes_decoders(batch, gspec.cids, floor=0)
+    spec = GroupSpec("tuple", gspec.cids, sizes, gspec.col_kinds,
+                     [tuple_code_key(gspec.cids)], decoders)
+    spec.kernel_sizes = [n_groups]
+    spec.percol = percol
+    spec.n_groups = n_groups
+    return spec
 
 
 def _orderable_i64(v):

@@ -212,22 +212,29 @@ def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
             raise errors.PlanError(
                 f"Key '{missing[0]}' doesn't exist in table "
                 f"'{ds.table_info.name}'", code=1176)
-        if "primary" in hints_use:
+        primary_hinted = "primary" in hints_use
+        if primary_hinted:
             # USE INDEX (PRIMARY) = scan by the handle, i.e. the table
             # scan itself; drop it from the secondary-index candidates
-            # (alone, it pins the table-scan path)
+            # (alone, it pins the table-scan path; alongside other names
+            # it re-admits the table scan as a cost-compared candidate)
             hints_use = [n for n in hints_use if n != "primary"]
             if not hints_use:
                 hints_ignore = {i.name.lower()
                                 for i in ds.table_info.indices}
+    else:
+        primary_hinted = False
     if not access and ds.table_info.id not in ctx.dirty:
         stats = ctx.stats(ds.table_info.id)
         table_cost = stats.count * SCAN_FACTOR + stats.count * NET_WORK_FACTOR
         idx_plan, idx_cost = _try_index_scan(ds, rest, ctx, stats,
                                              hints_use, hints_ignore)
-        if idx_plan is not None and (hints_use or idx_cost < table_cost):
-            # a USE/FORCE INDEX hint overrides the cost model
-            # (plan/physical_plan_builder.go index-hint flow)
+        if idx_plan is not None and (
+                (hints_use and not primary_hinted)
+                or idx_cost < table_cost):
+            # a USE/FORCE INDEX hint overrides the cost model — unless
+            # PRIMARY was hinted too, which keeps the table scan in the
+            # candidate set (plan/physical_plan_builder.go index-hint flow)
             return idx_plan
 
     scan = PhysicalTableScan()
