@@ -1,0 +1,131 @@
+"""ENUM / SET / BIT / HEX through the full SQL surface (round-3 verdict
+missing #6): DDL with elems, insert by name / index / number, predicate
+semantics (names vs strings, indices vs numbers), sorting by index,
+aggregates, indexes over enum columns, and wire output.
+
+Reference: util/types/{enum,set,bit,hex}.go; parser/parser.y enum/set
+column productions; tablecodec flatten/unflatten contract.
+"""
+
+import pytest
+
+from tidb_tpu import errors
+from tidb_tpu.session import Session, new_store
+
+
+@pytest.fixture
+def s():
+    from tests.testkit import _store_id
+    s = Session(new_store(f"memory://enumsql{next(_store_id)}"))
+    s.execute("create database d; use d")
+    s.execute("create table t (id bigint primary key, "
+              "c enum('red','green','blue'), s set('a','b','c'), "
+              "b bit(8))")
+    s.execute("insert into t values "
+              "(1, 'green', 'a,c', b'1010'), "
+              "(2, 2, 5, 10), "
+              "(3, 'BLUE', '', 0), "
+              "(4, null, null, null)")
+    return s
+
+
+def test_storage_and_display(s):
+    rows = s.execute("select id, c, s, b from t order by id")[0].rows
+    shown = [[None if d.is_null() else str(d.val) for d in r] for r in rows]
+    assert shown == [
+        ["1", "green", "a,c", "0b00001010"],
+        ["2", "green", "a,c", "0b00001010"],   # by index/number
+        ["3", "blue", "", "0b00000000"],       # case-insensitive item match
+        ["4", None, None, None]]
+
+
+def test_predicates(s):
+    q = lambda sql: s.execute(sql)[0].values()
+    assert q("select id from t where c = 'green' order by id") == [[1], [2]]
+    assert q("select id from t where c != 'green' order by id") == [[3]]
+    assert q("select id from t where c > 1 order by id") == [[1], [2], [3]]
+    assert q("select id from t where s = 'a,c' order by id") == [[1], [2]]
+    assert q("select id from t where b = 10 order by id") == [[1], [2]]
+    assert q("select id from t where c is null") == [[4]]
+
+
+def test_enum_sorts_by_index_not_name(s):
+    # green(2) < blue(3) although 'blue' < 'green' lexicographically
+    assert s.execute("select id from t order by c, id")[0].values() == \
+        [[4], [1], [2], [3]]
+
+
+def test_aggregates(s):
+    assert s.execute("select count(distinct c) from t")[0].values() == [[2]]
+    mx = s.execute("select max(c), min(c) from t")[0].rows[0]
+    assert str(mx[0].val) == "blue" and str(mx[1].val) == "green"
+    g = s.execute("select c, count(*) from t group by c order by c")[0].rows
+    assert [None if r[0].is_null() else str(r[0].val) for r in g] == \
+        [None, "green", "blue"]
+
+
+def test_invalid_values_rejected(s):
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (9, 'yellow', null, null)")
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (9, 9, null, null)")   # > 3 items
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (9, null, 'z', null)")
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (9, null, null, 256)")  # > BIT(8)
+
+
+def test_index_on_enum_column(s):
+    s.execute("create index ic on t (c)")
+    s.execute("admin check table t")
+    assert s.execute("select id from t use index (ic) where c = 'green' "
+                     "order by id")[0].values() == [[1], [2]]
+
+
+def test_update_and_cast(s):
+    s.execute("update t set c = 'red' where id = 2")
+    assert s.execute("select id from t where c = 'red'")[0].values() == [[2]]
+    # enum → int cast context: numeric value is the index
+    assert s.execute("select id + 0 from t where c = 'red'")[0] \
+        .values() == [[2]]
+
+
+def test_hex_bit_literals():
+    s = Session(new_store("memory://hexlit"))
+    s.execute("create database d; use d")
+    r = s.execute("select 0x41 + 1, x'4142', b'01000001'")[0].rows[0]
+    assert r[0].as_number() == 66            # numeric context
+    assert r[1].get_string() == "AB"         # string context
+    assert r[2].as_number() == 65
+    # string functions see the bytes; comparisons see the dual nature
+    assert s.execute("select length(x'4142')")[0].values() == [[2]]
+    assert s.execute("select 1 where 0x41 = 'A'")[0].values() == [[1]]
+    assert s.execute("select 1 where 0x41 = 65")[0].values() == [[1]]
+
+
+def test_show_create_table_renders_elems(s):
+    out = s.execute("show create table t")[0].values()[0][1]
+    assert "enum('red','green','blue')" in out
+    assert "set('a','b','c')" in out
+    assert "bit(8)" in out
+
+
+def test_wire_text_output():
+    """Over the real socket: enum/set as names, bit as binary string."""
+    from tests.testkit import _store_id
+    from tidb_tpu.server import Client, Server
+
+    store = new_store(f"memory://enumwire{next(_store_id)}")
+    server = Server(store)
+    server.start()
+    try:
+        c = Client("127.0.0.1", server.port)
+        c.query("create database d")
+        c.query("use d")
+        c.query("create table t "
+                "(id bigint primary key, c enum('x','y'), b bit(8))")
+        c.query("insert into t values (1, 'y', 65)")
+        rows = c.query("select c, b from t")[0].rows
+        assert rows == [["y", "A"]]
+    finally:
+        server.close()

@@ -245,3 +245,87 @@ class TestAggregation:
                 final.update(fctx, pr)
             got = final.get_result(fctx)
             assert got.val == want, name
+
+
+class TestRound4Builtins:
+    """Round-4 breadth: the remaining evaluator/builtin.go registry rows
+    (time formatting, name lookups, regexp, utility no-ops)."""
+
+    def g(self, name, *args):
+        return ev(fn(name, *args)).val
+
+    def gs(self, name, *args):
+        v = ev(fn(name, *args))
+        return v.get_string() if not v.is_null() else None
+
+    def test_dayname_monthname(self):
+        assert self.gs("dayname", "2026-07-30") == "Thursday"
+        assert self.gs("monthname", "2026-07-30") == "July"
+        assert ev(fn("dayname", "not-a-date")).is_null()
+
+    def test_weekofyear_yearweek(self):
+        assert self.g("weekofyear", "2026-01-01") == 1
+        assert self.g("weekofyear", "2024-12-30") == 1   # ISO rollover
+        assert self.g("yearweek", "2026-07-30") == 202630
+        assert self.g("yearweek", "2026-07-30", 1) == 202631
+
+    def test_date_format(self):
+        assert self.gs("date_format", "2026-07-30 15:04:05",
+                       "%Y-%m-%d %H:%i:%s") == "2026-07-30 15:04:05"
+        assert self.gs("date_format", "2026-07-30", "%W %M %D") == \
+            "Thursday July 30th"
+        assert self.gs("date_format", "2026-07-30 15:04:05", "%r") == \
+            "03:04:05 PM"
+        assert self.gs("date_format", "2026-07-30", "%% %q") == "% q"
+
+    def test_from_unixtime(self):
+        import datetime as dt
+        got = ev(fn("from_unixtime", 0))
+        assert got.val.dt == dt.datetime.fromtimestamp(0)
+        assert self.gs("from_unixtime", 86400 * 365, "%Y") == \
+            dt.datetime.fromtimestamp(86400 * 365).strftime("%Y")
+        assert ev(fn("from_unixtime", -5)).is_null()
+
+    def test_substring_index(self):
+        assert self.gs("substring_index", "www.mysql.com", ".", 2) == \
+            "www.mysql"
+        assert self.gs("substring_index", "www.mysql.com", ".", -2) == \
+            "mysql.com"
+        assert self.gs("substring_index", "www.mysql.com", ".", 0) == ""
+        assert self.gs("substring_index", "a,b", ";", 5) == "a,b"
+
+    def test_time_and_curtime(self):
+        from tidb_tpu.types.time_types import Duration
+        v = ev(fn("time", "2026-07-30 15:04:05"))
+        assert isinstance(v.val, Duration) and str(v.val) == "15:04:05"
+        v = ev(fn("time", "12:30:00"))
+        assert str(v.val).startswith("12:30:00")
+        assert isinstance(ev(fn("curtime")).val, Duration)
+        assert ev(fn("utc_date")).val.tp is not None
+
+    def test_regexp(self):
+        assert self.g("regexp", "abcdef", "c.e") == 1
+        assert self.g("regexp", "abcdef", "^c") == 0
+        assert self.g("not_regexp", "abcdef", "^c") == 1
+        assert ev(fn("regexp", "x", None)).is_null()
+        with pytest.raises(errors.TiDBError):
+            self.g("regexp", "x", "(")
+
+    def test_utility_no_ops(self):
+        assert self.g("get_lock", "name", 3) == 1
+        assert self.g("release_lock", "name") == 1
+        assert self.g("sleep", 0) == 0
+
+
+def test_regexp_parses_end_to_end():
+    from tidb_tpu.session import Session, new_store
+    s = Session(new_store("memory://rx"))
+    s.execute("create database d; use d")
+    s.execute("create table t (a int primary key, b varchar(20))")
+    s.execute("insert into t values (1, 'hello'), (2, 'world'), (3, null)")
+    assert s.execute("select a from t where b regexp '^h' order by a")[0] \
+        .values() == [[1]]
+    assert s.execute("select a from t where b rlike 'o' order by a")[0] \
+        .values() == [[1], [2]]
+    assert s.execute("select a from t where b not regexp 'o' order by a")[0] \
+        .values() == []   # NULL row filtered too

@@ -122,3 +122,108 @@ def test_wide_decimal_quantize_no_crash():
     from decimal import Decimal
     v = Decimal("12345678901234567890123456789.1")
     assert quantize_decimal(v, 2) == Decimal("12345678901234567890123456789.10")
+
+
+class TestEnumSetBitHex:
+    """ENUM/SET/BIT/HEX value semantics (round-3 verdict missing #6;
+    util/types/enum.go, set.go, bit.go, hex.go)."""
+
+    def test_parse_enum(self):
+        from tidb_tpu.types.enumset import parse_enum_name, parse_enum_value
+        e = parse_enum_name(["red", "green"], "GREEN")
+        assert (e.name, e.value) == ("green", 2)
+        assert parse_enum_value(["red", "green"], 1).name == "red"
+        assert parse_enum_name(["red", "green"], "2").name == "green"
+        with pytest.raises(errors.TiDBError):
+            parse_enum_name(["red"], "blue")
+        with pytest.raises(errors.TiDBError):
+            parse_enum_value(["red"], 0)
+        with pytest.raises(errors.TiDBError):
+            parse_enum_value(["red"], 2)
+
+    def test_parse_set(self):
+        from tidb_tpu.types.enumset import parse_set_name, parse_set_value
+        s = parse_set_name(["a", "b", "c"], "c,a")
+        assert (s.name, s.value) == ("a,c", 0b101)
+        assert parse_set_name(["a", "b"], "").value == 0
+        assert parse_set_value(["a", "b", "c"], 6).name == "b,c"
+        # numbers in string form, and de-dup of repeated members
+        assert parse_set_name(["a", "b"], "3").name == "a,b"
+        assert parse_set_name(["a", "b"], "a,a,b").value == 0b11
+        with pytest.raises(errors.TiDBError):
+            parse_set_name(["a"], "z")
+        with pytest.raises(errors.TiDBError):
+            parse_set_value(["a"], 2)
+
+    def test_parse_bit_hex(self):
+        from tidb_tpu.types.enumset import Bit, parse_bit, parse_hex
+        b = parse_bit("b'1010'", Bit.UNSPECIFIED_WIDTH)
+        assert (b.value, b.width) == (10, 8)
+        assert parse_bit("0b11", 2).value == 3
+        assert str(parse_bit("b'101'", 4)) == "0b0101"
+        assert parse_bit("b'1'", -1).to_bytes() == b"\x01"
+        with pytest.raises(errors.TiDBError):
+            parse_bit("b'102'", -1)
+        with pytest.raises(errors.TiDBError):
+            parse_bit("b'111'", 2)
+        h = parse_hex("0x4142")
+        assert h.value == 0x4142 and h.to_bytes() == b"AB"
+        assert str(parse_hex("x'0a'")) == "0x0A"
+        assert parse_hex("0x0").to_bytes() == b"\x00"
+        with pytest.raises(errors.TiDBError):
+            parse_hex("x'1'")   # odd digit count
+
+    def test_datum_views_and_compare(self):
+        from tidb_tpu.types.datum import Kind, compare_datum
+        from tidb_tpu.types.enumset import Bit, Enum, Hex, SetVal
+        e = Datum(Kind.ENUM, Enum("green", 2))
+        assert e.get_string() == "green" and e.as_number() == 2
+        # vs string → by NAME; vs number → by index
+        assert compare_datum(e, Datum.string("green")) == 0
+        assert compare_datum(e, Datum.string("red")) < 0
+        assert compare_datum(e, Datum.i64(2)) == 0
+        assert compare_datum(e, Datum.i64(3)) < 0
+        s = Datum(Kind.SET, SetVal("a,c", 0b101))
+        assert compare_datum(s, Datum.string("a,c")) == 0
+        assert compare_datum(s, Datum.i64(5)) == 0
+        h = Datum(Kind.HEX, Hex(0x41))
+        assert compare_datum(h, Datum.string("A")) == 0
+        assert compare_datum(h, Datum.i64(65)) == 0
+        b = Datum(Kind.BIT, Bit(65, 8))
+        assert compare_datum(b, Datum.string("A")) == 0
+        assert compare_datum(b, Datum.i64(65)) == 0
+
+    def test_convert_roundtrip_through_codec(self):
+        """Flatten/unflatten contract: enum/set/bit survive the codec as
+        uints and come back as rich objects via the column FieldType."""
+        from tidb_tpu import codec
+        from tidb_tpu.types.convert import convert_datum, unflatten_datum
+        from tidb_tpu.types.datum import Kind
+        from tidb_tpu.types.field_type import FieldType
+        import tidb_tpu.mysqldef as my
+
+        eft = FieldType(my.TypeEnum, elems=["red", "green"])
+        sft = FieldType(my.TypeSet, elems=["a", "b"])
+        bft = FieldType(my.TypeBit, flen=8)
+        for ft, raw, flat, shown in [
+                (eft, Datum.string("green"), 2, "green"),
+                (sft, Datum.string("b,a"), 3, "a,b"),
+                (bft, Datum.i64(9), 9, "\t")]:
+            stored = convert_datum(raw, ft)
+            enc = codec.encode_value([stored])
+            dec, _ = codec.decode_one(enc, 0)
+            assert dec.kind in (Kind.INT64, Kind.UINT64) and dec.val == flat
+            back = unflatten_datum(dec, ft)
+            assert back.kind == stored.kind
+            assert back.get_string() == shown
+
+    def test_review_fixes_roundtrip(self):
+        """Round-4 review findings: hex leading zeros / empty literal,
+        binary (non-UTF8) compare, oversized bit literals."""
+        from tidb_tpu.types.datum import Kind, compare_datum
+        from tidb_tpu.types.enumset import Hex, parse_hex
+        assert parse_hex("x'0041'").to_bytes() == b"\x00A"
+        assert parse_hex("x''").to_bytes() == b""
+        assert parse_hex("0x1").to_bytes() == b"\x01"
+        h = Datum(Kind.HEX, Hex(0xFF, 1))
+        assert compare_datum(h, Datum.bytes_(b"\xff")) == 0

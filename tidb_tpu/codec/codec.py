@@ -80,6 +80,12 @@ def encode_datum(buf: bytearray, d: Datum, comparable: bool) -> None:
     elif k == Kind.TIME:
         buf.append(TIME_FLAG)
         num.encode_u64(buf, d.val.to_packed_int())
+    elif k in (Kind.ENUM, Kind.SET, Kind.BIT):
+        # flatten to the uint value (types.Flatten); the column FieldType
+        # restores the rich object on read (convert.unflatten_datum)
+        encode_datum(buf, Datum(Kind.UINT64, d.val.value), comparable)
+    elif k == Kind.HEX:
+        encode_datum(buf, Datum(Kind.INT64, d.val.value), comparable)
     else:
         raise ValueError(f"cannot encode datum kind {k!r}")
 

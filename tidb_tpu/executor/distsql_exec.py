@@ -190,7 +190,7 @@ class XSelectIndexExec(Executor):
             ft = col_info.field_type
             pb_cols.append(PBColumnInfo(
                 column_id=col_info.id, tp=ft.tp, flag=ft.flag, flen=ft.flen,
-                decimal=ft.decimal))
+                decimal=ft.decimal, elems=list(ft.elems)))
         pk = info.pk_handle_column()
         pk_in_schema = pk is not None and any(
             c.col_id == pk.id for c in scan.schema)

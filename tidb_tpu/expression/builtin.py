@@ -911,3 +911,283 @@ def _datediff(args, row):
     if a is None or b is None:
         return NULL
     return Datum.i64((a.dt.date() - b.dt.date()).days)
+
+
+# ---- round-4 breadth: remaining reference-registry functions ----
+# (evaluator/builtin.go Funcs rows not yet covered above)
+
+@register("curtime", 0, 1)
+@register("current_time", 0, 1)
+def _curtime(args, row):
+    from tidb_tpu.types.time_types import Duration
+    t = _now_time().dt
+    nanos = (t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000_000
+    return Datum(Kind.DURATION, Duration(nanos, 0))
+
+
+@register("utc_date", 0, 0)
+def _utc_date(args, row):
+    import datetime as _dt
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.types.time_types import Time
+    now = _dt.datetime.now(_dt.timezone.utc).replace(
+        hour=0, minute=0, second=0, microsecond=0, tzinfo=None)
+    return Datum(Kind.TIME, Time(now, my.TypeDate, 0))
+
+
+@register("time", 1, 1)
+def _time_fn(args, row):
+    """TIME(expr): the time part, as a Duration (builtin_time.go)."""
+    from tidb_tpu.types.time_types import Duration, parse_duration
+    d = args[0].eval(row)
+    if d.is_null():
+        return NULL
+    if d.kind == Kind.DURATION:
+        return d
+    if d.kind in (Kind.STRING, Kind.BYTES):
+        # bare clock strings are durations; full datetimes fall through
+        try:
+            return Datum(Kind.DURATION, parse_duration(d.get_string()))
+        except errors.TiDBError:
+            pass
+    t = _as_time(d)
+    if t is None:
+        return NULL
+    nanos = ((t.dt.hour * 3600 + t.dt.minute * 60 + t.dt.second)
+             * 1_000_000_000 + t.dt.microsecond * 1000)
+    return Datum(Kind.DURATION, Duration(nanos,
+                                         6 if t.dt.microsecond else 0))
+
+
+_DAY_NAMES = ("Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+              "Saturday", "Sunday")
+_MONTH_NAMES = ("January", "February", "March", "April", "May", "June",
+                "July", "August", "September", "October", "November",
+                "December")
+
+
+@register("dayname", 1, 1)
+def _dayname(args, row):
+    t = _as_time(args[0].eval(row))
+    return NULL if t is None else Datum.string(_DAY_NAMES[t.dt.weekday()])
+
+
+@register("monthname", 1, 1)
+def _monthname(args, row):
+    t = _as_time(args[0].eval(row))
+    if t is None or t.dt.month == 0:
+        return NULL
+    return Datum.string(_MONTH_NAMES[t.dt.month - 1])
+
+
+@register("weekofyear", 1, 1)
+def _weekofyear(args, row):
+    """WEEKOFYEAR(d) = WEEK(d, 3): ISO-8601 week."""
+    t = _as_time(args[0].eval(row))
+    return NULL if t is None else Datum.i64(t.dt.isocalendar()[1])
+
+
+@register("yearweek", 1, 2)
+def _yearweek(args, row):
+    t = _as_time(args[0].eval(row))
+    if t is None:
+        return NULL
+    mode = 0
+    if len(args) > 1:
+        md = args[1].eval(row)
+        if not md.is_null():
+            mode = int(md.get_int())
+    if mode % 2:
+        iso = t.dt.isocalendar()
+        return Datum.i64(iso[0] * 100 + iso[1])
+    # Sunday-based %U with the year of that week's Sunday
+    wk = int(t.dt.strftime("%U"))
+    yr = t.dt.year
+    if wk == 0:
+        import datetime as _dt
+        prev = t.dt.replace(month=1, day=1) - _dt.timedelta(days=1)
+        return Datum.i64(prev.year * 100 + int(prev.strftime("%U")))
+    return Datum.i64(yr * 100 + wk)
+
+
+@register("from_unixtime", 1, 2)
+def _from_unixtime(args, row):
+    import datetime as _dt
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.types.time_types import Time
+    d = args[0].eval(row)
+    if d.is_null():
+        return NULL
+    try:
+        ts = float(d.get_string()) if d.kind in (Kind.STRING, Kind.BYTES) \
+            else (float(d.val) if d.kind in (Kind.FLOAT64, Kind.DECIMAL)
+                  else float(d.get_int()))
+    except (ValueError, errors.TiDBError):
+        return NULL
+    if ts < 0:
+        return NULL
+    try:
+        t = Time(_dt.datetime.fromtimestamp(ts), my.TypeDatetime,
+                 6 if ts % 1 else 0)
+    except (OSError, OverflowError, ValueError):
+        return NULL   # out of the platform epoch range (MySQL: NULL)
+    if len(args) > 1:
+        fmt = args[1].eval(row)
+        if fmt.is_null():
+            return NULL
+        return Datum.string(_mysql_strftime(t.dt, fmt.get_string()))
+    return Datum(Kind.TIME, t)
+
+
+# MySQL DATE_FORMAT specifiers → computed fields (builtin_time.go
+# mysqlTimeFormat; %x/%v ISO pair, %X/%U Sunday pair)
+def _mysql_strftime(dt, fmt: str) -> str:
+    out = []
+    i, n = 0, len(fmt)
+    while i < n:
+        c = fmt[i]
+        if c != "%" or i + 1 >= n:
+            out.append(c)
+            i += 1
+            continue
+        s = fmt[i + 1]
+        i += 2
+        if s == "Y":
+            out.append(f"{dt.year:04d}")
+        elif s == "y":
+            out.append(f"{dt.year % 100:02d}")
+        elif s == "m":
+            out.append(f"{dt.month:02d}")
+        elif s == "c":
+            out.append(str(dt.month))
+        elif s == "M":
+            out.append(_MONTH_NAMES[dt.month - 1] if dt.month else "")
+        elif s == "b":
+            out.append(_MONTH_NAMES[dt.month - 1][:3] if dt.month else "")
+        elif s == "d":
+            out.append(f"{dt.day:02d}")
+        elif s == "e":
+            out.append(str(dt.day))
+        elif s == "D":
+            d = dt.day
+            sfx = "th" if 11 <= d % 100 <= 13 else \
+                {1: "st", 2: "nd", 3: "rd"}.get(d % 10, "th")
+            out.append(f"{d}{sfx}")
+        elif s == "j":
+            out.append(f"{dt.timetuple().tm_yday:03d}")
+        elif s == "H":
+            out.append(f"{dt.hour:02d}")
+        elif s == "k":
+            out.append(str(dt.hour))
+        elif s in ("h", "I"):
+            out.append(f"{(dt.hour % 12) or 12:02d}")
+        elif s == "l":
+            out.append(str((dt.hour % 12) or 12))
+        elif s == "i":
+            out.append(f"{dt.minute:02d}")
+        elif s in ("s", "S"):
+            out.append(f"{dt.second:02d}")
+        elif s == "f":
+            out.append(f"{dt.microsecond:06d}")
+        elif s == "p":
+            out.append("AM" if dt.hour < 12 else "PM")
+        elif s == "r":
+            h = (dt.hour % 12) or 12
+            ap = "AM" if dt.hour < 12 else "PM"
+            out.append(f"{h:02d}:{dt.minute:02d}:{dt.second:02d} {ap}")
+        elif s == "T":
+            out.append(f"{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}")
+        elif s == "W":
+            out.append(_DAY_NAMES[dt.weekday()])
+        elif s == "a":
+            out.append(_DAY_NAMES[dt.weekday()][:3])
+        elif s == "w":
+            out.append(str((dt.weekday() + 1) % 7))
+        elif s in ("U", "X"):
+            out.append(f"{int(dt.strftime('%U')):02d}" if s == "U"
+                       else f"{dt.year:04d}")
+        elif s in ("v", "x"):
+            iso = dt.isocalendar()
+            out.append(f"{iso[1]:02d}" if s == "v" else f"{iso[0]:04d}")
+        elif s == "%":
+            out.append("%")
+        else:
+            out.append(s)   # unknown specifier: literal char (MySQL)
+    return "".join(out)
+
+
+@register("date_format", 2, 2)
+def _date_format(args, row):
+    t = _as_time(args[0].eval(row))
+    if t is None:
+        return NULL
+    fmt = args[1].eval(row)
+    if fmt.is_null():
+        return NULL
+    return Datum.string(_mysql_strftime(t.dt, fmt.get_string()))
+
+
+@register("substring_index", 3, 3)
+def _substring_index(args, row):
+    vs = _vals(args, row)
+    if any(v.is_null() for v in vs):
+        return NULL
+    s, delim = vs[0].get_string(), vs[1].get_string()
+    count = int(vs[2].get_int())
+    if not delim:
+        return Datum.string("")
+    parts = s.split(delim)
+    if count > 0:
+        return Datum.string(delim.join(parts[:count]))
+    if count < 0:
+        return Datum.string(delim.join(parts[count:]))
+    return Datum.string("")
+
+
+def _regexp_match(args, row) -> bool | None:
+    import re as _re
+    vs = _vals(args, row)
+    if any(v.is_null() for v in vs):
+        return None
+    try:
+        return _re.search(vs[1].get_string(), vs[0].get_string()) is not None
+    except _re.error as e:
+        raise errors.ExecError(f"invalid regexp: {e}")
+
+
+@register("regexp", 2, 2)
+def _regexp(args, row):
+    m = _regexp_match(args, row)
+    return NULL if m is None else xops.bool_datum(m)
+
+
+@register("not_regexp", 2, 2)
+def _not_regexp(args, row):
+    m = _regexp_match(args, row)
+    return NULL if m is None else xops.bool_datum(not m)
+
+
+# ---- misc utility (evaluator/builtin_other.go: advisory no-ops) ----
+
+@register("sleep", 1, 1)
+def _sleep(args, row):
+    d = args[0].eval(row)
+    if not d.is_null():
+        try:
+            _time.sleep(min(max(float(d.get_string()
+                                      if d.kind in (Kind.STRING, Kind.BYTES)
+                                      else d.val), 0.0), 5.0))
+        except (TypeError, ValueError):
+            pass
+    return Datum.i64(0)
+
+
+@register("get_lock", 2, 2)
+def _get_lock(args, row):
+    # single-process advisory lock: always granted (builtin_other.go)
+    return Datum.i64(1)
+
+
+@register("release_lock", 1, 1)
+def _release_lock(args, row):
+    return Datum.i64(1)

@@ -268,6 +268,8 @@ def _datum_to_str(d: Datum) -> str:
     if d.kind == Kind.FLOAT64:
         v = d.val
         return str(int(v)) if v == int(v) else repr(v)
+    if d.kind in (Kind.ENUM, Kind.SET, Kind.BIT, Kind.HEX):
+        return d.get_string()   # enum/set names; bit/hex binary string
     return str(d.val)
 
 

@@ -27,6 +27,7 @@ PARAM = "param"
 OP = "op"          # punctuation/operators; value is the literal text
 KEYWORD = "kw"     # upper-cased keyword
 HEX = "hex"
+BIT = "bit"
 USER_VAR = "uservar"
 SYS_VAR = "sysvar"
 
@@ -46,7 +47,7 @@ BLOB TINYBLOB MEDIUMBLOB LONGBLOB DATE TIME DATETIME TIMESTAMP YEAR BIT
 UNSIGNED SIGNED ZEROFILL ENUM CHARACTER COLLATE CHARSET ENGINE ANALYZE
 PREPARE EXECUTE DEALLOCATE GRANT REVOKE IDENTIFIED TO PRIVILEGES WITH
 LOAD DATA LOCAL INFILE FIELDS TERMINATED ENCLOSED ESCAPED LINES STARTING
-KILL FLUSH
+KILL FLUSH REGEXP RLIKE
 """.split())
 
 _MULTI_OPS = ("<=>", "<<", ">>", "<=", ">=", "!=", "<>", "||", "&&", ":=")
@@ -110,29 +111,49 @@ def tokenize(sql: str) -> list[Token]:
             toks.append(Token(IDENT, "".join(buf), i))
             i = j + 1
             continue
-        # numbers (incl. 0x hex integer literals)
+        # hex integer literals 0xNN (HEX token: dual string/number nature,
+        # util/types/hex.go)
         if c == "0" and sql[i : i + 2] in ("0x", "0X") and i + 2 < n \
                 and sql[i + 2] in "0123456789abcdefABCDEF":
             j = i + 2
             while j < n and sql[j] in "0123456789abcdefABCDEF":
                 j += 1
-            toks.append(Token(INT, int(sql[i + 2 : j], 16), i))
+            toks.append(Token(HEX, sql[i + 2 : j], i))
             i = j
+            continue
+        # bit literals 0bNN / b'0101' (util/types/bit.go ParseBit)
+        if c == "0" and sql[i : i + 2] in ("0b", "0B") and i + 2 < n \
+                and sql[i + 2] in "01":
+            j = i + 2
+            while j < n and sql[j] in "01":
+                j += 1
+            toks.append(Token(BIT, sql[i + 2 : j], i))
+            i = j
+            continue
+        if c in "bB" and sql[i + 1 : i + 2] == "'":
+            j = sql.find("'", i + 2)
+            if j < 0:
+                raise errors.ParseError("unterminated bit literal")
+            digits = sql[i + 2 : j]
+            if any(ch not in "01" for ch in digits):
+                raise errors.ParseError(f"invalid bit literal at {i}")
+            toks.append(Token(BIT, digits, i))
+            i = j + 1
             continue
         if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
             tok, i = _scan_number(sql, i)
             toks.append(tok)
             continue
-        # hex literal 0x / x''
+        # hex literal x'4142' (even digit count; token value is the int)
         if c in "xX" and sql[i : i + 2] in ("x'", "X'"):
             j = sql.find("'", i + 2)
             if j < 0:
                 raise errors.ParseError("unterminated hex literal")
-            try:
-                val = bytes.fromhex(sql[i + 2 : j])
-            except ValueError as e:
-                raise errors.ParseError(f"invalid hex literal at {i}: {e}") from e
-            toks.append(Token(HEX, val, i))
+            digits = sql[i + 2 : j]
+            if len(digits) % 2 or any(
+                    ch not in "0123456789abcdefABCDEF" for ch in digits):
+                raise errors.ParseError(f"invalid hex literal at {i}")
+            toks.append(Token(HEX, digits, i))
             i = j + 1
             continue
         # identifiers/keywords

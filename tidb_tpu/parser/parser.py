@@ -1110,7 +1110,8 @@ class Parser:
                 return self._BP_XOR, self._binary(Op.Xor, self._BP_XOR)
             if kw == "AND" and rbp < self._BP_AND:
                 return self._BP_AND, self._binary(Op.AndAnd, self._BP_AND)
-            if kw in ("IS", "LIKE", "IN", "BETWEEN", "NOT") and rbp < self._BP_CMP:
+            if kw in ("IS", "LIKE", "IN", "BETWEEN", "NOT", "REGEXP",
+                      "RLIKE") and rbp < self._BP_CMP:
                 return self._BP_CMP, self._cmp_keyword
             if kw == "DIV" and rbp < self._BP_MUL:
                 return self._BP_MUL, self._binary(Op.IntDiv, self._BP_MUL)
@@ -1183,7 +1184,10 @@ class Parser:
             self._expect_kw("AND")
             high = self._parse_expr(self._BP_CMP)
             return ast.Between(expr=left, low=low, high=high, not_=not_)
-        self._fail("expected LIKE/IN/BETWEEN")
+        if self._try_kw("REGEXP", "RLIKE"):
+            pat = self._parse_expr(self._BP_CMP)
+            return ast.PatternRegexp(expr=left, pattern=pat, not_=not_)
+        self._fail("expected LIKE/IN/BETWEEN/REGEXP")
 
     def _parse_prefix(self) -> ast.ExprNode:
         t = self._cur()
@@ -1195,8 +1199,25 @@ class Parser:
             self.pos += 1
             return ast.Literal(Datum.dec(t.val))
         if t.tp == lx.HEX:
+            # token value is the digit string; written length decides the
+            # byte width (x'0041' keeps its zero byte, x'' is empty)
             self.pos += 1
-            return ast.Literal(Datum.bytes_(t.val))
+            from tidb_tpu.types.datum import Kind as _K
+            from tidb_tpu.types.enumset import Hex
+            digits = t.val
+            return ast.Literal(Datum(_K.HEX, Hex(
+                int(digits, 16) if digits else 0, (len(digits) + 1) // 2)))
+        if t.tp == lx.BIT:
+            self.pos += 1
+            from tidb_tpu import errors as _errs
+            from tidb_tpu.types.datum import Kind as _K
+            from tidb_tpu.types.enumset import Bit, parse_bit
+            try:
+                b = parse_bit(f"b'{t.val}'" if t.val else "b'0'",
+                              Bit.UNSPECIFIED_WIDTH)
+            except _errs.TiDBError as e:
+                self._fail(str(e))
+            return ast.Literal(Datum(_K.BIT, b))
         if t.tp == lx.PARAM:
             self.pos += 1
             pm = ast.ParamMarker(order=len(self.param_markers))

@@ -821,6 +821,10 @@ class PlanBuilder:
                 name = "not_like" if n.not_ else "like"
                 return ScalarFunction(name, args,
                                       new_field_type(my.TypeLonglong))
+            if isinstance(n, ast.PatternRegexp):
+                name = "not_regexp" if n.not_ else "regexp"
+                return ScalarFunction(name, [rw(n.expr), rw(n.pattern)],
+                                      new_field_type(my.TypeLonglong))
             if isinstance(n, ast.IsNull):
                 name = "is_not_null" if n.not_ else "isnull"
                 return ScalarFunction(name, [rw(n.expr)],

@@ -51,11 +51,21 @@ def expr_to_pb(client, expr: Expression, req_type: int) -> proto.Expr | None:
 
 
 def _convert(expr: Expression) -> proto.Expr | None:
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.types.datum import Kind
+
     if isinstance(expr, Constant):
+        if expr.value.kind in (Kind.ENUM, Kind.SET, Kind.BIT, Kind.HEX):
+            return None  # dual string/number literals stay SQL-side
         return proto.expr_value(expr.value)
     if isinstance(expr, Column):
         if expr.is_agg or expr.col_id <= 0:
             return None  # not a storage column → can't cross the boundary
+        if expr.ret_type.tp in (my.TypeEnum, my.TypeSet, my.TypeBit):
+            # storage holds the flattened uint; the coprocessor would
+            # compare numbers where SQL compares item NAMES — these
+            # columns evaluate after unflatten, on the SQL side
+            return None
         return proto.expr_column(expr.col_id)
     if isinstance(expr, ScalarFunction):
         children = []

@@ -127,6 +127,28 @@ def _intersect_ranges(a: list[TableRange], b: list[TableRange]) -> list[TableRan
 
 # ---- index ranges ----
 
+def _coerce_index_datum(col: Column, v: Datum, op: Op) -> Datum | None:
+    """Index keys store enum/set/bit columns FLATTENED (their uint value);
+    coerce the comparison constant to the column type so the encoded range
+    bound matches the stored key bytes (refiner.go buildIndexRange →
+    types.Convert). None = no usable key range: the constant is outside
+    the column domain, or the operator's SQL ordering (enum/set compare
+    by NAME against strings) diverges from the flattened key order —
+    those conditions stay SQL-side filters. BIT's byte order equals its
+    numeric order, so its inequalities remain range-able."""
+    from tidb_tpu import mysqldef as my
+    if col.ret_type.tp in (my.TypeEnum, my.TypeSet, my.TypeBit):
+        if op != Op.EQ and col.ret_type.tp != my.TypeBit:
+            return None
+        from tidb_tpu import errors
+        from tidb_tpu.types.convert import convert_datum
+        try:
+            return convert_datum(v, col.ret_type)
+        except errors.TiDBError:
+            return None
+    return v
+
+
 def _col_cmp_any_const(cond: Expression, col: Column):
     """Like _col_cmp_const but for any constant datum type."""
     if not isinstance(cond, ScalarFunction) or cond.op is None:
@@ -137,12 +159,14 @@ def _col_cmp_any_const(cond: Expression, col: Column):
     a, b = cond.args
     if isinstance(a, Column) and a.equal(col) and isinstance(b, Constant) \
             and not b.value.is_null():
-        return op, b.value
+        v = _coerce_index_datum(col, b.value, op)
+        return None if v is None else (op, v)
     if isinstance(b, Column) and b.equal(col) and isinstance(a, Constant) \
             and not a.value.is_null():
         flipped = {Op.LT: Op.GT, Op.LE: Op.GE, Op.GT: Op.LT, Op.GE: Op.LE,
                    Op.EQ: Op.EQ}
-        return flipped[op], a.value
+        v = _coerce_index_datum(col, a.value, flipped[op])
+        return None if v is None else (flipped[op], v)
     return None
 
 

@@ -125,6 +125,16 @@ class PatternLike(ExprNode):
 
 
 @dataclass
+class PatternRegexp(ExprNode):
+    """expr REGEXP/RLIKE pattern (ast.PatternRegexpExpr,
+    evaluator/evaluator_like.go:165 patternRegexp)."""
+    expr: ExprNode
+    pattern: ExprNode
+    not_: bool = False
+    ftype: Any = None
+
+
+@dataclass
 class IsNull(ExprNode):
     expr: ExprNode
     not_: bool = False

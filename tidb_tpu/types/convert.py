@@ -59,7 +59,41 @@ def convert_datum(d: Datum, ft: FieldType) -> Datum:
     if tp == my.TypeDuration:
         return Datum(Kind.DURATION, _to_duration(d, ft.decimal if ft.decimal >= 0 else 0))
     if tp == my.TypeBit:
-        return _to_int(d, ft)
+        from tidb_tpu.types.enumset import Bit
+        width = ft.flen if ft.flen and ft.flen > 0 else 1
+        if d.kind == Kind.BIT:
+            v = d.val.value
+        elif d.kind in (Kind.STRING, Kind.BYTES):
+            s = d.get_string()
+            try:
+                from tidb_tpu.types.enumset import parse_bit
+                return Datum(Kind.BIT, parse_bit(s, width))
+            except errors.TiDBError:
+                v = int(_to_int(d, ft).val)
+        else:
+            v = int(_to_int(d, ft).val)
+        if width < 64 and v >= (1 << width):
+            raise errors.OverflowError_(
+                f"value {v} does not fit BIT({width})")
+        return Datum(Kind.BIT, Bit(v, width))
+    if tp == my.TypeEnum:
+        from tidb_tpu.types import enumset as es
+        if d.kind == Kind.ENUM:
+            return d
+        if d.kind in (Kind.STRING, Kind.BYTES):
+            return Datum(Kind.ENUM, es.parse_enum_name(ft.elems,
+                                                       d.get_string()))
+        n = d.as_number()
+        return Datum(Kind.ENUM, es.parse_enum_value(ft.elems, int(n)))
+    if tp == my.TypeSet:
+        from tidb_tpu.types import enumset as es
+        if d.kind == Kind.SET:
+            return d
+        if d.kind in (Kind.STRING, Kind.BYTES):
+            return Datum(Kind.SET, es.parse_set_name(ft.elems,
+                                                     d.get_string()))
+        n = d.as_number()
+        return Datum(Kind.SET, es.parse_set_value(ft.elems, int(n)))
     if tp == my.TypeNull:
         return NULL
     raise errors.TypeError_(f"unsupported conversion target type 0x{tp:02x}")
@@ -85,6 +119,8 @@ def _to_int(d: Datum, ft: FieldType) -> Datum:
         v = int(round(d.val.to_number()))
     elif k == Kind.DURATION:
         v = int(round(d.val.to_number()))
+    elif k in (Kind.ENUM, Kind.SET, Kind.BIT, Kind.HEX):
+        v = d.val.value
     else:
         raise errors.TypeError_(f"cannot convert {k!r} to integer")
     if ft.is_unsigned():
@@ -132,6 +168,10 @@ def _to_string(d: Datum) -> str:
         return format(d.val, "f")
     if k in (Kind.TIME, Kind.DURATION):
         return str(d.val)
+    if k in (Kind.ENUM, Kind.SET):
+        return d.val.name
+    if k in (Kind.BIT, Kind.HEX):
+        return d.val.to_bytes().decode("utf-8", "replace")
     raise errors.TypeError_(f"cannot convert {k!r} to string")
 
 
@@ -189,6 +229,18 @@ def unflatten_datum(d: Datum, ft: FieldType) -> Datum:
             return Datum(Kind.STRING, d.val.decode("utf-8", "replace"))
     if k == Kind.INT64 and ft.is_unsigned() and ft.tp == my.TypeLonglong and d.val >= 0:
         return Datum(Kind.UINT64, d.val)
+    if k in (Kind.INT64, Kind.UINT64):
+        # enum/set/bit columns flatten to their uint value in storage;
+        # rebuild the rich object from the column metadata (types.Unflatten)
+        from tidb_tpu.types import enumset as es
+        if ft.tp == my.TypeEnum:
+            return Datum(Kind.ENUM, es.parse_enum_value(ft.elems, d.val)) \
+                if d.val else Datum(Kind.ENUM, es.Enum("", 0))
+        if ft.tp == my.TypeSet:
+            return Datum(Kind.SET, es.parse_set_value(ft.elems, d.val))
+        if ft.tp == my.TypeBit:
+            return Datum(Kind.BIT, es.Bit(
+                d.val, ft.flen if ft.flen and ft.flen > 0 else 1))
     if k == Kind.DECIMAL and ft.is_decimal() and ft.decimal >= 0:
         # restore display scale (codec canonicalizes trailing zeros)
         quantized = quantize_decimal(d.val, ft.decimal)

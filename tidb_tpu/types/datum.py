@@ -27,6 +27,10 @@ class Kind(enum.IntEnum):
     DURATION = 7
     TIME = 8
     INTERFACE = 9        # row tuples in some executors (rare)
+    ENUM = 10            # KindMysqlEnum (util/types/enum.go)
+    SET = 11             # KindMysqlSet (util/types/set.go)
+    BIT = 12             # KindMysqlBit (util/types/bit.go)
+    HEX = 13             # KindMysqlHex (util/types/hex.go)
     MIN_NOT_NULL = 100   # range boundary sentinels (util/types/datum.go KindMinNotNull)
     MAX_VALUE = 101
 
@@ -95,6 +99,10 @@ class Datum:
             return self.val
         if self.kind == Kind.BYTES:
             return self.val.decode("utf-8", "replace")
+        if self.kind in (Kind.ENUM, Kind.SET):
+            return self.val.name
+        if self.kind in (Kind.BIT, Kind.HEX):
+            return self.val.to_bytes().decode("utf-8", "replace")
         raise errors.TypeError_(f"datum kind {self.kind!r} is not a string")
 
     def get_bytes(self) -> bytes:
@@ -102,6 +110,10 @@ class Datum:
             return self.val
         if self.kind == Kind.STRING:
             return self.val.encode("utf-8")
+        if self.kind in (Kind.BIT, Kind.HEX):
+            return self.val.to_bytes()
+        if self.kind in (Kind.ENUM, Kind.SET):
+            return self.val.name.encode("utf-8")
         raise errors.TypeError_(f"datum kind {self.kind!r} is not bytes")
 
     # ---- numeric view used by comparison/arith coercion ----
@@ -122,6 +134,8 @@ class Datum:
             return self.val.to_number()
         if k == Kind.TIME:
             return self.val.to_number()
+        if k in (Kind.ENUM, Kind.SET, Kind.BIT, Kind.HEX):
+            return self.val.value   # exact int (enum index / bitmask)
         raise errors.TypeError_(f"cannot coerce {k!r} to number")
 
     def __repr__(self):  # pragma: no cover - debug aid
@@ -187,6 +201,15 @@ def datum_from_py(v: Any) -> Datum:
     from tidb_tpu.types.time_types import Duration, Time
     if isinstance(v, (Duration, Time)):
         return Datum(Kind.DURATION if isinstance(v, Duration) else Kind.TIME, v)
+    from tidb_tpu.types.enumset import Bit, Enum, Hex, SetVal
+    if isinstance(v, Enum):
+        return Datum(Kind.ENUM, v)
+    if isinstance(v, SetVal):
+        return Datum(Kind.SET, v)
+    if isinstance(v, Bit):
+        return Datum(Kind.BIT, v)
+    if isinstance(v, Hex):
+        return Datum(Kind.HEX, v)
     raise errors.TypeError_(f"cannot make datum from {type(v)!r}")
 
 
@@ -250,6 +273,16 @@ def compare_datum(a: Datum, b: Datum) -> int:
         t = _parse_time_or_none(a.get_string())
         if t is not None:
             return -b.val.compare(t)
+
+    # enum/set/bit/hex vs string: string semantics (enum compares by item
+    # NAME against strings, by index against numbers — MySQL's dual nature;
+    # util/types/compare.go coerce rules)
+    _ESBH = (Kind.ENUM, Kind.SET, Kind.BIT, Kind.HEX)
+    if (ak in _ESBH and bk in (Kind.STRING, Kind.BYTES)) or \
+            (bk in _ESBH and ak in (Kind.STRING, Kind.BYTES)):
+        # raw bytes both sides: bit/hex are BINARY strings (0xFF = CHAR(255))
+        x, y = a.get_bytes(), b.get_bytes()
+        return -1 if x < y else (0 if x == y else 1)
 
     x, y = a.as_number(), b.as_number()
     return _cmp_num(x, y)

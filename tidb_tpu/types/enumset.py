@@ -1,0 +1,233 @@
+"""ENUM / SET / BIT / HEX value semantics.
+
+Reference: util/types/enum.go (Enum, ParseEnumName/Value), set.go
+(Set, ParseSetName/Value), bit.go (Bit, ParseBit), hex.go (Hex, ParseHex).
+
+Storage model follows the reference's flatten/unflatten contract
+(tablecodec + types.Flatten): these values travel the codec as plain
+uint64/int64 (their .value), and the column's FieldType (elems / flen)
+restores the rich object on read — so the memcomparable wire format and
+the native C codec stay untouched.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import errors
+
+
+class Enum:
+    """One item of an ENUM('a','b',…) column: name + 1-based index.
+    Sorts and computes numerically by index; displays as its name."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str, value: int):
+        self.name = name
+        self.value = int(value)
+
+    def to_number(self) -> float:
+        return float(self.value)
+
+    def __str__(self):
+        return self.name
+
+    def __repr__(self):  # pragma: no cover
+        return f"Enum({self.name!r}, {self.value})"
+
+    def __eq__(self, other):
+        return isinstance(other, Enum) and self.value == other.value \
+            and self.name == other.name
+
+    def __hash__(self):
+        return hash((self.name, self.value))
+
+
+class SetVal:
+    """A SET('a','b',…) value: comma-joined member names + bitmask over
+    the column's element list (bit i ↔ elems[i])."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str, value: int):
+        self.name = name
+        self.value = int(value)
+
+    def to_number(self) -> float:
+        return float(self.value)
+
+    def __str__(self):
+        return self.name
+
+    def __repr__(self):  # pragma: no cover
+        return f"SetVal({self.name!r}, 0b{self.value:b})"
+
+    def __eq__(self, other):
+        return isinstance(other, SetVal) and self.value == other.value
+
+    def __hash__(self):
+        return hash(("set", self.value))
+
+
+class Bit:
+    """BIT(width) value: unsigned integer with a display width. Numeric
+    contexts use the integer; string contexts use the big-endian bytes
+    (MySQL returns bit columns as binary strings)."""
+
+    __slots__ = ("value", "width")
+
+    MIN_WIDTH = 1
+    MAX_WIDTH = 64
+    UNSPECIFIED_WIDTH = -1
+
+    def __init__(self, value: int, width: int):
+        self.value = int(value)
+        self.width = width
+
+    def to_number(self) -> float:
+        return float(self.value)
+
+    def to_bytes(self) -> bytes:
+        nbytes = max((self.width + 7) // 8, 1)
+        return self.value.to_bytes(nbytes, "big")
+
+    def __str__(self):
+        return f"0b{self.value:0{max(self.width, 1)}b}"
+
+    def __repr__(self):  # pragma: no cover
+        return f"Bit({self})"
+
+    def __eq__(self, other):
+        return isinstance(other, Bit) and self.value == other.value
+
+    def __hash__(self):
+        return hash(("bit", self.value))
+
+
+class Hex:
+    """Hexadecimal literal (0x61, x'61', X'61'): integer in numeric
+    contexts, the decoded bytes in string contexts — the dual nature MySQL
+    defers until the literal meets an operator. `nbytes` preserves the
+    literal's written byte length so x'0041' keeps its leading zero byte
+    (and x'' stays empty) in string contexts."""
+
+    __slots__ = ("value", "nbytes")
+
+    def __init__(self, value: int, nbytes: int | None = None):
+        self.value = int(value)
+        self.nbytes = nbytes
+
+    def to_number(self) -> float:
+        return float(self.value)
+
+    def to_bytes(self) -> bytes:
+        if self.nbytes is not None:
+            return self.value.to_bytes(self.nbytes, "big") if self.nbytes \
+                else b""
+        s = f"{self.value:x}"
+        if len(s) % 2:
+            s = "0" + s
+        return bytes.fromhex(s)
+
+    def __str__(self):
+        s = f"{self.value:X}"
+        return "0x0" + s if len(s) % 2 else "0x" + s
+
+    def __repr__(self):  # pragma: no cover
+        return f"Hex({self})"
+
+    def __eq__(self, other):
+        return isinstance(other, Hex) and self.value == other.value
+
+    def __hash__(self):
+        return hash(("hex", self.value))
+
+
+# ---------------------------------------------------------------------------
+# parsing (ParseEnumName/Value, ParseSetName/Value, ParseBit, ParseHex)
+# ---------------------------------------------------------------------------
+
+def parse_enum_name(elems: list[str], name: str) -> Enum:
+    for i, n in enumerate(elems):
+        if n.lower() == name.lower():
+            return Enum(n, i + 1)
+    # not an item name — maybe a number in string form
+    try:
+        return parse_enum_value(elems, int(name, 0))
+    except ValueError:
+        pass
+    raise errors.TypeError_(f"item {name!r} is not in enum {elems}")
+
+
+def parse_enum_value(elems: list[str], number: int) -> Enum:
+    if number == 0 or number > len(elems):
+        raise errors.TypeError_(
+            f"number {number} overflows enum boundary [1, {len(elems)}]")
+    return Enum(elems[number - 1], number)
+
+
+def parse_set_name(elems: list[str], name: str) -> SetVal:
+    if not name:
+        return SetVal("", 0)
+    marked = {s.lower() for s in name.split(",")}
+    items, value = [], 0
+    for i, n in enumerate(elems):
+        if n.lower() in marked:
+            marked.discard(n.lower())
+            value |= 1 << i
+            items.append(n)
+    if not marked:
+        return SetVal(",".join(items), value)
+    try:
+        return parse_set_value(elems, int(name, 0))
+    except ValueError:
+        pass
+    raise errors.TypeError_(f"item {name!r} is not in set {elems}")
+
+
+def parse_set_value(elems: list[str], number: int) -> SetVal:
+    if number >= (1 << len(elems)):
+        raise errors.TypeError_(
+            f"number {number} overflows set {elems}")
+    items = [n for i, n in enumerate(elems) if number & (1 << i)]
+    return SetVal(",".join(items), number)
+
+
+def parse_bit(s: str, width: int) -> Bit:
+    """b'0101' / B'0101' / 0b0101 → Bit. width == UNSPECIFIED_WIDTH pads
+    to the next byte (reference bit.go ParseBit)."""
+    raw = s
+    if s and s[0] in "bB" and len(s) > 1 and s[1] == "'":
+        s = s[1:].strip("'")
+    elif s[:2] in ("0b", "0B"):
+        s = s[2:]
+    else:
+        raise errors.TypeError_(f"invalid bit literal {raw!r}")
+    if not s or any(c not in "01" for c in s):
+        raise errors.TypeError_(f"invalid bit literal {raw!r}")
+    if width == Bit.UNSPECIFIED_WIDTH:
+        width = (len(s) + 7) & ~7
+    width = max(width, Bit.MIN_WIDTH)
+    if width > Bit.MAX_WIDTH or len(s) > width:
+        raise errors.TypeError_(
+            f"bit literal {raw!r} does not fit BIT({width})")
+    return Bit(int(s, 2), width)
+
+
+def parse_hex(s: str) -> Hex:
+    """x'1A' / X'1A' / 0x1A → Hex (reference hex.go ParseHex)."""
+    raw = s
+    if s and s[0] in "xX" and len(s) > 1 and s[1] == "'":
+        s = s[1:].strip("'")
+        if len(s) % 2:
+            raise errors.TypeError_(
+                f"hex literal {raw!r} must have an even number of digits")
+    elif s[:2] in ("0x", "0X"):
+        s = s[2:]
+    else:
+        raise errors.TypeError_(f"invalid hex literal {raw!r}")
+    if not s:
+        return Hex(0, 0)
+    try:
+        return Hex(int(s, 16), (len(s) + 1) // 2)
+    except ValueError:
+        raise errors.TypeError_(f"invalid hex literal {raw!r}")

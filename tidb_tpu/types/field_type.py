@@ -72,7 +72,13 @@ class FieldType:
             my.TypeNull: "null", my.TypeEnum: "enum", my.TypeSet: "set",
         }
         s = names.get(self.tp, f"type({self.tp})")
-        if self.flen >= 0 and self.tp in (my.TypeVarchar, my.TypeString, my.TypeNewDecimal):
+        if self.tp in (my.TypeEnum, my.TypeSet) and self.elems:
+            items = ",".join("'" + e.replace("'", "''") + "'"
+                             for e in self.elems)
+            s += f"({items})"
+        elif self.tp == my.TypeBit and self.flen and self.flen > 0:
+            s += f"({self.flen})"
+        elif self.flen >= 0 and self.tp in (my.TypeVarchar, my.TypeString, my.TypeNewDecimal):
             if self.decimal >= 0 and self.tp == my.TypeNewDecimal:
                 s += f"({self.flen},{self.decimal})"
             else:
