@@ -1,0 +1,193 @@
+"""Charset / collation machinery (round-3 verdict missing #10).
+
+Reference: util/charset/charset.go (registry, ValidCharsetAndCollation,
+GetDefaultCollation), parser charset/collate options, executor/show.go
+charset surfaces, and *_ci collation semantics in compare / order by /
+group by — the part the reference leaves binary-only but MySQL requires.
+"""
+
+import pytest
+
+from tidb_tpu import charset as cset, errors
+from tidb_tpu.session import Session, new_store
+from tests.testkit import _store_id
+
+
+def _session():
+    return Session(new_store(f"memory://cs{next(_store_id)}"))
+
+
+class TestRegistry:
+    def test_defaults_and_validation(self):
+        assert cset.get_default_collation("utf8") == "utf8_bin"
+        assert cset.get_default_collation("UTF8MB4") == "utf8mb4_bin"
+        assert cset.valid_charset_and_collation("utf8", "utf8_general_ci")
+        assert not cset.valid_charset_and_collation("utf8", "latin1_bin")
+        assert not cset.valid_charset_and_collation("klingon", None)
+        with pytest.raises(errors.TiDBError) as ei:
+            cset.get_default_collation("klingon")
+        assert ei.value.code == 1115
+
+    def test_pair_resolution(self):
+        assert cset.validate_column_charset("latin1", None) == \
+            ("latin1", "latin1_bin")
+        assert cset.validate_column_charset(None, "utf8_general_ci") == \
+            ("utf8", "utf8_general_ci")
+        with pytest.raises(errors.TiDBError) as ei:
+            cset.validate_column_charset("ascii", "utf8_bin")
+        assert ei.value.code == 1253
+
+
+class TestDDLAndShow:
+    def test_ddl_errors(self):
+        s = _session()
+        s.execute("create database d; use d")
+        for sql, code in [
+                ("create table b1 (x varchar(3) character set klingon)", 1115),
+                ("create table b2 (x varchar(3) collate utf8_nope)", 1273),
+                ("create table b3 (x varchar(3) character set ascii "
+                 "collate utf8_bin)", 1253),
+                ("create database b4 charset klingon", 1115),
+                ("set names klingon", 1115)]:
+            with pytest.raises(errors.TiDBError) as ei:
+                s.execute(sql)
+            assert ei.value.code == code, sql
+
+    def test_table_default_inheritance(self):
+        s = _session()
+        s.execute("create database d; use d")
+        s.execute("create table t (a varchar(5), b varchar(5) collate "
+                  "utf8_bin, c int) default charset=utf8 "
+                  "collate=utf8_general_ci")
+        info = s.info_schema().table_by_name("d", "t").info
+        assert info.collate == "utf8_general_ci"
+        cols = {c.name: c.field_type for c in info.columns}
+        assert cols["a"].collate == "utf8_general_ci"   # inherited
+        assert cols["b"].collate == "utf8_bin"          # explicit wins
+        assert cols["c"].collate != "" or True          # non-string: n/a
+        out = s.execute("show create table t")[0].values()[0][1]
+        assert "DEFAULT CHARSET=utf8 COLLATE=utf8_general_ci" in out
+        assert "`b` varchar(5) CHARACTER SET utf8 COLLATE utf8_bin" in out
+
+    def test_show_and_information_schema(self):
+        s = _session()
+        charsets = s.execute("show character set")[0].values()
+        assert ["utf8", "UTF-8 Unicode", "utf8_bin", "3"] in charsets
+        colls = s.execute("show collation like 'utf8%'")[0].values()
+        assert any(r[0] == "utf8_general_ci" and r[1] == "utf8" and
+                   r[2] == "33" for r in colls)
+        rows = s.execute(
+            "select collation_name, id, is_default from "
+            "information_schema.collations where character_set_name = "
+            "'utf8mb4' order by id")[0].values()
+        assert [b"utf8mb4_general_ci", 45, b""] in rows
+        assert [b"utf8mb4_bin", 46, b"Yes"] in rows
+        db = s.execute("create database mb4 charset utf8mb4")
+        got = s.execute("select default_character_set_name from "
+                        "information_schema.schemata where schema_name = "
+                        "'mb4'")[0].values()
+        assert got == [[b"utf8mb4"]]
+
+
+class TestCiSemantics:
+    @pytest.fixture
+    def s(self):
+        s = _session()
+        s.execute("create database d; use d")
+        s.execute("create table t (id bigint primary key, "
+                  "a varchar(20) collate utf8_general_ci, "
+                  "b varchar(20))")
+        s.execute("insert into t values (1,'Alpha','X'), (2,'ALPHA','x'), "
+                  "(3,'beta','y')")
+        return s
+
+    def test_ci_compare(self, s):
+        assert s.execute("select id from t where a = 'alpha' order by id")[0] \
+            .values() == [[1], [2]]
+        assert s.execute("select id from t where a != 'ALPHA' order by "
+                         "id")[0].values() == [[3]]
+        # bin column stays case-sensitive
+        assert s.execute("select id from t where b = 'X'")[0].values() == [[1]]
+
+    def test_ci_group_by(self, s):
+        got = s.execute("select count(*) from t group by a order by 1")[0] \
+            .values()
+        assert got == [[1], [2]]   # alpha-group of 2, beta-group of 1
+
+    def test_ci_order_by(self, s):
+        # casefolded order: alpha-rows (ids 1,2) before 'beta' regardless
+        # of 'ALPHA' vs 'Alpha' binary order
+        got = [r[0] for r in
+               s.execute("select id from t order by a, id")[0].values()]
+        assert got == [1, 2, 3]
+
+    def test_ci_predicates_stay_sql_side(self, s):
+        """A ci-collated column comparison must not be pushed to the
+        coprocessor (which compares binary)."""
+        from tidb_tpu.plan.plans import PhysicalTableScan
+        from tidb_tpu.plan import optimize_plan
+        from tidb_tpu.plan.builder import PlanBuilder
+        stmt = s.parser.parse_one("select id from t where a = 'alpha'")
+        plan = optimize_plan(PlanBuilder(s).build(stmt), s,
+                             s.store.get_client(), set())
+        node = plan
+        while node is not None and not isinstance(node, PhysicalTableScan):
+            node = node.children[0] if node.children else None
+        assert node is not None and node.pushed_where is None
+
+
+class TestCiReviewRepros:
+    """Round-4 review findings: ci semantics must hold on EVERY path —
+    index ranges, stream agg over index order, DISTINCT, IN/LIKE,
+    count(distinct), and database-default inheritance."""
+
+    @pytest.fixture
+    def s(self):
+        s = _session()
+        s.execute("create database d; use d")
+        s.execute("create table t (id bigint primary key, "
+                  "a varchar(20) collate utf8_general_ci)")
+        s.execute("insert into t values (1,'ALPHA'), (2,'Apple'), "
+                  "(3,'alpha')")
+        s.execute("create index ka on t (a)")
+        return s
+
+    def test_indexed_ci_equality(self, s):
+        assert s.execute("select id from t where a = 'alpha' order by id")[0] \
+            .values() == [[1], [3]]
+        assert s.execute("select id from t use index (ka) where a = 'alpha' "
+                         "order by id")[0].values() == [[1], [3]]
+
+    def test_group_by_over_index_not_split(self, s):
+        got = s.execute("select count(*) from t use index (ka) group by a "
+                        "order by 1")[0].values()
+        assert got == [[1], [2]]
+
+    def test_distinct_and_count_distinct(self, s):
+        assert len(s.execute("select distinct a from t")[0].values()) == 2
+        assert s.execute("select count(distinct a) from t")[0].values() == \
+            [[2]]
+
+    def test_in_and_like_agree_with_eq(self, s):
+        assert s.execute("select id from t where a in ('alpha') "
+                         "order by id")[0].values() == [[1], [3]]
+        assert s.execute("select id from t where a like 'alp%' "
+                         "order by id")[0].values() == [[1], [3]]
+        assert s.execute("select id from t where a not in ('alpha', 'apple')")[0] \
+            .values() == []
+
+    def test_database_default_inheritance(self):
+        s = _session()
+        s.execute("create database m4 charset utf8mb4 collate "
+                  "utf8mb4_general_ci")
+        s.execute("use m4")
+        s.execute("create table u (id bigint primary key, x varchar(5))")
+        info = s.info_schema().table_by_name("m4", "u").info
+        assert (info.charset, info.collate) == ("utf8mb4",
+                                                "utf8mb4_general_ci")
+        xft = info.find_column("x").field_type
+        assert xft.collate == "utf8mb4_general_ci"
+        # and the inherited ci semantics actually apply
+        s.execute("insert into u values (1, 'Hi'), (2, 'HI')")
+        assert s.execute("select count(*) from u where x = 'hi'")[0] \
+            .values() == [[2]]

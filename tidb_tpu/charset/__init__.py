@@ -1,0 +1,144 @@
+"""Character set / collation registry.
+
+Reference: util/charset/charset.go (Charset/Collation structs, charsetInfos
+table, ValidCharsetAndCollation :97, GetDefaultCollation :120,
+GetCharsetInfo :132, GetCollations :141) and encoding_table.go collation
+ids. The engine stores text as UTF-8 regardless of the declared charset
+(like the reference); the registry drives DDL validation, SHOW surfaces,
+information_schema, and collation-aware comparison (`*_ci` collations
+compare case-insensitively in the expression layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tidb_tpu import errors
+
+
+@dataclass
+class Collation:
+    id: int
+    charset_name: str
+    name: str
+    is_default: bool = False
+
+    @property
+    def is_ci(self) -> bool:
+        return is_ci_collation(self.name)
+
+
+@dataclass
+class Charset:
+    name: str
+    desc: str
+    maxlen: int
+    default_collation: Collation | None = None
+    collations: dict[str, Collation] = field(default_factory=dict)
+
+
+# collation ids match the MySQL table the reference vendors
+# (util/charset/charset.go collations); the subset covering the charsets
+# below, defaults matching the reference (_bin defaults, MySQL-compatible
+# ids)
+_COLLATIONS = [
+    Collation(11, "ascii", "ascii_general_ci"),
+    Collation(65, "ascii", "ascii_bin", True),
+    Collation(5, "latin1", "latin1_german1_ci"),
+    Collation(8, "latin1", "latin1_swedish_ci"),
+    Collation(47, "latin1", "latin1_bin", True),
+    Collation(33, "utf8", "utf8_general_ci"),
+    Collation(83, "utf8", "utf8_bin", True),
+    Collation(192, "utf8", "utf8_unicode_ci"),
+    Collation(45, "utf8mb4", "utf8mb4_general_ci"),
+    Collation(46, "utf8mb4", "utf8mb4_bin", True),
+    Collation(224, "utf8mb4", "utf8mb4_unicode_ci"),
+    Collation(63, "binary", "binary", True),
+]
+
+_CHARSETS = [
+    Charset("utf8", "UTF-8 Unicode", 3),
+    Charset("latin1", "cp1252 West European", 1),
+    Charset("utf8mb4", "UTF-8 Unicode", 4),
+    Charset("ascii", "US ASCII", 1),
+    Charset("binary", "Binary pseudo charset", 1),
+]
+
+CHARSETS: dict[str, Charset] = {c.name: c for c in _CHARSETS}
+COLLATIONS: dict[str, Collation] = {}
+
+for _c in _COLLATIONS:
+    COLLATIONS[_c.name] = _c
+    cs = CHARSETS.get(_c.charset_name)
+    if cs is not None:
+        cs.collations[_c.name] = _c
+        if _c.is_default:
+            cs.default_collation = _c
+
+
+def valid_charset_and_collation(cs: str, co: str | None) -> bool:
+    """util/charset/charset.go:97 ValidCharsetAndCollation."""
+    charset = CHARSETS.get(cs.lower())
+    if charset is None:
+        return False
+    if not co:
+        return True
+    return co.lower() in charset.collations
+
+
+def get_default_collation(cs: str) -> str:
+    charset = CHARSETS.get(cs.lower())
+    if charset is None or charset.default_collation is None:
+        raise errors.TiDBError(f"Unknown character set: '{cs}'", code=1115)
+    return charset.default_collation.name
+
+
+def get_charset_info(cs: str) -> tuple[str, str]:
+    """(charset, default collation) or error 1115."""
+    charset = CHARSETS.get(cs.lower())
+    if charset is None:
+        raise errors.TiDBError(f"Unknown character set: '{cs}'", code=1115)
+    return charset.name, charset.default_collation.name
+
+
+def get_collations() -> list[Collation]:
+    return list(_COLLATIONS)
+
+
+def get_all_charsets() -> list[Charset]:
+    return list(_CHARSETS)
+
+
+def validate_column_charset(charset_name: str | None,
+                            collate_name: str | None) -> tuple[str, str]:
+    """Resolve (charset, collate) for a column/table DDL option pair with
+    MySQL's error codes: 1115 unknown charset, 1273 unknown collation,
+    1253 collation/charset mismatch. Either side may be None (defaulted
+    from the other; both None → utf8/utf8_bin, the engine default)."""
+    if charset_name is None and collate_name is None:
+        return "utf8", "utf8_bin"
+    if charset_name is not None:
+        cs = CHARSETS.get(charset_name.lower())
+        if cs is None:
+            raise errors.TiDBError(
+                f"Unknown character set: '{charset_name}'", code=1115)
+        if collate_name is None:
+            return cs.name, cs.default_collation.name
+        co = COLLATIONS.get(collate_name.lower())
+        if co is None:
+            raise errors.TiDBError(
+                f"Unknown collation: '{collate_name}'", code=1273)
+        if co.charset_name != cs.name:
+            raise errors.TiDBError(
+                f"COLLATION '{co.name}' is not valid for CHARACTER SET "
+                f"'{cs.name}'", code=1253)
+        return cs.name, co.name
+    co = COLLATIONS.get(collate_name.lower())
+    if co is None:
+        raise errors.TiDBError(
+            f"Unknown collation: '{collate_name}'", code=1273)
+    return co.charset_name, co.name
+
+
+def is_ci_collation(name: str | None) -> bool:
+    return bool(name) and name.endswith("_ci")

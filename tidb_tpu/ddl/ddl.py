@@ -149,11 +149,13 @@ class DDL:
             raise errors.ExecError(
                 f"DDL is not allowed on system database '{db.name}'")
 
-    def create_schema(self, name: str) -> None:
+    def create_schema(self, name: str, charset: str = "utf8",
+                      collate: str = "utf8_bin") -> None:
         schema = self.handle.get()
         if schema.schema_exists(name):
             raise errors.DBExistsError(f"Can't create database '{name}'; database exists")
-        job = self._new_job(ActionType.CREATE_SCHEMA, 0, 0, [name])
+        job = self._new_job(ActionType.CREATE_SCHEMA, 0, 0,
+                            [name, charset, collate])
         self._run_job(job)
 
     def drop_schema(self, name: str) -> None:
@@ -166,7 +168,8 @@ class DDL:
         self._run_job(job)
 
     def create_table(self, db_name: str, table_name: str, cols: list[ColumnSpec],
-                     indexes: list[IndexSpec]) -> None:
+                     indexes: list[IndexSpec], charset: str = "utf8",
+                     collate: str = "utf8_bin") -> None:
         schema = self.handle.get()
         db = schema.schema_by_name(db_name)
         if db is None:
@@ -174,7 +177,8 @@ class DDL:
         self._check_not_virtual(db)
         if schema.table_exists(db_name, table_name):
             raise errors.TableExistsError(f"Table '{table_name}' already exists")
-        tbl_json = self._build_table_info(table_name, cols, indexes).to_json()
+        tbl_json = self._build_table_info(table_name, cols, indexes,
+                                          charset, collate).to_json()
         job = self._new_job(ActionType.CREATE_TABLE, db.id, 0, [tbl_json])
         self._run_job(job)
 
@@ -254,7 +258,8 @@ class DDL:
     # ================= table-info construction =================
 
     def _build_table_info(self, name: str, cols: list[ColumnSpec],
-                          indexes: list[IndexSpec]) -> TableInfo:
+                          indexes: list[IndexSpec], charset: str = "utf8",
+                          collate: str = "utf8_bin") -> TableInfo:
         """Reference: ddl/ddl.go buildTableInfo + buildColumnsAndConstraints."""
         seen = set()
         columns = []
@@ -266,7 +271,8 @@ class DDL:
                 id=i + 1, name=spec.name, offset=i, field_type=spec.field_type,
                 default_value=spec.default_value, has_default=spec.has_default,
                 comment=spec.comment, state=SchemaState.PUBLIC))
-        info = TableInfo(id=0, name=name, columns=columns)
+        info = TableInfo(id=0, name=name, columns=columns,
+                         charset=charset, collate=collate)
 
         offsets = {c.lower_name: c.offset for c in columns}
         idx_id = 1
@@ -484,11 +490,13 @@ class DDL:
 
     def _on_create_schema(self, txn, m: Meta, job: DDLJob) -> bool:
         name = job.args[0]
+        cs = job.args[1] if len(job.args) > 1 else "utf8"
+        co = job.args[2] if len(job.args) > 2 else "utf8_bin"
         for db in m.list_databases():
             if db.name.lower() == name.lower():
                 raise errors.DBExistsError(f"database {name} exists")
         db_id = m.gen_global_id()
-        m.create_database(DBInfo(id=db_id, name=name))
+        m.create_database(DBInfo(id=db_id, name=name, charset=cs, collate=co))
         job.schema_id = db_id
         job.state = JobState.DONE
         return True

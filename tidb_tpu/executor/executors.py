@@ -104,6 +104,27 @@ class LimitExec(Executor):
         return row
 
 
+def _expr_is_ci(e) -> bool:
+    rt = getattr(e, "ret_type", None)
+    return rt is not None and rt.is_ci_collation()
+
+
+def _group_key_datums(group_by, row):
+    """Evaluate group-by items, casefolding *_ci-collated string keys so
+    'A' and 'a' land in one group (MySQL collation grouping)."""
+    from tidb_tpu.expression.ops import casefold_datum
+    return [casefold_datum(g.eval(row)) if _expr_is_ci(g) else g.eval(row)
+            for g in group_by]
+
+
+def _sort_keys(by_items: list[SortItem], row):
+    """Per-row sort keys, *_ci keys pre-casefolded ONCE here rather than
+    inside every pairwise comparison."""
+    from tidb_tpu.expression.ops import casefold_datum
+    return [casefold_datum(it.expr.eval(row)) if _expr_is_ci(it.expr)
+            else it.expr.eval(row) for it in by_items]
+
+
 def _cmp_rows(items: list[SortItem]):
     def cmp(a, b):
         for item, ka, kb in zip(items, a[0], b[0]):
@@ -129,7 +150,7 @@ class SortExec(Executor):
             row = child.next()
             if row is None:
                 break
-            keys = [item.expr.eval(row) for item in self.by_items]
+            keys = _sort_keys(self.by_items, row)
             rows.append((keys, row, child.last_handle))
         rows.sort(key=_cmp_rows(self.by_items))
         self._sorted = rows
@@ -167,7 +188,7 @@ class TopNExec(Executor):
             row = child.next()
             if row is None:
                 break
-            keys = [item.expr.eval(row) for item in self.by_items]
+            keys = _sort_keys(self.by_items, row)
             buf.append((keys, row, child.last_handle))
             if len(buf) > 2 * limit + 64:
                 buf.sort(key=key_of)
@@ -191,14 +212,24 @@ class DistinctExec(Executor):
         self.children = [child]
         self.schema = child.schema
         self._seen: set[bytes] = set()
+        # *_ci output columns dedup casefolded ('ALPHA' ≡ 'alpha')
+        self._ci_cols = [i for i, c in enumerate(self.schema.columns)
+                         if _expr_is_ci(c)]
 
     def next(self):
+        from tidb_tpu.expression.ops import casefold_datum
         child = self.children[0]
         while True:
             row = child.next()
             if row is None:
                 return None
-            key = codec.encode_value(row)
+            if self._ci_cols:
+                kr = list(row)
+                for i in self._ci_cols:
+                    kr[i] = casefold_datum(kr[i])
+                key = codec.encode_value(kr)
+            else:
+                key = codec.encode_value(row)
             if key in self._seen:
                 continue
             self._seen.add(key)
@@ -228,7 +259,7 @@ class HashAggExec(Executor):
             return row[0].get_bytes()
         if not self.group_by:
             return b""
-        return codec.encode_value([g.eval(row) for g in self.group_by])
+        return codec.encode_value(_group_key_datums(self.group_by, row))
 
     def _materialize(self):
         child = self.children[0]
@@ -294,7 +325,7 @@ class StreamAggExec(Executor):
     def _key(self, row) -> bytes:
         if not self.group_by:
             return b""
-        return codec.encode_value([g.eval(row) for g in self.group_by])
+        return codec.encode_value(_group_key_datums(self.group_by, row))
 
     def _result_row(self):
         return [f.get_result(ctx)

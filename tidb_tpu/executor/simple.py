@@ -193,7 +193,7 @@ def _ddl(session, stmt):
 
     if isinstance(stmt, ast.CreateDatabaseStmt):
         try:
-            ddl.create_schema(stmt.name)
+            ddl.create_schema(stmt.name, stmt.charset, stmt.collate)
         except errors.DBExistsError:
             if not stmt.if_not_exists:
                 raise
@@ -206,10 +206,22 @@ def _ddl(session, stmt):
         if session.vars.current_db.lower() == stmt.name.lower():
             session.vars.current_db = ""
     elif isinstance(stmt, ast.CreateTableStmt):
+        if not stmt.charset_explicit:
+            # inherit the database default (MySQL charset inheritance:
+            # db → table → column)
+            dbinfo = session.info_schema().schema_by_name(
+                dbname(stmt.table))
+            if dbinfo is not None and (dbinfo.charset, dbinfo.collate) != \
+                    (stmt.charset, stmt.collate):
+                stmt.charset, stmt.collate = dbinfo.charset, dbinfo.collate
+                for cd in stmt.cols:
+                    if cd.tp.is_string() and not cd.charset_explicit:
+                        cd.tp.charset = stmt.charset
+                        cd.tp.collate = stmt.collate
         specs, indices = _column_specs(stmt.cols, stmt.constraints)
         try:
             ddl.create_table(dbname(stmt.table), stmt.table.name, specs,
-                             indices)
+                             indices, stmt.charset, stmt.collate)
         except errors.TableExistsError:
             if not stmt.if_not_exists:
                 raise
@@ -285,6 +297,19 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
         rows = [[n, v] for n, v in metrics.registry.snapshot()]
         return _str_rs(["Variable_name", "Value"],
                        _like_filter(rows, stmt.pattern))
+    if tp == ast.ShowType.CHARSET:
+        from tidb_tpu import charset as _cs
+        rows = [[c.name, c.desc, c.default_collation.name, str(c.maxlen)]
+                for c in _cs.get_all_charsets()]
+        return _str_rs(["Charset", "Description", "Default collation",
+                        "Maxlen"], _like_filter(rows, stmt.pattern))
+    if tp == ast.ShowType.COLLATION:
+        from tidb_tpu import charset as _cs
+        rows = [[c.name, c.charset_name, str(c.id),
+                 "Yes" if c.is_default else "", "Yes", "1"]
+                for c in _cs.get_collations()]
+        return _str_rs(["Collation", "Charset", "Id", "Default", "Compiled",
+                        "Sortlen"], _like_filter(rows, stmt.pattern))
     if tp == ast.ShowType.PROCESSLIST:
         from tidb_tpu import perfschema, privilege as pv
         from tidb_tpu.session import sessions_for
@@ -378,6 +403,9 @@ def _create_table_sql(info) -> str:
     for c in info.public_columns():
         ft = c.field_type
         s = f"  `{c.name}` {ft.compact_str()}"
+        if ft.is_string() and (ft.charset, ft.collate) != \
+                (info.charset, info.collate):
+            s += f" CHARACTER SET {ft.charset} COLLATE {ft.collate}"
         if my.has_not_null_flag(ft.flag):
             s += " NOT NULL"
         if my.has_auto_increment_flag(ft.flag):
@@ -394,7 +422,10 @@ def _create_table_sql(info) -> str:
         else:
             parts.append(f"  KEY `{idx.name}` ({cols})")
     body = ",\n".join(parts)
-    return f"CREATE TABLE `{info.name}` (\n{body}\n) ENGINE=TiDB-TPU"
+    opts = "ENGINE=TiDB-TPU"
+    if (info.charset, info.collate) != ("utf8", "utf8_bin"):
+        opts += f" DEFAULT CHARSET={info.charset} COLLATE={info.collate}"
+    return f"CREATE TABLE `{info.name}` (\n{body}\n) {opts}"
 
 
 # ---------------------------------------------------------------------------

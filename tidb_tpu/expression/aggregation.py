@@ -104,7 +104,16 @@ class AggregationFunction:
         if self.mode == AggFunctionMode.FINAL:
             self._update_final(ctx, row)
         else:
-            AGG_IMPLS[self.name](self, ctx, [a.eval(row) for a in self.args])
+            vals = [a.eval(row) for a in self.args]
+            if self.distinct and self.name in ("count", "sum", "avg"):
+                # COUNT(DISTINCT a) over a *_ci column dedups casefolded
+                # (only counting aggs: min/max must keep the original case)
+                from tidb_tpu.expression.ops import casefold_datum
+                vals = [casefold_datum(v)
+                        if getattr(a, "ret_type", None) is not None
+                        and a.ret_type.is_ci_collation() else v
+                        for a, v in zip(self.args, vals)]
+            AGG_IMPLS[self.name](self, ctx, vals)
 
     def _update_final(self, ctx: AggEvaluateContext, row: list[Datum]) -> None:
         """Merge one partial row. Arg expressions are Columns pointing at the

@@ -204,6 +204,8 @@ class ScalarFunction(Expression):
         self.op = op
         self.ret_type = ret_type or new_field_type(my.TypeNull)
 
+    _CMP_OPS = (Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE, Op.NullEQ)
+
     def eval(self, row: list[Datum]) -> Datum:
         from tidb_tpu.expression import builtin
         op = self.op
@@ -218,8 +220,35 @@ class ScalarFunction(Expression):
             if op == Op.OrOr and xops.datum_truth(a) is True:
                 return xops.TRUE
             b = self.args[1].eval(row)
+            if op in self._CMP_OPS and self._ci_compare():
+                a, b = xops.casefold_datum(a), xops.casefold_datum(b)
             return xops.compute_binary(op, a, b)
-        return builtin.call(self.func_name, self.args, row)
+        name = self.func_name
+        if self._ci_compare() and name in ("in", "not_in", "like",
+                                           "not_like"):
+            # IN and LIKE must agree with `=` on *_ci columns
+            if name in ("in", "not_in"):
+                vals = [xops.casefold_datum(a.eval(row)) for a in self.args]
+                return xops.compute_in(vals[0], vals[1:],
+                                       negated=name == "not_in")
+            esc = self.args[2].eval(row)
+            return xops.compute_like(
+                xops.casefold_datum(self.args[0].eval(row)),
+                xops.casefold_datum(self.args[1].eval(row)),
+                esc.get_string() if not esc.is_null() else "\\",
+                negated=name == "not_like")
+        return builtin.call(name, self.args, row)
+
+    def _ci_compare(self) -> bool:
+        """True when any operand is a column with a case-insensitive
+        collation (*_ci): MySQL compares such strings casefolded. Decided
+        once per expression node (collation is compile-time metadata)."""
+        ci = getattr(self, "_ci_cached", None)
+        if ci is None:
+            ci = self._ci_cached = any(
+                isinstance(arg, Column) and arg.ret_type.is_ci_collation()
+                for arg in self.args)
+        return ci
 
     def clone(self) -> "ScalarFunction":
         return ScalarFunction(self.func_name, [a.clone() for a in self.args],

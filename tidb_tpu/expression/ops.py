@@ -32,6 +32,16 @@ TRUE = Datum.i64(1)
 FALSE = Datum.i64(0)
 
 
+def casefold_datum(d: Datum) -> Datum:
+    """Casefolded copy for *_ci collation compare (string kinds only)."""
+    if d.kind == Kind.STRING:
+        return Datum.string(d.val.casefold())
+    if d.kind == Kind.BYTES:
+        return Datum.bytes_(d.val.decode("utf-8", "replace").casefold()
+                            .encode("utf-8"))
+    return d
+
+
 def bool_datum(b: bool) -> Datum:
     return TRUE if b else FALSE
 

@@ -21,6 +21,8 @@ T_SCHEMATA = -201
 T_TABLES = -202
 T_COLUMNS = -203
 T_STATISTICS = -204
+T_CHARACTER_SETS = -205
+T_COLLATIONS = -206
 
 
 def _col(i: int, name: str, tp: int = my.TypeVarchar,
@@ -56,6 +58,13 @@ def table_infos() -> list[TableInfo]:
             ("NON_UNIQUE",), ("INDEX_SCHEMA",), ("INDEX_NAME",),
             ("SEQ_IN_INDEX", my.TypeLonglong, 21), ("COLUMN_NAME",),
             ("COMMENT", my.TypeVarchar, 256)]),
+        _tbl(T_CHARACTER_SETS, "CHARACTER_SETS", [
+            ("CHARACTER_SET_NAME",), ("DEFAULT_COLLATE_NAME",),
+            ("DESCRIPTION",), ("MAXLEN", my.TypeLonglong, 21)]),
+        _tbl(T_COLLATIONS, "COLLATIONS", [
+            ("COLLATION_NAME",), ("CHARACTER_SET_NAME",),
+            ("ID", my.TypeLonglong, 21), ("IS_DEFAULT",),
+            ("IS_COMPILED",), ("SORTLEN", my.TypeLonglong, 21)]),
     ]
 
 
@@ -123,6 +132,15 @@ def rows_for(snapshot, table_id: int) -> list[list[Datum]]:
                             _s(idx.name), Datum.i64(seq + 1), _s(ic.name),
                             _s("")])
         return out
+    if table_id == T_CHARACTER_SETS:
+        from tidb_tpu import charset as cset
+        return [[_s(c.name), _s(c.default_collation.name), _s(c.desc),
+                 Datum.i64(c.maxlen)] for c in cset.get_all_charsets()]
+    if table_id == T_COLLATIONS:
+        from tidb_tpu import charset as cset
+        return [[_s(c.name), _s(c.charset_name), Datum.i64(c.id),
+                 _s("Yes" if c.is_default else ""), _s("Yes"),
+                 Datum.i64(1)] for c in cset.get_collations()]
     return []
 
 

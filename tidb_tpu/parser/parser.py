@@ -526,7 +526,25 @@ class Parser:
                                       if_not_exists=ine)
         if self._try_kw("DATABASE", "SCHEMA"):
             ine = self._parse_if_not_exists()
-            return ast.CreateDatabaseStmt(name=self._ident(), if_not_exists=ine)
+            stmt = ast.CreateDatabaseStmt(name=self._ident(),
+                                          if_not_exists=ine)
+            cs_name, co_name = None, None
+            while True:
+                self._try_kw("DEFAULT")
+                if self._try_kw("CHARSET") or (self._try_kw("CHARACTER")
+                                               and self._try_kw("SET")):
+                    self._try_op("=")
+                    cs_name = self._ident_or_string()
+                elif self._try_kw("COLLATE"):
+                    self._try_op("=")
+                    co_name = self._ident_or_string()
+                else:
+                    break
+            if cs_name is not None or co_name is not None:
+                from tidb_tpu import charset as _cs
+                stmt.charset, stmt.collate = \
+                    _cs.validate_column_charset(cs_name, co_name)
+            return stmt
         if self._at_kw("UNIQUE", "INDEX"):
             unique = self._try_kw("UNIQUE")
             self._expect_kw("INDEX")
@@ -555,12 +573,36 @@ class Parser:
             if not self._try_op(","):
                 break
         self._expect_op(")")
-        # table options (ENGINE=, CHARSET=, COMMENT=...) — parse & ignore
+        # table options: [DEFAULT] CHARSET/CHARACTER SET and COLLATE are
+        # captured and validated; the rest (ENGINE=, COMMENT=...) parse
+        # and are ignored
+        cs_name, co_name = None, None
         while self._cur().tp in (lx.KEYWORD, lx.IDENT) and not self._at(lx.EOF) \
                 and not self._at_op(";"):
+            if self._try_kw("DEFAULT"):
+                continue
+            if self._try_kw("CHARSET") or (self._try_kw("CHARACTER")
+                                           and self._try_kw("SET")):
+                self._try_op("=")
+                cs_name = self._ident_or_string()
+                continue
+            if self._try_kw("COLLATE"):
+                self._try_op("=")
+                co_name = self._ident_or_string()
+                continue
             self._next()
             if self._try_op("="):
                 self._next()
+        if cs_name is not None or co_name is not None:
+            from tidb_tpu import charset as _cs
+            stmt.charset, stmt.collate = \
+                _cs.validate_column_charset(cs_name, co_name)
+            stmt.charset_explicit = True
+            # table default applies to string columns without their own
+            # CHARACTER SET/COLLATE (MySQL inheritance)
+            for cd in stmt.cols:
+                if cd.tp.is_string() and not cd.charset_explicit:
+                    cd.tp.charset, cd.tp.collate = stmt.charset, stmt.collate
         return stmt
 
     def _parse_if_not_exists(self) -> bool:
@@ -602,6 +644,7 @@ class Parser:
         name = self._ident("column name")
         ftype = self._parse_field_type()
         col = ast.ColumnDef(name=name, tp=ftype)
+        cs_name, co_name = None, None
         while True:
             if self._try_kw("NOT"):
                 self._expect_kw("NULL")
@@ -627,12 +670,17 @@ class Parser:
                 self._expect_kw("UPDATE")
                 self._next()  # CURRENT_TIMESTAMP etc.
                 col.options.append(ast.ColumnOption(ast.ColumnOptionType.ON_UPDATE))
-            elif self._try_kw("CHARACTER"):
-                self._expect_kw("SET")
-                self._ident()
+            elif self._try_kw("CHARACTER", "CHARSET"):
+                self._try_kw("SET")
+                cs_name = self._ident_or_string()
             elif self._try_kw("COLLATE"):
-                self._ident()
+                co_name = self._ident_or_string()
             else:
+                if cs_name is not None or co_name is not None:
+                    from tidb_tpu import charset as _cs
+                    ftype.charset, ftype.collate = \
+                        _cs.validate_column_charset(cs_name, co_name)
+                    col.charset_explicit = True
                 return col
 
     _TYPE_MAP = {
@@ -778,14 +826,18 @@ class Parser:
         # SetNamesStmt); drivers send them right after the handshake
         if self._at(lx.IDENT) and self._cur().val.lower() == "names":
             self._next()
-            self._ident_or_string()
+            cs_name = self._ident_or_string()
+            co_name = None
             if self._try_kw("COLLATE"):
-                self._ident_or_string()
+                co_name = self._ident_or_string()
+            from tidb_tpu import charset as _cs
+            _cs.validate_column_charset(cs_name, co_name)  # 1115/1273/1253
             return ast.SetStmt()
         if self._at_kw("CHARACTER"):
             self._next()
             self._expect_kw("SET")
-            self._ident_or_string()
+            from tidb_tpu import charset as _cs
+            _cs.get_charset_info(self._ident_or_string())   # 1115 on unknown
             return ast.SetStmt()
         stmt = ast.SetStmt()
         while True:
@@ -847,6 +899,18 @@ class Parser:
         if self._at(lx.IDENT) and self._cur().val.lower() == "processlist":
             self._next()
             return ast.ShowStmt(tp=ast.ShowType.PROCESSLIST, full=full)
+        if self._try_kw("CHARSET") or self._try_kw("CHARACTER"):
+            self._try_kw("SET")
+            pattern = ""
+            if self._try_kw("LIKE"):
+                pattern = str(self._next().val)
+            return ast.ShowStmt(tp=ast.ShowType.CHARSET, pattern=pattern)
+        if self._at(lx.IDENT) and self._cur().val.lower() == "collation":
+            self._next()
+            pattern = ""
+            if self._try_kw("LIKE"):
+                pattern = str(self._next().val)
+            return ast.ShowStmt(tp=ast.ShowType.COLLATION, pattern=pattern)
         if self._at(lx.IDENT) and self._cur().val.lower() == "grants":
             self._next()
             user = ""

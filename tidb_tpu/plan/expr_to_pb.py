@@ -66,6 +66,11 @@ def _convert(expr: Expression) -> proto.Expr | None:
             # compare numbers where SQL compares item NAMES — these
             # columns evaluate after unflatten, on the SQL side
             return None
+        if expr.ret_type.is_string() and \
+                expr.ret_type.collate.endswith("_ci"):
+            # coprocessor string compare is binary; *_ci collations must
+            # casefold, which only the SQL-side evaluator does
+            return None
         return proto.expr_column(expr.col_id)
     if isinstance(expr, ScalarFunction):
         children = []

@@ -415,6 +415,10 @@ def _stream_agg_applicable(agg: Aggregation, child: Plan) -> bool:
     for g in agg.group_by:
         if not isinstance(g, Column):
             return False
+        if g.ret_type.is_ci_collation():
+            # index order clusters by BYTES; a *_ci group ('ALPHA'/'alpha')
+            # spans non-adjacent keys — streaming would split the group
+            return False
         group_cols.append(g.col_name.lower())
     return idx_names[:len(group_cols)] == group_cols
 

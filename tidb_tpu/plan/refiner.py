@@ -137,6 +137,11 @@ def _coerce_index_datum(col: Column, v: Datum, op: Op) -> Datum | None:
     those conditions stay SQL-side filters. BIT's byte order equals its
     numeric order, so its inequalities remain range-able."""
     from tidb_tpu import mysqldef as my
+    if col.ret_type.is_ci_collation():
+        # binary index order is not *_ci value order: 'ALPHA' and 'alpha'
+        # are equal under the collation but land at different keys — no
+        # sound range exists; the predicate stays a SQL-side filter
+        return None
     if col.ret_type.tp in (my.TypeEnum, my.TypeSet, my.TypeBit):
         if op != Op.EQ and col.ret_type.tp != my.TypeBit:
             return None

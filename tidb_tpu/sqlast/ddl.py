@@ -37,6 +37,8 @@ class ColumnDef(Node):
     name: str
     tp: Any = None  # FieldType
     options: list[ColumnOption] = field(default_factory=list)
+    # CHARACTER SET / COLLATE given explicitly (table defaults don't apply)
+    charset_explicit: bool = False
 
 
 class ConstraintType(enum.IntEnum):
@@ -60,6 +62,8 @@ class Constraint(Node):
 class CreateDatabaseStmt(StmtNode):
     name: str
     if_not_exists: bool = False
+    charset: str = "utf8"
+    collate: str = "utf8_bin"
 
 
 @dataclass
@@ -74,6 +78,9 @@ class CreateTableStmt(StmtNode):
     cols: list[ColumnDef] = field(default_factory=list)
     constraints: list[Constraint] = field(default_factory=list)
     if_not_exists: bool = False
+    charset: str = "utf8"       # table default charset/collation options
+    collate: str = "utf8_bin"
+    charset_explicit: bool = False   # options given (vs database default)
 
 
 @dataclass

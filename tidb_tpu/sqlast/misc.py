@@ -57,6 +57,8 @@ class ShowType(enum.IntEnum):
     STATUS = 8        # metrics registry (SHOW STATUS)
     GRANTS = 9
     PROCESSLIST = 10
+    CHARSET = 11      # SHOW CHARACTER SET (executor/show.go fetchShowCharset)
+    COLLATION = 12    # SHOW COLLATION
 
 
 @dataclass

@@ -48,6 +48,12 @@ class FieldType:
     def is_numeric(self) -> bool:
         return self.is_integer() or self.is_float() or self.is_decimal()
 
+    def is_ci_collation(self) -> bool:
+        """Case-insensitive string column (utf8_general_ci etc.): compare/
+        group/sort casefolded; binary key order is NOT value order."""
+        from tidb_tpu import charset as _cs
+        return self.is_string() and _cs.is_ci_collation(self.collate)
+
     def clone(self) -> "FieldType":
         ft = FieldType(self.tp, self.flag, self.flen, self.decimal,
                        self.charset, self.collate, list(self.elems))
