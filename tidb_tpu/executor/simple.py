@@ -318,7 +318,7 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
         # this engine's administrative stand-in
         me = session.vars.user
         see_all = not me or pv.checker_for(session.store).check(
-            me, "", "", "Grant")
+            me, "", "", "Grant", host=session.vars.client_host)
         rows = []
         for s in sorted(sessions_for(session.store),
                         key=lambda s: s.vars.connection_id):
@@ -328,7 +328,8 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
             info = ps.current_sql(cid)
             if info and not stmt.full:
                 info = info[:100]
-            rows.append([str(cid), s.vars.user or "", "localhost",
+            rows.append([str(cid), s.vars.user or "",
+                         s.vars.client_host or "localhost",
                          s.vars.current_db or None, "Query", "0", "",
                          info])
         return _str_rs(["Id", "User", "Host", "db", "Command", "Time",
@@ -336,8 +337,15 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
     if tp == ast.ShowType.GRANTS:
         from tidb_tpu import privilege as pv
         user = stmt.pattern or session.vars.user or "root"
+        if stmt.host:
+            host = stmt.host          # FOR 'u'@'h': that identity
+        elif not stmt.pattern and session.vars.user:
+            host = session.vars.client_host   # own grants: what I hold
+        else:
+            host = None               # FOR 'u': every identity of u
         return _str_rs([f"Grants for {user}"],
-                       [[g] for g in pv.show_grants(session.store, user)])
+                       [[g] for g in pv.show_grants(session.store, user,
+                                                    host)])
     if tp == ast.ShowType.DATABASES:
         names = sorted(is_.all_schema_names(), key=str.lower)
         return _str_rs(["Database"], _like_filter([[n] for n in names],
@@ -663,24 +671,26 @@ def _internal(session):
     return Session(session.store, internal=True)
 
 
-def _user_exists(internal, user: str) -> bool:
+def _user_exists(internal, user: str, host: str = "%") -> bool:
     rs = internal.execute(
-        f"select count(1) from mysql.user where User = '{_esc(user)}'")
+        f"select count(1) from mysql.user where User = '{_esc(user)}' "
+        f"and Host = '{_esc(host or '%')}'")
     return rs[0].values()[0][0] > 0
 
 
 def _ensure_user(internal, spec, must_exist_ok: bool = True) -> None:
     from tidb_tpu.server.protocol import password_hash
     pw = password_hash(spec.password) if spec.password else ""
-    if _user_exists(internal, spec.user):
+    if _user_exists(internal, spec.user, spec.host):
         if spec.password is not None:
             internal.execute(
                 f"update mysql.user set Password = '{pw}' "
-                f"where User = '{_esc(spec.user)}'")
+                f"where User = '{_esc(spec.user)}' "
+                f"and Host = '{_esc(spec.host or '%')}'")
         return
     internal.execute(
         "insert into mysql.user (Host, User, Password) values "
-        f"('{_esc(spec.host)}', '{_esc(spec.user)}', '{pw}')")
+        f"('{_esc(spec.host or '%')}', '{_esc(spec.user)}', '{pw}')")
 
 
 def _kill(session, stmt: ast.KillStmt) -> None:
@@ -698,7 +708,8 @@ def _kill(session, stmt: ast.KillStmt) -> None:
                                code=1094)
     if session.vars.user and target.vars.user != session.vars.user \
             and not pv.checker_for(session.store).check(
-                session.vars.user, "", "", "Grant"):
+                session.vars.user, "", "", "Grant",
+                host=session.vars.client_host):
         raise pv.AccessDenied(
             "You are not owner of thread " + str(stmt.conn_id))
     target.killed = True
@@ -742,23 +753,35 @@ def _grant_revoke(session, stmt) -> None:
                 f"privilege(s) {', '.join(bad)} not grantable on {level}")
 
     for spec in stmt.users:
-        if granting:
-            _ensure_user(internal, spec)
-        elif not _user_exists(internal, spec.user):
-            raise errors.ExecError(
-                f"user '{spec.user}' does not exist")
+        if _user_exists(internal, spec.user, spec.host):
+            if granting and spec.password is not None:
+                _ensure_user(internal, spec)   # update the password
+        else:
+            if granting and spec.password:
+                # GRANT ... IDENTIFIED BY 'pw' may create the account
+                _ensure_user(internal, spec)
+            else:
+                # but a bare GRANT must not: a typo'd host would mint a
+                # new PASSWORDLESS identity that shadows the real one in
+                # the most-specific auth scan (NO_AUTO_CREATE_USER, 1133)
+                raise errors.ExecError(
+                    f"Can't find any matching row in the user table for "
+                    f"'{spec.user}'@'{spec.host or '%'}'", code=1133)
         u = _esc(spec.user)
+        h = _esc(spec.host or "%")
         if not db:  # global: mysql.user columns
             privs = pv.USER_PRIVS if stmt.privs == ["ALL"] else stmt.privs
             sets = ", ".join(f"{p}_priv = '{'Y' if granting else 'N'}'"
                              for p in privs)
             internal.execute(
-                f"update mysql.user set {sets} where User = '{u}'")
+                f"update mysql.user set {sets} where User = '{u}' "
+                f"and Host = '{h}'")
         elif not table:  # db level: mysql.db row
             privs = pv.DB_PRIVS if stmt.privs == ["ALL"] else stmt.privs
             n = internal.execute(
                 "select count(1) from mysql.db where User = "
-                f"'{u}' and DB = '{_esc(db)}'")[0].values()[0][0]
+                f"'{u}' and Host = '{h}' and DB = "
+                f"'{_esc(db)}'")[0].values()[0][0]
             if n == 0 and not granting:
                 # MySQL ER_NONEXISTING_GRANT: a REVOKE matching no stored
                 # grant row must say so, not silently no-op — a typo'd
@@ -769,19 +792,19 @@ def _grant_revoke(session, stmt) -> None:
             if n == 0 and granting:
                 internal.execute(
                     "insert into mysql.db (Host, DB, User) values "
-                    f"('{_esc(spec.host)}', '{_esc(db)}', '{u}')")
+                    f"('{h}', '{_esc(db)}', '{u}')")
             if n > 0 or granting:
                 sets = ", ".join(f"{p}_priv = '{'Y' if granting else 'N'}'"
                                  for p in privs)
                 internal.execute(
                     f"update mysql.db set {sets} where User = '{u}' "
-                    f"and DB = '{_esc(db)}'")
+                    f"and Host = '{h}' and DB = '{_esc(db)}'")
         else:  # table level: mysql.tables_priv Table_priv set
             privs = pv.TABLE_PRIVS if stmt.privs == ["ALL"] else stmt.privs
             rs = internal.execute(
                 "select Table_priv from mysql.tables_priv where User = "
-                f"'{u}' and DB = '{_esc(db)}' and Table_name = "
-                f"'{_esc(table)}'")[0].values()
+                f"'{u}' and Host = '{h}' and DB = '{_esc(db)}' "
+                f"and Table_name = '{_esc(table)}'")[0].values()
             have: set[str] = set()
             exists = bool(rs)
             if rs and rs[0][0]:
@@ -797,13 +820,14 @@ def _grant_revoke(session, stmt) -> None:
             if exists:
                 internal.execute(
                     f"update mysql.tables_priv set Table_priv = '{tp}' "
-                    f"where User = '{u}' and DB = '{_esc(db)}' "
+                    f"where User = '{u}' and Host = '{h}' "
+                    f"and DB = '{_esc(db)}' "
                     f"and Table_name = '{_esc(table)}'")
             elif granting:
                 internal.execute(
                     "insert into mysql.tables_priv (Host, DB, User, "
                     "Table_name, Table_priv) values "
-                    f"('{_esc(spec.host)}', '{_esc(db)}', '{u}', "
+                    f"('{h}', '{_esc(db)}', '{u}', "
                     f"'{_esc(table)}', '{tp}')")
     pv.invalidate(session.store)
     return None
@@ -814,9 +838,11 @@ def _create_user(session, stmt: ast.CreateUserStmt) -> None:
     session.commit_txn()
     internal = _internal(session)
     for spec in stmt.users:
-        if _user_exists(internal, spec.user):
+        if _user_exists(internal, spec.user, spec.host):
             if not stmt.if_not_exists:
-                raise errors.ExecError(f"user '{spec.user}' already exists")
+                raise errors.ExecError(
+                    f"user '{spec.user}'@'{spec.host or '%'}' already "
+                    "exists")
             continue
         _ensure_user(internal, spec)
     pv.invalidate(session.store)
@@ -828,14 +854,18 @@ def _drop_user(session, stmt: ast.DropUserStmt) -> None:
     session.commit_txn()
     internal = _internal(session)
     for spec in stmt.users:
-        if not _user_exists(internal, spec.user):
+        if not _user_exists(internal, spec.user, spec.host):
             if not stmt.if_exists:
-                raise errors.ExecError(f"user '{spec.user}' does not exist")
+                raise errors.ExecError(
+                    f"user '{spec.user}'@'{spec.host or '%'}' does not "
+                    "exist")
             continue
-        u = _esc(spec.user)
-        internal.execute(f"delete from mysql.user where User = '{u}'")
-        internal.execute(f"delete from mysql.db where User = '{u}'")
-        internal.execute(
-            f"delete from mysql.tables_priv where User = '{u}'")
+        u, h = _esc(spec.user), _esc(spec.host or "%")
+        internal.execute(f"delete from mysql.user where User = '{u}' "
+                         f"and Host = '{h}'")
+        internal.execute(f"delete from mysql.db where User = '{u}' "
+                         f"and Host = '{h}'")
+        internal.execute(f"delete from mysql.tables_priv where User = "
+                         f"'{u}' and Host = '{h}'")
     pv.invalidate(session.store)
     return None

@@ -914,13 +914,14 @@ class Parser:
         if self._at(lx.IDENT) and self._cur().val.lower() == "grants":
             self._next()
             user = ""
+            host = ""
             if self._try_kw("FOR"):
                 user = self._ident_or_string()
-                if self._at(lx.USER_VAR):  # 'u'@'h' — host ignored
+                if self._at(lx.USER_VAR):  # 'u'@'h' — the identity's host
                     t = self._next()
-                    if not t.val:
-                        self._ident_or_string()
-            return ast.ShowStmt(tp=ast.ShowType.GRANTS, pattern=user)
+                    host = str(t.val) if t.val else self._ident_or_string()
+            return ast.ShowStmt(tp=ast.ShowType.GRANTS, pattern=user,
+                                host=host)
         if self._try_kw("CREATE"):
             self._expect_kw("TABLE")
             return ast.ShowStmt(tp=ast.ShowType.CREATE_TABLE,

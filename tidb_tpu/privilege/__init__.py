@@ -9,10 +9,13 @@ derived from the AST — sessions without an authenticated user (library
 embedding, internal SQL) skip it, exactly like the reference's nil-checker
 contexts.
 
-Deliberate simplification vs MySQL: identities are keyed by USER only.
-Hosts are parsed and stored (wire compatibility) but never matched —
-'u'@'a' and 'u'@'b' are one identity. Single-tenant deployments behind the
-wire server don't need host-scoped grants; revisit if they ever do.
+Host matching (round-4): grant rows carry host patterns; a client
+connecting from H holds the UNION of privileges from rows whose pattern
+matches H ('%'/'_' wildcards, case-insensitive, empty ≡ '%'), the contract
+the reference implements as `Host="<h>" OR Host="%"` row filters
+(privilege/privileges/privileges.go:253) generalized to full patterns.
+Authentication picks the MOST SPECIFIC matching mysql.user row (exact >
+fewest wildcards > longest pattern), like MySQL's sorted ACL scan.
 """
 
 from __future__ import annotations
@@ -46,6 +49,34 @@ def _s(v) -> str:
     return v.decode() if isinstance(v, bytes) else str(v)
 
 
+import functools
+import re as _re
+
+
+@functools.lru_cache(maxsize=512)
+def _host_regex(pattern: str):
+    rx = _re.escape(pattern).replace("%", ".*").replace("_", ".")
+    return _re.compile(rx)
+
+
+def host_match(pattern: str, host: str) -> bool:
+    """MySQL host-pattern match: % and _ wildcards, case-insensitive;
+    empty pattern means any host. Compiled patterns are cached — this
+    sits on the per-statement privilege-check path."""
+    pattern = (pattern or "%").lower()
+    if pattern == "%":
+        return True
+    return _host_regex(pattern).fullmatch((host or "").lower()) is not None
+
+
+def host_specificity(pattern: str) -> tuple:
+    """Sort key: most specific first — exact (no wildcards), then fewest
+    wildcards, then longest literal prefix (MySQL ACL ordering)."""
+    pattern = pattern or "%"
+    wild = pattern.count("%") + pattern.count("_")
+    return (wild > 0, wild, -len(pattern))
+
+
 class Checker:
     """Lazy cache of one user's grants, rebuilt when version changes."""
 
@@ -54,9 +85,13 @@ class Checker:
         self._lock = threading.Lock()
         self._loaded_version = -1
         self.version = 0    # bumped per-store by GRANT/REVOKE executors
-        self._global: dict[str, set[str]] = {}
-        self._db: dict[tuple[str, str], set[str]] = {}
-        self._table: dict[tuple[str, str, str], set[str]] = {}
+        # grant rows indexed for the per-statement check: user-keyed (and
+        # user+db[+table]-keyed) lists of (host_pattern, privs), so a
+        # check touches only its own identity's rows
+        self._global: dict[str, list[tuple[str, set[str]]]] = {}
+        self._db: dict[tuple[str, str], list[tuple[str, set[str]]]] = {}
+        self._table: dict[tuple[str, str, str],
+                          list[tuple[str, set[str]]]] = {}
 
     def _load(self) -> None:
         from tidb_tpu.session import Session
@@ -68,63 +103,83 @@ class Checker:
         names = rs.field_names()
         for row in rs.values():
             rec = dict(zip(names, row))
-            user = _s(rec.get("User"))
+            hp = _s(rec.get("Host")).lower() or "%"
             privs = {p for p in USER_PRIVS
                      if _s(rec.get(f"{p}_priv")).upper() == "Y"}
-            self._global[user] = privs
+            self._global.setdefault(_s(rec.get("User")), []) \
+                .append((hp, privs))
         rs = s.execute("select * from mysql.db")[0]
         names = rs.field_names()
         for row in rs.values():
             rec = dict(zip(names, row))
+            hp = _s(rec.get("Host")).lower() or "%"
             key = (_s(rec.get("User")), _s(rec.get("DB")).lower())
             privs = {p for p in DB_PRIVS
                      if _s(rec.get(f"{p}_priv")).upper() == "Y"}
-            self._db[key] = privs
+            self._db.setdefault(key, []).append((hp, privs))
         rs = s.execute("select * from mysql.tables_priv")[0]
         names = rs.field_names()
         for row in rs.values():
             rec = dict(zip(names, row))
+            hp = _s(rec.get("Host")).lower() or "%"
             key = (_s(rec.get("User")), _s(rec.get("DB")).lower(),
                    _s(rec.get("Table_name")).lower())
             privs = {p.strip().capitalize()
                      for p in _s(rec.get("Table_priv")).split(",") if p}
-            self._table[key] = privs
+            self._table.setdefault(key, []).append((hp, privs))
 
-    def check(self, user: str, db: str, table: str, priv: str) -> bool:
-        """Global OR db OR table scope grant (privileges.go Check)."""
+    def _refresh(self) -> None:
+        if self._loaded_version != self.version:
+            self._load()
+            self._loaded_version = self.version
+
+    def check(self, user: str, db: str, table: str, priv: str,
+              host: str = "localhost") -> bool:
+        """Global OR db OR table scope grant (privileges.go Check), over
+        the union of rows whose host pattern matches `host`."""
         with self._lock:
-            if self._loaded_version != self.version:
-                self._load()
-                self._loaded_version = self.version
-            g = self._global.get(user)
-            if g is None:
-                return False  # unknown user holds nothing
-            if priv in g:
-                return True
+            self._refresh()
+            known = False
+            for hp, privs in self._global.get(user, ()):
+                if host_match(hp, host):
+                    known = True
+                    if priv in privs:
+                        return True
+            if not known:
+                return False  # unknown identity holds nothing
             if db:
-                if priv in self._db.get((user, db.lower()), ()):
-                    return True
-                if table and priv in self._table.get(
-                        (user, db.lower(), table.lower()), ()):
-                    return True
+                dbl = db.lower()
+                for hp, privs in self._db.get((user, dbl), ()):
+                    if priv in privs and host_match(hp, host):
+                        return True
+                if table:
+                    key = (user, dbl, table.lower())
+                    for hp, privs in self._table.get(key, ()):
+                        if priv in privs and host_match(hp, host):
+                            return True
             return False
 
-    def check_any(self, user: str, db: str, table: str) -> bool:
+    def check_any(self, user: str, db: str, table: str,
+                  host: str = "localhost") -> bool:
         """Does the user hold ANY privilege on db.table at any scope?
         MySQL's gate for schema inspection (COM_FIELD_LIST, SHOW COLUMNS,
         SHOW CREATE TABLE): column metadata is visible iff some privilege
         exists on the table (sql_show.cc check_table_access)."""
         with self._lock:
-            if self._loaded_version != self.version:
-                self._load()
-                self._loaded_version = self.version
-            if self._global.get(user):
-                return True
-            if db and self._db.get((user, db.lower())):
-                return True
-            if db and table and self._table.get(
-                    (user, db.lower(), table.lower())):
-                return True
+            self._refresh()
+            for hp, privs in self._global.get(user, ()):
+                if privs and host_match(hp, host):
+                    return True
+            if db:
+                dbl = db.lower()
+                for hp, privs in self._db.get((user, dbl), ()):
+                    if privs and host_match(hp, host):
+                        return True
+                if table:
+                    key = (user, dbl, table.lower())
+                    for hp, privs in self._table.get(key, ()):
+                        if privs and host_match(hp, host):
+                            return True
             return False
 
 
@@ -148,27 +203,46 @@ def invalidate(store) -> None:
     checker_for(store).version += 1
 
 
-def show_grants(store, user: str) -> list[str]:
+def show_grants(store, user: str, host: str | None = None) -> list[str]:
     """GRANT statements reconstructing a user's privileges
-    (privilege.Checker.ShowGrants)."""
+    (privilege.Checker.ShowGrants). `host` scopes which of the name's
+    identities are listed — None means all of them."""
     c = checker_for(store)
     c.check(user, "", "", "Select")  # force a (re)load
     out: list[str] = []
+
+    def want(hp: str) -> bool:
+        # host=None → every identity of the name; exact pattern → that
+        # identity; anything else (a client address) → identities whose
+        # pattern matches it (what the session actually holds)
+        if host is None:
+            return True
+        h = host.lower()
+        return hp == h or host_match(hp, h)
+
     with c._lock:
-        g = c._global.get(user)
-        if g is not None:
+        for hp, g in sorted(c._global.get(user, ())):
+            if not want(hp):
+                continue
             privs = "ALL PRIVILEGES" if set(USER_PRIVS) <= g else \
                 ", ".join(sorted(p.upper() for p in g)) or "USAGE"
-            out.append(f"GRANT {privs} ON *.* TO '{user}'@'%'")
-        for (u, db), privs in sorted(c._db.items()):
-            if u == user and privs:
-                p = "ALL PRIVILEGES" if set(DB_PRIVS) <= privs else \
-                    ", ".join(sorted(x.upper() for x in privs))
-                out.append(f"GRANT {p} ON `{db}`.* TO '{user}'@'%'")
-        for (u, db, tbl), privs in sorted(c._table.items()):
-            if u == user and privs:
-                p = ", ".join(sorted(x.upper() for x in privs))
-                out.append(f"GRANT {p} ON `{db}`.`{tbl}` TO '{user}'@'%'")
+            out.append(f"GRANT {privs} ON *.* TO '{user}'@'{hp}'")
+        for (u, db), rows in sorted(c._db.items()):
+            if u != user:
+                continue
+            for hp, privs in sorted(rows):
+                if privs and want(hp):
+                    p = "ALL PRIVILEGES" if set(DB_PRIVS) <= privs else \
+                        ", ".join(sorted(x.upper() for x in privs))
+                    out.append(f"GRANT {p} ON `{db}`.* TO '{user}'@'{hp}'")
+        for (u, db, tbl), rows in sorted(c._table.items()):
+            if u != user:
+                continue
+            for hp, privs in sorted(rows):
+                if privs and want(hp):
+                    p = ", ".join(sorted(x.upper() for x in privs))
+                    out.append(
+                        f"GRANT {p} ON `{db}`.`{tbl}` TO '{user}'@'{hp}'")
     return out
 
 
@@ -253,6 +327,7 @@ def check_stmt(session, stmt) -> None:
     user = session.vars.user
     if not user:
         return
+    host = getattr(session.vars, "client_host", "localhost") or "localhost"
     checker = checker_for(session.store)
     reqs = required_privs(stmt, session.vars.current_db)
     if isinstance(stmt, ast.ShowStmt) \
@@ -262,8 +337,8 @@ def check_stmt(session, stmt) -> None:
         db = (getattr(tn, "db", None) or stmt.db
               or session.vars.current_db or "").lower()
         name = (tn.name if hasattr(tn, "name") else str(tn)).lower()
-        if db not in VIRTUAL_SCHEMAS and not checker.check_any(user, db,
-                                                              name):
+        if db not in VIRTUAL_SCHEMAS and not checker.check_any(
+                user, db, name, host=host):
             raise AccessDenied(
                 f"SHOW command denied to user '{user}' for table "
                 f"'{db}.{name}'")
@@ -273,7 +348,7 @@ def check_stmt(session, stmt) -> None:
         # grant tables (MySQL: SELECT on the mysql schema)
         reqs = reqs + [("Select", "mysql", "")]
     for priv, db, table in reqs:
-        if not checker.check(user, db, table, priv):
+        if not checker.check(user, db, table, priv, host=host):
             where = f"table '{db}.{table}'" if table else \
                 (f"database '{db}'" if db else "this operation")
             raise AccessDenied(

@@ -25,6 +25,13 @@ class ClientConnection:
         self.salt = p.new_salt()
         self.session: Session | None = None
         self.user = ""
+        # peer address for host-scoped privileges; loopback ≡ localhost
+        # (MySQL name resolution for the common case)
+        try:
+            peer = sock.getpeername()[0]
+        except OSError:
+            peer = "localhost"
+        self.client_host = "localhost" if peer in ("127.0.0.1", "::1")             else peer
         self.capability = 0
         self.alive = True
         # per-statement bound param types (COM_STMT_EXECUTE may set
@@ -70,6 +77,7 @@ class ClientConnection:
         self.session = Session(self.server.store)
         self.session.vars.connection_id = self.conn_id
         self.session.vars.user = self.user
+        self.session.vars.client_host = self.client_host
         self.session._wire_conn = self  # KILL CONNECTION closes the socket
         if db:
             try:
@@ -81,7 +89,7 @@ class ClientConnection:
         return True
 
     def _check_user(self, user: str, token: bytes) -> bool:
-        stored = self.server.password_hash_for(user)
+        stored = self.server.password_hash_for(user, self.client_host)
         if stored is None:
             return False
         return p.check_auth(token, stored, self.salt)
@@ -252,7 +260,7 @@ class ClientConnection:
             # its column definitions (same gate as SHOW COLUMNS)
             from tidb_tpu import privilege as pv
             if not pv.checker_for(self.session.store).check_any(
-                    user, db, table):
+                    user, db, table, host=self.client_host):
                 raise pv.AccessDenied(
                     f"SHOW command denied to user '{user}' for table "
                     f"'{db}.{table}'")

@@ -152,25 +152,29 @@ class Server:
     # auth + status
     # ------------------------------------------------------------------
 
-    def password_hash_for(self, user: str) -> str | None:
-        """Stored mysql_native_password hash from mysql.user, or None when
-        the user doesn't exist (conn.go:272 auth path)."""
+    def password_hash_for(self, user: str,
+                          host: str = "localhost") -> str | None:
+        """Stored mysql_native_password hash from the MOST SPECIFIC
+        mysql.user row matching (user, client host), or None when no row
+        matches (conn.go:272 auth path + MySQL sorted ACL scan)."""
+        from tidb_tpu.privilege import host_match, host_specificity
         from tidb_tpu.utils import escape_string
         esc = escape_string(user)
         with self._auth_lock:
             rs = self._auth_session.execute(
-                f"select Password, User from mysql.user where User = '{esc}'")
+                f"select Password, User, Host from mysql.user "
+                f"where User = '{esc}'")
         rows = rs[0].values() if rs else []
-        # belt-and-braces: the row must name exactly this user
-        rows = [r for r in rows
-                if (r[1].decode() if isinstance(r[1], bytes)
-                    else str(r[1])) == user]
-        if not rows:
+
+        def _s(v):
+            return "" if v is None else (
+                v.decode() if isinstance(v, bytes) else str(v))
+        cands = [r for r in rows
+                 if _s(r[1]) == user and host_match(_s(r[2]), host)]
+        if not cands:
             return None
-        v = rows[0][0]
-        if v is None:
-            return ""
-        return v.decode() if isinstance(v, bytes) else str(v)
+        cands.sort(key=lambda r: host_specificity(_s(r[2])))
+        return _s(cands[0][0])
 
     def status(self) -> dict:
         """server/server.go:213-262 status JSON: version, connections,

@@ -510,7 +510,8 @@ class Session:
             # a store-global action needs the global Grant privilege
             from tidb_tpu import privilege
             if not privilege.checker_for(self.store).check(
-                    self.vars.user, "", "", "Grant"):
+                    self.vars.user, "", "", "Grant",
+                    host=self.vars.client_host):
                 raise privilege.AccessDenied(
                     f"user '{self.vars.user}' needs the global GRANT "
                     "privilege to set tidb_copr_backend")

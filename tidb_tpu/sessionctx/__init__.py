@@ -60,6 +60,7 @@ class SessionVars:
         self.in_txn = False                     # explicit BEGIN active
         self.connection_id = 0
         self.user = ""
+        self.client_host = "localhost"  # peer address (privilege matching)
         self.last_insert_id = 0
         self.affected_rows = 0
         self.found_rows = 0

@@ -68,6 +68,7 @@ class ShowStmt(StmtNode):
     db: str = ""
     full: bool = False
     pattern: str = ""
+    host: str = ""     # SHOW GRANTS FOR 'u'@'host' ('' = unspecified)
 
 
 @dataclass
