@@ -1,0 +1,119 @@
+"""Statement validation pass (round-3 coverage row #3: preprocess/
+validate was inline in the plan builder; now a separate pass).
+
+Reference: plan/preprocess.go:24, plan/validator.go:28-220.
+"""
+
+import pytest
+
+from tidb_tpu import errors
+from tidb_tpu.session import Session, new_store
+from tests.testkit import _store_id
+
+
+@pytest.fixture
+def s():
+    s = Session(new_store(f"memory://prep{next(_store_id)}"))
+    s.execute("create database d; use d")
+    s.execute("create table t (a bigint primary key, b int)")
+    s.execute("insert into t values (1, 2), (2, 3)")
+    return s
+
+
+def _code(ei):
+    return getattr(ei.value, "code", None)
+
+
+def test_nested_aggregate_rejected(s):
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("select sum(count(b)) from t")
+    assert _code(ei) == 1111
+    with pytest.raises(errors.TiDBError):
+        s.execute("select max(1 + min(b)) from t group by a")
+
+
+def test_multiple_primary_keys_rejected(s):
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a int primary key, b int primary key)")
+    assert _code(ei) == 1068
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a int primary key, b int, "
+                  "primary key (b))")
+    assert _code(ei) == 1068
+
+
+def test_auto_increment_rules(s):
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a int auto_increment, "
+                  "b int auto_increment, primary key (a))")
+    assert _code(ei) == 1075
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a int auto_increment, b int)")
+    assert _code(ei) == 1075   # auto column must be a key
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a varchar(5) auto_increment "
+                  "primary key)")
+    assert _code(ei) == 1063   # non-integer auto column
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a int auto_increment default 5 "
+                  "primary key)")
+    assert _code(ei) == 1067
+    # the valid shapes still work
+    s.execute("create table ok1 (a int auto_increment primary key)")
+    s.execute("create table ok2 (a bigint auto_increment, unique key (a))")
+
+
+def test_char_length_cap(s):
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a char(300))")
+    assert _code(ei) == 1074
+    s.execute("create table ok (a varchar(300))")   # varchar is fine
+
+
+def test_duplicate_index_columns(s):
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create index ix on t (b, b)")
+    assert _code(ei) == 1060
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("create table bad (a int, b int, key kk (a, a))")
+    assert _code(ei) == 1060
+
+
+def test_stray_param_marker_rejected(s):
+    with pytest.raises(errors.TiDBError):
+        s.execute("select * from t where a = ?")
+    # but PREPARE accepts markers, and EXECUTE binds them
+    s.execute("prepare p1 from 'select b from t where a = ?'")
+    s.execute("set @x = 1")
+    assert s.execute("execute p1 using @x")[0].values() == [[2]]
+
+
+def test_straight_join(s):
+    """STRAIGHT_JOIN both as operator and SELECT option (parser.y
+    StraightJoin productions): inner-join semantics, written order kept."""
+    s.execute("create table u (a bigint primary key, c int)")
+    s.execute("insert into u values (1, 10), (3, 30)")
+    got = s.execute("select t.a, u.c from t straight_join u on t.a = u.a")[0] \
+        .values()
+    assert got == [[1, 10]]
+    got = s.execute("select straight_join t.a, u.c from t, u "
+                    "where t.a = u.a")[0].values()
+    assert got == [[1, 10]]
+    # plan keeps the written order: t's scan precedes u's scan
+    txt = "\n".join(str(r[0]) for r in s.execute(
+        "explain select t.a from t straight_join u on t.a = u.a")[0].rows)
+    assert txt.index("table:t") < txt.index("table:u")
+    # DISTINCT before STRAIGHT_JOIN parses (MySQL select-option order)
+    s.execute("select distinct straight_join t.a from t, u "
+              "where t.a = u.a")
+    # aggregate inside a scalar subquery under an outer aggregate is a
+    # FRESH aggregate scope — the validator must not flag it as nested
+    # (the plan builder's subquery-in-agg-arg support is separate)
+    from tidb_tpu.parser.parser import Parser
+    from tidb_tpu.plan.preprocess import validate
+    validate(Parser().parse_one(
+        "select sum((select count(c) from u)) from t"))
+    # while a genuinely nested aggregate inside the subquery still trips
+    with pytest.raises(errors.TiDBError):
+        validate(Parser().parse_one(
+            "select (select max(count(c)) from u) from t"))

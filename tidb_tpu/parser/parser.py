@@ -238,10 +238,16 @@ class Parser:
     def _parse_select(self) -> ast.SelectStmt:
         self._expect_kw("SELECT")
         stmt = ast.SelectStmt()
-        if self._try_kw("DISTINCT"):
-            stmt.distinct = True
-        else:
-            self._try_kw("ALL")
+        # select options may appear in any order (parser.y SelectStmtOpts)
+        while True:
+            if self._try_kw("STRAIGHT_JOIN"):
+                stmt.straight_join = True   # keep the written join order
+            elif self._try_kw("DISTINCT"):
+                stmt.distinct = True
+            elif self._try_kw("ALL"):
+                pass
+            else:
+                break
         stmt.fields = self._parse_select_fields()
         if self._try_kw("FROM"):
             stmt.from_ = self._parse_table_refs()
@@ -311,6 +317,8 @@ class Parser:
             tp = None
             if self._try_kw("JOIN") or (self._try_kw("INNER") and self._expect_kw("JOIN")):
                 tp = "inner"
+            elif self._try_kw("STRAIGHT_JOIN"):
+                tp = "straight"
             elif self._at_kw("LEFT", "RIGHT"):
                 side = self._next().val
                 self._try_kw("OUTER")

@@ -119,7 +119,13 @@ class PlanBuilder:
         raise errors.PlanError(f"unsupported FROM node {type(node)}")
 
     def build_join(self, jn: ast.Join) -> Plan:
-        reordered = self._try_reorder_joins(jn)
+        # SELECT STRAIGHT_JOIN pins the written order; a STRAIGHT_JOIN
+        # operator anywhere in the chain does too (via the impure-chain
+        # check in _flatten_inner_chain)
+        if getattr(self, "_straight", False):
+            reordered = None
+        else:
+            reordered = self._try_reorder_joins(jn)
         if reordered is not None:
             return reordered
         left = self.build_table_ref(jn.left)
@@ -131,6 +137,7 @@ class PlanBuilder:
         if swapped:
             left, right = right, left
         tp = {"cross": Join.INNER, "inner": Join.INNER,
+              "straight": Join.INNER,
               "left": Join.LEFT_OUTER, "right": Join.LEFT_OUTER}[jn.tp]
         join = Join(tp)
         join.add_child(left)
@@ -250,6 +257,16 @@ class PlanBuilder:
     # ---- SELECT ----
 
     def build_select(self, sel: ast.SelectStmt) -> Plan:
+        # STRAIGHT_JOIN scopes to THIS query block (save/restore: derived
+        # tables and union branches choose their own order)
+        saved_straight = getattr(self, "_straight", False)
+        self._straight = sel.straight_join
+        try:
+            return self._build_select_inner(sel)
+        finally:
+            self._straight = saved_straight
+
+    def _build_select_inner(self, sel: ast.SelectStmt) -> Plan:
         if sel.from_ is not None:
             p = self.build_table_ref(sel.from_)
         else:

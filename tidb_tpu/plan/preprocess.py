@@ -1,0 +1,116 @@
+"""Statement validation pass, run BEFORE planning.
+
+Reference: plan/preprocess.go:24 (Preprocess) → plan/validator.go:28
+(Validate): structural checks that belong to the statement itself, not to
+name resolution or costing — nested aggregates, CREATE TABLE grammar
+(auto_increment rules, multiple primary keys, CHAR length), CREATE INDEX
+duplicate columns, stray param markers outside PREPARE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import fields as _dc_fields, is_dataclass
+
+from tidb_tpu import errors, mysqldef as my, sqlast as ast
+
+
+def validate(stmt, in_prepare: bool = False) -> None:
+    """Raise on structurally invalid statements (validator.go Validate)."""
+    if isinstance(stmt, ast.CreateTableStmt):
+        _check_create_table(stmt)
+    elif isinstance(stmt, ast.CreateIndexStmt):
+        _check_dup_index_columns(stmt.columns)
+    _walk_exprs(stmt, in_prepare, in_agg=False, top=True)
+
+
+def _is_agg_node(node) -> bool:
+    return isinstance(node, ast.AggregateFunc)
+
+
+def _walk_exprs(node, in_prepare: bool, in_agg: bool,
+                top: bool = False) -> None:
+    """Generic dataclass walk: nested-aggregate and param-marker checks
+    (validator.go Enter: ast.AggregateFuncExpr / ast.ParamMarkerExpr).
+
+    A nested query block (scalar subquery, EXISTS, derived table) is its
+    own aggregate scope: `sum((select count(c) from u))` is legal — the
+    inner count belongs to the inner block."""
+    if isinstance(node, ast.ParamMarker):
+        # a marker with a bound value is an EXECUTE re-run of a prepared
+        # statement; an unbound one outside PREPARE is a syntax error
+        if not in_prepare and node.value is None:
+            raise errors.ParseError("syntax error, unexpected '?'")
+        return
+    if not top and isinstance(node, (ast.SelectStmt, ast.UnionStmt)):
+        in_agg = False   # fresh scope for the inner block
+    entering_agg = _is_agg_node(node)
+    if entering_agg and in_agg:
+        raise errors.TiDBError(
+            "Invalid use of group function", code=1111)
+    inner = in_agg or entering_agg
+    if is_dataclass(node):
+        for f in _dc_fields(node):
+            _walk_exprs(getattr(node, f.name), in_prepare, inner)
+    elif isinstance(node, (list, tuple)):
+        for item in node:
+            _walk_exprs(item, in_prepare, inner)
+
+
+def _check_create_table(stmt: ast.CreateTableStmt) -> None:
+    """validator.go checkCreateTableGrammar + checkAutoIncrement."""
+    primary_defs = 0
+    auto_cols = []
+    key_cols = set()
+    for cons in stmt.constraints:
+        if cons.tp == ast.ConstraintType.PRIMARY_KEY:
+            primary_defs += 1
+        if cons.keys:
+            key_cols.add(cons.keys[0].lower())
+    for cd in stmt.cols:
+        opts = {o.tp for o in cd.options}
+        if ast.ColumnOptionType.PRIMARY_KEY in opts:
+            primary_defs += 1
+            key_cols.add(cd.name.lower())
+        if ast.ColumnOptionType.UNIQUE_KEY in opts:
+            key_cols.add(cd.name.lower())
+        if cd.tp.tp == my.TypeString and cd.tp.flen > 255:
+            raise errors.TiDBError(
+                f"Column length too big for column '{cd.name}' (max = "
+                "255); use BLOB or TEXT instead", code=1074)
+        if ast.ColumnOptionType.AUTO_INCREMENT in opts:
+            auto_cols.append(cd)
+            if ast.ColumnOptionType.DEFAULT in opts:
+                raise errors.TiDBError(
+                    f"Invalid default value for '{cd.name}'", code=1067)
+    if primary_defs > 1:
+        raise errors.TiDBError("Multiple primary key defined", code=1068)
+    if len(auto_cols) > 1:
+        raise errors.TiDBError(
+            "Incorrect table definition; there can be only one auto "
+            "column and it must be defined as a key", code=1075)
+    if auto_cols:
+        cd = auto_cols[0]
+        if cd.name.lower() not in key_cols:
+            raise errors.TiDBError(
+                "Incorrect table definition; there can be only one auto "
+                "column and it must be defined as a key", code=1075)
+        if cd.tp.tp not in (my.TypeTiny, my.TypeShort, my.TypeInt24,
+                            my.TypeLong, my.TypeLonglong):
+            raise errors.TiDBError(
+                f"Incorrect column specifier for column '{cd.name}'",
+                code=1063)
+    # duplicate column names inside any key spec
+    for cons in stmt.constraints:
+        if cons.keys:
+            _check_dup_index_columns(cons.keys)
+
+
+def _check_dup_index_columns(names) -> None:
+    """validator.go checkCreateIndexGrammar / checkIndexInfo."""
+    seen = set()
+    for n in names:
+        low = n.lower()
+        if low in seen:
+            raise errors.TiDBError(f"Duplicate column name '{n}'",
+                                   code=1060)
+        seen.add(low)

@@ -301,6 +301,8 @@ class Session:
             # (privilege/privilege.go Checker bound per-session)
             from tidb_tpu import privilege
             privilege.check_stmt(self, stmt)
+        from tidb_tpu.plan.preprocess import validate as _validate
+        _validate(stmt)
         if _is_simple(stmt):
             return execute_simple(self, stmt)
 
@@ -389,6 +391,8 @@ class Session:
             raise errors.ExecError(
                 "This command is not supported in the prepared statement "
                 "protocol yet")
+        from tidb_tpu.plan.preprocess import validate as _validate
+        _validate(inner, in_prepare=True)
         self.prepared[plan.name.lower()] = _PreparedStmt(
             inner, len(p.param_markers), text)
         return None
@@ -406,6 +410,8 @@ class Session:
             raise errors.ExecError(
                 "This command is not supported in the prepared statement "
                 "protocol yet")
+        from tidb_tpu.plan.preprocess import validate as _validate
+        _validate(inner, in_prepare=True)
         self._next_stmt_id += 1
         sid = self._next_stmt_id
         self.binary_stmts[sid] = _PreparedStmt(inner, len(p.param_markers),

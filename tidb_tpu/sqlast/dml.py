@@ -70,6 +70,7 @@ class SelectStmt(StmtNode):
     distinct: bool = False
     for_update: bool = False
     lock_in_share_mode: bool = False
+    straight_join: bool = False   # SELECT STRAIGHT_JOIN: keep FROM order
 
 
 @dataclass
