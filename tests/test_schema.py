@@ -315,3 +315,68 @@ def test_allocator_sequential_rebase_batches_meta_txns():
     finally:
         autoid_mod.run_in_new_txn = real_run
     assert calls <= 4, f"{calls} meta txns for 2000 sequential rebases"
+
+
+class TestModifyColumn:
+    """ALTER TABLE MODIFY COLUMN: metadata-only widening
+    (ddl/ddl.go:1070 modifiable, ddl/column.go:421 onModifyColumn)."""
+
+    def _mk(self):
+        from tests.testkit import _store_id
+        from tidb_tpu.session import Session, new_store
+        s = Session(new_store(f"memory://modcol{next(_store_id)}"))
+        s.execute("create database d; use d")
+        s.execute("create table t (a bigint primary key, b int, "
+                  "c varchar(10))")
+        s.execute("insert into t values (1, 5, 'hello')")
+        return s
+
+    def test_widen_int_and_varchar(self):
+        s = self._mk()
+        s.execute("alter table t modify column b bigint")
+        s.execute("alter table t modify c varchar(100)")
+        info = s.info_schema().table_by_name("d", "t").info
+        import tidb_tpu.mysqldef as my
+        assert info.find_column("b").field_type.tp == my.TypeLonglong
+        assert info.find_column("c").field_type.flen == 100
+        # existing rows still read correctly after the metadata change
+        assert s.execute("select b, c from t")[0].values() == [[5, "hello"]]
+        s.execute("insert into t values (2, 9999999999, 'x' )")
+        assert s.execute("select b from t where a = 2")[0].values() == \
+            [[9999999999]]
+
+    def test_narrowing_and_class_changes_rejected(self):
+        import pytest
+        from tidb_tpu import errors
+        s = self._mk()
+        for bad in ["alter table t modify c varchar(5)",      # shrink
+                    "alter table t modify b varchar(20)",     # int → string
+                    "alter table t modify c int",             # string → int
+                    "alter table t modify b int unsigned"]:   # signedness
+            with pytest.raises(errors.TiDBError):
+                s.execute(bad)
+        with pytest.raises(errors.TiDBError):
+            s.execute("alter table t modify zz bigint")       # no such col
+
+    def test_review_repros(self):
+        """Round-4 review: flags survive MODIFY; storage width governs
+        int changes; decimal scale cannot shrink to 0; ALL+DISTINCT."""
+        import pytest
+        from tidb_tpu import errors
+        s = self._mk()
+        # no-op retype of the pk keeps pk-handle detection working
+        s.execute("alter table t modify a bigint")
+        assert s.execute("select b from t where a = 1")[0].values() == [[5]]
+        info = s.info_schema().table_by_name("d", "t").info
+        assert info.pk_handle_column() is not None
+        # tinyint(30) is NOT wider than bigint, whatever its display width
+        with pytest.raises(errors.TiDBError):
+            s.execute("alter table t modify b tinyint(30)")
+        # decimal scale cannot shrink to 0
+        s.execute("create table td (x decimal(10,2) primary key)")
+        with pytest.raises(errors.TiDBError):
+            s.execute("alter table td modify x decimal(10)")
+        s.execute("alter table td modify x decimal(12,2)")   # widen ok
+        with pytest.raises(errors.TiDBError) as ei:
+            s.execute("select all distinct a from t")
+        assert getattr(ei.value, "code", None) == 1221

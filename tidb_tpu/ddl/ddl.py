@@ -236,6 +236,35 @@ class DDL:
         job = self._new_job(ActionType.ADD_COLUMN, db.id, tbl.id, [col_json])
         self._run_job(job)
 
+    def modify_column(self, db_name: str, table_name: str,
+                      spec: ColumnSpec) -> None:
+        """ALTER TABLE MODIFY COLUMN: metadata-only field-type change,
+        restricted to widenings the stored encoding already satisfies
+        (ddl/ddl.go:1070 modifiable; ddl/column.go:421 onModifyColumn)."""
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        old = tbl.info.find_column(spec.name)
+        if old is None or old.state != SchemaState.PUBLIC:
+            raise errors.UnknownFieldError(
+                f"column {spec.name} doesn't exist")
+        if not _modifiable(old.field_type, spec.field_type):
+            raise errors.TiDBError(
+                f"unsupported modify column {spec.name}", code=8200)
+        # MODIFY only changes the TYPE: structural flags (pk-handle
+        # detection, NOT NULL, auto_increment, key markers) carry over
+        new_ft = spec.field_type.clone()
+        struct = (my.PriKeyFlag | my.NotNullFlag | my.AutoIncrementFlag |
+                  my.UniqueKeyFlag | my.MultipleKeyFlag)
+        new_ft.flag = (new_ft.flag & ~struct) | (old.field_type.flag & struct)
+        new_col = ColumnInfo(old.id, old.name, old.offset, new_ft,
+                             old.default_value, old.has_default,
+                             old.original_default, old.comment,
+                             state=old.state)
+        job = self._new_job(ActionType.MODIFY_COLUMN, db.id, tbl.id,
+                            [new_col.to_json()])
+        self._run_job(job)
+
     def drop_column(self, db_name: str, table_name: str, col_name: str) -> None:
         schema = self.handle.get()
         tbl = schema.table_by_name(db_name, table_name)
@@ -437,6 +466,7 @@ class DDL:
                 ActionType.ADD_INDEX: self._on_add_index,
                 ActionType.DROP_INDEX: self._on_drop_index,
                 ActionType.ADD_COLUMN: self._on_add_column,
+                ActionType.MODIFY_COLUMN: self._on_modify_column,
                 ActionType.DROP_COLUMN: self._on_drop_column,
             }[job.tp]
         except KeyError:
@@ -668,6 +698,23 @@ class DDL:
 
     # ---- column ops ----
 
+    def _on_modify_column(self, txn, m: Meta, job: DDLJob) -> bool:
+        """Metadata-only swap of the column's FieldType
+        (ddl/column.go:421 onModifyColumn)."""
+        new_col = ColumnInfo.from_json(job.args[0])
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        old = info.find_column(new_col.name)
+        if old is None or old.state != SchemaState.PUBLIC:
+            raise errors.UnknownFieldError(
+                f"column {new_col.name} doesn't exist")
+        old.field_type = new_col.field_type
+        m.update_table(job.schema_id, info)
+        m.bump_schema_version()
+        job.state = JobState.DONE
+        return True
+
     def _on_add_column(self, txn, m: Meta, job: DDLJob) -> bool:
         col = ColumnInfo.from_json(job.args[0])
         info = m.get_table(job.schema_id, job.table_id)
@@ -719,3 +766,33 @@ class DDL:
             return True
         m.update_table(job.schema_id, info)
         return True
+
+
+_INT_WIDTH = {}  # storage-width rank, NOT display flen
+
+
+def _modifiable(origin, to) -> bool:
+    """ddl/ddl.go:1070: a MODIFY may only widen — same type class, no
+    flen/decimal/storage-width shrink, same charset/collation, same
+    signedness."""
+    from tidb_tpu import mysqldef as my
+    if not _INT_WIDTH:
+        _INT_WIDTH.update({my.TypeTiny: 1, my.TypeShort: 2, my.TypeInt24: 3,
+                           my.TypeLong: 4, my.TypeLonglong: 5})
+    if to.flen >= 0 and to.flen < (origin.flen or 0):
+        return False
+    if to.decimal >= 0 and to.decimal < max(origin.decimal, 0):
+        return False
+    if origin.tp in my.STRING_TYPES:
+        if (origin.charset, origin.collate) != (to.charset, to.collate):
+            return False
+    if my.has_unsigned_flag(origin.flag) != my.has_unsigned_flag(to.flag):
+        return False
+    if origin.tp in _INT_WIDTH:
+        # integers widen by STORAGE width (tinyint < ... < bigint); the
+        # display flen says nothing about what values the rows hold
+        return to.tp in _INT_WIDTH and \
+            _INT_WIDTH[to.tp] >= _INT_WIDTH[origin.tp]
+    if origin.tp in my.STRING_TYPES:
+        return to.tp in my.STRING_TYPES
+    return origin.tp == to.tp

@@ -258,6 +258,13 @@ def _alter(session, ddl, db: str, table: str, spec: ast.AlterTableSpec):
     if spec.tp == ast.AlterTableType.ADD_COLUMN:
         specs, _ = _column_specs([spec.column], [])
         ddl.add_column(db, table, specs[0])
+    elif spec.tp == ast.AlterTableType.MODIFY_COLUMN:
+        if spec.column.options:
+            raise errors.ExecError(
+                "unsupported modify column: only a plain field type "
+                "change is allowed")
+        specs, _ = _column_specs([spec.column], [])
+        ddl.modify_column(db, table, specs[0])
     elif spec.tp == ast.AlterTableType.DROP_COLUMN:
         ddl.drop_column(db, table, spec.name)
     elif spec.tp == ast.AlterTableType.ADD_CONSTRAINT:

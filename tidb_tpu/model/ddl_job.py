@@ -24,6 +24,7 @@ class ActionType(enum.IntEnum):
     ADD_INDEX = 7
     DROP_INDEX = 8
     TRUNCATE_TABLE = 9
+    MODIFY_COLUMN = 10
 
 
 class JobState(enum.IntEnum):

@@ -238,16 +238,21 @@ class Parser:
     def _parse_select(self) -> ast.SelectStmt:
         self._expect_kw("SELECT")
         stmt = ast.SelectStmt()
-        # select options may appear in any order (parser.y SelectStmtOpts)
+        # select options may appear in any order (parser.y SelectStmtOpts),
+        # but ALL and DISTINCT conflict (MySQL ER_WRONG_USAGE 1221)
+        saw_all = False
         while True:
             if self._try_kw("STRAIGHT_JOIN"):
                 stmt.straight_join = True   # keep the written join order
             elif self._try_kw("DISTINCT"):
                 stmt.distinct = True
             elif self._try_kw("ALL"):
-                pass
+                saw_all = True
             else:
                 break
+        if saw_all and stmt.distinct:
+            raise errors.TiDBError(
+                "Incorrect usage of ALL and DISTINCT", code=1221)
         stmt.fields = self._parse_select_fields()
         if self._try_kw("FROM"):
             stmt.from_ = self._parse_table_refs()
@@ -804,8 +809,15 @@ class Parser:
                 else:
                     stmt.specs.append(ast.AlterTableSpec(
                         ast.AlterTableType.DROP_COLUMN, name=self._ident()))
+            elif self._at(lx.IDENT) and \
+                    self._cur().val.lower() == "modify":
+                self._next()
+                self._try_kw("COLUMN")
+                stmt.specs.append(ast.AlterTableSpec(
+                    ast.AlterTableType.MODIFY_COLUMN,
+                    column=self._parse_column_def()))
             else:
-                self._fail("expected ADD or DROP in ALTER TABLE")
+                self._fail("expected ADD/DROP/MODIFY in ALTER TABLE")
             if not self._try_op(","):
                 return stmt
 

@@ -115,6 +115,7 @@ class AlterTableType(enum.IntEnum):
     ADD_CONSTRAINT = 3  # add index/key
     DROP_INDEX = 4
     DROP_PRIMARY_KEY = 5
+    MODIFY_COLUMN = 6   # ast.AlterTableModifyColumn
 
 
 @dataclass
