@@ -259,12 +259,12 @@ def kernel_probe(session, client, sql: str, runs: int):
     jax.block_until_ready(r)          # compile + first dispatch
     t0 = time.time()
     for _ in range(runs):
-        i_arr, f_arr = jitted(planes, live)
-        # read the (tiny, packed) outputs back: on this platform even
+        packed = jitted(planes, live)
+        # read the (tiny, packed) output back: on this platform even
         # post-D2H block_until_ready can return before some executables
         # finish — the result D2H is the only certified completion point,
         # and it is what every real query pays anyway
-        np.asarray(i_arr), np.asarray(f_arr)
+        np.asarray(packed)
     return (time.time() - t0) / runs
 
 

@@ -117,3 +117,30 @@ def test_straight_join(s):
     with pytest.raises(errors.TiDBError):
         validate(Parser().parse_one(
             "select (select max(count(c)) from u) from t"))
+
+
+def test_row_expressions(s):
+    """Row comparisons decompose to scalar 3VL expressions
+    (evaluator_binop.go row compare; MySQL lexicographic ordering)."""
+    s.execute("create table r (a bigint primary key, b int)")
+    s.execute("insert into r values (1,3), (2,3), (3,1), (4,null)")
+    q = lambda sql: s.execute(sql)[0].values()
+    assert q("select a from r where (a, b) in ((1,3), (2,3)) "
+             "order by a") == [[1], [2]]
+    assert q("select a from r where (a, b) = (3, 1)") == [[3]]
+    assert q("select a from r where (a, b) != (1, 3) order by a") == \
+        [[2], [3], [4]]
+    # lexicographic: (1,3) < (2,99); (2,3) < (2,99)
+    assert q("select a from r where (a, b) < (2, 99) order by a") == \
+        [[1], [2]]
+    assert q("select a from r where (a, b) >= (2, 3) order by a") == \
+        [[2], [3], [4]]
+    # NULL propagates through the row compare
+    assert q("select 1 where (1, null) = (1, 2)") == []
+    assert q("select a from r where (a, b) not in ((1,3)) order by a") == \
+        [[2], [3], [4]]   # (4,NULL): NOT(4=1 AND ...) = NOT(FALSE) = TRUE
+    with pytest.raises(errors.TiDBError):
+        s.execute("select 1 where (1, 2) = (1, 2, 3)")   # arity mismatch
+    # ORM-scale IN lists must not blow the rewriter's recursion
+    big = ", ".join(f"({i}, {i})" for i in range(2000))
+    assert q(f"select a from r where (a, b) in ({big})") == []

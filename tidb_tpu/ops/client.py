@@ -303,9 +303,8 @@ class TpuClient(kv.Client):
                 outs = [np.asarray(o)
                         for o in self.mesh.run_grouped(fn, planes, live)]
             else:
-                i_arr, f_arr = jitted(planes, live)
-                outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
-                                              np.asarray(f_arr))
+                packed = jitted(planes, live)
+                outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
             return self._emit_grouped(sel, batch, specs, gspec,
                                       fn.radices, outs)
         fn, wrapper, jitted = self._kernel(
@@ -315,9 +314,8 @@ class TpuClient(kv.Client):
             outs = [np.asarray(o)
                     for o in self.mesh.run_scalar(fn, planes, live)]
         else:
-            i_arr, f_arr = jitted(planes, live)
-            outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
-                                          np.asarray(f_arr))
+            packed = jitted(planes, live)
+            outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
         return self._emit_scalar(sel, batch, specs, outs)
 
     def _emit_scalar(self, sel, batch, specs, outs) -> SelectResponse:
@@ -435,9 +433,8 @@ class TpuClient(kv.Client):
                 sel, batch, f"rank{cap}",
                 lambda cap=cap: kernels.build_ranked_group_fn(
                     where, specs, group_cols, cap))
-            i_arr, f_arr = jitted(planes, live)
-            outs = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
-                                          np.asarray(f_arr))
+            packed = jitted(planes, live)
+            outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
             ngroups = int(outs[0])
             if ngroups <= cap - 1:
                 self._rank_cap_start[ck] = cap
@@ -582,9 +579,8 @@ class TpuClient(kv.Client):
                                           lambda: kernels.build_filter_fn(where))
         planes = kernels.batch_planes(batch)
         live = kernels.device_live(batch)
-        i_arr, f_arr = jitted(planes, live)
-        (mask_out,) = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
-                                             np.asarray(f_arr))
+        packed = jitted(planes, live)
+        (mask_out,) = kernels.unpack_outputs(wrapper, np.asarray(packed))
         mask = mask_out.astype(bool)
         idx = np.nonzero(mask)[0]
         if sel.desc:
@@ -609,9 +605,9 @@ class TpuClient(kv.Client):
         _, wrapper, jitted = self._kernel(sel, batch, "topn", build)
         planes = kernels.batch_planes(batch)
         live = kernels.device_live(batch)
-        i_arr, f_arr = jitted(planes, live)
-        idx_out, n_live = kernels.unpack_outputs(wrapper, np.asarray(i_arr),
-                                                 np.asarray(f_arr))
+        packed = jitted(planes, live)
+        idx_out, n_live = kernels.unpack_outputs(wrapper,
+                                                 np.asarray(packed))
         idx = np.asarray(idx_out)[: int(n_live)]
         return self._emit_rows(sel, batch, idx)
 

@@ -44,46 +44,70 @@ POS_CID = -1
 
 
 def pack_outputs(fn):
-    """Wrap a kernel so it returns (int64_stack, f64_stack) instead of a
-    tuple of per-aggregate results — ONE device→host transfer per dtype
-    per query instead of one per output. On tunneled platforms (axon) each
-    D2H costs a full round trip, so this dominates small-query latency.
+    """Wrap a kernel so it returns ONE f64 array instead of a tuple of
+    per-aggregate results — a single device→host transfer per query. On
+    tunneled platforms (axon) each D2H readback costs a full round trip
+    (~120 ms measured), which dominates query latency: the round-3 bench's
+    Q1 kernel spent ~240 ms on exactly two readbacks (the old
+    one-per-dtype packing) over ~0 ms of compute.
+
+    Encoding: f64 outputs ride verbatim. int64 outputs ride as EXACT
+    (hi, lo) f64 pairs — hi = floor(v / 2^32) ∈ [-2^31, 2^31), lo =
+    v mod 2^32 ∈ [0, 2^32), both integers f64 represents exactly, so the
+    full int64 range (decimal fixed-point sums) survives; a direct
+    f64↔i64 bitcast would be cheaper but the TPU x64-emulation rewrite
+    rejects it. bool / narrow-int outputs (filter masks) fit one exact
+    f64 slot each, keeping their transfer at 8 bytes/row.
 
     The wrapper's .layout (populated at trace time) maps original output
-    index → ('i'|'f', row) in the stacked arrays."""
+    index → (kind, offset, length) in the packed array."""
     layout: list = []
 
     def fn2(planes, live):
         layout.clear()
         outs = fn(planes, live)
-        ints, floats = [], []
-        i_off = f_off = 0
+        parts = []
+        off = 0
         for o in outs:
             o = jnp.atleast_1d(o)
             flat = o.reshape(-1)
+            n = flat.shape[0]
             if o.dtype == jnp.float64:
-                layout.append(("f", f_off, flat.shape[0]))
-                floats.append(flat)
-                f_off += flat.shape[0]
-            else:
-                layout.append(("i", i_off, flat.shape[0]))
-                ints.append(flat.astype(jnp.int64))
-                i_off += flat.shape[0]
-        i_arr = jnp.concatenate(ints) if ints else jnp.zeros(0, jnp.int64)
-        f_arr = jnp.concatenate(floats) if floats else jnp.zeros(
-            0, jnp.float64)
-        return i_arr, f_arr
+                layout.append(("f", off, n))
+                parts.append(flat)
+                off += n
+            elif o.dtype == jnp.int64:
+                hi = jnp.floor_divide(flat, 1 << 32).astype(jnp.float64)
+                lo = jnp.mod(flat, 1 << 32).astype(jnp.float64)
+                layout.append(("i", off, n))
+                parts.extend([hi, lo])
+                off += 2 * n
+            else:   # bool / int32-and-under: exact in one f64 slot
+                layout.append(("s", off, n))
+                parts.append(flat.astype(jnp.float64))
+                off += n
+        if not parts:
+            return jnp.zeros(0, jnp.float64)
+        return jnp.concatenate(parts)
 
     fn2.layout = layout
     fn2.inner = fn
     return fn2
 
 
-def unpack_outputs(wrapper, i_arr: np.ndarray, f_arr: np.ndarray) -> list:
-    """Host-side: packed arrays → list of per-output numpy values."""
+def unpack_outputs(wrapper, packed: np.ndarray) -> list:
+    """Host-side: packed f64 array → list of per-output numpy values
+    (int64 outputs reassembled exactly from their hi/lo pairs)."""
     out = []
     for kind, off, n in wrapper.layout:
-        arr = (f_arr if kind == "f" else i_arr)[off:off + n]
+        if kind == "f":
+            arr = packed[off:off + n]
+        elif kind == "i":
+            hi = packed[off:off + n].astype(np.int64)
+            lo = packed[off + n:off + 2 * n].astype(np.int64)
+            arr = (hi << np.int64(32)) + lo
+        else:
+            arr = packed[off:off + n].astype(np.int64)
         out.append(arr[0] if n == 1 else arr)
     return out
 

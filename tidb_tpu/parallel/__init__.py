@@ -93,9 +93,8 @@ class CoprMesh:
             self._jit_cache[id(fn)] = ent
             if len(self._jit_cache) > 256:
                 self._jit_cache.pop(next(iter(self._jit_cache)))
-        i_arr, f_arr = ent[2](planes, jnp.asarray(live))
-        return _kernels.unpack_outputs(ent[1], np.asarray(i_arr),
-                                       np.asarray(f_arr))
+        packed = ent[2](planes, jnp.asarray(live))
+        return _kernels.unpack_outputs(ent[1], np.asarray(packed))
 
     # the client calls these; signatures match the single-chip jit path
     def run_scalar(self, fn, planes, live):

@@ -788,6 +788,9 @@ class PlanBuilder:
                             Constant(Datum.string(iv.unit))]
                     return _fold(ScalarFunction(
                         fname, args, _func_ret_type(fname, args)))
+                if isinstance(n.left, ast.RowExpr) or \
+                        isinstance(n.right, ast.RowExpr):
+                    return rw(_lower_row_compare(n))
                 return new_op(n.op, rw(n.left), rw(n.right))
             if isinstance(n, ast.UnaryOp):
                 return new_op(n.op, rw(n.operand))
@@ -828,6 +831,28 @@ class PlanBuilder:
                 both = new_op(Op.AndAnd, ge, le)
                 return new_op(Op.UnaryNot, both) if n.not_ else both
             if isinstance(n, ast.InExpr):
+                if isinstance(n.expr, ast.RowExpr):
+                    # (a,b) IN ((1,2),…) → OR of per-tuple row equalities
+                    # (evaluator_binop.go row compare, decomposed so 3VL
+                    # NULL semantics come from AND/OR composition). The OR
+                    # tree is BALANCED: a left-deep chain would recurse as
+                    # deep as the IN list is long and ORM-generated lists
+                    # run to thousands of tuples.
+                    terms = [_lower_row_compare(ast.BinaryOp(
+                        op=Op.EQ, left=n.expr, right=item))
+                        for item in n.items]
+                    if not terms:
+                        raise errors.PlanError("empty IN list")
+                    while len(terms) > 1:
+                        terms = [
+                            ast.BinaryOp(op=Op.OrOr, left=terms[i],
+                                         right=terms[i + 1])
+                            if i + 1 < len(terms) else terms[i]
+                            for i in range(0, len(terms), 2)]
+                    ors = terms[0]
+                    if n.not_:
+                        ors = ast.UnaryOp(op=Op.UnaryNot, operand=ors)
+                    return rw(ors)
                 args = [rw(n.expr)] + [rw(i) for i in n.items]
                 name = "not_in" if n.not_ else "in"
                 return ScalarFunction(name, args,
@@ -955,6 +980,56 @@ def _ast_children(node):
     if isinstance(node, ast.RowExpr):
         return list(node.values)
     return []
+
+
+def _lower_row_compare(n: "ast.BinaryOp") -> "ast.ExprNode":
+    """Row-expression comparison → scalar decomposition (MySQL row
+    semantics; reference evaluator_binop.go row compare):
+
+      (a,b) =  (x,y)  →  a=x AND b=y
+      (a,b) != (x,y)  →  NOT(a=x AND b=y)
+      (a,b) <  (x,y)  →  a<x OR (a=x AND b<y)     (lexicographic)
+      <= / > / >=     →  strict form OR full equality
+
+    3VL falls out of the AND/OR composition, matching MySQL's NULL
+    behavior for row compares."""
+    if (not isinstance(n.left, ast.RowExpr)
+            or not isinstance(n.right, ast.RowExpr)
+            or len(n.left.values) != len(n.right.values)):
+        raise errors.PlanError("Operand should contain equal column count")
+    ls, rs = n.left.values, n.right.values
+
+    def conj(op):
+        out = None
+        for a, b in zip(ls, rs):
+            t = ast.BinaryOp(op=op, left=a, right=b)
+            out = t if out is None else ast.BinaryOp(op=Op.AndAnd,
+                                                     left=out, right=t)
+        return out
+
+    if n.op == Op.EQ:
+        return conj(Op.EQ)
+    if n.op == Op.NE:
+        return ast.UnaryOp(op=Op.UnaryNot, operand=conj(Op.EQ))
+    if n.op in (Op.LT, Op.GT, Op.LE, Op.GE):
+        strict = Op.LT if n.op in (Op.LT, Op.LE) else Op.GT
+        out = None
+        for i in range(len(ls)):
+            term = None
+            for j in range(i):
+                eq = ast.BinaryOp(op=Op.EQ, left=ls[j], right=rs[j])
+                term = eq if term is None else ast.BinaryOp(
+                    op=Op.AndAnd, left=term, right=eq)
+            cmp_ = ast.BinaryOp(op=strict, left=ls[i], right=rs[i])
+            term = cmp_ if term is None else ast.BinaryOp(
+                op=Op.AndAnd, left=term, right=cmp_)
+            out = term if out is None else ast.BinaryOp(
+                op=Op.OrOr, left=out, right=term)
+        if n.op in (Op.LE, Op.GE):
+            out = ast.BinaryOp(op=Op.OrOr, left=out, right=conj(Op.EQ))
+        return out
+    raise errors.PlanError(
+        f"row expressions do not support operator {n.op!r}")
 
 
 def _field_name(expr) -> str:
