@@ -324,3 +324,21 @@ class TestRankLadderOverflowCompactsToTuple:
             agg[k][1] += i % 13
         expect = [[g, h, c, v] for (g, h), (c, v) in sorted(agg.items())]
         assert [[int(x) for x in r] for r in rows] == expect
+
+
+def test_topn_limit_one():
+    """LIMIT 1 through the TPU top-k path (regression: unpack_outputs
+    scalarizes length-1 outputs; the index slice must restore the axis)."""
+    store = new_store("memory://topn1")
+    store.set_client(TpuClient(store))
+    s = Session(store)
+    s.execute("create database d; use d")
+    s.execute("create table t (a bigint primary key, b int)")
+    s.execute("insert into t values (1, 30), (2, 10), (3, 20)")
+    client = store.get_client()
+    before = client.stats["tpu_requests"]
+    assert s.execute("select a from t order by b limit 1")[0].values() == \
+        [[2]]
+    assert s.execute("select a from t order by b desc limit 1")[0] \
+        .values() == [[1]]
+    assert client.stats["tpu_requests"] > before

@@ -608,7 +608,8 @@ class TpuClient(kv.Client):
         packed = jitted(planes, live)
         idx_out, n_live = kernels.unpack_outputs(wrapper,
                                                  np.asarray(packed))
-        idx = np.asarray(idx_out)[: int(n_live)]
+        # LIMIT 1: unpack scalarizes length-1 outputs — restore the axis
+        idx = np.atleast_1d(np.asarray(idx_out))[: int(n_live)]
         return self._emit_rows(sel, batch, idx)
 
     def _emit_rows(self, sel, batch, idx) -> SelectResponse:
