@@ -1,0 +1,171 @@
+"""Concurrency chaos: writers, readers, online DDL, and GC running
+simultaneously against one store, then full consistency checks.
+
+The reference's equivalents are the race-enabled suites (Makefile `race`
+target) and the DDL-with-concurrent-writes tests (ddl/*_test.go with
+Callback hooks); here threads provide the interleavings and ADMIN CHECK
+TABLE + invariant queries provide the oracle.
+
+Invariants verified at the end:
+  - ADMIN CHECK TABLE passes (row/index consistency both directions)
+  - the running balance total is exactly preserved across random
+    transfer transactions (optimistic retry must lose no updates)
+  - every row inserted by the writer threads is present exactly once
+  - reads during the run never see a torn transfer (sum invariant)
+"""
+
+import random
+import threading
+
+import pytest
+
+from tidb_tpu import errors
+from tidb_tpu.session import Session, new_store
+from tests.testkit import _store_id
+
+N_ACCOUNTS = 40
+START_BALANCE = 1000
+
+
+@pytest.fixture
+def store():
+    return new_store(f"memory://chaos{next(_store_id)}")
+
+
+def _session(store, db=True):
+    s = Session(store)
+    if db:
+        s.execute("use d")
+    return s
+
+
+def test_concurrent_transfers_ddl_and_reads(store):
+    root = Session(store)
+    root.execute("create database d")
+    root.execute("use d")
+    root.execute("create table acct (id bigint primary key, bal bigint, "
+                 "note varchar(32))")
+    rows = ", ".join(f"({i}, {START_BALANCE}, 'init')"
+                     for i in range(N_ACCOUNTS))
+    root.execute(f"insert into acct values {rows}")
+    root.execute("create table audit_log (id bigint primary key "
+                 "auto_increment, who int)")
+
+    stop = threading.Event()
+    failures: list = []
+    torn: list = []
+    retries = {"n": 0}
+
+    def transfer_worker(seed):
+        s = _session(store)
+        rng = random.Random(seed)
+        for _ in range(60):
+            if stop.is_set():
+                return
+            a, b = rng.sample(range(N_ACCOUNTS), 2)
+            amt = rng.randint(1, 50)
+            try:
+                # one txn: debit a, credit b (retry loop inside session)
+                s.execute("begin")
+                s.execute(f"update acct set bal = bal - {amt} "
+                          f"where id = {a}")
+                s.execute(f"update acct set bal = bal + {amt} "
+                          f"where id = {b}")
+                s.execute("commit")
+            except errors.TiDBError:
+                retries["n"] += 1
+                try:
+                    s.execute("rollback")
+                except errors.TiDBError:
+                    pass
+
+    def insert_worker(tid):
+        s = _session(store)
+        for i in range(50):
+            if stop.is_set():
+                return
+            try:
+                s.execute(f"insert into audit_log (id, who) values "
+                          f"({tid * 1000 + i}, {tid})")
+            except errors.TiDBError as e:
+                failures.append(("insert", tid, i, str(e)))
+
+    def reader_worker():
+        s = _session(store)
+        for _ in range(40):
+            if stop.is_set():
+                return
+            # one retry: a read can legitimately race a schema change
+            # (the reference retries those); a SECOND failure is real
+            for attempt in (0, 1):
+                try:
+                    got = s.execute("select sum(bal) from acct")[0]                         .values()
+                    total = int(got[0][0])
+                    if total != N_ACCOUNTS * START_BALANCE:
+                        torn.append(total)
+                    break
+                except errors.TiDBError as e:
+                    if attempt:
+                        failures.append(("read", str(e)))
+
+    def ddl_worker():
+        s = _session(store)
+        ops = ["create index ib on acct (bal)",
+               "alter table acct add column tag int default 7",
+               "drop index ib on acct",
+               "alter table acct drop column tag",
+               "create index inote on acct (note)"]
+        for op in ops:
+            if stop.is_set():
+                return
+            # retryable races with in-flight txns (write conflict on a
+            # reorg batch, stale schema) get 3 attempts like the
+            # reference's job-queue retry; persistent failure is real
+            last = None
+            for _ in range(3):
+                try:
+                    s.execute(op)
+                    last = None
+                    break
+                except errors.TiDBError as e:
+                    last = e
+            if last is not None:
+                failures.append(("ddl", op, str(last)))
+
+    threads = ([threading.Thread(target=transfer_worker, args=(i,))
+                for i in range(3)]
+               + [threading.Thread(target=insert_worker, args=(i,))
+                  for i in range(2)]
+               + [threading.Thread(target=reader_worker)]
+               + [threading.Thread(target=ddl_worker)])
+    for t in threads:
+        t.start()
+    try:
+        wedged = []
+        for t in threads:
+            t.join(timeout=120)
+            if t.is_alive():
+                wedged.append(t.name)
+    finally:
+        stop.set()   # before any assert: a wedged worker must not keep
+        #              the other (non-daemon) threads spinning forever
+    assert not wedged, f"workers wedged: {wedged}"
+
+    assert not failures, failures[:5]
+    assert not torn, f"readers saw torn transfers: {torn[:5]}"
+
+    # final invariants
+    total = int(root.execute("select sum(bal) from acct")[0].values()[0][0])
+    assert total == N_ACCOUNTS * START_BALANCE, \
+        f"money {'appeared' if total > N_ACCOUNTS * START_BALANCE else 'vanished'}: {total}"
+    n = int(root.execute("select count(*) from audit_log")[0]
+            .values()[0][0])
+    assert n == 100, n
+    dup = root.execute("select id from audit_log group by id "
+                       "having count(*) > 1")[0].values()
+    assert dup == []
+    root.execute("admin check table acct")
+    root.execute("admin check table audit_log")
+    # informational: how often the optimistic-conflict path fired (the
+    # money invariant above is the correctness proof either way)
+    print(f"optimistic txn conflicts retried: {retries['n']}")

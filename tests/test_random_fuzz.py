@@ -41,7 +41,7 @@ def _build(store):
         "f1 double, d1 date, s1 varchar(16) collate utf8_general_ci, "
         "s2 varchar(16), e1 enum('lo','mid','hi'), m1 decimal(12,2))")
     tbl = s.info_schema().table_by_name("rf", "t")
-    date_tp = tbl.info.columns[5].field_type.tp
+    date_tp = tbl.info.columns[4].field_type.tp   # d1
 
     rng = random.Random(20260730)
     words = ["Ant", "ant", "BEE", "bee", "Cat", "cat", "dog", "DOG"]
