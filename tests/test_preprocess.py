@@ -144,3 +144,19 @@ def test_row_expressions(s):
     # ORM-scale IN lists must not blow the rewriter's recursion
     big = ", ".join(f"({i}, {i})" for i in range(2000))
     assert q(f"select a from r where (a, b) in ({big})") == []
+
+
+def test_do_and_convert_using(s):
+    """DO evaluates-and-discards (ast/misc.go DoStmt); CONVERT(expr USING
+    charset) validates the charset and yields the string (parser.y:2446)."""
+    assert s.execute("do 1 + 1, sleep(0)") == []
+    s.execute("set @side = 41")
+    assert s.execute("do @side + 1") == []   # evaluates, returns nothing
+    assert s.execute("do (select count(*) from t)") == []   # subquery form
+    assert s.execute("select convert('abc' using utf8)")[0].values() == \
+        [["abc"]]
+    assert s.execute("select convert(97 using latin1)")[0].values() == \
+        [["97"]]
+    with pytest.raises(errors.TiDBError) as ei:
+        s.execute("select convert('x' using klingon)")
+    assert _code(ei) == 1115

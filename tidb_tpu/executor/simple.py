@@ -72,6 +72,22 @@ def execute_simple(session, stmt) -> ResultSet | None:
         return _drop_user(session, stmt)
     if isinstance(stmt, ast.LoadDataStmt):
         return _load_data(session, stmt)
+    if isinstance(stmt, ast.DoStmt):
+        # DO: evaluate for side effects (sleep, get_lock), discard
+        # results (executor_simple.go DO handling). Subquery operands
+        # re-route through the planner as a SELECT whose rows are
+        # discarded (the reference's buildDo uses the full rewriter)
+        from tidb_tpu.plan.builder import PlanBuilder
+        from tidb_tpu.expression import Schema
+        builder = PlanBuilder(session.plan_ctx())
+        try:
+            for e in stmt.exprs:
+                builder.rewrite(e, Schema()).eval([])
+        except errors.PlanError:
+            sel = ast.SelectStmt(
+                fields=[ast.SelectField(expr=e) for e in stmt.exprs])
+            session.execute_stmt(sel, stmt.text or "do")
+        return None
     if isinstance(stmt, ast.KillStmt):
         return _kill(session, stmt)
     if isinstance(stmt, ast.FlushStmt):

@@ -47,7 +47,7 @@ BLOB TINYBLOB MEDIUMBLOB LONGBLOB DATE TIME DATETIME TIMESTAMP YEAR BIT
 UNSIGNED SIGNED ZEROFILL ENUM CHARACTER COLLATE CHARSET ENGINE ANALYZE
 PREPARE EXECUTE DEALLOCATE GRANT REVOKE IDENTIFIED TO PRIVILEGES WITH
 LOAD DATA LOCAL INFILE FIELDS TERMINATED ENCLOSED ESCAPED LINES STARTING
-KILL FLUSH REGEXP RLIKE STRAIGHT_JOIN
+KILL FLUSH REGEXP RLIKE STRAIGHT_JOIN DO
 """.split())
 
 _MULTI_OPS = ("<=>", "<<", ">>", "<=", ">=", "!=", "<>", "||", "&&", ":=")

@@ -153,6 +153,7 @@ class Parser:
             "ADMIN": self._parse_admin,
             "ANALYZE": self._parse_analyze,
             "LOAD": self._parse_load_data,
+            "DO": self._parse_do,
             "KILL": self._parse_kill,
             "FLUSH": self._parse_flush,
             "GRANT": self._parse_grant,
@@ -1068,6 +1069,14 @@ class Parser:
         what = self._ident("flush target").lower()
         return ast.FlushStmt(what=what)
 
+    def _parse_do(self) -> ast.DoStmt:
+        """DO expr[, expr…]: evaluate and discard (ast/misc.go DoStmt)."""
+        self._expect_kw("DO")
+        exprs = [self._parse_expr()]
+        while self._try_op(","):
+            exprs.append(self._parse_expr())
+        return ast.DoStmt(exprs=exprs)
+
     def _parse_kill(self) -> ast.KillStmt:
         self._expect_kw("KILL")
         query_only = False
@@ -1342,6 +1351,15 @@ class Parser:
             if self._try_kw("CONVERT"):
                 self._expect_op("(")
                 expr = self._parse_expr()
+                if self._try_kw("USING"):
+                    # CONVERT(expr USING charset) (parser.y:2446): text is
+                    # utf8 internally, so this validates the charset and
+                    # casts to char
+                    from tidb_tpu import charset as _cs
+                    _cs.get_charset_info(self._ident_or_string())
+                    self._expect_op(")")
+                    ftype = new_field_type(my.TypeVarString)
+                    return ast.CastExpr(expr=expr, cast_type=ftype)
                 self._expect_op(",")
                 ftype = self._parse_cast_type()
                 self._expect_op(")")

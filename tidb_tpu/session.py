@@ -608,7 +608,7 @@ def _is_simple(stmt) -> bool:
         ast.CreateIndexStmt, ast.DropIndexStmt, ast.AlterTableStmt,
         ast.AdminStmt, ast.AnalyzeTableStmt, ast.GrantStmt, ast.RevokeStmt,
         ast.CreateUserStmt, ast.DropUserStmt, ast.LoadDataStmt,
-        ast.KillStmt, ast.FlushStmt))
+        ast.DoStmt, ast.KillStmt, ast.FlushStmt))
 
 
 # ---------------------------------------------------------------------------

@@ -31,5 +31,5 @@ from tidb_tpu.sqlast.misc import (  # noqa: F401
     ShowStmt, ShowType, ExplainStmt, AdminStmt, AdminType,
     AnalyzeTableStmt, PrepareStmt, ExecuteStmt, DeallocateStmt,
     UserSpec, GrantStmt, RevokeStmt, CreateUserStmt, DropUserStmt,
-    LoadDataStmt, KillStmt, FlushStmt,
+    LoadDataStmt, DoStmt, KillStmt, FlushStmt,
 )

@@ -149,6 +149,13 @@ class FlushStmt(StmtNode):
 
 
 @dataclass
+class DoStmt(StmtNode):
+    """DO expr[, expr…] (ast/misc.go:412 DoStmt): expressions evaluate
+    for their side effects; results are discarded."""
+    exprs: list = field(default_factory=list)
+
+
+@dataclass
 class KillStmt(StmtNode):
     """KILL [QUERY | CONNECTION] id (ast/misc.go KillStmt)."""
     conn_id: int = 0
