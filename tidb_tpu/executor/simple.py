@@ -81,12 +81,17 @@ def execute_simple(session, stmt) -> ResultSet | None:
         from tidb_tpu.expression import Schema
         builder = PlanBuilder(session.plan_ctx())
         try:
-            for e in stmt.exprs:
-                builder.rewrite(e, Schema()).eval([])
+            # rewrite (plan) EVERY expr before evaluating ANY: if one
+            # needs the planner (subquery), nothing may have run yet —
+            # side effects like sleep() must fire exactly once
+            compiled = [builder.rewrite(e, Schema()) for e in stmt.exprs]
         except errors.PlanError:
             sel = ast.SelectStmt(
                 fields=[ast.SelectField(expr=e) for e in stmt.exprs])
             session.execute_stmt(sel, stmt.text or "do")
+            return None
+        for c in compiled:
+            c.eval([])
         return None
     if isinstance(stmt, ast.KillStmt):
         return _kill(session, stmt)

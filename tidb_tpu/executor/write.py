@@ -154,7 +154,10 @@ class InsertExec(Executor):
             expr_ast = _subst_values_func(expr_ast, tbl, full)
             e = PlanBuilder(self.ctx.plan_ctx()).rewrite(
                 expr_ast, _row_schema(tbl, old))
-            new[ci.offset] = cast_value(e.eval(old), ci)
+            # `old`/`new` are public-ORDER (row_with_cols); mid-DDL the
+            # model offset diverges from the public position
+            pos = _public_pos(tbl.info, ci.id)
+            new[pos] = cast_value(e.eval(old), ci)
         tbl.update_record(txn, handle, old, new)
 
     def _replace(self, txn, tbl, full, err=None):
@@ -202,9 +205,12 @@ def _subst_values_func(node, tbl, full):
 
 
 def _row_schema(tbl, row):
+    """Schema matching a PUBLIC-order row (row_with_cols / scan output):
+    mid-DDL the model column list is wider than the row, so indexing by
+    it would read the wrong positions."""
     from tidb_tpu.expression import Column, Schema
     s = Schema()
-    for i, ci in enumerate(tbl.info.columns):
+    for i, ci in enumerate(tbl.info.public_columns()):
         s.append(Column(col_name=ci.name, tbl_name=tbl.info.name,
                         ret_type=ci.field_type, index=i, position=i,
                         col_id=ci.id))
@@ -237,15 +243,21 @@ class UpdateExec(Executor):
             if handle is None:
                 raise errors.ExecError("UPDATE source lost row handles")
             updates.append((handle, list(row)))
+        # scan rows are public-ORDER; model offsets diverge during
+        # online DDL (half-added/half-dropped columns). Positions are
+        # per-statement constants — resolve once, not per row.
+        targets = []
+        for col, expr in self.plan.ordered_list:
+            ci = info.find_column(col.col_name)
+            targets.append((ci, _public_pos(info, ci.id), expr))
         for handle, row in updates:
             new_row = list(row)
             changed = False
-            for col, expr in self.plan.ordered_list:
-                ci = info.find_column(col.col_name)
+            for ci, pos, expr in targets:
                 d = cast_value(expr.eval(row), ci)
                 check_not_null(ci, d)
-                if _datum_changed(new_row[ci.offset], d):
-                    new_row[ci.offset] = d
+                if _datum_changed(new_row[pos], d):
+                    new_row[pos] = d
                     changed = True
             if changed:
                 tbl.update_record(txn, handle, row, new_row)
@@ -253,6 +265,15 @@ class UpdateExec(Executor):
         self.ctx.mark_dirty(info.id)
         self.ctx.set_affected_rows(affected)
         return None
+
+
+def _public_pos(info, col_id: int) -> int:
+    """Position of a column in the PUBLIC column list (= executor row
+    order). Updates may only target public columns."""
+    for pos, c in enumerate(info.public_columns()):
+        if c.id == col_id:
+            return pos
+    raise errors.UnknownFieldError(f"column id {col_id} is not public")
 
 
 def _datum_changed(old: Datum, new: Datum) -> bool:

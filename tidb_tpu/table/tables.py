@@ -191,15 +191,63 @@ class Table:
         return handle
 
     def remove_record(self, txn, handle: int, row: list[Datum]) -> None:
+        [row] = self._offset_aligned(txn, handle, [row])  # before delete:
+        #        hidden-column carry-over reads the stored row
         txn.delete(tc.encode_row_key(self.id, handle))
         for idx in self.indices:
             if idx.info.state == SchemaState.NONE:
                 continue
             idx.delete(txn, idx._values_for_row(row), handle)
 
+    def _offset_aligned(self, txn, handle: int, rows):
+        """Public-ORDER rows → model-OFFSET-aligned full rows.
+
+        Executor rows carry the statement's visible schema: one value per
+        PUBLIC column, in public-list order. The write paths below index
+        by model offset, which only coincides in steady state: during
+        online DDL a half-added column holds the offset past the public
+        width and a half-dropped one leaves a gap mid-row (F1 states;
+        model.TableInfo offsets stay stable until the job finishes).
+        Hidden writable columns get their STORED value carried through
+        (falling back to the original default) — every write must
+        preserve what the statement's schema cannot see, or the whole-row
+        rewrite would drop it."""
+        info = self.info
+        pubs = info.public_columns()
+        if len(pubs) == len(info.columns) and all(
+                c.offset == i for i, c in enumerate(pubs)):
+            return rows
+        stored = None
+        out = []
+        for row in rows:
+            if len(row) == len(info.columns):
+                out.append(row)   # already model-width (INSERT/REPLACE
+                continue          # full rows carry non-public columns)
+            full: list = [None] * len(info.columns)
+            for pos, c in enumerate(pubs):
+                full[c.offset] = row[pos]
+            for c in info.columns:
+                if full[c.offset] is None:
+                    if stored is None:
+                        try:
+                            raw = txn.get(tc.encode_row_key(self.id, handle))
+                            stored = tc.decode_row(raw)
+                        except errors.KeyNotExistsError:
+                            stored = {}   # no row value: defaults apply;
+                            # any OTHER storage error must propagate, not
+                            # silently rewrite hidden columns to defaults
+                    v = stored.get(c.id)
+                    full[c.offset] = (
+                        unflatten_datum(v, c.field_type) if v is not None
+                        else _missing_col_value(c))
+            out.append(full)
+        return out
+
     def update_record(self, txn, handle: int, old_row: list[Datum],
                       new_row: list[Datum], touched: list[bool] | None = None) -> None:
         info = self.info
+        old_row, new_row = self._offset_aligned(txn, handle,
+                                                [old_row, new_row])
         pk = info.pk_handle_column()
         if pk is not None:
             new_handle = new_row[pk.offset].get_int()
