@@ -169,3 +169,78 @@ def test_concurrent_transfers_ddl_and_reads(store):
     # informational: how often the optimistic-conflict path fired (the
     # money invariant above is the correctness proof either way)
     print(f"optimistic txn conflicts retried: {retries['n']}")
+
+
+def test_tpu_batch_cache_under_concurrent_writes(store):
+    """Concurrent writers vs TPU-tier readers: the device batch cache is
+    keyed by (ranges, data version) — a stale batch serving a newer
+    snapshot (or vice versa) would break the money invariant that every
+    snapshot read must see. Readers run through the pushed-aggregate TPU
+    path while transfers commit; any torn sum is a cache-coherence bug
+    (ops/client.py _get_batch version gating)."""
+    from tidb_tpu.ops import TpuClient
+
+    store.set_client(TpuClient(store))
+    root = Session(store)
+    root.execute("create database d")
+    root.execute("use d")
+    root.execute("create table acct (id bigint primary key, bal bigint)")
+    rows = ", ".join(f"({i}, {START_BALANCE})" for i in range(N_ACCOUNTS))
+    root.execute(f"insert into acct values {rows}")
+
+    stop = threading.Event()
+    torn: list = []
+    failures: list = []
+
+    def transfer_worker(seed):
+        s = _session(store)
+        rng = random.Random(seed)
+        for _ in range(40):
+            if stop.is_set():
+                return
+            a, b = rng.sample(range(N_ACCOUNTS), 2)
+            amt = rng.randint(1, 50)
+            try:
+                s.execute("begin")
+                s.execute(f"update acct set bal = bal - {amt} "
+                          f"where id = {a}")
+                s.execute(f"update acct set bal = bal + {amt} "
+                          f"where id = {b}")
+                s.execute("commit")
+            except errors.TiDBError:
+                try:
+                    s.execute("rollback")
+                except errors.TiDBError:
+                    pass
+
+    def tpu_reader():
+        s = _session(store)
+        for _ in range(30):
+            if stop.is_set():
+                return
+            try:
+                got = s.execute("select sum(bal), count(*) from acct")[0] \
+                    .values()
+                total, n = int(got[0][0]), int(got[0][1])
+                if total != N_ACCOUNTS * START_BALANCE or n != N_ACCOUNTS:
+                    torn.append((total, n))
+            except errors.TiDBError as e:
+                failures.append(str(e))
+
+    threads = ([threading.Thread(target=transfer_worker, args=(i,))
+                for i in range(2)]
+               + [threading.Thread(target=tpu_reader) for _ in range(2)])
+    for t in threads:
+        t.start()
+    try:
+        wedged = [t.name for t in threads if (t.join(timeout=180),
+                                              t.is_alive())[1]]
+    finally:
+        stop.set()
+    assert not wedged, wedged
+    assert not failures, failures[:3]
+    assert not torn, f"TPU reads saw torn snapshots: {torn[:5]}"
+    client = store.get_client()
+    assert client.stats["tpu_requests"] > 0, "readers never hit the TPU tier"
+    total = int(root.execute("select sum(bal) from acct")[0].values()[0][0])
+    assert total == N_ACCOUNTS * START_BALANCE

@@ -243,6 +243,7 @@ class TestWritesDuringColumnStates:
         assert ran and all(r is True for r in ran), ran
         # b incremented once per state transition; tag default intact
         rows = s.execute("select a, b, c, tag from t order by a")[0].values()
+        assert rows[0][1] == 10 + len(ran), rows   # every UPDATE landed
         assert rows[0][2] == "x" and rows[0][3] == 7
         assert rows[1] == [2, 20, "y", 7]
         s.execute("admin check table t")

@@ -153,7 +153,7 @@ class InsertExec(Executor):
             from tidb_tpu.plan.builder import PlanBuilder
             expr_ast = _subst_values_func(expr_ast, tbl, full)
             e = PlanBuilder(self.ctx.plan_ctx()).rewrite(
-                expr_ast, _row_schema(tbl, old))
+                expr_ast, _row_schema(tbl))
             # `old`/`new` are public-ORDER (row_with_cols); mid-DDL the
             # model offset diverges from the public position
             pos = _public_pos(tbl.info, ci.id)
@@ -204,7 +204,7 @@ def _subst_values_func(node, tbl, full):
     return node
 
 
-def _row_schema(tbl, row):
+def _row_schema(tbl):
     """Schema matching a PUBLIC-order row (row_with_cols / scan output):
     mid-DDL the model column list is wider than the row, so indexing by
     it would read the wrong positions."""

@@ -213,9 +213,18 @@ class Table:
         preserve what the statement's schema cannot see, or the whole-row
         rewrite would drop it."""
         info = self.info
-        pubs = info.public_columns()
-        if len(pubs) == len(info.columns) and all(
-                c.offset == i for i, c in enumerate(pubs)):
+        # steady-state fast path, cached behind the same (id, state)
+        # token _write_layout uses (per-row hot path on bulk writes)
+        token = tuple((c.id, c.state) for c in info.columns)
+        cached = getattr(self, "_align_cache", None)
+        if cached is not None and cached[0] == token:
+            pubs, identity = cached[1]
+        else:
+            pubs = info.public_columns()
+            identity = len(pubs) == len(info.columns) and all(
+                c.offset == i for i, c in enumerate(pubs))
+            self._align_cache = (token, (pubs, identity))
+        if identity:
             return rows
         stored = None
         out = []
