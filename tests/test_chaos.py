@@ -180,7 +180,7 @@ def test_tpu_batch_cache_under_concurrent_writes(store):
     (ops/client.py _get_batch version gating)."""
     from tidb_tpu.ops import TpuClient
 
-    store.set_client(TpuClient(store))
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
     root = Session(store)
     root.execute("create database d")
     root.execute("use d")

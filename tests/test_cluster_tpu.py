@@ -37,7 +37,7 @@ def _setup(store):
 def sessions():
     cpu_store = new_store("cluster://3/ctpu_cpu")
     tpu_store = new_store("cluster://3/ctpu_tpu")
-    tpu_store.set_client(TpuClient(tpu_store))
+    tpu_store.set_client(TpuClient(tpu_store, dispatch_floor_rows=0))
     return _setup(cpu_store), _setup(tpu_store)
 
 
@@ -131,7 +131,7 @@ def test_mesh_on_cluster(sessions):
     from tidb_tpu.parallel import CoprMesh
     cpu, _ = sessions
     store = new_store("cluster://3/ctpu_mesh")
-    store.set_client(TpuClient(store, mesh=CoprMesh()))
+    store.set_client(TpuClient(store, mesh=CoprMesh(), dispatch_floor_rows=0))
     s = _setup(store)
     for sql in ["select count(*), sum(a), min(a), max(a) from t",
                 "select b, count(*), sum(a) from t group by b order by b"]:

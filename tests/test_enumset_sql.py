@@ -73,6 +73,14 @@ def test_invalid_values_rejected(s):
         s.execute("insert into t values (9, null, 'z', null)")
     with pytest.raises(errors.TiDBError):
         s.execute("insert into t values (9, null, null, 256)")  # > BIT(8)
+    # negatives must overflow like the reference's uint64 parse — never
+    # wrap through Python's negative indexing into a live element
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (9, -1, null, null)")
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (9, null, -1, null)")
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (9, null, null, -1)")
 
 
 def test_index_on_enum_column(s):

@@ -303,6 +303,22 @@ class TestRound4Builtins:
         assert isinstance(ev(fn("curtime")).val, Duration)
         assert ev(fn("utc_date")).val.tp is not None
 
+    def test_now_and_curtime_fsp(self):
+        """CURTIME(n)/NOW(n) honor the fractional precision argument
+        (round-4 advice: the fsp arg was accepted but ignored)."""
+        from tidb_tpu.types.time_types import Duration
+        v = ev(fn("curtime", 3)).val
+        assert isinstance(v, Duration) and v.fsp == 3
+        assert v.nanos % 1_000_000 == 0          # truncated to millis
+        t0 = ev(fn("curtime", 0)).val
+        assert t0.fsp == 0 and t0.nanos % 1_000_000_000 == 0
+        n6 = ev(fn("now", 6)).val
+        assert n6.fsp == 6
+        n0 = ev(fn("now")).val
+        assert n0.fsp == 0 and n0.dt.microsecond == 0
+        with pytest.raises(errors.TiDBError):
+            ev(fn("curtime", 7))
+
     def test_regexp(self):
         assert self.g("regexp", "abcdef", "c.e") == 1
         assert self.g("regexp", "abcdef", "^c") == 0

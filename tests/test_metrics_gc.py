@@ -41,7 +41,7 @@ class TestMetrics:
     def test_tpu_fallback_counters(self):
         from tidb_tpu.ops import TpuClient
         store = new_store(f"memory://mgc{next(_store_id)}")
-        store.set_client(TpuClient(store))
+        store.set_client(TpuClient(store, dispatch_floor_rows=0))
         s = Session(store)
         before = metrics.counter("copr.tpu.requests").value
         s.execute("create database d; use d; create table t "

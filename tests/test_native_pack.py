@@ -104,7 +104,7 @@ class TestIncrementalCache:
     def _tpu_session(self):
         from tidb_tpu.ops import TpuClient
         store = new_store(f"memory://inc{next(_store_id)}")
-        store.set_client(TpuClient(store))
+        store.set_client(TpuClient(store, dispatch_floor_rows=0))
         s = Session(store)
         s.execute("create database d; use d")
         s.execute("create table t (id bigint primary key, a int, "

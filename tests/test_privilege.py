@@ -132,6 +132,15 @@ class TestGrantLevels:
         with pytest.raises(AccessDenied):
             s.execute("set tidb_copr_backend = 'cpu'")
 
+    def test_dispatch_floor_needs_global_grant(self, env):
+        """The floor re-routes every session's queries (store-level client
+        state) — same Grant gate as the backend switch."""
+        env.exec("create user 'df1'")
+        env.exec("grant select on app.* to 'df1'")
+        s = as_user(env, "df1")
+        with pytest.raises(AccessDenied):
+            s.execute("set global tidb_tpu_dispatch_floor = 0")
+
     def test_bare_star_grant_is_current_db_not_global(self, env):
         """GRANT ... ON * = current database (MySQL), NOT *.*."""
         env.exec("create user 'bs1'")

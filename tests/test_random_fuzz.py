@@ -81,10 +81,10 @@ def engines():
     sid = next(_store_id)
     cpu = _build(new_store(f"memory://rfz_cpu{sid}"))
     tstore = new_store(f"memory://rfz_tpu{sid}")
-    tstore.set_client(TpuClient(tstore))
+    tstore.set_client(TpuClient(tstore, dispatch_floor_rows=0))
     tpu = _build(tstore)
     mstore = new_store(f"memory://rfz_mesh{sid}")
-    mstore.set_client(TpuClient(mstore, mesh=CoprMesh()))
+    mstore.set_client(TpuClient(mstore, mesh=CoprMesh(), dispatch_floor_rows=0))
     mesh = _build(mstore)
     return cpu, tpu, mesh
 

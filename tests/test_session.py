@@ -155,3 +155,47 @@ class TestReviewRegressions:
         tk.exec("set @@global.tidb_distsql_scan_concurrency = 4")
         tk2 = tk.new_session()
         assert tk2.session.distsql_concurrency() == 4
+
+
+def test_set_transaction_isolation_end_to_end():
+    """Round-4 verdict missing #2: drivers issue SET TRANSACTION ISOLATION
+    LEVEL at connection setup. REPEATABLE READ is the engine's truth and
+    sets cleanly; other levels store the requested value but leave a
+    warning (snapshot isolation is what actually runs)."""
+    tk = TestKit()
+    tk.exec("set session transaction isolation level repeatable read")
+    assert tk.query("show warnings").rows == []
+    tk.query("select @@tx_isolation").check([["REPEATABLE-READ"]])
+    tk.exec("set transaction isolation level read committed")
+    warn = tk.query("show warnings").rows
+    assert len(warn) == 1 and warn[0][0] == "Warning"
+    assert "READ-COMMITTED" in warn[0][2]
+    tk.query("select @@tx_isolation").check([["READ-COMMITTED"]])
+    # diagnostics area resets on the next non-diagnostic statement
+    assert tk.query("show warnings").rows == []
+    with pytest.raises(errors.TiDBError):
+        tk.exec("set tx_isolation = 'chaos'")
+
+
+def test_microsecond_builtin():
+    tk = TestKit()
+    tk.query(
+        "select microsecond('2024-01-01 10:00:00.123456')"
+    ).check([[123456]])
+    tk.query("select microsecond(null)").check([[None]])
+
+
+def test_isolation_alias_and_global_warning():
+    """tx_isolation and transaction_isolation are one variable with two
+    names (Connector/J 8 reads the latter), and a GLOBAL-scope isolation
+    warning must survive the internal persist statements (review
+    findings: alias missing; nested execute wiped the warning)."""
+    tk = TestKit()
+    tk.exec("set transaction isolation level serializable")
+    tk.query("select @@tx_isolation, @@transaction_isolation").check(
+        [["SERIALIZABLE", "SERIALIZABLE"]])
+    tk.exec("set transaction_isolation = 'READ-COMMITTED'")
+    tk.query("select @@tx_isolation").check([["READ-COMMITTED"]])
+    tk.exec("set global transaction isolation level read uncommitted")
+    warn = tk.query("show warnings").rows
+    assert len(warn) == 1 and "READ-UNCOMMITTED" in warn[0][2]

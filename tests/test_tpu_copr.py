@@ -71,7 +71,7 @@ QUERIES = [
 def stores():
     cpu_store = new_store("memory://parity_cpu")
     tpu_store = new_store("memory://parity_tpu")
-    tpu_store.set_client(TpuClient(tpu_store))
+    tpu_store.set_client(TpuClient(tpu_store, dispatch_floor_rows=0))
     sessions = []
     for st in (cpu_store, tpu_store):
         s = Session(st)
@@ -174,7 +174,7 @@ def mesh_store(stores):
     """Same data, TPU client sharded over the 8 virtual devices."""
     from tidb_tpu.parallel import CoprMesh
     store = new_store("memory://parity_mesh")
-    store.set_client(TpuClient(store, mesh=CoprMesh()))
+    store.set_client(TpuClient(store, mesh=CoprMesh(), dispatch_floor_rows=0))
     s = Session(store)
     s.execute("create database test")
     s.execute("use test")
@@ -238,6 +238,15 @@ def test_set_copr_backend_sysvar():
     s.execute("set tidb_copr_backend = 'tpu'")
     client = s.store.get_client()
     assert isinstance(client, TpuClient)
+    # default dispatch floor: a 2-row scan cannot amortize the device
+    # round trip — the CPU engine answers, and no device dispatch happens
+    assert client.dispatch_floor_rows > 0
+    assert s.execute("select sum(a) from t")[0].values() == [[30]]
+    assert client.stats["small_to_cpu"] > 0
+    assert client.stats["tpu_requests"] == 0
+    # dropping the floor routes the same query to the device
+    s.execute("set global tidb_tpu_dispatch_floor = 0")
+    assert client.dispatch_floor_rows == 0
     assert s.execute("select sum(a) from t")[0].values() == [[30]]
     assert client.stats["tpu_requests"] > 0
 
@@ -259,7 +268,7 @@ class TestMeshHighNdvMinMax:
         from tidb_tpu.parallel import CoprMesh
         cpu_store = new_store("memory://ndvmm_cpu")
         mesh_store_ = new_store("memory://ndvmm_mesh")
-        mesh_store_.set_client(TpuClient(mesh_store_, mesh=CoprMesh()))
+        mesh_store_.set_client(TpuClient(mesh_store_, mesh=CoprMesh(), dispatch_floor_rows=0))
         for st in (cpu_store, mesh_store_):
             s = Session(st)
             s.execute("create database d")
@@ -292,7 +301,7 @@ class TestRankLadderOverflowCompactsToTuple:
 
     def test_overflow_falls_through_to_tuple_codes(self, monkeypatch):
         store = new_store("memory://rankovf")
-        store.set_client(TpuClient(store))
+        store.set_client(TpuClient(store, dispatch_floor_rows=0))
         s = Session(store)
         s.execute("create database d; use d")
         s.execute("create table t (id bigint primary key, g bigint, "
@@ -330,7 +339,7 @@ def test_topn_limit_one():
     """LIMIT 1 through the TPU top-k path (regression: unpack_outputs
     scalarizes length-1 outputs; the index slice must restore the axis)."""
     store = new_store("memory://topn1")
-    store.set_client(TpuClient(store))
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
     s = Session(store)
     s.execute("create database d; use d")
     s.execute("create table t (a bigint primary key, b int)")

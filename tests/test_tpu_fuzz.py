@@ -66,7 +66,7 @@ def _build(store):
 def sessions():
     cpu_store = new_store("memory://fuzz_cpu")
     tpu_store = new_store("memory://fuzz_tpu")
-    tpu_store.set_client(TpuClient(tpu_store))
+    tpu_store.set_client(TpuClient(tpu_store, dispatch_floor_rows=0))
     return _build(cpu_store), _build(tpu_store)
 
 
@@ -138,7 +138,7 @@ def test_index_with_pk_as_explicit_column():
     that column id twice (indexed datum + pk_handle) and the pack must not
     double-append its plane (regression: broadcast ValueError)."""
     store = new_store("memory://fuzz_pkidx")
-    store.set_client(TpuClient(store))
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
     s = Session(store)
     s.execute("create database d; use d")
     s.execute("create table t (id bigint primary key, a int)")
@@ -236,7 +236,7 @@ def test_too_fine_decimal_falls_back_cleanly():
     back to the CPU engine — NOT error (regression: TypeError_ escaped
     send())."""
     store = new_store("memory://fuzz_decfine")
-    store.set_client(TpuClient(store))
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
     s = Session(store)
     s.execute("create database d; use d")
     s.execute("create table t (a int primary key, p decimal(20,8))")

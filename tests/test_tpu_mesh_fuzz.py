@@ -66,7 +66,7 @@ def sessions():
 
     cpu_store = new_store("memory://meshfz_cpu")
     mesh_store = new_store("memory://meshfz_mesh")
-    mesh_store.set_client(TpuClient(mesh_store, mesh=CoprMesh()))
+    mesh_store.set_client(TpuClient(mesh_store, mesh=CoprMesh(), dispatch_floor_rows=0))
     return _build(cpu_store), _build(mesh_store)
 
 

@@ -1,0 +1,234 @@
+"""Cost-based TPU routing: requests below the device dispatch floor
+answer on the CPU engine, above it on the device — with result parity
+across the boundary.
+
+The floor prices the flat device dispatch+readback round trip against the
+CPU engine's per-row cost, the same tradeoff the reference encodes per
+access path via netWorkFactor/cpuFactor (plan/physical_plans.go:70-84).
+Two mechanisms, both covered here:
+  * pre-pack: planner histograms (ANALYZE) put est_rows on the request —
+    small scans route to CPU without packing a batch at all
+  * post-pack backstop: pseudo-stats scans pack once, and the exact batch
+    size routes every (cached) repeat below the floor to CPU
+"""
+
+import pytest
+
+from tidb_tpu.ops import TpuClient
+from tidb_tpu.ops import client as tpu_client_mod
+from tidb_tpu.session import Session, new_store
+
+
+def _tpu_session(name: str, floor: int):
+    store = new_store(f"memory://{name}")
+    client = TpuClient(store, dispatch_floor_rows=floor)
+    store.set_client(client)
+    s = Session(store)
+    s.execute("create database r")
+    s.execute("use r")
+    return s, client
+
+
+def test_default_floor_matches_sysvar_default():
+    from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+    assert SYSVAR_DEFAULTS["tidb_tpu_dispatch_floor"] == \
+        str(tpu_client_mod.DISPATCH_FLOOR_ROWS)
+    assert TpuClient(new_store("memory://floor_default")) \
+        .dispatch_floor_rows == tpu_client_mod.DISPATCH_FLOOR_ROWS
+
+
+def test_small_scan_routes_cpu_without_pack_when_analyzed():
+    s, client = _tpu_session("route_pre", floor=8)
+    s.execute("create table t (id bigint primary key, a int)")
+    s.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+    s.execute("analyze table t")
+    assert s.execute("select sum(a) from t")[0].values() == [[60]]
+    # histogram estimate (3 rows) < floor: no device dispatch AND no pack
+    assert client.stats["small_to_cpu"] > 0
+    assert client.stats["tpu_requests"] == 0
+    assert client.stats["batch_packs"] == 0
+
+
+def test_small_scan_routes_cpu_via_exact_backstop_without_stats():
+    s, client = _tpu_session("route_post", floor=8)
+    s.execute("create table t (id bigint primary key, a int)")
+    s.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+    # no ANALYZE: pseudo stats leave est_rows unset, so the engine packs
+    # once and the exact (3-row) batch falls below the floor
+    assert s.execute("select sum(a) from t")[0].values() == [[60]]
+    assert client.stats["small_to_cpu"] == 1
+    assert client.stats["tpu_requests"] == 0
+    assert client.stats["batch_packs"] == 1
+    # repeat: the cached batch answers the floor check — no repack
+    assert s.execute("select sum(a) from t")[0].values() == [[60]]
+    assert client.stats["small_to_cpu"] == 2
+    assert client.stats["batch_packs"] == 1
+    assert client.stats["batch_hits"] >= 1
+
+
+def test_large_scan_routes_tpu_above_floor():
+    s, client = _tpu_session("route_big", floor=8)
+    s.execute("create table t (id bigint primary key, a int)")
+    rows = ", ".join(f"({i}, {i * 3})" for i in range(1, 21))
+    s.execute(f"insert into t values {rows}")
+    want = sum(i * 3 for i in range(1, 21))
+    assert s.execute("select sum(a) from t")[0].values() == [[want]]
+    assert client.stats["tpu_requests"] > 0
+    assert client.stats["small_to_cpu"] == 0
+    # with ANALYZE the pre-pack estimate agrees: still the device
+    s.execute("analyze table t")
+    assert s.execute("select sum(a) from t")[0].values() == [[want]]
+    assert client.stats["small_to_cpu"] == 0
+
+
+def test_parity_across_the_routing_boundary():
+    """The same query set must answer identically on either side of the
+    floor — routing is a performance decision, never a semantic one."""
+    queries = [
+        "select sum(a), min(a), max(a), count(*) from t",
+        "select b, count(*), avg(a) from t group by b order by b",
+        "select count(distinct b) from t",
+        "select id from t where a > 9 order by a desc limit 3",
+    ]
+    results = {}
+    for floor in (0, 1_000_000):
+        s, client = _tpu_session(f"route_parity_{floor}", floor=floor)
+        s.execute("create table t (id bigint primary key, a int, "
+                  "b varchar(10))")
+        rows = ", ".join(f"({i}, {i % 7}, 'g{i % 3}')" for i in range(1, 31))
+        s.execute(f"insert into t values {rows}")
+        results[floor] = [s.execute(q)[0].values() for q in queries]
+        if floor == 0:
+            assert client.stats["tpu_requests"] > 0
+        else:
+            assert client.stats["tpu_requests"] == 0
+    assert results[0] == results[1_000_000]
+
+
+def test_distinct_below_floor_stays_request_global():
+    """Distinct aggregates were admitted on the promise of request-global
+    execution — the small-route must preserve that on a cluster store,
+    where the plain CPU path would under-merge per-region partials."""
+    store = new_store("cluster://4/route_distinct")
+    client = TpuClient(store, dispatch_floor_rows=1_000_000)
+    store.set_client(client)
+    s = Session(store)
+    s.execute("create database r")
+    s.execute("use r")
+    s.execute("create table t (id bigint primary key, a int)")
+    rows = ", ".join(f"({i}, {i % 5})" for i in range(1, 41))
+    s.execute(f"insert into t values {rows}")
+    assert s.execute("select count(distinct a) from t")[0].values() == [[5]]
+    assert client.stats["small_to_cpu"] > 0
+    assert client.stats["tpu_requests"] == 0
+
+
+def test_index_scan_carries_estimate():
+    s, client = _tpu_session("route_idx", floor=50)
+    s.execute("create table t (id bigint primary key, a int, key ia (a))")
+    rows = ", ".join(f"({i}, {i % 4})" for i in range(1, 101))
+    s.execute(f"insert into t values {rows}")
+    s.execute("analyze table t")
+    # an equality on the indexed column estimates ~25 rows < floor 50:
+    # the index request routes to CPU pre-pack
+    r = s.execute("select id from t where a = 1 order by id")[0].values()
+    assert r == [[i] for i in range(1, 101) if i % 4 == 1]
+    assert client.stats["small_to_cpu"] > 0
+    assert client.stats["batch_packs"] == 0
+
+
+def test_sysvar_validation():
+    s, client = _tpu_session("route_sysvar", floor=8)
+    with pytest.raises(Exception):
+        s.execute("set global tidb_tpu_dispatch_floor = -1")
+    with pytest.raises(Exception):
+        s.execute("set global tidb_tpu_dispatch_floor = 'lots'")
+    # GLOBAL-only: a session-scoped write would re-route every session
+    # through the shared store client while only this session's var
+    # recorded it (review finding)
+    with pytest.raises(Exception, match="GLOBAL"):
+        s.execute("set tidb_tpu_dispatch_floor = 1000")
+    assert client.dispatch_floor_rows == 8   # nothing mutated
+
+
+def test_floor_set_before_engine_swap_is_honored():
+    """A floor set while the CPU engine is active must carry into the
+    TpuClient that the backend swap creates (review finding: the sysvar
+    and the live floor diverged)."""
+    store = new_store("memory://route_swap")
+    s = Session(store)
+    s.execute("create database r")
+    s.execute("use r")
+    s.execute("set global tidb_tpu_dispatch_floor = 17")
+    s.execute("set tidb_copr_backend = 'tpu'")
+    assert store.get_client().dispatch_floor_rows == 17
+    s.execute("set tidb_copr_backend = 'cpu'")
+
+
+def test_floor_global_survives_restart(tmp_path):
+    """SET GLOBAL tidb_tpu_dispatch_floor persists to
+    mysql.global_variables and must hydrate back into both the global-var
+    cache and the TpuClient after a process restart (review finding: the
+    CLI path reverted to the default on restart)."""
+    from tidb_tpu.domain import clear_domains
+    from tidb_tpu.kv.kv import close_store
+    from tidb_tpu.session import _BOOTSTRAPPED_STORES, _global_vars_by_store
+    url = f"local://{tmp_path}/floor_db"
+    s = Session(new_store(url))
+    s.execute("set global tidb_tpu_dispatch_floor = 33")
+    uuid = s.store.uuid()
+    # simulate process death: evict every in-memory cache for the store
+    close_store(url)
+    clear_domains()
+    _BOOTSTRAPPED_STORES.discard(uuid)
+    _global_vars_by_store.pop(uuid, None)
+    s2 = Session(new_store(url))
+    assert s2.global_vars.get("tidb_tpu_dispatch_floor") == "33"
+    s2.execute("set tidb_copr_backend = 'tpu'")
+    assert s2.store.get_client().dispatch_floor_rows == 33
+    s2.execute("set tidb_copr_backend = 'cpu'")
+
+
+def test_range_scan_estimates_route_pre_pack():
+    """Handle-range scans carry a row estimate even without ANALYZE (the
+    span of finite PK ranges bounds the rows), so selective queries on
+    huge tables route to CPU before packing (review finding: est_rows
+    was the whole-table count and the fast path never fired)."""
+    s, client = _tpu_session("route_range", floor=50)
+    s.execute("create table t (id bigint primary key, a int)")
+    rows = ", ".join(f"({i}, {i})" for i in range(1, 201))
+    s.execute(f"insert into t values {rows}")
+    # pseudo stats: the BETWEEN span (10) bounds the rows — no pack
+    r = s.execute("select sum(a) from t where id between 1 and 10")
+    assert r[0].values() == [[55]]
+    assert client.stats["small_to_cpu"] == 1
+    assert client.stats["batch_packs"] == 0
+    # analyzed: the handle histogram estimates open-ended ranges too
+    s.execute("analyze table t")
+    r = s.execute("select sum(a) from t where id <= 10")
+    assert r[0].values() == [[55]]
+    assert client.stats["small_to_cpu"] == 2
+    assert client.stats["batch_packs"] == 0
+
+
+def test_backend_global_survives_restart(tmp_path):
+    """SET GLOBAL tidb_copr_backend='tpu' must restore the ENGINE on
+    restart, not just the variable's value (review finding: hydration
+    reported 'tpu' while the CPU client served)."""
+    from tidb_tpu.domain import clear_domains
+    from tidb_tpu.kv.kv import close_store
+    from tidb_tpu.session import _BOOTSTRAPPED_STORES, _global_vars_by_store
+    url = f"local://{tmp_path}/backend_db"
+    s = Session(new_store(url))
+    s.execute("set global tidb_tpu_dispatch_floor = 44")
+    s.execute("set global tidb_copr_backend = 'tpu'")
+    uuid = s.store.uuid()
+    close_store(url)
+    clear_domains()
+    _BOOTSTRAPPED_STORES.discard(uuid)
+    _global_vars_by_store.pop(uuid, None)
+    s2 = Session(new_store(url))
+    client = s2.store.get_client()
+    assert isinstance(client, TpuClient)
+    assert client.dispatch_floor_rows == 44
+    s2.execute("set tidb_copr_backend = 'cpu'")

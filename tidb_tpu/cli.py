@@ -49,8 +49,11 @@ def open_store(args):
     url = f"{args.store}://{args.path}"
     store = new_store(url)
     if args.copr == "tpu":
-        from tidb_tpu.ops import TpuClient
-        store.set_client(TpuClient(store))
+        from tidb_tpu.session import Session
+        # the swap path reads the persisted tidb_tpu_dispatch_floor global
+        # (mysql.global_variables) into the new client, so an operator's
+        # SET GLOBAL survives a server restart
+        Session(store, internal=True).apply_copr_backend("tpu")
     return store
 
 

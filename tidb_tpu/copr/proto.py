@@ -161,6 +161,13 @@ class SelectRequest:
     desc: bool = False                    # scan direction
     time_zone_offset: int = 0
     flags: int = 0
+    # TPU-tier extension (not in tipb): planner-estimated scan row count
+    # from ANALYZE histograms (None when only pseudo stats were available).
+    # The device engine uses it to price the dispatch round trip against
+    # the CPU engine's per-row cost BEFORE packing a batch — the same role
+    # as netWorkFactor/cpuFactor in the reference's calculateCost
+    # (plan/physical_plans.go:70-84), applied at the engine boundary.
+    est_rows: float | None = None
 
     def is_agg(self) -> bool:
         return bool(self.aggregates) or bool(self.group_by)

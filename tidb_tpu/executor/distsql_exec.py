@@ -138,6 +138,7 @@ class XSelectTableExec(Executor):
             order_by=list(scan.topn_pb),
             limit=scan.limit,
             desc=scan.desc,
+            est_rows=scan.est_rows,
         )
         if scan.aggregated_push_down:
             types = scan.agg_fields
@@ -206,7 +207,7 @@ class XSelectIndexExec(Executor):
         scan = self.scan_plan
         pb_index, pb_cols = self._index_pb()
         req = SelectRequest(start_ts=self.ctx.start_ts(), index_info=pb_index,
-                            desc=scan.desc)
+                            desc=scan.desc, est_rows=scan.est_rows)
         from tidb_tpu.copr.proto import field_type_from_pb_column
         field_types = [field_type_from_pb_column(c) for c in pb_cols]
         ranges = index_ranges_to_kv_ranges(scan.table_info.id, scan.index.id,
@@ -252,7 +253,8 @@ class XSelectIndexExec(Executor):
         scan = self.scan_plan
         req = SelectRequest(
             start_ts=self.ctx.start_ts(),
-            table_info=PBTableInfo(scan.table_info.id, _scan_pb_columns(scan)))
+            table_info=PBTableInfo(scan.table_info.id, _scan_pb_columns(scan)),
+            est_rows=float(len(handles)))  # exact: one row per handle
         ranges = handles_to_kv_ranges(scan.table_info.id, sorted(handles))
         types = [c.ret_type for c in scan.schema]
         return select(self.ctx.client, req, ranges, types,

@@ -132,19 +132,64 @@ def _set(session, stmt: ast.SetStmt):
             session.vars.users[va.name.lower()] = value
             continue
         sval = "" if value.is_null() else _datum_str(value)
+        names = [va.name]
+        if va.name.lower() in ("tx_isolation", "transaction_isolation"):
+            sval = _check_isolation_level(session, sval)
+            # one variable, two names (MySQL 5.7 / 8.0): writes through
+            # either must be visible through both — Connector/J 8 reads
+            # @@transaction_isolation, older drivers @@tx_isolation
+            names = ["tx_isolation", "transaction_isolation"]
         if va.name.lower() == "tidb_copr_backend":
             session.apply_copr_backend(sval)  # validates before storing
-        if va.is_global:
-            session.global_vars.set(va.name, sval)
-            session.persist_global_var(va.name, sval)
-        else:
-            session.vars.set_system(va.name, sval)
+        if va.name.lower() == "tidb_tpu_dispatch_floor":
+            if not va.is_global:
+                # the floor lives on the store-level client: a session-
+                # scoped write would re-route EVERY session while only
+                # this session's var recorded it (GLOBAL-only, like
+                # MySQL's ER_GLOBAL_VARIABLE)
+                raise errors.ExecError(
+                    "Variable 'tidb_tpu_dispatch_floor' is a GLOBAL "
+                    "variable and should be set with SET GLOBAL",
+                    code=1229)
+            session.apply_tpu_dispatch_floor(sval)
+        for name in names:
+            if va.is_global:
+                session.global_vars.set(name, sval)
+                session.persist_global_var(name, sval)
+            else:
+                session.vars.set_system(name, sval)
     return None
 
 
 def _datum_str(d: Datum) -> str:
     from tidb_tpu.expression.ops import _datum_to_str
     return _datum_to_str(d)
+
+
+_ISOLATION_LEVELS = ("REPEATABLE-READ", "READ-COMMITTED",
+                     "READ-UNCOMMITTED", "SERIALIZABLE")
+
+
+def _check_isolation_level(session, sval: str) -> str:
+    """tx_isolation assignment (SET TRANSACTION ISOLATION LEVEL or a
+    direct sysvar write): validate against MySQL's four levels and warn
+    when the requested level differs from what the engine actually
+    provides — every transaction runs snapshot-isolation
+    (REPEATABLE-READ), there is no per-level engine behavior to switch.
+    The reference parses-and-ignores (parser.y:3792); validating keeps
+    @@tx_isolation honest for drivers that read it back."""
+    norm = sval.strip().upper().replace(" ", "-")
+    if norm not in _ISOLATION_LEVELS:
+        raise errors.ExecError(
+            f"Variable 'tx_isolation' can't be set to the value of "
+            f"'{sval}'", code=1231)
+    if norm != "REPEATABLE-READ":
+        session.vars.warnings.append((
+            "Warning", 1105,
+            f"The isolation level '{norm}' is not supported; the engine "
+            "provides snapshot isolation (REPEATABLE-READ) for every "
+            "transaction"))
+    return norm
 
 
 # ---------------------------------------------------------------------------
@@ -430,7 +475,9 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
         return _str_rs(["Table", "Non_unique", "Key_name", "Seq_in_index",
                         "Column_name"], rows)
     if tp == ast.ShowType.WARNINGS:
-        return _str_rs(["Level", "Code", "Message"], [])
+        return _str_rs(["Level", "Code", "Message"],
+                       [[lv, str(code), msg]
+                        for lv, code, msg in session.vars.warnings])
     raise errors.ExecError(f"unsupported SHOW type {tp!r}")
 
 

@@ -602,18 +602,37 @@ def _char(args, row):
 
 # ---- time (evaluator/builtin_time.go; subset over types.time_types) ----
 
-def _now_time():
+def _eval_fsp(args, row) -> int:
+    """Optional fractional-seconds-precision argument (0..6, default 0)."""
+    if not args:
+        return 0
+    fd = args[0].eval(row)
+    if fd.is_null():
+        return 0
+    fsp = int(fd.get_int())
+    if not 0 <= fsp <= 6:
+        raise errors.ExecError(
+            f"Too-big precision {fsp} specified; maximum is 6", code=1426)
+    return fsp
+
+
+def _now_time(fsp: int = 0):
     import datetime as _dt
     from tidb_tpu import mysqldef as my
     from tidb_tpu.types.time_types import Time
-    return Time(_dt.datetime.now().replace(microsecond=0), my.TypeDatetime, 0)
+    now = _dt.datetime.now()
+    if fsp < 6:  # truncate micros to the requested precision
+        step = 10 ** (6 - fsp)
+        now = now.replace(microsecond=now.microsecond - now.microsecond % step
+                          if fsp else 0)
+    return Time(now, my.TypeDatetime, fsp)
 
 
 @register("now", 0, 1)
 @register("current_timestamp", 0, 1)
 @register("sysdate", 0, 1)
 def _now(args, row):
-    return Datum(Kind.TIME, _now_time())
+    return Datum(Kind.TIME, _now_time(_eval_fsp(args, row)))
 
 
 @register("curdate", 0, 0)
@@ -685,6 +704,13 @@ def _minute(args, row):
 @register("second", 1, 1)
 def _second(args, row):
     return _time_part(args, row, "second")
+
+
+@register("microsecond", 1, 1)
+def _microsecond(args, row):
+    """MICROSECOND(expr) — the last entry of the reference Funcs map
+    (evaluator/builtin.go) to gain a counterpart here."""
+    return _time_part(args, row, "microsecond")
 
 
 @register("date", 1, 1)
@@ -920,9 +946,11 @@ def _datediff(args, row):
 @register("current_time", 0, 1)
 def _curtime(args, row):
     from tidb_tpu.types.time_types import Duration
-    t = _now_time().dt
-    nanos = (t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000_000
-    return Datum(Kind.DURATION, Duration(nanos, 0))
+    fsp = _eval_fsp(args, row)
+    t = _now_time(fsp).dt
+    nanos = (t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000_000 \
+        + t.microsecond * 1_000
+    return Datum(Kind.DURATION, Duration(nanos, fsp))
 
 
 @register("utc_date", 0, 0)

@@ -118,6 +118,35 @@ class IndexInfo:
 
 
 @dataclass
+class FKInfo:
+    """Foreign-key metadata (model.FKInfo, reference model/model.go).
+    2016 semantics are metadata-only — the reference records the key and
+    never enforces referential integrity (ddl/foreign_key.go:46 "We just
+    support record the foreign key"); same contract here."""
+    id: int
+    name: str
+    cols: list[str]
+    ref_table: str
+    ref_cols: list[str]
+    on_delete: str = ""     # "" | RESTRICT | CASCADE | SET NULL | NO ACTION
+    on_update: str = ""
+    state: SchemaState = SchemaState.PUBLIC
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "name": self.name, "cols": self.cols,
+                "ref_table": self.ref_table, "ref_cols": self.ref_cols,
+                "on_delete": self.on_delete, "on_update": self.on_update,
+                "state": int(self.state)}
+
+    @staticmethod
+    def from_json(d: dict) -> "FKInfo":
+        return FKInfo(d["id"], d["name"], list(d["cols"]), d["ref_table"],
+                      list(d["ref_cols"]), d.get("on_delete", ""),
+                      d.get("on_update", ""),
+                      SchemaState(d.get("state", 4)))
+
+
+@dataclass
 class TableInfo:
     id: int
     name: str
@@ -129,6 +158,7 @@ class TableInfo:
     collate: str = "utf8_bin"
     comment: str = ""
     state: SchemaState = SchemaState.PUBLIC
+    foreign_keys: list[FKInfo] = field(default_factory=list)
 
     def to_json(self) -> dict:
         return {"id": self.id, "name": self.name,
@@ -136,7 +166,8 @@ class TableInfo:
                 "indices": [i.to_json() for i in self.indices],
                 "pk_is_handle": self.pk_is_handle,
                 "charset": self.charset, "collate": self.collate,
-                "comment": self.comment, "state": int(self.state)}
+                "comment": self.comment, "state": int(self.state),
+                "foreign_keys": [f.to_json() for f in self.foreign_keys]}
 
     @staticmethod
     def from_json(d: dict) -> "TableInfo":
@@ -145,7 +176,9 @@ class TableInfo:
                          [IndexInfo.from_json(i) for i in d.get("indices", [])],
                          d.get("pk_is_handle", False), 0,
                          d.get("charset", "utf8"), d.get("collate", "utf8_bin"),
-                         d.get("comment", ""), SchemaState(d.get("state", 4)))
+                         d.get("comment", ""), SchemaState(d.get("state", 4)),
+                         [FKInfo.from_json(f)
+                          for f in d.get("foreign_keys", [])])
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json(), separators=(",", ":")).encode()

@@ -45,6 +45,25 @@ def _n_outputs(spec) -> int:
     return 1 if spec.name == "count" else 2
 
 
+# Device-dispatch cost floor, in rows. Every device-routed request pays a
+# flat dispatch+readback round trip (measured ~110 ms through the axon
+# tunnel; see experiments/exp_crossover.py) that dwarfs the CPU engine's
+# per-row cost for small scans — the reference prices exactly this tradeoff
+# per access path via netWorkFactor/cpuFactor (plan/physical_plans.go:70-84).
+# Requests estimated (planner histograms) or measured (packed batch size)
+# below the floor route to the CPU engine. Overridable per-store with
+# SET GLOBAL tidb_tpu_dispatch_floor = N (0 disables). The sysvar default
+# is the single source of truth so SELECT @@tidb_tpu_dispatch_floor always
+# reports the floor a fresh client actually uses.
+from tidb_tpu.sessionctx import SYSVAR_DEFAULTS as _SYSVAR_DEFAULTS
+
+DISPATCH_FLOOR_ROWS = int(_SYSVAR_DEFAULTS["tidb_tpu_dispatch_floor"])
+
+
+class BelowFloor(Unsupported):
+    """Request is routable but too small to amortize the device round trip."""
+
+
 class _SingleResponse(kv.Response):
     def __init__(self, resp: SelectResponse):
         self._resp = resp
@@ -55,8 +74,11 @@ class _SingleResponse(kv.Response):
 
 
 class TpuClient(kv.Client):
-    def __init__(self, store, mesh=None):
+    def __init__(self, store, mesh=None, dispatch_floor_rows=None):
         self.store = store
+        self.dispatch_floor_rows = (DISPATCH_FLOOR_ROWS
+                                    if dispatch_floor_rows is None
+                                    else dispatch_floor_rows)
         # CPU fallback engine: the store's own coprocessor client (cluster
         # stores fan out per region with the retry ladder; localstore runs
         # in-process) — the TPU tier itself is storage-agnostic because it
@@ -67,10 +89,16 @@ class TpuClient(kv.Client):
         self.mesh = mesh            # parallel.CoprMesh for multi-chip
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
+        # (jitted, planes, live) of the most recent single-chip aggregate
+        # dispatch — bench.kernel_probe re-times EXACTLY this callable, so
+        # the "device kernel" figure can never diverge from what e2e ran
+        # (round-4 weak #1: a duplicated probe harness drifted and emitted
+        # a kernel time 290x the e2e time that contained it)
+        self._last_dispatch = None
         self._rank_cap_start: dict = {}
         self.stats = {"tpu_requests": 0, "cpu_fallbacks": 0,
                       "batch_packs": 0, "batch_hits": 0,
-                      "batch_appends": 0}
+                      "batch_appends": 0, "small_to_cpu": 0}
 
     # ------------------------------------------------------------------
     # capability probe: optimistic structural check; send() falls back on
@@ -102,6 +130,11 @@ class TpuClient(kv.Client):
 
     def send(self, req: kv.Request) -> kv.Response:
         sel: SelectRequest = req.data
+        # reset BEFORE any routing decision: a CPU-routed request must
+        # leave no stale kernel behind for the bench probe to mis-time.
+        # (Until the next request, the tuple pins the last batch's device
+        # planes — bounded retention, cleared on every send.)
+        self._last_dispatch = None
         routable = ((req.tp == kv.REQ_TYPE_SELECT
                      and sel.table_info is not None)
                     or (req.tp == kv.REQ_TYPE_INDEX
@@ -111,11 +144,19 @@ class TpuClient(kv.Client):
             self.stats["cpu_fallbacks"] += 1
             metrics.counter("copr.tpu.cpu_fallbacks").inc()
             return self.cpu.send(req)
+        floor = self.dispatch_floor_rows
+        if floor and sel.est_rows is not None and sel.est_rows < floor:
+            # planner histograms say the scan cannot amortize the device
+            # round trip — answer on CPU without even packing a batch
+            return self._route_small(req, sel)
         try:
             resp = self._send_tpu(req, sel)
             self.stats["tpu_requests"] += 1
             metrics.counter("copr.tpu.requests").inc()
             return _SingleResponse(resp)
+        except BelowFloor:
+            # exact row count (post-pack) under the floor: CPU is cheaper
+            return self._route_small(req, sel)
         except (Unsupported, errors.TypeError_):
             # TypeError_ = a column/value has no exact plane mapping
             # (e.g. decimal finer than the fixed-point scale): same
@@ -129,6 +170,17 @@ class TpuClient(kv.Client):
                 # global execution)
                 return self._cpu_global(req, sel)
             return self.cpu.send(req)
+
+    def _route_small(self, req: kv.Request, sel) -> kv.Response:
+        """Below the dispatch floor: the CPU engine answers. Distinct
+        aggregates were admitted on the promise of request-global
+        execution, so they take the single-region CPU path."""
+        from tidb_tpu import metrics
+        self.stats["small_to_cpu"] += 1
+        metrics.counter("copr.tpu.small_to_cpu").inc()
+        if any(e.distinct for e in sel.aggregates):
+            return self._cpu_global(req, sel)
+        return self.cpu.send(req)
 
     def _cpu_global(self, req: kv.Request, sel) -> kv.Response:
         from tidb_tpu.copr.region_handler import handle_request
@@ -227,6 +279,13 @@ class TpuClient(kv.Client):
         if sel.having is not None:
             raise Unsupported("having not lowered")
         batch = self._get_batch(sel, req.key_ranges)
+        if self.dispatch_floor_rows and batch.n_rows < self.dispatch_floor_rows:
+            # exact backstop for scans the planner could not estimate
+            # (pseudo stats): the packed batch is small enough that the
+            # device round trip costs more than a CPU scan — and the pack
+            # stays cached, so repeat queries skip straight to this check
+            raise BelowFloor(f"{batch.n_rows} rows < dispatch floor "
+                             f"{self.dispatch_floor_rows}")
         # per-request decode tables for datum reconstruction
         self._cur_batch = batch
         src = sel.table_info if sel.table_info is not None else sel.index_info
@@ -303,6 +362,7 @@ class TpuClient(kv.Client):
                 outs = [np.asarray(o)
                         for o in self.mesh.run_grouped(fn, planes, live)]
             else:
+                self._last_dispatch = (jitted, planes, live)
                 packed = jitted(planes, live)
                 outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
             return self._emit_grouped(sel, batch, specs, gspec,
@@ -314,6 +374,7 @@ class TpuClient(kv.Client):
             outs = [np.asarray(o)
                     for o in self.mesh.run_scalar(fn, planes, live)]
         else:
+            self._last_dispatch = (jitted, planes, live)
             packed = jitted(planes, live)
             outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
         return self._emit_scalar(sel, batch, specs, outs)

@@ -86,6 +86,23 @@ class Parser:
             self._fail(f"expected {'/'.join(kws)}")
         return self._next().val  # type: ignore[return-value]
 
+    # non-reserved words (lex as IDENT or KEYWORD depending on the list)
+    def _at_word(self, *words: str) -> bool:
+        t = self._cur()
+        return t.tp in (lx.KEYWORD, lx.IDENT) \
+            and str(t.val).upper() in words
+
+    def _try_word(self, *words: str) -> bool:
+        if self._at_word(*words):
+            self.pos += 1
+            return True
+        return False
+
+    def _expect_word(self, *words: str) -> str:
+        if not self._at_word(*words):
+            self._fail(f"expected {'/'.join(words)}")
+        return str(self._next().val).upper()
+
     def _at_op(self, *ops: str) -> bool:
         t = self._cur()
         return t.tp == lx.OP and t.val in ops
@@ -580,7 +597,8 @@ class Parser:
         stmt = ast.CreateTableStmt(table=table, if_not_exists=ine)
         self._expect_op("(")
         while True:
-            if self._at_kw("PRIMARY", "UNIQUE", "INDEX", "KEY", "CONSTRAINT"):
+            if self._at_kw("PRIMARY", "UNIQUE", "INDEX", "KEY", "CONSTRAINT") \
+                    or self._at_word("FOREIGN"):
                 stmt.constraints.append(self._parse_constraint())
             else:
                 stmt.cols.append(self._parse_column_def())
@@ -627,9 +645,20 @@ class Parser:
         return False
 
     def _parse_constraint(self) -> ast.Constraint:
+        symbol = ""
         if self._try_kw("CONSTRAINT"):
             if self._cur().tp == lx.IDENT:
-                self._ident()  # constraint symbol (ignored)
+                symbol = self._ident()  # constraint symbol (FK name)
+        if self._try_word("FOREIGN"):
+            # FOREIGN KEY [name] (cols) ReferDef (parser.y:1171)
+            self._expect_kw("KEY")
+            name = symbol
+            if self._cur().tp == lx.IDENT and not self._at_op("("):
+                name = self._ident("foreign key name")
+            keys = self._parse_paren_name_list()
+            return ast.Constraint(tp=ast.ConstraintType.FOREIGN_KEY,
+                                  name=name, keys=keys,
+                                  refer=self._parse_refer_def())
         if self._try_kw("PRIMARY"):
             self._expect_kw("KEY")
             tp = ast.ConstraintType.PRIMARY_KEY
@@ -642,6 +671,10 @@ class Parser:
             self._expect_kw("INDEX", "KEY")
             tp = ast.ConstraintType.INDEX
             name = self._ident("index name") if self._cur().tp == lx.IDENT else ""
+        keys = self._parse_paren_name_list()
+        return ast.Constraint(tp=tp, name=name, keys=keys)
+
+    def _parse_paren_name_list(self) -> list[str]:
         self._expect_op("(")
         keys = []
         while True:
@@ -652,7 +685,31 @@ class Parser:
             if not self._try_op(","):
                 break
         self._expect_op(")")
-        return ast.Constraint(tp=tp, name=name, keys=keys)
+        return keys
+
+    def _parse_refer_def(self) -> ast.ReferenceDef:
+        """REFERENCES tbl (cols) [ON DELETE opt] [ON UPDATE opt]
+        (parser.y:1181 ReferDef / OnDeleteOpt / OnUpdateOpt)."""
+        self._expect_word("REFERENCES")
+        refer = ast.ReferenceDef(table=self._parse_table_name())
+        refer.columns = self._parse_paren_name_list()
+        while self._try_kw("ON"):
+            which = self._expect_word("DELETE", "UPDATE")
+            if self._try_word("RESTRICT"):
+                opt = "RESTRICT"
+            elif self._try_word("CASCADE"):
+                opt = "CASCADE"
+            elif self._try_word("NO"):
+                self._expect_word("ACTION")
+                opt = "NO ACTION"
+            else:
+                self._expect_kw("SET")
+                opt = "SET " + self._expect_word("NULL", "DEFAULT")
+            if which == "DELETE":
+                refer.on_delete = opt
+            else:
+                refer.on_update = opt
+        return refer
 
     def _parse_column_def(self) -> ast.ColumnDef:
         name = self._ident("column name")
@@ -788,10 +845,14 @@ class Parser:
                     stmt.specs.append(ast.AlterTableSpec(
                         ast.AlterTableType.ADD_COLUMN,
                         column=self._parse_column_def()))
-                elif self._at_kw("PRIMARY", "UNIQUE", "INDEX", "KEY", "CONSTRAINT"):
+                elif self._at_kw("PRIMARY", "UNIQUE", "INDEX", "KEY",
+                                 "CONSTRAINT") or self._at_word("FOREIGN"):
+                    c = self._parse_constraint()
                     stmt.specs.append(ast.AlterTableSpec(
-                        ast.AlterTableType.ADD_CONSTRAINT,
-                        constraint=self._parse_constraint()))
+                        ast.AlterTableType.ADD_FOREIGN_KEY
+                        if c.tp == ast.ConstraintType.FOREIGN_KEY
+                        else ast.AlterTableType.ADD_CONSTRAINT,
+                        constraint=c))
                 else:
                     stmt.specs.append(ast.AlterTableSpec(
                         ast.AlterTableType.ADD_COLUMN,
@@ -807,6 +868,11 @@ class Parser:
                     self._expect_kw("KEY")
                     stmt.specs.append(ast.AlterTableSpec(
                         ast.AlterTableType.DROP_PRIMARY_KEY))
+                elif self._try_word("FOREIGN"):
+                    self._expect_kw("KEY")
+                    stmt.specs.append(ast.AlterTableSpec(
+                        ast.AlterTableType.DROP_FOREIGN_KEY,
+                        name=self._ident("foreign key name")))
                 else:
                     stmt.specs.append(ast.AlterTableSpec(
                         ast.AlterTableType.DROP_COLUMN, name=self._ident()))
@@ -860,6 +926,17 @@ class Parser:
             from tidb_tpu import charset as _cs
             _cs.get_charset_info(self._ident_or_string())   # 1115 on unknown
             return ast.SetStmt()
+        # SET [GLOBAL|SESSION] TRANSACTION TransactionChars (parser.y
+        # :3792-3814; the reference parses-and-ignores — here the isolation
+        # level maps onto @@tx_isolation with validation, because JDBC/ORMs
+        # issue this at connection setup and must not get a parse error)
+        save = self.pos
+        txn_global = bool(self._try_kw("GLOBAL"))
+        if not txn_global:
+            self._try_kw("SESSION")
+        if self._try_kw("TRANSACTION"):
+            return self._parse_set_transaction(txn_global)
+        self.pos = save
         stmt = ast.SetStmt()
         while True:
             is_global, is_system = False, False
@@ -884,6 +961,35 @@ class Parser:
             value = self._parse_expr()
             stmt.variables.append(ast.VariableAssignment(
                 name=name, value=value, is_global=is_global, is_system=is_system))
+            if not self._try_op(","):
+                return stmt
+
+    def _parse_set_transaction(self, is_global: bool) -> ast.SetStmt:
+        """TransactionChars: ISOLATION LEVEL <level> | READ WRITE |
+        READ ONLY, comma-separated (parser.y:3801-3814). Access-mode
+        chars parse and no-op (the engine has no read-only txns);
+        isolation levels become @@tx_isolation assignments."""
+        stmt = ast.SetStmt()
+        while True:
+            if self._try_word("ISOLATION"):
+                self._expect_word("LEVEL")
+                if self._try_word("REPEATABLE"):
+                    self._expect_word("READ")
+                    level = "REPEATABLE-READ"
+                elif self._try_word("SERIALIZABLE"):
+                    level = "SERIALIZABLE"
+                else:
+                    self._expect_word("READ")
+                    level = "READ-" + self._expect_word("COMMITTED",
+                                                        "UNCOMMITTED")
+                stmt.variables.append(ast.VariableAssignment(
+                    name="tx_isolation",
+                    value=ast.Literal(datum_from_py(level)),
+                    is_global=is_global, is_system=True))
+            elif self._try_word("READ"):
+                self._expect_word("WRITE", "ONLY")
+            else:
+                self._fail("expected ISOLATION LEVEL or READ WRITE/ONLY")
             if not self._try_op(","):
                 return stmt
 

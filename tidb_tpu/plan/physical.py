@@ -224,8 +224,14 @@ def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
                                 for i in ds.table_info.indices}
     else:
         primary_hinted = False
+    est_rows = None
+    if access and ds.table_info.id not in ctx.dirty:
+        est_rows = _estimate_table_ranges(ctx.stats(ds.table_info.id),
+                                          handle_col, table_ranges)
     if not access and ds.table_info.id not in ctx.dirty:
         stats = ctx.stats(ds.table_info.id)
+        if not stats.pseudo:
+            est_rows = float(stats.count)
         table_cost = stats.count * SCAN_FACTOR + stats.count * NET_WORK_FACTOR
         idx_plan, idx_cost = _try_index_scan(ds, rest, ctx, stats,
                                              hints_use, hints_ignore)
@@ -240,6 +246,7 @@ def _convert_datasource(ds: DataSource, ctx: PhysicalContext) -> Plan:
     scan = PhysicalTableScan()
     _fill_source(scan, ds)
     scan.ranges = table_ranges
+    scan.est_rows = est_rows
     if ds.table_info.id in ctx.dirty:
         scan.conditions = rest
         return _maybe_union_scan(scan, ds, conditions, ctx)
@@ -255,6 +262,39 @@ def _fill_source(scan, ds: DataSource) -> None:
     scan.table_info = ds.table_info
     scan.alias = ds.alias
     scan.schema = ds.schema
+
+
+def _estimate_table_ranges(stats, handle_col, ranges) -> float | None:
+    """Estimated rows under the scan's handle ranges: histogram counts
+    when ANALYZEd, else the exact handle-span upper bound when every range
+    is finite (rows <= span always — one row per handle — so routing
+    a below-floor span to CPU is safe). None when nothing can be said
+    (getRowCountByTableRanges, plan/physical_plan_builder.go:98)."""
+    from tidb_tpu.plan.refiner import I64_MAX, I64_MIN
+    from tidb_tpu.types import Datum
+    if not stats.pseudo and handle_col is not None:
+        total = 0.0
+        for r in ranges:
+            lo_open, hi_open = r.low <= I64_MIN, r.high >= I64_MAX
+            if lo_open and hi_open:
+                total += float(stats.count)
+            elif lo_open:
+                total += stats.less_row_count(handle_col.col_id,
+                                              Datum.i64(r.high + 1))
+            elif hi_open:
+                total += stats.greater_row_count(handle_col.col_id,
+                                                 Datum.i64(r.low - 1))
+            else:
+                total += stats.between_row_count(handle_col.col_id,
+                                                 Datum.i64(r.low),
+                                                 Datum.i64(r.high + 1))
+        return total
+    span = 0
+    for r in ranges:
+        if r.low <= I64_MIN or r.high >= I64_MAX:
+            return None
+        span += r.high - r.low + 1
+    return float(span)
 
 
 def _estimate_index_rows(stats, idx_cols, eq_vals, range_conds,
@@ -336,10 +376,10 @@ def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext,
             cost += rows * (NET_WORK_FACTOR + LOOKUP_FACTOR)
         if cost < best_cost:
             best_cost = cost
-            best = (idx, ranges, remained, not covered)
+            best = (idx, ranges, remained, not covered, rows)
     if best is None:
         return None, best_cost
-    idx, ranges, remained, double_read = best
+    idx, ranges, remained, double_read, est_rows = best
     scan = PhysicalIndexScan()
     _fill_source(scan, ds)
     scan.index = idx
@@ -347,6 +387,8 @@ def _try_index_scan(ds: DataSource, conditions, ctx: PhysicalContext,
     scan.conditions = remained
     scan.double_read = double_read
     scan.out_of_order = False
+    if not stats.pseudo:
+        scan.est_rows = est_rows
     return scan, best_cost
 
 

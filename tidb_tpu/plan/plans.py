@@ -328,6 +328,9 @@ class _PhysicalSource(PhysicalPlan):
         self.keep_order = False
         self.out_of_order = True
         self.aggregated_push_down = False
+        # histogram-estimated scan rows (None when only pseudo stats) —
+        # consumed by the TPU engine's dispatch-cost routing
+        self.est_rows: float | None = None
 
     def storage_schema(self) -> Schema:
         """Columns as fetched from storage (pre-agg layout)."""

@@ -78,6 +78,7 @@ class Session:
         self.vars = SessionVars()
         self.vars.connection_id = next(_conn_id_gen)
         self.killed = False
+        self._exec_depth = 0     # >0 while inside a nested internal execute
         # internal sessions (auth lookups, grant-table edits, stats loads)
         # stay OUT of the processlist/KILL registry: killing the server's
         # auth session would break every subsequent login
@@ -255,11 +256,23 @@ class Session:
         ev = ps.start_statement(self.vars.connection_id, sql_text)
         import time as _time
         t0 = _time.perf_counter()
+        from tidb_tpu.sqlast import ShowStmt, ShowType
+        if self._exec_depth == 0 and \
+                not (isinstance(stmt, ShowStmt)
+                     and stmt.tp == ShowType.WARNINGS):
+            # new TOP-LEVEL statement resets the diagnostics area; nested
+            # internal statements (e.g. persist_global_var's writes to
+            # mysql.global_variables) must not wipe the warnings their
+            # enclosing statement just produced
+            self.vars.warnings = []
+        self._exec_depth += 1
         try:
             rs = self._execute_one_inner(stmt, sql_text, record_history)
         except Exception as e:
             ps.end_statement(ev, error=str(e))
             raise
+        finally:
+            self._exec_depth -= 1
         ps.end_statement(ev, rows_sent=len(rs.rows) if rs is not None else 0,
                          rows_affected=self.vars.affected_rows)
         self._maybe_log_slow(sql_text, _time.perf_counter() - t0)
@@ -524,7 +537,19 @@ class Session:
         if backend == "tpu":
             from tidb_tpu.ops import TpuClient
             if not isinstance(self.store.get_client(), TpuClient):
-                self.store.set_client(TpuClient(self.store))
+                # honor the floor sysvar (session override, then global —
+                # the persisted global survives store restarts) so a floor
+                # set before the engine swap isn't silently lost
+                floor = None
+                sval = self.vars.get_system("tidb_tpu_dispatch_floor",
+                                            self.global_vars)
+                if sval is not None:
+                    try:
+                        floor = max(0, int(sval.strip()))
+                    except ValueError:
+                        pass
+                self.store.set_client(
+                    TpuClient(self.store, dispatch_floor_rows=floor))
         elif backend == "cpu":
             factory = getattr(self.store, "copr_cpu_client", None)
             if factory is not None:
@@ -532,6 +557,37 @@ class Session:
         else:
             raise errors.ExecError(
                 f"unknown tidb_copr_backend {backend!r} (cpu | tpu)")
+        # the var mirrors live store state: keep the cache in step with
+        # the engine actually installed so @@tidb_copr_backend never lies
+        self.global_vars.values["tidb_copr_backend"] = backend
+
+    def apply_tpu_dispatch_floor(self, value: str) -> None:
+        """SET tidb_tpu_dispatch_floor = N — rows below which a routable
+        request answers on CPU (0 disables the floor). Like the backend
+        switch, the floor lives on the store-level client, so it applies
+        to every session on this storage."""
+        try:
+            floor = int(value.strip())
+        except ValueError:
+            raise errors.ExecError(
+                f"tidb_tpu_dispatch_floor must be an integer, got {value!r}")
+        if floor < 0:
+            raise errors.ExecError(
+                "tidb_tpu_dispatch_floor must be >= 0")
+        if self.vars.user:
+            # store-wide blast radius (every session's routing changes):
+            # same global Grant gate as the backend switch above
+            from tidb_tpu import privilege
+            if not privilege.checker_for(self.store).check(
+                    self.vars.user, "", "", "Grant",
+                    host=self.vars.client_host):
+                raise privilege.AccessDenied(
+                    f"user '{self.vars.user}' needs the global GRANT "
+                    "privilege to set tidb_tpu_dispatch_floor")
+        from tidb_tpu.ops import TpuClient
+        client = self.store.get_client()
+        if isinstance(client, TpuClient):
+            client.dispatch_floor_rows = floor
 
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
@@ -678,7 +734,27 @@ def bootstrap(session: Session) -> None:
             return
         if session.info_schema().schema_exists("mysql"):
             _BOOTSTRAPPED_STORES.add(uuid)
-            return  # persisted store already bootstrapped
+            # persisted store already bootstrapped: hydrate the in-memory
+            # global-var cache from mysql.global_variables so SET GLOBALs
+            # survive a process restart (session.go loadCommonGlobalVars)
+            try:
+                rows = session.execute(
+                    "select variable_name, variable_value "
+                    "from mysql.global_variables")[0].values()
+            except errors.TiDBError:
+                return  # pre-sysvar-table store: defaults stand
+            gv = session.global_vars
+            for name, value in rows:
+                name = name.decode() if isinstance(name, bytes) else name
+                value = value.decode() if isinstance(value, bytes) else value
+                if value is not None and name.lower() in gv.values:
+                    gv.values[name.lower()] = value
+            # a hydrated engine choice must be APPLIED, not just reported —
+            # @@tidb_copr_backend mirrors the client actually installed
+            if gv.values.get("tidb_copr_backend", "").strip().lower() \
+                    == "tpu":
+                session.apply_copr_backend("tpu")
+            return
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,
                     CREATE_TABLES_PRIV_TABLE, CREATE_COLUMNS_PRIV_TABLE,

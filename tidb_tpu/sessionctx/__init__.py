@@ -33,6 +33,7 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "sql_select_limit": "18446744073709551615",
     "time_zone": "SYSTEM",
     "tx_isolation": "REPEATABLE-READ",
+    "transaction_isolation": "REPEATABLE-READ",   # MySQL 8 name, same var
     "version_comment": "TiDB-TPU Server",
     "version": my.SERVER_VERSION,
     "wait_timeout": "28800",
@@ -42,6 +43,9 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_skip_constraint_check": "0",
     # TPU coprocessor routing: cpu | tpu (this build's copr=tpu switch)
     "tidb_copr_backend": "cpu",
+    # rows below which a TPU-routable request answers on CPU (device
+    # dispatch-cost floor; ops.client.DISPATCH_FLOOR_ROWS derives from this)
+    "tidb_tpu_dispatch_floor": "8192",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
     "tidb_copr_batch_rows": "1048576",
@@ -55,6 +59,10 @@ class SessionVars:
         self.systems: dict[str, str] = {}       # session-scope overrides
         self._globals: "GlobalVars | None" = None  # bound by the session
         self.users: dict[str, Datum] = {}       # @user_vars
+        # statement-scoped diagnostics area: (level, code, message) rows
+        # for SHOW WARNINGS; cleared at the start of each non-diagnostic
+        # statement like MySQL's diagnostics area
+        self.warnings: list[tuple[str, int, str]] = []
         self.current_db = ""
         self.autocommit = True
         self.in_txn = False                     # explicit BEGIN active

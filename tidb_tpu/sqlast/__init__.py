@@ -24,7 +24,7 @@ from tidb_tpu.sqlast.ddl import (  # noqa: F401
     CreateDatabaseStmt, DropDatabaseStmt, CreateTableStmt, DropTableStmt,
     ColumnDef, ColumnOption, ColumnOptionType, Constraint, ConstraintType,
     CreateIndexStmt, DropIndexStmt, AlterTableStmt, AlterTableSpec,
-    AlterTableType, TruncateTableStmt,
+    AlterTableType, TruncateTableStmt, ReferenceDef,
 )
 from tidb_tpu.sqlast.misc import (  # noqa: F401
     BeginStmt, CommitStmt, RollbackStmt, UseStmt, SetStmt, VariableAssignment,

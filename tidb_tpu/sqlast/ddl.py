@@ -52,10 +52,21 @@ class ConstraintType(enum.IntEnum):
 
 
 @dataclass
+class ReferenceDef(Node):
+    """REFERENCES table (cols) [ON DELETE opt] [ON UPDATE opt]
+    (parser.y:1181 ReferDef)."""
+    table: "TableName" = None  # type: ignore[assignment]
+    columns: list[str] = field(default_factory=list)
+    on_delete: str = ""
+    on_update: str = ""
+
+
+@dataclass
 class Constraint(Node):
     tp: ConstraintType
     name: str = ""
     keys: list[str] = field(default_factory=list)
+    refer: ReferenceDef | None = None   # FOREIGN KEY only
 
 
 @dataclass
@@ -116,6 +127,8 @@ class AlterTableType(enum.IntEnum):
     DROP_INDEX = 4
     DROP_PRIMARY_KEY = 5
     MODIFY_COLUMN = 6   # ast.AlterTableModifyColumn
+    ADD_FOREIGN_KEY = 7   # via ADD_CONSTRAINT w/ FOREIGN_KEY constraint
+    DROP_FOREIGN_KEY = 8  # ast.AlterTableDropForeignKey
 
 
 @dataclass

@@ -72,7 +72,9 @@ def convert_datum(d: Datum, ft: FieldType) -> Datum:
                 v = int(_to_int(d, ft).val)
         else:
             v = int(_to_int(d, ft).val)
-        if width < 64 and v >= (1 << width):
+        if v < 0 or (width < 64 and v >= (1 << width)):
+            # BIT holds an unsigned bit pattern: negatives have no
+            # representation (and would blow up later encode contexts)
             raise errors.OverflowError_(
                 f"value {v} does not fit BIT({width})")
         return Datum(Kind.BIT, Bit(v, width))

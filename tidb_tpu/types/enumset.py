@@ -159,7 +159,7 @@ def parse_enum_name(elems: list[str], name: str) -> Enum:
 
 
 def parse_enum_value(elems: list[str], number: int) -> Enum:
-    if number == 0 or number > len(elems):
+    if number < 1 or number > len(elems):
         raise errors.TypeError_(
             f"number {number} overflows enum boundary [1, {len(elems)}]")
     return Enum(elems[number - 1], number)
@@ -185,7 +185,9 @@ def parse_set_name(elems: list[str], name: str) -> SetVal:
 
 
 def parse_set_value(elems: list[str], number: int) -> SetVal:
-    if number >= (1 << len(elems)):
+    if number < 0 or number >= (1 << len(elems)):
+        # the reference parses via uint64, so a negative can never reach
+        # its bounds check — reject, don't let Python's signed int wrap
         raise errors.TypeError_(
             f"number {number} overflows set {elems}")
     items = [n for i, n in enumerate(elems) if number & (1 << i)]
