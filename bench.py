@@ -121,8 +121,8 @@ def build_store(n_rows: int):
     batch = 20000
     for start in range(0, n_rows, batch):
         txn = store.begin()
-        for row in rows[start:start + batch]:
-            tbl.add_record(txn, row, skip_unique_check=True)
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
         txn.commit()
     load_s = time.time() - t0
     return store, s, tbl, load_s
@@ -225,6 +225,28 @@ def kernel_probe(client, runs: int):
     return (time.time() - t0) / runs
 
 
+def bytes_matched_sweep(elems: int, runs: int) -> float:
+    """Seconds for the simplest possible reduction over a plane of the
+    SAME size a config references — the roofline for THAT working set.
+    The 1 GB copy-sweep 'peak' is unreachable for small configs on this
+    rig (the flat dispatch round trip dominates below ~1 GB: a 10.2M-row
+    single-column sweep measures 0.7 GB/s where the 1 GB sweep measures
+    9.7 — experiments/exp_distinct_r5.py), so fraction-of-peak understated
+    small configs by up to 14x (round-4 weak #4: distinct's '7% of peak'
+    kernel is in fact AT its bytes-matched roofline)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    plane = jnp.ones(elems, jnp.float64)
+    f = jax.jit(lambda v: jnp.sum(v))
+    np.asarray(f(plane))
+    t0 = time.time()
+    for _ in range(runs):
+        np.asarray(f(plane))
+    return (time.time() - t0) / runs
+
+
 def measure_crossover(store, runs: int):
     """Empirical CPU/device crossover on a simple SUM over growing
     handle-range subsets — the measurement behind the dispatch-floor
@@ -269,6 +291,61 @@ def measure_crossover(store, runs: int):
             frac = d0 / (d0 - d1) if d0 != d1 else 0.0
             return int(sizes[i - 1] + frac * (sizes[i] - sizes[i - 1]))
     return -1
+
+
+def measure_join(n_left: int = 1_000_000, n_right: int = 100_000):
+    """Join-operator throughput at the verdict shape (1M probe x 100k
+    build): the numpy sort-merge fast path vs the per-row dict build/
+    probe, on pre-materialized rows so the figure isolates the JOIN (the
+    e2e query is scan-dominated and measures the row-decode path
+    instead). Returns (rows_per_sec_fast, speedup_vs_dict)."""
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.executor import executors
+    from tidb_tpu.expression import Column
+    from tidb_tpu.plan.plans import Join
+    from tidb_tpu.types import Datum
+    from tidb_tpu.types.field_type import new_field_type
+
+    class _Rows:
+        def __init__(self, rows, width):
+            self.rows, self.schema = rows, [None] * width
+
+        def drain(self):
+            return self.rows
+
+    ft = new_field_type(my.TypeLonglong)
+    lrows = [[Datum.i64(i), Datum.i64(i % n_right)]
+             for i in range(n_left)]
+    rrows = [[Datum.i64(i), Datum.i64(i * 3)] for i in range(n_right)]
+
+    class _Plan:
+        pass
+
+    plan = _Plan()
+    plan.eq_conditions = [(Column(ret_type=ft, index=1),
+                           Column(ret_type=ft, index=0))]
+    plan.right_conditions = []
+    plan.left_conditions = []
+    plan.other_conditions = []
+    plan.join_type = Join.INNER
+
+    times = {}
+    for label in ("vector", "dict"):
+        j = executors.HashJoinExec(_Rows(lrows, 2), _Rows(rrows, 2),
+                                   plan, None)
+        if label == "dict":
+            j._vector_tried = True
+            rit = iter(rrows)
+            j.children[1].next = lambda it=rit: next(it, None)
+            lit = iter(lrows)
+            j.children[0].next = lambda it=lit: next(it, None)
+        t0 = time.time()
+        n = 0
+        while j.next() is not None:
+            n += 1
+        times[label] = time.time() - t0
+        assert n == n_left, f"join produced {n} rows, expected {n_left}"
+    return n_left / times["vector"], times["dict"] / times["vector"]
 
 
 def timed_runs(session, sql: str, runs: int):
@@ -382,7 +459,7 @@ def main():
     # construction kernel <= e2e, and the bench FAILS if measurement says
     # otherwise (a broken probe must never reach BENCH_r*.json again)
     kernel_s: dict[str, float] = {}
-    speedups, tpu_rps_all, bw_figures = [], [], {}
+    speedups, tpu_rps_all, bw_figures, roofline = [], [], {}, {}
     for name, sql in configs:
         before = (tpu_client.stats["tpu_requests"],
                   tpu_client.stats["cpu_fallbacks"])
@@ -407,9 +484,15 @@ def main():
             kernel_s[name] = ks
             bw = n_rows * REFERENCED_COLS[name] * 9 / ks / 1e9
             bw_figures[name] = round(bw, 2)
+            sweep_t = bytes_matched_sweep(n_rows * REFERENCED_COLS[name],
+                                          runs)
+            roofline[name] = round(sweep_t / ks, 3)
             print(f"# {name}: device kernel {ks * 1000:.1f} ms/run "
                   f"({n_rows / ks:,.0f} rows/s/chip, {bw:.1f} GB/s = "
-                  f"{bw / hbm_peak * 100:.0f}% of peak)", file=sys.stderr)
+                  f"{bw / hbm_peak * 100:.0f}% of 1GB-sweep peak, "
+                  f"{roofline[name] * 100:.0f}% of its bytes-matched "
+                  f"roofline [{sweep_t * 1000:.0f} ms sweep])",
+                  file=sys.stderr)
         else:
             bw_figures[name] = 0.0
         print(f"# {name}: tpu e2e {tpu_s:.4f}s/run ({tpu_rps:,.0f} rows/s"
@@ -431,6 +514,11 @@ def main():
     print(f"# q1_mesh ({len(jax.devices())} devices): {mesh_s:.4f}s/run "
           f"({n_rows / mesh_s:,.0f} rows/s)", file=sys.stderr)
 
+    join_rps, join_speedup = measure_join()
+    print(f"# join (1M x 100k int key, operator-level): "
+          f"{join_rps:,.0f} probe rows/s, {join_speedup:.1f}x vs the "
+          "dict build/probe path", file=sys.stderr)
+
     geo_rps = math.exp(sum(math.log(x) for x in tpu_rps_all)
                        / len(tpu_rps_all))
     geo_speedup = math.exp(sum(math.log(x) for x in speedups)
@@ -449,9 +537,12 @@ def main():
         "hbm_fraction": {k: round(v / hbm_peak, 3)
                          for k, v in bw_figures.items()},
         "kernel_rows_per_sec": kernel_rps,
+        "roofline_fraction": roofline,
         "dispatch_floor_rows": tpu_client.dispatch_floor_rows,
         "routing_crossover_rows": crossover_rows,
         "small_query_ms": round(small_ms, 2),
+        "join_rows_per_sec": round(join_rps, 1),
+        "join_speedup_vs_dict": round(join_speedup, 2),
     }))
 
 

@@ -1,0 +1,60 @@
+"""Round-5 distinct-kernel isolation (post-poison, real chip).
+
+Question: at bench scale (10.2M rows), is the distinct sort kernel
+bandwidth-bound, or is it inside the flat ~110-130 ms dispatch window that
+every kernel on this rig pays? Compare:
+  1. pure sum sweep over the same i64 plane (bytes-matched roofline)
+  2. the actual _distinct_reduce kernel (sort + boundary count)
+  3. the same at 4x rows (does sort scale worse than linear?)
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.ops import kernels
+
+
+def bench(fn, *args, runs=5):
+    np.asarray(fn(*args))  # compile + poison-certified completion
+    t0 = time.time()
+    for _ in range(runs):
+        np.asarray(fn(*args))
+    return (time.time() - t0) / runs
+
+
+def main():
+    np.asarray(jnp.zeros(8))  # poison the tunnel
+
+    for n in (10_200_000, 40_800_000):
+        rng = np.random.RandomState(7)
+        v = jnp.asarray(rng.randint(1, n // 4, size=n).astype(np.int64))
+        contrib = jnp.asarray(rng.rand(n) < 0.97)
+
+        sweep = jax.jit(lambda x: jnp.sum(x))
+        t_sweep = bench(sweep, v)
+
+        dist = jax.jit(lambda x, c: kernels._distinct_reduce(x, c))
+        t_dist = bench(dist, v, contrib)
+
+        # what the bench's count(distinct) actually runs: XLA DCEs the
+        # distinct-sum half when only the count output is consumed
+        cnt_only = jax.jit(lambda x, c: kernels._distinct_reduce(x, c)[0])
+        t_cnt = bench(cnt_only, v, contrib)
+
+        sort_only = jax.jit(lambda x: jnp.sort(x)[-1])
+        t_sort = bench(sort_only, v)
+
+        gb = n * 8 / 1e9
+        print(f"n={n:,}: sweep {t_sweep*1e3:8.1f} ms ({gb/t_sweep:5.2f} GB/s)"
+              f"  sort {t_sort*1e3:8.1f} ms"
+              f"  cnt-distinct {t_cnt*1e3:8.1f} ms"
+              f"  distinct(cnt+sum) {t_dist*1e3:8.1f} ms "
+              f"({gb/t_dist:5.2f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()

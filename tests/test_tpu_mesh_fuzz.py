@@ -91,6 +91,17 @@ QUERIES = [
     "select a, f, b from t group by a, f order by a, f",
     # per-group distinct inside tuple-coded segments
     "select a, f, count(distinct e) from t group by a, f order by a, f",
+    # round-5: filter requests are row-sharded over the mesh (the mask
+    # comes back shard-major in global row order)
+    "select id from t where c > 0.5 order by id",
+    "select id, a from t where a > 3000 and f < 100 order by id",
+    "select id from t where b is null order by id",
+    # round-5: per-shard fixed-k top-k + host merge
+    "select id from t order by c desc limit 7",
+    "select id from t order by a limit 5",
+    "select id from t where c > 0.2 order by f desc, a limit 9",
+    "select id from t order by b limit 6",           # NULLs first asc
+    "select id from t order by b desc limit 6",      # NULLs last desc
 ]
 
 

@@ -75,6 +75,11 @@ class DistTxn(kv.Transaction):
         self._dirty = True
         self._us.set(key, value)
 
+    def set_many(self, pairs: list[tuple[bytes, bytes]]) -> None:
+        self._check()
+        self._dirty = True
+        self._us.set_many(pairs)
+
     def delete(self, key: bytes) -> None:
         self._check()
         self._dirty = True

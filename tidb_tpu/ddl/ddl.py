@@ -20,8 +20,8 @@ from tidb_tpu.ddl.callback import Callback
 from tidb_tpu.kv import run_in_new_txn
 from tidb_tpu.meta import Meta
 from tidb_tpu.model import (
-    ActionType, ColumnInfo, DBInfo, DDLJob, IndexColumn, IndexInfo, JobState,
-    SchemaState, TableInfo,
+    ActionType, ColumnInfo, DBInfo, DDLJob, FKInfo, IndexColumn, IndexInfo,
+    JobState, SchemaState, TableInfo,
 )
 from tidb_tpu.table import Table
 from tidb_tpu.types.field_type import FieldType
@@ -49,6 +49,18 @@ class IndexSpec:
     columns: list[str] = dc_field(default_factory=list)
     unique: bool = False
     primary: bool = False
+
+
+@dataclass
+class FKSpec:
+    """Foreign-key definition (reference ddl/ddl.go buildFKInfo :1240).
+    Metadata-only, matching the reference's 2016 semantics."""
+    name: str
+    cols: list[str] = dc_field(default_factory=list)
+    ref_table: str = ""
+    ref_cols: list[str] = dc_field(default_factory=list)
+    on_delete: str = ""
+    on_update: str = ""
 
 
 class DDL:
@@ -169,7 +181,8 @@ class DDL:
 
     def create_table(self, db_name: str, table_name: str, cols: list[ColumnSpec],
                      indexes: list[IndexSpec], charset: str = "utf8",
-                     collate: str = "utf8_bin") -> None:
+                     collate: str = "utf8_bin",
+                     fks: list[FKSpec] = ()) -> None:
         schema = self.handle.get()
         db = schema.schema_by_name(db_name)
         if db is None:
@@ -178,8 +191,37 @@ class DDL:
         if schema.table_exists(db_name, table_name):
             raise errors.TableExistsError(f"Table '{table_name}' already exists")
         tbl_json = self._build_table_info(table_name, cols, indexes,
-                                          charset, collate).to_json()
+                                          charset, collate, fks).to_json()
         job = self._new_job(ActionType.CREATE_TABLE, db.id, 0, [tbl_json])
+        self._run_job(job)
+
+    def create_foreign_key(self, db_name: str, table_name: str,
+                           spec: FKSpec) -> None:
+        """ALTER TABLE ADD FOREIGN KEY through the online-DDL queue
+        (reference ddl/ddl.go:1268 CreateForeignKey → foreign_key.go:23
+        onCreateForeignKey, none→public in one step)."""
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        fk = self._build_fk_info(tbl.info, spec)
+        job = self._new_job(ActionType.ADD_FOREIGN_KEY, db.id, tbl.id,
+                            [fk.to_json()])
+        self._run_job(job)
+
+    def drop_foreign_key(self, db_name: str, table_name: str,
+                         fk_name: str) -> None:
+        """Reference ddl/ddl.go:1299 DropForeignKey → foreign_key.go:76
+        onDropForeignKey (public→none)."""
+        schema = self.handle.get()
+        tbl = schema.table_by_name(db_name, table_name)
+        db = schema.schema_by_name(db_name)
+        if not any(f.name.lower() == fk_name.lower()
+                   for f in tbl.info.foreign_keys):
+            raise errors.TiDBError(
+                f"Can't DROP '{fk_name}'; check that column/key exists",
+                code=my.ErrCantDropFieldOrKey)
+        job = self._new_job(ActionType.DROP_FOREIGN_KEY, db.id, tbl.id,
+                            [fk_name])
         self._run_job(job)
 
     def drop_table(self, db_name: str, table_name: str) -> None:
@@ -286,9 +328,41 @@ class DDL:
 
     # ================= table-info construction =================
 
+    def _build_fk_info(self, info: TableInfo, spec: FKSpec,
+                       fk_id: int = 0) -> FKInfo:
+        """Validate + build FKInfo against a table's columns (reference
+        ddl/ddl.go:744 buildTableInfo FK branch + :1240 buildFKInfo)."""
+        if not spec.cols:
+            raise errors.TiDBError(
+                "foreign key should have one key at least", code=1215)
+        if len(spec.cols) != len(spec.ref_cols):
+            raise errors.TiDBError(
+                f"foreign key not match keys len {len(spec.cols)}, "
+                f"refkeys len {len(spec.ref_cols)}", code=1215)
+        for cn in spec.cols:
+            if info.find_column(cn) is None:
+                raise errors.UnknownFieldError(
+                    f"Key column '{cn}' doesn't exist in table")
+        name = spec.name or f"fk_{spec.cols[0].lower()}"
+        taken = {f.name.lower() for f in info.foreign_keys}
+        if name.lower() in taken:
+            if spec.name:
+                raise errors.TiDBError(
+                    f"duplicate foreign key {spec.name}", code=1826)
+            i = 1
+            while f"{name}_{i}".lower() in taken:
+                i += 1
+            name = f"{name}_{i}"
+        return FKInfo(id=fk_id, name=name, cols=list(spec.cols),
+                      ref_table=spec.ref_table,
+                      ref_cols=list(spec.ref_cols),
+                      on_delete=spec.on_delete, on_update=spec.on_update,
+                      state=SchemaState.PUBLIC)
+
     def _build_table_info(self, name: str, cols: list[ColumnSpec],
                           indexes: list[IndexSpec], charset: str = "utf8",
-                          collate: str = "utf8_bin") -> TableInfo:
+                          collate: str = "utf8_bin",
+                          fks: list[FKSpec] = ()) -> TableInfo:
         """Reference: ddl/ddl.go buildTableInfo + buildColumnsAndConstraints."""
         seen = set()
         columns = []
@@ -330,6 +404,8 @@ class DDL:
                 unique=spec.unique or spec.primary, primary=spec.primary,
                 state=SchemaState.PUBLIC))
             idx_id += 1
+        for i, fspec in enumerate(fks, 1):
+            info.foreign_keys.append(self._build_fk_info(info, fspec, i))
         return info
 
     # ================= job machinery =================
@@ -465,6 +541,8 @@ class DDL:
                 ActionType.TRUNCATE_TABLE: self._on_truncate_table,
                 ActionType.ADD_INDEX: self._on_add_index,
                 ActionType.DROP_INDEX: self._on_drop_index,
+                ActionType.ADD_FOREIGN_KEY: self._on_add_foreign_key,
+                ActionType.DROP_FOREIGN_KEY: self._on_drop_foreign_key,
                 ActionType.ADD_COLUMN: self._on_add_column,
                 ActionType.MODIFY_COLUMN: self._on_modify_column,
                 ActionType.DROP_COLUMN: self._on_drop_column,
@@ -694,6 +772,43 @@ class DDL:
             job.state = JobState.DONE
             return True
         m.update_table(job.schema_id, info)
+        return True
+
+    # ---- foreign key ops (reference ddl/foreign_key.go) ----
+
+    def _on_add_foreign_key(self, txn, m: Meta, job: DDLJob) -> bool:
+        """none→public in one step: FKs are recorded, never enforced
+        (foreign_key.go:46 "We just support record the foreign key")."""
+        fk = FKInfo.from_json(job.args[0])
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        if any(f.name.lower() == fk.name.lower()
+               for f in info.foreign_keys):
+            raise errors.TiDBError(f"duplicate foreign key {fk.name}",
+                                   code=1826)
+        fk.id = max([f.id for f in info.foreign_keys], default=0) + 1
+        fk.state = SchemaState.PUBLIC
+        info.foreign_keys.append(fk)
+        m.update_table(job.schema_id, info)
+        job.state = JobState.DONE
+        return True
+
+    def _on_drop_foreign_key(self, txn, m: Meta, job: DDLJob) -> bool:
+        """public→none in one step (foreign_key.go:76 onDropForeignKey)."""
+        fk_name = job.args[0]
+        info = m.get_table(job.schema_id, job.table_id)
+        if info is None:
+            raise errors.NoSuchTableError("table dropped concurrently")
+        if not any(f.name.lower() == fk_name.lower()
+                   for f in info.foreign_keys):
+            raise errors.TiDBError(
+                f"foreign key {fk_name} doesn't exist",
+                code=my.ErrCantDropFieldOrKey)
+        info.foreign_keys = [f for f in info.foreign_keys
+                             if f.name.lower() != fk_name.lower()]
+        m.update_table(job.schema_id, info)
+        job.state = JobState.DONE
         return True
 
     # ---- column ops ----

@@ -20,7 +20,7 @@ from tidb_tpu.expression import AggregationFunction, Expression, Schema
 from tidb_tpu.expression import ops as xops
 from tidb_tpu.plan.plans import SortItem
 from tidb_tpu.types import Datum
-from tidb_tpu.types.datum import NULL, compare_datum
+from tidb_tpu.types.datum import NULL, Kind, compare_datum
 
 
 class Executor:
@@ -365,9 +365,18 @@ class StreamAggExec(Executor):
 
 
 class HashJoinExec(Executor):
-    """Build the right side into a hash table, probe with the left
-    (executor/executor.go:442; worker concurrency is a later milestone —
-    the TPU path gets the parallelism instead)."""
+    """Equi-join executor. Two paths:
+
+    * vectorized sort-merge (numpy) for single int/float key joins — the
+      data-parallel answer to the reference's JoinConcurrency worker pool
+      (executor/executor.go:442,568-640): where Go shards the probe
+      stream across goroutines, this runtime gets its parallelism from
+      columnar batch operations (argsort + searchsorted + range-expand),
+      which beat a per-row Python hash probe by an order of magnitude.
+    * the row-at-a-time hash build/probe for everything else (multi-key,
+      string keys, exotic kinds) — semantics identical by construction
+      (the differential tests run both).
+    """
 
     def __init__(self, child_left: Executor, child_right: Executor,
                  plan, schema: Schema):
@@ -377,18 +386,23 @@ class HashJoinExec(Executor):
         self._built: dict[bytes, list] | None = None
         self._pending: list = []
         self._right_width = 0
+        self._vector_iter = None                  # streaming vector join
+        self._vector_tried = False
+        self._prebuilt_right: list | None = None  # drained by a bailed
+        self._left_iter = None                    # vector attempt; the
+        #                                           slow path replays them
 
     def _build(self):
         right = self.children[1]
         table: dict[bytes, list] = {}
         r_keys = [rcol for _, rcol in self.plan.eq_conditions]
         self._right_width = len(right.schema)
-        while True:
-            row = right.next()
-            if row is None:
-                break
-            if self.plan.right_conditions and not _conds_ok(
-                    self.plan.right_conditions, row):
+        prebuilt = getattr(self, "_prebuilt_right", None)
+        rows_iter = iter(prebuilt) if prebuilt is not None \
+            else iter(right.next, None)
+        for row in rows_iter:
+            if prebuilt is None and self.plan.right_conditions and \
+                    not _conds_ok(self.plan.right_conditions, row):
                 continue
             key_vals = [k.eval(row) for k in r_keys]
             if any(v.is_null() for v in key_vals):
@@ -396,14 +410,137 @@ class HashJoinExec(Executor):
             table.setdefault(codec.encode_value(key_vals), []).append(row)
         self._built = table
 
+    # ---- vectorized single-key sort-merge path ----
+
+    # UINT64 excluded: the codec keys the dict path uses encode u64(5)
+    # and i64(5) as DIFFERENT keys, and folding both into one int64
+    # array would (more correctly, but differently) match them
+    _VEC_KINDS = (Kind.INT64, Kind.FLOAT64)
+
+    def _key_array(self, rows, col):
+        """(values f64/i64 ndarray, valid bool ndarray) for one key column
+        across rows; None when a kind outside the fast set appears.
+        np.fromiter over a generator is ~10x a branchy Python loop."""
+        import numpy as np
+        idx = col.index
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, np.int64), np.zeros(0, bool)
+        kinds = np.fromiter((r[idx].kind for r in rows), dtype=np.int16,
+                            count=n)
+        k_null, k_int, k_f64 = int(Kind.NULL), int(Kind.INT64), \
+            int(Kind.FLOAT64)
+        present = set(np.unique(kinds).tolist())
+        if not present <= {k_null, k_int, k_f64}:
+            return None, None
+        if k_int in present and k_f64 in present:
+            # mixed kinds on ONE side: the dict path's codec keys treat
+            # int 1 and float 1.0 as distinct — stay on that path
+            return None, None
+        valid = kinds != k_null
+        dtype = np.float64 if k_f64 in present else np.int64
+        if k_null in present:
+            vals = np.fromiter(
+                (r[idx].val if m else 0
+                 for r, m in zip(rows, valid.tolist())),
+                dtype=dtype, count=n)
+        else:
+            vals = np.fromiter((r[idx].val for r in rows), dtype=dtype,
+                               count=n)
+        return vals, valid
+
+    def _try_vector_join(self) -> bool:
+        """Drain both sides and join via stable argsort + searchsorted +
+        range expansion. Emission order matches the dict path exactly:
+        left-scan order, matches in right-scan order."""
+        import numpy as np
+        from tidb_tpu.expression import Column as ExprColumn
+        from tidb_tpu.plan.plans import Join
+        plan = self.plan
+        if len(plan.eq_conditions) != 1:
+            return False
+        if plan.join_type not in (Join.INNER, Join.LEFT_OUTER):
+            return False
+        lcol, rcol = plan.eq_conditions[0]
+        if not isinstance(lcol, ExprColumn) or \
+                not isinstance(rcol, ExprColumn):
+            return False
+        if lcol.ret_type.is_ci_collation() or \
+                rcol.ret_type.is_ci_collation():
+            return False
+        rrows = self.children[1].drain()
+        self._right_width = len(self.children[1].schema)
+        if plan.right_conditions:
+            rrows = [r for r in rrows
+                     if _conds_ok(plan.right_conditions, r)]
+        rkey, rvalid = self._key_array(rrows, rcol)
+        if rkey is None:
+            self._prebuilt_right = rrows   # reuse the drain for the slow path
+            return False
+        lrows = self.children[0].drain()
+        lkey, lvalid = self._key_array(lrows, lcol)
+        if lkey is None:
+            # BOTH sides are drained by now — hand both to the slow path
+            # (discarding lrows would silently join an exhausted left)
+            self._prebuilt_right = rrows
+            self._left_iter = iter(lrows)
+            return False
+        if rkey.dtype != lkey.dtype:
+            # int side vs float side never match under the dict path's
+            # codec keys; replicate by matching nothing / outer-padding
+            lvalid = np.zeros_like(lvalid)
+        order = np.argsort(rkey[rvalid], kind="stable")
+        ridx = np.flatnonzero(rvalid)[order].tolist()
+        rs = rkey[rvalid][order]
+        lo = np.searchsorted(rs, lkey, side="left")
+        hi = np.searchsorted(rs, lkey, side="right")
+        hi = np.where(lvalid, hi, lo)      # NULL/unmatchable: empty range
+        left_ok = None
+        if plan.left_conditions:
+            left_ok = [_conds_ok(plan.left_conditions, r) for r in lrows]
+        # STREAMING emission: rows assemble per next() pull, so a LIMIT
+        # above the join stops after a handful of rows instead of paying
+        # for (and holding) the full join output
+        self._vector_iter = self._vector_stream(
+            lrows, rrows, ridx, lo.tolist(), hi.tolist(), left_ok)
+        return True
+
+    def _vector_stream(self, lrows, rrows, ridx, lo, hi, left_ok):
+        """Emit joined rows in left-scan order, matches in right-scan
+        order (= the dict path's order exactly)."""
+        from tidb_tpu.plan.plans import Join
+        other = self.plan.other_conditions
+        outer = self.plan.join_type == Join.LEFT_OUTER
+        pad = [NULL] * self._right_width
+        for i, lrow in enumerate(lrows):
+            if left_ok is not None and not left_ok[i]:
+                if outer:
+                    yield lrow + pad
+                continue
+            emitted = False
+            for p in range(lo[i], hi[i]):
+                joined = lrow + rrows[ridx[p]]
+                if other and not _conds_ok(other, joined):
+                    continue
+                emitted = True
+                yield joined
+            if outer and not emitted:
+                yield lrow + pad
+
     def next(self):
         from tidb_tpu.plan.plans import Join
+        if not self._vector_tried:
+            self._vector_tried = True
+            self._try_vector_join()
+        if self._vector_iter is not None:
+            return next(self._vector_iter, None)
         if self._built is None:
             self._build()
         while True:
             if self._pending:
                 return self._pending.pop(0)
-            left_row = self.children[0].next()
+            left_row = next(self._left_iter, None) \
+                if self._left_iter is not None else self.children[0].next()
             if left_row is None:
                 return None
             l_keys = [lcol for lcol, _ in self.plan.eq_conditions]

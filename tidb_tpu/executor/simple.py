@@ -8,7 +8,7 @@ executor/show.go, executor/executor_set.go, executor/explain.go.
 from __future__ import annotations
 
 from tidb_tpu import errors, mysqldef as my, sqlast as ast
-from tidb_tpu.ddl.ddl import ColumnSpec, IndexSpec
+from tidb_tpu.ddl.ddl import ColumnSpec, FKSpec, IndexSpec
 from tidb_tpu.plan import tree_string
 from tidb_tpu.types import Datum, datum_from_py
 from tidb_tpu.types.datum import NULL
@@ -199,6 +199,7 @@ def _check_isolation_level(session, sval: str) -> str:
 def _column_specs(cols: list[ast.ColumnDef], constraints: list[ast.Constraint]):
     specs: list[ColumnSpec] = []
     indices: list[IndexSpec] = []
+    fks: list[FKSpec] = []
     for col in cols:
         ft = col.tp.clone()
         default = None
@@ -241,8 +242,15 @@ def _column_specs(cols: list[ast.ColumnDef], constraints: list[ast.Constraint]):
             indices.append(IndexSpec(cons.name or cons.keys[0],
                                      list(cons.keys)))
         elif t == ast.ConstraintType.FOREIGN_KEY:
-            pass  # parsed and ignored (reference ddl/foreign_key.go is a stub)
-    return specs, indices
+            fks.append(_fk_spec(cons))
+    return specs, indices, fks
+
+
+def _fk_spec(cons: ast.Constraint) -> FKSpec:
+    r = cons.refer
+    return FKSpec(name=cons.name, cols=list(cons.keys),
+                  ref_table=r.table.name, ref_cols=list(r.columns),
+                  on_delete=r.on_delete, on_update=r.on_update)
 
 
 def _ddl(session, stmt):
@@ -284,10 +292,10 @@ def _ddl(session, stmt):
                     if cd.tp.is_string() and not cd.charset_explicit:
                         cd.tp.charset = stmt.charset
                         cd.tp.collate = stmt.collate
-        specs, indices = _column_specs(stmt.cols, stmt.constraints)
+        specs, indices, fks = _column_specs(stmt.cols, stmt.constraints)
         try:
             ddl.create_table(dbname(stmt.table), stmt.table.name, specs,
-                             indices, stmt.charset, stmt.collate)
+                             indices, stmt.charset, stmt.collate, fks)
         except errors.TableExistsError:
             if not stmt.if_not_exists:
                 raise
@@ -322,14 +330,14 @@ def _ddl(session, stmt):
 
 def _alter(session, ddl, db: str, table: str, spec: ast.AlterTableSpec):
     if spec.tp == ast.AlterTableType.ADD_COLUMN:
-        specs, _ = _column_specs([spec.column], [])
+        specs, _, _ = _column_specs([spec.column], [])
         ddl.add_column(db, table, specs[0])
     elif spec.tp == ast.AlterTableType.MODIFY_COLUMN:
         if spec.column.options:
             raise errors.ExecError(
                 "unsupported modify column: only a plain field type "
                 "change is allowed")
-        specs, _ = _column_specs([spec.column], [])
+        specs, _, _ = _column_specs([spec.column], [])
         ddl.modify_column(db, table, specs[0])
     elif spec.tp == ast.AlterTableType.DROP_COLUMN:
         ddl.drop_column(db, table, spec.name)
@@ -342,6 +350,10 @@ def _alter(session, ddl, db: str, table: str, spec: ast.AlterTableSpec):
                          list(cons.keys), unique)
     elif spec.tp == ast.AlterTableType.DROP_INDEX:
         ddl.drop_index(db, table, spec.name)
+    elif spec.tp == ast.AlterTableType.ADD_FOREIGN_KEY:
+        ddl.create_foreign_key(db, table, _fk_spec(spec.constraint))
+    elif spec.tp == ast.AlterTableType.DROP_FOREIGN_KEY:
+        ddl.drop_foreign_key(db, table, spec.name)
     else:
         raise errors.ExecError(f"unsupported ALTER TABLE spec {spec.tp!r}")
 
@@ -504,6 +516,19 @@ def _create_table_sql(info) -> str:
             parts.append(f"  UNIQUE KEY `{idx.name}` ({cols})")
         else:
             parts.append(f"  KEY `{idx.name}` ({cols})")
+    from tidb_tpu.model import SchemaState
+    for fk in info.foreign_keys:
+        if fk.state != SchemaState.PUBLIC:
+            continue
+        cols = ", ".join(f"`{c}`" for c in fk.cols)
+        rcols = ", ".join(f"`{c}`" for c in fk.ref_cols)
+        s = (f"  CONSTRAINT `{fk.name}` FOREIGN KEY ({cols}) "
+             f"REFERENCES `{fk.ref_table}` ({rcols})")
+        if fk.on_delete:
+            s += f" ON DELETE {fk.on_delete}"
+        if fk.on_update:
+            s += f" ON UPDATE {fk.on_update}"
+        parts.append(s)
     body = ",\n".join(parts)
     opts = "ENGINE=TiDB-TPU"
     if (info.charset, info.collate) != ("utf8", "utf8_bin"):

@@ -19,6 +19,8 @@ from tidb_tpu.types.field_type import FieldType
 DB_ID = -200
 T_SCHEMATA = -201
 T_TABLES = -202
+T_KEY_COLUMN_USAGE = -207
+T_REFERENTIAL_CONSTRAINTS = -208
 T_COLUMNS = -203
 T_STATISTICS = -204
 T_CHARACTER_SETS = -205
@@ -65,6 +67,23 @@ def table_infos() -> list[TableInfo]:
             ("COLLATION_NAME",), ("CHARACTER_SET_NAME",),
             ("ID", my.TypeLonglong, 21), ("IS_DEFAULT",),
             ("IS_COMPILED",), ("SORTLEN", my.TypeLonglong, 21)]),
+        # the reference registers these two but leaves them empty
+        # (infoschema/tables.go:576 — empty case arms); here they carry
+        # real rows from PRIMARY/UNIQUE indexes and FK metadata
+        _tbl(T_KEY_COLUMN_USAGE, "KEY_COLUMN_USAGE", [
+            ("CONSTRAINT_CATALOG",), ("CONSTRAINT_SCHEMA",),
+            ("CONSTRAINT_NAME",), ("TABLE_CATALOG",), ("TABLE_SCHEMA",),
+            ("TABLE_NAME",), ("COLUMN_NAME",),
+            ("ORDINAL_POSITION", my.TypeLonglong, 21),
+            ("POSITION_IN_UNIQUE_CONSTRAINT", my.TypeLonglong, 21),
+            ("REFERENCED_TABLE_SCHEMA",), ("REFERENCED_TABLE_NAME",),
+            ("REFERENCED_COLUMN_NAME",)]),
+        _tbl(T_REFERENTIAL_CONSTRAINTS, "REFERENTIAL_CONSTRAINTS", [
+            ("CONSTRAINT_CATALOG",), ("CONSTRAINT_SCHEMA",),
+            ("CONSTRAINT_NAME",), ("UNIQUE_CONSTRAINT_CATALOG",),
+            ("UNIQUE_CONSTRAINT_SCHEMA",), ("UNIQUE_CONSTRAINT_NAME",),
+            ("MATCH_OPTION",), ("UPDATE_RULE",), ("DELETE_RULE",),
+            ("TABLE_NAME",), ("REFERENCED_TABLE_NAME",)]),
     ]
 
 
@@ -131,6 +150,50 @@ def rows_for(snapshot, table_id: int) -> list[list[Datum]]:
                             _s("0" if idx.unique else "1"), _s(db.name),
                             _s(idx.name), Datum.i64(seq + 1), _s(ic.name),
                             _s("")])
+        return out
+    if table_id == T_KEY_COLUMN_USAGE:
+        out = []
+        for db in _real_schemas(snapshot):
+            for t in sorted(snapshot.schema_tables(db.name),
+                            key=lambda t: t.info.name.lower()):
+                pk = t.info.pk_handle_column()
+                if pk is not None:
+                    out.append([_s("def"), _s(db.name), _s("PRIMARY"),
+                                _s("def"), _s(db.name), _s(t.info.name),
+                                _s(pk.name), Datum.i64(1), NULL, NULL,
+                                NULL, NULL])
+                for idx in t.info.indices:
+                    if not idx.unique:
+                        continue
+                    cname = "PRIMARY" if idx.primary else idx.name
+                    for seq, ic in enumerate(idx.columns):
+                        out.append([_s("def"), _s(db.name), _s(cname),
+                                    _s("def"), _s(db.name),
+                                    _s(t.info.name), _s(ic.name),
+                                    Datum.i64(seq + 1), NULL, NULL, NULL,
+                                    NULL])
+                for fk in t.info.foreign_keys:
+                    for seq, (c, rc) in enumerate(zip(fk.cols,
+                                                      fk.ref_cols)):
+                        out.append([_s("def"), _s(db.name), _s(fk.name),
+                                    _s("def"), _s(db.name),
+                                    _s(t.info.name), _s(c),
+                                    Datum.i64(seq + 1),
+                                    Datum.i64(seq + 1), _s(db.name),
+                                    _s(fk.ref_table), _s(rc)])
+        return out
+    if table_id == T_REFERENTIAL_CONSTRAINTS:
+        out = []
+        for db in _real_schemas(snapshot):
+            for t in sorted(snapshot.schema_tables(db.name),
+                            key=lambda t: t.info.name.lower()):
+                for fk in t.info.foreign_keys:
+                    out.append([
+                        _s("def"), _s(db.name), _s(fk.name), _s("def"),
+                        _s(db.name), _s("PRIMARY"), _s("NONE"),
+                        _s(fk.on_update or "RESTRICT"),
+                        _s(fk.on_delete or "RESTRICT"),
+                        _s(t.info.name), _s(fk.ref_table)])
         return out
     if table_id == T_CHARACTER_SETS:
         from tidb_tpu import charset as cset

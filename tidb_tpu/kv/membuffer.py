@@ -47,6 +47,12 @@ class MemBuffer(Retriever, Mutator):
             self._dirty = True
         self._data[key] = value
 
+    def set_many(self, pairs) -> None:
+        """Bulk write (iterable of (key, value)): one dict.update instead
+        of a Python call per key — the bulk-load hot path."""
+        self._data.update(pairs)
+        self._dirty = True
+
     def delete(self, key: bytes) -> None:
         self.set(key, TOMBSTONE)
 

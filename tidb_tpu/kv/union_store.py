@@ -53,6 +53,9 @@ class UnionStore(Retriever, Mutator):
     def set(self, key: bytes, value: bytes) -> None:
         self.buffer.set(key, value)
 
+    def set_many(self, pairs) -> None:
+        self.buffer.set_many(pairs)
+
     def delete(self, key: bytes) -> None:
         self.buffer.delete(key)
 

@@ -38,13 +38,40 @@ class MVCCStore:
                 self._cells[key] = [(version, value)] + versions
             else:
                 # out-of-order insert (rare; e.g. replay in tests)
-                i = 0
-                while i < len(versions) and versions[i][0] > version:
-                    i += 1
-                if i < len(versions) and versions[i][0] == version:
-                    self._cells[key] = versions[:i] + [(version, value)] + versions[i + 1:]
+                self._write_out_of_order(key, version, value)
+
+    def write_many(self, pairs, version: int) -> None:
+        """One-lock bulk write. New keys skip the per-key bisect.insort
+        (O(len) memmove each) for a single extend+sort — timsort on the
+        nearly-sorted result is ~linear, and it runs in C. Existing or
+        out-of-order keys take the exact per-key path."""
+        with self._lock:
+            cells = self._cells
+            new_keys = []
+            for key, value in pairs:
+                versions = cells.get(key)
+                if versions is None:
+                    cells[key] = [(version, value)]
+                    new_keys.append(key)
+                elif version > versions[0][0]:
+                    cells[key] = [(version, value)] + versions
                 else:
-                    self._cells[key] = versions[:i] + [(version, value)] + versions[i:]
+                    self._write_out_of_order(key, version, value)
+            if new_keys:
+                self._keys.extend(new_keys)
+                self._keys.sort()
+
+    def _write_out_of_order(self, key, version, value):
+        versions = self._cells[key]
+        i = 0
+        while i < len(versions) and versions[i][0] > version:
+            i += 1
+        if i < len(versions) and versions[i][0] == version:
+            self._cells[key] = versions[:i] + [(version, value)] \
+                + versions[i + 1:]
+        else:
+            self._cells[key] = versions[:i] + [(version, value)] \
+                + versions[i:]
 
     # ---- reads ----
     def get(self, key: bytes, read_ts: int) -> bytes | None:

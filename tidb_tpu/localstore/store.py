@@ -106,6 +106,13 @@ class LocalTxn(Transaction):
         self._dirty = True
         self._us.set(key, value)
 
+    def set_many(self, pairs: list[tuple[bytes, bytes]]) -> None:
+        """Bulk set for the batch write path (values already validated
+        non-empty by the row encoder — it never emits b'')."""
+        self._check_valid()
+        self._dirty = True
+        self._us.set_many(pairs)
+
     def delete(self, key: bytes) -> None:
         self._check_valid()
         self._dirty = True
@@ -257,9 +264,9 @@ class LocalStore(Storage):
                       muts: list[tuple[bytes, bytes | None]]) -> None:
         """Apply an (already durable) commit to the MVCC core + version
         bookkeeping — shared by the live path and WAL recovery."""
+        self.mvcc.write_many(muts, commit_ts)
         bounds: dict[bytes, tuple[bytes, bytes]] = {}
-        for key, val in muts:
-            self.mvcc.write(key, commit_ts, val)
+        for key, _val in muts:
             p = bytes(key[:12])
             cur = bounds.get(p)
             if cur is None:

@@ -9,6 +9,7 @@ from tidb_tpu.model.model import (  # noqa: F401
     ColumnInfo,
     IndexColumn,
     IndexInfo,
+    FKInfo,
     TableInfo,
     DBInfo,
 )

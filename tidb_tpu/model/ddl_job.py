@@ -25,6 +25,8 @@ class ActionType(enum.IntEnum):
     DROP_INDEX = 8
     TRUNCATE_TABLE = 9
     MODIFY_COLUMN = 10
+    ADD_FOREIGN_KEY = 11
+    DROP_FOREIGN_KEY = 12
 
 
 class JobState(enum.IntEnum):

@@ -636,13 +636,19 @@ class TpuClient(kv.Client):
     # ------------------------------------------------------------------
 
     def _run_filter(self, sel, batch, where, req) -> SelectResponse:
-        _, wrapper, jitted = self._kernel(sel, batch, "filter",
-                                          lambda: kernels.build_filter_fn(where))
+        fn, wrapper, jitted = self._kernel(
+            sel, batch, "filter", lambda: kernels.build_filter_fn(where))
         planes = kernels.batch_planes(batch)
         live = kernels.device_live(batch)
-        packed = jitted(planes, live)
-        (mask_out,) = kernels.unpack_outputs(wrapper, np.asarray(packed))
-        mask = mask_out.astype(bool)
+        if self.mesh is not None:
+            # row-sharded over the mesh axis; the full-length mask comes
+            # back in global row order (contiguous blocks, shard-major)
+            (mask_out,) = self.mesh.run_sharded(fn, planes, live)
+        else:
+            packed = jitted(planes, live)
+            (mask_out,) = kernels.unpack_outputs(wrapper,
+                                                 np.asarray(packed))
+        mask = np.asarray(mask_out).astype(bool)
         idx = np.nonzero(mask)[0]
         if sel.desc:
             idx = idx[::-1]
@@ -653,6 +659,8 @@ class TpuClient(kv.Client):
     def _run_topn(self, sel, batch, where) -> SelectResponse:
         if not sel.order_by or sel.limit is None:
             raise Unsupported("topn lowering needs keys + limit")
+        if self.mesh is not None:
+            return self._run_topn_mesh(sel, batch, where)
         k = min(sel.limit, batch.capacity)
         if len(sel.order_by) == 1:
             key = compile_expr(sel.order_by[0].expr, batch)
@@ -672,6 +680,43 @@ class TpuClient(kv.Client):
         # LIMIT 1: unpack scalarizes length-1 outputs — restore the axis
         idx = np.atleast_1d(np.asarray(idx_out))[: int(n_live)]
         return self._emit_rows(sel, batch, idx)
+
+    def _run_topn_mesh(self, sel, batch, where) -> SelectResponse:
+        """Fixed-k per-shard top-k on every device, host merge of the
+        n_shards*k candidates (reference: per-region topn partials merged
+        SQL-side, store/tikv/coprocessor.go:305)."""
+        shard_len = batch.capacity // self.mesh.n
+        k = min(sel.limit, shard_len)
+        if k <= 0:
+            return self._emit_rows(sel, batch, np.zeros(0, np.int64))
+        planes = kernels.batch_planes(batch)
+        live = kernels.device_live(batch)
+        single = len(sel.order_by) == 1
+        if single:
+            key = compile_expr(sel.order_by[0].expr, batch)
+            fn, _w, _j = self._kernel(
+                sel, batch, "topn_mesh",
+                lambda: kernels.build_topn_partial_fn(
+                    where, key, sel.order_by[0].desc, k))
+            idx_l, scores, n_live = [
+                np.atleast_1d(np.asarray(o))
+                for o in self.mesh.run_sharded(fn, planes, live)]
+            merge_keys = [-scores.astype(np.float64)]
+        else:
+            keys = [(compile_expr(item.expr, batch), item.desc)
+                    for item in sel.order_by]
+            fn, _w, _j = self._kernel(
+                sel, batch, "topn_mesh",
+                lambda: kernels.build_topn_partial_fn_multi(where, keys,
+                                                            k))
+            outs = [np.atleast_1d(np.asarray(o))
+                    for o in self.mesh.run_sharded(fn, planes, live)]
+            idx_l, n_live = outs[0], outs[1]
+            merge_keys = outs[2:]   # least-significant first
+        top = kernels.merge_topn_partials(idx_l, n_live, merge_keys,
+                                          self.mesh.n, shard_len,
+                                          sel.limit)
+        return self._emit_rows(sel, batch, top)
 
     def _emit_rows(self, sel, batch, idx) -> SelectResponse:
         writer = ChunkWriter()

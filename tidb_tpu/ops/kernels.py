@@ -798,6 +798,81 @@ def build_topn_fn(where: CompiledExpr | None, key_expr: CompiledExpr,
     return fn
 
 
+def build_topn_partial_fn(where: CompiledExpr | None,
+                          key_expr: CompiledExpr, desc: bool, k: int):
+    """Per-shard top-k for the mesh: like build_topn_fn but ALSO emits
+    the (normalized, higher-is-better) scores of the chosen rows, so the
+    host can merge the n_shards fixed-k candidate sets exactly — the
+    uniform per-region fan-out contract of the reference's coprocessor
+    top-n (store/tikv/coprocessor.go:305; final merge stays above)."""
+
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+        v, va = key_expr(planes)
+        vf = v.astype(jnp.float64)
+        score = jnp.where(va, vf if desc else -vf,
+                          -jnp.inf if desc else jnp.inf)
+        score = jnp.where(mask, score, -jnp.inf)
+        top_scores, idx = jax.lax.top_k(score, k)
+        n_live = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), k)
+        return idx, top_scores, n_live.reshape(1)
+    return fn
+
+
+def merge_topn_partials(idx_l, n_live, merge_keys, n_shards: int,
+                        shard_len: int, limit: int):
+    """Host merge of n_shards fixed-k top-k candidate sets → global row
+    indices, best-first, truncated to `limit`. `merge_keys` are ascending
+    sort keys, least-significant first (np.lexsort order; pass [-scores]
+    for the single-key higher-is-better form); the global row index is
+    the final stability tiebreak. Shared by TpuClient._run_topn_mesh and
+    the driver dryrun so the two can never drift."""
+    import numpy as _np
+    k = idx_l.shape[0] // n_shards
+    within = _np.tile(_np.arange(k), n_shards)
+    valid = within < _np.repeat(n_live.astype(_np.int64), k)
+    gidx = idx_l.astype(_np.int64) + _np.repeat(
+        _np.arange(n_shards, dtype=_np.int64) * shard_len, k)
+    cand = _np.flatnonzero(valid)
+    order = _np.lexsort([gidx[cand]] + [mk[cand] for mk in merge_keys])
+    return gidx[cand[order]][:limit]
+
+
+def build_topn_partial_fn_multi(where: CompiledExpr | None,
+                                keys: list[tuple[CompiledExpr, bool]],
+                                k: int):
+    """Per-shard multi-key top-k + the chosen rows' sort-key columns
+    (least-significant first, matching jnp.lexsort/np.lexsort order) for
+    the host merge."""
+
+    def fn(planes, live):
+        mask = live
+        if where is not None:
+            wv, wva = where(planes)
+            mask = mask & wva & (wv if wv.dtype == jnp.bool_ else wv != 0)
+        sort_keys = []
+        for expr, desc in reversed(keys):
+            v, va = expr(planes)
+            vo = _orderable_i64(v)
+            if desc:
+                vo = -vo.astype(jnp.float64) if vo.dtype == jnp.float64 \
+                    else -vo
+            nullk = va.astype(jnp.int32) if not desc \
+                else (~va).astype(jnp.int32)
+            sort_keys.append(jnp.where(va, vo, jnp.zeros_like(vo)))
+            sort_keys.append(nullk)
+        sort_keys.append((~mask).astype(jnp.int32))  # dead rows last
+        order = jnp.lexsort(sort_keys)
+        idx = order[:k]
+        n_live = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), k)
+        return (idx, n_live.reshape(1),
+                *[sk[idx] for sk in sort_keys[:-1]])
+    return fn
+
+
 def build_topn_fn_multi(where: CompiledExpr | None,
                         keys: list[tuple[CompiledExpr, bool]], k: int):
     """Top-k row indices over LEXICOGRAPHIC multi-key order (the CPU

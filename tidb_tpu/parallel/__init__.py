@@ -102,3 +102,33 @@ class CoprMesh:
 
     def run_grouped(self, fn, planes, live):
         return self._run(fn, planes, live)
+
+    def run_sharded(self, fn, planes, live):
+        """Row-sharded execution with PER-SHARD outputs (out_specs along
+        the axis, no collectives): each device computes over its row
+        block and the outputs come back concatenated in shard order —
+        filter masks (full row length) and per-shard top-k candidate
+        sets ride this path; the host does the final (tiny) merge, the
+        same split as the reference's per-region coprocessor fan-out +
+        SQL-side merge (store/tikv/coprocessor.go:305)."""
+        if live.shape[0] % self.n != 0:
+            raise Unsupported(
+                f"batch capacity {live.shape[0]} not divisible by mesh "
+                f"size {self.n}")
+        key = ("sharded", id(fn))
+        ent = self._jit_cache.get(key)
+        if ent is None or ent[0] is not fn:
+            if self.n == 1:
+                sharded = lambda planes, live: tuple(fn(planes, live))
+            else:
+                sharded = shard_map(
+                    lambda p, l: tuple(fn(p, l)), mesh=self.mesh,
+                    in_specs=(P(AXIS), P(AXIS)),
+                    out_specs=P(AXIS))   # outputs stay shard-major
+            wrapper = _kernels.pack_outputs(sharded)
+            ent = (fn, wrapper, jax.jit(wrapper))
+            self._jit_cache[key] = ent
+            if len(self._jit_cache) > 256:
+                self._jit_cache.pop(next(iter(self._jit_cache)))
+        packed = ent[2](planes, jnp.asarray(live))
+        return _kernels.unpack_outputs(ent[1], np.asarray(packed))
