@@ -1,0 +1,231 @@
+"""Binlog hooks (reference sessionctx/binloginfo + 2pc.go:462-505) and
+the Prometheus push client (tidb-server/main.go:175-199)."""
+
+import threading
+
+import pytest
+
+from tidb_tpu import binloginfo, errors
+from tidb_tpu.session import Session, new_store
+
+
+@pytest.fixture
+def pump():
+    p = binloginfo.MemoryPump()
+    binloginfo.set_pump(p)
+    yield p
+    binloginfo.set_pump(None)
+
+
+class TestBinlog:
+    def test_commit_writes_prewrite_then_commit(self, pump):
+        store = new_store("cluster://4/binlog_c")
+        s = Session(store)
+        s.execute("create database b")
+        s.execute("use b")
+        s.execute("create table t (a bigint primary key, v int)")
+        pump.entries.clear()   # DDL/bootstrap noise out of the way
+        s.execute("insert into t values (1, 10), (2, 20)")
+        # background txns (owner leases, stats) binlog too — find the
+        # insert's prewrite: the one carrying exactly our 2 row keys
+        pre = next(e for e in pump.entries
+                   if e["tp"] == "prewrite" and len(e["mutations"]) == 2)
+        com = next(e for e in pump.entries
+                   if e["tp"] == "commit"
+                   and e["start_ts"] == pre["start_ts"])
+        assert com["commit_ts"] > pre["start_ts"]
+        # the prewrite carries the primary key + the full mutation set
+        assert pre["prewrite_key"] == pre["mutations"][0][0]
+        assert all(isinstance(k, bytes) and isinstance(v, bytes)
+                   for k, v in pre["mutations"])
+        # every commit in the stream pairs with a prior prewrite of the
+        # same start_ts (writeFinishBinlog invariant)
+        starts = {e["start_ts"] for e in pump.entries
+                  if e["tp"] == "prewrite"}
+        assert all(e["start_ts"] in starts for e in pump.entries
+                   if e["tp"] == "commit")
+
+    def test_conflict_rollback_writes_rollback(self, pump):
+        store = new_store("cluster://4/binlog_r")
+        s1 = Session(store)
+        s1.execute("create database b")
+        s1.execute("use b")
+        s1.execute("create table t (a bigint primary key, v int)")
+        s1.execute("insert into t values (1, 0)")
+        s2 = Session(store)
+        s2.execute("use b")
+        s1.execute("begin")
+        s2.execute("begin")
+        s1.execute("update t set v = 1 where a = 1")
+        s2.execute("update t set v = 2 where a = 1")
+        pump.entries.clear()
+        s1.execute("commit")
+        try:
+            s2.execute("commit")   # conflict → optimistic retry may
+            #                        succeed (replay) or raise
+        except errors.TiDBError:
+            pass
+        # every commit record pairs with a prewrite of the same start_ts;
+        # a failed prewrite leaves a rollback record instead
+        starts = {e["start_ts"] for e in pump.entries
+                  if e["tp"] == "prewrite"}
+        assert all(e["start_ts"] in starts for e in pump.entries
+                   if e["tp"] in ("commit", "rollback"))
+        assert any(e["tp"] == "commit" for e in pump.entries)
+
+    def test_pump_errors_never_fail_the_txn(self):
+        class ExplodingPump:
+            def write_binlog(self, payload):
+                raise RuntimeError("pump down")
+
+        binloginfo.set_pump(ExplodingPump())
+        try:
+            store = new_store("cluster://2/binlog_x")
+            s = Session(store)
+            s.execute("create database b")
+            s.execute("use b")
+            s.execute("create table t (a bigint primary key)")
+            s.execute("insert into t values (1)")
+            assert s.execute("select count(*) from t")[0].values() == [[1]]
+        finally:
+            binloginfo.set_pump(None)
+
+    def test_localstore_commits_skip_binlog(self, pump):
+        """Binlog attaches at the cluster 2PC boundary only — the
+        reference writes binlog in the tikv committer, not in
+        localstore."""
+        s = Session(new_store("memory://binlog_l"))
+        s.execute("create database b")
+        s.execute("use b")
+        s.execute("create table t (a bigint primary key)")
+        pump.entries.clear()
+        s.execute("insert into t values (1)")
+        assert pump.entries == []
+
+    def test_file_pump_round_trips(self, tmp_path, pump):
+        import json
+        path = str(tmp_path / "binlog.jsonl")
+        fp = binloginfo.FilePump(path)
+        binloginfo.set_pump(fp)
+        store = new_store("cluster://2/binlog_f")
+        s = Session(store)
+        s.execute("create database b")
+        s.execute("use b")
+        s.execute("create table t (a bigint primary key)")
+        s.execute("insert into t values (7)")
+        fp.close()
+        lines = [json.loads(line) for line in open(path)]
+        assert any(e["tp"] == "commit" for e in lines)
+        pre = next(e for e in lines if e["tp"] == "prewrite")
+        assert all(isinstance(k, str) for k, _v in pre["mutations"])
+        bytes.fromhex(pre["prewrite_key"])   # hex round-trips
+
+
+class TestMetricsPush:
+    def test_push_once_sends_exposition(self):
+        from tidb_tpu import metrics
+        from tidb_tpu.metrics import push as mpush
+        metrics.counter("push.test_counter").inc(3)
+        sent = {}
+
+        def transport(url, body):
+            sent["url"], sent["body"] = url, body
+
+        ok = mpush.push_once("gw:9091", job="tidb-tpu",
+                             instance="test-host", transport=transport)
+        assert ok
+        assert sent["url"] == \
+            "http://gw:9091/metrics/job/tidb-tpu/instance/test-host"
+        assert b"push.test_counter" in sent["body"] or \
+            b"push_test_counter" in sent["body"]
+
+    def test_push_errors_are_swallowed(self):
+        from tidb_tpu.metrics import push as mpush
+
+        def transport(url, body):
+            raise IOError("gateway down")
+
+        assert mpush.push_once("gw:9091", transport=transport) is False
+
+    def test_push_loop_over_real_http(self):
+        """End-to-end against an in-process Pushgateway-shaped server."""
+        import http.server
+        import time
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append((self.path, self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            from tidb_tpu.metrics import push as mpush
+            addr = f"127.0.0.1:{srv.server_port}"
+            t = mpush.start_push_client(addr, 0.05, job="jx")
+            assert t is not None
+            deadline = time.time() + 5
+            while not received and time.time() < deadline:
+                time.sleep(0.02)
+            t.stop_event.set()
+            t.join(timeout=2)
+            assert received, "no push arrived"
+            path, body = received[0]
+            assert path.startswith("/metrics/job/jx/instance/")
+            assert body  # exposition text
+        finally:
+            srv.shutdown()
+
+    def test_disabled_configs(self):
+        from tidb_tpu.metrics import push as mpush
+        assert mpush.start_push_client("", 15) is None
+        assert mpush.start_push_client("gw:9091", 0) is None
+
+
+def test_primary_committed_never_binlogs_rollback():
+    """Review finding: a failure committing the primary batch's REMAINDER
+    must not emit a rollback binlog — once the primary lands the txn IS
+    committed (2pc.go 'succeed with error') and a drainer replaying a
+    rollback record would silently diverge."""
+    from tidb_tpu.cluster.twopc import TwoPhaseCommitter
+    from tidb_tpu.session import Session
+
+    pump = binloginfo.MemoryPump()
+    binloginfo.set_pump(pump)
+    try:
+        store = new_store("cluster://1/binlog_partial")
+        Session(store)  # bootstrap
+        start_ts = store.oracle.current_version()
+        muts = {b"zk%02d" % i: b"v%d" % i for i in range(6)}
+        c = TwoPhaseCommitter(store, start_ts, muts)
+        orig = TwoPhaseCommitter._commit_batch
+        state = {"n": 0}
+
+        def flaky(self, keys, commit_ts, bo):
+            state["n"] += 1
+            if state["n"] == 2:   # the primary batch's remainder
+                raise errors.TiDBError("injected region error")
+            return orig(self, keys, commit_ts, bo)
+
+        TwoPhaseCommitter._commit_batch = flaky
+        pump.entries.clear()
+        try:
+            c.execute()           # must SUCCEED: primary landed
+        finally:
+            TwoPhaseCommitter._commit_batch = orig
+        assert c.committed
+        tps = [e["tp"] for e in pump.entries]
+        assert "rollback" not in tps, tps
+        assert tps == ["prewrite", "commit"], tps
+        # the stragglers' locks resolve on the next read
+        snap = store.get_snapshot()
+        got = dict(snap.iterate(b"zk", b"zl"))
+        assert got == muts
+    finally:
+        binloginfo.set_pump(None)

@@ -281,3 +281,42 @@ class TestWritesDuringColumnStates:
         rows = s.execute("select a, c from t order by a")[0].values()
         assert rows == [[1, "dup"], [2, "y"]]
         s.execute("admin check table t")
+
+
+class TestSchemaBarrierAutoArm:
+    """Round-4 weak #6: the 2xlease waitSchemaChanged barrier must arm
+    itself when live PEER servers share the store, even in embedded mode
+    where no explicit --lease was configured."""
+
+    def test_single_server_stays_unarmed(self, store):
+        d = Domain(store)
+        assert d.ddl._effective_lease() == 0.0
+
+    def test_two_servers_arm_the_barrier(self, store):
+        d1, d2 = two_domains(store)
+        assert d1.ddl._effective_lease() == d1.ddl.EMBEDDED_PEER_LEASE_S
+        assert d2.ddl._effective_lease() > 0
+        # explicit lease wins over the embedded floor
+        d1.ddl.schema_lease_s = 1.5
+        assert d1.ddl._effective_lease() == 1.5
+
+    def test_close_unregisters(self, store):
+        d1, d2 = two_domains(store)
+        d2.close()
+        assert d1.ddl._effective_lease() == 0.0
+
+    def test_barrier_applies_during_ddl(self, store):
+        import time as _t
+        d1, d2 = two_domains(store)
+        from tidb_tpu.session import Session
+        s = Session(store)
+        s.domain = d1
+        s.execute("create database bar")
+        s.execute("use bar")
+        s.execute("create table t (a int)")
+        t0 = _t.time()
+        s.execute("alter table t add index ia (a)")   # multi-state job
+        elapsed = _t.time() - t0
+        # add-index walks >=3 schema states; each pauses 2x the embedded
+        # peer lease → the DDL visibly waits for peers
+        assert elapsed >= 3 * 2 * d1.ddl.EMBEDDED_PEER_LEASE_S * 0.8

@@ -1,0 +1,92 @@
+"""Binlog hooks: the 2PC boundary publishes prewrite / commit / rollback
+events to a pluggable pump.
+
+Reference: sessionctx/binloginfo/binloginfo.go (a process-global
+PumpClient shared by every session; WriteBinlog marshals and ships) and
+store/tikv/2pc.go:462-505 (prewriteBinlog fires concurrently with the
+prewrite phase, writeFinishBinlog records the commit/rollback with its
+commit ts). The shape here is the same seam, tpu-native: the payload is
+a plain dict (the mutation set is already key→value bytes), the pump is
+any object with write_binlog(payload), and nothing in the commit path
+blocks on it — a pump error is logged, never surfaced into the txn
+(matching writeFinishBinlog's log-and-continue).
+
+Payload schema:
+    {"tp": "prewrite", "start_ts": int, "prewrite_key": bytes,
+     "mutations": [(key, value|None), ...]}
+    {"tp": "commit" | "rollback", "start_ts": int, "commit_ts": int}
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+_log = logging.getLogger("tidb_tpu.binlog")
+
+_lock = threading.Lock()
+_pump = None
+
+
+def set_pump(pump) -> None:
+    """Install the process-global pump (reference: binloginfo.PumpClient,
+    opened at server start and shared by all sessions). None disables."""
+    global _pump
+    with _lock:
+        _pump = pump
+
+
+def get_pump():
+    return _pump
+
+
+def write_binlog(payload: dict) -> None:
+    """Ship one binlog payload; errors are logged, never raised — binlog
+    must not fail a committed transaction (2pc.go writeFinishBinlog)."""
+    pump = _pump
+    if pump is None:
+        return
+    try:
+        pump.write_binlog(payload)
+    except Exception as e:  # noqa: BLE001 — deliberately broad: see doc
+        _log.error("failed to write binlog: %s", e)
+
+
+class MemoryPump:
+    """In-process pump: records payloads (tests, embedding)."""
+
+    def __init__(self):
+        self.entries: list[dict] = []
+        self._lock = threading.Lock()
+
+    def write_binlog(self, payload: dict) -> None:
+        with self._lock:
+            self.entries.append(payload)
+
+
+class FilePump:
+    """JSONL pump for the CLI's --binlog-path: one line per binlog, bytes
+    hex-encoded (the reference ships protobufs to a Pump server over
+    gRPC; a local durable stream is this build's equivalent transport)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def write_binlog(self, payload: dict) -> None:
+        import json
+
+        def enc(v):
+            if isinstance(v, bytes):
+                return v.hex()
+            if isinstance(v, (list, tuple)):
+                return [enc(x) for x in v]
+            return v
+
+        line = json.dumps({k: enc(v) for k, v in payload.items()},
+                          separators=(",", ":"))
+        with self._lock:
+            self._f.write(line + "\n")
+
+    def close(self) -> None:
+        self._f.close()

@@ -41,6 +41,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--status-port", type=int, default=10080,
                     help="HTTP status/metrics port (server.go:213); "
                          "-1 disables")
+    ap.add_argument("--metrics-addr", default="",
+                    help="Prometheus Pushgateway host:port; empty "
+                         "disables the push client (tidb-server "
+                         "-metrics-addr)")
+    ap.add_argument("--metrics-interval", type=float, default=15.0,
+                    help="push interval seconds; 0 disables "
+                         "(tidb-server -metrics-interval)")
+    ap.add_argument("--binlog-path", default="",
+                    help="append binlog events (prewrite/commit/"
+                         "rollback JSONL) to this file; the pluggable "
+                         "pump equivalent of tidb-server -binlog-socket")
     return ap
 
 
@@ -117,6 +128,11 @@ def _print_table(names, rows) -> None:
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+    if args.binlog_path:
+        from tidb_tpu import binloginfo
+        binloginfo.set_pump(binloginfo.FilePump(args.binlog_path))
+    from tidb_tpu.metrics.push import start_push_client
+    start_push_client(args.metrics_addr, args.metrics_interval)
     store = open_store(args)
     if args.repl:
         return repl(store)

@@ -75,6 +75,7 @@ class TwoPhaseCommitter:
             bo)
 
     def _cleanup(self) -> None:
+        from tidb_tpu import binloginfo
         bo = Backoffer()
         for batch in self._batches(self.keys):
             try:
@@ -85,10 +86,24 @@ class TwoPhaseCommitter:
                     bo)
             except errors.TiDBError:
                 pass  # leftover locks resolve via TTL later
+        # finish binlog: rollback (writeFinishBinlog, 2pc.go:486)
+        binloginfo.write_binlog({"tp": "rollback",
+                                 "start_ts": self.start_ts,
+                                 "commit_ts": 0})
 
     def execute(self) -> int:
         """Returns commit_ts. Reference: execute (2pc.go:406)."""
+        from tidb_tpu import binloginfo
         bo = Backoffer()
+        # binlog: the prewrite record ships alongside phase 1
+        # (2pc.go:462 prewriteBinlog — concurrent there, inline here;
+        # the pump never fails the txn either way)
+        if binloginfo.get_pump() is not None:
+            binloginfo.write_binlog({
+                "tp": "prewrite", "start_ts": self.start_ts,
+                "prewrite_key": self.primary,
+                "mutations": [(k, self.mutations[k]) for k in self.keys],
+            })
         # phase 1: prewrite — primary's batch first (it IS the txn record)
         try:
             primary_done = False
@@ -111,6 +126,11 @@ class TwoPhaseCommitter:
             for batch in self._batches(self.keys):
                 if self.primary in batch:
                     self._commit_batch([self.primary], commit_ts, bo)
+                    # the flag flips HERE, not after the loop: a failure
+                    # on the same batch's remainder must never roll back
+                    # (or binlog-rollback) a transaction whose primary —
+                    # the commit record — already landed
+                    self.committed = True
                     rest = [k for k in batch if k != self.primary]
                     if rest:
                         self._commit_batch(rest, commit_ts, bo)
@@ -118,8 +138,15 @@ class TwoPhaseCommitter:
         except errors.TiDBError:
             if not self.committed:
                 self._cleanup()
-            raise
-        self.committed = True
+                raise
+            # primary landed: committed despite the error; same-batch
+            # stragglers resolve via LockResolver like any secondary
+            # ("2PC succeed with error", 2pc.go:456)
+        # finish binlog: the txn IS committed once the primary lands
+        # (writeFinishBinlog, 2pc.go:480)
+        binloginfo.write_binlog({"tp": "commit",
+                                 "start_ts": self.start_ts,
+                                 "commit_ts": commit_ts})
         for batch in self._batches(self.keys):
             if self.primary in batch:
                 continue

@@ -86,6 +86,25 @@ class DDL:
         self._lock = threading.Lock()
         self._worker_stop: threading.Event | None = None
 
+    # each state transition pauses 2x this when live PEER servers share
+    # the store but no explicit lease is configured — the reference
+    # ALWAYS waits 2xlease (ddl_worker.go:397); embedded single-server
+    # stores skip the barrier for latency, but real peers must get their
+    # reload window (round-4 weak #6)
+    EMBEDDED_PEER_LEASE_S = 0.05
+
+    def _effective_lease(self) -> float:
+        if self.schema_lease_s > 0:
+            return self.schema_lease_s
+        try:
+            peers = run_in_new_txn(
+                self.store, False,
+                lambda txn: Meta(txn).live_servers())
+        except errors.TiDBError:
+            return 0.0
+        others = [p for p in peers if p != self.uuid]
+        return self.EMBEDDED_PEER_LEASE_S if others else 0.0
+
     # ---- owner lease (ddl_worker.go:97) ----
 
     def _take_owner(self, m: Meta, bg: bool = False) -> bool:
@@ -492,11 +511,12 @@ class DDL:
             # schema lease configured, give them 2 lease periods to load
             # it before the next state (waitSchemaChanged, :397)
             self.handle.load()
-            if self.schema_lease_s > 0:
+            lease_s = self._effective_lease()
+            if lease_s > 0:
                 # renew the lease while sleeping — a 2×lease barrier longer
                 # than OWNER_TIMEOUT must not let another server steal the
                 # job mid-state
-                remaining = 2 * self.schema_lease_s
+                remaining = 2 * lease_s
                 slice_s = OWNER_TIMEOUT_MS / 1000.0 / 4
                 while remaining > 0:
                     time.sleep(min(slice_s, remaining))

@@ -40,7 +40,6 @@ class Domain:
             self.gc_worker = None
         if self.gc_worker is not None:
             self.gc_worker.start()
-
         self._reload_stop: threading.Event | None = None
         # schema-validity kill-switch (domain.go:45,:474
         # schemaValidityInfo): when the reload loop stalls longer than the
@@ -50,14 +49,43 @@ class Domain:
         # lease is configured).
         self.schema_validity_lease_s: float = 0.0
         self._last_reload_ok = time.monotonic()
+        self._last_reg = time.monotonic()
+        # announce this server in the store's meta registry: DDL owners
+        # arm the 2xlease waitSchemaChanged barrier exactly when OTHER
+        # live servers share the store (round-4 weak #6 — the barrier
+        # defaulted off embedded even with real peers)
+        self._register_server()
+
+    SERVER_TTL_S = 60.0
+
+    def _register_server(self) -> None:
+        from tidb_tpu.kv import run_in_new_txn
+        from tidb_tpu.meta import Meta
+        try:
+            run_in_new_txn(
+                self.store, True,
+                lambda txn: Meta(txn).register_server(self.ddl.uuid,
+                                                      self.SERVER_TTL_S))
+        except Exception:   # noqa: BLE001 — advisory; store may be
+            pass            # mid-close (registry must never block)
 
     def close(self) -> None:
         if self.gc_worker is not None:
             self.gc_worker.stop()
         self.ddl.stop_worker()
+        # stop the reload loop BEFORE unregistering — its TTL/2 refresh
+        # must not re-insert this server's entry after the hdel
         if self._reload_stop is not None:
             self._reload_stop.set()
             self._reload_stop = None
+        from tidb_tpu.kv import run_in_new_txn
+        from tidb_tpu.meta import Meta
+        try:
+            run_in_new_txn(
+                self.store, True,
+                lambda txn: Meta(txn).unregister_server(self.ddl.uuid))
+        except Exception:   # noqa: BLE001 — store may already be closed
+            pass
 
     # ---- multi-server convergence (domain.go:371 loadSchemaInLoop) ----
 
@@ -84,10 +112,18 @@ class Domain:
         stop = self._reload_stop
 
         def loop():
+            last_reg = time.monotonic()
             while not stop.wait(interval_s):
                 try:
                     self.maybe_reload()
                     self._last_reload_ok = time.monotonic()
+                    # keep the server-registry entry fresh at TTL/2 (one
+                    # tiny meta txn every ~30s — NOT per tick, so
+                    # embedded stores' data version stays quiet)
+                    if time.monotonic() - last_reg > self.SERVER_TTL_S / 2 \
+                            and not stop.is_set():
+                        self._register_server()
+                        last_reg = time.monotonic()
                 except Exception:
                     pass
 
@@ -102,6 +138,14 @@ class Domain:
         (reload loop stalled / partitioned): continuing could commit
         against a schema version other servers already replaced
         (domain.go:474 Check → ErrInfoSchemaExpired)."""
+        # lazy registry refresh: embeddings without a reload loop still
+        # renew their server entry at TTL/2 — the peer-armed DDL barrier
+        # must not silently disarm after SERVER_TTL_S of process lifetime
+        # (an IDLE peer can still expire; its next statement re-registers
+        # before anything runs on a stale view)
+        if time.monotonic() - self._last_reg > self.SERVER_TTL_S / 2:
+            self._last_reg = time.monotonic()
+            self._register_server()
         lease = self.schema_validity_lease_s
         if lease <= 0:
             return

@@ -56,6 +56,45 @@ class Meta:
             raise errors.NoSuchTableError(f"table {table_id} not in db {db_id}")
         return self.t.inc(_autoid_key(table_id), step)
 
+    # ---- server registry (waitSchemaChanged peer discovery) ----
+    # The reference ALWAYS applies the 2xlease schema barrier
+    # (ddl_worker.go:397); embedded single-server stores skip it for
+    # latency. The registry lets the DDL worker see whether OTHER live
+    # servers share this store and arm the barrier exactly then.
+
+    KEY_SERVER_REGISTRY = b"ServerRegistry"
+
+    def register_server(self, server_id: str, ttl_s: float) -> None:
+        import time as _t
+        now = _t.time()
+        # opportunistic purge (already inside a write txn): crashed
+        # servers never unregister, and the hash is scanned per DDL
+        # state transition — expired entries must not accrete
+        for field, value in list(self.t.hgetall(self.KEY_SERVER_REGISTRY)):
+            try:
+                expired = float(value) <= now
+            except ValueError:
+                expired = True
+            if expired:
+                self.t.hdel(self.KEY_SERVER_REGISTRY, field)
+        self.t.hset(self.KEY_SERVER_REGISTRY, server_id.encode(),
+                    repr(now + ttl_s).encode())
+
+    def unregister_server(self, server_id: str) -> None:
+        self.t.hdel(self.KEY_SERVER_REGISTRY, server_id.encode())
+
+    def live_servers(self) -> list[str]:
+        import time as _t
+        now = _t.time()
+        out = []
+        for field, value in self.t.hgetall(self.KEY_SERVER_REGISTRY):
+            try:
+                if float(value) > now:
+                    out.append(field.decode())
+            except ValueError:
+                continue
+        return out
+
     # ---- schema version ----
     def schema_version(self) -> int:
         v = self.t.get(KEY_SCHEMA_VERSION)

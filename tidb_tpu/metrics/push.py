@@ -1,0 +1,73 @@
+"""Prometheus push client.
+
+Reference: tidb-server/main.go:175-199 — pushMetric spawns
+prometheusPushClient, which loops `push.AddFromGatherer(job, grouping,
+addr, DefaultGatherer); sleep(interval)` forever, logging (never
+raising) on push errors. Same contract here: a daemon thread PUTs the
+registry's text exposition to the Pushgateway path
+`/metrics/job/<job>/instance/<instance>` on a fixed interval; a zero
+interval or empty address disables the client (main.go:177-180).
+
+The transport is injectable so tests run against an in-process HTTP
+server (this image has no network egress).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from tidb_tpu import metrics
+
+_log = logging.getLogger("tidb_tpu.metrics.push")
+
+
+def _default_transport(url: str, body: bytes) -> None:
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=body, method="PUT",
+        headers={"Content-Type": "text/plain; version=0.0.4"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        if resp.status >= 300:
+            raise IOError(f"pushgateway returned {resp.status}")
+
+
+def push_once(addr: str, job: str = "tidb-tpu",
+              instance: str | None = None, transport=None) -> bool:
+    """One push; returns success. Errors are logged, not raised
+    (prometheusPushClient logs and keeps looping)."""
+    if instance is None:
+        import socket
+        instance = socket.gethostname()
+    url = f"http://{addr}/metrics/job/{job}/instance/{instance}"
+    body = metrics.render_text().encode()
+    try:
+        (transport or _default_transport)(url, body)
+        return True
+    except Exception as e:  # noqa: BLE001 — push must never take the db down
+        _log.error("could not push metrics to Prometheus Pushgateway: %s",
+                   e)
+        return False
+
+
+def start_push_client(addr: str, interval_s: float,
+                      job: str = "tidb-tpu", transport=None,
+                      stop_event: threading.Event | None = None):
+    """Spawn the push loop (pushMetric, main.go:175). Returns the thread,
+    or None when disabled (empty addr / non-positive interval)."""
+    if not addr or interval_s <= 0:
+        _log.info("disable Prometheus push client")
+        return None
+    stop = stop_event or threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            push_once(addr, job=job, transport=transport)
+            stop.wait(interval_s)
+
+    t = threading.Thread(target=loop, name="metrics-push", daemon=True)
+    t.stop_event = stop
+    t.start()
+    _log.info("start Prometheus push client with server addr %s and "
+              "interval %.1fs", addr, interval_s)
+    return t
