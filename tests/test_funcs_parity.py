@@ -141,3 +141,59 @@ def test_registered_builtins_are_callable(s):
             s.execute(f"select {name}()")
         else:
             s.execute(f"select {name}(null)")   # NULL-propagating probe
+
+
+# every top-level statement production of the reference grammar
+# (parser.y:4246 Statement:), with a probe that must parse here
+REF_STATEMENTS = {
+    "AdminStmt": "admin check table t",
+    "AlterTableStmt": "alter table t add column c int",
+    "AnalyzeTableStmt": "analyze table t",
+    "BeginTransactionStmt": "begin",
+    "BinlogStmt": "binlog 'YmFzZTY0'",
+    "CommitStmt": "commit",
+    "CreateDatabaseStmt": "create database d",
+    "CreateIndexStmt": "create index i on t (a)",
+    "CreateTableStmt": "create table t (a int)",
+    "CreateUserStmt": "create user 'u'",
+    "DeallocateStmt": "deallocate prepare p",
+    "DeleteFromStmt": "delete from t where a = 1",
+    "DoStmt": "do 1",
+    "DropDatabaseStmt": "drop database d",
+    "DropIndexStmt": "drop index i on t",
+    "DropTableStmt": "drop table t",
+    "DropUserStmt": "drop user 'u'",
+    "DropViewStmt": "drop view if exists v",
+    "EmptyStmt": ";",
+    "ExecuteStmt": "execute p",
+    "ExplainStmt": "explain select 1",
+    "FlushStmt": "flush privileges",
+    "GrantStmt": "grant select on d.* to 'u'",
+    "InsertIntoStmt": "insert into t values (1)",
+    "LoadDataStmt": "load data local infile 'f' into table t",
+    "LockTablesStmt": "lock tables t read, u write",
+    "PreparedStmt": "prepare p from 'select 1'",
+    "ReplaceIntoStmt": "replace into t values (1)",
+    "RollbackStmt": "rollback",
+    "SelectStmt": "select 1",
+    "SetStmt": "set @x = 1",
+    "ShowStmt": "show tables",
+    "TruncateTableStmt": "truncate table t",
+    "UnionStmt": "select 1 union select 2",
+    "UnlockTablesStmt": "unlock tables",
+    "UpdateStmt": "update t set a = 1",
+    "UseStmt": "use d",
+}
+
+
+def test_every_reference_statement_parses():
+    from tidb_tpu.parser.parser import Parser
+    p = Parser()
+    failed = []
+    for name, sql in REF_STATEMENTS.items():
+        try:
+            p.parse(sql)
+        except Exception as e:
+            failed.append((name, str(e)[:60]))
+    assert not failed, failed
+    assert len(REF_STATEMENTS) == 37   # transcription guard

@@ -144,6 +144,9 @@ class Parser:
         if t.tp == lx.OP and t.val == "(":
             # (SELECT ...) [UNION ...] as a top-level statement
             return self._parse_select_or_union()
+        if t.tp == lx.IDENT and str(t.val).upper() in ("BINLOG", "LOCK",
+                                                       "UNLOCK"):
+            return self._parse_ignored_stmt()
         if t.tp != lx.KEYWORD:
             self._fail("expected statement keyword")
         kw = t.val
@@ -178,6 +181,9 @@ class Parser:
             "PREPARE": self._parse_prepare,
             "EXECUTE": self._parse_execute,
             "DEALLOCATE": self._parse_deallocate,
+            "BINLOG": self._parse_ignored_stmt,
+            "LOCK": self._parse_ignored_stmt,
+            "UNLOCK": self._parse_ignored_stmt,
         }
         h = handlers.get(kw)  # type: ignore[arg-type]
         if h is None:
@@ -822,6 +828,16 @@ class Parser:
             iname = self._ident("index name")
             self._expect_kw("ON")
             return ast.DropIndexStmt(index_name=iname, table=self._parse_table_name())
+        if self._try_word("VIEW"):
+            # DROP VIEW IF EXISTS list → no-op, exactly the reference's
+            # production (parser.y:1534 returns an empty DoStmt): there
+            # are no views to drop, but mysqldump scripts emit this
+            self._expect_kw("IF")
+            self._expect_kw("EXISTS")
+            self._parse_table_name()
+            while self._try_op(","):
+                self._parse_table_name()
+            return ast.DoStmt()   # empty DO = the reference's no-op
         self._expect_kw("TABLE")
         ie = self._parse_if_exists()
         tables = [self._parse_table_name()]
@@ -887,6 +903,30 @@ class Parser:
                 self._fail("expected ADD/DROP/MODIFY in ALTER TABLE")
             if not self._try_op(","):
                 return stmt
+
+    def _parse_ignored_stmt(self) -> ast.DoStmt:
+        """BINLOG 'base64' / LOCK TABLES tbl READ|WRITE, ... / UNLOCK
+        TABLES: the reference parses all three and ignores them
+        (parser.y:928 BinlogStmt + executor_simple.go:83 "We just ignore
+        it"; parser.y LockTablesStmt/UnlockTablesStmt produce nothing).
+        An empty DoStmt is the no-op the reference returns."""
+        w = self._expect_word("BINLOG", "LOCK", "UNLOCK")
+        if w == "BINLOG":
+            if not self._at(lx.STRING):
+                self._fail("expected string after BINLOG")
+            self._next()
+        elif w == "LOCK":
+            self._expect_kw("TABLES")
+            while True:
+                self._parse_table_name()
+                lt = self._expect_word("READ", "WRITE")
+                if lt == "READ":
+                    self._try_word("LOCAL")
+                if not self._try_op(","):
+                    break
+        else:
+            self._expect_kw("TABLES")
+        return ast.DoStmt()   # empty DO = the reference's no-op shape
 
     def _parse_truncate(self) -> ast.TruncateTableStmt:
         self._expect_kw("TRUNCATE")
