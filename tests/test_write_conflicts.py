@@ -199,3 +199,43 @@ class TestBulkAddRecords:
             "select count(*), sum(a), min(s) from t")[0].values()
         mn = mn.decode() if isinstance(mn, bytes) else mn
         assert (cnt, int(sa), mn) == (300, 7 * (300 * 301) // 2, "s1")
+
+
+def test_skip_constraint_check_insert_bulk_path():
+    """tidb_skip_constraint_check (reference kv.SkipCheckForWrite) routes
+    plain multi-VALUES INSERTs through the bulk KV build; checks stay
+    enforced when the sysvar is off, and reactive forms (IGNORE/ON
+    DUPLICATE/REPLACE) never take the unchecked path."""
+    import pytest
+    from tidb_tpu import errors
+    from tidb_tpu.session import Session, new_store
+    s = Session(new_store("memory://skip_chk"))
+    s.execute("create database w")
+    s.execute("use w")
+    s.execute("create table t (id bigint primary key, a int)")
+    s.execute("set tidb_skip_constraint_check = 1")
+    s.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+    assert s.execute("select count(*) from t")[0].values() == [[3]]
+    s.execute("admin check table t")
+    # reactive forms still observe conflicts even with the var set
+    s.execute("insert into t values (1, 99), (4, 40) "
+              "on duplicate key update a = 99")
+    assert s.execute("select a from t where id = 1")[0].values() == [[99]]
+    s.execute("set tidb_skip_constraint_check = 0")
+    with pytest.raises(errors.TiDBError):
+        s.execute("insert into t values (2, 1), (999, 1)")
+
+
+def test_skip_constraint_check_applies_to_single_row():
+    """Review finding: the skip must not depend on statement row count —
+    a single-row INSERT under the var behaves like any batch row
+    (reference kv.SkipCheckForWrite applies to every write)."""
+    from tidb_tpu.session import Session, new_store
+    s = Session(new_store("memory://skip_chk1"))
+    s.execute("create database w")
+    s.execute("use w")
+    s.execute("create table t (id bigint primary key, a int)")
+    s.execute("insert into t values (5, 50)")
+    s.execute("set tidb_skip_constraint_check = 1")
+    s.execute("insert into t values (5, 77)")   # silently overwrites
+    s.execute("select a from t where id = 5")

@@ -63,11 +63,28 @@ class InsertExec(Executor):
         # lazy presume-not-exists check only fires at commit, far too late
         # to react inside the statement; executor_write.go:554)
         eager = bool(plan.on_duplicate or plan.ignore or plan.is_replace)
-        for value_row in rows:
+        def build(value_row):
             if plan.select_plan is None and len(value_row) != len(cols):
                 raise errors.ExecError(
                     "Column count doesn't match value count")
-            full = self._build_row(cols, value_row, txn)
+            return self._build_row(cols, value_row, txn)
+
+        # tidb_skip_constraint_check (reference kv.SkipCheckForWrite,
+        # sessionctx/variable): the operator vouches for uniqueness, so a
+        # plain INSERT takes the bulk KV-build path — regardless of row
+        # count, like the reference (a single-row statement must not
+        # suddenly re-enforce the check the operator disabled)
+        skip_check = str(self.ctx.get_sysvar("tidb_skip_constraint_check")
+                         or "0").lower() in ("1", "on", "true")
+        if skip_check and not eager:
+            full_rows = [build(r) for r in rows]
+            affected += tbl.add_records(txn, full_rows,
+                                        skip_unique_check=True)
+            self.ctx.mark_dirty(info.id)
+            self.ctx.set_affected_rows(affected)
+            return None
+        for value_row in rows:
+            full = build(value_row)
             try:
                 tbl.add_record(txn, full, eager_check=eager)
                 affected += 1
