@@ -238,4 +238,4 @@ def test_skip_constraint_check_applies_to_single_row():
     s.execute("insert into t values (5, 50)")
     s.execute("set tidb_skip_constraint_check = 1")
     s.execute("insert into t values (5, 77)")   # silently overwrites
-    s.execute("select a from t where id = 5")
+    assert s.execute("select a from t where id = 5")[0].values() == [[77]]

@@ -194,8 +194,15 @@ class SelectResponse:
     # columnar fast path (TPU engine): decoded result columns, bypassing
     # row-chunk encode/decode when both ends are in-proc. None → use chunks.
     columnar: object | None = None
+    # in-proc row fast path (CPU engine scans): (handle, datums) pairs in
+    # scan order, skipping the per-row encode_value/decode_all round trip
+    # chunks exist for — the datums are exactly what decoding the chunk
+    # bytes would produce (storage-flattened kinds). None → use chunks.
+    raw: list | None = None
 
     def row_count(self) -> int:
+        if self.raw is not None:
+            return len(self.raw)
         return sum(len(c.rows_meta) for c in self.chunks)
 
 
@@ -234,7 +241,11 @@ class ChunkWriter:
 
 def iter_response_rows(resp: SelectResponse):
     """Yield (handle, datums) decoded from chunks — partialResult.Next's
-    chunk-wise decode (distsql/distsql.go:192,253)."""
+    chunk-wise decode (distsql/distsql.go:192,253). In-proc responses
+    carry the rows directly (SelectResponse.raw) and skip the codec."""
+    if resp.raw is not None:
+        yield from resp.raw
+        return
     for chunk in resp.chunks:
         pos = 0
         mv = memoryview(chunk.rows_data)

@@ -66,12 +66,20 @@ class _SelectContext:
             (c for c in cols if c.pk_handle), None)
         # fill values for columns absent from a stored row (written before
         # an ADD COLUMN): the column's original default, else NULL — so
-        # pushed filters see the same value _output_row would emit
+        # pushed filters see the same value _output_row would emit.
+        # Defaults round-trip through the codec ONCE here so the raw row
+        # fast path emits byte-identical kinds to what chunk decode would
+        # (e.g. a STRING default flattens to BYTES like every stored value)
         from tidb_tpu.types.datum import NULL as _NULL
+
+        def _norm(d: Datum) -> Datum:
+            return codec.decode_all(codec.encode_value([d]))[0]
+
         self.fill_cols: list[tuple[int, Datum]] = [
-            (c.column_id, c.default_val if c.default_val is not None
-             else _NULL)
+            (c.column_id, _norm(c.default_val)
+             if c.default_val is not None else _NULL)
             for c in cols if not c.pk_handle]
+        self.raw_rows: list[tuple[int, list[Datum]]] = []
 
         self.aggs: list[AggregationFunction] = []
         self.agg_ctxs: dict[bytes, list] = {}
@@ -154,7 +162,14 @@ class _SelectContext:
             self._topn_row(handle, row)
             return
         self.count += 1
-        self.writer.append_row(handle, self._output_row(row))
+        # in-proc fast path: hand the decoded datums straight to the
+        # consumer (SelectResponse.raw) — the chunk encode/decode round
+        # trip per row exists for a wire this embedded handler never
+        # crosses (round-5: plain scans were double-codec bound). Peak
+        # memory is unchanged: the SQL-side executor materializes these
+        # same Datum objects anyway, and raw shares references where the
+        # chunk path held encoded bytes ALONGSIDE the consumer's datums.
+        self.raw_rows.append((handle, self._output_row(row)))
 
     def _output_row(self, row: dict[int, Datum]) -> list[Datum]:
         from tidb_tpu.types.datum import NULL
@@ -210,7 +225,9 @@ class _SelectContext:
             items = sorted((inv.item for inv in self._heap),
                            key=lambda it: (it[0], it[1]))
             for entry, _, handle, out in items:
-                self.writer.append_row(handle, out)
+                self.raw_rows.append((handle, out))
+        if not self.req.is_agg():
+            return SelectResponse(raw=self.raw_rows)
         return SelectResponse(chunks=self.writer.finish())
 
 

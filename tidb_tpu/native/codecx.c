@@ -640,7 +640,188 @@ fail_seqs:
     return NULL;
 }
 
+/* ------------------------------------------------------------------ */
+/* decode_row_datums: row value bytes → {col_id: Datum} — the row-scan  */
+/* hot loop (tablecodec.decode_row). Builds real Datum objects with the */
+/* EXACT kinds the Python decoder produces (i64/u64/f64/bytes/Duration/ */
+/* Time); DECIMAL and anything unknown raises Unsupported so the caller */
+/* redoes the whole value in Python. Reference: tablecodec.DecodeRow    */
+/* (tablecodec.go:198).                                                 */
+/* ------------------------------------------------------------------ */
+
+static PyObject *dx_datum_cls, *dx_null, *dx_duration_cls,
+    *dx_time_from_packed, *dx_kinds[16];
+
+static int dx_init(void) {
+    /* readiness is keyed on the LAST global assigned: imports below can
+     * release the GIL, so a concurrent caller observing a half-built
+     * state must see "not ready" and run the (idempotent) init itself.
+     * All globals are written together at the end, between which the
+     * GIL is never released. */
+    if (dx_time_from_packed) return 0;
+    PyObject *datum_cls = NULL, *null_obj = NULL, *duration_cls = NULL,
+        *from_packed = NULL, *kinds[16] = {0};
+    PyObject *dm = PyImport_ImportModule("tidb_tpu.types.datum");
+    if (!dm) return -1;
+    datum_cls = PyObject_GetAttrString(dm, "Datum");
+    null_obj = PyObject_GetAttrString(dm, "NULL");
+    PyObject *kind = PyObject_GetAttrString(dm, "Kind");
+    Py_DECREF(dm);
+    if (!datum_cls || !null_obj || !kind) goto fail;
+    for (int i = 0; i < 16; i++) {
+        PyObject *k = PyObject_CallFunction(kind, "i", i);
+        if (!k) { PyErr_Clear(); k = PyLong_FromLong(i); }
+        kinds[i] = k;
+    }
+    Py_DECREF(kind);
+    kind = NULL;
+    PyObject *tm = PyImport_ImportModule("tidb_tpu.types.time_types");
+    if (!tm) goto fail;
+    duration_cls = PyObject_GetAttrString(tm, "Duration");
+    PyObject *time_cls = PyObject_GetAttrString(tm, "Time");
+    Py_DECREF(tm);
+    if (!duration_cls || !time_cls) goto fail;
+    from_packed = PyObject_GetAttrString(time_cls, "from_packed_int");
+    Py_DECREF(time_cls);
+    if (!from_packed) goto fail;
+    if (dx_time_from_packed) {
+        /* another thread completed while an import had the GIL released */
+        Py_DECREF(datum_cls); Py_DECREF(null_obj);
+        Py_DECREF(duration_cls); Py_DECREF(from_packed);
+        for (int i = 0; i < 16; i++) Py_XDECREF(kinds[i]);
+        return 0;
+    }
+    dx_datum_cls = datum_cls;
+    dx_null = null_obj;
+    dx_duration_cls = duration_cls;
+    for (int i = 0; i < 16; i++) dx_kinds[i] = kinds[i];
+    dx_time_from_packed = from_packed;   /* readiness flag: LAST */
+    return 0;
+fail:
+    Py_XDECREF(datum_cls); Py_XDECREF(null_obj);
+    Py_XDECREF(duration_cls); Py_XDECREF(from_packed);
+    Py_XDECREF(kind);
+    for (int i = 0; i < 16; i++) Py_XDECREF(kinds[i]);
+    return -1;
+}
+
+static PyObject *dx_make(int kind, PyObject *val /* stolen */) {
+    if (!val) return NULL;
+    PyObject *d = PyObject_CallFunctionObjArgs(dx_datum_cls,
+                                               dx_kinds[kind], val, NULL);
+    Py_DECREF(val);
+    return d;
+}
+
+static PyObject *dx_decode_value(Rd *r) {
+    if (r->pos >= r->len) {
+        PyErr_SetString(Unsupported, "truncated row value");
+        return NULL;
+    }
+    uint8_t flag = r->p[r->pos++];
+    uint64_t u;
+    int64_t v;
+    switch (flag) {
+    case NIL_FLAG:
+        Py_INCREF(dx_null);
+        return dx_null;
+    case VARINT_FLAG:
+        if (rd_varint(r, &v) < 0) goto bad;
+        return dx_make(K_I64, PyLong_FromLongLong(v));
+    case INT_FLAG:
+        if (rd_u64be(r, &u) < 0) goto bad;
+        return dx_make(K_I64, PyLong_FromLongLong((int64_t)(u ^ SIGN_MASK)));
+    case UVARINT_FLAG:
+        if (rd_uvarint(r, &u) < 0) goto bad;
+        return dx_make(K_U64, PyLong_FromUnsignedLongLong(u));
+    case UINT_FLAG:
+        if (rd_u64be(r, &u) < 0) goto bad;
+        return dx_make(K_U64, PyLong_FromUnsignedLongLong(u));
+    case FLOAT_FLAG: {
+        if (rd_u64be(r, &u) < 0) goto bad;
+        if (u & SIGN_MASK) u &= ~SIGN_MASK; else u = ~u;
+        double f;
+        memcpy(&f, &u, 8);
+        return dx_make(K_F64, PyFloat_FromDouble(f));
+    }
+    case COMPACT_BYTES_FLAG: {
+        if (rd_varint(r, &v) < 0 || v < 0 || r->pos + v > r->len) goto bad;
+        PyObject *b = PyBytes_FromStringAndSize(
+            (const char *)(r->p + r->pos), (Py_ssize_t)v);
+        r->pos += v;
+        return dx_make(K_BYTES, b);
+    }
+    case DURATION_FLAG: {
+        if (rd_u64be(r, &u) < 0) goto bad;
+        PyObject *nanos = PyLong_FromLongLong((int64_t)(u ^ SIGN_MASK));
+        if (!nanos) return NULL;
+        PyObject *dur = PyObject_CallFunctionObjArgs(dx_duration_cls,
+                                                     nanos, NULL);
+        Py_DECREF(nanos);
+        return dx_make(K_DUR, dur);
+    }
+    case TIME_FLAG: {
+        if (rd_u64be(r, &u) < 0) goto bad;
+        PyObject *packed = PyLong_FromUnsignedLongLong(u);
+        if (!packed) return NULL;
+        PyObject *t = PyObject_CallFunctionObjArgs(dx_time_from_packed,
+                                                   packed, NULL);
+        Py_DECREF(packed);
+        return dx_make(K_TIME, t);
+    }
+    default:
+        /* DECIMAL, memcomparable BYTES (never in row values), unknown */
+        PyErr_SetString(Unsupported, "datum flag not handled natively");
+        return NULL;
+    }
+bad:
+    PyErr_SetString(Unsupported, "truncated row value");
+    return NULL;
+}
+
+static PyObject *py_decode_row_datums(PyObject *self, PyObject *args) {
+    Py_buffer buf;
+    if (!PyArg_ParseTuple(args, "y*", &buf)) return NULL;
+    if (dx_init() < 0) { PyBuffer_Release(&buf); return NULL; }
+    PyObject *out = PyDict_New();
+    if (!out) { PyBuffer_Release(&buf); return NULL; }
+    Rd r = {(const uint8_t *)buf.buf, buf.len, 0};
+    if (r.len == 0 || (r.len == 1 && r.p[0] == NIL_FLAG)) {
+        PyBuffer_Release(&buf);
+        return out;
+    }
+    while (r.pos < r.len) {
+        /* column id: always VARINT-encoded by encode_row */
+        int64_t cid;
+        if (r.p[r.pos] != VARINT_FLAG) {
+            PyErr_SetString(Unsupported, "row col-id not varint");
+            goto fail;
+        }
+        r.pos++;
+        if (rd_varint(&r, &cid) < 0) {
+            PyErr_SetString(Unsupported, "truncated row value");
+            goto fail;
+        }
+        PyObject *d = dx_decode_value(&r);
+        if (!d) goto fail;
+        PyObject *key = PyLong_FromLongLong(cid);
+        if (!key) { Py_DECREF(d); goto fail; }
+        int rc = PyDict_SetItem(out, key, d);
+        Py_DECREF(key);
+        Py_DECREF(d);
+        if (rc < 0) goto fail;
+    }
+    PyBuffer_Release(&buf);
+    return out;
+fail:
+    PyBuffer_Release(&buf);
+    Py_DECREF(out);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
+    {"decode_row_datums", py_decode_row_datums, METH_VARARGS,
+     "decode_row_datums(value) -> {col_id: Datum} (row-scan fast path)"},
     {"encode_row", py_encode_row, METH_VARARGS,
      "encode_row(col_ids, datums) -> bytes (compact row value layout)"},
     {"encode_datums", py_encode_datums, METH_VARARGS,

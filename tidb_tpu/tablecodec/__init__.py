@@ -139,7 +139,15 @@ def encode_row(col_ids, datums) -> bytes:
 
 
 def decode_row(value: bytes) -> dict[int, Datum]:
-    """Row value → {colID: datum}. Reference: tablecodec.DecodeRow:198."""
+    """Row value → {colID: datum}. Reference: tablecodec.DecodeRow:198.
+    Native (C) fast path when available — the per-datum Python dispatch
+    dominates row-returning scans otherwise; DECIMAL or unknown flags
+    fall back here."""
+    if _cx is not None:
+        try:
+            return _cx.decode_row_datums(value)
+        except _cx.Unsupported:
+            pass
     out: dict[int, Datum] = {}
     if not value or value == bytes([cdc.NIL_FLAG]):
         return out
