@@ -44,8 +44,11 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # TPU coprocessor routing: cpu | tpu (this build's copr=tpu switch)
     "tidb_copr_backend": "cpu",
     # rows below which a TPU-routable request answers on CPU (device
-    # dispatch-cost floor; ops.client.DISPATCH_FLOOR_ROWS derives from this)
-    "tidb_tpu_dispatch_floor": "8192",
+    # dispatch-cost floor; ops.client.DISPATCH_FLOOR_ROWS derives from
+    # this). Default tracks the measured CPU/device crossover on the
+    # bench rig (~16k rows after the native row decoder sped the CPU
+    # engine ~3x; bench.py measure_crossover re-measures every run).
+    "tidb_tpu_dispatch_floor": "16384",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
     "tidb_copr_batch_rows": "1048576",
