@@ -41,6 +41,10 @@ Environment:
     BENCH_ROWS        total lineitem rows for the TPU engine (default 10.2M)
     BENCH_BASE_ROWS   generated base rows / CPU-baseline rows (default 1.02M)
     BENCH_RUNS        timed repetitions (default 3)
+
+`--smoke` runs the same code paths at tiny, CPU-safe sizes (~25k rows,
+1 run, no crossover sweep / 1 GB HBM sweep) — tests/test_bench_smoke.py
+runs it in tier-1 so bench-path regressions fail fast.
 """
 
 from __future__ import annotations
@@ -367,10 +371,18 @@ def numpy_oracle_time(name: str, batch, col_id: dict, runs: int):
 
 def measure_join(n_left: int = 1_000_000, n_right: int = 100_000):
     """Join-operator throughput at the verdict shape (1M probe x 100k
-    build): the numpy sort-merge fast path vs the per-row dict build/
-    probe, on pre-materialized rows so the figure isolates the JOIN (the
-    e2e query is scan-dominated and measures the row-decode path
-    instead). Returns (rows_per_sec_fast, speedup_vs_dict)."""
+    build) across all three HashJoinExec paths, on pre-materialized rows
+    so the figure isolates the JOIN (the e2e query is scan-dominated and
+    measures the row-decode path instead):
+
+      device — build/probe kernels + columnar assembly (floor forced 0)
+      numpy  — host sort-merge, the below-dispatch-floor route (forced
+               by a floor ABOVE the row counts: proves the routing)
+      dict   — per-row hash build/probe (the oracle)
+
+    All three must emit identical row counts; device phase times (build /
+    probe / emit) come from HashJoinExec.join_stats. Returns a dict of
+    figures for the bench JSON."""
     from tidb_tpu import mysqldef as my
     from tidb_tpu.executor import executors
     from tidb_tpu.expression import Column
@@ -401,23 +413,109 @@ def measure_join(n_left: int = 1_000_000, n_right: int = 100_000):
     plan.other_conditions = []
     plan.join_type = Join.INNER
 
-    times = {}
-    for label in ("vector", "dict"):
+    def make(label):
         j = executors.HashJoinExec(_Rows(lrows, 2), _Rows(rrows, 2),
                                    plan, None)
-        if label == "dict":
+        if label == "device":
+            j.device_floor = 0
+        elif label == "numpy":
+            # a floor above both row counts must route to the numpy path
+            j.device_floor = max(n_left, n_right) + 1
+        else:
             j._vector_tried = True
             rit = iter(rrows)
             j.children[1].next = lambda it=rit: next(it, None)
             lit = iter(lrows)
             j.children[0].next = lambda it=lit: next(it, None)
+        return j
+
+    make("device").next()       # warm: jit compile outside timed windows
+    times, stats = {}, {}
+    for label in ("device", "numpy", "dict"):
+        best = None
+        for _ in range(2):      # best-of-2: drop scheduler-noise outliers
+            j = make(label)
+            t0 = time.time()
+            n = 0
+            while j.next() is not None:
+                n += 1
+            dt = time.time() - t0
+            assert n == n_left, \
+                f"{label} join produced {n} rows, expected {n_left}"
+            if best is None or dt < best:
+                best = dt
+                stats[label] = j.join_stats
+        times[label] = best
+    assert stats["device"].get("path") == "device", stats["device"]
+    assert stats["numpy"].get("path") == "numpy", \
+        "below-floor join did not take the numpy path"
+    dev = stats["device"]
+    return {
+        "join_rows_per_sec": round(n_left / times["device"], 1),
+        "join_speedup_vs_dict": round(times["dict"] / times["device"], 2),
+        "join_numpy_rows_per_sec": round(n_left / times["numpy"], 1),
+        "join_build_ms": round(dev.get("build_s", 0.0) * 1000, 2),
+        "join_probe_ms": round(dev.get("probe_s", 0.0) * 1000, 2),
+        "join_emit_ms": round(dev.get("emit_s", 0.0) * 1000, 2),
+    }
+
+
+JOIN_AGG_SQL = ("select count(*), sum(l_extendedprice), avg(l_quantity), "
+                "min(d_f), max(l_discount) from lineitem "
+                "join dim on l_orderkey = d_k")
+
+
+def measure_join_agg(store, n_dim: int, runs: int):
+    """Join→aggregate e2e through the full SQL stack: with the device
+    join the aggregate fuses over the joined COLUMN PLANES — the joined
+    rows are never materialized (executor.fused_agg). Re-runs the same
+    query with the device join disabled (row-loop oracle) and checks
+    result parity. Returns (seconds/run, fused?, parity_rows)."""
+    from tidb_tpu.executor import fused_agg
+    from tidb_tpu.ops import TpuClient
+    from tidb_tpu.session import Session
+
+    s = Session(store)
+    s.execute("use tpch")
+    s.execute("create table if not exists dim ("
+              "d_k bigint primary key, d_f double)")
+    if not s.execute("select count(*) from dim")[0].values()[0][0]:
+        batch = 20000
+        for start in range(1, n_dim + 1, batch):
+            vals = ", ".join(f"({k}, {k % 97}.5)"
+                             for k in range(start, min(start + batch,
+                                                       n_dim + 1)))
+            s.execute(f"insert into dim values {vals}")
+
+    old_client = store.get_client()
+    client = TpuClient(store)
+    store.set_client(client)
+    try:
+        sess = Session(store)
+        sess.execute("use tpch")
+        before = fused_agg.stats["fused"]
+        sess.execute(JOIN_AGG_SQL)        # warm (pack + compile)
         t0 = time.time()
-        n = 0
-        while j.next() is not None:
-            n += 1
-        times[label] = time.time() - t0
-        assert n == n_left, f"join produced {n} rows, expected {n_left}"
-    return n_left / times["vector"], times["dict"] / times["vector"]
+        results = []
+        for _ in range(runs):
+            results.append(sess.execute(JOIN_AGG_SQL)[0].values())
+        dt = (time.time() - t0) / runs
+        fused = fused_agg.stats["fused"] > before
+        # oracle: same SQL with the device join off (numpy join + the
+        # per-row aggregate loop)
+        client.device_join = False
+        oracle = sess.execute(JOIN_AGG_SQL)[0].values()
+        assert len(results[0]) == len(oracle), \
+            f"join_agg parity: {len(results[0])} rows vs {len(oracle)}"
+        for got, want in zip(results[0], oracle):
+            assert len(got) == len(want), \
+                f"join_agg parity: {len(got)} cols vs {len(want)}"
+            for a, b in zip(got, want):
+                assert _close(float(a), float(b)), \
+                    f"join_agg parity: {a} != {b}"
+        return dt, fused, len(results[0])
+    finally:
+        store.set_client(old_client)
 
 
 def timed_runs(session, sql: str, runs: int):
@@ -459,10 +557,18 @@ def check_scaled_parity(name: str, cpu_rows, tpu_rows, factor: int):
             assert int(cr[9]) * factor == int(tr[9]), f"{name}: count"
 
 
-def main():
-    n_rows = int(os.environ.get("BENCH_ROWS", "10200000"))
-    n_base = int(os.environ.get("BENCH_BASE_ROWS", "1020000"))
-    runs = int(os.environ.get("BENCH_RUNS", "3"))
+def main(smoke: bool = False):
+    if smoke:
+        # --smoke: tiny row counts, CPU-safe, same code paths — a tier-1
+        # test runs this so bench-path regressions fail fast instead of
+        # surfacing at the next full BENCH round
+        n_rows = int(os.environ.get("BENCH_ROWS", "24576"))
+        n_base = int(os.environ.get("BENCH_BASE_ROWS", str(n_rows)))
+        runs = int(os.environ.get("BENCH_RUNS", "1"))
+    else:
+        n_rows = int(os.environ.get("BENCH_ROWS", "10200000"))
+        n_base = int(os.environ.get("BENCH_BASE_ROWS", "1020000"))
+        runs = int(os.environ.get("BENCH_RUNS", "3"))
     n_base = min(n_base, n_rows)
     factor = max(1, n_rows // n_base)
     n_rows = n_base * factor
@@ -506,14 +612,17 @@ def main():
     # serving database lives in. Pre-D2H timings on this platform are
     # optimistic fiction (experiments/exp_axon_prims.py).
     poison_tunnel()
-    hbm_peak = measure_hbm_peak()
+    hbm_peak = measure_hbm_peak() if not smoke else 1.0
     print(f"# hbm peak (post-D2H copy-sweep): {hbm_peak:.2f} GB/s",
           file=sys.stderr)
 
     # routing: measured CPU/device crossover (on the base store, where the
     # CPU side stays tractable) + the steady-state latency of a small query
     # under the default floor — must be CPU-fast, not device-fast
-    crossover_rows = measure_crossover(base_store, runs)
+    # (smoke skips the sweep: 10 timed SQL runs for a figure the smoke
+    # JSON does not assert on)
+    crossover_rows = measure_crossover(base_store, runs) if not smoke \
+        else -1
     small_sql = "select sum(l_quantity) from lineitem where l_id <= 1000"
     tpu_session.execute(small_sql)   # warm: pack the 1k-row range batch
     t0 = time.time()
@@ -601,10 +710,24 @@ def main():
     print(f"# q1_mesh ({len(jax.devices())} devices): {mesh_s:.4f}s/run "
           f"({n_rows / mesh_s:,.0f} rows/s)", file=sys.stderr)
 
-    join_rps, join_speedup = measure_join()
-    print(f"# join (1M x 100k int key, operator-level): "
-          f"{join_rps:,.0f} probe rows/s, {join_speedup:.1f}x vs the "
-          "dict build/probe path", file=sys.stderr)
+    jl, jr = (60_000, 10_000) if smoke else (1_000_000, 100_000)
+    join_figs = measure_join(jl, jr)
+    print(f"# join ({jl / 1000:.0f}k x {jr / 1000:.0f}k int key, "
+          f"operator-level): {join_figs['join_rows_per_sec']:,.0f} probe "
+          f"rows/s device ({join_figs['join_speedup_vs_dict']:.1f}x vs "
+          f"dict; build {join_figs['join_build_ms']:.1f} ms, probe "
+          f"{join_figs['join_probe_ms']:.1f} ms, emit "
+          f"{join_figs['join_emit_ms']:.1f} ms), numpy below-floor "
+          f"{join_figs['join_numpy_rows_per_sec']:,.0f} rows/s",
+          file=sys.stderr)
+
+    n_dim = 4_000 if smoke else 100_000
+    join_agg_s, join_agg_fused, _ = measure_join_agg(base_store, n_dim,
+                                                     runs=1)
+    print(f"# join_agg e2e ({n_base / 1e6:.2f}M join {n_dim / 1000:.0f}k "
+          f"→ fused agg): {join_agg_s:.3f}s/run, fused="
+          f"{join_agg_fused} (no joined-row materialization)",
+          file=sys.stderr)
 
     geo_rps = math.exp(sum(math.log(x) for x in tpu_rps_all)
                        / len(tpu_rps_all))
@@ -628,8 +751,10 @@ def main():
         "dispatch_floor_rows": tpu_client.dispatch_floor_rows,
         "routing_crossover_rows": crossover_rows,
         "small_query_ms": round(small_ms, 2),
-        "join_rows_per_sec": round(join_rps, 1),
-        "join_speedup_vs_dict": round(join_speedup, 2),
+        **join_figs,
+        "join_agg_s": round(join_agg_s, 4),
+        "join_agg_fused": join_agg_fused,
+        "smoke": smoke,
         # the honest CPU comparison: a vectorized-numpy engine over the
         # same packed planes (the Python xeval baseline above understates
         # any real CPU engine; keep both so rounds stay comparable)
@@ -642,4 +767,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    main(smoke="--smoke" in sys.argv[1:])

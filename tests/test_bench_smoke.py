@@ -1,0 +1,40 @@
+"""bench.py --smoke in tier-1: the headline benchmark's code paths (store
+build, CPU baselines, device configs, mesh, join phases, join→agg fusion,
+JSON emission) run at tiny CPU-safe sizes so a bench-path regression
+fails here instead of surfacing at the next full BENCH round."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_smoke_emits_valid_json():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # even tinier than the --smoke defaults: this runs in tier-1
+    env["BENCH_ROWS"] = "18000"
+    env["BENCH_RUNS"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"bench --smoke failed:\n{proc.stderr[-4000:]}"
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line on stdout:\n{proc.stdout[-2000:]}"
+    out = json.loads(lines[-1])
+    assert out["smoke"] is True
+    assert out["metric"] == "tpch_geomean_rows_per_sec_tpu"
+    assert out["value"] > 0
+    # the join figures the verdict parses must be present and sane
+    assert out["join_rows_per_sec"] > 0
+    assert out["join_speedup_vs_dict"] > 0
+    assert out["join_numpy_rows_per_sec"] > 0
+    assert out["join_build_ms"] >= 0
+    assert out["join_probe_ms"] > 0
+    assert out["join_agg_fused"] is True, \
+        "join→agg e2e did not take the fused (no-materialization) path"
+    assert out["join_agg_s"] > 0

@@ -1,0 +1,313 @@
+"""Differential join parity: the device build/probe path and the numpy
+sort-merge path must be INVISIBLE next to the dict build/probe oracle —
+row-for-row identical output, values AND order, on every covered shape
+(LEFT_OUTER + other_conditions, NULL keys, mixed-kind bail-out,
+ci-collation bail-out, wide match sets), plus join→agg fusion parity
+and the dispatch-floor routing contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tidb_tpu.executor import executors
+from tests.testkit import TestKit
+
+
+QUERIES = [
+    # inner / outer, NULL keys on both sides (seeded below)
+    "select l.id, r.id from l join r on l.k = r.k",
+    "select l.id, r.id from l left join r on l.k = r.k",
+    # LEFT_OUTER + other_conditions (non-equi residual on both sides)
+    "select l.id, r.id from l left join r on l.k = r.k and l.v > 2 "
+    "and r.w < 22",
+    "select l.id, r.w from l join r on l.k = r.k and l.v > 2",
+    # wide match sets (k=2 fans out) + filter above the join
+    "select l.id, r.id from l left join r on l.k = r.k where l.id > 1",
+    # float keys
+    "select l.id, r.id from l join r on l.v = r.f",
+    "select l.id, r.id from l left join r on l.v = r.f",
+]
+
+
+def _seed(tk: TestKit):
+    tk.exec("create table l (id bigint primary key, k int, v double)")
+    tk.exec("create table r (id bigint primary key, k int, w int, "
+            "f double)")
+    tk.exec("insert into l values (1, 1, 1.5), (2, 2, null), "
+            "(3, null, 3.5), (4, 2, 4.5), (5, 9, 5.5), (6, 2, 2.5)")
+    tk.exec("insert into r values (10, 2, 20, 4.5), (11, 2, 21, 1.5), "
+            "(12, 1, 22, null), (13, null, 23, 2.5), (14, 2, 24, 4.5)")
+
+
+class _ForceDevice:
+    """Route every HashJoinExec through the device kernels (floor 0)."""
+
+    def __enter__(self):
+        self._orig = executors.HashJoinExec._device_join_floor
+        executors.HashJoinExec._device_join_floor = lambda self: 0
+        return self
+
+    def __exit__(self, *exc):
+        executors.HashJoinExec._device_join_floor = self._orig
+
+
+class _ForceDict:
+    """Pin every HashJoinExec to the dict build/probe oracle."""
+
+    def __enter__(self):
+        self._orig = executors.HashJoinExec._try_vector_join
+        executors.HashJoinExec._try_vector_join = lambda self: False
+        return self
+
+    def __exit__(self, *exc):
+        executors.HashJoinExec._try_vector_join = self._orig
+
+
+def _run_all(tk, queries):
+    return [tk.query(q).rows for q in queries]
+
+
+class TestJoinPathParity:
+    @pytest.fixture()
+    def tk(self):
+        tk = TestKit()
+        tk.exec("create database jp; use jp")
+        _seed(tk)
+        return tk
+
+    def test_three_paths_row_for_row(self, tk):
+        """device == numpy == dict, values and order, on every shape."""
+        with _ForceDict():
+            oracle = _run_all(tk, QUERIES)
+        numpy_rows = _run_all(tk, QUERIES)   # default: numpy path
+        with _ForceDevice():
+            device_rows = _run_all(tk, QUERIES)
+        for q, d, n, o in zip(QUERIES, device_rows, numpy_rows, oracle):
+            assert n == o, f"numpy vs dict diverged on {q!r}"
+            assert d == o, f"device vs dict diverged on {q!r}"
+        # sanity: the inner joins actually matched rows
+        assert len(oracle[0]) > 0 and len(oracle[3]) > 0
+
+    def test_mixed_kind_key_bails_to_dict(self, tk):
+        """A derived side mixing int and float key kinds must bail (after
+        both drains) and still produce the dict path's rows."""
+        q = ("select x.k, r.id from (select 1 as k union all "
+             "select 4.5e0 as k) x join r on x.k = r.f")
+        with _ForceDict():
+            oracle = tk.query(q).rows
+        assert sorted(map(tuple, oracle)) == [(4.5, 10), (4.5, 14)]
+        with _ForceDevice():
+            assert tk.query(q).rows == oracle
+
+    def test_ci_collation_key_bails_to_dict(self, tk):
+        """*_ci string keys must stay on the dict path (its codec keys
+        carry the collation), on every forced route."""
+        tk.exec("create table cl (id bigint primary key, "
+                "s varchar(8) collate utf8_general_ci)")
+        tk.exec("create table cr (id bigint primary key, "
+                "s varchar(8) collate utf8_general_ci)")
+        tk.exec("insert into cl values (1, 'Ant'), (2, 'bee'), (3, null)")
+        tk.exec("insert into cr values (10, 'Ant'), (11, 'BEE'), "
+                "(12, 'cat')")
+        q = "select cl.id, cr.id from cl join cr on cl.s = cr.s"
+        with _ForceDict():
+            oracle = tk.query(q).rows
+        assert len(oracle) > 0   # the exact-case pair matched
+        with _ForceDevice():
+            assert tk.query(q).rows == oracle
+
+    def test_wide_match_set_left_outer(self, tk):
+        """One probe row fanning out to many matches (the old
+        _pending.pop(0) O(n²) shape) — parity and completeness."""
+        tk.exec("create table wl (id bigint primary key, k int)")
+        tk.exec("create table wr (id bigint primary key, k int)")
+        tk.exec("insert into wl values (1, 7), (2, 7), (3, 8)")
+        rows = ", ".join(f"({i}, 7)" for i in range(10, 400))
+        tk.exec(f"insert into wr values {rows}")
+        q = "select wl.id, wr.id from wl left join wr on wl.k = wr.k"
+        with _ForceDict():
+            oracle = tk.query(q).rows
+        assert len(oracle) == 2 * 390 + 1
+        numpy_rows = tk.query(q).rows
+        with _ForceDevice():
+            device_rows = tk.query(q).rows
+        assert numpy_rows == oracle
+        assert device_rows == oracle
+
+
+class TestDeviceJoinKernels:
+    """Unit coverage of the kernel driver's edge shapes."""
+
+    def _pairs(self, lk, lv, rk, rv):
+        from tidb_tpu.ops import kernels
+        li, ri = kernels.join_match_pairs(
+            np.asarray(lk), np.asarray(lv, bool),
+            np.asarray(rk), np.asarray(rv, bool))
+        return list(zip(li.tolist(), ri.tolist()))
+
+    def test_sentinel_valued_keys_match(self):
+        """A genuine I64_MAX key must match — the NULL/padding sentinel
+        clamp may not eat it."""
+        big = (1 << 63) - 1
+        got = self._pairs([big, 0], [True, True],
+                          [big, big, 5], [True, False, True])
+        assert got == [(0, 0)]   # the valid big key only, not the NULL
+
+    def test_probe_capacity_escalation(self):
+        """total > initial out_cap (left bucket) forces the retry with a
+        larger bucket — pairs must be complete and ordered."""
+        n_l, n_r = 8, 3000    # 8 * 3000 = 24000 pairs >> bucket(8)=1024
+        got = self._pairs([7] * n_l, [True] * n_l,
+                          [7] * n_r, [True] * n_r)
+        assert len(got) == n_l * n_r
+        assert got[:3] == [(0, 0), (0, 1), (0, 2)]
+        assert got[-1] == (n_l - 1, n_r - 1)
+
+    def test_empty_and_all_null_sides(self):
+        assert self._pairs([1, 2], [True, True], [], []) == []
+        assert self._pairs([1, 2], [False, False],
+                           [1, 2], [True, True]) == []
+        assert self._pairs([], [], [1], [True]) == []
+
+    def test_float_keys_with_inf(self):
+        inf = float("inf")
+        got = self._pairs([inf, 1.0], [True, True],
+                          [inf, 1.0, 2.0], [True, True, False])
+        assert got == [(0, 0), (1, 1)]
+
+
+class TestJoinAggFusion:
+    """join→agg fusion must be invisible: identical rows, identical
+    order, vs the row-loop aggregate over the dict-path join."""
+
+    @pytest.fixture()
+    def tk(self):
+        tk = TestKit()
+        tk.exec("create database jf; use jf")
+        _seed(tk)
+        return tk
+
+    AGG_QUERIES = [
+        "select count(*), sum(r.w), avg(l.v), min(r.w), max(l.v) "
+        "from l join r on l.k = r.k",
+        "select l.k, count(*), sum(r.w), min(l.v) from l join r "
+        "on l.k = r.k group by l.k",
+        "select l.k, count(r.w), sum(l.v) from l left join r "
+        "on l.k = r.k group by l.k",
+        # empty join input: scalar aggs still emit one row
+        "select count(*), sum(r.w), max(l.v) from l join r "
+        "on l.k = r.k and l.v > 1e9",
+        # group-by over an empty join: no rows
+        "select l.k, count(*) from l join r on l.k = r.k "
+        "and l.v > 1e9 group by l.k",
+    ]
+
+    def test_fused_matches_row_loop(self, tk):
+        from tidb_tpu.executor import fused_agg
+        with _ForceDict():
+            oracle = _run_all(tk, self.AGG_QUERIES)
+        before = fused_agg.stats["fused"]
+        with _ForceDevice():
+            fused = _run_all(tk, self.AGG_QUERIES)
+        assert fused_agg.stats["fused"] > before, \
+            "device join+agg never took the fused path"
+        for q, f, o in zip(self.AGG_QUERIES, fused, oracle):
+            assert f == o, f"fused agg diverged on {q!r}"
+
+    def test_first_row_and_strings(self, tk):
+        """first_row gathers exact source datums (any kind); string
+        min/max falls back to the row loop — both must match."""
+        tk.exec("create table sl (id bigint primary key, k int, "
+                "s varchar(8))")
+        tk.exec("insert into sl values (1, 2, 'x'), (2, 2, 'y'), "
+                "(3, 1, null)")
+        q = ("select sl.k, min(sl.s), max(r.w) from sl join r "
+             "on sl.k = r.k group by sl.k")
+        with _ForceDict():
+            oracle = tk.query(q).rows
+        with _ForceDevice():
+            assert tk.query(q).rows == oracle
+
+
+class TestJoinRouting:
+    """The dispatch floor gates the device path; the sysvar kill switch
+    pins joins to the host."""
+
+    def test_floor_routes_numpy_below_device_above(self):
+        from tidb_tpu.ops import TpuClient
+        from tidb_tpu.session import new_store
+        store = new_store("memory://joinroute1")
+        store.set_client(TpuClient(store, dispatch_floor_rows=4))
+        tk = TestKit(store)
+        tk.exec("create database jr; use jr")
+        tk.exec("create table a (id bigint primary key, k int)")
+        tk.exec("create table b (id bigint primary key, k int)")
+        tk.exec("insert into a values (1, 1), (2, 2), (3, 3), (4, 4), "
+                "(5, 5)")
+        tk.exec("insert into b values (1, 1), (2, 2), (3, 9)")
+        seen = []
+        orig = executors.HashJoinExec._try_vector_join
+
+        def spy(self):
+            out = orig(self)
+            seen.append(self.join_stats.get("path"))
+            return out
+        executors.HashJoinExec._try_vector_join = spy
+        try:
+            q = "select a.id, b.id from a join b on a.k = b.k"
+            rows = tk.query(q).rows
+            assert sorted(map(tuple, rows)) == [(1, 1), (2, 2)]
+            assert seen[-1] == "device"   # 5 rows >= floor 4
+            tk.exec("set global tidb_tpu_dispatch_floor = 1000")
+            assert tk.query(q).rows == rows
+            assert seen[-1] == "numpy"    # below the floor
+            tk.exec("set global tidb_tpu_dispatch_floor = 4")
+            tk.exec("set global tidb_tpu_device_join = 0")
+            assert tk.query(q).rows == rows
+            assert seen[-1] == "numpy"    # kill switch
+            tk.exec("set global tidb_tpu_device_join = 1")
+            assert tk.query(q).rows == rows
+            assert seen[-1] == "device"
+        finally:
+            executors.HashJoinExec._try_vector_join = orig
+
+    def test_device_join_kill_switch_survives_new_client(self):
+        """A freshly constructed TpuClient must resolve the persisted
+        tidb_tpu_device_join global, not revert to the default."""
+        from tidb_tpu.ops import TpuClient
+        from tidb_tpu.session import new_store
+        store = new_store("memory://joinroute_dj")
+        store.set_client(TpuClient(store, dispatch_floor_rows=0))
+        tk = TestKit(store)
+        tk.exec("set global tidb_tpu_device_join = 0")
+        assert store.get_client().device_join is False
+        assert TpuClient(store).device_join is False
+        tk.exec("set global tidb_tpu_device_join = 1")
+        assert TpuClient(store).device_join is True
+
+    def test_no_tpu_client_stays_on_host(self):
+        """Without a TpuClient on the store, joins must not touch the
+        device path regardless of size."""
+        tk = TestKit()
+        tk.exec("create database jr2; use jr2")
+        tk.exec("create table a (id bigint primary key, k int)")
+        tk.exec("create table b (id bigint primary key, k int)")
+        tk.exec("insert into a values (1, 1), (2, 2)")
+        tk.exec("insert into b values (1, 1), (2, 9)")
+        seen = []
+        orig = executors.HashJoinExec._try_vector_join
+
+        def spy(self):
+            out = orig(self)
+            seen.append(self.join_stats.get("path"))
+            return out
+        executors.HashJoinExec._try_vector_join = spy
+        try:
+            rows = tk.query(
+                "select a.id, b.id from a join b on a.k = b.k").rows
+            assert sorted(map(tuple, rows)) == [(1, 1)]
+            assert seen[-1] == "numpy"
+        finally:
+            executors.HashJoinExec._try_vector_join = orig

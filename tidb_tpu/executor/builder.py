@@ -62,7 +62,9 @@ class ExecutorBuilder:
             left = self.build(p.children[0])
             right = self.build(p.children[1])
             if p.eq_conditions:
-                return ex.HashJoinExec(left, right, p, p.schema)
+                # ctx gives the join the store's TPU client for device
+                # routing (tidb_tpu_dispatch_floor)
+                return ex.HashJoinExec(left, right, p, p.schema, self.ctx)
             return ex.HashJoinCartesianFix(left, right, p, p.schema)
         if isinstance(p, pl.PhysicalUnion):
             return ex.UnionExec([self.build(c) for c in p.children], p.schema)

@@ -11,8 +11,11 @@ which record each row came from.
 
 from __future__ import annotations
 
+import collections
 import functools
 import heapq
+import sys
+import time
 
 from tidb_tpu import errors
 from tidb_tpu.codec import codec
@@ -252,6 +255,7 @@ class HashAggExec(Executor):
         self.pushed_child = pushed_child
         self._groups: dict[bytes, list] | None = None
         self._order: list[bytes] = []
+        self._fused: list | None = None   # join→agg fused result rows
         self._pos = 0
 
     def _group_key(self, row) -> bytes:
@@ -263,6 +267,16 @@ class HashAggExec(Executor):
 
     def _materialize(self):
         child = self.children[0]
+        if not self.pushed_child and \
+                hasattr(child, "device_join_result"):
+            # join→agg fusion: aggregate directly over the device join's
+            # gathered column planes — no joined-row materialization
+            from tidb_tpu.executor.fused_agg import try_fused_join_agg
+            fused = try_fused_join_agg(self)
+            if fused is not None:
+                self._fused = fused
+                self._groups, self._order = {}, []
+                return
         groups: dict[bytes, list] = {}
         order = []
         while True:
@@ -295,6 +309,12 @@ class HashAggExec(Executor):
     def next(self):
         if self._groups is None:
             self._materialize()
+        if self._fused is not None:
+            if self._pos >= len(self._fused):
+                return None
+            row = self._fused[self._pos]
+            self._pos += 1
+            return row
         if self._pos >= len(self._order):
             return None
         gk = self._order[self._pos]
@@ -365,29 +385,43 @@ class StreamAggExec(Executor):
 
 
 class HashJoinExec(Executor):
-    """Equi-join executor. Two paths:
+    """Equi-join executor. Three paths, fastest first:
 
-    * vectorized sort-merge (numpy) for single int/float key joins — the
-      data-parallel answer to the reference's JoinConcurrency worker pool
-      (executor/executor.go:442,568-640): where Go shards the probe
-      stream across goroutines, this runtime gets its parallelism from
-      columnar batch operations (argsort + searchsorted + range-expand),
-      which beat a per-row Python hash probe by an order of magnitude.
+    * device build/probe (ops.kernels join kernels) for single int/float
+      key joins at or above the TPU dispatch floor: stable sort of the
+      right keys + searchsorted/range-expand probe run as jitted XLA
+      kernels emitting match index pairs; output stays columnar
+      (ops.columnar.DeviceJoinResult) so an aggregate above the join
+      consumes gathered planes directly (join→agg fusion) and only
+      row-pulling consumers pay materialization — which is one native
+      batch call (codecx.join_rows), not a per-row Python generator.
+    * vectorized sort-merge (numpy) for the same join shapes below the
+      floor — the data-parallel answer to the reference's
+      JoinConcurrency worker pool (executor/executor.go:442,568-640).
     * the row-at-a-time hash build/probe for everything else (multi-key,
-      string keys, exotic kinds) — semantics identical by construction
-      (the differential tests run both).
+      string keys, exotic kinds, ci collations) — semantics identical by
+      construction (the differential tests run all three).
+
+    Emission order is the dict path's on every path: left-scan order,
+    matches in right-scan order.
     """
 
     def __init__(self, child_left: Executor, child_right: Executor,
-                 plan, schema: Schema):
+                 plan, schema: Schema, ctx=None):
         self.children = [child_left, child_right]
         self.plan = plan
         self.schema = schema
+        self.ctx = ctx
+        # explicit routing override (tests/bench); None → ask the store's
+        # TPU client for its tidb_tpu_dispatch_floor
+        self.device_floor: int | None = None
+        self.join_stats: dict = {}   # path + per-phase timings (bench)
         self._built: dict[bytes, list] | None = None
-        self._pending: list = []
+        self._pending: collections.deque = collections.deque()
         self._right_width = 0
         self._vector_iter = None                  # streaming vector join
         self._vector_tried = False
+        self._device = None                       # DeviceJoinResult
         self._prebuilt_right: list | None = None  # drained by a bailed
         self._left_iter = None                    # vector attempt; the
         #                                           slow path replays them
@@ -410,7 +444,7 @@ class HashJoinExec(Executor):
             table.setdefault(codec.encode_value(key_vals), []).append(row)
         self._built = table
 
-    # ---- vectorized single-key sort-merge path ----
+    # ---- vectorized single-key paths (device kernels / numpy) ----
 
     # UINT64 excluded: the codec keys the dict path uses encode u64(5)
     # and i64(5) as DIFFERENT keys, and folding both into one int64
@@ -419,40 +453,38 @@ class HashJoinExec(Executor):
 
     def _key_array(self, rows, col):
         """(values f64/i64 ndarray, valid bool ndarray) for one key column
-        across rows; None when a kind outside the fast set appears.
-        np.fromiter over a generator is ~10x a branchy Python loop."""
-        import numpy as np
-        idx = col.index
-        n = len(rows)
-        if n == 0:
-            return np.zeros(0, np.int64), np.zeros(0, bool)
-        kinds = np.fromiter((r[idx].kind for r in rows), dtype=np.int16,
-                            count=n)
-        k_null, k_int, k_f64 = int(Kind.NULL), int(Kind.INT64), \
-            int(Kind.FLOAT64)
-        present = set(np.unique(kinds).tolist())
-        if not present <= {k_null, k_int, k_f64}:
+        across rows; None when a kind outside the fast set appears
+        (strings route to the dict path: their codec-key collation
+        semantics live there)."""
+        from tidb_tpu.ops.columnar import rows_plane
+        kind, vals, valid = rows_plane(rows, col.index)
+        if kind not in ("i64", "f64"):
             return None, None
-        if k_int in present and k_f64 in present:
-            # mixed kinds on ONE side: the dict path's codec keys treat
-            # int 1 and float 1.0 as distinct — stay on that path
-            return None, None
-        valid = kinds != k_null
-        dtype = np.float64 if k_f64 in present else np.int64
-        if k_null in present:
-            vals = np.fromiter(
-                (r[idx].val if m else 0
-                 for r, m in zip(rows, valid.tolist())),
-                dtype=dtype, count=n)
-        else:
-            vals = np.fromiter((r[idx].val for r in rows), dtype=dtype,
-                               count=n)
         return vals, valid
 
+    def _device_join_floor(self) -> int | None:
+        """Row floor above which the join routes to the device kernels,
+        or None when no TPU engine is installed. Reads the store client's
+        tidb_tpu_dispatch_floor (the same sessionctx-variable-backed
+        floor that routes coprocessor scans), via sys.modules so a pure
+        CPU process never imports jax just to answer this question."""
+        if self.device_floor is not None:
+            return self.device_floor
+        mod = sys.modules.get("tidb_tpu.ops.client")
+        if mod is None or self.ctx is None:
+            return None
+        client = getattr(self.ctx, "client", None)
+        if isinstance(client, mod.TpuClient) and \
+                getattr(client, "device_join", True):
+            return client.dispatch_floor_rows
+        return None
+
     def _try_vector_join(self) -> bool:
-        """Drain both sides and join via stable argsort + searchsorted +
-        range expansion. Emission order matches the dict path exactly:
-        left-scan order, matches in right-scan order."""
+        """Drain both sides and join vectorized: device build/probe
+        kernels at/above the dispatch floor, stable numpy argsort +
+        searchsorted below it (or on device bail-out). Emission order
+        matches the dict path exactly: left-scan order, matches in
+        right-scan order."""
         import numpy as np
         from tidb_tpu.expression import Column as ExprColumn
         from tidb_tpu.plan.plans import Join
@@ -489,21 +521,90 @@ class HashJoinExec(Executor):
             # int side vs float side never match under the dict path's
             # codec keys; replicate by matching nothing / outer-padding
             lvalid = np.zeros_like(lvalid)
+            lkey = lkey.astype(rkey.dtype)
+        left_ok = None
+        if plan.left_conditions:
+            left_ok = [_conds_ok(plan.left_conditions, r) for r in lrows]
+        floor = self._device_join_floor()
+        if floor is not None and max(len(lrows), len(rrows)) >= floor:
+            try:
+                self._start_device(lrows, rrows, lkey, lvalid, rkey,
+                                   rvalid, left_ok)
+                return True
+            except Exception:
+                # clean bail-out: the numpy path below answers from the
+                # same drained rows and key planes — but a systematically
+                # failing device path must not degrade silently
+                import logging
+                logging.getLogger("tidb_tpu.join").warning(
+                    "device join bailed out to the numpy path",
+                    exc_info=True)
+                self.join_stats["device_error"] = True
+        self.join_stats["path"] = "numpy"
         order = np.argsort(rkey[rvalid], kind="stable")
         ridx = np.flatnonzero(rvalid)[order].tolist()
         rs = rkey[rvalid][order]
         lo = np.searchsorted(rs, lkey, side="left")
         hi = np.searchsorted(rs, lkey, side="right")
         hi = np.where(lvalid, hi, lo)      # NULL/unmatchable: empty range
-        left_ok = None
-        if plan.left_conditions:
-            left_ok = [_conds_ok(plan.left_conditions, r) for r in lrows]
         # STREAMING emission: rows assemble per next() pull, so a LIMIT
         # above the join stops after a handful of rows instead of paying
         # for (and holding) the full join output
         self._vector_iter = self._vector_stream(
             lrows, rrows, ridx, lo.tolist(), hi.tolist(), left_ok)
         return True
+
+    def _start_device(self, lrows, rrows, lkey, lvalid, rkey, rvalid,
+                      left_ok) -> None:
+        """Run the device join kernels and assemble the columnar result
+        (final emission-order index pairs; r_idx -1 = LEFT OUTER pad).
+        Rows are NOT materialized here — an aggregate parent fuses over
+        the gathered planes instead (executor.fused_agg)."""
+        import numpy as np
+        from tidb_tpu.ops import columnar as col_mod
+        from tidb_tpu.ops import kernels
+        from tidb_tpu.plan.plans import Join
+        stats = self.join_stats
+        li, ri = kernels.join_match_pairs(lkey, lvalid, rkey, rvalid,
+                                          stats=stats)
+        t0 = time.time()
+        if left_ok is not None:
+            lok = np.asarray(left_ok, dtype=bool)
+            keep = lok[li] if len(li) else np.zeros(0, bool)
+            li, ri = li[keep], ri[keep]
+        other = self.plan.other_conditions
+        if other:
+            # residual non-equi conditions need joined rows: materialize
+            # the matched pairs once, filter, keep the surviving pairs
+            pairs = col_mod.materialize_join_rows(lrows, rrows, li, ri,
+                                                  self._right_width)
+            keep = np.fromiter((_conds_ok(other, row) for row in pairs),
+                               dtype=bool, count=len(pairs))
+            li, ri = li[keep], ri[keep]
+        if self.plan.join_type == Join.LEFT_OUTER:
+            matched = np.bincount(li, minlength=len(lrows))
+            pad_l = np.flatnonzero(matched == 0)
+            if len(pad_l):
+                li = np.concatenate([li, pad_l])
+                ri = np.concatenate([ri, np.full(len(pad_l), -1, np.int64)])
+                # stable merge back into left-scan order; pads never share
+                # a left index with surviving matches
+                perm = np.argsort(li, kind="stable")
+                li, ri = li[perm], ri[perm]
+        self._device = col_mod.DeviceJoinResult(
+            lrows, rrows, li, ri, len(self.children[0].schema),
+            self._right_width)
+        stats["path"] = "device"
+        stats["assemble_s"] = time.time() - t0
+
+    def device_join_result(self):
+        """Start the join (if needed) and expose its columnar result for
+        join→agg fusion; None when a non-device path answered. Reading
+        planes off the result does not materialize rows."""
+        if not self._vector_tried:
+            self._vector_tried = True
+            self._try_vector_join()
+        return self._device
 
     def _vector_stream(self, lrows, rrows, ridx, lo, hi, left_ok):
         """Emit joined rows in left-scan order, matches in right-scan
@@ -528,17 +629,22 @@ class HashJoinExec(Executor):
                 yield lrow + pad
 
     def next(self):
-        from tidb_tpu.plan.plans import Join
         if not self._vector_tried:
             self._vector_tried = True
             self._try_vector_join()
+        if self._device is not None and self._vector_iter is None:
+            # chunked lazy assembly: a LIMIT above the join pays one
+            # chunk, a full drain still runs few native batch calls
+            self._vector_iter = self._device.iter_rows(
+                stats=self.join_stats)
         if self._vector_iter is not None:
             return next(self._vector_iter, None)
+        from tidb_tpu.plan.plans import Join
         if self._built is None:
             self._build()
         while True:
             if self._pending:
-                return self._pending.pop(0)
+                return self._pending.popleft()
             left_row = next(self._left_iter, None) \
                 if self._left_iter is not None else self.children[0].next()
             if left_row is None:
@@ -559,11 +665,13 @@ class HashJoinExec(Executor):
                         continue
                     out.append(joined)
             if out:
+                # deque, not list: LEFT OUTER drains via popleft, and a
+                # wide match set must not pay O(n²) list re-shifts
                 if self.plan.join_type == Join.LEFT_OUTER:
-                    self._pending = out
+                    self._pending = collections.deque(out)
                     continue
-                self._pending = out[1:]
-                return out[0]
+                self._pending = collections.deque(out)
+                return self._pending.popleft()
             if self.plan.join_type == Join.LEFT_OUTER:
                 return left_row + [NULL] * self._right_width
             # inner: no match → skip row

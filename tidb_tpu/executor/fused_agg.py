@@ -1,0 +1,252 @@
+"""join→aggregate fusion: COMPLETE-mode hash aggregation evaluated
+directly over a device join's columnar output (ops.columnar.
+DeviceJoinResult) — the joined rows are never materialized.
+
+This is the executor-layer payoff of keeping the join columnar (PAPER
+§L5: operators stay columnar end-to-end across the pushdown boundary):
+a join feeding an aggregate gathers only the planes the aggregate
+actually touches, and the aggregate itself runs as vectorized numpy
+segment reductions keyed by first-appearance group ids.
+
+Exactness contract — fused output must be row-for-row identical to the
+HashAggExec row loop it replaces, so every reduction mirrors
+expression.aggregation semantics precisely:
+
+- int SUM/AVG accumulate exactly (int64 with an overflow pre-guard; the
+  row path uses Decimal) and convert to the same Decimal datums;
+- float SUM/AVG use np.add.at — an UNBUFFERED scatter-add that applies
+  contributions in row order, i.e. the same left-to-right float rounding
+  sequence as the per-row accumulator (np.sum's pairwise summation would
+  differ in the last ulp);
+- groups emit in first-appearance order, NULL keys form one group;
+- anything outside the provably-identical subset (strings under min/max,
+  decimals, ci collations, distinct, mixed-kind planes, -0.0 in float
+  planes) returns None and the row loop answers.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+from tidb_tpu.expression.expression import Column, Constant
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, Kind
+
+I64_MAX = (1 << 63) - 1
+I64_MIN = -(1 << 63)
+
+_FUSABLE = ("count", "sum", "avg", "min", "max", "first_row")
+
+# process-wide fusion tallies (bench/tests introspection): "fused" counts
+# aggregates answered from planes, "fallback" counts row-loop bail-outs
+# that had a device join available
+stats = {"fused": 0, "fallback": 0}
+
+
+def _is_ci(e) -> bool:
+    rt = getattr(e, "ret_type", None)
+    return rt is not None and rt.is_ci_collation()
+
+
+def _has_neg_zero(vals, mask) -> bool:
+    """-0.0 poisons fused SUM/MIN/MAX output *identity*: the row path's
+    accumulator keeps the first-seen zero sign (an all-(-0.0) sum stays
+    -0.0; min/max keep the first-seen of a ±0.0 tie) while numpy
+    reductions normalize — those aggregates bail to the row loop.
+    GROUPING is unaffected: the codec normalizes -0.0 into the 0.0 key
+    (codec/number.py encode_float_to_cmp_u64) exactly like np.unique."""
+    z = (vals == 0.0) & np.signbit(vals) & mask
+    return bool(np.any(z))
+
+
+def try_fused_join_agg(agg):
+    """Fused result rows for a HashAggExec over a device join, or None
+    when any piece falls outside the vectorizable subset. Cheap
+    structural gates run BEFORE the child is started, so a None from
+    them leaves the join untouched for the row loop."""
+    out = _try_fused(agg)
+    if out is not None:
+        stats["fused"] += 1
+    elif getattr(agg.children[0], "_device", None) is not None:
+        stats["fallback"] += 1
+    return out
+
+
+def _try_fused(agg):
+    from tidb_tpu.expression.aggregation import AggFunctionMode
+
+    for f in agg.agg_funcs:
+        if f.mode != AggFunctionMode.COMPLETE or f.distinct:
+            return None
+        if f.name not in _FUSABLE or len(f.args) > 1:
+            return None
+        for a in f.args:
+            if not isinstance(a, (Column, Constant)):
+                return None
+    for g in agg.group_by:
+        if not isinstance(g, Column) or _is_ci(g):
+            return None
+
+    child = agg.children[0]
+    res = child.device_join_result()
+    if res is None:
+        return None
+    n = len(res)
+
+    if agg.group_by:
+        codes = []
+        for g in agg.group_by:
+            c = _group_codes(res, g.index)
+            if c is None:
+                return None
+            codes.append(c)
+        if len(codes) == 1:
+            _u, first_idx, gid = np.unique(
+                codes[0], return_index=True, return_inverse=True)
+            G = len(_u)
+        else:
+            mat = np.stack(codes, axis=1)
+            _u, first_idx, gid = np.unique(
+                mat, axis=0, return_index=True, return_inverse=True)
+            G = _u.shape[0]
+        gid = np.reshape(gid, -1)
+        if G == 0:
+            return []   # GROUP BY over empty input emits no rows
+    else:
+        if n == 0:
+            # aggregates over an empty input still yield one row — the
+            # exact fresh-context results of the row path
+            return [[f.get_result(f.create_context())
+                     for f in agg.agg_funcs]]
+        gid = np.zeros(n, dtype=np.int64)
+        first_idx = np.zeros(1, dtype=np.int64)
+        G = 1
+
+    cols = []
+    for f in agg.agg_funcs:
+        col_res = _fused_func(res, f, gid, G, first_idx, n)
+        if col_res is None:
+            return None
+        cols.append(col_res)
+
+    emit = np.argsort(first_idx, kind="stable")
+    child.join_stats["fused_agg"] = True
+    return [[c[g] for c in cols] for g in emit.tolist()]
+
+
+def _group_codes(res, j: int):
+    """Dense group codes for output column j; NULL → -1 (one group,
+    MySQL GROUP BY NULL). None when the plane can't represent the column
+    with codec-key-equal grouping."""
+    kind, vals, valid = res.column_plane(j)
+    if kind is None:
+        return None
+    if kind == "str":
+        uniq = sorted(set(vals[valid].tolist()))
+        m = {b: i for i, b in enumerate(uniq)}
+        return np.fromiter(
+            (m[v] if ok else -1
+             for v, ok in zip(vals.tolist(), valid.tolist())),
+            dtype=np.int64, count=len(vals))
+    if kind == "f64":
+        # -0.0 groups WITH 0.0 in both paths (the codec key normalizes
+        # it, np.unique compares it equal) — normalize so searchsorted
+        # below finds the one shared code
+        vals = np.where(vals == 0.0, 0.0, vals)
+    uniq = np.unique(vals[valid])
+    codes = np.searchsorted(uniq, vals).astype(np.int64)
+    codes[~valid] = -1
+    return codes
+
+
+def _arg_plane(res, f, n: int):
+    """(kind, values, valid) plane for an aggregate argument — a gathered
+    column or a broadcast constant. None when unsupported."""
+    arg = f.args[0] if f.args else None
+    if arg is None or isinstance(arg, Constant):
+        const = arg.value if arg is not None else Datum.i64(1)
+        if const.is_null():
+            return "i64", np.zeros(n, np.int64), np.zeros(n, bool)
+        if const.kind == Kind.INT64:
+            return ("i64", np.full(n, int(const.val), np.int64),
+                    np.ones(n, bool))
+        if const.kind == Kind.FLOAT64:
+            return ("f64", np.full(n, float(const.val), np.float64),
+                    np.ones(n, bool))
+        return None
+    return res.column_plane(arg.index)
+
+
+def _fused_func(res, f, gid, G: int, first_idx, n: int):
+    """Per-group result datums (unique-order indexing) for one aggregate,
+    or None to bail the whole fusion."""
+    name = f.name
+    if name == "first_row":
+        arg = f.args[0] if f.args else None
+        if isinstance(arg, Constant):
+            return [arg.value] * G
+        if not isinstance(arg, Column):
+            return None
+        return [res.datum_at(arg.index, int(first_idx[g]))
+                for g in range(G)]
+
+    plane = _arg_plane(res, f, n)
+    if plane is None:
+        return None
+    kind, vals, valid = plane
+
+    if name == "count":
+        cnt = np.bincount(gid[valid], minlength=G)
+        return [Datum.i64(int(c)) for c in cnt]
+
+    if kind == "str":
+        return None   # string min/max needs collation-aware compares
+    ok = valid
+    cnt = np.bincount(gid[ok], minlength=G)
+
+    if name in ("sum", "avg"):
+        vk, gk = vals[ok], gid[ok]
+        if kind == "i64":
+            if len(vk):
+                mx = max(abs(int(vk.min())), abs(int(vk.max())))
+                if mx and mx * len(vk) >= (1 << 63):
+                    return None   # could wrap: the Decimal row path answers
+            sums = np.zeros(G, np.int64)
+            np.add.at(sums, gk, vk)
+        else:
+            if _has_neg_zero(vals, ok):
+                return None
+            sums = np.zeros(G, np.float64)
+            np.add.at(sums, gk, vk)
+        out = []
+        for g in range(G):
+            c = int(cnt[g])
+            if c == 0:
+                out.append(NULL)
+            elif name == "sum":
+                out.append(Datum.f64(float(sums[g])) if kind == "f64"
+                           else Datum.dec(Decimal(int(sums[g]))))
+            else:
+                out.append(Datum.f64(float(sums[g]) / c) if kind == "f64"
+                           else Datum.dec(Decimal(int(sums[g]))
+                                          / Decimal(c)))
+        return out
+
+    if name in ("min", "max"):
+        is_min = name == "min"
+        if kind == "i64":
+            init = I64_MAX if is_min else I64_MIN
+            red = np.full(G, init, np.int64)
+        else:
+            if _has_neg_zero(vals, ok):
+                return None
+            red = np.full(G, np.inf if is_min else -np.inf, np.float64)
+        (np.minimum if is_min else np.maximum).at(red, gid[ok], vals[ok])
+        return [NULL if cnt[g] == 0
+                else (Datum.f64(float(red[g])) if kind == "f64"
+                      else Datum.i64(int(red[g])))
+                for g in range(G)]
+
+    return None

@@ -819,9 +819,158 @@ fail:
     return NULL;
 }
 
+/* ------------------------------------------------------------------ */
+/* join_rows: batch-assemble joined executor rows from device-join     */
+/* match index pairs. out[i] = lrows[l_idx[i]] + rrows[r_idx[i]], with */
+/* r_idx[i] == -1 emitting a LEFT OUTER NULL pad of right_width — the  */
+/* columnar join's row materialization tail in one C pass instead of a */
+/* per-row Python generator (the per-row dispatch tax the coprocessor  */
+/* model exists to avoid).                                             */
+/* ------------------------------------------------------------------ */
+
+static PyObject *py_join_rows(PyObject *self, PyObject *args) {
+    PyObject *lrows, *rrows;
+    Py_buffer lbuf, rbuf;
+    Py_ssize_t right_width;
+    if (!PyArg_ParseTuple(args, "O!O!y*y*n", &PyList_Type, &lrows,
+                          &PyList_Type, &rrows, &lbuf, &rbuf, &right_width))
+        return NULL;
+    PyObject *out = NULL;
+    if (lbuf.len != rbuf.len || lbuf.len % 8 != 0 || right_width < 0) {
+        PyErr_SetString(Unsupported, "join_rows: bad index buffers");
+        goto done;
+    }
+    if (dx_init() < 0) goto done;   /* for the NULL pad singleton */
+    Py_ssize_t n = lbuf.len / 8;
+    const int64_t *li = (const int64_t *)lbuf.buf;
+    const int64_t *ri = (const int64_t *)rbuf.buf;
+    Py_ssize_t nl = PyList_GET_SIZE(lrows), nr = PyList_GET_SIZE(rrows);
+    out = PyList_New(n);
+    if (!out) goto done;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (li[i] < 0 || li[i] >= nl || ri[i] >= nr) {
+            PyErr_SetString(Unsupported, "join_rows: index out of range");
+            Py_CLEAR(out);
+            goto done;
+        }
+        PyObject *lrow = PyList_GET_ITEM(lrows, li[i]);
+        PyObject *rrow = ri[i] >= 0 ? PyList_GET_ITEM(rrows, ri[i]) : NULL;
+        if (!PyList_Check(lrow) || (rrow && !PyList_Check(rrow))) {
+            PyErr_SetString(Unsupported, "join_rows: rows must be lists");
+            Py_CLEAR(out);
+            goto done;
+        }
+        Py_ssize_t lw = PyList_GET_SIZE(lrow);
+        Py_ssize_t rw = rrow ? PyList_GET_SIZE(rrow) : right_width;
+        PyObject *row = PyList_New(lw + rw);
+        if (!row) { Py_CLEAR(out); goto done; }
+        for (Py_ssize_t j = 0; j < lw; j++) {
+            PyObject *v = PyList_GET_ITEM(lrow, j);
+            Py_INCREF(v);
+            PyList_SET_ITEM(row, j, v);
+        }
+        for (Py_ssize_t j = 0; j < rw; j++) {
+            PyObject *v = rrow ? PyList_GET_ITEM(rrow, j) : dx_null;
+            Py_INCREF(v);
+            PyList_SET_ITEM(row, lw + j, v);
+        }
+        PyList_SET_ITEM(out, i, row);
+    }
+done:
+    PyBuffer_Release(&lbuf);
+    PyBuffer_Release(&rbuf);
+    return out;
+}
+
+/* ------------------------------------------------------------------ */
+/* num_plane: one numeric column of materialized executor rows → value */
+/* + validity planes in one C pass — the join key-array fast path      */
+/* (columnar.rows_plane). Only {NULL, INT64, FLOAT64} columns qualify, */
+/* and int/float may not mix (the dict join path's codec keys treat    */
+/* int 1 and float 1.0 as distinct); anything else raises Unsupported  */
+/* and the caller's Python scan decides.                               */
+/* ------------------------------------------------------------------ */
+
+static PyObject *py_num_plane(PyObject *self, PyObject *args) {
+    PyObject *rows;
+    Py_ssize_t idx;
+    if (!PyArg_ParseTuple(args, "O!n", &PyList_Type, &rows, &idx))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(rows);
+    union { int64_t i; double f; } *vals = NULL;
+    uint8_t *valid = NULL;
+    PyObject *vbytes = NULL, *mbytes = NULL, *out = NULL;
+    int is_f64 = -1;   /* -1 = undecided (only NULLs so far) */
+    vals = PyMem_Malloc(n ? n * 8 : 8);
+    valid = PyMem_Malloc(n ? n : 1);
+    if (!vals || !valid) { PyErr_NoMemory(); goto done; }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *row = PyList_GET_ITEM(rows, i);
+        if (!PyList_Check(row) || idx < 0 || idx >= PyList_GET_SIZE(row)) {
+            PyErr_SetString(Unsupported, "num_plane: bad row shape");
+            goto done;
+        }
+        PyObject *d = PyList_GET_ITEM(row, idx);
+        PyObject *kobj = PyObject_GetAttr(d, s_kind);
+        if (!kobj) goto done;
+        long k = PyLong_AsLong(kobj);
+        Py_DECREF(kobj);
+        if (k == -1 && PyErr_Occurred()) goto done;
+        if (k == K_NULL) {
+            valid[i] = 0;
+            vals[i].i = 0;
+            continue;
+        }
+        if (k != K_I64 && k != K_F64) {
+            PyErr_SetString(Unsupported, "num_plane: non-numeric kind");
+            goto done;
+        }
+        int f = (k == K_F64);
+        if (is_f64 == -1) is_f64 = f;
+        else if (is_f64 != f) {
+            PyErr_SetString(Unsupported, "num_plane: mixed int/float");
+            goto done;
+        }
+        PyObject *val = PyObject_GetAttr(d, s_val);
+        if (!val) goto done;
+        if (f) {
+            double v = PyFloat_AsDouble(val);
+            Py_DECREF(val);
+            if (v == -1.0 && PyErr_Occurred()) goto done;
+            vals[i].f = v;
+        } else {
+            int overflow = 0;
+            long long v = PyLong_AsLongLongAndOverflow(val, &overflow);
+            Py_DECREF(val);
+            if (overflow || (v == -1 && PyErr_Occurred())) {
+                if (!PyErr_Occurred())
+                    PyErr_SetString(Unsupported, "num_plane: i64 overflow");
+                goto done;
+            }
+            vals[i].i = v;
+        }
+        valid[i] = 1;
+    }
+    vbytes = PyBytes_FromStringAndSize((const char *)vals, n * 8);
+    mbytes = PyBytes_FromStringAndSize((const char *)valid, n);
+    if (vbytes && mbytes)
+        out = Py_BuildValue("sOO", is_f64 == 1 ? "f" : "i", vbytes, mbytes);
+done:
+    PyMem_Free(vals);
+    PyMem_Free(valid);
+    Py_XDECREF(vbytes);
+    Py_XDECREF(mbytes);
+    return out;
+}
+
 static PyMethodDef methods[] = {
     {"decode_row_datums", py_decode_row_datums, METH_VARARGS,
      "decode_row_datums(value) -> {col_id: Datum} (row-scan fast path)"},
+    {"join_rows", py_join_rows, METH_VARARGS,
+     "join_rows(lrows, rrows, l_idx, r_idx, right_width) -> "
+     "list[list] (device-join row materialization)"},
+    {"num_plane", py_num_plane, METH_VARARGS,
+     "num_plane(rows, idx) -> (kind, values, valid) numeric column plane"},
     {"encode_row", py_encode_row, METH_VARARGS,
      "encode_row(col_ids, datums) -> bytes (compact row value layout)"},
     {"encode_datums", py_encode_datums, METH_VARARGS,

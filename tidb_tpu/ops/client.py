@@ -87,6 +87,22 @@ class TpuClient(kv.Client):
         factory = getattr(store, "copr_cpu_client", None)
         self.cpu = factory() if factory is not None else LocalClient(store)
         self.mesh = mesh            # parallel.CoprMesh for multi-chip
+        # executor-layer device join routing (HashJoinExec reads this
+        # client's dispatch floor through it): SET GLOBAL
+        # tidb_tpu_device_join = 0 pins joins to the host numpy path
+        # while scans keep routing to the device. A freshly constructed
+        # client resolves the persisted global itself (any install path
+        # — SET backend, store.set_client, restart) instead of silently
+        # reverting the kill switch to its default.
+        self.device_join = bool(int(
+            _SYSVAR_DEFAULTS["tidb_tpu_device_join"]))
+        import sys as _sys
+        sess_mod = _sys.modules.get("tidb_tpu.session")
+        if sess_mod is not None:
+            v = sess_mod.store_global_var(store, "tidb_tpu_device_join")
+            if v is not None:
+                from tidb_tpu.sessionctx import parse_bool_sysvar
+                self.device_join = parse_bool_sysvar(v)
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
         # (jitted, planes, live) of the most recent single-chip aggregate

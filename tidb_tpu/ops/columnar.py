@@ -497,6 +497,181 @@ def pack_index_ranges(snapshot, index_info, ranges) -> ColumnBatch:
     return ColumnBatch(n, cap, h, cols)
 
 
+# ---------------------------------------------------------------------------
+# join output assembly: planes over materialized executor rows, gathered
+# by device-join match pairs — the columnar half of the device hash join
+# (ops.kernels.join_match_pairs). Rows materialize only when something
+# actually consumes rows; an aggregate above the join reads the gathered
+# planes directly (join→agg fusion, executor.fused_agg).
+# ---------------------------------------------------------------------------
+
+_JOIN_KINDS = None   # lazy (Kind import keeps this module numpy-light)
+
+
+def _native_num_plane(rows, idx: int):
+    """C single-pass numeric plane (codecx.num_plane); None → caller's
+    Python scan decides (string columns, exotic kinds, no extension)."""
+    if not isinstance(rows, list):
+        return None
+    from tidb_tpu.native import codecx as _cx
+    if _cx is None or not hasattr(_cx, "num_plane"):
+        return None
+    try:
+        kind, vbytes, mbytes = _cx.num_plane(rows, idx)
+    except (_cx.Unsupported, TypeError):
+        return None
+    n = len(rows)
+    valid = np.frombuffer(mbytes, dtype=np.uint8, count=n).astype(bool)
+    dtype = np.float64 if kind == "f" else np.int64
+    vals = np.frombuffer(vbytes, dtype=dtype, count=n).copy()
+    return ("f64" if kind == "f" else "i64"), vals, valid
+
+
+def rows_plane(rows, idx: int):
+    """One column of materialized executor rows → (kind, values, valid)
+    columnar plane. kind is "i64" / "f64" (numpy numeric planes) or
+    "str" (object plane of bytes); (None, None, None) when the column
+    mixes kinds or holds a kind with no plane mapping — mixed int/float
+    stays off the vector paths because the dict path's codec keys treat
+    int 1 and float 1.0 as distinct values."""
+    global _JOIN_KINDS
+    if _JOIN_KINDS is None:
+        _JOIN_KINDS = (int(Kind.NULL), int(Kind.INT64), int(Kind.FLOAT64),
+                       int(Kind.STRING), int(Kind.BYTES))
+    k_null, k_int, k_f64, k_str, k_bytes = _JOIN_KINDS
+    n = len(rows)
+    if n == 0:
+        return "i64", np.zeros(0, np.int64), np.zeros(0, bool)
+    native = _native_num_plane(rows, idx)
+    if native is not None:
+        return native
+    kinds = np.fromiter((r[idx].kind for r in rows), dtype=np.int16, count=n)
+    present = set(np.unique(kinds).tolist())
+    valid = kinds != k_null
+    if present == {k_null}:   # all-NULL: a (vacuously) numeric plane
+        return "i64", np.zeros(n, np.int64), valid
+    if present <= {k_null, k_str, k_bytes}:
+        vals = np.empty(n, dtype=object)
+        vals[:] = [r[idx].get_bytes() if m else None
+                   for r, m in zip(rows, valid.tolist())]
+        return "str", vals, valid
+    if not present <= {k_null, k_int, k_f64}:
+        return None, None, None
+    if k_int in present and k_f64 in present:
+        return None, None, None
+    dtype = np.float64 if k_f64 in present else np.int64
+    if k_null in present:
+        vals = np.fromiter(
+            (r[idx].val if m else 0 for r, m in zip(rows, valid.tolist())),
+            dtype=dtype, count=n)
+    else:
+        vals = np.fromiter((r[idx].val for r in rows), dtype=dtype, count=n)
+    return ("f64" if dtype == np.float64 else "i64"), vals, valid
+
+
+class DeviceJoinResult:
+    """Columnar view of a device join's output: the two drained sides
+    plus the FINAL emission-order index pairs (r_idx == -1 marks a LEFT
+    OUTER pad row). Column planes gather lazily per column; row
+    materialization is chunked native batch calls (codecx.join_rows)
+    paid only by consumers that actually pull rows."""
+
+    def __init__(self, lrows, rrows, l_idx: np.ndarray, r_idx: np.ndarray,
+                 left_width: int, right_width: int):
+        self.lrows = lrows
+        self.rrows = rrows
+        self.l_idx = l_idx
+        self.r_idx = r_idx
+        self.left_width = left_width
+        self.right_width = right_width
+        self._plane_cache: dict = {}
+
+    def __len__(self) -> int:
+        return len(self.l_idx)
+
+    def column_plane(self, j: int):
+        """Output column j (left columns first) gathered into a plane:
+        (kind, values, valid) or (None, None, None) when the source
+        column has no plane mapping. Right-side planes fold the outer
+        pads in as NULLs."""
+        ent = self._plane_cache.get(j)
+        if ent is not None:
+            return ent
+        if j < self.left_width:
+            kind, vals, valid = rows_plane(self.lrows, j)
+            if kind is not None:
+                vals, valid = vals[self.l_idx], valid[self.l_idx]
+        else:
+            kind, vals, valid = rows_plane(self.rrows, j - self.left_width)
+            if kind is not None:
+                pad = self.r_idx < 0
+                idx = np.where(pad, 0, self.r_idx)
+                if len(self.rrows):
+                    vals, valid = vals[idx], valid[idx] & ~pad
+                else:
+                    vals = np.zeros(len(self.r_idx), vals.dtype if kind != "str"
+                                    else object)
+                    valid = np.zeros(len(self.r_idx), bool)
+        ent = (kind, vals, valid)
+        self._plane_cache[j] = ent
+        return ent
+
+    def datum_at(self, j: int, i: int):
+        """Exact source Datum for output row i, column j — no plane
+        needed (first_row gathers a handful of these per group)."""
+        if j < self.left_width:
+            return self.lrows[self.l_idx[i]][j]
+        r = self.r_idx[i]
+        return NULL if r < 0 else self.rrows[r][j - self.left_width]
+
+    def iter_rows(self, chunk: int = 1 << 16, stats: dict | None = None):
+        """Stream output rows, assembling `chunk` index pairs per native
+        batch call — a LIMIT above the join pays one chunk, not the full
+        output (the streaming contract the numpy path keeps), while the
+        full drain still amortizes assembly across few C passes. `stats`
+        accumulates the total assembly time under "emit_s"."""
+        import time
+        n = len(self.l_idx)
+        for start in range(0, n, chunk):
+            t0 = time.time()
+            rows = materialize_join_rows(
+                self.lrows, self.rrows, self.l_idx[start:start + chunk],
+                self.r_idx[start:start + chunk], self.right_width)
+            if stats is not None:
+                stats["emit_s"] = stats.get("emit_s", 0.0) + \
+                    (time.time() - t0)
+            yield from rows
+
+
+def materialize_join_rows(lrows, rrows, l_idx, r_idx,
+                          right_width: int) -> list:
+    """Batch-assemble joined rows from match index pairs (r_idx -1 →
+    LEFT OUTER NULL pad). Native codec batch path when available; the
+    Python fallback is itself bulk (map over C iterators), not a per-row
+    generator. Cyclic GC pauses for the allocation burst: creating
+    millions of small lists under an already-huge live heap otherwise
+    spends ~5x the assembly time in generational scans."""
+    import gc
+    from tidb_tpu.ops import nativepack
+    gc_was_on = gc.isenabled()
+    if gc_was_on:
+        gc.disable()
+    try:
+        out = nativepack.join_rows(lrows, rrows, l_idx, r_idx, right_width)
+        if out is not None:
+            return out
+        pad = [NULL] * right_width
+        lget, rget = lrows.__getitem__, rrows.__getitem__
+        if len(r_idx) and int(r_idx.min()) >= 0:
+            return list(map(list.__add__, map(lget, l_idx.tolist()),
+                            map(rget, r_idx.tolist())))
+        return [lget(l) + (rget(r) if r >= 0 else pad)
+                for l, r in zip(l_idx.tolist(), r_idx.tolist())]
+    finally:
+        if gc_was_on:
+            gc.enable()
+
+
 def _pack_str_column(raw: list, va: np.ndarray, cap: int, n: int) -> ColumnData:
     uniq = sorted({v for v, ok in zip(raw, va[:n]) if ok})
     code_of = {b: i for i, b in enumerate(uniq)}

@@ -760,6 +760,129 @@ def build_ranked_group_fn(where: CompiledExpr | None, specs: list[AggSpec],
 
 
 # ---------------------------------------------------------------------------
+# device hash join: build (stable sort of right keys) + probe
+# (searchsorted + segment-range expansion) — the device answer to the
+# reference's HashJoinExec build/probe pools (executor/executor.go:442).
+# No hash table in HBM: XLA's sort is the join index (SURVEY §7 — sorts
+# beat data-dependent hashing on TPU), and stability is what carries the
+# dict path's emission order through the kernel.
+# ---------------------------------------------------------------------------
+
+
+def _join_build_impl(rkey, rvalid):
+    """Device join build over the right-side key plane.
+
+    Stable two-key sort (validity first, then key) puts NULL keys last
+    and keeps right-scan order among equal keys — exactly the per-key
+    row-list order the dict build produces. Positions at/after n_valid
+    are overwritten with a +sentinel so the probe's searchsorted sees a
+    monotone array whose tail can simply be clamped away."""
+    if rkey.dtype == jnp.float64:
+        sent = jnp.asarray(jnp.inf, rkey.dtype)
+    else:
+        sent = jnp.asarray(I64_MAX, rkey.dtype)
+    order = jnp.lexsort([rkey, (~rvalid).astype(jnp.int32)])
+    rs = rkey[order]
+    n_valid = jnp.sum(rvalid.astype(jnp.int64))
+    rs = jnp.where(jnp.arange(rs.shape[0]) < n_valid, rs, sent)
+    return rs, order, n_valid
+
+
+join_build_kernel = jax.jit(_join_build_impl)
+
+
+def _join_probe_impl(rs, order, n_valid, lkey, lvalid, out_cap):
+    """Device join probe: per-left-row match ranges via searchsorted,
+    expanded to explicit (l_idx, r_idx) pairs in ONE static-shaped pass.
+
+    Expansion is scatter-free: exclusive prefix sums of the per-row match
+    counts give each left row its output offset, and output slot j maps
+    back to its left row by searchsorted over those offsets — so pairs
+    come out in left-scan order with ties in right-scan order (emission
+    parity with the dict path by construction). `total` is exact even
+    when it exceeds out_cap; the host retries with the next bucket."""
+    lo = jnp.searchsorted(rs, lkey, side="left")
+    hi = jnp.searchsorted(rs, lkey, side="right")
+    # clamp away the sentinel tail (NULL right keys + padding); a genuine
+    # sentinel-valued left key must not match them
+    lo = jnp.minimum(lo, n_valid)
+    hi = jnp.minimum(hi, n_valid)
+    counts = jnp.where(lvalid, hi - lo, 0)
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int64), jnp.cumsum(counts.astype(jnp.int64))])
+    total = offsets[-1]
+    j = jnp.arange(out_cap, dtype=jnp.int64)
+    l = jnp.searchsorted(offsets, j, side="right") - 1
+    lc = jnp.clip(l, 0, lkey.shape[0] - 1)
+    p = lo[lc] + (j - offsets[lc])
+    p = jnp.clip(p, 0, order.shape[0] - 1)
+    r = order[p]
+    ok = j < total
+    # ONE packed int64 output = ONE device→host transfer for the whole
+    # probe (l pairs, r pairs, total) — on tunneled deployments every
+    # readback costs a full round trip (see pack_outputs)
+    return jnp.concatenate([jnp.where(ok, lc, -1), jnp.where(ok, r, -1),
+                            total[None]])
+
+
+join_probe_kernel = jax.jit(_join_probe_impl, static_argnames="out_cap")
+
+
+def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None):
+    """Host driver for the device join kernels: numpy key planes in,
+    (l_idx, r_idx) int64 numpy match pairs out, in left-scan order with
+    ties in right-scan order.
+
+    Inputs are padded to power-of-two buckets (one compiled kernel per
+    bucket, like every other kernel here). The probe's output capacity
+    starts at the left bucket (FK joins average ≤1 match per probe row)
+    and escalates to bucket(total) — at most one retry, because `total`
+    is exact regardless of capacity. `stats`, when given, receives
+    build_s / probe_s wall times (readback-certified) for the bench's
+    phase split."""
+    import time as _time
+
+    n_left = int(lkey.shape[0])
+    lcap = col.bucket_capacity(max(n_left, 1))
+    rcap = col.bucket_capacity(max(int(rkey.shape[0]), 1))
+    lk = np.zeros(lcap, dtype=lkey.dtype)
+    lk[:n_left] = lkey
+    lv = np.zeros(lcap, dtype=bool)
+    lv[:n_left] = lvalid
+    rk = np.zeros(rcap, dtype=rkey.dtype)
+    rk[: rkey.shape[0]] = rkey
+    rv = np.zeros(rcap, dtype=bool)
+    rv[: rkey.shape[0]] = rvalid
+
+    # build: dispatch only — its outputs stay device-resident as the
+    # probe's inputs, so no readback happens here (on tunneled
+    # deployments a sync would cost a whole extra round trip; build_s is
+    # therefore dispatch time, and probe_s, which ends at the certified
+    # pair readback, absorbs the build's actual compute)
+    t0 = _time.time()
+    rs, order, n_valid = join_build_kernel(jnp.asarray(rk), jnp.asarray(rv))
+    if stats is not None:
+        stats["build_s"] = _time.time() - t0
+
+    t0 = _time.time()
+    lk_d, lv_d = jnp.asarray(lk), jnp.asarray(lv)
+    out_cap = lcap
+    while True:
+        packed = np.asarray(join_probe_kernel(rs, order, n_valid, lk_d,
+                                              lv_d, out_cap=out_cap))
+        n_out = int(packed[-1])
+        if n_out <= out_cap:
+            break
+        out_cap = col.bucket_capacity(n_out)
+    l_idx = packed[:n_out]
+    r_idx = packed[out_cap:out_cap + n_out]
+    if stats is not None:
+        stats["probe_s"] = _time.time() - t0
+        stats["n_pairs"] = n_out
+    return l_idx, r_idx
+
+
+# ---------------------------------------------------------------------------
 # filter / topn kernels (non-aggregate requests)
 # ---------------------------------------------------------------------------
 

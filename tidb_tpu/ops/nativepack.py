@@ -26,6 +26,20 @@ def _kind_char(col) -> str | None:
     return {"i64": "i", "f64": "f", "str": "s"}.get(k)
 
 
+def join_rows(lrows, rrows, l_idx, r_idx, right_width: int):
+    """Native batch assembly of joined executor rows from device-join
+    match pairs (r_idx -1 → LEFT OUTER NULL pad). Returns None to fall
+    back to the Python assembly (module unavailable / non-list rows)."""
+    if _cx is None or not hasattr(_cx, "join_rows"):
+        return None
+    li = np.ascontiguousarray(l_idx, dtype=np.int64)
+    ri = np.ascontiguousarray(r_idx, dtype=np.int64)
+    try:
+        return _cx.join_rows(lrows, rrows, li, ri, right_width)
+    except (_cx.Unsupported, TypeError):
+        return None
+
+
 def scan_rows(snapshot, table_id: int, columns, ranges, defaults):
     """Native equivalent of columnar._scan_rows: returns
     (handles list/array, raw dict, valid dict) or None to fall back."""

@@ -28,6 +28,14 @@ from tidb_tpu.types import Datum
 
 _conn_id_gen = itertools.count(1)
 _global_vars_by_store: dict[str, GlobalVars] = {}
+
+
+def store_global_var(store, name: str) -> str | None:
+    """Hydrated global sysvar value for a store, or None before the
+    first session binds it (the supported read for non-session callers
+    like TpuClient.__init__)."""
+    gv = _global_vars_by_store.get(store.uuid())
+    return gv.get(name) if gv is not None else None
 _bootstrap_lock = threading.Lock()
 
 
@@ -548,6 +556,8 @@ class Session:
                         floor = max(0, int(sval.strip()))
                     except ValueError:
                         pass
+                # (device_join resolves itself in TpuClient.__init__
+                # from this store's hydrated global-var cache)
                 self.store.set_client(
                     TpuClient(self.store, dispatch_floor_rows=floor))
         elif backend == "cpu":
@@ -588,6 +598,29 @@ class Session:
         client = self.store.get_client()
         if isinstance(client, TpuClient):
             client.dispatch_floor_rows = floor
+
+    def apply_tpu_device_join(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_device_join = 0|1 — the executor-join
+        device-routing kill switch. Lives on the store-level client like
+        the dispatch floor (every session's joins re-route)."""
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        if value.strip().lower() not in ("0", "1", "on", "off", "true",
+                                         "false"):
+            raise errors.ExecError(
+                f"tidb_tpu_device_join must be 0 or 1, got {value!r}")
+        enabled = parse_bool_sysvar(value)
+        if self.vars.user:
+            from tidb_tpu import privilege
+            if not privilege.checker_for(self.store).check(
+                    self.vars.user, "", "", "Grant",
+                    host=self.vars.client_host):
+                raise privilege.AccessDenied(
+                    f"user '{self.vars.user}' needs the global GRANT "
+                    "privilege to set tidb_tpu_device_join")
+        from tidb_tpu.ops import TpuClient
+        client = self.store.get_client()
+        if isinstance(client, TpuClient):
+            client.device_join = enabled
 
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
@@ -754,6 +787,25 @@ def bootstrap(session: Session) -> None:
             if gv.values.get("tidb_copr_backend", "").strip().lower() \
                     == "tpu":
                 session.apply_copr_backend("tpu")
+            else:
+                # a TpuClient installed BEFORE the first session
+                # (store.set_client embed pattern) must also pick up the
+                # persisted routing knobs, not their defaults
+                import sys as _sys
+                mod = _sys.modules.get("tidb_tpu.ops.client")
+                client = session.store.get_client()
+                if mod is not None and isinstance(client, mod.TpuClient):
+                    from tidb_tpu.sessionctx import parse_bool_sysvar
+                    dj = gv.values.get("tidb_tpu_device_join")
+                    if dj is not None:
+                        client.device_join = parse_bool_sysvar(dj)
+                    fl = gv.values.get("tidb_tpu_dispatch_floor")
+                    try:
+                        if fl is not None:
+                            client.dispatch_floor_rows = max(0,
+                                                             int(fl.strip()))
+                    except ValueError:
+                        pass
             return
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,

@@ -48,11 +48,24 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # this). Default tracks the measured CPU/device crossover on the
     # bench rig (~16k rows after the native row decoder sped the CPU
     # engine ~3x; bench.py measure_crossover re-measures every run).
+    # The SAME floor routes executor-layer hash joins: at/above it a
+    # single-int/float-key join runs the device build/probe kernels
+    # (executors.HashJoinExec), below it the host numpy sort-merge.
     "tidb_tpu_dispatch_floor": "16384",
+    # device join kill switch: 0 pins executor joins to the host numpy
+    # path while scans/aggregates keep routing to the device
+    "tidb_tpu_device_join": "1",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
     "tidb_copr_batch_rows": "1048576",
 }
+
+
+def parse_bool_sysvar(value: str) -> bool:
+    """MySQL-style boolean sysvar parse ('1'/'on'/'true' → True) — the
+    single parser for every consumer of a boolean global (client init,
+    SET handling, bootstrap hydration must never drift apart)."""
+    return value.strip().lower() in ("1", "on", "true")
 
 
 class SessionVars:
