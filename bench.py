@@ -465,12 +465,25 @@ JOIN_AGG_SQL = ("select count(*), sum(l_extendedprice), avg(l_quantity), "
                 "join dim on l_orderkey = d_k")
 
 
-def measure_join_agg(store, n_dim: int, runs: int):
-    """Join→aggregate e2e through the full SQL stack: with the device
-    join the aggregate fuses over the joined COLUMN PLANES — the joined
-    rows are never materialized (executor.fused_agg). Re-runs the same
-    query with the device join disabled (row-loop oracle) and checks
-    result parity. Returns (seconds/run, fused?, parity_rows)."""
+def measure_join_e2e(store, n_probe: int, n_dim: int, runs: int,
+                     floor=None):
+    """scan→join→agg e2e through the full SQL stack, three regimes of the
+    same query:
+
+      columnar — coprocessor answers scans with COLUMN PLANES
+                 (SelectResponse.columnar), the device join builds and
+                 probes straight off them, and the aggregate fuses over
+                 the gathered planes: from KV decode to aggregate
+                 emission no row is ever materialized. Asserts
+                 distsql.columnar_fallbacks == 0 over the timed window.
+      row path — tidb_tpu_columnar_scan off: the PR-1 regime (scan rows
+                 chunk-encoded, decoded, key planes re-extracted per
+                 row), the speedup denominator.
+      oracle   — device join off too: numpy join + per-row aggregate
+                 loop, the parity check.
+
+    Returns the bench-JSON figure dict."""
+    from tidb_tpu import metrics
     from tidb_tpu.executor import fused_agg
     from tidb_tpu.ops import TpuClient
     from tidb_tpu.session import Session
@@ -488,32 +501,55 @@ def measure_join_agg(store, n_dim: int, runs: int):
             s.execute(f"insert into dim values {vals}")
 
     old_client = store.get_client()
-    client = TpuClient(store)
+    client = TpuClient(store, dispatch_floor_rows=floor)
     store.set_client(client)
+    hits = metrics.counter("distsql.columnar_hits")
+    fbs = metrics.counter("distsql.columnar_fallbacks")
     try:
         sess = Session(store)
         sess.execute("use tpch")
         before = fused_agg.stats["fused"]
         sess.execute(JOIN_AGG_SQL)        # warm (pack + compile)
+        h0, f0 = hits.value, fbs.value
         t0 = time.time()
         results = []
         for _ in range(runs):
             results.append(sess.execute(JOIN_AGG_SQL)[0].values())
-        dt = (time.time() - t0) / runs
+        t_col = (time.time() - t0) / runs
+        d_hits, d_fbs = hits.value - h0, fbs.value - f0
         fused = fused_agg.stats["fused"] > before
-        # oracle: same SQL with the device join off (numpy join + the
-        # per-row aggregate loop)
+        scan_columnar = d_hits > 0 and d_fbs == 0
+
+        # PR-1 row-materializing path: columnar channel off, device join on
+        client.columnar_scan = False
+        sess.execute(JOIN_AGG_SQL)        # warm the row regime
+        t0 = time.time()
+        for _ in range(runs):
+            row_results = sess.execute(JOIN_AGG_SQL)[0].values()
+        t_row = (time.time() - t0) / runs
+
+        # oracle: device join off too (numpy join + row-loop aggregate)
         client.device_join = False
         oracle = sess.execute(JOIN_AGG_SQL)[0].values()
-        assert len(results[0]) == len(oracle), \
-            f"join_agg parity: {len(results[0])} rows vs {len(oracle)}"
-        for got, want in zip(results[0], oracle):
-            assert len(got) == len(want), \
-                f"join_agg parity: {len(got)} cols vs {len(want)}"
-            for a, b in zip(got, want):
-                assert _close(float(a), float(b)), \
-                    f"join_agg parity: {a} != {b}"
-        return dt, fused, len(results[0])
+        for name, got_rows in (("columnar", results[0]),
+                               ("rowpath", row_results)):
+            assert len(got_rows) == len(oracle), \
+                f"join_e2e {name} parity: {len(got_rows)} vs {len(oracle)}"
+            for got, want in zip(got_rows, oracle):
+                assert len(got) == len(want), \
+                    f"join_e2e {name} parity: {len(got)} vs {len(want)} cols"
+                for a, b in zip(got, want):
+                    assert _close(float(a), float(b)), \
+                        f"join_e2e {name} parity: {a} != {b}"
+        return {
+            "join_agg_s": round(t_col, 4),
+            "join_agg_fused": fused,
+            "join_e2e_rows_per_sec": round(n_probe / t_col, 1),
+            "join_e2e_speedup_vs_rowpath": round(t_row / t_col, 2),
+            "scan_columnar": scan_columnar,
+            "columnar_hits": d_hits,
+            "columnar_fallbacks": d_fbs,
+        }
     finally:
         store.set_client(old_client)
 
@@ -721,13 +757,20 @@ def main(smoke: bool = False):
           f"{join_figs['join_numpy_rows_per_sec']:,.0f} rows/s",
           file=sys.stderr)
 
+    # scan→join→agg e2e: in smoke the dim side sits below the default
+    # dispatch floor, so the floor is disabled there (same code paths,
+    # tiny sizes — the point of smoke); the full run uses the default
     n_dim = 4_000 if smoke else 100_000
-    join_agg_s, join_agg_fused, _ = measure_join_agg(base_store, n_dim,
-                                                     runs=1)
-    print(f"# join_agg e2e ({n_base / 1e6:.2f}M join {n_dim / 1000:.0f}k "
-          f"→ fused agg): {join_agg_s:.3f}s/run, fused="
-          f"{join_agg_fused} (no joined-row materialization)",
-          file=sys.stderr)
+    e2e_figs = measure_join_e2e(base_store, n_base, n_dim, runs=1,
+                                floor=0 if smoke else None)
+    print(f"# join_e2e ({n_base / 1e6:.2f}M join {n_dim / 1000:.0f}k "
+          f"scan→join→agg): "
+          f"{e2e_figs['join_e2e_rows_per_sec']:,.0f} probe rows/s "
+          f"columnar ({e2e_figs['join_e2e_speedup_vs_rowpath']:.2f}x the "
+          f"row-materializing path), fused={e2e_figs['join_agg_fused']}, "
+          f"scan_columnar={e2e_figs['scan_columnar']} "
+          f"(hits {e2e_figs['columnar_hits']}, fallbacks "
+          f"{e2e_figs['columnar_fallbacks']})", file=sys.stderr)
 
     geo_rps = math.exp(sum(math.log(x) for x in tpu_rps_all)
                        / len(tpu_rps_all))
@@ -752,8 +795,7 @@ def main(smoke: bool = False):
         "routing_crossover_rows": crossover_rows,
         "small_query_ms": round(small_ms, 2),
         **join_figs,
-        "join_agg_s": round(join_agg_s, 4),
-        "join_agg_fused": join_agg_fused,
+        **e2e_figs,
         "smoke": smoke,
         # the honest CPU comparison: a vectorized-numpy engine over the
         # same packed planes (the Python xeval baseline above understates

@@ -38,3 +38,9 @@ def test_bench_smoke_emits_valid_json():
     assert out["join_agg_fused"] is True, \
         "join→agg e2e did not take the fused (no-materialization) path"
     assert out["join_agg_s"] > 0
+    # the scan→join→agg pipeline must stay columnar end to end: planes
+    # served for every scan, zero row-protocol fallbacks on the timed run
+    assert out["scan_columnar"] is True, \
+        "scan→join→agg e2e decoded rows (columnar_fallbacks > 0 or no hits)"
+    assert out["join_e2e_rows_per_sec"] > 0
+    assert out["columnar_fallbacks"] == 0

@@ -1,0 +1,282 @@
+"""Differential columnar-scan parity: a scan answered with COLUMN PLANES
+(SelectResponse.columnar) must be INVISIBLE next to the row protocol —
+row-for-row identical results, values and order, for scan→join,
+scan→agg and scan→topn, including NULL planes, mixed-kind bail-outs,
+the below-floor row fallback, and the tidb_tpu_columnar_scan kill
+switch. The distsql.columnar_hits / columnar_fallbacks counters prove
+which channel actually answered.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+from tidb_tpu import metrics
+from tests.testkit import TestKit
+
+_store_id = itertools.count(1)
+
+
+def _tpu_tk(floor: int = 0) -> TestKit:
+    from tidb_tpu.ops import TpuClient
+    from tidb_tpu.session import new_store
+    store = new_store(f"memory://colscan{next(_store_id)}")
+    store.set_client(TpuClient(store, dispatch_floor_rows=floor))
+    return TestKit(store)
+
+
+def _seed(tk: TestKit) -> None:
+    tk.exec("create table l (id bigint primary key, k int, v double, "
+            "s varchar(8), t datetime)")
+    tk.exec("create table r (id bigint primary key, k int, w int, "
+            "f double)")
+    tk.exec("insert into l values "
+            "(1, 1, 1.5, 'ant', '2020-01-01 00:00:00'), "
+            "(2, 2, null, 'bee', null), "
+            "(3, null, 3.5, null, '2021-05-05 12:00:00'), "
+            "(4, 2, 4.5, 'cat', '2020-01-01 00:00:00'), "
+            "(5, 9, 5.5, 'dog', '1999-12-31 23:59:59'), "
+            "(6, 2, 2.5, 'eel', null)")
+    tk.exec("insert into r values (10, 2, 20, 4.5), (11, 2, 21, 1.5), "
+            "(12, 1, 22, null), (13, null, 23, 2.5), (14, 2, 24, 4.5)")
+
+
+def _hits():
+    return metrics.counter("distsql.columnar_hits").value
+
+
+def _fallbacks():
+    return metrics.counter("distsql.columnar_fallbacks").value
+
+
+JOIN_QUERIES = [
+    # inner / outer, NULL keys on both sides, strings + datetimes in the
+    # output (post-join row materialization straight from the planes)
+    "select l.id, r.id, l.s, l.t from l join r on l.k = r.k",
+    "select l.id, r.id, l.s from l left join r on l.k = r.k",
+    # float keys + residual other_conditions above the device pairs
+    "select l.id, r.id from l join r on l.v = r.f and r.w < 24",
+    "select l.id, r.w from l left join r on l.v = r.f and r.w < 24",
+    # filter above the join (row pull through DeviceJoinResult.iter_rows)
+    "select l.id, r.id from l left join r on l.k = r.k where l.id > 1",
+]
+
+AGG_QUERIES = [
+    "select count(*), sum(r.w), avg(l.v), min(r.w), max(l.v) "
+    "from l join r on l.k = r.k",
+    "select l.k, count(*), sum(r.w), min(l.v) from l join r "
+    "on l.k = r.k group by l.k",
+    "select l.s, count(r.w), sum(l.v) from l left join r "
+    "on l.k = r.k group by l.s",
+]
+
+TOPN_QUERIES = [
+    "select id, v from l order by v desc limit 3",
+    "select id, v, s from l order by v limit 2",
+    # projection between TopN and scan: stays on the row path (parity
+    # only, no columnar hit expected)
+    "select id, s from l order by v limit 2",
+]
+
+
+class TestColumnarScanParity:
+    @pytest.fixture()
+    def tk(self):
+        tk = _tpu_tk(floor=0)
+        tk.exec("create database cs; use cs")
+        _seed(tk)
+        return tk
+
+    def _run_both(self, tk, queries):
+        """(columnar rows, row-protocol rows, columnar hit delta)."""
+        h0 = _hits()
+        columnar = [tk.query(q).rows for q in queries]
+        d_hits = _hits() - h0
+        tk.exec("set global tidb_tpu_columnar_scan = 0")
+        try:
+            rows = [tk.query(q).rows for q in queries]
+        finally:
+            tk.exec("set global tidb_tpu_columnar_scan = 1")
+        return columnar, rows, d_hits
+
+    def test_scan_join_row_for_row(self, tk):
+        columnar, rows, d_hits = self._run_both(tk, JOIN_QUERIES)
+        for q, c, r in zip(JOIN_QUERIES, columnar, rows):
+            assert c == r, f"columnar vs row path diverged on {q!r}"
+        assert d_hits >= 2 * len(JOIN_QUERIES), \
+            "join scans did not take the columnar channel"
+
+    def test_scan_join_agg_row_for_row(self, tk):
+        from tidb_tpu.executor import fused_agg
+        f0 = fused_agg.stats["fused"]
+        columnar, rows, d_hits = self._run_both(tk, AGG_QUERIES)
+        assert fused_agg.stats["fused"] > f0, \
+            "join→agg over columnar scans never fused"
+        for q, c, r in zip(AGG_QUERIES, columnar, rows):
+            assert c == r, f"columnar vs row path diverged on {q!r}"
+        assert d_hits > 0
+
+    def test_scan_topn_row_for_row(self, tk):
+        columnar, rows, d_hits = self._run_both(tk, TOPN_QUERIES)
+        for q, c, r in zip(TOPN_QUERIES, columnar, rows):
+            assert c == r, f"columnar vs row path diverged on {q!r}"
+        assert d_hits >= 2, "topn scans did not take the columnar channel"
+
+    def test_scan_agg_unpushed_fuses_over_planes(self, tk):
+        """An aggregate the capability probe keeps SQL-side (COMPLETE
+        HashAgg over a bare scan) fuses directly over the scan's planes
+        — identical to the row loop over decoded rows."""
+        from tidb_tpu.copr.proto import AGG_TYPES, Expr
+        from tidb_tpu.executor import fused_agg
+        from tidb_tpu.kv import kv
+        client = tk.store.get_client()
+        orig = client.support_request_type
+
+        def refuse_aggs(req_type, sub_type):
+            if isinstance(sub_type, Expr) and sub_type.tp in AGG_TYPES:
+                return False
+            if sub_type == kv.REQ_SUB_TYPE_GROUP_BY:
+                return False
+            return orig(req_type, sub_type)
+
+        client.support_request_type = refuse_aggs
+        q = ("select k, count(*), sum(v), min(v), max(v), count(s) "
+             "from l group by k")
+        try:
+            f0 = fused_agg.stats["fused"]
+            fused = tk.query(q).rows
+            assert fused_agg.stats["fused"] > f0, \
+                "scan→agg never fused over the scan planes"
+            tk.exec("set global tidb_tpu_columnar_scan = 0")
+            try:
+                assert tk.query(q).rows == fused
+            finally:
+                tk.exec("set global tidb_tpu_columnar_scan = 1")
+        finally:
+            client.support_request_type = orig
+
+    def test_mixed_kind_key_bails_with_parity(self, tk):
+        """Keys whose post-unflatten kind has no plane mapping (datetime)
+        or that mix kinds (derived int/float union) must leave the
+        vector paths — and the columnar side's rows, materialized from
+        its planes, must equal the row protocol's exactly."""
+        queries = [
+            # datetime key: plane gate returns None on both paths
+            "select l.id, r2.id from l join l r2 on l.t = r2.t",
+            # derived side mixes int/float; scan side stays columnar
+            "select x.k, r.id from (select 1 as k union all "
+            "select 4.5e0 as k) x join r on x.k = r.f",
+        ]
+        columnar, rows, _ = self._run_both(tk, queries)
+        for q, c, r in zip(queries, columnar, rows):
+            assert c == r, f"bail-out diverged on {q!r}"
+        assert len(columnar[0]) > 0 and len(columnar[1]) > 0
+
+    def test_decimal_and_unsigned_columns(self):
+        """Planes with no row-path mapping (decimal, unsigned bigint)
+        must bail the SAME way on both channels: fused aggregates drop
+        to the row loop, u64 join keys to the dict path — and every
+        materialized datum (Decimal scale, u64 range) matches."""
+        tk = _tpu_tk(floor=0)
+        tk.exec("create database cdu; use cdu")
+        tk.exec("create table a (id bigint primary key, k int, "
+                "d decimal(10,2), u bigint unsigned)")
+        tk.exec("create table b (id bigint primary key, k int)")
+        tk.exec("insert into a values (1, 1, 12.50, 5), (2, 2, null, 11), "
+                "(3, 2, 0.01, 0)")
+        tk.exec("insert into b values (10, 2), (11, 1)")
+        queries = [
+            "select a.id, b.id, a.d, a.u from a join b on a.k = b.k",
+            "select sum(a.d), max(a.u), count(*) from a join b "
+            "on a.k = b.k",
+            "select a.d, count(*) from a join b on a.k = b.k "
+            "group by a.d",
+            "select a.u, b.id from a join b on a.u = b.id",
+        ]
+        columnar = [tk.query(q).rows for q in queries]
+        tk.exec("set global tidb_tpu_columnar_scan = 0")
+        rows = [tk.query(q).rows for q in queries]
+        tk.exec("set global tidb_tpu_device_join = 0")
+        oracle = [tk.query(q).rows for q in queries]
+        for q, c, r, o in zip(queries, columnar, rows, oracle):
+            assert c == r == o, f"decimal/unsigned diverged on {q!r}"
+        assert len(columnar[0]) == 3
+
+    def test_below_floor_falls_back_to_rows(self):
+        """Scans under the dispatch floor answer on the CPU engine —
+        the hinted request counts a columnar fallback and every result
+        still matches."""
+        tk = _tpu_tk(floor=10_000)
+        tk.exec("create database csf; use csf")
+        _seed(tk)
+        f0, h0 = _fallbacks(), _hits()
+        q = "select l.id, r.id from l join r on l.k = r.k"
+        rows = tk.query(q).rows
+        assert _fallbacks() > f0, "below-floor scan did not count a fallback"
+        assert _hits() == h0
+        tk2 = _tpu_tk(floor=0)
+        tk2.exec("create database csf2; use csf2")
+        _seed(tk2)
+        assert tk2.query(q).rows == rows
+
+
+class TestColumnarScanKillSwitch:
+    def test_kill_switch_counts_fallbacks_and_matches(self):
+        tk = _tpu_tk(floor=0)
+        tk.exec("create database ck; use ck")
+        _seed(tk)
+        q = "select l.id, r.id, l.s from l left join r on l.k = r.k"
+        on_rows = tk.query(q).rows
+        tk.exec("set global tidb_tpu_columnar_scan = 0")
+        f0 = _fallbacks()
+        assert tk.query(q).rows == on_rows
+        assert _fallbacks() > f0, \
+            "kill switch off-path did not count columnar fallbacks"
+        tk.exec("set global tidb_tpu_columnar_scan = 1")
+        h0 = _hits()
+        assert tk.query(q).rows == on_rows
+        assert _hits() > h0
+
+    def test_global_only(self):
+        tk = _tpu_tk(floor=0)
+        with pytest.raises(Exception, match="GLOBAL"):
+            tk.exec("set tidb_tpu_columnar_scan = 0")
+
+    def test_survives_new_client(self):
+        """A freshly constructed TpuClient must resolve the persisted
+        tidb_tpu_columnar_scan global, not revert to the default."""
+        from tidb_tpu.ops import TpuClient
+        tk = _tpu_tk(floor=0)
+        tk.exec("set global tidb_tpu_columnar_scan = 0")
+        assert tk.store.get_client().columnar_scan is False
+        assert TpuClient(tk.store).columnar_scan is False
+        tk.exec("set global tidb_tpu_columnar_scan = 1")
+        assert TpuClient(tk.store).columnar_scan is True
+
+
+class TestColumnarObservability:
+    def test_slow_log_carries_columnar_counters(self):
+        import logging
+        tk = _tpu_tk(floor=0)
+        tk.exec("create database co; use co")
+        _seed(tk)
+        tk.exec("set global tidb_slow_log_threshold = 0.000001")
+        records: list[str] = []
+
+        class _H(logging.Handler):
+            def emit(self, rec):
+                records.append(rec.getMessage())
+
+        h = _H()
+        logging.getLogger("tidb_tpu.slowlog").addHandler(h)
+        try:
+            tk.query("select count(*), sum(r.w) from l join r "
+                     "on l.k = r.k")
+            assert any("[SLOW_QUERY]" in m and "columnar_hits:2" in m
+                       and "columnar_fallbacks:0" in m for m in records), \
+                records
+        finally:
+            logging.getLogger("tidb_tpu.slowlog").removeHandler(h)
+            tk.exec("set global tidb_slow_log_threshold = 300")

@@ -168,6 +168,12 @@ class SelectRequest:
     # as netWorkFactor/cpuFactor in the reference's calculateCost
     # (plan/physical_plans.go:70-84), applied at the engine boundary.
     est_rows: float | None = None
+    # TPU-tier extension: the consumer understands column planes — a
+    # capable responder may answer with SelectResponse.columnar (the
+    # scan's ColumnBatch + selection index) instead of chunk rows, the
+    # "return-format-aware pushdown" of arXiv:2312.15405. Responders that
+    # don't (CPU engine, below-floor routes) ignore it and send rows.
+    columnar_hint: bool = False
 
     def is_agg(self) -> bool:
         return bool(self.aggregates) or bool(self.group_by)
@@ -191,8 +197,11 @@ class Chunk:
 class SelectResponse:
     chunks: list[Chunk] = field(default_factory=list)
     error: str | None = None
-    # columnar fast path (TPU engine): decoded result columns, bypassing
-    # row-chunk encode/decode when both ends are in-proc. None → use chunks.
+    # columnar fast path (TPU engine, requests with columnar_hint): the
+    # scan's planes + selection index (ops.columnar.ColumnarScanResult),
+    # bypassing row-chunk encode/decode entirely — plane-aware consumers
+    # (device join, fused aggregates, TopN) read columns straight off it.
+    # None → use chunks.
     columnar: object | None = None
     # in-proc row fast path (CPU engine scans): (handle, datums) pairs in
     # scan order, skipping the per-row encode_value/decode_all round trip
@@ -201,6 +210,8 @@ class SelectResponse:
     raw: list | None = None
 
     def row_count(self) -> int:
+        if self.columnar is not None:
+            return len(self.columnar)
         if self.raw is not None:
             return len(self.raw)
         return sum(len(c.rows_meta) for c in self.chunks)
@@ -242,7 +253,12 @@ class ChunkWriter:
 def iter_response_rows(resp: SelectResponse):
     """Yield (handle, datums) decoded from chunks — partialResult.Next's
     chunk-wise decode (distsql/distsql.go:192,253). In-proc responses
-    carry the rows directly (SelectResponse.raw) and skip the codec."""
+    carry the rows directly (SelectResponse.raw) and skip the codec;
+    columnar responses materialize the same flattened datums from their
+    planes (the safety net for a consumer that iterates rows anyway)."""
+    if resp.columnar is not None:
+        yield from resp.columnar.iter_raw_with_handles()
+        return
     if resp.raw is not None:
         yield from resp.raw
         return

@@ -504,7 +504,15 @@ class DDL:
                     m.bump_schema_version()
                 return True
 
-            progressed = run_in_new_txn(self.store, True, step)
+            # a state transition must win EVENTUALLY: a reorg batch
+            # conflicts with every concurrent write txn, so it gets the
+            # reference's ~100-attempt meta-txn budget — giving up after
+            # the default 10 would strand the job mid-flight with earlier
+            # states already public (a re-issued ADD INDEX then fails on
+            # its own partial work: "Duplicate key name"). Ordinary txns
+            # it conflicts with always make progress, so this converges.
+            progressed = run_in_new_txn(self.store, True, step,
+                                        max_retries=100)
             if not progressed:
                 return None
             # every version bump is visible to other servers here; with a

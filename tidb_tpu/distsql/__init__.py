@@ -8,6 +8,8 @@ decoding codec-encoded chunk rows back into typed Datums
 
 from __future__ import annotations
 
+import threading
+
 from tidb_tpu import errors
 from tidb_tpu.copr.proto import SelectRequest, SelectResponse, iter_response_rows
 from tidb_tpu.kv import kv
@@ -15,21 +17,74 @@ from tidb_tpu.types import Datum
 from tidb_tpu.types.convert import unflatten_datum
 from tidb_tpu.types.field_type import FieldType
 
+# monotonic per-THREAD columnar counts: connections execute statements on
+# their own threads, so deltas of these attribute hits/fallbacks to the
+# right statement in the slow-query log (the process-global metrics
+# counters stay authoritative for SHOW STATUS / bench)
+_thread_columnar = threading.local()
+
+
+def thread_columnar_counts() -> tuple[int, int]:
+    """(hits, fallbacks) tallied on this thread so far — snapshot before
+    a statement and diff after."""
+    return (getattr(_thread_columnar, "hits", 0),
+            getattr(_thread_columnar, "fallbacks", 0))
+
 
 class SelectResult:
-    """Iterates (handle, typed row) across all regions of one request."""
+    """Iterates (handle, typed row) across all regions of one request.
 
-    def __init__(self, resp: kv.Response, field_types: list[FieldType]):
+    Plane-aware consumers ask columnar() FIRST: a single-partial response
+    carrying a columnar payload (TpuClient answering a columnar_hint
+    request) hands the scan's planes over without any row ever being
+    encoded or decoded; everything else falls back to the row iterator.
+    """
+
+    def __init__(self, resp: kv.Response, field_types: list[FieldType],
+                 columnar_hinted: bool = False):
         self._resp = resp
         self._types = field_types
         self._rows = iter(())
         self._done = False
+        self._hinted = columnar_hinted
+        self._decode_info = None
 
     def __iter__(self):
         return self
 
     def close(self) -> None:
         self._resp.close()
+
+    def columnar(self):
+        """The response's columnar plane payload (ops.columnar.
+        ColumnarScanResult), or None — rows then flow through the
+        iterator as usual. Counts distsql.columnar_hits /
+        distsql.columnar_fallbacks (a fallback is a hinted request the
+        responder answered with rows: CPU engine, below-floor route,
+        kill switch)."""
+        from tidb_tpu import metrics
+        if not self._done:
+            part = self._resp.next()
+            if part is None:
+                self._done = True
+            elif part.error:
+                raise errors.ExecError(f"coprocessor error: {part.error}")
+            else:
+                payload = getattr(part, "columnar", None)
+                if payload is not None:
+                    # single-partial contract: the TPU engine answers one
+                    # response per request, and only it emits payloads
+                    self._done = True
+                    metrics.counter("distsql.columnar_hits").inc()
+                    _thread_columnar.hits = getattr(
+                        _thread_columnar, "hits", 0) + 1
+                    return payload
+                self._rows = iter_response_rows(part)
+        if self._hinted:
+            metrics.counter("distsql.columnar_fallbacks").inc()
+            _thread_columnar.fallbacks = getattr(
+                _thread_columnar, "fallbacks", 0) + 1
+        return None
 
     def __next__(self):
         while True:
@@ -50,7 +105,15 @@ class SelectResult:
             raise errors.ExecError(
                 f"coprocessor row has {len(datums)} columns, "
                 f"schema wants {len(self._types)}")
-        return [unflatten_datum(d, ft) for d, ft in zip(datums, self._types)]
+        info = self._decode_info
+        if info is None:
+            from tidb_tpu.types.convert import unflatten_identity_kinds
+            info = self._decode_info = [
+                (ft, unflatten_identity_kinds(ft)) for ft in self._types]
+        # identity fast path: most cells arrive already in their column's
+        # final kind — skip the per-cell unflatten call for those
+        return [d if d.kind in idk else unflatten_datum(d, ft)
+                for d, (ft, idk) in zip(datums, info)]
 
     def partials(self):
         """Yield one region's SelectResponse per call (for partial-aware
@@ -84,4 +147,5 @@ def select(client: kv.Client, req: SelectRequest,
         raise
     metrics.histogram("distsql.send_seconds").observe(
         _time.perf_counter() - t0)
-    return SelectResult(resp, field_types)
+    return SelectResult(resp, field_types,
+                        columnar_hinted=getattr(req, "columnar_hint", False))

@@ -119,13 +119,25 @@ class MemTableExec(Executor):
 
 
 class XSelectTableExec(Executor):
-    """Reference: executor/executor_distsql.go:733."""
+    """Reference: executor/executor_distsql.go:733.
+
+    Plane-aware parents (device join, fused aggregates, TopN) call
+    columnar_result() before any next(): the request then advertises
+    columnar_hint and, when the TPU engine answers with the scan's
+    planes, consumers read columns without a single row being encoded,
+    decoded, or re-extracted. next() still serves rows either way —
+    a consumer that bails materializes them from the same planes."""
 
     def __init__(self, scan: PhysicalTableScan, ctx):
         self.scan_plan = scan
         self.schema = scan.schema
         self.ctx = ctx
         self._result = None
+        self._sel_result = None
+        self._columnar = None
+        self._columnar_tried = False
+        self._columnar_hint = False
+        self._row_iter = None
 
     def _do_request(self):
         scan = self.scan_plan
@@ -139,20 +151,46 @@ class XSelectTableExec(Executor):
             limit=scan.limit,
             desc=scan.desc,
             est_rows=scan.est_rows,
+            columnar_hint=self._columnar_hint,
         )
         if scan.aggregated_push_down:
             types = scan.agg_fields
         else:
             types = [c.ret_type for c in scan.schema]
         ranges = table_ranges_to_kv_ranges(scan.table_info.id, scan.ranges)
-        self._result = iter(select(
+        self._sel_result = select(
             self.ctx.client, req, ranges, types,
             concurrency=self.ctx.distsql_concurrency(),
-            keep_order=scan.keep_order))
+            keep_order=scan.keep_order)
+        self._result = iter(self._sel_result)
+
+    def columnar_result(self):
+        """The scan's columnar payload (ops.columnar.ColumnarScanResult),
+        or None when the responder sent rows (CPU engine, below-floor
+        route, kill switch) — the caller then drains rows as usual."""
+        if self._columnar_tried:
+            return self._columnar
+        self._columnar_tried = True
+        if self._result is not None:
+            return None     # rows already flowing through next()
+        if self.scan_plan.aggregated_push_down:
+            return None     # partial-row protocol carries no planes
+        self._columnar_hint = True
+        self._do_request()
+        self._columnar = self._sel_result.columnar()
+        return self._columnar
 
     def next(self):
         if self._result is None:
             self._do_request()
+        if self._columnar is not None:
+            if self._row_iter is None:
+                self._row_iter = self._columnar.iter_rows_with_handles()
+            nxt = next(self._row_iter, None)
+            if nxt is None:
+                return None
+            self.last_handle, row = nxt
+            return row
         try:
             handle, row = next(self._result)
         except StopIteration:
@@ -163,8 +201,8 @@ class XSelectTableExec(Executor):
     def close(self) -> None:
         # abandon pipelined region workers when the consumer stopped early
         # (LIMIT above a scan) — they must not stay parked on the window
-        if self._result is not None:
-            self._result.close()
+        if self._sel_result is not None:
+            self._sel_result.close()
         super().close()
 
 

@@ -184,6 +184,12 @@ class TopNExec(Executor):
 
     def _materialize(self):
         child = self.children[0]
+        get_columnar = getattr(child, "columnar_result", None)
+        if get_columnar is not None:
+            # plane-aware drain: a columnar scan serves the rows below
+            # straight from its planes (no chunk decode; with pushed
+            # TopN the coprocessor already bounded them to ~limit)
+            get_columnar()
         limit = self.offset + self.count
         key_of = _cmp_rows(self.by_items)
         buf = []
@@ -268,11 +274,13 @@ class HashAggExec(Executor):
     def _materialize(self):
         child = self.children[0]
         if not self.pushed_child and \
-                hasattr(child, "device_join_result"):
-            # join→agg fusion: aggregate directly over the device join's
-            # gathered column planes — no joined-row materialization
-            from tidb_tpu.executor.fused_agg import try_fused_join_agg
-            fused = try_fused_join_agg(self)
+                (hasattr(child, "device_join_result")
+                 or hasattr(child, "columnar_result")):
+            # columnar fusion: aggregate directly over the device join's
+            # gathered column planes — or a columnar scan's planes when
+            # the aggregate stayed SQL-side — no row materialization
+            from tidb_tpu.executor.fused_agg import try_fused_agg
+            fused = try_fused_agg(self)
             if fused is not None:
                 self._fused = fused
                 self._groups, self._order = {}, []
@@ -395,6 +403,10 @@ class HashJoinExec(Executor):
       consumes gathered planes directly (join→agg fusion) and only
       row-pulling consumers pay materialization — which is one native
       batch call (codecx.join_rows), not a per-row Python generator.
+      Bare scan children drain COLUMNAR (XSelectTableExec.
+      columnar_result): the coprocessor hands over the scan's planes and
+      the join keys come straight off them — from KV decode to aggregate
+      emission no row is materialized, decoded, or re-extracted.
     * vectorized sort-merge (numpy) for the same join shapes below the
       floor — the data-parallel answer to the reference's
       JoinConcurrency worker pool (executor/executor.go:442,568-640).
@@ -451,16 +463,24 @@ class HashJoinExec(Executor):
     # array would (more correctly, but differently) match them
     _VEC_KINDS = (Kind.INT64, Kind.FLOAT64)
 
-    def _key_array(self, rows, col):
+    def _side_key(self, side, col):
         """(values f64/i64 ndarray, valid bool ndarray) for one key column
-        across rows; None when a kind outside the fast set appears
-        (strings route to the dict path: their codec-key collation
-        semantics live there)."""
-        from tidb_tpu.ops.columnar import rows_plane
-        kind, vals, valid = rows_plane(rows, col.index)
+        across a join side (drained rows or a columnar scan payload);
+        None when a kind outside the fast set appears (strings route to
+        the dict path: their codec-key collation semantics live there)."""
+        kind, vals, valid = side.column_plane(col.index)
         if kind not in ("i64", "f64"):
             return None, None
         return vals, valid
+
+    def _columnar_scan_side(self, child, side_conds):
+        """The child scan's columnar payload as a join side, or None —
+        the row drain then decides. Join-level side filters evaluate on
+        rows, so their presence keeps the row path."""
+        if side_conds:
+            return None
+        get = getattr(child, "columnar_result", None)
+        return get() if get is not None else None
 
     def _device_join_floor(self) -> int | None:
         """Row floor above which the join routes to the device kernels,
@@ -500,22 +520,34 @@ class HashJoinExec(Executor):
         if lcol.ret_type.is_ci_collation() or \
                 rcol.ret_type.is_ci_collation():
             return False
-        rrows = self.children[1].drain()
+        from tidb_tpu.ops.columnar import RowsSide
         self._right_width = len(self.children[1].schema)
-        if plan.right_conditions:
-            rrows = [r for r in rrows
-                     if _conds_ok(plan.right_conditions, r)]
-        rkey, rvalid = self._key_array(rrows, rcol)
+        # plane-aware drains: a bare scan child answers with its column
+        # planes (no row decode); anything else drains rows as before
+        rside = self._columnar_scan_side(self.children[1],
+                                         plan.right_conditions)
+        if rside is None:
+            rrows = self.children[1].drain()
+            if plan.right_conditions:
+                rrows = [r for r in rrows
+                         if _conds_ok(plan.right_conditions, r)]
+            rside = RowsSide(rrows)
+        rkey, rvalid = self._side_key(rside, rcol)
         if rkey is None:
-            self._prebuilt_right = rrows   # reuse the drain for the slow path
+            # reuse the drain for the slow path (columnar sides
+            # materialize their rows from the planes)
+            self._prebuilt_right = rside.rows()
             return False
-        lrows = self.children[0].drain()
-        lkey, lvalid = self._key_array(lrows, lcol)
+        lside = self._columnar_scan_side(self.children[0],
+                                         plan.left_conditions)
+        if lside is None:
+            lside = RowsSide(self.children[0].drain())
+        lkey, lvalid = self._side_key(lside, lcol)
         if lkey is None:
             # BOTH sides are drained by now — hand both to the slow path
-            # (discarding lrows would silently join an exhausted left)
-            self._prebuilt_right = rrows
-            self._left_iter = iter(lrows)
+            # (discarding them would silently join an exhausted child)
+            self._prebuilt_right = rside.rows()
+            self._left_iter = iter(lside.rows())
             return False
         if rkey.dtype != lkey.dtype:
             # int side vs float side never match under the dict path's
@@ -524,16 +556,19 @@ class HashJoinExec(Executor):
             lkey = lkey.astype(rkey.dtype)
         left_ok = None
         if plan.left_conditions:
-            left_ok = [_conds_ok(plan.left_conditions, r) for r in lrows]
+            # left side conditions force the row drain above, so rows
+            # are already materialized here
+            left_ok = [_conds_ok(plan.left_conditions, r)
+                       for r in lside.rows()]
         floor = self._device_join_floor()
-        if floor is not None and max(len(lrows), len(rrows)) >= floor:
+        if floor is not None and max(len(lside), len(rside)) >= floor:
             try:
-                self._start_device(lrows, rrows, lkey, lvalid, rkey,
+                self._start_device(lside, rside, lkey, lvalid, rkey,
                                    rvalid, left_ok)
                 return True
             except Exception:
                 # clean bail-out: the numpy path below answers from the
-                # same drained rows and key planes — but a systematically
+                # same drained sides and key planes — but a systematically
                 # failing device path must not degrade silently
                 import logging
                 logging.getLogger("tidb_tpu.join").warning(
@@ -541,6 +576,7 @@ class HashJoinExec(Executor):
                     exc_info=True)
                 self.join_stats["device_error"] = True
         self.join_stats["path"] = "numpy"
+        lrows, rrows = lside.rows(), rside.rows()
         order = np.argsort(rkey[rvalid], kind="stable")
         ridx = np.flatnonzero(rvalid)[order].tolist()
         rs = rkey[rvalid][order]
@@ -554,12 +590,13 @@ class HashJoinExec(Executor):
             lrows, rrows, ridx, lo.tolist(), hi.tolist(), left_ok)
         return True
 
-    def _start_device(self, lrows, rrows, lkey, lvalid, rkey, rvalid,
+    def _start_device(self, lside, rside, lkey, lvalid, rkey, rvalid,
                       left_ok) -> None:
         """Run the device join kernels and assemble the columnar result
         (final emission-order index pairs; r_idx -1 = LEFT OUTER pad).
         Rows are NOT materialized here — an aggregate parent fuses over
-        the gathered planes instead (executor.fused_agg)."""
+        the gathered planes instead (executor.fused_agg), and columnar
+        scan sides keep even the SCAN rows unmaterialized."""
         import numpy as np
         from tidb_tpu.ops import columnar as col_mod
         from tidb_tpu.ops import kernels
@@ -576,13 +613,13 @@ class HashJoinExec(Executor):
         if other:
             # residual non-equi conditions need joined rows: materialize
             # the matched pairs once, filter, keep the surviving pairs
-            pairs = col_mod.materialize_join_rows(lrows, rrows, li, ri,
-                                                  self._right_width)
+            pairs = col_mod.materialize_join_rows(
+                lside.rows(), rside.rows(), li, ri, self._right_width)
             keep = np.fromiter((_conds_ok(other, row) for row in pairs),
                                dtype=bool, count=len(pairs))
             li, ri = li[keep], ri[keep]
         if self.plan.join_type == Join.LEFT_OUTER:
-            matched = np.bincount(li, minlength=len(lrows))
+            matched = np.bincount(li, minlength=len(lside))
             pad_l = np.flatnonzero(matched == 0)
             if len(pad_l):
                 li = np.concatenate([li, pad_l])
@@ -592,7 +629,7 @@ class HashJoinExec(Executor):
                 perm = np.argsort(li, kind="stable")
                 li, ri = li[perm], ri[perm]
         self._device = col_mod.DeviceJoinResult(
-            lrows, rrows, li, ri, len(self.children[0].schema),
+            lside, rside, li, ri, len(self.children[0].schema),
             self._right_width)
         stats["path"] = "device"
         stats["assemble_s"] = time.time() - t0

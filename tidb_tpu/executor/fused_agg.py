@@ -1,12 +1,14 @@
-"""join→aggregate fusion: COMPLETE-mode hash aggregation evaluated
-directly over a device join's columnar output (ops.columnar.
-DeviceJoinResult) — the joined rows are never materialized.
+"""columnar aggregate fusion: COMPLETE-mode hash aggregation evaluated
+directly over a columnar child result — a device join's output
+(ops.columnar.DeviceJoinResult) or a columnar scan payload
+(ops.columnar.ColumnarScanResult) — without the rows under the
+aggregate ever being materialized.
 
-This is the executor-layer payoff of keeping the join columnar (PAPER
-§L5: operators stay columnar end-to-end across the pushdown boundary):
-a join feeding an aggregate gathers only the planes the aggregate
-actually touches, and the aggregate itself runs as vectorized numpy
-segment reductions keyed by first-appearance group ids.
+This is the executor-layer payoff of keeping results columnar across
+the pushdown boundary (PAPER §L5: operators stay columnar end-to-end):
+a join or scan feeding an aggregate gathers only the planes the
+aggregate actually touches, and the aggregate itself runs as vectorized
+numpy segment reductions keyed by first-appearance group ids.
 
 Exactness contract — fused output must be row-for-row identical to the
 HashAggExec row loop it replaces, so every reduction mirrors
@@ -61,15 +63,17 @@ def _has_neg_zero(vals, mask) -> bool:
     return bool(np.any(z))
 
 
-def try_fused_join_agg(agg):
-    """Fused result rows for a HashAggExec over a device join, or None
-    when any piece falls outside the vectorizable subset. Cheap
-    structural gates run BEFORE the child is started, so a None from
-    them leaves the join untouched for the row loop."""
+def try_fused_agg(agg):
+    """Fused result rows for a HashAggExec over a device join or a
+    columnar scan, or None when any piece falls outside the vectorizable
+    subset. Cheap structural gates run BEFORE the child is started, so a
+    None from them leaves the child untouched for the row loop."""
+    child = agg.children[0]
     out = _try_fused(agg)
     if out is not None:
         stats["fused"] += 1
-    elif getattr(agg.children[0], "_device", None) is not None:
+    elif getattr(child, "_device", None) is not None or \
+            getattr(child, "_columnar", None) is not None:
         stats["fallback"] += 1
     return out
 
@@ -90,7 +94,10 @@ def _try_fused(agg):
             return None
 
     child = agg.children[0]
-    res = child.device_join_result()
+    if hasattr(child, "device_join_result"):
+        res = child.device_join_result()
+    else:
+        res = child.columnar_result()
     if res is None:
         return None
     n = len(res)
@@ -132,7 +139,9 @@ def _try_fused(agg):
         cols.append(col_res)
 
     emit = np.argsort(first_idx, kind="stable")
-    child.join_stats["fused_agg"] = True
+    join_stats = getattr(child, "join_stats", None)
+    if join_stats is not None:
+        join_stats["fused_agg"] = True
     return [[c[g] for c in cols] for g in emit.tolist()]
 
 
@@ -196,6 +205,10 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int):
     if plane is None:
         return None
     kind, vals, valid = plane
+    if kind is None:
+        # argument column has no plane mapping (unsigned bigint, time,
+        # duration, decimal, bit): the row loop answers
+        return None
 
     if name == "count":
         cnt = np.bincount(gid[valid], minlength=G)

@@ -161,6 +161,15 @@ def _set(session, stmt: ast.SetStmt):
                     "variable and should be set with SET GLOBAL",
                     code=1229)
             session.apply_tpu_device_join(sval)
+        if va.name.lower() == "tidb_tpu_columnar_scan":
+            if not va.is_global:
+                # store-level client state, same GLOBAL-only contract as
+                # the dispatch floor
+                raise errors.ExecError(
+                    "Variable 'tidb_tpu_columnar_scan' is a GLOBAL "
+                    "variable and should be set with SET GLOBAL",
+                    code=1229)
+            session.apply_tpu_columnar_scan(sval)
         for name in names:
             if va.is_global:
                 session.global_vars.set(name, sval)

@@ -29,14 +29,17 @@ def backoff(attempts: int) -> float:
     return ms / 1000.0
 
 
-def run_in_new_txn(store, retryable: bool, fn: Callable[[object], T]) -> T:
+def run_in_new_txn(store, retryable: bool, fn: Callable[[object], T],
+                   max_retries: int = MAX_RETRY_CNT) -> T:
     """Run fn(txn) in a fresh transaction, retrying on write conflict.
 
     Reference: kv/txn.go RunInNewTxn — used by DDL/meta operations that must
-    win eventually.
+    win eventually. Callers whose txns conflict with EVERY concurrent
+    write (DDL reorg batches) pass a larger max_retries, matching the
+    reference's ~100-attempt meta-txn budget.
     """
     last_err: BaseException | None = None
-    for attempt in range(MAX_RETRY_CNT):
+    for attempt in range(max_retries):
         txn = store.begin()
         try:
             result = fn(txn)

@@ -96,13 +96,22 @@ class TpuClient(kv.Client):
         # reverting the kill switch to its default.
         self.device_join = bool(int(
             _SYSVAR_DEFAULTS["tidb_tpu_device_join"]))
+        # columnar result channel: SET GLOBAL tidb_tpu_columnar_scan = 0
+        # pins every scan response to the row protocol (plane-aware
+        # consumers fall back to row drains) while scans keep routing to
+        # the device — same store-level resolution contract as the join
+        # kill switch.
+        self.columnar_scan = bool(int(
+            _SYSVAR_DEFAULTS["tidb_tpu_columnar_scan"]))
         import sys as _sys
         sess_mod = _sys.modules.get("tidb_tpu.session")
         if sess_mod is not None:
-            v = sess_mod.store_global_var(store, "tidb_tpu_device_join")
-            if v is not None:
-                from tidb_tpu.sessionctx import parse_bool_sysvar
-                self.device_join = parse_bool_sysvar(v)
+            from tidb_tpu.sessionctx import parse_bool_sysvar
+            for attr, var in (("device_join", "tidb_tpu_device_join"),
+                              ("columnar_scan", "tidb_tpu_columnar_scan")):
+                v = sess_mod.store_global_var(store, var)
+                if v is not None:
+                    setattr(self, attr, parse_bool_sysvar(v))
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
         # (jitted, planes, live) of the most recent single-chip aggregate
@@ -735,32 +744,20 @@ class TpuClient(kv.Client):
         return self._emit_rows(sel, batch, top)
 
     def _emit_rows(self, sel, batch, idx) -> SelectResponse:
+        if sel.columnar_hint and self.columnar_scan:
+            # plane-aware consumer: ship the scan's planes + selection
+            # index instead of encoding rows the far side would only
+            # re-extract (the columnar half of scan→join→agg staying
+            # device-resident end-to-end)
+            return SelectResponse(columnar=col.ColumnarScanResult(
+                batch, np.asarray(idx, dtype=np.int64),
+                list(self._cur_cols)))
         writer = ChunkWriter()
         cols = self._cur_cols
-        planes = {cid: cd for cid, cd in batch.columns.items()}
+        planes = batch.columns
         for i in idx:
-            row = []
-            for c in cols:
-                cd = planes[c.column_id]
-                if not cd.valid[i]:
-                    row.append(NULL)
-                elif cd.kind == col.K_STR:
-                    row.append(Datum.bytes_(cd.dictionary[int(cd.values[i])]))
-                elif cd.kind == col.K_F64:
-                    row.append(Datum.f64(float(cd.values[i])))
-                elif cd.kind == col.K_DEC:
-                    row.append(Datum.dec(
-                        Decimal(int(cd.values[i]))
-                        / (Decimal(10) ** cd.dec_scale)))
-                else:
-                    v = int(cd.values[i])
-                    if c.tp in my.TIME_TYPES:
-                        row.append(Datum(Kind.TIME, _number_to_time(v, c.tp)))
-                    elif c.tp == my.TypeDuration:
-                        from tidb_tpu.types.time_types import Duration
-                        row.append(Datum(Kind.DURATION, Duration(v)))
-                    else:
-                        row.append(Datum.i64(v))
+            row = [col.plane_datum(planes[c.column_id], c, int(i))
+                   for c in cols]
             writer.append_row(int(batch.handles[i]), row)
         return SelectResponse(chunks=writer.finish())
 

@@ -498,8 +498,187 @@ def pack_index_ranges(snapshot, index_info, ranges) -> ColumnBatch:
 
 
 # ---------------------------------------------------------------------------
-# join output assembly: planes over materialized executor rows, gathered
-# by device-join match pairs — the columnar half of the device hash join
+# columnar coprocessor results: the payload a plane-aware consumer gets
+# back INSTEAD of chunk rows. A scan request carrying columnar_hint (and a
+# TpuClient with tidb_tpu_columnar_scan on) answers with the packed
+# ColumnBatch plus the selection index — the device join, fused aggregates
+# and TopN then read planes directly; no row is encoded, decoded, or
+# re-extracted anywhere on the path.
+# ---------------------------------------------------------------------------
+
+def plane_datum(cd: ColumnData, c: PBColumnInfo, i: int) -> Datum:
+    """One plane cell → the storage-flattened Datum the row protocol
+    carries (TpuClient._emit_rows' decode, shared with the columnar
+    payload's row materialization so both emit identical datums)."""
+    if not cd.valid[i]:
+        return NULL
+    if cd.kind == K_STR:
+        return Datum.bytes_(cd.dictionary[int(cd.values[i])])
+    if cd.kind == K_F64:
+        return Datum.f64(float(cd.values[i]))
+    if cd.kind == K_DEC:
+        return Datum.dec(Decimal(int(cd.values[i]))
+                         / (Decimal(10) ** cd.dec_scale))
+    v = int(cd.values[i])
+    if c.tp in my.TIME_TYPES:
+        from tidb_tpu.types.time_types import Time
+        return Datum(Kind.TIME, Time.from_packed_int(v, c.tp))
+    if c.tp == my.TypeDuration:
+        from tidb_tpu.types.time_types import Duration
+        return Datum(Kind.DURATION, Duration(v))
+    return Datum.i64(v)
+
+
+class ColumnarScanResult:
+    """A scan's columnar answer: the packed ColumnBatch plus the selection
+    index (filter/TopN survivors, in emission order) and the output column
+    metadata. Doubles as a device-join SIDE: column_plane / datum_at /
+    rows mirror what rows_plane over the materialized row path would
+    produce, value-for-value, so routing and results agree by
+    construction. The batch is the client's shared cache — read-only;
+    every gather copies."""
+
+    def __init__(self, batch: ColumnBatch, sel: np.ndarray,
+                 pb_cols: list[PBColumnInfo]):
+        self.batch = batch
+        self.sel = np.asarray(sel, dtype=np.int64)
+        self.pb_cols = pb_cols
+        self._fts: list | None = None
+        self._plane_cache: dict = {}
+        self._rows_cache: list | None = None
+
+    def __len__(self) -> int:
+        return len(self.sel)
+
+    def handles(self) -> np.ndarray:
+        return self.batch.handles[self.sel]
+
+    def _ft(self, j: int):
+        if self._fts is None:
+            from tidb_tpu.copr.proto import field_type_from_pb_column
+            self._fts = [field_type_from_pb_column(c) for c in self.pb_cols]
+        return self._fts[j]
+
+    def column_plane(self, j: int):
+        """Output column j as a (kind, values, valid) plane, kind one of
+        "i64" / "f64" / "str" — or (None, None, None) when the column's
+        post-unflatten datum kind has no plane mapping (unsigned bigint,
+        time, duration, decimal, bit). The gate mirrors rows_plane over
+        the row path exactly, so both paths route the same shapes."""
+        ent = self._plane_cache.get(j)
+        if ent is not None:
+            return ent
+        c = self.pb_cols[j]
+        cd = self.batch.columns[c.column_id]
+        sel = self.sel
+        valid = cd.valid[sel]
+        if not valid.any():
+            # all-NULL: a (vacuously) numeric plane, like rows_plane
+            ent = ("i64", np.zeros(len(sel), np.int64), valid)
+        elif cd.kind == K_STR:
+            vals = np.empty(len(sel), dtype=object)
+            dic = self._emit_dictionary(j, cd)
+            vals[:] = [dic[code] if ok else None
+                       for code, ok in zip(cd.values[sel].tolist(),
+                                           valid.tolist())]
+            ent = ("str", vals, valid)
+        elif cd.kind == K_F64:
+            ent = ("f64", cd.values[sel], valid)
+        elif cd.kind == K_I64 and c.tp in my.INTEGER_TYPES and \
+                not (c.tp == my.TypeLonglong and my.has_unsigned_flag(c.flag)):
+            ent = ("i64", cd.values[sel], valid)
+        else:
+            ent = (None, None, None)
+        self._plane_cache[j] = ent
+        return ent
+
+    def _emit_dictionary(self, j: int, cd: ColumnData) -> list[bytes]:
+        """Dictionary bytes as the ROW path would carry them: non-binary
+        string columns round-trip through utf-8 with replacement
+        (types.convert.unflatten_datum), so grouping/join keys agree
+        byte-for-byte even on invalid utf-8."""
+        from tidb_tpu.types.convert import bytes_decode_to_string
+        if bytes_decode_to_string(self._ft(j)):
+            return [b.decode("utf-8", "replace").encode("utf-8")
+                    for b in cd.dictionary]
+        return cd.dictionary
+
+    def _col_datums(self, j: int) -> list[Datum]:
+        from tidb_tpu.types.convert import (
+            unflatten_datum, unflatten_identity_kinds,
+        )
+        c = self.pb_cols[j]
+        cd = self.batch.columns[c.column_id]
+        ft = self._ft(j)
+        idk = unflatten_identity_kinds(ft)
+        out = []
+        for i in self.sel.tolist():
+            d = plane_datum(cd, c, i)
+            out.append(d if d.kind in idk else unflatten_datum(d, ft))
+        return out
+
+    def rows(self) -> list[list[Datum]]:
+        """Materialized executor rows (typed, unflattened) — the lazy
+        fallback for consumers that end up pulling rows after all."""
+        if self._rows_cache is None:
+            cols = [self._col_datums(j) for j in range(len(self.pb_cols))]
+            self._rows_cache = [list(t) for t in zip(*cols)]
+        return self._rows_cache
+
+    def datum_at(self, j: int, i: int) -> Datum:
+        """Exact typed Datum for output row i, column j — no full
+        materialization (first_row gathers a handful of these)."""
+        if self._rows_cache is not None:
+            return self._rows_cache[i][j]
+        from tidb_tpu.types.convert import unflatten_datum
+        c = self.pb_cols[j]
+        d = plane_datum(self.batch.columns[c.column_id], c,
+                        int(self.sel[i]))
+        return unflatten_datum(d, self._ft(j))
+
+    def iter_rows_with_handles(self):
+        return iter(zip(self.handles().tolist(), self.rows()))
+
+    def iter_raw_with_handles(self):
+        """(handle, storage-flattened datums) pairs — what decoding this
+        response's chunks would have yielded (copr.proto
+        iter_response_rows' contract for columnar parts)."""
+        handles = self.handles().tolist()
+        cols = [self.pb_cols[j] for j in range(len(self.pb_cols))]
+        cds = [self.batch.columns[c.column_id] for c in cols]
+        for pos, i in enumerate(self.sel.tolist()):
+            yield handles[pos], [plane_datum(cd, c, i)
+                                 for cd, c in zip(cds, cols)]
+
+
+class RowsSide:
+    """Row-list side of a device join: the drained executor rows behind
+    the same plane/rows/datum protocol ColumnarScanResult speaks."""
+
+    def __init__(self, rows: list):
+        self._rows = rows
+        self._plane_cache: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def rows(self) -> list:
+        return self._rows
+
+    def column_plane(self, j: int):
+        ent = self._plane_cache.get(j)
+        if ent is None:
+            ent = self._plane_cache[j] = rows_plane(self._rows, j)
+        return ent
+
+    def datum_at(self, j: int, i: int):
+        return self._rows[i][j]
+
+
+# ---------------------------------------------------------------------------
+# join output assembly: planes over the two join sides (materialized
+# executor rows or columnar scan payloads), gathered by device-join match
+# pairs — the columnar half of the device hash join
 # (ops.kernels.join_match_pairs). Rows materialize only when something
 # actually consumes rows; an aggregate above the join reads the gathered
 # planes directly (join→agg fusion, executor.fused_agg).
@@ -570,16 +749,17 @@ def rows_plane(rows, idx: int):
 
 
 class DeviceJoinResult:
-    """Columnar view of a device join's output: the two drained sides
-    plus the FINAL emission-order index pairs (r_idx == -1 marks a LEFT
-    OUTER pad row). Column planes gather lazily per column; row
-    materialization is chunked native batch calls (codecx.join_rows)
-    paid only by consumers that actually pull rows."""
+    """Columnar view of a device join's output: the two sides (RowsSide
+    row lists or ColumnarScanResult scan payloads) plus the FINAL
+    emission-order index pairs (r_idx == -1 marks a LEFT OUTER pad row).
+    Column planes gather lazily per column; row materialization is
+    chunked native batch calls (codecx.join_rows) paid only by consumers
+    that actually pull rows."""
 
-    def __init__(self, lrows, rrows, l_idx: np.ndarray, r_idx: np.ndarray,
+    def __init__(self, lside, rside, l_idx: np.ndarray, r_idx: np.ndarray,
                  left_width: int, right_width: int):
-        self.lrows = lrows
-        self.rrows = rrows
+        self.lside = lside
+        self.rside = rside
         self.l_idx = l_idx
         self.r_idx = r_idx
         self.left_width = left_width
@@ -598,15 +778,15 @@ class DeviceJoinResult:
         if ent is not None:
             return ent
         if j < self.left_width:
-            kind, vals, valid = rows_plane(self.lrows, j)
+            kind, vals, valid = self.lside.column_plane(j)
             if kind is not None:
                 vals, valid = vals[self.l_idx], valid[self.l_idx]
         else:
-            kind, vals, valid = rows_plane(self.rrows, j - self.left_width)
+            kind, vals, valid = self.rside.column_plane(j - self.left_width)
             if kind is not None:
                 pad = self.r_idx < 0
                 idx = np.where(pad, 0, self.r_idx)
-                if len(self.rrows):
+                if len(self.rside):
                     vals, valid = vals[idx], valid[idx] & ~pad
                 else:
                     vals = np.zeros(len(self.r_idx), vals.dtype if kind != "str"
@@ -620,9 +800,9 @@ class DeviceJoinResult:
         """Exact source Datum for output row i, column j — no plane
         needed (first_row gathers a handful of these per group)."""
         if j < self.left_width:
-            return self.lrows[self.l_idx[i]][j]
-        r = self.r_idx[i]
-        return NULL if r < 0 else self.rrows[r][j - self.left_width]
+            return self.lside.datum_at(j, int(self.l_idx[i]))
+        r = int(self.r_idx[i])
+        return NULL if r < 0 else self.rside.datum_at(j - self.left_width, r)
 
     def iter_rows(self, chunk: int = 1 << 16, stats: dict | None = None):
         """Stream output rows, assembling `chunk` index pairs per native
@@ -632,10 +812,14 @@ class DeviceJoinResult:
         accumulates the total assembly time under "emit_s"."""
         import time
         n = len(self.l_idx)
+        t0 = time.time()
+        lrows, rrows = self.lside.rows(), self.rside.rows()
+        if stats is not None:
+            stats["emit_s"] = stats.get("emit_s", 0.0) + (time.time() - t0)
         for start in range(0, n, chunk):
             t0 = time.time()
             rows = materialize_join_rows(
-                self.lrows, self.rrows, self.l_idx[start:start + chunk],
+                lrows, rrows, self.l_idx[start:start + chunk],
                 self.r_idx[start:start + chunk], self.right_width)
             if stats is not None:
                 stats["emit_s"] = stats.get("emit_s", 0.0) + \

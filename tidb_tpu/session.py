@@ -263,6 +263,8 @@ class Session:
         ps = perfschema.perf_for(self.store)
         ev = ps.start_statement(self.vars.connection_id, sql_text)
         import time as _time
+        from tidb_tpu.distsql import thread_columnar_counts
+        ch0, cf0 = thread_columnar_counts()
         t0 = _time.perf_counter()
         from tidb_tpu.sqlast import ShowStmt, ShowType
         if self._exec_depth == 0 and \
@@ -283,10 +285,14 @@ class Session:
             self._exec_depth -= 1
         ps.end_statement(ev, rows_sent=len(rs.rows) if rs is not None else 0,
                          rows_affected=self.vars.affected_rows)
-        self._maybe_log_slow(sql_text, _time.perf_counter() - t0)
+        ch1, cf1 = thread_columnar_counts()
+        self._maybe_log_slow(sql_text, _time.perf_counter() - t0,
+                             ch1 - ch0, cf1 - cf0)
         return rs
 
-    def _maybe_log_slow(self, sql_text: str, elapsed_s: float) -> None:
+    def _maybe_log_slow(self, sql_text: str, elapsed_s: float,
+                        columnar_hits: int = 0,
+                        columnar_fallbacks: int = 0) -> None:
         """Slow-query log ([TIME_TABLE_SCAN]-style operator logs,
         executor_distsql.go:849): statements over
         tidb_slow_log_threshold ms go to the 'tidb_tpu.slowlog' logger."""
@@ -301,8 +307,10 @@ class Session:
         if thr_ms > 0 and elapsed_s * 1000 >= thr_ms:
             import logging
             logging.getLogger("tidb_tpu.slowlog").warning(
-                "[SLOW_QUERY] cost_time:%.3fs conn:%s sql:%s",
-                elapsed_s, self.vars.connection_id, sql_text[:2048])
+                "[SLOW_QUERY] cost_time:%.3fs conn:%s columnar_hits:%d "
+                "columnar_fallbacks:%d sql:%s",
+                elapsed_s, self.vars.connection_id, columnar_hits,
+                columnar_fallbacks, sql_text[:2048])
             from tidb_tpu import metrics
             metrics.counter("server.slow_queries").inc()
 
@@ -599,15 +607,17 @@ class Session:
         if isinstance(client, TpuClient):
             client.dispatch_floor_rows = floor
 
-    def apply_tpu_device_join(self, value: str) -> None:
-        """SET GLOBAL tidb_tpu_device_join = 0|1 — the executor-join
-        device-routing kill switch. Lives on the store-level client like
-        the dispatch floor (every session's joins re-route)."""
+    def _apply_tpu_bool_switch(self, name: str, attr: str,
+                               value: str) -> None:
+        """Shared SET GLOBAL handler for the store-level TpuClient bool
+        switches: validate the literal, gate on the global Grant
+        privilege (store-wide blast radius, like the dispatch floor),
+        then flip the attribute on the installed client."""
         from tidb_tpu.sessionctx import parse_bool_sysvar
         if value.strip().lower() not in ("0", "1", "on", "off", "true",
                                          "false"):
             raise errors.ExecError(
-                f"tidb_tpu_device_join must be 0 or 1, got {value!r}")
+                f"{name} must be 0 or 1, got {value!r}")
         enabled = parse_bool_sysvar(value)
         if self.vars.user:
             from tidb_tpu import privilege
@@ -616,11 +626,23 @@ class Session:
                     host=self.vars.client_host):
                 raise privilege.AccessDenied(
                     f"user '{self.vars.user}' needs the global GRANT "
-                    "privilege to set tidb_tpu_device_join")
+                    f"privilege to set {name}")
         from tidb_tpu.ops import TpuClient
         client = self.store.get_client()
         if isinstance(client, TpuClient):
-            client.device_join = enabled
+            setattr(client, attr, enabled)
+
+    def apply_tpu_device_join(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_device_join = 0|1 — the executor-join
+        device-routing kill switch (every session's joins re-route)."""
+        self._apply_tpu_bool_switch("tidb_tpu_device_join", "device_join",
+                                    value)
+
+    def apply_tpu_columnar_scan(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_columnar_scan = 0|1 — the columnar result
+        channel kill switch (every session's scan responses re-route)."""
+        self._apply_tpu_bool_switch("tidb_tpu_columnar_scan",
+                                    "columnar_scan", value)
 
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
@@ -799,6 +821,9 @@ def bootstrap(session: Session) -> None:
                     dj = gv.values.get("tidb_tpu_device_join")
                     if dj is not None:
                         client.device_join = parse_bool_sysvar(dj)
+                    cs = gv.values.get("tidb_tpu_columnar_scan")
+                    if cs is not None:
+                        client.columnar_scan = parse_bool_sysvar(cs)
                     fl = gv.values.get("tidb_tpu_dispatch_floor")
                     try:
                         if fl is not None:

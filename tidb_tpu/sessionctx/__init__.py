@@ -55,6 +55,10 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # device join kill switch: 0 pins executor joins to the host numpy
     # path while scans/aggregates keep routing to the device
     "tidb_tpu_device_join": "1",
+    # columnar result channel kill switch: 0 pins scan responses to the
+    # row protocol (plane-aware consumers fall back to row drains) while
+    # scans keep routing to the device
+    "tidb_tpu_columnar_scan": "1",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
     "tidb_copr_batch_rows": "1048576",

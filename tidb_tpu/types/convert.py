@@ -225,10 +225,8 @@ def unflatten_datum(d: Datum, ft: FieldType) -> Datum:
     if k == Kind.DURATION:
         fsp = ft.decimal if ft.decimal >= 0 else 0
         return Datum(Kind.DURATION, Duration(d.val.nanos, fsp))
-    if k == Kind.BYTES and ft.is_string() and ft.tp not in (
-            my.TypeBlob, my.TypeTinyBlob, my.TypeMediumBlob, my.TypeLongBlob):
-        if not (ft.flag & my.BinaryFlag):
-            return Datum(Kind.STRING, d.val.decode("utf-8", "replace"))
+    if k == Kind.BYTES and bytes_decode_to_string(ft):
+        return Datum(Kind.STRING, d.val.decode("utf-8", "replace"))
     if k == Kind.INT64 and ft.is_unsigned() and ft.tp == my.TypeLonglong and d.val >= 0:
         return Datum(Kind.UINT64, d.val)
     if k in (Kind.INT64, Kind.UINT64):
@@ -249,6 +247,33 @@ def unflatten_datum(d: Datum, ft: FieldType) -> Datum:
         if d.val == quantized:
             return Datum(Kind.DECIMAL, quantized)
     return d
+
+
+def bytes_decode_to_string(ft: FieldType) -> bool:
+    """True when a BYTES storage value unflattens into a STRING datum
+    for this column (non-binary, non-blob string type) — THE predicate
+    shared by unflatten_datum, unflatten_identity_kinds, and the
+    columnar dictionary emit (ops.columnar); byte-parity between the
+    row and columnar channels depends on them never drifting."""
+    return ft.is_string() and ft.tp not in (
+        my.TypeBlob, my.TypeTinyBlob, my.TypeMediumBlob,
+        my.TypeLongBlob) and not (ft.flag & my.BinaryFlag)
+
+
+def unflatten_identity_kinds(ft: FieldType) -> frozenset:
+    """Datum kinds for which unflatten_datum(d, ft) is the identity for
+    this column type — the per-cell fast path of row decode: a caller may
+    skip the call entirely when d.kind is in the returned set. Kinds whose
+    unflatten depends on the VALUE (TIME/DURATION fsp rebuild, DECIMAL
+    re-quantize) are never in the set."""
+    kinds = {Kind.NULL, Kind.FLOAT64, Kind.STRING}
+    if not bytes_decode_to_string(ft):
+        kinds.add(Kind.BYTES)
+    if ft.tp not in (my.TypeEnum, my.TypeSet, my.TypeBit):
+        kinds.add(Kind.UINT64)
+        if not (ft.is_unsigned() and ft.tp == my.TypeLonglong):
+            kinds.add(Kind.INT64)
+    return frozenset(kinds)
 
 
 def cast_to_number(d: Datum):
