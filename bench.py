@@ -554,6 +554,96 @@ def measure_join_e2e(store, n_probe: int, n_dim: int, runs: int,
         store.set_client(old_client)
 
 
+REGION_FANOUT_SQL = ("select count(*), sum(f_v), min(f_v), max(d_f) "
+                     "from fan join fdim on f_k = d_k")
+
+
+def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
+                          runs: int):
+    """scan→join→agg e2e ACROSS a real per-region fan-out: a cluster
+    store split into n_regions, each region answering the hinted scan
+    with a ColumnarScanResult PARTIAL (copr.columnar_region), the numpy
+    join building off the stacked planes, and the fused aggregate
+    merging per-region partial states device-side (one combine, one
+    readback). The row-protocol regime (kill switch) is the speedup
+    denominator. Asserts columnar_fallbacks == 0 and ≥ n_regions
+    partials over the timed window."""
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.executor import fused_agg
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    store = new_store(f"cluster://3/benchfan{n_rows}")
+    s = Session(store)
+    s.execute("create database fan")
+    s.execute("use fan")
+    s.execute("create table fan (f_id bigint primary key, f_k bigint, "
+              "f_v bigint)")
+    s.execute("create table fdim (d_k bigint primary key, d_f double)")
+    tbl = s.info_schema().table_by_name("fan", "fan")
+    rows = [[Datum.i64(i), Datum.i64(i % n_dim), Datum.i64(i * 3)]
+            for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    for start in range(0, n_dim, batch):
+        vals = ", ".join(f"({k}, {k % 97}.5)"
+                         for k in range(start, min(start + batch, n_dim)))
+        s.execute(f"insert into fdim values {vals}")
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+
+    hits = metrics.counter("distsql.columnar_hits")
+    fbs = metrics.counter("distsql.columnar_fallbacks")
+    parts = metrics.counter("distsql.columnar_partials")
+    sess = Session(store)
+    sess.execute("use fan")
+    sess.execute(REGION_FANOUT_SQL)       # warm (cache, jit)
+    h0, f0, p0 = hits.value, fbs.value, parts.value
+    c0 = fused_agg.stats["partial_combines"]
+    t0 = time.time()
+    for _ in range(runs):
+        col_results = sess.execute(REGION_FANOUT_SQL)[0].values()
+    t_col = (time.time() - t0) / runs
+    d_hits, d_fbs = hits.value - h0, fbs.value - f0
+    d_parts = parts.value - p0
+    combines = fused_agg.stats["partial_combines"] - c0
+    assert d_fbs == 0, \
+        f"region fan-out run counted {d_fbs} columnar fallbacks"
+    assert d_parts >= n_regions * runs, \
+        f"only {d_parts} columnar partials across {n_regions} regions"
+    assert combines > 0, \
+        "fused aggregate never merged per-region partials device-side"
+
+    # row-protocol regime across the SAME fan-out (the kill switch path)
+    client = store.get_client()
+    client.columnar_scan = False
+    try:
+        sess.execute(REGION_FANOUT_SQL)   # warm the row regime
+        t0 = time.time()
+        for _ in range(runs):
+            row_results = sess.execute(REGION_FANOUT_SQL)[0].values()
+        t_row = (time.time() - t0) / runs
+    finally:
+        client.columnar_scan = True
+    for got, want in zip(col_results[0], row_results[0]):
+        assert _close(float(got), float(want)), \
+            f"region fan-out parity: {got} != {want}"
+    return {
+        "region_fanout_rows_per_sec": round(n_rows / t_col, 1),
+        "region_fanout_speedup_vs_rowpath": round(t_row / t_col, 2),
+        "region_fanout_regions": n_regions,
+        "region_fanout_fallbacks": d_fbs,
+        "columnar_partials": d_parts,
+        "region_partial_combines": combines,
+    }
+
+
 def timed_runs(session, sql: str, runs: int):
     session.execute(sql)  # warm (compile + cache + pack)
     results = []
@@ -772,6 +862,19 @@ def main(smoke: bool = False):
           f"(hits {e2e_figs['columnar_hits']}, fallbacks "
           f"{e2e_figs['columnar_fallbacks']})", file=sys.stderr)
 
+    # per-region fan-out e2e: every region answers the columnar channel,
+    # per-region partial aggregates merge device-side (4-region cluster)
+    fr, fd = (6_000, 500) if smoke else (120_000, 5_000)
+    fan_figs = measure_region_fanout(fr, fd, n_regions=4, runs=runs)
+    print(f"# region_fanout ({fr / 1000:.0f}k rows x "
+          f"{fan_figs['region_fanout_regions']} regions scan→join→agg): "
+          f"{fan_figs['region_fanout_rows_per_sec']:,.0f} rows/s columnar "
+          f"({fan_figs['region_fanout_speedup_vs_rowpath']:.2f}x the row "
+          f"protocol), {fan_figs['columnar_partials']} partials, "
+          f"{fan_figs['region_fanout_fallbacks']} fallbacks, "
+          f"{fan_figs['region_partial_combines']} device partial-combines",
+          file=sys.stderr)
+
     geo_rps = math.exp(sum(math.log(x) for x in tpu_rps_all)
                        / len(tpu_rps_all))
     geo_speedup = math.exp(sum(math.log(x) for x in speedups)
@@ -796,6 +899,7 @@ def main(smoke: bool = False):
         "small_query_ms": round(small_ms, 2),
         **join_figs,
         **e2e_figs,
+        **fan_figs,
         "smoke": smoke,
         # the honest CPU comparison: a vectorized-numpy engine over the
         # same packed planes (the Python xeval baseline above understates

@@ -44,3 +44,10 @@ def test_bench_smoke_emits_valid_json():
         "scan→join→agg e2e decoded rows (columnar_fallbacks > 0 or no hits)"
     assert out["join_e2e_rows_per_sec"] > 0
     assert out["columnar_fallbacks"] == 0
+    # the per-region fan-out e2e: every region answered the columnar
+    # channel and per-region partial aggregates merged device-side
+    assert out["region_fanout_rows_per_sec"] > 0
+    assert out["region_fanout_regions"] == 4
+    assert out["columnar_partials"] >= 4
+    assert out["region_fanout_fallbacks"] == 0
+    assert out["region_partial_combines"] > 0

@@ -1,0 +1,292 @@
+"""Differential parity for the columnar channel ACROSS the cluster
+store's per-region fan-out: a scan answered as per-region
+ColumnarScanResult partials (stacked into a ColumnarPartialSet, fused
+aggregates merging per-region partial states device-side) must be
+row-for-row identical to the single-region columnar path AND to the row
+protocol — including a region split and a region merge injected MID-SCAN
+via cluster.topology, the tidb_tpu_columnar_scan kill switch, per-PARTIAL
+hit/fallback attribution for mixed responses, and the unsigned-bigint
+pack overflow regression on both pack paths.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+from tidb_tpu import metrics, tablecodec as tc
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+JOIN_AGG_Q = ("select count(*), sum(t.v), min(t.v), max(d.d_f), avg(t.v), "
+              "sum(t.f) from t join d on t.k = d.d_k")
+GROUPED_Q = ("select t.k, count(*), sum(t.v), min(t.f), max(t.v) "
+             "from t join d on t.k = d.d_k group by t.k order by t.k")
+QUERIES = [
+    JOIN_AGG_Q,
+    GROUPED_Q,
+    "select t.id, t.v, d.d_f from t join d on t.k = d.d_k order by t.id",
+    "select t.id, d.d_k from t left join d on t.k = d.d_k "
+    "and d.d_f > 2.0 order by t.id",
+    "select count(*), sum(v) from t join d on t.k = d.d_k "
+    "where t.v > 500",
+    "select id, v from t order by v desc limit 7",
+    "select id, f from t where k < 5 order by f limit 9",
+    "select k, count(*), min(v) from t group by k order by k",
+]
+
+
+def _build(n_regions: int):
+    store = new_store(f"cluster://3/fanout{next(_id)}")
+    s = Session(store)
+    s.execute("create database fo")
+    s.execute("use fo")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double)")
+    rows = ", ".join(
+        f"({i}, {i % 7}, {i * 10}, {i}.25)" if i % 11 else
+        f"({i}, null, {i * 10}, null)"
+        for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create table d (d_k bigint primary key, d_f double)")
+    s.execute("insert into d values " +
+              ", ".join(f"({i}, {i}.5)" for i in range(7)))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("fo", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _counter(name):
+    return metrics.counter(f"distsql.columnar_{name}").value
+
+
+@pytest.fixture(scope="module")
+def single():
+    return _build(1)
+
+
+@pytest.mark.parametrize("n_regions", [2, 4, 8])
+def test_multi_region_parity(single, n_regions):
+    """Stacked per-region partials vs the single-region columnar path vs
+    the row protocol: row-for-row identical on every query shape."""
+    multi = _build(n_regions)
+    h0, p0, f0 = _counter("hits"), _counter("partials"), _counter(
+        "fallbacks")
+    got = [multi.execute(q)[0].values() for q in QUERIES]
+    assert _counter("hits") - h0 >= n_regions, \
+        "fan-out scans did not answer per-region columnar partials"
+    assert _counter("partials") - p0 >= n_regions
+    assert _counter("fallbacks") == f0, \
+        "a hinted region partial fell back to rows"
+    want = [single.execute(q)[0].values() for q in QUERIES]
+    for q, g, w in zip(QUERIES, got, want):
+        assert g == w, f"multi-region diverged from single-region on {q!r}"
+    multi.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        rows = [multi.execute(q)[0].values() for q in QUERIES]
+    finally:
+        multi.execute("set global tidb_tpu_columnar_scan = 1")
+    for q, g, r in zip(QUERIES, got, rows):
+        assert g == r, f"columnar fan-out diverged from row protocol {q!r}"
+
+
+def test_partial_combine_runs_device_side(single):
+    """The fused aggregate over a 4-region join merges per-region partial
+    states through the device combine (one combine per fusion)."""
+    from tidb_tpu.executor import fused_agg
+    multi = _build(4)
+    before = fused_agg.stats["partial_combines"]
+    got = multi.execute(JOIN_AGG_Q)[0].values()
+    assert fused_agg.stats["partial_combines"] > before, \
+        "multi-region fusion did not take the partial-combine path"
+    assert fused_agg.stats["last_combine_regions"] >= 4
+    assert got == single.execute(JOIN_AGG_Q)[0].values()
+    # grouped fusion combines too
+    before = fused_agg.stats["partial_combines"]
+    got = multi.execute(GROUPED_Q)[0].values()
+    assert fused_agg.stats["partial_combines"] > before
+    assert got == single.execute(GROUPED_Q)[0].values()
+
+
+class TestTopologyChangesMidScan:
+    """Region split / merge DURING the fan-out: the per-task worklist
+    retries on StaleEpoch and re-emits partials for the new region shape
+    without breaking plane alignment (each partial is self-contained)."""
+
+    def _with_mid_scan(self, mutate_after: int, mutate):
+        s = _build(4)
+        store = s.store
+        want = [s.execute(q)[0].values() for q in QUERIES]
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts):
+            state["n"] += 1
+            if state["n"] == mutate_after and not state["done"]:
+                state["done"] = True
+                mutate(store)
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        try:
+            got = [s.execute(q)[0].values() for q in QUERIES]
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"], "topology mutation never fired"
+        for q, g, w in zip(QUERIES, got, want):
+            assert g == w, f"mid-scan topology change diverged on {q!r}"
+        # and the post-mutation steady state still matches
+        after = [s.execute(q)[0].values() for q in QUERIES]
+        for q, a, w in zip(QUERIES, after, want):
+            assert a == w, f"post-mutation steady state diverged on {q!r}"
+
+    def test_split_mid_scan(self):
+        def split(store):
+            # split INSIDE the table's key space, between existing splits
+            from tidb_tpu.session import Session
+            s = Session(store)
+            tid = s.info_schema().table_by_name("fo", "t").info.id
+            store.cluster.split_keys([tc.encode_row_key(tid, 31),
+                                      tc.encode_row_key(tid, 171)])
+
+        self._with_mid_scan(2, split)
+
+    def test_merge_mid_scan(self):
+        def merge(store):
+            regions = store.cluster.regions
+            # merge the two middle regions (adjacent by construction)
+            for i in range(len(regions) - 1):
+                if regions[i].start:   # skip the leading region
+                    store.cluster.merge(regions[i].region_id,
+                                        regions[i + 1].region_id)
+                    return
+
+        self._with_mid_scan(2, merge)
+
+
+def test_mixed_response_counts_per_partial():
+    """A response where ONE region falls back to rows (u64 values above
+    the int64 plane live only in that region) counts hits for the
+    columnar partials AND fallbacks for the row partial on the SAME
+    request, and every result still matches the row protocol."""
+    store = new_store(f"cluster://3/fanmix{next(_id)}")
+    s = Session(store)
+    s.execute("create database fm")
+    s.execute("use fm")
+    s.execute("create table t (id bigint primary key, u bigint unsigned, "
+              "k bigint)")
+    rows = ", ".join(f"({i}, {i}, {i % 3})" for i in range(1, 101))
+    s.execute(f"insert into t values {rows}")
+    # the poison value lives in the LAST region only
+    s.execute("insert into t values (200, 9223372036854775813, 1)")
+    s.execute("create table d (d_k bigint primary key)")
+    s.execute("insert into d values (0), (1), (2)")
+    tid = s.info_schema().table_by_name("fm", "t").info.id
+    store.cluster.split_keys([tc.encode_row_key(tid, 40),
+                              tc.encode_row_key(tid, 80),
+                              tc.encode_row_key(tid, 120)])
+    q = "select t.id, t.u from t join d on t.k = d.d_k order by t.id"
+    h0, f0 = _counter("hits"), _counter("fallbacks")
+    got = s.execute(q)[0].values()
+    assert _counter("hits") - h0 >= 3, \
+        "clean regions did not answer columnar partials"
+    assert _counter("fallbacks") - f0 >= 1, \
+        "the u64-poisoned region did not count a row fallback"
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    assert s.execute(q)[0].values() == got
+    assert len(got) == 101
+
+
+class TestU64PackRegression:
+    """Seed bug: unsigned bigint above int64 range broke the columnar
+    pack (Python path OverflowError, native path silent wrap). Both
+    paths must raise TypeError_ → CPU fallback, like out-of-scale
+    decimals."""
+
+    BIG = 9223372036854775813          # i64max + 6
+    ROWS = ("(1, 5), (2, 9223372036854775813), "
+            "(3, 18446744073709551615), (4, null)")
+
+    def _tpu_session(self):
+        from tidb_tpu.ops import TpuClient
+        store = new_store(f"memory://u64pack{next(_id)}")
+        store.set_client(TpuClient(store, dispatch_floor_rows=0))
+        s = Session(store)
+        s.execute("create database u; use u")
+        s.execute("create table t (id bigint primary key, "
+                  "u bigint unsigned)")
+        s.execute(f"insert into t values {self.ROWS}")
+        return s
+
+    WANT_MAX = [[4, 18446744073709551615]]
+
+    def test_native_pack_path_falls_back(self):
+        s = self._tpu_session()
+        client = s.store.get_client()
+        f0 = client.stats["cpu_fallbacks"]
+        assert s.execute("select count(*), max(u) from t")[0].values() \
+            == self.WANT_MAX
+        assert client.stats["cpu_fallbacks"] > f0, \
+            "u64 overflow did not take the CPU fallback (native pack)"
+        assert s.execute("select u from t where u > 10 order by id")[0] \
+            .values() == [[self.BIG], [18446744073709551615]]
+
+    def test_python_pack_path_falls_back(self):
+        import tidb_tpu.ops.nativepack as npk
+        s = self._tpu_session()
+        client = s.store.get_client()
+        orig = npk.scan_rows
+        npk.scan_rows = lambda *a, **k: None   # force the Python pack
+        try:
+            f0 = client.stats["cpu_fallbacks"]
+            assert s.execute("select count(*), max(u) from t")[0] \
+                .values() == self.WANT_MAX
+            assert client.stats["cpu_fallbacks"] > f0, \
+                "u64 overflow did not take the CPU fallback (python pack)"
+        finally:
+            npk.scan_rows = orig
+
+    def test_region_pack_falls_back_to_rows(self):
+        """The per-region columnar engine takes the same TypeError_ →
+        row-handler fallback (counted as a per-partial fallback)."""
+        store = new_store(f"cluster://3/u64r{next(_id)}")
+        s = Session(store)
+        s.execute("create database u; use u")
+        s.execute("create table t (id bigint primary key, "
+                  "u bigint unsigned, k bigint)")
+        s.execute("insert into t values (1, 9223372036854775813, 1), "
+                  "(2, 7, 1)")
+        s.execute("create table d (d_k bigint primary key)")
+        s.execute("insert into d values (1)")
+        f0 = _counter("fallbacks")
+        got = s.execute("select t.id, t.u from t join d on t.k = d.d_k "
+                        "order by t.id")[0].values()
+        assert got == [[1, self.BIG], [2, 7]]
+        assert _counter("fallbacks") > f0
+
+
+def test_in_proc_single_partial_unchanged():
+    """The localstore TpuClient response stays a single partial: one hit,
+    one partial per hinted scan (back-compat for the PR-2 contract)."""
+    from tidb_tpu.ops import TpuClient
+    store = new_store(f"memory://fanone{next(_id)}")
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
+    s = Session(store)
+    s.execute("create database o; use o")
+    s.execute("create table t (id bigint primary key, k bigint)")
+    s.execute("insert into t values " +
+              ", ".join(f"({i}, {i % 3})" for i in range(1, 40)))
+    s.execute("create table d (d_k bigint primary key)")
+    s.execute("insert into d values (0), (1), (2)")
+    h0, p0 = _counter("hits"), _counter("partials")
+    s.execute("select count(*) from t join d on t.k = d.d_k")
+    assert _counter("hits") - h0 == 2        # one per scan side
+    assert _counter("partials") - p0 == 2

@@ -132,6 +132,16 @@ class RpcHandler:
             if hi is None or lo < hi:
                 clipped.append(KeyRange(lo, hi))
         snapshot = _MvccSnapshotView(self.mvcc, read_ts)
+        if getattr(sel, "columnar_hint", False):
+            # columnar channel across the fan-out: THIS region packs its
+            # clipped ranges into planes and answers with a columnar
+            # partial (copr.columnar_region); shapes it cannot express
+            # exactly fall through to the row handler for this region
+            # only — the client counts the channel per PARTIAL
+            from tidb_tpu.copr.columnar_region import handle_columnar_scan
+            resp = handle_columnar_scan(snapshot, sel, clipped)
+            if resp is not None:
+                return resp
         return handle_request(snapshot, sel, clipped)
 
 

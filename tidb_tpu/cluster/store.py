@@ -120,6 +120,14 @@ class DistCoprClient(kv.Client):
 
     def __init__(self, store: "DistStore"):
         self.store = store
+        # columnar result channel across the fan-out: with the hint set
+        # each region answers a ColumnarScanResult PARTIAL instead of
+        # chunk rows (copr.columnar_region). SET GLOBAL
+        # tidb_tpu_columnar_scan = 0 pins every region back to the row
+        # protocol — same store-level resolution contract as TpuClient.
+        from tidb_tpu.sessionctx import store_bool_sysvar
+        self.columnar_scan = store_bool_sysvar(store,
+                                               "tidb_tpu_columnar_scan")
 
     def support_request_type(self, req_type: int, sub_type) -> bool:
         if req_type not in (kv.REQ_TYPE_SELECT, kv.REQ_TYPE_INDEX):
@@ -131,11 +139,30 @@ class DistCoprClient(kv.Client):
 
     def send(self, req: kv.Request) -> kv.Response:
         sel: SelectRequest = req.data
+        if getattr(sel, "columnar_hint", False) and not self.columnar_scan:
+            # kill switch: strip the hint so every region answers rows —
+            # on a COPY, the executor's request object is not ours to edit
+            import dataclasses
+            sel = dataclasses.replace(sel, columnar_hint=False)
         ranges = list(req.key_ranges)
         desc = bool(req.desc or sel.desc)
+        # buildCopTasks (store/tikv/coprocessor.go:216): pre-split each
+        # range into per-REGION segments so the worker pool fans out one
+        # task per region instead of one per client range (a whole-table
+        # scan is ONE range — without the split it would serve all
+        # regions sequentially). Region boundaries may go stale between
+        # split and execution; each task's worklist re-resolves per
+        # attempt, so a mid-scan split/merge only changes how many
+        # partials a task emits, never their combined coverage.
+        tasks = []
+        for rg in ranges:
+            for _region, lo, hi in self.store.cache.split_range_by_region(
+                    rg.start, rg.end):
+                tasks.append(kv.KeyRange(lo, hi))
         # per-range results still come back low→high per region; the desc
         # ordering applies across tasks
-        tasks = list(reversed(ranges)) if desc else ranges
+        if desc:
+            tasks = list(reversed(tasks))
 
         def run(rg: kv.KeyRange):
             out = self._exec_range(rg, sel)
@@ -149,9 +176,16 @@ class DistCoprClient(kv.Client):
             return _ListResponse(responses)
         # copIterator (store/tikv/coprocessor.go:305): worker threads fan
         # out per task, results stream back IN TASK ORDER so keep_order
-        # scans stay sorted while later regions fetch in the background
+        # scans stay sorted while later regions fetch in the background.
+        # Scalar-aggregate responses whose FINAL merge is provably
+        # arrival-order independent stream in COMPLETION order instead —
+        # the consumer never waits on a straggler region it doesn't need
+        # first ("region order only when the consumer needs sorted rows")
+        ordered = bool(req.keep_order
+                       or not _commutative_scalar_agg(sel))
         return _PipelinedResponse(tasks, run,
-                                  min(concurrency, len(tasks)))
+                                  min(concurrency, len(tasks)),
+                                  ordered=ordered)
 
     def _exec_range(self, rg: kv.KeyRange, sel: SelectRequest):
         """Worklist execution of one key range: each step serves the prefix
@@ -203,6 +237,39 @@ class DistCoprClient(kv.Client):
             cursor = seg_end
 
 
+def _commutative_scalar_agg(sel: SelectRequest) -> bool:
+    """True only for no-group-by aggregate requests whose FinalMode merge
+    cannot observe partial ARRIVAL order: COUNT, and SUM/AVG/MIN/MAX over
+    integer columns. Everything else stays in task order — float partial
+    sums re-associate the rounding sequence; MIN/MAX keep the FIRST-SEEN
+    value on compare-equal ties, so kinds with distinct-but-equal
+    representations (-0.0 vs 0.0 floats, decimal scales 1.0 vs 1.00,
+    *_ci strings) are order-sensitive too; first_row keeps the first
+    partial seen; group_concat appends buffers in arrival order; and
+    distinct merges are kept conservative."""
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.copr.proto import ExprType
+    if not sel.aggregates or sel.group_by or sel.having is not None:
+        return False
+    src = sel.table_info if sel.table_info is not None else sel.index_info
+    cols = {c.column_id: c for c in src.columns} if src is not None else {}
+    for e in sel.aggregates:
+        if e.distinct:
+            return False
+        if e.tp == ExprType.AGG_COUNT:
+            continue
+        if e.tp in (ExprType.AGG_SUM, ExprType.AGG_AVG,
+                    ExprType.AGG_MIN, ExprType.AGG_MAX):
+            arg = e.children[0] if e.children else None
+            if arg is not None and arg.tp == ExprType.COLUMN_REF:
+                c = cols.get(arg.val)
+                if c is not None and (c.tp in my.INTEGER_TYPES
+                                      or c.tp == my.TypeBit):
+                    continue   # exact, representation-unique: any order
+        return False
+    return True
+
+
 class _ListResponse(kv.Response):
     def __init__(self, responses):
         self._responses = list(responses)
@@ -215,16 +282,28 @@ class _ListResponse(kv.Response):
         self._i += 1
         return r
 
+    def drain_all(self):
+        """Every remaining partial, in task order."""
+        out = self._responses[self._i:]
+        self._i = len(self._responses)
+        return out
+
 
 class _PipelinedResponse(kv.Response):
     """Streaming fan-out: worker threads execute tasks concurrently, the
     consumer receives completed task results in TASK ORDER (the reference's
     ordered copIterator.Next with its buffered channel,
-    store/tikv/coprocessor.go:348). A worker error surfaces on next()."""
+    store/tikv/coprocessor.go:348) — or, with ordered=False (scalar
+    aggregates, whose partials merge commutatively), in COMPLETION order
+    so no consumer stalls on a straggler region. A worker error surfaces
+    on next()."""
 
-    def __init__(self, tasks, run, concurrency: int):
+    def __init__(self, tasks, run, concurrency: int, ordered: bool = True):
         self._results: dict[int, list] = {}
         self._next_task = 0
+        self._consumed = 0
+        self._ordered = ordered
+        self._remaining = set(range(len(tasks)))   # not yet consumed
         self._n = len(tasks)
         self._cv = threading.Condition()
         self._err: BaseException | None = None
@@ -248,7 +327,7 @@ class _PipelinedResponse(kv.Response):
                     return
                 idx, rg = nxt
                 with self._cv:
-                    while (idx >= self._next_task + self._window
+                    while (idx >= self._consumed + self._window
                            and self._err is None and not self._abandoned):
                         self._cv.wait()
                     if self._err is not None or self._abandoned:
@@ -276,6 +355,34 @@ class _PipelinedResponse(kv.Response):
             self._abandoned = True
             self._cv.notify_all()
 
+    def drain_all(self):
+        """Block until every remaining task completes and return ALL
+        their partials in TASK order. The backpressure window lifts for
+        the duration — the consumer wants everything, so workers run
+        free; completion order does not matter because partials are
+        reassembled by task index (this is how the columnar channel
+        collects per-region partials concurrently while the stacked
+        plane order stays the row protocol's scan order)."""
+        out = self._buf[self._cursor:]
+        self._buf, self._cursor = [], 0
+        with self._cv:
+            self._window = self._n + 1     # lift backpressure
+            self._cv.notify_all()
+            while True:
+                if self._err is not None:
+                    raise self._err
+                if self._abandoned or \
+                        all(i in self._results for i in self._remaining):
+                    break
+                self._cv.wait()
+            for i in sorted(self._remaining):
+                got = self._results.pop(i, None)
+                if got is not None:   # abandoned fan-outs return what ran
+                    out.extend(got)
+            self._remaining.clear()
+            self._next_task = self._consumed = self._n
+        return out
+
     def next(self):
         if self._cursor < len(self._buf):
             r = self._buf[self._cursor]
@@ -285,12 +392,21 @@ class _PipelinedResponse(kv.Response):
             while True:
                 if self._err is not None:
                     raise self._err
-                if self._next_task >= self._n:
+                if not self._remaining:
                     return None
-                if self._next_task in self._results:
-                    self._buf = self._results.pop(self._next_task)
+                take = None
+                if self._ordered:
+                    if self._next_task in self._results:
+                        take = self._next_task
+                        self._next_task += 1
+                elif self._results:
+                    # completion order: dict preserves insertion order
+                    take = next(iter(self._results))
+                if take is not None:
+                    self._buf = self._results.pop(take)
                     self._cursor = 0
-                    self._next_task += 1
+                    self._remaining.discard(take)
+                    self._consumed += 1
                     self._cv.notify_all()   # window advanced: wake workers
                     break
                 self._cv.wait()

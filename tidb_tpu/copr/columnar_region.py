@@ -1,0 +1,125 @@
+"""Per-region COLUMNAR coprocessor results: the region-side half of the
+columnar channel across the cluster store's fan-out.
+
+A scan request carrying columnar_hint used to be answered columnar only
+by the in-proc TpuClient (one response for the whole scan). Here each
+REGION answers the hint itself: its share of the key ranges packs into a
+ColumnBatch (the same native-C row→plane decode the TPU tier uses), the
+pushed filter evaluates vectorized over the planes (ops.exprc — the same
+lowering the device kernels trace), and the response ships the planes +
+selection index as a ColumnarScanResult PARTIAL. The client stacks the
+per-region partials (ops.columnar.ColumnarPartialSet) so a multi-region
+scan→join→agg stays columnar end to end, and the SQL-side fused
+aggregate merges per-region partial states with the mesh combine algebra
+(executor.fused_agg). Reference: the per-region coprocessor tasks of
+store/tikv/coprocessor.go:305 — with planes instead of chunk rows.
+
+Anything this engine cannot express EXACTLY returns None and the row
+handler (copr.region_handler) answers that region instead — including
+TypeError_ packs (unsigned bigint above the int64 plane, out-of-scale
+decimals): per-region fallback, counted per PARTIAL by the client.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tidb_tpu import errors
+from tidb_tpu.copr.proto import ExprType, SelectRequest, SelectResponse
+from tidb_tpu.kv.kv import KeyRange
+from tidb_tpu.ops import columnar as col
+
+
+def handle_columnar_scan(snapshot, sel: SelectRequest,
+                         ranges: list[KeyRange]) -> SelectResponse | None:
+    """One region's share of a columnar_hint scan as a columnar partial,
+    or None → the caller runs the row handler for this region."""
+    if sel.table_info is None or sel.is_agg():
+        # index scans and pushed aggregates keep the row/partial-row
+        # protocol (columnar index results are a ROADMAP open item)
+        return None
+    if sel.order_by and (sel.desc or sel.limit is None):
+        return None
+    columns = sel.table_info.columns
+    defaults = {c.column_id: c.default_val for c in columns
+                if c.default_val is not None}
+    try:
+        batch = col.pack_ranges(snapshot, sel.table_info.table_id,
+                                columns, ranges, defaults)
+        mask = _filter_mask(sel, batch)
+    except errors.TypeError_:
+        return None      # no exact plane mapping: the CPU engine answers
+    except errors.TiDBError:
+        return None
+    if mask is None:
+        return None
+    if sel.order_by:
+        idx = _topn_select(sel, batch, mask)
+        if idx is None:
+            return None
+    else:
+        idx = np.nonzero(mask)[0]
+        if sel.desc:
+            idx = idx[::-1]
+        if sel.limit is not None:
+            idx = idx[: sel.limit]
+    return SelectResponse(columnar=col.ColumnarScanResult(
+        batch, np.asarray(idx, dtype=np.int64), list(columns)))
+
+
+def _filter_mask(sel: SelectRequest, batch: col.ColumnBatch):
+    """Live-row mask with the pushed where-filter applied vectorized, or
+    None when the filter does not lower (row handler answers)."""
+    mask = batch.row_mask()
+    if sel.where is None:
+        return mask
+    try:
+        from tidb_tpu.ops.exprc import Unsupported, compile_expr
+    except ImportError:      # jax-free deployment: rows answer
+        return None
+    try:
+        compiled = compile_expr(sel.where, batch)
+    except (Unsupported, errors.TypeError_):
+        return None
+    planes = {cid: (cd.values, cd.valid)
+              for cid, cd in batch.columns.items()}
+    wv, wva = compiled(planes)
+    wv, wva = np.asarray(wv), np.asarray(wva)
+    truth = wv if wv.dtype == np.bool_ else (wv != 0)
+    return mask & wva & truth
+
+
+def _topn_select(sel: SelectRequest, batch: col.ColumnBatch,
+                 mask: np.ndarray):
+    """Per-region top-`limit` row indices for a pushed TopN, sorted by
+    the by-items with scan-position tiebreak — the same bounded candidate
+    set (and the same tie semantics) the row handler's heap keeps, so the
+    SQL-side merge sees identical partials. None → row handler."""
+    sort_keys = []       # least-significant first (np.lexsort order)
+    for item in reversed(sel.order_by):
+        e = item.expr
+        if e.tp != ExprType.COLUMN_REF:
+            return None
+        cd = batch.columns.get(e.val)
+        if cd is None:
+            return None
+        vals, va = cd.values, cd.valid
+        if cd.kind == col.K_F64:
+            vo = np.where(vals == 0.0, 0.0, vals)   # -0.0 ties +0.0
+            if item.desc:
+                vo = -vo
+        else:
+            # int64 planes (ints, times, durations, dict codes, scaled
+            # decimals) order directly; desc via bitwise-not (exact at
+            # I64_MIN, where unary minus would wrap)
+            vo = ~vals if item.desc else vals
+        # NULL ordering: asc → NULLs first, desc → NULLs last (MySQL)
+        nullk = va.astype(np.int8) if not item.desc \
+            else (~va).astype(np.int8)
+        sort_keys.append(np.where(va, vo, np.zeros_like(vo)))
+        sort_keys.append(nullk)
+    sort_keys.append(~mask)   # dead rows last; stable sort keeps
+    #                           scan-position order among ties
+    order = np.lexsort(sort_keys)
+    n_live = int(np.count_nonzero(mask))
+    return order[: min(sel.limit, n_live)]

@@ -197,11 +197,14 @@ class Chunk:
 class SelectResponse:
     chunks: list[Chunk] = field(default_factory=list)
     error: str | None = None
-    # columnar fast path (TPU engine, requests with columnar_hint): the
-    # scan's planes + selection index (ops.columnar.ColumnarScanResult),
+    # columnar fast path (requests with columnar_hint): the scan's
+    # planes + selection index (ops.columnar.ColumnarScanResult),
     # bypassing row-chunk encode/decode entirely — plane-aware consumers
     # (device join, fused aggregates, TopN) read columns straight off it.
-    # None → use chunks.
+    # The in-proc TPU engine answers ONE columnar response per request;
+    # a cluster fan-out answers one columnar PARTIAL per region task and
+    # the client stacks them (ops.columnar.ColumnarPartialSet), so this
+    # field is per-partial, not per-request. None → use chunks.
     columnar: object | None = None
     # in-proc row fast path (CPU engine scans): (handle, datums) pairs in
     # scan order, skipping the per-row encode_value/decode_all round trip

@@ -20,25 +20,40 @@ from tidb_tpu.types.field_type import FieldType
 # monotonic per-THREAD columnar counts: connections execute statements on
 # their own threads, so deltas of these attribute hits/fallbacks to the
 # right statement in the slow-query log (the process-global metrics
-# counters stay authoritative for SHOW STATUS / bench)
+# counters stay authoritative for SHOW STATUS / bench). Counting is per
+# PARTIAL, not per request: a multi-region response where some regions
+# answered planes and some fell back to rows shows BOTH sides in the
+# same statement's tallies.
 _thread_columnar = threading.local()
 
 
-def thread_columnar_counts() -> tuple[int, int]:
-    """(hits, fallbacks) tallied on this thread so far — snapshot before
-    a statement and diff after."""
+def thread_columnar_counts() -> tuple[int, int, int]:
+    """(hits, fallbacks, partials) tallied on this thread so far —
+    snapshot before a statement and diff after. hits/fallbacks count
+    per PARTIAL which channel answered; partials counts the region
+    partials of fully-columnar responses (1 for in-proc single-partial
+    responses, ≥ the region count across a cluster fan-out)."""
     return (getattr(_thread_columnar, "hits", 0),
-            getattr(_thread_columnar, "fallbacks", 0))
+            getattr(_thread_columnar, "fallbacks", 0),
+            getattr(_thread_columnar, "partials", 0))
+
+
+def _count(attr: str, n: int) -> None:
+    if n:
+        from tidb_tpu import metrics
+        metrics.counter(f"distsql.columnar_{attr}").inc(n)
+        setattr(_thread_columnar, attr,
+                getattr(_thread_columnar, attr, 0) + n)
 
 
 class SelectResult:
     """Iterates (handle, typed row) across all regions of one request.
 
-    Plane-aware consumers ask columnar() FIRST: a single-partial response
-    carrying a columnar payload (TpuClient answering a columnar_hint
-    request) hands the scan's planes over without any row ever being
-    encoded or decoded; everything else falls back to the row iterator.
-    """
+    Plane-aware consumers ask columnar() FIRST: a response whose partials
+    all carry columnar payloads (the in-proc TpuClient's single partial,
+    or one ColumnarScanResult per region of a cluster fan-out) hands the
+    scan's planes over without any row ever being encoded or decoded;
+    everything else falls back to the row iterator."""
 
     def __init__(self, resp: kv.Response, field_types: list[FieldType],
                  columnar_hinted: bool = False):
@@ -47,6 +62,7 @@ class SelectResult:
         self._rows = iter(())
         self._done = False
         self._hinted = columnar_hinted
+        self._attribute_parts = False   # row-fallback: count per partial
         self._decode_info = None
 
     def __iter__(self):
@@ -56,34 +72,70 @@ class SelectResult:
         self._resp.close()
 
     def columnar(self):
-        """The response's columnar plane payload (ops.columnar.
-        ColumnarScanResult), or None — rows then flow through the
-        iterator as usual. Counts distsql.columnar_hits /
-        distsql.columnar_fallbacks (a fallback is a hinted request the
-        responder answered with rows: CPU engine, below-floor route,
-        kill switch)."""
-        from tidb_tpu import metrics
-        if not self._done:
-            part = self._resp.next()
-            if part is None:
-                self._done = True
-            elif part.error:
+        """The response's columnar plane payload — a single partial's
+        ops.columnar.ColumnarScanResult, or a ColumnarPartialSet stacking
+        the per-region partials of a cluster fan-out — or None: rows then
+        flow through the iterator as usual (including any columnar
+        partials of a MIXED response, materialized from their planes).
+
+        Counts distsql.columnar_hits / columnar_fallbacks per PARTIAL (a
+        fallback is a hinted partial the region answered with rows: CPU
+        engine, below-floor route, kill switch, shapes the region engine
+        can't plane) and distsql.columnar_partials for fully-columnar
+        responses. Region partials are collected CONCURRENTLY
+        (Response.drain_all lifts the fan-out's backpressure window) and
+        reassembled in task order, so the stacked row order equals the
+        row protocol's scan order."""
+        if self._done:
+            if self._hinted:
+                _count("fallbacks", 1)
+            return None
+        first = self._resp.next()
+        if first is None:
+            # zero partials (empty range set): nothing answered rows, so
+            # per-partial attribution counts nothing
+            self._done = True
+            return None
+        if first.error:
+            raise errors.ExecError(f"coprocessor error: {first.error}")
+        if getattr(first, "columnar", None) is None:
+            # row-protocol first partial (CPU engine, below-floor route,
+            # kill switch): keep PR-2's STREAMING row path — remaining
+            # partials arrive one per __next__ fetch under the fan-out's
+            # bounded window (and close() can still abandon workers on
+            # an early LIMIT); __next__ attributes those per partial
+            if self._hinted:
+                _count("fallbacks", 1)
+                self._attribute_parts = True
+            self._rows = iter_response_rows(first)
+            return None
+        # columnar first partial: the consumer wants planes, which need
+        # the full region set — drain the rest concurrently (the window
+        # lifts) and stack in task order
+        drain = getattr(self._resp, "drain_all", None)
+        parts = [first] + (drain() if drain is not None else
+                           list(iter(self._resp.next, None)))
+        self._done = True
+        for part in parts:
+            if part.error:
                 raise errors.ExecError(f"coprocessor error: {part.error}")
-            else:
-                payload = getattr(part, "columnar", None)
-                if payload is not None:
-                    # single-partial contract: the TPU engine answers one
-                    # response per request, and only it emits payloads
-                    self._done = True
-                    metrics.counter("distsql.columnar_hits").inc()
-                    _thread_columnar.hits = getattr(
-                        _thread_columnar, "hits", 0) + 1
-                    return payload
-                self._rows = iter_response_rows(part)
+        payloads = [getattr(p, "columnar", None) for p in parts]
+        n_col = sum(1 for p in payloads if p is not None)
+        _count("hits", n_col)
+        if n_col == len(parts):
+            _count("partials", n_col)
+            if n_col == 1:
+                return payloads[0]
+            from tidb_tpu.ops.columnar import ColumnarPartialSet
+            return ColumnarPartialSet(payloads)
+        # MIXED response (some regions columnar, some row-fallback): the
+        # row iterator serves everything — columnar partials materialize
+        # from their planes; attribution stays per partial
         if self._hinted:
-            metrics.counter("distsql.columnar_fallbacks").inc()
-            _thread_columnar.fallbacks = getattr(
-                _thread_columnar, "fallbacks", 0) + 1
+            _count("fallbacks", len(parts) - n_col)
+        import itertools
+        self._rows = itertools.chain.from_iterable(
+            iter_response_rows(p) for p in parts)
         return None
 
     def __next__(self):
@@ -98,6 +150,12 @@ class SelectResult:
                 raise StopIteration
             if part.error:
                 raise errors.ExecError(f"coprocessor error: {part.error}")
+            if self._attribute_parts:
+                # columnar() fell back on a row-answered first partial;
+                # later partials stream through here — keep the
+                # per-PARTIAL channel attribution as they arrive
+                _count("fallbacks" if getattr(part, "columnar", None)
+                       is None else "hits", 1)
             self._rows = iter_response_rows(part)
 
     def _decode(self, datums: list[Datum]) -> list[Datum]:

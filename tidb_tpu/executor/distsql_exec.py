@@ -123,10 +123,13 @@ class XSelectTableExec(Executor):
 
     Plane-aware parents (device join, fused aggregates, TopN) call
     columnar_result() before any next(): the request then advertises
-    columnar_hint and, when the TPU engine answers with the scan's
-    planes, consumers read columns without a single row being encoded,
-    decoded, or re-extracted. next() still serves rows either way —
-    a consumer that bails materializes them from the same planes."""
+    columnar_hint and, when the responder answers with the scan's planes
+    — the in-proc TPU engine's single payload, or the per-region
+    ColumnarScanResult partials of a cluster fan-out stacked into one
+    ColumnarPartialSet — consumers read columns without a single row
+    being encoded, decoded, or re-extracted. next() still serves rows
+    either way — a consumer that bails materializes them from the same
+    planes."""
 
     def __init__(self, scan: PhysicalTableScan, ctx):
         self.scan_plan = scan

@@ -576,19 +576,45 @@ class HashJoinExec(Executor):
                     exc_info=True)
                 self.join_stats["device_error"] = True
         self.join_stats["path"] = "numpy"
-        lrows, rrows = lside.rows(), rside.rows()
+        # host sort-merge, pairs expanded VECTORIZED (the same
+        # offsets/searchsorted expansion the device probe kernel runs) —
+        # the numpy path emits the same columnar DeviceJoinResult as the
+        # device path, so join→agg fusion (and the multi-region partial
+        # combine) applies below the dispatch floor and on stores with
+        # no TPU client installed; row consumers stream via chunked
+        # assembly exactly like the device path
+        t0 = time.time()
         order = np.argsort(rkey[rvalid], kind="stable")
-        ridx = np.flatnonzero(rvalid)[order].tolist()
+        ridx = np.flatnonzero(rvalid)[order]
         rs = rkey[rvalid][order]
         lo = np.searchsorted(rs, lkey, side="left")
         hi = np.searchsorted(rs, lkey, side="right")
         hi = np.where(lvalid, hi, lo)      # NULL/unmatchable: empty range
-        # STREAMING emission: rows assemble per next() pull, so a LIMIT
-        # above the join stops after a handful of rows instead of paying
-        # for (and holding) the full join output
-        self._vector_iter = self._vector_stream(
-            lrows, rrows, ridx, lo.tolist(), hi.tolist(), left_ok)
+        counts = (hi - lo).astype(np.int64)
+        total = int(counts.sum())
+        if total > self._NUMPY_PAIR_CAP:
+            # pathological high-duplicate key (pair blow-up): the eager
+            # expansion would hold O(total) index arrays — hand the
+            # already-drained sides to the streaming dict path instead,
+            # which emits per-left-row and never holds the full output
+            self.join_stats["path"] = "dict"
+            self._prebuilt_right = rside.rows()
+            self._left_iter = iter(lside.rows())
+            return False
+        li = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+        if total:
+            within = np.arange(total, dtype=np.int64) - \
+                np.repeat(np.cumsum(counts) - counts, counts)
+            ri = ridx[lo[li] + within]
+        else:
+            ri = np.zeros(0, np.int64)
+        self.join_stats["probe_s"] = time.time() - t0
+        self._finish_pairs(lside, rside, li, ri, left_ok)
         return True
+
+    # eager numpy pair-expansion ceiling (~0.5 GB of index arrays); a
+    # join whose match count exceeds it streams through the dict path
+    _NUMPY_PAIR_CAP = 1 << 25
 
     def _start_device(self, lside, rside, lkey, lvalid, rkey, rvalid,
                       left_ok) -> None:
@@ -597,13 +623,21 @@ class HashJoinExec(Executor):
         Rows are NOT materialized here — an aggregate parent fuses over
         the gathered planes instead (executor.fused_agg), and columnar
         scan sides keep even the SCAN rows unmaterialized."""
-        import numpy as np
-        from tidb_tpu.ops import columnar as col_mod
         from tidb_tpu.ops import kernels
-        from tidb_tpu.plan.plans import Join
         stats = self.join_stats
         li, ri = kernels.join_match_pairs(lkey, lvalid, rkey, rvalid,
                                           stats=stats)
+        self._finish_pairs(lside, rside, li, ri, left_ok)
+        stats["path"] = "device"
+
+    def _finish_pairs(self, lside, rside, li, ri, left_ok) -> None:
+        """Shared tail of the vector paths: filter the match pairs
+        (left-side conditions, residual other_conditions), add LEFT
+        OUTER pads, and expose the columnar DeviceJoinResult."""
+        import numpy as np
+        from tidb_tpu.ops import columnar as col_mod
+        from tidb_tpu.plan.plans import Join
+        stats = self.join_stats
         t0 = time.time()
         if left_ok is not None:
             lok = np.asarray(left_ok, dtype=bool)
@@ -612,11 +646,20 @@ class HashJoinExec(Executor):
         other = self.plan.other_conditions
         if other:
             # residual non-equi conditions need joined rows: materialize
-            # the matched pairs once, filter, keep the surviving pairs
-            pairs = col_mod.materialize_join_rows(
-                lside.rows(), rside.rows(), li, ri, self._right_width)
-            keep = np.fromiter((_conds_ok(other, row) for row in pairs),
-                               dtype=bool, count=len(pairs))
+            # matched pairs in CHUNKS, filter, keep surviving pairs —
+            # a duplicate-heavy key under the pair cap would otherwise
+            # hold tens of millions of joined rows simultaneously just
+            # to evaluate a filter that reads them once
+            lrows, rrows = lside.rows(), rside.rows()
+            keep = np.empty(len(li), dtype=bool)
+            chunk = 1 << 16
+            for s in range(0, len(li), chunk):
+                pairs = col_mod.materialize_join_rows(
+                    lrows, rrows, li[s:s + chunk], ri[s:s + chunk],
+                    self._right_width)
+                keep[s:s + chunk] = np.fromiter(
+                    (_conds_ok(other, row) for row in pairs),
+                    dtype=bool, count=len(pairs))
             li, ri = li[keep], ri[keep]
         if self.plan.join_type == Join.LEFT_OUTER:
             matched = np.bincount(li, minlength=len(lside))
@@ -631,39 +674,18 @@ class HashJoinExec(Executor):
         self._device = col_mod.DeviceJoinResult(
             lside, rside, li, ri, len(self.children[0].schema),
             self._right_width)
-        stats["path"] = "device"
-        stats["assemble_s"] = time.time() - t0
+        stats["assemble_s"] = stats.get("assemble_s", 0.0) + \
+            (time.time() - t0)
 
     def device_join_result(self):
         """Start the join (if needed) and expose its columnar result for
-        join→agg fusion; None when a non-device path answered. Reading
-        planes off the result does not materialize rows."""
+        join→agg fusion — either vector path (device kernels or numpy
+        sort-merge) emits one; None only when the dict path answered.
+        Reading planes off the result does not materialize rows."""
         if not self._vector_tried:
             self._vector_tried = True
             self._try_vector_join()
         return self._device
-
-    def _vector_stream(self, lrows, rrows, ridx, lo, hi, left_ok):
-        """Emit joined rows in left-scan order, matches in right-scan
-        order (= the dict path's order exactly)."""
-        from tidb_tpu.plan.plans import Join
-        other = self.plan.other_conditions
-        outer = self.plan.join_type == Join.LEFT_OUTER
-        pad = [NULL] * self._right_width
-        for i, lrow in enumerate(lrows):
-            if left_ok is not None and not left_ok[i]:
-                if outer:
-                    yield lrow + pad
-                continue
-            emitted = False
-            for p in range(lo[i], hi[i]):
-                joined = lrow + rrows[ridx[p]]
-                if other and not _conds_ok(other, joined):
-                    continue
-                emitted = True
-                yield joined
-            if outer and not emitted:
-                yield lrow + pad
 
     def next(self):
         if not self._vector_tried:

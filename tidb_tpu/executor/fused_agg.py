@@ -43,8 +43,71 @@ _FUSABLE = ("count", "sum", "avg", "min", "max", "first_row")
 
 # process-wide fusion tallies (bench/tests introspection): "fused" counts
 # aggregates answered from planes, "fallback" counts row-loop bail-outs
-# that had a device join available
-stats = {"fused": 0, "fallback": 0}
+# that had a device join available, "partial_combines" counts fusions
+# whose per-region partial states merged device-side
+stats = {"fused": 0, "fallback": 0, "partial_combines": 0,
+         "last_combine_regions": 0}
+
+
+class _RegionCombine:
+    """Collects per-REGION partial aggregate states ([R, G] stacks) from
+    every aggregate of one fusion and merges them in ONE device dispatch
+    (ops.kernels.combine_region_partials — count/sum → psum-shaped sum,
+    min/max/first-row-position → pmin/pmax over the region axis) with a
+    single packed readback for the whole result. The group-code space is
+    unified HOST-side before slicing (np.unique over the stacked group
+    planes), so per-region states are group-aligned by construction —
+    the same host-built-global-codes contract ColumnBatch.group_codes
+    keeps for the mesh."""
+
+    def __init__(self, slices: list[tuple[int, int]]):
+        self.slices = slices
+        self._states: list = []
+        self._ops: list[str] = []
+        self._results: list | None = None
+
+    def add(self, state_stack, op: str) -> int:
+        self._states.append(state_stack)
+        self._ops.append(op)
+        return len(self._states) - 1
+
+    def stack(self, G: int, init, dtype, fill) -> "object":
+        """[R, G] state stack initialized to the monoid identity; fill(
+        row, s, e) populates one region's partial state."""
+        out = np.full((len(self.slices), G), init, dtype)
+        for r, (s, e) in enumerate(self.slices):
+            fill(out[r], s, e)
+        return out
+
+    def run(self) -> None:
+        if not self._states:
+            return
+        from tidb_tpu.ops import kernels
+        self._results = kernels.combine_region_partials(self._states,
+                                                        self._ops)
+        stats["partial_combines"] += 1
+        stats["last_combine_regions"] = len(self.slices)
+
+    def get(self, idx: int):
+        return self._results[idx]
+
+
+def _region_combine_for(res) -> _RegionCombine | None:
+    """A combine context when `res` is a multi-region columnar result
+    (ColumnarPartialSet, or a DeviceJoinResult over one) and the device
+    tier is importable; None → the flat single-batch path answers (same
+    values — the combinable aggregates are order-insensitive exactly)."""
+    get = getattr(res, "region_slices", None)
+    if get is None:
+        return None
+    slices = get()
+    if not slices or len(slices) <= 1:
+        return None
+    try:
+        import jax  # noqa: F401 — device combine needs the TPU tier
+    except ImportError:
+        return None
+    return _RegionCombine(slices)
 
 
 def _is_ci(e) -> bool:
@@ -131,12 +194,16 @@ def _try_fused(agg):
         first_idx = np.zeros(1, dtype=np.int64)
         G = 1
 
+    combine = _region_combine_for(res)
     cols = []
     for f in agg.agg_funcs:
-        col_res = _fused_func(res, f, gid, G, first_idx, n)
+        col_res = _fused_func(res, f, gid, G, first_idx, n, combine)
         if col_res is None:
             return None
         cols.append(col_res)
+    if combine is not None:
+        combine.run()   # ONE dispatch + readback merges every state
+        cols = [c() if callable(c) else c for c in cols]
 
     emit = np.argsort(first_idx, kind="stable")
     join_stats = getattr(child, "join_stats", None)
@@ -188,9 +255,15 @@ def _arg_plane(res, f, n: int):
     return res.column_plane(arg.index)
 
 
-def _fused_func(res, f, gid, G: int, first_idx, n: int):
+def _fused_func(res, f, gid, G: int, first_idx, n: int,
+                combine: _RegionCombine | None = None):
     """Per-group result datums (unique-order indexing) for one aggregate,
-    or None to bail the whole fusion."""
+    or None to bail the whole fusion. With a `combine` context (multi-
+    region columnar input), the order-insensitive aggregates register
+    per-region partial states and return a THUNK that datum-izes the
+    device-combined arrays after combine.run(); float SUM/AVG stay on the
+    flat sequential np.add.at path — per-region partial float sums would
+    re-associate the row path's left-to-right rounding sequence."""
     name = f.name
     if name == "first_row":
         arg = f.args[0] if f.args else None
@@ -198,8 +271,22 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int):
             return [arg.value] * G
         if not isinstance(arg, Column):
             return None
-        return [res.datum_at(arg.index, int(first_idx[g]))
-                for g in range(G)]
+        if combine is None:
+            return [res.datum_at(arg.index, int(first_idx[g]))
+                    for g in range(G)]
+        # per-region first-position states, combined with pmin: the
+        # group's first contributing row is the min global position.
+        # first_idx already holds the same number (np.unique over the
+        # stacked planes), but the stacked host pass is exactly what a
+        # real mesh won't have — keeping first_row on the combine is
+        # what lets the same algebra ride ICI unchanged later
+        pos = combine.stack(
+            G, I64_MAX, np.int64,
+            lambda row, s, e: np.minimum.at(
+                row, gid[s:e], np.arange(s, e, dtype=np.int64)))
+        idx = combine.add(pos, "min")
+        return lambda: [res.datum_at(arg.index, int(combine.get(idx)[g]))
+                        for g in range(G)]
 
     plane = _arg_plane(res, f, n)
     if plane is None:
@@ -210,56 +297,103 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int):
         # duration, decimal, bit): the row loop answers
         return None
 
+    def counts(ok):
+        if combine is None:
+            return np.bincount(gid[ok], minlength=G)
+        state = combine.stack(
+            G, 0, np.int64,
+            lambda row, s, e: np.add.at(
+                row, gid[s:e][ok[s:e]], 1))
+        return combine.add(state, "sum")   # psum over the region axis
+
     if name == "count":
-        cnt = np.bincount(gid[valid], minlength=G)
-        return [Datum.i64(int(c)) for c in cnt]
+        cnt = counts(valid)
+        if combine is None:
+            return [Datum.i64(int(c)) for c in cnt]
+        return lambda: [Datum.i64(int(c)) for c in combine.get(cnt)]
 
     if kind == "str":
         return None   # string min/max needs collation-aware compares
     ok = valid
-    cnt = np.bincount(gid[ok], minlength=G)
 
     if name in ("sum", "avg"):
-        vk, gk = vals[ok], gid[ok]
         if kind == "i64":
+            vk = vals[ok]
             if len(vk):
                 mx = max(abs(int(vk.min())), abs(int(vk.max())))
                 if mx and mx * len(vk) >= (1 << 63):
-                    return None   # could wrap: the Decimal row path answers
+                    return None   # could wrap: the Decimal row path
+                    # answers (the bound also covers every per-region
+                    # partial sum, so the device combine cannot wrap)
+            if combine is not None:
+                cnt_i = counts(ok)
+                sum_state = combine.stack(
+                    G, 0, np.int64,
+                    lambda row, s, e: np.add.at(
+                        row, gid[s:e][ok[s:e]], vals[s:e][ok[s:e]]))
+                sum_i = combine.add(sum_state, "sum")
+                return lambda: _sum_avg_datums(
+                    name, "i64", combine.get(cnt_i), combine.get(sum_i),
+                    G)
+            cnt = np.bincount(gid[ok], minlength=G)
             sums = np.zeros(G, np.int64)
-            np.add.at(sums, gk, vk)
+            np.add.at(sums, gid[ok], vk)
         else:
+            # float sums accumulate in ROW order (np.add.at, unbuffered)
+            # even for multi-region inputs: exactness beats the combine
             if _has_neg_zero(vals, ok):
                 return None
+            cnt = np.bincount(gid[ok], minlength=G)
             sums = np.zeros(G, np.float64)
-            np.add.at(sums, gk, vk)
-        out = []
-        for g in range(G):
-            c = int(cnt[g])
-            if c == 0:
-                out.append(NULL)
-            elif name == "sum":
-                out.append(Datum.f64(float(sums[g])) if kind == "f64"
-                           else Datum.dec(Decimal(int(sums[g]))))
-            else:
-                out.append(Datum.f64(float(sums[g]) / c) if kind == "f64"
-                           else Datum.dec(Decimal(int(sums[g]))
-                                          / Decimal(c)))
-        return out
+            np.add.at(sums, gid[ok], vals[ok])
+        return _sum_avg_datums(name, kind, cnt, sums, G)
 
     if name in ("min", "max"):
         is_min = name == "min"
         if kind == "i64":
             init = I64_MAX if is_min else I64_MIN
-            red = np.full(G, init, np.int64)
+            dtype = np.int64
         else:
             if _has_neg_zero(vals, ok):
                 return None
-            red = np.full(G, np.inf if is_min else -np.inf, np.float64)
-        (np.minimum if is_min else np.maximum).at(red, gid[ok], vals[ok])
-        return [NULL if cnt[g] == 0
-                else (Datum.f64(float(red[g])) if kind == "f64"
-                      else Datum.i64(int(red[g])))
-                for g in range(G)]
+            init = np.inf if is_min else -np.inf
+            dtype = np.float64
+        reduce_at = np.minimum.at if is_min else np.maximum.at
+        if combine is not None:
+            cnt_i = counts(ok)
+            red_state = combine.stack(
+                G, init, dtype,
+                lambda row, s, e: reduce_at(
+                    row, gid[s:e][ok[s:e]], vals[s:e][ok[s:e]]))
+            red_i = combine.add(red_state, "min" if is_min else "max")
+            return lambda: _minmax_datums(kind, combine.get(cnt_i),
+                                          combine.get(red_i), G)
+        cnt = np.bincount(gid[ok], minlength=G)
+        red = np.full(G, init, dtype)
+        reduce_at(red, gid[ok], vals[ok])
+        return _minmax_datums(kind, cnt, red, G)
 
     return None
+
+
+def _sum_avg_datums(name: str, kind: str, cnt, sums, G: int) -> list:
+    out = []
+    for g in range(G):
+        c = int(cnt[g])
+        if c == 0:
+            out.append(NULL)
+        elif name == "sum":
+            out.append(Datum.f64(float(sums[g])) if kind == "f64"
+                       else Datum.dec(Decimal(int(sums[g]))))
+        else:
+            out.append(Datum.f64(float(sums[g]) / c) if kind == "f64"
+                       else Datum.dec(Decimal(int(sums[g]))
+                                      / Decimal(c)))
+    return out
+
+
+def _minmax_datums(kind: str, cnt, red, G: int) -> list:
+    return [NULL if int(cnt[g]) == 0
+            else (Datum.f64(float(red[g])) if kind == "f64"
+                  else Datum.i64(int(red[g])))
+            for g in range(G)]

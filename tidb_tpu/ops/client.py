@@ -94,24 +94,15 @@ class TpuClient(kv.Client):
         # client resolves the persisted global itself (any install path
         # — SET backend, store.set_client, restart) instead of silently
         # reverting the kill switch to its default.
-        self.device_join = bool(int(
-            _SYSVAR_DEFAULTS["tidb_tpu_device_join"]))
+        from tidb_tpu.sessionctx import store_bool_sysvar
+        self.device_join = store_bool_sysvar(store, "tidb_tpu_device_join")
         # columnar result channel: SET GLOBAL tidb_tpu_columnar_scan = 0
         # pins every scan response to the row protocol (plane-aware
         # consumers fall back to row drains) while scans keep routing to
         # the device — same store-level resolution contract as the join
         # kill switch.
-        self.columnar_scan = bool(int(
-            _SYSVAR_DEFAULTS["tidb_tpu_columnar_scan"]))
-        import sys as _sys
-        sess_mod = _sys.modules.get("tidb_tpu.session")
-        if sess_mod is not None:
-            from tidb_tpu.sessionctx import parse_bool_sysvar
-            for attr, var in (("device_join", "tidb_tpu_device_join"),
-                              ("columnar_scan", "tidb_tpu_columnar_scan")):
-                v = sess_mod.store_global_var(store, var)
-                if v is not None:
-                    setattr(self, attr, parse_bool_sysvar(v))
+        self.columnar_scan = store_bool_sysvar(store,
+                                               "tidb_tpu_columnar_scan")
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
         # (jitted, planes, live) of the most recent single-chip aggregate
@@ -155,6 +146,14 @@ class TpuClient(kv.Client):
 
     def send(self, req: kv.Request) -> kv.Response:
         sel: SelectRequest = req.data
+        if getattr(sel, "columnar_hint", False) and not self.columnar_scan:
+            # kill switch off: strip the hint up front so EVERY route —
+            # including the CPU fallback engine, which on cluster stores
+            # is a region fan-out that answers hints with per-region
+            # columnar partials — serves the row protocol
+            import dataclasses
+            sel = dataclasses.replace(sel, columnar_hint=False)
+            req = dataclasses.replace(req, data=sel)
         # reset BEFORE any routing decision: a CPU-routed request must
         # leave no stale kernel behind for the bench probe to mis-time.
         # (Until the next request, the tuple pins the last batch's device

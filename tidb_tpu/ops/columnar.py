@@ -33,6 +33,7 @@ from tidb_tpu.types.datum import Kind, NULL
 from tidb_tpu import mysqldef as my
 
 I64_MIN = -(1 << 63)
+I64_MAX = (1 << 63) - 1
 
 # column physical kinds
 K_I64 = "i64"     # ints, times (to_number), durations (nanos), bools
@@ -48,6 +49,22 @@ _POW10 = [10 ** i for i in range(19)]
 
 def _dec_scale_of(c: PBColumnInfo, kind: str) -> int:
     return c.decimal if kind == K_DEC and c.decimal and c.decimal > 0 else 0
+
+
+def _check_u64_plane(c: PBColumnInfo, vals: np.ndarray, va: np.ndarray,
+                     n: int, start: int = 0) -> None:
+    """Native-path guard for the u64 pack bug: codecx.pack_rows decodes
+    unsigned bigints as wrapping int64, so a stored value above int64
+    range surfaces as a NEGATIVE plane value on a column that cannot hold
+    negatives. Raise TypeError_ (→ CPU fallback) instead of serving the
+    silently wrapped plane. The Python path raises in datum_to_phys.
+    `start` lets the incremental append path validate only the NEW
+    segment — earlier rows were checked when they packed."""
+    if c.tp == my.TypeLonglong and my.has_unsigned_flag(c.flag) and \
+            n > start:
+        if bool(np.any((vals[start:n] < 0) & va[start:n])):
+            raise errors.TypeError_(
+                "unsigned bigint above the int64 plane range")
 
 
 def _plane_max_abs(vals: np.ndarray, n: int, kind: str) -> int:
@@ -251,7 +268,15 @@ def datum_to_phys(d: Datum, kind: str, dec_scale: int = 0):
         return iv, True
     if kind == K_I64:
         if k in (Kind.INT64, Kind.UINT64):
-            return int(d.val), True
+            v = int(d.val)
+            if not (I64_MIN <= v <= I64_MAX):
+                # unsigned bigint above the int64 plane range: the plane
+                # cannot represent it exactly — TypeError_ bails the pack
+                # to the CPU engine, like out-of-scale decimals (the seed
+                # raised OverflowError here; the native path wrapped)
+                raise errors.TypeError_(
+                    f"integer {v} exceeds the int64 plane")
+            return v, True
         if k == Kind.TIME:
             # packed int is order-preserving and uniform across DATE /
             # DATETIME (Time.to_packed_int) — to_number is not
@@ -347,6 +372,8 @@ def pack_ranges(snapshot, table_id: int, columns: list[PBColumnInfo],
                 else:
                     vals[:n] = [x if ok else 0
                                 for x, ok in zip(src, valid[cid])]
+            if kind == K_I64:
+                _check_u64_plane(c, vals, va, n)
             cols[cid] = ColumnData(
                 kind, vals, va, tp=c.tp,
                 dec_scale=_dec_scale_of(c, kind),
@@ -419,6 +446,8 @@ def append_rows(batch: ColumnBatch, snapshot, table_id: int,
             else:
                 vals[n_old:n] = [x if ok else 0
                                  for x, ok in zip(src, valid[cid])]
+            if kind == K_I64:
+                _check_u64_plane(c, vals, va, n, start=n_old)
             cols[cid] = ColumnData(
                 kind, vals, va, tp=c.tp,
                 dec_scale=_dec_scale_of(c, kind),
@@ -651,6 +680,105 @@ class ColumnarScanResult:
                                  for cd, c in zip(cds, cols)]
 
 
+class ColumnarPartialSet:
+    """A MULTI-REGION columnar response: one ColumnarScanResult partial
+    per region task of a cluster fan-out (split/merge retries mid-scan
+    may emit several partials per original region — each partial is
+    self-contained, so re-emission never breaks plane alignment), in
+    region/task order so the stacked row order equals the row protocol's
+    scan order.
+
+    Speaks the same column_plane / rows / datum_at side protocol as a
+    single ColumnarScanResult, so joins and fused aggregates consume a
+    multi-region response unchanged. region_slices() additionally exposes
+    the per-region row segments — executor.fused_agg computes per-region
+    partial aggregate states over them and merges the states device-side
+    with a psum-shaped reduction (the combine contract of
+    parallel.CoprMesh, so the same algebra later rides a real mesh)."""
+
+    def __init__(self, parts: list):
+        assert parts, "empty partial set"
+        self.parts = parts
+        self.pb_cols = parts[0].pb_cols
+        lens = [len(p) for p in parts]
+        self.offsets = np.concatenate(
+            [np.zeros(1, np.int64), np.cumsum(lens, dtype=np.int64)])
+        self._plane_cache: dict = {}
+        self._rows_cache: list | None = None
+
+    def __len__(self) -> int:
+        return int(self.offsets[-1])
+
+    def region_slices(self) -> list[tuple[int, int]]:
+        """[start, end) stacked-row segment per region partial."""
+        return [(int(self.offsets[i]), int(self.offsets[i + 1]))
+                for i in range(len(self.parts))]
+
+    def handles(self) -> np.ndarray:
+        return np.concatenate([p.handles() for p in self.parts])
+
+    def column_plane(self, j: int):
+        """Output column j stacked across the region partials:
+        (kind, values, valid) like ColumnarScanResult.column_plane.
+        Partials whose plane is vacuous (all-NULL segments report a
+        degenerate numeric plane) coerce to the kind the other regions
+        agree on; a column any region cannot plane, or regions that
+        genuinely disagree on kind, returns (None, None, None) — the
+        same gate rows_plane applies to mixed row drains."""
+        ent = self._plane_cache.get(j)
+        if ent is not None:
+            return ent
+        planes = [p.column_plane(j) for p in self.parts]
+        if any(k is None for k, _v, _va in planes):
+            ent = (None, None, None)
+        else:
+            kinds = {k for k, _v, va in planes if va.any()}
+            if len(kinds) > 1:
+                ent = (None, None, None)   # regions disagree on kind
+            else:
+                kind = kinds.pop() if kinds else "i64"
+                vals_parts, valid_parts = [], []
+                for (k, v, va), p in zip(planes, self.parts):
+                    if k != kind and not va.any():
+                        # vacuous segment: coerce to the agreed kind
+                        if kind == "str":
+                            v = np.empty(len(p), dtype=object)
+                        else:
+                            v = np.zeros(
+                                len(p),
+                                np.float64 if kind == "f64" else np.int64)
+                    vals_parts.append(v)
+                    valid_parts.append(va)
+                ent = (kind, np.concatenate(vals_parts),
+                       np.concatenate(valid_parts))
+        self._plane_cache[j] = ent
+        return ent
+
+    def _locate(self, i: int) -> tuple:
+        p = int(np.searchsorted(self.offsets, i, side="right")) - 1
+        return self.parts[p], i - int(self.offsets[p])
+
+    def datum_at(self, j: int, i: int):
+        part, local = self._locate(i)
+        return part.datum_at(j, local)
+
+    def rows(self) -> list:
+        if self._rows_cache is None:
+            out = []
+            for p in self.parts:
+                out.extend(p.rows())
+            self._rows_cache = out
+        return self._rows_cache
+
+    def iter_rows_with_handles(self):
+        for p in self.parts:
+            yield from p.iter_rows_with_handles()
+
+    def iter_raw_with_handles(self):
+        for p in self.parts:
+            yield from p.iter_raw_with_handles()
+
+
 class RowsSide:
     """Row-list side of a device join: the drained executor rows behind
     the same plane/rows/datum protocol ColumnarScanResult speaks."""
@@ -803,6 +931,26 @@ class DeviceJoinResult:
             return self.lside.datum_at(j, int(self.l_idx[i]))
         r = int(self.r_idx[i])
         return NULL if r < 0 else self.rside.datum_at(j - self.left_width, r)
+
+    def region_slices(self):
+        """Per-region [start, end) segments of the JOIN OUTPUT, inherited
+        from a multi-region left side: emission is left-scan order, so
+        l_idx is non-decreasing and each left-side region segment maps to
+        a contiguous output range (searchsorted over the match pairs).
+        None when the left side is single-region (or emission order was
+        disturbed) — the fused aggregate then runs its flat path."""
+        src = getattr(self.lside, "region_slices", None)
+        if src is None:
+            return None
+        if len(self.l_idx) and np.any(np.diff(self.l_idx) < 0):
+            return None
+        bounds = [s for s, _e in src()]
+        if not bounds:
+            return None
+        cuts = np.searchsorted(self.l_idx, np.asarray(bounds, np.int64),
+                               side="left").tolist() + [len(self.l_idx)]
+        return [(int(cuts[i]), int(cuts[i + 1]))
+                for i in range(len(cuts) - 1)]
 
     def iter_rows(self, chunk: int = 1 << 16, stats: dict | None = None):
         """Stream output rows, assembling `chunk` index pairs per native

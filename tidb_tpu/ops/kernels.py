@@ -760,6 +760,65 @@ def build_ranked_group_fn(where: CompiledExpr | None, specs: list[AggSpec],
 
 
 # ---------------------------------------------------------------------------
+# per-region partial-aggregate combine: the device-side merge of the
+# cluster fan-out's columnar partials (executor.fused_agg). Each state is
+# a [R, G] stack — one row of per-group partial values per REGION — and
+# the combine reduces over the region axis with the SAME monoid ops the
+# mesh combine applies over ICI (_combiners: count/sum → psum, min →
+# pmin, max → pmax; first_row → pmin over global row positions). On a
+# real mesh the region axis becomes the device axis and the reduction
+# lowers to the collectives; here it runs as ONE jitted kernel whose
+# packed output is the query's single final readback.
+# ---------------------------------------------------------------------------
+
+_combine_cache: dict = {}
+
+
+def combine_region_partials(states: list[np.ndarray],
+                            ops: list[str]) -> list[np.ndarray]:
+    """Merge per-region partial aggregate states device-side.
+
+    states[i] is a [R, G] array (R regions, G groups — or G=1 scalar
+    states); ops[i] ∈ {"sum", "min", "max"} is its combine monoid. All
+    states merge in ONE jitted dispatch with ONE packed readback
+    (pack_outputs: int64 rides exact hi/lo f64 pairs), mirroring
+    parallel.CoprMesh._combined so the algebra cannot drift between the
+    fan-out combine and the mesh combine.
+
+    The cache key includes the state SHAPES: pack_outputs populates its
+    layout at trace time, so a shape change must map to its own wrapper
+    (a shared wrapper would serve a stale layout after jit returns a
+    previously-compiled signature without retracing)."""
+    key = (tuple(ops),
+           tuple((s.shape, np.dtype(s.dtype).char) for s in states))
+    ent = _combine_cache.get(key)
+    if ent is None:
+        ops_t = tuple(ops)
+
+        def fn(arrs, _live):
+            out = []
+            for a, op in zip(arrs, ops_t):
+                if op == "sum":
+                    out.append(jnp.sum(a, axis=0))
+                elif op == "min":
+                    out.append(jnp.min(a, axis=0))
+                else:
+                    out.append(jnp.max(a, axis=0))
+            return tuple(out)
+
+        wrapper = pack_outputs(fn)
+        ent = (wrapper, jax.jit(wrapper))
+        _combine_cache[key] = ent
+        if len(_combine_cache) > 256:
+            _combine_cache.pop(next(iter(_combine_cache)))
+    wrapper, jitted = ent
+    packed = jitted(tuple(jnp.asarray(s) for s in states), None)
+    outs = unpack_outputs(wrapper, np.asarray(packed))
+    # unpack scalarizes length-1 outputs; states are per-group arrays
+    return [np.atleast_1d(np.asarray(o)) for o in outs]
+
+
+# ---------------------------------------------------------------------------
 # device hash join: build (stable sort of right keys) + probe
 # (searchsorted + segment-range expansion) — the device answer to the
 # reference's HashJoinExec build/probe pools (executor/executor.go:442).

@@ -264,7 +264,7 @@ class Session:
         ev = ps.start_statement(self.vars.connection_id, sql_text)
         import time as _time
         from tidb_tpu.distsql import thread_columnar_counts
-        ch0, cf0 = thread_columnar_counts()
+        ch0, cf0, cp0 = thread_columnar_counts()
         t0 = _time.perf_counter()
         from tidb_tpu.sqlast import ShowStmt, ShowType
         if self._exec_depth == 0 and \
@@ -285,14 +285,15 @@ class Session:
             self._exec_depth -= 1
         ps.end_statement(ev, rows_sent=len(rs.rows) if rs is not None else 0,
                          rows_affected=self.vars.affected_rows)
-        ch1, cf1 = thread_columnar_counts()
+        ch1, cf1, cp1 = thread_columnar_counts()
         self._maybe_log_slow(sql_text, _time.perf_counter() - t0,
-                             ch1 - ch0, cf1 - cf0)
+                             ch1 - ch0, cf1 - cf0, cp1 - cp0)
         return rs
 
     def _maybe_log_slow(self, sql_text: str, elapsed_s: float,
                         columnar_hits: int = 0,
-                        columnar_fallbacks: int = 0) -> None:
+                        columnar_fallbacks: int = 0,
+                        columnar_partials: int = 0) -> None:
         """Slow-query log ([TIME_TABLE_SCAN]-style operator logs,
         executor_distsql.go:849): statements over
         tidb_slow_log_threshold ms go to the 'tidb_tpu.slowlog' logger."""
@@ -306,11 +307,14 @@ class Session:
             thr_ms = float(SYSVAR_DEFAULTS["tidb_slow_log_threshold"])
         if thr_ms > 0 and elapsed_s * 1000 >= thr_ms:
             import logging
+            # hits/fallbacks count per PARTIAL: a mixed multi-region
+            # response (some regions columnar, some row-fallback) shows
+            # both sides on the statement's own line
             logging.getLogger("tidb_tpu.slowlog").warning(
                 "[SLOW_QUERY] cost_time:%.3fs conn:%s columnar_hits:%d "
-                "columnar_fallbacks:%d sql:%s",
+                "columnar_fallbacks:%d columnar_partials:%d sql:%s",
                 elapsed_s, self.vars.connection_id, columnar_hits,
-                columnar_fallbacks, sql_text[:2048])
+                columnar_fallbacks, columnar_partials, sql_text[:2048])
             from tidb_tpu import metrics
             metrics.counter("server.slow_queries").inc()
 
@@ -609,10 +613,13 @@ class Session:
 
     def _apply_tpu_bool_switch(self, name: str, attr: str,
                                value: str) -> None:
-        """Shared SET GLOBAL handler for the store-level TpuClient bool
+        """Shared SET GLOBAL handler for the store-level client bool
         switches: validate the literal, gate on the global Grant
         privilege (store-wide blast radius, like the dispatch floor),
-        then flip the attribute on the installed client."""
+        then flip the attribute on the installed client — TpuClient or
+        the cluster fan-out DistCoprClient, whichever carries it — AND
+        on a TpuClient's CPU fallback engine, so a fallback-routed
+        request on a cluster store honors the same switch."""
         from tidb_tpu.sessionctx import parse_bool_sysvar
         if value.strip().lower() not in ("0", "1", "on", "off", "true",
                                          "false"):
@@ -627,10 +634,10 @@ class Session:
                 raise privilege.AccessDenied(
                     f"user '{self.vars.user}' needs the global GRANT "
                     f"privilege to set {name}")
-        from tidb_tpu.ops import TpuClient
         client = self.store.get_client()
-        if isinstance(client, TpuClient):
-            setattr(client, attr, enabled)
+        for target in (client, getattr(client, "cpu", None)):
+            if target is not None and hasattr(target, attr):
+                setattr(target, attr, enabled)
 
     def apply_tpu_device_join(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_device_join = 0|1 — the executor-join
@@ -810,25 +817,27 @@ def bootstrap(session: Session) -> None:
                     == "tpu":
                 session.apply_copr_backend("tpu")
             else:
-                # a TpuClient installed BEFORE the first session
-                # (store.set_client embed pattern) must also pick up the
+                # a client installed BEFORE the first session
+                # (store.set_client embed pattern, or the cluster store's
+                # default DistCoprClient fan-out) must also pick up the
                 # persisted routing knobs, not their defaults
-                import sys as _sys
-                mod = _sys.modules.get("tidb_tpu.ops.client")
+                from tidb_tpu.sessionctx import parse_bool_sysvar
                 client = session.store.get_client()
-                if mod is not None and isinstance(client, mod.TpuClient):
-                    from tidb_tpu.sessionctx import parse_bool_sysvar
-                    dj = gv.values.get("tidb_tpu_device_join")
-                    if dj is not None:
-                        client.device_join = parse_bool_sysvar(dj)
-                    cs = gv.values.get("tidb_tpu_columnar_scan")
-                    if cs is not None:
-                        client.columnar_scan = parse_bool_sysvar(cs)
+                for target in (client, getattr(client, "cpu", None)):
+                    if target is None:
+                        continue
+                    for var, attr in (
+                            ("tidb_tpu_device_join", "device_join"),
+                            ("tidb_tpu_columnar_scan", "columnar_scan")):
+                        v = gv.values.get(var)
+                        if v is not None and hasattr(target, attr):
+                            setattr(target, attr, parse_bool_sysvar(v))
                     fl = gv.values.get("tidb_tpu_dispatch_floor")
                     try:
-                        if fl is not None:
-                            client.dispatch_floor_rows = max(0,
-                                                             int(fl.strip()))
+                        if fl is not None and hasattr(target,
+                                                      "dispatch_floor_rows"):
+                            target.dispatch_floor_rows = max(
+                                0, int(fl.strip()))
                     except ValueError:
                         pass
             return

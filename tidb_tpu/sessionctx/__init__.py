@@ -72,6 +72,22 @@ def parse_bool_sysvar(value: str) -> bool:
     return value.strip().lower() in ("1", "on", "true")
 
 
+def store_bool_sysvar(store, name: str) -> bool:
+    """Store-level boolean sysvar as a freshly constructed CLIENT must
+    resolve it: the persisted/hydrated global when a session has bound
+    this store, else the default. The session module is reached through
+    sys.modules so client constructors (TpuClient, DistCoprClient) never
+    import it — the one place the circular-import workaround lives."""
+    import sys
+    val = None
+    sess_mod = sys.modules.get("tidb_tpu.session")
+    if sess_mod is not None:
+        val = sess_mod.store_global_var(store, name)
+    if val is None:
+        val = SYSVAR_DEFAULTS[name]
+    return parse_bool_sysvar(val)
+
+
 class SessionVars:
     """Reference: sessionctx/variable.SessionVars."""
 
