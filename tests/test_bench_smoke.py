@@ -51,3 +51,14 @@ def test_bench_smoke_emits_valid_json():
     assert out["columnar_partials"] >= 4
     assert out["region_fanout_fallbacks"] == 0
     assert out["region_partial_combines"] > 0
+    # trace-derived kernel/copr instrumentation summary: present and
+    # non-negative, so tier-1 guards the tracing layer itself
+    assert out["trace_copr_tasks"] >= 4
+    assert out["trace_copr_task_ms_max"] >= 0
+    assert out["trace_copr_queue_ms_max"] >= 0
+    assert out["trace_copr_retries"] >= 0
+    assert out["trace_kernel_dispatches"] >= 1, \
+        "traced fan-out run recorded no device kernel spans"
+    assert out["trace_kernel_ms_total"] >= 0
+    assert out["trace_readbacks"] >= 1
+    assert out["trace_readback_bytes"] > 0

@@ -55,6 +55,72 @@ class TestStatusHTTP:
             srv.close()
 
 
+def _parse_prometheus(body: str) -> dict:
+    """Tiny Prometheus text-format parser: name{labels} value lines →
+    {name: value} for plain samples, {name: {le: cum}} for buckets."""
+    out: dict = {}
+    for line in body.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        if "{" in name_part:
+            name, labels = name_part.split("{", 1)
+            labels = labels.rstrip("}")
+            kv = dict(p.split("=", 1) for p in labels.split(","))
+            le = kv.get('le', '').strip('"')
+            out.setdefault(name, {})[le] = float(value)
+        else:
+            out[name_part] = float(value)
+    return out
+
+
+class TestMetricsExposition:
+    def test_histogram_bucket_round_trip(self):
+        """Histograms on /metrics emit conformant cumulative
+        _bucket{le=...} series: parse the endpoint's text back and check
+        monotonicity, the mandatory +Inf bucket == _count, and counter
+        agreement with the live registry."""
+        from tidb_tpu import metrics
+        srv = Server(new_store(f"memory://obs{next(_store_id)}"),
+                     status_port=0)
+        srv.start()
+        try:
+            c = Client("127.0.0.1", srv.port)
+            c.query("create database mh; use mh; "
+                    "create table t (a int primary key)")
+            for i in range(5):
+                c.query(f"insert into t values ({i})")
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/metrics",
+                timeout=5).read().decode()
+            parsed = _parse_prometheus(body)
+            buckets = parsed["session_run_seconds_bucket"]
+            assert "+Inf" in buckets, "mandatory +Inf bucket missing"
+            # cumulative and monotone over ascending bounds
+            finite = sorted((float(le), v) for le, v in buckets.items()
+                            if le != "+Inf")
+            cum = [v for _le, v in finite]
+            assert cum == sorted(cum), "bucket counts not cumulative"
+            assert all(v <= buckets["+Inf"] for v in cum)
+            # +Inf == _count, and _sum present
+            assert buckets["+Inf"] == parsed["session_run_seconds_count"]
+            assert parsed["session_run_seconds_sum"] >= 0
+            # registry agreement (>=: the registry is process-global and
+            # background loops may observe after the HTTP fetch)
+            hist = metrics.histogram("session.run_seconds")
+            assert hist.count >= parsed["session_run_seconds_count"] > 0
+            assert metrics.counter("server.connections_total").value >= \
+                parsed["server_connections_total"] >= 1
+            # SHOW STATUS (registry snapshot) exposes the same series
+            snap = dict(metrics.registry.snapshot())
+            assert float(snap["session.run_seconds_count"]) >= \
+                parsed["session_run_seconds_count"]
+            c.close()
+        finally:
+            srv.close()
+
+
 class TestSlowQueryLog:
     def test_threshold_triggers_log(self):
         records = []

@@ -380,3 +380,53 @@ class TestModifyColumn:
         with pytest.raises(errors.TiDBError) as ei:
             s.execute("select all distinct a from t")
         assert getattr(ei.value, "code", None) == 1221
+
+
+def test_index_ids_never_reused_after_drop():
+    """CREATE INDEX after DROP INDEX must allocate a fresh index id: a
+    transaction planned against the pre-drop schema can commit AFTER the
+    drop's delete pass, orphaning entries under the dead id — an index
+    reusing that id would adopt them as corrupt rows (the test_chaos
+    ADMIN CHECK mismatch: a bal-typed entry inside an index on note)."""
+    from tests.testkit import _store_id
+    from tidb_tpu.session import Session, new_store
+    s = Session(new_store(f"memory://idxid{next(_store_id)}"))
+    s.execute("create database d; use d")
+    s.execute("create table t (id bigint primary key, bal bigint, "
+              "note varchar(32))")
+    s.execute("insert into t values (1, 992, 'init')")
+    s.execute("create index ib on t (bal)")
+    info = s.info_schema().table_by_name("d", "t").info
+    ib_id = info.find_index("ib").id
+    s.execute("drop index ib on t")
+    s.execute("create index inote on t (note)")
+    info = s.info_schema().table_by_name("d", "t").info
+    inote_id = info.find_index("inote").id
+    assert inote_id != ib_id, \
+        "dropped index id reused — stale-schema writers would corrupt it"
+    assert info.max_index_id >= inote_id
+    # the high-water mark survives serialization (meta round trip)
+    from tidb_tpu.model import TableInfo
+    assert TableInfo.deserialize(info.serialize()).max_index_id == \
+        info.max_index_id
+    s.execute("admin check table t")
+
+
+def test_index_ids_not_reused_for_create_table_inline_indexes():
+    """The reuse guard must also cover indexes declared inline in CREATE
+    TABLE: that path allocates ids outside alloc_index_id, so the
+    builder must record the high-water mark (review finding)."""
+    from tests.testkit import _store_id
+    from tidb_tpu.session import Session, new_store
+    s = Session(new_store(f"memory://idxid{next(_store_id)}"))
+    s.execute("create database d; use d")
+    s.execute("create table t (id bigint primary key, a bigint, "
+              "b varchar(10), key ka (a))")
+    info = s.info_schema().table_by_name("d", "t").info
+    ka_id = info.find_index("ka").id
+    assert info.max_index_id >= ka_id
+    s.execute("drop index ka on t")
+    s.execute("create index kb on t (b)")
+    info = s.info_schema().table_by_name("d", "t").info
+    assert info.find_index("kb").id != ka_id, \
+        "CREATE TABLE-inline index id reused after drop"

@@ -1,0 +1,418 @@
+"""EXPLAIN ANALYZE + hierarchical query tracing: per-operator runtime
+stats, per-region coprocessor task attribution (including mid-scan
+split/merge retries), device-kernel attribution (readbacks, jit cache),
+and the consistency contract — everything the trace reports must agree
+row-for-row with the flat distsql.columnar_* counters that
+tests/test_region_fanout_columnar.py already asserts.
+
+Also: the tracing-disabled overhead guard (no Span is ever allocated for
+an untraced statement; the per-statement hook cost stays under a fixed
+bound vs a hooks-stubbed baseline) and the thread-local tally
+cross-attribution test for concurrent sessions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+
+import pytest
+
+from tidb_tpu import metrics, tablecodec as tc, tracing
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+JOIN_AGG_Q = ("select count(*), sum(t.v), min(t.v), max(d.d_f) "
+              "from t join d on t.k = d.d_k")
+
+
+def _build(n_regions: int):
+    store = new_store(f"cluster://3/trace{next(_id)}")
+    s = Session(store)
+    s.execute("create database tr")
+    s.execute("use tr")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double)")
+    rows = ", ".join(f"({i}, {i % 7}, {i * 10}, {i}.25)"
+                     for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create table d (d_k bigint primary key, d_f double)")
+    s.execute("insert into d values " +
+              ", ".join(f"({i}, {i}.5)" for i in range(7)))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("tr", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _counter(name: str) -> int:
+    return metrics.counter(f"distsql.columnar_{name}").value
+
+
+def _spans(doc: dict, name: str, out=None) -> list[dict]:
+    if out is None:
+        out = []
+    if doc.get("name") == name:
+        out.append(doc)
+    for c in doc.get("children", ()):
+        _spans(c, name, out)
+    return out
+
+
+class TestExplainAnalyze:
+    def test_four_region_scan_join_agg(self):
+        """Acceptance: per-operator actual rows + wall time, per-region
+        copr task timings, all consistent with the flat counters."""
+        s = _build(4)
+        h0, f0, p0 = _counter("hits"), _counter("fallbacks"), \
+            _counter("partials")
+        rs = s.execute("explain analyze " + JOIN_AGG_Q)[0]
+        dh, df, dp = _counter("hits") - h0, _counter("fallbacks") - f0, \
+            _counter("partials") - p0
+        assert rs.field_names() == ["id", "actRows", "loops", "time_ms",
+                                    "execution info"]
+        rows = rs.values()
+        by_id = {str(r[0]).strip(): r for r in rows}
+        labels = list(by_id)
+        assert any(l.startswith("HashAgg") for l in labels), labels
+        assert any(l.startswith("HashJoin") for l in labels), labels
+        scans = [r for r in rows if "TableScan" in str(r[0])]
+        assert len(scans) == 2
+        t_scan = next(r for r in scans if "table:t" in str(r[0]))
+        d_scan = next(r for r in scans if "table:d" in str(r[0]))
+        # actual rows: the t scan delivered all 240 rows (as planes), d 7
+        assert int(t_scan[1]) == N_ROWS
+        assert int(d_scan[1]) == 7
+        # wall time present on every instrumented operator
+        for r in rows:
+            assert float(r[3]) >= 0.0
+        # per-region copr tasks on the t scan, one per region
+        info = str(t_scan[4])
+        assert "partials:4" in info, info
+        assert info.count("region#") == 4, info
+        assert "queue:" in info and "run:" in info and "segments:" in info
+        assert "drain_seq:" in info
+        # row-for-row consistency with the flat counters this statement
+        # actually incremented
+        ea_partials = sum(
+            int(str(r[4]).split("partials:")[1].split(" ")[0])
+            for r in scans)
+        ea_hits = sum(
+            int(str(r[4]).split("columnar_hits:")[1].split(" ")[0])
+            for r in scans)
+        ea_fbs = sum(
+            int(str(r[4]).split("columnar_fallbacks:")[1].split(" ")[0])
+            for r in scans)
+        assert ea_partials == dp == 5   # 4 t-regions + 1 d-region
+        assert ea_hits == dh == 5
+        assert ea_fbs == df == 0
+        # device-kernel attribution: the fused aggregate merged the
+        # per-region partial states in one combine with one readback
+        agg = next(r for r in rows if "HashAgg" in str(r[0]))
+        agg_info = str(agg[4])
+        assert "fused:true" in agg_info
+        assert "combine_regions:4" in agg_info
+        assert "combine_readbacks:1" in agg_info
+        assert "combine_readback_bytes:" in agg_info
+        rb = int(agg_info.split("combine_readback_bytes:")[1].split(" ")[0])
+        assert rb > 0
+
+    def test_split_mid_scan_shows_retries(self):
+        """A region split injected mid-scan surfaces as stale-epoch
+        retries (and extra segments) on the region task attribution."""
+        s = _build(4)
+        store = s.store
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts):
+            state["n"] += 1
+            if state["n"] == 2 and not state["done"]:
+                state["done"] = True
+                tid = s.info_schema().table_by_name("tr", "t").info.id
+                store.cluster.split_keys([tc.encode_row_key(tid, 31),
+                                          tc.encode_row_key(tid, 171)])
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        try:
+            rs = s.execute("explain analyze " + JOIN_AGG_Q)[0]
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"]
+        t_scan = next(r for r in rs.values()
+                      if "TableScan" in str(r[0]) and "table:t" in str(r[0]))
+        info = str(t_scan[4])
+        assert "retries:" in info, info
+        assert "stale_epoch" in info, info
+        # the split region re-emitted one partial per new segment
+        segs = [int(p.split(" ")[0].split("]")[0].split(";")[0])
+                for p in info.split("segments:")[1:]]
+        assert sum(segs) > 4, info
+
+    def test_plain_explain_unchanged(self):
+        s = _build(1)
+        rs = s.execute("explain " + JOIN_AGG_Q)[0]
+        assert rs.field_names() == ["Plan"]
+
+    def test_explain_analyze_write_executes(self):
+        s = _build(1)
+        s.execute("explain analyze insert into d values (100, 1.5)")
+        got = s.execute("select d_f from d where d_k = 100")[0].values()
+        assert got == [[1.5]]
+
+
+class TestTraceJson:
+    def test_span_tree_matches_counters(self):
+        s = _build(4)
+        h0, p0 = _counter("hits"), _counter("partials")
+        rs = s.execute(f"trace format='json' {JOIN_AGG_Q}")[0]
+        dh, dp = _counter("hits") - h0, _counter("partials") - p0
+        assert rs.field_names() == ["trace"]
+        doc = json.loads(rs.values()[0][0])
+        assert doc["name"] == "statement"
+        assert doc["duration_us"] > 0
+        assert doc["rows_returned"] == 1
+        # copr spans carry the same per-partial attribution the flat
+        # counters tallied for this statement
+        coprs = _spans(doc, "copr")
+        assert sum(c.get("attrs", {}).get("columnar_hits", 0)
+                   for c in coprs) == dh == 5
+        assert sum(c.get("attrs", {}).get("columnar_partials", 0)
+                   for c in coprs) == dp == 5
+        # one region_task per region, each with pack/filter children
+        tasks = _spans(doc, "region_task")
+        assert len(tasks) == 5
+        t_rows = 0
+        for t in tasks:
+            packs = _spans(t, "pack")
+            assert len(packs) == 1
+            t_rows += packs[0]["attrs"]["rows"]
+            a = t["attrs"]
+            assert a["queue_us"] >= 0 and a["run_us"] >= 0
+            assert a["segments"] >= 1
+            assert "complete_seq" in a
+        assert t_rows == N_ROWS + 7
+        # the device combine ran with one packed readback
+        combines = _spans(doc, "combine_region_partials")
+        assert len(combines) == 1
+        ca = combines[0]["attrs"]
+        assert ca["regions"] == 4
+        assert ca["readbacks"] == 1 and ca["readback_bytes"] > 0
+        # operators subtree mirrors the executor tree
+        ops = doc["operators"]
+        assert ops["operator"] == "Projection"
+        agg = ops["children"][0]
+        assert agg["operator"] == "HashAgg"
+        assert agg["act_rows"] == 1
+        assert agg["fused_agg"]["combine_regions"] == 4
+
+    def test_trace_row_format(self):
+        s = _build(2)
+        rs = s.execute(f"trace format='row' {JOIN_AGG_Q}")[0]
+        assert rs.field_names() == ["operation", "duration_us"]
+        names = [str(r[0]).strip() for r in rs.values()]
+        assert names[0] == "statement"
+        assert any(n == "copr" for n in names)
+        assert any(n == "region_task" for n in names)
+
+    def test_trace_requires_statement(self):
+        from tidb_tpu import errors
+        s = _build(1)
+        with pytest.raises(errors.ParseError):
+            s.execute("trace format='json' set @x = 1")
+        with pytest.raises(errors.ParseError):
+            s.execute("trace format='xml' select 1")
+
+
+class TestSessionTracing:
+    def test_sysvar_traces_every_statement(self):
+        s = _build(2)
+        s.execute("set tidb_trace_enabled = 1")
+        try:
+            s.execute(JOIN_AGG_Q)
+            root = s.last_trace
+            assert root is not None and root.name == "statement"
+            assert root.end_ns > 0
+            assert len(root.find("region_task")) == 3  # 2 t + 1 d
+        finally:
+            s.execute("set tidb_trace_enabled = 0")
+        alloc = tracing.span_allocations
+        s.execute(JOIN_AGG_Q)
+        assert tracing.span_allocations == alloc, \
+            "untraced statement allocated spans"
+
+    def test_perfschema_execution_detail(self):
+        s = _build(4)
+        s.execute(JOIN_AGG_Q)
+        rows = s.execute(
+            "select SQL_TEXT, EXECUTION_DETAIL from "
+            "performance_schema.events_statements_history")[0].values()
+
+        def _s(v):
+            return v.decode() if isinstance(v, bytes) else str(v)
+        match = [r for r in rows
+                 if "from t join d" in _s(r[0]) and r[1] is not None]
+        assert match, "statement missing from events_statements_history"
+        detail = _s(match[-1][1])
+        assert "columnar_partials:5" in detail, detail
+        assert "columnar_hits:5" in detail, detail
+        assert "columnar_fallbacks:0" in detail, detail
+        assert "kernel_dispatches:" in detail, detail
+        assert "readback_bytes:" in detail, detail
+
+
+class TestKernelAttribution:
+    def test_tpu_client_kernel_spans_and_jit_cache(self):
+        from tidb_tpu.ops import TpuClient
+        store = new_store(f"memory://tracetpu{next(_id)}")
+        s = Session(store)
+        s.execute("create database k")
+        s.execute("use k")
+        s.execute("create table t (id bigint primary key, v bigint)")
+        s.execute("insert into t values " +
+                  ", ".join(f"({i}, {i * 2})" for i in range(1, 101)))
+        store.set_client(TpuClient(store, dispatch_floor_rows=0))
+        sess = Session(store)
+        sess.execute("use k")
+        doc = json.loads(sess.execute(
+            "trace format='json' select sum(v), count(*) from t"
+        )[0].values()[0][0])
+        kernels = _spans(doc, "kernel")
+        assert kernels, "device-routed aggregate recorded no kernel span"
+        ka = kernels[0]["attrs"]
+        assert ka["kind"] == "scalar"
+        assert ka["phase"] == "trace+execute"   # first run pays compile
+        assert ka["readbacks"] == 1
+        assert ka["readback_bytes"] > 0
+        coprs = _spans(doc, "copr")
+        assert any(c.get("attrs", {}).get("route") == "tpu"
+                   for c in coprs)
+        # repeat: the jitted kernel is cached — phase drops to execute
+        doc2 = json.loads(sess.execute(
+            "trace format='json' select sum(v), count(*) from t"
+        )[0].values()[0][0])
+        ka2 = _spans(doc2, "kernel")[0]["attrs"]
+        assert ka2["phase"] == "execute"
+        hits = metrics.counter("ops.jit_cache_hits").value
+        assert hits >= 1
+
+
+class TestDisabledOverhead:
+    def test_no_span_allocations_when_off(self):
+        s = _build(1)
+        s.execute(JOIN_AGG_Q)   # warm every lazy path
+        alloc0 = tracing.span_allocations
+        for _ in range(20):
+            s.execute(JOIN_AGG_Q)
+        assert tracing.span_allocations == alloc0, \
+            "tracing-off statements allocated real spans (always-on " \
+            "span leak)"
+
+    def test_per_statement_overhead_bounded(self):
+        """Repeated-statement micro-benchmark: statements with the
+        tracing hooks live vs the same statements with every hook
+        stubbed out. The per-statement delta must stay under a fixed
+        bound — a regression that builds spans unconditionally (or does
+        real work per statement while off) trips this."""
+        s = _build(1)
+        sql = "select count(*) from t"
+        n = 60
+
+        def timed() -> float:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    s.execute(sql)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        s.execute(sql)   # warm
+        with_hooks = timed()
+
+        saved = (tracing.counters_snapshot, tracing.counters_delta,
+                 tracing.current, Session._tracing_enabled)
+        tracing.counters_snapshot = lambda: {}
+        tracing.counters_delta = lambda before: {}
+        tracing.current = lambda: tracing.NOOP
+        Session._tracing_enabled = lambda self: False
+        try:
+            baseline = timed()
+        finally:
+            (tracing.counters_snapshot, tracing.counters_delta,
+             tracing.current, Session._tracing_enabled) = saved
+
+        per_stmt_overhead = (with_hooks - baseline) / n
+        assert per_stmt_overhead < 0.002, \
+            f"tracing-off overhead {per_stmt_overhead * 1e6:.0f}us per " \
+            f"statement exceeds the 2ms bound"
+
+
+class TestConcurrentAttribution:
+    def test_thread_local_tallies_do_not_cross_attribute(self):
+        """Two sessions executing concurrently on different stores (2 vs
+        4 regions) must each see exactly their own per-statement columnar
+        tallies, while the process-wide registry counters account for the
+        sum — SHOW STATUS / /metrics agree with the slow-log numbers."""
+        from tidb_tpu.distsql import thread_columnar_counts
+        s2, s4 = _build(2), _build(4)
+        for s in (s2, s4):
+            s.execute(JOIN_AGG_Q)   # warm outside the measured window
+        rounds = 5
+        barrier = threading.Barrier(2)
+        results: dict[str, list] = {"s2": [], "s4": []}
+        errors: list = []
+
+        def run(name, sess):
+            try:
+                barrier.wait(timeout=30)
+                for _ in range(rounds):
+                    h0, f0, p0 = thread_columnar_counts()
+                    sess.execute(JOIN_AGG_Q)
+                    h1, f1, p1 = thread_columnar_counts()
+                    results[name].append((h1 - h0, f1 - f0, p1 - p0))
+            except Exception as e:   # surfaced after join
+                errors.append(e)
+
+        g_hits0 = _counter("hits")
+        g_parts0 = _counter("partials")
+        threads = [threading.Thread(target=run, args=("s2", s2)),
+                   threading.Thread(target=run, args=("s4", s4))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        # per-statement attribution: 2-region store = 2 t-partials + 1 d,
+        # 4-region store = 4 + 1 — every round, no bleed-through
+        assert results["s2"] == [(3, 0, 3)] * rounds, results["s2"]
+        assert results["s4"] == [(5, 0, 5)] * rounds, results["s4"]
+        # the process-wide counters saw the sum of both sessions
+        assert _counter("hits") - g_hits0 == rounds * 8
+        assert _counter("partials") - g_parts0 == rounds * 8
+
+
+def test_trace_is_not_a_reserved_word():
+    """TRACE dispatches as a bare identifier: columns and tables named
+    `trace` must keep working in every expression position (review
+    finding: making it a lexer keyword broke `select trace from t`)."""
+    s = _build(1)
+    s.execute("create table trace (id bigint primary key, trace bigint)")
+    s.execute("insert into trace values (1, 42)")
+    assert s.execute("select trace from trace where trace = 42"
+                     )[0].values() == [[42]]
+    assert s.execute("select t.trace from trace t order by trace"
+                     )[0].values() == [[42]]
+    # and the statement form still parses from the same spelling
+    doc = json.loads(s.execute(
+        "trace format='json' select trace from trace")[0].values()[0][0])
+    assert doc["name"] == "statement"

@@ -154,25 +154,56 @@ class DistCoprClient(kv.Client):
         # split and execution; each task's worklist re-resolves per
         # attempt, so a mid-scan split/merge only changes how many
         # partials a task emits, never their combined coverage.
-        tasks = []
+        import time as _time
+
+        from tidb_tpu import tracing
+        build_t0 = _time.perf_counter_ns()
+        ranges_split = []
         for rg in ranges:
             for _region, lo, hi in self.store.cache.split_range_by_region(
                     rg.start, rg.end):
-                tasks.append(kv.KeyRange(lo, hi))
+                ranges_split.append(kv.KeyRange(lo, hi))
         # per-range results still come back low→high per region; the desc
         # ordering applies across tasks
         if desc:
-            tasks = list(reversed(tasks))
+            ranges_split = list(reversed(ranges_split))
+        # tracing: one region_task span per task (NOOP when untraced),
+        # created at BUILD time so queue wait (build → worker pickup) is
+        # attributable; workers attach their span so the region-side
+        # engine's pack/filter/topn spans nest under the right task
+        parent = tracing.current()
+        parent.set("task_build_us",
+                   (_time.perf_counter_ns() - build_t0) / 1e3)
+        parent.set("tasks", len(ranges_split))
+        tasks = [(rg, parent.child("region_task").set("task", i))
+                 for i, rg in enumerate(ranges_split)]
+        complete_seq = __import__("itertools").count()
 
-        def run(rg: kv.KeyRange):
-            out = self._exec_range(rg, sel)
+        def run(task):
+            rg, sp = task
+            if not sp.is_noop:
+                sp.set("queue_us",
+                       (_time.perf_counter_ns() - sp.start_ns) / 1e3)
+            run_t0 = _time.perf_counter_ns()
+            tok = tracing.attach(sp)
+            try:
+                out = self._exec_range(rg, sel, sp)
+            finally:
+                tracing.detach(tok)
+            if not sp.is_noop:
+                sp.set("run_us", (_time.perf_counter_ns() - run_t0) / 1e3)
+                # mid-scan split/merge re-emits one partial per region
+                # segment the worklist served — visible here
+                sp.set("segments", len(out))
+                sp.set("complete_seq", next(complete_seq))
+                sp.finish()
             return list(reversed(out)) if desc else out
 
         concurrency = max(1, getattr(req, "concurrency", 1) or 1)
         if len(tasks) <= 1 or concurrency <= 1:
             responses = []
-            for rg in tasks:
-                responses.extend(run(rg))
+            for task in tasks:
+                responses.extend(run(task))
             return _ListResponse(responses)
         # copIterator (store/tikv/coprocessor.go:305): worker threads fan
         # out per task, results stream back IN TASK ORDER so keep_order
@@ -187,19 +218,29 @@ class DistCoprClient(kv.Client):
                                   min(concurrency, len(tasks)),
                                   ordered=ordered)
 
-    def _exec_range(self, rg: kv.KeyRange, sel: SelectRequest):
+    def _exec_range(self, rg: kv.KeyRange, sel: SelectRequest, span=None):
         """Worklist execution of one key range: each step serves the prefix
         owned by the current region, re-splitting whenever the cache learns
         a new region shape (rebuildCurrentTask, coprocessor.go:500). The
         clipped segment is recomputed every attempt so a success always
         served exactly [cursor, seg_end) — the server's epoch check
-        guarantees the cached bounds matched."""
+        guarantees the cached bounds matched. `span`, when given, counts
+        the ladder's retries per error kind (mid-scan split/merge shows
+        up as retry_stale_epoch/retry_region_miss plus extra segments)."""
+        from tidb_tpu import tracing
         from tidb_tpu.cluster.rpc import (
             NotLeaderError, RegionCtx, ServerIsBusyError,
         )
+        if span is None:
+            span = tracing.NOOP
         bo = Backoffer()
         out = []
         cursor, end = rg.start, rg.end
+
+        def retried(kind: str) -> None:
+            span.inc("retries")
+            span.inc(f"retry_{kind}")
+
         while True:
             if end is not None and cursor >= end:
                 return out
@@ -213,23 +254,28 @@ class DistCoprClient(kv.Client):
                     ctx, sel, [kv.KeyRange(cursor, seg_end)], sel.start_ts)
             except NotLeaderError as e:
                 self.store.cache.on_not_leader(e)
+                retried("not_leader")
                 bo.backoff("rpc", e)
                 continue
             except StaleEpochError as e:
                 self.store.cache.on_stale(e)
+                retried("stale_epoch")
                 bo.backoff("region_miss", e)
                 continue
             except ServerIsBusyError as e:
+                retried("server_busy")
                 bo.backoff("server_busy", e)
                 continue
             except RegionError as e:
                 self.store.cache.invalidate(region.region_id)
+                retried("region_miss")
                 bo.backoff("region_miss", e)
                 continue
             except KeyIsLockedError as e:
                 cleared = self.store.resolver.resolve([e.lock], bo)
                 if not cleared:
                     bo.backoff("txn_lock", e)
+                retried("lock")
                 continue
             out.append(resp)
             if seg_end is None or seg_end == end:

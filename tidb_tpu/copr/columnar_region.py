@@ -40,13 +40,19 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         return None
     if sel.order_by and (sel.desc or sel.limit is None):
         return None
+    from tidb_tpu import tracing
     columns = sel.table_info.columns
     defaults = {c.column_id: c.default_val for c in columns
                 if c.default_val is not None}
     try:
-        batch = col.pack_ranges(snapshot, sel.table_info.table_id,
-                                columns, ranges, defaults)
-        mask = _filter_mask(sel, batch)
+        with tracing.trace("pack") as psp:
+            batch = col.pack_ranges(snapshot, sel.table_info.table_id,
+                                    columns, ranges, defaults)
+            psp.set("rows", batch.n_rows)
+        with tracing.trace("filter") as fsp:
+            mask = _filter_mask(sel, batch)
+            if mask is not None:
+                fsp.set("rows_out", int(np.count_nonzero(mask)))
     except errors.TypeError_:
         return None      # no exact plane mapping: the CPU engine answers
     except errors.TiDBError:
@@ -54,7 +60,10 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
     if mask is None:
         return None
     if sel.order_by:
-        idx = _topn_select(sel, batch, mask)
+        with tracing.trace("topn") as tsp:
+            idx = _topn_select(sel, batch, mask)
+            if idx is not None:
+                tsp.set("rows_out", len(idx))
         if idx is None:
             return None
     else:

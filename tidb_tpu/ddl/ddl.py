@@ -423,6 +423,10 @@ class DDL:
                 unique=spec.unique or spec.primary, primary=spec.primary,
                 state=SchemaState.PUBLIC))
             idx_id += 1
+        # record the allocation high-water mark: without it, dropping the
+        # last CREATE TABLE-inline index would let alloc_index_id hand
+        # the dead id to the next CREATE INDEX (same reuse corruption)
+        info.max_index_id = idx_id - 1
         for i, fspec in enumerate(fks, 1):
             info.foreign_keys.append(self._build_fk_info(info, fspec, i))
         return info
@@ -718,7 +722,11 @@ class DDL:
                 if c is None:
                     raise errors.UnknownFieldError(f"column {cn} doesn't exist")
                 cols.append(IndexColumn(c.name, c.offset))
-            idx = IndexInfo(id=max((i.id for i in info.indices), default=0) + 1,
+            # alloc_index_id, never max(existing)+1: reusing a dropped
+            # index's id would adopt entries a stale-schema writer
+            # orphaned under it after the drop's delete pass (surfaced
+            # as an ADMIN CHECK index/row type mismatch in test_chaos)
+            idx = IndexInfo(id=info.alloc_index_id(),
                             name=index_name, columns=cols, unique=unique,
                             state=SchemaState.NONE)
             info.indices.append(idx)
@@ -795,6 +803,9 @@ class DDL:
             prefix = tc.encode_index_seek_key(info.id, idx.id)
             for k, _v in list(txn.iterate(prefix, prefix + b"\xff" * 9)):
                 txn.delete(k)
+            # pin the dead id into the high-water mark — covers tables
+            # persisted before max_index_id existed (deserialized as 0)
+            info.max_index_id = max(info.max_index_id, idx.id)
             info.indices = [i for i in info.indices if i.id != idx.id]
             m.update_table(job.schema_id, info)
             job.state = JobState.DONE

@@ -38,12 +38,14 @@ def thread_columnar_counts() -> tuple[int, int, int]:
             getattr(_thread_columnar, "partials", 0))
 
 
-def _count(attr: str, n: int) -> None:
+def _count(attr: str, n: int, span=None) -> None:
     if n:
         from tidb_tpu import metrics
         metrics.counter(f"distsql.columnar_{attr}").inc(n)
         setattr(_thread_columnar, attr,
                 getattr(_thread_columnar, attr, 0) + n)
+        if span is not None:
+            span.inc(f"columnar_{attr}", n)
 
 
 class SelectResult:
@@ -56,7 +58,7 @@ class SelectResult:
     everything else falls back to the row iterator."""
 
     def __init__(self, resp: kv.Response, field_types: list[FieldType],
-                 columnar_hinted: bool = False):
+                 columnar_hinted: bool = False, span=None):
         self._resp = resp
         self._types = field_types
         self._rows = iter(())
@@ -64,11 +66,17 @@ class SelectResult:
         self._hinted = columnar_hinted
         self._attribute_parts = False   # row-fallback: count per partial
         self._decode_info = None
+        # the request's trace span (tracing.NOOP when untraced): per-
+        # partial channel attribution and the fan-out's region-task
+        # spans hang off it
+        from tidb_tpu import tracing
+        self.span = span if span is not None else tracing.NOOP
 
     def __iter__(self):
         return self
 
     def close(self) -> None:
+        self.span.finish()
         self._resp.close()
 
     def columnar(self):
@@ -88,13 +96,14 @@ class SelectResult:
         row protocol's scan order."""
         if self._done:
             if self._hinted:
-                _count("fallbacks", 1)
+                _count("fallbacks", 1, self.span)
             return None
         first = self._resp.next()
         if first is None:
             # zero partials (empty range set): nothing answered rows, so
             # per-partial attribution counts nothing
             self._done = True
+            self.span.finish()
             return None
         if first.error:
             raise errors.ExecError(f"coprocessor error: {first.error}")
@@ -105,7 +114,7 @@ class SelectResult:
             # bounded window (and close() can still abandon workers on
             # an early LIMIT); __next__ attributes those per partial
             if self._hinted:
-                _count("fallbacks", 1)
+                _count("fallbacks", 1, self.span)
                 self._attribute_parts = True
             self._rows = iter_response_rows(first)
             return None
@@ -116,14 +125,15 @@ class SelectResult:
         parts = [first] + (drain() if drain is not None else
                            list(iter(self._resp.next, None)))
         self._done = True
+        self.span.finish()
         for part in parts:
             if part.error:
                 raise errors.ExecError(f"coprocessor error: {part.error}")
         payloads = [getattr(p, "columnar", None) for p in parts]
         n_col = sum(1 for p in payloads if p is not None)
-        _count("hits", n_col)
+        _count("hits", n_col, self.span)
         if n_col == len(parts):
-            _count("partials", n_col)
+            _count("partials", n_col, self.span)
             if n_col == 1:
                 return payloads[0]
             from tidb_tpu.ops.columnar import ColumnarPartialSet
@@ -132,7 +142,7 @@ class SelectResult:
         # row iterator serves everything — columnar partials materialize
         # from their planes; attribution stays per partial
         if self._hinted:
-            _count("fallbacks", len(parts) - n_col)
+            _count("fallbacks", len(parts) - n_col, self.span)
         import itertools
         self._rows = itertools.chain.from_iterable(
             iter_response_rows(p) for p in parts)
@@ -147,6 +157,7 @@ class SelectResult:
             part = self._resp.next()
             if part is None:
                 self._done = True
+                self.span.finish()
                 raise StopIteration
             if part.error:
                 raise errors.ExecError(f"coprocessor error: {part.error}")
@@ -155,7 +166,7 @@ class SelectResult:
                 # later partials stream through here — keep the
                 # per-PARTIAL channel attribution as they arrive
                 _count("fallbacks" if getattr(part, "columnar", None)
-                       is None else "hits", 1)
+                       is None else "hits", 1, self.span)
             self._rows = iter_response_rows(part)
 
     def _decode(self, datums: list[Datum]) -> list[Datum]:
@@ -191,19 +202,30 @@ def select(client: kv.Client, req: SelectRequest,
            req_type: int = kv.REQ_TYPE_SELECT) -> SelectResult:
     """Reference: distsql.Select (distsql/distsql.go:277)."""
     import time as _time
-    from tidb_tpu import metrics
+    from tidb_tpu import metrics, tracing
     kreq = kv.Request(tp=req_type, data=req, key_ranges=key_ranges,
                       keep_order=keep_order, desc=req.desc,
                       concurrency=concurrency)
     kind = "index" if req_type == kv.REQ_TYPE_INDEX else "select"
     metrics.counter(f"distsql.queries.{kind}").inc()
+    # the request's copr span: the fan-out client hangs per-region task
+    # spans off it (worker threads attach it explicitly), the in-proc
+    # engines hang kernel spans; it finishes when the result drains.
+    # NOOP when the statement is untraced — one thread-local read.
+    span = tracing.current().child("copr") \
+        .set("kind", kind).set("ranges", len(key_ranges)) \
+        .set("columnar_hint", bool(getattr(req, "columnar_hint", False)))
     t0 = _time.perf_counter()
+    tok = tracing.attach(span)
     try:
         resp = client.send(kreq)
     except Exception:
         metrics.counter("distsql.errors").inc()
         raise
+    finally:
+        tracing.detach(tok)
     metrics.histogram("distsql.send_seconds").observe(
         _time.perf_counter() - t0)
     return SelectResult(resp, field_types,
-                        columnar_hinted=getattr(req, "columnar_hint", False))
+                        columnar_hinted=getattr(req, "columnar_hint", False),
+                        span=span)

@@ -141,6 +141,7 @@ class XSelectTableExec(Executor):
         self._columnar_tried = False
         self._columnar_hint = False
         self._row_iter = None
+        self.copr_spans: list = []   # trace spans of this scan's requests
 
     def _do_request(self):
         scan = self.scan_plan
@@ -165,6 +166,7 @@ class XSelectTableExec(Executor):
             self.ctx.client, req, ranges, types,
             concurrency=self.ctx.distsql_concurrency(),
             keep_order=scan.keep_order)
+        self.copr_spans.append(self._sel_result.span)
         self._result = iter(self._sel_result)
 
     def columnar_result(self):
@@ -179,8 +181,17 @@ class XSelectTableExec(Executor):
         if self.scan_plan.aggregated_push_down:
             return None     # partial-row protocol carries no planes
         self._columnar_hint = True
+        import time as _time
+        st = getattr(self, "exec_stats", None)
+        t0 = _time.perf_counter_ns() if st is not None else 0
         self._do_request()
         self._columnar = self._sel_result.columnar()
+        if st is not None:
+            # plane-consumed scans never run next(): credit the request+
+            # drain time and the rows delivered as planes to the operator
+            st.time_ns += _time.perf_counter_ns() - t0
+            if self._columnar is not None:
+                self._columnar_rows = len(self._columnar)
         return self._columnar
 
     def next(self):
@@ -220,6 +231,7 @@ class XSelectIndexExec(Executor):
         self._rows = None
         self._pos = 0
         self._open_result = None   # in-flight SelectResult (error cleanup)
+        self.copr_spans: list = []   # trace spans of this scan's requests
 
     # -- request plumbing --
 
@@ -260,6 +272,7 @@ class XSelectIndexExec(Executor):
     def _materialize(self):
         scan = self.scan_plan
         result, pb_cols = self._index_request()
+        self.copr_spans.append(result.span)
         self._open_result = result
         if not scan.double_read:
             # single read: remap pb column order → schema order
@@ -298,8 +311,10 @@ class XSelectIndexExec(Executor):
             est_rows=float(len(handles)))  # exact: one row per handle
         ranges = handles_to_kv_ranges(scan.table_info.id, sorted(handles))
         types = [c.ret_type for c in scan.schema]
-        return select(self.ctx.client, req, ranges, types,
-                      concurrency=self.ctx.distsql_concurrency())
+        result = select(self.ctx.client, req, ranges, types,
+                        concurrency=self.ctx.distsql_concurrency())
+        self.copr_spans.append(result.span)
+        return result
 
     def next(self):
         if self._rows is None:

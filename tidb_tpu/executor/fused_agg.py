@@ -201,14 +201,25 @@ def _try_fused(agg):
         if col_res is None:
             return None
         cols.append(col_res)
-    if combine is not None:
-        combine.run()   # ONE dispatch + readback merges every state
-        cols = [c() if callable(c) else c for c in cols]
+    from tidb_tpu import tracing
+    with tracing.trace("fused_agg") as sp:
+        sp.set("rows", n).set("groups", G)
+        if combine is not None:
+            sp.set("combine_regions", len(combine.slices))
+            combine.run()   # ONE dispatch + readback merges every state
+            cols = [c() if callable(c) else c for c in cols]
 
     emit = np.argsort(first_idx, kind="stable")
     join_stats = getattr(child, "join_stats", None)
     if join_stats is not None:
         join_stats["fused_agg"] = True
+    # EXPLAIN ANALYZE / TRACE read these off the executor nodes: the
+    # fused child never serves next(), so its plane-delivered row count
+    # is credited here
+    child._columnar_rows = n
+    agg._fused_info = {"fused": True, "rows": n, "groups": G}
+    if combine is not None:
+        agg._fused_info["combine_regions"] = len(combine.slices)
     return [[c[g] for c in cols] for g in emit.tolist()]
 
 

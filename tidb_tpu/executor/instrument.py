@@ -1,0 +1,267 @@
+"""Executor-tree runtime instrumentation for EXPLAIN ANALYZE / TRACE.
+
+Reference: the reference's RuntimeStats collection under EXPLAIN ANALYZE
+(executor/explain.go + distsql/select_result.go copr stats). Here the
+already-built executor tree is wrapped in place: each node's bound
+next()/close() is replaced by a timing closure accumulating into an
+OperatorStats, so no per-row cost exists outside an instrumented run and
+no executor class needs to know it is being measured.
+
+Reported time is INCLUSIVE wall time (a parent's next() contains its
+children's), like the reference's EXPLAIN ANALYZE `time` column.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tidb_tpu.executor import executors as ex
+
+
+class OperatorStats:
+    __slots__ = ("label", "detail", "rows", "loops", "time_ns",
+                 "close_ns", "node")
+
+    def __init__(self, label: str, detail: str, node):
+        self.label = label
+        self.detail = detail
+        self.rows = 0
+        self.loops = 0
+        self.time_ns = 0
+        self.close_ns = 0
+        self.node = node
+
+    def time_ms(self) -> float:
+        return self.time_ns / 1e6
+
+
+_LABELS = {
+    "XSelectTableExec": "TableScan",
+    "XSelectIndexExec": "IndexScan",
+    "MemTableExec": "MemTableScan",
+    "UnionScanExec": "UnionScan",
+    "SelectionExec": "Selection",
+    "ProjectionExec": "Projection",
+    "HashAggExec": "HashAgg",
+    "StreamAggExec": "StreamAgg",
+    "HashJoinExec": "HashJoin",
+    "HashJoinCartesianFix": "CartesianJoin",
+    "HashSemiJoinExec": "HashSemiJoin",
+    "SortExec": "Sort",
+    "TopNExec": "TopN",
+    "LimitExec": "Limit",
+    "DistinctExec": "Distinct",
+    "UnionExec": "Union",
+    "TableDualExec": "TableDual",
+    "ApplyExec": "Apply",
+    "ExistsExec": "Exists",
+    "MaxOneRowExec": "MaxOneRow",
+    "InsertExec": "Insert",
+    "UpdateExec": "Update",
+    "DeleteExec": "Delete",
+}
+
+
+def _label_detail(node) -> tuple[str, str]:
+    label = _LABELS.get(type(node).__name__, type(node).__name__)
+    detail = ""
+    scan = getattr(node, "scan_plan", None)
+    if scan is not None:
+        detail = f"table:{scan.alias or getattr(scan.table_info, 'name', '')}"
+        idx = getattr(scan, "index", None)
+        if idx is not None:
+            detail += f" index:{idx.name}"
+        if getattr(scan, "pushed_where", None) is not None:
+            detail += " pushed_where"
+    plan = getattr(node, "plan", None)
+    if isinstance(node, ex.HashJoinExec) and plan is not None:
+        detail = f"eq:{plan.eq_conditions!r}"
+    if isinstance(node, (ex.HashAggExec, ex.StreamAggExec)):
+        detail = f"funcs:{[f.name for f in node.agg_funcs]!r}"
+    return label, detail
+
+
+def instrument_tree(root) -> list[OperatorStats]:
+    """Wrap every node of an executor tree with timing closures; returns
+    the stats objects in depth-first order. Idempotent per node."""
+    out: list[OperatorStats] = []
+
+    def wrap(node):
+        if getattr(node, "exec_stats", None) is not None:
+            out.append(node.exec_stats)
+        else:
+            label, detail = _label_detail(node)
+            st = OperatorStats(label, detail, node)
+            node.exec_stats = st
+            out.append(st)
+            orig_next = node.next
+            orig_close = node.close
+
+            def timed_next(_st=st, _next=orig_next):
+                t0 = time.perf_counter_ns()
+                try:
+                    row = _next()
+                finally:
+                    _st.time_ns += time.perf_counter_ns() - t0
+                _st.loops += 1
+                if row is not None:
+                    _st.rows += 1
+                return row
+
+            def timed_close(_st=st, _close=orig_close):
+                t0 = time.perf_counter_ns()
+                try:
+                    _close()
+                finally:
+                    _st.close_ns += time.perf_counter_ns() - t0
+
+            node.next = timed_next
+            node.close = timed_close
+        for child in getattr(node, "children", ()):
+            wrap(child)
+
+    wrap(root)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _copr_info(node) -> str:
+    """Coprocessor attribution for a scan node, read off the copr span(s)
+    the scan's distsql request(s) recorded: per-region task timings
+    (queue/run, segments re-emitted by mid-scan split/merge, retries),
+    columnar channel attribution, and device-kernel readbacks."""
+    spans = [sp for sp in getattr(node, "copr_spans", ()) or ()
+             if sp is not None and not sp.is_noop]
+    if not spans:
+        return ""
+    parts = []
+    hits = sum(sp.attrs.get("columnar_hits", 0) for sp in spans)
+    fbs = sum(sp.attrs.get("columnar_fallbacks", 0) for sp in spans)
+    partials = sum(sp.attrs.get("columnar_partials", 0) for sp in spans)
+    parts.append(f"copr: partials:{partials} columnar_hits:{hits} "
+                 f"columnar_fallbacks:{fbs}")
+    tasks = [t for sp in spans for t in sp.find("region_task")]
+    if tasks:
+        task_bits = []
+        for t in tasks:
+            # snapshot first (atomic C-level copy): an abandoned fan-out
+            # worker may still be writing this span's attrs
+            a = dict(t.attrs)
+            bit = (f"region#{a.get('task', '?')}: "
+                   f"queue:{a.get('queue_us', 0) / 1e3:.2f}ms "
+                   f"run:{a.get('run_us', 0) / 1e3:.2f}ms "
+                   f"segments:{a.get('segments', 0)}")
+            retries = a.get("retries", 0)
+            if retries:
+                kinds = ",".join(f"{k[6:]}:{v}" for k, v in a.items()
+                                 if k.startswith("retry_"))
+                bit += f" retries:{retries}({kinds})"
+            seq = a.get("complete_seq")
+            if seq is not None:
+                bit += f" drain_seq:{seq}"
+            task_bits.append(bit)
+        parts.append("tasks:[" + "; ".join(task_bits) + "]")
+    kernels = [k for sp in spans for k in sp.find("kernel")]
+    if kernels:
+        rb = sum(k.attrs.get("readback_bytes", 0) for k in kernels)
+        n_rb = sum(k.attrs.get("readbacks", 0) for k in kernels)
+        t_us = sum(k.duration_us() for k in kernels)
+        parts.append(f"kernel: dispatches:{len(kernels)} "
+                     f"time:{t_us / 1e3:.2f}ms readbacks:{n_rb} "
+                     f"readback_bytes:{rb}")
+    return " ".join(parts)
+
+
+def _node_info(node, root_span) -> str:
+    """execution-info column for one executor node."""
+    bits = []
+    info = _copr_info(node)
+    if info:
+        bits.append(info)
+    js = getattr(node, "join_stats", None)
+    if js:
+        jb = [f"path:{js.get('path', '?')}"]
+        for k in ("build_s", "probe_s", "assemble_s"):
+            if k in js:
+                jb.append(f"{k[:-2]}:{js[k] * 1e3:.2f}ms")
+        if "n_pairs" in js:
+            jb.append(f"pairs:{js['n_pairs']}")
+        if js.get("fused_agg"):
+            jb.append("fused_agg:true")
+        bits.append("join: " + " ".join(jb))
+    fi = getattr(node, "_fused_info", None)
+    if fi:
+        fb = "fused:true"
+        if fi.get("combine_regions"):
+            fb += f" combine_regions:{fi['combine_regions']}"
+            if root_span is not None and not root_span.is_noop:
+                combines = root_span.find("combine_region_partials")
+                if combines:
+                    rb = sum(c.attrs.get("readback_bytes", 0)
+                             for c in combines)
+                    fb += (f" combine_readbacks:{len(combines)} "
+                           f"combine_readback_bytes:{rb}")
+        bits.append(fb)
+    return " ".join(bits)
+
+
+def analyze_rows(root_exec, root_span=None) -> list[list[str]]:
+    """EXPLAIN ANALYZE rows: [id, actRows, loops, time_ms, info] per
+    operator, children indented under parents."""
+    rows: list[list[str]] = []
+
+    def walk(node, indent):
+        st = getattr(node, "exec_stats", None)
+        if st is None:
+            label, detail = _label_detail(node)
+            ident = f"{indent}{label}"
+            rows.append([ident + (f" {detail}" if detail else ""),
+                         "", "", "", ""])
+        else:
+            ident = f"{indent}{st.label}"
+            if st.detail:
+                ident += f" {st.detail}"
+            # an operator consumed through its column planes never runs
+            # next(); its plane-delivered row count stands in
+            act = max(st.rows, getattr(node, "_columnar_rows", 0))
+            rows.append([ident, str(act), str(st.loops),
+                         f"{st.time_ms():.3f}",
+                         _node_info(node, root_span)])
+        for child in getattr(node, "children", ()):
+            walk(child, indent + "  ")
+
+    walk(root_exec, "")
+    return rows
+
+
+def operators_dict(root_exec) -> dict:
+    """The executor tree + stats as a JSON-able dict (the `operators`
+    subtree of TRACE FORMAT='json')."""
+
+    def walk(node):
+        st = getattr(node, "exec_stats", None)
+        if st is None:
+            label, detail = _label_detail(node)
+            d = {"operator": label, "detail": detail}
+        else:
+            d = {"operator": st.label, "detail": st.detail,
+                 "act_rows": max(st.rows,
+                                 getattr(node, "_columnar_rows", 0)),
+                 "loops": st.loops,
+                 "time_ms": round(st.time_ms(), 3)}
+        js = getattr(node, "join_stats", None)
+        if js:
+            d["join"] = {k: v for k, v in js.items()
+                         if isinstance(v, (int, float, bool, str))}
+        fi = getattr(node, "_fused_info", None)
+        if fi:
+            d["fused_agg"] = dict(fi)
+        kids = [walk(c) for c in getattr(node, "children", ())]
+        if kids:
+            d["children"] = kids
+        return d
+
+    return walk(root_exec)

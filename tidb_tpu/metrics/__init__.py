@@ -61,6 +61,20 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def snapshot_buckets(self) -> tuple[list[float], list[int], float, int]:
+        """(upper bounds, CUMULATIVE counts per bound incl. +Inf, sum,
+        count) — a consistent view taken under the lock, in the shape the
+        Prometheus text exposition wants."""
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, total_count = self._sum, self._count
+        cum = []
+        running = 0
+        for c in counts:
+            running += c
+            cum.append(running)
+        return list(self.buckets), cum, total_sum, total_count
+
 
 class Registry:
     def __init__(self):
@@ -110,13 +124,41 @@ def counter(name: str) -> Counter:
     return registry.counter(name)
 
 
+def _fmt_le(b: float) -> str:
+    """Bucket bound label: integral bounds render bare ('1' not '1.0'),
+    like the Prometheus client libraries."""
+    return str(int(b)) if float(b) == int(b) else repr(float(b))
+
+
 def render_text() -> str:
     """Prometheus text exposition of the default registry (the status
     HTTP port's /metrics; tidb-server/main.go:181 push-gateway analogue).
-    Metric names sanitize '.' → '_' per the Prometheus data model."""
+    Metric names sanitize '.' → '_' per the Prometheus data model.
+
+    Counters emit one sample line; histograms emit the full conformant
+    series per the text format: cumulative `_bucket{le="..."}` lines
+    (one per configured bound plus the mandatory le="+Inf" == _count),
+    then `_sum` and `_count`. The legacy `_avg` convenience line stays
+    for SHOW STATUS parity but is emitted as its own gauge-style sample.
+    """
     lines = []
-    for name, value in registry.snapshot():
-        lines.append(f"{name.replace('.', '_')} {value}")
+    with registry._lock:
+        items = sorted(registry._metrics.items())
+    for name, m in items:
+        pname = name.replace(".", "_")
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {m.value}")
+            continue
+        bounds, cum, total_sum, total_count = m.snapshot_buckets()
+        lines.append(f"# TYPE {pname} histogram")
+        for b, c in zip(bounds, cum[:-1]):
+            lines.append(f'{pname}_bucket{{le="{_fmt_le(b)}"}} {c}')
+        lines.append(f'{pname}_bucket{{le="+Inf"}} {cum[-1]}')
+        lines.append(f"{pname}_sum {total_sum:.6f}")
+        lines.append(f"{pname}_count {total_count}")
+        avg = total_sum / total_count if total_count else 0.0
+        lines.append(f"{pname}_avg {avg:.6f}")
     return "\n".join(lines) + "\n"
 
 

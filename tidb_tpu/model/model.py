@@ -159,6 +159,13 @@ class TableInfo:
     comment: str = ""
     state: SchemaState = SchemaState.PUBLIC
     foreign_keys: list[FKInfo] = field(default_factory=list)
+    # high-water mark of every index id EVER allocated on this table.
+    # Index ids must never be reused: a transaction planned against an
+    # older schema (where a since-dropped index was still writable) can
+    # commit AFTER the drop's data deletion, orphaning entries under the
+    # dead id — a new index reusing that id would inherit them as
+    # corrupt rows (model.TableInfo MaxIndexID in the reference).
+    max_index_id: int = 0
 
     def to_json(self) -> dict:
         return {"id": self.id, "name": self.name,
@@ -167,7 +174,8 @@ class TableInfo:
                 "pk_is_handle": self.pk_is_handle,
                 "charset": self.charset, "collate": self.collate,
                 "comment": self.comment, "state": int(self.state),
-                "foreign_keys": [f.to_json() for f in self.foreign_keys]}
+                "foreign_keys": [f.to_json() for f in self.foreign_keys],
+                "max_index_id": self.max_index_id}
 
     @staticmethod
     def from_json(d: dict) -> "TableInfo":
@@ -178,7 +186,16 @@ class TableInfo:
                          d.get("charset", "utf8"), d.get("collate", "utf8_bin"),
                          d.get("comment", ""), SchemaState(d.get("state", 4)),
                          [FKInfo.from_json(f)
-                          for f in d.get("foreign_keys", [])])
+                          for f in d.get("foreign_keys", [])],
+                         d.get("max_index_id", 0))
+
+    def alloc_index_id(self) -> int:
+        """Next never-before-used index id (monotonic per table; stores
+        written before max_index_id existed resume from max(existing))."""
+        self.max_index_id = max(self.max_index_id,
+                                max((i.id for i in self.indices),
+                                    default=0)) + 1
+        return self.max_index_id
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json(), separators=(",", ":")).encode()

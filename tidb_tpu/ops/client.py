@@ -163,23 +163,30 @@ class TpuClient(kv.Client):
                      and sel.table_info is not None)
                     or (req.tp == kv.REQ_TYPE_INDEX
                         and sel.index_info is not None))
-        from tidb_tpu import metrics
+        from tidb_tpu import metrics, tracing
+        # the distsql copr span is this thread's current span for the
+        # duration of send() — route attribution lands on it
+        sp = tracing.current()
         if not routable:
             self.stats["cpu_fallbacks"] += 1
             metrics.counter("copr.tpu.cpu_fallbacks").inc()
+            sp.set("route", "cpu_fallback")
             return self.cpu.send(req)
         floor = self.dispatch_floor_rows
         if floor and sel.est_rows is not None and sel.est_rows < floor:
             # planner histograms say the scan cannot amortize the device
             # round trip — answer on CPU without even packing a batch
+            sp.set("route", "below_floor")
             return self._route_small(req, sel)
         try:
             resp = self._send_tpu(req, sel)
             self.stats["tpu_requests"] += 1
             metrics.counter("copr.tpu.requests").inc()
+            sp.set("route", "tpu")
             return _SingleResponse(resp)
         except BelowFloor:
             # exact row count (post-pack) under the floor: CPU is cheaper
+            sp.set("route", "below_floor")
             return self._route_small(req, sel)
         except (Unsupported, errors.TypeError_):
             # TypeError_ = a column/value has no exact plane mapping
@@ -187,6 +194,7 @@ class TpuClient(kv.Client):
             # fallback contract as Unsupported — CPU answers
             self.stats["cpu_fallbacks"] += 1
             metrics.counter("copr.tpu.cpu_fallbacks").inc()
+            sp.set("route", "cpu_fallback")
             if any(e.distinct for e in sel.aggregates):
                 # per-region partials under-merge distinct aggregates; the
                 # CPU fallback must run the whole request as ONE region
@@ -333,19 +341,55 @@ class TpuClient(kv.Client):
 
     def _kernel(self, sel, batch, kind: str, build):
         """Compiled-kernel cache: one traced+jitted callable per (batch,
-        request-shape) signature — repeat queries skip tracing entirely."""
+        request-shape) signature — repeat queries skip tracing entirely.
+        Returns (fn, wrapper, jitted, state); state["runs"] counts
+        executions so the dispatch helper can attribute trace+compile
+        time to the first run. Cache hits/misses feed the statement
+        tallies and the ops.jit_cache_* metrics."""
+        from tidb_tpu import tracing
         key = (kind, batch._uid, repr(sel.where), repr(sel.aggregates),
                repr(sel.group_by), repr(sel.order_by), sel.limit, sel.desc)
         ent = self._fn_cache.get(key)
+        tracing.record_jit_cache(hit=ent is not None)
         if ent is None:
             import jax
             fn = build()
             wrapper = kernels.pack_outputs(fn)
-            ent = (fn, wrapper, jax.jit(wrapper))
+            ent = (fn, wrapper, jax.jit(wrapper), {"runs": 0})
             self._fn_cache[key] = ent
             if len(self._fn_cache) > 256:
                 self._fn_cache.pop(next(iter(self._fn_cache)))
         return ent
+
+    def _dispatch_kernel(self, jitted, planes, live, kind: str,
+                         state=None) -> np.ndarray:
+        """One device dispatch + the packed-output readback, attributed:
+        a `kernel` trace span (kind, dispatch vs total time, readback
+        bytes, whether this run paid jit trace+compile), the per-thread
+        statement tallies, and the ops.* process metrics. The np.asarray
+        IS the readback — the only certified completion point on
+        tunneled deployments."""
+        import time as _time
+
+        from tidb_tpu import metrics, tracing
+        first = state is not None and state["runs"] == 0
+        if state is not None:
+            state["runs"] += 1
+        sp = tracing.current().child("kernel").set("kind", kind)
+        t0 = _time.perf_counter()
+        packed = jitted(planes, live)
+        t_disp = _time.perf_counter()
+        host = np.asarray(packed)
+        t1 = _time.perf_counter()
+        nbytes = int(host.nbytes)
+        sp.set("phase", "trace+execute" if first else "execute")
+        sp.set("dispatch_us", round((t_disp - t0) * 1e6, 1))
+        sp.set("readbacks", 1)
+        sp.set("readback_bytes", nbytes)
+        sp.finish()
+        tracing.record_dispatch(readback_bytes=nbytes)
+        metrics.histogram("ops.kernel_seconds").observe(t1 - t0)
+        return host
 
     def _run_aggregate(self, sel, batch, where) -> SelectResponse:
         specs = kernels.lower_aggregates(sel, batch)
@@ -377,7 +421,7 @@ class TpuClient(kv.Client):
                             "ceiling")
                     gspec = tspec
             planes = self._with_group_planes(batch, gspec, planes)
-            fn, wrapper, jitted = self._kernel(
+            fn, wrapper, jitted, kst = self._kernel(
                 sel, batch, "grouped",
                 lambda: kernels.build_grouped_agg_fn(where, specs,
                                                      gspec.plane_keys,
@@ -387,11 +431,12 @@ class TpuClient(kv.Client):
                         for o in self.mesh.run_grouped(fn, planes, live)]
             else:
                 self._last_dispatch = (jitted, planes, live)
-                packed = jitted(planes, live)
-                outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
+                packed = self._dispatch_kernel(jitted, planes, live,
+                                               "grouped", kst)
+                outs = kernels.unpack_outputs(wrapper, packed)
             return self._emit_grouped(sel, batch, specs, gspec,
                                       fn.radices, outs)
-        fn, wrapper, jitted = self._kernel(
+        fn, wrapper, jitted, kst = self._kernel(
             sel, batch, "scalar",
             lambda: kernels.build_scalar_agg_fn(where, specs, batch.n_rows))
         if self.mesh is not None:
@@ -399,8 +444,9 @@ class TpuClient(kv.Client):
                     for o in self.mesh.run_scalar(fn, planes, live)]
         else:
             self._last_dispatch = (jitted, planes, live)
-            packed = jitted(planes, live)
-            outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
+            packed = self._dispatch_kernel(jitted, planes, live,
+                                           "scalar", kst)
+            outs = kernels.unpack_outputs(wrapper, packed)
         return self._emit_scalar(sel, batch, specs, outs)
 
     def _emit_scalar(self, sel, batch, specs, outs) -> SelectResponse:
@@ -514,12 +560,13 @@ class TpuClient(kv.Client):
         for cap in self._RANK_CAPS:
             if cap < start:
                 continue
-            _, wrapper, jitted = self._kernel(
+            _, wrapper, jitted, kst = self._kernel(
                 sel, batch, f"rank{cap}",
                 lambda cap=cap: kernels.build_ranked_group_fn(
                     where, specs, group_cols, cap))
-            packed = jitted(planes, live)
-            outs = kernels.unpack_outputs(wrapper, np.asarray(packed))
+            packed = self._dispatch_kernel(jitted, planes, live,
+                                           f"rank{cap}", kst)
+            outs = kernels.unpack_outputs(wrapper, packed)
             ngroups = int(outs[0])
             if ngroups <= cap - 1:
                 self._rank_cap_start[ck] = cap
@@ -660,7 +707,7 @@ class TpuClient(kv.Client):
     # ------------------------------------------------------------------
 
     def _run_filter(self, sel, batch, where, req) -> SelectResponse:
-        fn, wrapper, jitted = self._kernel(
+        fn, wrapper, jitted, kst = self._kernel(
             sel, batch, "filter", lambda: kernels.build_filter_fn(where))
         planes = kernels.batch_planes(batch)
         live = kernels.device_live(batch)
@@ -669,9 +716,9 @@ class TpuClient(kv.Client):
             # back in global row order (contiguous blocks, shard-major)
             (mask_out,) = self.mesh.run_sharded(fn, planes, live)
         else:
-            packed = jitted(planes, live)
-            (mask_out,) = kernels.unpack_outputs(wrapper,
-                                                 np.asarray(packed))
+            packed = self._dispatch_kernel(jitted, planes, live,
+                                           "filter", kst)
+            (mask_out,) = kernels.unpack_outputs(wrapper, packed)
         mask = np.asarray(mask_out).astype(bool)
         idx = np.nonzero(mask)[0]
         if sel.desc:
@@ -695,12 +742,11 @@ class TpuClient(kv.Client):
                     for item in sel.order_by]
             build = lambda: kernels.build_topn_fn_multi(  # noqa: E731
                 where, keys, k)
-        _, wrapper, jitted = self._kernel(sel, batch, "topn", build)
+        _, wrapper, jitted, kst = self._kernel(sel, batch, "topn", build)
         planes = kernels.batch_planes(batch)
         live = kernels.device_live(batch)
-        packed = jitted(planes, live)
-        idx_out, n_live = kernels.unpack_outputs(wrapper,
-                                                 np.asarray(packed))
+        packed = self._dispatch_kernel(jitted, planes, live, "topn", kst)
+        idx_out, n_live = kernels.unpack_outputs(wrapper, packed)
         # LIMIT 1: unpack scalarizes length-1 outputs — restore the axis
         idx = np.atleast_1d(np.asarray(idx_out))[: int(n_live)]
         return self._emit_rows(sel, batch, idx)
@@ -718,7 +764,7 @@ class TpuClient(kv.Client):
         single = len(sel.order_by) == 1
         if single:
             key = compile_expr(sel.order_by[0].expr, batch)
-            fn, _w, _j = self._kernel(
+            fn, _w, _j, _kst = self._kernel(
                 sel, batch, "topn_mesh",
                 lambda: kernels.build_topn_partial_fn(
                     where, key, sel.order_by[0].desc, k))
@@ -729,7 +775,7 @@ class TpuClient(kv.Client):
         else:
             keys = [(compile_expr(item.expr, batch), item.desc)
                     for item in sel.order_by]
-            fn, _w, _j = self._kernel(
+            fn, _w, _j, _kst = self._kernel(
                 sel, batch, "topn_mesh",
                 lambda: kernels.build_topn_partial_fn_multi(where, keys,
                                                             k))

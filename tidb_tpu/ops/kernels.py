@@ -789,9 +789,11 @@ def combine_region_partials(states: list[np.ndarray],
     layout at trace time, so a shape change must map to its own wrapper
     (a shared wrapper would serve a stale layout after jit returns a
     previously-compiled signature without retracing)."""
+    from tidb_tpu import tracing as _tracing
     key = (tuple(ops),
            tuple((s.shape, np.dtype(s.dtype).char) for s in states))
     ent = _combine_cache.get(key)
+    _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
         ops_t = tuple(ops)
 
@@ -812,8 +814,15 @@ def combine_region_partials(states: list[np.ndarray],
         if len(_combine_cache) > 256:
             _combine_cache.pop(next(iter(_combine_cache)))
     wrapper, jitted = ent
+    sp = _tracing.current().child("combine_region_partials") \
+        .set("regions", int(states[0].shape[0])) \
+        .set("states", len(states))
     packed = jitted(tuple(jnp.asarray(s) for s in states), None)
-    outs = unpack_outputs(wrapper, np.asarray(packed))
+    host = np.asarray(packed)
+    sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
+    sp.finish()
+    _tracing.record_dispatch(readback_bytes=int(host.nbytes))
+    outs = unpack_outputs(wrapper, host)
     # unpack scalarizes length-1 outputs; states are per-group arrays
     return [np.atleast_1d(np.asarray(o)) for o in outs]
 
@@ -918,21 +927,35 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None):
     # deployments a sync would cost a whole extra round trip; build_s is
     # therefore dispatch time, and probe_s, which ends at the certified
     # pair readback, absorbs the build's actual compute)
+    from tidb_tpu import tracing
     t0 = _time.time()
+    bsp = tracing.current().child("kernel").set("kind", "join_build")
     rs, order, n_valid = join_build_kernel(jnp.asarray(rk), jnp.asarray(rv))
+    bsp.finish()
+    tracing.record_dispatch(readbacks=0)   # outputs stay device-resident
     if stats is not None:
         stats["build_s"] = _time.time() - t0
 
     t0 = _time.time()
+    psp = tracing.current().child("kernel").set("kind", "join_probe")
     lk_d, lv_d = jnp.asarray(lk), jnp.asarray(lv)
     out_cap = lcap
+    rb_bytes = 0
+    rb_count = 0
     while True:
         packed = np.asarray(join_probe_kernel(rs, order, n_valid, lk_d,
                                               lv_d, out_cap=out_cap))
+        rb_bytes += int(packed.nbytes)
+        rb_count += 1
         n_out = int(packed[-1])
         if n_out <= out_cap:
             break
         out_cap = col.bucket_capacity(n_out)
+    psp.set("readbacks", rb_count).set("readback_bytes", rb_bytes) \
+        .set("pairs", int(n_out))
+    psp.finish()
+    tracing.record_dispatch(dispatches=rb_count, readbacks=rb_count,
+                            readback_bytes=rb_bytes)
     l_idx = packed[:n_out]
     r_idx = packed[out_cap:out_cap + n_out]
     if stats is not None:

@@ -147,6 +147,11 @@ class Parser:
         if t.tp == lx.IDENT and str(t.val).upper() in ("BINLOG", "LOCK",
                                                        "UNLOCK"):
             return self._parse_ignored_stmt()
+        if t.tp == lx.IDENT and str(t.val).upper() == "TRACE":
+            # TRACE dispatches on the bare identifier (not a lexer
+            # keyword) so columns/tables named `trace` keep parsing in
+            # expressions — same pattern as BINLOG/LOCK above
+            return self._parse_trace()
         if t.tp != lx.KEYWORD:
             self._fail("expected statement keyword")
         kw = t.val
@@ -1101,11 +1106,46 @@ class Parser:
 
     def _parse_explain(self) -> ast.StmtNode:
         self._next()  # EXPLAIN/DESCRIBE/DESC
+        if self._at_kw("ANALYZE"):
+            # EXPLAIN ANALYZE <stmt>: runs the statement, annotates the
+            # plan with actual per-operator stats. Disambiguated from
+            # `DESCRIBE analyze` (a table named analyze) by requiring a
+            # statement keyword after ANALYZE.
+            nxt = self.toks[self.pos + 1] if self.pos + 1 < len(self.toks) \
+                else None
+            if nxt is not None and nxt.tp == lx.KEYWORD and \
+                    nxt.val in ("SELECT", "INSERT", "UPDATE", "DELETE",
+                                "REPLACE"):
+                self._next()  # ANALYZE
+                return ast.ExplainStmt(stmt=self._parse_statement(),
+                                       analyze=True)
         if self._cur().tp == lx.KEYWORD and self._at_kw("SELECT", "INSERT", "UPDATE",
                                                         "DELETE"):
             return ast.ExplainStmt(stmt=self._parse_statement())
         # DESCRIBE table → SHOW COLUMNS
         return ast.ShowStmt(tp=ast.ShowType.COLUMNS, table=self._parse_table_name())
+
+    def _parse_trace(self) -> ast.TraceStmt:
+        """TRACE [FORMAT = 'json'] <stmt> (reference parser.y
+        TraceStmt; executor/trace.go). TRACE is dispatched as a bare
+        identifier, never a keyword."""
+        self._next()  # TRACE
+        fmt = "json"
+        if self._at_word("FORMAT"):
+            self._next()
+            self._expect_op("=")
+            t = self._cur()
+            if t.tp != lx.STRING:
+                self._fail("expected format string after FORMAT =")
+            self.pos += 1
+            fmt = str(t.val).lower()
+            if fmt not in ("json", "row"):
+                self._fail(f"unsupported TRACE format {fmt!r}")
+        if not self._at_kw("SELECT", "INSERT", "UPDATE", "DELETE",
+                           "REPLACE"):
+            self._fail("TRACE expects a SELECT/INSERT/UPDATE/DELETE/"
+                       "REPLACE statement")
+        return ast.TraceStmt(stmt=self._parse_statement(), format=fmt)
 
     def _parse_prepare(self) -> ast.PrepareStmt:
         """PREPARE name FROM 'sql' | @var (reference parser.y PreparedStmt,

@@ -46,6 +46,9 @@ _STMT_COLS = [
     ("TIMER_WAIT", my.TypeLonglong), ("ROWS_SENT", my.TypeLonglong),
     ("ROWS_AFFECTED", my.TypeLonglong), ("ERRORS", my.TypeLonglong),
     ("MESSAGE_TEXT", my.TypeVarchar),
+    # per-statement execution details: columnar channel attribution +
+    # device-kernel tallies (the session's always-on per-thread counters)
+    ("EXECUTION_DETAIL", my.TypeBlob),
 ]
 
 
@@ -69,7 +72,8 @@ def table_infos() -> list[TableInfo]:
 
 class StatementEvent:
     __slots__ = ("thread_id", "event_id", "name", "sql_text", "t_start",
-                 "t_end", "rows_sent", "rows_affected", "errors", "message")
+                 "t_end", "rows_sent", "rows_affected", "errors", "message",
+                 "detail")
 
     def __init__(self, thread_id: int, event_id: int, sql_text: str):
         self.thread_id = thread_id
@@ -82,6 +86,7 @@ class StatementEvent:
         self.rows_affected = 0
         self.errors = 0
         self.message = ""
+        self.detail = ""
 
     def row(self) -> list[Datum]:
         wait = max(0, self.t_end - self.t_start) if self.t_end else 0
@@ -92,6 +97,8 @@ class StatementEvent:
                 Datum.i64(wait), Datum.i64(self.rows_sent),
                 Datum.i64(self.rows_affected), Datum.i64(self.errors),
                 Datum.bytes_(self.message.encode()) if self.message
+                else NULL,
+                Datum.bytes_(self.detail.encode()) if self.detail
                 else NULL]
 
 
@@ -123,7 +130,8 @@ class PerfSchema:
         return ev
 
     def end_statement(self, ev: StatementEvent | None, rows_sent: int = 0,
-                      rows_affected: int = 0, error: str = "") -> None:
+                      rows_affected: int = 0, error: str = "",
+                      detail: str = "") -> None:
         if ev is None:
             return
         # mutate + publish under the lock: rows() may be rendering this
@@ -135,6 +143,7 @@ class PerfSchema:
             if error:
                 ev.errors = 1
                 ev.message = error
+            ev.detail = detail[:1024]
             self._history.append(ev)
 
     def current_sql(self, thread_id: int) -> str | None:

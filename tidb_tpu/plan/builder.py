@@ -56,7 +56,10 @@ class PlanBuilder:
         if isinstance(node, ast.ShowStmt):
             return ShowPlan(node)
         if isinstance(node, ast.ExplainStmt):
-            return ExplainPlan(self.build(node.stmt))
+            return ExplainPlan(self.build(node.stmt), analyze=node.analyze)
+        if isinstance(node, ast.TraceStmt):
+            from tidb_tpu.plan.plans import TracePlan
+            return TracePlan(self.build(node.stmt), format=node.format)
         if isinstance(node, ast.UnionStmt):
             return self.build_union(node)
         if isinstance(node, ast.PrepareStmt):

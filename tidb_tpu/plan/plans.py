@@ -267,9 +267,22 @@ class SimplePlan(Plan):
 
 
 class ExplainPlan(Plan):
-    def __init__(self, target: Plan):
+    def __init__(self, target: Plan, analyze: bool = False):
         super().__init__("explain")
         self.target = target
+        self.analyze = analyze   # EXPLAIN ANALYZE: run + annotate
+
+
+class TracePlan(ExplainPlan):
+    """TRACE FORMAT='json' <stmt> — subclasses ExplainPlan so the whole
+    optimizer pipeline (predicate pushdown, to_physical, projection
+    elimination) treats the wrapped target identically; only the session
+    dispatch renders a span tree instead of an annotated plan."""
+
+    def __init__(self, target: Plan, format: str = "json"):
+        super().__init__(target, analyze=True)
+        self.tp = "trace"
+        self.format = format
 
 
 class Prepare(Plan):

@@ -316,7 +316,8 @@ def required_privs(stmt, current_db: str) -> list[tuple[str, str, str]]:
                            ast.CreateUserStmt, ast.DropUserStmt)):
         out.append(("Grant", "", ""))
     # SHOW / SET / USE / txn control / EXPLAIN target checked via its stmt
-    elif isinstance(stmt, ast.ExplainStmt) and stmt.stmt is not None:
+    elif isinstance(stmt, (ast.ExplainStmt, ast.TraceStmt)) \
+            and stmt.stmt is not None:
         return required_privs(stmt.stmt, current_db)
     return out
 

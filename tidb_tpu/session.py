@@ -21,7 +21,7 @@ from tidb_tpu.plan import optimize_plan
 from tidb_tpu.plan.builder import PlanBuilder
 from tidb_tpu.plan.plans import (
     Deallocate, Delete, Execute, ExplainPlan, Insert, Prepare, ShowPlan,
-    SimplePlan, Update,
+    SimplePlan, TracePlan, Update,
 )
 from tidb_tpu.sessionctx import GlobalVars, SessionVars
 from tidb_tpu.types import Datum
@@ -112,6 +112,7 @@ class Session:
         self.binary_stmts: dict[int, _PreparedStmt] = {}
         self._next_stmt_id = 0
         self.dirty_tables: set[int] = set()
+        self.last_trace = None   # root span of the last traced statement
         bootstrap(self)
 
     @property
@@ -259,12 +260,13 @@ class Session:
             self.killed = False
             raise errors.ExecError("Query execution was interrupted",
                                    code=1317)
-        from tidb_tpu import perfschema
+        from tidb_tpu import perfschema, tracing
         ps = perfschema.perf_for(self.store)
         ev = ps.start_statement(self.vars.connection_id, sql_text)
         import time as _time
         from tidb_tpu.distsql import thread_columnar_counts
         ch0, cf0, cp0 = thread_columnar_counts()
+        tally0 = tracing.counters_snapshot()
         t0 = _time.perf_counter()
         from tidb_tpu.sqlast import ShowStmt, ShowType
         if self._exec_depth == 0 and \
@@ -275,28 +277,77 @@ class Session:
             # mysql.global_variables) must not wipe the warnings their
             # enclosing statement just produced
             self.vars.warnings = []
+        # statement-level span tree, opt-in (SET tidb_trace_enabled = 1):
+        # the default path allocates nothing — one dict lookup decides
+        root = None
+        trace_tok = None
+        if self._exec_depth == 0 and self._tracing_enabled():
+            root = tracing.Span("statement")
+            root.set("sql", sql_text[:256])
+            root.set("conn", self.vars.connection_id)
+            trace_tok = tracing.attach(root)
         self._exec_depth += 1
         try:
-            rs = self._execute_one_inner(stmt, sql_text, record_history)
-        except Exception as e:
-            ps.end_statement(ev, error=str(e))
-            raise
+            try:
+                rs = self._execute_one_inner(stmt, sql_text, record_history)
+            except Exception as e:
+                ps.end_statement(ev, error=str(e),
+                                 detail=self._exec_detail(
+                                     ch0, cf0, cp0, tally0))
+                raise
         finally:
             self._exec_depth -= 1
+            if root is not None:
+                tracing.detach(trace_tok)
+                root.finish()
+                self.last_trace = root
+        detail = self._exec_detail(ch0, cf0, cp0, tally0)
         ps.end_statement(ev, rows_sent=len(rs.rows) if rs is not None else 0,
-                         rows_affected=self.vars.affected_rows)
+                         rows_affected=self.vars.affected_rows,
+                         detail=detail)
         ch1, cf1, cp1 = thread_columnar_counts()
         self._maybe_log_slow(sql_text, _time.perf_counter() - t0,
-                             ch1 - ch0, cf1 - cf0, cp1 - cp0)
+                             ch1 - ch0, cf1 - cf0, cp1 - cp0,
+                             tracing.counters_delta(tally0), root)
         return rs
+
+    def _tracing_enabled(self) -> bool:
+        """Cheap per-statement check for SET tidb_trace_enabled = 1 —
+        two dict lookups, no sysvar machinery."""
+        v = self.vars.systems.get("tidb_trace_enabled")
+        if v is None:
+            v = self.global_vars.values.get("tidb_trace_enabled")
+        return v is not None and v.strip().lower() in ("1", "on", "true")
+
+    def _exec_detail(self, ch0: int, cf0: int, cp0: int,
+                     tally0: dict) -> str:
+        """Execution-details string for performance_schema: the always-on
+        per-thread tallies (columnar channel + device kernels) diffed
+        over this statement."""
+        from tidb_tpu import tracing
+        from tidb_tpu.distsql import thread_columnar_counts
+        ch1, cf1, cp1 = thread_columnar_counts()
+        parts = [f"columnar_hits:{ch1 - ch0}",
+                 f"columnar_fallbacks:{cf1 - cf0}",
+                 f"columnar_partials:{cp1 - cp0}"]
+        delta = tracing.counters_delta(tally0)
+        for key in tracing.COUNTER_KEYS:
+            if delta.get(key):
+                parts.append(f"{key}:{delta[key]}")
+        return " ".join(parts)
 
     def _maybe_log_slow(self, sql_text: str, elapsed_s: float,
                         columnar_hits: int = 0,
                         columnar_fallbacks: int = 0,
-                        columnar_partials: int = 0) -> None:
+                        columnar_partials: int = 0,
+                        kernel_tally: dict | None = None,
+                        root_span=None) -> None:
         """Slow-query log ([TIME_TABLE_SCAN]-style operator logs,
         executor_distsql.go:849): statements over
-        tidb_slow_log_threshold ms go to the 'tidb_tpu.slowlog' logger."""
+        tidb_slow_log_threshold ms go to the 'tidb_tpu.slowlog' logger.
+        The detail line carries the statement's device-kernel tallies
+        and, when the statement was traced (tidb_trace_enabled), a
+        per-region copr summary derived from the span tree."""
         from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
         raw = self.vars.get_system("tidb_slow_log_threshold",
                                    self.global_vars) \
@@ -307,14 +358,34 @@ class Session:
             thr_ms = float(SYSVAR_DEFAULTS["tidb_slow_log_threshold"])
         if thr_ms > 0 and elapsed_s * 1000 >= thr_ms:
             import logging
+            kt = kernel_tally or {}
+            detail = (" kernel_dispatches:%d readbacks:%d "
+                      "readback_bytes:%d jit_hits:%d jit_misses:%d" % (
+                          kt.get("kernel_dispatches", 0),
+                          kt.get("readbacks", 0),
+                          kt.get("readback_bytes", 0),
+                          kt.get("jit_hits", 0),
+                          kt.get("jit_misses", 0)))
+            if root_span is not None:
+                tasks = root_span.find("region_task")
+                if tasks:
+                    worst = max(tasks,
+                                key=lambda t: t.attrs.get("run_us", 0))
+                    detail += (" copr_tasks:%d copr_retries:%d "
+                               "copr_max_task_ms:%.2f" % (
+                                   len(tasks),
+                                   sum(t.attrs.get("retries", 0)
+                                       for t in tasks),
+                                   worst.attrs.get("run_us", 0) / 1e3))
             # hits/fallbacks count per PARTIAL: a mixed multi-region
             # response (some regions columnar, some row-fallback) shows
             # both sides on the statement's own line
             logging.getLogger("tidb_tpu.slowlog").warning(
                 "[SLOW_QUERY] cost_time:%.3fs conn:%s columnar_hits:%d "
-                "columnar_fallbacks:%d columnar_partials:%d sql:%s",
+                "columnar_fallbacks:%d columnar_partials:%d%s sql:%s",
                 elapsed_s, self.vars.connection_id, columnar_hits,
-                columnar_fallbacks, columnar_partials, sql_text[:2048])
+                columnar_fallbacks, columnar_partials, detail,
+                sql_text[:2048])
             from tidb_tpu import metrics
             metrics.counter("server.slow_queries").inc()
 
@@ -356,7 +427,12 @@ class Session:
         path and EXECUTE (so prepared SHOW/SET/EXPLAIN work too)."""
         if isinstance(plan, (ShowPlan, SimplePlan)):
             return execute_simple(self, plan.stmt)
+        if isinstance(plan, TracePlan):
+            return self._run_traced_plan(plan, sql_text, record_history)
         if isinstance(plan, ExplainPlan):
+            if plan.analyze:
+                return self._run_explain_analyze(plan, sql_text,
+                                                 record_history)
             return explain_result(plan.target)
         if isinstance(plan, Prepare):
             return self._do_prepare(plan)
@@ -400,6 +476,82 @@ class Session:
             if self.vars.autocommit:
                 self.commit_txn()
         return rs
+
+    # ------------------------------------------------------------------
+    # EXPLAIN ANALYZE / TRACE (executor/explain.go, executor/trace.go)
+    # ------------------------------------------------------------------
+
+    def _run_instrumented(self, target, sql_text: str,
+                          record_history: bool):
+        """Execute a physical plan to completion under a fresh trace
+        root with an instrumented executor tree. Returns (executor,
+        root_span, rows_drained); the caller renders either the
+        annotated plan (EXPLAIN ANALYZE) or the span tree (TRACE).
+        Transaction semantics match _run_plan — write targets really
+        write, autocommit applies."""
+        from tidb_tpu import tracing
+        from tidb_tpu.executor.instrument import instrument_tree
+        is_write = isinstance(target, (Insert, Update, Delete))
+        root = tracing.Span("statement")
+        root.set("sql", sql_text[:256])
+        root.set("conn", self.vars.connection_id)
+        tok = tracing.attach(root)
+        executor = ExecutorBuilder(self).build(target)
+        instrument_tree(executor)
+        n_rows = 0
+        try:
+            try:
+                while executor.next() is not None:
+                    n_rows += 1
+                if is_write and record_history:
+                    self.history.append(sql_text)
+            except Exception:
+                if not self.vars.in_txn:
+                    self.rollback_txn()
+                raise
+            finally:
+                executor.close()
+        finally:
+            tracing.detach(tok)
+            root.finish()
+        if not self.vars.in_txn and not getattr(self, "_in_retry", False):
+            if self.vars.autocommit:
+                self.commit_txn()
+        self.last_trace = root
+        return executor, root, n_rows
+
+    def _run_explain_analyze(self, plan: ExplainPlan, sql_text: str,
+                             record_history: bool) -> ResultSet:
+        from tidb_tpu.executor.instrument import analyze_rows
+        from tidb_tpu.executor.simple import _str_rs
+        executor, root, _ = self._run_instrumented(plan.target, sql_text,
+                                                   record_history)
+        return _str_rs(["id", "actRows", "loops", "time_ms",
+                        "execution info"], analyze_rows(executor, root))
+
+    def _run_traced_plan(self, plan: TracePlan, sql_text: str,
+                         record_history: bool) -> ResultSet:
+        import json as _json
+
+        from tidb_tpu.executor.instrument import operators_dict
+        from tidb_tpu.executor.simple import _str_rs
+        executor, root, n_rows = self._run_instrumented(
+            plan.target, sql_text, record_history)
+        doc = root.to_dict()
+        doc["rows_returned"] = n_rows
+        doc["operators"] = operators_dict(executor)
+        if plan.format == "row":
+            rows = []
+
+            def walk(sp, depth):
+                rows.append(["  " * depth + sp.name,
+                             f"{sp.duration_us():.1f}"])
+                for c in sp.children:
+                    walk(c, depth + 1)
+
+            walk(root, 0)
+            return _str_rs(["operation", "duration_us"], rows)
+        return _str_rs(["trace"], [[_json.dumps(doc)]])
 
     # ------------------------------------------------------------------
     # prepared statements (executor/prepared.go, session.go:478-563)

@@ -61,6 +61,10 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_tpu_columnar_scan": "1",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
+    # hierarchical statement tracing (tidb_tpu.tracing): 1 builds a span
+    # tree for EVERY statement (slow-log detail gets the span summary);
+    # 0 (default) builds spans only under EXPLAIN ANALYZE / TRACE
+    "tidb_trace_enabled": "0",
     "tidb_copr_batch_rows": "1048576",
 }
 

@@ -28,7 +28,7 @@ from tidb_tpu.sqlast.ddl import (  # noqa: F401
 )
 from tidb_tpu.sqlast.misc import (  # noqa: F401
     BeginStmt, CommitStmt, RollbackStmt, UseStmt, SetStmt, VariableAssignment,
-    ShowStmt, ShowType, ExplainStmt, AdminStmt, AdminType,
+    ShowStmt, ShowType, ExplainStmt, TraceStmt, AdminStmt, AdminType,
     AnalyzeTableStmt, PrepareStmt, ExecuteStmt, DeallocateStmt,
     UserSpec, GrantStmt, RevokeStmt, CreateUserStmt, DropUserStmt,
     LoadDataStmt, DoStmt, KillStmt, FlushStmt,

@@ -74,6 +74,18 @@ class ShowStmt(StmtNode):
 @dataclass
 class ExplainStmt(StmtNode):
     stmt: StmtNode = None  # type: ignore[assignment]
+    # EXPLAIN ANALYZE: execute the statement and annotate the plan tree
+    # with per-operator runtime stats (ast/misc.go ExplainStmt.Analyze)
+    analyze: bool = False
+
+
+@dataclass
+class TraceStmt(StmtNode):
+    """TRACE [FORMAT = 'json'] <stmt>: execute the statement under the
+    hierarchical tracer and return its span tree (ast/misc.go
+    TraceStmt)."""
+    stmt: StmtNode = None  # type: ignore[assignment]
+    format: str = "json"
 
 
 class AdminType(enum.IntEnum):

@@ -1,0 +1,277 @@
+"""Hierarchical query tracing: per-statement span trees with per-region
+and device-kernel attribution.
+
+Reference: the reference's util/tracing (opentracing spans around each
+Execute, session.go:454) and executor runtime stats
+(executor/executor.go RuntimeStats / distsql metrics) — here one
+lightweight span tree per statement, built only when a consumer asked
+for it (EXPLAIN ANALYZE, TRACE, or SET tidb_trace_enabled = 1), plus a
+set of always-on per-thread counters cheap enough for every statement
+(the slow-query log / performance_schema execution-detail source).
+
+Design rules:
+
+* OFF is the default and must cost ~nothing: `current()` is one
+  thread-local read; every span operation on the shared NOOP sentinel
+  is a constant-returning method. No Span object is ever allocated
+  while tracing is off (`span_allocations` counts real allocations so
+  tests can assert exactly that).
+* Worker threads (the cluster fan-out) attach explicitly: a span
+  created on the statement thread is handed to the worker, which
+  `attach()`es it so nested `trace(...)` blocks land under the right
+  region task. CPython list.append/dict assignment make the child/attr
+  writes safe without a lock.
+* Span times are perf_counter_ns; rendered durations are microseconds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_tls = threading.local()
+
+# real Span allocations since process start — the overhead guard asserts
+# this stays flat across untraced statements
+span_allocations = 0
+
+
+class Span:
+    """One node of a statement's span tree."""
+
+    __slots__ = ("name", "start_ns", "end_ns", "attrs", "children")
+
+    def __init__(self, name: str):
+        global span_allocations
+        span_allocations += 1
+        self.name = name
+        self.start_ns = time.perf_counter_ns()
+        self.end_ns = 0
+        self.attrs: dict = {}
+        self.children: list[Span] = []
+
+    is_noop = False
+
+    def child(self, name: str) -> "Span":
+        sp = Span(name)
+        self.children.append(sp)
+        return sp
+
+    def set(self, key: str, value) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def inc(self, key: str, n: int = 1) -> None:
+        self.attrs[key] = self.attrs.get(key, 0) + n
+
+    def finish(self) -> None:
+        if self.end_ns == 0:
+            self.end_ns = time.perf_counter_ns()
+
+    # ---- introspection ----
+
+    def duration_us(self) -> float:
+        end = self.end_ns or time.perf_counter_ns()
+        return (end - self.start_ns) / 1e3
+
+    def walk(self):
+        """Yield self and every descendant, depth-first."""
+        yield self
+        for c in list(self.children):
+            yield from c.walk()
+
+    def find(self, name: str) -> list["Span"]:
+        return [s for s in self.walk() if s.name == name]
+
+    def attr_sum(self, key: str) -> int:
+        """Sum of an attr over the whole subtree (0 where absent)."""
+        return sum(s.attrs.get(key, 0) for s in self.walk()
+                   if isinstance(s.attrs.get(key, 0), (int, float)))
+
+    def to_dict(self) -> dict:
+        # snapshot attrs/children FIRST: an abandoned fan-out worker
+        # (LIMIT stopped the consumer early) may still be mutating this
+        # span while the statement thread renders it. dict()/list() are
+        # single C-level copies under the GIL — atomic, never the
+        # RuntimeError a Python-level iteration over a live dict risks;
+        # a late write is simply absent from the snapshot.
+        attrs = dict(self.attrs)
+        children = list(self.children)
+        d: dict = {"name": self.name,
+                   "duration_us": round(self.duration_us(), 3)}
+        if attrs:
+            d["attrs"] = attrs
+        if children:
+            d["children"] = [c.to_dict() for c in children]
+        return d
+
+    def __repr__(self):
+        return f"<Span {self.name} {self.duration_us():.1f}us " \
+               f"{self.attrs!r} children={len(self.children)}>"
+
+
+class _NoopSpan:
+    """Shared do-nothing span: every operation returns a constant, so an
+    untraced statement pays one thread-local read per instrumentation
+    point and zero allocations."""
+
+    __slots__ = ()
+    is_noop = True
+    name = "noop"
+    attrs: dict = {}
+    children: list = []
+
+    def child(self, name: str) -> "_NoopSpan":
+        return self
+
+    def set(self, key: str, value) -> "_NoopSpan":
+        return self
+
+    def inc(self, key: str, n: int = 1) -> None:
+        return None
+
+    def finish(self) -> None:
+        return None
+
+    def duration_us(self) -> float:
+        return 0.0
+
+    def walk(self):
+        return iter(())
+
+    def find(self, name: str) -> list:
+        return []
+
+    def attr_sum(self, key: str) -> int:
+        return 0
+
+    def to_dict(self) -> dict:
+        return {"name": "noop"}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP = _NoopSpan()
+
+
+def current():
+    """The thread's active span, or the NOOP sentinel when tracing is
+    off — callers chain `.child()/.set()/.inc()` unconditionally."""
+    sp = getattr(_tls, "span", None)
+    return sp if sp is not None else NOOP
+
+
+def attach(span) -> object:
+    """Make `span` the thread's active span (worker threads attach the
+    region-task span handed to them; the statement thread attaches its
+    root). Returns a token for detach()."""
+    prev = getattr(_tls, "span", None)
+    _tls.span = None if span is None or span.is_noop else span
+    return prev
+
+
+def detach(token) -> None:
+    _tls.span = token
+
+
+class trace:
+    """Context manager: a child span of the thread's current span, made
+    current for the block. On an untraced thread this is a no-op that
+    allocates nothing but this tiny context object."""
+
+    __slots__ = ("name", "_span", "_tok")
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self._span = None
+        if attrs:
+            parent = current()
+            if not parent.is_noop:
+                self._span = parent.child(name)
+                self._span.attrs.update(attrs)
+
+    def __enter__(self):
+        sp = self._span
+        if sp is None:
+            parent = current()
+            if parent.is_noop:
+                return NOOP
+            sp = self._span = parent.child(self.name)
+        self._tok = attach(sp)
+        return sp
+
+    def __exit__(self, *exc):
+        if self._span is not None:
+            self._span.finish()
+            detach(self._tok)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# always-on per-thread statement counters — the cheap (dict-increment)
+# attribution the slow-query log and perfschema execution-detail read
+# even when no span tree is being built. Same monotonic-per-thread
+# contract as distsql.thread_columnar_counts: snapshot before a
+# statement, diff after.
+# ---------------------------------------------------------------------------
+
+# the counter keys every consumer renders, in display order
+COUNTER_KEYS = ("kernel_dispatches", "readbacks", "readback_bytes",
+                "jit_hits", "jit_misses")
+
+
+def _tally() -> dict:
+    d = getattr(_tls, "tally", None)
+    if d is None:
+        d = _tls.tally = {}
+    return d
+
+
+def count(name: str, n: int = 1) -> None:
+    d = _tally()
+    d[name] = d.get(name, 0) + n
+
+
+def counters_snapshot() -> dict:
+    """Copy of this thread's monotonic tallies (diff two snapshots to
+    attribute a statement)."""
+    return dict(_tally())
+
+
+def counters_delta(before: dict) -> dict:
+    now = _tally()
+    keys = set(before) | set(now)
+    return {k: now.get(k, 0) - before.get(k, 0) for k in keys
+            if now.get(k, 0) != before.get(k, 0)}
+
+
+def record_dispatch(dispatches: int = 1, readbacks: int = 1,
+                    readback_bytes: int = 0) -> None:
+    """THE device-dispatch tally: per-thread statement counters + the
+    ops.* process metrics, in one place so the slow-log, perfschema and
+    /metrics surfaces can never drift apart. Called by every kernel
+    dispatch site (TpuClient._dispatch_kernel, the join kernels, the
+    region-partial combine)."""
+    from tidb_tpu import metrics
+    count("kernel_dispatches", dispatches)
+    metrics.counter("ops.kernel_dispatches").inc(dispatches)
+    if readbacks:
+        count("readbacks", readbacks)
+        count("readback_bytes", readback_bytes)
+        metrics.counter("ops.readbacks").inc(readbacks)
+        metrics.counter("ops.readback_bytes").inc(readback_bytes)
+
+
+def record_jit_cache(hit: bool) -> None:
+    """Jit-cache attribution for a compiled-kernel cache lookup."""
+    from tidb_tpu import metrics
+    if hit:
+        count("jit_hits")
+        metrics.counter("ops.jit_cache_hits").inc()
+    else:
+        count("jit_misses")
+        metrics.counter("ops.jit_cache_misses").inc()
