@@ -429,7 +429,16 @@ def measure_join(n_left: int = 1_000_000, n_right: int = 100_000):
             j.children[0].next = lambda it=lit: next(it, None)
         return j
 
-    make("device").next()       # warm: jit compile outside timed windows
+    # warm: a FULL drain, not one next() — the first drain pays jit
+    # trace+compile for the build/probe buckets AND the native row-
+    # assembly warm-up (codecx buffers, allocator growth), so the timed
+    # runs below are steady state (BENCH_r05 recorded 333k rows/s with
+    # speedup 0.94x vs dict because cold-path costs leaked into the
+    # timed window; the sizes themselves already sit above the default
+    # tidb_tpu_dispatch_floor so routing is not the variable)
+    warm = make("device")
+    while warm.next() is not None:
+        pass
     times, stats = {}, {}
     for label in ("device", "numpy", "dict"):
         best = None
@@ -603,7 +612,12 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
     parts = metrics.counter("distsql.columnar_partials")
     sess = Session(store)
     sess.execute("use fan")
-    sess.execute(REGION_FANOUT_SQL)       # warm (cache, jit)
+    # the fan-out figure measures the PACK PATH (comparable across bench
+    # rounds): the plane cache is disabled for this phase so every timed
+    # run re-packs every region; the repeat case below measures the
+    # cache against exactly this regime
+    sess.execute("set global tidb_tpu_plane_cache = 0")
+    sess.execute(REGION_FANOUT_SQL)       # warm (jit)
     h0, f0, p0 = hits.value, fbs.value, parts.value
     c0 = fused_agg.stats["partial_combines"]
     t0 = time.time()
@@ -634,6 +648,34 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
     for got, want in zip(col_results[0], row_results[0]):
         assert _close(float(got), float(want)), \
             f"region fan-out parity: {got} != {want}"
+
+    # REPEAT fan-out regime: the dashboard/serving shape the per-region
+    # plane cache exists for. The cold denominator IS the main phase
+    # above (cache disabled: every run re-packed every region); warm =
+    # cache on (every region answers from its pinned planes; hits >=
+    # regions per run). Both regimes and the row protocol must agree
+    # exactly.
+    pc_hits = metrics.counter("copr.plane_cache.hits")
+    t_cold, cold_results = t_col, col_results
+    sess.execute("set global tidb_tpu_plane_cache = 1")
+    sess.execute(REGION_FANOUT_SQL)       # populate the cache
+    h0, f0 = pc_hits.value, fbs.value
+    t0 = time.time()
+    for _ in range(runs):
+        warm_results = sess.execute(REGION_FANOUT_SQL)[0].values()
+    t_warm = (time.time() - t0) / runs
+    d_pc_hits = pc_hits.value - h0
+    assert fbs.value == f0, \
+        "plane-cache repeat run counted columnar fallbacks"
+    assert d_pc_hits >= n_regions * runs, \
+        (f"repeat fan-out hit the plane cache only {d_pc_hits}x across "
+         f"{n_regions} regions x {runs} runs")
+    for got, want in zip(warm_results[0], cold_results[0]):
+        assert _close(float(got), float(want)), \
+            f"plane-cache parity (warm vs cold): {got} != {want}"
+    for got, want in zip(warm_results[0], row_results[0]):
+        assert _close(float(got), float(want)), \
+            f"plane-cache parity (warm vs row protocol): {got} != {want}"
     return {
         "region_fanout_rows_per_sec": round(n_rows / t_col, 1),
         "region_fanout_speedup_vs_rowpath": round(t_row / t_col, 2),
@@ -641,6 +683,9 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
         "region_fanout_fallbacks": d_fbs,
         "columnar_partials": d_parts,
         "region_partial_combines": combines,
+        "region_fanout_repeat_rows_per_sec": round(n_rows / t_warm, 1),
+        "region_fanout_repeat_speedup_vs_cold": round(t_cold / t_warm, 2),
+        "plane_cache_hits": d_pc_hits,
         **trace_summary(sess, REGION_FANOUT_SQL),
     }
 
@@ -914,6 +959,11 @@ def main(smoke: bool = False):
           f"{fan_figs['region_fanout_fallbacks']} fallbacks, "
           f"{fan_figs['region_partial_combines']} device partial-combines",
           file=sys.stderr)
+    print(f"# region_fanout_repeat (plane cache): "
+          f"{fan_figs['region_fanout_repeat_rows_per_sec']:,.0f} rows/s "
+          f"warm ({fan_figs['region_fanout_repeat_speedup_vs_cold']:.2f}x "
+          f"the cold re-pack regime), {fan_figs['plane_cache_hits']} "
+          f"plane-cache hits", file=sys.stderr)
 
     geo_rps = math.exp(sum(math.log(x) for x in tpu_rps_all)
                        / len(tpu_rps_all))

@@ -51,6 +51,12 @@ def test_bench_smoke_emits_valid_json():
     assert out["columnar_partials"] >= 4
     assert out["region_fanout_fallbacks"] == 0
     assert out["region_partial_combines"] > 0
+    # the repeat fan-out (plane cache) case: every region answered the
+    # warm runs from its cached planes, parity-checked against the cold
+    # re-pack regime and the row protocol inside the bench itself
+    assert out["region_fanout_repeat_rows_per_sec"] > 0
+    assert out["plane_cache_hits"] >= 4
+    assert out["region_fanout_repeat_speedup_vs_cold"] > 0
     # trace-derived kernel/copr instrumentation summary: present and
     # non-negative, so tier-1 guards the tracing layer itself
     assert out["trace_copr_tasks"] >= 4

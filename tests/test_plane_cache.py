@@ -1,0 +1,583 @@
+"""Differential suite for the per-region columnar plane cache
+(copr.plane_cache): cache-on vs cache-off vs the row protocol must be
+row-for-row identical across every invalidation edge — a committed write
+between two runs (data-version bump → miss), a region split/merge
+mid-scan (epoch bump → miss, worklist retry re-packs), two concurrent
+sessions at different start_ts (snapshot isolation: the older snapshot
+must never see the newer version's planes), and LRU eviction under a
+tiny byte budget. Plus the observability contract: Prometheus
+counters/gauges on /metrics, per-statement thread tallies in the
+slow-query log, cache_hit/cache_miss on region_task spans, and the
+device-resident reuse path (pinned planes consumed by the device join).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+
+import pytest
+
+from tidb_tpu import metrics, tablecodec as tc
+from tidb_tpu.copr.plane_cache import cache_for
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 200
+
+JOIN_AGG_Q = ("select count(*), sum(t.v), min(t.v), max(d.d_f), avg(t.f) "
+              "from t join d on t.k = d.d_k")
+QUERIES = [
+    JOIN_AGG_Q,
+    "select t.k, count(*), sum(t.v), min(t.f) from t join d "
+    "on t.k = d.d_k group by t.k order by t.k",
+    "select t.id, t.v, d.d_f from t join d on t.k = d.d_k order by t.id",
+    "select id, v from t order by v desc limit 7",
+    "select count(*), sum(v) from t where v > 500",
+]
+
+
+def _counter(name: str) -> int:
+    return metrics.counter(f"copr.plane_cache.{name}").value
+
+
+def _build(n_regions: int = 4):
+    store = new_store(f"cluster://3/planecache{next(_id)}")
+    s = Session(store)
+    s.execute("create database pc")
+    s.execute("use pc")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double)")
+    rows = ", ".join(
+        f"({i}, {i % 7}, {i * 10}, {i}.25)" if i % 11 else
+        f"({i}, null, {i * 10}, null)"
+        for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create table d (d_k bigint primary key, d_f double)")
+    s.execute("insert into d values " +
+              ", ".join(f"({i}, {i}.5)" for i in range(7)))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("pc", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _all(s) -> list:
+    return [s.execute(q)[0].values() for q in QUERIES]
+
+
+def _parity_against_oracles(s, got: list) -> None:
+    """got must equal the cache-off regime AND the row protocol."""
+    s.execute("set global tidb_tpu_plane_cache = 0")
+    try:
+        off = _all(s)
+    finally:
+        s.execute("set global tidb_tpu_plane_cache = 1")
+    for q, g, o in zip(QUERIES, got, off):
+        assert g == o, f"cache-on diverged from cache-off on {q!r}"
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        rows = _all(s)
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+    for q, g, r in zip(QUERIES, got, rows):
+        assert g == r, f"cache-on diverged from the row protocol on {q!r}"
+
+
+def test_repeat_query_hits_cache():
+    """The repeat fan-out shape: the second run of the same query answers
+    every region from cached planes — and matches the first run, the
+    cache-off regime, and the row protocol."""
+    s = _build(4)
+    first = _all(s)
+    h0 = _counter("hits")
+    second = _all(s)
+    assert _counter("hits") - h0 >= 4, \
+        "repeat fan-out did not hit the plane cache per region"
+    for q, a, b in zip(QUERIES, first, second):
+        assert a == b, f"cached run diverged from the packing run on {q!r}"
+    _parity_against_oracles(s, second)
+
+
+def test_committed_write_invalidates_version():
+    """A commit between two runs bumps data_version_at(start_ts): the
+    next run MISSES (never serves the stale planes), sweeps the dead
+    generation, and sees the write."""
+    s = _build(4)
+    before = _all(s)
+    s.execute(JOIN_AGG_Q)    # ensure cached planes exist for the join
+    m0, iv0 = _counter("misses"), _counter("invalidations_version")
+    s.execute("insert into t values (501, 1, 99999, 1.5)")
+    after = s.execute(JOIN_AGG_Q)[0].values()
+    assert after != before[0], "committed write invisible after caching"
+    assert _counter("misses") > m0
+    assert _counter("invalidations_version") > iv0, \
+        "stale-version entries were not swept"
+    got = _all(s)
+    _parity_against_oracles(s, got)
+
+
+def test_update_and_delete_invalidate():
+    """Non-append writes (UPDATE/DELETE) also bump the version — the
+    cache must never serve planes that hide them."""
+    s = _build(4)
+    s.execute(JOIN_AGG_Q)
+    s.execute("update t set v = v + 1 where id = 50")
+    got = _all(s)
+    _parity_against_oracles(s, got)
+    s.execute("delete from t where id = 51")
+    got = _all(s)
+    _parity_against_oracles(s, got)
+
+
+class TestEpochInvalidation:
+    def test_split_between_runs(self):
+        """A region split bumps the epoch: entries packed under the old
+        shape are swept (invalidations_epoch) and never served."""
+        s = _build(4)
+        before = _all(s)
+        ie0 = _counter("invalidations_epoch")
+        tid = s.info_schema().table_by_name("pc", "t").info.id
+        s.store.cluster.split_keys([tc.encode_row_key(tid, 26)])
+        got = _all(s)
+        for q, g, w in zip(QUERIES, got, before):
+            assert g == w, f"post-split run diverged on {q!r}"
+        assert _counter("invalidations_epoch") > ie0, \
+            "old-epoch entries were not swept after the split"
+        _parity_against_oracles(s, got)
+
+    def test_merge_between_runs(self):
+        s = _build(4)
+        before = _all(s)
+        regions = s.store.cluster.regions
+        for i in range(len(regions) - 1):
+            if regions[i].start:
+                s.store.cluster.merge(regions[i].region_id,
+                                      regions[i + 1].region_id)
+                break
+        got = _all(s)
+        for q, g, w in zip(QUERIES, got, before):
+            assert g == w, f"post-merge run diverged on {q!r}"
+        _parity_against_oracles(s, got)
+
+    def test_split_mid_scan(self):
+        """Split injected DURING the fan-out (after the 2nd region
+        request): the stale-epoch retry re-packs under the new shape;
+        results match the pre-split runs and the steady state."""
+        s = _build(4)
+        store = s.store
+        want = _all(s)           # also populates the cache
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts):
+            state["n"] += 1
+            if state["n"] == 2 and not state["done"]:
+                state["done"] = True
+                tid = s.info_schema().table_by_name("pc", "t").info.id
+                store.cluster.split_keys([tc.encode_row_key(tid, 31),
+                                          tc.encode_row_key(tid, 171)])
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        try:
+            got = _all(s)
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"], "mid-scan split never fired"
+        for q, g, w in zip(QUERIES, got, want):
+            assert g == w, f"mid-scan split diverged on {q!r}"
+        after = _all(s)
+        for q, a, w in zip(QUERIES, after, want):
+            assert a == w, f"post-split steady state diverged on {q!r}"
+        _parity_against_oracles(s, after)
+
+
+def test_snapshot_isolation_across_sessions():
+    """Two sessions at different start_ts: the older snapshot (open
+    transaction) must keep seeing ITS version's planes after a newer
+    commit — and the newer session must see the write — with both
+    served through the cache."""
+    s1 = _build(4)
+    s2 = Session(s1.store)
+    s2.execute("use pc")
+    q = "select count(*), sum(v) from t"
+    s1.execute("begin")
+    old = s1.execute(q)[0].values()
+    # populate the cache at the OLD version through the open snapshot
+    old2 = s1.execute(q)[0].values()
+    assert old2 == old
+    s2.execute("insert into t values (900, 2, 777, 9.5)")
+    new = s2.execute(q)[0].values()
+    assert new != old, "newer session missed the committed write"
+    new2 = s2.execute(q)[0].values()       # cached at the new version
+    assert new2 == new
+    # the open older snapshot must NOT see the newer version's planes
+    still_old = s1.execute(q)[0].values()
+    assert still_old == old, \
+        "older snapshot served planes from a newer data version"
+    s1.execute("commit")
+    assert s1.execute(q)[0].values() == new
+
+
+def test_pending_lock_blocks_cache_hit():
+    """Percolator lock gate: a pending prewrite lock with start_ts <=
+    read_ts may resolve to a commit the reader must see (its commit_ts
+    can predate read_ts) — the scan path blocks on it; a cached hit
+    must NOT skip that check. With a blocking lock in range the cache
+    refuses to serve; once the lock resolves (TTL rollback here) the
+    result matches the pre-lock runs and hits resume."""
+    s = _build(2)
+    tid = s.info_schema().table_by_name("pc", "t").info.id
+    q = "select id, v from t order by v desc limit 5"
+    want = s.execute(q)[0].values()
+    s.execute(q)                       # populate the cache
+    key = tc.encode_row_key(tid, 10)
+    s.store.mvcc.prewrite([("put", key, b"xx")], primary=key,
+                          start_ts=s.store.oracle.current_version(),
+                          ttl_ms=1)    # expires immediately → rollback
+    got = s.execute(q)[0].values()
+    assert got == want
+    # the observable contract: the statement RESOLVED the lock (gate →
+    # pack path → KeyIsLockedError → resolver ladder → TTL rollback)
+    # instead of serving cached planes past it and leaving it pending.
+    # (Serving a hit on the post-resolution retry is fine — a rollback
+    # commits nothing, so the cached planes are still the snapshot.)
+    # Pre-gate this bypassed the scan and left the lock in place;
+    # pre-seed-fix the statement died with "coprocessor error: key
+    # locked" because the row handler stringified the retryable error.
+    assert key not in s.store.mvcc._locks, \
+        "cached planes served past a pending blocking lock"
+    # a non-blocking 'lock' kind (SELECT FOR UPDATE) must NOT gate hits
+    key2 = tc.encode_row_key(tid, 11)
+    s.store.mvcc.prewrite([("lock", key2, None)], primary=key2,
+                          start_ts=s.store.oracle.current_version(),
+                          ttl_ms=60000)
+    try:
+        s.execute(q)                   # repopulate post-rollback version
+        h1 = _counter("hits")
+        assert s.execute(q)[0].values() == want
+        assert _counter("hits") > h1, \
+            "a SELECT FOR UPDATE lock wrongly gated the cache"
+    finally:
+        s.store.mvcc.rollback([key2], s.store.mvcc._locks[key2].start_ts
+                              if key2 in s.store.mvcc._locks else 0)
+
+
+def test_tpu_client_batch_cache_lock_gate():
+    """TpuClient on a cluster store (SET tidb_copr_backend='tpu'): its
+    in-proc batch cache obeys the same Percolator lock gate — a pending
+    blocking lock in the scanned ranges bypasses the hit so the
+    snapshot scan resolves the lock, exactly like the region cache."""
+    from tidb_tpu.ops import TpuClient
+    s = _build(1)
+    store = s.store
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
+    s2 = Session(store)
+    s2.execute("use pc")
+    q = "select count(*), sum(v) from t"
+    want = s2.execute(q)[0].values()
+    s2.execute(q)                     # populate the client batch cache
+    client = store.get_client()
+    tid = s2.info_schema().table_by_name("pc", "t").info.id
+    key = tc.encode_row_key(tid, 10)
+    store.mvcc.prewrite([("put", key, b"xx")], primary=key,
+                        start_ts=store.oracle.current_version(),
+                        ttl_ms=1)
+    h0 = client.stats["batch_hits"]
+    assert s2.execute(q)[0].values() == want
+    assert key not in store.mvcc._locks, \
+        "TpuClient batch-cache hit served past a pending blocking lock"
+    assert client.stats["batch_hits"] == h0, \
+        "batch cache hit under a pending blocking lock"
+
+
+def test_bootstrap_hydration_reaches_region_cache_on_tpu_backend():
+    """Persisted tidb_tpu_plane_cache=0 / _bytes must hydrate the region
+    cache on restart EVEN when tidb_copr_backend='tpu' is persisted too
+    (the backend branch used to skip the cache hydration block)."""
+    from tidb_tpu import session as sess_mod
+    s = _build(1)
+    store = s.store
+    s.execute("set global tidb_copr_backend = 'tpu'")
+    s.execute("set global tidb_tpu_plane_cache = 0")
+    s.execute("set global tidb_tpu_plane_cache_bytes = 12345")
+    pc = cache_for(store)
+    pc.enabled = True                 # simulate a fresh process's default
+    pc.budget_bytes = 999
+    try:
+        # simulate restart: drop the bootstrapped mark and re-bind
+        sess_mod._BOOTSTRAPPED_STORES.discard(store.uuid())
+        sess_mod._global_vars_by_store.pop(store.uuid(), None)
+        s2 = Session(store)
+        assert pc.enabled is False, \
+            "persisted plane-cache kill switch reverted on tpu backend"
+        assert pc.budget_bytes == 12345
+        s2.execute("set global tidb_tpu_plane_cache = 1")
+    finally:
+        s.execute("set global tidb_tpu_plane_cache_bytes = 268435456")
+        s.execute("set global tidb_copr_backend = 'cpu'")
+
+
+def test_lru_eviction_under_tiny_budget():
+    """A byte budget smaller than the working set forces LRU evictions;
+    results stay exact and the eviction counter moves."""
+    s = _build(4)
+    s.execute("set global tidb_tpu_plane_cache_bytes = 40000")
+    try:
+        ev0 = _counter("evictions")
+        first = _all(s)
+        second = _all(s)
+        assert _counter("evictions") > ev0, \
+            "tiny budget never evicted an entry"
+        for q, a, b in zip(QUERIES, first, second):
+            assert a == b, f"evicting cache diverged on {q!r}"
+        _parity_against_oracles(s, second)
+        pc = cache_for(s.store)
+        assert pc.bytes_cached <= 40000
+    finally:
+        s.execute("set global tidb_tpu_plane_cache_bytes = 268435456")
+
+
+def test_budget_zero_caches_nothing():
+    s = _build(2)
+    s.execute("set global tidb_tpu_plane_cache_bytes = 0")
+    try:
+        h0 = _counter("hits")
+        got = [s.execute(JOIN_AGG_Q)[0].values() for _ in range(2)]
+        assert got[0] == got[1]
+        assert _counter("hits") == h0
+        assert len(cache_for(s.store)) == 0
+    finally:
+        s.execute("set global tidb_tpu_plane_cache_bytes = 268435456")
+
+
+def test_sysvars_global_only():
+    s = _build(1)
+    from tidb_tpu import errors
+    with pytest.raises(errors.ExecError):
+        s.execute("set tidb_tpu_plane_cache = 0")
+    with pytest.raises(errors.ExecError):
+        s.execute("set tidb_tpu_plane_cache_bytes = 1024")
+    assert s.execute("select @@tidb_tpu_plane_cache")[0].values() \
+        == [["1"]]
+
+
+def test_kill_switch_disables_and_clears():
+    s = _build(4)
+    before = _all(s)
+    pc = cache_for(s.store)
+    assert len(pc) > 0
+    s.execute("set global tidb_tpu_plane_cache = 0")
+    try:
+        assert len(pc) == 0, "kill switch left entries resident"
+        h0 = _counter("hits")
+        got = _all(s)
+        assert _counter("hits") == h0, "disabled cache served a hit"
+        for q, g, w in zip(QUERIES, got, before):
+            assert g == w, f"cache-off diverged on {q!r}"
+    finally:
+        s.execute("set global tidb_tpu_plane_cache = 1")
+
+
+def test_kill_switch_clears_tpu_client_batch_cache():
+    """The same switch governs the in-proc TpuClient batch cache: off
+    stops serving AND drops the held batches (with their device pins)."""
+    from tidb_tpu.ops import TpuClient
+    store = new_store(f"memory://planecache{next(_id)}")
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
+    s = Session(store)
+    s.execute("create database pc; use pc")
+    s.execute("create table t (id bigint primary key, v bigint)")
+    s.execute("insert into t values " +
+              ", ".join(f"({i}, {i * 3})" for i in range(1, 60)))
+    q = "select count(*), sum(v) from t"
+    want = s.execute(q)[0].values()
+    client = store.get_client()
+    assert s.execute(q)[0].values() == want
+    assert client._batch_cache, "warm query never cached a batch"
+    h0 = client.stats["batch_hits"]
+    s.execute("set global tidb_tpu_plane_cache = 0")
+    try:
+        assert not client._batch_cache, \
+            "kill switch left TpuClient batches resident"
+        assert s.execute(q)[0].values() == want
+        assert client.stats["batch_hits"] == h0, \
+            "disabled batch cache served a hit"
+    finally:
+        s.execute("set global tidb_tpu_plane_cache = 1")
+    assert s.execute(q)[0].values() == want
+
+
+class TestObservability:
+    def test_metrics_exposition(self):
+        s = _build(4)
+        _all(s)
+        _all(s)
+        text = metrics.render_text()
+        assert "# TYPE copr_plane_cache_hits counter" in text
+        assert "# TYPE copr_plane_cache_bytes_pinned gauge" in text
+        assert "# TYPE copr_plane_cache_entries gauge" in text
+        pc = cache_for(s.store)
+        assert pc.bytes_cached > 0
+        ent = metrics.gauge("copr.plane_cache.entries").value
+        assert ent >= len(pc)   # other stores in-process may add more
+
+    def test_slow_log_thread_tallies(self, caplog):
+        """Per-statement plane-cache tallies ride the slow-query log with
+        the same monotonic-diff contract as columnar_hits — and two
+        runs attribute hit vs miss to the right statement."""
+        s = _build(4)
+        s.execute("set tidb_slow_log_threshold = 0.001")
+        with caplog.at_level(logging.WARNING, logger="tidb_tpu.slowlog"):
+            s.execute(JOIN_AGG_Q)
+            s.execute(JOIN_AGG_Q)
+        msgs = [r.getMessage() for r in caplog.records
+                if "SLOW_QUERY" in r.getMessage()
+                and "from t join d" in r.getMessage()]
+        assert len(msgs) >= 2
+        assert "plane_cache_misses:" in msgs[0], msgs[0]
+        assert "plane_cache_hits:" in msgs[-1], msgs[-1]
+        assert "plane_cache_misses:" not in msgs[-1], msgs[-1]
+
+    def test_region_task_span_cache_attrs(self):
+        """cache_hit / cache_miss land on the region_task spans of a
+        traced statement."""
+        s = _build(4)
+        s.execute(JOIN_AGG_Q)                      # populate
+        s.execute("set tidb_trace_enabled = 1")
+        try:
+            s.execute(JOIN_AGG_Q)
+            root = s.last_trace
+        finally:
+            s.execute("set tidb_trace_enabled = 0")
+        tasks = root.find("region_task")
+        assert tasks, "traced fan-out produced no region_task spans"
+        hits = sum(t.attrs.get("cache_hit", 0) for t in tasks)
+        assert hits >= 4, [t.attrs for t in tasks]
+        copr = root.find("copr")
+        assert any(sp.attrs.get("plane_cache_hits", 0) >= 4
+                   for sp in copr), [sp.attrs for sp in copr]
+
+
+class TestDeviceResidentReuse:
+    def test_pinned_planes_and_device_plane_parity(self):
+        """Cached batches are pinned device-resident (jax is live in the
+        test process); the columnar payload's device planes must equal
+        its host planes value-for-value."""
+        import numpy as np
+        s = _build(2)
+        s.execute(JOIN_AGG_Q)
+        pc = cache_for(s.store)
+        assert pc.bytes_pinned > 0, "admitted batches were not pinned"
+        from tidb_tpu.ops import columnar as col
+        info = s.info_schema().table_by_name("pc", "t").info
+        parts = _cached_scan_results(s, pc, info)
+        assert parts, "no cached batch for table t"
+        res = parts[0]
+        assert getattr(res.batch, "_device_planes", None) is not None
+        checked = 0
+        for j in range(len(res.pb_cols)):
+            kind, vals, valid = res.column_plane(j)
+            dev = res.device_plane(j)
+            if kind in ("i64", "f64") and dev is not None:
+                dv, dva = np.asarray(dev[0]), np.asarray(dev[1])
+                assert dva.tolist() == valid.tolist()
+                assert dv[valid].tolist() == vals[valid].tolist()
+                checked += 1
+        assert checked >= 2, "no numeric device planes to check"
+
+    def test_device_join_over_cached_fanout(self):
+        """With the dispatch floor at 0, a cluster-store join routes to
+        the device kernels and consumes the cached partials' DEVICE
+        planes (no host→device key transfer); results match the numpy
+        route exactly."""
+        from tidb_tpu.ops import kernels
+        s = _build(4)
+        base = _all(s)
+        kd = metrics.counter("ops.kernel_dispatches")
+        s.execute("set global tidb_tpu_dispatch_floor = 0")
+        seen = {"device_keys": False}
+        orig = kernels.join_match_pairs
+
+        def spy(lkey, lvalid, rkey, rvalid, stats=None, device_keys=None):
+            if device_keys is not None:
+                seen["device_keys"] = True
+            return orig(lkey, lvalid, rkey, rvalid, stats=stats,
+                        device_keys=device_keys)
+
+        kernels.join_match_pairs = spy
+        try:
+            s.execute(JOIN_AGG_Q)        # populate under the new version
+            k0 = kd.value
+            got = _all(s)
+            assert kd.value > k0, "floor 0 never dispatched a device join"
+        finally:
+            kernels.join_match_pairs = orig
+            s.execute("set global tidb_tpu_dispatch_floor = 16384")
+        assert seen["device_keys"], \
+            "device join never consumed the cached DEVICE key planes"
+        for q, g, w in zip(QUERIES, got, base):
+            assert g == w, f"device route diverged from numpy on {q!r}"
+
+    def test_partial_set_device_stacking(self):
+        """ColumnarPartialSet.device_plane stacks per-region pinned
+        planes with the jitted device concat and equals the host
+        np.concatenate stacking exactly."""
+        import numpy as np
+        from tidb_tpu.ops import columnar as col
+        s = _build(4)
+        s.execute(JOIN_AGG_Q)                  # populate all regions
+        pc = cache_for(s.store)
+        info = s.info_schema().table_by_name("pc", "t").info
+        parts = _cached_scan_results(s, pc, info)
+        assert len(parts) >= 2, "expected multiple cached region batches"
+        ps = col.ColumnarPartialSet(parts)
+        checked = 0
+        for j in range(len(ps.pb_cols)):
+            kind, vals, valid = ps.column_plane(j)
+            dev = ps.device_plane(j)
+            if kind in ("i64", "f64") and dev is not None:
+                dv, dva = np.asarray(dev[0]), np.asarray(dev[1])
+                assert dva.tolist() == valid.tolist()
+                assert dv[valid].tolist() == vals[valid].tolist()
+                checked += 1
+        assert checked >= 2
+
+
+def _cached_scan_results(s, pc, info):
+    """One ColumnarScanResult (all live rows selected) per cached batch
+    of `info`'s table, in region-start order — the cache key records the
+    scanned column ids, so each wrapper carries exactly the columns its
+    batch packed."""
+    import numpy as np
+    from tidb_tpu.ops import columnar as col
+    by_id = {c.id: c for c in info.columns}
+    pb_all = {c.column_id: c for c in _pb_columns(info)}
+    out = []
+    for fk, ent in sorted(pc._entries.items(),
+                          key=lambda kv: kv[0][3]):   # by range bounds
+        region_id, table_id, cids = fk[0], fk[1], fk[2]
+        if table_id != info.id or not all(c in by_id for c in cids):
+            continue
+        out.append(col.ColumnarScanResult(
+            ent.batch, np.arange(ent.batch.n_rows, dtype=np.int64),
+            [pb_all[c] for c in cids]))
+    return out
+
+
+def _pb_columns(info):
+    """PBColumnInfo list for a table the way the executor builds scan
+    requests (executor.distsql_exec._pb_col contract)."""
+    from tidb_tpu.copr.proto import PBColumnInfo
+    pk = info.pk_handle_column()
+    return [PBColumnInfo(column_id=c.id, tp=c.field_type.tp,
+                         flag=c.field_type.flag, flen=c.field_type.flen,
+                         decimal=c.field_type.decimal,
+                         pk_handle=pk is not None and c.id == pk.id,
+                         elems=list(c.field_type.elems))
+            for c in info.columns]

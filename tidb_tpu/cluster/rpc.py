@@ -58,6 +58,11 @@ class RpcHandler:
         self.mvcc = mvcc
         self.down_stores: set[int] = set()
         self.busy_stores: set[int] = set()
+        # per-region columnar plane cache (server-side, like TiKV's copr
+        # cache): keyed by (region id, epoch, data version, table,
+        # columns, range bounds) so a hit is provably snapshot-consistent
+        from tidb_tpu.copr.plane_cache import PlaneCache
+        self.plane_cache = PlaneCache()
 
     # ---- region context validation ----
 
@@ -139,7 +144,10 @@ class RpcHandler:
             # exactly fall through to the row handler for this region
             # only — the client counts the channel per PARTIAL
             from tidb_tpu.copr.columnar_region import handle_columnar_scan
-            resp = handle_columnar_scan(snapshot, sel, clipped)
+            resp = handle_columnar_scan(
+                snapshot, sel, clipped,
+                region=(ctx.region_id, region.epoch()),
+                cache=self.plane_cache)
             if resp is not None:
                 return resp
         return handle_request(snapshot, sel, clipped)

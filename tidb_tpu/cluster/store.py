@@ -125,9 +125,20 @@ class DistCoprClient(kv.Client):
         # chunk rows (copr.columnar_region). SET GLOBAL
         # tidb_tpu_columnar_scan = 0 pins every region back to the row
         # protocol — same store-level resolution contract as TpuClient.
-        from tidb_tpu.sessionctx import store_bool_sysvar
+        from tidb_tpu.sessionctx import store_bool_sysvar, store_int_sysvar
         self.columnar_scan = store_bool_sysvar(store,
                                                "tidb_tpu_columnar_scan")
+        # executor-layer join routing over the fan-out's columnar planes:
+        # HashJoinExec reads these (the same contract as TpuClient) so a
+        # cluster-store join at/above the floor runs the device
+        # build/probe kernels straight off plane-cache-pinned region
+        # planes — no TpuClient install required. The TPU tier must
+        # already be live in the process (HashJoinExec gates on
+        # tidb_tpu.ops.client being imported); a jax-free cluster
+        # deployment keeps the numpy path unconditionally.
+        self.device_join = store_bool_sysvar(store, "tidb_tpu_device_join")
+        self.dispatch_floor_rows = store_int_sysvar(
+            store, "tidb_tpu_dispatch_floor")
 
     def support_request_type(self, req_type: int, sub_type) -> bool:
         if req_type not in (kv.REQ_TYPE_SELECT, kv.REQ_TYPE_INDEX):

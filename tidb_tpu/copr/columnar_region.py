@@ -31,9 +31,19 @@ from tidb_tpu.ops import columnar as col
 
 
 def handle_columnar_scan(snapshot, sel: SelectRequest,
-                         ranges: list[KeyRange]) -> SelectResponse | None:
+                         ranges: list[KeyRange], region=None,
+                         cache=None) -> SelectResponse | None:
     """One region's share of a columnar_hint scan as a columnar partial,
-    or None → the caller runs the row handler for this region."""
+    or None → the caller runs the row handler for this region.
+
+    With `region` ((region_id, epoch), as validated by the RPC epoch
+    check) and a `cache` (copr.plane_cache.PlaneCache), the post-pack
+    pre-filter planes for the clipped ranges are served from / admitted
+    to the per-region plane cache keyed by (region_id, epoch,
+    data_version_at(start_ts), table_id, column set, range bounds) — a
+    repeat fan-out query skips the native repack (and, with pinned
+    planes, the host→device transfer). The filter/TopN selection still
+    evaluates per request; only the snapshot-determined pack is shared."""
     if sel.table_info is None or sel.is_agg():
         # index scans and pushed aggregates keep the row/partial-row
         # protocol (columnar index results are a ROADMAP open item)
@@ -44,17 +54,54 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
     columns = sel.table_info.columns
     defaults = {c.column_id: c.default_val for c in columns
                 if c.default_val is not None}
+    batch = None
+    cache_info = None
+    base_key = version = None
+    mvcc = getattr(snapshot, "mvcc", None)
+    if cache is not None and cache.enabled and region is not None \
+            and mvcc is not None \
+            and not any(mvcc.has_blocking_lock(snapshot.read_ts,
+                                               rg.start, rg.end)
+                        for rg in ranges):
+        # Percolator lock gate: a pending lock with start_ts <= read_ts
+        # can resolve to a commit whose commit_ts was allocated BEFORE
+        # read_ts — the scan path blocks on it, resolves, and includes
+        # the write; a cached hit would silently skip that lock check
+        # and serve a snapshot missing it (two reads at the same
+        # read_ts could then disagree). Any blocking lock in range
+        # forces the pack path, whose scan raises KeyIsLockedError into
+        # the client's resolver ladder exactly like the row handler.
+        version = mvcc.data_version_at(snapshot.read_ts)
+        base_key = (region[0], sel.table_info.table_id,
+                    tuple(c.column_id for c in columns),
+                    tuple((r.start, r.end) for r in ranges))
+        batch, cache_info = cache.lookup(base_key, region[1], version)
+        # cache_hit / cache_miss land on the region_task span the fan-out
+        # worker attached (NOOP when untraced)
+        tracing.current().inc("cache_hit" if batch is not None
+                              else "cache_miss")
     try:
-        with tracing.trace("pack") as psp:
-            batch = col.pack_ranges(snapshot, sel.table_info.table_id,
-                                    columns, ranges, defaults)
-            psp.set("rows", batch.n_rows)
+        if batch is None:
+            with tracing.trace("pack") as psp:
+                batch = col.pack_ranges(snapshot, sel.table_info.table_id,
+                                        columns, ranges, defaults)
+                psp.set("rows", batch.n_rows)
+            if base_key is not None:
+                # sound only if the visible version held still across the
+                # pack (lock resolution can land commits below start_ts
+                # mid-scan — same stabilization rule as TpuClient's
+                # batch cache); a churned version serves uncached
+                if mvcc.data_version_at(snapshot.read_ts) == version:
+                    cache.insert(base_key, region[1], version, batch,
+                                 cache_info)
         with tracing.trace("filter") as fsp:
             mask = _filter_mask(sel, batch)
             if mask is not None:
                 fsp.set("rows_out", int(np.count_nonzero(mask)))
     except errors.TypeError_:
         return None      # no exact plane mapping: the CPU engine answers
+    except errors.RetryableError:
+        raise   # pending lock mid-pack: the client ladder resolves it
     except errors.TiDBError:
         return None
     if mask is None:
@@ -72,8 +119,12 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
             idx = idx[::-1]
         if sel.limit is not None:
             idx = idx[: sel.limit]
-    return SelectResponse(columnar=col.ColumnarScanResult(
-        batch, np.asarray(idx, dtype=np.int64), list(columns)))
+    res = col.ColumnarScanResult(batch, np.asarray(idx, dtype=np.int64),
+                                 list(columns))
+    # per-response attribution: the client rolls these into the
+    # statement thread's monotonic tallies (slow-log / perfschema)
+    res.cache_info = cache_info
+    return SelectResponse(columnar=res)
 
 
 def _filter_mask(sel: SelectRequest, batch: col.ColumnBatch):

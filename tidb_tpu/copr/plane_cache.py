@@ -1,0 +1,278 @@
+"""Per-region columnar plane cache: repeat fan-out queries skip the repack.
+
+Every execution of a columnar_hint scan used to re-pack each region's rows
+into planes from the MVCC store and re-ship them host→device. For repeat
+queries — the dominant shape of dashboard/serving traffic — that repack is
+pure waste: the visible row set of a region is fully determined by
+(region epoch, visible data version), both of which the infrastructure
+already tracks. This cache keys the post-pack, pre-filter/pre-TopN
+ColumnBatch of one region's clipped ranges by
+
+    (region_id, region epoch, data_version_at(start_ts),
+     table_id, column set, range bounds)
+
+so a hit is provably snapshot-consistent:
+
+* `DistStore.data_version_at(start_ts)` (cluster/mvcc.py) counts commit
+  events visible at start_ts — equal versions imply identical visible
+  data, and ANY commit bumps it, so a cached batch can never hide a
+  write. Two snapshots at different start_ts map to different versions
+  and to different entries — an older reader never sees a newer
+  version's planes (and vice versa).
+* The region `epoch()` (cluster/topology.py) bumps on split/merge, so a
+  topology change orphans every entry packed under the old shape; the
+  worklist retry re-packs under the new epoch.
+
+Entries are byte-budget LRU (SET GLOBAL tidb_tpu_plane_cache_bytes) with
+a kill switch (SET GLOBAL tidb_tpu_plane_cache = 0). When the TPU tier is
+live in the process, inserted batches are pinned DEVICE-resident
+(ops.client.pin_batch_device): a repeat query then skips the host→device
+transfer too — the join/aggregate tier reads the planes straight out of
+HBM (ColumnarScanResult.device_plane / ColumnarPartialSet.device_plane).
+
+Caching materialized pushdown state near the compute is the core lever in
+near-data-processing systems (PAPERS: "Near Data Processing in Taurus
+Database", "Enhancing Computation Pushdown for Cloud OLAP Databases").
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import weakref
+from collections import OrderedDict
+
+from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+
+DEFAULT_BUDGET_BYTES = int(SYSVAR_DEFAULTS["tidb_tpu_plane_cache_bytes"])
+
+# counter names exported through metrics/ (Prometheus) and, per statement,
+# through the thread-local tallies in the slow-query log (prefixed
+# plane_cache_), in display order
+COUNTER_NAMES = ("hits", "misses", "evictions", "invalidations_epoch",
+                 "invalidations_version")
+
+
+def _metric(name: str):
+    from tidb_tpu import metrics
+    return metrics.counter(f"copr.plane_cache.{name}")
+
+
+# live caches in this process (one per cluster store): the byte/entry
+# gauges are process-wide, so they SUM across instances — a per-instance
+# absolute set would be last-writer-wins when several stores coexist
+_instances: "weakref.WeakSet[PlaneCache]" = weakref.WeakSet()
+
+
+def _update_gauges() -> None:
+    from tidb_tpu import metrics
+    caches = list(_instances)
+    metrics.gauge("copr.plane_cache.bytes").set(
+        sum(c._bytes for c in caches))
+    metrics.gauge("copr.plane_cache.bytes_pinned").set(
+        sum(c._bytes_pinned for c in caches))
+    metrics.gauge("copr.plane_cache.entries").set(
+        sum(len(c._entries) for c in caches))
+
+
+def batch_nbytes(batch) -> int:
+    """Byte footprint of one cached ColumnBatch (host planes + string
+    dictionaries; device pins mirror the numeric plane bytes)."""
+    n = int(batch.handles.nbytes)
+    for cd in batch.columns.values():
+        n += int(cd.values.nbytes) + int(cd.valid.nbytes)
+        if cd.dictionary:
+            # bytes payload + per-entry object overhead estimate
+            n += sum(len(b) for b in cd.dictionary) + 64 * len(cd.dictionary)
+    return n
+
+
+class _Entry:
+    __slots__ = ("batch", "nbytes", "epoch", "version", "pinned")
+
+    def __init__(self, batch, nbytes: int, epoch, version: int,
+                 pinned: bool):
+        self.batch = batch
+        self.nbytes = nbytes
+        self.epoch = epoch
+        self.version = version
+        self.pinned = pinned
+
+
+class PlaneCache:
+    """Byte-budget LRU of per-region packed ColumnBatches.
+
+    base_key = (region_id, table_id, column ids, clipped range bounds);
+    full key = base_key + (epoch, version). Lookups sweep the queried
+    REGION's entries for provably-dead generations — a different epoch
+    (split/merge moved the region's bounds) or a strictly older data
+    version (a commit made those planes invisible to every future
+    reader) — and count the sweep per cause. Entries at a NEWER version
+    than the lookup survive: an old-snapshot reader must not evict the
+    planes current readers are hitting (snapshot isolation works both
+    ways). Thread-safe: fan-out workers for different regions hit it
+    concurrently."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.budget_bytes = budget_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._by_region: dict[int, set] = {}   # region_id → {full_key}
+        self._bytes = 0
+        self._bytes_pinned = 0
+        _instances.add(self)
+
+    # ---- introspection (tests / gauges) ----
+
+    @property
+    def bytes_cached(self) -> int:
+        return self._bytes
+
+    @property
+    def bytes_pinned(self) -> int:
+        return self._bytes_pinned
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ------------------------------------------------------------------
+
+    def lookup(self, base_key: tuple, epoch, version: int):
+        """(batch, attribution) — batch is None on a miss. attribution is
+        the per-response counter dict the client rolls into the
+        statement's thread-local tallies (same monotonic-diff contract
+        as distsql.columnar_hits); process metrics count here, at the
+        cache, so they stay exact even when a response is abandoned."""
+        full_key = base_key + (epoch, version)
+        region_id = base_key[0]
+        with self._lock:
+            ent = self._entries.get(full_key)
+            if ent is not None:
+                self._entries.move_to_end(full_key)
+                _metric("hits").inc()
+                return ent.batch, {"hits": 1}
+            info = {"misses": 1}
+            _metric("misses").inc()
+            # invalidation sweep for THIS region: entries whose epoch
+            # moved (split/merge) or whose data version is strictly
+            # older than the querying reader's can never serve again
+            swept = 0
+            for fk in list(self._by_region.get(region_id, ())):
+                e = self._entries.get(fk)
+                if e is None:
+                    continue
+                same_base = fk[:-2] == base_key
+                if e.epoch != epoch:
+                    self._remove(fk, e)
+                    swept += 1
+                    info["invalidations_epoch"] = \
+                        info.get("invalidations_epoch", 0) + 1
+                    _metric("invalidations_epoch").inc()
+                elif same_base and e.version < version:
+                    self._remove(fk, e)
+                    swept += 1
+                    info["invalidations_version"] = \
+                        info.get("invalidations_version", 0) + 1
+                    _metric("invalidations_version").inc()
+            if swept:
+                self._update_gauges()   # once per sweep, not per entry
+            return None, info
+
+    def insert(self, base_key: tuple, epoch, version: int, batch,
+               info: dict | None = None) -> None:
+        """Admit a freshly packed batch (device-pinning it when the TPU
+        tier is live); LRU-evicts to the byte budget. `info`, when given,
+        accumulates the evictions this insert caused (per-statement
+        attribution for the statement that packed)."""
+        nbytes = batch_nbytes(batch)
+        full_key = base_key + (epoch, version)
+        with self._lock:
+            # admission BEFORE the device pin: a rejected entry (kill
+            # switch raced the pack, or batch beyond the whole budget)
+            # must not pay a dead host→device transfer
+            if not self.enabled or nbytes > self.budget_bytes:
+                return
+        pinned = _maybe_pin_device(batch)   # H2D outside the lock
+        with self._lock:
+            if not self.enabled or nbytes > self.budget_bytes:
+                return      # re-check: the switch/budget may have moved
+            old = self._entries.pop(full_key, None)
+            if old is not None:
+                self._account_remove(old)
+            self._entries[full_key] = _Entry(batch, nbytes, epoch, version,
+                                             pinned)
+            self._by_region.setdefault(base_key[0], set()).add(full_key)
+            self._bytes += nbytes
+            if pinned:
+                self._bytes_pinned += nbytes
+            while self._bytes > self.budget_bytes and self._entries:
+                fk, ent = self._entries.popitem(last=False)
+                self._unindex(fk)
+                self._account_remove(ent)
+                _metric("evictions").inc()
+                if info is not None:
+                    info["evictions"] = info.get("evictions", 0) + 1
+            self._update_gauges()
+
+    def set_budget(self, budget_bytes: int) -> None:
+        with self._lock:
+            self.budget_bytes = budget_bytes
+            while self._bytes > self.budget_bytes and self._entries:
+                fk, ent = self._entries.popitem(last=False)
+                self._unindex(fk)
+                self._account_remove(ent)
+                _metric("evictions").inc()
+            self._update_gauges()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_region.clear()
+            self._bytes = self._bytes_pinned = 0
+            self._update_gauges()
+
+    # ---- internals (lock held) ----
+
+    def _remove(self, full_key: tuple, ent: _Entry) -> None:
+        # gauge refresh is the CALLER's job (batched once per sweep)
+        self._entries.pop(full_key, None)
+        self._unindex(full_key)
+        self._account_remove(ent)
+
+    def _unindex(self, full_key: tuple) -> None:
+        keys = self._by_region.get(full_key[0])
+        if keys is not None:
+            keys.discard(full_key)
+            if not keys:
+                self._by_region.pop(full_key[0], None)
+
+    def _account_remove(self, ent: _Entry) -> None:
+        self._bytes -= ent.nbytes
+        if ent.pinned:
+            self._bytes_pinned -= ent.nbytes
+
+    def _update_gauges(self) -> None:
+        _update_gauges()
+
+
+def _maybe_pin_device(batch) -> bool:
+    """Pin the batch's planes device-resident when the TPU tier is live
+    in this process — the H2D happens once, at insert, and every repeat
+    query reads HBM. A jax-free deployment never pays (or imports)
+    anything here."""
+    if sys.modules.get("jax") is None:
+        return False
+    try:
+        from tidb_tpu.ops.client import pin_batch_device
+        pin_batch_device(batch)
+        return True
+    except Exception:
+        return False            # device tier broken ≠ cache broken
+
+
+def cache_for(store):
+    """The store's region plane cache, or None (non-cluster storage) —
+    the supported handle for SET GLOBAL / bootstrap hydration."""
+    return getattr(getattr(store, "rpc", None), "plane_cache", None)

@@ -44,6 +44,14 @@ def handle_request(snapshot, req: SelectRequest,
         else:
             raise errors.ExecError("SelectRequest has neither table nor index info")
         return ctx.finish()
+    except errors.RetryableError:
+        # pending locks (KeyIsLockedError) and region errors drive the
+        # CLIENT's resolve-and-retry ladder (DistCoprClient._exec_range)
+        # — stringifying them into an error response used to strand the
+        # statement with "coprocessor error: key ... locked by txn"
+        # instead of resolving the lock (seed bug, surfaced by the plane
+        # cache's hit-side lock gate tests)
+        raise
     except errors.TiDBError as e:
         return SelectResponse(error=str(e))
 

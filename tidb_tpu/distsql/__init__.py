@@ -48,6 +48,22 @@ def _count(attr: str, n: int, span=None) -> None:
             span.inc(f"columnar_{attr}", n)
 
 
+def _count_plane_cache(payload, span) -> None:
+    """Roll one columnar partial's plane-cache attribution (hit/miss/
+    eviction/invalidation counts the region recorded on the response)
+    into the STATEMENT thread's monotonic tallies — the fan-out packs on
+    worker threads, so the cache site itself cannot attribute to the
+    statement; process metrics count at the cache and stay exact."""
+    info = getattr(payload, "cache_info", None)
+    if not info:
+        return
+    from tidb_tpu import tracing
+    for k, v in info.items():
+        if v:
+            tracing.count(f"plane_cache_{k}", v)
+            span.inc(f"plane_cache_{k}", v)
+
+
 class SelectResult:
     """Iterates (handle, typed row) across all regions of one request.
 
@@ -130,6 +146,9 @@ class SelectResult:
             if part.error:
                 raise errors.ExecError(f"coprocessor error: {part.error}")
         payloads = [getattr(p, "columnar", None) for p in parts]
+        for p in payloads:
+            if p is not None:
+                _count_plane_cache(p, self.span)
         n_col = sum(1 for p in payloads if p is not None)
         _count("hits", n_col, self.span)
         if n_col == len(parts):
@@ -165,8 +184,11 @@ class SelectResult:
                 # columnar() fell back on a row-answered first partial;
                 # later partials stream through here — keep the
                 # per-PARTIAL channel attribution as they arrive
-                _count("fallbacks" if getattr(part, "columnar", None)
-                       is None else "hits", 1, self.span)
+                payload = getattr(part, "columnar", None)
+                _count("fallbacks" if payload is None else "hits", 1,
+                       self.span)
+                if payload is not None:
+                    _count_plane_cache(payload, self.span)
             self._rows = iter_response_rows(part)
 
     def _decode(self, datums: list[Datum]) -> list[Datum]:

@@ -497,6 +497,15 @@ class HashJoinExec(Executor):
         if isinstance(client, mod.TpuClient) and \
                 getattr(client, "device_join", True):
             return client.dispatch_floor_rows
+        # any other client exposing the routing pair (the cluster store's
+        # DistCoprClient): joins over per-region columnar planes route to
+        # the device kernels by the same floor — with plane-cache-pinned
+        # planes the keys never leave HBM. The sys.modules gate above
+        # keeps jax-free deployments on the numpy path.
+        if client is not None \
+                and getattr(client, "device_join", False) \
+                and hasattr(client, "dispatch_floor_rows"):
+            return client.dispatch_floor_rows
         return None
 
     def _try_vector_join(self) -> bool:
@@ -549,7 +558,8 @@ class HashJoinExec(Executor):
             self._prebuilt_right = rside.rows()
             self._left_iter = iter(lside.rows())
             return False
-        if rkey.dtype != lkey.dtype:
+        dtype_mismatch = rkey.dtype != lkey.dtype
+        if dtype_mismatch:
             # int side vs float side never match under the dict path's
             # codec keys; replicate by matching nothing / outer-padding
             lvalid = np.zeros_like(lvalid)
@@ -562,9 +572,18 @@ class HashJoinExec(Executor):
                        for r in lside.rows()]
         floor = self._device_join_floor()
         if floor is not None and max(len(lside), len(rside)) >= floor:
+            # device-resident key planes (plane-cache-pinned region
+            # batches): the device route then pads/gathers in HBM and
+            # skips the per-query host→device key transfer entirely.
+            # Resolved only once the floor admits the device route — the
+            # gathers are device dispatches a below-floor (numpy-path)
+            # join must not pay.
+            device_keys = None if dtype_mismatch else \
+                self._side_device_keys(lside, rside, lcol, rcol)
             try:
                 self._start_device(lside, rside, lkey, lvalid, rkey,
-                                   rvalid, left_ok)
+                                   rvalid, left_ok,
+                                   device_keys=device_keys)
                 return True
             except Exception:
                 # clean bail-out: the numpy path below answers from the
@@ -616,8 +635,22 @@ class HashJoinExec(Executor):
     # join whose match count exceeds it streams through the dict path
     _NUMPY_PAIR_CAP = 1 << 25
 
+    def _side_device_keys(self, lside, rside, lcol, rcol):
+        """(lkey, lvalid, rkey, rvalid) as DEVICE arrays when BOTH sides
+        expose device-resident key planes (plane-cache-pinned batches),
+        else None — kind/dtype agreement with the host planes is
+        guaranteed by the sides' device_plane gates."""
+        gl = getattr(lside, "device_plane", None)
+        gr = getattr(rside, "device_plane", None)
+        if gl is None or gr is None:
+            return None
+        dl, dr = gl(lcol.index), gr(rcol.index)
+        if dl is None or dr is None or dl[0].dtype != dr[0].dtype:
+            return None
+        return (dl[0], dl[1], dr[0], dr[1])
+
     def _start_device(self, lside, rside, lkey, lvalid, rkey, rvalid,
-                      left_ok) -> None:
+                      left_ok, device_keys=None) -> None:
         """Run the device join kernels and assemble the columnar result
         (final emission-order index pairs; r_idx -1 = LEFT OUTER pad).
         Rows are NOT materialized here — an aggregate parent fuses over
@@ -626,9 +659,12 @@ class HashJoinExec(Executor):
         from tidb_tpu.ops import kernels
         stats = self.join_stats
         li, ri = kernels.join_match_pairs(lkey, lvalid, rkey, rvalid,
-                                          stats=stats)
+                                          stats=stats,
+                                          device_keys=device_keys)
         self._finish_pairs(lside, rside, li, ri, left_ok)
         stats["path"] = "device"
+        if device_keys is not None:
+            stats["device_resident_keys"] = True
 
     def _finish_pairs(self, lside, rside, li, ri, left_ok) -> None:
         """Shared tail of the vector paths: filter the match pairs

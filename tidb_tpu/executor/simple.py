@@ -120,6 +120,18 @@ def _use(session, stmt: ast.UseStmt):
     return None
 
 
+# store-level engine knobs that live on the client/RPC layer, never in a
+# session's variable map: GLOBAL-only (ER_GLOBAL_VARIABLE), each applied
+# through its Session method (which validates and gates on global Grant)
+_GLOBAL_ONLY_TPU_VARS = {
+    "tidb_tpu_dispatch_floor": "apply_tpu_dispatch_floor",
+    "tidb_tpu_device_join": "apply_tpu_device_join",
+    "tidb_tpu_columnar_scan": "apply_tpu_columnar_scan",
+    "tidb_tpu_plane_cache": "apply_tpu_plane_cache",
+    "tidb_tpu_plane_cache_bytes": "apply_tpu_plane_cache_bytes",
+}
+
+
 def _set(session, stmt: ast.SetStmt):
     from tidb_tpu.plan.builder import PlanBuilder
     from tidb_tpu.expression import Schema
@@ -141,35 +153,16 @@ def _set(session, stmt: ast.SetStmt):
             names = ["tx_isolation", "transaction_isolation"]
         if va.name.lower() == "tidb_copr_backend":
             session.apply_copr_backend(sval)  # validates before storing
-        if va.name.lower() == "tidb_tpu_dispatch_floor":
+        apply_global = _GLOBAL_ONLY_TPU_VARS.get(va.name.lower())
+        if apply_global is not None:
             if not va.is_global:
-                # the floor lives on the store-level client: a session-
-                # scoped write would re-route EVERY session while only
-                # this session's var recorded it (GLOBAL-only, like
-                # MySQL's ER_GLOBAL_VARIABLE)
+                # store-level client/cache state, same GLOBAL-only
+                # contract as the dispatch floor
                 raise errors.ExecError(
-                    "Variable 'tidb_tpu_dispatch_floor' is a GLOBAL "
+                    f"Variable '{va.name.lower()}' is a GLOBAL "
                     "variable and should be set with SET GLOBAL",
                     code=1229)
-            session.apply_tpu_dispatch_floor(sval)
-        if va.name.lower() == "tidb_tpu_device_join":
-            if not va.is_global:
-                # store-level client state, same GLOBAL-only contract as
-                # the dispatch floor
-                raise errors.ExecError(
-                    "Variable 'tidb_tpu_device_join' is a GLOBAL "
-                    "variable and should be set with SET GLOBAL",
-                    code=1229)
-            session.apply_tpu_device_join(sval)
-        if va.name.lower() == "tidb_tpu_columnar_scan":
-            if not va.is_global:
-                # store-level client state, same GLOBAL-only contract as
-                # the dispatch floor
-                raise errors.ExecError(
-                    "Variable 'tidb_tpu_columnar_scan' is a GLOBAL "
-                    "variable and should be set with SET GLOBAL",
-                    code=1229)
-            session.apply_tpu_columnar_scan(sval)
+            getattr(session, apply_global)(sval)
         for name in names:
             if va.is_global:
                 session.global_vars.set(name, sval)

@@ -32,6 +32,35 @@ class Counter:
         return self._value
 
 
+class Gauge:
+    """A value that goes up AND down (cache bytes, pinned bytes, entry
+    counts) — Prometheus gauge semantics, SHOW STATUS renders the
+    current value."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+
 class Histogram:
     __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
 
@@ -79,13 +108,20 @@ class Histogram:
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict[str, Counter | Histogram] = {}
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = Counter(name)
+            return m  # type: ignore[return-value]
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(name)
             return m  # type: ignore[return-value]
 
     def histogram(self, name: str, buckets=_DEFAULT_BUCKETS) -> Histogram:
@@ -102,7 +138,7 @@ class Registry:
             items = sorted(self._metrics.items())
         out: list[tuple[str, str]] = []
         for name, m in items:
-            if isinstance(m, Counter):
+            if isinstance(m, (Counter, Gauge)):
                 out.append((name, str(m.value)))
             else:
                 out.append((f"{name}_count", str(m.count)))
@@ -150,6 +186,10 @@ def render_text() -> str:
             lines.append(f"# TYPE {pname} counter")
             lines.append(f"{pname} {m.value}")
             continue
+        if isinstance(m, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {m.value}")
+            continue
         bounds, cum, total_sum, total_count = m.snapshot_buckets()
         lines.append(f"# TYPE {pname} histogram")
         for b, c in zip(bounds, cum[:-1]):
@@ -164,3 +204,7 @@ def render_text() -> str:
 
 def histogram(name: str) -> Histogram:
     return registry.histogram(name)
+
+
+def gauge(name: str) -> Gauge:
+    return registry.gauge(name)

@@ -64,6 +64,17 @@ class BelowFloor(Unsupported):
     """Request is routable but too small to amortize the device round trip."""
 
 
+def pin_batch_device(batch) -> None:
+    """Push a packed batch's planes to the device and keep them resident
+    (memoized on the batch — kernels.batch_planes / device_live reuse
+    them for every later dispatch). The plane cache pins admitted region
+    batches through this, so a repeat fan-out query skips the
+    host→device transfer as well as the repack; the join tier reads the
+    pinned planes straight from HBM (ColumnarScanResult.device_plane)."""
+    kernels.batch_planes(batch)
+    kernels.device_live(batch)
+
+
 class _SingleResponse(kv.Response):
     def __init__(self, resp: SelectResponse):
         self._resp = resp
@@ -103,6 +114,13 @@ class TpuClient(kv.Client):
         # kill switch.
         self.columnar_scan = store_bool_sysvar(store,
                                                "tidb_tpu_columnar_scan")
+        # plane-cache kill switch: SET GLOBAL tidb_tpu_plane_cache = 0
+        # disables BOTH caches of packed planes — the per-region cache
+        # on cluster stores (copr.plane_cache) and this client's in-proc
+        # batch cache — so every query re-packs from the MVCC store (the
+        # parity oracle for cache correctness).
+        self.plane_cache_enabled = store_bool_sysvar(store,
+                                                     "tidb_tpu_plane_cache")
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
         # (jitted, planes, live) of the most recent single-chip aggregate
@@ -231,8 +249,10 @@ class TpuClient(kv.Client):
                     tuple(c.column_id for c in cols),
                     tuple((r.start, r.end) for r in ranges))
         version = self.store.data_version_at(sel.start_ts)
-        ent = self._batch_cache.get(base_key)
-        if ent is not None and ent[1] == version:
+        ent = self._batch_cache.get(base_key) if self.plane_cache_enabled \
+            else None
+        if ent is not None and ent[1] == version \
+                and not self._ranges_locked(sel.start_ts, ranges):
             self.stats["batch_hits"] += 1
             return ent[0]
         # a cached batch from a NEWER version must never serve an older
@@ -279,11 +299,25 @@ class TpuClient(kv.Client):
             batch._uid = next(self._uid_gen)
         # monotonic cache: never let an older-snapshot build displace a
         # newer cached batch
-        if ent is None or version >= ent[1]:
+        if self.plane_cache_enabled and (ent is None or version >= ent[1]):
             self._batch_cache[base_key] = (batch, version)
             if len(self._batch_cache) > 64:
                 self._batch_cache.pop(next(iter(self._batch_cache)))
         return batch
+
+    def _ranges_locked(self, start_ts: int, ranges) -> bool:
+        """Percolator lock gate for batch-cache hits on Percolator-backed
+        stores (the cluster DistStore): a pending blocking lock with
+        start_ts <= the reader's ts may resolve to a commit whose
+        commit_ts PREDATES the reader — the pack path's snapshot scan
+        resolves it and includes the write, a cached hit would hide it.
+        Same rule as the region plane cache (copr.plane_cache); stores
+        whose snapshots never surface locks (localstore) answer False."""
+        mvcc = getattr(self.store, "mvcc", None)
+        gate = getattr(mvcc, "has_blocking_lock", None)
+        if gate is None:
+            return False
+        return any(gate(start_ts, rg.start, rg.end) for rg in ranges)
 
     def _appends_only(self, table_id: int, ent) -> bool:
         """True when every commit in (cached version, now] either avoids

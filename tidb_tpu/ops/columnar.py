@@ -574,7 +574,12 @@ class ColumnarScanResult:
         self.pb_cols = pb_cols
         self._fts: list | None = None
         self._plane_cache: dict = {}
+        self._device_plane_cache: dict = {}
         self._rows_cache: list | None = None
+        # plane-cache attribution for this response (hit/miss/eviction
+        # counts), set by the region engine; the client tallies it onto
+        # the statement thread (distsql)
+        self.cache_info: dict | None = None
 
     def __len__(self) -> int:
         return len(self.sel)
@@ -620,6 +625,33 @@ class ColumnarScanResult:
             ent = (None, None, None)
         self._plane_cache[j] = ent
         return ent
+
+    def device_plane(self, j: int):
+        """Output column j as DEVICE-resident (values, valid) arrays,
+        gathered in HBM from the batch's pinned planes (the plane
+        cache's device pin, ops.client.pin_batch_device) — or None when
+        the batch is not pinned, the column's host plane is not a plain
+        numeric plane, or the host plane's dtype would not match the
+        storage plane's (vacuous all-NULL coercions). Kind/dtype always
+        agree with column_plane(j), so consumers may mix host and device
+        planes freely; values under valid=False are unspecified either
+        way (every consumer masks)."""
+        ent = self._device_plane_cache.get(j, False)
+        if ent is not False:
+            return ent
+        out = None
+        dev = getattr(self.batch, "_device_planes", None)
+        if dev is not None:
+            c = self.pb_cols[j]
+            cd = self.batch.columns[c.column_id]
+            kind, _v, _va = self.column_plane(j)
+            if (kind == "f64" and cd.kind == K_F64) or \
+                    (kind == "i64" and cd.kind == K_I64):
+                from tidb_tpu.ops import kernels
+                dv, dva = dev[c.column_id]
+                out = kernels.gather_plane(dv, dva, self.sel)
+        self._device_plane_cache[j] = out
+        return out
 
     def _emit_dictionary(self, j: int, cd: ColumnData) -> list[bytes]:
         """Dictionary bytes as the ROW path would carry them: non-binary
@@ -704,6 +736,7 @@ class ColumnarPartialSet:
         self.offsets = np.concatenate(
             [np.zeros(1, np.int64), np.cumsum(lens, dtype=np.int64)])
         self._plane_cache: dict = {}
+        self._device_plane_cache: dict = {}
         self._rows_cache: list | None = None
 
     def __len__(self) -> int:
@@ -753,6 +786,30 @@ class ColumnarPartialSet:
                        np.concatenate(valid_parts))
         self._plane_cache[j] = ent
         return ent
+
+    def device_plane(self, j: int):
+        """Output column j stacked across the region partials ON DEVICE
+        (values, valid) — a jitted concat of the per-region device
+        gathers, so cached partials stack in HBM instead of round-
+        tripping through np.concatenate (the device-side stacking of
+        region planes). None unless EVERY part answers device_plane with
+        the set's agreed plane dtype."""
+        ent = self._device_plane_cache.get(j, False)
+        if ent is not False:
+            return ent
+        out = None
+        kind, _v, _va = self.column_plane(j)
+        if kind in ("i64", "f64"):
+            devs = [p.device_plane(j)
+                    if hasattr(p, "device_plane") else None
+                    for p in self.parts]
+            if all(d is not None for d in devs):
+                want = np.float64 if kind == "f64" else np.int64
+                if all(d[0].dtype == want for d in devs):
+                    from tidb_tpu.ops import kernels
+                    out = kernels.stack_planes(devs)
+        self._device_plane_cache[j] = out
+        return out
 
     def _locate(self, i: int) -> tuple:
         p = int(np.searchsorted(self.offsets, i, side="right")) - 1

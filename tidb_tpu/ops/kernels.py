@@ -135,6 +135,66 @@ def batch_planes(batch: col.ColumnBatch, with_pos: bool = False) -> dict:
     return planes
 
 
+_gather_jit = None
+
+
+def gather_plane(values, valid, sel):
+    """Jitted DEVICE gather of a batch plane by a selection index — the
+    device twin of ColumnarScanResult.column_plane over pinned (plane-
+    cache) batches: the values stay in HBM, only the small selection
+    index crosses host→device."""
+    global _gather_jit
+    if _gather_jit is None:
+        _gather_jit = jax.jit(
+            lambda v, va, s: (jnp.take(v, s), jnp.take(va, s)))
+    return _gather_jit(values, valid, jnp.asarray(sel))
+
+
+_stack_cache: dict = {}
+
+
+def stack_planes(parts):
+    """Jitted DEVICE concat of per-region (values, valid) plane pairs —
+    the device-side stacking of region partials: cached region planes
+    stack in HBM instead of round-tripping through np.concatenate. One
+    compiled kernel per (segment lengths, dtype) signature."""
+    key = (tuple(int(v.shape[0]) for v, _va in parts),
+           str(parts[0][0].dtype))
+    fn = _stack_cache.get(key)
+    if fn is None:
+        n_parts = len(parts)
+
+        def impl(*arrs):
+            return (jnp.concatenate(arrs[:n_parts]),
+                    jnp.concatenate(arrs[n_parts:]))
+
+        fn = _stack_cache[key] = jax.jit(impl)
+        if len(_stack_cache) > 256:
+            _stack_cache.pop(next(iter(_stack_cache)))
+    return fn(*[v for v, _va in parts], *[va for _v, va in parts])
+
+
+_pad_cache: dict = {}
+
+
+def _device_pad(arr, cap: int):
+    """Pad a device array to `cap` ON DEVICE (zeros tail — valid planes
+    pad False, value planes pad under invalid): the bucket-padding the
+    join kernels need without pulling a pinned plane back to host."""
+    n = int(arr.shape[0])
+    if n == cap:
+        return arr
+    key = (n, int(cap), str(arr.dtype))
+    fn = _pad_cache.get(key)
+    if fn is None:
+        pad = int(cap) - n
+        fn = _pad_cache[key] = jax.jit(
+            lambda v: jnp.concatenate([v, jnp.zeros(pad, v.dtype)]))
+        if len(_pad_cache) > 256:
+            _pad_cache.pop(next(iter(_pad_cache)))
+    return fn(arr)
+
+
 def device_live(batch: col.ColumnBatch):
     """Device-resident row-liveness plane, memoized on the batch. Passing
     a host numpy mask instead costs an H2D of capacity bytes on EVERY
@@ -859,7 +919,8 @@ def _join_build_impl(rkey, rvalid):
 join_build_kernel = jax.jit(_join_build_impl)
 
 
-def _join_probe_impl(rs, order, n_valid, lkey, lvalid, out_cap):
+def _join_probe_impl(rs, order, n_valid, lkey, lvalid, out_cap,
+                     narrow=False):
     """Device join probe: per-left-row match ranges via searchsorted,
     expanded to explicit (l_idx, r_idx) pairs in ONE static-shaped pass.
 
@@ -886,51 +947,72 @@ def _join_probe_impl(rs, order, n_valid, lkey, lvalid, out_cap):
     p = jnp.clip(p, 0, order.shape[0] - 1)
     r = order[p]
     ok = j < total
-    # ONE packed int64 output = ONE device→host transfer for the whole
-    # probe (l pairs, r pairs, total) — on tunneled deployments every
-    # readback costs a full round trip (see pack_outputs)
+    # ONE packed output = ONE device→host transfer for the whole probe
+    # (l pairs, r pairs, total) — on tunneled deployments every readback
+    # costs a full round trip (see pack_outputs). With `narrow` (both
+    # side capacities fit int32 — every realistic join), the pairs ride
+    # int32 and the readback HALVES; `total` can exceed int32 on a
+    # pair blow-up, so it rides as exact (hi, lo) 32-bit words.
+    if narrow:
+        return jnp.concatenate([
+            jnp.where(ok, lc, -1).astype(jnp.int32),
+            jnp.where(ok, r, -1).astype(jnp.int32),
+            (total >> 32).astype(jnp.int32)[None],
+            (total & 0xFFFFFFFF).astype(jnp.int32)[None]])
     return jnp.concatenate([jnp.where(ok, lc, -1), jnp.where(ok, r, -1),
                             total[None]])
 
 
-join_probe_kernel = jax.jit(_join_probe_impl, static_argnames="out_cap")
+join_probe_kernel = jax.jit(_join_probe_impl,
+                            static_argnames=("out_cap", "narrow"))
 
 
-def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None):
+def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
+                     device_keys=None):
     """Host driver for the device join kernels: numpy key planes in,
     (l_idx, r_idx) int64 numpy match pairs out, in left-scan order with
     ties in right-scan order.
 
     Inputs are padded to power-of-two buckets (one compiled kernel per
-    bucket, like every other kernel here). The probe's output capacity
-    starts at the left bucket (FK joins average ≤1 match per probe row)
-    and escalates to bucket(total) — at most one retry, because `total`
-    is exact regardless of capacity. `stats`, when given, receives
-    build_s / probe_s wall times (readback-certified) for the bench's
-    phase split."""
+    bucket, like every other kernel here). With `device_keys` — the
+    (lkey, lvalid, rkey, rvalid) planes ALREADY device-resident, e.g.
+    gathered from plane-cache-pinned region batches — the padding runs
+    on device and the per-query host→device key transfer disappears
+    entirely (the host planes are then used only for lengths/dtypes).
+    The probe's output capacity starts at the left bucket (FK joins
+    average ≤1 match per probe row) and escalates to bucket(total) — at
+    most one retry, because `total` is exact regardless of capacity.
+    Pair indices ride an int32 readback when both capacities fit (half
+    the bytes of the int64 packing — the probe readback dominates the
+    join's round-trip cost on tunneled deployments). `stats`, when
+    given, receives build_s / probe_s wall times (readback-certified)
+    for the bench's phase split."""
     import time as _time
 
     n_left = int(lkey.shape[0])
     lcap = col.bucket_capacity(max(n_left, 1))
     rcap = col.bucket_capacity(max(int(rkey.shape[0]), 1))
-    lk = np.zeros(lcap, dtype=lkey.dtype)
-    lk[:n_left] = lkey
-    lv = np.zeros(lcap, dtype=bool)
-    lv[:n_left] = lvalid
-    rk = np.zeros(rcap, dtype=rkey.dtype)
-    rk[: rkey.shape[0]] = rkey
-    rv = np.zeros(rcap, dtype=bool)
-    rv[: rkey.shape[0]] = rvalid
+    from tidb_tpu import tracing
+    t0 = _time.time()
+    bsp = tracing.current().child("kernel").set("kind", "join_build")
+    if device_keys is not None:
+        lkd, lvd, rkd, rvd = device_keys
+        rk_d = _device_pad(rkd, rcap)
+        rv_d = _device_pad(rvd, rcap)
+        bsp.set("device_resident", True)
+    else:
+        rk = np.zeros(rcap, dtype=rkey.dtype)
+        rk[: rkey.shape[0]] = rkey
+        rv = np.zeros(rcap, dtype=bool)
+        rv[: rkey.shape[0]] = rvalid
+        rk_d, rv_d = jnp.asarray(rk), jnp.asarray(rv)
 
     # build: dispatch only — its outputs stay device-resident as the
     # probe's inputs, so no readback happens here (on tunneled
     # deployments a sync would cost a whole extra round trip; build_s is
     # therefore dispatch time, and probe_s, which ends at the certified
     # pair readback, absorbs the build's actual compute)
-    from tidb_tpu import tracing
-    t0 = _time.time()
-    bsp = tracing.current().child("kernel").set("kind", "join_build")
-    rs, order, n_valid = join_build_kernel(jnp.asarray(rk), jnp.asarray(rv))
+    rs, order, n_valid = join_build_kernel(rk_d, rv_d)
     bsp.finish()
     tracing.record_dispatch(readbacks=0)   # outputs stay device-resident
     if stats is not None:
@@ -938,16 +1020,32 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None):
 
     t0 = _time.time()
     psp = tracing.current().child("kernel").set("kind", "join_probe")
-    lk_d, lv_d = jnp.asarray(lk), jnp.asarray(lv)
+    if device_keys is not None:
+        lk_d = _device_pad(lkd, lcap)
+        lv_d = _device_pad(lvd, lcap)
+    else:
+        lk = np.zeros(lcap, dtype=lkey.dtype)
+        lk[:n_left] = lkey
+        lv = np.zeros(lcap, dtype=bool)
+        lv[:n_left] = lvalid
+        lk_d, lv_d = jnp.asarray(lk), jnp.asarray(lv)
     out_cap = lcap
     rb_bytes = 0
     rb_count = 0
     while True:
+        narrow = out_cap < (1 << 31) and rcap < (1 << 31) \
+            and lcap < (1 << 31)
         packed = np.asarray(join_probe_kernel(rs, order, n_valid, lk_d,
-                                              lv_d, out_cap=out_cap))
+                                              lv_d, out_cap=out_cap,
+                                              narrow=narrow))
         rb_bytes += int(packed.nbytes)
         rb_count += 1
-        n_out = int(packed[-1])
+        if narrow:
+            # exact int64 total from its (hi, lo) 32-bit words
+            n_out = (int(packed[-2]) << 32) | (int(packed[-1])
+                                              & 0xFFFFFFFF)
+        else:
+            n_out = int(packed[-1])
         if n_out <= out_cap:
             break
         out_cap = col.bucket_capacity(n_out)
@@ -956,8 +1054,9 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None):
     psp.finish()
     tracing.record_dispatch(dispatches=rb_count, readbacks=rb_count,
                             readback_bytes=rb_bytes)
-    l_idx = packed[:n_out]
-    r_idx = packed[out_cap:out_cap + n_out]
+    # narrow readbacks widen here; the int64 path stays zero-copy
+    l_idx = packed[:n_out].astype(np.int64, copy=False)
+    r_idx = packed[out_cap:out_cap + n_out].astype(np.int64, copy=False)
     if stats is not None:
         stats["probe_s"] = _time.time() - t0
         stats["n_pairs"] = n_out

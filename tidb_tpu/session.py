@@ -366,6 +366,15 @@ class Session:
                           kt.get("readback_bytes", 0),
                           kt.get("jit_hits", 0),
                           kt.get("jit_misses", 0)))
+            # plane-cache tallies (per-partial attribution from the
+            # region responses) appear whenever the statement touched
+            # the cache — same monotonic-diff contract as columnar_hits
+            for key in ("plane_cache_hits", "plane_cache_misses",
+                        "plane_cache_evictions",
+                        "plane_cache_invalidations_epoch",
+                        "plane_cache_invalidations_version"):
+                if kt.get(key):
+                    detail += f" {key}:{kt[key]}"
             if root_span is not None:
                 tasks = root_span.find("region_task")
                 if tasks:
@@ -696,16 +705,9 @@ class Session:
             raise errors.ExecError(
                 "tidb_copr_backend cannot be NULL/empty; "
                 "use 'cpu' or 'tpu' (swaps the engine store-wide)")
-        if self.vars.user:
-            # the knob swaps the engine for EVERY session on this store —
-            # a store-global action needs the global Grant privilege
-            from tidb_tpu import privilege
-            if not privilege.checker_for(self.store).check(
-                    self.vars.user, "", "", "Grant",
-                    host=self.vars.client_host):
-                raise privilege.AccessDenied(
-                    f"user '{self.vars.user}' needs the global GRANT "
-                    "privilege to set tidb_copr_backend")
+        # the knob swaps the engine for EVERY session on this store —
+        # a store-global action needs the global Grant privilege
+        self._require_global_grant("tidb_copr_backend")
         if backend == "tpu":
             from tidb_tpu.ops import TpuClient
             if not isinstance(self.store.get_client(), TpuClient):
@@ -748,20 +750,30 @@ class Session:
         if floor < 0:
             raise errors.ExecError(
                 "tidb_tpu_dispatch_floor must be >= 0")
-        if self.vars.user:
-            # store-wide blast radius (every session's routing changes):
-            # same global Grant gate as the backend switch above
-            from tidb_tpu import privilege
-            if not privilege.checker_for(self.store).check(
-                    self.vars.user, "", "", "Grant",
-                    host=self.vars.client_host):
-                raise privilege.AccessDenied(
-                    f"user '{self.vars.user}' needs the global GRANT "
-                    "privilege to set tidb_tpu_dispatch_floor")
-        from tidb_tpu.ops import TpuClient
+        # store-wide blast radius (every session's routing changes):
+        # same global Grant gate as the backend switch above
+        self._require_global_grant("tidb_tpu_dispatch_floor")
         client = self.store.get_client()
-        if isinstance(client, TpuClient):
-            client.dispatch_floor_rows = floor
+        for target in (client, getattr(client, "cpu", None)):
+            # TpuClient, and any fan-out client carrying the floor (the
+            # cluster DistCoprClient routes executor joins by it)
+            if target is not None and hasattr(target,
+                                              "dispatch_floor_rows"):
+                target.dispatch_floor_rows = floor
+
+    def _require_global_grant(self, name: str) -> None:
+        """Store-level engine knobs change behavior for EVERY session on
+        this storage — authenticated sessions need the global Grant
+        privilege; library/internal sessions (no user) skip the check."""
+        if not self.vars.user:
+            return
+        from tidb_tpu import privilege
+        if not privilege.checker_for(self.store).check(
+                self.vars.user, "", "", "Grant",
+                host=self.vars.client_host):
+            raise privilege.AccessDenied(
+                f"user '{self.vars.user}' needs the global GRANT "
+                f"privilege to set {name}")
 
     def _apply_tpu_bool_switch(self, name: str, attr: str,
                                value: str) -> None:
@@ -778,14 +790,7 @@ class Session:
             raise errors.ExecError(
                 f"{name} must be 0 or 1, got {value!r}")
         enabled = parse_bool_sysvar(value)
-        if self.vars.user:
-            from tidb_tpu import privilege
-            if not privilege.checker_for(self.store).check(
-                    self.vars.user, "", "", "Grant",
-                    host=self.vars.client_host):
-                raise privilege.AccessDenied(
-                    f"user '{self.vars.user}' needs the global GRANT "
-                    f"privilege to set {name}")
+        self._require_global_grant(name)
         client = self.store.get_client()
         for target in (client, getattr(client, "cpu", None)):
             if target is not None and hasattr(target, attr):
@@ -802,6 +807,51 @@ class Session:
         channel kill switch (every session's scan responses re-route)."""
         self._apply_tpu_bool_switch("tidb_tpu_columnar_scan",
                                     "columnar_scan", value)
+
+    def apply_tpu_plane_cache(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_plane_cache = 0|1 — the packed-plane cache
+        kill switch: flips the in-proc TpuClient batch cache (client
+        attribute) AND the cluster store's per-region plane cache. Off
+        re-packs every columnar scan from the MVCC store — the parity
+        oracle for cache correctness."""
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        self._apply_tpu_bool_switch("tidb_tpu_plane_cache",
+                                    "plane_cache_enabled", value)
+        enabled = parse_bool_sysvar(value)
+        if not enabled:
+            # a disabled cache must also stop HOLDING: dropping entries
+            # frees the budget (and the device pins) and makes re-enable
+            # start cold — for the in-proc TpuClient batch cache too,
+            # which is the documented contract of this switch
+            client = self.store.get_client()
+            for target in (client, getattr(client, "cpu", None)):
+                bc = getattr(target, "_batch_cache", None)
+                if bc is not None:
+                    bc.clear()
+        from tidb_tpu.copr.plane_cache import cache_for
+        pc = cache_for(self.store)
+        if pc is not None:
+            pc.enabled = enabled
+            if not enabled:
+                pc.clear()
+
+    def apply_tpu_plane_cache_bytes(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_plane_cache_bytes = N — the plane cache's
+        LRU byte budget (evicts immediately when shrunk)."""
+        try:
+            budget = int(value.strip())
+        except ValueError:
+            raise errors.ExecError(
+                f"tidb_tpu_plane_cache_bytes must be an integer, "
+                f"got {value!r}")
+        if budget < 0:
+            raise errors.ExecError(
+                "tidb_tpu_plane_cache_bytes must be >= 0")
+        self._require_global_grant("tidb_tpu_plane_cache_bytes")
+        from tidb_tpu.copr.plane_cache import cache_for
+        pc = cache_for(self.store)
+        if pc is not None:
+            pc.set_budget(budget)
 
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
@@ -965,6 +1015,7 @@ def bootstrap(session: Session) -> None:
                     gv.values[name.lower()] = value
             # a hydrated engine choice must be APPLIED, not just reported —
             # @@tidb_copr_backend mirrors the client actually installed
+            from tidb_tpu.sessionctx import parse_bool_sysvar
             if gv.values.get("tidb_copr_backend", "").strip().lower() \
                     == "tpu":
                 session.apply_copr_backend("tpu")
@@ -973,14 +1024,15 @@ def bootstrap(session: Session) -> None:
                 # (store.set_client embed pattern, or the cluster store's
                 # default DistCoprClient fan-out) must also pick up the
                 # persisted routing knobs, not their defaults
-                from tidb_tpu.sessionctx import parse_bool_sysvar
                 client = session.store.get_client()
                 for target in (client, getattr(client, "cpu", None)):
                     if target is None:
                         continue
                     for var, attr in (
                             ("tidb_tpu_device_join", "device_join"),
-                            ("tidb_tpu_columnar_scan", "columnar_scan")):
+                            ("tidb_tpu_columnar_scan", "columnar_scan"),
+                            ("tidb_tpu_plane_cache",
+                             "plane_cache_enabled")):
                         v = gv.values.get(var)
                         if v is not None and hasattr(target, attr):
                             setattr(target, attr, parse_bool_sysvar(v))
@@ -992,6 +1044,22 @@ def bootstrap(session: Session) -> None:
                                 0, int(fl.strip()))
                     except ValueError:
                         pass
+            # the region plane cache hangs off the store's RPC handler,
+            # not a client — hydrate it directly, on EVERY backend path
+            # (the 'tpu' branch above installs a TpuClient but must not
+            # silently revert the cache's persisted kill switch/budget)
+            from tidb_tpu.copr.plane_cache import cache_for
+            pc = cache_for(session.store)
+            if pc is not None:
+                v = gv.values.get("tidb_tpu_plane_cache")
+                if v is not None:
+                    pc.enabled = parse_bool_sysvar(v)
+                b = gv.values.get("tidb_tpu_plane_cache_bytes")
+                try:
+                    if b:
+                        pc.set_budget(max(0, int(b.strip())))
+                except ValueError:
+                    pass
             return
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,

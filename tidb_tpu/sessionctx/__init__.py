@@ -59,6 +59,14 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # row protocol (plane-aware consumers fall back to row drains) while
     # scans keep routing to the device
     "tidb_tpu_columnar_scan": "1",
+    # per-region columnar plane cache (copr.plane_cache) kill switch:
+    # 0 re-packs every columnar_hint scan from the MVCC store (and
+    # disables the in-proc TpuClient batch cache) — the parity oracle
+    # for cache correctness. GLOBAL-only, store-level, like
+    # tidb_tpu_columnar_scan.
+    "tidb_tpu_plane_cache": "1",
+    # plane-cache byte budget (LRU evicts past it); GLOBAL-only
+    "tidb_tpu_plane_cache_bytes": "268435456",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
     # hierarchical statement tracing (tidb_tpu.tracing): 1 builds a span
@@ -76,10 +84,10 @@ def parse_bool_sysvar(value: str) -> bool:
     return value.strip().lower() in ("1", "on", "true")
 
 
-def store_bool_sysvar(store, name: str) -> bool:
-    """Store-level boolean sysvar as a freshly constructed CLIENT must
-    resolve it: the persisted/hydrated global when a session has bound
-    this store, else the default. The session module is reached through
+def _store_sysvar_raw(store, name: str) -> str:
+    """Store-level sysvar as a freshly constructed CLIENT must resolve
+    it: the persisted/hydrated global when a session has bound this
+    store, else the default. The session module is reached through
     sys.modules so client constructors (TpuClient, DistCoprClient) never
     import it — the one place the circular-import workaround lives."""
     import sys
@@ -87,9 +95,20 @@ def store_bool_sysvar(store, name: str) -> bool:
     sess_mod = sys.modules.get("tidb_tpu.session")
     if sess_mod is not None:
         val = sess_mod.store_global_var(store, name)
-    if val is None:
-        val = SYSVAR_DEFAULTS[name]
-    return parse_bool_sysvar(val)
+    return val if val is not None else SYSVAR_DEFAULTS[name]
+
+
+def store_bool_sysvar(store, name: str) -> bool:
+    return parse_bool_sysvar(_store_sysvar_raw(store, name))
+
+
+def store_int_sysvar(store, name: str) -> int:
+    """Clients resolve routing floors and budgets through this so a
+    restart never silently reverts them."""
+    try:
+        return int(_store_sysvar_raw(store, name).strip())
+    except ValueError:
+        return int(SYSVAR_DEFAULTS[name])
 
 
 class SessionVars:

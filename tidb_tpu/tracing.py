@@ -219,9 +219,14 @@ class trace:
 # statement, diff after.
 # ---------------------------------------------------------------------------
 
-# the counter keys every consumer renders, in display order
+# the counter keys every consumer renders, in display order (plane-cache
+# tallies arrive from distsql's per-partial attribution of the region
+# responses; see copr.plane_cache)
 COUNTER_KEYS = ("kernel_dispatches", "readbacks", "readback_bytes",
-                "jit_hits", "jit_misses")
+                "jit_hits", "jit_misses",
+                "plane_cache_hits", "plane_cache_misses",
+                "plane_cache_evictions", "plane_cache_invalidations_epoch",
+                "plane_cache_invalidations_version")
 
 
 def _tally() -> dict:
