@@ -16,10 +16,11 @@ Invariants verified at the end:
 
 import random
 import threading
+import time
 
 import pytest
 
-from tidb_tpu import errors
+from tidb_tpu import errors, failpoint
 from tidb_tpu.session import Session, new_store
 from tests.testkit import _store_id
 
@@ -244,3 +245,142 @@ def test_tpu_batch_cache_under_concurrent_writes(store):
     assert client.stats["tpu_requests"] > 0, "readers never hit the TPU tier"
     total = int(root.execute("select sum(bal) from acct")[0].values()[0][0])
     assert total == N_ACCOUNTS * START_BALANCE
+
+
+def test_chaos_with_failpoints_active():
+    """The original chaos shape run WITH a seeded failpoint schedule live
+    mid-run — region timeouts, ServerIsBusy storms, and device-dispatch
+    failures injected probabilistically under concurrent transfers,
+    inserts, and TPU-tier readers — and the same four end-state
+    invariants: money conserved, every insert present exactly once, no
+    torn reads, ADMIN CHECK TABLE clean. Injected faults are RECOVERED
+    faults: the retry ladder absorbs the region errors and the
+    degradation chain absorbs the device errors, so the workload's
+    observable behavior is unchanged."""
+    from tidb_tpu.kv import backoff as kvbackoff
+    from tidb_tpu.ops import TpuClient
+
+    store = new_store(f"cluster://3/chaosfp{next(_store_id)}")
+    store.set_client(TpuClient(store, dispatch_floor_rows=0))
+    root = Session(store)
+    root.execute("create database d")
+    root.execute("use d")
+    root.execute("create table acct (id bigint primary key, bal bigint)")
+    rows = ", ".join(f"({i}, {START_BALANCE})" for i in range(N_ACCOUNTS))
+    root.execute(f"insert into acct values {rows}")
+    root.execute("create table audit_log (id bigint primary key, who int)")
+
+    stop = threading.Event()
+    torn: list = []
+    failures: list = []
+
+    def transfer_worker(seed):
+        s = _session(store)
+        rng = random.Random(seed)
+        for _ in range(25):
+            if stop.is_set():
+                return
+            a, b = rng.sample(range(N_ACCOUNTS), 2)
+            amt = rng.randint(1, 50)
+            try:
+                s.execute("begin")
+                s.execute(f"update acct set bal = bal - {amt} "
+                          f"where id = {a}")
+                s.execute(f"update acct set bal = bal + {amt} "
+                          f"where id = {b}")
+                s.execute("commit")
+            except errors.TiDBError:
+                # injected fault storms may exhaust a statement budget —
+                # a rolled-back transfer preserves the money invariant
+                try:
+                    s.execute("rollback")
+                except errors.TiDBError:
+                    pass
+
+    def insert_worker(tid):
+        s = _session(store)
+        for i in range(30):
+            if stop.is_set():
+                return
+            # inserts must land EXACTLY once despite injected faults:
+            # retry until success; a duplicate-key error proves the
+            # earlier attempt already applied
+            for _attempt in range(50):
+                try:
+                    s.execute(f"insert into audit_log values "
+                              f"({tid * 1000 + i}, {tid})")
+                    break
+                except errors.DupEntryError:
+                    break
+                except errors.TiDBError:
+                    continue
+            else:
+                failures.append(("insert", tid, i))
+
+    def tpu_reader():
+        s = _session(store)
+        for _ in range(15):
+            if stop.is_set():
+                return
+            for attempt in (0, 1, 2):
+                try:
+                    got = s.execute(
+                        "select sum(bal), count(*) from acct")[0].values()
+                    total, n = int(got[0][0]), int(got[0][1])
+                    if total != N_ACCOUNTS * START_BALANCE \
+                            or n != N_ACCOUNTS:
+                        torn.append((total, n))
+                    break
+                except errors.TiDBError as e:
+                    if attempt == 2:
+                        failures.append(("read", str(e)))
+
+    # scale backoff sleeps down so injected storms retry fast, and seed
+    # every probability so the schedule replays
+    kvbackoff.set_test_hooks(sleeper=lambda s: time.sleep(min(s, 0.002)))
+    failpoint.enable("rpc/server_busy", when=("prob", 0.03), seed=11)
+    failpoint.enable("rpc/timeout", when=("prob", 0.01), seed=12)
+    failpoint.enable("copr/region_timeout", when=("prob", 0.05), seed=13)
+    failpoint.enable("device/oom", when=("prob", 0.10), seed=14)
+    threads = ([threading.Thread(target=transfer_worker, args=(i,))
+                for i in range(2)]
+               + [threading.Thread(target=insert_worker, args=(1,))]
+               + [threading.Thread(target=tpu_reader)])
+    evals = {}
+    try:
+        for t in threads:
+            t.start()
+        wedged = []
+        for t in threads:
+            t.join(timeout=120)
+            if t.is_alive():
+                wedged.append(t.name)
+    finally:
+        stop.set()
+        # snapshot BEFORE disable_all: counters read zeros once disabled
+        evals = {name: failpoint.counters(name)["evals"]
+                 for name in ("rpc/server_busy", "copr/region_timeout",
+                              "device/oom")}
+        failpoint.disable_all()
+        kvbackoff.reset_test_hooks()
+    assert not wedged, f"workers wedged under failpoints: {wedged}"
+    assert not failures, failures[:5]
+    assert not torn, f"readers saw torn transfers: {torn[:5]}"
+    # the schedule really ran: each fault class was evaluated at its seam
+    # (probabilistic firing may legitimately be 0 for a short run, but a
+    # never-EVALUATED site means the injection seam regressed)
+    for name, n in evals.items():
+        assert n > 0, f"failpoint seam {name} was never reached"
+    # end-state invariants, fault-free verification pass
+    total = int(root.execute("select sum(bal) from acct")[0]
+                .values()[0][0])
+    assert total == N_ACCOUNTS * START_BALANCE, \
+        f"money {'appeared' if total > N_ACCOUNTS * START_BALANCE else 'vanished'}: {total}"
+    n = int(root.execute("select count(*) from audit_log")[0]
+            .values()[0][0])
+    assert n == 30, n
+    dup = root.execute("select id from audit_log group by id "
+                       "having count(*) > 1")[0].values()
+    assert dup == []
+    root.execute("admin check table acct")
+    root.execute("admin check table audit_log")

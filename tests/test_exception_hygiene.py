@@ -1,0 +1,131 @@
+"""Static check: retryable errors must never be swallowed silently.
+
+The seed shipped a bug class this repo keeps re-finding: a broad
+`except` (bare, Exception, TiDBError, KVError) in the coprocessor /
+cluster / distsql path that catches a RETRYABLE error — a pending
+Percolator lock, a region epoch move — and converts it into a string,
+a None, or nothing, stranding the statement instead of driving the
+client's resolve-and-retry ladder (PR 5 fixed exactly this in
+copr/region_handler). This AST walk makes that class unrepresentable:
+every broad handler in the guarded packages must either
+
+  (a) contain a `raise` in its body (re-raise / wrap-and-raise), or
+  (b) be preceded, in the same `try`, by a handler naming a retryable
+      type (RetryableError / RegionError / KeyIsLockedError / ...)
+      whose body re-raises — the broad catch then provably cannot see
+      a live retryable, or
+  (c) carry an explicit `# retryable-ok: <reason>` pragma on the
+      `except` line, for the rare best-effort sites (2PC cleanup,
+      straggler commits) where swallowing everything IS the contract.
+
+Tier-1 fails on any new violation, with file:line and the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent / "tidb_tpu"
+
+GUARDED_DIRS = ("cluster", "copr", "distsql")
+
+# names whose catch can swallow a retryable error (superclasses of
+# RetryableError, or catch-everything forms)
+BROAD_NAMES = {"Exception", "BaseException", "TiDBError", "KVError"}
+
+# retryable family: a preceding re-raising handler for any of these
+# clears the broad handler below it
+RETRYABLE_NAMES = {
+    "RetryableError", "RegionError", "KeyIsLockedError", "StaleEpochError",
+    "NotLeaderError", "ServerIsBusyError", "RegionMissError",
+    "RpcTimeoutError",
+}
+
+PRAGMA = "# retryable-ok:"
+
+
+def _type_names(node) -> list[str]:
+    """Terminal names of an except clause's type expression."""
+    if node is None:
+        return ["<bare>"]
+    if isinstance(node, ast.Tuple):
+        out: list[str] = []
+        for elt in node.elts:
+            out.extend(_type_names(elt))
+        return out
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Name):
+        return [node.id]
+    return ["<dynamic>"]
+
+
+def _contains_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _violations(path: Path) -> list[str]:
+    src = path.read_text()
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=str(path))
+    bad: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        retryable_cleared = False
+        for handler in node.handlers:
+            names = _type_names(handler.type)
+            if any(n in RETRYABLE_NAMES for n in names) \
+                    and _contains_raise(handler):
+                retryable_cleared = True
+            is_broad = handler.type is None \
+                or any(n in BROAD_NAMES for n in names)
+            if not is_broad:
+                continue
+            if _contains_raise(handler):
+                continue
+            if retryable_cleared:
+                continue
+            if PRAGMA in lines[handler.lineno - 1]:
+                continue
+            rel = path.relative_to(ROOT.parent)
+            bad.append(
+                f"{rel}:{handler.lineno}: broad `except "
+                f"{'/'.join(names)}` can swallow a RetryableError — "
+                f"re-raise, add a preceding `except RetryableError: "
+                f"raise`, or justify with `{PRAGMA} <reason>`")
+    return bad
+
+
+def test_no_swallowed_retryables_in_guarded_packages():
+    files = []
+    for d in GUARDED_DIRS:
+        files.extend(sorted((ROOT / d).rglob("*.py")))
+    assert files, "guarded packages not found — layout changed?"
+    problems: list[str] = []
+    for f in files:
+        problems.extend(_violations(f))
+    assert not problems, "\n".join(problems)
+
+
+def test_checker_detects_a_violation():
+    """The checker itself must flag the seed's bug shape (meta-test so a
+    refactor can't silently neuter the walk)."""
+    import textwrap
+    snippet = textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception as e:
+                return str(e)
+    """)
+    tmp = ROOT / "cluster"
+    tree = ast.parse(snippet)
+    found = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try):
+            h = node.handlers[0]
+            found = _type_names(h.type) == ["Exception"] \
+                and not _contains_raise(h)
+    assert found and tmp.exists()

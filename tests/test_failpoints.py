@@ -1,0 +1,461 @@
+"""Failpoint fault injection + the unified Backoffer: differential chaos.
+
+The contract under test is the robustness tentpole's acceptance bar:
+under a seeded failpoint schedule (region timeout, NotLeader, StaleEpoch,
+ServerIsBusy, device join/combine/OOM/readback faults, region pack
+faults, cache-admission drops) a 4-region scan→join→agg returns
+row-for-row parity with the fault-free run; every tier fallback is
+accounted on the copr.degraded_* counters; and a statement that hangs
+under tidb_tpu_max_execution_time fails with a typed
+DeadlineExceededError (ladder history attached) within budget instead of
+wedging. Backoff schedules are asserted EXACTLY via the injectable
+RNG/sleeper hooks — no wall-clock sleeping in this file.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import socket
+import time
+
+import pytest
+
+from tidb_tpu import errors, failpoint, metrics, tablecodec as tc, tracing
+from tidb_tpu.kv import backoff as kvbackoff
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+QUERIES = [
+    "select count(*), sum(t.v), min(t.v), max(d.d_f), avg(t.v) "
+    "from t join d on t.k = d.d_k",
+    "select t.k, count(*), sum(t.v), max(t.v) from t "
+    "join d on t.k = d.d_k group by t.k order by t.k",
+    "select id, v from t where v > 500 order by v desc limit 7",
+    "select k, count(*), min(v) from t group by k order by k",
+]
+
+DEGRADED_KINDS = ("device_to_cpu", "join_to_numpy", "combine_to_host",
+                  "region_to_rows")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+    kvbackoff.reset_test_hooks()
+
+
+def _build(n_regions: int = 4, floor0: bool = False) -> Session:
+    store = new_store(f"cluster://3/fp{next(_id)}")
+    s = Session(store)
+    s.execute("create database fp")
+    s.execute("use fp")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double)")
+    rows = ", ".join(f"({i}, {i % 7}, {i * 10}, {i}.25)"
+                     for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create table d (d_k bigint primary key, d_f double)")
+    s.execute("insert into d values "
+              + ", ".join(f"({i}, {i}.5)" for i in range(7)))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("fp", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    if floor0:
+        s.execute("set global tidb_tpu_dispatch_floor = 0")
+    return s
+
+
+def _degraded():
+    return {k: metrics.counter(f"copr.degraded_{k}").value
+            for k in DEGRADED_KINDS}
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_policies(self):
+        failpoint.enable("x/always")
+        assert [bool(_fires("x/always")) for _ in range(3)] == [True] * 3
+
+        failpoint.enable("x/every", when=("every", 3))
+        fired = [bool(_fires("x/every")) for _ in range(6)]
+        assert fired == [False, False, True, False, False, True]
+
+        failpoint.enable("x/first", when=("first", 2))
+        fired = [bool(_fires("x/first")) for _ in range(4)]
+        assert fired == [True, True, False, False]
+
+        # probability replays EXACTLY for a given seed
+        failpoint.enable("x/prob", when=("prob", 0.5), seed=42)
+        a = [bool(_fires("x/prob")) for _ in range(20)]
+        failpoint.enable("x/prob", when=("prob", 0.5), seed=42)
+        b = [bool(_fires("x/prob")) for _ in range(20)]
+        assert a == b and True in a and False in a
+        assert failpoint.counters("x/prob")["evals"] == 20
+
+    def test_actions_and_lifecycle(self):
+        # error action with the call site's typed default
+        failpoint.enable("x/err")
+        with pytest.raises(errors.KVError):
+            failpoint.eval("x/err", lambda: errors.KVError("typed"))
+        # explicit exception class wins over the default
+        failpoint.enable("x/err", exc=errors.DeviceError)
+        with pytest.raises(errors.DeviceError):
+            failpoint.eval("x/err", lambda: errors.KVError("typed"))
+        # return action carries a value; sleep returns None and continues
+        failpoint.enable("x/ret", action="return", value={"drop": 1})
+        assert failpoint.eval("x/ret") == {"drop": 1}
+        failpoint.enable("x/sleep", action="sleep", seconds=0.0)
+        assert failpoint.eval("x/sleep") is None
+        # disabled name is a no-op; counters read zeros
+        failpoint.disable("x/ret")
+        assert failpoint.eval("x/ret") is None
+        assert failpoint.counters("x/ret") == {"evals": 0, "triggers": 0}
+        # context manager cleans up even on error
+        with pytest.raises(RuntimeError):
+            with failpoint.failpoints({"x/cm": {"action": "return",
+                                                "value": 1}}):
+                assert failpoint.enabled("x/cm")
+                raise RuntimeError
+        assert not failpoint.enabled("x/cm")
+        # invalid specs are rejected loudly
+        with pytest.raises(ValueError):
+            failpoint.enable("x/bad", action="explode")
+        with pytest.raises(ValueError):
+            failpoint.enable("x/bad", when=("never",))
+
+    def test_trigger_metric(self):
+        c0 = metrics.counter("failpoint.triggers.x.m").value
+        failpoint.enable("x/m")
+        with pytest.raises(failpoint.FailpointError):
+            failpoint.eval("x/m")
+        assert metrics.counter("failpoint.triggers.x.m").value == c0 + 1
+
+    def test_disabled_path_is_inert(self):
+        failpoint.disable_all()
+        assert not failpoint._active
+        for _ in range(1000):
+            assert failpoint.eval("no/such/site") is None
+
+
+def _fires(name: str) -> bool:
+    t0 = failpoint.counters(name)["triggers"]
+    try:
+        failpoint.eval(name)
+    except failpoint.FailpointError:
+        pass
+    return failpoint.counters(name)["triggers"] == t0 + 1
+
+
+# ---------------------------------------------------------------------------
+# Backoffer: exact schedules, shared budget, deadline
+# ---------------------------------------------------------------------------
+
+class TestBackoffer:
+    def test_exact_schedule_via_hooks(self):
+        slept: list[float] = []
+        kvbackoff.set_test_hooks(rng=random.Random(7),
+                                 sleeper=slept.append)
+        bo = kvbackoff.Backoffer(budget_ms=100_000)
+        err = errors.KVError("x")
+        got = [bo.backoff("server_busy", err) for _ in range(4)]
+        # recompute the same schedule with an identical RNG clone
+        rng = random.Random(7)
+        want = [min(20 * (2 ** n), 200) * (0.5 + rng.random() / 2)
+                for n in range(4)]
+        assert got == pytest.approx(want)
+        assert slept == pytest.approx([ms / 1000.0 for ms in want])
+        assert bo.attempts["server_busy"] == 4
+        assert [h[0] for h in bo.history] == ["server_busy"] * 4
+
+    def test_budget_exhaustion_typed_with_history(self):
+        kvbackoff.set_test_hooks(rng=random.Random(1),
+                                 sleeper=lambda s: None)
+        bo = kvbackoff.Backoffer(budget_ms=50)
+        err = errors.KVError("busy")
+        with pytest.raises(errors.DeadlineExceededError) as ei:
+            for _ in range(100):
+                bo.backoff("server_busy", err)
+        assert ei.value.history, "ladder history missing"
+        assert ei.value.history[0][0] == "server_busy"
+        assert "server_busy" in str(ei.value)
+        # typed, NON-retryable: the session must not replay it
+        assert not errors.is_retryable(ei.value)
+        assert ei.value.code == 3024
+
+    def test_deadline_bounds_sleep_and_raises(self):
+        slept: list[float] = []
+
+        def sleeper(sec: float) -> None:
+            slept.append(sec)
+            time.sleep(0.002)   # advance real time toward the deadline
+
+        kvbackoff.set_test_hooks(rng=random.Random(3), sleeper=sleeper)
+        bo = kvbackoff.Backoffer(budget_ms=None,
+                                 deadline=time.monotonic() + 0.010)
+        err = errors.KVError("x")
+        with pytest.raises(errors.DeadlineExceededError):
+            for _ in range(1000):
+                bo.backoff("txn_lock", err)
+        # every sleep was clamped to the remaining deadline
+        assert slept and all(s <= 0.011 for s in slept)
+
+    def test_txn_util_routes_through_hooks(self):
+        from tidb_tpu.kv import txn_util
+        slept: list[float] = []
+        kvbackoff.set_test_hooks(rng=random.Random(5),
+                                 sleeper=slept.append)
+        got = [txn_util.backoff(n) for n in range(3)]
+        rng = random.Random(5)
+        want = [rng.uniform(0, min(100, 1 << n)) / 1000.0
+                for n in range(3)]
+        assert got == pytest.approx(want)
+        assert slept == pytest.approx(want)
+
+    def test_run_in_new_txn_exhaustion_counter(self):
+        from tidb_tpu.kv import txn_util
+        kvbackoff.set_test_hooks(sleeper=lambda s: None)
+        store = new_store(f"memory://fpbo{next(_id)}")
+
+        def always_conflict(txn):
+            raise errors.RetryableError("injected conflict")
+
+        e0 = metrics.counter("kv.txn_retry_exhausted").value
+        r0 = metrics.counter("kv.txn_retries").value
+        with pytest.raises(errors.RetryableError):
+            txn_util.run_in_new_txn(store, True, always_conflict,
+                                    max_retries=3)
+        assert metrics.counter("kv.txn_retry_exhausted").value == e0 + 1
+        assert metrics.counter("kv.txn_retries").value == r0 + 3
+
+    def test_session_retry_metrics_and_span(self):
+        s = _build(1)
+        kvbackoff.set_test_hooks(sleeper=lambda sec: None)
+        s.history = ["update t set v = v where id = 1"]
+        s.vars.retry_limit = 3
+        calls = {"n": 0}
+
+        def conflict(*a, **k):
+            calls["n"] += 1
+            raise errors.RetryableError("injected write conflict")
+
+        r0 = metrics.counter("session.retries").value
+        e0 = metrics.counter("session.retry_exhausted").value
+        root = tracing.Span("statement")
+        tok = tracing.attach(root)
+        orig = s._execute_one
+        s._execute_one = conflict
+        try:
+            with pytest.raises(errors.RetryableError):
+                s._retry()
+        finally:
+            s._execute_one = orig
+            tracing.detach(tok)
+        assert calls["n"] == 3
+        assert metrics.counter("session.retries").value == r0 + 3
+        assert metrics.counter("session.retry_exhausted").value == e0 + 1
+        spans = root.find("session_retry")
+        assert [sp.attrs["attempt"] for sp in spans] == [0, 1, 2]
+        assert all("conflict" in sp.attrs for sp in spans)
+
+
+# ---------------------------------------------------------------------------
+# the differential chaos schedule (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_chaos_schedule_parity_4_region():
+    """Every fault class injected at least once; the 4-region
+    scan→join→agg answers row-for-row like the fault-free run; every
+    tier fallback is accounted on copr.degraded_*; and after
+    disable_all() the store behaves as if nothing happened."""
+    s = _build(4, floor0=True)
+    want = [s.execute(q)[0].values() for q in QUERIES]
+    kvbackoff.set_test_hooks(sleeper=lambda sec: None)  # no wall-clock
+    d0 = _degraded()
+    schedule = {
+        "rpc/timeout": {"when": ("first", 2)},
+        "rpc/not_leader": {"when": ("first", 2)},
+        "rpc/stale_epoch": {"when": ("first", 2)},
+        "rpc/server_busy": {"when": ("first", 3)},
+        "copr/region_timeout": {"when": ("first", 1)},
+        "copr/pack": {"when": ("first", 1)},
+        "copr/drop_columnar": {"action": "return", "value": True,
+                               "when": ("first", 1)},
+        "cache/no_admit": {"action": "return", "value": True,
+                           "when": ("first", 2)},
+        "device/join": {"when": ("first", 1)},
+        "device/combine": {"when": ("first", 1)},
+    }
+    # drop the warmed plane cache so the faulted runs exercise the pack
+    # and admission seams (a cache hit would skip both)
+    from tidb_tpu.copr.plane_cache import cache_for
+    cache_for(s.store).clear()
+    with failpoint.failpoints(schedule):
+        got = [s.execute(q)[0].values() for q in QUERIES]
+        got2 = [s.execute(q)[0].values() for q in QUERIES]
+        for name in schedule:
+            assert failpoint.counters(name)["triggers"] >= 1, \
+                f"failpoint {name} never fired"
+    for q, g, w in zip(QUERIES, want, got):
+        assert g == w, f"parity broke under faults on {q!r}"
+    for q, g, w in zip(QUERIES, want, got2):
+        assert g == w, f"parity broke on the second faulted run {q!r}"
+    d1 = _degraded()
+    assert d1["join_to_numpy"] > d0["join_to_numpy"], \
+        "device join fault did not account a join_to_numpy fallback"
+    assert d1["combine_to_host"] > d0["combine_to_host"], \
+        "combine fault did not account a combine_to_host fallback"
+    assert d1["region_to_rows"] > d0["region_to_rows"], \
+        "region pack/drop faults did not account region_to_rows fallbacks"
+    # clean after disable: parity again, no further degradation
+    kvbackoff.reset_test_hooks()
+    d2 = _degraded()
+    clean = [s.execute(q)[0].values() for q in QUERIES]
+    assert clean == want
+    assert _degraded() == d2, "fallbacks counted with zero failpoints on"
+
+
+def test_device_tier_faults_degrade_to_cpu():
+    """TpuClient rung of the chain: injected compile / OOM / readback
+    faults reroute the request to the CPU engine with identical answers,
+    each accounted on copr.degraded_device_to_cpu — never a statement
+    error while the lower tier exists."""
+    s = _build(1)
+    s.execute("set global tidb_tpu_dispatch_floor = 0")
+    s.execute("set global tidb_copr_backend = 'tpu'")
+    client = s.store.get_client()
+    q = "select count(*), sum(v), min(v), max(f) from t where v > 100"
+    want = s.execute(q)[0].values()
+    d0 = _degraded()["device_to_cpu"]
+    fb0 = client.stats["cpu_fallbacks"]
+    for fp in ("device/oom", "device/readback"):
+        with failpoint.failpoints({fp: {"when": ("first", 1)}}):
+            assert s.execute(q)[0].values() == want, f"{fp} broke parity"
+            assert failpoint.counters(fp)["triggers"] == 1
+    # compile fires only on a jit-cache MISS: use a fresh request shape
+    with failpoint.failpoints({"device/compile": {"when": ("first", 1)}}):
+        q2 = "select count(*), sum(v) from t where v > 101"
+        row_want = s.execute("select count(*) from t where v > 101")
+        assert failpoint.counters("device/compile")["triggers"] >= 1
+        del row_want
+        assert s.execute(q2)[0].values() is not None
+    assert _degraded()["device_to_cpu"] >= d0 + 3
+    assert client.stats["cpu_fallbacks"] >= fb0 + 3
+    # parity one more time with everything off
+    assert s.execute(q)[0].values() == want
+
+
+# ---------------------------------------------------------------------------
+# statement deadline under an injected hang (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_hang_fails_typed_within_deadline():
+    s = _build(4)
+    s.execute("set tidb_tpu_max_execution_time = 400")
+    failpoint.enable("copr/region_scan", action="hang")
+    t0 = time.monotonic()
+    with pytest.raises(errors.DeadlineExceededError) as ei:
+        s.execute("select count(*) from t")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"deadline not enforced within budget: {elapsed}"
+    assert isinstance(ei.value.history, list)  # ladder history attached
+    assert not errors.is_retryable(ei.value)
+    failpoint.disable_all()
+    s.execute("set tidb_tpu_max_execution_time = 0")
+    # the session (and the store) remain fully usable afterwards
+    got = s.execute("select count(*), sum(v) from t")[0].values()
+    assert int(got[0][0]) == N_ROWS
+
+
+def test_ladder_storm_exhausts_one_shared_budget():
+    """With ServerIsBusy injected ALWAYS, the statement's retry ladders
+    spin against ONE shared budget and surface DeadlineExceededError
+    carrying the server_busy ladder history — instead of N independent
+    per-call budgets retrying forever."""
+    s = _build(2)
+    kvbackoff.set_test_hooks(sleeper=lambda sec: None)
+    e0 = metrics.counter("kv.backoff_exhausted").value
+    with failpoint.failpoints({"rpc/server_busy": {}}):
+        with pytest.raises(errors.DeadlineExceededError) as ei:
+            s.execute("select count(*) from t")
+    assert any(h[0] == "server_busy" for h in ei.value.history)
+    assert metrics.counter("kv.backoff_exhausted").value > e0
+    # recovery: ladder clean, answers intact
+    kvbackoff.reset_test_hooks()
+    assert int(s.execute("select count(*) from t")[0]
+               .values()[0][0]) == N_ROWS
+
+
+# ---------------------------------------------------------------------------
+# pending-lock regression: RETRYABLE error still drives resolve-and-retry
+# under an injected StaleEpoch on the same range
+# ---------------------------------------------------------------------------
+
+def test_pending_lock_resolves_under_injected_stale_epoch():
+    s = _build(2)
+    kvbackoff.set_test_hooks(sleeper=lambda sec: None)
+    tid = s.info_schema().table_by_name("fp", "t").info.id
+    q = "select count(*), sum(v) from t"
+    want = s.execute(q)[0].values()
+    key = tc.encode_row_key(tid, 10)
+    # crashed-writer lock (expires immediately → TTL rollback path)
+    s.store.mvcc.prewrite([("put", key, b"xx")], primary=key,
+                          start_ts=s.store.oracle.current_version(),
+                          ttl_ms=1)
+    with failpoint.failpoints({"rpc/stale_epoch": {"when": ("first", 1)}}):
+        got = s.execute(q)[0].values()
+        assert failpoint.counters("rpc/stale_epoch")["triggers"] == 1
+    assert got == want, \
+        "pending lock + injected StaleEpoch broke resolve-and-retry"
+    assert key not in s.store.mvcc._locks, \
+        "the RETRYABLE lock error did not drive the resolver ladder"
+
+
+# ---------------------------------------------------------------------------
+# server/client.py typed timeouts (satellite)
+# ---------------------------------------------------------------------------
+
+class TestClientTimeout:
+    def test_handshake_read_timeout_is_typed(self):
+        from tidb_tpu.server.client import Client, ClientTimeout, MySQLError
+        srv = socket.socket()
+        try:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)  # accepts connects, never sends a greeting
+            port = srv.getsockname()[1]
+            t0 = time.monotonic()
+            with pytest.raises(ClientTimeout) as ei:
+                Client("127.0.0.1", port, timeout=0.3)
+            assert time.monotonic() - t0 < 3.0
+            assert isinstance(ei.value, MySQLError)
+            assert ei.value.code == 2013
+            assert ei.value.op == "handshake"
+        finally:
+            srv.close()
+
+    def test_read_timeout_plumbed_separately(self):
+        from tidb_tpu.server.client import Client, ClientTimeout
+        srv = socket.socket()
+        try:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            port = srv.getsockname()[1]
+            t0 = time.monotonic()
+            with pytest.raises(ClientTimeout) as ei:
+                Client("127.0.0.1", port, timeout=10.0, read_timeout=0.2)
+            # the short READ timeout governed the silent handshake, not
+            # the long connect timeout
+            assert time.monotonic() - t0 < 5.0
+            assert ei.value.seconds == 0.2
+        finally:
+            srv.close()

@@ -11,9 +11,7 @@ with the full retry ladder).
 from __future__ import annotations
 
 import bisect
-import random
 import threading
-import time
 
 from tidb_tpu import errors
 from tidb_tpu.cluster.mvcc import KeyIsLockedError, LockInfo
@@ -23,33 +21,12 @@ from tidb_tpu.cluster.rpc import (
 )
 from tidb_tpu.cluster.topology import Cluster, Region
 from tidb_tpu.kv import kv
-
-
-# ---------------------------------------------------------------------------
-# backoff (store/tikv/backoff.go)
-# ---------------------------------------------------------------------------
-
-class Backoffer:
-    """Exponential backoff with jitter and a total budget per operation."""
-
-    BASES_MS = {"rpc": 2, "txn_lock": 10, "region_miss": 1,
-                "server_busy": 20, "pd": 5}
-
-    def __init__(self, budget_ms: int = 2000):
-        self.budget_ms = budget_ms
-        self.spent_ms = 0.0
-        self.attempts: dict[str, int] = {}
-
-    def backoff(self, kind: str, err: Exception) -> None:
-        n = self.attempts.get(kind, 0)
-        self.attempts[kind] = n + 1
-        base = self.BASES_MS.get(kind, 5)
-        sleep_ms = min(base * (2 ** n), 200) * (0.5 + random.random() / 2)
-        self.spent_ms += sleep_ms
-        if self.spent_ms > self.budget_ms:
-            raise errors.KVError(
-                f"backoff budget exhausted after {kind}: {err}") from err
-        time.sleep(sleep_ms / 1000.0)
+# the backoff ladder (store/tikv/backoff.go) lives in kv/backoff.py now:
+# ONE statement-scoped Backoffer with per-kind budgets and the
+# tidb_tpu_max_execution_time deadline, shared by every retry loop of a
+# statement (this module's ladders pick it up via backoff.current_or())
+from tidb_tpu.kv import backoff as kvbackoff
+from tidb_tpu.kv.backoff import Backoffer  # noqa: F401 — historical home
 
 
 # ---------------------------------------------------------------------------
@@ -145,8 +122,9 @@ class RegionRequestSender:
 
     def send(self, key_for_region: bytes, op, bo: Backoffer | None = None):
         """op(ctx, region) → result; region re-resolved per attempt."""
-        bo = bo or Backoffer()
+        bo = bo or kvbackoff.current_or()
         while True:
+            bo.check_deadline("region rpc")
             region = self.cache.locate(key_for_region)
             ctx = RegionCtx(region.region_id, region.epoch(),
                             region.leader_store_id)
@@ -228,7 +206,7 @@ class DistSnapshot(kv.Snapshot):
         self.version = version
 
     def _resolve_and_retry(self, fn):
-        bo = Backoffer()
+        bo = kvbackoff.current_or()
         while True:
             try:
                 return fn()

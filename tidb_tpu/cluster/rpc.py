@@ -11,13 +11,23 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from tidb_tpu import errors
+from tidb_tpu import errors, failpoint
 from tidb_tpu.cluster.mvcc import KeyIsLockedError, MvccStore
 from tidb_tpu.cluster.topology import Cluster, Region
 
 
 class RegionError(errors.RetryableError):
     pass
+
+
+class RpcTimeoutError(RegionError):
+    """A request (or its response) was lost on the wire — the client
+    cannot tell which, so the ladder invalidates the region and retries
+    (store/tikv: send errors route through onSendFail)."""
+
+    def __init__(self, region_id: int):
+        super().__init__(f"region {region_id}: rpc timeout")
+        self.region_id = region_id
 
 
 class NotLeaderError(RegionError):
@@ -66,7 +76,27 @@ class RpcHandler:
 
     # ---- region context validation ----
 
+    def _inject(self, ctx: RegionCtx) -> None:
+        """Failpoint seam for every KV/coprocessor request: each site
+        raises the REAL region error the retry ladder handles, built
+        from live cluster state (an injected stale-epoch carries the
+        server's current region exactly like a natural one)."""
+        failpoint.eval("rpc/hang")
+        failpoint.eval("rpc/timeout",
+                       lambda: RpcTimeoutError(ctx.region_id))
+        failpoint.eval("rpc/server_busy", lambda: ServerIsBusyError(
+            f"store {ctx.store_id} busy (injected)"))
+        failpoint.eval("rpc/region_miss",
+                       lambda: RegionMissError(ctx.region_id))
+        region = self.cluster.region_by_id(ctx.region_id)
+        failpoint.eval("rpc/not_leader", lambda: NotLeaderError(
+            ctx.region_id, region.leader_store_id if region else 0))
+        failpoint.eval("rpc/stale_epoch",
+                       lambda: StaleEpochError(ctx.region_id, region))
+
     def _check(self, ctx: RegionCtx) -> Region:
+        if failpoint._active:
+            self._inject(ctx)
         if ctx.store_id in self.down_stores:
             raise errors.KVError(f"store {ctx.store_id} unreachable")
         if ctx.store_id in self.busy_stores:
@@ -103,10 +133,14 @@ class RpcHandler:
     def kv_prewrite(self, ctx: RegionCtx, mutations, primary: bytes,
                     start_ts: int, ttl_ms: int):
         self._check(ctx)
+        failpoint.eval("twopc/prewrite", lambda: ServerIsBusyError(
+            "injected prewrite fault"))
         self.mvcc.prewrite(mutations, primary, start_ts, ttl_ms)
 
     def kv_commit(self, ctx: RegionCtx, keys, start_ts: int, commit_ts: int):
         self._check(ctx)
+        failpoint.eval("twopc/commit", lambda: ServerIsBusyError(
+            "injected commit fault"))
         self.mvcc.commit(keys, start_ts, commit_ts)
 
     def kv_rollback(self, ctx: RegionCtx, keys, start_ts: int):
@@ -131,6 +165,12 @@ class RpcHandler:
         from tidb_tpu.copr.region_handler import handle_request
         from tidb_tpu.kv.kv import KeyRange
         region = self._check(ctx)
+        # region-scan seams: a hang/sleep here stalls ONE fan-out worker
+        # (the statement deadline bounds it); a timeout drives the
+        # client's invalidate-and-retry
+        failpoint.eval("copr/region_scan")
+        failpoint.eval("copr/region_timeout",
+                       lambda: RpcTimeoutError(ctx.region_id))
         clipped = []
         for rg in ranges:
             lo, hi = self._clip(region, rg.start, rg.end)

@@ -15,10 +15,11 @@ from __future__ import annotations
 
 import threading
 
-from tidb_tpu import errors
+from tidb_tpu import errors, failpoint
 from tidb_tpu.cluster.client import (
     Backoffer, DistSnapshot, LockResolver, RegionCache, RegionRequestSender,
 )
+from tidb_tpu.kv import backoff as kvbackoff
 from tidb_tpu.cluster.mvcc import KeyIsLockedError, MvccStore
 from tidb_tpu.cluster.rpc import (
     RegionError, RpcHandler, StaleEpochError,
@@ -189,6 +190,11 @@ class DistCoprClient(kv.Client):
         tasks = [(rg, parent.child("region_task").set("task", i))
                  for i, rg in enumerate(ranges_split)]
         complete_seq = __import__("itertools").count()
+        # the statement's Backoffer (unified budget + deadline) crosses
+        # onto the fan-out worker threads here: every per-task ladder
+        # sleeps against the SAME budget, and hang-style faults inside a
+        # worker observe the statement deadline
+        stmt_bo = kvbackoff.current()
 
         def run(task):
             rg, sp = task
@@ -197,9 +203,13 @@ class DistCoprClient(kv.Client):
                        (_time.perf_counter_ns() - sp.start_ns) / 1e3)
             run_t0 = _time.perf_counter_ns()
             tok = tracing.attach(sp)
+            bo_tok = kvbackoff.attach(stmt_bo) \
+                if stmt_bo is not None else None
             try:
                 out = self._exec_range(rg, sel, sp)
             finally:
+                if stmt_bo is not None:
+                    kvbackoff.detach(bo_tok)
                 tracing.detach(tok)
             if not sp.is_noop:
                 sp.set("run_us", (_time.perf_counter_ns() - run_t0) / 1e3)
@@ -244,7 +254,10 @@ class DistCoprClient(kv.Client):
         )
         if span is None:
             span = tracing.NOOP
-        bo = Backoffer()
+        # the statement's ambient Backoffer (attached onto this worker by
+        # send()'s run()): every task of the fan-out sleeps against ONE
+        # budget/deadline instead of a private 2-second ladder each
+        bo = kvbackoff.current_or()
         out = []
         cursor, end = rg.start, rg.end
 
@@ -253,6 +266,9 @@ class DistCoprClient(kv.Client):
             span.inc(f"retry_{kind}")
 
         while True:
+            bo.check_deadline("copr worklist")
+            if failpoint._active:
+                failpoint.eval("copr/worklist")
             if end is not None and cursor >= end:
                 return out
             region = self.store.cache.locate(cursor)
@@ -391,7 +407,9 @@ class _PipelinedResponse(kv.Response):
                         return
                 try:
                     out = run(rg)
-                except BaseException as e:  # surfaced to the consumer
+                except BaseException as e:  # retryable-ok: stored and
+                    # RE-RAISED on the consumer thread (next/drain_all) —
+                    # routed, not swallowed
                     with self._cv:
                         if self._err is None:
                             self._err = e

@@ -12,6 +12,7 @@ from __future__ import annotations
 from tidb_tpu import errors
 from tidb_tpu.cluster.client import Backoffer
 from tidb_tpu.cluster.mvcc import KeyIsLockedError
+from tidb_tpu.kv import backoff as kvbackoff
 
 MAX_BATCH_BYTES = 512 * 1024  # appendBatchBySize (2pc.go:514)
 LOCK_TTL_MS = 3000
@@ -76,6 +77,9 @@ class TwoPhaseCommitter:
 
     def _cleanup(self) -> None:
         from tidb_tpu import binloginfo
+        # standalone ladder on purpose: cleanup runs AFTER a failure, when
+        # the statement's shared budget may already be exhausted — it must
+        # still make its best effort to release the locks
         bo = Backoffer()
         for batch in self._batches(self.keys):
             try:
@@ -84,7 +88,7 @@ class TwoPhaseCommitter:
                     lambda ctx, r: self.store.rpc.kv_rollback(
                         ctx, batch, self.start_ts),
                     bo)
-            except errors.TiDBError:
+            except errors.TiDBError:  # retryable-ok: best-effort cleanup,
                 pass  # leftover locks resolve via TTL later
         # finish binlog: rollback (writeFinishBinlog, 2pc.go:486)
         binloginfo.write_binlog({"tp": "rollback",
@@ -94,7 +98,9 @@ class TwoPhaseCommitter:
     def execute(self) -> int:
         """Returns commit_ts. Reference: execute (2pc.go:406)."""
         from tidb_tpu import binloginfo
-        bo = Backoffer()
+        # commit sleeps against the statement's unified budget/deadline
+        # when one is attached (autocommit/COMMIT run inside a statement)
+        bo = kvbackoff.current_or()
         # binlog: the prewrite record ships alongside phase 1
         # (2pc.go:462 prewriteBinlog — concurrent there, inline here;
         # the pump never fails the txn either way)
@@ -152,7 +158,7 @@ class TwoPhaseCommitter:
                 continue
             try:
                 self._commit_batch(batch, commit_ts, bo)
-            except errors.TiDBError:
+            except errors.TiDBError:  # retryable-ok: txn already decided,
                 # committed state is decided by the primary; stragglers
                 # resolve via LockResolver on next read
                 break

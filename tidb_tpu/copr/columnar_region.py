@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from tidb_tpu import errors
+from tidb_tpu import errors, failpoint
 from tidb_tpu.copr.proto import ExprType, SelectRequest, SelectResponse
 from tidb_tpu.kv.kv import KeyRange
 from tidb_tpu.ops import columnar as col
@@ -51,6 +51,15 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
     if sel.order_by and (sel.desc or sel.limit is None):
         return None
     from tidb_tpu import tracing
+    if failpoint._active and \
+            failpoint.eval("copr/drop_columnar") is not None:
+        # corrupt-partial seam, made SAFE by construction: instead of
+        # shipping damaged planes, the injected fault drops this region's
+        # columnar partial entirely — the row handler answers (the last
+        # tier of the degradation chain), so parity is preserved and the
+        # client counts a fallback for exactly this partial
+        tracing.record_degraded("region_to_rows", tally=False)
+        return None
     columns = sel.table_info.columns
     defaults = {c.column_id: c.default_val for c in columns
                 if c.default_val is not None}
@@ -83,6 +92,12 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
     try:
         if batch is None:
             with tracing.trace("pack") as psp:
+                if failpoint._active:
+                    # pack-tier fault: the typed TypeError_ takes the
+                    # same no-exact-plane-mapping exit a real unsigned
+                    # overflow does — this region degrades to rows
+                    failpoint.eval("copr/pack", lambda: errors.TypeError_(
+                        "injected region pack fault"))
                 batch = col.pack_ranges(snapshot, sel.table_info.table_id,
                                         columns, ranges, defaults)
                 psp.set("rows", batch.n_rows)
@@ -95,14 +110,22 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
                     cache.insert(base_key, region[1], version, batch,
                                  cache_info)
         with tracing.trace("filter") as fsp:
+            if failpoint._active:
+                failpoint.eval("copr/filter", lambda: errors.TypeError_(
+                    "injected region filter fault"))
             mask = _filter_mask(sel, batch)
             if mask is not None:
                 fsp.set("rows_out", int(np.count_nonzero(mask)))
     except errors.TypeError_:
-        return None      # no exact plane mapping: the CPU engine answers
+        # no exact plane mapping (or an injected pack/filter fault): this
+        # region degrades to the row protocol — the bottom tier of the
+        # degradation chain, counted so every fallback is accounted
+        tracing.record_degraded("region_to_rows", tally=False)
+        return None
     except errors.RetryableError:
         raise   # pending lock mid-pack: the client ladder resolves it
     except errors.TiDBError:
+        tracing.record_degraded("region_to_rows", tally=False)
         return None
     if mask is None:
         return None

@@ -42,6 +42,7 @@ import threading
 import weakref
 from collections import OrderedDict
 
+from tidb_tpu import errors, failpoint
 from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
 
 DEFAULT_BUDGET_BYTES = int(SYSVAR_DEFAULTS["tidb_tpu_plane_cache_bytes"])
@@ -186,6 +187,11 @@ class PlaneCache:
         tier is live); LRU-evicts to the byte budget. `info`, when given,
         accumulates the evictions this insert caused (per-statement
         attribution for the statement that packed)."""
+        if failpoint._active and \
+                failpoint.eval("cache/no_admit") is not None:
+            # admission seam: a dropped insert only costs a repack next
+            # time — correctness never depends on the cache admitting
+            return
         nbytes = batch_nbytes(batch)
         full_key = base_key + (epoch, version)
         with self._lock:
@@ -268,6 +274,8 @@ def _maybe_pin_device(batch) -> bool:
         from tidb_tpu.ops.client import pin_batch_device
         pin_batch_device(batch)
         return True
+    except errors.RetryableError:
+        raise       # a retryable fault must reach the client ladder
     except Exception:
         return False            # device tier broken ≠ cache broken
 

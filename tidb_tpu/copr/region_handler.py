@@ -116,8 +116,8 @@ class _SelectContext:
                 return
             try:
                 _, handle = tablecodec.decode_row_key(key)
-            except errors.TiDBError:
-                continue
+            except errors.TiDBError:  # retryable-ok: pure key decode,
+                continue              # no KV access inside the try
             row = tablecodec.decode_row(value)
             self._fill_handle(row, handle)
             for cid, dv in self.fill_cols:

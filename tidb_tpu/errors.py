@@ -90,6 +90,28 @@ class KeyExistsError(KVError, DupEntryError):
         self.existing_handle = existing_handle
 
 
+class DeadlineExceededError(KVError):
+    """Backoff budget or statement deadline exhausted — the typed,
+    NON-retryable surface of the unified Backoffer (kv/backoff.py).
+    Carries the retry ladder history in `.history` as
+    (kind, attempt, sleep_ms, err) tuples. MySQL 3024 ER_QUERY_TIMEOUT."""
+
+    code = 3024
+
+    def __init__(self, msg: str = "", code: int | None = None):
+        super().__init__(msg, code)
+        self.history: list = []
+
+
+class DeviceError(TiDBError):
+    """Device-tier fault (kernel compile failure, device OOM, readback
+    failure — real or failpoint-injected). Recoverable by construction:
+    every device route has a certified host fallback, so this class is
+    caught at the degradation seams (ops/client.send, HashJoinExec,
+    fused_agg's region combine) and never becomes a statement error
+    while a lower tier exists."""
+
+
 class RetryableError(KVError):
     """kv.ErrRetryable / write-conflict class: session may replay the txn.
 

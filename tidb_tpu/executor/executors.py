@@ -588,11 +588,17 @@ class HashJoinExec(Executor):
             except Exception:
                 # clean bail-out: the numpy path below answers from the
                 # same drained sides and key planes — but a systematically
-                # failing device path must not degrade silently
+                # failing device path must not degrade silently. This is
+                # the join rung of the degradation chain (device→host
+                # numpy), counted on copr.degraded_join_to_numpy and the
+                # statement's tally so every fallback is accounted.
                 import logging
+
+                from tidb_tpu import tracing
                 logging.getLogger("tidb_tpu.join").warning(
                     "device join bailed out to the numpy path",
                     exc_info=True)
+                tracing.record_degraded("join_to_numpy")
                 self.join_stats["device_error"] = True
         self.join_stats["path"] = "numpy"
         # host sort-merge, pairs expanded VECTORIZED (the same

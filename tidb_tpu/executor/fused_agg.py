@@ -82,9 +82,23 @@ class _RegionCombine:
     def run(self) -> None:
         if not self._states:
             return
+        from tidb_tpu import errors
         from tidb_tpu.ops import kernels
-        self._results = kernels.combine_region_partials(self._states,
-                                                        self._ops)
+        try:
+            self._results = kernels.combine_region_partials(self._states,
+                                                            self._ops)
+        except errors.DeviceError:
+            # combine rung of the degradation chain: the SAME monoid
+            # reductions run host-side over the [R, G] stacks — exact
+            # (int sums/counts are int64-exact, min/max are order-free;
+            # float SUM/AVG never enter the combine — they stay on the
+            # sequential host accumulator) so answers cannot change
+            from tidb_tpu import tracing
+            tracing.record_degraded("combine_to_host")
+            reduce_ = {"sum": np.sum, "min": np.min, "max": np.max}
+            self._results = [
+                np.atleast_1d(reduce_[op](s, axis=0))
+                for s, op in zip(self._states, self._ops)]
         stats["partial_combines"] += 1
         stats["last_combine_regions"] = len(self.slices)
 

@@ -1,0 +1,215 @@
+"""Failpoint fault injection: a process-wide, thread-safe registry of
+named fault sites threaded through every boundary of the coprocessor
+path.
+
+Reference: the reference hardens its storage tier with gofail-style
+failpoints (`// gofail:` markers compiled into injectable sites) and
+exercises the client retry ladder with them; here the same idea is a
+plain registry — production code calls `failpoint.eval("site/name")` at
+each seam, which is a no-op (one global dict truth-test) until a test or
+operator enables that name.
+
+Catalog discipline: a site name is `<layer>/<fault>` (e.g.
+`rpc/server_busy`, `device/readback`). The call site supplies the
+default exception factory, so an injected `rpc/stale_epoch` raises a
+REAL StaleEpochError carrying the server's current region — the ladder
+being tested cannot tell injection from nature. See README "Robustness"
+for the full catalog.
+
+Trigger policies (per enabled failpoint):
+
+* ``always``          — every evaluation fires
+* ``("every", n)``    — every n-th evaluation fires (n, 2n, …)
+* ``("first", n)``    — the first n evaluations fire, then never again
+* ``("prob", p)``     — each evaluation fires with probability p, from a
+                        PER-FAILPOINT ``random.Random(seed)`` so chaos
+                        schedules replay exactly
+
+Actions:
+
+* ``error``  — raise: `exc` (instance, class, or zero-arg callable), else
+               the call site's `default_exc`, else FailpointError
+* ``sleep``  — block `seconds` then continue
+* ``hang``   — block until `release(name)` / `disable(name)`; while
+               hanging, the AMBIENT statement deadline (kv.backoff) is
+               honored: a hung statement under `tidb_tpu_max_execution_time`
+               fails with DeadlineExceededError instead of wedging
+* ``return`` — eval returns `value` (sites use this for data-shape
+               faults: corrupt-partial row drops, cache-admission drops)
+
+Disabled-path cost: `eval()` is one module-global load and truth test —
+the zero-failpoint bench figures must be indistinguishable from a build
+without the framework.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_active: dict[str, "_Failpoint"] = {}
+
+
+class FailpointError(Exception):
+    """Default injected error when neither the enable() nor the call site
+    supplied a typed one."""
+
+
+class _Failpoint:
+    __slots__ = ("name", "action", "exc", "value", "seconds", "when",
+                 "rng", "evals", "triggers", "release_event")
+
+    def __init__(self, name: str, action: str, exc, value, seconds: float,
+                 when, seed):
+        if action not in ("error", "sleep", "hang", "return"):
+            raise ValueError(f"unknown failpoint action {action!r}")
+        norm = ("always",) if when == "always" else tuple(when)
+        if norm[0] not in ("always", "every", "first", "prob"):
+            raise ValueError(f"unknown failpoint policy {when!r}")
+        self.name = name
+        self.action = action
+        self.exc = exc
+        self.value = value
+        self.seconds = seconds
+        self.when = norm
+        self.rng = random.Random(seed)
+        self.evals = 0
+        self.triggers = 0
+        self.release_event = threading.Event()
+
+    def should_fire(self) -> bool:
+        """Policy decision for one evaluation; caller holds _lock."""
+        self.evals += 1
+        kind = self.when[0]
+        if kind == "always":
+            return True
+        if kind == "every":
+            return self.evals % int(self.when[1]) == 0
+        if kind == "first":
+            return self.evals <= int(self.when[1])
+        return self.rng.random() < float(self.when[1])
+
+
+def enable(name: str, action: str = "error", *, exc=None, value=None,
+           seconds: float = 0.0, when="always", seed=None) -> None:
+    """Enable one failpoint (replacing any previous state under `name`)."""
+    fp = _Failpoint(name, action, exc, value, seconds, when, seed)
+    with _lock:
+        old = _active.get(name)
+        if old is not None:
+            old.release_event.set()   # never strand a hung thread
+        _active[name] = fp
+
+
+def disable(name: str) -> None:
+    with _lock:
+        fp = _active.pop(name, None)
+    if fp is not None:
+        fp.release_event.set()
+
+
+def disable_all() -> None:
+    with _lock:
+        fps = list(_active.values())
+        _active.clear()
+    for fp in fps:
+        fp.release_event.set()
+
+
+def release(name: str) -> None:
+    """Unblock threads parked on a `hang` failpoint (it stays enabled —
+    later evaluations hang again on a fresh event)."""
+    with _lock:
+        fp = _active.get(name)
+        if fp is not None:
+            fp.release_event.set()
+            fp.release_event = threading.Event()
+
+
+def enabled(name: str) -> bool:
+    return name in _active
+
+
+def counters(name: str) -> dict:
+    """{"evals": n, "triggers": n} for an enabled failpoint (zeros when
+    not enabled) — tests assert schedules through this."""
+    with _lock:
+        fp = _active.get(name)
+        if fp is None:
+            return {"evals": 0, "triggers": 0}
+        return {"evals": fp.evals, "triggers": fp.triggers}
+
+
+@contextmanager
+def failpoints(spec: dict):
+    """Enable a schedule of failpoints for a block, disabling every one
+    (and releasing any hangs) on exit:
+
+        with failpoint.failpoints({
+                "rpc/server_busy": {"when": ("first", 1)},
+                "device/readback": {"action": "error"}}):
+            ...
+    """
+    names = []
+    try:
+        for name, kw in spec.items():
+            enable(name, **(kw if isinstance(kw, dict)
+                            else {"action": kw}))
+            names.append(name)
+        yield
+    finally:
+        for name in names:
+            disable(name)
+
+
+def eval(name: str, default_exc=None):
+    """Evaluate one fault site. Returns None when the failpoint is not
+    enabled or its policy does not fire this time; `return`-action
+    failpoints return their configured value; `error`/`sleep`/`hang`
+    act as documented above. `default_exc` is a zero-arg callable the
+    call site provides so injected errors are the REAL typed errors its
+    retry ladder handles."""
+    if not _active:
+        return None
+    with _lock:
+        fp = _active.get(name)
+        if fp is None or not fp.should_fire():
+            return None
+        fp.triggers += 1
+        event = fp.release_event
+    from tidb_tpu import metrics
+    metrics.counter("failpoint.triggers."
+                    + name.replace("/", ".")).inc()
+    if fp.action == "return":
+        return fp.value
+    if fp.action == "sleep":
+        time.sleep(fp.seconds)
+        return None
+    if fp.action == "hang":
+        _hang(fp, event)
+        return None
+    exc = fp.exc
+    if exc is None and default_exc is not None:
+        exc = default_exc
+    if exc is None:
+        raise FailpointError(f"injected failpoint {name}")
+    if isinstance(exc, BaseException):
+        raise exc
+    raise exc()
+
+
+def _hang(fp: _Failpoint, event: threading.Event) -> None:
+    """Block until released/disabled — but honor the ambient statement
+    deadline so a hung statement fails typed-and-bounded instead of
+    wedging its worker thread forever."""
+    from tidb_tpu.kv import backoff as _backoff
+    while not event.wait(0.02):
+        if _active.get(fp.name) is not fp:
+            return
+        bo = _backoff.current()
+        if bo is not None and bo.deadline is not None \
+                and time.monotonic() >= bo.deadline:
+            raise bo.deadline_error(f"failpoint {fp.name} hang")

@@ -26,4 +26,7 @@ from tidb_tpu.kv.kv import (  # noqa: F401
 )
 from tidb_tpu.kv.membuffer import MemBuffer  # noqa: F401
 from tidb_tpu.kv.union_store import UnionStore  # noqa: F401
-from tidb_tpu.kv.txn_util import run_in_new_txn, backoff  # noqa: F401
+# NOTE: txn_util.backoff (the function) is deliberately NOT re-exported —
+# `tidb_tpu.kv.backoff` is the unified-Backoffer MODULE; the package attr
+# must resolve to it unambiguously
+from tidb_tpu.kv.txn_util import run_in_new_txn  # noqa: F401

@@ -1,0 +1,247 @@
+"""Unified backoff budget + statement deadline.
+
+Reference: store/tikv/backoff.go — a Backoffer is ONE object per
+operation carrying per-error-kind exponential schedules, a shared sleep
+budget, and (here) an absolute deadline derived from
+`tidb_tpu_max_execution_time`. Every retry ladder in the cluster tier
+(region RPC, coprocessor worklist, lock resolution, 2PC, optimistic
+statement replay) sleeps against the SAME statement-scoped instance, so
+a fault storm exhausts one typed budget instead of N independent 2-second
+ladders, and exhaustion surfaces a DeadlineExceededError carrying the
+full retry history.
+
+Scope plumbing: the session attaches a statement Backoffer to this
+module's thread-local at the top of each statement; the coprocessor
+fan-out re-attaches it on its worker threads (cluster/store.py run()),
+so sleeps on ANY thread of the statement draw from the one budget and
+observe the one deadline. Code that retries outside a statement
+(GC, DDL job queue) uses a standalone instance.
+
+Determinism hooks: `set_test_hooks(rng=..., sleeper=...)` swaps the
+module RNG and sleeper so chaos/failpoint tests assert EXACT backoff
+schedules without sleeping wall-clock; kv.txn_util routes through the
+same hooks.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from tidb_tpu import errors
+
+# per-kind exponential bases (ms) — store/tikv/backoff.go's typed configs
+BASES_MS = {"rpc": 2, "txn_lock": 10, "region_miss": 1,
+            "server_busy": 20, "pd": 5, "txn_retry": 1}
+CAPS_MS = {"txn_retry": 100}
+DEFAULT_BASE_MS = 5
+DEFAULT_CAP_MS = 200
+
+DEFAULT_BUDGET_MS = 2000        # standalone ladders (GC, background)
+DEFAULT_STMT_BUDGET_MS = 10_000  # the per-statement shared budget
+
+HISTORY_CAP = 64
+
+# ---- injectable determinism hooks (kv/txn_util routes through these) ----
+
+_default_rng = random.Random()
+_rng = _default_rng
+_sleep = time.sleep
+
+
+def set_test_hooks(rng=None, sleeper=None) -> None:
+    """Swap the RNG and/or sleeper module-wide (pass None to keep one).
+    Tests assert exact schedules with rng=random.Random(seed) and a
+    recording sleeper; ALWAYS pair with reset_test_hooks()."""
+    global _rng, _sleep
+    if rng is not None:
+        _rng = rng
+    if sleeper is not None:
+        _sleep = sleeper
+
+
+def reset_test_hooks() -> None:
+    global _rng, _sleep
+    _rng = _default_rng
+    _sleep = time.sleep
+
+
+def compute_sleep_ms(kind: str, attempt: int) -> float:
+    """The jittered exponential sleep for one retry — the single formula
+    every ladder (Backoffer and kv.txn_util's legacy helper) uses."""
+    base = BASES_MS.get(kind, DEFAULT_BASE_MS)
+    cap = CAPS_MS.get(kind, DEFAULT_CAP_MS)
+    return min(base * (2 ** min(attempt, 30)), cap) \
+        * (0.5 + _rng.random() / 2)
+
+
+class Backoffer:
+    """Exponential backoff with per-kind schedules, one shared budget,
+    an optional absolute deadline, and an attached retry history.
+
+    Thread-safe: the fan-out's worker threads share the statement's
+    instance (that IS the unified budget). `budget_ms=None` disables the
+    budget (deadline-only ladders, e.g. DDL meta retries)."""
+
+    BASES_MS = BASES_MS   # back-compat alias (older call sites read it)
+
+    def __init__(self, budget_ms: int | None = DEFAULT_BUDGET_MS,
+                 deadline: float | None = None):
+        self.budget_ms = budget_ms
+        self.deadline = deadline          # absolute time.monotonic() secs
+        self.spent_ms = 0.0
+        self.attempts: dict[str, int] = {}
+        self.history: list[tuple] = []    # (kind, attempt, sleep_ms, err)
+        self._dropped = 0
+        self._lock = threading.Lock()
+
+    def fork(self) -> "Backoffer":
+        """Worker-thread handle sharing THIS budget/deadline/history —
+        all state is lock-protected, so the instance itself is the
+        shared ledger (tikv's Fork, with a genuinely shared budget)."""
+        return self
+
+    # ---- deadline ----
+
+    def remaining_s(self) -> float | None:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def check_deadline(self, what: str = "") -> None:
+        """Raise DeadlineExceededError when the statement deadline has
+        passed — cheap enough for per-attempt loop headers."""
+        if self.deadline is not None and time.monotonic() >= self.deadline:
+            raise self.deadline_error(what)
+
+    def deadline_error(self, what: str = "",
+                       cause: BaseException | None = None):
+        err = errors.DeadlineExceededError(
+            "statement deadline exceeded"
+            + (f" during {what}" if what else "")
+            + f"; retries: [{self.history_summary()}]")
+        err.history = list(self.history)
+        if cause is not None:
+            err.__cause__ = cause
+        return err
+
+    def history_summary(self) -> str:
+        with self._lock:
+            ents = list(self.history)
+            dropped = self._dropped
+        parts = [f"{kind}#{attempt}:{sleep_ms:.1f}ms({msg})"
+                 for kind, attempt, sleep_ms, msg in ents]
+        if dropped:
+            parts.append(f"... +{dropped} more")
+        return ", ".join(parts)
+
+    # ---- the ladder ----
+
+    def backoff(self, kind: str, err: Exception) -> float:
+        """Record one retry of `kind`, sleep its jittered exponential
+        slot against the shared budget/deadline, and return the slept
+        milliseconds. Exhaustion (budget OR deadline) raises
+        DeadlineExceededError with the ladder history attached."""
+        with self._lock:
+            n = self.attempts.get(kind, 0)
+            self.attempts[kind] = n + 1
+            sleep_ms = compute_sleep_ms(kind, n)
+            over_budget = self.budget_ms is not None \
+                and self.spent_ms + sleep_ms > self.budget_ms
+            if not over_budget:
+                self.spent_ms += sleep_ms
+            if len(self.history) < HISTORY_CAP:
+                self.history.append((kind, n, round(sleep_ms, 2),
+                                     str(err)[:120]))
+            else:
+                self._dropped += 1
+        from tidb_tpu import metrics, tracing
+        if over_budget:
+            metrics.counter("kv.backoff_exhausted").inc()
+            e = errors.DeadlineExceededError(
+                f"backoff budget {self.budget_ms}ms exhausted at {kind}: "
+                f"{err}; retries: [{self.history_summary()}]")
+            e.history = list(self.history)
+            raise e from err
+        remaining = self.remaining_s()
+        if remaining is not None:
+            if remaining <= 0:
+                metrics.counter("kv.backoff_exhausted").inc()
+                raise self.deadline_error(f"{kind} backoff", err)
+            sleep_ms = min(sleep_ms, remaining * 1000.0)
+        metrics.counter(f"kv.backoff.{kind}").inc()
+        tracing.count("backoff_retries")
+        tracing.count("backoff_ms", int(round(sleep_ms)))
+        # span attribution: on a fan-out worker the current span is its
+        # region_task, so the trace shows which task slept how long
+        sp = tracing.current()
+        if not sp.is_noop:
+            sp.inc("backoff_retries")
+            sp.inc("backoff_ms", int(round(sleep_ms)))
+        _sleep(sleep_ms / 1000.0)
+        if self.deadline is not None and time.monotonic() >= self.deadline:
+            metrics.counter("kv.backoff_exhausted").inc()
+            raise self.deadline_error(f"{kind} backoff", err)
+        return sleep_ms
+
+
+def txn_retry_sleep(upper_ms: float) -> float:
+    """kv/txn_util's uniform backoff slot, routed through this module's
+    determinism hooks (set_test_hooks makes the schedule exact under
+    test) and the AMBIENT statement deadline. Budget-EXEMPT on purpose:
+    meta/DDL retries must win eventually, so they never draw down the
+    statement's shared sleep budget — but a statement deadline still
+    bounds them typed. Returns slept seconds."""
+    ms = _rng.uniform(0, upper_ms)
+    bo = current()
+    if bo is not None and bo.deadline is not None:
+        remaining = bo.remaining_s()
+        if remaining <= 0:
+            from tidb_tpu import metrics
+            metrics.counter("kv.backoff_exhausted").inc()
+            raise bo.deadline_error("txn retry backoff")
+        ms = min(ms, remaining * 1000.0)
+    from tidb_tpu import metrics, tracing
+    metrics.counter("kv.backoff.txn_retry").inc()
+    tracing.count("backoff_retries")
+    tracing.count("backoff_ms", int(round(ms)))
+    _sleep(ms / 1000.0)
+    return ms / 1000.0
+
+
+# ---------------------------------------------------------------------------
+# statement scope: thread-local ambient Backoffer
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def attach(bo: Backoffer | None):
+    """Make `bo` the thread's ambient Backoffer; returns a token for
+    detach(). The session attaches per statement; fan-out workers attach
+    the statement's instance handed to them."""
+    prev = getattr(_tls, "bo", None)
+    _tls.bo = bo
+    return prev
+
+
+def detach(token) -> None:
+    _tls.bo = token
+
+
+def current() -> Backoffer | None:
+    return getattr(_tls, "bo", None)
+
+
+def current_or(budget_ms: int | None = DEFAULT_BUDGET_MS) -> Backoffer:
+    """The ambient statement Backoffer — every ladder of one statement
+    shares its budget — or a fresh standalone one outside a statement
+    (background work: GC, domain reloads)."""
+    bo = current()
+    return bo if bo is not None else Backoffer(budget_ms=budget_ms)
+
+
+def ambient_deadline() -> float | None:
+    bo = current()
+    return bo.deadline if bo is not None else None

@@ -1,16 +1,19 @@
 """Transaction retry helpers.
 
 Reference: kv/txn.go (RunInNewTxn, BackOff with exponential jitter).
+The sleep itself routes through kv.backoff's injectable RNG/sleeper
+hooks (set_test_hooks), so chaos/failpoint tests assert exact backoff
+schedules instead of sleeping wall-clock, and an ambient statement
+deadline (tidb_tpu_max_execution_time) bounds meta-txn retries typed.
 """
 
 from __future__ import annotations
 
 import logging
-import random
-import time
 from typing import Callable, TypeVar
 
 from tidb_tpu import errors
+from tidb_tpu.kv.backoff import txn_retry_sleep
 
 log = logging.getLogger(__name__)
 
@@ -22,11 +25,11 @@ T = TypeVar("T")
 
 
 def backoff(attempts: int) -> float:
-    """Sleep with capped exponential backoff + jitter; returns slept seconds."""
-    upper = min(RETRY_BACKOFF_CAP_MS, RETRY_BACKOFF_BASE_MS * (1 << min(attempts, 20)))
-    ms = random.uniform(0, upper)
-    time.sleep(ms / 1000.0)
-    return ms / 1000.0
+    """Sleep with capped exponential backoff + jitter; returns slept
+    seconds. Deterministic under kv.backoff.set_test_hooks."""
+    upper = min(RETRY_BACKOFF_CAP_MS,
+                RETRY_BACKOFF_BASE_MS * (1 << min(attempts, 20)))
+    return txn_retry_sleep(upper)
 
 
 def run_in_new_txn(store, retryable: bool, fn: Callable[[object], T],
@@ -54,5 +57,9 @@ def run_in_new_txn(store, retryable: bool, fn: Callable[[object], T],
                 raise
             last_err = e
             log.debug("run_in_new_txn retry %d: %s", attempt, e)
+            from tidb_tpu import metrics
+            metrics.counter("kv.txn_retries").inc()
             backoff(attempt)
+    from tidb_tpu import metrics
+    metrics.counter("kv.txn_retry_exhausted").inc()
     raise last_err  # type: ignore[misc]

@@ -26,7 +26,7 @@ from decimal import Decimal
 
 import numpy as np
 
-from tidb_tpu import errors, mysqldef as my
+from tidb_tpu import errors, failpoint, mysqldef as my
 from tidb_tpu.codec import codec
 from tidb_tpu.copr.proto import (
     AGG_NAME, ChunkWriter, Expr, ExprType, SelectRequest, SelectResponse,
@@ -206,6 +206,22 @@ class TpuClient(kv.Client):
             # exact row count (post-pack) under the floor: CPU is cheaper
             sp.set("route", "below_floor")
             return self._route_small(req, sel)
+        except errors.DeviceError as e:
+            # device-tier fault (compile, OOM, readback — real or
+            # injected): the FIRST rung of the degradation chain. The
+            # fault is recoverable by construction — the CPU engine
+            # answers the same request from the same snapshot — so it is
+            # counted (copr.degraded_device_to_cpu + statement tally),
+            # logged, and never becomes a statement error
+            import logging
+            logging.getLogger("tidb_tpu.ops").warning(
+                "device tier degraded to CPU engine: %s", e)
+            tracing.record_degraded("device_to_cpu")
+            self.stats["cpu_fallbacks"] += 1
+            metrics.counter("copr.tpu.cpu_fallbacks").inc()
+            sp.set("route", "cpu_fallback")
+            sp.set("degraded", "device_to_cpu")
+            return self._cpu_answer(req, sel)
         except (Unsupported, errors.TypeError_):
             # TypeError_ = a column/value has no exact plane mapping
             # (e.g. decimal finer than the fixed-point scale): same
@@ -213,24 +229,24 @@ class TpuClient(kv.Client):
             self.stats["cpu_fallbacks"] += 1
             metrics.counter("copr.tpu.cpu_fallbacks").inc()
             sp.set("route", "cpu_fallback")
-            if any(e.distinct for e in sel.aggregates):
-                # per-region partials under-merge distinct aggregates; the
-                # CPU fallback must run the whole request as ONE region
-                # (the TPU probe admitted distinct on the promise of
-                # global execution)
-                return self._cpu_global(req, sel)
-            return self.cpu.send(req)
+            return self._cpu_answer(req, sel)
 
-    def _route_small(self, req: kv.Request, sel) -> kv.Response:
-        """Below the dispatch floor: the CPU engine answers. Distinct
-        aggregates were admitted on the promise of request-global
-        execution, so they take the single-region CPU path."""
-        from tidb_tpu import metrics
-        self.stats["small_to_cpu"] += 1
-        metrics.counter("copr.tpu.small_to_cpu").inc()
+    def _cpu_answer(self, req: kv.Request, sel) -> kv.Response:
+        """Distinct-aware CPU dispatch — THE fallback tail every reroute
+        shares: per-region partials under-merge distinct aggregates, so
+        a request admitted on the promise of request-global execution
+        runs the single-region CPU path; everything else goes to the
+        store's own coprocessor engine."""
         if any(e.distinct for e in sel.aggregates):
             return self._cpu_global(req, sel)
         return self.cpu.send(req)
+
+    def _route_small(self, req: kv.Request, sel) -> kv.Response:
+        """Below the dispatch floor: the CPU engine answers."""
+        from tidb_tpu import metrics
+        self.stats["small_to_cpu"] += 1
+        metrics.counter("copr.tpu.small_to_cpu").inc()
+        return self._cpu_answer(req, sel)
 
     def _cpu_global(self, req: kv.Request, sel) -> kv.Response:
         from tidb_tpu.copr.region_handler import handle_request
@@ -387,9 +403,20 @@ class TpuClient(kv.Client):
         tracing.record_jit_cache(hit=ent is not None)
         if ent is None:
             import jax
-            fn = build()
-            wrapper = kernels.pack_outputs(fn)
-            ent = (fn, wrapper, jax.jit(wrapper), {"runs": 0})
+            if failpoint._active:
+                failpoint.eval("device/compile", lambda: errors.DeviceError(
+                    f"injected kernel compile failure ({kind})"))
+            try:
+                fn = build()
+                wrapper = kernels.pack_outputs(fn)
+                ent = (fn, wrapper, jax.jit(wrapper), {"runs": 0})
+            except (errors.TiDBError, Unsupported):
+                raise       # typed routing decisions, not device faults
+            except Exception as e:
+                # a real lowering/compile crash is a device-tier fault:
+                # surface it typed so send() degrades instead of erroring
+                raise errors.DeviceError(
+                    f"kernel build failed ({kind}): {e}") from e
             self._fn_cache[key] = ent
             if len(self._fn_cache) > 256:
                 self._fn_cache.pop(next(iter(self._fn_cache)))
@@ -411,9 +438,27 @@ class TpuClient(kv.Client):
             state["runs"] += 1
         sp = tracing.current().child("kernel").set("kind", kind)
         t0 = _time.perf_counter()
-        packed = jitted(planes, live)
-        t_disp = _time.perf_counter()
-        host = np.asarray(packed)
+        try:
+            if failpoint._active:
+                failpoint.eval("device/oom", lambda: errors.DeviceError(
+                    f"injected device OOM ({kind})"))
+            packed = jitted(planes, live)
+            t_disp = _time.perf_counter()
+            if failpoint._active:
+                failpoint.eval("device/readback",
+                               lambda: errors.DeviceError(
+                                   f"injected readback failure ({kind})"))
+            host = np.asarray(packed)
+        except errors.TiDBError:
+            sp.set("error", "fault").finish()   # a dead span must not
+            raise                               # bleed to statement end
+        except Exception as e:
+            # XLA RESOURCE_EXHAUSTED / runtime crashes at the dispatch or
+            # readback boundary are device faults by definition: typed so
+            # the degradation chain handles them, never a statement error
+            sp.set("error", "fault").finish()
+            raise errors.DeviceError(
+                f"device dispatch failed ({kind}): {e}") from e
         t1 = _time.perf_counter()
         nbytes = int(host.nbytes)
         sp.set("phase", "trace+execute" if first else "execute")

@@ -874,11 +874,25 @@ def combine_region_partials(states: list[np.ndarray],
         if len(_combine_cache) > 256:
             _combine_cache.pop(next(iter(_combine_cache)))
     wrapper, jitted = ent
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
     sp = _tracing.current().child("combine_region_partials") \
         .set("regions", int(states[0].shape[0])) \
         .set("states", len(states))
-    packed = jitted(tuple(jnp.asarray(s) for s in states), None)
-    host = np.asarray(packed)
+    try:
+        if _failpoint._active:
+            _failpoint.eval("device/combine", lambda: _errors.DeviceError(
+                "injected region-combine failure"))
+        packed = jitted(tuple(jnp.asarray(s) for s in states), None)
+        host = np.asarray(packed)
+    except _errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash in the combine kernel: typed, so the
+        # fused aggregate degrades to the host combine (same algebra);
+        # the span is finished here, not at statement end
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(f"region combine failed: {e}") from e
     sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
     sp.finish()
     _tracing.record_dispatch(readback_bytes=int(host.nbytes))
@@ -989,6 +1003,10 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
     for the bench's phase split."""
     import time as _time
 
+    from tidb_tpu import errors, failpoint
+    if failpoint._active:
+        failpoint.eval("device/join", lambda: errors.DeviceError(
+            "injected device join failure"))
     n_left = int(lkey.shape[0])
     lcap = col.bucket_capacity(max(n_left, 1))
     rcap = col.bucket_capacity(max(int(rkey.shape[0]), 1))

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import socket
 import struct
+from contextlib import contextmanager
 
 from tidb_tpu.server import protocol as p
 from tidb_tpu.server.packetio import PacketIO
@@ -21,6 +22,19 @@ class MySQLError(Exception):
         super().__init__(f"({code}) {message}")
         self.code = code
         self.message = message
+
+
+class ClientTimeout(MySQLError):
+    """A socket operation exceeded the client's connect/read timeout —
+    the TYPED surface of what used to escape as a raw socket.timeout
+    (CR 2013, what libmysql raises when the server goes silent)."""
+
+    def __init__(self, op: str, seconds: float | None):
+        super().__init__(
+            2013, f"Lost connection to MySQL server during {op} "
+            f"(timeout after {seconds}s)")
+        self.op = op
+        self.seconds = seconds
 
 
 class QueryResult:
@@ -35,18 +49,48 @@ class QueryResult:
 class Client:
     def __init__(self, host: str, port: int, user: str = "root",
                  password: str = "", db: str = "", timeout: float = 10.0,
-                 local_infile: bool = False):
-        sock = socket.create_connection((host, port), timeout=timeout)
+                 local_infile: bool = False,
+                 read_timeout: float | None = None):
+        """`timeout` bounds the TCP connect (and the handshake);
+        `read_timeout` bounds every later read/write on the connection
+        (None → same as `timeout`). Both surface as the typed
+        ClientTimeout instead of a raw socket.timeout."""
+        self._read_timeout = timeout if read_timeout is None else \
+            read_timeout
+        with self._timeout_guard("connect", timeout):
+            sock = socket.create_connection((host, port), timeout=timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # connect used the connect timeout; every subsequent socket op
+        # (handshake reads included) runs under the read timeout
+        sock.settimeout(self._read_timeout)
         self.pkt = PacketIO(sock)
         # opt-in, like MySQL's local_infile: a server must not be able to
         # exfiltrate arbitrary client files via unsolicited 0xFB requests
         self.local_infile = local_infile
         try:
-            self._handshake(user, password, db)
+            with self._timeout_guard("handshake"):
+                self._handshake(user, password, db)
         except BaseException:
             self.pkt.close()  # don't leak the fd on auth/db rejection
             raise
+
+    @contextmanager
+    def _timeout_guard(self, op: str, seconds: float | None = None):
+        """Convert a socket.timeout escaping this block into the typed
+        ClientTimeout. The connection is CLOSED first: a timeout leaves
+        the wire mid-response, so reusing the socket would parse the
+        late bytes as the next command's result (CR 2013 is
+        connection-fatal in libmysql for the same reason) — callers
+        catch the typed error and reconnect."""
+        try:
+            yield
+        except socket.timeout as e:
+            pkt = getattr(self, "pkt", None)
+            if pkt is not None:     # connect timeout: no PacketIO yet
+                pkt.close()
+            raise ClientTimeout(
+                op, self._read_timeout if seconds is None else seconds) \
+                from e
 
     # ---- handshake ----
 
@@ -100,12 +144,13 @@ class Client:
     def query(self, sql: str) -> list[QueryResult]:
         """COM_QUERY; returns one QueryResult per resultset (rows=None for
         effect-only statements)."""
-        self.pkt.reset_sequence()
-        self.pkt.write_packet(bytes((p.COM_QUERY,)) + sql.encode())
-        results = [self._read_result()]
-        while results[-1].more:
-            results.append(self._read_result())
-        return results
+        with self._timeout_guard("query"):
+            self.pkt.reset_sequence()
+            self.pkt.write_packet(bytes((p.COM_QUERY,)) + sql.encode())
+            results = [self._read_result()]
+            while results[-1].more:
+                results.append(self._read_result())
+            return results
 
     def _read_result(self) -> QueryResult:
         first = self.pkt.read_packet()
@@ -173,6 +218,10 @@ class Client:
 
     def prepare(self, sql: str) -> tuple[int, int]:
         """COM_STMT_PREPARE → (statement id, param count)."""
+        with self._timeout_guard("prepare"):
+            return self._prepare(sql)
+
+    def _prepare(self, sql: str) -> tuple[int, int]:
         self.pkt.reset_sequence()
         self.pkt.write_packet(bytes((p.COM_STMT_PREPARE,)) + sql.encode())
         head = self.pkt.read_packet()
@@ -233,9 +282,10 @@ class Client:
                     types += struct.pack("<H", 0xFD)       # VAR_STRING
                     vals += p.lenenc_bytes(str(v).encode())
             body += bytes(bitmap) + b"\x01" + types + vals
-        self.pkt.reset_sequence()
-        self.pkt.write_packet(bytes((p.COM_STMT_EXECUTE,)) + body)
-        return self._read_binary_result()
+        with self._timeout_guard("execute"):
+            self.pkt.reset_sequence()
+            self.pkt.write_packet(bytes((p.COM_STMT_EXECUTE,)) + body)
+            return self._read_binary_result()
 
     def close_stmt(self, stmt_id: int) -> None:
         self.pkt.reset_sequence()
@@ -349,11 +399,12 @@ class Client:
         return row
 
     def ping(self) -> None:
-        self.pkt.reset_sequence()
-        self.pkt.write_packet(bytes((p.COM_PING,)))
-        resp = self.pkt.read_packet()
-        if resp[0] == 0xFF:
-            raise self._as_error(resp)
+        with self._timeout_guard("ping"):
+            self.pkt.reset_sequence()
+            self.pkt.write_packet(bytes((p.COM_PING,)))
+            resp = self.pkt.read_packet()
+            if resp[0] == 0xFF:
+                raise self._as_error(resp)
 
     def close(self) -> None:
         try:

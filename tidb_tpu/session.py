@@ -14,6 +14,7 @@ import threading
 from tidb_tpu import errors, sqlast as ast
 from tidb_tpu.executor.builder import ExecutorBuilder
 from tidb_tpu.executor.simple import ResultSet, execute_simple, explain_result
+from tidb_tpu.kv import backoff as kvbackoff
 from tidb_tpu.kv.kv import open_store, register_driver
 from tidb_tpu.domain import get_domain
 from tidb_tpu.parser.parser import Parser
@@ -204,28 +205,39 @@ class Session:
 
     def _retry(self) -> None:
         """Replay statement history on a fresh snapshot (session.Retry
-        :274). History holds the txn's mutating statement texts."""
+        :274). History holds the txn's mutating statement texts. Each
+        replay is counted (session.retries metric + session_retries
+        statement tally) and attributed on a session_retry span;
+        exhaustion bumps session.retry_exhausted so optimistic-retry
+        storms are visible on /metrics instead of only as errors."""
+        from tidb_tpu import metrics, tracing
         stmts = list(self.history)
         last_err = None
         self._in_retry = True
         try:
-            for _ in range(self.vars.retry_limit):
-                try:
-                    for sql in stmts:
-                        self._execute_one(self.parser.parse_one(sql), sql,
-                                          record_history=False)
-                    if self._txn is not None:
-                        self._txn.commit()
-                        self._txn = None
-                    return
-                except errors.RetryableError as e:
-                    last_err = e
-                    if self._txn is not None:
-                        self._txn.rollback()
-                        self._txn = None
-                    continue
+            for attempt in range(self.vars.retry_limit):
+                with tracing.trace("session_retry") as sp:
+                    sp.set("attempt", attempt)
+                    try:
+                        for sql in stmts:
+                            self._execute_one(self.parser.parse_one(sql),
+                                              sql, record_history=False)
+                        if self._txn is not None:
+                            self._txn.commit()
+                            self._txn = None
+                        return
+                    except errors.RetryableError as e:
+                        metrics.counter("session.retries").inc()
+                        tracing.count("session_retries")
+                        sp.set("conflict", str(e)[:120])
+                        last_err = e
+                        if self._txn is not None:
+                            self._txn.rollback()
+                            self._txn = None
+                        continue
         finally:
             self._in_retry = False
+        metrics.counter("session.retry_exhausted").inc()
         raise last_err
 
     # ------------------------------------------------------------------
@@ -286,6 +298,14 @@ class Session:
             root.set("sql", sql_text[:256])
             root.set("conn", self.vars.connection_id)
             trace_tok = tracing.attach(root)
+        # the statement's unified Backoffer: ONE budget + deadline
+        # (tidb_tpu_max_execution_time) shared by every retry ladder the
+        # statement reaches, on this thread and the fan-out workers.
+        # Nested internal statements run under the enclosing statement's
+        # instance — their retries draw from the same budget.
+        bo_attached = self._exec_depth == 0
+        bo_tok = kvbackoff.attach(self._statement_backoffer()) \
+            if bo_attached else None
         self._exec_depth += 1
         try:
             try:
@@ -297,6 +317,8 @@ class Session:
                 raise
         finally:
             self._exec_depth -= 1
+            if bo_attached:
+                kvbackoff.detach(bo_tok)
             if root is not None:
                 tracing.detach(trace_tok)
                 root.finish()
@@ -310,6 +332,24 @@ class Session:
                              ch1 - ch0, cf1 - cf0, cp1 - cp0,
                              tracing.counters_delta(tally0), root)
         return rs
+
+    def _statement_backoffer(self) -> kvbackoff.Backoffer:
+        """One Backoffer per top-level statement: the shared retry-sleep
+        budget plus the absolute deadline tidb_tpu_max_execution_time
+        prescribes (0/unset = no deadline; session value overrides the
+        global default per connection)."""
+        import time as _time
+        raw = self.vars.get_system("tidb_tpu_max_execution_time",
+                                   self.global_vars)
+        ms = 0
+        if raw:
+            try:
+                ms = max(0, int(float(raw.strip())))
+            except (ValueError, OverflowError):
+                ms = 0      # unparseable/inf value must never wedge SET
+        deadline = (_time.monotonic() + ms / 1000.0) if ms else None
+        return kvbackoff.Backoffer(
+            budget_ms=kvbackoff.DEFAULT_STMT_BUDGET_MS, deadline=deadline)
 
     def _tracing_enabled(self) -> bool:
         """Cheap per-statement check for SET tidb_trace_enabled = 1 —
@@ -368,11 +408,17 @@ class Session:
                           kt.get("jit_misses", 0)))
             # plane-cache tallies (per-partial attribution from the
             # region responses) appear whenever the statement touched
-            # the cache — same monotonic-diff contract as columnar_hits
+            # the cache — same monotonic-diff contract as columnar_hits.
+            # Backoff/degradation/retry tallies follow: a slow statement
+            # shows WHERE its time went (retry sleeps) and which tiers
+            # it fell back through.
             for key in ("plane_cache_hits", "plane_cache_misses",
                         "plane_cache_evictions",
                         "plane_cache_invalidations_epoch",
-                        "plane_cache_invalidations_version"):
+                        "plane_cache_invalidations_version",
+                        "backoff_retries", "backoff_ms", "session_retries",
+                        "degraded_device", "degraded_join",
+                        "degraded_combine"):
                 if kt.get(key):
                     detail += f" {key}:{kt[key]}"
             if root_span is not None:
@@ -623,8 +669,19 @@ class Session:
             self.killed = False
             raise errors.ExecError("Query execution was interrupted",
                                    code=1317)
-        # autocommit is handled inside _run_plan (run_prepared ends there)
-        return self.run_prepared(ent, values, ent.text)
+        # autocommit is handled inside _run_plan (run_prepared ends there).
+        # The binary path bypasses _execute_one, so the statement
+        # Backoffer (budget + tidb_tpu_max_execution_time deadline)
+        # attaches here — and the depth bump makes nested internal
+        # statements (persist_global_var etc.) share THIS instance
+        # instead of shadowing it with a fresh deadline.
+        bo_tok = kvbackoff.attach(self._statement_backoffer())
+        self._exec_depth += 1
+        try:
+            return self.run_prepared(ent, values, ent.text)
+        finally:
+            self._exec_depth -= 1
+            kvbackoff.detach(bo_tok)
 
     def close_binary(self, stmt_id: int) -> None:
         self.binary_stmts.pop(stmt_id, None)

@@ -69,6 +69,13 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_tpu_plane_cache_bytes": "268435456",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
+    # statement deadline in ms (0 = unlimited): every retry ladder of a
+    # statement — region RPC, coprocessor worklist (including fan-out
+    # worker threads), lock resolution, 2PC, txn replay — shares ONE
+    # Backoffer (kv.backoff) carrying this deadline; exhaustion raises
+    # DeadlineExceededError with the ladder history attached. Session
+    # scope overrides per connection; SET GLOBAL changes the default.
+    "tidb_tpu_max_execution_time": "0",
     # hierarchical statement tracing (tidb_tpu.tracing): 1 builds a span
     # tree for EVERY statement (slow-log detail gets the span summary);
     # 0 (default) builds spans only under EXPLAIN ANALYZE / TRACE

@@ -226,7 +226,9 @@ COUNTER_KEYS = ("kernel_dispatches", "readbacks", "readback_bytes",
                 "jit_hits", "jit_misses",
                 "plane_cache_hits", "plane_cache_misses",
                 "plane_cache_evictions", "plane_cache_invalidations_epoch",
-                "plane_cache_invalidations_version")
+                "plane_cache_invalidations_version",
+                "backoff_retries", "backoff_ms", "session_retries",
+                "degraded_device", "degraded_join", "degraded_combine")
 
 
 def _tally() -> dict:
@@ -269,6 +271,28 @@ def record_dispatch(dispatches: int = 1, readbacks: int = 1,
         count("readback_bytes", readback_bytes)
         metrics.counter("ops.readbacks").inc(readbacks)
         metrics.counter("ops.readback_bytes").inc(readback_bytes)
+
+
+# degradation-chain attribution: fallback kind → the statement-tally key
+# the slow log / perfschema render (the copr.degraded_* process counters
+# are the /metrics-facing names)
+_DEGRADED_TALLY = {"device_to_cpu": "degraded_device",
+                   "join_to_numpy": "degraded_join",
+                   "combine_to_host": "degraded_combine"}
+
+
+def record_degraded(kind: str, tally: bool = True) -> None:
+    """THE degradation tally: one call per tier fallback (device→CPU
+    request rerouting, device join→numpy, device combine→host, region
+    columnar→rows), feeding the copr.degraded_* process counters so
+    every fallback is accounted on /metrics and — for statement-thread
+    sites — the per-statement thread tallies. Fan-out WORKER threads
+    pass tally=False: their per-thread counter would attribute to the
+    wrong statement (the process counter stays exact either way)."""
+    from tidb_tpu import metrics
+    if tally:
+        count(_DEGRADED_TALLY.get(kind, f"degraded_{kind}"))
+    metrics.counter(f"copr.degraded_{kind}").inc()
 
 
 def record_jit_cache(hit: bool) -> None:
