@@ -687,6 +687,55 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
         "region_fanout_repeat_speedup_vs_cold": round(t_cold / t_warm, 2),
         "plane_cache_hits": d_pc_hits,
         **trace_summary(sess, REGION_FANOUT_SQL),
+        **workload_summary(store, sess, n_regions),
+    }
+
+
+def workload_summary(store, sess, n_regions: int) -> dict:
+    """Workload-observability figures off the fan-out store: the digest
+    summary's view of the run just measured (every timed statement above
+    rolled into its digest's entry), the region heat the fan-out left
+    behind, and the digest pipeline's per-statement cost.
+    tests/test_bench_smoke.py asserts the digest_*/hot_region_* keys, so
+    tier-1 guards the aggregation layer the same way it guards tracing."""
+    from tidb_tpu import digest as _digest, perfschema
+    dig, _norm = _digest.sql_digest(REGION_FANOUT_SQL)
+    ds = perfschema.perf_for(store).digest_summary
+    entries = ds.windows()[-1][2]
+    e = entries.get(dig)
+    assert e is not None, "fan-out query missing from the digest summary"
+    assert e.plan_digest, "fan-out digest entry recorded no plan digest"
+    heat = store.rpc.region_heat.snapshot()
+    assert len(heat) >= n_regions, \
+        f"only {len(heat)} regions carry heat across {n_regions}"
+
+    # digest-pipeline overhead: trivial statements with the summary on
+    # vs off — the same <2ms contract the tier-1 guard enforces
+    n = 40
+    sess.execute("select 1")   # warm
+    t0 = time.time()
+    for _ in range(n):
+        sess.execute("select 1")
+    t_on = time.time() - t0
+    sess.execute("set global tidb_tpu_stmt_summary = 0")
+    try:
+        sess.execute("select 1")
+        t0 = time.time()
+        for _ in range(n):
+            sess.execute("select 1")
+        t_off = time.time() - t0
+    finally:
+        sess.execute("set global tidb_tpu_stmt_summary = 1")
+    return {
+        "digest_entries": len(entries),
+        "digest_fanout_exec_count": e.exec_count,
+        "digest_fanout_device_ms": round(e.device_time_us() / 1e3, 3),
+        "digest_fanout_p95_ms": round(e.p95_latency_ms(), 3),
+        "digest_overhead_us_per_stmt": round(
+            max(0.0, (t_on - t_off) / n) * 1e6, 1),
+        "hot_region_count": len(heat),
+        "hot_region_top_read_rows": int(heat[0]["total_read_rows"]),
+        "hot_region_top_score": round(heat[0]["heat"], 3),
     }
 
 
@@ -964,6 +1013,14 @@ def main(smoke: bool = False):
           f"warm ({fan_figs['region_fanout_repeat_speedup_vs_cold']:.2f}x "
           f"the cold re-pack regime), {fan_figs['plane_cache_hits']} "
           f"plane-cache hits", file=sys.stderr)
+    print(f"# workload: {fan_figs['digest_entries']} digests "
+          f"(fan-out query x{fan_figs['digest_fanout_exec_count']}, "
+          f"{fan_figs['digest_fanout_device_ms']:.1f} ms device, "
+          f"p95 {fan_figs['digest_fanout_p95_ms']:.1f} ms), digest "
+          f"pipeline {fan_figs['digest_overhead_us_per_stmt']:.0f} us/stmt, "
+          f"{fan_figs['hot_region_count']} hot regions (top "
+          f"{fan_figs['hot_region_top_read_rows']} rows read, score "
+          f"{fan_figs['hot_region_top_score']:.0f})", file=sys.stderr)
 
     geo_rps = math.exp(sum(math.log(x) for x in tpu_rps_all)
                        / len(tpu_rps_all))

@@ -68,3 +68,15 @@ def test_bench_smoke_emits_valid_json():
     assert out["trace_kernel_ms_total"] >= 0
     assert out["trace_readbacks"] >= 1
     assert out["trace_readback_bytes"] > 0
+    # workload-observability figures: the digest summary saw the fan-out
+    # workload (plan digest asserted inside the bench), region heat
+    # covers every region, and the digest pipeline stays under the same
+    # 2ms/statement bound the tier-1 overhead guard enforces
+    assert out["digest_entries"] >= 1
+    assert out["digest_fanout_exec_count"] >= 2
+    assert out["digest_fanout_device_ms"] >= 0
+    assert out["digest_fanout_p95_ms"] > 0
+    assert out["digest_overhead_us_per_stmt"] < 2000
+    assert out["hot_region_count"] >= 4
+    assert out["hot_region_top_read_rows"] > 0
+    assert out["hot_region_top_score"] > 0

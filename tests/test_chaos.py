@@ -384,3 +384,116 @@ def test_chaos_with_failpoints_active():
     assert dup == []
     root.execute("admin check table acct")
     root.execute("admin check table audit_log")
+
+
+def test_digest_summary_reconciles_under_flush_chaos():
+    """The workload-aggregation layer under concurrency + injected flush
+    faults: three sessions run a known per-thread statement schedule on
+    a 4-region store while a chaos thread ages the summary window to
+    force rotations and a `summary/flush` failpoint probabilistically
+    fails them. Contract: an injected flush fault DEFERS the rotation
+    (the window extends) and never fails a statement or drops a count —
+    per-digest exec counts summed across ALL windows (history + current)
+    must equal the deterministic schedule exactly AND reconcile with the
+    flat perfschema.digest_statements process counter, with no
+    cross-session bleed."""
+    from tidb_tpu import digest, metrics, perfschema, tablecodec as tc
+
+    store = new_store(f"cluster://3/chaosdg{next(_store_id)}")
+    root = Session(store)
+    root.execute("create database d")
+    root.execute("use d")
+    root.execute("create table t (id bigint primary key, k bigint, "
+                 "v bigint)")
+    root.execute("insert into t values " +
+                 ", ".join(f"({i}, {i % 7}, {i * 10})"
+                           for i in range(1, 121)))
+    tid = root.info_schema().table_by_name("d", "t").info.id
+    store.cluster.split_keys([tc.encode_row_key(tid, 30 * i + 1)
+                              for i in range(1, 4)])
+    sessions = [_session(store) for _ in range(3)]
+    ds = perfschema.perf_for(store).digest_summary
+    # fresh window, nothing recorded for the reset itself
+    ds.set_enabled(False)
+    ds.set_enabled(True)
+    c0 = metrics.counter("perfschema.digest_statements").value
+    flush0 = metrics.counter("perfschema.digest_windows_flushed").value
+    defer0 = metrics.counter("perfschema.digest_flush_errors").value
+
+    # per-thread schedule: a SHARED shape (point read, literal variants)
+    # plus one thread-UNIQUE shape — bleed in either direction breaks an
+    # exact count below
+    shared_counts = (11, 7, 5)
+    unique_shapes = ("select v from t where k = %d",
+                     "select k, v from t where id = %d",
+                     "select sum(v) from t where id > %d")
+    unique_counts = (4, 6, 8)
+    stop = threading.Event()
+    errs: list = []
+    barrier = threading.Barrier(4)
+
+    def worker(i):
+        s = sessions[i]
+        try:
+            barrier.wait(timeout=30)
+            for n in range(shared_counts[i]):
+                s.execute(f"select v from t where id = {i * 40 + n + 1}")
+            for n in range(unique_counts[i]):
+                s.execute(unique_shapes[i] % n)
+        except Exception as e:
+            errs.append(e)
+
+    def rotator():
+        # age the current window past the refresh interval repeatedly so
+        # rotations happen DURING the workload, racing the failpoint
+        barrier.wait(timeout=30)
+        for _ in range(12):
+            if stop.is_set():
+                return
+            with ds.lock:
+                ds.window_begin -= ds.refresh_interval_s + 1
+            time.sleep(0.01)
+
+    failpoint.enable("summary/flush", when=("prob", 0.5), seed=42)
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(3)] + [threading.Thread(target=rotator)]
+    try:
+        for t in threads:
+            t.start()
+        wedged = []
+        for t in threads:
+            t.join(timeout=120)
+            if t.is_alive():
+                wedged.append(t.name)
+    finally:
+        stop.set()
+        fp_evals = failpoint.counters("summary/flush")
+        failpoint.disable("summary/flush")
+    assert not wedged, wedged
+    assert not errs, errs[:3]
+    assert fp_evals["evals"] > 0, "summary/flush seam never reached"
+
+    # reconcile across EVERY window: nothing lost to rotation or to an
+    # injected flush failure, nothing double-counted
+    per_digest: dict = {}
+    for _b, _e, entries, ed, ee in ds.windows():
+        assert ed == 0 and ee == 0   # nothing evicted in this schedule
+        for dig, e in entries.items():
+            per_digest[dig] = per_digest.get(dig, 0) + e.exec_count
+    shared_dig = digest.sql_digest("select v from t where id = 1")[0]
+    assert per_digest.get(shared_dig) == sum(shared_counts)
+    for i, shape in enumerate(unique_shapes):
+        dig = digest.sql_digest(shape % 0)[0]
+        assert per_digest.get(dig) == unique_counts[i], \
+            f"thread-{i} unique shape bled: {per_digest.get(dig)}"
+    recorded = metrics.counter("perfschema.digest_statements").value - c0
+    assert sum(per_digest.values()) == recorded == \
+        sum(shared_counts) + sum(unique_counts)
+    # the chaos actually exercised both sides of the flush seam:
+    # rotations happened AND at least one injected fault deferred one
+    flushed = metrics.counter(
+        "perfschema.digest_windows_flushed").value - flush0
+    deferred = metrics.counter(
+        "perfschema.digest_flush_errors").value - defer0
+    assert flushed > 0, "no window ever rotated under the chaos schedule"
+    assert deferred > 0, "the summary/flush failpoint never deferred"

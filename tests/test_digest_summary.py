@@ -1,0 +1,503 @@
+"""Workload observability: statement/plan digests, the perfschema
+digest summary (windowed current+history, capped with eviction
+accounting), TOP-SQL, region heat, SHOW PROCESSLIST digest reporting,
+and the reconciliation contract — a concurrent multi-session workload's
+per-digest exec counts and resource tallies must sum EXACTLY to the
+flat global counters, with no cross-session bleed.
+
+Also the digest-pipeline overhead guard: computing digests + updating
+the summary must cost < 2 ms per statement vs the summary disabled.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import pytest
+
+from tidb_tpu import digest, metrics, perfschema, tablecodec as tc
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+JOIN_AGG_Q = ("select count(*), sum(t.v), min(t.v), max(d.d_f) "
+              "from t join d on t.k = d.d_k")
+
+
+def _build(n_regions: int = 4):
+    store = new_store(f"cluster://3/digest{next(_id)}")
+    s = Session(store)
+    s.execute("create database dg")
+    s.execute("use dg")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double)")
+    rows = ", ".join(f"({i}, {i % 7}, {i * 10}, {i}.25)"
+                     for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create table d (d_k bigint primary key, d_f double)")
+    s.execute("insert into d values " +
+              ", ".join(f"({i}, {i}.5)" for i in range(7)))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("dg", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _summary(store) -> "perfschema.DigestSummary":
+    return perfschema.perf_for(store).digest_summary
+
+
+def _reset_summary(store) -> None:
+    """Fresh summary window with NO statements recorded for the reset
+    itself (the SQL kill switch would work too, but the SET statements
+    would race the measured phase's first snapshot)."""
+    ds = _summary(store)
+    ds.set_enabled(False)
+    ds.set_enabled(True)
+
+
+def _entries(store) -> dict:
+    return _summary(store).windows()[-1][2]
+
+
+# ---------------------------------------------------------------------------
+# normalization: the digest identity itself
+# ---------------------------------------------------------------------------
+
+class TestNormalization:
+    def test_literal_variants_share_one_digest(self):
+        variants = [
+            "select v from t where id = 5",
+            "select v from t where id = 999",
+            "SELECT V FROM T WHERE ID = 123",
+            "select  v\nfrom t   where id=7",
+            "select v from t where id = 5 -- trailing comment",
+            "select v from t where id = ?",   # prepared text, same shape
+        ]
+        digs = {digest.sql_digest(v)[0] for v in variants}
+        assert len(digs) == 1, digs
+
+    def test_in_lists_collapse_across_arity(self):
+        digs = {digest.sql_digest(q)[0] for q in (
+            "select v from t where id in (1)",       # arity 1 too
+            "select v from t where id in (-1)",      # signed singleton
+            "select v from t where id in (?)",       # prepared singleton
+            "select v from t where id in (1, 2)",
+            "select v from t where id in (1, 2, 3, 4, 5)",
+            "select v from t where id in (9, -8, 7.5, 'x')",
+        )}
+        assert len(digs) == 1, digs
+        # a bare parenthesized literal NOT after IN keeps its shape
+        assert "(...)" not in digest.normalize("select (1)")
+
+    def test_unary_sign_folds_into_the_literal(self):
+        # text `-1` and a prepared param bound to -1 share a digest
+        assert digest.sql_digest("select v from t where a = -1")[0] \
+            == digest.sql_digest("select v from t where a = ?")[0]
+        assert digest.sql_digest("select v from t where a = -1.5 "
+                                 "and b < +3")[0] \
+            == digest.sql_digest("select v from t where a = ? "
+                                 "and b < ?")[0]
+        # BINARY minus (operand on its left) keeps its shape
+        assert digest.normalize("select a - 1 from t") \
+            == "select a - ? from t"
+        assert digest.normalize("select (a) - 1 from t") \
+            == "select (a) - ? from t"
+        assert digest.normalize("select 1 - 2") == "select ? - ?"
+
+    def test_distinct_shapes_get_distinct_digests(self):
+        shapes = [
+            "select v from t where id = 5",
+            "select v from t where k = 5",
+            "select v, k from t where id = 5",
+            "select v from t where id > 5",
+            "select sum(v) from t where id = 5",
+            "select v from d where id = 5",
+            "insert into t values (1, 2, 3, 4.0)",
+        ]
+        digs = [digest.sql_digest(s)[0] for s in shapes]
+        assert len(set(digs)) == len(shapes)
+
+    def test_mixed_tuple_keeps_shape(self):
+        # "(?, col)" is not a pure literal list: it must NOT collapse
+        a = digest.normalize("select * from t where (1, k) = (2, 3)")
+        assert "(? , k)" in a.replace(", ", " , ") or "(?, k)" in a, a
+
+    def test_unlexable_text_still_digests(self):
+        d, norm = digest.sql_digest("select ' unterminated")
+        assert d and norm   # stable fallback fold, never an exception
+
+    def test_plan_digest_tracks_shape_not_constants(self):
+        s = _build(1)
+        from tidb_tpu.plan.builder import PlanBuilder
+        from tidb_tpu.plan.optimizer import optimize_plan
+
+        def plan_of(sql: str):
+            stmt = s.parser.parse_one(sql)
+            return optimize_plan(PlanBuilder(s).build(stmt), s, s.client,
+                                 s.dirty_tables)
+
+        p1, _ = digest.plan_digest(plan_of("select v from t where id > 5"))
+        p2, _ = digest.plan_digest(plan_of("select v from t where id > 99"))
+        p3, _ = digest.plan_digest(plan_of("select d_f from d"))
+        assert p1 == p2          # constants do not change the plan shape
+        assert p1 != p3          # different table/tree does
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: concurrent reconciliation
+# ---------------------------------------------------------------------------
+
+class TestConcurrentReconciliation:
+    def test_multi_session_counts_reconcile_with_global_counters(self):
+        """Three sessions, 4-region store, mixed point/range/join/agg
+        workload: per-digest exec counts must equal each thread's known
+        statement count summed (no bleed), and the per-digest resource
+        tallies must sum EXACTLY to the flat global counter deltas."""
+        s_main = _build(4)
+        store = s_main.store
+        sessions = [s_main, Session(store), Session(store)]
+        for s in sessions[1:]:
+            s.execute("use dg")
+        # warm every path OUTSIDE the measured window (jit compile,
+        # plane cache, plan caches)
+        for s in sessions:
+            s.execute(JOIN_AGG_Q)
+            s.execute("select v from t where id = 3")
+        _reset_summary(store)
+
+        point = "select v from t where id = %d"
+        rng = "select sum(v) from t where id between %d and %d"
+        agg = "select k, count(*), max(v) from t group by k"
+        # per-session schedule: (sql template kind, count)
+        plans = [
+            [("point", 9), ("join", 3), ("agg", 2)],
+            [("point", 5), ("range", 6), ("join", 2)],
+            [("range", 4), ("agg", 3), ("join", 1)],
+        ]
+        g0 = {name: metrics.counter(name).value
+              for name in ("distsql.columnar_hits",
+                           "distsql.columnar_partials",
+                           "ops.kernel_dispatches", "ops.readbacks",
+                           "ops.readback_bytes")}
+        barrier = threading.Barrier(len(sessions))
+        errs: list = []
+
+        def run(sess, plan, seed):
+            try:
+                barrier.wait(timeout=30)
+                for kind, n in plan:
+                    for i in range(n):
+                        if kind == "point":
+                            sess.execute(point % (seed * 31 + i))
+                        elif kind == "range":
+                            sess.execute(rng % (seed, seed + 40 + i))
+                        elif kind == "join":
+                            sess.execute(JOIN_AGG_Q)
+                        else:
+                            sess.execute(agg)
+            except Exception as e:  # surfaced after join
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(s, p, i + 1))
+                   for i, (s, p) in enumerate(zip(sessions, plans))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+
+        entries = _entries(store)
+        by_norm = {e.norm_sql: e for e in entries.values()}
+        want = {
+            digest.normalize(point % 0): 9 + 5,
+            digest.normalize(rng % (0, 0)): 6 + 4,
+            digest.normalize(JOIN_AGG_Q): 3 + 2 + 1,
+            digest.normalize(agg): 2 + 3,
+        }
+        assert len(entries) == len(want), sorted(by_norm)
+        for norm, count in want.items():
+            assert by_norm[norm].exec_count == count, \
+                f"{norm}: {by_norm[norm].exec_count} != {count}"
+            assert by_norm[norm].errors == 0
+
+        # resource reconciliation: per-digest sums == global deltas
+        def digest_sum(key: str) -> int:
+            return sum(e.res.get(key, 0) for e in entries.values())
+
+        for key, name in (("columnar_hits", "distsql.columnar_hits"),
+                          ("columnar_partials",
+                           "distsql.columnar_partials"),
+                          ("kernel_dispatches", "ops.kernel_dispatches"),
+                          ("readbacks", "ops.readbacks"),
+                          ("readback_bytes", "ops.readback_bytes")):
+            got = digest_sum(key)
+            delta = metrics.counter(name).value - g0[name]
+            assert got == delta, \
+                f"{key}: digest sum {got} != global delta {delta}"
+        # the join workload actually exercised the columnar channel
+        assert digest_sum("columnar_partials") >= 4 * 6
+
+    def test_errored_statements_are_workload_too(self):
+        s = _build(1)
+        _reset_summary(s.store)
+        for _ in range(3):
+            with pytest.raises(Exception):
+                s.execute("select no_such_column from t where id = 1")
+        [e] = _entries(s.store).values()
+        assert e.exec_count == 3
+        assert e.errors == 3
+
+    def test_binary_protocol_shares_the_text_digest(self):
+        s = _build(1)
+        _reset_summary(s.store)
+        from tidb_tpu.types import Datum
+        sid, n_params = s.prepare_binary(
+            "select v from t where id = ?")
+        assert n_params == 1
+        s.execute_binary(sid, [Datum.i64(7)])
+        s.execute_binary(sid, [Datum.i64(8)])
+        s.execute("select v from t where id = 99")
+        [e] = _entries(s.store).values()
+        assert e.exec_count == 3, \
+            "binary and text executions of one shape did not share a digest"
+
+
+# ---------------------------------------------------------------------------
+# summary windows, caps, eviction accounting
+# ---------------------------------------------------------------------------
+
+class TestSummaryWindows:
+    def test_p95_and_latency_bounds(self):
+        s = _build(1)
+        _reset_summary(s.store)
+        for i in range(20):
+            s.execute(f"select v from t where id = {i + 1}")
+        [e] = _entries(s.store).values()
+        assert e.exec_count == 20
+        assert 0 < e.min_latency_ms <= e.max_latency_ms
+        assert e.min_latency_ms <= e.p95_latency_ms()
+        assert abs(e.sum_latency_ms / 20
+                   - e.sum_latency_ms / e.exec_count) < 1e-9
+        assert e.first_seen <= e.last_seen
+
+    def test_cap_evicts_lru_with_exact_accounting(self):
+        s = _build(1)
+        s.execute("set global tidb_tpu_stmt_summary_max_digests = 2")
+        try:
+            _reset_summary(s.store)
+            shapes = ["select v from t where id = 1",
+                      "select k from t where id = 1",
+                      "select f from t where id = 1",
+                      "select v, k from t where id = 1"]
+            for i, q in enumerate(shapes):
+                for _ in range(i + 1):    # 1, 2, 3, 4 executions
+                    s.execute(q)
+            ds = _summary(s.store)
+            with ds.lock:
+                n_entries = len(ds.entries)
+                kept_exec = sum(e.exec_count for e in ds.entries.values())
+                ev_digests, ev_exec = (ds.evicted_digests,
+                                       ds.evicted_exec_count)
+            assert n_entries == 2
+            assert ev_digests == 2
+            # recorded = Σ kept + evicted: nothing lost to the cap
+            assert kept_exec + ev_exec == 1 + 2 + 3 + 4
+            rows = s.execute(
+                "select EVICTED_DIGESTS, EVICTED_EXEC_COUNT from "
+                "performance_schema.events_statements_summary_evicted"
+            )[0].values()
+            assert [int(rows[-1][0]), int(rows[-1][1])] == [2, ev_exec]
+        finally:
+            s.execute("set global tidb_tpu_stmt_summary_max_digests = 512")
+
+    def test_window_rotation_into_bounded_history(self):
+        s = _build(1)
+        ds = _summary(s.store)
+        s.execute("set global tidb_tpu_stmt_summary_history_size = 2")
+        try:
+            _reset_summary(s.store)
+            for w in range(4):
+                s.execute(f"select v from t where id = {w + 1}")
+                with ds.lock:       # age the window past the interval
+                    ds.window_begin -= ds.refresh_interval_s + 1
+            # lazy rotation applies on read: 4 aged windows rolled, ring
+            # keeps the newest 2, the current window is empty
+            wins = ds.windows()
+            assert len(wins) == 3            # 2 history + current
+            assert all(w[1] is not None for w in wins[:-1])
+            assert wins[-1][1] is None and not wins[-1][2]
+            rows = s.execute(
+                "select DIGEST, EXEC_COUNT from performance_schema."
+                "events_statements_summary_by_digest_history")[0].values()
+            assert len(rows) == 2
+        finally:
+            s.execute("set global tidb_tpu_stmt_summary_history_size = 24")
+
+    def test_kill_switch_clears_and_skips_pipeline(self):
+        s = _build(1)
+        s.execute("select v from t where id = 1")
+        assert _entries(s.store)
+        s.execute("set global tidb_tpu_stmt_summary = 0")
+        try:
+            assert not _entries(s.store)
+            s.execute("select v from t where id = 2")
+            assert not _entries(s.store), \
+                "disabled summary still recorded statements"
+        finally:
+            s.execute("set global tidb_tpu_stmt_summary = 1")
+        s.execute("select v from t where id = 3")
+        assert len(_entries(s.store)) == 1
+
+    def test_history_ring_cap_sysvar(self):
+        s = _build(1)
+        ps = perfschema.perf_for(s.store)
+        s.execute("set global tidb_tpu_perfschema_history_cap = 5")
+        try:
+            for i in range(12):
+                s.execute(f"select v from t where id = {i + 1}")
+            rows = ps.rows(perfschema.T_STMT_HISTORY)
+            assert len(rows) == 5
+        finally:
+            s.execute("set global tidb_tpu_perfschema_history_cap = 1024")
+
+    def test_sysvars_are_global_only_and_validated(self):
+        s = _build(1)
+        from tidb_tpu import errors
+        with pytest.raises(errors.ExecError):
+            s.execute("set tidb_tpu_stmt_summary = 0")   # session scope
+        with pytest.raises(errors.ExecError):
+            s.execute("set global tidb_tpu_stmt_summary_max_digests = 'x'")
+        with pytest.raises(errors.ExecError):
+            s.execute("set global tidb_tpu_stmt_summary_max_digests = 0")
+
+
+# ---------------------------------------------------------------------------
+# TOP-SQL + hot regions + processlist
+# ---------------------------------------------------------------------------
+
+class TestTopSqlAndHeat:
+    def test_top_sql_ranks_by_device_time(self):
+        s = _build(4)
+        _reset_summary(s.store)
+        for _ in range(3):
+            s.execute(JOIN_AGG_Q)          # device combine → dispatch_us
+        for i in range(10):
+            s.execute(f"select v from t where id = {i + 1}")   # no device
+        rows = s.execute(
+            "select RANK, DIGEST, EXEC_COUNT, DEVICE_TIME_MS from "
+            "information_schema.TIDB_TPU_TOP_SQL")[0].values()
+        assert rows, "TOP_SQL empty after a device workload"
+        top = rows[0]
+        join_dig = digest.sql_digest(JOIN_AGG_Q)[0]
+        assert top[1].decode() == join_dig
+        assert int(top[0]) == 1 and int(top[2]) == 3
+        assert float(top[3]) > 0, "device time not attributed per digest"
+        # ranking is by device time descending
+        times = [float(r[3]) for r in rows]
+        assert times == sorted(times, reverse=True)
+
+    def test_hot_regions_rank_follows_access_skew(self):
+        s = _build(4)
+        tid = s.info_schema().table_by_name("dg", "t").info.id
+        heat = s.store.rpc.region_heat
+        heat.clear()
+        # skew: hammer handles that live in the LAST region (181..240)
+        for _ in range(6):
+            for hid in (190, 200, 210, 220, 230, 240):
+                s.execute(f"select v from t where id = {hid}")
+        hot_region = s.store.cluster.region_by_key(
+            tc.encode_row_key(tid, 200))
+        rows = s.execute(
+            "select RANK, REGION_ID, READ_ROWS, TOTAL_READ_ROWS, HEAT "
+            "from information_schema.TIDB_TPU_HOT_REGIONS")[0].values()
+        assert rows, "no heat recorded"
+        assert int(rows[0][1]) == hot_region.region_id, \
+            f"skewed region did not rank first: {rows}"
+        assert int(rows[0][3]) >= 36
+        heats = [float(r[4]) for r in rows]
+        assert heats == sorted(heats, reverse=True)
+
+    def test_heat_decays_but_totals_are_monotonic(self):
+        from tidb_tpu.cluster.heat import RegionHeat
+        h = RegionHeat(half_life_s=0.05)
+        h.record_read(1, 1000, 8000)
+        first = h.snapshot()[0]
+        assert first["read_rows"] == pytest.approx(1000, rel=0.2)
+        time.sleep(0.2)
+        decayed = h.snapshot()[0]
+        assert decayed["read_rows"] < first["read_rows"] / 4
+        assert decayed["total_read_rows"] == 1000   # flat total: exact
+
+    def test_write_heat_lands_at_prewrite(self):
+        s = _build(4)
+        heat = s.store.rpc.region_heat
+        heat.clear()
+        s.execute("insert into t values (1000, 1, 1, 1.0)")
+        snap = heat.snapshot()
+        assert sum(int(h["total_write_rows"]) for h in snap) >= 1
+        rows = s.execute(
+            "select WRITE_ROWS from information_schema.TIDB_TPU_HOT_REGIONS"
+            " where WRITE_ROWS > 0")[0].values()
+        assert rows
+
+    def test_show_processlist_reports_time_state_digest(self):
+        s = _build(1)
+        other = Session(s.store)
+        other.execute("use dg")
+        other.execute("select v from t where id = 42")
+        rows = s.execute("show full processlist")[0].values()
+
+        def dec(v):
+            return v.decode() if isinstance(v, bytes) else v
+
+        by_id = {int(r[0]): r for r in rows}
+        own = by_id[s.vars.connection_id]
+        assert dec(own[4]) == "Query" and dec(own[6]) == "executing"
+        assert dec(own[7]) == "show full processlist"
+        assert dec(own[8]) == digest.sql_digest("show full processlist")[0]
+        peer = by_id[other.vars.connection_id]
+        assert dec(peer[4]) == "Sleep" and dec(peer[6]) == ""
+        assert int(peer[5]) >= 0
+        assert dec(peer[8]) == \
+            digest.sql_digest("select v from t where id = 42")[0]
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: the digest pipeline must stay under 2 ms/statement
+# ---------------------------------------------------------------------------
+
+class TestOverheadGuard:
+    def test_digest_pipeline_under_2ms_per_statement(self):
+        s = _build(1)
+        sql = "select count(*) from t"
+        n = 60
+
+        def timed() -> float:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    s.execute(sql)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        s.execute(sql)                     # warm
+        with_pipeline = timed()
+        _summary(s.store).set_enabled(False)
+        try:
+            s.execute(sql)
+            baseline = timed()
+        finally:
+            _summary(s.store).set_enabled(True)
+        per_stmt = (with_pipeline - baseline) / n
+        assert per_stmt < 0.002, \
+            f"digest pipeline costs {per_stmt * 1e6:.0f}us per " \
+            f"statement, over the 2ms bound"

@@ -1,0 +1,178 @@
+"""metrics/push.py: push-gateway round-trips carrying the
+workload-observability metric families (perfschema digest summary +
+copr.region_heat), and exposition conformance of those families in
+render_text — name charset, TYPE declarations, registry agreement.
+"""
+
+from __future__ import annotations
+
+import http.server
+import itertools
+import re
+import threading
+import time
+
+from tidb_tpu import metrics, tablecodec as tc
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+
+def _workload_store():
+    """A 2-region store that has run enough workload that every digest
+    and heat family exists in the process registry, with the lazy
+    gauges refreshed (reading the SQL surfaces is what refreshes them,
+    same contract as the plane-cache gauges)."""
+    store = new_store(f"cluster://3/mpush{next(_id)}")
+    s = Session(store)
+    s.execute("create database m")
+    s.execute("use m")
+    s.execute("create table t (id bigint primary key, v bigint)")
+    s.execute("insert into t values " +
+              ", ".join(f"({i}, {i})" for i in range(1, 41)))
+    tid = s.info_schema().table_by_name("m", "t").info.id
+    store.cluster.split_keys([tc.encode_row_key(tid, 21)])
+    for i in (1, 5, 25, 30):
+        s.execute(f"select v from t where id = {i}")
+    s.execute("select * from information_schema.TIDB_TPU_HOT_REGIONS")
+    s.execute("select * from performance_schema."
+              "events_statements_summary_by_digest")
+    return store, s
+
+
+# the new families, by their exposition (dot→underscore) names
+DIGEST_HEAT_FAMILIES = {
+    "perfschema_digest_statements": "counter",
+    "perfschema_digest_entries": "gauge",
+    "copr_region_heat_read_rows": "counter",
+    "copr_region_heat_read_bytes": "counter",
+    "copr_region_heat_write_rows": "counter",
+    "copr_region_heat_write_bytes": "counter",
+    "copr_region_heat_regions": "gauge",
+    "copr_region_heat_top_region": "gauge",
+    "copr_region_heat_top_score": "gauge",
+}
+
+
+class TestPushRoundTrip:
+    def test_push_once_carries_digest_and_heat_families(self):
+        """One real HTTP PUT against an in-process Pushgateway-shaped
+        server: the body must be the registry's exposition including
+        every digest/heat family the workload populated."""
+        _workload_store()
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append((self.path,
+                                 self.headers.get("Content-Type", ""),
+                                 self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            from tidb_tpu.metrics import push as mpush
+            ok = mpush.push_once(f"127.0.0.1:{srv.server_port}",
+                                 job="wk", instance="i1")
+            assert ok
+            assert received, "no push arrived"
+            path, ctype, body = received[0]
+            assert path == "/metrics/job/wk/instance/i1"
+            assert ctype.startswith("text/plain")
+            text = body.decode()
+            for fam in DIGEST_HEAT_FAMILIES:
+                assert f"\n{fam} " in "\n" + text, \
+                    f"family {fam} missing from the pushed exposition"
+        finally:
+            srv.shutdown()
+
+    def test_push_loop_keeps_families_fresh(self):
+        """The interval loop re-renders at each push: a counter bumped
+        between pushes shows its new value in a later body."""
+        _store, s = _workload_store()
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append(self.rfile.read(n))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            from tidb_tpu.metrics import push as mpush
+            t = mpush.start_push_client(
+                f"127.0.0.1:{srv.server_port}", 0.05, job="wk2")
+            assert t is not None
+            deadline = time.time() + 5
+            while not received and time.time() < deadline:
+                time.sleep(0.02)
+            n_before = len(received)
+            before = metrics.counter("perfschema.digest_statements").value
+            s.execute("select v from t where id = 2")
+            deadline = time.time() + 5
+            while len(received) <= n_before and time.time() < deadline:
+                time.sleep(0.02)
+            t.stop_event.set()
+            t.join(timeout=2)
+            assert len(received) > n_before, "push loop stopped pushing"
+            line = next(ln for ln in received[-1].decode().splitlines()
+                        if ln.startswith("perfschema_digest_statements "))
+            assert int(float(line.split()[-1])) >= before + 1
+        finally:
+            srv.shutdown()
+
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+
+
+class TestExpositionConformance:
+    def test_new_families_are_exposition_conformant(self):
+        """Parse render_text back: every line is a comment or a valid
+        sample, the digest/heat families carry correct TYPE
+        declarations, and their values agree with the live registry."""
+        store, _s = _workload_store()
+        body = metrics.render_text()
+        types: dict[str, str] = {}
+        samples: dict[str, float] = {}
+        for line in body.splitlines():
+            if line.startswith("# TYPE "):
+                _h, _t, name, kind = line.split(" ")
+                assert _NAME_RE.fullmatch(name), name
+                assert kind in ("counter", "gauge", "histogram"), line
+                types[name] = kind
+                continue
+            if not line or line.startswith("#"):
+                continue
+            assert _SAMPLE_RE.match(line), f"malformed sample: {line!r}"
+            name_part, value = line.rsplit(" ", 1)
+            if "{" not in name_part:
+                samples[name_part] = float(value)
+        for fam, kind in DIGEST_HEAT_FAMILIES.items():
+            assert types.get(fam) == kind, \
+                f"{fam}: TYPE {types.get(fam)} != {kind}"
+            assert fam in samples, f"{fam}: no sample line"
+        # registry agreement for the flat (exact) counters
+        assert samples["copr_region_heat_read_rows"] == \
+            metrics.counter("copr.region_heat.read_rows").value > 0
+        assert samples["perfschema_digest_statements"] == \
+            metrics.counter("perfschema.digest_statements").value > 0
+        # the decayed-window gauges refresh on snapshot: a fresh read
+        # must agree with what the store's heat reports now
+        snap = store.rpc.region_heat.snapshot()
+        assert metrics.gauge("copr.region_heat.regions").value == len(snap)
+        assert metrics.gauge("copr.region_heat.top_region").value == \
+            snap[0]["region_id"]

@@ -61,6 +61,9 @@ class TestPerfSchema:
         tk = TestKit()
         tk.exec("show tables from performance_schema").check(
             [["events_statements_current"], ["events_statements_history"],
+             ["events_statements_summary_by_digest"],
+             ["events_statements_summary_by_digest_history"],
+             ["events_statements_summary_evicted"],
              ["setup_instruments"]])
         tk.exec("select ENABLED from performance_schema.setup_instruments"
                 ).check([["YES"]])

@@ -1,0 +1,151 @@
+"""Region access-heat tracking: time-decayed per-region read/write
+row and byte counters, maintained on the cluster RpcHandler.
+
+Reference: TiKV's hotspot statistics (pd's hot-region scheduler reads
+per-region read/write flow reported with store heartbeats) and PD's
+`pd-ctl hot read/write` surface — the placement signal the ROADMAP's
+mesh-sharded region→shard item consumes, and the model Taurus' near-data
+design presumes ("know per-partition access heat before placing work
+near data", PAPERS.md).
+
+Design rules:
+
+* The hot path pays near nothing: one dict lookup + a few float ops per
+  RPC, under a plain lock (the RPCs already serialize on Python dict
+  ops; contention is the fan-out worker count at most). No timers, no
+  background threads — decay is applied lazily, at update and at
+  snapshot time.
+* Two views of every counter: the DECAYED window (exponential half-life
+  decay, default 60 s — "what is hot NOW", what the HOT_REGIONS table
+  ranks on) and the FLAT total (monotonic, exact — what reconciles
+  against the `copr.region_heat.*` process counters).
+* Region ids survive splits/merges the way PD's do: a new region id
+  starts cold; the old id's heat decays away instead of being
+  reassigned (heat is an access signal, not a topology mirror).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _HeatEntry:
+    __slots__ = ("read_rows", "read_bytes", "write_rows", "write_bytes",
+                 "total_read_rows", "total_read_bytes",
+                 "total_write_rows", "total_write_bytes",
+                 "last_ts", "last_access")
+
+    def __init__(self, now: float):
+        self.read_rows = 0.0
+        self.read_bytes = 0.0
+        self.write_rows = 0.0
+        self.write_bytes = 0.0
+        self.total_read_rows = 0
+        self.total_read_bytes = 0
+        self.total_write_rows = 0
+        self.total_write_bytes = 0
+        self.last_ts = now
+        self.last_access = now
+
+    def decay(self, now: float, half_life_s: float) -> None:
+        dt = now - self.last_ts
+        if dt > 0:
+            f = 0.5 ** (dt / half_life_s)
+            self.read_rows *= f
+            self.read_bytes *= f
+            self.write_rows *= f
+            self.write_bytes *= f
+            self.last_ts = now
+
+
+class RegionHeat:
+    """Per-region access heat for one cluster's RpcHandler."""
+
+    HALF_LIFE_S = 60.0
+    MAX_REGIONS = 4096          # dead-region entries age out past this
+
+    def __init__(self, half_life_s: float = HALF_LIFE_S):
+        self.half_life_s = half_life_s
+        self._lock = threading.Lock()
+        self._entries: dict[int, _HeatEntry] = {}
+
+    def _entry(self, region_id: int, now: float) -> _HeatEntry:
+        e = self._entries.get(region_id)
+        if e is None:
+            e = self._entries[region_id] = _HeatEntry(now)
+            if len(self._entries) > self.MAX_REGIONS:
+                # evict the longest-untouched id (a merged-away region)
+                dead = min(self._entries,
+                           key=lambda r: self._entries[r].last_access)
+                self._entries.pop(dead, None)
+        return e
+
+    def record_read(self, region_id: int, rows: int, nbytes: int) -> None:
+        if not rows and not nbytes:
+            return
+        from tidb_tpu import metrics
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(region_id, now)
+            e.decay(now, self.half_life_s)
+            e.read_rows += rows
+            e.read_bytes += nbytes
+            e.total_read_rows += rows
+            e.total_read_bytes += nbytes
+            e.last_access = now
+        metrics.counter("copr.region_heat.read_rows").inc(rows)
+        metrics.counter("copr.region_heat.read_bytes").inc(nbytes)
+
+    def record_write(self, region_id: int, rows: int, nbytes: int) -> None:
+        if not rows and not nbytes:
+            return
+        from tidb_tpu import metrics
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(region_id, now)
+            e.decay(now, self.half_life_s)
+            e.write_rows += rows
+            e.write_bytes += nbytes
+            e.total_write_rows += rows
+            e.total_write_bytes += nbytes
+            e.last_access = now
+        metrics.counter("copr.region_heat.write_rows").inc(rows)
+        metrics.counter("copr.region_heat.write_bytes").inc(nbytes)
+
+    def snapshot(self) -> list[dict]:
+        """Decayed per-region heat, hottest first. Refreshes the
+        `copr.region_heat.*` gauges as a side effect (same lazy-refresh
+        contract as the plane-cache gauges: reading the surface is what
+        keeps /metrics current)."""
+        from tidb_tpu import metrics
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for rid, e in self._entries.items():
+                e.decay(now, self.half_life_s)
+                heat = (e.read_rows + e.write_rows
+                        + (e.read_bytes + e.write_bytes) / 1024.0)
+                out.append({
+                    "region_id": rid,
+                    "read_rows": e.read_rows,
+                    "read_bytes": e.read_bytes,
+                    "write_rows": e.write_rows,
+                    "write_bytes": e.write_bytes,
+                    "total_read_rows": e.total_read_rows,
+                    "total_read_bytes": e.total_read_bytes,
+                    "total_write_rows": e.total_write_rows,
+                    "total_write_bytes": e.total_write_bytes,
+                    "heat": heat,
+                })
+        out.sort(key=lambda d: (-d["heat"], d["region_id"]))
+        metrics.gauge("copr.region_heat.regions").set(len(out))
+        metrics.gauge("copr.region_heat.top_region").set(
+            out[0]["region_id"] if out else 0)
+        metrics.gauge("copr.region_heat.top_score").set(
+            round(out[0]["heat"], 3) if out else 0)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

@@ -73,6 +73,13 @@ class RpcHandler:
         # columns, range bounds) so a hit is provably snapshot-consistent
         from tidb_tpu.copr.plane_cache import PlaneCache
         self.plane_cache = PlaneCache()
+        # per-region access heat (server-side, like TiKV's hot-region
+        # flow statistics): time-decayed read/write row+byte windows fed
+        # from request completion — the placement signal
+        # information_schema.TIDB_TPU_HOT_REGIONS and the mesh
+        # region→shard item read
+        from tidb_tpu.cluster.heat import RegionHeat
+        self.region_heat = RegionHeat()
 
     # ---- region context validation ----
 
@@ -122,13 +129,22 @@ class RpcHandler:
         region = self._check(ctx)
         if not region.contains(key):
             raise StaleEpochError(ctx.region_id, region)
-        return self.mvcc.get(key, read_ts)
+        v = self.mvcc.get(key, read_ts)
+        if v is not None:
+            self.region_heat.record_read(ctx.region_id, 1,
+                                         len(key) + len(v))
+        return v
 
     def kv_scan(self, ctx: RegionCtx, start: bytes, end: bytes | None,
                 read_ts: int, limit: int | None = None):
         region = self._check(ctx)
         lo, hi = self._clip(region, start, end)
-        return self.mvcc.scan(lo, hi, read_ts, limit)
+        out = self.mvcc.scan(lo, hi, read_ts, limit)
+        if out:
+            self.region_heat.record_read(
+                ctx.region_id, len(out),
+                sum(len(k) + len(v) for k, v in out))
+        return out
 
     def kv_prewrite(self, ctx: RegionCtx, mutations, primary: bytes,
                     start_ts: int, ttl_ms: int):
@@ -136,6 +152,12 @@ class RpcHandler:
         failpoint.eval("twopc/prewrite", lambda: ServerIsBusyError(
             "injected prewrite fault"))
         self.mvcc.prewrite(mutations, primary, start_ts, ttl_ms)
+        # write heat lands at prewrite (where the data bytes arrive);
+        # commit only flips lock records, so counting it too would
+        # double-attribute every row
+        self.region_heat.record_write(
+            ctx.region_id, len(mutations),
+            sum(len(k) + (len(v) if v else 0) for _op, k, v in mutations))
 
     def kv_commit(self, ctx: RegionCtx, keys, start_ts: int, commit_ts: int):
         self._check(ctx)
@@ -189,8 +211,36 @@ class RpcHandler:
                 region=(ctx.region_id, region.epoch()),
                 cache=self.plane_cache)
             if resp is not None:
+                self._record_copr_heat(ctx.region_id, resp)
                 return resp
-        return handle_request(snapshot, sel, clipped)
+        resp = handle_request(snapshot, sel, clipped)
+        self._record_copr_heat(ctx.region_id, resp)
+        return resp
+
+    def _record_copr_heat(self, region_id: int, resp) -> None:
+        """Read-heat attribution for one coprocessor response — at
+        request completion, off the retry ladder (a retried request
+        counts once per attempt that actually produced data, the same
+        way TiKV's flow stats count served reads). Cost: a row count the
+        response already knows plus one heat update."""
+        col = resp.columnar
+        if col is not None:
+            # columnar partial: the region scanned the whole pack (the
+            # filter ran over every plane row); bytes are the plane
+            # footprint (8-byte values + 1-byte valid per column)
+            batch = getattr(col, "batch", None)
+            rows = batch.n_rows if batch is not None else len(col)
+            ncols = len(batch.columns) if batch is not None else 1
+            self.region_heat.record_read(region_id, rows, rows * 9 * ncols)
+            return
+        if resp.chunks:
+            self.region_heat.record_read(
+                region_id, sum(len(c.rows_meta) for c in resp.chunks),
+                sum(len(c.rows_data) for c in resp.chunks))
+            return
+        rows = resp.row_count()
+        if rows:
+            self.region_heat.record_read(region_id, rows, rows * 16)
 
 
 class _MvccSnapshotView:

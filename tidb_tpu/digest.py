@@ -1,0 +1,198 @@
+"""Statement + plan digests: the workload-aggregation identity.
+
+Reference: the reference's parser normalization (util/sqlexec /
+parser.Normalize + parser.DigestNormalized in later TiDB: literals fold
+to '?', whitespace collapses, keywords/identifiers case-fold, IN-lists
+collapse to one marker) and plan digests (util/plancodec.NormalizePlan:
+the physical tree SHAPE, not its per-run constants). A digest is the key
+every workload-level surface aggregates on —
+performance_schema.events_statements_summary_by_digest, the TOP-SQL
+view, SHOW PROCESSLIST's DIGEST column — so two statements differing
+only in literals MUST map to one digest and two different plan shapes
+must not.
+
+The normalizer rides the SQL lexer's token stream (parser.lexer), not a
+second hand-rolled scanner, so anything the parser accepts normalizes
+consistently; a statement the lexer rejects still gets a stable digest
+from its folded raw text (errors are workload too). Cost discipline:
+one tokenize pass per statement (same order of work as the parse that
+already ran) — the tier-1 overhead guard holds the whole digest +
+summary pipeline under 2 ms per statement.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from tidb_tpu.parser import lexer as lx
+
+# literal-ish token types that fold to the '?' marker (PARAM itself is
+# already the marker, so prepared text and literal text share digests)
+_LITERALS = frozenset((lx.STRING, lx.INT, lx.DECIMAL, lx.FLOAT, lx.HEX,
+                       lx.BIT, lx.PARAM))
+
+# no space BEFORE these punctuation tokens when rendering the
+# normalized text (cosmetic only — the digest is over the rendered text,
+# so the rules just need to be deterministic)
+_TIGHT_BEFORE = frozenset((",", ")", ".", ";"))
+_TIGHT_AFTER = frozenset(("(", "."))
+
+
+def _hash(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()
+
+
+def _ends_operand(t) -> bool:
+    """Can this token END an operand? Decides whether a following +/- is
+    a binary operator (`a - 1`, `(x) - 1`) or a unary sign (`= -1`,
+    `select -1`, `(-1`) whose literal folds to one '?'."""
+    return (t.tp == lx.IDENT or t.tp == lx.SYS_VAR or t.tp == lx.USER_VAR
+            or t.tp in _LITERALS or (t.tp == lx.OP and t.val == ")"))
+
+
+def normalize(sql: str) -> str:
+    """Canonical statement text: literals → '?', IN (?, ?, …) → (...),
+    keywords/identifiers lower-cased, whitespace/comments folded.
+    Lexer-rejected text falls back to a whitespace/case fold of the raw
+    statement so every statement — even a syntax error — normalizes."""
+    try:
+        toks = lx.tokenize(sql)
+    except Exception:  # noqa: BLE001 — unlexable input still digests
+        return " ".join(sql.split()).lower()
+    words: list[str] = []
+    i = 0
+    n = len(toks)
+    while i < n:
+        t = toks[i]
+        if t.tp == lx.EOF:
+            break
+        if t.tp in _LITERALS:
+            # a unary sign folds into the literal's '?' so text `-1` and
+            # a prepared param bound to -1 share a digest; a BINARY +/-
+            # (operand on its left) keeps its shape
+            if words and words[-1] in ("-", "+") \
+                    and (i < 2 or not _ends_operand(toks[i - 2])):
+                words.pop()
+            words.append("?")
+            i += 1
+            continue
+        if t.tp == lx.OP and t.val == "(":
+            j = i + 1
+            items = commas = 0
+            while j < n:
+                tj = toks[j]
+                if tj.tp in _LITERALS:
+                    items += 1
+                elif tj.tp == lx.OP and tj.val == ",":
+                    commas += 1
+                elif tj.tp == lx.OP and tj.val in ("-", "+"):
+                    pass       # signed literal item
+                else:
+                    break
+                j += 1
+            # collapse when it IS a list (>=2 literal items) — or a
+            # single-literal parens directly after IN, so `in (1)` and
+            # `in (1, 2, 3)` share a digest ("any arity" contract); a
+            # bare parenthesized literal elsewhere keeps its shape
+            is_list = items >= 2 and commas >= 1
+            if (is_list or (items == 1 and commas == 0 and words
+                            and words[-1] == "in")) \
+                    and j < n and toks[j].tp == lx.OP and toks[j].val == ")":
+                words.append("(...)")
+                i = j + 1
+                continue
+            words.append("(")
+            i += 1
+            continue
+        if t.tp == lx.KEYWORD:
+            words.append(str(t.val).lower())
+        elif t.tp == lx.IDENT:
+            words.append(str(t.val).lower())
+        elif t.tp == lx.SYS_VAR:
+            words.append("@@" + str(t.val).lower())
+        elif t.tp == lx.USER_VAR:
+            words.append("@" + str(t.val).lower())
+        else:  # operators / punctuation
+            words.append(str(t.val))
+        i += 1
+    # render with light spacing so DIGEST_TEXT reads like SQL
+    out: list[str] = []
+    for w in words:
+        if out and w not in _TIGHT_BEFORE and out[-1] not in _TIGHT_AFTER:
+            out.append(" ")
+        out.append(w)
+    return "".join(out)
+
+
+def sql_digest(sql: str) -> tuple[str, str]:
+    """(digest hex, normalized text) for one statement."""
+    norm = normalize(sql)
+    return _hash(norm), norm
+
+
+# ---------------------------------------------------------------------------
+# plan digest: the physical tree's SHAPE
+# ---------------------------------------------------------------------------
+
+def _plan_label(p) -> str:
+    """One node's shape-relevant identity: operator type plus the
+    attributes that change how it executes (table/index, pushed-down
+    payload kinds, join keys count) — never per-run constants (range
+    bounds, literal filters), which belong to the SQL digest."""
+    parts = [p.tp]
+    tp = p.tp
+    if tp in ("tscan", "iscan"):
+        ti = getattr(p, "table_info", None)
+        if ti is not None:
+            parts.append(f"t={ti.name.lower()}")
+        idx = getattr(p, "index", None)
+        if idx is not None:
+            parts.append(f"i={idx.name.lower()}")
+        if getattr(p, "double_read", False):
+            parts.append("dr")
+        if getattr(p, "pushed_where", None) is not None:
+            parts.append("w")
+        if getattr(p, "aggregates", None):
+            parts.append(f"agg={len(p.aggregates)}")
+        if getattr(p, "topn_pb", None):
+            parts.append("topn")
+        if getattr(p, "limit", None) is not None:
+            parts.append("lim")
+        if getattr(p, "desc", False):
+            parts.append("desc")
+    elif tp == "phashjoin":
+        parts.append(f"jt={getattr(p, 'join_type', 0)}")
+        parts.append(f"eq={len(getattr(p, 'eq_conditions', ()))}")
+    elif tp in ("phashagg", "pstreamagg"):
+        parts.append(f"f={len(getattr(p, 'agg_funcs', ()))}")
+        parts.append(f"g={len(getattr(p, 'group_by', ()))}")
+    elif tp == "ptopn":
+        parts.append(f"by={len(getattr(p, 'by_items', ()))}")
+    elif tp == "insert":
+        t = getattr(p, "table", None)
+        info = getattr(t, "info", None)
+        if info is not None:
+            parts.append(f"t={info.name.lower()}")
+    return ":".join(parts)
+
+
+def plan_digest(plan) -> tuple[str, str]:
+    """(digest hex, normalized plan text) from a physical plan tree.
+    The text is the indented shape rendering the digest hashes — kept
+    as the summary's PLAN_SAMPLE so a digest is explainable."""
+    lines: list[str] = []
+
+    def walk(p, depth: int) -> None:
+        lines.append("  " * depth + _plan_label(p))
+        for c in getattr(p, "children", ()):
+            walk(c, depth + 1)
+        inner = getattr(p, "inner_plan", None)
+        if inner is not None:
+            walk(inner, depth + 1)
+        sel = getattr(p, "select_plan", None)
+        if sel is not None:
+            walk(sel, depth + 1)
+
+    walk(plan, 0)
+    text = "\n".join(lines)
+    return _hash(text), text

@@ -129,6 +129,13 @@ _GLOBAL_ONLY_TPU_VARS = {
     "tidb_tpu_columnar_scan": "apply_tpu_columnar_scan",
     "tidb_tpu_plane_cache": "apply_tpu_plane_cache",
     "tidb_tpu_plane_cache_bytes": "apply_tpu_plane_cache_bytes",
+    # statement-digest summary knobs (perfschema digest_summary state)
+    "tidb_tpu_stmt_summary": "apply_stmt_summary",
+    "tidb_tpu_stmt_summary_max_digests": "apply_stmt_summary_max_digests",
+    "tidb_tpu_stmt_summary_refresh_interval":
+        "apply_stmt_summary_refresh_interval",
+    "tidb_tpu_stmt_summary_history_size": "apply_stmt_summary_history_size",
+    "tidb_tpu_perfschema_history_cap": "apply_perfschema_history_cap",
 }
 
 
@@ -421,15 +428,18 @@ def _show(session, stmt: ast.ShowStmt) -> ResultSet:
             if not see_all and s.vars.user != me:
                 continue
             cid = s.vars.connection_id
-            info = ps.current_sql(cid)
+            info, digest, elapsed, running = ps.current_info(cid)
             if info and not stmt.full:
                 info = info[:100]
             rows.append([str(cid), s.vars.user or "",
                          s.vars.client_host or "localhost",
-                         s.vars.current_db or None, "Query", "0", "",
-                         info])
+                         s.vars.current_db or None,
+                         "Query" if running else "Sleep",
+                         str(int(elapsed)),
+                         "executing" if running else "",
+                         info, digest or None])
         return _str_rs(["Id", "User", "Host", "db", "Command", "Time",
-                        "State", "Info"], rows)
+                        "State", "Info", "Digest"], rows)
     if tp == ast.ShowType.GRANTS:
         from tidb_tpu import privilege as pv
         user = stmt.pattern or session.vars.user or "root"

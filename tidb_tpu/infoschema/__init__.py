@@ -53,6 +53,10 @@ class InfoSchema:
             ivt = it.InfoVirtualTable(ti, self)
             self._tbl_by_name[(idb.name.lower(), ti.name.lower())] = ivt
             self._tbl_by_id[ti.id] = ivt
+        for ti in it.store_table_infos():
+            svt = it.StoreVirtualTable(ti, store)
+            self._tbl_by_name[(idb.name.lower(), ti.name.lower())] = svt
+            self._tbl_by_id[ti.id] = svt
 
     # ---- lookups ----
     def schema_by_name(self, name: str) -> DBInfo | None:

@@ -25,6 +25,10 @@ T_COLUMNS = -203
 T_STATISTICS = -204
 T_CHARACTER_SETS = -205
 T_COLLATIONS = -206
+# workload-observability tables (store-bound, not snapshot-bound):
+# TOP-SQL by device time per time bucket, and region access heat
+T_TPU_TOP_SQL = -210
+T_TPU_HOT_REGIONS = -211
 
 
 def _col(i: int, name: str, tp: int = my.TypeVarchar,
@@ -85,6 +89,105 @@ def table_infos() -> list[TableInfo]:
             ("MATCH_OPTION",), ("UPDATE_RULE",), ("DELETE_RULE",),
             ("TABLE_NAME",), ("REFERENCED_TABLE_NAME",)]),
     ]
+
+
+def store_table_infos() -> list[TableInfo]:
+    """Tables whose rows come from live STORE state (perfschema digest
+    summary, cluster region heat) rather than the schema snapshot."""
+    return [
+        _tbl(T_TPU_TOP_SQL, "TIDB_TPU_TOP_SQL", [
+            ("TIME_BUCKET_BEGIN", my.TypeLonglong, 21),
+            ("TIME_BUCKET_END", my.TypeLonglong, 21),
+            ("RANK", my.TypeLonglong, 21),
+            ("DIGEST",), ("DIGEST_TEXT", my.TypeVarchar, 1024),
+            ("EXEC_COUNT", my.TypeLonglong, 21),
+            ("DEVICE_TIME_MS", my.TypeDouble, 22),
+            ("KERNEL_DISPATCHES", my.TypeLonglong, 21),
+            ("READBACK_BYTES", my.TypeLonglong, 21),
+            ("SUM_LATENCY_MS", my.TypeDouble, 22),
+            ("AVG_LATENCY_MS", my.TypeDouble, 22),
+            ("ROWS_SENT", my.TypeLonglong, 21)]),
+        _tbl(T_TPU_HOT_REGIONS, "TIDB_TPU_HOT_REGIONS", [
+            ("RANK", my.TypeLonglong, 21),
+            ("REGION_ID", my.TypeLonglong, 21),
+            ("START_KEY", my.TypeVarchar, 128),
+            ("END_KEY", my.TypeVarchar, 128),
+            ("LEADER_STORE", my.TypeLonglong, 21),
+            ("READ_ROWS", my.TypeLonglong, 21),
+            ("READ_BYTES", my.TypeLonglong, 21),
+            ("WRITE_ROWS", my.TypeLonglong, 21),
+            ("WRITE_BYTES", my.TypeLonglong, 21),
+            ("TOTAL_READ_ROWS", my.TypeLonglong, 21),
+            ("TOTAL_WRITE_ROWS", my.TypeLonglong, 21),
+            ("HEAT", my.TypeDouble, 22)]),
+    ]
+
+
+def rows_for_store(store, table_id: int) -> list[list[Datum]]:
+    """Synthesize one store-bound table's rows from live store state."""
+    if table_id == T_TPU_TOP_SQL:
+        from tidb_tpu import perfschema as ps
+        out: list[list[Datum]] = []
+        for begin, end, entries, _ed, _ee in \
+                ps.perf_for(store).digest_summary.windows():
+            ranked = sorted(entries.values(),
+                            key=lambda e: (-e.device_time_us(),
+                                           -e.sum_latency_ms, e.digest))
+            for rank, e in enumerate(ranked[:32], start=1):
+                out.append([
+                    Datum.i64(int(begin)),
+                    Datum.i64(int(end)) if end is not None else NULL,
+                    Datum.i64(rank), _s(e.digest),
+                    _s(e.norm_sql[:1024]), Datum.i64(e.exec_count),
+                    Datum.f64(round(e.device_time_us() / 1e3, 3)),
+                    Datum.i64(e.res.get("kernel_dispatches", 0)),
+                    Datum.i64(e.res.get("readback_bytes", 0)),
+                    Datum.f64(round(e.sum_latency_ms, 3)),
+                    Datum.f64(round(e.sum_latency_ms
+                                    / max(e.exec_count, 1), 3)),
+                    Datum.i64(e.rows_sent)])
+        return out
+    if table_id == T_TPU_HOT_REGIONS:
+        rpc = getattr(store, "rpc", None)
+        heat = getattr(rpc, "region_heat", None)
+        if heat is None:
+            return []   # single-node store: no regions, no heat
+        cluster = getattr(store, "cluster", None)
+        out = []
+        for rank, h in enumerate(heat.snapshot(), start=1):
+            region = cluster.region_by_id(h["region_id"]) \
+                if cluster is not None else None
+            out.append([
+                Datum.i64(rank), Datum.i64(h["region_id"]),
+                _s(region.start.hex()) if region is not None else NULL,
+                _s(region.end.hex()) if region is not None
+                and region.end is not None else NULL,
+                Datum.i64(region.leader_store_id)
+                if region is not None else NULL,
+                # decayed windows round (not truncate): one fresh access
+                # decays to 0.99… within the same statement and must not
+                # render as zero
+                Datum.i64(round(h["read_rows"])),
+                Datum.i64(round(h["read_bytes"])),
+                Datum.i64(round(h["write_rows"])),
+                Datum.i64(round(h["write_bytes"])),
+                Datum.i64(h["total_read_rows"]),
+                Datum.i64(h["total_write_rows"]),
+                Datum.f64(round(h["heat"], 3))])
+        return out
+    return []
+
+
+class StoreVirtualTable(VirtualTableBase):
+    """information_schema table bound to the live store (digest
+    summaries, region heat) instead of the schema snapshot."""
+
+    def __init__(self, info: TableInfo, store):
+        super().__init__(info, "information_schema")
+        self.store = store
+
+    def rows(self):
+        return rows_for_store(self.store, self.id)
 
 
 def _s(v: str) -> Datum:

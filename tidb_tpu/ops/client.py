@@ -466,7 +466,8 @@ class TpuClient(kv.Client):
         sp.set("readbacks", 1)
         sp.set("readback_bytes", nbytes)
         sp.finish()
-        tracing.record_dispatch(readback_bytes=nbytes)
+        tracing.record_dispatch(readback_bytes=nbytes,
+                                dispatch_us=(t1 - t0) * 1e6)
         metrics.histogram("ops.kernel_seconds").observe(t1 - t0)
         return host
 

@@ -849,6 +849,8 @@ def combine_region_partials(states: list[np.ndarray],
     layout at trace time, so a shape change must map to its own wrapper
     (a shared wrapper would serve a stale layout after jit returns a
     previously-compiled signature without retracing)."""
+    import time as _time
+
     from tidb_tpu import tracing as _tracing
     key = (tuple(ops),
            tuple((s.shape, np.dtype(s.dtype).char) for s in states))
@@ -878,6 +880,7 @@ def combine_region_partials(states: list[np.ndarray],
     sp = _tracing.current().child("combine_region_partials") \
         .set("regions", int(states[0].shape[0])) \
         .set("states", len(states))
+    _t0 = _time.perf_counter()
     try:
         if _failpoint._active:
             _failpoint.eval("device/combine", lambda: _errors.DeviceError(
@@ -895,7 +898,9 @@ def combine_region_partials(states: list[np.ndarray],
         raise _errors.DeviceError(f"region combine failed: {e}") from e
     sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
     sp.finish()
-    _tracing.record_dispatch(readback_bytes=int(host.nbytes))
+    _tracing.record_dispatch(
+        readback_bytes=int(host.nbytes),
+        dispatch_us=(_time.perf_counter() - _t0) * 1e6)
     outs = unpack_outputs(wrapper, host)
     # unpack scalarizes length-1 outputs; states are per-group arrays
     return [np.atleast_1d(np.asarray(o)) for o in outs]
@@ -1037,6 +1042,7 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
         stats["build_s"] = _time.time() - t0
 
     t0 = _time.time()
+    _pc0 = _time.perf_counter()   # monotonic, for the dispatch_us tally
     psp = tracing.current().child("kernel").set("kind", "join_probe")
     if device_keys is not None:
         lk_d = _device_pad(lkd, lcap)
@@ -1071,7 +1077,8 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
         .set("pairs", int(n_out))
     psp.finish()
     tracing.record_dispatch(dispatches=rb_count, readbacks=rb_count,
-                            readback_bytes=rb_bytes)
+                            readback_bytes=rb_bytes,
+                            dispatch_us=(_time.perf_counter() - _pc0) * 1e6)
     # narrow readbacks widen here; the int64 path stays zero-copy
     l_idx = packed[:n_out].astype(np.int64, copy=False)
     r_idx = packed[out_cap:out_cap + n_out].astype(np.int64, copy=False)

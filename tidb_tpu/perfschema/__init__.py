@@ -8,6 +8,17 @@ keeps a fixed-capacity ring of statement events; the infoschema snapshot
 attaches the virtual `performance_schema` database whose tables read from
 it, so `select * from performance_schema.events_statements_history` runs
 through the regular planner with SQL-side filtering (no KV, no pushdown).
+
+Workload aggregation (the layer above per-statement events): a
+TiDB-style statement-digest summary —
+`events_statements_summary_by_digest` (the CURRENT time window),
+`_history` (rotated windows, a bounded ring) and `_evicted` (per-window
+eviction accounting, so capped summaries stay reconcilable). Every
+top-level statement rolls its latency + the full per-statement resource
+tally (device kernels, columnar channel, plane cache, backoff,
+degradations) into its digest's entry; the aggregation rides the
+existing thread-local tally contract (monotonic diffs, one locked
+update at statement end).
 """
 
 from __future__ import annotations
@@ -30,8 +41,12 @@ DB_ID = -100
 T_STMT_CURRENT = -101
 T_STMT_HISTORY = -102
 T_INSTRUMENTS = -103
+T_DIGEST_SUMMARY = -104
+T_DIGEST_HISTORY = -105
+T_DIGEST_EVICTED = -106
 
-HISTORY_CAP = 1024  # stmtEventsHistoryElemMax-style bound
+HISTORY_CAP = 1024  # stmtEventsHistoryElemMax-style bound (default; the
+#                     tidb_tpu_perfschema_history_cap sysvar re-sizes it)
 
 
 def _col(i: int, name: str, tp: int, flen: int = 64) -> ColumnInfo:
@@ -58,6 +73,59 @@ def _stmt_table(tid: int, name: str) -> TableInfo:
                               for i, (n, tp) in enumerate(_STMT_COLS)])
 
 
+# the per-digest resource vocabulary rolled up from the per-statement
+# tallies — column name → tally key. One table drives the summary
+# columns, the row rendering, AND the reconciliation contract (each
+# column sums the exact per-statement deltas, so per-digest sums equal
+# the flat global counters for any workload the store ran alone).
+RESOURCE_COLS = (
+    ("KERNEL_DISPATCHES", "kernel_dispatches"),
+    ("KERNEL_DISPATCH_US", "kernel_dispatch_us"),
+    ("READBACKS", "readbacks"),
+    ("READBACK_BYTES", "readback_bytes"),
+    ("JIT_HITS", "jit_hits"),
+    ("JIT_MISSES", "jit_misses"),
+    ("COLUMNAR_HITS", "columnar_hits"),
+    ("COLUMNAR_FALLBACKS", "columnar_fallbacks"),
+    ("COLUMNAR_PARTIALS", "columnar_partials"),
+    ("PLANE_CACHE_HITS", "plane_cache_hits"),
+    ("PLANE_CACHE_MISSES", "plane_cache_misses"),
+    ("BACKOFF_RETRIES", "backoff_retries"),
+    ("BACKOFF_MS", "backoff_ms"),
+    ("SESSION_RETRIES", "session_retries"),
+    ("DEGRADED_DEVICE", "degraded_device"),
+    ("DEGRADED_JOIN", "degraded_join"),
+    ("DEGRADED_COMBINE", "degraded_combine"),
+)
+
+
+def _digest_table(tid: int, name: str) -> TableInfo:
+    cols = [
+        ("SUMMARY_BEGIN_TIME", my.TypeLonglong, 21),
+        ("SUMMARY_END_TIME", my.TypeLonglong, 21),
+        ("DIGEST", my.TypeVarchar, 64),
+        ("PLAN_DIGEST", my.TypeVarchar, 64),
+        ("DIGEST_TEXT", my.TypeBlob, 1024),
+        ("EXEC_COUNT", my.TypeLonglong, 21),
+        ("ERRORS", my.TypeLonglong, 21),
+        ("SUM_LATENCY_MS", my.TypeDouble, 22),
+        ("AVG_LATENCY_MS", my.TypeDouble, 22),
+        ("MIN_LATENCY_MS", my.TypeDouble, 22),
+        ("MAX_LATENCY_MS", my.TypeDouble, 22),
+        ("P95_LATENCY_MS", my.TypeDouble, 22),
+        ("ROWS_SENT", my.TypeLonglong, 21),
+        ("ROWS_AFFECTED", my.TypeLonglong, 21),
+    ] + [(n, my.TypeLonglong, 21) for n, _k in RESOURCE_COLS] + [
+        ("FIRST_SEEN", my.TypeLonglong, 21),
+        ("LAST_SEEN", my.TypeLonglong, 21),
+        ("QUERY_SAMPLE_TEXT", my.TypeBlob, 1024),
+        ("PLAN_SAMPLE", my.TypeBlob, 1024),
+    ]
+    return TableInfo(id=tid, name=name,
+                     columns=[_col(i, n, tp, fl)
+                              for i, (n, tp, fl) in enumerate(cols)])
+
+
 def table_infos() -> list[TableInfo]:
     return [
         _stmt_table(T_STMT_CURRENT, "events_statements_current"),
@@ -67,15 +135,27 @@ def table_infos() -> list[TableInfo]:
             _col(1, "ENABLED", my.TypeVarchar, 4),
             _col(2, "TIMED", my.TypeVarchar, 4),
         ]),
+        _digest_table(T_DIGEST_SUMMARY,
+                      "events_statements_summary_by_digest"),
+        _digest_table(T_DIGEST_HISTORY,
+                      "events_statements_summary_by_digest_history"),
+        TableInfo(id=T_DIGEST_EVICTED,
+                  name="events_statements_summary_evicted", columns=[
+                      _col(0, "SUMMARY_BEGIN_TIME", my.TypeLonglong, 21),
+                      _col(1, "SUMMARY_END_TIME", my.TypeLonglong, 21),
+                      _col(2, "EVICTED_DIGESTS", my.TypeLonglong, 21),
+                      _col(3, "EVICTED_EXEC_COUNT", my.TypeLonglong, 21),
+                  ]),
     ]
 
 
 class StatementEvent:
     __slots__ = ("thread_id", "event_id", "name", "sql_text", "t_start",
                  "t_end", "rows_sent", "rows_affected", "errors", "message",
-                 "detail")
+                 "detail", "digest")
 
-    def __init__(self, thread_id: int, event_id: int, sql_text: str):
+    def __init__(self, thread_id: int, event_id: int, sql_text: str,
+                 digest: str = ""):
         self.thread_id = thread_id
         self.event_id = event_id
         self.name = "statement/sql/execute"
@@ -87,6 +167,7 @@ class StatementEvent:
         self.errors = 0
         self.message = ""
         self.detail = ""
+        self.digest = digest       # statement digest (SHOW PROCESSLIST)
 
     def row(self) -> list[Datum]:
         wait = max(0, self.t_end - self.t_start) if self.t_end else 0
@@ -105,6 +186,218 @@ class StatementEvent:
 CURRENT_CAP = 512  # bounded like the history ring: threads come and go
 
 
+# per-digest latency histogram bounds (ms) for the p95 estimate — a
+# fixed log2 ladder so every entry costs one small int list, no
+# per-observation allocation
+_LAT_BOUNDS_MS = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                  128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0, 8192.0)
+
+
+class DigestEntry:
+    """One digest's aggregate within one summary window."""
+
+    __slots__ = ("digest", "plan_digest", "norm_sql", "sample_sql",
+                 "sample_plan", "exec_count", "errors", "sum_latency_ms",
+                 "min_latency_ms", "max_latency_ms", "lat_buckets",
+                 "rows_sent", "rows_affected", "res", "first_seen",
+                 "last_seen")
+
+    def __init__(self, digest: str, norm_sql: str, now: float):
+        self.digest = digest
+        self.plan_digest = ""
+        self.norm_sql = norm_sql
+        self.sample_sql = ""
+        self.sample_plan = ""
+        self.exec_count = 0
+        self.errors = 0
+        self.sum_latency_ms = 0.0
+        self.min_latency_ms = float("inf")
+        self.max_latency_ms = 0.0
+        self.lat_buckets = [0] * (len(_LAT_BOUNDS_MS) + 1)
+        self.rows_sent = 0
+        self.rows_affected = 0
+        self.res: dict[str, int] = {}
+        self.first_seen = now
+        self.last_seen = now
+
+    def observe(self, latency_ms: float, rows_sent: int,
+                rows_affected: int, error: bool, resources: dict,
+                now: float) -> None:
+        self.exec_count += 1
+        if error:
+            self.errors += 1
+        self.sum_latency_ms += latency_ms
+        if latency_ms < self.min_latency_ms:
+            self.min_latency_ms = latency_ms
+        if latency_ms > self.max_latency_ms:
+            self.max_latency_ms = latency_ms
+        for i, b in enumerate(_LAT_BOUNDS_MS):
+            if latency_ms <= b:
+                self.lat_buckets[i] += 1
+                break
+        else:
+            self.lat_buckets[-1] += 1
+        self.rows_sent += rows_sent
+        self.rows_affected += rows_affected
+        if resources:
+            res = self.res
+            for k, v in resources.items():
+                if v:
+                    res[k] = res.get(k, 0) + v
+        self.last_seen = now
+
+    def p95_latency_ms(self) -> float:
+        """Upper bound of the bucket holding the 95th percentile (the
+        +Inf bucket reports the observed max — exact for it)."""
+        if not self.exec_count:
+            return 0.0
+        target = self.exec_count * 0.95
+        cum = 0
+        for i, c in enumerate(self.lat_buckets):
+            cum += c
+            if cum >= target:
+                return _LAT_BOUNDS_MS[i] if i < len(_LAT_BOUNDS_MS) \
+                    else self.max_latency_ms
+        return self.max_latency_ms
+
+    def device_time_us(self) -> int:
+        return self.res.get("kernel_dispatch_us", 0)
+
+
+class DigestSummary:
+    """Windowed per-digest statement summary for one store.
+
+    The CURRENT window aggregates statements since window_begin; when
+    the refresh interval elapses the window rotates into a bounded
+    history ring (the flush crosses the `summary/flush` failpoint — an
+    injected fault DEFERS the rotation, extending the window, so
+    accounting never loses a statement). Entry count is capped; evicted
+    entries are counted (digests + their exec counts) per window so a
+    capped summary still reconciles: recorded = Σ entries + evicted."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.enabled = True
+        self.max_digests = 512
+        self.refresh_interval_s = 1800.0
+        self.history_size = 24
+        self.window_begin = time.time()
+        self.entries: "OrderedDict[str, DigestEntry]" = OrderedDict()
+        self.evicted_digests = 0
+        self.evicted_exec_count = 0
+        # rotated windows: (begin, end, entries dict, evicted_digests,
+        # evicted_exec_count)
+        self.history: deque = deque(maxlen=self.history_size)
+
+    # ---- configuration (sysvar appliers call these) ----
+
+    def set_enabled(self, on: bool) -> None:
+        with self.lock:
+            self.enabled = on
+            if not on:
+                # the documented contract of the kill switch: off stops
+                # holding (and a re-enable starts a fresh window)
+                self.entries = OrderedDict()
+                self.history.clear()
+                self.evicted_digests = self.evicted_exec_count = 0
+                self.window_begin = time.time()
+
+    def set_max_digests(self, n: int) -> None:
+        with self.lock:
+            self.max_digests = max(1, n)
+            while len(self.entries) > self.max_digests:
+                self._evict_locked()
+
+    def set_refresh_interval(self, seconds: float) -> None:
+        with self.lock:
+            self.refresh_interval_s = max(1.0, seconds)
+
+    def set_history_size(self, n: int) -> None:
+        with self.lock:
+            self.history_size = max(1, n)
+            self.history = deque(self.history, maxlen=self.history_size)
+
+    # ---- recording ----
+
+    def _evict_locked(self) -> None:
+        _k, old = self.entries.popitem(last=False)
+        self.evicted_digests += 1
+        self.evicted_exec_count += old.exec_count
+        from tidb_tpu import metrics
+        metrics.counter("perfschema.digest_evicted").inc()
+
+    def _maybe_rotate_locked(self, now: float) -> None:
+        if now - self.window_begin < self.refresh_interval_s:
+            return
+        from tidb_tpu import failpoint, metrics
+        if failpoint._active:
+            try:
+                failpoint.eval("summary/flush")
+            except Exception:  # noqa: BLE001 — an injected flush fault
+                # must never fail a statement or drop a window: defer
+                # the rotation (the window extends) and count it
+                metrics.counter("perfschema.digest_flush_errors").inc()
+                return
+        self.history.append((self.window_begin, now, self.entries,
+                             self.evicted_digests,
+                             self.evicted_exec_count))
+        self.entries = OrderedDict()
+        self.evicted_digests = self.evicted_exec_count = 0
+        self.window_begin = now
+        metrics.counter("perfschema.digest_windows_flushed").inc()
+
+    def record(self, digest: str, norm_sql: str, sample_sql: str,
+               plan_digest: str, sample_plan: str, latency_ms: float,
+               rows_sent: int, rows_affected: int, error: bool,
+               resources: dict) -> None:
+        if not self.enabled or not digest:
+            return
+        from tidb_tpu import metrics
+        now = time.time()
+        with self.lock:
+            # re-check under the lock: a statement racing the kill
+            # switch must not insert into the just-cleared summary
+            # (same discipline as PlaneCache.insert)
+            if not self.enabled:
+                return
+            self._maybe_rotate_locked(now)
+            e = self.entries.get(digest)
+            if e is None:
+                e = self.entries[digest] = DigestEntry(digest, norm_sql,
+                                                       now)
+                e.sample_sql = sample_sql[:1024]
+                while len(self.entries) > self.max_digests:
+                    self._evict_locked()
+            else:
+                self.entries.move_to_end(digest)   # cap evicts true LRU
+            if plan_digest:
+                e.plan_digest = plan_digest
+                if sample_plan:
+                    e.sample_plan = sample_plan[:1024]
+            e.observe(latency_ms, rows_sent, rows_affected, error,
+                      resources, now)
+        metrics.counter("perfschema.digest_statements").inc()
+
+    # ---- read surface ----
+
+    def windows(self) -> list[tuple]:
+        """(begin, end|None, entries snapshot, evicted_digests,
+        evicted_exec) — history oldest-first, then the current window
+        (end None). Rotation is applied lazily here too, so a long-idle
+        store still rolls its window on read."""
+        now = time.time()
+        with self.lock:
+            self._maybe_rotate_locked(now)
+            out = [(b, en, dict(es), ed, ee)
+                   for (b, en, es, ed, ee) in self.history]
+            out.append((self.window_begin, None, dict(self.entries),
+                        self.evicted_digests, self.evicted_exec_count))
+        from tidb_tpu import metrics
+        metrics.gauge("perfschema.digest_entries").set(
+            sum(len(w[2]) for w in out))
+        return out
+
+
 class PerfSchema:
     """Per-store statement event store (perfschema.statementStmts)."""
 
@@ -116,12 +409,22 @@ class PerfSchema:
         # *_current until the thread's next one), LRU-bounded
         self._current: "OrderedDict[int, StatementEvent]" = OrderedDict()
         self.enabled = True
+        # workload aggregation above the event ring
+        self.digest_summary = DigestSummary()
 
-    def start_statement(self, thread_id: int,
-                        sql_text: str) -> StatementEvent | None:
+    def set_history_cap(self, cap: int) -> None:
+        """Re-bound the events_statements_history ring (the
+        tidb_tpu_perfschema_history_cap sysvar): a shrink keeps the most
+        recent events, like any ring re-size."""
+        with self._lock:
+            self._history = deque(self._history, maxlen=max(1, cap))
+
+    def start_statement(self, thread_id: int, sql_text: str,
+                        digest: str = "") -> StatementEvent | None:
         if not self.enabled:
             return None
-        ev = StatementEvent(thread_id, next(self._event_ids), sql_text)
+        ev = StatementEvent(thread_id, next(self._event_ids), sql_text,
+                            digest)
         with self._lock:
             self._current[thread_id] = ev
             self._current.move_to_end(thread_id)
@@ -146,12 +449,20 @@ class PerfSchema:
             ev.detail = detail[:1024]
             self._history.append(ev)
 
-    def current_sql(self, thread_id: int) -> str | None:
-        """Locked accessor for the thread's latest statement text (SHOW
-        PROCESSLIST Info column)."""
+    def current_info(self, thread_id: int):
+        """SHOW PROCESSLIST detail for one connection: (sql_text, digest,
+        elapsed_s, running). While the statement runs (t_end unset)
+        elapsed counts from its start; once it completed, from its end —
+        MySQL's Time column semantics (seconds in the current state)."""
         with self._lock:
             ev = self._current.get(thread_id)
-            return ev.sql_text if ev is not None else None
+            if ev is None:
+                return None, "", 0.0, False
+            now = time.perf_counter_ns()
+            running = ev.t_end == 0
+            anchor = ev.t_start if running else ev.t_end
+            return (ev.sql_text, ev.digest,
+                    max(0.0, (now - anchor) / 1e9), running)
 
     # ---- virtual-table row providers ----
 
@@ -166,7 +477,83 @@ class PerfSchema:
             on = b"YES" if self.enabled else b"NO"
             return [[Datum.bytes_(b"statement/sql/execute"),
                      Datum.bytes_(on), Datum.bytes_(b"YES")]]
+        if table_id == T_DIGEST_SUMMARY:
+            w = self.digest_summary.windows()[-1]   # the current window
+            return _digest_rows([w])
+        if table_id == T_DIGEST_HISTORY:
+            return _digest_rows(self.digest_summary.windows()[:-1])
+        if table_id == T_DIGEST_EVICTED:
+            out = []
+            for begin, end, _es, ed, ee in self.digest_summary.windows():
+                out.append([Datum.i64(int(begin)),
+                            Datum.i64(int(end)) if end is not None
+                            else NULL,
+                            Datum.i64(ed), Datum.i64(ee)])
+            return out
         return []
+
+
+def _digest_rows(windows: list[tuple]) -> list[list[Datum]]:
+    """Render digest-summary windows as table rows, hottest-window-order
+    preserved (oldest window first, entries by last_seen within)."""
+    out: list[list[Datum]] = []
+
+    def _b(s: str) -> Datum:
+        return Datum.bytes_(s.encode()) if s else NULL
+
+    for begin, end, entries, _ed, _ee in windows:
+        for e in sorted(entries.values(), key=lambda x: x.first_seen):
+            row = [Datum.i64(int(begin)),
+                   Datum.i64(int(end)) if end is not None else NULL,
+                   _b(e.digest), _b(e.plan_digest), _b(e.norm_sql),
+                   Datum.i64(e.exec_count), Datum.i64(e.errors),
+                   Datum.f64(round(e.sum_latency_ms, 3)),
+                   Datum.f64(round(e.sum_latency_ms
+                                   / max(e.exec_count, 1), 3)),
+                   Datum.f64(round(e.min_latency_ms, 3)
+                             if e.exec_count else 0.0),
+                   Datum.f64(round(e.max_latency_ms, 3)),
+                   Datum.f64(round(e.p95_latency_ms(), 3)),
+                   Datum.i64(e.rows_sent), Datum.i64(e.rows_affected)]
+            row.extend(Datum.i64(e.res.get(key, 0))
+                       for _n, key in RESOURCE_COLS)
+            row.extend([Datum.i64(int(e.first_seen)),
+                        Datum.i64(int(e.last_seen)),
+                        _b(e.sample_sql), _b(e.sample_plan)])
+            out.append(row)
+    return out
+
+
+def apply_sysvars(store, values: dict) -> None:
+    """Hydrate this store's perfschema knobs from persisted globals
+    (bootstrap calls this on every restart path, exactly like the plane
+    cache's budget/kill-switch hydration)."""
+    from tidb_tpu.sessionctx import parse_bool_sysvar
+    ps = perf_for(store)
+    ds = ps.digest_summary
+
+    def _int(name: str):
+        raw = values.get(name)
+        try:
+            return int(raw.strip()) if raw else None
+        except (ValueError, AttributeError):
+            return None
+
+    v = values.get("tidb_tpu_stmt_summary")
+    if v is not None:
+        ds.set_enabled(parse_bool_sysvar(v))
+    n = _int("tidb_tpu_stmt_summary_max_digests")
+    if n is not None:
+        ds.set_max_digests(n)
+    n = _int("tidb_tpu_stmt_summary_refresh_interval")
+    if n is not None:
+        ds.set_refresh_interval(float(n))
+    n = _int("tidb_tpu_stmt_summary_history_size")
+    if n is not None:
+        ds.set_history_size(n)
+    n = _int("tidb_tpu_perfschema_history_cap")
+    if n is not None:
+        ps.set_history_cap(n)
 
 
 _schemas: "OrderedDict[str, PerfSchema]" = OrderedDict()

@@ -78,6 +78,20 @@ def sessions_for(store) -> list["Session"]:
     return out
 
 
+def _detail_str(res: dict) -> str:
+    """Render one statement's resource-delta dict as the EXECUTION_DETAIL
+    string (perfschema) — columnar channel always, then every non-zero
+    tally in COUNTER_KEYS display order."""
+    from tidb_tpu import tracing
+    parts = [f"columnar_hits:{res.get('columnar_hits', 0)}",
+             f"columnar_fallbacks:{res.get('columnar_fallbacks', 0)}",
+             f"columnar_partials:{res.get('columnar_partials', 0)}"]
+    for key in tracing.COUNTER_KEYS:
+        if res.get(key):
+            parts.append(f"{key}:{res[key]}")
+    return " ".join(parts)
+
+
 class Session:
     """One connection's state. Reference: session.go session struct."""
 
@@ -114,6 +128,11 @@ class Session:
         self._next_stmt_id = 0
         self.dirty_tables: set[int] = set()
         self.last_trace = None   # root span of the last traced statement
+        # workload digests: the running top-level statement's plan digest
+        # (set by _run_plan/_run_instrumented, read at statement end) and
+        # whether digesting is live for it (summary enabled, top level)
+        self._cur_plan_digest: tuple[str, str] | None = None
+        self._digest_on = False
         bootstrap(self)
 
     @property
@@ -274,7 +293,18 @@ class Session:
                                    code=1317)
         from tidb_tpu import perfschema, tracing
         ps = perfschema.perf_for(self.store)
-        ev = ps.start_statement(self.vars.connection_id, sql_text)
+        # statement digest, computed ONCE per top-level statement (the
+        # identity every workload surface aggregates on). Internal
+        # statements and a disabled summary skip the normalizer — the
+        # digest pipeline's cost must be opt-out-able to zero.
+        dig = norm = ""
+        if self._exec_depth == 0:
+            self._cur_plan_digest = None
+            self._digest_on = ps.digest_summary.enabled
+            if self._digest_on:
+                from tidb_tpu import digest as _digest
+                dig, norm = _digest.sql_digest(sql_text)
+        ev = ps.start_statement(self.vars.connection_id, sql_text, dig)
         import time as _time
         from tidb_tpu.distsql import thread_columnar_counts
         ch0, cf0, cp0 = thread_columnar_counts()
@@ -311,9 +341,14 @@ class Session:
             try:
                 rs = self._execute_one_inner(stmt, sql_text, record_history)
             except Exception as e:
+                res = self._exec_resources(ch0, cf0, cp0, tally0)
                 ps.end_statement(ev, error=str(e),
-                                 detail=self._exec_detail(
-                                     ch0, cf0, cp0, tally0))
+                                 detail=_detail_str(res))
+                # errored statements are workload too: their digest rows
+                # carry the error count and whatever resources they burned
+                self._record_digest(ps, dig, norm, sql_text,
+                                    (_time.perf_counter() - t0) * 1e3,
+                                    0, 0, True, res)
                 raise
         finally:
             self._exec_depth -= 1
@@ -323,15 +358,50 @@ class Session:
                 tracing.detach(trace_tok)
                 root.finish()
                 self.last_trace = root
-        detail = self._exec_detail(ch0, cf0, cp0, tally0)
-        ps.end_statement(ev, rows_sent=len(rs.rows) if rs is not None else 0,
+        res = self._exec_resources(ch0, cf0, cp0, tally0)
+        n_sent = len(rs.rows) if rs is not None else 0
+        ps.end_statement(ev, rows_sent=n_sent,
                          rows_affected=self.vars.affected_rows,
-                         detail=detail)
-        ch1, cf1, cp1 = thread_columnar_counts()
+                         detail=_detail_str(res))
+        self._record_digest(ps, dig, norm, sql_text,
+                            (_time.perf_counter() - t0) * 1e3,
+                            n_sent, self.vars.affected_rows, False, res)
         self._maybe_log_slow(sql_text, _time.perf_counter() - t0,
-                             ch1 - ch0, cf1 - cf0, cp1 - cp0,
-                             tracing.counters_delta(tally0), root)
+                             res["columnar_hits"],
+                             res["columnar_fallbacks"],
+                             res["columnar_partials"], res, root, dig)
         return rs
+
+    def _exec_resources(self, ch0: int, cf0: int, cp0: int,
+                        tally0: dict) -> dict:
+        """One statement's resource deltas — the always-on per-thread
+        tallies (columnar channel + device kernels + cache/backoff/
+        degradation) diffed over the statement. Computed ONCE at
+        statement end; every consumer (perfschema EXECUTION_DETAIL, the
+        digest summary, the slow log) reads this same dict, so the
+        surfaces cannot disagree."""
+        from tidb_tpu import tracing
+        from tidb_tpu.distsql import thread_columnar_counts
+        ch1, cf1, cp1 = thread_columnar_counts()
+        res = {"columnar_hits": ch1 - ch0,
+               "columnar_fallbacks": cf1 - cf0,
+               "columnar_partials": cp1 - cp0}
+        res.update(tracing.counters_delta(tally0))
+        return res
+
+    def _record_digest(self, ps, dig: str, norm: str, sql_text: str,
+                       latency_ms: float, rows_sent: int,
+                       rows_affected: int, error: bool,
+                       res: dict) -> None:
+        """Roll one finished TOP-LEVEL statement into its digest's
+        summary entry (no-op for internal statements / disabled
+        summary, where `dig` is empty)."""
+        if not dig:
+            return
+        pd, ptext = self._cur_plan_digest or ("", "")
+        ps.digest_summary.record(dig, norm, sql_text, pd, ptext,
+                                 latency_ms, rows_sent, rows_affected,
+                                 error, res)
 
     def _statement_backoffer(self) -> kvbackoff.Backoffer:
         """One Backoffer per top-level statement: the shared retry-sleep
@@ -359,29 +429,12 @@ class Session:
             v = self.global_vars.values.get("tidb_trace_enabled")
         return v is not None and v.strip().lower() in ("1", "on", "true")
 
-    def _exec_detail(self, ch0: int, cf0: int, cp0: int,
-                     tally0: dict) -> str:
-        """Execution-details string for performance_schema: the always-on
-        per-thread tallies (columnar channel + device kernels) diffed
-        over this statement."""
-        from tidb_tpu import tracing
-        from tidb_tpu.distsql import thread_columnar_counts
-        ch1, cf1, cp1 = thread_columnar_counts()
-        parts = [f"columnar_hits:{ch1 - ch0}",
-                 f"columnar_fallbacks:{cf1 - cf0}",
-                 f"columnar_partials:{cp1 - cp0}"]
-        delta = tracing.counters_delta(tally0)
-        for key in tracing.COUNTER_KEYS:
-            if delta.get(key):
-                parts.append(f"{key}:{delta[key]}")
-        return " ".join(parts)
-
     def _maybe_log_slow(self, sql_text: str, elapsed_s: float,
                         columnar_hits: int = 0,
                         columnar_fallbacks: int = 0,
                         columnar_partials: int = 0,
                         kernel_tally: dict | None = None,
-                        root_span=None) -> None:
+                        root_span=None, digest: str = "") -> None:
         """Slow-query log ([TIME_TABLE_SCAN]-style operator logs,
         executor_distsql.go:849): statements over
         tidb_slow_log_threshold ms go to the 'tidb_tpu.slowlog' logger.
@@ -432,6 +485,9 @@ class Session:
                                    sum(t.attrs.get("retries", 0)
                                        for t in tasks),
                                    worst.attrs.get("run_us", 0) / 1e3))
+            if digest:
+                # the digest joins slow-log lines to their summary row
+                detail += f" digest:{digest}"
             # hits/fallbacks count per PARTIAL: a mixed multi-region
             # response (some regions columnar, some row-fallback) shows
             # both sides on the statement's own line
@@ -497,9 +553,18 @@ class Session:
             return self._do_execute(plan, sql_text, record_history)
         return self._run_plan(plan, sql_text, record_history)
 
+    def _note_plan(self, plan) -> None:
+        """Plan digest for the running top-level statement — computed at
+        dispatch, where the physical tree exists, once per statement
+        (nested internal statements run at depth ≥ 2 and are skipped)."""
+        if self._digest_on and self._exec_depth == 1:
+            from tidb_tpu import digest as _digest
+            self._cur_plan_digest = _digest.plan_digest(plan)
+
     def _run_plan(self, plan, sql_text: str,
                   record_history: bool = True) -> ResultSet | None:
         is_write = isinstance(plan, (Insert, Update, Delete))
+        self._note_plan(plan)
         executor = ExecutorBuilder(self).build(plan)
         try:
             if is_write:
@@ -547,6 +612,7 @@ class Session:
         from tidb_tpu import tracing
         from tidb_tpu.executor.instrument import instrument_tree
         is_write = isinstance(target, (Insert, Update, Delete))
+        self._note_plan(target)
         root = tracing.Span("statement")
         root.set("sql", sql_text[:256])
         root.set("conn", self.vars.connection_id)
@@ -674,14 +740,52 @@ class Session:
         # Backoffer (budget + tidb_tpu_max_execution_time deadline)
         # attaches here — and the depth bump makes nested internal
         # statements (persist_global_var etc.) share THIS instance
-        # instead of shadowing it with a fresh deadline.
+        # instead of shadowing it with a fresh deadline. Statement
+        # accounting (perfschema event + digest summary) attaches here
+        # too: COM_STMT_EXECUTE statements are workload like any other,
+        # and the prepared text's digest is computed ONCE per handle
+        # (its '?' markers normalize identically to folded literals, so
+        # binary and text executions of one shape share a digest).
+        import time as _time
+
+        from tidb_tpu import perfschema, tracing
+        ps = perfschema.perf_for(self.store)
+        self._cur_plan_digest = None
+        self._digest_on = ps.digest_summary.enabled
+        dig = norm = ""
+        if self._digest_on:
+            if ent.digest_pair is None:
+                from tidb_tpu import digest as _digest
+                ent.digest_pair = _digest.sql_digest(ent.text)
+            dig, norm = ent.digest_pair
+        ev = ps.start_statement(self.vars.connection_id, ent.text, dig)
+        from tidb_tpu.distsql import thread_columnar_counts
+        ch0, cf0, cp0 = thread_columnar_counts()
+        tally0 = tracing.counters_snapshot()
+        t0 = _time.perf_counter()
         bo_tok = kvbackoff.attach(self._statement_backoffer())
         self._exec_depth += 1
         try:
-            return self.run_prepared(ent, values, ent.text)
+            rs = self.run_prepared(ent, values, ent.text)
+        except Exception as e:
+            res = self._exec_resources(ch0, cf0, cp0, tally0)
+            ps.end_statement(ev, error=str(e), detail=_detail_str(res))
+            self._record_digest(ps, dig, norm, ent.text,
+                                (_time.perf_counter() - t0) * 1e3,
+                                0, 0, True, res)
+            raise
         finally:
             self._exec_depth -= 1
             kvbackoff.detach(bo_tok)
+        res = self._exec_resources(ch0, cf0, cp0, tally0)
+        n_sent = len(rs.rows) if rs is not None else 0
+        ps.end_statement(ev, rows_sent=n_sent,
+                         rows_affected=self.vars.affected_rows,
+                         detail=_detail_str(res))
+        self._record_digest(ps, dig, norm, ent.text,
+                            (_time.perf_counter() - t0) * 1e3,
+                            n_sent, self.vars.affected_rows, False, res)
+        return rs
 
     def close_binary(self, stmt_id: int) -> None:
         self.binary_stmts.pop(stmt_id, None)
@@ -910,6 +1014,67 @@ class Session:
         if pc is not None:
             pc.set_budget(budget)
 
+    def _int_sysvar(self, name: str, value: str, lo: int = 0) -> int:
+        try:
+            n = int(value.strip())
+        except ValueError:
+            raise errors.ExecError(
+                f"{name} must be an integer, got {value!r}")
+        if n < lo:
+            raise errors.ExecError(f"{name} must be >= {lo}")
+        return n
+
+    def apply_stmt_summary(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_stmt_summary = 0|1 — the statement-digest
+        summary kill switch. Off clears the summary (current + history)
+        and skips the whole digest pipeline per statement; on starts a
+        fresh window."""
+        from tidb_tpu import perfschema
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        if value.strip().lower() not in ("0", "1", "on", "off", "true",
+                                         "false"):
+            raise errors.ExecError(
+                f"tidb_tpu_stmt_summary must be 0 or 1, got {value!r}")
+        self._require_global_grant("tidb_tpu_stmt_summary")
+        perfschema.perf_for(self.store).digest_summary.set_enabled(
+            parse_bool_sysvar(value))
+
+    def apply_stmt_summary_max_digests(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_stmt_summary_max_digests = N — the
+        summary's per-window entry cap (shrink evicts immediately; every
+        eviction is counted in events_statements_summary_evicted)."""
+        n = self._int_sysvar("tidb_tpu_stmt_summary_max_digests", value, 1)
+        self._require_global_grant("tidb_tpu_stmt_summary_max_digests")
+        from tidb_tpu import perfschema
+        perfschema.perf_for(self.store).digest_summary.set_max_digests(n)
+
+    def apply_stmt_summary_refresh_interval(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_stmt_summary_refresh_interval = seconds —
+        the summary window length (TOP-SQL's time-bucket width)."""
+        n = self._int_sysvar("tidb_tpu_stmt_summary_refresh_interval",
+                             value, 1)
+        self._require_global_grant("tidb_tpu_stmt_summary_refresh_interval")
+        from tidb_tpu import perfschema
+        perfschema.perf_for(self.store).digest_summary \
+            .set_refresh_interval(float(n))
+
+    def apply_stmt_summary_history_size(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_stmt_summary_history_size = N — rotated
+        windows kept in _history (a bounded ring)."""
+        n = self._int_sysvar("tidb_tpu_stmt_summary_history_size", value, 1)
+        self._require_global_grant("tidb_tpu_stmt_summary_history_size")
+        from tidb_tpu import perfschema
+        perfschema.perf_for(self.store).digest_summary.set_history_size(n)
+
+    def apply_perfschema_history_cap(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_perfschema_history_cap = N — the
+        events_statements_history ring size (long-running sessions must
+        not grow it without limit; a shrink keeps the newest events)."""
+        n = self._int_sysvar("tidb_tpu_perfschema_history_cap", value, 1)
+        self._require_global_grant("tidb_tpu_perfschema_history_cap")
+        from tidb_tpu import perfschema
+        perfschema.perf_for(self.store).set_history_cap(n)
+
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
         if self.store.uuid() not in _BOOTSTRAPPED_STORES:
@@ -937,7 +1102,8 @@ class _PreparedStmt:
     """One PREPAREd statement: parsed AST + param count + cached physical
     plan (executor/prepared.go Prepared)."""
 
-    __slots__ = ("stmt", "param_count", "text", "plan", "plan_key")
+    __slots__ = ("stmt", "param_count", "text", "plan", "plan_key",
+                 "digest_pair")
 
     def __init__(self, stmt, param_count: int, text: str):
         self.stmt = stmt
@@ -945,6 +1111,7 @@ class _PreparedStmt:
         self.text = text
         self.plan = None
         self.plan_key = None
+        self.digest_pair: tuple[str, str] | None = None  # lazy, once
 
 
 class _MetricHandles:
@@ -1117,6 +1284,10 @@ def bootstrap(session: Session) -> None:
                         pc.set_budget(max(0, int(b.strip())))
                 except ValueError:
                     pass
+            # digest-summary / history-ring knobs live on the per-store
+            # PerfSchema — hydrate them like the plane cache's
+            from tidb_tpu import perfschema
+            perfschema.apply_sysvars(session.store, gv.values)
             return
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,

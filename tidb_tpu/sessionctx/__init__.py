@@ -80,6 +80,18 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # tree for EVERY statement (slow-log detail gets the span summary);
     # 0 (default) builds spans only under EXPLAIN ANALYZE / TRACE
     "tidb_trace_enabled": "0",
+    # statement-digest summary (perfschema
+    # events_statements_summary_by_digest + TOP-SQL): kill switch, the
+    # per-window digest cap (evictions counted in _summary_evicted), the
+    # window length in seconds (TOP-SQL's time-bucket width), and how
+    # many rotated windows the _history ring keeps. GLOBAL-only,
+    # store-level, hydrated on restart like the plane-cache knobs.
+    "tidb_tpu_stmt_summary": "1",
+    "tidb_tpu_stmt_summary_max_digests": "512",
+    "tidb_tpu_stmt_summary_refresh_interval": "1800",
+    "tidb_tpu_stmt_summary_history_size": "24",
+    # events_statements_history ring size (bounded; GLOBAL-only)
+    "tidb_tpu_perfschema_history_cap": "1024",
     "tidb_copr_batch_rows": "1048576",
 }
 

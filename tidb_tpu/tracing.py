@@ -222,7 +222,8 @@ class trace:
 # the counter keys every consumer renders, in display order (plane-cache
 # tallies arrive from distsql's per-partial attribution of the region
 # responses; see copr.plane_cache)
-COUNTER_KEYS = ("kernel_dispatches", "readbacks", "readback_bytes",
+COUNTER_KEYS = ("kernel_dispatches", "kernel_dispatch_us",
+                "readbacks", "readback_bytes",
                 "jit_hits", "jit_misses",
                 "plane_cache_hits", "plane_cache_misses",
                 "plane_cache_evictions", "plane_cache_invalidations_epoch",
@@ -257,15 +258,22 @@ def counters_delta(before: dict) -> dict:
 
 
 def record_dispatch(dispatches: int = 1, readbacks: int = 1,
-                    readback_bytes: int = 0) -> None:
+                    readback_bytes: int = 0,
+                    dispatch_us: float = 0.0) -> None:
     """THE device-dispatch tally: per-thread statement counters + the
     ops.* process metrics, in one place so the slow-log, perfschema and
     /metrics surfaces can never drift apart. Called by every kernel
     dispatch site (TpuClient._dispatch_kernel, the join kernels, the
-    region-partial combine)."""
+    region-partial combine). `dispatch_us` is the host-observed device
+    time of the dispatch (µs, tallied integral) — the statement summary
+    rolls it up per digest and TOP-SQL ranks on it."""
     from tidb_tpu import metrics
     count("kernel_dispatches", dispatches)
     metrics.counter("ops.kernel_dispatches").inc(dispatches)
+    if dispatch_us:
+        us = int(dispatch_us)
+        count("kernel_dispatch_us", us)
+        metrics.counter("ops.kernel_dispatch_us").inc(us)
     if readbacks:
         count("readbacks", readbacks)
         count("readback_bytes", readback_bytes)
