@@ -691,6 +691,123 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
     }
 
 
+MESH_FANOUT_SQL = ("select f_g, count(*), sum(f_v), min(f_v), max(d_f) "
+                   "from mfan join mdim on f_k = d_k "
+                   "group by f_g order by f_g")
+
+
+def measure_mesh_fanout(n_rows: int, n_dim: int, n_regions: int,
+                        runs: int):
+    """The MESH execution regime over a real per-region fan-out: a
+    4-region cluster store answers the columnar channel per region, each
+    region's partials land on their home shard (region→shard placement
+    over the device mesh), and the grouped partial-aggregate states
+    combine via psum/pmin/pmax over ICI (ops.mesh.combine_rows_sharded)
+    instead of the host-side [R, G] stack. On a 1-device rig this runs
+    the same code path over a 1-shard mesh; on the 8-device dryrun the
+    combine crosses real shard boundaries. Asserts zero columnar
+    fallbacks and ≥1 mesh combine per timed run; parity is checked
+    against the mesh-off (single-device combine) regime AND the row
+    protocol."""
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.executor import fused_agg
+    from tidb_tpu.ops import mesh as mesh_mod
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    store = new_store(f"cluster://3/benchmesh{n_rows}")
+    s = Session(store)
+    s.execute("create database mesh")
+    s.execute("use mesh")
+    s.execute("create table mfan (f_id bigint primary key, f_g bigint, "
+              "f_k bigint, f_v bigint)")
+    s.execute("create table mdim (d_k bigint primary key, d_f double)")
+    tbl = s.info_schema().table_by_name("mesh", "mfan")
+    rows = [[Datum.i64(i), Datum.i64(i % 24), Datum.i64(i % n_dim),
+             Datum.i64(i * 3)] for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    for start in range(0, n_dim, batch):
+        vals = ", ".join(f"({k}, {k % 89}.25)"
+                         for k in range(start, min(start + batch, n_dim)))
+        s.execute(f"insert into mdim values {vals}")
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+
+    fbs = metrics.counter("distsql.columnar_fallbacks")
+    sess = Session(store)
+    sess.execute("use mesh")
+    sess.execute(MESH_FANOUT_SQL)          # warm (pack + jit)
+    f0 = fbs.value
+    mc0 = fused_agg.stats["mesh_combines"]
+    t0 = time.time()
+    for _ in range(runs):
+        mesh_results = sess.execute(MESH_FANOUT_SQL)[0].values()
+    t_mesh = (time.time() - t0) / runs
+    d_fbs = fbs.value - f0
+    combines = fused_agg.stats["mesh_combines"] - mc0
+    assert d_fbs == 0, \
+        f"mesh fan-out run counted {d_fbs} columnar fallbacks"
+    assert combines >= runs, \
+        (f"only {combines} mesh combines across {runs} runs — the "
+         "partial combine did not ride the mesh")
+    mesh = mesh_mod.get_mesh()
+    shards = mesh.n if mesh is not None else 0
+
+    # collective time: one traced run, summed over its mesh_combine spans
+    doc = json.loads(sess.execute(
+        f"trace format='json' {MESH_FANOUT_SQL}")[0].values()[0][0])
+
+    def spans(d, name, out):
+        if d.get("name") == name:
+            out.append(d)
+        for c in d.get("children", ()):
+            spans(c, name, out)
+        return out
+
+    meshes = spans(doc, "mesh_combine", [])
+    collective_ms = sum(m.get("duration_us", 0.0) for m in meshes) / 1e3
+    transfer_bytes = sum(m.get("attrs", {}).get("transfer_bytes", 0)
+                         for m in meshes)
+
+    # parity regime 1: mesh off → the single-device combine answers
+    sess.execute("set global tidb_tpu_mesh = 0")
+    try:
+        sess.execute(MESH_FANOUT_SQL)      # warm the single-device jit
+        t0 = time.time()
+        for _ in range(runs):
+            single_results = sess.execute(MESH_FANOUT_SQL)[0].values()
+        t_single = (time.time() - t0) / runs
+    finally:
+        sess.execute("set global tidb_tpu_mesh = 1")
+    # parity regime 2: the row protocol
+    client = store.get_client()
+    client.columnar_scan = False
+    try:
+        row_results = sess.execute(MESH_FANOUT_SQL)[0].values()
+    finally:
+        client.columnar_scan = True
+    assert mesh_results == single_results, \
+        "mesh combine diverged from the single-device combine"
+    assert mesh_results == row_results, \
+        "mesh combine diverged from the row protocol"
+    return {
+        "mesh_fanout_rows_per_sec": round(n_rows / t_mesh, 1),
+        "mesh_fanout_vs_single_device": round(t_single / t_mesh, 2),
+        "mesh_shards": shards,
+        "mesh_combines": combines,
+        "mesh_collective_ms": round(collective_ms, 3),
+        "mesh_transfer_bytes": transfer_bytes,
+        "mesh_fanout_fallbacks": d_fbs,
+    }
+
+
 def workload_summary(store, sess, n_regions: int) -> dict:
     """Workload-observability figures off the fan-out store: the digest
     summary's view of the run just measured (every timed statement above
@@ -757,10 +874,15 @@ def trace_summary(sess, sql: str) -> dict:
 
     tasks = spans(doc, "region_task", [])
     kernels = spans(doc, "kernel", []) + \
-        spans(doc, "combine_region_partials", [])
+        spans(doc, "combine_region_partials", []) + \
+        spans(doc, "mesh_combine", [])
+    meshes = spans(doc, "mesh_combine", [])
     attrs = [t.get("attrs", {}) for t in tasks]
     kattrs = [k.get("attrs", {}) for k in kernels]
     return {
+        "trace_mesh_combines": len(meshes),
+        "trace_mesh_ms_total": round(
+            sum(m.get("duration_us", 0.0) for m in meshes) / 1e3, 3),
         "trace_copr_tasks": len(tasks),
         "trace_copr_task_ms_max": round(
             max((a.get("run_us", 0.0) for a in attrs), default=0.0) / 1e3,
@@ -969,6 +1091,7 @@ def main(smoke: bool = False):
     assert mesh_client.stats["tpu_requests"] > 0, "mesh engine never used"
     print(f"# q1_mesh ({len(jax.devices())} devices): {mesh_s:.4f}s/run "
           f"({n_rows / mesh_s:,.0f} rows/s)", file=sys.stderr)
+    q1_mesh_rps = round(n_rows / mesh_s, 1)
 
     jl, jr = (60_000, 10_000) if smoke else (1_000_000, 100_000)
     join_figs = measure_join(jl, jr)
@@ -1013,6 +1136,19 @@ def main(smoke: bool = False):
           f"warm ({fan_figs['region_fanout_repeat_speedup_vs_cold']:.2f}x "
           f"the cold re-pack regime), {fan_figs['plane_cache_hits']} "
           f"plane-cache hits", file=sys.stderr)
+    # mesh fan-out regime: region partials land on their home shards and
+    # the grouped partial-agg states combine over ICI (1-shard on a
+    # single-device rig — same code path, no collectives)
+    mr, md = (6_000, 500) if smoke else (120_000, 5_000)
+    mesh_figs = measure_mesh_fanout(mr, md, n_regions=4, runs=runs)
+    print(f"# mesh_fanout ({mr / 1000:.0f}k rows x 4 regions → "
+          f"{mesh_figs['mesh_shards']} shards): "
+          f"{mesh_figs['mesh_fanout_rows_per_sec']:,.0f} rows/s "
+          f"({mesh_figs['mesh_fanout_vs_single_device']:.2f}x the "
+          f"single-device combine), {mesh_figs['mesh_combines']} ICI "
+          f"combines, collective {mesh_figs['mesh_collective_ms']:.1f} ms"
+          f", {mesh_figs['mesh_transfer_bytes']} shard-fan-in bytes",
+          file=sys.stderr)
     print(f"# workload: {fan_figs['digest_entries']} digests "
           f"(fan-out query x{fan_figs['digest_fanout_exec_count']}, "
           f"{fan_figs['digest_fanout_device_ms']:.1f} ms device, "
@@ -1047,6 +1183,9 @@ def main(smoke: bool = False):
         **join_figs,
         **e2e_figs,
         **fan_figs,
+        "q1_mesh_rows_per_sec": q1_mesh_rps,
+        "mesh_devices": len(jax.devices()),
+        **mesh_figs,
         "smoke": smoke,
         # the honest CPU comparison: a vectorized-numpy engine over the
         # same packed planes (the Python xeval baseline above understates

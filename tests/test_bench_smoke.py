@@ -57,6 +57,21 @@ def test_bench_smoke_emits_valid_json():
     assert out["region_fanout_repeat_rows_per_sec"] > 0
     assert out["plane_cache_hits"] >= 4
     assert out["region_fanout_repeat_speedup_vs_cold"] > 0
+    # the mesh execution regime: q1 over the mesh client, and the
+    # 4-region fan-out whose partial-aggregate combine rides the mesh
+    # (1-shard on this rig — same code path, no collectives) with zero
+    # columnar fallbacks
+    assert out["q1_mesh_rows_per_sec"] > 0
+    assert out["mesh_devices"] >= 1
+    assert out["mesh_fanout_rows_per_sec"] > 0
+    assert out["mesh_shards"] >= 1
+    assert out["mesh_combines"] >= 1, \
+        "the fan-out partial combine never rode the mesh tier"
+    assert out["mesh_collective_ms"] >= 0
+    assert out["mesh_transfer_bytes"] > 0
+    assert out["mesh_fanout_fallbacks"] == 0
+    assert out["trace_mesh_combines"] >= 0
+    assert out["trace_mesh_ms_total"] >= 0
     # trace-derived kernel/copr instrumentation summary: present and
     # non-negative, so tier-1 guards the tracing layer itself
     assert out["trace_copr_tasks"] >= 4

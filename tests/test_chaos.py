@@ -270,9 +270,47 @@ def test_chaos_with_failpoints_active():
     root.execute(f"insert into acct values {rows}")
     root.execute("create table audit_log (id bigint primary key, who int)")
 
+    # mesh rung under chaos: a second cluster store (fan-out client — no
+    # TpuClient in front, so regions answer per-region columnar partials)
+    # runs the 4-region scan→join→agg shape whose partial-aggregate
+    # combine rides the device mesh; the seeded device/mesh_collective
+    # fault drives the mesh → single-device degradation mid-run with
+    # unchanged answers
+    from tidb_tpu import tablecodec as tc
+    fan_store = new_store(f"cluster://3/chaosmesh{next(_store_id)}")
+    fs = Session(fan_store)
+    fs.execute("create database m")
+    fs.execute("use m")
+    fs.execute("create table t (id bigint primary key, k bigint, "
+               "v bigint)")
+    fs.execute("insert into t values " + ", ".join(
+        f"({i}, {i % 5}, {i * 3})" for i in range(1, 161)))
+    fs.execute("create table fd (d_k bigint primary key)")
+    fs.execute("insert into fd values (0), (1), (2), (3), (4)")
+    fan_tid = fs.info_schema().table_by_name("m", "t").info.id
+    fan_store.cluster.split_keys(
+        [tc.encode_row_key(fan_tid, 40 * i + 1) for i in range(1, 4)])
+    FAN_Q = ("select count(*), sum(t.v), min(t.v), max(t.k) "
+             "from t join fd on t.k = fd.d_k")
+    fan_want = fs.execute(FAN_Q)[0].values()
+    fan_diverged: list = []
+
     stop = threading.Event()
     torn: list = []
     failures: list = []
+
+    def mesh_reader():
+        s = _session(fan_store, db=False)
+        s.execute("use m")
+        for _ in range(12):
+            if stop.is_set():
+                return
+            try:
+                got = s.execute(FAN_Q)[0].values()
+                if got != fan_want:
+                    fan_diverged.append(got)
+            except errors.TiDBError as e:
+                failures.append(("mesh_read", str(e)))
 
     def transfer_worker(seed):
         s = _session(store)
@@ -342,10 +380,13 @@ def test_chaos_with_failpoints_active():
     failpoint.enable("rpc/timeout", when=("prob", 0.01), seed=12)
     failpoint.enable("copr/region_timeout", when=("prob", 0.05), seed=13)
     failpoint.enable("device/oom", when=("prob", 0.10), seed=14)
+    failpoint.enable("device/mesh_collective", when=("prob", 0.30),
+                     seed=15)
     threads = ([threading.Thread(target=transfer_worker, args=(i,))
                 for i in range(2)]
                + [threading.Thread(target=insert_worker, args=(1,))]
-               + [threading.Thread(target=tpu_reader)])
+               + [threading.Thread(target=tpu_reader)]
+               + [threading.Thread(target=mesh_reader)])
     evals = {}
     try:
         for t in threads:
@@ -360,12 +401,15 @@ def test_chaos_with_failpoints_active():
         # snapshot BEFORE disable_all: counters read zeros once disabled
         evals = {name: failpoint.counters(name)["evals"]
                  for name in ("rpc/server_busy", "copr/region_timeout",
-                              "device/oom")}
+                              "device/oom", "device/mesh_collective")}
         failpoint.disable_all()
         kvbackoff.reset_test_hooks()
     assert not wedged, f"workers wedged under failpoints: {wedged}"
     assert not failures, failures[:5]
     assert not torn, f"readers saw torn transfers: {torn[:5]}"
+    assert not fan_diverged, \
+        f"mesh reader diverged under mesh-collective faults: " \
+        f"{fan_diverged[:3]}"
     # the schedule really ran: each fault class was evaluated at its seam
     # (probabilistic firing may legitimately be 0 for a short run, but a
     # never-EVALUATED site means the injection seam regressed)

@@ -39,7 +39,7 @@ QUERIES = [
 ]
 
 DEGRADED_KINDS = ("device_to_cpu", "join_to_numpy", "combine_to_host",
-                  "region_to_rows")
+                  "region_to_rows", "mesh")
 
 
 @pytest.fixture(autouse=True)
@@ -294,6 +294,10 @@ def test_chaos_schedule_parity_4_region():
         "cache/no_admit": {"action": "return", "value": True,
                            "when": ("first", 2)},
         "device/join": {"when": ("first", 1)},
+        # the ICI collective fault drives the mesh → single-device rung,
+        # which is ALSO what lets device/combine (the next rung down) be
+        # reached now that the mesh tier answers multi-region combines
+        "device/mesh_collective": {"when": ("first", 3)},
         "device/combine": {"when": ("first", 1)},
     }
     # drop the warmed plane cache so the faulted runs exercise the pack
@@ -315,6 +319,8 @@ def test_chaos_schedule_parity_4_region():
         "device join fault did not account a join_to_numpy fallback"
     assert d1["combine_to_host"] > d0["combine_to_host"], \
         "combine fault did not account a combine_to_host fallback"
+    assert d1["mesh"] > d0["mesh"], \
+        "mesh collective fault did not account a copr.degraded_mesh"
     assert d1["region_to_rows"] > d0["region_to_rows"], \
         "region pack/drop faults did not account region_to_rows fallbacks"
     # clean after disable: parity again, no further degradation

@@ -1,0 +1,421 @@
+"""Differential parity for the MESH execution tier (ops.mesh): the
+4-region scan→join→agg fan-out whose region partials land on their home
+shards (region-id-hash placement over the device mesh) and whose grouped
+partial-aggregate states combine via psum/pmin/pmax over ICI must be
+row-for-row identical to the single-device combine AND the row protocol
+— over a 1-shard and a multi-shard mesh, through mid-scan split/merge
+re-placement, with float-SUM exact sequential rounding kept on host, and
+under mesh-collective faults degrading to the single-device combine with
+unchanged answers. The sharded join probe and the [R, G] state combine
+are parity-checked against their single-device twins directly.
+
+The test process spans 8 virtual CPU devices (conftest sets
+xla_force_host_platform_device_count), so the multi-shard regimes cross
+REAL shard boundaries with real collectives.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+from tidb_tpu import errors, failpoint, metrics, tablecodec as tc
+from tidb_tpu.executor import fused_agg
+from tidb_tpu.ops import mesh as mesh_mod
+from tidb_tpu.session import Session, new_store
+
+# commit the process to the TPU tier so DistCoprClient.mesh (sys.modules
+# gate) answers the executor's mesh probes, as a real TPU deployment would
+import tidb_tpu.ops.client  # noqa: F401
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+JOIN_AGG_Q = ("select count(*), sum(t.v), min(t.v), max(d.d_f), "
+              "avg(t.v), sum(t.f) from t join d on t.k = d.d_k")
+GROUPED_Q = ("select t.k, count(*), sum(t.v), min(t.f), max(t.v) "
+             "from t join d on t.k = d.d_k group by t.k order by t.k")
+# float sums above a JOIN: the fused aggregate answers from planes (a
+# bare-scan group-by pushes the aggregate down the row protocol — the
+# standing fallback, where re-segmentation legitimately re-orders float
+# partial merges), and the host accumulator keeps row order exactly
+FLOAT_SUM_Q = ("select t.k, count(*), sum(t.f), avg(t.f) "
+               "from t join d on t.k = d.d_k group by t.k order by t.k")
+QUERIES = [
+    JOIN_AGG_Q,
+    GROUPED_Q,
+    FLOAT_SUM_Q,
+    "select count(*), sum(v), min(v), max(v) from t",
+    "select count(*), sum(v) from t join d on t.k = d.d_k "
+    "where t.v > 500",
+]
+
+
+def _mesh(n_shards: int):
+    from tidb_tpu.parallel import CoprMesh
+    return CoprMesh(n_devices=n_shards)
+
+
+@pytest.fixture(autouse=True)
+def _mesh_tier_reset():
+    """Every test starts from the lazy default mesh with the tier on,
+    and cannot leak an explicit mesh, a disabled tier, or a failpoint."""
+    mesh_mod.set_mesh(None)
+    mesh_mod.set_enabled(True)
+    yield
+    failpoint.disable_all()
+    mesh_mod.set_mesh(None)
+    mesh_mod.set_enabled(True)
+
+
+def _build(n_regions: int = 4) -> Session:
+    store = new_store(f"cluster://3/meshexec{next(_id)}")
+    s = Session(store)
+    s.execute("create database me")
+    s.execute("use me")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double)")
+    # f = i/10: not binary-representable, so float-SUM answers are
+    # sensitive to accumulation ORDER — the sequential-rounding probe
+    rows = ", ".join(
+        f"({i}, {i % 7}, {i * 10}, {i / 10!r})" if i % 11 else
+        f"({i}, null, {i * 10}, null)"
+        for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create table d (d_k bigint primary key, d_f double)")
+    s.execute("insert into d values " +
+              ", ".join(f"({i}, {i}.5)" for i in range(7)))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("me", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _row_protocol(s: Session, queries=QUERIES) -> list:
+    client = s.store.get_client()
+    client.columnar_scan = False
+    try:
+        return [s.execute(q)[0].values() for q in queries]
+    finally:
+        client.columnar_scan = True
+
+
+# ---------------------------------------------------------------------------
+# region → shard placement
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_deterministic_and_spread(self):
+        """The shard is a pure hash of the region id: identical across
+        placement instances (a restarted process re-derives the same
+        map), and spread over every shard for realistic region counts."""
+        a = mesh_mod.RegionPlacement(8)
+        b = mesh_mod.RegionPlacement(8)
+        ids = list(range(1, 257))
+        assert a.shard_of(ids) == b.shard_of(ids)
+        assert set(a.shard_of(ids)) == set(range(8)), \
+            "256 regions left some shard empty"
+
+    def test_stable_under_neighbor_churn(self):
+        """A surviving region NEVER moves when other regions split or
+        merge away — its shard depends on nothing but its own id."""
+        pl = mesh_mod.RegionPlacement(8)
+        home = pl.place(42)
+        for rid in range(1000, 1100):     # neighbors come and go
+            pl.place(rid)
+        assert pl.place(42) == home
+
+    def test_epoch_bump_replaces_deterministically(self):
+        """An epoch bump (split/merge bumps the region version)
+        re-places the region — counted — onto the same hash-derived
+        shard, so mid-scan topology changes never strand partials."""
+        pl = mesh_mod.RegionPlacement(8)
+        home = pl.place(7, epoch=(1, 1))
+        assert pl.replacements == 0
+        again = pl.place(7, epoch=(2, 1))
+        assert again == home
+        assert pl.replacements == 1
+        assert pl.place(7, epoch=(2, 1)) == home
+        assert pl.replacements == 1       # same epoch: no re-place
+
+
+# ---------------------------------------------------------------------------
+# the core differential suite: 1-shard and multi-shard mesh vs the
+# single-device combine vs the row protocol
+# ---------------------------------------------------------------------------
+
+class TestMeshParity:
+    @pytest.mark.parametrize("n_shards", [1, 8])
+    def test_fanout_parity(self, n_shards):
+        """4-region scan→join→agg over an n-shard mesh: every query
+        matches the single-device combine and the row protocol
+        row-for-row, and the combine really rode the mesh tier."""
+        s = _build(4)
+        mesh_mod.set_mesh(_mesh(n_shards))
+        want_row = _row_protocol(s)
+
+        mc0 = fused_agg.stats["mesh_combines"]
+        got_mesh = [s.execute(q)[0].values() for q in QUERIES]
+        assert fused_agg.stats["mesh_combines"] > mc0, \
+            "no fusion combined over the mesh tier"
+        assert fused_agg.stats["last_mesh_shards"] == n_shards
+
+        # mesh off: the single-device combine (degradation rung 2)
+        s.execute("set global tidb_tpu_mesh = 0")
+        try:
+            mc1 = fused_agg.stats["mesh_combines"]
+            got_single = [s.execute(q)[0].values() for q in QUERIES]
+            assert fused_agg.stats["mesh_combines"] == mc1, \
+                "mesh combines counted while the tier was off"
+        finally:
+            s.execute("set global tidb_tpu_mesh = 1")
+
+        for q, m, sd, r in zip(QUERIES, got_mesh, got_single, want_row):
+            assert m == sd, \
+                f"{n_shards}-shard mesh diverged from single-device " \
+                f"combine on {q!r}"
+            assert m == r, \
+                f"{n_shards}-shard mesh diverged from row protocol " \
+                f"on {q!r}"
+
+    def test_float_sum_sequential_rounding_on_host(self):
+        """Float SUM/AVG never enter the mesh combine: they keep the
+        sequential host accumulator, so the answer is BIT-identical to
+        the row protocol's left-to-right accumulation — while the count
+        states of the same fusion still combine over the mesh."""
+        s = _build(4)
+        mesh_mod.set_mesh(_mesh(8))
+        mc0 = fused_agg.stats["mesh_combines"]
+        got = s.execute(FLOAT_SUM_Q)[0].values()
+        assert fused_agg.stats["mesh_combines"] > mc0
+        want = _row_protocol(s, [FLOAT_SUM_Q])[0]
+        assert got == want, \
+            "mesh-tier float SUM diverged from sequential rounding"
+        # the probe is real: for at least one group, accumulating i/10 in
+        # a different order genuinely rounds differently — so the parity
+        # above could only hold because the accumulation ORDER matched
+        def acc(xs):
+            t = 0.0
+            for x in xs:
+                t += x
+            return t
+
+        groups: dict[int, list[float]] = {}
+        for i in range(1, N_ROWS + 1):
+            if i % 11:
+                groups.setdefault(i % 7, []).append(i / 10)
+        assert any(acc(v) != acc(v[::-1]) for v in groups.values()), \
+            "float data is order-insensitive — the probe proves nothing"
+
+    def test_exact_i64_min_survives_max(self):
+        """max() over a group holding exactly -2^63 answers -2^63 on the
+        mesh rung (regression: the max monoid identity was I64_MIN + 1,
+        off by one for this value on every combine path)."""
+        store = new_store(f"cluster://3/meshexec{next(_id)}")
+        s = Session(store)
+        s.execute("create database mn")
+        s.execute("use mn")
+        s.execute("create table t (id bigint primary key, k bigint, "
+                  "v bigint)")
+        lo = -(1 << 63)
+        # group 1 holds ONLY the int64 minimum: its max IS the identity
+        s.execute("insert into t values " + ", ".join(
+            f"({i}, {i % 2}, {lo if i % 2 else i})"
+            for i in range(1, 41)))
+        s.execute("create table d (d_k bigint primary key)")
+        s.execute("insert into d values (0), (1)")
+        tid = s.info_schema().table_by_name("mn", "t").info.id
+        store.cluster.split_keys(
+            [tc.encode_row_key(tid, 10 * i + 1) for i in range(1, 4)])
+        mesh_mod.set_mesh(_mesh(8))
+        q = ("select t.k, count(*), max(t.v), min(t.v) from t "
+             "join d on t.k = d.d_k group by t.k order by t.k")
+        mc0 = fused_agg.stats["mesh_combines"]
+        got = s.execute(q)[0].values()
+        assert fused_agg.stats["mesh_combines"] > mc0
+        assert got == _row_protocol(s, [q])[0]
+        assert [r for r in got if r[0] == 1][0][2] == lo, \
+            "max over an all--2^63 group rounded to the monoid identity"
+
+
+class TestTopologyChangesMidScan:
+    """Region split / merge DURING the mesh fan-out: the worklist
+    re-emits partials for the new region shape, the placement re-places
+    bumped epochs onto their deterministic shards, and answers never
+    change."""
+
+    def _with_mid_scan(self, mutate):
+        s = _build(4)
+        store = s.store
+        mesh_mod.set_mesh(_mesh(8))
+        want = _row_protocol(s)
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts):
+            state["n"] += 1
+            if state["n"] == 2 and not state["done"]:
+                state["done"] = True
+                mutate(store)
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        mc0 = fused_agg.stats["mesh_combines"]
+        try:
+            got = [s.execute(q)[0].values() for q in QUERIES]
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"], "topology mutation never fired"
+        assert fused_agg.stats["mesh_combines"] > mc0
+        for q, g, w in zip(QUERIES, got, want):
+            assert g == w, f"mid-scan topology change diverged on {q!r}"
+        # post-mutation steady state: re-placed regions, same answers
+        after = [s.execute(q)[0].values() for q in QUERIES]
+        for q, a, w in zip(QUERIES, after, want):
+            assert a == w, f"post-mutation steady state diverged on {q!r}"
+        pl = mesh_mod.placement_for(mesh_mod.get_mesh())
+        assert pl.placements > 0, "no region was ever placed on a shard"
+
+    def test_split_mid_scan(self):
+        def split(store):
+            s = Session(store)
+            tid = s.info_schema().table_by_name("me", "t").info.id
+            store.cluster.split_keys([tc.encode_row_key(tid, 31),
+                                      tc.encode_row_key(tid, 171)])
+
+        self._with_mid_scan(split)
+
+    def test_merge_mid_scan(self):
+        def merge(store):
+            regions = store.cluster.regions
+            for i in range(len(regions) - 1):
+                if regions[i].start:
+                    store.cluster.merge(regions[i].region_id,
+                                        regions[i + 1].region_id)
+                    return
+
+        self._with_mid_scan(merge)
+
+
+# ---------------------------------------------------------------------------
+# mesh-tier fault degradation (device/mesh_collective failpoint)
+# ---------------------------------------------------------------------------
+
+class TestMeshDegradation:
+    def test_collective_fault_degrades_to_single_device(self):
+        """An ICI collective fault degrades mesh → single-device combine
+        (counted on copr.degraded_mesh) with unchanged answers — never a
+        statement error; the tier resumes once the fault clears."""
+        s = _build(4)
+        mesh_mod.set_mesh(_mesh(8))
+        want = [s.execute(q)[0].values() for q in QUERIES]
+        deg = metrics.counter("copr.degraded_mesh")
+
+        failpoint.enable("device/mesh_collective")
+        try:
+            d0, mc0 = deg.value, fused_agg.stats["mesh_combines"]
+            pc0 = fused_agg.stats["partial_combines"]
+            got = [s.execute(q)[0].values() for q in QUERIES]
+            assert deg.value > d0, \
+                "mesh fault never accounted a copr.degraded_mesh"
+            assert fused_agg.stats["mesh_combines"] == mc0, \
+                "a faulted combine still counted as a mesh combine"
+            assert fused_agg.stats["partial_combines"] > pc0, \
+                "degradation skipped the single-device combine rung"
+        finally:
+            failpoint.disable_all()
+        for q, g, w in zip(QUERIES, got, want):
+            assert g == w, f"mesh degradation changed answers on {q!r}"
+        # fault cleared: combines ride the mesh again
+        mc1 = fused_agg.stats["mesh_combines"]
+        assert s.execute(JOIN_AGG_Q)[0].values() == want[0]
+        assert fused_agg.stats["mesh_combines"] > mc1
+
+    def test_kill_switch_is_global_only(self):
+        s = _build(1)
+        with pytest.raises(errors.TiDBError, match="GLOBAL"):
+            s.execute("set tidb_tpu_mesh = 0")
+        s.execute("set global tidb_tpu_mesh = 0")
+        try:
+            assert mesh_mod.get_mesh() is None
+        finally:
+            s.execute("set global tidb_tpu_mesh = 1")
+        assert mesh_mod.get_mesh() is not None
+
+
+# ---------------------------------------------------------------------------
+# the sharded kernels against their single-device twins, directly
+# ---------------------------------------------------------------------------
+
+class TestShardedKernelParity:
+    def test_join_probe_sharded_matches_single_device(self):
+        """The mesh-sharded probe (build replicated, probe rows sharded,
+        one merged packed readback) emits the SAME (l_idx, r_idx) pairs
+        in the same order as the single-device probe — including rows
+        with multiple matches and the capacity-escalation retry."""
+        from tidb_tpu.ops import kernels
+        rng = np.random.RandomState(11)
+        lkey = rng.randint(0, 40, size=1000).astype(np.int64)
+        lvalid = rng.rand(1000) > 0.1
+        rkey = rng.randint(0, 40, size=300).astype(np.int64)
+        rvalid = rng.rand(300) > 0.1
+        li0, ri0 = kernels.join_match_pairs(lkey, lvalid, rkey, rvalid)
+        li1, ri1 = kernels.join_match_pairs(lkey, lvalid, rkey, rvalid,
+                                            mesh=_mesh(8))
+        assert np.array_equal(li0, li1)
+        assert np.array_equal(ri0, ri1)
+
+    def test_join_probe_rides_mesh_end_to_end(self):
+        """With the dispatch floor at 0, a cluster-store join routes to
+        the SHARDED probe (spy on ops.mesh.join_probe_sharded) and the
+        answers match the row protocol."""
+        s = _build(4)
+        mesh_mod.set_mesh(_mesh(8))
+        want = _row_protocol(s)
+        seen = {"n": 0}
+        orig = mesh_mod.join_probe_sharded
+
+        def spy(*a, **kw):
+            seen["n"] += 1
+            return orig(*a, **kw)
+
+        s.execute("set global tidb_tpu_dispatch_floor = 0")
+        mesh_mod.join_probe_sharded = spy
+        try:
+            got = [s.execute(q)[0].values() for q in QUERIES]
+        finally:
+            mesh_mod.join_probe_sharded = orig
+            s.execute("set global tidb_tpu_dispatch_floor = 16384")
+        assert seen["n"] > 0, "no join ever took the sharded probe"
+        for q, g, w in zip(QUERIES, got, want):
+            assert g == w, f"sharded probe diverged on {q!r}"
+
+    def test_state_combine_matches_single_device(self):
+        """combine_states_sharded ([R, G] states placed onto shards,
+        reduced locally, merged over ICI) is bit-identical to the
+        single-device combine_region_partials — the MULTICHIP dryrun
+        contract, held on tier-1 too."""
+        from tidb_tpu.ops import kernels
+        rng = np.random.RandomState(5)
+        R, G = 9, 13
+        states = [
+            rng.randint(0, 1 << 30, size=(R, G)).astype(np.int64),
+            rng.randint(-(1 << 50), 1 << 50, size=(R, G)).astype(np.int64),
+            rng.rand(R, G) * 1e6 - 5e5,
+            rng.randint(-(1 << 31), 1 << 31, size=(R, G)).astype(np.int64),
+        ]
+        ops = ["sum", "min", "min", "max"]
+        want = kernels.combine_region_partials(states, ops)
+        for n_shards in (1, 8):
+            got = mesh_mod.combine_states_sharded(states, ops,
+                                                  _mesh(n_shards))
+            for i, (g, w) in enumerate(zip(got, want)):
+                assert np.array_equal(np.asarray(g), np.asarray(w)), \
+                    f"{n_shards}-shard state combine diverged on " \
+                    f"state {i}"

@@ -504,11 +504,12 @@ class TestDeviceResidentReuse:
         seen = {"device_keys": False}
         orig = kernels.join_match_pairs
 
-        def spy(lkey, lvalid, rkey, rvalid, stats=None, device_keys=None):
+        def spy(lkey, lvalid, rkey, rvalid, stats=None, device_keys=None,
+                **kw):
             if device_keys is not None:
                 seen["device_keys"] = True
             return orig(lkey, lvalid, rkey, rvalid, stats=stats,
-                        device_keys=device_keys)
+                        device_keys=device_keys, **kw)
 
         kernels.join_match_pairs = spy
         try:

@@ -115,15 +115,18 @@ class TestExplainAnalyze:
         assert ea_hits == dh == 5
         assert ea_fbs == df == 0
         # device-kernel attribution: the fused aggregate merged the
-        # per-region partial states in one combine with one readback
+        # per-region partial states over the MESH (per-shard partial agg
+        # + ICI collectives) in one combine with one packed readback
         agg = next(r for r in rows if "HashAgg" in str(r[0]))
         agg_info = str(agg[4])
         assert "fused:true" in agg_info
         assert "combine_regions:4" in agg_info
-        assert "combine_readbacks:1" in agg_info
-        assert "combine_readback_bytes:" in agg_info
-        rb = int(agg_info.split("combine_readback_bytes:")[1].split(" ")[0])
+        assert "mesh_shards:" in agg_info, agg_info
+        assert "mesh_combines:1" in agg_info, agg_info
+        assert "mesh_transfer_bytes:" in agg_info
+        rb = int(agg_info.split("mesh_readback_bytes:")[1].split(" ")[0])
         assert rb > 0
+        assert "psum" in agg_info.split("mesh_collectives:[")[1]
 
     def test_split_mid_scan_shows_retries(self):
         """A region split injected mid-scan surfaces as stale-epoch
@@ -201,12 +204,21 @@ class TestTraceJson:
             assert a["segments"] >= 1
             assert "complete_seq" in a
         assert t_rows == N_ROWS + 7
-        # the device combine ran with one packed readback
-        combines = _spans(doc, "combine_region_partials")
+        # the mesh combine ran with one packed readback: per-shard
+        # partial agg over the placed regions + collectives over ICI
+        combines = _spans(doc, "mesh_combine")
         assert len(combines) == 1
         ca = combines[0]["attrs"]
         assert ca["regions"] == 4
+        assert ca["shards"] >= 1
         assert ca["readbacks"] == 1 and ca["readback_bytes"] > 0
+        assert ca["transfer_bytes"] > 0
+        assert "psum" in ca["collectives"]
+        shards = _spans(combines[0], "mesh_shard")
+        assert len(shards) == ca["shards"]
+        placed = [rid for sh in shards for rid in sh["attrs"]["regions"]]
+        assert len(placed) == 4   # every region placed on exactly one shard
+        assert sum(sh["attrs"]["rows"] for sh in shards) > 0
         # operators subtree mirrors the executor tree
         ops = doc["operators"]
         assert ops["operator"] == "Projection"

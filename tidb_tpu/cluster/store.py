@@ -141,6 +141,24 @@ class DistCoprClient(kv.Client):
         self.dispatch_floor_rows = store_int_sysvar(
             store, "tidb_tpu_dispatch_floor")
 
+    @property
+    def mesh(self):
+        """The process device mesh for executor-layer sharded kernels
+        (mesh join probe, fused-aggregate ICI combine): present only
+        when the TPU tier is already live in this process (sys.modules
+        gate — a jax-free cluster deployment never imports jax to
+        answer this) and the mesh tier is on (SET GLOBAL
+        tidb_tpu_mesh). A 1-device rig answers a 1-shard mesh — the
+        same code path, no collectives."""
+        import sys
+        if "tidb_tpu.ops.client" not in sys.modules:
+            return None
+        try:
+            from tidb_tpu.ops import mesh as mesh_mod
+        except ImportError:   # retryable-ok: routing probe, not a retry
+            return None
+        return mesh_mod.get_mesh()
+
     def support_request_type(self, req_type: int, sub_type) -> bool:
         if req_type not in (kv.REQ_TYPE_SELECT, kv.REQ_TYPE_INDEX):
             return False

@@ -22,6 +22,8 @@ decimals): per-region fallback, counted per PARTIAL by the client.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from tidb_tpu import errors, failpoint
@@ -147,7 +149,75 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
     # per-response attribution: the client rolls these into the
     # statement thread's monotonic tallies (slow-log / perfschema)
     res.cache_info = cache_info
+    if region is not None:
+        # origin (region id, epoch): the mesh tier's region→shard
+        # placement key (ops.mesh.RegionPlacement) — epoch bumps
+        # (split/merge) re-place the region
+        res.region_id = region[0]
+        res.region_epoch = region[1]
     return SelectResponse(columnar=res)
+
+
+# cross-statement cache of compiled region filters (PR 5 residual):
+# keyed by the EXPRESSION SHAPE + per-column lowering signature, never by
+# the statement — the same WHERE clause re-issued by a later statement
+# (dashboards, prepared re-execution, repeat fan-outs) skips the exprc
+# re-lower on every region. jit_hits/jit_misses count across statements
+# through tracing.record_jit_cache (ops.jit_cache_* metrics).
+_filter_cache: dict = {}
+_filter_lock = threading.Lock()
+
+
+def _where_cids(e, out: set) -> None:
+    if e.tp == ExprType.COLUMN_REF:
+        out.add(e.val)
+    for c in e.children or ():
+        _where_cids(c, out)
+
+
+def _compiled_filter(sel: SelectRequest, batch: col.ColumnBatch):
+    """Compile (or reuse) the pushed where-filter for this batch.
+
+    Reuse is sound only when every lowering input matches: the Expr tree
+    itself (repr — constants are baked into the closures), and each
+    referenced column's (kind, MySQL type, fixed-point scale, max-abs
+    overflow bound, dictionary identity). Dictionaries pin in the cache
+    entry so their ids cannot be recycled while the entry lives — a
+    plane-cache hit serves the SAME batch object, so repeat statements
+    over cached regions reuse string-filter lowerings too; numeric-only
+    filters reuse across fresh packs as long as the guard bounds agree."""
+    from tidb_tpu import tracing
+    from tidb_tpu.ops.exprc import compile_expr
+    cids: set = set()
+    _where_cids(sel.where, cids)
+    sig = []
+    dicts = []
+    for cid in sorted(cids):
+        cd = batch.columns.get(cid)
+        if cd is None:
+            sig.append((cid, None))
+            continue
+        dict_key = None
+        if cd.dictionary is not None:
+            dict_key = id(cd.dictionary)
+            dicts.append(cd.dictionary)
+        sig.append((cid, cd.kind, cd.tp, cd.dec_scale, cd.max_abs,
+                    dict_key))
+    key = (repr(sel.where), tuple(sig))
+    # fan-out worker threads share this cache: lookup/insert/evict under
+    # the lock (a concurrent duplicate compile is harmless; a dict
+    # mutated mid-eviction-iteration is not)
+    with _filter_lock:
+        ent = _filter_cache.get(key)
+    tracing.record_jit_cache(hit=ent is not None)
+    if ent is None:
+        compiled = compile_expr(sel.where, batch)
+        ent = (compiled, dicts)
+        with _filter_lock:
+            _filter_cache[key] = ent
+            while len(_filter_cache) > 512:
+                _filter_cache.pop(next(iter(_filter_cache)))
+    return ent[0]
 
 
 def _filter_mask(sel: SelectRequest, batch: col.ColumnBatch):
@@ -157,11 +227,11 @@ def _filter_mask(sel: SelectRequest, batch: col.ColumnBatch):
     if sel.where is None:
         return mask
     try:
-        from tidb_tpu.ops.exprc import Unsupported, compile_expr
+        from tidb_tpu.ops.exprc import Unsupported
     except ImportError:      # jax-free deployment: rows answer
         return None
     try:
-        compiled = compile_expr(sel.where, batch)
+        compiled = _compiled_filter(sel, batch)
     except (Unsupported, errors.TypeError_):
         return None
     planes = {cid: (cd.values, cd.valid)

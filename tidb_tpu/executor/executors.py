@@ -508,6 +508,16 @@ class HashJoinExec(Executor):
             return client.dispatch_floor_rows
         return None
 
+    def _join_mesh(self):
+        """The device mesh for the sharded join probe, read off the
+        store client (TpuClient's explicit mesh, or the cluster
+        DistCoprClient's process mesh) — None keeps the single-device
+        probe. The sys.modules gate in _device_join_floor has already
+        committed the process to jax by the time this is consulted."""
+        client = getattr(self.ctx, "client", None) \
+            if self.ctx is not None else None
+        return getattr(client, "mesh", None)
+
     def _try_vector_join(self) -> bool:
         """Drain both sides and join vectorized: device build/probe
         kernels at/above the dispatch floor, stable numpy argsort +
@@ -664,11 +674,15 @@ class HashJoinExec(Executor):
         scan sides keep even the SCAN rows unmaterialized."""
         from tidb_tpu.ops import kernels
         stats = self.join_stats
+        mesh = self._join_mesh()
         li, ri = kernels.join_match_pairs(lkey, lvalid, rkey, rvalid,
                                           stats=stats,
-                                          device_keys=device_keys)
+                                          device_keys=device_keys,
+                                          mesh=mesh)
         self._finish_pairs(lside, rside, li, ri, left_ok)
         stats["path"] = "device"
+        if mesh is not None and mesh.n > 1:
+            stats["mesh_shards"] = mesh.n
         if device_keys is not None:
             stats["device_resident_keys"] = True
 

@@ -44,61 +44,126 @@ _FUSABLE = ("count", "sum", "avg", "min", "max", "first_row")
 # process-wide fusion tallies (bench/tests introspection): "fused" counts
 # aggregates answered from planes, "fallback" counts row-loop bail-outs
 # that had a device join available, "partial_combines" counts fusions
-# whose per-region partial states merged device-side
+# whose per-region partial states merged device-side, "mesh_combines"
+# counts fusions whose partials combined over the device MESH (per-shard
+# partial agg + psum/pmin/pmax over ICI)
 stats = {"fused": 0, "fallback": 0, "partial_combines": 0,
-         "last_combine_regions": 0}
+         "last_combine_regions": 0, "mesh_combines": 0,
+         "last_mesh_shards": 0}
+
+I64_SENTINEL_MIN = I64_MAX        # "min" monoid identity (int planes)
+I64_SENTINEL_MAX = I64_MIN        # "max" monoid identity — EXACT min,
+#                                   so max over a group holding -2^63
+#                                   still answers -2^63 (the identity
+#                                   never leaks: empty groups NULL via
+#                                   their count state, not the sentinel)
 
 
 class _RegionCombine:
-    """Collects per-REGION partial aggregate states ([R, G] stacks) from
-    every aggregate of one fusion and merges them in ONE device dispatch
-    (ops.kernels.combine_region_partials — count/sum → psum-shaped sum,
-    min/max/first-row-position → pmin/pmax over the region axis) with a
-    single packed readback for the whole result. The group-code space is
-    unified HOST-side before slicing (np.unique over the stacked group
-    planes), so per-region states are group-aligned by construction —
-    the same host-built-global-codes contract ColumnBatch.group_codes
-    keeps for the mesh."""
+    """Collects the per-region partial aggregate work of one fusion as
+    (op, values, contrib) ROW specs and merges it in ONE device dispatch
+    with one packed readback, through the first live rung of the combine
+    chain:
 
-    def __init__(self, slices: list[tuple[int, int]]):
+    1. MESH (ops.mesh.combine_rows_sharded): each region's result rows
+       land on their HOME SHARD (region→shard placement over the device
+       mesh), every shard computes its [G] partial states with the same
+       scatter-free segment reductions the device kernels use, and the
+       states merge over ICI with the monoid collectives (count/sum →
+       psum, min/first-row-position → pmin, max → pmax). The host-side
+       [R, G] state stack never exists on this path — the PR 5 residual.
+    2. single-device (ops.kernels.combine_region_partials): the [R, G]
+       stacks build host-side and reduce over the region axis in one
+       jitted kernel — the pre-mesh behavior, and the degradation target
+       when the mesh tier faults (counted on copr.degraded_mesh).
+    3. host: the SAME monoid reductions in numpy — exact (int
+       sums/counts are int64-exact, min/max order-free; float SUM/AVG
+       never enter the combine — they stay on the sequential host
+       accumulator), so answers cannot change down the whole chain.
+
+    The group-code space is unified HOST-side before any slicing
+    (np.unique over the stacked group planes), so per-region/per-shard
+    states are group-aligned by construction — the same
+    host-built-global-codes contract ColumnBatch.group_codes keeps for
+    the mesh kernels."""
+
+    def __init__(self, slices: list[tuple[int, int]], gid, G: int,
+                 mesh=None, region_ids=None, epochs=None):
         self.slices = slices
-        self._states: list = []
-        self._ops: list[str] = []
+        self.gid = gid
+        self.G = G
+        self.mesh = mesh
+        self.region_ids = region_ids
+        self.epochs = epochs
+        self._specs: list = []      # (op, vals|None, ok)
         self._results: list | None = None
+        # THIS combine's outcome (the process stats are cross-session:
+        # another statement's mesh combine must not label this one)
+        self.rode_mesh = False
 
-    def add(self, state_stack, op: str) -> int:
-        self._states.append(state_stack)
-        self._ops.append(op)
-        return len(self._states) - 1
+    def add(self, op: str, vals, ok) -> int:
+        """Register one partial state: op ∈ {"sum","min","max"}, `vals`
+        a host int64/float64 row plane (None → int64 ones: a count),
+        `ok` the contribution mask. Returns the result index."""
+        self._specs.append((op, vals, ok))
+        return len(self._specs) - 1
 
-    def stack(self, G: int, init, dtype, fill) -> "object":
-        """[R, G] state stack initialized to the monoid identity; fill(
-        row, s, e) populates one region's partial state."""
-        out = np.full((len(self.slices), G), init, dtype)
-        for r, (s, e) in enumerate(self.slices):
-            fill(out[r], s, e)
+    def _build_states(self) -> list:
+        """[R, G] stacks for the single-device/host rungs."""
+        out = []
+        gid, G = self.gid, self.G
+        for op, vals, ok in self._specs:
+            if vals is None:
+                vals = np.ones(len(gid), dtype=np.int64)
+            if op == "sum":
+                init: object = 0
+                fill = np.add.at
+            elif op == "min":
+                init = I64_SENTINEL_MIN if vals.dtype == np.int64 \
+                    else np.inf
+                fill = np.minimum.at
+            else:
+                init = I64_SENTINEL_MAX if vals.dtype == np.int64 \
+                    else -np.inf
+                fill = np.maximum.at
+            state = np.full((len(self.slices), G), init, vals.dtype)
+            for r, (s, e) in enumerate(self.slices):
+                seg_ok = ok[s:e]
+                fill(state[r], gid[s:e][seg_ok], vals[s:e][seg_ok])
+            out.append(state)
         return out
 
     def run(self) -> None:
-        if not self._states:
+        if not self._specs:
             return
-        from tidb_tpu import errors
+        from tidb_tpu import errors, tracing
+        ops = [op for op, _v, _ok in self._specs]
+        if self.mesh is not None:
+            try:
+                from tidb_tpu.ops import mesh as mesh_mod
+                self._results = mesh_mod.combine_rows_sharded(
+                    self.mesh, self._specs, self.gid, self.G,
+                    self.slices, self.region_ids, self.epochs)
+                self.rode_mesh = True
+                stats["mesh_combines"] += 1
+                stats["last_mesh_shards"] = self.mesh.n
+                stats["partial_combines"] += 1
+                stats["last_combine_regions"] = len(self.slices)
+                return
+            except errors.DeviceError:
+                # mesh rung of the degradation chain: the single-device
+                # combine answers with the same monoid algebra
+                tracing.record_degraded("mesh")
+        states = self._build_states()
         from tidb_tpu.ops import kernels
         try:
-            self._results = kernels.combine_region_partials(self._states,
-                                                            self._ops)
+            self._results = kernels.combine_region_partials(states, ops)
         except errors.DeviceError:
-            # combine rung of the degradation chain: the SAME monoid
-            # reductions run host-side over the [R, G] stacks — exact
-            # (int sums/counts are int64-exact, min/max are order-free;
-            # float SUM/AVG never enter the combine — they stay on the
-            # sequential host accumulator) so answers cannot change
-            from tidb_tpu import tracing
             tracing.record_degraded("combine_to_host")
             reduce_ = {"sum": np.sum, "min": np.min, "max": np.max}
             self._results = [
                 np.atleast_1d(reduce_[op](s, axis=0))
-                for s, op in zip(self._states, self._ops)]
+                for s, op in zip(states, ops)]
         stats["partial_combines"] += 1
         stats["last_combine_regions"] = len(self.slices)
 
@@ -106,11 +171,14 @@ class _RegionCombine:
         return self._results[idx]
 
 
-def _region_combine_for(res) -> _RegionCombine | None:
+def _region_combine_for(res, gid, G: int) -> _RegionCombine | None:
     """A combine context when `res` is a multi-region columnar result
     (ColumnarPartialSet, or a DeviceJoinResult over one) and the device
     tier is importable; None → the flat single-batch path answers (same
-    values — the combinable aggregates are order-insensitive exactly)."""
+    values — the combinable aggregates are order-insensitive exactly).
+    With the mesh tier live (ops.mesh enabled + jax devices), the
+    context carries the mesh and the partials' (region id, epoch)
+    placement keys so the combine rides ICI."""
     get = getattr(res, "region_slices", None)
     if get is None:
         return None
@@ -121,7 +189,21 @@ def _region_combine_for(res) -> _RegionCombine | None:
         import jax  # noqa: F401 — device combine needs the TPU tier
     except ImportError:
         return None
-    return _RegionCombine(slices)
+    mesh = region_ids = epochs = None
+    try:
+        from tidb_tpu.ops import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+    except ImportError:
+        pass
+    if mesh is not None:
+        get_ids = getattr(res, "region_ids", None)
+        get_eps = getattr(res, "region_epochs", None)
+        region_ids = get_ids() if get_ids is not None else None
+        epochs = get_eps() if get_eps is not None else None
+        if region_ids is not None and len(region_ids) != len(slices):
+            region_ids = epochs = None   # re-split mid-fusion: positional
+    return _RegionCombine(slices, gid, G, mesh=mesh,
+                          region_ids=region_ids, epochs=epochs)
 
 
 def _is_ci(e) -> bool:
@@ -208,7 +290,7 @@ def _try_fused(agg):
         first_idx = np.zeros(1, dtype=np.int64)
         G = 1
 
-    combine = _region_combine_for(res)
+    combine = _region_combine_for(res, gid, G)
     cols = []
     for f in agg.agg_funcs:
         col_res = _fused_func(res, f, gid, G, first_idx, n, combine)
@@ -221,6 +303,8 @@ def _try_fused(agg):
         if combine is not None:
             sp.set("combine_regions", len(combine.slices))
             combine.run()   # ONE dispatch + readback merges every state
+            if combine.rode_mesh:
+                sp.set("mesh_shards", combine.mesh.n)
             cols = [c() if callable(c) else c for c in cols]
 
     emit = np.argsort(first_idx, kind="stable")
@@ -234,6 +318,8 @@ def _try_fused(agg):
     agg._fused_info = {"fused": True, "rows": n, "groups": G}
     if combine is not None:
         agg._fused_info["combine_regions"] = len(combine.slices)
+        if combine.rode_mesh:
+            agg._fused_info["mesh_shards"] = combine.mesh.n
     return [[c[g] for c in cols] for g in emit.tolist()]
 
 
@@ -304,12 +390,9 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int,
         # first_idx already holds the same number (np.unique over the
         # stacked planes), but the stacked host pass is exactly what a
         # real mesh won't have — keeping first_row on the combine is
-        # what lets the same algebra ride ICI unchanged later
-        pos = combine.stack(
-            G, I64_MAX, np.int64,
-            lambda row, s, e: np.minimum.at(
-                row, gid[s:e], np.arange(s, e, dtype=np.int64)))
-        idx = combine.add(pos, "min")
+        # what rides the same algebra over ICI on the mesh rung
+        idx = combine.add("min", np.arange(n, dtype=np.int64),
+                          np.ones(n, dtype=bool))
         return lambda: [res.datum_at(arg.index, int(combine.get(idx)[g]))
                         for g in range(G)]
 
@@ -325,11 +408,8 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int,
     def counts(ok):
         if combine is None:
             return np.bincount(gid[ok], minlength=G)
-        state = combine.stack(
-            G, 0, np.int64,
-            lambda row, s, e: np.add.at(
-                row, gid[s:e][ok[s:e]], 1))
-        return combine.add(state, "sum")   # psum over the region axis
+        # None values → int64 ones: a count, psum over the region axis
+        return combine.add("sum", None, ok)
 
     if name == "count":
         cnt = counts(valid)
@@ -352,11 +432,7 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int,
                     # partial sum, so the device combine cannot wrap)
             if combine is not None:
                 cnt_i = counts(ok)
-                sum_state = combine.stack(
-                    G, 0, np.int64,
-                    lambda row, s, e: np.add.at(
-                        row, gid[s:e][ok[s:e]], vals[s:e][ok[s:e]]))
-                sum_i = combine.add(sum_state, "sum")
+                sum_i = combine.add("sum", vals, ok)
                 return lambda: _sum_avg_datums(
                     name, "i64", combine.get(cnt_i), combine.get(sum_i),
                     G)
@@ -386,11 +462,7 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int,
         reduce_at = np.minimum.at if is_min else np.maximum.at
         if combine is not None:
             cnt_i = counts(ok)
-            red_state = combine.stack(
-                G, init, dtype,
-                lambda row, s, e: reduce_at(
-                    row, gid[s:e][ok[s:e]], vals[s:e][ok[s:e]]))
-            red_i = combine.add(red_state, "min" if is_min else "max")
+            red_i = combine.add("min" if is_min else "max", vals, ok)
             return lambda: _minmax_datums(kind, combine.get(cnt_i),
                                           combine.get(red_i), G)
         cnt = np.bincount(gid[ok], minlength=G)

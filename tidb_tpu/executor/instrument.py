@@ -197,6 +197,8 @@ def _node_info(node, root_span) -> str:
         fb = "fused:true"
         if fi.get("combine_regions"):
             fb += f" combine_regions:{fi['combine_regions']}"
+            if fi.get("mesh_shards"):
+                fb += f" mesh_shards:{fi['mesh_shards']}"
             if root_span is not None and not root_span.is_noop:
                 combines = root_span.find("combine_region_partials")
                 if combines:
@@ -204,6 +206,22 @@ def _node_info(node, root_span) -> str:
                              for c in combines)
                     fb += (f" combine_readbacks:{len(combines)} "
                            f"combine_readback_bytes:{rb}")
+                meshes = root_span.find("mesh_combine")
+                if meshes:
+                    # mesh/ICI transfer attribution (PR 4 residual): the
+                    # shard fan-in bytes + collective kinds per combine
+                    tx = sum(m.attrs.get("transfer_bytes", 0)
+                             for m in meshes)
+                    rb = sum(m.attrs.get("readback_bytes", 0)
+                             for m in meshes)
+                    kinds = " ".join(sorted(
+                        {m.attrs.get("collectives", "")
+                         for m in meshes} - {""}))
+                    fb += (f" mesh_combines:{len(meshes)} "
+                           f"mesh_transfer_bytes:{tx} "
+                           f"mesh_readback_bytes:{rb}")
+                    if kinds:
+                        fb += f" mesh_collectives:[{kinds}]"
         bits.append(fb)
     return " ".join(bits)
 

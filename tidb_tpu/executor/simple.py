@@ -129,6 +129,7 @@ _GLOBAL_ONLY_TPU_VARS = {
     "tidb_tpu_columnar_scan": "apply_tpu_columnar_scan",
     "tidb_tpu_plane_cache": "apply_tpu_plane_cache",
     "tidb_tpu_plane_cache_bytes": "apply_tpu_plane_cache_bytes",
+    "tidb_tpu_mesh": "apply_tpu_mesh",
     # statement-digest summary knobs (perfschema digest_summary state)
     "tidb_tpu_stmt_summary": "apply_stmt_summary",
     "tidb_tpu_stmt_summary_max_digests": "apply_stmt_summary_max_digests",

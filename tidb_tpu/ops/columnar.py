@@ -580,6 +580,11 @@ class ColumnarScanResult:
         # counts), set by the region engine; the client tallies it onto
         # the statement thread (distsql)
         self.cache_info: dict | None = None
+        # origin region (id, epoch) when this partial came from a cluster
+        # region (copr.columnar_region sets both) — the mesh tier's
+        # region→shard placement key; None for in-proc single partials
+        self.region_id: int | None = None
+        self.region_epoch: tuple | None = None
 
     def __len__(self) -> int:
         return len(self.sel)
@@ -746,6 +751,15 @@ class ColumnarPartialSet:
         """[start, end) stacked-row segment per region partial."""
         return [(int(self.offsets[i]), int(self.offsets[i + 1]))
                 for i in range(len(self.parts))]
+
+    def region_ids(self) -> list:
+        """Origin region id per partial (None entries for partials that
+        carry no region, e.g. in-proc responses) — the mesh tier's
+        region→shard placement key, aligned with region_slices()."""
+        return [getattr(p, "region_id", None) for p in self.parts]
+
+    def region_epochs(self) -> list:
+        return [getattr(p, "region_epoch", None) for p in self.parts]
 
     def handles(self) -> np.ndarray:
         return np.concatenate([p.handles() for p in self.parts])
@@ -1008,6 +1022,16 @@ class DeviceJoinResult:
                                side="left").tolist() + [len(self.l_idx)]
         return [(int(cuts[i]), int(cuts[i + 1]))
                 for i in range(len(cuts) - 1)]
+
+    def region_ids(self):
+        """Placement keys for the join-output segments, inherited from a
+        multi-region left side (aligned with region_slices)."""
+        src = getattr(self.lside, "region_ids", None)
+        return src() if src is not None else None
+
+    def region_epochs(self):
+        src = getattr(self.lside, "region_epochs", None)
+        return src() if src is not None else None
 
     def iter_rows(self, chunk: int = 1 << 16, stats: dict | None = None):
         """Stream output rows, assembling `chunk` index pairs per native

@@ -473,7 +473,9 @@ class SegCtx:
         if v.dtype == jnp.float64:
             sentinel = F64_MAX if is_min else -F64_MAX
         else:
-            sentinel = I64_MAX if is_min else I64_MIN + 1
+            # exact int64 extremes (a real -2^63 max must survive; empty
+            # segments are NULLed by their count, not by sentinel value)
+            sentinel = I64_MAX if is_min else I64_MIN
         vv = jnp.where(contrib, v, jnp.full_like(v, sentinel))
         if self.use_onehot:
             oh = self.onehot()
@@ -586,7 +588,7 @@ def _scalar_agg(spec: AggSpec, planes, mask):
         if v.dtype == jnp.float64:
             sentinel = F64_MAX if name == "min" else -F64_MAX
         else:
-            sentinel = I64_MAX if name == "min" else I64_MIN + 1
+            sentinel = I64_MAX if name == "min" else I64_MIN
         vv = jnp.where(contrib, v, jnp.full_like(v, sentinel))
         red = jnp.min(vv) if name == "min" else jnp.max(vv)
         return (n, red)
@@ -601,18 +603,58 @@ def _scalar_agg(spec: AggSpec, planes, mask):
     raise Unsupported(name)
 
 
+def _radix_words(key):
+    """Radix decomposition of an int64 sort key: (hi int32, lo uint32)
+    words whose LEXICOGRAPHIC order equals the int64 order (hi is the
+    arithmetic-shift high word, so sign carries; lo compares unsigned).
+    Sorting two native 32-bit digit planes replaces one 64-bit comparator
+    sort — on TPU the x64-emulation rewrite makes every i64 compare a
+    two-word operation, so the digit-decomposed (radix) sort is the
+    cheaper partitioned form of the same pass. Reassembly is exact:
+    key == hi * 2^32 + lo."""
+    hi = (key >> 32).astype(jnp.int32)
+    lo = (key & 0xFFFFFFFF).astype(jnp.uint32)
+    return hi, lo
+
+
 def _distinct_reduce(v, contrib):
     """Exact request-global (distinct count, distinct sum) with ONE
-    single-key sort: non-contributing rows are folded into a +sentinel
-    run (instead of a second lexsort key), distinct runs are boundary
-    counts among non-sentinel keys, and a genuine sentinel-valued
-    contributing row is recovered exactly by a separate reduction. Sort
-    passes dominate this kernel, so one key instead of two ≈ 2× faster."""
+    dedup sort: non-contributing rows are folded into a +sentinel run
+    (instead of a second lexsort key), distinct runs are boundary counts
+    among non-sentinel keys, and a genuine sentinel-valued contributing
+    row is recovered exactly by a separate reduction.
+
+    int64 keys sort RADIX-DECOMPOSED: the (hi, lo) 32-bit digit planes
+    sort lexicographically (jax.lax.sort, num_keys=2) instead of one
+    x64-emulated 64-bit comparator sort — sort passes dominate this
+    kernel (BENCH_r05: 6% of the HBM sweep peak), and two native 32-bit
+    digits halve the per-compare cost on TPU. f64 keys keep the native
+    f64 sort (the TPU sorts f64 directly; a bitcast to i64 is rejected
+    by the x64-emulation rewrite)."""
     if jnp.ndim(v) == 0:
         v = jnp.broadcast_to(v, contrib.shape)
     key = _orderable_i64(v)
-    sent = jnp.asarray(jnp.inf if key.dtype == jnp.float64 else I64_MAX,
-                       key.dtype)
+    if key.dtype == jnp.int64:
+        k2 = jnp.where(contrib, key, jnp.asarray(I64_MAX, jnp.int64))
+        hi, lo = _radix_words(k2)
+        hi_s, lo_s = jax.lax.sort((hi, lo), num_keys=2)
+        boundary = jnp.concatenate(
+            [jnp.ones(1, bool),
+             (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1])])
+        is_sent = (hi_s == jnp.int32((1 << 31) - 1)) \
+            & (lo_s == jnp.uint32(0xFFFFFFFF))
+        firsts = (~is_sent) & boundary
+        has_sent = jnp.any(contrib & (key == I64_MAX))
+        cnt = jnp.sum(firsts.astype(jnp.int64)) \
+            + has_sent.astype(jnp.int64)
+        # run-opening values reassemble exactly from their digit words
+        ks = hi_s.astype(jnp.int64) * jnp.int64(1 << 32) \
+            + lo_s.astype(jnp.int64)
+        vsum = jnp.sum(jnp.where(firsts, ks, jnp.zeros_like(ks)))
+        vsum = vsum + jnp.where(has_sent, jnp.int64(I64_MAX),
+                                jnp.int64(0))
+        return cnt, vsum.astype(v.dtype)
+    sent = jnp.asarray(jnp.inf, key.dtype)
     ks = jnp.sort(jnp.where(contrib, key, sent))
     # position 0 always opens a run (ks[0]-1 would be wrong for huge f64
     # where x-1 == x)
@@ -643,6 +685,22 @@ def _grouped_distinct(v, contrib, gid, num_segments):
     if jnp.ndim(v) == 0:
         v = jnp.broadcast_to(v, contrib.shape)
     key = _orderable_i64(v)
+    if key.dtype == jnp.int64:
+        # radix-decomposed sort keys: the value's (hi, lo) 32-bit digit
+        # words + an int32 group id (num_segments < 2^31 always — the
+        # radix/rank ceilings cap it) make every lexsort key a native
+        # 32-bit plane instead of an x64-emulated 64-bit one
+        hi, lo = _radix_words(key)
+        order = jnp.lexsort([lo, hi, (~contrib).astype(jnp.int32),
+                             gid.astype(jnp.int32)])
+        gs, cs, vs = gid[order], contrib[order], v[order]
+        hs, ls = hi[order], lo[order]
+        prev_g = jnp.concatenate([jnp.full(1, -1, gs.dtype), gs[:-1]])
+        changed = jnp.concatenate(
+            [jnp.zeros(1, bool),
+             (hs[1:] != hs[:-1]) | (ls[1:] != ls[:-1])])
+        firsts = cs & ((gs != prev_g) | changed)
+        return _sorted_boundary_sums(firsts, vs, gs, num_segments)
     order = jnp.lexsort([key, (~contrib).astype(jnp.int32), gid])
     gs, ks, cs, vs = gid[order], key[order], contrib[order], v[order]
     prev_g = jnp.concatenate([jnp.full(1, -1, gs.dtype), gs[:-1]])
@@ -987,7 +1045,7 @@ join_probe_kernel = jax.jit(_join_probe_impl,
 
 
 def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
-                     device_keys=None):
+                     device_keys=None, mesh=None):
     """Host driver for the device join kernels: numpy key planes in,
     (l_idx, r_idx) int64 numpy match pairs out, in left-scan order with
     ties in right-scan order.
@@ -1053,35 +1111,49 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
         lv = np.zeros(lcap, dtype=bool)
         lv[:n_left] = lvalid
         lk_d, lv_d = jnp.asarray(lk), jnp.asarray(lv)
-    out_cap = lcap
-    rb_bytes = 0
-    rb_count = 0
-    while True:
-        narrow = out_cap < (1 << 31) and rcap < (1 << 31) \
-            and lcap < (1 << 31)
-        packed = np.asarray(join_probe_kernel(rs, order, n_valid, lk_d,
-                                              lv_d, out_cap=out_cap,
-                                              narrow=narrow))
-        rb_bytes += int(packed.nbytes)
-        rb_count += 1
-        if narrow:
-            # exact int64 total from its (hi, lo) 32-bit words
-            n_out = (int(packed[-2]) << 32) | (int(packed[-1])
-                                              & 0xFFFFFFFF)
-        else:
-            n_out = int(packed[-1])
-        if n_out <= out_cap:
-            break
-        out_cap = col.bucket_capacity(n_out)
+    if mesh is not None and mesh.n > 1 and lcap % mesh.n == 0:
+        # mesh-sharded probe: the sorted build side is replicated, the
+        # probe rows shard over the device axis, and all per-shard pair
+        # blocks come back in ONE merged packed readback (shard-major =
+        # global left-scan order, because shards hold contiguous row
+        # blocks) — the mesh answer to the per-region probe fan-out
+        from tidb_tpu.ops import mesh as mesh_mod
+        l_idx, r_idx, n_out, rb_bytes, rb_count = \
+            mesh_mod.join_probe_sharded(mesh, rs, order, n_valid, lk_d,
+                                        lv_d, lcap, rcap)
+        psp.set("mesh_shards", mesh.n)
+    else:
+        out_cap = lcap
+        rb_bytes = 0
+        rb_count = 0
+        while True:
+            narrow = out_cap < (1 << 31) and rcap < (1 << 31) \
+                and lcap < (1 << 31)
+            packed = np.asarray(join_probe_kernel(rs, order, n_valid,
+                                                  lk_d, lv_d,
+                                                  out_cap=out_cap,
+                                                  narrow=narrow))
+            rb_bytes += int(packed.nbytes)
+            rb_count += 1
+            if narrow:
+                # exact int64 total from its (hi, lo) 32-bit words
+                n_out = (int(packed[-2]) << 32) | (int(packed[-1])
+                                                  & 0xFFFFFFFF)
+            else:
+                n_out = int(packed[-1])
+            if n_out <= out_cap:
+                break
+            out_cap = col.bucket_capacity(n_out)
+        # narrow readbacks widen here; the int64 path stays zero-copy
+        l_idx = packed[:n_out].astype(np.int64, copy=False)
+        r_idx = packed[out_cap:out_cap + n_out].astype(np.int64,
+                                                       copy=False)
     psp.set("readbacks", rb_count).set("readback_bytes", rb_bytes) \
         .set("pairs", int(n_out))
     psp.finish()
     tracing.record_dispatch(dispatches=rb_count, readbacks=rb_count,
                             readback_bytes=rb_bytes,
                             dispatch_us=(_time.perf_counter() - _pc0) * 1e6)
-    # narrow readbacks widen here; the int64 path stays zero-copy
-    l_idx = packed[:n_out].astype(np.int64, copy=False)
-    r_idx = packed[out_cap:out_cap + n_out].astype(np.int64, copy=False)
     if stats is not None:
         stats["probe_s"] = _time.time() - t0
         stats["n_pairs"] = n_out

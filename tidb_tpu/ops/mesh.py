@@ -1,0 +1,557 @@
+"""Mesh execution tier: region→shard placement + sharded partial-agg
+combine over ICI.
+
+The paper's north star is the distsql fan-out landing on a real device
+mesh: every region's partial lands on its HOME SHARD, each shard runs the
+pack→filter→partial-agg pipeline over the rows placed on it, and the
+partial aggregate states combine via `lax.psum`/`pmin`/`pmax` over the
+chip interconnect instead of a host-side stack (PAPER §0; "Partial
+Partial Aggregates" / "Enhancing Computation Pushdown" — ship states, not
+rows). This module supplies the three pieces the cluster tier was
+missing:
+
+* `RegionPlacement` — a stable region→shard map over the device mesh
+  tracking `cluster.topology`: assignment is a pure region-id hash
+  (splitmix64), so it is STABLE under split/merge by construction (a
+  surviving region keeps its shard; only new region ids gain
+  assignments); an epoch bump re-places the region (counted, observable)
+  to the same deterministic shard, so mid-scan topology changes never
+  strand partials.
+* `combine_rows_sharded` — the mesh rung of the partial-aggregate
+  combine: result rows are gathered shard-major by their region's
+  placement, each shard computes its [G] partial states with the SAME
+  scatter-free segment reductions the device kernels use
+  (`kernels.SegCtx`), and the states merge over ICI with the monoid
+  collectives (`count`/`sum` → psum, `min`/`first_row`-position → pmin,
+  `max` → pmax) in ONE dispatch with ONE packed readback. The host-side
+  [R, G] state stack (PR 5 residual) never exists on this path.
+* `combine_states_sharded` — the [R, G]-states-in variant (the sharded
+  twin of `kernels.combine_region_partials`): states place onto shards,
+  reduce locally over their region block, and combine over ICI — the
+  dryrun proves it bit-identical to the single-device combine.
+
+On a 1-device rig (the CPU-XLA tier-1 environment) the SAME code path
+runs over a 1-shard mesh: the local shard function executes unchanged and
+the collectives drop out (axis of one), so parity holds everywhere the
+multi-chip path will run.
+
+Degradation: a fault in the sharded combine (real, or injected through
+the `device/mesh_collective` failpoint) raises a typed DeviceError; the
+caller (executor.fused_agg) degrades mesh → single-device
+`combine_region_partials` → host monoid combine, counted on
+`copr.degraded_mesh` — never a statement error.
+
+jax imports live inside functions: importing this module must stay legal
+in a jax-free process (the session SET/hydration path touches it).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from tidb_tpu import errors, failpoint
+
+# process-wide switch (SET GLOBAL tidb_tpu_mesh; hydrated on bootstrap).
+# The mesh spans physical chips, a process-level resource — so unlike the
+# per-client tidb_tpu_* switches this one is a module flag.
+_enabled = True
+_lock = threading.Lock()
+_mesh = None            # CoprMesh singleton over every jax device
+_mesh_failed = False
+_placements: dict = {}  # id(mesh) -> RegionPlacement
+_combine_cache: dict = {}
+_probe_cache: dict = {}
+
+
+def set_enabled(enabled: bool) -> None:
+    global _enabled
+    _enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_mesh(mesh) -> None:
+    """Install an explicit CoprMesh as the process mesh (tests/bench:
+    e.g. a 1-shard mesh on a multi-device rig). None resets to lazy
+    auto-detection."""
+    global _mesh, _mesh_failed
+    with _lock:
+        _mesh = mesh
+        _mesh_failed = False
+
+
+def get_mesh():
+    """The process CoprMesh over every jax device (1-shard on a
+    single-device rig), or None when the tier is disabled or jax is
+    unavailable."""
+    global _mesh, _mesh_failed
+    if not _enabled:
+        return None
+    if _mesh is None and not _mesh_failed:
+        with _lock:
+            if _mesh is None and not _mesh_failed:
+                try:
+                    from tidb_tpu.parallel import CoprMesh
+                    _mesh = CoprMesh()
+                except Exception:
+                    _mesh_failed = True
+    return _mesh
+
+
+# ---------------------------------------------------------------------------
+# region → shard placement
+# ---------------------------------------------------------------------------
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer: region ids are small sequential ints — the
+    mixer spreads them uniformly over shards so adjacent regions (the hot
+    contiguous key ranges) don't pile onto one chip."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+class RegionPlacement:
+    """Region→shard assignment over an n-shard mesh, stable under
+    split/merge: the shard is a pure hash of the region id, so a
+    surviving region NEVER moves when its neighbors split or merge away
+    (their partials would otherwise cross shards mid-statement), and a
+    re-placement on epoch bump (split/merge bumps the region's version)
+    deterministically lands on the same shard — observable through the
+    `replacements` counter and the copr.mesh.* metrics."""
+
+    def __init__(self, n_shards: int):
+        self.n_shards = max(1, int(n_shards))
+        self._assigned: dict[int, tuple[int, object]] = {}
+        self._lock = threading.Lock()
+        self.placements = 0
+        self.replacements = 0
+
+    def place(self, region_id: int, epoch=None) -> int:
+        """Home shard for a region; `epoch` (the region's version tuple)
+        re-places on bump."""
+        rid = int(region_id)
+        with self._lock:
+            ent = self._assigned.get(rid)
+            if ent is not None and (epoch is None or ent[1] == epoch):
+                return ent[0]
+            shard = _mix64(rid) % self.n_shards
+            from tidb_tpu import metrics
+            if ent is None:
+                self.placements += 1
+                metrics.counter("copr.mesh.placements").inc()
+            else:
+                self.replacements += 1
+                metrics.counter("copr.mesh.replacements").inc()
+            self._assigned[rid] = (shard, epoch)
+            if len(self._assigned) > 4096:
+                self._assigned.pop(next(iter(self._assigned)))
+            return shard
+
+    def shard_of(self, region_ids, epochs=None) -> list[int]:
+        epochs = epochs or [None] * len(region_ids)
+        return [self.place(rid, ep)
+                for rid, ep in zip(region_ids, epochs)]
+
+
+def placement_for(mesh) -> RegionPlacement:
+    """The process placement for a mesh (one per mesh instance)."""
+    with _lock:
+        pl = _placements.get(id(mesh))
+        if pl is None or pl.n_shards != mesh.n:
+            pl = _placements[id(mesh)] = RegionPlacement(mesh.n)
+        return pl
+
+
+# ---------------------------------------------------------------------------
+# sharded partial-aggregate combine (rows in: per-shard partial agg + ICI)
+# ---------------------------------------------------------------------------
+
+def _identity(op: str, dtype) -> float | int:
+    import jax.numpy as jnp
+    if op == "sum":
+        return 0
+    if dtype == np.float64:
+        return float(jnp.finfo(jnp.float64).max) if op == "min" \
+            else -float(jnp.finfo(jnp.float64).max)
+    # exact int64 extremes: max over a region whose value IS -2^63 must
+    # not round to the identity (empty groups NULL via counts, never by
+    # sentinel comparison, so the exact bound is safe)
+    return (1 << 63) - 1 if op == "min" else -(1 << 63)
+
+
+def _shard_layout(slices, shard_of, n_shards: int):
+    """Row permutation placing each region's result-row segment onto its
+    home shard: (idx int64[S*Lmax] gather index, live bool[S*Lmax],
+    rows_per_shard list). Padding rows gather row 0 under live=False."""
+    segs: list[list[tuple[int, int]]] = [[] for _ in range(n_shards)]
+    for (s, e), sh in zip(slices, shard_of):
+        segs[sh].append((s, e))
+    per_shard = [sum(e - s for s, e in blocks) for blocks in segs]
+    lmax = max(max(per_shard), 1)
+    idx = np.zeros(n_shards * lmax, dtype=np.int64)
+    live = np.zeros(n_shards * lmax, dtype=bool)
+    for sh, blocks in enumerate(segs):
+        off = sh * lmax
+        for s, e in blocks:
+            n = e - s
+            idx[off:off + n] = np.arange(s, e, dtype=np.int64)
+            live[off:off + n] = True
+            off += n
+    return idx, live, per_shard
+
+
+# ONE collective per monoid — THE algebra table of the mesh tier (the
+# same mapping parallel.CoprMesh and kernels.combine_region_partials
+# keep, so the three rungs cannot drift)
+_COLLECTIVE = {"sum": "psum", "min": "pmin", "max": "pmax"}
+
+
+def _monoid_collective_fn(mesh, local, ops: tuple, n_in: int):
+    """Wrap a per-shard `local` (tuple of n_in arrays in → one partial
+    per op out) with the monoid collectives over the mesh axis and the
+    packed-single-readback jit. On a 1-shard mesh `local` runs as-is —
+    the collectives drop out (partials are already totals) — so the
+    multi-chip and tier-1 paths share every instruction but the
+    all-reduce. Returns (wrapper, jitted)."""
+    import jax
+    from tidb_tpu import parallel
+    from tidb_tpu.ops import kernels
+
+    if mesh.n == 1:
+        run = local
+    else:
+        try:
+            from jax import shard_map
+        except ImportError:           # older jax
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def combined(arrs):
+            outs = local(arrs)
+            return tuple(
+                getattr(jax.lax, _COLLECTIVE[op])(o, parallel.AXIS)
+                for o, op in zip(outs, ops))
+
+        run = shard_map(combined, mesh=mesh.mesh,
+                        in_specs=(tuple([P(parallel.AXIS)] * n_in),),
+                        out_specs=P())
+
+    def adapter(arrs, _live, run=run):
+        return run(arrs)
+
+    wrapper = kernels.pack_outputs(adapter)
+    return wrapper, jax.jit(wrapper)
+
+
+def _cache_put(cache: dict, key, mesh, wrapper, jitted) -> None:
+    """Insert a jitted entry with the MESH PINNED in it: a live entry
+    keeps id(mesh) from being recycled, so a key built from id(mesh) can
+    never serve a shard_map compiled for a dead mesh. Mutations ride the
+    module lock (concurrent statements share these caches; a duplicate
+    compile is harmless, a dict resized mid-eviction-iteration is not)."""
+    with _lock:
+        cache[key] = (mesh, wrapper, jitted)
+        while len(cache) > 256:
+            cache.pop(next(iter(cache)))
+
+
+def _sharded_combine_fn(mesh, n_specs: int, ops: tuple, g: int,
+                        lmax: int, dtypes: tuple):
+    """Jitted shard_map kernel: per-shard segment reductions over the
+    placed rows (the partial-agg half) + monoid collectives over the mesh
+    axis (the ICI combine), packed into one readback. Cached per
+    (mesh, spec ops, G, Lmax, dtypes) signature."""
+    key = (id(mesh), ops, g, lmax, dtypes)
+    with _lock:
+        ent = _combine_cache.get(key)
+    from tidb_tpu import tracing
+    tracing.record_jit_cache(hit=ent is not None)
+    if ent is not None:
+        return ent[1], ent[2]
+    from tidb_tpu.ops import kernels
+
+    def local(planes):
+        gid = planes[0]
+        seg = kernels.SegCtx(gid, g + 1)   # +1: padding/dead-row sink
+        outs = []
+        for i, op in enumerate(ops):
+            vals = planes[1 + 2 * i]
+            ok = planes[2 + 2 * i]
+            if op == "sum":
+                red = seg.sum(vals, ok)
+            elif op == "min":
+                red = seg.min(vals, ok)
+            else:
+                red = seg.max(vals, ok)
+            outs.append(red[:g])
+        return tuple(outs)
+
+    wrapper, jitted = _monoid_collective_fn(mesh, local, ops,
+                                            1 + 2 * n_specs)
+    _cache_put(_combine_cache, key, mesh, wrapper, jitted)
+    return wrapper, jitted
+
+
+def combine_rows_sharded(mesh, specs, gid, G: int, slices,
+                         region_ids=None, epochs=None) -> list[np.ndarray]:
+    """Combine one fusion's per-region partial aggregates over the mesh.
+
+    `specs` is a list of (op, vals, ok): op ∈ {"sum","min","max"}, vals a
+    host int64/float64 row plane (None → int64 ones: a count), ok the
+    contribution mask. `gid` maps every result row to its group
+    (host-unified global codes, same contract as ColumnBatch.group_codes
+    — which is what makes per-shard segment ids combinable), `slices` the
+    per-region [start, end) row segments, `region_ids`/`epochs` the
+    placement key per partial (positional when a partial carries no
+    region id). Returns one combined [G] array per spec.
+
+    Every region's rows land on its HOME SHARD (RegionPlacement), each
+    shard computes its [G] partial states, and the states merge with
+    psum/pmin/pmax over ICI — one dispatch, one packed readback. Faults
+    (incl. the device/mesh_collective failpoint) raise typed DeviceError
+    so the caller can degrade to the single-device combine."""
+    import time as _time
+
+    from tidb_tpu import tracing
+    import jax.numpy as jnp
+
+    n = len(gid)
+    if region_ids is None:
+        region_ids = list(range(len(slices)))
+    region_ids = [rid if rid is not None else -(i + 1)
+                  for i, rid in enumerate(region_ids)]
+    placement = placement_for(mesh)
+    shard_of = placement.shard_of(region_ids, epochs)
+    idx, live, per_shard = _shard_layout(slices, shard_of, mesh.n)
+    lmax = len(live) // mesh.n
+
+    gid_sh = np.where(live, np.asarray(gid, np.int64)[idx], G)
+    planes = [jnp.asarray(gid_sh)]
+    ops = []
+    h2d = gid_sh.nbytes
+    dtypes = []
+    for op, vals, ok in specs:
+        if vals is None:
+            vals = np.ones(n, dtype=np.int64)
+        vals = np.asarray(vals)
+        ok_sh = np.asarray(ok, bool)[idx] & live
+        vals_sh = vals[idx]
+        ops.append(op)
+        dtypes.append(np.dtype(vals.dtype).char)
+        h2d += vals_sh.nbytes + ok_sh.nbytes
+        planes.append(jnp.asarray(vals_sh))
+        planes.append(jnp.asarray(ok_sh))
+
+    wrapper, jitted = _sharded_combine_fn(mesh, len(specs), tuple(ops), G,
+                                          lmax, tuple(dtypes))
+    kinds = {}
+    for op in ops:
+        k = {"sum": "psum", "min": "pmin", "max": "pmax"}[op]
+        kinds[k] = kinds.get(k, 0) + 1
+    sp = tracing.current().child("mesh_combine") \
+        .set("shards", mesh.n).set("regions", len(slices)) \
+        .set("states", len(specs)).set("rows", n) \
+        .set("transfer_bytes", int(h2d)) \
+        .set("collectives", " ".join(f"{k}:{v}"
+                                     for k, v in sorted(kinds.items())))
+    if not sp.is_noop:
+        for sh in range(mesh.n):
+            placed = [rid for rid, s in zip(region_ids, shard_of)
+                      if s == sh]
+            sp.child("mesh_shard").set("shard", sh) \
+                .set("regions", placed).set("rows", per_shard[sh]) \
+                .finish()
+    t0 = _time.perf_counter()
+    try:
+        if failpoint._active:
+            failpoint.eval("device/mesh_collective",
+                           lambda: errors.DeviceError(
+                               "injected mesh collective failure"))
+        packed = jitted(tuple(planes), None)
+        host = np.asarray(packed)
+    except errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/collective/readback crash on the mesh: typed, so the
+        # fused aggregate degrades to the single-device combine (same
+        # monoid algebra) — answers cannot change
+        sp.set("error", "fault").finish()
+        raise errors.DeviceError(f"mesh combine failed: {e}") from e
+    sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
+    sp.finish()
+    tracing.record_dispatch(
+        readback_bytes=int(host.nbytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    from tidb_tpu.ops import kernels
+    outs = kernels.unpack_outputs(wrapper, host)
+    return [np.atleast_1d(np.asarray(o)) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# sharded combine of pre-built [R, G] states (the dryrun twin of
+# kernels.combine_region_partials)
+# ---------------------------------------------------------------------------
+
+def combine_states_sharded(states, ops, mesh,
+                           shard_of=None) -> list[np.ndarray]:
+    """Merge per-region [R, G] partial states over the mesh: regions
+    place onto shards ([S, Rmax, G] blocks padded with the monoid
+    identity), each shard reduces its local region block, and the shard
+    partials combine with psum/pmin/pmax over ICI — bit-identical to the
+    single-device `combine_region_partials` by construction (the dryrun
+    asserts exactly that)."""
+    import jax.numpy as jnp
+    from tidb_tpu.ops import kernels
+
+    R = int(states[0].shape[0])
+    if shard_of is None:
+        placement = placement_for(mesh)
+        shard_of = placement.shard_of(list(range(R)))
+    S = mesh.n
+    counts = [0] * S
+    for sh in shard_of:
+        counts[sh] += 1
+    rmax = max(max(counts), 1)
+    blocks = []
+    for st, op in zip(states, ops):
+        st = np.asarray(st)
+        G = st.shape[1] if st.ndim > 1 else 1
+        st = st.reshape(R, G)
+        out = np.full((S, rmax, G), _identity(op, st.dtype),
+                      dtype=st.dtype)
+        fill = [0] * S
+        for r, sh in enumerate(shard_of):
+            out[sh, fill[sh]] = st[r]
+            fill[sh] += 1
+        blocks.append(out.reshape(S * rmax, G))
+
+    key = ("states", id(mesh), tuple(ops),
+           tuple((b.shape, np.dtype(b.dtype).char) for b in blocks))
+    with _lock:
+        ent = _combine_cache.get(key)
+    if ent is None:
+        ops_t = tuple(ops)
+
+        def local(arrs):
+            out = []
+            for a, op in zip(arrs, ops_t):
+                if op == "sum":
+                    out.append(jnp.sum(a, axis=0))
+                elif op == "min":
+                    out.append(jnp.min(a, axis=0))
+                else:
+                    out.append(jnp.max(a, axis=0))
+            return tuple(out)
+
+        wrapper, jitted = _monoid_collective_fn(mesh, local, ops_t,
+                                                len(blocks))
+        _cache_put(_combine_cache, key, mesh, wrapper, jitted)
+    else:
+        wrapper, jitted = ent[1], ent[2]
+    if failpoint._active:
+        failpoint.eval("device/mesh_collective",
+                       lambda: errors.DeviceError(
+                           "injected mesh collective failure"))
+    try:
+        host = np.asarray(jitted(tuple(jnp.asarray(b) for b in blocks),
+                                 None))
+    except errors.TiDBError:
+        raise
+    except Exception as e:
+        raise errors.DeviceError(f"sharded state combine failed: {e}") \
+            from e
+    outs = kernels.unpack_outputs(wrapper, host)
+    return [np.atleast_1d(np.asarray(o)) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded join probe: build replicated, probe rows sharded over the
+# axis, per-shard pair blocks in ONE merged packed readback
+# ---------------------------------------------------------------------------
+
+def _sharded_probe_fn(mesh, out_cap: int, narrow: bool):
+    key = ("probe", id(mesh), out_cap, narrow)
+    with _lock:
+        ent = _probe_cache.get(key)
+    if ent is not None:
+        return ent[2]
+    import jax
+    from tidb_tpu import parallel
+    from tidb_tpu.ops import kernels
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(rs, order, n_valid, lk, lv):
+        return kernels._join_probe_impl(rs, order, n_valid, lk, lv,
+                                        out_cap, narrow=narrow)
+
+    sharded = shard_map(
+        local, mesh=mesh.mesh,
+        in_specs=(P(), P(), P(), P(parallel.AXIS), P(parallel.AXIS)),
+        out_specs=P(parallel.AXIS))
+    jitted = jax.jit(sharded)
+    _cache_put(_probe_cache, key, mesh, None, jitted)
+    return jitted
+
+
+def join_probe_sharded(mesh, rs, order, n_valid, lk_d, lv_d, lcap: int,
+                       rcap: int):
+    """Mesh-sharded probe: the sorted build side is replicated (broadcast
+    over ICI once), the probe key plane is row-sharded over the axis, and
+    every shard's fixed-capacity pair block comes back in ONE merged
+    packed readback (shard-major — which IS global left-scan order,
+    because shards hold contiguous row blocks). Returns (l_idx, r_idx,
+    n_out, readback_bytes, readbacks) with l_idx already globalized.
+
+    Per-shard capacity starts at the shard's own row count (FK joins
+    average ≤1 match/row) and escalates to bucket(max per-shard total) —
+    at most one retry, because every shard's total is exact regardless of
+    capacity."""
+    from tidb_tpu.ops import columnar as col
+
+    S = mesh.n
+    shard_len = lcap // S
+    out_cap = shard_len
+    rb_bytes = 0
+    rb_count = 0
+    while True:
+        narrow = out_cap < (1 << 31) and rcap < (1 << 31) \
+            and lcap < (1 << 31)
+        fn = _sharded_probe_fn(mesh, out_cap, narrow)
+        packed = np.asarray(fn(rs, order, n_valid, lk_d, lv_d))
+        rb_bytes += int(packed.nbytes)
+        rb_count += 1
+        blk = 2 * out_cap + (2 if narrow else 1)
+        totals = []
+        for s in range(S):
+            b = packed[s * blk:(s + 1) * blk]
+            if narrow:
+                totals.append((int(b[-2]) << 32) | (int(b[-1])
+                                                    & 0xFFFFFFFF))
+            else:
+                totals.append(int(b[-1]))
+        worst = max(totals)
+        if worst <= out_cap:
+            break
+        out_cap = col.bucket_capacity(worst)
+    l_parts, r_parts = [], []
+    for s in range(S):
+        b = packed[s * blk:(s + 1) * blk]
+        n_s = totals[s]
+        l_parts.append(b[:n_s].astype(np.int64, copy=False)
+                       + np.int64(s * shard_len))
+        r_parts.append(b[out_cap:out_cap + n_s].astype(np.int64,
+                                                       copy=False))
+    l_idx = np.concatenate(l_parts) if l_parts else np.zeros(0, np.int64)
+    r_idx = np.concatenate(r_parts) if r_parts else np.zeros(0, np.int64)
+    return l_idx, r_idx, int(sum(totals)), rb_bytes, rb_count

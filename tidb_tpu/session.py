@@ -996,6 +996,25 @@ class Session:
             if not enabled:
                 pc.clear()
 
+    def apply_tpu_mesh(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_mesh = 0|1 — the mesh execution tier
+        (ops.mesh): off pins the partial-aggregate combine and the join
+        probe to the single-device kernels. Process-wide (the mesh spans
+        physical chips), so this flips the ops.mesh module flag; a
+        jax-free process validates and persists but has nothing to
+        flip."""
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        if value.strip().lower() not in ("0", "1", "on", "off", "true",
+                                         "false"):
+            raise errors.ExecError(
+                f"tidb_tpu_mesh must be 0 or 1, got {value!r}")
+        self._require_global_grant("tidb_tpu_mesh")
+        try:
+            from tidb_tpu.ops import mesh as mesh_mod
+        except ImportError:   # retryable-ok: jax-free process, flag moot
+            return
+        mesh_mod.set_enabled(parse_bool_sysvar(value))
+
     def apply_tpu_plane_cache_bytes(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_plane_cache_bytes = N — the plane cache's
         LRU byte budget (evicts immediately when shrunk)."""
@@ -1283,6 +1302,15 @@ def bootstrap(session: Session) -> None:
                     if b:
                         pc.set_budget(max(0, int(b.strip())))
                 except ValueError:
+                    pass
+            # the mesh tier switch is a process-level ops.mesh flag —
+            # hydrate it on every backend path, like the plane cache
+            v = gv.values.get("tidb_tpu_mesh")
+            if v is not None:
+                try:
+                    from tidb_tpu.ops import mesh as _mesh_mod
+                    _mesh_mod.set_enabled(parse_bool_sysvar(v))
+                except ImportError:   # retryable-ok: jax-free process
                     pass
             # digest-summary / history-ring knobs live on the per-store
             # PerfSchema — hydrate them like the plane cache's

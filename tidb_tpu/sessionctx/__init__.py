@@ -67,6 +67,12 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_tpu_plane_cache": "1",
     # plane-cache byte budget (LRU evicts past it); GLOBAL-only
     "tidb_tpu_plane_cache_bytes": "268435456",
+    # mesh execution tier (ops.mesh) kill switch: 0 pins the partial-
+    # aggregate combine and the join probe to the single-device kernels
+    # (the first degradation rung) while everything else keeps routing.
+    # GLOBAL-only and PROCESS-wide — the mesh spans physical chips, so
+    # unlike the per-client switches it flips a module flag.
+    "tidb_tpu_mesh": "1",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
     # statement deadline in ms (0 = unlimited): every retry ladder of a

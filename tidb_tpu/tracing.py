@@ -229,7 +229,8 @@ COUNTER_KEYS = ("kernel_dispatches", "kernel_dispatch_us",
                 "plane_cache_evictions", "plane_cache_invalidations_epoch",
                 "plane_cache_invalidations_version",
                 "backoff_retries", "backoff_ms", "session_retries",
-                "degraded_device", "degraded_join", "degraded_combine")
+                "degraded_device", "degraded_join", "degraded_combine",
+                "degraded_mesh")
 
 
 def _tally() -> dict:
@@ -286,12 +287,14 @@ def record_dispatch(dispatches: int = 1, readbacks: int = 1,
 # are the /metrics-facing names)
 _DEGRADED_TALLY = {"device_to_cpu": "degraded_device",
                    "join_to_numpy": "degraded_join",
-                   "combine_to_host": "degraded_combine"}
+                   "combine_to_host": "degraded_combine",
+                   "mesh": "degraded_mesh"}
 
 
 def record_degraded(kind: str, tally: bool = True) -> None:
     """THE degradation tally: one call per tier fallback (device→CPU
-    request rerouting, device join→numpy, device combine→host, region
+    request rerouting, device join→numpy, mesh combine→single-device
+    ("mesh" → copr.degraded_mesh), device combine→host, region
     columnar→rows), feeding the copr.degraded_* process counters so
     every fallback is accounted on /metrics and — for statement-thread
     sites — the per-statement thread tallies. Fan-out WORKER threads
