@@ -808,6 +808,189 @@ def measure_mesh_fanout(n_rows: int, n_dim: int, n_regions: int,
     }
 
 
+def measure_qps(n_conns: int, smoke: bool):
+    """The heavy-traffic concurrency regime: N simulated connections run
+    the SAME mixed point/range/join sequence (literals differ per
+    connection) against one store whose table sits below the dispatch
+    floor — the regime the micro-batch tier (ops.sched) exists for.
+    Below-floor statements gather inside the batch window and ride
+    shared padded device dispatches; the 1-connection control runs the
+    identical workload with no peers to batch with (solo below-floor
+    routing). Emits sustained QPS, p50/p99 per regime, the p99 ratio
+    (the tier's exit criterion: p99 at N connections <= 2x p99 at 1),
+    and batched-dispatch counts; parity of batched answers vs the solo
+    route (kill switch) is asserted INSIDE the regime on a sampled
+    statement set."""
+    import threading
+
+    import numpy as np
+
+    from tidb_tpu import metrics
+    from tidb_tpu.ops import TpuClient
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    n_rows, n_vals = 16384, 256
+    # window sized for wave cohesion at n_conns on a GIL rig: the whole
+    # wave's submits (~1-3 ms python each, serialized) must land inside
+    # one gather window or waves fragment into sub-batches whose extra
+    # window+dispatch rounds inflate p99. The 1-connection control pays
+    # NO window (the traffic gate solo-routes lone statements), so a
+    # wide window costs nothing in the denominator.
+    window_ms = 100
+    per_conn = 4 if smoke else 8
+    per_conn_1 = 8 if smoke else 16
+
+    store = new_store(f"memory://benchqps{n_conns}")
+    s = Session(store)
+    s.execute("set global tidb_slow_log_threshold = 0")
+    s.execute(f"set global tidb_tpu_batch_window_ms = {window_ms}")
+    s.execute("create database q")
+    s.execute("use q")
+    s.execute("create table qtab (q_id bigint primary key, q_v bigint, "
+              "q_j bigint)")
+    s.execute("create table qdim (d_v bigint primary key)")
+    tbl = s.info_schema().table_by_name("q", "qtab")
+    rows = [[Datum.i64(i), Datum.i64(i % n_vals), Datum.i64(i % 32)]
+            for i in range(1, n_rows + 1)]
+    txn = store.begin()
+    tbl.add_records(txn, rows, skip_unique_check=True)
+    txn.commit()
+    s.execute("insert into qdim values "
+              + ", ".join(f"({i})" for i in range(32)))
+    # the whole table sits below the floor: every statement is the
+    # small-statement shape that dominates the millions-of-users regime
+    store.set_client(TpuClient(store, dispatch_floor_rows=1 << 20))
+    client = store.get_client()
+
+    def seq(conn_id: int, n: int):
+        rng = random.Random(1000 + conn_id)
+        shapes = ("point", "range", "point", "range", "join")
+        out = []
+        for i in range(n):
+            sh = shapes[i % len(shapes)]
+            if sh == "point":
+                out.append(f"select q_id, q_j from qtab "
+                           f"where q_v = {rng.randrange(n_vals)}")
+            elif sh == "range":
+                a = rng.randrange(n_vals - 4)
+                out.append(f"select q_id from qtab "
+                           f"where q_v between {a} and {a + 3}")
+            else:
+                a = rng.randrange(28)
+                out.append(f"select q_id, d_v from qtab join qdim "
+                           f"on q_j = d_v "
+                           f"where q_v between {a} and {a + 2}")
+        return out
+
+    def run_regime(conns: int, per: int):
+        sessions = [Session(store) for _ in range(conns)]
+        for ss in sessions:
+            ss.execute("use q")
+        plans = [seq(i, per) for i in range(conns)]
+        lat: list = []
+        results: list = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(conns)
+
+        def worker(i):
+            my_lat, my_res = [], []
+            barrier.wait()
+            for sql in plans[i]:
+                t0 = time.perf_counter()
+                rs = sessions[i].execute(sql)[0].values()
+                my_lat.append((time.perf_counter() - t0) * 1000)
+                my_res.append((sql, rs))
+            with lock:
+                lat.extend(my_lat)
+                results.extend(my_res)
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(conns)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return np.array(lat), time.time() - t0, results
+
+    # warm: pack the batch, compile the solo paths AND every batchable
+    # signature at both slot buckets (concurrent bursts)
+    warm_sessions = [Session(store) for _ in range(40)]
+    for ss in warm_sessions:
+        ss.execute("use q")
+
+    def warm_burst(n: int, sql_for):
+        b = threading.Barrier(n)
+
+        def w(i):
+            b.wait()
+            warm_sessions[i].execute(sql_for(i))
+        ts = [threading.Thread(target=w, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    for sql in seq(0, 5):
+        s.execute(sql)
+    for n in (4, min(40, max(n_conns, 8))):
+        warm_burst(n, lambda i: f"select q_id, q_j from qtab "
+                                f"where q_v = {i}")
+        warm_burst(n, lambda i: f"select q_id from qtab "
+                                f"where q_v between {i} and {i + 3}")
+        warm_burst(n, lambda i: f"select q_id, d_v from qtab join qdim "
+                                f"on q_j = d_v where q_v between "
+                                f"{i % 28} and {i % 28 + 2}")
+
+    # 1-connection control AFTER the hot signatures cool: the solo
+    # regime must see the genuine below-floor solo routing
+    time.sleep(2.2)
+    lat1, wall1, _ = run_regime(1, per_conn_1)
+
+    batched0 = metrics.counter("sched.batched_dispatches").value
+    stmts0 = metrics.counter("sched.batched_statements").value
+    degr0 = metrics.counter("copr.degraded_batch").value
+    lat_n, wall_n, results = run_regime(n_conns, per_conn)
+    batched = metrics.counter("sched.batched_dispatches").value - batched0
+    batched_stmts = metrics.counter("sched.batched_statements").value \
+        - stmts0
+    degraded = metrics.counter("copr.degraded_batch").value - degr0
+    assert batched > 0, \
+        "concurrent below-floor statements never shared a dispatch"
+
+    # parity: a deterministic sample of the concurrent run's statements,
+    # re-answered by the SOLO route (micro-batch kill switch) — batched
+    # answers must match exactly, row for row
+    client.micro_batch = False
+    try:
+        sample = results[:: max(1, len(results) // 10)]
+        for sql, got in sample:
+            want = s.execute(sql)[0].values()
+            assert got == want, \
+                f"batched answer diverged from solo route: {sql}"
+    finally:
+        client.micro_batch = True
+
+    p50_1 = float(np.percentile(lat1, 50))
+    p99_1 = float(np.percentile(lat1, 99))
+    p50_n = float(np.percentile(lat_n, 50))
+    p99_n = float(np.percentile(lat_n, 99))
+    return {
+        "qps_connections": n_conns,
+        "qps_sustained": round(len(lat_n) / wall_n, 1),
+        "qps_1conn": round(len(lat1) / wall1, 1),
+        "qps_p50_ms": round(p50_n, 2),
+        "qps_p99_ms": round(p99_n, 2),
+        "qps_p50_ms_1conn": round(p50_1, 2),
+        "qps_p99_ms_1conn": round(p99_1, 2),
+        "qps_p99_ratio_vs_1conn": round(p99_n / p99_1, 3),
+        "qps_batched_dispatches": batched,
+        "qps_batched_statements": batched_stmts,
+        "qps_degraded_batch": degraded,
+        "qps_batch_window_ms": window_ms,
+        "qps_parity": True,
+    }
+
+
 def workload_summary(store, sess, n_regions: int) -> dict:
     """Workload-observability figures off the fan-out store: the digest
     summary's view of the run just measured (every timed statement above
@@ -1149,6 +1332,20 @@ def main(smoke: bool = False):
           f"combines, collective {mesh_figs['mesh_collective_ms']:.1f} ms"
           f", {mesh_figs['mesh_transfer_bytes']} shard-fan-in bytes",
           file=sys.stderr)
+    # sustained-QPS concurrency regime: N simulated connections x mixed
+    # point/range/join below-floor workload — the micro-batch tier's
+    # headline production metric (p99 must stay flat as connections grow)
+    qps_figs = measure_qps(n_conns=32, smoke=smoke)
+    print(f"# qps ({qps_figs['qps_connections']} conns mixed "
+          f"point/range/join): {qps_figs['qps_sustained']:,.0f} stmt/s "
+          f"sustained ({qps_figs['qps_1conn']:.1f} at 1 conn), p50 "
+          f"{qps_figs['qps_p50_ms']:.0f} ms / p99 "
+          f"{qps_figs['qps_p99_ms']:.0f} ms vs 1-conn p99 "
+          f"{qps_figs['qps_p99_ms_1conn']:.0f} ms (ratio "
+          f"{qps_figs['qps_p99_ratio_vs_1conn']:.2f}), "
+          f"{qps_figs['qps_batched_dispatches']} batched dispatches / "
+          f"{qps_figs['qps_batched_statements']} batched statements, "
+          f"{qps_figs['qps_degraded_batch']} degraded", file=sys.stderr)
     print(f"# workload: {fan_figs['digest_entries']} digests "
           f"(fan-out query x{fan_figs['digest_fanout_exec_count']}, "
           f"{fan_figs['digest_fanout_device_ms']:.1f} ms device, "
@@ -1186,6 +1383,7 @@ def main(smoke: bool = False):
         "q1_mesh_rows_per_sec": q1_mesh_rps,
         "mesh_devices": len(jax.devices()),
         **mesh_figs,
+        **qps_figs,
         "smoke": smoke,
         # the honest CPU comparison: a vectorized-numpy engine over the
         # same packed planes (the Python xeval baseline above understates

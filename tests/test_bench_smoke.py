@@ -83,6 +83,22 @@ def test_bench_smoke_emits_valid_json():
     assert out["trace_kernel_ms_total"] >= 0
     assert out["trace_readbacks"] >= 1
     assert out["trace_readback_bytes"] > 0
+    # the sustained-QPS concurrency regime: concurrent below-floor
+    # statements shared device dispatches (micro-batch tier), batched
+    # answers matched the solo route exactly (asserted inside the bench,
+    # surfaced as qps_parity), and p99 at 32 simulated connections held
+    # within 2x the 1-connection p99 — the tier's exit criterion
+    assert out["qps_connections"] == 32
+    assert out["qps_sustained"] > 0
+    assert out["qps_batched_dispatches"] > 0, \
+        "no concurrent below-floor statements shared a dispatch"
+    assert out["qps_batched_statements"] >= out["qps_batched_dispatches"]
+    assert out["qps_parity"] is True
+    assert out["qps_p99_ms"] > 0 and out["qps_p99_ms_1conn"] > 0
+    assert out["qps_p99_ratio_vs_1conn"] <= 2.0, \
+        (f"p99 at 32 connections is "
+         f"{out['qps_p99_ratio_vs_1conn']:.2f}x the 1-connection p99 "
+         "(concurrency tier failed to keep latency flat)")
     # workload-observability figures: the digest summary saw the fan-out
     # workload (plan digest asserted inside the bench), region heat
     # covers every region, and the digest pipeline stays under the same

@@ -1,0 +1,415 @@
+"""Differential suite for the heavy-traffic concurrency tier.
+
+Three surfaces, each checked against its parity oracle:
+  1. micro-batched device dispatch (ops.sched): concurrent below-floor
+     statements sharing one padded dispatch must answer row-for-row what
+     the solo route (SET GLOBAL tidb_tpu_micro_batch = 0) answers —
+     mixed shapes, NULL planes, string/float/decimal literals, desc and
+     limit, deadline exhaustion inside a shared batch.
+  2. the shared drain pool (cluster.pool): pooled per-region fan-out
+     drains must answer exactly what sequential (concurrency-1)
+     execution and the row protocol answer, with NO per-statement thread
+     spawns.
+  3. admission-tier observability: batched statements tally `batched:`
+     into perfschema EXECUTION_DETAIL and count sched.* metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu import errors, failpoint, metrics
+from tidb_tpu import tablecodec as tc
+from tidb_tpu.ops import TpuClient
+from tidb_tpu.session import Session, new_store
+from tests.testkit import _store_id
+
+
+def _mk_store(n_rows: int = 3000, window_ms: int = 40):
+    """Local store + TpuClient with the floor raised so EVERY statement
+    is below-floor (the micro-batch tier's regime)."""
+    store = new_store(f"memory://conc{next(_store_id)}")
+    s = Session(store)
+    s.execute("set global tidb_slow_log_threshold = 0")
+    s.execute("create database d")
+    s.execute("use d")
+    s.execute("create table t (id bigint primary key, v bigint, "
+              "f double, sx varchar(16), dc decimal(8,2))")
+    vals = []
+    for i in range(1, n_rows + 1):
+        # every 7th row: NULL v and f (NULL-plane coverage)
+        if i % 7 == 0:
+            vals.append(f"({i}, null, null, 's{i % 5}', {i % 50}.25)")
+        else:
+            vals.append(f"({i}, {i % 97}, {i}.5, 's{i % 5}', "
+                        f"{i % 50}.25)")
+    s.execute("insert into t values " + ", ".join(vals))
+    store.set_client(TpuClient(store, dispatch_floor_rows=1 << 20))
+    client = store.get_client()
+    client.batch_window_ms = window_ms
+    # warm the packed batch (solo route) so concurrent submitters all
+    # hit the batch cache and land inside one gather window
+    s.execute("select id from t where v = 0")
+    return store, s, client
+
+
+MIXED_SHAPES = [
+    "select id, v from t where v = {k}",
+    "select id from t where v between {k} and {k2}",
+    "select id, sx from t where sx = 's{m}'",
+    "select id from t where f > {k}.5",
+    "select id, v from t where v is null",
+    "select id from t where v is not null and v < {k}",
+    "select id from t where dc = {m}.25",
+    "select id, v from t where v = {k} or v = {k2}",
+    "select id from t where not (v = {k})",
+    "select id, v from t where v = {k} limit 3",
+]
+
+
+def _fill(tpl: str, seed: int) -> str:
+    return tpl.format(k=seed % 90, k2=seed % 90 + 5, m=seed % 5)
+
+
+def _concurrent(store, sqls):
+    """Execute sqls concurrently (one session each, barrier start) and
+    return {sql: rows}."""
+    sessions = [Session(store) for _ in sqls]
+    for ss in sessions:
+        ss.execute("use d")
+    out = {}
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(sqls))
+    errs = []
+
+    def run(ss, q):
+        try:
+            barrier.wait()
+            r = ss.execute(q)[0].values()
+            with lock:
+                out[q] = r
+        except Exception as e:   # surfaced by the caller's assert
+            with lock:
+                errs.append((q, e))
+    ts = [threading.Thread(target=run, args=(ss, q))
+          for ss, q in zip(sessions, sqls)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs, errs[:3]
+    return out
+
+
+class TestBatchedVsSolo:
+    def test_same_shape_batch_parity_and_counters(self):
+        store, s, client = _mk_store()
+        sqls = [f"select id, v from t where v = {k}"
+                for k in (3, 11, 42, 77, 90, 96, 55, 7)]
+        client.micro_batch = False
+        oracle = {q: s.execute(q)[0].values() for q in sqls}
+        client.micro_batch = True
+        d0 = metrics.counter("sched.batched_dispatches").value
+        s0 = metrics.counter("sched.batched_statements").value
+        got = _concurrent(store, sqls)
+        assert metrics.counter("sched.batched_dispatches").value > d0, \
+            "concurrent same-shape statements never shared a dispatch"
+        assert metrics.counter("sched.batched_statements").value >= s0 + 2
+        for q in sqls:
+            assert got[q] == oracle[q], q
+
+    def test_mixed_shapes_null_planes_parity(self):
+        store, s, client = _mk_store()
+        sqls = [_fill(tpl, seed) for seed in (13, 31)
+                for tpl in MIXED_SHAPES]
+        client.micro_batch = False
+        oracle = {q: s.execute(q)[0].values() for q in sqls}
+        client.micro_batch = True
+        got = _concurrent(store, sqls)
+        for q in sqls:
+            assert got[q] == oracle[q], q
+
+    def test_desc_and_limit_demux_per_statement(self):
+        store, s, client = _mk_store()
+        sqls = ["select id from t where v = 5 order by id desc limit 4",
+                "select id from t where v = 5",
+                "select id from t where v = 12 limit 2"]
+        client.micro_batch = False
+        oracle = {q: s.execute(q)[0].values() for q in sqls}
+        client.micro_batch = True
+        got = _concurrent(store, sqls)
+        for q in sqls:
+            assert got[q] == oracle[q], q
+
+    def test_kill_switch_pins_solo_route(self):
+        store, s, client = _mk_store()
+        s2 = Session(store)
+        s2.execute("set global tidb_tpu_micro_batch = 0")
+        assert client.micro_batch is False
+        sqls = [f"select id, v from t where v = {k}" for k in range(8)]
+        d0 = metrics.counter("sched.batched_dispatches").value
+        c0 = client.stats["small_to_cpu"]
+        got = _concurrent(store, sqls)
+        assert metrics.counter("sched.batched_dispatches").value == d0, \
+            "kill switch off but statements still batched"
+        assert client.stats["small_to_cpu"] - c0 >= len(sqls)
+        s2.execute("set global tidb_tpu_micro_batch = 1")
+        oracle = {q: s.execute(q)[0].values() for q in sqls}
+        for q in sqls:
+            assert got[q] == oracle[q], q
+
+    def test_hot_signature_single_rides_device(self):
+        """After a multi-statement batch, a lone statement of the same
+        shape keeps riding the device (1-slot dispatch) while traffic is
+        hot — and answers exactly the same."""
+        store, s, client = _mk_store()
+        sqls = [f"select id, v from t where v = {k}" for k in (1, 2, 3, 4)]
+        _concurrent(store, sqls)    # heats the signature
+        d0 = metrics.counter("sched.batched_dispatches").value
+        got = s.execute("select id, v from t where v = 9")[0].values()
+        assert metrics.counter("sched.batched_dispatches").value == d0 + 1, \
+            "hot-signature single did not ride a 1-slot dispatch"
+        client.micro_batch = False
+        want = s.execute("select id, v from t where v = 9")[0].values()
+        assert got == want
+
+    def test_u64_literal_above_i64_degrades_to_solo(self):
+        """A literal outside int64 must not crash the batch tier — the
+        solo route answers (regression: np.int64 OverflowError)."""
+        store, s, client = _mk_store()
+        big = (1 << 63) + 7
+        sqls = [f"select id from t where v = {big}",
+                f"select id from t where v = {big}",
+                "select id from t where v = 4"]
+        client.micro_batch = False
+        oracle = {q: s.execute(q)[0].values() for q in set(sqls)}
+        client.micro_batch = True
+        got = _concurrent(store, list(set(sqls)))
+        for q in set(sqls):
+            assert got[q] == oracle[q], q
+
+    def test_batched_tally_in_execution_detail(self):
+        """Satellite: `batched:` lands on perfschema EXECUTION_DETAIL
+        (and therefore the slow-log key set) for batched statements."""
+        store, s, client = _mk_store(window_ms=200)
+        sqls = [f"select id, v from t where v = {k}"
+                for k in (21, 22, 23, 24, 25, 26)]
+        _concurrent(store, sqls)
+        rows = s.execute(
+            "select SQL_TEXT, EXECUTION_DETAIL from "
+            "performance_schema.events_statements_history")[0].values()
+        details = [str(r[1]) for r in rows
+                   if "where v =" in str(r[0])]
+        assert any("batched:1" in d for d in details), \
+            f"no EXECUTION_DETAIL carried the batched: tally: {details[-4:]}"
+
+    def test_deadline_in_shared_batch_fails_only_expired(self):
+        """A statement whose deadline expires while parked in the gather
+        window dies typed (3024) — its batch-mates answer normally."""
+        store, s, client = _mk_store(window_ms=150)
+        sqls = [f"select id, v from t where v = {k}" for k in (5, 6, 7, 8)]
+        client.micro_batch = False
+        oracle = {q: s.execute(q)[0].values() for q in sqls}
+        client.micro_batch = True
+
+        sessions = [Session(store) for _ in sqls]
+        for ss in sessions:
+            ss.execute("use d")
+        # the LAST session gets a deadline far shorter than the window:
+        # it will expire while waiting inside the shared batch
+        sessions[-1].execute("set tidb_tpu_max_execution_time = 30")
+        out, errs = {}, []
+        lock = threading.Lock()
+        barrier = threading.Barrier(len(sqls))
+
+        def run(i):
+            try:
+                barrier.wait()
+                if i == len(sqls) - 1:
+                    time.sleep(0.01)   # arrive as a follower
+                r = sessions[i].execute(sqls[i])[0].values()
+                with lock:
+                    out[sqls[i]] = r
+            except errors.TiDBError as e:
+                with lock:
+                    errs.append((i, e))
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(len(sqls))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert len(errs) == 1 and errs[0][0] == len(sqls) - 1, \
+            f"expected exactly the short-deadline statement to fail: {errs}"
+        assert isinstance(errs[0][1], errors.DeadlineExceededError), errs
+        for q in sqls[:-1]:
+            assert out[q] == oracle[q], q
+
+
+class TestStalledWindowDegrades:
+    def test_stalled_gather_window_degrades_to_solo(self):
+        """sched/batch_window hang: followers reclaim their entries and
+        answer through the solo route with unchanged answers, counted on
+        copr.degraded_batch."""
+        store, s, client = _mk_store(window_ms=20)
+        sqls = [f"select id, v from t where v = {k}"
+                for k in (31, 32, 33, 34, 35)]
+        client.micro_batch = False
+        oracle = {q: s.execute(q)[0].values() for q in sqls}
+        client.micro_batch = True
+        d0 = metrics.counter("copr.degraded_batch").value
+        failpoint.enable("sched/batch_window", action="sleep",
+                         seconds=0.6)
+        try:
+            got = _concurrent(store, sqls)
+        finally:
+            failpoint.disable_all()
+        assert metrics.counter("copr.degraded_batch").value > d0, \
+            "stalled window never counted a batch degradation"
+        for q in sqls:
+            assert got[q] == oracle[q], q
+
+
+class TestPooledDrain:
+    def _fan_store(self, n_regions: int = 4):
+        store = new_store(f"cluster://3/concfan{next(_store_id)}")
+        s = Session(store)
+        s.execute("create database m")
+        s.execute("use m")
+        s.execute("create table ft (id bigint primary key, k bigint, "
+                  "v bigint)")
+        s.execute("insert into ft values " + ", ".join(
+            f"({i}, {i % 5}, {i * 3})" for i in range(1, 241)))
+        tid = s.info_schema().table_by_name("m", "ft").info.id
+        step = 240 // n_regions
+        store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+        return store, s
+
+    def test_pooled_drain_parity_vs_sequential_and_rowpath(self):
+        """Pooled fan-out (shared bounded pool) vs concurrency-1
+        sequential execution vs the row protocol — row-for-row."""
+        store, s = self._fan_store()
+        q = ("select k, count(*), sum(v), min(v), max(v) from ft "
+             "group by k order by k")
+        scan = "select id, v from ft where v > 100 order by id"
+        pooled = {x: s.execute(x)[0].values() for x in (q, scan)}
+        # sequential oracle: distsql concurrency 1 routes through
+        # _ListResponse (no pool involvement at all)
+        s.execute("set tidb_distsql_scan_concurrency = 1")
+        seq = {x: s.execute(x)[0].values() for x in (q, scan)}
+        s.execute("set tidb_distsql_scan_concurrency = 10")
+        # row-protocol oracle
+        s.execute("set global tidb_tpu_columnar_scan = 0")
+        try:
+            rowp = {x: s.execute(x)[0].values() for x in (q, scan)}
+        finally:
+            s.execute("set global tidb_tpu_columnar_scan = 1")
+        for x in (q, scan):
+            assert pooled[x] == seq[x], f"pooled != sequential: {x}"
+            assert pooled[x] == rowp[x], f"pooled != row protocol: {x}"
+
+    def test_no_per_statement_thread_spawns(self):
+        """The fan-out drain path spawns no per-statement threads: the
+        shared pool's worker count is bounded across many statements."""
+        from tidb_tpu.cluster.pool import get_pool
+        store, s = self._fan_store()
+        q = "select k, count(*), sum(v) from ft group by k order by k"
+        s.execute(q)    # pool warm
+        before = threading.active_count()
+        for _ in range(12):
+            s.execute(q)
+        after = threading.active_count()
+        pool = get_pool()
+        assert after <= before + pool.size, \
+            (f"thread count grew {before} -> {after} across statements "
+             f"(pool size {pool.size}) — per-statement spawns remain")
+        st = pool.stats()
+        assert st["threads"] <= pool.size, st
+
+    def test_pooled_drain_concurrent_statements_parity(self):
+        """Many statements share the bounded pool concurrently; every
+        answer matches the single-threaded oracle."""
+        store, s = self._fan_store()
+        q = "select k, count(*), sum(v) from ft group by k order by k"
+        want = s.execute(q)[0].values()
+        sessions = [Session(store) for _ in range(8)]
+        for ss in sessions:
+            ss.execute("use m")
+        outs, errs = [], []
+        lock = threading.Lock()
+        barrier = threading.Barrier(len(sessions))
+
+        def run(ss):
+            try:
+                barrier.wait()
+                for _ in range(3):
+                    r = ss.execute(q)[0].values()
+                    with lock:
+                        outs.append(r)
+            except Exception as e:
+                with lock:
+                    errs.append(e)
+        ts = [threading.Thread(target=run, args=(ss,)) for ss in sessions]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errs, errs[:3]
+        assert len(outs) == 24
+        for r in outs:
+            assert r == want
+
+    def test_pool_preserves_backoffer_deadline(self):
+        """A statement deadline still bounds pooled fan-out workers: a
+        hang inside a region task fails typed, within the deadline."""
+        store, s = self._fan_store()
+        s.execute("set tidb_tpu_max_execution_time = 400")
+        failpoint.enable("copr/region_scan", action="hang")
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(errors.DeadlineExceededError):
+                s.execute("select count(*), sum(v) from ft")
+            assert time.monotonic() - t0 < 30
+        finally:
+            failpoint.disable_all()
+            s.execute("set tidb_tpu_max_execution_time = 0")
+        # pool workers recovered: the next statement answers normally
+        r = s.execute("select count(*) from ft")[0].values()
+        assert int(r[0][0]) == 240
+
+    def test_deadline_enforced_while_tasks_queued_in_pool(self):
+        """A statement whose fan-out tasks sit QUEUED behind another
+        statement's slow tasks in the shared pool still fails its
+        deadline typed — the consumer polls the Backoffer while
+        waiting, instead of sleeping until a worker frees."""
+        from tidb_tpu.cluster.pool import get_pool
+        store, s = self._fan_store()
+        pool = get_pool()
+        old_size = pool.size
+        pool.set_size(1)
+        failpoint.enable("copr/region_scan", action="sleep", seconds=0.8)
+        try:
+            holder = Session(store)
+            holder.execute("use m")
+            t = threading.Thread(
+                target=lambda: holder.execute("select count(*) from ft"))
+            t.start()
+            time.sleep(0.1)   # the single worker is now busy sleeping
+            victim = Session(store)
+            victim.execute("use m")
+            victim.execute("set tidb_tpu_max_execution_time = 200")
+            t0 = time.monotonic()
+            with pytest.raises(errors.DeadlineExceededError):
+                victim.execute("select count(*), sum(v) from ft")
+            took = time.monotonic() - t0
+            assert took < 2.0, \
+                f"queued statement overshot its 200ms deadline by {took:.1f}s"
+            t.join(timeout=30)
+        finally:
+            failpoint.disable_all()
+            pool.set_size(old_size)

@@ -1,0 +1,128 @@
+"""Shared bounded drain pool for the coprocessor fan-out.
+
+Before this tier, EVERY statement's per-region fan-out spawned its own
+worker threads (cluster.store._PipelinedResponse) — under heavy traffic
+with thousands of concurrent sessions that is thousands of short-lived
+threads per second, and the spawn cost + scheduler churn lands directly
+on statement latency. This module owns ONE process-wide bounded pool
+(the Taurus near-data design keeps the drain pool shared rather than
+per-query; PAPERS.md): fan-outs submit region tasks here, workers are
+reused across statements, and the pool size caps total drain
+concurrency no matter how many statements are in flight.
+
+Per-statement context (the statement's Backoffer/deadline and its trace
+span) does NOT ride the pool — each submitted task closure attaches its
+own span and backoffer explicitly (cluster.store's run()), so pooled
+workers serve interleaved statements without cross-attributing.
+
+Size: tidb_tpu_drain_pool_size (GLOBAL-only, process-wide like
+tidb_tpu_mesh). Shrinking takes effect as workers finish their current
+task; growing spawns on demand. Idle workers exit after a timeout so a
+quiet process holds no threads.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+
+_IDLE_EXIT_S = 30.0
+
+
+class DrainPool:
+    def __init__(self, size: int):
+        self._size = max(1, int(size))
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._threads = 0          # live workers
+        self._idle = 0             # workers parked in wait()
+        self._seq = itertools.count()
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def set_size(self, n: int) -> None:
+        with self._cv:
+            self._size = max(1, int(n))
+            self._cv.notify_all()   # over-target idle workers exit
+
+    def submit(self, fn) -> None:
+        """Run fn() on a pool worker. fn must route its own errors (the
+        fan-out stores them on the response and re-raises on the
+        consumer thread) — the pool never propagates."""
+        from tidb_tpu import metrics
+        with self._cv:
+            self._q.append(fn)
+            metrics.counter("copr.drain_pool.tasks").inc()
+            metrics.gauge("copr.drain_pool.queue_depth").set(len(self._q))
+            # spawn whenever the queue outruns the idlers: a notified
+            # worker only decrements _idle once it reacquires the lock,
+            # so a burst of submits cannot credit the same idler N
+            # times (that would serialize the whole burst on one
+            # worker). A mild over-spawn just idles out.
+            if self._threads < self._size and len(self._q) > self._idle:
+                self._threads += 1
+                threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"tidb-drain-{next(self._seq)}").start()
+            elif self._idle > 0:
+                self._cv.notify()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"threads": self._threads, "idle": self._idle,
+                    "queued": len(self._q), "size": self._size}
+
+    def _worker(self) -> None:
+        from tidb_tpu import metrics
+        qd = metrics.gauge("copr.drain_pool.queue_depth")
+        while True:
+            with self._cv:
+                while not self._q:
+                    if self._threads > self._size:
+                        self._threads -= 1
+                        return          # shrink target reached
+                    self._idle += 1
+                    got = self._cv.wait(timeout=_IDLE_EXIT_S)
+                    self._idle -= 1
+                    if not got and not self._q:
+                        self._threads -= 1
+                        return          # idle exit
+                if self._threads > self._size:
+                    self._threads -= 1
+                    self._cv.notify()   # someone else serves the queue
+                    return
+                fn = self._q.popleft()
+                qd.set(len(self._q))
+            try:
+                fn()
+            except BaseException:  # retryable-ok: fan-out task closures
+                # route their errors onto the response object and the
+                # consumer thread re-raises; a closure that leaks here is
+                # a bug but must never kill a shared worker
+                import logging
+                logging.getLogger(__name__).exception(
+                    "drain-pool task leaked an exception")
+
+
+_pool: DrainPool | None = None
+_pool_lock = threading.Lock()
+
+
+def get_pool() -> DrainPool:
+    global _pool
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+                _pool = DrainPool(
+                    int(SYSVAR_DEFAULTS["tidb_tpu_drain_pool_size"]))
+    return _pool
+
+
+def set_pool_size(n: int) -> None:
+    """Process-wide resize (SET GLOBAL tidb_tpu_drain_pool_size and
+    bootstrap hydration apply through this)."""
+    get_pool().set_size(n)

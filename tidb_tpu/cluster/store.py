@@ -381,15 +381,27 @@ class _ListResponse(kv.Response):
 
 
 class _PipelinedResponse(kv.Response):
-    """Streaming fan-out: worker threads execute tasks concurrently, the
+    """Streaming fan-out over the SHARED drain pool (cluster.pool): the
     consumer receives completed task results in TASK ORDER (the reference's
     ordered copIterator.Next with its buffered channel,
     store/tikv/coprocessor.go:348) — or, with ordered=False (scalar
     aggregates, whose partials merge commutatively), in COMPLETION order
     so no consumer stalls on a straggler region. A worker error surfaces
-    on next()."""
+    on next().
+
+    No per-statement threads are spawned: tasks are SCHEDULED onto the
+    process-wide bounded pool only while they sit inside the statement's
+    backpressure window AND under its inflight cap (per-statement
+    backpressure — a slow consumer holds results proportional to its own
+    concurrency, and one statement cannot flood the shared pool past its
+    distsql concurrency). Pooled tasks never block on consumer progress:
+    scheduling advances from completion/consumption instead, so a parked
+    consumer can never wedge a shared worker. The statement's Backoffer
+    and trace span cross onto pooled workers inside run() itself."""
 
     def __init__(self, tasks, run, concurrency: int, ordered: bool = True):
+        self._tasks = tasks
+        self._run = run
         self._results: dict[int, list] = {}
         self._next_task = 0
         self._consumed = 0
@@ -400,50 +412,77 @@ class _PipelinedResponse(kv.Response):
         self._err: BaseException | None = None
         self._buf: list = []
         self._cursor = 0
-        # backpressure: workers only start tasks inside a sliding window
+        # backpressure: tasks are only scheduled inside a sliding window
         # ahead of the consumer, so completed-but-unconsumed results stay
         # proportional to concurrency instead of the whole region set (the
         # reference's bounded channel, coprocessor.go:317)
         self._window = max(2 * concurrency, 4)
+        self._max_inflight = concurrency
+        self._scheduled = 0
+        self._inflight = 0
         self._abandoned = False
+        from tidb_tpu.cluster.pool import get_pool
+        self._pool = get_pool()
+        with self._cv:
+            self._schedule_locked()
 
-        task_iter = iter(enumerate(tasks))
-        iter_lock = threading.Lock()
+    def _schedule_locked(self) -> None:
+        """Push eligible tasks onto the shared pool (caller holds _cv)."""
+        while (self._scheduled < self._n
+               and self._err is None and not self._abandoned
+               and self._inflight < self._max_inflight
+               and self._scheduled < self._consumed + self._window):
+            idx = self._scheduled
+            self._scheduled += 1
+            self._inflight += 1
+            self._pool.submit(lambda idx=idx: self._run_one(idx))
 
-        def worker():
-            while True:
-                with iter_lock:
-                    nxt = next(task_iter, None)
-                if nxt is None:
-                    return
-                idx, rg = nxt
-                with self._cv:
-                    while (idx >= self._consumed + self._window
-                           and self._err is None and not self._abandoned):
-                        self._cv.wait()
-                    if self._err is not None or self._abandoned:
-                        return
-                try:
-                    out = run(rg)
-                except BaseException as e:  # retryable-ok: stored and
-                    # RE-RAISED on the consumer thread (next/drain_all) —
-                    # routed, not swallowed
-                    with self._cv:
-                        if self._err is None:
-                            self._err = e
-                        self._cv.notify_all()
-                    return
-                with self._cv:
-                    self._results[idx] = out
-                    self._cv.notify_all()
+    def _wait_or_deadline(self) -> None:
+        """Consumer-side wait (caller holds _cv) that still honors the
+        statement deadline while this fan-out's tasks sit QUEUED behind
+        other statements in the shared pool — running tasks enforce the
+        Backoffer themselves, but an unscheduled task has no thread to
+        check it. Expiry abandons the fan-out (scheduled tasks no-op at
+        pickup) and fails the statement typed."""
+        bo = kvbackoff.current()
+        if bo is None or bo.deadline is None:
+            self._cv.wait()
+            return
+        self._cv.wait(timeout=0.05)
+        try:
+            bo.check_deadline("copr fan-out wait")
+        except Exception:
+            self._abandoned = True
+            self._cv.notify_all()
+            raise
 
-        for _ in range(concurrency):
-            threading.Thread(target=worker, daemon=True).start()
+    def _run_one(self, idx: int) -> None:
+        with self._cv:
+            if self._err is not None or self._abandoned:
+                self._inflight -= 1
+                self._cv.notify_all()
+                return
+        try:
+            out = self._run(self._tasks[idx])
+        except BaseException as e:  # retryable-ok: stored and
+            # RE-RAISED on the consumer thread (next/drain_all) —
+            # routed, not swallowed
+            with self._cv:
+                if self._err is None:
+                    self._err = e
+                self._inflight -= 1
+                self._cv.notify_all()
+            return
+        with self._cv:
+            self._results[idx] = out
+            self._inflight -= 1
+            self._schedule_locked()
+            self._cv.notify_all()
 
     def close(self) -> None:
-        """Abandon the fan-out: wake any workers parked on the window so
-        they exit instead of waiting for a consumer that stopped early
-        (LIMIT). Idempotent."""
+        """Abandon the fan-out: unscheduled tasks never reach the pool
+        and scheduled ones exit at pickup instead of running for a
+        consumer that stopped early (LIMIT). Idempotent."""
         with self._cv:
             self._abandoned = True
             self._cv.notify_all()
@@ -451,15 +490,17 @@ class _PipelinedResponse(kv.Response):
     def drain_all(self):
         """Block until every remaining task completes and return ALL
         their partials in TASK order. The backpressure window lifts for
-        the duration — the consumer wants everything, so workers run
-        free; completion order does not matter because partials are
-        reassembled by task index (this is how the columnar channel
-        collects per-region partials concurrently while the stacked
-        plane order stays the row protocol's scan order)."""
+        the duration — the consumer wants everything, so the schedule
+        runs free (still under the statement's inflight cap, which IS
+        its distsql concurrency); completion order does not matter
+        because partials are reassembled by task index (this is how the
+        columnar channel collects per-region partials concurrently while
+        the stacked plane order stays the row protocol's scan order)."""
         out = self._buf[self._cursor:]
         self._buf, self._cursor = [], 0
         with self._cv:
             self._window = self._n + 1     # lift backpressure
+            self._schedule_locked()
             self._cv.notify_all()
             while True:
                 if self._err is not None:
@@ -467,7 +508,7 @@ class _PipelinedResponse(kv.Response):
                 if self._abandoned or \
                         all(i in self._results for i in self._remaining):
                     break
-                self._cv.wait()
+                self._wait_or_deadline()
             for i in sorted(self._remaining):
                 got = self._results.pop(i, None)
                 if got is not None:   # abandoned fan-outs return what ran
@@ -500,9 +541,10 @@ class _PipelinedResponse(kv.Response):
                     self._cursor = 0
                     self._remaining.discard(take)
                     self._consumed += 1
-                    self._cv.notify_all()   # window advanced: wake workers
+                    self._schedule_locked()  # window advanced: next tasks
+                    self._cv.notify_all()
                     break
-                self._cv.wait()
+                self._wait_or_deadline()
         return self.next()
 
 

@@ -130,6 +130,10 @@ _GLOBAL_ONLY_TPU_VARS = {
     "tidb_tpu_plane_cache": "apply_tpu_plane_cache",
     "tidb_tpu_plane_cache_bytes": "apply_tpu_plane_cache_bytes",
     "tidb_tpu_mesh": "apply_tpu_mesh",
+    "tidb_tpu_micro_batch": "apply_tpu_micro_batch",
+    "tidb_tpu_batch_window_ms": "apply_tpu_batch_window",
+    "tidb_tpu_conn_queue_depth": "apply_conn_queue_depth",
+    "tidb_tpu_drain_pool_size": "apply_drain_pool_size",
     # statement-digest summary knobs (perfschema digest_summary state)
     "tidb_tpu_stmt_summary": "apply_stmt_summary",
     "tidb_tpu_stmt_summary_max_digests": "apply_stmt_summary_max_digests",

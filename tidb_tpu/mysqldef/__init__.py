@@ -120,6 +120,7 @@ ErrDupKeyName = 1061
 ErrDBCreateExists = 1007
 ErrDBDropExists = 1008
 ErrAccessDenied = 1045
+ErrConCount = 1040          # "Too many connections" (admission gate)
 
 # THE server version string: version() builtin, @@version sysvar, and the
 # wire handshake must all agree — drivers version-gate features on it

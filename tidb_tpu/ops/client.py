@@ -121,6 +121,17 @@ class TpuClient(kv.Client):
         # parity oracle for cache correctness).
         self.plane_cache_enabled = store_bool_sysvar(store,
                                                      "tidb_tpu_plane_cache")
+        # micro-batch tier (ops.sched): concurrent below-floor statements
+        # gather for tidb_tpu_batch_window_ms and ride ONE padded device
+        # dispatch instead of N solo CPU scans. SET GLOBAL
+        # tidb_tpu_micro_batch = 0 pins every below-floor statement to
+        # the solo route (the parity oracle for the batched path).
+        from tidb_tpu.sessionctx import store_int_sysvar
+        self.micro_batch = store_bool_sysvar(store, "tidb_tpu_micro_batch")
+        self.batch_window_ms = store_int_sysvar(store,
+                                                "tidb_tpu_batch_window_ms")
+        from tidb_tpu.ops.sched import MicroBatcher
+        self._sched = MicroBatcher()
         self._batch_cache: dict = {}
         self._fn_cache: dict = {}
         # (jitted, planes, live) of the most recent single-chip aggregate
@@ -132,7 +143,8 @@ class TpuClient(kv.Client):
         self._rank_cap_start: dict = {}
         self.stats = {"tpu_requests": 0, "cpu_fallbacks": 0,
                       "batch_packs": 0, "batch_hits": 0,
-                      "batch_appends": 0, "small_to_cpu": 0}
+                      "batch_appends": 0, "small_to_cpu": 0,
+                      "small_batched": 0}
 
     # ------------------------------------------------------------------
     # capability probe: optimistic structural check; send() falls back on
@@ -242,8 +254,18 @@ class TpuClient(kv.Client):
         return self.cpu.send(req)
 
     def _route_small(self, req: kv.Request, sel) -> kv.Response:
-        """Below the dispatch floor: the CPU engine answers."""
+        """Below the dispatch floor: try the micro-batch tier first —
+        concurrent below-floor statements arriving within the gather
+        window share ONE padded device dispatch (ops.sched); a statement
+        with no batch (unbatchable shape, no peers, stalled window,
+        device fault) answers on the CPU engine exactly as before."""
         from tidb_tpu import metrics
+        if self.micro_batch:
+            resp = self._sched.submit(self, req, sel)
+            if resp is not None:
+                self.stats["small_batched"] += 1
+                metrics.counter("copr.tpu.small_batched").inc()
+                return resp
         self.stats["small_to_cpu"] += 1
         metrics.counter("copr.tpu.small_to_cpu").inc()
         return self._cpu_answer(req, sel)
@@ -423,13 +445,15 @@ class TpuClient(kv.Client):
         return ent
 
     def _dispatch_kernel(self, jitted, planes, live, kind: str,
-                         state=None) -> np.ndarray:
+                         state=None, extra=(), attrs=None) -> np.ndarray:
         """One device dispatch + the packed-output readback, attributed:
         a `kernel` trace span (kind, dispatch vs total time, readback
         bytes, whether this run paid jit trace+compile), the per-thread
         statement tallies, and the ops.* process metrics. The np.asarray
         IS the readback — the only certified completion point on
-        tunneled deployments."""
+        tunneled deployments. `extra` passes additional jitted-call args
+        (the micro-batch tier's per-slot parameter blocks); `attrs` adds
+        span attributes (batch attribution on the kernel span)."""
         import time as _time
 
         from tidb_tpu import metrics, tracing
@@ -437,18 +461,26 @@ class TpuClient(kv.Client):
         if state is not None:
             state["runs"] += 1
         sp = tracing.current().child("kernel").set("kind", kind)
+        if attrs:
+            for k, v in attrs.items():
+                sp.set(k, v)
         t0 = _time.perf_counter()
         try:
             if failpoint._active:
                 failpoint.eval("device/oom", lambda: errors.DeviceError(
                     f"injected device OOM ({kind})"))
-            packed = jitted(planes, live)
-            t_disp = _time.perf_counter()
-            if failpoint._active:
-                failpoint.eval("device/readback",
-                               lambda: errors.DeviceError(
-                                   f"injected readback failure ({kind})"))
-            host = np.asarray(packed)
+            # launch + readback serialized across statement threads
+            # (kernels.dispatch_serial): concurrent sessions racing a
+            # program's dispatch/first-compile can wedge the runtime
+            with kernels.dispatch_serial:
+                packed = jitted(planes, live, *extra)
+                t_disp = _time.perf_counter()
+                if failpoint._active:
+                    failpoint.eval("device/readback",
+                                   lambda: errors.DeviceError(
+                                       f"injected readback failure "
+                                       f"({kind})"))
+                host = np.asarray(packed)
         except errors.TiDBError:
             sp.set("error", "fault").finish()   # a dead span must not
             raise                               # bleed to statement end
@@ -868,17 +900,21 @@ class TpuClient(kv.Client):
                                           sel.limit)
         return self._emit_rows(sel, batch, top)
 
-    def _emit_rows(self, sel, batch, idx) -> SelectResponse:
+    def _emit_rows(self, sel, batch, idx, cols=None) -> SelectResponse:
+        """Emit the filter/topn survivors. `cols` defaults to the
+        current request's columns; the micro-batch tier passes its
+        entry's own (emission must not read per-request client state
+        from the leader thread)."""
+        if cols is None:
+            cols = self._cur_cols
         if sel.columnar_hint and self.columnar_scan:
             # plane-aware consumer: ship the scan's planes + selection
             # index instead of encoding rows the far side would only
             # re-extract (the columnar half of scan→join→agg staying
             # device-resident end-to-end)
             return SelectResponse(columnar=col.ColumnarScanResult(
-                batch, np.asarray(idx, dtype=np.int64),
-                list(self._cur_cols)))
+                batch, np.asarray(idx, dtype=np.int64), list(cols)))
         writer = ChunkWriter()
-        cols = self._cur_cols
         planes = batch.columns
         for i in idx:
             row = [col.plane_datum(planes[c.column_id], c, int(i))

@@ -37,6 +37,18 @@ F64_MAX = jnp.finfo(jnp.float64).max
 I64_MAX = (1 << 63) - 1
 I64_MIN = -(1 << 63)
 
+# Serialize XLA executable LAUNCH + result READBACK across statement
+# threads. Concurrent sessions racing the dispatch (and the first-call
+# trace/compile) of a jitted program can wedge the runtime — observed on
+# the CPU backend as three fused-aggregate combines parked forever in
+# ArrayImpl._value with no thread holding the GIL or any Python lock.
+# One physical device executes one program at a time anyway, so
+# serializing the launch+readback costs no real parallelism; compute-
+# only helpers (plane pads/gathers) stay outside.
+import threading as _threading
+
+dispatch_serial = _threading.Lock()
+
 # pseudo column id carrying the global row position plane (arange over the
 # batch; sharded along with the data under shard_map, so positions stay
 # global across the mesh). Used by exact first_row lowering.
@@ -943,8 +955,9 @@ def combine_region_partials(states: list[np.ndarray],
         if _failpoint._active:
             _failpoint.eval("device/combine", lambda: _errors.DeviceError(
                 "injected region-combine failure"))
-        packed = jitted(tuple(jnp.asarray(s) for s in states), None)
-        host = np.asarray(packed)
+        dev = tuple(jnp.asarray(s) for s in states)
+        with dispatch_serial:
+            host = np.asarray(jitted(dev, None))
     except _errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -1129,10 +1142,11 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
         while True:
             narrow = out_cap < (1 << 31) and rcap < (1 << 31) \
                 and lcap < (1 << 31)
-            packed = np.asarray(join_probe_kernel(rs, order, n_valid,
-                                                  lk_d, lv_d,
-                                                  out_cap=out_cap,
-                                                  narrow=narrow))
+            with dispatch_serial:
+                packed = np.asarray(join_probe_kernel(rs, order, n_valid,
+                                                      lk_d, lv_d,
+                                                      out_cap=out_cap,
+                                                      narrow=narrow))
             rb_bytes += int(packed.nbytes)
             rb_count += 1
             if narrow:

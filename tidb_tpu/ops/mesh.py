@@ -319,6 +319,7 @@ def combine_rows_sharded(mesh, specs, gid, G: int, slices,
     import time as _time
 
     from tidb_tpu import tracing
+    from tidb_tpu.ops import kernels
     import jax.numpy as jnp
 
     n = len(gid)
@@ -373,8 +374,9 @@ def combine_rows_sharded(mesh, specs, gid, G: int, slices,
             failpoint.eval("device/mesh_collective",
                            lambda: errors.DeviceError(
                                "injected mesh collective failure"))
-        packed = jitted(tuple(planes), None)
-        host = np.asarray(packed)
+        with kernels.dispatch_serial:
+            packed = jitted(tuple(planes), None)
+            host = np.asarray(packed)
     except errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -460,8 +462,9 @@ def combine_states_sharded(states, ops, mesh,
                        lambda: errors.DeviceError(
                            "injected mesh collective failure"))
     try:
-        host = np.asarray(jitted(tuple(jnp.asarray(b) for b in blocks),
-                                 None))
+        dev = tuple(jnp.asarray(b) for b in blocks)
+        with kernels.dispatch_serial:
+            host = np.asarray(jitted(dev, None))
     except errors.TiDBError:
         raise
     except Exception as e:
@@ -528,7 +531,9 @@ def join_probe_sharded(mesh, rs, order, n_valid, lk_d, lv_d, lcap: int,
         narrow = out_cap < (1 << 31) and rcap < (1 << 31) \
             and lcap < (1 << 31)
         fn = _sharded_probe_fn(mesh, out_cap, narrow)
-        packed = np.asarray(fn(rs, order, n_valid, lk_d, lv_d))
+        from tidb_tpu.ops import kernels
+        with kernels.dispatch_serial:
+            packed = np.asarray(fn(rs, order, n_valid, lk_d, lv_d))
         rb_bytes += int(packed.nbytes)
         rb_count += 1
         blk = 2 * out_cap + (2 if narrow else 1)

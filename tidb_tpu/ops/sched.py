@@ -1,0 +1,682 @@
+"""Micro-batched device dispatch for below-floor statements.
+
+Heavy traffic from many sessions is dominated by SMALL statements —
+point and short-range scans that sit under the dispatch floor, where a
+solo device round trip can never amortize (ops.client routes them to the
+CPU engine). But the flat dispatch+readback cost is exactly the kind of
+per-request fixed cost that amortizes when concurrent requests SHARE a
+dispatch (the continuous-batching shape of an inference server): N
+concurrent below-floor scans of the same packed batch ride ONE padded
+device dispatch and one packed readback, de-multiplexed per statement.
+
+Mechanics:
+  1. submit() lowers the statement's pushed-down WHERE into a
+     PARAMETERIZED kernel shape — literals become per-slot parameters
+     (an int64 and a float64 vector), so `v = 3` and `v = 7` share one
+     compiled kernel. The structural signature (operators, columns,
+     compare domains — never literal values) is the group key.
+  2. The first submitter of a gather cycle becomes the LEADER: it waits
+     one gather window (tidb_tpu_batch_window_ms) for followers, drains
+     the queue, groups entries by (batch, signature), and executes each
+     group as one vmapped dispatch over slot-bucketed parameter blocks
+     (slot counts pad to a small bucket set, so N concurrent scans
+     compile once per signature+bucket, not once per N).
+  3. The [slots, capacity] mask block reads back packed as ONE transfer;
+     each statement demuxes its own slot host-side (desc/limit applied
+     per statement, same as the solo filter path) and emits its own
+     response — columnar planes for hinted consumers, chunk rows
+     otherwise.
+
+Degradation contract: a stalled gather window (sched/batch_window
+failpoint: hang or sleep) or a device fault inside the shared dispatch
+NEVER changes answers — affected statements fall back to the solo
+below-floor route (the CPU engine), counted on copr.degraded_batch. A
+statement whose deadline expires while waiting in a shared batch fails
+typed (DeadlineExceededError) without taking its batch-mates with it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from tidb_tpu import errors, failpoint
+from tidb_tpu.copr.proto import ExprType, SelectResponse
+from tidb_tpu.kv import backoff as kvbackoff
+from tidb_tpu.kv import kv
+from tidb_tpu.ops import columnar as col
+from tidb_tpu.ops.exprc import Unsupported
+from tidb_tpu.sqlast.opcode import Op
+
+# slot-count buckets: a chunk of K statements pads its parameter block to
+# the smallest bucket >= K, so the jit cache sees at most len(_SLOT_BUCKETS)
+# shapes per signature no matter how concurrency fluctuates
+_SLOT_BUCKETS = (8, 32)
+MAX_SLOTS = _SLOT_BUCKETS[-1]
+
+_CMP_OPS = {Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE}
+_LOGIC_OPS = {Op.AndAnd, Op.OrOr, Op.Xor}
+
+_FLIP = {Op.LT: Op.GT, Op.LE: Op.GE, Op.GT: Op.LT, Op.GE: Op.LE,
+         Op.EQ: Op.EQ, Op.NE: Op.NE}
+
+
+class _Unbatchable(Exception):
+    """WHERE shape this tier cannot parameterize — solo route answers."""
+
+
+def _cmp_fn(op: Op):
+    if op == Op.EQ:
+        return lambda a, b: a == b
+    if op == Op.NE:
+        return lambda a, b: a != b
+    if op == Op.LT:
+        return lambda a, b: a < b
+    if op == Op.LE:
+        return lambda a, b: a <= b
+    if op == Op.GT:
+        return lambda a, b: a > b
+    return lambda a, b: a >= b
+
+
+def _truthy(v):
+    import jax.numpy as jnp
+    if v.dtype == jnp.bool_:
+        return v
+    return v != 0
+
+
+class _Lowerer:
+    """Lower one statement's WHERE into fn(planes, pi, pf) -> (val, valid)
+    with literals hoisted into the pi (int64) / pf (float64) parameter
+    vectors. Mirrors ops.exprc's lowering semantics EXACTLY (same valid
+    planes, same compare domains, same fixed-point scaling) — batched
+    answers must be bit-identical to the solo device path, which the
+    parity suites certify against the CPU engine."""
+
+    def __init__(self, batch):
+        self.batch = batch
+        self.pi: list[int] = []
+        self.pf: list[float] = []
+        self.cids: set[int] = set()
+
+    def _param_i(self, v: int) -> int:
+        v = int(v)
+        if not -(1 << 63) <= v < (1 << 63):
+            # a literal outside int64 cannot ride the parameter plane —
+            # the solo route answers (np.int64 would overflow, and the
+            # solo device path rejects the same shape to CPU)
+            raise _Unbatchable("integer literal exceeds int64")
+        self.pi.append(v)
+        return len(self.pi) - 1
+
+    def _param_f(self, v: float) -> int:
+        self.pf.append(float(v))
+        return len(self.pf) - 1
+
+    def lower(self, e):
+        """Returns (fn, sig): fn(planes, pi_row, pf_row) -> (val, valid);
+        sig is the literal-free structural signature."""
+        import jax.numpy as jnp
+        tp = e.tp
+        if tp == ExprType.OPERATOR:
+            op = e.op
+            if len(e.children) == 1:
+                if op in (Op.UnaryNot, Op.Not):
+                    cf, cs = self.lower(e.children[0])
+
+                    def unot(planes, pi, pf, cf=cf):
+                        v, va = cf(planes, pi, pf)
+                        return jnp.logical_not(_truthy(v)), va
+                    return unot, ("not", cs)
+                raise _Unbatchable(f"unary {op!r}")
+            if op in _LOGIC_OPS:
+                af, asig = self.lower(e.children[0])
+                bf, bsig = self.lower(e.children[1])
+
+                def logic(planes, pi, pf, af=af, bf=bf, op=op):
+                    av, aa = af(planes, pi, pf)
+                    bv, bb = bf(planes, pi, pf)
+                    at, bt = _truthy(av), _truthy(bv)
+                    if op == Op.AndAnd:
+                        val = at & bt
+                        valid = (aa & bb) | (aa & ~at) | (bb & ~bt)
+                    elif op == Op.OrOr:
+                        val = at | bt
+                        valid = (aa & bb) | (aa & at) | (bb & bt)
+                    else:  # Xor
+                        val = at ^ bt
+                        valid = aa & bb
+                    return val, valid
+                return logic, ("logic", int(op), asig, bsig)
+            if op in _CMP_OPS:
+                return self._compare(e)
+            raise _Unbatchable(f"op {op!r}")
+        if tp in (ExprType.IS_NULL, ExprType.IS_NOT_NULL):
+            c = e.children[0]
+            if c.tp != ExprType.COLUMN_REF \
+                    or c.val not in self.batch.columns:
+                raise _Unbatchable("IS NULL on non-column")
+            cid = c.val
+            self.cids.add(cid)
+            neg = tp == ExprType.IS_NULL
+
+            def isnull(planes, pi, pf, cid=cid, neg=neg):
+                _, va = planes[cid]
+                return (jnp.logical_not(va) if neg else va), jnp.bool_(True)
+            return isnull, ("isnull" if neg else "isnotnull", cid)
+        raise _Unbatchable(f"expr type {tp!r}")
+
+    def _compare(self, e):
+        """COLUMN_REF <cmp> VALUE with the literal hoisted to a per-slot
+        parameter. Domain/scale handling mirrors exprc._align so the
+        traced graph is identical to what solo compilation would build."""
+        import jax.numpy as jnp
+
+        from tidb_tpu import mysqldef as my
+        from tidb_tpu.ops.exprc import (
+            DEC_ABS_LIMIT, MAX_DEC_SCALE,
+        )
+        from tidb_tpu.types.datum import Kind
+        left, right = e.children
+        for a, b, flip in ((left, right, False), (right, left, True)):
+            if a.tp == ExprType.COLUMN_REF and b.tp == ExprType.VALUE:
+                col_e, val_e = a, b
+                op = _FLIP[e.op] if flip else e.op
+                break
+        else:
+            raise _Unbatchable("compare without a column/literal pair")
+        cd = self.batch.columns.get(col_e.val)
+        if cd is None:
+            raise _Unbatchable(f"column {col_e.val} not packed")
+        cid = col_e.val
+        self.cids.add(cid)
+        d = val_e.val
+        if d.is_null():
+            # NULL literal: exprc yields valid=False everywhere — the
+            # compare contributes an all-invalid plane, no parameter
+            def nullcmp(planes, pi, pf, cid=cid):
+                _, va = planes[cid]
+                z = jnp.zeros_like(va)
+                return z, z
+            return nullcmp, ("nullcmp", cid)
+        cmp = _cmp_fn(op)
+
+        # --- string dictionary columns: compare in code space ---------
+        if cd.kind == col.K_STR:
+            if d.kind not in (Kind.STRING, Kind.BYTES):
+                raise _Unbatchable("non-string literal vs dict column")
+            const = d.get_bytes()
+            # the graph op and the host-precomputed code parameter mirror
+            # exprc._compile_str_cmp: EQ/NE compare the exact code (-1
+            # when absent: codes are non-negative, so == is all-false and
+            # != all-true, same as exprc's zeros/ones branches); ordered
+            # compares use the dictionary bounds (codes sorted by bytes)
+            if op in (Op.EQ, Op.NE):
+                j = self._param_i(cd.code_of(const))
+                gop = "eq" if op == Op.EQ else "ne"
+            elif op in (Op.LT, Op.LE):
+                j = self._param_i(cd.lower_bound(const) if op == Op.LT
+                                  else cd.upper_bound(const))
+                gop = "lt"
+            else:  # GT / GE
+                j = self._param_i(cd.upper_bound(const) if op == Op.GT
+                                  else cd.lower_bound(const))
+                gop = "ge"
+            gfn = {"eq": lambda c, p: c == p, "ne": lambda c, p: c != p,
+                   "lt": lambda c, p: c < p,
+                   "ge": lambda c, p: c >= p}[gop]
+
+            def strcmp(planes, pi, pf, cid=cid, j=j, gfn=gfn):
+                codes, va = planes[cid]
+                return gfn(codes, pi[j]), va
+            return strcmp, ("strcmp", gop, cid)
+
+        # --- temporal columns vs string/TIME literal → packed int ------
+        lv = None
+        if cd.kind == col.K_I64 and cd.tp in my.TIME_TYPES \
+                and d.kind in (Kind.STRING, Kind.BYTES):
+            from tidb_tpu.types.time_types import parse_time
+            try:
+                lv = ("i", parse_time(d.get_string()).to_packed_int())
+            except Exception:
+                raise _Unbatchable("unparseable date constant")
+        elif d.kind == Kind.TIME:
+            lv = ("i", int(d.val.to_packed_int()))
+        elif d.kind in (Kind.INT64, Kind.UINT64):
+            lv = ("i", int(d.val))
+        elif d.kind == Kind.FLOAT64:
+            lv = ("f", float(d.val))
+        elif d.kind == Kind.DECIMAL:
+            exp = -d.val.as_tuple().exponent
+            scale = max(0, exp)
+            if scale > MAX_DEC_SCALE:
+                raise _Unbatchable("decimal literal scale too fine")
+            lv = ("d", int(d.val * (10 ** scale)), scale)
+            if abs(lv[1]) >= DEC_ABS_LIMIT:
+                raise _Unbatchable("decimal literal exceeds int64")
+        else:
+            raise _Unbatchable(f"literal kind {d.kind!r}")
+
+        # --- numeric compare, exprc._align's domain rules --------------
+        if cd.kind == col.K_F64 or lv[0] == "f":
+            # float context: both sides to f64 exactly as _to_f64 does
+            # (the host computes the parameter with the same f64 ops the
+            # device graph would, so the bits agree)
+            if lv[0] == "f":
+                p = lv[1]
+            elif lv[0] == "d":
+                p = float(np.float64(lv[1]) / np.float64(10.0 ** lv[2]))
+            else:
+                p = float(np.float64(lv[1]))
+            j = self._param_f(p)
+            dec_scale = cd.dec_scale if cd.kind == col.K_DEC else 0
+
+            def fcmp(planes, pi, pf, cid=cid, j=j, cmp=cmp,
+                     dec_scale=dec_scale):
+                v, va = planes[cid]
+                f = v.astype(jnp.float64) if v.dtype != jnp.float64 else v
+                if dec_scale:
+                    f = f / (10.0 ** dec_scale)
+                return cmp(f, pf[j]), va
+            return fcmp, ("cmp", int(op), cid, "f64", dec_scale)
+
+        # exact integer domain: fixed-point rescale to the max scale with
+        # the same overflow proofs _align runs (an unprovable rescale is
+        # unbatchable — the CPU engine answers exactly instead)
+        col_scale = cd.dec_scale if cd.kind == col.K_DEC else 0
+        lit_scale = lv[2] if lv[0] == "d" else 0
+        s = max(col_scale, lit_scale)
+        col_mul = 10 ** (s - col_scale)
+        lit_iv = lv[1] * (10 ** (s - lit_scale))
+        if s and abs(lit_iv) >= DEC_ABS_LIMIT:
+            raise _Unbatchable("fixed-point literal rescale may exceed int64")
+        max_abs = getattr(cd, "max_abs", None)
+        if col_mul != 1:
+            if max_abs is None or max_abs * col_mul >= DEC_ABS_LIMIT:
+                raise _Unbatchable("fixed-point rescale unprovable")
+        j = self._param_i(lit_iv)
+
+        def icmp(planes, pi, pf, cid=cid, j=j, cmp=cmp, col_mul=col_mul):
+            v, va = planes[cid]
+            if v.dtype != jnp.int64:
+                v = v.astype(jnp.int64)
+            if col_mul != 1:
+                v = v * jnp.int64(col_mul)
+            return cmp(v, pi[j]), va
+        return icmp, ("cmp", int(op), cid, "i64", col_mul)
+
+
+def _slot_bucket(k: int) -> int:
+    for b in _SLOT_BUCKETS:
+        if k <= b:
+            return b
+    return _SLOT_BUCKETS[-1]
+
+
+class _Entry:
+    __slots__ = ("req", "sel", "batch", "fn", "sig", "pi", "pf", "cids",
+                 "cols", "event", "result", "error", "degrade", "taken")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.degrade = None     # None | "solo" | "stall" | "fault"
+        self.taken = False
+
+    @property
+    def group_key(self):
+        return (self.batch._uid, self.sig)
+
+
+class MicroBatcher:
+    """One per TpuClient (all sessions of a store share the client, so
+    concurrent below-floor statements meet here). Leader/follower gather
+    protocol: the first submitter of a cycle owns the window and the
+    dispatch; followers block on their entry's event with deadline
+    polling and a stall patience, so a wedged leader degrades followers
+    to the solo route instead of wedging the statement."""
+
+    # a signature stays "hot" this long after its last MULTI-statement
+    # batch: heavy traffic keeps flowing, so a singleton that just missed
+    # its wave rides a 1-slot dispatch instead of dropping to the row
+    # engine (and re-stalling the next wave behind its slow scan). Low
+    # traffic never heats a signature — the dispatch-floor economics for
+    # genuinely-idle connections are untouched.
+    HOT_SIG_S = 2.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue: list[_Entry] = []
+        self._leader_active = False
+        self._fn_cache: dict = {}
+        self._hot: dict = {}        # sig → monotonic ts of last multi-batch
+        self._last_submit = 0.0     # traffic gate: ts of the last submit
+        self._last_thread = None    # ... and which thread submitted it
+        self._last_multi = 0.0      # ts of the last multi-statement batch
+
+    # ------------------------------------------------------------------
+    # eligibility + lowering (on the submitting statement's thread)
+    # ------------------------------------------------------------------
+
+    def _prepare(self, client, req: kv.Request, sel) -> _Entry | None:
+        if req.tp != kv.REQ_TYPE_SELECT or sel.table_info is None:
+            return None
+        if sel.is_agg() or sel.order_by or sel.having is not None \
+                or sel.where is None:
+            return None
+        try:
+            batch = client._get_batch(sel, req.key_ranges)
+        except (Unsupported, errors.TypeError_):
+            return None
+        lw = _Lowerer(batch)
+        try:
+            fn, sig = lw.lower(sel.where)
+        except _Unbatchable:
+            return None
+        e = _Entry()
+        e.req, e.sel, e.batch = req, sel, batch
+        e.fn, e.cids = fn, frozenset(lw.cids)
+        # parameter COUNTS ride the signature so equal sigs guarantee
+        # aligned parameter blocks
+        e.sig = (sig, len(lw.pi), len(lw.pf))
+        e.pi = np.asarray(lw.pi, dtype=np.int64)
+        e.pf = np.asarray(lw.pf, dtype=np.float64)
+        e.cols = list(sel.table_info.columns)
+        return e
+
+    # ------------------------------------------------------------------
+    # gather protocol
+    # ------------------------------------------------------------------
+
+    def submit(self, client, req: kv.Request, sel):
+        """Try to answer a below-floor request through a shared batched
+        dispatch. Returns a kv.Response, or None when the caller should
+        take the solo route (unbatchable shape, no peers, or a degraded
+        batch — degradations are counted on copr.degraded_batch)."""
+        from tidb_tpu import metrics, tracing
+        window_s = max(0.0, client.batch_window_ms) / 1000.0
+        # traffic gate: with NO concurrent traffic in sight — nothing
+        # queued, no recent multi-batch, and no recent submit from a
+        # DIFFERENT connection thread — the solo route answers
+        # immediately. A lone connection pays neither the gather window
+        # nor a speculative plane pack no matter how fast it issues
+        # statements (its own back-to-back submits are one thread); the
+        # gate opens on the second statement of any cross-connection
+        # burst (the first one of a cold burst routes solo, then
+        # heat/queue keep the tier engaged).
+        now = time.monotonic()
+        me = threading.get_ident()
+        with self._lock:   # atomic read+update: a cold burst must gate
+            prev = self._last_submit        # out exactly ONE statement
+            prev_thread = self._last_thread
+            self._last_submit = now
+            self._last_thread = me
+            gate = (not self._queue
+                    and now - self._last_multi > self.HOT_SIG_S
+                    and (prev_thread == me
+                         or now - prev > max(2 * window_s, 0.02)))
+        if gate:
+            return None
+        entry = self._prepare(client, req, sel)
+        if entry is None:
+            return None
+        with self._lock:
+            self._queue.append(entry)
+            is_leader = not self._leader_active
+            if is_leader:
+                self._leader_active = True
+            metrics.gauge("sched.queue_depth").set(len(self._queue))
+        if is_leader:
+            self._lead(client, entry, window_s)
+        else:
+            self._follow(client, entry, window_s)
+        # ---- shared completion handling, on the statement's own thread
+        if entry.error is not None:
+            raise entry.error
+        if entry.result is not None:
+            tracing.count("batched")
+            metrics.counter("sched.batched_statements").inc()
+            tracing.current().set("route", "batched")
+            return _BatchedResponse(entry.result)
+        if entry.degrade in ("stall", "fault"):
+            # a stalled window / faulted shared dispatch degrades THIS
+            # statement to the solo below-floor route, answers unchanged
+            tracing.record_degraded("batch")
+        return None
+
+    def _lead(self, client, own: _Entry, window_s: float) -> None:
+        stall_err = None
+        try:
+            if failpoint._active:
+                # the gather-window fault site: sleep stretches the
+                # window (followers eventually self-degrade), hang parks
+                # the leader until release/deadline
+                failpoint.eval("sched/batch_window")
+            bo = kvbackoff.current()
+            if window_s > 0:
+                # never sleep past the statement deadline: the window
+                # truncates to the remaining budget, then the check
+                # below fails the leader typed (followers degrade solo)
+                if bo is not None and bo.deadline is not None:
+                    window_s = min(window_s,
+                                   max(0.0, bo.deadline - time.monotonic()))
+                if window_s > 0:
+                    time.sleep(window_s)
+            if bo is not None:
+                bo.check_deadline("micro-batch gather")
+        except BaseException as e:  # retryable-ok: routed below — the
+            # leader's own statement re-raises typed errors; a stalled
+            # window degrades every gathered entry to the solo route
+            stall_err = e
+        with self._lock:
+            entries = [e for e in self._queue]
+            self._queue.clear()
+            for e in entries:
+                e.taken = True
+            self._leader_active = False
+            from tidb_tpu import metrics
+            metrics.gauge("sched.queue_depth").set(0)
+        if stall_err is not None:
+            for e in entries:
+                if e is not own:
+                    e.degrade = "stall"
+                e.event.set()
+            if isinstance(stall_err, errors.DeadlineExceededError):
+                own.error = stall_err   # typed statement failure
+            else:
+                own.degrade = "stall"
+            return
+        self._execute(client, entries, own)
+
+    def _follow(self, client, entry: _Entry, window_s: float) -> None:
+        bo = kvbackoff.current()
+        patience = max(0.05, window_s * 5)
+        end = time.monotonic() + patience
+        # wake cadence: stall detection needs only coarse ticks; with a
+        # deadline, wake just often enough to fail it promptly (a fixed
+        # fine poll would burn the GIL exactly on the hot path)
+        step = 0.05
+        if bo is not None and bo.deadline is not None:
+            step = min(step, max(
+                0.002, bo.deadline - time.monotonic()))
+        while not entry.event.wait(step):
+            if bo is not None:
+                try:
+                    bo.check_deadline("micro-batch gather")
+                except errors.DeadlineExceededError as e:
+                    with self._lock:
+                        if not entry.taken and entry in self._queue:
+                            self._queue.remove(entry)
+                    # only the expired statement fails — its slot (if
+                    # already taken) computes a result nobody reads
+                    entry.error = e
+                    return
+            if time.monotonic() >= end:
+                with self._lock:
+                    if not entry.taken and entry in self._queue:
+                        # leader stalled without draining: reclaim the
+                        # entry and take the solo route
+                        self._queue.remove(entry)
+                        entry.degrade = "stall"
+                        return
+                # taken: the leader is executing — keep waiting (its own
+                # deadline/failpoint handling bounds the dispatch)
+                end = time.monotonic() + patience
+
+    # ------------------------------------------------------------------
+    # batch execution (leader thread)
+    # ------------------------------------------------------------------
+
+    def _execute(self, client, entries: list[_Entry], own: _Entry) -> None:
+        groups: dict = {}
+        for e in entries:
+            groups.setdefault(e.group_key, []).append(e)
+        for group in groups.values():
+            try:
+                if len(group) == 1 and not self._sig_hot(group[0].sig):
+                    # no peers shared this shape and traffic on it is
+                    # cold: nothing to amortize — the solo route answers
+                    # (not a degradation)
+                    group[0].degrade = "solo"
+                else:
+                    # a HOT singleton (its shape batched within
+                    # HOT_SIG_S) rides a 1-slot dispatch: under
+                    # sustained traffic the planes are device-resident
+                    # and a wave is always in flight, so dropping a
+                    # straggler to the row engine would cost more AND
+                    # de-align the next wave behind its slow scan
+                    for i in range(0, len(group), MAX_SLOTS):
+                        self._dispatch_chunk(client,
+                                             group[i:i + MAX_SLOTS])
+            except errors.DeadlineExceededError as dl:
+                # the LEADER's statement deadline expired inside the
+                # shared dispatch: only the leader fails typed; its
+                # batch-mates degrade to the solo route
+                for e in group:
+                    if e.result is not None:
+                        continue
+                    if e is own:
+                        e.error = dl
+                    else:
+                        e.degrade = "fault"
+            except Exception:
+                # device fault (real or injected) inside the shared
+                # dispatch: every unanswered entry of the group degrades
+                # to the solo route — answers unchanged by construction
+                for e in group:
+                    if e.result is None:
+                        e.degrade = "fault"
+            finally:
+                for e in group:
+                    e.event.set()
+
+    def _sig_hot(self, sig) -> bool:
+        with self._lock:
+            ts = self._hot.get(sig)
+        return ts is not None and time.monotonic() - ts < self.HOT_SIG_S
+
+    def _kernel(self, client, proto: _Entry, kb: int):
+        """Shared-shape jit cache: one traced+jitted callable per
+        (signature, slot bucket, capacity) — N concurrent statements of
+        one shape compile once, and later batches of the same shape skip
+        tracing entirely (counted on the statement's jit_hits). Lock-
+        guarded: overlapping leaders (leadership releases at drain, so
+        cycles pipeline) must not race the insert/eviction."""
+        from tidb_tpu import tracing
+        key = (proto.sig, kb, proto.batch.capacity)
+        with self._lock:
+            ent = self._fn_cache.get(key)
+        tracing.record_jit_cache(hit=ent is not None)
+        if ent is None:
+            import jax
+            import jax.numpy as jnp
+            if failpoint._active:
+                failpoint.eval("device/compile", lambda: errors.DeviceError(
+                    "injected kernel compile failure (batched_filter)"))
+            root = proto.fn
+
+            def wrapper(planes, live, pi, pf):
+                def one(pi_row, pf_row):
+                    v, va = root(planes, pi_row, pf_row)
+                    return live & va & _truthy(v)
+                masks = jax.vmap(one)(pi, pf)       # [kb, capacity] bool
+                # one packed f64 readback (exact for bools), like
+                # kernels.pack_outputs' narrow-output slots
+                return masks.astype(jnp.float64).reshape(-1)
+
+            try:
+                ent = (jax.jit(wrapper), {"runs": 0})
+            except (errors.TiDBError, Unsupported):
+                raise
+            except Exception as e:
+                raise errors.DeviceError(
+                    f"batched kernel build failed: {e}") from e
+            with self._lock:
+                cur = self._fn_cache.get(key)
+                if cur is not None:
+                    return cur          # a concurrent leader built it
+                self._fn_cache[key] = ent
+                if len(self._fn_cache) > 256:
+                    self._fn_cache.pop(next(iter(self._fn_cache)))
+        return ent
+
+    def _dispatch_chunk(self, client, chunk: list[_Entry]) -> None:
+        import jax.numpy as jnp
+
+        from tidb_tpu import metrics
+        from tidb_tpu.ops import kernels
+        proto = chunk[0]
+        batch = proto.batch
+        k = len(chunk)
+        kb = _slot_bucket(k)
+        n_i, n_f = proto.sig[1], proto.sig[2]
+        pi = np.zeros((kb, n_i), dtype=np.int64)
+        pf = np.zeros((kb, n_f), dtype=np.float64)
+        for j, e in enumerate(chunk):
+            pi[j], pf[j] = e.pi, e.pf
+        for j in range(k, kb):          # pad slots replay the last entry
+            pi[j], pf[j] = chunk[-1].pi, chunk[-1].pf
+        jitted, kst = self._kernel(client, proto, kb)
+        planes = kernels.batch_planes(batch)
+        sub = {cid: planes[cid] for cid in proto.cids}
+        live = kernels.device_live(batch)
+        packed = client._dispatch_kernel(
+            jitted, sub, live, "batched_filter", kst,
+            extra=(jnp.asarray(pi), jnp.asarray(pf)),
+            attrs={"batch_size": k, "batch_slots": kb})
+        masks = packed.reshape(kb, batch.capacity)[:k].astype(bool)
+        metrics.counter("sched.batched_dispatches").inc()
+        metrics.histogram("sched.batch_size").observe(k)
+        if k > 1:
+            with self._lock:
+                self._hot[proto.sig] = self._last_multi = time.monotonic()
+                if len(self._hot) > 256:
+                    self._hot.pop(next(iter(self._hot)))
+        for j, e in enumerate(chunk):
+            idx = np.nonzero(masks[j])[0]
+            if e.sel.desc:
+                idx = idx[::-1]
+            if e.sel.limit is not None:
+                idx = idx[: e.sel.limit]
+            e.result = self._emit(client, e, idx)
+
+    # ------------------------------------------------------------------
+    # per-statement emission — THE solo emission path, with the entry's
+    # own columns (the batched and solo routes cannot diverge)
+    # ------------------------------------------------------------------
+
+    def _emit(self, client, e: _Entry, idx) -> SelectResponse:
+        return client._emit_rows(e.sel, e.batch, idx, cols=e.cols)
+
+
+class _BatchedResponse(kv.Response):
+    def __init__(self, resp: SelectResponse):
+        self._resp = resp
+
+    def next(self):
+        r, self._resp = self._resp, None
+        return r

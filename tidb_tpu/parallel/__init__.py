@@ -93,8 +93,10 @@ class CoprMesh:
             self._jit_cache[id(fn)] = ent
             if len(self._jit_cache) > 256:
                 self._jit_cache.pop(next(iter(self._jit_cache)))
-        packed = ent[2](planes, jnp.asarray(live))
-        return _kernels.unpack_outputs(ent[1], np.asarray(packed))
+        live_d = jnp.asarray(live)
+        with _kernels.dispatch_serial:
+            packed = np.asarray(ent[2](planes, live_d))
+        return _kernels.unpack_outputs(ent[1], packed)
 
     # the client calls these; signatures match the single-chip jit path
     def run_scalar(self, fn, planes, live):
@@ -130,5 +132,7 @@ class CoprMesh:
             self._jit_cache[key] = ent
             if len(self._jit_cache) > 256:
                 self._jit_cache.pop(next(iter(self._jit_cache)))
-        packed = ent[2](planes, jnp.asarray(live))
-        return _kernels.unpack_outputs(ent[1], np.asarray(packed))
+        live_d = jnp.asarray(live)
+        with _kernels.dispatch_serial:
+            packed = np.asarray(ent[2](planes, live_d))
+        return _kernels.unpack_outputs(ent[1], packed)

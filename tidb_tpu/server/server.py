@@ -1,13 +1,23 @@
-"""TCP server: listener, connection registry, token limit.
+"""TCP server: listener, connection registry, admission gate.
 
 Reference: server/server.go:65 (Server struct, Run loop :130, connection
 limit via tokenlimiter.go, status info :213). Threads stand in for
-goroutines: one accept loop plus one thread per connection, bounded by a
-semaphore token exactly like the reference's token limiter.
+goroutines: one accept loop plus a BOUNDED set of connection workers.
+
+Admission gate (the heavy-traffic concurrency tier's front door):
+active connections are served by at most @@max_connections workers
+(worker threads are REUSED for queued connections, so worker count is
+bounded by the sysvar, not by connection churn); accepted sockets past
+that wait in a bounded admission queue (@@tidb_tpu_conn_queue_depth)
+until a worker frees; past the queue too, the client gets a TYPED
+ER 1040 "Too many connections" instead of the old silent close — so
+overload degrades gracefully (queueing, then typed rejection) instead
+of collapsing.
 """
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import socket
@@ -25,6 +35,10 @@ class Server:
         self.host = host
         self.port = port
         self.running = False
+        # constructor-level cap kept for embedders; the effective worker
+        # bound is min(token_limit, @@max_connections) read live per
+        # accept, so SET GLOBAL max_connections applies without restart
+        self.token_limit = token_limit
         # wire connection ids come from the SESSION id space — a separate
         # counter would collide with library/internal session ids in
         # SHOW PROCESSLIST / KILL / perfschema thread ids
@@ -32,7 +46,10 @@ class Server:
         self._conn_ids = _conn_id_gen
         self._conns: set[ClientConnection] = set()
         self._conns_lock = threading.Lock()
-        self._tokens = threading.BoundedSemaphore(token_limit)
+        # admission state: active workers + pending (accepted, unserved)
+        self._admission_lock = threading.Lock()
+        self._active_workers = 0
+        self._pending: collections.deque = collections.deque()
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         # one internal session for auth lookups (session.go ExecRestrictedSQL)
@@ -71,29 +88,108 @@ class Server:
         if self.status_port is not None:
             self._start_status_server()
 
+    def _int_sysvar(self, name: str) -> int:
+        from tidb_tpu.sessionctx import store_int_sysvar
+        return store_int_sysvar(self.store, name)
+
+    def max_connections(self) -> int:
+        """Live worker bound: min(constructor token_limit,
+        @@max_connections) — SET GLOBAL applies to the next accept."""
+        return max(1, min(self.token_limit,
+                          self._int_sysvar("max_connections")))
+
     def _accept_loop(self) -> None:
+        from tidb_tpu import metrics
+        qd = metrics.gauge("server.conn_queue_depth")
         while self.running:
             try:
                 sock, _addr = self._listener.accept()
             except OSError:
                 return
-            if not self._tokens.acquire(blocking=False):
-                sock.close()  # over the connection limit (tokenlimiter.go)
-                continue
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn = ClientConnection(self, sock, next(self._conn_ids))
-            from tidb_tpu import metrics
-            metrics.counter("server.connections_total").inc()
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=conn.run, daemon=True,
-                             name=f"tidb-conn-{conn.conn_id}").start()
+            limit = self.max_connections()
+            depth = max(0, self._int_sysvar("tidb_tpu_conn_queue_depth"))
+            with self._admission_lock:
+                if self._active_workers < limit:
+                    self._active_workers += 1
+                    threading.Thread(
+                        target=self._conn_worker, args=(sock,), daemon=True,
+                        name=f"tidb-conn-worker-{self._active_workers}"
+                    ).start()
+                    continue
+                if len(self._pending) < depth:
+                    # saturated workers: queue until one frees (graceful
+                    # degradation — latency, not failure)
+                    self._pending.append(sock)
+                    qd.set(len(self._pending))
+                    metrics.counter("server.queued_connections").inc()
+                    continue
+            # queue full too: typed rejection (MySQL ER_CON_COUNT_ERROR),
+            # never a silent close the client can't distinguish from a
+            # network fault
+            self._reject(sock)
+
+    def _reject(self, sock) -> None:
+        from tidb_tpu import metrics, mysqldef as my
+        from tidb_tpu.server import protocol as p
+        from tidb_tpu.server.packetio import PacketIO
+        metrics.counter("server.rejected_connections").inc()
+        pkt = PacketIO(sock)
+        try:
+            pkt.write_packet(p.err_packet(
+                my.ErrConCount, "Too many connections", "08004"))
+        except OSError:
+            pass
+        finally:
+            pkt.close()
+
+    def _conn_worker(self, sock) -> None:
+        """One BOUNDED connection worker: serves a connection to
+        completion, then takes the next queued socket — worker threads
+        are reused across queued connections, so the thread count is
+        capped by max_connections regardless of connection churn. A
+        crash escaping the serve loop must still release the admission
+        slot (and hand queued sockets to a fresh worker): a leaked slot
+        would count phantom connections against max_connections
+        forever."""
+        from tidb_tpu import metrics
+        qd = metrics.gauge("server.conn_queue_depth")
+        while True:
+            ok = False
+            try:
+                self._serve_conn(sock)
+                ok = True
+            finally:
+                if not ok:
+                    with self._admission_lock:
+                        self._active_workers -= 1
+                        if self._pending and self.running:
+                            nxt = self._pending.popleft()
+                            qd.set(len(self._pending))
+                            self._active_workers += 1
+                            threading.Thread(
+                                target=self._conn_worker, args=(nxt,),
+                                daemon=True,
+                                name="tidb-conn-worker-r").start()
+            with self._admission_lock:
+                if self._pending and self.running:
+                    sock = self._pending.popleft()
+                    qd.set(len(self._pending))
+                else:
+                    self._active_workers -= 1
+                    return
+
+    def _serve_conn(self, sock) -> None:
+        from tidb_tpu import metrics
+        conn = ClientConnection(self, sock, next(self._conn_ids))
+        metrics.counter("server.connections_total").inc()
+        with self._conns_lock:
+            self._conns.add(conn)
+        conn.run()
 
     def deregister(self, conn: ClientConnection) -> None:
         with self._conns_lock:
-            if conn in self._conns:
-                self._conns.discard(conn)
-                self._tokens.release()
+            self._conns.discard(conn)
 
     def _start_status_server(self) -> None:
         server = self
@@ -140,6 +236,14 @@ class Server:
         if self._listener is not None:
             try:
                 self._listener.close()
+            except OSError:
+                pass
+        with self._admission_lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        for sock in pending:
+            try:
+                sock.close()
             except OSError:
                 pass
         with self._conns_lock:

@@ -465,13 +465,13 @@ class Session:
             # Backoff/degradation/retry tallies follow: a slow statement
             # shows WHERE its time went (retry sleeps) and which tiers
             # it fell back through.
-            for key in ("plane_cache_hits", "plane_cache_misses",
+            for key in ("batched", "plane_cache_hits", "plane_cache_misses",
                         "plane_cache_evictions",
                         "plane_cache_invalidations_epoch",
                         "plane_cache_invalidations_version",
                         "backoff_retries", "backoff_ms", "session_retries",
                         "degraded_device", "degraded_join",
-                        "degraded_combine"):
+                        "degraded_combine", "degraded_batch"):
                 if kt.get(key):
                     detail += f" {key}:{kt[key]}"
             if root_span is not None:
@@ -996,6 +996,38 @@ class Session:
             if not enabled:
                 pc.clear()
 
+    def apply_tpu_micro_batch(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_micro_batch = 0|1 — the micro-batch tier
+        kill switch: 0 pins every below-floor statement to the solo
+        route (the parity oracle for batched dispatch)."""
+        self._apply_tpu_bool_switch("tidb_tpu_micro_batch", "micro_batch",
+                                    value)
+
+    def apply_tpu_batch_window(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_batch_window_ms = N — how long the first
+        below-floor statement of a gather cycle waits for peers."""
+        ms = self._int_sysvar("tidb_tpu_batch_window_ms", value)
+        self._require_global_grant("tidb_tpu_batch_window_ms")
+        client = self.store.get_client()
+        for target in (client, getattr(client, "cpu", None)):
+            if target is not None and hasattr(target, "batch_window_ms"):
+                target.batch_window_ms = ms
+
+    def apply_conn_queue_depth(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_conn_queue_depth = N — the wire server's
+        admission queue depth (read live per accept; no state to flip)."""
+        self._int_sysvar("tidb_tpu_conn_queue_depth", value)
+        self._require_global_grant("tidb_tpu_conn_queue_depth")
+
+    def apply_drain_pool_size(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_drain_pool_size = N — the shared fan-out
+        drain pool's worker bound. Process-wide (every store's fan-outs
+        share the pool), like tidb_tpu_mesh."""
+        n = self._int_sysvar("tidb_tpu_drain_pool_size", value, 1)
+        self._require_global_grant("tidb_tpu_drain_pool_size")
+        from tidb_tpu.cluster.pool import set_pool_size
+        set_pool_size(n)
+
     def apply_tpu_mesh(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_mesh = 0|1 — the mesh execution tier
         (ops.mesh): off pins the partial-aggregate combine and the join
@@ -1274,19 +1306,24 @@ def bootstrap(session: Session) -> None:
                     for var, attr in (
                             ("tidb_tpu_device_join", "device_join"),
                             ("tidb_tpu_columnar_scan", "columnar_scan"),
+                            ("tidb_tpu_micro_batch", "micro_batch"),
                             ("tidb_tpu_plane_cache",
                              "plane_cache_enabled")):
                         v = gv.values.get(var)
                         if v is not None and hasattr(target, attr):
                             setattr(target, attr, parse_bool_sysvar(v))
-                    fl = gv.values.get("tidb_tpu_dispatch_floor")
-                    try:
-                        if fl is not None and hasattr(target,
-                                                      "dispatch_floor_rows"):
-                            target.dispatch_floor_rows = max(
-                                0, int(fl.strip()))
-                    except ValueError:
-                        pass
+                    for var, attr in (
+                            ("tidb_tpu_dispatch_floor",
+                             "dispatch_floor_rows"),
+                            ("tidb_tpu_batch_window_ms",
+                             "batch_window_ms")):
+                        fl = gv.values.get(var)
+                        try:
+                            if fl is not None and hasattr(target, attr):
+                                setattr(target, attr,
+                                        max(0, int(fl.strip())))
+                        except ValueError:
+                            pass
             # the region plane cache hangs off the store's RPC handler,
             # not a client — hydrate it directly, on EVERY backend path
             # (the 'tpu' branch above installs a TpuClient but must not
@@ -1301,6 +1338,15 @@ def bootstrap(session: Session) -> None:
                 try:
                     if b:
                         pc.set_budget(max(0, int(b.strip())))
+                except ValueError:
+                    pass
+            # the shared drain pool's size is process-level like the mesh
+            # switch — hydrate on every backend path
+            v = gv.values.get("tidb_tpu_drain_pool_size")
+            if v is not None:
+                try:
+                    from tidb_tpu.cluster.pool import set_pool_size
+                    set_pool_size(max(1, int(v.strip())))
                 except ValueError:
                     pass
             # the mesh tier switch is a process-level ops.mesh flag —

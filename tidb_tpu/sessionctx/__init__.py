@@ -73,6 +73,23 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # GLOBAL-only and PROCESS-wide — the mesh spans physical chips, so
     # unlike the per-client switches it flips a module flag.
     "tidb_tpu_mesh": "1",
+    # micro-batch tier (ops.sched) kill switch: 0 pins every below-floor
+    # statement to the solo route (CPU engine) — the parity oracle for
+    # batched dispatch. GLOBAL-only, store-level, like the other tidb_tpu
+    # client switches.
+    "tidb_tpu_micro_batch": "1",
+    # micro-batch gather window in ms: how long the first below-floor
+    # statement of a cycle waits for peers before dispatching. 0 batches
+    # only statements already queued. GLOBAL-only.
+    "tidb_tpu_batch_window_ms": "2",
+    # wire-server admission queue depth: accepted connections past
+    # @@max_connections wait here for a free connection worker; past this
+    # too they are rejected typed (ER 1040). GLOBAL-only.
+    "tidb_tpu_conn_queue_depth": "64",
+    # shared fan-out drain pool size (parallel.pool): ONE bounded worker
+    # pool drains every statement's per-region coprocessor fan-out —
+    # process-wide like tidb_tpu_mesh. GLOBAL-only.
+    "tidb_tpu_drain_pool_size": "16",
     "tidb_slow_log_threshold": "300",   # ms; statements slower than this
     #                                     hit the tidb_tpu.slowlog logger
     # statement deadline in ms (0 = unlimited): every retry ladder of a

@@ -224,13 +224,13 @@ class trace:
 # responses; see copr.plane_cache)
 COUNTER_KEYS = ("kernel_dispatches", "kernel_dispatch_us",
                 "readbacks", "readback_bytes",
-                "jit_hits", "jit_misses",
+                "jit_hits", "jit_misses", "batched",
                 "plane_cache_hits", "plane_cache_misses",
                 "plane_cache_evictions", "plane_cache_invalidations_epoch",
                 "plane_cache_invalidations_version",
                 "backoff_retries", "backoff_ms", "session_retries",
                 "degraded_device", "degraded_join", "degraded_combine",
-                "degraded_mesh")
+                "degraded_mesh", "degraded_batch")
 
 
 def _tally() -> dict:
