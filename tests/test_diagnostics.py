@@ -1,0 +1,632 @@
+"""Cluster diagnostics tier: queryable metrics time series, the
+slow-statement flight recorder, the device-utilization profiler, and the
+automatic inspection rules.
+
+Four surfaces, each driven through real workload (and, for the rules,
+the failpoint chaos schedule that produces its pathology):
+
+  1. information_schema.TIDB_TPU_METRICS / TIDB_TPU_METRICS_HISTORY —
+     `SELECT` over current values and time-bucketed samples with
+     delta/rate, covering the copr/sched/pool/cache/mesh families.
+  2. TIDB_TPU_SLOW_TRACES — a statement slowed by an injected failpoint
+     lands its FULL span tree despite tidb_trace_enabled = 0; healthy
+     statements retain nothing (the extended PR 4 guard lives in
+     test_tracing).
+  3. the profiler: device.busy_fraction from the metered dispatch lock,
+     batch slot occupancy/padding waste, drain-pool queue wait and
+     worker utilization, mesh shard balance, HBM pinned attribution —
+     plus the quiesced-gauge fix (sched/pool queue depths report 0).
+  4. each inspection rule fires under its driving chaos schedule and
+     CLEARS after recovery (the window slides past the burst).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+
+import pytest
+
+from tidb_tpu import errors, failpoint, flight, inspection, metrics
+from tidb_tpu import tablecodec as tc
+from tidb_tpu.metrics import timeseries
+from tidb_tpu.ops import TpuClient
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 1200
+JOIN_AGG_Q = ("select count(*), sum(t.v), min(t.v), max(d.d_f) "
+              "from t join d on t.k = d.d_k")
+
+
+def _build(n_regions: int = 4):
+    """4-region cluster store with a join-able workload (the
+    test_tracing shape): fused aggregates ride the device combine, the
+    fan-out rides the shared drain pool, packs ride the plane cache."""
+    store = new_store(f"cluster://3/diag{next(_id)}")
+    s = Session(store)
+    s.execute("create database dg")
+    s.execute("use dg")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double)")
+    rows = ", ".join(f"({i}, {i % 7}, {i * 10}, {i}.25)"
+                     for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create table d (d_k bigint primary key, d_f double)")
+    s.execute("insert into d values " +
+              ", ".join(f"({i}, {i}.5)" for i in range(7)))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("dg", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _mk_batch_store(n_rows: int = 2500, window_ms: int = 40):
+    """Local store + TpuClient with the floor raised so every statement
+    is below-floor (test_concurrency_tier's micro-batch regime)."""
+    store = new_store(f"memory://diagb{next(_id)}")
+    s = Session(store)
+    s.execute("set global tidb_slow_log_threshold = 0")
+    s.execute("create database d")
+    s.execute("use d")
+    s.execute("create table t (id bigint primary key, v bigint)")
+    s.execute("insert into t values " +
+              ", ".join(f"({i}, {i % 97})" for i in range(1, n_rows + 1)))
+    store.set_client(TpuClient(store, dispatch_floor_rows=1 << 20))
+    client = store.get_client()
+    client.batch_window_ms = window_ms
+    s.execute("select id from t where v = 0")   # warm the packed batch
+    return store, s, client
+
+
+def _concurrent(store, sqls, setup=(), catch=()):
+    """Execute sqls concurrently (one session each, barrier start);
+    returns (results, caught_errors). Exceptions of types in `catch`
+    are collected, anything else fails the test."""
+    sessions = []
+    for _q in sqls:
+        ss = Session(store)
+        ss.execute("use d")
+        for stmt in setup:
+            ss.execute(stmt)
+        sessions.append(ss)
+    out, caught, errs = {}, [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(sqls))
+
+    def run(ss, q):
+        try:
+            barrier.wait()
+            r = ss.execute(q)[0].values()
+            with lock:
+                out[q] = r
+        except catch as e:
+            with lock:
+                caught.append(e)
+        except Exception as e:   # surfaced by the caller's assert
+            with lock:
+                errs.append((q, e))
+    ts = [threading.Thread(target=run, args=(ss, q))
+          for ss, q in zip(sessions, sqls)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs, errs[:3]
+    return out, caught
+
+
+def _flush_window(n: int | None = None) -> None:
+    """Push the inspection window past whatever the previous test (or
+    burst) left in it: force `n` fresh samples (the recorder coalesces
+    sub-ms forced samples, so space them)."""
+    n = (inspection.WINDOW_SAMPLES + 2) if n is None else n
+    for _ in range(n):
+        timeseries.recorder.sample()
+        time.sleep(0.002)
+
+
+def _rows(s, sql):
+    return s.execute(sql)[0].values()
+
+
+def _sv(v):
+    return v.decode() if isinstance(v, bytes) else v
+
+
+# ---------------------------------------------------------------------------
+# 1. metrics tables
+# ---------------------------------------------------------------------------
+
+class TestMetricsTables:
+    def test_current_metrics_typed_and_documented(self):
+        s = _build()
+        s.execute(JOIN_AGG_Q)
+        rows = _rows(s, "select NAME, TYPE, LABELS, METRIC_VALUE, HELP "
+                        "from information_schema.TIDB_TPU_METRICS")
+        by_name: dict = {}
+        for name, tp, labels, val, help_ in rows:
+            by_name.setdefault(_sv(name), []).append(
+                (_sv(tp), _sv(labels), val, _sv(help_)))
+        # counters/gauges: one row, typed, helped (catalog-documented)
+        for want, wtp in (("ops.kernel_dispatches", "counter"),
+                          ("copr.plane_cache.bytes", "gauge"),
+                          ("copr.drain_pool.tasks", "counter")):
+            assert want in by_name, f"{want} missing from TIDB_TPU_METRICS"
+            tp, labels, val, help_ = by_name[want][0]
+            assert tp == wtp and labels == "" and help_, (want, tp, help_)
+            assert val >= 0
+        # histograms expand to stat-labeled count/sum/avg rows
+        hist = by_name.get("session.parse_seconds")
+        assert hist is not None and len(hist) == 3
+        stats = {lb for (_t, lb, _v, _h) in hist}
+        assert stats == {'stat="count"', 'stat="sum"', 'stat="avg"'}
+        assert all(t == "histogram" for (t, _l, _v, _h) in hist)
+
+    def test_history_buckets_cover_all_families(self):
+        """The acceptance criterion: SELECT over TIDB_TPU_METRICS_HISTORY
+        returns time-bucketed samples for the copr / sched / pool /
+        cache / mesh families, with sane delta/rate."""
+        s = _build()
+        # sched family needs the micro-batch tier engaged (process-wide
+        # registry, so any store's traffic lands in the same history)
+        bstore, _bs, _bc = _mk_batch_store()
+        sqls = [f"select id from t where v = {k}" for k in (3, 11, 42, 7)]
+        _concurrent(bstore, sqls)
+        base = metrics.counter("ops.kernel_dispatches").value
+        timeseries.recorder.sample()
+        time.sleep(0.002)
+        for _ in range(3):
+            s.execute(JOIN_AGG_Q)           # copr/pool/cache/mesh traffic
+            timeseries.recorder.sample()
+            time.sleep(0.002)
+        rows = _rows(s, "select TS, NAME, TYPE, METRIC_VALUE, DELTA, "
+                        "RATE_PER_SEC from "
+                        "information_schema.TIDB_TPU_METRICS_HISTORY")
+        by_family: dict = {}
+        ts_per_name: dict = {}
+        for ts_, name, tp, val, delta, rate in rows:
+            name = _sv(name)
+            by_family.setdefault(name.split(".")[0], set()).add(name)
+            ts_per_name.setdefault(name, []).append((ts_, val, delta, rate))
+        names = set(ts_per_name)
+        for fam_name in ("copr.plane_cache.hits",
+                         "copr.plane_cache.misses",
+                         "copr.drain_pool.tasks",
+                         "copr.drain_pool.queue_wait_seconds_count",
+                         "copr.mesh.shard_skew",
+                         "sched.batched_dispatches",
+                         "sched.slot_occupancy_count",
+                         "ops.kernel_dispatches",
+                         "device.busy_us"):
+            assert fam_name in names, \
+                f"{fam_name} missing from METRICS_HISTORY ({sorted(by_family)})"
+        # time-bucketed: multiple distinct TS per series
+        kd = ts_per_name["ops.kernel_dispatches"]
+        assert len({t for (t, _v, _d, _r) in kd}) >= 3
+        # deltas reconcile with the counter's true growth across the
+        # window, and rates are non-negative for monotonic series
+        total_delta = sum(d for (_t, _v, d, _r) in kd if d is not None)
+        assert total_delta == kd[-1][1] - kd[0][1]
+        assert kd[-1][1] >= base
+        assert all(r >= 0 for (_t, _v, _d, r) in kd if r is not None)
+
+    def test_history_ring_bounded_by_cap(self):
+        s = _build(1)
+        s.execute("set global tidb_tpu_metrics_history_cap = 5")
+        try:
+            for _ in range(12):
+                timeseries.recorder.sample()
+                time.sleep(0.002)
+            assert timeseries.recorder.cap == 5
+            rows = _rows(s, "select TS from "
+                            "information_schema.TIDB_TPU_METRICS_HISTORY")
+            assert 2 <= len({r[0] for r in rows}) <= 5
+        finally:
+            s.execute("set global tidb_tpu_metrics_history_cap = 240")
+
+    def test_interval_sysvar_validated(self):
+        s = _build(1)
+        with pytest.raises(errors.ExecError):
+            s.execute("set global tidb_tpu_metrics_interval_ms = 'x'")
+        with pytest.raises(errors.ExecError):
+            s.execute("set tidb_tpu_metrics_interval_ms = 50")  # GLOBAL-only
+        s.execute("set global tidb_tpu_metrics_interval_ms = 50")
+        try:
+            assert timeseries.recorder.interval_s == 0.05
+        finally:
+            s.execute("set global tidb_tpu_metrics_interval_ms = 1000")
+
+
+# ---------------------------------------------------------------------------
+# 2. flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_slow_statement_retained_despite_tracing_off(self):
+        """THE acceptance case: a statement slowed by an injected
+        failpoint appears in TIDB_TPU_SLOW_TRACES with its full span
+        tree, even though tidb_trace_enabled = 0 the whole time."""
+        s = _build()
+        assert not s._tracing_enabled()
+        flight.recorder_for(s.store).clear()
+        s.execute("set tidb_slow_log_threshold = 30")
+        failpoint.enable("copr/region_scan", action="sleep", seconds=0.02)
+        try:
+            want = _rows(s, JOIN_AGG_Q)
+        finally:
+            failpoint.disable("copr/region_scan")
+        rows = _rows(s, "select REASON, DURATION_MS, SPAN_COUNT, CONN_ID,"
+                        " DIGEST, SQL_TEXT, TRACE_JSON from "
+                        "information_schema.TIDB_TPU_SLOW_TRACES")
+        assert rows, "slowed statement was not retained"
+        reason, dur, spans, conn, dig, sql, tj = rows[-1]
+        assert _sv(reason) == "slow"
+        assert dur >= 30
+        assert conn == s.vars.connection_id
+        assert _sv(dig)                     # joins to the digest summary
+        assert "from t join d" in _sv(sql)
+        doc = json.loads(_sv(tj))
+        assert doc["name"] == "statement"
+        names = [sp["name"] for sp in _walk(doc)]
+        # the FULL hierarchy: per-region copr tasks under the statement
+        assert names.count("region_task") >= 4, names
+        assert "copr" in names
+        assert spans == len(names) >= 6
+        # answers unchanged by the recording
+        assert want == _rows(s, JOIN_AGG_Q)
+
+    def test_deadline_death_retained_with_error(self):
+        s = _build()
+        flight.recorder_for(s.store).clear()
+        s.execute("set tidb_tpu_max_execution_time = 150")
+        failpoint.enable("copr/region_scan", action="hang")
+        try:
+            with pytest.raises(errors.DeadlineExceededError):
+                s.execute(JOIN_AGG_Q)
+        finally:
+            failpoint.disable("copr/region_scan")
+            s.execute("set tidb_tpu_max_execution_time = 0")
+        rows = _rows(s, "select REASON, ERROR from "
+                        "information_schema.TIDB_TPU_SLOW_TRACES")
+        assert rows
+        reason, err = rows[-1]
+        assert _sv(reason) == "deadline"
+        assert "deadline" in _sv(err).lower() or _sv(err)
+
+    def test_degraded_statement_retained(self):
+        """A statement that fell through a tier is diagnostics-worthy
+        even when it stayed fast: the mesh-collective fault degrades the
+        combine and the trace is kept under its degraded_* reason."""
+        s = _build()
+        s.execute(JOIN_AGG_Q)                    # warm (jit compile)
+        flight.recorder_for(s.store).clear()
+        s.execute("set tidb_slow_log_threshold = 0")   # isolate the reason
+        failpoint.enable("device/mesh_collective")
+        try:
+            got = _rows(s, JOIN_AGG_Q)
+        finally:
+            failpoint.disable("device/mesh_collective")
+        assert got == _rows(s, JOIN_AGG_Q)       # answers unchanged
+        rows = _rows(s, "select REASON, KERNEL_DISPATCHES from "
+                        "information_schema.TIDB_TPU_SLOW_TRACES")
+        assert rows, "degraded statement was not retained"
+        assert _sv(rows[-1][0]).startswith("degraded_")
+
+    def test_ring_bounded_and_kill_switch_clears(self):
+        s = _build(1)
+        fr = flight.recorder_for(s.store)
+        fr.clear()
+        s.execute("set global tidb_tpu_slow_trace_cap = 3")
+        s.execute("set tidb_slow_log_threshold = 1")
+        try:
+            for i in range(5):
+                s.execute(f"select count(*) from t where v > {i}")
+            entries = fr.entries()
+            assert len(entries) == 3, "ring not bounded at the cap"
+            # oldest dropped, newest kept
+            assert "v > 4" in entries[-1]["sql"]
+            s.execute("set global tidb_tpu_flight_recorder = 0")
+            assert len(fr) == 0, "kill switch must clear the ring"
+            s.execute("select count(*) from t where v > 99")
+            assert len(fr) == 0, "disabled recorder retained a trace"
+        finally:
+            s.execute("set global tidb_tpu_flight_recorder = 1")
+            s.execute("set global tidb_tpu_slow_trace_cap = 64")
+        # re-enabled: retention works again
+        s.execute("select count(*) from t where v > 5")
+        assert len(fr) >= 1
+
+    def test_global_only_sysvars(self):
+        s = _build(1)
+        for name in ("tidb_tpu_flight_recorder", "tidb_tpu_slow_trace_cap"):
+            with pytest.raises(errors.ExecError):
+                s.execute(f"set {name} = 1")
+
+
+def _walk(doc):
+    yield doc
+    for c in doc.get("children", ()):
+        yield from _walk(c)
+
+
+# ---------------------------------------------------------------------------
+# 3. device-utilization profiler
+# ---------------------------------------------------------------------------
+
+class TestProfiler:
+    def test_device_busy_fraction_meters_dispatches(self):
+        s = _build()
+        s.execute(JOIN_AGG_Q)     # warm: compile outside the window
+        busy0 = metrics.counter("device.busy_us").value
+        timeseries.recorder.sample()
+        time.sleep(0.002)
+        for _ in range(3):
+            s.execute(JOIN_AGG_Q)
+        timeseries.recorder.sample()
+        assert metrics.counter("device.busy_us").value > busy0, \
+            "device dispatches did not meter busy time"
+        frac = metrics.gauge("device.busy_fraction").value
+        assert 0 < frac <= 1.0, frac
+
+    def test_drain_pool_wait_and_utilization(self):
+        s = _build()
+        h = metrics.histogram("copr.drain_pool.queue_wait_seconds")
+        c0 = h.count
+        timeseries.recorder.sample()
+        time.sleep(0.002)
+        for _ in range(2):
+            s.execute(JOIN_AGG_Q)     # 4-region fan-out rides the pool
+        timeseries.recorder.sample()
+        assert h.count > c0, "fan-out drains did not observe queue wait"
+        assert metrics.histogram("copr.drain_pool.task_seconds").count > 0
+        util = metrics.gauge("copr.drain_pool.worker_utilization").value
+        assert 0 <= util <= 1.0
+        assert metrics.gauge("copr.drain_pool.size").value >= 1
+
+    def test_batch_slot_occupancy_and_quiesced_gauges(self):
+        """Occupancy/padding histograms from the shared dispatch, and
+        the satellite fix: after the burst drains, sched.queue_depth
+        AND copr.drain_pool.queue_depth report 0 (quiesced server),
+        including after follower-stall removals."""
+        store, s, client = _mk_batch_store()
+        occ = metrics.histogram("sched.slot_occupancy")
+        pad = metrics.histogram("sched.padding_waste")
+        o0, p0 = occ.count, pad.count
+        sqls = [f"select id from t where v = {k}"
+                for k in (3, 11, 42, 77, 90, 96)]
+        _concurrent(store, sqls)
+        assert occ.count > o0 and pad.count > p0
+        # occupancy of a 6-statement burst in an 8-slot bucket
+        _b, _c, osum, ocnt = occ.snapshot_buckets()
+        assert 0 < osum / ocnt <= 1.0
+        q50 = metrics.quantile(occ, 0.5)
+        assert 0 < q50 <= 1.0
+        assert metrics.gauge("sched.queue_depth").value == 0, \
+            "quiesced micro-batcher reports a stale queue depth"
+        # follower-stall path: a stalled window self-removes entries —
+        # the gauge must still come back to 0
+        failpoint.enable("sched/batch_window", action="sleep",
+                         seconds=0.6)
+        try:
+            d0 = metrics.counter("copr.degraded_batch").value
+            _concurrent(store, sqls[:3])
+            assert metrics.counter("copr.degraded_batch").value > d0
+        finally:
+            failpoint.disable("sched/batch_window")
+        assert metrics.gauge("sched.queue_depth").value == 0, \
+            "stall-path removals left a stale sched.queue_depth"
+        assert metrics.gauge("copr.drain_pool.queue_depth").value == 0, \
+            "quiesced drain pool reports a stale queue depth"
+
+    def test_mesh_shard_balance_gauges(self):
+        s = _build()
+        d0 = metrics.counter("copr.mesh.dispatches").value
+        s.execute(JOIN_AGG_Q)     # mesh combine (8 forced host shards
+        #                           under tier-1's XLA_FLAGS)
+        assert metrics.counter("copr.mesh.dispatches").value > d0
+        mx = metrics.gauge("copr.mesh.shard_rows_max").value
+        mean = metrics.gauge("copr.mesh.shard_rows_mean").value
+        skew = metrics.gauge("copr.mesh.shard_skew").value
+        assert mx > 0 and mean > 0 and mx >= mean
+        assert skew >= 1.0 and skew == pytest.approx(mx / mean, rel=1e-3)
+        # the publisher computes skew correctly for imbalanced layouts
+        from tidb_tpu.ops import mesh as mesh_mod
+        mesh_mod.publish_shard_balance([4000, 500, 500, 1000])
+        assert metrics.gauge("copr.mesh.shard_skew").value == \
+            pytest.approx(4000 / 1500, rel=1e-3)
+        mesh_mod.publish_shard_balance([mx])   # restore sane state
+
+    def test_plane_cache_hbm_attribution(self):
+        s = _build()
+        s.execute(JOIN_AGG_Q)     # cold: packs + pins
+        s.execute(JOIN_AGG_Q)     # warm: hits
+        assert metrics.counter("copr.plane_cache.hits").value > 0
+        pinned = metrics.gauge("copr.plane_cache.bytes_pinned").value
+        top_b = metrics.gauge("copr.plane_cache.top_pinned_bytes").value
+        top_t = metrics.gauge("copr.plane_cache.top_pinned_table").value
+        assert pinned > 0 and top_b > 0
+        assert top_b <= pinned
+        tid = s.info_schema().table_by_name("dg", "t").info.id
+        pc = s.store.rpc.plane_cache
+        by_table = pc.pinned_by_table()
+        assert by_table.get(tid, 0) > 0
+        assert top_t in by_table
+
+
+# ---------------------------------------------------------------------------
+# 4. inspection rules — fire under chaos, clear after recovery
+# ---------------------------------------------------------------------------
+
+def _findings(s) -> list[tuple]:
+    return [(_sv(r[0]), _sv(r[1]), _sv(r[2]))
+            for r in _rows(s, "select RULE, ITEM, SEVERITY from "
+                              "information_schema."
+                              "TIDB_TPU_INSPECTION_RESULT")]
+
+
+def _fired(s, rule: str, item: str | None = None) -> list[tuple]:
+    return [f for f in _findings(s)
+            if f[0] == rule and (item is None or f[1] == item)]
+
+
+class TestInspectionRules:
+    def test_degradation_burst_fires_and_clears(self):
+        s = _build()
+        s.execute(JOIN_AGG_Q)                 # warm
+        _flush_window()
+        assert not _fired(s, "degradation-burst")
+        failpoint.enable("device/mesh_collective")
+        try:
+            for _ in range(inspection.DEGRADED_BURST_N + 1):
+                s.execute(JOIN_AGG_Q)         # each degrades mesh→single
+        finally:
+            failpoint.disable("device/mesh_collective")
+        hits = _fired(s, "degradation-burst")
+        assert hits, "mesh degradation burst did not fire"
+        assert any(item == "mesh" for (_r, item, _sev) in hits)
+        # recovery: the window slides past the burst and the rule clears
+        _flush_window()
+        assert not _fired(s, "degradation-burst"), \
+            "rule did not clear after recovery"
+
+    def test_plane_cache_collapse_fires_and_clears(self):
+        s = _build()
+        s.execute(JOIN_AGG_Q)                 # warm + seed the cache
+        _flush_window()
+        failpoint.enable("cache/no_admit", action="return", value=True)
+        try:
+            # a commit bumps the store's data version (orphaning the
+            # warm entries), and no_admit keeps every re-pack OUT of the
+            # cache: 5 regions x 5 runs of pure misses, ratio 0
+            s.execute("insert into t values (99991, 1, 1, 1.0)")
+            for _ in range(5):
+                s.execute(JOIN_AGG_Q)
+            hits = _fired(s, "plane-cache-collapse", "hit-ratio")
+            assert hits, "all-miss window did not fire the cache rule"
+        finally:
+            failpoint.disable("cache/no_admit")
+        _flush_window()
+        for _ in range(5):
+            s.execute(JOIN_AGG_Q)             # warm hits dominate again
+        assert not _fired(s, "plane-cache-collapse"), \
+            "rule did not clear after the cache recovered"
+
+    def test_drain_pool_saturation_fires_and_clears(self):
+        from tidb_tpu.cluster.pool import get_pool, set_pool_size
+        s = _build(1)
+        _flush_window()
+        set_pool_size(2)
+        release = threading.Event()
+        try:
+            pool = get_pool()
+            for _ in range(8):
+                pool.submit(lambda: release.wait(5))
+            # workers (2) busy, ≥ 2 queued → depth ≥ size
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and metrics.gauge(
+                    "copr.drain_pool.queue_depth").value < 2:
+                time.sleep(0.01)
+            hits = _fired(s, "admission-saturation", "drain-pool")
+            assert hits, "saturated drain pool did not fire"
+        finally:
+            release.set()
+            set_pool_size(16)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and metrics.gauge(
+                "copr.drain_pool.queue_depth").value > 0:
+            time.sleep(0.01)
+        assert not _fired(s, "admission-saturation", "drain-pool"), \
+            "rule did not clear after the pool drained"
+
+    def test_conn_queue_saturation_fires_and_clears(self):
+        """The conn-queue item rides the queue-deadline counter: a
+        timed-out queued connection (satellite a) is exactly the
+        evidence the rule wants."""
+        from tidb_tpu.server import MySQLError, Server
+        from tests.test_server import connect
+        s = _build(1)
+        _flush_window()
+        store = s.store
+        s.execute("set global max_connections = 1")
+        s.execute("set global tidb_tpu_conn_queue_depth = 4")
+        s.execute("set global tidb_tpu_conn_queue_timeout_ms = 150")
+        server = Server(store)
+        server.start()
+        try:
+            c1 = connect(server)
+            with pytest.raises(MySQLError):
+                connect(server, timeout=10)   # queue-deadline death
+            c1.close()
+        finally:
+            server.close()
+        hits = _fired(s, "admission-saturation", "conn-queue")
+        assert hits, "queue-deadline rejection did not fire the rule"
+        _flush_window()
+        assert not _fired(s, "admission-saturation", "conn-queue")
+
+    def test_batch_expiry_spike_fires_and_clears(self):
+        store, s, client = _mk_batch_store(window_ms=30)
+        _flush_window()
+        sqls = [f"select id from t where v = {k}"
+                for k in (3, 11, 42, 77, 90)]
+        failpoint.enable("sched/batch_window", action="sleep",
+                         seconds=0.5)
+        try:
+            _ok, caught = _concurrent(
+                store, sqls,
+                setup=("set tidb_tpu_max_execution_time = 120",),
+                catch=(errors.DeadlineExceededError,))
+            assert len(caught) >= inspection.BATCH_EXPIRY_N, \
+                f"only {len(caught)} deadlines expired in the window"
+        finally:
+            failpoint.disable("sched/batch_window")
+        hits = _fired(s, "batch-expiry-spike", "gather-window")
+        assert hits, "gather-window expiries did not fire the rule"
+        _flush_window()
+        assert not _fired(s, "batch-expiry-spike")
+
+    def test_mesh_skew_fires_and_clears(self):
+        from tidb_tpu.ops import mesh as mesh_mod
+        s = _build(1)
+        _flush_window()
+        assert not _fired(s, "mesh-shard-skew")
+        # a hot region dragging its home shard: max 8x the mean at a
+        # non-trivial row count (the gauge seam the real combine feeds)
+        mesh_mod.publish_shard_balance([8000, 500, 500, 1000])
+        hits = _fired(s, "mesh-shard-skew", "placement")
+        assert hits, "skewed shard layout did not fire"
+        mesh_mod.publish_shard_balance([2000, 2000, 2000, 2000])
+        assert not _fired(s, "mesh-shard-skew"), \
+            "balanced layout did not clear the rule"
+
+    def test_findings_carry_window_and_evidence(self):
+        s = _build()
+        s.execute(JOIN_AGG_Q)
+        _flush_window()
+        failpoint.enable("device/mesh_collective")
+        try:
+            for _ in range(inspection.DEGRADED_BURST_N + 1):
+                s.execute(JOIN_AGG_Q)
+        finally:
+            failpoint.disable("device/mesh_collective")
+        rows = _rows(s, "select RULE, ITEM, SEVERITY, ITEM_VALUE, "
+                        "REFERENCE, DETAILS, WINDOW_BEGIN, WINDOW_END "
+                        "from information_schema."
+                        "TIDB_TPU_INSPECTION_RESULT")
+        burst = [r for r in rows if _sv(r[0]) == "degradation-burst"
+                 and _sv(r[1]) == "mesh"]
+        assert burst
+        _rule, _item, sev, val, ref, details, begin, end = burst[0]
+        assert _sv(sev) in ("warning", "critical")
+        assert int(val) >= inspection.DEGRADED_BURST_N
+        assert "fallbacks/window" in _sv(ref)
+        assert "copr.degraded_mesh" in _sv(details)
+        assert 0 < begin <= end
+        _flush_window()

@@ -1,0 +1,122 @@
+"""Metrics-catalog conformance (tier-1, test_exception_hygiene spirit):
+walk the source tree for every metrics.counter/gauge/histogram call site
+in tidb_tpu/ and assert each emitted name is registered in the catalog
+with the right type and documented in README's observability tables — a
+new metric cannot land silently undocumented, and a documented metric
+cannot silently change type.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from tidb_tpu.metrics import catalog
+
+ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tidb_tpu")
+
+# metrics.counter("literal.name") — including the string-concat form
+# metrics.counter("prefix." + expr)
+_LITERAL = re.compile(
+    r"""metrics\s*\.\s*(counter|gauge|histogram)\s*\(\s*"([^"]+)"\s*([),+])""",
+    re.S)
+# metrics.counter(f"prefix.{var}") — dynamic families: the literal
+# prefix before the first placeholder must be a catalog PREFIX (or be
+# covered by exact entries that share it)
+_FSTRING = re.compile(
+    r"""metrics\s*\.\s*(counter|gauge|histogram)\s*\(\s*f"([^"{]+)\{""",
+    re.S)
+
+
+def _walk_sources():
+    for dirpath, _dirs, files in os.walk(ROOT):
+        for fn in files:
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    yield os.path.relpath(path, ROOT), f.read()
+
+
+def _collect():
+    exact: dict[str, tuple[str, str]] = {}    # name → (type, where)
+    prefixes: dict[str, tuple[str, str]] = {}
+    for rel, src in _walk_sources():
+        for m in _LITERAL.finditer(src):
+            kind, name, tail = m.group(1), m.group(2), m.group(3)
+            if tail == "+":
+                # "literal." + expr concatenation: a dynamic family
+                prefixes[name] = (kind, rel)
+            else:
+                exact[name] = (kind, rel)
+        for m in _FSTRING.finditer(src):
+            prefixes[m.group(2)] = (m.group(1), rel)
+    return exact, prefixes
+
+
+def test_every_emitted_metric_is_in_the_catalog_with_its_type():
+    exact, prefixes = _collect()
+    assert len(exact) >= 40, "source walk found suspiciously few metrics"
+    problems = []
+    for name, (kind, where) in sorted(exact.items()):
+        hit = catalog.lookup(name)
+        if hit is None:
+            problems.append(f"{name} ({where}): not in catalog")
+        elif hit[0] != kind:
+            problems.append(
+                f"{name} ({where}): emitted as {kind}, catalog says "
+                f"{hit[0]}")
+        elif not hit[1].strip():
+            problems.append(f"{name} ({where}): empty help text")
+    assert not problems, "metric drift:\n" + "\n".join(problems)
+
+
+def test_every_dynamic_family_prefix_is_covered():
+    _exact, prefixes = _collect()
+    assert prefixes, "no dynamic metric families found (regex rot?)"
+    problems = []
+    for prefix, (kind, where) in sorted(prefixes.items()):
+        # covered when the prefix itself is a catalog family entry, or
+        # every plausible expansion resolves through exact entries that
+        # share the prefix (the plane-cache COUNTER_NAMES pattern)
+        if prefix in catalog.CATALOG:
+            if catalog.CATALOG[prefix][0] != kind:
+                problems.append(
+                    f"{prefix}* ({where}): emitted as {kind}, catalog "
+                    f"says {catalog.CATALOG[prefix][0]}")
+            continue
+        # other call sites may register other-typed metrics under the
+        # same dotted prefix (plane-cache gauges beside its counters),
+        # so require at least one same-typed exact entry as evidence
+        # the family is documented
+        covered = [n for n in catalog.CATALOG if n.startswith(prefix)
+                   and n != prefix and catalog.CATALOG[n][0] == kind]
+        if not covered:
+            problems.append(
+                f"{prefix}* ({where}): no catalog family entry and no "
+                f"exact {kind} entries under the prefix")
+    assert not problems, "dynamic-family drift:\n" + "\n".join(problems)
+
+
+def test_catalog_prefix_resolution():
+    assert catalog.lookup("copr.degraded_mesh") == \
+        catalog.CATALOG["copr.degraded_"]
+    assert catalog.lookup("kv.backoff.rpc") == \
+        catalog.CATALOG["kv.backoff."]
+    # histogram series sampled as _count/_sum resolve to their family
+    assert catalog.lookup("ops.kernel_seconds_count")[0] == "histogram"
+    assert catalog.lookup("no.such.metric") is None
+
+
+def test_readme_documents_every_catalog_entry():
+    """README's observability tables are the operator-facing copy of the
+    catalog: every entry (exact name or dynamic-family prefix) must
+    appear there — and in backticks, so it renders as a metric name."""
+    readme = os.path.join(os.path.dirname(ROOT), "README.md")
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    missing = [name for name in sorted(catalog.CATALOG)
+               if f"`{name}" not in text]
+    assert not missing, \
+        "catalog entries missing from README's observability tables:\n" \
+        + "\n".join(missing)

@@ -325,6 +325,134 @@ class TestAdmissionGate:
         finally:
             server.close()
 
+    def test_conn_queue_wait_deadline_typed_1040(self):
+        """ROADMAP concurrency residual (f): a connection queued behind
+        the admission gate dies TYPED (ER 1040) after
+        tidb_tpu_conn_queue_timeout_ms instead of waiting forever on the
+        client's own connect timeout, counted on
+        server.conn_queue_timeouts — and the deadline sheds only the
+        queued socket, never the served connection."""
+        import time
+
+        from tidb_tpu import metrics
+        store = new_store(f"memory://srvadm{next(_store_id)}")
+        root = Session(store)
+        root.execute("set global max_connections = 1")
+        root.execute("set global tidb_tpu_conn_queue_depth = 4")
+        root.execute("set global tidb_tpu_conn_queue_timeout_ms = 200")
+        server = Server(store)
+        server.start()
+        try:
+            c1 = connect(server)        # occupies the only worker
+            n0 = metrics.counter("server.conn_queue_timeouts").value
+            t0 = time.time()
+            with pytest.raises(MySQLError) as ei:
+                # queues (depth 4 > 0), then the sweeper rejects typed —
+                # WELL before the client's own 10 s timeout
+                connect(server, timeout=10)
+            elapsed = time.time() - t0
+            assert ei.value.code == 1040
+            assert "Too many connections" in str(ei.value)
+            assert 0.15 <= elapsed < 5, \
+                f"queue deadline fired at {elapsed:.2f}s, not ~0.2s"
+            assert metrics.counter(
+                "server.conn_queue_timeouts").value == n0 + 1
+            # the served connection is untouched, and a freed worker
+            # still admits fresh connections afterwards
+            c1.ping()
+            c1.close()
+            c2 = None
+            for _ in range(200):
+                try:
+                    c2 = connect(server)
+                    break
+                except MySQLError:
+                    time.sleep(0.02)
+            assert c2 is not None
+            c2.ping()
+            c2.close()
+        finally:
+            server.close()
+
+    def test_conn_queue_timeout_applies_to_already_queued_sockets(self):
+        """SET GLOBAL tidb_tpu_conn_queue_timeout_ms while sockets are
+        ALREADY queued still sheds them: the sweeper runs whenever the
+        queue is non-empty and reads the sysvar live — enabling the
+        deadline mid-backlog must not strand the waiting sockets."""
+        import time
+
+        store = new_store(f"memory://srvadm{next(_store_id)}")
+        root = Session(store)
+        root.execute("set global max_connections = 1")
+        root.execute("set global tidb_tpu_conn_queue_depth = 4")
+        root.execute("set global tidb_tpu_conn_queue_timeout_ms = 0")
+        server = Server(store)
+        server.start()
+        try:
+            c1 = connect(server)        # occupies the only worker
+            got = {}
+
+            def waiter():
+                try:
+                    connect(server, timeout=10)
+                    got["ok"] = True
+                except Exception as e:
+                    got["err"] = e
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            t.join(timeout=0.4)
+            assert t.is_alive(), "socket was not queued"
+            # enable the deadline AFTER the socket queued; it has
+            # already waited > 100 ms, so the sweeper sheds it promptly
+            root.execute(
+                "set global tidb_tpu_conn_queue_timeout_ms = 100")
+            t.join(timeout=5)
+            assert not t.is_alive(), \
+                "mid-backlog deadline never shed the queued socket"
+            err = got.get("err")
+            assert err is not None and getattr(err, "code", None) == 1040, \
+                f"expected typed ER 1040, got {got}"
+            c1.ping()
+            c1.close()
+        finally:
+            server.close()
+
+    def test_conn_queue_timeout_zero_waits(self):
+        """tidb_tpu_conn_queue_timeout_ms = 0 restores wait-forever: the
+        queued connection is served when the worker frees, never
+        deadline-rejected."""
+        store = new_store(f"memory://srvadm{next(_store_id)}")
+        root = Session(store)
+        root.execute("set global max_connections = 1")
+        root.execute("set global tidb_tpu_conn_queue_depth = 4")
+        root.execute("set global tidb_tpu_conn_queue_timeout_ms = 0")
+        server = Server(store)
+        server.start()
+        try:
+            c1 = connect(server)
+            got = {}
+
+            def waiter():
+                try:
+                    c = connect(server, timeout=10)
+                    c.ping()
+                    got["ok"] = True
+                    c.close()
+                except Exception as e:   # surfaces via assert below
+                    got["err"] = e
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            t.join(timeout=0.6)   # > a would-be small deadline window
+            assert t.is_alive(), "queued connection was served early"
+            c1.close()
+            t.join(timeout=10)
+            assert got.get("ok"), \
+                f"queued connection failed: {got.get('err')}"
+        finally:
+            server.close()
+
     def test_bounded_workers_reused_across_churn(self):
         store = new_store(f"memory://srvadm{next(_store_id)}")
         server = Server(store)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import collections
 import itertools
 import threading
+import time
 
 _IDLE_EXIT_S = 30.0
 
@@ -38,14 +39,18 @@ class DrainPool:
         self._threads = 0          # live workers
         self._idle = 0             # workers parked in wait()
         self._seq = itertools.count()
+        from tidb_tpu import metrics
+        metrics.gauge("copr.drain_pool.size").set(self._size)
 
     @property
     def size(self) -> int:
         return self._size
 
     def set_size(self, n: int) -> None:
+        from tidb_tpu import metrics
         with self._cv:
             self._size = max(1, int(n))
+            metrics.gauge("copr.drain_pool.size").set(self._size)
             self._cv.notify_all()   # over-target idle workers exit
 
     def submit(self, fn) -> None:
@@ -54,7 +59,10 @@ class DrainPool:
         consumer thread) — the pool never propagates."""
         from tidb_tpu import metrics
         with self._cv:
-            self._q.append(fn)
+            # enqueue time rides the entry: the worker turns it into the
+            # queue-wait histogram (host-stall attribution — time a
+            # region drain waited for a worker, not for data)
+            self._q.append((fn, time.perf_counter()))
             metrics.counter("copr.drain_pool.tasks").inc()
             metrics.gauge("copr.drain_pool.queue_depth").set(len(self._q))
             # spawn whenever the queue outruns the idlers: a notified
@@ -78,24 +86,34 @@ class DrainPool:
     def _worker(self) -> None:
         from tidb_tpu import metrics
         qd = metrics.gauge("copr.drain_pool.queue_depth")
+        workers = metrics.gauge("copr.drain_pool.workers")
+        wait_h = metrics.histogram("copr.drain_pool.queue_wait_seconds")
+        task_h = metrics.histogram("copr.drain_pool.task_seconds")
+        busy_us = metrics.counter("copr.drain_pool.busy_us")
+        workers.set(self._threads)
         while True:
             with self._cv:
                 while not self._q:
                     if self._threads > self._size:
                         self._threads -= 1
+                        workers.set(self._threads)
                         return          # shrink target reached
                     self._idle += 1
                     got = self._cv.wait(timeout=_IDLE_EXIT_S)
                     self._idle -= 1
                     if not got and not self._q:
                         self._threads -= 1
+                        workers.set(self._threads)
                         return          # idle exit
                 if self._threads > self._size:
                     self._threads -= 1
+                    workers.set(self._threads)
                     self._cv.notify()   # someone else serves the queue
                     return
-                fn = self._q.popleft()
+                fn, t_enq = self._q.popleft()
                 qd.set(len(self._q))
+            t_run = time.perf_counter()
+            wait_h.observe(t_run - t_enq)
             try:
                 fn()
             except BaseException:  # retryable-ok: fan-out task closures
@@ -105,6 +123,10 @@ class DrainPool:
                 import logging
                 logging.getLogger(__name__).exception(
                     "drain-pool task leaked an exception")
+            finally:
+                dt = time.perf_counter() - t_run
+                task_h.observe(dt)
+                busy_us.inc(int(dt * 1e6))
 
 
 _pool: DrainPool | None = None

@@ -74,6 +74,20 @@ def _update_gauges() -> None:
         sum(c._bytes_pinned for c in caches))
     metrics.gauge("copr.plane_cache.entries").set(
         sum(len(c._entries) for c in caches))
+    # HBM attribution for the device-utilization profiler: which table's
+    # cached planes hold the most device memory right now, SUMMED per
+    # table across caches (a table split over stores must not lose to a
+    # single-cache table). Each cache republishes an immutable snapshot
+    # tuple under its OWN lock (this sweep may run while a sibling holds
+    # its lock — only attribute reads are safe here, never a lock
+    # acquisition; a tuple read is atomic)
+    by_table: dict[int, int] = {}
+    for c in caches:
+        for tid, n in c._pinned_snapshot:
+            by_table[tid] = by_table.get(tid, 0) + n
+    top = max(by_table.items(), key=lambda kv: kv[1], default=(0, 0))
+    metrics.gauge("copr.plane_cache.top_pinned_table").set(int(top[0]))
+    metrics.gauge("copr.plane_cache.top_pinned_bytes").set(int(top[1]))
 
 
 def batch_nbytes(batch) -> int:
@@ -89,15 +103,17 @@ def batch_nbytes(batch) -> int:
 
 
 class _Entry:
-    __slots__ = ("batch", "nbytes", "epoch", "version", "pinned")
+    __slots__ = ("batch", "nbytes", "epoch", "version", "pinned",
+                 "table_id")
 
     def __init__(self, batch, nbytes: int, epoch, version: int,
-                 pinned: bool):
+                 pinned: bool, table_id: int = 0):
         self.batch = batch
         self.nbytes = nbytes
         self.epoch = epoch
         self.version = version
         self.pinned = pinned
+        self.table_id = table_id
 
 
 class PlaneCache:
@@ -123,6 +139,8 @@ class PlaneCache:
         self._by_region: dict[int, set] = {}   # region_id → {full_key}
         self._bytes = 0
         self._bytes_pinned = 0
+        self._pinned_tables: dict[int, int] = {}
+        self._pinned_snapshot: tuple = ()
         _instances.add(self)
 
     # ---- introspection (tests / gauges) ----
@@ -134,6 +152,23 @@ class PlaneCache:
     @property
     def bytes_pinned(self) -> int:
         return self._bytes_pinned
+
+    def pinned_by_table(self) -> dict[int, int]:
+        """HBM-pinned cached bytes per table id (base_key[1]) — the
+        profiler's bytes-pinned attribution."""
+        with self._lock:
+            return dict(self._pinned_tables)
+
+    def _account_pin_locked(self, table_id: int, nbytes: int) -> None:
+        """Maintain the per-table pinned-bytes map and republish it as
+        an immutable snapshot tuple the module-level gauge sweep can
+        read WITHOUT taking this lock."""
+        n = self._pinned_tables.get(table_id, 0) + nbytes
+        if n > 0:
+            self._pinned_tables[table_id] = n
+        else:
+            self._pinned_tables.pop(table_id, None)
+        self._pinned_snapshot = tuple(self._pinned_tables.items())
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -208,11 +243,12 @@ class PlaneCache:
             if old is not None:
                 self._account_remove(old)
             self._entries[full_key] = _Entry(batch, nbytes, epoch, version,
-                                             pinned)
+                                             pinned, base_key[1])
             self._by_region.setdefault(base_key[0], set()).add(full_key)
             self._bytes += nbytes
             if pinned:
                 self._bytes_pinned += nbytes
+                self._account_pin_locked(base_key[1], nbytes)
             while self._bytes > self.budget_bytes and self._entries:
                 fk, ent = self._entries.popitem(last=False)
                 self._unindex(fk)
@@ -237,6 +273,8 @@ class PlaneCache:
             self._entries.clear()
             self._by_region.clear()
             self._bytes = self._bytes_pinned = 0
+            self._pinned_tables.clear()
+            self._pinned_snapshot = ()
             self._update_gauges()
 
     # ---- internals (lock held) ----
@@ -258,6 +296,7 @@ class PlaneCache:
         self._bytes -= ent.nbytes
         if ent.pinned:
             self._bytes_pinned -= ent.nbytes
+            self._account_pin_locked(ent.table_id, -ent.nbytes)
 
     def _update_gauges(self) -> None:
         _update_gauges()

@@ -141,6 +141,13 @@ _GLOBAL_ONLY_TPU_VARS = {
         "apply_stmt_summary_refresh_interval",
     "tidb_tpu_stmt_summary_history_size": "apply_stmt_summary_history_size",
     "tidb_tpu_perfschema_history_cap": "apply_perfschema_history_cap",
+    # diagnostics tier (flight recorder / metrics time series / admission
+    # queue deadline)
+    "tidb_tpu_flight_recorder": "apply_flight_recorder",
+    "tidb_tpu_slow_trace_cap": "apply_slow_trace_cap",
+    "tidb_tpu_metrics_interval_ms": "apply_metrics_interval",
+    "tidb_tpu_metrics_history_cap": "apply_metrics_history_cap",
+    "tidb_tpu_conn_queue_timeout_ms": "apply_conn_queue_timeout",
 }
 
 

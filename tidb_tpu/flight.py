@@ -1,0 +1,162 @@
+"""Slow-statement flight recorder: always-on-but-cheap statement traces,
+retained only when a statement turns out to matter.
+
+Reference: TiDB's slow-query memory buffer (infoschema SLOW_QUERY reads
+the slow log back) plus the "continuous profiling" idea from its
+diagnostics lineage — you want the FULL hierarchical trace of the
+statement that was slow five minutes ago, not the ability to re-run it
+with tracing enabled (the re-run hits a warm cache and tells you
+nothing). So:
+
+* Every top-level statement builds its span tree unconditionally (the
+  session layer attaches a root even when tidb_trace_enabled = 0; span
+  construction is a perf_counter read + two container allocs — the
+  extended PR 4 guard bounds the whole statement overhead < 2 ms).
+* At statement end the tree is RETAINED only when the statement crossed
+  the slow-log threshold, died on its deadline, or degraded through any
+  tier (degraded_* tallies) — everything else drops the tree on the
+  floor, so the fast path retains nothing (zero live Span objects after
+  a burst of healthy statements; the guard asserts exactly that).
+* Retained traces land in a bounded per-store ring queryable through
+  information_schema.TIDB_TPU_SLOW_TRACES: the serialized span tree
+  (region tasks, kernel dispatches, batch/mesh attribution), the
+  statement's resource deltas, and why it was kept.
+
+Knobs (GLOBAL-only, persisted + hydrated like the plane-cache pair):
+SET GLOBAL tidb_tpu_flight_recorder = 0|1 (off clears the ring and
+stops building spans), SET GLOBAL tidb_tpu_slow_trace_cap = N.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+
+DEFAULT_CAP = int(SYSVAR_DEFAULTS["tidb_tpu_slow_trace_cap"])
+
+
+class FlightRecorder:
+    """Bounded ring of retained statement traces for one store."""
+
+    def __init__(self, cap: int = DEFAULT_CAP):
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=max(1, cap))
+
+    # ---- configuration (sysvar appliers) ----
+
+    def set_enabled(self, on: bool) -> None:
+        with self._lock:
+            self.enabled = on
+            if not on:
+                self._ring.clear()
+
+    def set_cap(self, n: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(1, int(n)))
+
+    @property
+    def cap(self) -> int:
+        return self._ring.maxlen or 0
+
+    # ------------------------------------------------------------------
+
+    def record(self, *, conn_id: int, digest: str, sql_text: str,
+               duration_ms: float, reason: str, root,
+               resources: dict, error: str = "") -> None:
+        """Retain one statement's trace. The span tree is serialized
+        HERE (root.to_dict() snapshots attrs/children), so an abandoned
+        fan-out worker still mutating a span cannot corrupt a retained
+        entry, and the ring holds plain dicts — no live Span objects."""
+        from tidb_tpu import metrics
+        doc = root.to_dict()
+        entry = {
+            "ts": time.time(),
+            "conn_id": conn_id,
+            "digest": digest,
+            "sql": sql_text[:2048],
+            "duration_ms": round(duration_ms, 3),
+            "reason": reason,
+            "error": error[:512],
+            "span_count": _count_spans(doc),
+            "resources": dict(resources),
+            "trace": doc,
+        }
+        with self._lock:
+            if not self.enabled:
+                return      # a statement racing the kill switch
+            self._ring.append(entry)
+        metrics.counter("tracing.slow_traces_retained").inc()
+
+    def entries(self) -> list[dict]:
+        """Oldest-first snapshot of the retained traces."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+def _count_spans(doc: dict) -> int:
+    n = 1
+    for c in doc.get("children", ()):
+        n += _count_spans(c)
+    return n
+
+
+def retain_reason(elapsed_ms: float, threshold_ms: float,
+                  resources: dict, deadline: bool) -> str | None:
+    """Why (if at all) a finished statement's trace must be retained —
+    THE retention policy, shared by the success and error paths:
+    deadline death first (the most specific), then any tier
+    degradation, then the slow-log threshold (<= 0 disables the slow
+    leg exactly like the slow log itself)."""
+    if deadline:
+        return "deadline"
+    for key, v in resources.items():
+        if v and key.startswith("degraded_"):
+            return key
+    if threshold_ms > 0 and elapsed_ms >= threshold_ms:
+        return "slow"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-store registry (perfschema.perf_for discipline: bounded, keyed by
+# store uuid so tests' short-lived stores don't pin recorders forever)
+# ---------------------------------------------------------------------------
+
+from collections import OrderedDict as _OrderedDict
+
+_recorders: "_OrderedDict[str, FlightRecorder]" = _OrderedDict()
+_lock = threading.Lock()
+
+
+def recorder_for(store) -> FlightRecorder:
+    with _lock:
+        uuid = store.uuid()
+        fr = _recorders.get(uuid)
+        if fr is None:
+            fr = _recorders[uuid] = FlightRecorder()
+        # true LRU (perf_for discipline): evict the least-recently USED
+        # store, never a live one — FIFO would drop a long-lived server
+        # store's retained traces (and its kill-switch state) the
+        # moment enough short-lived stores churned past the cap
+        _recorders.move_to_end(uuid)
+        while len(_recorders) > 64:
+            _recorders.popitem(last=False)
+        return fr
+
+
+def trace_json(entry: dict) -> str:
+    """The TRACE_JSON cell: the full span tree, compact."""
+    return json.dumps(entry["trace"], sort_keys=True,
+                      separators=(",", ":"))

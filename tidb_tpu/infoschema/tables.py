@@ -29,6 +29,12 @@ T_COLLATIONS = -206
 # TOP-SQL by device time per time bucket, and region access heat
 T_TPU_TOP_SQL = -210
 T_TPU_HOT_REGIONS = -211
+# diagnostics tier: queryable metrics (current + time series), the
+# slow-statement flight recorder, and the inspection rule findings
+T_TPU_METRICS = -212
+T_TPU_METRICS_HISTORY = -213
+T_TPU_SLOW_TRACES = -214
+T_TPU_INSPECTION_RESULT = -215
 
 
 def _col(i: int, name: str, tp: int = my.TypeVarchar,
@@ -120,11 +126,131 @@ def store_table_infos() -> list[TableInfo]:
             ("TOTAL_READ_ROWS", my.TypeLonglong, 21),
             ("TOTAL_WRITE_ROWS", my.TypeLonglong, 21),
             ("HEAT", my.TypeDouble, 22)]),
+        # column names dodge lexer keywords (VALUE, TIME) so bare
+        # projections parse: METRIC_VALUE / TS / ITEM_VALUE
+        _tbl(T_TPU_METRICS, "TIDB_TPU_METRICS", [
+            ("NAME", my.TypeVarchar, 128),
+            ("TYPE", my.TypeVarchar, 16),
+            ("LABELS", my.TypeVarchar, 64),
+            ("METRIC_VALUE", my.TypeDouble, 22),
+            ("HELP", my.TypeVarchar, 256)]),
+        _tbl(T_TPU_METRICS_HISTORY, "TIDB_TPU_METRICS_HISTORY", [
+            ("TS", my.TypeDouble, 22),
+            ("NAME", my.TypeVarchar, 128),
+            ("TYPE", my.TypeVarchar, 16),
+            ("METRIC_VALUE", my.TypeDouble, 22),
+            ("DELTA", my.TypeDouble, 22),
+            ("RATE_PER_SEC", my.TypeDouble, 22)]),
+        _tbl(T_TPU_SLOW_TRACES, "TIDB_TPU_SLOW_TRACES", [
+            ("TS", my.TypeDouble, 22),
+            ("CONN_ID", my.TypeLonglong, 21),
+            ("DIGEST",),
+            ("REASON", my.TypeVarchar, 32),
+            ("DURATION_MS", my.TypeDouble, 22),
+            ("SPAN_COUNT", my.TypeLonglong, 21),
+            ("KERNEL_DISPATCHES", my.TypeLonglong, 21),
+            ("READBACK_BYTES", my.TypeLonglong, 21),
+            ("ERROR", my.TypeVarchar, 512),
+            ("SQL_TEXT", my.TypeVarchar, 2048),
+            ("TRACE_JSON", my.TypeVarchar, 1 << 20)]),
+        _tbl(T_TPU_INSPECTION_RESULT, "TIDB_TPU_INSPECTION_RESULT", [
+            ("RULE", my.TypeVarchar, 64),
+            ("ITEM", my.TypeVarchar, 64),
+            ("SEVERITY", my.TypeVarchar, 16),
+            ("ITEM_VALUE", my.TypeVarchar, 64),
+            ("REFERENCE", my.TypeVarchar, 128),
+            ("DETAILS", my.TypeVarchar, 512),
+            ("WINDOW_BEGIN", my.TypeDouble, 22),
+            ("WINDOW_END", my.TypeDouble, 22)]),
     ]
+
+
+_TYPE_WORDS = {"c": "counter", "g": "gauge", "h": "histogram"}
+
+
+def _metrics_rows() -> list[list[Datum]]:
+    """Current registry values with type/labels/help — `SELECT` replaces
+    scraping /metrics and grepping. Histograms expand to count/sum/avg
+    rows (LABELS carries the stat)."""
+    from tidb_tpu import metrics
+    from tidb_tpu.metrics import Counter, Gauge, catalog
+    with metrics.registry._lock:
+        items = sorted(metrics.registry._metrics.items())
+    out: list[list[Datum]] = []
+    for name, m in items:
+        hit = catalog.lookup(name)
+        help_ = hit[1] if hit is not None else ""
+        if isinstance(m, (Counter, Gauge)):
+            tp = "counter" if isinstance(m, Counter) else "gauge"
+            out.append([_s(name), _s(tp), _s(""), Datum.f64(float(m.value)),
+                        _s(help_)])
+            continue
+        _b, _c, total_sum, total_count = m.snapshot_buckets()
+        avg = total_sum / total_count if total_count else 0.0
+        for stat, v in (("count", float(total_count)),
+                        ("sum", total_sum), ("avg", avg)):
+            out.append([_s(name), _s("histogram"), _s(f'stat="{stat}"'),
+                        Datum.f64(v), _s(help_)])
+    return out
+
+
+def _metrics_history_rows() -> list[list[Datum]]:
+    """Time-bucketed samples with delta/rate — the recorder takes a
+    fresh sample at read time when a full interval has elapsed, so a
+    SELECT sees a bucket no older than the configured cadence without
+    a poll loop compressing the ring."""
+    from tidb_tpu.metrics import timeseries
+    timeseries.recorder.sample(
+        min_interval_s=timeseries.recorder.interval_s)
+    out: list[list[Datum]] = []
+    for ts, name, tc, v, delta, rate in timeseries.history_rows():
+        out.append([Datum.f64(round(ts, 3)), _s(name),
+                    _s(_TYPE_WORDS.get(tc, tc)), Datum.f64(v),
+                    Datum.f64(round(delta, 6)) if delta is not None
+                    else NULL,
+                    Datum.f64(round(rate, 6)) if rate is not None
+                    else NULL])
+    return out
+
+
+def _slow_trace_rows(store) -> list[list[Datum]]:
+    from tidb_tpu import flight
+    fr = flight.recorder_for(store)
+    out: list[list[Datum]] = []
+    for e in fr.entries():
+        res = e["resources"]
+        out.append([
+            Datum.f64(round(e["ts"], 3)), Datum.i64(e["conn_id"]),
+            _s(e["digest"]), _s(e["reason"]),
+            Datum.f64(e["duration_ms"]), Datum.i64(e["span_count"]),
+            Datum.i64(res.get("kernel_dispatches", 0)),
+            Datum.i64(res.get("readback_bytes", 0)),
+            _s(e["error"]), _s(e["sql"]), _s(flight.trace_json(e))])
+    return out
+
+
+def _inspection_rows() -> list[list[Datum]]:
+    from tidb_tpu import inspection
+    out: list[list[Datum]] = []
+    for r in inspection.inspect():
+        out.append([
+            _s(r["rule"]), _s(r["item"]), _s(r["severity"]),
+            _s(str(r["value"])), _s(r["reference"]), _s(r["details"]),
+            Datum.f64(round(r["window_begin"], 3)),
+            Datum.f64(round(r["window_end"], 3))])
+    return out
 
 
 def rows_for_store(store, table_id: int) -> list[list[Datum]]:
     """Synthesize one store-bound table's rows from live store state."""
+    if table_id == T_TPU_METRICS:
+        return _metrics_rows()
+    if table_id == T_TPU_METRICS_HISTORY:
+        return _metrics_history_rows()
+    if table_id == T_TPU_SLOW_TRACES:
+        return _slow_trace_rows(store)
+    if table_id == T_TPU_INSPECTION_RESULT:
+        return _inspection_rows()
     if table_id == T_TPU_TOP_SQL:
         from tidb_tpu import perfschema as ps
         out: list[list[Datum]] = []

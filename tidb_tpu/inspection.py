@@ -1,0 +1,181 @@
+"""Automatic inspection rules: SQL-queryable health findings over the
+metrics time series.
+
+Reference: TiDB's inspection framework
+(information_schema.inspection_result, executor/inspection_result.go) —
+a fixed rule set evaluates cluster metrics and emits (rule, item,
+severity, value, reference, details) rows, so "is something wrong?" is
+one SELECT instead of a dashboard crawl. The seed even carries a
+vestigial `inspectkv` package pointing the same way.
+
+Here each rule reads the metrics recorder's trailing window
+(metrics.timeseries) — deltas for monotonic series, levels for gauges —
+and fires with the offending window and the metric evidence attached.
+Rules CLEAR on recovery by construction: the window slides, so once the
+burst ages out of it the delta drops under threshold and the rule stops
+firing. Each rule is chaos-tested by driving it with the failpoint (or
+the real saturation mechanism) that produces its pathology.
+"""
+
+from __future__ import annotations
+
+# evaluation window: trailing samples of the recorder ring (at the
+# default 1 s interval ≈ the last half minute). Small enough that a
+# recovered incident ages out quickly; rules re-fire if it returns.
+WINDOW_SAMPLES = 30
+
+# rule thresholds (module constants so tests and docs cite one place)
+DEGRADED_BURST_N = 5          # tier fallbacks in the window
+CACHE_MIN_LOOKUPS = 16        # plane-cache traffic floor for the ratio
+CACHE_HIT_RATIO_FLOOR = 0.5   # below this, the cache collapsed
+QUEUE_TIMEOUTS_N = 1          # admission-queue deadline rejections
+POOL_SATURATION_DEPTH = 1.0   # queue depth ≥ size × this
+BATCH_EXPIRY_N = 3            # gather-window deadline expiries
+MESH_SKEW_RATIO = 2.0         # max/mean per-shard rows
+MESH_SKEW_ROWS_FLOOR = 256    # ignore skew on trivial row counts
+
+
+def _severity(value: float, threshold: float) -> str:
+    """warning at the threshold, critical at 4x it."""
+    return "critical" if value >= 4 * threshold else "warning"
+
+
+def _result(rule: str, item: str, severity: str, value, reference: str,
+            details: str, begin: float, end: float) -> dict:
+    return {"rule": rule, "item": item, "severity": severity,
+            "value": value, "reference": reference, "details": details,
+            "window_begin": begin, "window_end": end}
+
+
+def _rule_degradation_burst(d: dict, begin: float, end: float) -> list:
+    """A burst of tier fallbacks (device→CPU, join→numpy, combine→host,
+    mesh→single-device, batch→solo, columnar→rows) inside the window:
+    answers stayed correct, but the fast tier is not holding. Driven by
+    the device/* and device/mesh_collective failpoints."""
+    out = []
+    for name, delta in sorted(d.items()):
+        if not name.startswith("copr.degraded_") or \
+                delta < DEGRADED_BURST_N:
+            continue
+        kind = name[len("copr.degraded_"):]
+        out.append(_result(
+            "degradation-burst", kind,
+            _severity(delta, DEGRADED_BURST_N), int(delta),
+            f">= {DEGRADED_BURST_N} fallbacks/window",
+            f"{name} rose {int(delta)} in the window — the "
+            f"{kind} tier is degrading instead of serving",
+            begin, end))
+    return out
+
+
+def _rule_cache_collapse(d: dict, begin: float, end: float) -> list:
+    """Plane-cache hit ratio collapsed under real traffic: repeat
+    fan-outs are re-packing every region (version churn, epoch churn,
+    or a byte budget too small). Driven by the cache/no_admit
+    failpoint."""
+    hits = d.get("copr.plane_cache.hits", 0.0)
+    misses = d.get("copr.plane_cache.misses", 0.0)
+    total = hits + misses
+    if total < CACHE_MIN_LOOKUPS:
+        return []
+    ratio = hits / total
+    if ratio >= CACHE_HIT_RATIO_FLOOR:
+        return []
+    evs = int(d.get("copr.plane_cache.evictions", 0.0))
+    return [_result(
+        "plane-cache-collapse", "hit-ratio",
+        "critical" if ratio < CACHE_HIT_RATIO_FLOOR / 2 else "warning",
+        round(ratio, 3), f">= {CACHE_HIT_RATIO_FLOOR} hit ratio",
+        f"{int(hits)} hits / {int(total)} lookups in the window"
+        f" ({evs} evictions) — repeat scans are re-packing",
+        begin, end)]
+
+
+def _rule_admission_saturation(d: dict, begin: float, end: float) -> list:
+    """The admission front doors are shedding or stacking load: queued
+    wire connections died on the queue deadline (server gate), or the
+    shared drain pool's backlog outgrew its worker bound."""
+    out = []
+    timeouts = d.get("server.conn_queue_timeouts", 0.0)
+    rejected = d.get("server.rejected_connections", 0.0)
+    shed = timeouts + rejected
+    if shed >= QUEUE_TIMEOUTS_N:
+        out.append(_result(
+            "admission-saturation", "conn-queue",
+            _severity(shed, max(QUEUE_TIMEOUTS_N, 4)), int(shed),
+            f"< {QUEUE_TIMEOUTS_N} typed rejections/window",
+            f"{int(timeouts)} queue-deadline timeouts + "
+            f"{int(rejected)} queue-full rejections (ER 1040) in the "
+            "window — raise max_connections/queue depth or shed load",
+            begin, end))
+    depth = d.get("copr.drain_pool.queue_depth", 0.0)
+    size = d.get("copr.drain_pool.size", 0.0)
+    if size > 0 and depth >= max(1.0, size * POOL_SATURATION_DEPTH):
+        out.append(_result(
+            "admission-saturation", "drain-pool",
+            "critical" if depth >= 4 * size else "warning", int(depth),
+            f"queue depth < pool size ({int(size)})",
+            f"{int(depth)} region drains queued behind "
+            f"{int(size)} workers — fan-outs are waiting on the pool, "
+            "not on data", begin, end))
+    return out
+
+
+def _rule_batch_expiry_spike(d: dict, begin: float, end: float) -> list:
+    """Statement deadlines expiring inside the micro-batch gather
+    window: the window (or a stalled leader) is eating the latency
+    budget of below-floor statements. Driven by the sched/batch_window
+    failpoint under tidb_tpu_max_execution_time."""
+    n = d.get("sched.window_expiries", 0.0)
+    if n < BATCH_EXPIRY_N:
+        return []
+    return [_result(
+        "batch-expiry-spike", "gather-window",
+        _severity(n, BATCH_EXPIRY_N), int(n),
+        f"< {BATCH_EXPIRY_N} expiries/window",
+        f"{int(n)} statement deadlines expired inside the shared batch "
+        "gather window — shrink tidb_tpu_batch_window_ms or raise the "
+        "statement deadline", begin, end)]
+
+
+def _rule_mesh_shard_skew(d: dict, begin: float, end: float) -> list:
+    """One shard is dragging the mesh collective: the per-shard row
+    imbalance of the last mesh dispatch exceeds the skew bound at a
+    non-trivial row count (region placement is hash-uniform over
+    regions, not over ROWS — a hot region skews its home shard)."""
+    if d.get("copr.mesh.dispatches", 0.0) < 1:
+        return []    # no mesh traffic in the window: a stale skew gauge
+        #              from long-gone dispatches is not a live finding
+    skew = d.get("copr.mesh.shard_skew", 0.0)
+    mx = d.get("copr.mesh.shard_rows_max", 0.0)
+    if skew < MESH_SKEW_RATIO or mx < MESH_SKEW_ROWS_FLOOR:
+        return []
+    return [_result(
+        "mesh-shard-skew", "placement",
+        "critical" if skew >= 2 * MESH_SKEW_RATIO else "warning",
+        round(skew, 3), f"max/mean < {MESH_SKEW_RATIO}",
+        f"fullest shard holds {int(mx)} rows at {skew:.2f}x the mean — "
+        "collectives wait on one shard (hot region or placement skew)",
+        begin, end)]
+
+
+RULES = (_rule_degradation_burst, _rule_cache_collapse,
+         _rule_admission_saturation, _rule_batch_expiry_spike,
+         _rule_mesh_shard_skew)
+
+
+def inspect(window: int = WINDOW_SAMPLES) -> list[dict]:
+    """Evaluate every rule over the recorder's trailing window, ended
+    at a fresh registry walk (one walk serves both the history bucket
+    and the rules — and findings always judge CURRENT state); returns
+    findings most-severe first (stable within severity)."""
+    from tidb_tpu.metrics.timeseries import recorder
+    deltas, begin, end = recorder.sample_window(window)
+    if not deltas:
+        return []
+    out: list[dict] = []
+    for rule in RULES:
+        out.extend(rule(deltas, begin, end))
+    out.sort(key=lambda r: (r["severity"] != "critical", r["rule"],
+                            r["item"]))
+    return out

@@ -206,5 +206,25 @@ def histogram(name: str) -> Histogram:
     return registry.histogram(name)
 
 
+def quantile(hist: Histogram, q: float) -> float:
+    """Approximate quantile from a histogram's cumulative buckets
+    (linear interpolation inside the bucket, Prometheus
+    histogram_quantile-style). 0.0 on an empty histogram; observations
+    past the last bound clamp to it."""
+    bounds, cum, _sum, count = hist.snapshot_buckets()
+    if count == 0:
+        return 0.0
+    target = q * count
+    lo_bound = 0.0
+    lo_cum = 0
+    for b, c in zip(bounds, cum[:-1]):
+        if c >= target:
+            span = c - lo_cum
+            frac = (target - lo_cum) / span if span else 1.0
+            return lo_bound + (b - lo_bound) * frac
+        lo_bound, lo_cum = b, c
+    return bounds[-1] if bounds else 0.0
+
+
 def gauge(name: str) -> Gauge:
     return registry.gauge(name)

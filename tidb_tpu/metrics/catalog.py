@@ -1,0 +1,145 @@
+"""The metrics catalog: every metric this engine emits, with its type
+and help text.
+
+Reference: TiDB registers every collector centrally (metrics/metrics.go)
+so the Grafana dashboards and docs can enumerate them; here the catalog
+is the single source of truth three consumers share:
+
+* information_schema.TIDB_TPU_METRICS renders TYPE/HELP from it,
+* README.md's observability tables must list every entry (the
+  conformance test cross-checks), and
+* tests/test_metrics_catalog.py walks the source tree for
+  metrics.counter/gauge/histogram call sites and fails on any name
+  missing here — so a new metric cannot land silently undocumented.
+
+Dynamic families (per-kind counters built with f-strings) register a
+PREFIX entry; `lookup()` resolves exact names first, then the longest
+matching prefix.
+"""
+
+from __future__ import annotations
+
+# name → (type, help). Types: "counter" | "gauge" | "histogram".
+CATALOG: dict[str, tuple[str, str]] = {
+    # ---- coprocessor / columnar channel ----
+    "copr.tpu.requests": ("counter", "Select requests routed to the device engine."),
+    "copr.tpu.cpu_fallbacks": ("counter", "Device-routable requests answered by the CPU engine instead."),
+    "copr.tpu.small_batched": ("counter", "Below-floor requests answered through a shared micro-batched dispatch."),
+    "copr.tpu.small_to_cpu": ("counter", "Below-floor requests answered solo by the CPU engine."),
+    "distsql.errors": ("counter", "Distsql select requests that errored."),
+    "distsql.send_seconds": ("histogram", "Latency of one distsql select round trip."),
+    "distsql.queries.": ("counter", "Distsql select requests by kind (select/index/topn...)."),
+    "distsql.columnar_": ("counter", "Columnar-channel results by outcome (hits/fallbacks/partials, counted per region partial)."),
+    # ---- plane cache ----
+    "copr.plane_cache.hits": ("counter", "Region plane-cache lookups served from a cached pack."),
+    "copr.plane_cache.misses": ("counter", "Region plane-cache lookups that had to re-pack."),
+    "copr.plane_cache.evictions": ("counter", "Plane-cache entries evicted by the LRU byte budget."),
+    "copr.plane_cache.invalidations_epoch": ("counter", "Plane-cache entries invalidated by a region epoch bump (split/merge)."),
+    "copr.plane_cache.invalidations_version": ("counter", "Plane-cache entries invalidated by a newer visible data version."),
+    "copr.plane_cache.bytes": ("gauge", "Bytes currently held by the region plane caches."),
+    "copr.plane_cache.bytes_pinned": ("gauge", "Cached bytes currently pinned device-resident (HBM)."),
+    "copr.plane_cache.entries": ("gauge", "Entries currently held by the region plane caches."),
+    "copr.plane_cache.top_pinned_table": ("gauge", "Table id holding the most HBM-pinned cached bytes."),
+    "copr.plane_cache.top_pinned_bytes": ("gauge", "HBM-pinned cached bytes of the top pinned table."),
+    # ---- degradation chain ----
+    "copr.degraded_": ("counter", "Tier fallbacks by kind (device_to_cpu, join_to_numpy, combine_to_host, mesh, batch, rows...)."),
+    # ---- mesh tier ----
+    "copr.mesh.placements": ("counter", "Region-to-shard placements computed."),
+    "copr.mesh.replacements": ("counter", "Region re-placements after an epoch bump."),
+    "copr.mesh.dispatches": ("counter", "Mesh dispatches that published a shard-balance layout."),
+    "copr.mesh.shard_rows_max": ("gauge", "Rows on the fullest shard of the last mesh combine."),
+    "copr.mesh.shard_rows_mean": ("gauge", "Mean rows per shard of the last mesh combine."),
+    "copr.mesh.shard_skew": ("gauge", "Max/mean per-shard row ratio of the last mesh combine (1.0 = balanced)."),
+    # ---- region heat ----
+    "copr.region_heat.read_rows": ("counter", "Rows read across all regions (heat tracker total)."),
+    "copr.region_heat.read_bytes": ("counter", "Bytes read across all regions (heat tracker total)."),
+    "copr.region_heat.write_rows": ("counter", "Rows written across all regions (heat tracker total)."),
+    "copr.region_heat.write_bytes": ("counter", "Bytes written across all regions (heat tracker total)."),
+    "copr.region_heat.regions": ("gauge", "Regions currently carrying access heat."),
+    "copr.region_heat.top_region": ("gauge", "Region id with the highest decayed heat score."),
+    "copr.region_heat.top_score": ("gauge", "Highest decayed region heat score."),
+    # ---- shared drain pool ----
+    "copr.drain_pool.tasks": ("counter", "Region drain tasks submitted to the shared pool."),
+    "copr.drain_pool.queue_depth": ("gauge", "Drain tasks queued waiting for a pool worker."),
+    "copr.drain_pool.size": ("gauge", "Configured worker bound of the shared drain pool."),
+    "copr.drain_pool.workers": ("gauge", "Live drain-pool worker threads."),
+    "copr.drain_pool.busy_us": ("counter", "Cumulative microseconds drain-pool workers spent running tasks."),
+    "copr.drain_pool.queue_wait_seconds": ("histogram", "Time a drain task waited in the pool queue before a worker picked it up."),
+    "copr.drain_pool.task_seconds": ("histogram", "Run time of one pooled region drain task."),
+    "copr.drain_pool.worker_utilization": ("gauge", "Busy fraction of the drain pool over the last metrics-recorder window."),
+    # ---- device / kernels ----
+    "ops.kernel_dispatches": ("counter", "Device kernel dispatches."),
+    "ops.kernel_dispatch_us": ("counter", "Cumulative host-observed device dispatch time (µs)."),
+    "ops.readbacks": ("counter", "Device-to-host readbacks."),
+    "ops.readback_bytes": ("counter", "Bytes read back device-to-host."),
+    "ops.jit_cache_hits": ("counter", "Compiled-kernel cache hits."),
+    "ops.jit_cache_misses": ("counter", "Compiled-kernel cache misses (trace+compile paid)."),
+    "ops.kernel_seconds": ("histogram", "Wall time of one device dispatch + readback."),
+    "device.busy_us": ("counter", "Cumulative microseconds the serialized device executed a program (metered inside kernels.dispatch_serial)."),
+    "device.busy_fraction": ("gauge", "Fraction of the last metrics-recorder window the device was executing (device saturated vs host stalled)."),
+    # ---- micro-batch scheduler ----
+    "sched.batched_dispatches": ("counter", "Shared micro-batched device dispatches."),
+    "sched.batched_statements": ("counter", "Statements answered through a shared batched dispatch."),
+    "sched.batch_size": ("histogram", "Statements per shared batched dispatch."),
+    "sched.slot_occupancy": ("histogram", "Filled fraction of the padded slot bucket per batched dispatch."),
+    "sched.padding_waste": ("histogram", "Padded-slot fraction wasted per batched dispatch."),
+    "sched.queue_depth": ("gauge", "Statements currently queued in the micro-batch gather window."),
+    "sched.window_expiries": ("counter", "Statement deadlines that expired inside a micro-batch gather window or shared dispatch."),
+    # ---- kv / backoff / txn ----
+    "kv.backoff.": ("counter", "Backoffer sleeps by retry kind (plus kv.backoff.txn_retry for optimistic replays)."),
+    "kv.backoff_exhausted": ("counter", "Statements whose backoff budget or deadline was exhausted."),
+    "kv.txn_retries": ("counter", "Transaction-level optimistic retries."),
+    "kv.txn_retry_exhausted": ("counter", "Transactions that exhausted the optimistic retry budget."),
+    # ---- session / server ----
+    "session.parse_seconds": ("histogram", "SQL parse phase latency."),
+    "session.compile_seconds": ("histogram", "Plan build + optimize phase latency."),
+    "session.run_seconds": ("histogram", "Execution phase latency."),
+    "session.retries": ("counter", "Statement-history replays after a retryable commit conflict."),
+    "session.retry_exhausted": ("counter", "Optimistic replays that exhausted the retry limit."),
+    "session.statements.": ("counter", "Executed statements by AST type."),
+    "server.connections_total": ("counter", "Wire connections served."),
+    "server.queued_connections": ("counter", "Connections that waited in the admission queue."),
+    "server.rejected_connections": ("counter", "Connections rejected typed (ER 1040) at the admission gate."),
+    "server.conn_queue_timeouts": ("counter", "Queued connections rejected typed (ER 1040) after tidb_tpu_conn_queue_timeout_ms."),
+    "server.conn_queue_depth": ("gauge", "Accepted connections currently waiting in the admission queue."),
+    "server.slow_queries": ("counter", "Statements over tidb_slow_log_threshold."),
+    # ---- perfschema / digest summary ----
+    "perfschema.digest_statements": ("counter", "Statements rolled into the digest summary."),
+    "perfschema.digest_entries": ("gauge", "Digest entries currently held (current + history windows)."),
+    "perfschema.digest_evicted": ("counter", "Digest entries evicted by the per-window cap."),
+    "perfschema.digest_windows_flushed": ("counter", "Digest summary windows rotated into history."),
+    "perfschema.digest_flush_errors": ("counter", "Digest window rotations deferred by an injected flush fault."),
+    # ---- flight recorder ----
+    "tracing.slow_traces_retained": ("counter", "Statement traces retained by the flight recorder (slow / deadline / degraded)."),
+    # ---- gc / compaction ----
+    "gc.runs": ("counter", "MVCC garbage-collection runs."),
+    "gc.versions_removed": ("counter", "MVCC versions removed by GC."),
+    "gc.tick_errors": ("counter", "GC ticks that errored."),
+    "gc.lease_lost": ("counter", "GC leader leases lost mid-run."),
+    "compactor.runs": ("counter", "Background compaction runs."),
+    "compactor.versions_removed": ("counter", "Versions removed by compaction."),
+    # ---- failpoints ----
+    "failpoint.triggers.": ("counter", "Failpoint activations by site name."),
+}
+
+# dynamic-family prefixes (f-string call sites register these)
+PREFIXES = tuple(sorted((n for n in CATALOG if n.endswith(".")
+                         or n.endswith("_")), key=len, reverse=True))
+
+
+def lookup(name: str) -> tuple[str, str] | None:
+    """(type, help) for a metric name — exact first, then the longest
+    matching dynamic-family prefix. Histogram series sampled as
+    `name_count`/`name_sum` resolve to their family."""
+    hit = CATALOG.get(name)
+    if hit is not None:
+        return hit
+    for suffix in ("_count", "_sum"):
+        if name.endswith(suffix):
+            fam = CATALOG.get(name[: -len(suffix)])
+            if fam is not None and fam[0] == "histogram":
+                return fam
+    for p in PREFIXES:
+        if name.startswith(p):
+            return CATALOG[p]
+    return None

@@ -1,0 +1,264 @@
+"""In-process metrics time series: the queryable history behind
+information_schema.TIDB_TPU_METRICS_HISTORY.
+
+Reference: TiDB's metrics schema (infoschema/metrics_schema.go +
+metrics_table.go) answers `SELECT` over Prometheus range queries so
+operators diagnose through SQL; here there is no Prometheus server, so a
+lock-cheap recorder samples the process registry itself on a fixed
+interval into a bounded ring of (timestamp, {name: value}) snapshots.
+`SELECT` over the history table then replaces eyeballing two /metrics
+scrapes and diffing by hand — the rate/delta columns are computed
+between adjacent samples at read time.
+
+Design rules:
+
+* NO background thread. Sampling is lazy: `maybe_sample()` is one
+  monotonic-clock compare on the fast path (statement end calls it),
+  and the diagnostics tables force a sample at read time so a SELECT
+  always sees a fresh bucket. A quiesced process holds no timer.
+* Bounded: the ring keeps `cap` samples (SET GLOBAL
+  tidb_tpu_metrics_history_cap); one sample is a plain dict of
+  ~a-few-hundred floats, so the whole history is a few MB at worst.
+* Histograms sample as two numeric series (`name_count`, `name_sum`) —
+  both monotonic, so rate/delta work the same as for counters.
+* Derived gauges: some utilization figures only exist BETWEEN two
+  samples (device busy fraction = Δbusy_us / Δwall). The recorder
+  computes them at sample time and publishes them as real registry
+  gauges too, so /metrics and the SQL surface agree.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from tidb_tpu import metrics
+from tidb_tpu.metrics import Counter, Gauge, Histogram
+
+DEFAULT_INTERVAL_S = 1.0
+DEFAULT_CAP = 240
+
+
+class _Sample:
+    __slots__ = ("ts", "mono", "values")
+
+    def __init__(self, ts: float, mono: float, values: dict):
+        self.ts = ts          # wall clock (rendered in the SQL surface)
+        self.mono = mono      # monotonic (rate denominators)
+        self.values = values  # name → (type_char, float)
+
+
+# type chars kept per sampled series: c=counter, g=gauge, h=histogram
+# (histogram _count/_sum series carry 'h' so the SQL surface can show
+# their family type while still rating them like counters)
+_MONOTONIC = ("c", "h")
+
+
+def _registry_values() -> dict:
+    """One consistent-enough walk of the process registry: each metric's
+    own lock makes its value internally consistent; cross-metric skew is
+    inherent to any scrape and fine for diagnostics."""
+    with metrics.registry._lock:
+        items = list(metrics.registry._metrics.items())
+    out: dict = {}
+    for name, m in items:
+        if isinstance(m, Counter):
+            out[name] = ("c", float(m.value))
+        elif isinstance(m, Gauge):
+            out[name] = ("g", float(m.value))
+        elif isinstance(m, Histogram):
+            out[name + "_count"] = ("h", float(m.count))
+            out[name + "_sum"] = ("h", float(m.sum))
+    return out
+
+
+class MetricsRecorder:
+    """Bounded ring of registry snapshots with lazy interval sampling."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 cap: int = DEFAULT_CAP):
+        self.interval_s = max(0.01, float(interval_s))
+        self._lock = threading.Lock()
+        self._ring: deque[_Sample] = deque(maxlen=max(2, int(cap)))
+        self._last_mono = 0.0
+
+    # ---- configuration (sysvar appliers) ----
+
+    def set_interval(self, seconds: float) -> None:
+        with self._lock:
+            self.interval_s = max(0.01, float(seconds))
+
+    def set_cap(self, n: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(2, int(n)))
+
+    @property
+    def cap(self) -> int:
+        return self._ring.maxlen or 0
+
+    # ---- sampling ----
+
+    def maybe_sample(self) -> bool:
+        """Take a sample if the interval elapsed. The miss path is one
+        monotonic read + one float compare — cheap enough for every
+        statement end."""
+        now = time.monotonic()
+        if now - self._last_mono < self.interval_s:
+            return False
+        return self.sample(now)
+
+    def sample(self, mono: float | None = None,
+               min_interval_s: float = 0.001) -> bool:
+        """Force a sample. `min_interval_s` is the spacing floor below
+        which the call coalesces into the previous sample: direct
+        callers (tests, inspection) keep the 1 ms default; READ-TIME
+        forcing (the history table) passes the configured interval, so
+        an operator polling the diagnostics tables during an incident
+        refreshes the ring at the designed cadence instead of
+        compressing the sample-count windows (and evicting real
+        history) with every SELECT."""
+        mono = time.monotonic() if mono is None else mono
+        with self._lock:
+            if mono - self._last_mono < min_interval_s:
+                return False        # coalesce
+            prev = self._ring[-1] if self._ring else None
+            self._last_mono = mono
+        # the registry walk and derived-gauge math run OUTSIDE the
+        # recorder lock: sampling must never serialize statement ends
+        values = _registry_values()
+        _apply_derived(prev, mono, values)
+        sample = _Sample(time.time(), mono, values)
+        with self._lock:
+            if self._ring and self._ring[-1].mono >= mono:
+                # a concurrent sampler with a NEWER reservation finished
+                # its walk first: appending this older snapshot would
+                # put the ring out of monotonic order (negative DELTA
+                # rows, inverted inspection windows) — drop it
+                return False
+            self._ring.append(sample)
+        return True
+
+    def sample_window(self, window: int) -> tuple[dict, float, float]:
+        """Force a sample AND return (deltas, begin_ts, end_ts) over the
+        trailing window ending at that fresh registry walk — ONE walk
+        serves both, and the window's end is always CURRENT state (a
+        sub-ms-coalesced forced sample can never hide a just-fired
+        burst). The inspection rules read this."""
+        mono = time.monotonic()
+        with self._lock:
+            prev = self._ring[-1] if self._ring else None
+            # a new RING bucket only at the configured cadence (an
+            # inspection poll loop must not compress the windows); the
+            # deltas below always ride the fresh walk regardless
+            fresh = mono - self._last_mono >= self.interval_s
+            if fresh:
+                self._last_mono = mono
+        values = _registry_values()
+        _apply_derived(prev, mono, values)
+        if fresh:
+            with self._lock:
+                if not self._ring or self._ring[-1].mono < mono:
+                    self._ring.append(_Sample(time.time(), mono, values))
+        samples = self.samples()[-max(2, window):]
+        if not samples:
+            return {}, 0.0, 0.0
+        return (self._deltas_from(samples[0], values), samples[0].ts,
+                time.time())
+
+    @staticmethod
+    def _deltas_from(first: _Sample, last_values: dict) -> dict:
+        """Monotonic series: increase first→last. Gauges: the LAST
+        value (a saturation gauge is meaningful as a level, not a
+        delta)."""
+        out: dict = {}
+        for name, (tc, v) in last_values.items():
+            if tc in _MONOTONIC:
+                out[name] = v - first.values.get(name, (tc, 0.0))[1]
+            else:
+                out[name] = v
+        return out
+
+    # ---- read surface ----
+
+    def samples(self) -> list[_Sample]:
+        with self._lock:
+            return list(self._ring)
+
+    def series(self, name: str) -> list[tuple[float, float]]:
+        """(wall ts, value) for one sampled series, oldest first."""
+        return [(s.ts, s.values[name][1]) for s in self.samples()
+                if name in s.values]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._last_mono = 0.0
+
+
+def _apply_derived(prev: _Sample | None, mono: float,
+                   values: dict) -> None:
+    """Between-sample utilization gauges, published into both the new
+    sample and the live registry:
+
+    * device.busy_fraction — Δdevice.busy_us over the wall interval:
+      the fraction of the window the (serialized) device executed a
+      program, i.e. "device saturated" vs "host stalled".
+    * copr.drain_pool.worker_utilization — Δcopr.drain_pool.busy_us
+      over interval × pool size: how busy the shared fan-out drain
+      workers were.
+    """
+    if prev is None:
+        return
+    dt_us = (mono - prev.mono) * 1e6
+    if dt_us <= 0:
+        return
+
+    def delta(name: str) -> float:
+        cur = values.get(name)
+        if cur is None:
+            return 0.0
+        return cur[1] - prev.values.get(name, (cur[0], 0.0))[1]
+
+    busy = min(1.0, max(0.0, delta("device.busy_us") / dt_us))
+    metrics.gauge("device.busy_fraction").set(round(busy, 6))
+    values["device.busy_fraction"] = ("g", round(busy, 6))
+
+    size = values.get("copr.drain_pool.size", ("g", 0.0))[1]
+    if size > 0:
+        util = min(1.0, max(
+            0.0, delta("copr.drain_pool.busy_us") / (dt_us * size)))
+        metrics.gauge("copr.drain_pool.worker_utilization").set(
+            round(util, 6))
+        values["copr.drain_pool.worker_utilization"] = ("g",
+                                                        round(util, 6))
+
+
+# the process recorder (the registry it samples is process-wide too)
+recorder = MetricsRecorder()
+
+
+def history_rows() -> list[tuple]:
+    """(ts, name, type_char, value, delta, rate_per_sec) rows, sample-
+    major oldest-first — the TIDB_TPU_METRICS_HISTORY row source. Delta
+    is vs the previous sample carrying the series (None for the first
+    occurrence); gauges get value-to-value deltas too — what you want
+    when eyeballing a queue-depth series — but rate stays NULL for
+    them (rate is a monotonic-series notion)."""
+    out: list[tuple] = []
+    prev: _Sample | None = None
+    for s in recorder.samples():
+        for name in sorted(s.values):
+            tc, v = s.values[name]
+            delta = rate = None
+            if prev is not None and name in prev.values:
+                delta = v - prev.values[name][1]
+                dt = s.mono - prev.mono
+                if dt > 0 and tc in _MONOTONIC:
+                    # rate only for monotonic series: a level gauge's
+                    # value-to-value slope reads as nonsense next to
+                    # counter rates
+                    rate = delta / dt
+            out.append((s.ts, name, tc, v, delta, rate))
+        prev = s
+    return out

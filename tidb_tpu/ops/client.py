@@ -471,7 +471,9 @@ class TpuClient(kv.Client):
                     f"injected device OOM ({kind})"))
             # launch + readback serialized across statement threads
             # (kernels.dispatch_serial): concurrent sessions racing a
-            # program's dispatch/first-compile can wedge the runtime
+            # program's dispatch/first-compile can wedge the runtime.
+            # The lock is metered — held time feeds device.busy_us and
+            # the diagnostics tier's device.busy_fraction window gauge
             with kernels.dispatch_serial:
                 packed = jitted(planes, live, *extra)
                 t_disp = _time.perf_counter()

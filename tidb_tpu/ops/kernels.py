@@ -46,8 +46,49 @@ I64_MIN = -(1 << 63)
 # serializing the launch+readback costs no real parallelism; compute-
 # only helpers (plane pads/gathers) stay outside.
 import threading as _threading
+import time as _time
 
-dispatch_serial = _threading.Lock()
+
+class _MeteredDispatchLock:
+    """dispatch_serial with device-busy metering: every executable
+    launch+readback already serializes here, so the time the lock is
+    HELD is exactly the time the device (or the runtime on its behalf)
+    was executing a program — the `device.busy_us` counter the
+    diagnostics tier turns into `device.busy_fraction` per window
+    ("device saturated" vs "host stalled"). One perf_counter pair per
+    dispatch; held-time is single-holder by construction so the _t0
+    attribute needs no extra lock."""
+
+    __slots__ = ("_lock", "_t0")
+
+    def __init__(self):
+        self._lock = _threading.Lock()
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._lock.acquire()
+        self._t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        held_us = (_time.perf_counter() - self._t0) * 1e6
+        self._lock.release()
+        from tidb_tpu import metrics
+        metrics.counter("device.busy_us").inc(int(held_us))
+        return False
+
+    # Lock-protocol passthrough for any caller not using `with`
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        return self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+
+dispatch_serial = _MeteredDispatchLock()
 
 # pseudo column id carrying the global row position plane (arange over the
 # batch; sharded along with the data under shard_map, so positions stay

@@ -159,6 +159,27 @@ class RegionPlacement:
                 for rid, ep in zip(region_ids, epochs)]
 
 
+def publish_shard_balance(rows_per_shard) -> None:
+    """Per-shard row-imbalance gauges for the diagnostics tier: the mesh
+    combine calls this with its shard layout's row counts, so the
+    inspection rules (and the ROADMAP's rig re-stamp) can tell a
+    saturated balanced mesh from one shard dragging the collective.
+    skew = max/mean (1.0 = perfectly balanced)."""
+    from tidb_tpu import metrics
+    counts = [int(c) for c in rows_per_shard]
+    if not counts:
+        return
+    # the activity counter gates the skew inspection rule: a stale skew
+    # gauge from long-quiesced traffic must not keep a finding alive
+    metrics.counter("copr.mesh.dispatches").inc()
+    mx = max(counts)
+    mean = sum(counts) / len(counts)
+    metrics.gauge("copr.mesh.shard_rows_max").set(mx)
+    metrics.gauge("copr.mesh.shard_rows_mean").set(round(mean, 3))
+    metrics.gauge("copr.mesh.shard_skew").set(
+        round(mx / mean, 3) if mean > 0 else 0.0)
+
+
 def placement_for(mesh) -> RegionPlacement:
     """The process placement for a mesh (one per mesh instance)."""
     with _lock:
@@ -330,6 +351,7 @@ def combine_rows_sharded(mesh, specs, gid, G: int, slices,
     placement = placement_for(mesh)
     shard_of = placement.shard_of(region_ids, epochs)
     idx, live, per_shard = _shard_layout(slices, shard_of, mesh.n)
+    publish_shard_balance(per_shard)
     lmax = len(live) // mesh.n
 
     gid_sh = np.where(live, np.asarray(gid, np.int64)[idx], G)
@@ -547,6 +569,7 @@ def join_probe_sharded(mesh, rs, order, n_valid, lk_d, lv_d, lcap: int,
                 totals.append(int(b[-1]))
         worst = max(totals)
         if worst <= out_cap:
+            publish_shard_balance(totals)   # probe-match imbalance
             break
         out_cap = col.bucket_capacity(worst)
     l_parts, r_parts = [], []

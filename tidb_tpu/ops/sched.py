@@ -56,6 +56,19 @@ from tidb_tpu.sqlast.opcode import Op
 _SLOT_BUCKETS = (8, 32)
 MAX_SLOTS = _SLOT_BUCKETS[-1]
 
+# histogram bounds for [0, 1] slot fractions (occupancy/padding): 1/32
+# steps so every possible k/kb value lands on an exact bucket bound and
+# metrics.quantile interpolates within <= 1/32. Registered EAGERLY at
+# import: first creation pins a histogram's buckets, and a reader
+# (bench/tests calling metrics.histogram) must never pin the default
+# latency-shaped bounds first.
+_FRACTION_BUCKETS = tuple((i + 1) / MAX_SLOTS for i in range(MAX_SLOTS))
+
+from tidb_tpu import metrics as _metrics  # noqa: E402
+
+for _n in ("sched.slot_occupancy", "sched.padding_waste"):
+    _metrics.registry.histogram(_n, buckets=_FRACTION_BUCKETS)
+
 _CMP_OPS = {Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE}
 _LOGIC_OPS = {Op.AndAnd, Op.OrOr, Op.Xor}
 
@@ -358,6 +371,14 @@ class MicroBatcher:
         self._last_thread = None    # ... and which thread submitted it
         self._last_multi = 0.0      # ts of the last multi-statement batch
 
+    def _refresh_queue_gauge(self) -> None:
+        """sched.queue_depth from the live queue — called (under the
+        lock) at EVERY queue mutation, including the follower self-
+        removal paths, so a quiesced batcher always reports 0 instead of
+        the depth of the last submit burst."""
+        from tidb_tpu import metrics
+        metrics.gauge("sched.queue_depth").set(len(self._queue))
+
     # ------------------------------------------------------------------
     # eligibility + lowering (on the submitting statement's thread)
     # ------------------------------------------------------------------
@@ -429,7 +450,7 @@ class MicroBatcher:
             is_leader = not self._leader_active
             if is_leader:
                 self._leader_active = True
-            metrics.gauge("sched.queue_depth").set(len(self._queue))
+            self._refresh_queue_gauge()
         if is_leader:
             self._lead(client, entry, window_s)
         else:
@@ -478,14 +499,15 @@ class MicroBatcher:
             for e in entries:
                 e.taken = True
             self._leader_active = False
-            from tidb_tpu import metrics
-            metrics.gauge("sched.queue_depth").set(0)
+            self._refresh_queue_gauge()
         if stall_err is not None:
             for e in entries:
                 if e is not own:
                     e.degrade = "stall"
                 e.event.set()
             if isinstance(stall_err, errors.DeadlineExceededError):
+                from tidb_tpu import metrics
+                metrics.counter("sched.window_expiries").inc()
                 own.error = stall_err   # typed statement failure
             else:
                 own.degrade = "stall"
@@ -508,11 +530,14 @@ class MicroBatcher:
                 try:
                     bo.check_deadline("micro-batch gather")
                 except errors.DeadlineExceededError as e:
+                    from tidb_tpu import metrics
                     with self._lock:
                         if not entry.taken and entry in self._queue:
                             self._queue.remove(entry)
+                            self._refresh_queue_gauge()
                     # only the expired statement fails — its slot (if
                     # already taken) computes a result nobody reads
+                    metrics.counter("sched.window_expiries").inc()
                     entry.error = e
                     return
             if time.monotonic() >= end:
@@ -521,6 +546,7 @@ class MicroBatcher:
                         # leader stalled without draining: reclaim the
                         # entry and take the solo route
                         self._queue.remove(entry)
+                        self._refresh_queue_gauge()
                         entry.degrade = "stall"
                         return
                 # taken: the leader is executing — keep waiting (its own
@@ -556,6 +582,8 @@ class MicroBatcher:
                 # the LEADER's statement deadline expired inside the
                 # shared dispatch: only the leader fails typed; its
                 # batch-mates degrade to the solo route
+                from tidb_tpu import metrics
+                metrics.counter("sched.window_expiries").inc()
                 for e in group:
                     if e.result is not None:
                         continue
@@ -651,6 +679,17 @@ class MicroBatcher:
         masks = packed.reshape(kb, batch.capacity)[:k].astype(bool)
         metrics.counter("sched.batched_dispatches").inc()
         metrics.histogram("sched.batch_size").observe(k)
+        # slot-bucket economics for the profiler: how full the padded
+        # dispatch was, and what fraction of its slots computed a result
+        # nobody reads (the bench's batch_slot_occupancy_p50 source).
+        # Fraction-shaped buckets (1/32 steps — occupancies are k/8 or
+        # k/32): the default latency buckets would smear every quantile
+        metrics.registry.histogram(
+            "sched.slot_occupancy", buckets=_FRACTION_BUCKETS
+        ).observe(k / kb)
+        metrics.registry.histogram(
+            "sched.padding_waste", buckets=_FRACTION_BUCKETS
+        ).observe((kb - k) / kb)
         if k > 1:
             with self._lock:
                 self._hot[proto.sig] = self._last_multi = time.monotonic()

@@ -22,6 +22,7 @@ import itertools
 import json
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tidb_tpu.server.conn import ClientConnection
@@ -46,10 +47,12 @@ class Server:
         self._conn_ids = _conn_id_gen
         self._conns: set[ClientConnection] = set()
         self._conns_lock = threading.Lock()
-        # admission state: active workers + pending (accepted, unserved)
+        # admission state: active workers + pending (accepted, unserved,
+        # stamped with their enqueue time for the queue-wait deadline)
         self._admission_lock = threading.Lock()
         self._active_workers = 0
         self._pending: collections.deque = collections.deque()
+        self._sweeper_alive = False
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         # one internal session for auth lookups (session.go ExecRestrictedSQL)
@@ -119,21 +122,25 @@ class Server:
                     continue
                 if len(self._pending) < depth:
                     # saturated workers: queue until one frees (graceful
-                    # degradation — latency, not failure)
-                    self._pending.append(sock)
+                    # degradation — latency, not failure). The queue-wait
+                    # deadline sweeper bounds how long an abandoned
+                    # socket can occupy a slot.
+                    self._pending.append((sock, time.monotonic()))
                     qd.set(len(self._pending))
                     metrics.counter("server.queued_connections").inc()
+                    self._ensure_sweeper_locked()
                     continue
             # queue full too: typed rejection (MySQL ER_CON_COUNT_ERROR),
             # never a silent close the client can't distinguish from a
             # network fault
             self._reject(sock)
 
-    def _reject(self, sock) -> None:
+    def _reject(self, sock, counter: str = "server.rejected_connections"
+                ) -> None:
         from tidb_tpu import metrics, mysqldef as my
         from tidb_tpu.server import protocol as p
         from tidb_tpu.server.packetio import PacketIO
-        metrics.counter("server.rejected_connections").inc()
+        metrics.counter(counter).inc()
         pkt = PacketIO(sock)
         try:
             pkt.write_packet(p.err_packet(
@@ -142,6 +149,67 @@ class Server:
             pass
         finally:
             pkt.close()
+
+    # ------------------------------------------------------------------
+    # admission-queue wait deadline (tidb_tpu_conn_queue_timeout_ms):
+    # a queued connection is rejected TYPED after T ms instead of
+    # waiting forever on the client's own connect timeout — abandoned
+    # sockets must not occupy admission-queue slots indefinitely.
+    # ------------------------------------------------------------------
+
+    def _queue_timeout_s(self) -> float:
+        ms = self._int_sysvar("tidb_tpu_conn_queue_timeout_ms")
+        return max(0, ms) / 1000.0
+
+    def _take_expired_locked(self) -> list:
+        """Pull timed-out sockets off the pending queue (admission lock
+        held); the caller rejects them OUTSIDE the lock. 0 = no
+        deadline."""
+        timeout_s = self._queue_timeout_s()
+        if timeout_s <= 0 or not self._pending:
+            return []
+        now = time.monotonic()
+        keep: collections.deque = collections.deque()
+        expired = []
+        for sock, t_enq in self._pending:
+            if now - t_enq >= timeout_s:
+                expired.append(sock)
+            else:
+                keep.append((sock, t_enq))
+        if expired:
+            self._pending = keep
+            from tidb_tpu import metrics
+            metrics.gauge("server.conn_queue_depth").set(len(keep))
+        return expired
+
+    def _ensure_sweeper_locked(self) -> None:
+        """Start the queue-deadline sweeper (admission lock held). One
+        daemon thread lives while the queue is non-empty — a queued
+        socket with no accepts arriving and no workers freeing would
+        otherwise never be swept. Started UNCONDITIONALLY on enqueue
+        (not gated on the current timeout): the sweep loop reads the
+        sysvar live, so SET GLOBAL tidb_tpu_conn_queue_timeout_ms while
+        sockets are already queued still sheds the backlog."""
+        if self._sweeper_alive:
+            return
+        self._sweeper_alive = True
+        threading.Thread(target=self._sweep_loop, daemon=True,
+                         name="tidb-conn-queue-sweeper").start()
+
+    def _sweep_loop(self) -> None:
+        while True:
+            time.sleep(0.02)
+            with self._admission_lock:
+                expired = self._take_expired_locked()
+                if not self.running or not self._pending:
+                    self._sweeper_alive = False
+                    done = True
+                else:
+                    done = False
+            for sock in expired:
+                self._reject(sock, counter="server.conn_queue_timeouts")
+            if done:
+                return
 
     def _conn_worker(self, sock) -> None:
         """One BOUNDED connection worker: serves a connection to
@@ -163,21 +231,30 @@ class Server:
                 if not ok:
                     with self._admission_lock:
                         self._active_workers -= 1
+                        expired = self._take_expired_locked()
                         if self._pending and self.running:
-                            nxt = self._pending.popleft()
+                            nxt, _ts = self._pending.popleft()
                             qd.set(len(self._pending))
                             self._active_workers += 1
                             threading.Thread(
                                 target=self._conn_worker, args=(nxt,),
                                 daemon=True,
                                 name="tidb-conn-worker-r").start()
+                    for dead in expired:
+                        self._reject(
+                            dead, counter="server.conn_queue_timeouts")
             with self._admission_lock:
+                expired = self._take_expired_locked()
                 if self._pending and self.running:
-                    sock = self._pending.popleft()
+                    sock, _ts = self._pending.popleft()
                     qd.set(len(self._pending))
                 else:
                     self._active_workers -= 1
-                    return
+                    sock = None
+            for dead in expired:
+                self._reject(dead, counter="server.conn_queue_timeouts")
+            if sock is None:
+                return
 
     def _serve_conn(self, sock) -> None:
         from tidb_tpu import metrics
@@ -241,7 +318,7 @@ class Server:
         with self._admission_lock:
             pending = list(self._pending)
             self._pending.clear()
-        for sock in pending:
+        for sock, _ts in pending:
             try:
                 sock.close()
             except OSError:

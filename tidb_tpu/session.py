@@ -319,15 +319,25 @@ class Session:
             # mysql.global_variables) must not wipe the warnings their
             # enclosing statement just produced
             self.vars.warnings = []
-        # statement-level span tree, opt-in (SET tidb_trace_enabled = 1):
-        # the default path allocates nothing — one dict lookup decides
+        # statement-level span tree: built for every top-level statement
+        # while the flight recorder is live (always-on-but-cheap — the
+        # tree is RETAINED only when the statement turns out slow,
+        # deadline-dead, or degraded) and, as before, when SET
+        # tidb_trace_enabled = 1 asked for it explicitly. With both off
+        # the path allocates nothing — two dict lookups decide.
         root = None
         trace_tok = None
-        if self._exec_depth == 0 and self._tracing_enabled():
-            root = tracing.Span("statement")
-            root.set("sql", sql_text[:256])
-            root.set("conn", self.vars.connection_id)
-            trace_tok = tracing.attach(root)
+        trace_on = False
+        fr = None
+        if self._exec_depth == 0:
+            from tidb_tpu import flight
+            trace_on = self._tracing_enabled()
+            fr = flight.recorder_for(self.store)
+            if trace_on or fr.enabled:
+                root = tracing.Span("statement")
+                root.set("sql", sql_text[:256])
+                root.set("conn", self.vars.connection_id)
+                trace_tok = tracing.attach(root)
         # the statement's unified Backoffer: ONE budget + deadline
         # (tidb_tpu_max_execution_time) shared by every retry ladder the
         # statement reaches, on this thread and the fan-out workers.
@@ -349,6 +359,11 @@ class Session:
                 self._record_digest(ps, dig, norm, sql_text,
                                     (_time.perf_counter() - t0) * 1e3,
                                     0, 0, True, res)
+                self._maybe_flight_record(
+                    fr, root, dig, sql_text,
+                    (_time.perf_counter() - t0) * 1e3, res,
+                    deadline=isinstance(e, errors.DeadlineExceededError),
+                    error=str(e))
                 raise
         finally:
             self._exec_depth -= 1
@@ -357,7 +372,8 @@ class Session:
             if root is not None:
                 tracing.detach(trace_tok)
                 root.finish()
-                self.last_trace = root
+                if trace_on:
+                    self.last_trace = root
         res = self._exec_resources(ch0, cf0, cp0, tally0)
         n_sent = len(rs.rows) if rs is not None else 0
         ps.end_statement(ev, rows_sent=n_sent,
@@ -366,11 +382,40 @@ class Session:
         self._record_digest(ps, dig, norm, sql_text,
                             (_time.perf_counter() - t0) * 1e3,
                             n_sent, self.vars.affected_rows, False, res)
+        self._maybe_flight_record(fr, root, dig, sql_text,
+                                  (_time.perf_counter() - t0) * 1e3, res)
         self._maybe_log_slow(sql_text, _time.perf_counter() - t0,
                              res["columnar_hits"],
                              res["columnar_fallbacks"],
                              res["columnar_partials"], res, root, dig)
+        if self._exec_depth == 0:
+            # metrics time series: lazy interval sampling on statement
+            # end — one monotonic read on the miss path
+            from tidb_tpu.metrics.timeseries import recorder as _tsrec
+            _tsrec.maybe_sample()
         return rs
+
+    def _maybe_flight_record(self, fr, root, dig: str, sql_text: str,
+                             elapsed_ms: float, res: dict,
+                             deadline: bool = False,
+                             error: str = "") -> None:
+        """Flight-recorder retention decision for one finished top-level
+        statement (success and error paths share it): keep the span tree
+        iff the statement crossed the slow-log threshold, died on its
+        deadline, or degraded through any tier — otherwise the tree is
+        dropped here and the fast path retains nothing."""
+        if fr is None or root is None or not fr.enabled:
+            return
+        from tidb_tpu import flight
+        reason = flight.retain_reason(elapsed_ms,
+                                      self._slow_threshold_ms(), res,
+                                      deadline)
+        if reason is None:
+            return
+        root.finish()   # idempotent; the finally's finish is then a no-op
+        fr.record(conn_id=self.vars.connection_id, digest=dig,
+                  sql_text=sql_text, duration_ms=elapsed_ms,
+                  reason=reason, root=root, resources=res, error=error)
 
     def _exec_resources(self, ch0: int, cf0: int, cp0: int,
                         tally0: dict) -> dict:
@@ -429,6 +474,18 @@ class Session:
             v = self.global_vars.values.get("tidb_trace_enabled")
         return v is not None and v.strip().lower() in ("1", "on", "true")
 
+    def _slow_threshold_ms(self) -> float:
+        """tidb_slow_log_threshold in ms — the slow log's and the flight
+        recorder's shared 'this statement mattered' bound."""
+        from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+        raw = self.vars.get_system("tidb_slow_log_threshold",
+                                   self.global_vars) \
+            or SYSVAR_DEFAULTS["tidb_slow_log_threshold"]
+        try:
+            return float(raw)
+        except ValueError:
+            return float(SYSVAR_DEFAULTS["tidb_slow_log_threshold"])
+
     def _maybe_log_slow(self, sql_text: str, elapsed_s: float,
                         columnar_hits: int = 0,
                         columnar_fallbacks: int = 0,
@@ -441,14 +498,7 @@ class Session:
         The detail line carries the statement's device-kernel tallies
         and, when the statement was traced (tidb_trace_enabled), a
         per-region copr summary derived from the span tree."""
-        from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
-        raw = self.vars.get_system("tidb_slow_log_threshold",
-                                   self.global_vars) \
-            or SYSVAR_DEFAULTS["tidb_slow_log_threshold"]
-        try:
-            thr_ms = float(raw)
-        except ValueError:
-            thr_ms = float(SYSVAR_DEFAULTS["tidb_slow_log_threshold"])
+        thr_ms = self._slow_threshold_ms()
         if thr_ms > 0 and elapsed_s * 1000 >= thr_ms:
             import logging
             kt = kernel_tally or {}
@@ -1126,6 +1176,53 @@ class Session:
         from tidb_tpu import perfschema
         perfschema.perf_for(self.store).set_history_cap(n)
 
+    def apply_flight_recorder(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_flight_recorder = 0|1 — the slow-trace
+        flight recorder: off stops building the always-on span trees and
+        clears the retained ring (tidb_trace_enabled / EXPLAIN ANALYZE
+        still trace explicitly)."""
+        from tidb_tpu import flight
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        if value.strip().lower() not in ("0", "1", "on", "off", "true",
+                                         "false"):
+            raise errors.ExecError(
+                f"tidb_tpu_flight_recorder must be 0 or 1, got {value!r}")
+        self._require_global_grant("tidb_tpu_flight_recorder")
+        flight.recorder_for(self.store).set_enabled(
+            parse_bool_sysvar(value))
+
+    def apply_slow_trace_cap(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_slow_trace_cap = N — retained slow traces
+        kept per store (shrink drops the oldest immediately)."""
+        n = self._int_sysvar("tidb_tpu_slow_trace_cap", value, 1)
+        self._require_global_grant("tidb_tpu_slow_trace_cap")
+        from tidb_tpu import flight
+        flight.recorder_for(self.store).set_cap(n)
+
+    def apply_metrics_interval(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_metrics_interval_ms = N — the metrics
+        time-series sampling interval (process-wide, like the registry
+        it samples)."""
+        n = self._int_sysvar("tidb_tpu_metrics_interval_ms", value, 10)
+        self._require_global_grant("tidb_tpu_metrics_interval_ms")
+        from tidb_tpu.metrics.timeseries import recorder
+        recorder.set_interval(n / 1000.0)
+
+    def apply_metrics_history_cap(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_metrics_history_cap = N — samples the
+        metrics time-series ring retains (shrink keeps the newest)."""
+        n = self._int_sysvar("tidb_tpu_metrics_history_cap", value, 2)
+        self._require_global_grant("tidb_tpu_metrics_history_cap")
+        from tidb_tpu.metrics.timeseries import recorder
+        recorder.set_cap(n)
+
+    def apply_conn_queue_timeout(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_conn_queue_timeout_ms = N — the admission
+        queue's server-side wait deadline (0 = wait forever; the server
+        reads it live per sweep, nothing to flip here)."""
+        self._int_sysvar("tidb_tpu_conn_queue_timeout_ms", value)
+        self._require_global_grant("tidb_tpu_conn_queue_timeout_ms")
+
     def persist_global_var(self, name: str, value: str) -> None:
         """Write-through to mysql.global_variables (session.go globalVars)."""
         if self.store.uuid() not in _BOOTSTRAPPED_STORES:
@@ -1362,6 +1459,32 @@ def bootstrap(session: Session) -> None:
             # PerfSchema — hydrate them like the plane cache's
             from tidb_tpu import perfschema
             perfschema.apply_sysvars(session.store, gv.values)
+            # flight-recorder knobs live on the per-store recorder;
+            # metrics-recorder knobs are process-wide like the drain pool
+            from tidb_tpu import flight
+            fr = flight.recorder_for(session.store)
+            v = gv.values.get("tidb_tpu_flight_recorder")
+            if v is not None:
+                fr.set_enabled(parse_bool_sysvar(v))
+            v = gv.values.get("tidb_tpu_slow_trace_cap")
+            try:
+                if v:
+                    fr.set_cap(max(1, int(v.strip())))
+            except ValueError:
+                pass
+            from tidb_tpu.metrics.timeseries import recorder as _tsrec
+            v = gv.values.get("tidb_tpu_metrics_interval_ms")
+            try:
+                if v:
+                    _tsrec.set_interval(max(10, int(v.strip())) / 1000.0)
+            except ValueError:
+                pass
+            v = gv.values.get("tidb_tpu_metrics_history_cap")
+            try:
+                if v:
+                    _tsrec.set_cap(max(2, int(v.strip())))
+            except ValueError:
+                pass
             return
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,

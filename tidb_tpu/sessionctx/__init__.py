@@ -115,6 +115,29 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_tpu_stmt_summary_history_size": "24",
     # events_statements_history ring size (bounded; GLOBAL-only)
     "tidb_tpu_perfschema_history_cap": "1024",
+    # slow-statement flight recorder (tidb_tpu.flight): 1 records every
+    # top-level statement's span tree into a scratch buffer and RETAINS
+    # it only when the statement crossed the slow-log threshold, died on
+    # its deadline, or degraded through any tier — queryable via
+    # information_schema.TIDB_TPU_SLOW_TRACES. 0 stops building spans
+    # (tidb_trace_enabled / EXPLAIN ANALYZE still work) and clears the
+    # ring. GLOBAL-only, store-level, hydrated on restart.
+    "tidb_tpu_flight_recorder": "1",
+    # retained slow traces kept per store (bounded ring). GLOBAL-only.
+    "tidb_tpu_slow_trace_cap": "64",
+    # metrics time-series recorder (metrics.timeseries): sampling
+    # interval in ms and samples retained — the history behind
+    # information_schema.TIDB_TPU_METRICS_HISTORY and the inspection
+    # rules' evaluation windows. Process-wide (the registry is),
+    # GLOBAL-only like tidb_tpu_drain_pool_size.
+    "tidb_tpu_metrics_interval_ms": "1000",
+    "tidb_tpu_metrics_history_cap": "240",
+    # admission-queue wait deadline in ms: a connection queued behind
+    # the admission gate is rejected typed (ER 1040, counted on
+    # server.conn_queue_timeouts) after this long instead of waiting
+    # forever on the client's own connect timeout. 0 = wait forever
+    # (the pre-deadline behavior). GLOBAL-only, read live per sweep.
+    "tidb_tpu_conn_queue_timeout_ms": "10000",
     "tidb_copr_batch_rows": "1048576",
 }
 

@@ -11,11 +11,17 @@ set of always-on per-thread counters cheap enough for every statement
 
 Design rules:
 
-* OFF is the default and must cost ~nothing: `current()` is one
-  thread-local read; every span operation on the shared NOOP sentinel
-  is a constant-returning method. No Span object is ever allocated
-  while tracing is off (`span_allocations` counts real allocations so
-  tests can assert exactly that).
+* OFF must cost ~nothing: `current()` is one thread-local read; every
+  span operation on the shared NOOP sentinel is a constant-returning
+  method. With both `tidb_trace_enabled` and the flight recorder
+  (tidb_tpu.flight) disabled, no Span object is ever allocated
+  (`span_allocations` counts real allocations so tests can assert
+  exactly that). With the flight recorder live — its default — every
+  top-level statement builds a SCRATCH span tree, but a healthy
+  statement retains none of it: the tree is dropped at statement end
+  unless the statement crossed the slow-log threshold, died on its
+  deadline, or degraded through a tier (the extended guard asserts
+  < 2 ms/statement and zero retained allocations on that fast path).
 * Worker threads (the cluster fan-out) attach explicitly: a span
   created on the statement thread is handed to the worker, which
   `attach()`es it so nested `trace(...)` blocks land under the right
