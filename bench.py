@@ -691,6 +691,115 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
     }
 
 
+Q1_PUSHDOWN_SQL = (
+    "select l_flag, l_status, sum(l_qty), sum(l_price), avg(l_qty), "
+    "avg(l_price), avg(l_disc), count(*) from lineitem "
+    "where l_ship <= 180 group by l_flag, l_status "
+    "order by l_flag, l_status")
+
+
+def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
+    """TPC-H-q1-shaped aggregate PUSHDOWN over the 4-region cluster
+    store: the planner pushes the partial-row aggregate, every region
+    answers with grouped partial STATES (ColumnarAggStates — states,
+    not rows, cross the wire), and the FINAL aggregate merges them
+    through the device/mesh combine chain (fused_agg.try_fused_final).
+    Asserts zero columnar fallbacks, ≥ n_regions states partials per
+    run, a states-channel fusion per run, and exact parity vs the row
+    protocol (kill switch). Emits the states-vs-rows wire-bytes ratio
+    from the copr.agg_{states,rows}.wire_bytes counters."""
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.executor import fused_agg
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    store = new_store(f"cluster://3/benchq1p{n_rows}")
+    s = Session(store)
+    s.execute("create database q1p")
+    s.execute("use q1p")
+    s.execute("create table lineitem (l_id bigint primary key, "
+              "l_flag varchar(4), l_status varchar(4), "
+              "l_qty decimal(12,2), l_price decimal(12,2), "
+              "l_disc double, l_ship bigint)")
+    tbl = s.info_schema().table_by_name("q1p", "lineitem")
+    from decimal import Decimal
+    flags = ("A", "N", "R")
+    stats = ("F", "O")
+    rows = [[Datum.i64(i), Datum.string(flags[i % 3]),
+             Datum.string(stats[i % 2]),
+             Datum.dec(Decimal(i % 50) + Decimal(i % 4) / 4),
+             Datum.dec(Decimal(900 + i * 7) + Decimal(i % 10) / 10),
+             Datum.f64((i % 11) * 0.01), Datum.i64(i % 365)]
+            for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+
+    fbs = metrics.counter("distsql.columnar_fallbacks")
+    states = metrics.counter("distsql.columnar_states")
+    st_bytes = metrics.counter("copr.agg_states.wire_bytes")
+    row_bytes = metrics.counter("copr.agg_rows.wire_bytes")
+    s.execute(Q1_PUSHDOWN_SQL)            # warm (pack + jit)
+    f0, p0, b0 = fbs.value, states.value, st_bytes.value
+    fs0 = fused_agg.stats["final_states"]
+    t0 = time.time()
+    for _ in range(runs):
+        col_results = s.execute(Q1_PUSHDOWN_SQL)[0].values()
+    t_col = (time.time() - t0) / runs
+    d_fbs = fbs.value - f0
+    d_states = states.value - p0
+    d_st_bytes = st_bytes.value - b0
+    d_fusions = fused_agg.stats["final_states"] - fs0
+    assert d_fbs == 0, \
+        f"q1 pushdown counted {d_fbs} columnar fallbacks"
+    assert d_states >= n_regions * runs, \
+        (f"only {d_states} partial-STATES payloads crossed the wire "
+         f"across {n_regions} regions x {runs} runs")
+    assert d_fusions >= runs, \
+        "the FINAL aggregate never fused the partial states"
+
+    # row-protocol regime (kill switch): the parity oracle AND the
+    # wire-bytes denominator (partial chunk rows per region)
+    client = store.get_client()
+    client.columnar_scan = False
+    try:
+        s.execute(Q1_PUSHDOWN_SQL)        # warm the row regime
+        rb0 = row_bytes.value
+        t0 = time.time()
+        for _ in range(runs):
+            row_results = s.execute(Q1_PUSHDOWN_SQL)[0].values()
+        t_row = (time.time() - t0) / runs
+        d_row_bytes = row_bytes.value - rb0
+    finally:
+        client.columnar_scan = True
+    assert len(col_results) == len(row_results)
+    for got, want in zip(col_results, row_results):
+        for a, b in zip(got, want):
+            ga = a.decode() if isinstance(a, bytes) else a
+            gb = b.decode() if isinstance(b, bytes) else b
+            # EXACT parity: Decimal sums compare at full precision and
+            # float SUM/AVG must be bit-identical (the states channel
+            # preserves the row path's sequential rounding)
+            assert ga == gb, f"q1 pushdown parity: {a} != {b}"
+    return {
+        "q1_pushdown_rows_per_sec": round(n_rows / t_col, 1),
+        "q1_pushdown_speedup_vs_rowpath": round(t_row / t_col, 2),
+        "q1_pushdown_regions": n_regions,
+        "q1_pushdown_fallbacks": d_fbs,
+        "q1_pushdown_states_partials": d_states,
+        "q1_pushdown_state_fusions": d_fusions,
+        "q1_states_bytes_vs_rows_bytes": round(
+            d_st_bytes / d_row_bytes, 3) if d_row_bytes else None,
+    }
+
+
 MESH_FANOUT_SQL = ("select f_g, count(*), sum(f_v), min(f_v), max(d_f) "
                    "from mfan join mdim on f_k = d_k "
                    "group by f_g order by f_g")
@@ -1393,6 +1502,20 @@ def main(smoke: bool = False):
           f"warm ({fan_figs['region_fanout_repeat_speedup_vs_cold']:.2f}x "
           f"the cold re-pack regime), {fan_figs['plane_cache_hits']} "
           f"plane-cache hits", file=sys.stderr)
+    # aggregate-pushdown regime: TPC-H-q1-shaped grouped aggregate over
+    # the 4-region cluster store, partial STATES (not group rows)
+    # crossing the wire and merging through the device combine chain
+    qr = 8_000 if smoke else 200_000
+    q1p_figs = measure_q1_pushdown(qr, n_regions=4, runs=runs)
+    print(f"# q1_pushdown ({qr / 1000:.0f}k rows x "
+          f"{q1p_figs['q1_pushdown_regions']} regions grouped agg): "
+          f"{q1p_figs['q1_pushdown_rows_per_sec']:,.0f} rows/s states "
+          f"channel ({q1p_figs['q1_pushdown_speedup_vs_rowpath']:.2f}x "
+          f"the row protocol), "
+          f"{q1p_figs['q1_pushdown_states_partials']} states partials, "
+          f"{q1p_figs['q1_pushdown_fallbacks']} fallbacks, states/rows "
+          f"wire bytes {q1p_figs['q1_states_bytes_vs_rows_bytes']}",
+          file=sys.stderr)
     # mesh fan-out regime: region partials land on their home shards and
     # the grouped partial-agg states combine over ICI (1-shard on a
     # single-device rig — same code path, no collectives)
@@ -1464,6 +1587,7 @@ def main(smoke: bool = False):
         **join_figs,
         **e2e_figs,
         **fan_figs,
+        **q1p_figs,
         "q1_mesh_rows_per_sec": q1_mesh_rps,
         "mesh_devices": len(jax.devices()),
         **mesh_figs,

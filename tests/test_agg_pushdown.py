@@ -1,0 +1,450 @@
+"""Differential parity for the columnar AGGREGATE-PUSHDOWN channel and
+the columnar INDEX channel: a pushed-down (partial-row) aggregate over
+the cluster store's fan-out must answer with grouped partial STATES
+(ColumnarAggStates — states, not rows, crossing the wire), merge through
+the device/mesh combine chain, and stay row-for-row identical to the row
+protocol AND a host oracle across 1/2/4/8 regions — including mid-scan
+split/merge, u64 edge values, NULL group keys, float-sum sequential
+rounding, and the tidb_tpu_columnar_scan kill switch. Index scans
+(single read and double-read) must answer columnar with zero fallbacks,
+survive a stale plane cache (version invalidation), and every new seam
+must degrade device→host→row under its failpoint with unchanged
+answers.
+"""
+
+from __future__ import annotations
+
+import itertools
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from tidb_tpu import failpoint, metrics, tablecodec as tc
+from tidb_tpu.copr import columnar_region
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 260
+
+Q1 = ("select l_flag, l_status, sum(l_qty), sum(l_price), avg(l_qty), "
+      "avg(l_price), avg(l_disc), count(*) from lineitem "
+      "where l_ship <= '1998-09-02' "
+      "group by l_flag, l_status order by l_flag, l_status")
+
+QUERIES = [
+    Q1,
+    # scalar aggregates (no group by): the PR 8 residual shape
+    "select count(*), sum(l_qty), min(l_price), max(l_price), "
+    "avg(l_disc), sum(l_disc) from lineitem",
+    # NULL group keys form one group; float sums keep sequential rounding
+    "select l_k, count(*), sum(l_disc), min(l_disc), max(l_qty) "
+    "from lineitem group by l_k order by l_k",
+    # string min/max + first_row-carried group columns
+    "select l_flag, min(l_status), max(l_status), count(l_k) "
+    "from lineitem group by l_flag order by l_flag",
+    # filtered grouped aggregate
+    "select l_status, count(*), sum(l_price) from lineitem "
+    "where l_qty > 10 group by l_status order by l_status",
+]
+
+
+def _row_spec(i: int):
+    flag = ("A", "N", "R")[i % 3]
+    status = ("F", "O")[i % 2]
+    qty = Decimal(i % 50) + Decimal(i % 4) / 4          # .00/.25/.50/.75
+    price = Decimal(900 + i * 7) + Decimal(i % 10) / 10
+    disc = (i % 11) * 0.01
+    k = None if i % 11 == 0 else i % 7
+    ship = f"1998-{(i % 12) + 1:02d}-{(i % 27) + 1:02d}"
+    return flag, status, qty, price, disc, k, ship
+
+
+def _build(n_regions: int) -> Session:
+    store = new_store(f"cluster://3/aggpush{next(_id)}")
+    s = Session(store)
+    s.execute("create database ap")
+    s.execute("use ap")
+    s.execute(
+        "create table lineitem (l_id bigint primary key, "
+        "l_flag varchar(4), l_status varchar(4), l_qty decimal(12,2), "
+        "l_price decimal(12,2), l_disc double, l_k bigint, l_ship date)")
+    vals = []
+    for i in range(1, N_ROWS + 1):
+        flag, status, qty, price, disc, k, ship = _row_spec(i)
+        vals.append(f"({i}, '{flag}', '{status}', {qty}, {price}, "
+                    f"{disc!r}, {'null' if k is None else k}, '{ship}')")
+    s.execute(f"insert into lineitem values {', '.join(vals)}")
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("ap", "lineitem").info.id
+        step = N_ROWS // n_regions
+        store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _counter(name: str) -> int:
+    return metrics.counter(f"distsql.columnar_{name}").value
+
+
+def _norm(rows):
+    out = []
+    for row in rows:
+        nr = []
+        for v in row:
+            if v is None:
+                nr.append(None)
+            else:
+                try:
+                    nr.append(round(float(v), 9))
+                except (TypeError, ValueError):
+                    nr.append(v.decode() if isinstance(v, bytes) else v)
+        out.append(nr)
+    return out
+
+
+def _row_protocol(s: Session, queries):
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        return [s.execute(q)[0].values() for q in queries]
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+
+
+def _q1_oracle():
+    """Host-computed TPC-H-q1-shaped expectation from the generator."""
+    groups: dict = {}
+    for i in range(1, N_ROWS + 1):
+        flag, status, qty, price, disc, _k, ship = _row_spec(i)
+        if ship > "1998-09-02":
+            continue
+        g = groups.setdefault((flag, status),
+                              [Decimal(0), Decimal(0), 0.0, 0])
+        g[0] += qty
+        g[1] += price
+        g[2] += disc
+        g[3] += 1
+    out = []
+    for (flag, status) in sorted(groups):
+        sq, sp, sd, n = groups[(flag, status)]
+        out.append([flag, status, float(sq), float(sp),
+                    float(sq) / n, float(sp) / n, sd / n, n])
+    return out
+
+
+@pytest.mark.parametrize("n_regions", [1, 2, 4, 8])
+def test_states_parity_vs_row_protocol_and_oracle(n_regions):
+    s = _build(n_regions)
+    f0 = _counter("fallbacks")
+    st0 = _counter("states")
+    sp0 = metrics.counter("copr.agg_states.partials").value
+    got = [s.execute(q)[0].values() for q in QUERIES]
+    assert _counter("fallbacks") == f0, \
+        "a hinted aggregate partial fell back to rows"
+    d_states = _counter("states") - st0
+    assert d_states >= n_regions * len(QUERIES), \
+        f"only {d_states} STATES partials crossed the wire"
+    assert metrics.counter("copr.agg_states.partials").value - sp0 \
+        >= n_regions * len(QUERIES)
+    want = _row_protocol(s, QUERIES)
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"states channel diverged from row protocol on {q!r}"
+    # the host oracle pins both engines to the generator's ground truth
+    q1 = got[0]
+    oracle = _q1_oracle()
+    assert len(q1) == len(oracle)
+    for g, w in zip(q1, oracle):
+        keys = [v.decode() if isinstance(v, bytes) else v for v in g[:2]]
+        assert keys == w[:2]
+        for a, b in zip(g[2:], w[2:]):
+            assert float(a) == pytest.approx(b, rel=1e-9), (g, w)
+
+
+def test_float_sum_keeps_sequential_rounding_exact():
+    """Float SUM/AVG parity must be EXACT (==), not approximate: the
+    per-region partials accumulate in row order and merge in task
+    order, reproducing the row protocol's rounding sequence bit for
+    bit."""
+    s = _build(4)
+    q = ("select l_k, sum(l_disc), avg(l_disc) from lineitem "
+         "group by l_k order by l_k")
+    got = s.execute(q)[0].values()
+    want = _row_protocol(s, [q])[0]
+    assert got == want     # bitwise-identical floats
+
+
+def test_kill_switch_pins_row_protocol():
+    s = _build(4)
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        st0 = _counter("states")
+        h0 = _counter("hits")
+        s.execute(Q1)
+        assert _counter("states") == st0
+        assert _counter("hits") == h0
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+
+
+def test_u64_edge_value_degrades_to_rows_exactly():
+    """An unsigned bigint above the int64 plane cannot pack: the region
+    degrades to the row protocol (counted per partial) and answers are
+    unchanged."""
+    store = new_store(f"cluster://3/aggpushu{next(_id)}")
+    s = Session(store)
+    s.execute("create database u")
+    s.execute("use u")
+    s.execute("create table t (id bigint primary key, "
+              "v bigint unsigned, k bigint)")
+    big = (1 << 63) + 5
+    vals = ", ".join(f"({i}, {big if i == 7 else i}, {i % 3})"
+                     for i in range(1, 41))
+    s.execute(f"insert into t values {vals}")
+    tid = s.info_schema().table_by_name("u", "t").info.id
+    store.cluster.split_keys([tc.encode_row_key(tid, 21)])
+    f0 = _counter("fallbacks")
+    q = "select k, count(*), max(v) from t group by k order by k"
+    got = s.execute(q)[0].values()
+    assert _counter("fallbacks") > f0, \
+        "u64-over-i64 region should have fallen back to rows"
+    want = _row_protocol(s, [q])[0]
+    assert got == want
+
+
+def test_mid_scan_split_and_merge_keep_parity():
+    s = _build(4)
+    store = s.store
+    want = [s.execute(q)[0].values() for q in QUERIES]
+    tid = s.info_schema().table_by_name("ap", "lineitem").info.id
+
+    def mutate_split(st):
+        st.cluster.split_keys([tc.encode_row_key(tid, 33),
+                               tc.encode_row_key(tid, 177)])
+
+    def mutate_merge(st):
+        regions = st.cluster.regions
+        for i in range(len(regions) - 1):
+            if regions[i].start:
+                st.cluster.merge(regions[i].region_id,
+                                 regions[i + 1].region_id)
+                return
+
+    for mutate in (mutate_split, mutate_merge):
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts, orig=orig, state=state,
+                 mutate=mutate):
+            state["n"] += 1
+            if state["n"] == 2 and not state["done"]:
+                state["done"] = True
+                mutate(store)
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        try:
+            got = [s.execute(q)[0].values() for q in QUERIES]
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"]
+        for q, g, w in zip(QUERIES, got, want):
+            assert _norm(g) == _norm(w), \
+                f"mid-scan topology change diverged on {q!r}"
+
+
+def test_device_states_failpoint_degrades_to_host(monkeypatch):
+    """device/agg_states inside the states kernel → the region computes
+    the SAME monoid states host-side (copr.degraded_states_to_host),
+    still shipping a STATES payload — answers unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _row_protocol(s, QUERIES)
+    deg = metrics.counter("copr.degraded_states_to_host")
+    st0 = _counter("states")
+    d0 = deg.value
+    failpoint.enable("device/agg_states")
+    try:
+        got = [s.execute(q)[0].values() for q in QUERIES]
+    finally:
+        failpoint.disable("device/agg_states")
+    assert deg.value > d0, "device states fault never degraded to host"
+    assert _counter("states") - st0 >= 4 * len(QUERIES), \
+        "host-degraded regions stopped shipping states payloads"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), f"host-path states diverged on {q!r}"
+    # and the DEVICE path itself (floor 0, no fault) matches too
+    got2 = [s.execute(q)[0].values() for q in QUERIES]
+    for q, g, w in zip(QUERIES, got2, want):
+        assert _norm(g) == _norm(w), f"device-path states diverged on {q!r}"
+
+
+def test_agg_states_failpoint_degrades_to_row_protocol():
+    """copr/agg_states → the region drops to partial ROWS (counted as a
+    per-partial fallback) — the bottom rung, answers unchanged."""
+    s = _build(4)
+    want = _row_protocol(s, QUERIES)
+    f0 = _counter("fallbacks")
+    failpoint.enable("copr/agg_states")
+    try:
+        got = [s.execute(q)[0].values() for q in QUERIES]
+    finally:
+        failpoint.disable("copr/agg_states")
+    assert _counter("fallbacks") > f0
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), f"row-degraded agg diverged on {q!r}"
+
+
+def test_combine_failpoint_degrades_to_host_combine():
+    """device/combine under a 4-region states merge → the host monoid
+    combine answers (copr.degraded_combine_to_host), same results."""
+    s = _build(4)
+    want = _row_protocol(s, QUERIES)
+    deg = metrics.counter("copr.degraded_combine_to_host")
+    d0 = deg.value
+    failpoint.enable("device/combine")
+    failpoint.enable("device/mesh_collective")
+    try:
+        got = [s.execute(q)[0].values() for q in QUERIES]
+    finally:
+        failpoint.disable("device/combine")
+        failpoint.disable("device/mesh_collective")
+    assert deg.value > d0, "combine fault never reached the host rung"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), f"host combine diverged on {q!r}"
+
+
+# ---------------------------------------------------------------------------
+# columnar index channel
+# ---------------------------------------------------------------------------
+
+IDX_QUERIES = [
+    # covering single-read (index columns only)
+    "select l_k from lineitem use index (ik) where l_k >= 3 order by l_k",
+    # double-read: handles resolve through the columnar table lookup
+    "select l_id, l_k, l_flag, l_price from lineitem use index (ik) "
+    "where l_k = 2 order by l_id",
+    "select l_id, l_disc from lineitem use index (ik) "
+    "where l_k between 1 and 4 order by l_id",
+]
+
+
+def _build_indexed(n_regions: int) -> Session:
+    s = _build(n_regions)
+    s.execute("create index ik on lineitem (l_k)")
+    return s
+
+
+@pytest.mark.parametrize("n_regions", [1, 4])
+def test_index_scans_answer_columnar_with_zero_fallbacks(n_regions):
+    s = _build_indexed(n_regions)
+    f0, h0 = _counter("fallbacks"), _counter("hits")
+    got = [s.execute(q)[0].values() for q in IDX_QUERIES]
+    assert _counter("fallbacks") == f0, \
+        "a hinted index partial fell back to rows"
+    assert _counter("hits") > h0
+    want = _row_protocol(s, IDX_QUERIES)
+    for q, g, w in zip(IDX_QUERIES, got, want):
+        assert g == w, f"columnar index scan diverged on {q!r}"
+
+
+def test_index_double_read_stale_cache_invalidation():
+    """A committed UPDATE bumps the data version: cached index AND base
+    planes must invalidate, so the re-run sees fresh values (parity with
+    the row protocol after the write)."""
+    s = _build_indexed(4)
+    q = IDX_QUERIES[1]
+    before = s.execute(q)[0].values()
+    assert before, "fixture query returned no rows"
+    s.execute("update lineitem set l_price = l_price + 1000 where l_k = 2")
+    f0 = _counter("fallbacks")
+    after = s.execute(q)[0].values()
+    assert _counter("fallbacks") == f0
+    assert after != before, "stale cached planes served after a commit"
+    want = _row_protocol(s, [q])[0]
+    assert after == want
+
+
+def test_index_pack_failpoint_degrades_to_rows():
+    s = _build_indexed(4)
+    want = _row_protocol(s, IDX_QUERIES)
+    f0 = _counter("fallbacks")
+    failpoint.enable("copr/pack")
+    try:
+        got = [s.execute(q)[0].values() for q in IDX_QUERIES]
+    finally:
+        failpoint.disable("copr/pack")
+    assert _counter("fallbacks") > f0
+    for q, g, w in zip(IDX_QUERIES, got, want):
+        assert g == w, f"row-degraded index scan diverged on {q!r}"
+
+
+# ---------------------------------------------------------------------------
+# micro-batch mask readback bit-packing (PR 9 residual satellite)
+# ---------------------------------------------------------------------------
+
+def test_bitpacked_mask_words_roundtrip():
+    """_unpack_mask_words inverts the kernel's 64-rows-per-int64 pack
+    for every bit position, including bit 63 (the int64 sign bit)."""
+    from tidb_tpu.ops.sched import _unpack_mask_words
+    rng = np.random.default_rng(7)
+    for kb, capacity in ((1, 1024), (8, 1024), (3, 2048)):
+        masks = rng.random((kb, capacity)) < 0.3
+        masks[:, 63] = True          # exercise the sign bit
+        masks[:, capacity - 1] = True
+        bits = masks.reshape(kb, -1, 64).astype(np.uint64)
+        weights = (np.uint64(1) << np.arange(64, dtype=np.uint64))
+        words = (bits * weights).sum(axis=-1, dtype=np.uint64) \
+            .astype(np.int64)        # two's complement reinterpretation
+        out = _unpack_mask_words(words.reshape(-1), kb, capacity)
+        assert np.array_equal(out, masks)
+
+
+def test_batched_mask_readback_parity_vs_solo():
+    """The bit-packed batched dispatch answers exactly what the solo
+    route answers — concurrent below-floor statements over a TpuClient
+    store, same shape, batched vs kill switch."""
+    import threading
+
+    from tidb_tpu.ops import TpuClient
+
+    store = new_store(f"memory://bitpack{next(_id)}")
+    s = Session(store)
+    s.execute("create database b")
+    s.execute("use b")
+    s.execute("create table t (id bigint primary key, v bigint)")
+    s.execute("insert into t values " +
+              ", ".join(f"({i}, {i % 97})" for i in range(1, 301)))
+    store.set_client(TpuClient(store, dispatch_floor_rows=10**9))
+    s.execute("set global tidb_tpu_batch_window_ms = 30")
+
+    def run_all(label):
+        out = {}
+
+        def worker(j):
+            sess = Session(store)
+            sess.execute("use b")
+            out[j] = sess.execute(
+                f"select id, v from t where v > {40 + j} order by id"
+            )[0].values()
+
+        threads = [threading.Thread(target=worker, args=(j,))
+                   for j in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    batched = metrics.counter("sched.batched_statements")
+    b0 = batched.value
+    got = run_all("batched")
+    assert batched.value > b0, "no statement rode the batched dispatch"
+    s.execute("set global tidb_tpu_micro_batch = 0")
+    try:
+        want = run_all("solo")
+    finally:
+        s.execute("set global tidb_tpu_micro_batch = 1")
+    assert got == want

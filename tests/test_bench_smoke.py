@@ -57,6 +57,18 @@ def test_bench_smoke_emits_valid_json():
     assert out["region_fanout_repeat_rows_per_sec"] > 0
     assert out["plane_cache_hits"] >= 4
     assert out["region_fanout_repeat_speedup_vs_cold"] > 0
+    # the aggregate-pushdown regime: TPC-H-q1-shaped grouped aggregate
+    # over the 4-region cluster store with partial STATES (not group
+    # rows) crossing the wire, zero fallbacks, and the FINAL aggregate
+    # fusing the states through the combine chain (parity vs the row
+    # protocol asserted inside the bench itself)
+    assert out["q1_pushdown_rows_per_sec"] > 0
+    assert out["q1_pushdown_regions"] == 4
+    assert out["q1_pushdown_fallbacks"] == 0
+    assert out["q1_pushdown_states_partials"] >= 4
+    assert out["q1_pushdown_state_fusions"] >= 1
+    assert out["q1_states_bytes_vs_rows_bytes"] is not None \
+        and out["q1_states_bytes_vs_rows_bytes"] > 0
     # the mesh execution regime: q1 over the mesh client, and the
     # 4-region fan-out whose partial-aggregate combine rides the mesh
     # (1-shard on this rig — same code path, no collectives) with zero

@@ -126,7 +126,7 @@ def _flush_window(n: int | None = None) -> None:
     """Push the inspection window past whatever the previous test (or
     burst) left in it: force `n` fresh samples (the recorder coalesces
     sub-ms forced samples, so space them)."""
-    n = (inspection.WINDOW_SAMPLES + 2) if n is None else n
+    n = (int(inspection.threshold("window_samples")) + 2) if n is None else n
     for _ in range(n):
         timeseries.recorder.sample()
         time.sleep(0.002)
@@ -484,7 +484,7 @@ class TestInspectionRules:
         assert not _fired(s, "degradation-burst")
         failpoint.enable("device/mesh_collective")
         try:
-            for _ in range(inspection.DEGRADED_BURST_N + 1):
+            for _ in range(int(inspection.threshold("degraded_burst")) + 1):
                 s.execute(JOIN_AGG_Q)         # each degrades mesh→single
         finally:
             failpoint.disable("device/mesh_collective")
@@ -583,7 +583,7 @@ class TestInspectionRules:
                 store, sqls,
                 setup=("set tidb_tpu_max_execution_time = 120",),
                 catch=(errors.DeadlineExceededError,))
-            assert len(caught) >= inspection.BATCH_EXPIRY_N, \
+            assert len(caught) >= int(inspection.threshold("batch_expiries")), \
                 f"only {len(caught)} deadlines expired in the window"
         finally:
             failpoint.disable("sched/batch_window")
@@ -612,7 +612,7 @@ class TestInspectionRules:
         _flush_window()
         failpoint.enable("device/mesh_collective")
         try:
-            for _ in range(inspection.DEGRADED_BURST_N + 1):
+            for _ in range(int(inspection.threshold("degraded_burst")) + 1):
                 s.execute(JOIN_AGG_Q)
         finally:
             failpoint.disable("device/mesh_collective")
@@ -625,8 +625,107 @@ class TestInspectionRules:
         assert burst
         _rule, _item, sev, val, ref, details, begin, end = burst[0]
         assert _sv(sev) in ("warning", "critical")
-        assert int(val) >= inspection.DEGRADED_BURST_N
+        assert int(val) >= int(inspection.threshold("degraded_burst"))
         assert "fallbacks/window" in _sv(ref)
         assert "copr.degraded_mesh" in _sv(details)
         assert 0 < begin <= end
         _flush_window()
+
+
+class TestDaemonTicker:
+    """Daemon-mode metrics ticker: a SERVING process accrues history
+    buckets while fully idle (the PR 10 lazy-sampling residual); library
+    embeds stay thread-free, and the sampler exits when the last server
+    detaches."""
+
+    def test_quiesced_server_accrues_history(self):
+        from tidb_tpu.metrics.timeseries import recorder
+        from tidb_tpu.server.server import Server
+        store = new_store(f"memory://tick{next(_id)}")
+        old_interval = recorder.interval_s
+        recorder.set_interval(0.02)
+        srv = Server(store, port=0)
+        srv.start()
+        try:
+            assert timeseries.ticker_active()
+            samples = recorder.samples()
+            t0 = samples[-1].mono if samples else 0.0
+            time.sleep(0.4)          # NO statements run anywhere
+            fresh = sum(1 for smp in recorder.samples() if smp.mono > t0)
+            assert fresh >= 3, \
+                f"idle server accrued only {fresh} history buckets"
+        finally:
+            srv.close()
+            recorder.set_interval(old_interval)
+        # the sampler thread exits once no server remains
+        deadline = time.monotonic() + 2.0
+        while timeseries.ticker_active() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not timeseries.ticker_active(), \
+            "ticker thread survived the last server close"
+
+    def test_library_process_stays_lazy(self):
+        """Without a wire server, no ticker is live — the zero-thread
+        library contract holds."""
+        assert not timeseries.ticker_active()
+
+
+class TestInspectionThresholds:
+    """tidb_tpu_inspection_* sysvars replace the static rule constants:
+    GLOBAL-only, applied live, persisted + hydrated on bootstrap."""
+
+    def test_set_global_applies_live_and_rule_uses_it(self):
+        s = _build()
+        s.execute(JOIN_AGG_Q)
+        _flush_window()
+        assert not _fired(s, "degradation-burst")
+        try:
+            # lower the burst threshold to 2: a 2-fault burst (below the
+            # default 5) must now fire the rule
+            s.execute("set global tidb_tpu_inspection_degraded_burst = 2")
+            assert inspection.threshold("degraded_burst") == 2.0
+            failpoint.enable("device/mesh_collective")
+            try:
+                for _ in range(2):
+                    s.execute(JOIN_AGG_Q)
+            finally:
+                failpoint.disable("device/mesh_collective")
+            assert _fired(s, "degradation-burst", "mesh"), \
+                "tuned-down threshold did not fire on a 2-fault burst"
+            _flush_window()
+            assert not _fired(s, "degradation-burst")
+        finally:
+            inspection.reset_thresholds()
+
+    def test_global_only_and_validation(self):
+        s = _build(1)
+        with pytest.raises(errors.TiDBError):
+            s.execute("set tidb_tpu_inspection_mesh_skew = 3")
+        with pytest.raises(errors.TiDBError):
+            s.execute("set global tidb_tpu_inspection_mesh_skew = 'x'")
+        with pytest.raises(errors.TiDBError):
+            s.execute("set global tidb_tpu_inspection_mesh_skew = -1")
+        assert inspection.threshold("mesh_skew") == \
+            inspection.DEFAULTS["mesh_skew"]
+
+    def test_persisted_and_hydrated_on_bootstrap(self):
+        """A persisted threshold survives the in-memory cache being
+        wiped: re-hydration (the restart path) reapplies it."""
+        import tidb_tpu.session as sess_mod
+        store = new_store(f"memory://insph{next(_id)}")
+        s = Session(store)
+        try:
+            s.execute(
+                "set global tidb_tpu_inspection_batch_expiries = 9")
+            assert inspection.threshold("batch_expiries") == 9.0
+            inspection.reset_thresholds()
+            assert inspection.threshold("batch_expiries") == \
+                inspection.DEFAULTS["batch_expiries"]
+            # simulate a process restart: forget the bootstrap mark and
+            # let a fresh session hydrate from mysql.global_variables
+            sess_mod._BOOTSTRAPPED_STORES.discard(store.uuid())
+            Session(store).execute("select 1")
+            assert inspection.threshold("batch_expiries") == 9.0, \
+                "persisted inspection threshold did not hydrate"
+        finally:
+            inspection.reset_thresholds()

@@ -23,34 +23,67 @@ decimals): per-region fallback, counted per PARTIAL by the client.
 from __future__ import annotations
 
 import threading
+from decimal import Decimal
 
 import numpy as np
 
-from tidb_tpu import errors, failpoint
-from tidb_tpu.copr.proto import ExprType, SelectRequest, SelectResponse
+from tidb_tpu import errors, failpoint, mysqldef as my
+from tidb_tpu.codec import codec
+from tidb_tpu.copr.proto import (
+    AGG_NAME, ExprType, SelectRequest, SelectResponse,
+)
 from tidb_tpu.kv.kv import KeyRange
 from tidb_tpu.ops import columnar as col
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL
+
+I64_MAX = (1 << 63) - 1
+I64_MIN = -(1 << 63)
+
+# rows below which the host numpy states beat a device dispatch for the
+# region-side grouped partial-aggregate pass (the same flat round-trip
+# economics as the client dispatch floor, applied inside one region).
+# Both paths compute the identical monoid states; tests monkeypatch this
+# to 0 to force the device kernel + its failpoint seams.
+STATES_DEVICE_FLOOR = 4096
 
 
 def handle_columnar_scan(snapshot, sel: SelectRequest,
                          ranges: list[KeyRange], region=None,
                          cache=None) -> SelectResponse | None:
-    """One region's share of a columnar_hint scan as a columnar partial,
-    or None → the caller runs the row handler for this region.
+    """One region's share of a columnar_hint request as a columnar
+    partial, or None → the caller runs the row handler for this region.
+
+    Three request shapes answer columnar here: plain/TopN TABLE scans
+    ship their packed planes + selection index (ColumnarScanResult),
+    INDEX scans ship the decoded index-key planes + handle plane the
+    same way (pack_index_ranges — index order IS key order, so the
+    keep-order contract survives), and pushed-down AGGREGATES ship
+    grouped partial STATES (ColumnarAggStates: per-group monoid states
+    computed by scatter-free segment reductions over the packed planes —
+    device kernel at/above STATES_DEVICE_FLOOR, host numpy below or on
+    device fault) instead of partial rows.
 
     With `region` ((region_id, epoch), as validated by the RPC epoch
     check) and a `cache` (copr.plane_cache.PlaneCache), the post-pack
     pre-filter planes for the clipped ranges are served from / admitted
     to the per-region plane cache keyed by (region_id, epoch,
-    data_version_at(start_ts), table_id, column set, range bounds) — a
-    repeat fan-out query skips the native repack (and, with pinned
-    planes, the host→device transfer). The filter/TopN selection still
-    evaluates per request; only the snapshot-determined pack is shared."""
-    if sel.table_info is None or sel.is_agg():
-        # index scans and pushed aggregates keep the row/partial-row
-        # protocol (columnar index results are a ROADMAP open item)
+    data_version_at(start_ts), table/index identity, column set, range
+    bounds) — a repeat fan-out query skips the native repack (and, with
+    pinned planes, the host→device transfer). The filter/TopN/aggregate
+    work still evaluates per request; only the snapshot-determined pack
+    is shared."""
+    is_index = sel.table_info is None
+    if is_index and sel.index_info is None:
         return None
-    if sel.order_by and (sel.desc or sel.limit is None):
+    agg_specs = None
+    if sel.is_agg():
+        if is_index:
+            return None   # pushed agg over an index scan: row protocol
+        agg_specs = _states_specs(sel)
+        if agg_specs is None:
+            return None
+    if sel.order_by and (is_index or sel.desc or sel.limit is None):
         return None
     from tidb_tpu import tracing
     if failpoint._active and \
@@ -62,9 +95,16 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         # client counts a fallback for exactly this partial
         tracing.record_degraded("region_to_rows", tally=False)
         return None
-    columns = sel.table_info.columns
-    defaults = {c.column_id: c.default_val for c in columns
-                if c.default_val is not None}
+    if is_index:
+        columns = sel.index_info.columns
+        defaults = {}
+        pack_key = ("idx", sel.index_info.table_id,
+                    sel.index_info.index_id)
+    else:
+        columns = sel.table_info.columns
+        defaults = {c.column_id: c.default_val for c in columns
+                    if c.default_val is not None}
+        pack_key = sel.table_info.table_id
     batch = None
     cache_info = None
     base_key = version = None
@@ -83,7 +123,7 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         # forces the pack path, whose scan raises KeyIsLockedError into
         # the client's resolver ladder exactly like the row handler.
         version = mvcc.data_version_at(snapshot.read_ts)
-        base_key = (region[0], sel.table_info.table_id,
+        base_key = (region[0], pack_key,
                     tuple(c.column_id for c in columns),
                     tuple((r.start, r.end) for r in ranges))
         batch, cache_info = cache.lookup(base_key, region[1], version)
@@ -100,8 +140,13 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
                     # overflow does — this region degrades to rows
                     failpoint.eval("copr/pack", lambda: errors.TypeError_(
                         "injected region pack fault"))
-                batch = col.pack_ranges(snapshot, sel.table_info.table_id,
-                                        columns, ranges, defaults)
+                if is_index:
+                    batch = col.pack_index_ranges(snapshot,
+                                                  sel.index_info, ranges)
+                else:
+                    batch = col.pack_ranges(snapshot,
+                                            sel.table_info.table_id,
+                                            columns, ranges, defaults)
                 psp.set("rows", batch.n_rows)
             if base_key is not None:
                 # sound only if the visible version held still across the
@@ -118,10 +163,21 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
             mask = _filter_mask(sel, batch)
             if mask is not None:
                 fsp.set("rows_out", int(np.count_nonzero(mask)))
+        if agg_specs is not None and mask is not None:
+            if failpoint._active:
+                failpoint.eval("copr/agg_states",
+                               lambda: errors.TypeError_(
+                                   "injected agg-states fault"))
+            resp = _agg_states_response(sel, batch, mask, agg_specs,
+                                        region, cache_info)
+            if resp is None:
+                tracing.record_degraded("region_to_rows", tally=False)
+            return resp
     except errors.TypeError_:
-        # no exact plane mapping (or an injected pack/filter fault): this
-        # region degrades to the row protocol — the bottom tier of the
-        # degradation chain, counted so every fallback is accounted
+        # no exact plane mapping (or an injected pack/filter/states
+        # fault): this region degrades to the row protocol — the bottom
+        # tier of the degradation chain, counted so every fallback is
+        # accounted
         tracing.record_degraded("region_to_rows", tally=False)
         return None
     except errors.RetryableError:
@@ -276,3 +332,290 @@ def _topn_select(sel: SelectRequest, batch: col.ColumnBatch,
     order = np.lexsort(sort_keys)
     n_live = int(np.count_nonzero(mask))
     return order[: min(sel.limit, n_live)]
+
+
+# ---------------------------------------------------------------------------
+# region-side grouped partial-aggregate STATES (the aggregate half of the
+# columnar channel): instead of running the per-row interpreter and
+# shipping partial chunk rows, the region computes every aggregate's
+# per-group monoid state vectorized over the packed planes — group codes
+# via the batch's pack/dictionary machinery (tuple_codes: NULL keys get
+# their reserved slot), counts/sums/mins/maxes as segment reductions
+# (device SegCtx kernel at/above STATES_DEVICE_FLOOR, host numpy below or
+# after a device fault) — and ships a ColumnarAggStates payload. Float
+# SUM/AVG always accumulate on the host in row order (np.add.at), so the
+# per-region partial carries the exact left-to-right rounding sequence
+# the row handler's accumulator produces.
+# ---------------------------------------------------------------------------
+
+_STATES_NAMES = ("count", "sum", "avg", "min", "max", "first_row")
+
+
+def _states_specs(sel: SelectRequest):
+    """Structural gate for the grouped-states channel, evaluated BEFORE
+    any pack: (agg specs, group column ids) when every aggregate and
+    group item is expressible as exact per-group monoid states, else
+    None → the row handler answers this region with partial rows."""
+    if sel.having is not None or sel.order_by or sel.limit is not None \
+            or sel.desc:
+        return None
+    specs = []
+    for e in sel.aggregates:
+        name = AGG_NAME.get(e.tp)
+        if name not in _STATES_NAMES or e.distinct or len(e.children) > 1:
+            return None
+        arg = e.children[0] if e.children else None
+        if arg is None or arg.tp == ExprType.VALUE:
+            if name != "count":
+                return None   # sum(const)/first_row(const): row handler
+        elif arg.tp != ExprType.COLUMN_REF:
+            return None       # expression args: row handler answers
+        specs.append((name, arg))
+    gcids = []
+    for item in sel.group_by:
+        if item.expr.tp != ExprType.COLUMN_REF:
+            return None
+        gcids.append(item.expr.val)
+    return specs, gcids
+
+
+def _int_plane(cd: col.ColumnData, c) -> bool:
+    """A plain-integer int64 plane (times/durations/bits excluded: their
+    flattened codec forms are not safely reconstructible from the plane
+    value alone, so those shapes stay on the row handler)."""
+    return cd.kind == col.K_I64 and c.tp in my.INTEGER_TYPES
+
+
+def _flat_datum(cd: col.ColumnData, c, i: int) -> Datum:
+    """Plane cell i → the FLATTENED storage datum the row handler's
+    decoded row carries (what group keys and partial rows are built
+    from). Delegates to col.plane_datum with two deliberate overrides:
+    unsigned integer columns keep their storage kind (UINT64 — the
+    codec key bytes differ from INT64's, and group keys must merge
+    byte-identically with row-protocol partials), and decimals keep the
+    column scale via scaleb (plane_datum's division canonicalizes
+    trailing zeros; partial-row value slots carry the scale the row
+    accumulator's Decimals carry). Callers gate kinds via
+    _int_plane/K_F64/K_STR/K_DEC first — times/durations never reach
+    this."""
+    if cd.valid[i]:
+        if cd.kind == col.K_I64 and my.has_unsigned_flag(c.flag):
+            return Datum.u64(int(cd.values[i]))
+        if cd.kind == col.K_DEC:
+            return Datum.dec(
+                Decimal(int(cd.values[i])).scaleb(-cd.dec_scale))
+    return col.plane_datum(cd, c, i)
+
+
+def _run_states(batch: col.ColumnBatch, gid: np.ndarray, reductions: list,
+                G: int) -> list:
+    """Run the device-safe segment reductions: ONE device dispatch
+    at/above the floor, host numpy below it — and the device→host rung
+    of the degradation chain on any device fault (counted on
+    copr.degraded_states_to_host; answers identical by the monoid
+    algebra)."""
+    if not reductions or G == 0:
+        return [np.zeros(G, np.int64) for _ in reductions]
+    use_device = batch.n_rows >= STATES_DEVICE_FLOOR
+    if use_device:
+        try:
+            import jax  # noqa: F401
+        except ImportError:
+            use_device = False
+    if use_device:
+        from tidb_tpu import tracing
+        from tidb_tpu.ops import kernels
+        try:
+            return kernels.region_agg_states(gid, reductions, G)
+        except errors.DeviceError:
+            tracing.record_degraded("states_to_host", tally=False)
+    outs = []
+    for op, vals, ok in reductions:
+        if vals is None:
+            vals = np.ones(len(gid), dtype=np.int64)
+        if op == "sum":
+            acc = np.zeros(G, vals.dtype)
+            np.add.at(acc, gid[ok], vals[ok])
+        elif op == "min":
+            init = np.inf if vals.dtype == np.float64 else I64_MAX
+            acc = np.full(G, init, vals.dtype)
+            np.minimum.at(acc, gid[ok], vals[ok])
+        else:
+            init = -np.inf if vals.dtype == np.float64 else I64_MIN
+            acc = np.full(G, init, vals.dtype)
+            np.maximum.at(acc, gid[ok], vals[ok])
+        outs.append(acc)
+    return outs
+
+
+def _agg_states_response(sel: SelectRequest, batch: col.ColumnBatch,
+                         mask: np.ndarray, agg_specs, region,
+                         cache_info) -> SelectResponse | None:
+    """One region's pushed aggregate as grouped partial states, or None
+    → the row handler answers (a column kind without an exact state
+    mapping, or an int-sum overflow guard)."""
+    from tidb_tpu import metrics, tracing
+    specs, gcids = agg_specs
+    colpb = {c.column_id: c for c in sel.table_info.columns}
+    live_idx = np.nonzero(mask)[0]
+    for cid in gcids:
+        cd = batch.columns.get(cid)
+        c = colpb.get(cid)
+        if cd is None or c is None:
+            return None
+        if not (cd.kind == col.K_STR or cd.kind == col.K_F64
+                or _int_plane(cd, c)):
+            # decimal/time group keys stay on the row handler: their
+            # codec key bytes are write-scale/representation sensitive,
+            # so a reconstructed key might not merge with a row-protocol
+            # partial of the same group
+            return None
+    if gcids:
+        codes, _percol = batch.tuple_codes(gcids)
+        lg = codes[mask]
+    else:
+        lg = np.zeros(len(live_idx), dtype=np.int64)
+    uniq, first_idx, inv = np.unique(lg, return_index=True,
+                                     return_inverse=True)
+    G = len(uniq)
+    # region-local groups in FIRST-APPEARANCE scan order — the partial
+    # emission order of the row handler, which the client's group
+    # unification preserves across regions
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(G, np.int64)
+    rank[order] = np.arange(G, dtype=np.int64)
+    rep_rows = live_idx[first_idx[order]]       # group's first live row
+    gid = np.full(batch.capacity, G, dtype=np.int64)   # dead-row sink
+    if G:
+        gid[mask] = rank[np.reshape(inv, -1)]
+    group_keys = []
+    for r in rep_rows.tolist():
+        gvals = [_flat_datum(batch.columns[cid], colpb[cid], int(r))
+                 for cid in gcids]
+        group_keys.append(codec.encode_value(gvals))
+
+    reductions: list = []       # (op, vals|None, contrib) — device-safe
+    builders: list = []         # idx layout → AggStateCol
+
+    def red(op, vals, ok) -> int:
+        reductions.append((op, vals, ok))
+        return len(reductions) - 1
+
+    for name, arg in specs:
+        if arg is None or arg.tp == ExprType.VALUE:
+            # count over a literal: count(*) lowers to count(1)
+            const = arg.val if arg is not None else Datum.i64(1)
+            contrib = np.zeros(batch.capacity, bool) if const.is_null() \
+                else mask
+            ci = red("sum", None, contrib)
+            builders.append(lambda outs, ci=ci: col.AggStateCol(
+                "count", outs[ci].astype(np.int64)))
+            continue
+        cd = batch.columns.get(arg.val)
+        c = colpb.get(arg.val)
+        if cd is None or c is None:
+            return None
+        contrib = mask & cd.valid
+        if name == "count":
+            ci = red("sum", None, contrib)
+            builders.append(lambda outs, ci=ci: col.AggStateCol(
+                "count", outs[ci].astype(np.int64)))
+            continue
+        if name == "first_row":
+            if not (cd.kind in (col.K_STR, col.K_F64, col.K_DEC)
+                    or _int_plane(cd, c)):
+                return None
+            datums = [_flat_datum(cd, c, int(r)) for r in rep_rows.tolist()]
+            ci = red("sum", None, mask)
+            builders.append(lambda outs, ci=ci, datums=datums, name=name:
+                            col.AggStateCol(name,
+                                            outs[ci].astype(np.int64),
+                                            datums=datums))
+            continue
+        if cd.kind == col.K_F64:
+            vals = cd.values
+            if name in ("sum", "avg"):
+                # float partial sums accumulate HOST-side in row order:
+                # np.add.at is unbuffered, so the state carries the same
+                # left-to-right rounding sequence the row accumulator
+                # produces (a device segment sum could re-associate)
+                sums = np.zeros(G, np.float64)
+                np.add.at(sums, gid[contrib], vals[contrib])
+                ci = red("sum", None, contrib)
+                builders.append(
+                    lambda outs, ci=ci, sums=sums, name=name:
+                    col.AggStateCol(name, outs[ci].astype(np.int64),
+                                    values=sums, op="sum", kind="f64"))
+                continue
+            # min/max: -0.0 keeps first-seen-tie semantics on the row
+            # path that a numeric combine cannot reproduce
+            if bool(np.any((vals == 0.0) & np.signbit(vals) & contrib)):
+                return None
+            ci = red("sum", None, contrib)
+            vi = red("min" if name == "min" else "max", vals, contrib)
+            builders.append(
+                lambda outs, ci=ci, vi=vi, name=name:
+                col.AggStateCol(name, outs[ci].astype(np.int64),
+                                values=outs[vi], op=name, kind="f64"))
+            continue
+        if cd.kind == col.K_STR:
+            if name not in ("min", "max"):
+                return None   # sum over strings: row handler casts
+            # dictionary codes are sorted by bytes, so the code extremum
+            # IS the bytes extremum; decode per group afterwards
+            ci = red("sum", None, contrib)
+            vi = red("min" if name == "min" else "max",
+                     cd.values.astype(np.int64), contrib)
+            dic = cd.dictionary
+            builders.append(
+                lambda outs, ci=ci, vi=vi, name=name, dic=dic:
+                col.AggStateCol(
+                    name, outs[ci].astype(np.int64),
+                    datums=[NULL if int(n) == 0
+                            else Datum.bytes_(dic[int(v)])
+                            for n, v in zip(outs[ci], outs[vi])]))
+            continue
+        if not (cd.kind == col.K_DEC or _int_plane(cd, c)):
+            return None       # time/duration/bit aggregates: row handler
+        kind = "dec" if cd.kind == col.K_DEC else "i64"
+        scale = cd.dec_scale
+        vals = cd.values
+        if name in ("sum", "avg"):
+            n_contrib = int(np.count_nonzero(contrib))
+            mx = cd.max_abs
+            if mx and n_contrib and mx * n_contrib >= (1 << 63):
+                return None   # could wrap: the Decimal row path answers
+            ci = red("sum", None, contrib)
+            vi = red("sum", vals, contrib)
+        else:
+            ci = red("sum", None, contrib)
+            vi = red("min" if name == "min" else "max", vals, contrib)
+        op = "sum" if name in ("sum", "avg") else name
+        builders.append(
+            lambda outs, ci=ci, vi=vi, name=name, op=op, kind=kind,
+            scale=scale, c=c:
+            col.AggStateCol(name, outs[ci].astype(np.int64),
+                            values=outs[vi], op=op, kind=kind,
+                            dec_scale=scale, pb_col=c))
+
+    with tracing.trace("agg_states_pass") as ssp:
+        outs = _run_states(batch, gid, reductions, G)
+        ssp.set("groups", G).set("rows", len(live_idx))
+    aggs = [build(outs) for build in builders]
+    payload = col.ColumnarAggStates(group_keys, aggs,
+                                    list(sel.aggregates), colpb)
+    payload.cache_info = cache_info
+    if region is not None:
+        payload.region_id = region[0]
+        payload.region_epoch = region[1]
+    wire = sum(len(k) for k in group_keys)
+    for st in aggs:
+        wire += int(st.counts.nbytes)
+        if st.values is not None:
+            wire += int(st.values.nbytes)
+        if st.datums is not None:
+            wire += 16 * len(st.datums)   # flattened datum estimate
+    metrics.counter("copr.agg_states.partials").inc()
+    metrics.counter("copr.agg_states.rows").inc(len(live_idx))
+    metrics.counter("copr.agg_states.wire_bytes").inc(wire)
+    return SelectResponse(columnar=payload)

@@ -242,13 +242,18 @@ class PlaneCache:
             old = self._entries.pop(full_key, None)
             if old is not None:
                 self._account_remove(old)
+            # index entries key on ("idx", table_id, index_id): their
+            # pinned bytes attribute to the BASE table's id, so the
+            # profiler's top-pinned-table view stays an int table id
+            tid = base_key[1][1] if isinstance(base_key[1], tuple) \
+                else base_key[1]
             self._entries[full_key] = _Entry(batch, nbytes, epoch, version,
-                                             pinned, base_key[1])
+                                             pinned, tid)
             self._by_region.setdefault(base_key[0], set()).add(full_key)
             self._bytes += nbytes
             if pinned:
                 self._bytes_pinned += nbytes
-                self._account_pin_locked(base_key[1], nbytes)
+                self._account_pin_locked(tid, nbytes)
             while self._bytes > self.budget_bytes and self._entries:
                 fk, ent = self._entries.popitem(last=False)
                 self._unindex(fk)

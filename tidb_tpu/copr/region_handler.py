@@ -227,16 +227,23 @@ class _SelectContext:
                 for agg, ctx in zip(self.aggs, ctxs):
                     out.extend(agg.get_partial_result(ctx))
                 self.writer.append_row(0, out)
-        elif self.topn:
+            chunks = self.writer.finish()
+            # partial-row wire footprint of this aggregate response —
+            # the denominator of the states-vs-rows bytes figure the
+            # columnar STATES channel (copr.columnar_region) is
+            # measured against (bench measure_q1_pushdown)
+            from tidb_tpu import metrics
+            metrics.counter("copr.agg_rows.wire_bytes").inc(
+                sum(len(c.rows_data) for c in chunks))
+            return SelectResponse(chunks=chunks)
+        if self.topn:
             # ties break by scan order (seq) so output is deterministic and
             # engine-independent (TPU top_k is stable by row index)
             items = sorted((inv.item for inv in self._heap),
                            key=lambda it: (it[0], it[1]))
             for entry, _, handle, out in items:
                 self.raw_rows.append((handle, out))
-        if not self.req.is_agg():
-            return SelectResponse(raw=self.raw_rows)
-        return SelectResponse(chunks=self.writer.finish())
+        return SelectResponse(raw=self.raw_rows)
 
 
 class _TopNEntry:

@@ -151,10 +151,29 @@ class SelectResult:
                 _count_plane_cache(p, self.span)
         n_col = sum(1 for p in payloads if p is not None)
         _count("hits", n_col, self.span)
+        n_states = sum(1 for p in payloads
+                       if getattr(p, "is_agg_states", False))
+        if n_states:
+            # pushed-down aggregates answered as grouped partial STATES
+            # (ColumnarAggStates) instead of partial rows — counted so
+            # the bench/tests can assert states, not rows, crossed the
+            # wire
+            _count("states", n_states, self.span)
         if n_col == len(parts):
             _count("partials", n_col, self.span)
             if n_col == 1:
                 return payloads[0]
+            if n_states == n_col:
+                from tidb_tpu.ops.columnar import ColumnarStatesSet
+                return ColumnarStatesSet(payloads)
+            if n_states:
+                # states and scan planes in one response cannot stack —
+                # the row iterator serves everything (states materialize
+                # their exact partial rows)
+                import itertools
+                self._rows = itertools.chain.from_iterable(
+                    iter_response_rows(p) for p in parts)
+                return None
             from tidb_tpu.ops.columnar import ColumnarPartialSet
             return ColumnarPartialSet(payloads)
         # MIXED response (some regions columnar, some row-fallback): the

@@ -170,16 +170,18 @@ class XSelectTableExec(Executor):
         self._result = iter(self._sel_result)
 
     def columnar_result(self):
-        """The scan's columnar payload (ops.columnar.ColumnarScanResult),
-        or None when the responder sent rows (CPU engine, below-floor
-        route, kill switch) — the caller then drains rows as usual."""
+        """The scan's columnar payload — ops.columnar.ColumnarScanResult
+        for plain scans, or the grouped partial-STATES payload
+        (ColumnarAggStates / ColumnarStatesSet) for a pushed-down
+        aggregate — or None when the responder sent rows (CPU engine,
+        below-floor route, kill switch): the caller then drains rows as
+        usual (for states payloads, next() materializes the exact
+        partial rows the row protocol would have carried)."""
         if self._columnar_tried:
             return self._columnar
         self._columnar_tried = True
         if self._result is not None:
             return None     # rows already flowing through next()
-        if self.scan_plan.aggregated_push_down:
-            return None     # partial-row protocol carries no planes
         self._columnar_hint = True
         import time as _time
         st = getattr(self, "exec_stats", None)
@@ -256,11 +258,19 @@ class XSelectIndexExec(Executor):
         return PBIndexInfo(table_id=info.id, index_id=scan.index.id,
                            columns=pb_cols, unique=scan.index.unique), pb_cols
 
+    def _columnar_capable(self) -> bool:
+        """Advertise columnar_hint only to clients that carry the
+        columnar channel (TpuClient / the cluster fan-out client): a
+        bare row engine would just accrue fallback counts for a payload
+        it can never produce."""
+        return bool(getattr(self.ctx.client, "columnar_scan", False))
+
     def _index_request(self):
         scan = self.scan_plan
         pb_index, pb_cols = self._index_pb()
         req = SelectRequest(start_ts=self.ctx.start_ts(), index_info=pb_index,
-                            desc=scan.desc, est_rows=scan.est_rows)
+                            desc=scan.desc, est_rows=scan.est_rows,
+                            columnar_hint=self._columnar_capable())
         from tidb_tpu.copr.proto import field_type_from_pb_column
         field_types = [field_type_from_pb_column(c) for c in pb_cols]
         ranges = index_ranges_to_kv_ranges(scan.table_info.id, scan.index.id,
@@ -274,19 +284,30 @@ class XSelectIndexExec(Executor):
         result, pb_cols = self._index_request()
         self.copr_spans.append(result.span)
         self._open_result = result
+        # columnar index channel: the regions answered with packed
+        # key/handle planes (index order) instead of row chunks — rows
+        # materialize from the planes, handles read off the handle plane
+        payload = result.columnar() if self._columnar_capable() else None
         if not scan.double_read:
             # single read: remap pb column order → schema order
             col_pos = {c.column_id: i for i, c in enumerate(pb_cols)}
+            picks = [col_pos[c.col_id] for c in scan.schema]
             rows = []
-            for handle, vals in result:
-                row = [vals[col_pos[c.col_id]] for c in scan.schema]
-                rows.append((handle, row))
+            if payload is not None:
+                for handle, vals in payload.iter_rows_with_handles():
+                    rows.append((handle, [vals[i] for i in picks]))
+            else:
+                for handle, vals in result:
+                    rows.append((handle, [vals[i] for i in picks]))
             self._rows = rows
             result.close()
             self._open_result = None
             return
         # double read: collect handles in index order, then batched lookups
-        handles = [handle for handle, _ in result]
+        if payload is not None:
+            handles = [int(h) for h in payload.handles().tolist()]
+        else:
+            handles = [handle for handle, _ in result]
         rows_by_handle: dict[int, list] = {}
         batch = BASE_LOOKUP_TASK_SIZE
         i = 0
@@ -303,18 +324,29 @@ class XSelectIndexExec(Executor):
 
     def _lookup_rows(self, handles: list[int]):
         """Second request: fetch full rows by handle ranges
-        (doTableRequest, executor_distsql.go:701)."""
+        (doTableRequest, executor_distsql.go:701). With a columnar-
+        capable client the lookup rides the columnar channel too: the
+        regions answer base-table planes (served from the plane cache on
+        repeats) and the handle→row resolution is one vectorized gather
+        over the handle plane instead of a per-row decode loop."""
         scan = self.scan_plan
         req = SelectRequest(
             start_ts=self.ctx.start_ts(),
             table_info=PBTableInfo(scan.table_info.id, _scan_pb_columns(scan)),
-            est_rows=float(len(handles)))  # exact: one row per handle
+            est_rows=float(len(handles)),  # exact: one row per handle
+            columnar_hint=self._columnar_capable())
         ranges = handles_to_kv_ranges(scan.table_info.id, sorted(handles))
         types = [c.ret_type for c in scan.schema]
         result = select(self.ctx.client, req, ranges, types,
                         concurrency=self.ctx.distsql_concurrency())
         self.copr_spans.append(result.span)
-        return result
+        payload = result.columnar() if self._columnar_capable() else None
+        if payload is None:
+            return result
+        out = list(zip((int(h) for h in payload.handles().tolist()),
+                       payload.rows()))
+        result.close()
+        return out
 
     def next(self):
         if self._rows is None:

@@ -273,6 +273,19 @@ class HashAggExec(Executor):
 
     def _materialize(self):
         child = self.children[0]
+        if self.pushed_child and hasattr(child, "columnar_result"):
+            # states channel: the regions answered the pushed aggregate
+            # with grouped partial STATES — merge them through the
+            # device/mesh combine chain instead of row-looping partial
+            # rows (executor.fused_agg.try_fused_final); a None falls
+            # through to the row loop, which consumes the exact partial
+            # rows the payload (or the row protocol) materializes
+            from tidb_tpu.executor.fused_agg import try_fused_final
+            fused = try_fused_final(self)
+            if fused is not None:
+                self._fused = fused
+                self._groups, self._order = {}, []
+                return
         if not self.pushed_child and \
                 (hasattr(child, "device_join_result")
                  or hasattr(child, "columnar_result")):

@@ -49,7 +49,7 @@ _FUSABLE = ("count", "sum", "avg", "min", "max", "first_row")
 # partial agg + psum/pmin/pmax over ICI)
 stats = {"fused": 0, "fallback": 0, "partial_combines": 0,
          "last_combine_regions": 0, "mesh_combines": 0,
-         "last_mesh_shards": 0}
+         "last_mesh_shards": 0, "final_states": 0}
 
 I64_SENTINEL_MIN = I64_MAX        # "min" monoid identity (int planes)
 I64_SENTINEL_MAX = I64_MIN        # "max" monoid identity — EXACT min,
@@ -471,6 +471,338 @@ def _fused_func(res, f, gid, G: int, first_idx, n: int,
         return _minmax_datums(kind, cnt, red, G)
 
     return None
+
+
+# ---------------------------------------------------------------------------
+# FINAL-mode fusion over grouped partial STATES (the aggregate-pushdown
+# columnar channel): when the regions answered a pushed-down aggregate
+# with ColumnarAggStates payloads, the per-region [G_r] monoid states
+# scatter into [R, G] stacks over the client-unified group space and
+# merge through the SAME combine chain the COMPLETE fusion rides —
+# mesh psum/pmin/pmax over ICI, single-device combine_region_partials,
+# host monoid — instead of row-looping partial rows. Float SUM/AVG merge
+# host-side in task order (the row protocol's partial arrival order), so
+# the sequential rounding sequence is preserved end to end.
+# ---------------------------------------------------------------------------
+
+
+class _StatesCombine:
+    """Pre-built [R, G] state stacks merged in ONE device dispatch
+    through the _RegionCombine chain: mesh combine_states_sharded (the
+    per-region placement keys ride along) → combine_region_partials →
+    host monoid. R == 1 short-circuits to the host (there is nothing to
+    combine)."""
+
+    def __init__(self, R: int, G: int, region_ids=None, epochs=None):
+        self.R, self.G = R, G
+        self.region_ids, self.epochs = region_ids, epochs
+        self._states: list = []
+        self._ops: list = []
+        self._results: list | None = None
+        self.rode_mesh = False
+        self.mesh = None
+
+    def add(self, op: str, state: np.ndarray) -> int:
+        self._states.append(state)
+        self._ops.append(op)
+        return len(self._states) - 1
+
+    def _host(self) -> list:
+        reduce_ = {"sum": np.sum, "min": np.min, "max": np.max}
+        return [np.atleast_1d(reduce_[op](s, axis=0))
+                for s, op in zip(self._states, self._ops)]
+
+    def run(self) -> None:
+        if not self._states:
+            return
+        if self.R <= 1:
+            self._results = self._host()
+            return
+        from tidb_tpu import errors, tracing
+        device = True
+        try:
+            import jax  # noqa: F401
+        except ImportError:
+            device = False
+        mesh = None
+        if device:
+            try:
+                from tidb_tpu.ops import mesh as mesh_mod
+                mesh = mesh_mod.get_mesh()
+            except ImportError:
+                mesh = None
+        if mesh is not None:
+            try:
+                shard_of = None
+                if self.region_ids is not None \
+                        and len(self.region_ids) == self.R:
+                    rids = [rid if rid is not None else -(i + 1)
+                            for i, rid in enumerate(self.region_ids)]
+                    shard_of = mesh_mod.placement_for(mesh).shard_of(
+                        rids, self.epochs)
+                self._results = mesh_mod.combine_states_sharded(
+                    self._states, self._ops, mesh, shard_of=shard_of)
+                self.rode_mesh = True
+                self.mesh = mesh
+                stats["mesh_combines"] += 1
+                stats["last_mesh_shards"] = mesh.n
+                stats["partial_combines"] += 1
+                stats["last_combine_regions"] = self.R
+                return
+            except errors.DeviceError:
+                # mesh rung of the degradation chain: the single-device
+                # combine answers with the same monoid algebra
+                tracing.record_degraded("mesh")
+        if device:
+            from tidb_tpu.ops import kernels
+            try:
+                self._results = kernels.combine_region_partials(
+                    self._states, self._ops)
+            except errors.DeviceError:
+                tracing.record_degraded("combine_to_host")
+                self._results = self._host()
+        else:
+            self._results = self._host()
+        stats["partial_combines"] += 1
+        stats["last_combine_regions"] = self.R
+
+    def get(self, idx: int):
+        return self._results[idx]
+
+
+def try_fused_final(agg):
+    """FINAL-mode hash aggregation straight off grouped partial STATES
+    (ColumnarAggStates / ColumnarStatesSet), or None when the payload is
+    rows-shaped or any state falls outside the exact subset — the row
+    loop then consumes the same payload as materialized partial rows, so
+    a None never changes answers."""
+    child = agg.children[0]
+    get = getattr(child, "columnar_result", None)
+    if get is None:
+        return None
+    res = get()
+    if res is None:
+        return None
+    from tidb_tpu.ops import columnar as colmod
+    if isinstance(res, colmod.ColumnarStatesSet):
+        parts = res.parts
+        region_ids, epochs = res.region_ids(), res.region_epochs()
+    elif isinstance(res, colmod.ColumnarAggStates):
+        parts = [res]
+        region_ids, epochs = [res.region_id], [res.region_epoch]
+    else:
+        return None   # engine-local partial rows / scan payload: row loop
+    if not all(isinstance(p, colmod.ColumnarAggStates) for p in parts):
+        return None
+    out = _try_final_states(agg, child, parts, region_ids, epochs)
+    if out is not None:
+        stats["fused"] += 1
+        stats["final_states"] += 1
+    else:
+        stats["fallback"] += 1
+    return out
+
+
+def _try_final_states(agg, child, parts, region_ids, epochs):
+    from tidb_tpu.types.convert import (
+        unflatten_datum, unflatten_identity_kinds,
+    )
+    from tidb_tpu.types.datum import compare_datum
+
+    n_aggs = len(agg.agg_funcs)
+    for p in parts:
+        if len(p.aggs) != n_aggs:
+            return None
+        for st, f in zip(p.aggs, agg.agg_funcs):
+            if st.name != f.name:
+                return None
+    # unify the group space across regions in TASK order — the row
+    # protocol's partial arrival order, so global first-appearance ids
+    # reproduce the row loop's emission order exactly
+    key_order: list[bytes] = []
+    key_idx: dict = {}
+    maps: list[np.ndarray] = []
+    for p in parts:
+        m = []
+        for gk in p.group_keys:
+            gi = key_idx.get(gk)
+            if gi is None:
+                gi = key_idx[gk] = len(key_order)
+                key_order.append(gk)
+            m.append(gi)
+        maps.append(np.asarray(m, dtype=np.int64))
+    G = len(key_order)
+    R = len(parts)
+    scan = getattr(child, "scan_plan", None)
+    pushed_groups = bool(scan is not None and scan.group_by_pb)
+    if G == 0:
+        if pushed_groups:
+            return []   # GROUP BY over empty input emits no rows
+        return [[f.get_result(f.create_context()) for f in agg.agg_funcs]]
+
+    combine = _StatesCombine(R, G, region_ids=region_ids, epochs=epochs)
+    col_specs: list[dict] = []
+    for i, f in enumerate(agg.agg_funcs):
+        sts = [p.aggs[i] for p in parts]
+        name = sts[0].name
+        cnt_state = np.zeros((R, G), np.int64)
+        for r, m in enumerate(maps):
+            cnt_state[r, m] = sts[r].counts
+        entry: dict = {"name": name, "sts": sts,
+                       "ci": combine.add("sum", cnt_state),
+                       "ft": parts[0].value_ft(i)}
+        if name == "count":
+            col_specs.append(entry)
+            continue
+        if any(st.datums is not None for st in sts):
+            if not all(st.datums is not None for st in sts):
+                return None
+            entry["mode"] = "datum"
+            col_specs.append(entry)
+            continue
+        kinds = {st.kind for st in sts}
+        scales = {st.dec_scale for st in sts}
+        if len(kinds) != 1 or len(scales) != 1 or None in kinds:
+            return None
+        kind = kinds.pop()
+        entry["kind"], entry["scale"] = kind, scales.pop()
+        if kind == "f64" and name in ("sum", "avg"):
+            entry["mode"] = "fsum"   # ordered host float accumulation
+            col_specs.append(entry)
+            continue
+        if kind != "f64" and name in ("sum", "avg"):
+            # combined int sum could wrap where per-region sums did not:
+            # conservative bound, else the Decimal row loop answers
+            mx = 0
+            for st in sts:
+                if len(st.values):
+                    mx = max(mx, abs(int(st.values.min())),
+                             abs(int(st.values.max())))
+            if mx and mx * R >= (1 << 63):
+                return None
+        if name in ("sum", "avg"):
+            op: str = "sum"
+            init: object = 0
+        elif name == "min":
+            op = "min"
+            init = np.inf if kind == "f64" else I64_SENTINEL_MIN
+        else:
+            op = "max"
+            init = -np.inf if kind == "f64" else I64_SENTINEL_MAX
+        dtype = np.float64 if kind == "f64" else np.int64
+        vstate = np.full((R, G), init, dtype)
+        for r, m in enumerate(maps):
+            vstate[r, m] = sts[r].values
+        entry["mode"] = "num"
+        entry["vi"] = combine.add(op, vstate)
+        col_specs.append(entry)
+
+    from tidb_tpu import tracing
+    with tracing.trace("fused_agg") as sp:
+        total_rows = sum(len(p) for p in parts)
+        sp.set("rows", total_rows).set("groups", G)
+        sp.set("combine_regions", R).set("final_states", True)
+        combine.run()   # ONE dispatch + readback merges every state
+        if combine.rode_mesh:
+            sp.set("mesh_shards", combine.mesh.n)
+
+    def unflat(d, ft):
+        return d if d.kind in unflatten_identity_kinds(ft) \
+            else unflatten_datum(d, ft)
+
+    out_cols: list[list] = []
+    for entry, f in zip(col_specs, agg.agg_funcs):
+        name = entry["name"]
+        cnts = combine.get(entry["ci"])
+        ft = entry["ft"]
+        if name == "count":
+            out_cols.append([Datum.i64(int(c)) for c in cnts])
+            continue
+        if entry.get("mode") == "datum":
+            vals = _merge_datum_states(name, entry["sts"], maps, G,
+                                       compare_datum)
+            out_cols.append([unflat(v, ft) for v in vals])
+            continue
+        kind, scale = entry["kind"], entry["scale"]
+        if entry.get("mode") == "fsum":
+            # float partial sums merge HOST-side in task order — the
+            # exact _sum_exact float sequence the row loop runs
+            acc: list = [None] * G
+            for st, m in zip(entry["sts"], maps):
+                for j, g in enumerate(m.tolist()):
+                    if int(st.counts[j]) == 0:
+                        continue
+                    x = float(st.values[j])
+                    acc[g] = x if acc[g] is None else acc[g] + x
+            col_out = []
+            for g in range(G):
+                c = int(cnts[g])
+                if c == 0 or acc[g] is None:
+                    col_out.append(NULL)
+                elif name == "sum":
+                    col_out.append(Datum.f64(acc[g]))
+                else:
+                    col_out.append(Datum.f64(acc[g] / c))
+            out_cols.append(col_out)
+            continue
+        vs = combine.get(entry["vi"])
+        col_out = []
+        for g in range(G):
+            c = int(cnts[g])
+            if c == 0:
+                col_out.append(NULL)
+                continue
+            if name in ("sum", "avg"):
+                s = Decimal(int(vs[g])).scaleb(-scale) if kind == "dec" \
+                    else Decimal(int(vs[g]))
+                col_out.append(Datum.dec(s) if name == "sum"
+                               else Datum.dec(s / Decimal(c)))
+                continue
+            # min/max over a numeric plane → flattened datum → typed
+            if kind == "f64":
+                d = Datum.f64(float(vs[g]))
+            elif kind == "dec":
+                d = Datum.dec(Decimal(int(vs[g])).scaleb(-scale))
+            else:
+                pb = entry["sts"][0].pb_col
+                from tidb_tpu import mysqldef as my
+                d = Datum.u64(int(vs[g])) if pb is not None and \
+                    my.has_unsigned_flag(pb.flag) else Datum.i64(int(vs[g]))
+            col_out.append(unflat(d, ft))
+        out_cols.append(col_out)
+
+    child._columnar_rows = total_rows
+    agg._fused_info = {"fused": True, "rows": total_rows, "groups": G,
+                       "combine_regions": R, "final_states": True}
+    if combine.rode_mesh:
+        agg._fused_info["mesh_shards"] = combine.mesh.n
+    return [[c[g] for c in out_cols] for g in range(G)]
+
+
+def _merge_datum_states(name: str, sts, maps, G: int,
+                        compare_datum) -> list:
+    """Host FINAL merge of datum-mode states (string min/max, first_row)
+    in task order — exactly AggregationFunction._update_final's
+    semantics: first_row keeps the FIRST partial seen (even NULL),
+    min/max skip NULLs and keep the first-seen value on ties."""
+    vals: list = [None] * G
+    for st, m in zip(sts, maps):
+        for j, g in enumerate(m.tolist()):
+            d = st.datums[j]
+            if name == "first_row":
+                if vals[g] is None:
+                    vals[g] = d
+                continue
+            if d.is_null():
+                continue
+            cur = vals[g]
+            if cur is None or cur.is_null():
+                vals[g] = d
+                continue
+            c = compare_datum(d, cur)
+            if (c > 0) == (name == "max") and c != 0:
+                vals[g] = d
+    return [NULL if v is None else v for v in vals]
 
 
 def _sum_avg_datums(name: str, kind: str, cnt, sums, G: int) -> list:

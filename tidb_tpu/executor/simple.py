@@ -172,16 +172,23 @@ def _set(session, stmt: ast.SetStmt):
             names = ["tx_isolation", "transaction_isolation"]
         if va.name.lower() == "tidb_copr_backend":
             session.apply_copr_backend(sval)  # validates before storing
-        apply_global = _GLOBAL_ONLY_TPU_VARS.get(va.name.lower())
-        if apply_global is not None:
+        name_l = va.name.lower()
+        apply_global = _GLOBAL_ONLY_TPU_VARS.get(name_l)
+        is_inspection = name_l.startswith("tidb_tpu_inspection_")
+        if apply_global is not None or is_inspection:
             if not va.is_global:
                 # store-level client/cache state, same GLOBAL-only
                 # contract as the dispatch floor
                 raise errors.ExecError(
-                    f"Variable '{va.name.lower()}' is a GLOBAL "
+                    f"Variable '{name_l}' is a GLOBAL "
                     "variable and should be set with SET GLOBAL",
                     code=1229)
-            getattr(session, apply_global)(sval)
+            if is_inspection:
+                # the whole tidb_tpu_inspection_* threshold family
+                # shares one applier (the name selects the rule key)
+                session.apply_inspection_threshold(name_l, sval)
+            else:
+                getattr(session, apply_global)(sval)
         for name in names:
             if va.is_global:
                 session.global_vars.set(name, sval)

@@ -19,20 +19,62 @@ the real saturation mechanism) that produces its pathology.
 
 from __future__ import annotations
 
-# evaluation window: trailing samples of the recorder ring (at the
-# default 1 s interval ≈ the last half minute). Small enough that a
-# recovered incident ages out quickly; rules re-fire if it returns.
-WINDOW_SAMPLES = 30
+# rule thresholds: per-deployment tunables behind GLOBAL-only persisted
+# tidb_tpu_inspection_* sysvars (SET GLOBAL applies live through
+# set_threshold; bootstrap hydrates persisted values like the PR 9/10
+# knobs). DEFAULTS is the one place tests and docs cite; the live values
+# sit in _thresholds.
+DEFAULTS: dict[str, float] = {
+    # evaluation window: trailing samples of the recorder ring (at the
+    # default 1 s interval ≈ the last half minute). Small enough that a
+    # recovered incident ages out quickly; rules re-fire if it returns.
+    "window_samples": 30,
+    "degraded_burst": 5,        # tier fallbacks in the window
+    "cache_min_lookups": 16,    # plane-cache traffic floor for the ratio
+    "cache_hit_ratio": 0.5,     # below this, the cache collapsed
+    "queue_timeouts": 1,        # admission-queue deadline rejections
+    "pool_depth": 1.0,          # queue depth >= size × this
+    "batch_expiries": 3,        # gather-window deadline expiries
+    "mesh_skew": 2.0,           # max/mean per-shard rows
+    "mesh_skew_rows": 256,      # ignore skew on trivial row counts
+}
 
-# rule thresholds (module constants so tests and docs cite one place)
-DEGRADED_BURST_N = 5          # tier fallbacks in the window
-CACHE_MIN_LOOKUPS = 16        # plane-cache traffic floor for the ratio
-CACHE_HIT_RATIO_FLOOR = 0.5   # below this, the cache collapsed
-QUEUE_TIMEOUTS_N = 1          # admission-queue deadline rejections
-POOL_SATURATION_DEPTH = 1.0   # queue depth ≥ size × this
-BATCH_EXPIRY_N = 3            # gather-window deadline expiries
-MESH_SKEW_RATIO = 2.0         # max/mean per-shard rows
-MESH_SKEW_ROWS_FLOOR = 256    # ignore skew on trivial row counts
+SYSVAR_PREFIX = "tidb_tpu_inspection_"
+
+# sysvar defaults (string-valued, MySQL-style) — merged into
+# sessionctx.SYSVAR_DEFAULTS so the whole family persists/hydrates
+SYSVAR_DEFAULTS = {SYSVAR_PREFIX + k: (str(int(v))
+                                       if float(v).is_integer()
+                                       else str(v))
+                   for k, v in DEFAULTS.items()}
+
+_thresholds: dict[str, float] = dict(DEFAULTS)
+
+
+def threshold(key: str) -> float:
+    return _thresholds[key]
+
+
+def set_threshold(name: str, value) -> None:
+    """Apply one tidb_tpu_inspection_* sysvar (bare key accepted too).
+    Raises ValueError on an unknown key or non-numeric/negative value —
+    the SET handler surfaces it typed."""
+    key = name.lower()
+    if key.startswith(SYSVAR_PREFIX):
+        key = key[len(SYSVAR_PREFIX):]
+    if key not in DEFAULTS:
+        raise ValueError(f"unknown inspection threshold {name!r}")
+    v = float(str(value).strip())
+    if v < 0:
+        raise ValueError(f"{name} must be >= 0")
+    if key == "window_samples":
+        v = max(2.0, v)
+    _thresholds[key] = v
+
+
+def reset_thresholds() -> None:
+    _thresholds.clear()
+    _thresholds.update(DEFAULTS)
 
 
 def _severity(value: float, threshold: float) -> str:
@@ -55,13 +97,13 @@ def _rule_degradation_burst(d: dict, begin: float, end: float) -> list:
     out = []
     for name, delta in sorted(d.items()):
         if not name.startswith("copr.degraded_") or \
-                delta < DEGRADED_BURST_N:
+                delta < threshold("degraded_burst"):
             continue
         kind = name[len("copr.degraded_"):]
         out.append(_result(
             "degradation-burst", kind,
-            _severity(delta, DEGRADED_BURST_N), int(delta),
-            f">= {DEGRADED_BURST_N} fallbacks/window",
+            _severity(delta, threshold("degraded_burst")), int(delta),
+            f">= {threshold('degraded_burst'):g} fallbacks/window",
             f"{name} rose {int(delta)} in the window — the "
             f"{kind} tier is degrading instead of serving",
             begin, end))
@@ -76,16 +118,16 @@ def _rule_cache_collapse(d: dict, begin: float, end: float) -> list:
     hits = d.get("copr.plane_cache.hits", 0.0)
     misses = d.get("copr.plane_cache.misses", 0.0)
     total = hits + misses
-    if total < CACHE_MIN_LOOKUPS:
+    if total < threshold("cache_min_lookups"):
         return []
     ratio = hits / total
-    if ratio >= CACHE_HIT_RATIO_FLOOR:
+    if ratio >= threshold("cache_hit_ratio"):
         return []
     evs = int(d.get("copr.plane_cache.evictions", 0.0))
     return [_result(
         "plane-cache-collapse", "hit-ratio",
-        "critical" if ratio < CACHE_HIT_RATIO_FLOOR / 2 else "warning",
-        round(ratio, 3), f">= {CACHE_HIT_RATIO_FLOOR} hit ratio",
+        "critical" if ratio < threshold("cache_hit_ratio") / 2 else "warning",
+        round(ratio, 3), f">= {threshold('cache_hit_ratio'):g} hit ratio",
         f"{int(hits)} hits / {int(total)} lookups in the window"
         f" ({evs} evictions) — repeat scans are re-packing",
         begin, end)]
@@ -99,18 +141,18 @@ def _rule_admission_saturation(d: dict, begin: float, end: float) -> list:
     timeouts = d.get("server.conn_queue_timeouts", 0.0)
     rejected = d.get("server.rejected_connections", 0.0)
     shed = timeouts + rejected
-    if shed >= QUEUE_TIMEOUTS_N:
+    if shed >= threshold("queue_timeouts"):
         out.append(_result(
             "admission-saturation", "conn-queue",
-            _severity(shed, max(QUEUE_TIMEOUTS_N, 4)), int(shed),
-            f"< {QUEUE_TIMEOUTS_N} typed rejections/window",
+            _severity(shed, max(threshold("queue_timeouts"), 4)), int(shed),
+            f"< {threshold('queue_timeouts'):g} typed rejections/window",
             f"{int(timeouts)} queue-deadline timeouts + "
             f"{int(rejected)} queue-full rejections (ER 1040) in the "
             "window — raise max_connections/queue depth or shed load",
             begin, end))
     depth = d.get("copr.drain_pool.queue_depth", 0.0)
     size = d.get("copr.drain_pool.size", 0.0)
-    if size > 0 and depth >= max(1.0, size * POOL_SATURATION_DEPTH):
+    if size > 0 and depth >= max(1.0, size * threshold("pool_depth")):
         out.append(_result(
             "admission-saturation", "drain-pool",
             "critical" if depth >= 4 * size else "warning", int(depth),
@@ -127,12 +169,12 @@ def _rule_batch_expiry_spike(d: dict, begin: float, end: float) -> list:
     budget of below-floor statements. Driven by the sched/batch_window
     failpoint under tidb_tpu_max_execution_time."""
     n = d.get("sched.window_expiries", 0.0)
-    if n < BATCH_EXPIRY_N:
+    if n < threshold("batch_expiries"):
         return []
     return [_result(
         "batch-expiry-spike", "gather-window",
-        _severity(n, BATCH_EXPIRY_N), int(n),
-        f"< {BATCH_EXPIRY_N} expiries/window",
+        _severity(n, threshold("batch_expiries")), int(n),
+        f"< {threshold('batch_expiries'):g} expiries/window",
         f"{int(n)} statement deadlines expired inside the shared batch "
         "gather window — shrink tidb_tpu_batch_window_ms or raise the "
         "statement deadline", begin, end)]
@@ -148,12 +190,12 @@ def _rule_mesh_shard_skew(d: dict, begin: float, end: float) -> list:
         #              from long-gone dispatches is not a live finding
     skew = d.get("copr.mesh.shard_skew", 0.0)
     mx = d.get("copr.mesh.shard_rows_max", 0.0)
-    if skew < MESH_SKEW_RATIO or mx < MESH_SKEW_ROWS_FLOOR:
+    if skew < threshold("mesh_skew") or mx < threshold("mesh_skew_rows"):
         return []
     return [_result(
         "mesh-shard-skew", "placement",
-        "critical" if skew >= 2 * MESH_SKEW_RATIO else "warning",
-        round(skew, 3), f"max/mean < {MESH_SKEW_RATIO}",
+        "critical" if skew >= 2 * threshold("mesh_skew") else "warning",
+        round(skew, 3), f"max/mean < {threshold('mesh_skew'):g}",
         f"fullest shard holds {int(mx)} rows at {skew:.2f}x the mean — "
         "collectives wait on one shard (hot region or placement skew)",
         begin, end)]
@@ -164,12 +206,14 @@ RULES = (_rule_degradation_burst, _rule_cache_collapse,
          _rule_mesh_shard_skew)
 
 
-def inspect(window: int = WINDOW_SAMPLES) -> list[dict]:
+def inspect(window: int | None = None) -> list[dict]:
     """Evaluate every rule over the recorder's trailing window, ended
     at a fresh registry walk (one walk serves both the history bucket
     and the rules — and findings always judge CURRENT state); returns
     findings most-severe first (stable within severity)."""
     from tidb_tpu.metrics.timeseries import recorder
+    if window is None:
+        window = int(threshold("window_samples"))
     deltas, begin, end = recorder.sample_window(window)
     if not deltas:
         return []

@@ -41,8 +41,13 @@ CATALOG: dict[str, tuple[str, str]] = {
     "copr.plane_cache.entries": ("gauge", "Entries currently held by the region plane caches."),
     "copr.plane_cache.top_pinned_table": ("gauge", "Table id holding the most HBM-pinned cached bytes."),
     "copr.plane_cache.top_pinned_bytes": ("gauge", "HBM-pinned cached bytes of the top pinned table."),
+    # ---- aggregate pushdown (columnar STATES channel) ----
+    "copr.agg_states.partials": ("counter", "Region partials that answered a pushed-down aggregate as grouped partial STATES."),
+    "copr.agg_states.rows": ("counter", "Rows aggregated region-side into grouped partial states."),
+    "copr.agg_states.wire_bytes": ("counter", "Wire bytes of grouped partial-STATES payloads (group keys + state arrays)."),
+    "copr.agg_rows.wire_bytes": ("counter", "Wire bytes of row-protocol partial-aggregate chunk responses."),
     # ---- degradation chain ----
-    "copr.degraded_": ("counter", "Tier fallbacks by kind (device_to_cpu, join_to_numpy, combine_to_host, mesh, batch, rows...)."),
+    "copr.degraded_": ("counter", "Tier fallbacks by kind (device_to_cpu, join_to_numpy, combine_to_host, mesh, batch, states_to_host, rows...)."),
     # ---- mesh tier ----
     "copr.mesh.placements": ("counter", "Region-to-shard placements computed."),
     "copr.mesh.replacements": ("counter", "Region re-placements after an epoch bump."),

@@ -12,10 +12,16 @@ between adjacent samples at read time.
 
 Design rules:
 
-* NO background thread. Sampling is lazy: `maybe_sample()` is one
-  monotonic-clock compare on the fast path (statement end calls it),
-  and the diagnostics tables force a sample at read time so a SELECT
-  always sees a fresh bucket. A quiesced process holds no timer.
+* NO background thread in library mode. Sampling is lazy:
+  `maybe_sample()` is one monotonic-clock compare on the fast path
+  (statement end calls it), and the diagnostics tables force a sample
+  at read time so a SELECT always sees a fresh bucket. A quiesced
+  LIBRARY process holds no timer. DAEMON mode is the one exception: a
+  serving wire server registers with `ticker_attach()` and a single
+  background sampler thread keeps the ring warm between statements —
+  an idle server still accrues TIDB_TPU_METRICS_HISTORY buckets, so
+  "what happened while nothing ran" is answerable. The thread exits as
+  soon as the last server detaches (ticker_detach at Server.close).
 * Bounded: the ring keeps `cap` samples (SET GLOBAL
   tidb_tpu_metrics_history_cap); one sample is a plain dict of
   ~a-few-hundred floats, so the whole history is a few MB at worst.
@@ -236,6 +242,57 @@ def _apply_derived(prev: _Sample | None, mono: float,
 
 # the process recorder (the registry it samples is process-wide too)
 recorder = MetricsRecorder()
+
+
+# ---------------------------------------------------------------------------
+# daemon-mode ticker: gated on a wire server being up. Library embeds
+# keep the zero-thread contract; a serving process samples on the
+# configured cadence even while fully idle, so the history ring (and the
+# inspection windows judged over it) never goes dark between statements.
+# ---------------------------------------------------------------------------
+
+_ticker_lock = threading.Lock()
+_ticker_sources: set = set()          # live wire servers (by id token)
+_ticker_thread: threading.Thread | None = None
+
+
+def ticker_attach(source) -> None:
+    """Register a serving wire server; starts the sampler thread on the
+    first attach. Idempotent per source."""
+    global _ticker_thread
+    with _ticker_lock:
+        _ticker_sources.add(id(source))
+        if _ticker_thread is not None and _ticker_thread.is_alive():
+            return
+        _ticker_thread = threading.Thread(
+            target=_ticker_loop, name="tidb-metrics-ticker", daemon=True)
+        _ticker_thread.start()
+
+
+def ticker_detach(source) -> None:
+    """Deregister a server; the sampler thread exits on its next tick
+    once no server remains (a library process returns to zero threads)."""
+    with _ticker_lock:
+        _ticker_sources.discard(id(source))
+
+
+def ticker_active() -> bool:
+    with _ticker_lock:
+        return bool(_ticker_sources) and _ticker_thread is not None \
+            and _ticker_thread.is_alive()
+
+
+def _ticker_loop() -> None:
+    while True:
+        with _ticker_lock:
+            if not _ticker_sources:
+                return
+            interval = recorder.interval_s
+        recorder.maybe_sample()
+        # wake at most 4x per interval so a SET GLOBAL
+        # tidb_tpu_metrics_interval_ms shrink takes effect promptly
+        # without busy-spinning at long cadences
+        time.sleep(max(0.01, min(interval / 4, 0.25)))
 
 
 def history_rows() -> list[tuple]:

@@ -564,14 +564,12 @@ class TpuClient(kv.Client):
         return self._emit_scalar(sel, batch, specs, outs)
 
     def _emit_scalar(self, sel, batch, specs, outs) -> SelectResponse:
-        writer = ChunkWriter()
         row: list[Datum] = [Datum.bytes_(b"")]
         i = 0
         for spec, e in zip(specs, sel.aggregates):
             row.extend(self._partial_datums(spec, e, outs, i, None))
             i += _n_outputs(spec)
-        writer.append_row(0, row)
-        return SelectResponse(chunks=writer.finish())
+        return self._agg_response(sel, [(0, row)])
 
     def _with_group_planes(self, batch, gspec, planes):
         """Add host-built group-code planes (device-cached on the batch):
@@ -621,7 +619,7 @@ class TpuClient(kv.Client):
 
     def _emit_grouped(self, sel, batch, specs, gspec, radices,
                       outs) -> SelectResponse:
-        writer = ChunkWriter()
+        rows: list = []
         row_count = outs[0]
         n_segments = row_count.shape[0]
         live_gids = [g for g in range(n_segments - 1) if row_count[g] > 0]
@@ -650,8 +648,8 @@ class TpuClient(kv.Client):
             for spec, e in zip(specs, sel.aggregates):
                 row.extend(self._partial_datums(spec, e, outs, i, gid))
                 i += _n_outputs(spec)
-            writer.append_row(0, row)
-        return SelectResponse(chunks=writer.finish())
+            rows.append((0, row))
+        return self._agg_response(sel, rows)
 
     # escalation ladder of segment buckets for ranked group-by (last slot
     # of each bucket is the dead-row sink); overflow → next bucket → CPU
@@ -694,7 +692,7 @@ class TpuClient(kv.Client):
 
     def _emit_ranked(self, sel, batch, specs, gspec, outs,
                      ngroups: int) -> SelectResponse:
-        writer = ChunkWriter()
+        rows: list = []
         # outs layout: [ngroups, row_count, (rep, nonnull)×group col, aggs…]
         base = 2 + 2 * len(gspec.cids)
         for g in range(ngroups):
@@ -721,7 +719,22 @@ class TpuClient(kv.Client):
             for spec, e in zip(specs, sel.aggregates):
                 row.extend(self._partial_datums(spec, e, outs, i, g))
                 i += _n_outputs(spec)
-            writer.append_row(0, row)
+            rows.append((0, row))
+        return self._agg_response(sel, rows)
+
+    def _agg_response(self, sel, rows: list) -> SelectResponse:
+        """Ship an aggregate's partial rows. A plane-aware consumer
+        (columnar_hint) gets them as a columnar ColumnarAggRows payload
+        — no chunk encode/decode round trip, and the channel stays
+        columnar for the in-proc engine whose kernels already reduced
+        the whole request (there are no per-region states to combine).
+        Row consumers get the chunk protocol unchanged."""
+        if sel.columnar_hint and self.columnar_scan:
+            fts = col.agg_partial_field_types(sel.aggregates, self._col_pb)
+            return SelectResponse(columnar=col.ColumnarAggRows(rows, fts))
+        writer = ChunkWriter()
+        for handle, row in rows:
+            writer.append_row(handle, row)
         return SelectResponse(chunks=writer.finish())
 
     def _partial_datums(self, spec, agg_expr, outs, i, gid) -> list[Datum]:

@@ -850,6 +850,234 @@ class ColumnarPartialSet:
             yield from p.iter_raw_with_handles()
 
 
+# ---------------------------------------------------------------------------
+# columnar partial-aggregate STATES: the payload a pushed-down aggregate
+# request gets back INSTEAD of partial chunk rows. Each region ships its
+# grouped partial states as numpy arrays (count/sum/min/max monoid states
+# aligned to the region's first-appearance group order, keyed by the SAME
+# codec-encoded group-key bytes the row protocol's partial rows carry), so
+# the SQL-side FINAL aggregate merges them through the device/mesh combine
+# chain (executor.fused_agg) — states, not rows, cross the wire. Every
+# payload can still materialize the exact partial rows the row handler
+# would have emitted, which is what keeps MIXED responses (some regions
+# states, some rows) and the row-loop fallback exact by construction.
+# ---------------------------------------------------------------------------
+
+def agg_partial_field_types(aggregates, col_pb: dict):
+    """Field types of the partial-row layout [groupKey, f0 parts…, …] —
+    the payload-side mirror of plan.physical's agg_fields synthesis
+    (count first if need_count, then value if need_value)."""
+    from tidb_tpu.copr.proto import AGG_NAME, ExprType, field_type_from_pb_column
+    from tidb_tpu.types.field_type import agg_field_type, new_field_type
+    fts = [new_field_type(my.TypeBlob)]
+    for e in aggregates:
+        name = AGG_NAME[e.tp]
+        arg = e.children[0] if e.children else None
+        if arg is not None and arg.tp == ExprType.COLUMN_REF \
+                and arg.val in col_pb:
+            arg_ft = field_type_from_pb_column(col_pb[arg.val])
+        else:
+            from tidb_tpu.types.field_type import FieldType
+            arg_ft = FieldType(my.TypeLonglong)
+        need_count = name in ("count", "avg")
+        need_value = name in ("sum", "avg", "min", "max", "first_row",
+                              "group_concat")
+        if need_count:
+            fts.append(new_field_type(my.TypeLonglong))
+        if need_value:
+            fts.append(agg_field_type(name, arg_ft))
+        if not need_count and not need_value:   # plain count
+            fts.append(new_field_type(my.TypeLonglong))
+    return fts
+
+
+@dataclass
+class AggStateCol:
+    """One aggregate's per-group partial states inside a
+    ColumnarAggStates payload. `values` is the device-combinable numeric
+    state (int64/f64 with `op` its combine monoid); datum-mode states
+    (string min/max, first_row) carry per-group flattened Datums in
+    `datums` and merge host-side — groups are few, rows were many."""
+    name: str                       # count|sum|avg|min|max|first_row
+    counts: np.ndarray              # int64[G] contributing rows
+    values: np.ndarray | None = None   # int64/f64[G] numeric state
+    op: str | None = None           # "sum" | "min" | "max"
+    kind: str | None = None         # value kind: "i64" | "f64" | "dec"
+    dec_scale: int = 0
+    pb_col: PBColumnInfo | None = None   # arg column (datum decode)
+    datums: list | None = None      # datum-mode per-group partial values
+
+
+def _state_value_datum(st: AggStateCol, g: int) -> Datum:
+    """One combinable state cell → the flattened partial-row datum the
+    row handler would have emitted (sum/avg → Decimal/f64 via
+    aggregation._sum_exact's kinds; min/max → the column's flattened
+    storage datum)."""
+    if int(st.counts[g]) == 0:
+        return NULL
+    v = st.values[g]
+    if st.name in ("sum", "avg"):
+        if st.kind == "f64":
+            return Datum.f64(float(v))
+        if st.kind == "dec":
+            return Datum.dec(Decimal(int(v)).scaleb(-st.dec_scale))
+        return Datum.dec(Decimal(int(v)))
+    # min/max over a numeric plane
+    if st.kind == "f64":
+        return Datum.f64(float(v))
+    if st.kind == "dec":
+        return Datum.dec(Decimal(int(v)).scaleb(-st.dec_scale))
+    if st.pb_col is not None and my.has_unsigned_flag(st.pb_col.flag):
+        return Datum.u64(int(v))
+    return Datum.i64(int(v))
+
+
+class ColumnarAggStates:
+    """One region's pushed-down aggregate answered as grouped partial
+    STATES: codec-encoded group keys in the region's first-appearance
+    order plus one AggStateCol per requested aggregate. The client feeds
+    the numeric states straight into the combine_region_partials / mesh
+    psum/pmin/pmax chain (executor.fused_agg.try_fused_final); the
+    partial-ROW materialization below is the exactness net for mixed
+    responses and the row-loop fallback."""
+
+    is_agg_states = True
+
+    def __init__(self, group_keys: list[bytes], aggs: list[AggStateCol],
+                 aggregates, col_pb: dict):
+        self.group_keys = group_keys
+        self.aggs = aggs
+        self._aggregates = aggregates      # request pb Expr list
+        self._col_pb = col_pb
+        self._fts: list | None = None
+        self.cache_info: dict | None = None
+        self.region_id: int | None = None
+        self.region_epoch: tuple | None = None
+
+    def __len__(self) -> int:
+        return len(self.group_keys)
+
+    def field_types(self) -> list:
+        if self._fts is None:
+            self._fts = agg_partial_field_types(self._aggregates,
+                                                self._col_pb)
+        return self._fts
+
+    def value_ft(self, i: int):
+        """Field type of aggregate i's value slot (unflatten target for
+        the combined datum)."""
+        fts = self.field_types()
+        j = 1
+        for k, st in enumerate(self.aggs):
+            if st.name in ("count", "avg"):
+                if k == i and st.name == "count":
+                    return fts[j]
+                j += 1
+            if st.name != "count":
+                if k == i:
+                    return fts[j]
+                j += 1
+        return fts[-1]
+
+    def partial_slices(self, i: int, g: int) -> list[Datum]:
+        """Aggregate i's [cnt?, val?] partial-row slice for group g —
+        layout-identical to AggregationFunction.get_partial_result."""
+        st = self.aggs[i]
+        cnt = int(st.counts[g])
+        if st.name == "count":
+            return [Datum.i64(cnt)]
+        if st.datums is not None:
+            val = st.datums[g]
+        else:
+            val = _state_value_datum(st, g)
+        if st.name == "avg":
+            return [Datum.i64(cnt), val]
+        return [val]
+
+    def partial_row(self, g: int) -> list[Datum]:
+        row: list[Datum] = [Datum.bytes_(self.group_keys[g])]
+        for i in range(len(self.aggs)):
+            row.extend(self.partial_slices(i, g))
+        return row
+
+    def iter_raw_with_handles(self):
+        """(0, flattened partial row) per group — what decoding the row
+        handler's aggregate chunks would have yielded."""
+        for g in range(len(self.group_keys)):
+            yield 0, self.partial_row(g)
+
+    def iter_rows_with_handles(self):
+        """Typed partial rows (unflattened via the agg-field layout) —
+        the row-loop fallback a FINAL HashAggExec consumes unchanged."""
+        from tidb_tpu.types.convert import (
+            unflatten_datum, unflatten_identity_kinds,
+        )
+        info = [(ft, unflatten_identity_kinds(ft))
+                for ft in self.field_types()]
+        for h, row in self.iter_raw_with_handles():
+            yield h, [d if d.kind in idk else unflatten_datum(d, ft)
+                      for d, (ft, idk) in zip(row, info)]
+
+
+class ColumnarStatesSet:
+    """A multi-region pushed-aggregate response: one ColumnarAggStates
+    partial per region task, in task order (= the row protocol's partial
+    arrival order, so group first-appearance order is preserved)."""
+
+    is_agg_states = True
+
+    def __init__(self, parts: list):
+        assert parts, "empty states set"
+        self.parts = parts
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self.parts)
+
+    def region_ids(self) -> list:
+        return [getattr(p, "region_id", None) for p in self.parts]
+
+    def region_epochs(self) -> list:
+        return [getattr(p, "region_epoch", None) for p in self.parts]
+
+    def iter_raw_with_handles(self):
+        for p in self.parts:
+            yield from p.iter_raw_with_handles()
+
+    def iter_rows_with_handles(self):
+        for p in self.parts:
+            yield from p.iter_rows_with_handles()
+
+
+class ColumnarAggRows:
+    """An engine-local aggregate answered columnar as finished PARTIAL
+    ROWS (the in-proc TpuClient's single-response shape: its device
+    kernels already reduced the whole request, so there are no per-region
+    states to combine — shipping the rows it computed keeps the channel
+    columnar without a chunk encode/decode round trip). Not combinable:
+    the FINAL aggregate's row loop merges them."""
+
+    is_agg_states = True
+
+    def __init__(self, rows: list, field_types: list):
+        self._rows = rows          # [(handle, flattened datums)]
+        self._fts = field_types
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def iter_raw_with_handles(self):
+        return iter(self._rows)
+
+    def iter_rows_with_handles(self):
+        from tidb_tpu.types.convert import (
+            unflatten_datum, unflatten_identity_kinds,
+        )
+        info = [(ft, unflatten_identity_kinds(ft)) for ft in self._fts]
+        for h, row in self._rows:
+            yield h, [d if d.kind in idk else unflatten_datum(d, ft)
+                      for d, (ft, idk) in zip(row, info)]
+
+
 class RowsSide:
     """Row-list side of a device join: the drained executor rows behind
     the same plane/rows/datum protocol ColumnarScanResult speaks."""

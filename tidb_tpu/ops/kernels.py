@@ -1019,6 +1019,96 @@ def combine_region_partials(states: list[np.ndarray],
 
 
 # ---------------------------------------------------------------------------
+# region-side grouped partial-aggregate STATES: the device half of the
+# columnar aggregate-pushdown channel (copr.columnar_region). One jitted
+# dispatch computes every aggregate's per-group monoid state over the
+# region's packed planes with the SAME scatter-free SegCtx segment
+# reductions the grouped kernels and the mesh combine use — states, not
+# rows, then cross the wire and merge through combine_region_partials /
+# the mesh psum/pmin/pmax chain.
+# ---------------------------------------------------------------------------
+
+_region_states_cache: dict = {}
+
+
+def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
+    """Per-group partial states for one region's pushed aggregate.
+
+    `gid` maps every plane row to its region-local group id (G = dead-row
+    sink); specs[i] = (op, vals|None, contrib): op ∈ {"sum","min","max"},
+    vals a host int64/float64 plane (None → int64 ones: a count), contrib
+    the contributing-row mask. Returns one [G] array per spec from ONE
+    dispatch + one packed readback. Faults (incl. the device/agg_states
+    failpoint) raise typed DeviceError so the region engine can degrade
+    to the host numpy states — same algebra, same answers."""
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
+    from tidb_tpu import tracing as _tracing
+
+    n = len(gid)
+    ops_t = tuple(op for op, _v, _ok in specs)
+    dtypes = tuple("c" if v is None else np.dtype(v.dtype).char
+                   for _op, v, _ok in specs)
+    key = (ops_t, G, n, dtypes)
+    ent = _region_states_cache.get(key)
+    _tracing.record_jit_cache(hit=ent is not None)
+    if ent is None:
+
+        def fn(arrs, _live):
+            seg = SegCtx(arrs[0], G + 1)   # +1: dead-row sink
+            outs = []
+            for i, op in enumerate(ops_t):
+                vals = arrs[1 + 2 * i]
+                ok = arrs[2 + 2 * i]
+                if op == "sum":
+                    red = seg.sum(vals, ok)
+                elif op == "min":
+                    red = seg.min(vals, ok)
+                else:
+                    red = seg.max(vals, ok)
+                outs.append(red[:G])
+            return tuple(outs)
+
+        wrapper = pack_outputs(fn)
+        ent = (wrapper, jax.jit(wrapper))
+        _region_states_cache[key] = ent
+        if len(_region_states_cache) > 256:
+            _region_states_cache.pop(next(iter(_region_states_cache)))
+    wrapper, jitted = ent
+    sp = _tracing.current().child("agg_states") \
+        .set("groups", G).set("states", len(specs)).set("rows", n)
+    t0 = _time.perf_counter()
+    try:
+        if _failpoint._active:
+            _failpoint.eval("device/agg_states",
+                            lambda: _errors.DeviceError(
+                                "injected agg-states kernel failure"))
+        arrs = [jnp.asarray(np.asarray(gid, np.int64))]
+        for _op, vals, ok in specs:
+            if vals is None:
+                vals = np.ones(n, dtype=np.int64)
+            arrs.append(jnp.asarray(vals))
+            arrs.append(jnp.asarray(np.asarray(ok, bool)))
+        with dispatch_serial:
+            host = np.asarray(jitted(tuple(arrs), None))
+    except _errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash in the states kernel: typed, so the
+        # region engine degrades to the host numpy states (same monoid
+        # algebra) instead of erroring the statement
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(f"region agg states failed: {e}") from e
+    sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
+    sp.finish()
+    _tracing.record_dispatch(
+        readback_bytes=int(host.nbytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    outs = unpack_outputs(wrapper, host)
+    return [np.atleast_1d(np.asarray(o)) for o in outs]
+
+
+# ---------------------------------------------------------------------------
 # device hash join: build (stable sort of right keys) + probe
 # (searchsorted + segment-range expansion) — the device answer to the
 # reference's HashJoinExec build/probe pools (executor/executor.go:442).

@@ -88,6 +88,11 @@ class Server:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="tidb-accept", daemon=True)
         self._accept_thread.start()
+        # daemon-mode metrics ticker: a SERVING process keeps the
+        # diagnostics time series warm while idle (library embeds stay
+        # thread-free — metrics.timeseries samples lazily there)
+        from tidb_tpu.metrics import timeseries
+        timeseries.ticker_attach(self)
         if self.status_port is not None:
             self._start_status_server()
 
@@ -306,6 +311,8 @@ class Server:
 
     def close(self) -> None:
         self.running = False
+        from tidb_tpu.metrics import timeseries
+        timeseries.ticker_detach(self)
         if self._status_httpd is not None:
             self._status_httpd.shutdown()
             self._status_httpd.server_close()

@@ -1216,6 +1216,18 @@ class Session:
         from tidb_tpu.metrics.timeseries import recorder
         recorder.set_cap(n)
 
+    def apply_inspection_threshold(self, name: str, value: str) -> None:
+        """SET GLOBAL tidb_tpu_inspection_<rule-key> = N — one
+        inspection-rule threshold (tidb_tpu.inspection), applied live to
+        the process rule engine (the metrics registry it judges is
+        process-wide too)."""
+        self._require_global_grant(name)
+        from tidb_tpu import inspection
+        try:
+            inspection.set_threshold(name, value)
+        except ValueError as e:
+            raise errors.ExecError(str(e))
+
     def apply_conn_queue_timeout(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_conn_queue_timeout_ms = N — the admission
         queue's server-side wait deadline (0 = wait forever; the server
@@ -1485,6 +1497,15 @@ def bootstrap(session: Session) -> None:
                     _tsrec.set_cap(max(2, int(v.strip())))
             except ValueError:
                 pass
+            # inspection-rule thresholds are process-level like the
+            # metrics recorder — hydrate the whole persisted family
+            from tidb_tpu import inspection as _inspection
+            for var, val in gv.values.items():
+                if var.startswith(_inspection.SYSVAR_PREFIX) and val:
+                    try:
+                        _inspection.set_threshold(var, val)
+                    except ValueError:
+                        pass
             return
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,

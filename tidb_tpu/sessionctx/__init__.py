@@ -141,6 +141,14 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_copr_batch_rows": "1048576",
 }
 
+# inspection-rule thresholds (tidb_tpu_inspection_*): per-deployment
+# tuning surface over the static rule constants — GLOBAL-only,
+# persisted, hydrated on bootstrap like the diagnostics knobs above.
+# The inspection module owns the keys/defaults (one source of truth).
+from tidb_tpu.inspection import SYSVAR_DEFAULTS as _INSPECTION_DEFAULTS
+
+SYSVAR_DEFAULTS.update(_INSPECTION_DEFAULTS)
+
 
 def parse_bool_sysvar(value: str) -> bool:
     """MySQL-style boolean sysvar parse ('1'/'on'/'true' → True) — the
