@@ -800,6 +800,136 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
     }
 
 
+HTAP_SQL = "select count(*), sum(v), min(v), max(v) from ht where k < 6"
+
+
+def measure_htap_mixed(n_rows: int, n_regions: int, runs: int):
+    """The HTAP freshness regime (ROADMAP exit criterion): OLTP commits
+    interleaved with repeat 4-region fan-out scans. With the delta tier
+    on (tidb_tpu_delta_pack=1), every post-commit scan answers from
+    cached base planes + a device base+delta merge — plane-cache hit
+    ratio (exact hits + delta merges over lookups) stays high; with it
+    off, every commit re-colds the cache and the ratio collapses. A
+    commit to an unrelated table never touches the hot table's entries
+    (counter-asserted: zero misses, zero version invalidations), and
+    every iteration's answer is row-for-row identical to the row
+    protocol at the same state. A small delta budget forces fold-and-
+    reset cycles so the background re-pack path is exercised too."""
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    store = new_store(f"cluster://3/benchhtap{n_rows}")
+    s = Session(store)
+    s.execute("create database htap")
+    s.execute("use htap")
+    s.execute("create table ht (id bigint primary key, k bigint, "
+              "v bigint)")
+    s.execute("create table other (id bigint primary key, x bigint)")
+    tbl = s.info_schema().table_by_name("htap", "ht")
+    rows = [[Datum.i64(i), Datum.i64(i % 11), Datum.i64(i * 3)]
+            for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    s.execute("insert into other values (0, 0)")
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+    # a small delta budget so the fold-and-reset (background re-pack)
+    # path fires inside the timed regime
+    s.execute("set global tidb_tpu_delta_budget_rows = 64")
+
+    hits = metrics.counter("copr.plane_cache.hits")
+    misses = metrics.counter("copr.plane_cache.misses")
+    merges = metrics.counter("copr.delta.merges")
+    repacks = metrics.counter("copr.delta.repacks")
+    inv = metrics.counter("copr.plane_cache.invalidations_version")
+    client = store.get_client()
+    iters = max(6, runs * 6)
+    next_id = n_rows + 1
+    merges_at_entry, repacks_at_entry = merges.value, repacks.value
+
+    def regime(label: str):
+        """One interleaved commit/scan loop; returns (scan rows/s, hit
+        ratio, per-iteration parity failures)."""
+        nonlocal next_id
+        s.execute(HTAP_SQL)         # warm / populate the cache
+        h0, m0, g0 = hits.value, misses.value, merges.value
+        t_scan = 0.0
+        for i in range(iters):
+            vals = ", ".join(f"({next_id + j}, {j % 11}, {j})"
+                             for j in range(32))
+            next_id += 32
+            s.execute(f"insert into ht values {vals}")
+            s.execute(f"update ht set v = v + 1 where id = {i % n_rows + 1}")
+            # the deleted id is never re-inserted (next_id only grows),
+            # so its tombstone must KEEP holding through every later
+            # merge — the parity check below would catch a resurrection
+            s.execute(f"delete from ht where id = {next_id - 1}")
+            t0 = time.time()
+            got = s.execute(HTAP_SQL)[0].values()
+            t_scan += time.time() - t0
+            # exact row-for-row parity vs the row protocol AT THE SAME
+            # STATE (no commit between the two runs)
+            client.columnar_scan = False
+            try:
+                want = s.execute(HTAP_SQL)[0].values()
+            finally:
+                client.columnar_scan = True
+            assert got == want, \
+                f"{label} iter {i}: columnar {got} != row protocol {want}"
+        lookups = (hits.value - h0) + (misses.value - m0)
+        served_warm = (hits.value - h0) + (merges.value - g0)
+        ratio = served_warm / lookups if lookups else 0.0
+        return n_rows * iters / t_scan, ratio
+
+    rps_on, ratio_on = regime("delta_on")
+    d_merges = merges.value - merges_at_entry
+    d_repacks = repacks.value - repacks_at_entry
+    assert d_merges > 0, "HTAP regime never took a base+delta merge"
+    assert d_repacks > 0, \
+        "delta budget never triggered a fold-and-reset re-pack"
+
+    # unrelated-table commits: table B traffic must not move table A's
+    # cached planes at all (per-table commit filtering)
+    s.execute(HTAP_SQL)
+    m0, i0, h0 = misses.value, inv.value, hits.value
+    for i in range(4):
+        s.execute(f"insert into other values ({i + 1}, {i})")
+        s.execute(HTAP_SQL)
+    assert misses.value == m0 and inv.value == i0, \
+        "a commit to table B invalidated table A's cached planes"
+    assert hits.value - h0 >= 4 * n_regions, \
+        "post-B-commit scans did not exact-hit table A's planes"
+
+    # kill-switch regime: every commit re-colds the cache (the PR-5
+    # behavior) — the ratio must collapse while answers stay identical
+    s.execute("set global tidb_tpu_delta_pack = 0")
+    try:
+        rps_off, ratio_off = regime("delta_off")
+    finally:
+        s.execute("set global tidb_tpu_delta_pack = 1")
+        s.execute("set global tidb_tpu_delta_budget_rows = 4096")
+    assert ratio_on >= 0.8, \
+        f"HTAP hit ratio {ratio_on:.2f} < 0.8 with the delta tier on"
+    assert ratio_off < 0.3, \
+        f"delta-off hit ratio {ratio_off:.2f} not near zero (bad oracle)"
+    return {
+        "htap_scan_rows_per_sec": round(rps_on, 1),
+        "htap_scan_rows_per_sec_off": round(rps_off, 1),
+        "htap_plane_cache_hit_ratio": round(ratio_on, 3),
+        "htap_plane_cache_hit_ratio_off": round(ratio_off, 3),
+        "htap_regions": n_regions,
+        "delta_merges": d_merges,
+        "delta_repacks": d_repacks,
+    }
+
+
 MESH_FANOUT_SQL = ("select f_g, count(*), sum(f_v), min(f_v), max(d_f) "
                    "from mfan join mdim on f_k = d_k "
                    "group by f_g order by f_g")
@@ -1516,6 +1646,18 @@ def main(smoke: bool = False):
           f"{q1p_figs['q1_pushdown_fallbacks']} fallbacks, states/rows "
           f"wire bytes {q1p_figs['q1_states_bytes_vs_rows_bytes']}",
           file=sys.stderr)
+    # HTAP freshness regime: OLTP commits interleaved with repeat fan-out
+    # scans — cached planes stay warm through region delta packs + device
+    # base+delta merges; the kill-switch regime is the collapse oracle
+    hr = 4_000 if smoke else 100_000
+    htap_figs = measure_htap_mixed(hr, n_regions=4, runs=runs)
+    print(f"# htap_mixed ({hr / 1000:.0f}k rows x "
+          f"{htap_figs['htap_regions']} regions, commits interleaved): "
+          f"{htap_figs['htap_scan_rows_per_sec']:,.0f} rows/s scans "
+          f"(hit ratio {htap_figs['htap_plane_cache_hit_ratio']:.2f} "
+          f"delta-on vs {htap_figs['htap_plane_cache_hit_ratio_off']:.2f} "
+          f"off), {htap_figs['delta_merges']} delta merges, "
+          f"{htap_figs['delta_repacks']} re-packs", file=sys.stderr)
     # mesh fan-out regime: region partials land on their home shards and
     # the grouped partial-agg states combine over ICI (1-shard on a
     # single-device rig — same code path, no collectives)
@@ -1588,6 +1730,7 @@ def main(smoke: bool = False):
         **e2e_figs,
         **fan_figs,
         **q1p_figs,
+        **htap_figs,
         "q1_mesh_rows_per_sec": q1_mesh_rps,
         "mesh_devices": len(jax.devices()),
         **mesh_figs,

@@ -382,6 +382,87 @@ def test_index_pack_failpoint_degrades_to_rows():
 
 
 # ---------------------------------------------------------------------------
+# index-carried aggregates ride STATES (PR 11 residual b)
+# ---------------------------------------------------------------------------
+
+IDX_AGG_QUERIES = [
+    # grouped over the index column, args on index column + pk handle
+    "select l_k, count(*), min(l_id), max(l_id) from lineitem "
+    "use index (ik) where l_k >= 0 group by l_k order by l_k",
+    # scalar aggregates over the covering index
+    "select count(*), min(l_k), max(l_k), sum(l_k) from lineitem "
+    "use index (ik) where l_k >= 0",
+    "select l_k, sum(l_id) from lineitem use index (ik) "
+    "where l_k between 1 and 5 group by l_k order by l_k",
+]
+
+
+@pytest.mark.parametrize("n_regions", [1, 4])
+def test_index_aggregates_answer_with_states(n_regions):
+    """A covering index request carrying pushed-down aggregates answers
+    with grouped partial STATES (ColumnarAggStates) like base-table
+    requests — counted on distsql.columnar_states, fused by the FINAL
+    aggregate, row-for-row vs the row protocol AND the table-scan
+    plan."""
+    from tidb_tpu.codec import codec
+    from tidb_tpu.executor import fused_agg
+    from tidb_tpu.types import Datum
+    s = _build_indexed(n_regions)
+    if n_regions > 1:
+        # row-key splits leave the whole INDEX keyspace in one region —
+        # split it too so the states really fan out per region
+        info = s.info_schema().table_by_name("ap", "lineitem").info
+        ik = next(ix for ix in info.indices if ix.name.lower() == "ik")
+        seek = tc.encode_index_seek_key(info.id, ik.id)
+        s.store.cluster.split_keys(
+            [seek + codec.encode_key([Datum.i64(k)]) for k in (2, 4)])
+    st0, f0 = _counter("states"), _counter("fallbacks")
+    fu0 = fused_agg.stats["final_states"]
+    got = [s.execute(q)[0].values() for q in IDX_AGG_QUERIES]
+    per_q = 3 if n_regions > 1 else 1   # index segments serving a query
+    assert _counter("states") - st0 >= per_q * len(IDX_AGG_QUERIES), \
+        "index aggregates did not ship partial STATES"
+    assert _counter("fallbacks") == f0
+    assert fused_agg.stats["final_states"] > fu0, \
+        "the FINAL aggregate never fused the index states"
+    want = _row_protocol(s, IDX_AGG_QUERIES)
+    for q, g, w in zip(IDX_AGG_QUERIES, got, want):
+        assert g == w, f"index states diverged from the row protocol {q!r}"
+    # and vs the table-scan plan of the same aggregates (no hint)
+    plain = [s.execute(q.replace("use index (ik) ", ""))[0].values()
+             for q in IDX_AGG_QUERIES]
+    for q, g, p in zip(IDX_AGG_QUERIES, got, plain):
+        assert g == p, f"index states diverged from the table plan {q!r}"
+
+
+def test_index_decimal_aggregate_keeps_row_protocol_exact():
+    """DECIMAL-valued aggregates over an index stay on the row handler
+    (comparable-key scale canonicalization) — per-partial fallback, same
+    answers."""
+    s = _build_indexed(4)
+    s.execute("create index ipr on lineitem (l_price)")
+    q = ("select count(*), sum(l_price), min(l_price) from lineitem "
+         "use index (ipr) where l_price >= 0")
+    got = s.execute(q)[0].values()
+    want = _row_protocol(s, [q])[0]
+    assert got == want
+
+
+def test_index_agg_states_failpoint_degrades_to_rows():
+    """copr/agg_states over the index request degrades that region to
+    partial ROWS with unchanged answers (the bottom rung)."""
+    s = _build_indexed(4)
+    want = _row_protocol(s, IDX_AGG_QUERIES)
+    failpoint.enable("copr/agg_states")
+    try:
+        got = [s.execute(q)[0].values() for q in IDX_AGG_QUERIES]
+    finally:
+        failpoint.disable("copr/agg_states")
+    for q, g, w in zip(IDX_AGG_QUERIES, got, want):
+        assert g == w, f"row-degraded index aggregate diverged on {q!r}"
+
+
+# ---------------------------------------------------------------------------
 # micro-batch mask readback bit-packing (PR 9 residual satellite)
 # ---------------------------------------------------------------------------
 

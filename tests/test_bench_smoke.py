@@ -69,6 +69,20 @@ def test_bench_smoke_emits_valid_json():
     assert out["q1_pushdown_state_fusions"] >= 1
     assert out["q1_states_bytes_vs_rows_bytes"] is not None \
         and out["q1_states_bytes_vs_rows_bytes"] > 0
+    # the HTAP freshness regime: commits interleaved with repeat fan-out
+    # scans keep the plane cache hot through region delta packs + device
+    # base+delta merges (parity vs the row protocol and the commit-to-
+    # table-B invariance are asserted inside the bench itself)
+    assert out["htap_scan_rows_per_sec"] > 0
+    assert out["htap_regions"] == 4
+    assert out["htap_plane_cache_hit_ratio"] >= 0.8, \
+        ("mixed commit/scan traffic re-colded the plane cache "
+         f"(hit ratio {out['htap_plane_cache_hit_ratio']})")
+    assert out["htap_plane_cache_hit_ratio_off"] < 0.3
+    assert out["delta_merges"] >= 1, \
+        "no scan answered through a base+delta merge"
+    assert out["delta_repacks"] >= 1, \
+        "the delta budget never folded a pack into a fresh base"
     # the mesh execution regime: q1 over the mesh client, and the
     # 4-region fan-out whose partial-aggregate combine rides the mesh
     # (1-shard on this rig — same code path, no collectives) with zero

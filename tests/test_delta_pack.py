@@ -1,0 +1,411 @@
+"""Differential suite for the HTAP freshness tier (copr.delta): per-table
+commit filtering + region-side delta packs over cached base planes with
+device base+delta merge at scan time.
+
+Every regime is judged against two oracles — the kill switch
+(tidb_tpu_delta_pack = 0 restores invalidate-on-commit) and the row
+protocol (tidb_tpu_columnar_scan = 0) — row-for-row, including emission
+order. Snapshot isolation is exercised both ways (a newer reader merges
+the delta; an older open snapshot keeps its pre-delta generation), the
+budget fold (background re-pack) and both degradation rungs
+(copr/delta_merge → re-pack, device/delta_merge → host merge plan) are
+driven by failpoints, and a chaos schedule races a writer thread against
+fan-out readers under prob-failpoints.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+import pytest
+
+from tidb_tpu import errors, failpoint, metrics, tablecodec as tc
+from tidb_tpu.copr.delta import delta_for
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+SCALAR_Q = ("select count(*), sum(v), min(v), max(f), min(sv), sum(dc) "
+            "from t where k < 9")
+QUERIES = [
+    SCALAR_Q,
+    "select k, count(*), sum(v) from t group by k order by k",
+    "select id, k, v, f, sv, dc from t order by id",
+    "select id, v from t order by v desc limit 9",
+]
+
+
+def _c(name: str) -> int:
+    return metrics.counter(name).value
+
+
+def _build(n_regions: int = 4):
+    store = new_store(f"cluster://3/deltapack{next(_id)}")
+    s = Session(store)
+    s.execute("create database dp")
+    s.execute("use dp")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f double, sv varchar(16), dc decimal(10,2))")
+    s.execute("create table other (id bigint primary key, x bigint)")
+    rows = ", ".join(
+        f"({i}, {i % 13}, {i * 10}, {i}.25, 's{i % 17:02d}', {i}.5)"
+        if i % 11 else
+        f"({i}, null, {i * 10}, null, null, null)"
+        for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    s.execute("insert into other values (0, 0)")
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("dp", "t").info.id
+        step = N_ROWS // n_regions
+        s.store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _all(s) -> list:
+    return [s.execute(q)[0].values() for q in QUERIES]
+
+
+def _parity(s, got: list) -> None:
+    """got must equal the delta-off regime AND the row protocol — at the
+    CURRENT state (no commits in between)."""
+    s.execute("set global tidb_tpu_delta_pack = 0")
+    try:
+        off = _all(s)
+    finally:
+        s.execute("set global tidb_tpu_delta_pack = 1")
+    for q, g, o in zip(QUERIES, got, off):
+        assert g == o, f"delta-on diverged from delta-off on {q!r}"
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        rows = _all(s)
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+    for q, g, r in zip(QUERIES, got, rows):
+        assert g == r, f"delta-on diverged from the row protocol on {q!r}"
+
+
+def test_commit_to_other_table_never_touches_cached_planes():
+    """The per-table commit filter: table B traffic leaves table A's
+    entries untouched — exact hits, zero misses, zero version sweeps
+    (the acceptance criterion's counter assert)."""
+    s = _build(4)
+    _all(s)                          # populate every region's planes
+    _all(s)
+    h0 = _c("copr.plane_cache.hits")
+    m0 = _c("copr.plane_cache.misses")
+    i0 = _c("copr.plane_cache.invalidations_version")
+    g0 = _c("copr.delta.merges")
+    for i in range(3):
+        s.execute(f"insert into other values ({i + 1}, {i})")
+        got = _all(s)
+    assert _c("copr.plane_cache.misses") == m0, \
+        "a commit to table B re-packed table A"
+    assert _c("copr.plane_cache.invalidations_version") == i0, \
+        "a commit to table B swept table A's entries"
+    assert _c("copr.delta.merges") == g0, \
+        "a commit to table B forced a delta merge on table A"
+    assert _c("copr.plane_cache.hits") - h0 >= 3 * 4
+    _parity(s, got)
+
+
+def test_delta_merge_parity_insert_update_delete():
+    """Mixed mutations (inserts between existing handles, updates,
+    deletes, new dictionary strings) merge base+delta into exactly the
+    batch a re-pack would build — row-for-row vs both oracles, in scan
+    order, with the merges counted."""
+    s = _build(4)
+    _all(s)
+    s.execute("insert into t values (1000, 3, 5, 0.5, 'zzz-new', 7.25), "
+              "(1001, null, -4, null, null, null)")
+    s.execute("update t set v = -77, sv = 'aa-upd' where id = 10")
+    s.execute("delete from t where id in (11, 12)")
+    # counters snapshot AFTER the DML: the DML statements' own scans use
+    # fresh point-range base keys whose first lookups legitimately miss
+    g0 = _c("copr.delta.merges")
+    m0 = _c("copr.plane_cache.misses")
+    got = _all(s)
+    d_merges = _c("copr.delta.merges") - g0
+    d_misses = _c("copr.plane_cache.misses") - m0
+    assert d_merges > 0, "no scan took the merge path"
+    # a merge-served lookup still counts a miss (no EXACT entry served);
+    # the claim is that every such miss merged instead of re-packing
+    assert d_merges == d_misses, \
+        f"{d_misses - d_merges} lookups re-packed instead of merging"
+    _parity(s, got)
+    # the merged generation was admitted: repeat scans exact-hit
+    h0 = _c("copr.plane_cache.hits")
+    again = _all(s)
+    assert again == got
+    assert _c("copr.plane_cache.hits") - h0 >= 4
+
+
+def test_old_snapshot_reader_keeps_pre_delta_generation():
+    """Snapshot isolation both ways: after a delta lands, a still-open
+    older snapshot keeps reading its pre-delta data while new readers
+    see the merge. Regions the commit actually touched keep serving the
+    old reader from its retained base entry; version-only regions were
+    re-keyed forward (identical planes, exact byte accounting), so the
+    old reader re-packs those ONCE and re-admits its own generation —
+    repeat old reads then exact-hit again."""
+    s1 = _build(4)
+    s2 = Session(s1.store)
+    s2.execute("use dp")
+    q = "select count(*), sum(v) from t"
+    s1.execute("begin")
+    old = s1.execute(q)[0].values()
+    s1.execute(q)                    # cache at the old generation
+    s2.execute("insert into t values (2000, 1, 999999, null, null, null)")
+    new = s2.execute(q)[0].values()
+    assert new != old, "newer session missed the committed write"
+    still_old = s1.execute(q)[0].values()
+    assert still_old == old, \
+        "older snapshot observed the delta (snapshot isolation broken)"
+    h0, m0 = _c("copr.plane_cache.hits"), _c("copr.plane_cache.misses")
+    again_old = s1.execute(q)[0].values()
+    assert again_old == old
+    assert _c("copr.plane_cache.hits") - h0 >= 4 and \
+        _c("copr.plane_cache.misses") == m0, \
+        "old snapshot did not re-establish its own cached generation"
+    s1.execute("commit")
+    assert s1.execute(q)[0].values() == new
+
+
+def test_budget_fold_resets_pack():
+    """A pack past tidb_tpu_delta_budget_rows folds into a fresh base on
+    the next scan (background re-pack): counted, pack emptied, answers
+    exact."""
+    s = _build(2)
+    s.execute("set global tidb_tpu_delta_budget_rows = 8")
+    try:
+        _all(s)
+        r0 = _c("copr.delta.repacks")
+        vals = ", ".join(f"({3000 + i}, 1, {i}, null, null, null)"
+                         for i in range(24))
+        s.execute(f"insert into t values {vals}")
+        got = _all(s)
+        assert _c("copr.delta.repacks") > r0, \
+            "over-budget delta never folded into a fresh base"
+        ds = delta_for(s.store)
+        tid = s.info_schema().table_by_name("dp", "t").info.id
+        assert all(ds.pack_rows(r.region_id, tid) == 0
+                   for r in s.store.cluster.regions), \
+            "fold did not reset the pack"
+        _parity(s, got)
+    finally:
+        s.execute("set global tidb_tpu_delta_budget_rows = 4096")
+
+
+def test_failpoint_degrades_to_repack():
+    """copr/delta_merge drops the merge path: the scan re-packs (the
+    plain PR-5 behavior) with unchanged answers, counted on
+    copr.degraded_delta_to_repack."""
+    s = _build(4)
+    want_pre = _all(s)
+    s.execute("insert into t values (4000, 2, 42, null, null, null)")
+    d0 = _c("copr.degraded_delta_to_repack")
+    failpoint.enable("copr/delta_merge", action="return", value=True)
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("copr/delta_merge")
+    assert got != want_pre           # the write is visible either way
+    assert _c("copr.degraded_delta_to_repack") > d0
+    _parity(s, got)
+    # after the failpoint clears, the merge path resumes on fresh deltas
+    g0 = _c("copr.delta.merges")
+    s.execute("insert into t values (4001, 2, 43, null, null, null)")
+    got2 = _all(s)
+    assert _c("copr.delta.merges") > g0
+    _parity(s, got2)
+
+
+def test_device_fault_degrades_to_host_plan(monkeypatch):
+    """device/delta_merge fails the kernel: the merge degrades to the
+    host numpy plan (identical order), counted on
+    copr.degraded_delta_to_host."""
+    from tidb_tpu.copr import delta as delta_mod
+    monkeypatch.setattr(delta_mod, "MERGE_DEVICE_FLOOR", 0)
+    s = _build(4)
+    _all(s)
+    s.execute("update t set v = v + 5 where id < 20")
+    d0 = _c("copr.degraded_delta_to_host")
+    g0 = _c("copr.delta.merges")
+    failpoint.enable("device/delta_merge")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("device/delta_merge")
+    assert _c("copr.degraded_delta_to_host") > d0, \
+        "device fault did not degrade to the host merge plan"
+    assert _c("copr.delta.merges") > g0
+    _parity(s, got)
+
+
+def test_device_merge_plan_matches_host(monkeypatch):
+    """The device kernel's order plan is bit-identical to the host
+    numpy plan (floor forced to 0 so the kernel actually runs)."""
+    from tidb_tpu.copr import delta as delta_mod
+    monkeypatch.setattr(delta_mod, "MERGE_DEVICE_FLOOR", 0)
+    s = _build(4)
+    _all(s)
+    s.execute("insert into t values (5000, 4, 1, 1.0, 'kx', 2.5)")
+    s.execute("delete from t where id = 30")
+    got = _all(s)
+    _parity(s, got)
+
+
+def test_kill_switch_and_sysvars():
+    """GLOBAL-only validation, persistence, and the kill switch clearing
+    live packs."""
+    s = _build(2)
+    with pytest.raises(errors.TiDBError):
+        s.execute("set tidb_tpu_delta_pack = 0")          # GLOBAL-only
+    with pytest.raises(errors.TiDBError):
+        s.execute("set global tidb_tpu_delta_pack = 'x'")
+    with pytest.raises(errors.TiDBError):
+        s.execute("set global tidb_tpu_delta_budget_rows = 0")
+    _all(s)
+    s.execute("insert into t values (6000, 1, 1, null, null, null)")
+    _all(s)                          # delta pack now live
+    ds = delta_for(s.store)
+    assert len(ds) > 0
+    s.execute("set global tidb_tpu_delta_pack = 0")
+    try:
+        assert len(ds) == 0, "kill switch left packs behind"
+        assert not ds.enabled
+        got = _all(s)
+        s.execute("set global tidb_tpu_columnar_scan = 0")
+        try:
+            rows = _all(s)
+        finally:
+            s.execute("set global tidb_tpu_columnar_scan = 1")
+        assert got == rows
+        row = s.execute(
+            "select variable_value from mysql.global_variables where "
+            "variable_name = 'tidb_tpu_delta_pack'")[0].values()
+        assert row == [["0"]]
+    finally:
+        s.execute("set global tidb_tpu_delta_pack = 1")
+    s.execute("set global tidb_tpu_delta_budget_rows = 512")
+    try:
+        assert ds.budget_rows == 512
+        row = s.execute(
+            "select variable_value from mysql.global_variables where "
+            "variable_name = 'tidb_tpu_delta_budget_rows'")[0].values()
+        assert row == [["512"]]
+    finally:
+        s.execute("set global tidb_tpu_delta_budget_rows = 4096")
+
+
+def test_chaos_writer_races_fanout_readers():
+    """Chaos schedule (satellite): one writer thread committing
+    inserts/updates/deletes on t (plus unrelated-table traffic) races
+    fan-out readers while copr/delta_merge and cache/no_admit fire
+    probabilistically. Invariants: no reader ever errors or diverges
+    from the row protocol at its own snapshot (checked differentially
+    inside each reader turn), the old-snapshot session keeps its
+    pre-delta read, and degraded accounting only grows."""
+    s = _build(4)
+    store = s.store
+    q = "select count(*), sum(v), min(v) from t"
+    # the pinned old snapshot (its generation must survive the chaos)
+    s_old = Session(store)
+    s_old.execute("use dp")
+    s_old.execute("begin")
+    old_want = s_old.execute(q)[0].values()
+    _all(s)
+    d0 = _c("copr.degraded_delta_to_repack")
+    stop = threading.Event()
+    errors_seen: list = []
+
+    def writer():
+        w = Session(store)
+        w.execute("use dp")
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                w.execute(f"insert into t values ({7000 + i}, {i % 13}, "
+                          f"{i}, null, null, null)")
+                w.execute(f"update t set v = v + 1 where id = {i % 100 + 1}")
+                if i % 3 == 0:
+                    w.execute(f"insert into other values ({100 + i}, {i})")
+                if i % 5 == 0:
+                    w.execute(f"delete from t where id = {7000 + i}")
+            except errors.TiDBError as e:   # retryable-ok: chaos noise
+                errors_seen.append(("writer", e))
+
+    def reader(seed: int):
+        r = Session(store)
+        r.execute("use dp")
+        rq = QUERIES[seed % len(QUERIES)]
+        while not stop.is_set():
+            try:
+                r.execute(rq)
+            except errors.TiDBError as e:
+                errors_seen.append(("reader", e))
+
+    failpoint.enable("copr/delta_merge", action="return", value=True,
+                     when=("prob", 0.3), seed=11)
+    failpoint.enable("cache/no_admit", action="return", value=True,
+                     when=("prob", 0.2), seed=13)
+    threads = [threading.Thread(target=writer)] + \
+        [threading.Thread(target=reader, args=(i,)) for i in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(2.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        failpoint.disable("copr/delta_merge")
+        failpoint.disable("cache/no_admit")
+    assert not errors_seen, f"chaos surfaced errors: {errors_seen[:3]}"
+    assert not any(t.is_alive() for t in threads), "chaos thread wedged"
+    # the old snapshot read is unchanged through all of it
+    assert s_old.execute(q)[0].values() == old_want, \
+        "old-snapshot reader lost its pre-delta generation"
+    s_old.execute("rollback")
+    # steady state: full differential parity at the final state
+    got = _all(s)
+    _parity(s, got)
+    assert _c("copr.degraded_delta_to_repack") >= d0
+
+
+def test_modify_column_ddl_never_serves_stale_pack():
+    """Per-table versions deliberately ignore meta-only DDL commits —
+    the cache key's full column-schema SIGNATURE is what maps a MODIFY
+    COLUMN onto fresh entries (a pre-DDL pack must never serve the
+    post-DDL request shape)."""
+    s = _build(4)
+    s.execute("create table mt (id bigint primary key, a int)")
+    s.execute("insert into mt values " +
+              ", ".join(f"({i}, {i % 9})" for i in range(1, 121)))
+    tid = s.info_schema().table_by_name("dp", "mt").info.id
+    s.store.cluster.split_keys([tc.encode_row_key(tid, 61)])
+    q = "select count(*), sum(a) from mt where a < 7"
+    want = s.execute(q)[0].values()
+    s.execute(q)                        # cache at the pre-DDL signature
+    m0 = _c("copr.plane_cache.misses")
+    s.execute("alter table mt modify column a bigint")   # int → bigint
+    got = s.execute(q)[0].values()
+    assert got == want
+    assert _c("copr.plane_cache.misses") > m0, \
+        "post-DDL request was served from the pre-DDL signature"
+    # the same-type no-op form keeps hitting (signature unchanged)
+    h0 = _c("copr.plane_cache.hits")
+    s.execute(q)
+    assert _c("copr.plane_cache.hits") > h0
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        rows = s.execute(q)[0].values()
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+    assert got == rows

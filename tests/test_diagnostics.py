@@ -501,8 +501,12 @@ class TestInspectionRules:
         s.execute(JOIN_AGG_Q)                 # warm + seed the cache
         _flush_window()
         failpoint.enable("cache/no_admit", action="return", value=True)
+        # the HTAP delta tier would RESCUE this scenario (the commit's
+        # delta merges/rekeys keep serving warm planes) — the rule's
+        # pathology needs it off, like a deployment that disabled it
+        s.execute("set global tidb_tpu_delta_pack = 0")
         try:
-            # a commit bumps the store's data version (orphaning the
+            # a commit bumps the table's data version (orphaning the
             # warm entries), and no_admit keeps every re-pack OUT of the
             # cache: 5 regions x 5 runs of pure misses, ratio 0
             s.execute("insert into t values (99991, 1, 1, 1.0)")
@@ -512,6 +516,7 @@ class TestInspectionRules:
             assert hits, "all-miss window did not fire the cache rule"
         finally:
             failpoint.disable("cache/no_admit")
+            s.execute("set global tidb_tpu_delta_pack = 1")
         _flush_window()
         for _ in range(5):
             s.execute(JOIN_AGG_Q)             # warm hits dominate again
@@ -729,3 +734,118 @@ class TestInspectionThresholds:
                 "persisted inspection threshold did not hydrate"
         finally:
             inspection.reset_thresholds()
+
+
+# ---------------------------------------------------------------------------
+# PR 13 satellites: per-entry trace truncation + the metrics label model
+# ---------------------------------------------------------------------------
+
+class TestFlightTruncation:
+    def test_oversized_trace_keeps_root_and_slowest_subtrees(self):
+        """tidb_tpu_slow_trace_max_spans bounds each RETAINED entry: a
+        pathological fan-out keeps the root + the slowest subtrees,
+        stamps truncated=true + dropped_spans in TRACE_JSON, and the
+        slowest copr subtree survives the cut."""
+        s = _build()
+        fr = flight.recorder_for(s.store)
+        fr.clear()
+        s.execute("set global tidb_tpu_slow_trace_max_spans = 6")
+        s.execute("set tidb_slow_log_threshold = 10")
+        failpoint.enable("copr/region_scan", action="sleep", seconds=0.01)
+        try:
+            s.execute(JOIN_AGG_Q)
+        finally:
+            failpoint.disable("copr/region_scan")
+            s.execute("set global tidb_tpu_slow_trace_max_spans = 512")
+        rows = _rows(s, "select SPAN_COUNT, TRACE_JSON from "
+                        "information_schema.TIDB_TPU_SLOW_TRACES")
+        assert rows, "slowed statement was not retained"
+        spans, tj = rows[-1]
+        doc = json.loads(_sv(tj))
+        assert doc.get("truncated") is True, \
+            "oversized trace not stamped truncated"
+        assert doc.get("dropped_spans", 0) > 0
+        names = [sp["name"] for sp in _walk(doc)]
+        assert len(names) <= 6, f"budget exceeded: {names}"
+        assert spans == len(names)
+        assert doc["name"] == "statement"
+        # the slowest subtree (the copr fan-out) survives the cut
+        assert "copr" in names, names
+
+    def test_small_trace_untouched_and_zero_unbounded(self):
+        s = _build(1)
+        fr = flight.recorder_for(s.store)
+        fr.clear()
+        s.execute("set tidb_slow_log_threshold = 1")
+        s.execute("select count(*) from t where v > 3")
+        entries = fr.entries()
+        assert entries
+        assert "truncated" not in entries[-1]["trace"]
+        # 0 = unbounded: a big tree stays whole
+        s.execute("set global tidb_tpu_slow_trace_max_spans = 0")
+        try:
+            fr.clear()
+            s.execute(JOIN_AGG_Q)
+            entries = fr.entries()
+            assert entries and "truncated" not in entries[-1]["trace"]
+        finally:
+            s.execute("set global tidb_tpu_slow_trace_max_spans = 512")
+
+    def test_max_spans_sysvar_global_only_and_persisted(self):
+        s = _build(1)
+        with pytest.raises(errors.ExecError):
+            s.execute("set tidb_tpu_slow_trace_max_spans = 5")
+        s.execute("set global tidb_tpu_slow_trace_max_spans = 7")
+        try:
+            assert flight.recorder_for(s.store).max_spans == 7
+            row = _rows(s, "select variable_value from "
+                           "mysql.global_variables where variable_name ="
+                           " 'tidb_tpu_slow_trace_max_spans'")
+            assert row == [["7"]]
+        finally:
+            s.execute("set global tidb_tpu_slow_trace_max_spans = 512")
+
+
+class TestMetricsLabels:
+    def test_dynamic_families_split_into_name_and_labels(self):
+        """Dynamic dotted families render as family NAME + kind LABEL in
+        TIDB_TPU_METRICS, so HISTORY can aggregate across kinds."""
+        s = _build()
+        # produce a degraded_* family member
+        failpoint.enable("device/mesh_collective")
+        try:
+            s.execute(JOIN_AGG_Q)
+        finally:
+            failpoint.disable("device/mesh_collective")
+        rows = _rows(s, "select NAME, TYPE, LABELS from "
+                        "information_schema.TIDB_TPU_METRICS")
+        by_name: dict = {}
+        for name, tp, labels in rows:
+            by_name.setdefault(_sv(name), []).append((_sv(tp),
+                                                      _sv(labels)))
+        assert "copr.degraded" in by_name, sorted(by_name)[:40]
+        kinds = {lb for (_t, lb) in by_name["copr.degraded"]}
+        assert all(lb.startswith('kind="') for lb in kinds), kinds
+        # exact catalog names keep full name + empty labels
+        assert ("counter", "") in by_name["ops.kernel_dispatches"]
+        # no raw dynamic member leaks through un-split
+        assert not any(n.startswith("copr.degraded_") for n in by_name)
+
+    def test_history_aggregates_across_kinds(self):
+        """GROUP BY NAME over the labeled history sums a family's kinds
+        (the satellite's acceptance shape)."""
+        s = _build()
+        s.execute(JOIN_AGG_Q)
+        timeseries.recorder.sample()
+        time.sleep(0.002)
+        s.execute(JOIN_AGG_Q)
+        timeseries.recorder.sample()
+        rows = _rows(s, "select NAME, LABELS, METRIC_VALUE from "
+                        "information_schema.TIDB_TPU_METRICS_HISTORY "
+                        "where NAME = 'distsql.queries'")
+        assert rows, "labeled family missing from HISTORY"
+        assert all(_sv(lb).startswith('kind="') for _n, lb, _v in rows)
+        agg = _rows(s, "select NAME, sum(METRIC_VALUE) from "
+                       "information_schema.TIDB_TPU_METRICS_HISTORY "
+                       "where NAME = 'distsql.queries' group by NAME")
+        assert len(agg) == 1 and agg[0][1] > 0

@@ -148,11 +148,15 @@ class TestIncrementalCache:
         q = "select count(*) from t"
         s.execute(q)
         packs = cl.stats["batch_packs"]
+        hits = cl.stats["batch_hits"]
         s.execute("insert into u values (1)")
         assert s.execute(q)[0].values() == [[200]]
-        # zero-delta append: the cached batch object is reused as-is
+        # per-table commit filtering (PR 13): a commit to table u does
+        # not move t's version at all — the cached batch EXACT-hits
+        # (pre-PR-13 this cost a zero-delta append pass)
         assert cl.stats["batch_packs"] == packs
-        assert cl.stats["batch_appends"] == 1
+        assert cl.stats["batch_appends"] == 0
+        assert cl.stats["batch_hits"] == hits + 1
 
     def test_older_snapshot_never_sees_newer_batch(self):
         """Snapshot isolation: a txn whose start_ts predates an insert
@@ -172,7 +176,10 @@ class TestIncrementalCache:
 
     def test_bounds_window_expiry_forces_full_pack(self):
         store, s, cl = self._tpu_session()
-        store._commit_bounds_cap = 2
+        # the appends-only proof rides the PER-TABLE bounds window now
+        # (table_commits_below); shrinking it past the cached version
+        # makes the proof unknowable → full repack
+        store._table_log_cap = 2
         q = "select count(*) from t"
         s.execute(q)
         for i in range(400, 405):  # push the window past the cached version

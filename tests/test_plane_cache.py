@@ -104,20 +104,27 @@ def test_repeat_query_hits_cache():
 
 
 def test_committed_write_invalidates_version():
-    """A commit between two runs bumps data_version_at(start_ts): the
-    next run MISSES (never serves the stale planes), sweeps the dead
-    generation, and sees the write."""
+    """A commit between two runs bumps the table's
+    data_version_at(start_ts): the next run MISSES (never serves the
+    stale planes) and sees the write. With the HTAP delta tier OFF this
+    is PR 5's sweep (invalidations_version); with it on (the default)
+    the old generation instead survives as a delta-merge base — covered
+    by test_delta_pack.py."""
     s = _build(4)
-    before = _all(s)
-    s.execute(JOIN_AGG_Q)    # ensure cached planes exist for the join
-    m0, iv0 = _counter("misses"), _counter("invalidations_version")
-    s.execute("insert into t values (501, 1, 99999, 1.5)")
-    after = s.execute(JOIN_AGG_Q)[0].values()
-    assert after != before[0], "committed write invisible after caching"
-    assert _counter("misses") > m0
-    assert _counter("invalidations_version") > iv0, \
-        "stale-version entries were not swept"
-    got = _all(s)
+    s.execute("set global tidb_tpu_delta_pack = 0")
+    try:
+        before = _all(s)
+        s.execute(JOIN_AGG_Q)   # ensure cached planes exist for the join
+        m0, iv0 = _counter("misses"), _counter("invalidations_version")
+        s.execute("insert into t values (501, 1, 99999, 1.5)")
+        after = s.execute(JOIN_AGG_Q)[0].values()
+        assert after != before[0], "committed write invisible after caching"
+        assert _counter("misses") > m0
+        assert _counter("invalidations_version") > iv0, \
+            "stale-version entries were not swept"
+        got = _all(s)
+    finally:
+        s.execute("set global tidb_tpu_delta_pack = 1")
     _parity_against_oracles(s, got)
 
 
@@ -562,7 +569,11 @@ def _cached_scan_results(s, pc, info):
     out = []
     for fk, ent in sorted(pc._entries.items(),
                           key=lambda kv: kv[0][3]):   # by range bounds
-        region_id, table_id, cids = fk[0], fk[1], fk[2]
+        region_id, table_id = fk[0], fk[1]
+        # the key's column part is the full schema SIGNATURE since the
+        # per-table-version change (PR 13) — the column id leads each
+        # per-column tuple
+        cids = [c[0] if isinstance(c, tuple) else c for c in fk[2]]
         if table_id != info.id or not all(c in by_id for c in cids):
             continue
         out.append(col.ColumnarScanResult(

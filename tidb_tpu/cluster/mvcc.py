@@ -59,6 +59,13 @@ class _Versions:
     values: list[bytes | None] = field(default_factory=list)  # None=delete
 
 
+# the granularity of per-table commit filtering: a commit bumps the data
+# version only of the tables whose keyspace it touched, so the plane
+# cache keyed on the TABLE's version survives unrelated writes. The
+# bucketing rule itself lives with the key layout (tablecodec).
+from tidb_tpu.tablecodec import table_prefix_of  # noqa: E402
+
+
 class MvccStore:
     """One per mock cluster (mock-tikv shares a single store too)."""
 
@@ -72,18 +79,49 @@ class MvccStore:
         # ascending commit_ts of every commit batch (data_version_at)
         self._commit_log: list[int] = []
         self._max_commit_ts = 0
+        # per-table-prefix twins of the commit log (HTAP freshness tier):
+        # commits append their commit_ts under every table prefix they
+        # touch, so data_version_at(ts, prefix) answers "how many commits
+        # touched THIS table" — the plane cache's per-table version key
+        self._table_log: dict[bytes, list[int]] = {}
+        self._table_max: dict[bytes, int] = {}
 
-    def data_version_at(self, read_ts: int) -> int:
+    def data_version_at(self, read_ts: int, prefix: bytes | None = None
+                        ) -> int:
         """Count of commit events visible at read_ts: equal versions imply
         identical visible data — the columnar plane-cache key (mirrors
         localstore.LocalStore.data_version_at). The plane cache consults
         this 2-3× per region task (lookup + post-pack stabilization), so
         the common fresh-snapshot case (read_ts at/above every commit)
-        answers O(1) without the bisect."""
+        answers O(1) without the bisect.
+
+        With `prefix` (a table_prefix_of bucket) only commits that touched
+        that table's keyspace count — equal TABLE versions imply identical
+        visible data for any range inside the table's prefix, which is all
+        a per-region pack ever reads. A commit to table B then never moves
+        table A's version (the per-table commit filter)."""
         with self._lock:
-            if read_ts >= self._max_commit_ts:
-                return len(self._commit_log)
-            return bisect.bisect_right(self._commit_log, read_ts)
+            if prefix is None:
+                if read_ts >= self._max_commit_ts:
+                    return len(self._commit_log)
+                return bisect.bisect_right(self._commit_log, read_ts)
+            log = self._table_log.get(prefix)
+            if log is None:
+                return 0
+            if read_ts >= self._table_max.get(prefix, 0):
+                return len(log)
+            return bisect.bisect_right(log, read_ts)
+
+    def table_commits_between(self, prefix: bytes, v0: int,
+                              v1: int) -> list[int]:
+        """The commit_ts values of table-prefix commits (v0, v1] —
+        positions v0..v1 of the sorted per-table log. The delta-merge
+        validity check: a cached base at table version v0 serves a reader
+        at version v1 iff its delta pack holds an entry for EVERY one of
+        these commits (missing ts ⇒ the pack has a gap ⇒ re-pack)."""
+        with self._lock:
+            log = self._table_log.get(prefix, [])
+            return list(log[v0:v1])
 
     # ---- reads ----
 
@@ -157,7 +195,12 @@ class MvccStore:
                                             op, value)
             self._sorted_keys = None
 
-    def commit(self, keys: list[bytes], start_ts: int, commit_ts: int) -> None:
+    def commit(self, keys: list[bytes], start_ts: int,
+               commit_ts: int) -> list[tuple[bytes, bytes | None]]:
+        """Commit the prewritten keys; returns the DATA mutations applied
+        as (key, value|None) pairs (None = delete; SELECT FOR UPDATE
+        'lock' records apply nothing) — the region-side delta-pack tier
+        appends these over cached base planes (copr.delta)."""
         with self._lock:
             for key in keys:
                 lock = self._locks.get(key)
@@ -168,11 +211,19 @@ class MvccStore:
                     raise TxnAborted(
                         f"commit of {key!r}@{start_ts}: lock missing")
             # visible-data version log: any commit advances the version
-            # seen by readers at ts >= commit_ts (columnar cache key)
+            # seen by readers at ts >= commit_ts (columnar cache key) —
+            # plus the per-table twins, so only the TOUCHED tables'
+            # versions move (the per-table commit filter)
             i = bisect.bisect_left(self._commit_log, commit_ts)
             self._commit_log.insert(i, commit_ts)
             if commit_ts > self._max_commit_ts:
                 self._max_commit_ts = commit_ts
+            for prefix in {table_prefix_of(k) for k in keys}:
+                log = self._table_log.setdefault(prefix, [])
+                log.insert(bisect.bisect_left(log, commit_ts), commit_ts)
+                if commit_ts > self._table_max.get(prefix, 0):
+                    self._table_max[prefix] = commit_ts
+            applied: list[tuple[bytes, bytes | None]] = []
             for key in keys:
                 lock = self._locks.pop(key, None)
                 if lock is None or lock.start_ts != start_ts:
@@ -183,9 +234,11 @@ class MvccStore:
                 i = bisect.bisect_left(vs.commit_ts, commit_ts)
                 vs.commit_ts.insert(i, commit_ts)
                 vs.start_ts.insert(i, start_ts)
-                vs.values.insert(i, None if lock.kind == "delete"
-                                 else lock.value)
+                value = None if lock.kind == "delete" else lock.value
+                vs.values.insert(i, value)
+                applied.append((key, value))
             self._sorted_keys = None
+            return applied
 
     def rollback(self, keys: list[bytes], start_ts: int) -> None:
         with self._lock:

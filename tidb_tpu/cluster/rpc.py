@@ -73,6 +73,11 @@ class RpcHandler:
         # columns, range bounds) so a hit is provably snapshot-consistent
         from tidb_tpu.copr.plane_cache import PlaneCache
         self.plane_cache = PlaneCache()
+        # HTAP freshness tier (copr.delta): commits whose table has live
+        # cached base planes append region-side delta packs instead of
+        # orphaning the cache; scans merge base+delta device-side
+        from tidb_tpu.copr.delta import DeltaStore
+        self.delta_store = DeltaStore(self.plane_cache)
         # per-region access heat (server-side, like TiKV's hot-region
         # flow statistics): time-decayed read/write row+byte windows fed
         # from request completion — the placement signal
@@ -160,10 +165,16 @@ class RpcHandler:
             sum(len(k) + (len(v) if v else 0) for _op, k, v in mutations))
 
     def kv_commit(self, ctx: RegionCtx, keys, start_ts: int, commit_ts: int):
-        self._check(ctx)
+        region = self._check(ctx)
         failpoint.eval("twopc/commit", lambda: ServerIsBusyError(
             "injected commit fault"))
-        self.mvcc.commit(keys, start_ts, commit_ts)
+        applied = self.mvcc.commit(keys, start_ts, commit_ts)
+        # delta tier: the commit's row mutations land as append-only
+        # delta entries over any live cached base planes (instead of the
+        # per-table version bump above orphaning them) — after the MVCC
+        # apply, so a racing scan that sees the new version but not yet
+        # the delta entry simply re-packs (never a wrong answer)
+        self.delta_store.on_commit(region, keys, applied or [], commit_ts)
 
     def kv_rollback(self, ctx: RegionCtx, keys, start_ts: int):
         self._check(ctx)
@@ -209,7 +220,7 @@ class RpcHandler:
             resp = handle_columnar_scan(
                 snapshot, sel, clipped,
                 region=(ctx.region_id, region.epoch()),
-                cache=self.plane_cache)
+                cache=self.plane_cache, delta=self.delta_store)
             if resp is not None:
                 self._record_copr_heat(ctx.region_id, resp)
                 return resp

@@ -587,11 +587,13 @@ class DistStore(kv.Storage):
     def current_version(self) -> int:
         return self.oracle.current_version()
 
-    def data_version_at(self, start_ts: int) -> int:
+    def data_version_at(self, start_ts: int,
+                        prefix: bytes | None = None) -> int:
         """Visible-data version for snapshot reads at start_ts — the TPU
         columnar cache key (splits/leader changes do NOT bump it: topology
-        moves no data)."""
-        return self.mvcc.data_version_at(start_ts)
+        moves no data). With `prefix` (mvcc.table_prefix_of) only commits
+        touching that table count — the per-table commit filter."""
+        return self.mvcc.data_version_at(start_ts, prefix)
 
     def copr_cpu_client(self) -> kv.Client:
         """CPU coprocessor engine for this storage — the TpuClient's

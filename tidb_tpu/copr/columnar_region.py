@@ -27,7 +27,7 @@ from decimal import Decimal
 
 import numpy as np
 
-from tidb_tpu import errors, failpoint, mysqldef as my
+from tidb_tpu import errors, failpoint, mysqldef as my, tablecodec as tc
 from tidb_tpu.codec import codec
 from tidb_tpu.copr.proto import (
     AGG_NAME, ExprType, SelectRequest, SelectResponse,
@@ -50,7 +50,7 @@ STATES_DEVICE_FLOOR = 4096
 
 def handle_columnar_scan(snapshot, sel: SelectRequest,
                          ranges: list[KeyRange], region=None,
-                         cache=None) -> SelectResponse | None:
+                         cache=None, delta=None) -> SelectResponse | None:
     """One region's share of a columnar_hint request as a columnar
     partial, or None → the caller runs the row handler for this region.
 
@@ -78,8 +78,12 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         return None
     agg_specs = None
     if sel.is_agg():
-        if is_index:
-            return None   # pushed agg over an index scan: row protocol
+        # index requests carrying pushed-down aggregates answer with
+        # grouped partial STATES too (PR 11 residual b): the index-key
+        # planes hold every referenced column, so the same monoid pass
+        # applies — decimal-valued aggregates stay on the row handler
+        # for index scans (comparable-key decimal decode could disagree
+        # with the record codec's scale), gated in _agg_states_response
         agg_specs = _states_specs(sel)
         if agg_specs is None:
             return None
@@ -107,7 +111,7 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         pack_key = sel.table_info.table_id
     batch = None
     cache_info = None
-    base_key = version = None
+    base_key = version = prefix = None
     mvcc = getattr(snapshot, "mvcc", None)
     if cache is not None and cache.enabled and region is not None \
             and mvcc is not None \
@@ -122,15 +126,34 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         # read_ts could then disagree). Any blocking lock in range
         # forces the pack path, whose scan raises KeyIsLockedError into
         # the client's resolver ladder exactly like the row handler.
-        version = mvcc.data_version_at(snapshot.read_ts)
-        base_key = (region[0], pack_key,
-                    tuple(c.column_id for c in columns),
+        # The version key is the TABLE's (per-table commit filtering):
+        # record and index keys share the 10-byte table prefix, and a
+        # region pack only ever reads inside it, so a commit to an
+        # unrelated table no longer moves this entry's version at all.
+        table_id = pack_key[1] if is_index else pack_key
+        prefix = tc.table_prefix(table_id)
+        version = mvcc.data_version_at(snapshot.read_ts, prefix)
+        # the column part of the key is the full SCHEMA SIGNATURE, not
+        # just the ids: DDL (MODIFY COLUMN type/default) commits only
+        # meta keys, which the per-table version deliberately ignores —
+        # a schema change must map to a fresh entry, never a stale pack
+        base_key = (region[0], pack_key, _columns_sig(columns),
                     tuple((r.start, r.end) for r in ranges))
-        batch, cache_info = cache.lookup(base_key, region[1], version)
+        base_ok = None
+        if delta is not None and not is_index and delta.enabled:
+            base_ok = (lambda v0: delta.usable(
+                region[0], table_id, v0, version, mvcc, prefix))
+        batch, cache_info, dbase = cache.lookup_with_base(
+            base_key, region[1], version, base_ok)
         # cache_hit / cache_miss land on the region_task span the fan-out
         # worker attached (NOOP when untraced)
         tracing.current().inc("cache_hit" if batch is not None
                               else "cache_miss")
+        if batch is None and dbase is not None:
+            batch = _delta_merge(delta, dbase, region, table_id, version,
+                                 mvcc, prefix, sel, ranges, cache,
+                                 base_key, columns, defaults, cache_info,
+                                 snapshot)
     try:
         if batch is None:
             with tracing.trace("pack") as psp:
@@ -153,7 +176,8 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
                 # pack (lock resolution can land commits below start_ts
                 # mid-scan — same stabilization rule as TpuClient's
                 # batch cache); a churned version serves uncached
-                if mvcc.data_version_at(snapshot.read_ts) == version:
+                if mvcc.data_version_at(snapshot.read_ts,
+                                        prefix) == version:
                     cache.insert(base_key, region[1], version, batch,
                                  cache_info)
         with tracing.trace("filter") as fsp:
@@ -169,7 +193,8 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
                                lambda: errors.TypeError_(
                                    "injected agg-states fault"))
             resp = _agg_states_response(sel, batch, mask, agg_specs,
-                                        region, cache_info)
+                                        region, cache_info, columns,
+                                        is_index)
             if resp is None:
                 tracing.record_degraded("region_to_rows", tally=False)
             return resp
@@ -212,6 +237,82 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         res.region_id = region[0]
         res.region_epoch = region[1]
     return SelectResponse(columnar=res)
+
+
+def _columns_sig(columns) -> tuple:
+    """Schema signature of the requested columns — the cache-key part
+    that changes when DDL changes a column's shape (type, flags,
+    precision, enum elems, fill default) without touching any table
+    key: per-table versions ignore meta commits, so the signature is
+    what keeps a MODIFY COLUMN from ever serving a pre-DDL pack."""
+    return tuple(
+        (c.column_id, c.tp, c.flag, c.flen, c.decimal, c.pk_handle,
+         tuple(c.elems or ()),
+         repr(c.default_val) if c.default_val is not None else None)
+        for c in columns)
+
+
+def _delta_merge(delta, dbase, region, table_id: int, version: int,
+                 mvcc, prefix: bytes, sel, ranges, cache, base_key,
+                 columns, defaults, cache_info, snapshot):
+    """The scan-time base+delta merge (HTAP freshness tier): a protected
+    older-generation base plus its region's delta pack reconstruct the
+    batch a fresh pack at `version` would produce — device tombstone
+    mask + handle-ordered concat (kernels.delta_merge_order), host numpy
+    below the floor. Returns the merged batch (admitted at the current
+    version, with the pack FOLDED and reset when its delta outgrew the
+    budget — the background re-pack), or None → the plain pack path.
+    The copr/delta_merge failpoint degrades exactly there, with
+    unchanged answers (counted on copr.degraded_delta_to_repack)."""
+    from tidb_tpu import metrics, tracing
+    if failpoint._active and \
+            failpoint.eval("copr/delta_merge") is not None:
+        tracing.record_degraded("delta_to_repack", tally=False)
+        return None
+    base_batch, base_version = dbase
+    with tracing.trace("delta_merge") as dsp:
+        try:
+            merged = delta.merge(base_batch, base_version, region[0],
+                                 table_id, version, mvcc, prefix, columns,
+                                 ranges, defaults)
+        except errors.RetryableError:
+            raise   # pending-lock class faults reach the client ladder
+        except errors.TiDBError:
+            # any typed merge fault degrades to the plain re-pack (same
+            # answers from the MVCC scan) — never a statement error
+            tracing.record_degraded("delta_to_repack", tally=False)
+            dsp.set("error", "fault")
+            return None
+        if merged is None:
+            dsp.set("error", "gap")
+            return None
+        dsp.set("rows_base", base_batch.n_rows).set("rows", merged.n_rows)
+    # attribution: the statement's tallies see a delta merge (the repack
+    # was avoided — the freshness tier's hit), per the same monotonic
+    # contract as plane_cache_hits
+    if cache_info is not None:
+        cache_info["delta_merges"] = cache_info.get("delta_merges", 0) + 1
+    # admit the merged batch as the CURRENT generation (repeat scans at
+    # this version then exact-hit), under the same version-stabilization
+    # rule as the pack path; fold-and-reset when the delta outgrew its
+    # budget — that admission IS the background re-pack. A version-only
+    # merge (merged IS the base: the delta held no rows for these
+    # planes) REKEYS the existing entry instead of re-inserting the same
+    # batch — identical planes, zero byte-accounting churn, no re-pin.
+    if mvcc.data_version_at(snapshot.read_ts, prefix) == version:
+        if not (merged is base_batch
+                and cache.rekey(base_key, region[1], base_version,
+                                version)):
+            # real merge — or the base entry was concurrently evicted
+            # (rekey returns False): admit normally
+            cache.insert(base_key, region[1], version, merged, cache_info)
+        if delta.repack_due(region[0], table_id):
+            delta.reset(region[0], table_id)
+            metrics.counter("copr.delta.repacks").inc()
+            if cache_info is not None:
+                cache_info["delta_repacks"] = \
+                    cache_info.get("delta_repacks", 0) + 1
+    return merged
 
 
 # cross-statement cache of compiled region filters (PR 5 residual):
@@ -450,13 +551,27 @@ def _run_states(batch: col.ColumnBatch, gid: np.ndarray, reductions: list,
 
 def _agg_states_response(sel: SelectRequest, batch: col.ColumnBatch,
                          mask: np.ndarray, agg_specs, region,
-                         cache_info) -> SelectResponse | None:
+                         cache_info, columns=None,
+                         is_index: bool = False) -> SelectResponse | None:
     """One region's pushed aggregate as grouped partial states, or None
     → the row handler answers (a column kind without an exact state
-    mapping, or an int-sum overflow guard)."""
+    mapping, or an int-sum overflow guard). Serves TABLE and INDEX
+    requests alike (the index-key planes carry every referenced column);
+    index requests keep DECIMAL-valued aggregates on the row handler —
+    their datums decode from the comparable key encoding, whose scale
+    canonicalization can differ from the record codec's, and a partial
+    value slot must merge byte-identically with row-protocol partials."""
     from tidb_tpu import metrics, tracing
     specs, gcids = agg_specs
-    colpb = {c.column_id: c for c in sel.table_info.columns}
+    if columns is None:
+        columns = sel.table_info.columns
+    colpb = {c.column_id: c for c in columns}
+    if is_index:
+        for _name, arg in specs:
+            if arg is not None and arg.tp == ExprType.COLUMN_REF:
+                cd = batch.columns.get(arg.val)
+                if cd is not None and cd.kind == col.K_DEC:
+                    return None
     live_idx = np.nonzero(mask)[0]
     for cid in gcids:
         cd = batch.columns.get(cid)

@@ -1,0 +1,478 @@
+"""Region-side append-only delta packs over cached base planes: the HTAP
+freshness tier.
+
+The plane cache (copr.plane_cache) made repeat analytical fan-out fast,
+but a write to a table used to orphan its cached planes — the next scan
+re-packed the whole region from the MVCC store. Under realistic mixed
+OLTP/fan-out traffic the cache was cold exactly when it mattered. This
+module is the Taurus-style answer (PAPERS: "Near Data Processing in
+Taurus Database" — writes land as log appends NEAR the data, readers
+merge base+delta at scan time):
+
+* Per-table commit filtering (cluster/mvcc.py data_version_at(ts,
+  prefix)) keys cached planes on the TABLE's version, so a commit to
+  table B never touches table A's entries at all.
+* A commit whose table HAS live cached base planes appends its row
+  mutations (inserts/updates/deletes — deletes as tombstones by handle)
+  to a bounded per-(region, table) DeltaPack instead of invalidating.
+  Every later commit of the table appends too (an empty
+  version-continuity entry when its rows belong to another region), so
+  a pack provably covers every commit between a cached base's version
+  and the present: the merge validity check matches the pack's entry
+  commit_ts multiset against the MVCC store's per-table commit log for
+  exactly the (base_version, read_version] window — any gap means
+  re-pack, never a wrong answer.
+* A scan whose lookup misses at the current version but finds a
+  protected older base merges base planes + delta at scan time: the
+  handle-sorted tombstone mask + appended-plane concat runs as ONE
+  device dispatch (ops.kernels.delta_merge_order) at/above the floor,
+  host numpy below it or on device fault, and the whole merge path
+  degrades to the plain re-pack on the copr/delta_merge failpoint with
+  unchanged answers. Snapshot consistency holds exactly as before:
+  entries apply only when their commit_ts is visible at the reader's
+  snapshot (the per-table version IS that filter), the Percolator lock
+  gate still guards the whole cached path, and old-snapshot readers
+  keep hitting their own pre-delta generation.
+* When a pack's delta exceeds the row budget (SET GLOBAL
+  tidb_tpu_delta_budget_rows; kill switch tidb_tpu_delta_pack), the
+  next scan FOLDS the delta into a fresh base entry and resets the pack
+  (background re-pack, amortized onto the scan that needed it).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import Counter
+
+import numpy as np
+
+from tidb_tpu import errors, tablecodec as tc
+from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+from tidb_tpu.types.datum import NULL
+
+I64_MAX = (1 << 63) - 1
+
+DEFAULT_BUDGET_ROWS = int(SYSVAR_DEFAULTS["tidb_tpu_delta_budget_rows"])
+
+# a pack whose delta outgrows this multiple of the budget is dropped
+# outright (the scan that would have folded it never came — re-packing is
+# cheaper than carrying an unbounded log)
+HARD_CAP_FACTOR = 4
+
+# ENTRY-count budget, independent of the row budget: version-continuity
+# entries (other-region / index-only commits of the table) carry zero
+# rows but still cost list/Counter weight and per-merge walk time — past
+# this the next scan folds the pack (merge + reset) even with few rows,
+# and past 4x the pack drops, so sustained foreign traffic can never
+# grow a pack without bound
+ENTRY_BUDGET = 1024
+
+# rows below which the host numpy merge plan beats a device dispatch —
+# the same flat round-trip economics as the other region-side floors
+MERGE_DEVICE_FLOOR = 4096
+
+_instances: "weakref.WeakSet[DeltaStore]" = weakref.WeakSet()
+
+
+def _update_gauges() -> None:
+    from tidb_tpu import metrics
+    stores = list(_instances)
+    metrics.gauge("copr.delta.bytes").set(
+        sum(s._bytes for s in stores))
+    metrics.gauge("copr.delta.rows").set(
+        sum(s._rows for s in stores))
+    metrics.gauge("copr.delta.entries").set(
+        sum(len(s._packs) for s in stores))
+
+
+class DeltaPack:
+    """Append-only delta of one (region, table): the commits that landed
+    since some cached base plane was packed. entries preserve append
+    (= application) order; rows are (handle, row_value_bytes|None) with
+    None the delete tombstone."""
+
+    __slots__ = ("region_id", "table_id", "entries", "rows", "nbytes",
+                 "ts_counts")
+
+    def __init__(self, region_id: int, table_id: int):
+        self.region_id = region_id
+        self.table_id = table_id
+        self.entries: list[tuple[int, list]] = []   # (commit_ts, rows)
+        self.rows = 0
+        self.nbytes = 0
+        self.ts_counts: Counter = Counter()         # commit_ts → entries
+
+    def append(self, commit_ts: int, rows: list) -> None:
+        self.entries.append((commit_ts, rows))
+        self.ts_counts[commit_ts] += 1
+        self.rows += len(rows)
+        self.nbytes += sum(len(r[1]) + 16 if r[1] is not None else 16
+                           for r in rows)
+
+
+class DeltaStore:
+    """Per-store registry of delta packs, fed from the RPC commit path
+    (cluster/rpc.py kv_commit) and drained by the region columnar engine
+    (copr/columnar_region). Thread-safe; never takes the plane-cache
+    lock while holding its own."""
+
+    def __init__(self, cache):
+        self.cache = cache                     # copr.plane_cache.PlaneCache
+        self.enabled = True
+        self.budget_rows = DEFAULT_BUDGET_ROWS
+        self._lock = threading.Lock()
+        self._packs: dict[tuple[int, int], DeltaPack] = {}
+        self._rows = 0
+        self._bytes = 0
+        _instances.add(self)
+
+    # ---- introspection (tests / sysvars) ----
+
+    def __len__(self) -> int:
+        return len(self._packs)
+
+    def pack_rows(self, region_id: int, table_id: int) -> int:
+        with self._lock:
+            pack = self._packs.get((region_id, table_id))
+            return pack.rows if pack is not None else 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._packs.clear()
+            self._rows = self._bytes = 0
+        _update_gauges()
+
+    def set_enabled(self, on: bool) -> None:
+        self.enabled = on
+        if not on:
+            self.clear()
+
+    # ---- commit side ----
+
+    def on_commit(self, region, keys: list, applied: list,
+                  commit_ts: int) -> None:
+        """One region's share of a commit just applied to the MVCC store
+        (called from kv_commit, after the per-table version bump).
+        `keys` are ALL committed keys of this call (they drove the
+        version bump, including lock-kind records), `applied` the data
+        mutations actually written. Appends clipped row mutations to
+        this region's packs and version-continuity entries to sibling
+        regions' packs of the same tables; anything unprovable drops the
+        affected packs instead of guessing."""
+        if not self.enabled:
+            return
+        if not self._packs and not self.cache._base_tables:
+            # write-only workloads (no cached analytical planes) skip
+            # the whole pass — lock-free truthiness reads; a stale
+            # answer only delays a pack's first entry by one commit,
+            # which the merge-validity window turns into a re-pack,
+            # never a wrong answer
+            return
+        from tidb_tpu import metrics
+        touched: set[int] = set()
+        for k in keys:
+            if tc.table_prefix_of(k) != tc.META_BUCKET:
+                try:
+                    touched.add(tc.decode_table_id(k))
+                except (ValueError, errors.TiDBError):  # retryable-ok:
+                    pass    # pure key decode, no KV access inside
+        if not touched:
+            return
+        by_table: dict[int, list] = {}
+        bad_tables: set[int] = set()
+        for key, value in applied:
+            if key[:1] != b"t" or key[10:12] != tc.ROW_PREFIX_SEP:
+                continue        # index/meta keys: base planes unaffected
+            try:
+                tid, handle = tc.decode_row_key(key)
+            except (ValueError, errors.TiDBError):  # retryable-ok:
+                continue    # pure key decode, no KV access inside
+            if not region.contains(key) or handle == I64_MAX:
+                # a row outside the committing region's bounds (stale
+                # grouping edge) — or the merge kernel's sentinel handle:
+                # nothing sound to append, drop the table's packs
+                bad_tables.add(tid)
+                continue
+            by_table.setdefault(tid, []).append((handle, value))
+        # regions holding live cached bases, read per table BEFORE the
+        # delta lock: the scan path nests cache-lock → delta-lock
+        # (lookup_with_base's base_ok), so taking the cache lock while
+        # holding ours would be an ABBA deadlock
+        live_by_table = {tid: set(self.cache.regions_with_table(tid))
+                         for tid in touched}
+        appended = 0
+        with self._lock:
+            for tid in touched:
+                live_regions = set(live_by_table[tid])
+                live_regions.update(
+                    rid for (rid, t) in self._packs if t == tid)
+                if tid in bad_tables:
+                    for rid in list(live_regions):
+                        self._drop_locked(rid, tid)
+                    continue
+                for rid in live_regions:
+                    pk = (rid, tid)
+                    pack = self._packs.get(pk)
+                    rows = by_table.get(tid, []) \
+                        if rid == region.region_id else []
+                    if rid not in live_by_table[tid]:
+                        # no cached base left to merge over (LRU evicted
+                        # it, or the region's entries died): the pack can
+                        # never serve again — free it
+                        if pack is not None:
+                            self._drop_locked(rid, tid)
+                        continue
+                    if pack is None:
+                        pack = self._packs[pk] = DeltaPack(rid, tid)
+                    before = pack.nbytes
+                    pack.append(commit_ts, rows)
+                    self._rows += len(rows)
+                    self._bytes += pack.nbytes - before
+                    if rows:
+                        appended += 1
+                    if pack.rows > self.budget_rows * HARD_CAP_FACTOR \
+                            or len(pack.entries) > \
+                            ENTRY_BUDGET * HARD_CAP_FACTOR:
+                        self._drop_locked(rid, tid)
+                        metrics.counter("copr.delta.drops").inc()
+        if appended:
+            metrics.counter("copr.delta.appends").inc(appended)
+        _update_gauges()
+
+    def _drop_locked(self, region_id: int, table_id: int) -> None:
+        pack = self._packs.pop((region_id, table_id), None)
+        if pack is not None:
+            self._rows -= pack.rows
+            self._bytes -= pack.nbytes
+
+    def reset(self, region_id: int, table_id: int) -> None:
+        """Fold complete: the merged batch became the new base entry, the
+        delta restarts empty (counted as a re-pack by the caller)."""
+        with self._lock:
+            self._drop_locked(region_id, table_id)
+        _update_gauges()
+
+    # NOTE on split/merge: there is no explicit epoch hook. The cache's
+    # epoch sweep kills the old shape's base entries on the next lookup,
+    # after which regions_with_table stops reporting the region and the
+    # next table commit prunes the orphaned pack in on_commit (and the
+    # entry/row hard caps bound it meanwhile). Merge correctness never
+    # depended on the hook: bases are epoch-matched by the cache sweep
+    # and merge puts clip to the request's (current-epoch) ranges.
+
+    # ---- scan side ----
+
+    def usable(self, region_id: int, table_id: int, base_version: int,
+               version: int, mvcc, prefix: bytes) -> bool:
+        """Can a cached base at per-table version `base_version` serve a
+        reader at `version` through this pack? Yes iff the pack holds an
+        entry for EVERY table commit in (base_version, version] — the
+        multiset of entry commit_ts must cover the MVCC per-table log
+        window (window boundaries always fall on ts boundaries, so a
+        same-ts pair is either fully inside or fully outside)."""
+        if not self.enabled or version <= base_version:
+            return False
+        with self._lock:
+            pack = self._packs.get((region_id, table_id))
+            if pack is None:
+                return False
+            counts = dict(pack.ts_counts)
+        need = Counter(mvcc.table_commits_between(prefix, base_version,
+                                                  version))
+        return all(counts.get(ts, 0) >= n for ts, n in need.items())
+
+    def repack_due(self, region_id: int, table_id: int) -> bool:
+        with self._lock:
+            pack = self._packs.get((region_id, table_id))
+            return pack is not None and \
+                (pack.rows > self.budget_rows
+                 or len(pack.entries) > ENTRY_BUDGET)
+
+    def merge(self, base, base_version: int, region_id: int,
+              table_id: int, version: int, mvcc, prefix: bytes,
+              columns, ranges, defaults):
+        """Base planes + delta → a fresh ColumnBatch identical to what a
+        re-pack at `version` would produce, or None (caller re-packs).
+        The tombstone mask + handle-ordered concat runs as one device
+        dispatch at/above MERGE_DEVICE_FLOOR (kernels.delta_merge_order),
+        host numpy below it — and the device→host rung of the
+        degradation chain on any device fault (copr.degraded_delta_to_host,
+        identical order by construction)."""
+        from tidb_tpu import metrics, tracing
+        need = Counter(mvcc.table_commits_between(prefix, base_version,
+                                                  version))
+        with self._lock:
+            pack = self._packs.get((region_id, table_id))
+            if pack is None:
+                return None
+            remaining = Counter(need)
+            picked: list[list] = []
+            for ts, rows in pack.entries:
+                if remaining.get(ts, 0) > 0:
+                    remaining[ts] -= 1
+                    picked.append(rows)
+            if any(n > 0 for n in remaining.values()):
+                return None     # gap: the pack missed a commit
+        # last write wins per handle, in application order
+        final: dict[int, bytes | None] = {}
+        for rows in picked:
+            for handle, value in rows:
+                final[handle] = value
+        if not final:
+            # version-only delta (other-region / index-only commits):
+            # the base IS the current pack — serve it unchanged
+            metrics.counter("copr.delta.merges").inc()
+            return base
+        row_key = tc.encode_row_key
+        in_range = (lambda k: any(rg.start <= k and
+                                  (rg.end is None or k < rg.end)
+                                  for rg in ranges))
+        tomb = np.fromiter(sorted(final), dtype=np.int64,
+                           count=len(final))
+        puts = sorted((h, v) for h, v in final.items()
+                      if v is not None and
+                      in_range(row_key(table_id, h)))
+        try:
+            merged = _merge_batch(base, tomb, puts, columns, defaults)
+        except errors.TypeError_:
+            return None     # no exact plane mapping: re-pack → row tier
+        if merged is None:
+            return None
+        metrics.counter("copr.delta.merges").inc()
+        tracing.current().set("delta_rows", len(final)) \
+            .set("delta_tombstones", len(tomb)) \
+            .set("delta_appended", len(puts))
+        return merged
+
+
+def _merge_batch(base, tomb: np.ndarray, puts: list, columns, defaults):
+    """Materialize the merged ColumnBatch: decode the surviving delta
+    rows into appended plane segments, get the handle-sorted merge order
+    (device kernel or host plan), gather every plane once."""
+    from tidb_tpu.ops import columnar as col
+    if getattr(base, "max_handle", 0) == I64_MAX:
+        return None   # the kernel's sentinel handle is in play: re-pack
+    cap = base.capacity
+    k = len(puts)
+    app_handles = np.fromiter((h for h, _v in puts), dtype=np.int64,
+                              count=k)
+    # decode appended rows → raw per-column values (the same
+    # datum_to_phys contract the pack path applies; TypeError_ bails the
+    # whole merge to the re-pack tier)
+    col_kinds = {c.column_id: col.column_phys_kind(c) for c in columns}
+    pk_col = next((c for c in columns if c.pk_handle), None)
+    raw: dict[int, list] = {c.column_id: [] for c in columns}
+    ok: dict[int, list] = {c.column_id: [] for c in columns}
+    for h, value in puts:
+        row = tc.decode_row(value)
+        for c in columns:
+            cid = c.column_id
+            if pk_col is not None and cid == pk_col.column_id:
+                raw[cid].append(h)
+                ok[cid].append(True)
+                continue
+            d = row.get(cid)
+            if d is None:
+                d = defaults.get(cid, NULL)
+            scale = c.decimal if col_kinds[cid] == col.K_DEC \
+                and c.decimal and c.decimal > 0 else 0
+            v, valid = col.datum_to_phys(d, col_kinds[cid], scale)
+            raw[cid].append(v)
+            ok[cid].append(valid)
+
+    order = _merge_order(base, tomb, app_handles)
+    n = len(order)
+    cap_new = col.bucket_capacity(n)
+    from_base = order < cap
+    base_idx = np.where(from_base, order, 0)
+    app_idx = np.where(from_base, 0, order - cap)
+
+    handles = np.full(cap_new, -(1 << 63), dtype=np.int64)
+    h_app = np.full(max(k, 1), -(1 << 63), dtype=np.int64)
+    h_app[:k] = app_handles
+    handles[:n] = np.where(from_base, base.handles[base_idx],
+                           h_app[app_idx])
+    cols: dict[int, col.ColumnData] = {}
+    for c in columns:
+        cid = c.column_id
+        kind = col_kinds[cid]
+        old = base.columns[cid]
+        va = np.zeros(cap_new, dtype=bool)
+        okv = np.zeros(max(k, 1), dtype=bool)
+        okv[:k] = ok[cid]
+        va[:n] = np.where(from_base, old.valid[base_idx], okv[app_idx])
+        if kind == col.K_STR:
+            new_vals = [v if o else None for v, o in zip(raw[cid], ok[cid])]
+            merged_dict = sorted(set(old.dictionary)
+                                 | {v for v in new_vals if v is not None})
+            code_of = {b: i for i, b in enumerate(merged_dict)}
+            base_codes = np.full(cap, -1, dtype=np.int64)
+            if old.dictionary:
+                remap = np.array([code_of[b] for b in old.dictionary],
+                                 dtype=np.int64)
+                oc = np.clip(old.values, 0, None)
+                base_codes = np.where(old.valid, remap[oc], -1)
+            app_codes = np.full(max(k, 1), -1, dtype=np.int64)
+            app_codes[:k] = [code_of[v] if v is not None else -1
+                             for v in new_vals]
+            codes = np.full(cap_new, -1, dtype=np.int64)
+            codes[:n] = np.where(from_base, base_codes[base_idx],
+                                 app_codes[app_idx])
+            cols[cid] = col.ColumnData(col.K_STR, codes, va, merged_dict,
+                                       tp=c.tp)
+        else:
+            dtype = np.float64 if kind == col.K_F64 else np.int64
+            app_vals = np.zeros(max(k, 1), dtype=dtype)
+            if k:
+                app_vals[:k] = [x if o else 0
+                                for x, o in zip(raw[cid], ok[cid])]
+            vals = np.zeros(cap_new, dtype=dtype)
+            vals[:n] = np.where(from_base, old.values[base_idx],
+                                app_vals[app_idx])
+            if kind == col.K_I64:
+                col._check_u64_plane(c, vals, va, n)
+            scale = c.decimal if kind == col.K_DEC and c.decimal \
+                and c.decimal > 0 else 0
+            cols[cid] = col.ColumnData(
+                kind, vals, va, tp=c.tp, dec_scale=scale,
+                max_abs=col._plane_max_abs(vals, n, kind))
+    out = col.ColumnBatch(n, cap_new, handles, cols)
+    out.max_handle = int(handles[:n].max()) if n else -(1 << 63)
+    return out
+
+
+def _merge_order(base, tomb: np.ndarray,
+                 app_handles: np.ndarray) -> np.ndarray:
+    """The handle-sorted merge order over [base planes | appended rows]:
+    device kernel at/above the floor, host numpy below it or after a
+    device fault (counted on copr.degraded_delta_to_host)."""
+    import sys
+    from tidb_tpu import errors as _errors, tracing
+    use_device = base.n_rows >= MERGE_DEVICE_FLOOR \
+        and sys.modules.get("jax") is not None
+    if use_device:
+        from tidb_tpu.ops import kernels
+        try:
+            return kernels.delta_merge_order(
+                base.handles, base.row_mask(), tomb, app_handles)
+        except _errors.DeviceError:
+            tracing.record_degraded("delta_to_host", tally=False)
+    live = base.row_mask()
+    pos = np.searchsorted(tomb, base.handles)
+    pos_c = np.clip(pos, 0, max(len(tomb) - 1, 0))
+    dead = (pos < len(tomb)) & \
+        (tomb[pos_c] == base.handles if len(tomb) else False)
+    keep = live & ~dead
+    all_h = np.concatenate([np.where(keep, base.handles, I64_MAX),
+                            app_handles])
+    all_live = np.concatenate([keep, np.ones(len(app_handles), bool)])
+    order = np.argsort(all_h, kind="stable")
+    n_live = int(np.count_nonzero(all_live))
+    return order[:n_live].astype(np.int64)
+
+
+def delta_for(store):
+    """The store's delta-pack registry, or None (non-cluster storage) —
+    the handle for SET GLOBAL / bootstrap hydration."""
+    return getattr(getattr(store, "rpc", None), "delta_store", None)

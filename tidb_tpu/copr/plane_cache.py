@@ -137,6 +137,11 @@ class PlaneCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._by_region: dict[int, set] = {}   # region_id → {full_key}
+        # BASE-TABLE entry counts per (table_id → region_id) — the delta
+        # tier asks "which regions hold live cached base planes for this
+        # table" on every commit (copr.delta); index entries (tuple pack
+        # key) don't count, they cannot merge row deltas
+        self._base_tables: dict[int, dict[int, int]] = {}
         self._bytes = 0
         self._bytes_pinned = 0
         self._pinned_tables: dict[int, int] = {}
@@ -181,6 +186,24 @@ class PlaneCache:
         statement's thread-local tallies (same monotonic-diff contract
         as distsql.columnar_hits); process metrics count here, at the
         cache, so they stay exact even when a response is abandoned."""
+        batch, info, _base = self.lookup_with_base(base_key, epoch,
+                                                   version, None)
+        return batch, info
+
+    def lookup_with_base(self, base_key: tuple, epoch, version: int,
+                         base_ok):
+        """lookup() plus the HTAP delta tier's base resolution:
+        (batch, attribution, delta_base).
+
+        `base_ok(entry_version)` — when given — judges whether an
+        OLDER-version same-base entry can still serve as the base of a
+        device base+delta merge (a live delta pack covers the version
+        gap, copr.delta). The NEWEST such entry is protected from the
+        version sweep and comes back as delta_base = (batch,
+        entry_version); every OTHER older generation dies — a hot table
+        under steady writes holds current + one base, never one
+        generation per commit. Without `base_ok` the sweep is PR 5's:
+        any strictly-older same-base generation dies."""
         full_key = base_key + (epoch, version)
         region_id = base_key[0]
         with self._lock:
@@ -188,13 +211,15 @@ class PlaneCache:
             if ent is not None:
                 self._entries.move_to_end(full_key)
                 _metric("hits").inc()
-                return ent.batch, {"hits": 1}
+                return ent.batch, {"hits": 1}, None
             info = {"misses": 1}
             _metric("misses").inc()
             # invalidation sweep for THIS region: entries whose epoch
             # moved (split/merge) or whose data version is strictly
-            # older than the querying reader's can never serve again
+            # older than the querying reader's can never serve again —
+            # except the newest delta-mergeable base (base_ok)
             swept = 0
+            stale: list = []
             for fk in list(self._by_region.get(region_id, ())):
                 e = self._entries.get(fk)
                 if e is None:
@@ -207,14 +232,26 @@ class PlaneCache:
                         info.get("invalidations_epoch", 0) + 1
                     _metric("invalidations_epoch").inc()
                 elif same_base and e.version < version:
-                    self._remove(fk, e)
-                    swept += 1
-                    info["invalidations_version"] = \
-                        info.get("invalidations_version", 0) + 1
-                    _metric("invalidations_version").inc()
+                    stale.append((fk, e))
+            base_ent: _Entry | None = None
+            if base_ok is not None:
+                for _fk, e in stale:
+                    if (base_ent is None or e.version > base_ent.version) \
+                            and base_ok(e.version):
+                        base_ent = e
+            for fk, e in stale:
+                if e is base_ent:
+                    continue
+                self._remove(fk, e)
+                swept += 1
+                info["invalidations_version"] = \
+                    info.get("invalidations_version", 0) + 1
+                _metric("invalidations_version").inc()
             if swept:
                 self._update_gauges()   # once per sweep, not per entry
-            return None, info
+            base = (base_ent.batch, base_ent.version) \
+                if base_ent is not None else None
+            return None, info, base
 
     def insert(self, base_key: tuple, epoch, version: int, batch,
                info: dict | None = None) -> None:
@@ -250,6 +287,11 @@ class PlaneCache:
             self._entries[full_key] = _Entry(batch, nbytes, epoch, version,
                                              pinned, tid)
             self._by_region.setdefault(base_key[0], set()).add(full_key)
+            if old is None and not isinstance(base_key[1], tuple):
+                # re-admits at the same full key keep their count (the
+                # pop above skipped _unindex)
+                regs = self._base_tables.setdefault(tid, {})
+                regs[base_key[0]] = regs.get(base_key[0], 0) + 1
             self._bytes += nbytes
             if pinned:
                 self._bytes_pinned += nbytes
@@ -263,6 +305,35 @@ class PlaneCache:
                     info["evictions"] = info.get("evictions", 0) + 1
             self._update_gauges()
 
+    def rekey(self, base_key: tuple, epoch, old_version: int,
+              new_version: int) -> bool:
+        """MOVE an entry to a new version under the same base key — the
+        version-only delta case (other-region / index-only commits of
+        the table): the visible planes are IDENTICAL, so re-admitting
+        the same batch would double-count its bytes and re-pin it; a
+        rekey costs nothing and keeps the accounting exact. Returns
+        False when the old entry is gone (caller inserts normally)."""
+        full_old = base_key + (epoch, old_version)
+        full_new = base_key + (epoch, new_version)
+        with self._lock:
+            ent = self._entries.pop(full_old, None)
+            if ent is None:
+                return False
+            self._unindex(full_old)
+            dup = self._entries.pop(full_new, None)
+            if dup is not None:
+                self._unindex(full_new)
+                self._account_remove(dup)
+            ent.version = new_version
+            self._entries[full_new] = ent
+            self._entries.move_to_end(full_new)
+            self._by_region.setdefault(base_key[0], set()).add(full_new)
+            if not isinstance(base_key[1], tuple):
+                regs = self._base_tables.setdefault(ent.table_id, {})
+                regs[base_key[0]] = regs.get(base_key[0], 0) + 1
+            self._update_gauges()
+            return True
+
     def set_budget(self, budget_bytes: int) -> None:
         with self._lock:
             self.budget_bytes = budget_bytes
@@ -273,10 +344,18 @@ class PlaneCache:
                 _metric("evictions").inc()
             self._update_gauges()
 
+    def regions_with_table(self, table_id: int) -> list[int]:
+        """Region ids currently holding live cached BASE-TABLE entries
+        for table_id — the delta tier appends a commit's rows only where
+        a base exists to merge over (no base ⇒ nothing to keep fresh)."""
+        with self._lock:
+            return list(self._base_tables.get(table_id, ()))
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self._by_region.clear()
+            self._base_tables.clear()
             self._bytes = self._bytes_pinned = 0
             self._pinned_tables.clear()
             self._pinned_snapshot = ()
@@ -296,6 +375,16 @@ class PlaneCache:
             keys.discard(full_key)
             if not keys:
                 self._by_region.pop(full_key[0], None)
+        if not isinstance(full_key[1], tuple):
+            regs = self._base_tables.get(full_key[1])
+            if regs is not None:
+                n = regs.get(full_key[0], 0) - 1
+                if n > 0:
+                    regs[full_key[0]] = n
+                else:
+                    regs.pop(full_key[0], None)
+                    if not regs:
+                        self._base_tables.pop(full_key[1], None)
 
     def _account_remove(self, ent: _Entry) -> None:
         self._bytes -= ent.nbytes

@@ -233,6 +233,9 @@ class XSelectIndexExec(Executor):
         self._rows = None
         self._pos = 0
         self._open_result = None   # in-flight SelectResult (error cleanup)
+        self._agg_result = None    # pushed-aggregate request (shared by
+        self._agg_payload = None   # columnar_result and the row loop)
+        self._agg_tried = False
         self.copr_spans: list = []   # trace spans of this scan's requests
 
     # -- request plumbing --
@@ -270,17 +273,60 @@ class XSelectIndexExec(Executor):
         pb_index, pb_cols = self._index_pb()
         req = SelectRequest(start_ts=self.ctx.start_ts(), index_info=pb_index,
                             desc=scan.desc, est_rows=scan.est_rows,
+                            aggregates=list(scan.aggregates),
+                            group_by=list(scan.group_by_pb),
                             columnar_hint=self._columnar_capable())
-        from tidb_tpu.copr.proto import field_type_from_pb_column
-        field_types = [field_type_from_pb_column(c) for c in pb_cols]
+        if scan.aggregated_push_down:
+            # partial-row layout [groupKey, f0 parts…] — regions answer
+            # grouped partial STATES on the columnar channel (PR 11
+            # residual b), partial chunk rows on the row protocol
+            field_types = scan.agg_fields
+        else:
+            from tidb_tpu.copr.proto import field_type_from_pb_column
+            field_types = [field_type_from_pb_column(c) for c in pb_cols]
         ranges = index_ranges_to_kv_ranges(scan.table_info.id, scan.index.id,
                                            scan.ranges)
         return select(self.ctx.client, req, ranges, field_types,
                       concurrency=self.ctx.distsql_concurrency(),
                       keep_order=True, req_type=kv.REQ_TYPE_INDEX), pb_cols
 
+    def columnar_result(self):
+        """The pushed-down aggregate's columnar payload — the grouped
+        partial-STATES set the FINAL HashAgg fuses through the combine
+        chain (executor.fused_agg.try_fused_final) — or None: plain
+        index scans and row-protocol responses keep the row path (the
+        row loop then materializes the exact partial rows)."""
+        scan = self.scan_plan
+        if not scan.aggregated_push_down:
+            return None
+        if self._agg_tried:
+            return self._agg_payload
+        self._agg_tried = True
+        result, _pb_cols = self._index_request()
+        self.copr_spans.append(result.span)
+        self._open_result = result
+        self._agg_result = result
+        self._agg_payload = result.columnar() \
+            if self._columnar_capable() else None
+        if self._agg_payload is not None:
+            self._columnar_rows = len(self._agg_payload)
+        return self._agg_payload
+
     def _materialize(self):
         scan = self.scan_plan
+        if scan.aggregated_push_down:
+            # row-loop leg of a pushed aggregate (states fusion bailed,
+            # or a rows-shaped response): the SAME request serves both —
+            # states payloads materialize their exact partial rows
+            payload = self.columnar_result()
+            result = self._agg_result
+            if payload is not None:
+                self._rows = list(payload.iter_rows_with_handles())
+            else:
+                self._rows = [(h, row) for h, row in result]
+            result.close()
+            self._open_result = None
+            return
         result, pb_cols = self._index_request()
         self.copr_spans.append(result.span)
         self._open_result = result

@@ -164,6 +164,14 @@ def _copr_info(node) -> str:
                 bit += f" drain_seq:{seq}"
             task_bits.append(bit)
         parts.append("tasks:[" + "; ".join(task_bits) + "]")
+    merges = [m for sp in spans for m in sp.find("delta_merge")]
+    if merges:
+        # HTAP freshness tier: scans served by a base+delta merge over
+        # cached planes instead of a re-pack (copr.delta)
+        rows = sum(m.attrs.get("rows", 0) for m in merges)
+        t_us = sum(m.duration_us() for m in merges)
+        parts.append(f"delta: merges:{len(merges)} merged_rows:{rows} "
+                     f"time:{t_us / 1e3:.2f}ms")
     kernels = [k for sp in spans for k in sp.find("kernel")]
     if kernels:
         rb = sum(k.attrs.get("readback_bytes", 0) for k in kernels)

@@ -129,6 +129,9 @@ _GLOBAL_ONLY_TPU_VARS = {
     "tidb_tpu_columnar_scan": "apply_tpu_columnar_scan",
     "tidb_tpu_plane_cache": "apply_tpu_plane_cache",
     "tidb_tpu_plane_cache_bytes": "apply_tpu_plane_cache_bytes",
+    # HTAP freshness tier (region delta packs over cached base planes)
+    "tidb_tpu_delta_pack": "apply_tpu_delta_pack",
+    "tidb_tpu_delta_budget_rows": "apply_tpu_delta_budget_rows",
     "tidb_tpu_mesh": "apply_tpu_mesh",
     "tidb_tpu_micro_batch": "apply_tpu_micro_batch",
     "tidb_tpu_batch_window_ms": "apply_tpu_batch_window",
@@ -145,6 +148,7 @@ _GLOBAL_ONLY_TPU_VARS = {
     # queue deadline)
     "tidb_tpu_flight_recorder": "apply_flight_recorder",
     "tidb_tpu_slow_trace_cap": "apply_slow_trace_cap",
+    "tidb_tpu_slow_trace_max_spans": "apply_slow_trace_max_spans",
     "tidb_tpu_metrics_interval_ms": "apply_metrics_interval",
     "tidb_tpu_metrics_history_cap": "apply_metrics_history_cap",
     "tidb_tpu_conn_queue_timeout_ms": "apply_conn_queue_timeout",

@@ -37,13 +37,16 @@ from collections import deque
 from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
 
 DEFAULT_CAP = int(SYSVAR_DEFAULTS["tidb_tpu_slow_trace_cap"])
+DEFAULT_MAX_SPANS = int(SYSVAR_DEFAULTS["tidb_tpu_slow_trace_max_spans"])
 
 
 class FlightRecorder:
     """Bounded ring of retained statement traces for one store."""
 
-    def __init__(self, cap: int = DEFAULT_CAP):
+    def __init__(self, cap: int = DEFAULT_CAP,
+                 max_spans: int = DEFAULT_MAX_SPANS):
         self.enabled = True
+        self.max_spans = max_spans
         self._lock = threading.Lock()
         self._ring: deque[dict] = deque(maxlen=max(1, cap))
 
@@ -58,6 +61,13 @@ class FlightRecorder:
     def set_cap(self, n: int) -> None:
         with self._lock:
             self._ring = deque(self._ring, maxlen=max(1, int(n)))
+
+    def set_max_spans(self, n: int) -> None:
+        """Per-ENTRY retained span budget (0 = unbounded): the cap
+        bounds how many traces the ring keeps, this bounds how big each
+        one may be — a pathological fan-out (thousands of region tasks ×
+        kernel spans) must not bloat TIDB_TPU_SLOW_TRACES."""
+        self.max_spans = max(0, int(n))
 
     @property
     def cap(self) -> int:
@@ -74,6 +84,7 @@ class FlightRecorder:
         entry, and the ring holds plain dicts — no live Span objects."""
         from tidb_tpu import metrics
         doc = root.to_dict()
+        _truncate_doc(doc, self.max_spans)
         entry = {
             "ts": time.time(),
             "conn_id": conn_id,
@@ -110,6 +121,60 @@ def _count_spans(doc: dict) -> int:
     for c in doc.get("children", ()):
         n += _count_spans(c)
     return n
+
+
+def _truncate_doc(doc: dict, budget: int) -> bool:
+    """Prune a serialized span tree to ≤ `budget` spans, keeping the
+    ROOT plus the SLOWEST subtrees (a span survives only with its whole
+    ancestor chain, so the retained tree stays well-formed — the slow
+    statement's dominant paths are exactly what the operator reads).
+    Stamps truncated=true + dropped_spans on the root so TRACE_JSON
+    says it is partial. Returns whether anything was dropped."""
+    if budget <= 0:
+        return False
+    nodes: list[tuple[float, dict]] = []
+    parent_of: dict[int, dict] = {}
+
+    def walk(d: dict) -> None:
+        for c in d.get("children", ()):
+            nodes.append((float(c.get("duration_us", 0.0)), c))
+            parent_of[id(c)] = d
+            walk(c)
+
+    walk(doc)
+    total = len(nodes) + 1
+    if total <= budget:
+        return False
+    keep: set[int] = {id(doc)}
+    budget_left = budget - 1
+    for _dur, c in sorted(nodes, key=lambda t: -t[0]):
+        if budget_left <= 0:
+            break
+        chain = []
+        n = c
+        while id(n) not in keep:
+            chain.append(n)
+            n = parent_of[id(n)]
+        if len(chain) <= budget_left:
+            keep.update(id(m) for m in chain)
+            budget_left -= len(chain)
+
+    def prune(d: dict) -> None:
+        kids = d.get("children")
+        if not kids:
+            return
+        kept = [c for c in kids if id(c) in keep]
+        for c in kept:
+            prune(c)
+        if kept:
+            d["children"] = kept
+        else:
+            d.pop("children", None)
+
+    prune(doc)
+    doc["truncated"] = True
+    doc["dropped_spans"] = total - len(keep)
+    return True
 
 
 def retain_reason(elapsed_ms: float, threshold_ms: float,

@@ -138,6 +138,7 @@ def store_table_infos() -> list[TableInfo]:
             ("TS", my.TypeDouble, 22),
             ("NAME", my.TypeVarchar, 128),
             ("TYPE", my.TypeVarchar, 16),
+            ("LABELS", my.TypeVarchar, 64),
             ("METRIC_VALUE", my.TypeDouble, 22),
             ("DELTA", my.TypeDouble, 22),
             ("RATE_PER_SEC", my.TypeDouble, 22)]),
@@ -182,8 +183,12 @@ def _metrics_rows() -> list[list[Datum]]:
         help_ = hit[1] if hit is not None else ""
         if isinstance(m, (Counter, Gauge)):
             tp = "counter" if isinstance(m, Counter) else "gauge"
-            out.append([_s(name), _s(tp), _s(""), Datum.f64(float(m.value)),
-                        _s(help_)])
+            # dynamic-family members split into family NAME + a kind
+            # LABEL (copr.degraded_mesh → copr.degraded, kind="mesh"),
+            # so GROUP BY NAME aggregates across kinds
+            fam, labels = catalog.split_labels(name)
+            out.append([_s(fam), _s(tp), _s(labels),
+                        Datum.f64(float(m.value)), _s(help_)])
             continue
         _b, _c, total_sum, total_count = m.snapshot_buckets()
         avg = total_sum / total_count if total_count else 0.0
@@ -199,13 +204,14 @@ def _metrics_history_rows() -> list[list[Datum]]:
     fresh sample at read time when a full interval has elapsed, so a
     SELECT sees a bucket no older than the configured cadence without
     a poll loop compressing the ring."""
-    from tidb_tpu.metrics import timeseries
+    from tidb_tpu.metrics import catalog, timeseries
     timeseries.recorder.sample(
         min_interval_s=timeseries.recorder.interval_s)
     out: list[list[Datum]] = []
     for ts, name, tc, v, delta, rate in timeseries.history_rows():
-        out.append([Datum.f64(round(ts, 3)), _s(name),
-                    _s(_TYPE_WORDS.get(tc, tc)), Datum.f64(v),
+        fam, labels = catalog.split_labels(name)
+        out.append([Datum.f64(round(ts, 3)), _s(fam),
+                    _s(_TYPE_WORDS.get(tc, tc)), _s(labels), Datum.f64(v),
                     Datum.f64(round(delta, 6)) if delta is not None
                     else NULL,
                     Datum.f64(round(rate, 6)) if rate is not None

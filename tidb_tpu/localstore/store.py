@@ -14,7 +14,7 @@ import threading
 import time
 from typing import Iterator
 
-from tidb_tpu import errors
+from tidb_tpu import errors, tablecodec as _tc
 from tidb_tpu.kv.kv import (
     ActiveReads, Client, Driver, KeyRange, Request, Response, Snapshot,
     Storage, Transaction,
@@ -23,6 +23,11 @@ from tidb_tpu.kv.union_store import UnionStore
 from tidb_tpu.kv.membuffer import TOMBSTONE
 from tidb_tpu.localstore.mvcc import MVCCStore
 from tidb_tpu.localstore.regions import RegionManager
+
+
+# sentinel distinguishing "this commit touched the table but wrote no
+# record key" (None bound) from "prefix unseen this commit"
+_NO_RECORD = object()
 
 
 class VersionProvider:
@@ -164,6 +169,19 @@ class LocalStore(Storage):
         self._commit_bounds_log: list[dict[bytes, tuple[bytes, bytes]]] = []
         self._commit_bounds_base = 0           # version of log[0]
         self._commit_bounds_cap = 4096
+        # per-table-prefix commit bookkeeping (HTAP freshness tier,
+        # mirrors cluster.mvcc.MvccStore._table_log): the 10-byte
+        # 't'+enc_int(tid) prefix shared by a table's record and index
+        # keys → (ascending commit_ts list, per-commit record-key min
+        # bound or None). Only the TOUCHED tables' versions move on a
+        # commit, so the TPU batch cache keyed on the table's version
+        # survives unrelated writes; the bounds twin carries the
+        # appends-only proof per table (bounded window like the global
+        # bounds log)
+        self._table_ts_log: dict[bytes, list[int]] = {}
+        self._table_min_log: dict[bytes, list[bytes | None]] = {}
+        self._table_log_base: dict[bytes, int] = {}
+        self._table_log_cap = 4096
         # live readers (snapshots/txns): GC clamps its safepoint to the
         # oldest of these so a long scan can never have the versions it
         # is reading reclaimed mid-flight
@@ -266,6 +284,7 @@ class LocalStore(Storage):
         bookkeeping — shared by the live path and WAL recovery."""
         self.mvcc.write_many(muts, commit_ts)
         bounds: dict[bytes, tuple[bytes, bytes]] = {}
+        table_mins: dict[bytes, bytes | None] = {}
         for key, _val in muts:
             p = bytes(key[:12])
             cur = bounds.get(p)
@@ -273,6 +292,20 @@ class LocalStore(Storage):
                 bounds[p] = (key, key)
             else:
                 bounds[p] = (min(cur[0], key), max(cur[1], key))
+            # per-TABLE twin: bucket by the shared table-prefix rule
+            # (tablecodec.table_prefix_of); the bound kept is the
+            # smallest RECORD key touched (None when the commit only
+            # wrote index/meta keys of the table), which is all the
+            # appends-only proof needs
+            tp = _tc.table_prefix_of(key)
+            is_record = tp != _tc.META_BUCKET and \
+                key[10:12] == _tc.ROW_PREFIX_SEP
+            prev = table_mins.get(tp, _NO_RECORD)
+            if is_record and (prev is _NO_RECORD or prev is None
+                              or key < prev):
+                table_mins[tp] = key
+            elif prev is _NO_RECORD:
+                table_mins[tp] = None
         self.regions.note_write(len(muts))
         self._commit_ts_log.append(commit_ts)
         self._commit_bounds_log.append(bounds)
@@ -280,12 +313,47 @@ class LocalStore(Storage):
         if overflow > 0:
             del self._commit_bounds_log[:overflow]
             self._commit_bounds_base += overflow
+        for tp, min_rec in table_mins.items():
+            self._table_ts_log.setdefault(tp, []).append(commit_ts)
+            mins = self._table_min_log.setdefault(tp, [])
+            mins.append(min_rec)
+            over = len(mins) - self._table_log_cap
+            if over > 0:
+                del mins[:over]
+                self._table_log_base[tp] = \
+                    self._table_log_base.get(tp, 0) + over
 
-    def data_version_at(self, start_ts: int) -> int:
+    def data_version_at(self, start_ts: int,
+                        prefix: bytes | None = None) -> int:
         """Number of commits visible at start_ts — the cache key the TPU
-        columnar cache uses: equal versions ⇒ identical visible data."""
+        columnar cache uses: equal versions ⇒ identical visible data.
+        With `prefix` (the 10-byte table prefix) only commits touching
+        that table's keyspace count, so a commit to table B never moves
+        table A's version (per-table commit filtering — the cluster
+        MvccStore twin)."""
         import bisect
-        return bisect.bisect_right(self._commit_ts_log, start_ts)
+        log = self._commit_ts_log if prefix is None \
+            else self._table_ts_log.get(prefix, [])
+        return bisect.bisect_right(log, start_ts)
+
+    def table_commits_below(self, prefix: bytes, from_version: int,
+                            wm_key: bytes) -> bool | None:
+        """Did any table-prefix commit AFTER table version `from_version`
+        write a record key at/below `wm_key`? None = unknown (the bounded
+        window no longer covers from_version, or a commit wrote no record
+        key we can bound) — callers must treat None as 'not provably
+        append-only'. The per-table twin of the commit_bounds proof."""
+        base = self._table_log_base.get(prefix, 0)
+        lo = from_version - base
+        if lo < 0:
+            return None
+        for min_rec in self._table_min_log.get(prefix, [])[lo:]:
+            if min_rec is None:
+                # index/meta-only commit for this table: no record moved
+                continue
+            if min_rec <= wm_key:
+                return True
+        return False
 
     def commit_bounds(self, from_version: int, to_version: int):
         """Per-commit key-prefix bounds for commits (from, to], or None

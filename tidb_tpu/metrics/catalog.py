@@ -41,6 +41,14 @@ CATALOG: dict[str, tuple[str, str]] = {
     "copr.plane_cache.entries": ("gauge", "Entries currently held by the region plane caches."),
     "copr.plane_cache.top_pinned_table": ("gauge", "Table id holding the most HBM-pinned cached bytes."),
     "copr.plane_cache.top_pinned_bytes": ("gauge", "HBM-pinned cached bytes of the top pinned table."),
+    # ---- HTAP freshness tier (region delta packs) ----
+    "copr.delta.appends": ("counter", "Commit row-sets appended to region delta packs instead of invalidating cached planes."),
+    "copr.delta.merges": ("counter", "Scans answered by a device base+delta merge over cached planes."),
+    "copr.delta.repacks": ("counter", "Delta packs folded into a fresh base entry after exceeding tidb_tpu_delta_budget_rows."),
+    "copr.delta.drops": ("counter", "Delta packs dropped at the hard cap (no scan came to fold them)."),
+    "copr.delta.bytes": ("gauge", "Bytes currently held by region delta packs."),
+    "copr.delta.rows": ("gauge", "Delta rows currently held by region delta packs."),
+    "copr.delta.entries": ("gauge", "Live region delta packs."),
     # ---- aggregate pushdown (columnar STATES channel) ----
     "copr.agg_states.partials": ("counter", "Region partials that answered a pushed-down aggregate as grouped partial STATES."),
     "copr.agg_states.rows": ("counter", "Rows aggregated region-side into grouped partial states."),
@@ -130,6 +138,32 @@ CATALOG: dict[str, tuple[str, str]] = {
 # dynamic-family prefixes (f-string call sites register these)
 PREFIXES = tuple(sorted((n for n in CATALOG if n.endswith(".")
                          or n.endswith("_")), key=len, reverse=True))
+
+
+def split_labels(name: str) -> tuple[str, str]:
+    """(family name, labels) for one emitted metric name — the label
+    model of the SQL metrics surface: a dynamic-family member like
+    `copr.degraded_mesh` renders as NAME `copr.degraded` with LABELS
+    `kind="mesh"`, so TIDB_TPU_METRICS_HISTORY can aggregate across
+    kinds (`GROUP BY NAME`). Exact catalog names (and names the catalog
+    does not know) keep their full name and empty labels. Histogram
+    series sampled as `_count`/`_sum` keep the stat suffix on the NAME —
+    their stat already rides LABELS in the current-metrics table."""
+    if name in CATALOG:
+        return name, ""
+    base = name
+    for suffix in ("_count", "_sum"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            break
+    for p in PREFIXES:
+        if base.startswith(p) and len(base) > len(p):
+            fam = p.rstrip("._")
+            kind = base[len(p):]
+            if base is not name:            # histogram stat suffix
+                return name, f'kind="{kind}"'
+            return fam, f'kind="{kind}"'
+    return name, ""
 
 
 def lookup(name: str) -> tuple[str, str] | None:

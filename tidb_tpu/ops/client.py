@@ -283,10 +283,21 @@ class TpuClient(kv.Client):
         is_index = sel.table_info is None
         src = sel.index_info if is_index else sel.table_info
         cols = src.columns
+        # the column part of the key is the full schema signature (not
+        # just ids): per-table versions ignore meta-only DDL commits, so
+        # a MODIFY COLUMN must land on a fresh entry by KEY
+        from tidb_tpu.copr.columnar_region import _columns_sig
         base_key = (("idx", src.index_id) if is_index else src.table_id,
-                    tuple(c.column_id for c in cols),
+                    _columns_sig(cols),
                     tuple((r.start, r.end) for r in ranges))
-        version = self.store.data_version_at(sel.start_ts)
+        # per-TABLE version key (HTAP freshness tier): only commits that
+        # touched THIS table's keyspace move it, so a commit to an
+        # unrelated table no longer evicts this batch (record and index
+        # keys share the 10-byte prefix, so index batches invalidate on
+        # their base table's writes too)
+        from tidb_tpu import tablecodec as _tc
+        prefix = _tc.table_prefix(src.table_id)
+        version = self.store.data_version_at(sel.start_ts, prefix)
         ent = self._batch_cache.get(base_key) if self.plane_cache_enabled \
             else None
         if ent is not None and ent[1] == version \
@@ -325,7 +336,7 @@ class TpuClient(kv.Client):
         # at the same key could see a different row set — don't cache
         for _ in range(3):
             batch = build()
-            after = self.store.data_version_at(sel.start_ts)
+            after = self.store.data_version_at(sel.start_ts, prefix)
             if after == version:
                 break
             version = after
@@ -358,26 +369,21 @@ class TpuClient(kv.Client):
         return any(gate(start_ts, rg.start, rg.end) for rg in ranges)
 
     def _appends_only(self, table_id: int, ent) -> bool:
-        """True when every commit in (cached version, now] either avoids
-        this table's record keyspace or only writes keys above the cached
-        batch's max handle."""
-        bounds_fn = getattr(self.store, "commit_bounds", None)
+        """True when every commit in (cached TABLE version, now] either
+        avoids this table's record keyspace or only writes keys above the
+        cached batch's max handle. Cached versions are per-table now, so
+        the proof consults the per-table bounds twin
+        (LocalStore.table_commits_below) — unrelated tables' commits are
+        out of the window by construction."""
+        fn = getattr(self.store, "table_commits_below", None)
         old_batch, old_version = ent
         watermark = getattr(old_batch, "max_handle", None)
-        if bounds_fn is None or watermark is None:
+        if fn is None or watermark is None:
             return False
         from tidb_tpu import tablecodec as tc
-        prefix = tc.table_record_prefix(table_id)
         wm_key = tc.encode_row_key(table_id, watermark)
-        cur = self.store.data_version_at(self.store.current_version())
-        commits = bounds_fn(old_version, cur)
-        if commits is None:  # bounds window expired: can't prove anything
-            return False
-        for commit in commits:
-            b = commit.get(prefix)
-            if b is not None and b[0] <= wm_key:
-                return False
-        return True
+        below = fn(tc.table_prefix(table_id), old_version, wm_key)
+        return below is False   # None = window expired: cannot prove
 
     def _send_tpu(self, req: kv.Request, sel: SelectRequest) -> SelectResponse:
         if sel.having is not None:

@@ -248,6 +248,94 @@ def _device_pad(arr, cap: int):
     return fn(arr)
 
 
+_delta_merge_cache: dict = {}
+
+
+def delta_merge_order(handles: np.ndarray, live: np.ndarray,
+                      tomb_handles: np.ndarray,
+                      app_handles: np.ndarray) -> np.ndarray:
+    """Device plan for one base+delta merge (the HTAP freshness tier,
+    copr.delta): handle-sorted tombstone mask + appended-plane concat in
+    ONE dispatch with one packed readback.
+
+    `handles` is the base batch's handle plane (int64[cap], padding
+    I64_MIN), `live` its row-liveness mask, `tomb_handles` the SORTED
+    handles the delta superseded (updates + deletes), `app_handles` the
+    delta's appended row handles. Returns the merge ORDER: int64 indices
+    into the virtual concat [base planes | appended planes] (i < cap →
+    base row i, else appended row i - cap), ascending by handle — exactly
+    the row order a fresh pack of the same snapshot would produce, so
+    TopN tiebreaks, first_row, and emission order survive the merge.
+    Tombstoned/dead base rows are dropped. Faults (incl. the
+    device/delta_merge failpoint) raise typed DeviceError so the caller
+    degrades to the host numpy plan — same order, same answers."""
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
+    from tidb_tpu import tracing as _tracing
+    cap = int(handles.shape[0])
+    m_cap = max(1, col.bucket_capacity(len(tomb_handles), minimum=64))
+    k_cap = col.bucket_capacity(len(app_handles), minimum=64)
+    key = (cap, m_cap, k_cap)
+    ent = _delta_merge_cache.get(key)
+    _tracing.record_jit_cache(hit=ent is not None)
+    if ent is None:
+
+        def fn(h, lv, tomb, n_tomb, app_h, app_lv):
+            pos = jnp.searchsorted(tomb, h)
+            pos_c = jnp.clip(pos, 0, m_cap - 1)
+            dead = (pos < n_tomb) & (tomb[pos_c] == h)
+            keep = lv & ~dead
+            all_h = jnp.concatenate([
+                jnp.where(keep, h, jnp.int64(I64_MAX)),
+                jnp.where(app_lv, app_h, jnp.int64(I64_MAX))])
+            all_live = jnp.concatenate([keep, app_lv])
+            order = jnp.argsort(all_h)
+            n_live = jnp.sum(all_live.astype(jnp.int64))
+            # order indices < cap + k_cap < 2^53: exact in f64, so the
+            # whole plan rides ONE f64 readback (pack_outputs economics)
+            return jnp.concatenate([order.astype(jnp.float64),
+                                    n_live.astype(jnp.float64)[None]])
+
+        ent = _delta_merge_cache[key] = jax.jit(fn)
+        if len(_delta_merge_cache) > 256:
+            _delta_merge_cache.pop(next(iter(_delta_merge_cache)))
+    sp = _tracing.current().child("delta_merge_kernel") \
+        .set("rows", cap).set("tombstones", len(tomb_handles)) \
+        .set("appended", len(app_handles))
+    t0 = _time.perf_counter()
+    try:
+        if _failpoint._active:
+            _failpoint.eval("device/delta_merge",
+                            lambda: _errors.DeviceError(
+                                "injected delta-merge kernel failure"))
+        tomb = np.full(m_cap, I64_MAX, np.int64)
+        tomb[:len(tomb_handles)] = tomb_handles
+        app_h = np.full(k_cap, I64_MAX, np.int64)
+        app_h[:len(app_handles)] = app_handles
+        app_lv = np.zeros(k_cap, bool)
+        app_lv[:len(app_handles)] = True
+        args = (jnp.asarray(np.asarray(handles, np.int64)),
+                jnp.asarray(np.asarray(live, bool)), jnp.asarray(tomb),
+                jnp.int64(len(tomb_handles)), jnp.asarray(app_h),
+                jnp.asarray(app_lv))
+        with dispatch_serial:
+            host = np.asarray(ent(*args))
+    except _errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash: typed, so the merge degrades to the
+        # host numpy plan (identical order) instead of erroring the scan
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(f"delta merge failed: {e}") from e
+    sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
+    sp.finish()
+    _tracing.record_dispatch(
+        readback_bytes=int(host.nbytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    n_live = int(host[-1])
+    return host[:-1].astype(np.int64)[:n_live]
+
+
 def device_live(batch: col.ColumnBatch):
     """Device-resident row-liveness plane, memoized on the batch. Passing
     a host numpy mask instead costs an H2D of capacity bytes on EVERY

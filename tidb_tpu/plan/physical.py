@@ -410,11 +410,17 @@ def _maybe_union_scan(scan, ds: DataSource, conditions, ctx: PhysicalContext):
 # ---------------------------------------------------------------------------
 
 def _pushable_scan(p: Plan):
-    """The scan an Aggregation may push into: a bare table scan with nothing
-    SQL-side between (residual filters break pushdown soundness). Virtual
-    scans have no coprocessor behind them — nothing pushes."""
+    """The scan an Aggregation may push into: a bare table scan — or a
+    COVERING (single-read) index scan, whose request carries every
+    referenced column in its key planes — with nothing SQL-side between
+    (residual filters break pushdown soundness). Virtual scans have no
+    coprocessor behind them — nothing pushes."""
     if isinstance(p, PhysicalTableScan) and not p.conditions \
             and not getattr(p, "virtual", False) \
+            and not p.aggregates and p.limit is None and not p.topn_pb:
+        return p
+    if isinstance(p, PhysicalIndexScan) and not p.double_read \
+            and not p.conditions and not getattr(p, "virtual", False) \
             and not p.aggregates and p.limit is None and not p.topn_pb:
         return p
     return None
@@ -465,21 +471,26 @@ def _stream_agg_applicable(agg: Aggregation, child: Plan) -> bool:
     return idx_names[:len(group_cols)] == group_cols
 
 
-def _try_push_aggregation(agg: Aggregation, scan: PhysicalTableScan,
+def _try_push_aggregation(agg: Aggregation, scan,
                           ctx: PhysicalContext) -> Plan | None:
+    # a covering index scan pushes through the INDEX request type — its
+    # key planes carry every referenced column (PR 11 residual b: index
+    # requests now answer with grouped partial STATES too)
+    req_tp = kv.REQ_TYPE_INDEX if isinstance(scan, PhysicalIndexScan) \
+        else kv.REQ_TYPE_SELECT
     pb_aggs = []
     for f in agg.agg_funcs:
-        pb = agg_func_to_pb(ctx.client, f, kv.REQ_TYPE_SELECT)
+        pb = agg_func_to_pb(ctx.client, f, req_tp)
         if pb is None:
             return None
         pb_aggs.append(pb)
     pb_groups = []
     for g in agg.group_by:
-        item = group_by_item_to_pb(ctx.client, g, kv.REQ_TYPE_SELECT)
+        item = group_by_item_to_pb(ctx.client, g, req_tp)
         if item is None:
             return None
         pb_groups.append(item)
-    if not ctx.client.support_request_type(kv.REQ_TYPE_SELECT,
+    if not ctx.client.support_request_type(req_tp,
                                            kv.REQ_SUB_TYPE_GROUP_BY):
         return None
 

@@ -1046,6 +1046,42 @@ class Session:
             if not enabled:
                 pc.clear()
 
+    def apply_tpu_delta_pack(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_delta_pack = 0|1 — the HTAP freshness
+        tier's kill switch: off drops every region delta pack and
+        restores invalidate-on-commit (the parity oracle for base+delta
+        merges); per-table commit filtering stays on either way."""
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        if value.strip().lower() not in ("0", "1", "on", "off", "true",
+                                         "false"):
+            raise errors.ExecError(
+                f"tidb_tpu_delta_pack must be 0 or 1, got {value!r}")
+        self._require_global_grant("tidb_tpu_delta_pack")
+        from tidb_tpu.copr.delta import delta_for
+        ds = delta_for(self.store)
+        if ds is not None:
+            ds.set_enabled(parse_bool_sysvar(value))
+
+    def apply_tpu_delta_budget_rows(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_delta_budget_rows = N — rows a region
+        delta pack may accrue before the next scan folds it into a fresh
+        base entry (the background re-pack trigger)."""
+        n = self._int_sysvar("tidb_tpu_delta_budget_rows", value, 1)
+        self._require_global_grant("tidb_tpu_delta_budget_rows")
+        from tidb_tpu.copr.delta import delta_for
+        ds = delta_for(self.store)
+        if ds is not None:
+            ds.budget_rows = n
+
+    def apply_slow_trace_max_spans(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_slow_trace_max_spans = N — per-entry span
+        budget of the flight recorder (0 = unbounded): oversized trees
+        keep the root + slowest subtrees and stamp truncated=true."""
+        n = self._int_sysvar("tidb_tpu_slow_trace_max_spans", value)
+        self._require_global_grant("tidb_tpu_slow_trace_max_spans")
+        from tidb_tpu import flight
+        flight.recorder_for(self.store).set_max_spans(n)
+
     def apply_tpu_micro_batch(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_micro_batch = 0|1 — the micro-batch tier
         kill switch: 0 pins every below-floor statement to the solo
@@ -1484,6 +1520,26 @@ def bootstrap(session: Session) -> None:
                     fr.set_cap(max(1, int(v.strip())))
             except ValueError:
                 pass
+            v = gv.values.get("tidb_tpu_slow_trace_max_spans")
+            try:
+                if v:
+                    fr.set_max_spans(max(0, int(v.strip())))
+            except ValueError:
+                pass
+            # the delta-pack tier hangs off the store's RPC handler like
+            # the plane cache — hydrate on every backend path
+            from tidb_tpu.copr.delta import delta_for
+            ds = delta_for(session.store)
+            if ds is not None:
+                v = gv.values.get("tidb_tpu_delta_pack")
+                if v is not None:
+                    ds.set_enabled(parse_bool_sysvar(v))
+                v = gv.values.get("tidb_tpu_delta_budget_rows")
+                try:
+                    if v:
+                        ds.budget_rows = max(1, int(v.strip()))
+                except ValueError:
+                    pass
             from tidb_tpu.metrics.timeseries import recorder as _tsrec
             v = gv.values.get("tidb_tpu_metrics_interval_ms")
             try:

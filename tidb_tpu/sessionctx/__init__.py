@@ -67,6 +67,16 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_tpu_plane_cache": "1",
     # plane-cache byte budget (LRU evicts past it); GLOBAL-only
     "tidb_tpu_plane_cache_bytes": "268435456",
+    # HTAP freshness tier (copr.delta): region-side append-only delta
+    # packs over cached base planes. Kill switch 0 restores the PR-5
+    # behavior (any table commit orphans that table's cached planes;
+    # per-table commit filtering stays on either way) — the parity
+    # oracle for delta-merge correctness. Budget: when a pack's delta
+    # exceeds this many rows, the next scan folds base+delta into a
+    # fresh base entry and resets the pack (background re-pack).
+    # GLOBAL-only, store-level, hydrated on restart.
+    "tidb_tpu_delta_pack": "1",
+    "tidb_tpu_delta_budget_rows": "4096",
     # mesh execution tier (ops.mesh) kill switch: 0 pins the partial-
     # aggregate combine and the join probe to the single-device kernels
     # (the first degradation rung) while everything else keeps routing.
@@ -125,6 +135,11 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     "tidb_tpu_flight_recorder": "1",
     # retained slow traces kept per store (bounded ring). GLOBAL-only.
     "tidb_tpu_slow_trace_cap": "64",
+    # per-entry span budget for retained traces: a pathological fan-out
+    # (thousands of region tasks × kernel spans) is truncated to this
+    # many spans — the root plus the slowest subtrees survive, the entry
+    # stamps truncated=true in TRACE_JSON. 0 = unbounded. GLOBAL-only.
+    "tidb_tpu_slow_trace_max_spans": "512",
     # metrics time-series recorder (metrics.timeseries): sampling
     # interval in ms and samples retained — the history behind
     # information_schema.TIDB_TPU_METRICS_HISTORY and the inspection

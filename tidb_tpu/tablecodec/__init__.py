@@ -56,6 +56,21 @@ def table_prefix(table_id: int) -> bytes:
     return TABLE_PREFIX + _enc_int(table_id)
 
 
+TABLE_PREFIX_LEN = 10   # 't' + enc_int(table_id)
+META_BUCKET = b"m"
+
+
+def table_prefix_of(key: bytes) -> bytes:
+    """Table-prefix bucket of one encoded key: the 10-byte
+    't' + enc_int(table_id) prefix shared by a table's record AND index
+    keys, or META_BUCKET for meta/non-table keys — THE bucketing rule of
+    per-table commit filtering (cluster mvcc, localstore, copr.delta all
+    share this one definition)."""
+    if key[:1] == TABLE_PREFIX and len(key) >= TABLE_PREFIX_LEN:
+        return bytes(key[:TABLE_PREFIX_LEN])
+    return META_BUCKET
+
+
 enc_handle = _enc_int  # handles use the same comparable-int key layout
 
 
