@@ -800,6 +800,195 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
     }
 
 
+MULTIQ_Q5_SQL = (
+    "select l_nation, count(*), sum(l_qty), min(l_price), max(l_price) "
+    "from lineitem join nation on l_flag = n_flag and l_status = n_status "
+    "where l_ship < 300 group by l_nation")
+MULTIQ_Q3_SQL = (
+    "select l_nation, l_price, l_qty from lineitem "
+    "join nation on l_flag = n_flag and l_status = n_status "
+    "where l_ship < 300 order by l_nation, l_price desc limit 10")
+
+
+def measure_multiq(n_rows: int, n_regions: int, runs: int,
+                   floor: int | None = None):
+    """TPC-H-q3/q5-shaped MULTI-KEY STRING joins over the 4-region
+    cluster store — the device dictionary execution tier's headline
+    regime (copr.dictionary): both queries join on a composite
+    (varchar, varchar) key lowered to key-tuple codes over shared
+    dictionary domains, the q5 shape groups by a string column riding
+    the same codes, and the q3 shape orders by DICTIONARY RANK through
+    the join→TopN plane path without materializing rows. Asserts the
+    run is fully columnar (multiq_fallbacks == 0, zero degraded_dict,
+    composite keys on the device join path via the remap kernel) with
+    row-for-row parity against BOTH the kill-switch dict path
+    (tidb_tpu_device_dict = 0) and a vectorized numpy oracle computing
+    the same queries over pre-encoded planes per run."""
+    import numpy as np
+
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    store = new_store(f"cluster://3/benchmq{n_rows}")
+    s = Session(store)
+    s.execute("create database mq")
+    s.execute("use mq")
+    s.execute("create table lineitem (l_id bigint primary key, "
+              "l_flag varchar(4), l_status varchar(4), "
+              "l_nation varchar(16), l_qty bigint, l_price bigint, "
+              "l_ship bigint)")
+    s.execute("create table nation (n_id bigint primary key, "
+              "n_flag varchar(4), n_status varchar(4), n_disc bigint)")
+    flags = ("A", "N", "R")
+    stats_ = ("F", "O")
+    nations = ("ALGERIA", "BRAZIL", "CANADA", "EGYPT", "FRANCE",
+               "GERMANY", "INDIA", "JAPAN")
+    tbl = s.info_schema().table_by_name("mq", "lineitem")
+    rows = [[Datum.i64(i), Datum.string(flags[i % 3]),
+             Datum.string(stats_[i % 2]), Datum.string(nations[i % 8]),
+             Datum.i64(i % 50), Datum.i64(900 + (i * 7) % 1000),
+             Datum.i64(i % 365)]
+            for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    # one nation row per (flag, status) combo: FK-shaped composite key
+    drows = ", ".join(
+        f"({i}, '{f}', '{st}', {i * 3})"
+        for i, (f, st) in enumerate((f, st) for f in flags
+                                    for st in stats_))
+    s.execute(f"insert into nation values {drows}")
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+    if floor is not None:
+        s.execute(f"set global tidb_tpu_dispatch_floor = {floor}")
+
+    fbs = metrics.counter("distsql.columnar_fallbacks")
+    jk = metrics.counter("copr.dict.join_keys")
+    dr = metrics.counter("copr.dict.device_remaps")
+    tp = metrics.counter("copr.dict.topn_plane")
+    dd = metrics.counter("copr.degraded_dict")
+    s.execute(MULTIQ_Q5_SQL)              # warm (pack + dicts + jit)
+    s.execute(MULTIQ_Q3_SQL)
+    f0, j0, d0, t0c, g0 = (fbs.value, jk.value, dr.value, tp.value,
+                           dd.value)
+    t0 = time.time()
+    for _ in range(runs):
+        q5_col = s.execute(MULTIQ_Q5_SQL)[0].values()
+        q3_col = s.execute(MULTIQ_Q3_SQL)[0].values()
+    t_col = (time.time() - t0) / (2 * runs)
+    d_fbs = fbs.value - f0
+    d_jk = jk.value - j0
+    d_dr = dr.value - d0
+    d_tp = tp.value - t0c
+    assert d_fbs == 0, f"multiq counted {d_fbs} columnar fallbacks"
+    assert dd.value == g0, "multiq degraded to the dict path"
+    assert d_jk >= 2 * runs, \
+        f"only {d_jk} joins rode composite key-tuple codes"
+    assert d_dr >= 2 * runs, \
+        (f"only {d_dr} device remap dispatches — composite keys did not "
+         f"ride the device join path")
+    assert d_tp >= runs, \
+        "join→TopN never took the dictionary-rank plane path"
+
+    # kill-switch regime: the row-at-a-time dict path is the oracle
+    s.execute("set global tidb_tpu_device_dict = 0")
+    try:
+        s.execute(MULTIQ_Q5_SQL)          # warm the dict regime
+        s.execute(MULTIQ_Q3_SQL)
+        t0 = time.time()
+        for _ in range(runs):
+            q5_dict = s.execute(MULTIQ_Q5_SQL)[0].values()
+            q3_dict = s.execute(MULTIQ_Q3_SQL)[0].values()
+        t_dict = (time.time() - t0) / (2 * runs)
+    finally:
+        s.execute("set global tidb_tpu_device_dict = 1")
+
+    def norm(rows_):
+        return [tuple(a.decode() if isinstance(a, bytes) else a
+                      for a in r) for r in rows_]
+
+    assert norm(q5_col) == norm(q5_dict), "multiq q5 parity vs dict path"
+    assert norm(q3_col) == norm(q3_dict), "multiq q3 parity vs dict path"
+
+    # vectorized numpy oracle over pre-encoded planes (the pack-time
+    # analog): per run it evaluates the filter, builds the composite
+    # keys, joins via sort+searchsorted, and computes the group-by /
+    # top-n — the honest host baseline for the dictionary tier
+    lf = np.array([flags[i % 3] for i in range(1, n_rows + 1)])
+    ls = np.array([stats_[i % 2] for i in range(1, n_rows + 1)])
+    ln = np.array([nations[i % 8] for i in range(1, n_rows + 1)])
+    lq = np.arange(1, n_rows + 1, dtype=np.int64) % 50
+    lp = 900 + (np.arange(1, n_rows + 1, dtype=np.int64) * 7) % 1000
+    lsh = np.arange(1, n_rows + 1, dtype=np.int64) % 365
+    combos = [(f, st) for f in flags for st in stats_]
+    nf = np.array([f for f, _ in combos])
+    ns = np.array([st for _, st in combos])
+    # shared dictionary codes (what the registry provides the engine)
+    fu = np.unique(np.concatenate([lf, nf]))
+    su = np.unique(np.concatenate([ls, ns]))
+    nu = np.unique(ln)
+    lfc = np.searchsorted(fu, lf)
+    lsc = np.searchsorted(su, ls)
+    lnc = np.searchsorted(nu, ln)
+    nfc = np.searchsorted(fu, nf)
+    nsc = np.searchsorted(su, ns)
+
+    def oracle_run():
+        m = lsh < 300
+        lkey = lfc * len(su) + lsc
+        rkey = nfc * len(su) + nsc
+        order = np.argsort(rkey, kind="stable")
+        rs = rkey[order]
+        pos = np.searchsorted(rs, lkey)
+        posc = np.clip(pos, 0, len(rs) - 1)
+        matched = m & (rs[posc] == lkey)
+        # q5: group by nation over the matched rows
+        g = lnc[matched]
+        cnt = np.bincount(g, minlength=len(nu))
+        qty = np.bincount(g, weights=lq[matched].astype(np.float64),
+                          minlength=len(nu))
+        price = lp[matched]
+        mn = np.full(len(nu), np.iinfo(np.int64).max, np.int64)
+        np.minimum.at(mn, g, price)
+        mx = np.full(len(nu), np.iinfo(np.int64).min, np.int64)
+        np.maximum.at(mx, g, price)
+        q5 = [(nu[i], int(cnt[i]), int(qty[i]), int(mn[i]), int(mx[i]))
+              for i in range(len(nu)) if cnt[i]]
+        # q3: order by (nation asc, price desc, scan position) limit 10
+        idx = np.flatnonzero(matched)
+        top = idx[np.lexsort([idx, -lp[idx], lnc[idx]])][:10]
+        q3 = [(ln[i], int(lp[i]), int(lq[i])) for i in top.tolist()]
+        return q5, q3
+
+    q5_o, q3_o = oracle_run()     # warm + parity sample
+    t0 = time.time()
+    for _ in range(runs):
+        oracle_run()
+    t_oracle = (time.time() - t0) / (2 * runs)
+    got5 = sorted((r[0], r[1], int(r[2]), int(r[3]), int(r[4]))
+                  for r in norm(q5_col))
+    assert got5 == sorted(q5_o), "multiq q5 parity vs numpy oracle"
+    got3 = [(r[0], int(r[1]), int(r[2])) for r in norm(q3_col)]
+    assert got3 == q3_o, "multiq q3 parity vs numpy oracle"
+    return {
+        "multiq_rows_per_sec": round(n_rows / t_col, 1),
+        "multiq_vs_numpy_oracle": round(t_oracle / t_col, 2),
+        "multiq_fallbacks": d_fbs,
+        "multiq_regions": n_regions,
+        "multiq_dict_joins": d_jk,
+        "multiq_device_remaps": d_dr,
+        "multiq_topn_plane": d_tp,
+        "multiq_speedup_vs_dict_path": round(t_dict / t_col, 2),
+    }
+
+
 HTAP_SQL = "select count(*), sum(v), min(v), max(v) from ht where k < 6"
 
 
@@ -1646,6 +1835,21 @@ def main(smoke: bool = False):
           f"{q1p_figs['q1_pushdown_fallbacks']} fallbacks, states/rows "
           f"wire bytes {q1p_figs['q1_states_bytes_vs_rows_bytes']}",
           file=sys.stderr)
+    # multi-key string-join regime: TPC-H-q3/q5-shaped joins on
+    # composite (varchar, varchar) keys riding the dictionary tier's
+    # key-tuple codes (device remap kernel at floor 0 so the smoke rig
+    # exercises the device join path too)
+    mqr = 6_000 if smoke else 120_000
+    mq_figs = measure_multiq(mqr, n_regions=4, runs=runs, floor=0)
+    print(f"# multiq ({mqr / 1000:.0f}k rows x "
+          f"{mq_figs['multiq_regions']} regions, composite string keys): "
+          f"{mq_figs['multiq_rows_per_sec']:,.0f} rows/s columnar "
+          f"({mq_figs['multiq_speedup_vs_dict_path']:.2f}x the dict "
+          f"path, {mq_figs['multiq_vs_numpy_oracle']:.2f}x vs numpy "
+          f"oracle), {mq_figs['multiq_dict_joins']} dict joins / "
+          f"{mq_figs['multiq_device_remaps']} device remaps / "
+          f"{mq_figs['multiq_topn_plane']} plane TopNs, "
+          f"{mq_figs['multiq_fallbacks']} fallbacks", file=sys.stderr)
     # HTAP freshness regime: OLTP commits interleaved with repeat fan-out
     # scans — cached planes stay warm through region delta packs + device
     # base+delta merges; the kill-switch regime is the collapse oracle
@@ -1730,6 +1934,7 @@ def main(smoke: bool = False):
         **e2e_figs,
         **fan_figs,
         **q1p_figs,
+        **mq_figs,
         **htap_figs,
         "q1_mesh_rows_per_sec": q1_mesh_rps,
         "mesh_devices": len(jax.devices()),

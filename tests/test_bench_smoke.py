@@ -69,6 +69,19 @@ def test_bench_smoke_emits_valid_json():
     assert out["q1_pushdown_state_fusions"] >= 1
     assert out["q1_states_bytes_vs_rows_bytes"] is not None \
         and out["q1_states_bytes_vs_rows_bytes"] > 0
+    # the multi-key string-join regime: q3/q5-shaped joins on composite
+    # (varchar, varchar) keys ride the dictionary tier fully columnar —
+    # zero fallbacks, the device remap kernel built the key-tuple codes,
+    # and join→TopN ordered by dictionary rank (parity vs the
+    # kill-switch dict path and the numpy oracle asserted inside the
+    # bench itself)
+    assert out["multiq_rows_per_sec"] > 0
+    assert out["multiq_regions"] == 4
+    assert out["multiq_fallbacks"] == 0
+    assert out["multiq_dict_joins"] >= 2
+    assert out["multiq_device_remaps"] >= 2
+    assert out["multiq_topn_plane"] >= 1
+    assert out["multiq_vs_numpy_oracle"] > 0
     # the HTAP freshness regime: commits interleaved with repeat fan-out
     # scans keep the plane cache hot through region delta packs + device
     # base+delta merges (parity vs the row protocol and the commit-to-

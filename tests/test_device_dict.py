@@ -1,0 +1,360 @@
+"""Differential suite for the device dictionary execution tier
+(copr.dictionary): cluster-wide versioned string dictionaries + composite
+key-tuple codes for string / multi-key equi-joins.
+
+Every regime is judged against the kill-switch oracle (SET GLOBAL
+tidb_tpu_device_dict = 0 pins the row-at-a-time dict path) row-for-row,
+including emission order. Covered edges: the collation matrix (binary
+rides, *_ci bails counted), NULL keys on both sides under INNER and LEFT
+OUTER, the high-NDV ratio bail (tidb_tpu_dict_max_ndv), dictionary
+version churn mid-workload (commits extending the append-only global
+dictionaries between scans), the device/dict_remap failpoint degrading
+to the dict path with unchanged answers under a seeded chaos schedule,
+join→TopN by dictionary rank, DISTINCT over code planes, the micro-batch
+scalar-aggregate slot kind (PR 9 residual a), and the pre-decoded delta
+plane cache (PR 13 residual b).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+import pytest
+
+from tidb_tpu import failpoint, metrics, tablecodec as tc
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+JOIN_QUERIES = [
+    # composite (varchar, varchar) key, inner + outer
+    "select count(*), sum(v), min(dv), max(dv) from t "
+    "join dim on f = df and g = dg",
+    "select count(*), sum(v), sum(dv) from t "
+    "left join dim on f = df and g = dg",
+    # single string key
+    "select count(*), sum(v) from t join dim on f = df",
+    # mixed string + int composite key
+    "select count(*), max(dv) from t join dim on f = df and v = dv",
+    # string group-by over the join (codes through fused_agg)
+    "select f, count(*), sum(v) from t join dim on f = df and g = dg "
+    "group by f",
+    # join→TopN ordered by dictionary rank (string primary key, desc
+    # numeric tiebreak) — no row materialization on the device path
+    "select f, g, v from t join dim on f = df and g = dg "
+    "order by f, v desc limit 9",
+    "select f, v from t join dim on f = df and g = dg "
+    "order by f desc, v limit 7",
+    # DISTINCT over the join's code planes
+    "select distinct f, g from t join dim on f = df and g = dg",
+]
+
+
+def _c(name: str) -> int:
+    return metrics.counter(name).value
+
+
+def _build(n_regions: int = 4, ci: bool = False):
+    store = new_store(f"cluster://3/devdict{next(_id)}")
+    s = Session(store)
+    s.execute("create database dd")
+    s.execute("use dd")
+    coll = " collate utf8_general_ci" if ci else ""
+    s.execute(f"create table t (id bigint primary key, "
+              f"f varchar(8){coll}, g varchar(8){coll}, v bigint)")
+    s.execute(f"create table dim (k bigint primary key, "
+              f"df varchar(8){coll}, dg varchar(8){coll}, dv bigint)")
+    flags = ("AA", "NN", "RR", "QQ")
+    stats = ("F", "O")
+    rows = ", ".join(
+        f"({i}, '{flags[i % 4]}', '{stats[i % 2]}', {i * 3})"
+        if i % 9 else f"({i}, null, '{stats[i % 2]}', {i * 3})"
+        for i in range(1, N_ROWS + 1))
+    s.execute(f"insert into t values {rows}")
+    drows = ", ".join(
+        f"({i}, '{f}', '{st}', {i * 7})"
+        for i, (f, st) in enumerate(
+            (f, st) for f in flags + ("ZZ",) for st in stats))
+    s.execute(f"insert into dim values {drows}")
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("dd", "t").info.id
+        step = N_ROWS // n_regions
+        store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _all(s) -> list:
+    return [s.execute(q)[0].values() for q in JOIN_QUERIES]
+
+
+def _oracle(s) -> list:
+    s.execute("set global tidb_tpu_device_dict = 0")
+    try:
+        return _all(s)
+    finally:
+        s.execute("set global tidb_tpu_device_dict = 1")
+
+
+def test_dict_join_parity_all_shapes():
+    """Every covered join shape — composite/single/mixed keys, outer
+    pads, NULL keys, group-by, TopN-by-rank, DISTINCT — must match the
+    dict path row-for-row INCLUDING order, and must actually ride the
+    tier (join-keys counter moves, zero degraded_dict)."""
+    s = _build()
+    jk0, dd0 = _c("copr.dict.join_keys"), _c("copr.degraded_dict")
+    got = _all(s)
+    assert _c("copr.dict.join_keys") - jk0 >= len(JOIN_QUERIES), \
+        "joins did not route through composite key-tuple codes"
+    assert _c("copr.degraded_dict") == dd0
+    want = _oracle(s)
+    for q, a, b in zip(JOIN_QUERIES, got, want):
+        assert a == b, f"parity vs dict path: {q}"
+
+
+def test_device_route_builds_keys_on_device():
+    """At floor 0 the composite codes build through the device remap
+    kernel (one dispatch per side, no readback) and the probe runs the
+    device build/probe kernels — answers unchanged."""
+    s = _build()
+    s.execute("set global tidb_tpu_dispatch_floor = 0")
+    dr0 = _c("copr.dict.device_remaps")
+    got = _all(s)
+    assert _c("copr.dict.device_remaps") - dr0 >= 2, \
+        "device remap kernel never dispatched at floor 0"
+    assert got == _oracle(s)
+
+
+def test_ci_collation_bails_counted():
+    """The collation matrix: *_ci keys bail to the dict path (its codec
+    keys carry the casefold), counted on copr.degraded_dict — answers
+    are the dict path's by construction."""
+    s = _build(ci=True)
+    dd0 = _c("copr.degraded_dict")
+    jk0 = _c("copr.dict.join_keys")
+    got = s.execute(JOIN_QUERIES[0])[0].values()
+    assert _c("copr.degraded_dict") > dd0
+    assert _c("copr.dict.join_keys") == jk0
+    assert got == _oracle_one(s, JOIN_QUERIES[0])
+    # and ci values actually merge case-insensitively (the semantics the
+    # tier must NOT break by taking these joins)
+    s.execute("insert into t values (9001, 'aa', 'f', 1)")
+    a = s.execute("select count(*) from t join dim on f = df")[0].values()
+    assert a == _oracle_one(s, "select count(*) from t join dim "
+                               "on f = df")
+
+
+def _oracle_one(s, q):
+    s.execute("set global tidb_tpu_device_dict = 0")
+    try:
+        return s.execute(q)[0].values()
+    finally:
+        s.execute("set global tidb_tpu_device_dict = 1")
+
+
+def test_high_ndv_bails_counted():
+    """A string key whose distinct/rows ratio exceeds
+    tidb_tpu_dict_max_ndv bails to the dict path, counted — and the
+    registry refuses the column (rejected_ndv)."""
+    s = _build()
+    # every row a distinct key value, far above any sane ratio
+    s.execute("create table hn (id bigint primary key, u varchar(16))")
+    s.execute("create table hd (id bigint primary key, du varchar(16))")
+    rows = ", ".join(f"({i}, 'u{i:05d}')" for i in range(1, 201))
+    s.execute(f"insert into hn values {rows}")
+    s.execute(f"insert into hd values {rows.replace('u', 'x')}")
+    s.execute("set global tidb_tpu_dict_max_ndv = 0.01")
+    try:
+        dd0 = _c("copr.degraded_dict")
+        q = "select count(*) from hn join hd on u = du"
+        got = s.execute(q)[0].values()
+        assert _c("copr.degraded_dict") > dd0, "high NDV not counted"
+        assert got == _oracle_one(s, q)
+    finally:
+        s.execute("set global tidb_tpu_dict_max_ndv = 0.5")
+
+
+def test_dictionary_version_churn_extends_append_only():
+    """Commits that add new strings EXTEND the global dictionaries
+    (append-only codes — delta entries counted) instead of invalidating;
+    repeat joins stay exact across the churn."""
+    from tidb_tpu.copr.dictionary import registry_for
+    s = _build()
+    got = _all(s)
+    assert got == _oracle(s)
+    reg = registry_for(s.store)
+    assert reg is not None and len(reg) > 0, "nothing registered"
+    tid = s.info_schema().table_by_name("dd", "t").info.id
+    fcol = next(c for c in s.info_schema()
+                .table_by_name("dd", "t").info.columns if c.name == "f")
+    gd = reg.get(tid, fcol.id)
+    assert gd is not None
+    base_len = len(gd)
+    de0 = _c("copr.dict.delta_entries")
+    for i in range(3):
+        s.execute(f"insert into t values ({9100 + i}, 'WW{i}', 'F', 1)")
+        got = _all(s)
+        assert got == _oracle(s), f"churn round {i} diverged"
+    gd2 = reg.get(tid, fcol.id)
+    assert gd2 is gd, "churn rebuilt the dictionary instead of extending"
+    assert len(gd2) >= base_len + 3
+    assert gd2.entries[:base_len] == gd.entries[:base_len]
+    assert _c("copr.dict.delta_entries") - de0 >= 3
+
+
+def test_dict_remap_failpoint_degrades_with_chaos():
+    """device/dict_remap prob-failpoint under concurrent fan-out readers
+    at floor 0: every fault degrades to the dict path with unchanged
+    answers, counted on copr.degraded_dict."""
+    s = _build()
+    s.execute("set global tidb_tpu_dispatch_floor = 0")
+    want = _oracle(s)
+    dd0 = _c("copr.degraded_dict")
+    failpoint.enable("device/dict_remap", when=("prob", 0.5), seed=7)
+    try:
+        errs: list = []
+
+        def reader(seed: int):
+            try:
+                sess = Session(s.store)
+                sess.execute("use dd")
+                for q, w in zip(JOIN_QUERIES, want):
+                    got = sess.execute(q)[0].values()
+                    if got != w:
+                        errs.append((q, got, w))
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=reader, args=(i,)) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs[:2]
+    finally:
+        failpoint.disable("device/dict_remap")
+    assert _c("copr.degraded_dict") > dd0, \
+        "chaos schedule never fired the remap failpoint"
+    # clean after disable
+    assert _all(s) == want
+
+
+def test_kill_switch_is_global_only_and_persisted():
+    s = _build(n_regions=1)
+    with pytest.raises(Exception):
+        s.execute("set tidb_tpu_device_dict = 0")
+    s.execute("set global tidb_tpu_device_dict = 0")
+    assert s.execute("select @@tidb_tpu_device_dict")[0].values() \
+        in ([["0"]], [[b"0"]], [[0]])
+    jk0 = _c("copr.dict.join_keys")
+    s.execute(JOIN_QUERIES[0])
+    assert _c("copr.dict.join_keys") == jk0, "kill switch ignored"
+    s.execute("set global tidb_tpu_device_dict = 1")
+    with pytest.raises(Exception):
+        s.execute("set global tidb_tpu_dict_max_ndv = 7")
+
+
+def test_topn_and_distinct_plane_counters_and_null_order():
+    """The plane TopN keeps MySQL NULL ordering (asc → first, desc →
+    last) and the stable scan-position tiebreak; DISTINCT treats NULL as
+    one value. Both counted."""
+    s = _build()
+    tp0, dp0 = _c("copr.dict.topn_plane"), _c("copr.dict.distinct_plane")
+    qs = [
+        "select f, v from t join dim on g = dg order by f limit 12",
+        "select f, v from t join dim on g = dg order by f desc limit 12",
+        "select distinct f from t join dim on g = dg",
+    ]
+    got = [s.execute(q)[0].values() for q in qs]
+    assert _c("copr.dict.topn_plane") - tp0 >= 2
+    assert _c("copr.dict.distinct_plane") - dp0 >= 1
+    s.execute("set global tidb_tpu_device_dict = 0")
+    try:
+        want = [s.execute(q)[0].values() for q in qs]
+    finally:
+        s.execute("set global tidb_tpu_device_dict = 1")
+    for q, a, b in zip(qs, got, want):
+        assert a == b, f"plane TopN/DISTINCT parity: {q}"
+
+
+def test_micro_batch_agg_slot_kind_parity():
+    """PR 9 residual a: concurrent below-floor SCALAR aggregates batch
+    as per-slot masked reductions — answers identical to the solo (kill
+    switch) route, counted on sched.batched_agg_statements."""
+    from tidb_tpu.ops.client import TpuClient
+    store = new_store(f"memory://devdictagg{next(_id)}")
+    s = Session(store)
+    s.execute("create database ba")
+    s.execute("use ba")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v bigint, f varchar(4), d decimal(10,2), x double)")
+    rows = ", ".join(
+        f"({i}, {i % 7}, {i * 3}, '{'ANRQ'[i % 4]}', {i % 50}.25, "
+        f"{i % 11}.5)" for i in range(1, 1201))
+    s.execute(f"insert into t values {rows}")
+    store.set_client(TpuClient(store))
+    s.execute("set global tidb_tpu_batch_window_ms = 30")
+    sqls = [
+        "select count(*), sum(v), min(v), max(v) from t where k < 5",
+        "select count(*), sum(d), min(d), max(d) from t where k < 5",
+        "select min(f), max(f), count(f) from t where k < 5",
+        "select avg(v), min(x), max(x) from t where k < 5",
+        "select count(*) from t where k > 99",    # empty result set
+    ]
+    for q in sqls:
+        s.execute(q)        # warm: pack + cache the batches
+
+    def run_all():
+        out = {}
+
+        def w(i, sql):
+            sess = Session(store)
+            sess.execute("use ba")
+            out[i] = tuple(map(tuple, sess.execute(sql)[0].values()))
+
+        ts = [threading.Thread(target=w, args=(i, sql))
+              for i, sql in enumerate(sqls * 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return out
+
+    b0 = _c("sched.batched_agg_statements")
+    got = run_all()
+    assert _c("sched.batched_agg_statements") - b0 > 0, \
+        "scalar aggregates never rode the batched slot kind"
+    s.execute("set global tidb_tpu_micro_batch = 0")
+    try:
+        want = run_all()
+    finally:
+        s.execute("set global tidb_tpu_micro_batch = 1")
+    assert got == want
+
+
+def test_delta_decode_reuse_counter():
+    """PR 13 residual b: repeat merges over an unchanged delta pack
+    generation reuse the pre-decoded appended-row planes instead of
+    re-decoding per scan. The cache/no_admit failpoint keeps the merged
+    batch out of the plane cache, so every scan at the current version
+    re-merges the same generation — the second one must reuse."""
+    s = _build(n_regions=2)
+    q = "select count(*), sum(v) from t where v >= 0"
+    s.execute(q)                                 # cache base planes
+    s.execute("insert into t values (9500, 'AA', 'F', 42)")  # delta
+    failpoint.enable("cache/no_admit", action="return", value=True)
+    try:
+        m0 = _c("copr.delta.merges")
+        first = s.execute(q)[0].values()         # merge #1: decodes
+        assert _c("copr.delta.merges") > m0
+        r0 = _c("copr.delta.decode_reuse")
+        again = s.execute(q)[0].values()         # merge #2: reuses
+        assert again == first
+        assert _c("copr.delta.decode_reuse") > r0, \
+            "repeat merge re-decoded an unchanged pack generation"
+    finally:
+        failpoint.disable("cache/no_admit")

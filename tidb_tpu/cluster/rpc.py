@@ -78,6 +78,13 @@ class RpcHandler:
         # orphaning the cache; scans merge base+delta device-side
         from tidb_tpu.copr.delta import DeltaStore
         self.delta_store = DeltaStore(self.plane_cache)
+        # device dictionary execution tier (copr.dictionary): the
+        # per-(table, column) versioned global string dictionaries live
+        # beside the plane cache — low-NDV string columns register at
+        # pack time, so codes are stable across regions and responses
+        # ship dictionary deltas instead of whole dictionaries
+        from tidb_tpu.copr.dictionary import DictRegistry
+        self.dict_registry = DictRegistry()
         # per-region access heat (server-side, like TiKV's hot-region
         # flow statistics): time-decayed read/write row+byte windows fed
         # from request completion — the placement signal
@@ -220,7 +227,8 @@ class RpcHandler:
             resp = handle_columnar_scan(
                 snapshot, sel, clipped,
                 region=(ctx.region_id, region.epoch()),
-                cache=self.plane_cache, delta=self.delta_store)
+                cache=self.plane_cache, delta=self.delta_store,
+                dicts=self.dict_registry)
             if resp is not None:
                 self._record_copr_heat(ctx.region_id, resp)
                 return resp

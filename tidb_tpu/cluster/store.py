@@ -140,6 +140,14 @@ class DistCoprClient(kv.Client):
         self.device_join = store_bool_sysvar(store, "tidb_tpu_device_join")
         self.dispatch_floor_rows = store_int_sysvar(
             store, "tidb_tpu_dispatch_floor")
+        # dictionary execution tier: the same executor-layer contract as
+        # device_join — HashJoinExec reads these off the store client so
+        # string/multi-key joins over the fan-out's columnar planes ride
+        # composite key-tuple codes (kill switch + NDV ratio gate)
+        from tidb_tpu.sessionctx import store_float_sysvar
+        self.device_dict = store_bool_sysvar(store, "tidb_tpu_device_dict")
+        self.dict_max_ndv = store_float_sysvar(store,
+                                               "tidb_tpu_dict_max_ndv")
 
     @property
     def mesh(self):

@@ -50,7 +50,8 @@ STATES_DEVICE_FLOOR = 4096
 
 def handle_columnar_scan(snapshot, sel: SelectRequest,
                          ranges: list[KeyRange], region=None,
-                         cache=None, delta=None) -> SelectResponse | None:
+                         cache=None, delta=None,
+                         dicts=None) -> SelectResponse | None:
     """One region's share of a columnar_hint request as a columnar
     partial, or None → the caller runs the row handler for this region.
 
@@ -180,6 +181,19 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
                                         prefix) == version:
                     cache.insert(base_key, region[1], version, batch,
                                  cache_info)
+        if dicts is not None:
+            # device dictionary execution tier (copr.dictionary): every
+            # low-NDV string column registers its batch dictionary into
+            # the per-(table, column) versioned GLOBAL dictionary at
+            # pack time — codes become stable across regions/versions,
+            # responses ship only dictionary DELTAS, and the join/TopN/
+            # group tiers read shared code domains instead of bytes.
+            # Invalidation keys on each COLUMN's own shape signature +
+            # the per-table version (a MODIFY COLUMN rebuilds; a
+            # version advance extends append-only).
+            table_id = pack_key[1] if is_index else pack_key
+            dicts.register_batch(batch, columns, table_id,
+                                 version if version is not None else 0)
         with tracing.trace("filter") as fsp:
             if failpoint._active:
                 failpoint.eval("copr/filter", lambda: errors.TypeError_(

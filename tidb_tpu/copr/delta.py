@@ -93,7 +93,7 @@ class DeltaPack:
     None the delete tombstone."""
 
     __slots__ = ("region_id", "table_id", "entries", "rows", "nbytes",
-                 "ts_counts")
+                 "ts_counts", "gen")
 
     def __init__(self, region_id: int, table_id: int):
         self.region_id = region_id
@@ -102,11 +102,15 @@ class DeltaPack:
         self.rows = 0
         self.nbytes = 0
         self.ts_counts: Counter = Counter()         # commit_ts → entries
+        self.gen = 0        # bumps per append — the pre-decoded plane
+        #                     cache's staleness key (decode once per
+        #                     pack GENERATION, not per scan)
 
     def append(self, commit_ts: int, rows: list) -> None:
         self.entries.append((commit_ts, rows))
         self.ts_counts[commit_ts] += 1
         self.rows += len(rows)
+        self.gen += 1
         self.nbytes += sum(len(r[1]) + 16 if r[1] is not None else 16
                            for r in rows)
 
@@ -123,6 +127,11 @@ class DeltaStore:
         self.budget_rows = DEFAULT_BUDGET_ROWS
         self._lock = threading.Lock()
         self._packs: dict[tuple[int, int], DeltaPack] = {}
+        # pre-decoded delta planes: (region, table, pack gen, window,
+        # columns sig, range bounds) → the decoded appended-row planes —
+        # repeat scans over an unchanged pack generation skip the
+        # host-side row decode entirely (counted copr.delta.decode_reuse)
+        self._decoded: dict[tuple, tuple] = {}
         self._rows = 0
         self._bytes = 0
         _instances.add(self)
@@ -140,6 +149,7 @@ class DeltaStore:
     def clear(self) -> None:
         with self._lock:
             self._packs.clear()
+            self._decoded.clear()
             self._rows = self._bytes = 0
         _update_gauges()
 
@@ -245,6 +255,9 @@ class DeltaStore:
         if pack is not None:
             self._rows -= pack.rows
             self._bytes -= pack.nbytes
+        for k in [k for k in self._decoded
+                  if k[0] == region_id and k[1] == table_id]:
+            del self._decoded[k]
 
     def reset(self, region_id: int, table_id: int) -> None:
         """Fold complete: the merged batch became the new base entry, the
@@ -306,6 +319,7 @@ class DeltaStore:
             pack = self._packs.get((region_id, table_id))
             if pack is None:
                 return None
+            gen = pack.gen
             remaining = Counter(need)
             picked: list[list] = []
             for ts, rows in pack.entries:
@@ -324,17 +338,42 @@ class DeltaStore:
             # the base IS the current pack — serve it unchanged
             metrics.counter("copr.delta.merges").inc()
             return base
-        row_key = tc.encode_row_key
-        in_range = (lambda k: any(rg.start <= k and
-                                  (rg.end is None or k < rg.end)
-                                  for rg in ranges))
-        tomb = np.fromiter(sorted(final), dtype=np.int64,
-                           count=len(final))
-        puts = sorted((h, v) for h, v in final.items()
-                      if v is not None and
-                      in_range(row_key(table_id, h)))
+        # pre-decoded delta plane cache: the appended rows' decode
+        # (tc.decode_row + datum_to_phys per cell) is invariant for a
+        # given pack GENERATION × visibility window × schema × ranges —
+        # repeat scans (the dashboard shape that hits the merge path
+        # every time) reuse it instead of re-decoding per merge
+        from tidb_tpu.copr.columnar_region import _columns_sig
+        dec_key = (region_id, table_id, gen, base_version, version,
+                   _columns_sig(columns),
+                   tuple((rg.start, rg.end) for rg in ranges))
+        with self._lock:
+            dec = self._decoded.get(dec_key)
+        if dec is not None:
+            metrics.counter("copr.delta.decode_reuse").inc()
+            tomb, app_handles, raw, ok = dec
+        else:
+            row_key = tc.encode_row_key
+            in_range = (lambda k: any(rg.start <= k and
+                                      (rg.end is None or k < rg.end)
+                                      for rg in ranges))
+            tomb = np.fromiter(sorted(final), dtype=np.int64,
+                               count=len(final))
+            puts = sorted((h, v) for h, v in final.items()
+                          if v is not None and
+                          in_range(row_key(table_id, h)))
+            try:
+                app_handles, raw, ok = _decode_puts(puts, columns,
+                                                    defaults)
+            except errors.TypeError_:
+                return None     # no exact plane mapping: re-pack tier
+            with self._lock:
+                self._decoded[dec_key] = (tomb, app_handles, raw, ok)
+                while len(self._decoded) > 32:
+                    self._decoded.pop(next(iter(self._decoded)))
         try:
-            merged = _merge_batch(base, tomb, puts, columns, defaults)
+            merged = _merge_batch(base, tomb, app_handles, raw, ok,
+                                  columns)
         except errors.TypeError_:
             return None     # no exact plane mapping: re-pack → row tier
         if merged is None:
@@ -342,24 +381,20 @@ class DeltaStore:
         metrics.counter("copr.delta.merges").inc()
         tracing.current().set("delta_rows", len(final)) \
             .set("delta_tombstones", len(tomb)) \
-            .set("delta_appended", len(puts))
+            .set("delta_appended", len(app_handles))
         return merged
 
 
-def _merge_batch(base, tomb: np.ndarray, puts: list, columns, defaults):
-    """Materialize the merged ColumnBatch: decode the surviving delta
-    rows into appended plane segments, get the handle-sorted merge order
-    (device kernel or host plan), gather every plane once."""
+def _decode_puts(puts: list, columns, defaults):
+    """Decode the surviving delta rows → (app_handles, raw per-column
+    values, valid flags): the same datum_to_phys contract the pack path
+    applies (TypeError_ bails the whole merge to the re-pack tier).
+    Runs once per pack generation — DeltaStore.merge caches the result
+    and repeat scans reuse it (copr.delta.decode_reuse)."""
     from tidb_tpu.ops import columnar as col
-    if getattr(base, "max_handle", 0) == I64_MAX:
-        return None   # the kernel's sentinel handle is in play: re-pack
-    cap = base.capacity
     k = len(puts)
     app_handles = np.fromiter((h for h, _v in puts), dtype=np.int64,
                               count=k)
-    # decode appended rows → raw per-column values (the same
-    # datum_to_phys contract the pack path applies; TypeError_ bails the
-    # whole merge to the re-pack tier)
     col_kinds = {c.column_id: col.column_phys_kind(c) for c in columns}
     pk_col = next((c for c in columns if c.pk_handle), None)
     raw: dict[int, list] = {c.column_id: [] for c in columns}
@@ -380,6 +415,20 @@ def _merge_batch(base, tomb: np.ndarray, puts: list, columns, defaults):
             v, valid = col.datum_to_phys(d, col_kinds[cid], scale)
             raw[cid].append(v)
             ok[cid].append(valid)
+    return app_handles, raw, ok
+
+
+def _merge_batch(base, tomb: np.ndarray, app_handles: np.ndarray,
+                 raw: dict, ok: dict, columns):
+    """Materialize the merged ColumnBatch from the (possibly cached)
+    pre-decoded appended planes: get the handle-sorted merge order
+    (device kernel or host plan), gather every plane once."""
+    from tidb_tpu.ops import columnar as col
+    if getattr(base, "max_handle", 0) == I64_MAX:
+        return None   # the kernel's sentinel handle is in play: re-pack
+    cap = base.capacity
+    k = len(app_handles)
+    col_kinds = {c.column_id: col.column_phys_kind(c) for c in columns}
 
     order = _merge_order(base, tomb, app_handles)
     n = len(order)

@@ -1,0 +1,473 @@
+"""Cluster-wide versioned string dictionaries + join-domain unification:
+the device dictionary execution tier.
+
+String join/group keys used to be the dict path's exclusive territory:
+the device join kernels take int64/f64 key planes, so any string (or
+multi-column) equi-join fell back to the per-row hash build/probe. But a
+packed string column already IS integers — the batch-local ordered
+dictionary codes of ops.columnar — and pushing string predicates and
+joins down as integer codes is the classic computation-pushdown win
+(PAPERS: "Enhancing Computation Pushdown for Cloud OLAP Databases").
+What was missing is a shared CODE DOMAIN: two sides' batch-local
+dictionaries assign different codes to the same bytes, and two regions'
+partials of one table do too.
+
+This module provides both halves:
+
+* A per-(table, column) VERSIONED dictionary registry living beside the
+  plane cache on each region server (cluster RpcHandler) and on the
+  in-proc TpuClient. Low-NDV string columns register their batch
+  dictionaries at pack time (NDV gate: SET GLOBAL tidb_tpu_dict_max_ndv,
+  a distinct/rows ratio); the global dictionary is APPEND-ONLY, so codes
+  are stable across data versions and across every region's partials —
+  a commit that adds strings extends the dictionary instead of
+  invalidating it, and a response ships only the DELTA entries the
+  consumer hasn't seen (counted on copr.dict.delta_entries /
+  copr.dict.wire_bytes). Invalidation follows the PR 13 discipline: a
+  schema-signature change rebuilds the dictionary outright, and a
+  version advance that left the append-only union far above the live
+  NDV rebuilds it too (copr.dict.rebuilds) so deleted strings cannot
+  grow it without bound.
+
+* Join-domain unification: for a string/multi-key equi-join, each key
+  column pair maps both sides into ONE shared integer domain (cached
+  remaps between registered global dictionaries — repeat joins skip the
+  union — or a per-query sorted union for unregistered sides), numeric
+  key columns map through a per-query value domain (np.unique +
+  searchsorted), and the composite key is the mixed-radix KEY-TUPLE
+  code over the per-column domains (the MULTICHIP r05 dryrun shape).
+  The tuple codes feed the EXISTING device build/probe kernels
+  unchanged — including the mesh-sharded probe — and the host numpy
+  twin (host_keys) is bit-identical integer arithmetic, so the
+  below-floor route and the device route cannot disagree.
+
+Ordering survives encoding: batch-local dictionaries are sorted, and a
+GlobalDict exposes ranks() (code → position in byte order), so a TopN
+above a join orders string keys by dictionary RANK without ever
+materializing the bytes (executors.TopNExec plane path).
+
+High-NDV columns and non-binary (ci) collations bail to the existing
+dict path, counted on copr.degraded_dict; SET GLOBAL
+tidb_tpu_device_dict = 0 is the kill switch — the parity oracle.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+import numpy as np
+
+from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+
+DEFAULT_MAX_NDV_RATIO = float(SYSVAR_DEFAULTS["tidb_tpu_dict_max_ndv"])
+
+# columns whose distinct count sits under this never trip the NDV ratio
+# gate: tiny batches make any ratio meaningless (3 distinct values over
+# 4 rows is 0.75 — and exactly the shape the tier exists for)
+NDV_RATIO_FLOOR = 64
+
+# a registered dictionary whose append-only union outgrew the live NDV
+# by this factor rebuilds on the next registration at a newer version
+# (deleted strings must not grow the domain without bound)
+REBUILD_FACTOR = 4
+
+# composite key-tuple codes must fit int64 with headroom (the device
+# kernels' sentinel arithmetic): past this the dict path answers
+RADIX_LIMIT = 1 << 62
+
+
+class DictBail(Exception):
+    """Join shape outside the dictionary tier: `counted` marks the bails
+    the ROADMAP wants accounted (high NDV, radix overflow) on
+    copr.degraded_dict — structurally ineligible shapes (no plane
+    mapping) bail silently, like the single-key numeric path."""
+
+    def __init__(self, reason: str, counted: bool = False):
+        super().__init__(reason)
+        self.counted = counted
+
+
+class GlobalDict:
+    """One (table, column)'s cluster-wide dictionary: APPEND-ONLY entries
+    (code = first-registration index, stable across versions/regions),
+    plus a lazily built rank view (code → position in byte order) for
+    order-by-dictionary-rank consumers. Thread-safe through the owning
+    registry's lock; readers see immutable prefixes (extend only
+    appends, and the caches invalidate under the lock)."""
+
+    __slots__ = ("table_id", "column_id", "schema_sig", "version",
+                 "entries", "_code_of", "_ranks", "gen")
+
+    def __init__(self, table_id: int, column_id: int, schema_sig,
+                 version: int):
+        self.table_id = table_id
+        self.column_id = column_id
+        self.schema_sig = schema_sig
+        self.version = version
+        self.entries: list[bytes] = []
+        self._code_of: dict[bytes, int] = {}
+        self._ranks: np.ndarray | None = None
+        self.gen = 0            # bumps on extend — unify-cache key part
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def extend(self, values) -> int:
+        """Append unseen values; returns how many were new (the DELTA a
+        response ships — everything before it the consumer already
+        holds)."""
+        new = 0
+        for b in values:
+            if b not in self._code_of:
+                self._code_of[b] = len(self.entries)
+                self.entries.append(b)
+                new += 1
+        if new:
+            self._ranks = None
+            self.gen += 1
+        return new
+
+    def remap_from(self, local_dict: list[bytes]) -> np.ndarray:
+        """local (batch) code → global code. Every local entry must be
+        registered already (extend runs first)."""
+        code_of = self._code_of
+        return np.fromiter((code_of[b] for b in local_dict),
+                           dtype=np.int64, count=len(local_dict))
+
+    def ranks(self) -> np.ndarray:
+        """code → rank in byte order — the sort key that makes global
+        (append-order) codes orderable like the batch-local sorted
+        dictionaries are by construction."""
+        r = self._ranks
+        if r is None or len(r) != len(self.entries):
+            order = sorted(range(len(self.entries)),
+                           key=self.entries.__getitem__)
+            r = np.empty(len(self.entries), dtype=np.int64)
+            r[order] = np.arange(len(self.entries), dtype=np.int64)
+            self._ranks = r
+        return r
+
+
+class LocalDomain:
+    """A batch-local SORTED dictionary wrapped in the same domain
+    protocol a GlobalDict speaks — codes are already rank-ordered, so
+    ranks() is the identity."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, entries: list[bytes]):
+        self.entries = entries
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def ranks(self) -> np.ndarray:
+        return np.arange(len(self.entries), dtype=np.int64)
+
+
+_instances: "weakref.WeakSet[DictRegistry]" = weakref.WeakSet()
+
+
+def _update_gauges() -> None:
+    from tidb_tpu import metrics
+    regs = list(_instances)
+    metrics.gauge("copr.dict.entries").set(
+        sum(sum(len(d) for d in r._dicts.values()) for r in regs))
+    metrics.gauge("copr.dict.dictionaries").set(
+        sum(len(r._dicts) for r in regs))
+
+
+class DictRegistry:
+    """Per-store registry of GlobalDicts, fed at pack time (region
+    columnar engine / TpuClient batch build) and consumed by the join /
+    TopN / group-code tiers through ColumnData attributes (_gdict, the
+    dictionary; _gmap, local→global code remap). Registration is
+    idempotent per batch (batches are immutable once packed)."""
+
+    def __init__(self):
+        self.enabled = True
+        self.max_ndv_ratio = DEFAULT_MAX_NDV_RATIO
+        self._lock = threading.Lock()
+        self._dicts: dict[tuple[int, int], GlobalDict] = {}
+        _instances.add(self)
+
+    def __len__(self) -> int:
+        return len(self._dicts)
+
+    def get(self, table_id: int, column_id: int) -> GlobalDict | None:
+        with self._lock:
+            return self._dicts.get((table_id, column_id))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._dicts.clear()
+        _update_gauges()
+
+    def register_batch(self, batch, columns, table_id: int,
+                       version: int) -> None:
+        """Register every low-NDV K_STR column of a freshly packed (or
+        cache-hit, not-yet-registered) batch: extend the global
+        dictionary with the batch's values and attach the local→global
+        remap to the ColumnData. High-NDV columns are refused (counted
+        copr.dict.rejected_ndv) — joins on them take the per-query
+        bytes-union path or bail to the dict path."""
+        if not self.enabled:
+            return
+        from tidb_tpu import metrics
+        from tidb_tpu.ops import columnar as col
+        changed = False
+        for c in columns:
+            cd = batch.columns.get(c.column_id)
+            if cd is None or cd.kind != col.K_STR:
+                continue
+            gd = getattr(cd, "_gdict", None)
+            if gd is not None and getattr(cd, "_gmap", None) is not None:
+                continue    # batch already registered (immutable planes)
+            # the invalidation signature is the COLUMN's own shape (type,
+            # flags, precision, enum elems) — never the requesting
+            # statement's column SET, which varies per query and must not
+            # churn the dictionary
+            col_sig = (c.tp, c.flag, c.flen, c.decimal,
+                       tuple(c.elems or ()))
+            ndv = len(cd.dictionary)
+            if ndv > NDV_RATIO_FLOOR and \
+                    ndv > self.max_ndv_ratio * max(batch.n_rows, 1):
+                metrics.counter("copr.dict.rejected_ndv").inc()
+                continue
+            with self._lock:
+                key = (table_id, c.column_id)
+                gd = self._dicts.get(key)
+                if gd is not None and gd.schema_sig != col_sig:
+                    # DDL changed the column's shape: codes built over
+                    # the old signature must never mix with the new —
+                    # rebuild outright (the PR 13 invalidation rule)
+                    gd = None
+                    metrics.counter("copr.dict.rebuilds").inc()
+                if gd is not None and version > gd.version and \
+                        len(gd.entries) > max(REBUILD_FACTOR * max(ndv, 1),
+                                              NDV_RATIO_FLOOR):
+                    # the append-only union outgrew the live NDV across
+                    # versions (deletes/updates retired strings): rebuild
+                    # at the new version so the domain tracks the data
+                    gd = None
+                    metrics.counter("copr.dict.rebuilds").inc()
+                if gd is None:
+                    gd = GlobalDict(table_id, c.column_id, col_sig,
+                                    version)
+                    self._dicts[key] = gd
+                new = gd.extend(cd.dictionary)
+                gd.version = max(gd.version, version)
+                remap = gd.remap_from(cd.dictionary)
+            cd._gdict = gd
+            cd._gmap = remap
+            changed = True
+            metrics.counter("copr.dict.registered").inc()
+            if new:
+                # the DELTA a response actually ships: entries the
+                # consumer's mirror has not seen yet (append-only codes
+                # make the known prefix implicit)
+                metrics.counter("copr.dict.delta_entries").inc(new)
+                metrics.counter("copr.dict.wire_bytes").inc(
+                    sum(len(b) for b in gd.entries[-new:]) + 8 * new)
+        if changed:
+            _update_gauges()
+
+
+def registry_for(store):
+    """The store's dictionary registry (cluster RpcHandler or in-proc
+    TpuClient), or None — the handle for SET GLOBAL / hydration."""
+    rpc = getattr(store, "rpc", None)
+    reg = getattr(rpc, "dict_registry", None)
+    if reg is not None:
+        return reg
+    client = store.get_client() if hasattr(store, "get_client") else None
+    return getattr(client, "dict_registry", None)
+
+
+# ---------------------------------------------------------------------------
+# join-domain unification: map both sides' per-column codes/values into
+# one shared integer domain per key column, then mixed-radix them into a
+# single int64 key-tuple code per row
+# ---------------------------------------------------------------------------
+
+# (domain identity → union remaps) LRU: repeat joins between the same
+# registered dictionaries skip the sorted union entirely (the remap is
+# invariant until either dictionary extends — gen is in the key)
+_unify_cache: dict = {}
+_unify_lock = threading.Lock()
+
+
+def _dom_key(dom) -> tuple:
+    return (id(dom), len(dom), getattr(dom, "gen", 0))
+
+
+def unify_domains(doms: list):
+    """One shared byte domain over several dictionaries: returns
+    (union entries sorted, [remap int64[len(dom_i)] per dom]). Cached by
+    dictionary identity+generation; counted on copr.dict.remaps /
+    copr.dict.remap_reuse."""
+    from tidb_tpu import metrics
+    key = tuple(_dom_key(d) for d in doms)
+    with _unify_lock:
+        ent = _unify_cache.get(key)
+    if ent is not None:
+        metrics.counter("copr.dict.remap_reuse").inc()
+        return ent[0], ent[1]
+    union = sorted(set().union(*(d.entries for d in doms)))
+    pos = {b: i for i, b in enumerate(union)}
+    remaps = [np.fromiter((pos[b] for b in d.entries), dtype=np.int64,
+                          count=len(d)) for d in doms]
+    metrics.counter("copr.dict.remaps").inc()
+    with _unify_lock:
+        # doms held strongly in the value: ids in the key stay valid
+        _unify_cache[key] = (union, remaps, doms)
+        while len(_unify_cache) > 128:
+            _unify_cache.pop(next(iter(_unify_cache)))
+    return union, remaps
+
+
+class KeySpec:
+    """One join key column lowered to its shared-domain pieces, one per
+    SIDE: `mode` is "codes" (values already domain codes, -1 = NULL),
+    "remap" (batch-local codes through `table`, an int64 local→domain
+    map) or "domain" (raw i64/f64 values through `table`, the sorted
+    per-query value domain, via searchsorted). `size` is the domain
+    cardinality; the composite builder assigns `stride`."""
+
+    __slots__ = ("mode", "values", "valid", "table", "size", "stride")
+
+    def __init__(self, mode: str, values, valid, table, size: int):
+        self.mode = mode
+        self.values = values
+        self.valid = valid
+        self.table = table
+        self.size = size
+        self.stride = 1
+
+
+def _norm_f64(vals: np.ndarray) -> np.ndarray:
+    # -0.0 joins/groups with +0.0 (the codec key normalizes it)
+    return np.where(vals == 0.0, 0.0, vals)
+
+
+def _str_specs(lside, rside, lj: int, rj: int, n_rows: int,
+               max_ndv_ratio: float):
+    """Shared-domain specs for one STRING key column pair: registered
+    global dictionaries unify through the cached remap; unregistered
+    sides fall back to a per-query union over the emitted bytes planes
+    (exactly the bytes the dict path's codec keys carry). High NDV bails
+    counted."""
+    lcp = getattr(lside, "dict_code_plane", None)
+    rcp = getattr(rside, "dict_code_plane", None)
+    lent = lcp(lj) if lcp is not None else None
+    rent = rcp(rj) if rcp is not None else None
+    if lent is not None and rent is not None:
+        lcodes, lvalid, ldom = lent
+        rcodes, rvalid, rdom = rent
+        if len(ldom) + len(rdom) > \
+                max(2 * NDV_RATIO_FLOOR, max_ndv_ratio * max(n_rows, 1) * 2):
+            raise DictBail("string NDV above tidb_tpu_dict_max_ndv",
+                           counted=True)
+        if ldom is rdom:
+            size = len(ldom)
+            return (KeySpec("codes", lcodes, lvalid, None, size),
+                    KeySpec("codes", rcodes, rvalid, None, size))
+        _union, (lmap, rmap) = unify_domains([ldom, rdom])
+        size = len(_union)
+        return (KeySpec("remap", lcodes, lvalid, lmap, size),
+                KeySpec("remap", rcodes, rvalid, rmap, size))
+    # bytes-union fallback: works for RowsSide drains too — the object
+    # planes carry the SAME emitted bytes the codec keys encode
+    lkind, lvals, lvalid = lside.column_plane(lj)
+    rkind, rvals, rvalid = rside.column_plane(rj)
+    if lkind != "str" or rkind != "str":
+        return None     # vacuous/mismatched side: never-match (caller)
+    luniq = {v for v, ok in zip(lvals.tolist(), lvalid.tolist()) if ok}
+    runiq = {v for v, ok in zip(rvals.tolist(), rvalid.tolist()) if ok}
+    union = sorted(luniq | runiq)
+    if len(union) > NDV_RATIO_FLOOR and \
+            len(union) > max_ndv_ratio * max(n_rows, 1):
+        raise DictBail("string NDV above tidb_tpu_dict_max_ndv",
+                       counted=True)
+    pos = {b: i for i, b in enumerate(union)}
+
+    def codes_of(vals, valid):
+        return np.fromiter(
+            (pos[v] if ok else -1
+             for v, ok in zip(vals.tolist(), valid.tolist())),
+            dtype=np.int64, count=len(vals))
+
+    size = len(union)
+    return (KeySpec("codes", codes_of(lvals, lvalid), lvalid, None, size),
+            KeySpec("codes", codes_of(rvals, rvalid), rvalid, None, size))
+
+
+def build_join_specs(lside, rside, pairs, max_ndv_ratio: float):
+    """Lower every eq-condition column pair into shared-domain KeySpecs:
+    returns (l_specs, r_specs) with strides assigned, or None when some
+    pair can NEVER match (cross-kind sides — the codec keys differ by
+    construction, so the join matches nothing; the caller emits the
+    empty/outer-padded result directly). Raises DictBail for shapes the
+    tier does not take (counted=True for the accounted reasons)."""
+    n_rows = len(lside) + len(rside)
+    l_specs: list[KeySpec] = []
+    r_specs: list[KeySpec] = []
+    for lj, rj, is_str in pairs:
+        if is_str:
+            ent = _str_specs(lside, rside, lj, rj, n_rows, max_ndv_ratio)
+            if ent is None:
+                return None     # vacuous side: no matches possible
+            ls, rs = ent
+        else:
+            lkind, lvals, lvalid = lside.column_plane(lj)
+            rkind, rvals, rvalid = rside.column_plane(rj)
+            if lkind not in ("i64", "f64") or rkind not in ("i64", "f64"):
+                raise DictBail(f"no plane mapping for key pair "
+                               f"({lkind}, {rkind})")
+            if lkind != rkind:
+                # int side vs float side never match under the dict
+                # path's codec keys (i64(5) != f64(5.0))
+                return None
+            if lkind == "f64":
+                lvals, rvals = _norm_f64(lvals), _norm_f64(rvals)
+            dom = np.unique(np.concatenate([lvals[lvalid], rvals[rvalid]]))
+            size = len(dom)
+            ls = KeySpec("domain", lvals, lvalid, dom, size)
+            rs = KeySpec("domain", rvals, rvalid, dom, size)
+        l_specs.append(ls)
+        r_specs.append(rs)
+    # mixed-radix strides, least-significant last (declaration order is
+    # most-significant first — any consistent order is correct, equality
+    # is all the join reads)
+    prod = 1
+    for s in l_specs:
+        prod *= max(s.size, 1)
+        if prod >= RADIX_LIMIT:
+            raise DictBail("key-tuple radix exceeds int64", counted=True)
+    stride = 1
+    for ls, rs in zip(reversed(l_specs), reversed(r_specs)):
+        ls.stride = rs.stride = stride
+        stride *= max(ls.size, 1)
+    return l_specs, r_specs
+
+
+def host_keys(specs: list[KeySpec], n: int):
+    """Composite key-tuple codes on the HOST: (key int64[n], valid
+    bool[n]) — bit-identical to the device remap kernel (same integer
+    arithmetic, same clip semantics), the below-floor route and the
+    parity anchor for kernels.dict_remap_keys."""
+    key = np.zeros(n, dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for s in specs:
+        if s.mode == "codes":
+            codes = np.clip(s.values, 0, max(s.size - 1, 0))
+        elif s.mode == "remap":
+            codes = s.table[np.clip(s.values, 0, len(s.table) - 1)] \
+                if len(s.table) else np.zeros(n, dtype=np.int64)
+        else:
+            codes = np.searchsorted(s.table, s.values).astype(np.int64)
+            np.clip(codes, 0, max(s.size - 1, 0), out=codes)
+        key += codes * s.stride
+        valid &= s.valid
+    return key, valid

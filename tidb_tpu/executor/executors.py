@@ -112,6 +112,51 @@ def _expr_is_ci(e) -> bool:
     return rt is not None and rt.is_ci_collation()
 
 
+class _ProjectedView:
+    """A columnar join/scan result seen through a ProjectionExec of
+    plain columns: output column j reads source column idx_map[j].
+    Speaks the same column_plane / dict_code_plane / datum_at protocol,
+    so the TopN/distinct plane paths fuse across the projection without
+    it ever pulling a row."""
+
+    def __init__(self, res, idx_map: list[int]):
+        self.res = res
+        self.idx_map = idx_map
+
+    def __len__(self) -> int:
+        return len(self.res)
+
+    def column_plane(self, j: int):
+        return self.res.column_plane(self.idx_map[j])
+
+    def dict_code_plane(self, j: int):
+        get = getattr(self.res, "dict_code_plane", None)
+        return get(self.idx_map[j]) if get is not None else None
+
+    def datum_at(self, j: int, i: int):
+        return self.res.datum_at(self.idx_map[j], i)
+
+
+def _columnar_view(child):
+    """(columnar result provider node, start) for a plane fast path:
+    `child` itself, or — seen through one ProjectionExec whose exprs are
+    all plain Columns — its grandchild. Returns (node, idx_map) with
+    idx_map None for the direct case; (None, None) when no columnar
+    provider is reachable."""
+    from tidb_tpu.expression import Column as ExprColumn
+    idx_map = None
+    node = child
+    if isinstance(node, ProjectionExec):
+        if not all(isinstance(e, ExprColumn) for e in node.exprs):
+            return None, None
+        idx_map = [e.index for e in node.exprs]
+        node = node.children[0]
+    if hasattr(node, "device_join_result") or \
+            hasattr(node, "columnar_result"):
+        return node, idx_map
+    return None, None
+
+
 def _group_key_datums(group_by, row):
     """Evaluate group-by items, casefolding *_ci-collated string keys so
     'A' and 'a' land in one group (MySQL collation grouping)."""
@@ -184,6 +229,8 @@ class TopNExec(Executor):
 
     def _materialize(self):
         child = self.children[0]
+        if self._try_plane_topn(child):
+            return
         get_columnar = getattr(child, "columnar_result", None)
         if get_columnar is not None:
             # plane-aware drain: a columnar scan serves the rows below
@@ -205,6 +252,82 @@ class TopNExec(Executor):
         buf.sort(key=key_of)
         self._rows = buf[self.offset:limit]
 
+    def _try_plane_topn(self, child) -> bool:
+        """join→TopN WITHOUT materializing the join output: order the
+        DeviceJoinResult's column planes host-side — string keys by
+        DICTIONARY RANK (copr.dictionary: batch-local codes are
+        rank-ordered, global codes order through ranks()) — and
+        materialize only the offset..limit surviving rows. Key
+        construction mirrors copr.columnar_region._topn_select exactly
+        (desc via bitwise-not / negate, MySQL NULL ordering, stable
+        emission-position tiebreak), so answers equal the row loop's by
+        construction. Bails (row loop answers) on ci collations, planes
+        without an exact mapping, or the tidb_tpu_device_dict kill
+        switch."""
+        import numpy as np
+        from tidb_tpu.expression import Column as ExprColumn
+        node, idx_map = _columnar_view(child)
+        get = getattr(node, "device_join_result", None) \
+            if node is not None else None
+        if get is None:
+            return False
+        gate = getattr(node, "_device_dict_on", None)
+        if gate is not None and not gate():
+            return False    # kill switch: the parity oracle's row loop
+        width = len(child.schema)
+        for item in self.by_items:
+            if not isinstance(item.expr, ExprColumn) or \
+                    _expr_is_ci(item.expr) or item.expr.index >= width:
+                return False
+        res = get()
+        if res is None:
+            return False
+        if idx_map is not None:
+            res = _ProjectedView(res, idx_map)
+        from tidb_tpu import mysqldef as my
+        sort_keys = []      # least-significant first (np.lexsort order)
+        for item in reversed(self.by_items):
+            e = item.expr
+            j = e.index
+            is_str = e.ret_type is not None and \
+                e.ret_type.tp in my.STRING_TYPES
+            if is_str:
+                get_codes = getattr(res, "dict_code_plane", None)
+                ent = get_codes(j) if get_codes is not None else None
+                if ent is None:
+                    return False
+                codes, va, dom = ent
+                ranks = dom.ranks()
+                vo = ranks[np.clip(codes, 0, max(len(ranks) - 1, 0))] \
+                    if len(ranks) else np.zeros(len(codes), np.int64)
+                if item.desc:
+                    vo = ~vo
+            else:
+                kind, vals, va = res.column_plane(j)
+                if kind == "f64":
+                    vo = np.where(vals == 0.0, 0.0, vals)
+                    if item.desc:
+                        vo = -vo
+                elif kind == "i64":
+                    vo = ~vals if item.desc else vals
+                else:
+                    return False
+            nullk = va.astype(np.int8) if not item.desc \
+                else (~va).astype(np.int8)
+            sort_keys.append(np.where(va, vo, np.zeros_like(vo)))
+            sort_keys.append(nullk)
+        order = np.lexsort(sort_keys)   # stable: ties keep emission order
+        limit = self.offset + self.count
+        keep = order[self.offset: limit].tolist()
+        self._rows = [(None, [res.datum_at(j, i) for j in range(width)],
+                       None) for i in keep]
+        from tidb_tpu import metrics
+        metrics.counter("copr.dict.topn_plane").inc()
+        js = getattr(node, "join_stats", None)
+        if js is not None:
+            js["topn_plane"] = True
+        return True
+
     def next(self):
         if self._rows is None:
             self._materialize()
@@ -221,13 +344,83 @@ class DistinctExec(Executor):
         self.children = [child]
         self.schema = child.schema
         self._seen: set[bytes] = set()
+        self._plane_iter = None
+        self._plane_tried = False
         # *_ci output columns dedup casefolded ('ALPHA' ≡ 'alpha')
         self._ci_cols = [i for i, c in enumerate(self.schema.columns)
                          if _expr_is_ci(c)]
 
+    def _try_plane_distinct(self):
+        """Dedup over a columnar child's CODE planes instead of per-row
+        codec keys: every output column maps to dense codes (string
+        columns ride dictionary codes — copr.dictionary — NULL = -1,
+        -0.0 normalized like the codec key), one np.unique over the
+        stacked code matrix keeps first-appearance order, and only the
+        surviving rows materialize. None → the row loop answers (ci
+        columns, kinds without an exact code mapping, non-columnar
+        children, or the tidb_tpu_device_dict kill switch)."""
+        import numpy as np
+        child = self.children[0]
+        if self._ci_cols:
+            return None
+        node, idx_map = _columnar_view(child)
+        if node is None:
+            return None
+        gate = getattr(node, "_device_dict_on", None)
+        if gate is not None:
+            if not gate():
+                return None
+        else:
+            # scan children carry no join-side gate: read the kill
+            # switch off the store client directly, so the parity
+            # oracle (tidb_tpu_device_dict = 0) pins scan-backed
+            # DISTINCTs to the row loop too
+            client = getattr(getattr(node, "ctx", None), "client", None)
+            if client is not None and \
+                    not getattr(client, "device_dict", True):
+                return None
+        get = getattr(node, "device_join_result", None)
+        if get is None:
+            get = getattr(node, "columnar_result", None)
+        if get is None:
+            return None
+        res = get()
+        if res is None or getattr(res, "is_agg_states", False):
+            return None
+        if idx_map is not None:
+            res = _ProjectedView(res, idx_map)
+        from tidb_tpu.executor.fused_agg import _group_codes
+        n = len(res)
+        codes = []
+        for j in range(len(self.schema)):
+            c = _group_codes(res, j)
+            if c is None:
+                return None
+            codes.append(c)
+        if n == 0:
+            return []
+        if len(codes) == 1:
+            _u, first_idx = np.unique(codes[0], return_index=True)
+        else:
+            _u, first_idx = np.unique(np.stack(codes, axis=1), axis=0,
+                                      return_index=True)
+        keep = np.sort(first_idx)       # first-appearance emission order
+        width = len(self.schema)
+        from tidb_tpu import metrics
+        metrics.counter("copr.dict.distinct_plane").inc()
+        return [[res.datum_at(j, int(i)) for j in range(width)]
+                for i in keep.tolist()]
+
     def next(self):
         from tidb_tpu.expression.ops import casefold_datum
         child = self.children[0]
+        if not self._plane_tried:
+            self._plane_tried = True
+            rows = self._try_plane_distinct()
+            if rows is not None:
+                self._plane_iter = iter(rows)
+        if self._plane_iter is not None:
+            return next(self._plane_iter, None)
         while True:
             row = child.next()
             if row is None:
@@ -536,22 +729,49 @@ class HashJoinExec(Executor):
         kernels at/above the dispatch floor, stable numpy argsort +
         searchsorted below it (or on device bail-out). Emission order
         matches the dict path exactly: left-scan order, matches in
-        right-scan order."""
+        right-scan order.
+
+        Single-int/float-key joins take the original key-plane route;
+        string-key and MULTI-key equi-joins route through the device
+        dictionary tier (copr.dictionary): per-column shared code
+        domains mixed-radixed into one composite key-tuple code per row,
+        joined by the same kernels. Non-binary collations and high-NDV
+        string keys bail to the row-at-a-time dict path, counted on
+        copr.degraded_dict; SET GLOBAL tidb_tpu_device_dict = 0 pins
+        every such join there (the parity oracle)."""
         import numpy as np
+        from tidb_tpu import mysqldef as my
         from tidb_tpu.expression import Column as ExprColumn
         from tidb_tpu.plan.plans import Join
         plan = self.plan
-        if len(plan.eq_conditions) != 1:
+        if not plan.eq_conditions:
             return False
         if plan.join_type not in (Join.INNER, Join.LEFT_OUTER):
             return False
+        for lc, rc in plan.eq_conditions:
+            if not isinstance(lc, ExprColumn) or \
+                    not isinstance(rc, ExprColumn):
+                return False
+        any_ci = any(c.ret_type is not None and
+                     c.ret_type.is_ci_collation()
+                     for pair in plan.eq_conditions for c in pair)
+        any_str = any(c.ret_type is not None and
+                      c.ret_type.tp in my.STRING_TYPES
+                      for pair in plan.eq_conditions for c in pair)
+        if len(plan.eq_conditions) > 1 or any_str:
+            # the dictionary tier's scope: multi-key and/or string keys
+            if not self._device_dict_on():
+                return False
+            if any_ci:
+                # ci comparison semantics live in the dict path's
+                # casefolded codec keys — bail there, accounted
+                from tidb_tpu import tracing
+                tracing.record_degraded("dict")
+                return False
+            return self._try_dict_join()
+        if any_ci:
+            return False
         lcol, rcol = plan.eq_conditions[0]
-        if not isinstance(lcol, ExprColumn) or \
-                not isinstance(rcol, ExprColumn):
-            return False
-        if lcol.ret_type.is_ci_collation() or \
-                rcol.ret_type.is_ci_collation():
-            return False
         from tidb_tpu.ops.columnar import RowsSide
         self._right_width = len(self.children[1].schema)
         # plane-aware drains: a bare scan child answers with its column
@@ -623,14 +843,21 @@ class HashJoinExec(Executor):
                     exc_info=True)
                 tracing.record_degraded("join_to_numpy")
                 self.join_stats["device_error"] = True
+        return self._numpy_pairs(lside, rside, lkey, lvalid, rkey, rvalid,
+                                 left_ok)
+
+    def _numpy_pairs(self, lside, rside, lkey, lvalid, rkey, rvalid,
+                     left_ok) -> bool:
+        """Host sort-merge over prepared key planes, pairs expanded
+        VECTORIZED (the same offsets/searchsorted expansion the device
+        probe kernel runs) — emits the same columnar DeviceJoinResult as
+        the device path, so join→agg fusion (and the multi-region
+        partial combine) applies below the dispatch floor and on stores
+        with no TPU client installed; row consumers stream via chunked
+        assembly exactly like the device path. False hands the drained
+        sides to the streaming dict path (pair blow-up)."""
+        import numpy as np
         self.join_stats["path"] = "numpy"
-        # host sort-merge, pairs expanded VECTORIZED (the same
-        # offsets/searchsorted expansion the device probe kernel runs) —
-        # the numpy path emits the same columnar DeviceJoinResult as the
-        # device path, so join→agg fusion (and the multi-region partial
-        # combine) applies below the dispatch floor and on stores with
-        # no TPU client installed; row consumers stream via chunked
-        # assembly exactly like the device path
         t0 = time.time()
         order = np.argsort(rkey[rvalid], kind="stable")
         ridx = np.flatnonzero(rvalid)[order]
@@ -659,6 +886,140 @@ class HashJoinExec(Executor):
         self.join_stats["probe_s"] = time.time() - t0
         self._finish_pairs(lside, rside, li, ri, left_ok)
         return True
+
+    # ---- dictionary execution tier: string / multi-key equi-joins ----
+
+    def _device_dict_on(self) -> bool:
+        """SET GLOBAL tidb_tpu_device_dict kill switch, read off the
+        store client like device_join; clientless harnesses default on
+        (the numpy tuple-code route needs no device)."""
+        client = getattr(self.ctx, "client", None) \
+            if self.ctx is not None else None
+        if client is not None and hasattr(client, "device_dict"):
+            return bool(client.device_dict)
+        return True
+
+    def _dict_max_ndv(self) -> float:
+        client = getattr(self.ctx, "client", None) \
+            if self.ctx is not None else None
+        v = getattr(client, "dict_max_ndv", None)
+        if v is None:
+            from tidb_tpu.copr.dictionary import DEFAULT_MAX_NDV_RATIO
+            return DEFAULT_MAX_NDV_RATIO
+        return float(v)
+
+    def _try_dict_join(self) -> bool:
+        """String-key / multi-key equi-join through the dictionary tier:
+        each eq pair maps into one shared integer domain
+        (copr.dictionary — registered global dictionaries unify through
+        cached remaps, numeric columns through per-query value domains),
+        the composite KEY-TUPLE code joins through the existing device
+        build/probe kernels (mesh-sharded probe included) at/above the
+        floor with the codes built ON DEVICE (kernels.dict_remap_keys),
+        and through the numpy sort-merge below it. Any bail replays the
+        drained sides through the row-at-a-time dict path — answers
+        unchanged by construction."""
+        import numpy as np
+
+        from tidb_tpu import metrics, tracing
+        from tidb_tpu.copr import dictionary as dict_mod
+        from tidb_tpu.ops.columnar import RowsSide
+        plan = self.plan
+        self._right_width = len(self.children[1].schema)
+        rside = self._columnar_scan_side(self.children[1],
+                                         plan.right_conditions)
+        if rside is None:
+            rrows = self.children[1].drain()
+            if plan.right_conditions:
+                rrows = [r for r in rrows
+                         if _conds_ok(plan.right_conditions, r)]
+            rside = RowsSide(rrows)
+        lside = self._columnar_scan_side(self.children[0],
+                                         plan.left_conditions)
+        if lside is None:
+            lside = RowsSide(self.children[0].drain())
+
+        def bail() -> bool:
+            # BOTH sides are drained: hand them to the dict path
+            # (discarding them would silently join exhausted children)
+            self._prebuilt_right = rside.rows()
+            self._left_iter = iter(lside.rows())
+            return False
+
+        from tidb_tpu import mysqldef as my
+        pairs = [(lc.index, rc.index,
+                  (lc.ret_type is not None
+                   and lc.ret_type.tp in my.STRING_TYPES)
+                  or (rc.ret_type is not None
+                      and rc.ret_type.tp in my.STRING_TYPES))
+                 for lc, rc in plan.eq_conditions]
+        try:
+            specs = dict_mod.build_join_specs(lside, rside, pairs,
+                                              self._dict_max_ndv())
+        except dict_mod.DictBail as e:
+            if e.counted:
+                tracing.record_degraded("dict")
+            return bail()
+        left_ok = None
+        if plan.left_conditions:
+            # left side conditions force the row drain above, so rows
+            # are already materialized here
+            left_ok = [_conds_ok(plan.left_conditions, r)
+                       for r in lside.rows()]
+        stats = self.join_stats
+        stats["dict_keys"] = True
+        stats["key_cols"] = len(plan.eq_conditions)
+        metrics.counter("copr.dict.join_keys").inc()
+        if specs is None:
+            # provably matchless (cross-kind pair / vacuous side): the
+            # codec keys could never compare equal, so emit the empty /
+            # outer-padded result directly
+            stats["path"] = "numpy"
+            empty = np.zeros(0, np.int64)
+            self._finish_pairs(lside, rside, empty, empty.copy(), left_ok)
+            return True
+        l_specs, r_specs = specs
+        lkey, lvalid = dict_mod.host_keys(l_specs, len(lside))
+        rkey, rvalid = dict_mod.host_keys(r_specs, len(rside))
+        floor = self._device_join_floor()
+        if floor is not None and max(len(lside), len(rside)) >= floor:
+            from tidb_tpu.ops import columnar as col_mod
+            from tidb_tpu.ops import kernels
+            try:
+                # composite codes built ON DEVICE, one dispatch per side
+                # (the device/dict_remap failpoint seam) — the planes
+                # stay resident as the probe's inputs
+                lk_d, lv_d = kernels.dict_remap_keys(
+                    l_specs, col_mod.bucket_capacity(max(len(lside), 1)))
+                rk_d, rv_d = kernels.dict_remap_keys(
+                    r_specs, col_mod.bucket_capacity(max(len(rside), 1)))
+            except Exception:
+                # remap-kernel fault (real or injected): degrade to the
+                # dict path with unchanged answers, accounted
+                import logging
+                logging.getLogger("tidb_tpu.join").warning(
+                    "dictionary remap bailed to the dict path",
+                    exc_info=True)
+                tracing.record_degraded("dict")
+                stats["device_error"] = True
+                return bail()
+            try:
+                self._start_device(lside, rside, lkey, lvalid, rkey,
+                                   rvalid, left_ok,
+                                   device_keys=(lk_d, lv_d, rk_d, rv_d))
+                return True
+            except Exception:
+                # build/probe rung of the degradation chain, same as the
+                # single-key path: the numpy sort-merge answers from the
+                # same host key planes
+                import logging
+                logging.getLogger("tidb_tpu.join").warning(
+                    "device join bailed out to the numpy path",
+                    exc_info=True)
+                tracing.record_degraded("join_to_numpy")
+                stats["device_error"] = True
+        return self._numpy_pairs(lside, rside, lkey, lvalid, rkey, rvalid,
+                                 left_ok)
 
     # eager numpy pair-expansion ceiling (~0.5 GB of index arrays); a
     # join whose match count exceeds it streams through the dict path

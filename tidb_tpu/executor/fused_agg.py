@@ -327,6 +327,15 @@ def _group_codes(res, j: int):
     """Dense group codes for output column j; NULL → -1 (one group,
     MySQL GROUP BY NULL). None when the plane can't represent the column
     with codec-key-equal grouping."""
+    get_codes = getattr(res, "dict_code_plane", None)
+    if get_codes is not None:
+        ent = get_codes(j)
+        if ent is not None:
+            # dictionary execution tier: string group keys ride their
+            # integer codes (injective over bytes, NULL = -1 — the same
+            # identity the codec key carries) — no bytes materialize
+            codes, valid, _dom = ent
+            return np.where(valid, codes, -1).astype(np.int64)
     kind, vals, valid = res.column_plane(j)
     if kind is None:
         return None

@@ -126,6 +126,8 @@ def _use(session, stmt: ast.UseStmt):
 _GLOBAL_ONLY_TPU_VARS = {
     "tidb_tpu_dispatch_floor": "apply_tpu_dispatch_floor",
     "tidb_tpu_device_join": "apply_tpu_device_join",
+    "tidb_tpu_device_dict": "apply_tpu_device_dict",
+    "tidb_tpu_dict_max_ndv": "apply_tpu_dict_max_ndv",
     "tidb_tpu_columnar_scan": "apply_tpu_columnar_scan",
     "tidb_tpu_plane_cache": "apply_tpu_plane_cache",
     "tidb_tpu_plane_cache_bytes": "apply_tpu_plane_cache_bytes",

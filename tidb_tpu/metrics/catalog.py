@@ -50,6 +50,23 @@ CATALOG: dict[str, tuple[str, str]] = {
     "copr.delta.rows": ("gauge", "Delta rows currently held by region delta packs."),
     "copr.delta.entries": ("gauge", "Live region delta packs."),
     # ---- aggregate pushdown (columnar STATES channel) ----
+    "copr.delta.decode_reuse": ("counter", "Delta merges that reused the pre-decoded appended-row planes of an unchanged pack generation."),
+    # ---- device dictionary execution tier (copr.dictionary) ----
+    "copr.dict.registered": ("counter", "Low-NDV string columns registered into a per-(table, column) global dictionary at pack time."),
+    "copr.dict.rejected_ndv": ("counter", "String columns refused registry registration by the tidb_tpu_dict_max_ndv ratio gate."),
+    "copr.dict.rebuilds": ("counter", "Global dictionaries rebuilt (schema-signature change, or the append-only union outgrew the live NDV across versions)."),
+    "copr.dict.delta_entries": ("counter", "Dictionary entries shipped as response DELTAS (append-only codes make the known prefix implicit)."),
+    "copr.dict.wire_bytes": ("counter", "Wire bytes of dictionary delta entries shipped in columnar responses."),
+    "copr.dict.remaps": ("counter", "Join-domain unifications built (sorted union + per-dictionary remap tables)."),
+    "copr.dict.remap_reuse": ("counter", "Join-domain unifications served from the cached remap (repeat joins skip the union)."),
+    "copr.dict.device_remaps": ("counter", "Code-remap kernel dispatches: composite key-tuple codes built on device."),
+    "copr.dict.join_keys": ("counter", "String/multi-key equi-joins routed through composite key-tuple codes."),
+    "copr.dict.topn_plane": ("counter", "join-to-TopN orderings answered from planes by dictionary rank without materializing rows."),
+    "copr.dict.distinct_plane": ("counter", "DISTINCT dedups answered over code planes without per-row codec keys."),
+    "copr.dict.entries": ("gauge", "Entries currently held across all global dictionaries."),
+    "copr.dict.dictionaries": ("gauge", "Live per-(table, column) global dictionaries."),
+    # ---- micro-batch aggregate slot kind ----
+    "sched.batched_agg_statements": ("counter", "Below-floor scalar-aggregate statements answered through a shared per-slot masked-reduction dispatch."),
     "copr.agg_states.partials": ("counter", "Region partials that answered a pushed-down aggregate as grouped partial STATES."),
     "copr.agg_states.rows": ("counter", "Rows aggregated region-side into grouped partial states."),
     "copr.agg_states.wire_bytes": ("counter", "Wire bytes of grouped partial-STATES payloads (group keys + state arrays)."),

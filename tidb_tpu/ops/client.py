@@ -130,6 +130,19 @@ class TpuClient(kv.Client):
         self.micro_batch = store_bool_sysvar(store, "tidb_tpu_micro_batch")
         self.batch_window_ms = store_int_sysvar(store,
                                                 "tidb_tpu_batch_window_ms")
+        # dictionary execution tier (copr.dictionary): SET GLOBAL
+        # tidb_tpu_device_dict = 0 pins string/multi-key equi-joins to
+        # the row-at-a-time dict path (the parity oracle);
+        # tidb_tpu_dict_max_ndv is the distinct/rows ratio above which a
+        # string key bails there too. The in-proc registry twins the
+        # region servers' (cluster RpcHandler.dict_registry).
+        from tidb_tpu.copr.dictionary import DictRegistry
+        from tidb_tpu.sessionctx import store_float_sysvar
+        self.device_dict = store_bool_sysvar(store, "tidb_tpu_device_dict")
+        self.dict_max_ndv = store_float_sysvar(store,
+                                               "tidb_tpu_dict_max_ndv")
+        self.dict_registry = DictRegistry()
+        self.dict_registry.max_ndv_ratio = self.dict_max_ndv
         from tidb_tpu.ops.sched import MicroBatcher
         self._sched = MicroBatcher()
         self._batch_cache: dict = {}
@@ -352,6 +365,12 @@ class TpuClient(kv.Client):
             self._batch_cache[base_key] = (batch, version)
             if len(self._batch_cache) > 64:
                 self._batch_cache.pop(next(iter(self._batch_cache)))
+        # dictionary tier: low-NDV string columns register their batch
+        # dictionaries into the in-proc global registry (same version +
+        # schema-signature keying as the region servers'), so joins and
+        # TopN over this engine's payloads ride shared code domains
+        self.dict_registry.register_batch(batch, cols, src.table_id,
+                                          version)
         return batch
 
     def _ranges_locked(self, start_ts: int, ranges) -> bool:

@@ -658,6 +658,58 @@ class ColumnarScanResult:
         self._device_plane_cache[j] = out
         return out
 
+    def dict_code_plane(self, j: int):
+        """Output column j as DICTIONARY CODES: (codes int64 in emission
+        order with -1 marking NULLs, valid, domain) — the domain is the
+        column's registered GlobalDict (copr.dictionary: codes stable
+        across regions/versions, gathered through the batch's
+        local→global remap) or the batch-local sorted dictionary wrapped
+        as a LocalDomain. None when the column is not a plain K_STR
+        plane, or when the row path's utf-8 round-trip would REWRITE any
+        dictionary entry (invalid utf-8 under a decode-to-string type:
+        two raw entries could collapse to one emitted value, so code
+        identity would diverge from byte identity — the bytes plane
+        handles those). The join/TopN/group tiers read this instead of
+        materializing bytes objects."""
+        ent = self._plane_cache.get(("dict", j))
+        if ent is not None:
+            return ent if ent != () else None
+        out = None
+        c = self.pb_cols[j]
+        cd = self.batch.columns.get(c.column_id)
+        if cd is not None and cd.kind == K_STR and \
+                self._dict_utf8_clean(j, cd):
+            sel = self.sel
+            valid = cd.valid[sel]
+            gmap = getattr(cd, "_gmap", None)
+            if gmap is not None and getattr(cd, "_gdict", None) is not None:
+                local = np.clip(cd.values[sel], 0, max(len(gmap) - 1, 0))
+                codes = np.where(valid,
+                                 gmap[local] if len(gmap)
+                                 else np.int64(0), np.int64(-1))
+                out = (codes.astype(np.int64), valid, cd._gdict)
+            else:
+                from tidb_tpu.copr.dictionary import LocalDomain
+                codes = np.where(valid, cd.values[sel], -1)
+                out = (codes.astype(np.int64), valid,
+                       LocalDomain(cd.dictionary))
+        self._plane_cache[("dict", j)] = out if out is not None else ()
+        return out
+
+    def _dict_utf8_clean(self, j: int, cd: ColumnData) -> bool:
+        """True when the emitted dictionary equals the stored one —
+        binary columns always, decode-to-string columns only when every
+        entry survives the utf-8 replacement round trip unchanged."""
+        from tidb_tpu.types.convert import bytes_decode_to_string
+        if not bytes_decode_to_string(self._ft(j)):
+            return True
+        clean = getattr(cd, "_utf8_clean", None)
+        if clean is None:
+            clean = all(b.decode("utf-8", "replace").encode("utf-8") == b
+                        for b in cd.dictionary)
+            cd._utf8_clean = clean
+        return clean
+
     def _emit_dictionary(self, j: int, cd: ColumnData) -> list[bytes]:
         """Dictionary bytes as the ROW path would carry them: non-binary
         string columns round-trip through utf-8 with replacement
@@ -823,6 +875,42 @@ class ColumnarPartialSet:
                     from tidb_tpu.ops import kernels
                     out = kernels.stack_planes(devs)
         self._device_plane_cache[j] = out
+        return out
+
+    def dict_code_plane(self, j: int):
+        """Column j's dictionary codes stacked across the region
+        partials in ONE shared domain: when every partial registered the
+        SAME GlobalDict (the common case — one table, one registry) the
+        code planes concatenate directly; differing domains unify
+        through copr.dictionary.unify_domains (cached remaps). None when
+        any partial has no code plane — the bytes path answers."""
+        ent = self._plane_cache.get(("dict", j))
+        if ent is not None:
+            return ent if ent != () else None
+        out = None
+        planes = [p.dict_code_plane(j)
+                  if hasattr(p, "dict_code_plane") else None
+                  for p in self.parts]
+        if all(pl is not None for pl in planes):
+            doms = [pl[2] for pl in planes]
+            valid = np.concatenate([pl[1] for pl in planes])
+            first = doms[0]
+            if all(d is first for d in doms):
+                codes = np.concatenate([pl[0] for pl in planes])
+                out = (codes, valid, first)
+            else:
+                from tidb_tpu.copr import dictionary as dict_mod
+                union, remaps = dict_mod.unify_domains(doms)
+                parts = []
+                for (codes, va, _d), remap in zip(planes, remaps):
+                    if len(remap):
+                        c = remap[np.clip(codes, 0, len(remap) - 1)]
+                        parts.append(np.where(va, c, -1))
+                    else:
+                        parts.append(np.full(len(codes), -1, np.int64))
+                out = (np.concatenate(parts).astype(np.int64), valid,
+                       dict_mod.LocalDomain(union))
+        self._plane_cache[("dict", j)] = out if out is not None else ()
         return out
 
     def _locate(self, i: int) -> tuple:
@@ -1222,6 +1310,38 @@ class DeviceJoinResult:
         ent = (kind, vals, valid)
         self._plane_cache[j] = ent
         return ent
+
+    def dict_code_plane(self, j: int):
+        """Output column j's dictionary codes gathered through the match
+        pairs (codes -1 on NULLs and LEFT OUTER pads) — string group-bys
+        and TopN above a join stay on integer codes instead of
+        materializing bytes. None when the source side has no code
+        plane."""
+        ent = self._plane_cache.get(("dict", j))
+        if ent is not None:
+            return ent if ent != () else None
+        out = None
+        if j < self.left_width:
+            get = getattr(self.lside, "dict_code_plane", None)
+            src = get(j) if get is not None else None
+            if src is not None:
+                codes, valid, dom = src
+                out = (codes[self.l_idx], valid[self.l_idx], dom)
+        else:
+            get = getattr(self.rside, "dict_code_plane", None)
+            src = get(j - self.left_width) if get is not None else None
+            if src is not None:
+                codes, valid, dom = src
+                pad = self.r_idx < 0
+                idx = np.where(pad, 0, self.r_idx)
+                if len(self.rside):
+                    out = (np.where(pad, -1, codes[idx]),
+                           valid[idx] & ~pad, dom)
+                else:
+                    out = (np.full(len(self.r_idx), -1, np.int64),
+                           np.zeros(len(self.r_idx), bool), dom)
+        self._plane_cache[("dict", j)] = out if out is not None else ()
+        return out
 
     def datum_at(self, j: int, i: int):
         """Exact source Datum for output row i, column j — no plane

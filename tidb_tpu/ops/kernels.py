@@ -1394,6 +1394,111 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
 
 
 # ---------------------------------------------------------------------------
+# dictionary code-remap kernel: the device half of the dictionary
+# execution tier (copr.dictionary). One jitted dispatch maps every key
+# column of ONE join side into its shared domain — string codes through
+# the unified-dictionary remap table (a gather), numeric values through
+# the sorted value domain (a searchsorted) — and mixed-radixes them into
+# the composite key-tuple code plane, which stays DEVICE-RESIDENT and
+# feeds the existing join build/probe kernels (join_match_pairs
+# device_keys) unchanged. The host numpy twin (copr.dictionary.host_keys)
+# runs the identical integer arithmetic, so the below-floor route and
+# the device route cannot disagree.
+# ---------------------------------------------------------------------------
+
+_dict_remap_cache: dict = {}
+
+
+def dict_remap_keys(specs, cap: int):
+    """Composite key-tuple code plane ON DEVICE for one join side.
+
+    `specs` is copr.dictionary's KeySpec list (mode codes|remap|domain,
+    host values/valid planes, remap/domain table, size, stride); `cap`
+    the padded plane capacity the join kernels expect. Returns device
+    (key int64[cap], valid bool[cap]) with NO readback — the pairs
+    readback stays the join's single transfer. Faults (including the
+    device/dict_remap failpoint) raise typed DeviceError so the caller
+    degrades to the dict path with unchanged answers, counted on
+    copr.degraded_dict."""
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
+    from tidb_tpu import tracing as _tracing
+    if _failpoint._active:
+        _failpoint.eval("device/dict_remap", lambda: _errors.DeviceError(
+            "injected dictionary remap failure"))
+    n = int(specs[0].values.shape[0])
+    shape_sig = []
+    tables = []
+    for s in specs:
+        if s.mode == "codes":
+            tables.append(None)
+            tcap = 0
+        else:
+            tcap = col.bucket_capacity(max(len(s.table), 1), minimum=64)
+            pad_val = I64_MAX if s.table.dtype != np.float64 else np.inf
+            t = np.full(tcap, pad_val, dtype=s.table.dtype)
+            t[:len(s.table)] = s.table
+            tables.append(t)
+        shape_sig.append((s.mode, str(s.values.dtype), tcap,
+                          max(s.size - 1, 0), int(s.stride)))
+    key = (tuple(shape_sig), cap, n)
+    fn = _dict_remap_cache.get(key)
+    _tracing.record_jit_cache(hit=fn is not None)
+    if fn is None:
+        sig = tuple(shape_sig)
+
+        def impl(*arrs):
+            out = jnp.zeros(cap, dtype=jnp.int64)
+            valid = jnp.ones(cap, dtype=bool)
+            i = 0
+            for mode, _dt, _tcap, cmax, stride in sig:
+                vals, va = arrs[i], arrs[i + 1]
+                i += 2
+                if mode == "codes":
+                    codes = jnp.clip(vals, 0, cmax)
+                elif mode == "remap":
+                    table = arrs[i]
+                    i += 1
+                    codes = table[jnp.clip(vals, 0, table.shape[0] - 1)]
+                    codes = jnp.clip(codes, 0, cmax)
+                else:   # domain: normalized values → searchsorted codes
+                    table = arrs[i]
+                    i += 1
+                    v = vals
+                    if v.dtype == jnp.float64:
+                        v = jnp.where(v == 0.0, 0.0, v)
+                    codes = jnp.clip(jnp.searchsorted(table, v), 0, cmax)
+                out = out + codes.astype(jnp.int64) * jnp.int64(stride)
+                valid = valid & va
+            return out, valid
+
+        fn = _dict_remap_cache[key] = jax.jit(impl)
+        if len(_dict_remap_cache) > 256:
+            _dict_remap_cache.pop(next(iter(_dict_remap_cache)))
+    args = []
+    for s, t in zip(specs, tables):
+        vals = np.zeros(cap, dtype=s.values.dtype)
+        vals[:n] = s.values
+        va = np.zeros(cap, dtype=bool)
+        va[:n] = s.valid
+        args.append(jnp.asarray(vals))
+        args.append(jnp.asarray(va))
+        if t is not None:
+            args.append(jnp.asarray(t))
+    sp = _tracing.current().child("kernel").set("kind", "dict_remap") \
+        .set("key_cols", len(specs)).set("rows", n)
+    try:
+        out = fn(*args)     # dispatch only: outputs feed the probe
+    except Exception as e:
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(f"dictionary remap failed: {e}") from e
+    sp.finish()
+    _tracing.record_dispatch(readbacks=0)
+    from tidb_tpu import metrics as _metrics
+    _metrics.counter("copr.dict.device_remaps").inc()
+    return out
+
+
+# ---------------------------------------------------------------------------
 # filter / topn kernels (non-aggregate requests)
 # ---------------------------------------------------------------------------
 

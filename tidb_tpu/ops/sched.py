@@ -343,14 +343,169 @@ def _unpack_mask_words(packed: np.ndarray, kb: int,
     return bits.reshape(kb, capacity).astype(bool)
 
 
+class _SlotAgg:
+    """One scalar aggregate lowered for the per-slot masked-reduction
+    slot kind: `op` names the reduction ("count" | "sum" | "min" |
+    "max"), `cid` the argument plane (None = count over the mask),
+    `kind`/`scale`/`unsigned`/`dic` drive the partial-datum
+    reconstruction that must merge byte-identically with the CPU row
+    handler's partial rows."""
+
+    __slots__ = ("name", "op", "cid", "kind", "scale", "unsigned",
+                 "dic", "sig")
+
+    def __init__(self, name, op, cid, kind, scale, unsigned, dic, sig):
+        self.name = name
+        self.op = op
+        self.cid = cid
+        self.kind = kind
+        self.scale = scale
+        self.unsigned = unsigned
+        self.dic = dic
+        self.sig = sig
+
+
+def _lower_slot_aggs(sel, batch):
+    """Lower a below-floor scalar aggregate (no group-by) into per-slot
+    masked reductions, or None → unbatchable (the solo CPU route
+    answers). The admitted subset mirrors copr.columnar_region's states
+    gating: plain-integer/decimal exact sums with the overflow
+    pre-guard, int/float/decimal/string min/max (string extrema through
+    the sorted dictionary codes; -0.0 floats bail — the row path keeps
+    first-seen zero signs), counts over anything. Float SUM/AVG always
+    bail: a device reduction would re-associate the row path's
+    sequential rounding."""
+    import numpy as np
+
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.copr.proto import AGG_NAME
+    colpb = {c.column_id: c for c in sel.table_info.columns}
+    out = []
+    for e in sel.aggregates:
+        name = AGG_NAME.get(e.tp)
+        if name not in ("count", "sum", "avg", "min", "max") \
+                or e.distinct or len(e.children) > 1:
+            return None
+        arg = e.children[0] if e.children else None
+        if arg is None or arg.tp == ExprType.VALUE:
+            if name != "count":
+                return None
+            const = arg.val if arg is not None else None
+            if const is not None and const.is_null():
+                return None     # count(NULL literal): solo route
+            out.append(_SlotAgg("count", "count", None, None, 0, False,
+                                None, ("count", None)))
+            continue
+        if arg.tp != ExprType.COLUMN_REF:
+            return None
+        cd = batch.columns.get(arg.val)
+        c = colpb.get(arg.val)
+        if cd is None or c is None:
+            return None
+        if name == "count":
+            out.append(_SlotAgg("count", "count", arg.val, None, 0,
+                                False, None, ("count", arg.val)))
+            continue
+        unsigned = my.has_unsigned_flag(c.flag)
+        int_plane = cd.kind == col.K_I64 and c.tp in my.INTEGER_TYPES
+        if name in ("sum", "avg"):
+            if not (int_plane or cd.kind == col.K_DEC):
+                return None     # float sums keep sequential rounding;
+                #                 time/duration/string sums: row handler
+            mx = getattr(cd, "max_abs", 0)
+            if mx and batch.n_rows and mx * batch.n_rows >= (1 << 63):
+                return None     # could wrap: the Decimal row path
+            out.append(_SlotAgg(name, "sum", arg.val, cd.kind,
+                                cd.dec_scale, unsigned, None,
+                                (name, arg.val, cd.kind, cd.dec_scale)))
+            continue
+        # min / max
+        if cd.kind == col.K_F64:
+            vals = cd.values
+            z = (vals == 0.0) & np.signbit(vals) & cd.valid
+            if bool(np.any(z[:batch.n_rows])):
+                return None     # first-seen ±0.0 tie semantics
+        elif cd.kind == col.K_STR:
+            pass                # code extrema ARE byte extrema
+        elif not (int_plane or cd.kind == col.K_DEC):
+            return None         # time/duration/bit: row handler
+        out.append(_SlotAgg(name, name, arg.val, cd.kind, cd.dec_scale,
+                            unsigned, cd.dictionary
+                            if cd.kind == col.K_STR else None,
+                            (name, arg.val, cd.kind, cd.dec_scale)))
+    return out
+
+
+def _build_agg_wrapper(root, aggs):
+    """Traceable body of the aggregate slot kind: vmap over the per-slot
+    parameter blocks, each slot computing its where-mask and every
+    aggregate's masked reduction in the SAME fused computation —
+    sentinel conventions identical to kernels._scalar_agg (empty
+    reductions are NULLed by their count, never by sentinel value), and
+    int64 results ride exact (hi, lo) f64 pairs so the single packed
+    readback loses nothing."""
+    import jax
+    import jax.numpy as jnp
+    specs = [(a.op, a.cid, a.kind) for a in aggs]
+    F64_MAX = jnp.finfo(jnp.float64).max
+    I64_MAX_ = (1 << 63) - 1
+    I64_MIN_ = -(1 << 63)
+
+    def wrapper(planes, live, pi, pf):
+        def one(pi_row, pf_row):
+            mask = live
+            if root is not None:
+                v, va = root(planes, pi_row, pf_row)
+                mask = mask & va & _truthy(v)
+            parts = [jnp.sum(mask.astype(jnp.int64))
+                     .astype(jnp.float64)[None]]
+            for op, cid, _kind in specs:
+                if cid is None:
+                    contrib = mask
+                    vals = None
+                else:
+                    vals, cva = planes[cid]
+                    contrib = mask & cva
+                n = jnp.sum(contrib.astype(jnp.int64))
+                parts.append(n.astype(jnp.float64)[None])
+                if op == "count":
+                    continue
+                if op == "sum":
+                    red = jnp.sum(jnp.where(contrib, vals,
+                                            jnp.zeros_like(vals)))
+                else:
+                    if vals.dtype == jnp.float64:
+                        sent = F64_MAX if op == "min" else -F64_MAX
+                    else:
+                        sent = I64_MAX_ if op == "min" else I64_MIN_
+                    vv = jnp.where(contrib, vals,
+                                   jnp.full_like(vals, sent))
+                    red = jnp.min(vv) if op == "min" else jnp.max(vv)
+                if red.dtype == jnp.float64:
+                    parts.append(red[None])
+                else:
+                    red = red.astype(jnp.int64)
+                    parts.append(jnp.floor_divide(red, 1 << 32)
+                                 .astype(jnp.float64)[None])
+                    parts.append(jnp.mod(red, 1 << 32)
+                                 .astype(jnp.float64)[None])
+            return jnp.concatenate(parts)
+
+        return jax.vmap(one)(pi, pf).reshape(-1)
+
+    return wrapper
+
+
 class _Entry:
     __slots__ = ("req", "sel", "batch", "fn", "sig", "pi", "pf", "cids",
-                 "cols", "event", "result", "error", "degrade", "taken")
+                 "cols", "aggs", "event", "result", "error", "degrade",
+                 "taken")
 
     def __init__(self):
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.aggs = None        # _SlotAgg list for the aggregate kind
         self.degrade = None     # None | "solo" | "stall" | "fault"
         self.taken = False
 
@@ -400,24 +555,45 @@ class MicroBatcher:
     def _prepare(self, client, req: kv.Request, sel) -> _Entry | None:
         if req.tp != kv.REQ_TYPE_SELECT or sel.table_info is None:
             return None
-        if sel.is_agg() or sel.order_by or sel.having is not None \
-                or sel.where is None:
+        if sel.order_by or sel.having is not None:
+            return None
+        is_agg = sel.is_agg()
+        if is_agg and (sel.group_by or sel.limit is not None or sel.desc):
+            return None
+        if not is_agg and sel.where is None:
             return None
         try:
             batch = client._get_batch(sel, req.key_ranges)
         except (Unsupported, errors.TypeError_):
             return None
         lw = _Lowerer(batch)
-        try:
-            fn, sig = lw.lower(sel.where)
-        except _Unbatchable:
-            return None
+        fn, sig = None, ()
+        if sel.where is not None:
+            try:
+                fn, sig = lw.lower(sel.where)
+            except _Unbatchable:
+                return None
+        aggs = None
+        if is_agg:
+            # the aggregate slot kind (PR 9 residual a): below-floor
+            # scalar aggregates batch as per-slot masked reductions over
+            # the same padded planes instead of each running a solo CPU
+            # row scan
+            aggs = _lower_slot_aggs(sel, batch)
+            if aggs is None:
+                return None
         e = _Entry()
         e.req, e.sel, e.batch = req, sel, batch
-        e.fn, e.cids = fn, frozenset(lw.cids)
+        cids = set(lw.cids)
+        if aggs is not None:
+            cids.update(a.cid for a in aggs if a.cid is not None)
+        e.fn, e.cids = fn, frozenset(cids)
+        e.aggs = aggs
         # parameter COUNTS ride the signature so equal sigs guarantee
-        # aligned parameter blocks
-        e.sig = (sig, len(lw.pi), len(lw.pf))
+        # aligned parameter blocks; the aggregate shape rides it too so
+        # filter and aggregate entries can never share a dispatch
+        agg_sig = tuple(a.sig for a in aggs) if aggs is not None else None
+        e.sig = (sig, agg_sig, len(lw.pi), len(lw.pf))
         e.pi = np.asarray(lw.pi, dtype=np.int64)
         e.pf = np.asarray(lw.pf, dtype=np.float64)
         e.cols = list(sel.table_info.columns)
@@ -621,6 +797,95 @@ class MicroBatcher:
             ts = self._hot.get(sig)
         return ts is not None and time.monotonic() - ts < self.HOT_SIG_S
 
+    # ------------------------------------------------------------------
+    # aggregate slot kind: per-slot masked reductions (PR 9 residual a)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _slot_layout(aggs) -> int:
+        """f64 readback slots per statement: leading where-pass count,
+        then per aggregate a contrib count + (for valued aggregates) the
+        reduction — int64 reductions ride exact (hi, lo) f64 pairs, the
+        pack_outputs encoding."""
+        n = 1
+        for a in aggs:
+            n += 1
+            if a.op != "count":
+                n += 1 if a.kind == col.K_F64 else 2
+        return n
+
+    @staticmethod
+    def _decode_slot(aggs, vec):
+        """One slot's packed f64 vector → (where-pass rows,
+        [(contrib n, value|None) per aggregate])."""
+        n_pass = int(vec[0])
+        o = 1
+        outs = []
+        for a in aggs:
+            n = int(vec[o])
+            o += 1
+            v = None
+            if a.op != "count":
+                if a.kind == col.K_F64:
+                    v = float(vec[o])
+                    o += 1
+                else:
+                    v = (int(vec[o]) << 32) + int(vec[o + 1])
+                    o += 2
+            outs.append((n, v))
+        return n_pass, outs
+
+    def _emit_agg(self, client, e: _Entry, vec) -> SelectResponse:
+        """One statement's scalar-aggregate partial response from its
+        decoded slot: the EXACT partial row the CPU row handler would
+        emit ([b'' group key, per-agg partials], handle 0) — and, like
+        the row handler, NO row at all when no row passed the filter
+        (the SQL-side FINAL aggregate synthesizes the empty result)."""
+        from decimal import Decimal
+
+        from tidb_tpu.types import Datum
+        from tidb_tpu.types.datum import NULL
+        n_pass, outs = self._decode_slot(e.aggs, vec)
+        rows: list = []
+        if n_pass:
+            row = [Datum.bytes_(b"")]
+            for a, (n, v) in zip(e.aggs, outs):
+                if a.name == "count":
+                    row.append(Datum.i64(n))
+                    continue
+                if n == 0:
+                    val = NULL
+                elif a.op == "sum":
+                    # exact scaled-int sum → the row accumulator's
+                    # Decimal (scaleb keeps the column scale, so the
+                    # partial merges byte-identically)
+                    val = Datum.dec(Decimal(v).scaleb(-a.scale)) \
+                        if a.kind == col.K_DEC else Datum.dec(Decimal(v))
+                elif a.kind == col.K_F64:
+                    val = Datum.f64(v)
+                elif a.kind == col.K_DEC:
+                    val = Datum.dec(Decimal(v).scaleb(-a.scale))
+                elif a.kind == col.K_STR:
+                    # code extremum IS the bytes extremum (sorted dict)
+                    val = Datum.bytes_(a.dic[v])
+                elif a.unsigned:
+                    val = Datum.u64(v)
+                else:
+                    val = Datum.i64(v)
+                if a.name == "avg":
+                    row.append(Datum.i64(n))
+                row.append(val)
+            rows = [(0, row)]
+        if e.sel.columnar_hint and client.columnar_scan:
+            colpb = {c.column_id: c for c in e.cols}
+            fts = col.agg_partial_field_types(e.sel.aggregates, colpb)
+            return SelectResponse(columnar=col.ColumnarAggRows(rows, fts))
+        from tidb_tpu.copr.proto import ChunkWriter
+        writer = ChunkWriter()
+        for h, row in rows:
+            writer.append_row(h, row)
+        return SelectResponse(chunks=writer.finish())
+
     def _kernel(self, client, proto: _Entry, kb: int):
         """Shared-shape jit cache: one traced+jitted callable per
         (signature, slot bucket, capacity) — N concurrent statements of
@@ -640,6 +905,23 @@ class MicroBatcher:
                 failpoint.eval("device/compile", lambda: errors.DeviceError(
                     "injected kernel compile failure (batched_filter)"))
             root = proto.fn
+            if proto.aggs is not None:
+                wrapper = _build_agg_wrapper(root, proto.aggs)
+                try:
+                    ent = (jax.jit(wrapper), {"runs": 0})
+                except (errors.TiDBError, Unsupported):
+                    raise
+                except Exception as e:
+                    raise errors.DeviceError(
+                        f"batched agg kernel build failed: {e}") from e
+                with self._lock:
+                    cur = self._fn_cache.get(key)
+                    if cur is not None:
+                        return cur
+                    self._fn_cache[key] = ent
+                    if len(self._fn_cache) > 256:
+                        self._fn_cache.pop(next(iter(self._fn_cache)))
+                return ent
 
             def wrapper(planes, live, pi, pf):
                 def one(pi_row, pf_row):
@@ -684,7 +966,7 @@ class MicroBatcher:
         batch = proto.batch
         k = len(chunk)
         kb = _slot_bucket(k)
-        n_i, n_f = proto.sig[1], proto.sig[2]
+        n_i, n_f = proto.sig[2], proto.sig[3]
         pi = np.zeros((kb, n_i), dtype=np.int64)
         pf = np.zeros((kb, n_f), dtype=np.float64)
         for j, e in enumerate(chunk):
@@ -695,11 +977,14 @@ class MicroBatcher:
         planes = kernels.batch_planes(batch)
         sub = {cid: planes[cid] for cid in proto.cids}
         live = kernels.device_live(batch)
+        kind = "batched_agg" if proto.aggs is not None else "batched_filter"
         packed = client._dispatch_kernel(
-            jitted, sub, live, "batched_filter", kst,
+            jitted, sub, live, kind, kst,
             extra=(jnp.asarray(pi), jnp.asarray(pf)),
             attrs={"batch_size": k, "batch_slots": kb})
-        masks = _unpack_mask_words(packed, kb, batch.capacity)[:k]
+        masks = None
+        if proto.aggs is None:
+            masks = _unpack_mask_words(packed, kb, batch.capacity)[:k]
         metrics.counter("sched.batched_dispatches").inc()
         metrics.histogram("sched.batch_size").observe(k)
         # slot-bucket economics for the profiler: how full the padded
@@ -718,6 +1003,15 @@ class MicroBatcher:
                 self._hot[proto.sig] = self._last_multi = time.monotonic()
                 if len(self._hot) > 256:
                     self._hot.pop(next(iter(self._hot)))
+        if proto.aggs is not None:
+            # aggregate slot kind: each slot's packed reductions demux
+            # into that statement's partial-row response
+            L = self._slot_layout(proto.aggs)
+            block = np.asarray(packed, dtype=np.float64).reshape(kb, L)
+            metrics.counter("sched.batched_agg_statements").inc(k)
+            for j, e in enumerate(chunk):
+                e.result = self._emit_agg(client, e, block[j])
+            return
         for j, e in enumerate(chunk):
             idx = np.nonzero(masks[j])[0]
             if e.sel.desc:

@@ -1019,6 +1019,44 @@ class Session:
         self._apply_tpu_bool_switch("tidb_tpu_columnar_scan",
                                     "columnar_scan", value)
 
+    def apply_tpu_device_dict(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_device_dict = 0|1 — the dictionary
+        execution tier's kill switch: 0 pins every string/multi-key
+        equi-join to the row-at-a-time dict path (the parity oracle).
+        Off also disables further registry registration; existing
+        dictionaries stay (they are append-only supersets — harmless,
+        and re-enable starts warm)."""
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        self._apply_tpu_bool_switch("tidb_tpu_device_dict", "device_dict",
+                                    value)
+        from tidb_tpu.copr.dictionary import registry_for
+        reg = registry_for(self.store)
+        if reg is not None:
+            reg.enabled = parse_bool_sysvar(value)
+
+    def apply_tpu_dict_max_ndv(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_dict_max_ndv = R — the distinct/rows
+        ratio above which a string join key bails to the dict path
+        (counted on copr.degraded_dict) and a column is refused registry
+        registration (copr.dict.rejected_ndv)."""
+        try:
+            ratio = float(value.strip())
+        except ValueError:
+            raise errors.ExecError(
+                f"tidb_tpu_dict_max_ndv must be a number, got {value!r}")
+        if not 0.0 < ratio <= 1.0:
+            raise errors.ExecError(
+                "tidb_tpu_dict_max_ndv must be in (0, 1]")
+        self._require_global_grant("tidb_tpu_dict_max_ndv")
+        client = self.store.get_client()
+        for target in (client, getattr(client, "cpu", None)):
+            if target is not None and hasattr(target, "dict_max_ndv"):
+                target.dict_max_ndv = ratio
+        from tidb_tpu.copr.dictionary import registry_for
+        reg = registry_for(self.store)
+        if reg is not None:
+            reg.max_ndv_ratio = ratio
+
     def apply_tpu_plane_cache(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_plane_cache = 0|1 — the packed-plane cache
         kill switch: flips the in-proc TpuClient batch cache (client
@@ -1450,6 +1488,7 @@ def bootstrap(session: Session) -> None:
                         continue
                     for var, attr in (
                             ("tidb_tpu_device_join", "device_join"),
+                            ("tidb_tpu_device_dict", "device_dict"),
                             ("tidb_tpu_columnar_scan", "columnar_scan"),
                             ("tidb_tpu_micro_batch", "micro_batch"),
                             ("tidb_tpu_plane_cache",
@@ -1457,6 +1496,13 @@ def bootstrap(session: Session) -> None:
                         v = gv.values.get(var)
                         if v is not None and hasattr(target, attr):
                             setattr(target, attr, parse_bool_sysvar(v))
+                    v = gv.values.get("tidb_tpu_dict_max_ndv")
+                    try:
+                        if v is not None and hasattr(target,
+                                                     "dict_max_ndv"):
+                            target.dict_max_ndv = float(v.strip())
+                    except ValueError:
+                        pass
                     for var, attr in (
                             ("tidb_tpu_dispatch_floor",
                              "dispatch_floor_rows"),
@@ -1483,6 +1529,21 @@ def bootstrap(session: Session) -> None:
                 try:
                     if b:
                         pc.set_budget(max(0, int(b.strip())))
+                except ValueError:
+                    pass
+            # the region dictionary registry hangs off the RPC handler
+            # like the plane cache — hydrate its kill switch + NDV gate
+            # on every backend path
+            from tidb_tpu.copr.dictionary import registry_for
+            reg = registry_for(session.store)
+            if reg is not None:
+                v = gv.values.get("tidb_tpu_device_dict")
+                if v is not None:
+                    reg.enabled = parse_bool_sysvar(v)
+                v = gv.values.get("tidb_tpu_dict_max_ndv")
+                try:
+                    if v:
+                        reg.max_ndv_ratio = float(v.strip())
                 except ValueError:
                     pass
             # the shared drain pool's size is process-level like the mesh

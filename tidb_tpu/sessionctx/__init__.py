@@ -59,6 +59,18 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # row protocol (plane-aware consumers fall back to row drains) while
     # scans keep routing to the device
     "tidb_tpu_columnar_scan": "1",
+    # device dictionary execution tier (copr.dictionary): string-key and
+    # multi-key equi-joins route through the device build/probe kernels
+    # on composite key-tuple codes over shared dictionary domains. 0 is
+    # the kill switch — every such join takes the row-at-a-time dict
+    # path (the parity oracle). GLOBAL-only, store-level.
+    "tidb_tpu_device_dict": "1",
+    # NDV ratio gate for the dictionary tier: a string column whose
+    # distinct/rows ratio exceeds this bails to the dict path (counted
+    # on copr.degraded_dict) and is refused registry registration
+    # (copr.dict.rejected_ndv); columns under 64 distinct values never
+    # trip it. GLOBAL-only, store-level.
+    "tidb_tpu_dict_max_ndv": "0.5",
     # per-region columnar plane cache (copr.plane_cache) kill switch:
     # 0 re-packs every columnar_hint scan from the MVCC store (and
     # disables the in-proc TpuClient batch cache) — the parity oracle
@@ -197,6 +209,15 @@ def store_int_sysvar(store, name: str) -> int:
         return int(_store_sysvar_raw(store, name).strip())
     except ValueError:
         return int(SYSVAR_DEFAULTS[name])
+
+
+def store_float_sysvar(store, name: str) -> float:
+    """Ratio-shaped knobs (tidb_tpu_dict_max_ndv) resolve like the int
+    floors: persisted global if set, else the default."""
+    try:
+        return float(_store_sysvar_raw(store, name).strip())
+    except ValueError:
+        return float(SYSVAR_DEFAULTS[name])
 
 
 class SessionVars:
