@@ -691,6 +691,103 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
     }
 
 
+OVERSIZED_SQL = ("select count(*), sum(f_v), min(f_v), max(d_f) "
+                 "from fan join odim on f_k = d_k")
+
+
+def measure_join_oversized(n_rows: int, n_dim: int, n_regions: int,
+                           runs: int):
+    """Out-of-core join regime (HBM governance tier): the BUILD side
+    (odim) is sized ~4x the configured `tidb_tpu_hbm_budget_bytes`, so
+    every join over the 4-region cluster store takes the
+    radix-partitioned grace-hash route — split by key radix, run in
+    passes through the existing kernels, merged bit-identically to the
+    single-pass order. Asserts >= 2 partitioned passes on the counters,
+    zero columnar fallbacks, and row-for-row parity against the
+    unpartitioned oracle (budget 0 — the kill switch) inside the bench
+    itself."""
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.ops import membudget
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    store = new_store(f"cluster://3/benchov{n_rows}")
+    s = Session(store)
+    s.execute("create database ov")
+    s.execute("use ov")
+    s.execute("create table fan (f_id bigint primary key, f_k bigint, "
+              "f_v bigint)")
+    s.execute("create table odim (d_k bigint primary key, d_f double)")
+    tbl = s.info_schema().table_by_name("ov", "fan")
+    rows = [[Datum.i64(i), Datum.i64(i % n_dim), Datum.i64(i * 3)]
+            for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    dtbl = s.info_schema().table_by_name("ov", "odim")
+    drows = [[Datum.i64(k), Datum.f64(k % 97 + 0.5)]
+             for k in range(n_dim)]
+    for start in range(0, n_dim, batch):
+        txn = store.begin()
+        dtbl.add_records(txn, drows[start:start + batch],
+                         skip_unique_check=True)
+        txn.commit()
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+
+    sess = Session(store)
+    sess.execute("use ov")
+    sess.execute("set global tidb_tpu_dispatch_floor = 0")
+    # build side ~4x the budget: the ledger must partition every run
+    budget = max(membudget.build_bytes_estimate(n_dim) // 4, 4096)
+    pj = metrics.counter("copr.partitioned_joins")
+    pp = metrics.counter("copr.partitioned_passes")
+    fbs = metrics.counter("distsql.columnar_fallbacks")
+    try:
+        sess.execute(f"set global tidb_tpu_hbm_budget_bytes = {budget}")
+        sess.execute(OVERSIZED_SQL)       # warm (pack + compile)
+        j0, p0, f0 = pj.value, pp.value, fbs.value
+        t0 = time.time()
+        for _ in range(runs):
+            part_results = sess.execute(OVERSIZED_SQL)[0].values()
+        t_part = (time.time() - t0) / runs
+        d_joins, d_passes = pj.value - j0, pp.value - p0
+        d_fbs = fbs.value - f0
+        assert d_joins >= runs, \
+            (f"oversized build side took the partitioned route only "
+             f"{d_joins}x in {runs} runs")
+        assert d_passes >= 2 * runs, \
+            (f"only {d_passes} partitioned passes across {runs} runs — "
+             "the out-of-core join did not split")
+        assert d_fbs == 0, \
+            f"oversized join run counted {d_fbs} columnar fallbacks"
+        # parity oracle: budget 0 pins the unpartitioned single-pass
+        # route — answers must match row for row
+        sess.execute("set global tidb_tpu_hbm_budget_bytes = 0")
+        j1 = pj.value
+        oracle = sess.execute(OVERSIZED_SQL)[0].values()
+        assert pj.value == j1, \
+            "budget 0 (kill switch) still took the partitioned route"
+        for got, want in zip(part_results[0], oracle[0]):
+            assert _close(float(got), float(want)), \
+                f"oversized join parity: {got} != {want}"
+    finally:
+        sess.execute("set global tidb_tpu_hbm_budget_bytes = 'auto'")
+    return {
+        "oversized_join_rows_per_sec": round(n_rows / t_part, 1),
+        "oversized_join_passes": d_passes,
+        "oversized_join_partitions": d_passes // max(d_joins, 1),
+        "oversized_join_fallbacks": d_fbs,
+        "oversized_join_budget_bytes": budget,
+        "oversized_join_regions": n_regions,
+    }
+
+
 Q1_PUSHDOWN_SQL = (
     "select l_flag, l_status, sum(l_qty), sum(l_price), avg(l_qty), "
     "avg(l_price), avg(l_disc), count(*) from lineitem "
@@ -1850,6 +1947,18 @@ def main(smoke: bool = False):
           f"{mq_figs['multiq_device_remaps']} device remaps / "
           f"{mq_figs['multiq_topn_plane']} plane TopNs, "
           f"{mq_figs['multiq_fallbacks']} fallbacks", file=sys.stderr)
+    # out-of-core join regime (HBM governance): build side ~4x the
+    # configured HBM budget — the join splits into radix-partitioned
+    # passes bit-identical to the single-pass oracle
+    ovr, ovd = (6_000, 4_000) if smoke else (120_000, 60_000)
+    ov_figs = measure_join_oversized(ovr, ovd, n_regions=4, runs=runs)
+    print(f"# join_oversized ({ovr / 1000:.0f}k probe x {ovd / 1000:.0f}k "
+          f"build, budget {ov_figs['oversized_join_budget_bytes']} B): "
+          f"{ov_figs['oversized_join_rows_per_sec']:,.0f} rows/s across "
+          f"{ov_figs['oversized_join_passes']} partitioned passes "
+          f"({ov_figs['oversized_join_partitions']} partitions/join), "
+          f"{ov_figs['oversized_join_fallbacks']} fallbacks",
+          file=sys.stderr)
     # HTAP freshness regime: OLTP commits interleaved with repeat fan-out
     # scans — cached planes stay warm through region delta packs + device
     # base+delta merges; the kill-switch regime is the collapse oracle
@@ -1935,6 +2044,7 @@ def main(smoke: bool = False):
         **fan_figs,
         **q1p_figs,
         **mq_figs,
+        **ov_figs,
         **htap_figs,
         "q1_mesh_rows_per_sec": q1_mesh_rps,
         "mesh_devices": len(jax.devices()),

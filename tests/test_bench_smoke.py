@@ -82,6 +82,15 @@ def test_bench_smoke_emits_valid_json():
     assert out["multiq_device_remaps"] >= 2
     assert out["multiq_topn_plane"] >= 1
     assert out["multiq_vs_numpy_oracle"] > 0
+    # the out-of-core join regime (HBM governance tier): a build side
+    # ~4x the configured budget splits into radix-partitioned passes
+    # through the existing kernels, bit-identical to the unpartitioned
+    # budget-0 oracle (parity asserted inside the bench itself)
+    assert out["oversized_join_rows_per_sec"] > 0
+    assert out["oversized_join_passes"] >= 2, \
+        "the oversized build side never split into partitioned passes"
+    assert out["oversized_join_fallbacks"] == 0
+    assert out["oversized_join_budget_bytes"] > 0
     # the HTAP freshness regime: commits interleaved with repeat fan-out
     # scans keep the plane cache hot through region delta packs + device
     # base+delta merges (parity vs the row protocol and the commit-to-

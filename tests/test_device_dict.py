@@ -358,3 +358,89 @@ def test_delta_decode_reuse_counter():
             "repeat merge re-decoded an unchanged pack generation"
     finally:
         failpoint.disable("cache/no_admit")
+
+
+def test_device_remap_route_skips_host_key_planes():
+    """PR 14 residual b: when the device remap route takes the join, the
+    host composite key planes are never built — copr.dictionary
+    .host_keys runs only for the below-floor route (or a device bail /
+    out-of-core rung that actually partitions on host planes)."""
+    from tidb_tpu.copr import dictionary as dict_mod
+    s = _build()
+    calls = []
+    orig = dict_mod.host_keys
+
+    def spy(specs, n):
+        calls.append(n)
+        return orig(specs, n)
+
+    dict_mod.host_keys = spy
+    try:
+        s.execute("set global tidb_tpu_dispatch_floor = 0")
+        got = s.execute(JOIN_QUERIES[0])[0].values()
+        assert not calls, \
+            f"device remap route still built host key planes ({calls})"
+        # the below-floor route must still build them (the numpy
+        # sort-merge joins on the host planes)
+        s.execute("set global tidb_tpu_dispatch_floor = 1000000")
+        below = s.execute(JOIN_QUERIES[0])[0].values()
+        assert calls, "below-floor route never built host key planes"
+        assert got == below
+    finally:
+        dict_mod.host_keys = orig
+        s.execute("set global tidb_tpu_dispatch_floor = 16384")
+
+
+def test_batched_gather_emit_matches_per_cell():
+    """PR 14 residual c: the batched plane-gather emit (gather_datums /
+    _gather_rows) must produce datums IDENTICAL to the per-cell
+    datum_at protocol on every side shape — join output over row sides
+    with LEFT OUTER pads, a real packed ColumnarScanResult (string
+    dictionary, floats, NULLs), and the projected view."""
+    import numpy as np
+
+    from tidb_tpu.executor.executors import _ProjectedView, _gather_rows
+    from tidb_tpu.ops import columnar as col_mod
+    from tidb_tpu.types import Datum
+
+    lrows = [[Datum.i64(i), Datum.bytes_(b"x%d" % (i % 3)),
+              Datum.f64(i + 0.5)] for i in range(6)]
+    lrows[3][1] = Datum.null() if hasattr(Datum, "null") else lrows[3][1]
+    rrows = [[Datum.i64(10 + i), Datum.bytes_(b"y%d" % i)]
+             for i in range(4)]
+    l_idx = np.arange(6, dtype=np.int64)
+    r_idx = np.array([0, -1, 2, 3, -1, 1], dtype=np.int64)
+    res = col_mod.DeviceJoinResult(
+        col_mod.RowsSide(lrows), col_mod.RowsSide(rrows),
+        l_idx, r_idx, 3, 2)
+    idx = [4, 0, 2, 5, 1]
+    for j in range(5):
+        got = res.gather_datums(j, idx)
+        want = [res.datum_at(j, i) for i in idx]
+        assert got == want, f"join gather_datums diverged on column {j}"
+    rows = _gather_rows(res, np.asarray(idx), 5)
+    assert rows == [[res.datum_at(j, i) for j in range(5)] for i in idx]
+    # a real packed batch behind a ColumnarScanResult: drive one scan
+    # through the device engine and rebuild the scan payload
+    s = _build(n_regions=1)
+    from tidb_tpu.ops import TpuClient
+    store = s.store
+    old = store.get_client()
+    client = TpuClient(store, dispatch_floor_rows=0)
+    store.set_client(client)
+    try:
+        s.execute("select count(*) from t where v >= 0")
+        batch, cols = client._cur_batch, list(client._cur_cols)
+        scan = col_mod.ColumnarScanResult(
+            batch, np.arange(batch.n_rows, dtype=np.int64), cols)
+        pick = [5, 0, 8, 3, 8]
+        for j in range(len(cols)):
+            got = scan.gather_datums(j, pick)
+            want = [scan.datum_at(j, i) for i in pick]
+            assert got == want, f"scan gather_datums diverged on col {j}"
+        view = _ProjectedView(scan, [len(cols) - 1, 0])
+        for j in range(2):
+            assert view.gather_datums(j, pick) == \
+                [view.datum_at(j, i) for i in pick]
+    finally:
+        store.set_client(old)

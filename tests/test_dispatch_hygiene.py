@@ -1,0 +1,217 @@
+"""Static check: every jitted launch+readback site in tidb_tpu/ops/
+must serialize on `kernels.dispatch_serial`.
+
+PR 9 fixed a flaky runtime deadlock (concurrent statement threads racing
+a jitted program's launch/first-compile + readback wedged the process)
+by serializing every executable launch+readback on one metered lock.
+That contract was prose until now — a new dispatch site (the
+partitioned-pass joins, the key-partitioned mesh probe, any future
+spill-capable operator) could silently reintroduce the deadlock class.
+This AST walk makes it unrepresentable. Two rules over `tidb_tpu/ops/`:
+
+  (a) every CALL to a jitted executable — a name bound from a
+      `jax.jit(...)` result in the same scope (function or module), or
+      the conventional cache-entry name `jitted` — must sit lexically
+      inside a `with ... dispatch_serial` block, and
+  (b) every `np.asarray(<call>)` readback (the certified completion
+      point on tunneled deployments) must too — excluding host-side
+      helpers (`np.asarray` of another np call, `unpack_outputs`).
+
+Compute-only dispatches whose outputs stay device-resident (the join
+build, plane pads/gathers/stacks, the dictionary remap) need no lock —
+one physical device runs one program at a time and nothing reads back —
+but must SAY so with an explicit `# dispatch-ok: <reason>` pragma on
+the call line, so review sees every exemption.
+
+Tier-1 fails on any new violation, with file:line and the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent / "tidb_tpu" / "ops"
+
+PRAGMA = "# dispatch-ok:"
+
+# cache-entry convention: jitted callables unpacked from kernel caches
+# are always bound (or passed) under this name
+SEED_JITTED_NAMES = {"jitted"}
+
+# host-side helpers whose np.asarray(...) argument is NOT a readback
+HOST_CALL_NAMES = {"asarray", "unpack_outputs", "atleast_1d", "zeros",
+                   "ones", "arange", "concatenate", "where", "full"}
+
+
+def _terminal_name(func) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _contains_jit_call(node) -> bool:
+    return any(isinstance(n, ast.Call) and _terminal_name(n.func) == "jit"
+               for n in ast.walk(node))
+
+
+def _scope_nodes(scope):
+    """All nodes of one scope, NOT descending into nested function /
+    lambda bodies (those are their own scopes and walk separately)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _jitted_names(scope) -> set[str]:
+    """Name targets assigned IN THIS SCOPE from an expression containing
+    a jax.jit call."""
+    names: set[str] = set()
+    for node in _scope_nodes(scope):
+        if isinstance(node, ast.Assign) and _contains_jit_call(node.value):
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+    return names
+
+
+def _serial_ranges(tree) -> list[tuple[int, int]]:
+    """(lineno, end_lineno) spans of every `with ... dispatch_serial`
+    body."""
+    spans = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            if _terminal_name(item.context_expr) == "dispatch_serial" or (
+                    isinstance(item.context_expr, ast.Call)
+                    and _terminal_name(item.context_expr.func)
+                    == "dispatch_serial"):
+                spans.append((node.lineno, node.end_lineno))
+    return spans
+
+
+def _inside(spans, lineno: int) -> bool:
+    return any(a <= lineno <= b for a, b in spans)
+
+
+def _is_np_asarray(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "asarray"
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "np")
+
+
+def _violations(path: Path) -> list[str]:
+    src = path.read_text()
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=str(path))
+    spans = _serial_ranges(tree)
+    module_jitted = _jitted_names(tree)
+    bad: list[str] = []
+
+    def check_scope(scope, jitted: set[str]):
+        for node in _scope_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            pragma = PRAGMA in lines[node.lineno - 1]
+            fname = _terminal_name(node.func)
+            # rule (a): launching a jitted executable
+            if isinstance(node.func, ast.Name) and fname in jitted:
+                if not _inside(spans, node.lineno) and not pragma:
+                    bad.append(
+                        f"{path.name}:{node.lineno}: jitted executable "
+                        f"`{fname}(...)` launched outside `with "
+                        f"dispatch_serial` — serialize it, or justify a "
+                        f"no-readback dispatch with `{PRAGMA} <reason>`")
+            # rule (b): np.asarray readback of a call result
+            if _is_np_asarray(node) and node.args:
+                inner = [n for n in ast.walk(node.args[0])
+                         if isinstance(n, ast.Call)
+                         and _terminal_name(n.func) not in HOST_CALL_NAMES]
+                if inner and not _inside(spans, node.lineno) and not pragma:
+                    bad.append(
+                        f"{path.name}:{node.lineno}: np.asarray readback "
+                        f"of a call result outside `with dispatch_serial` "
+                        f"— the launch+readback race (PR 9 deadlock "
+                        f"class); serialize it or justify with "
+                        f"`{PRAGMA} <reason>`")
+
+    check_scope(tree, module_jitted)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            check_scope(node, module_jitted | SEED_JITTED_NAMES
+                        | _jitted_names(node))
+    return bad
+
+
+def test_every_jitted_launch_readback_serializes():
+    files = sorted(ROOT.glob("*.py"))
+    assert files, "tidb_tpu/ops/ not found — layout changed?"
+    problems: list[str] = []
+    for f in files:
+        problems.extend(_violations(f))
+    assert not problems, "\n".join(problems)
+
+
+def test_checker_detects_unserialized_launch(tmp_path):
+    """Meta-test: the walker must flag both rule shapes end-to-end (a
+    refactor cannot silently neuter it)."""
+    import textwrap
+    bad = tmp_path / "badmod.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        module_kernel = jax.jit(lambda x: x)
+
+        def f(planes):
+            fn = jax.jit(lambda x: x)
+            packed = fn(planes)
+            host = np.asarray(run_thing(planes))
+            return np.asarray(module_kernel(packed)), host
+    """))
+    problems = _violations(bad)
+    # fn launch, run_thing readback, module_kernel launch + readback
+    assert len(problems) == 4, problems
+    assert any("`fn(...)`" in p for p in problems)
+    assert any("np.asarray readback" in p for p in problems)
+    # pragma and serialization both clear the same shapes
+    ok = tmp_path / "okmod.py"
+    ok.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def f(planes):
+            fn = jax.jit(lambda x: x)
+            out = fn(planes)  # dispatch-ok: device-resident output
+            with dispatch_serial:
+                host = np.asarray(fn(planes))
+            return out, host
+    """))
+    assert not _violations(ok)
+
+
+def test_checker_accepts_serialized_launch():
+    import textwrap
+    snippet = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def f(planes):
+            fn = jax.jit(lambda x: x)
+            with dispatch_serial:
+                host = np.asarray(fn(planes))
+            return host
+    """)
+    tree = ast.parse(snippet)
+    spans = _serial_ranges(tree)
+    assert spans and _inside(spans, 8)

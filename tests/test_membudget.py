@@ -1,0 +1,381 @@
+"""HBM governance tier (ops.membudget): the budget ledger's accounting,
+the radix-partitioned out-of-core join — single-device passes AND the
+key-partitioned mesh probe — with its escalation/degradation chain, the
+sysvar plumbing, the plane cache's pin skip under pressure, and the
+hbm-pressure inspection rule.
+
+The parity oracle throughout is the UNPARTITIONED route under budget 0
+(the kill switch): every partitioned answer must be bit-identical —
+exact pair equality at the kernel level, row-for-row at the SQL level.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu import errors, failpoint, inspection, metrics
+from tidb_tpu.ops import TpuClient, kernels, membudget
+from tests.testkit import TestKit
+
+
+@pytest.fixture(autouse=True)
+def _reset_budget():
+    yield
+    membudget.set_budget(membudget.DEFAULT_BUDGET_SPEC)
+    failpoint.disable_all()
+
+
+def _cnt(name: str) -> int:
+    return metrics.counter(name).value
+
+
+def _mk_keys(seed=7, n_l=30_000, n_r=12_000, ndv=5000):
+    rng = np.random.default_rng(seed)
+    lkey = rng.integers(0, ndv, n_l).astype(np.int64)
+    rkey = rng.integers(0, ndv, n_r).astype(np.int64)
+    lvalid = rng.random(n_l) > 0.05
+    rvalid = rng.random(n_r) > 0.05
+    return lkey, lvalid, rkey, rvalid
+
+
+class TestLedger:
+    def test_budget_spec_validation(self):
+        membudget.set_budget("auto")
+        assert membudget.budget_bytes() >= 0   # CPU rig: resolves 0
+        membudget.set_budget(12345)
+        assert membudget.budget_bytes() == 12345
+        membudget.set_budget("0")
+        assert membudget.budget_bytes() == 0
+        with pytest.raises(ValueError):
+            membudget.set_budget("-1")
+        with pytest.raises(ValueError):
+            membudget.set_budget("lots")
+
+    def test_reserve_pin_accounting_and_gauges(self):
+        membudget.set_budget(10_000)
+        r0, p0 = membudget.usage()
+        membudget.pin(4_000)
+        try:
+            assert membudget.usage()[1] == p0 + 4_000
+            assert membudget.headroom() <= 6_000 - r0
+            with membudget.reserve(1_000, "test"):
+                res, _ = membudget.usage()
+                assert res == r0 + 1_000
+                assert metrics.gauge("device.hbm.reserved").value == res
+            assert membudget.usage()[0] == r0
+            assert metrics.gauge("device.hbm.budget").value == 10_000
+        finally:
+            membudget.unpin(4_000)
+
+    def test_over_budget_reservation_counts(self):
+        membudget.set_budget(1_000)
+        before = _cnt("device.hbm.over_budget")
+        with membudget.reserve(100_000, "test"):
+            pass
+        assert _cnt("device.hbm.over_budget") == before + 1
+        # unlimited budget never counts
+        membudget.set_budget(0)
+        with membudget.reserve(100_000, "test"):
+            pass
+        assert _cnt("device.hbm.over_budget") == before + 1
+
+    def test_partition_codes_discipline(self):
+        # equal keys share a partition; -0.0 joins +0.0; NULLs home at 0
+        vals = np.array([3.5, 0.0, -0.0, 3.5, 9.25])
+        valid = np.array([True, True, True, True, False])
+        part = membudget.partition_codes(vals, valid, 8)
+        assert part[0] == part[3]
+        assert part[1] == part[2]
+        assert part[4] == 0
+        assert ((part >= 0) & (part < 8)).all()
+        ints = np.array([5, -5, 5, (1 << 62)], dtype=np.int64)
+        pi = membudget.partition_codes(ints, np.ones(4, bool), 16)
+        assert pi[0] == pi[2]
+        assert ((pi >= 0) & (pi < 16)).all()
+
+
+class TestPartitionedPasses:
+    def test_int_key_parity_and_counters(self):
+        lkey, lvalid, rkey, rvalid = _mk_keys()
+        membudget.set_budget(0)
+        li0, ri0 = membudget.join_match_pairs(lkey, lvalid, rkey, rvalid)
+        membudget.set_budget(64 * 1024)
+        j0, p0 = _cnt("copr.partitioned_joins"), \
+            _cnt("copr.partitioned_passes")
+        st: dict = {}
+        li1, ri1 = membudget.join_match_pairs(lkey, lvalid, rkey, rvalid,
+                                              stats=st)
+        assert st["partitioned"] and st["passes"] >= 2
+        assert _cnt("copr.partitioned_joins") == j0 + 1
+        assert _cnt("copr.partitioned_passes") - p0 == st["passes"]
+        assert np.array_equal(li0, li1) and np.array_equal(ri0, ri1)
+
+    def test_float_key_parity_signed_zero(self):
+        rng = np.random.default_rng(11)
+        base = np.concatenate([rng.random(2000) * 50,
+                               np.array([0.0, -0.0])])
+        lk = rng.choice(base, 20_000)
+        rk = rng.choice(base, 9_000)
+        lv = rng.random(20_000) > 0.1
+        rv = rng.random(9_000) > 0.1
+        membudget.set_budget(0)
+        li0, ri0 = membudget.join_match_pairs(lk, lv, rk, rv)
+        membudget.set_budget(48 * 1024)
+        st: dict = {}
+        li1, ri1 = membudget.join_match_pairs(lk, lv, rk, rv, stats=st)
+        assert st["partitioned"] and st["passes"] >= 2
+        assert np.array_equal(li0, li1) and np.array_equal(ri0, ri1)
+
+    def test_budget_zero_pins_unpartitioned(self):
+        lkey, lvalid, rkey, rvalid = _mk_keys(seed=3)
+        membudget.set_budget(0)
+        j0 = _cnt("copr.partitioned_joins")
+        st: dict = {}
+        membudget.join_match_pairs(lkey, lvalid, rkey, rvalid, stats=st)
+        assert "partitioned" not in st
+        assert _cnt("copr.partitioned_joins") == j0
+
+    def test_oom_escalates_partitions_not_host(self):
+        lkey, lvalid, rkey, rvalid = _mk_keys(seed=5)
+        membudget.set_budget(0)
+        li0, ri0 = membudget.join_match_pairs(lkey, lvalid, rkey, rvalid)
+        membudget.set_budget(128 * 1024)
+        d0 = _cnt("copr.degraded_partition")
+        failpoint.enable("device/oom", when=("first", 1))
+        try:
+            st: dict = {}
+            li1, ri1 = membudget.join_match_pairs(
+                lkey, lvalid, rkey, rvalid, stats=st)
+        finally:
+            failpoint.disable("device/oom")
+        assert st["partition_escalations"] == 1
+        assert st["partitions"] >= 4       # doubled at least once
+        assert _cnt("copr.degraded_partition") == d0 + 1
+        assert np.array_equal(li0, li1) and np.array_equal(ri0, ri1)
+
+    def test_oom_escalation_is_bounded(self):
+        lkey, lvalid, rkey, rvalid = _mk_keys(seed=6, n_l=8_000,
+                                              n_r=4_000)
+        membudget.set_budget(32 * 1024)
+        failpoint.enable("device/oom")       # every pass OOMs forever
+        try:
+            with pytest.raises(errors.DeviceError):
+                membudget.join_match_pairs(lkey, lvalid, rkey, rvalid)
+        finally:
+            failpoint.disable("device/oom")
+
+
+class TestMeshPartitionedProbe:
+    def _mesh(self):
+        from tidb_tpu.parallel import CoprMesh
+        mesh = CoprMesh()
+        assert mesh.n == 8, "test env must expose 8 virtual devices"
+        return mesh
+
+    def test_key_partitioned_probe_parity(self):
+        mesh = self._mesh()
+        lkey, lvalid, rkey, rvalid = _mk_keys(seed=9)
+        membudget.set_budget(0)
+        li0, ri0 = membudget.join_match_pairs(lkey, lvalid, rkey, rvalid)
+        membudget.set_budget(64 * 1024)
+        p0 = _cnt("copr.partitioned_passes")
+        st: dict = {}
+        li1, ri1 = membudget.join_match_pairs(lkey, lvalid, rkey, rvalid,
+                                              stats=st, mesh=mesh)
+        assert st["mesh_partitioned"] and st["mesh_shards"] == 8
+        assert _cnt("copr.partitioned_passes") == p0 + 8
+        assert np.array_equal(li0, li1) and np.array_equal(ri0, ri1)
+
+    def test_collective_fault_degrades_to_replicated(self):
+        """partitioned-mesh → replicated-mesh rung: the collective
+        failpoint kills the key-partitioned probe, the replicated probe
+        answers (the failpoint seam lives only in the partitioned
+        kernel), counted on copr.degraded_mesh — answers unchanged."""
+        mesh = self._mesh()
+        lkey, lvalid, rkey, rvalid = _mk_keys(seed=13)
+        membudget.set_budget(0)
+        li0, ri0 = membudget.join_match_pairs(lkey, lvalid, rkey, rvalid)
+        membudget.set_budget(64 * 1024)
+        d0 = _cnt("copr.degraded_mesh")
+        failpoint.enable("device/mesh_collective")
+        try:
+            st: dict = {}
+            li1, ri1 = membudget.join_match_pairs(
+                lkey, lvalid, rkey, rvalid, stats=st, mesh=mesh)
+        finally:
+            failpoint.disable("device/mesh_collective")
+        assert "mesh_partitioned" not in st
+        assert _cnt("copr.degraded_mesh") >= d0 + 1
+        assert np.array_equal(li0, li1) and np.array_equal(ri0, ri1)
+
+
+N_PROBE = 3000
+N_BUILD = 2000
+JOIN_Q = "select l.id, r.w from l join r on l.k = r.k order by l.id, r.w"
+OUTER_Q = ("select l.id, r.w from l left join r on l.k = r.k "
+           "order by l.id, r.w")
+AGG_Q = "select count(*), sum(r.w), min(l.id) from l join r on l.k = r.k"
+
+
+def _join_store() -> TestKit:
+    tk = TestKit()
+    tk.exec("create database mb; use mb")
+    tk.exec("create table l (id bigint primary key, k bigint)")
+    tk.exec("create table r (id bigint primary key, k bigint, w bigint)")
+    lrows = ", ".join(f"({i}, {i % (N_BUILD + 40)})"
+                      for i in range(1, N_PROBE + 1))
+    tk.exec(f"insert into l values {lrows}")
+    rrows = ", ".join(f"({i}, {i % N_BUILD}, {i * 7})"
+                      for i in range(1, N_BUILD + 1))
+    tk.exec(f"insert into r values {rrows}")
+    tk.store.set_client(TpuClient(tk.store, dispatch_floor_rows=0))
+    return tk
+
+
+class TestExecutorRoute:
+    def test_sql_parity_partitioned_vs_kill_switch(self):
+        tk = _join_store()
+        membudget.set_budget(0)
+        oracle = [tk.query(q).rows for q in (JOIN_Q, OUTER_Q, AGG_Q)]
+        tk.exec("set global tidb_tpu_hbm_budget_bytes = 12288")
+        assert membudget.budget_bytes() == 12288
+        j0 = _cnt("copr.partitioned_joins")
+        p0 = _cnt("copr.partitioned_passes")
+        got = [tk.query(q).rows for q in (JOIN_Q, OUTER_Q, AGG_Q)]
+        assert _cnt("copr.partitioned_joins") >= j0 + 3
+        assert _cnt("copr.partitioned_passes") >= p0 + 6  # >=2 per join
+        assert got == oracle
+        # kill switch pins the unpartitioned route
+        tk.exec("set global tidb_tpu_hbm_budget_bytes = 0")
+        j1 = _cnt("copr.partitioned_joins")
+        assert [tk.query(q).rows
+                for q in (JOIN_Q, OUTER_Q, AGG_Q)] == oracle
+        assert _cnt("copr.partitioned_joins") == j1
+
+    def test_chaos_oom_mid_pass_answers_unchanged(self):
+        """The satellite chaos schedule: a prob-seeded device/oom fires
+        mid-pass across repeated partitioned joins — P escalates
+        (copr.degraded_partition) and every answer stays equal to the
+        kill-switch oracle; even a join that exhausts its escalation
+        budget lands on the executor's numpy rung, never an error."""
+        tk = _join_store()
+        membudget.set_budget(0)
+        oracle = tk.query(JOIN_Q).rows
+        tk.exec("set global tidb_tpu_hbm_budget_bytes = 12288")
+        d0 = _cnt("copr.degraded_partition")
+        failpoint.enable("device/oom", when=("prob", 0.25), seed=42)
+        try:
+            for _ in range(6):
+                assert tk.query(JOIN_Q).rows == oracle
+        finally:
+            failpoint.disable("device/oom")
+        assert _cnt("copr.degraded_partition") > d0, \
+            "no pass ever escalated under the prob schedule"
+        tk.exec("set global tidb_tpu_hbm_budget_bytes = 'auto'")
+
+    def test_sysvar_is_global_only_and_validated(self):
+        tk = _join_store()
+        with pytest.raises(errors.TiDBError):
+            tk.exec("set tidb_tpu_hbm_budget_bytes = 4096")   # no GLOBAL
+        with pytest.raises(errors.TiDBError):
+            tk.exec("set global tidb_tpu_hbm_budget_bytes = 'sometimes'")
+        with pytest.raises(errors.TiDBError):
+            tk.exec("set global tidb_tpu_hbm_budget_bytes = -3")
+        tk.exec("set global tidb_tpu_hbm_budget_bytes = 'auto'")
+        r = tk.query("select @@tidb_tpu_hbm_budget_bytes").rows
+        assert r[0][0] in (b"auto", "auto")
+
+    def test_dict_join_partitions_through_host_keys_fn(self):
+        """String-key joins reach the partitioned route through the
+        LAZY host-key planes: the device remap path skips them, the
+        out-of-core rungs resolve them on demand — answers equal the
+        kill-switch oracle."""
+        tk = TestKit()
+        tk.exec("create database mbs; use mbs")
+        tk.exec("create table sl (id bigint primary key, s varchar(16))")
+        tk.exec("create table sr (id bigint primary key, s varchar(16), "
+                "w bigint)")
+        lrows = ", ".join(f"({i}, 'k{i % 600}')" for i in range(1, 2501))
+        tk.exec(f"insert into sl values {lrows}")
+        rrows = ", ".join(f"({i}, 'k{i % 500}', {i})"
+                          for i in range(1, 2001))
+        tk.exec(f"insert into sr values {rrows}")
+        tk.store.set_client(TpuClient(tk.store, dispatch_floor_rows=0))
+        q = ("select sl.id, sr.w from sl join sr on sl.s = sr.s "
+             "order by sl.id, sr.w")
+        membudget.set_budget(0)
+        oracle = tk.query(q).rows
+        membudget.set_budget(12 * 1024)
+        j0 = _cnt("copr.partitioned_joins")
+        assert tk.query(q).rows == oracle
+        assert _cnt("copr.partitioned_joins") == j0 + 1
+
+
+class TestPlaneCachePinSkip:
+    def test_pin_skipped_under_pressure_cache_still_serves(self):
+        from tidb_tpu import tablecodec as tc
+        from tidb_tpu.session import Session, new_store
+        store = new_store("cluster://3/mbpin1")
+        s = Session(store)
+        s.execute("create database pc")
+        s.execute("use pc")
+        s.execute("create table t (id bigint primary key, v bigint)")
+        s.execute("insert into t values " +
+                  ", ".join(f"({i}, {i * 3})" for i in range(1, 1201)))
+        tid = s.info_schema().table_by_name("pc", "t").info.id
+        store.cluster.split_keys([tc.encode_row_key(tid, 601)])
+        q = "select count(*), sum(v) from t"
+        membudget.set_budget(0)
+        oracle = s.execute(q)[0].values()
+        # a 1-byte budget: every admission must skip the device pin but
+        # still cache host-side (repeat scans hit)
+        membudget.set_budget(1)
+        sk0 = _cnt("copr.plane_cache.pin_skipped")
+        h0 = _cnt("copr.plane_cache.hits")
+        s.execute("insert into pc.t values (9999, 1)")  # orphan entries
+        s.execute(q)
+        assert _cnt("copr.plane_cache.pin_skipped") > sk0
+        got = s.execute(q)[0].values()
+        assert _cnt("copr.plane_cache.hits") > h0
+        assert [int(v) for v in got[0][:1]] == [int(oracle[0][0]) + 1]
+
+
+class TestInspectionRule:
+    def test_hbm_pressure_fires_and_clears(self):
+        from tidb_tpu.metrics import timeseries
+        membudget.set_budget(10_000)
+        membudget.pin(9_500)
+        try:
+            timeseries.recorder.sample()
+            findings = [f for f in inspection.inspect()
+                        if f["rule"] == "hbm-pressure"]
+            assert findings, "pressured ledger did not fire hbm-pressure"
+            assert findings[0]["item"] == "ledger"
+            assert findings[0]["value"] >= \
+                inspection.threshold("hbm_pressure_ratio")
+        finally:
+            membudget.unpin(9_500)
+        # pressure drained (the budget outgrows the live pinned set —
+        # earlier tests' batches may still pin real planes): the rule
+        # clears once the over-budget burst ages out of the window
+        membudget.set_budget(membudget.usage()[1] * 4 + (1 << 20))
+        for _ in range(int(inspection.threshold("window_samples")) + 2):
+            timeseries.recorder.sample()
+            time.sleep(0.002)   # forced sub-ms samples coalesce
+        assert not [f for f in inspection.inspect()
+                    if f["rule"] == "hbm-pressure"], \
+            "rule did not clear after the ledger drained"
+
+    def test_unlimited_budget_never_fires(self):
+        from tidb_tpu.metrics import timeseries
+        membudget.set_budget(0)
+        membudget.pin(1 << 30)
+        try:
+            timeseries.recorder.sample()
+            assert not [f for f in inspection.inspect()
+                        if f["rule"] == "hbm-pressure"]
+        finally:
+            membudget.unpin(1 << 30)

@@ -400,11 +400,25 @@ def _maybe_pin_device(batch) -> bool:
     """Pin the batch's planes device-resident when the TPU tier is live
     in this process — the H2D happens once, at insert, and every repeat
     query reads HBM. A jax-free deployment never pays (or imports)
-    anything here."""
+    anything here.
+
+    HBM governance (ops.membudget): a pin that would cross the
+    configured `tidb_tpu_hbm_budget_bytes` is SKIPPED — the entry still
+    caches host-side (repeat queries skip the repack, they just pay the
+    H2D again), counted on `copr.plane_cache.pin_skipped`. The ledger
+    charge itself rides kernels.batch_planes, so pinned bytes un-charge
+    exactly when the device buffers die."""
     if sys.modules.get("jax") is None:
         return False
     try:
+        from tidb_tpu.ops import membudget
         from tidb_tpu.ops.client import pin_batch_device
+        dev_bytes = sum(int(cd.values.nbytes) + int(cd.valid.nbytes)
+                        for cd in batch.columns.values()) + batch.capacity
+        if membudget.would_exceed_pin(dev_bytes) \
+                and getattr(batch, "_device_planes", None) is None:
+            _metric("pin_skipped").inc()
+            return False
         pin_batch_device(batch)
         return True
     except errors.RetryableError:

@@ -136,6 +136,29 @@ class _ProjectedView:
     def datum_at(self, j: int, i: int):
         return self.res.datum_at(self.idx_map[j], i)
 
+    def gather_datums(self, j: int, idx):
+        g = getattr(self.res, "gather_datums", None)
+        if g is not None:
+            return g(self.idx_map[j], idx)
+        return [self.res.datum_at(self.idx_map[j], int(i)) for i in idx]
+
+
+def _gather_rows(res, idx, width: int) -> list:
+    """Materialize the winner rows `idx` of a columnar result in ONE
+    batched plane gather per column (res.gather_datums) instead of
+    width × rows per-cell datum_at calls — the emit path of the plane
+    TopN/DISTINCT fast paths. Falls back to the per-cell protocol for
+    results without a batched gather; values identical by construction
+    (gather_datums mirrors datum_at branch for branch)."""
+    if not len(idx):
+        return []
+    g = getattr(res, "gather_datums", None)
+    if g is None:
+        return [[res.datum_at(j, int(i)) for j in range(width)]
+                for i in idx]
+    cols = [g(j, idx) for j in range(width)]
+    return [list(t) for t in zip(*cols)]
+
 
 def _columnar_view(child):
     """(columnar result provider node, start) for a plane fast path:
@@ -318,9 +341,9 @@ class TopNExec(Executor):
             sort_keys.append(nullk)
         order = np.lexsort(sort_keys)   # stable: ties keep emission order
         limit = self.offset + self.count
-        keep = order[self.offset: limit].tolist()
-        self._rows = [(None, [res.datum_at(j, i) for j in range(width)],
-                       None) for i in keep]
+        keep = order[self.offset: limit]
+        self._rows = [(None, row, None)
+                      for row in _gather_rows(res, keep, width)]
         from tidb_tpu import metrics
         metrics.counter("copr.dict.topn_plane").inc()
         js = getattr(node, "join_stats", None)
@@ -408,8 +431,7 @@ class DistinctExec(Executor):
         width = len(self.schema)
         from tidb_tpu import metrics
         metrics.counter("copr.dict.distinct_plane").inc()
-        return [[res.datum_at(j, int(i)) for j in range(width)]
-                for i in keep.tolist()]
+        return _gather_rows(res, keep, width)
 
     def next(self):
         from tidb_tpu.expression.ops import casefold_datum
@@ -979,8 +1001,22 @@ class HashJoinExec(Executor):
             self._finish_pairs(lside, rside, empty, empty.copy(), left_ok)
             return True
         l_specs, r_specs = specs
-        lkey, lvalid = dict_mod.host_keys(l_specs, len(lside))
-        rkey, rvalid = dict_mod.host_keys(r_specs, len(rside))
+
+        # host key planes build LAZILY: when the device remap route
+        # takes over they are never needed (the remap kernel computes
+        # the same composite codes on device), so the host pass is paid
+        # only by the below-floor route, a device bail, or an
+        # out-of-core rung that partitions on host planes
+        host_planes: list | None = None
+
+        def host_keys_fn():
+            nonlocal host_planes
+            if host_planes is None:
+                host_planes = [
+                    dict_mod.host_keys(l_specs, len(lside)),
+                    dict_mod.host_keys(r_specs, len(rside))]
+            return host_planes
+
         floor = self._device_join_floor()
         if floor is not None and max(len(lside), len(rside)) >= floor:
             from tidb_tpu.ops import columnar as col_mod
@@ -1004,9 +1040,12 @@ class HashJoinExec(Executor):
                 stats["device_error"] = True
                 return bail()
             try:
-                self._start_device(lside, rside, lkey, lvalid, rkey,
-                                   rvalid, left_ok,
-                                   device_keys=(lk_d, lv_d, rk_d, rv_d))
+                self._start_device(lside, rside, None, None, None, None,
+                                   left_ok,
+                                   device_keys=(lk_d, lv_d, rk_d, rv_d),
+                                   sizes=(len(lside), len(rside)),
+                                   host_keys_fn=host_keys_fn)
+                stats["host_keys_skipped"] = host_planes is None
                 return True
             except Exception:
                 # build/probe rung of the degradation chain, same as the
@@ -1018,6 +1057,7 @@ class HashJoinExec(Executor):
                     exc_info=True)
                 tracing.record_degraded("join_to_numpy")
                 stats["device_error"] = True
+        (lkey, lvalid), (rkey, rvalid) = host_keys_fn()
         return self._numpy_pairs(lside, rside, lkey, lvalid, rkey, rvalid,
                                  left_ok)
 
@@ -1040,19 +1080,29 @@ class HashJoinExec(Executor):
         return (dl[0], dl[1], dr[0], dr[1])
 
     def _start_device(self, lside, rside, lkey, lvalid, rkey, rvalid,
-                      left_ok, device_keys=None) -> None:
+                      left_ok, device_keys=None, sizes=None,
+                      host_keys_fn=None) -> None:
         """Run the device join kernels and assemble the columnar result
         (final emission-order index pairs; r_idx -1 = LEFT OUTER pad).
         Rows are NOT materialized here — an aggregate parent fuses over
         the gathered planes instead (executor.fused_agg), and columnar
-        scan sides keep even the SCAN rows unmaterialized."""
-        from tidb_tpu.ops import kernels
+        scan sides keep even the SCAN rows unmaterialized.
+
+        Routing rides the HBM governance tier (ops.membudget): a build
+        side above the ledger's headroom takes the radix-partitioned
+        out-of-core route (key-partitioned mesh probe → replicated mesh
+        → single-device passes) instead of one oversized dispatch. With
+        `sizes`/`host_keys_fn` the host key planes may be None (the
+        dictionary route defers building them until a rung needs
+        them)."""
+        from tidb_tpu.ops import membudget
         stats = self.join_stats
         mesh = self._join_mesh()
-        li, ri = kernels.join_match_pairs(lkey, lvalid, rkey, rvalid,
-                                          stats=stats,
-                                          device_keys=device_keys,
-                                          mesh=mesh)
+        li, ri = membudget.join_match_pairs(lkey, lvalid, rkey, rvalid,
+                                            stats=stats,
+                                            device_keys=device_keys,
+                                            mesh=mesh, sizes=sizes,
+                                            host_keys_fn=host_keys_fn)
         self._finish_pairs(lside, rside, li, ri, left_ok)
         stats["path"] = "device"
         if mesh is not None and mesh.n > 1:

@@ -135,6 +135,8 @@ _GLOBAL_ONLY_TPU_VARS = {
     "tidb_tpu_delta_pack": "apply_tpu_delta_pack",
     "tidb_tpu_delta_budget_rows": "apply_tpu_delta_budget_rows",
     "tidb_tpu_mesh": "apply_tpu_mesh",
+    # HBM governance ledger (ops.membudget): process-wide budget
+    "tidb_tpu_hbm_budget_bytes": "apply_tpu_hbm_budget",
     "tidb_tpu_micro_batch": "apply_tpu_micro_batch",
     "tidb_tpu_batch_window_ms": "apply_tpu_batch_window",
     "tidb_tpu_conn_queue_depth": "apply_conn_queue_depth",

@@ -37,6 +37,10 @@ DEFAULTS: dict[str, float] = {
     "batch_expiries": 3,        # gather-window deadline expiries
     "mesh_skew": 2.0,           # max/mean per-shard rows
     "mesh_skew_rows": 256,      # ignore skew on trivial row counts
+    # HBM governance: (pinned + reserved) / budget above this fires
+    # hbm-pressure (any over-budget reservation in the window fires
+    # regardless — the ledger let it through, but it is evidence)
+    "hbm_pressure_ratio": 0.85,
 }
 
 SYSVAR_PREFIX = "tidb_tpu_inspection_"
@@ -201,9 +205,42 @@ def _rule_mesh_shard_skew(d: dict, begin: float, end: float) -> list:
         begin, end)]
 
 
+def _rule_hbm_pressure(d: dict, begin: float, end: float) -> list:
+    """Device memory is running out of headroom: pinned planes plus
+    in-flight reservations sit above the pressure ratio of the
+    configured budget, or a reservation crossed the budget outright
+    (device.hbm.over_budget rose). Under sustained pressure the join
+    tier is partitioning into passes and the plane cache is skipping
+    device pins — correct, but slower than a budget raise or a smaller
+    pinned working set. Only fires with an explicit budget
+    (tidb_tpu_hbm_budget_bytes > 0); driven by the ledger itself under
+    a tiny budget."""
+    budget = d.get("device.hbm.budget", 0.0)
+    if budget <= 0:
+        return []
+    used = d.get("device.hbm.pinned", 0.0) + d.get("device.hbm.reserved",
+                                                   0.0)
+    over = d.get("device.hbm.over_budget", 0.0)
+    ratio = used / budget
+    if ratio < threshold("hbm_pressure_ratio") and over < 1:
+        return []
+    return [_result(
+        "hbm-pressure", "ledger",
+        "critical" if ratio >= 1.0 or over >= 1 else "warning",
+        round(ratio, 3),
+        f"(pinned + reserved) / budget < "
+        f"{threshold('hbm_pressure_ratio'):g}",
+        f"{int(used)} of {int(budget)} budgeted HBM bytes in use "
+        f"({int(over)} over-budget reservations in the window) — "
+        "oversized joins are partitioning into passes and the plane "
+        "cache is skipping device pins; raise "
+        "tidb_tpu_hbm_budget_bytes or shrink the pinned working set",
+        begin, end)]
+
+
 RULES = (_rule_degradation_burst, _rule_cache_collapse,
          _rule_admission_saturation, _rule_batch_expiry_spike,
-         _rule_mesh_shard_skew)
+         _rule_mesh_shard_skew, _rule_hbm_pressure)
 
 
 def inspect(window: int | None = None) -> list[dict]:

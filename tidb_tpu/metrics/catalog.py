@@ -107,6 +107,15 @@ CATALOG: dict[str, tuple[str, str]] = {
     "ops.kernel_seconds": ("histogram", "Wall time of one device dispatch + readback."),
     "device.busy_us": ("counter", "Cumulative microseconds the serialized device executed a program (metered inside kernels.dispatch_serial)."),
     "device.busy_fraction": ("gauge", "Fraction of the last metrics-recorder window the device was executing (device saturated vs host stalled)."),
+    # ---- HBM governance tier (ops.membudget) ----
+    "device.hbm.budget": ("gauge", "Resolved HBM budget in bytes (tidb_tpu_hbm_budget_bytes; 0 = unlimited/kill switch)."),
+    "device.hbm.reserved": ("gauge", "Bytes currently reserved by in-flight dispatch working sets (joins, batched dispatches, kernel inputs)."),
+    "device.hbm.pinned": ("gauge", "Bytes of device-resident pinned planes charged to the ledger (plane cache + batch planes)."),
+    "device.hbm.headroom": ("gauge", "Bytes a new reservation may take before crossing the budget (0 when unlimited)."),
+    "device.hbm.over_budget": ("counter", "Reservations that proceeded past the configured HBM budget (the hbm-pressure rule's evidence)."),
+    "copr.partitioned_joins": ("counter", "Joins whose build side exceeded the HBM headroom and took the radix-partitioned out-of-core route."),
+    "copr.partitioned_passes": ("counter", "Partition executions of out-of-core joins (single-device passes, or per-shard partitions of the key-partitioned mesh probe)."),
+    "copr.plane_cache.pin_skipped": ("counter", "Plane-cache admissions that skipped the device pin because pinning would cross the HBM budget."),
     # ---- micro-batch scheduler ----
     "sched.batched_dispatches": ("counter", "Shared micro-batched device dispatches."),
     "sched.batched_statements": ("counter", "Statements answered through a shared batched dispatch."),

@@ -498,16 +498,21 @@ class TpuClient(kv.Client):
             # (kernels.dispatch_serial): concurrent sessions racing a
             # program's dispatch/first-compile can wedge the runtime.
             # The lock is metered — held time feeds device.busy_us and
-            # the diagnostics tier's device.busy_fraction window gauge
-            with kernels.dispatch_serial:
-                packed = jitted(planes, live, *extra)
-                t_disp = _time.perf_counter()
-                if failpoint._active:
-                    failpoint.eval("device/readback",
-                                   lambda: errors.DeviceError(
-                                       f"injected readback failure "
-                                       f"({kind})"))
-                host = np.asarray(packed)
+            # the diagnostics tier's device.busy_fraction window gauge.
+            # The dispatch's transient working set charges the HBM
+            # governance ledger for its duration (device.hbm.reserved)
+            from tidb_tpu.ops import membudget
+            with membudget.reserve(
+                    membudget.planes_nbytes(planes, live, extra), kind):
+                with kernels.dispatch_serial:
+                    packed = jitted(planes, live, *extra)
+                    t_disp = _time.perf_counter()
+                    if failpoint._active:
+                        failpoint.eval("device/readback",
+                                       lambda: errors.DeviceError(
+                                           f"injected readback failure "
+                                           f"({kind})"))
+                    host = np.asarray(packed)
         except errors.TiDBError:
             sp.set("error", "fault").finish()   # a dead span must not
             raise                               # bleed to statement end

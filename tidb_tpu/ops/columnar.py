@@ -558,6 +558,38 @@ def plane_datum(cd: ColumnData, c: PBColumnInfo, i: int) -> Datum:
     return Datum.i64(v)
 
 
+def plane_datums_batch(cd: ColumnData, c: PBColumnInfo,
+                       rows: np.ndarray) -> list[Datum]:
+    """plane_datum over a batch of plane cells: ONE numpy gather per
+    plane, datum construction off the small gathered arrays — the
+    batched emit for TopN/DISTINCT winner rows (the per-cell loop paid
+    a plane lookup + validity read per cell). Value-identical to
+    plane_datum by construction: same branch per kind, same decode."""
+    vals = cd.values[rows]
+    valid = cd.valid[rows].tolist()
+    if cd.kind == K_STR:
+        dic = cd.dictionary
+        return [Datum.bytes_(dic[v]) if ok else NULL
+                for v, ok in zip(vals.tolist(), valid)]
+    if cd.kind == K_F64:
+        return [Datum.f64(v) if ok else NULL
+                for v, ok in zip(vals.tolist(), valid)]
+    if cd.kind == K_DEC:
+        scale = Decimal(10) ** cd.dec_scale
+        return [Datum.dec(Decimal(v) / scale) if ok else NULL
+                for v, ok in zip(vals.tolist(), valid)]
+    if c.tp in my.TIME_TYPES:
+        from tidb_tpu.types.time_types import Time
+        return [Datum(Kind.TIME, Time.from_packed_int(v, c.tp)) if ok
+                else NULL for v, ok in zip(vals.tolist(), valid)]
+    if c.tp == my.TypeDuration:
+        from tidb_tpu.types.time_types import Duration
+        return [Datum(Kind.DURATION, Duration(v)) if ok else NULL
+                for v, ok in zip(vals.tolist(), valid)]
+    return [Datum.i64(v) if ok else NULL
+            for v, ok in zip(vals.tolist(), valid)]
+
+
 class ColumnarScanResult:
     """A scan's columnar answer: the packed ColumnBatch plus the selection
     index (filter/TopN survivors, in emission order) and the output column
@@ -754,6 +786,24 @@ class ColumnarScanResult:
                         int(self.sel[i]))
         return unflatten_datum(d, self._ft(j))
 
+    def gather_datums(self, j: int, idx) -> list[Datum]:
+        """Typed datums for output rows `idx` (positions into sel),
+        column j — the batched twin of datum_at (one plane gather,
+        identical values by construction: plane_datums_batch follows
+        plane_datum branch for branch, then the same unflatten)."""
+        if self._rows_cache is not None:
+            return [self._rows_cache[int(i)][j] for i in idx]
+        from tidb_tpu.types.convert import (
+            unflatten_datum, unflatten_identity_kinds,
+        )
+        c = self.pb_cols[j]
+        cd = self.batch.columns[c.column_id]
+        ft = self._ft(j)
+        idk = unflatten_identity_kinds(ft)
+        rows = self.sel[np.asarray(idx, dtype=np.int64)]
+        return [d if d.kind in idk else unflatten_datum(d, ft)
+                for d in plane_datums_batch(cd, c, rows)]
+
     def iter_rows_with_handles(self):
         return iter(zip(self.handles().tolist(), self.rows()))
 
@@ -920,6 +970,24 @@ class ColumnarPartialSet:
     def datum_at(self, j: int, i: int):
         part, local = self._locate(i)
         return part.datum_at(j, local)
+
+    def gather_datums(self, j: int, idx) -> list:
+        """Batched datum_at: stacked positions split per region partial
+        (one locate pass), each partial answering with its own plane
+        gather, reassembled in the callers' order."""
+        gidx = np.asarray(idx, dtype=np.int64)
+        pids = np.searchsorted(self.offsets, gidx, side="right") - 1
+        out: list = [None] * len(gidx)
+        for p in np.unique(pids).tolist():
+            m = pids == p
+            local = gidx[m] - int(self.offsets[p])
+            part = self.parts[p]
+            g = getattr(part, "gather_datums", None)
+            sub = g(j, local) if g is not None else \
+                [part.datum_at(j, int(i)) for i in local.tolist()]
+            for pos, d in zip(np.flatnonzero(m).tolist(), sub):
+                out[pos] = d
+        return out
 
     def rows(self) -> list:
         if self._rows_cache is None:
@@ -1189,6 +1257,10 @@ class RowsSide:
     def datum_at(self, j: int, i: int):
         return self._rows[i][j]
 
+    def gather_datums(self, j: int, idx) -> list:
+        rows = self._rows
+        return [rows[int(i)][j] for i in idx]
+
 
 # ---------------------------------------------------------------------------
 # join output assembly: planes over the two join sides (materialized
@@ -1351,6 +1423,21 @@ class DeviceJoinResult:
         r = int(self.r_idx[i])
         return NULL if r < 0 else self.rside.datum_at(j - self.left_width, r)
 
+    def gather_datums(self, j: int, idx) -> list:
+        """Batched datum_at through the match pairs: one index
+        translation, then the source side's own plane gather (LEFT
+        OUTER pads fold in as NULLs)."""
+        gidx = np.asarray(idx, dtype=np.int64)
+        if j < self.left_width:
+            return _side_gather(self.lside, j, self.l_idx[gidx])
+        r = self.r_idx[gidx]
+        pad = r < 0
+        if not len(self.rside) or pad.all():
+            return [NULL] * len(gidx)
+        vals = _side_gather(self.rside, j - self.left_width,
+                            np.where(pad, 0, r))
+        return [NULL if p else v for p, v in zip(pad.tolist(), vals)]
+
     def region_slices(self):
         """Per-region [start, end) segments of the JOIN OUTPUT, inherited
         from a multi-region left side: emission is left-scan order, so
@@ -1402,6 +1489,15 @@ class DeviceJoinResult:
                 stats["emit_s"] = stats.get("emit_s", 0.0) + \
                     (time.time() - t0)
             yield from rows
+
+
+def _side_gather(side, j: int, rows_idx: np.ndarray) -> list:
+    """One side's datums for a translated row index: the side's own
+    batched gather when it has one, the per-cell protocol otherwise."""
+    g = getattr(side, "gather_datums", None)
+    if g is not None:
+        return g(j, rows_idx)
+    return [side.datum_at(j, int(i)) for i in rows_idx.tolist()]
 
 
 def materialize_join_rows(lrows, rrows, l_idx, r_idx,

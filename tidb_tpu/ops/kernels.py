@@ -165,10 +165,24 @@ def unpack_outputs(wrapper, packed: np.ndarray) -> list:
     return out
 
 
+def _charge_pinned(batch, nbytes: int) -> None:
+    """Charge freshly pinned device planes against the HBM governance
+    ledger (ops.membudget: `device.hbm.pinned`), un-charged exactly when
+    the batch — and therefore its device buffers — dies. The weakref
+    finalizer tracks the buffers' true lifetime: a cache eviction frees
+    the charge only once no in-flight result still holds the planes."""
+    import weakref
+
+    from tidb_tpu.ops import membudget
+    membudget.pin(nbytes)
+    weakref.finalize(batch, membudget.unpin, nbytes)
+
+
 def batch_planes(batch: col.ColumnBatch, with_pos: bool = False) -> dict:
     """Host numpy → device arrays, one (values, valid) pair per column.
     Memoized on the batch: planes stay device-resident across requests
-    (HBM residency is the point of the columnar cache).
+    (HBM residency is the point of the columnar cache). The H2D charges
+    the HBM budget ledger as PINNED bytes for the planes' lifetime.
 
     with_pos adds the POS_CID plane — global row positions for exact
     first_row (sharded with the data, so positions remain global under
@@ -178,11 +192,14 @@ def batch_planes(batch: col.ColumnBatch, with_pos: bool = False) -> dict:
         planes = {cid: (jnp.asarray(cd.values), jnp.asarray(cd.valid))
                   for cid, cd in batch.columns.items()}
         batch._device_planes = planes
+        _charge_pinned(batch, sum(int(v.nbytes) + int(va.nbytes)
+                                  for v, va in planes.values()))
     if with_pos:
         pos = getattr(batch, "_device_pos", None)
         if pos is None:
             pos = (jnp.arange(batch.capacity, dtype=jnp.int64), None)
             batch._device_pos = pos
+            _charge_pinned(batch, int(pos[0].nbytes))
         planes = dict(planes)
         planes[POS_CID] = pos
     return planes
@@ -200,7 +217,7 @@ def gather_plane(values, valid, sel):
     if _gather_jit is None:
         _gather_jit = jax.jit(
             lambda v, va, s: (jnp.take(v, s), jnp.take(va, s)))
-    return _gather_jit(values, valid, jnp.asarray(sel))
+    return _gather_jit(values, valid, jnp.asarray(sel))  # dispatch-ok: device-resident gather, no readback
 
 
 _stack_cache: dict = {}
@@ -224,7 +241,8 @@ def stack_planes(parts):
         fn = _stack_cache[key] = jax.jit(impl)
         if len(_stack_cache) > 256:
             _stack_cache.pop(next(iter(_stack_cache)))
-    return fn(*[v for v, _va in parts], *[va for _v, va in parts])
+    return fn(*[v for v, _va in parts],  # dispatch-ok: device-resident concat, no readback
+              *[va for _v, va in parts])
 
 
 _pad_cache: dict = {}
@@ -245,7 +263,7 @@ def _device_pad(arr, cap: int):
             lambda v: jnp.concatenate([v, jnp.zeros(pad, v.dtype)]))
         if len(_pad_cache) > 256:
             _pad_cache.pop(next(iter(_pad_cache)))
-    return fn(arr)
+    return fn(arr)  # dispatch-ok: device-resident pad, no readback
 
 
 _delta_merge_cache: dict = {}
@@ -343,6 +361,7 @@ def device_live(batch: col.ColumnBatch):
     arr = getattr(batch, "_device_live", None)
     if arr is None:
         arr = batch._device_live = jnp.asarray(batch.row_mask())
+        _charge_pinned(batch, int(arr.nbytes))
     return arr
 
 
@@ -1277,7 +1296,7 @@ join_probe_kernel = jax.jit(_join_probe_impl,
 
 
 def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
-                     device_keys=None, mesh=None):
+                     device_keys=None, mesh=None, sizes=None):
     """Host driver for the device join kernels: numpy key planes in,
     (l_idx, r_idx) int64 numpy match pairs out, in left-scan order with
     ties in right-scan order.
@@ -1295,16 +1314,20 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
     the bytes of the int64 packing — the probe readback dominates the
     join's round-trip cost on tunneled deployments). `stats`, when
     given, receives build_s / probe_s wall times (readback-certified)
-    for the bench's phase split."""
+    for the bench's phase split. With `sizes` = (n_left, n_right) and
+    device_keys given, the host key planes may be None entirely — the
+    dictionary route skips building them when the device remap route
+    takes over (host planes are otherwise read only for lengths)."""
     import time as _time
 
     from tidb_tpu import errors, failpoint
     if failpoint._active:
         failpoint.eval("device/join", lambda: errors.DeviceError(
             "injected device join failure"))
-    n_left = int(lkey.shape[0])
+    n_left = int(sizes[0]) if lkey is None else int(lkey.shape[0])
+    n_right = int(sizes[1]) if rkey is None else int(rkey.shape[0])
     lcap = col.bucket_capacity(max(n_left, 1))
-    rcap = col.bucket_capacity(max(int(rkey.shape[0]), 1))
+    rcap = col.bucket_capacity(max(n_right, 1))
     from tidb_tpu import tracing
     t0 = _time.time()
     bsp = tracing.current().child("kernel").set("kind", "join_build")
@@ -1315,9 +1338,9 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
         bsp.set("device_resident", True)
     else:
         rk = np.zeros(rcap, dtype=rkey.dtype)
-        rk[: rkey.shape[0]] = rkey
+        rk[:n_right] = rkey
         rv = np.zeros(rcap, dtype=bool)
-        rv[: rkey.shape[0]] = rvalid
+        rv[:n_right] = rvalid
         rk_d, rv_d = jnp.asarray(rk), jnp.asarray(rv)
 
     # build: dispatch only — its outputs stay device-resident as the
@@ -1325,7 +1348,7 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
     # deployments a sync would cost a whole extra round trip; build_s is
     # therefore dispatch time, and probe_s, which ends at the certified
     # pair readback, absorbs the build's actual compute)
-    rs, order, n_valid = join_build_kernel(rk_d, rv_d)
+    rs, order, n_valid = join_build_kernel(rk_d, rv_d)  # dispatch-ok: outputs stay device-resident as the probe's inputs
     bsp.finish()
     tracing.record_dispatch(readbacks=0)   # outputs stay device-resident
     if stats is not None:
@@ -1487,7 +1510,7 @@ def dict_remap_keys(specs, cap: int):
     sp = _tracing.current().child("kernel").set("kind", "dict_remap") \
         .set("key_cols", len(specs)).set("rows", n)
     try:
-        out = fn(*args)     # dispatch only: outputs feed the probe
+        out = fn(*args)  # dispatch-ok: dispatch only, outputs feed the probe
     except Exception as e:
         sp.set("error", "fault").finish()
         raise _errors.DeviceError(f"dictionary remap failed: {e}") from e

@@ -1,0 +1,506 @@
+"""HBM governance tier: device memory budgeting + radix-partitioned
+out-of-core joins.
+
+Nothing in the process used to account for HBM as a SHARED budget: the
+plane cache pinned packed planes device-resident, the micro-batch tier
+padded slot blocks, and every join replicated its build side on each
+shard — and the first `device/oom` bailed the whole statement to the
+host row path. PIMDAL (arxiv 2504.01948) measures analytics operators
+memory-bound long before they are compute-bound, and the
+pushdown-planning literature (Enhancing Computation Pushdown, arxiv
+2312.15405) argues operator placement must be budget-aware: a serving
+tier whose working set exceeds one device's memory must degrade into
+PASSES, not into the row path. This module supplies the two pieces:
+
+* **The budget ledger** — a process-wide account of device memory
+  (`SET GLOBAL tidb_tpu_hbm_budget_bytes`: `auto` derives the budget
+  from the backend's reported memory limit, `0` is the kill switch —
+  unlimited, every route pinned unpartitioned — and an explicit byte
+  count is the operator's cap). Long-lived plane pins
+  (`kernels.batch_planes`, the plane cache's `pin_batch_device`) charge
+  `device.hbm.pinned`; transient dispatch working sets (`TpuClient.
+  _dispatch_kernel`, the micro-batch slot blocks, join build/probe)
+  charge `device.hbm.reserved` for the duration of the dispatch;
+  `device.hbm.headroom` is what a new reservation may still take, and
+  reservations past the budget count `device.hbm.over_budget` (the
+  `hbm-pressure` inspection rule's evidence). Every later spill-capable
+  operator (sort, window, agg states) charges against the same ledger.
+
+* **The radix-partitioned grace-hash join** — when a join's build side
+  exceeds the ledger's headroom, build AND probe planes split by
+  key-code radix (splitmix64 over the int64 key image — the
+  `RegionPlacement` discipline, so float keys hash their -0.0-normalized
+  bit pattern) into P partitions, and the partitions run in PASSES
+  through the EXISTING build/probe kernels: one packed readback per
+  pass, concatenated back into global probe order (a stable argsort by
+  global left index — equal keys share a partition and per-partition
+  right order is a monotone restriction of global right-scan order, so
+  the result is BIT-IDENTICAL to the single-pass route; the parity
+  oracle is the unpartitioned join under budget 0). A real or injected
+  `device/oom` mid-pass ESCALATES P ×2 (bounded retries, counted
+  `copr.degraded_partition`) instead of abandoning the device tier.
+
+* **The key-partitioned mesh probe** (ops.mesh.join_probe_partitioned)
+  rung above the passes: on a multi-shard mesh each shard OWNS the
+  build partitions whose radix hashes there and probe rows route to the
+  owning shard in one all-to-all layout, so the build side is no longer
+  replicated per shard. Degradation: partitioned-mesh → replicated-mesh
+  → single-device passes → host numpy, counted on the existing
+  `copr.degraded_mesh` chain.
+
+jax imports live inside functions: importing this module must stay
+legal in a jax-free process (the session SET/hydration path touches
+it).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from tidb_tpu import errors, failpoint
+from tidb_tpu.sessionctx import SYSVAR_DEFAULTS
+
+DEFAULT_BUDGET_SPEC = SYSVAR_DEFAULTS["tidb_tpu_hbm_budget_bytes"]
+
+# fraction of the backend-reported device memory `auto` budgets to —
+# the runtime, XLA scratch, and non-ledger allocations need the rest
+AUTO_BUDGET_FRACTION = 0.85
+
+# partition escalation bounds: P starts at the smallest power of two
+# whose per-partition build slice fits the target headroom, doubles on
+# each device/oom, and gives up (DeviceError → the caller's host rung)
+# past MAX_PARTITIONS or MAX_ESCALATIONS
+MIN_PARTITIONS = 2
+MAX_PARTITIONS = 1024
+MAX_ESCALATIONS = 4
+
+# per-row working-set estimate of the device join BUILD side: key plane
+# (8) + valid plane (1) + the build kernel's sorted copy (8) + order
+# permutation (8), rounded up for padding slack
+BUILD_ROW_BYTES = 32
+# probe side adds its key/valid planes + the packed pair readback
+PROBE_ROW_BYTES = 16
+PAIR_ROW_BYTES = 16
+
+_lock = threading.Lock()
+_budget_spec: str | int = DEFAULT_BUDGET_SPEC
+_budget_resolved: int | None = None     # cached auto resolution
+_reserved = 0
+_pinned = 0
+
+_gauges = None
+
+
+def _g():
+    """Resolved-once gauge handles (the ledger mutates on every
+    dispatch — the registry lock + name lookup must not)."""
+    global _gauges
+    if _gauges is None:
+        from tidb_tpu import metrics
+        _gauges = (metrics.gauge("device.hbm.budget"),
+                   metrics.gauge("device.hbm.reserved"),
+                   metrics.gauge("device.hbm.pinned"),
+                   metrics.gauge("device.hbm.headroom"))
+    return _gauges
+
+
+def _publish_locked() -> None:
+    budget = _resolve_budget_locked()
+    gb, gr, gp, gh = _g()
+    gb.set(budget)
+    gr.set(_reserved)
+    gp.set(_pinned)
+    gh.set(max(budget - _reserved - _pinned, 0) if budget > 0 else 0)
+
+
+def set_budget(spec) -> None:
+    """Install the budget from its sysvar string: 'auto' (derive from
+    the backend), 0 (kill switch — unlimited, unpartitioned), or an
+    explicit byte count. Raises ValueError on anything else — the SET
+    handler surfaces it typed; the validator lives in sessionctx
+    (parse_hbm_budget_spec) so the jax-free SET path shares it."""
+    from tidb_tpu.sessionctx import parse_hbm_budget_spec
+    global _budget_spec, _budget_resolved
+    val = parse_hbm_budget_spec(spec)
+    with _lock:
+        _budget_spec = val
+        _budget_resolved = None
+        _publish_locked()
+
+
+def _resolve_budget_locked() -> int:
+    global _budget_resolved
+    if isinstance(_budget_spec, int):
+        return _budget_spec
+    if _budget_resolved is None:
+        _budget_resolved = _derive_backend_budget()
+    return _budget_resolved
+
+
+def budget_bytes() -> int:
+    """The resolved budget in bytes; 0 = unlimited (no partitioning)."""
+    with _lock:
+        return _resolve_budget_locked()
+
+
+def _derive_backend_budget() -> int:
+    """`auto`: the backend's reported per-device memory limit scaled by
+    AUTO_BUDGET_FRACTION. Backends that report no limit (the CPU-XLA
+    tier-1 rig) resolve to 0 — unlimited, so default behavior off real
+    accelerators is unchanged until an operator sets an explicit cap."""
+    import sys
+    if sys.modules.get("jax") is None:
+        return 0
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit", 0)
+        return int(limit * AUTO_BUDGET_FRACTION) if limit else 0
+    except Exception:   # backend without memory stats: unlimited
+        return 0
+
+
+def headroom() -> int:
+    """Bytes a new reservation may take before crossing the budget
+    (0 when the budget is unlimited — callers gate on budget_bytes())."""
+    with _lock:
+        budget = _resolve_budget_locked()
+        return max(budget - _reserved - _pinned, 0) if budget > 0 else 0
+
+
+def usage() -> tuple[int, int]:
+    """(reserved, pinned) — test/introspection handle."""
+    with _lock:
+        return _reserved, _pinned
+
+
+def pin(nbytes: int) -> None:
+    """Charge a long-lived device-resident allocation (pinned planes).
+    Callers pair it with unpin() at end of life — kernels.batch_planes
+    registers a weakref finalizer so the charge lives exactly as long
+    as the device buffers do."""
+    global _pinned
+    with _lock:
+        _pinned += int(nbytes)
+        _publish_locked()
+
+
+def unpin(nbytes: int) -> None:
+    global _pinned
+    with _lock:
+        _pinned = max(_pinned - int(nbytes), 0)
+        _publish_locked()
+
+
+def would_exceed_pin(nbytes: int) -> bool:
+    """True when pinning nbytes would cross the configured budget — the
+    plane cache consults this to keep admitting HOST entries while
+    skipping the device pin under HBM pressure."""
+    with _lock:
+        budget = _resolve_budget_locked()
+        if budget <= 0:
+            return False
+        return _pinned + _reserved + int(nbytes) > budget
+
+
+class _Reservation:
+    """Scoped charge of a dispatch's transient device working set."""
+
+    __slots__ = ("nbytes", "kind")
+
+    def __init__(self, nbytes: int, kind: str):
+        self.nbytes = int(nbytes)
+        self.kind = kind
+
+    def __enter__(self):
+        global _reserved
+        with _lock:
+            budget = _resolve_budget_locked()
+            over = budget > 0 and \
+                _reserved + _pinned + self.nbytes > budget
+            _reserved += self.nbytes
+            _publish_locked()
+        if over:
+            import logging
+
+            from tidb_tpu import metrics
+            metrics.counter("device.hbm.over_budget").inc()
+            # the kind label attributes WHICH consumer crossed the
+            # budget — the ledger's one per-kind diagnostic
+            logging.getLogger("tidb_tpu.ops").debug(
+                "HBM reservation over budget: %d bytes (%s)",
+                self.nbytes, self.kind)
+        return self
+
+    def __exit__(self, *exc):
+        global _reserved
+        with _lock:
+            _reserved = max(_reserved - self.nbytes, 0)
+            _publish_locked()
+        return False
+
+
+def planes_nbytes(planes, live=None, extra=()) -> int:
+    """Transient working-set estimate for one dispatch: the input plane
+    bytes stand in for the kernel's INTERMEDIATES (sort buffers, segment
+    arrays — roughly proportional to its inputs), which is what the
+    dispatch actually adds on top of the already-pinned planes; `extra`
+    argument blocks (per-slot parameters) are genuine per-dispatch
+    transfers. Best-effort accounting, never a gate."""
+    n = 0
+    ents = planes.values() if hasattr(planes, "values") else planes
+    for ent in ents:
+        if isinstance(ent, tuple):
+            for a in ent:
+                if a is not None and hasattr(a, "nbytes"):
+                    n += int(a.nbytes)
+        elif ent is not None and hasattr(ent, "nbytes"):
+            n += int(ent.nbytes)
+    if live is not None and hasattr(live, "nbytes"):
+        n += int(live.nbytes)
+    for a in extra:
+        if hasattr(a, "nbytes"):
+            n += int(a.nbytes)
+    return n
+
+
+def reserve(nbytes: int, kind: str = "dispatch") -> _Reservation:
+    """Charge `device.hbm.reserved` for the duration of a dispatch
+    (accounting, never a gate: an over-budget reservation proceeds and
+    counts `device.hbm.over_budget` — the join router is the one caller
+    that REROUTES on pressure, via headroom())."""
+    return _Reservation(nbytes, kind)
+
+
+# ---------------------------------------------------------------------------
+# key-radix partitioning (the RegionPlacement splitmix64 discipline,
+# vectorized over key planes)
+# ---------------------------------------------------------------------------
+
+def _mix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 — the same mixer
+    ops.mesh.RegionPlacement applies to region ids, so partition and
+    shard assignment share one hashing discipline."""
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def partition_codes(vals: np.ndarray, valid: np.ndarray,
+                    parts: int) -> np.ndarray:
+    """Radix partition id per row ∈ [0, parts): splitmix64 over the
+    key's int64 image, modulo parts. Float keys hash their bit pattern
+    with -0.0 normalized to +0.0 first (SQL equality — the join kernels
+    match them, so they must share a partition). NULL/invalid rows land
+    in partition 0 (they match nothing; any consistent home works)."""
+    if vals.dtype == np.float64:
+        img = np.where(vals == 0.0, 0.0, vals).view(np.int64)
+    else:
+        img = np.ascontiguousarray(vals, dtype=np.int64)
+    h = _mix64_np(img.view(np.uint64))
+    part = (h % np.uint64(parts)).astype(np.int64)
+    return np.where(valid, part, 0)
+
+
+def build_bytes_estimate(n_right: int) -> int:
+    from tidb_tpu.ops import columnar as col
+    return col.bucket_capacity(max(int(n_right), 1)) * BUILD_ROW_BYTES
+
+
+def join_bytes_estimate(n_left: int, n_right: int) -> int:
+    from tidb_tpu.ops import columnar as col
+    lcap = col.bucket_capacity(max(int(n_left), 1))
+    return build_bytes_estimate(n_right) \
+        + lcap * (PROBE_ROW_BYTES + PAIR_ROW_BYTES)
+
+
+def _initial_partitions(build_bytes: int, budget: int) -> int:
+    """Smallest power-of-two P whose per-partition build slice fits the
+    current headroom (floor: an eighth of the budget, so a headroom
+    crushed by pins still yields a finite P)."""
+    target = max(headroom(), budget // 8, 1)
+    p = MIN_PARTITIONS
+    while p < MAX_PARTITIONS and build_bytes // p > target:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# the budget-aware join router
+# ---------------------------------------------------------------------------
+
+def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
+                     device_keys=None, mesh=None, sizes=None,
+                     host_keys_fn=None):
+    """Budget-aware front of kernels.join_match_pairs — THE join entry
+    the executor uses. Within budget (or budget 0, the kill switch) the
+    existing single-pass kernels run unchanged, charged as one
+    reservation. A build side exceeding the ledger's headroom takes the
+    out-of-core route (counted `copr.partitioned_joins`):
+
+        partitioned-mesh probe  (mesh.n > 1: shards own build partitions)
+      → replicated-mesh probe   (counted copr.degraded_mesh)
+      → single-device passes    (P radix partitions, escalating on oom)
+      → host                    (DeviceError to the caller's numpy rung)
+
+    `sizes`/`host_keys_fn` let the dictionary route defer building its
+    host key planes until a rung actually needs them: with device_keys
+    and sizes given, lkey/rkey may be None and the partitioned rungs
+    resolve host planes through host_keys_fn on demand."""
+    from tidb_tpu.ops import kernels
+    n_left = int(sizes[0]) if lkey is None else int(lkey.shape[0])
+    n_right = int(sizes[1]) if rkey is None else int(rkey.shape[0])
+    budget = budget_bytes()
+    build_bytes = build_bytes_estimate(n_right)
+    if budget <= 0 or n_right == 0 or build_bytes <= headroom():
+        with reserve(join_bytes_estimate(n_left, n_right), "join"):
+            return kernels.join_match_pairs(
+                lkey, lvalid, rkey, rvalid, stats=stats,
+                device_keys=device_keys, mesh=mesh, sizes=sizes)
+    # ---- out-of-core: the build side does not fit its reservation ----
+    from tidb_tpu import metrics, tracing
+    if lkey is None:
+        (lkey, lvalid), (rkey, rvalid) = host_keys_fn()
+    metrics.counter("copr.partitioned_joins").inc()
+    if stats is not None:
+        stats["partitioned"] = True
+    if mesh is not None and mesh.n > 1 and n_left >= mesh.n:
+        from tidb_tpu.ops import mesh as mesh_mod
+        try:
+            with reserve(build_bytes // mesh.n
+                         + join_bytes_estimate(n_left, n_right) // mesh.n,
+                         "join_mesh"):
+                return mesh_mod.join_probe_partitioned(
+                    mesh, lkey, lvalid, rkey, rvalid, stats=stats)
+        except errors.DeviceError:
+            # partitioned-mesh → replicated-mesh rung
+            import logging
+            logging.getLogger("tidb_tpu.ops").warning(
+                "key-partitioned mesh probe degraded to the replicated "
+                "probe", exc_info=True)
+            tracing.record_degraded("mesh")
+        try:
+            with reserve(join_bytes_estimate(n_left, n_right),
+                         "join_replicated"):
+                return kernels.join_match_pairs(
+                    lkey, lvalid, rkey, rvalid, stats=stats, mesh=mesh)
+        except errors.TiDBError as e:
+            if not isinstance(e, errors.DeviceError):
+                raise
+            fault: Exception = e
+        except Exception as e:
+            # a REAL runtime fault rides the same rung: an actual OOM
+            # of the replicated build (not a TiDBError) is the expected
+            # failure here
+            fault = e
+        # replicated-mesh → single-device passes rung
+        import logging
+        logging.getLogger("tidb_tpu.ops").warning(
+            "replicated mesh probe degraded to single-device passes: %s",
+            fault)
+        tracing.record_degraded("mesh")
+    return _partitioned_passes(lkey, lvalid, rkey, rvalid,
+                               _initial_partitions(build_bytes, budget),
+                               stats)
+
+
+def _partitioned_passes(lkey, lvalid, rkey, rvalid, parts: int, stats):
+    """Grace-hash passes on one device: split both sides by key radix,
+    run each partition through the existing build/probe kernels (one
+    packed readback per pass), and merge the per-pass pairs back into
+    the single-pass emission order. A DeviceError mid-pass (real OOM or
+    the device/oom failpoint) escalates P ×2 and REPLAYS from scratch —
+    passes are read-only over the host planes, so a replay cannot
+    change answers. Escalation past the bounds raises DeviceError: the
+    caller's host numpy rung answers."""
+    import time as _time
+
+    from tidb_tpu import metrics, tracing
+    from tidb_tpu.ops import kernels
+    escalations = 0
+    while True:
+        sp = tracing.current().child("partitioned_join") \
+            .set("partitions", parts) \
+            .set("rows_left", int(lkey.shape[0])) \
+            .set("rows_right", int(rkey.shape[0]))
+        t0 = _time.perf_counter()
+        try:
+            l_part = partition_codes(lkey, lvalid, parts)
+            r_part = partition_codes(rkey, rvalid, parts)
+            l_parts_out, r_parts_out = [], []
+            passes = 0
+            for p in range(parts):
+                l_loc = np.flatnonzero(l_part == p)
+                r_loc = np.flatnonzero(r_part == p)
+                # a pass that provably produces no pairs — no probe
+                # rows, no valid probe keys (NULLs home at partition
+                # 0), or no valid build rows — skips its dispatches
+                # entirely; the emitted pairs are identical (LEFT OUTER
+                # pads are the executor's job, off missing l indices)
+                if not len(l_loc) or not lvalid[l_loc].any() \
+                        or not len(r_loc) or not rvalid[r_loc].any():
+                    continue
+                if failpoint._active:
+                    failpoint.eval(
+                        "device/oom", lambda: errors.DeviceError(
+                            "injected device OOM (partitioned join pass)"))
+                pass_bytes = join_bytes_estimate(len(l_loc), len(r_loc))
+                try:
+                    with reserve(pass_bytes, "join_pass"):
+                        li, ri = kernels.join_match_pairs(
+                            lkey[l_loc], lvalid[l_loc],
+                            rkey[r_loc], rvalid[r_loc])
+                except errors.TiDBError:
+                    raise
+                except Exception as e:
+                    # a REAL runtime fault mid-pass (XLA
+                    # RESOURCE_EXHAUSTED is not a TiDBError) must drive
+                    # the escalation, exactly like the injected one
+                    raise errors.DeviceError(
+                        f"partitioned join pass failed: {e}") from e
+                passes += 1
+                metrics.counter("copr.partitioned_passes").inc()
+                if len(li):
+                    l_parts_out.append(l_loc[li])
+                    # NULL-key probe rows ride partition 0 but never
+                    # match, so ri indexes real build rows only
+                    r_parts_out.append(r_loc[ri])
+        except errors.DeviceError:
+            sp.set("error", "oom").finish()
+            escalations += 1
+            if escalations > MAX_ESCALATIONS or \
+                    parts * 2 > MAX_PARTITIONS:
+                raise
+            tracing.record_degraded("partition")
+            parts *= 2
+            continue
+        except errors.TiDBError:
+            sp.set("error", "fault").finish()
+            raise
+        if l_parts_out:
+            l_all = np.concatenate(l_parts_out)
+            r_all = np.concatenate(r_parts_out)
+            # stable merge back to global left-scan order: each left
+            # row's matches live in exactly one pass (its key's
+            # partition) already in right-scan order, so this IS the
+            # single-pass emission order
+            perm = np.argsort(l_all, kind="stable")
+            l_all, r_all = l_all[perm], r_all[perm]
+        else:
+            l_all = np.zeros(0, np.int64)
+            r_all = np.zeros(0, np.int64)
+        sp.set("passes", passes).set("pairs", int(len(l_all))) \
+            .set("escalations", escalations) \
+            .set("elapsed_us", round((_time.perf_counter() - t0) * 1e6, 1)) \
+            .finish()
+        # per-pass kernel dispatches/readbacks are already tallied by
+        # kernels.join_match_pairs — no double counting here
+        if stats is not None:
+            stats["passes"] = passes
+            stats["partitions"] = parts
+            stats["partition_escalations"] = escalations
+            stats["path"] = "device"
+        return l_all, r_all

@@ -529,6 +529,178 @@ def _sharded_probe_fn(mesh, out_cap: int, narrow: bool):
     return jitted
 
 
+def _shard_block_totals(packed: np.ndarray, n_shards: int, out_cap: int,
+                        narrow: bool) -> tuple[int, list[int]]:
+    """(block stride, per-shard exact pair totals) of one merged
+    packed probe readback — THE layout contract of the sharded probe
+    kernels (`_join_probe_impl`'s packing, stacked shard-major): each
+    shard's block is [l pairs, r pairs, total] with `total` riding
+    exact (hi, lo) 32-bit words under `narrow`. One decoder for both
+    the replicated and the key-partitioned probes, so the layout
+    cannot drift between them."""
+    blk = 2 * out_cap + (2 if narrow else 1)
+    totals = []
+    for s in range(n_shards):
+        b = packed[s * blk:(s + 1) * blk]
+        if narrow:
+            totals.append((int(b[-2]) << 32) | (int(b[-1]) & 0xFFFFFFFF))
+        else:
+            totals.append(int(b[-1]))
+    return blk, totals
+
+
+def _partitioned_probe_fn(mesh, out_cap: int, narrow: bool):
+    """Jitted shard_map kernel of the KEY-PARTITIONED probe: every
+    shard builds over ITS OWN build partition and probes ITS OWN probe
+    partition — the build side is never replicated (the HBM governance
+    tier's answer to build sides above one device's budget). Each shard
+    runs the EXISTING build+probe kernels back to back; the pair blocks
+    come back in ONE merged packed readback."""
+    key = ("kprobe", id(mesh), out_cap, narrow)
+    with _lock:
+        ent = _probe_cache.get(key)
+    if ent is not None:
+        return ent[2]
+    import jax
+    from tidb_tpu import parallel
+    from tidb_tpu.ops import kernels
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(rk, rv, lk, lv):
+        rs, order, n_valid = kernels._join_build_impl(rk, rv)
+        return kernels._join_probe_impl(rs, order, n_valid, lk, lv,
+                                        out_cap, narrow=narrow)
+
+    sharded = shard_map(
+        local, mesh=mesh.mesh,
+        in_specs=(P(parallel.AXIS), P(parallel.AXIS), P(parallel.AXIS),
+                  P(parallel.AXIS)),
+        out_specs=P(parallel.AXIS))
+    jitted = jax.jit(sharded)
+    _cache_put(_probe_cache, key, mesh, None, jitted)
+    return jitted
+
+
+def join_probe_partitioned(mesh, lkey, lvalid, rkey, rvalid, stats=None):
+    """Key-partitioned mesh probe over host key planes: each shard OWNS
+    the build partitions whose key radix hashes there (splitmix64 over
+    the key image — ops.membudget.partition_codes, the RegionPlacement
+    discipline), and probe rows route to the owning shard through ONE
+    all-to-all shard-major layout instead of replicating the build side
+    on every chip. Equal keys share a shard by construction, so the
+    merged pairs (stable argsort by global left index) are BIT-IDENTICAL
+    to the single-pass emission order. Faults — incl. the
+    device/mesh_collective failpoint — raise typed DeviceError so the
+    caller degrades to the replicated probe, counted copr.degraded_mesh.
+
+    Returns (l_idx, r_idx) in global left-scan order with ties in
+    right-scan order. Each shard's partition execution counts one
+    `copr.partitioned_passes` unit (the mesh twin of the single-device
+    pass counter)."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from tidb_tpu import metrics, tracing
+    from tidb_tpu.ops import columnar as col, kernels, membudget
+
+    S = mesh.n
+    sp = tracing.current().child("mesh_kprobe").set("shards", S) \
+        .set("rows_left", int(lkey.shape[0])) \
+        .set("rows_right", int(rkey.shape[0]))
+    t0 = _time.perf_counter()
+    try:
+        if failpoint._active:
+            failpoint.eval("device/mesh_collective",
+                           lambda: errors.DeviceError(
+                               "injected mesh collective failure"))
+        l_shard = membudget.partition_codes(lkey, lvalid, S)
+        r_shard = membudget.partition_codes(rkey, rvalid, S)
+        l_sel = [np.flatnonzero(l_shard == s) for s in range(S)]
+        r_sel = [np.flatnonzero(r_shard == s) for s in range(S)]
+        lcap_s = col.bucket_capacity(
+            max(max(len(x) for x in l_sel), 1))
+        rcap_s = col.bucket_capacity(
+            max(max(len(x) for x in r_sel), 1))
+        lk = np.zeros(S * lcap_s, dtype=lkey.dtype)
+        lv = np.zeros(S * lcap_s, dtype=bool)
+        rk = np.zeros(S * rcap_s, dtype=rkey.dtype)
+        rv = np.zeros(S * rcap_s, dtype=bool)
+        for s in range(S):
+            ls, rs_ = l_sel[s], r_sel[s]
+            lk[s * lcap_s:s * lcap_s + len(ls)] = lkey[ls]
+            lv[s * lcap_s:s * lcap_s + len(ls)] = lvalid[ls]
+            rk[s * rcap_s:s * rcap_s + len(rs_)] = rkey[rs_]
+            rv[s * rcap_s:s * rcap_s + len(rs_)] = rvalid[rs_]
+        h2d = lk.nbytes + lv.nbytes + rk.nbytes + rv.nbytes
+        args = (jnp.asarray(rk), jnp.asarray(rv), jnp.asarray(lk),
+                jnp.asarray(lv))
+        out_cap = lcap_s
+        rb_bytes = 0
+        rb_count = 0
+        while True:
+            narrow = out_cap < (1 << 31) and rcap_s < (1 << 31) \
+                and lcap_s < (1 << 31)
+            fn = _partitioned_probe_fn(mesh, out_cap, narrow)
+            with kernels.dispatch_serial:
+                packed = np.asarray(fn(*args))
+            rb_bytes += int(packed.nbytes)
+            rb_count += 1
+            blk, totals = _shard_block_totals(packed, S, out_cap, narrow)
+            worst = max(totals)
+            if worst <= out_cap:
+                publish_shard_balance(totals)
+                break
+            out_cap = col.bucket_capacity(worst)
+        l_parts, r_parts = [], []
+        for s in range(S):
+            b = packed[s * blk:(s + 1) * blk]
+            n_s = totals[s]
+            if not n_s:
+                continue
+            # local pair indices → global rows through the shard's
+            # gather index (monotone, so per-shard right-scan order IS
+            # the global right-scan order restricted to the partition)
+            l_parts.append(l_sel[s][b[:n_s].astype(np.int64,
+                                                   copy=False)])
+            r_parts.append(r_sel[s][b[out_cap:out_cap + n_s]
+                                    .astype(np.int64, copy=False)])
+        if l_parts:
+            l_idx = np.concatenate(l_parts)
+            r_idx = np.concatenate(r_parts)
+            perm = np.argsort(l_idx, kind="stable")
+            l_idx, r_idx = l_idx[perm], r_idx[perm]
+        else:
+            l_idx = np.zeros(0, np.int64)
+            r_idx = np.zeros(0, np.int64)
+    except errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash in the partitioned probe: typed, so
+        # the caller degrades to the replicated-probe rung
+        sp.set("error", "fault").finish()
+        raise errors.DeviceError(
+            f"key-partitioned mesh probe failed: {e}") from e
+    metrics.counter("copr.partitioned_passes").inc(S)
+    sp.set("readbacks", rb_count).set("readback_bytes", rb_bytes) \
+        .set("transfer_bytes", int(h2d)).set("pairs", int(len(l_idx))) \
+        .finish()
+    tracing.record_dispatch(dispatches=rb_count, readbacks=rb_count,
+                            readback_bytes=rb_bytes,
+                            dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    if stats is not None:
+        stats["mesh_partitioned"] = True
+        stats["mesh_shards"] = S
+        stats["passes"] = S
+        stats["partitions"] = S
+    return l_idx, r_idx
+
+
 def join_probe_sharded(mesh, rs, order, n_valid, lk_d, lv_d, lcap: int,
                        rcap: int):
     """Mesh-sharded probe: the sorted build side is replicated (broadcast
@@ -558,15 +730,7 @@ def join_probe_sharded(mesh, rs, order, n_valid, lk_d, lv_d, lcap: int,
             packed = np.asarray(fn(rs, order, n_valid, lk_d, lv_d))
         rb_bytes += int(packed.nbytes)
         rb_count += 1
-        blk = 2 * out_cap + (2 if narrow else 1)
-        totals = []
-        for s in range(S):
-            b = packed[s * blk:(s + 1) * blk]
-            if narrow:
-                totals.append((int(b[-2]) << 32) | (int(b[-1])
-                                                    & 0xFFFFFFFF))
-            else:
-                totals.append(int(b[-1]))
+        blk, totals = _shard_block_totals(packed, S, out_cap, narrow)
         worst = max(totals)
         if worst <= out_cap:
             publish_shard_balance(totals)   # probe-match imbalance

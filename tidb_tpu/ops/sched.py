@@ -978,10 +978,22 @@ class MicroBatcher:
         sub = {cid: planes[cid] for cid in proto.cids}
         live = kernels.device_live(batch)
         kind = "batched_agg" if proto.aggs is not None else "batched_filter"
-        packed = client._dispatch_kernel(
-            jitted, sub, live, kind, kst,
-            extra=(jnp.asarray(pi), jnp.asarray(pf)),
-            attrs={"batch_size": k, "batch_slots": kb})
+        # HBM governance: the [slots, capacity] mask block (or per-slot
+        # reduction block) the batched kernel materializes charges the
+        # process ledger for the dispatch's duration
+        # (device.hbm.reserved). The per-slot parameter blocks ride
+        # _dispatch_kernel's own reservation (they are its `extra`
+        # args), and the pinned batch planes are already charged by
+        # kernels.batch_planes — neither is re-counted here.
+        from tidb_tpu.ops import membudget
+        slot_bytes = kb * batch.capacity \
+            + kb * 8 * max(self._slot_layout(proto.aggs)
+                           if proto.aggs is not None else 1, 1)
+        with membudget.reserve(slot_bytes, "batch"):
+            packed = client._dispatch_kernel(
+                jitted, sub, live, kind, kst,
+                extra=(jnp.asarray(pi), jnp.asarray(pf)),
+                attrs={"batch_size": k, "batch_slots": kb})
         masks = None
         if proto.aggs is None:
             masks = _unpack_mask_words(packed, kb, batch.capacity)[:k]

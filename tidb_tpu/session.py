@@ -1171,6 +1171,26 @@ class Session:
             return
         mesh_mod.set_enabled(parse_bool_sysvar(value))
 
+    def apply_tpu_hbm_budget(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_hbm_budget_bytes = auto|0|N — the HBM
+        governance ledger's budget (ops.membudget): 'auto' derives from
+        the backend, 0 is the kill switch (unlimited — joins stay
+        unpartitioned), N caps the ledger and routes oversized join
+        build sides into radix-partitioned passes. Process-wide like
+        tidb_tpu_mesh; a jax-free process validates and persists but
+        resolves 'auto' to unlimited."""
+        from tidb_tpu.sessionctx import parse_hbm_budget_spec
+        try:
+            parse_hbm_budget_spec(value)
+        except ValueError as e:
+            raise errors.ExecError(str(e))
+        self._require_global_grant("tidb_tpu_hbm_budget_bytes")
+        try:
+            from tidb_tpu.ops import membudget
+        except ImportError:   # retryable-ok: jax-free process, ledger moot
+            return
+        membudget.set_budget(value)
+
     def apply_tpu_plane_cache_bytes(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_plane_cache_bytes = N — the plane cache's
         LRU byte budget (evicts immediately when shrunk)."""
@@ -1563,6 +1583,16 @@ def bootstrap(session: Session) -> None:
                     from tidb_tpu.ops import mesh as _mesh_mod
                     _mesh_mod.set_enabled(parse_bool_sysvar(v))
                 except ImportError:   # retryable-ok: jax-free process
+                    pass
+            # the HBM budget ledger is a process-level ops.membudget
+            # account like the mesh switch — hydrate on every backend
+            # path (jax-free processes have no ledger to set)
+            v = gv.values.get("tidb_tpu_hbm_budget_bytes")
+            if v is not None:
+                try:
+                    from tidb_tpu.ops import membudget as _membudget
+                    _membudget.set_budget(v)
+                except (ImportError, ValueError):  # retryable-ok: jax-free
                     pass
             # digest-summary / history-ring knobs live on the per-store
             # PerfSchema — hydrate them like the plane cache's

@@ -95,6 +95,17 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # GLOBAL-only and PROCESS-wide — the mesh spans physical chips, so
     # unlike the per-client switches it flips a module flag.
     "tidb_tpu_mesh": "1",
+    # HBM governance tier (ops.membudget): the process-wide device
+    # memory budget the ledger charges plane pins, dispatch working
+    # sets, and join build/probe reservations against. 'auto' derives
+    # the budget from the backend's reported memory limit (backends
+    # without one — the CPU-XLA rig — resolve to unlimited); 0 is the
+    # kill switch (unlimited: joins stay unpartitioned — the parity
+    # oracle for the out-of-core route); an explicit byte count caps
+    # the ledger and routes oversized join build sides into
+    # radix-partitioned passes. GLOBAL-only and PROCESS-wide like
+    # tidb_tpu_mesh.
+    "tidb_tpu_hbm_budget_bytes": "auto",
     # micro-batch tier (ops.sched) kill switch: 0 pins every below-floor
     # statement to the solo route (CPU engine) — the parity oracle for
     # batched dispatch. GLOBAL-only, store-level, like the other tidb_tpu
@@ -175,6 +186,26 @@ SYSVAR_DEFAULTS: dict[str, str] = {
 from tidb_tpu.inspection import SYSVAR_DEFAULTS as _INSPECTION_DEFAULTS
 
 SYSVAR_DEFAULTS.update(_INSPECTION_DEFAULTS)
+
+
+def parse_hbm_budget_spec(value) -> "str | int":
+    """tidb_tpu_hbm_budget_bytes spec: 'auto' (derive from the
+    backend), 0 (kill switch — unlimited), or an explicit byte count.
+    THE one validator — the SET applier (which must validate jax-free)
+    and ops.membudget.set_budget both resolve through it, so the
+    accepted forms cannot drift. Raises ValueError."""
+    s = str(value).strip().lower()
+    if s == "auto":
+        return "auto"
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"tidb_tpu_hbm_budget_bytes must be 'auto' or an integer "
+            f">= 0, got {value!r}")
+    if n < 0:
+        raise ValueError("tidb_tpu_hbm_budget_bytes must be >= 0")
+    return n
 
 
 def parse_bool_sysvar(value: str) -> bool:
