@@ -843,8 +843,16 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
     states = metrics.counter("distsql.columnar_states")
     st_bytes = metrics.counter("copr.agg_states.wire_bytes")
     row_bytes = metrics.counter("copr.agg_rows.wire_bytes")
+    # the near-data headline: states DISPATCHES per statement — one
+    # batched segmented dispatch (mesh or single-device) must cover ALL
+    # regions; the serial per-region counter rides the sum so any
+    # degradation to one-dispatch-per-region fails the == 1 assert
+    disp = (metrics.counter("copr.states_batch.dispatches"),
+            metrics.counter("copr.mesh.near_data_dispatches"),
+            metrics.counter("copr.states_batch.serial_dispatches"))
     s.execute(Q1_PUSHDOWN_SQL)            # warm (pack + jit)
     f0, p0, b0 = fbs.value, states.value, st_bytes.value
+    d0 = sum(c.value for c in disp)
     fs0 = fused_agg.stats["final_states"]
     t0 = time.time()
     for _ in range(runs):
@@ -853,6 +861,7 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
     d_fbs = fbs.value - f0
     d_states = states.value - p0
     d_st_bytes = st_bytes.value - b0
+    d_disp = sum(c.value for c in disp) - d0
     d_fusions = fused_agg.stats["final_states"] - fs0
     assert d_fbs == 0, \
         f"q1 pushdown counted {d_fbs} columnar fallbacks"
@@ -861,6 +870,10 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
          f"across {n_regions} regions x {runs} runs")
     assert d_fusions >= runs, \
         "the FINAL aggregate never fused the partial states"
+    disp_per_stmt = d_disp / runs if runs else 0.0
+    assert disp_per_stmt == 1, \
+        (f"q1 ran {disp_per_stmt} states dispatches per statement "
+         f"across {n_regions} regions — near-data batching regressed")
 
     # row-protocol regime (kill switch): the parity oracle AND the
     # wire-bytes denominator (partial chunk rows per region)
@@ -892,6 +905,7 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
         "q1_pushdown_fallbacks": d_fbs,
         "q1_pushdown_states_partials": d_states,
         "q1_pushdown_state_fusions": d_fusions,
+        "q1_states_dispatches_per_stmt": disp_per_stmt,
         "q1_states_bytes_vs_rows_bytes": round(
             d_st_bytes / d_row_bytes, 3) if d_row_bytes else None,
     }
@@ -1721,7 +1735,7 @@ def check_scaled_parity(name: str, cpu_rows, tpu_rows, factor: int):
             assert int(cr[9]) * factor == int(tr[9]), f"{name}: count"
 
 
-def main(smoke: bool = False):
+def main(smoke: bool = False, full: bool = False):
     if smoke:
         # --smoke: tiny row counts, CPU-safe, same code paths — a tier-1
         # test runs this so bench-path regressions fail fast instead of
@@ -1729,6 +1743,12 @@ def main(smoke: bool = False):
         n_rows = int(os.environ.get("BENCH_ROWS", "24576"))
         n_base = int(os.environ.get("BENCH_BASE_ROWS", str(n_rows)))
         runs = int(os.environ.get("BENCH_RUNS", "1"))
+    elif full:
+        # --full: every measure_* regime at its canonical full size in
+        # ONE pass — env overrides are ignored so a BENCH_ROWS left
+        # behind in the environment can never silently shrink a
+        # published round
+        n_rows, n_base, runs = 10_200_000, 1_020_000, 3
     else:
         n_rows = int(os.environ.get("BENCH_ROWS", "10200000"))
         n_base = int(os.environ.get("BENCH_BASE_ROWS", "1020000"))
@@ -2064,4 +2084,7 @@ def main(smoke: bool = False):
 
 
 if __name__ == "__main__":
-    main(smoke="--smoke" in sys.argv[1:])
+    _argv = sys.argv[1:]
+    if "--smoke" in _argv and "--full" in _argv:
+        sys.exit("bench.py: --smoke and --full are mutually exclusive")
+    main(smoke="--smoke" in _argv, full="--full" in _argv)

@@ -69,6 +69,13 @@ def test_bench_smoke_emits_valid_json():
     assert out["q1_pushdown_state_fusions"] >= 1
     assert out["q1_states_bytes_vs_rows_bytes"] is not None \
         and out["q1_states_bytes_vs_rows_bytes"] > 0
+    # near-data execution (PR 16): ALL regions' grouped partial states
+    # compute in ONE batched segmented dispatch per statement — a
+    # regression to one-dispatch-per-region fails here (the counter
+    # delta is asserted inside measure_q1_pushdown too)
+    assert out["q1_states_dispatches_per_stmt"] == 1, \
+        (f"q1 ran {out['q1_states_dispatches_per_stmt']} states "
+         "dispatches per statement — near-data batching regressed")
     # the multi-key string-join regime: q3/q5-shaped joins on composite
     # (varchar, varchar) keys ride the dictionary tier fully columnar —
     # zero fallbacks, the device remap kernel built the key-tuple codes,

@@ -144,6 +144,52 @@ class TestBatchedVsSolo:
         for q in sqls:
             assert got[q] == oracle[q], q
 
+    def test_topn_batches_and_matches_solo(self):
+        """Below-floor ORDER BY ... LIMIT statements ride the top-n slot
+        kind (sequential-rounding concerns pin float SUM/AVG solo, not
+        top-n) and answer row-for-row what the solo route answers —
+        multi-key, desc, NULL ordering, string/decimal/float keys."""
+        store, s, client = _mk_store()
+        shapes = [
+            "select id, v from t where v > {k} order by v, id limit 5",
+            "select id, v from t where v > {k} order by v desc, id limit 5",
+            "select id, f from t where v > {k} order by f desc limit 7",
+            "select id, sx from t where v > {k} order by sx desc, id limit 6",
+            "select id, dc from t where v > {k} order by dc, id desc limit 4",
+            "select id, f from t where v > {k} order by f limit 9",
+        ]
+        # two literals per shape: every signature gathers >= 2 entries,
+        # so each rides a genuinely shared top-n dispatch
+        sqls = [tpl.format(k=k) for tpl in shapes for k in (10, 60)]
+        client.micro_batch = False
+        oracle = {q: s.execute(q)[0].values() for q in sqls}
+        client.micro_batch = True
+        t0 = metrics.counter("sched.batched_topn_statements").value
+        got = _concurrent(store, sqls)
+        assert metrics.counter("sched.batched_topn_statements").value > t0, \
+            "below-floor top-n statements never rode the batched dispatch"
+        for q in sqls:
+            assert got[q] == oracle[q], q
+
+    def test_topn_batched_vs_row_protocol(self):
+        """The batched top-n against the row protocol oracle (columnar
+        scan off): the per-slot lexsort must reproduce the CPU heap's
+        order, ties and NULLs included."""
+        store, s, client = _mk_store()
+        shapes = ["select id, v from t where v < {k} order by v, id limit 8",
+                  "select id, sx from t where v < {k} order by sx, id limit 8",
+                  "select id, f from t where v < {k} order by f desc, id "
+                  "limit 8"]
+        sqls = [tpl.format(k=k) for tpl in shapes for k in (50, 90)]
+        s.execute("set global tidb_tpu_columnar_scan = 0")
+        try:
+            oracle = {q: s.execute(q)[0].values() for q in sqls}
+        finally:
+            s.execute("set global tidb_tpu_columnar_scan = 1")
+        got = _concurrent(store, sqls)
+        for q in sqls:
+            assert got[q] == oracle[q], q
+
     def test_kill_switch_pins_solo_route(self):
         store, s, client = _mk_store()
         s2 = Session(store)

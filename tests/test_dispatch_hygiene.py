@@ -31,7 +31,11 @@ from __future__ import annotations
 import ast
 from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent / "tidb_tpu" / "ops"
+_PKG = Path(__file__).resolve().parent.parent / "tidb_tpu"
+ROOT = _PKG / "ops"
+# the near-data states channel (PR 16) moved a launch+readback site into
+# tidb_tpu/parallel (CoprMesh._run_shardmajor) — the walk covers it too
+EXTRA_ROOTS = (_PKG / "parallel",)
 
 PRAGMA = "# dispatch-ok:"
 
@@ -156,10 +160,39 @@ def _violations(path: Path) -> list[str]:
 def test_every_jitted_launch_readback_serializes():
     files = sorted(ROOT.glob("*.py"))
     assert files, "tidb_tpu/ops/ not found — layout changed?"
+    for extra in EXTRA_ROOTS:
+        extra_files = sorted(extra.glob("*.py"))
+        assert extra_files, f"{extra} not found — layout changed?"
+        files.extend(extra_files)
     problems: list[str] = []
     for f in files:
         problems.extend(_violations(f))
     assert not problems, "\n".join(problems)
+
+
+def _serial_span_of(path: Path, func_name: str) -> bool:
+    """True iff `func_name` in `path` contains at least one
+    `with ... dispatch_serial` block (the launch+readback home)."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == func_name):
+            return bool(_serial_ranges(node))
+    return False
+
+
+def test_segmented_states_dispatch_sites_serialize():
+    """The PR 16 near-data sites, pinned by name: the batched segmented
+    states kernel and the mesh shard-major runner both own a
+    launch+readback and must keep their dispatch_serial blocks — a
+    refactor that renames or moves them out fails here, not at the next
+    concurrency deadlock."""
+    assert _serial_span_of(ROOT / "kernels.py",
+                           "region_agg_states_batched"), \
+        "kernels.region_agg_states_batched lost its dispatch_serial block"
+    assert _serial_span_of(_PKG / "parallel" / "__init__.py",
+                           "_run_shardmajor"), \
+        "CoprMesh._run_shardmajor lost its dispatch_serial block"
 
 
 def test_checker_detects_unserialized_launch(tmp_path):

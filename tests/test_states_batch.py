@@ -1,0 +1,347 @@
+"""Differential suite for the NEAR-DATA states channel (PR 16): a
+grouped/scalar aggregate over the cluster store's fan-out ships every
+region's partial states PENDING, and the statement-level finisher
+(copr.columnar_region.finish_states_batch) computes ALL of them in ONE
+batched segmented dispatch — routed shard-owned over the device mesh
+(ops.mesh.region_states_sharded) when one is up, the single-device
+ragged kernel (kernels.region_agg_states_batched) otherwise. The
+contract across 1/2/4/8 regions: exactly one states dispatch per
+statement, row-for-row identical to the serial per-region path
+(BATCH_STATES_ENABLED=False) AND the row protocol — including mid-scan
+split/merge re-batching, every failpoint rung of the degradation ladder
+(mesh → single-device batched → serial → host), float-SUM sequential
+rounding bit for bit, and the plane-cache keep set that stops a live
+old snapshot from re-packing (copr.plane_cache.kept_active).
+"""
+
+from __future__ import annotations
+
+import gc
+import itertools
+
+import pytest
+
+from tidb_tpu import failpoint, metrics, tablecodec as tc
+from tidb_tpu.copr import columnar_region
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 260
+
+QUERIES = [
+    # TPC-H-q1 shape: decimal sums, double avg, string group keys
+    "select l_flag, l_status, sum(l_qty), sum(l_price), avg(l_qty), "
+    "avg(l_price), avg(l_disc), count(*) from lineitem "
+    "where l_ship <= '1998-09-02' "
+    "group by l_flag, l_status order by l_flag, l_status",
+    # scalar aggregates (no group by): G == 1 per region
+    "select count(*), sum(l_qty), min(l_price), max(l_price), "
+    "avg(l_disc), sum(l_disc) from lineitem",
+    # NULL group keys form one group; float sums keep sequential rounding
+    "select l_k, count(*), sum(l_disc), min(l_disc), max(l_qty) "
+    "from lineitem group by l_k order by l_k",
+    # filtered grouped aggregate
+    "select l_status, count(*), sum(l_price) from lineitem "
+    "where l_qty > 10 group by l_status order by l_status",
+]
+
+
+def _row_spec(i: int):
+    from decimal import Decimal
+    flag = ("A", "N", "R")[i % 3]
+    status = ("F", "O")[i % 2]
+    qty = Decimal(i % 50) + Decimal(i % 4) / 4
+    price = Decimal(900 + i * 7) + Decimal(i % 10) / 10
+    disc = (i % 11) * 0.01
+    k = None if i % 11 == 0 else i % 7
+    ship = f"1998-{(i % 12) + 1:02d}-{(i % 27) + 1:02d}"
+    return flag, status, qty, price, disc, k, ship
+
+
+def _build(n_regions: int) -> Session:
+    store = new_store(f"cluster://3/statesbatch{next(_id)}")
+    s = Session(store)
+    s.execute("create database nd")
+    s.execute("use nd")
+    s.execute(
+        "create table lineitem (l_id bigint primary key, "
+        "l_flag varchar(4), l_status varchar(4), l_qty decimal(12,2), "
+        "l_price decimal(12,2), l_disc double, l_k bigint, l_ship date)")
+    vals = []
+    for i in range(1, N_ROWS + 1):
+        flag, status, qty, price, disc, k, ship = _row_spec(i)
+        vals.append(f"({i}, '{flag}', '{status}', {qty}, {price}, "
+                    f"{disc!r}, {'null' if k is None else k}, '{ship}')")
+    s.execute(f"insert into lineitem values {', '.join(vals)}")
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("nd", "lineitem").info.id
+        step = N_ROWS // n_regions
+        store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _c(name: str) -> int:
+    return metrics.counter(name).value
+
+
+def _disp() -> int:
+    """Total batched states dispatches, whichever route answered."""
+    return (_c("copr.states_batch.dispatches")
+            + _c("copr.mesh.near_data_dispatches"))
+
+
+def _all(s: Session) -> list:
+    return [s.execute(q)[0].values() for q in QUERIES]
+
+
+def _row_protocol(s: Session, queries=QUERIES) -> list:
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        return [s.execute(q)[0].values() for q in queries]
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+
+
+def _norm(rows):
+    out = []
+    for row in rows:
+        nr = []
+        for v in row:
+            if v is None:
+                nr.append(None)
+            else:
+                try:
+                    nr.append(round(float(v), 9))
+                except (TypeError, ValueError):
+                    nr.append(v.decode() if isinstance(v, bytes) else v)
+        out.append(nr)
+    return out
+
+
+@pytest.mark.parametrize("n_regions", [1, 2, 4, 8])
+def test_one_batched_dispatch_per_statement(n_regions, monkeypatch):
+    """The headline invariant: EVERY region's states compute in ONE
+    segmented dispatch per statement (never one per region), on either
+    route, with answers identical to the serial per-region path and the
+    row protocol."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(n_regions)
+    d0 = _disp()
+    ser0 = _c("copr.states_batch.serial_dispatches")
+    reg0 = (_c("copr.states_batch.regions")
+            + _c("copr.mesh.near_data_regions"))
+    got = _all(s)
+    assert _disp() - d0 == len(QUERIES), \
+        (f"{_disp() - d0} batched dispatches for {len(QUERIES)} "
+         f"statements over {n_regions} regions — not one per statement")
+    assert _c("copr.states_batch.serial_dispatches") == ser0, \
+        "a region fell off the batch onto the serial per-region path"
+    regs = (_c("copr.states_batch.regions")
+            + _c("copr.mesh.near_data_regions")) - reg0
+    assert regs >= n_regions * len(QUERIES) - len(QUERIES), \
+        f"only {regs} region segments rode the batched dispatches"
+
+    # oracle 1: the serial per-region path (pre-PR-16 behavior)
+    monkeypatch.setattr(columnar_region, "BATCH_STATES_ENABLED", False)
+    serial = _all(s)
+    assert _c("copr.states_batch.serial_dispatches") > ser0, \
+        "BATCH_STATES_ENABLED=False never took the serial device path"
+    monkeypatch.setattr(columnar_region, "BATCH_STATES_ENABLED", True)
+    for q, g, w in zip(QUERIES, got, serial):
+        assert _norm(g) == _norm(w), \
+            f"batched states diverged from the serial path on {q!r}"
+    # oracle 2: the row protocol
+    want = _row_protocol(s)
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"batched states diverged from the row protocol on {q!r}"
+
+
+def test_float_sum_sequential_rounding_bitexact(monkeypatch):
+    """Float SUM/AVG through the BATCHED device dispatch stay EXACT
+    (==, not approximate) vs the row protocol: partials accumulate in
+    row order inside each region segment and merge in task order,
+    reproducing the row path's rounding sequence bit for bit."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    q = ("select l_k, sum(l_disc), avg(l_disc) from lineitem "
+         "group by l_k order by l_k")
+    d0 = _disp()
+    got = s.execute(q)[0].values()
+    assert _disp() > d0, "float-sum query missed the batched dispatch"
+    want = _row_protocol(s, [q])[0]
+    assert got == want     # bitwise-identical floats
+
+
+def test_mid_scan_split_and_merge_rebatch(monkeypatch):
+    """A split/merge injected DURING the fan-out: the stale-epoch retry
+    re-collects payloads and the finisher still computes the statement
+    in one batched dispatch over the NEW region set — answers
+    unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    store = s.store
+    want = _all(s)
+    tid = s.info_schema().table_by_name("nd", "lineitem").info.id
+
+    def mutate_split(st):
+        st.cluster.split_keys([tc.encode_row_key(tid, 33),
+                               tc.encode_row_key(tid, 177)])
+
+    def mutate_merge(st):
+        regions = st.cluster.regions
+        for i in range(len(regions) - 1):
+            if regions[i].start:
+                st.cluster.merge(regions[i].region_id,
+                                 regions[i + 1].region_id)
+                return
+
+    for mutate in (mutate_split, mutate_merge):
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts, orig=orig, state=state,
+                 mutate=mutate):
+            state["n"] += 1
+            if state["n"] == 2 and not state["done"]:
+                state["done"] = True
+                mutate(store)
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        d0 = _disp()
+        try:
+            got = _all(s)
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"]
+        assert _disp() - d0 == len(QUERIES), \
+            "mid-scan topology change broke one-dispatch-per-statement"
+        for q, g, w in zip(QUERIES, got, want):
+            assert _norm(g) == _norm(w), \
+                f"mid-scan topology change diverged on {q!r}"
+
+
+def test_mesh_fault_degrades_to_single_device_batch(monkeypatch):
+    """device/mesh_collective under the shard-owned route → the
+    single-device batched kernel answers (copr.degraded_near_data), the
+    dispatch stays ONE per statement, answers unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _all(s)
+    deg = metrics.counter("copr.degraded_near_data")
+    d0, sd0, md0 = deg.value, _c("copr.states_batch.dispatches"), \
+        _c("copr.mesh.near_data_dispatches")
+    failpoint.enable("device/mesh_collective")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("device/mesh_collective")
+    from tidb_tpu.ops import mesh as mesh_mod
+    if mesh_mod.get_mesh() is not None:
+        assert deg.value > d0, \
+            "mesh collective fault never degraded the near-data route"
+        assert _c("copr.mesh.near_data_dispatches") == md0
+    assert _c("copr.states_batch.dispatches") - sd0 >= len(QUERIES), \
+        "degraded statements missed the single-device batched kernel"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"single-device degraded batch diverged on {q!r}"
+
+
+def test_device_fault_ladder_bottoms_out_at_host(monkeypatch):
+    """device/agg_states + device/mesh_collective take out EVERY device
+    rung: mesh → (degraded_near_data) batched single-device →
+    (degraded_states_batch) serial per-region → (degraded_states_to_host)
+    host numpy — answers unchanged at the bottom."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _row_protocol(s)
+    deg_b = metrics.counter("copr.degraded_states_batch")
+    deg_h = metrics.counter("copr.degraded_states_to_host")
+    b0, h0 = deg_b.value, deg_h.value
+    st0 = _c("distsql.columnar_states")
+    failpoint.enable("device/mesh_collective")
+    failpoint.enable("device/agg_states")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("device/agg_states")
+        failpoint.disable("device/mesh_collective")
+    assert deg_b.value > b0, \
+        "batched-kernel fault never degraded to the serial path"
+    assert deg_h.value > h0, \
+        "serial-kernel fault never degraded to host numpy"
+    assert _c("distsql.columnar_states") - st0 >= 4 * len(QUERIES), \
+        "host-degraded regions stopped shipping states payloads"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"host-degraded states diverged on {q!r}"
+
+
+def test_copr_agg_states_fault_degrades_to_rows():
+    """copr/agg_states → regions drop to partial ROWS (the bottom rung
+    below the states channel entirely) — counted as per-partial
+    fallbacks, answers unchanged."""
+    s = _build(4)
+    want = _row_protocol(s)
+    f0 = _c("distsql.columnar_fallbacks")
+    failpoint.enable("copr/agg_states")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("copr/agg_states")
+    assert _c("distsql.columnar_fallbacks") > f0
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"row-degraded aggregate diverged on {q!r}"
+
+
+def _pc(name: str) -> int:
+    return metrics.counter(f"copr.plane_cache.{name}").value
+
+
+def test_plane_cache_keeps_live_old_snapshot_generation():
+    """The oldest-active-ts keep set (HTAP residual): a NEWER reader's
+    version sweep KEEPS the generation a live old snapshot still reads
+    verbatim (copr.plane_cache.kept_active) — the old snapshot's re-read
+    HITS instead of re-packing — and once that reader is gone the next
+    sweep reclaims it as usual."""
+    s1 = _build(4)
+    store = s1.store
+    s1.execute("set global tidb_tpu_delta_pack = 0")
+    try:
+        s2 = Session(store)
+        s2.execute("use nd")
+        q = "select count(*), sum(l_qty) from lineitem"
+        s1.execute("begin")
+        old = s1.execute(q)[0].values()    # packs planes at the OLD version
+        s2.execute("insert into lineitem values "
+                   "(900, 'A', 'F', 5, 1000, 0.05, 1, '1998-01-01')")
+        ka0, iv0 = _pc("kept_active"), _pc("invalidations_version")
+        new = s2.execute(q)[0].values()
+        assert new != old, "newer session missed the committed write"
+        assert _pc("kept_active") > ka0, \
+            "the live old snapshot's generation was swept"
+        assert _pc("invalidations_version") == iv0, \
+            "the keep set still let the version sweep reclaim entries"
+        h0, m0 = _pc("hits"), _pc("misses")
+        assert s1.execute(q)[0].values() == old, \
+            "old snapshot diverged after the newer reader's sweep"
+        assert _pc("hits") - h0 >= 4, \
+            "old snapshot re-read did not hit its kept generation"
+        assert _pc("misses") == m0, \
+            "old snapshot re-packed despite the keep set"
+        s1.execute("commit")
+        gc.collect()           # drop any lingering snapshot registrants
+        s2.execute("insert into lineitem values "
+                   "(901, 'N', 'O', 6, 1001, 0.06, 2, '1998-01-02')")
+        iv1 = _pc("invalidations_version")
+        s2.execute(q)
+        assert _pc("invalidations_version") > iv1, \
+            "with no live old reader the stale generations must be swept"
+    finally:
+        s1.execute("set global tidb_tpu_delta_pack = 1")

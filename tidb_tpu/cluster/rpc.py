@@ -92,6 +92,11 @@ class RpcHandler:
         # region→shard item read
         from tidb_tpu.cluster.heat import RegionHeat
         self.region_heat = RegionHeat()
+        # oldest-active-reader probe (the owning store wires its
+        # oldest_active_ts here): lets the plane cache's version sweep
+        # KEEP generations a live old snapshot still reads verbatim,
+        # instead of re-packing that snapshot's planes on every read
+        self.oldest_active_ts_fn = None
 
     # ---- region context validation ----
 
@@ -224,11 +229,13 @@ class RpcHandler:
             # exactly fall through to the row handler for this region
             # only — the client counts the channel per PARTIAL
             from tidb_tpu.copr.columnar_region import handle_columnar_scan
+            oldest = (self.oldest_active_ts_fn()
+                      if self.oldest_active_ts_fn is not None else None)
             resp = handle_columnar_scan(
                 snapshot, sel, clipped,
                 region=(ctx.region_id, region.epoch()),
                 cache=self.plane_cache, delta=self.delta_store,
-                dicts=self.dict_registry)
+                dicts=self.dict_registry, oldest_ts=oldest)
             if resp is not None:
                 self._record_copr_heat(ctx.region_id, resp)
                 return resp

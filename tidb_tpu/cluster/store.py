@@ -561,6 +561,7 @@ class DistStore(kv.Storage):
         self.cluster = cluster or Cluster(n_stores)
         self.mvcc = MvccStore()
         self.rpc = RpcHandler(self.cluster, self.mvcc)
+        self.rpc.oldest_active_ts_fn = self.oldest_active_ts
         self.cache = RegionCache(self.cluster)
         self.sender = RegionRequestSender(self.cache, self.rpc)
         self.resolver = LockResolver(self.sender, self.rpc)

@@ -47,11 +47,20 @@ I64_MIN = -(1 << 63)
 # to 0 to force the device kernel + its failpoint seams.
 STATES_DEVICE_FLOOR = 4096
 
+# near-data batched states (PR 16): when on, each region DEFERS its
+# device states pass — the fan-out workers ship payloads with the
+# segment reductions still pending, and the drain's statement-level
+# finisher (finish_states_batch) computes EVERY region's states in ONE
+# ragged segmented dispatch (shard-owned on a mesh). Off → the serial
+# per-region dispatch of PR 11, which is also the degradation rung.
+# Tests monkeypatch this for the differential suites.
+BATCH_STATES_ENABLED = True
+
 
 def handle_columnar_scan(snapshot, sel: SelectRequest,
                          ranges: list[KeyRange], region=None,
-                         cache=None, delta=None,
-                         dicts=None) -> SelectResponse | None:
+                         cache=None, delta=None, dicts=None,
+                         oldest_ts=None) -> SelectResponse | None:
     """One region's share of a columnar_hint request as a columnar
     partial, or None → the caller runs the row handler for this region.
 
@@ -144,8 +153,15 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
         if delta is not None and not is_index and delta.enabled:
             base_ok = (lambda v0: delta.usable(
                 region[0], table_id, v0, version, mvcc, prefix))
+        # HTAP keep set: generations at/above the OLDEST active reader's
+        # visible version survive the sweep — an old snapshot below the
+        # kept base stops re-packing on every read. Only-current-readers
+        # ⇒ keep_version == version ⇒ the sweep is unchanged.
+        keep_version = (mvcc.data_version_at(oldest_ts, prefix)
+                        if oldest_ts is not None else None)
         batch, cache_info, dbase = cache.lookup_with_base(
-            base_key, region[1], version, base_ok)
+            base_key, region[1], version, base_ok,
+            keep_version=keep_version)
         # cache_hit / cache_miss land on the region_task span the fan-out
         # worker attached (NOOP when untraced)
         tracing.current().inc("cache_hit" if batch is not None
@@ -727,24 +743,157 @@ def _agg_states_response(sel: SelectRequest, batch: col.ColumnBatch,
                             values=outs[vi], op=op, kind=kind,
                             dec_scale=scale, pb_col=c))
 
-    with tracing.trace("agg_states_pass") as ssp:
-        outs = _run_states(batch, gid, reductions, G)
-        ssp.set("groups", G).set("rows", len(live_idx))
-    aggs = [build(outs) for build in builders]
-    payload = col.ColumnarAggStates(group_keys, aggs,
-                                    list(sel.aggregates), colpb)
+    pending = _PendingStates(batch, gid, reductions, G, builders,
+                             len(live_idx), group_keys)
+    if BATCH_STATES_ENABLED and reductions and G > 0:
+        # DEFER the states pass: the payload ships with its segment
+        # reductions pending, and the drain's statement-level finisher
+        # (finish_states_batch) runs every region's states in ONE
+        # batched dispatch — or any consumer touching .aggs first
+        # resolves this region serially (identical answers)
+        payload = col.ColumnarAggStates(group_keys, None,
+                                        list(sel.aggregates), colpb,
+                                        pending=pending)
+    else:
+        payload = col.ColumnarAggStates(group_keys, pending.resolve(),
+                                        list(sel.aggregates), colpb)
     payload.cache_info = cache_info
     if region is not None:
         payload.region_id = region[0]
         payload.region_epoch = region[1]
-    wire = sum(len(k) for k in group_keys)
-    for st in aggs:
-        wire += int(st.counts.nbytes)
-        if st.values is not None:
-            wire += int(st.values.nbytes)
-        if st.datums is not None:
-            wire += 16 * len(st.datums)   # flattened datum estimate
     metrics.counter("copr.agg_states.partials").inc()
     metrics.counter("copr.agg_states.rows").inc(len(live_idx))
-    metrics.counter("copr.agg_states.wire_bytes").inc(wire)
     return SelectResponse(columnar=payload)
+
+
+class _PendingStates:
+    """One region's DEFERRED grouped-states pass: everything
+    `_agg_states_response` prepared host-side (group ids, device-safe
+    reductions, state builders) minus the device dispatch itself — the
+    unit the statement-level finisher batches. `resolve()` is the serial
+    per-region path (device at/above STATES_DEVICE_FLOOR, host numpy
+    below or on fault) — both the BATCH_STATES_ENABLED=False behavior
+    and the bottom degradation rung of the batched dispatch."""
+
+    __slots__ = ("batch", "gid", "reductions", "G", "builders", "n_live",
+                 "group_keys")
+
+    def __init__(self, batch, gid, reductions, G, builders, n_live,
+                 group_keys):
+        self.batch = batch
+        self.gid = gid
+        self.reductions = reductions
+        self.G = G
+        self.builders = builders
+        self.n_live = n_live
+        self.group_keys = group_keys
+
+    def signature(self) -> tuple:
+        """The statement's aggregate shape — regions sharing it share
+        one ragged dispatch (kernels.region_agg_states_batched's cache
+        key domain)."""
+        return (tuple(op for op, _v, _ok in self.reductions),
+                tuple("c" if v is None else np.dtype(v.dtype).char
+                      for _op, v, _ok in self.reductions))
+
+    def device_reductions(self) -> list:
+        """Reductions with value planes swapped for their PINNED device
+        twins where the batch's planes are device-resident (plane-cache
+        pinning): the batched dispatch then reads HBM directly — the
+        host touches group offsets and masks, not row values."""
+        planes = getattr(self.batch, "_device_planes", None)
+        if planes is None:
+            return self.reductions
+        by_id = {id(cd.values): cid
+                 for cid, cd in self.batch.columns.items()}
+        out = []
+        for op, vals, ok in self.reductions:
+            if vals is not None:
+                cid = by_id.get(id(vals))
+                if cid is not None and cid in planes:
+                    vals = planes[cid][0]
+            out.append((op, vals, ok))
+        return out
+
+    def finish(self, outs) -> list:
+        """Per-spec state arrays → AggStateCols (+ the wire-bytes tally,
+        which needs the materialized states)."""
+        from tidb_tpu import metrics
+        aggs = [build(outs) for build in self.builders]
+        wire = sum(len(k) for k in self.group_keys)
+        for st in aggs:
+            wire += int(st.counts.nbytes)
+            if st.values is not None:
+                wire += int(st.values.nbytes)
+            if st.datums is not None:
+                wire += 16 * len(st.datums)   # flattened datum estimate
+        metrics.counter("copr.agg_states.wire_bytes").inc(wire)
+        return aggs
+
+    def resolve(self) -> list:
+        from tidb_tpu import tracing
+        with tracing.trace("agg_states_pass") as ssp:
+            outs = _run_states(self.batch, self.gid, self.reductions,
+                               self.G)
+            ssp.set("groups", self.G).set("rows", self.n_live)
+        return self.finish(outs)
+
+
+def finish_states_batch(payloads) -> None:
+    """The statement-level finisher of the deferred states channel: the
+    drain hands over every states payload of one statement; regions
+    sharing an aggregate shape fulfill from ONE ragged segmented device
+    dispatch (kernels.region_agg_states_batched) — routed shard-owned
+    through the mesh (ops.mesh.region_states_sharded) when the mesh tier
+    is up — instead of one dispatch per region. Per-statement floor: the
+    statement's TOTAL packed rows decide device vs host, so many small
+    regions that individually sit under STATES_DEVICE_FLOOR still
+    amortize into one dispatch. Degradation ladder (answers unchanged at
+    every rung): mesh → single-device batched (copr.degraded_near_data)
+    → serial per-region (copr.degraded_states_batch) → host numpy."""
+    from tidb_tpu import tracing
+    pend = [p for p in payloads
+            if getattr(p, "states_pending", None) is not None
+            and p.states_pending()]
+    if not pend:
+        return
+    groups: dict = {}
+    for p in pend:
+        groups.setdefault(p._pending.signature(), []).append(p)
+    for group in groups.values():
+        pends = [p._pending for p in group]
+        total_rows = sum(pe.batch.n_rows for pe in pends)
+        use_device = total_rows >= STATES_DEVICE_FLOOR
+        if use_device:
+            try:
+                import jax  # noqa: F401
+            except ImportError:
+                use_device = False
+        if use_device:
+            from tidb_tpu.ops import kernels
+            from tidb_tpu.ops import mesh as mesh_mod
+            mesh = mesh_mod.get_mesh()
+            if mesh is not None:
+                try:
+                    outs = mesh_mod.region_states_sharded(
+                        mesh,
+                        [(pe.gid, pe.reductions, pe.G) for pe in pends],
+                        region_ids=[p.region_id for p in group],
+                        epochs=[p.region_epoch for p in group])
+                    for p, pe, o in zip(group, pends, outs):
+                        p.fulfill_states(pe.finish(o))
+                    continue
+                except errors.DeviceError:
+                    tracing.record_degraded("near_data")
+            try:
+                outs = kernels.region_agg_states_batched(
+                    [(pe.gid, pe.device_reductions(), pe.G)
+                     for pe in pends])
+                for p, pe, o in zip(group, pends, outs):
+                    p.fulfill_states(pe.finish(o))
+                continue
+            except errors.DeviceError:
+                tracing.record_degraded("states_batch")
+        for p in group:
+            if p.states_pending():
+                p.aggs   # serial resolution (device→host ladder inside)

@@ -51,7 +51,7 @@ DEFAULT_BUDGET_BYTES = int(SYSVAR_DEFAULTS["tidb_tpu_plane_cache_bytes"])
 # through the thread-local tallies in the slow-query log (prefixed
 # plane_cache_), in display order
 COUNTER_NAMES = ("hits", "misses", "evictions", "invalidations_epoch",
-                 "invalidations_version")
+                 "invalidations_version", "kept_active")
 
 
 def _metric(name: str):
@@ -191,7 +191,7 @@ class PlaneCache:
         return batch, info
 
     def lookup_with_base(self, base_key: tuple, epoch, version: int,
-                         base_ok):
+                         base_ok, keep_version: int | None = None):
         """lookup() plus the HTAP delta tier's base resolution:
         (batch, attribution, delta_base).
 
@@ -203,7 +203,15 @@ class PlaneCache:
         entry_version); every OTHER older generation dies — a hot table
         under steady writes holds current + one base, never one
         generation per commit. Without `base_ok` the sweep is PR 5's:
-        any strictly-older same-base generation dies."""
+        any strictly-older same-base generation dies.
+
+        `keep_version` — when given — is the visible-data version of the
+        OLDEST ACTIVE reader (store.oldest_active_ts through the per-
+        table commit filter): older same-base generations at or above it
+        can still serve a live old-snapshot reader VERBATIM, so the
+        sweep keeps them (counted `kept_active`) instead of forcing that
+        reader to re-pack on every read. With only current-version
+        readers, keep_version == version and behavior is unchanged."""
         full_key = base_key + (epoch, version)
         region_id = base_key[0]
         with self._lock:
@@ -241,6 +249,13 @@ class PlaneCache:
                         base_ent = e
             for fk, e in stale:
                 if e is base_ent:
+                    continue
+                if keep_version is not None and e.version >= keep_version:
+                    # a live reader whose snapshot sits at or above this
+                    # generation can still hit it exactly — sweeping it
+                    # would re-pack that snapshot on every read
+                    info["kept_active"] = info.get("kept_active", 0) + 1
+                    _metric("kept_active").inc()
                     continue
                 self._remove(fk, e)
                 swept += 1

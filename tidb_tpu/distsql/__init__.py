@@ -159,6 +159,12 @@ class SelectResult:
             # the bench/tests can assert states, not rows, crossed the
             # wire
             _count("states", n_states, self.span)
+            # statement-level finisher of the near-data channel: regions
+            # shipped their states PENDING; fulfill all of them from one
+            # batched segmented dispatch before any consumer fans out
+            from tidb_tpu.copr.columnar_region import finish_states_batch
+            finish_states_batch(
+                [p for p in payloads if getattr(p, "is_agg_states", False)])
         if n_col == len(parts):
             _count("partials", n_col, self.span)
             if n_col == 1:

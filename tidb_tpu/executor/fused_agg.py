@@ -49,7 +49,8 @@ _FUSABLE = ("count", "sum", "avg", "min", "max", "first_row")
 # partial agg + psum/pmin/pmax over ICI)
 stats = {"fused": 0, "fallback": 0, "partial_combines": 0,
          "last_combine_regions": 0, "mesh_combines": 0,
-         "last_mesh_shards": 0, "final_states": 0}
+         "last_mesh_shards": 0, "final_states": 0,
+         "states_batch_finished": 0}
 
 I64_SENTINEL_MIN = I64_MAX        # "min" monoid identity (int planes)
 I64_SENTINEL_MAX = I64_MIN        # "max" monoid identity — EXACT min,
@@ -603,6 +604,13 @@ def try_fused_final(agg):
         return None   # engine-local partial rows / scan payload: row loop
     if not all(isinstance(p, colmod.ColumnarAggStates) for p in parts):
         return None
+    if any(p.states_pending() for p in parts):
+        # payloads that reached the executor with their near-data states
+        # still deferred (paths that bypass SelectResult.columnar): one
+        # batched fulfillment here beats R serial resolves via .aggs
+        from tidb_tpu.copr.columnar_region import finish_states_batch
+        finish_states_batch(parts)
+        stats["states_batch_finished"] += 1
     out = _try_final_states(agg, child, parts, region_ids, epochs)
     if out is not None:
         stats["fused"] += 1

@@ -36,6 +36,7 @@ CATALOG: dict[str, tuple[str, str]] = {
     "copr.plane_cache.evictions": ("counter", "Plane-cache entries evicted by the LRU byte budget."),
     "copr.plane_cache.invalidations_epoch": ("counter", "Plane-cache entries invalidated by a region epoch bump (split/merge)."),
     "copr.plane_cache.invalidations_version": ("counter", "Plane-cache entries invalidated by a newer visible data version."),
+    "copr.plane_cache.kept_active": ("counter", "Stale-version entries the sweep KEPT because a live reader's snapshot (oldest_active_ts) still reads them verbatim."),
     "copr.plane_cache.bytes": ("gauge", "Bytes currently held by the region plane caches."),
     "copr.plane_cache.bytes_pinned": ("gauge", "Cached bytes currently pinned device-resident (HBM)."),
     "copr.plane_cache.entries": ("gauge", "Entries currently held by the region plane caches."),
@@ -67,10 +68,19 @@ CATALOG: dict[str, tuple[str, str]] = {
     "copr.dict.dictionaries": ("gauge", "Live per-(table, column) global dictionaries."),
     # ---- micro-batch aggregate slot kind ----
     "sched.batched_agg_statements": ("counter", "Below-floor scalar-aggregate statements answered through a shared per-slot masked-reduction dispatch."),
+    "sched.batched_topn_statements": ("counter", "Below-floor TopN statements answered through a shared per-slot lexsort dispatch (desc/limit lowered into the slot kernel)."),
     "copr.agg_states.partials": ("counter", "Region partials that answered a pushed-down aggregate as grouped partial STATES."),
     "copr.agg_states.rows": ("counter", "Rows aggregated region-side into grouped partial states."),
     "copr.agg_states.wire_bytes": ("counter", "Wire bytes of grouped partial-STATES payloads (group keys + state arrays)."),
     "copr.agg_rows.wire_bytes": ("counter", "Wire bytes of row-protocol partial-aggregate chunk responses."),
+    # ---- near-data region execution (batched segmented states) ----
+    "copr.states_batch.dispatches": ("counter", "Batched segmented states dispatches: all of a statement's region partials computed in one ragged kernel."),
+    "copr.states_batch.serial_dispatches": ("counter", "Per-region states kernel dispatches (the serial path: below the per-statement floor, or degraded)."),
+    "copr.states_batch.regions": ("counter", "Region segments computed by batched segmented states dispatches."),
+    "copr.states_batch.rows": ("counter", "Rows aggregated through batched segmented states dispatches."),
+    "copr.mesh.near_data_dispatches": ("counter", "Shard-owned near-data states dispatches: each region's segment computed on its RegionPlacement home shard in one mesh dispatch."),
+    "copr.mesh.near_data_regions": ("counter", "Region segments computed by shard-owned near-data dispatches."),
+    "copr.mesh.near_data_rows": ("counter", "Rows aggregated through shard-owned near-data dispatches."),
     # ---- degradation chain ----
     "copr.degraded_": ("counter", "Tier fallbacks by kind (device_to_cpu, join_to_numpy, combine_to_host, mesh, batch, states_to_host, rows...)."),
     # ---- mesh tier ----

@@ -571,8 +571,17 @@ class TpuClient(kv.Client):
                                                      gspec.plane_keys,
                                                      gspec.kernel_sizes))
             if self.mesh is not None:
-                outs = [np.asarray(o)
-                        for o in self.mesh.run_grouped(fn, planes, live)]
+                try:
+                    outs = [np.asarray(o)
+                            for o in self.mesh.run_grouped(fn, planes, live)]
+                except Unsupported:
+                    # not mesh-combinable (DISTINCT states): the single
+                    # device still answers columnar — planes stay in HBM
+                    # instead of the statement falling to the CPU row scan
+                    self._last_dispatch = (jitted, planes, live)
+                    packed = self._dispatch_kernel(jitted, planes, live,
+                                                   "grouped", kst)
+                    outs = kernels.unpack_outputs(wrapper, packed)
             else:
                 self._last_dispatch = (jitted, planes, live)
                 packed = self._dispatch_kernel(jitted, planes, live,
@@ -584,8 +593,14 @@ class TpuClient(kv.Client):
             sel, batch, "scalar",
             lambda: kernels.build_scalar_agg_fn(where, specs, batch.n_rows))
         if self.mesh is not None:
-            outs = [np.asarray(o)
-                    for o in self.mesh.run_scalar(fn, planes, live)]
+            try:
+                outs = [np.asarray(o)
+                        for o in self.mesh.run_scalar(fn, planes, live)]
+            except Unsupported:
+                self._last_dispatch = (jitted, planes, live)
+                packed = self._dispatch_kernel(jitted, planes, live,
+                                               "scalar", kst)
+                outs = kernels.unpack_outputs(wrapper, packed)
         else:
             self._last_dispatch = (jitted, planes, live)
             packed = self._dispatch_kernel(jitted, planes, live,

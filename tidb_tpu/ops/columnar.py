@@ -1100,15 +1100,39 @@ class ColumnarAggStates:
     is_agg_states = True
 
     def __init__(self, group_keys: list[bytes], aggs: list[AggStateCol],
-                 aggregates, col_pb: dict):
+                 aggregates, col_pb: dict, pending=None):
         self.group_keys = group_keys
-        self.aggs = aggs
+        self._aggs = aggs
+        # deferred states (the near-data batched dispatch): the fan-out
+        # worker ships the payload with its device work still PENDING —
+        # the drain's statement-level finisher
+        # (copr.columnar_region.finish_states_batch) fulfills every
+        # region's states from ONE ragged dispatch; any consumer that
+        # touches .aggs first resolves serially (same answers)
+        self._pending = pending
         self._aggregates = aggregates      # request pb Expr list
         self._col_pb = col_pb
         self._fts: list | None = None
         self.cache_info: dict | None = None
         self.region_id: int | None = None
         self.region_epoch: tuple | None = None
+
+    @property
+    def aggs(self) -> list[AggStateCol]:
+        if self._aggs is None:
+            self._aggs = self._pending.resolve()
+            self._pending = None
+        return self._aggs
+
+    def states_pending(self) -> bool:
+        return self._aggs is None and self._pending is not None
+
+    def fulfill_states(self, aggs: list[AggStateCol]) -> None:
+        """Install the batch-dispatch-computed states (the finisher's
+        path); a payload already resolved keeps its states."""
+        if self._aggs is None:
+            self._aggs = aggs
+            self._pending = None
 
     def __len__(self) -> int:
         return len(self.group_keys)

@@ -1211,8 +1211,141 @@ def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
     _tracing.record_dispatch(
         readback_bytes=int(host.nbytes),
         dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    from tidb_tpu import metrics as _metrics
+    # the serial (one-dispatch-per-region) rung of the states channel:
+    # counted alongside copr.states_batch.dispatches so the bench can
+    # assert dispatches-per-statement
+    _metrics.counter("copr.states_batch.serial_dispatches").inc()
     outs = unpack_outputs(wrapper, host)
     return [np.atleast_1d(np.asarray(o)) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# batched (ragged) region states: ONE segmented dispatch computes EVERY
+# region's grouped partial states for a statement. Each region keeps its
+# own region-local group space; the traced kernel offsets region r's ids
+# by sum_{s<r}(G_s + 1) — each region keeps its own dead-row sink — and
+# runs the SAME SegCtx segment reductions over the concatenated rows, so
+# the per-region slices of the output are bit-identical to what R serial
+# region_agg_states dispatches would produce. This is the near-data
+# amortization move (Taurus NDP): a 64-region statement pays ONE flat
+# dispatch round trip instead of 64.
+# ---------------------------------------------------------------------------
+
+_batched_states_cache: dict = {}
+
+
+def region_agg_states_batched(segs: list) -> list:
+    """Per-group partial states for EVERY region of one statement in ONE
+    ragged segmented dispatch.
+
+    segs[r] = (gid_r, specs_r, G_r) with the same per-region contract as
+    region_agg_states; every region must share the statement's aggregate
+    shape (same ops, same value dtypes — the caller groups by that
+    signature). Returns outs[r] = one [G_r] array per spec, exactly what
+    R serial region_agg_states calls would return. Value planes may
+    arrive as device-resident jax arrays (pinned plane-cache planes ride
+    the dispatch without a fresh H2D). Faults (incl. the
+    device/agg_states failpoint) raise typed DeviceError so the caller
+    can degrade to the serial per-region path."""
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
+    from tidb_tpu import metrics as _metrics
+    from tidb_tpu import tracing as _tracing
+
+    R = len(segs)
+    Gs = tuple(int(g) for _gid, _sp, g in segs)
+    ns = tuple(len(gid) for gid, _sp, _g in segs)
+    specs0 = segs[0][1]
+    ops_t = tuple(op for op, _v, _ok in specs0)
+    dtypes = tuple("c" if v is None else np.dtype(v.dtype).char
+                   for _op, v, _ok in specs0)
+    # region offsets into the global segment space (+1 per region: its
+    # own dead-row sink — sink states read back and are discarded)
+    offs = []
+    off = 0
+    for g in Gs:
+        offs.append(off)
+        off += g + 1
+    S_total = off
+    key = (ops_t, Gs, ns, dtypes)
+    ent = _batched_states_cache.get(key)
+    _tracing.record_jit_cache(hit=ent is not None)
+    if ent is None:
+        offs_t = tuple(offs)
+
+        def fn(arrs, _live):
+            parts = [arrs[r] + offs_t[r] for r in range(R)]
+            gid = parts[0] if R == 1 else jnp.concatenate(parts)
+            seg = SegCtx(gid, S_total)
+            outs = []
+            for i, op in enumerate(ops_t):
+                b = R + 2 * i * R
+                vals = arrs[b] if R == 1 \
+                    else jnp.concatenate([arrs[b + r] for r in range(R)])
+                ok = arrs[b + R] if R == 1 \
+                    else jnp.concatenate([arrs[b + R + r]
+                                          for r in range(R)])
+                if op == "sum":
+                    red = seg.sum(vals, ok)
+                elif op == "min":
+                    red = seg.min(vals, ok)
+                else:
+                    red = seg.max(vals, ok)
+                outs.append(red)
+            return tuple(outs)
+
+        wrapper = pack_outputs(fn)
+        ent = (wrapper, jax.jit(wrapper))
+        _batched_states_cache[key] = ent
+        if len(_batched_states_cache) > 256:
+            _batched_states_cache.pop(next(iter(_batched_states_cache)))
+    wrapper, jitted = ent
+    n_rows = sum(ns)
+    sp = _tracing.current().child("agg_states_batch") \
+        .set("regions", R).set("groups", sum(Gs)) \
+        .set("states", len(ops_t)).set("rows", n_rows)
+    t0 = _time.perf_counter()
+    try:
+        if _failpoint._active:
+            _failpoint.eval("device/agg_states",
+                            lambda: _errors.DeviceError(
+                                "injected agg-states kernel failure"))
+        arrs = [jnp.asarray(np.asarray(gid, np.int64))
+                for gid, _sp2, _g in segs]
+        for i in range(len(ops_t)):
+            vplanes = []
+            okplanes = []
+            for gid_r, specs_r, _g in segs:
+                _op, vals, ok = specs_r[i]
+                if vals is None:
+                    vals = np.ones(len(gid_r), dtype=np.int64)
+                vplanes.append(jnp.asarray(vals))
+                okplanes.append(jnp.asarray(np.asarray(ok, bool)))
+            arrs.extend(vplanes)
+            arrs.extend(okplanes)
+        with dispatch_serial:
+            host = np.asarray(jitted(tuple(arrs), None))
+    except _errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash in the batched states kernel: typed,
+        # so the statement degrades to the serial per-region path (same
+        # monoid algebra, same answers)
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(
+            f"batched region agg states failed: {e}") from e
+    sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
+    sp.finish()
+    _tracing.record_dispatch(
+        readback_bytes=int(host.nbytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    _metrics.counter("copr.states_batch.dispatches").inc()
+    _metrics.counter("copr.states_batch.regions").inc(R)
+    _metrics.counter("copr.states_batch.rows").inc(n_rows)
+    outs = unpack_outputs(wrapper, host)
+    full = [np.atleast_1d(np.asarray(o)) for o in outs]
+    return [[o[offs[r]:offs[r] + Gs[r]] for o in full] for r in range(R)]
 
 
 # ---------------------------------------------------------------------------

@@ -497,6 +497,190 @@ def combine_states_sharded(states, ops, mesh,
 
 
 # ---------------------------------------------------------------------------
+# near-data region states: shard-OWNED region compute in one shard_map
+# dispatch. Unlike combine_rows_sharded (whose groups are globally
+# unified and whose states all-reduce over ICI), each region here keeps
+# its own region-local group space and lives WHOLLY on its home shard —
+# so the per-shard outputs are already each region's exact states and no
+# collective runs at all: a per-SHARD states channel (out_specs along
+# the axis), the mesh twin of kernels.region_agg_states_batched.
+# ---------------------------------------------------------------------------
+
+_states_fn_cache: dict = {}
+
+
+def _states_local_fn(mesh, ops: tuple, sp_total: int, lmax: int,
+                     dtypes: tuple):
+    """The per-shard local states function, with STABLE IDENTITY per
+    (mesh, spec ops, segment space, Lmax, dtypes) signature: every shard
+    runs the SAME SegCtx segment reductions over its placed row block
+    against the statement's GLOBAL segment space (region-offset group
+    ids; the last segment is the cross-shard padding sink). The mesh
+    pins this fn in the cache entry (CoprMesh.run_states keys its jit
+    cache by id(fn)), and this cache pins the mesh, so neither id can be
+    recycled while an entry lives."""
+    key = (id(mesh), ops, sp_total, lmax, dtypes)
+    with _lock:
+        ent = _states_fn_cache.get(key)
+    from tidb_tpu import tracing
+    tracing.record_jit_cache(hit=ent is not None)
+    if ent is not None:
+        return ent[1]
+    from tidb_tpu.ops import kernels
+
+    def local(planes, _live):
+        gid = planes[0]
+        seg = kernels.SegCtx(gid, sp_total)
+        outs = []
+        for i, op in enumerate(ops):
+            vals = planes[1 + 2 * i]
+            ok = planes[2 + 2 * i]
+            if op == "sum":
+                red = seg.sum(vals, ok)
+            elif op == "min":
+                red = seg.min(vals, ok)
+            else:
+                red = seg.max(vals, ok)
+            outs.append(red)
+        return tuple(outs)
+
+    with _lock:
+        cur = _states_fn_cache.get(key)
+        if cur is not None:
+            return cur[1]
+        _states_fn_cache[key] = (mesh, local)
+        while len(_states_fn_cache) > 256:
+            _states_fn_cache.pop(next(iter(_states_fn_cache)))
+    return local
+
+
+def region_states_sharded(mesh, segs: list, region_ids=None,
+                          epochs=None) -> list:
+    """Every region's grouped partial states for one statement, computed
+    on each region's HOME SHARD in ONE shard_map dispatch.
+
+    segs[r] = (gid_r, specs_r, G_r) — the region_agg_states contract per
+    region, same aggregate shape across regions (the caller groups by
+    signature). Rows place shard-major by RegionPlacement; group ids
+    offset into the statement's global segment space (sum(G_r + 1) + 1,
+    the last segment the padding sink) so each shard's SegCtx block is
+    exact for exactly the regions it owns. Region r's states read back
+    from its home shard's block — no merge arithmetic, no collectives.
+    Returns outs[r] = one [G_r] array per spec, bit-identical to the
+    serial per-region path. Faults (incl. the device/mesh_collective
+    failpoint) raise typed DeviceError so the caller degrades to the
+    single-device batched dispatch."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from tidb_tpu import metrics, tracing
+
+    R = len(segs)
+    Gs = [int(g) for _gid, _sp, g in segs]
+    specs0 = segs[0][1]
+    ops = tuple(op for op, _v, _ok in specs0)
+    dtypes = tuple("c" if v is None else np.dtype(v.dtype).char
+                   for _op, v, _ok in specs0)
+    offs = []
+    off = 0
+    for g in Gs:
+        offs.append(off)
+        off += g + 1
+    sp_total = off + 1          # +1: cross-shard padding sink
+    if region_ids is None:
+        region_ids = list(range(R))
+    region_ids = [rid if rid is not None else -(i + 1)
+                  for i, rid in enumerate(region_ids)]
+    placement = placement_for(mesh)
+    shard_of = placement.shard_of(region_ids, epochs)
+
+    # statement-global host planes (region-concatenated), then the
+    # shard-major placement gather
+    slices = []
+    s0 = 0
+    for gid_r, _sp2, _g in segs:
+        slices.append((s0, s0 + len(gid_r)))
+        s0 += len(gid_r)
+    gid_glob = np.concatenate(
+        [np.asarray(gid_r, np.int64) + offs[r]
+         for r, (gid_r, _sp2, _g) in enumerate(segs)])
+    idx, live, per_shard = _shard_layout(slices, shard_of, mesh.n)
+    publish_shard_balance(per_shard)
+    lmax = len(live) // mesh.n
+
+    gid_sh = np.where(live, gid_glob[idx], sp_total - 1)
+    planes = [jnp.asarray(gid_sh)]
+    h2d = gid_sh.nbytes
+    for i in range(len(ops)):
+        vparts = []
+        okparts = []
+        for gid_r, specs_r, _g in segs:
+            _op, vals, ok = specs_r[i]
+            if vals is None:
+                vals = np.ones(len(gid_r), dtype=np.int64)
+            vparts.append(np.asarray(vals))
+            okparts.append(np.asarray(ok, bool))
+        vals_sh = np.concatenate(vparts)[idx]
+        ok_sh = np.concatenate(okparts)[idx] & live
+        h2d += vals_sh.nbytes + ok_sh.nbytes
+        planes.append(jnp.asarray(vals_sh))
+        planes.append(jnp.asarray(ok_sh))
+
+    local = _states_local_fn(mesh, ops, sp_total, lmax, dtypes)
+    sp = tracing.current().child("mesh_near_data") \
+        .set("shards", mesh.n).set("regions", R) \
+        .set("states", len(ops)).set("rows", int(s0)) \
+        .set("transfer_bytes", int(h2d))
+    if not sp.is_noop:
+        for sh in range(mesh.n):
+            placed = [rid for rid, s in zip(region_ids, shard_of)
+                      if s == sh]
+            sp.child("mesh_shard").set("shard", sh) \
+                .set("regions", placed).set("rows", per_shard[sh]) \
+                .finish()
+    t0 = _time.perf_counter()
+    try:
+        if failpoint._active:
+            failpoint.eval("device/mesh_collective",
+                           lambda: errors.DeviceError(
+                               "injected mesh collective failure"))
+            # the near-data channel IS a states kernel dispatch: a
+            # device/agg_states fault fails this rung too, so the ladder
+            # bottoms out at the host states path the failpoint targets
+            failpoint.eval("device/agg_states",
+                           lambda: errors.DeviceError(
+                               "injected device agg-states failure"))
+        outs = mesh.run_states(local, tuple(planes), live)
+    except errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash on the mesh states channel: typed, so
+        # the statement degrades to the single-device batched dispatch
+        # (same monoid algebra) — answers cannot change
+        sp.set("error", "fault").finish()
+        raise errors.DeviceError(
+            f"mesh near-data states failed: {e}") from e
+    rb_bytes = sum(int(np.atleast_1d(np.asarray(o)).nbytes)
+                   for o in outs)
+    sp.set("readbacks", 1).set("readback_bytes", int(rb_bytes))
+    sp.finish()
+    tracing.record_dispatch(
+        readback_bytes=int(rb_bytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    metrics.counter("copr.mesh.near_data_dispatches").inc()
+    metrics.counter("copr.mesh.near_data_regions").inc(R)
+    metrics.counter("copr.mesh.near_data_rows").inc(int(s0))
+    # each output is [n * Sp] shard-major (or [Sp] on a 1-shard mesh);
+    # region r's states live in its HOME SHARD's block at its offset
+    full = [np.atleast_1d(np.asarray(o)).reshape(mesh.n, sp_total)
+            for o in outs]
+    return [[o[shard_of[r], offs[r]:offs[r] + Gs[r]] for o in full]
+            for r in range(R)]
+
+
+# ---------------------------------------------------------------------------
 # mesh-sharded join probe: build replicated, probe rows sharded over the
 # axis, per-shard pair blocks in ONE merged packed readback
 # ---------------------------------------------------------------------------

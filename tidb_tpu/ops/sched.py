@@ -496,16 +496,91 @@ def _build_agg_wrapper(root, aggs):
     return wrapper
 
 
+# top-n limits above this never batch: the per-slot readback is (k+1)
+# f64 values, so a large k erodes the shared-dispatch economics the tier
+# exists for (and a below-floor scan rarely wants more rows than this)
+TOPN_SLOT_LIMIT_MAX = 128
+
+
+def _lower_slot_topn(sel, batch):
+    """Lower a below-floor ORDER BY ... LIMIT k (top-n) into the per-slot
+    sort kind, or None → unbatchable (the solo route answers). Admitted
+    keys are packed COLUMN planes whose code/plane order IS the SQL
+    order — ints/times (packed monotone), floats, fixed-scale decimals,
+    dictionary strings (codes sorted by bytes) — the same key domains
+    kernels.build_topn_fn_multi sorts, so batched answers are
+    row-identical to the solo device top-n and the CPU heap."""
+    if not sel.order_by or sel.limit is None:
+        return None
+    k = int(sel.limit)
+    if k <= 0 or k > min(TOPN_SLOT_LIMIT_MAX, batch.capacity):
+        return None
+    keys = []
+    for item in sel.order_by:
+        e = item.expr
+        if e.tp != ExprType.COLUMN_REF:
+            return None
+        cd = batch.columns.get(e.val)
+        if cd is None:
+            return None
+        if cd.kind not in (col.K_I64, col.K_F64, col.K_DEC, col.K_STR):
+            return None
+        keys.append((e.val, bool(item.desc), cd.kind))
+    return tuple(keys), k
+
+
+def _build_topn_wrapper(root, keys, k: int):
+    """Traceable body of the top-n slot kind: vmap over the per-slot
+    parameter blocks, each slot computing its where-mask and ONE full
+    lexsort over the shared sort-key planes — the sort-key construction
+    mirrors kernels.build_topn_fn_multi term for term (orderable domain,
+    -0.0 normalization, NULL ordering, dead-rows-last, stable row-index
+    tiebreak), so the batched and solo top-n orders cannot diverge. Each
+    slot reads back (k + 1) f64 values: the chosen row indices (exact in
+    f64 — capacities sit far below 2^53) and the live count."""
+    import jax
+    import jax.numpy as jnp
+
+    def wrapper(planes, live, pi, pf):
+        def one(pi_row, pf_row):
+            mask = live
+            if root is not None:
+                v, va = root(planes, pi_row, pf_row)
+                mask = mask & va & _truthy(v)
+            sort_keys = []   # least-significant first for lexsort
+            for cid, desc, _kind in reversed(keys):
+                v, va = planes[cid]
+                vo = jnp.where(v == 0.0, 0.0, v) \
+                    if v.dtype == jnp.float64 else v.astype(jnp.int64)
+                if desc:
+                    vo = -vo
+                nullk = va.astype(jnp.int32) if not desc \
+                    else (~va).astype(jnp.int32)
+                sort_keys.append(jnp.where(va, vo, jnp.zeros_like(vo)))
+                sort_keys.append(nullk)
+            sort_keys.append((~mask).astype(jnp.int32))  # dead rows last
+            order = jnp.lexsort(sort_keys)
+            idx = order[:k]
+            n_live = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), k)
+            return jnp.concatenate([idx.astype(jnp.float64),
+                                    n_live.astype(jnp.float64)[None]])
+
+        return jax.vmap(one)(pi, pf).reshape(-1)
+
+    return wrapper
+
+
 class _Entry:
     __slots__ = ("req", "sel", "batch", "fn", "sig", "pi", "pf", "cids",
-                 "cols", "aggs", "event", "result", "error", "degrade",
-                 "taken")
+                 "cols", "aggs", "topn", "event", "result", "error",
+                 "degrade", "taken")
 
     def __init__(self):
         self.event = threading.Event()
         self.result = None
         self.error = None
         self.aggs = None        # _SlotAgg list for the aggregate kind
+        self.topn = None        # (keys, k) for the top-n slot kind
         self.degrade = None     # None | "solo" | "stall" | "fault"
         self.taken = False
 
@@ -555,12 +630,18 @@ class MicroBatcher:
     def _prepare(self, client, req: kv.Request, sel) -> _Entry | None:
         if req.tp != kv.REQ_TYPE_SELECT or sel.table_info is None:
             return None
-        if sel.order_by or sel.having is not None:
+        if sel.having is not None:
             return None
         is_agg = sel.is_agg()
-        if is_agg and (sel.group_by or sel.limit is not None or sel.desc):
+        if is_agg and (sel.group_by or sel.limit is not None or sel.desc
+                       or sel.order_by):
             return None
-        if not is_agg and sel.where is None:
+        # non-agg ORDER BY batches only as top-n (order + LIMIT); an
+        # unlimited sort is not below-floor work this tier should own
+        is_topn = bool(sel.order_by) and not is_agg
+        if is_topn and sel.limit is None:
+            return None
+        if not is_agg and not is_topn and sel.where is None:
             return None
         try:
             batch = client._get_batch(sel, req.key_ranges)
@@ -582,18 +663,30 @@ class MicroBatcher:
             aggs = _lower_slot_aggs(sel, batch)
             if aggs is None:
                 return None
+        topn = None
+        if is_topn:
+            # the top-n slot kind: desc/limit selection lowers INTO the
+            # vmapped dispatch (per-slot lexsort), so below-floor ORDER
+            # BY ... LIMIT statements stop solo-routing to the row engine
+            topn = _lower_slot_topn(sel, batch)
+            if topn is None:
+                return None
         e = _Entry()
         e.req, e.sel, e.batch = req, sel, batch
         cids = set(lw.cids)
         if aggs is not None:
             cids.update(a.cid for a in aggs if a.cid is not None)
+        if topn is not None:
+            cids.update(cid for cid, _d, _kd in topn[0])
         e.fn, e.cids = fn, frozenset(cids)
         e.aggs = aggs
+        e.topn = topn
         # parameter COUNTS ride the signature so equal sigs guarantee
-        # aligned parameter blocks; the aggregate shape rides it too so
-        # filter and aggregate entries can never share a dispatch
+        # aligned parameter blocks; the aggregate and top-n shapes ride
+        # it too so filter, aggregate, and top-n entries can never share
+        # a dispatch
         agg_sig = tuple(a.sig for a in aggs) if aggs is not None else None
-        e.sig = (sig, agg_sig, len(lw.pi), len(lw.pf))
+        e.sig = (sig, agg_sig, topn, len(lw.pi), len(lw.pf))
         e.pi = np.asarray(lw.pi, dtype=np.int64)
         e.pf = np.asarray(lw.pf, dtype=np.float64)
         e.cols = list(sel.table_info.columns)
@@ -905,15 +998,17 @@ class MicroBatcher:
                 failpoint.eval("device/compile", lambda: errors.DeviceError(
                     "injected kernel compile failure (batched_filter)"))
             root = proto.fn
-            if proto.aggs is not None:
-                wrapper = _build_agg_wrapper(root, proto.aggs)
+            if proto.aggs is not None or proto.topn is not None:
+                wrapper = (_build_agg_wrapper(root, proto.aggs)
+                           if proto.aggs is not None else
+                           _build_topn_wrapper(root, *proto.topn))
                 try:
                     ent = (jax.jit(wrapper), {"runs": 0})
                 except (errors.TiDBError, Unsupported):
                     raise
                 except Exception as e:
                     raise errors.DeviceError(
-                        f"batched agg kernel build failed: {e}") from e
+                        f"batched slot kernel build failed: {e}") from e
                 with self._lock:
                     cur = self._fn_cache.get(key)
                     if cur is not None:
@@ -966,7 +1061,7 @@ class MicroBatcher:
         batch = proto.batch
         k = len(chunk)
         kb = _slot_bucket(k)
-        n_i, n_f = proto.sig[2], proto.sig[3]
+        n_i, n_f = proto.sig[3], proto.sig[4]
         pi = np.zeros((kb, n_i), dtype=np.int64)
         pf = np.zeros((kb, n_f), dtype=np.float64)
         for j, e in enumerate(chunk):
@@ -977,7 +1072,9 @@ class MicroBatcher:
         planes = kernels.batch_planes(batch)
         sub = {cid: planes[cid] for cid in proto.cids}
         live = kernels.device_live(batch)
-        kind = "batched_agg" if proto.aggs is not None else "batched_filter"
+        kind = ("batched_agg" if proto.aggs is not None else
+                "batched_topn" if proto.topn is not None else
+                "batched_filter")
         # HBM governance: the [slots, capacity] mask block (or per-slot
         # reduction block) the batched kernel materializes charges the
         # process ledger for the dispatch's duration
@@ -988,14 +1085,16 @@ class MicroBatcher:
         from tidb_tpu.ops import membudget
         slot_bytes = kb * batch.capacity \
             + kb * 8 * max(self._slot_layout(proto.aggs)
-                           if proto.aggs is not None else 1, 1)
+                           if proto.aggs is not None else
+                           proto.topn[1] + 1
+                           if proto.topn is not None else 1, 1)
         with membudget.reserve(slot_bytes, "batch"):
             packed = client._dispatch_kernel(
                 jitted, sub, live, kind, kst,
                 extra=(jnp.asarray(pi), jnp.asarray(pf)),
                 attrs={"batch_size": k, "batch_slots": kb})
         masks = None
-        if proto.aggs is None:
+        if proto.aggs is None and proto.topn is None:
             masks = _unpack_mask_words(packed, kb, batch.capacity)[:k]
         metrics.counter("sched.batched_dispatches").inc()
         metrics.histogram("sched.batch_size").observe(k)
@@ -1023,6 +1122,20 @@ class MicroBatcher:
             metrics.counter("sched.batched_agg_statements").inc(k)
             for j, e in enumerate(chunk):
                 e.result = self._emit_agg(client, e, block[j])
+            return
+        if proto.topn is not None:
+            # top-n slot kind: each slot's (k row indices, live count)
+            # demuxes straight into that statement's emission — order
+            # and limit already applied ON DEVICE, the host touches k+1
+            # values per statement instead of re-sorting rows
+            kk = proto.topn[1]
+            block = np.asarray(packed, dtype=np.float64).reshape(kb,
+                                                                 kk + 1)
+            metrics.counter("sched.batched_topn_statements").inc(k)
+            for j, e in enumerate(chunk):
+                n = int(block[j, kk])
+                idx = block[j, :n].astype(np.int64)
+                e.result = self._emit(client, e, idx)
             return
         for j, e in enumerate(chunk):
             idx = np.nonzero(masks[j])[0]

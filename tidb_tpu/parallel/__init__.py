@@ -113,11 +113,26 @@ class CoprMesh:
         sets ride this path; the host does the final (tiny) merge, the
         same split as the reference's per-region coprocessor fan-out +
         SQL-side merge (store/tikv/coprocessor.go:305)."""
+        return self._run_shardmajor(("sharded", id(fn)), fn, planes, live)
+
+    def run_states(self, fn, planes, live):
+        """Per-shard grouped-STATES channel (the near-data execution
+        tier, ops.mesh.region_states_sharded): identical mechanics to
+        run_sharded — rows sharded over the axis, per-shard state blocks
+        back shard-major, NO collectives (each region lives wholly on
+        its home shard, so an all-reduce would only fold monoid
+        identities) — under its own cache key so statement-signature
+        states kernels and filter/top-k kernels can never collide on a
+        recycled fn id. This is what lets the in-proc mesh TpuClient and
+        the fan-out drain ship per-shard STATES instead of raw columnar
+        rows."""
+        return self._run_shardmajor(("states", id(fn)), fn, planes, live)
+
+    def _run_shardmajor(self, key, fn, planes, live):
         if live.shape[0] % self.n != 0:
             raise Unsupported(
                 f"batch capacity {live.shape[0]} not divisible by mesh "
                 f"size {self.n}")
-        key = ("sharded", id(fn))
         ent = self._jit_cache.get(key)
         if ent is None or ent[0] is not fn:
             if self.n == 1:
