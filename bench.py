@@ -610,6 +610,9 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
     hits = metrics.counter("distsql.columnar_hits")
     fbs = metrics.counter("distsql.columnar_fallbacks")
     parts = metrics.counter("distsql.columnar_partials")
+    # no pushed-down WHERE on this shape: the filter tier must stay out
+    # of the way (0 batched filter dispatches across the timed window)
+    fdisp = metrics.counter("copr.filter.batched_dispatches")
     sess = Session(store)
     sess.execute("use fan")
     # the fan-out figure measures the PACK PATH (comparable across bench
@@ -620,6 +623,7 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
     sess.execute(REGION_FANOUT_SQL)       # warm (jit)
     h0, f0, p0 = hits.value, fbs.value, parts.value
     c0 = fused_agg.stats["partial_combines"]
+    fd0 = fdisp.value
     t0 = time.time()
     for _ in range(runs):
         col_results = sess.execute(REGION_FANOUT_SQL)[0].values()
@@ -633,6 +637,9 @@ def measure_region_fanout(n_rows: int, n_dim: int, n_regions: int,
         f"only {d_parts} columnar partials across {n_regions} regions"
     assert combines > 0, \
         "fused aggregate never merged per-region partials device-side"
+    assert fdisp.value - fd0 == 0, \
+        (f"WHERE-less fan-out ran {fdisp.value - fd0} batched filter "
+         f"dispatches — the filter tier fired without a predicate")
 
     # row-protocol regime across the SAME fan-out (the kill switch path)
     client = store.get_client()
@@ -850,9 +857,14 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
     disp = (metrics.counter("copr.states_batch.dispatches"),
             metrics.counter("copr.mesh.near_data_dispatches"),
             metrics.counter("copr.states_batch.serial_dispatches"))
+    # the filter headline: the pushed-down WHERE (l_ship <= 180) must
+    # ride ONE batched device filter dispatch per statement — filter +
+    # states together cost ≤ 2 device dispatches for the whole fan-out
+    fdisp = metrics.counter("copr.filter.batched_dispatches")
     s.execute(Q1_PUSHDOWN_SQL)            # warm (pack + jit)
     f0, p0, b0 = fbs.value, states.value, st_bytes.value
     d0 = sum(c.value for c in disp)
+    fd0 = fdisp.value
     fs0 = fused_agg.stats["final_states"]
     t0 = time.time()
     for _ in range(runs):
@@ -862,6 +874,7 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
     d_states = states.value - p0
     d_st_bytes = st_bytes.value - b0
     d_disp = sum(c.value for c in disp) - d0
+    d_fdisp = fdisp.value - fd0
     d_fusions = fused_agg.stats["final_states"] - fs0
     assert d_fbs == 0, \
         f"q1 pushdown counted {d_fbs} columnar fallbacks"
@@ -874,6 +887,14 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
     assert disp_per_stmt == 1, \
         (f"q1 ran {disp_per_stmt} states dispatches per statement "
          f"across {n_regions} regions — near-data batching regressed")
+    fdisp_per_stmt = d_fdisp / runs if runs else 0.0
+    assert fdisp_per_stmt == 1, \
+        (f"q1 ran {fdisp_per_stmt} batched filter dispatches per "
+         f"statement across {n_regions} regions — the pushed-down WHERE "
+         f"fell off the device filter tier")
+    assert fdisp_per_stmt + disp_per_stmt <= 2, \
+        (f"q1 cost {fdisp_per_stmt + disp_per_stmt} device dispatches "
+         f"per statement — the ≤ 2 filter+states budget regressed")
 
     # row-protocol regime (kill switch): the parity oracle AND the
     # wire-bytes denominator (partial chunk rows per region)
@@ -906,6 +927,8 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
         "q1_pushdown_states_partials": d_states,
         "q1_pushdown_state_fusions": d_fusions,
         "q1_states_dispatches_per_stmt": disp_per_stmt,
+        "q1_filter_dispatches_per_stmt": fdisp_per_stmt,
+        "q1_device_dispatches_per_stmt": fdisp_per_stmt + disp_per_stmt,
         "q1_states_bytes_vs_rows_bytes": round(
             d_st_bytes / d_row_bytes, 3) if d_row_bytes else None,
     }

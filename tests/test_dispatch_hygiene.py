@@ -195,6 +195,14 @@ def test_segmented_states_dispatch_sites_serialize():
         "CoprMesh._run_shardmajor lost its dispatch_serial block"
 
 
+def test_batched_filter_dispatch_site_serializes():
+    """The PR 17 filter tier, pinned by name: the batched ragged filter
+    kernel owns a launch+readback (bit-packed masks) and must keep its
+    dispatch_serial block."""
+    assert _serial_span_of(ROOT / "kernels.py", "region_filter_batched"), \
+        "kernels.region_filter_batched lost its dispatch_serial block"
+
+
 def test_checker_detects_unserialized_launch(tmp_path):
     """Meta-test: the walker must flag both rule shapes end-to-end (a
     refactor cannot silently neuter it)."""

@@ -129,3 +129,19 @@ def test_checker_detects_a_violation():
             found = _type_names(h.type) == ["Exception"] \
                 and not _contains_raise(h)
     assert found and tmp.exists()
+
+
+def test_filter_tier_degradation_seams_present():
+    """PR 17 filter-tier seams, pinned by name: the batched filter
+    kernel's device fault seam and the statement finisher's host-rung
+    seam must stay wired to the degradation ladder (typed DeviceError
+    handlers, counted on copr.degraded_filter_batch) — removing either
+    silently un-certifies the ladder the differential suite exercises."""
+    kernels = (ROOT / "ops" / "kernels.py").read_text()
+    region = (ROOT / "copr" / "columnar_region.py").read_text()
+    assert '"device/filter_batched"' in kernels, \
+        "kernels.region_filter_batched lost its device/filter_batched seam"
+    assert '"copr/filter_batched"' in region, \
+        "_finish_filter_batch lost its copr/filter_batched seam"
+    assert 'record_degraded("filter_batch")' in region, \
+        "filter-tier fallbacks no longer counted on copr.degraded_filter_batch"

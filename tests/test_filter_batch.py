@@ -1,0 +1,468 @@
+"""Differential suite for the DEVICE FILTER tier (PR 17): a pushed-down
+aggregate statement with a WHERE ships every region's payload with the
+filter AND the states deferred, and the statement finisher runs the
+whole thing in ≤ 2 device dispatches: ONE batched ragged filter
+(kernels.region_filter_batched — bit-packed survivor masks, rows never
+transit the host) feeding ONE batched segmented states dispatch. The
+contract across 1/2/4/8 regions: answers identical to the host exprc
+rung (BATCH_FILTER_ENABLED=False) AND the row protocol — including NULL
+planes in the predicate, dictionary-code predicates (prefix LIKE as a
+code-range compare, IN as a sorted-membership probe), every failpoint
+rung of the filter degradation ladder, mid-scan split/merge
+re-batching, shape-bucketed jit (bounded retraces under skewed splits),
+and the cross-STATEMENT gather window that batches concurrent
+below-floor statements into one shared states dispatch."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu import failpoint, metrics, tablecodec as tc
+from tidb_tpu.copr import columnar_region
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 260
+
+QUERIES = [
+    # q1 shape: numeric compare WHERE, decimal sums, string group keys
+    "select l_flag, l_status, sum(l_qty), sum(l_price), avg(l_qty), "
+    "avg(l_price), avg(l_disc), count(*) from lineitem "
+    "where l_ship <= 180 group by l_flag, l_status "
+    "order by l_flag, l_status",
+    # scalar aggregates under an AND of compares
+    "select count(*), sum(l_qty), min(l_price), max(l_price), "
+    "avg(l_disc), sum(l_disc) from lineitem "
+    "where l_qty > 10 and l_price < 2400",
+    # NULL plane in the predicate AND the group key: l_k is NULL every
+    # 11th row (NULL < 5 is UNKNOWN → filtered out, MySQL semantics)
+    "select l_k, count(*), sum(l_disc), min(l_disc), max(l_qty) "
+    "from lineitem where l_k < 5 group by l_k order by l_k",
+    # prefix LIKE over the sorted global dictionary: a caseless-ASCII
+    # prefix lowers to an integer code-RANGE compare (PR 14 residual d)
+    "select l_flag, count(*), sum(l_price) from lineitem "
+    "where l_ref like '2-%' group by l_flag order by l_flag",
+    # IN over dict codes (one absent item exercises the dropped -1
+    # code) + a general non-prefix LIKE (the dictionary LUT path)
+    "select l_status, count(*), sum(l_qty) from lineitem "
+    "where l_flag in ('A', 'Z') and l_ref like '%-y' "
+    "group by l_status order by l_status",
+]
+
+
+def _build(n_regions: int) -> Session:
+    store = new_store(f"cluster://3/filterbatch{next(_id)}")
+    s = Session(store)
+    s.execute("create database fb")
+    s.execute("use fb")
+    s.execute(
+        "create table lineitem (l_id bigint primary key, "
+        "l_flag varchar(4), l_status varchar(4), l_qty decimal(12,2), "
+        "l_price decimal(12,2), l_disc double, l_k bigint, "
+        "l_ship bigint, l_ref varchar(8))")
+    from decimal import Decimal
+    vals = []
+    for i in range(1, N_ROWS + 1):
+        flag = ("A", "N", "R")[i % 3]
+        status = ("F", "O")[i % 2]
+        qty = Decimal(i % 50) + Decimal(i % 4) / 4
+        price = Decimal(900 + i * 7) + Decimal(i % 10) / 10
+        disc = (i % 11) * 0.01
+        k = "null" if i % 11 == 0 else str(i % 7)
+        ref = f"{i % 4}-{'xyz'[i % 3]}"
+        vals.append(f"({i}, '{flag}', '{status}', {qty}, {price}, "
+                    f"{disc!r}, {k}, {i % 365}, '{ref}')")
+    s.execute(f"insert into lineitem values {', '.join(vals)}")
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("fb", "lineitem").info.id
+        step = N_ROWS // n_regions
+        store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _c(name: str) -> int:
+    return metrics.counter(name).value
+
+
+def _fdisp() -> int:
+    return _c("copr.filter.batched_dispatches")
+
+
+def _sdisp() -> int:
+    """States dispatches, whichever device route answered."""
+    return (_c("copr.states_batch.dispatches")
+            + _c("copr.mesh.near_data_dispatches"))
+
+
+def _all(s: Session, queries=QUERIES) -> list:
+    return [s.execute(q)[0].values() for q in queries]
+
+
+def _host_rung(s: Session, monkeypatch, queries=QUERIES) -> list:
+    """Oracle 1: the per-region HOST exprc filter (the pre-PR-17 eager
+    path — same compiled predicate algebra, evaluated region-side)."""
+    monkeypatch.setattr(columnar_region, "BATCH_FILTER_ENABLED", False)
+    try:
+        return [s.execute(q)[0].values() for q in queries]
+    finally:
+        monkeypatch.setattr(columnar_region, "BATCH_FILTER_ENABLED", True)
+
+
+def _row_protocol(s: Session, queries=QUERIES) -> list:
+    """Oracle 2: the row protocol (kill switch)."""
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        return [s.execute(q)[0].values() for q in queries]
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+
+
+def _norm(rows):
+    out = []
+    for row in rows:
+        nr = []
+        for v in row:
+            if v is None:
+                nr.append(None)
+            else:
+                try:
+                    nr.append(round(float(v), 9))
+                except (TypeError, ValueError):
+                    nr.append(v.decode() if isinstance(v, bytes) else v)
+        out.append(nr)
+    return out
+
+
+@pytest.mark.parametrize("n_regions", [1, 2, 4, 8])
+def test_filter_plus_states_in_two_dispatches(n_regions, monkeypatch):
+    """The headline invariant: a pushed-down aggregate with a WHERE
+    costs ONE batched filter dispatch + at most one states dispatch per
+    statement — never one per region — with answers identical to the
+    host exprc rung and the row protocol."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(n_regions)
+    f0, s0 = _fdisp(), _sdisp()
+    fr0 = _c("copr.filter.batched_regions")
+    def0 = _c("distsql.columnar_filter_deferred")
+    fb0 = _c("distsql.columnar_fallbacks")
+    got = _all(s)
+    assert _fdisp() - f0 == len(QUERIES), \
+        (f"{_fdisp() - f0} batched filter dispatches for {len(QUERIES)} "
+         f"statements over {n_regions} regions — not one per statement")
+    assert (_fdisp() - f0) + (_sdisp() - s0) <= 2 * len(QUERIES), \
+        "a statement cost more than 2 device dispatches (filter+states)"
+    assert _c("copr.filter.batched_regions") - fr0 == \
+        n_regions * len(QUERIES), \
+        "not every region's WHERE rode the batched filter dispatches"
+    assert _c("distsql.columnar_filter_deferred") - def0 == \
+        n_regions * len(QUERIES), \
+        "not every region deferred its filter to the statement finisher"
+    assert _c("distsql.columnar_fallbacks") == fb0, \
+        "the filter tier pushed a region off the columnar channel"
+
+    host = _host_rung(s, monkeypatch)
+    for q, g, w in zip(QUERIES, got, host):
+        assert _norm(g) == _norm(w), \
+            f"device filter diverged from the host exprc rung on {q!r}"
+    rows = _row_protocol(s)
+    for q, g, w in zip(QUERIES, got, rows):
+        assert _norm(g) == _norm(w), \
+            f"device filter diverged from the row protocol on {q!r}"
+
+
+def test_float_sums_after_device_filter_bitexact(monkeypatch):
+    """Float SUM/AVG over device-filtered survivors stay EXACT (==, not
+    approximate) vs the row protocol: the mask is bit-identical to the
+    host filter's, and the surviving floats accumulate in row order."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    q = ("select l_k, sum(l_disc), avg(l_disc) from lineitem "
+         "where l_ship <= 180 group by l_k order by l_k")
+    f0 = _fdisp()
+    got = s.execute(q)[0].values()
+    assert _fdisp() > f0, "filtered float query missed the filter batch"
+    want = _row_protocol(s, [q])[0]
+    assert got == want     # bitwise-identical floats
+
+
+def test_jit_churn_bounded_under_skewed_splits(monkeypatch):
+    """Residual-b churn guard: plane capacities, filter caps and
+    segment spans are power-of-two BUCKETED, so repeated scans retrace
+    NOTHING and a skewed mid-table split retraces at most the handful
+    of shapes its new region count introduces — the re-scan after each
+    split compiles nothing new."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    store = s.store
+    tid = s.info_schema().table_by_name("fb", "lineitem").info.id
+    q = QUERIES[0]
+    s.execute(q)                       # warm: traces the 4-region shapes
+    m0 = _c("ops.jit_cache_misses")
+    for _ in range(5):
+        s.execute(q)
+    assert _c("ops.jit_cache_misses") == m0, \
+        "steady-state repeat scans paid trace+compile (jit churn)"
+    # skewed splits: 4 → 5 → 6 regions at lopsided keys. Each new
+    # region COUNT may trace its own batched shapes once; the repeat
+    # scan after each split must hit every cache (shape bucketing eats
+    # the row-count/group-count skew).
+    for split_at in (7, 251):
+        store.cluster.split_keys([tc.encode_row_key(tid, split_at)])
+        s.execute(q)
+        m_after = _c("ops.jit_cache_misses")
+        s.execute(q)
+        assert _c("ops.jit_cache_misses") == m_after, \
+            f"re-scan after split@{split_at} still paid trace+compile"
+    total = _c("ops.jit_cache_misses") - m0
+    # budget: per new region count ≤ (filter trace + states trace +
+    # per-region predicate compiles + final-combine shapes) — bounded
+    # by the topology changes, NOT by the scan count
+    assert total <= 20, \
+        f"{total} jit misses across 2 splits — shape bucketing regressed"
+
+
+def test_copr_filter_batched_fault_takes_host_rung(monkeypatch):
+    """copr/filter_batched (finisher seam) → the statement's masks come
+    from the per-region host exprc rung (copr.degraded_filter_batch),
+    no filter kernel dispatch, answers unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _all(s)
+    deg = metrics.counter("copr.degraded_filter_batch")
+    d0, f0 = deg.value, _fdisp()
+    failpoint.enable("copr/filter_batched", "return", value=True)
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("copr/filter_batched")
+    assert deg.value - d0 == len(QUERIES), \
+        "the finisher seam never degraded the filter batch"
+    assert _fdisp() == f0, \
+        "degraded statements still dispatched the filter kernel"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"host-rung degraded filter diverged on {q!r}"
+
+
+def test_device_filter_batched_fault_takes_host_rung(monkeypatch):
+    """device/filter_batched (kernel seam) → typed DeviceError → the
+    host exprc rung answers (copr.degraded_filter_batch), the states
+    batch still runs, answers unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _all(s)
+    deg = metrics.counter("copr.degraded_filter_batch")
+    d0, s0 = deg.value, _sdisp()
+    failpoint.enable("device/filter_batched")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("device/filter_batched")
+    assert deg.value - d0 == len(QUERIES), \
+        "the kernel fault never degraded the filter batch"
+    assert _sdisp() > s0, \
+        "host-rung masks no longer feed the batched states dispatch"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"kernel-fault degraded filter diverged on {q!r}"
+
+
+def test_device_fault_ladder_bottoms_out_at_host(monkeypatch):
+    """Every device rung out at once (filter kernel + states kernel +
+    mesh collective): masks from host exprc, states from host numpy —
+    answers still identical to the row protocol."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _row_protocol(s)
+    deg_f = metrics.counter("copr.degraded_filter_batch")
+    f0 = deg_f.value
+    failpoint.enable("device/filter_batched")
+    failpoint.enable("device/agg_states")
+    failpoint.enable("device/mesh_collective")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("device/mesh_collective")
+        failpoint.disable("device/agg_states")
+        failpoint.disable("device/filter_batched")
+    assert deg_f.value > f0, \
+        "the filter kernel fault never hit the degradation counter"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"all-host degraded pipeline diverged on {q!r}"
+
+
+def test_copr_filter_fault_degrades_to_rows(monkeypatch):
+    """copr/filter (region seam, below the deferral) → the region drops
+    to the row protocol entirely: nothing defers, fallbacks are counted
+    per partial, answers unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _row_protocol(s)
+    f0, fb0 = _fdisp(), _c("distsql.columnar_fallbacks")
+    failpoint.enable("copr/filter")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("copr/filter")
+    assert _c("distsql.columnar_fallbacks") > fb0
+    assert _fdisp() == f0, \
+        "row-degraded regions still rode the batched filter"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"row-degraded filter diverged on {q!r}"
+
+
+def test_copr_agg_states_fault_degrades_to_rows(monkeypatch):
+    """copr/agg_states fires at REGION time in deferred mode too (the
+    seam is hoisted above the deferral decision): a typed fault drops
+    the region to partial rows exactly as the eager path does."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _row_protocol(s)
+    f0, fb0 = _fdisp(), _c("distsql.columnar_fallbacks")
+    failpoint.enable("copr/agg_states")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("copr/agg_states")
+    assert _c("distsql.columnar_fallbacks") > fb0
+    assert _fdisp() == f0, \
+        "agg-states-degraded regions still deferred their filter"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"row-degraded aggregate diverged on {q!r}"
+
+
+def test_mid_scan_split_and_merge_rebatch(monkeypatch):
+    """A split/merge injected DURING the fan-out: the stale-epoch retry
+    re-collects deferred payloads and the finisher still filters the
+    statement in ONE batched dispatch over the NEW region set."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    store = s.store
+    want = _all(s)
+    tid = s.info_schema().table_by_name("fb", "lineitem").info.id
+
+    def mutate_split(st):
+        st.cluster.split_keys([tc.encode_row_key(tid, 33),
+                               tc.encode_row_key(tid, 177)])
+
+    def mutate_merge(st):
+        regions = st.cluster.regions
+        for i in range(len(regions) - 1):
+            if regions[i].start:
+                st.cluster.merge(regions[i].region_id,
+                                 regions[i + 1].region_id)
+                return
+
+    for mutate in (mutate_split, mutate_merge):
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts, orig=orig, state=state,
+                 mutate=mutate):
+            state["n"] += 1
+            if state["n"] == 2 and not state["done"]:
+                state["done"] = True
+                mutate(store)
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        f0 = _fdisp()
+        try:
+            got = _all(s)
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"]
+        assert _fdisp() - f0 == len(QUERIES), \
+            "mid-scan topology change broke one-filter-dispatch-per-stmt"
+        for q, g, w in zip(QUERIES, got, want):
+            assert _norm(g) == _norm(w), \
+                f"mid-scan topology change diverged on {q!r}"
+
+
+def test_states_gather_combines_concurrent_submissions():
+    """The cross-statement gather, driven directly: two below-floor
+    submissions inside one window combine past the floor into ONE
+    batched dispatch (sched.cross_stmt_states_batches), each getting
+    exactly its own segment's slice — identical to a solo dispatch."""
+    from tidb_tpu.ops import kernels, sched
+    g = sched.StatesGather(window_s=0.25)
+    g._last_multi = time.monotonic()   # hot signature: leader waits
+    n = 64
+    gid = (np.arange(n, dtype=np.int64) % 4)
+    vals = np.arange(n, dtype=np.int64)
+    ok = np.ones(n, dtype=bool)
+    seg = (gid, [("sum", vals, ok)], 4)
+    want = kernels.region_agg_states_batched([seg])[0]   # solo oracle
+    c0 = _c("sched.cross_stmt_states_batches")
+    outs = [None, None]
+    barrier = threading.Barrier(2)
+
+    def run(i):
+        barrier.wait()
+        outs[i] = g.submit(("sum",), [seg], n, 100)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # 64 < 100 each, 128 ≥ 100 combined: both fulfilled by one dispatch
+    assert outs[0] is not None and outs[1] is not None, \
+        "combined-past-the-floor submissions stayed serial"
+    assert _c("sched.cross_stmt_states_batches") == c0 + 1, \
+        "two concurrent statements did not share one states dispatch"
+    for o in outs:
+        np.testing.assert_array_equal(np.asarray(o[0][0]),
+                                      np.asarray(want[0]))
+
+
+def test_cross_statement_batching_parity_vs_solo(monkeypatch):
+    """E2E: two below-floor statements running CONCURRENTLY drain into
+    the gather window and answer from one shared states dispatch — with
+    answers identical to each statement running solo."""
+    from tidb_tpu.ops import sched
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 300)
+    g = sched.StatesGather(window_s=0.25)
+    g._last_multi = time.monotonic()
+    monkeypatch.setattr(sched, "states_gather", g)
+    s1 = _build(4)
+    s2 = Session(s1.store)
+    s2.execute("use fb")
+    q = QUERIES[0]
+    solo = s1.execute(q)[0].values()     # warm + solo oracle
+    g._last_multi = time.monotonic()     # keep the hot-sig gate open
+    c0 = _c("sched.cross_stmt_states_batches")
+    results = [None, None]
+    errs = []
+    barrier = threading.Barrier(2)
+
+    def run(i, sess):
+        try:
+            barrier.wait()
+            results[i] = sess.execute(q)[0].values()
+        except Exception as e:          # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(0, s1)),
+          threading.Thread(target=run, args=(1, s2))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    assert _c("sched.cross_stmt_states_batches") > c0, \
+        "concurrent below-floor statements never shared a dispatch"
+    for got in results:
+        assert _norm(got) == _norm(solo), \
+            "cross-statement batched answer diverged from solo"

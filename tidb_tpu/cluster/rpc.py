@@ -255,6 +255,13 @@ class RpcHandler:
             # filter ran over every plane row); bytes are the plane
             # footprint (8-byte values + 1-byte valid per column)
             batch = getattr(col, "batch", None)
+            if batch is None:
+                # deferred states/filter payload: its pending pass knows
+                # the scanned pack — len(col) here would force the
+                # serial resolution the statement finisher exists to
+                # batch (and un-defer the whole near-data channel)
+                batch = getattr(getattr(col, "_pending", None),
+                                "batch", None)
             rows = batch.n_rows if batch is not None else len(col)
             ncols = len(batch.columns) if batch is not None else 1
             self.region_heat.record_read(region_id, rows, rows * 9 * ncols)

@@ -56,6 +56,19 @@ STATES_DEVICE_FLOOR = 4096
 # Tests monkeypatch this for the differential suites.
 BATCH_STATES_ENABLED = True
 
+# near-data batched FILTER (PR 17): when on, a pushed-down aggregate
+# region with a lowerable WHERE defers the filter pass too — the payload
+# ships with mask AND states pending, and the statement finisher
+# evaluates every region's predicate over the device-resident cached
+# planes in ONE ragged dispatch (kernels.region_filter_batched, bit-
+# packed survivor masks back), then feeds the masks straight into the
+# batched states dispatch: filter+states in ≤ 2 flat round trips, no
+# host row materialization. Deferral happens only when the region-time
+# probe (_states_probe) PROVES the finish-time states prep cannot fall
+# back to rows — the RPC has already answered by then. Off → the
+# eager host exprc filter of PR 11, which is also the degradation rung.
+BATCH_FILTER_ENABLED = True
+
 
 def handle_columnar_scan(snapshot, sel: SelectRequest,
                          ranges: list[KeyRange], region=None,
@@ -214,14 +227,25 @@ def handle_columnar_scan(snapshot, sel: SelectRequest,
             if failpoint._active:
                 failpoint.eval("copr/filter", lambda: errors.TypeError_(
                     "injected region filter fault"))
+                if agg_specs is not None:
+                    # the agg-states seam fires at region time in BOTH
+                    # modes (deferral would otherwise skip it): a typed
+                    # fault degrades this region to rows exactly as the
+                    # eager path does
+                    failpoint.eval("copr/agg_states",
+                                   lambda: errors.TypeError_(
+                                       "injected agg-states fault"))
+            if agg_specs is not None:
+                resp = _deferred_filter_response(sel, batch, agg_specs,
+                                                 region, cache_info,
+                                                 columns, is_index)
+                if resp is not None:
+                    fsp.set("deferred", 1)
+                    return resp
             mask = _filter_mask(sel, batch)
             if mask is not None:
                 fsp.set("rows_out", int(np.count_nonzero(mask)))
         if agg_specs is not None and mask is not None:
-            if failpoint._active:
-                failpoint.eval("copr/agg_states",
-                               lambda: errors.TypeError_(
-                                   "injected agg-states fault"))
             resp = _agg_states_response(sel, batch, mask, agg_specs,
                                         region, cache_info, columns,
                                         is_index)
@@ -363,7 +387,12 @@ def _where_cids(e, out: set) -> None:
 
 
 def _compiled_filter(sel: SelectRequest, batch: col.ColumnBatch):
-    """Compile (or reuse) the pushed where-filter for this batch.
+    return _compiled_filter_ent(sel, batch)[0]
+
+
+def _compiled_filter_ent(sel: SelectRequest, batch: col.ColumnBatch):
+    """(compiled, pinned dictionaries, structural key) of the pushed
+    where-filter for this batch — compiled fresh or reused.
 
     Reuse is sound only when every lowering input matches: the Expr tree
     itself (repr — constants are baked into the closures), and each
@@ -404,7 +433,7 @@ def _compiled_filter(sel: SelectRequest, batch: col.ColumnBatch):
             _filter_cache[key] = ent
             while len(_filter_cache) > 512:
                 _filter_cache.pop(next(iter(_filter_cache)))
-    return ent[0]
+    return ent[0], ent[1], key
 
 
 def _filter_mask(sel: SelectRequest, batch: col.ColumnBatch):
@@ -427,6 +456,108 @@ def _filter_mask(sel: SelectRequest, batch: col.ColumnBatch):
     wv, wva = np.asarray(wv), np.asarray(wva)
     truth = wv if wv.dtype == np.bool_ else (wv != 0)
     return mask & wva & truth
+
+
+def _states_probe(batch: col.ColumnBatch, agg_specs, colpb: dict,
+                  is_index: bool) -> bool:
+    """Can _prepare_states possibly return None for ANY survivor mask of
+    this batch? Evaluated at region time, BEFORE the filter runs — the
+    deferred-filter payload promises states, so the row fallback must be
+    provably unreachable. Mirrors every None exit of _prepare_states:
+    the structural ones are mask-independent; the two mask-dependent
+    guards (-0.0 presence in a float min/max plane, the int-sum wrap
+    bound) are MONOTONE — checked against the SUPERSET mask (all packed
+    rows), they hold for every subset the real filter can produce."""
+    specs, gcids = agg_specs
+    if is_index:
+        for _name, arg in specs:
+            if arg is not None and arg.tp == ExprType.COLUMN_REF:
+                cd = batch.columns.get(arg.val)
+                if cd is not None and cd.kind == col.K_DEC:
+                    return False
+    for cid in gcids:
+        cd = batch.columns.get(cid)
+        c = colpb.get(cid)
+        if cd is None or c is None:
+            return False
+        if not (cd.kind == col.K_STR or cd.kind == col.K_F64
+                or _int_plane(cd, c)):
+            return False
+    sup = batch.row_mask()
+    for name, arg in specs:
+        if arg is None or arg.tp == ExprType.VALUE:
+            continue    # count over a literal: always expressible
+        cd = batch.columns.get(arg.val)
+        c = colpb.get(arg.val)
+        if cd is None or c is None:
+            return False
+        if name == "count":
+            continue
+        if name == "first_row":
+            if not (cd.kind in (col.K_STR, col.K_F64, col.K_DEC)
+                    or _int_plane(cd, c)):
+                return False
+            continue
+        if cd.kind == col.K_F64:
+            if name in ("sum", "avg"):
+                continue
+            contrib = sup & cd.valid
+            if bool(np.any((cd.values == 0.0) & np.signbit(cd.values)
+                           & contrib)):
+                return False
+            continue
+        if cd.kind == col.K_STR:
+            if name not in ("min", "max"):
+                return False
+            continue
+        if not (cd.kind == col.K_DEC or _int_plane(cd, c)):
+            return False
+        if name in ("sum", "avg"):
+            n_sup = int(np.count_nonzero(sup & cd.valid))
+            mx = cd.max_abs
+            if mx and n_sup and mx * n_sup >= (1 << 63):
+                return False
+    return True
+
+
+def _deferred_filter_response(sel: SelectRequest, batch: col.ColumnBatch,
+                              agg_specs, region, cache_info, columns,
+                              is_index: bool) -> SelectResponse | None:
+    """A pushed-down aggregate region's payload with the FILTER deferred
+    too (the batched filter channel), or None → the eager path decides
+    as before. Deferral requires: the flag, a WHERE that lowers (no
+    WHERE → the states channel already covers it; unsupported shapes —
+    raw-byte LIKE, u64 edge — keep the host path untouched), a
+    jax-backed process, and _states_probe's proof that the finish-time
+    states prep can never need the row fallback."""
+    if not BATCH_FILTER_ENABLED or sel.where is None:
+        return None
+    try:
+        import jax  # noqa: F401
+
+        from tidb_tpu.ops.exprc import Unsupported
+    except ImportError:
+        return None
+    try:
+        compiled, pins, fkey = _compiled_filter_ent(sel, batch)
+    except (Unsupported, errors.TypeError_):
+        return None
+    colpb = {c.column_id: c for c in columns}
+    if not _states_probe(batch, agg_specs, colpb, is_index):
+        return None
+    cids: set = set()
+    _where_cids(sel.where, cids)
+    pending = _PendingFilter(
+        batch, agg_specs, colpb, is_index, compiled, fkey, pins,
+        sorted(c for c in cids if c in batch.columns))
+    payload = col.ColumnarAggStates(None, None, list(sel.aggregates),
+                                    colpb, pending=pending)
+    pending.payload = payload
+    payload.cache_info = cache_info
+    if region is not None:
+        payload.region_id = region[0]
+        payload.region_epoch = region[1]
+    return SelectResponse(columnar=payload)
 
 
 def _topn_select(sel: SelectRequest, batch: col.ColumnBatch,
@@ -591,11 +722,45 @@ def _agg_states_response(sel: SelectRequest, batch: col.ColumnBatch,
     their datums decode from the comparable key encoding, whose scale
     canonicalization can differ from the record codec's, and a partial
     value slot must merge byte-identically with row-protocol partials."""
-    from tidb_tpu import metrics, tracing
-    specs, gcids = agg_specs
     if columns is None:
         columns = sel.table_info.columns
     colpb = {c.column_id: c for c in columns}
+    prepared = _prepare_states(batch, mask, agg_specs, colpb, is_index)
+    if prepared is None:
+        return None
+    group_keys, pending = prepared
+    if BATCH_STATES_ENABLED and pending.reductions and pending.G > 0:
+        # DEFER the states pass: the payload ships with its segment
+        # reductions pending, and the drain's statement-level finisher
+        # (finish_states_batch) runs every region's states in ONE
+        # batched dispatch — or any consumer touching .aggs first
+        # resolves this region serially (identical answers)
+        payload = col.ColumnarAggStates(group_keys, None,
+                                        list(sel.aggregates), colpb,
+                                        pending=pending)
+    else:
+        payload = col.ColumnarAggStates(group_keys, pending.resolve(),
+                                        list(sel.aggregates), colpb)
+    payload.cache_info = cache_info
+    if region is not None:
+        payload.region_id = region[0]
+        payload.region_epoch = region[1]
+    return SelectResponse(columnar=payload)
+
+
+def _prepare_states(batch: col.ColumnBatch, mask: np.ndarray, agg_specs,
+                    colpb: dict, is_index: bool):
+    """Everything between the survivor mask and the device dispatch:
+    group discovery in first-appearance scan order, codec-encoded group
+    keys, device-safe segment reductions and the state builders —
+    returns (group_keys, _PendingStates), or None when a column kind has
+    no exact state mapping / an int-sum could wrap (the row handler must
+    answer). Every None exit is either mask-INDEPENDENT or MONOTONE
+    under mask subsets — the contract _states_probe relies on to prove a
+    deferred-filter region can never need the row fallback after its RPC
+    already answered."""
+    from tidb_tpu import metrics
+    specs, gcids = agg_specs
     if is_index:
         for _name, arg in specs:
             if arg is not None and arg.tp == ExprType.COLUMN_REF:
@@ -745,25 +910,9 @@ def _agg_states_response(sel: SelectRequest, batch: col.ColumnBatch,
 
     pending = _PendingStates(batch, gid, reductions, G, builders,
                              len(live_idx), group_keys)
-    if BATCH_STATES_ENABLED and reductions and G > 0:
-        # DEFER the states pass: the payload ships with its segment
-        # reductions pending, and the drain's statement-level finisher
-        # (finish_states_batch) runs every region's states in ONE
-        # batched dispatch — or any consumer touching .aggs first
-        # resolves this region serially (identical answers)
-        payload = col.ColumnarAggStates(group_keys, None,
-                                        list(sel.aggregates), colpb,
-                                        pending=pending)
-    else:
-        payload = col.ColumnarAggStates(group_keys, pending.resolve(),
-                                        list(sel.aggregates), colpb)
-    payload.cache_info = cache_info
-    if region is not None:
-        payload.region_id = region[0]
-        payload.region_epoch = region[1]
     metrics.counter("copr.agg_states.partials").inc()
     metrics.counter("copr.agg_states.rows").inc(len(live_idx))
-    return SelectResponse(columnar=payload)
+    return group_keys, pending
 
 
 class _PendingStates:
@@ -839,6 +988,121 @@ class _PendingStates:
         return self.finish(outs)
 
 
+class _PendingFilter:
+    """One region's DEFERRED filter+states pass: the compiled predicate
+    plus everything _prepare_states needs once the survivor mask exists.
+    The statement finisher evaluates every deferred region's predicate
+    in ONE batched device dispatch (kernels.region_filter_batched, bit-
+    packed masks back — rows never transit the host); `resolve()` is the
+    serial rung: host exprc mask, then the serial states ladder — both
+    what a consumer touching the payload early gets and the bottom of
+    the batched filter's degradation ladder. Answers are bit-identical
+    at every rung (the device kernel traces the SAME compiled closure
+    the host rung evaluates eagerly)."""
+
+    __slots__ = ("batch", "agg_specs", "colpb", "is_index", "compiled",
+                 "fkey", "pins", "cids", "payload")
+
+    is_filter = True    # ColumnarAggStates.filter_pending's marker
+
+    def __init__(self, batch, agg_specs, colpb, is_index, compiled,
+                 fkey, pins, cids):
+        self.batch = batch
+        self.agg_specs = agg_specs
+        self.colpb = colpb
+        self.is_index = is_index
+        self.compiled = compiled
+        self.fkey = fkey
+        self.pins = pins
+        self.cids = cids
+        self.payload = None    # back-ref, set at payload construction
+
+    def filter_seg(self) -> tuple:
+        """This region's kernels.region_filter_batched segment — device-
+        resident planes preferred (pinned plane-cache planes ride the
+        dispatch without a fresh H2D)."""
+        dev = getattr(self.batch, "_device_planes", None)
+        planes = {}
+        for cid in self.cids:
+            cd = self.batch.columns[cid]
+            if dev is not None and cid in dev:
+                planes[cid] = dev[cid]
+            else:
+                planes[cid] = (cd.values, cd.valid)
+        return (self.fkey, self.compiled, planes, self.batch.capacity,
+                self.batch.n_rows, self.pins)
+
+    def host_mask(self) -> np.ndarray:
+        """The host exprc rung: the same compiled closure over the numpy
+        planes — bit-identical to the device kernel's mask."""
+        planes = {cid: (cd.values, cd.valid)
+                  for cid, cd in self.batch.columns.items()}
+        wv, wva = self.compiled(planes)
+        wv, wva = np.asarray(wv), np.asarray(wva)
+        truth = wv if wv.dtype == np.bool_ else (wv != 0)
+        return self.batch.row_mask() & wva & truth
+
+    def fulfill_mask(self, mask: np.ndarray) -> None:
+        """Survivor mask → group keys + states reductions on the
+        payload: it joins the statement's states batch, or resolves on
+        the spot when no batched-shape work remains (G == 0, or the
+        states channel is off)."""
+        prepared = _prepare_states(self.batch, mask, self.agg_specs,
+                                   self.colpb, self.is_index)
+        # _states_probe proved every None exit unreachable under any
+        # subset of the probed superset mask
+        assert prepared is not None, "deferred filter lost its states"
+        group_keys, pending = prepared
+        p = self.payload
+        p.group_keys = group_keys
+        if BATCH_STATES_ENABLED and pending.reductions and pending.G > 0:
+            p._pending = pending
+        else:
+            p.fulfill_states(pending.resolve())
+
+    def resolve(self) -> list:
+        from tidb_tpu import tracing
+        with tracing.trace("filter_pass") as fsp:
+            mask = self.host_mask()
+            fsp.set("rows_out", int(np.count_nonzero(mask)))
+        prepared = _prepare_states(self.batch, mask, self.agg_specs,
+                                   self.colpb, self.is_index)
+        assert prepared is not None, "deferred filter lost its states"
+        group_keys, pending = prepared
+        self.payload.group_keys = group_keys
+        return pending.resolve()
+
+
+def _finish_filter_batch(group) -> None:
+    """Phase A of the statement finisher: every deferred-FILTER payload
+    gets its survivor mask — ONE batched device dispatch over the
+    device-resident planes at/above the statement floor
+    (kernels.region_filter_batched), the host exprc rung below it or on
+    any device fault (counted on copr.degraded_filter_batch; the
+    copr/filter_batched failpoint degrades exactly there) — then each
+    payload's group keys + states reductions build from its mask and the
+    payload joins phase B's states batch."""
+    from tidb_tpu import tracing
+    pends = [p._pending for p in group]
+    total_rows = sum(pe.batch.n_rows for pe in pends)
+    use_device = total_rows >= STATES_DEVICE_FLOOR
+    if use_device and failpoint._active and \
+            failpoint.eval("copr/filter_batched") is not None:
+        tracing.record_degraded("filter_batch")
+        use_device = False
+    masks = None
+    if use_device:
+        from tidb_tpu.ops import kernels
+        try:
+            masks = kernels.region_filter_batched(
+                [pe.filter_seg() for pe in pends])
+        except errors.DeviceError:
+            tracing.record_degraded("filter_batch")
+    for i, pe in enumerate(pends):
+        pe.fulfill_mask(masks[i] if masks is not None
+                        else pe.host_mask())
+
+
 def finish_states_batch(payloads) -> None:
     """The statement-level finisher of the deferred states channel: the
     drain hands over every states payload of one statement; regions
@@ -850,25 +1114,39 @@ def finish_states_batch(payloads) -> None:
     regions that individually sit under STATES_DEVICE_FLOOR still
     amortize into one dispatch. Degradation ladder (answers unchanged at
     every rung): mesh → single-device batched (copr.degraded_near_data)
-    → serial per-region (copr.degraded_states_batch) → host numpy."""
+    → serial per-region (copr.degraded_states_batch) → host numpy.
+
+    Phase A (PR 17): payloads whose FILTER deferred too get their
+    survivor masks first — one batched filter dispatch feeding straight
+    into phase B's states batch, so a fully-deferred statement costs
+    ≤ 2 device round trips. Phase B also lifts below-floor groups into
+    the cross-STATEMENT gather window (ops.sched.states_gather):
+    concurrent small statements share one states dispatch instead of
+    each resolving host-serial."""
     from tidb_tpu import tracing
     pend = [p for p in payloads
             if getattr(p, "states_pending", None) is not None
             and p.states_pending()]
     if not pend:
         return
+    fgroup = [p for p in pend if isinstance(p._pending, _PendingFilter)]
+    if fgroup:
+        _finish_filter_batch(fgroup)
+        pend = [p for p in pend if p.states_pending()]
+        if not pend:
+            return
     groups: dict = {}
     for p in pend:
         groups.setdefault(p._pending.signature(), []).append(p)
-    for group in groups.values():
+    for sig, group in groups.items():
         pends = [p._pending for p in group]
         total_rows = sum(pe.batch.n_rows for pe in pends)
-        use_device = total_rows >= STATES_DEVICE_FLOOR
-        if use_device:
-            try:
-                import jax  # noqa: F401
-            except ImportError:
-                use_device = False
+        try:
+            import jax  # noqa: F401
+            jax_ok = True
+        except ImportError:
+            jax_ok = False
+        use_device = jax_ok and total_rows >= STATES_DEVICE_FLOOR
         if use_device:
             from tidb_tpu.ops import kernels
             from tidb_tpu.ops import mesh as mesh_mod
@@ -894,6 +1172,26 @@ def finish_states_batch(payloads) -> None:
                 continue
             except errors.DeviceError:
                 tracing.record_degraded("states_batch")
+        elif jax_ok:
+            # below the per-statement floor: offer the segments to the
+            # cross-STATEMENT gather window (PR 16 residual c) — when
+            # concurrent statements' segments combine past the floor,
+            # one shared batched dispatch fulfills all of them; solo
+            # traffic falls straight through to the serial path
+            from tidb_tpu.ops import sched
+            try:
+                outs = sched.states_gather.submit(
+                    sig,
+                    [(pe.gid, pe.device_reductions(), pe.G)
+                     for pe in pends],
+                    total_rows, STATES_DEVICE_FLOOR)
+            except errors.DeviceError:
+                tracing.record_degraded("states_batch")
+                outs = None
+            if outs is not None:
+                for p, pe, o in zip(group, pends, outs):
+                    p.fulfill_states(pe.finish(o))
+                continue
         for p in group:
             if p.states_pending():
                 p.aggs   # serial resolution (device→host ladder inside)

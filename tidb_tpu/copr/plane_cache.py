@@ -415,7 +415,13 @@ def _maybe_pin_device(batch) -> bool:
     """Pin the batch's planes device-resident when the TPU tier is live
     in this process — the H2D happens once, at insert, and every repeat
     query reads HBM. A jax-free deployment never pays (or imports)
-    anything here.
+    anything here. Pinned planes are what the near-data batched kernels
+    read directly: the deferred filter (kernels.region_filter_batched
+    via _PendingFilter.filter_seg) and the batched states dispatch
+    (_PendingStates.device_reductions) both swap host planes for these
+    device twins, so a cached+pinned region's filter+states pipeline
+    moves only bit-packed masks and per-group states over PCIe — never
+    rows.
 
     HBM governance (ops.membudget): a pin that would cross the
     configured `tidb_tpu_hbm_budget_bytes` is SKIPPED — the entry still

@@ -159,6 +159,14 @@ class SelectResult:
             # the bench/tests can assert states, not rows, crossed the
             # wire
             _count("states", n_states, self.span)
+            # regions that deferred their FILTER too (the batched filter
+            # channel) — counted before the finisher fulfills them, so
+            # the span shows how much of the statement rode the
+            # filter+states deferred pipeline
+            _count("filter_deferred",
+                   sum(1 for p in payloads
+                       if getattr(p, "filter_pending", None) is not None
+                       and p.filter_pending()), self.span)
             # statement-level finisher of the near-data channel: regions
             # shipped their states PENDING; fulfill all of them from one
             # batched segmented dispatch before any consumer fans out

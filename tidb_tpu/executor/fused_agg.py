@@ -50,7 +50,7 @@ _FUSABLE = ("count", "sum", "avg", "min", "max", "first_row")
 stats = {"fused": 0, "fallback": 0, "partial_combines": 0,
          "last_combine_regions": 0, "mesh_combines": 0,
          "last_mesh_shards": 0, "final_states": 0,
-         "states_batch_finished": 0}
+         "states_batch_finished": 0, "filter_batch_finished": 0}
 
 I64_SENTINEL_MIN = I64_MAX        # "min" monoid identity (int planes)
 I64_SENTINEL_MAX = I64_MIN        # "max" monoid identity — EXACT min,
@@ -608,9 +608,16 @@ def try_fused_final(agg):
         # payloads that reached the executor with their near-data states
         # still deferred (paths that bypass SelectResult.columnar): one
         # batched fulfillment here beats R serial resolves via .aggs
+        n_filter = sum(1 for p in parts
+                       if getattr(p, "filter_pending", None) is not None
+                       and p.filter_pending())
         from tidb_tpu.copr.columnar_region import finish_states_batch
         finish_states_batch(parts)
         stats["states_batch_finished"] += 1
+        if n_filter:
+            # regions that deferred the FILTER too: their survivor masks
+            # came from the batched filter dispatch just now
+            stats["filter_batch_finished"] += 1
     out = _try_final_states(agg, child, parts, region_ids, epochs)
     if out is not None:
         stats["fused"] += 1

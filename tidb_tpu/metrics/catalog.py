@@ -78,11 +78,15 @@ CATALOG: dict[str, tuple[str, str]] = {
     "copr.states_batch.serial_dispatches": ("counter", "Per-region states kernel dispatches (the serial path: below the per-statement floor, or degraded)."),
     "copr.states_batch.regions": ("counter", "Region segments computed by batched segmented states dispatches."),
     "copr.states_batch.rows": ("counter", "Rows aggregated through batched segmented states dispatches."),
+    "copr.filter.batched_dispatches": ("counter", "Batched device filter dispatches: every deferred region's WHERE evaluated over cached planes in one ragged kernel (bit-packed masks read back)."),
+    "copr.filter.batched_regions": ("counter", "Region segments filtered by batched device filter dispatches."),
+    "copr.filter.batched_rows": ("counter", "Rows filtered on device through batched filter dispatches."),
     "copr.mesh.near_data_dispatches": ("counter", "Shard-owned near-data states dispatches: each region's segment computed on its RegionPlacement home shard in one mesh dispatch."),
     "copr.mesh.near_data_regions": ("counter", "Region segments computed by shard-owned near-data dispatches."),
     "copr.mesh.near_data_rows": ("counter", "Rows aggregated through shard-owned near-data dispatches."),
     # ---- degradation chain ----
     "copr.degraded_": ("counter", "Tier fallbacks by kind (device_to_cpu, join_to_numpy, combine_to_host, mesh, batch, states_to_host, rows...)."),
+    "copr.degraded_filter_batch": ("counter", "Deferred-filter groups that fell off the batched device filter kernel onto the per-region host exprc rung (answers stay bit-identical)."),
     # ---- mesh tier ----
     "copr.mesh.placements": ("counter", "Region-to-shard placements computed."),
     "copr.mesh.replacements": ("counter", "Region re-placements after an epoch bump."),
@@ -134,6 +138,7 @@ CATALOG: dict[str, tuple[str, str]] = {
     "sched.padding_waste": ("histogram", "Padded-slot fraction wasted per batched dispatch."),
     "sched.queue_depth": ("gauge", "Statements currently queued in the micro-batch gather window."),
     "sched.window_expiries": ("counter", "Statement deadlines that expired inside a micro-batch gather window or shared dispatch."),
+    "sched.cross_stmt_states_batches": ("counter", "Segmented states dispatches that combined ≥ 2 concurrent below-floor statements through the gather window."),
     # ---- kv / backoff / txn ----
     "kv.backoff.": ("counter", "Backoffer sleeps by retry kind (plus kv.backoff.txn_retry for optimistic replays)."),
     "kv.backoff_exhausted": ("counter", "Statements whose backoff budget or deadline was exhausted."),

@@ -1099,9 +1099,15 @@ class ColumnarAggStates:
 
     is_agg_states = True
 
-    def __init__(self, group_keys: list[bytes], aggs: list[AggStateCol],
+    def __init__(self, group_keys: list[bytes] | None,
+                 aggs: list[AggStateCol],
                  aggregates, col_pb: dict, pending=None):
-        self.group_keys = group_keys
+        # None → the region deferred its FILTER too (the batched filter
+        # channel): group membership is unknown until the statement
+        # finisher computes the survivor mask, so the keys fulfill
+        # together with the states — any earlier reader forces the
+        # serial resolution below
+        self._group_keys = group_keys
         self._aggs = aggs
         # deferred states (the near-data batched dispatch): the fan-out
         # worker ships the payload with its device work still PENDING —
@@ -1124,8 +1130,25 @@ class ColumnarAggStates:
             self._pending = None
         return self._aggs
 
+    @property
+    def group_keys(self) -> list[bytes]:
+        if self._group_keys is None:
+            self.aggs   # serial resolution fills the keys en route
+        return self._group_keys
+
+    @group_keys.setter
+    def group_keys(self, keys: list[bytes]) -> None:
+        self._group_keys = keys
+
     def states_pending(self) -> bool:
         return self._aggs is None and self._pending is not None
+
+    def filter_pending(self) -> bool:
+        """The region deferred its FILTER too (the batched filter
+        channel): the survivor mask, group keys and states all fulfill
+        in the statement finisher."""
+        return (self._aggs is None and self._pending is not None
+                and getattr(self._pending, "is_filter", False))
 
     def fulfill_states(self, aggs: list[AggStateCol]) -> None:
         """Install the batch-dispatch-computed states (the finisher's

@@ -532,13 +532,21 @@ def _compile_in(e: Expr, batch, negated: bool) -> CompiledExpr:
                 continue
             codes.append(cd.code_of(it.val.get_bytes()))
         cid = target.val
-        code_arr = jnp.asarray([c for c in codes], dtype=jnp.int32) \
-            if codes else jnp.asarray([-2], dtype=jnp.int32)
+        # sorted-code membership: absent constants (code -1) can never
+        # equal a live row's code (NULL rows carry valid=False), so they
+        # drop; the remaining codes sort and the row test is one
+        # searchsorted probe instead of a rows×items broadcast — the
+        # IN list rides the global dictionary's sorted domain
+        present = sorted(c for c in codes if c >= 0)
+        code_arr = jnp.asarray(present, dtype=jnp.int32) \
+            if present else jnp.asarray([-2], dtype=jnp.int32)
 
         def str_in(planes, cid=cid, code_arr=code_arr, has_null=has_null,
                    negated=negated):
             cvals, va = planes[cid]
-            hit = jnp.any(cvals[:, None] == code_arr[None, :], axis=1)
+            pos = jnp.clip(jnp.searchsorted(code_arr, cvals),
+                           0, code_arr.shape[0] - 1)
+            hit = code_arr[pos] == cvals
             val = ~hit if negated else hit
             # no match + NULL in list → NULL
             valid = va & (hit | jnp.bool_(not has_null))
@@ -595,6 +603,47 @@ def _compile_in(e: Expr, batch, negated: bool) -> CompiledExpr:
     return CompiledExpr(num_in, "bool")
 
 
+def _like_prefix_bytes(p: str, escape: str):
+    """The literal prefix when the pattern is `literal%` — a single
+    trailing unescaped `%`, no `_`, no interior `%` — AND every prefix
+    char is caseless ASCII (MySQL LIKE is case-insensitive; caseless
+    chars make the sorted-byte range test exactly the regex's answer).
+    None → no fast path (the dictionary LUT stays correct for
+    everything)."""
+    out: list[str] = []
+    i, n = 0, len(p)
+    while i < n:
+        ch = p[i]
+        if escape and ch == escape and i + 1 < n:
+            out.append(p[i + 1])
+            i += 2
+            continue
+        if ch == "%":
+            if i != n - 1:
+                return None
+            lit = "".join(out)
+            if any(ord(c) >= 128 or c.lower() != c.upper() for c in lit):
+                return None
+            return lit.encode("ascii")
+        if ch == "_":
+            return None
+        out.append(ch)
+        i += 1
+    return None     # no trailing %: an exact literal — not this shape
+
+
+def _byte_successor(b: bytes):
+    """Smallest byte string greater than every string prefixed by `b`
+    (increment the last non-0xFF byte); None → no upper bound."""
+    arr = bytearray(b)
+    while arr and arr[-1] == 0xFF:
+        arr.pop()
+    if not arr:
+        return None
+    arr[-1] += 1
+    return bytes(arr)
+
+
 def _compile_like(e: Expr, batch, negated: bool) -> CompiledExpr:
     target, pattern = e.children[0], e.children[1]
     cd = _str_column_of(target, batch)
@@ -602,7 +651,24 @@ def _compile_like(e: Expr, batch, negated: bool) -> CompiledExpr:
         raise Unsupported("LIKE needs dict column + constant pattern")
     escape = e.val if isinstance(e.val, str) else "\\"
     pat = pattern.val
-    # evaluate the pattern over the dictionary on host → boolean LUT
+    cid = target.val
+    # `LIKE 'prefix%'` over the SORTED global dictionary is an integer
+    # range compare — lower_bound(prefix) ≤ code < lower_bound(byte
+    # successor) — no per-entry byte decode, and the closure carries two
+    # ints instead of a dictionary-sized LUT (PR 14 residual d)
+    pfx = None if pat.is_null() \
+        else _like_prefix_bytes(pat.get_string(), escape)
+    if pfx is not None:
+        lb = cd.lower_bound(pfx)
+        succ = _byte_successor(pfx)
+        ub = len(cd.dictionary) if succ is None else cd.lower_bound(succ)
+
+        def like_range(planes, cid=cid, lb=lb, ub=ub, negated=negated):
+            codes, va = planes[cid]
+            hit = (codes >= lb) & (codes < ub)
+            return (~hit if negated else hit), va
+        return CompiledExpr(like_range, "bool")
+    # general patterns: evaluate over the dictionary on host → boolean LUT
     import numpy as np
     from tidb_tpu.types.datum import Datum as D
     lut_host = np.zeros(max(len(cd.dictionary), 1), dtype=bool)
@@ -610,7 +676,6 @@ def _compile_like(e: Expr, batch, negated: bool) -> CompiledExpr:
         m = xops.compute_like(D.bytes_(b), pat, escape)
         lut_host[i] = (not m.is_null()) and m.val == 1
     lut = jnp.asarray(lut_host)
-    cid = target.val
 
     def like(planes, cid=cid, lut=lut, negated=negated):
         codes, va = planes[cid]

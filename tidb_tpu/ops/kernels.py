@@ -1235,6 +1235,19 @@ def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
 _batched_states_cache: dict = {}
 
 
+def bucket_segments(n: int, minimum: int = 8) -> int:
+    """Power-of-two bucket for a per-region segment-space span. Skewed
+    splits drift every region's group count a little on every epoch;
+    spacing regions by the bucketed span (instead of the exact one)
+    keeps the traced kernel's static offsets stable, so the jit cache
+    stops minting a fresh entry per (G_0..G_R) shape set. Padded
+    segment slots are empty (SegCtx identities) and never sliced out."""
+    c = minimum
+    while c < n:
+        c *= 2
+    return c
+
+
 def region_agg_states_batched(segs: list) -> list:
     """Per-group partial states for EVERY region of one statement in ONE
     ragged segmented dispatch.
@@ -1259,15 +1272,22 @@ def region_agg_states_batched(segs: list) -> list:
     ops_t = tuple(op for op, _v, _ok in specs0)
     dtypes = tuple("c" if v is None else np.dtype(v.dtype).char
                    for _op, v, _ok in specs0)
-    # region offsets into the global segment space (+1 per region: its
-    # own dead-row sink — sink states read back and are discarded)
+    # region offsets into the global segment space: each region owns a
+    # BUCKETED span covering its G_r groups + its dead-row sink (the
+    # sink is gid value G_r, always inside the span); slots above the
+    # sink are empty segments whose identity states read back and are
+    # discarded with it. Bucketing the span — not the exact G_r + 1 —
+    # is the residual-b churn fix: the cache key below sees only the
+    # power-of-two spans, so a skewed split that nudges group counts
+    # re-uses the already-traced kernel.
+    Gbs = tuple(bucket_segments(g + 1) for g in Gs)
     offs = []
     off = 0
-    for g in Gs:
+    for gb in Gbs:
         offs.append(off)
-        off += g + 1
+        off += gb
     S_total = off
-    key = (ops_t, Gs, ns, dtypes)
+    key = (ops_t, Gbs, ns, dtypes)
     ent = _batched_states_cache.get(key)
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
@@ -1346,6 +1366,117 @@ def region_agg_states_batched(segs: list) -> list:
     outs = unpack_outputs(wrapper, host)
     full = [np.atleast_1d(np.asarray(o)) for o in outs]
     return [[o[offs[r]:offs[r] + Gs[r]] for o in full] for r in range(R)]
+
+
+# ---------------------------------------------------------------------------
+# batched (ragged) region FILTER: ONE jitted dispatch evaluates EVERY
+# region's pushed-down WHERE over its device-resident cached planes and
+# reads back only the bit-packed survivor masks — rows never transit the
+# host on this path (Taurus NDP / PushdownDB: ship the predicate to the
+# data, ship bits back). The masks feed straight into the gid build for
+# region_agg_states_batched, so a pushed-down aggregate statement runs
+# filter+states in two flat dispatches total.
+# ---------------------------------------------------------------------------
+
+_batched_filter_cache: dict = {}
+
+
+def region_filter_batched(segs: list) -> list:
+    """Survivor masks for EVERY region of one statement in ONE dispatch.
+
+    segs[r] = (fkey_r, compiled_r, planes_r, cap_r, n_rows_r, pins_r):
+    fkey_r the structural key of the compiled predicate (dictionary ids
+    included — pins_r keeps those objects alive so ids can't recycle
+    under a cached trace), compiled_r an exprc CompiledExpr, planes_r a
+    {col_id: (values, valid)} dict of length-cap_r planes (device-
+    resident jax arrays ride without a fresh H2D), n_rows_r the live
+    row count (padding rows above it never survive). Returns one host
+    bool[cap_r] mask per region — bit-identical to
+    row_mask & where_valid & truthy(where_value), i.e. exactly what the
+    host exprc path (_filter_mask) computes. Faults (incl. the
+    device/filter_batched failpoint) raise typed DeviceError so the
+    caller can degrade to the host per-region filter."""
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
+    from tidb_tpu import metrics as _metrics
+    from tidb_tpu import tracing as _tracing
+
+    R = len(segs)
+    caps = tuple(int(s[3]) for s in segs)
+    cids_t = tuple(tuple(sorted(s[2])) for s in segs)
+    fkeys = tuple(s[0] for s in segs)
+    key = (fkeys, caps, cids_t)
+    ent = _batched_filter_cache.get(key)
+    _tracing.record_jit_cache(hit=ent is not None)
+    if ent is None:
+        compiled_t = tuple(s[1] for s in segs)
+        pins_t = tuple(s[5] for s in segs)
+
+        def fn(*args):
+            # args = n_0..n_{R-1} (traced scalars: live-row counts vary
+            # without retracing) then each region's planes in cid order
+            words = []
+            pos = R
+            for r in range(R):
+                planes = {}
+                for cid in cids_t[r]:
+                    planes[cid] = (args[pos], args[pos + 1])
+                    pos += 2
+                wv, wva = compiled_t[r](planes)
+                truth = wv if wv.dtype == bool else (wv != 0)
+                live = jnp.arange(caps[r], dtype=jnp.int32) < args[r]
+                words.append(jnp.packbits(live & wva & truth,
+                                          bitorder="little"))
+            return words[0] if R == 1 else jnp.concatenate(words)
+
+        ent = (jax.jit(fn), compiled_t, pins_t)
+        _batched_filter_cache[key] = ent
+        if len(_batched_filter_cache) > 256:
+            _batched_filter_cache.pop(next(iter(_batched_filter_cache)))
+    jitted = ent[0]
+    n_rows = sum(int(s[4]) for s in segs)
+    sp = _tracing.current().child("filter_batch") \
+        .set("regions", R).set("rows", n_rows)
+    t0 = _time.perf_counter()
+    try:
+        if _failpoint._active:
+            _failpoint.eval("device/filter_batched",
+                            lambda: _errors.DeviceError(
+                                "injected batched filter kernel failure"))
+        args = [jnp.asarray(np.int32(s[4])) for s in segs]
+        for r in range(R):
+            planes_r = segs[r][2]
+            for cid in cids_t[r]:
+                vals, valid = planes_r[cid]
+                args.append(jnp.asarray(vals))
+                args.append(jnp.asarray(valid))
+        with dispatch_serial:
+            host = np.asarray(jitted(*args))
+    except _errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash in the batched filter kernel: typed,
+        # so the statement degrades to the host per-region exprc filter
+        # (same predicate algebra, same answers)
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(
+            f"batched region filter failed: {e}") from e
+    sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
+    sp.finish()
+    _tracing.record_dispatch(
+        readback_bytes=int(host.nbytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    _metrics.counter("copr.filter.batched_dispatches").inc()
+    _metrics.counter("copr.filter.batched_regions").inc(R)
+    _metrics.counter("copr.filter.batched_rows").inc(n_rows)
+    masks = []
+    woff = 0
+    for cap in caps:
+        w = (cap + 7) // 8
+        bits = np.unpackbits(host[woff:woff + w], bitorder="little")
+        masks.append(bits[:cap].astype(bool))
+        woff += w
+    return masks
 
 
 # ---------------------------------------------------------------------------

@@ -214,7 +214,12 @@ def _shard_layout(slices, shard_of, n_shards: int):
     for (s, e), sh in zip(slices, shard_of):
         segs[sh].append((s, e))
     per_shard = [sum(e - s for s, e in blocks) for blocks in segs]
-    lmax = max(max(per_shard), 1)
+    # bucket the per-shard row span to a power of two (residual-b churn
+    # fix): the shard-local traced fns key on lmax, and skewed splits
+    # move per-shard row totals every epoch — padding rows gather row 0
+    # under live=False, so the extra slots never contribute
+    from tidb_tpu.ops.kernels import bucket_segments
+    lmax = bucket_segments(max(max(per_shard), 1), minimum=1024)
     idx = np.zeros(n_shards * lmax, dtype=np.int64)
     live = np.zeros(n_shards * lmax, dtype=bool)
     for sh, blocks in enumerate(segs):
@@ -587,7 +592,12 @@ def region_states_sharded(mesh, segs: list, region_ids=None,
     for g in Gs:
         offs.append(off)
         off += g + 1
-    sp_total = off + 1          # +1: cross-shard padding sink
+    # +1: cross-shard padding sink — then bucket the total segment
+    # count to a power of two (residual-b churn fix: _states_local_fn
+    # keys on sp_total; the offsets above are host-side DATA, so only
+    # this one static needs taming). Extra slots are empty segments.
+    from tidb_tpu.ops.kernels import bucket_segments
+    sp_total = bucket_segments(off + 1, minimum=64)
     if region_ids is None:
         region_ids = list(range(R))
     region_ids = [rid if rid is not None else -(i + 1)

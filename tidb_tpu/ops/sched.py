@@ -1161,3 +1161,141 @@ class _BatchedResponse(kv.Response):
     def next(self):
         r, self._resp = self._resp, None
         return r
+
+
+# ---------------------------------------------------------------------------
+# cross-STATEMENT deferred-states gather (PR 16 residual c): the states
+# finisher's batch boundary lifted from per-statement to the micro-batch
+# gather window. A statement whose deferred segments sit under the
+# per-statement device floor used to resolve host-serial; now it offers
+# them here — concurrent below-floor statements whose segments share an
+# aggregate signature combine into ONE ragged batched dispatch
+# (kernels.region_agg_states_batched), counted on
+# sched.cross_stmt_states_batches. Solo traffic falls straight through
+# (no window sleep, no dispatch) to the unchanged serial path.
+# ---------------------------------------------------------------------------
+
+
+class _GatherEntry:
+    __slots__ = ("sig", "segs", "rows", "floor", "event", "outs",
+                 "error", "taken", "done")
+
+    def __init__(self, sig, segs, rows, floor):
+        self.sig = sig
+        self.segs = segs
+        self.rows = rows
+        self.floor = floor
+        self.event = threading.Event()
+        self.outs = None
+        self.error = None
+        self.taken = False
+        self.done = False
+
+
+class StatesGather:
+    """Leader/follower gather for deferred below-floor states segments.
+
+    The first submitter of a cycle leads: it waits one gather window for
+    concurrent statements (skipped entirely for solo traffic — same
+    traffic gate as the MicroBatcher), drains the queue, groups entries
+    by aggregate signature, and runs each group whose COMBINED rows
+    clear the floor as one batched dispatch. Followers wait on their
+    entry's event with a patience bound and self-claim on a stalled
+    leader, so a wedged window can never wedge a statement. submit()
+    returns None when the segments should stay on the caller's serial
+    path; device faults raise typed DeviceError for the caller's
+    degradation ladder."""
+
+    WINDOW_S = 0.002
+    HOT_SIG_S = 2.0
+
+    def __init__(self, window_s: float = WINDOW_S):
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._queue: list = []
+        self._last_multi = 0.0
+        self._last_enq: tuple = (0.0, None)
+
+    def submit(self, sig, segs: list, rows: int, floor: int):
+        """segs are region_agg_states_batched segments; rows their total
+        packed rows; floor the caller's device floor. Returns outs (one
+        list per seg) when a shared/own batched dispatch ran, None → the
+        caller resolves serially."""
+        e = _GatherEntry(sig, segs, rows, floor)
+        me = threading.get_ident()
+        now = time.monotonic()
+        with self._lock:
+            leader = not self._queue
+            prev_t, prev_thread = self._last_enq
+            self._last_enq = (now, me)
+            solo = (leader
+                    and now - self._last_multi > self.HOT_SIG_S
+                    and (prev_thread == me
+                         or now - prev_t > max(2 * self.window_s, 0.02)))
+            self._queue.append(e)
+        if leader:
+            if not solo:
+                time.sleep(self.window_s)
+            with self._lock:
+                batch = [x for x in self._queue if not x.taken]
+                for x in batch:
+                    x.taken = True
+                self._queue = [x for x in self._queue if not x.taken]
+                if len(batch) > 1:
+                    self._last_multi = time.monotonic()
+            self._execute(batch)
+        else:
+            patience = max(0.05, self.window_s * 5)
+            while not e.done and not e.event.wait(patience):
+                with self._lock:
+                    claim = not e.taken
+                    if claim:
+                        if e in self._queue:
+                            self._queue.remove(e)
+                        e.taken = True
+                if claim:
+                    # stalled leader: this statement runs its own cycle
+                    self._execute([e])
+                    break
+        if e.error is not None:
+            raise e.error
+        return e.outs
+
+    def _execute(self, batch: list) -> None:
+        from tidb_tpu import errors as _errors
+        from tidb_tpu import metrics
+        groups: dict = {}
+        for x in batch:
+            groups.setdefault(x.sig, []).append(x)
+        for entries in groups.values():
+            rows = sum(x.rows for x in entries)
+            floor = min(x.floor for x in entries)
+            if rows < floor:
+                # still under the floor even combined: not worth a
+                # dispatch — everyone stays serial (outs None)
+                for x in entries:
+                    x.done = True
+                    x.event.set()
+                continue
+            segs = [s for x in entries for s in x.segs]
+            try:
+                from tidb_tpu.ops import kernels
+                outs = kernels.region_agg_states_batched(segs)
+            except _errors.TiDBError as err:
+                for x in entries:
+                    x.error = err
+                    x.done = True
+                    x.event.set()
+                continue
+            if len(entries) > 1:
+                metrics.counter("sched.cross_stmt_states_batches").inc()
+            off = 0
+            for x in entries:
+                x.outs = outs[off:off + len(x.segs)]
+                off += len(x.segs)
+                x.done = True
+                x.event.set()
+
+
+# the process-wide gather — finish_states_batch's below-floor hand-off
+states_gather = StatesGather()
