@@ -221,12 +221,17 @@ def kernel_probe(client, runs: int):
         return None
     jitted, planes, live = client._last_dispatch
     np.asarray(jitted(planes, live))   # warm (already compiled by e2e)
-    t0 = time.time()
-    for _ in range(runs):
+    samples = []
+    for _ in range(max(runs, 3)):
         # the result D2H is the only certified completion point on this
         # platform (block_until_ready can return early post-D2H)
+        t0 = time.time()
         np.asarray(jitted(planes, live))
-    return (time.time() - t0) / runs
+        samples.append(time.time() - t0)
+    # min over samples: a fixed dispatch cost with one-sided noise (GC
+    # pause, page fault under suite load) — a single spiked sample must
+    # not fail the kernel<=e2e containment assert at runs=1
+    return min(samples)
 
 
 def bytes_matched_sweep(elems: int, runs: int) -> float:
@@ -931,6 +936,164 @@ def measure_q1_pushdown(n_rows: int, n_regions: int, runs: int):
         "q1_device_dispatches_per_stmt": fdisp_per_stmt + disp_per_stmt,
         "q1_states_bytes_vs_rows_bytes": round(
             d_st_bytes / d_row_bytes, 3) if d_row_bytes else None,
+    }
+
+
+# every TPC-H aggregate shape the parser accepts over one lineitem
+# store: the REAL q1 (expression aggregate arguments, 10 aggregates),
+# the q6 scalar reduction, min/max over arithmetic, float expression
+# arguments (bit-parity rung), and decimal / datetime GROUP columns —
+# the full expression-pushdown surface of PR 18. Every statement must
+# stay columnar: the sweep asserts ZERO fallbacks across all of them.
+TPCH_SWEEP_SQLS = (
+    ("q1full",
+     "select l_returnflag, l_linestatus, sum(l_quantity), "
+     "sum(l_extendedprice), sum(l_extendedprice * (1 - l_discount)), "
+     "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
+     "avg(l_quantity), avg(l_extendedprice), avg(l_discount), count(*) "
+     "from lineitem where l_ship <= 180 "
+     "group by l_returnflag, l_linestatus "
+     "order by l_returnflag, l_linestatus"),
+    ("q6",
+     "select sum(l_extendedprice * l_discount) from lineitem "
+     "where l_ship <= 120"),
+    ("minmax_expr",
+     "select l_returnflag, min(l_extendedprice - l_discount), "
+     "max(l_extendedprice + l_tax) from lineitem "
+     "group by l_returnflag order by l_returnflag"),
+    ("float_expr",
+     "select l_returnflag, sum(l_fdisc * 2), avg(l_fdisc + 0.5) "
+     "from lineitem group by l_returnflag order by l_returnflag"),
+    ("dec_group",
+     "select l_quantity, count(*), sum(l_extendedprice) from lineitem "
+     "group by l_quantity order by l_quantity"),
+    ("date_group",
+     "select l_shipdate, count(*), "
+     "sum(l_extendedprice * (1 - l_discount)) from lineitem "
+     "group by l_shipdate order by l_shipdate"),
+)
+
+
+def measure_tpch_sweep(n_rows: int, n_regions: int, runs: int):
+    """TPC-H sweep over the 4-region cluster store: every aggregate
+    shape the parser accepts (TPCH_SWEEP_SQLS — the real q1 with
+    expression arguments, q6, min/max over arithmetic, float expression
+    args, decimal and datetime group keys) runs columnar with ZERO
+    fallbacks, and the real-shape q1 counter-asserts ≤ 2 device
+    dispatches per statement. Exact parity vs the row protocol (kill
+    switch) for every query — Decimal sums at full precision, float
+    SUM/AVG bit-identical."""
+    from decimal import Decimal
+
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum, Kind
+    from tidb_tpu.types.time_types import parse_time
+
+    store = new_store(f"cluster://3/benchtpch{n_rows}")
+    s = Session(store)
+    s.execute("create database tpch")
+    s.execute("use tpch")
+    s.execute("create table lineitem (l_id bigint primary key, "
+              "l_returnflag varchar(4), l_linestatus varchar(4), "
+              "l_quantity decimal(12,2), l_extendedprice decimal(12,2), "
+              "l_discount decimal(12,2), l_tax decimal(12,2), "
+              "l_fdisc double, l_ship bigint, l_shipdate datetime)")
+    tbl = s.info_schema().table_by_name("tpch", "lineitem")
+    flags = ("A", "N", "R")
+    stats = ("F", "O")
+    rows = [[Datum.i64(i), Datum.string(flags[i % 3]),
+             Datum.string(stats[i % 2]),
+             Datum.dec(Decimal(i % 50) + Decimal(i % 4) / 4),
+             Datum.dec(Decimal(900 + i * 7 % 1000) + Decimal(i % 10) / 10),
+             Datum.dec(Decimal(i % 11) / 100),
+             Datum.dec(Decimal(i % 9) / 100),
+             Datum.f64((i % 7) * 0.01), Datum.i64(i % 365),
+             Datum(Kind.TIME,
+                   parse_time(f"2024-0{1 + i % 9}-1{i % 9} 00:00:00",
+                              fsp=0))]
+            for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+
+    fbs = metrics.counter("distsql.columnar_fallbacks")
+    argp = metrics.counter("distsql.columnar_arg_planes")
+    disp = (metrics.counter("copr.states_batch.dispatches"),
+            metrics.counter("copr.mesh.near_data_dispatches"),
+            metrics.counter("copr.states_batch.serial_dispatches"),
+            metrics.counter("copr.filter.batched_dispatches"))
+    for _, sql in TPCH_SWEEP_SQLS:
+        s.execute(sql)                    # warm (pack + jit)
+
+    col_results = {}
+    q1_disp_per_stmt = 0.0
+    f0, a0 = fbs.value, argp.value
+    t0 = time.time()
+    for name, sql in TPCH_SWEEP_SQLS:
+        if name == "q1full":
+            d0 = sum(c.value for c in disp)
+            for _ in range(runs):
+                col_results[name] = s.execute(sql)[0].values()
+            q1_disp_per_stmt = (sum(c.value for c in disp) - d0) / runs
+        else:
+            for _ in range(runs):
+                col_results[name] = s.execute(sql)[0].values()
+    t_col = (time.time() - t0) / runs
+    d_fbs = fbs.value - f0
+    d_argp = argp.value - a0
+    assert d_fbs == 0, \
+        f"tpch sweep counted {d_fbs} columnar fallbacks"
+    assert d_argp >= 4 * runs, \
+        (f"only {d_argp} arg-plane states partials across the sweep — "
+         f"expression arguments fell off the fused states path")
+    assert q1_disp_per_stmt <= 2, \
+        (f"real-shape q1 cost {q1_disp_per_stmt} device dispatches per "
+         f"statement — the ≤ 2 filter+states budget regressed")
+
+    # row-protocol regime (kill switch): the parity oracle for every
+    # sweep shape — the same statements, rows crossing the wire
+    client = store.get_client()
+    client.columnar_scan = False
+    try:
+        t0 = time.time()
+        row_results = {name: s.execute(sql)[0].values()
+                       for name, sql in TPCH_SWEEP_SQLS}
+        t_row = time.time() - t0
+    finally:
+        client.columnar_scan = True
+    for name, _ in TPCH_SWEEP_SQLS:
+        got_rows, want_rows = col_results[name], row_results[name]
+        assert len(got_rows) == len(want_rows), name
+        for got, want in zip(got_rows, want_rows):
+            for a, b in zip(got, want):
+                ga = a.decode() if isinstance(a, bytes) else a
+                gb = b.decode() if isinstance(b, bytes) else b
+                # EXACT parity: Decimal sums at full precision, float
+                # SUM/AVG bit-identical (the arg-plane channel preserves
+                # the row path's sequential rounding); str() pins the
+                # display SCALE too — the states channel must render the
+                # same codec-canonical decimals the row partials carry
+                assert ga == gb and str(ga) == str(gb), \
+                    f"tpch sweep parity [{name}]: {a!r} != {b!r}"
+    return {
+        "tpch_sweep_queries": len(TPCH_SWEEP_SQLS),
+        "tpch_sweep_regions": n_regions,
+        "tpch_sweep_rows_per_sec": round(
+            n_rows * len(TPCH_SWEEP_SQLS) / t_col, 1),
+        "tpch_sweep_speedup_vs_rowpath": round(t_row * runs / t_col, 2)
+        if t_col else None,
+        "tpch_sweep_fallbacks": d_fbs,
+        "tpch_sweep_arg_plane_partials": d_argp,
+        "q1full_fallbacks": d_fbs,
+        "q1full_dispatches_per_stmt": q1_disp_per_stmt,
     }
 
 
@@ -1975,6 +2138,22 @@ def main(smoke: bool = False, full: bool = False):
           f"{q1p_figs['q1_pushdown_fallbacks']} fallbacks, states/rows "
           f"wire bytes {q1p_figs['q1_states_bytes_vs_rows_bytes']}",
           file=sys.stderr)
+    # TPC-H sweep regime: every parser-accepted aggregate shape — the
+    # REAL q1 (expression aggregate arguments), q6, min/max arithmetic,
+    # float expression args, decimal/datetime group keys — all columnar,
+    # zero fallbacks, exact row-protocol parity (PR 18)
+    tsr = 8_000 if smoke else 150_000
+    tpch_figs = measure_tpch_sweep(tsr, n_regions=4, runs=runs)
+    print(f"# tpch_sweep ({tsr / 1000:.0f}k rows x "
+          f"{tpch_figs['tpch_sweep_regions']} regions, "
+          f"{tpch_figs['tpch_sweep_queries']} query shapes): "
+          f"{tpch_figs['tpch_sweep_rows_per_sec']:,.0f} rows/s columnar "
+          f"({tpch_figs['tpch_sweep_speedup_vs_rowpath']:.2f}x the row "
+          f"protocol), {tpch_figs['tpch_sweep_fallbacks']} fallbacks, "
+          f"{tpch_figs['tpch_sweep_arg_plane_partials']} arg-plane "
+          f"partials, q1full "
+          f"{tpch_figs['q1full_dispatches_per_stmt']} dispatches/stmt",
+          file=sys.stderr)
     # multi-key string-join regime: TPC-H-q3/q5-shaped joins on
     # composite (varchar, varchar) keys riding the dictionary tier's
     # key-tuple codes (device remap kernel at floor 0 so the smoke rig
@@ -2086,6 +2265,7 @@ def main(smoke: bool = False, full: bool = False):
         **e2e_figs,
         **fan_figs,
         **q1p_figs,
+        **tpch_figs,
         **mq_figs,
         **ov_figs,
         **htap_figs,

@@ -1,0 +1,344 @@
+"""Differential suite for EXPRESSION aggregate arguments (PR 18): the
+arg-plane compiler (ops.exprc.compile_arg_plane) lowers arithmetic over
+columns into jitted plane programs evaluated INSIDE the batched states
+dispatch (kernels.region_agg_states_batched) — no extra device round
+trip. The contract across 1/2/4/8 regions: zero columnar fallbacks and
+row-for-row identity with BOTH oracles — the per-region host exprc rung
+(failpoint copr/arg_plane) and the row protocol (kill switch) — through
+NULL propagation (`a * (1 - b)` with NULL b), decimal rescale exactness
+at mixed scales, the int-overflow pre-guard's row-protocol bail,
+float-SUM/AVG sequential-rounding bit parity, every failpoint rung of
+the states ladder, and mid-scan split/merge."""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+from tidb_tpu import failpoint, metrics, tablecodec as tc
+from tidb_tpu.copr import columnar_region
+from tidb_tpu.session import Session, new_store
+
+_id = itertools.count(1)
+
+N_ROWS = 240
+
+# every query's aggregate argument is an EXPRESSION — these must all
+# ride the fused arg-plane states path with ZERO fallbacks
+QUERIES = [
+    # NULL propagation: b NULL every 7th row → a*(1-b) contributes
+    # nothing for that row (valid-plane fold), exactly like the row path
+    "select g, sum(a * (1 - b)), count(*) from t group by g order by g",
+    # decimal rescale at MIXED scales: p scale 2, q scale 4 → product
+    # scale 6, sums exact at full precision
+    "select g, sum(p * (1 - q)), min(p - q), max(p + q) from t "
+    "group by g order by g",
+    # pure-int IntDiv / Mod (Go truncation semantics on device)
+    "select g, sum(a div (b + 1)), sum(a % (b + 1)) from t "
+    "where b is not null group by g order by g",
+    # float expression args: SUM/AVG must keep the row path's
+    # sequential rounding bit for bit (device plane, host accumulation)
+    "select g, sum(f * 2), avg(f + 0.5), sum(f / 2) from t "
+    "group by g order by g",
+    # unary minus + int avg with NULL propagation
+    "select g, sum(-a), avg(a * 3 - b) from t group by g order by g",
+    # scalar (no group by): G == 1 per region
+    "select sum(p * q), count(*) from t",
+]
+
+
+def _build(n_regions: int) -> Session:
+    store = new_store(f"cluster://3/argplanes{next(_id)}")
+    s = Session(store)
+    s.execute("create database ap")
+    s.execute("use ap")
+    s.execute(
+        "create table t (id bigint primary key, a bigint, b bigint, "
+        "p decimal(10,2), q decimal(8,4), f double, g varchar(4), "
+        "big bigint)")
+    vals = []
+    for i in range(1, N_ROWS + 1):
+        b = "null" if i % 7 == 0 else str(i % 5)
+        vals.append(
+            f"({i}, {i % 23}, {b}, {i % 40 + (i % 4) * 0.25}, "
+            f"{(i % 13) / 16}, {(i % 9) * 0.01!r}, "
+            f"'{('A', 'B', 'C')[i % 3]}', {(1 << 40) + i})")
+    s.execute("insert into t values " + ",".join(vals))
+    if n_regions > 1:
+        tid = s.info_schema().table_by_name("ap", "t").info.id
+        step = N_ROWS // n_regions
+        store.cluster.split_keys(
+            [tc.encode_row_key(tid, step * i + 1)
+             for i in range(1, n_regions)])
+    return s
+
+
+def _c(name: str) -> int:
+    return metrics.counter(name).value
+
+
+def _all(s: Session, queries=QUERIES) -> list:
+    return [s.execute(q)[0].values() for q in queries]
+
+
+def _row_protocol(s: Session, queries=QUERIES) -> list:
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        return [s.execute(q)[0].values() for q in queries]
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+
+
+def _norm(rows):
+    out = []
+    for row in rows:
+        nr = []
+        for v in row:
+            if v is None:
+                nr.append(None)
+            else:
+                try:
+                    nr.append(round(float(v), 9))
+                except (TypeError, ValueError):
+                    nr.append(v.decode() if isinstance(v, bytes) else v)
+        out.append(nr)
+    return out
+
+
+@pytest.mark.parametrize("n_regions", [1, 2, 4, 8])
+def test_arg_planes_zero_fallbacks_and_row_parity(n_regions, monkeypatch):
+    """The headline invariant: every expression-argument aggregate runs
+    columnar (zero fallbacks), its programs counted on the arg-plane
+    metrics, with answers identical to the row protocol."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(n_regions)
+    fb0 = _c("distsql.columnar_fallbacks")
+    sp0 = _c("copr.arg_plane.specs")
+    ap0 = _c("distsql.columnar_arg_planes")
+    got = _all(s)
+    assert _c("distsql.columnar_fallbacks") == fb0, \
+        "an expression-argument aggregate fell off the columnar tier"
+    assert _c("copr.arg_plane.specs") - sp0 >= len(QUERIES), \
+        "no aggregate spec lowered through the arg-plane compiler"
+    assert _c("distsql.columnar_arg_planes") - ap0 >= len(QUERIES), \
+        "no statement counted arg-plane states partials"
+    want = _row_protocol(s)
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"arg-plane states diverged from the row protocol on {q!r}"
+
+
+def test_decimal_rescale_exactness_mixed_scales(monkeypatch):
+    """Decimal products at mixed scales (2 x 4 → 6) sum EXACTLY — the
+    fixed-point rescale on device matches the row path's arbitrary-
+    precision Decimal arithmetic value for value, not approximately."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    q = ("select g, sum(p * (1 - q)), sum(p * q) from t "
+         "group by g order by g")
+    got = s.execute(q)[0].values()
+    want = _row_protocol(s, [q])[0]
+    for g, w in zip(got, want):
+        for a, b in zip(g, w):
+            # exact Decimal equality at full precision, AND identical
+            # rendering — the states route must reproduce the row
+            # protocol's codec-canonical display scale, not just the
+            # numeric value
+            assert a == b, f"decimal rescale diverged: {a} != {b}"
+            assert str(a) == str(b), \
+                f"decimal display scale diverged: {a!r} != {b!r}"
+
+
+def test_float_sum_avg_bit_parity(monkeypatch):
+    """Float SUM/AVG over expression args stay bit-identical to the row
+    protocol: the plane computes on device but reads back row-space so
+    the host accumulates in row order (np.add.at), reproducing the row
+    path's sequential rounding exactly."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    q = ("select g, sum(f * 2), avg(f + 0.5), sum(f / 2) from t "
+         "group by g order by g")
+    got = s.execute(q)[0].values()
+    want = _row_protocol(s, [q])[0]
+    assert got == want     # bitwise-identical floats
+
+
+def test_int_overflow_preguard_bails_to_row_protocol(monkeypatch):
+    """big*big exceeds the int64 plane bound: the compile-time bound
+    walk rejects the program (mask-independent, so the states probe
+    agrees) and the statement degrades to the row protocol — which
+    raises MySQL's BIGINT-out-of-range error, never a silently wrapped
+    plane sum. The columnar route must surface the SAME error."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    q = "select g, sum(big * big) from t group by g order by g"
+    with pytest.raises(Exception) as col_err:
+        s.execute(q)
+    s.execute("set global tidb_tpu_columnar_scan = 0")
+    try:
+        with pytest.raises(Exception) as row_err:
+            s.execute(q)
+    finally:
+        s.execute("set global tidb_tpu_columnar_scan = 1")
+    assert "out of range" in str(col_err.value)
+    assert type(col_err.value) is type(row_err.value)
+
+
+def test_unpushable_div_degrades_with_parity(monkeypatch):
+    """Div outside float context (row side divides in exact Decimal) is
+    rejected by the arg-plane compiler — the statement's regions answer
+    through the row protocol with identical results (the certified
+    bottom rung, counted as fallbacks, never wrong answers)."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    q = "select g, sum(p / 2) from t group by g order by g"
+    got = s.execute(q)[0].values()
+    want = _row_protocol(s, [q])[0]
+    assert _norm(got) == _norm(want)
+
+
+def test_arg_plane_failpoint_lowers_to_host_exprc(monkeypatch):
+    """copr/arg_plane forces every program off the fused states kernel
+    onto the per-region host exprc rung (copr.degraded_arg_plane):
+    answers bit-identical, still zero row-protocol fallbacks."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _all(s)
+    deg = metrics.counter("copr.degraded_arg_plane")
+    fb0, d0 = _c("distsql.columnar_fallbacks"), deg.value
+    failpoint.enable("copr/arg_plane", action="return", value=True)
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("copr/arg_plane")
+    assert deg.value > d0, \
+        "copr/arg_plane never lowered a program to the host exprc rung"
+    assert _c("distsql.columnar_fallbacks") == fb0, \
+        "the host exprc rung fell through to the row protocol"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"host exprc rung diverged from the fused kernel on {q!r}"
+
+
+def test_device_fault_ladder_bottoms_out_with_arg_planes(monkeypatch):
+    """device/agg_states takes out the device states rungs under
+    arg-plane reductions: programs lower host-side
+    (copr.degraded_arg_plane via the fault path) and the statement still
+    answers through the states channel — answers unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _all(s)
+    deg = metrics.counter("copr.degraded_states_to_host")
+    d0 = deg.value
+    failpoint.enable("device/agg_states")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("device/agg_states")
+    assert deg.value > d0, \
+        "device/agg_states never pushed the states ladder to the host"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"host-ladder answers diverged on {q!r}"
+
+
+def test_region_fault_bails_to_row_protocol_with_parity(monkeypatch):
+    """copr/agg_states (region-time typed fault) drops every region to
+    the row protocol — the bottom of the ladder — with identical
+    answers for expression-argument aggregates."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _all(s)
+    fb0 = _c("distsql.columnar_fallbacks")
+    failpoint.enable("copr/agg_states")
+    try:
+        got = _all(s)
+    finally:
+        failpoint.disable("copr/agg_states")
+    assert _c("distsql.columnar_fallbacks") > fb0, \
+        "copr/agg_states never degraded a region to the row protocol"
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"row-protocol bottom rung diverged on {q!r}"
+
+
+def test_mid_scan_split_and_merge_rebatch(monkeypatch):
+    """A split/merge injected DURING the fan-out: the stale-epoch retry
+    re-collects payloads and the finisher still evaluates every
+    arg-plane program over the NEW region set — answers unchanged."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    store = s.store
+    want = _all(s)
+    tid = s.info_schema().table_by_name("ap", "t").info.id
+
+    def mutate_split(st):
+        st.cluster.split_keys([tc.encode_row_key(tid, 33),
+                               tc.encode_row_key(tid, 177)])
+
+    def mutate_merge(st):
+        regions = st.cluster.regions
+        for i in range(len(regions) - 1):
+            if regions[i].start:
+                st.cluster.merge(regions[i].region_id,
+                                 regions[i + 1].region_id)
+                return
+
+    for mutate in (mutate_split, mutate_merge):
+        orig = store.rpc.cop_request
+        state = {"n": 0, "done": False}
+
+        def hook(ctx, sel, ranges, read_ts, orig=orig, state=state,
+                 mutate=mutate):
+            state["n"] += 1
+            if state["n"] == 2 and not state["done"]:
+                state["done"] = True
+                mutate(store)
+            return orig(ctx, sel, ranges, read_ts)
+
+        store.rpc.cop_request = hook
+        try:
+            got = _all(s)
+        finally:
+            store.rpc.cop_request = orig
+        assert state["done"]
+        for q, g, w in zip(QUERIES, got, want):
+            assert _norm(g) == _norm(w), \
+                f"mid-scan topology change diverged on {q!r}"
+
+
+def test_serial_route_matches_batched(monkeypatch):
+    """BATCH_STATES_ENABLED=False pins every region to the serial
+    per-region states kernel — arg-plane programs evaluate through
+    kernels.region_agg_states (not the batched variant) with identical
+    answers."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    want = _all(s)
+    monkeypatch.setattr(columnar_region, "BATCH_STATES_ENABLED", False)
+    got = _all(s)
+    for q, g, w in zip(QUERIES, got, want):
+        assert _norm(g) == _norm(w), \
+            f"serial states route diverged on {q!r}"
+
+
+def test_q1_shape_two_dispatch_budget(monkeypatch):
+    """The real-q1 shape (filtered, expression args, grouped) costs at
+    most 2 device dispatches for the whole fan-out: one batched filter,
+    one batched states with the arg programs fused in."""
+    monkeypatch.setattr(columnar_region, "STATES_DEVICE_FLOOR", 0)
+    s = _build(4)
+    q = ("select g, sum(p * (1 - q)), count(*) from t "
+         "where a <= 18 group by g order by g")
+    s.execute(q)     # warm (pack + jit)
+    disp = (metrics.counter("copr.states_batch.dispatches"),
+            metrics.counter("copr.mesh.near_data_dispatches"),
+            metrics.counter("copr.states_batch.serial_dispatches"),
+            metrics.counter("copr.filter.batched_dispatches"))
+    d0 = sum(c.value for c in disp)
+    got = s.execute(q)[0].values()
+    assert sum(c.value for c in disp) - d0 <= 2, \
+        "real-q1 shape exceeded the 2-device-dispatch budget"
+    want = _row_protocol(s, [q])[0]
+    assert _norm(got) == _norm(want)

@@ -76,6 +76,25 @@ def test_bench_smoke_emits_valid_json():
     assert out["q1_states_dispatches_per_stmt"] == 1, \
         (f"q1 ran {out['q1_states_dispatches_per_stmt']} states "
          "dispatches per statement — near-data batching regressed")
+    # the TPC-H sweep regime (PR 18): every parser-accepted aggregate
+    # shape — the REAL q1 with expression arguments, q6, min/max over
+    # arithmetic, float expression args, decimal/datetime group keys —
+    # stays columnar with ZERO fallbacks, expression arguments ride the
+    # fused arg-plane states path, and the real-shape q1 keeps the ≤ 2
+    # device-dispatches-per-statement budget (row-protocol parity for
+    # every query asserted inside the bench itself)
+    assert out["tpch_sweep_queries"] >= 6
+    assert out["tpch_sweep_regions"] == 4
+    assert out["tpch_sweep_rows_per_sec"] > 0
+    assert out["tpch_sweep_fallbacks"] == 0, \
+        "the TPC-H sweep fell off the columnar tier"
+    assert out["tpch_sweep_arg_plane_partials"] >= 4, \
+        "no expression aggregate argument rode the arg-plane path"
+    assert out["q1full_fallbacks"] == 0, \
+        "real-shape q1 (expression aggregate args) counted fallbacks"
+    assert out["q1full_dispatches_per_stmt"] <= 2, \
+        (f"real-shape q1 cost {out['q1full_dispatches_per_stmt']} device "
+         "dispatches per statement — the ≤ 2 budget regressed")
     # the multi-key string-join regime: q3/q5-shaped joins on composite
     # (varchar, varchar) keys ride the dictionary tier fully columnar —
     # zero fallbacks, the device remap kernel built the key-tuple codes,

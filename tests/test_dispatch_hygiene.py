@@ -203,6 +203,14 @@ def test_batched_filter_dispatch_site_serializes():
         "kernels.region_filter_batched lost its dispatch_serial block"
 
 
+def test_serial_states_dispatch_site_serializes():
+    """The PR 18 arg-plane work rides BOTH states kernels: the serial
+    per-region variant (the below-floor / degraded rung) owns a
+    launch+readback too and must keep its dispatch_serial block."""
+    assert _serial_span_of(ROOT / "kernels.py", "region_agg_states"), \
+        "kernels.region_agg_states lost its dispatch_serial block"
+
+
 def test_checker_detects_unserialized_launch(tmp_path):
     """Meta-test: the walker must flag both rule shapes end-to-end (a
     refactor cannot silently neuter it)."""

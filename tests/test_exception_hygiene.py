@@ -145,3 +145,15 @@ def test_filter_tier_degradation_seams_present():
         "_finish_filter_batch lost its copr/filter_batched seam"
     assert 'record_degraded("filter_batch")' in region, \
         "filter-tier fallbacks no longer counted on copr.degraded_filter_batch"
+
+
+def test_arg_plane_degradation_seams_present():
+    """PR 18 arg-plane seams, pinned by name: the statement finisher's
+    host-exprc-rung failpoint and the degradation counter must stay
+    wired — every arg-plane program that falls off the fused states
+    kernel is counted on copr.degraded_arg_plane, never silent."""
+    region = (ROOT / "copr" / "columnar_region.py").read_text()
+    assert '"copr/arg_plane"' in region, \
+        "finish_states_batch lost its copr/arg_plane seam"
+    assert 'record_degraded("arg_plane")' in region, \
+        "arg-plane fallbacks no longer counted on copr.degraded_arg_plane"

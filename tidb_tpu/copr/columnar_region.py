@@ -469,24 +469,21 @@ def _states_probe(batch: col.ColumnBatch, agg_specs, colpb: dict,
     bound) are MONOTONE — checked against the SUPERSET mask (all packed
     rows), they hold for every subset the real filter can produce."""
     specs, gcids = agg_specs
-    if is_index:
-        for _name, arg in specs:
-            if arg is not None and arg.tp == ExprType.COLUMN_REF:
-                cd = batch.columns.get(arg.val)
-                if cd is not None and cd.kind == col.K_DEC:
-                    return False
     for cid in gcids:
         cd = batch.columns.get(cid)
         c = colpb.get(cid)
         if cd is None or c is None:
             return False
-        if not (cd.kind == col.K_STR or cd.kind == col.K_F64
-                or _int_plane(cd, c)):
+        if not _group_plane(cd, c):
             return False
     sup = batch.row_mask()
     for name, arg in specs:
         if arg is None or arg.tp == ExprType.VALUE:
             continue    # count over a literal: always expressible
+        if arg.tp != ExprType.COLUMN_REF:
+            if not _probe_arg_plane(name, arg, batch, colpb, sup):
+                return False
+            continue
         cd = batch.columns.get(arg.val)
         c = colpb.get(arg.val)
         if cd is None or c is None:
@@ -494,8 +491,10 @@ def _states_probe(batch: col.ColumnBatch, agg_specs, colpb: dict,
         if name == "count":
             continue
         if name == "first_row":
-            if not (cd.kind in (col.K_STR, col.K_F64, col.K_DEC)
-                    or _int_plane(cd, c)):
+            # same admission as group keys: first_row datums decode
+            # through _flat_datum, which handles every _group_plane kind
+            # (temporal included via plane_datum)
+            if not _group_plane(cd, c):
                 return False
             continue
         if cd.kind == col.K_F64:
@@ -517,6 +516,34 @@ def _states_probe(batch: col.ColumnBatch, agg_specs, colpb: dict,
             mx = cd.max_abs
             if mx and n_sup and mx * n_sup >= (1 << 63):
                 return False
+    return True
+
+
+def _probe_arg_plane(name: str, arg, batch: col.ColumnBatch, colpb: dict,
+                     sup: np.ndarray) -> bool:
+    """Mirror of _prepare_states' EXPRESSION-argument exits, against the
+    superset mask: the compile rejects are mask-independent (expression
+    shape + whole-batch column metadata), and the int/decimal sum wrap
+    bound is monotone (a filter can only shrink the contributing set)."""
+    try:
+        from tidb_tpu.ops import exprc
+    except ImportError:
+        return False
+    try:
+        prog = exprc.compile_arg_plane(arg, batch, colpb)
+    except exprc.Unsupported:
+        return False
+    except errors.TypeError_:
+        return False
+    if name == "count":
+        return True
+    if prog.kind == col.K_F64:
+        return name in ("sum", "avg")   # derived-plane min/max: row path
+    if name in ("sum", "avg"):
+        n_sup = int(np.count_nonzero(sup))
+        mx = prog.max_abs
+        if mx and n_sup and mx * n_sup >= (1 << 63):
+            return False
     return True
 
 
@@ -631,7 +658,8 @@ def _states_specs(sel: SelectRequest):
             if name != "count":
                 return None   # sum(const)/first_row(const): row handler
         elif arg.tp != ExprType.COLUMN_REF:
-            return None       # expression args: row handler answers
+            if not _arg_expr_shape_ok(name, arg):
+                return None   # shapes the arg-plane compiler can't take
         specs.append((name, arg))
     gcids = []
     for item in sel.group_by:
@@ -641,11 +669,45 @@ def _states_specs(sel: SelectRequest):
     return specs, gcids
 
 
+
+
+def _arg_expr_shape_ok(name: str, e) -> bool:
+    """Structural pre-pack gate for EXPRESSION aggregate arguments
+    (PR 18) — the shared planner/region rule (proto.arg_plane_shape_ok):
+    arithmetic over column refs / constants, reduced by a
+    plane-expressible aggregate. The full contextual rules (kind typing,
+    overflow bounds, float-context restrictions) need the packed batch
+    and run in exprc.compile_arg_plane at prepare time; every deeper
+    reject there is mask-independent and mirrored by _states_probe."""
+    from tidb_tpu.copr.proto import arg_plane_shape_ok
+    return arg_plane_shape_ok(name, e)
+
+
 def _int_plane(cd: col.ColumnData, c) -> bool:
     """A plain-integer int64 plane (times/durations/bits excluded: their
     flattened codec forms are not safely reconstructible from the plane
     value alone, so those shapes stay on the row handler)."""
     return cd.kind == col.K_I64 and c.tp in my.INTEGER_TYPES
+
+
+def _temporal_plane(cd: col.ColumnData, c) -> bool:
+    """A time/duration int64 plane: packed time words / duration nanos
+    are CANONICAL comparable codes (equal SQL values → equal plane
+    words), and col.plane_datum reconstructs the exact flattened storage
+    datum — good enough to GROUP by (PR 18), while arithmetic over them
+    stays on the row handler (_int_plane keeps excluding them)."""
+    return cd.kind == col.K_I64 and (c.tp in my.TIME_TYPES
+                                     or c.tp == my.TypeDuration)
+
+
+def _group_plane(cd: col.ColumnData, c) -> bool:
+    """GROUP-key plane kinds: strings (sorted dict codes), floats, plain
+    ints — and, since PR 18, decimals and times/durations: their plane
+    values are scale-canonical / packed integer codes, so tuple_codes
+    groups them structurally and _flat_datum reconstructs group keys
+    that merge byte-identically with row-protocol partials."""
+    return (cd.kind in (col.K_STR, col.K_F64, col.K_DEC)
+            or _int_plane(cd, c) or _temporal_plane(cd, c))
 
 
 def _flat_datum(cd: col.ColumnData, c, i: int) -> Datum:
@@ -657,9 +719,9 @@ def _flat_datum(cd: col.ColumnData, c, i: int) -> Datum:
     byte-identically with row-protocol partials), and decimals keep the
     column scale via scaleb (plane_datum's division canonicalizes
     trailing zeros; partial-row value slots carry the scale the row
-    accumulator's Decimals carry). Callers gate kinds via
-    _int_plane/K_F64/K_STR/K_DEC first — times/durations never reach
-    this."""
+    accumulator's Decimals carry). Callers gate kinds via _group_plane /
+    _int_plane / K_F64 / K_STR / K_DEC first — times/durations (group
+    keys since PR 18) take the plane_datum decode below."""
     if cd.valid[i]:
         if cd.kind == col.K_I64 and my.has_unsigned_flag(c.flag):
             return Datum.u64(int(cd.values[i]))
@@ -667,6 +729,89 @@ def _flat_datum(cd: col.ColumnData, c, i: int) -> Datum:
             return Datum.dec(
                 Decimal(int(cd.values[i])).scaleb(-cd.dec_scale))
     return col.plane_datum(cd, c, i)
+
+
+class ArgPlaneSpec:
+    """The VALUE slot of one EXPRESSION-argument reduction (PR 18): the
+    compiled arg-plane program plus the batch whose column planes feed
+    it. The states kernels recognize it via `is_arg_plane` and evaluate
+    the program INSIDE the fused dispatch (validity folds into the
+    contrib mask in-trace); `host_eval` is the next ladder rung — the
+    SAME compiled closure eagerly over the host planes, bit-identical by
+    construction. `cell` is the float-SUM/AVG builder's side channel:
+    whichever rung ran fills the per-group row-order sums exactly
+    once."""
+
+    is_arg_plane = True
+
+    __slots__ = ("prog", "batch", "cell", "_host")
+
+    def __init__(self, prog, batch: col.ColumnBatch):
+        self.prog = prog
+        self.batch = batch
+        self.cell: dict = {}
+        self._host = None
+
+    def device_planes(self) -> dict:
+        """{cid: (values, valid)} feeding the fused dispatch — PINNED
+        device twins preferred so the kernel reads HBM directly (the
+        same discipline as _PendingFilter.filter_seg)."""
+        dev = getattr(self.batch, "_device_planes", None)
+        planes = {}
+        for cid in self.prog.cids:
+            cd = self.batch.columns[cid]
+            if dev is not None and cid in dev:
+                planes[cid] = dev[cid]
+            else:
+                planes[cid] = (cd.values, cd.valid)
+        return planes
+
+    def host_eval(self) -> tuple:
+        """(values, valid) of the program over the host planes — the
+        per-region host exprc rung (memoized: lowering and the float
+        builder may both ask)."""
+        if self._host is None:
+            planes = {cid: (self.batch.columns[cid].values,
+                            self.batch.columns[cid].valid)
+                      for cid in self.prog.cids}
+            v, va = self.prog(planes)
+            self._host = (np.asarray(v), np.asarray(va).astype(bool))
+        return self._host
+
+
+def _has_arg_planes(reductions) -> bool:
+    return any(getattr(v, "is_arg_plane", False)
+               for _op, v, _ok in reductions)
+
+
+def _lower_arg_planes(gid: np.ndarray, reductions: list, G: int) -> list:
+    """The rung between the fused kernel and the row protocol: evaluate
+    each arg-plane program host-side (exprc eager — bit-identical to the
+    traced form) and rewrite its reductions into plain (op, vals, ok)
+    shape. ARITY-PRESERVING: builder output indices stay valid — float
+    plane slots become dummy count reductions after their row-order sums
+    precompute into the builder's cell."""
+    out = []
+    for op, v, ok in reductions:
+        if not getattr(v, "is_arg_plane", False):
+            out.append((op, v, ok))
+            continue
+        pv, pva = v.host_eval()
+        okv = np.asarray(ok, bool) & pva
+        if op == "cnt":
+            out.append(("sum", None, okv))
+        elif op == "plane":
+            if "sums" not in v.cell:
+                sums = np.zeros(G, np.float64)
+                np.add.at(sums, gid[okv], pv[okv])
+                v.cell["sums"] = sums
+            out.append(("sum", None, okv))
+        elif op == "pvalid":
+            out.append(("sum", None, okv))
+        else:
+            vals = pv if pv.dtype == np.float64 else pv.astype(np.int64)
+            out.append((op, vals, okv))
+    return out
 
 
 def _run_states(batch: col.ColumnBatch, gid: np.ndarray, reductions: list,
@@ -691,6 +836,13 @@ def _run_states(batch: col.ColumnBatch, gid: np.ndarray, reductions: list,
             return kernels.region_agg_states(gid, reductions, G)
         except errors.DeviceError:
             tracing.record_degraded("states_to_host", tally=False)
+            if _has_arg_planes(reductions):
+                tracing.record_degraded("arg_plane", tally=False)
+    if _has_arg_planes(reductions):
+        # below the floor (routine) or after a device fault (counted
+        # above): the host exprc rung materializes the arg planes and
+        # the plain numpy reductions below answer identically
+        reductions = _lower_arg_planes(gid, reductions, G)
     outs = []
     for op, vals, ok in reductions:
         if vals is None:
@@ -718,10 +870,10 @@ def _agg_states_response(sel: SelectRequest, batch: col.ColumnBatch,
     → the row handler answers (a column kind without an exact state
     mapping, or an int-sum overflow guard). Serves TABLE and INDEX
     requests alike (the index-key planes carry every referenced column);
-    index requests keep DECIMAL-valued aggregates on the row handler —
-    their datums decode from the comparable key encoding, whose scale
-    canonicalization can differ from the record codec's, and a partial
-    value slot must merge byte-identically with row-protocol partials."""
+    since PR 18 that includes DECIMAL-valued index aggregates — the
+    comparable-key decode and the record codec both land on the scaled
+    int64 plane at the COLUMN scale, so _flat_datum reconstructs the
+    same digits either way and merged results stay numerically exact."""
     if columns is None:
         columns = sel.table_info.columns
     colpb = {c.column_id: c for c in columns}
@@ -761,24 +913,13 @@ def _prepare_states(batch: col.ColumnBatch, mask: np.ndarray, agg_specs,
     already answered."""
     from tidb_tpu import metrics
     specs, gcids = agg_specs
-    if is_index:
-        for _name, arg in specs:
-            if arg is not None and arg.tp == ExprType.COLUMN_REF:
-                cd = batch.columns.get(arg.val)
-                if cd is not None and cd.kind == col.K_DEC:
-                    return None
     live_idx = np.nonzero(mask)[0]
     for cid in gcids:
         cd = batch.columns.get(cid)
         c = colpb.get(cid)
         if cd is None or c is None:
             return None
-        if not (cd.kind == col.K_STR or cd.kind == col.K_F64
-                or _int_plane(cd, c)):
-            # decimal/time group keys stay on the row handler: their
-            # codec key bytes are write-scale/representation sensitive,
-            # so a reconstructed key might not merge with a row-protocol
-            # partial of the same group
+        if not _group_plane(cd, c):
             return None
     if gcids:
         codes, _percol = batch.tuple_codes(gcids)
@@ -804,8 +945,9 @@ def _prepare_states(batch: col.ColumnBatch, mask: np.ndarray, agg_specs,
                  for cid in gcids]
         group_keys.append(codec.encode_value(gvals))
 
-    reductions: list = []       # (op, vals|None, contrib) — device-safe
+    reductions: list = []       # (op, vals|None|ArgPlaneSpec, contrib)
     builders: list = []         # idx layout → AggStateCol
+    has_arg_planes = False
 
     def red(op, vals, ok) -> int:
         reductions.append((op, vals, ok))
@@ -821,6 +963,78 @@ def _prepare_states(batch: col.ColumnBatch, mask: np.ndarray, agg_specs,
             builders.append(lambda outs, ci=ci: col.AggStateCol(
                 "count", outs[ci].astype(np.int64)))
             continue
+        if arg.tp != ExprType.COLUMN_REF:
+            # EXPRESSION argument (PR 18): lower into an arg-plane
+            # program the states kernel evaluates INSIDE the fused
+            # dispatch — no extra device round trip. Every reject
+            # mirrors into _probe_arg_plane (mask-independent compile,
+            # or a bound monotone under the superset mask).
+            try:
+                from tidb_tpu.ops import exprc
+            except ImportError:
+                return None
+            try:
+                prog = exprc.compile_arg_plane(arg, batch, colpb)
+            except exprc.Unsupported:
+                return None
+            except errors.TypeError_:
+                return None
+            spec = ArgPlaneSpec(prog, batch)
+            has_arg_planes = True
+            metrics.counter("copr.arg_plane.specs").inc()
+            if name == "count":
+                ci = red("cnt", spec, mask)
+                builders.append(lambda outs, ci=ci: col.AggStateCol(
+                    "count", outs[ci].astype(np.int64)))
+                continue
+            if prog.kind == col.K_F64:
+                if name in ("min", "max"):
+                    # a derived float plane can surface -0.0 ties whose
+                    # first-seen row semantics a combine can't reproduce
+                    return None
+                # float SUM/AVG: the plane computes ON DEVICE inside the
+                # fused dispatch but reads back ROW-SPACE, so the sums
+                # accumulate host-side in row order (np.add.at is
+                # unbuffered) — the same left-to-right rounding sequence
+                # the row accumulator produces
+                ci = red("cnt", spec, mask)
+                pi = red("plane", spec, mask)
+                qi = red("pvalid", spec, mask)
+
+                def fbuild(outs, ci=ci, pi=pi, qi=qi, name=name,
+                           cell=spec.cell, gid=gid, G=G):
+                    counts = outs[ci].astype(np.int64)
+                    sums = cell.get("sums")
+                    if sums is None:
+                        sums = np.zeros(G, np.float64)
+                        if G:
+                            pok = np.asarray(outs[qi]).astype(bool)
+                            pv = np.asarray(outs[pi], np.float64)
+                            np.add.at(sums, gid[pok], pv[pok])
+                    return col.AggStateCol(name, counts, values=sums,
+                                           op="sum", kind="f64")
+                builders.append(fbuild)
+                continue
+            kind = "dec" if prog.kind == col.K_DEC else "i64"
+            scale = prog.scale
+            if name in ("sum", "avg"):
+                n_contrib = int(np.count_nonzero(mask))
+                mx = prog.max_abs
+                if mx and n_contrib and mx * n_contrib >= (1 << 63):
+                    return None   # could wrap: Decimal row path answers
+                ci = red("cnt", spec, mask)
+                vi = red("sum", spec, mask)
+            else:
+                ci = red("cnt", spec, mask)
+                vi = red("min" if name == "min" else "max", spec, mask)
+            op = "sum" if name in ("sum", "avg") else name
+            builders.append(
+                lambda outs, ci=ci, vi=vi, name=name, op=op, kind=kind,
+                scale=scale:
+                col.AggStateCol(name, outs[ci].astype(np.int64),
+                                values=outs[vi], op=op, kind=kind,
+                                dec_scale=scale))
+            continue
         cd = batch.columns.get(arg.val)
         c = colpb.get(arg.val)
         if cd is None or c is None:
@@ -832,8 +1046,7 @@ def _prepare_states(batch: col.ColumnBatch, mask: np.ndarray, agg_specs,
                 "count", outs[ci].astype(np.int64)))
             continue
         if name == "first_row":
-            if not (cd.kind in (col.K_STR, col.K_F64, col.K_DEC)
-                    or _int_plane(cd, c)):
+            if not _group_plane(cd, c):
                 return None
             datums = [_flat_datum(cd, c, int(r)) for r in rep_rows.tolist()]
             ci = red("sum", None, mask)
@@ -912,6 +1125,8 @@ def _prepare_states(batch: col.ColumnBatch, mask: np.ndarray, agg_specs,
                              len(live_idx), group_keys)
     metrics.counter("copr.agg_states.partials").inc()
     metrics.counter("copr.agg_states.rows").inc(len(live_idx))
+    if has_arg_planes:
+        metrics.counter("copr.arg_plane.rows").inc(len(live_idx))
     return group_keys, pending
 
 
@@ -940,16 +1155,37 @@ class _PendingStates:
     def signature(self) -> tuple:
         """The statement's aggregate shape — regions sharing it share
         one ragged dispatch (kernels.region_agg_states_batched's cache
-        key domain)."""
-        return (tuple(op for op, _v, _ok in self.reductions),
-                tuple("c" if v is None else np.dtype(v.dtype).char
-                      for _op, v, _ok in self.reductions))
+        key domain). Arg-plane reductions contribute their program's
+        STRUCTURAL signature: same expression shape + column layout →
+        same trace."""
+        sig = []
+        for op, v, _ok in self.reductions:
+            if v is None:
+                sig.append((op, "c"))
+            elif getattr(v, "is_arg_plane", False):
+                sig.append((op, "x") + v.prog.sig)
+            else:
+                sig.append((op, np.dtype(v.dtype).char))
+        return tuple(sig)
+
+    def has_arg_planes(self) -> bool:
+        return _has_arg_planes(self.reductions)
+
+    def lower_arg_planes(self) -> None:
+        """Force the host exprc rung for every arg-plane program (the
+        copr/arg_plane failpoint's seam; arity-preserving — see
+        _lower_arg_planes)."""
+        if self.has_arg_planes():
+            self.reductions = _lower_arg_planes(self.gid, self.reductions,
+                                                self.G)
 
     def device_reductions(self) -> list:
         """Reductions with value planes swapped for their PINNED device
         twins where the batch's planes are device-resident (plane-cache
         pinning): the batched dispatch then reads HBM directly — the
-        host touches group offsets and masks, not row values."""
+        host touches group offsets and masks, not row values. Arg-plane
+        specs pass through: they resolve their own device planes at
+        marshal time (ArgPlaneSpec.device_planes)."""
         planes = getattr(self.batch, "_device_planes", None)
         if planes is None:
             return self.reductions
@@ -957,7 +1193,8 @@ class _PendingStates:
                  for cid, cd in self.batch.columns.items()}
         out = []
         for op, vals, ok in self.reductions:
-            if vals is not None:
+            if vals is not None and not getattr(vals, "is_arg_plane",
+                                                False):
                 cid = by_id.get(id(vals))
                 if cid is not None and cid in planes:
                     vals = planes[cid][0]
@@ -1135,6 +1372,20 @@ def finish_states_batch(payloads) -> None:
         pend = [p for p in pend if p.states_pending()]
         if not pend:
             return
+    if failpoint._active and failpoint.eval("copr/arg_plane") is not None:
+        # certified mid-ladder seam: force every arg-plane program down
+        # to the per-region host exprc rung (copr.degraded_arg_plane) —
+        # the now-plain reductions ride the normal states ladder, and
+        # the differential suite pins the answers bit-identical
+        lowered = False
+        for p in pend:
+            pe = p._pending
+            if getattr(pe, "has_arg_planes", None) is not None \
+                    and pe.has_arg_planes():
+                pe.lower_arg_planes()
+                lowered = True
+        if lowered:
+            tracing.record_degraded("arg_plane")
     groups: dict = {}
     for p in pend:
         groups.setdefault(p._pending.signature(), []).append(p)
@@ -1151,6 +1402,12 @@ def finish_states_batch(payloads) -> None:
             from tidb_tpu.ops import kernels
             from tidb_tpu.ops import mesh as mesh_mod
             mesh = mesh_mod.get_mesh()
+            if mesh is not None and any(pe.has_arg_planes()
+                                        for pe in pends):
+                # the shard-owned mesh kernel reads raw (op, vals, ok)
+                # specs; arg-plane statements take the single-device
+                # fused dispatch below instead of half-lowering here
+                mesh = None
             if mesh is not None:
                 try:
                     outs = mesh_mod.region_states_sharded(

@@ -68,6 +68,38 @@ AGG_NAME = {
 }
 AGG_TYPE_BY_NAME = {v: k for k, v in AGG_NAME.items()}
 
+# aggregates whose EXPRESSION arguments the arg-plane compiler
+# (ops.exprc.compile_arg_plane) can lower into the batched states
+# dispatch — and the arithmetic grammar it takes. Shared by the planner
+# (don't push an aggregate whose arg no region could answer columnar)
+# and the region handler (pre-pack structural gate): both sides agreeing
+# on the shape rule is what keeps a pushed statement at zero fallbacks.
+ARG_PLANE_AGGS = ("count", "sum", "avg", "min", "max")
+
+_ARG_PLANE_BINOPS = (Op.Plus, Op.Minus, Op.Mul, Op.Div, Op.IntDiv, Op.Mod)
+_ARG_PLANE_UNOPS = (Op.UnaryMinus, Op.UnaryPlus)
+
+
+def arg_plane_shape_ok(name: str, e: "Expr") -> bool:
+    """Structural (jax-free) gate for EXPRESSION aggregate arguments:
+    arithmetic over column refs / constants, reduced by a
+    plane-expressible aggregate. The full contextual rules (kind typing,
+    overflow bounds, float-context restrictions) need the packed batch
+    and run in exprc.compile_arg_plane at prepare time."""
+    if name not in ARG_PLANE_AGGS:
+        return False
+    if e.tp in (ExprType.VALUE, ExprType.COLUMN_REF):
+        return True
+    if e.tp != ExprType.OPERATOR or not e.children:
+        return False
+    if len(e.children) == 1:
+        ok = e.op in _ARG_PLANE_UNOPS
+    elif len(e.children) == 2:
+        ok = e.op in _ARG_PLANE_BINOPS
+    else:
+        ok = False
+    return ok and all(arg_plane_shape_ok(name, c) for c in e.children)
+
 
 @dataclass
 class Expr:

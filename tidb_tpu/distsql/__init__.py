@@ -159,6 +159,18 @@ class SelectResult:
             # the bench/tests can assert states, not rows, crossed the
             # wire
             _count("states", n_states, self.span)
+            # states partials whose aggregate arguments are EXPRESSIONS
+            # (arg-plane programs evaluated inside the states dispatch,
+            # PR 18) — counted so the bench/tests can assert the real-q1
+            # shape rode the fused arg-plane path, not the row protocol
+            from tidb_tpu.copr.proto import ExprType as _ET
+            _count("arg_planes",
+                   sum(1 for p in payloads
+                       if getattr(p, "is_agg_states", False)
+                       and any(e.children
+                               and e.children[0].tp == _ET.OPERATOR
+                               for e in (getattr(p, "_aggregates", None)
+                                         or ()))), self.span)
             # regions that deferred their FILTER too (the batched filter
             # channel) — counted before the finisher fulfills them, so
             # the span shows how much of the statement rode the

@@ -770,6 +770,29 @@ def _try_final_states(agg, child, parts, region_ids, epochs):
             out_cols.append(col_out)
             continue
         vs = combine.get(entry["vi"])
+        exp_g: list = [None] * G
+        if kind == "dec" and name in ("sum", "avg"):
+            # the row protocol's FINAL sums per-region partials that
+            # crossed the codec (trailing zeros trimmed) and the decode
+            # restore (quantize back to the declared scale when
+            # lossless), so its sum's exponent is the MIN over those
+            # addends — every addend is a multiple of 10^exp, so
+            # requantizing the device-combined total to it is exact and
+            # string-identical to the row loop
+            from tidb_tpu.ops.columnar import dec_canonical
+            sdecl = ft.decimal if (ft is not None and ft.is_decimal()
+                                   and ft.decimal >= 0) else None
+            for st, m in zip(entry["sts"], maps):
+                for j, g2 in enumerate(m.tolist()):
+                    if int(st.counts[j]) == 0:
+                        continue
+                    e = dec_canonical(
+                        Decimal(int(st.values[j]))
+                        .scaleb(-st.dec_scale)).as_tuple().exponent
+                    if sdecl is not None:
+                        e = min(e, -sdecl)
+                    if exp_g[g2] is None or e < exp_g[g2]:
+                        exp_g[g2] = e
         col_out = []
         for g in range(G):
             c = int(cnts[g])
@@ -777,8 +800,12 @@ def _try_final_states(agg, child, parts, region_ids, epochs):
                 col_out.append(NULL)
                 continue
             if name in ("sum", "avg"):
-                s = Decimal(int(vs[g])).scaleb(-scale) if kind == "dec" \
-                    else Decimal(int(vs[g]))
+                if kind == "dec":
+                    s = Decimal(int(vs[g])).scaleb(-scale)
+                    if exp_g[g] is not None:
+                        s = s.quantize(Decimal((0, (1,), exp_g[g])))
+                else:
+                    s = Decimal(int(vs[g]))
                 col_out.append(Datum.dec(s) if name == "sum"
                                else Datum.dec(s / Decimal(c)))
                 continue

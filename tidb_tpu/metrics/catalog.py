@@ -84,9 +84,13 @@ CATALOG: dict[str, tuple[str, str]] = {
     "copr.mesh.near_data_dispatches": ("counter", "Shard-owned near-data states dispatches: each region's segment computed on its RegionPlacement home shard in one mesh dispatch."),
     "copr.mesh.near_data_regions": ("counter", "Region segments computed by shard-owned near-data dispatches."),
     "copr.mesh.near_data_rows": ("counter", "Rows aggregated through shard-owned near-data dispatches."),
+    # ---- expression pushdown (aggregate-argument planes) ----
+    "copr.arg_plane.specs": ("counter", "Aggregate specs whose argument is an EXPRESSION lowered to a jitted arg-plane program inside the states dispatch."),
+    "copr.arg_plane.rows": ("counter", "Rows aggregated through arg-plane programs (expression evaluated on device, never materialized as rows)."),
     # ---- degradation chain ----
     "copr.degraded_": ("counter", "Tier fallbacks by kind (device_to_cpu, join_to_numpy, combine_to_host, mesh, batch, states_to_host, rows...)."),
     "copr.degraded_filter_batch": ("counter", "Deferred-filter groups that fell off the batched device filter kernel onto the per-region host exprc rung (answers stay bit-identical)."),
+    "copr.degraded_arg_plane": ("counter", "Statements whose arg-plane programs fell off the fused states kernel onto the per-region host exprc rung (answers stay bit-identical)."),
     # ---- mesh tier ----
     "copr.mesh.placements": ("counter", "Region-to-shard placements computed."),
     "copr.mesh.replacements": ("counter", "Region re-placements after an epoch bump."),
@@ -189,8 +193,13 @@ def split_labels(name: str) -> tuple[str, str]:
     kinds (`GROUP BY NAME`). Exact catalog names (and names the catalog
     does not know) keep their full name and empty labels. Histogram
     series sampled as `_count`/`_sum` keep the stat suffix on the NAME —
-    their stat already rides LABELS in the current-metrics table."""
-    if name in CATALOG:
+    their stat already rides LABELS in the current-metrics table. An
+    exact catalog entry that is ALSO a dynamic-family member (a
+    documented kind like `copr.degraded_filter_batch`) still splits —
+    the exact entry exists for its specific help text (`lookup`), not
+    to exempt the kind from family aggregation."""
+    if name in CATALOG and not any(
+            name.startswith(p) and len(name) > len(p) for p in PREFIXES):
         return name, ""
     base = name
     for suffix in ("_count", "_sum"):

@@ -1019,6 +1019,40 @@ class ColumnarPartialSet:
 # states, some rows) and the row-loop fallback exact by construction.
 # ---------------------------------------------------------------------------
 
+def _expr_field_type(e, col_pb: dict):
+    """Result FieldType of a pushed-down argument EXPRESSION — the
+    region-side mirror of expression.new_op's arithmetic inference
+    (merge_numeric, then Div over non-floats promotes to decimal), so
+    the partial-row layout types the value slot exactly as the plan's
+    agg_fields synthesis did."""
+    from tidb_tpu.copr.proto import ExprType, field_type_from_pb_column
+    from tidb_tpu.types import Kind
+    from tidb_tpu.types.field_type import merge_numeric, new_field_type
+    from tidb_tpu.sqlast.opcode import Op
+    if e.tp == ExprType.COLUMN_REF and e.val in col_pb:
+        return field_type_from_pb_column(col_pb[e.val])
+    if e.tp == ExprType.VALUE:
+        d = e.val
+        if d is None or d.is_null():
+            return new_field_type(my.TypeNull)
+        if d.kind == Kind.FLOAT64:
+            return new_field_type(my.TypeDouble)
+        if d.kind == Kind.DECIMAL:
+            ft = new_field_type(my.TypeNewDecimal)
+            ft.decimal = max(-d.val.as_tuple().exponent, 0)
+            return ft
+        return new_field_type(my.TypeLonglong)
+    if e.tp == ExprType.OPERATOR and e.children:
+        if len(e.children) == 1:
+            return _expr_field_type(e.children[0], col_pb)
+        rt = merge_numeric(_expr_field_type(e.children[0], col_pb),
+                           _expr_field_type(e.children[1], col_pb))
+        if e.op == Op.Div and rt.tp not in (my.TypeDouble, my.TypeFloat):
+            rt = new_field_type(my.TypeNewDecimal)
+        return rt
+    return new_field_type(my.TypeLonglong)
+
+
 def agg_partial_field_types(aggregates, col_pb: dict):
     """Field types of the partial-row layout [groupKey, f0 parts…, …] —
     the payload-side mirror of plan.physical's agg_fields synthesis
@@ -1032,6 +1066,8 @@ def agg_partial_field_types(aggregates, col_pb: dict):
         if arg is not None and arg.tp == ExprType.COLUMN_REF \
                 and arg.val in col_pb:
             arg_ft = field_type_from_pb_column(col_pb[arg.val])
+        elif arg is not None:
+            arg_ft = _expr_field_type(arg, col_pb)
         else:
             from tidb_tpu.types.field_type import FieldType
             arg_ft = FieldType(my.TypeLonglong)
@@ -1064,11 +1100,30 @@ class AggStateCol:
     datums: list | None = None      # datum-mode per-group partial values
 
 
+def dec_canonical(d: Decimal) -> Decimal:
+    """Codec-canonical Decimal: trailing zero digits trimmed, exactly
+    the form codec._encode_decimal/_decode_decimal round-trips. The row
+    protocol's partial value slots cross the wire through that codec,
+    so its FINAL merge sums TRIMMED addends — a states-channel decimal
+    must render the same form or the final sum's display scale drifts
+    (numerically equal, string-visible). NOT Decimal.normalize(): that
+    rounds to context precision and corrupts long mantissas."""
+    sign, digits, exp = d.as_tuple()
+    dl = list(digits)
+    while len(dl) > 1 and dl[-1] == 0:
+        dl.pop()
+        exp += 1
+    if dl == [0]:
+        return Decimal(0)
+    return Decimal((sign, tuple(dl), exp))
+
+
 def _state_value_datum(st: AggStateCol, g: int) -> Datum:
     """One combinable state cell → the flattened partial-row datum the
     row handler would have emitted (sum/avg → Decimal/f64 via
     aggregation._sum_exact's kinds; min/max → the column's flattened
-    storage datum)."""
+    storage datum). Decimals render codec-canonical — the form the row
+    protocol's partial rows carry after their codec round trip."""
     if int(st.counts[g]) == 0:
         return NULL
     v = st.values[g]
@@ -1076,13 +1131,15 @@ def _state_value_datum(st: AggStateCol, g: int) -> Datum:
         if st.kind == "f64":
             return Datum.f64(float(v))
         if st.kind == "dec":
-            return Datum.dec(Decimal(int(v)).scaleb(-st.dec_scale))
+            return Datum.dec(dec_canonical(
+                Decimal(int(v)).scaleb(-st.dec_scale)))
         return Datum.dec(Decimal(int(v)))
     # min/max over a numeric plane
     if st.kind == "f64":
         return Datum.f64(float(v))
     if st.kind == "dec":
-        return Datum.dec(Decimal(int(v)).scaleb(-st.dec_scale))
+        return Datum.dec(dec_canonical(
+            Decimal(int(v)).scaleb(-st.dec_scale)))
     if st.pb_col is not None and my.has_unsigned_flag(st.pb_col.flag):
         return Datum.u64(int(v))
     return Datum.i64(int(v))

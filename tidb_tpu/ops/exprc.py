@@ -19,6 +19,8 @@ capability probe turns that into "keep it on the SQL side / CPU engine".
 
 from __future__ import annotations
 
+import threading
+
 import jax.numpy as jnp
 
 from tidb_tpu import errors
@@ -669,13 +671,7 @@ def _compile_like(e: Expr, batch, negated: bool) -> CompiledExpr:
             return (~hit if negated else hit), va
         return CompiledExpr(like_range, "bool")
     # general patterns: evaluate over the dictionary on host → boolean LUT
-    import numpy as np
-    from tidb_tpu.types.datum import Datum as D
-    lut_host = np.zeros(max(len(cd.dictionary), 1), dtype=bool)
-    for i, b in enumerate(cd.dictionary):
-        m = xops.compute_like(D.bytes_(b), pat, escape)
-        lut_host[i] = (not m.is_null()) and m.val == 1
-    lut = jnp.asarray(lut_host)
+    lut = _like_lut(cd, pat, escape)
 
     def like(planes, cid=cid, lut=lut, negated=negated):
         codes, va = planes[cid]
@@ -736,3 +732,266 @@ def supported_for_tpu(e: Expr, columns_by_id: dict[int, str]) -> bool:
               ExprType.IFNULL):
         return all(supported_for_tpu(c, columns_by_id) for c in e.children)
     return False
+
+
+# ---------------------------------------------------------------------------
+# general-LIKE LUT cache: the per-code boolean LUT is a pure function of
+# (dictionary generation, pattern, escape) — recompiling a statement (jit
+# cache-key churn, repeated PREPAREs) must not re-walk the dictionary. The
+# dictionary object is pinned in the entry so the id() key cannot be
+# recycled; append-only growth changes len() and misses naturally.
+# ---------------------------------------------------------------------------
+
+_LIKE_LUT_CAP = 256
+_like_lut_cache: dict = {}
+_like_lut_lock = threading.Lock()
+
+
+def _like_lut(cd: col.ColumnData, pat: Datum, escape: str):
+    import numpy as np
+    pkey = None if pat.is_null() else pat.get_string()
+    key = (id(cd.dictionary), len(cd.dictionary), pkey, escape)
+    with _like_lut_lock:
+        ent = _like_lut_cache.get(key)
+    if ent is not None:
+        return ent[0]
+    lut_host = np.zeros(max(len(cd.dictionary), 1), dtype=bool)
+    for i, b in enumerate(cd.dictionary):
+        m = xops.compute_like(Datum.bytes_(b), pat, escape)
+        lut_host[i] = (not m.is_null()) and m.val == 1
+    lut = jnp.asarray(lut_host)
+    with _like_lut_lock:
+        _like_lut_cache[key] = (lut, cd.dictionary)  # pin: id() stays live
+        while len(_like_lut_cache) > _LIKE_LUT_CAP:
+            _like_lut_cache.pop(next(iter(_like_lut_cache)))
+    return lut
+
+
+# ---------------------------------------------------------------------------
+# aggregate-argument planes (PR 18): lower the ARGUMENT EXPRESSION of a
+# pushed-down sum/avg/min/max/count into a plane program the states kernel
+# evaluates INSIDE the existing fused dispatch. The grammar is arithmetic
+# over numeric columns/constants only, restricted to shapes whose plane
+# result is PROVABLY bit/digit-identical to the row protocol's per-row
+# datum arithmetic (expression/ops.compute_arith):
+#
+#   * Div only in float context — int/decimal division is EXACT Decimal
+#     row-side, a float plane would round;
+#   * IntDiv/Mod only in pure-int context — float/decimal forms round
+#     through Decimal strings row-side;
+#   * decimal operands feeding a float context must fit the f64 exact-int
+#     window (< 2^53 scaled) so scaled-int→f64→/10^s equals the row
+#     engine's correctly-rounded float(Decimal);
+#   * pure-int results carry a whole-tree |value| bound with EVERY
+#     intermediate proven below DEC_ABS_LIMIT — the kernel's int64 math
+#     must not wrap where row-side Python ints would not (the row engine
+#     raises on real overflow, so bailing to rows keeps error parity too).
+# ---------------------------------------------------------------------------
+
+_ARG_ARITH_OPS = (Op.Plus, Op.Minus, Op.Mul, Op.Div, Op.IntDiv, Op.Mod)
+_ARG_UNARY_OPS = (Op.UnaryMinus, Op.UnaryPlus)
+F64_EXACT_INT = 1 << 53  # exact-integer window of an f64 mantissa
+
+
+class ArgPlaneProg:
+    """A compiled aggregate-argument plane program.
+
+    `sig` is the STRUCTURAL signature — expression shape + per-column
+    (cid, kind, tp, dec_scale) — that keys kernel traces; data-dependent
+    bounds (max_abs) are deliberately excluded so same-shape batches share
+    one trace. `kind`/`scale` type the resulting plane; `max_abs` bounds
+    the scaled |value| for int/decimal results (None for f64)."""
+
+    __slots__ = ("compiled", "cids", "kind", "scale", "max_abs", "sig")
+
+    def __init__(self, compiled: CompiledExpr, cids: tuple, sig: tuple):
+        self.compiled = compiled
+        self.cids = cids
+        self.kind = compiled.kind
+        self.scale = compiled.scale
+        self.max_abs = compiled.max_abs
+        self.sig = sig
+
+    def __call__(self, planes):
+        return self.compiled(planes)
+
+
+def _arg_cids(e: Expr, out: set) -> None:
+    if e.tp == ExprType.COLUMN_REF:
+        out.add(e.val)
+    for c in (e.children or ()):
+        _arg_cids(c, out)
+
+
+def _arg_static_kind(e: Expr, batch: col.ColumnBatch, colpb: dict):
+    """Static value kind (col.K_* or None for a NULL constant) of an
+    argument-expression node under the row engine's CONTEXTUAL typing —
+    raises Unsupported for any shape whose plane could differ from the
+    row protocol (see module comment). plan.physical mirrors these rules
+    jax-free on the planner side; drift is parity-safe in both directions
+    (planner-only accept → counted region fallback, region-only accept →
+    shape simply stays SQL-side)."""
+    from tidb_tpu import mysqldef as my
+    if e.tp == ExprType.VALUE:
+        d = e.val
+        if d is None or not isinstance(d, Datum):
+            raise Unsupported("arg-plane constant is not a datum")
+        if d.is_null():
+            return None
+        if d.kind in (Kind.INT64, Kind.UINT64):
+            return col.K_I64
+        if d.kind == Kind.FLOAT64:
+            return col.K_F64
+        if d.kind == Kind.DECIMAL:
+            return col.K_DEC
+        raise Unsupported(f"arg-plane constant kind {d.kind!r}")
+    if e.tp == ExprType.COLUMN_REF:
+        cd = batch.columns.get(e.val)
+        c = colpb.get(e.val)
+        if cd is None or c is None:
+            raise Unsupported("arg-plane column not packed")
+        if cd.kind == col.K_STR:
+            raise Unsupported("string column in arithmetic argument")
+        if my.has_unsigned_flag(c.flag):
+            # row arithmetic sees the full u64 range; the plane is i64
+            raise Unsupported("unsigned column in arithmetic argument")
+        if cd.kind == col.K_I64 and c.tp not in my.INTEGER_TYPES:
+            # packed time words / duration nanos are NOT the row
+            # engine's numeric coercion of those values
+            raise Unsupported("temporal/bit column in arithmetic argument")
+        return cd.kind
+    if e.tp == ExprType.OPERATOR:
+        if len(e.children) == 1:
+            if e.op not in _ARG_UNARY_OPS:
+                raise Unsupported(f"arg-plane unary op {e.op!r}")
+            return _arg_static_kind(e.children[0], batch, colpb)
+        if len(e.children) != 2 or e.op not in _ARG_ARITH_OPS:
+            raise Unsupported(f"arg-plane op {getattr(e, 'op', None)!r}")
+        ka = _arg_static_kind(e.children[0], batch, colpb)
+        kb = _arg_static_kind(e.children[1], batch, colpb)
+        f64 = col.K_F64 in (ka, kb)
+        dec = col.K_DEC in (ka, kb)
+        if e.op == Op.Div and not f64:
+            raise Unsupported("Div outside float context stays on rows")
+        if e.op in (Op.IntDiv, Op.Mod) and (f64 or dec):
+            raise Unsupported("IntDiv/Mod outside int context stays on rows")
+        if f64 and dec:
+            for ch, k in ((e.children[0], ka), (e.children[1], kb)):
+                if k != col.K_DEC:
+                    continue
+                b = _arg_bound(ch, batch)
+                if b is None or b >= F64_EXACT_INT:
+                    raise Unsupported(
+                        "decimal too wide for exact float conversion")
+        if f64:
+            return col.K_F64
+        if dec:
+            return col.K_DEC
+        return col.K_F64 if e.op == Op.Div else col.K_I64
+    raise Unsupported(f"arg-plane expr type {e.tp!r}")
+
+
+def _arg_scale(e: Expr, batch: col.ColumnBatch) -> int:
+    """Decimal scale of an int/dec argument node (0 for ints/floats)."""
+    if e.tp == ExprType.VALUE:
+        d = e.val
+        if not d.is_null() and d.kind == Kind.DECIMAL:
+            return max(0, -d.val.as_tuple().exponent)
+        return 0
+    if e.tp == ExprType.COLUMN_REF:
+        return batch.columns[e.val].dec_scale
+    if len(e.children) == 1:
+        return _arg_scale(e.children[0], batch)
+    sa = _arg_scale(e.children[0], batch)
+    sb = _arg_scale(e.children[1], batch)
+    if e.op == Op.Mul:
+        return sa + sb
+    if e.op in (Op.Plus, Op.Minus):
+        return max(sa, sb)
+    return 0
+
+
+def _arg_bound(e: Expr, batch: col.ColumnBatch):
+    """Scaled-int |value| bound of an argument node, every intermediate
+    guarded below DEC_ABS_LIMIT; None once float context is entered (f64
+    never wraps). Raises Unsupported when a needed bound is unprovable."""
+    if e.tp == ExprType.VALUE:
+        d = e.val
+        if d.is_null():
+            return 0
+        if d.kind in (Kind.INT64, Kind.UINT64):
+            return _dec_guard(abs(int(d.val)), "argument constant")
+        if d.kind == Kind.FLOAT64:
+            return None
+        scale = max(0, -d.val.as_tuple().exponent)
+        return _dec_guard(abs(int(d.val * (10 ** scale))),
+                          "argument constant")
+    if e.tp == ExprType.COLUMN_REF:
+        cd = batch.columns[e.val]
+        if cd.kind == col.K_F64:
+            return None
+        if cd.max_abs is None:
+            raise Unsupported("argument column carries no bound")
+        return _dec_guard(int(cd.max_abs), "argument column")
+    if len(e.children) == 1:
+        return _arg_bound(e.children[0], batch)
+    ma = _arg_bound(e.children[0], batch)
+    mb = _arg_bound(e.children[1], batch)
+    if ma is None or mb is None or e.op == Op.Div:
+        return None
+    if e.op == Op.Mul:
+        return _dec_guard(ma * mb, "argument product")
+    if e.op in (Op.Plus, Op.Minus):
+        # decimal add/sub aligns scales first — bound at the wider scale
+        sa = _arg_scale(e.children[0], batch)
+        sb = _arg_scale(e.children[1], batch)
+        s = max(sa, sb)
+        return _dec_guard(ma * 10 ** (s - sa) + mb * 10 ** (s - sb),
+                          "argument sum")
+    if e.op == Op.IntDiv:
+        return ma
+    return min(ma, mb)  # Mod: |a mod b| <= min(|a|, |b|)
+
+
+_ARG_PLANE_CAP = 512
+_arg_plane_cache: dict = {}
+_arg_plane_lock = threading.Lock()
+
+
+def compile_arg_plane(e: Expr, batch: col.ColumnBatch,
+                      colpb: dict) -> ArgPlaneProg:
+    """Compile an aggregate's argument expression into an ArgPlaneProg, or
+    raise Unsupported. Every reject here is mask-independent (it depends
+    on the expression shape and whole-batch column metadata, never on
+    which rows a WHERE keeps), which is what lets _states_probe certify
+    the deferred-filter path against it."""
+    cids: set = set()
+    _arg_cids(e, cids)
+    if not cids:
+        raise Unsupported("argument expression references no column")
+    kind = _arg_static_kind(e, batch, colpb)
+    if kind is None:
+        raise Unsupported("NULL-only argument expression")
+    cids_t = tuple(sorted(cids))
+    sig_cols = []
+    key_cols = []
+    for cid in cids_t:
+        cd = batch.columns[cid]
+        sig_cols.append((cid, cd.kind, cd.tp, cd.dec_scale))
+        key_cols.append((cid, cd.kind, cd.tp, cd.dec_scale, cd.max_abs))
+    key = (repr(e), tuple(key_cols))
+    with _arg_plane_lock:
+        prog = _arg_plane_cache.get(key)
+    if prog is not None:
+        return prog
+    compiled = compile_expr(e, batch)
+    if compiled.kind not in (col.K_I64, col.K_F64, col.K_DEC):
+        raise Unsupported(f"argument kind {compiled.kind!r} not aggregable")
+    if compiled.kind != col.K_F64 and compiled.max_abs is None:
+        compiled.max_abs = _arg_bound(e, batch)
+    prog = ArgPlaneProg(compiled, cids_t, ((repr(e),) + tuple(sig_cols)))
+    with _arg_plane_lock:
+        _arg_plane_cache[key] = prog
+        while len(_arg_plane_cache) > _ARG_PLANE_CAP:
+            _arg_plane_cache.pop(next(iter(_arg_plane_cache)))
+    return prog

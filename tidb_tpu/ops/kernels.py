@@ -1138,24 +1138,57 @@ def combine_region_partials(states: list[np.ndarray],
 _region_states_cache: dict = {}
 
 
+def _states_spec_forms(specs: list):
+    """(cache-key elements, trace forms, programs) of one spec list —
+    shared by the serial and batched states kernels so their cache keys
+    and marshaling layout cannot drift. A legacy spec keys on (op,
+    dtype-char) and occupies 2 input slots (vals, ok); an ARG-PLANE spec
+    (PR 18) keys on its program's structural signature and occupies
+    1 + 2·len(cids) slots (contrib mask, then each column's values +
+    valid planes). The entry pins the trace-time compiled closures the
+    same way region_filter_batched pins its predicates: a later batch
+    with the same structural key provably traces identically."""
+    kelems = []
+    forms = []
+    progs = []
+    for op, v, _ok in specs:
+        if v is None:
+            kelems.append((op, "c"))
+            forms.append((op, None))
+            progs.append(None)
+        elif getattr(v, "is_arg_plane", False):
+            kelems.append((op, "x") + v.prog.sig)
+            forms.append((op, v.prog.cids))
+            progs.append(v.prog)
+        else:
+            kelems.append((op, np.dtype(v.dtype).char))
+            forms.append((op, None))
+            progs.append(None)
+    return tuple(kelems), tuple(forms), tuple(progs)
+
+
 def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
     """Per-group partial states for one region's pushed aggregate.
 
     `gid` maps every plane row to its region-local group id (G = dead-row
     sink); specs[i] = (op, vals|None, contrib): op ∈ {"sum","min","max"},
     vals a host int64/float64 plane (None → int64 ones: a count), contrib
-    the contributing-row mask. Returns one [G] array per spec from ONE
-    dispatch + one packed readback. Faults (incl. the device/agg_states
-    failpoint) raise typed DeviceError so the region engine can degrade
-    to the host numpy states — same algebra, same answers."""
+    the contributing-row mask. An ARG-PLANE spec carries an
+    ArgPlaneSpec value instead: its program evaluates in-trace over the
+    column planes (FUSED into this same dispatch), validity folds into
+    contrib, and op extends to "cnt" (valid-count) plus the row-space
+    readbacks "plane"/"pvalid" that feed the float-SUM host accumulator.
+    Returns one array per spec ([G] segment states; [n] for row-space
+    ops) from ONE dispatch + one packed readback. Faults (incl. the
+    device/agg_states failpoint) raise typed DeviceError so the region
+    engine can degrade to the host rungs — same algebra, same
+    answers."""
     from tidb_tpu import errors as _errors, failpoint as _failpoint
     from tidb_tpu import tracing as _tracing
 
     n = len(gid)
-    ops_t = tuple(op for op, _v, _ok in specs)
-    dtypes = tuple("c" if v is None else np.dtype(v.dtype).char
-                   for _op, v, _ok in specs)
-    key = (ops_t, G, n, dtypes)
+    kelems, forms_t, progs_t = _states_spec_forms(specs)
+    key = (kelems, G, n)
     ent = _region_states_cache.get(key)
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
@@ -1163,9 +1196,33 @@ def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
         def fn(arrs, _live):
             seg = SegCtx(arrs[0], G + 1)   # +1: dead-row sink
             outs = []
-            for i, op in enumerate(ops_t):
-                vals = arrs[1 + 2 * i]
-                ok = arrs[2 + 2 * i]
+            pos = 1
+            for (op, cids), prog in zip(forms_t, progs_t):
+                if prog is not None:
+                    contrib = arrs[pos]
+                    pos += 1
+                    planes = {}
+                    for cid in cids:
+                        planes[cid] = (arrs[pos], arrs[pos + 1])
+                        pos += 2
+                    v, va = prog(planes)
+                    ok = contrib & va
+                    if op == "plane":
+                        outs.append(v.astype(jnp.float64))
+                        continue
+                    if op == "pvalid":
+                        outs.append(ok)
+                        continue
+                    if op == "cnt":
+                        outs.append(
+                            seg.sum(jnp.ones(n, jnp.int64), ok)[:G])
+                        continue
+                    vals = v if v.dtype == jnp.float64 \
+                        else v.astype(jnp.int64)
+                else:
+                    vals = arrs[pos]
+                    ok = arrs[pos + 1]
+                    pos += 2
                 if op == "sum":
                     red = seg.sum(vals, ok)
                 elif op == "min":
@@ -1191,6 +1248,14 @@ def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
                                 "injected agg-states kernel failure"))
         arrs = [jnp.asarray(np.asarray(gid, np.int64))]
         for _op, vals, ok in specs:
+            if getattr(vals, "is_arg_plane", False):
+                arrs.append(jnp.asarray(np.asarray(ok, bool)))
+                planes = vals.device_planes()
+                for cid in vals.prog.cids:
+                    pv, pva = planes[cid]
+                    arrs.append(jnp.asarray(pv))
+                    arrs.append(jnp.asarray(pva))
+                continue
             if vals is None:
                 vals = np.ones(n, dtype=np.int64)
             arrs.append(jnp.asarray(vals))
@@ -1254,9 +1319,13 @@ def region_agg_states_batched(segs: list) -> list:
 
     segs[r] = (gid_r, specs_r, G_r) with the same per-region contract as
     region_agg_states; every region must share the statement's aggregate
-    shape (same ops, same value dtypes — the caller groups by that
-    signature). Returns outs[r] = one [G_r] array per spec, exactly what
-    R serial region_agg_states calls would return. Value planes may
+    shape (same ops, same value dtypes / arg-plane structural
+    signatures — the caller groups by that signature). Returns outs[r] =
+    one array per spec ([G_r] segment states; [n_r] row-space planes for
+    "plane"/"pvalid"), exactly what R serial region_agg_states calls
+    would return. Arg-plane specs (PR 18) evaluate their programs over
+    the concatenated column planes INSIDE this same dispatch — the
+    expression pushdown costs no extra round trip. Value planes may
     arrive as device-resident jax arrays (pinned plane-cache planes ride
     the dispatch without a fresh H2D). Faults (incl. the
     device/agg_states failpoint) raise typed DeviceError so the caller
@@ -1269,9 +1338,7 @@ def region_agg_states_batched(segs: list) -> list:
     Gs = tuple(int(g) for _gid, _sp, g in segs)
     ns = tuple(len(gid) for gid, _sp, _g in segs)
     specs0 = segs[0][1]
-    ops_t = tuple(op for op, _v, _ok in specs0)
-    dtypes = tuple("c" if v is None else np.dtype(v.dtype).char
-                   for _op, v, _ok in specs0)
+    kelems, forms_t, progs_t = _states_spec_forms(specs0)
     # region offsets into the global segment space: each region owns a
     # BUCKETED span covering its G_r groups + its dead-row sink (the
     # sink is gid value G_r, always inside the span); slots above the
@@ -1287,24 +1354,56 @@ def region_agg_states_batched(segs: list) -> list:
         offs.append(off)
         off += gb
     S_total = off
-    key = (ops_t, Gbs, ns, dtypes)
+    key = (kelems, Gbs, ns)
     ent = _batched_states_cache.get(key)
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
         offs_t = tuple(offs)
+        n_total = int(sum(ns))
 
         def fn(arrs, _live):
+            def cat(xs):
+                xs = list(xs)
+                return xs[0] if R == 1 else jnp.concatenate(xs)
+
             parts = [arrs[r] + offs_t[r] for r in range(R)]
             gid = parts[0] if R == 1 else jnp.concatenate(parts)
             seg = SegCtx(gid, S_total)
             outs = []
-            for i, op in enumerate(ops_t):
-                b = R + 2 * i * R
-                vals = arrs[b] if R == 1 \
-                    else jnp.concatenate([arrs[b + r] for r in range(R)])
-                ok = arrs[b + R] if R == 1 \
-                    else jnp.concatenate([arrs[b + R + r]
-                                          for r in range(R)])
+            pos = R
+            for (op, cids), prog in zip(forms_t, progs_t):
+                if prog is not None:
+                    # ARG PLANE (PR 18): the program evaluates over the
+                    # concatenated column planes INSIDE this dispatch —
+                    # elementwise, so region boundaries don't matter
+                    contrib = cat(arrs[pos:pos + R])
+                    pos += R
+                    planes = {}
+                    for cid in cids:
+                        pv = cat(arrs[pos:pos + R])
+                        pos += R
+                        pva = cat(arrs[pos:pos + R])
+                        pos += R
+                        planes[cid] = (pv, pva)
+                    v, va = prog(planes)
+                    ok = contrib & va
+                    if op == "plane":
+                        outs.append(v.astype(jnp.float64))
+                        continue
+                    if op == "pvalid":
+                        outs.append(ok)
+                        continue
+                    if op == "cnt":
+                        outs.append(
+                            seg.sum(jnp.ones(n_total, jnp.int64), ok))
+                        continue
+                    vals = v if v.dtype == jnp.float64 \
+                        else v.astype(jnp.int64)
+                else:
+                    vals = cat(arrs[pos:pos + R])
+                    pos += R
+                    ok = cat(arrs[pos:pos + R])
+                    pos += R
                 if op == "sum":
                     red = seg.sum(vals, ok)
                 elif op == "min":
@@ -1323,7 +1422,7 @@ def region_agg_states_batched(segs: list) -> list:
     n_rows = sum(ns)
     sp = _tracing.current().child("agg_states_batch") \
         .set("regions", R).set("groups", sum(Gs)) \
-        .set("states", len(ops_t)).set("rows", n_rows)
+        .set("states", len(forms_t)).set("rows", n_rows)
     t0 = _time.perf_counter()
     try:
         if _failpoint._active:
@@ -1332,7 +1431,19 @@ def region_agg_states_batched(segs: list) -> list:
                                 "injected agg-states kernel failure"))
         arrs = [jnp.asarray(np.asarray(gid, np.int64))
                 for gid, _sp2, _g in segs]
-        for i in range(len(ops_t)):
+        for i, (op0, v0, _ok0) in enumerate(specs0):
+            if getattr(v0, "is_arg_plane", False):
+                for _gid_r, specs_r, _g in segs:
+                    arrs.append(jnp.asarray(np.asarray(specs_r[i][2],
+                                                       bool)))
+                planes_r = [specs_r[i][1].device_planes()
+                            for _gid_r, specs_r, _g in segs]
+                for cid in v0.prog.cids:
+                    for pr in planes_r:
+                        arrs.append(jnp.asarray(pr[cid][0]))
+                    for pr in planes_r:
+                        arrs.append(jnp.asarray(pr[cid][1]))
+                continue
             vplanes = []
             okplanes = []
             for gid_r, specs_r, _g in segs:
@@ -1365,7 +1476,17 @@ def region_agg_states_batched(segs: list) -> list:
     _metrics.counter("copr.states_batch.rows").inc(n_rows)
     outs = unpack_outputs(wrapper, host)
     full = [np.atleast_1d(np.asarray(o)) for o in outs]
-    return [[o[offs[r]:offs[r] + Gs[r]] for o in full] for r in range(R)]
+    # segment states slice by bucketed group offsets; row-space outputs
+    # ("plane"/"pvalid" readbacks) slice by cumulative row offsets
+    modes = tuple("row" if op in ("plane", "pvalid") else "seg"
+                  for op, _cids in forms_t)
+    roffs = [0]
+    for x in ns:
+        roffs.append(roffs[-1] + x)
+    return [[(o[roffs[r]:roffs[r + 1]] if m == "row"
+              else o[offs[r]:offs[r] + Gs[r]])
+             for o, m in zip(full, modes)]
+            for r in range(R)]
 
 
 # ---------------------------------------------------------------------------

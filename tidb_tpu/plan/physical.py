@@ -483,6 +483,17 @@ def _try_push_aggregation(agg: Aggregation, scan,
         pb = agg_func_to_pb(ctx.client, f, req_tp)
         if pb is None:
             return None
+        arg = pb.children[0] if pb.children else None
+        if arg is not None and arg.tp not in (proto.ExprType.VALUE,
+                                              proto.ExprType.COLUMN_REF) \
+                and not proto.arg_plane_shape_ok(proto.AGG_NAME[pb.tp],
+                                                 arg):
+            # an expression argument the arg-plane compiler can never
+            # lower: pushing it would make EVERY region degrade to the
+            # row protocol. Keep the aggregation SQL-side instead — the
+            # scan below stays columnar and the statement stays at zero
+            # fallbacks (PR 18).
+            return None
         pb_aggs.append(pb)
     pb_groups = []
     for g in agg.group_by:
