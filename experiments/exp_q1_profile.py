@@ -1,13 +1,18 @@
-"""Where does Q1 e2e time go? Stand up the bench store at small scale,
-run Q1 through the full SQL stack, and time the phases inside the TPU
-client (dispatch vs D2H vs emit vs SQL-side)."""
+"""Where does Q1 e2e time go? Superseded by the kernel-level continuous
+profiler (tidb_tpu.profiler): the monkey-patched client-phase timers this
+experiment used to carry are now first-class — every metered dispatch
+publishes into the per-(kind, signature) registry, and the same figures
+are queryable live via information_schema.TIDB_TPU_KERNEL_PROFILE.
+
+This wrapper stands up the bench store, runs the three bench queries
+through the full SQL stack, and prints the profiler's roofline table
+plus the statement's Perfetto trace-event export path.
+"""
+import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np
 
 import bench
 from tidb_tpu.ops import TpuClient
@@ -26,108 +31,38 @@ if factor > 1:
 store.set_client(TpuClient(store))
 sess = Session(store)
 sess.execute("use tpch")
-client = store.get_client()
 
-# instrument the client phases
-import tidb_tpu.ops.client as cl
+for label, sql in (("q6", bench.Q6), ("q1", bench.Q1),
+                   ("distinct", bench.QDIST)):
+    sess.execute(sql)   # warm (trace)
+    sess.execute(sql)   # steady state (execute)
+    print(f"# {label}: ran", file=sys.stderr)
 
-orig_run_agg = TpuClient._run_aggregate
-phase = {}
+# the roofline table the old hand-timed phases approximated: device time,
+# tunnel bytes, rows, and the readback-vs-compute-bound verdict per
+# kernel signature
+from tidb_tpu import profiler
 
-
-def timed_run_agg(self, sel, batch, where):
-    t0 = time.time()
-    r = orig_run_agg(self, sel, batch, where)
-    phase["run_aggregate"] = time.time() - t0
-    return r
-
-
-TpuClient._run_aggregate = timed_run_agg
-
-orig_get_batch = TpuClient._get_batch
-
-
-def timed_get_batch(self, sel, ranges):
-    t0 = time.time()
-    r = orig_get_batch(self, sel, ranges)
-    phase["get_batch"] = time.time() - t0
-    return r
-
-
-TpuClient._get_batch = timed_get_batch
-
-
-def run(sql, label, runs=3):
-    sess.execute(sql)  # warm
-    times = []
-    for _ in range(runs):
-        t0 = time.time()
-        sess.execute(sql)
-        times.append(time.time() - t0)
-    print(f"{label}: {min(times)*1e3:.0f}..{max(times)*1e3:.0f} ms/run  "
-          f"phases={ {k: round(v*1e3) for k, v in phase.items()} }",
+for row in profiler.profile_rows():
+    print(f"{row['kind']}|{row['signature']}: "
+          f"{row['dispatches']} dispatches "
+          f"({row['retraces']} retraces), "
+          f"{row['device_us']} us device "
+          f"({row['trace_us']} us tracing), "
+          f"{row['readback_bytes']} B readback at "
+          f"{row['bytes_per_device_sec']/1e9:.2f} GB/s, "
+          f"{row['rows_per_sec']:,.0f} rows/s -> {row['bound']}",
           file=sys.stderr)
 
-
-print("=== pre-D2H state is already gone (execute reads results) ===",
-      file=sys.stderr)
-run(bench.Q6, "q6 e2e")
-run(bench.Q1, "q1 e2e")
-run(bench.QDIST, "distinct e2e")
-run(bench.Q1, "q1 e2e again")
-
-# break down inside run_aggregate for q1: time dispatch vs asarray
-import jax
-from tidb_tpu.ops import kernels
-
-sel_holder = {}
-orig_send_tpu = TpuClient._send_tpu
-
-
-def capture_send(self, req, sel):
-    sel_holder["sel"] = sel
-    sel_holder["ranges"] = req.key_ranges
-    return orig_send_tpu(self, req, sel)
-
-
-TpuClient._send_tpu = capture_send
+# cross-thread timeline of the most recent retained statement trace
+# (SET GLOBAL tidb_slow_log_threshold low enough and re-run to retain):
+# the same JSON ADMIN TPU PROFILE EXPORT returns — load it in Perfetto
+sess.execute("set global tidb_slow_log_threshold = 1")
 sess.execute(bench.Q1)
-sel = sel_holder["sel"]
-batch = client._get_batch(sel, sel_holder["ranges"])
-specs = kernels.lower_aggregates(sel, batch)
-planes = kernels.batch_planes(
-    batch, with_pos=any(sp.name == "first_row" for sp in specs))
-live = np.zeros(batch.capacity, dtype=bool)
-live[: batch.n_rows] = True
-gspec = kernels.lower_group_by(sel, batch)
-print(f"gspec kind={gspec.kind} plane_keys={gspec.plane_keys} "
-      f"sizes={gspec.sizes}", file=sys.stderr)
-planes = client._with_group_planes(batch, gspec, planes)
-fn, wrapper, jitted = client._kernel(
-    sel, batch, "grouped",
-    lambda: kernels.build_grouped_agg_fn(
-        kernels.compile_expr(sel.where, batch) if sel.where is not None
-        else None, specs, gspec.plane_keys, gspec.sizes))
-r = jitted(planes, live)
-jax.block_until_ready(r)
-for lbl, fn_call in [
-    ("dispatch+block (host live)",
-     lambda: jax.block_until_ready(jitted(planes, live))),
-]:
-    t0 = time.time()
-    for _ in range(3):
-        fn_call()
-    print(f"{lbl}: {(time.time()-t0)/3*1e3:.0f} ms", file=sys.stderr)
-live_dev = __import__("jax.numpy", fromlist=["asarray"]).asarray(live)
-r = jitted(planes, live_dev)
-jax.block_until_ready(r)
-t0 = time.time()
-for _ in range(3):
-    jax.block_until_ready(jitted(planes, live_dev))
-print(f"dispatch+block (dev live): {(time.time()-t0)/3*1e3:.0f} ms",
-      file=sys.stderr)
-t0 = time.time()
-for _ in range(3):
-    packed = jitted(planes, live_dev)
-    np.asarray(packed)
-print(f"dispatch+1xD2H: {(time.time()-t0)/3*1e3:.0f} ms", file=sys.stderr)
+rs = sess.execute("admin tpu profile export")[0]
+rows = rs.values()
+if rows:
+    doc = json.loads(rows[0][2])
+    print(f"# trace-event export: {len(doc['traceEvents'])} events "
+          f"(load in ui.perfetto.dev)", file=sys.stderr)
+    print(json.dumps(doc))

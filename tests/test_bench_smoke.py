@@ -196,3 +196,15 @@ def test_bench_smoke_emits_valid_json():
     assert out["hot_region_count"] >= 4
     assert out["hot_region_top_read_rows"] > 0
     assert out["hot_region_top_score"] > 0
+    # kernel-profiler figures (PR 19): the continuous profiler watched
+    # every metered dispatch the regimes above ran — a top signature
+    # exists, owns a real share of device time, and the retrace counter
+    # reconciles with the jit-cache phase counters
+    assert out["kernel_profile_signatures"] >= 1, \
+        "the profiler registry saw no dispatches across the whole bench"
+    top = out["kernel_profile_top_signature"]
+    assert top and "|" in top, top
+    assert out["kernel_profile_top_device_us"] > 0
+    assert 0 < out["kernel_profile_top_device_us_share"] <= 1.0
+    assert out["kernel_profile_retraces"] >= 1, \
+        "cold jit compiles never published as retraces"

@@ -1,0 +1,440 @@
+"""Kernel-level continuous profiler: per-(kind, signature) roofline
+attribution off the metered dispatch lock, the TIDB_TPU_KERNEL_PROFILE
+table, cross-thread Perfetto trace-event export, HBM high-water
+telemetry, and the statement-level `profile:` clause.
+
+The accounting contract under test: the dispatch-serial lock's __exit__
+computes ONE integer microsecond figure and feeds it to BOTH
+`device.busy_us` and `profiler.publish`, so Σ per-signature device_us
+must equal the busy_us delta exactly — including under concurrent
+sessions (no cross-attribution, no second accounting path). The kill
+switch retains nothing; the always-on cost stays under the same <2 ms
+per-statement guard as the digest pipeline (PR 10).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+
+import pytest
+
+from tidb_tpu import errors, inspection, metrics, profiler, tracing
+from tidb_tpu import flight
+from tidb_tpu import tablecodec as tc
+from tidb_tpu.metrics import timeseries
+from tidb_tpu.session import Session, new_store
+from tidb_tpu.types import Datum
+
+_id = itertools.count(1)
+
+N_ROWS = 40_000
+N_REGIONS = 8
+AGG_Q = "select b, sum(a), count(c) from t group by b"
+
+
+def _build(n_rows: int = N_ROWS, n_regions: int = N_REGIONS) -> Session:
+    """Cluster store split into n_regions, each region's row count above
+    the device-states floor (4096) so the fan-out dispatches per-region
+    device kernels on the drain-pool workers AND a mesh/combine on the
+    statement thread — the cross-thread shape the trace-event export
+    must render."""
+    store = new_store(f"cluster://4/kprof{next(_id)}")
+    s = Session(store)
+    s.execute("create database kp")
+    s.execute("use kp")
+    s.execute("create table t (id bigint primary key, a bigint, "
+              "b bigint, c bigint)")
+    tbl = s.info_schema().table_by_name("kp", "t")
+    rows = [[Datum.i64(i), Datum.i64(i % 97), Datum.i64(i % 13),
+             Datum.i64(i)] for i in range(1, n_rows + 1)]
+    for start in range(0, n_rows, 10_000):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + 10_000],
+                        skip_unique_check=True)
+        txn.commit()
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+    return s
+
+
+@pytest.fixture(scope="module")
+def sess() -> Session:
+    profiler.set_enabled(True)
+    s = _build()
+    s.execute(AGG_Q)   # warm: jit compile + plane pack
+    return s
+
+
+def _sv(v):
+    return v.decode() if isinstance(v, bytes) else v
+
+
+def _rows(s, sql):
+    return s.execute(sql)[0].values()
+
+
+# ---------------------------------------------------------------------------
+# 1. registry attribution + windowed reconciliation
+# ---------------------------------------------------------------------------
+
+class TestAttribution:
+    def test_registry_attributes_device_dispatches(self, sess):
+        snap0 = profiler.registry_snapshot()
+        sess.execute(AGG_Q)
+        snap1 = profiler.registry_snapshot()
+        grown = {label: e for label, e in snap1.items()
+                 if e["dispatches"] > snap0.get(label,
+                                                {"dispatches": 0})
+                 ["dispatches"]}
+        assert grown, f"no signature grew: {sorted(snap1)}"
+        for label, e in grown.items():
+            kind, _, sig = label.partition("|")
+            assert kind and sig, label
+            assert e["device_us"] > 0, (label, e)
+        # the statement moved real bytes through the tunnel somewhere
+        assert any(e["readback_bytes"] > 0 for e in snap1.values())
+        assert any(e["rows"] > 0 for e in snap1.values())
+
+    def test_device_us_reconciles_across_concurrent_sessions(self, sess):
+        """Acceptance: Σ per-signature device_us == device.busy_us delta
+        with 3 sessions dispatching concurrently — both sides are fed
+        the same integer inside the lock's __exit__, so equality is
+        exact, not approximate."""
+        store = sess.store
+        sessions = [Session(store) for _ in range(3)]
+        for ss in sessions:
+            ss.execute("use kp")
+        busy0 = metrics.counter("device.busy_us").value
+        snap0 = profiler.registry_snapshot()
+        barrier = threading.Barrier(3)
+        errs: list = []
+
+        def run(ss):
+            try:
+                barrier.wait()
+                for _ in range(2):
+                    ss.execute(AGG_Q)
+            except Exception as e:   # surfaced by the assert below
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(ss,))
+              for ss in sessions]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errs, errs
+        busy_delta = metrics.counter("device.busy_us").value - busy0
+        snap1 = profiler.registry_snapshot()
+        sig_delta = sum(
+            e["device_us"] - snap0.get(label, {"device_us": 0})
+            ["device_us"] for label, e in snap1.items())
+        assert busy_delta > 0
+        assert sig_delta == busy_delta, (sig_delta, busy_delta)
+
+    def test_no_cross_attribution_between_sessions(self, sess):
+        """A session running only below-floor statements must not pick
+        up another session's concurrent device dispatches in its own
+        per-statement profile tally."""
+        store = sess.store
+        heavy, light = Session(store), Session(store)
+        heavy.execute("use kp")
+        light.execute("use kp")
+        barrier = threading.Barrier(2)
+        out: dict = {}
+
+        def run_heavy():
+            barrier.wait()
+            for _ in range(3):
+                heavy.execute(AGG_Q)
+
+        def run_light():
+            barrier.wait()
+            for _ in range(20):
+                kp0 = tracing.kernel_profile_snapshot()
+                light.execute("select 1")
+                d = tracing.kernel_profile_delta(kp0)
+                out.setdefault("deltas", []).append(d)
+
+        ts = [threading.Thread(target=run_heavy),
+              threading.Thread(target=run_light)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        leaked = [d for d in out["deltas"] if d]
+        assert not leaked, leaked
+
+    def test_windowed_profile_reconciles_with_busy_us(self, sess):
+        """The TIDB_TPU_KERNEL_PROFILE window derivation: over ONE
+        recorder window, Σ profiler.sig.device_us deltas equals the
+        device.busy_us delta (both are counters sampled at the same
+        instants)."""
+        timeseries.recorder.sample()
+        sess.execute(AGG_Q)
+        time.sleep(0.002)
+        d, _begin, _end = timeseries.recorder.sample_window(
+            int(inspection.threshold("window_samples")))
+        sig_sum = sum(delta for name, delta in d.items()
+                      if name.startswith(profiler.METRIC_PREFIX
+                                         + "device_us."))
+        assert sig_sum == pytest.approx(d.get("device.busy_us", 0.0))
+        assert sig_sum > 0
+
+
+# ---------------------------------------------------------------------------
+# 2. queryable surfaces: profile table, profile clause, retrace rule
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_kernel_profile_table(self, sess):
+        sess.execute(AGG_Q)
+        time.sleep(0.002)
+        rows = _rows(sess,
+                     "select KIND, SIGNATURE, DISPATCHES, RETRACES, "
+                     "DEVICE_US, TRACE_US, EXECUTE_US, READBACK_BYTES, "
+                     "H2D_BYTES, PROCESSED_ROWS, BYTES_PER_DEVICE_SEC, "
+                     "ROWS_PER_SEC, BOUND from "
+                     "information_schema.TIDB_TPU_KERNEL_PROFILE")
+        assert rows, "profile table empty after a device statement"
+        for r in rows:
+            kind, sig = _sv(r[0]), _sv(r[1])
+            assert kind and sig
+            assert r[2] >= 1 and r[4] > 0          # dispatches, device_us
+            assert r[6] == r[4] - r[5]             # execute = device-trace
+            assert _sv(r[12]) in ("readback-bound", "compute-bound",
+                                  "idle")
+        # ordered hottest-first by device time
+        dev = [r[4] for r in rows]
+        assert dev == sorted(dev, reverse=True)
+
+    def test_profile_clause_in_execution_detail_and_digest(self, sess):
+        sess.execute(AGG_Q)
+        details = [_sv(r[1]) or "" for r in _rows(
+            sess, "select SQL_TEXT, EXECUTION_DETAIL from "
+                  "performance_schema.events_statements_history")]
+        assert any("profile:" in d for d in details), details[-5:]
+        prof = [_sv(r[1]) for r in _rows(
+            sess, "select DIGEST_TEXT, PROFILE from "
+                  "performance_schema.events_statements_summary_by_digest")
+            if r[1] is not None]
+        assert prof and all("|" in p and p.endswith("us") for p in prof)
+
+    def test_profile_clause_in_slow_log(self, sess, caplog):
+        import logging
+        sess.execute("set global tidb_slow_log_threshold = 1")
+        try:
+            with caplog.at_level(logging.WARNING, "tidb_tpu.slowlog"):
+                sess.execute(AGG_Q)
+        finally:
+            sess.execute("set global tidb_slow_log_threshold = 300")
+        slow = [r.getMessage() for r in caplog.records
+                if "SLOW_QUERY" in r.getMessage()]
+        assert any("profile:" in m for m in slow), slow
+
+    def test_retrace_storm_rule_fires(self, sess):
+        burst = int(inspection.threshold("retrace_burst"))
+        label = "fake|99pl/32768"
+        timeseries.recorder.sample()
+        metrics.counter(
+            f"{profiler.METRIC_PREFIX}jit_misses.{label}").inc(burst + 1)
+        metrics.counter(
+            f"{profiler.METRIC_PREFIX}device_us.{label}").inc(50_000)
+        metrics.counter(
+            f"{profiler.METRIC_PREFIX}trace_us.{label}").inc(45_000)
+        time.sleep(0.002)
+        rows = _rows(sess,
+                     "select RULE, ITEM, ITEM_VALUE, DETAILS from "
+                     "information_schema.TIDB_TPU_INSPECTION_RESULT")
+        hits = [r for r in rows if _sv(r[0]) == "retrace-storm"
+                and _sv(r[1]) == label]
+        assert hits, [(_sv(r[0]), _sv(r[1])) for r in rows]
+        assert "retraced" in _sv(hits[0][3])
+
+
+# ---------------------------------------------------------------------------
+# 3. trace-event export (Perfetto) — cross-thread timeline
+# ---------------------------------------------------------------------------
+
+class TestTraceEventExport:
+    def _export(self, sess) -> dict:
+        sess.execute("set global tidb_slow_log_threshold = 1")
+        try:
+            sess.execute(AGG_Q)
+        finally:
+            sess.execute("set global tidb_slow_log_threshold = 300")
+        entries = flight.recorder_for(sess.store).entries()
+        agg = [e for e in entries if "group by" in e["sql"]]
+        assert agg, [e["sql"][:40] for e in entries]
+        return json.loads(flight.trace_event_json(agg[-1]))
+
+    def test_export_valid_with_four_lanes_and_kernel_args(self, sess):
+        """Acceptance: the fan-out statement's export parses as valid
+        JSON with >= 4 distinct thread lanes (statement thread, drain
+        pool workers, the synthetic device-serial lane) and >= 1 kernel
+        slice carrying bytes/rows args."""
+        doc = self._export(sess)
+        slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert slices
+        for e in slices:
+            assert e["dur"] >= 0 and isinstance(e["tid"], int)
+        lanes = {e["tid"] for e in slices}
+        assert len(lanes) >= 4, sorted(lanes)
+        with_io = [e for e in slices
+                   if set(e.get("args", {})) & {"readback_bytes",
+                                                "rows", "n_rows"}]
+        assert with_io, [e["name"] for e in slices][:20]
+        # thread_name metadata labels every lane (Perfetto track names)
+        named = {e["tid"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        assert lanes <= named
+        # the dispatch-serial lock lane carries at least one hold
+        assert any(e["tid"] == 0 and e.get("cat") == "device"
+                   for e in slices)
+
+    def test_slow_traces_trace_event_json_column(self, sess):
+        self._export(sess)
+        rows = _rows(sess,
+                     "select SQL_TEXT, TRACE_EVENT_JSON from "
+                     "information_schema.TIDB_TPU_SLOW_TRACES")
+        assert rows
+        doc = json.loads(_sv(rows[-1][1]))
+        assert doc["traceEvents"]
+
+    def test_admin_tpu_profile_export(self, sess):
+        self._export(sess)
+        rows = _rows(sess, "admin tpu profile export")
+        assert len(rows) == 1
+        digest, sql_text, tej = (_sv(c) for c in rows[0])
+        assert digest and sql_text
+        doc = json.loads(tej)
+        assert {e.get("ph") for e in doc["traceEvents"]} >= {"X", "M"}
+
+
+# ---------------------------------------------------------------------------
+# 4. sysvars: GLOBAL-only, persisted, kill switch retains nothing
+# ---------------------------------------------------------------------------
+
+class TestSysvars:
+    def test_global_only_and_persisted(self, sess):
+        with pytest.raises(errors.TiDBError):
+            sess.execute("set tidb_tpu_kernel_profile = 0")
+        with pytest.raises(errors.TiDBError):
+            sess.execute("set tidb_tpu_profile_max_signatures = 8")
+        sess.execute("set global tidb_tpu_profile_max_signatures = 300")
+        try:
+            row = _rows(sess,
+                        "select variable_value from "
+                        "mysql.global_variables where variable_name = "
+                        "'tidb_tpu_profile_max_signatures'")
+            assert _sv(row[0][0]) == "300"
+        finally:
+            sess.execute(
+                "set global tidb_tpu_profile_max_signatures = 256")
+
+    def test_kill_switch_retains_nothing(self, sess):
+        sess.execute("set global tidb_tpu_kernel_profile = 0")
+        try:
+            assert not profiler.is_enabled()
+            assert profiler.registry_snapshot() == {}
+            # a device statement while off must not repopulate anything
+            busy0 = metrics.counter("device.busy_us").value
+            sess.execute(AGG_Q)
+            assert metrics.counter("device.busy_us").value > busy0, \
+                "workload did not dispatch — kill-switch test is vacuous"
+            assert profiler.registry_snapshot() == {}
+            assert len(profiler._holds) == 0
+            assert profiler._thread_names == {}
+        finally:
+            sess.execute("set global tidb_tpu_kernel_profile = 1")
+        assert profiler.is_enabled()
+
+    def test_max_signatures_folds_overflow(self):
+        profiler.set_enabled(True)
+        profiler.set_max_signatures(2)
+        try:
+            base = dict.fromkeys(("rows", "rb", "h2d"), 0)
+            for i in range(5):
+                profiler.publish(("tkind", f"sig{i}", 0, 0, 0, False), 7)
+            snap = profiler.registry_snapshot()
+            mine = {l: e for l, e in snap.items()
+                    if l.startswith("tkind|")}
+            assert "tkind|~overflow" in mine, sorted(snap)
+            # the fold keeps the device_us sum closed
+            assert sum(e["device_us"] for e in mine.values()) == 35, mine
+            del base
+        finally:
+            profiler.set_max_signatures(256)
+
+
+# ---------------------------------------------------------------------------
+# 5. overhead guard + HBM high-water telemetry
+# ---------------------------------------------------------------------------
+
+class TestOverheadAndHbm:
+    def test_profiler_overhead_under_2ms_per_stmt(self):
+        """PR 10 guard pattern: best-of-3 timed loops, profiler on vs
+        off, on a trivial statement — the per-statement cost of the
+        kprof snapshot/delta + publish path must stay under 2 ms."""
+        store = new_store(f"memory://kprofov{next(_id)}")
+        s = Session(store)
+        s.execute("set global tidb_slow_log_threshold = 0")
+        s.execute("create database o")
+        s.execute("use o")
+        n = 40
+
+        def timed_loop() -> float:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    s.execute("select 1")
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        s.execute("select 1")
+        t_on = timed_loop()
+        s.execute("set global tidb_tpu_kernel_profile = 0")
+        try:
+            t_off = timed_loop()
+        finally:
+            s.execute("set global tidb_tpu_kernel_profile = 1")
+        per_stmt_ms = max(0.0, (t_on - t_off) / n) * 1e3
+        assert per_stmt_ms < 2.0, f"{per_stmt_ms:.3f} ms/stmt"
+
+    def test_hbm_highwater_marks(self):
+        from tidb_tpu.ops import membudget
+        membudget.reset_highwater()
+        with membudget.reserve(1000, kind="probe"):
+            with membudget.reserve(2500, kind="probe"):
+                pass
+        with membudget.reserve(700, kind="build"):
+            pass
+        hw = membudget.highwater()
+        assert hw["probe"] == 3500 and hw["build"] >= 700
+        assert hw["total"] >= 3500
+        # gauges mirror the ledger for the metrics/inspection surfaces
+        assert metrics.gauge("device.hbm.hw.probe").value == 3500
+        assert metrics.gauge("device.hbm.hw.total").value == hw["total"]
+        membudget.reset_highwater()
+        assert membudget.highwater()["total"] == 0
+        assert metrics.gauge("device.hbm.hw.probe").value == 0
+
+    def test_highwater_sampled_into_metrics_history(self, sess):
+        from tidb_tpu.ops import membudget
+        with membudget.reserve(4096, kind="dispatch"):
+            timeseries.recorder.sample()
+        time.sleep(0.002)
+        rows = _rows(sess,
+                     "select NAME, LABELS, METRIC_VALUE from "
+                     "information_schema.TIDB_TPU_METRICS_HISTORY "
+                     "where NAME = 'device.hbm.hw'")
+        kinds = {_sv(r[1]) for r in rows}
+        assert 'kind="total"' in kinds, sorted(kinds)
+        assert any(_sv(r[1]) == 'kind="total"' and r[2] >= 4096
+                   for r in rows)

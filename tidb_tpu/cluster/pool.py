@@ -84,7 +84,8 @@ class DrainPool:
                     "queued": len(self._q), "size": self._size}
 
     def _worker(self) -> None:
-        from tidb_tpu import metrics
+        from tidb_tpu import metrics, profiler
+        profiler.register_thread()   # lane name for trace-event export
         qd = metrics.gauge("copr.drain_pool.queue_depth")
         workers = metrics.gauge("copr.drain_pool.workers")
         wait_h = metrics.histogram("copr.drain_pool.queue_wait_seconds")

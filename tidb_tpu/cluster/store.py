@@ -227,6 +227,10 @@ class DistCoprClient(kv.Client):
             if not sp.is_noop:
                 sp.set("queue_us",
                        (_time.perf_counter_ns() - sp.start_ns) / 1e3)
+                # the span was built on the statement thread; re-stamp
+                # it with the EXECUTING thread so the trace-event export
+                # shows real worker lanes
+                sp.tid = __import__("threading").get_ident()
             run_t0 = _time.perf_counter_ns()
             tok = tracing.attach(sp)
             bo_tok = kvbackoff.attach(stmt_bo) \

@@ -156,6 +156,9 @@ _GLOBAL_ONLY_TPU_VARS = {
     "tidb_tpu_metrics_interval_ms": "apply_metrics_interval",
     "tidb_tpu_metrics_history_cap": "apply_metrics_history_cap",
     "tidb_tpu_conn_queue_timeout_ms": "apply_conn_queue_timeout",
+    # kernel-level continuous profiler (tidb_tpu.profiler)
+    "tidb_tpu_kernel_profile": "apply_tpu_kernel_profile",
+    "tidb_tpu_profile_max_signatures": "apply_tpu_profile_max_signatures",
 }
 
 
@@ -612,6 +615,18 @@ def _admin(session, stmt: ast.AdminStmt) -> ResultSet:
             tbl = session.info_schema().table_by_name(tn.db or db, tn.name)
             check_table(session.store.get_snapshot(), tbl)
         return None
+    if stmt.tp == ast.AdminType.TPU_PROFILE_EXPORT:
+        # the most recently retained statement trace, as Perfetto-loadable
+        # Chrome trace-event JSON (same serializer TIDB_TPU_SLOW_TRACES'
+        # TRACE_EVENT_JSON column uses)
+        from tidb_tpu import flight
+        entries = flight.recorder_for(session.store).entries()
+        rows = []
+        if entries:
+            e = entries[-1]
+            rows.append([e["digest"], e["sql"][:256],
+                         flight.trace_event_json(e)])
+        return _str_rs(["DIGEST", "SQL", "TRACE_EVENT_JSON"], rows)
     raise errors.ExecError(f"unsupported ADMIN statement {stmt.tp!r}")
 
 

@@ -225,3 +225,11 @@ def trace_json(entry: dict) -> str:
     """The TRACE_JSON cell: the full span tree, compact."""
     return json.dumps(entry["trace"], sort_keys=True,
                       separators=(",", ":"))
+
+
+def trace_event_json(entry: dict) -> str:
+    """The TRACE_EVENT_JSON cell: the statement's cross-thread timeline
+    in Chrome trace-event form (Perfetto-loadable) — the span tree as
+    per-thread slices plus the dispatch-serial lock hold intervals."""
+    from tidb_tpu import profiler
+    return profiler.trace_event_json(entry)

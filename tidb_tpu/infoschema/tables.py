@@ -35,6 +35,8 @@ T_TPU_METRICS = -212
 T_TPU_METRICS_HISTORY = -213
 T_TPU_SLOW_TRACES = -214
 T_TPU_INSPECTION_RESULT = -215
+# kernel-level continuous profiler: windowed per-signature roofline
+T_TPU_KERNEL_PROFILE = -216
 
 
 def _col(i: int, name: str, tp: int = my.TypeVarchar,
@@ -153,7 +155,8 @@ def store_table_infos() -> list[TableInfo]:
             ("READBACK_BYTES", my.TypeLonglong, 21),
             ("ERROR", my.TypeVarchar, 512),
             ("SQL_TEXT", my.TypeVarchar, 2048),
-            ("TRACE_JSON", my.TypeVarchar, 1 << 20)]),
+            ("TRACE_JSON", my.TypeVarchar, 1 << 20),
+            ("TRACE_EVENT_JSON", my.TypeVarchar, 1 << 20)]),
         _tbl(T_TPU_INSPECTION_RESULT, "TIDB_TPU_INSPECTION_RESULT", [
             ("RULE", my.TypeVarchar, 64),
             ("ITEM", my.TypeVarchar, 64),
@@ -163,6 +166,22 @@ def store_table_infos() -> list[TableInfo]:
             ("DETAILS", my.TypeVarchar, 512),
             ("WINDOW_BEGIN", my.TypeDouble, 22),
             ("WINDOW_END", my.TypeDouble, 22)]),
+        _tbl(T_TPU_KERNEL_PROFILE, "TIDB_TPU_KERNEL_PROFILE", [
+            ("WINDOW_BEGIN", my.TypeDouble, 22),
+            ("WINDOW_END", my.TypeDouble, 22),
+            ("KIND", my.TypeVarchar, 64),
+            ("SIGNATURE", my.TypeVarchar, 128),
+            ("DISPATCHES", my.TypeLonglong, 21),
+            ("RETRACES", my.TypeLonglong, 21),
+            ("DEVICE_US", my.TypeLonglong, 21),
+            ("TRACE_US", my.TypeLonglong, 21),
+            ("EXECUTE_US", my.TypeLonglong, 21),
+            ("READBACK_BYTES", my.TypeLonglong, 21),
+            ("H2D_BYTES", my.TypeLonglong, 21),
+            ("PROCESSED_ROWS", my.TypeLonglong, 21),
+            ("BYTES_PER_DEVICE_SEC", my.TypeDouble, 22),
+            ("ROWS_PER_SEC", my.TypeDouble, 22),
+            ("BOUND", my.TypeVarchar, 16)]),
     ]
 
 
@@ -231,7 +250,28 @@ def _slow_trace_rows(store) -> list[list[Datum]]:
             Datum.f64(e["duration_ms"]), Datum.i64(e["span_count"]),
             Datum.i64(res.get("kernel_dispatches", 0)),
             Datum.i64(res.get("readback_bytes", 0)),
-            _s(e["error"]), _s(e["sql"]), _s(flight.trace_json(e))])
+            _s(e["error"]), _s(e["sql"]), _s(flight.trace_json(e)),
+            _s(flight.trace_event_json(e))])
+    return out
+
+
+def _kernel_profile_rows() -> list[list[Datum]]:
+    from tidb_tpu import inspection, profiler
+    window = int(inspection.threshold("window_samples"))
+    out: list[list[Datum]] = []
+    for r in profiler.profile_rows(window):
+        out.append([
+            Datum.f64(round(r["window_begin"], 3)),
+            Datum.f64(round(r["window_end"], 3)),
+            _s(r["kind"]), _s(r["signature"]),
+            Datum.i64(r["dispatches"]), Datum.i64(r["retraces"]),
+            Datum.i64(r["device_us"]), Datum.i64(r["trace_us"]),
+            Datum.i64(r["execute_us"]),
+            Datum.i64(r["readback_bytes"]), Datum.i64(r["h2d_bytes"]),
+            Datum.i64(r["rows"]),
+            Datum.f64(round(r["bytes_per_device_sec"], 3)),
+            Datum.f64(round(r["rows_per_sec"], 3)),
+            _s(r["bound"])])
     return out
 
 
@@ -257,6 +297,8 @@ def rows_for_store(store, table_id: int) -> list[list[Datum]]:
         return _slow_trace_rows(store)
     if table_id == T_TPU_INSPECTION_RESULT:
         return _inspection_rows()
+    if table_id == T_TPU_KERNEL_PROFILE:
+        return _kernel_profile_rows()
     if table_id == T_TPU_TOP_SQL:
         from tidb_tpu import perfschema as ps
         out: list[list[Datum]] = []

@@ -41,6 +41,10 @@ DEFAULTS: dict[str, float] = {
     # hbm-pressure (any over-budget reservation in the window fires
     # regardless — the ledger let it through, but it is evidence)
     "hbm_pressure_ratio": 0.85,
+    # kernel profiler: jit retraces of a SINGLE signature in the window
+    # above this fires retrace-storm (a hot signature is churning the
+    # jit cache — shape buckets too fine, or a cache cap too small)
+    "retrace_burst": 4,
 }
 
 SYSVAR_PREFIX = "tidb_tpu_inspection_"
@@ -224,6 +228,7 @@ def _rule_hbm_pressure(d: dict, begin: float, end: float) -> list:
     ratio = used / budget
     if ratio < threshold("hbm_pressure_ratio") and over < 1:
         return []
+    peak = d.get("device.hbm.hw.total", used)
     return [_result(
         "hbm-pressure", "ledger",
         "critical" if ratio >= 1.0 or over >= 1 else "warning",
@@ -231,16 +236,50 @@ def _rule_hbm_pressure(d: dict, begin: float, end: float) -> list:
         f"(pinned + reserved) / budget < "
         f"{threshold('hbm_pressure_ratio'):g}",
         f"{int(used)} of {int(budget)} budgeted HBM bytes in use "
-        f"({int(over)} over-budget reservations in the window) — "
+        f"(peak {int(peak)}, {int(over)} over-budget reservations in "
+        "the window) — "
         "oversized joins are partitioning into passes and the plane "
         "cache is skipping device pins; raise "
         "tidb_tpu_hbm_budget_bytes or shrink the pinned working set",
         begin, end)]
 
 
+def _rule_retrace_storm(d: dict, begin: float, end: float) -> list:
+    """One kernel signature is retracing over and over inside the
+    window: its jit cache entry keeps missing (an unstable shape leaking
+    past the capacity buckets, or a cache cap churning hot entries), so
+    the device pays compilation instead of execution. Evidence comes
+    from the profiler's per-signature metric families — the trace_us
+    share says how much of the signature's device time went to
+    retracing."""
+    from tidb_tpu import profiler
+    out = []
+    pre = profiler.METRIC_PREFIX
+    for name, delta in sorted(d.items()):
+        if not name.startswith(pre + "jit_misses."):
+            continue
+        if delta < threshold("retrace_burst"):
+            continue
+        label = name[len(pre + "jit_misses."):]
+        dev = d.get(f"{pre}device_us.{label}", 0.0)
+        trc = d.get(f"{pre}trace_us.{label}", 0.0)
+        share = (trc / dev) if dev > 0 else 0.0
+        out.append(_result(
+            "retrace-storm", label,
+            _severity(delta, threshold("retrace_burst")), int(delta),
+            f"< {threshold('retrace_burst'):g} retraces/window/signature",
+            f"signature {label} retraced {int(delta)}x in the window — "
+            f"{int(trc)}us of its {int(dev)}us device time "
+            f"({share:.0%}) went to tracing, not executing; stabilize "
+            "the shape buckets or raise the kernel cache caps",
+            begin, end))
+    return out
+
+
 RULES = (_rule_degradation_burst, _rule_cache_collapse,
          _rule_admission_saturation, _rule_batch_expiry_spike,
-         _rule_mesh_shard_skew, _rule_hbm_pressure)
+         _rule_mesh_shard_skew, _rule_hbm_pressure,
+         _rule_retrace_storm)
 
 
 def inspect(window: int | None = None) -> list[dict]:

@@ -166,6 +166,26 @@ def _fmt_le(b: float) -> str:
     return str(int(b)) if float(b) == int(b) else repr(float(b))
 
 
+def _prom_name(name: str) -> tuple[str, str]:
+    """(exposition name, label block) for one registry name. Names that
+    sanitize cleanly ('.' → '_') keep their historical flat form —
+    copr_degraded_mesh stays copr_degraded_mesh. Names whose dynamic
+    suffix is not metric-name-safe (the profiler's kind|signature
+    labels carry '|' and '/') split through the catalog's label model
+    instead: profiler.sig.device_us.<label> renders as
+    profiler_sig_device_us{kind="<label>"}. A non-family name with bad
+    characters hard-sanitizes as the last resort."""
+    import re
+    pname = name.replace(".", "_")
+    if re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", pname):
+        return pname, ""
+    from tidb_tpu.metrics import catalog
+    fam, labels = catalog.split_labels(name)
+    if labels and fam != name and '"' not in labels[len('kind="'):-1]:
+        return fam.replace(".", "_"), "{" + labels + "}"
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", pname), ""
+
+
 def render_text() -> str:
     """Prometheus text exposition of the default registry (the status
     HTTP port's /metrics; tidb-server/main.go:181 push-gateway analogue).
@@ -180,15 +200,20 @@ def render_text() -> str:
     lines = []
     with registry._lock:
         items = sorted(registry._metrics.items())
+    typed: set[str] = set()
     for name, m in items:
-        pname = name.replace(".", "_")
+        pname, lbl = _prom_name(name)
         if isinstance(m, Counter):
-            lines.append(f"# TYPE {pname} counter")
-            lines.append(f"{pname} {m.value}")
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname}{lbl} {m.value}")
             continue
         if isinstance(m, Gauge):
-            lines.append(f"# TYPE {pname} gauge")
-            lines.append(f"{pname} {m.value}")
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname}{lbl} {m.value}")
             continue
         bounds, cum, total_sum, total_count = m.snapshot_buckets()
         lines.append(f"# TYPE {pname} histogram")

@@ -125,12 +125,21 @@ CATALOG: dict[str, tuple[str, str]] = {
     "ops.kernel_seconds": ("histogram", "Wall time of one device dispatch + readback."),
     "device.busy_us": ("counter", "Cumulative microseconds the serialized device executed a program (metered inside kernels.dispatch_serial)."),
     "device.busy_fraction": ("gauge", "Fraction of the last metrics-recorder window the device was executing (device saturated vs host stalled)."),
+    # ---- kernel-level continuous profiler (tidb_tpu.profiler) ----
+    "profiler.sig.dispatches.": ("counter", "Kernel profiler: dispatches per (kind|signature) label."),
+    "profiler.sig.device_us.": ("counter", "Kernel profiler: metered device microseconds per (kind|signature) label (sums to device.busy_us)."),
+    "profiler.sig.trace_us.": ("counter", "Kernel profiler: device microseconds spent on dispatches that paid a jit trace+compile, per (kind|signature) label."),
+    "profiler.sig.jit_misses.": ("counter", "Kernel profiler: jit-cache misses (retraces) per (kind|signature) label — the retrace-storm rule's evidence."),
+    "profiler.sig.readback_bytes.": ("counter", "Kernel profiler: D2H readback bytes per (kind|signature) label."),
+    "profiler.sig.h2d_bytes.": ("counter", "Kernel profiler: H2D transfer bytes per (kind|signature) label."),
+    "profiler.sig.rows.": ("counter", "Kernel profiler: rows processed per (kind|signature) label."),
     # ---- HBM governance tier (ops.membudget) ----
     "device.hbm.budget": ("gauge", "Resolved HBM budget in bytes (tidb_tpu_hbm_budget_bytes; 0 = unlimited/kill switch)."),
     "device.hbm.reserved": ("gauge", "Bytes currently reserved by in-flight dispatch working sets (joins, batched dispatches, kernel inputs)."),
     "device.hbm.pinned": ("gauge", "Bytes of device-resident pinned planes charged to the ledger (plane cache + batch planes)."),
     "device.hbm.headroom": ("gauge", "Bytes a new reservation may take before crossing the budget (0 when unlimited)."),
     "device.hbm.over_budget": ("counter", "Reservations that proceeded past the configured HBM budget (the hbm-pressure rule's evidence)."),
+    "device.hbm.hw.": ("gauge", "HBM ledger high-water marks by reservation kind (join/dispatch/...; 'pinned' tracks the pin ledger, 'total' the reserved+pinned combined peak)."),
     "copr.partitioned_joins": ("counter", "Joins whose build side exceeded the HBM headroom and took the radix-partitioned out-of-core route."),
     "copr.partitioned_passes": ("counter", "Partition executions of out-of-core joins (single-device passes, or per-shard partitions of the key-partitioned mesh probe)."),
     "copr.plane_cache.pin_skipped": ("counter", "Plane-cache admissions that skipped the device pin because pinning would cross the HBM budget."),

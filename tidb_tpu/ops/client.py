@@ -75,6 +75,18 @@ def pin_batch_device(batch) -> None:
     kernels.device_live(batch)
 
 
+def _planes_capacity(planes) -> int:
+    """Row capacity of a dispatch's plane set — the leading axis of the
+    first array found. Planes arrive as {cid: (values, valid)} dicts or
+    plain sequences depending on the kernel family."""
+    ents = planes.values() if hasattr(planes, "values") else planes
+    for ent in ents:
+        a = ent[0] if isinstance(ent, tuple) else ent
+        if a is not None and getattr(a, "shape", None):
+            return int(a.shape[0])
+    return 0
+
+
 class _SingleResponse(kv.Response):
     def __init__(self, resp: SelectResponse):
         self._resp = resp
@@ -502,8 +514,9 @@ class TpuClient(kv.Client):
             # The dispatch's transient working set charges the HBM
             # governance ledger for its duration (device.hbm.reserved)
             from tidb_tpu.ops import membudget
-            with membudget.reserve(
-                    membudget.planes_nbytes(planes, live, extra), kind):
+            h2d = membudget.planes_nbytes(planes, live, extra)
+            cap = _planes_capacity(planes)
+            with membudget.reserve(h2d, kind):
                 with kernels.dispatch_serial:
                     packed = jitted(planes, live, *extra)
                     t_disp = _time.perf_counter()
@@ -513,6 +526,11 @@ class TpuClient(kv.Client):
                                            f"injected readback failure "
                                            f"({kind})"))
                     host = np.asarray(packed)
+                    kernels.dispatch_serial.annotate(
+                        kind, f"{len(planes)}pl/{cap}",
+                        rows=(attrs or {}).get("rows", cap),
+                        readback_bytes=int(host.nbytes), h2d_bytes=h2d,
+                        jit_miss=first)
         except errors.TiDBError:
             sp.set("error", "fault").finish()   # a dead span must not
             raise                               # bleed to statement end
@@ -529,6 +547,7 @@ class TpuClient(kv.Client):
         sp.set("dispatch_us", round((t_disp - t0) * 1e6, 1))
         sp.set("readbacks", 1)
         sp.set("readback_bytes", nbytes)
+        sp.set("rows", (attrs or {}).get("rows", cap))
         sp.finish()
         tracing.record_dispatch(readback_bytes=nbytes,
                                 dispatch_us=(t1 - t0) * 1e6)

@@ -59,22 +59,43 @@ class _MeteredDispatchLock:
     dispatch; held-time is single-holder by construction so the _t0
     attribute needs no extra lock."""
 
-    __slots__ = ("_lock", "_t0")
+    __slots__ = ("_lock", "_t0", "_ann")
 
     def __init__(self):
         self._lock = _threading.Lock()
         self._t0 = 0.0
+        self._ann = None
+
+    def annotate(self, kind: str, sig: str, rows: int = 0,
+                 readback_bytes: int = 0, h2d_bytes: int = 0,
+                 jit_miss: bool = False) -> None:
+        """Attribute the CURRENT hold to a (kernel kind, structural
+        signature) for the continuous profiler. Call INSIDE the
+        with-block (after the readback, when its byte count is known);
+        single-holder by construction, so the slot needs no extra lock.
+        An unannotated hold still publishes (under other|~unannotated)
+        so per-signature device_us always sums to device.busy_us."""
+        self._ann = (kind, sig, int(rows), int(readback_bytes),
+                     int(h2d_bytes), bool(jit_miss))
 
     def __enter__(self):
         self._lock.acquire()
         self._t0 = _time.perf_counter()
+        self._ann = None
         return self
 
     def __exit__(self, *exc):
-        held_us = (_time.perf_counter() - self._t0) * 1e6
+        t0 = self._t0
+        held_us = (_time.perf_counter() - t0) * 1e6
+        ann, self._ann = self._ann, None
         self._lock.release()
-        from tidb_tpu import metrics
-        metrics.counter("device.busy_us").inc(int(held_us))
+        from tidb_tpu import metrics, profiler
+        # ONE truncated figure feeds both surfaces: the reconciliation
+        # contract (Σ per-signature device_us == Δdevice.busy_us over a
+        # window) holds exactly, never modulo rounding
+        us = int(held_us)
+        metrics.counter("device.busy_us").inc(us)
+        profiler.publish(ann, us, t0_us=t0 * 1e6)
         return False
 
     # Lock-protocol passthrough for any caller not using `with`
@@ -294,6 +315,7 @@ def delta_merge_order(handles: np.ndarray, live: np.ndarray,
     k_cap = col.bucket_capacity(len(app_handles), minimum=64)
     key = (cap, m_cap, k_cap)
     ent = _delta_merge_cache.get(key)
+    miss = ent is None
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
 
@@ -337,6 +359,11 @@ def delta_merge_order(handles: np.ndarray, live: np.ndarray,
                 jnp.asarray(app_lv))
         with dispatch_serial:
             host = np.asarray(ent(*args))
+            dispatch_serial.annotate(
+                "delta_merge", f"{cap}/{m_cap}/{k_cap}", rows=cap,
+                readback_bytes=int(host.nbytes),
+                h2d_bytes=sum(int(a.nbytes) for a in args),
+                jit_miss=miss)
     except _errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -1073,6 +1100,7 @@ def combine_region_partials(states: list[np.ndarray],
     key = (tuple(ops),
            tuple((s.shape, np.dtype(s.dtype).char) for s in states))
     ent = _combine_cache.get(key)
+    miss = ent is None
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
         ops_t = tuple(ops)
@@ -1106,6 +1134,12 @@ def combine_region_partials(states: list[np.ndarray],
         dev = tuple(jnp.asarray(s) for s in states)
         with dispatch_serial:
             host = np.asarray(jitted(dev, None))
+            dispatch_serial.annotate(
+                "combine", f"{len(states)}st/{int(states[0].shape[0])}r",
+                rows=int(states[0].shape[0]),
+                readback_bytes=int(host.nbytes),
+                h2d_bytes=sum(int(s.nbytes) for s in states),
+                jit_miss=miss)
     except _errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -1190,6 +1224,7 @@ def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
     kelems, forms_t, progs_t = _states_spec_forms(specs)
     key = (kelems, G, n)
     ent = _region_states_cache.get(key)
+    miss = ent is None
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
 
@@ -1262,6 +1297,11 @@ def region_agg_states(gid: np.ndarray, specs: list, G: int) -> list:
             arrs.append(jnp.asarray(np.asarray(ok, bool)))
         with dispatch_serial:
             host = np.asarray(jitted(tuple(arrs), None))
+            dispatch_serial.annotate(
+                "agg_states", f"{len(specs)}st/{G}g/{n}r", rows=n,
+                readback_bytes=int(host.nbytes),
+                h2d_bytes=sum(int(a.nbytes) for a in arrs),
+                jit_miss=miss)
     except _errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -1356,6 +1396,7 @@ def region_agg_states_batched(segs: list) -> list:
     S_total = off
     key = (kelems, Gbs, ns)
     ent = _batched_states_cache.get(key)
+    miss = ent is None
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
         offs_t = tuple(offs)
@@ -1456,6 +1497,12 @@ def region_agg_states_batched(segs: list) -> list:
             arrs.extend(okplanes)
         with dispatch_serial:
             host = np.asarray(jitted(tuple(arrs), None))
+            dispatch_serial.annotate(
+                "agg_states_batch",
+                f"{len(forms_t)}st/{R}rg/{S_total}g", rows=n_rows,
+                readback_bytes=int(host.nbytes),
+                h2d_bytes=sum(int(a.nbytes) for a in arrs),
+                jit_miss=miss)
     except _errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -1527,6 +1574,7 @@ def region_filter_batched(segs: list) -> list:
     fkeys = tuple(s[0] for s in segs)
     key = (fkeys, caps, cids_t)
     ent = _batched_filter_cache.get(key)
+    miss = ent is None
     _tracing.record_jit_cache(hit=ent is not None)
     if ent is None:
         compiled_t = tuple(s[1] for s in segs)
@@ -1572,6 +1620,11 @@ def region_filter_batched(segs: list) -> list:
                 args.append(jnp.asarray(valid))
         with dispatch_serial:
             host = np.asarray(jitted(*args))
+            dispatch_serial.annotate(
+                "filter_batch", f"{R}rg/{sum(caps)}cap", rows=n_rows,
+                readback_bytes=int(host.nbytes),
+                h2d_bytes=sum(int(a.nbytes) for a in args),
+                jit_miss=miss)
     except _errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -1774,6 +1827,10 @@ def join_match_pairs(lkey, lvalid, rkey, rvalid, stats=None,
                                                       lk_d, lv_d,
                                                       out_cap=out_cap,
                                                       narrow=narrow))
+                dispatch_serial.annotate(
+                    "join_probe", f"{lcap}l/{rcap}r/{out_cap}cap",
+                    rows=lcap, readback_bytes=int(packed.nbytes),
+                    h2d_bytes=int(lk_d.nbytes) + int(lv_d.nbytes))
             rb_bytes += int(packed.nbytes)
             rb_count += 1
             if narrow:

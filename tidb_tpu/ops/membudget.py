@@ -90,7 +90,55 @@ _budget_resolved: int | None = None     # cached auto resolution
 _reserved = 0
 _pinned = 0
 
+# reservation waterfall: current + high-water bytes per reservation
+# KIND (dispatch/join/join_pass/...; "pinned" tracks the pin ledger) and
+# the combined reserved+pinned peak — the profiler's HBM telemetry. The
+# marks publish as device.hbm.hw.* gauges so the MetricsRecorder samples
+# them into TIDB_TPU_METRICS_HISTORY and the hbm-pressure inspection
+# rule can cite the actual peak instead of the instantaneous gauge.
+_res_by_kind: dict = {}
+_hw_by_kind: dict = {}
+_hw_total = 0
+_hw_gauges: dict = {}
+
 _gauges = None
+
+
+def _hw_note_locked(kind: str, current: int) -> None:
+    global _hw_total
+    if current > _hw_by_kind.get(kind, 0):
+        _hw_by_kind[kind] = current
+        g = _hw_gauges.get(kind)
+        if g is None:
+            from tidb_tpu import metrics
+            g = _hw_gauges[kind] = metrics.gauge(f"device.hbm.hw.{kind}")
+        g.set(current)
+    total = _reserved + _pinned
+    if total > _hw_total:
+        _hw_total = total
+        g = _hw_gauges.get("total")
+        if g is None:
+            from tidb_tpu import metrics
+            g = _hw_gauges["total"] = metrics.gauge("device.hbm.hw.total")
+        g.set(total)
+
+
+def highwater() -> dict:
+    """{kind: high-water bytes} since start/reset, plus "total" — the
+    reserved+pinned combined peak."""
+    with _lock:
+        d = dict(_hw_by_kind)
+        d["total"] = _hw_total
+        return d
+
+
+def reset_highwater() -> None:
+    global _hw_total
+    with _lock:
+        _hw_by_kind.clear()
+        _hw_total = 0
+        for g in _hw_gauges.values():
+            g.set(0)
 
 
 def _g():
@@ -184,6 +232,7 @@ def pin(nbytes: int) -> None:
     global _pinned
     with _lock:
         _pinned += int(nbytes)
+        _hw_note_locked("pinned", _pinned)
         _publish_locked()
 
 
@@ -221,6 +270,9 @@ class _Reservation:
             over = budget > 0 and \
                 _reserved + _pinned + self.nbytes > budget
             _reserved += self.nbytes
+            cur = _res_by_kind.get(self.kind, 0) + self.nbytes
+            _res_by_kind[self.kind] = cur
+            _hw_note_locked(self.kind, cur)
             _publish_locked()
         if over:
             import logging
@@ -238,6 +290,8 @@ class _Reservation:
         global _reserved
         with _lock:
             _reserved = max(_reserved - self.nbytes, 0)
+            _res_by_kind[self.kind] = max(
+                _res_by_kind.get(self.kind, 0) - self.nbytes, 0)
             _publish_locked()
         return False
 

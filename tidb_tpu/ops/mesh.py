@@ -404,6 +404,10 @@ def combine_rows_sharded(mesh, specs, gid, G: int, slices,
         with kernels.dispatch_serial:
             packed = jitted(tuple(planes), None)
             host = np.asarray(packed)
+            kernels.dispatch_serial.annotate(
+                "mesh_combine", f"{mesh.n}sh/{len(specs)}st/{G}g",
+                rows=n, readback_bytes=int(host.nbytes),
+                h2d_bytes=int(h2d))
     except errors.TiDBError:
         sp.set("error", "fault").finish()
         raise
@@ -465,6 +469,7 @@ def combine_states_sharded(states, ops, mesh,
            tuple((b.shape, np.dtype(b.dtype).char) for b in blocks))
     with _lock:
         ent = _combine_cache.get(key)
+    miss = ent is None
     if ent is None:
         ops_t = tuple(ops)
 
@@ -492,6 +497,11 @@ def combine_states_sharded(states, ops, mesh,
         dev = tuple(jnp.asarray(b) for b in blocks)
         with kernels.dispatch_serial:
             host = np.asarray(jitted(dev, None))
+            kernels.dispatch_serial.annotate(
+                "mesh_combine_states", f"{S}sh/{len(blocks)}st/{R}r",
+                rows=R, readback_bytes=int(host.nbytes),
+                h2d_bytes=sum(int(b.nbytes) for b in blocks),
+                jit_miss=miss)
     except errors.TiDBError:
         raise
     except Exception as e:
@@ -842,6 +852,11 @@ def join_probe_partitioned(mesh, lkey, lvalid, rkey, rvalid, stats=None):
             fn = _partitioned_probe_fn(mesh, out_cap, narrow)
             with kernels.dispatch_serial:
                 packed = np.asarray(fn(*args))
+                kernels.dispatch_serial.annotate(
+                    "mesh_kprobe", f"{S}sh/{lcap_s}l/{rcap_s}r",
+                    rows=int(lkey.shape[0]),
+                    readback_bytes=int(packed.nbytes),
+                    h2d_bytes=int(h2d))
             rb_bytes += int(packed.nbytes)
             rb_count += 1
             blk, totals = _shard_block_totals(packed, S, out_cap, narrow)
@@ -922,6 +937,10 @@ def join_probe_sharded(mesh, rs, order, n_valid, lk_d, lv_d, lcap: int,
         from tidb_tpu.ops import kernels
         with kernels.dispatch_serial:
             packed = np.asarray(fn(rs, order, n_valid, lk_d, lv_d))
+            kernels.dispatch_serial.annotate(
+                "mesh_probe", f"{S}sh/{lcap}l/{rcap}r/{out_cap}cap",
+                rows=lcap, readback_bytes=int(packed.nbytes),
+                h2d_bytes=int(lk_d.nbytes) + int(lv_d.nbytes))
         rb_bytes += int(packed.nbytes)
         rb_count += 1
         blk, totals = _shard_block_totals(packed, S, out_cap, narrow)

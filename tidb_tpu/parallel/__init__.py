@@ -72,6 +72,7 @@ class CoprMesh:
                 f"batch capacity {live.shape[0]} not divisible by mesh "
                 f"size {self.n}")
         ent = self._jit_cache.get(id(fn))
+        miss = ent is None or ent[0] is not fn
         if ent is None or ent[0] is not fn:
             if self.n == 1:
                 # axis of one: partials are already totals — no shard_map,
@@ -94,8 +95,13 @@ class CoprMesh:
             if len(self._jit_cache) > 256:
                 self._jit_cache.pop(next(iter(self._jit_cache)))
         live_d = jnp.asarray(live)
+        cap = int(live.shape[0])
         with _kernels.dispatch_serial:
             packed = np.asarray(ent[2](planes, live_d))
+            _kernels.dispatch_serial.annotate(
+                "mesh_run", f"{self.n}sh/{len(planes)}pl/{cap}",
+                rows=cap, readback_bytes=int(packed.nbytes),
+                jit_miss=miss)
         return _kernels.unpack_outputs(ent[1], packed)
 
     # the client calls these; signatures match the single-chip jit path
@@ -134,6 +140,7 @@ class CoprMesh:
                 f"batch capacity {live.shape[0]} not divisible by mesh "
                 f"size {self.n}")
         ent = self._jit_cache.get(key)
+        miss = ent is None or ent[0] is not fn
         if ent is None or ent[0] is not fn:
             if self.n == 1:
                 sharded = lambda planes, live: tuple(fn(planes, live))
@@ -148,6 +155,11 @@ class CoprMesh:
             if len(self._jit_cache) > 256:
                 self._jit_cache.pop(next(iter(self._jit_cache)))
         live_d = jnp.asarray(live)
+        cap = int(live.shape[0])
         with _kernels.dispatch_serial:
             packed = np.asarray(ent[2](planes, live_d))
+            _kernels.dispatch_serial.annotate(
+                f"mesh_{key[0]}", f"{self.n}sh/{len(planes)}pl/{cap}",
+                rows=cap, readback_bytes=int(packed.nbytes),
+                jit_miss=miss)
         return _kernels.unpack_outputs(ent[1], packed)

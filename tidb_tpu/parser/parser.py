@@ -1186,6 +1186,13 @@ class Parser:
         if self._try_kw("SHOW"):
             self._ident("ddl")  # ADMIN SHOW DDL
             return ast.AdminStmt(tp=ast.AdminType.SHOW_DDL)
+        if self._try_word("TPU"):
+            # ADMIN TPU PROFILE EXPORT: the most recently retained
+            # statement trace as Chrome trace-event JSON
+            if not (self._try_word("PROFILE")
+                    and self._try_word("EXPORT")):
+                self._fail("expected PROFILE EXPORT")
+            return ast.AdminStmt(tp=ast.AdminType.TPU_PROFILE_EXPORT)
         self._expect_kw("CHECK")
         self._expect_kw("TABLE")
         tables = [self._parse_table_name()]

@@ -116,6 +116,10 @@ def _digest_table(tid: int, name: str) -> TableInfo:
         ("ROWS_SENT", my.TypeLonglong, 21),
         ("ROWS_AFFECTED", my.TypeLonglong, 21),
     ] + [(n, my.TypeLonglong, 21) for n, _k in RESOURCE_COLS] + [
+        # top kernel signature by accumulated device time — rolled up
+        # from the same per-statement kprof.* tallies the columns above
+        # come from (kernel profiler, tidb_tpu.profiler)
+        ("PROFILE", my.TypeVarchar, 160),
         ("FIRST_SEEN", my.TypeLonglong, 21),
         ("LAST_SEEN", my.TypeLonglong, 21),
         ("QUERY_SAMPLE_TEXT", my.TypeBlob, 1024),
@@ -517,6 +521,13 @@ def _digest_rows(windows: list[tuple]) -> list[list[Datum]]:
                    Datum.i64(e.rows_sent), Datum.i64(e.rows_affected)]
             row.extend(Datum.i64(e.res.get(key, 0))
                        for _n, key in RESOURCE_COLS)
+            kprof = {k[6:]: v for k, v in e.res.items()
+                     if k.startswith("kprof.")}
+            if kprof:
+                from tidb_tpu import profiler
+                row.append(_b(profiler.top_signature(kprof)))
+            else:
+                row.append(NULL)
             row.extend([Datum.i64(int(e.first_seen)),
                         Datum.i64(int(e.last_seen)),
                         _b(e.sample_sql), _b(e.sample_plan)])

@@ -1,0 +1,310 @@
+"""Kernel-level continuous profiler: per-(kind, signature) roofline
+attribution off the metered dispatch lock, cross-thread Chrome
+trace-event export, and the dispatch-serial hold ring.
+
+Reference: TiDB's "continuous profiling" diagnostics lineage (TOP-SQL
+attributes device time per digest; conprof keeps flame-level detail
+always-on), and PIMDAL's memory-bottleneck framing — per kernel family
+the question is whether the tunnel (readback) or the device (compute)
+bounds it, which a flat `device.busy_us` cannot answer. Here every
+launch+readback in the engine already serializes on
+`kernels.dispatch_serial`; that choke point is the ONE publish site:
+
+* Call sites annotate the current hold with
+  `dispatch_serial.annotate(kind, sig, rows=..., readback_bytes=...,
+  h2d_bytes=..., jit_miss=...)` INSIDE the with-block (single-holder by
+  construction, so the annotation slot needs no extra lock).
+* The lock's `__exit__` computes ONE truncated microsecond figure and
+  feeds it to both `device.busy_us` and `publish()` — so
+  Σ per-signature device_us ≡ the `device.busy_us` delta over any
+  recorder window, exactly (the reconciliation test asserts it under
+  concurrent sessions). Unannotated holds publish under
+  `other|~unannotated` so the sum still closes.
+* `publish()` fans one figure into three surfaces with no second
+  accounting path: the bounded signature registry (cumulative), the
+  `profiler.sig.<field>.<kind>|<sig>` dynamic counter families (so the
+  PR 10 MetricsRecorder windows/deltas them for free — the
+  TIDB_TPU_KERNEL_PROFILE table and the retrace-storm inspection rule
+  both read `recorder.sample_window`), and the per-THREAD signature
+  tally (tracing.kernel_profile_note) the statement layer diffs into
+  its `profile:` clause.
+
+Roofline verdict: a signature moving readback bytes at a rate near the
+calibrated tunnel bandwidth is READBACK-BOUND — shrinking its output
+(bit-packing, states-not-rows) is the win; otherwise it is
+COMPUTE-BOUND and only a faster kernel helps.
+
+Kill switch: SET GLOBAL tidb_tpu_kernel_profile = 0 stops everything —
+no registry entries, no counters, no per-thread dicts, no hold-ring
+appends (the overhead guard asserts zero retained allocations off).
+GLOBAL-only, persisted, hydrated; tidb_tpu_profile_max_signatures
+bounds the registry (overflow folds into `<kind>|~overflow`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict, deque
+
+# Calibrated tunnel (D2H readback) bandwidth, GB/s. The real rig's
+# post-D2H copy-sweep (bench.py measure_hbm_peak / BENCH_r05) is the
+# calibration source; re-stamp with set_tunnel_gbps() when a rig round
+# measures a different tunnel.
+TUNNEL_GBPS = 1.0
+# a signature is READBACK-BOUND when its achieved D2H rate exceeds this
+# fraction of the tunnel (at half the tunnel, the readback already
+# dominates a kernel overlapped with compute)
+READBACK_BOUND_FRACTION = 0.5
+
+METRIC_PREFIX = "profiler.sig."
+# per-signature counter families published under METRIC_PREFIX —
+# field order is the registry-entry layout
+FIELDS = ("dispatches", "device_us", "trace_us", "jit_misses",
+          "readback_bytes", "h2d_bytes", "rows")
+_F_INDEX = {f: i for i, f in enumerate(FIELDS)}
+
+_lock = threading.Lock()
+_enabled = True
+_max_signatures = 256
+# label "<kind>|<sig>" → [counts per FIELDS..., metric-counter tuple]
+_registry: "OrderedDict[str, list]" = OrderedDict()
+# recent dispatch-serial hold intervals (perf_counter µs): the device
+# lane of the trace-event export
+_holds: deque = deque(maxlen=4096)
+# tid → thread name, for Perfetto thread_name metadata (pool workers
+# register themselves; the exporting thread registers as "statement")
+_thread_names: dict[int, str] = {}
+
+
+def set_enabled(on: bool) -> None:
+    """The tidb_tpu_kernel_profile kill switch. OFF clears everything
+    retained (registry, hold ring, thread names) — the documented
+    zero-retention contract of every diagnostics kill switch here."""
+    global _enabled
+    with _lock:
+        _enabled = bool(on)
+        if not _enabled:
+            _registry.clear()
+            _holds.clear()
+            _thread_names.clear()
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def set_max_signatures(n: int) -> None:
+    global _max_signatures
+    with _lock:
+        _max_signatures = max(1, int(n))
+        while len(_registry) > _max_signatures:
+            _registry.popitem(last=False)
+
+
+def set_tunnel_gbps(gbps: float) -> None:
+    global TUNNEL_GBPS
+    TUNNEL_GBPS = max(1e-6, float(gbps))
+
+
+def register_thread(name: str | None = None) -> None:
+    """Record this thread's lane name for the trace-event export
+    (drain-pool workers call it at spawn; the export thread labels
+    itself). A no-op while the profiler is off."""
+    if not _enabled:
+        return
+    tid = threading.get_ident()
+    with _lock:
+        _thread_names[tid] = name or threading.current_thread().name
+
+
+def publish(ann, us: int, t0_us: float = 0.0) -> None:
+    """One metered hold, published everywhere at once. `ann` is the
+    tuple the lock's annotate() captured (or None), `us` the SAME
+    truncated integer device.busy_us was incremented by."""
+    if not _enabled:
+        return
+    if ann is None:
+        kind, sig, rows, rb, h2d, miss = \
+            "other", "~unannotated", 0, 0, 0, False
+    else:
+        kind, sig, rows, rb, h2d, miss = ann
+    label = f"{kind}|{sig}"
+    with _lock:
+        if not _enabled:        # racing the kill switch
+            return
+        ent = _registry.get(label)
+        if ent is None:
+            if len(_registry) >= _max_signatures:
+                # fold past-cap signatures per kind so the registry —
+                # and the metric families it mirrors into — stay
+                # bounded while the device_us sum still closes
+                label = f"{kind}|~overflow"
+                ent = _registry.get(label)
+            if ent is None:
+                ent = _registry[label] = [0] * len(FIELDS) + [None]
+                while len(_registry) > _max_signatures + 1:
+                    _registry.popitem(last=False)
+        ent[0] += 1
+        ent[1] += us
+        if miss:
+            ent[2] += us
+            ent[3] += 1
+        ent[4] += rb
+        ent[5] += h2d
+        ent[6] += rows
+        ctrs = ent[-1]
+        if ctrs is None:
+            from tidb_tpu import metrics
+            ctrs = ent[-1] = tuple(
+                metrics.counter(f"{METRIC_PREFIX}{f}.{label}")
+                for f in FIELDS)
+        if t0_us:
+            _holds.append((t0_us, float(us), label))
+    # counter objects are individually locked — no need to hold _lock
+    ctrs[0].inc(1)
+    ctrs[1].inc(us)
+    if miss:
+        ctrs[2].inc(us)
+        ctrs[3].inc(1)
+    if rb:
+        ctrs[4].inc(rb)
+    if h2d:
+        ctrs[5].inc(h2d)
+    if rows:
+        ctrs[6].inc(rows)
+    from tidb_tpu import tracing
+    tracing.kernel_profile_note(label, us)
+
+
+def classify(readback_bytes: float, device_us: float) -> str:
+    """Roofline verdict for one signature over one window."""
+    if device_us <= 0:
+        return "idle"
+    bps = readback_bytes / (device_us / 1e6)
+    if bps >= READBACK_BOUND_FRACTION * TUNNEL_GBPS * 1e9:
+        return "readback-bound"
+    return "compute-bound"
+
+
+def registry_snapshot() -> dict[str, dict]:
+    """Cumulative per-signature totals since enable (label → field
+    dict) — the bench summary and tests read this."""
+    with _lock:
+        return {label: dict(zip(FIELDS, ent[:len(FIELDS)]))
+                for label, ent in _registry.items()}
+
+
+def profile_rows(window: int = 30) -> list[dict]:
+    """Windowed per-signature profile via the metrics recorder (deltas
+    over the trailing `window` samples — the same mechanism every
+    inspection rule uses), with the derived roofline columns. Feeds
+    information_schema.TIDB_TPU_KERNEL_PROFILE."""
+    from tidb_tpu.metrics import timeseries
+    d, begin, end = timeseries.recorder.sample_window(window)
+    sigs: dict[str, dict] = {}
+    for name, delta in d.items():
+        if not name.startswith(METRIC_PREFIX):
+            continue
+        field, _, label = name[len(METRIC_PREFIX):].partition(".")
+        if field not in _F_INDEX or not label:
+            continue
+        sigs.setdefault(label, dict.fromkeys(FIELDS, 0.0))[field] = delta
+    out = []
+    for label, f in sigs.items():
+        if f["dispatches"] <= 0 and f["device_us"] <= 0:
+            continue
+        kind, _, sig = label.partition("|")
+        dev_s = f["device_us"] / 1e6
+        out.append({
+            "window_begin": begin, "window_end": end,
+            "kind": kind, "signature": sig,
+            "dispatches": int(f["dispatches"]),
+            "retraces": int(f["jit_misses"]),
+            "device_us": int(f["device_us"]),
+            "trace_us": int(f["trace_us"]),
+            "execute_us": int(f["device_us"] - f["trace_us"]),
+            "readback_bytes": int(f["readback_bytes"]),
+            "h2d_bytes": int(f["h2d_bytes"]),
+            "rows": int(f["rows"]),
+            "bytes_per_device_sec":
+                f["readback_bytes"] / dev_s if dev_s > 0 else 0.0,
+            "rows_per_sec": f["rows"] / dev_s if dev_s > 0 else 0.0,
+            "bound": classify(f["readback_bytes"], f["device_us"]),
+        })
+    out.sort(key=lambda r: -r["device_us"])
+    return out
+
+
+def top_signature(kprof: dict) -> str:
+    """The `profile:` clause body from one statement's per-thread
+    signature tally delta: `<kind>|<sig>:<device_us>us` of the top
+    signature by device time ('' when the statement dispatched
+    nothing)."""
+    if not kprof:
+        return ""
+    label, us = max(kprof.items(), key=lambda kv: kv[1])
+    return f"{label}:{int(us)}us"
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event (Perfetto-loadable) export of one retained trace
+# ---------------------------------------------------------------------------
+
+def trace_events(doc: dict) -> dict:
+    """Convert one flight-recorder span-tree document into the Chrome
+    trace-event JSON object Perfetto loads directly: every span is a
+    complete ("X") slice on its OWN thread's lane (Span stamps the
+    creating thread's id; fan-out workers re-stamp their region task),
+    span attrs ride `args`, the dispatch-serial hold ring contributes a
+    synthetic `device-serial` lane (tid 0) for the holds inside the
+    statement's time window, and thread_name metadata labels the lanes
+    the drain pool registered."""
+    events: list[dict] = []
+    tids: set[int] = set()
+    root_tid = int(doc.get("tid", 1) or 1)
+    t_lo = float(doc.get("start_us", 0.0))
+    t_hi = t_lo + float(doc.get("duration_us", 0.0))
+
+    def walk(d: dict, parent_tid: int) -> None:
+        tid = int(d.get("tid", parent_tid) or parent_tid)
+        ts = float(d.get("start_us", t_lo))
+        ev = {"ph": "X", "cat": "span", "name": str(d.get("name", "?")),
+              "pid": 1, "tid": tid, "ts": round(ts - t_lo, 3),
+              "dur": round(float(d.get("duration_us", 0.0)), 3)}
+        attrs = d.get("attrs")
+        if attrs:
+            ev["args"] = attrs
+        events.append(ev)
+        tids.add(tid)
+        for c in d.get("children", ()):
+            walk(c, tid)
+
+    walk(doc, root_tid)
+    with _lock:
+        holds = list(_holds)
+        names = dict(_thread_names)
+    for t0_us, dur_us, label in holds:
+        if t0_us + dur_us < t_lo or t0_us > t_hi:
+            continue
+        events.append({"ph": "X", "cat": "device", "name": label,
+                       "pid": 1, "tid": 0,
+                       "ts": round(t0_us - t_lo, 3),
+                       "dur": round(dur_us, 3),
+                       "args": {"lane": "dispatch-serial hold"}})
+        tids.add(0)
+    meta = [{"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+             "args": {"name": "device-serial"}}]
+    for tid in sorted(tids - {0}):
+        name = names.get(tid,
+                         "statement" if tid == root_tid else f"thread-{tid}")
+        meta.append({"ph": "M", "pid": 1, "tid": tid,
+                     "name": "thread_name", "args": {"name": name}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def trace_event_json(entry: dict) -> str:
+    """The TRACE_EVENT_JSON cell / ADMIN TPU PROFILE EXPORT payload for
+    one flight-recorder entry."""
+    return json.dumps(trace_events(entry["trace"]),
+                      separators=(",", ":"))

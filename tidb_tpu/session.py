@@ -89,7 +89,21 @@ def _detail_str(res: dict) -> str:
     for key in tracing.COUNTER_KEYS:
         if res.get(key):
             parts.append(f"{key}:{res[key]}")
+    top = _profile_clause(res)
+    if top:
+        parts.append(top)
     return " ".join(parts)
+
+
+def _profile_clause(res: dict) -> str:
+    """``profile:<kind>|<sig>:<us>us`` — the statement's top kernel
+    signature by device time, read straight from the per-thread kprof
+    tally riding the resource dict (no second accounting path)."""
+    kprof = {k[6:]: v for k, v in res.items() if k.startswith("kprof.")}
+    if not kprof:
+        return ""
+    from tidb_tpu import profiler
+    return f"profile:{profiler.top_signature(kprof)}"
 
 
 class Session:
@@ -309,6 +323,7 @@ class Session:
         from tidb_tpu.distsql import thread_columnar_counts
         ch0, cf0, cp0 = thread_columnar_counts()
         tally0 = tracing.counters_snapshot()
+        kprof0 = tracing.kernel_profile_snapshot()
         t0 = _time.perf_counter()
         from tidb_tpu.sqlast import ShowStmt, ShowType
         if self._exec_depth == 0 and \
@@ -351,7 +366,7 @@ class Session:
             try:
                 rs = self._execute_one_inner(stmt, sql_text, record_history)
             except Exception as e:
-                res = self._exec_resources(ch0, cf0, cp0, tally0)
+                res = self._exec_resources(ch0, cf0, cp0, tally0, kprof0)
                 ps.end_statement(ev, error=str(e),
                                  detail=_detail_str(res))
                 # errored statements are workload too: their digest rows
@@ -374,7 +389,7 @@ class Session:
                 root.finish()
                 if trace_on:
                     self.last_trace = root
-        res = self._exec_resources(ch0, cf0, cp0, tally0)
+        res = self._exec_resources(ch0, cf0, cp0, tally0, kprof0)
         n_sent = len(rs.rows) if rs is not None else 0
         ps.end_statement(ev, rows_sent=n_sent,
                          rows_affected=self.vars.affected_rows,
@@ -418,13 +433,15 @@ class Session:
                   reason=reason, root=root, resources=res, error=error)
 
     def _exec_resources(self, ch0: int, cf0: int, cp0: int,
-                        tally0: dict) -> dict:
+                        tally0: dict, kprof0: dict | None = None) -> dict:
         """One statement's resource deltas — the always-on per-thread
         tallies (columnar channel + device kernels + cache/backoff/
         degradation) diffed over the statement. Computed ONCE at
         statement end; every consumer (perfschema EXECUTION_DETAIL, the
         digest summary, the slow log) reads this same dict, so the
-        surfaces cannot disagree."""
+        surfaces cannot disagree. The kernel-profiler per-thread tally
+        rides the same dict as int-valued ``kprof.<kind>|<sig>`` keys —
+        the statement's profile clause has no second accounting path."""
         from tidb_tpu import tracing
         from tidb_tpu.distsql import thread_columnar_counts
         ch1, cf1, cp1 = thread_columnar_counts()
@@ -432,6 +449,9 @@ class Session:
                "columnar_fallbacks": cf1 - cf0,
                "columnar_partials": cp1 - cp0}
         res.update(tracing.counters_delta(tally0))
+        if kprof0 is not None:
+            for label, us in tracing.kernel_profile_delta(kprof0).items():
+                res[f"kprof.{label}"] = int(us)
         return res
 
     def _record_digest(self, ps, dig: str, norm: str, sql_text: str,
@@ -535,6 +555,11 @@ class Session:
                                    sum(t.attrs.get("retries", 0)
                                        for t in tasks),
                                    worst.attrs.get("run_us", 0) / 1e3))
+            top = _profile_clause(kt)
+            if top:
+                # top kernel signature by device time — same per-thread
+                # kprof tally EXECUTION_DETAIL renders, not a re-count
+                detail += f" {top}"
             if digest:
                 # the digest joins slow-log lines to their summary row
                 detail += f" digest:{digest}"
@@ -812,13 +837,14 @@ class Session:
         from tidb_tpu.distsql import thread_columnar_counts
         ch0, cf0, cp0 = thread_columnar_counts()
         tally0 = tracing.counters_snapshot()
+        kprof0 = tracing.kernel_profile_snapshot()
         t0 = _time.perf_counter()
         bo_tok = kvbackoff.attach(self._statement_backoffer())
         self._exec_depth += 1
         try:
             rs = self.run_prepared(ent, values, ent.text)
         except Exception as e:
-            res = self._exec_resources(ch0, cf0, cp0, tally0)
+            res = self._exec_resources(ch0, cf0, cp0, tally0, kprof0)
             ps.end_statement(ev, error=str(e), detail=_detail_str(res))
             self._record_digest(ps, dig, norm, ent.text,
                                 (_time.perf_counter() - t0) * 1e3,
@@ -827,7 +853,7 @@ class Session:
         finally:
             self._exec_depth -= 1
             kvbackoff.detach(bo_tok)
-        res = self._exec_resources(ch0, cf0, cp0, tally0)
+        res = self._exec_resources(ch0, cf0, cp0, tally0, kprof0)
         n_sent = len(rs.rows) if rs is not None else 0
         ps.end_statement(ev, rows_sent=n_sent,
                          rows_affected=self.vars.affected_rows,
@@ -1151,6 +1177,30 @@ class Session:
         self._require_global_grant("tidb_tpu_drain_pool_size")
         from tidb_tpu.cluster.pool import set_pool_size
         set_pool_size(n)
+
+    def apply_tpu_kernel_profile(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_kernel_profile = 0|1 — the continuous
+        kernel profiler's kill switch. Off clears the per-signature
+        registry and the lock-hold ring, so a disabled profiler retains
+        nothing (the overhead guard asserts exactly that). Process-wide
+        like tidb_tpu_mesh: the dispatch-serial lock is one per process."""
+        from tidb_tpu.sessionctx import parse_bool_sysvar
+        if value.strip().lower() not in ("0", "1", "on", "off", "true",
+                                         "false"):
+            raise errors.ExecError(
+                f"tidb_tpu_kernel_profile must be 0 or 1, got {value!r}")
+        self._require_global_grant("tidb_tpu_kernel_profile")
+        from tidb_tpu import profiler
+        profiler.set_enabled(parse_bool_sysvar(value))
+
+    def apply_tpu_profile_max_signatures(self, value: str) -> None:
+        """SET GLOBAL tidb_tpu_profile_max_signatures = N — registry
+        cardinality bound: signature N+1 and beyond fold into a per-kind
+        ~overflow bucket (device_us totals stay exact)."""
+        n = self._int_sysvar("tidb_tpu_profile_max_signatures", value, 1)
+        self._require_global_grant("tidb_tpu_profile_max_signatures")
+        from tidb_tpu import profiler
+        profiler.set_max_signatures(n)
 
     def apply_tpu_mesh(self, value: str) -> None:
         """SET GLOBAL tidb_tpu_mesh = 0|1 — the mesh execution tier
@@ -1653,6 +1703,18 @@ def bootstrap(session: Session) -> None:
                         _inspection.set_threshold(var, val)
                     except ValueError:
                         pass
+            # the kernel profiler is process-level like the dispatch
+            # lock it rides — hydrate its kill switch + cardinality cap
+            from tidb_tpu import profiler as _profiler
+            v = gv.values.get("tidb_tpu_kernel_profile")
+            if v is not None:
+                _profiler.set_enabled(parse_bool_sysvar(v))
+            v = gv.values.get("tidb_tpu_profile_max_signatures")
+            try:
+                if v:
+                    _profiler.set_max_signatures(max(1, int(v.strip())))
+            except ValueError:
+                pass
             return
         session.execute("create database if not exists mysql")
         for ddl in (CREATE_USER_TABLE, CREATE_DB_TABLE,

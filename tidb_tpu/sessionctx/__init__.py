@@ -170,6 +170,15 @@ SYSVAR_DEFAULTS: dict[str, str] = {
     # GLOBAL-only like tidb_tpu_drain_pool_size.
     "tidb_tpu_metrics_interval_ms": "1000",
     "tidb_tpu_metrics_history_cap": "240",
+    # kernel-level continuous profiler (tidb_tpu.profiler): 1 publishes
+    # every metered dispatch into the per-(kind, signature) registry
+    # behind information_schema.TIDB_TPU_KERNEL_PROFILE and the
+    # profiler.sig.* metric families; 0 clears the registry and retains
+    # nothing. Cardinality bound: past max_signatures new signatures
+    # fold into a per-kind ~overflow bucket. Process-wide (the dispatch
+    # lock is), GLOBAL-only, hydrated on restart.
+    "tidb_tpu_kernel_profile": "1",
+    "tidb_tpu_profile_max_signatures": "256",
     # admission-queue wait deadline in ms: a connection queued behind
     # the admission gate is rejected typed (ER 1040, counted on
     # server.conn_queue_timeouts) after this long instead of waiting

@@ -91,6 +91,9 @@ class TraceStmt(StmtNode):
 class AdminType(enum.IntEnum):
     SHOW_DDL = 1
     CHECK_TABLE = 2
+    # ADMIN TPU PROFILE EXPORT: Chrome trace-event JSON of the most
+    # recently retained statement trace (Perfetto-loadable)
+    TPU_PROFILE_EXPORT = 3
 
 
 @dataclass

@@ -45,7 +45,7 @@ span_allocations = 0
 class Span:
     """One node of a statement's span tree."""
 
-    __slots__ = ("name", "start_ns", "end_ns", "attrs", "children")
+    __slots__ = ("name", "start_ns", "end_ns", "attrs", "children", "tid")
 
     def __init__(self, name: str):
         global span_allocations
@@ -55,6 +55,10 @@ class Span:
         self.end_ns = 0
         self.attrs: dict = {}
         self.children: list[Span] = []
+        # creating thread's lane for the cross-thread trace-event
+        # export; fan-out workers RE-STAMP the region-task span they
+        # execute so the exported timeline shows real worker lanes
+        self.tid = threading.get_ident()
 
     is_noop = False
 
@@ -104,7 +108,11 @@ class Span:
         attrs = dict(self.attrs)
         children = list(self.children)
         d: dict = {"name": self.name,
-                   "duration_us": round(self.duration_us(), 3)}
+                   "duration_us": round(self.duration_us(), 3),
+                   # perf_counter timeline + lane: what the Chrome
+                   # trace-event export needs to place this span
+                   "start_us": round(self.start_ns / 1e3, 3),
+                   "tid": self.tid}
         if attrs:
             d["attrs"] = attrs
         if children:
@@ -310,6 +318,32 @@ def record_degraded(kind: str, tally: bool = True) -> None:
     if tally:
         count(_DEGRADED_TALLY.get(kind, f"degraded_{kind}"))
     metrics.counter(f"copr.degraded_{kind}").inc()
+
+
+def kernel_profile_note(label: str, us: int) -> None:
+    """Per-thread per-signature device-time tally — written ONLY by
+    profiler.publish (the metered lock's exit), so the statement-level
+    `profile:` clause reads the exact figures the global registry got:
+    one accounting path, two aggregation scopes."""
+    d = getattr(_tls, "kprof", None)
+    if d is None:
+        d = _tls.kprof = {}
+    d[label] = d.get(label, 0) + us
+
+
+def kernel_profile_snapshot() -> dict:
+    d = getattr(_tls, "kprof", None)
+    return dict(d) if d else {}
+
+
+def kernel_profile_delta(before: dict) -> dict:
+    """label → device_us this thread accrued since `before` (empty when
+    the profiler is off or nothing dispatched)."""
+    now = getattr(_tls, "kprof", None)
+    if not now:
+        return {}
+    return {k: v - before.get(k, 0) for k, v in now.items()
+            if v != before.get(k, 0)}
 
 
 def record_jit_cache(hit: bool) -> None:
