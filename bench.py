@@ -800,6 +800,161 @@ def measure_join_oversized(n_rows: int, n_dim: int, n_regions: int,
     }
 
 
+SPILL_SORT_SQL = ("select s_id, s_v from sp join spd on s_k = d_k "
+                  "order by s_v desc, s_id")
+SPILL_WINDOW_SQL = ("select s_id, rank() over "
+                    "(partition by s_w order by s_v) from sp")
+SPILL_GROUPBY_SQL = "select s_g, sum(s_v), count(*) from sp group by s_g"
+
+
+def measure_spill(n_rows: int, n_dim: int, n_regions: int, runs: int):
+    """Out-of-core everything regime (HBM governance tier): the HBM
+    budget is set to a fraction of every operator's working set, so over
+    the 4-region cluster store (a) the join→ORDER BY sorts its key
+    planes through the range-partitioned external sort, (b) the window
+    function rides the same external sort plus the segment-scan kernel,
+    and (c) the high-NDV group-by runs its states table in key-radix-
+    partitioned passes. Asserts the partitioned routes actually engaged
+    (>= 2 passes on the counters), zero fallbacks of any kind, and
+    row-for-row parity against the budget-0 kill-switch oracle inside
+    the bench itself."""
+    from tidb_tpu import metrics, tablecodec as tc
+    from tidb_tpu.ops import extsort, membudget
+    from tidb_tpu.session import Session, new_store
+    from tidb_tpu.types import Datum
+
+    store = new_store(f"cluster://3/benchsp{n_rows}")
+    s = Session(store)
+    s.execute("create database sp")
+    s.execute("use sp")
+    s.execute("create table sp (s_id bigint primary key, s_k bigint, "
+              "s_g bigint, s_w bigint, s_v bigint)")
+    s.execute("create table spd (d_k bigint primary key, d_f double)")
+    tbl = s.info_schema().table_by_name("sp", "sp")
+    # s_g: high-NDV group key (~n/2 distinct), s_w: 64 window
+    # partitions, s_v: pseudo-shuffled sort/agg payload
+    rows = [[Datum.i64(i), Datum.i64(i % n_dim),
+             Datum.i64((i * 7919) % max(n_rows // 2, 1)),
+             Datum.i64(i % 64),
+             Datum.i64((i * 2654435761) % 1000003)]
+            for i in range(1, n_rows + 1)]
+    batch = 20000
+    for start in range(0, n_rows, batch):
+        txn = store.begin()
+        tbl.add_records(txn, rows[start:start + batch],
+                        skip_unique_check=True)
+        txn.commit()
+    dtbl = s.info_schema().table_by_name("sp", "spd")
+    drows = [[Datum.i64(k), Datum.f64(k % 89 + 0.25)] for k in range(n_dim)]
+    for start in range(0, n_dim, batch):
+        txn = store.begin()
+        dtbl.add_records(txn, drows[start:start + batch],
+                         skip_unique_check=True)
+        txn.commit()
+    step = max(n_rows // n_regions, 1)
+    store.cluster.split_keys(
+        [tc.encode_row_key(tbl.info.id, step * i + 1)
+         for i in range(1, n_regions)])
+
+    sess = Session(store)
+    sess.execute("use sp")
+    sess.execute("set global tidb_tpu_dispatch_floor = 0")
+    # budget a fraction of the sort working set (60 B/row: two
+    # (i64 value, int8 null) key levels, x2 partition scratch, +24
+    # order/perm) — sized so each range partition stays at or above
+    # SORT_DEVICE_FLOOR rows and still takes a device pass. The cached
+    # region planes PIN ledger bytes for the life of the store, so the
+    # budget rides on top of the pinned residue (measured after warm,
+    # when every plane this workload touches is packed).
+    pieces = min(4, max(2, n_rows // extsort.SORT_DEVICE_FLOOR))
+    sort_est = 60 * n_rows
+    c_sorts = metrics.counter("copr.spill.sorts")
+    c_spass = metrics.counter("copr.spill.sort_passes")
+    c_plane = metrics.counter("copr.spill.plane_sorts")
+    c_gbys = metrics.counter("copr.spill.groupbys")
+    c_gpass = metrics.counter("copr.spill.groupby_passes")
+    c_wpass = metrics.counter("copr.spill.window_passes")
+    c_esc = metrics.counter("copr.spill.escalations")
+    fbs = metrics.counter("distsql.columnar_fallbacks")
+    degr = [metrics.counter(f"copr.degraded_spill_{k}")
+            for k in ("sort", "groupby", "window")]
+    legs = (SPILL_SORT_SQL, SPILL_WINDOW_SQL, SPILL_GROUPBY_SQL)
+    try:
+        warm_budget = 16 * sort_est
+        sess.execute(f"set global tidb_tpu_hbm_budget_bytes = "
+                     f"{warm_budget}")
+        for sql in legs:                  # warm (pack + pin + compile)
+            sess.execute(sql)
+        pinned = warm_budget - membudget.headroom()
+        budget = pinned + int(sort_est / pieces * 1.15)
+        sess.execute(f"set global tidb_tpu_hbm_budget_bytes = {budget}")
+        s0, sp0, pl0 = c_sorts.value, c_spass.value, c_plane.value
+        g0, gp0, w0 = c_gbys.value, c_gpass.value, c_wpass.value
+        e0, f0 = c_esc.value, fbs.value
+        d0 = [c.value for c in degr]
+        t0 = time.time()
+        for _ in range(runs):
+            sort_rows = sess.execute(SPILL_SORT_SQL)[0].values()
+            win_rows = sess.execute(SPILL_WINDOW_SQL)[0].values()
+            gby_rows = sess.execute(SPILL_GROUPBY_SQL)[0].values()
+        t_spill = (time.time() - t0) / runs
+        d_sorts, d_spass = c_sorts.value - s0, c_spass.value - sp0
+        d_plane = c_plane.value - pl0
+        d_gbys, d_gpass = c_gbys.value - g0, c_gpass.value - gp0
+        d_wpass, d_esc = c_wpass.value - w0, c_esc.value - e0
+        d_fbs = (fbs.value - f0) \
+            + sum(c.value - v for c, v in zip(degr, d0))
+        assert d_plane >= runs, \
+            (f"only {d_plane} plane sorts in {runs} runs — ORDER BY "
+             "never rode the columnar external sort")
+        assert d_sorts >= 2 * runs, \
+            (f"only {d_sorts} over-headroom sorts in {runs} runs — the "
+             "external sort did not partition")
+        assert d_spass >= 2 * runs, \
+            f"only {d_spass} device sort passes across {runs} runs"
+        assert d_gbys >= runs and d_gpass >= 2 * runs, \
+            (f"high-NDV group-by spilled {d_gbys}x / {d_gpass} passes "
+             f"in {runs} runs — the states table did not partition")
+        assert d_wpass >= runs, \
+            f"only {d_wpass} window scan passes across {runs} runs"
+        assert d_fbs == 0, \
+            f"spill regime counted {d_fbs} fallbacks/degraded rungs"
+        # parity oracle: budget 0 pins the host rungs (np.lexsort, the
+        # unpartitioned states dispatch, the numpy window scan) —
+        # answers must match row for row
+        sess.execute("set global tidb_tpu_hbm_budget_bytes = 0")
+        s1 = c_sorts.value
+        o_sort = sess.execute(SPILL_SORT_SQL)[0].values()
+        o_win = sess.execute(SPILL_WINDOW_SQL)[0].values()
+        o_gby = sess.execute(SPILL_GROUPBY_SQL)[0].values()
+        assert c_sorts.value == s1, \
+            "budget 0 (kill switch) still took the partitioned sort"
+        assert list(sort_rows) == list(o_sort), \
+            "external sort parity vs kill-switch oracle"
+        assert list(win_rows) == list(o_win), \
+            "window function parity vs kill-switch oracle"
+        # spilled states passes may emit groups in partition order —
+        # group-by output order is unspecified, compare as sets of rows
+        assert sorted(map(tuple, gby_rows)) == sorted(map(tuple, o_gby)), \
+            "spilling group-by parity vs kill-switch oracle"
+    finally:
+        sess.execute("set global tidb_tpu_hbm_budget_bytes = 'auto'")
+    d_passes = d_spass + d_gpass + d_wpass
+    assert d_passes >= 2, \
+        f"only {d_passes} partitioned passes — nothing spilled"
+    return {
+        "spill_rows_per_sec": round(3 * n_rows / t_spill, 1),
+        "spill_passes": d_passes,
+        "spill_sort_passes": d_spass,
+        "spill_groupby_passes": d_gpass,
+        "spill_window_passes": d_wpass,
+        "spill_escalations": d_esc,
+        "spill_fallbacks": d_fbs,
+        "spill_budget_bytes": budget,
+        "spill_regions": n_regions,
+    }
+
+
 Q1_PUSHDOWN_SQL = (
     "select l_flag, l_status, sum(l_qty), sum(l_price), avg(l_qty), "
     "avg(l_price), avg(l_disc), count(*) from lineitem "
@@ -2211,6 +2366,20 @@ def main(smoke: bool = False, full: bool = False):
           f"({ov_figs['oversized_join_partitions']} partitions/join), "
           f"{ov_figs['oversized_join_fallbacks']} fallbacks",
           file=sys.stderr)
+    # out-of-core everything regime: ORDER BY + window function +
+    # high-NDV group-by at a budget a fraction of every working set —
+    # partitioned external sort / spilling states / chunked window
+    # scans, bit-identical to the kill-switch oracle
+    spr, spd = (12_000, 3_000) if smoke else (40_000, 10_000)
+    sp_figs = measure_spill(spr, spd, n_regions=4, runs=runs)
+    print(f"# spill ({spr / 1000:.0f}k rows x {sp_figs['spill_regions']} "
+          f"regions, budget {sp_figs['spill_budget_bytes']} B): "
+          f"{sp_figs['spill_rows_per_sec']:,.0f} rows/s across "
+          f"{sp_figs['spill_passes']} spill passes "
+          f"({sp_figs['spill_sort_passes']} sort / "
+          f"{sp_figs['spill_groupby_passes']} group-by / "
+          f"{sp_figs['spill_window_passes']} window), "
+          f"{sp_figs['spill_fallbacks']} fallbacks", file=sys.stderr)
     # HTAP freshness regime: OLTP commits interleaved with repeat fan-out
     # scans — cached planes stay warm through region delta packs + device
     # base+delta merges; the kill-switch regime is the collapse oracle
@@ -2306,6 +2475,7 @@ def main(smoke: bool = False, full: bool = False):
         **tpch_figs,
         **mq_figs,
         **ov_figs,
+        **sp_figs,
         **htap_figs,
         "q1_mesh_rows_per_sec": q1_mesh_rps,
         "mesh_devices": len(jax.devices()),

@@ -117,6 +117,22 @@ def test_bench_smoke_emits_valid_json():
         "the oversized build side never split into partitioned passes"
     assert out["oversized_join_fallbacks"] == 0
     assert out["oversized_join_budget_bytes"] > 0
+    # the out-of-core everything regime (PR 20): ORDER BY through the
+    # range-partitioned external sort, the high-NDV group-by through
+    # radix-partitioned states passes, and a window function over the
+    # same ledger — zero fallbacks, bit parity vs the budget-0
+    # kill-switch oracle asserted inside the bench itself
+    assert out["spill_rows_per_sec"] > 0
+    assert out["spill_passes"] >= 2, \
+        "no out-of-core operator split into partitioned passes"
+    assert out["spill_sort_passes"] >= 2, \
+        "the external sort never took a partitioned device pass"
+    assert out["spill_groupby_passes"] >= 2, \
+        "the high-NDV states table never partitioned"
+    assert out["spill_window_passes"] >= 1, \
+        "no window function rode the device segment-scan kernel"
+    assert out["spill_fallbacks"] == 0
+    assert out["spill_budget_bytes"] > 0
     # the HTAP freshness regime: commits interleaved with repeat fan-out
     # scans keep the plane cache hot through region delta packs + device
     # base+delta merges (parity vs the row protocol and the commit-to-

@@ -211,6 +211,19 @@ def test_serial_states_dispatch_site_serializes():
         "kernels.region_agg_states lost its dispatch_serial block"
 
 
+def test_spill_dispatch_sites_serialize():
+    """The PR 20 out-of-core sites, pinned by name: the device sort
+    permutation kernel (external sort passes) and the window segment
+    scan both own a launch+readback and must keep their dispatch_serial
+    blocks — partitioned passes multiply the dispatch count, so an
+    unserialized spill site is the fastest route back to the PR 9
+    deadlock class."""
+    assert _serial_span_of(ROOT / "kernels.py", "sort_perm"), \
+        "kernels.sort_perm lost its dispatch_serial block"
+    assert _serial_span_of(ROOT / "kernels.py", "window_scan"), \
+        "kernels.window_scan lost its dispatch_serial block"
+
+
 def test_checker_detects_unserialized_launch(tmp_path):
     """Meta-test: the walker must flag both rule shapes end-to-end (a
     refactor cannot silently neuter it)."""

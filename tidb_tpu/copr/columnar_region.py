@@ -1399,9 +1399,15 @@ def finish_states_batch(payloads) -> None:
             jax_ok = False
         use_device = jax_ok and total_rows >= STATES_DEVICE_FLOOR
         if use_device:
-            from tidb_tpu.ops import kernels
+            from tidb_tpu.ops import extsort, kernels
             from tidb_tpu.ops import mesh as mesh_mod
-            mesh = mesh_mod.get_mesh()
+            # spilling trumps shard placement: a states table over the
+            # HBM headroom takes the radix-partitioned passes no matter
+            # where the shards live (the estimate reads lengths only,
+            # so arg-plane specs are fine here)
+            spill = extsort.states_over_headroom(
+                [(pe.gid, pe.reductions, pe.G) for pe in pends])
+            mesh = None if spill else mesh_mod.get_mesh()
             if mesh is not None and any(pe.has_arg_planes()
                                         for pe in pends):
                 # the shard-owned mesh kernel reads raw (op, vals, ok)
@@ -1421,9 +1427,27 @@ def finish_states_batch(payloads) -> None:
                 except errors.DeviceError:
                     tracing.record_degraded("near_data")
             try:
-                outs = kernels.region_agg_states_batched(
-                    [(pe.gid, pe.device_reductions(), pe.G)
-                     for pe in pends])
+                if spill:
+                    # states table over headroom: lower any arg-plane
+                    # programs to the host exprc rung (row-aligned
+                    # planes cannot partition by group), then
+                    # radix-partition the group codes and run the SAME
+                    # batched kernel per partition in passes
+                    # (ops.extsort), each charged against
+                    # device.hbm.reserved, answers unchanged
+                    for pe in pends:
+                        pe.lower_arg_planes()
+                    try:
+                        outs = extsort.region_states_spill(
+                            [(pe.gid, pe.reductions, pe.G)
+                             for pe in pends])
+                    except errors.DeviceError:
+                        tracing.record_degraded("spill_groupby")
+                        raise
+                else:
+                    outs = kernels.region_agg_states_batched(
+                        [(pe.gid, pe.device_reductions(), pe.G)
+                         for pe in pends])
                 for p, pe, o in zip(group, pends, outs):
                     p.fulfill_states(pe.finish(o))
                 continue

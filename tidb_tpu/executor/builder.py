@@ -51,6 +51,9 @@ class ExecutorBuilder:
                                   p.group_by, p.schema, p.has_pushed_child)
         if isinstance(p, pl.PhysicalSort):
             return ex.SortExec(self.build(p.child), p.by_items)
+        if isinstance(p, pl.PhysicalWindow):
+            from tidb_tpu.executor.window import WindowExec
+            return WindowExec(self.build(p.child), p.window_funcs, p.schema)
         if isinstance(p, pl.PhysicalTopN):
             return ex.TopNExec(self.build(p.child), p.by_items, p.offset,
                                p.count)

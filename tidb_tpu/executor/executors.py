@@ -206,6 +206,58 @@ def _cmp_rows(items: list[SortItem]):
     return functools.cmp_to_key(cmp)
 
 
+def _plane_sort_keys(res, by_items, width):
+    """np.lexsort-convention key planes (least-significant first; each
+    by-item contributes a DIRECTED value plane then its directed NULL
+    plane) for ordering a columnar result's rows — string keys by
+    DICTIONARY RANK (copr.dictionary: batch-local codes are rank-
+    ordered, global codes order through ranks()), desc via bitwise-not /
+    negate, MySQL NULL ordering. The construction mirrors
+    copr.columnar_region._topn_select exactly, so a stable sort over
+    these planes equals the row comparator by construction. Returns
+    None when a key cannot map exactly (ci collation, non-column
+    expression, plane kind without an order-preserving image)."""
+    import numpy as np
+
+    from tidb_tpu import mysqldef as my
+    from tidb_tpu.expression import Column as ExprColumn
+    sort_keys = []      # least-significant first (np.lexsort order)
+    for item in reversed(by_items):
+        e = item.expr
+        if not isinstance(e, ExprColumn) or _expr_is_ci(e) \
+                or e.index >= width:
+            return None
+        j = e.index
+        is_str = e.ret_type is not None and \
+            e.ret_type.tp in my.STRING_TYPES
+        if is_str:
+            get_codes = getattr(res, "dict_code_plane", None)
+            ent = get_codes(j) if get_codes is not None else None
+            if ent is None:
+                return None
+            codes, va, dom = ent
+            ranks = dom.ranks()
+            vo = ranks[np.clip(codes, 0, max(len(ranks) - 1, 0))] \
+                if len(ranks) else np.zeros(len(codes), np.int64)
+            if item.desc:
+                vo = ~vo
+        else:
+            kind, vals, va = res.column_plane(j)
+            if kind == "f64":
+                vo = np.where(vals == 0.0, 0.0, vals)
+                if item.desc:
+                    vo = -vo
+            elif kind == "i64":
+                vo = ~vals if item.desc else vals
+            else:
+                return None
+        nullk = va.astype(np.int8) if not item.desc \
+            else (~va).astype(np.int8)
+        sort_keys.append(np.where(va, vo, np.zeros_like(vo)))
+        sort_keys.append(nullk)
+    return sort_keys
+
+
 class SortExec(Executor):
     def __init__(self, child: Executor, by_items: list[SortItem]):
         self.children = [child]
@@ -216,6 +268,8 @@ class SortExec(Executor):
 
     def _materialize(self):
         child = self.children[0]
+        if self._try_plane_sort(child):
+            return
         rows = []
         while True:
             row = child.next()
@@ -225,6 +279,43 @@ class SortExec(Executor):
             rows.append((keys, row, child.last_handle))
         rows.sort(key=_cmp_rows(self.by_items))
         self._sorted = rows
+
+    def _try_plane_sort(self, child) -> bool:
+        """join→ORDER BY without materializing-then-comparing rows:
+        order the DeviceJoinResult's column planes through the budget-
+        aware external sort (ops.extsort — one device pass within
+        headroom, range-partitioned passes over it, np.lexsort under
+        the kill switch) and gather rows in sorted order. Same key
+        recipe and stable tiebreak as the TopN plane path, so answers
+        equal the row comparator's. Bails to the row loop on ci
+        collations or unmapped planes."""
+        node, idx_map = _columnar_view(child)
+        get = getattr(node, "device_join_result", None) \
+            if node is not None else None
+        if get is None:
+            return False
+        gate = getattr(node, "_device_dict_on", None)
+        if gate is not None and not gate():
+            return False    # kill switch: the parity oracle's row loop
+        res = get()
+        if res is None:
+            return False
+        if idx_map is not None:
+            res = _ProjectedView(res, idx_map)
+        width = len(self.schema)
+        sort_keys = _plane_sort_keys(res, self.by_items, width)
+        if sort_keys is None:
+            return False
+        from tidb_tpu.ops import extsort
+        order = extsort.sort_order(sort_keys, len(sort_keys[0]))
+        self._sorted = [(None, row, None)
+                        for row in _gather_rows(res, order, width)]
+        from tidb_tpu import metrics
+        metrics.counter("copr.spill.plane_sorts").inc()
+        js = getattr(node, "join_stats", None)
+        if js is not None:
+            js["sort_plane"] = True
+        return True
 
     def next(self):
         if self._sorted is None:
@@ -287,7 +378,6 @@ class TopNExec(Executor):
         construction. Bails (row loop answers) on ci collations, planes
         without an exact mapping, or the tidb_tpu_device_dict kill
         switch."""
-        import numpy as np
         from tidb_tpu.expression import Column as ExprColumn
         node, idx_map = _columnar_view(child)
         get = getattr(node, "device_join_result", None) \
@@ -307,39 +397,14 @@ class TopNExec(Executor):
             return False
         if idx_map is not None:
             res = _ProjectedView(res, idx_map)
-        from tidb_tpu import mysqldef as my
-        sort_keys = []      # least-significant first (np.lexsort order)
-        for item in reversed(self.by_items):
-            e = item.expr
-            j = e.index
-            is_str = e.ret_type is not None and \
-                e.ret_type.tp in my.STRING_TYPES
-            if is_str:
-                get_codes = getattr(res, "dict_code_plane", None)
-                ent = get_codes(j) if get_codes is not None else None
-                if ent is None:
-                    return False
-                codes, va, dom = ent
-                ranks = dom.ranks()
-                vo = ranks[np.clip(codes, 0, max(len(ranks) - 1, 0))] \
-                    if len(ranks) else np.zeros(len(codes), np.int64)
-                if item.desc:
-                    vo = ~vo
-            else:
-                kind, vals, va = res.column_plane(j)
-                if kind == "f64":
-                    vo = np.where(vals == 0.0, 0.0, vals)
-                    if item.desc:
-                        vo = -vo
-                elif kind == "i64":
-                    vo = ~vals if item.desc else vals
-                else:
-                    return False
-            nullk = va.astype(np.int8) if not item.desc \
-                else (~va).astype(np.int8)
-            sort_keys.append(np.where(va, vo, np.zeros_like(vo)))
-            sort_keys.append(nullk)
-        order = np.lexsort(sort_keys)   # stable: ties keep emission order
+        sort_keys = _plane_sort_keys(res, self.by_items, width)
+        if sort_keys is None:
+            return False
+        # stable budget-aware sort: ties keep emission order on every
+        # route (np.lexsort below the floor / under the kill switch,
+        # one jitted pass within headroom, partitioned passes over it)
+        from tidb_tpu.ops import extsort
+        order = extsort.sort_order(sort_keys, len(sort_keys[0]))
         limit = self.offset + self.count
         keep = order[self.offset: limit]
         self._rows = [(None, row, None)

@@ -1,0 +1,384 @@
+"""Window function executor (PR 20).
+
+One WindowExec evaluates every window call of a SELECT over the fully
+materialized child rowset and emits the child rows IN INPUT ORDER with
+the window figures appended — window functions never reorder the
+resultset, only an outer ORDER BY does.
+
+Execution ladder per window call, top rung first:
+
+1. plane path — partition-by and order-by keys lower to directed key
+   planes (the TopN/ORDER BY recipe: value plane + NULL plane per key,
+   strings by dictionary rank), the sort permutation comes from
+   ops.extsort.sort_order — i.e. windows ride the SAME budget-aware
+   partitioned external sort as ORDER BY, charging device.hbm.reserved
+   per pass and checkpointing completed partitions across device/oom
+   escalations. Partition codes and peer-group ids are change-flag
+   cumsums over the sorted planes (peer ids globally monotone), and ONE
+   kernels.window_scan dispatch computes every ranking and default-frame
+   reduction for the call.
+2. host numpy rung — same seg/peer formulas on the host (searchsorted +
+   cumsum + per-partition accumulate) when the scan estimate exceeds
+   headroom, the rowset is under the device floor, the budget kill
+   switch is on, or the device faults (copr.degraded_spill_window).
+3. row protocol — python stable sort + streaming AggregationFunction
+   contexts per peer group, for keys/args that do not lower to planes
+   (ci collations, decimals, times). This rung is also the differential
+   oracle the spill tests compare the plane path against.
+
+Frame semantics are the MySQL defaults: with ORDER BY the frame is
+RANGE UNBOUNDED PRECEDING..CURRENT ROW (peer-inclusive), without it the
+whole partition. Integer SUM yields Decimal datums on every rung
+(matching _sum_exact), so rung choice never changes a result.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+from tidb_tpu import errors
+from tidb_tpu.executor.executors import Executor, _cmp_rows, _sort_keys
+from tidb_tpu.expression import AggregationFunction, Schema
+from tidb_tpu.plan.plans import SortItem
+from tidb_tpu.types import Datum
+from tidb_tpu.types.datum import NULL, Kind
+
+RANKING_FUNCS = frozenset(("row_number", "rank", "dense_rank"))
+
+# bytes per row a window_scan dispatch holds live: seg + peer (int64
+# each) plus vals + contrib + output per reduction spec
+WINDOW_ROW_BYTES = 16
+WINDOW_SPEC_BYTES = 25
+
+
+class WindowExec(Executor):
+    """Appends one column per window call to the child rows, input order
+    preserved. `window_funcs` are plan WindowFuncDesc entries whose
+    args/partition_by are Expressions and order_by are SortItems, all
+    bound to the child schema."""
+
+    def __init__(self, child: Executor, window_funcs, schema: Schema):
+        self.children = [child]
+        self.window_funcs = window_funcs
+        self.schema = schema
+        self._out: list | None = None
+        self._handles: list | None = None
+        self._pos = 0
+
+    def next(self):
+        if self._out is None:
+            self._materialize()
+        if self._pos >= len(self._out):
+            return None
+        row = self._out[self._pos]
+        self.last_handle = self._handles[self._pos]
+        self._pos += 1
+        return row
+
+    def _materialize(self):
+        child = self.children[0]
+        rows, handles = [], []
+        while True:
+            row = child.next()
+            if row is None:
+                break
+            rows.append(row)
+            handles.append(child.last_handle)
+        cols = [self._compute(d, rows) for d in self.window_funcs]
+        self._out = [rows[i] + [c[i] for c in cols]
+                     for i in range(len(rows))]
+        self._handles = handles
+
+    # ---- one window call over the materialized rowset ----
+
+    def _compute(self, desc, rows) -> list:
+        n = len(rows)
+        if n == 0:
+            return []
+        plane = self._try_planes(desc, rows)
+        if plane is None:
+            return self._compute_rows(desc, rows)
+        keys, spec, va = plane
+        import numpy as np
+
+        from tidb_tpu.ops import extsort
+        order = extsort.sort_order(keys, n)
+        # partition / peer ids over the SORTED planes: keys are in
+        # np.lexsort order (least-significant first), so the partition
+        # planes are the trailing 2*len(partition_by) entries
+        g = [k[order] for k in keys]
+        npart = 2 * len(desc.partition_by)
+        part_planes = g[len(g) - npart:] if npart else []
+        seg_chg = np.zeros(n, bool)
+        peer_chg = np.zeros(n, bool)
+        for k in part_planes:
+            seg_chg[1:] |= k[1:] != k[:-1]
+        for k in g:
+            peer_chg[1:] |= k[1:] != k[:-1]
+        peer_chg |= seg_chg
+        seg = np.cumsum(seg_chg.astype(np.int64)) - np.int64(seg_chg[0])
+        peer = np.cumsum(peer_chg.astype(np.int64)) - np.int64(peer_chg[0])
+
+        name = desc.name
+        if name in RANKING_FUNCS:
+            specs = [(name, None, None)]
+        else:
+            vals, contrib = spec
+            specs = [(name, vals[order] if vals is not None else None,
+                      contrib[order]),
+                     ("count", None, contrib[order])]
+        outs = self._scan(specs, seg, peer, n)
+
+        # scatter back to input order and lift to datums
+        res = [None] * n
+        figures = outs[0]
+        fcount = outs[1] if len(outs) > 1 else None
+        for k in range(n):
+            i = int(order[k])
+            if name in RANKING_FUNCS or name == "count":
+                res[i] = Datum.i64(int(figures[k]))
+            elif fcount is not None and int(fcount[k]) == 0:
+                res[i] = NULL    # no contributing row in the frame
+            elif name == "sum":
+                # integer SUM is Decimal-typed on every rung (_sum_exact)
+                res[i] = Datum.dec(Decimal(int(figures[k])))
+            else:
+                res[i] = Datum.i64(int(figures[k]))
+        return res
+
+    def _scan(self, specs, seg, peer, n) -> list:
+        """Device segment scan within budget, host numpy rung (same
+        formulas) under the floor / kill switch / on fault. A scan whose
+        working set exceeds headroom runs in PASSES over spans of WHOLE
+        partitions (every window figure only reads its own partition's
+        prefix, so per-span scans compose exactly); each pass charges
+        device.hbm.reserved. A single partition over the target still
+        dispatches — the reservation is accounting, not a gate."""
+        import numpy as np
+
+        from tidb_tpu import metrics, tracing
+        from tidb_tpu.ops import membudget
+
+        row_bytes = (WINDOW_ROW_BYTES
+                     + WINDOW_SPEC_BYTES * sum(1 for s in specs
+                                               if s[0] not in RANKING_FUNCS)
+                     + 8 * len(specs))
+        est = n * row_bytes
+        if n < extsort_floor() or membudget.budget_bytes() <= 0:
+            return _scan_host(specs, seg, peer, n)
+        from tidb_tpu.ops import kernels
+        target = max(membudget.headroom(), 1)
+        try:
+            if est <= target:
+                with membudget.reserve(est, "window_scan"):
+                    outs = kernels.window_scan(seg, peer, specs, n)
+                metrics.counter("copr.spill.windows").inc()
+                metrics.counter("copr.spill.window_passes").inc()
+                return outs
+            starts = np.flatnonzero(
+                np.concatenate([[True], seg[1:] != seg[:-1]]))
+            span = max(int(target // row_bytes), 1)
+            bounds = [0]
+            for st in starts[1:]:
+                if st - bounds[-1] >= span:
+                    bounds.append(int(st))
+            bounds.append(n)
+            outs = None
+            for a, b in zip(bounds[:-1], bounds[1:]):
+                sub = [(op, v[a:b] if v is not None else None,
+                        c[a:b] if c is not None else None)
+                       for op, v, c in specs]
+                with membudget.reserve((b - a) * row_bytes,
+                                       "window_pass"):
+                    part = kernels.window_scan(
+                        seg[a:b], peer[a:b], sub, b - a)
+                metrics.counter("copr.spill.window_passes").inc()
+                outs = part if outs is None else [
+                    np.concatenate([o, p]) for o, p in zip(outs, part)]
+            metrics.counter("copr.spill.windows").inc()
+            return outs
+        except errors.DeviceError:
+            tracing.record_degraded("spill_window")
+        return _scan_host(specs, seg, peer, n)
+
+    # ---- plane lowering ----
+
+    def _try_planes(self, desc, rows):
+        """(lexsort key planes, reduction (vals, contrib), valid) or None
+        when a key or the argument does not lower exactly."""
+        import numpy as np
+
+        n = len(rows)
+        items = [SortItem(e, False) for e in desc.partition_by] \
+            + list(desc.order_by)
+        keys: list = []
+        for item in reversed(items):
+            ent = _datum_plane([item.expr.eval(r) for r in rows],
+                               item.expr)
+            if ent is None:
+                return None
+            vo, va = ent
+            if item.desc:
+                vo = -vo if vo.dtype == np.float64 else ~vo
+                nullk = (~va).astype(np.int8)
+            else:
+                nullk = va.astype(np.int8)
+            keys.append(np.where(va, vo, np.zeros_like(vo)))
+            keys.append(nullk)
+        if not keys:
+            # no PARTITION BY and no ORDER BY: one global partition in
+            # input order — a constant key keeps the recipe uniform
+            keys = [np.zeros(n, np.int64), np.zeros(n, np.int8)]
+        spec = (None, None)
+        if desc.name not in RANKING_FUNCS:
+            arg = desc.args[0]
+            datums = [arg.eval(r) for r in rows]
+            va = np.array([not d.is_null() for d in datums], bool)
+            if desc.name == "count":
+                spec = (None, va)
+            else:
+                if not all(d.is_null() or d.kind == Kind.INT64
+                           for d in datums):
+                    return None    # float/decimal reductions: host rungs
+                vals = np.array(
+                    [0 if d.is_null() else int(d.val) for d in datums],
+                    np.int64)
+                spec = (vals, va)
+        return keys, spec, None
+
+    # ---- row protocol (the differential oracle rung) ----
+
+    def _compute_rows(self, desc, rows) -> list:
+        items = [SortItem(e, False) for e in desc.partition_by] \
+            + list(desc.order_by)
+        keyed = [(_sort_keys(items, r), i) for i, r in enumerate(rows)]
+        cmpkey = _cmp_rows(items)
+        keyed.sort(key=lambda ent: cmpkey((ent[0], None, None)))
+        order = [i for _, i in keyed]
+        npart = len(desc.partition_by)
+        n = len(rows)
+        res = [None] * n
+        name = desc.name
+        fn = None if name in RANKING_FUNCS \
+            else AggregationFunction(name, desc.args)
+        k = 0
+        while k < n:
+            # partition = run of equal partition keys
+            pstart, pkey = k, keyed[k][0][:npart]
+            while k < n and not _keys_differ(keyed[k][0][:npart], pkey):
+                k += 1
+            dense = 0
+            ctx = fn.create_context() if fn is not None else None
+            j = pstart
+            while j < k:
+                # peer group = run of equal full keys
+                gstart, gkey = j, keyed[j][0]
+                while j < k and not _keys_differ(keyed[j][0], gkey):
+                    j += 1
+                dense += 1
+                if fn is not None:
+                    for t in range(gstart, j):
+                        fn.update(ctx, rows[order[t]])
+                    d = fn.get_result(ctx)
+                for t in range(gstart, j):
+                    i = order[t]
+                    if name == "row_number":
+                        res[i] = Datum.i64(t - pstart + 1)
+                    elif name == "rank":
+                        res[i] = Datum.i64(gstart - pstart + 1)
+                    elif name == "dense_rank":
+                        res[i] = Datum.i64(dense)
+                    else:
+                        res[i] = d
+        return res
+
+
+def extsort_floor() -> int:
+    from tidb_tpu.ops import extsort
+    return extsort.SORT_DEVICE_FLOOR
+
+
+def _keys_differ(a, b) -> bool:
+    from tidb_tpu.types.datum import compare_datum
+    return any(compare_datum(x, y) != 0 for x, y in zip(a, b))
+
+
+def _datum_plane(datums, expr):
+    """(undirected int64/f64 value plane, valid mask) for one key
+    column of evaluated datums; None when the kinds do not lower to an
+    order-exact plane (the _plane_sort_keys contract: ints as int64,
+    floats with -0.0 normalized, strings by RANK — here via sorted
+    distinct values, which equals dictionary-rank order)."""
+    import numpy as np
+
+    rt = getattr(expr, "ret_type", None)
+    if rt is not None and rt.is_ci_collation():
+        return None
+    va = np.array([not d.is_null() for d in datums], bool)
+    kinds = {d.kind for d in datums if not d.is_null()}
+    if not kinds:
+        return np.zeros(len(datums), np.int64), va
+    if kinds <= {Kind.INT64}:
+        vo = np.array([0 if d.is_null() else int(d.val) for d in datums],
+                      np.int64)
+        return vo, va
+    if kinds <= {Kind.FLOAT64}:
+        vo = np.array([0.0 if d.is_null() else float(d.val)
+                       for d in datums], np.float64)
+        vo = np.where(vo == 0.0, 0.0, vo)
+        return vo, va
+    if kinds <= {Kind.STRING, Kind.BYTES}:
+        svals = [None if d.is_null()
+                 else (d.val if isinstance(d.val, bytes)
+                       else str(d.val).encode()) for d in datums]
+        ranks = {s: r for r, s in
+                 enumerate(sorted({s for s in svals if s is not None}))}
+        vo = np.array([0 if s is None else ranks[s] for s in svals],
+                      np.int64)
+        return vo, va
+    return None
+
+
+def _scan_host(specs, seg, peer, n) -> list:
+    """Host rung of the segment scan: numpy, same formulas as the
+    kernel (searchsorted starts/ends, cumsum differencing, per-partition
+    accumulate for min/max). Bit-identical outputs by construction."""
+    import numpy as np
+
+    seg = np.asarray(seg, np.int64)
+    peer = np.asarray(peer, np.int64)
+    pos = np.arange(n, dtype=np.int64)
+    s = np.searchsorted(seg, seg, side="left")
+    p = np.searchsorted(peer, peer, side="left")
+    e = np.searchsorted(peer, peer, side="right") - 1
+    outs = []
+    for op, vals, contrib in specs:
+        if op == "row_number":
+            outs.append(pos - s + 1)
+            continue
+        if op == "rank":
+            outs.append(p - s + 1)
+            continue
+        if op == "dense_rank":
+            outs.append(peer - peer[s] + 1)
+            continue
+        ok = np.asarray(contrib, bool)
+        if op in ("sum", "count"):
+            c = ok.astype(np.int64) if op == "count" \
+                else np.where(ok, np.asarray(vals, np.int64), 0)
+            cs = np.concatenate([np.zeros(1, np.int64), np.cumsum(c)])
+            outs.append(cs[e + 1] - cs[s])
+            continue
+        sent = np.iinfo(np.int64).max if op == "min" \
+            else np.iinfo(np.int64).min
+        v = np.where(ok, np.asarray(vals, np.int64), sent)
+        acc = np.minimum.accumulate if op == "min" \
+            else np.maximum.accumulate
+        run = np.empty(n, np.int64)
+        starts = np.flatnonzero(np.concatenate(
+            [[True], seg[1:] != seg[:-1]]))
+        bounds = np.concatenate([starts, [n]])
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            run[a:b] = acc(v[a:b])
+        outs.append(run[e])
+    return outs

@@ -140,9 +140,21 @@ CATALOG: dict[str, tuple[str, str]] = {
     "device.hbm.headroom": ("gauge", "Bytes a new reservation may take before crossing the budget (0 when unlimited)."),
     "device.hbm.over_budget": ("counter", "Reservations that proceeded past the configured HBM budget (the hbm-pressure rule's evidence)."),
     "device.hbm.hw.": ("gauge", "HBM ledger high-water marks by reservation kind (join/dispatch/...; 'pinned' tracks the pin ledger, 'total' the reserved+pinned combined peak)."),
+    "device.hbm.estimate_error_ratio": ("gauge", "Allocator reconciliation: measured memory_stats() delta over the ledger estimate for the last reservation (0 when no backend stats)."),
     "copr.partitioned_joins": ("counter", "Joins whose build side exceeded the HBM headroom and took the radix-partitioned out-of-core route."),
     "copr.partitioned_passes": ("counter", "Partition executions of out-of-core joins (single-device passes, or per-shard partitions of the key-partitioned mesh probe)."),
     "copr.plane_cache.pin_skipped": ("counter", "Plane-cache admissions that skipped the device pin because pinning would cross the HBM budget."),
+    # ---- out-of-core execution (ops.extsort + executor.window) ----
+    "copr.spill.sorts": ("counter", "ORDER BY / window sorts whose key planes exceeded the HBM headroom and took the range-partitioned external sort."),
+    "copr.spill.sort_passes": ("counter", "Device sort-pass dispatches of partitioned external sorts (each pass charges device.hbm.reserved)."),
+    "copr.spill.plane_sorts": ("counter", "ORDER BY statements answered through the columnar plane sort (ops.extsort) instead of the row comparator."),
+    "copr.spill.groupbys": ("counter", "Group-by statements whose states table exceeded the HBM headroom and ran as key-radix-partitioned states passes."),
+    "copr.spill.groupby_passes": ("counter", "Per-partition states dispatches of spilling group-bys (each pass charges device.hbm.reserved)."),
+    "copr.spill.windows": ("counter", "Window calls computed by the device segment-scan kernel over extsort-ordered planes."),
+    "copr.spill.window_passes": ("counter", "window_scan dispatches (over-headroom scans split into spans of whole partitions; each pass charges device.hbm.reserved)."),
+    "copr.spill.escalations": ("counter", "Mid-pass device/oom faults that escalated a partitioned operator to finer partitions (P*2) or a salted split."),
+    "copr.spill.checkpoint_hits": ("counter", "Completed partitions whose recorded results were REPLAYED (not re-run) across an escalation — pass-level checkpointing."),
+    "copr.spill.salted_splits": ("counter", "Two-level salted splits of partitions a key-disjoint split cannot shrink (single hot key / fully tied sort job)."),
     # ---- micro-batch scheduler ----
     "sched.batched_dispatches": ("counter", "Shared micro-batched device dispatches."),
     "sched.batched_statements": ("counter", "Statements answered through a shared batched dispatch."),

@@ -1,0 +1,524 @@
+"""Out-of-core external sort and spilling group-by states over the HBM
+governance ledger (ops.membudget) — PR 20.
+
+The membudget ledger arbitrates every blocking operator, not just joins:
+
+* **Partitioned external sort** (`sort_order`): ORDER BY / large-TopN /
+  window sort keys ride ONE jitted stable-lexsort dispatch
+  (kernels.sort_perm, the 32-bit radix-digit discipline) while the
+  working set fits headroom. When it doesn't, the key planes RANGE-
+  partition on the primary comparator — NULL stratum first, then value
+  pivots from a deterministic sorted sample — so emitting the sorted
+  partitions in pivot order IS the globally sorted order (merge is
+  concatenation by construction; ties never straddle a partition
+  because equal primary keys share one range). Each pass charges a
+  scoped `device.hbm.reserved` reservation and is bit-identical to the
+  single-pass order via the stable global-index tiebreak.
+
+* **Spilling group-by states** (`region_states_spill`): a high-NDV
+  aggregate whose states table overflows headroom partitions its GROUP
+  ids by the PR 15 splitmix64 radix and runs the existing
+  `kernels.region_agg_states_batched` segmented reduction per partition
+  in passes. Equal keys share a partition, so per-partition states
+  merge by scatter — no cross-partition combine exists. Float SUM/AVG
+  never ride this path (the prepare layer keeps the host row-order
+  accumulator), so every pass is exact.
+
+* **Pass-level checkpointing** (PR 15 residual c): completed partitions
+  of either operator record their results, so a mid-pass `device/oom`
+  escalation replays only unfinished partitions — counted on
+  `copr.spill.checkpoint_hits`.
+
+* **Salted two-level split** (PR 15 residual d): a partition pinned
+  over headroom by a single hot key re-splits by a secondary dimension
+  that preserves answers — the next sort key (then the stable row
+  order, which for fully-tied keys IS the sorted order) for the sort;
+  a salted positional hash with monoid state merges for the group-by.
+  Counted on `copr.spill.salted_splits`.
+
+Degradation ladder (every rung keeps answers unchanged and is counted):
+
+    single device pass
+  → range/radix-partitioned device passes   (copr.spill.*)
+  → P×2 escalation on device/oom            (copr.degraded_spill_partition)
+  → host numpy                              (copr.degraded_spill_sort /
+                                             copr.degraded_spill_groupby)
+
+This module is HOST-side orchestration only: every jitted launch and
+readback lives in ops/kernels.py under the metered dispatch_serial
+discipline the hygiene walk enforces.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tidb_tpu import errors
+from tidb_tpu.ops import membudget
+
+# below this row count the host lexsort is the natural tier (identical
+# comparator, no dispatch overhead) — mirrors copr's STATES_DEVICE_FLOOR
+SORT_DEVICE_FLOOR = 4096
+
+# transient working-set model for one sort pass: each key plane rides to
+# the device and back through the sort's scratch (~2x), plus the radix
+# digit planes and the int64 permutation readback per row
+SORT_SCRATCH_BYTES = 24
+
+# bound on the secondary (salted / chunked) split factor: hot keys stop
+# pinning a pass long before this
+MAX_SALTED_CHUNKS = 64
+
+
+def sort_bytes_estimate(planes, n: int) -> int:
+    """Working-set estimate for sorting n rows of the given key planes
+    (np.lexsort convention). Best-effort accounting, never a gate."""
+    per_row = sum(int(np.asarray(p).dtype.itemsize) for p in planes)
+    return int(n) * (2 * per_row + SORT_SCRATCH_BYTES)
+
+
+def _pass_target(budget: int) -> int:
+    """Per-pass byte target: current headroom, floored at an eighth of
+    the budget (the _initial_partitions discipline — a headroom crushed
+    by pins still yields finite partitions)."""
+    return max(membudget.headroom(), budget // 8, 1)
+
+
+def _split_job(planes, rows: np.ndarray, level: int,
+               pieces: int = 4) -> list:
+    """Range-partition `rows` on key group `level` (0 = the PRIMARY
+    by-item, i.e. the LAST (value, null) plane pair of the lexsort
+    list). Emission order of the returned sub-jobs equals the primary
+    comparator's order — null stratum ascending (the null plane is the
+    more significant half of the pair), value ranges ascending within —
+    and equal keys never straddle a split, so concatenating the sorted
+    sub-jobs reproduces the global stable sort exactly. Returns [rows]
+    unchanged ONLY when every row is tied on this key group — callers
+    rely on that to descend to the next key level soundly."""
+    ln = len(planes)
+    vplane = np.asarray(planes[ln - 2 * level - 2])
+    nplane = np.asarray(planes[ln - 2 * level - 1])
+    nv = nplane[rows]
+    vv = vplane[rows]
+    subs: list = []
+    for stratum in np.unique(nv):
+        smask = nv == stratum
+        srows = rows[smask]
+        vals = vv[smask]
+        vmin = vals.min()
+        vmax = vals.max()
+        if len(srows) < 2 or vmin == vmax:
+            subs.append(srows)
+            continue
+        # deterministic pivots: quantiles of a sorted stride-sample of
+        # the stratum, deduplicated — equal values collapse into one
+        # range. searchsorted(side="right") sends v == pivot to the
+        # pivot's right range, so keeping pivots strictly above the
+        # stratum minimum makes partition 0 ({v < piv[0]}) nonempty; a
+        # skewed sample falls back to isolating the maximum — with
+        # vmin != vmax the split ALWAYS shrinks the job.
+        samp = np.sort(vals[::max(1, len(vals) // 4096)])
+        picks = np.linspace(0, len(samp) - 1,
+                            max(pieces, 2) + 1).astype(np.int64)[1:-1]
+        piv = np.unique(samp[picks])
+        piv = piv[piv > vmin]
+        if piv.size == 0:
+            piv = np.asarray([vmax], dtype=vals.dtype)
+        part = np.searchsorted(piv, vals, side="right")
+        for pidx in range(piv.size + 1):
+            sub = srows[part == pidx]
+            if sub.size:
+                subs.append(sub)
+    return subs
+
+
+def sort_order(planes, n: int, stats: dict | None = None) -> np.ndarray:
+    """Budget-aware stable sort permutation — THE sort entry for plane-
+    path ORDER BY / TopN / window ordering. `planes` follow the
+    np.lexsort convention (least-significant key first; each by-item
+    contributes a directed value plane then its directed NULL plane, the
+    executor's proven TopN key recipe). Below the device floor, or
+    without a resolved budget headroom problem, the answer is one
+    np.lexsort / one jitted kernels.sort_perm dispatch; an over-headroom
+    working set takes the partitioned external sort. All routes return
+    bit-identical permutations (stable; ties keep input order)."""
+    n = int(n)
+    if n <= 1:
+        return np.arange(n, dtype=np.int64)
+    host = [np.asarray(p) for p in planes]
+    budget = membudget.budget_bytes()
+    if n < SORT_DEVICE_FLOOR or budget <= 0:
+        # below the device floor, or budget 0 (the kill switch and the
+        # differential oracle): the host comparator — bit-identical to
+        # every other route by construction
+        return np.lexsort(host)
+    from tidb_tpu import tracing
+    from tidb_tpu.ops import kernels
+    est = sort_bytes_estimate(host, n)
+    if est <= membudget.headroom():
+        try:
+            with membudget.reserve(est, "sort"):
+                return kernels.sort_perm(host, n)
+        except errors.DeviceError:
+            # certified host rung: np.lexsort is the same comparator
+            tracing.record_degraded("spill_sort")
+            return np.lexsort(host)
+    return _partitioned_sort(host, n, est, stats)
+
+
+def _partitioned_sort(planes, n: int, est: int,
+                      stats: dict | None) -> np.ndarray:
+    """Range-partitioned external sort: a worklist of (rows, key level)
+    jobs in primary-key order. Oversized jobs split by value pivots;
+    jobs tied on the current key descend to the next key (the two-level
+    hot-key split — counted `copr.spill.salted_splits`); jobs tied on
+    EVERY key emit in stable input order without a dispatch. Completed
+    jobs are checkpoints: a DeviceError mid-pass halves the pass target
+    (the P×2 escalation, expressed bytes-first) and re-splits only the
+    unfinished jobs."""
+    import time as _time
+
+    from tidb_tpu import metrics, tracing
+    budget = membudget.budget_bytes()
+    target = _pass_target(budget)
+    levels = len(planes) // 2
+    jobs: list = [(np.arange(n, dtype=np.int64), 0)]
+    results: list = []
+    passes = escalations = salted = 0
+    host_rung = False
+    metrics.counter("copr.spill.sorts").inc()
+    sp = tracing.current().child("partitioned_sort") \
+        .set("rows", n).set("keys", levels)
+    t0 = _time.perf_counter()
+    if stats is not None:
+        stats["spilled"] = True
+    from tidb_tpu.ops import kernels
+    i = 0
+    while i < len(jobs):
+        rows, level = jobs[i]
+        if rows.size <= 1:
+            results.append(rows)
+            i += 1
+            continue
+        jest = sort_bytes_estimate(planes, rows.size)
+        if not host_rung and jest > target:
+            subs = _split_job(planes, rows, level,
+                              pieces=min(8, -(-jest // target)))
+            if len(subs) > 1:
+                jobs[i:i + 1] = [(s, level) for s in subs]
+                continue
+            if level + 1 < levels:
+                # hot key: every row ties on this key group — re-split
+                # on the next key (the salted two-level split; answers
+                # unchanged because the tied group sorts purely by its
+                # remaining keys)
+                metrics.counter("copr.spill.salted_splits").inc()
+                salted += 1
+                jobs[i] = (rows, level + 1)
+                continue
+            # tied on every key: the stable order IS the input order
+            results.append(rows)
+            i += 1
+            continue
+        if host_rung or rows.size < SORT_DEVICE_FLOOR:
+            results.append(rows[np.lexsort([p[rows] for p in planes])])
+            i += 1
+            continue
+        try:
+            with membudget.reserve(jest, "sort_pass"):
+                perm = kernels.sort_perm([p[rows] for p in planes],
+                                         rows.size)
+            results.append(rows[perm])
+            passes += 1
+            metrics.counter("copr.spill.sort_passes").inc()
+            i += 1
+        except errors.DeviceError:
+            escalations += 1
+            metrics.counter("copr.spill.escalations").inc()
+            if results:
+                # pass-level checkpoint: completed partitions keep
+                # their sorted slices; only unfinished jobs replay
+                metrics.counter("copr.spill.checkpoint_hits") \
+                    .inc(len(results))
+            if escalations > membudget.MAX_ESCALATIONS:
+                # certified last rung: host lexsort for what remains
+                # (identical comparator, so answers are unchanged)
+                tracing.record_degraded("spill_sort")
+                host_rung = True
+                continue
+            tracing.record_degraded("spill_partition")
+            target = max(target // 2, 1)
+    order = np.concatenate(results) if results \
+        else np.zeros(0, np.int64)
+    sp.set("passes", passes).set("partitions", len(results)) \
+        .set("escalations", escalations).set("salted", salted) \
+        .set("elapsed_us", round((_time.perf_counter() - t0) * 1e6, 1)) \
+        .finish()
+    if stats is not None:
+        stats["sort_passes"] = passes
+        stats["sort_partitions"] = len(results)
+        stats["sort_escalations"] = escalations
+        stats["sort_salted"] = salted
+        stats["sort_host_rung"] = host_rung
+    return order
+
+
+# ---------------------------------------------------------------------------
+# spilling group-by states
+# ---------------------------------------------------------------------------
+
+# states working-set model per row: each device spec ships an 8-byte
+# value plane and a 1-byte contrib plane and flows through one segment
+# reduction (~2x), plus the shared 8-byte gid plane; each segment slot
+# holds a 16-byte packed (hi, lo) state per spec
+STATES_ROW_BYTES_PER_SPEC = 17
+STATES_SEG_BYTES_PER_SPEC = 16
+
+
+def states_bytes_estimate(segs) -> int:
+    total = 0
+    for gid, specs, g in segs:
+        nspecs = max(len(specs), 1)
+        total += len(gid) * (nspecs * STATES_ROW_BYTES_PER_SPEC + 8) \
+            + (int(g) + 1) * nspecs * STATES_SEG_BYTES_PER_SPEC
+    return int(total)
+
+
+def states_over_headroom(segs) -> bool:
+    """A resolved budget and a states working set over the ledger's
+    headroom — the raw spill trigger, BEFORE the arg-plane test. A
+    caller that can lower arg-plane programs to the host exprc rung
+    (bit-identical by construction) checks this one, lowers, and hands
+    the now-plain reductions to region_states_spill."""
+    if membudget.budget_bytes() <= 0:
+        return False
+    return states_bytes_estimate(segs) > membudget.headroom()
+
+
+def states_should_spill(segs) -> bool:
+    """True when the batched states dispatch for `segs` (the
+    region_agg_states_batched contract) should partition AS GIVEN: a
+    resolved budget, no row-space (arg-plane) readbacks — those are
+    row-aligned and cannot partition by group without lowering — and a
+    states working set over the ledger's headroom."""
+    for _gid, specs, _g in segs:
+        for _op, vals, _ok in specs:
+            if getattr(vals, "is_arg_plane", False):
+                return False
+    return states_over_headroom(segs)
+
+
+def region_states_spill(segs, stats: dict | None = None) -> list:
+    """Per-group partial states for every region of one statement, in
+    group-radix-partitioned passes through the existing
+    kernels.region_agg_states_batched dispatch — same contract, same
+    outputs, bounded per-pass working set.
+
+    Equal group ids share a partition (splitmix64 over the dense group
+    index), so each group's rows land in exactly ONE pass in original
+    relative order and its states scatter straight into the output —
+    int SUM/COUNT/MIN/MAX are order-free monoids and float SUM never
+    rides the device states path, so every pass is bit-exact. Completed
+    partitions checkpoint across device/oom escalations (P×2, replaying
+    only unfinished groups); a single hot group splits its ROWS by a
+    salted positional hash and merges the partial states host-side
+    (monoid combine — exact for every device op). Escalation past the
+    bounds raises DeviceError: the caller's serial/host states rung
+    answers (counted copr.degraded_spill_groupby there)."""
+    import time as _time
+
+    from tidb_tpu import failpoint, metrics, tracing
+    from tidb_tpu.ops import kernels
+
+    nregions = len(segs)
+    gids = [np.asarray(g, np.int64) for g, _s, _G in segs]
+    caps = [int(g) for _g, _s, g in segs]
+    specs_h = []
+    for _gid, specs, _g in segs:
+        row = []
+        for op, vals, ok in specs:
+            v = None if vals is None else np.asarray(vals)
+            row.append((op, v, np.asarray(ok, bool)))
+        specs_h.append(row)
+    budget = membudget.budget_bytes()
+    est = states_bytes_estimate(segs)
+    target = _pass_target(budget)
+    parts = membudget.MIN_PARTITIONS
+    while parts < membudget.MAX_PARTITIONS and est // parts > target:
+        parts *= 2
+    metrics.counter("copr.spill.groupbys").inc()
+    sp = tracing.current().child("spill_groupby") \
+        .set("regions", nregions).set("groups", sum(caps)) \
+        .set("partitions", parts)
+    t0 = _time.perf_counter()
+    outs = []
+    for r in range(nregions):
+        row = []
+        for op, v, _ok in specs_h[r]:
+            dt = np.float64 if (v is not None
+                                and v.dtype == np.float64) else np.int64
+            row.append(np.zeros(caps[r], dt))
+        outs.append(row)
+    done = [np.zeros(g, bool) for g in caps]
+    passes = escalations = salted = completed = 0
+    if stats is not None:
+        stats["spilled"] = True
+    while True:
+        codes = [membudget.partition_codes(
+            np.arange(g, dtype=np.int64), np.ones(g, bool), parts)
+            for g in caps]
+        fault = None
+        # continue-on-fault: a partition that OOMs stays not-done and
+        # replays next round at 2P; the rest of this round still runs,
+        # so completed partitions are never re-dispatched
+        for p in range(parts):
+            gsel = [np.flatnonzero((codes[r] == p) & ~done[r])
+                    for r in range(nregions)]
+            n_groups = sum(len(g) for g in gsel)
+            if n_groups == 0:
+                continue
+            luts, rsels = [], []
+            pass_rows = 0
+            nspecs = max(len(specs_h[0]), 1)
+            for r in range(nregions):
+                lut = np.full(caps[r] + 1, len(gsel[r]), np.int64)
+                lut[gsel[r]] = np.arange(len(gsel[r]), dtype=np.int64)
+                rsel = np.flatnonzero(lut[gids[r]] < len(gsel[r]))
+                luts.append(lut)
+                rsels.append(rsel)
+                pass_rows += len(rsel)
+            pass_est = pass_rows * (nspecs * STATES_ROW_BYTES_PER_SPEC
+                                    + 8) \
+                + n_groups * nspecs * STATES_SEG_BYTES_PER_SPEC
+            try:
+                if failpoint._active:
+                    failpoint.eval(
+                        "device/oom", lambda: errors.DeviceError(
+                            "injected device OOM (states pass)"))
+                if pass_est > target \
+                        and all(len(g) <= 1 for g in gsel) \
+                        and pass_rows >= 2:
+                    # hot group: radix escalation can never separate
+                    # one group id — salted positional row split,
+                    # partial states merge by monoid (exact)
+                    chunk_outs = _salted_states_chunks(
+                        kernels, specs_h, gids, luts, rsels, gsel,
+                        pass_est, target, escalations)
+                    metrics.counter("copr.spill.salted_splits").inc()
+                    salted += 1
+                    passes += len(chunk_outs)
+                    metrics.counter("copr.spill.groupby_passes") \
+                        .inc(len(chunk_outs))
+                    merged = _merge_states_chunks(specs_h, gsel,
+                                                  chunk_outs)
+                else:
+                    sub_segs = []
+                    for r in range(nregions):
+                        gl = luts[r][gids[r][rsels[r]]]
+                        sub_specs = [
+                            (op,
+                             None if v is None else v[rsels[r]],
+                             ok[rsels[r]])
+                            for op, v, ok in specs_h[r]]
+                        sub_segs.append((gl, sub_specs, len(gsel[r])))
+                    with membudget.reserve(pass_est, "states_pass"):
+                        merged = kernels.region_agg_states_batched(
+                            sub_segs)
+                    passes += 1
+                    metrics.counter("copr.spill.groupby_passes").inc()
+            except errors.DeviceError as e:
+                fault = e
+                continue
+            for r in range(nregions):
+                for j in range(len(specs_h[r])):
+                    if len(gsel[r]):
+                        outs[r][j][gsel[r]] = merged[r][j]
+                done[r][gsel[r]] = True
+            completed += 1
+        if fault is None:
+            break
+        escalations += 1
+        metrics.counter("copr.spill.escalations").inc()
+        if completed:
+            # pass-level checkpoint: completed partitions keep their
+            # states; the replay touches only not-done groups
+            metrics.counter("copr.spill.checkpoint_hits").inc(completed)
+        if escalations > membudget.MAX_ESCALATIONS \
+                or parts * 2 > membudget.MAX_PARTITIONS:
+            sp.set("error", "oom").finish()
+            raise fault
+        tracing.record_degraded("spill_partition")
+        parts *= 2
+    sp.set("passes", passes).set("escalations", escalations) \
+        .set("salted", salted) \
+        .set("elapsed_us", round((_time.perf_counter() - t0) * 1e6, 1)) \
+        .finish()
+    if stats is not None:
+        stats["states_passes"] = passes
+        stats["states_partitions"] = parts
+        stats["states_escalations"] = escalations
+        stats["states_salted"] = salted
+    return outs
+
+
+def _salted_states_chunks(kernels, specs_h, gids, luts, rsels, gsel,
+                          pass_est: int, target: int,
+                          escalations: int) -> list:
+    """Dispatch one hot-group pass as salted row chunks: rows split by
+    splitmix64 over their (salted) global positions — order-free because
+    every device states op is a commutative monoid. Returns the list of
+    per-chunk region_agg_states_batched outputs."""
+    nregions = len(specs_h)
+    chunks = max(2, -(-pass_est // target)) << escalations
+    chunks = min(chunks, MAX_SALTED_CHUNKS)
+    salt = np.int64(0x5D4)    # decorrelate from the key-radix hash
+    chunk_outs = []
+    for c in range(chunks):
+        sub_segs = []
+        empty = True
+        for r in range(nregions):
+            rs = rsels[r]
+            hashed = membudget.partition_codes(
+                np.bitwise_xor(rs, salt), np.ones(len(rs), bool), chunks)
+            crs = rs[hashed == c]
+            if len(crs):
+                empty = False
+            gl = luts[r][gids[r][crs]]
+            sub_specs = [(op, None if v is None else v[crs], ok[crs])
+                         for op, v, ok in specs_h[r]]
+            sub_segs.append((gl, sub_specs, len(gsel[r])))
+        if empty:
+            continue
+        with membudget.reserve(max(pass_est // chunks, 1),
+                               "states_pass"):
+            chunk_outs.append(kernels.region_agg_states_batched(
+                sub_segs))
+    return chunk_outs
+
+
+def _merge_states_chunks(specs_h, gsel, chunk_outs) -> list:
+    """Monoid-combine per-chunk partial states: sums/counts add, mins
+    take np.minimum, maxes np.maximum — exact for every op the device
+    states path carries (int sums, int/float min/max; empty-chunk
+    identities are 0 / ±sentinel and combine neutrally)."""
+    nregions = len(specs_h)
+    merged = []
+    for r in range(nregions):
+        row = []
+        for j, (op, _v, _ok) in enumerate(specs_h[r]):
+            acc = None
+            for co in chunk_outs:
+                part = np.asarray(co[r][j])
+                if acc is None:
+                    acc = part.copy()
+                elif op == "min":
+                    acc = np.minimum(acc, part)
+                elif op == "max":
+                    acc = np.maximum(acc, part)
+                else:
+                    acc = acc + part
+            if acc is None:
+                acc = np.zeros(len(gsel[r]), np.int64)
+            row.append(acc)
+        merged.append(row)
+    return merged

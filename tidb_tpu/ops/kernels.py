@@ -2108,3 +2108,238 @@ def build_topn_fn_multi(where: CompiledExpr | None,
         n_live = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), k)
         return idx, n_live
     return fn
+
+
+# ---------------------------------------------------------------------------
+# external sort (PR 20): ONE jitted stable-lexsort dispatch returns the
+# sort permutation over directed key planes. int64 keys sort RADIX-
+# DECOMPOSED into (hi, lo) 32-bit digit words — the PR 8 _distinct_reduce
+# discipline: lexicographic digit order equals int64 order and two native
+# 32-bit digit compares beat one x64-emulated 64-bit compare on TPU. The
+# membudget-aware partitioned driver lives in ops/extsort.py; this kernel
+# is one pass.
+# ---------------------------------------------------------------------------
+
+_sort_perm_cache: dict = {}
+
+
+def sort_perm(planes: list, n_rows: int) -> np.ndarray:
+    """Stable sort permutation for directed key planes in ONE jitted
+    dispatch. `planes` follow the np.lexsort convention — LEAST
+    significant key first, direction/NULL encoding already applied by
+    the caller — so the result is bit-identical to np.lexsort(planes)
+    (jnp.lexsort is stable; ties keep input order). f64 keys sort
+    natively (a f64→i64 bitcast is rejected by the TPU x64-emulation
+    rewrite); narrow int keys ride as int32 digits. Rows pad to the
+    power-of-two capacity bucket with a most-significant liveness key so
+    padding sorts last and never retraces per exact row count. Faults
+    (incl. the device/oom failpoint — this is a spill PASS) raise typed
+    DeviceError so the out-of-core driver can escalate or degrade."""
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
+    from tidb_tpu import tracing as _tracing
+
+    n = int(n_rows)
+    cap = col.bucket_capacity(max(n, 1))
+    dtypes = tuple(str(np.asarray(p).dtype) for p in planes)
+    key = (cap, dtypes)
+    ent = _sort_perm_cache.get(key)
+    miss = ent is None
+    _tracing.record_jit_cache(hit=ent is not None)
+    if ent is None:
+        def fn(arrs, n_live):
+            keys = []
+            for a in arrs:
+                if a.dtype == jnp.int64:
+                    hi, lo = _radix_words(a)
+                    keys.append(lo)   # less significant digit first
+                    keys.append(hi)
+                elif a.dtype == jnp.float64:
+                    keys.append(a)
+                else:
+                    keys.append(a.astype(jnp.int32))
+            # pads sort last: liveness is the MOST significant key
+            keys.append((jnp.arange(cap, dtype=jnp.int32)
+                         >= n_live).astype(jnp.int32))
+            return jnp.lexsort(keys).astype(jnp.int64)
+
+        ent = _sort_perm_cache[key] = jax.jit(fn)
+        if len(_sort_perm_cache) > 256:
+            _sort_perm_cache.pop(next(iter(_sort_perm_cache)))
+    jitted = ent
+    sp = _tracing.current().child("sort_perm") \
+        .set("rows", n).set("keys", len(planes))
+    t0 = _time.perf_counter()
+    try:
+        if _failpoint._active:
+            _failpoint.eval("device/oom",
+                            lambda: _errors.DeviceError(
+                                "injected device OOM (sort pass)"))
+        arrs = []
+        h2d = 0
+        for p in planes:
+            a = np.asarray(p)
+            if a.shape[0] != cap:
+                a = np.concatenate(
+                    [a, np.zeros(cap - a.shape[0], dtype=a.dtype)])
+            h2d += int(a.nbytes)
+            arrs.append(jnp.asarray(a))
+        with dispatch_serial:
+            perm = np.asarray(jitted(tuple(arrs), n))
+            dispatch_serial.annotate(
+                "sort_perm", f"{len(planes)}k/{cap}r", rows=n,
+                readback_bytes=int(perm.nbytes), h2d_bytes=h2d,
+                jit_miss=miss)
+    except _errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash in the sort kernel: typed, so the
+        # external-sort driver escalates partitions or lands on the
+        # host lexsort (same comparator) instead of erroring
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(f"device sort pass failed: {e}") from e
+    sp.set("readbacks", 1).set("readback_bytes", int(perm.nbytes))
+    sp.finish()
+    _tracing.record_dispatch(
+        readback_bytes=int(perm.nbytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    return perm[:n]
+
+
+# ---------------------------------------------------------------------------
+# window frame reductions (PR 20): ONE jitted segment-scan dispatch over
+# PRESORTED planes computes every ranking and default-frame aggregate of
+# a window spec. The frame is the MySQL default with ORDER BY — RANGE
+# UNBOUNDED PRECEDING .. CURRENT ROW, i.e. partition start through the
+# current row's last PEER — so every figure is a prefix reduction gathered
+# at peer boundaries: cumsum differencing for SUM/COUNT, a segmented
+# associative min/max scan for MIN/MAX. Scatter-free throughout.
+# ---------------------------------------------------------------------------
+
+_window_scan_cache: dict = {}
+
+
+def window_scan(seg, peer, specs: list, n_rows: int) -> list:
+    """Per-row window figures over presorted planes in ONE dispatch.
+
+    seg / peer: int64 partition codes and global peer-group ids, both
+    monotone non-decreasing in the presorted row order (peer ids are
+    globally monotone: a new partition always opens a new peer group).
+    specs entries are ("row_number"|"rank"|"dense_rank", None, None) or
+    ("sum"|"count"|"min"|"max", vals int64, contrib bool). All outputs
+    are exact int64 [n_rows] planes; SUM/MIN/MAX NULL-ness is derived by
+    the caller from a COUNT spec over the same contrib (frame valid
+    count 0 → NULL). Float SUM never rides this kernel — the executor
+    keeps the host row-order accumulator for bit parity. Faults (incl.
+    the device/window_scan failpoint) raise typed DeviceError so the
+    executor degrades to the host numpy rung (same formulas)."""
+    from tidb_tpu import errors as _errors, failpoint as _failpoint
+    from tidb_tpu import tracing as _tracing
+
+    n = int(n_rows)
+    cap = col.bucket_capacity(max(n, 1))
+    ops = tuple(op for op, _v, _c in specs)
+    key = (cap, ops)
+    ent = _window_scan_cache.get(key)
+    miss = ent is None
+    _tracing.record_jit_cache(hit=ent is not None)
+    if ent is None:
+        def fn(arrs, _live):
+            sg, pr = arrs[0], arrs[1]
+            pos = jnp.arange(cap, dtype=jnp.int64)
+            s = jnp.searchsorted(sg, sg, side="left")    # partition start
+            p = jnp.searchsorted(pr, pr, side="left")    # peer start
+            e = jnp.searchsorted(pr, pr, side="right") - 1  # frame end
+            is_start = pos == s
+            outs = []
+            i = 2
+            for op in ops:
+                if op == "row_number":
+                    outs.append(pos - s + 1)
+                    continue
+                if op == "rank":
+                    outs.append(p - s + 1)
+                    continue
+                if op == "dense_rank":
+                    outs.append(pr - jnp.take(pr, s) + 1)
+                    continue
+                vals, contrib = arrs[i], arrs[i + 1]
+                i += 2
+                if op in ("sum", "count"):
+                    c = contrib.astype(jnp.int64) if op == "count" \
+                        else jnp.where(contrib, vals,
+                                       jnp.zeros_like(vals))
+                    cs = jnp.concatenate(
+                        [jnp.zeros(1, jnp.int64), jnp.cumsum(c)])
+                    outs.append(jnp.take(cs, e + 1) - jnp.take(cs, s))
+                    continue
+                sent = I64_MAX if op == "min" else I64_MIN
+                v = jnp.where(contrib, vals, jnp.asarray(sent, jnp.int64))
+
+                def comb(a, b, _min=(op == "min")):
+                    av, af = a
+                    bv, bf = b
+                    red = jnp.minimum(av, bv) if _min \
+                        else jnp.maximum(av, bv)
+                    return (jnp.where(bf, bv, red), af | bf)
+
+                run, _ = jax.lax.associative_scan(comb, (v, is_start))
+                outs.append(jnp.take(run, e))
+            return tuple(outs)
+
+        wrapper = pack_outputs(fn)
+        ent = _window_scan_cache[key] = (wrapper, jax.jit(wrapper))
+        if len(_window_scan_cache) > 256:
+            _window_scan_cache.pop(next(iter(_window_scan_cache)))
+    wrapper, jitted = ent
+    sp = _tracing.current().child("window_scan") \
+        .set("rows", n).set("specs", len(specs))
+    t0 = _time.perf_counter()
+    try:
+        if _failpoint._active:
+            _failpoint.eval("device/window_scan",
+                            lambda: _errors.DeviceError(
+                                "injected window-scan kernel failure"))
+        sg = np.asarray(seg, np.int64)
+        pr = np.asarray(peer, np.int64)
+        if n == 0:
+            raise _errors.DeviceError("window_scan over zero rows")
+        if cap != n:
+            # pads extend the last peer group with non-contributing
+            # rows: every real row's frame figures are unchanged
+            sg = np.concatenate([sg, np.full(cap - n, sg[-1], np.int64)])
+            pr = np.concatenate([pr, np.full(cap - n, pr[-1], np.int64)])
+        arrs = [jnp.asarray(sg), jnp.asarray(pr)]
+        h2d = int(sg.nbytes + pr.nbytes)
+        for op, vals, contrib in specs:
+            if op in ("row_number", "rank", "dense_rank"):
+                continue
+            v = np.zeros(cap, np.int64)
+            ok = np.zeros(cap, bool)
+            if vals is not None:
+                v[:n] = np.asarray(vals, np.int64)
+            ok[:n] = np.asarray(contrib, bool)
+            h2d += int(v.nbytes + ok.nbytes)
+            arrs.append(jnp.asarray(v))
+            arrs.append(jnp.asarray(ok))
+        with dispatch_serial:
+            host = np.asarray(jitted(tuple(arrs), None))
+            dispatch_serial.annotate(
+                "window_scan", f"{len(specs)}sp/{cap}r", rows=n,
+                readback_bytes=int(host.nbytes), h2d_bytes=h2d,
+                jit_miss=miss)
+    except _errors.TiDBError:
+        sp.set("error", "fault").finish()
+        raise
+    except Exception as e:
+        # dispatch/readback crash in the scan kernel: typed, so the
+        # window executor degrades to the host numpy rung
+        sp.set("error", "fault").finish()
+        raise _errors.DeviceError(f"window scan failed: {e}") from e
+    sp.set("readbacks", 1).set("readback_bytes", int(host.nbytes))
+    sp.finish()
+    _tracing.record_dispatch(
+        readback_bytes=int(host.nbytes),
+        dispatch_us=(_time.perf_counter() - t0) * 1e6)
+    outs = unpack_outputs(wrapper, host)
+    return [np.atleast_1d(np.asarray(o))[:n] for o in outs]

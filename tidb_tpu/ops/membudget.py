@@ -73,6 +73,10 @@ AUTO_BUDGET_FRACTION = 0.85
 # each device/oom, and gives up (DeviceError → the caller's host rung)
 # past MAX_PARTITIONS or MAX_ESCALATIONS
 MIN_PARTITIONS = 2
+
+# bound on the salted secondary split of a hot-key partition (probe
+# chunks × build blocks) — a hot key stops pinning a pass long before
+MAX_SALTED_CHUNKS = 64
 MAX_PARTITIONS = 1024
 MAX_ESCALATIONS = 4
 
@@ -254,17 +258,78 @@ def would_exceed_pin(nbytes: int) -> bool:
         return _pinned + _reserved + int(nbytes) > budget
 
 
+# --- backend allocator reconciliation (PR 15 residual a) -------------------
+# When the backend reports allocator stats (memory_stats() with
+# bytes_in_use — TPU/GPU rigs; the CPU-XLA tier-1 rig reports None and
+# pays nothing), every scoped reservation compares its row-byte ESTIMATE
+# against the measured allocator delta and publishes the ratio as the
+# `device.hbm.estimate_error_ratio` gauge — the one signal that says
+# whether the ledger's byte model tracks reality on this rig. Tests
+# inject a provider via set_stats_provider, so the reconciliation is
+# rig-independent.
+
+_stats_provider = None
+_stats_checked = False
+
+
+def set_stats_provider(fn) -> None:
+    """Install the allocator-stats source (a callable returning a
+    memory_stats()-shaped dict, or None to fall back to backend
+    auto-detection). Test seam AND the operator hook for rigs whose
+    allocator sits outside jax."""
+    global _stats_provider, _stats_checked
+    with _lock:
+        _stats_provider = fn
+        _stats_checked = fn is not None
+
+
+def _detect_stats_provider() -> None:
+    """One-time probe: adopt the backend's memory_stats when it reports
+    real numbers. Never imports jax on its own (the module stays
+    jax-free until a dispatch has already paid for the import)."""
+    global _stats_provider, _stats_checked
+    import sys
+    _stats_checked = True
+    if sys.modules.get("jax") is None:
+        return
+    try:
+        import jax
+        dev = jax.devices()[0]
+        if dev.memory_stats() is not None:
+            _stats_provider = dev.memory_stats
+    except Exception:
+        pass
+
+
+def _measured_bytes():
+    """Allocator bytes_in_use right now, or None when unmeasurable."""
+    if not _stats_checked:
+        _detect_stats_provider()
+    fn = _stats_provider
+    if fn is None:
+        return None
+    try:
+        stats = fn()
+        if not stats:
+            return None
+        return int(stats.get("bytes_in_use", 0))
+    except Exception:
+        return None
+
+
 class _Reservation:
     """Scoped charge of a dispatch's transient device working set."""
 
-    __slots__ = ("nbytes", "kind")
+    __slots__ = ("nbytes", "kind", "_m0")
 
     def __init__(self, nbytes: int, kind: str):
         self.nbytes = int(nbytes)
         self.kind = kind
+        self._m0 = None
 
     def __enter__(self):
         global _reserved
+        self._m0 = _measured_bytes()
         with _lock:
             budget = _resolve_budget_locked()
             over = budget > 0 and \
@@ -288,6 +353,17 @@ class _Reservation:
 
     def __exit__(self, *exc):
         global _reserved
+        if self._m0 is not None and self.nbytes > 0:
+            m1 = _measured_bytes()
+            if m1 is not None:
+                # estimate reconciliation: measured allocator delta over
+                # the row-byte estimate. 1.0 = the model is exact; the
+                # gauge holds the LAST dispatch's ratio (a trend signal,
+                # not an average — the profiler's HW marks keep history)
+                from tidb_tpu import metrics
+                ratio = max(m1 - self._m0, 0) / self.nbytes
+                metrics.gauge("device.hbm.estimate_error_ratio").set(
+                    round(ratio, 6))
         with _lock:
             _reserved = max(_reserved - self.nbytes, 0)
             _res_by_kind[self.kind] = max(
@@ -465,96 +541,201 @@ def _partitioned_passes(lkey, lvalid, rkey, rvalid, parts: int, stats):
     """Grace-hash passes on one device: split both sides by key radix,
     run each partition through the existing build/probe kernels (one
     packed readback per pass), and merge the per-pass pairs back into
-    the single-pass emission order. A DeviceError mid-pass (real OOM or
-    the device/oom failpoint) escalates P ×2 and REPLAYS from scratch —
-    passes are read-only over the host planes, so a replay cannot
-    change answers. Escalation past the bounds raises DeviceError: the
-    caller's host numpy rung answers."""
+    the single-pass emission order.
+
+    Pass-level checkpointing: completed partitions mark their rows DONE
+    and keep their pairs, so a DeviceError mid-pass (real OOM or the
+    device/oom failpoint) escalates P ×2 and replays ONLY unfinished
+    partitions — sound because equal keys share a partition at every P,
+    so a partition's pair set is closed under re-partitioning (counted
+    `copr.spill.checkpoint_hits`). A partition still over the pass
+    target after an escalation because ONE key owns it re-splits by a
+    salted secondary hash on the probe side and contiguous blocks on the
+    build side (`copr.spill.salted_splits` — right-scan order within a
+    probe row is preserved by ascending build blocks, so the merged
+    pairs stay bit-identical). Escalation past the bounds raises
+    DeviceError: the caller's host numpy rung answers."""
     import time as _time
 
     from tidb_tpu import metrics, tracing
     from tidb_tpu.ops import kernels
-    escalations = 0
+    budget = budget_bytes()
+    target = max(headroom(), budget // 8, 1)
+    escalations = passes = completed = salted = 0
+    l_done = np.zeros(lkey.shape[0], bool)
+    r_done = np.zeros(rkey.shape[0], bool)
+    l_parts_out, r_parts_out = [], []
+    sp = tracing.current().child("partitioned_join") \
+        .set("partitions", parts) \
+        .set("rows_left", int(lkey.shape[0])) \
+        .set("rows_right", int(rkey.shape[0]))
+    t0 = _time.perf_counter()
     while True:
-        sp = tracing.current().child("partitioned_join") \
-            .set("partitions", parts) \
-            .set("rows_left", int(lkey.shape[0])) \
-            .set("rows_right", int(rkey.shape[0]))
-        t0 = _time.perf_counter()
-        try:
-            l_part = partition_codes(lkey, lvalid, parts)
-            r_part = partition_codes(rkey, rvalid, parts)
-            l_parts_out, r_parts_out = [], []
-            passes = 0
-            for p in range(parts):
-                l_loc = np.flatnonzero(l_part == p)
-                r_loc = np.flatnonzero(r_part == p)
-                # a pass that provably produces no pairs — no probe
-                # rows, no valid probe keys (NULLs home at partition
-                # 0), or no valid build rows — skips its dispatches
-                # entirely; the emitted pairs are identical (LEFT OUTER
-                # pads are the executor's job, off missing l indices)
-                if not len(l_loc) or not lvalid[l_loc].any() \
-                        or not len(r_loc) or not rvalid[r_loc].any():
-                    continue
+        l_part = partition_codes(lkey, lvalid, parts)
+        r_part = partition_codes(rkey, rvalid, parts)
+        fault = None
+        # continue-on-fault: a partition that OOMs stays not-done and
+        # replays next round at 2P; the rest of this round still runs,
+        # so completed partitions are never re-dispatched
+        for p in range(parts):
+            l_loc = np.flatnonzero((l_part == p) & ~l_done)
+            r_loc = np.flatnonzero((r_part == p) & ~r_done)
+            if not len(l_loc) and not len(r_loc):
+                continue
+            # a pass that provably produces no pairs — no probe
+            # rows, no valid probe keys (NULLs home at partition
+            # 0), or no valid build rows — skips its dispatches
+            # entirely; the emitted pairs are identical (LEFT OUTER
+            # pads are the executor's job, off missing l indices)
+            if not len(l_loc) or not lvalid[l_loc].any() \
+                    or not len(r_loc) or not rvalid[r_loc].any():
+                l_done[l_loc] = True
+                r_done[r_loc] = True
+                continue
+            pass_bytes = join_bytes_estimate(len(l_loc), len(r_loc))
+            try:
                 if failpoint._active:
                     failpoint.eval(
                         "device/oom", lambda: errors.DeviceError(
                             "injected device OOM (partitioned join pass)"))
-                pass_bytes = join_bytes_estimate(len(l_loc), len(r_loc))
-                try:
+                if escalations and pass_bytes > target \
+                        and _single_key(lkey, lvalid, l_loc) \
+                        and _single_key(rkey, rvalid, r_loc):
+                    # hot key: radix escalation can never separate one
+                    # key's rows — salted two-level split
+                    lp, rp, n_sub = _salted_join_pass(
+                        kernels, lkey, lvalid, rkey, rvalid,
+                        l_loc, r_loc, pass_bytes, target, escalations)
+                    metrics.counter("copr.spill.salted_splits").inc()
+                    salted += 1
+                    passes += n_sub
+                    l_parts_out.extend(lp)
+                    r_parts_out.extend(rp)
+                else:
                     with reserve(pass_bytes, "join_pass"):
                         li, ri = kernels.join_match_pairs(
                             lkey[l_loc], lvalid[l_loc],
                             rkey[r_loc], rvalid[r_loc])
-                except errors.TiDBError:
+                    passes += 1
+                    metrics.counter("copr.partitioned_passes").inc()
+                    if len(li):
+                        l_parts_out.append(l_loc[li])
+                        # NULL-key probe rows ride partition 0 but
+                        # never match, so ri indexes real build rows
+                        r_parts_out.append(r_loc[ri])
+            except errors.TiDBError as e:
+                if not isinstance(e, errors.DeviceError):
+                    sp.set("error", "fault").finish()
                     raise
-                except Exception as e:
-                    # a REAL runtime fault mid-pass (XLA
-                    # RESOURCE_EXHAUSTED is not a TiDBError) must drive
-                    # the escalation, exactly like the injected one
-                    raise errors.DeviceError(
-                        f"partitioned join pass failed: {e}") from e
-                passes += 1
-                metrics.counter("copr.partitioned_passes").inc()
-                if len(li):
-                    l_parts_out.append(l_loc[li])
-                    # NULL-key probe rows ride partition 0 but never
-                    # match, so ri indexes real build rows only
-                    r_parts_out.append(r_loc[ri])
-        except errors.DeviceError:
+                fault = e
+                continue
+            except Exception as e:
+                # a REAL runtime fault mid-pass (XLA
+                # RESOURCE_EXHAUSTED is not a TiDBError) must drive
+                # the escalation, exactly like the injected one
+                fault = errors.DeviceError(
+                    f"partitioned join pass failed: {e}")
+                fault.__cause__ = e
+                continue
+            l_done[l_loc] = True
+            r_done[r_loc] = True
+            completed += 1
+        if fault is None:
+            break
+        escalations += 1
+        metrics.counter("copr.spill.escalations").inc()
+        if completed:
+            # pass-level checkpoint: completed partitions keep their
+            # pairs; the replay touches only not-done rows
+            metrics.counter("copr.spill.checkpoint_hits").inc(completed)
+        if escalations > MAX_ESCALATIONS or parts * 2 > MAX_PARTITIONS:
             sp.set("error", "oom").finish()
-            escalations += 1
-            if escalations > MAX_ESCALATIONS or \
-                    parts * 2 > MAX_PARTITIONS:
-                raise
-            tracing.record_degraded("partition")
-            parts *= 2
+            raise fault
+        tracing.record_degraded("partition")
+        parts *= 2
+    if l_parts_out:
+        l_all = np.concatenate(l_parts_out)
+        r_all = np.concatenate(r_parts_out)
+        # stable merge back to global left-scan order: each left
+        # row's matches live in exactly one pass (its key's
+        # partition) already in right-scan order, so this IS the
+        # single-pass emission order
+        perm = np.argsort(l_all, kind="stable")
+        l_all, r_all = l_all[perm], r_all[perm]
+    else:
+        l_all = np.zeros(0, np.int64)
+        r_all = np.zeros(0, np.int64)
+    sp.set("passes", passes).set("pairs", int(len(l_all))) \
+        .set("escalations", escalations).set("salted", salted) \
+        .set("elapsed_us", round((_time.perf_counter() - t0) * 1e6, 1)) \
+        .finish()
+    # per-pass kernel dispatches/readbacks are already tallied by
+    # kernels.join_match_pairs — no double counting here
+    if stats is not None:
+        stats["passes"] = passes
+        stats["partitions"] = parts
+        stats["partition_escalations"] = escalations
+        stats["salted_splits"] = salted
+        stats["path"] = "device"
+    return l_all, r_all
+
+
+def _single_key(key, valid, loc) -> bool:
+    """True when the partition's valid rows carry at most one distinct
+    key — the terminal case radix escalation cannot shrink."""
+    v = key[loc][valid[loc]]
+    if len(v) < 2:
+        return True
+    if v.dtype == np.float64:
+        v = np.where(v == 0.0, 0.0, v)
+    return bool((v == v[0]).all())
+
+
+def _salted_join_pass(kernels, lkey, lvalid, rkey, rvalid,
+                      l_loc, r_loc, pass_bytes: int, target: int,
+                      escalations: int):
+    """One hot-key partition as a blocked pass grid: probe rows split by
+    a salted positional splitmix64 hash (PR 15 residual d — the salt
+    decorrelates from the key radix that failed to split), build rows by
+    CONTIGUOUS position blocks. Every probe row lives in exactly one
+    probe chunk and meets the build blocks in ascending right-scan
+    order, so the caller's stable merge reproduces the single-pass pair
+    order exactly. Returns (l_pair_chunks, r_pair_chunks, n_passes)."""
+    from tidb_tpu import metrics
+    build_b = build_bytes_estimate(len(r_loc))
+    probe_b = max(pass_bytes - build_b, 0)
+    boost = 1 << min(escalations, 4)
+    bc = pc = 1
+    if build_b > target:
+        bc = min(MAX_SALTED_CHUNKS, max(2, -(-build_b // target)) * boost)
+    if probe_b > target:
+        pc = min(MAX_SALTED_CHUNKS, max(2, -(-probe_b // target)) * boost)
+    if bc == 1 and pc == 1:
+        pc = 2
+    salt = np.int64(0x5D4)
+    if pc > 1:
+        pchunk = partition_codes(np.bitwise_xor(l_loc, salt),
+                                 np.ones(len(l_loc), bool), pc)
+    else:
+        pchunk = np.zeros(len(l_loc), np.int64)
+    bbounds = np.linspace(0, len(r_loc), bc + 1).astype(np.int64)
+    lp, rp = [], []
+    n_sub = 0
+    for c in range(pc):
+        lc = l_loc[pchunk == c]
+        if not len(lc) or not lvalid[lc].any():
             continue
-        except errors.TiDBError:
-            sp.set("error", "fault").finish()
-            raise
-        if l_parts_out:
-            l_all = np.concatenate(l_parts_out)
-            r_all = np.concatenate(r_parts_out)
-            # stable merge back to global left-scan order: each left
-            # row's matches live in exactly one pass (its key's
-            # partition) already in right-scan order, so this IS the
-            # single-pass emission order
-            perm = np.argsort(l_all, kind="stable")
-            l_all, r_all = l_all[perm], r_all[perm]
-        else:
-            l_all = np.zeros(0, np.int64)
-            r_all = np.zeros(0, np.int64)
-        sp.set("passes", passes).set("pairs", int(len(l_all))) \
-            .set("escalations", escalations) \
-            .set("elapsed_us", round((_time.perf_counter() - t0) * 1e6, 1)) \
-            .finish()
-        # per-pass kernel dispatches/readbacks are already tallied by
-        # kernels.join_match_pairs — no double counting here
-        if stats is not None:
-            stats["passes"] = passes
-            stats["partitions"] = parts
-            stats["partition_escalations"] = escalations
-            stats["path"] = "device"
-        return l_all, r_all
+        for b in range(bc):
+            rc = r_loc[bbounds[b]:bbounds[b + 1]]
+            if not len(rc) or not rvalid[rc].any():
+                continue
+            with reserve(join_bytes_estimate(len(lc), len(rc)),
+                         "join_pass"):
+                li, ri = kernels.join_match_pairs(
+                    lkey[lc], lvalid[lc], rkey[rc], rvalid[rc])
+            n_sub += 1
+            metrics.counter("copr.partitioned_passes").inc()
+            if len(li):
+                lp.append(lc[li])
+                rp.append(rc[ri])
+    return lp, rp, n_sub

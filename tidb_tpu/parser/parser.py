@@ -23,6 +23,11 @@ from tidb_tpu.types.field_type import FieldType, new_field_type
 AGG_FUNCS = frozenset(("count", "sum", "avg", "min", "max", "group_concat",
                        "first_row"))
 
+# window functions the engine executes (parser.y WindowFuncCall subset):
+# rankings plus the frame reductions that ride the plane pipeline
+WINDOW_FUNCS = frozenset(("row_number", "rank", "dense_rank",
+                          "sum", "count", "min", "max"))
+
 
 def _split_sysvar_scope(name: str) -> tuple[bool, str]:
     """'global.x' → (True, 'x'); 'session.x' → (False, 'x'); else (False, name)."""
@@ -1702,6 +1707,8 @@ class Parser:
                     if not self._try_op(","):
                         break
             self._expect_op(")")
+            if self._at_over_clause():
+                return self._parse_window_func(name, args, distinct)
             return ast.AggregateFunc(name=name, args=args, distinct=distinct)
         args = []
         if not self._at_op(")"):
@@ -1710,7 +1717,46 @@ class Parser:
                 if not self._try_op(","):
                     break
         self._expect_op(")")
+        if self._at_over_clause():
+            return self._parse_window_func(name, args, False)
         return ast.FuncCall(name=name, args=args)
+
+    def _at_over_clause(self) -> bool:
+        # OVER is not a reserved word: a bare IDENT "over" only starts a
+        # window spec when "(" follows (SELECT over FROM t stays legal)
+        return self._at_word("OVER") \
+            and self.toks[self.pos + 1].tp == lx.OP \
+            and self.toks[self.pos + 1].val == "("
+
+    def _parse_window_func(self, name: str, args, distinct: bool) \
+            -> ast.WindowFunc:
+        """name(args) OVER ([PARTITION BY exprs] [ORDER BY by_items])
+        (parser.y WindowFuncCall + WindowSpec, the engine's subset)."""
+        self.pos += 1       # OVER
+        if name not in WINDOW_FUNCS:
+            self._fail(f"unsupported window function {name!r}")
+        if distinct:
+            self._fail("DISTINCT is not supported in window functions")
+        ranking = name in ("row_number", "rank", "dense_rank")
+        if ranking and args:
+            self._fail(f"{name}() takes no arguments")
+        if not ranking and len(args) != 1:
+            self._fail(f"window function {name}() takes one argument")
+        self._expect_op("(")
+        partition_by: list[ast.ExprNode] = []
+        order_by: list[ast.ByItem] = []
+        if self._try_word("PARTITION"):
+            self._expect_kw("BY")
+            while True:
+                partition_by.append(self._parse_expr())
+                if not self._try_op(","):
+                    break
+        if self._try_kw("ORDER"):
+            self._expect_kw("BY")
+            order_by = self._parse_by_items()
+        self._expect_op(")")
+        return ast.WindowFunc(name=name, args=args,
+                              partition_by=partition_by, order_by=order_by)
 
 
 def parse(sql: str) -> list[ast.StmtNode]:

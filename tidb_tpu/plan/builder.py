@@ -23,6 +23,7 @@ from tidb_tpu.plan.plans import (
     Aggregation, Apply, DataSource, Delete, Distinct, Exists, ExplainPlan,
     Insert, Join, Limit, MaxOneRow, Plan, Projection, Selection, SemiJoin,
     ShowPlan, SimplePlan, Sort, SortItem, TableDual, Union, Update,
+    Window, WindowFuncDesc,
 )
 from tidb_tpu.sqlast.opcode import Op
 from tidb_tpu.types import Datum
@@ -293,9 +294,28 @@ class PlanBuilder:
         for item in sel.order_by:
             _collect_aggs(item.expr, agg_nodes)
 
+        # window functions live in the select list only (the Window node
+        # sits above aggregation / below the final projection); anywhere
+        # else the rewriter raises "misplaced window function"
+        win_nodes: list = []
+        for f in fields:
+            _collect_windows(f.expr, win_nodes)
+        misplaced: list = []
+        if sel.where is not None:
+            _collect_windows(sel.where, misplaced)
+        if sel.having is not None:
+            _collect_windows(sel.having, misplaced)
+        for item in list(sel.group_by) + list(sel.order_by):
+            _collect_windows(item.expr, misplaced)
+        if misplaced:
+            raise errors.PlanError(
+                "window functions are only allowed in the select list")
+
         mapper: dict[int, Column] = {}
         if agg_nodes or sel.group_by:
             p = self._build_aggregation(p, fields, sel, agg_nodes, mapper)
+        if win_nodes:
+            p = self._build_window(p, win_nodes, mapper)
 
         # final projection (subqueries in the select list / HAVING may wrap
         # the plan in Apply/SemiJoin nodes through `holder`)
@@ -480,6 +500,36 @@ class PlanBuilder:
             group_exprs.append(e)
         agg.group_by = group_exprs
         return agg
+
+    def _build_window(self, p: Plan, win_nodes, mapper: dict) -> Plan:
+        """Window node above p (and above any aggregation — window
+        arguments may reference aggregate results through the mapper):
+        schema = child columns + one appended column per window call.
+        Frame reductions type exactly like their aggregate namesakes
+        (int SUM → Decimal, COUNT → bigint), rankings type as bigint."""
+        descs = []
+        schema = Schema([c.clone() for c in p.schema])
+        for node in win_nodes:
+            args = [self.rewrite(a, p.schema, mapper) for a in node.args]
+            pby = [self.rewrite(e, p.schema, mapper)
+                   for e in node.partition_by]
+            oby = [SortItem(self.rewrite(it.expr, p.schema, mapper),
+                            it.desc) for it in node.order_by]
+            if node.name in ("row_number", "rank", "dense_rank"):
+                rt = AggregationFunction(
+                    "count", [Constant(Datum.i64(1))]).ret_type()
+            else:
+                wargs = args or [Constant(Datum.i64(1))]
+                rt = AggregationFunction(node.name, wargs).ret_type()
+            col = Column(col_name=_window_name(node), ret_type=rt,
+                         position=len(schema))
+            schema.append(col)
+            descs.append(WindowFuncDesc(node.name, args, pby, oby))
+            mapper[id(node)] = col
+        w = Window(descs)
+        w.add_child(p)
+        w.set_schema(schema)
+        return w
 
     def _resolve_by_item(self, expr, fields, schema: Schema, mapper) -> Expression:
         """GROUP BY / ORDER BY item: positional ints and select aliases
@@ -774,6 +824,12 @@ class PlanBuilder:
                     raise errors.PlanError(
                         f"misplaced aggregate function {n.name}()")
                 return col.clone()
+            if isinstance(n, ast.WindowFunc):
+                col = m.get(id(n))
+                if col is None:
+                    raise errors.PlanError(
+                        f"misplaced window function {n.name}()")
+                return col.clone()
             if isinstance(n, ast.BinaryOp):
                 # date +/- INTERVAL lowers to date_add/date_sub
                 # (parser.y DateArithOpt → ast.FuncDateArith)
@@ -941,6 +997,16 @@ def _collect_aggs(node, out: list) -> None:
         _collect_aggs(child, out)
 
 
+def _collect_windows(node, out: list) -> None:
+    if node is None:
+        return
+    if isinstance(node, ast.WindowFunc):
+        out.append(node)
+        return  # no nested window functions
+    for child in _ast_children(node):
+        _collect_windows(child, out)
+
+
 def _collect_bare_columns(node, out: list, in_agg: bool = False) -> None:
     if node is None:
         return
@@ -961,6 +1027,11 @@ def _ast_children(node):
         return [node.operand]
     if isinstance(node, (ast.FuncCall, ast.AggregateFunc)):
         return list(node.args)
+    if isinstance(node, ast.WindowFunc):
+        # args + window-spec expressions: nested aggregates collect and
+        # bare columns get first_row treatment through the same walks
+        return list(node.args) + list(node.partition_by) \
+            + [it.expr for it in node.order_by]
     if isinstance(node, ast.Between):
         return [node.expr, node.low, node.high]
     if isinstance(node, ast.InExpr):
@@ -1040,6 +1111,8 @@ def _field_name(expr) -> str:
         return expr.name
     if isinstance(expr, ast.AggregateFunc):
         return _agg_name(expr)
+    if isinstance(expr, ast.WindowFunc):
+        return _window_name(expr)
     if isinstance(expr, ast.FuncCall):
         return f"{expr.name}(...)"
     text = getattr(expr, "text", "") or ""
@@ -1051,6 +1124,12 @@ def _agg_name(node: "ast.AggregateFunc") -> str:
         a.name if isinstance(a, ast.ColumnName) else "..." for a in node.args)
     d = "distinct " if node.distinct else ""
     return f"{node.name.lower()}({d}{inner})"
+
+
+def _window_name(node: "ast.WindowFunc") -> str:
+    inner = "" if not node.args else ", ".join(
+        a.name if isinstance(a, ast.ColumnName) else "..." for a in node.args)
+    return f"{node.name.lower()}({inner}) over (..)"
 
 
 # functions whose value depends on more than their arguments — never

@@ -29,8 +29,8 @@ from tidb_tpu.plan.plans import (
     PhysicalIndexScan, PhysicalLimit, PhysicalMaxOneRow, PhysicalProjection,
     PhysicalSelection, PhysicalSort, PhysicalStreamAgg, PhysicalTableDual,
     PhysicalTableScan, PhysicalTopN, PhysicalUnion, PhysicalUnionScan,
-    Projection, Selection,
-    SemiJoin, Sort, SortItem, TableDual, Union, Update,
+    PhysicalWindow, Projection, Selection,
+    SemiJoin, Sort, SortItem, TableDual, Union, Update, Window,
 )
 from tidb_tpu.types.field_type import FieldType, new_field_type
 
@@ -94,6 +94,12 @@ def to_physical(p: Plan, ctx: PhysicalContext) -> Plan:
         srt.add_child(child)
         srt.schema = child.schema
         return srt
+    if isinstance(p, Window):
+        child = to_physical(p.child, ctx)
+        w = PhysicalWindow(p.window_funcs)
+        w.add_child(child)
+        w.schema = p.schema
+        return w
     if isinstance(p, Join):
         left = to_physical(p.children[0], ctx)
         right = to_physical(p.children[1], ctx)

@@ -110,6 +110,38 @@ class SortItem:
         return f"{self.expr!r}{' desc' if self.desc else ''}"
 
 
+class WindowFuncDesc:
+    """One window call inside a Window plan node: rewritten argument /
+    PARTITION BY / ORDER BY expressions over the child schema. Frame is
+    the MySQL default (whole partition, or RANGE UNBOUNDED PRECEDING..
+    CURRENT ROW peer-inclusive when ordered)."""
+
+    __slots__ = ("name", "args", "partition_by", "order_by")
+
+    def __init__(self, name: str, args: list[Expression],
+                 partition_by: list[Expression],
+                 order_by: list["SortItem"]):
+        self.name = name
+        self.args = args
+        self.partition_by = partition_by
+        self.order_by = order_by
+
+    def __repr__(self):
+        return (f"{self.name}({self.args!r}) over("
+                f"partition:{self.partition_by!r} "
+                f"order:{self.order_by!r})")
+
+
+class Window(Plan):
+    """Window evaluation: child rows pass through in input order with
+    one appended column per window call (logical_plans.go LogicalWindow;
+    schema = child schema + window columns)."""
+
+    def __init__(self, window_funcs: list[WindowFuncDesc]):
+        super().__init__("window")
+        self.window_funcs = window_funcs
+
+
 class Limit(Plan):
     def __init__(self, offset: int, count: int):
         super().__init__("limit")
@@ -400,6 +432,12 @@ class PhysicalSort(PhysicalPlan):
         self.by_items = by_items
 
 
+class PhysicalWindow(PhysicalPlan):
+    def __init__(self, window_funcs: list[WindowFuncDesc]):
+        super().__init__("pwindow")
+        self.window_funcs = window_funcs
+
+
 class PhysicalTopN(PhysicalPlan):
     def __init__(self, by_items: list[SortItem], offset: int, count: int):
         super().__init__("ptopn")
@@ -515,6 +553,8 @@ def tree_string(p: Plan, indent: str = "") -> str:
         detail = f" funcs:{p.agg_funcs!r} group_by:{p.group_by!r}"
     elif isinstance(p, (PhysicalSort, Sort)):
         detail = f" {p.by_items!r}"
+    elif isinstance(p, (PhysicalWindow, Window)):
+        detail = f" funcs:{p.window_funcs!r}"
     elif isinstance(p, PhysicalTopN):
         detail = f" {p.by_items!r} limit:{p.offset},{p.count}"
     elif isinstance(p, (PhysicalLimit, Limit)):

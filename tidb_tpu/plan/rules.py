@@ -21,7 +21,7 @@ from tidb_tpu.expression.expression import Cast
 from tidb_tpu.plan.plans import (
     Aggregation, Apply, DataSource, Delete, Distinct, Exists, ExplainPlan,
     Insert, Join, Limit, MaxOneRow, Plan, Projection, Selection, SemiJoin,
-    Sort, TableDual, Union, Update,
+    Sort, TableDual, Union, Update, Window,
 )
 from tidb_tpu.sqlast.opcode import Op
 
@@ -198,6 +198,13 @@ def predicate_push_down(p: Plan, predicates: list[Expression] | None = None):
     if isinstance(p, Aggregation):
         # conditions on agg outputs stay above (HAVING); group-key-only
         # pushdown is a later optimization
+        rem, child = predicate_push_down(p.child, [])
+        p.children = [_maybe_wrap_selection(child, rem)]
+        return preds, p
+
+    if isinstance(p, Window):
+        # filters never cross a window (they would change partition
+        # membership and hence every rank/frame value)
         rem, child = predicate_push_down(p.child, [])
         p.children = [_maybe_wrap_selection(child, rem)]
         return preds, p
@@ -594,6 +601,12 @@ def iter_plan_exprs(p: Plan):
     elif isinstance(p, Sort):
         for it in p.by_items:
             yield it.expr
+    elif isinstance(p, Window):
+        for d in p.window_funcs:
+            yield from d.args
+            yield from d.partition_by
+            for it in d.order_by:
+                yield it.expr
     elif isinstance(p, Join):
         for lcol, rcol in p.eq_conditions:
             yield lcol
@@ -743,6 +756,14 @@ def resolve_indices(p: Plan) -> None:
     elif isinstance(p, Sort):
         for item in p.by_items:
             _bind_expr(item.expr, lookup)
+    elif isinstance(p, Window):
+        for d in p.window_funcs:
+            for a in d.args:
+                _bind_expr(a, lookup)
+            for e in d.partition_by:
+                _bind_expr(e, lookup)
+            for item in d.order_by:
+                _bind_expr(item.expr, lookup)
     elif isinstance(p, Update):
         for _, e in p.ordered_list:
             _bind_expr(e, lookup)

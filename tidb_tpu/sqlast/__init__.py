@@ -10,6 +10,7 @@ from tidb_tpu.sqlast.base import Node, ExprNode, StmtNode, Visitor  # noqa: F401
 from tidb_tpu.sqlast.opcode import Op  # noqa: F401
 from tidb_tpu.sqlast.expressions import (  # noqa: F401
     Literal, ColumnName, BinaryOp, UnaryOp, FuncCall, AggregateFunc,
+    WindowFunc,
     Between, InExpr, IntervalExpr, PatternLike, PatternRegexp, IsNull,
     CaseExpr, WhenClause,
     ParamMarker, RowExpr, DefaultExpr, VariableExpr, CastExpr,

@@ -81,6 +81,21 @@ class AggregateFunc(ExprNode):
 
 
 @dataclass
+class WindowFunc(ExprNode):
+    """Window function call (ast.WindowFuncExpr):
+    name(args) OVER (PARTITION BY exprs ORDER BY by_items). Ranking
+    functions (row_number/rank/dense_rank) carry no args; the frame
+    reductions (sum/count/min/max) carry exactly one. The frame is the
+    MySQL default: the whole partition without ORDER BY, RANGE UNBOUNDED
+    PRECEDING..CURRENT ROW (peer-inclusive) with it."""
+    name: str
+    args: list[ExprNode] = field(default_factory=list)
+    partition_by: list[ExprNode] = field(default_factory=list)
+    order_by: list[Any] = field(default_factory=list)   # dml.ByItem
+    ftype: Any = None
+
+
+@dataclass
 class Between(ExprNode):
     expr: ExprNode
     low: ExprNode
